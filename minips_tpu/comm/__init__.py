@@ -1,0 +1,2 @@
+from minips_tpu.comm.bus import ControlBus  # noqa: F401
+from minips_tpu.comm.heartbeat import HeartbeatMonitor  # noqa: F401

@@ -1,0 +1,124 @@
+"""ControlBus — the surviving sliver of the reference's ZeroMQ Mailbox.
+
+The reference routes *all* traffic (push/pull payloads, clocks, barriers,
+heartbeats) through a zmq ROUTER/DEALER mailbox (SURVEY.md §2.3). In the
+rebuild the data plane is XLA collectives, so the only traffic that still
+needs sockets is the control plane: SSP clock gossip and heartbeats, which
+must stay nonblocking while a TPU step runs (SURVEY.md §2.3 "Control
+plane"). This is a deliberately tiny pub/sub bus: every process binds one
+PUB socket and subscribes to all peers; messages are small JSON dicts
+``{kind, sender, payload}``.
+
+Tested over loopback in-process (the reference tests its mailbox the same
+way — threads as nodes, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Optional
+
+try:
+    import zmq
+    _HAS_ZMQ = True
+except ImportError:  # pragma: no cover - zmq is present in the target env
+    _HAS_ZMQ = False
+
+
+class ControlBus:
+    """PUB/SUB gossip bus: ``publish(kind, payload)`` fans out to all peers;
+    handlers registered per kind run on a background receive thread."""
+
+    def __init__(self, my_addr: str, peer_addrs: list[str],
+                 my_id: int = 0):
+        if not _HAS_ZMQ:
+            raise RuntimeError("pyzmq not available")
+        self.my_id = my_id
+        self._ctx = zmq.Context.instance()
+        self._pub = self._ctx.socket(zmq.PUB)
+        self._pub.bind(my_addr)
+        self._sub = self._ctx.socket(zmq.SUB)
+        for addr in peer_addrs:
+            self._sub.connect(addr)
+        self._sub.setsockopt_string(zmq.SUBSCRIBE, "")
+        self._handlers: dict[str, Callable[[int, dict], None]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._pub_lock = threading.Lock()
+
+    def on(self, kind: str, handler: Callable[[int, dict], None]) -> None:
+        """Register ``handler(sender_id, payload)`` for message kind."""
+        self._handlers[kind] = handler
+
+    def start(self) -> "ControlBus":
+        self._thread = threading.Thread(target=self._recv_loop, daemon=True)
+        self._thread.start()
+        # PUB/SUB needs a beat for subscriptions to propagate (slow joiner).
+        time.sleep(0.05)
+        return self
+
+    def publish(self, kind: str, payload: dict) -> None:
+        msg = json.dumps({"kind": kind, "sender": self.my_id,
+                          "payload": payload})
+        with self._pub_lock:
+            self._pub.send_string(msg)
+
+    def _recv_loop(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self._sub, zmq.POLLIN)
+        while not self._stop.is_set():
+            if not dict(poller.poll(timeout=50)):
+                continue
+            try:
+                msg = json.loads(self._sub.recv_string(zmq.NOBLOCK))
+            except (zmq.ZMQError, json.JSONDecodeError):
+                continue
+            handler = self._handlers.get(msg.get("kind"))
+            if handler is not None:
+                handler(msg.get("sender", -1), msg.get("payload", {}))
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+        self._pub.close(linger=0)
+        self._sub.close(linger=0)
+
+    def __enter__(self) -> "ControlBus":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class ClockGossip:
+    """SSP clock exchange over the bus (SURVEY.md §7.4): each process
+    publishes its local worker clocks; the merged global view feeds the
+    host-side staleness gate."""
+
+    def __init__(self, bus: ControlBus, num_processes: int,
+                 workers_per_process: int):
+        self.bus = bus
+        self._clocks = {p: [0] * workers_per_process
+                        for p in range(num_processes)}
+        self._lock = threading.Lock()
+        bus.on("clock", self._on_clock)
+
+    def _on_clock(self, sender: int, payload: dict) -> None:
+        with self._lock:
+            self._clocks[sender] = list(payload.get("clocks", []))
+
+    def publish_local(self, clocks: list[int]) -> None:
+        with self._lock:
+            self._clocks[self.bus.my_id] = list(clocks)
+        self.bus.publish("clock", {"clocks": list(clocks)})
+
+    def global_min(self) -> int:
+        with self._lock:
+            return min(min(v) for v in self._clocks.values() if v)
+
+    def snapshot(self) -> dict[int, list[int]]:
+        with self._lock:
+            return {k: list(v) for k, v in self._clocks.items()}

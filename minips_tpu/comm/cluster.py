@@ -1,0 +1,51 @@
+"""Multi-host bootstrap — the rebuild of the launch-script + mailbox bind.
+
+The reference spawns one process per node via ssh with ``--my_id i`` and a
+hostfile; the mailbox binds zmq ROUTER sockets (SURVEY.md §1 L7, §3.1). On
+TPU pods the moral equivalent is ``jax.distributed.initialize`` — the
+coordination service wires processes into one JAX runtime, after which the
+*data plane* is XLA collectives over ICI/DCN and needs no sockets at all
+(SURVEY.md §2.3). Only the SSP clock gossip + heartbeats keep a socket bus
+(minips_tpu/comm/bus.py).
+
+Single-process (this sandbox) everything degrades to no-ops.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the cluster. Mirrors the reference's ``--my_id`` flag surface:
+    pass explicit args or set JAX's standard env vars; single-process if
+    neither is present."""
+    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ:
+        return  # single-process
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def barrier(name: str = "minips_barrier", timeout_s: int = 120) -> None:
+    """Cluster-wide barrier (reference Engine::Barrier, SURVEY.md §3.4)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

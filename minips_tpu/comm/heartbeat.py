@@ -1,0 +1,76 @@
+"""Heartbeat / failure detection — rebuild of the reference's liveness pings.
+
+The reference's lineage runs periodic heartbeats through the mailbox with a
+master that detects dead nodes and triggers restart-from-checkpoint
+(SURVEY.md §2 "Heartbeat / failure detection", §5.3). Here heartbeats ride
+the control bus; a monitor flags peers whose last beat is older than
+``timeout``; the recovery action (reload latest checkpoint and relaunch —
+restart semantics are all-or-nothing per JAX job, SURVEY.md §7.4.5) is the
+caller's, delivered via the ``on_failure`` callback.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from minips_tpu.comm.bus import ControlBus
+
+
+class HeartbeatMonitor:
+    def __init__(self, bus: ControlBus, peer_ids: list[int],
+                 interval: float = 1.0, timeout: float = 5.0,
+                 on_failure: Optional[Callable[[int], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.bus = bus
+        self.interval = interval
+        self.timeout = timeout
+        self.on_failure = on_failure
+        self._clock = clock
+        now = clock()
+        self._last_seen = {p: now for p in peer_ids if p != bus.my_id}
+        self._dead: set[int] = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        bus.on("heartbeat", self._on_beat)
+
+    def _on_beat(self, sender: int, payload: dict) -> None:
+        with self._lock:
+            if sender in self._last_seen:
+                self._last_seen[sender] = self._clock()
+
+    def check(self) -> set[int]:
+        """Sweep for newly-dead peers; fires on_failure once per peer."""
+        newly_dead = []
+        with self._lock:
+            now = self._clock()
+            for p, seen in self._last_seen.items():
+                if p not in self._dead and now - seen > self.timeout:
+                    self._dead.add(p)
+                    newly_dead.append(p)
+        for p in newly_dead:
+            if self.on_failure is not None:
+                self.on_failure(p)
+        return set(self._dead)
+
+    def start(self) -> "HeartbeatMonitor":
+        def loop() -> None:
+            while not self._stop.wait(self.interval):
+                self.bus.publish("heartbeat", {"t": self._clock()})
+                self.check()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def dead(self) -> set[int]:
+        with self._lock:
+            return set(self._dead)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

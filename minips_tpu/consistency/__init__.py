@@ -1,0 +1,8 @@
+from minips_tpu.consistency.tracker import PendingBuffer, ProgressTracker  # noqa: F401
+from minips_tpu.consistency.controllers import (  # noqa: F401
+    ASP,
+    BSP,
+    SSP,
+    ConsistencyController,
+    make_controller,
+)

@@ -1,0 +1,2 @@
+from minips_tpu.core.config import Config, TableConfig, TrainConfig  # noqa: F401
+from minips_tpu.core.engine import Engine, Info, MLTask  # noqa: F401

@@ -1,0 +1,7 @@
+from minips_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    local_mesh_size,
+)
+from minips_tpu.parallel.partition import RangePartitioner  # noqa: F401

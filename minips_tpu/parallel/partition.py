@@ -1,0 +1,40 @@
+"""RangePartitioner — the rebuild of SimpleRangeManager (SURVEY.md §2).
+
+The reference partitions each table's key space into contiguous ranges, one
+per server thread, and splits a request's keys into per-server slices
+(``Gen(keys) -> per-server slices``). Here the partition *is* the sharding:
+a table of ``n`` keys padded to ``P`` is laid out as ``shards`` contiguous
+ranges of ``P/shards`` keys, shard ``i`` living on mesh position ``i`` of the
+data axis. The partitioner is pure index math used by the KVClientTable
+emulation path and by tests; the SPMD fast path never materializes slices —
+XLA's reduce-scatter/all-gather embody the same range partition.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from minips_tpu.parallel.mesh import padded_size
+
+
+class RangePartitioner:
+    def __init__(self, num_keys: int, num_shards: int):
+        self.num_keys = int(num_keys)
+        self.num_shards = int(num_shards)
+        self.padded = padded_size(self.num_keys, self.num_shards)
+        self.shard_size = self.padded // self.num_shards
+
+    def shard_of(self, keys: np.ndarray) -> np.ndarray:
+        """Owner shard id for each key (contiguous ranges)."""
+        return np.asarray(keys) // self.shard_size
+
+    def split(self, keys: np.ndarray) -> list[np.ndarray]:
+        """Reference ``Gen(keys) -> per-server slices``: group keys by owner,
+        preserving sorted order within each slice."""
+        keys = np.asarray(keys)
+        owners = self.shard_of(keys)
+        return [keys[owners == s] for s in range(self.num_shards)]
+
+    def local_offset(self, keys: np.ndarray) -> np.ndarray:
+        """Offset of each key within its owner shard."""
+        return np.asarray(keys) % self.shard_size

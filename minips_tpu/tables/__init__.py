@@ -1,0 +1,3 @@
+from minips_tpu.tables.updaters import make_updater  # noqa: F401
+from minips_tpu.tables.dense import DenseTable  # noqa: F401
+from minips_tpu.tables.sparse import SparseTable  # noqa: F401

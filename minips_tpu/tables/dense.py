@@ -1,0 +1,232 @@
+"""DenseTable — the KVTable + RangeManager + updater collapsed into data.
+
+The reference's dense path is ``VectorStorage<Val>`` on server threads, a
+``SimpleRangeManager`` contiguous key partition, and a server-side updater
+applied at push (SURVEY.md §2 "KVTable storage", "SimpleRangeManager",
+"Updaters"; §3.3 hot loop). TPU-first, all three collapse into one object:
+
+- The table's key space 0..n-1 is a flat parameter vector, padded to ``P``
+  and sharded in contiguous ranges across the mesh's ``data`` axis — the
+  range partition *is* the ``PartitionSpec``.
+- ``pull``  ≡ ``all_gather``  of the owner shards (SURVEY.md §2.3).
+- ``push``  ≡ ``psum_scatter`` of worker grads into the owner shard followed
+  by the optax updater on that shard — i.e. weight-update sharding
+  (PAPERS.md, arXiv 2004.13336), which is exactly the PS server role.
+- ``make_step`` fuses pull → grad → push → update into ONE jitted SPMD
+  program so XLA overlaps the collectives with compute; this is the hot
+  path replacing the reference's zmq round-trips (SURVEY.md §3.3).
+
+Apps see parameters as a pytree: the table ravels any pytree template via
+``jax.flatten_util.ravel_pytree``, so "keys" are positions in the raveled
+vector — the same world view as the reference's integer key space.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from minips_tpu.parallel.mesh import DATA_AXIS, padded_size
+from minips_tpu.parallel.partition import RangePartitioner
+from minips_tpu.tables.updaters import make_updater
+
+PyTree = Any
+
+
+class DenseTable:
+    """A dense parameter table sharded across the mesh ``data`` axis."""
+
+    def __init__(
+        self,
+        template: PyTree,
+        mesh: Mesh,
+        *,
+        name: str = "dense0",
+        updater: str = "sgd",
+        lr: float = 0.1,
+        grad_reduce: str = "mean",
+        tx: Optional[optax.GradientTransformation] = None,
+    ):
+        if grad_reduce not in ("mean", "sum"):
+            raise ValueError("grad_reduce must be 'mean' or 'sum'")
+        self.name = name
+        self.mesh = mesh
+        self.grad_reduce = grad_reduce
+        self.num_shards = mesh.shape[DATA_AXIS]
+        self.tx = tx if tx is not None else make_updater(updater, lr)
+
+        flat, self._unravel = ravel_pytree(template)
+        self.num_keys = int(flat.shape[0])
+        self.partitioner = RangePartitioner(self.num_keys, self.num_shards)
+        self.padded = self.partitioner.padded
+        self._shard_shape = (self.padded // self.num_shards,)
+
+        self._pspec = P(DATA_AXIS)
+        self._sharding = NamedSharding(mesh, self._pspec)
+        padded_flat = jnp.zeros(self.padded, flat.dtype).at[: self.num_keys].set(flat)
+        self.params = jax.device_put(padded_flat, self._sharding)
+
+        opt_state = jax.eval_shape(self.tx.init, self.params)
+        opt_shardings = jax.tree.map(
+            lambda l: NamedSharding(
+                mesh, P(DATA_AXIS) if l.shape == (self.padded,) else P()
+            ),
+            opt_state,
+        )
+        # Note: specs below describe the *global* opt leaves; inside shard_map
+        # sharded leaves have the per-shard shape.
+        self.opt_state = jax.jit(
+            self.tx.init, out_shardings=opt_shardings
+        )(self.params)
+        self._opt_specs = jax.tree.map(
+            lambda l: P(DATA_AXIS) if l.shape == (self.padded,) else P(), opt_state
+        )
+
+    # ------------------------------------------------------------------ pull
+    def pull(self) -> PyTree:
+        """Full parameter pytree (all-gather of the owner shards).
+
+        Reference: ``KVClientTable::Pull/Get`` over all keys (SURVEY.md §2
+        "KVClientTable"). Under jit this is an all-gather on ICI; as a host
+        call it just reads the (distributed) array.
+        """
+        return self._unravel(self.params[: self.num_keys])
+
+    def pull_keys(self, keys: np.ndarray) -> jnp.ndarray:
+        """Sparse read of a dense table (emulation/API-parity path)."""
+        return self.params[jnp.asarray(keys)]
+
+    # ------------------------------------------------------------------ push
+    def push(self, grads: PyTree) -> None:
+        """Apply a full-pytree gradient through the server-side updater.
+
+        Reference: ``KVClientTable::Push/Add`` → server ``updater->Update``
+        (SURVEY.md §3.3). The caller passes the already-reduced gradient
+        (the engine's fused path reduces across workers itself).
+        """
+        gflat, _ = ravel_pytree(grads)
+        self._push_flat(jnp.zeros(self.padded, gflat.dtype)
+                        .at[: self.num_keys].set(gflat))
+
+    def push_keys(self, keys: np.ndarray, vals: jnp.ndarray) -> None:
+        """Sparse additive push into a dense table (emulation path).
+
+        Per-key server semantics (SURVEY.md §3.3 ``updater->Update(keys,
+        grads)``): only the pushed keys' parameters and elementwise
+        optimizer state move; untouched keys are masked out so stateful
+        updaters (adam/momentum) do not drift them. Scalar opt-state
+        (e.g. adam's step count) still advances once per push.
+        """
+        keys = jnp.asarray(keys)
+        flat = jnp.zeros(self.padded, self.params.dtype).at[keys].add(vals)
+        mask = jnp.zeros(self.padded, self.params.dtype).at[keys].set(1.0)
+        self.params, self.opt_state = self._jit_apply_masked(
+            self.params, self.opt_state, flat, mask)
+
+    def _push_flat(self, flat_grads: jnp.ndarray) -> None:
+        self.params, self.opt_state = self._jit_apply(
+            self.params, self.opt_state, flat_grads
+        )
+
+    def _make_apply(self, masked: bool):
+        vec_shard = (self.padded // self.num_shards,)
+        in_specs = (self._pspec, self._opt_specs, self._pspec) + (
+            (self._pspec,) if masked else ())
+
+        def apply_shard(p_shard, opt_shard, g_shard, *mask):
+            updates, new_opt = self.tx.update(g_shard, opt_shard, p_shard)
+            if masked:
+                m = mask[0]
+                updates = updates * m
+                new_opt = jax.tree.map(
+                    lambda new, old: jnp.where(m > 0, new, old)
+                    if getattr(new, "shape", ()) == vec_shard else new,
+                    new_opt, opt_shard)
+            return optax.apply_updates(p_shard, updates), new_opt
+
+        return jax.jit(
+            jax.shard_map(apply_shard, mesh=self.mesh, in_specs=in_specs,
+                          out_specs=(self._pspec, self._opt_specs)),
+            donate_argnums=(0, 1))
+
+    @functools.cached_property
+    def _jit_apply(self):
+        return self._make_apply(masked=False)
+
+    @functools.cached_property
+    def _jit_apply_masked(self):
+        return self._make_apply(masked=True)
+
+    # ------------------------------------------------------------- fused step
+    def make_step(
+        self,
+        grad_fn: Callable[[PyTree, Any], tuple[jnp.ndarray, PyTree]],
+        *,
+        batch_spec: Optional[PyTree] = None,
+        jit: bool = True,
+    ):
+        """Fuse pull → grad → push → update into one SPMD program.
+
+        ``grad_fn(params_pytree, batch_shard) -> (loss, grads_pytree)`` runs
+        per worker on its batch shard; the returned ``step(params, opt,
+        batch) -> (params, opt, loss)`` is the TPU-native rewrite of one hot
+        loop iteration (SURVEY.md §3.3): all-gather (pull), local grad
+        (worker compute on MXU), psum_scatter (push), optax on the owner
+        shard (server update). BSP is implicit — the collectives are the
+        barrier (SURVEY.md §2 "BSPModel").
+        """
+        n, padded = self.num_keys, self.padded
+        num_workers = self.num_shards
+        unravel, tx, reduce = self._unravel, self.tx, self.grad_reduce
+        bspec = batch_spec if batch_spec is not None else P(DATA_AXIS)
+
+        def local_step(p_shard, opt_shard, batch):
+            full = jax.lax.all_gather(p_shard, DATA_AXIS, tiled=True)  # pull
+            loss, grads = grad_fn(unravel(full[:n]), batch)
+            gflat, _ = ravel_pytree(grads)
+            gpad = jnp.zeros(padded, gflat.dtype).at[:n].set(gflat)
+            g_shard = jax.lax.psum_scatter(gpad, DATA_AXIS, tiled=True)  # push
+            if reduce == "mean":
+                g_shard = g_shard / num_workers
+            updates, opt_shard = tx.update(g_shard, opt_shard, p_shard)
+            p_shard = optax.apply_updates(p_shard, updates)
+            return p_shard, opt_shard, jax.lax.pmean(loss, DATA_AXIS)
+
+        step = jax.shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(self._pspec, self._opt_specs, bspec),
+            out_specs=(self._pspec, self._opt_specs, P()),
+        )
+        if jit:
+            step = jax.jit(step, donate_argnums=(0, 1))
+        return step
+
+    def step_inplace(self, step, batch) -> jnp.ndarray:
+        """Run a fused step against the table's own state."""
+        self.params, self.opt_state, loss = step(self.params, self.opt_state, batch)
+        return loss
+
+    # ------------------------------------------------------------- state I/O
+    def state_dict(self) -> dict:
+        """Host copies for checkpointing (params + opt state)."""
+        return {
+            "params": np.asarray(self.params),
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.params = jax.device_put(
+            jnp.asarray(state["params"]), self._sharding)
+        self.opt_state = jax.tree.map(
+            lambda cur, new: jax.device_put(jnp.asarray(new), cur.sharding),
+            self.opt_state, state["opt_state"],
+        )

@@ -1,0 +1,176 @@
+"""SparseTable — fixed-capacity hashed embedding replacing MapStorage.
+
+The reference's sparse path is ``MapStorage<Val>`` — a per-server
+``std::map<key, val>`` grown on demand (SURVEY.md §2 "KVTable storage").
+TPUs have no dynamic dictionaries: XLA needs static shapes. The TPU-native
+equivalent (SURVEY.md §7.1) is a fixed-slot embedding matrix
+``[num_slots, dim]`` with multiplicative hashing of the (unbounded) feature
+id space onto slots — the standard "hashing trick" used by production CTR
+systems for exactly this workload family (Criteo W&D/DeepFM,
+BASELINE.json:10).
+
+Sharding: rows are range-partitioned across the mesh ``data`` axis
+(``PartitionSpec('data', None)``) — the same contiguous-range server
+partition as the reference's RangeManager, but expressed as a sharding so
+XLA GSPMD inserts the gather/scatter collectives (SURVEY.md §2.3; PAPERS.md
+SparCML is the sparse-collective analog).
+
+``pull(keys)`` is a row gather; ``push(keys, grads)`` scatter-adds duplicate
+keys (reference ``Add`` semantics) and applies the server-side updater.
+Per-row lazy updates for Adagrad keep push cost O(batch · dim) instead of
+O(num_slots · dim) — the reference's per-key server update has the same
+sparsity property.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from minips_tpu.parallel.mesh import DATA_AXIS
+
+_HASH_MULT = np.uint32(2654435761)  # Knuth multiplicative hash
+
+
+def hash_to_slots(keys: jnp.ndarray, num_slots: int, salt: int = 0) -> jnp.ndarray:
+    """Hash arbitrary int feature ids onto [0, num_slots). num_slots must be
+    a power of two (masked multiply-shift hash, cheap on VPU)."""
+    assert num_slots & (num_slots - 1) == 0, "num_slots must be a power of 2"
+    k = keys.astype(jnp.uint32)
+    h = (k * _HASH_MULT) ^ (k >> 16) ^ jnp.uint32(salt)
+    return (h & jnp.uint32(num_slots - 1)).astype(jnp.int32)
+
+
+class SparseTable:
+    """Hashed, sharded embedding table with server-side SGD/Adagrad on push."""
+
+    def __init__(
+        self,
+        num_slots: int,
+        dim: int,
+        mesh: Mesh,
+        *,
+        name: str = "sparse0",
+        updater: str = "sgd",
+        lr: float = 0.05,
+        init_scale: float = 0.01,
+        adagrad_init: float = 0.1,
+        salt: int = 0,
+        seed: int = 0,
+        dtype=jnp.float32,
+    ):
+        if updater not in ("sgd", "adagrad"):
+            raise ValueError("sparse updater must be 'sgd' or 'adagrad'")
+        self.name = name
+        self.mesh = mesh
+        self.num_slots = int(num_slots)
+        self.dim = int(dim)
+        self.updater = updater
+        self.lr = lr
+        self.adagrad_init = adagrad_init
+        self.salt = salt
+
+        self._sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+        key = jax.random.PRNGKey(seed)
+        emb = jax.random.normal(key, (self.num_slots, self.dim), dtype) * init_scale
+        self.emb = jax.device_put(emb, self._sharding)
+        if updater == "adagrad":
+            self.accum = jax.device_put(
+                jnp.full((self.num_slots, self.dim), adagrad_init, dtype),
+                self._sharding,
+            )
+        else:
+            self.accum = None
+
+    # ------------------------------------------------------------------ hash
+    def slots_of(self, keys: jnp.ndarray) -> jnp.ndarray:
+        return hash_to_slots(jnp.asarray(keys), self.num_slots, self.salt)
+
+    # ------------------------------------------------------------------ pull
+    def pull(self, keys: jnp.ndarray) -> jnp.ndarray:
+        """Gather embedding rows for (hashed) keys — KVClientTable::Pull for
+        sparse tables (SURVEY.md §2 "KVClientTable"). [B] or [B, F] keys →
+        [..., dim] rows."""
+        return self._jit_pull(self.emb, jnp.asarray(keys))
+
+    @functools.cached_property
+    def _jit_pull(self):
+        @jax.jit
+        def pull(emb, keys):
+            return emb[hash_to_slots(keys, self.num_slots, self.salt)]
+        return pull
+
+    # ------------------------------------------------------------------ push
+    def push(self, keys: jnp.ndarray, grads: jnp.ndarray) -> None:
+        """Scatter-add grads for (hashed) keys and apply the updater to the
+        touched rows only — the reference's per-key server update
+        (SURVEY.md §3.3 ``updater->Update(keys, grads)``)."""
+        if self.updater == "sgd":
+            self.emb = self._jit_push_sgd(self.emb, jnp.asarray(keys),
+                                          jnp.asarray(grads))
+        else:
+            self.emb, self.accum = self._jit_push_adagrad(
+                self.emb, self.accum, jnp.asarray(keys), jnp.asarray(grads))
+
+    @functools.cached_property
+    def _jit_push_sgd(self):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def push(emb, keys, grads):
+            slots = hash_to_slots(keys, self.num_slots, self.salt)
+            return emb.at[slots.reshape(-1)].add(
+                -self.lr * grads.reshape(-1, self.dim))
+        return push
+
+    @functools.cached_property
+    def _jit_push_adagrad(self):
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def push(emb, accum, keys, grads):
+            slots = hash_to_slots(keys, self.num_slots, self.salt).reshape(-1)
+            g = grads.reshape(-1, self.dim)
+            # Sum duplicate keys first (reference Add semantics), then do one
+            # row-wise adagrad step on the summed grad. segment-style sum via
+            # scatter-add into a dense grad buffer restricted to touched rows
+            # would still be O(num_slots); instead sum duplicates with a
+            # sorted-segment trick that stays O(B log B).
+            order = jnp.argsort(slots)
+            s_sorted = slots[order]
+            g_sorted = g[order]
+            first = jnp.concatenate(
+                [jnp.ones(1, jnp.bool_), s_sorted[1:] != s_sorted[:-1]])
+            seg_id = jnp.cumsum(first) - 1
+            n = s_sorted.shape[0]
+            g_sum = jnp.zeros((n, self.dim), g.dtype).at[seg_id].add(g_sorted)
+            # representative slot per segment (padded with slot of last seg)
+            rep = jnp.zeros(n, jnp.int32).at[seg_id].max(s_sorted)
+            valid = jnp.arange(n) <= seg_id[-1]
+            rep = jnp.where(valid, rep, 0)
+            g_sum = jnp.where(valid[:, None], g_sum, 0.0)
+            # scatter-ADD a zero delta for padding rows: duplicate padded
+            # indices are harmless under add (they would race under set)
+            g2 = g_sum * g_sum
+            acc_rows = accum[rep] + g2
+            accum = accum.at[rep].add(g2)
+            # epsilon guards adagrad_init=0 + zero-grad dims (0/sqrt(0)=NaN)
+            step = -self.lr * g_sum / (jnp.sqrt(acc_rows) + 1e-10)
+            emb = emb.at[rep].add(step)
+            return emb, accum
+        return push
+
+    # ------------------------------------------------------------- state I/O
+    def state_dict(self) -> dict:
+        out = {"emb": np.asarray(self.emb)}
+        if self.accum is not None:
+            out["accum"] = np.asarray(self.accum)
+        return out
+
+    def load_state_dict(self, state: dict) -> None:
+        self.emb = jax.device_put(jnp.asarray(state["emb"]), self._sharding)
+        if self.accum is not None and "accum" in state:
+            self.accum = jax.device_put(jnp.asarray(state["accum"]),
+                                        self._sharding)

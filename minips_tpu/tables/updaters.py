@@ -1,0 +1,28 @@
+"""Server-side updaters — rebuild of the reference's SGD/Adagrad updaters.
+
+The reference applies the optimizer **on the server, at push time**
+(``model->Add -> updater->Update(keys, grads) -> storage``, SURVEY.md §3.3),
+which is exactly optax applied to the owner shard of the parameters inside
+the fused SPMD step (SURVEY.md §2 "Updaters"). SGD and Adagrad are the two
+the reference ships (BASELINE.json:3 via SURVEY.md §2); Adam is added because
+it costs nothing under optax and apps want it.
+"""
+
+from __future__ import annotations
+
+import optax
+
+UPDATERS = ("sgd", "adagrad", "adam")
+
+
+def make_updater(name: str, lr: float, **kwargs) -> optax.GradientTransformation:
+    name = name.lower()
+    if name == "sgd":
+        return optax.sgd(lr, momentum=kwargs.get("momentum", 0.0) or None)
+    if name == "adagrad":
+        # Reference Adagrad accumulates squared grads per key; optax matches.
+        return optax.adagrad(lr, initial_accumulator_value=kwargs.get(
+            "initial_accumulator_value", 0.1))
+    if name == "adam":
+        return optax.adam(lr, b1=kwargs.get("b1", 0.9), b2=kwargs.get("b2", 0.999))
+    raise ValueError(f"unknown updater {name!r}; expected one of {UPDATERS}")

@@ -1,0 +1,2 @@
+from minips_tpu.utils.metrics import MetricsLogger  # noqa: F401
+from minips_tpu.utils.timing import StepTimer  # noqa: F401

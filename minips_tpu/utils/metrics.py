@@ -1,0 +1,44 @@
+"""Structured JSONL metrics — rebuild of the reference's glog loss printing.
+
+The reference logs per-iteration loss via glog (SURVEY.md §5.5). Here metrics
+are structured JSONL records carrying the [T1] primary metric
+(samples/sec/chip) plus SSP's key observable, min/max clock skew
+(SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO, Any, Optional
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics sink; also mirrors to stderr when verbose."""
+
+    def __init__(self, path: Optional[str] = None, verbose: bool = True):
+        self._fh: Optional[IO[str]] = open(path, "a") if path else None
+        self._verbose = verbose
+        self._t0 = time.monotonic()
+
+    def log(self, **record: Any) -> dict:
+        record.setdefault("t", round(time.monotonic() - self._t0, 6))
+        line = json.dumps(record, sort_keys=True)
+        if self._fh is not None:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        if self._verbose:
+            print(line, file=sys.stderr)
+        return record
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

@@ -1,0 +1,36 @@
+"""Test bootstrap: 8 fake CPU devices — the "threads as nodes" trick.
+
+The reference tests multi-node behavior with in-process threads + a fake
+mailbox (SURVEY.md §4); the JAX equivalent is forcing the CPU platform with
+8 host devices so every mesh/sharding/collective path runs TPU-free
+(SURVEY.md §4 "Rebuild mapping"). NOTE: in this sandbox the axon TPU plugin
+ignores the JAX_PLATFORMS env var, so the config.update path is required
+and must run before the first backend-touching call.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from minips_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) == 8, "expected 8 fake CPU devices"
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="session")
+def mesh4():
+    from minips_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(4, devices=jax.devices()[:4])

@@ -1,0 +1,125 @@
+"""Scripted Add/Get/Clock admission tests — the reference's most-tested
+surface, tested the same way (SURVEY.md §4): pure logic, no devices."""
+
+import threading
+import time
+
+import pytest
+
+from minips_tpu.consistency import ASP, BSP, SSP, PendingBuffer, ProgressTracker, make_controller
+
+
+# ------------------------------------------------------------- ProgressTracker
+def test_tracker_advance_and_changed_min():
+    t = ProgressTracker(3)
+    assert t.min_clock == 0 and t.skew == 0
+    assert t.advance(0) is None          # clocks [1,0,0] — min unchanged
+    assert t.advance(1) is None          # [1,1,0]
+    assert t.skew == 1
+    assert t.advance(2) == 1             # [1,1,1] — min moved to 1
+    assert t.advance(2) is None          # [1,1,2]
+    assert t.max_clock == 2
+
+
+def test_pending_buffer_fifo_by_clock():
+    b = PendingBuffer()
+    b.park(2, "a")
+    b.park(1, "b")
+    b.park(2, "c")
+    assert b.num_parked == 3
+    assert b.pop_ready(0) == []
+    assert b.pop_ready(1) == ["b"]
+    assert b.pop_ready(2) == ["a", "c"]
+    assert b.num_parked == 0
+
+
+# ----------------------------------------------------------------- controllers
+def test_bsp_admission_matrix():
+    c = BSP(2)
+    # both at clock 0: both admitted
+    assert c.admit(0) and c.admit(1)
+    c.clock(0)  # worker0 -> 1
+    # worker0 must wait for worker1 (min=0 < 1-0)
+    assert not c.admit(0)
+    assert c.admit(1)
+    c.clock(1)
+    assert c.admit(0) and c.admit(1)
+
+
+def test_ssp_staleness_window():
+    c = SSP(2, staleness=2)
+    for _ in range(2):
+        c.clock(0)
+    assert c.admit(0)            # my=2, min=0, 0 >= 2-2
+    c.clock(0)                   # my=3
+    assert not c.admit(0)        # 0 < 3-2
+    c.clock(1)                   # min=1
+    assert c.admit(0)
+    assert c.skew == 2
+
+
+def test_asp_never_blocks():
+    c = ASP(2, sync_every=0)
+    for _ in range(100):
+        c.clock(0)
+    assert c.admit(0) and c.admit(1)
+    assert not c.should_sync(0)
+
+
+def test_asp_sync_every():
+    c = ASP(2, sync_every=4)
+    assert not c.should_sync(0)  # clock 0
+    for _ in range(4):
+        c.clock(0)
+    assert c.should_sync(0)      # clock 4 % 4 == 0
+    c.clock(0)
+    assert not c.should_sync(0)
+
+
+def test_blocked_pull_wakes_on_clock():
+    """The AppBlocker rendezvous (SURVEY.md §2): a BSP worker parked on a
+    pull is woken when the laggard clocks."""
+    c = BSP(2)
+    c.clock(0)  # worker0 ahead
+    admitted = []
+
+    def waiter():
+        admitted.append(c.wait_until_admitted(0, timeout=5.0))
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.05)
+    assert admitted == []        # still parked
+    c.clock(1)                   # laggard catches up -> min moves
+    th.join(timeout=5.0)
+    assert admitted == [True]
+
+
+def test_stop_unblocks_waiters():
+    c = SSP(2, staleness=0)
+    c.clock(0)
+    res = []
+    th = threading.Thread(target=lambda: res.append(
+        c.wait_until_admitted(0, timeout=5.0)))
+    th.start()
+    time.sleep(0.05)
+    c.stop()
+    th.join(timeout=5.0)
+    assert res == [False]
+
+
+def test_make_controller_kinds():
+    assert make_controller("bsp", 2).kind == "bsp"
+    assert make_controller("ssp", 2, staleness=3).staleness == 3
+    assert make_controller("asp", 2).kind == "asp"
+    with pytest.raises(ValueError):
+        make_controller("nope", 2)
+
+
+def test_ssp_state_roundtrip():
+    c = SSP(3, staleness=4)
+    c.clock(0); c.clock(0); c.clock(1)
+    state = c.state_dict()
+    c2 = SSP(3, staleness=4)
+    c2.load_state_dict(state)
+    assert c2.tracker.snapshot() == [2, 1, 0]

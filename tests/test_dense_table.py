@@ -1,0 +1,125 @@
+"""DenseTable vs NumPy oracle on the 8-fake-device mesh (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from minips_tpu.tables.dense import DenseTable
+
+
+def _template():
+    return {"w": jnp.zeros((3, 4)), "b": jnp.zeros(5)}  # 17 keys -> pads to 24
+
+
+def test_init_pull_roundtrip(mesh8):
+    t = DenseTable(_template(), mesh8)
+    assert t.num_keys == 17 and t.padded == 24
+    pulled = t.pull()
+    assert pulled["w"].shape == (3, 4) and pulled["b"].shape == (5,)
+    np.testing.assert_allclose(np.asarray(pulled["w"]), 0.0)
+
+
+def test_push_sgd_matches_oracle(mesh8):
+    t = DenseTable(_template(), mesh8, updater="sgd", lr=0.5)
+    grads = {"w": jnp.ones((3, 4)) * 2.0, "b": jnp.arange(5.0)}
+    t.push(grads)
+    pulled = t.pull()
+    np.testing.assert_allclose(np.asarray(pulled["w"]), -1.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pulled["b"]),
+                               -0.5 * np.arange(5.0), rtol=1e-6)
+
+
+def test_push_adagrad_matches_oracle(mesh8):
+    lr, eps_acc = 0.1, 0.1
+    t = DenseTable({"w": jnp.zeros(8)}, mesh8, updater="adagrad", lr=lr)
+    g = np.linspace(1.0, 2.0, 8).astype(np.float32)
+    acc = np.full(8, eps_acc)
+    w = np.zeros(8)
+    for _ in range(3):
+        t.push({"w": jnp.asarray(g)})
+        acc = acc + g * g
+        w = w - lr * g / np.sqrt(acc)
+    np.testing.assert_allclose(np.asarray(t.pull()["w"]), w, rtol=1e-5)
+
+
+def test_pull_keys_and_push_keys(mesh8):
+    t = DenseTable({"w": jnp.zeros(16)}, mesh8, updater="sgd", lr=1.0)
+    keys = np.array([1, 5, 5, 9])
+    vals = jnp.array([1.0, 2.0, 3.0, 4.0])
+    t.push_keys(keys, vals)  # duplicate key 5 must accumulate (Add semantics)
+    got = np.asarray(t.pull_keys(np.array([1, 5, 9, 0])))
+    np.testing.assert_allclose(got, [-1.0, -5.0, -4.0, 0.0], rtol=1e-6)
+
+
+def test_fused_step_quadratic_descent(mesh8):
+    """Fused pull→grad→push→update: minimize ||params - target||^2 with the
+    batch unused; every worker computes the same grad, mean-reduce keeps
+    scale, loss must drop monotonically."""
+    target = jnp.arange(24.0)
+    t = DenseTable({"w": jnp.zeros(24)}, mesh8, updater="sgd", lr=0.2,
+                   grad_reduce="mean")
+
+    def grad_fn(params, batch):
+        loss = jnp.sum((params["w"] - target) ** 2)
+        return loss, {"w": 2.0 * (params["w"] - target)}
+
+    step = t.make_step(grad_fn)
+    batch = jnp.zeros((8, 1))  # sharded over workers, unused
+    losses = [float(t.step_inplace(step, batch)) for _ in range(20)]
+    assert losses[-1] < losses[0] * 1e-3
+    np.testing.assert_allclose(np.asarray(t.pull()["w"]), np.arange(24.0),
+                               atol=1e-2)
+
+
+def test_fused_step_data_parallel_grads_average(mesh8):
+    """Each worker sees a different batch shard; push must reduce across
+    workers exactly like the oracle mean of per-shard grads."""
+    t = DenseTable({"w": jnp.zeros(8)}, mesh8, updater="sgd", lr=1.0,
+                   grad_reduce="mean")
+
+    def grad_fn(params, batch):
+        # grad = mean over local batch rows of (batch_row)
+        g = jnp.mean(batch, axis=0)
+        return jnp.sum(params["w"] * 0.0), {"w": g}
+
+    step = t.make_step(grad_fn)
+    batch = jnp.arange(16.0).reshape(16, 1) * jnp.ones((1, 8))
+    t.step_inplace(step, batch)
+    # oracle: mean over 8 shards of per-shard mean = global mean of column
+    expect = -np.mean(np.arange(16.0)) * np.ones(8)
+    np.testing.assert_allclose(np.asarray(t.pull()["w"]), expect, rtol=1e-6)
+
+
+def test_state_dict_roundtrip(mesh8):
+    t = DenseTable(_template(), mesh8, updater="adagrad", lr=0.1)
+    t.push({"w": jnp.ones((3, 4)), "b": jnp.ones(5)})
+    state = t.state_dict()
+    t2 = DenseTable(_template(), mesh8, updater="adagrad", lr=0.1)
+    t2.load_state_dict(state)
+    np.testing.assert_allclose(np.asarray(t2.pull()["w"]),
+                               np.asarray(t.pull()["w"]))
+    t.push({"w": jnp.ones((3, 4)), "b": jnp.ones(5)})
+    t2.push({"w": jnp.ones((3, 4)), "b": jnp.ones(5)})
+    np.testing.assert_allclose(np.asarray(t2.pull()["w"]),
+                               np.asarray(t.pull()["w"]))
+
+
+def test_push_keys_adam_does_not_drift_untouched_keys(mesh8):
+    """Regression: per-key server semantics — stateful updaters must not
+    move keys that were not pushed (SURVEY.md §3.3 per-key Update)."""
+    t = DenseTable({"w": jnp.zeros(16)}, mesh8, updater="adam", lr=0.1)
+    t.push_keys(np.array([5]), jnp.array([1.0]))
+    w5_before = float(np.asarray(t.params)[5])
+    t.push_keys(np.array([7]), jnp.array([1.0]))
+    assert float(np.asarray(t.params)[5]) == w5_before
+    assert float(np.asarray(t.params)[7]) != 0.0
+
+
+def test_step_timer_warmup_zero():
+    from minips_tpu.utils.timing import StepTimer
+    import time as _time
+    timer = StepTimer(warmup_steps=0)
+    _time.sleep(0.01)
+    timer.step(100)
+    assert timer.samples_per_sec > 0

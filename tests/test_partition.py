@@ -1,0 +1,38 @@
+import numpy as np
+import pytest
+
+from minips_tpu.parallel.mesh import padded_size
+from minips_tpu.parallel.partition import RangePartitioner
+
+
+def test_padded_size():
+    assert padded_size(10, 4) == 12
+    assert padded_size(8, 4) == 8
+    assert padded_size(1, 8) == 8
+    assert padded_size(0, 4) == 4  # empty tables still get one row per shard
+
+
+def test_contiguous_ranges():
+    p = RangePartitioner(num_keys=10, num_shards=4)
+    assert p.padded == 12 and p.shard_size == 3
+    keys = np.arange(10)
+    np.testing.assert_array_equal(
+        p.shard_of(keys), [0, 0, 0, 1, 1, 1, 2, 2, 2, 3])
+
+
+def test_split_preserves_order_and_partition():
+    p = RangePartitioner(num_keys=100, num_shards=8)
+    keys = np.array([5, 99, 13, 0, 64, 63, 12])
+    slices = p.split(keys)
+    assert len(slices) == 8
+    merged = np.concatenate([s for s in slices])
+    assert sorted(merged.tolist()) == sorted(keys.tolist())
+    for s, sl in enumerate(slices):
+        assert (p.shard_of(sl) == s).all()
+
+
+def test_local_offset_roundtrip():
+    p = RangePartitioner(num_keys=64, num_shards=8)
+    keys = np.arange(64)
+    recon = p.shard_of(keys) * p.shard_size + p.local_offset(keys)
+    np.testing.assert_array_equal(recon, keys)
