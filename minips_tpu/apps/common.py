@@ -1,0 +1,35 @@
+"""Shared app scaffolding — the gflags `main()` pattern of the reference
+apps (SURVEY.md §1 L7, §5.6): parse flags, build config, run, print metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from minips_tpu.core.config import Config, add_config_flags, config_from_args
+from minips_tpu.utils.metrics import MetricsLogger
+
+
+def app_main(name: str, default_cfg: Config, run, extra_flags=None):
+    # Dev escape hatch: MINIPS_FORCE_CPU=1 runs on (fake multi-) CPU devices.
+    # Must happen before the first backend-touching JAX call; the sandbox's
+    # TPU plugin ignores the JAX_PLATFORMS env var, hence config.update.
+    import os
+    if os.environ.get("MINIPS_FORCE_CPU"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    parser = argparse.ArgumentParser(prog=name)
+    add_config_flags(parser)
+    parser.add_argument("--exec", dest="exec_mode", default="spmd",
+                        choices=["spmd", "threaded"],
+                        help="spmd: fused collective step (TPU fast path); "
+                             "threaded: per-worker threads with the "
+                             "consistency gate (reference semantics)")
+    if extra_flags is not None:
+        extra_flags(parser)
+    args = parser.parse_args()
+    cfg = config_from_args(args, default=default_cfg)
+    metrics = MetricsLogger(cfg.train.metrics_path, verbose=True)
+    result = run(cfg, args, metrics)
+    metrics.close()
+    return result

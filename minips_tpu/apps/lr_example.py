@@ -1,0 +1,142 @@
+"""lr_example — logistic regression, the reference's first app
+(BASELINE.json:3,7: LR on a9a/RCV1, sparse push/pull, BSP).
+
+Modes:
+- ``--data dense`` (a9a-like): DenseTable fused SPMD step — the minimum
+  end-to-end slice (SURVEY.md §7.3).
+- ``--data sparse`` (RCV1-like): hashed SparseTable of per-feature weights,
+  fused sparse pull/push step.
+- ``--exec threaded``: reference-semantics worker threads under the
+  configured consistency model (BSP/SSP/ASP).
+
+Usage: python -m minips_tpu.apps.lr_example --num_iters 200 --lr 0.5
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from minips_tpu.apps.common import app_main
+from minips_tpu.core.config import Config, TableConfig, TrainConfig
+from minips_tpu.core.engine import Engine, MLTask
+from minips_tpu.data.loader import BatchIterator
+from minips_tpu.data import synthetic
+from minips_tpu.models import lr as lr_model
+from minips_tpu.parallel.mesh import make_mesh
+from minips_tpu.tables.dense import DenseTable
+from minips_tpu.tables.sparse import SparseTable
+from minips_tpu.train.loop import TrainLoop
+from minips_tpu.train.ps_step import PSTrainStep
+
+DEFAULT = Config(
+    table=TableConfig(name="weights", kind="dense", consistency="bsp",
+                      updater="adagrad", lr=0.5),
+    train=TrainConfig(batch_size=512, num_iters=200),
+)
+
+
+def run(cfg: Config, args, metrics) -> dict:
+    dim = getattr(args, "dim", 123)
+    if getattr(args, "data", "dense") == "dense":
+        data = synthetic.classification_dense(8192, dim,
+                                              seed=cfg.train.seed)
+        return _run_dense(cfg, args, metrics, data, dim)
+    data = synthetic.classification_sparse(8192, seed=cfg.train.seed)
+    return _run_sparse(cfg, args, metrics, data)
+
+
+def _run_dense(cfg, args, metrics, data, dim) -> dict:
+    batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
+    if getattr(args, "exec_mode", "spmd") == "threaded":
+        return _run_threaded(cfg, metrics, data, dim)
+    mesh = make_mesh()
+    table = DenseTable(lr_model.init(dim), mesh, updater=cfg.table.updater,
+                       lr=cfg.table.lr)
+    step = table.make_step(lr_model.grad_fn_dense)
+
+    def do_step(batch):
+        b = {k: jnp.asarray(v) for k, v in batch.items()}
+        return table.step_inplace(step, b)
+
+    loop = TrainLoop(do_step, batches, metrics=metrics,
+                     log_every=cfg.train.log_every,
+                     batch_size=cfg.train.batch_size)
+    losses = loop.run(cfg.train.num_iters)
+    return {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
+            "table": table}
+
+
+def _run_sparse(cfg, args, metrics, data) -> dict:
+    mesh = make_mesh()
+    table = SparseTable(1 << 16, 1, mesh, updater=cfg.table.updater,
+                        lr=cfg.table.lr, init_scale=0.0)
+
+    def loss_fn(dense_params, rows, batch):
+        return lr_model.loss_sparse(rows["w"], batch)
+
+    ps = PSTrainStep(loss_fn, sparse={"w": table},
+                     key_fns={"w": lambda b: b["idx"]})
+    batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
+    loop = TrainLoop(lambda b: ps(ps.shard_batch(b)), batches,
+                     metrics=metrics, log_every=cfg.train.log_every,
+                     batch_size=cfg.train.batch_size)
+    losses = loop.run(cfg.train.num_iters)
+    return {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
+            "table": table}
+
+
+def _run_threaded(cfg, metrics, data, dim) -> dict:
+    engine = Engine(num_workers=cfg.train.num_workers).start_everything()
+    engine.create_table(
+        TableConfig(name="w", kind="dense", consistency=cfg.table.consistency,
+                    staleness=cfg.table.staleness, updater=cfg.table.updater,
+                    lr=cfg.table.lr),
+        template=lr_model.init(dim))
+    n_iters = cfg.train.num_iters
+    per_worker_losses: dict[int, list] = {}
+
+    def udf(info):
+        tbl = info.table("w")
+        shard = np.array_split(np.arange(len(data["y"])),
+                               info.num_workers)[info.worker_id]
+        batches = BatchIterator({k: v[shard] for k, v in data.items()},
+                                min(cfg.train.batch_size,
+                                    max(len(shard) // 2, 1)),
+                                seed=cfg.train.seed + info.worker_id)
+        g = jax.jit(lambda p, b: lr_model.grad_fn_dense(p, b))
+        losses = []
+        for batch, _ in zip(batches, range(n_iters)):
+            params = tbl.pull()
+            b = {k: jnp.asarray(v) for k, v in batch.items()}
+            loss, grads = g(params, b)
+            grads = jax.tree.map(lambda x: x / info.num_workers, grads)
+            tbl.push(grads)
+            tbl.clock()
+            losses.append(float(loss))
+        per_worker_losses[info.worker_id] = losses
+        return losses
+
+    engine.run(MLTask(fn=udf))
+    skew = engine.controllers["w"].skew
+    engine.stop_everything()
+    mean_losses = [float(np.mean([per_worker_losses[w][i]
+                                  for w in per_worker_losses]))
+                   for i in range(n_iters)]
+    metrics.log(final_loss=mean_losses[-1], clock_skew=skew)
+    return {"losses": mean_losses, "samples_per_sec": 0.0, "skew": skew}
+
+
+def _flags(parser):
+    parser.add_argument("--data", default="dense",
+                        choices=["dense", "sparse"])
+    parser.add_argument("--dim", type=int, default=123)
+
+
+def main():
+    return app_main("lr_example", DEFAULT, run, extra_flags=_flags)
+
+
+if __name__ == "__main__":
+    main()

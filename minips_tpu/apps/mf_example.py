@@ -1,0 +1,113 @@
+"""mf_example — matrix factorization on MovieLens-shaped data
+(BASELINE.json:9: "Matrix factorization on MovieLens-20M, async ASP").
+
+User/item factor matrices live in SparseTables (per-key pull/push — the
+canonical PS workload); the fused SPMD step gathers the batch's rows,
+differentiates the squared error, and row-updates both tables. ``--exec
+threaded`` runs ASP worker threads (never blocking, reference semantics).
+
+Usage: python -m minips_tpu.apps.mf_example --num_iters 300
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from minips_tpu.apps.common import app_main
+from minips_tpu.core.config import Config, TableConfig, TrainConfig
+from minips_tpu.core.engine import Engine, MLTask
+from minips_tpu.data.loader import BatchIterator
+from minips_tpu.data import synthetic
+from minips_tpu.models import mf as mf_model
+from minips_tpu.parallel.mesh import make_mesh
+from minips_tpu.tables.sparse import SparseTable
+from minips_tpu.train.loop import TrainLoop
+from minips_tpu.train.ps_step import PSTrainStep
+
+DEFAULT = Config(
+    table=TableConfig(name="factors", kind="sparse", consistency="asp",
+                      updater="sgd", lr=0.05, dim=9),  # rank 8 + bias col
+    train=TrainConfig(batch_size=1024, num_iters=300),
+)
+MU = 3.0  # global rating mean offset
+
+
+def _make_tables(cfg, mesh, users=1024, items=2048):
+    mk = functools.partial(SparseTable, mesh=mesh, updater=cfg.table.updater,
+                           lr=cfg.table.lr, init_scale=0.1)
+    return (mk(max(1 << 10, users), cfg.table.dim, seed=1, name="user"),
+            mk(max(1 << 11, items), cfg.table.dim, seed=2, name="item"))
+
+
+def run(cfg: Config, args, metrics) -> dict:
+    data = synthetic.movielens_like(seed=cfg.train.seed)
+    mesh = make_mesh()
+    user_t, item_t = _make_tables(cfg, mesh)
+
+    if getattr(args, "exec_mode", "spmd") == "threaded":
+        return _run_threaded(cfg, metrics, data, user_t, item_t)
+
+    def loss_fn(dense_params, rows, batch):
+        return mf_model.loss(rows["user"], rows["item"], batch["rating"],
+                             mu=MU, reg=0.02)
+
+    ps = PSTrainStep(loss_fn, sparse={"user": user_t, "item": item_t},
+                     key_fns={"user": lambda b: b["user"],
+                              "item": lambda b: b["item"]})
+    batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
+    loop = TrainLoop(lambda b: ps(ps.shard_batch(b)), batches,
+                     metrics=metrics, log_every=cfg.train.log_every,
+                     batch_size=cfg.train.batch_size)
+    losses = loop.run(cfg.train.num_iters)
+    return {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
+            "tables": (user_t, item_t)}
+
+
+def _run_threaded(cfg, metrics, data, user_t, item_t) -> dict:
+    engine = Engine(num_workers=cfg.train.num_workers).start_everything()
+    from minips_tpu.consistency import make_controller
+    for name, t in (("user", user_t), ("item", item_t)):
+        engine.register_table(name, t, make_controller(
+            "asp", engine.num_workers, sync_every=0))
+
+    n_iters = cfg.train.num_iters
+    all_losses: dict[int, list] = {}
+
+    def udf(info):
+        ut, it_ = info.table("user"), info.table("item")
+        shard = np.array_split(np.arange(len(data["rating"])),
+                               info.num_workers)[info.worker_id]
+        batches = BatchIterator({k: v[shard] for k, v in data.items()},
+                                min(cfg.train.batch_size, len(shard)),
+                                seed=cfg.train.seed + info.worker_id)
+        g = jax.jit(functools.partial(mf_model.grad_fn, mu=MU))
+        losses = []
+        for batch, _ in zip(batches, range(n_iters)):
+            u_rows = ut.pull(keys=batch["user"])   # ASP: never blocks
+            i_rows = it_.pull(keys=batch["item"])
+            loss, gu, gi = g(u_rows, i_rows,
+                             {"rating": jnp.asarray(batch["rating"])})
+            ut.push(gu, keys=batch["user"])
+            it_.push(gi, keys=batch["item"])
+            ut.clock(); it_.clock()
+            losses.append(float(loss))
+        all_losses[info.worker_id] = losses
+
+    engine.run(MLTask(fn=udf))
+    engine.stop_everything()
+    mean_losses = [float(np.mean([all_losses[w][i] for w in all_losses]))
+                   for i in range(min(len(v) for v in all_losses.values()))]
+    metrics.log(final_loss=mean_losses[-1])
+    return {"losses": mean_losses, "samples_per_sec": 0.0}
+
+
+def main():
+    return app_main("mf_example", DEFAULT, run)
+
+
+if __name__ == "__main__":
+    main()

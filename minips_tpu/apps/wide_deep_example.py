@@ -1,0 +1,87 @@
+"""wide_deep_example — Wide&Deep / DeepFM CTR on Criteo-shaped data
+(BASELINE.json:10: "Wide&Deep / DeepFM on Criteo-1TB, sparse embedding PS
+shards on TPU mesh"). The flagship workload: hashed wide weights (dim 1) +
+hashed field embeddings + a dense deep tower, all in one fused SPMD step.
+
+Usage: python -m minips_tpu.apps.wide_deep_example --model deepfm
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from minips_tpu.apps.common import app_main
+from minips_tpu.core.config import Config, TableConfig, TrainConfig
+from minips_tpu.data.loader import BatchIterator
+from minips_tpu.data import synthetic
+from minips_tpu.models import wide_deep as wd_model
+from minips_tpu.parallel.mesh import make_mesh
+from minips_tpu.tables.dense import DenseTable
+from minips_tpu.tables.sparse import SparseTable
+from minips_tpu.train.loop import TrainLoop
+from minips_tpu.train.ps_step import PSTrainStep
+
+DEFAULT = Config(
+    table=TableConfig(name="ctr", kind="sparse", consistency="bsp",
+                      updater="adagrad", lr=0.05, dim=8,
+                      num_slots=1 << 18),
+    train=TrainConfig(batch_size=1024, num_iters=200),
+)
+NUM_DENSE, NUM_CAT = 13, 26
+
+
+def build(cfg: Config, *, use_fm: bool, mesh=None, seed: int = 0):
+    """Tables + fused step for W&D/DeepFM; shared with bench.py."""
+    mesh = mesh or make_mesh()
+    emb_dim = cfg.table.dim
+    wide_t = SparseTable(cfg.table.num_slots, 1, mesh, name="wide",
+                         updater=cfg.table.updater, lr=cfg.table.lr,
+                         init_scale=0.0, salt=1, seed=seed)
+    emb_t = SparseTable(cfg.table.num_slots, emb_dim, mesh, name="emb",
+                        updater=cfg.table.updater, lr=cfg.table.lr,
+                        init_scale=0.01, salt=2, seed=seed + 1)
+    deep_t = DenseTable(
+        wd_model.init_deep(jax.random.PRNGKey(seed + 2), NUM_CAT, emb_dim,
+                           NUM_DENSE),
+        mesh, name="deep", updater="adam", lr=1e-3)
+
+    def loss_fn(deep_params, rows, batch):
+        return wd_model.loss(rows["wide"], rows["emb"], deep_params, batch,
+                             use_fm=use_fm)
+
+    ps = PSTrainStep(loss_fn, dense=deep_t,
+                     sparse={"wide": wide_t, "emb": emb_t},
+                     key_fns={"wide": lambda b: b["cat"],
+                              "emb": lambda b: b["cat"]})
+    return ps, (wide_t, emb_t, deep_t)
+
+
+def run(cfg: Config, args, metrics) -> dict:
+    use_fm = getattr(args, "model", "widedeep") == "deepfm"
+    data = synthetic.criteo_like(16384, seed=cfg.train.seed)
+    ps, tables = build(cfg, use_fm=use_fm, seed=cfg.train.seed)
+    batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
+    loop = TrainLoop(lambda b: ps(ps.shard_batch(b)), batches,
+                     metrics=metrics, log_every=cfg.train.log_every,
+                     batch_size=cfg.train.batch_size)
+    losses = loop.run(cfg.train.num_iters)
+    metrics.log(final_loss=losses[-1],
+                samples_per_sec=loop.timer.samples_per_sec)
+    return {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
+            "tables": tables}
+
+
+def _flags(parser):
+    parser.add_argument("--model", default="widedeep",
+                        choices=["widedeep", "deepfm"])
+
+
+def main():
+    return app_main("wide_deep_example", DEFAULT, run, extra_flags=_flags)
+
+
+if __name__ == "__main__":
+    main()

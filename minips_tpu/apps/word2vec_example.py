@@ -1,0 +1,84 @@
+"""word2vec_example — skip-gram negative sampling on enwiki-shaped text
+(BASELINE.json:11: "Word2Vec skip-gram on enwiki, negative sampling, async
+push"). Input/output embeddings in two SparseTables; negatives sampled
+host-side from unigram^0.75; fused SPMD step pushes rows asynchronously
+w.r.t. the host (dispatch is async; data dependencies order updates).
+
+Usage: python -m minips_tpu.apps.word2vec_example --num_iters 200
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from minips_tpu.apps.common import app_main
+from minips_tpu.core.config import Config, TableConfig, TrainConfig
+from minips_tpu.data import synthetic
+from minips_tpu.models import word2vec as w2v
+from minips_tpu.parallel.mesh import make_mesh
+from minips_tpu.tables.sparse import SparseTable
+from minips_tpu.train.loop import TrainLoop
+from minips_tpu.train.ps_step import PSTrainStep
+
+DEFAULT = Config(
+    table=TableConfig(name="emb", kind="sparse", consistency="asp",
+                      updater="sgd", lr=0.05, dim=64, num_slots=1 << 14),
+    train=TrainConfig(batch_size=1024, num_iters=200),
+)
+NEG = 5
+
+
+def _pair_batches(cfg, vocab=10_000):
+    tokens, counts = synthetic.text_corpus(vocab, seed=cfg.train.seed)
+    centers, contexts = synthetic.skipgram_pairs(tokens,
+                                                 seed=cfg.train.seed)
+    sampler = w2v.UnigramSampler(counts, seed=cfg.train.seed)
+    B = cfg.train.batch_size
+    rng = np.random.default_rng(cfg.train.seed)
+
+    def gen():
+        n = len(centers)
+        while True:
+            sel = rng.integers(0, n, size=B)
+            yield {"center": centers[sel], "pos": contexts[sel],
+                   "neg": sampler.sample((B, NEG)).astype(np.int32)}
+
+    return gen()
+
+
+def run(cfg: Config, args, metrics) -> dict:
+    mesh = make_mesh()
+    in_t = SparseTable(cfg.table.num_slots, cfg.table.dim, mesh, name="in",
+                       updater=cfg.table.updater, lr=cfg.table.lr,
+                       init_scale=0.01, seed=1)
+    out_t = SparseTable(cfg.table.num_slots, cfg.table.dim, mesh, name="out",
+                        updater=cfg.table.updater, lr=cfg.table.lr,
+                        init_scale=0.0, seed=2)
+    import jax.numpy as jnp
+
+    def loss_fn(dense_params, rows, batch):
+        # rows["out"]: [B, 1+K, dim] (keys were [B, 1+K])
+        return w2v.sgns_loss(rows["in"], rows["out"][:, 0],
+                             rows["out"][:, 1:])
+
+    ps = PSTrainStep(
+        loss_fn, sparse={"in": in_t, "out": out_t},
+        key_fns={"in": lambda b: b["center"],
+                 "out": lambda b: jnp.concatenate(
+                     [b["pos"][:, None], b["neg"]], axis=1)})
+    batches = _pair_batches(cfg)
+    loop = TrainLoop(lambda b: ps(ps.shard_batch(b)), batches,
+                     metrics=metrics, log_every=cfg.train.log_every,
+                     batch_size=cfg.train.batch_size)
+    losses = loop.run(cfg.train.num_iters)
+    metrics.log(final_loss=losses[-1])
+    return {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
+            "tables": (in_t, out_t)}
+
+
+def main():
+    return app_main("word2vec_example", DEFAULT, run)
+
+
+if __name__ == "__main__":
+    main()

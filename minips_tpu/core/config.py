@@ -91,6 +91,7 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--num_iters", type=int, default=None)
     parser.add_argument("--num_workers", type=int, default=None)
     parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--log_every", type=int, default=None)
     parser.add_argument("--metrics_path", type=str, default=None)
     parser.add_argument("--checkpoint_dir", type=str, default=None)
     parser.add_argument("--checkpoint_every", type=int, default=None)
@@ -108,7 +109,8 @@ def config_from_args(args: argparse.Namespace,
         if val is not None:
             setattr(cfg.table, name, val)
     for name in ("batch_size", "num_iters", "num_workers", "seed",
-                 "metrics_path", "checkpoint_dir", "checkpoint_every"):
+                 "log_every", "metrics_path", "checkpoint_dir",
+                 "checkpoint_every"):
         val = getattr(args, name, None)
         if val is not None:
             setattr(cfg.train, name, val)

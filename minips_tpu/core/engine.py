@@ -29,6 +29,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+import jax
 import numpy as np
 
 from minips_tpu.consistency import ConsistencyController, make_controller
@@ -75,10 +76,19 @@ class KVClientTable:
                 f"my_clock={self._controller.tracker.clock_of(self._worker_id)})")
         with self._lock:
             if keys is None:
-                return self._table.pull()
-            if isinstance(self._table, SparseTable):
-                return self._table.pull(keys)
-            return self._table.pull_keys(keys)
+                out = self._table.pull()
+            elif isinstance(self._table, SparseTable):
+                out = self._table.pull(keys)
+            else:
+                out = self._table.pull_keys(keys)
+            # Materialize INSIDE the lock: reading a mesh-sharded table
+            # compiles to a cross-device gather, and JAX dispatch is lazy —
+            # returning the lazy value would let two worker threads run
+            # collective programs concurrently, which deadlocks the
+            # backend's rendezvous. A host copy also matches reference pull
+            # semantics (the worker owns a snapshot, SURVEY.md §3.3), and
+            # keeps worker-side grad jits single-device/collective-free.
+            return jax.tree.map(np.asarray, out)
 
     # Add/Push: fire-and-forget-ish; server-side updater applies (§3.3).
     def push(self, grads, keys: Optional[np.ndarray] = None) -> None:
@@ -118,7 +128,12 @@ class Engine:
         self.mesh = None
         self.tables: dict[str, Any] = {}
         self.controllers: dict[str, ConsistencyController] = {}
-        self._locks: dict[str, threading.Lock] = {}
+        # ONE dispatch lock shared by every table: concurrent multi-device
+        # *collective* programs from different worker threads deadlock the
+        # backend rendezvous, and per-table locks would still allow a pull
+        # on table A to race a pull on table B. All mesh-touching dispatch
+        # in the threaded path serializes here.
+        self._dispatch_lock = threading.Lock()
         self.num_workers = 0
         self._started = False
 
@@ -159,10 +174,16 @@ class Engine:
         controller = make_controller(
             cfg.consistency, self.num_workers,
             staleness=cfg.staleness, sync_every=cfg.sync_every)
-        self.tables[cfg.name] = table
-        self.controllers[cfg.name] = controller
-        self._locks[cfg.name] = threading.Lock()
-        return cfg.name
+        return self.register_table(cfg.name, table, controller)
+
+    def register_table(self, name: str, table,
+                       controller: ConsistencyController) -> str:
+        """Register an externally-built table with its controller (apps that
+        construct tables directly, e.g. MF's user/item factor tables)."""
+        assert self._started, "call start_everything() first"
+        self.tables[name] = table
+        self.controllers[name] = controller
+        return name
 
     # ------------------------------------------------------------------- run
     def run(self, task: MLTask) -> list[Any]:
@@ -185,7 +206,7 @@ class Engine:
                 num_workers=n,
                 tables={
                     name: KVClientTable(tbl, self.controllers[name], wid,
-                                        self._locks[name])
+                                        self._dispatch_lock)
                     for name, tbl in self.tables.items()
                 },
             )

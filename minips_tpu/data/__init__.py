@@ -1,0 +1,3 @@
+from minips_tpu.data.libsvm import read_libsvm, write_libsvm  # noqa: F401
+from minips_tpu.data.loader import BatchIterator, prefetch_to_device  # noqa: F401
+from minips_tpu.data import synthetic  # noqa: F401

@@ -1,0 +1,83 @@
+"""libsvm text format reader/writer — the reference's parser family
+(SURVEY.md §2 "Data loading": libsvm/text parsers, LabeledSample).
+
+Format: ``label idx:val idx:val ...`` per line (a9a/RCV1 ship this way —
+BASELINE.json:7). The Python reader is vectorized per chunk; a C++ reader
+(cpp/) accelerates the same contract when built (SURVEY.md §2.1 item 6) —
+``read_libsvm`` transparently uses it when available.
+
+Output is padded fixed-width arrays (idx [N, F], val [N, F], mask) because
+TPU batches need static shapes; F = max features per row (or the given
+cap, truncating the tail).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def write_libsvm(path: str, y: np.ndarray, idx: np.ndarray,
+                 val: np.ndarray, mask: np.ndarray) -> None:
+    with open(path, "w") as f:
+        for r in range(len(y)):
+            feats = " ".join(
+                f"{int(i)}:{float(v):g}"
+                for i, v, m in zip(idx[r], val[r], mask[r]) if m)
+            f.write(f"{int(y[r])} {feats}\n")
+
+
+def read_libsvm(path: str, max_features: int | None = None,
+                use_native: bool = True):
+    """Returns dict(y [N] float32, idx [N, F] int32, val [N, F] float32,
+    mask [N, F] float32)."""
+    if use_native:
+        try:
+            from minips_tpu.data.native import read_libsvm_native
+
+            out = read_libsvm_native(path, max_features)
+            if out is not None:
+                return out
+        except ImportError:
+            pass
+    rows = []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            label = float(parts[0])
+            pairs = [p.split(":") for p in parts[1:]]
+            rows.append((label,
+                         np.array([int(i) for i, _ in pairs], np.int32),
+                         np.array([float(v) for _, v in pairs], np.float32)))
+    n = len(rows)
+    width = max((len(r[1]) for r in rows), default=0)
+    if max_features is not None:
+        width = min(width, max_features)
+    y = np.zeros(n, np.float32)
+    idx = np.zeros((n, width), np.int32)
+    val = np.zeros((n, width), np.float32)
+    mask = np.zeros((n, width), np.float32)
+    for r, (label, ii, vv) in enumerate(rows):
+        y[r] = label
+        k = min(len(ii), width)
+        idx[r, :k] = ii[:k]
+        val[r, :k] = vv[:k]
+        mask[r, :k] = 1.0
+    # normalize labels {-1,1} -> {0,1} (a9a convention)
+    if y.min() < 0:
+        y = (y > 0).astype(np.float32)
+    return {"y": y, "idx": idx, "val": val, "mask": mask}
+
+
+def densify(data: dict, dim: int) -> dict:
+    """Sparse rows -> dense [N, dim] matrix (the LR-on-a9a dense-ified
+    minimum slice, SURVEY.md §7.3)."""
+    n, width = data["idx"].shape
+    X = np.zeros((n, dim), np.float32)
+    rows = np.repeat(np.arange(n), width)
+    cols = data["idx"].reshape(-1)
+    vals = (data["val"] * data["mask"]).reshape(-1)
+    keep = cols < dim
+    np.add.at(X, (rows[keep], cols[keep]), vals[keep])
+    return {"x": X, "y": data["y"]}

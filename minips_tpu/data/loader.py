@@ -1,0 +1,92 @@
+"""Batch iteration + device prefetch — the host input pipeline.
+
+The reference's loader threads read shards into per-worker sample stores
+(SURVEY.md §2 "Data loading"); the TPU rebuild's job is keeping the chip
+fed: batches are assembled on host (numpy), then double-buffered onto the
+device with data-axis sharding so step N+1's H2D copy overlaps step N's
+compute (SURVEY.md §7.4 item 4).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import numpy as np
+
+
+class BatchIterator:
+    """Infinite shuffled minibatches over a dict of equal-length arrays.
+
+    ``drop_last=True`` (default) yields only full batches — TPU steps need
+    static shapes; ``drop_last=False`` also yields the ragged tail batch
+    each epoch (useful for evaluation sweeps).
+    """
+
+    def __init__(self, data: dict, batch_size: int, *, seed: int = 0,
+                 drop_last: bool = True):
+        self.data = {k: np.asarray(v) for k, v in data.items()}
+        lens = {len(v) for v in self.data.values()}
+        if len(lens) != 1:
+            raise ValueError("all arrays must share length")
+        self.n = lens.pop()
+        if batch_size > self.n:
+            raise ValueError(f"batch_size {batch_size} > dataset size {self.n}")
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            perm = self._rng.permutation(self.n)
+            end = (self.n - self.batch_size + 1 if self.drop_last
+                   else self.n)
+            for s in range(0, end, self.batch_size):
+                sel = perm[s: s + self.batch_size]
+                yield {k: v[sel] for k, v in self.data.items()}
+
+
+_POISON = object()
+
+
+def prefetch_to_device(it, put: Callable[[Any], Any], depth: int = 2):
+    """Run ``put`` (e.g. PSTrainStep.shard_batch) on a background thread,
+    keeping ``depth`` batches in flight ahead of the consumer. Producer
+    errors re-raise in the consumer; early consumer exit releases the
+    producer (no leaked thread parked on a full queue)."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        """Blocking put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer() -> None:
+        try:
+            for item in it:
+                if stop.is_set() or not _put(("item", put(item))):
+                    return
+            _put((_POISON, None))
+        except BaseException as e:  # re-raised consumer-side
+            _put(("error", e))
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            kind, item = q.get()
+            if kind is _POISON:
+                return
+            if kind == "error":
+                raise item
+            yield item
+    finally:
+        stop.set()

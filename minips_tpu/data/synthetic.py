@@ -1,0 +1,112 @@
+"""Synthetic dataset generators shaped like the reference's workloads.
+
+The sandbox has no network, so a9a/RCV1/MNIST/MovieLens/Criteo/enwiki
+cannot be downloaded; these generators produce statistically-similar data
+with the same schemas (BASELINE.json:6-12 configs) so every app trains and
+every benchmark measures the same compute/communication shape as the real
+dataset would. Real datasets drop in via the same loaders (libsvm/CSV).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def classification_dense(n: int = 4096, dim: int = 123, seed: int = 0):
+    """a9a-like dense binary classification: [N, dim] features, {0,1} labels,
+    linearly separable-ish with noise."""
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=dim).astype(np.float32)
+    X = rng.normal(size=(n, dim)).astype(np.float32)
+    logits = X @ w + rng.normal(scale=0.5, size=n).astype(np.float32)
+    return {"x": X, "y": (logits > 0).astype(np.float32)}
+
+
+def classification_sparse(n: int = 4096, dim: int = 47_236,
+                          nnz_per_row: int = 14, seed: int = 0):
+    """RCV1-like sparse rows: padded (idx, val, mask) + labels. Feature ids
+    zipf-ish so hot keys exist (realistic PS traffic skew)."""
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=dim).astype(np.float32) / np.sqrt(nnz_per_row)
+    # zipf-weighted feature popularity
+    pop = 1.0 / np.arange(1, dim + 1) ** 0.7
+    pop /= pop.sum()
+    idx = rng.choice(dim, size=(n, nnz_per_row), p=pop).astype(np.int32)
+    val = np.abs(rng.normal(size=(n, nnz_per_row))).astype(np.float32)
+    mask = np.ones((n, nnz_per_row), np.float32)
+    logits = (w[idx] * val).sum(-1) + rng.normal(scale=0.3, size=n)
+    return {"idx": idx, "val": val, "mask": mask,
+            "y": (logits > 0).astype(np.float32)}
+
+
+def mnist_like(n: int = 8192, dim: int = 784, classes: int = 10,
+               seed: int = 0):
+    """MNIST-shaped: 10 gaussian class blobs in [0,1]^784."""
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(0.2, 0.8, size=(classes, dim)).astype(np.float32)
+    y = rng.integers(0, classes, size=n).astype(np.int32)
+    X = np.clip(centers[y] + rng.normal(scale=0.3, size=(n, dim)), 0, 1)
+    return {"x": X.astype(np.float32), "y": y}
+
+
+def movielens_like(n: int = 100_000, users: int = 1024, items: int = 2048,
+                   rank: int = 8, seed: int = 0):
+    """MovieLens-shaped implicit low-rank ratings in [0.5, 5]."""
+    rng = np.random.default_rng(seed)
+    U = rng.normal(scale=0.5, size=(users, rank)).astype(np.float32)
+    V = rng.normal(scale=0.5, size=(items, rank)).astype(np.float32)
+    u = rng.integers(0, users, size=n).astype(np.int32)
+    i = rng.integers(0, items, size=n).astype(np.int32)
+    r = 3.0 + (U[u] * V[i]).sum(-1) + rng.normal(scale=0.2, size=n)
+    return {"user": u, "item": i,
+            "rating": np.clip(r, 0.5, 5.0).astype(np.float32)}
+
+
+def criteo_like(n: int = 8192, num_dense: int = 13, num_cat: int = 26,
+                cat_cardinality: int = 100_000, seed: int = 0):
+    """Criteo-shaped CTR rows: 13 numeric + 26 categorical (large id space,
+    zipf-skewed), binary click label correlated with a hidden linear model."""
+    rng = np.random.default_rng(seed)
+    dense = rng.normal(size=(n, num_dense)).astype(np.float32)
+    pop = 1.0 / np.arange(1, cat_cardinality + 1) ** 1.05
+    pop /= pop.sum()
+    cats = rng.choice(cat_cardinality, size=(n, num_cat), p=pop).astype(
+        np.int64)
+    # distinct id spaces per field (like Criteo's per-column vocabularies)
+    cats = cats + np.arange(num_cat, dtype=np.int64) * cat_cardinality
+    w_dense = rng.normal(size=num_dense).astype(np.float32)
+    cat_effect = ((cats % 97) / 97.0 - 0.5).sum(-1).astype(np.float32)
+    logits = dense @ w_dense * 0.5 + 0.3 * cat_effect + rng.normal(
+        scale=0.5, size=n)
+    return {"dense": dense, "cat": cats,
+            "y": (logits > 0).astype(np.float32)}
+
+
+def text_corpus(vocab: int = 10_000, n_tokens: int = 200_000, seed: int = 0):
+    """enwiki-shaped token stream: zipf unigram distribution with weak
+    bigram structure (neighbors correlated) for skip-gram training."""
+    rng = np.random.default_rng(seed)
+    p = 1.0 / np.arange(1, vocab + 1) ** 1.05
+    p /= p.sum()
+    tokens = rng.choice(vocab, size=n_tokens, p=p).astype(np.int32)
+    # weak local structure: every other token copies a neighbor's topic bucket
+    tokens[1::2] = (tokens[::2][: len(tokens[1::2])] + rng.integers(
+        0, 50, size=len(tokens[1::2]))) % vocab
+    counts = np.bincount(tokens, minlength=vocab)
+    return tokens, counts
+
+
+def skipgram_pairs(tokens: np.ndarray, window: int = 2, seed: int = 0):
+    """(center, context) pairs from a token stream."""
+    rng = np.random.default_rng(seed)
+    centers, contexts = [], []
+    offsets = rng.integers(1, window + 1, size=len(tokens))
+    for off in range(1, window + 1):
+        sel = offsets >= off
+        idx = np.nonzero(sel[:-off])[0]
+        centers.append(tokens[idx])
+        contexts.append(tokens[idx + off])
+    c = np.concatenate(centers)
+    x = np.concatenate(contexts)
+    perm = rng.permutation(len(c))
+    return c[perm], x[perm]

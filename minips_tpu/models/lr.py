@@ -1,0 +1,57 @@
+"""Logistic regression — the reference's ``lr_example`` workload
+(BASELINE.json:3,7: LR on a9a/RCV1, sparse push/pull).
+
+Two forms, both pure functions suitable for the fused table steps:
+
+- **dense**: ``X [B, D]`` against a dense weight table (a9a dense-ified —
+  SURVEY.md §7.3's minimum end-to-end slice).
+- **sparse**: libsvm-style ``(idx [B, F], val [B, F], pad mask)`` against a
+  hashed SparseTable of per-feature weights — the reference's sparse
+  push/pull path where only the batch's feature ids travel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init(dim: int, bias: bool = True):
+    p = {"w": jnp.zeros((dim,), jnp.float32)}
+    if bias:
+        p["b"] = jnp.zeros((), jnp.float32)
+    return p
+
+
+def logits_dense(params, X):
+    out = X @ params["w"]
+    if "b" in params:
+        out = out + params["b"]
+    return out
+
+
+def bce_with_logits(logits, y):
+    # numerically-stable binary cross entropy; y in {0, 1}
+    return jnp.mean(jnp.logaddexp(0.0, logits) - y * logits)
+
+
+def loss_dense(params, batch):
+    X, y = batch["x"], batch["y"]
+    return bce_with_logits(logits_dense(params, X), y)
+
+
+def grad_fn_dense(params, batch):
+    """(loss, grads) for DenseTable.make_step."""
+    loss, grads = jax.value_and_grad(loss_dense)(params, batch)
+    return loss, grads
+
+
+def logits_sparse(w_rows, vals, mask, bias=0.0):
+    """w_rows [B, F, 1] gathered weights; vals [B, F] feature values;
+    mask [B, F] 1 for real features, 0 for padding."""
+    return jnp.sum(w_rows[..., 0] * vals * mask, axis=-1) + bias
+
+
+def loss_sparse(w_rows, batch, bias=0.0):
+    return bce_with_logits(
+        logits_sparse(w_rows, batch["val"], batch["mask"], bias), batch["y"])

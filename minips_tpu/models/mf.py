@@ -1,0 +1,38 @@
+"""Matrix factorization — the reference's MF workload (BASELINE.json:9:
+MovieLens-20M, async ASP).
+
+Rating r_ui ≈ mu + b_u + b_i + <p_u, q_i>. User/item factors live in
+SparseTables (keys = user/item ids — the PS's per-key pull/push is exactly
+embedding-row traffic); biases ride in the last factor column to keep one
+table per side: factor vector = [p_u (k), b_u (1)] and [q_i (k), 1].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def predict(u_rows, i_rows, mu: float = 0.0):
+    """u_rows/i_rows: [B, k+1] where column k holds bias (user) / 1 (item
+    handled by caller init). Prediction = mu + sum(u*i)."""
+    return mu + jnp.sum(u_rows * i_rows, axis=-1)
+
+
+def loss(u_rows, i_rows, ratings, mu: float = 0.0, reg: float = 0.0):
+    """Squared error + L2 on the touched rows (the reference regularizes
+    per-sample on pulled keys — server-side global L2 is impossible in a
+    per-key PS, same here)."""
+    err = predict(u_rows, i_rows, mu) - ratings
+    l = jnp.mean(err * err)
+    if reg > 0.0:
+        l = l + reg * (jnp.mean(jnp.sum(u_rows * u_rows, -1))
+                       + jnp.mean(jnp.sum(i_rows * i_rows, -1)))
+    return l
+
+
+def grad_fn(u_rows, i_rows, batch, mu: float = 0.0, reg: float = 0.02):
+    def f(rows):
+        return loss(rows[0], rows[1], batch["rating"], mu, reg)
+    l, (gu, gi) = jax.value_and_grad(f)((u_rows, i_rows))
+    return l, gu, gi

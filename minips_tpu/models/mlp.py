@@ -1,0 +1,55 @@
+"""3-layer MLP — the reference's ``mlp_example`` (BASELINE.json:3,8: MLP on
+MNIST, dense KVTable, SSP staleness=4).
+
+Plain-dict functional model so the whole parameter pytree lives in one
+DenseTable (the reference holds MLP weights in a dense KVTable the same
+way). Matmuls run in bfloat16 on the MXU with float32 params/accumulation —
+the TPU-idiomatic mixed precision; the reference's Eigen math was float32
+CPU (SURVEY.md §2 "Worker compute").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init(key, sizes=(784, 256, 128, 10)):
+    """He-initialized weights, zero biases."""
+    params = {}
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        params[f"w{i}"] = (jax.random.normal(keys[i], (fan_in, fan_out),
+                                             jnp.float32)
+                           * jnp.sqrt(2.0 / fan_in))
+        params[f"b{i}"] = jnp.zeros((fan_out,), jnp.float32)
+    return params
+
+
+def apply(params, x, *, compute_dtype=jnp.bfloat16):
+    h = x.astype(compute_dtype)
+    n_layers = sum(1 for k in params if k.startswith("w"))
+    for i in range(n_layers):
+        w = params[f"w{i}"].astype(compute_dtype)
+        h = h @ w + params[f"b{i}"].astype(compute_dtype)
+        if i < n_layers - 1:
+            h = jax.nn.relu(h)
+    return h.astype(jnp.float32)
+
+
+def loss(params, batch, *, compute_dtype=jnp.bfloat16):
+    logits = apply(params, batch["x"], compute_dtype=compute_dtype)
+    labels = batch["y"]
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def grad_fn(params, batch):
+    l, g = jax.value_and_grad(loss)(params, batch)
+    return l, g
+
+
+def accuracy(params, batch):
+    logits = apply(params, batch["x"])
+    return jnp.mean((jnp.argmax(logits, -1) == batch["y"]).astype(jnp.float32))

@@ -1,0 +1,63 @@
+"""Wide&Deep and DeepFM — the reference's Criteo CTR workloads
+(BASELINE.json:10: "Wide&Deep / DeepFM on Criteo-1TB, sparse embedding PS
+shards on TPU mesh").
+
+Criteo rows: 13 dense numeric fields + 26 categorical fields. Components:
+
+- **wide**: per-feature scalar weights from a hashed SparseTable (dim 1) —
+  exactly the sparse-LR path.
+- **embeddings**: [B, 26] categorical ids → hashed SparseTable rows
+  [B, 26, k].
+- **deep**: MLP over [dense_13 ; flattened embeddings].
+- **fm** (DeepFM): second-order interactions via the sum-square trick,
+  O(B·F·k) — no pairwise blowup, MXU/VPU friendly.
+
+All pieces are pure functions of (wide_rows, emb_rows, dense_params, batch)
+so the fused GSPMD step can differentiate through to both tables.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from minips_tpu.models import mlp as _mlp
+
+
+def init_deep(key, num_fields: int = 26, emb_dim: int = 8,
+              num_dense: int = 13, hidden=(256, 128)):
+    """Dense-side params: the deep MLP (+ output head) as one pytree for a
+    DenseTable. Input = dense features + flattened embeddings."""
+    in_dim = num_dense + num_fields * emb_dim
+    return _mlp.init(key, (in_dim,) + tuple(hidden) + (1,))
+
+
+def fm_term(emb_rows):
+    """Second-order FM interaction from field embeddings [B, F, k]:
+    0.5 * sum_k ((sum_f v)^2 - sum_f v^2)."""
+    s = jnp.sum(emb_rows, axis=1)
+    s2 = jnp.sum(emb_rows * emb_rows, axis=1)
+    return 0.5 * jnp.sum(s * s - s2, axis=-1)
+
+
+def logits(wide_rows, emb_rows, deep_params, batch, *, use_fm: bool):
+    """wide_rows [B, F_tot, 1]; emb_rows [B, 26, k]; batch["dense"] [B, 13].
+
+    use_fm=False → Wide&Deep; use_fm=True → DeepFM (wide part doubles as
+    FM's first-order term, per the DeepFM formulation)."""
+    B = emb_rows.shape[0]
+    wide = jnp.sum(wide_rows[..., 0], axis=-1)
+    deep_in = jnp.concatenate(
+        [batch["dense"], emb_rows.reshape(B, -1)], axis=-1)
+    deep = _mlp.apply(deep_params, deep_in)[:, 0]
+    out = wide + deep
+    if use_fm:
+        out = out + fm_term(emb_rows)
+    return out
+
+
+def loss(wide_rows, emb_rows, deep_params, batch, *, use_fm: bool = False):
+    from minips_tpu.models.lr import bce_with_logits
+    return bce_with_logits(
+        logits(wide_rows, emb_rows, deep_params, batch, use_fm=use_fm),
+        batch["y"])

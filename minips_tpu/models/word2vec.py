@@ -1,0 +1,44 @@
+"""Word2Vec skip-gram with negative sampling — the reference's w2v workload
+(BASELINE.json:11: "Word2Vec skip-gram on enwiki, negative sampling, async
+push").
+
+Input ("center") and output ("context") embeddings live in two SparseTables
+keyed by vocab id. A training example is (center, positive context, K
+negatives); SGNS loss = log σ(u·v⁺) + Σ log σ(−u·v⁻). Negative sampling is
+done host-side from a unigram^0.75 table (the reference samples host-side
+too); the device sees fixed-shape [B], [B], [B, K] id arrays.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sgns_loss(center_rows, pos_rows, neg_rows):
+    """center [B, k], pos [B, k], neg [B, K, k] → scalar SGNS loss."""
+    pos_score = jnp.sum(center_rows * pos_rows, axis=-1)              # [B]
+    neg_score = jnp.einsum("bk,bnk->bn", center_rows, neg_rows)       # [B, K]
+    pos_loss = jnp.logaddexp(0.0, -pos_score)
+    neg_loss = jnp.sum(jnp.logaddexp(0.0, neg_score), axis=-1)
+    return jnp.mean(pos_loss + neg_loss)
+
+
+def grad_fn(center_rows, pos_rows, neg_rows):
+    def f(rows):
+        return sgns_loss(*rows)
+    l, (gc, gp, gn) = jax.value_and_grad(f)((center_rows, pos_rows, neg_rows))
+    return l, gc, gp, gn
+
+
+class UnigramSampler:
+    """Host-side negative sampler over unigram counts^0.75."""
+
+    def __init__(self, counts: np.ndarray, power: float = 0.75, seed: int = 0):
+        p = np.asarray(counts, np.float64) ** power
+        self._p = p / p.sum()
+        self._rng = np.random.default_rng(seed)
+
+    def sample(self, shape) -> np.ndarray:
+        return self._rng.choice(len(self._p), size=shape, p=self._p)

@@ -1,0 +1,5 @@
+from minips_tpu.ops.sparse_update import (  # noqa: F401
+    dedup_segment_sum,
+    row_adagrad,
+    row_sgd,
+)

@@ -1,0 +1,58 @@
+"""Row-wise sparse updates — the per-key server update, jit-safe.
+
+The reference server applies ``updater->Update(keys, grads)`` touching only
+the pushed keys (SURVEY.md §3.3). On TPU that becomes scatter-add (SGD) or a
+dedup + row-wise accumulator step (Adagrad), with static shapes throughout:
+duplicates are merged with a sorted-segment sum (O(B log B)) so the
+accumulator sees each touched row exactly once per push — matching the
+reference's "sum duplicate Adds, then update" semantics.
+
+Shared by SparseTable.push and the fused GSPMD training steps so both paths
+have identical numerics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dedup_segment_sum(slots: jnp.ndarray, grads: jnp.ndarray):
+    """Merge duplicate slots. Returns (rep_slots [B], summed [B, D], valid
+    [B]) where only the first k entries (k = number of unique slots) are
+    valid; invalid entries have summed == 0 so scatter-ADDs are no-ops.
+    Shapes are static (B) for jit."""
+    slots = slots.reshape(-1)
+    grads = grads.reshape(slots.shape[0], -1)
+    order = jnp.argsort(slots)
+    s_sorted = slots[order]
+    g_sorted = grads[order]
+    first = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), s_sorted[1:] != s_sorted[:-1]])
+    seg_id = jnp.cumsum(first) - 1
+    n = s_sorted.shape[0]
+    g_sum = jnp.zeros_like(g_sorted).at[seg_id].add(g_sorted)
+    rep = jnp.zeros(n, slots.dtype).at[seg_id].max(s_sorted)
+    valid = jnp.arange(n) <= seg_id[-1]
+    g_sum = jnp.where(valid[:, None], g_sum, 0)
+    rep = jnp.where(valid, rep, 0)
+    return rep, g_sum, valid
+
+
+def row_sgd(emb: jnp.ndarray, slots: jnp.ndarray, grads: jnp.ndarray,
+            lr: float) -> jnp.ndarray:
+    """SGD scatter: duplicates accumulate natively under scatter-add."""
+    return emb.at[slots.reshape(-1)].add(
+        -lr * grads.reshape(slots.size, -1).astype(emb.dtype))
+
+
+def row_adagrad(emb: jnp.ndarray, accum: jnp.ndarray, slots: jnp.ndarray,
+                grads: jnp.ndarray, lr: float, eps: float = 1e-10):
+    """Row-wise Adagrad on the touched rows only (O(B·D) per push)."""
+    rep, g_sum, _ = dedup_segment_sum(slots, grads.astype(emb.dtype))
+    g2 = g_sum * g_sum
+    acc_rows = accum[rep] + g2
+    accum = accum.at[rep].add(g2)
+    step = -lr * g_sum / (jnp.sqrt(acc_rows) + eps)
+    emb = emb.at[rep].add(step)
+    return emb, accum

@@ -120,46 +120,22 @@ class SparseTable:
 
     @functools.cached_property
     def _jit_push_sgd(self):
+        from minips_tpu.ops.sparse_update import row_sgd
+
         @functools.partial(jax.jit, donate_argnums=(0,))
         def push(emb, keys, grads):
             slots = hash_to_slots(keys, self.num_slots, self.salt)
-            return emb.at[slots.reshape(-1)].add(
-                -self.lr * grads.reshape(-1, self.dim))
+            return row_sgd(emb, slots, grads, self.lr)
         return push
 
     @functools.cached_property
     def _jit_push_adagrad(self):
+        from minips_tpu.ops.sparse_update import row_adagrad
+
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def push(emb, accum, keys, grads):
-            slots = hash_to_slots(keys, self.num_slots, self.salt).reshape(-1)
-            g = grads.reshape(-1, self.dim)
-            # Sum duplicate keys first (reference Add semantics), then do one
-            # row-wise adagrad step on the summed grad. segment-style sum via
-            # scatter-add into a dense grad buffer restricted to touched rows
-            # would still be O(num_slots); instead sum duplicates with a
-            # sorted-segment trick that stays O(B log B).
-            order = jnp.argsort(slots)
-            s_sorted = slots[order]
-            g_sorted = g[order]
-            first = jnp.concatenate(
-                [jnp.ones(1, jnp.bool_), s_sorted[1:] != s_sorted[:-1]])
-            seg_id = jnp.cumsum(first) - 1
-            n = s_sorted.shape[0]
-            g_sum = jnp.zeros((n, self.dim), g.dtype).at[seg_id].add(g_sorted)
-            # representative slot per segment (padded with slot of last seg)
-            rep = jnp.zeros(n, jnp.int32).at[seg_id].max(s_sorted)
-            valid = jnp.arange(n) <= seg_id[-1]
-            rep = jnp.where(valid, rep, 0)
-            g_sum = jnp.where(valid[:, None], g_sum, 0.0)
-            # scatter-ADD a zero delta for padding rows: duplicate padded
-            # indices are harmless under add (they would race under set)
-            g2 = g_sum * g_sum
-            acc_rows = accum[rep] + g2
-            accum = accum.at[rep].add(g2)
-            # epsilon guards adagrad_init=0 + zero-grad dims (0/sqrt(0)=NaN)
-            step = -self.lr * g_sum / (jnp.sqrt(acc_rows) + 1e-10)
-            emb = emb.at[rep].add(step)
-            return emb, accum
+            slots = hash_to_slots(keys, self.num_slots, self.salt)
+            return row_adagrad(emb, accum, slots, grads, self.lr)
         return push
 
     # ------------------------------------------------------------- state I/O

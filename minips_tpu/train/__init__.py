@@ -1,0 +1,2 @@
+from minips_tpu.train.ps_step import PSTrainStep  # noqa: F401
+from minips_tpu.train.loop import TrainLoop  # noqa: F401

@@ -1,0 +1,63 @@
+"""TrainLoop — the driver's Run() loop for SPMD apps.
+
+Threads together the pieces the reference scatters across Engine::Run and
+the app UDF (SURVEY.md §3.2-3.3): data iteration, the fused step, JSONL
+metrics with samples/sec (the [T1] primary metric), optional periodic
+checkpointing, and the consistency clock (for observability; on the pure
+SPMD path BSP is implicit in the collectives).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+from minips_tpu.utils.metrics import MetricsLogger
+from minips_tpu.utils.timing import StepTimer
+
+
+class TrainLoop:
+    def __init__(
+        self,
+        step: Callable[[Any], Any],
+        data: Iterable[Any],
+        *,
+        metrics: Optional[MetricsLogger] = None,
+        log_every: int = 10,
+        batch_size: Optional[int] = None,
+        checkpointer=None,
+        checkpoint_every: int = 0,
+        warmup_steps: int = 2,
+    ):
+        self.step = step
+        self.data = data
+        self.metrics = metrics or MetricsLogger(verbose=False)
+        self.log_every = log_every
+        self.batch_size = batch_size
+        self.checkpointer = checkpointer
+        self.checkpoint_every = checkpoint_every
+        self.timer = StepTimer(warmup_steps=warmup_steps)
+
+    def run(self, num_iters: int) -> list[float]:
+        losses: list[float] = []
+        it = iter(self.data)
+        for i in range(num_iters):
+            batch = next(it)
+            loss = self.step(batch)
+            n = (self.batch_size if self.batch_size is not None
+                 else _leading_dim(batch))
+            self.timer.step(n)
+            losses.append(float(loss))
+            if self.log_every and (i + 1) % self.log_every == 0:
+                self.metrics.log(step=i + 1, loss=float(loss),
+                                 samples_per_sec=self.timer.samples_per_sec)
+            if (self.checkpointer is not None and self.checkpoint_every
+                    and (i + 1) % self.checkpoint_every == 0):
+                self.checkpointer.save(step=i + 1)
+        return losses
+
+
+def _leading_dim(batch) -> int:
+    import jax
+
+    leaves = jax.tree.leaves(batch)
+    return int(leaves[0].shape[0]) if leaves else 0

@@ -1,0 +1,148 @@
+"""PSTrainStep — one fused SPMD program for dense + sparse tables.
+
+The reference's hot loop does four round-trips per iteration: pull sparse
+keys, pull dense weights, push sparse grads, push dense grads — each a zmq
+hop through server threads (SURVEY.md §3.3). Here the whole iteration is ONE
+jitted GSPMD program: shardings are annotated on the table state and batch,
+and XLA inserts the collectives (all-gather for pulls, reduce-scatter for
+dense pushes, gather/scatter collectives for embedding traffic) over ICI —
+the "pick a mesh, annotate shardings, let the compiler insert collectives"
+recipe (SURVEY.md §2.3; PAPERS.md arXiv 2004.13336 for the sharded weight
+update).
+
+User contract:
+    loss_fn(dense_params, rows: dict[name, [B?, F?, dim]], batch) -> loss
+    key_fns[name](batch) -> integer key array for that sparse table
+
+The step differentiates through dense params and gathered rows, applies the
+dense updater on the sharded flat vector and the row-wise sparse updater on
+the touched slots — identical numerics to DenseTable.push /
+SparseTable.push (shared ops in minips_tpu/ops/sparse_update.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from minips_tpu.parallel.mesh import DATA_AXIS
+from minips_tpu.ops.sparse_update import row_adagrad, row_sgd
+from minips_tpu.tables.dense import DenseTable
+from minips_tpu.tables.sparse import SparseTable, hash_to_slots
+
+PyTree = Any
+
+
+class PSTrainStep:
+    """Builds and runs the fused step; owns nothing — state stays in the
+    tables, flowing through the jitted function with donation."""
+
+    def __init__(
+        self,
+        loss_fn: Callable[..., jnp.ndarray],
+        dense: Optional[DenseTable] = None,
+        sparse: Optional[dict[str, SparseTable]] = None,
+        key_fns: Optional[dict[str, Callable]] = None,
+    ):
+        self.loss_fn = loss_fn
+        self.dense = dense
+        self.sparse = sparse or {}
+        self.key_fns = key_fns or {}
+        if "dense" in self.sparse:
+            raise ValueError(
+                "'dense' is a reserved state key; rename the sparse table")
+        missing = set(self.sparse) - set(self.key_fns)
+        if missing:
+            raise ValueError(f"sparse tables missing key_fns: {missing}")
+        self._mesh = (dense.mesh if dense is not None
+                      else next(iter(self.sparse.values())).mesh)
+        self._jit_step = self._build()
+
+    # ------------------------------------------------------------------ build
+    def _collect_state(self) -> dict:
+        state: dict = {}
+        if self.dense is not None:
+            state["dense"] = (self.dense.params, self.dense.opt_state)
+        for name, t in self.sparse.items():
+            state[name] = (t.emb, t.accum)
+        return state
+
+    def _restore_state(self, state: dict) -> None:
+        if self.dense is not None:
+            self.dense.params, self.dense.opt_state = state["dense"]
+        for name, t in self.sparse.items():
+            t.emb, t.accum = state[name]
+
+    def _build(self):
+        dense = self.dense
+        sparse = dict(self.sparse)
+        key_fns = dict(self.key_fns)
+        loss_fn = self.loss_fn
+        mesh = self._mesh
+
+        def step(state, batch):
+            # ----- pull phase (differentiable views of table state)
+            if dense is not None:
+                p_flat, opt = state["dense"]
+
+            def compute_loss(p_flat_in, rows_in):
+                dp = (dense._unravel(p_flat_in[: dense.num_keys])
+                      if dense is not None else None)
+                return loss_fn(dp, rows_in, batch)
+
+            slots = {}
+            rows = {}
+            for name, t in sparse.items():
+                keys = key_fns[name](batch)
+                slots[name] = hash_to_slots(jnp.asarray(keys), t.num_slots,
+                                            t.salt)
+                rows[name] = state[name][0][slots[name]]
+
+            if dense is not None:
+                loss, (g_flat, g_rows) = jax.value_and_grad(
+                    compute_loss, argnums=(0, 1))(p_flat, rows)
+            else:
+                loss, g_rows = jax.value_and_grad(
+                    lambda rw: compute_loss(None, rw))(rows)
+
+            new_state = dict(state)
+            # ----- dense push: reduce-scatter + sharded optax update
+            if dense is not None:
+                g_flat = jax.lax.with_sharding_constraint(
+                    g_flat, NamedSharding(mesh, P(DATA_AXIS)))
+                updates, opt = dense.tx.update(g_flat, opt, p_flat)
+                new_state["dense"] = (optax.apply_updates(p_flat, updates),
+                                      opt)
+            # ----- sparse pushes: row-wise updater on touched slots
+            for name, t in sparse.items():
+                emb, accum = state[name]
+                if t.updater == "sgd":
+                    emb = row_sgd(emb, slots[name], g_rows[name], t.lr)
+                else:
+                    emb, accum = row_adagrad(emb, accum, slots[name],
+                                             g_rows[name], t.lr)
+                new_state[name] = (emb, accum)
+            return new_state, loss
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    # -------------------------------------------------------------------- run
+    def __call__(self, batch) -> float:
+        """Run one fused step against the tables' live state. The batch
+        should already be device_put with data-axis sharding (use
+        ``shard_batch``)."""
+        state = self._collect_state()
+        new_state, loss = self._jit_step(state, batch)
+        self._restore_state(new_state)
+        return loss
+
+    def shard_batch(self, batch: PyTree) -> PyTree:
+        """device_put batch leaves sharded along the data axis (axis 0)."""
+        sharding = NamedSharding(self._mesh, P(DATA_AXIS))
+        return jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), sharding), batch)

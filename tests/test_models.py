@@ -1,0 +1,88 @@
+"""Model math vs oracle + convergence smoke (SURVEY.md §4 app-level
+validation: "loss goes down")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from minips_tpu.models import lr, mf, mlp, wide_deep, word2vec
+
+
+def test_lr_bce_oracle():
+    logits = jnp.array([0.0, 2.0, -2.0])
+    y = jnp.array([0.0, 1.0, 0.0])
+    got = float(lr.bce_with_logits(logits, y))
+    p = 1 / (1 + np.exp(-np.array([0.0, 2.0, -2.0])))
+    want = -np.mean(np.array([np.log(1 - p[0]), np.log(p[1]),
+                              np.log(1 - p[2])]))
+    assert abs(got - want) < 1e-6
+
+
+def test_lr_sparse_matches_dense():
+    """Sparse (idx/val/mask) logits must equal the dense dot product."""
+    rng = np.random.default_rng(0)
+    D = 16
+    w = rng.normal(size=D).astype(np.float32)
+    idx = np.array([[1, 5, 3], [0, 2, 2]], np.int32)
+    val = rng.normal(size=(2, 3)).astype(np.float32)
+    mask = np.array([[1, 1, 0], [1, 1, 1]], np.float32)
+    X = np.zeros((2, D), np.float32)
+    for r in range(2):
+        for c in range(3):
+            if mask[r, c]:
+                X[r, idx[r, c]] += val[r, c]
+    w_rows = w[idx][..., None]
+    got = np.asarray(lr.logits_sparse(jnp.asarray(w_rows), jnp.asarray(val),
+                                      jnp.asarray(mask)))
+    np.testing.assert_allclose(got, X @ w, rtol=1e-5)
+
+
+def test_mlp_shapes_and_loss_finite():
+    params = mlp.init(jax.random.PRNGKey(0), (20, 16, 8, 4))
+    x = jnp.ones((32, 20))
+    out = mlp.apply(params, x)
+    assert out.shape == (32, 4)
+    l, g = mlp.grad_fn(params, {"x": x, "y": jnp.zeros(32, jnp.int32)})
+    assert np.isfinite(float(l))
+    assert jax.tree.all(jax.tree.map(lambda a: np.isfinite(a).all(), g))
+
+
+def test_mf_prediction_oracle():
+    u = jnp.array([[1.0, 2.0, 0.5]])   # last col = user bias
+    v = jnp.array([[3.0, 4.0, 1.0]])   # last col = 1 (bias carrier)
+    pred = float(mf.predict(u, v, mu=3.0)[0])
+    assert abs(pred - (3.0 + 3.0 + 8.0 + 0.5)) < 1e-6
+
+
+def test_fm_term_oracle():
+    """FM sum-square trick vs explicit pairwise sum."""
+    rng = np.random.default_rng(1)
+    v = rng.normal(size=(3, 4, 2)).astype(np.float32)  # B=3, F=4, k=2
+    got = np.asarray(wide_deep.fm_term(jnp.asarray(v)))
+    want = np.zeros(3)
+    for b in range(3):
+        for i in range(4):
+            for j in range(i + 1, 4):
+                want[b] += v[b, i] @ v[b, j]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_sgns_loss_decreases_under_grad():
+    rng = np.random.default_rng(0)
+    c = jnp.asarray(rng.normal(scale=0.1, size=(64, 8)).astype(np.float32))
+    p = jnp.asarray(rng.normal(scale=0.1, size=(64, 8)).astype(np.float32))
+    n = jnp.asarray(rng.normal(scale=0.1, size=(64, 3, 8)).astype(np.float32))
+    l0, gc, gp, gn = word2vec.grad_fn(c, p, n)
+    c2, p2, n2 = c - 0.5 * gc, p - 0.5 * gp, n - 0.5 * gn
+    l1 = float(word2vec.sgns_loss(c2, p2, n2))
+    assert l1 < float(l0)
+
+
+def test_unigram_sampler_distribution():
+    counts = np.array([100, 10, 1, 0])
+    s = word2vec.UnigramSampler(counts, seed=0)
+    draws = s.sample(10_000)
+    freq = np.bincount(draws, minlength=4)
+    assert freq[0] > freq[1] > freq[2]
+    assert freq[3] == 0
