@@ -1,0 +1,125 @@
+"""Benchmark harness — emits ONE JSON line for the driver.
+
+Metric (BASELINE.json:2): **samples/sec/chip, LR + MLP on Criteo**. The
+reference publishes no numbers (BASELINE.json:14 "published": {}); the only
+quantitative anchor is the north-star target of >= 1M samples/sec aggregate
+on a TPU v4-32 for LR + 3-layer MLP on Criteo with SSP staleness <= 4
+(BASELINE.json:3-4). A v4-32 slice has 16 chips, so the per-chip target is
+1e6 / 16 = 62,500 samples/sec/chip; ``vs_baseline`` reports our measured
+samples/sec/chip divided by that target (>1.0 beats the north-star rate
+per chip).
+
+What runs (both fused SPMD steps on Criteo-shaped batches, steady-state
+timed after compile warmup; every sample passes through BOTH models, so the
+reported rate is the end-to-end LR+MLP pipeline rate):
+
+1. **LR**: sparse logistic regression — hashed wide table (26 categorical
+   fields) + dense 13-feature linear term.
+2. **MLP**: 3-layer tower over [13 dense ; 26 x 8 hashed embeddings], the
+   "3-layer MLP on Criteo" shape.
+
+Usage: python bench.py [--cpu] [--iters N] [--batch B]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="force CPU (8 fake devices) for development")
+    ap.add_argument("--iters", type=int, default=60)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=16384)
+    args = ap.parse_args()
+
+    if args.cpu:
+        import os
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from minips_tpu.core.config import Config, TableConfig, TrainConfig
+    from minips_tpu.data import synthetic
+    from minips_tpu.models import lr as lr_model
+    from minips_tpu.models import wide_deep as wd_model
+    from minips_tpu.parallel.mesh import make_mesh
+    from minips_tpu.tables.dense import DenseTable
+    from minips_tpu.tables.sparse import SparseTable
+    from minips_tpu.train.ps_step import PSTrainStep
+
+    n_chips = len(jax.devices())
+    mesh = make_mesh()
+    B = args.batch
+    data = synthetic.criteo_like(B, seed=0)
+
+    # ---------------- model 1: sparse LR (wide table + dense linear) -------
+    wide_t = SparseTable(1 << 18, 1, mesh, name="wide", updater="adagrad",
+                         lr=0.05, init_scale=0.0, salt=1)
+    lin_t = DenseTable(lr_model.init(13), mesh, name="lin",
+                       updater="adagrad", lr=0.05)
+
+    def lr_loss(dp, rows, batch):
+        logits = (jnp.sum(rows["wide"][..., 0], axis=-1)
+                  + lr_model.logits_dense(dp, batch["dense"]))
+        return lr_model.bce_with_logits(logits, batch["y"])
+
+    lr_step = PSTrainStep(lr_loss, dense=lin_t, sparse={"wide": wide_t},
+                          key_fns={"wide": lambda b: b["cat"]})
+
+    # ---------------- model 2: 3-layer MLP over dense + embeddings ---------
+    emb_t = SparseTable(1 << 18, 8, mesh, name="emb", updater="adagrad",
+                        lr=0.05, init_scale=0.01, salt=2)
+    deep_t = DenseTable(
+        wd_model.init_deep(jax.random.PRNGKey(0), 26, 8, 13,
+                           hidden=(256, 128)),
+        mesh, name="deep", updater="adam", lr=1e-3)
+
+    def mlp_loss(dp, rows, batch):
+        bsz = rows["emb"].shape[0]
+        x = jnp.concatenate([batch["dense"], rows["emb"].reshape(bsz, -1)],
+                            axis=-1)
+        from minips_tpu.models import mlp as mlp_model
+        logits = mlp_model.apply(dp, x)[:, 0]
+        return lr_model.bce_with_logits(logits, batch["y"])
+
+    mlp_step = PSTrainStep(mlp_loss, dense=deep_t, sparse={"emb": emb_t},
+                           key_fns={"emb": lambda b: b["cat"]})
+
+    batch = lr_step.shard_batch(data)
+
+    # ---------------- measure: every sample goes through BOTH models -------
+    for _ in range(args.warmup):
+        lr_step(batch)
+        mlp_step(batch)
+    jax.block_until_ready(lr_step.dense.params)
+    jax.block_until_ready(mlp_step.dense.params)
+    t0 = time.monotonic()
+    for _ in range(args.iters):
+        l1 = lr_step(batch)
+        l2 = mlp_step(batch)
+    jax.block_until_ready((l1, l2))
+    dt = time.monotonic() - t0
+
+    samples = args.iters * B
+    sps_per_chip = samples / dt / n_chips
+    target_per_chip = 1_000_000 / 16  # north-star on v4-32 (16 chips)
+    print(json.dumps({
+        "metric": "samples/sec/chip (LR+MLP on Criteo-shaped, fused SPMD)",
+        "value": round(sps_per_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps_per_chip / target_per_chip, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

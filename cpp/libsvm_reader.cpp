@@ -1,0 +1,140 @@
+// Native libsvm/Criteo-text parser — the rebuild of the reference's C++
+// data-loading layer (SURVEY.md §2 "Data loading": AbstractDataLoader +
+// line parsers feeding per-worker sample stores; §2.1 item 6 marks this as
+// the one host-side component where native code earns its keep for
+// samples/sec targets).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+// Two-pass contract over a whole file:
+//   pass 1: libsvm_count()  -> rows + max features/row
+//   pass 2: libsvm_parse()  -> fills caller-allocated padded arrays
+//           y[N], idx[N*W], val[N*W], mask[N*W]  (row-major, zero padded)
+// Parsing is hand-rolled (no iostream/sscanf): one linear scan, no
+// allocation per token.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct FileBuf {
+  char* data = nullptr;
+  size_t size = 0;
+  bool ok = false;
+  explicit FileBuf(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return;
+    std::fseek(f, 0, SEEK_END);
+    long n = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    if (n < 0) { std::fclose(f); return; }
+    data = static_cast<char*>(std::malloc(static_cast<size_t>(n) + 1));
+    if (!data) { std::fclose(f); return; }
+    size = std::fread(data, 1, static_cast<size_t>(n), f);
+    data[size] = '\0';
+    std::fclose(f);
+    ok = true;
+  }
+  ~FileBuf() { std::free(data); }
+};
+
+inline const char* skip_ws(const char* p) {
+  while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
+  return p;
+}
+
+// Fast non-locale float parse for "123", "-1", "0.5", "1e-3" style tokens.
+inline float parse_float(const char*& p) {
+  char* end = nullptr;
+  float v = std::strtof(p, &end);
+  p = end;
+  return v;
+}
+
+inline long parse_long(const char*& p) {
+  char* end = nullptr;
+  long v = std::strtol(p, &end, 10);
+  p = end;
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success; fills n_rows and max_width (max nnz on any row).
+int libsvm_count(const char* path, int64_t* n_rows, int64_t* max_width) {
+  FileBuf fb(path);
+  if (!fb.ok) return 1;
+  int64_t rows = 0, maxw = 0;
+  const char* p = fb.data;
+  const char* endp = fb.data + fb.size;
+  while (p < endp) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(endp - p)));
+    if (!line_end) line_end = endp;
+    p = skip_ws(p);
+    if (p < line_end) {
+      ++rows;
+      int64_t w = 0;
+      for (const char* q = p; q < line_end; ++q)
+        if (*q == ':') ++w;
+      if (w > maxw) maxw = w;
+    }
+    p = line_end + 1;
+  }
+  *n_rows = rows;
+  *max_width = maxw;
+  return 0;
+}
+
+// Fills y[N], idx[N*W], val[N*W], mask[N*W]; width W truncates longer rows.
+// Labels in {-1,1} are normalized to {0,1}; other labels pass through.
+int libsvm_parse(const char* path, int64_t n_rows, int64_t width,
+                 float* y, int32_t* idx, float* val, float* mask) {
+  FileBuf fb(path);
+  if (!fb.ok) return 1;
+  std::memset(idx, 0, sizeof(int32_t) * static_cast<size_t>(n_rows * width));
+  std::memset(val, 0, sizeof(float) * static_cast<size_t>(n_rows * width));
+  std::memset(mask, 0, sizeof(float) * static_cast<size_t>(n_rows * width));
+  const char* p = fb.data;
+  const char* endp = fb.data + fb.size;
+  int64_t r = 0;
+  bool saw_negative_label = false;
+  while (p < endp && r < n_rows) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(endp - p)));
+    if (!line_end) line_end = endp;
+    p = skip_ws(p);
+    if (p < line_end) {
+      float label = parse_float(p);
+      if (label < 0.0f) saw_negative_label = true;
+      y[r] = label;
+      int64_t c = 0;
+      while (p < line_end && c < width) {
+        p = skip_ws(p);
+        if (p >= line_end || *p == '\n') break;
+        long feature = parse_long(p);
+        if (*p != ':') break;  // malformed token: stop this row
+        ++p;
+        float v = parse_float(p);
+        int64_t off = r * width + c;
+        idx[off] = static_cast<int32_t>(feature);
+        val[off] = v;
+        mask[off] = 1.0f;
+        ++c;
+      }
+      ++r;
+    }
+    p = line_end + 1;
+  }
+  if (saw_negative_label) {  // {-1,1} -> {0,1} (a9a convention)
+    for (int64_t i = 0; i < n_rows; ++i) y[i] = y[i] > 0.0f ? 1.0f : 0.0f;
+  }
+  return r == n_rows ? 0 : 2;
+}
+
+}  // extern "C"

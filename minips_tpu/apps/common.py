@@ -6,7 +6,11 @@ from __future__ import annotations
 
 import argparse
 
+import numpy as np
+
 from minips_tpu.core.config import Config, add_config_flags, config_from_args
+from minips_tpu.core.engine import Engine, MLTask
+from minips_tpu.data.loader import BatchIterator
 from minips_tpu.utils.metrics import MetricsLogger
 
 
@@ -33,3 +37,36 @@ def app_main(name: str, default_cfg: Config, run, extra_flags=None):
     result = run(cfg, args, metrics)
     metrics.close()
     return result
+
+
+def threaded_train(engine: Engine, cfg: Config, data: dict, step_fn,
+                   *, clock_tables: list[str],
+                   n_iters: int | None = None) -> list[float]:
+    """Shared threaded-worker loop (reference UDF shape, SURVEY.md §3.3):
+    each worker iterates its data shard, calls ``step_fn(info, batch) ->
+    loss`` (which pulls/pushes through the consistency gate — step_fn is
+    responsible for scaling grads by 1/num_workers where the updater
+    expects a mean), clocks the listed tables, and per-iteration losses are
+    averaged across workers."""
+    n_iters = n_iters or cfg.train.num_iters
+    n_rows = len(next(iter(data.values())))
+    losses_by_worker: dict[int, list[float]] = {}
+
+    def udf(info):
+        shard = np.array_split(np.arange(n_rows),
+                               info.num_workers)[info.worker_id]
+        batches = BatchIterator(
+            {k: v[shard] for k, v in data.items()},
+            min(cfg.train.batch_size, max(len(shard) // 2, 1)),
+            seed=cfg.train.seed + info.worker_id)
+        losses = []
+        for batch, _ in zip(batches, range(n_iters)):
+            losses.append(float(step_fn(info, batch)))
+            for t in clock_tables:
+                info.table(t).clock()
+        losses_by_worker[info.worker_id] = losses
+
+    engine.run(MLTask(fn=udf))
+    n = min(len(v) for v in losses_by_worker.values())
+    return [float(np.mean([losses_by_worker[w][i]
+                           for w in losses_by_worker])) for i in range(n)]

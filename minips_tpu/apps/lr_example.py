@@ -48,9 +48,9 @@ def run(cfg: Config, args, metrics) -> dict:
 
 
 def _run_dense(cfg, args, metrics, data, dim) -> dict:
-    batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
     if getattr(args, "exec_mode", "spmd") == "threaded":
         return _run_threaded(cfg, metrics, data, dim)
+    batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
     mesh = make_mesh()
     table = DenseTable(lr_model.init(dim), mesh, updater=cfg.table.updater,
                        lr=cfg.table.lr)
@@ -60,10 +60,20 @@ def _run_dense(cfg, args, metrics, data, dim) -> dict:
         b = {k: jnp.asarray(v) for k, v in batch.items()}
         return table.step_inplace(step, b)
 
+    ck, start_step = None, 0
+    if cfg.train.checkpoint_dir:
+        from minips_tpu.ckpt.checkpoint import Checkpointer
+        ck = Checkpointer(cfg.train.checkpoint_dir, {"weights": table})
+        if ck.list_steps():  # resume-from-latest (SURVEY.md §3.5)
+            start_step = ck.restore()
+            metrics.log(resumed_from_step=start_step)
     loop = TrainLoop(do_step, batches, metrics=metrics,
                      log_every=cfg.train.log_every,
-                     batch_size=cfg.train.batch_size)
-    losses = loop.run(cfg.train.num_iters)
+                     batch_size=cfg.train.batch_size,
+                     checkpointer=ck,
+                     checkpoint_every=cfg.train.checkpoint_every,
+                     step_offset=start_step)
+    losses = loop.run(max(cfg.train.num_iters - start_step, 0))
     return {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
             "table": table}
 
@@ -88,42 +98,27 @@ def _run_sparse(cfg, args, metrics, data) -> dict:
 
 
 def _run_threaded(cfg, metrics, data, dim) -> dict:
+    from minips_tpu.apps.common import threaded_train
+
     engine = Engine(num_workers=cfg.train.num_workers).start_everything()
     engine.create_table(
         TableConfig(name="w", kind="dense", consistency=cfg.table.consistency,
                     staleness=cfg.table.staleness, updater=cfg.table.updater,
                     lr=cfg.table.lr),
         template=lr_model.init(dim))
-    n_iters = cfg.train.num_iters
-    per_worker_losses: dict[int, list] = {}
+    g = jax.jit(lr_model.grad_fn_dense)
 
-    def udf(info):
+    def step_fn(info, batch):
         tbl = info.table("w")
-        shard = np.array_split(np.arange(len(data["y"])),
-                               info.num_workers)[info.worker_id]
-        batches = BatchIterator({k: v[shard] for k, v in data.items()},
-                                min(cfg.train.batch_size,
-                                    max(len(shard) // 2, 1)),
-                                seed=cfg.train.seed + info.worker_id)
-        g = jax.jit(lambda p, b: lr_model.grad_fn_dense(p, b))
-        losses = []
-        for batch, _ in zip(batches, range(n_iters)):
-            params = tbl.pull()
-            b = {k: jnp.asarray(v) for k, v in batch.items()}
-            loss, grads = g(params, b)
-            grads = jax.tree.map(lambda x: x / info.num_workers, grads)
-            tbl.push(grads)
-            tbl.clock()
-            losses.append(float(loss))
-        per_worker_losses[info.worker_id] = losses
-        return losses
+        params = tbl.pull()
+        loss, grads = g(params, {k: jnp.asarray(v) for k, v in batch.items()})
+        tbl.push(jax.tree.map(lambda x: x / info.num_workers, grads))
+        return loss
 
-    engine.run(MLTask(fn=udf))
+    mean_losses = threaded_train(engine, cfg, data, step_fn,
+                                 clock_tables=["w"])
     skew = engine.controllers["w"].skew
     engine.stop_everything()
-    mean_losses = [float(np.mean([per_worker_losses[w][i]
-                                  for w in per_worker_losses]))
-                   for i in range(n_iters)]
     metrics.log(final_loss=mean_losses[-1], clock_skew=skew)
     return {"losses": mean_losses, "samples_per_sec": 0.0, "skew": skew}
 

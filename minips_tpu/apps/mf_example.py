@@ -68,39 +68,29 @@ def run(cfg: Config, args, metrics) -> dict:
 
 
 def _run_threaded(cfg, metrics, data, user_t, item_t) -> dict:
-    engine = Engine(num_workers=cfg.train.num_workers).start_everything()
+    from minips_tpu.apps.common import threaded_train
     from minips_tpu.consistency import make_controller
+
+    engine = Engine(num_workers=cfg.train.num_workers).start_everything()
     for name, t in (("user", user_t), ("item", item_t)):
         engine.register_table(name, t, make_controller(
             "asp", engine.num_workers, sync_every=0))
+    g = jax.jit(functools.partial(mf_model.grad_fn, mu=MU))
 
-    n_iters = cfg.train.num_iters
-    all_losses: dict[int, list] = {}
-
-    def udf(info):
+    def step_fn(info, batch):
         ut, it_ = info.table("user"), info.table("item")
-        shard = np.array_split(np.arange(len(data["rating"])),
-                               info.num_workers)[info.worker_id]
-        batches = BatchIterator({k: v[shard] for k, v in data.items()},
-                                min(cfg.train.batch_size, len(shard)),
-                                seed=cfg.train.seed + info.worker_id)
-        g = jax.jit(functools.partial(mf_model.grad_fn, mu=MU))
-        losses = []
-        for batch, _ in zip(batches, range(n_iters)):
-            u_rows = ut.pull(keys=batch["user"])   # ASP: never blocks
-            i_rows = it_.pull(keys=batch["item"])
-            loss, gu, gi = g(u_rows, i_rows,
-                             {"rating": jnp.asarray(batch["rating"])})
-            ut.push(gu, keys=batch["user"])
-            it_.push(gi, keys=batch["item"])
-            ut.clock(); it_.clock()
-            losses.append(float(loss))
-        all_losses[info.worker_id] = losses
+        u_rows = ut.pull(keys=batch["user"])   # ASP: never blocks
+        i_rows = it_.pull(keys=batch["item"])
+        loss, gu, gi = g(u_rows, i_rows,
+                         {"rating": jnp.asarray(batch["rating"])})
+        # scale by 1/num_workers so aggregate step size matches spmd mode
+        ut.push(gu / info.num_workers, keys=batch["user"])
+        it_.push(gi / info.num_workers, keys=batch["item"])
+        return loss
 
-    engine.run(MLTask(fn=udf))
+    mean_losses = threaded_train(engine, cfg, data, step_fn,
+                                 clock_tables=["user", "item"])
     engine.stop_everything()
-    mean_losses = [float(np.mean([all_losses[w][i] for w in all_losses]))
-                   for i in range(min(len(v) for v in all_losses.values()))]
     metrics.log(final_loss=mean_losses[-1])
     return {"losses": mean_losses, "samples_per_sec": 0.0}
 

@@ -36,11 +36,11 @@ def run(cfg: Config, args, metrics) -> dict:
     sizes = (784, 256, 128, 10)
     data = synthetic.mnist_like(8192, seed=cfg.train.seed)
     template = mlp_model.init(jax.random.PRNGKey(cfg.train.seed), sizes)
-    batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
 
     if getattr(args, "exec_mode", "spmd") == "threaded":
         return _run_threaded(cfg, metrics, data, template)
 
+    batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
     mesh = make_mesh()
     table = DenseTable(template, mesh, updater=cfg.table.updater,
                        lr=cfg.table.lr)
@@ -63,6 +63,8 @@ def run(cfg: Config, args, metrics) -> dict:
 
 
 def _run_threaded(cfg, metrics, data, template) -> dict:
+    from minips_tpu.apps.common import threaded_train
+
     engine = Engine(num_workers=cfg.train.num_workers).start_everything()
     engine.create_table(
         TableConfig(name="mlp", kind="dense",
@@ -70,39 +72,24 @@ def _run_threaded(cfg, metrics, data, template) -> dict:
                     staleness=cfg.table.staleness,
                     updater=cfg.table.updater, lr=cfg.table.lr),
         template=template)
-    n_iters = cfg.train.num_iters
-    losses_by_worker: dict[int, list] = {}
+    g = jax.jit(mlp_model.grad_fn)
 
-    def udf(info):
+    def step_fn(info, batch):
         tbl = info.table("mlp")
-        shard = np.array_split(np.arange(len(data["y"])),
-                               info.num_workers)[info.worker_id]
-        batches = BatchIterator(
-            {k: v[shard] for k, v in data.items()},
-            min(cfg.train.batch_size, max(len(shard) // 2, 1)),
-            seed=cfg.train.seed + info.worker_id)
-        g = jax.jit(mlp_model.grad_fn)
-        losses = []
-        for batch, _ in zip(batches, range(n_iters)):
-            params = tbl.pull()
-            b = {"x": jnp.asarray(batch["x"]), "y": jnp.asarray(batch["y"])}
-            loss, grads = g(params, b)
-            tbl.push(jax.tree.map(lambda x: x / info.num_workers, grads))
-            tbl.clock()
-            losses.append(float(loss))
-        losses_by_worker[info.worker_id] = losses
-        return losses
+        params = tbl.pull()
+        loss, grads = g(params, {"x": jnp.asarray(batch["x"]),
+                                 "y": jnp.asarray(batch["y"])})
+        tbl.push(jax.tree.map(lambda x: x / info.num_workers, grads))
+        return loss
 
-    engine.run(MLTask(fn=udf))
+    mean_losses = threaded_train(engine, cfg, data, step_fn,
+                                 clock_tables=["mlp"])
     skew = engine.controllers["mlp"].skew
     final_params = engine.tables["mlp"].pull()
     engine.stop_everything()
     acc = float(mlp_model.accuracy(
         final_params, {"x": jnp.asarray(data["x"][:2048]),
                        "y": jnp.asarray(data["y"][:2048])}))
-    mean_losses = [float(np.mean([losses_by_worker[w][i]
-                                  for w in losses_by_worker]))
-                   for i in range(n_iters)]
     metrics.log(final_loss=mean_losses[-1], accuracy=acc, clock_skew=skew)
     return {"losses": mean_losses, "accuracy": acc, "skew": skew,
             "samples_per_sec": 0.0}

@@ -34,7 +34,8 @@ NUM_DENSE, NUM_CAT = 13, 26
 
 
 def build(cfg: Config, *, use_fm: bool, mesh=None, seed: int = 0):
-    """Tables + fused step for W&D/DeepFM; shared with bench.py."""
+    """Tables + fused step for W&D/DeepFM; also used by
+    __graft_entry__.dryrun_multichip."""
     mesh = mesh or make_mesh()
     emb_dim = cfg.table.dim
     wide_t = SparseTable(cfg.table.num_slots, 1, mesh, name="wide",

@@ -1,0 +1,1 @@
+from minips_tpu.ckpt.checkpoint import Checkpointer  # noqa: F401

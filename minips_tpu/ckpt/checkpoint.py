@@ -1,0 +1,157 @@
+"""Checkpoint/recovery — rebuild of the reference's Dump/Load path.
+
+The reference dumps KVTable contents to disk every K iterations (worker 0)
+and recovers by restarting the task from the last dump (SURVEY.md §2
+"Checkpoint/recovery", §3.5). PS state = parameters + optimizer state +
+the clock vector, so that is exactly what a checkpoint holds here
+(SURVEY.md §5.4):
+
+- one ``.npz`` per table (dense: params + opt leaves; sparse: emb + accum),
+- a JSON manifest with step, table names/kinds and controller clocks,
+- atomic publish: write to ``step_K.tmp/`` then rename to ``step_K/``, so a
+  crash mid-save never corrupts the latest good checkpoint,
+- optional async save (a background thread snapshots host copies first —
+  the device keeps training while bytes hit disk), the moral equivalent of
+  orbax async checkpointing without requiring it.
+
+Recovery = construct the same tables, ``restore()`` the newest step, resume
+the loop at ``step`` (SURVEY.md §5.3: recovery is relaunch + reload; no
+elastic resize, same as the reference's fixed node set).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+
+class Checkpointer:
+    def __init__(self, directory: str, tables: dict[str, Any],
+                 controllers: Optional[dict[str, Any]] = None,
+                 *, keep: int = 3, async_save: bool = False):
+        self.dir = directory
+        self.tables = tables
+        self.controllers = controllers or {}
+        self.keep = keep
+        self.async_save = async_save
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(directory, exist_ok=True)
+
+    # ------------------------------------------------------------------ save
+    def save(self, step: int) -> str:
+        """Snapshot to host, then (a)synchronously write + atomically
+        publish ``step_<step>/``."""
+        snap = {name: t.state_dict() for name, t in self.tables.items()}
+        clocks = {name: c.state_dict() for name, c in self.controllers.items()}
+        if self.async_save:
+            self.wait()  # one save in flight at a time
+            self._thread = threading.Thread(
+                target=self._write, args=(step, snap, clocks), daemon=True)
+            self._thread.start()
+        else:
+            self._write(step, snap, clocks)
+        return self._step_dir(step)
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.dir, f"step_{step:010d}")
+
+    def _write(self, step: int, snap: dict, clocks: dict) -> None:
+        final = self._step_dir(step)
+        tmp = final + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        for name, state in snap.items():
+            flat = _flatten(state)
+            np.savez(os.path.join(tmp, f"{name}.npz"), **flat)
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump({"step": step, "tables": sorted(snap),
+                       "clocks": clocks}, f)
+        shutil.rmtree(final, ignore_errors=True)
+        os.replace(tmp, final)
+        self._gc()
+
+    def _gc(self) -> None:
+        steps = self.list_steps()
+        for s in steps[: -self.keep] if self.keep > 0 else []:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    # --------------------------------------------------------------- restore
+    def list_steps(self) -> list[int]:
+        out = []
+        for d in os.listdir(self.dir):
+            m = re.fullmatch(r"step_(\d+)", d)
+            if m and os.path.exists(os.path.join(self.dir, d,
+                                                 "manifest.json")):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def restore(self, step: Optional[int] = None) -> int:
+        """Load the given (or newest) step into the live tables/controllers.
+        Returns the restored step number."""
+        steps = self.list_steps()
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {self.dir}")
+        step = steps[-1] if step is None else step
+        d = self._step_dir(step)
+        with open(os.path.join(d, "manifest.json")) as f:
+            manifest = json.load(f)
+        for name, t in self.tables.items():
+            path = os.path.join(d, f"{name}.npz")
+            with np.load(path) as z:
+                t.load_state_dict(_unflatten(dict(z.items())))
+        for name, c in self.controllers.items():
+            if name in manifest.get("clocks", {}):
+                c.load_state_dict(manifest["clocks"][name])
+        return manifest["step"]
+
+
+# --------------------------------------------------------------------- utils
+def _flatten(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
+    """Flatten a nested state dict (dicts/lists/tuples/ndarrays) to
+    slash-keyed arrays for npz."""
+    out: dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}_{i}/"))
+    elif tree is None:
+        out[prefix + "__none__"] = np.zeros(0)
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat: dict[str, np.ndarray]) -> Any:
+    """Inverse of _flatten (lists come back as lists)."""
+    root: dict = {}
+    for key, val in flat.items():
+        parts = key.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = None if parts[-1] == "__none__" else val
+    return _listify(root)
+
+
+def _listify(node: Any) -> Any:
+    if not isinstance(node, dict):
+        return node
+    if node.keys() and all(re.fullmatch(r"_\d+", k) for k in node):
+        return [_listify(node[k]) for k in
+                sorted(node, key=lambda s: int(s[1:]))]
+    if set(node.keys()) == {"__none__"}:
+        return None
+    return {k: _listify(v) for k, v in node.items()}

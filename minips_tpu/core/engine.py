@@ -233,6 +233,14 @@ class Engine:
             raise root
         return results
 
+    def make_checkpointer(self, directory: str, **kwargs):
+        """Checkpointer over every table + controller this engine owns
+        (reference Dump/Load, SURVEY.md §3.5)."""
+        from minips_tpu.ckpt.checkpoint import Checkpointer
+
+        return Checkpointer(directory, self.tables, self.controllers,
+                            **kwargs)
+
     def barrier(self) -> None:
         """All logical workers are joined at the end of run(); a standalone
         barrier is only meaningful multi-host, where it delegates to the

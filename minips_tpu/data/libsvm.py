@@ -65,7 +65,7 @@ def read_libsvm(path: str, max_features: int | None = None,
         val[r, :k] = vv[:k]
         mask[r, :k] = 1.0
     # normalize labels {-1,1} -> {0,1} (a9a convention)
-    if y.min() < 0:
+    if y.size and y.min() < 0:
         y = (y > 0).astype(np.float32)
     return {"y": y, "idx": idx, "val": val, "mask": mask}
 

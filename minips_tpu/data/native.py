@@ -1,0 +1,84 @@
+"""ctypes binding for the C++ data-path library (cpp/libsvm_reader.cpp).
+
+The reference's loaders are native C++ (SURVEY.md §2 "Data loading");
+pybind11 is absent in this image so the boundary is a plain C ABI + ctypes
+(zero-copy into numpy buffers). The library is built lazily on first use
+(one ~1s g++ invocation) and everything degrades to the pure-Python parser
+when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_CPP = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "cpp")
+_LIB_PATH = os.path.join(_REPO_CPP, "build", "libminips_data.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(["make", "-C", _REPO_CPP], check=True,
+                               capture_output=True, timeout=120)
+            except (OSError, subprocess.SubprocessError):
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.libsvm_count.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.libsvm_count.restype = ctypes.c_int
+        lib.libsvm_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")]
+        lib.libsvm_parse.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def read_libsvm_native(path: str,
+                       max_features: Optional[int] = None) -> Optional[dict]:
+    """Native fast path for data.libsvm.read_libsvm. Returns None when the
+    library is unavailable (caller falls back to pure Python)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = ctypes.c_int64()
+    w = ctypes.c_int64()
+    if lib.libsvm_count(path.encode(), ctypes.byref(n), ctypes.byref(w)):
+        raise ImportError(f"cannot read {path}")
+    rows, width = n.value, w.value
+    if max_features is not None:
+        width = min(width, max_features)
+    width = max(width, 1)
+    y = np.zeros(rows, np.float32)
+    idx = np.zeros((rows, width), np.int32)
+    val = np.zeros((rows, width), np.float32)
+    mask = np.zeros((rows, width), np.float32)
+    rc = lib.libsvm_parse(path.encode(), rows, width, y, idx, val, mask)
+    if rc != 0:
+        raise ValueError(f"libsvm_parse failed with code {rc} on {path}")
+    return {"y": y, "idx": idx, "val": val, "mask": mask}

@@ -226,7 +226,17 @@ class DenseTable:
     def load_state_dict(self, state: dict) -> None:
         self.params = jax.device_put(
             jnp.asarray(state["params"]), self._sharding)
-        self.opt_state = jax.tree.map(
-            lambda cur, new: jax.device_put(jnp.asarray(new), cur.sharding),
-            self.opt_state, state["opt_state"],
-        )
+        # Graft by leaf order, not structure: a checkpoint roundtrip turns
+        # optax's namedtuple states into plain lists, but leaf order is
+        # deterministic either way.
+        cur_leaves, treedef = jax.tree.flatten(self.opt_state)
+        new_leaves = jax.tree.leaves(state["opt_state"])
+        if len(cur_leaves) != len(new_leaves):
+            raise ValueError(
+                f"opt state leaf count mismatch: table has "
+                f"{len(cur_leaves)}, checkpoint has {len(new_leaves)} "
+                "(different updater?)")
+        self.opt_state = jax.tree.unflatten(treedef, [
+            jax.device_put(jnp.asarray(new), cur.sharding)
+            for cur, new in zip(cur_leaves, new_leaves)
+        ])

@@ -27,6 +27,7 @@ class TrainLoop:
         checkpointer=None,
         checkpoint_every: int = 0,
         warmup_steps: int = 2,
+        step_offset: int = 0,
     ):
         self.step = step
         self.data = data
@@ -35,6 +36,10 @@ class TrainLoop:
         self.batch_size = batch_size
         self.checkpointer = checkpointer
         self.checkpoint_every = checkpoint_every
+        # Global step numbering continues across resumes: without the
+        # offset, a resumed run would re-save low step numbers and a later
+        # restore() would pick an old-numbered-but-newer checkpoint.
+        self.step_offset = step_offset
         self.timer = StepTimer(warmup_steps=warmup_steps)
 
     def run(self, num_iters: int) -> list[float]:
@@ -47,12 +52,13 @@ class TrainLoop:
                  else _leading_dim(batch))
             self.timer.step(n)
             losses.append(float(loss))
+            gstep = self.step_offset + i + 1
             if self.log_every and (i + 1) % self.log_every == 0:
-                self.metrics.log(step=i + 1, loss=float(loss),
+                self.metrics.log(step=gstep, loss=float(loss),
                                  samples_per_sec=self.timer.samples_per_sec)
             if (self.checkpointer is not None and self.checkpoint_every
                     and (i + 1) % self.checkpoint_every == 0):
-                self.checkpointer.save(step=i + 1)
+                self.checkpointer.save(step=gstep)
         return losses
 
 

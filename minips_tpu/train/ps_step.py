@@ -59,6 +59,9 @@ class PSTrainStep:
         missing = set(self.sparse) - set(self.key_fns)
         if missing:
             raise ValueError(f"sparse tables missing key_fns: {missing}")
+        if dense is None and not self.sparse:
+            raise ValueError("PSTrainStep needs a dense table and/or at "
+                             "least one sparse table")
         self._mesh = (dense.mesh if dense is not None
                       else next(iter(self.sparse.values())).mesh)
         self._jit_step = self._build()

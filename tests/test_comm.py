@@ -1,0 +1,88 @@
+"""Control bus + heartbeat over loopback — threads-as-nodes, the same way
+the reference tests its mailbox (SURVEY.md §4)."""
+
+import time
+
+import pytest
+
+from minips_tpu.comm.bus import ClockGossip, ControlBus
+from minips_tpu.comm.heartbeat import HeartbeatMonitor
+
+
+def _mk_buses(n, base_port):
+    addrs = [f"tcp://127.0.0.1:{base_port + i}" for i in range(n)]
+    buses = [ControlBus(addrs[i], [a for j, a in enumerate(addrs) if j != i],
+                        my_id=i) for i in range(n)]
+    for b in buses:
+        b.start()
+    time.sleep(0.2)  # PUB/SUB slow-joiner settle
+    return buses
+
+
+def test_bus_pubsub_roundtrip():
+    buses = _mk_buses(2, 15730)
+    got = []
+    buses[1].on("hello", lambda sender, p: got.append((sender, p["x"])))
+    buses[0].publish("hello", {"x": 42})
+    deadline = time.time() + 5
+    while not got and time.time() < deadline:
+        time.sleep(0.01)
+    for b in buses:
+        b.close()
+    assert got == [(0, 42)]
+
+
+def test_clock_gossip_global_min():
+    buses = _mk_buses(3, 15760)
+    gossips = [ClockGossip(b, 3, workers_per_process=2) for b in buses]
+    gossips[0].publish_local([5, 6])
+    gossips[1].publish_local([3, 9])
+    gossips[2].publish_local([7, 7])
+    deadline = time.time() + 5
+    ok = False
+    while time.time() < deadline:
+        if all(g.global_min() == 3 for g in gossips):
+            ok = True
+            break
+        time.sleep(0.02)
+    for b in buses:
+        b.close()
+    assert ok, [g.snapshot() for g in gossips]
+
+
+def test_heartbeat_detects_dead_peer():
+    buses = _mk_buses(2, 15790)
+    failures = []
+    fake_time = [0.0]
+    mon = HeartbeatMonitor(buses[0], peer_ids=[0, 1], interval=0.05,
+                           timeout=1.0, on_failure=failures.append,
+                           clock=lambda: fake_time[0])
+    # peer 1 beats at t=0.5 -> alive
+    fake_time[0] = 0.5
+    mon._on_beat(1, {})
+    assert mon.check() == set()
+    # silence until t=2.0 -> dead (2.0 - 0.5 > 1.0)
+    fake_time[0] = 2.0
+    assert mon.check() == {1}
+    assert failures == [1]
+    # still dead, but on_failure fires only once
+    fake_time[0] = 3.0
+    mon.check()
+    assert failures == [1]
+    for b in buses:
+        b.close()
+
+
+def test_heartbeat_live_peer_not_flagged():
+    buses = _mk_buses(2, 15820)
+    mons = [HeartbeatMonitor(b, peer_ids=[0, 1], interval=0.05, timeout=2.0)
+            for b in buses]
+    for m in mons:
+        m.start()
+    time.sleep(0.5)  # several beat intervals
+    dead = [m.dead for m in mons]
+    for m in mons:
+        m.stop()
+    for b in buses:
+        b.close()
+    assert dead == [set(), set()]

@@ -95,3 +95,31 @@ def test_prefetch_early_exit_releases_producer():
     import time
     time.sleep(0.5)
     assert threading.active_count() <= n_before + 1
+
+
+def test_native_reader_matches_python(tmp_path):
+    from minips_tpu.data.native import read_libsvm_native
+    d = synthetic.classification_sparse(200, dim=5000, nnz_per_row=7, seed=3)
+    path = str(tmp_path / "n.libsvm")
+    write_libsvm(path, d["y"], d["idx"], d["val"], d["mask"])
+    nat = read_libsvm_native(path)
+    if nat is None:
+        pytest.skip("native lib unavailable (no compiler)")
+    py = read_libsvm(path, use_native=False)
+    np.testing.assert_array_equal(nat["y"], py["y"])
+    np.testing.assert_array_equal(nat["idx"], py["idx"])
+    np.testing.assert_allclose(nat["val"], py["val"], rtol=1e-6)
+    np.testing.assert_array_equal(nat["mask"], py["mask"])
+
+
+def test_native_reader_width_cap(tmp_path):
+    from minips_tpu.data.native import read_libsvm_native
+    with open(tmp_path / "w.libsvm", "w") as f:
+        f.write("1 1:1.0 2:2.0 3:3.0\n-1 5:5.0\n")
+    nat = read_libsvm_native(str(tmp_path / "w.libsvm"), max_features=2)
+    if nat is None:
+        pytest.skip("native lib unavailable")
+    assert nat["idx"].shape == (2, 2)
+    np.testing.assert_array_equal(nat["y"], [1.0, 0.0])  # {-1,1}->{0,1}
+    np.testing.assert_array_equal(nat["idx"][0], [1, 2])  # truncated at 2
+    np.testing.assert_array_equal(nat["mask"][1], [1.0, 0.0])
