@@ -37,6 +37,8 @@ def main() -> int:
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--batch", type=int, default=16384)
     args = ap.parse_args()
+    if args.iters < 1:
+        ap.error("--iters must be >= 1")
 
     if args.cpu:
         import os

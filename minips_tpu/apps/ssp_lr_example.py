@@ -1,0 +1,163 @@
+"""Distributed SSP logistic regression — the multi-process smoke workload.
+
+The reference's distributed smoke story is its launch scripts run against a
+hostfile of localhost entries: N real processes, real zmq over loopback
+(SURVEY.md §4). Same here: run under the launcher
+
+    python -m minips_tpu.launch --n 3 -- python -m minips_tpu.apps.ssp_lr_example \
+        --iters 60 --mode ssp --staleness 2
+
+and each process trains LR on its own data shard via SSPTrainer (delta
+gossip + clock gate over the bus), then prints ONE JSON line of results for
+the driver/test to assert on: loss fell, the staleness bound held, replicas
+agree after finalize.
+
+Fault drill (SURVEY.md §5.3): ``--kill-at K --kill-rank R`` makes rank R
+die abruptly at step K; survivors detect via heartbeat, exit with code 42;
+the driver relaunches everyone with ``--resume`` to restore the latest
+checkpoint and finish — restart-from-checkpoint, the reference's recovery
+semantics (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=60)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--mode", choices=["bsp", "ssp", "asp"], default="ssp")
+    ap.add_argument("--staleness", type=int, default=2)
+    ap.add_argument("--push-every", type=int, default=1)
+    ap.add_argument("--slow-rank", type=int, default=-1,
+                    help="rank to artificially slow (straggler injection)")
+    ap.add_argument("--slow-ms", type=float, default=0.0)
+    ap.add_argument("--checkpoint-dir", default="")
+    ap.add_argument("--checkpoint-every", type=int, default=0)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--kill-at", type=int, default=0,
+                    help="die abruptly at this step (fault injection)")
+    ap.add_argument("--kill-rank", type=int, default=-1)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    # Dev escape hatch (matches apps/common.py): the sandbox TPU plugin
+    # ignores JAX_PLATFORMS, so force via config before any backend touch.
+    if os.environ.get("MINIPS_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from minips_tpu.comm.heartbeat import HeartbeatMonitor
+    from minips_tpu.data import synthetic
+    from minips_tpu.launch import init_from_env
+    from minips_tpu.models import lr as lr_model
+    from minips_tpu.train.ssp_trainer import PeerFailureError, SSPTrainer
+
+    rank, nprocs, bus = init_from_env()
+    staleness = {"bsp": 0, "ssp": args.staleness,
+                 "asp": float("inf")}[args.mode]
+
+    # my shard: different seed per rank = disjoint data (SURVEY.md §2.2 DP)
+    data = synthetic.classification_dense(
+        n=args.batch * 8, dim=args.dim, seed=100 + rank)
+
+    params = lr_model.init(args.dim)
+
+    @jax.jit
+    def local_step(p, batch):
+        loss, g = jax.value_and_grad(lr_model.loss_dense)(p, batch)
+        new = jax.tree.map(lambda w, gw: w - args.lr * gw / nprocs, p, g)
+        return new, loss
+
+    monitor = None
+    if bus is not None:
+        monitor = HeartbeatMonitor(
+            bus, peer_ids=list(range(nprocs)),
+            interval=0.2, timeout=2.0).start()
+
+    trainer = SSPTrainer(local_step, params, bus, nprocs,
+                         staleness=staleness, push_every=args.push_every,
+                         gate_timeout=30.0, monitor=monitor) \
+        if bus is not None else None
+    if bus is not None:
+        # AFTER all handlers (delta/clock/heartbeat) are registered — a
+        # handler-less recv loop drops messages, so handshaking first would
+        # reopen the very lost-traffic window it exists to close.
+        bus.handshake(nprocs)
+
+    ckpt = None
+    start_step = 0
+    if args.checkpoint_dir and trainer is not None:
+        from minips_tpu.ckpt.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(args.checkpoint_dir, {"ssp": trainer}, keep=2)
+        if args.resume:
+            start_step = ckpt.restore()
+
+    losses = []
+    rng = np.random.default_rng(rank)
+    code = 0
+    try:
+        for i in range(start_step, args.iters):
+            if args.kill_at and rank == args.kill_rank and i == args.kill_at:
+                os._exit(137)  # abrupt death: no close(), no flush
+            sel = rng.integers(0, data["y"].shape[0], size=args.batch)
+            batch = {"x": data["x"][sel], "y": data["y"][sel]}
+            if trainer is not None:
+                loss = trainer.step(batch)
+            else:  # single-process degenerate case
+                params, loss = local_step(params, batch)
+                loss = float(loss)
+            losses.append(loss)
+            if rank == args.slow_rank and args.slow_ms > 0:
+                time.sleep(args.slow_ms / 1000.0)
+            if (ckpt is not None and rank == 0 and args.checkpoint_every
+                    and (i + 1) % args.checkpoint_every == 0):
+                ckpt.save(step=i + 1)
+        if trainer is not None:
+            final = trainer.finalize(timeout=20.0)
+    except PeerFailureError as e:
+        print(json.dumps({"rank": rank, "event": "peer_failure",
+                          "dead": sorted(e.dead),
+                          "at_clock": trainer.clock}), flush=True)
+        code = 42
+    except TimeoutError as e:
+        print(json.dumps({"rank": rank, "event": "gate_timeout",
+                          "err": str(e)}), flush=True)
+        code = 43
+
+    if code == 0 and trainer is not None:
+        from jax.flatten_util import ravel_pytree
+
+        flat, _ = ravel_pytree(final)
+        flat = np.asarray(flat)
+        print(json.dumps({
+            "rank": rank, "event": "done",
+            "loss_first": losses[0] if losses else None,
+            "loss_last": float(np.mean(losses[-5:])) if losses else None,
+            "gate_waits": trainer.gate_waits,
+            "max_skew_seen": trainer.max_skew_seen,
+            "deltas_applied": trainer.deltas_applied,
+            "param_sum": float(flat.sum()),
+            "param_norm": float(np.linalg.norm(flat)),
+            "clock": trainer.clock,
+        }), flush=True)
+
+    if monitor is not None:
+        monitor.stop()
+    if bus is not None:
+        bus.close()
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

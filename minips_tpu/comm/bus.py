@@ -59,11 +59,20 @@ class ControlBus:
         time.sleep(0.05)
         return self
 
-    def publish(self, kind: str, payload: dict) -> None:
+    def publish(self, kind: str, payload: dict,
+                blob: Optional[bytes] = None) -> None:
+        """Fan out ``payload`` (small JSON) with an optional binary ``blob``
+        second frame (e.g. a packed ndarray of parameter deltas). Receivers
+        find the blob at ``payload["__blob__"]``. JSON stays the control
+        format (reference BinStream's role, SURVEY.md §2); the blob frame
+        exists so host-relayed pushes need no base64 inflation."""
         msg = json.dumps({"kind": kind, "sender": self.my_id,
                           "payload": payload})
         with self._pub_lock:
-            self._pub.send_string(msg)
+            if blob is None:
+                self._pub.send_string(msg)
+            else:
+                self._pub.send_multipart([msg.encode(), blob])
 
     def _recv_loop(self) -> None:
         poller = zmq.Poller()
@@ -72,12 +81,68 @@ class ControlBus:
             if not dict(poller.poll(timeout=50)):
                 continue
             try:
-                msg = json.loads(self._sub.recv_string(zmq.NOBLOCK))
-            except (zmq.ZMQError, json.JSONDecodeError):
+                frames = self._sub.recv_multipart(zmq.NOBLOCK)
+                msg = json.loads(frames[0])
+            except (zmq.ZMQError, json.JSONDecodeError, IndexError):
                 continue
             handler = self._handlers.get(msg.get("kind"))
             if handler is not None:
-                handler(msg.get("sender", -1), msg.get("payload", {}))
+                payload = msg.get("payload", {})
+                if len(frames) > 1:
+                    payload["__blob__"] = frames[1]
+                handler(msg.get("sender", -1), payload)
+
+    def handshake(self, num_processes: int, timeout: float = 15.0) -> None:
+        """Rendezvous before real traffic: PUB/SUB drops messages published
+        before a subscriber's connect lands (the zmq slow-joiner problem),
+        which for the delta-gossip data path would mean silent replica
+        divergence — so nobody proceeds until everyone provably hears
+        everyone. Each process repeats ``hello``; once it has heard hello
+        from all peers it also repeats ``ready``; it returns once it has
+        heard ready from all peers (with a short grace of extra publishes
+        for stragglers). Reference analog: the mailbox's startup
+        bind/connect barrier (SURVEY.md §3.1)."""
+        import time as _time
+
+        peers = set(range(num_processes)) - {self.my_id}
+        if not peers:
+            return
+        hellos: set[int] = set()
+        readys: set[int] = set()
+        lock = threading.Lock()
+
+        def on_hello(sender: int, payload: dict) -> None:
+            with lock:
+                hellos.add(sender)
+
+        def on_ready(sender: int, payload: dict) -> None:
+            with lock:
+                hellos.add(sender)
+                readys.add(sender)
+
+        self.on("__hello", on_hello)
+        self.on("__ready", on_ready)
+        deadline = _time.monotonic() + timeout
+        while True:
+            self.publish("__hello", {})
+            with lock:
+                all_hello = hellos >= peers
+                all_ready = readys >= peers
+            if all_hello:
+                self.publish("__ready", {})
+            if all_ready:
+                break
+            if _time.monotonic() > deadline:
+                with lock:
+                    missing = peers - readys
+                raise TimeoutError(
+                    f"bus handshake: peers {sorted(missing)} never ready")
+            _time.sleep(0.02)
+        for _ in range(5):  # grace: peers may still await my ready
+            self.publish("__ready", {})
+            _time.sleep(0.02)
+        self._handlers.pop("__hello", None)
+        self._handlers.pop("__ready", None)
 
     def close(self) -> None:
         self._stop.set()
@@ -103,22 +168,57 @@ class ClockGossip:
         self.bus = bus
         self._clocks = {p: [0] * workers_per_process
                         for p in range(num_processes)}
-        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._excluded: set[int] = set()
         bus.on("clock", self._on_clock)
 
     def _on_clock(self, sender: int, payload: dict) -> None:
-        with self._lock:
+        with self._cond:
+            if sender not in self._clocks:
+                return  # stray sender (stale run / port reuse): no ghosts
             self._clocks[sender] = list(payload.get("clocks", []))
+            self._cond.notify_all()
 
     def publish_local(self, clocks: list[int]) -> None:
-        with self._lock:
+        with self._cond:
             self._clocks[self.bus.my_id] = list(clocks)
+            self._cond.notify_all()
         self.bus.publish("clock", {"clocks": list(clocks)})
 
+    def exclude(self, process_id: int) -> None:
+        """Drop a dead peer from min-clock computation (failure handling,
+        SURVEY.md §5.3) so survivors aren't gated on a corpse forever."""
+        with self._cond:
+            self._excluded.add(process_id)
+            self._cond.notify_all()
+
+    def _min_locked(self) -> int:
+        vals = [min(v) for p, v in self._clocks.items()
+                if v and p not in self._excluded]
+        return min(vals) if vals else 0
+
     def global_min(self) -> int:
-        with self._lock:
-            return min(min(v) for v in self._clocks.values() if v)
+        with self._cond:
+            return self._min_locked()
+
+    def wait_global_min(self, threshold: int,
+                        timeout: Optional[float] = None) -> bool:
+        """Block until every live process's min clock >= threshold — the
+        host-side SSP gate's wait primitive (SURVEY.md §7.4.1). Returns
+        False on timeout."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: self._min_locked() >= threshold, timeout)
 
     def snapshot(self) -> dict[int, list[int]]:
-        with self._lock:
+        with self._cond:
             return {k: list(v) for k, v in self._clocks.items()}
+
+    @property
+    def skew(self) -> int:
+        """max clock − min clock over live processes (the SSP observable,
+        SURVEY.md §5.5)."""
+        with self._cond:
+            vals = [c for p, v in self._clocks.items()
+                    if v and p not in self._excluded for c in v]
+            return (max(vals) - min(vals)) if vals else 0

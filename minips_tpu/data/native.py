@@ -69,7 +69,7 @@ def read_libsvm_native(path: str,
     n = ctypes.c_int64()
     w = ctypes.c_int64()
     if lib.libsvm_count(path.encode(), ctypes.byref(n), ctypes.byref(w)):
-        raise ImportError(f"cannot read {path}")
+        return None  # unreadable file: let the Python path surface the OSError
     rows, width = n.value, w.value
     if max_features is not None:
         width = min(width, max_features)

@@ -1,0 +1,167 @@
+"""Process launcher — rebuild of the reference's launch scripts (SURVEY.md
+§1 L7, §2 "Launch scripts").
+
+The reference parses a hostfile and ssh-spawns one process per node with
+``--my_id i``. Here the same shape: ``python -m minips_tpu.launch
+--hostfile hosts.txt -- python worker.py ...`` spawns one worker process
+per hostfile line (locally via subprocess for 127.0.0.1/localhost lines,
+via ssh otherwise) and wires each with environment variables instead of
+flags, so any program can join without argparse ceremony:
+
+- ``MINIPS_PROC_ID`` / ``MINIPS_NUM_PROCS`` — my rank / world size
+  (reference ``--my_id`` + hostfile length).
+- ``MINIPS_BUS_ADDRS`` — comma list of every process's control-bus PUB
+  endpoint (reference: mailbox node list). Process i binds the i-th.
+- ``MINIPS_COORDINATOR`` — proc 0's host:port for
+  ``jax.distributed.initialize`` on real multi-host pods (unused by the
+  loopback smoke tests, whose data plane is the bus).
+
+Failure policy matches a PS job's: first nonzero exit kills the rest
+(all-or-nothing restart semantics, SURVEY.md §7.4.5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+from typing import Optional
+
+_LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
+
+
+def read_hostfile(path: str) -> list[str]:
+    """One host per line; blank lines and #-comments ignored (reference
+    hostfile format)."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if line:
+                hosts.append(line)
+    return hosts
+
+
+def bus_addresses(hosts: list[str], base_port: int) -> list[str]:
+    """PUB endpoint per process. Same-host processes get consecutive ports
+    (colocated deployment, SURVEY.md §1). Local aliases share one port
+    counter (a hostfile mixing 'localhost' and '127.0.0.1' is one machine),
+    and IPv6 literals get zmq's required brackets."""
+    counts: dict[str, int] = {}
+    addrs = []
+    for h in hosts:
+        key = "127.0.0.1" if h in _LOCAL_NAMES else h
+        k = counts.get(key, 0)
+        counts[key] = k + 1
+        ep = f"[{h}]" if ":" in h else h
+        addrs.append(f"tcp://{ep}:{base_port + k}")
+    return addrs
+
+
+def child_env(rank: int, hosts: list[str], base_port: int) -> dict[str, str]:
+    env = dict(os.environ)
+    env["MINIPS_PROC_ID"] = str(rank)
+    env["MINIPS_NUM_PROCS"] = str(len(hosts))
+    env["MINIPS_BUS_ADDRS"] = ",".join(bus_addresses(hosts, base_port))
+    env["MINIPS_COORDINATOR"] = f"{hosts[0]}:{base_port + 1000}"
+    return env
+
+
+def spawn(hosts: list[str], argv: list[str], base_port: int = 5700,
+          stdout=None) -> list[subprocess.Popen]:
+    """Spawn one process per host entry; returns live Popen handles."""
+    procs = []
+    for rank, host in enumerate(hosts):
+        env = child_env(rank, hosts, base_port)
+        if host in _LOCAL_NAMES:
+            cmd = argv
+        else:  # remote: ssh with env inlined (reference ssh-spawn path)
+            import shlex
+            exports = " ".join(
+                f"{k}={shlex.quote(v)}" for k, v in env.items()
+                if k.startswith("MINIPS_"))
+            cmd = ["ssh", host,
+                   exports + " " + " ".join(shlex.quote(a) for a in argv)]
+        procs.append(subprocess.Popen(
+            cmd, env=env, stdout=stdout,
+            stderr=subprocess.STDOUT if stdout is not None else None))
+    return procs
+
+
+def wait(procs: list[subprocess.Popen], timeout: Optional[float] = None,
+         kill_on_failure: bool = True) -> int:
+    """Join all; on first nonzero exit (optionally) terminate the rest and
+    return that code. Returns 0 when everyone exited clean."""
+    import time
+    deadline = None if timeout is None else time.monotonic() + timeout
+    live = list(procs)
+    rc = 0
+    while live:
+        for p in list(live):
+            code = p.poll()
+            if code is None:
+                continue
+            live.remove(p)
+            if code != 0 and rc == 0:
+                rc = code
+                if kill_on_failure:
+                    for q in live:
+                        q.terminate()
+        if deadline is not None and time.monotonic() > deadline:
+            for q in live:
+                q.kill()
+            for q in live:  # reap: SIGKILLed children must not linger as zombies
+                try:
+                    q.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+            return rc or -signal.SIGKILL
+        time.sleep(0.05)
+    return rc
+
+
+def init_from_env():
+    """Worker-side: build my ControlBus from the launcher's env vars.
+    Returns ``(proc_id, num_procs, bus)``; bus is None single-process."""
+    from minips_tpu.comm.bus import ControlBus
+
+    rank = int(os.environ.get("MINIPS_PROC_ID", "0"))
+    n = int(os.environ.get("MINIPS_NUM_PROCS", "1"))
+    addrs = [a for a in os.environ.get("MINIPS_BUS_ADDRS", "").split(",") if a]
+    if n <= 1 or not addrs:
+        return rank, 1, None
+    peers = [a for i, a in enumerate(addrs) if i != rank]
+    # bind on all interfaces at my advertised port; peers connect by name
+    port = addrs[rank].rsplit(":", 1)[1]
+    bus = ControlBus(f"tcp://*:{port}", peers, my_id=rank).start()
+    return rank, n, bus
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="spawn one worker process per hostfile line")
+    ap.add_argument("--hostfile", help="one host per line")
+    ap.add_argument("--n", type=int, default=0,
+                    help="shortcut: n local processes (no hostfile)")
+    ap.add_argument("--base-port", type=int, default=5700)
+    ap.add_argument("--timeout", type=float, default=None)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- program args...")
+    args = ap.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        ap.error("no worker command given (use: -- python worker.py ...)")
+    if args.hostfile:
+        hosts = read_hostfile(args.hostfile)
+    elif args.n > 0:
+        hosts = ["localhost"] * args.n
+    else:
+        ap.error("need --hostfile or --n")
+    procs = spawn(hosts, cmd, base_port=args.base_port)
+    return wait(procs, timeout=args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

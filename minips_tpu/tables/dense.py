@@ -230,7 +230,9 @@ class DenseTable:
         # optax's namedtuple states into plain lists, but leaf order is
         # deterministic either way.
         cur_leaves, treedef = jax.tree.flatten(self.opt_state)
-        new_leaves = jax.tree.leaves(state["opt_state"])
+        # A leafless opt state (sgd: all EmptyState) writes no npz entry at
+        # all, so the key may be legitimately absent from the checkpoint.
+        new_leaves = jax.tree.leaves(state.get("opt_state", ()))
         if len(cur_leaves) != len(new_leaves):
             raise ValueError(
                 f"opt state leaf count mismatch: table has "

@@ -1,0 +1,233 @@
+"""SSPTrainer — multi-process BSP/SSP/ASP over the control bus.
+
+This is the rebuild of the reference's *distributed* consistency mechanics
+(SURVEY.md §7.4.1): one process per node, each driving its own jitted
+shard-local step on its chip, with the parameter-server semantics carried by
+two host-side channels instead of server threads:
+
+- **push** ≡ publish my parameter *delta* (packed float32 blob) to all
+  peers; every process applies every peer's deltas into its local replica —
+  a replicated PS where "server state" is the merged replica, exactly the
+  additive semantics of ``updater->Update`` on a shared KVTable
+  (SURVEY.md §3.3). Additive updates commute, so all replicas converge to
+  the same state once all deltas land (float-addition reorder noise aside).
+- **clock gossip + gate** ≡ ``Clock()`` + the BSP/SSP/ASP admission rule:
+  before starting step ``c+1`` a process waits until
+  ``global_min_clock >= c + 1 - staleness`` (staleness 0 = BSP lockstep,
+  s = SSP bounded staleness, ∞ = ASP never waits) — the same unified rule
+  as minips_tpu/consistency/controllers.py, enforced across *processes*.
+
+zmq PUB/SUB preserves per-publisher frame order, and a process publishes its
+step-``c`` delta *before* its clock-``c`` gossip on the same socket — so
+once the gate observes a peer at clock ``c``, that peer's deltas through
+step ``c`` have already been received and will be merged at the next drain.
+That ordering is what makes staleness the *only* inconsistency: an admitted
+step at clock ``c`` has seen every peer update up to ``c - skew`` with
+skew ≤ staleness, the SSP contract.
+
+Scope: this host-relay path is the honest multi-process story for
+PS-style bounded-staleness across hosts (the reference's distinctive
+capability — its deltas rode ZeroMQ TCP too, SURVEY.md §2.3). Synchronous
+data-parallel throughput on a pod should instead use the fused SPMD path
+(PSTrainStep / DenseTable.make_step), where pushes compile to
+reduce-scatter over ICI; see docs/consistency.md for when each applies.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from minips_tpu.comm.bus import ClockGossip, ControlBus
+
+PyTree = Any
+
+
+class PeerFailureError(RuntimeError):
+    """Raised when the staleness gate times out and heartbeats show dead
+    peers — the caller's cue to run recovery (SURVEY.md §5.3)."""
+
+    def __init__(self, dead: set[int]):
+        super().__init__(f"peer process(es) {sorted(dead)} failed")
+        self.dead = dead
+
+
+class SSPTrainer:
+    """Drives ``step_fn`` locally; exchanges deltas + clocks with peers.
+
+    Parameters
+    ----------
+    step_fn: jitted ``(params, batch) -> (new_params, loss)``.
+    params: initial parameter pytree (identical on every process).
+    bus / num_processes: the loopback/TCP control bus and peer count.
+    staleness: 0 = BSP, s = SSP, ``float('inf')`` = ASP.
+    push_every: publish accumulated local deltas every k steps (k=1 matches
+        the reference's per-iteration Push; larger k trades freshness for
+        bandwidth, the SparCML-style batching knob).
+    monitor: optional HeartbeatMonitor; on gate timeout its dead set turns a
+        hang into a PeerFailureError and excludes corpses from the gate.
+    """
+
+    def __init__(
+        self,
+        step_fn: Callable[[PyTree, Any], tuple[PyTree, Any]],
+        params: PyTree,
+        bus: ControlBus,
+        num_processes: int,
+        *,
+        staleness: float = 0,
+        push_every: int = 1,
+        gate_timeout: float = 60.0,
+        monitor=None,
+    ):
+        if staleness < 0:
+            raise ValueError("staleness must be >= 0")
+        self.step_fn = step_fn
+        self.bus = bus
+        self.num_processes = num_processes
+        self.staleness = staleness
+        self.push_every = max(int(push_every), 1)
+        self.gate_timeout = gate_timeout
+        self.monitor = monitor
+
+        flat, self._unravel = ravel_pytree(params)
+        self._params = params
+        self._nparam = flat.shape[0]
+        self._dtype = flat.dtype
+        self._pending_push = np.zeros(self._nparam, np.float32)
+        self._inbox: deque[np.ndarray] = deque()
+        self._inbox_lock = threading.Lock()
+        self.clock = 0
+        self.gate_waits = 0      # times the SSP gate actually blocked
+        self.max_skew_seen = 0   # max (my_clock - global_min) observed
+        self.deltas_applied = 0
+
+        self.gossip = ClockGossip(bus, num_processes, workers_per_process=1)
+        bus.on("delta", self._on_delta)
+
+    # ------------------------------------------------------------- messaging
+    def _on_delta(self, sender: int, payload: dict) -> None:
+        if sender == self.bus.my_id:
+            return  # own PUB loops back only if self-subscribed; be safe
+        blob = payload.get("__blob__")
+        if blob is None:
+            return
+        vec = np.frombuffer(blob, np.float32)
+        if vec.shape[0] != self._nparam:
+            return  # shape mismatch: stale peer from an old run; drop
+        with self._inbox_lock:
+            self._inbox.append(vec)
+
+    def _drain_inbox(self) -> None:
+        with self._inbox_lock:
+            pending = list(self._inbox)
+            self._inbox.clear()
+        if not pending:
+            return
+        total = np.sum(pending, axis=0) if len(pending) > 1 else pending[0]
+        flat, _ = ravel_pytree(self._params)
+        self._params = self._unravel(
+            flat + jax.numpy.asarray(total, dtype=self._dtype))
+        self.deltas_applied += len(pending)
+
+    def _push(self, force: bool = False) -> None:
+        if not force and self.clock % self.push_every != 0:
+            return
+        if not np.any(self._pending_push):
+            return
+        self.bus.publish("delta", {"clock": self.clock},
+                         blob=self._pending_push.astype(np.float32).tobytes())
+        self._pending_push = np.zeros(self._nparam, np.float32)
+
+    # ------------------------------------------------------------------ gate
+    def _gate(self) -> None:
+        """Block until global_min >= my_clock - staleness (SSP rule)."""
+        if self.staleness == float("inf"):
+            return
+        threshold = self.clock - int(self.staleness)
+        if threshold <= 0:
+            return
+        gmin = self.gossip.global_min()
+        self.max_skew_seen = max(self.max_skew_seen, self.clock - gmin)
+        if gmin >= threshold:
+            return
+        self.gate_waits += 1
+        deadline = time.monotonic() + self.gate_timeout
+        while not self.gossip.wait_global_min(
+                threshold, timeout=min(1.0, self.gate_timeout)):
+            dead = self.monitor.check() if self.monitor is not None else set()
+            if dead:
+                for p in dead:
+                    self.gossip.exclude(p)
+                raise PeerFailureError(dead)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"SSP gate timed out at clock {self.clock} "
+                    f"(global_min={self.gossip.global_min()}, "
+                    f"staleness={self.staleness})")
+
+    # ------------------------------------------------------------------ step
+    def step(self, batch) -> float:
+        """One local step: merge peer pushes, compute, push, clock, gate."""
+        self._drain_inbox()
+        before, _ = ravel_pytree(self._params)
+        new_params, loss = self.step_fn(self._params, batch)
+        after, _ = ravel_pytree(new_params)
+        self._pending_push += np.asarray(after - before, np.float32)
+        self._params = new_params
+        self.clock += 1
+        self._push()
+        self.gossip.publish_local([self.clock])
+        self._gate()
+        return float(loss)
+
+    # -------------------------------------------------------------- lifecycle
+    def finalize(self, timeout: float = 30.0) -> PyTree:
+        """Flush my remaining delta, wait for all live peers to reach my
+        clock, merge their tail — after this every live replica holds the
+        same merged parameters (up to float reorder noise)."""
+        self._push(force=True)
+        self.gossip.publish_local([self.clock])
+        if not self.gossip.wait_global_min(self.clock, timeout):
+            dead = self.monitor.check() if self.monitor is not None else set()
+            if dead:
+                for p in dead:
+                    self.gossip.exclude(p)
+            else:
+                raise TimeoutError("finalize: peers never caught up")
+        # Peer clock == final implies its deltas are already queued locally
+        # (PUB frame ordering), but delivery runs on the bus thread — give
+        # the handler a beat, then merge.
+        time.sleep(0.1)
+        self._drain_inbox()
+        return self._params
+
+    @property
+    def params(self) -> PyTree:
+        return self._params
+
+    @property
+    def skew(self) -> int:
+        return self.gossip.skew
+
+    # ------------------------------------------------------------ checkpoint
+    # state_dict/load_state_dict make the trainer a "table" to
+    # ckpt.Checkpointer — PS state = params + clock (SURVEY.md §5.4).
+    def state_dict(self) -> dict:
+        flat, _ = ravel_pytree(self._params)
+        return {"flat": np.asarray(flat), "clock": np.asarray(self.clock)}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._params = self._unravel(
+            jax.numpy.asarray(state["flat"], dtype=self._dtype))
+        self.clock = int(state["clock"])
+        self._pending_push = np.zeros(self._nparam, np.float32)
+        with self._inbox_lock:
+            self._inbox.clear()
+        self.gossip.publish_local([self.clock])

@@ -101,3 +101,15 @@ def test_partial_tmp_dir_ignored(mesh8, tmp_path):
     os.makedirs(str(tmp_path / "step_0000000002.tmp"))
     assert ck.list_steps() == [1]
     assert ck.restore() == 1
+
+
+def test_sgd_roundtrip_leafless_opt_state(mesh8, tmp_path):
+    """sgd's opt state has zero leaves (EmptyStates), so no 'opt_state' key
+    lands in the npz at all — restore must tolerate the absent key."""
+    d1 = DenseTable({"w": jnp.zeros(8)}, mesh8, updater="sgd", lr=0.1)
+    d1.push({"w": jnp.ones(8)})
+    Checkpointer(str(tmp_path), {"d": d1}).save(step=1)
+    d2 = DenseTable({"w": jnp.zeros(8)}, mesh8, updater="sgd", lr=0.1)
+    assert Checkpointer(str(tmp_path), {"d": d2}).restore() == 1
+    np.testing.assert_allclose(np.asarray(d2.params), np.asarray(d1.params),
+                               rtol=1e-6)
