@@ -64,6 +64,7 @@ class SparseTable:
         salt: int = 0,
         seed: int = 0,
         dtype=jnp.float32,
+        use_pallas: Optional[bool] = None,
     ):
         if updater not in ("sgd", "adagrad"):
             raise ValueError("sparse updater must be 'sgd' or 'adagrad'")
@@ -75,6 +76,18 @@ class SparseTable:
         self.lr = lr
         self.adagrad_init = adagrad_init
         self.salt = salt
+
+        # Pallas gather opt-in, resolved ONCE here (the jitted pull is
+        # trace-cached, so a late env toggle would be silently ignored).
+        # Single-device meshes only: pallas_call has no GSPMD partitioning
+        # rule, so on a sharded table it would force a full replication
+        # all-gather of emb instead of the sharded XLA gather.
+        from minips_tpu.ops import pallas_kernels as _pk
+
+        n_dev = len(np.asarray(mesh.devices).reshape(-1))
+        self.use_pallas = bool(
+            (use_pallas if use_pallas is not None else _pk.pallas_enabled())
+            and n_dev == 1)
 
         self._sharding = NamedSharding(mesh, P(DATA_AXIS, None))
         key = jax.random.PRNGKey(seed)
@@ -101,9 +114,18 @@ class SparseTable:
 
     @functools.cached_property
     def _jit_pull(self):
+        from minips_tpu.ops import pallas_kernels
+
         @jax.jit
         def pull(emb, keys):
-            return emb[hash_to_slots(keys, self.num_slots, self.salt)]
+            slots = hash_to_slots(keys, self.num_slots, self.salt)
+            if (self.use_pallas
+                    and pallas_kernels.gather_supported(self.dim, slots.size)):
+                # opt-in hand-scheduled DMA gather; XLA native is the
+                # measured default (ops/pallas_kernels.py docstring)
+                rows = pallas_kernels.gather_rows(emb, slots.reshape(-1))
+                return rows.reshape(*slots.shape, self.dim)
+            return emb[slots]
         return pull
 
     # ------------------------------------------------------------------ push

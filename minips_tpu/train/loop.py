@@ -28,6 +28,8 @@ class TrainLoop:
         checkpoint_every: int = 0,
         warmup_steps: int = 2,
         step_offset: int = 0,
+        profile_dir: Optional[str] = None,
+        profile_range: tuple[int, int] = (10, 13),
     ):
         self.step = step
         self.data = data
@@ -41,11 +43,25 @@ class TrainLoop:
         # restore() would pick an old-numbered-but-newer checkpoint.
         self.step_offset = step_offset
         self.timer = StepTimer(warmup_steps=warmup_steps)
+        self.profiler = None
+        if profile_dir:
+            from minips_tpu.utils.profiling import StepWindowProfiler
+
+            self.profiler = StepWindowProfiler(profile_dir, *profile_range)
 
     def run(self, num_iters: int) -> list[float]:
+        try:
+            return self._run(num_iters)
+        finally:
+            if self.profiler is not None:
+                self.profiler.close()  # an open trace must flush even on error
+
+    def _run(self, num_iters: int) -> list[float]:
         losses: list[float] = []
         it = iter(self.data)
         for i in range(num_iters):
+            if self.profiler is not None:
+                self.profiler.on_step(i)
             batch = next(it)
             loss = self.step(batch)
             n = (self.batch_size if self.batch_size is not None

@@ -1,0 +1,129 @@
+"""Property-based tests of the consistency layer (SURVEY.md §4: the
+consistency models are the reference's most heavily tested surface —
+scripted Add/Get/Clock sequences; hypothesis generates the scripts).
+
+Invariants under ANY interleaving of clock/admit calls:
+
+1. Admission rule: ``admit(w)`` ⟺ ``min_clock >= clock_of(w) - staleness``
+   (BSP: s=0; SSP: s; ASP: ∞ ⇒ always true).
+2. Clock vector: advancing w increments only w; min/max/skew consistent.
+3. ``advance`` returns the new min iff the min changed.
+4. PendingBuffer: pop_ready returns exactly the items whose admission
+   clock <= min, FIFO within a clock, ascending clocks; never loses items.
+5. Wake-up soundness (threaded path): a parked pull is admitted as soon as
+   the min reaches its threshold — checked via the controller state
+   machine rather than real threads (the distributed smoke tests cover the
+   threaded/process reality).
+"""
+
+from __future__ import annotations
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from minips_tpu.consistency.controllers import ASP, BSP, SSP, make_controller
+from minips_tpu.consistency.tracker import PendingBuffer, ProgressTracker
+
+# a script is a list of (worker, op) with op in {"clock", "admit"}
+scripts = st.lists(
+    st.tuples(st.integers(0, 3),
+              st.sampled_from(["clock", "admit"])),
+    min_size=1, max_size=200)
+
+
+@given(script=scripts, staleness=st.integers(0, 5))
+@settings(max_examples=200, deadline=None)
+def test_admission_rule_is_exactly_bounded_staleness(script, staleness):
+    c = SSP(4, staleness=staleness)
+    for worker, op in script:
+        if op == "clock":
+            c.clock(worker)
+        else:
+            expected = (c.tracker.min_clock
+                        >= c.tracker.clock_of(worker) - staleness)
+            assert c.admit(worker) == expected
+
+
+@given(script=scripts)
+@settings(max_examples=100, deadline=None)
+def test_bsp_admits_only_at_min(script):
+    c = BSP(4)
+    for worker, op in script:
+        if op == "clock":
+            c.clock(worker)
+        else:
+            assert c.admit(worker) == (
+                c.tracker.clock_of(worker) == c.tracker.min_clock)
+
+
+@given(script=scripts)
+@settings(max_examples=100, deadline=None)
+def test_asp_always_admits(script):
+    c = ASP(4)
+    for worker, op in script:
+        if op == "clock":
+            c.clock(worker)
+        else:
+            assert c.admit(worker)
+
+
+@given(advances=st.lists(st.integers(0, 3), min_size=1, max_size=300))
+@settings(max_examples=200, deadline=None)
+def test_tracker_vector_semantics(advances):
+    t = ProgressTracker(4)
+    shadow = [0, 0, 0, 0]
+    for w in advances:
+        old_min = min(shadow)
+        changed = t.advance(w)
+        shadow[w] += 1
+        assert t.snapshot() == shadow
+        new_min = min(shadow)
+        assert changed == (new_min if new_min != old_min else None)
+        assert t.min_clock == new_min
+        assert t.max_clock == max(shadow)
+        assert t.skew == max(shadow) - new_min
+
+
+@given(
+    parked=st.lists(st.tuples(st.integers(0, 10), st.integers(0, 999)),
+                    max_size=50),
+    pops=st.lists(st.integers(0, 12), max_size=10),
+)
+@settings(max_examples=200, deadline=None)
+def test_pending_buffer_conservation_and_order(parked, pops):
+    buf = PendingBuffer()
+    shadow: list[tuple[int, int]] = []  # (clock, item), insertion order
+    for clock, item in parked:
+        buf.park(clock, item)
+        shadow.append((clock, item))
+    popped_total = []
+    done = set()
+    for min_clock in sorted(pops):
+        got = buf.pop_ready(min_clock)
+        # expected: all not-yet-popped items with clock <= min_clock,
+        # ascending clock, FIFO within a clock
+        expect = []
+        for c in sorted({c for i, (c, _) in enumerate(shadow)
+                         if c <= min_clock and i not in done}):
+            for i, (ci, item) in enumerate(shadow):
+                if ci == c and i not in done:
+                    expect.append(item)
+                    done.add(i)
+        assert got == expect
+        popped_total.extend(got)
+    assert buf.num_parked == len(shadow) - len(done)
+
+
+@given(script=scripts, staleness=st.integers(0, 3))
+@settings(max_examples=100, deadline=None)
+def test_skew_of_gated_execution_never_exceeds_staleness_plus_one(
+        script, staleness):
+    """Simulate workers that respect the gate: a worker only clocks when
+    admitted (else it 'blocks' = skips its turn). The resulting clock skew
+    can never exceed staleness + 1 — the system-level SSP guarantee the
+    multi-process trainer also asserts (tests/test_distributed_smoke.py)."""
+    c = SSP(4, staleness=staleness)
+    for worker, _ in script:
+        if c.admit(worker):
+            c.clock(worker)
+        assert c.skew <= staleness + 1

@@ -1,0 +1,101 @@
+"""Fault injection + recovery — the reference's failure-handling milestone.
+
+The reference lineage: heartbeats through the mailbox, a master that
+detects a dead node, and restart-from-checkpoint (SURVEY.md §2 "Heartbeat /
+failure detection", §3.5, §5.3). The drill here is the real thing, not a
+mock: N processes over loopback, one killed abruptly (``os._exit`` — no
+close, no flush) mid-run; survivors' SSP gate stalls on the corpse's clock,
+the HeartbeatMonitor times it out, the gate turns the stall into a
+PeerFailureError (exit 42, the "I detected a failure" code); the driver
+then relaunches the full job with ``--resume`` and everyone restores the
+latest checkpoint and finishes — all-or-nothing restart at fixed size,
+exactly the reference's recovery semantics (SURVEY.md §7.4.5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+from minips_tpu import launch
+
+APP = "minips_tpu.apps.ssp_lr_example"
+_PORT = [6100]
+
+
+def _run(n: int, extra: list[str], timeout: float = 240.0,
+         kill_on_failure: bool = False):
+    """Launch n workers; return (rc, per-rank JSON events)."""
+    _PORT[0] += n + 3
+    hosts = ["localhost"] * n
+    outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
+    procs = []
+    for rank, host in enumerate(hosts):
+        env = launch.child_env(rank, hosts, _PORT[0])
+        env.update({"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", APP] + extra,
+            env=env, stdout=outs[rank], stderr=subprocess.STDOUT))
+    # kill_on_failure=False: survivors must detect the death THEMSELVES via
+    # heartbeat — the launcher must not mercy-kill them first.
+    rc = launch.wait(procs, timeout=timeout, kill_on_failure=kill_on_failure)
+    events = []
+    for f in outs:
+        f.flush(); f.seek(0)
+        text = f.read()
+        f.close(); os.unlink(f.name)
+        events.append([json.loads(l) for l in text.splitlines()
+                       if l.strip().startswith("{")])
+    return rc, events
+
+
+@pytest.mark.slow
+def test_kill_detect_resume(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    base = ["--iters", "40", "--mode", "ssp", "--staleness", "2",
+            "--checkpoint-dir", ckpt, "--checkpoint-every", "10"]
+
+    # --- phase 1: rank 2 dies abruptly at step 15 -----------------------
+    rc, events = _run(3, base + ["--kill-at", "15", "--kill-rank", "2"])
+    assert rc != 0  # the job failed, as it must
+    survivors = [ev[-1] for r, ev in enumerate(events) if r != 2 and ev]
+    assert len(survivors) == 2, events
+    for ev in survivors:
+        assert ev["event"] == "peer_failure", events
+        assert 2 in ev["dead"]
+    # a checkpoint exists from before the crash
+    steps = [d for d in os.listdir(ckpt) if d.startswith("step_")]
+    assert steps, "no checkpoint written before the kill"
+
+    # --- phase 2: relaunch everyone with --resume ------------------------
+    rc, events = _run(3, base + ["--resume"])
+    assert rc == 0, events
+    dones = [ev[-1] for ev in events]
+    for d in dones:
+        assert d["event"] == "done", events
+        assert d["clock"] == 40  # resumed at 10, finished the run
+        assert d["max_skew_seen"] <= 3
+    sums = [d["param_sum"] for d in dones]
+    norms = [d["param_norm"] for d in dones]
+    assert max(sums) - min(sums) < 1e-4
+    assert max(norms) - min(norms) < 1e-4
+
+
+@pytest.mark.slow
+def test_clean_job_leaves_no_failure_events(tmp_path):
+    """Control: same config, no kill — everyone reports done, nobody
+    reports peer_failure, and checkpoints accumulate."""
+    ckpt = str(tmp_path / "ckpt")
+    rc, events = _run(3, ["--iters", "20", "--mode", "bsp",
+                          "--checkpoint-dir", ckpt,
+                          "--checkpoint-every", "10"])
+    assert rc == 0, events
+    for ev in events:
+        assert ev[-1]["event"] == "done"
+        assert all(e["event"] != "peer_failure" for e in ev)
+    assert len([d for d in os.listdir(ckpt) if d.startswith("step_")]) == 2

@@ -1,0 +1,52 @@
+"""Pallas gather kernel — interpret-mode correctness (SURVEY.md §4: the
+TPU-free test story; compiled-mode numbers live in ops/pallas_kernels.py's
+docstring, measured on the real chip)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from minips_tpu.ops import pallas_kernels as pk
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def test_gather_matches_xla(rng):
+    S, D, N = 512, 128, 64
+    emb = jnp.asarray(rng.normal(size=(S, D)), jnp.float32)
+    slots = jnp.asarray(rng.integers(0, S, N), jnp.int32)
+    out = pk.gather_rows(emb, slots, interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(emb)[np.asarray(slots)], rtol=1e-6)
+
+
+def test_gather_repeated_and_boundary_rows(rng):
+    S, D = 256, 128
+    emb = jnp.asarray(rng.normal(size=(S, D)), jnp.float32)
+    slots = jnp.asarray([0, 0, S - 1, S - 1, 3, 3, 0, S - 1], jnp.int32)
+    out = pk.gather_rows(emb, slots, interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(emb)[np.asarray(slots)], rtol=1e-6)
+
+
+def test_unsupported_shapes_fall_back(rng):
+    # D=8 (not lane-aligned) and N=7 (not chunk-aligned) take the XLA path
+    emb = jnp.asarray(rng.normal(size=(64, 8)), jnp.float32)
+    slots = jnp.asarray(rng.integers(0, 64, 7), jnp.int32)
+    assert not pk.gather_supported(8, 56)    # lane-misaligned dim
+    assert not pk.gather_supported(128, 7)   # chunk-misaligned n
+    out = pk.gather_rows(emb, slots)  # must not raise
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(emb)[np.asarray(slots)], rtol=1e-6)
+
+
+def test_opt_in_is_off_by_default_and_off_tpu(monkeypatch):
+    assert not pk.pallas_enabled()  # default: no env flag
+    monkeypatch.setenv("MINIPS_PALLAS", "1")
+    # CPU test session: still disabled (TPU-only switch)
+    assert not pk.pallas_enabled()
