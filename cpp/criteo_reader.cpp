@@ -1,0 +1,174 @@
+// Native Criteo TSV parser — the reference family's flagship sparse CTR
+// format (SURVEY.md §2 "Data loading"; BASELINE.json:10 names Wide&Deep /
+// DeepFM on Criteo-1TB). Line format (display-advertising release):
+//
+//   label \t I1..I13 (ints, may be empty/negative) \t C1..C26 (8-hex cats,
+//   may be empty) \n
+//
+// Exposed as a plain C ABI consumed via ctypes (same contract style as
+// libsvm_reader.cpp):
+//   pass 1: criteo_count(path, &n_rows)
+//   pass 2: criteo_parse(path, n_rows, y[N], dense[N*13], dense_mask[N*13],
+//           cat[N*26]) — missing ints get value 0 / mask 0; categorical hex
+//           values parse to uint32 and are offset by (field << 32) so every
+//           column keeps a distinct int64 id space (missing → field-offset
+//           0), matching the per-column-vocabulary convention the Python
+//           synthetic generator uses (minips_tpu/data/synthetic.py
+//           criteo_like).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+constexpr int kDense = 13;
+constexpr int kCat = 26;
+
+struct FileBuf {
+  char* data = nullptr;
+  size_t size = 0;
+  bool ok = false;
+  explicit FileBuf(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return;
+    std::fseek(f, 0, SEEK_END);
+    long n = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    if (n < 0) { std::fclose(f); return; }
+    data = static_cast<char*>(std::malloc(static_cast<size_t>(n) + 1));
+    if (!data) { std::fclose(f); return; }
+    size = std::fread(data, 1, static_cast<size_t>(n), f);
+    data[size] = '\0';
+    std::fclose(f);
+    ok = true;
+  }
+  ~FileBuf() { std::free(data); }
+};
+
+// Parse a decimal int field ending at tab/newline; empty → missing.
+// On failure p is left UNMOVED so the caller's garbage check (*p != '\t')
+// catches a lone '-' instead of recording it as missing.
+inline bool parse_int_field(const char*& p, const char* line_end, long* out) {
+  const char* q = p;
+  if (q >= line_end || *q == '\t') return false;
+  bool neg = false;
+  if (*q == '-') { neg = true; ++q; }
+  long v = 0;
+  bool any = false;
+  while (q < line_end && *q >= '0' && *q <= '9') {
+    v = v * 10 + (*q - '0');
+    any = true;
+    ++q;
+  }
+  if (!any) return false;
+  p = q;
+  *out = neg ? -v : v;
+  return true;
+}
+
+// Parse a hex categorical field ending at tab/newline; empty → missing.
+// ndigits lets the caller reject >8-digit tokens (they would wrap uint32
+// here while the Python oracle keeps all bits — reject in both instead).
+inline bool parse_hex_field(const char*& p, const char* line_end,
+                            uint32_t* out, int* ndigits) {
+  uint32_t v = 0;
+  int digits = 0;
+  while (p < line_end) {
+    char c = *p;
+    uint32_t d;
+    if (c >= '0' && c <= '9') d = static_cast<uint32_t>(c - '0');
+    else if (c >= 'a' && c <= 'f') d = static_cast<uint32_t>(c - 'a' + 10);
+    else if (c >= 'A' && c <= 'F') d = static_cast<uint32_t>(c - 'A' + 10);
+    else break;
+    v = (v << 4) | d;
+    ++digits;
+    ++p;
+  }
+  *ndigits = digits;
+  if (digits == 0) return false;
+  *out = v;
+  return true;
+}
+
+// Advance past the field separator (one tab) if present.
+inline void skip_tab(const char*& p, const char* line_end) {
+  if (p < line_end && *p == '\t') ++p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success; fills n_rows (non-empty lines).
+int criteo_count(const char* path, int64_t* n_rows) {
+  FileBuf fb(path);
+  if (!fb.ok) return 1;
+  int64_t rows = 0;
+  const char* p = fb.data;
+  const char* endp = fb.data + fb.size;
+  while (p < endp) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(endp - p)));
+    if (!line_end) line_end = endp;
+    if (line_end > p && !(line_end == p + 1 && *p == '\r')) ++rows;
+    p = line_end + 1;
+  }
+  *n_rows = rows;
+  return 0;
+}
+
+// Fills y[N], dense[N*13], dense_mask[N*13], cat[N*26].
+// Returns 0 ok, 1 unreadable, 2 row-count mismatch, 3 malformed field —
+// strict like the pure-Python oracle (which raises on garbage tokens), so
+// the native fast path never silently trains on corrupted rows.
+int criteo_parse(const char* path, int64_t n_rows, float* y, float* dense,
+                 float* dense_mask, int64_t* cat) {
+  FileBuf fb(path);
+  if (!fb.ok) return 1;
+  std::memset(dense, 0, sizeof(float) * static_cast<size_t>(n_rows * kDense));
+  std::memset(dense_mask, 0,
+              sizeof(float) * static_cast<size_t>(n_rows * kDense));
+  const char* p = fb.data;
+  const char* endp = fb.data + fb.size;
+  int64_t r = 0;
+  while (p < endp && r < n_rows) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(endp - p)));
+    if (!line_end) line_end = endp;
+    const char* eol = line_end;
+    if (eol > p && eol[-1] == '\r') --eol;  // tolerate CRLF
+    if (p < eol) {
+      long label = 0;
+      parse_int_field(p, eol, &label);
+      if (p < eol && *p != '\t') return 3;  // e.g. "3.5" label
+      y[r] = static_cast<float>(label);
+      skip_tab(p, eol);
+      for (int f = 0; f < kDense; ++f) {
+        long v;
+        if (parse_int_field(p, eol, &v)) {
+          dense[r * kDense + f] = static_cast<float>(v);
+          dense_mask[r * kDense + f] = 1.0f;
+        }
+        if (p < eol && *p != '\t') return 3;  // unconsumed garbage in field
+        skip_tab(p, eol);
+      }
+      for (int f = 0; f < kCat; ++f) {
+        uint32_t v = 0;
+        int ndigits = 0;
+        parse_hex_field(p, eol, &v, &ndigits);  // missing → 0 in the space
+        if (ndigits > 8) return 3;            // would wrap uint32 silently
+        if (p < eol && *p != '\t') return 3;  // non-hex byte in field
+        cat[r * kCat + f] =
+            (static_cast<int64_t>(f) << 32) | static_cast<int64_t>(v);
+        skip_tab(p, eol);
+      }
+      ++r;
+    }
+    p = line_end + 1;
+  }
+  return r == n_rows ? 0 : 2;
+}
+
+}  // extern "C"

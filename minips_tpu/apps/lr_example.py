@@ -39,11 +39,21 @@ DEFAULT = Config(
 
 def run(cfg: Config, args, metrics) -> dict:
     dim = getattr(args, "dim", 123)
+    path = getattr(args, "data_file", None)
     if getattr(args, "data", "dense") == "dense":
-        data = synthetic.classification_dense(8192, dim,
-                                              seed=cfg.train.seed)
+        if path:  # real a9a-style libsvm file, dense-ified (SURVEY.md §7.3)
+            from minips_tpu.data.libsvm import (densify, read_libsvm,
+                                                shift_one_based)
+            data = densify(shift_one_based(read_libsvm(path)), dim)
+        else:
+            data = synthetic.classification_dense(8192, dim,
+                                                  seed=cfg.train.seed)
         return _run_dense(cfg, args, metrics, data, dim)
-    data = synthetic.classification_sparse(8192, seed=cfg.train.seed)
+    if path:  # real RCV1-style libsvm file, hashed sparse weights
+        from minips_tpu.data.libsvm import read_libsvm
+        data = read_libsvm(path)
+    else:
+        data = synthetic.classification_sparse(8192, seed=cfg.train.seed)
     return _run_sparse(cfg, args, metrics, data)
 
 
@@ -127,6 +137,8 @@ def _flags(parser):
     parser.add_argument("--data", default="dense",
                         choices=["dense", "sparse"])
     parser.add_argument("--dim", type=int, default=123)
+    parser.add_argument("--data_file", default=None,
+                        help="libsvm file (a9a/RCV1) instead of synthetic")
 
 
 def main():

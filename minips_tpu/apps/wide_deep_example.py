@@ -62,7 +62,14 @@ def build(cfg: Config, *, use_fm: bool, mesh=None, seed: int = 0):
 
 def run(cfg: Config, args, metrics) -> dict:
     use_fm = getattr(args, "model", "widedeep") == "deepfm"
-    data = synthetic.criteo_like(16384, seed=cfg.train.seed)
+    path = getattr(args, "data_file", None)
+    if path:  # real Criteo TSV through the native/python reader
+        from minips_tpu.data.criteo import log_transform, read_criteo
+        raw = read_criteo(path)
+        data = {"dense": log_transform(raw["dense"], raw["dense_mask"]),
+                "cat": raw["cat"], "y": raw["y"]}
+    else:
+        data = synthetic.criteo_like(16384, seed=cfg.train.seed)
     ps, tables = build(cfg, use_fm=use_fm, seed=cfg.train.seed)
     batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
     loop = TrainLoop(lambda b: ps(ps.shard_batch(b)), batches,
@@ -78,6 +85,8 @@ def run(cfg: Config, args, metrics) -> dict:
 def _flags(parser):
     parser.add_argument("--model", default="widedeep",
                         choices=["widedeep", "deepfm"])
+    parser.add_argument("--data_file", default=None,
+                        help="Criteo TSV file instead of synthetic data")
 
 
 def main():

@@ -1,0 +1,117 @@
+"""Criteo display-advertising TSV reader/writer.
+
+The reference family's flagship sparse workload is Wide&Deep / DeepFM on
+Criteo-1TB (SURVEY.md §2 "Data loading"; BASELINE.json:10). Line format:
+
+    label \\t I1..I13 (decimal ints, may be empty or negative)
+          \\t C1..C26 (8-hex-digit categorical hashes, may be empty)
+
+``read_criteo`` returns the same batch schema the apps and the synthetic
+generator use (minips_tpu/data/synthetic.py ``criteo_like``):
+
+- ``y``          [N]      float32 click labels
+- ``dense``      [N, 13]  float32 numeric features (missing → 0)
+- ``dense_mask`` [N, 13]  float32 presence mask
+- ``cat``        [N, 26]  int64 categorical ids, offset ``field << 32`` so
+  every column keeps a distinct id space (per-column vocabularies); missing
+  values map to the field-offset 0 token. Downstream, SparseTable hashes
+  these unbounded ids onto slots (tables/sparse.py ``hash_to_slots``).
+
+A native C++ parser (cpp/criteo_reader.cpp, SURVEY.md §2.1 item 6) is used
+transparently when buildable; the pure-Python path is the fallback and the
+correctness oracle for it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+NUM_DENSE = 13
+NUM_CAT = 26
+
+
+def write_criteo(path: str, y: np.ndarray, dense: np.ndarray,
+                 cat: np.ndarray, dense_mask: np.ndarray | None = None) -> None:
+    """Write rows in Criteo TSV form (used by tests/synthetic dumps). ``cat``
+    entries are written as 8-hex of their low 32 bits; a masked-out dense
+    cell (or NaN) is written as an empty field."""
+    y = np.asarray(y)
+    dense = np.asarray(dense)
+    cat = np.asarray(cat)
+    with open(path, "w") as f:
+        for r in range(len(y)):
+            fields = [str(int(y[r]))]
+            for j in range(dense.shape[1]):
+                v = dense[r, j]
+                present = not np.isnan(v) if dense_mask is None \
+                    else bool(dense_mask[r, j])
+                fields.append(str(int(v)) if present else "")
+            for j in range(cat.shape[1]):
+                fields.append(format(int(cat[r, j]) & 0xFFFFFFFF, "08x"))
+            f.write("\t".join(fields) + "\n")
+
+
+def _read_python(path: str) -> dict:
+    ys, denses, masks, cats = [], [], [], []
+    field_offset = np.arange(NUM_CAT, dtype=np.int64) << 32
+    with open(path) as f:
+        for line in f:
+            line = line.rstrip("\r\n")
+            if not line:
+                continue
+            parts = line.split("\t")
+            # pad short lines so slicing below is uniform
+            parts += [""] * (1 + NUM_DENSE + NUM_CAT - len(parts))
+            # strict int label (same contract as the native parser's rc=3)
+            ys.append(float(int(parts[0])) if parts[0] else 0.0)
+            d = np.zeros(NUM_DENSE, np.float32)
+            m = np.zeros(NUM_DENSE, np.float32)
+            for j, tok in enumerate(parts[1:1 + NUM_DENSE]):
+                if tok:
+                    d[j] = float(int(tok))
+                    m[j] = 1.0
+            cat_toks = parts[1 + NUM_DENSE:1 + NUM_DENSE + NUM_CAT]
+            if any(len(tok) > 8 for tok in cat_toks):
+                # >8 hex digits would exceed the 32-bit per-field id space
+                # (the native parser rejects these too — rc=3)
+                raise ValueError(f"categorical token over 8 hex digits in "
+                                 f"{path!r}")
+            c = np.array([int(tok, 16) if tok else 0 for tok in cat_toks],
+                         np.int64) | field_offset
+            denses.append(d)
+            masks.append(m)
+            cats.append(c)
+    n = len(ys)
+    return {
+        "y": np.asarray(ys, np.float32),
+        "dense": (np.stack(denses) if n else
+                  np.zeros((0, NUM_DENSE), np.float32)),
+        "dense_mask": (np.stack(masks) if n else
+                       np.zeros((0, NUM_DENSE), np.float32)),
+        "cat": (np.stack(cats) if n else np.zeros((0, NUM_CAT), np.int64)),
+    }
+
+
+def read_criteo(path: str, use_native: bool = True) -> dict:
+    """Returns dict(y, dense, dense_mask, cat) — see module docstring."""
+    if use_native:
+        try:
+            from minips_tpu.data.native import read_criteo_native
+
+            out = read_criteo_native(path)
+            if out is not None:
+                return out
+        except ImportError:
+            pass
+    return _read_python(path)
+
+
+def log_transform(dense: np.ndarray,
+                  mask: np.ndarray | None = None) -> np.ndarray:
+    """Standard Criteo numeric preprocessing: ``log1p(max(x, 0))``, with
+    masked-out (missing) cells staying 0. Negative raw values (I2 can be
+    −1..−3) clamp to 0 before the log."""
+    out = np.log1p(np.maximum(np.asarray(dense, np.float32), 0.0))
+    if mask is not None:
+        out = out * np.asarray(mask, np.float32)
+    return out

@@ -70,6 +70,18 @@ def read_libsvm(path: str, max_features: int | None = None,
     return {"y": y, "idx": idx, "val": val, "mask": mask}
 
 
+def shift_one_based(data: dict) -> dict:
+    """Canonical libsvm files (a9a/RCV1) index features from 1; the
+    framework's key spaces are 0-based. If every present index is >= 1,
+    shift down by one (masked padding cells stay 0). Without this, densify
+    at dim=D silently drops feature D of a 1-based file. Returns the same
+    dict, modified in place."""
+    present = data["mask"] > 0
+    if present.any() and data["idx"][present].min() >= 1:
+        data["idx"] = np.where(present, data["idx"] - 1, 0).astype(np.int32)
+    return data
+
+
 def densify(data: dict, dim: int) -> dict:
     """Sparse rows -> dense [N, dim] matrix (the LR-on-a9a dense-ified
     minimum slice, SURVEY.md §7.3)."""

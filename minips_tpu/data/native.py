@@ -32,11 +32,21 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_failed:
             return None
-        if not os.path.exists(_LIB_PATH):
-            try:
+        # Always invoke make: a no-op when fresh, a rebuild when the C++
+        # sources are newer than a stale .so (which would lack new symbols).
+        # An inter-process flock serializes concurrent builds (the launcher
+        # starts several local workers at once; without it two g++ runs can
+        # interleave writes to the .so while a third dlopens the torso).
+        try:
+            os.makedirs(os.path.join(_REPO_CPP, "build"), exist_ok=True)
+            import fcntl
+
+            with open(os.path.join(_REPO_CPP, "build", ".lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
                 subprocess.run(["make", "-C", _REPO_CPP], check=True,
                                capture_output=True, timeout=120)
-            except (OSError, subprocess.SubprocessError):
+        except (OSError, subprocess.SubprocessError):
+            if not os.path.exists(_LIB_PATH):
                 _build_failed = True
                 return None
         try:
@@ -55,6 +65,19 @@ def _load() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")]
         lib.libsvm_parse.restype = ctypes.c_int
+        try:  # a stale .so surviving a failed rebuild lacks these symbols
+            lib.criteo_count.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+            lib.criteo_count.restype = ctypes.c_int
+            lib.criteo_parse.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+            lib.criteo_parse.restype = ctypes.c_int
+        except AttributeError:
+            lib.criteo_count = None
         _lib = lib
         return _lib
 
@@ -82,3 +105,25 @@ def read_libsvm_native(path: str,
     if rc != 0:
         raise ValueError(f"libsvm_parse failed with code {rc} on {path}")
     return {"y": y, "idx": idx, "val": val, "mask": mask}
+
+
+def read_criteo_native(path: str) -> Optional[dict]:
+    """Native fast path for data.criteo.read_criteo. Returns None when the
+    library is unavailable (caller falls back to pure Python)."""
+    from minips_tpu.data.criteo import NUM_CAT, NUM_DENSE
+
+    lib = _load()
+    if lib is None or lib.criteo_count is None:
+        return None
+    n = ctypes.c_int64()
+    if lib.criteo_count(path.encode(), ctypes.byref(n)):
+        return None  # unreadable file: let the Python path surface the OSError
+    rows = n.value
+    y = np.zeros(rows, np.float32)
+    dense = np.zeros((rows, NUM_DENSE), np.float32)
+    dense_mask = np.zeros((rows, NUM_DENSE), np.float32)
+    cat = np.zeros((rows, NUM_CAT), np.int64)
+    rc = lib.criteo_parse(path.encode(), rows, y, dense, dense_mask, cat)
+    if rc != 0:
+        raise ValueError(f"criteo_parse failed with code {rc} on {path}")
+    return {"y": y, "dense": dense, "dense_mask": dense_mask, "cat": cat}

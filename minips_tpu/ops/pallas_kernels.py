@@ -51,11 +51,15 @@ except Exception:  # pragma: no cover
 _CHUNK = 8  # rows per grid step = output sublane tile
 
 
+def backend_supported() -> bool:
+    """The compiled (non-interpret) kernels use pltpu primitives — TPU only;
+    off-TPU they exist solely in interpret mode (tests)."""
+    return _HAS_PALLAS and jax.default_backend() == "tpu"
+
+
 def pallas_enabled() -> bool:
-    """Opt-in switch consulted by SparseTable (see module docstring).
-    TPU-only: off-TPU the kernels exist solely in interpret mode (tests)."""
-    return (_HAS_PALLAS and os.environ.get("MINIPS_PALLAS", "") == "1"
-            and jax.default_backend() == "tpu")
+    """Opt-in switch consulted by SparseTable (see module docstring)."""
+    return backend_supported() and os.environ.get("MINIPS_PALLAS", "") == "1"
 
 
 def gather_supported(dim: int, n: int) -> bool:
@@ -86,7 +90,10 @@ def gather_rows(emb: jnp.ndarray, slots: jnp.ndarray,
     """
     slots = slots.reshape(-1).astype(jnp.int32)
     n, d = slots.shape[0], emb.shape[1]
-    if not gather_supported(d, n):
+    # compiled kernels are TPU-only (pltpu primitives fail Mosaic lowering
+    # elsewhere); interpret mode runs anywhere
+    if not gather_supported(d, n) or (not interpret
+                                      and not backend_supported()):
         return emb[slots]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,

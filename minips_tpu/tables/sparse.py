@@ -81,13 +81,15 @@ class SparseTable:
         # trace-cached, so a late env toggle would be silently ignored).
         # Single-device meshes only: pallas_call has no GSPMD partitioning
         # rule, so on a sharded table it would force a full replication
-        # all-gather of emb instead of the sharded XLA gather.
+        # all-gather of emb instead of the sharded XLA gather. The backend
+        # check applies even to an explicit use_pallas=True — the kernel
+        # uses pltpu primitives, which fail Mosaic lowering off-TPU.
         from minips_tpu.ops import pallas_kernels as _pk
 
         n_dev = len(np.asarray(mesh.devices).reshape(-1))
         self.use_pallas = bool(
             (use_pallas if use_pallas is not None else _pk.pallas_enabled())
-            and n_dev == 1)
+            and n_dev == 1 and _pk.backend_supported())
 
         self._sharding = NamedSharding(mesh, P(DATA_AXIS, None))
         key = jax.random.PRNGKey(seed)

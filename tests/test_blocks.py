@@ -1,0 +1,231 @@
+"""Dynamic block assigner tests — local (threads-as-workers) and over the
+loopback control bus (threads-as-processes), the reference's mailbox-test
+style (SURVEY.md §4). Covers the FlexPS-lineage coordinator semantics:
+exactly-once assignment, straggler-friendly dynamic draining, and dead-worker
+block re-queue (SURVEY.md §1 L5, §5.3)."""
+
+import threading
+import time
+
+import pytest
+
+from minips_tpu.data.blocks import (BlockClient, BlockMaster,
+                                    LocalBlockAssigner, read_block_lines,
+                                    split_file_lines, split_rows)
+
+
+def test_split_rows_covers_range():
+    blocks = split_rows(103, 25)
+    assert [b["id"] for b in blocks] == list(range(5))
+    assert blocks[0] == {"id": 0, "start": 0, "end": 25}
+    assert blocks[-1] == {"id": 4, "start": 100, "end": 103}
+    covered = [r for b in blocks for r in range(b["start"], b["end"])]
+    assert covered == list(range(103))
+
+
+def test_split_file_lines_roundtrip(tmp_path):
+    lines = [f"row {i} payload".encode() for i in range(37)]
+    path = str(tmp_path / "d.txt")
+    with open(path, "wb") as f:
+        f.write(b"\n".join(lines))  # no trailing newline: tail block case
+    blocks = split_file_lines(path, 10)
+    assert [b["lines"] for b in blocks] == [10, 10, 10, 7]
+    back = [ln for b in blocks for ln in read_block_lines(b)]
+    assert back == lines
+    # byte ranges tile the file exactly
+    assert blocks[0]["offset"] == 0
+    for a, b in zip(blocks, blocks[1:]):
+        assert a["offset"] + a["nbytes"] == b["offset"]
+
+
+def test_local_assigner_exactly_once_under_threads():
+    blocks = split_rows(1000, 10)  # 100 blocks
+    asg = LocalBlockAssigner(blocks)
+    taken: list[int] = []
+    lock = threading.Lock()
+
+    def worker(wid):
+        while True:
+            b = asg.next_block(wid)
+            if b is None:
+                return
+            time.sleep(0.0005 * (wid + 1))  # unequal speeds → dynamic split
+            with lock:
+                taken.append(b["id"])
+            asg.done(wid, b["id"])
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(taken) == list(range(100))  # every block exactly once
+    assert asg.remaining == 0
+
+
+def test_local_assigner_requeues_dead_worker():
+    asg = LocalBlockAssigner(split_rows(30, 10))
+    b0 = asg.next_block(worker=1)
+    b1 = asg.next_block(worker=1)
+    asg.done(1, b0["id"])  # finished one, died holding the other
+    assert asg.requeue_worker(1) == 1
+    ids_left = {asg.next_block(2)["id"], asg.next_block(2)["id"]}
+    assert b1["id"] in ids_left
+    assert asg.next_block(2) is None
+
+
+def test_iter_block_batches_static_shapes_across_blocks(tmp_path):
+    """Out-of-core streaming: criteo file → line blocks → fixed batches."""
+    import numpy as np
+
+    from minips_tpu.data import synthetic
+    from minips_tpu.data.blocks import iter_block_batches
+    from minips_tpu.data.criteo import read_criteo, write_criteo
+
+    d = synthetic.criteo_like(70, seed=2)
+    dense = np.round(d["dense"]).astype(np.float32)
+    path = str(tmp_path / "c.tsv")
+    write_criteo(path, d["y"], dense, d["cat"])
+    blocks = split_file_lines(path, 16)  # 16,16,16,16,6 lines
+
+    def parse(block):
+        sub = str(tmp_path / f"b{block['id']}.tsv")
+        with open(sub, "wb") as f:
+            f.write(b"\n".join(read_block_lines(block)) + b"\n")
+        out = read_criteo(sub, use_native=False)
+        return {"y": out["y"], "cat": out["cat"]}
+
+    batches = list(iter_block_batches(iter(blocks), parse, batch_size=32))
+    assert [len(b["y"]) for b in batches] == [32, 32]  # 70 rows, drop tail 6
+    ys = np.concatenate([b["y"] for b in batches])
+    np.testing.assert_array_equal(ys, d["y"][:64])  # order preserved
+    # ragged tail surfaced when asked
+    tail = list(iter_block_batches(iter(blocks), parse, batch_size=32,
+                                   drop_last=False))[-1]
+    assert len(tail["y"]) == 6
+
+
+def _mk_buses(n, base_port):
+    from minips_tpu.comm.bus import ControlBus
+    addrs = [f"tcp://127.0.0.1:{base_port + i}" for i in range(n)]
+    buses = [ControlBus(addrs[i], [a for j, a in enumerate(addrs) if j != i],
+                        my_id=i) for i in range(n)]
+    for b in buses:
+        b.start()
+    time.sleep(0.2)  # PUB/SUB slow-joiner settle
+    return buses
+
+
+def test_block_master_client_over_bus():
+    buses = _mk_buses(3, 15880)
+    try:
+        master = BlockMaster(buses[0], split_rows(120, 10))  # 12 blocks
+        clients = [BlockClient(buses[0], local_master=master),
+                   BlockClient(buses[1]), BlockClient(buses[2])]
+        got: dict[int, list[int]] = {0: [], 1: [], 2: []}
+
+        def drain(pid):
+            for b in clients[pid]:
+                got[pid].append(b["id"])
+                time.sleep(0.02)  # simulate work so the split is dynamic
+
+        threads = [threading.Thread(target=drain, args=(p,)) for p in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        all_ids = sorted(i for ids in got.values() for i in ids)
+        assert all_ids == list(range(12))  # exactly once across processes
+        assert master.assigner.remaining == 0
+        # remote (bus-served) clients did get work — the protocol ran; the
+        # local direct-call client may legitimately grab the lion's share
+        assert len(got[1]) + len(got[2]) > 0
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_block_master_requeues_on_failure():
+    buses = _mk_buses(2, 15920)
+    try:
+        master = BlockMaster(buses[0], split_rows(20, 10))  # blocks 0, 1
+        remote = BlockClient(buses[1])
+        b = remote.next_block()
+        assert b is not None  # worker 1 holds a block, then "dies" silently
+        assert master.handle_failure(1) == 1
+        local = BlockClient(buses[0], local_master=master)
+        ids = []
+        while True:
+            nb = local.next_block()
+            if nb is None:
+                break
+            ids.append(nb["id"])
+            local.done(nb)
+        assert sorted(ids + [b["id"]]) == [0, 1] or sorted(ids) == [0, 1]
+        assert b["id"] in ids  # the dead worker's block was re-served
+    finally:
+        for b in buses:
+            b.close()
+
+
+class _FakeBus:
+    """Loopback-free stub: captures publishes, delivers nothing."""
+
+    def __init__(self, my_id=0):
+        self.my_id = my_id
+        self.published = []
+        self._handlers = {}
+
+    def on(self, kind, handler):
+        self._handlers[kind] = handler
+
+    def publish(self, kind, payload, blob=None):
+        self.published.append((kind, payload))
+
+
+def test_master_reserves_duplicate_request_idempotently():
+    """A retried req id (lost reply) gets the SAME block back — the block is
+    not re-popped, so a timeout can't strand or double-assign it."""
+    bus = _FakeBus()
+    master = BlockMaster(bus, split_rows(30, 10))  # blocks 0,1,2
+    master._on_req(sender=1, payload={"req": 1})
+    master._on_req(sender=1, payload={"req": 1})  # duplicate (client retry)
+    asns = [p for k, p in bus.published if k == "blk_asn"]
+    assert asns[0]["block"]["id"] == asns[1]["block"]["id"]
+    assert master.assigner.remaining == 2  # only one block actually popped
+    master._on_req(sender=1, payload={"req": 2})  # next req → next block
+    asns = [p for k, p in bus.published if k == "blk_asn"]
+    assert asns[2]["block"]["id"] != asns[0]["block"]["id"]
+
+
+def test_client_retries_until_answered():
+    buses = _mk_buses(2, 15970)
+    try:
+        client = BlockClient(buses[1], timeout=10.0, retry_every=0.2)
+        # master comes up LATE — first request frames are lost to the void
+        result = {}
+
+        def ask():
+            result["block"] = client.next_block()
+
+        t = threading.Thread(target=ask)
+        t.start()
+        time.sleep(0.6)  # client has already published >= 1 lost request
+        BlockMaster(buses[0], split_rows(10, 10))
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        assert result["block"]["id"] == 0  # retry got the block
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_client_timeout_without_master():
+    buses = _mk_buses(2, 15950)
+    try:
+        client = BlockClient(buses[1], timeout=0.3)  # nobody serves blk_req
+        with pytest.raises(TimeoutError):
+            client.next_block()
+    finally:
+        for b in buses:
+            b.close()

@@ -112,6 +112,131 @@ def test_native_reader_matches_python(tmp_path):
     np.testing.assert_array_equal(nat["mask"], py["mask"])
 
 
+def test_criteo_roundtrip_python(tmp_path):
+    from minips_tpu.data.criteo import read_criteo, write_criteo
+    d = synthetic.criteo_like(64, seed=1)
+    # synthetic dense is continuous; Criteo numerics are ints — quantize
+    dense = np.round(d["dense"] * 10).astype(np.float32)
+    path = str(tmp_path / "c.tsv")
+    write_criteo(path, d["y"], dense, d["cat"])
+    back = read_criteo(path, use_native=False)
+    np.testing.assert_array_equal(back["y"], d["y"])
+    np.testing.assert_array_equal(back["dense"], dense)
+    np.testing.assert_array_equal(back["dense_mask"], np.ones_like(dense))
+    # ids survive modulo the 32-bit field packing: low 32 bits match, and
+    # per-field spaces stay disjoint via the field<<32 offset
+    np.testing.assert_array_equal(back["cat"] & 0xFFFFFFFF,
+                                  d["cat"] & 0xFFFFFFFF)
+    assert (back["cat"] >> 32 == np.arange(26)).all()
+
+
+def test_criteo_missing_fields_and_crlf(tmp_path):
+    from minips_tpu.data.criteo import read_criteo
+    # row 1: missing I2, negative I1, missing C2; row 2: truncated line
+    line1 = "1\t-3\t\t" + "\t".join(str(i) for i in range(3, 14)) \
+        + "\tdeadbeef\t\t" + "\t".join(["0a0b0c0d"] * 24)
+    line2 = "0\t7"
+    path = str(tmp_path / "m.tsv")
+    with open(path, "wb") as f:
+        f.write((line1 + "\r\n" + line2 + "\n").encode())
+    out = read_criteo(path, use_native=False)
+    assert out["y"].tolist() == [1.0, 0.0]
+    assert out["dense"][0, 0] == -3 and out["dense_mask"][0, 1] == 0.0
+    assert out["dense"][1, 0] == 7 and out["dense_mask"][1, 1:].sum() == 0
+    assert out["cat"][0, 0] == 0xDEADBEEF
+    assert out["cat"][0, 1] == (1 << 32)  # missing → field-offset 0 token
+    assert out["cat"][1, 0] == 0  # truncated row: all cats missing
+
+
+def test_criteo_native_matches_python(tmp_path):
+    from minips_tpu.data.criteo import read_criteo, write_criteo
+    from minips_tpu.data.native import read_criteo_native
+    d = synthetic.criteo_like(128, seed=5)
+    dense = np.round(d["dense"] * 100).astype(np.float32)
+    mask = (np.random.default_rng(0).uniform(size=dense.shape) > 0.2
+            ).astype(np.float32)
+    path = str(tmp_path / "n.tsv")
+    write_criteo(path, d["y"], dense, d["cat"], dense_mask=mask)
+    nat = read_criteo_native(path)
+    if nat is None:
+        pytest.skip("native lib unavailable (no compiler)")
+    py = read_criteo(path, use_native=False)
+    for k in ("y", "dense", "dense_mask", "cat"):
+        np.testing.assert_array_equal(nat[k], py[k], err_msg=k)
+    np.testing.assert_array_equal(nat["dense_mask"], mask)
+
+
+def test_criteo_malformed_rejected_both_paths(tmp_path):
+    from minips_tpu.data.criteo import read_criteo
+    from minips_tpu.data.native import read_criteo_native
+    # a float numeric field is garbage in Criteo (ints only)
+    path = str(tmp_path / "bad.tsv")
+    with open(path, "w") as f:
+        f.write("1\t3.5\t" + "\t".join(["1"] * 12) + "\t"
+                + "\t".join(["ab"] * 26) + "\n")
+    with pytest.raises(ValueError):
+        read_criteo(path, use_native=False)
+    nat_err = None
+    try:
+        nat = read_criteo_native(path)
+    except ValueError as e:
+        nat, nat_err = None, e
+    if nat is None and nat_err is None:
+        pytest.skip("native lib unavailable")
+    assert nat_err is not None  # native is as strict as the oracle
+
+
+def test_criteo_strictness_edge_tokens(tmp_path):
+    """Lone '-' int field and >8-hex cat token must be rejected by BOTH
+    paths (native rc=3 == python ValueError), not silently salvaged."""
+    from minips_tpu.data.criteo import read_criteo
+    from minips_tpu.data.native import read_criteo_native
+    cases = {
+        "dash.tsv": "1\t-\t" + "\t".join(["1"] * 12) + "\t"
+                    + "\t".join(["ab"] * 26) + "\n",
+        "ninehex.tsv": "0\t" + "\t".join(["1"] * 13) + "\t"
+                       + "fdeadbeef\t" + "\t".join(["ab"] * 25) + "\n",
+    }
+    for name, content in cases.items():
+        path = str(tmp_path / name)
+        with open(path, "w") as f:
+            f.write(content)
+        with pytest.raises(ValueError):
+            read_criteo(path, use_native=False)
+        try:
+            nat = read_criteo_native(path)
+        except ValueError:
+            nat = "rejected"
+        if nat is None:
+            pytest.skip("native lib unavailable")
+        assert nat == "rejected", f"native accepted malformed {name}"
+
+
+def test_libsvm_shift_one_based():
+    from minips_tpu.data.libsvm import densify, shift_one_based
+    raw = {"idx": np.array([[1, 123], [5, 0]], np.int32),
+           "val": np.array([[1.0, 2.0], [3.0, 9.0]], np.float32),
+           "mask": np.array([[1, 1], [1, 0]], np.float32),
+           "y": np.array([1.0, 0.0], np.float32)}
+    out = densify(shift_one_based(raw), dim=123)
+    assert out["x"][0, 122] == 2.0  # feature 123 of a 1-based file survives
+    assert out["x"][0, 0] == 1.0 and out["x"][1, 4] == 3.0
+    # 0-based data (a present index 0 exists) is left untouched
+    raw0 = {"idx": np.array([[0, 2]], np.int32),
+            "val": np.array([[1.0, 1.0]], np.float32),
+            "mask": np.array([[1, 1]], np.float32),
+            "y": np.array([1.0], np.float32)}
+    assert shift_one_based(raw0)["idx"].tolist() == [[0, 2]]
+
+
+def test_criteo_log_transform():
+    from minips_tpu.data.criteo import log_transform
+    dense = np.array([[-2.0, 0.0, np.e - 1]], np.float32)
+    mask = np.array([[1.0, 0.0, 1.0]], np.float32)
+    np.testing.assert_allclose(log_transform(dense, mask),
+                               [[0.0, 0.0, 1.0]], rtol=1e-6)
+
+
 def test_native_reader_width_cap(tmp_path):
     from minips_tpu.data.native import read_libsvm_native
     with open(tmp_path / "w.libsvm", "w") as f:

@@ -45,6 +45,19 @@ def test_unsupported_shapes_fall_back(rng):
                                np.asarray(emb)[np.asarray(slots)], rtol=1e-6)
 
 
+def test_supported_shapes_fall_back_off_tpu(rng):
+    # aligned shapes (D=128, N=64) with interpret=False: on this CPU test
+    # session the compiled pltpu kernel can't lower, so gather_rows must
+    # take the XLA path instead of crashing in Mosaic
+    assert pk.gather_supported(128, 64)
+    assert not pk.backend_supported()
+    emb = jnp.asarray(rng.normal(size=(256, 128)), jnp.float32)
+    slots = jnp.asarray(rng.integers(0, 256, 64), jnp.int32)
+    out = pk.gather_rows(emb, slots)  # must not raise
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(emb)[np.asarray(slots)], rtol=1e-6)
+
+
 def test_opt_in_is_off_by_default_and_off_tpu(monkeypatch):
     assert not pk.pallas_enabled()  # default: no env flag
     monkeypatch.setenv("MINIPS_PALLAS", "1")
