@@ -5,3 +5,7 @@ from minips_tpu.parallel.mesh import (  # noqa: F401
     local_mesh_size,
 )
 from minips_tpu.parallel.partition import RangePartitioner  # noqa: F401
+from minips_tpu.parallel.ring_attention import (  # noqa: F401
+    make_ring_attention,
+    ring_attention_local,
+)
