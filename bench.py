@@ -36,6 +36,7 @@ def _tpu_responsive(timeout_s: float = 180.0) -> bool:
     import subprocess
 
     code = ("import jax, jax.numpy as jnp;"
+            "assert jax.default_backend() == 'tpu', jax.default_backend();"
             "x = jnp.ones((8, 8));"
             "jax.block_until_ready(x @ x);"
             "print('ok')")

@@ -110,3 +110,24 @@ def skipgram_pairs(tokens: np.ndarray, window: int = 2, seed: int = 0):
     x = np.concatenate(contexts)
     perm = rng.permutation(len(c))
     return c[perm], x[perm]
+
+
+def lm_sequences(n: int = 2048, seq_len: int = 128, vocab: int = 256,
+                 seed: int = 0, order: int = 3):
+    """Long-context LM windows [n, seq_len+1]: an order-k Markov chain over
+    the vocab, so next-token loss has real learnable structure (an LM that
+    trains drives cross-entropy well below log(vocab))."""
+    rng = np.random.default_rng(seed)
+    # deterministic transition: context hash -> a small candidate set
+    a, b = rng.integers(1, vocab, size=2) | 1
+    stream = list(rng.integers(0, vocab, size=order))
+    noise = rng.random(n * (seq_len + 1) + order)
+    jump = rng.integers(0, vocab, size=len(noise))
+    for i in range(n * (seq_len + 1)):
+        h = 0
+        for t in stream[-order:]:
+            h = (h * a + t * b) % vocab
+        nxt = h if noise[i] > 0.15 else jump[i]   # 85% predictable
+        stream.append(int(nxt))
+    toks = np.asarray(stream[order:], dtype=np.int32)
+    return {"tokens": toks.reshape(n, seq_len + 1)}

@@ -1,1 +1,1 @@
-from minips_tpu.models import lr, mf, mlp, wide_deep, word2vec  # noqa: F401
+from minips_tpu.models import lr, mf, mlp, transformer, wide_deep, word2vec  # noqa: F401

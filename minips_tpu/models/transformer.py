@@ -1,0 +1,157 @@
+"""Decoder-only transformer LM — the rebuild's long-context model family.
+
+The reference has no attention models at all (SURVEY.md §2.2: configs are
+LR/MLP/MF/W&D/w2v), so this family is beyond parity: it exists to exercise
+the framework's first-class long-context path — causal ring attention
+(``parallel/ring_attention.py``) with the sequence axis sharded across the
+mesh — inside the same PS machinery (DenseTable fused step) every other
+model uses.
+
+Functional plain-dict params like the other model files, so the whole LM
+lives in one DenseTable. Matmuls run bfloat16 on the MXU with float32
+params; pre-LN blocks, learned positional embeddings, GELU MLP, weight-tied
+output head.
+
+Two attention modes, numerically identical:
+- ``apply(params, tokens)`` — single-program causal attention (any device).
+- ``apply_sp(params, tokens_local, shift, axis_name)`` — call under
+  ``shard_map`` with tokens sharded along the sequence axis; attention runs
+  as a ring over ``axis_name`` and positional embeddings are indexed by the
+  shard's global offset ``shift``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from minips_tpu.parallel.mesh import DATA_AXIS
+from minips_tpu.parallel.ring_attention import (
+    reference_attention,
+    ring_attention_local,
+)
+
+
+def init(key, *, vocab: int = 256, dim: int = 64, heads: int = 4,
+         depth: int = 2, max_len: int = 1024, mlp_mult: int = 4):
+    if dim % heads:
+        raise ValueError(f"dim {dim} not divisible by heads {heads}")
+    ks = iter(jax.random.split(key, 2 + depth * 4))
+    scale = dim ** -0.5
+    params = {
+        "tok_emb": jax.random.normal(next(ks), (vocab, dim)) * scale,
+        "pos_emb": jax.random.normal(next(ks), (max_len, dim)) * scale,
+        "ln_f": {"g": jnp.ones(dim), "b": jnp.zeros(dim)},
+        "blocks": [],
+    }
+    for _ in range(depth):
+        params["blocks"].append({
+            "ln1": {"g": jnp.ones(dim), "b": jnp.zeros(dim)},
+            "ln2": {"g": jnp.ones(dim), "b": jnp.zeros(dim)},
+            "qkv": jax.random.normal(next(ks), (dim, 3 * dim)) * scale,
+            "proj": jax.random.normal(next(ks), (dim, dim)) * scale,
+            "mlp_in": jax.random.normal(next(ks), (dim, mlp_mult * dim))
+                      * scale,
+            "mlp_out": jax.random.normal(next(ks), (mlp_mult * dim, dim))
+                       * (mlp_mult * dim) ** -0.5,
+        })
+    return params
+
+
+def _ln(x, p):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-5) * p["g"] + p["b"]
+
+
+def _block(h, blk, heads, attn_fn, compute_dtype):
+    B, T, D = h.shape
+    hd = D // heads
+    x = _ln(h, blk["ln1"]).astype(compute_dtype)
+    qkv = x @ blk["qkv"].astype(compute_dtype)
+    q, k, v = jnp.split(qkv.astype(jnp.float32), 3, axis=-1)
+    q = q.reshape(B, T, heads, hd)
+    k = k.reshape(B, T, heads, hd)
+    v = v.reshape(B, T, heads, hd)
+    a = attn_fn(q, k, v).reshape(B, T, D)
+    h = h + (a.astype(compute_dtype)
+             @ blk["proj"].astype(compute_dtype)).astype(jnp.float32)
+    x = _ln(h, blk["ln2"]).astype(compute_dtype)
+    x = jax.nn.gelu(x @ blk["mlp_in"].astype(compute_dtype))
+    h = h + (x @ blk["mlp_out"].astype(compute_dtype)).astype(jnp.float32)
+    return h
+
+
+def _forward(params, tokens, pos, heads, attn_fn, compute_dtype):
+    h = params["tok_emb"][tokens] + params["pos_emb"][pos]
+    for blk in params["blocks"]:
+        h = _block(h, blk, heads, attn_fn, compute_dtype)
+    h = _ln(h, params["ln_f"])
+    # weight-tied head
+    return (h.astype(compute_dtype)
+            @ params["tok_emb"].T.astype(compute_dtype)).astype(jnp.float32)
+
+
+def apply(params, tokens, *, heads=4, compute_dtype=jnp.bfloat16):
+    """Logits [B, T, vocab]; plain causal attention in one program.
+    ``heads`` is static model structure, not table state — pass the value
+    used at ``init``."""
+    T = tokens.shape[1]
+    return _forward(params, tokens, jnp.arange(T), heads,
+                    lambda q, k, v: reference_attention(q, k, v, causal=True),
+                    compute_dtype)
+
+
+def apply_sp(params, tokens_local, shift, *, heads=4, axis_name=DATA_AXIS,
+             compute_dtype=jnp.bfloat16):
+    """Sequence-parallel logits for a local token shard [B, T_local].
+
+    Call inside ``shard_map``: ``shift`` is this shard's global sequence
+    offset (``axis_index * T_local``); attention is a causal ring over
+    ``axis_name``. Full params, sharded activations — sequence parallelism
+    in its pure form.
+    """
+    T_local = tokens_local.shape[1]
+    pos = shift + jnp.arange(T_local)
+    return _forward(
+        params, tokens_local, pos, heads,
+        lambda q, k, v: ring_attention_local(q, k, v, axis_name=axis_name,
+                                             causal=True),
+        compute_dtype)
+
+
+def loss(params, batch, *, heads=4, compute_dtype=jnp.bfloat16):
+    """Next-token cross-entropy; batch = {"tokens": [B, T+1] int32}."""
+    toks = batch["tokens"]
+    logits = apply(params, toks[:, :-1], heads=heads,
+                   compute_dtype=compute_dtype)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, toks[:, 1:, None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def grad_fn(params, batch, *, heads=4):
+    l, g = jax.value_and_grad(
+        lambda p, b: loss(p, b, heads=heads))(params, batch)
+    return l, g
+
+
+def loss_sp(params, tokens_local, targets_local, shift, *, heads=4,
+            axis_name=DATA_AXIS, compute_dtype=jnp.bfloat16,
+            reduce="pmean"):
+    """Per-shard next-token loss over the shard's tokens.
+
+    ``reduce="pmean"`` returns the global mean loss (standalone use — take
+    ``jax.grad`` OUTSIDE the shard_map). ``reduce="local"`` returns the
+    shard-local mean: required when differentiating INSIDE shard_map under
+    ``DenseTable.make_step``, whose psum_scatter + 1/N already averages the
+    per-shard grads — a pmean here would double-scale them by 1/N.
+    """
+    logits = apply_sp(params, tokens_local, shift, heads=heads,
+                      axis_name=axis_name, compute_dtype=compute_dtype)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, targets_local[..., None], axis=-1)[..., 0]
+    local = jnp.mean(nll)
+    if reduce == "local":
+        return local
+    return jax.lax.pmean(local, axis_name)
