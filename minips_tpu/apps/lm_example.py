@@ -57,8 +57,9 @@ def run(cfg: Config, args, metrics) -> dict:
         raise SystemExit(f"--seq_len {seq_len} must divide by the "
                          f"{n_shards}-way mesh")
     if seq_len > MODEL["max_len"]:
-        # jax clamps out-of-range indices silently, so an oversized seq_len
-        # would reuse the last positional embedding instead of erroring
+        # the model's static check can't see the GLOBAL length on the sp
+        # path (each shard only knows its T_local; the shift is traced),
+        # so the app validates it here for both layouts
         raise SystemExit(f"--seq_len {seq_len} exceeds the model's "
                          f"max_len {MODEL['max_len']}")
 

@@ -83,6 +83,13 @@ def _block(h, blk, heads, attn_fn, compute_dtype):
 
 
 def _forward(params, tokens, pos, heads, attn_fn, compute_dtype):
+    # static check: jax clamps out-of-range indices silently, so an
+    # oversized sequence would reuse the last positional embedding row
+    # for every tail position instead of erroring
+    max_len = params["pos_emb"].shape[0]
+    if pos.shape[0] > max_len:
+        raise ValueError(f"sequence length {pos.shape[0]} exceeds the "
+                         f"model's max_len {max_len}")
     h = params["tok_emb"][tokens] + params["pos_emb"][pos]
     for blk in params["blocks"]:
         h = _block(h, blk, heads, attn_fn, compute_dtype)

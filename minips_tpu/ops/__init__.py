@@ -3,3 +3,7 @@ from minips_tpu.ops.sparse_update import (  # noqa: F401
     row_adagrad,
     row_sgd,
 )
+from minips_tpu.ops.quantized_comm import (  # noqa: F401
+    quantized_all_gather,
+    quantized_psum_scatter,
+)

@@ -172,6 +172,7 @@ class DenseTable:
         *,
         batch_spec: Optional[PyTree] = None,
         jit: bool = True,
+        comm: str = "float32",
     ):
         """Fuse pull → grad → push → update into one SPMD program.
 
@@ -182,18 +183,25 @@ class DenseTable:
         (worker compute on MXU), psum_scatter (push), optax on the owner
         shard (server update). BSP is implicit — the collectives are the
         barrier (SURVEY.md §2 "BSPModel").
+
+        ``comm`` compresses the two collectives' wire format ("bfloat16" or
+        "int8"; EQuARX-style, see ops/quantized_comm.py). Params and the
+        optimizer update stay float32 — only bytes-on-wire change.
         """
         n, padded = self.num_keys, self.padded
         num_workers = self.num_shards
         unravel, tx, reduce = self._unravel, self.tx, self.grad_reduce
         bspec = batch_spec if batch_spec is not None else P(DATA_AXIS)
+        from minips_tpu.ops.quantized_comm import (
+            _check, quantized_all_gather, quantized_psum_scatter)
+        _check(comm)  # eager: tracing happens on first step call
 
         def local_step(p_shard, opt_shard, batch):
-            full = jax.lax.all_gather(p_shard, DATA_AXIS, tiled=True)  # pull
+            full = quantized_all_gather(p_shard, DATA_AXIS, comm)      # pull
             loss, grads = grad_fn(unravel(full[:n]), batch)
             gflat, _ = ravel_pytree(grads)
             gpad = jnp.zeros(padded, gflat.dtype).at[:n].set(gflat)
-            g_shard = jax.lax.psum_scatter(gpad, DATA_AXIS, tiled=True)  # push
+            g_shard = quantized_psum_scatter(gpad, DATA_AXIS, comm)    # push
             if reduce == "mean":
                 g_shard = g_shard / num_workers
             updates, opt_shard = tx.update(g_shard, opt_shard, p_shard)

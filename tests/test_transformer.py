@@ -154,3 +154,9 @@ def test_dp_and_sp_training_steps_match(mesh8):
     f_sp, _ = jax.flatten_util.ravel_pytree(t_sp.pull())
     np.testing.assert_allclose(np.asarray(f_sp), np.asarray(f_dp),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_seq_len_over_max_len_raises(params):
+    long_toks = _toks(1, 200)  # CFG max_len=128
+    with pytest.raises(ValueError, match="max_len"):
+        tfm.apply(params, long_toks, heads=CFG["heads"])
