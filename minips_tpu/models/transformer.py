@@ -36,7 +36,7 @@ def init(key, *, vocab: int = 256, dim: int = 64, heads: int = 4,
          depth: int = 2, max_len: int = 1024, mlp_mult: int = 4):
     if dim % heads:
         raise ValueError(f"dim {dim} not divisible by heads {heads}")
-    ks = iter(jax.random.split(key, 2 + depth * 4))
+    ks = iter(jax.random.split(key, 2 + depth))
     scale = dim ** -0.5
     params = {
         "tok_emb": jax.random.normal(next(ks), (vocab, dim)) * scale,
@@ -45,14 +45,17 @@ def init(key, *, vocab: int = 256, dim: int = 64, heads: int = 4,
         "blocks": [],
     }
     for _ in range(depth):
+        kq, kp, ki, ko = jax.random.split(next(ks), 4)
         params["blocks"].append({
             "ln1": {"g": jnp.ones(dim), "b": jnp.zeros(dim)},
             "ln2": {"g": jnp.ones(dim), "b": jnp.zeros(dim)},
-            "qkv": jax.random.normal(next(ks), (dim, 3 * dim)) * scale,
-            "proj": jax.random.normal(next(ks), (dim, dim)) * scale,
-            "mlp_in": jax.random.normal(next(ks), (dim, mlp_mult * dim))
-                      * scale,
-            "mlp_out": jax.random.normal(next(ks), (mlp_mult * dim, dim))
+            # one [dim, 3, dim] tensor, axis 1 = (q, k, v); the last dim is
+            # the head dim (heads contiguous), so tensor parallelism can
+            # shard it at head boundaries
+            "qkv": jax.random.normal(kq, (dim, 3, dim)) * scale,
+            "proj": jax.random.normal(kp, (dim, dim)) * scale,
+            "mlp_in": jax.random.normal(ki, (dim, mlp_mult * dim)) * scale,
+            "mlp_out": jax.random.normal(ko, (mlp_mult * dim, dim))
                        * (mlp_mult * dim) ** -0.5,
         })
     return params
@@ -64,25 +67,39 @@ def _ln(x, p):
     return (x - mu) * jax.lax.rsqrt(var + 1e-5) * p["g"] + p["b"]
 
 
-def _block(h, blk, heads, attn_fn, compute_dtype):
-    B, T, D = h.shape
-    hd = D // heads
+def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None):
+    """One pre-LN block. With ``psum_axis`` the block runs Megatron-style
+    tensor parallel under shard_map: qkv/mlp_in arrive sharded on their
+    OUTPUT feature dim (this device computes heads/k heads and hidden/k
+    MLP units), proj/mlp_out on their INPUT dim, and the two row-parallel
+    matmuls' partial products are psum'd before each residual add —
+    activations stay replicated, two collectives per block."""
+    B, T, _ = h.shape
+    tp = 1 if psum_axis is None else jax.lax.axis_size(psum_axis)
+    local_heads = heads // tp
     x = _ln(h, blk["ln1"]).astype(compute_dtype)
-    qkv = x @ blk["qkv"].astype(compute_dtype)
-    q, k, v = jnp.split(qkv.astype(jnp.float32), 3, axis=-1)
-    q = q.reshape(B, T, heads, hd)
-    k = k.reshape(B, T, heads, hd)
-    v = v.reshape(B, T, heads, hd)
-    a = attn_fn(q, k, v).reshape(B, T, D)
-    h = h + (a.astype(compute_dtype)
-             @ blk["proj"].astype(compute_dtype)).astype(jnp.float32)
+    qkv = jnp.einsum("btd,dce->btce", x, blk["qkv"].astype(compute_dtype))
+    q, k, v = (qkv[:, :, i].astype(jnp.float32) for i in range(3))
+    hd = q.shape[-1] // local_heads
+    q = q.reshape(B, T, local_heads, hd)
+    k = k.reshape(B, T, local_heads, hd)
+    v = v.reshape(B, T, local_heads, hd)
+    a = attn_fn(q, k, v).reshape(B, T, -1)
+    att = (a.astype(compute_dtype)
+           @ blk["proj"].astype(compute_dtype)).astype(jnp.float32)
+    if psum_axis is not None:
+        att = jax.lax.psum(att, psum_axis)
+    h = h + att
     x = _ln(h, blk["ln2"]).astype(compute_dtype)
     x = jax.nn.gelu(x @ blk["mlp_in"].astype(compute_dtype))
-    h = h + (x @ blk["mlp_out"].astype(compute_dtype)).astype(jnp.float32)
-    return h
+    m = (x @ blk["mlp_out"].astype(compute_dtype)).astype(jnp.float32)
+    if psum_axis is not None:
+        m = jax.lax.psum(m, psum_axis)
+    return h + m
 
 
-def _forward(params, tokens, pos, heads, attn_fn, compute_dtype):
+def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
+             psum_axis=None):
     # static check: jax clamps out-of-range indices silently, so an
     # oversized sequence would reuse the last positional embedding row
     # for every tail position instead of erroring
@@ -92,7 +109,7 @@ def _forward(params, tokens, pos, heads, attn_fn, compute_dtype):
                          f"model's max_len {max_len}")
     h = params["tok_emb"][tokens] + params["pos_emb"][pos]
     for blk in params["blocks"]:
-        h = _block(h, blk, heads, attn_fn, compute_dtype)
+        h = _block(h, blk, heads, attn_fn, compute_dtype, psum_axis)
     h = _ln(h, params["ln_f"])
     # weight-tied head
     return (h.astype(compute_dtype)
@@ -125,6 +142,53 @@ def apply_sp(params, tokens_local, shift, *, heads=4, axis_name=DATA_AXIS,
         lambda q, k, v: ring_attention_local(q, k, v, axis_name=axis_name,
                                              causal=True),
         compute_dtype)
+
+
+def apply_tp(params, tokens, *, heads=4, axis_name="model",
+             compute_dtype=jnp.bfloat16):
+    """Megatron-style tensor-parallel logits — call INSIDE shard_map with
+    block weights sharded per ``tp_specs`` (qkv/mlp_in column-parallel,
+    proj/mlp_out row-parallel; embeddings/LN replicated). Activations are
+    replicated across the ``axis_name`` axis; two psums per block.
+
+    For training, take ``value_and_grad`` OUTSIDE the shard_map (of a loss
+    that closes over the shard_map call): shard_map's transpose inserts the
+    Megatron conjugate-operator reductions automatically. Raw local grads
+    taken inside would mis-reduce the replicated params
+    (tests/test_tensor_parallel.py::test_tp_composes_with_dp).
+    """
+    tp = jax.lax.axis_size(axis_name)
+    if heads % tp:
+        raise ValueError(f"heads {heads} not divisible by tensor-parallel "
+                         f"size {tp} (head-boundary sharding)")
+    T = tokens.shape[1]
+    return _forward(params, tokens, jnp.arange(T), heads,
+                    lambda q, k, v: reference_attention(q, k, v, causal=True),
+                    compute_dtype, psum_axis=axis_name)
+
+
+def tp_specs(params, axis_name="model"):
+    """PartitionSpec pytree for ``apply_tp``: shard each block's qkv and
+    mlp_in on their output feature dim, proj and mlp_out on their input
+    dim; replicate embeddings and layernorms."""
+    from jax.sharding import PartitionSpec as P
+
+    def one_block(blk):
+        return {
+            "ln1": jax.tree.map(lambda _: P(), blk["ln1"]),
+            "ln2": jax.tree.map(lambda _: P(), blk["ln2"]),
+            "qkv": P(None, None, axis_name),
+            "proj": P(axis_name, None),
+            "mlp_in": P(None, axis_name),
+            "mlp_out": P(axis_name, None),
+        }
+
+    return {
+        "tok_emb": P(),
+        "pos_emb": P(),
+        "ln_f": jax.tree.map(lambda _: P(), params["ln_f"]),
+        "blocks": [one_block(b) for b in params["blocks"]],
+    }
 
 
 def loss(params, batch, *, heads=4, compute_dtype=jnp.bfloat16):
