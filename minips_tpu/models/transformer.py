@@ -99,7 +99,7 @@ def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None):
 
 
 def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
-             psum_axis=None):
+             psum_axis=None, apply_blocks=None):
     # static check: jax clamps out-of-range indices silently, so an
     # oversized sequence would reuse the last positional embedding row
     # for every tail position instead of erroring
@@ -108,8 +108,13 @@ def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
         raise ValueError(f"sequence length {pos.shape[0]} exceeds the "
                          f"model's max_len {max_len}")
     h = params["tok_emb"][tokens] + params["pos_emb"][pos]
-    for blk in params["blocks"]:
-        h = _block(h, blk, heads, attn_fn, compute_dtype, psum_axis)
+    if apply_blocks is not None:
+        # parallel schedules (e.g. the GPipe pipeline) replace the
+        # sequential layer loop but share embedding/head/LN code
+        h = apply_blocks(h)
+    else:
+        for blk in params["blocks"]:
+            h = _block(h, blk, heads, attn_fn, compute_dtype, psum_axis)
     h = _ln(h, params["ln_f"])
     # weight-tied head
     return (h.astype(compute_dtype)
@@ -188,6 +193,54 @@ def tp_specs(params, axis_name="model"):
         "pos_emb": P(),
         "ln_f": jax.tree.map(lambda _: P(), params["ln_f"]),
         "blocks": [one_block(b) for b in params["blocks"]],
+    }
+
+
+def apply_pp(params, tokens, *, heads=4, axis_name="model",
+             num_microbatches=4, compute_dtype=jnp.bfloat16):
+    """GPipe pipeline-parallel logits — call INSIDE shard_map with
+    ``params["blocks"]`` STACKED (parallel/pipeline.stack_layers) and its
+    leading depth axis sharded over ``axis_name``; embeddings/LN
+    replicated (see ``pp_specs``). The batch splits into
+    ``num_microbatches`` that flow through the stages via ppermute.
+
+    Like ``apply_tp``, take grads OUTSIDE the shard_map.
+    """
+    from minips_tpu.parallel.pipeline import gpipe
+
+    B, T = tokens.shape
+    if B % num_microbatches:
+        raise ValueError(f"batch {B} not divisible into "
+                         f"{num_microbatches} microbatches")
+    blocks_local = params["blocks"]  # leading depth axis, local slice
+
+    def stage_fn(x):
+        def one(hc, blk):
+            return _block(hc, blk, heads,
+                          lambda q, k, v: reference_attention(
+                              q, k, v, causal=True),
+                          compute_dtype), None
+        return jax.lax.scan(one, x, blocks_local)[0]
+
+    def piped_blocks(h):
+        h_mb = h.reshape(num_microbatches, B // num_microbatches, T, -1)
+        return gpipe(stage_fn, h_mb, axis_name=axis_name).reshape(B, T, -1)
+
+    return _forward(params, tokens, jnp.arange(T), heads, None,
+                    compute_dtype, apply_blocks=piped_blocks)
+
+
+def pp_specs(params_stacked, axis_name="model"):
+    """PartitionSpec pytree for ``apply_pp``: shard every stacked block
+    leaf on its leading depth axis; replicate everything else."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "tok_emb": P(),
+        "pos_emb": P(),
+        "ln_f": jax.tree.map(lambda _: P(), params_stacked["ln_f"]),
+        "blocks": jax.tree.map(lambda _: P(axis_name),
+                               params_stacked["blocks"]),
     }
 
 

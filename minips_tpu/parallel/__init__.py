@@ -9,3 +9,8 @@ from minips_tpu.parallel.ring_attention import (  # noqa: F401
     make_ring_attention,
     ring_attention_local,
 )
+from minips_tpu.parallel.pipeline import (  # noqa: F401
+    gpipe,
+    stack_layers,
+    unstack_layers,
+)
