@@ -14,3 +14,8 @@ from minips_tpu.parallel.pipeline import (  # noqa: F401
     stack_layers,
     unstack_layers,
 )
+from minips_tpu.parallel.moe import (  # noqa: F401
+    init_moe,
+    moe_apply_dense,
+    moe_apply_local,
+)

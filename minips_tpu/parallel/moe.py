@@ -1,0 +1,138 @@
+"""Expert parallelism — Switch-style top-1 MoE FFN over a mesh axis.
+
+Beyond parity (the reference has no expert parallelism, SURVEY.md §2.2).
+Completes the framework's parallelism set (dp / sp ring attention / tp /
+pp / ep), all expressed the same way: shard_map over named mesh axes with
+explicit collectives.
+
+Mechanics (Switch Transformer shape, public recipe): a linear router picks
+each token's top-1 expert; tokens are packed into per-expert capacity
+slots (earliest-first, overflow dropped — the standard fixed-shape trick,
+since TPU programs need static shapes); an ``all_to_all`` ships slots to
+the devices that own the experts (``E`` experts sharded over the axis),
+each device runs its local experts' FFN on its slots, a second
+``all_to_all`` ships results back, and outputs are combined weighted by
+the router probability. Gradients flow through both all_to_alls and the
+dispatch/combine einsums; the router gets trained through the combine
+weights (straight-through on the top-1 choice, as in Switch).
+
+``moe_apply_dense`` is the unsharded oracle: identical numerics (including
+capacity drops) computed without collectives, used by tests and usable on
+one device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_moe(key, num_experts: int, dim: int, hidden: int):
+    """Router + stacked expert FFN weights ([E, ...] — shard dim 0 for EP)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = dim ** -0.5
+    return {
+        "router": jax.random.normal(k1, (dim, num_experts)) * scale_in,
+        "w_in": jax.random.normal(k2, (num_experts, dim, hidden)) * scale_in,
+        "w_out": jax.random.normal(k3, (num_experts, hidden, dim))
+                 * hidden ** -0.5,
+    }
+
+
+def _dispatch_combine(x, router_w, num_experts: int, capacity: int):
+    """Route [N, D] tokens: returns (dispatch [N, E, C] f32 one-hot,
+    combine [N, E, C] f32 prob-weighted, frac [E], mean_p [E]) — the last
+    two are the raw load-balancing statistics for ``_aux_loss``."""
+    logits = x @ router_w                              # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                # [N]
+    onehot = jax.nn.one_hot(expert, num_experts)       # [N, E]
+    # position of each token within its expert's queue (earliest-first)
+    pos = (jnp.cumsum(onehot, axis=0) * onehot).astype(jnp.int32) - 1
+    keep = (pos >= 0) & (pos < capacity)               # [N, E], -1 unrouted
+    slot = jax.nn.one_hot(pos, capacity)               # [N, E, C]
+    dispatch = slot * keep[..., None]
+    combine = dispatch * jnp.sum(probs * onehot, axis=-1)[:, None, None]
+    # Switch aux load-balancing statistics: fraction of tokens routed to
+    # each expert and mean router prob per expert. Returned raw (not yet
+    # combined) so the distributed path can pmean them BEFORE the product —
+    # mean-of-products would differ from the global loss.
+    frac = jnp.mean(onehot, axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    return dispatch, combine, frac, mean_p
+
+
+def _aux_loss(frac, mean_p, num_experts):
+    """E * sum_e(frac_e * mean_prob_e) — minimized at uniform routing."""
+    return num_experts * jnp.sum(frac * mean_p)
+
+
+def _expert_ffn(w_in, w_out, x, compute_dtype):
+    """x: [E_local, C', D] through each local expert's GELU MLP."""
+    h = jax.nn.gelu(jnp.einsum(
+        "ecd,edh->ech", x.astype(compute_dtype), w_in.astype(compute_dtype)))
+    return jnp.einsum("ech,ehd->ecd", h,
+                      w_out.astype(compute_dtype)).astype(jnp.float32)
+
+
+def moe_apply_dense(params, x, *, capacity: int,
+                    compute_dtype=jnp.bfloat16):
+    """Unsharded oracle: [N, D] -> ([N, D], aux_loss). Matches the
+    distributed path exactly whenever capacity does not bind; when it
+    does, drop patterns differ (one global queue per expert here vs one
+    queue per (expert, source device) there)."""
+    E = params["router"].shape[1]
+    dispatch, combine, frac, mean_p = _dispatch_combine(
+        x, params["router"], E, capacity)
+    slots = jnp.einsum("nec,nd->ecd", dispatch, x)     # [E, C, D]
+    out_slots = _expert_ffn(params["w_in"], params["w_out"], slots,
+                            compute_dtype)
+    return (jnp.einsum("nec,ecd->nd", combine, out_slots),
+            _aux_loss(frac, mean_p, E))
+
+
+def moe_apply_local(params_local, x_local, *, axis_name: str,
+                    capacity: int, compute_dtype=jnp.bfloat16):
+    """Expert-parallel MoE — call INSIDE shard_map with tokens sharded
+    [N_local, D] over ``axis_name``, router replicated, and w_in/w_out
+    sharded on their expert dim (``ep_specs``). ``capacity`` is per-expert
+    per-source-device. Returns ([N_local, D], aux_loss pmean'd).
+
+    Like the other parallel schedules, take grads OUTSIDE the shard_map.
+    """
+    k = jax.lax.axis_size(axis_name)
+    E = params_local["router"].shape[1]
+    e_local = params_local["w_in"].shape[0]
+    if e_local * k != E:
+        raise ValueError(f"router knows {E} experts but {k} devices hold "
+                         f"{e_local} each")
+    dispatch, combine, frac, mean_p = _dispatch_combine(
+        x_local, params_local["router"], E, capacity)
+    slots = jnp.einsum("nec,nd->ecd", dispatch, x_local)   # [E, C, D]
+    # ship: expert block e_blk of every device -> device owning those
+    # experts; receive my experts' slots from every source device
+    slots = slots.reshape(k, e_local, capacity, -1)
+    recv = jax.lax.all_to_all(slots, axis_name, split_axis=0,
+                              concat_axis=0, tiled=False)  # [k, eL, C, D]
+    # fold source-device axis into the slot axis for the local FFN
+    mine = recv.transpose(1, 0, 2, 3).reshape(e_local, k * capacity, -1)
+    out = _expert_ffn(params_local["w_in"], params_local["w_out"], mine,
+                      compute_dtype)
+    # ship results back along the inverse route
+    out = out.reshape(e_local, k, capacity, -1).transpose(1, 0, 2, 3)
+    back = jax.lax.all_to_all(out, axis_name, split_axis=0,
+                              concat_axis=0, tiled=False)  # [k, eL, C, D]
+    out_slots = back.reshape(E, capacity, -1)
+    y = jnp.einsum("nec,ecd->nd", combine, out_slots)
+    # global aux loss: average the statistics across shards BEFORE the
+    # product so it equals the dense oracle's loss exactly
+    aux = _aux_loss(jax.lax.pmean(frac, axis_name),
+                    jax.lax.pmean(mean_p, axis_name), E)
+    return y, aux
+
+
+def ep_specs(axis_name: str = "data"):
+    """PartitionSpec pytree for ``moe_apply_local``'s params."""
+    from jax.sharding import PartitionSpec as P
+
+    return {"router": P(), "w_in": P(axis_name), "w_out": P(axis_name)}
