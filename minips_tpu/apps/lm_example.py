@@ -1,17 +1,25 @@
-"""lm_example — decoder-only LM with optional sequence parallelism.
+"""lm_example — decoder-only LM across the framework's parallel layouts.
 
 Beyond-parity app (the reference has no attention models, SURVEY.md §2.2):
-demonstrates the framework's long-context path end-to-end. Two layouts:
+demonstrates the long-context/model-parallel paths end-to-end. Layouts:
 
 - ``--layout dp``  (default): batch sharded over the mesh ``data`` axis,
-  full attention per shard — ordinary data parallelism.
+  full attention per shard — ordinary data parallelism through the
+  DenseTable fused PS step.
 - ``--layout sp``: BATCH REPLICATED, SEQUENCE sharded over the same axis —
   causal ring attention (K/V rotate over ppermute), positional embeddings
   offset per shard. Identical numerics to dp (tests prove grad parity);
   per-device activation memory scales as T/N, so sequences that cannot fit
-  one device train anyway.
+  one device train anyway. Also a DenseTable fused step.
+- ``--layout tp``: 2D mesh (data x model) — batch over ``data``, block
+  weights Megatron-sharded over ``model`` (``--tp`` ranks); optimizer
+  state sharded like the weights (weight-update sharding, the PS server
+  role distributed per-tensor instead of per-key-range).
+- ``--layout pp``: 2D mesh — batch over ``data``, layers GPipe-pipelined
+  over ``model`` (``--tp`` stages, ``--microbatches`` in flight).
 
 Usage: python -m minips_tpu.apps.lm_example --num_iters 200 --layout sp
+       python -m minips_tpu.apps.lm_example --layout tp --tp 2
 """
 
 from __future__ import annotations
@@ -42,15 +50,23 @@ MODEL = dict(vocab=256, dim=64, heads=4, depth=2, max_len=1024)
 
 
 def _flags(parser):
-    parser.add_argument("--layout", default="dp", choices=["dp", "sp"],
+    parser.add_argument("--layout", default="dp",
+                        choices=["dp", "sp", "tp", "pp"],
                         help="dp: batch sharded; sp: sequence sharded "
-                             "(ring attention)")
+                             "(ring attention); tp: Megatron tensor "
+                             "parallel; pp: GPipe pipeline")
     parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--tp", type=int, default=2,
+                        help="model-axis size for tp/pp layouts")
+    parser.add_argument("--microbatches", type=int, default=4,
+                        help="pp layout: microbatches in flight")
 
 
 def run(cfg: Config, args, metrics) -> dict:
     seq_len = getattr(args, "seq_len", 128)
     layout = getattr(args, "layout", "dp")
+    if layout in ("tp", "pp"):
+        return _run_model_parallel(cfg, args, metrics, layout, seq_len)
     mesh = make_mesh()
     n_shards = mesh.shape[DATA_AXIS]
     if seq_len % n_shards:
@@ -114,6 +130,97 @@ def run(cfg: Config, args, metrics) -> dict:
     metrics.log(final_loss=losses[-1], layout=layout, seq_len=seq_len,
                 tokens_per_sec=loop.timer.samples_per_sec * seq_len)
     return {"losses": losses, "table": table, "layout": layout,
+            "samples_per_sec": loop.timer.samples_per_sec}
+
+
+def _run_model_parallel(cfg, args, metrics, layout, seq_len) -> dict:
+    """tp/pp layouts: 2D (data x model) mesh, weights + optimizer state
+    sharded over the model axis (per-tensor weight-update sharding),
+    value_and_grad outside the shard_map, optax step under one jit."""
+    import optax
+
+    from minips_tpu.parallel.mesh import MODEL_AXIS
+    from minips_tpu.parallel.pipeline import stack_layers
+
+    tp_size = getattr(args, "tp", 2)
+    micro = getattr(args, "microbatches", 4)
+    n_dev = len(jax.devices())
+    if n_dev % tp_size:
+        raise SystemExit(f"--tp {tp_size} must divide {n_dev} devices")
+    mesh = make_mesh(n_dev // tp_size, model_size=tp_size)
+    heads = MODEL["heads"]
+    if seq_len > MODEL["max_len"]:
+        raise SystemExit(f"--seq_len {seq_len} exceeds max_len")
+    if layout == "tp" and heads % tp_size:
+        raise SystemExit(f"--tp {tp_size} must divide heads {heads}")
+    if layout == "pp" and MODEL["depth"] % tp_size:
+        raise SystemExit(f"--tp {tp_size} must divide depth "
+                         f"{MODEL['depth']} (pipeline stages)")
+    data_shards = n_dev // tp_size
+    if cfg.train.batch_size % data_shards:
+        raise SystemExit(f"--batch_size {cfg.train.batch_size} must divide "
+                         f"by the {data_shards}-way data axis")
+    local_b = cfg.train.batch_size // data_shards
+    if layout == "pp" and local_b % micro:
+        raise SystemExit(
+            f"--microbatches {micro} must divide the per-device batch "
+            f"{local_b} (= --batch_size {cfg.train.batch_size} / "
+            f"{data_shards} data shards)")
+
+    params = tfm.init(jax.random.PRNGKey(cfg.train.seed), **MODEL)
+    if layout == "pp":
+        params = {**params, "blocks": stack_layers(params["blocks"])}
+        specs = tfm.pp_specs(params, MODEL_AXIS)
+    else:
+        specs = tfm.tp_specs(params, MODEL_AXIS)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    params = jax.tree.map(jax.device_put, params, shardings)
+
+    def sharded_loss(p, toks):
+        def shard_fn(p_, t_):
+            if layout == "pp":
+                logits = tfm.apply_pp(p_, t_[:, :-1], heads=heads,
+                                      axis_name=MODEL_AXIS,
+                                      num_microbatches=micro)
+            else:
+                logits = tfm.apply_tp(p_, t_[:, :-1], heads=heads,
+                                      axis_name=MODEL_AXIS)
+            return jax.lax.pmean(tfm.nll(logits, t_[:, 1:]), DATA_AXIS)
+        return jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(specs, P(DATA_AXIS)), out_specs=P())(p, toks)
+
+    tx = optax.adam(cfg.table.lr)
+    opt = tx.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, o, toks):
+        loss, g = jax.value_and_grad(sharded_loss)(p, toks)
+        updates, o = tx.update(g, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    data = synthetic.lm_sequences(2048, seq_len, MODEL["vocab"],
+                                  seed=cfg.train.seed)
+    batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    state = {"p": params, "o": opt}
+
+    def do_step(batch):
+        toks = jax.device_put(jnp.asarray(batch["tokens"]), batch_sharding)
+        state["p"], state["o"], loss = train_step(state["p"], state["o"],
+                                                  toks)
+        return loss
+
+    batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
+    loop = TrainLoop(do_step, batches, metrics=metrics,
+                     log_every=cfg.train.log_every,
+                     batch_size=cfg.train.batch_size)
+    losses = loop.run(cfg.train.num_iters)
+    metrics.log(final_loss=losses[-1], layout=layout, seq_len=seq_len,
+                tp=tp_size, tokens_per_sec=loop.timer.samples_per_sec
+                * seq_len)
+    return {"losses": losses, "params": state["p"], "layout": layout,
             "samples_per_sec": loop.timer.samples_per_sec}
 
 

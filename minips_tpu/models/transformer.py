@@ -244,14 +244,20 @@ def pp_specs(params_stacked, axis_name="model"):
     }
 
 
+def nll(logits, targets):
+    """Mean next-token negative log-likelihood — the one cross-entropy
+    shared by every layout (full/sp/tp/pp)."""
+    logp = jax.nn.log_softmax(logits)
+    return jnp.mean(
+        -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0])
+
+
 def loss(params, batch, *, heads=4, compute_dtype=jnp.bfloat16):
     """Next-token cross-entropy; batch = {"tokens": [B, T+1] int32}."""
     toks = batch["tokens"]
     logits = apply(params, toks[:, :-1], heads=heads,
                    compute_dtype=compute_dtype)
-    logp = jax.nn.log_softmax(logits)
-    nll = -jnp.take_along_axis(logp, toks[:, 1:, None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return nll(logits, toks[:, 1:])
 
 
 def grad_fn(params, batch, *, heads=4):
@@ -273,9 +279,7 @@ def loss_sp(params, tokens_local, targets_local, shift, *, heads=4,
     """
     logits = apply_sp(params, tokens_local, shift, heads=heads,
                       axis_name=axis_name, compute_dtype=compute_dtype)
-    logp = jax.nn.log_softmax(logits)
-    nll = -jnp.take_along_axis(logp, targets_local[..., None], axis=-1)[..., 0]
-    local = jnp.mean(nll)
+    local = nll(logits, targets_local)
     if reduce == "local":
         return local
     return jax.lax.pmean(local, axis_name)

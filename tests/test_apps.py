@@ -55,3 +55,26 @@ def test_lr_dense_from_libsvm_file(tmp_path):
     losses = out["losses"]
     assert np.isfinite(losses).all()
     assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_lm_example_all_layouts():
+    """The LM app trains under every parallel layout (dp / sp ring
+    attention / tp Megatron / pp GPipe) and the loss trajectories agree —
+    layouts change the schedule, not the math."""
+    from minips_tpu.apps import lm_example as app
+
+    cfg = Config(
+        table=TableConfig(name="lm", kind="dense", updater="adam", lr=3e-3),
+        train=TrainConfig(batch_size=16, num_iters=12, log_every=100),
+    )
+    finals = {}
+    for layout in ("dp", "sp", "tp", "pp"):
+        metrics = MetricsLogger(None, verbose=False)
+        out = app.run(cfg, _args(layout=layout, seq_len=32, tp=2,
+                                 microbatches=2), metrics)
+        losses = out["losses"]
+        assert np.isfinite(losses).all(), layout
+        assert losses[-1] < losses[0], (layout, losses[:3], losses[-3:])
+        finals[layout] = losses[-1]
+    spread = max(finals.values()) - min(finals.values())
+    assert spread < 0.05, finals
