@@ -20,32 +20,16 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <vector>
+
+#include "reader_common.h"
+
+using minips::FileBuf;
 
 namespace {
 
 constexpr int kDense = 13;
 constexpr int kCat = 26;
-
-struct FileBuf {
-  char* data = nullptr;
-  size_t size = 0;
-  bool ok = false;
-  explicit FileBuf(const char* path) {
-    FILE* f = std::fopen(path, "rb");
-    if (!f) return;
-    std::fseek(f, 0, SEEK_END);
-    long n = std::ftell(f);
-    std::fseek(f, 0, SEEK_SET);
-    if (n < 0) { std::fclose(f); return; }
-    data = static_cast<char*>(std::malloc(static_cast<size_t>(n) + 1));
-    if (!data) { std::fclose(f); return; }
-    size = std::fread(data, 1, static_cast<size_t>(n), f);
-    data[size] = '\0';
-    std::fclose(f);
-    ok = true;
-  }
-  ~FileBuf() { std::free(data); }
-};
 
 // Parse a decimal int field ending at tab/newline; empty → missing.
 // On failure p is left UNMOVED so the caller's garbage check (*p != '\t')
@@ -97,43 +81,14 @@ inline void skip_tab(const char*& p, const char* line_end) {
   if (p < line_end && *p == '\t') ++p;
 }
 
-}  // namespace
-
-extern "C" {
-
-// Returns 0 on success; fills n_rows (non-empty lines).
-int criteo_count(const char* path, int64_t* n_rows) {
-  FileBuf fb(path);
-  if (!fb.ok) return 1;
-  int64_t rows = 0;
-  const char* p = fb.data;
-  const char* endp = fb.data + fb.size;
-  while (p < endp) {
-    const char* line_end = static_cast<const char*>(
-        std::memchr(p, '\n', static_cast<size_t>(endp - p)));
-    if (!line_end) line_end = endp;
-    if (line_end > p && !(line_end == p + 1 && *p == '\r')) ++rows;
-    p = line_end + 1;
-  }
-  *n_rows = rows;
-  return 0;
-}
-
-// Fills y[N], dense[N*13], dense_mask[N*13], cat[N*26].
-// Returns 0 ok, 1 unreadable, 2 row-count mismatch, 3 malformed field —
-// strict like the pure-Python oracle (which raises on garbage tokens), so
-// the native fast path never silently trains on corrupted rows.
-int criteo_parse(const char* path, int64_t n_rows, float* y, float* dense,
-                 float* dense_mask, int64_t* cat) {
-  FileBuf fb(path);
-  if (!fb.ok) return 1;
-  std::memset(dense, 0, sizeof(float) * static_cast<size_t>(n_rows * kDense));
-  std::memset(dense_mask, 0,
-              sizeof(float) * static_cast<size_t>(n_rows * kDense));
-  const char* p = fb.data;
-  const char* endp = fb.data + fb.size;
+// Parse whole lines in [p, endp); writes up to max_rows rows starting at
+// row 0 of the given output pointers; *rows_done reports how many rows the
+// range actually held. Returns 0 ok / 3 malformed.
+int parse_criteo_range(const char* p, const char* endp, int64_t max_rows,
+                       float* y, float* dense, float* dense_mask,
+                       int64_t* cat, int64_t* rows_done) {
   int64_t r = 0;
-  while (p < endp && r < n_rows) {
+  while (p < endp && r < max_rows) {
     const char* line_end = static_cast<const char*>(
         std::memchr(p, '\n', static_cast<size_t>(endp - p)));
     if (!line_end) line_end = endp;
@@ -157,7 +112,7 @@ int criteo_parse(const char* path, int64_t n_rows, float* y, float* dense,
       for (int f = 0; f < kCat; ++f) {
         uint32_t v = 0;
         int ndigits = 0;
-        parse_hex_field(p, eol, &v, &ndigits);  // missing → 0 in the space
+        parse_hex_field(p, eol, &v, &ndigits);  // missing -> 0 in the space
         if (ndigits > 8) return 3;            // would wrap uint32 silently
         if (p < eol && *p != '\t') return 3;  // non-hex byte in field
         cat[r * kCat + f] =
@@ -168,7 +123,89 @@ int criteo_parse(const char* path, int64_t n_rows, float* y, float* dense,
     }
     p = line_end + 1;
   }
-  return r == n_rows ? 0 : 2;
+  *rows_done = r;
+  return 0;
+}
+
+int64_t count_rows_range(const char* p, const char* endp) {
+  int64_t rows = 0;
+  while (p < endp) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(endp - p)));
+    if (!line_end) line_end = endp;
+    if (line_end > p && !(line_end == p + 1 && *p == '\r')) ++rows;
+    p = line_end + 1;
+  }
+  return rows;
+}
+
+}  // namespace
+
+extern "C" {
+
+int criteo_parse_mt(const char* path, int64_t n_rows, float* y,
+                    float* dense, float* dense_mask, int64_t* cat,
+                    int n_threads);
+
+// Returns 0 on success; fills n_rows (non-empty lines).
+int criteo_count(const char* path, int64_t* n_rows) {
+  FileBuf fb(path);
+  if (!fb.ok) return 1;
+  *n_rows = count_rows_range(fb.data, fb.data + fb.size);
+  return 0;
+}
+
+// Fills y[N], dense[N*13], dense_mask[N*13], cat[N*26].
+// Returns 0 ok, 1 unreadable, 2 row-count mismatch, 3 malformed field —
+// strict like the pure-Python oracle (which raises on garbage tokens), so
+// the native fast path never silently trains on corrupted rows.
+int criteo_parse(const char* path, int64_t n_rows, float* y, float* dense,
+                 float* dense_mask, int64_t* cat) {
+  return criteo_parse_mt(path, n_rows, y, dense, dense_mask, cat, 1);
+}
+
+// Multi-threaded variant: the file is split into line-aligned chunks, row
+// offsets come from a parallel counting pass, then chunks parse in
+// parallel into disjoint output slices. Same strict error codes.
+int criteo_parse_mt(const char* path, int64_t n_rows, float* y, float* dense,
+                    float* dense_mask, int64_t* cat, int n_threads) {
+  FileBuf fb(path);
+  if (!fb.ok) return 1;
+  std::memset(dense, 0, sizeof(float) * static_cast<size_t>(n_rows * kDense));
+  std::memset(dense_mask, 0,
+              sizeof(float) * static_cast<size_t>(n_rows * kDense));
+  int T = minips::clamp_threads(n_threads);
+  if (T == 1) {  // true single scan: no offset pass needed
+    int64_t done = 0;
+    int rc = parse_criteo_range(fb.data, fb.data + fb.size, n_rows, y,
+                                dense, dense_mask, cat, &done);
+    return rc ? rc : (done == n_rows ? 0 : 2);
+  }
+  std::vector<const char*> b = minips::line_chunks(fb.data, fb.size, T);
+  std::vector<int64_t> counts(static_cast<size_t>(T), 0);
+  minips::parallel_for(T, [&](int i) {
+    counts[static_cast<size_t>(i)] = count_rows_range(b[i], b[i + 1]);
+  });
+  std::vector<int64_t> offs(static_cast<size_t>(T) + 1, 0);
+  for (int i = 0; i < T; ++i)
+    offs[static_cast<size_t>(i) + 1] =
+        offs[static_cast<size_t>(i)] + counts[static_cast<size_t>(i)];
+  if (offs[static_cast<size_t>(T)] != n_rows) return 2;
+  std::vector<int> rcs(static_cast<size_t>(T), 0);
+  minips::parallel_for(T, [&](int i) {
+    int64_t off = offs[static_cast<size_t>(i)];
+    int64_t done = 0;
+    rcs[static_cast<size_t>(i)] = parse_criteo_range(
+        b[i], b[i + 1], counts[static_cast<size_t>(i)], y + off,
+        dense + off * kDense, dense_mask + off * kDense, cat + off * kCat,
+        &done);
+    if (rcs[static_cast<size_t>(i)] == 0 &&
+        done != counts[static_cast<size_t>(i)])
+      rcs[static_cast<size_t>(i)] = 2;
+  });
+  for (int i = 0; i < T; ++i)
+    if (rcs[static_cast<size_t>(i)]) return rcs[static_cast<size_t>(i)];
+  return 0;
 }
 
 }  // extern "C"

@@ -9,6 +9,7 @@
 //   pass 1: libsvm_count()  -> rows + max features/row
 //   pass 2: libsvm_parse()  -> fills caller-allocated padded arrays
 //           y[N], idx[N*W], val[N*W], mask[N*W]  (row-major, zero padded)
+// libsvm_parse_mt() parallelizes pass 2 over line-aligned chunks.
 // Parsing is hand-rolled (no iostream/sscanf): one linear scan, no
 // allocation per token.
 
@@ -18,28 +19,11 @@
 #include <cstring>
 #include <vector>
 
-namespace {
+#include "reader_common.h"
 
-struct FileBuf {
-  char* data = nullptr;
-  size_t size = 0;
-  bool ok = false;
-  explicit FileBuf(const char* path) {
-    FILE* f = std::fopen(path, "rb");
-    if (!f) return;
-    std::fseek(f, 0, SEEK_END);
-    long n = std::ftell(f);
-    std::fseek(f, 0, SEEK_SET);
-    if (n < 0) { std::fclose(f); return; }
-    data = static_cast<char*>(std::malloc(static_cast<size_t>(n) + 1));
-    if (!data) { std::fclose(f); return; }
-    size = std::fread(data, 1, static_cast<size_t>(n), f);
-    data[size] = '\0';
-    std::fclose(f);
-    ok = true;
-  }
-  ~FileBuf() { std::free(data); }
-};
+using minips::FileBuf;
+
+namespace {
 
 inline const char* skip_ws(const char* p) {
   while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
@@ -61,17 +45,10 @@ inline long parse_long(const char*& p) {
   return v;
 }
 
-}  // namespace
-
-extern "C" {
-
-// Returns 0 on success; fills n_rows and max_width (max nnz on any row).
-int libsvm_count(const char* path, int64_t* n_rows, int64_t* max_width) {
-  FileBuf fb(path);
-  if (!fb.ok) return 1;
+// rows + max nnz width over whole lines in [p, endp).
+void count_range(const char* p, const char* endp, int64_t* n_rows,
+                 int64_t* max_width) {
   int64_t rows = 0, maxw = 0;
-  const char* p = fb.data;
-  const char* endp = fb.data + fb.size;
   while (p < endp) {
     const char* line_end = static_cast<const char*>(
         std::memchr(p, '\n', static_cast<size_t>(endp - p)));
@@ -88,30 +65,37 @@ int libsvm_count(const char* path, int64_t* n_rows, int64_t* max_width) {
   }
   *n_rows = rows;
   *max_width = maxw;
-  return 0;
 }
 
-// Fills y[N], idx[N*W], val[N*W], mask[N*W]; width W truncates longer rows.
-// Labels in {-1,1} are normalized to {0,1}; other labels pass through.
-int libsvm_parse(const char* path, int64_t n_rows, int64_t width,
-                 float* y, int32_t* idx, float* val, float* mask) {
-  FileBuf fb(path);
-  if (!fb.ok) return 1;
-  std::memset(idx, 0, sizeof(int32_t) * static_cast<size_t>(n_rows * width));
-  std::memset(val, 0, sizeof(float) * static_cast<size_t>(n_rows * width));
-  std::memset(mask, 0, sizeof(float) * static_cast<size_t>(n_rows * width));
-  const char* p = fb.data;
-  const char* endp = fb.data + fb.size;
+// rows only — the cheap (memchr + whitespace) pass the MT offset
+// computation needs; no per-byte ':' tokenization.
+int64_t count_rows_only(const char* p, const char* endp) {
+  int64_t rows = 0;
+  while (p < endp) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(endp - p)));
+    if (!line_end) line_end = endp;
+    if (skip_ws(p) < line_end) ++rows;
+    p = line_end + 1;
+  }
+  return rows;
+}
+
+// Parse whole lines in [p, endp) into row-0-based outputs; reports rows
+// written and whether any label was negative (the {-1,1} convention —
+// normalization is a global post-pass, it cannot run per chunk).
+int64_t parse_range(const char* p, const char* endp, int64_t max_rows,
+                    int64_t width, float* y, int32_t* idx, float* val,
+                    float* mask, bool* saw_negative_label) {
   int64_t r = 0;
-  bool saw_negative_label = false;
-  while (p < endp && r < n_rows) {
+  while (p < endp && r < max_rows) {
     const char* line_end = static_cast<const char*>(
         std::memchr(p, '\n', static_cast<size_t>(endp - p)));
     if (!line_end) line_end = endp;
     p = skip_ws(p);
     if (p < line_end) {
       float label = parse_float(p);
-      if (label < 0.0f) saw_negative_label = true;
+      if (label < 0.0f) *saw_negative_label = true;
       y[r] = label;
       int64_t c = 0;
       while (p < line_end && c < width) {
@@ -131,10 +115,82 @@ int libsvm_parse(const char* path, int64_t n_rows, int64_t width,
     }
     p = line_end + 1;
   }
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+int libsvm_parse_mt(const char* path, int64_t n_rows, int64_t width,
+                    float* y, int32_t* idx, float* val, float* mask,
+                    int n_threads);
+
+// Returns 0 on success; fills n_rows and max_width (max nnz on any row).
+int libsvm_count(const char* path, int64_t* n_rows, int64_t* max_width) {
+  FileBuf fb(path);
+  if (!fb.ok) return 1;
+  count_range(fb.data, fb.data + fb.size, n_rows, max_width);
+  return 0;
+}
+
+// Fills y[N], idx[N*W], val[N*W], mask[N*W]; width W truncates longer rows.
+// Labels in {-1,1} are normalized to {0,1}; other labels pass through.
+int libsvm_parse(const char* path, int64_t n_rows, int64_t width,
+                 float* y, int32_t* idx, float* val, float* mask) {
+  return libsvm_parse_mt(path, n_rows, width, y, idx, val, mask, 1);
+}
+
+// Multi-threaded variant: line-aligned chunks, parallel counting pass for
+// row offsets, parallel parse into disjoint slices, then the global
+// {-1,1} -> {0,1} label fixup.
+int libsvm_parse_mt(const char* path, int64_t n_rows, int64_t width,
+                    float* y, int32_t* idx, float* val, float* mask,
+                    int n_threads) {
+  FileBuf fb(path);
+  if (!fb.ok) return 1;
+  std::memset(idx, 0, sizeof(int32_t) * static_cast<size_t>(n_rows * width));
+  std::memset(val, 0, sizeof(float) * static_cast<size_t>(n_rows * width));
+  std::memset(mask, 0, sizeof(float) * static_cast<size_t>(n_rows * width));
+  int T = minips::clamp_threads(n_threads);
+  if (T == 1) {  // true single scan: no offset pass needed
+    bool saw_neg = false;
+    int64_t done = parse_range(fb.data, fb.data + fb.size, n_rows, width,
+                               y, idx, val, mask, &saw_neg);
+    if (saw_neg)
+      for (int64_t i = 0; i < n_rows; ++i) y[i] = y[i] > 0.0f ? 1.0f : 0.0f;
+    return done == n_rows ? 0 : 2;
+  }
+  std::vector<const char*> b = minips::line_chunks(fb.data, fb.size, T);
+  std::vector<int64_t> counts(static_cast<size_t>(T), 0);
+  minips::parallel_for(T, [&](int i) {
+    counts[static_cast<size_t>(i)] = count_rows_only(b[i], b[i + 1]);
+  });
+  std::vector<int64_t> offs(static_cast<size_t>(T) + 1, 0);
+  for (int i = 0; i < T; ++i)
+    offs[static_cast<size_t>(i) + 1] =
+        offs[static_cast<size_t>(i)] + counts[static_cast<size_t>(i)];
+  if (offs[static_cast<size_t>(T)] != n_rows) return 2;
+  std::vector<char> neg(static_cast<size_t>(T), 0);
+  std::vector<int64_t> done(static_cast<size_t>(T), 0);
+  minips::parallel_for(T, [&](int i) {
+    bool saw_neg = false;
+    int64_t off = offs[static_cast<size_t>(i)];
+    done[static_cast<size_t>(i)] = parse_range(
+        b[i], b[i + 1], counts[static_cast<size_t>(i)], width, y + off,
+        idx + off * width, val + off * width, mask + off * width, &saw_neg);
+    neg[static_cast<size_t>(i)] = saw_neg ? 1 : 0;
+  });
+  bool saw_negative_label = false;
+  for (int i = 0; i < T; ++i) {
+    if (done[static_cast<size_t>(i)] != counts[static_cast<size_t>(i)])
+      return 2;
+    if (neg[static_cast<size_t>(i)]) saw_negative_label = true;
+  }
   if (saw_negative_label) {  // {-1,1} -> {0,1} (a9a convention)
     for (int64_t i = 0; i < n_rows; ++i) y[i] = y[i] > 0.0f ? 1.0f : 0.0f;
   }
-  return r == n_rows ? 0 : 2;
+  return 0;
 }
 
 }  // extern "C"

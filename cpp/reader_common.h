@@ -1,0 +1,77 @@
+// Shared plumbing for the native readers: whole-file buffer, line-aligned
+// chunking, and a tiny parallel-for — the pieces that turn the single-scan
+// parsers into multi-threaded ones (SURVEY.md §7.4.4: the input pipeline
+// must keep a pod fed; parsing parallelizes embarrassingly once chunk
+// boundaries land on line starts).
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace minips {
+
+struct FileBuf {
+  char* data = nullptr;
+  size_t size = 0;
+  bool ok = false;
+  explicit FileBuf(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return;
+    std::fseek(f, 0, SEEK_END);
+    long n = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    if (n < 0) { std::fclose(f); return; }
+    data = static_cast<char*>(std::malloc(static_cast<size_t>(n) + 1));
+    if (!data) { std::fclose(f); return; }
+    size = std::fread(data, 1, static_cast<size_t>(n), f);
+    data[size] = '\0';
+    std::fclose(f);
+    ok = true;
+  }
+  ~FileBuf() { std::free(data); }
+  FileBuf(const FileBuf&) = delete;
+  FileBuf& operator=(const FileBuf&) = delete;
+};
+
+// n_chunks+1 boundaries into [data, data+size); every boundary except the
+// first sits just past a '\n', so chunks hold whole lines. Chunks may be
+// empty when lines are long relative to size/n_chunks.
+inline std::vector<const char*> line_chunks(const char* data, size_t size,
+                                            int n_chunks) {
+  std::vector<const char*> b;
+  b.reserve(static_cast<size_t>(n_chunks) + 1);
+  const char* endp = data + size;
+  b.push_back(data);
+  for (int i = 1; i < n_chunks; ++i) {
+    const char* target = data + size * static_cast<size_t>(i) /
+                                    static_cast<size_t>(n_chunks);
+    if (target < b.back()) target = b.back();
+    const char* nl = static_cast<const char*>(std::memchr(
+        target, '\n', static_cast<size_t>(endp - target)));
+    b.push_back(nl ? nl + 1 : endp);
+  }
+  b.push_back(endp);
+  return b;
+}
+
+template <typename Fn>
+inline void parallel_for(int n, Fn&& fn) {
+  if (n <= 1) { for (int i = 0; i < n; ++i) fn(i); return; }
+  std::vector<std::thread> ts;
+  ts.reserve(static_cast<size_t>(n));
+  for (int i = 0; i < n; ++i) ts.emplace_back([&fn, i] { fn(i); });
+  for (auto& t : ts) t.join();
+}
+
+inline int clamp_threads(int n_threads) {
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  if (hw <= 0) hw = 1;
+  if (n_threads <= 0) n_threads = hw;
+  return n_threads > 64 ? 64 : n_threads;
+}
+
+}  // namespace minips

@@ -78,14 +78,32 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.criteo_parse.restype = ctypes.c_int
         except AttributeError:
             lib.criteo_count = None
+        try:  # multi-threaded parse entry points (chunked, line-aligned);
+            # a stale .so predating them raises AttributeError here
+            lib.criteo_parse_mt.argtypes = (
+                list(lib.criteo_parse.argtypes) + [ctypes.c_int])
+            lib.criteo_parse_mt.restype = ctypes.c_int
+            lib.libsvm_parse_mt.argtypes = (
+                list(lib.libsvm_parse.argtypes) + [ctypes.c_int])
+            lib.libsvm_parse_mt.restype = ctypes.c_int
+        except AttributeError:
+            lib.criteo_parse_mt = None
+            lib.libsvm_parse_mt = None
         _lib = lib
         return _lib
 
 
-def read_libsvm_native(path: str,
-                       max_features: Optional[int] = None) -> Optional[dict]:
+def _num_threads(threads: Optional[int]) -> int:
+    if threads is not None:
+        return max(1, threads)
+    return min(os.cpu_count() or 1, 16)
+
+
+def read_libsvm_native(path: str, max_features: Optional[int] = None,
+                       threads: Optional[int] = None) -> Optional[dict]:
     """Native fast path for data.libsvm.read_libsvm. Returns None when the
-    library is unavailable (caller falls back to pure Python)."""
+    library is unavailable (caller falls back to pure Python). ``threads``
+    defaults to min(cpu_count, 16); 1 forces the single-scan path."""
     lib = _load()
     if lib is None:
         return None
@@ -101,15 +119,21 @@ def read_libsvm_native(path: str,
     idx = np.zeros((rows, width), np.int32)
     val = np.zeros((rows, width), np.float32)
     mask = np.zeros((rows, width), np.float32)
-    rc = lib.libsvm_parse(path.encode(), rows, width, y, idx, val, mask)
+    if getattr(lib, "libsvm_parse_mt", None) is not None:
+        rc = lib.libsvm_parse_mt(path.encode(), rows, width, y, idx, val,
+                                 mask, _num_threads(threads))
+    else:
+        rc = lib.libsvm_parse(path.encode(), rows, width, y, idx, val, mask)
     if rc != 0:
         raise ValueError(f"libsvm_parse failed with code {rc} on {path}")
     return {"y": y, "idx": idx, "val": val, "mask": mask}
 
 
-def read_criteo_native(path: str) -> Optional[dict]:
+def read_criteo_native(path: str,
+                       threads: Optional[int] = None) -> Optional[dict]:
     """Native fast path for data.criteo.read_criteo. Returns None when the
-    library is unavailable (caller falls back to pure Python)."""
+    library is unavailable (caller falls back to pure Python). ``threads``
+    defaults to min(cpu_count, 16); 1 forces the single-scan path."""
     from minips_tpu.data.criteo import NUM_CAT, NUM_DENSE
 
     lib = _load()
@@ -123,7 +147,11 @@ def read_criteo_native(path: str) -> Optional[dict]:
     dense = np.zeros((rows, NUM_DENSE), np.float32)
     dense_mask = np.zeros((rows, NUM_DENSE), np.float32)
     cat = np.zeros((rows, NUM_CAT), np.int64)
-    rc = lib.criteo_parse(path.encode(), rows, y, dense, dense_mask, cat)
+    if getattr(lib, "criteo_parse_mt", None) is not None:
+        rc = lib.criteo_parse_mt(path.encode(), rows, y, dense, dense_mask,
+                                 cat, _num_threads(threads))
+    else:
+        rc = lib.criteo_parse(path.encode(), rows, y, dense, dense_mask, cat)
     if rc != 0:
         raise ValueError(f"criteo_parse failed with code {rc} on {path}")
     return {"y": y, "dense": dense, "dense_mask": dense_mask, "cat": cat}

@@ -248,3 +248,49 @@ def test_native_reader_width_cap(tmp_path):
     np.testing.assert_array_equal(nat["y"], [1.0, 0.0])  # {-1,1}->{0,1}
     np.testing.assert_array_equal(nat["idx"][0], [1, 2])  # truncated at 2
     np.testing.assert_array_equal(nat["mask"][1], [1.0, 0.0])
+
+
+def test_native_mt_matches_single_thread(tmp_path):
+    """Multi-threaded chunked parse must be byte-identical to the
+    single-scan path on both formats, and the chunk seams (line-aligned
+    boundaries, per-chunk row offsets) must not duplicate or drop rows."""
+    from minips_tpu.data import native, synthetic
+    from minips_tpu.data.criteo import write_criteo
+    from minips_tpu.data.libsvm import write_libsvm
+
+    d = synthetic.criteo_like(4096, seed=7)
+    dense = np.round(np.abs(d["dense"]) * 5).astype(np.float32)
+    cpath = str(tmp_path / "c.tsv")
+    write_criteo(cpath, d["y"], dense, d["cat"])
+    one = native.read_criteo_native(cpath, threads=1)
+    if one is None:
+        pytest.skip("native lib unavailable")
+    many = native.read_criteo_native(cpath, threads=7)
+    for k in one:
+        np.testing.assert_array_equal(one[k], many[k], err_msg=k)
+
+    s = synthetic.classification_sparse(2048, dim=1000, seed=3)
+    lpath = str(tmp_path / "s.svm")
+    write_libsvm(lpath, s["y"], s["idx"], s["val"], s["mask"])
+    one = native.read_libsvm_native(lpath, threads=1)
+    many = native.read_libsvm_native(lpath, threads=5)
+    for k in one:
+        np.testing.assert_array_equal(one[k], many[k], err_msg=k)
+
+
+def test_native_mt_strict_on_malformed(tmp_path):
+    """A malformed field in ANY chunk must fail the whole multi-threaded
+    parse (same strictness as single-scan)."""
+    from minips_tpu.data import native, synthetic
+    from minips_tpu.data.criteo import write_criteo
+
+    d = synthetic.criteo_like(512, seed=8)
+    dense = np.round(np.abs(d["dense"]) * 5).astype(np.float32)
+    path = str(tmp_path / "bad.tsv")
+    write_criteo(path, d["y"], dense, d["cat"])
+    with open(path, "a") as f:
+        f.write("1\tnot_an_int" + "\t" * 38 + "\n")
+    if native._load() is None:
+        pytest.skip("native lib unavailable")
+    with pytest.raises(ValueError, match="code 3"):
+        native.read_criteo_native(path, threads=6)
