@@ -96,7 +96,14 @@ def _load() -> Optional[ctypes.CDLL]:
 def _num_threads(threads: Optional[int]) -> int:
     if threads is not None:
         return max(1, threads)
-    return min(os.cpu_count() or 1, 16)
+    env = os.environ.get("MINIPS_PARSE_THREADS")
+    if env:
+        return max(1, int(env))
+    # divide the machine between colocated launcher workers (the hostfile
+    # launcher starts several local processes at once; each would otherwise
+    # spin up cpu_count parse threads and thrash)
+    procs = max(1, int(os.environ.get("MINIPS_NUM_PROCS", "1") or 1))
+    return max(1, min(os.cpu_count() or 1, 16) // procs)
 
 
 def read_libsvm_native(path: str, max_features: Optional[int] = None,

@@ -67,13 +67,17 @@ def _ln(x, p):
     return (x - mu) * jax.lax.rsqrt(var + 1e-5) * p["g"] + p["b"]
 
 
-def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None):
+def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None,
+           ffn_fn=None):
     """One pre-LN block. With ``psum_axis`` the block runs Megatron-style
     tensor parallel under shard_map: qkv/mlp_in arrive sharded on their
     OUTPUT feature dim (this device computes heads/k heads and hidden/k
     MLP units), proj/mlp_out on their INPUT dim, and the two row-parallel
     matmuls' partial products are psum'd before each residual add —
-    activations stay replicated, two collectives per block."""
+    activations stay replicated, two collectives per block.
+
+    ``ffn_fn(blk, x_2d [B*T, D]) -> (y_2d, aux)`` replaces the dense MLP
+    (the MoE variant); the dense path reports aux 0. Returns (h, aux)."""
     B, T, _ = h.shape
     tp = 1 if psum_axis is None else jax.lax.axis_size(psum_axis)
     local_heads = heads // tp
@@ -90,16 +94,22 @@ def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None):
     if psum_axis is not None:
         att = jax.lax.psum(att, psum_axis)
     h = h + att
+    if ffn_fn is not None:
+        D = h.shape[-1]
+        y, aux = ffn_fn(blk, _ln(h, blk["ln2"]).reshape(B * T, D))
+        return h + y.reshape(B, T, D), aux
     x = _ln(h, blk["ln2"]).astype(compute_dtype)
     x = jax.nn.gelu(x @ blk["mlp_in"].astype(compute_dtype))
     m = (x @ blk["mlp_out"].astype(compute_dtype)).astype(jnp.float32)
     if psum_axis is not None:
         m = jax.lax.psum(m, psum_axis)
-    return h + m
+    return h + m, 0.0
 
 
 def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
-             psum_axis=None, apply_blocks=None):
+             psum_axis=None, apply_blocks=None, ffn_fn=None):
+    """Returns (logits, total aux loss) — aux is nonzero only for MoE
+    ``ffn_fn`` blocks; the plain ``apply*`` wrappers drop it."""
     # static check: jax clamps out-of-range indices silently, so an
     # oversized sequence would reuse the last positional embedding row
     # for every tail position instead of erroring
@@ -108,17 +118,21 @@ def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
         raise ValueError(f"sequence length {pos.shape[0]} exceeds the "
                          f"model's max_len {max_len}")
     h = params["tok_emb"][tokens] + params["pos_emb"][pos]
+    aux_total = 0.0
     if apply_blocks is not None:
         # parallel schedules (e.g. the GPipe pipeline) replace the
         # sequential layer loop but share embedding/head/LN code
         h = apply_blocks(h)
     else:
         for blk in params["blocks"]:
-            h = _block(h, blk, heads, attn_fn, compute_dtype, psum_axis)
+            h, aux = _block(h, blk, heads, attn_fn, compute_dtype,
+                            psum_axis, ffn_fn)
+            aux_total = aux_total + aux
     h = _ln(h, params["ln_f"])
     # weight-tied head
-    return (h.astype(compute_dtype)
-            @ params["tok_emb"].T.astype(compute_dtype)).astype(jnp.float32)
+    logits = (h.astype(compute_dtype)
+              @ params["tok_emb"].T.astype(compute_dtype)).astype(jnp.float32)
+    return logits, aux_total
 
 
 def apply(params, tokens, *, heads=4, compute_dtype=jnp.bfloat16):
@@ -128,7 +142,7 @@ def apply(params, tokens, *, heads=4, compute_dtype=jnp.bfloat16):
     T = tokens.shape[1]
     return _forward(params, tokens, jnp.arange(T), heads,
                     lambda q, k, v: reference_attention(q, k, v, causal=True),
-                    compute_dtype)
+                    compute_dtype)[0]
 
 
 def apply_sp(params, tokens_local, shift, *, heads=4, axis_name=DATA_AXIS,
@@ -146,7 +160,7 @@ def apply_sp(params, tokens_local, shift, *, heads=4, axis_name=DATA_AXIS,
         params, tokens_local, pos, heads,
         lambda q, k, v: ring_attention_local(q, k, v, axis_name=axis_name,
                                              causal=True),
-        compute_dtype)
+        compute_dtype)[0]
 
 
 def apply_tp(params, tokens, *, heads=4, axis_name="model",
@@ -169,7 +183,7 @@ def apply_tp(params, tokens, *, heads=4, axis_name="model",
     T = tokens.shape[1]
     return _forward(params, tokens, jnp.arange(T), heads,
                     lambda q, k, v: reference_attention(q, k, v, causal=True),
-                    compute_dtype, psum_axis=axis_name)
+                    compute_dtype, psum_axis=axis_name)[0]
 
 
 def tp_specs(params, axis_name="model"):
@@ -216,10 +230,11 @@ def apply_pp(params, tokens, *, heads=4, axis_name="model",
 
     def stage_fn(x):
         def one(hc, blk):
-            return _block(hc, blk, heads,
-                          lambda q, k, v: reference_attention(
-                              q, k, v, causal=True),
-                          compute_dtype), None
+            h2, _ = _block(hc, blk, heads,
+                           lambda q, k, v: reference_attention(
+                               q, k, v, causal=True),
+                           compute_dtype)
+            return h2, None
         return jax.lax.scan(one, x, blocks_local)[0]
 
     def piped_blocks(h):
@@ -227,7 +242,7 @@ def apply_pp(params, tokens, *, heads=4, axis_name="model",
         return gpipe(stage_fn, h_mb, axis_name=axis_name).reshape(B, T, -1)
 
     return _forward(params, tokens, jnp.arange(T), heads, None,
-                    compute_dtype, apply_blocks=piped_blocks)
+                    compute_dtype, apply_blocks=piped_blocks)[0]
 
 
 def pp_specs(params_stacked, axis_name="model"):
@@ -241,6 +256,81 @@ def pp_specs(params_stacked, axis_name="model"):
         "ln_f": jax.tree.map(lambda _: P(), params_stacked["ln_f"]),
         "blocks": jax.tree.map(lambda _: P(axis_name),
                                params_stacked["blocks"]),
+    }
+
+
+def init_moe_lm(key, *, vocab: int = 256, dim: int = 64, heads: int = 4,
+                depth: int = 2, max_len: int = 1024, num_experts: int = 8,
+                expert_hidden: int = 256):
+    """LM variant whose FFNs are Switch-style MoE layers (parallel/moe.py):
+    same attention as ``init``, each block's MLP replaced by router +
+    stacked expert weights. Use with ``apply_ep`` under shard_map (experts
+    sharded over the data axis) or with moe_apply_dense on one device."""
+    from minips_tpu.parallel.moe import init_moe
+
+    k_base, k_moe = jax.random.split(key)
+    base = init(k_base, vocab=vocab, dim=dim, heads=heads, depth=depth,
+                max_len=max_len, mlp_mult=1)
+    ks = jax.random.split(k_moe, depth)
+    for i, blk in enumerate(base["blocks"]):
+        del blk["mlp_in"], blk["mlp_out"]
+        blk["moe"] = init_moe(ks[i], num_experts, dim, expert_hidden)
+    return base
+
+
+def apply_moe_dense(params, tokens, *, heads=4, capacity: int,
+                    compute_dtype=jnp.bfloat16):
+    """Single-program MoE-LM logits (oracle / one device):
+    returns (logits, total aux loss)."""
+    from minips_tpu.parallel.moe import moe_apply_dense
+
+    return _forward(
+        params, tokens, jnp.arange(tokens.shape[1]), heads,
+        lambda q, k, v: reference_attention(q, k, v, causal=True),
+        compute_dtype,
+        ffn_fn=lambda blk, x: moe_apply_dense(
+            blk["moe"], x, capacity=capacity, compute_dtype=compute_dtype))
+
+
+def apply_ep(params, tokens_local, *, heads=4, axis_name=DATA_AXIS,
+             capacity: int, compute_dtype=jnp.bfloat16):
+    """Expert-parallel MoE-LM logits — call INSIDE shard_map with the
+    batch sharded over ``axis_name``, attention weights replicated, and
+    each block's expert stacks sharded per ``ep_lm_specs``. Attention runs
+    data-parallel per shard; every FFN's tokens fan out to the experts by
+    all_to_all. Grads OUTSIDE the shard_map, like the other schedules."""
+    from minips_tpu.parallel.moe import moe_apply_local
+
+    return _forward(
+        params, tokens_local, jnp.arange(tokens_local.shape[1]), heads,
+        lambda q, k, v: reference_attention(q, k, v, causal=True),
+        compute_dtype,
+        ffn_fn=lambda blk, x: moe_apply_local(
+            blk["moe"], x, axis_name=axis_name, capacity=capacity,
+            compute_dtype=compute_dtype))
+
+
+def ep_lm_specs(params, axis_name=DATA_AXIS):
+    """PartitionSpec pytree for ``apply_ep``: expert stacks sharded over
+    the axis, everything else replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from minips_tpu.parallel.moe import ep_specs
+
+    def one_block(blk):
+        return {
+            "ln1": jax.tree.map(lambda _: P(), blk["ln1"]),
+            "ln2": jax.tree.map(lambda _: P(), blk["ln2"]),
+            "qkv": P(),
+            "proj": P(),
+            "moe": ep_specs(axis_name),
+        }
+
+    return {
+        "tok_emb": P(),
+        "pos_emb": P(),
+        "ln_f": jax.tree.map(lambda _: P(), params["ln_f"]),
+        "blocks": [one_block(b) for b in params["blocks"]],
     }
 
 
