@@ -99,11 +99,11 @@ def _num_threads(threads: Optional[int]) -> int:
     env = os.environ.get("MINIPS_PARSE_THREADS")
     if env:
         return max(1, int(env))
-    # divide the machine between colocated launcher workers (the hostfile
-    # launcher starts several local processes at once; each would otherwise
-    # spin up cpu_count parse threads and thrash)
-    procs = max(1, int(os.environ.get("MINIPS_NUM_PROCS", "1") or 1))
-    return max(1, min(os.cpu_count() or 1, 16) // procs)
+    # divide the machine between COLOCATED launcher workers (set by
+    # launch.child_env; remote hosts in a hostfile don't share cores so
+    # the world size would be the wrong divisor), capping after the split
+    procs = max(1, int(os.environ.get("MINIPS_LOCAL_PROCS", "1") or 1))
+    return max(1, min((os.cpu_count() or 1) // procs, 16))
 
 
 def read_libsvm_native(path: str, max_features: Optional[int] = None,

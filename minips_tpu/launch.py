@@ -64,6 +64,10 @@ def child_env(rank: int, hosts: list[str], base_port: int) -> dict[str, str]:
     env = dict(os.environ)
     env["MINIPS_PROC_ID"] = str(rank)
     env["MINIPS_NUM_PROCS"] = str(len(hosts))
+    # processes COLOCATED on this rank's host — what host-resource
+    # divisions (e.g. native parse threads) should divide by, not the
+    # world size
+    env["MINIPS_LOCAL_PROCS"] = str(hosts.count(hosts[rank]))
     env["MINIPS_BUS_ADDRS"] = ",".join(bus_addresses(hosts, base_port))
     env["MINIPS_COORDINATOR"] = f"{hosts[0]}:{base_port + 1000}"
     return env

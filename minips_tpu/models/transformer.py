@@ -107,9 +107,12 @@ def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None,
 
 
 def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
-             psum_axis=None, apply_blocks=None, ffn_fn=None):
+             psum_axis=None, apply_blocks=None, ffn_fn=None, remat=False):
     """Returns (logits, total aux loss) — aux is nonzero only for MoE
-    ``ffn_fn`` blocks; the plain ``apply*`` wrappers drop it."""
+    ``ffn_fn`` blocks; the plain ``apply*`` wrappers drop it. ``remat``
+    wraps each block in ``jax.checkpoint`` so the backward pass recomputes
+    block activations instead of storing them — the standard HBM-for-FLOPs
+    trade that long-context training needs."""
     # static check: jax clamps out-of-range indices silently, so an
     # oversized sequence would reuse the last positional embedding row
     # for every tail position instead of erroring
@@ -124,9 +127,13 @@ def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
         # sequential layer loop but share embedding/head/LN code
         h = apply_blocks(h)
     else:
+        block_fn = _block
+        if remat:
+            block_fn = jax.checkpoint(
+                _block, static_argnums=(2, 3, 4, 5, 6))
         for blk in params["blocks"]:
-            h, aux = _block(h, blk, heads, attn_fn, compute_dtype,
-                            psum_axis, ffn_fn)
+            h, aux = block_fn(h, blk, heads, attn_fn, compute_dtype,
+                              psum_axis, ffn_fn)
             aux_total = aux_total + aux
     h = _ln(h, params["ln_f"])
     # weight-tied head
@@ -135,18 +142,20 @@ def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
     return logits, aux_total
 
 
-def apply(params, tokens, *, heads=4, compute_dtype=jnp.bfloat16):
+def apply(params, tokens, *, heads=4, compute_dtype=jnp.bfloat16,
+          remat=False):
     """Logits [B, T, vocab]; plain causal attention in one program.
     ``heads`` is static model structure, not table state — pass the value
-    used at ``init``."""
+    used at ``init``. ``remat=True`` recomputes block activations in the
+    backward pass (jax.checkpoint) to cut peak HBM on long sequences."""
     T = tokens.shape[1]
     return _forward(params, tokens, jnp.arange(T), heads,
                     lambda q, k, v: reference_attention(q, k, v, causal=True),
-                    compute_dtype)[0]
+                    compute_dtype, remat=remat)[0]
 
 
 def apply_sp(params, tokens_local, shift, *, heads=4, axis_name=DATA_AXIS,
-             compute_dtype=jnp.bfloat16):
+             compute_dtype=jnp.bfloat16, remat=False):
     """Sequence-parallel logits for a local token shard [B, T_local].
 
     Call inside ``shard_map``: ``shift`` is this shard's global sequence
@@ -160,7 +169,7 @@ def apply_sp(params, tokens_local, shift, *, heads=4, axis_name=DATA_AXIS,
         params, tokens_local, pos, heads,
         lambda q, k, v: ring_attention_local(q, k, v, axis_name=axis_name,
                                              causal=True),
-        compute_dtype)[0]
+        compute_dtype, remat=remat)[0]
 
 
 def apply_tp(params, tokens, *, heads=4, axis_name="model",
