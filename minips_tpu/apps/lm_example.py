@@ -60,6 +60,15 @@ def _flags(parser):
                         help="model-axis size for tp/pp layouts")
     parser.add_argument("--microbatches", type=int, default=4,
                         help="pp layout: microbatches in flight")
+    parser.add_argument("--data_file", default=None,
+                        help="train on this file's bytes (byte-level LM, "
+                             "vocab 256) instead of synthetic data")
+    parser.add_argument("--checkpoint_dir", default=None,
+                        help="dp/sp: save table state here")
+    parser.add_argument("--checkpoint_every", type=int, default=100)
+    parser.add_argument("--resume", action="store_true",
+                        help="dp/sp: restore newest checkpoint before "
+                             "training")
 
 
 def run(cfg: Config, args, metrics) -> dict:
@@ -79,12 +88,13 @@ def run(cfg: Config, args, metrics) -> dict:
         raise SystemExit(f"--seq_len {seq_len} exceeds the model's "
                          f"max_len {MODEL['max_len']}")
 
-    data = synthetic.lm_sequences(2048, seq_len, MODEL["vocab"],
-                                  seed=cfg.train.seed)
+    data = _load_data(cfg, args, seq_len)
     params = tfm.init(jax.random.PRNGKey(cfg.train.seed), **MODEL)
     table = DenseTable(params, mesh, updater=cfg.table.updater,
                        lr=cfg.table.lr, name=cfg.table.name)
     heads = MODEL["heads"]
+
+    ckpt, start_step = _maybe_checkpointer(args, table)
 
     if layout == "dp":
         step = table.make_step(
@@ -123,14 +133,49 @@ def run(cfg: Config, args, metrics) -> dict:
                 "tgt": jax.device_put(t[:, 1:], seq_sharding)}}
 
     batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
-    loop = TrainLoop(lambda b: table.step_inplace(step, prep(b)), batches,
+    n_done = {"step": start_step}
+
+    def do_step(b):
+        loss = table.step_inplace(step, prep(b))
+        n_done["step"] += 1
+        if ckpt is not None and n_done["step"] % args.checkpoint_every == 0:
+            ckpt.save(step=n_done["step"])
+        return loss
+
+    loop = TrainLoop(do_step, batches,
                      metrics=metrics, log_every=cfg.train.log_every,
                      batch_size=cfg.train.batch_size)
-    losses = loop.run(cfg.train.num_iters)
+    remaining = max(cfg.train.num_iters - start_step, 1)
+    losses = loop.run(remaining)
+    if ckpt is not None:
+        ckpt.save(step=n_done["step"])
     metrics.log(final_loss=losses[-1], layout=layout, seq_len=seq_len,
                 tokens_per_sec=loop.timer.samples_per_sec * seq_len)
     return {"losses": losses, "table": table, "layout": layout,
+            "start_step": start_step,
             "samples_per_sec": loop.timer.samples_per_sec}
+
+
+def _load_data(cfg, args, seq_len):
+    path = getattr(args, "data_file", None)
+    if path:
+        from minips_tpu.data.text import read_lm_file
+
+        return read_lm_file(path, seq_len, max_windows=65536)
+    return synthetic.lm_sequences(2048, seq_len, MODEL["vocab"],
+                                  seed=cfg.train.seed)
+
+
+def _maybe_checkpointer(args, table):
+    """(Checkpointer | None, start_step) for the dp/sp table layouts."""
+    path = getattr(args, "checkpoint_dir", None)
+    if not path:
+        return None, 0
+    from minips_tpu.ckpt.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(path, {"lm": table})
+    start = ckpt.restore() if getattr(args, "resume", False) else 0
+    return ckpt, start
 
 
 def _run_model_parallel(cfg, args, metrics, layout, seq_len) -> dict:
@@ -200,8 +245,7 @@ def _run_model_parallel(cfg, args, metrics, layout, seq_len) -> dict:
         updates, o = tx.update(g, o, p)
         return optax.apply_updates(p, updates), o, loss
 
-    data = synthetic.lm_sequences(2048, seq_len, MODEL["vocab"],
-                                  seed=cfg.train.seed)
+    data = _load_data(cfg, args, seq_len)
     batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
 
     state = {"p": params, "o": opt}

@@ -1,0 +1,44 @@
+"""Byte-level text loader for the LM family.
+
+The sandbox has no network, so there is no tokenizer download path — any
+local text/binary file becomes LM training data at the byte level
+(vocab 256), the honest equivalent of the reference's "read the local
+shard" loaders (SURVEY.md §2 "Data loading"). Windows are sampled with a
+stride so a small file still yields many distinct sequences.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def read_bytes(path: str) -> np.ndarray:
+    """File -> uint8 token stream."""
+    with open(path, "rb") as f:
+        return np.frombuffer(f.read(), dtype=np.uint8)
+
+
+def byte_windows(tokens: np.ndarray, seq_len: int, *,
+                 max_windows: int | None = None,
+                 stride: int | None = None) -> dict:
+    """Token stream -> {"tokens": [n, seq_len+1] int32} next-token windows.
+
+    ``stride`` defaults to seq_len // 2 (half-overlapping windows); the
+    stream must hold at least one full window.
+    """
+    need = seq_len + 1
+    if len(tokens) < need:
+        raise ValueError(f"need at least {need} tokens, file has "
+                         f"{len(tokens)}")
+    stride = stride or max(seq_len // 2, 1)
+    starts = np.arange(0, len(tokens) - need + 1, stride)
+    if max_windows is not None:
+        starts = starts[:max_windows]
+    idx = starts[:, None] + np.arange(need)[None, :]
+    return {"tokens": tokens[idx].astype(np.int32)}
+
+
+def read_lm_file(path: str, seq_len: int, *,
+                 max_windows: int | None = None) -> dict:
+    """Convenience: file path -> LM windows dict."""
+    return byte_windows(read_bytes(path), seq_len, max_windows=max_windows)
