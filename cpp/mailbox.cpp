@@ -1,0 +1,388 @@
+// Native control-plane mailbox: TCP full-mesh pub/sub transport.
+//
+// The reference's Mailbox is native C++ over ZeroMQ ROUTER/DEALER sockets
+// with per-thread ThreadsafeQueue inboxes and a dedicated Sender actor
+// (SURVEY.md L0/L1, §2.3). In the TPU rebuild the data plane is XLA
+// collectives, so what survives here is the control plane (SSP clocks,
+// heartbeats, barriers, host-relayed deltas) — but that plane is still
+// native C++, matching the reference's runtime layering: raw TCP sockets,
+// a ThreadsafeQueue<Message> inbox, an accept/reader actor per connection
+// and a Sender actor draining an outgoing queue so publish() never blocks
+// the training thread.
+//
+// Wire frame (little-endian):
+//   u32 magic 'MPSB' | u32 msg_len | i64 blob_len (-1 = none)
+//   | msg bytes (JSON) | blob bytes
+//
+// C ABI only (pybind11 absent in this image); bound via ctypes from
+// minips_tpu/comm/native_bus.py.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4253504Du;  // 'MPSB'
+constexpr uint32_t kMaxMsg = 16u << 20;   // 16 MB JSON frame cap
+constexpr int64_t kMaxBlob = 1ll << 30;   // 1 GB blob cap
+
+struct Msg {
+  std::string msg;
+  std::vector<uint8_t> blob;
+  bool has_blob = false;
+};
+
+// The reference's ThreadsafeQueue<Message>: mutex + condvar inbox.
+template <typename T>
+class ThreadsafeQueue {
+ public:
+  void push(T v) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      q_.push_back(std::move(v));
+    }
+    cv_.notify_one();
+  }
+  // Returns false on timeout or close-with-empty-queue.
+  bool pop(T* out, int timeout_ms) {
+    std::unique_lock<std::mutex> g(mu_);
+    auto pred = [&] { return !q_.empty() || closed_; };
+    if (timeout_ms < 0) {
+      cv_.wait(g, pred);
+    } else if (!cv_.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                             pred)) {
+      return false;
+    }
+    if (q_.empty()) return false;  // closed
+    *out = std::move(q_.front());
+    q_.pop_front();
+    return true;
+  }
+  void close() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      closed_ = true;
+    }
+    cv_.notify_all();
+  }
+  bool drain_wait(int timeout_ms) {  // wait until empty (sender flush)
+    std::unique_lock<std::mutex> g(mu_);
+    return drained_cv_.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                                [&] { return q_.empty(); });
+  }
+  void notify_drained() { drained_cv_.notify_all(); }
+  bool empty() {
+    std::lock_guard<std::mutex> g(mu_);
+    return q_.empty();
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable drained_cv_;
+  std::deque<T> q_;
+  bool closed_ = false;
+};
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+// Blocking read of exactly n bytes, polling `stop` every 100ms.
+bool read_all(int fd, void* buf, size_t n, const std::atomic<bool>& stop) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    struct pollfd pfd = {fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, 100);
+    if (stop.load()) return false;
+    if (pr == 0) continue;
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class Mailbox {
+ public:
+  Mailbox() = default;
+
+  // Bind + listen; returns false on failure. port 0 = ephemeral.
+  bool Bind(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) < 0 ||
+        ::listen(listen_fd_, 64) < 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return false;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    bound_port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread(&Mailbox::AcceptLoop, this);
+    sender_thread_ = std::thread(&Mailbox::SenderLoop, this);
+    return true;
+  }
+
+  int BoundPort() const { return bound_port_; }
+
+  // Connect to a peer's listener, retrying until timeout_ms (the peer's
+  // process may not have bound yet — the reference's startup has the same
+  // bind-before-connect ordering problem, solved there by config-ordered
+  // boot; here by retry).
+  bool Connect(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return false;
+    while (!stop_.load()) {
+      int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd < 0) return false;
+      if (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                    sizeof(addr)) == 0) {
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        // Bounded sends: a wedged peer (full receive buffer, SIGSTOP)
+        // must not block the Sender actor forever while it holds
+        // peers_mu_ — after 5s the peer is treated as dead and dropped,
+        // the same verdict the heartbeat layer would reach.
+        struct timeval tv = {5, 0};
+        ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+        std::lock_guard<std::mutex> g(peers_mu_);
+        peer_fds_.push_back(fd);
+        return true;
+      }
+      ::close(fd);
+      if (std::chrono::steady_clock::now() >= deadline) return false;
+      std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    }
+    return false;
+  }
+
+  // Nonblocking publish: enqueue for the Sender actor.
+  void Publish(Msg m) { outbox_.push(std::move(m)); }
+
+  bool Recv(Msg* out, int timeout_ms) { return inbox_.pop(out, timeout_ms); }
+
+  // Flush outgoing queue (bounded), then tear everything down.
+  void Close() {
+    outbox_.drain_wait(1000);
+    stop_.store(true);
+    inbox_.close();
+    outbox_.close();
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    if (sender_thread_.joinable()) sender_thread_.join();
+    {
+      std::lock_guard<std::mutex> g(readers_mu_);
+      for (int fd : reader_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    for (auto& t : reader_threads_)
+      if (t.joinable()) t.join();
+    {
+      std::lock_guard<std::mutex> g(readers_mu_);
+      for (int fd : reader_fds_) ::close(fd);
+      reader_fds_.clear();
+    }
+    {
+      std::lock_guard<std::mutex> g(peers_mu_);
+      for (int fd : peer_fds_)
+        if (fd >= 0) ::close(fd);
+      peer_fds_.clear();
+    }
+    if (listen_fd_ >= 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!stop_.load()) {
+      struct pollfd pfd = {listen_fd_, POLLIN, 0};
+      int pr = ::poll(&pfd, 1, 100);
+      if (stop_.load()) return;
+      if (pr <= 0) continue;
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) continue;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(readers_mu_);
+      reader_fds_.push_back(fd);
+      reader_threads_.emplace_back(&Mailbox::ReaderLoop, this, fd);
+    }
+  }
+
+  void ReaderLoop(int fd) {
+    while (!stop_.load()) {
+      uint32_t header[2];
+      int64_t blob_len;
+      if (!read_all(fd, header, sizeof(header), stop_)) return;
+      if (header[0] != kMagic || header[1] > kMaxMsg) return;  // poisoned
+      if (!read_all(fd, &blob_len, sizeof(blob_len), stop_)) return;
+      if (blob_len > kMaxBlob) return;
+      Msg m;
+      m.msg.resize(header[1]);
+      if (header[1] && !read_all(fd, &m.msg[0], header[1], stop_)) return;
+      if (blob_len >= 0) {
+        m.has_blob = true;
+        m.blob.resize(static_cast<size_t>(blob_len));
+        if (blob_len &&
+            !read_all(fd, m.blob.data(), m.blob.size(), stop_))
+          return;
+      }
+      inbox_.push(std::move(m));
+    }
+  }
+
+  // The Sender actor: drains the outbox, fanning each message out to every
+  // connected peer. A peer whose socket dies is dropped (marked -1) — the
+  // heartbeat layer above notices the silence and excludes it.
+  void SenderLoop() {
+    while (true) {
+      Msg m;
+      if (!outbox_.pop(&m, 200)) {  // idle beat or closed-and-empty
+        outbox_.notify_drained();
+        if (stop_.load()) return;
+        continue;
+      }
+      uint32_t header[2] = {kMagic, static_cast<uint32_t>(m.msg.size())};
+      int64_t blob_len = m.has_blob
+                             ? static_cast<int64_t>(m.blob.size())
+                             : -1;
+      std::lock_guard<std::mutex> g(peers_mu_);
+      for (int& fd : peer_fds_) {
+        if (fd < 0) continue;
+        bool ok = write_all(fd, header, sizeof(header)) &&
+                  write_all(fd, &blob_len, sizeof(blob_len)) &&
+                  (m.msg.empty() || write_all(fd, m.msg.data(),
+                                              m.msg.size())) &&
+                  (!m.has_blob || m.blob.empty() ||
+                   write_all(fd, m.blob.data(), m.blob.size()));
+        if (!ok) {
+          ::close(fd);
+          fd = -1;
+        }
+      }
+      if (outbox_.empty()) outbox_.notify_drained();
+    }
+  }
+
+  int listen_fd_ = -1;
+  int bound_port_ = 0;
+  std::atomic<bool> stop_{false};
+  ThreadsafeQueue<Msg> inbox_;
+  ThreadsafeQueue<Msg> outbox_;
+  std::mutex peers_mu_;
+  std::vector<int> peer_fds_;  // outgoing fan-out sockets
+  std::mutex readers_mu_;
+  std::vector<int> reader_fds_;  // accepted incoming sockets
+  std::vector<std::thread> reader_threads_;
+  std::thread accept_thread_;
+  std::thread sender_thread_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mailbox_create(int listen_port) {
+  auto* mb = new Mailbox();
+  if (!mb->Bind(listen_port)) {
+    delete mb;
+    return nullptr;
+  }
+  return mb;
+}
+
+int mailbox_port(void* h) { return static_cast<Mailbox*>(h)->BoundPort(); }
+
+int mailbox_connect(void* h, const char* host, int port, int timeout_ms) {
+  return static_cast<Mailbox*>(h)->Connect(host, port, timeout_ms) ? 0 : -1;
+}
+
+void mailbox_publish(void* h, const char* msg, int64_t msg_len,
+                     const uint8_t* blob, int64_t blob_len) {
+  Msg m;
+  m.msg.assign(msg, static_cast<size_t>(msg_len));
+  if (blob_len >= 0) {
+    m.has_blob = true;
+    m.blob.assign(blob, blob + blob_len);
+  }
+  static_cast<Mailbox*>(h)->Publish(std::move(m));
+}
+
+// Returns 1 with ownership of *msg_out/*blob_out transferred (free via
+// mailbox_free_buf), 0 on timeout/closed.
+int mailbox_recv(void* h, int timeout_ms, char** msg_out, int64_t* msg_len,
+                 uint8_t** blob_out, int64_t* blob_len) {
+  Msg m;
+  if (!static_cast<Mailbox*>(h)->Recv(&m, timeout_ms)) return 0;
+  *msg_len = static_cast<int64_t>(m.msg.size());
+  *msg_out = static_cast<char*>(::malloc(m.msg.size() + 1));
+  std::memcpy(*msg_out, m.msg.data(), m.msg.size());
+  (*msg_out)[m.msg.size()] = '\0';
+  if (m.has_blob) {
+    *blob_len = static_cast<int64_t>(m.blob.size());
+    *blob_out = static_cast<uint8_t*>(::malloc(m.blob.size() ? m.blob.size()
+                                                             : 1));
+    std::memcpy(*blob_out, m.blob.data(), m.blob.size());
+  } else {
+    *blob_len = -1;
+    *blob_out = nullptr;
+  }
+  return 1;
+}
+
+void mailbox_free_buf(void* p) { ::free(p); }
+
+void mailbox_close(void* h) {
+  auto* mb = static_cast<Mailbox*>(h);
+  mb->Close();
+  delete mb;
+}
+
+}  // extern "C"

@@ -132,25 +132,29 @@ def run(cfg: Config, args, metrics) -> dict:
                 "inp": jax.device_put(t[:, :-1], seq_sharding),
                 "tgt": jax.device_put(t[:, 1:], seq_sharding)}}
 
-    batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
-    n_done = {"step": start_step}
+    batches = iter(BatchIterator(data, cfg.train.batch_size,
+                                 seed=cfg.train.seed))
+    # Fast-forward past the batches the pre-crash run already consumed so
+    # the resumed trajectory continues the stream instead of replaying it.
+    for _ in range(start_step):
+        next(batches)
 
-    def do_step(b):
-        loss = table.step_inplace(step, prep(b))
-        n_done["step"] += 1
-        if ckpt is not None and n_done["step"] % args.checkpoint_every == 0:
-            ckpt.save(step=n_done["step"])
-        return loss
-
-    loop = TrainLoop(do_step, batches,
+    loop = TrainLoop(lambda b: table.step_inplace(step, prep(b)), batches,
                      metrics=metrics, log_every=cfg.train.log_every,
-                     batch_size=cfg.train.batch_size)
-    remaining = max(cfg.train.num_iters - start_step, 1)
+                     batch_size=cfg.train.batch_size,
+                     checkpointer=ckpt,
+                     checkpoint_every=getattr(args, "checkpoint_every", 0),
+                     step_offset=start_step)
+    # A completed run resumed again is a no-op, not an extra step.
+    remaining = max(cfg.train.num_iters - start_step, 0)
     losses = loop.run(remaining)
-    if ckpt is not None:
-        ckpt.save(step=n_done["step"])
-    metrics.log(final_loss=losses[-1], layout=layout, seq_len=seq_len,
-                tokens_per_sec=loop.timer.samples_per_sec * seq_len)
+    ckpt_every = getattr(args, "checkpoint_every", 0)
+    if ckpt is not None and remaining and not (
+            ckpt_every and cfg.train.num_iters % ckpt_every == 0):
+        ckpt.save(step=cfg.train.num_iters)  # not already saved by the loop
+    if losses:
+        metrics.log(final_loss=losses[-1], layout=layout, seq_len=seq_len,
+                    tokens_per_sec=loop.timer.samples_per_sec * seq_len)
     return {"losses": losses, "table": table, "layout": layout,
             "start_step": start_step,
             "samples_per_sec": loop.timer.samples_per_sec}
@@ -174,8 +178,10 @@ def _maybe_checkpointer(args, table):
     from minips_tpu.ckpt.checkpoint import Checkpointer
 
     ckpt = Checkpointer(path, {"lm": table})
-    start = ckpt.restore() if getattr(args, "resume", False) else 0
-    return ckpt, start
+    start = 0
+    if getattr(args, "resume", False) and ckpt.list_steps():
+        start = ckpt.restore()  # resume-if-present: first launch of an
+    return ckpt, start          # always---resume wrapper starts at 0
 
 
 def _run_model_parallel(cfg, args, metrics, layout, seq_len) -> dict:

@@ -82,67 +82,19 @@ class ControlBus:
                 continue
             try:
                 frames = self._sub.recv_multipart(zmq.NOBLOCK)
-                msg = json.loads(frames[0])
-            except (zmq.ZMQError, json.JSONDecodeError, IndexError):
+            except zmq.ZMQError:
                 continue
-            handler = self._handlers.get(msg.get("kind"))
-            if handler is not None:
-                payload = msg.get("payload", {})
-                if len(frames) > 1:
-                    payload["__blob__"] = frames[1]
-                handler(msg.get("sender", -1), payload)
+            dispatch_message(self._handlers, frames[0],
+                             frames[1] if len(frames) > 1 else None)
 
     def handshake(self, num_processes: int, timeout: float = 15.0) -> None:
         """Rendezvous before real traffic: PUB/SUB drops messages published
         before a subscriber's connect lands (the zmq slow-joiner problem),
         which for the delta-gossip data path would mean silent replica
         divergence — so nobody proceeds until everyone provably hears
-        everyone. Each process repeats ``hello``; once it has heard hello
-        from all peers it also repeats ``ready``; it returns once it has
-        heard ready from all peers (with a short grace of extra publishes
-        for stragglers). Reference analog: the mailbox's startup
-        bind/connect barrier (SURVEY.md §3.1)."""
-        import time as _time
-
-        peers = set(range(num_processes)) - {self.my_id}
-        if not peers:
-            return
-        hellos: set[int] = set()
-        readys: set[int] = set()
-        lock = threading.Lock()
-
-        def on_hello(sender: int, payload: dict) -> None:
-            with lock:
-                hellos.add(sender)
-
-        def on_ready(sender: int, payload: dict) -> None:
-            with lock:
-                hellos.add(sender)
-                readys.add(sender)
-
-        self.on("__hello", on_hello)
-        self.on("__ready", on_ready)
-        deadline = _time.monotonic() + timeout
-        while True:
-            self.publish("__hello", {})
-            with lock:
-                all_hello = hellos >= peers
-                all_ready = readys >= peers
-            if all_hello:
-                self.publish("__ready", {})
-            if all_ready:
-                break
-            if _time.monotonic() > deadline:
-                with lock:
-                    missing = peers - readys
-                raise TimeoutError(
-                    f"bus handshake: peers {sorted(missing)} never ready")
-            _time.sleep(0.02)
-        for _ in range(5):  # grace: peers may still await my ready
-            self.publish("__ready", {})
-            _time.sleep(0.02)
-        self._handlers.pop("__hello", None)
-        self._handlers.pop("__ready", None)
+        everyone. Reference analog: the mailbox's startup bind/connect
+        barrier (SURVEY.md §3.1)."""
+        run_handshake(self, num_processes, timeout)
 
     def close(self) -> None:
         self._stop.set()
@@ -156,6 +108,110 @@ class ControlBus:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def dispatch_message(handlers: dict, raw, blob: Optional[bytes]) -> None:
+    """Shared receive-side tail for every bus backend: decode the JSON
+    control frame, attach the blob at ``__blob__``, invoke the handler. A
+    raising handler is reported, not propagated — one bad handler must not
+    kill the backend's receive thread (clocks/heartbeats ride the same
+    thread)."""
+    try:
+        msg = json.loads(raw)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return
+    handler = handlers.get(msg.get("kind"))
+    if handler is None:
+        return
+    payload = msg.get("payload", {})
+    if blob is not None:
+        payload["__blob__"] = blob
+    try:
+        handler(msg.get("sender", -1), payload)
+    except Exception:  # noqa: BLE001 - isolate handler faults
+        import sys
+        import traceback
+
+        print(f"bus: handler for {msg.get('kind')!r} raised:",
+              file=sys.stderr)
+        traceback.print_exc()
+
+
+def run_handshake(bus, num_processes: int, timeout: float = 15.0) -> None:
+    """Backend-agnostic startup rendezvous over any bus exposing
+    ``on``/``publish``/``my_id``/``_handlers``. Each process repeats
+    ``hello``; once it has heard hello from all peers it also repeats
+    ``ready``; it returns once it has heard ready from all peers (with a
+    short grace of extra publishes for stragglers)."""
+    import time as _time
+
+    peers = set(range(num_processes)) - {bus.my_id}
+    if not peers:
+        return
+    hellos: set[int] = set()
+    readys: set[int] = set()
+    lock = threading.Lock()
+
+    def on_hello(sender: int, payload: dict) -> None:
+        with lock:
+            hellos.add(sender)
+
+    def on_ready(sender: int, payload: dict) -> None:
+        with lock:
+            hellos.add(sender)
+            readys.add(sender)
+
+    bus.on("__hello", on_hello)
+    bus.on("__ready", on_ready)
+    deadline = _time.monotonic() + timeout
+    while True:
+        bus.publish("__hello", {})
+        with lock:
+            all_hello = hellos >= peers
+            all_ready = readys >= peers
+        if all_hello:
+            bus.publish("__ready", {})
+        if all_ready:
+            break
+        if _time.monotonic() > deadline:
+            with lock:
+                missing = peers - readys
+            raise TimeoutError(
+                f"bus handshake: peers {sorted(missing)} never ready")
+        _time.sleep(0.02)
+    for _ in range(5):  # grace: peers may still await my ready
+        bus.publish("__ready", {})
+        _time.sleep(0.02)
+    bus._handlers.pop("__hello", None)
+    bus._handlers.pop("__ready", None)
+
+
+def make_bus(my_addr: str, peer_addrs: list[str], my_id: int = 0,
+             backend: Optional[str] = None):
+    """Bus factory. ``backend``: ``"zmq"`` (pyzmq PUB/SUB, default) or
+    ``"native"`` (the C++ TCP mailbox, cpp/mailbox.cpp — the reference's
+    native-runtime analog); default from ``$MINIPS_BUS``.
+
+    An explicit native request that cannot be satisfied raises instead of
+    silently falling back: the two wire formats do not interoperate, so a
+    quiet fallback on one host of a multi-host job would produce a mixed
+    mesh that fails 15s later with a misleading handshake timeout."""
+    import os
+
+    backend = backend or os.environ.get("MINIPS_BUS", "zmq")
+    if backend == "native":
+        from minips_tpu.comm.native_bus import NativeControlBus
+
+        if not NativeControlBus.available():
+            raise RuntimeError(
+                "MINIPS_BUS=native requested but the C++ mailbox library "
+                "is unavailable (no compiler?); every host must use the "
+                "same backend — set MINIPS_BUS=zmq explicitly to fall back")
+        return NativeControlBus(my_addr, peer_addrs, my_id=my_id)
+    if backend != "zmq":
+        raise ValueError(f"unknown bus backend {backend!r} "
+                         "(expected 'zmq' or 'native')")
+    return ControlBus(my_addr, peer_addrs, my_id=my_id)
 
 
 class ClockGossip:

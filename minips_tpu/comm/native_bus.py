@@ -1,0 +1,201 @@
+"""NativeControlBus — ctypes binding for the C++ TCP mailbox.
+
+The reference's Mailbox is native C++ (ZeroMQ ROUTER/DEALER + per-thread
+``ThreadsafeQueue`` inboxes + a Sender actor; SURVEY.md L0/L1, §2.3). This
+is the rebuild's native-runtime equivalent for the surviving control plane:
+``cpp/mailbox.cpp`` implements the transport (raw TCP full mesh, framed
+messages, a C++ ThreadsafeQueue inbox, reader actors per connection, a
+Sender actor draining an outgoing queue), and this module is the thin
+Python skin exposing the exact ``ControlBus`` interface so ``ClockGossip``,
+``HeartbeatMonitor``, ``BlockMaster`` etc. run unchanged on either backend.
+
+Select with ``make_bus(..., backend="native")`` or ``MINIPS_BUS=native``.
+Like the native data readers, the library builds lazily on first use and
+callers degrade to the zmq backend when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import threading
+from typing import Callable, Optional
+
+from minips_tpu.comm.bus import dispatch_message
+from minips_tpu.utils.native_lib import load_native_lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.mailbox_create.argtypes = [ctypes.c_int]
+    lib.mailbox_create.restype = ctypes.c_void_p
+    lib.mailbox_port.argtypes = [ctypes.c_void_p]
+    lib.mailbox_port.restype = ctypes.c_int
+    lib.mailbox_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int, ctypes.c_int]
+    lib.mailbox_connect.restype = ctypes.c_int
+    lib.mailbox_publish.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_int64]
+    lib.mailbox_publish.restype = None
+    lib.mailbox_recv.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.mailbox_recv.restype = ctypes.c_int
+    lib.mailbox_free_buf.argtypes = [ctypes.c_void_p]
+    lib.mailbox_free_buf.restype = None
+    lib.mailbox_close.argtypes = [ctypes.c_void_p]
+    lib.mailbox_close.restype = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    return load_native_lib("libminips_comm.so", _declare)
+
+
+def _parse_addr(addr: str) -> tuple[str, int]:
+    """``tcp://host:port`` → (IPv4, port); hostnames (``localhost``,
+    hostfile names) resolve here so the C side only sees literals."""
+    import socket
+
+    hostport = addr.split("//", 1)[-1]
+    host, port = hostport.rsplit(":", 1)
+    if host in ("*", "0.0.0.0", ""):
+        return "0.0.0.0", int(port)
+    try:
+        socket.inet_aton(host)
+    except OSError:
+        host = socket.gethostbyname(host)
+    return host, int(port)
+
+
+class NativeControlBus:
+    """Same interface as ``ControlBus`` (on/start/publish/handshake/close),
+    backed by the C++ mailbox instead of pyzmq. Fan-out happens over the
+    full mesh of outgoing TCP connections made in ``start()``."""
+
+    def __init__(self, my_addr: str, peer_addrs: list[str], my_id: int = 0,
+                 connect_timeout: float = 15.0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native mailbox library unavailable")
+        self.my_id = my_id
+        self._lib = lib
+        _, port = _parse_addr(my_addr)
+        self._h = lib.mailbox_create(port)
+        if not self._h:
+            raise OSError(f"mailbox_create: cannot bind {my_addr}")
+        self._peer_addrs = [_parse_addr(a) for a in peer_addrs]
+        self._connect_timeout = connect_timeout
+        self._handlers: dict[str, Callable[[int, dict], None]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        # Serializes publish() against close(): the C publish call must
+        # never run concurrently with (or after) mailbox_close freeing the
+        # Mailbox — a late heartbeat publish would be a use-after-free.
+        self._h_lock = threading.Lock()
+
+    @staticmethod
+    def available() -> bool:
+        return _load() is not None
+
+    @property
+    def port(self) -> int:
+        return self._lib.mailbox_port(self._h)
+
+    def on(self, kind: str, handler: Callable[[int, dict], None]) -> None:
+        self._handlers[kind] = handler
+
+    def start(self) -> "NativeControlBus":
+        # Outgoing connects retry in C until the peer's listener is up
+        # (processes boot in arbitrary order, SURVEY.md §3.1).
+        for host, port in self._peer_addrs:
+            rc = self._lib.mailbox_connect(
+                self._h, host.encode(), port,
+                int(self._connect_timeout * 1000))
+            if rc != 0:
+                raise TimeoutError(
+                    f"native bus: cannot reach peer {host}:{port}")
+        self._thread = threading.Thread(target=self._recv_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    # Receive-side protocol caps (cpp/mailbox.cpp kMaxMsg/kMaxBlob). An
+    # oversized frame would be written in full here but poison the peer's
+    # reader thread there — the link dies silently. Reject at the source.
+    MAX_MSG = 16 << 20
+    MAX_BLOB = 1 << 30
+
+    def publish(self, kind: str, payload: dict,
+                blob: Optional[bytes] = None) -> None:
+        """Nonblocking: enqueues onto the C++ Sender actor's queue.
+        A publish after close() is a silent no-op (matches zmq's at-worst-
+        an-error behavior rather than a use-after-free)."""
+        msg = json.dumps({"kind": kind, "sender": self.my_id,
+                          "payload": payload}).encode()
+        if len(msg) > self.MAX_MSG:
+            raise ValueError(f"control frame {len(msg)}B exceeds the "
+                             f"{self.MAX_MSG}B protocol cap")
+        if blob is not None and len(blob) > self.MAX_BLOB:
+            raise ValueError(f"blob {len(blob)}B exceeds the "
+                             f"{self.MAX_BLOB}B protocol cap")
+        with self._h_lock:
+            if self._closed:
+                return
+            if blob is None:
+                self._lib.mailbox_publish(self._h, msg, len(msg), None, -1)
+            else:
+                self._lib.mailbox_publish(self._h, msg, len(msg),
+                                          bytes(blob), len(blob))
+
+    def _recv_loop(self) -> None:
+        msg_p = ctypes.c_char_p()
+        msg_len = ctypes.c_int64()
+        blob_p = ctypes.POINTER(ctypes.c_uint8)()
+        blob_len = ctypes.c_int64()
+        while not self._stop.is_set():
+            got = self._lib.mailbox_recv(
+                self._h, 50, ctypes.byref(msg_p), ctypes.byref(msg_len),
+                ctypes.byref(blob_p), ctypes.byref(blob_len))
+            if not got:
+                continue
+            try:
+                raw = ctypes.string_at(msg_p, msg_len.value)
+                blob = (ctypes.string_at(blob_p, blob_len.value)
+                        if blob_len.value >= 0 and blob_p else None)
+            finally:
+                self._lib.mailbox_free_buf(msg_p)
+                if blob_p:
+                    self._lib.mailbox_free_buf(blob_p)
+                blob_p = ctypes.POINTER(ctypes.c_uint8)()
+            dispatch_message(self._handlers, raw, blob)
+
+    def handshake(self, num_processes: int, timeout: float = 15.0) -> None:
+        """TCP never drops post-connect, but a peer may publish before OUR
+        connect to it finished accepting — same rendezvous as zmq."""
+        from minips_tpu.comm.bus import run_handshake
+
+        run_handshake(self, num_processes, timeout)
+
+    def close(self) -> None:
+        with self._h_lock:  # waits out any in-flight publish, blocks new ones
+            if self._closed:
+                return
+            self._closed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            if self._thread.is_alive():
+                # A handler is wedged past the grace period. mailbox_close
+                # would free the C++ object under the recv thread's feet
+                # (use-after-free → segfault); leaking the handle is the
+                # safe failure mode.
+                return
+        self._lib.mailbox_close(self._h)
+
+    def __enter__(self) -> "NativeControlBus":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -11,86 +11,53 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 from typing import Optional
 
 import numpy as np
 
-_REPO_CPP = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "cpp")
-_LIB_PATH = os.path.join(_REPO_CPP, "build", "libminips_data.so")
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_build_failed = False
+from minips_tpu.utils.native_lib import load_native_lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.libsvm_count.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.libsvm_count.restype = ctypes.c_int
+    lib.libsvm_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")]
+    lib.libsvm_parse.restype = ctypes.c_int
+    try:  # a stale .so surviving a failed rebuild lacks these symbols
+        lib.criteo_count.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+        lib.criteo_count.restype = ctypes.c_int
+        lib.criteo_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+        lib.criteo_parse.restype = ctypes.c_int
+    except AttributeError:
+        lib.criteo_count = None
+    try:  # multi-threaded parse entry points (chunked, line-aligned);
+        # a stale .so predating them raises AttributeError here
+        lib.criteo_parse_mt.argtypes = (
+            list(lib.criteo_parse.argtypes) + [ctypes.c_int])
+        lib.criteo_parse_mt.restype = ctypes.c_int
+        lib.libsvm_parse_mt.argtypes = (
+            list(lib.libsvm_parse.argtypes) + [ctypes.c_int])
+        lib.libsvm_parse_mt.restype = ctypes.c_int
+    except AttributeError:
+        lib.criteo_parse_mt = None
+        lib.libsvm_parse_mt = None
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _build_failed
-    with _lock:
-        if _lib is not None:
-            return _lib
-        if _build_failed:
-            return None
-        # Always invoke make: a no-op when fresh, a rebuild when the C++
-        # sources are newer than a stale .so (which would lack new symbols).
-        # An inter-process flock serializes concurrent builds (the launcher
-        # starts several local workers at once; without it two g++ runs can
-        # interleave writes to the .so while a third dlopens the torso).
-        try:
-            os.makedirs(os.path.join(_REPO_CPP, "build"), exist_ok=True)
-            import fcntl
-
-            with open(os.path.join(_REPO_CPP, "build", ".lock"), "w") as lk:
-                fcntl.flock(lk, fcntl.LOCK_EX)
-                subprocess.run(["make", "-C", _REPO_CPP], check=True,
-                               capture_output=True, timeout=120)
-        except (OSError, subprocess.SubprocessError):
-            if not os.path.exists(_LIB_PATH):
-                _build_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            _build_failed = True
-            return None
-        lib.libsvm_count.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64)]
-        lib.libsvm_count.restype = ctypes.c_int
-        lib.libsvm_parse.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
-            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")]
-        lib.libsvm_parse.restype = ctypes.c_int
-        try:  # a stale .so surviving a failed rebuild lacks these symbols
-            lib.criteo_count.argtypes = [
-                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
-            lib.criteo_count.restype = ctypes.c_int
-            lib.criteo_parse.argtypes = [
-                ctypes.c_char_p, ctypes.c_int64,
-                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
-                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
-                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
-                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
-            lib.criteo_parse.restype = ctypes.c_int
-        except AttributeError:
-            lib.criteo_count = None
-        try:  # multi-threaded parse entry points (chunked, line-aligned);
-            # a stale .so predating them raises AttributeError here
-            lib.criteo_parse_mt.argtypes = (
-                list(lib.criteo_parse.argtypes) + [ctypes.c_int])
-            lib.criteo_parse_mt.restype = ctypes.c_int
-            lib.libsvm_parse_mt.argtypes = (
-                list(lib.libsvm_parse.argtypes) + [ctypes.c_int])
-            lib.libsvm_parse_mt.restype = ctypes.c_int
-        except AttributeError:
-            lib.criteo_parse_mt = None
-            lib.libsvm_parse_mt = None
-        _lib = lib
-        return _lib
+    return load_native_lib("libminips_data.so", _declare)
 
 
 def _num_threads(threads: Optional[int]) -> int:

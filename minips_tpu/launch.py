@@ -128,8 +128,9 @@ def wait(procs: list[subprocess.Popen], timeout: Optional[float] = None,
 
 def init_from_env():
     """Worker-side: build my ControlBus from the launcher's env vars.
-    Returns ``(proc_id, num_procs, bus)``; bus is None single-process."""
-    from minips_tpu.comm.bus import ControlBus
+    Returns ``(proc_id, num_procs, bus)``; bus is None single-process.
+    Backend honors ``$MINIPS_BUS`` (zmq | native C++ mailbox)."""
+    from minips_tpu.comm.bus import make_bus
 
     rank = int(os.environ.get("MINIPS_PROC_ID", "0"))
     n = int(os.environ.get("MINIPS_NUM_PROCS", "1"))
@@ -139,7 +140,7 @@ def init_from_env():
     peers = [a for i, a in enumerate(addrs) if i != rank]
     # bind on all interfaces at my advertised port; peers connect by name
     port = addrs[rank].rsplit(":", 1)[1]
-    bus = ControlBus(f"tcp://*:{port}", peers, my_id=rank).start()
+    bus = make_bus(f"tcp://*:{port}", peers, my_id=rank).start()
     return rank, n, bus
 
 

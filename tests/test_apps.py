@@ -57,6 +57,28 @@ def test_lr_dense_from_libsvm_file(tmp_path):
     assert np.mean(losses[-5:]) < np.mean(losses[:5])
 
 
+def test_lm_example_resume_completed_run_is_noop(tmp_path):
+    """Resuming a run that already reached num_iters trains zero extra
+    steps and leaves the newest checkpoint number unchanged."""
+    from minips_tpu.apps import lm_example as app
+    from minips_tpu.ckpt.checkpoint import Checkpointer
+
+    cfg = Config(
+        table=TableConfig(name="lm", kind="dense", updater="adam", lr=3e-3),
+        train=TrainConfig(batch_size=16, num_iters=6, log_every=100),
+    )
+    args = _args(layout="dp", seq_len=32, tp=2, microbatches=2,
+                 checkpoint_dir=str(tmp_path), checkpoint_every=100,
+                 resume=False)
+    out1 = app.run(cfg, args, MetricsLogger(None, verbose=False))
+    assert len(out1["losses"]) == 6
+    args.resume = True
+    out2 = app.run(cfg, args, MetricsLogger(None, verbose=False))
+    assert out2["start_step"] == 6
+    assert out2["losses"] == []          # no extra training
+    assert max(Checkpointer(str(tmp_path), {}).list_steps()) == 6
+
+
 def test_lm_example_all_layouts():
     """The LM app trains under every parallel layout (dp / sp ring
     attention / tp Megatron / pp GPipe) and the loss trajectories agree —

@@ -1,26 +1,36 @@
 """Control bus + heartbeat over loopback — threads-as-nodes, the same way
-the reference tests its mailbox (SURVEY.md §4)."""
+the reference tests its mailbox (SURVEY.md §4). The same suite runs over
+both backends: pyzmq PUB/SUB and the native C++ TCP mailbox
+(cpp/mailbox.cpp via comm/native_bus.py)."""
 
 import time
 
 import pytest
 
-from minips_tpu.comm.bus import ClockGossip, ControlBus
+from minips_tpu.comm.bus import ClockGossip, ControlBus, make_bus
 from minips_tpu.comm.heartbeat import HeartbeatMonitor
+from minips_tpu.comm.native_bus import NativeControlBus
 
 
-def _mk_buses(n, base_port):
+def _mk_buses(n, base_port, backend="zmq"):
     addrs = [f"tcp://127.0.0.1:{base_port + i}" for i in range(n)]
-    buses = [ControlBus(addrs[i], [a for j, a in enumerate(addrs) if j != i],
-                        my_id=i) for i in range(n)]
+    buses = [make_bus(addrs[i], [a for j, a in enumerate(addrs) if j != i],
+                      my_id=i, backend=backend) for i in range(n)]
     for b in buses:
         b.start()
     time.sleep(0.2)  # PUB/SUB slow-joiner settle
     return buses
 
 
-def test_bus_pubsub_roundtrip():
-    buses = _mk_buses(2, 15730)
+BACKENDS = ["zmq"] + (["native"] if NativeControlBus.available() else [])
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_bus_pubsub_roundtrip(backend):
+    buses = _mk_buses(2, 15730 if backend == "zmq" else 16730,
+                      backend=backend)
+    if backend == "native":
+        assert all(isinstance(b, NativeControlBus) for b in buses)
     got = []
     buses[1].on("hello", lambda sender, p: got.append((sender, p["x"])))
     buses[0].publish("hello", {"x": 42})
@@ -32,8 +42,62 @@ def test_bus_pubsub_roundtrip():
     assert got == [(0, 42)]
 
 
-def test_clock_gossip_global_min():
-    buses = _mk_buses(3, 15760)
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_bus_blob_frame(backend):
+    """Binary blob rides as a second frame, surfacing at __blob__ —
+    the host-relay delta path (ASP push payloads) depends on this."""
+    buses = _mk_buses(2, 15860 if backend == "zmq" else 16860,
+                      backend=backend)
+    got = []
+    buses[0].on("delta", lambda s, p: got.append((s, p["step"],
+                                                  p["__blob__"])))
+    payload = bytes(range(256)) * 17  # embedded NULs + non-ASCII
+    buses[1].publish("delta", {"step": 7}, blob=payload)
+    deadline = time.time() + 5
+    while not got and time.time() < deadline:
+        time.sleep(0.01)
+    for b in buses:
+        b.close()
+    assert got == [(1, 7, payload)]
+
+
+def test_native_bus_handshake_and_ordering():
+    """Per-sender FIFO over the native mailbox: TCP preserves order, the
+    inbox queue preserves arrival order, so one sender's messages arrive
+    in publish order."""
+    if not NativeControlBus.available():
+        pytest.skip("native mailbox unavailable")
+    buses = _mk_buses(3, 16930, backend="native")
+    try:
+        import threading
+
+        # startup rendezvous is symmetric: every node must run it
+        # concurrently (in production each runs in its own process)
+        ts = [threading.Thread(target=b.handshake, args=(3, 10.0))
+              for b in buses]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=12.0)
+        assert not any(t.is_alive() for t in ts)
+        got = []
+        buses[2].on("seq", lambda s, p: got.append((s, p["i"])))
+        for i in range(50):
+            buses[0].publish("seq", {"i": i})
+        deadline = time.time() + 5
+        while len([g for g in got if g[0] == 0]) < 50 \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert [i for s, i in got if s == 0] == list(range(50))
+    finally:
+        for b in buses:
+            b.close()
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_clock_gossip_global_min(backend):
+    buses = _mk_buses(3, 15760 if backend == "zmq" else 16760,
+                      backend=backend)
     gossips = [ClockGossip(b, 3, workers_per_process=2) for b in buses]
     gossips[0].publish_local([5, 6])
     gossips[1].publish_local([3, 9])

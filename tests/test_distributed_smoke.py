@@ -34,12 +34,14 @@ _PORT = [5800]  # bumped per spawn so tests never collide on TIME_WAIT ports
 
 
 def run_job(n: int, extra: list[str], iters: int = 30,
-            timeout: float = 240.0) -> list[dict]:
+            timeout: float = 240.0, env_extra: dict | None = None
+            ) -> list[dict]:
     """Launch n local worker processes, harvest one JSON line per rank."""
     _PORT[0] += n + 3
     hosts = ["localhost"] * n
     env_patch = {"MINIPS_FORCE_CPU": "1",
                  "JAX_PLATFORMS": "cpu"}
+    env_patch.update(env_extra or {})
     outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
     procs = []
     for rank, host in enumerate(hosts):
@@ -103,6 +105,24 @@ def test_asp_never_waits():
         assert r["event"] == "done"
         assert r["gate_waits"] == 0             # ASP never blocks
         assert r["loss_last"] < r["loss_first"]
+    assert_replicas_agree(res)
+
+
+@pytest.mark.slow
+def test_ssp_on_native_mailbox():
+    """The full multi-process SSP job over the C++ TCP mailbox instead of
+    pyzmq (MINIPS_BUS=native) — same consistency contracts must hold."""
+    from minips_tpu.comm.native_bus import NativeControlBus
+
+    if not NativeControlBus.available():
+        pytest.skip("native mailbox unavailable")
+    s = 2
+    res = run_job(3, ["--mode", "ssp", "--staleness", str(s),
+                      "--slow-rank", "1", "--slow-ms", "40"],
+                  env_extra={"MINIPS_BUS": "native"})
+    for r in res:
+        assert r["event"] == "done"
+        assert r["max_skew_seen"] <= s + 1
     assert_replicas_agree(res)
 
 
