@@ -63,17 +63,38 @@ def _flags(parser):
     parser.add_argument("--data_file", default=None,
                         help="train on this file's bytes (byte-level LM, "
                              "vocab 256) instead of synthetic data")
-    parser.add_argument("--checkpoint_dir", default=None,
-                        help="dp/sp: save table state here")
-    parser.add_argument("--checkpoint_every", type=int, default=100)
+    # --checkpoint_dir / --checkpoint_every come from add_config_flags
     parser.add_argument("--resume", action="store_true",
                         help="dp/sp: restore newest checkpoint before "
                              "training")
+    parser.add_argument("--attn", default="reference",
+                        choices=["reference", "flash"],
+                        help="dp layout attention: full-scores XLA or the "
+                             "fused O(T)-memory flash kernel "
+                             "(ops/flash_attention.py) — the win is at "
+                             "long --seq_len, where full scores thrash or "
+                             "OOM HBM")
+    parser.add_argument("--max_len", type=int, default=None,
+                        help="positional-embedding capacity (default: "
+                             f"{MODEL['max_len']}, auto-grown to "
+                             "--seq_len)")
+
+
+def _model_cfg(args, seq_len: int) -> dict:
+    """MODEL with positional capacity covering --max_len / --seq_len."""
+    cap = max(getattr(args, "max_len", None) or MODEL["max_len"], seq_len)
+    return {**MODEL, "max_len": cap}
 
 
 def run(cfg: Config, args, metrics) -> dict:
     seq_len = getattr(args, "seq_len", 128)
     layout = getattr(args, "layout", "dp")
+    if getattr(args, "attn", "reference") == "flash" and layout != "dp":
+        # only the dp branch threads attn_impl through; failing loud beats
+        # silently training with different memory/perf than requested
+        raise SystemExit(f"--attn flash is only wired into --layout dp "
+                         f"(got {layout}); sp already runs O(T/N)-memory "
+                         "ring attention")
     if layout in ("tp", "pp"):
         return _run_model_parallel(cfg, args, metrics, layout, seq_len)
     mesh = make_mesh()
@@ -81,24 +102,19 @@ def run(cfg: Config, args, metrics) -> dict:
     if seq_len % n_shards:
         raise SystemExit(f"--seq_len {seq_len} must divide by the "
                          f"{n_shards}-way mesh")
-    if seq_len > MODEL["max_len"]:
-        # the model's static check can't see the GLOBAL length on the sp
-        # path (each shard only knows its T_local; the shift is traced),
-        # so the app validates it here for both layouts
-        raise SystemExit(f"--seq_len {seq_len} exceeds the model's "
-                         f"max_len {MODEL['max_len']}")
-
+    model = _model_cfg(args, seq_len)
     data = _load_data(cfg, args, seq_len)
-    params = tfm.init(jax.random.PRNGKey(cfg.train.seed), **MODEL)
+    params = tfm.init(jax.random.PRNGKey(cfg.train.seed), **model)
     table = DenseTable(params, mesh, updater=cfg.table.updater,
                        lr=cfg.table.lr, name=cfg.table.name)
-    heads = MODEL["heads"]
+    heads = model["heads"]
 
-    ckpt, start_step = _maybe_checkpointer(args, table)
+    ckpt, start_step = _maybe_checkpointer(cfg, args, table)
 
     if layout == "dp":
         step = table.make_step(
-            functools.partial(tfm.grad_fn, heads=heads),
+            functools.partial(tfm.grad_fn, heads=heads,
+                              attn_impl=getattr(args, "attn", "reference")),
             batch_spec=P(DATA_AXIS))
         batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
 
@@ -132,23 +148,21 @@ def run(cfg: Config, args, metrics) -> dict:
                 "inp": jax.device_put(t[:, :-1], seq_sharding),
                 "tgt": jax.device_put(t[:, 1:], seq_sharding)}}
 
-    batches = iter(BatchIterator(data, cfg.train.batch_size,
-                                 seed=cfg.train.seed))
     # Fast-forward past the batches the pre-crash run already consumed so
     # the resumed trajectory continues the stream instead of replaying it.
-    for _ in range(start_step):
-        next(batches)
+    batches = BatchIterator(data, cfg.train.batch_size,
+                            seed=cfg.train.seed).iter_from(start_step)
 
+    ckpt_every = _ckpt_every(cfg, args)
     loop = TrainLoop(lambda b: table.step_inplace(step, prep(b)), batches,
                      metrics=metrics, log_every=cfg.train.log_every,
                      batch_size=cfg.train.batch_size,
                      checkpointer=ckpt,
-                     checkpoint_every=getattr(args, "checkpoint_every", 0),
+                     checkpoint_every=ckpt_every,
                      step_offset=start_step)
     # A completed run resumed again is a no-op, not an extra step.
     remaining = max(cfg.train.num_iters - start_step, 0)
     losses = loop.run(remaining)
-    ckpt_every = getattr(args, "checkpoint_every", 0)
     if ckpt is not None and remaining and not (
             ckpt_every and cfg.train.num_iters % ckpt_every == 0):
         ckpt.save(step=cfg.train.num_iters)  # not already saved by the loop
@@ -170,9 +184,18 @@ def _load_data(cfg, args, seq_len):
                                   seed=cfg.train.seed)
 
 
-def _maybe_checkpointer(args, table):
-    """(Checkpointer | None, start_step) for the dp/sp table layouts."""
-    path = getattr(args, "checkpoint_dir", None)
+def _ckpt_every(cfg, args) -> int:
+    """Checkpoint cadence from the merged config, falling back to raw args
+    (tests call run() with a bare Namespace, skipping config_from_args)."""
+    return (getattr(cfg.train, "checkpoint_every", 0)
+            or getattr(args, "checkpoint_every", 0) or 0)
+
+
+def _maybe_checkpointer(cfg, args, table):
+    """(Checkpointer | None, start_step) for the dp/sp table layouts.
+    checkpoint_dir honors --config_file via cfg.train, like lr_example."""
+    path = (getattr(cfg.train, "checkpoint_dir", None)
+            or getattr(args, "checkpoint_dir", None))
     if not path:
         return None, 0
     from minips_tpu.ckpt.checkpoint import Checkpointer
@@ -199,14 +222,13 @@ def _run_model_parallel(cfg, args, metrics, layout, seq_len) -> dict:
     if n_dev % tp_size:
         raise SystemExit(f"--tp {tp_size} must divide {n_dev} devices")
     mesh = make_mesh(n_dev // tp_size, model_size=tp_size)
-    heads = MODEL["heads"]
-    if seq_len > MODEL["max_len"]:
-        raise SystemExit(f"--seq_len {seq_len} exceeds max_len")
+    model = _model_cfg(args, seq_len)
+    heads = model["heads"]
     if layout == "tp" and heads % tp_size:
         raise SystemExit(f"--tp {tp_size} must divide heads {heads}")
-    if layout == "pp" and MODEL["depth"] % tp_size:
+    if layout == "pp" and model["depth"] % tp_size:
         raise SystemExit(f"--tp {tp_size} must divide depth "
-                         f"{MODEL['depth']} (pipeline stages)")
+                         f"{model['depth']} (pipeline stages)")
     data_shards = n_dev // tp_size
     if cfg.train.batch_size % data_shards:
         raise SystemExit(f"--batch_size {cfg.train.batch_size} must divide "
@@ -218,7 +240,7 @@ def _run_model_parallel(cfg, args, metrics, layout, seq_len) -> dict:
             f"{local_b} (= --batch_size {cfg.train.batch_size} / "
             f"{data_shards} data shards)")
 
-    params = tfm.init(jax.random.PRNGKey(cfg.train.seed), **MODEL)
+    params = tfm.init(jax.random.PRNGKey(cfg.train.seed), **model)
     if layout == "pp":
         params = {**params, "blocks": stack_layers(params["blocks"])}
         specs = tfm.pp_specs(params, MODEL_AXIS)

@@ -39,13 +39,24 @@ class BatchIterator:
         self._rng = np.random.default_rng(seed)
 
     def __iter__(self) -> Iterator[dict]:
+        return self.iter_from(0)
+
+    def iter_from(self, start_batch: int) -> Iterator[dict]:
+        """The same infinite stream, starting at batch ``start_batch`` —
+        the resume fast-forward. Skipped epochs cost one RNG permutation
+        draw each (O(n) ints), not ``start_batch`` full batch copies."""
+        end = (self.n - self.batch_size + 1 if self.drop_last else self.n)
+        starts = range(0, end, self.batch_size)
+        per_epoch = len(starts)
+        skip_epochs, skip_batches = divmod(start_batch, per_epoch)
+        for _ in range(skip_epochs):
+            self._rng.permutation(self.n)  # advance the stream's RNG only
         while True:
             perm = self._rng.permutation(self.n)
-            end = (self.n - self.batch_size + 1 if self.drop_last
-                   else self.n)
-            for s in range(0, end, self.batch_size):
+            for s in starts[skip_batches:]:
                 sel = perm[s: s + self.batch_size]
                 yield {k: v[sel] for k, v in self.data.items()}
+            skip_batches = 0
 
 
 _POISON = object()

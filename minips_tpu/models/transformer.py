@@ -142,16 +142,30 @@ def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
     return logits, aux_total
 
 
+def _attn_fn(attn_impl: str):
+    """Causal attention implementation by name: ``reference`` (full [T, T]
+    scores, XLA-fused) or ``flash`` (ops/flash_attention.py — Pallas kernel
+    on TPU, exact blockwise scan elsewhere; O(T) memory either way)."""
+    if attn_impl == "flash":
+        from minips_tpu.ops.flash_attention import flash_attention
+
+        return lambda q, k, v: flash_attention(q, k, v, causal=True)
+    if attn_impl != "reference":
+        raise ValueError(f"unknown attn_impl {attn_impl!r} "
+                         "(expected 'reference' or 'flash')")
+    return lambda q, k, v: reference_attention(q, k, v, causal=True)
+
+
 def apply(params, tokens, *, heads=4, compute_dtype=jnp.bfloat16,
-          remat=False):
+          remat=False, attn_impl="reference"):
     """Logits [B, T, vocab]; plain causal attention in one program.
     ``heads`` is static model structure, not table state — pass the value
     used at ``init``. ``remat=True`` recomputes block activations in the
-    backward pass (jax.checkpoint) to cut peak HBM on long sequences."""
+    backward pass (jax.checkpoint) to cut peak HBM on long sequences.
+    ``attn_impl="flash"`` swaps in the fused O(T)-memory attention."""
     T = tokens.shape[1]
     return _forward(params, tokens, jnp.arange(T), heads,
-                    lambda q, k, v: reference_attention(q, k, v, causal=True),
-                    compute_dtype, remat=remat)[0]
+                    _attn_fn(attn_impl), compute_dtype, remat=remat)[0]
 
 
 def apply_sp(params, tokens_local, shift, *, heads=4, axis_name=DATA_AXIS,
@@ -351,17 +365,19 @@ def nll(logits, targets):
         -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0])
 
 
-def loss(params, batch, *, heads=4, compute_dtype=jnp.bfloat16):
+def loss(params, batch, *, heads=4, compute_dtype=jnp.bfloat16,
+         attn_impl="reference"):
     """Next-token cross-entropy; batch = {"tokens": [B, T+1] int32}."""
     toks = batch["tokens"]
     logits = apply(params, toks[:, :-1], heads=heads,
-                   compute_dtype=compute_dtype)
+                   compute_dtype=compute_dtype, attn_impl=attn_impl)
     return nll(logits, toks[:, 1:])
 
 
-def grad_fn(params, batch, *, heads=4):
+def grad_fn(params, batch, *, heads=4, attn_impl="reference"):
     l, g = jax.value_and_grad(
-        lambda p, b: loss(p, b, heads=heads))(params, batch)
+        lambda p, b: loss(p, b, heads=heads, attn_impl=attn_impl))(
+        params, batch)
     return l, g
 
 

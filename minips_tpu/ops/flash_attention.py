@@ -1,0 +1,398 @@
+"""Flash attention — fused blockwise causal attention for the LM family.
+
+The reference has no attention at all (SURVEY.md §2.2: LR/MLP/MF/W&D/w2v);
+the LM/transformer family is this rebuild's beyond-parity long-context
+capability, and this module is its single-chip hot op. Two implementations
+of the same exact math (softmax(QK^T)V, never materializing the [T, T]
+score matrix in HBM):
+
+- ``blockwise_attention`` — pure jnp, ``lax.scan`` over K/V chunks with
+  online-softmax carry. Runs anywhere (CPU tests, TPU), differentiable by
+  AD through the scan, O(T·block_k) live scores. This is the oracle-exact
+  portable path and the backward function for the kernel below.
+
+- ``flash_attention`` — Pallas TPU kernels. Forward: grid (batch, head,
+  Q blocks, K blocks) with the K sweep innermost; the float32 online-
+  softmax state (running max m, normalizer l, accumulator acc) lives in
+  VMEM scratch across the sweep, blocks are pipelined HBM→VMEM by Pallas,
+  scores exist only in VMEM, and the per-row logsumexp is written out for
+  the backward. Backward (``jax.custom_vjp``): two kernels that recompute
+  p = exp(s − lse) per block — dQ accumulates over the K sweep, dK/dV over
+  the transposed Q sweep — so training memory stays O(T) and the [T, T]
+  matrix never exists in either pass. Causal runs skip fully-masked blocks
+  in all three kernels.
+
+Measured on the one real chip here (2026-07-29, bf16, B=2 H=8 D=64,
+T=8192): forward 5.8ms vs 12.4ms XLA full-scores; fwd+bwd 21ms vs 40ms;
+end-to-end LM training (apps/lm_example --attn flash) 1.5x tokens/sec at
+T=8192, and T=32768 works where full scores OOM HBM.
+
+Layout matches the rest of the stack: q/k/v are ``[B, T, H, D]`` (the
+ring-attention convention, parallel/ring_attention.py). The kernel wants
+the sequence contiguous per (batch, head), so it transposes to
+``[B, H, T, D]`` at the jit boundary — XLA fuses the transposes into the
+surrounding program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas imports can fail on exotic backends; degrade to blockwise
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+_NEG_INF = -1e30  # finite mask value (matches ring_attention) — avoids
+                  # -inf arithmetic NaNs on fully-masked rows
+
+
+# --------------------------------------------------------------- blockwise
+def blockwise_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_k: int = 256,
+) -> jnp.ndarray:
+    """Exact attention, scanning K/V in chunks of ``block_k``.
+
+    q/k/v: [B, T, H, D]. Equals softmax(QK^T·scale)V to float tolerance;
+    peak score memory is [B, Tq, block_k, H] instead of [B, Tq, Tk, H].
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    bk = min(block_k, Tk)
+    pad = (-Tk) % bk  # ragged tail: pad K/V and mask — never one full-width
+    if pad:           # chunk, which would void the O(T*block_k) bound
+        zeros = jnp.zeros((B, pad, H, D), k.dtype)
+        k = jnp.concatenate([k, zeros], axis=1)
+        v = jnp.concatenate([v, zeros], axis=1)
+    masked = causal or pad
+    nk = (Tk + pad) // bk
+    qf = q.astype(jnp.float32)
+    kc = k.astype(jnp.float32).reshape(B, nk, bk, H, D)
+    vc = v.astype(jnp.float32).reshape(B, nk, bk, H, D)
+    q_pos = jnp.arange(Tq)
+
+    def fold(carry, blk):
+        o, m, l = carry
+        k_blk, v_blk, j = blk
+        s = jnp.einsum("bqhd,bkhd->bqkh", qf, k_blk) * scale
+        if masked:
+            k_pos = j * bk + jnp.arange(bk)
+            keep = k_pos[None, :] < Tk  # padding keys attend to nothing
+            if causal:
+                keep = keep & (q_pos[:, None] >= k_pos[None, :])
+            s = jnp.where(keep[None, :, :, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=2))        # [B, Tq, H]
+        p = jnp.exp(s - m_new[:, :, None, :])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=2)
+        o = o * alpha[:, :, :, None] + jnp.einsum("bqkh,bkhd->bqhd", p, v_blk)
+        return (o, m_new, l), None
+
+    o0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    m0 = jnp.full((B, Tq, H), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Tq, H), jnp.float32)
+    # Inside shard_map, fresh carries are axis-invariant while the folded
+    # values vary over the mesh — pcast keeps the scan carry type fixed
+    # (same VMA discipline as ring_attention_local).
+    vma = tuple(sorted(getattr(jax.typeof(q), "vma", frozenset())))
+    if vma:
+        o0, m0, l0 = (jax.lax.pcast(x, vma, to="varying")
+                      for x in (o0, m0, l0))
+    (o, _, l), _ = jax.lax.scan(
+        fold, (o0, m0, l0),
+        (kc.swapaxes(0, 1), vc.swapaxes(0, 1), jnp.arange(nk)))
+    return (o / jnp.maximum(l, 1e-30)[:, :, :, None]).astype(q.dtype)
+
+
+# ----------------------------------------------------------- pallas kernel
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                  l_ref, *, scale, causal, num_k):
+    # Grid (B, H, nQ, nK), K innermost and sequential on TPU: the online-
+    # softmax state for one Q block lives in VMEM scratch across the nK
+    # sweep. Blocks: q/o [1, 1, bq, D]; k/v [1, 1, bk, D]; lse [1, 1, bq].
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: K blocks wholly above the diagonal contribute nothing — skip
+    # the matmuls (the block DMA still happens; compute dominates here)
+    live = (j * bk <= (i + 1) * bq - 1) if causal else True
+
+    @pl.when(live)
+    def _fold():
+        qb = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+        kb = k_ref[0, 0, :, :].astype(jnp.float32)
+        vb = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m = m_ref[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))  # [bq, 1]
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = (acc_ref[:] * alpha
+                      + jnp.dot(p, vb, preferred_element_type=jnp.float32))
+        m_ref[:] = m_new
+
+    @pl.when(j == num_k - 1)
+    def _write():
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0, 0, :, :] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        # logsumexp per row — the backward recomputes p = exp(s - lse)
+        lse_ref[0, 0, :, 0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    """[B, T, H, D] in/out; kernel runs on [B, H, T, D]."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    bq = min(block_q, Tq)
+    bk = min(block_k, Tk)
+    grid = (B, H, Tq // bq, Tk // bk)
+    # Inside shard_map the output type must declare which mesh axes it
+    # varies over (VMA tracking); it varies exactly where the inputs do.
+    vma = frozenset()
+    for x in (q, k, v):
+        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, causal=causal,
+                          num_k=Tk // bk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32, vma=vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),   # acc
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max m
+            pltpu.VMEM((bq, 1), jnp.float32),   # normalizer l
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3), lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                         dq_ref, dq_acc, *, scale, causal, num_k):
+    # Grid (B, H, nQ, nK), K innermost; dQ for one Q block accumulates in
+    # scratch across the K sweep. p is recomputed from the saved
+    # logsumexp — the [T, T] matrix never exists.
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = (j * bk <= (i + 1) * bq - 1) if causal else True
+
+    @pl.when(live)
+    def _fold():
+        qb = q_ref[0, 0, :, :].astype(jnp.float32)
+        kb = k_ref[0, 0, :, :].astype(jnp.float32)
+        vb = v_ref[0, 0, :, :].astype(jnp.float32)
+        dob = do_ref[0, 0, :, :].astype(jnp.float32)
+        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0, :, :])            # [bq, bk]
+        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dvec_ref[0, 0, :, :]) * scale
+        dq_acc[:] = dq_acc[:] + jnp.dot(
+            ds, kb, preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_k - 1)
+    def _write():
+        dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                          num_q):
+    # Grid (B, H, nK, nQ), Q innermost; dK/dV for one K block accumulate
+    # in scratch across the Q sweep (the transposed iteration of dq).
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+    j, i = pl.program_id(2), pl.program_id(3)   # j: K block, i: Q block
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = ((i + 1) * bq - 1 >= j * bk) if causal else True
+
+    @pl.when(live)
+    def _fold():
+        qb = q_ref[0, 0, :, :].astype(jnp.float32)
+        kb = k_ref[0, 0, :, :].astype(jnp.float32)
+        vb = v_ref[0, 0, :, :].astype(jnp.float32)
+        dob = do_ref[0, 0, :, :].astype(jnp.float32)
+        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0, :, :])            # [bq, bk]
+        dv_acc[:] = dv_acc[:] + jnp.dot(
+            p.T, dob, preferred_element_type=jnp.float32)
+        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dvec_ref[0, 0, :, :]) * scale
+        dk_acc[:] = dk_acc[:] + jnp.dot(
+            ds.T, qb, preferred_element_type=jnp.float32)
+
+    @pl.when(i == num_q - 1)
+    def _write():
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    """dQ/dK/dV via the two backward kernels; [B, T, H, D] layout."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    bq = min(block_q, Tq)
+    bk = min(block_k, Tk)
+    qt, kt, vt, dot = (x.transpose(0, 2, 1, 3) for x in (q, k, v, g))
+    # D_i = rowsum(dO * O) — tiny elementwise reduce; XLA fuses it
+    dvec = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                   axis=-1).transpose(0, 2, 1)[..., None]      # [B, H, Tq, 1]
+    vma = frozenset()
+    for x in (q, k, v, g):
+        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+
+    q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
+    row_spec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
+                          num_k=Tk // bk),
+        grid=(B, H, Tq // bq, Tk // bk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype, vma=vma),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, dvec)
+
+    # transposed grid: K outer, Q inner
+    q_spec_t = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
+    kv_spec_t = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
+    row_spec_t = pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale,
+                          causal=causal, num_q=Tq // bq),
+        grid=(B, H, Tk // bk, Tq // bq),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tk, D), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((B, H, Tk, D), v.dtype, vma=vma),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, dvec)
+    return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
+            dv.transpose(0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret)[0]
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
+                           block_k, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def kernel_supported(q_shape, k_shape, block_q: int, block_k: int) -> bool:
+    """Static shape gate for the Pallas path: block sizes must tile the
+    sequence (no ragged tails in the kernel) and D should be lane-friendly."""
+    if not _HAS_PALLAS:
+        return False
+    B, Tq, H, D = q_shape
+    Tk = k_shape[1]
+    bq, bk = min(block_q, Tq), min(block_k, Tk)
+    return Tq % bq == 0 and Tk % bk == 0 and D % 8 == 0
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused attention; same signature/semantics as
+    ``ring_attention.reference_attention`` but never materializes the full
+    score matrix. Uses the Pallas kernel on TPU (or ``interpret=True``
+    anywhere, for tests); otherwise the blockwise scan — both exact.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = False
+        use_kernel = (kernel_supported(q.shape, k.shape, block_q, block_k)
+                      and jax.default_backend() == "tpu")
+    else:
+        use_kernel = kernel_supported(q.shape, k.shape, block_q, block_k)
+    if use_kernel:
+        return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    return blockwise_attention(q, k, v, causal=causal, scale=scale,
+                               block_k=block_k)

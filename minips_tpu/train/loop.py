@@ -72,8 +72,11 @@ class TrainLoop:
             if self.log_every and (i + 1) % self.log_every == 0:
                 self.metrics.log(step=gstep, loss=float(loss),
                                  samples_per_sec=self.timer.samples_per_sec)
+            # GLOBAL-step modulo: a resumed run keeps the same checkpoint
+            # cadence as an uninterrupted one (local modulo would drift by
+            # start_step and can leave resumed tail steps never saved)
             if (self.checkpointer is not None and self.checkpoint_every
-                    and (i + 1) % self.checkpoint_every == 0):
+                    and gstep % self.checkpoint_every == 0):
                 self.checkpointer.save(step=gstep)
         return losses
 

@@ -40,6 +40,20 @@ def test_batch_iterator_shapes_and_coverage():
     assert len(seen) > 90  # near-full coverage over 2 epochs
 
 
+def test_batch_iterator_iter_from_matches_stream():
+    """iter_from(s) yields exactly the batches a fresh stream yields after
+    s next() calls — the resume fast-forward contract — across epoch
+    boundaries, without materializing the skipped batches."""
+    data = {"x": np.arange(50).reshape(50, 1)}
+    for skip in (0, 3, 7, 12):  # 16 batches/epoch... 3/epoch at bs=16
+        full = iter(BatchIterator(data, 16, seed=5))
+        for _ in range(skip):
+            next(full)
+        fast = BatchIterator(data, 16, seed=5).iter_from(skip)
+        for _ in range(5):
+            np.testing.assert_array_equal(next(full)["x"], next(fast)["x"])
+
+
 def test_batch_iterator_rejects_mismatch():
     with pytest.raises(ValueError):
         BatchIterator({"x": np.zeros(10), "y": np.zeros(9)}, 2)
