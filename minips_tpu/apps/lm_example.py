@@ -89,12 +89,12 @@ def _model_cfg(args, seq_len: int) -> dict:
 def run(cfg: Config, args, metrics) -> dict:
     seq_len = getattr(args, "seq_len", 128)
     layout = getattr(args, "layout", "dp")
-    if getattr(args, "attn", "reference") == "flash" and layout != "dp":
-        # only the dp branch threads attn_impl through; failing loud beats
+    if getattr(args, "attn", "reference") == "flash" \
+            and layout not in ("dp", "sp"):
+        # tp/pp don't thread attn_impl through; failing loud beats
         # silently training with different memory/perf than requested
-        raise SystemExit(f"--attn flash is only wired into --layout dp "
-                         f"(got {layout}); sp already runs O(T/N)-memory "
-                         "ring attention")
+        raise SystemExit(f"--attn flash is only wired into --layout dp/sp "
+                         f"(got {layout})")
     if layout in ("tp", "pp"):
         return _run_model_parallel(cfg, args, metrics, layout, seq_len)
     mesh = make_mesh()
@@ -130,7 +130,9 @@ def run(cfg: Config, args, metrics) -> dict:
             def shard_loss(p_, inp, tgt):
                 shift = jax.lax.axis_index(DATA_AXIS) * T_local
                 return tfm.loss_sp(p_, inp, tgt, shift, heads=heads,
-                                   reduce="local")
+                                   reduce="local",
+                                   attn_impl=getattr(args, "attn",
+                                                     "reference"))
             toks = b["tokens"]
             return jax.value_and_grad(shard_loss)(p, toks["inp"], toks["tgt"])
 

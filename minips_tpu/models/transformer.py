@@ -169,21 +169,30 @@ def apply(params, tokens, *, heads=4, compute_dtype=jnp.bfloat16,
 
 
 def apply_sp(params, tokens_local, shift, *, heads=4, axis_name=DATA_AXIS,
-             compute_dtype=jnp.bfloat16, remat=False):
+             compute_dtype=jnp.bfloat16, remat=False,
+             attn_impl="reference"):
     """Sequence-parallel logits for a local token shard [B, T_local].
 
     Call inside ``shard_map``: ``shift`` is this shard's global sequence
     offset (``axis_index * T_local``); attention is a causal ring over
     ``axis_name``. Full params, sharded activations — sequence parallelism
-    in its pure form.
+    in its pure form. ``attn_impl="flash"`` runs each ring step through the
+    offset-masked flash kernel (ops/flash_attention.py) instead of the
+    full per-step score block.
     """
     T_local = tokens_local.shape[1]
     pos = shift + jnp.arange(T_local)
-    return _forward(
-        params, tokens_local, pos, heads,
-        lambda q, k, v: ring_attention_local(q, k, v, axis_name=axis_name,
-                                             causal=True),
-        compute_dtype, remat=remat)[0]
+    if attn_impl == "flash":
+        from minips_tpu.ops.flash_attention import (
+            ring_flash_attention_local)
+
+        attn = lambda q, k, v: ring_flash_attention_local(  # noqa: E731
+            q, k, v, axis_name=axis_name, causal=True)
+    else:
+        attn = lambda q, k, v: ring_attention_local(  # noqa: E731
+            q, k, v, axis_name=axis_name, causal=True)
+    return _forward(params, tokens_local, pos, heads, attn,
+                    compute_dtype, remat=remat)[0]
 
 
 def apply_tp(params, tokens, *, heads=4, axis_name="model",
@@ -383,7 +392,7 @@ def grad_fn(params, batch, *, heads=4, attn_impl="reference"):
 
 def loss_sp(params, tokens_local, targets_local, shift, *, heads=4,
             axis_name=DATA_AXIS, compute_dtype=jnp.bfloat16,
-            reduce="pmean"):
+            reduce="pmean", attn_impl="reference"):
     """Per-shard next-token loss over the shard's tokens.
 
     ``reduce="pmean"`` returns the global mean loss (standalone use — take
@@ -393,7 +402,8 @@ def loss_sp(params, tokens_local, targets_local, shift, *, heads=4,
     per-shard grads — a pmean here would double-scale them by 1/N.
     """
     logits = apply_sp(params, tokens_local, shift, heads=heads,
-                      axis_name=axis_name, compute_dtype=compute_dtype)
+                      axis_name=axis_name, compute_dtype=compute_dtype,
+                      attn_impl=attn_impl)
     local = nll(logits, targets_local)
     if reduce == "local":
         return local

@@ -53,6 +53,14 @@ _NEG_INF = -1e30  # finite mask value (matches ring_attention) — avoids
                   # -inf arithmetic NaNs on fully-masked rows
 
 
+def _pcast_varying(x, axes):
+    """pcast x to varying over exactly the axes it isn't already varying
+    over (pcast rejects varying→varying)."""
+    have = getattr(jax.typeof(x), "vma", frozenset())
+    need = tuple(a for a in axes if a not in have)
+    return jax.lax.pcast(x, need, to="varying") if need else x
+
+
 # --------------------------------------------------------------- blockwise
 def blockwise_attention(
     q: jnp.ndarray,
@@ -62,11 +70,20 @@ def blockwise_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     block_k: int = 256,
-) -> jnp.ndarray:
+    q_off=0,
+    k_off=0,
+    return_lse: bool = False,
+):
     """Exact attention, scanning K/V in chunks of ``block_k``.
 
     q/k/v: [B, T, H, D]. Equals softmax(QK^T·scale)V to float tolerance;
     peak score memory is [B, Tq, block_k, H] instead of [B, Tq, Tk, H].
+    Ragged K tails are padded and masked, preserving that bound.
+
+    ``q_off``/``k_off`` shift causal masking to global positions (the ring
+    path passes each shard's sequence offset); ``return_lse=True`` also
+    returns the per-row logsumexp [B, Tq, H] for shard merging. This is
+    the pure-jnp twin of the Pallas kernels.
     """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -83,17 +100,17 @@ def blockwise_attention(
     qf = q.astype(jnp.float32)
     kc = k.astype(jnp.float32).reshape(B, nk, bk, H, D)
     vc = v.astype(jnp.float32).reshape(B, nk, bk, H, D)
-    q_pos = jnp.arange(Tq)
+    q_pos = q_off + jnp.arange(Tq)
 
     def fold(carry, blk):
         o, m, l = carry
         k_blk, v_blk, j = blk
         s = jnp.einsum("bqhd,bkhd->bqkh", qf, k_blk) * scale
         if masked:
-            k_pos = j * bk + jnp.arange(bk)
-            keep = k_pos[None, :] < Tk  # padding keys attend to nothing
+            k_local = j * bk + jnp.arange(bk)
+            keep = k_local[None, :] < Tk  # padding keys attend to nothing
             if causal:
-                keep = keep & (q_pos[:, None] >= k_pos[None, :])
+                keep = keep & (q_pos[:, None] >= (k_off + k_local)[None, :])
             s = jnp.where(keep[None, :, :, None], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=2))        # [B, Tq, H]
         p = jnp.exp(s - m_new[:, :, None, :])
@@ -108,26 +125,52 @@ def blockwise_attention(
     # Inside shard_map, fresh carries are axis-invariant while the folded
     # values vary over the mesh — pcast keeps the scan carry type fixed
     # (same VMA discipline as ring_attention_local).
-    vma = tuple(sorted(getattr(jax.typeof(q), "vma", frozenset())))
-    if vma:
-        o0, m0, l0 = (jax.lax.pcast(x, vma, to="varying")
-                      for x in (o0, m0, l0))
-    (o, _, l), _ = jax.lax.scan(
+    vma = tuple(sorted(_vma_of(q, k, v, q_off, k_off)))
+    o0, m0, l0 = (_pcast_varying(x, vma) for x in (o0, m0, l0))
+    (o, m, l), _ = jax.lax.scan(
         fold, (o0, m0, l0),
         (kc.swapaxes(0, 1), vc.swapaxes(0, 1), jnp.arange(nk)))
-    return (o / jnp.maximum(l, 1e-30)[:, :, :, None]).astype(q.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (o / l_safe[..., None]).astype(q.dtype)
+    if return_lse:
+        return out, m + jnp.log(l_safe)
+    return out
 
 
 # ----------------------------------------------------------- pallas kernel
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                  l_ref, *, scale, causal, num_k):
+#
+# All three kernels mask by GLOBAL positions: row q_off + (local index),
+# col k_off + (local index). Plain causal attention passes offsets (0, 0);
+# ring flash attention (ring_flash_attention_local) passes each shard's
+# sequence offsets so the same kernels compute the diagonal, kept, and
+# fully-masked ring steps. Offsets arrive as (1,) int32 arrays in SMEM.
+
+def _mask_scores(s, masked, i, j, bq, bk, q_off, k_off):
+    if not masked:
+        return s
+    q_pos = q_off + i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_off + j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _block_live(masked, i, j, bq, bk, q_off, k_off):
+    """False only for blocks that the global causal mask kills entirely —
+    skip their matmuls (the block DMA still happens; compute dominates)."""
+    if not masked:
+        return True
+    return k_off + j * bk <= q_off + (i + 1) * bq - 1
+
+
+def _flash_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                  acc_ref, m_ref, l_ref, *, scale, masked, num_k):
     # Grid (B, H, nQ, nK), K innermost and sequential on TPU: the online-
     # softmax state for one Q block lives in VMEM scratch across the nK
-    # sweep. Blocks: q/o [1, 1, bq, D]; k/v [1, 1, bk, D]; lse [1, 1, bq].
+    # sweep. Blocks: q/o [1, 1, bq, D]; k/v [1, 1, bk, D]; lse [1, 1, bq, 1].
     bq = q_ref.shape[2]
     bk = k_ref.shape[2]
     i = pl.program_id(2)
     j = pl.program_id(3)
+    q_off, k_off = qoff_ref[0], koff_ref[0]
 
     @pl.when(j == 0)
     def _init():
@@ -135,20 +178,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: K blocks wholly above the diagonal contribute nothing — skip
-    # the matmuls (the block DMA still happens; compute dominates here)
-    live = (j * bk <= (i + 1) * bq - 1) if causal else True
-
-    @pl.when(live)
+    @pl.when(_block_live(masked, i, j, bq, bk, q_off, k_off))
     def _fold():
         qb = q_ref[0, 0, :, :].astype(jnp.float32) * scale
         kb = k_ref[0, 0, :, :].astype(jnp.float32)
         vb = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s = _mask_scores(s, masked, i, j, bq, bk, q_off, k_off)
         m = m_ref[:]
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))  # [bq, 1]
         p = jnp.exp(s - m_new)
@@ -162,11 +198,26 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
     def _write():
         l_safe = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0, 0, :, :] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        # logsumexp per row — the backward recomputes p = exp(s - lse)
+        # true logsumexp per row — the backward recomputes p = exp(s - lse),
+        # and the ring merge weights shards by exp(lse_s - lse_total)
         lse_ref[0, 0, :, 0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _smem_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _vma_of(*xs):
+    # Inside shard_map the output type must declare which mesh axes it
+    # varies over (VMA tracking); it varies exactly where the inputs do.
+    vma = frozenset()
+    for x in xs:
+        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    return vma
+
+
+def _flash_forward(q, k, v, q_off, k_off, masked, scale, block_q, block_k,
+                   interpret):
     """[B, T, H, D] in/out; kernel runs on [B, H, T, D]."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -176,16 +227,15 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     bq = min(block_q, Tq)
     bk = min(block_k, Tk)
     grid = (B, H, Tq // bq, Tk // bk)
-    # Inside shard_map the output type must declare which mesh axes it
-    # varies over (VMA tracking); it varies exactly where the inputs do.
-    vma = frozenset()
-    for x in (q, k, v):
-        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    vma = _vma_of(q, k, v, q_off, k_off)
+    offs = (jnp.asarray(q_off, jnp.int32).reshape(1),
+            jnp.asarray(k_off, jnp.int32).reshape(1))
     out, lse = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, causal=causal,
+        functools.partial(_flash_kernel, scale=scale, masked=masked,
                           num_k=Tk // bk),
         grid=grid,
         in_specs=[
+            _smem_spec(), _smem_spec(),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
@@ -204,35 +254,32 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pltpu.VMEM((bq, 1), jnp.float32),   # normalizer l
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*offs, qt, kt, vt)
     return out.transpose(0, 2, 1, 3), lse
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
-                         dq_ref, dq_acc, *, scale, causal, num_k):
+def _flash_bwd_dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
+                         lse_ref, dvec_ref, dq_ref, dq_acc, *, scale,
+                         masked, num_k):
     # Grid (B, H, nQ, nK), K innermost; dQ for one Q block accumulates in
     # scratch across the K sweep. p is recomputed from the saved
     # logsumexp — the [T, T] matrix never exists.
     bq, bk = q_ref.shape[2], k_ref.shape[2]
     i, j = pl.program_id(2), pl.program_id(3)
+    q_off, k_off = qoff_ref[0], koff_ref[0]
 
     @pl.when(j == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    live = (j * bk <= (i + 1) * bq - 1) if causal else True
-
-    @pl.when(live)
+    @pl.when(_block_live(masked, i, j, bq, bk, q_off, k_off))
     def _fold():
         qb = q_ref[0, 0, :, :].astype(jnp.float32)
         kb = k_ref[0, 0, :, :].astype(jnp.float32)
         vb = v_ref[0, 0, :, :].astype(jnp.float32)
         dob = do_ref[0, 0, :, :].astype(jnp.float32)
         s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s = _mask_scores(s, masked, i, j, bq, bk, q_off, k_off)
         p = jnp.exp(s - lse_ref[0, 0, :, :])            # [bq, bk]
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - dvec_ref[0, 0, :, :]) * scale
@@ -244,32 +291,28 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
         dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
-                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                          num_q):
+def _flash_bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
+                          lse_ref, dvec_ref, dk_ref, dv_ref, dk_acc,
+                          dv_acc, *, scale, masked, num_q):
     # Grid (B, H, nK, nQ), Q innermost; dK/dV for one K block accumulate
     # in scratch across the Q sweep (the transposed iteration of dq).
     bq, bk = q_ref.shape[2], k_ref.shape[2]
     j, i = pl.program_id(2), pl.program_id(3)   # j: K block, i: Q block
+    q_off, k_off = qoff_ref[0], koff_ref[0]
 
     @pl.when(i == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    live = ((i + 1) * bq - 1 >= j * bk) if causal else True
-
-    @pl.when(live)
+    @pl.when(_block_live(masked, i, j, bq, bk, q_off, k_off))
     def _fold():
         qb = q_ref[0, 0, :, :].astype(jnp.float32)
         kb = k_ref[0, 0, :, :].astype(jnp.float32)
         vb = v_ref[0, 0, :, :].astype(jnp.float32)
         dob = do_ref[0, 0, :, :].astype(jnp.float32)
         s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s = _mask_scores(s, masked, i, j, bq, bk, q_off, k_off)
         p = jnp.exp(s - lse_ref[0, 0, :, :])            # [bq, bk]
         dv_acc[:] = dv_acc[:] + jnp.dot(
             p.T, dob, preferred_element_type=jnp.float32)
@@ -284,34 +327,33 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
         dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                    interpret):
-    """dQ/dK/dV via the two backward kernels; [B, T, H, D] layout."""
+def _flash_backward(q, k, v, q_off, k_off, g, lse, dvec, masked, scale,
+                    block_q, block_k, interpret):
+    """dQ/dK/dV via the two backward kernels; [B, T, H, D] layout.
+    ``dvec`` is [B, H, Tq, 1] — rowsum(dO*O) minus the lse cotangent."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     bq = min(block_q, Tq)
     bk = min(block_k, Tk)
     qt, kt, vt, dot = (x.transpose(0, 2, 1, 3) for x in (q, k, v, g))
-    # D_i = rowsum(dO * O) — tiny elementwise reduce; XLA fuses it
-    dvec = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                   axis=-1).transpose(0, 2, 1)[..., None]      # [B, H, Tq, 1]
-    vma = frozenset()
-    for x in (q, k, v, g):
-        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    vma = _vma_of(q, k, v, q_off, k_off, g)
+    offs = (jnp.asarray(q_off, jnp.int32).reshape(1),
+            jnp.asarray(k_off, jnp.int32).reshape(1))
 
     q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
     row_spec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0))
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, masked=masked,
                           num_k=Tk // bk),
         grid=(B, H, Tq // bq, Tk // bk),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        in_specs=[_smem_spec(), _smem_spec(),
+                  q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, dvec)
+    )(*offs, qt, kt, vt, dot, lse, dvec)
 
     # transposed grid: K outer, Q inner
     q_spec_t = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
@@ -319,9 +361,10 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     row_spec_t = pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale,
-                          causal=causal, num_q=Tq // bq),
+                          masked=masked, num_q=Tq // bq),
         grid=(B, H, Tk // bk, Tq // bq),
-        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+        in_specs=[_smem_spec(), _smem_spec(),
+                  q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
                   row_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
         out_shape=[
@@ -331,30 +374,58 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, dvec)
+    )(*offs, qt, kt, vt, dot, lse, dvec)
     return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
             dv.transpose(0, 2, 1, 3))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _int_zero_cotangent(x):
+    import numpy as np
+
+    return np.zeros(jnp.shape(x), jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_with_lse(q, k, v, q_off, k_off, masked, scale, block_q, block_k,
+                    interpret):
+    """Core primitive: (out, lse) with global-offset causal masking.
+    The lse output is a first-class differentiable result — the ring merge
+    consumes it, so its cotangent must flow (see _flash_with_lse_bwd)."""
+    return _flash_forward(q, k, v, q_off, k_off, masked, scale, block_q,
+                          block_k, interpret)
+
+
+def _flash_with_lse_fwd(q, k, v, q_off, k_off, masked, scale, block_q,
+                        block_k, interpret):
+    out, lse = _flash_forward(q, k, v, q_off, k_off, masked, scale,
+                              block_q, block_k, interpret)
+    return (out, lse), (q, k, v, q_off, k_off, out, lse)
+
+
+def _flash_with_lse_bwd(masked, scale, block_q, block_k, interpret, res,
+                        gs):
+    q, k, v, q_off, k_off, out, lse = res
+    g, g_lse = gs
+    # ds = p * (dp - rowsum(dO*O) + g_lse): the lse cotangent enters the
+    # softmax-jacobian row term with opposite sign to D_i, so both ride
+    # the same dvec input of the kernels (d lse / d s_k = p_k).
+    dvec = (jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).transpose(0, 2, 1)[..., None]
+            - g_lse.astype(jnp.float32))                 # [B, H, Tq, 1]
+    dq, dk, dv = _flash_backward(
+        q, k, v, q_off, k_off, g, lse, dvec, masked, scale, block_q,
+        block_k, interpret)
+    return (dq, dk, dv, _int_zero_cotangent(q_off),
+            _int_zero_cotangent(k_off))
+
+
+_flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
+
+
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                          interpret)[0]
-
-
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                              interpret)
-    return out, (q, k, v, out, lse)
-
-
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
-    return _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
-                           block_k, interpret)
-
-
-_flash.defvjp(_flash_fwd, _flash_bwd)
+    zero = jnp.zeros((), jnp.int32)
+    return _flash_with_lse(q, k, v, zero, zero, causal, scale, block_q,
+                           block_k, interpret)[0]
 
 
 def kernel_supported(q_shape, k_shape, block_q: int, block_k: int) -> bool:
@@ -396,3 +467,83 @@ def flash_attention(
         return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
     return blockwise_attention(q, k, v, causal=causal, scale=scale,
                                block_k=block_k)
+
+
+# -------------------------------------------------------- ring flash attn
+def ring_flash_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Ring attention with the flash kernel doing each step's blockwise
+    math — call INSIDE shard_map with the sequence axis sharded along
+    ``axis_name`` (drop-in for ring_attention.ring_attention_local).
+
+    Each of the N ring steps runs the offset-masked flash kernel on the
+    resident Q shard against the visiting K/V shard (global positions via
+    q_off/k_off, so diagonal steps are causal, earlier shards fully kept,
+    later shards fully skipped) and returns (out_s, lse_s). Shards merge by
+    logsumexp weighting — exact attention over the full sequence. Forward
+    per-device memory is O(T/N); training stores each step's visiting K/V
+    shard as AD residuals (O(T) per device across the n steps) — wrap the
+    caller in jax.checkpoint (the LM family's ``remat=True``) to trade
+    that back to O(T/N). K/V rotate one ICI hop per step (ppermute); XLA
+    overlaps the hop with the kernel. Gradients flow through the kernels'
+    custom VJP at every step.
+    """
+    n = jax.lax.axis_size(axis_name)
+    r = jax.lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    # Pallas path: compiled on TPU, interpreter only if explicitly asked
+    # (the interpreter can't track varying-manual-axes, so it only works
+    # under check_vma=False — kernel-level tests). Everywhere else the
+    # per-step math runs as the pure-jnp offset blockwise scan: identical
+    # numerics, ordinary AD, no pallas involved.
+    use_kernel = (kernel_supported(q.shape, k.shape, block_q, block_k)
+                  and (interpret is True
+                       or (interpret is None
+                           and jax.default_backend() == "tpu")))
+    interpret = bool(interpret) if interpret is not None else False
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    q_off = (r * Tq).astype(jnp.int32)
+
+    def step_fn(carry, s):
+        acc, lse_run, k_cur, v_cur = carry
+        src = ((r - s) % n).astype(jnp.int32)     # original owner of k_cur
+        if use_kernel:
+            o_s, lse_s = _flash_with_lse(
+                q, k_cur, v_cur, q_off, src * Tk, causal, scale,
+                min(block_q, Tq), min(block_k, Tk), interpret)
+            lse_s = lse_s[..., 0].transpose(0, 2, 1)   # -> [B, Tq, H]
+        else:
+            o_s, lse_s = blockwise_attention(
+                q, k_cur, v_cur, causal=causal, scale=scale,
+                block_k=block_k, q_off=q_off, k_off=src * Tk,
+                return_lse=True)
+        lse_new = jnp.logaddexp(lse_run, lse_s)
+        acc = (acc * jnp.exp(lse_run - lse_new)[..., None]
+               + o_s.astype(jnp.float32)
+               * jnp.exp(lse_s - lse_new)[..., None])
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc, lse_new, k_nxt, v_nxt), None
+
+    acc0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    lse0 = jnp.full((B, Tq, H), _NEG_INF, jnp.float32)
+    # the axis index r makes every step output vary over the ring axis, so
+    # ALL carries must be varying — even when the inputs arrive replicated
+    acc0, lse0, k, v = (_pcast_varying(x, (axis_name,))
+                        for x in (acc0, lse0, k, v))
+    (acc, _, _, _), _ = jax.lax.scan(
+        step_fn, (acc0, lse0, k, v), jnp.arange(n))
+    return acc.astype(q.dtype)
