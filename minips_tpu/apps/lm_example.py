@@ -69,11 +69,12 @@ def _flags(parser):
                              "training")
     parser.add_argument("--attn", default="reference",
                         choices=["reference", "flash"],
-                        help="dp layout attention: full-scores XLA or the "
-                             "fused O(T)-memory flash kernel "
-                             "(ops/flash_attention.py) — the win is at "
-                             "long --seq_len, where full scores thrash or "
-                             "OOM HBM")
+                        help="dp/sp layout attention: full-scores XLA or "
+                             "the fused O(T)-memory flash kernels "
+                             "(ops/flash_attention.py; on sp this is ring "
+                             "flash attention) — the win is at long "
+                             "--seq_len, where full scores thrash or OOM "
+                             "HBM")
     parser.add_argument("--max_len", type=int, default=None,
                         help="positional-embedding capacity (default: "
                              f"{MODEL['max_len']}, auto-grown to "

@@ -1,4 +1,10 @@
-"""Distributed SSP logistic regression — the multi-process smoke workload.
+"""Distributed SSP training — the multi-process smoke workload.
+
+``--model lr`` (default) is sparse-free logistic regression; ``--model
+mlp`` is the 3-layer MLP on MNIST-shaped data — the BASELINE.json config
+"3-layer MLP on MNIST, SSP staleness = 4" — through the very same
+SSPTrainer (it is model-agnostic: any jitted (params, batch) -> (params,
+loss) step).
 
 The reference's distributed smoke story is its launch scripts run against a
 hostfile of localhost entries: N real processes, real zmq over loopback
@@ -30,9 +36,14 @@ import time
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=["lr", "mlp"], default="lr",
+                    help="lr: logistic regression; mlp: 3-layer MLP on "
+                         "MNIST-shaped data (BASELINE.json config 2)")
     ap.add_argument("--iters", type=int, default=60)
     ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--dim", type=int, default=None,
+                    help="lr: feature dim (default 64); mlp: fixed at 784 "
+                         "(MNIST-shaped), passing --dim is an error")
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--mode", choices=["bsp", "ssp", "asp"], default="ssp")
     ap.add_argument("--staleness", type=int, default=2)
@@ -59,7 +70,6 @@ def main(argv=None) -> int:
     from minips_tpu.comm.heartbeat import HeartbeatMonitor
     from minips_tpu.data import synthetic
     from minips_tpu.launch import init_from_env
-    from minips_tpu.models import lr as lr_model
     from minips_tpu.train.ssp_trainer import PeerFailureError, SSPTrainer
 
     rank, nprocs, bus = init_from_env()
@@ -67,14 +77,28 @@ def main(argv=None) -> int:
                  "asp": float("inf")}[args.mode]
 
     # my shard: different seed per rank = disjoint data (SURVEY.md §2.2 DP)
-    data = synthetic.classification_dense(
-        n=args.batch * 8, dim=args.dim, seed=100 + rank)
+    if args.model == "mlp":
+        if args.dim is not None:
+            ap.error("--dim applies to --model lr only (mlp input is "
+                     "fixed at 784, MNIST-shaped)")
+        from minips_tpu.models import mlp as mlp_model
 
-    params = lr_model.init(args.dim)
+        data = synthetic.mnist_like(n=args.batch * 8, seed=100 + rank)
+        params = mlp_model.init(jax.random.PRNGKey(0),
+                                sizes=(784, 256, 128, 10))
+        loss_fn = mlp_model.loss
+    else:
+        from minips_tpu.models import lr as lr_model
+
+        dim = args.dim if args.dim is not None else 64
+        data = synthetic.classification_dense(
+            n=args.batch * 8, dim=dim, seed=100 + rank)
+        params = lr_model.init(dim)
+        loss_fn = lr_model.loss_dense
 
     @jax.jit
     def local_step(p, batch):
-        loss, g = jax.value_and_grad(lr_model.loss_dense)(p, batch)
+        loss, g = jax.value_and_grad(loss_fn)(p, batch)
         new = jax.tree.map(lambda w, gw: w - args.lr * gw / nprocs, p, g)
         return new, loss
 

@@ -188,9 +188,12 @@ def apply_sp(params, tokens_local, shift, *, heads=4, axis_name=DATA_AXIS,
 
         attn = lambda q, k, v: ring_flash_attention_local(  # noqa: E731
             q, k, v, axis_name=axis_name, causal=True)
-    else:
+    elif attn_impl == "reference":
         attn = lambda q, k, v: ring_attention_local(  # noqa: E731
             q, k, v, axis_name=axis_name, causal=True)
+    else:
+        raise ValueError(f"unknown attn_impl {attn_impl!r} "
+                         "(expected 'reference' or 'flash')")
     return _forward(params, tokens_local, pos, heads, attn,
                     compute_dtype, remat=remat)[0]
 

@@ -127,6 +127,19 @@ def test_ssp_on_native_mailbox():
 
 
 @pytest.mark.slow
+def test_ssp_mlp_staleness4():
+    """BASELINE.json config 2 — 3-layer MLP (MNIST-shaped), SSP s=4 —
+    through the same SSPTrainer: skew bounded, replicas agree, loss falls."""
+    res = run_job(3, ["--model", "mlp", "--mode", "ssp", "--staleness", "4",
+                      "--lr", "0.05", "--slow-rank", "1", "--slow-ms", "30"])
+    for r in res:
+        assert r["event"] == "done"
+        assert r["max_skew_seen"] <= 5       # s+1 pre-gate bound
+        assert r["loss_last"] < r["loss_first"]
+    assert_replicas_agree(res)
+
+
+@pytest.mark.slow
 def test_two_processes_converge_better_than_start():
     res = run_job(2, ["--mode", "ssp", "--staleness", "1"], iters=50)
     for r in res:
