@@ -92,8 +92,17 @@ def _read_python(path: str) -> dict:
     }
 
 
-def read_criteo(path: str, use_native: bool = True) -> dict:
-    """Returns dict(y, dense, dense_mask, cat) — see module docstring."""
+def read_criteo(path: str, use_native: bool = True,
+                shared: bool = False) -> dict:
+    """Returns dict(y, dense, dense_mask, cat) — see module docstring.
+    ``shared=True``: under the launcher, only the host's local leader
+    parses; colocated processes mmap the same copy (data/shm_store.py)."""
+    if shared:
+        from minips_tpu.data.shm_store import make_tag, shared_load
+
+        tag = make_tag("criteo", path)
+        return shared_load(tag, lambda: read_criteo(
+            path, use_native=use_native, shared=False))
     if use_native:
         try:
             from minips_tpu.data.native import read_criteo_native

@@ -27,9 +27,17 @@ def write_libsvm(path: str, y: np.ndarray, idx: np.ndarray,
 
 
 def read_libsvm(path: str, max_features: int | None = None,
-                use_native: bool = True):
+                use_native: bool = True, shared: bool = False):
     """Returns dict(y [N] float32, idx [N, F] int32, val [N, F] float32,
-    mask [N, F] float32)."""
+    mask [N, F] float32). ``shared=True``: under the launcher, only the
+    host's local leader parses; colocated processes mmap the same copy
+    (data/shm_store.py)."""
+    if shared:
+        from minips_tpu.data.shm_store import make_tag, shared_load
+
+        tag = make_tag("libsvm", path, max_features)
+        return shared_load(tag, lambda: read_libsvm(
+            path, max_features, use_native=use_native, shared=False))
     if use_native:
         try:
             from minips_tpu.data.native import read_libsvm_native

@@ -66,8 +66,20 @@ def child_env(rank: int, hosts: list[str], base_port: int) -> dict[str, str]:
     env["MINIPS_NUM_PROCS"] = str(len(hosts))
     # processes COLOCATED on this rank's host — what host-resource
     # divisions (e.g. native parse threads) should divide by, not the
-    # world size
-    env["MINIPS_LOCAL_PROCS"] = str(hosts.count(hosts[rank]))
+    # world size. Local aliases normalize to one key (a hostfile mixing
+    # 'localhost' and '127.0.0.1' is one machine — same rule as
+    # bus_addresses; two would-be leaders would race the shared store).
+    def _hkey(h):
+        return "127.0.0.1" if h in _LOCAL_NAMES else h
+
+    keys = [_hkey(h) for h in hosts]
+    env["MINIPS_LOCAL_PROCS"] = str(keys.count(keys[rank]))
+    # my index among those colocated processes (0 = local leader, e.g.
+    # the one that parses into the shared-memory sample store)
+    env["MINIPS_LOCAL_RANK"] = str(keys[:rank].count(keys[rank]))
+    # one id per launcher invocation: namespaces shared-memory segments so
+    # a relaunch never attaches to a crashed run's stale store
+    env["MINIPS_RUN_ID"] = f"{os.getpid()}"
     env["MINIPS_BUS_ADDRS"] = ",".join(bus_addresses(hosts, base_port))
     env["MINIPS_COORDINATOR"] = f"{hosts[0]}:{base_port + 1000}"
     return env
@@ -76,6 +88,11 @@ def child_env(rank: int, hosts: list[str], base_port: int) -> dict[str, str]:
 def spawn(hosts: list[str], argv: list[str], base_port: int = 5700,
           stdout=None) -> list[subprocess.Popen]:
     """Spawn one process per host entry; returns live Popen handles."""
+    from minips_tpu.data.shm_store import sweep_stale_segments
+
+    # a SIGKILLed run never reaches its atexit cleanup — reclaim any
+    # dataset-sized shared-store segments whose launcher is dead
+    sweep_stale_segments()
     procs = []
     for rank, host in enumerate(hosts):
         env = child_env(rank, hosts, base_port)

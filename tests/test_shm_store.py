@@ -1,0 +1,150 @@
+"""Shared-memory sample store — parse-once-per-host semantics with REAL
+processes (the launcher's colocated deployment, SURVEY.md §1)."""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu.data.shm_store import shared_load
+
+WORKER = r"""
+import json, os, sys
+import numpy as np
+from minips_tpu.data.shm_store import shared_load
+from minips_tpu.data.libsvm import read_libsvm
+
+marker = sys.argv[1]      # loader invocations are counted via marker files
+path = sys.argv[2]
+
+def loader():
+    open(f"{marker}.{os.environ['MINIPS_LOCAL_RANK']}", "w").close()
+    return read_libsvm(path)
+
+data = shared_load("t1", loader)
+print(json.dumps({
+    "rank": os.environ["MINIPS_LOCAL_RANK"],
+    "sum": float(np.sum(data["val"])),
+    "rows": int(data["y"].shape[0]),
+    "mapped": all(isinstance(v, np.memmap) for v in data.values())
+              if os.environ["MINIPS_LOCAL_RANK"] != "0" else None,
+}))
+"""
+
+
+def _write_libsvm(path, rows=64, dim=10, seed=0):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(rows):
+            y = rng.integers(0, 2)
+            feats = sorted(rng.choice(dim, size=4, replace=False))
+            cols = " ".join(f"{j + 1}:{rng.uniform():.4f}" for j in feats)
+            f.write(f"{y} {cols}\n")
+
+
+def test_shared_load_single_process_passthrough():
+    calls = []
+    out = shared_load("solo", lambda: (calls.append(1),
+                                       {"x": np.arange(4)})[1],
+                      local_rank=0, local_procs=1)
+    assert calls == [1]
+    np.testing.assert_array_equal(out["x"], np.arange(4))
+
+
+def test_shared_load_multiprocess_parse_once(tmp_path):
+    """3 colocated processes: exactly one parse, identical zero-copy
+    views for the attachers."""
+    svm = tmp_path / "d.svm"
+    _write_libsvm(str(svm))
+    marker = str(tmp_path / "loaded")
+    script = tmp_path / "w.py"
+    script.write_text(WORKER)
+    procs = []
+    for lr in range(3):
+        env = dict(os.environ)
+        env.update({"MINIPS_LOCAL_RANK": str(lr), "MINIPS_LOCAL_PROCS": "3",
+                    "MINIPS_RUN_ID": f"test{os.getpid()}",
+                    "JAX_PLATFORMS": "cpu", "MINIPS_FORCE_CPU": "1"})
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), marker, str(svm)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=120)
+        assert p.returncode == 0, stderr[-2000:]
+        outs.append(json.loads(stdout.strip().splitlines()[-1]))
+    # exactly one loader invocation, by the local leader
+    loaded = [f for f in os.listdir(tmp_path) if f.startswith("loaded.")]
+    assert loaded == ["loaded.0"], loaded
+    sums = {o["sum"] for o in outs}
+    rows = {o["rows"] for o in outs}
+    assert len(sums) == 1 and len(rows) == 1, outs
+    # attachers got memmap views, not copies
+    assert all(o["mapped"] for o in outs if o["rank"] != "0"), outs
+
+
+def test_shared_load_attacher_timeout():
+    with pytest.raises(TimeoutError):
+        shared_load("never", lambda: {}, local_rank=1, local_procs=2,
+                    timeout=0.3)
+
+
+def test_sweep_reclaims_dead_runs(tmp_path):
+    """Segments namespaced by a dead launcher pid are deleted; a live
+    run's and non-pid (test) runs are kept."""
+    from minips_tpu.data.shm_store import sweep_stale_segments
+
+    dead = str(tmp_path / "minips_shm_999999999_tag.x.bin")   # no such pid
+    live = str(tmp_path / f"minips_shm_{os.getpid()}_tag.x.bin")
+    named = str(tmp_path / "minips_shm_testrun_tag.x.bin")
+    for p in (dead, live, named):
+        open(p, "wb").close()
+    removed = sweep_stale_segments(str(tmp_path))
+    assert removed == 1
+    assert not os.path.exists(dead)
+    assert os.path.exists(live) and os.path.exists(named)
+
+
+def test_tombstone_fails_late_attacher_fast(tmp_path):
+    """A peer arriving after the leader reclaimed the store gets an
+    immediate, accurate error — not a full-timeout poll."""
+    import minips_tpu.data.shm_store as shm
+
+    os.environ["MINIPS_RUN_ID"] = "tomb"
+    try:
+        base, _ = shm._names("late", str(tmp_path))
+        shm._atomic_write(base + ".tombstone", b"1")
+        t0 = time.time()
+        with pytest.raises(RuntimeError, match="already exited"):
+            shared_load("late", lambda: {}, local_rank=1, local_procs=2,
+                        directory=str(tmp_path), timeout=30.0)
+        assert time.time() - t0 < 5.0
+    finally:
+        os.environ.pop("MINIPS_RUN_ID", None)
+
+
+def test_run_id_namespacing(tmp_path, monkeypatch):
+    """Two different MINIPS_RUN_IDs never share segments."""
+    import minips_tpu.data.shm_store as shm
+
+    # this leader has no real peers; don't stall interpreter exit waiting
+    monkeypatch.setattr(shm, "_CLEANUP_GRACE_S", 0.1)
+    env_backup = os.environ.get("MINIPS_RUN_ID")
+    try:
+        os.environ["MINIPS_RUN_ID"] = "runA"
+        shared_load("ns", lambda: {"x": np.ones(3)}, local_rank=0,
+                    local_procs=2, directory=str(tmp_path))
+        os.environ["MINIPS_RUN_ID"] = "runB"
+        with pytest.raises(TimeoutError):  # runB's segments don't exist
+            shared_load("ns", lambda: {}, local_rank=1, local_procs=2,
+                        directory=str(tmp_path), timeout=0.3)
+    finally:
+        if env_backup is None:
+            os.environ.pop("MINIPS_RUN_ID", None)
+        else:
+            os.environ["MINIPS_RUN_ID"] = env_backup
