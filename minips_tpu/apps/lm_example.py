@@ -17,6 +17,10 @@ demonstrates the long-context/model-parallel paths end-to-end. Layouts:
   role distributed per-tensor instead of per-key-range).
 - ``--layout pp``: 2D mesh — batch over ``data``, layers GPipe-pipelined
   over ``model`` (``--tp`` stages, ``--microbatches`` in flight).
+- ``--layout ep``: MoE-LM — every block's FFN is a top-k-routed expert
+  layer with the expert stacks sharded over the mesh; tokens reach their
+  experts via two all_to_alls per block (``--experts``, ``--k_top``,
+  ``--capacity``).
 
 Usage: python -m minips_tpu.apps.lm_example --num_iters 200 --layout sp
        python -m minips_tpu.apps.lm_example --layout tp --tp 2
@@ -51,10 +55,20 @@ MODEL = dict(vocab=256, dim=64, heads=4, depth=2, max_len=1024)
 
 def _flags(parser):
     parser.add_argument("--layout", default="dp",
-                        choices=["dp", "sp", "tp", "pp"],
+                        choices=["dp", "sp", "tp", "pp", "ep"],
                         help="dp: batch sharded; sp: sequence sharded "
                              "(ring attention); tp: Megatron tensor "
-                             "parallel; pp: GPipe pipeline")
+                             "parallel; pp: GPipe pipeline; ep: MoE-LM "
+                             "with experts sharded over the mesh")
+    parser.add_argument("--experts", type=int, default=8,
+                        help="ep layout: number of experts (must divide "
+                             "by the device count)")
+    parser.add_argument("--k_top", type=int, default=1,
+                        help="ep layout: experts per token (1=Switch, "
+                             "2=GShard)")
+    parser.add_argument("--capacity", type=int, default=0,
+                        help="ep layout: slots per expert per source "
+                             "device (0 = 2x the even share)")
     parser.add_argument("--seq_len", type=int, default=128)
     parser.add_argument("--tp", type=int, default=2,
                         help="model-axis size for tp/pp layouts")
@@ -98,6 +112,8 @@ def run(cfg: Config, args, metrics) -> dict:
                          f"(got {layout})")
     if layout in ("tp", "pp"):
         return _run_model_parallel(cfg, args, metrics, layout, seq_len)
+    if layout == "ep":
+        return _run_ep(cfg, args, metrics, seq_len)
     mesh = make_mesh()
     n_shards = mesh.shape[DATA_AXIS]
     if seq_len % n_shards:
@@ -210,12 +226,48 @@ def _maybe_checkpointer(cfg, args, table):
     return ckpt, start          # always---resume wrapper starts at 0
 
 
+def _optax_train(cfg, args, metrics, mesh, params, sharded_loss,
+                 seq_len, layout, **log_fields) -> dict:
+    """Shared tail of the non-PS layouts (tp/pp/ep): jitted
+    value_and_grad + optax adam with donated buffers, data-parallel batch
+    placement, TrainLoop, metrics."""
+    import optax
+
+    tx = optax.adam(cfg.table.lr)
+    opt = tx.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, o, toks):
+        loss, g = jax.value_and_grad(sharded_loss)(p, toks)
+        updates, o = tx.update(g, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    data = _load_data(cfg, args, seq_len)
+    batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    state = {"p": params, "o": opt}
+
+    def do_step(batch):
+        toks = jax.device_put(jnp.asarray(batch["tokens"]), batch_sharding)
+        state["p"], state["o"], loss = train_step(state["p"], state["o"],
+                                                  toks)
+        return loss
+
+    batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
+    loop = TrainLoop(do_step, batches, metrics=metrics,
+                     log_every=cfg.train.log_every,
+                     batch_size=cfg.train.batch_size)
+    losses = loop.run(cfg.train.num_iters)
+    metrics.log(final_loss=losses[-1], layout=layout, seq_len=seq_len,
+                tokens_per_sec=loop.timer.samples_per_sec * seq_len,
+                **log_fields)
+    return {"losses": losses, "params": state["p"], "layout": layout,
+            "samples_per_sec": loop.timer.samples_per_sec}
+
+
 def _run_model_parallel(cfg, args, metrics, layout, seq_len) -> dict:
     """tp/pp layouts: 2D (data x model) mesh, weights + optimizer state
     sharded over the model axis (per-tensor weight-update sharding),
     value_and_grad outside the shard_map, optax step under one jit."""
-    import optax
-
     from minips_tpu.parallel.mesh import MODEL_AXIS
     from minips_tpu.parallel.pipeline import stack_layers
 
@@ -267,36 +319,56 @@ def _run_model_parallel(cfg, args, metrics, layout, seq_len) -> dict:
             shard_fn, mesh=mesh,
             in_specs=(specs, P(DATA_AXIS)), out_specs=P())(p, toks)
 
-    tx = optax.adam(cfg.table.lr)
-    opt = tx.init(params)
+    return _optax_train(cfg, args, metrics, mesh, params, sharded_loss,
+                        seq_len, layout, tp=tp_size)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(p, o, toks):
-        loss, g = jax.value_and_grad(sharded_loss)(p, toks)
-        updates, o = tx.update(g, o, p)
-        return optax.apply_updates(p, updates), o, loss
 
-    data = _load_data(cfg, args, seq_len)
-    batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+def _run_ep(cfg, args, metrics, seq_len) -> dict:
+    """ep layout: MoE-LM, batch data-parallel, experts sharded over the
+    same axis; dispatch/return ride two all_to_alls per block
+    (parallel/moe.py). Optimizer state shards with the expert weights
+    (weight-update sharding, PS-server-role per-expert)."""
+    mesh = make_mesh()
+    n_dev = mesh.shape[DATA_AXIS]
+    model = _model_cfg(args, seq_len)
+    heads = model["heads"]
+    experts = getattr(args, "experts", 8)
+    k_top = getattr(args, "k_top", 1)
+    if not 1 <= k_top <= experts:
+        raise SystemExit(f"--k_top {k_top} must be in [1, --experts "
+                         f"{experts}] (0 would disable every MoE FFN)")
+    if experts % n_dev:
+        raise SystemExit(f"--experts {experts} must divide by the "
+                         f"{n_dev}-way mesh")
+    if cfg.train.batch_size % n_dev:
+        raise SystemExit(f"--batch_size {cfg.train.batch_size} must "
+                         f"divide by the {n_dev}-way mesh")
+    local_tokens = (cfg.train.batch_size // n_dev) * seq_len
+    capacity = getattr(args, "capacity", 0) or max(
+        2 * k_top * local_tokens // experts, 4)
 
-    state = {"p": params, "o": opt}
+    params = tfm.init_moe_lm(
+        jax.random.PRNGKey(cfg.train.seed), vocab=model["vocab"],
+        dim=model["dim"], heads=heads, depth=model["depth"],
+        max_len=model["max_len"], num_experts=experts)
+    specs = tfm.ep_lm_specs(params)
+    shardings = jax.tree.map(lambda sp: NamedSharding(mesh, sp), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    params = jax.tree.map(jax.device_put, params, shardings)
 
-    def do_step(batch):
-        toks = jax.device_put(jnp.asarray(batch["tokens"]), batch_sharding)
-        state["p"], state["o"], loss = train_step(state["p"], state["o"],
-                                                  toks)
-        return loss
+    def sharded_loss(p, toks):
+        def shard_fn(p_, t_):
+            logits, aux = tfm.apply_ep(p_, t_[:, :-1], heads=heads,
+                                       capacity=capacity, k_top=k_top)
+            nll = jax.lax.pmean(tfm.nll(logits, t_[:, 1:]), DATA_AXIS)
+            return nll + 0.01 * aux   # router load-balance pressure
+        return jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(specs, P(DATA_AXIS)), out_specs=P())(p, toks)
 
-    batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
-    loop = TrainLoop(do_step, batches, metrics=metrics,
-                     log_every=cfg.train.log_every,
-                     batch_size=cfg.train.batch_size)
-    losses = loop.run(cfg.train.num_iters)
-    metrics.log(final_loss=losses[-1], layout=layout, seq_len=seq_len,
-                tp=tp_size, tokens_per_sec=loop.timer.samples_per_sec
-                * seq_len)
-    return {"losses": losses, "params": state["p"], "layout": layout,
-            "samples_per_sec": loop.timer.samples_per_sec}
+    return _optax_train(cfg, args, metrics, mesh, params, sharded_loss,
+                        seq_len, "ep", experts=experts, k_top=k_top,
+                        capacity=capacity)
 
 
 def main():

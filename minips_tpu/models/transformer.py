@@ -314,7 +314,7 @@ def init_moe_lm(key, *, vocab: int = 256, dim: int = 64, heads: int = 4,
 
 
 def apply_moe_dense(params, tokens, *, heads=4, capacity: int,
-                    compute_dtype=jnp.bfloat16):
+                    compute_dtype=jnp.bfloat16, k_top: int = 1):
     """Single-program MoE-LM logits (oracle / one device):
     returns (logits, total aux loss)."""
     from minips_tpu.parallel.moe import moe_apply_dense
@@ -324,11 +324,12 @@ def apply_moe_dense(params, tokens, *, heads=4, capacity: int,
         lambda q, k, v: reference_attention(q, k, v, causal=True),
         compute_dtype,
         ffn_fn=lambda blk, x: moe_apply_dense(
-            blk["moe"], x, capacity=capacity, compute_dtype=compute_dtype))
+            blk["moe"], x, capacity=capacity, compute_dtype=compute_dtype,
+            k_top=k_top))
 
 
 def apply_ep(params, tokens_local, *, heads=4, axis_name=DATA_AXIS,
-             capacity: int, compute_dtype=jnp.bfloat16):
+             capacity: int, compute_dtype=jnp.bfloat16, k_top: int = 1):
     """Expert-parallel MoE-LM logits — call INSIDE shard_map with the
     batch sharded over ``axis_name``, attention weights replicated, and
     each block's expert stacks sharded per ``ep_lm_specs``. Attention runs
@@ -342,7 +343,7 @@ def apply_ep(params, tokens_local, *, heads=4, axis_name=DATA_AXIS,
         compute_dtype,
         ffn_fn=lambda blk, x: moe_apply_local(
             blk["moe"], x, axis_name=axis_name, capacity=capacity,
-            compute_dtype=compute_dtype))
+            compute_dtype=compute_dtype, k_top=k_top))
 
 
 def ep_lm_specs(params, axis_name=DATA_AXIS):

@@ -1,4 +1,5 @@
-"""Expert parallelism — Switch-style top-1 MoE FFN over a mesh axis.
+"""Expert parallelism — top-k MoE FFN over a mesh axis (Switch top-1 default,
+GShard-style top-2+ via ``k_top``).
 
 Beyond parity (the reference has no expert parallelism, SURVEY.md §2.2).
 Completes the framework's parallelism set (dp / sp ring attention / tp /
@@ -39,25 +40,40 @@ def init_moe(key, num_experts: int, dim: int, hidden: int):
     }
 
 
-def _dispatch_combine(x, router_w, num_experts: int, capacity: int):
-    """Route [N, D] tokens: returns (dispatch [N, E, C] f32 one-hot,
-    combine [N, E, C] f32 prob-weighted, frac [E], mean_p [E]) — the last
-    two are the raw load-balancing statistics for ``_aux_loss``."""
+def _dispatch_combine(x, router_w, num_experts: int, capacity: int,
+                      k_top: int = 1):
+    """Route [N, D] tokens to their top-``k_top`` experts: returns
+    (dispatch [N, E, C] f32 {0,1}, combine [N, E, C] f32 gate-weighted,
+    frac [E], mean_p [E]) — the last two are the raw load-balancing
+    statistics for ``_aux_loss``.
+
+    ``k_top=1`` is Switch; ``k_top=2`` is the GShard shape. Capacity slots
+    are assigned rank-major (every token's primary choice queues before
+    any secondary choice), so when capacity binds, primary routes survive
+    preferentially. Gates are the raw softmax probabilities of the chosen
+    experts (no top-k renormalization) — for k=1 this is exactly Switch's
+    straight-through combine weight."""
+    N = x.shape[0]
     logits = x @ router_w                              # [N, E]
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                # [N]
-    onehot = jax.nn.one_hot(expert, num_experts)       # [N, E]
-    # position of each token within its expert's queue (earliest-first)
-    pos = (jnp.cumsum(onehot, axis=0) * onehot).astype(jnp.int32) - 1
-    keep = (pos >= 0) & (pos < capacity)               # [N, E], -1 unrouted
-    slot = jax.nn.one_hot(pos, capacity)               # [N, E, C]
-    dispatch = slot * keep[..., None]
-    combine = dispatch * jnp.sum(probs * onehot, axis=-1)[:, None, None]
-    # Switch aux load-balancing statistics: fraction of tokens routed to
-    # each expert and mean router prob per expert. Returned raw (not yet
-    # combined) so the distributed path can pmean them BEFORE the product —
-    # mean-of-products would differ from the global loss.
-    frac = jnp.mean(onehot, axis=0)
+    gate, expert = jax.lax.top_k(probs, k_top)         # [N, k] each
+    onehots = jax.nn.one_hot(expert, num_experts)      # [N, k, E]
+    # queue position per (token, choice) within its expert, earliest-first
+    # across a rank-major flattening: [k*N, E]
+    flat = onehots.transpose(1, 0, 2).reshape(k_top * N, num_experts)
+    pos = (jnp.cumsum(flat, axis=0) * flat).astype(jnp.int32) - 1
+    keep = (pos >= 0) & (pos < capacity)               # -1 = not routed
+    slot = jax.nn.one_hot(pos, capacity)               # [kN, E, C]
+    disp = (slot * keep[..., None]).reshape(k_top, N, num_experts,
+                                            capacity)
+    dispatch = jnp.sum(disp, axis=0)                   # [N, E, C]
+    combine = jnp.sum(disp * gate.T[:, :, None, None], axis=0)
+    # Switch aux load-balancing statistics: fraction of tokens whose
+    # PRIMARY route is each expert and mean router prob per expert.
+    # Returned raw (not yet combined) so the distributed path can pmean
+    # them BEFORE the product — mean-of-products would differ from the
+    # global loss.
+    frac = jnp.mean(onehots[:, 0], axis=0)
     mean_p = jnp.mean(probs, axis=0)
     return dispatch, combine, frac, mean_p
 
@@ -76,14 +92,14 @@ def _expert_ffn(w_in, w_out, x, compute_dtype):
 
 
 def moe_apply_dense(params, x, *, capacity: int,
-                    compute_dtype=jnp.bfloat16):
+                    compute_dtype=jnp.bfloat16, k_top: int = 1):
     """Unsharded oracle: [N, D] -> ([N, D], aux_loss). Matches the
     distributed path exactly whenever capacity does not bind; when it
     does, drop patterns differ (one global queue per expert here vs one
     queue per (expert, source device) there)."""
     E = params["router"].shape[1]
     dispatch, combine, frac, mean_p = _dispatch_combine(
-        x, params["router"], E, capacity)
+        x, params["router"], E, capacity, k_top)
     slots = jnp.einsum("nec,nd->ecd", dispatch, x)     # [E, C, D]
     out_slots = _expert_ffn(params["w_in"], params["w_out"], slots,
                             compute_dtype)
@@ -92,7 +108,8 @@ def moe_apply_dense(params, x, *, capacity: int,
 
 
 def moe_apply_local(params_local, x_local, *, axis_name: str,
-                    capacity: int, compute_dtype=jnp.bfloat16):
+                    capacity: int, compute_dtype=jnp.bfloat16,
+                    k_top: int = 1):
     """Expert-parallel MoE — call INSIDE shard_map with tokens sharded
     [N_local, D] over ``axis_name``, router replicated, and w_in/w_out
     sharded on their expert dim (``ep_specs``). ``capacity`` is per-expert
@@ -107,7 +124,7 @@ def moe_apply_local(params_local, x_local, *, axis_name: str,
         raise ValueError(f"router knows {E} experts but {k} devices hold "
                          f"{e_local} each")
     dispatch, combine, frac, mean_p = _dispatch_combine(
-        x_local, params_local["router"], E, capacity)
+        x_local, params_local["router"], E, capacity, k_top)
     slots = jnp.einsum("nec,nd->ecd", dispatch, x_local)   # [E, C, D]
     # ship: expert block e_blk of every device -> device owning those
     # experts; receive my experts' slots from every source device
