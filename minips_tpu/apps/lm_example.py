@@ -89,6 +89,10 @@ def _flags(parser):
                              "flash attention) — the win is at long "
                              "--seq_len, where full scores thrash or OOM "
                              "HBM")
+    parser.add_argument("--accum", type=int, default=1,
+                        help="dp/sp: gradient-accumulation microbatches "
+                             "per step (effective batch = batch_size, "
+                             "activation memory = batch_size/accum)")
     parser.add_argument("--max_len", type=int, default=None,
                         help="positional-embedding capacity (default: "
                              f"{MODEL['max_len']}, auto-grown to "
@@ -110,6 +114,9 @@ def run(cfg: Config, args, metrics) -> dict:
         # silently training with different memory/perf than requested
         raise SystemExit(f"--attn flash is only wired into --layout dp/sp "
                          f"(got {layout})")
+    if getattr(args, "accum", 1) != 1 and layout not in ("dp", "sp"):
+        raise SystemExit(f"--accum is only wired into --layout dp/sp "
+                         f"(got {layout})")
     if layout in ("tp", "pp"):
         return _run_model_parallel(cfg, args, metrics, layout, seq_len)
     if layout == "ep":
@@ -128,11 +135,12 @@ def run(cfg: Config, args, metrics) -> dict:
 
     ckpt, start_step = _maybe_checkpointer(cfg, args, table)
 
+    accum = getattr(args, "accum", 1)
     if layout == "dp":
         step = table.make_step(
             functools.partial(tfm.grad_fn, heads=heads,
                               attn_impl=getattr(args, "attn", "reference")),
-            batch_spec=P(DATA_AXIS))
+            batch_spec=P(DATA_AXIS), accum=accum)
         batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
 
         def prep(batch):
@@ -158,7 +166,8 @@ def run(cfg: Config, args, metrics) -> dict:
         step = table.make_step(
             sp_grad,
             batch_spec={"tokens": {"inp": P(None, DATA_AXIS),
-                                   "tgt": P(None, DATA_AXIS)}})
+                                   "tgt": P(None, DATA_AXIS)}},
+            accum=accum)
         seq_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
 
         def prep(batch):

@@ -173,6 +173,7 @@ class DenseTable:
         batch_spec: Optional[PyTree] = None,
         jit: bool = True,
         comm: str = "float32",
+        accum: int = 1,
     ):
         """Fuse pull → grad → push → update into one SPMD program.
 
@@ -187,19 +188,65 @@ class DenseTable:
         ``comm`` compresses the two collectives' wire format ("bfloat16" or
         "int8"; EQuARX-style, see ops/quantized_comm.py). Params and the
         optimizer update stay float32 — only bytes-on-wire change.
+
+        ``accum`` > 1 splits each shard's batch into that many microbatches
+        and folds their grads in float32 under one ``lax.scan`` before the
+        single push/update — effective batch grows ``accum``x while
+        activation memory stays one microbatch's worth (one pull, one
+        push, one optimizer step per call, so PS clock semantics are
+        unchanged). The leading batch dim must divide by ``accum``.
         """
         n, padded = self.num_keys, self.padded
         num_workers = self.num_shards
         unravel, tx, reduce = self._unravel, self.tx, self.grad_reduce
         bspec = batch_spec if batch_spec is not None else P(DATA_AXIS)
+        if accum < 1:
+            raise ValueError(f"accum must be >= 1, got {accum}")
         from minips_tpu.ops.quantized_comm import (
             _check, quantized_all_gather, quantized_psum_scatter)
         _check(comm)  # eager: tracing happens on first step call
 
+        def _grads_flat(params, batch):
+            if accum == 1:
+                loss, grads = grad_fn(params, batch)
+                return loss, ravel_pytree(grads)[0]
+
+            def to_micro(x):
+                if x.shape[0] % accum:
+                    raise ValueError(
+                        f"batch dim {x.shape[0]} must divide by "
+                        f"accum={accum}")
+                return x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+
+            micro = jax.tree.map(to_micro, batch)
+
+            def fold(carry, mb):
+                loss_sum, gsum = carry
+                loss, grads = grad_fn(params, mb)
+                return (loss_sum + loss, gsum + ravel_pytree(grads)[0]), None
+
+            # fresh carries are axis-invariant but fold outputs vary
+            # wherever params OR batch do (a replicated batch still yields
+            # varying grads via the all-gathered params) — pcast keeps the
+            # scan carry type fixed
+            vma = frozenset()
+            for leaf in jax.tree.leaves((params, batch)):
+                vma = vma | getattr(jax.typeof(leaf), "vma", frozenset())
+            loss0, g0 = jnp.zeros((), jnp.float32), jnp.zeros(n)
+            need = tuple(sorted(vma))
+            if need:
+                loss0 = jax.lax.pcast(loss0, need, to="varying")
+                g0 = jax.lax.pcast(g0, need, to="varying")
+            (loss_sum, gsum), _ = jax.lax.scan(fold, (loss0, g0), micro)
+            if reduce == "sum":
+                # sum-semantics grad_fns: microbatch sums add up to the
+                # full-batch sum — averaging would scale grads by 1/accum
+                return loss_sum, gsum
+            return loss_sum / accum, gsum / accum
+
         def local_step(p_shard, opt_shard, batch):
             full = quantized_all_gather(p_shard, DATA_AXIS, comm)      # pull
-            loss, grads = grad_fn(unravel(full[:n]), batch)
-            gflat, _ = ravel_pytree(grads)
+            loss, gflat = _grads_flat(unravel(full[:n]), batch)
             gpad = jnp.zeros(padded, gflat.dtype).at[:n].set(gflat)
             g_shard = quantized_psum_scatter(gpad, DATA_AXIS, comm)    # push
             if reduce == "mean":

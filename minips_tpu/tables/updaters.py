@@ -10,12 +10,20 @@ it costs nothing under optax and apps want it.
 
 from __future__ import annotations
 
+from typing import Callable, Union
+
 import optax
 
 UPDATERS = ("sgd", "adagrad", "adam")
 
+# a float or an optax schedule (step -> lr); optax consumes either
+# directly, so warmup/cosine/decay schedules work on every updater:
+#   DenseTable(..., lr=optax.warmup_cosine_decay_schedule(...))
+LearningRate = Union[float, Callable[[int], float]]
 
-def make_updater(name: str, lr: float, **kwargs) -> optax.GradientTransformation:
+
+def make_updater(name: str, lr: LearningRate,
+                 **kwargs) -> optax.GradientTransformation:
     name = name.lower()
     if name == "sgd":
         return optax.sgd(lr, momentum=kwargs.get("momentum", 0.0) or None)

@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from minips_tpu.parallel.mesh import make_mesh
 from minips_tpu.tables.dense import DenseTable
 
 
@@ -123,3 +124,119 @@ def test_step_timer_warmup_zero():
     _time.sleep(0.01)
     timer.step(100)
     assert timer.samples_per_sec > 0
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum=k on a mean-loss model equals one step on the full batch:
+    grads average over microbatches exactly (f32 fold), so the update is
+    identical up to float reassociation."""
+    from minips_tpu.models import lr as lr_model
+
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(0)
+    dim = 16
+    X = rng.normal(size=(256, dim)).astype(np.float32)
+    y = (X @ rng.normal(size=dim) > 0).astype(np.float32)
+    batch = {"x": jnp.asarray(X), "y": jnp.asarray(y)}
+    grad_fn = jax.value_and_grad(
+        lambda p, b: lr_model.bce_with_logits(
+            lr_model.logits_dense(p, b["x"]), b["y"]))
+
+    losses = {}
+    params = {}
+    for accum in (1, 4):
+        t = DenseTable(lr_model.init(dim), mesh, name=f"a{accum}",
+                       updater="sgd", lr=0.5)
+        step = t.make_step(grad_fn, accum=accum)
+        losses[accum] = [float(t.step_inplace(step, batch))
+                        for _ in range(5)]
+        params[accum] = np.asarray(t.params)
+    np.testing.assert_allclose(losses[1], losses[4], atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(params[1], params[4], atol=1e-5, rtol=1e-5)
+
+
+def test_accum_rejects_ragged_batch():
+    from minips_tpu.models import lr as lr_model
+
+    mesh = make_mesh(8)
+    t = DenseTable(lr_model.init(4), mesh, name="rag", updater="sgd",
+                   lr=0.1)
+    grad_fn = jax.value_and_grad(
+        lambda p, b: lr_model.bce_with_logits(
+            lr_model.logits_dense(p, b["x"]), b["y"]))
+    step = t.make_step(grad_fn, accum=3)
+    batch = {"x": jnp.zeros((64, 4)), "y": jnp.zeros(64)}  # 64/8=8, 8%3!=0
+    with pytest.raises(ValueError, match="divide by"):
+        t.step_inplace(step, batch)
+
+
+def test_lr_schedule_callable():
+    """lr may be an optax schedule: step sizes follow the schedule (a
+    decaying schedule shrinks successive updates of a constant grad)."""
+    import optax
+
+    from minips_tpu.models import lr as lr_model
+
+    mesh = make_mesh(8)
+    sched = optax.piecewise_constant_schedule(1.0, {2: 0.1})
+    t = DenseTable(lr_model.init(4), mesh, name="sch", updater="sgd",
+                   lr=sched)
+    grad_fn = lambda p, b: (jnp.zeros(()),  # noqa: E731
+                            jax.tree.map(jnp.ones_like, p))
+    step = t.make_step(grad_fn)
+    batch = {"x": jnp.zeros((8, 4))}
+    n = t.num_keys  # the padded tail gets zero grads, so slice it off
+    before = np.asarray(t.params)[:n]
+    t.step_inplace(step, batch)         # lr 1.0
+    d1 = before - np.asarray(t.params)[:n]
+    t.step_inplace(step, batch)         # lr 1.0
+    mid = np.asarray(t.params)[:n]
+    t.step_inplace(step, batch)         # lr 0.1 after boundary
+    d3 = mid - np.asarray(t.params)[:n]
+    np.testing.assert_allclose(d1, 1.0, atol=1e-6)
+    np.testing.assert_allclose(d3, 0.1, atol=1e-6)
+
+
+def test_accum_sum_semantics_not_rescaled():
+    """grad_reduce='sum' with a summed loss: accum must not divide the
+    accumulated grads — microbatch sums already add to the batch sum."""
+    from minips_tpu.models import lr as lr_model
+
+    mesh = make_mesh(8)
+
+    def grad_fn(p, b):  # summed loss -> summed grads
+        def loss(p_):
+            logits = lr_model.logits_dense(p_, b["x"])
+            return jnp.sum((logits - b["y"]) ** 2)
+        return jax.value_and_grad(loss)(p)
+
+    rng = np.random.default_rng(1)
+    batch = {"x": jnp.asarray(rng.normal(size=(64, 4)), jnp.float32),
+             "y": jnp.asarray(rng.normal(size=64), jnp.float32)}
+    outs = {}
+    for accum in (1, 4):
+        t = DenseTable(lr_model.init(4), mesh, name=f"s{accum}",
+                       updater="sgd", lr=0.01, grad_reduce="sum")
+        step = t.make_step(grad_fn, accum=accum)
+        t.step_inplace(step, batch)
+        outs[accum] = np.asarray(t.params)[:t.num_keys]
+    np.testing.assert_allclose(outs[1], outs[4], atol=1e-5, rtol=1e-5)
+
+
+def test_accum_with_replicated_batch_spec():
+    """accum under batch_spec=P() (replicated batch): the scan carries
+    must still adopt the params' varying axes — this traced wrong before."""
+    from jax.sharding import PartitionSpec as P
+
+    from minips_tpu.models import lr as lr_model
+
+    mesh = make_mesh(8)
+    t = DenseTable(lr_model.init(4), mesh, name="rep", updater="sgd",
+                   lr=0.1)
+    grad_fn = jax.value_and_grad(
+        lambda p, b: lr_model.bce_with_logits(
+            lr_model.logits_dense(p, b["x"]), b["y"]))
+    step = t.make_step(grad_fn, batch_spec=P(), accum=4)
+    batch = {"x": jnp.zeros((16, 4)), "y": jnp.zeros(16)}
+    loss = t.step_inplace(step, batch)
+    assert jnp.isfinite(loss)
