@@ -226,9 +226,9 @@ def _maybe_checkpointer(cfg, args, table):
             or getattr(args, "checkpoint_dir", None))
     if not path:
         return None, 0
-    from minips_tpu.ckpt.checkpoint import Checkpointer
+    from minips_tpu.ckpt.orbax_backend import make_checkpointer
 
-    ckpt = Checkpointer(path, {"lm": table})
+    ckpt = make_checkpointer(path, {"lm": table})
     start = 0
     if getattr(args, "resume", False) and ckpt.list_steps():
         start = ckpt.restore()  # resume-if-present: first launch of an

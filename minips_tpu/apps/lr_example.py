@@ -72,8 +72,9 @@ def _run_dense(cfg, args, metrics, data, dim) -> dict:
 
     ck, start_step = None, 0
     if cfg.train.checkpoint_dir:
-        from minips_tpu.ckpt.checkpoint import Checkpointer
-        ck = Checkpointer(cfg.train.checkpoint_dir, {"weights": table})
+        from minips_tpu.ckpt.orbax_backend import make_checkpointer
+        ck = make_checkpointer(cfg.train.checkpoint_dir,
+                               {"weights": table})
         if ck.list_steps():  # resume-from-latest (SURVEY.md §3.5)
             start_step = ck.restore()
             metrics.log(resumed_from_step=start_step)

@@ -121,9 +121,10 @@ def main(argv=None) -> int:
     ckpt = None
     start_step = 0
     if args.checkpoint_dir and trainer is not None:
-        from minips_tpu.ckpt.checkpoint import Checkpointer
+        from minips_tpu.ckpt.orbax_backend import make_checkpointer
 
-        ckpt = Checkpointer(args.checkpoint_dir, {"ssp": trainer}, keep=2)
+        ckpt = make_checkpointer(args.checkpoint_dir, {"ssp": trainer},
+                                 keep=2)
         if args.resume:
             start_step = ckpt.restore()
 

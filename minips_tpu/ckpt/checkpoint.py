@@ -63,6 +63,10 @@ class Checkpointer:
             self._thread.join()
             self._thread = None
 
+    def close(self) -> None:
+        """Interface parity with the orbax backend: flush pending saves."""
+        self.wait()
+
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.dir, f"step_{step:010d}")
 

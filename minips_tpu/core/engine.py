@@ -236,9 +236,9 @@ class Engine:
     def make_checkpointer(self, directory: str, **kwargs):
         """Checkpointer over every table + controller this engine owns
         (reference Dump/Load, SURVEY.md §3.5)."""
-        from minips_tpu.ckpt.checkpoint import Checkpointer
+        from minips_tpu.ckpt.orbax_backend import make_checkpointer
 
-        return Checkpointer(directory, self.tables, self.controllers,
+        return make_checkpointer(directory, self.tables, self.controllers,
                             **kwargs)
 
     def barrier(self) -> None:

@@ -36,7 +36,7 @@ from jax.sharding import PartitionSpec as P
 
 from minips_tpu.parallel.mesh import DATA_AXIS, padded_size
 from minips_tpu.parallel.partition import RangePartitioner
-from minips_tpu.tables.updaters import make_updater
+from minips_tpu.tables.updaters import LearningRate, make_updater
 
 PyTree = Any
 
@@ -51,7 +51,7 @@ class DenseTable:
         *,
         name: str = "dense0",
         updater: str = "sgd",
-        lr: float = 0.1,
+        lr: LearningRate = 0.1,
         grad_reduce: str = "mean",
         tx: Optional[optax.GradientTransformation] = None,
     ):

@@ -113,3 +113,73 @@ def test_sgd_roundtrip_leafless_opt_state(mesh8, tmp_path):
     assert Checkpointer(str(tmp_path), {"d": d2}).restore() == 1
     np.testing.assert_allclose(np.asarray(d2.params), np.asarray(d1.params),
                                rtol=1e-6)
+
+
+class TestOrbaxBackend:
+    """Same contract as the native backend, through orbax.checkpoint."""
+
+    def test_roundtrip_resumes_identically(self, mesh8, tmp_path):
+        from minips_tpu.ckpt.orbax_backend import make_checkpointer
+
+        d1, s1 = _trained_tables(mesh8)
+        ck = make_checkpointer(str(tmp_path), {"d": d1, "s": s1},
+                               backend="orbax")
+        ck.save(step=3)
+        ck.wait()
+
+        d2, s2 = _trained_tables(mesh8)
+        d2.push({"w": jnp.ones(8) * 100})      # diverge; restore overwrites
+        ck2 = make_checkpointer(str(tmp_path), {"d": d2, "s": s2},
+                                backend="orbax")
+        assert ck2.restore() == 3
+        for t in (d1, d2):
+            t.push({"w": jnp.arange(8.0)})
+        np.testing.assert_allclose(np.asarray(d2.params),
+                                   np.asarray(d1.params), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s2.emb), np.asarray(s1.emb),
+                                   rtol=1e-6)
+        ck.close()
+        ck2.close()
+
+    def test_keep_and_list_steps(self, mesh8, tmp_path):
+        from minips_tpu.ckpt.orbax_backend import make_checkpointer
+
+        d, s = _trained_tables(mesh8)
+        ck = make_checkpointer(str(tmp_path), {"d": d}, keep=2,
+                               backend="orbax")
+        for step in (1, 2, 3):
+            ck.save(step=step)
+        ck.wait()
+        assert ck.list_steps() == [2, 3]
+        ck.close()
+
+    def test_clocks_roundtrip(self, mesh8, tmp_path):
+        from minips_tpu.ckpt.orbax_backend import make_checkpointer
+
+        d, _ = _trained_tables(mesh8)
+        ctl = SSP(staleness=2, num_workers=3)
+        for w in range(3):
+            ctl.clock(w)
+        ctl.clock(0)
+        ck = make_checkpointer(str(tmp_path), {"d": d},
+                               {"ssp": ctl}, backend="orbax")
+        ck.save(step=5)
+        ck.wait()
+        ctl2 = SSP(staleness=2, num_workers=3)
+        ck2 = make_checkpointer(str(tmp_path), {"d": d},
+                                {"ssp": ctl2}, backend="orbax")
+        assert ck2.restore() == 5
+        assert ctl2.state_dict() == ctl.state_dict()
+        ck.close()
+        ck2.close()
+
+    def test_factory_default_is_native(self, mesh8, tmp_path, monkeypatch):
+        from minips_tpu.ckpt.checkpoint import Checkpointer
+        from minips_tpu.ckpt.orbax_backend import make_checkpointer
+
+        monkeypatch.delenv("MINIPS_CKPT_BACKEND", raising=False)
+        d, _ = _trained_tables(mesh8)
+        ck = make_checkpointer(str(tmp_path), {"d": d})
+        assert isinstance(ck, Checkpointer)
+        with pytest.raises(ValueError, match="unknown checkpoint backend"):
+            make_checkpointer(str(tmp_path), {"d": d}, backend="bogus")
