@@ -48,6 +48,9 @@ def main(argv=None) -> int:
     ap.add_argument("--mode", choices=["bsp", "ssp", "asp"], default="ssp")
     ap.add_argument("--staleness", type=int, default=2)
     ap.add_argument("--push-every", type=int, default=1)
+    ap.add_argument("--compress", type=float, default=1.0,
+                    help="fraction of delta entries per push (<1 = top-k "
+                         "sparsification with error feedback)")
     ap.add_argument("--slow-rank", type=int, default=-1,
                     help="rank to artificially slow (straggler injection)")
     ap.add_argument("--slow-ms", type=float, default=0.0)
@@ -110,7 +113,8 @@ def main(argv=None) -> int:
 
     trainer = SSPTrainer(local_step, params, bus, nprocs,
                          staleness=staleness, push_every=args.push_every,
-                         gate_timeout=30.0, monitor=monitor) \
+                         gate_timeout=30.0, monitor=monitor,
+                         compress=args.compress) \
         if bus is not None else None
     if bus is not None:
         # AFTER all handlers (delta/clock/heartbeat) are registered — a
@@ -172,6 +176,7 @@ def main(argv=None) -> int:
             "gate_waits": trainer.gate_waits,
             "max_skew_seen": trainer.max_skew_seen,
             "deltas_applied": trainer.deltas_applied,
+            "bytes_pushed": trainer.bytes_pushed,
             "param_sum": float(flat.sum()),
             "param_norm": float(np.linalg.norm(flat)),
             "clock": trainer.clock,

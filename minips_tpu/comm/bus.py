@@ -257,6 +257,11 @@ class ClockGossip:
         with self._cond:
             return self._min_locked()
 
+    @property
+    def excluded(self) -> set[int]:
+        with self._cond:
+            return set(self._excluded)
+
     def wait_global_min(self, threshold: int,
                         timeout: Optional[float] = None) -> bool:
         """Block until every live process's min clock >= threshold — the

@@ -70,6 +70,13 @@ class SSPTrainer:
     push_every: publish accumulated local deltas every k steps (k=1 matches
         the reference's per-iteration Push; larger k trades freshness for
         bandwidth, the SparCML-style batching knob).
+    compress: fraction of delta entries shipped per push (1.0 = dense).
+        Below 1.0, each push sends only the top-``compress``-fraction of
+        entries by magnitude (int32 indices + f32 values) and keeps the
+        unsent mass as a residual folded into the next push — top-k
+        sparsification with error feedback (SparCML lineage, PAPERS.md).
+        No gradient is ever dropped, only delayed; ``finalize`` flushes
+        the full residual dense so replicas still converge exactly.
     monitor: optional HeartbeatMonitor; on gate timeout its dead set turns a
         hang into a PeerFailureError and excludes corpses from the gate.
     """
@@ -85,9 +92,12 @@ class SSPTrainer:
         push_every: int = 1,
         gate_timeout: float = 60.0,
         monitor=None,
+        compress: float = 1.0,
     ):
         if staleness < 0:
             raise ValueError("staleness must be >= 0")
+        if not 0.0 < compress <= 1.0:
+            raise ValueError("compress must be in (0, 1]")
         self.step_fn = step_fn
         self.bus = bus
         self.num_processes = num_processes
@@ -95,6 +105,8 @@ class SSPTrainer:
         self.push_every = max(int(push_every), 1)
         self.gate_timeout = gate_timeout
         self.monitor = monitor
+        self.compress = compress
+        self.bytes_pushed = 0    # wire accounting (the compression payoff)
 
         flat, self._unravel = ravel_pytree(params)
         self._params = params
@@ -109,7 +121,10 @@ class SSPTrainer:
         self.deltas_applied = 0
 
         self.gossip = ClockGossip(bus, num_processes, workers_per_process=1)
+        self._flushed: set[int] = set()
+        self._flush_cond = threading.Condition()
         bus.on("delta", self._on_delta)
+        bus.on("flush", self._on_flush)
 
     # ------------------------------------------------------------- messaging
     def _on_delta(self, sender: int, payload: dict) -> None:
@@ -118,11 +133,28 @@ class SSPTrainer:
         blob = payload.get("__blob__")
         if blob is None:
             return
-        vec = np.frombuffer(blob, np.float32)
-        if vec.shape[0] != self._nparam:
-            return  # shape mismatch: stale peer from an old run; drop
+        if payload.get("fmt") == "topk":
+            # blob = [k int32 indices][k f32 values]
+            k = int(payload.get("k", 0))
+            if len(blob) != k * 8 or k > self._nparam:
+                return  # malformed / stale peer; drop
+            idx = np.frombuffer(blob[: 4 * k], np.int32)
+            if k and (idx.min() < 0 or idx.max() >= self._nparam):
+                return
+            vals = np.frombuffer(blob[4 * k:], np.float32)
+            vec = np.zeros(self._nparam, np.float32)
+            vec[idx] = vals
+        else:
+            vec = np.frombuffer(blob, np.float32)
+            if vec.shape[0] != self._nparam:
+                return  # shape mismatch: stale peer from an old run; drop
         with self._inbox_lock:
             self._inbox.append(vec)
+
+    def _on_flush(self, sender: int, payload: dict) -> None:
+        with self._flush_cond:
+            self._flushed.add(sender)
+            self._flush_cond.notify_all()
 
     def _drain_inbox(self) -> None:
         with self._inbox_lock:
@@ -141,8 +173,24 @@ class SSPTrainer:
             return
         if not np.any(self._pending_push):
             return
-        self.bus.publish("delta", {"clock": self.clock},
-                         blob=self._pending_push.astype(np.float32).tobytes())
+        vec = self._pending_push.astype(np.float32)
+        if self.compress < 1.0 and not force:
+            # top-k by magnitude; the unsent tail STAYS in _pending_push
+            # (error feedback) and rides a later push
+            k = max(1, int(self.compress * self._nparam))
+            idx = np.argpartition(np.abs(vec), -k)[-k:].astype(np.int32)
+            vals = vec[idx]
+            blob = idx.tobytes() + vals.tobytes()
+            self.bus.publish("delta", {"clock": self.clock, "fmt": "topk",
+                                       "k": int(k)}, blob=blob)
+            self.bytes_pushed += len(blob)
+            self._pending_push[idx] = 0.0   # residual keeps the rest
+            return
+        # dense: force-pushes (finalize) always take this path so the
+        # full residual lands and replicas converge exactly
+        blob = vec.tobytes()
+        self.bus.publish("delta", {"clock": self.clock}, blob=blob)
+        self.bytes_pushed += len(blob)
         self._pending_push = np.zeros(self._nparam, np.float32)
 
     # ------------------------------------------------------------------ gate
@@ -193,18 +241,32 @@ class SSPTrainer:
         clock, merge their tail — after this every live replica holds the
         same merged parameters (up to float reorder noise)."""
         self._push(force=True)
+        # "flush" is published AFTER the forced dense push on the same
+        # socket, so per-publisher frame ordering guarantees that once we
+        # have heard flush from a peer, every delta it ever sent —
+        # including the compressed path's final residual — is already in
+        # our inbox (clock gossip alone cannot promise that: a peer's last
+        # clock precedes its finalize-time residual).
+        self.bus.publish("flush", {"clock": self.clock})
         self.gossip.publish_local([self.clock])
-        if not self.gossip.wait_global_min(self.clock, timeout):
+        deadline = time.monotonic() + timeout
+        peers = set(range(self.num_processes)) - {self.bus.my_id}
+        while True:
+            with self._flush_cond:
+                live = peers - self.gossip.excluded
+                if live <= self._flushed:
+                    break
+                self._flush_cond.wait(timeout=0.5)
             dead = self.monitor.check() if self.monitor is not None else set()
-            if dead:
-                for p in dead:
-                    self.gossip.exclude(p)
-            else:
-                raise TimeoutError("finalize: peers never caught up")
-        # Peer clock == final implies its deltas are already queued locally
-        # (PUB frame ordering), but delivery runs on the bus thread — give
-        # the handler a beat, then merge.
-        time.sleep(0.1)
+            for p in dead:
+                self.gossip.exclude(p)
+            if time.monotonic() > deadline:
+                with self._flush_cond:
+                    missing = sorted(peers - self._flushed
+                                     - self.gossip.excluded)
+                if not dead:
+                    raise TimeoutError(
+                        f"finalize: peers {missing} never flushed")
         self._drain_inbox()
         return self._params
 

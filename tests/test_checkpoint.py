@@ -118,6 +118,10 @@ def test_sgd_roundtrip_leafless_opt_state(mesh8, tmp_path):
 class TestOrbaxBackend:
     """Same contract as the native backend, through orbax.checkpoint."""
 
+    @pytest.fixture(autouse=True)
+    def _require_orbax(self):
+        pytest.importorskip("orbax.checkpoint")
+
     def test_roundtrip_resumes_identically(self, mesh8, tmp_path):
         from minips_tpu.ckpt.orbax_backend import make_checkpointer
 

@@ -140,6 +140,24 @@ def test_ssp_mlp_staleness4():
 
 
 @pytest.mark.slow
+def test_ssp_compressed_push_converges_and_agrees():
+    """--compress 0.1: top-k sparsified deltas with error feedback ship a
+    fraction of the bytes, yet finalize's dense residual flush makes the
+    replicas agree exactly and training still converges."""
+    res = run_job(3, ["--mode", "ssp", "--staleness", "2",
+                      "--compress", "0.1"], iters=40)
+    dense_bytes = None
+    for r in res:
+        assert r["event"] == "done"
+        assert r["loss_last"] < r["loss_first"]
+        # dense would ship nparam*4 bytes per push, every step
+        if dense_bytes is None:
+            dense_bytes = 40 * 65 * 4   # iters * dim+1 params * f32
+        assert r["bytes_pushed"] < dense_bytes / 2, r["bytes_pushed"]
+    assert_replicas_agree(res)
+
+
+@pytest.mark.slow
 def test_two_processes_converge_better_than_start():
     res = run_job(2, ["--mode", "ssp", "--staleness", "1"], iters=50)
     for r in res:
