@@ -65,11 +65,14 @@ def test_shared_load_multiprocess_parse_once(tmp_path):
     script = tmp_path / "w.py"
     script.write_text(WORKER)
     procs = []
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     for lr in range(3):
         env = dict(os.environ)
         env.update({"MINIPS_LOCAL_RANK": str(lr), "MINIPS_LOCAL_PROCS": "3",
                     "MINIPS_RUN_ID": f"test{os.getpid()}",
-                    "JAX_PLATFORMS": "cpu", "MINIPS_FORCE_CPU": "1"})
+                    "JAX_PLATFORMS": "cpu", "MINIPS_FORCE_CPU": "1",
+                    "PYTHONPATH": os.pathsep.join(
+                        filter(None, [repo_root, env.get("PYTHONPATH")]))})
         procs.append(subprocess.Popen(
             [sys.executable, str(script), marker, str(svm)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
