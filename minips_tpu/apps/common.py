@@ -39,6 +39,33 @@ def app_main(name: str, default_cfg: Config, run, extra_flags=None):
     return result
 
 
+def holdout_split(data: dict, frac: float, seed: int = 0):
+    """Random row split into (train, holdout). ``frac`` is the holdout
+    fraction; 0 disables (returns (data, None)). Used by the CTR apps for
+    the post-training AUC eval pass."""
+    if not 0.0 <= frac < 1.0:
+        raise ValueError(f"eval fraction must be in [0, 1), got {frac}")
+    n = len(next(iter(data.values())))
+    n_hold = int(n * frac)
+    if n_hold == 0:
+        return data, None
+    perm = np.random.default_rng(seed).permutation(n)
+    hold, train = perm[:n_hold], perm[n_hold:]
+    return ({k: v[train] for k, v in data.items()},
+            {k: v[hold] for k, v in data.items()})
+
+
+def score_holdout(predict, holdout, out: dict, metrics) -> dict:
+    """Shared post-training eval: streaming ROC-AUC of ``predict`` on the
+    holdout rows, recorded in both the result dict and the JSONL metrics.
+    No-op when there is no holdout (``--eval_frac 0``)."""
+    if holdout is not None:
+        from minips_tpu.utils.evaluation import evaluate_auc
+        out["auc"] = evaluate_auc(predict, holdout)
+        metrics.log(holdout_auc=out["auc"], holdout_rows=len(holdout["y"]))
+    return out
+
+
 def threaded_train(engine: Engine, cfg: Config, data: dict, step_fn,
                    *, clock_tables: list[str],
                    n_iters: int | None = None) -> list[float]:

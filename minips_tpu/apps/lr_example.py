@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from minips_tpu.apps.common import app_main
+from minips_tpu.apps.common import (app_main, holdout_split, score_holdout,
+                                    threaded_train)
 from minips_tpu.core.config import Config, TableConfig, TrainConfig
 from minips_tpu.core.engine import Engine, MLTask
 from minips_tpu.data.loader import BatchIterator
@@ -58,8 +59,10 @@ def run(cfg: Config, args, metrics) -> dict:
 
 
 def _run_dense(cfg, args, metrics, data, dim) -> dict:
+    data, holdout = holdout_split(data, getattr(args, "eval_frac", 0.0),
+                                  seed=cfg.train.seed)
     if getattr(args, "exec_mode", "spmd") == "threaded":
-        return _run_threaded(cfg, metrics, data, dim)
+        return _run_threaded(cfg, metrics, data, dim, holdout)
     batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
     mesh = make_mesh()
     table = DenseTable(lr_model.init(dim), mesh, updater=cfg.table.updater,
@@ -78,6 +81,13 @@ def _run_dense(cfg, args, metrics, data, dim) -> dict:
         if ck.list_steps():  # resume-from-latest (SURVEY.md §3.5)
             start_step = ck.restore()
             metrics.log(resumed_from_step=start_step)
+            if holdout is not None:
+                # The split is deterministic in (--seed, --eval_frac), so a
+                # resumed run holds out the same rows ONLY if both flags
+                # match the run that wrote the checkpoint — flag it.
+                metrics.log(warning="holdout AUC after resume is only valid "
+                                    "if --eval_frac/--seed match the "
+                                    "checkpointing run")
     loop = TrainLoop(do_step, batches, metrics=metrics,
                      log_every=cfg.train.log_every,
                      batch_size=cfg.train.batch_size,
@@ -85,11 +95,16 @@ def _run_dense(cfg, args, metrics, data, dim) -> dict:
                      checkpoint_every=cfg.train.checkpoint_every,
                      step_offset=start_step)
     losses = loop.run(max(cfg.train.num_iters - start_step, 0))
-    return {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
-            "table": table}
+    params, predict = table.pull(), jax.jit(lr_model.logits_dense)
+    return score_holdout(
+        lambda b: predict(params, jnp.asarray(b["x"])), holdout,
+        {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
+         "table": table}, metrics)
 
 
 def _run_sparse(cfg, args, metrics, data) -> dict:
+    data, holdout = holdout_split(data, getattr(args, "eval_frac", 0.0),
+                                  seed=cfg.train.seed)
     mesh = make_mesh()
     table = SparseTable(1 << 16, 1, mesh, updater=cfg.table.updater,
                         lr=cfg.table.lr, init_scale=0.0)
@@ -104,13 +119,19 @@ def _run_sparse(cfg, args, metrics, data) -> dict:
                      metrics=metrics, log_every=cfg.train.log_every,
                      batch_size=cfg.train.batch_size)
     losses = loop.run(cfg.train.num_iters)
-    return {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
-            "table": table}
+
+    def predict(b):
+        rows = table.pull(jnp.asarray(b["idx"]))
+        return lr_model.logits_sparse(rows, jnp.asarray(b["val"]),
+                                      jnp.asarray(b["mask"]))
+
+    return score_holdout(
+        predict, holdout,
+        {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
+         "table": table}, metrics)
 
 
-def _run_threaded(cfg, metrics, data, dim) -> dict:
-    from minips_tpu.apps.common import threaded_train
-
+def _run_threaded(cfg, metrics, data, dim, holdout=None) -> dict:
     engine = Engine(num_workers=cfg.train.num_workers).start_everything()
     engine.create_table(
         TableConfig(name="w", kind="dense", consistency=cfg.table.consistency,
@@ -129,9 +150,14 @@ def _run_threaded(cfg, metrics, data, dim) -> dict:
     mean_losses = threaded_train(engine, cfg, data, step_fn,
                                  clock_tables=["w"])
     skew = engine.controllers["w"].skew
+    params = engine.tables["w"].pull()
     engine.stop_everything()
     metrics.log(final_loss=mean_losses[-1], clock_skew=skew)
-    return {"losses": mean_losses, "samples_per_sec": 0.0, "skew": skew}
+    predict = jax.jit(lr_model.logits_dense)
+    return score_holdout(
+        lambda b: predict(params, jnp.asarray(b["x"])), holdout,
+        {"losses": mean_losses, "samples_per_sec": 0.0, "skew": skew},
+        metrics)
 
 
 def _flags(parser):
@@ -140,6 +166,9 @@ def _flags(parser):
     parser.add_argument("--dim", type=int, default=123)
     parser.add_argument("--data_file", default=None,
                         help="libsvm file (a9a/RCV1) instead of synthetic")
+    parser.add_argument("--eval_frac", type=float, default=0.0,
+                        help="opt-in: fraction of rows held out and scored "
+                             "by streaming ROC-AUC after training")
 
 
 def main():

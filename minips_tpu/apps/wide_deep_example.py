@@ -13,7 +13,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from minips_tpu.apps.common import app_main
+from minips_tpu.apps.common import app_main, holdout_split, score_holdout
 from minips_tpu.core.config import Config, TableConfig, TrainConfig
 from minips_tpu.data.loader import BatchIterator
 from minips_tpu.data import synthetic
@@ -70,6 +70,8 @@ def run(cfg: Config, args, metrics) -> dict:
                 "cat": raw["cat"], "y": raw["y"]}
     else:
         data = synthetic.criteo_like(16384, seed=cfg.train.seed)
+    data, holdout = holdout_split(data, getattr(args, "eval_frac", 0.0),
+                                  seed=cfg.train.seed)
     ps, tables = build(cfg, use_fm=use_fm, seed=cfg.train.seed)
     batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
     loop = TrainLoop(lambda b: ps(ps.shard_batch(b)), batches,
@@ -78,8 +80,19 @@ def run(cfg: Config, args, metrics) -> dict:
     losses = loop.run(cfg.train.num_iters)
     metrics.log(final_loss=losses[-1],
                 samples_per_sec=loop.timer.samples_per_sec)
-    return {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
-            "tables": tables}
+    wide_t, emb_t, deep_t = tables
+    deep_params = deep_t.pull()
+
+    def predict(b):
+        cats = jnp.asarray(b["cat"])
+        return wd_model.logits(
+            wide_t.pull(cats), emb_t.pull(cats), deep_params,
+            {"dense": jnp.asarray(b["dense"])}, use_fm=use_fm)
+
+    return score_holdout(
+        predict, holdout,
+        {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
+         "tables": tables}, metrics)
 
 
 def _flags(parser):
@@ -87,6 +100,9 @@ def _flags(parser):
                         choices=["widedeep", "deepfm"])
     parser.add_argument("--data_file", default=None,
                         help="Criteo TSV file instead of synthetic data")
+    parser.add_argument("--eval_frac", type=float, default=0.0,
+                        help="opt-in: fraction of rows held out and scored "
+                             "by streaming ROC-AUC after training")
 
 
 def main():

@@ -1,0 +1,134 @@
+"""Evaluation metrics for the CTR workloads — streaming AUC on device.
+
+The reference validates its apps by "loss goes down" (SURVEY.md §4,
+app-level validation); its CTR configs (LR on a9a/RCV1, Wide&Deep/DeepFM on
+Criteo — BASELINE.json:6-12) are exactly the workloads the CTR literature
+scores by ROC-AUC. This module adds that as a first-class, TPU-friendly
+observable:
+
+- ``StreamingAUC`` bucketizes each score batch on device with a jitted
+  kernel, then folds the per-batch histograms into float64 host
+  accumulators — O(buckets) state no matter how many samples stream
+  through, so a Criteo-1TB-sized eval pass never materialises the score
+  vector, and the float64 counters stay exact far beyond 2^53 samples
+  (a per-batch float32 histogram is safe because one batch's bucket
+  counts never approach float32's 2^24 integer ceiling).
+- AUC is computed from the histograms by the rank-sum formula with the
+  within-bucket tie correction (pairs falling in the same bucket count
+  0.5), which makes the estimator exact in the limit of one score per
+  bucket and biased by at most O(1/buckets) otherwise.
+- ``auc_exact`` is the O(n log n) host oracle used by the tests and fine
+  for small evals.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _batch_hists(scores, labels, weights, num_buckets):
+    """Bucketize sigmoid(scores) into [0, 1); per-class batch histograms."""
+    p = jax.nn.sigmoid(scores.astype(jnp.float32)).reshape(-1)
+    labels = labels.reshape(-1).astype(jnp.float32)
+    weights = weights.reshape(-1).astype(jnp.float32)
+    idx = jnp.clip((p * num_buckets).astype(jnp.int32), 0, num_buckets - 1)
+    zeros = jnp.zeros((num_buckets,), jnp.float32)
+    return (zeros.at[idx].add(weights * labels),
+            zeros.at[idx].add(weights * (1.0 - labels)))
+
+
+def _auc_from_hists(pos_hist, neg_hist) -> float:
+    """Rank-sum AUC over score-ascending buckets with tie correction."""
+    cum_neg_below = np.cumsum(neg_hist) - neg_hist
+    pairs_won = np.sum(pos_hist * (cum_neg_below + 0.5 * neg_hist))
+    total = np.sum(pos_hist) * np.sum(neg_hist)
+    return float(pairs_won / total) if total > 0 else 0.5
+
+
+class StreamingAUC:
+    """Accumulate ROC-AUC over score batches with O(buckets) state.
+
+    Scores are LOGITS (mapped through sigmoid internally, which is
+    monotonic and therefore AUC-preserving); labels are {0, 1}. Optional
+    per-sample weights support padded eval batches (weight 0 = ignore).
+    """
+
+    def __init__(self, num_buckets: int = 1 << 14):
+        if num_buckets < 2:
+            raise ValueError(f"need >= 2 buckets, got {num_buckets}")
+        self.num_buckets = num_buckets
+        self.reset()
+
+    def reset(self) -> None:
+        self._pos = np.zeros((self.num_buckets,), np.float64)
+        self._neg = np.zeros((self.num_buckets,), np.float64)
+
+    def update(self, logits, labels, weights=None) -> None:
+        if weights is None:
+            weights = jnp.ones(jnp.size(logits), jnp.float32)
+        pos, neg = _batch_hists(jnp.asarray(logits), jnp.asarray(labels),
+                                jnp.asarray(weights), self.num_buckets)
+        self._pos += np.asarray(pos, np.float64)
+        self._neg += np.asarray(neg, np.float64)
+
+    @property
+    def count(self) -> float:
+        return float(self._pos.sum() + self._neg.sum())
+
+    def result(self) -> float:
+        return _auc_from_hists(self._pos, self._neg)
+
+
+def auc_exact(scores, labels) -> float:
+    """O(n log n) exact ROC-AUC (rank-sum with midranks for ties) — the
+    host oracle for tests and small holdouts."""
+    scores = np.asarray(scores, np.float64).reshape(-1)
+    labels = np.asarray(labels, np.float64).reshape(-1)
+    n_pos = labels.sum()
+    n_neg = labels.shape[0] - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    order = np.argsort(scores, kind="mergesort")
+    s, y = scores[order], labels[order]
+    # midranks: average rank within each tied group
+    ranks = np.empty_like(s)
+    i = 0
+    while i < len(s):
+        j = i
+        while j + 1 < len(s) and s[j + 1] == s[i]:
+            j += 1
+        ranks[i:j + 1] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    rank_sum_pos = ranks[y == 1].sum()
+    return float((rank_sum_pos - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+def evaluate_auc(predict_logits, data: dict, batch_size: int = 8192,
+                 label_key: str = "y", num_buckets: int = 1 << 14) -> float:
+    """Stream ``data`` through ``predict_logits(batch)->logits`` in fixed
+    chunks (a ragged tail is padded and masked by weight so every chunk has
+    one compiled shape) and return the streaming AUC."""
+    n = int(np.asarray(data[label_key]).shape[0])
+    auc = StreamingAUC(num_buckets)
+    for lo in range(0, n, batch_size):
+        hi = min(lo + batch_size, n)
+        pad = batch_size - (hi - lo) if hi - lo < batch_size else 0
+
+        def cut(v):
+            chunk = np.asarray(v)[lo:hi]
+            if pad:
+                chunk = np.concatenate(
+                    [chunk, np.repeat(chunk[-1:], pad, axis=0)], axis=0)
+            return chunk
+
+        batch = {k: cut(v) for k, v in data.items()}
+        w = np.ones((hi - lo + pad,), np.float32)
+        if pad:
+            w[hi - lo:] = 0.0
+        auc.update(predict_logits(batch), batch[label_key], w)
+    return auc.result()

@@ -1,0 +1,79 @@
+"""Streaming AUC — oracle equality, tie handling, chunk invariance."""
+
+import numpy as np
+import pytest
+
+from minips_tpu.utils.evaluation import (StreamingAUC, auc_exact,
+                                         evaluate_auc)
+
+
+def _logit(p):
+    p = np.clip(p, 1e-6, 1 - 1e-6)
+    return np.log(p / (1 - p))
+
+
+def test_exact_oracle_matches_closed_forms():
+    # perfect separation
+    assert auc_exact([-2, -1, 1, 2], [0, 0, 1, 1]) == 1.0
+    # perfectly wrong
+    assert auc_exact([2, 1, -1, -2], [0, 0, 1, 1]) == 0.0
+    # all tied -> 0.5
+    assert auc_exact([0.3, 0.3, 0.3, 0.3], [0, 1, 0, 1]) == 0.5
+    # degenerate single-class -> 0.5 by convention
+    assert auc_exact([0.1, 0.9], [1, 1]) == 0.5
+
+
+def test_streaming_matches_exact_on_random_scores():
+    rng = np.random.default_rng(0)
+    n = 4000
+    y = rng.integers(0, 2, size=n)
+    # separable-ish scores with noise, as logits
+    scores = y * 1.5 + rng.normal(size=n)
+    exact = auc_exact(scores, y)
+    auc = StreamingAUC(1 << 14)
+    auc.update(scores.astype(np.float32), y)
+    assert auc.result() == pytest.approx(exact, abs=2e-3)
+    assert auc.count == pytest.approx(n)
+
+
+def test_streaming_chunked_equals_one_shot():
+    rng = np.random.default_rng(1)
+    n = 1000
+    y = rng.integers(0, 2, size=n)
+    scores = rng.normal(size=n).astype(np.float32)
+    one = StreamingAUC(1 << 12)
+    one.update(scores, y)
+    chunked = StreamingAUC(1 << 12)
+    for lo in range(0, n, 128):
+        chunked.update(scores[lo:lo + 128], y[lo:lo + 128])
+    assert chunked.result() == pytest.approx(one.result(), abs=1e-7)
+
+
+def test_weights_mask_padding():
+    y = np.array([0, 1, 1, 0])
+    s = np.array([-1.0, 2.0, 1.0, -2.0], np.float32)
+    auc = StreamingAUC(1 << 12)
+    # pad with garbage rows at weight 0 — must not affect the result
+    auc.update(np.concatenate([s, [5.0, -5.0]]),
+               np.concatenate([y, [0, 1]]),
+               np.array([1, 1, 1, 1, 0, 0], np.float32))
+    assert auc.result() == pytest.approx(auc_exact(s, y), abs=1e-3)
+
+
+def test_evaluate_auc_pads_ragged_tail():
+    rng = np.random.default_rng(2)
+    n = 777  # not a multiple of the eval batch
+    y = rng.integers(0, 2, size=n)
+    x = (y * 2.0 + rng.normal(size=n)).astype(np.float32)
+    data = {"x": x, "y": y}
+    got = evaluate_auc(lambda b: b["x"], data, batch_size=256)
+    assert got == pytest.approx(auc_exact(x, y), abs=2e-3)
+
+
+def test_sigmoid_mapping_preserves_order_for_extreme_logits():
+    # huge logits saturate sigmoid; clip keeps them in the top/bottom bucket
+    y = np.array([0, 0, 1, 1])
+    s = np.array([-200.0, -100.0, 100.0, 200.0], np.float32)
+    auc = StreamingAUC(1 << 12)
+    auc.update(s, y)
+    assert auc.result() == pytest.approx(1.0, abs=1e-6)
