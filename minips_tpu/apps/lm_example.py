@@ -176,10 +176,9 @@ def run(cfg: Config, args, metrics) -> dict:
                 "inp": jax.device_put(t[:, :-1], seq_sharding),
                 "tgt": jax.device_put(t[:, 1:], seq_sharding)}}
 
-    # Fast-forward past the batches the pre-crash run already consumed so
-    # the resumed trajectory continues the stream instead of replaying it.
-    batches = BatchIterator(data, cfg.train.batch_size,
-                            seed=cfg.train.seed).iter_from(start_step)
+    # TrainLoop fast-forwards the iterator to step_offset, so the resumed
+    # trajectory continues the stream instead of replaying it.
+    batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
 
     ckpt_every = _ckpt_every(cfg, args)
     loop = TrainLoop(lambda b: table.step_inplace(step, prep(b)), batches,

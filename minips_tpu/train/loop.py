@@ -58,7 +58,20 @@ class TrainLoop:
 
     def _run(self, num_iters: int) -> list[float]:
         losses: list[float] = []
-        it = iter(self.data)
+        # Resume continues the data stream, not just the step numbering: a
+        # data source with iter_from (BatchIterator) is fast-forwarded to
+        # the global step so a resumed run sees exactly the batches the
+        # uninterrupted run would have seen from there.
+        if self.step_offset and hasattr(self.data, "iter_from"):
+            it = self.data.iter_from(self.step_offset)
+        else:
+            if self.step_offset:
+                # e.g. a bare generator: we cannot fast-forward it, so the
+                # exact-replay-on-resume guarantee is the caller's problem
+                self.metrics.log(
+                    warning="resume: data source has no iter_from; stream "
+                            "starts wherever the caller left it")
+            it = iter(self.data)
         for i in range(num_iters):
             if self.profiler is not None:
                 self.profiler.on_step(i)

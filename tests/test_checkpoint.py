@@ -187,3 +187,38 @@ class TestOrbaxBackend:
         assert isinstance(ck, Checkpointer)
         with pytest.raises(ValueError, match="unknown checkpoint backend"):
             make_checkpointer(str(tmp_path), {"d": d}, backend="bogus")
+
+
+def test_resume_replays_exact_data_stream(mesh8, tmp_path):
+    """Interrupt-at-k + resume must reproduce the uninterrupted run's final
+    params EXACTLY: TrainLoop fast-forwards the BatchIterator to the global
+    step, so the resumed run consumes the same batches in the same order."""
+    from minips_tpu.data.loader import BatchIterator
+    from minips_tpu.models import lr as lr_model
+    from minips_tpu.train.loop import TrainLoop
+
+    rng = np.random.default_rng(0)
+    data = {"x": rng.normal(size=(256, 16)).astype(np.float32),
+            "y": rng.integers(0, 2, size=256).astype(np.float32)}
+
+    def make():
+        t = DenseTable(lr_model.init(16), mesh8, updater="adagrad", lr=0.3)
+        s = t.make_step(lr_model.grad_fn_dense)
+        return t, (lambda b: t.step_inplace(
+            s, {k: jnp.asarray(v) for k, v in b.items()}))
+
+    t1, f1 = make()  # uninterrupted: 10 steps
+    TrainLoop(f1, BatchIterator(data, 32, seed=3), log_every=0).run(10)
+
+    t2, f2 = make()  # interrupted at 6...
+    ck = Checkpointer(str(tmp_path), {"w": t2})
+    TrainLoop(f2, BatchIterator(data, 32, seed=3), checkpointer=ck,
+              checkpoint_every=6, log_every=0).run(6)
+    t3, f3 = make()  # ...resumed for the remaining 4
+    start = Checkpointer(str(tmp_path), {"w": t3}).restore()
+    assert start == 6
+    TrainLoop(f3, BatchIterator(data, 32, seed=3), step_offset=start,
+              log_every=0).run(10 - start)
+
+    np.testing.assert_array_equal(np.asarray(t3.params),
+                                  np.asarray(t1.params))
