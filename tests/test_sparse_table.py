@@ -83,3 +83,30 @@ def test_adagrad_zero_init_zero_grad_no_nan(mesh8):
     row = np.asarray(t.pull(jnp.array([5])))[0]
     assert np.isfinite(row).all()
     assert row[1] == 0.0 and row[0] < 0.0
+
+
+def test_row_adagrad_dense_and_sorted_paths_agree():
+    """The dense-accumulate fast path and the sort-dedup big-table path
+    are the same update, bit-for-bit within float tolerance — duplicates,
+    untouched rows, accumulator state and all."""
+    import numpy as np
+
+    from minips_tpu.ops.sparse_update import row_adagrad
+
+    rng = np.random.default_rng(3)
+    S, D = 64, 4
+    emb = jnp.asarray(rng.normal(size=(S, D)), jnp.float32)
+    accum = jnp.asarray(rng.uniform(0, 2, size=(S, D)), jnp.float32)
+    slots = jnp.asarray(rng.integers(0, S, size=(32,)))  # many duplicates
+    grads = jnp.asarray(rng.normal(size=(32, D)), jnp.float32)
+
+    e_d, a_d = row_adagrad(emb, accum, slots, grads, 0.1, prefer_dense=True)
+    e_s, a_s = row_adagrad(emb, accum, slots, grads, 0.1, prefer_dense=False)
+    np.testing.assert_allclose(np.asarray(e_d), np.asarray(e_s), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(a_d), np.asarray(a_s), atol=1e-5)
+    # untouched rows identical to the originals on both paths
+    untouched = np.setdiff1d(np.arange(S), np.asarray(slots))
+    np.testing.assert_array_equal(np.asarray(e_d)[untouched],
+                                  np.asarray(emb)[untouched])
+    np.testing.assert_array_equal(np.asarray(a_d)[untouched],
+                                  np.asarray(accum)[untouched])
