@@ -93,6 +93,17 @@ def _flags(parser):
                         help="dp/sp: gradient-accumulation microbatches "
                              "per step (effective batch = batch_size, "
                              "activation memory = batch_size/accum)")
+    parser.add_argument("--dim", type=int, default=None,
+                        help=f"model width (default {MODEL['dim']})")
+    parser.add_argument("--depth", type=int, default=None,
+                        help=f"transformer blocks (default {MODEL['depth']})")
+    parser.add_argument("--heads", type=int, default=None,
+                        help=f"attention heads (default {MODEL['heads']})")
+    parser.add_argument("--dtype", default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="dp/sp: worker-math precision (bfloat16 = "
+                             "MXU-native mixed precision; master weights "
+                             "and the optimizer stay float32)")
     parser.add_argument("--max_len", type=int, default=None,
                         help="positional-embedding capacity (default: "
                              f"{MODEL['max_len']}, auto-grown to "
@@ -100,9 +111,19 @@ def _flags(parser):
 
 
 def _model_cfg(args, seq_len: int) -> dict:
-    """MODEL with positional capacity covering --max_len / --seq_len."""
-    cap = max(getattr(args, "max_len", None) or MODEL["max_len"], seq_len)
-    return {**MODEL, "max_len": cap}
+    """MODEL with --dim/--depth/--heads overrides and positional capacity
+    covering --max_len / --seq_len."""
+    m = {**MODEL}
+    for k in ("dim", "depth", "heads"):
+        v = getattr(args, k, None)
+        if v is not None:
+            m[k] = v
+    if m["heads"] < 1 or m["dim"] % m["heads"]:
+        raise SystemExit(f"--dim {m['dim']} must divide by --heads "
+                         f"{m['heads']} (>= 1)")
+    m["max_len"] = max(getattr(args, "max_len", None) or m["max_len"],
+                       seq_len)
+    return m
 
 
 def run(cfg: Config, args, metrics) -> dict:
@@ -116,6 +137,10 @@ def run(cfg: Config, args, metrics) -> dict:
                          f"(got {layout})")
     if getattr(args, "accum", 1) != 1 and layout not in ("dp", "sp"):
         raise SystemExit(f"--accum is only wired into --layout dp/sp "
+                         f"(got {layout})")
+    if getattr(args, "dtype", "float32") != "float32" \
+            and layout not in ("dp", "sp"):
+        raise SystemExit(f"--dtype is only wired into --layout dp/sp "
                          f"(got {layout})")
     if layout in ("tp", "pp"):
         return _run_model_parallel(cfg, args, metrics, layout, seq_len)
@@ -136,11 +161,15 @@ def run(cfg: Config, args, metrics) -> dict:
     ckpt, start_step = _maybe_checkpointer(cfg, args, table)
 
     accum = getattr(args, "accum", 1)
+    compute_dtype = (jnp.bfloat16
+                     if getattr(args, "dtype", "float32") == "bfloat16"
+                     else None)
     if layout == "dp":
         step = table.make_step(
             functools.partial(tfm.grad_fn, heads=heads,
                               attn_impl=getattr(args, "attn", "reference")),
-            batch_spec=P(DATA_AXIS), accum=accum)
+            batch_spec=P(DATA_AXIS), accum=accum,
+            compute_dtype=compute_dtype)
         batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
 
         def prep(batch):
@@ -167,7 +196,7 @@ def run(cfg: Config, args, metrics) -> dict:
             sp_grad,
             batch_spec={"tokens": {"inp": P(None, DATA_AXIS),
                                    "tgt": P(None, DATA_AXIS)}},
-            accum=accum)
+            accum=accum, compute_dtype=compute_dtype)
         seq_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
 
         def prep(batch):

@@ -174,6 +174,7 @@ class DenseTable:
         jit: bool = True,
         comm: str = "float32",
         accum: int = 1,
+        compute_dtype: Optional[Any] = None,
     ):
         """Fuse pull → grad → push → update into one SPMD program.
 
@@ -188,6 +189,15 @@ class DenseTable:
         ``comm`` compresses the two collectives' wire format ("bfloat16" or
         "int8"; EQuARX-style, see ops/quantized_comm.py). Params and the
         optimizer update stay float32 — only bytes-on-wire change.
+
+        ``compute_dtype`` (e.g. ``jnp.bfloat16``) runs the worker math in
+        reduced precision — the MXU-native mixed-precision recipe: float32
+        master weights and optimizer update on the owner shard, with
+        params AND floating batch leaves cast down before ``grad_fn`` and
+        the gradients cast back up before the push, so the loss surface is
+        evaluated in bf16 but the update path never loses master-weight
+        precision. Composes with ``comm`` (wire) and ``accum`` (the f32
+        microbatch fold).
 
         ``accum`` > 1 splits each shard's batch into that many microbatches
         and folds their grads in float32 under one ``lax.scan`` before the
@@ -205,6 +215,22 @@ class DenseTable:
         from minips_tpu.ops.quantized_comm import (
             _check, quantized_all_gather, quantized_psum_scatter)
         _check(comm)  # eager: tracing happens on first step call
+
+        if compute_dtype is not None:
+            cd = jnp.dtype(compute_dtype)
+
+            def _down(x):
+                return (x.astype(cd)
+                        if jnp.issubdtype(jnp.result_type(x), jnp.floating)
+                        else x)
+
+            user_grad_fn = grad_fn
+
+            def grad_fn(params, batch):  # noqa: F811 - deliberate wrap
+                loss, grads = user_grad_fn(jax.tree.map(_down, params),
+                                           jax.tree.map(_down, batch))
+                return (loss.astype(jnp.float32),
+                        jax.tree.map(lambda g: g.astype(jnp.float32), grads))
 
         def _grads_flat(params, batch):
             if accum == 1:

@@ -159,3 +159,24 @@ def test_lm_example_all_layouts():
         finals[layout] = losses[-1]
     spread = max(finals.values()) - min(finals.values())
     assert spread < 0.05, finals
+
+
+def test_lm_example_bfloat16_layouts():
+    """--dtype bfloat16 trains dp and sp to a loss close to the f32 run
+    (mixed precision changes rounding, not the trajectory shape)."""
+    from minips_tpu.apps import lm_example as app
+
+    cfg = Config(
+        table=TableConfig(name="lm", kind="dense", updater="adam", lr=3e-3),
+        train=TrainConfig(batch_size=16, num_iters=12, log_every=100),
+    )
+    finals = {}
+    for layout in ("dp", "sp"):
+        out = app.run(cfg, _args(layout=layout, seq_len=32, tp=2,
+                                 microbatches=2, dtype="bfloat16"),
+                      MetricsLogger(None, verbose=False))
+        losses = out["losses"]
+        assert np.isfinite(losses).all(), layout
+        assert losses[-1] < losses[0], layout
+        finals[layout] = losses[-1]
+    assert abs(finals["dp"] - finals["sp"]) < 0.1, finals
