@@ -33,7 +33,8 @@ DEFAULT = Config(
 NUM_DENSE, NUM_CAT = 13, 26
 
 
-def build(cfg: Config, *, use_fm: bool, mesh=None, seed: int = 0):
+def build(cfg: Config, *, use_fm: bool, mesh=None, seed: int = 0,
+          compute_dtype=None):
     """Tables + fused step for W&D/DeepFM; also used by
     __graft_entry__.dryrun_multichip."""
     mesh = mesh or make_mesh()
@@ -56,7 +57,8 @@ def build(cfg: Config, *, use_fm: bool, mesh=None, seed: int = 0):
     ps = PSTrainStep(loss_fn, dense=deep_t,
                      sparse={"wide": wide_t, "emb": emb_t},
                      key_fns={"wide": lambda b: b["cat"],
-                              "emb": lambda b: b["cat"]})
+                              "emb": lambda b: b["cat"]},
+                     compute_dtype=compute_dtype)
     return ps, (wide_t, emb_t, deep_t)
 
 
@@ -72,7 +74,10 @@ def run(cfg: Config, args, metrics) -> dict:
         data = synthetic.criteo_like(16384, seed=cfg.train.seed)
     data, holdout = holdout_split(data, getattr(args, "eval_frac", 0.0),
                                   seed=cfg.train.seed)
-    ps, tables = build(cfg, use_fm=use_fm, seed=cfg.train.seed)
+    ps, tables = build(cfg, use_fm=use_fm, seed=cfg.train.seed,
+                       compute_dtype=(jnp.bfloat16
+                                      if getattr(args, "dtype", "float32")
+                                      == "bfloat16" else None))
     batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
     loop = TrainLoop(lambda b: ps(ps.shard_batch(b)), batches,
                      metrics=metrics, log_every=cfg.train.log_every,
@@ -100,6 +105,10 @@ def _flags(parser):
                         choices=["widedeep", "deepfm"])
     parser.add_argument("--data_file", default=None,
                         help="Criteo TSV file instead of synthetic data")
+    parser.add_argument("--dtype", default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="worker-math precision (master tables stay "
+                             "float32)")
     parser.add_argument("--eval_frac", type=float, default=0.0,
                         help="opt-in: fraction of rows held out and scored "
                              "by streaming ROC-AUC after training")

@@ -41,6 +41,22 @@ from minips_tpu.tables.updaters import LearningRate, make_updater
 PyTree = Any
 
 
+def cast_floating(tree: PyTree, dtype) -> PyTree:
+    """Cast every floating leaf of ``tree`` to ``dtype`` (ints/bools pass
+    through) — the shared mixed-precision downcast used by
+    ``DenseTable.make_step`` and ``PSTrainStep`` so both paths keep the
+    same contract. ``dtype=None`` is the identity."""
+    if dtype is None:
+        return tree
+    dt = jnp.dtype(dtype)
+
+    def down(x):
+        return (x.astype(dt)
+                if jnp.issubdtype(jnp.result_type(x), jnp.floating) else x)
+
+    return jax.tree.map(down, tree)
+
+
 class DenseTable:
     """A dense parameter table sharded across the mesh ``data`` axis."""
 
@@ -218,19 +234,13 @@ class DenseTable:
 
         if compute_dtype is not None:
             cd = jnp.dtype(compute_dtype)
-
-            def _down(x):
-                return (x.astype(cd)
-                        if jnp.issubdtype(jnp.result_type(x), jnp.floating)
-                        else x)
-
             user_grad_fn = grad_fn
 
             def grad_fn(params, batch):  # noqa: F811 - deliberate wrap
-                loss, grads = user_grad_fn(jax.tree.map(_down, params),
-                                           jax.tree.map(_down, batch))
+                loss, grads = user_grad_fn(cast_floating(params, cd),
+                                           cast_floating(batch, cd))
                 return (loss.astype(jnp.float32),
-                        jax.tree.map(lambda g: g.astype(jnp.float32), grads))
+                        cast_floating(grads, jnp.float32))
 
         def _grads_flat(params, batch):
             if accum == 1:

@@ -32,7 +32,7 @@ from jax.sharding import PartitionSpec as P
 
 from minips_tpu.parallel.mesh import DATA_AXIS
 from minips_tpu.ops.sparse_update import row_adagrad, row_sgd
-from minips_tpu.tables.dense import DenseTable
+from minips_tpu.tables.dense import DenseTable, cast_floating
 from minips_tpu.tables.sparse import SparseTable, hash_to_slots
 
 PyTree = Any
@@ -48,7 +48,16 @@ class PSTrainStep:
         dense: Optional[DenseTable] = None,
         sparse: Optional[dict[str, SparseTable]] = None,
         key_fns: Optional[dict[str, Callable]] = None,
+        compute_dtype: Optional[Any] = None,
     ):
+        """``compute_dtype`` (e.g. ``jnp.bfloat16``): run ``loss_fn`` in
+        reduced precision — dense params, gathered sparse rows, and
+        floating batch leaves are cast down before the loss, gradients are
+        cast back to float32 before the sharded optimizer / row updates,
+        and master table state stays float32 throughout (same contract as
+        ``DenseTable.make_step(compute_dtype=...)``)."""
+        self.compute_dtype = (None if compute_dtype is None
+                              else jnp.dtype(compute_dtype))
         self.loss_fn = loss_fn
         self.dense = dense
         self.sparse = sparse or {}
@@ -87,16 +96,20 @@ class PSTrainStep:
         key_fns = dict(self.key_fns)
         loss_fn = self.loss_fn
         mesh = self._mesh
+        cd = self.compute_dtype
 
         def step(state, batch):
             # ----- pull phase (differentiable views of table state)
             if dense is not None:
                 p_flat, opt = state["dense"]
+            cbatch = cast_floating(batch, cd)
 
             def compute_loss(p_flat_in, rows_in):
-                dp = (dense._unravel(p_flat_in[: dense.num_keys])
+                dp = (cast_floating(
+                          dense._unravel(p_flat_in[: dense.num_keys]), cd)
                       if dense is not None else None)
-                return loss_fn(dp, rows_in, batch)
+                return loss_fn(dp, cast_floating(rows_in, cd),
+                               cbatch).astype(jnp.float32)
 
             slots = {}
             rows = {}

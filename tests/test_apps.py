@@ -180,3 +180,23 @@ def test_lm_example_bfloat16_layouts():
         assert losses[-1] < losses[0], layout
         finals[layout] = losses[-1]
     assert abs(finals["dp"] - finals["sp"]) < 0.1, finals
+
+
+def test_wide_deep_bfloat16():
+    """--dtype bfloat16 on the CTR flagship: trains, converges, and still
+    separates the holdout (the app-level wiring of PSTrainStep's
+    compute_dtype)."""
+    from minips_tpu.apps import wide_deep_example as app
+
+    cfg = Config(
+        table=TableConfig(name="ctr", kind="sparse", updater="adagrad",
+                          lr=0.05, dim=4, num_slots=1 << 12),
+        train=TrainConfig(batch_size=512, num_iters=60, log_every=100),
+    )
+    out = app.run(cfg, _args(model="deepfm", data_file=None,
+                             dtype="bfloat16", eval_frac=0.2),
+                  MetricsLogger(None, verbose=False))
+    losses = out["losses"]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    assert 0.6 < out["auc"] <= 1.0, out["auc"]

@@ -83,3 +83,43 @@ def test_reserved_dense_name_rejected(mesh8):
     with pytest.raises(ValueError, match="reserved"):
         PSTrainStep(lambda d, r, b: 0.0, sparse={"dense": t},
                     key_fns={"dense": lambda b: b["k"]})
+
+
+def test_compute_dtype_bfloat16_joint_step(mesh8):
+    """compute_dtype=bfloat16: the loss_fn provably sees bf16 dense
+    params, rows, and batch floats; master state stays f32; the bf16
+    trajectory tracks the f32 one."""
+    seen = []
+
+    def loss_fn(dp, rows, batch):
+        seen.append((dp["w"].dtype, rows["e"].dtype, batch["y"].dtype,
+                     batch["k"].dtype))
+        feats = jnp.concatenate(
+            [rows["e"].reshape(rows["e"].shape[0], -1),
+             jnp.ones((rows["e"].shape[0], 4), rows["e"].dtype)], axis=-1)
+        logits = feats @ dp["w"] + dp["b"]
+        return jnp.mean((logits - batch["y"]) ** 2)
+
+    rng = np.random.default_rng(0)
+    raw = {"k": np.arange(16, dtype=np.int32),
+           "y": rng.normal(size=16).astype(np.float32)}
+    finals = {}
+    for label, cd in [("f32", None), ("bf16", jnp.bfloat16)]:
+        dense = DenseTable({"w": jnp.zeros(8), "b": jnp.zeros(())}, mesh8,
+                           updater="sgd", lr=0.1)
+        emb = SparseTable(64, 4, mesh8, updater="adagrad", lr=0.1,
+                          init_scale=0.01, seed=3)
+        ps = PSTrainStep(loss_fn, dense=dense, sparse={"e": emb},
+                         key_fns={"e": lambda b: b["k"]},
+                         compute_dtype=cd)
+        batch = ps.shard_batch(raw)
+        l0 = float(ps(batch))
+        for _ in range(25):
+            l = float(ps(batch))
+        finals[label] = (l0, l)
+        assert dense.params.dtype == jnp.float32
+        assert emb.emb.dtype == jnp.float32
+    assert (jnp.bfloat16, jnp.bfloat16, jnp.bfloat16, jnp.int32) in seen
+    for label, (l0, l) in finals.items():
+        assert l < l0, (label, l0, l)
+    assert abs(finals["bf16"][1] - finals["f32"][1]) < 0.05, finals
