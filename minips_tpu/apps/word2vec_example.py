@@ -61,11 +61,15 @@ def run(cfg: Config, args, metrics) -> dict:
         return w2v.sgns_loss(rows["in"], rows["out"][:, 0],
                              rows["out"][:, 1:])
 
+    # grad_scale=B: the mean-loss gradient underscales per-row updates by
+    # the batch size; scaling restores the reference's per-sample SGD
+    # magnitude (classic per-pair word2vec updates at this lr).
     ps = PSTrainStep(
         loss_fn, sparse={"in": in_t, "out": out_t},
         key_fns={"in": lambda b: b["center"],
                  "out": lambda b: jnp.concatenate(
-                     [b["pos"][:, None], b["neg"]], axis=1)})
+                     [b["pos"][:, None], b["neg"]], axis=1)},
+        grad_scale=cfg.train.batch_size)
     batches = _pair_batches(cfg)
     loop = TrainLoop(lambda b: ps(ps.shard_batch(b)), batches,
                      metrics=metrics, log_every=cfg.train.log_every,

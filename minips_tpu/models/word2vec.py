@@ -33,12 +33,30 @@ def grad_fn(center_rows, pos_rows, neg_rows):
 
 
 class UnigramSampler:
-    """Host-side negative sampler over unigram counts^0.75."""
+    """Host-side negative sampler over unigram counts^0.75, via a Walker
+    alias table: O(vocab) setup, O(1) per draw — ``np.random.choice(p=...)``
+    is O(vocab) per call, which at enwiki-scale vocab makes the host
+    sampler the bottleneck of the whole input pipeline."""
 
     def __init__(self, counts: np.ndarray, power: float = 0.75, seed: int = 0):
         p = np.asarray(counts, np.float64) ** power
         self._p = p / p.sum()
         self._rng = np.random.default_rng(seed)
+        n = len(self._p)
+        scaled = self._p * n
+        self._prob = np.ones(n)
+        self._alias = np.arange(n)
+        small = [i for i in range(n) if scaled[i] < 1.0]
+        large = [i for i in range(n) if scaled[i] >= 1.0]
+        while small and large:
+            s, l = small.pop(), large.pop()
+            self._prob[s] = scaled[s]
+            self._alias[s] = l
+            scaled[l] -= 1.0 - scaled[s]
+            (small if scaled[l] < 1.0 else large).append(l)
+        # leftovers are 1.0 within float error; keep prob=1 (self-alias)
 
     def sample(self, shape) -> np.ndarray:
-        return self._rng.choice(len(self._p), size=shape, p=self._p)
+        idx = self._rng.integers(0, len(self._p), size=shape)
+        accept = self._rng.random(np.shape(idx)) < self._prob[idx]
+        return np.where(accept, idx, self._alias[idx])

@@ -49,15 +49,28 @@ class PSTrainStep:
         sparse: Optional[dict[str, SparseTable]] = None,
         key_fns: Optional[dict[str, Callable]] = None,
         compute_dtype: Optional[Any] = None,
+        grad_scale: float = 1.0,
     ):
         """``compute_dtype`` (e.g. ``jnp.bfloat16``): run ``loss_fn`` in
         reduced precision — dense params, gathered sparse rows, and
         floating batch leaves are cast down before the loss, gradients are
         cast back to float32 before the sharded optimizer / row updates,
         and master table state stays float32 throughout (same contract as
-        ``DenseTable.make_step(compute_dtype=...)``)."""
+        ``DenseTable.make_step(compute_dtype=...)``).
+
+        ``grad_scale``: multiply all gradients by this constant before the
+        updates while reporting the unscaled loss. The reference's server
+        SUMS per-key contributions (``updater->Update`` adds each worker
+        sample's gradient at full magnitude, SURVEY.md §3.3), so a
+        batch-MEAN ``loss_fn`` underscales row updates by the batch size;
+        ``grad_scale=batch_size`` restores per-sample update semantics
+        (classic per-pair SGD, e.g. word2vec) without distorting the
+        logged loss."""
         self.compute_dtype = (None if compute_dtype is None
                               else jnp.dtype(compute_dtype))
+        if grad_scale <= 0:
+            raise ValueError(f"grad_scale must be > 0, got {grad_scale}")
+        self.grad_scale = float(grad_scale)
         self.loss_fn = loss_fn
         self.dense = dense
         self.sparse = sparse or {}
@@ -97,6 +110,7 @@ class PSTrainStep:
         loss_fn = self.loss_fn
         mesh = self._mesh
         cd = self.compute_dtype
+        gscale = self.grad_scale
 
         def step(state, batch):
             # ----- pull phase (differentiable views of table state)
@@ -125,6 +139,10 @@ class PSTrainStep:
             else:
                 loss, g_rows = jax.value_and_grad(
                     lambda rw: compute_loss(None, rw))(rows)
+            if gscale != 1.0:
+                g_rows = jax.tree.map(lambda g: g * gscale, g_rows)
+                if dense is not None:
+                    g_flat = g_flat * gscale
 
             new_state = dict(state)
             # ----- dense push: reduce-scatter + sharded optax update

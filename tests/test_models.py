@@ -86,3 +86,21 @@ def test_unigram_sampler_distribution():
     freq = np.bincount(draws, minlength=4)
     assert freq[0] > freq[1] > freq[2]
     assert freq[3] == 0
+
+
+def test_unigram_sampler_alias_matches_target_distribution():
+    """The alias table reproduces counts^0.75 frequencies to statistical
+    accuracy (the O(1)-per-draw replacement for np.random.choice(p=...))."""
+    rng = np.random.default_rng(5)
+    counts = rng.integers(1, 1000, size=50)
+    s = word2vec.UnigramSampler(counts, seed=1)
+    n_draw = 200_000
+    draws = s.sample(n_draw)
+    freq = np.bincount(draws, minlength=50) / n_draw
+    p = counts.astype(np.float64) ** 0.75
+    p /= p.sum()
+    # 5-sigma binomial bound per bucket
+    sigma = np.sqrt(p * (1 - p) / n_draw)
+    assert np.all(np.abs(freq - p) < 5 * sigma + 1e-4)
+    # shape passthrough
+    assert s.sample((7, 3)).shape == (7, 3)

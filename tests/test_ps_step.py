@@ -123,3 +123,34 @@ def test_compute_dtype_bfloat16_joint_step(mesh8):
     for label, (l0, l) in finals.items():
         assert l < l0, (label, l0, l)
     assert abs(finals["bf16"][1] - finals["f32"][1]) < 0.05, finals
+
+
+def test_grad_scale_matches_sum_loss(mesh8):
+    """grad_scale=B with a mean loss produces exactly the updates of a
+    sum loss (the reference's per-sample server-add semantics), while the
+    reported loss stays the mean."""
+    raw = {"k": np.arange(16, dtype=np.int32),
+           "y": np.random.default_rng(0).normal(size=16).astype(np.float32)}
+
+    def mean_loss(dp, rows, batch):
+        pred = rows["e"].sum(axis=-1)
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def sum_loss(dp, rows, batch):
+        pred = rows["e"].sum(axis=-1)
+        return jnp.sum((pred - batch["y"]) ** 2)
+
+    embs = {}
+    losses = {}
+    for label, (fn, gs) in [("scaled_mean", (mean_loss, 16.0)),
+                            ("sum", (sum_loss, 1.0))]:
+        t = SparseTable(64, 4, mesh8, updater="sgd", lr=0.01,
+                        init_scale=0.01, seed=7)
+        ps = PSTrainStep(fn, sparse={"e": t},
+                         key_fns={"e": lambda b: b["k"]}, grad_scale=gs)
+        batch = ps.shard_batch(raw)
+        losses[label] = float(ps(batch))
+        embs[label] = np.asarray(t.emb)
+    np.testing.assert_allclose(embs["scaled_mean"], embs["sum"],
+                               rtol=1e-5, atol=1e-7)
+    assert losses["scaled_mean"] == pytest.approx(losses["sum"] / 16, 1e-5)
