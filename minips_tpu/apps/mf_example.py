@@ -55,9 +55,12 @@ def run(cfg: Config, args, metrics) -> dict:
         return mf_model.loss(rows["user"], rows["item"], batch["rating"],
                              mu=MU, reg=0.02)
 
+    # grad_scale=B: per-sample SGD magnitude (the reference's server-add
+    # semantics) instead of 1/B-scaled mean-loss grads — see word2vec.
     ps = PSTrainStep(loss_fn, sparse={"user": user_t, "item": item_t},
                      key_fns={"user": lambda b: b["user"],
-                              "item": lambda b: b["item"]})
+                              "item": lambda b: b["item"]},
+                     grad_scale=cfg.train.batch_size)
     batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
     loop = TrainLoop(lambda b: ps(ps.shard_batch(b)), batches,
                      metrics=metrics, log_every=cfg.train.log_every,

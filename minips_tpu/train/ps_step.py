@@ -65,7 +65,10 @@ class PSTrainStep:
         batch-MEAN ``loss_fn`` underscales row updates by the batch size;
         ``grad_scale=batch_size`` restores per-sample update semantics
         (classic per-pair SGD, e.g. word2vec) without distorting the
-        logged loss."""
+        logged loss. Note: adagrad rows are invariant to any constant
+        gradient scale (the accumulator normalizes it away up to eps), so
+        this knob only changes SGD-updated tables and the dense path's
+        scale-sensitive optimizers."""
         self.compute_dtype = (None if compute_dtype is None
                               else jnp.dtype(compute_dtype))
         if grad_scale <= 0:

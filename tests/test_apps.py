@@ -217,3 +217,20 @@ def test_word2vec_learns():
     losses = out["losses"]
     assert np.isfinite(losses).all()
     assert losses[-1] < 3.9, losses[-1]  # well off the 4.159 plateau
+
+
+def test_mf_learns():
+    """MF drives the squared error well below the init plateau within a
+    demo-scale run (per-sample grad_scale, like the reference's SGD)."""
+    from minips_tpu.apps import mf_example as app
+
+    cfg = Config(
+        table=TableConfig(name="factors", kind="sparse", consistency="asp",
+                          updater="sgd", lr=0.05, dim=9),
+        train=TrainConfig(batch_size=1024, num_iters=300, log_every=500),
+    )
+    out = app.run(cfg, _args(), MetricsLogger(None, verbose=False))
+    losses = out["losses"]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.35, losses[-1]
+    assert losses[-1] < losses[0] * 0.7
