@@ -54,6 +54,13 @@ def main(argv=None) -> int:
     ap.add_argument("--slow-rank", type=int, default=-1,
                     help="rank to artificially slow (straggler injection)")
     ap.add_argument("--slow-ms", type=float, default=0.0)
+    ap.add_argument("--jitter-ms", type=float, default=0.0,
+                    help="transient-stall injection: every rank sleeps "
+                         "this long on a random --jitter-prob fraction of "
+                         "its steps (rank-seeded; the workload where SSP "
+                         "beats BSP wall-clock — the slack window absorbs "
+                         "stalls instead of propagating them)")
+    ap.add_argument("--jitter-prob", type=float, default=0.2)
     ap.add_argument("--checkpoint-dir", default="")
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--resume", action="store_true")
@@ -134,7 +141,9 @@ def main(argv=None) -> int:
 
     losses = []
     rng = np.random.default_rng(rank)
+    jitter_rng = np.random.default_rng(1000 + rank)
     code = 0
+    t_loop0 = time.monotonic()
     try:
         for i in range(start_step, args.iters):
             if args.kill_at and rank == args.kill_rank and i == args.kill_at:
@@ -149,6 +158,8 @@ def main(argv=None) -> int:
             losses.append(loss)
             if rank == args.slow_rank and args.slow_ms > 0:
                 time.sleep(args.slow_ms / 1000.0)
+            if args.jitter_ms > 0 and jitter_rng.random() < args.jitter_prob:
+                time.sleep(args.jitter_ms / 1000.0)
             if (ckpt is not None and rank == 0 and args.checkpoint_every
                     and (i + 1) % args.checkpoint_every == 0):
                 ckpt.save(step=i + 1)
@@ -171,6 +182,7 @@ def main(argv=None) -> int:
         flat = np.asarray(flat)
         print(json.dumps({
             "rank": rank, "event": "done",
+            "wall_s": round(time.monotonic() - t_loop0, 4),
             "loss_first": losses[0] if losses else None,
             "loss_last": float(np.mean(losses[-5:])) if losses else None,
             "gate_waits": trainer.gate_waits,

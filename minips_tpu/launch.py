@@ -143,6 +143,49 @@ def wait(procs: list[subprocess.Popen], timeout: Optional[float] = None,
     return rc
 
 
+def run_local_job(n: int, argv: list[str], *, base_port: int,
+                  env_extra: Optional[dict] = None,
+                  timeout: float = 240.0) -> list[dict]:
+    """Spawn ``n`` local ranks of ``argv`` over loopback, wait, and harvest
+    the last JSON line each rank printed (the smoke/bench protocol: every
+    worker prints one result dict). Raises with the worker's captured
+    output if a rank produced no JSON or the job failed — shared by
+    tests/test_distributed_smoke.py and bench_ssp.py so the spawn/harvest
+    protocol lives in one place."""
+    import json
+    import tempfile
+
+    hosts = ["localhost"] * n
+    outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
+    procs = []
+    for rank in range(n):
+        env = child_env(rank, hosts, base_port)
+        if env_extra:
+            env.update(env_extra)
+        procs.append(subprocess.Popen(
+            argv, env=env, stdout=outs[rank], stderr=subprocess.STDOUT))
+    rc = wait(procs, timeout=timeout)
+    results = []
+    try:
+        for f in outs:
+            f.flush()
+            f.seek(0)
+            text = f.read()
+            lines = [json.loads(ln) for ln in text.splitlines()
+                     if ln.strip().startswith("{")]
+            if not lines:
+                raise RuntimeError(
+                    f"worker produced no JSON output (rc={rc}):\n{text}")
+            results.append(lines[-1])
+    finally:
+        for f in outs:
+            f.close()
+            os.unlink(f.name)
+    if rc != 0:
+        raise RuntimeError(f"job failed rc={rc}: {results}")
+    return results
+
+
 def init_from_env():
     """Worker-side: build my ControlBus from the launcher's env vars.
     Returns ``(proc_id, num_procs, bus)``; bus is None single-process.

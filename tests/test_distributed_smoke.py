@@ -18,11 +18,7 @@ commute, so every process's merged state matches up to float reorder noise.
 
 from __future__ import annotations
 
-import json
-import os
-import subprocess
 import sys
-import tempfile
 
 import numpy as np
 import pytest
@@ -36,34 +32,15 @@ _PORT = [5800]  # bumped per spawn so tests never collide on TIME_WAIT ports
 def run_job(n: int, extra: list[str], iters: int = 30,
             timeout: float = 240.0, env_extra: dict | None = None
             ) -> list[dict]:
-    """Launch n local worker processes, harvest one JSON line per rank."""
+    """Launch n local worker processes, harvest one JSON line per rank
+    (the shared spawn/harvest protocol lives in launch.run_local_job)."""
     _PORT[0] += n + 3
-    hosts = ["localhost"] * n
     env_patch = {"MINIPS_FORCE_CPU": "1",
                  "JAX_PLATFORMS": "cpu"}
     env_patch.update(env_extra or {})
-    outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
-    procs = []
-    for rank, host in enumerate(hosts):
-        env = launch.child_env(rank, hosts, _PORT[0])
-        env.update(env_patch)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", APP, "--iters", str(iters)] + extra,
-            env=env, stdout=outs[rank], stderr=subprocess.STDOUT))
-    rc = launch.wait(procs, timeout=timeout)
-    results = []
-    for f in outs:
-        f.flush()
-        f.seek(0)
-        text = f.read()
-        f.close()
-        os.unlink(f.name)
-        lines = [json.loads(l) for l in text.splitlines()
-                 if l.strip().startswith("{")]
-        assert lines, f"worker produced no JSON output:\n{text}"
-        results.append(lines[-1])
-    assert rc == 0, f"job failed rc={rc}: {results}"
-    return results
+    return launch.run_local_job(
+        n, [sys.executable, "-m", APP, "--iters", str(iters)] + extra,
+        base_port=_PORT[0], env_extra=env_patch, timeout=timeout)
 
 
 def assert_replicas_agree(results: list[dict]) -> None:
@@ -163,3 +140,22 @@ def test_two_processes_converge_better_than_start():
     for r in res:
         assert r["loss_last"] < r["loss_first"] - 0.02
     assert_replicas_agree(res)
+
+
+@pytest.mark.slow
+def test_ssp_beats_bsp_under_transient_stalls():
+    """The secondary-metric mechanism (BASELINE.json "SSP wall-clock to
+    target loss", bench_ssp.py's measurement): with random per-rank
+    stalls, BSP pays the union of all stalls, SSP absorbs them in the
+    slack window — less wall-clock, same loss, staleness bound held."""
+    jitter = ["--jitter-ms", "50", "--jitter-prob", "0.3"]
+    walls, finals, skews = {}, {}, {}
+    for mode, s in [("bsp", 0), ("ssp", 4)]:
+        rs = run_job(3, ["--mode", mode, "--staleness", str(s)] + jitter,
+                     iters=60)
+        walls[mode] = max(r["wall_s"] for r in rs)
+        finals[mode] = max(r["loss_last"] for r in rs)
+        skews[mode] = max(r["max_skew_seen"] for r in rs)
+    assert walls["ssp"] < walls["bsp"] * 0.92, (walls, skews)
+    assert abs(finals["ssp"] - finals["bsp"]) < 0.05, finals
+    assert skews["ssp"] <= 5  # s + 1 pre-gate
