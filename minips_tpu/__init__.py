@@ -24,5 +24,10 @@ from minips_tpu.core.config import Config, TableConfig, TrainConfig  # noqa: F40
 from minips_tpu.core.engine import Engine, Info, MLTask  # noqa: F401
 from minips_tpu.consistency import ASP, BSP, SSP, make_controller  # noqa: F401
 from minips_tpu.parallel.mesh import make_mesh  # noqa: F401
-from minips_tpu.tables.dense import DenseTable  # noqa: F401
+from minips_tpu.tables.dense import DenseTable, cast_floating  # noqa: F401
 from minips_tpu.tables.sparse import SparseTable  # noqa: F401
+from minips_tpu.train.loop import TrainLoop  # noqa: F401
+from minips_tpu.train.ps_step import PSTrainStep  # noqa: F401
+from minips_tpu.utils.evaluation import (StreamingAUC,  # noqa: F401
+                                         auc_exact, evaluate_auc)
+from minips_tpu.utils.metrics import MetricsLogger  # noqa: F401

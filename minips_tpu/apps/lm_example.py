@@ -104,6 +104,11 @@ def _flags(parser):
                         help="dp/sp: worker-math precision (bfloat16 = "
                              "MXU-native mixed precision; master weights "
                              "and the optimizer stay float32)")
+    parser.add_argument("--comm", default="float32",
+                        choices=["float32", "bfloat16", "int8"],
+                        help="dp/sp: wire format of the pull/push "
+                             "collectives (EQuARX-style quantization, "
+                             "2-4x fewer bytes, f32 accumulation)")
     parser.add_argument("--max_len", type=int, default=None,
                         help="positional-embedding capacity (default: "
                              f"{MODEL['max_len']}, auto-grown to "
@@ -129,19 +134,15 @@ def _model_cfg(args, seq_len: int) -> dict:
 def run(cfg: Config, args, metrics) -> dict:
     seq_len = getattr(args, "seq_len", 128)
     layout = getattr(args, "layout", "dp")
-    if getattr(args, "attn", "reference") == "flash" \
-            and layout not in ("dp", "sp"):
-        # tp/pp don't thread attn_impl through; failing loud beats
-        # silently training with different memory/perf than requested
-        raise SystemExit(f"--attn flash is only wired into --layout dp/sp "
-                         f"(got {layout})")
-    if getattr(args, "accum", 1) != 1 and layout not in ("dp", "sp"):
-        raise SystemExit(f"--accum is only wired into --layout dp/sp "
-                         f"(got {layout})")
-    if getattr(args, "dtype", "float32") != "float32" \
-            and layout not in ("dp", "sp"):
-        raise SystemExit(f"--dtype is only wired into --layout dp/sp "
-                         f"(got {layout})")
+    # These flags only thread through the dp/sp fused-step path; failing
+    # loud beats silently training with different memory/perf/precision
+    # than requested on tp/pp/ep.
+    if layout not in ("dp", "sp"):
+        for flag, default in (("attn", "reference"), ("accum", 1),
+                              ("dtype", "float32"), ("comm", "float32")):
+            if getattr(args, flag, default) != default:
+                raise SystemExit(f"--{flag} is only wired into --layout "
+                                 f"dp/sp (got {layout})")
     if layout in ("tp", "pp"):
         return _run_model_parallel(cfg, args, metrics, layout, seq_len)
     if layout == "ep":
@@ -161,6 +162,7 @@ def run(cfg: Config, args, metrics) -> dict:
     ckpt, start_step = _maybe_checkpointer(cfg, args, table)
 
     accum = getattr(args, "accum", 1)
+    comm = getattr(args, "comm", "float32")
     compute_dtype = (jnp.bfloat16
                      if getattr(args, "dtype", "float32") == "bfloat16"
                      else None)
@@ -169,7 +171,7 @@ def run(cfg: Config, args, metrics) -> dict:
             functools.partial(tfm.grad_fn, heads=heads,
                               attn_impl=getattr(args, "attn", "reference")),
             batch_spec=P(DATA_AXIS), accum=accum,
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, comm=comm)
         batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
 
         def prep(batch):
@@ -196,7 +198,7 @@ def run(cfg: Config, args, metrics) -> dict:
             sp_grad,
             batch_spec={"tokens": {"inp": P(None, DATA_AXIS),
                                    "tgt": P(None, DATA_AXIS)}},
-            accum=accum, compute_dtype=compute_dtype)
+            accum=accum, compute_dtype=compute_dtype, comm=comm)
         seq_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
 
         def prep(batch):

@@ -234,3 +234,25 @@ def test_mf_learns():
     assert np.isfinite(losses).all()
     assert losses[-1] < 0.35, losses[-1]
     assert losses[-1] < losses[0] * 0.7
+
+
+def test_lm_example_quantized_comm():
+    """--comm bfloat16/int8 wire compression trains dp to a loss near the
+    f32-wire run (quantization error is bounded per hop)."""
+    from minips_tpu.apps import lm_example as app
+
+    cfg = Config(
+        table=TableConfig(name="lm", kind="dense", updater="adam", lr=3e-3),
+        train=TrainConfig(batch_size=16, num_iters=12, log_every=100),
+    )
+    finals = {}
+    for comm in ("float32", "bfloat16", "int8"):
+        out = app.run(cfg, _args(layout="dp", seq_len=32, tp=2,
+                                 microbatches=2, comm=comm),
+                      MetricsLogger(None, verbose=False))
+        losses = out["losses"]
+        assert np.isfinite(losses).all(), comm
+        assert losses[-1] < losses[0], comm
+        finals[comm] = losses[-1]
+    assert abs(finals["bfloat16"] - finals["float32"]) < 0.05, finals
+    assert abs(finals["int8"] - finals["float32"]) < 0.15, finals
