@@ -34,7 +34,18 @@ DEFAULT = Config(
 
 def run(cfg: Config, args, metrics) -> dict:
     sizes = (784, 256, 128, 10)
-    data = synthetic.mnist_like(8192, seed=cfg.train.seed)
+    images = getattr(args, "images", None)
+    labels = getattr(args, "labels", None)
+    if images:  # real MNIST idx files (BASELINE.json:8)
+        if not labels:
+            raise SystemExit("--labels is required with --images")
+        from minips_tpu.data.mnist import read_mnist
+        data = read_mnist(images, labels)
+    else:
+        if labels:
+            raise SystemExit("--labels without --images would silently "
+                             "train on synthetic data; pass both")
+        data = synthetic.mnist_like(8192, seed=cfg.train.seed)
     template = mlp_model.init(jax.random.PRNGKey(cfg.train.seed), sizes)
 
     if getattr(args, "exec_mode", "spmd") == "threaded":
@@ -95,8 +106,18 @@ def _run_threaded(cfg, metrics, data, template) -> dict:
             "samples_per_sec": 0.0}
 
 
+def _flags(parser):
+    parser.add_argument("--images", default=None,
+                        help="MNIST images idx3 file (e.g. "
+                             "train-images-idx3-ubyte[.gz]); synthetic "
+                             "data when omitted")
+    parser.add_argument("--labels", default=None,
+                        help="MNIST labels idx1 file (required with "
+                             "--images)")
+
+
 def main():
-    return app_main("mlp_example", DEFAULT, run)
+    return app_main("mlp_example", DEFAULT, run, extra_flags=_flags)
 
 
 if __name__ == "__main__":

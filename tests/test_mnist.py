@@ -1,0 +1,105 @@
+"""MNIST idx codec + mlp_example --images path (real-file MLP workload)."""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from minips_tpu.data.mnist import read_idx, read_mnist, write_idx
+
+
+def _fake_mnist(tmp_path, n=512, gz=False):
+    rng = np.random.default_rng(0)
+    # separable digits: class k lights pixel block k
+    y = rng.integers(0, 10, size=n).astype(np.uint8)
+    imgs = rng.integers(0, 30, size=(n, 28, 28)).astype(np.uint8)
+    for i, k in enumerate(y):
+        imgs[i, k * 2: k * 2 + 2, :] = 255
+    ext = ".gz" if gz else ""
+    ip, lp = str(tmp_path / f"img{ext}"), str(tmp_path / f"lab{ext}")
+    write_idx(ip, imgs)
+    write_idx(lp, y)
+    return ip, lp, imgs, y
+
+
+def test_idx_roundtrip_all_dims(tmp_path):
+    for arr in (np.arange(12, dtype=np.uint8).reshape(3, 4),
+                np.arange(24, dtype=np.int32).reshape(2, 3, 4),
+                np.linspace(0, 1, 6, dtype=np.float32)):
+        p = str(tmp_path / "a.idx")
+        write_idx(p, arr)
+        out = read_idx(p)
+        assert out.dtype == arr.dtype
+        np.testing.assert_array_equal(out, arr)
+
+
+def test_idx_gzip_roundtrip(tmp_path):
+    arr = np.arange(60000, dtype=np.uint8).reshape(100, 600) % 251
+    p = str(tmp_path / "a.idx.gz")
+    write_idx(p, arr)
+    np.testing.assert_array_equal(read_idx(p), arr)
+    with gzip.open(p, "rb") as f:  # really gzipped
+        f.read(1)
+
+
+def test_read_mnist_shapes_and_range(tmp_path):
+    ip, lp, imgs, y = _fake_mnist(tmp_path)
+    data = read_mnist(ip, lp)
+    assert data["x"].shape == (512, 784) and data["x"].dtype == np.float32
+    assert data["y"].shape == (512,) and data["y"].dtype == np.int32
+    assert 0.0 <= data["x"].min() and data["x"].max() <= 1.0
+    np.testing.assert_array_equal(data["y"], y.astype(np.int32))
+
+
+def test_truncated_idx_rejected(tmp_path):
+    ip, lp, _, _ = _fake_mnist(tmp_path, n=8)
+    raw = open(ip, "rb").read()
+    open(ip, "wb").write(raw[:-10])
+    with pytest.raises(ValueError, match="truncated"):
+        read_idx(ip)
+
+
+def test_label_count_mismatch_rejected(tmp_path):
+    ip, lp, _, _ = _fake_mnist(tmp_path, n=8)
+    write_idx(lp, np.zeros(5, np.uint8))
+    with pytest.raises(ValueError, match="does not match"):
+        read_mnist(ip, lp)
+
+
+def test_mlp_example_trains_from_idx_files(tmp_path):
+    from argparse import Namespace
+
+    from minips_tpu.apps import mlp_example as app
+    from minips_tpu.core.config import Config, TableConfig, TrainConfig
+    from minips_tpu.utils.metrics import MetricsLogger
+
+    ip, lp, _, _ = _fake_mnist(tmp_path, n=2048, gz=True)
+    cfg = Config(
+        table=TableConfig(name="mlp", kind="dense", updater="adagrad",
+                          lr=0.05),
+        train=TrainConfig(batch_size=256, num_iters=80, log_every=100),
+    )
+    out = app.run(cfg, Namespace(images=ip, labels=lp, exec_mode="spmd"),
+                  MetricsLogger(None, verbose=False))
+    assert out["losses"][-1] < out["losses"][0]
+    assert out["accuracy"] > 0.8, out["accuracy"]  # separable synthetic digits
+
+
+def test_float_images_not_rescaled(tmp_path):
+    ip = str(tmp_path / "fimg")
+    lp = str(tmp_path / "flab")
+    x = np.random.default_rng(1).uniform(size=(4, 2, 2)).astype(np.float32)
+    write_idx(ip, x)
+    write_idx(lp, np.zeros(4, np.uint8))
+    out = read_mnist(ip, lp)
+    np.testing.assert_allclose(out["x"], x.reshape(4, -1), rtol=1e-6)
+
+
+def test_short_header_raises_valueerror(tmp_path):
+    p = str(tmp_path / "short")
+    open(p, "wb").write(b"\x00\x00")
+    with pytest.raises(ValueError, match="truncated idx header"):
+        read_idx(p)
+    open(p, "wb").write(b"\x00\x00\x08\x02\x00\x00")  # dims cut off
+    with pytest.raises(ValueError, match="truncated idx dims"):
+        read_idx(p)
