@@ -44,9 +44,17 @@ def _make_tables(cfg, mesh, users=1024, items=2048):
 
 
 def run(cfg: Config, args, metrics) -> dict:
-    data = synthetic.movielens_like(seed=cfg.train.seed)
+    path = getattr(args, "data_file", None)
+    if path:  # real MovieLens ratings (csv/dat/u.data)
+        from minips_tpu.data.movielens import read_ratings
+        raw = read_ratings(path)
+        data = {k: raw[k] for k in ("user", "item", "rating")}
+    else:
+        data = synthetic.movielens_like(seed=cfg.train.seed)
     mesh = make_mesh()
-    user_t, item_t = _make_tables(cfg, mesh)
+    user_t, item_t = _make_tables(cfg, mesh,
+                                  users=int(data["user"].max()) + 1,
+                                  items=int(data["item"].max()) + 1)
 
     if getattr(args, "exec_mode", "spmd") == "threaded":
         return _run_threaded(cfg, metrics, data, user_t, item_t)
@@ -98,8 +106,14 @@ def _run_threaded(cfg, metrics, data, user_t, item_t) -> dict:
     return {"losses": mean_losses, "samples_per_sec": 0.0}
 
 
+def _flags(parser):
+    parser.add_argument("--data_file", default=None,
+                        help="MovieLens ratings file (ratings.csv, "
+                             "ratings.dat, or u.data) instead of synthetic")
+
+
 def main():
-    return app_main("mf_example", DEFAULT, run)
+    return app_main("mf_example", DEFAULT, run, extra_flags=_flags)
 
 
 if __name__ == "__main__":

@@ -28,8 +28,13 @@ DEFAULT = Config(
 NEG = 5
 
 
-def _pair_batches(cfg, vocab=10_000):
-    tokens, counts = synthetic.text_corpus(vocab, seed=cfg.train.seed)
+def _pair_batches(cfg, args, vocab=10_000):
+    path = getattr(args, "data_file", None)
+    if path:  # real text corpus (enwiki-style), word-level tokens
+        from minips_tpu.data.text import word_tokens
+        tokens, counts = word_tokens(path, vocab_size=vocab)
+    else:
+        tokens, counts = synthetic.text_corpus(vocab, seed=cfg.train.seed)
     centers, contexts = synthetic.skipgram_pairs(tokens,
                                                  seed=cfg.train.seed)
     sampler = w2v.UnigramSampler(counts, seed=cfg.train.seed)
@@ -70,7 +75,7 @@ def run(cfg: Config, args, metrics) -> dict:
                  "out": lambda b: jnp.concatenate(
                      [b["pos"][:, None], b["neg"]], axis=1)},
         grad_scale=cfg.train.batch_size)
-    batches = _pair_batches(cfg)
+    batches = _pair_batches(cfg, args)
     loop = TrainLoop(lambda b: ps(ps.shard_batch(b)), batches,
                      metrics=metrics, log_every=cfg.train.log_every,
                      batch_size=cfg.train.batch_size)
@@ -80,8 +85,14 @@ def run(cfg: Config, args, metrics) -> dict:
             "tables": (in_t, out_t)}
 
 
+def _flags(parser):
+    parser.add_argument("--data_file", default=None,
+                        help="text file (enwiki-style) tokenized at word "
+                             "level instead of the synthetic corpus")
+
+
 def main():
-    return app_main("word2vec_example", DEFAULT, run)
+    return app_main("word2vec_example", DEFAULT, run, extra_flags=_flags)
 
 
 if __name__ == "__main__":

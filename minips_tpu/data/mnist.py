@@ -78,6 +78,10 @@ def read_mnist(images_path: str, labels_path: str) -> dict:
             f"labels shape {labels.shape} does not match "
             f"{images.shape[0]} images")
     x = images.reshape(images.shape[0], -1).astype(np.float32)
-    if np.issubdtype(images.dtype, np.integer):
+    if images.dtype == np.uint8:
         x /= 255.0  # uint8 pixels -> [0, 1]; float files are kept as-is
+    elif np.issubdtype(images.dtype, np.integer):
+        raise ValueError(
+            f"images dtype {images.dtype} has no defined [0,1] scaling; "
+            "MNIST images are uint8 (or pre-scaled floats)")
     return {"x": x, "y": labels.astype(np.int32)}

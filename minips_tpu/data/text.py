@@ -42,3 +42,43 @@ def read_lm_file(path: str, seq_len: int, *,
                  max_windows: int | None = None) -> dict:
     """Convenience: file path -> LM windows dict."""
     return byte_windows(read_bytes(path), seq_len, max_windows=max_windows)
+
+
+def word_tokens(path: str, vocab_size: int = 10_000,
+                min_count: int = 1) -> tuple[np.ndarray, np.ndarray]:
+    """Whitespace-tokenize a text file into word ids for word2vec.
+
+    Classic w2v preprocessing (the reference's enwiki pipeline shape):
+    keep the ``vocab_size`` most frequent words with count >= min_count,
+    DROP out-of-vocab tokens from the stream (w2v convention — an UNK
+    bucket would dominate the unigram table), and return
+    ``(ids [n] int32, counts [vocab] int64)`` where id ordering is by
+    descending frequency (id 0 = most frequent; ties broken
+    lexicographically for determinism). ``counts`` feeds UnigramSampler
+    directly.
+
+    Two streaming line passes (count, then map) so memory stays near the
+    KEPT token stream, not several times the corpus size — this is the
+    enwiki-scale path."""
+    from collections import Counter
+
+    counter: Counter = Counter()
+    with open(path, "r", errors="replace") as f:
+        for line in f:
+            counter.update(line.split())
+    if not counter:
+        raise ValueError(f"{path}: no tokens")
+    ranked = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+    kept = [(w, c) for w, c in ranked[:vocab_size] if c >= min_count]
+    if not kept:
+        raise ValueError(f"{path}: vocab filter dropped every token")
+    word_to_id = {w: i for i, (w, _) in enumerate(kept)}
+    chunks = []
+    with open(path, "r", errors="replace") as f:
+        for line in f:
+            mapped = [word_to_id[w] for w in line.split()
+                      if w in word_to_id]
+            if mapped:
+                chunks.append(np.asarray(mapped, np.int32))
+    ids = np.concatenate(chunks)
+    return ids, np.asarray([c for _, c in kept], np.int64)

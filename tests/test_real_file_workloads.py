@@ -1,0 +1,118 @@
+"""MovieLens ratings reader + word-level tokenizer and their app wiring —
+the remaining real-file paths for the BASELINE workloads."""
+
+from argparse import Namespace
+
+import numpy as np
+import pytest
+
+from minips_tpu.core.config import Config, TableConfig, TrainConfig
+from minips_tpu.data.movielens import read_ratings
+from minips_tpu.data.text import word_tokens
+from minips_tpu.utils.metrics import MetricsLogger
+
+
+def test_read_ratings_all_three_formats(tmp_path):
+    rows = [(3, 7, 4.0), (1, 7, 2.5), (3, 9, 5.0)]
+    csv = tmp_path / "ratings.csv"
+    csv.write_text("userId,movieId,rating,timestamp\n"
+                   + "\n".join(f"{u},{i},{r},123" for u, i, r in rows))
+    dat = tmp_path / "ratings.dat"
+    dat.write_text("\n".join(f"{u}::{i}::{r}::123" for u, i, r in rows))
+    udata = tmp_path / "u.data"
+    udata.write_text("\n".join(f"{u}\t{i}\t{r}\t123" for u, i, r in rows))
+    outs = [read_ratings(str(p)) for p in (csv, dat, udata)]
+    for out in outs:
+        assert out["num_users"] == 2 and out["num_items"] == 2
+        # dense remap: users {1,3}->{0,1}, items {7,9}->{0,1}
+        np.testing.assert_array_equal(out["user"], [1, 0, 1])
+        np.testing.assert_array_equal(out["item"], [0, 0, 1])
+        np.testing.assert_allclose(out["rating"], [4.0, 2.5, 5.0])
+
+
+def test_read_ratings_rejects_garbage(tmp_path):
+    p = tmp_path / "bad"
+    p.write_text("header,line,here\n1,2,3\nnot,a,row\n")
+    with pytest.raises(ValueError, match="unparseable"):
+        read_ratings(str(p))
+    (tmp_path / "empty").write_text("")
+    with pytest.raises(ValueError, match="no ratings"):
+        read_ratings(str(tmp_path / "empty"))
+
+
+def test_word_tokens_frequency_ranked_and_filtered(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_text("the the the cat cat sat on on on on a mat\n")
+    ids, counts = word_tokens(str(p), vocab_size=3)
+    # top-3: on(4) the(3) cat(2); sat/a/mat dropped
+    assert list(counts) == [4, 3, 2]
+    assert ids.max() == 2 and len(ids) == 9  # 4+3+2 kept tokens
+    # id 0 is the most frequent word
+    assert (ids == 0).sum() == 4
+
+
+def test_mf_example_from_ratings_file(tmp_path):
+    from minips_tpu.apps import mf_example as app
+
+    rng = np.random.default_rng(0)
+    U = rng.normal(scale=0.5, size=(60, 8))
+    V = rng.normal(scale=0.5, size=(80, 8))
+    u = rng.integers(0, 60, size=6000)
+    i = rng.integers(0, 80, size=6000)
+    r = np.clip(3.0 + (U[u] * V[i]).sum(-1), 0.5, 5.0)
+    p = tmp_path / "ratings.dat"
+    p.write_text("\n".join(f"{a + 1}::{b + 1}::{c:.2f}::0"
+                           for a, b, c in zip(u, i, r)))
+    cfg = Config(
+        table=TableConfig(name="factors", kind="sparse", consistency="asp",
+                          updater="sgd", lr=0.05, dim=9),
+        train=TrainConfig(batch_size=512, num_iters=200, log_every=500),
+    )
+    out = app.run(cfg, Namespace(data_file=str(p)),
+                  MetricsLogger(None, verbose=False))
+    losses = out["losses"]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_word2vec_from_text_file(tmp_path):
+    from minips_tpu.apps import word2vec_example as app
+
+    # structured corpus: words co-occur within fixed blocks, so skip-gram
+    # signal exists
+    rng = np.random.default_rng(1)
+    blocks = [[f"w{b}_{k}" for k in range(8)] for b in range(30)]
+    words = []
+    for _ in range(4000):
+        blk = blocks[rng.integers(0, 30)]
+        words.extend(rng.choice(blk, size=6))
+    p = tmp_path / "corpus.txt"
+    p.write_text(" ".join(words))
+    cfg = Config(
+        table=TableConfig(name="emb", kind="sparse", consistency="asp",
+                          updater="sgd", lr=0.05, dim=32,
+                          num_slots=1 << 12),
+        train=TrainConfig(batch_size=512, num_iters=150, log_every=500),
+    )
+    out = app.run(cfg, Namespace(data_file=str(p)),
+                  MetricsLogger(None, verbose=False))
+    losses = out["losses"]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 3.9, losses[-1]  # off the 4.159 plateau
+
+
+def test_corrupt_first_dat_row_raises(tmp_path):
+    p = tmp_path / "ratings.dat"
+    p.write_text("abc::7::4.0::0\n1::2::3.0::0\n")
+    with pytest.raises(ValueError, match="unparseable"):
+        read_ratings(str(p))
+
+
+def test_signed_int_images_rejected(tmp_path):
+    from minips_tpu.data.mnist import read_mnist, write_idx
+
+    ip, lp = str(tmp_path / "i"), str(tmp_path / "l")
+    write_idx(ip, np.zeros((2, 2, 2), np.int32))
+    write_idx(lp, np.zeros(2, np.uint8))
+    with pytest.raises(ValueError, match="no defined"):
+        read_mnist(ip, lp)
