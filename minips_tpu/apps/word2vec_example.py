@@ -35,6 +35,10 @@ def _pair_batches(cfg, args, vocab=10_000):
         tokens, counts = word_tokens(path, vocab_size=vocab)
     else:
         tokens, counts = synthetic.text_corpus(vocab, seed=cfg.train.seed)
+    t = getattr(args, "subsample", 0.0)
+    if t > 0:  # classic frequent-word subsampling (t=1e-5 at enwiki scale)
+        tokens = w2v.subsample_frequent(tokens, counts, t=t,
+                                        seed=cfg.train.seed)
     centers, contexts = synthetic.skipgram_pairs(tokens,
                                                  seed=cfg.train.seed)
     sampler = w2v.UnigramSampler(counts, seed=cfg.train.seed)
@@ -89,6 +93,10 @@ def _flags(parser):
     parser.add_argument("--data_file", default=None,
                         help="text file (enwiki-style) tokenized at word "
                              "level instead of the synthetic corpus")
+    parser.add_argument("--subsample", type=float, default=0.0,
+                        help="frequent-word subsampling threshold t "
+                             "(classic 1e-5 for enwiki-scale corpora; "
+                             "0 disables)")
 
 
 def main():

@@ -32,6 +32,28 @@ def grad_fn(center_rows, pos_rows, neg_rows):
     return l, gc, gp, gn
 
 
+def subsample_frequent(ids: np.ndarray, counts: np.ndarray,
+                       t: float = 1e-5, seed: int = 0) -> np.ndarray:
+    """Classic w2v frequent-word subsampling: token occurrences of word w
+    are KEPT with probability ``min(1, sqrt(t / f(w)))`` where ``f`` is
+    w's relative frequency — very frequent words ("the") are mostly
+    dropped, rare words always kept, which both speeds training and
+    improves rare-word vectors. ``t`` is the classic 1e-5 for real
+    corpora (1e-3..1e-4 for small ones); the returned stream is the
+    filtered ``ids``."""
+    if t <= 0:
+        return ids
+    counts = np.asarray(counts, np.float64)
+    freq = counts / counts.sum()
+    keep_p = np.minimum(1.0, np.sqrt(t / np.maximum(freq, 1e-300)))
+    rng = np.random.default_rng(seed)
+    kept = ids[rng.random(ids.shape[0]) < keep_p[ids]]
+    if kept.size == 0:
+        raise ValueError(
+            f"subsample t={t} dropped the whole stream; raise t")
+    return kept
+
+
 class UnigramSampler:
     """Host-side negative sampler over unigram counts^0.75, via a Walker
     alias table: O(vocab) setup, O(1) per draw — ``np.random.choice(p=...)``

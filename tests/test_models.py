@@ -104,3 +104,20 @@ def test_unigram_sampler_alias_matches_target_distribution():
     assert np.all(np.abs(freq - p) < 5 * sigma + 1e-4)
     # shape passthrough
     assert s.sample((7, 3)).shape == (7, 3)
+
+
+def test_subsample_frequent_keeps_rare_drops_common():
+    from minips_tpu.models.word2vec import subsample_frequent
+
+    counts = np.array([100_000, 10])      # word 0 dominates
+    ids = np.concatenate([np.zeros(10_000, np.int32),
+                          np.ones(10, np.int32)])
+    kept = subsample_frequent(ids, counts, t=1e-3, seed=0)
+    # rare word survives in full; frequent word mostly dropped
+    assert (kept == 1).sum() == 10
+    frac0 = (kept == 0).sum() / 10_000
+    # keep_p(word0) = sqrt(1e-3 / (1e5/100010)) ~ 0.0316
+    assert 0.02 < frac0 < 0.05, frac0
+    # t=0 disables
+    out = subsample_frequent(ids, counts, t=0.0)
+    assert out is ids
