@@ -100,6 +100,26 @@ def test_word2vec_from_text_file(tmp_path):
     assert np.isfinite(losses).all()
     assert losses[-1] < 3.9, losses[-1]  # off the 4.159 plateau
 
+    # semantic check: words from the same co-occurrence block should be
+    # closer in embedding space than words from different blocks
+    import jax.numpy as jnp
+    from collections import Counter
+
+    in_t, _ = out["tables"]
+    # rebuild the frequency-ranked word->id map word_tokens used
+    ctr = Counter(p.read_text().split())
+    ranked = [w for w, _ in sorted(ctr.items(), key=lambda kv: (-kv[1],
+                                                                kv[0]))]
+    emb = np.asarray(in_t.pull(jnp.arange(len(ranked))))
+    emb = emb / (np.linalg.norm(emb, axis=-1, keepdims=True) + 1e-8)
+    sims = emb @ emb.T
+    blocks_arr = np.asarray([w.split("_")[0] for w in ranked])
+    same_mask = (blocks_arr[:, None] == blocks_arr[None, :]) \
+        & ~np.eye(len(ranked), dtype=bool)
+    diff_mask = ~same_mask & ~np.eye(len(ranked), dtype=bool)
+    assert sims[same_mask].mean() > sims[diff_mask].mean() + 0.05, (
+        sims[same_mask].mean(), sims[diff_mask].mean())
+
 
 def test_corrupt_first_dat_row_raises(tmp_path):
     p = tmp_path / "ratings.dat"
