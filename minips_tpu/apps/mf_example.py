@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from minips_tpu.apps.common import app_main
+from minips_tpu.apps.common import (app_main, holdout_split,
+                                    threaded_train)
 from minips_tpu.core.config import Config, TableConfig, TrainConfig
 from minips_tpu.core.engine import Engine, MLTask
 from minips_tpu.data.loader import BatchIterator
@@ -55,9 +56,11 @@ def run(cfg: Config, args, metrics) -> dict:
     user_t, item_t = _make_tables(cfg, mesh,
                                   users=int(data["user"].max()) + 1,
                                   items=int(data["item"].max()) + 1)
+    data, holdout = holdout_split(data, getattr(args, "eval_frac", 0.0),
+                                  seed=cfg.train.seed)
 
     if getattr(args, "exec_mode", "spmd") == "threaded":
-        return _run_threaded(cfg, metrics, data, user_t, item_t)
+        return _run_threaded(cfg, metrics, data, user_t, item_t, holdout)
 
     def loss_fn(dense_params, rows, batch):
         return mf_model.loss(rows["user"], rows["item"], batch["rating"],
@@ -74,12 +77,42 @@ def run(cfg: Config, args, metrics) -> dict:
                      metrics=metrics, log_every=cfg.train.log_every,
                      batch_size=cfg.train.batch_size)
     losses = loop.run(cfg.train.num_iters)
-    return {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
-            "tables": (user_t, item_t)}
+    out = {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
+           "tables": (user_t, item_t)}
+    return _score_holdout_rmse(out, holdout, user_t, item_t, metrics)
 
 
-def _run_threaded(cfg, metrics, data, user_t, item_t) -> dict:
-    from minips_tpu.apps.common import threaded_train
+def _score_holdout_rmse(out, holdout, user_t, item_t, metrics,
+                        chunk: int = 8192) -> dict:
+    """Rating prediction is a regression — the holdout metric is RMSE,
+    the MovieLens-standard number (CTR apps use AUC instead). Streams the
+    holdout in fixed-size chunks like utils.evaluation.evaluate_auc so a
+    ML-20M-sized holdout never materializes one giant gather."""
+    if holdout is None:
+        return out
+    n = len(holdout["rating"])
+    sq_err = 0.0
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        pad = chunk - (hi - lo)  # repeat-pad the ragged tail: one
+        # compiled shape for every chunk, padded rows masked out below
+
+        def cut(v):
+            c = np.asarray(v[lo:hi])
+            return (np.concatenate([c, np.repeat(c[-1:], pad)])
+                    if pad else c)
+
+        pred = np.asarray(mf_model.predict(
+            user_t.pull(jnp.asarray(cut(holdout["user"]))),
+            item_t.pull(jnp.asarray(cut(holdout["item"]))), mu=MU))
+        err = pred[: hi - lo] - holdout["rating"][lo:hi]
+        sq_err += float(np.sum(err * err))
+    out["rmse"] = float(np.sqrt(sq_err / n))
+    metrics.log(holdout_rmse=out["rmse"], holdout_rows=n)
+    return out
+
+
+def _run_threaded(cfg, metrics, data, user_t, item_t, holdout=None) -> dict:
     from minips_tpu.consistency import make_controller
 
     engine = Engine(num_workers=cfg.train.num_workers).start_everything()
@@ -94,22 +127,31 @@ def _run_threaded(cfg, metrics, data, user_t, item_t) -> dict:
         i_rows = it_.pull(keys=batch["item"])
         loss, gu, gi = g(u_rows, i_rows,
                          {"rating": jnp.asarray(batch["rating"])})
-        # scale by 1/num_workers so aggregate step size matches spmd mode
-        ut.push(gu / info.num_workers, keys=batch["user"])
-        it_.push(gi / info.num_workers, keys=batch["item"])
+        # push the SUM of per-sample grads (mean-loss grads x B) — the
+        # reference's server-add magnitude, matching the spmd path's
+        # grad_scale=batch_size; without it updates are 1/B-scaled and
+        # demo-length runs never leave the mean-baseline plateau
+        scale = float(len(batch["rating"]))
+        ut.push(gu * scale, keys=batch["user"])
+        it_.push(gi * scale, keys=batch["item"])
         return loss
 
     mean_losses = threaded_train(engine, cfg, data, step_fn,
                                  clock_tables=["user", "item"])
     engine.stop_everything()
     metrics.log(final_loss=mean_losses[-1])
-    return {"losses": mean_losses, "samples_per_sec": 0.0}
+    return _score_holdout_rmse(
+        {"losses": mean_losses, "samples_per_sec": 0.0}, holdout,
+        user_t, item_t, metrics)
 
 
 def _flags(parser):
     parser.add_argument("--data_file", default=None,
                         help="MovieLens ratings file (ratings.csv, "
                              "ratings.dat, or u.data) instead of synthetic")
+    parser.add_argument("--eval_frac", type=float, default=0.0,
+                        help="opt-in: fraction of ratings held out and "
+                             "scored by RMSE after training")
 
 
 def main():

@@ -136,3 +136,34 @@ def test_signed_int_images_rejected(tmp_path):
     write_idx(lp, np.zeros(2, np.uint8))
     with pytest.raises(ValueError, match="no defined"):
         read_mnist(ip, lp)
+
+
+def test_mf_holdout_rmse():
+    """--eval_frac on MF: held-out RMSE beats the predict-the-mean
+    baseline (the data is genuinely low-rank)."""
+    from minips_tpu.apps import mf_example as app
+
+    cfg = Config(
+        table=TableConfig(name="factors", kind="sparse", consistency="asp",
+                          updater="sgd", lr=0.05, dim=9),
+        train=TrainConfig(batch_size=1024, num_iters=1500, log_every=5000),
+    )
+    out = app.run(cfg, Namespace(eval_frac=0.2),
+                  MetricsLogger(None, verbose=False))
+    # mean-baseline RMSE = rating std ~0.73; measured ~0.26 at 1500 iters
+    assert 0.0 < out["rmse"] < 0.45, out["rmse"]
+
+
+def test_mf_threaded_honors_eval_frac():
+    from minips_tpu.apps import mf_example as app
+
+    cfg = Config(
+        table=TableConfig(name="factors", kind="sparse", consistency="asp",
+                          updater="sgd", lr=0.05, dim=9),
+        train=TrainConfig(batch_size=512, num_iters=400, num_workers=2,
+                          log_every=5000),
+    )
+    out = app.run(cfg, Namespace(eval_frac=0.2, exec_mode="threaded"),
+                  MetricsLogger(None, verbose=False))
+    # mean-baseline RMSE ~0.73; measured ~0.52 at 400 iters
+    assert 0.0 < out["rmse"] < 0.65, out["rmse"]
