@@ -90,22 +90,15 @@ def _score_holdout_rmse(out, holdout, user_t, item_t, metrics,
     ML-20M-sized holdout never materializes one giant gather."""
     if holdout is None:
         return out
+    from minips_tpu.utils.evaluation import padded_chunks
+
     n = len(holdout["rating"])
     sq_err = 0.0
-    for lo in range(0, n, chunk):
-        hi = min(lo + chunk, n)
-        pad = chunk - (hi - lo)  # repeat-pad the ragged tail: one
-        # compiled shape for every chunk, padded rows masked out below
-
-        def cut(v):
-            c = np.asarray(v[lo:hi])
-            return (np.concatenate([c, np.repeat(c[-1:], pad)])
-                    if pad else c)
-
+    for batch, n_valid in padded_chunks(holdout, chunk):
         pred = np.asarray(mf_model.predict(
-            user_t.pull(jnp.asarray(cut(holdout["user"]))),
-            item_t.pull(jnp.asarray(cut(holdout["item"]))), mu=MU))
-        err = pred[: hi - lo] - holdout["rating"][lo:hi]
+            user_t.pull(jnp.asarray(batch["user"])),
+            item_t.pull(jnp.asarray(batch["item"])), mu=MU))
+        err = pred[:n_valid] - batch["rating"][:n_valid]
         sq_err += float(np.sum(err * err))
     out["rmse"] = float(np.sqrt(sq_err / n))
     metrics.log(holdout_rmse=out["rmse"], holdout_rows=n)

@@ -108,16 +108,16 @@ def auc_exact(scores, labels) -> float:
     return float((rank_sum_pos - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
 
 
-def evaluate_auc(predict_logits, data: dict, batch_size: int = 8192,
-                 label_key: str = "y", num_buckets: int = 1 << 14) -> float:
-    """Stream ``data`` through ``predict_logits(batch)->logits`` in fixed
-    chunks (a ragged tail is padded and masked by weight so every chunk has
-    one compiled shape) and return the streaming AUC."""
-    n = int(np.asarray(data[label_key]).shape[0])
-    auc = StreamingAUC(num_buckets)
+def padded_chunks(data: dict, batch_size: int):
+    """Yield ``(chunk, n_valid)`` over dict-of-arrays rows: every chunk is
+    repeat-padded to exactly ``batch_size`` rows (one compiled shape for
+    the whole sweep; padded rows duplicate the last valid row and must be
+    masked/sliced out by the consumer via ``n_valid``). Shared by
+    ``evaluate_auc`` and the apps' chunked holdout scorers."""
+    n = int(len(next(iter(data.values()))))
     for lo in range(0, n, batch_size):
         hi = min(lo + batch_size, n)
-        pad = batch_size - (hi - lo) if hi - lo < batch_size else 0
+        pad = batch_size - (hi - lo)
 
         def cut(v):
             chunk = np.asarray(v)[lo:hi]
@@ -126,9 +126,17 @@ def evaluate_auc(predict_logits, data: dict, batch_size: int = 8192,
                     [chunk, np.repeat(chunk[-1:], pad, axis=0)], axis=0)
             return chunk
 
-        batch = {k: cut(v) for k, v in data.items()}
-        w = np.ones((hi - lo + pad,), np.float32)
-        if pad:
-            w[hi - lo:] = 0.0
+        yield {k: cut(v) for k, v in data.items()}, hi - lo
+
+
+def evaluate_auc(predict_logits, data: dict, batch_size: int = 8192,
+                 label_key: str = "y", num_buckets: int = 1 << 14) -> float:
+    """Stream ``data`` through ``predict_logits(batch)->logits`` in fixed
+    chunks (a ragged tail is padded and masked by weight so every chunk has
+    one compiled shape) and return the streaming AUC."""
+    auc = StreamingAUC(num_buckets)
+    for batch, n_valid in padded_chunks(data, batch_size):
+        w = np.ones((batch_size,), np.float32)
+        w[n_valid:] = 0.0
         auc.update(predict_logits(batch), batch[label_key], w)
     return auc.result()
