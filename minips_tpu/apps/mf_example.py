@@ -110,8 +110,10 @@ def _run_threaded(cfg, metrics, data, user_t, item_t, holdout=None) -> dict:
 
     engine = Engine(num_workers=cfg.train.num_workers).start_everything()
     for name, t in (("user", user_t), ("item", item_t)):
+        # honor --consistency/--staleness (asp = the reference config)
         engine.register_table(name, t, make_controller(
-            "asp", engine.num_workers, sync_every=0))
+            cfg.table.consistency, engine.num_workers,
+            staleness=cfg.table.staleness, sync_every=0))
     g = jax.jit(functools.partial(mf_model.grad_fn, mu=MU))
 
     def step_fn(info, batch):

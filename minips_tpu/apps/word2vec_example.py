@@ -28,7 +28,8 @@ DEFAULT = Config(
 NEG = 5
 
 
-def _pair_batches(cfg, args, vocab=10_000):
+def _pairs(cfg, args, vocab=10_000):
+    """(centers, contexts, counts) — tokenize/subsample/pair ONCE."""
     path = getattr(args, "data_file", None)
     if path:  # real text corpus (enwiki-style), word-level tokens
         from minips_tpu.data.text import word_tokens
@@ -41,18 +42,29 @@ def _pair_batches(cfg, args, vocab=10_000):
                                         seed=cfg.train.seed)
     centers, contexts = synthetic.skipgram_pairs(tokens,
                                                  seed=cfg.train.seed)
-    sampler = w2v.UnigramSampler(counts, seed=cfg.train.seed)
+    return centers, contexts, counts
+
+
+def _batch_gen(cfg, centers, contexts, counts, seed):
+    """Per-consumer infinite batch stream (own rng + sampler: safe to
+    create one per worker thread — a shared generator is not)."""
+    sampler = w2v.UnigramSampler(counts, seed=seed)
+    rng = np.random.default_rng(seed)
     B = cfg.train.batch_size
-    rng = np.random.default_rng(cfg.train.seed)
+    n = len(centers)
 
     def gen():
-        n = len(centers)
         while True:
             sel = rng.integers(0, n, size=B)
             yield {"center": centers[sel], "pos": contexts[sel],
                    "neg": sampler.sample((B, NEG)).astype(np.int32)}
 
     return gen()
+
+
+def _pair_batches(cfg, args, vocab=10_000):
+    centers, contexts, counts = _pairs(cfg, args, vocab)
+    return _batch_gen(cfg, centers, contexts, counts, cfg.train.seed)
 
 
 def run(cfg: Config, args, metrics) -> dict:
@@ -63,6 +75,8 @@ def run(cfg: Config, args, metrics) -> dict:
     out_t = SparseTable(cfg.table.num_slots, cfg.table.dim, mesh, name="out",
                         updater=cfg.table.updater, lr=cfg.table.lr,
                         init_scale=0.0, seed=2)
+    if getattr(args, "exec_mode", "spmd") == "threaded":
+        return _run_threaded(cfg, args, metrics, in_t, out_t)
     import jax.numpy as jnp
 
     def loss_fn(dense_params, rows, batch):
@@ -86,6 +100,57 @@ def run(cfg: Config, args, metrics) -> dict:
     losses = loop.run(cfg.train.num_iters)
     metrics.log(final_loss=losses[-1])
     return {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
+            "tables": (in_t, out_t)}
+
+
+def _run_threaded(cfg, args, metrics, in_t, out_t) -> dict:
+    """ASP worker threads — the reference's literal "async push" w2v
+    (BASELINE.json:11): every thread pulls rows, pushes per-sample SGNS
+    gradients, never blocks."""
+    import jax
+    import jax.numpy as jnp
+
+    from minips_tpu.consistency import make_controller
+    from minips_tpu.core.engine import Engine
+
+    engine = Engine(num_workers=cfg.train.num_workers).start_everything()
+    for name, t in (("in", in_t), ("out", out_t)):
+        # honor --consistency/--staleness (asp = the reference config)
+        engine.register_table(name, t, make_controller(
+            cfg.table.consistency, engine.num_workers,
+            staleness=cfg.table.staleness, sync_every=0))
+    g = jax.jit(w2v.grad_fn)
+    centers, contexts, counts = _pairs(cfg, args)
+
+    def udf(info):
+        it_, ot = info.table("in"), info.table("out")
+        batches = _batch_gen(cfg, centers, contexts, counts,
+                             cfg.train.seed + info.worker_id)
+        losses = []
+        for _ in range(cfg.train.num_iters):
+            b = next(batches)  # sampled batches; no shard bookkeeping
+            out_keys = np.concatenate([b["pos"][:, None], b["neg"]], axis=1)
+            c_rows = it_.pull(keys=b["center"])  # gated per consistency
+            o_rows = ot.pull(keys=out_keys)
+            loss, gc, gp, gn = g(c_rows, o_rows[:, 0], o_rows[:, 1:])
+            scale = float(len(b["center"]))  # per-sample server-add
+            it_.push(gc * scale, keys=b["center"])
+            ot.push(jnp.concatenate([gp[:, None], gn], axis=1) * scale,
+                    keys=out_keys)
+            it_.clock()
+            ot.clock()
+            losses.append(float(loss))
+        return losses
+
+    from minips_tpu.core.engine import MLTask
+
+    per_worker = engine.run(MLTask(fn=udf))
+    engine.stop_everything()
+    n = min(len(v) for v in per_worker)
+    mean_losses = [float(np.mean([w[i] for w in per_worker]))
+                   for i in range(n)]
+    metrics.log(final_loss=mean_losses[-1])
+    return {"losses": mean_losses, "samples_per_sec": 0.0,
             "tables": (in_t, out_t)}
 
 

@@ -167,3 +167,22 @@ def test_mf_threaded_honors_eval_frac():
                   MetricsLogger(None, verbose=False))
     # mean-baseline RMSE ~0.73; measured ~0.52 at 400 iters
     assert 0.0 < out["rmse"] < 0.65, out["rmse"]
+
+
+def test_word2vec_threaded_async_push():
+    """--exec threaded: the reference's literal 'async push' w2v — ASP
+    worker threads, per-sample SGNS pushes, loss leaves the plateau."""
+    from minips_tpu.apps import word2vec_example as app
+
+    cfg = Config(
+        table=TableConfig(name="emb", kind="sparse", consistency="asp",
+                          updater="sgd", lr=0.05, dim=32,
+                          num_slots=1 << 12),
+        train=TrainConfig(batch_size=512, num_iters=120, num_workers=2,
+                          log_every=5000),
+    )
+    out = app.run(cfg, Namespace(exec_mode="threaded"),
+                  MetricsLogger(None, verbose=False))
+    losses = out["losses"]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 3.9, losses[-1]
