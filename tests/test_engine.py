@@ -108,3 +108,33 @@ def test_sparse_table_via_engine():
     assert len(set(slots.tolist())) == 3
     np.testing.assert_allclose(rows[1], 2 * rows[0], rtol=1e-6)
     np.testing.assert_allclose(rows[0], rows[2], rtol=1e-6)
+
+
+def test_mltask_builder_api(mesh8):
+    """Reference builder verbs (SURVEY.md §2 MLTask::SetLambda /
+    SetWorkerAlloc) — chainable and honored by Engine.run."""
+    from minips_tpu.core.config import TableConfig
+    from minips_tpu.core.engine import Engine, MLTask
+
+    eng = Engine(num_workers=2).start_everything()
+    eng.create_table(TableConfig(name="t", kind="dense", consistency="bsp",
+                                 updater="sgd", lr=0.1),
+                     template={"w": jnp.zeros(4)})
+    seen = []
+    task = MLTask().set_lambda(
+        lambda info: seen.append(info.worker_id)).set_worker_alloc(2)
+    eng.run(task)
+    eng.stop_everything()
+    assert sorted(seen) == [0, 1]
+
+
+def test_config_json_roundtrip(tmp_path):
+    """to_json/from_json mirror --config_file (SURVEY.md §5.6)."""
+    from minips_tpu.core.config import Config, TableConfig, TrainConfig
+
+    cfg = Config(table=TableConfig(name="x", kind="sparse", staleness=3,
+                                   updater="adagrad", lr=0.25, dim=7),
+                 train=TrainConfig(batch_size=96, num_iters=5),
+                 app={"extra": 1})
+    back = Config.from_json(cfg.to_json())
+    assert back == cfg
