@@ -110,16 +110,10 @@ def test_sparse_table_via_engine():
     np.testing.assert_allclose(rows[0], rows[2], rtol=1e-6)
 
 
-def test_mltask_builder_api(mesh8):
+def test_mltask_builder_api():
     """Reference builder verbs (SURVEY.md §2 MLTask::SetLambda /
     SetWorkerAlloc) — chainable and honored by Engine.run."""
-    from minips_tpu.core.config import TableConfig
-    from minips_tpu.core.engine import Engine, MLTask
-
-    eng = Engine(num_workers=2).start_everything()
-    eng.create_table(TableConfig(name="t", kind="dense", consistency="bsp",
-                                 updater="sgd", lr=0.1),
-                     template={"w": jnp.zeros(4)})
+    eng = make_engine(2, consistency="bsp")
     seen = []
     task = MLTask().set_lambda(
         lambda info: seen.append(info.worker_id)).set_worker_alloc(2)
@@ -129,12 +123,21 @@ def test_mltask_builder_api(mesh8):
 
 
 def test_config_json_roundtrip(tmp_path):
-    """to_json/from_json mirror --config_file (SURVEY.md §5.6)."""
-    from minips_tpu.core.config import Config, TableConfig, TrainConfig
+    """to_json/from_json and the --config_file path (SURVEY.md §5.6)."""
+    import argparse
+
+    from minips_tpu.core.config import (Config, TrainConfig,
+                                        add_config_flags, config_from_args)
 
     cfg = Config(table=TableConfig(name="x", kind="sparse", staleness=3,
                                    updater="adagrad", lr=0.25, dim=7),
                  train=TrainConfig(batch_size=96, num_iters=5),
                  app={"extra": 1})
-    back = Config.from_json(cfg.to_json())
-    assert back == cfg
+    assert Config.from_json(cfg.to_json()) == cfg
+    # the gflags-style file path: --config_file round-trips through argparse
+    path = tmp_path / "cfg.json"
+    path.write_text(cfg.to_json())
+    parser = argparse.ArgumentParser()
+    add_config_flags(parser)
+    args = parser.parse_args(["--config_file", str(path)])
+    assert config_from_args(args) == cfg
