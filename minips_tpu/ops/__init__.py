@@ -1,6 +1,7 @@
 from minips_tpu.ops.sparse_update import (  # noqa: F401
     dedup_segment_sum,
     row_adagrad,
+    row_adam,
     row_sgd,
 )
 from minips_tpu.ops.quantized_comm import (  # noqa: F401

@@ -97,3 +97,63 @@ def _row_adagrad_sorted(emb, accum, slots, grads, lr, eps):
     step = -lr * g_sum / (jnp.sqrt(acc_rows) + eps)
     emb = emb.at[rep].add(step)
     return emb, accum
+
+
+def row_adam(emb: jnp.ndarray, m: jnp.ndarray, v: jnp.ndarray,
+             steps: jnp.ndarray, slots: jnp.ndarray, grads: jnp.ndarray,
+             lr: float, b1: float = 0.9, b2: float = 0.999,
+             eps: float = 1e-8, prefer_dense: bool | None = None):
+    """Row-wise LAZY Adam: touched rows get one full Adam step (moments,
+    per-row bias correction via a per-row step counter) and untouched rows
+    are left completely alone — no moment decay, the standard lazy-Adam
+    semantics sparse/CTR systems use, and the sparse analog of the
+    reference's per-key server update. Same two strategies as
+    :func:`row_adagrad`, auto-picked by static table size."""
+    if eps <= 0:
+        raise ValueError(f"eps must be > 0, got {eps}")
+    if prefer_dense is None:
+        # Adam's dense path streams m and v whole-table and materializes
+        # two extra table-shaped temporaries (~4x adagrad's scratch
+        # traffic), so its crossover to sort-dedup sits 4x lower.
+        prefer_dense = emb.size <= DENSE_ACCUM_MAX_ELEMS // 4
+    if prefer_dense:
+        return _row_adam_dense(emb, m, v, steps, slots, grads, lr, b1, b2,
+                               eps)
+    return _row_adam_sorted(emb, m, v, steps, slots, grads, lr, b1, b2,
+                            eps)
+
+
+def _row_adam_dense(emb, m, v, steps, slots, grads, lr, b1, b2, eps):
+    flat = slots.reshape(-1)
+    g = (jnp.zeros_like(emb)
+         .at[flat].add(grads.reshape(flat.shape[0], -1).astype(emb.dtype)))
+    touched = jnp.zeros((emb.shape[0],), jnp.bool_).at[flat].set(True)
+    tcol = touched[:, None]
+    steps_new = steps + touched.astype(steps.dtype)
+    m_new = jnp.where(tcol, b1 * m + (1 - b1) * g, m)
+    v_new = jnp.where(tcol, b2 * v + (1 - b2) * g * g, v)
+    tf = steps_new.astype(emb.dtype)
+    bc1 = jnp.where(touched, 1 - b1 ** tf, 1.0)[:, None]
+    bc2 = jnp.where(touched, 1 - b2 ** tf, 1.0)[:, None]
+    update = lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    return (emb - jnp.where(tcol, update, 0.0), m_new, v_new, steps_new)
+
+
+def _row_adam_sorted(emb, m, v, steps, slots, grads, lr, b1, b2, eps):
+    rep, g_sum, valid = dedup_segment_sum(slots, grads.astype(emb.dtype))
+    vcol = valid[:, None]
+    m_rows, v_rows = m[rep], v[rep]
+    s_new = steps[rep] + valid.astype(steps.dtype)
+    m_n = b1 * m_rows + (1 - b1) * g_sum
+    v_n = b2 * v_rows + (1 - b2) * g_sum * g_sum
+    tf = s_new.astype(emb.dtype)
+    bc1 = jnp.where(valid, 1 - b1 ** tf, 1.0)[:, None]
+    bc2 = jnp.where(valid, 1 - b2 ** tf, 1.0)[:, None]
+    update = lr * (m_n / bc1) / (jnp.sqrt(v_n / bc2) + eps)
+    # masked DELTA scatter-adds: invalid entries contribute exactly zero,
+    # so the duplicate rep=0 rows of the invalid tail are harmless
+    emb = emb.at[rep].add(jnp.where(vcol, -update, 0.0))
+    m = m.at[rep].add(jnp.where(vcol, m_n - m_rows, 0.0))
+    v = v.at[rep].add(jnp.where(vcol, v_n - v_rows, 0.0))
+    steps = steps.at[rep].add(valid.astype(steps.dtype))
+    return emb, m, v, steps

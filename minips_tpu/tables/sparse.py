@@ -66,8 +66,9 @@ class SparseTable:
         dtype=jnp.float32,
         use_pallas: Optional[bool] = None,
     ):
-        if updater not in ("sgd", "adagrad"):
-            raise ValueError("sparse updater must be 'sgd' or 'adagrad'")
+        if updater not in ("sgd", "adagrad", "adam"):
+            raise ValueError(
+                "sparse updater must be 'sgd', 'adagrad', or 'adam'")
         self.name = name
         self.mesh = mesh
         self.num_slots = int(num_slots)
@@ -95,13 +96,54 @@ class SparseTable:
         key = jax.random.PRNGKey(seed)
         emb = jax.random.normal(key, (self.num_slots, self.dim), dtype) * init_scale
         self.emb = jax.device_put(emb, self._sharding)
+        self.accum = None
+        self.m = self.v = self.steps = None
         if updater == "adagrad":
             self.accum = jax.device_put(
                 jnp.full((self.num_slots, self.dim), adagrad_init, dtype),
                 self._sharding,
             )
-        else:
-            self.accum = None
+        elif updater == "adam":  # row-wise LAZY adam: moments + per-row t
+            zeros = jnp.zeros((self.num_slots, self.dim), dtype)
+            self.m = jax.device_put(zeros, self._sharding)
+            self.v = jax.device_put(zeros, self._sharding)
+            self.steps = jax.device_put(
+                jnp.zeros((self.num_slots,), jnp.int32),
+                NamedSharding(mesh, P(DATA_AXIS)))
+
+    # --------------------------------------------------- unified opt state
+    # (emb,) + opt_state() is the table's full tuple; row_update is the
+    # pure per-push transition both SparseTable.push and the fused
+    # PSTrainStep use, so the two paths cannot drift numerically.
+    def opt_state(self) -> tuple:
+        if self.updater == "adagrad":
+            return (self.accum,)
+        if self.updater == "adam":
+            return (self.m, self.v, self.steps)
+        return ()
+
+    def set_opt_state(self, opt: tuple) -> None:
+        if self.updater == "adagrad":
+            (self.accum,) = opt
+        elif self.updater == "adam":
+            self.m, self.v, self.steps = opt
+
+    def row_update(self, emb, opt: tuple, slots, grads):
+        """Pure updater: (emb', opt') for one push of already-hashed slots.
+        Traceable under jit; duplicates follow the reference's
+        sum-then-update server semantics."""
+        from minips_tpu.ops.sparse_update import (row_adagrad, row_adam,
+                                                  row_sgd)
+
+        if self.updater == "sgd":
+            return row_sgd(emb, slots, grads, self.lr), ()
+        if self.updater == "adagrad":
+            (accum,) = opt
+            emb, accum = row_adagrad(emb, accum, slots, grads, self.lr)
+            return emb, (accum,)
+        m, v, steps = opt
+        emb, m, v, steps = row_adam(emb, m, v, steps, slots, grads, self.lr)
+        return emb, (m, v, steps)
 
     # ------------------------------------------------------------------ hash
     def slots_of(self, keys: jnp.ndarray) -> jnp.ndarray:
@@ -135,42 +177,39 @@ class SparseTable:
         """Scatter-add grads for (hashed) keys and apply the updater to the
         touched rows only — the reference's per-key server update
         (SURVEY.md §3.3 ``updater->Update(keys, grads)``)."""
-        if self.updater == "sgd":
-            self.emb = self._jit_push_sgd(self.emb, jnp.asarray(keys),
-                                          jnp.asarray(grads))
-        else:
-            self.emb, self.accum = self._jit_push_adagrad(
-                self.emb, self.accum, jnp.asarray(keys), jnp.asarray(grads))
+        self.emb, new_opt = self._jit_push(
+            self.emb, self.opt_state(), jnp.asarray(keys),
+            jnp.asarray(grads))
+        self.set_opt_state(new_opt)
 
     @functools.cached_property
-    def _jit_push_sgd(self):
-        from minips_tpu.ops.sparse_update import row_sgd
-
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def push(emb, keys, grads):
-            slots = hash_to_slots(keys, self.num_slots, self.salt)
-            return row_sgd(emb, slots, grads, self.lr)
-        return push
-
-    @functools.cached_property
-    def _jit_push_adagrad(self):
-        from minips_tpu.ops.sparse_update import row_adagrad
-
+    def _jit_push(self):
         @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def push(emb, accum, keys, grads):
+        def push(emb, opt, keys, grads):
             slots = hash_to_slots(keys, self.num_slots, self.salt)
-            return row_adagrad(emb, accum, slots, grads, self.lr)
+            return self.row_update(emb, opt, slots, grads)
         return push
 
     # ------------------------------------------------------------- state I/O
+    _OPT_KEYS = {"adagrad": ("accum",), "adam": ("m", "v", "steps"),
+                 "sgd": ()}
+
     def state_dict(self) -> dict:
         out = {"emb": np.asarray(self.emb)}
-        if self.accum is not None:
-            out["accum"] = np.asarray(self.accum)
+        for k in self._OPT_KEYS[self.updater]:
+            out[k] = np.asarray(getattr(self, k))
         return out
 
     def load_state_dict(self, state: dict) -> None:
+        missing = [k for k in self._OPT_KEYS[self.updater]
+                   if k not in state]
+        if missing:
+            raise ValueError(
+                f"checkpoint lacks sparse optimizer state {missing} for "
+                f"updater {self.updater!r} (written by a different "
+                "updater?)")
         self.emb = jax.device_put(jnp.asarray(state["emb"]), self._sharding)
-        if self.accum is not None and "accum" in state:
-            self.accum = jax.device_put(jnp.asarray(state["accum"]),
-                                        self._sharding)
+        for k in self._OPT_KEYS[self.updater]:
+            cur = getattr(self, k)
+            setattr(self, k, jax.device_put(
+                jnp.asarray(state[k]), cur.sharding))

@@ -31,7 +31,6 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from minips_tpu.parallel.mesh import DATA_AXIS
-from minips_tpu.ops.sparse_update import row_adagrad, row_sgd
 from minips_tpu.tables.dense import DenseTable, cast_floating
 from minips_tpu.tables.sparse import SparseTable, hash_to_slots
 
@@ -97,14 +96,15 @@ class PSTrainStep:
         if self.dense is not None:
             state["dense"] = (self.dense.params, self.dense.opt_state)
         for name, t in self.sparse.items():
-            state[name] = (t.emb, t.accum)
+            state[name] = (t.emb, t.opt_state())
         return state
 
     def _restore_state(self, state: dict) -> None:
         if self.dense is not None:
             self.dense.params, self.dense.opt_state = state["dense"]
         for name, t in self.sparse.items():
-            t.emb, t.accum = state[name]
+            t.emb, opt = state[name]
+            t.set_opt_state(opt)
 
     def _build(self):
         dense = self.dense
@@ -156,14 +156,11 @@ class PSTrainStep:
                 new_state["dense"] = (optax.apply_updates(p_flat, updates),
                                       opt)
             # ----- sparse pushes: row-wise updater on touched slots
+            # (shared transition with SparseTable.push: t.row_update)
             for name, t in sparse.items():
-                emb, accum = state[name]
-                if t.updater == "sgd":
-                    emb = row_sgd(emb, slots[name], g_rows[name], t.lr)
-                else:
-                    emb, accum = row_adagrad(emb, accum, slots[name],
-                                             g_rows[name], t.lr)
-                new_state[name] = (emb, accum)
+                emb, opt = state[name]
+                new_state[name] = t.row_update(emb, opt, slots[name],
+                                               g_rows[name])
             return new_state, loss
 
         return jax.jit(step, donate_argnums=(0,))
