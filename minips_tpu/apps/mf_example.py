@@ -25,7 +25,7 @@ from minips_tpu.data.loader import BatchIterator
 from minips_tpu.data import synthetic
 from minips_tpu.models import mf as mf_model
 from minips_tpu.parallel.mesh import make_mesh
-from minips_tpu.tables.sparse import SparseTable
+from minips_tpu.tables.sparse import SparseTable, next_pow2
 from minips_tpu.train.loop import TrainLoop
 from minips_tpu.train.ps_step import PSTrainStep
 
@@ -38,10 +38,14 @@ MU = 3.0  # global rating mean offset
 
 
 def _make_tables(cfg, mesh, users=1024, items=2048):
+    # Capacities round UP to a power of two (hash_to_slots masks), and the
+    # readers emit dense 0-based ids, so identity mapping gives every
+    # user/item its own row — the reference's exact per-key MapStorage
+    # semantics, no hash collisions (ML-1M: 6040 users → 8192 slots).
     mk = functools.partial(SparseTable, mesh=mesh, updater=cfg.table.updater,
-                           lr=cfg.table.lr, init_scale=0.1)
-    return (mk(max(1 << 10, users), cfg.table.dim, seed=1, name="user"),
-            mk(max(1 << 11, items), cfg.table.dim, seed=2, name="item"))
+                           lr=cfg.table.lr, init_scale=0.1, identity=True)
+    return (mk(next_pow2(users, 1 << 10), cfg.table.dim, seed=1, name="user"),
+            mk(next_pow2(items, 1 << 11), cfg.table.dim, seed=2, name="item"))
 
 
 def run(cfg: Config, args, metrics) -> dict:

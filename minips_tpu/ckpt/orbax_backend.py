@@ -52,6 +52,11 @@ class OrbaxCheckpointer:
                 max_to_keep=keep if keep > 0 else None,
                 enable_async_checkpointing=async_save,
             ),
+            # registering the handlers up front makes item_metadata()
+            # usable before any save/restore — restore() prunes its
+            # template against the saved tree (legacy checkpoints)
+            item_handlers={"tables": ocp.StandardCheckpointHandler(),
+                           "clocks": ocp.JsonCheckpointHandler()},
         )
 
     # ------------------------------------------------------------------ save
@@ -85,6 +90,16 @@ class OrbaxCheckpointer:
         # sharding) instead of guessing the topology — restoring without a
         # target is the documented-unsafe path
         template = {n: t.state_dict() for n, t in self.tables.items()}
+        # prune template entries the checkpoint does not carry (e.g. the
+        # sparse 'layout' record added after a checkpoint was written):
+        # StandardRestore errors on template keys absent from storage, and
+        # load_state_dict owns the is-this-tolerable decision instead
+        try:
+            saved = self._mgr.item_metadata(step).tables
+            template = {n: {k: v for k, v in td.items() if k in saved[n]}
+                        for n, td in template.items()}
+        except (KeyError, TypeError, AttributeError):
+            pass  # metadata unavailable → restore with the full template
         state = self._mgr.restore(step, args=self._ocp.args.Composite(
             tables=self._ocp.args.StandardRestore(template),
             clocks=self._ocp.args.JsonRestore()))

@@ -171,11 +171,25 @@ def run_local_job(n: int, argv: list[str], *, base_port: int,
             f.flush()
             f.seek(0)
             text = f.read()
-            lines = [json.loads(ln) for ln in text.splitlines()
-                     if ln.strip().startswith("{")]
+            lines = []
+            last_brace_ok = True
+            for ln in text.splitlines():
+                if not ln.strip().startswith("{"):
+                    continue
+                try:  # tolerate non-JSON log lines that start with '{'
+                    lines.append(json.loads(ln))
+                    last_brace_ok = True
+                except json.JSONDecodeError:
+                    last_brace_ok = False
             if not lines:
                 raise RuntimeError(
                     f"worker produced no JSON output (rc={rc}):\n{text}")
+            if not last_brace_ok:
+                # the FINAL brace line is the result-dict protocol slot; if
+                # it is malformed, surfacing an earlier metrics line as the
+                # "result" would silently corrupt the harvest
+                raise RuntimeError(
+                    f"worker's final brace line is not JSON (rc={rc}):\n{text}")
             results.append(lines[-1])
     finally:
         for f in outs:

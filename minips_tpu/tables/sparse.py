@@ -38,13 +38,28 @@ from minips_tpu.parallel.mesh import DATA_AXIS
 _HASH_MULT = np.uint32(2654435761)  # Knuth multiplicative hash
 
 
-def hash_to_slots(keys: jnp.ndarray, num_slots: int, salt: int = 0) -> jnp.ndarray:
+def hash_to_slots(keys: jnp.ndarray, num_slots: int, salt: int = 0,
+                  identity: bool = False) -> jnp.ndarray:
     """Hash arbitrary int feature ids onto [0, num_slots). num_slots must be
-    a power of two (masked multiply-shift hash, cheap on VPU)."""
+    a power of two (masked multiply-shift hash, cheap on VPU).
+
+    ``identity=True`` skips the hash and maps key → key & (num_slots-1):
+    exact per-key rows (the reference's MapStorage gives every key its own
+    entry) for already-dense 0-based id spaces that fit the table, while the
+    mask keeps any stray key in range."""
     assert num_slots & (num_slots - 1) == 0, "num_slots must be a power of 2"
     k = keys.astype(jnp.uint32)
+    if identity:
+        return (k & jnp.uint32(num_slots - 1)).astype(jnp.int32)
     h = (k * _HASH_MULT) ^ (k >> 16) ^ jnp.uint32(salt)
     return (h & jnp.uint32(num_slots - 1)).astype(jnp.int32)
+
+
+def next_pow2(n: int, floor: int = 1) -> int:
+    """Smallest power of two ≥ max(n, floor) — SparseTable capacities must
+    be powers of two (masked hash above)."""
+    n = max(int(n), int(floor), 1)
+    return 1 << (n - 1).bit_length()
 
 
 class SparseTable:
@@ -62,6 +77,7 @@ class SparseTable:
         init_scale: float = 0.01,
         adagrad_init: float = 0.1,
         salt: int = 0,
+        identity: bool = False,
         seed: int = 0,
         dtype=jnp.float32,
         use_pallas: Optional[bool] = None,
@@ -77,6 +93,9 @@ class SparseTable:
         self.lr = lr
         self.adagrad_init = adagrad_init
         self.salt = salt
+        # exact per-key rows for dense 0-based id spaces (reference
+        # MapStorage semantics — no hash collisions); see hash_to_slots
+        self.identity = identity
 
         # Pallas gather opt-in, resolved ONCE here (the jitted pull is
         # trace-cached, so a late env toggle would be silently ignored).
@@ -147,7 +166,8 @@ class SparseTable:
 
     # ------------------------------------------------------------------ hash
     def slots_of(self, keys: jnp.ndarray) -> jnp.ndarray:
-        return hash_to_slots(jnp.asarray(keys), self.num_slots, self.salt)
+        return hash_to_slots(jnp.asarray(keys), self.num_slots, self.salt,
+                             self.identity)
 
     # ------------------------------------------------------------------ pull
     def pull(self, keys: jnp.ndarray) -> jnp.ndarray:
@@ -162,7 +182,7 @@ class SparseTable:
 
         @jax.jit
         def pull(emb, keys):
-            slots = hash_to_slots(keys, self.num_slots, self.salt)
+            slots = self.slots_of(keys)
             if (self.use_pallas
                     and pallas_kernels.gather_supported(self.dim, slots.size)):
                 # opt-in hand-scheduled DMA gather; XLA native is the
@@ -186,7 +206,7 @@ class SparseTable:
     def _jit_push(self):
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def push(emb, opt, keys, grads):
-            slots = hash_to_slots(keys, self.num_slots, self.salt)
+            slots = self.slots_of(keys)
             return self.row_update(emb, opt, slots, grads)
         return push
 
@@ -194,8 +214,16 @@ class SparseTable:
     _OPT_KEYS = {"adagrad": ("accum",), "adam": ("m", "v", "steps"),
                  "sgd": ()}
 
+    def _layout(self) -> list:
+        """[salt, identity] — salt normalized to 0 on the identity path,
+        where hash_to_slots never reads it."""
+        return [0 if self.identity else self.salt, int(self.identity)]
+
     def state_dict(self) -> dict:
-        out = {"emb": np.asarray(self.emb)}
+        out = {"emb": np.asarray(self.emb),
+               # key→slot layout: a checkpoint written under one layout is
+               # garbage under another (every row lands at a different slot)
+               "layout": np.asarray(self._layout(), np.int64)}
         for k in self._OPT_KEYS[self.updater]:
             out[k] = np.asarray(getattr(self, k))
         return out
@@ -208,6 +236,22 @@ class SparseTable:
                 f"checkpoint lacks sparse optimizer state {missing} for "
                 f"updater {self.updater!r} (written by a different "
                 "updater?)")
+        want = self._layout()
+        if "layout" in state:
+            got = np.asarray(state["layout"]).tolist()
+            if got != want:
+                raise ValueError(
+                    f"checkpoint key→slot layout [salt, identity]={got} "
+                    f"does not match this table's {want} — rows would "
+                    "restore to different slots")
+        elif self.identity or self.salt != 0:
+            # legacy checkpoints carry no layout record; only the default
+            # hashed layout (salt=0) can be assumed — anything else risks
+            # silently loading rows under a different key→slot mapping
+            raise ValueError(
+                "checkpoint predates layout metadata (default hashed "
+                f"layout) but this table uses {want} — cannot verify the "
+                "key→slot mapping matches")
         self.emb = jax.device_put(jnp.asarray(state["emb"]), self._sharding)
         for k in self._OPT_KEYS[self.updater]:
             cur = getattr(self, k)

@@ -32,7 +32,7 @@ from jax.sharding import PartitionSpec as P
 
 from minips_tpu.parallel.mesh import DATA_AXIS
 from minips_tpu.tables.dense import DenseTable, cast_floating
-from minips_tpu.tables.sparse import SparseTable, hash_to_slots
+from minips_tpu.tables.sparse import SparseTable
 
 PyTree = Any
 
@@ -132,8 +132,7 @@ class PSTrainStep:
             rows = {}
             for name, t in sparse.items():
                 keys = key_fns[name](batch)
-                slots[name] = hash_to_slots(jnp.asarray(keys), t.num_slots,
-                                            t.salt)
+                slots[name] = t.slots_of(keys)
                 rows[name] = state[name][0][slots[name]]
 
             if dense is not None:

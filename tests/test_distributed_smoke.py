@@ -159,3 +159,25 @@ def test_ssp_beats_bsp_under_transient_stalls():
     assert walls["ssp"] < walls["bsp"] * 0.92, (walls, skews)
     assert abs(finals["ssp"] - finals["bsp"]) < 0.05, finals
     assert skews["ssp"] <= 5  # s + 1 pre-gate
+
+
+def test_run_local_job_tolerates_non_json_brace_lines():
+    """ADVICE round 1: a log line that starts with '{' but is not JSON
+    (e.g. a dict repr) must be skipped, not crash the harvest loop."""
+    code = ("print({'pyrepr': 1}); "
+            "print('{not json either'); "
+            "import json; print(json.dumps({'ok': 1}))")
+    _PORT[0] += 2
+    res = launch.run_local_job(1, [sys.executable, "-c", code],
+                               base_port=_PORT[0], timeout=60)
+    assert res == [{"ok": 1}]
+
+    # but a malformed FINAL brace line must fail loudly, not silently
+    # surface an earlier metrics line as the result
+    _PORT[0] += 2
+    with pytest.raises(RuntimeError, match="final brace line"):
+        launch.run_local_job(
+            1, [sys.executable, "-c",
+                "import json; print(json.dumps({'metrics': 1})); "
+                "print({'result': 2})"],
+            base_port=_PORT[0], timeout=60)

@@ -186,3 +186,40 @@ def test_word2vec_threaded_async_push():
     losses = out["losses"]
     assert np.isfinite(losses).all()
     assert losses[-1] < 3.9, losses[-1]
+
+
+def test_mf_tables_handle_ml1m_shaped_ids(tmp_path):
+    """ADVICE round 1 (high): real MovieLens id counts are not powers of
+    two (ML-1M: 6040 users x 3706 items) — table sizing must round up and
+    the first pull must not trip the power-of-2 assert."""
+    from argparse import Namespace as NS
+
+    import jax.numpy as jnp
+
+    from minips_tpu.apps import mf_example as app
+    from minips_tpu.parallel.mesh import make_mesh
+
+    cfg = Config(
+        table=TableConfig(name="factors", kind="sparse", consistency="asp",
+                          updater="sgd", lr=0.05, dim=9),
+        train=TrainConfig(batch_size=256, num_iters=5, log_every=500),
+    )
+    user_t, item_t = app._make_tables(cfg, make_mesh(), users=6040,
+                                      items=3706)
+    assert user_t.num_slots == 8192 and item_t.num_slots == 4096
+    # identity mapping: distinct dense ids -> distinct rows (no collisions)
+    assert len(np.unique(np.asarray(
+        user_t.slots_of(jnp.arange(6040))))) == 6040
+    user_t.pull(jnp.array([6039]))  # the crash reported by the advisor
+
+    # end-to-end on a tiny ML-1M-shaped file (sparse ids near the maxima)
+    rng = np.random.default_rng(2)
+    u = np.concatenate([rng.integers(0, 6040, size=1500), [6039]])
+    i = np.concatenate([rng.integers(0, 3706, size=1500), [3705]])
+    r = np.clip(3.0 + rng.normal(scale=0.5, size=u.size), 0.5, 5.0)
+    p = tmp_path / "ratings.dat"
+    p.write_text("\n".join(f"{a + 1}::{b + 1}::{c:.2f}::0"
+                           for a, b, c in zip(u, i, r)))
+    out = app.run(cfg, NS(data_file=str(p)),
+                  MetricsLogger(None, verbose=False))
+    assert np.isfinite(out["losses"]).all()

@@ -213,3 +213,28 @@ def test_sparse_updater_mismatch_rejected(mesh8, tmp_path):
     t_adam = SparseTable(64, 2, mesh8, updater="adam")
     with pytest.raises(ValueError, match="different"):
         Checkpointer(str(tmp_path), {"s": t_adam}).restore()
+
+
+def test_next_pow2():
+    from minips_tpu.tables.sparse import next_pow2
+
+    assert next_pow2(1) == 1
+    assert next_pow2(1024) == 1024
+    assert next_pow2(1025) == 2048
+    assert next_pow2(6040) == 8192
+    assert next_pow2(3706) == 4096
+    assert next_pow2(3, floor=1 << 10) == 1024
+
+
+def test_identity_mapping_exact_rows(mesh8):
+    """identity=True: dense 0-based ids get their own row — exact per-key
+    MapStorage semantics, no collisions (ADVICE round 1)."""
+    t = SparseTable(128, 4, mesh8, updater="sgd", lr=1.0, init_scale=0.0,
+                    identity=True)
+    keys = jnp.arange(128)
+    slots = np.asarray(t.slots_of(keys))
+    np.testing.assert_array_equal(slots, np.arange(128))  # no collisions
+    t.push(jnp.array([5]), jnp.ones((1, 4)))
+    emb = np.asarray(t.emb)
+    np.testing.assert_allclose(emb[5], -1.0)
+    assert np.all(emb[np.arange(128) != 5] == 0.0)  # only row 5 touched
