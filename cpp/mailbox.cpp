@@ -45,6 +45,7 @@ struct Msg {
   std::string msg;
   std::vector<uint8_t> blob;
   bool has_blob = false;
+  int dest = -1;  // outgoing-peer index (connect order); -1 = broadcast
 };
 
 // The reference's ThreadsafeQueue<Message>: mutex + condvar inbox.
@@ -294,7 +295,17 @@ class Mailbox {
                              ? static_cast<int64_t>(m.blob.size())
                              : -1;
       std::lock_guard<std::mutex> g(peers_mu_);
-      for (int& fd : peer_fds_) {
+      // Directed frames (dest >= 0, connect-order index) hit one socket;
+      // broadcasts fan out. FIFO through the shared outbox preserves the
+      // per-peer ordering contract across send() and publish().
+      size_t lo = 0, hi = peer_fds_.size();
+      if (m.dest >= 0) {
+        if (static_cast<size_t>(m.dest) >= peer_fds_.size()) continue;
+        lo = static_cast<size_t>(m.dest);
+        hi = lo + 1;
+      }
+      for (size_t i = lo; i < hi; ++i) {
+        int& fd = peer_fds_[i];
         if (fd < 0) continue;
         bool ok = write_all(fd, header, sizeof(header)) &&
                   write_all(fd, &blob_len, sizeof(blob_len)) &&
@@ -347,6 +358,19 @@ int mailbox_connect(void* h, const char* host, int port, int timeout_ms) {
 void mailbox_publish(void* h, const char* msg, int64_t msg_len,
                      const uint8_t* blob, int64_t blob_len) {
   Msg m;
+  m.msg.assign(msg, static_cast<size_t>(msg_len));
+  if (blob_len >= 0) {
+    m.has_blob = true;
+    m.blob.assign(blob, blob + blob_len);
+  }
+  static_cast<Mailbox*>(h)->Publish(std::move(m));
+}
+
+// Directed variant: peer_index is the order Connect() was called in.
+void mailbox_send(void* h, int peer_index, const char* msg, int64_t msg_len,
+                  const uint8_t* blob, int64_t blob_len) {
+  Msg m;
+  m.dest = peer_index;
   m.msg.assign(msg, static_cast<size_t>(msg_len));
   if (blob_len >= 0) {
     m.has_blob = true;

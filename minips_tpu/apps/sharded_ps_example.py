@@ -1,0 +1,182 @@
+"""Sharded multi-process PS — the distributed smoke workload for the
+key-range-sharded server (train/sharded_ps.py).
+
+Unlike ssp_lr_example (replicated delta relay), every process here owns a
+contiguous ROW RANGE of each table (the reference's server-per-node
+topology, SURVEY.md §1 L2): pushes route per-owner key slices point-to-
+point, the owner applies the SGD/Adagrad updater server-side, and pulls
+fetch rows from owners. Consistency (BSP/SSP/ASP + staleness gate) is
+unchanged.
+
+Two models:
+- ``--model dense``: logistic regression on dense features; the weight
+  vector is a dim-1-per-row table pulled whole (range fast path).
+- ``--model sparse``: RCV1-shaped sparse LR — the per-key PS workload;
+  only the batch's touched rows ride the wire (the W&D/Criteo pattern,
+  SURVEY.md §7.4.2).
+
+Run under the launcher:
+    python -m minips_tpu.launch --n 3 -- \
+        python -m minips_tpu.apps.sharded_ps_example --iters 40 --mode ssp
+
+Each rank prints ONE JSON line (smoke/bench protocol) with loss, wire and
+memory accounting, gate stats, and post-finalize parameter fingerprints the
+test asserts replica agreement on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=["dense", "sparse"], default="dense")
+    ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--dim", type=int, default=64,
+                    help="dense: feature dim; sparse: key-space size")
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--updater", choices=["sgd", "adagrad"], default="sgd")
+    ap.add_argument("--mode", choices=["bsp", "ssp", "asp"], default="ssp")
+    ap.add_argument("--staleness", type=int, default=2)
+    ap.add_argument("--slow-rank", type=int, default=-1)
+    ap.add_argument("--slow-ms", type=float, default=0.0)
+    ap.add_argument("--kill-at", type=int, default=0)
+    ap.add_argument("--kill-rank", type=int, default=-1)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if os.environ.get("MINIPS_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from minips_tpu.comm.heartbeat import HeartbeatMonitor
+    from minips_tpu.data import synthetic
+    from minips_tpu.launch import init_from_env
+    from minips_tpu.models import lr as lr_model
+    from minips_tpu.train.sharded_ps import (PeerFailureError, ShardedTable,
+                                             ShardedPSTrainer)
+
+    rank, nprocs, bus = init_from_env()
+    if bus is None:
+        print(json.dumps({"rank": 0, "event": "error",
+                          "err": "sharded PS needs the launcher (n >= 2)"}),
+              flush=True)
+        return 2
+    staleness = {"bsp": 0, "ssp": args.staleness,
+                 "asp": float("inf")}[args.mode]
+    monitor = HeartbeatMonitor(bus, peer_ids=list(range(nprocs)),
+                               interval=0.2, timeout=2.0).start()
+
+    sparse = args.model == "sparse"
+    if sparse:
+        num_rows = args.dim if args.dim > 64 else 1 << 14
+        data = synthetic.classification_sparse(
+            n=args.batch * 8, dim=num_rows, seed=100 + rank)
+    else:
+        num_rows = args.dim + 1  # weights + bias row
+        data = synthetic.classification_dense(
+            n=args.batch * 8, dim=args.dim, seed=100 + rank)
+
+    table = ShardedTable("w", num_rows, 1, bus, rank, nprocs,
+                         updater=args.updater, lr=args.lr,
+                         monitor=monitor, pull_timeout=20.0)
+    trainer = ShardedPSTrainer({"w": table}, bus, nprocs,
+                               staleness=staleness, gate_timeout=30.0,
+                               monitor=monitor)
+    bus.handshake(nprocs)  # after ALL handlers are registered
+
+    if sparse:
+        @jax.jit
+        def grads_sparse(w_rows, batch):
+            def f(rows):
+                return lr_model.loss_sparse(rows, batch)
+            loss, g = jax.value_and_grad(f)(w_rows)
+            return loss, g
+    else:
+        @jax.jit
+        def grads_dense(vec, batch):
+            def f(v):
+                params = {"w": v[:-1, 0], "b": v[-1, 0]}
+                return lr_model.loss_dense(params, batch)
+            loss, g = jax.value_and_grad(f)(vec)
+            return loss, g
+
+    losses = []
+    rng = np.random.default_rng(rank)
+    code = 0
+    t0 = time.monotonic()
+    try:
+        for i in range(args.iters):
+            if args.kill_at and rank == args.kill_rank and i == args.kill_at:
+                os._exit(137)
+            sel = rng.integers(0, data["y"].shape[0], size=args.batch)
+            if sparse:
+                batch = {k: jnp.asarray(data[k][sel])
+                         for k in ("val", "mask", "y")}
+                keys = data["idx"][sel].reshape(-1)
+                rows = table.pull(keys).reshape(args.batch, -1, 1)
+                loss, g = grads_sparse(jnp.asarray(rows), batch)
+                # scale 1/nprocs: N workers push per clock; keeps the
+                # effective per-clock step comparable across world sizes
+                table.push(keys, np.asarray(g).reshape(-1, 1) / nprocs)
+            else:
+                batch = {"x": jnp.asarray(data["x"][sel]),
+                         "y": jnp.asarray(data["y"][sel])}
+                vec = table.pull_all()
+                loss, g = grads_dense(jnp.asarray(vec), batch)
+                table.push_dense(np.asarray(g) / nprocs)
+            losses.append(float(loss))
+            trainer.tick()
+            if rank == args.slow_rank and args.slow_ms > 0:
+                time.sleep(args.slow_ms / 1000.0)
+        trainer.finalize(timeout=20.0)
+        # inside the try: a peer that already printed and closed its bus
+        # can look heartbeat-dead while we assemble — that must surface as
+        # the structured peer_failure/gate_timeout event, not a traceback
+        final = table.pull_all()
+        # finalize quiesced pushes only; peers' pull_alls still need my
+        # server — rendezvous before anyone closes
+        trainer.shutdown_barrier(timeout=10.0)
+    except PeerFailureError as e:
+        print(json.dumps({"rank": rank, "event": "peer_failure",
+                          "dead": sorted(e.dead),
+                          "at_clock": trainer.clock}), flush=True)
+        code = 42
+    except TimeoutError as e:
+        print(json.dumps({"rank": rank, "event": "gate_timeout",
+                          "err": str(e)}), flush=True)
+        code = 43
+
+    if code == 0:
+        table_bytes = final.nbytes * (2 if args.updater == "adagrad" else 1)
+        print(json.dumps({
+            "rank": rank, "event": "done",
+            "wall_s": round(time.monotonic() - t0, 4),
+            "loss_first": losses[0] if losses else None,
+            "loss_last": float(np.mean(losses[-5:])) if losses else None,
+            "gate_waits": trainer.gate_waits,
+            "max_skew_seen": trainer.max_skew_seen,
+            "bytes_pushed": trainer.bytes_pushed,
+            "bytes_pulled": trainer.bytes_pulled,
+            "local_bytes": trainer.local_bytes(),
+            "table_bytes": int(table_bytes),
+            "param_sum": float(final.sum()),
+            "param_norm": float(np.linalg.norm(final)),
+            "clock": trainer.clock,
+        }), flush=True)
+
+    monitor.stop()
+    bus.close()
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

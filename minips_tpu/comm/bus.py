@@ -29,20 +29,28 @@ except ImportError:  # pragma: no cover - zmq is present in the target env
 
 class ControlBus:
     """PUB/SUB gossip bus: ``publish(kind, payload)`` fans out to all peers;
-    handlers registered per kind run on a background receive thread."""
+    ``send(dest, ...)`` delivers to ONE peer (zmq topic-prefix subscription,
+    filtered at the publisher for TCP transports — directed traffic does not
+    ride every link). Handlers registered per kind run on a background
+    receive thread."""
 
     def __init__(self, my_addr: str, peer_addrs: list[str],
                  my_id: int = 0):
         if not _HAS_ZMQ:
             raise RuntimeError("pyzmq not available")
         self.my_id = my_id
+        self.bytes_sent = 0  # wire accounting (sharded-PS slice assertions)
+        self._n_world = len(peer_addrs) + 1
         self._ctx = zmq.Context.instance()
         self._pub = self._ctx.socket(zmq.PUB)
         self._pub.bind(my_addr)
         self._sub = self._ctx.socket(zmq.SUB)
         for addr in peer_addrs:
             self._sub.connect(addr)
-        self._sub.setsockopt_string(zmq.SUBSCRIBE, "")
+        # Two topics reach me: broadcast "b|" and my directed "d<id>|".
+        # The trailing delimiter keeps "d1|" from prefix-matching "d12|".
+        self._sub.setsockopt(zmq.SUBSCRIBE, b"b|")
+        self._sub.setsockopt(zmq.SUBSCRIBE, f"d{my_id}|".encode())
         self._handlers: dict[str, Callable[[int, dict], None]] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -62,17 +70,34 @@ class ControlBus:
     def publish(self, kind: str, payload: dict,
                 blob: Optional[bytes] = None) -> None:
         """Fan out ``payload`` (small JSON) with an optional binary ``blob``
-        second frame (e.g. a packed ndarray of parameter deltas). Receivers
-        find the blob at ``payload["__blob__"]``. JSON stays the control
-        format (reference BinStream's role, SURVEY.md §2); the blob frame
-        exists so host-relayed pushes need no base64 inflation."""
+        frame (e.g. a packed ndarray of parameter deltas). Receivers find
+        the blob at ``payload["__blob__"]``. JSON stays the control format
+        (reference BinStream's role, SURVEY.md §2); the blob frame exists so
+        host-relayed pushes need no base64 inflation."""
+        self._emit(b"b|", kind, payload, blob)
+
+    def send(self, dest: int, kind: str, payload: dict,
+             blob: Optional[bytes] = None) -> None:
+        """Deliver to ONE peer — the reference Mailbox's per-thread-id
+        addressing (SURVEY.md §2.3), here a topic only ``dest`` subscribes
+        to. Per-(publisher → subscriber) frame order still holds across
+        publish() and send() on this bus: one PUB socket, one connection."""
+        # validate like the native backend: a typo'd dest would otherwise
+        # publish to a topic nobody subscribes and vanish silently
+        if dest == self.my_id:
+            raise ValueError("directed send to self (serve locally instead)")
+        if not 0 <= dest < self._n_world:
+            raise ValueError(f"dest rank {dest} out of range")
+        self._emit(f"d{dest}|".encode(), kind, payload, blob)
+
+    def _emit(self, topic: bytes, kind: str, payload: dict,
+              blob: Optional[bytes]) -> None:
         msg = json.dumps({"kind": kind, "sender": self.my_id,
-                          "payload": payload})
+                          "payload": payload}).encode()
+        frames = [topic, msg] if blob is None else [topic, msg, blob]
         with self._pub_lock:
-            if blob is None:
-                self._pub.send_string(msg)
-            else:
-                self._pub.send_multipart([msg.encode(), blob])
+            self._pub.send_multipart(frames)
+            self.bytes_sent += len(msg) + (len(blob) if blob else 0)
 
     def _recv_loop(self) -> None:
         poller = zmq.Poller()
@@ -84,8 +109,10 @@ class ControlBus:
                 frames = self._sub.recv_multipart(zmq.NOBLOCK)
             except zmq.ZMQError:
                 continue
-            dispatch_message(self._handlers, frames[0],
-                             frames[1] if len(frames) > 1 else None)
+            if len(frames) < 2:
+                continue  # topic-only frame: malformed
+            dispatch_message(self._handlers, frames[1],
+                             frames[2] if len(frames) > 2 else None)
 
     def handshake(self, num_processes: int, timeout: float = 15.0) -> None:
         """Rendezvous before real traffic: PUB/SUB drops messages published
@@ -226,7 +253,17 @@ class ClockGossip:
                         for p in range(num_processes)}
         self._cond = threading.Condition()
         self._excluded: set[int] = set()
+        self._listeners: list = []  # called (no locks held) on any change
         bus.on("clock", self._on_clock)
+
+    def add_listener(self, fn) -> None:
+        """``fn()`` runs after every clock/exclusion change — the server-
+        side pending-buffer's re-admission hook (parked pulls re-check)."""
+        self._listeners.append(fn)
+
+    def _notify_listeners(self) -> None:
+        for fn in self._listeners:
+            fn()
 
     def _on_clock(self, sender: int, payload: dict) -> None:
         with self._cond:
@@ -234,12 +271,14 @@ class ClockGossip:
                 return  # stray sender (stale run / port reuse): no ghosts
             self._clocks[sender] = list(payload.get("clocks", []))
             self._cond.notify_all()
+        self._notify_listeners()
 
     def publish_local(self, clocks: list[int]) -> None:
         with self._cond:
             self._clocks[self.bus.my_id] = list(clocks)
             self._cond.notify_all()
         self.bus.publish("clock", {"clocks": list(clocks)})
+        self._notify_listeners()
 
     def exclude(self, process_id: int) -> None:
         """Drop a dead peer from min-clock computation (failure handling,
@@ -247,6 +286,7 @@ class ClockGossip:
         with self._cond:
             self._excluded.add(process_id)
             self._cond.notify_all()
+        self._notify_listeners()
 
     def _min_locked(self) -> int:
         vals = [min(v) for p, v in self._clocks.items()

@@ -37,6 +37,10 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
         ctypes.c_char_p, ctypes.c_int64]
     lib.mailbox_publish.restype = None
+    lib.mailbox_send.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_int64]
+    lib.mailbox_send.restype = None
     lib.mailbox_recv.argtypes = [
         ctypes.c_void_p, ctypes.c_int,
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
@@ -80,6 +84,7 @@ class NativeControlBus:
         if lib is None:
             raise RuntimeError("native mailbox library unavailable")
         self.my_id = my_id
+        self.bytes_sent = 0
         self._lib = lib
         _, port = _parse_addr(my_addr)
         self._h = lib.mailbox_create(port)
@@ -132,6 +137,23 @@ class NativeControlBus:
         """Nonblocking: enqueues onto the C++ Sender actor's queue.
         A publish after close() is a silent no-op (matches zmq's at-worst-
         an-error behavior rather than a use-after-free)."""
+        self._emit(-1, kind, payload, blob)
+
+    def send(self, dest: int, kind: str, payload: dict,
+             blob: Optional[bytes] = None) -> None:
+        """Directed delivery to peer rank ``dest`` over its one TCP link.
+        Assumes ``peer_addrs`` was built in ascending-rank order minus my
+        own entry (what launch.init_from_env produces) so the connect-order
+        index is recoverable from the rank."""
+        if dest == self.my_id:
+            raise ValueError("directed send to self (serve locally instead)")
+        idx = dest if dest < self.my_id else dest - 1
+        if not 0 <= idx < len(self._peer_addrs):
+            raise ValueError(f"dest rank {dest} out of range")
+        self._emit(idx, kind, payload, blob)
+
+    def _emit(self, peer_index: int, kind: str, payload: dict,
+              blob: Optional[bytes]) -> None:
         msg = json.dumps({"kind": kind, "sender": self.my_id,
                           "payload": payload}).encode()
         if len(msg) > self.MAX_MSG:
@@ -143,11 +165,14 @@ class NativeControlBus:
         with self._h_lock:
             if self._closed:
                 return
-            if blob is None:
-                self._lib.mailbox_publish(self._h, msg, len(msg), None, -1)
+            data = None if blob is None else bytes(blob)
+            blen = -1 if blob is None else len(blob)
+            if peer_index < 0:
+                self._lib.mailbox_publish(self._h, msg, len(msg), data, blen)
             else:
-                self._lib.mailbox_publish(self._h, msg, len(msg),
-                                          bytes(blob), len(blob))
+                self._lib.mailbox_send(self._h, peer_index, msg, len(msg),
+                                       data, blen)
+            self.bytes_sent += len(msg) + (blen if blen > 0 else 0)
 
     def _recv_loop(self) -> None:
         msg_p = ctypes.c_char_p()

@@ -1,0 +1,66 @@
+"""StalenessGate — the multi-process BSP/SSP/ASP admission rule.
+
+One gate object per process wraps ClockGossip with the unified admission
+rule the reference's consistency models implement server-side (SURVEY.md §2
+BSP/SSP/ASPModel): before running step ``c+1`` a process blocks until
+``global_min_clock >= c + 1 - staleness`` (0 = BSP lockstep, s = SSP
+bounded staleness, inf = ASP never waits). Shared by SSPTrainer (replicated
+delta relay) and ShardedPSTrainer (key-range-sharded PS) so the distinctive
+consistency axis has exactly one implementation.
+
+A timed-out wait consults the heartbeat monitor: dead peers raise
+PeerFailureError (recovery cue, SURVEY.md §5.3) instead of hanging the gate
+forever on a corpse.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class PeerFailureError(RuntimeError):
+    """Raised when the staleness gate times out and heartbeats show dead
+    peers — the caller's cue to run recovery (SURVEY.md §5.3)."""
+
+    def __init__(self, dead: set[int]):
+        super().__init__(f"peer process(es) {sorted(dead)} failed")
+        self.dead = dead
+
+
+class StalenessGate:
+    def __init__(self, gossip, staleness: float, *,
+                 timeout: float = 60.0, monitor=None):
+        if staleness < 0:
+            raise ValueError("staleness must be >= 0")
+        self.gossip = gossip
+        self.staleness = staleness
+        self.timeout = timeout
+        self.monitor = monitor
+        self.gate_waits = 0      # times the gate actually blocked
+        self.max_skew_seen = 0   # max (my_clock - global_min) observed
+
+    def wait(self, clock: int) -> None:
+        """Block until global_min >= clock - staleness (the SSP rule)."""
+        if self.staleness == float("inf"):
+            return
+        threshold = clock - int(self.staleness)
+        if threshold <= 0:
+            return
+        gmin = self.gossip.global_min()
+        self.max_skew_seen = max(self.max_skew_seen, clock - gmin)
+        if gmin >= threshold:
+            return
+        self.gate_waits += 1
+        deadline = time.monotonic() + self.timeout
+        while not self.gossip.wait_global_min(
+                threshold, timeout=min(1.0, self.timeout)):
+            dead = self.monitor.check() if self.monitor is not None else set()
+            if dead:
+                for p in dead:
+                    self.gossip.exclude(p)
+                raise PeerFailureError(dead)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"SSP gate timed out at clock {clock} "
+                    f"(global_min={self.gossip.global_min()}, "
+                    f"staleness={self.staleness})")

@@ -1,0 +1,562 @@
+"""Key-range-sharded multi-process parameter server.
+
+This is the reference's *actual* server topology (SURVEY.md §1 L2, §2
+SimpleRangeManager/ServerThread/KVTable rows): every process hosts a server
+shard owning a contiguous row range of each table, and worker pushes/pulls
+route **per-owner key slices** over the bus — point-to-point directed
+frames, not full-model broadcasts. This replaces the replicated delta relay
+(train/ssp_trainer.py) for workloads whose tables don't fit one host:
+
+- per-process table memory is ``~1/N`` of the table (plus optimizer state,
+  sharded identically — PS state *is* optimizer state);
+- wire traffic per push is the touched rows, split by owner (the sparse
+  Criteo/W&D case ships only the batch's embedding rows, SURVEY.md §7.4.2);
+- the server applies the updater (SGD/Adagrad, reference ``updater->
+  Update(keys, grads)`` semantics with duplicate keys summed first) on
+  receipt, exactly the reference's server-side optimizer;
+- consistency is the same StalenessGate + ClockGossip as the delta relay —
+  BSP/SSP/ASP admission is unchanged (consistency/gate.py).
+
+Why the SSP contract holds — admission happens AT THE OWNER, like the
+reference's server-side ``model->Get`` (SURVEY.md §3.3): every pull request
+carries the requester's clock ``c``; the owner serves it only once *its
+own* view of the global min clock reaches ``c − s``, otherwise the request
+is **parked** (the reference's PendingBuffer) and re-checked on every clock
+message. Every bus backend preserves per-(sender → receiver) frame order,
+and a worker pushes its step-``k`` slices *before* publishing clock ``k`` —
+so when the owner's view says peer P reached ``c − s``, P's pushes through
+``c − s`` have already been applied to the owner's shard. An admitted pull
+therefore reads state containing every peer's updates up to ``c − s``, the
+SSP contract, enforced per-owner (client-side gating alone could not
+promise this: the pusher→owner link and the pusher→reader clock broadcast
+are different links).
+
+Numerics: the server-side numpy updaters match ops/sparse_update.py's
+row_sgd/row_adagrad (sum-duplicates-then-update) bit-for-bit at f32 — the
+parity tests in tests/test_sharded_ps.py assert it against those oracles.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from minips_tpu.comm.bus import ClockGossip
+from minips_tpu.consistency.gate import PeerFailureError, StalenessGate
+from minips_tpu.parallel.partition import RangePartitioner
+
+__all__ = ["ShardedTable", "ShardedPSTrainer", "PeerFailureError"]
+
+
+class ShardedTable:
+    """One table: my server shard (owned contiguous row range) + the client
+    router splitting pulls/pushes by owner (reference KVClientTable +
+    ServerThread + RangeManager collapsed into one object per process).
+
+    ``dim=1`` rows model the reference's dense ``VectorStorage`` (each key a
+    scalar parameter); larger ``dim`` is the embedding-table case
+    (``MapStorage`` → fixed rows). Dense whole-vector traffic uses the
+    range fast path (``pull_all``/``push_range``) with no key lists on the
+    wire.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        num_rows: int,
+        dim: int,
+        bus,
+        rank: int,
+        num_processes: int,
+        *,
+        updater: str = "sgd",
+        lr: float = 0.05,
+        adagrad_init: float = 0.1,
+        eps: float = 1e-10,
+        init_scale: float = 0.0,
+        seed: int = 0,
+        pull_timeout: float = 30.0,
+        monitor=None,
+    ):
+        if updater not in ("sgd", "adagrad"):
+            raise ValueError("sharded-PS updater must be 'sgd' or 'adagrad'")
+        self.name = name
+        self.num_rows = int(num_rows)
+        self.dim = int(dim)
+        self.bus = bus
+        self.rank = rank
+        self.num_processes = num_processes
+        self.updater = updater
+        self.lr = lr
+        self.eps = eps
+        self.pull_timeout = pull_timeout
+        self.monitor = monitor
+        self.part = RangePartitioner(self.num_rows, num_processes)
+        self.shard_lo = rank * self.part.shard_size
+        # ---- server shard: ONLY my row range lives here (the 1/N memory
+        # claim); padding rows in the last shard are allocated but unused
+        rng = np.random.default_rng(seed)  # same stream every process...
+        full_like = rng.normal(scale=init_scale, size=(
+            self.part.padded, self.dim)) if init_scale else None
+        self._w = (np.zeros((self.part.shard_size, self.dim), np.float32)
+                   if full_like is None else
+                   full_like[self.shard_lo:self.shard_lo
+                             + self.part.shard_size].astype(np.float32))
+        # ...so shard init equals the slice of one global init (replica-
+        # independent); only the shard is RETAINED (full_like is transient)
+        self._acc = (np.full((self.part.shard_size, self.dim),
+                             adagrad_init, np.float32)
+                     if updater == "adagrad" else None)
+        self._state_lock = threading.Lock()
+        # ---- server-side admission (bound by ShardedPSTrainer): parked
+        # pull requests waiting for the staleness rule — the reference's
+        # PendingBuffer (SURVEY.md §2 ProgressTracker/PendingBuffer row)
+        self._cons = None  # object with admit_pull(clk) + clock
+        self._parked: list[tuple] = []  # (sender, req, keys|None, clk)
+        self._park_lock = threading.Lock()
+        # ---- client plumbing
+        self._req = 0
+        self._req_lock = threading.Lock()
+        self._replies: dict[int, dict[int, np.ndarray]] = {}
+        self._reply_cond = threading.Condition()
+        self.bytes_pushed = 0
+        self.bytes_pulled = 0
+        self.rows_pushed = 0
+        if bus is not None:
+            bus.on(f"psP:{name}", self._on_push)
+            bus.on(f"psR:{name}", self._on_push_range)
+            bus.on(f"psG:{name}", self._on_pull)
+            bus.on(f"psA:{name}", self._on_pull_all)
+            bus.on(f"psr:{name}", self._on_pull_reply)
+
+    # --------------------------------------------------------- server side
+    def _apply_rows(self, offs: np.ndarray, grads: np.ndarray) -> None:
+        """Reference ``updater->Update``: sum duplicate keys, then one
+        update per touched row (ops/sparse_update.py semantics)."""
+        grads = grads.reshape(offs.size, self.dim)
+        with self._state_lock:
+            uniq, inv = np.unique(offs, return_inverse=True)
+            g = np.zeros((uniq.size, self.dim), np.float32)
+            np.add.at(g, inv, grads)
+            if self.updater == "sgd":
+                self._w[uniq] -= self.lr * g
+            else:  # adagrad: accum += g², step by rsqrt of NEW accum
+                self._acc[uniq] += g * g
+                self._w[uniq] -= self.lr * g / (
+                    np.sqrt(self._acc[uniq]) + self.eps)
+
+    def _apply_range(self, lo_local: int, grads: np.ndarray) -> None:
+        grads = grads.reshape(-1, self.dim)
+        sl = slice(lo_local, lo_local + grads.shape[0])
+        with self._state_lock:
+            if self.updater == "sgd":
+                self._w[sl] -= self.lr * grads
+            else:
+                self._acc[sl] += grads * grads
+                self._w[sl] -= self.lr * grads / (
+                    np.sqrt(self._acc[sl]) + self.eps)
+
+    def _on_push(self, sender: int, payload: dict) -> None:
+        blob = payload.get("__blob__")
+        n = int(payload.get("n", 0))
+        if blob is None or len(blob) != n * (8 + 4 * self.dim):
+            return  # malformed frame from a stale run; drop
+        keys = np.frombuffer(blob[: 8 * n], np.int64)
+        offs = keys - self.shard_lo
+        if n and (offs.min() < 0 or offs.max() >= self.part.shard_size):
+            return  # mis-routed; drop
+        grads = np.frombuffer(blob[8 * n:], np.float32)
+        self._apply_rows(offs, grads)  # read-only view is fine: never written
+
+    def _on_push_range(self, sender: int, payload: dict) -> None:
+        blob = payload.get("__blob__")
+        lo = int(payload.get("lo", -1))
+        if blob is None:
+            return
+        grads = np.frombuffer(blob, np.float32)
+        if grads.size % self.dim:
+            return
+        k = grads.size // self.dim
+        lo_local = lo - self.shard_lo
+        if lo_local < 0 or lo_local + k > self.part.shard_size:
+            return
+        self._apply_range(lo_local, grads)
+
+    def _on_pull(self, sender: int, payload: dict) -> None:
+        blob = payload.get("__blob__")
+        req = int(payload.get("req", -1))
+        if blob is None:
+            return
+        keys = np.frombuffer(blob, np.int64)
+        offs = keys - self.shard_lo
+        if keys.size and (offs.min() < 0
+                          or offs.max() >= self.part.shard_size):
+            return
+        clk = int(payload.get("clk", 0))
+        if self._cons is not None and not self._cons.admit_pull(clk):
+            with self._park_lock:  # reference PendingBuffer: park the Get
+                self._parked.append((sender, req, keys, clk))
+            # re-check: a clock change between the admission test and the
+            # append would have drained an empty buffer and never retried
+            if self._cons.admit_pull(clk):
+                self.serve_parked()
+            return
+        self._serve_pull(sender, req, keys)
+
+    def _serve_pull(self, sender: int, req: int, keys: np.ndarray) -> None:
+        offs = keys - self.shard_lo
+        with self._state_lock:
+            rows = self._w[offs]  # fancy indexing: already a fresh array
+        self.bus.send(sender, f"psr:{self.name}", {"req": req},
+                      blob=rows.tobytes())
+
+    def _on_pull_all(self, sender: int, payload: dict) -> None:
+        req = int(payload.get("req", -1))
+        clk = int(payload.get("clk", 0))
+        if self._cons is not None and not self._cons.admit_pull(clk):
+            with self._park_lock:
+                self._parked.append((sender, req, None, clk))
+            if self._cons.admit_pull(clk):  # same park/drain race as above
+                self.serve_parked()
+            return
+        self._serve_pull_all(sender, req)
+
+    def _serve_pull_all(self, sender: int, req: int) -> None:
+        with self._state_lock:
+            rows = self._w.copy()  # full shard: copy out of the lock
+        self.bus.send(sender, f"psr:{self.name}",
+                      {"req": req, "lo": self.shard_lo},
+                      blob=rows.tobytes())
+
+    def serve_parked(self) -> None:
+        """Re-check parked pulls against the admission rule — called by the
+        trainer on every clock/exclusion change (the PendingBuffer drain,
+        reference ``Clock → may unpark others' Gets``, SURVEY.md §3.3)."""
+        if self._cons is None:
+            return
+        # admission is evaluated ONCE per entry: global_min advances
+        # concurrently, and a flip between two evaluations must not let an
+        # entry fall between "not ready" and "not kept"
+        with self._park_lock:
+            ready, still = [], []
+            for p in self._parked:
+                (ready if self._cons.admit_pull(p[3]) else still).append(p)
+            self._parked = still
+        for sender, req, keys, _clk in ready:
+            if keys is None:
+                self._serve_pull_all(sender, req)
+            else:
+                self._serve_pull(sender, req, keys)
+
+    def _on_pull_reply(self, sender: int, payload: dict) -> None:
+        blob = payload.get("__blob__")
+        req = int(payload.get("req", -1))
+        if blob is None:
+            return
+        rows = np.frombuffer(blob, np.float32).reshape(-1, self.dim)
+        with self._reply_cond:
+            if req in self._replies:
+                self._replies[req][sender] = rows
+                self._reply_cond.notify_all()
+
+    # --------------------------------------------------------- client side
+    def bind_consistency(self, cons) -> None:
+        """Attach the trainer's admission rule (server-side SSP gate)."""
+        self._cons = cons
+
+    def _my_clk(self) -> int:
+        return self._cons.clock if self._cons is not None else 0
+
+    def _next_req(self) -> int:
+        with self._req_lock:
+            self._req += 1
+            return self._req
+
+    def _await_replies(self, req: int, owners: set[int]) -> dict:
+        deadline = time.monotonic() + self.pull_timeout
+        with self._reply_cond:
+            while set(self._replies[req]) < owners:
+                self._reply_cond.wait(timeout=0.5)
+                if set(self._replies[req]) >= owners:
+                    break
+                dead = (self.monitor.check()
+                        if self.monitor is not None else set())
+                if dead & owners:
+                    self._replies.pop(req, None)
+                    raise PeerFailureError(dead & owners)
+                if time.monotonic() > deadline:
+                    missing = sorted(owners - set(self._replies[req]))
+                    self._replies.pop(req, None)
+                    raise TimeoutError(
+                        f"pull({self.name}): owners {missing} never "
+                        "replied")
+            return self._replies.pop(req)
+
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        """Gather rows for global ``keys`` from their owners —
+        KVClientTable::Pull with RangeManager routing (SURVEY.md §3.3)."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        owners = self.part.shard_of(keys)
+        out = np.empty((keys.size, self.dim), np.float32)
+        req = self._next_req()
+        remote: list[tuple[int, np.ndarray]] = []
+        with self._reply_cond:
+            self._replies[req] = {}
+        for o in range(self.num_processes):
+            mask = owners == o
+            if not mask.any():
+                continue
+            if o == self.rank:
+                offs = keys[mask] - self.shard_lo
+                with self._state_lock:
+                    out[mask] = self._w[offs]
+                continue
+            kslice = keys[mask]
+            self.bus.send(o, f"psG:{self.name}",
+                          {"req": req, "clk": self._my_clk()},
+                          blob=kslice.tobytes())
+            self.bytes_pulled += kslice.nbytes
+            remote.append((o, mask))
+        if remote:
+            got = self._await_replies(req, {o for o, _ in remote})
+            for o, mask in remote:
+                out[mask] = got[o]
+                self.bytes_pulled += got[o].nbytes
+        else:
+            with self._reply_cond:
+                self._replies.pop(req, None)
+        return out
+
+    def pull_all(self) -> np.ndarray:
+        """Assemble the full table (dense pulls / finalize / eval): each
+        owner ships its shard once — an all-gather over the bus."""
+        req = self._next_req()
+        with self._reply_cond:
+            self._replies[req] = {}
+        peers = set(range(self.num_processes)) - {self.rank}
+        for o in peers:
+            self.bus.send(o, f"psA:{self.name}",
+                          {"req": req, "clk": self._my_clk()})
+        out = np.empty((self.part.padded, self.dim), np.float32)
+        with self._state_lock:
+            out[self.shard_lo:self.shard_lo + self.part.shard_size] = self._w
+        if peers:
+            got = self._await_replies(req, peers)
+            for o, rows in got.items():
+                lo = o * self.part.shard_size
+                out[lo:lo + rows.shape[0]] = rows
+                self.bytes_pulled += rows.nbytes
+        return out[: self.num_rows]
+
+    def push(self, keys: np.ndarray, grads: np.ndarray) -> None:
+        """Route per-owner (keys, grads) slices; owners apply the updater.
+        Duplicate keys in one push are summed first (reference Add)."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(keys.size, self.dim)
+        owners = self.part.shard_of(keys)
+        for o in range(self.num_processes):
+            mask = owners == o
+            if not mask.any():
+                continue
+            if o == self.rank:
+                self._apply_rows(keys[mask] - self.shard_lo, grads[mask])
+                continue
+            kb = keys[mask].tobytes()
+            gb = grads[mask].tobytes()
+            self.bus.send(o, f"psP:{self.name}",
+                          {"n": int(mask.sum())}, blob=kb + gb)
+            self.bytes_pushed += len(kb) + len(gb)
+        self.rows_pushed += keys.size
+
+    def push_dense(self, grad: np.ndarray) -> None:
+        """Whole-vector gradient push, split into per-owner contiguous
+        ranges (no key lists on the wire) — the dense-table fast path."""
+        grad = np.asarray(grad, np.float32).reshape(-1, self.dim)
+        if grad.shape[0] != self.num_rows:
+            raise ValueError(
+                f"push_dense expects [{self.num_rows}, {self.dim}]")
+        sz = self.part.shard_size
+        for o in range(self.num_processes):
+            lo, hi = o * sz, min((o + 1) * sz, self.num_rows)
+            if hi <= lo:
+                continue
+            if o == self.rank:
+                self._apply_range(0, grad[lo:hi])
+                continue
+            gb = grad[lo:hi].tobytes()
+            self.bus.send(o, f"psR:{self.name}", {"lo": lo}, blob=gb)
+            self.bytes_pushed += len(gb)
+        self.rows_pushed += self.num_rows
+
+    # ------------------------------------------------------------- accounting
+    def local_bytes(self) -> int:
+        """Bytes of table + optimizer state THIS process holds — the ~1/N
+        sharding claim the smoke test asserts."""
+        n = self._w.nbytes
+        if self._acc is not None:
+            n += self._acc.nbytes
+        return n
+
+    # ------------------------------------------------------------- state I/O
+    def shard_state_dict(self) -> dict:
+        with self._state_lock:
+            out = {"w": self._w.copy(), "lo": np.asarray(self.shard_lo)}
+            if self._acc is not None:
+                out["acc"] = self._acc.copy()
+        return out
+
+    def load_shard_state_dict(self, state: dict) -> None:
+        if int(state["lo"]) != self.shard_lo:
+            raise ValueError(
+                f"shard checkpoint lo={int(state['lo'])} belongs to a "
+                f"different rank/partition (mine starts at {self.shard_lo})")
+        with self._state_lock:
+            self._w[...] = state["w"]
+            if self._acc is not None:
+                if "acc" not in state:
+                    raise ValueError("checkpoint lacks adagrad accumulator")
+                self._acc[...] = state["acc"]
+
+
+class ShardedPSTrainer:
+    """Clock/gate/finalize driver over a set of ShardedTables — the Engine-
+    side loop of the sharded PS (pull → compute → push → clock → gate).
+
+    The app owns the compute (jitted model math on pulled rows); this class
+    owns consistency (StalenessGate), the finalize barrier, and aggregate
+    wire/memory accounting.
+    """
+
+    def __init__(self, tables: dict[str, ShardedTable], bus,
+                 num_processes: int, *, staleness: float = 0,
+                 gate_timeout: float = 60.0, monitor=None):
+        self.tables = tables
+        self.bus = bus
+        self.num_processes = num_processes
+        self.staleness = staleness
+        self.monitor = monitor
+        self.clock = 0
+        self.gossip = ClockGossip(bus, num_processes, workers_per_process=1)
+        self.gate = StalenessGate(self.gossip, staleness,
+                                  timeout=gate_timeout, monitor=monitor)
+        self._flushed: set[int] = set()
+        self._acked: set[int] = set()
+        self._byes: set[int] = set()
+        self._fin_cond = threading.Condition()
+        bus.on("psFlush", self._on_flush)
+        bus.on("psFlushAck", self._on_flush_ack)
+        bus.on("psBye", self._on_bye)
+        # server-side admission: tables park pulls until my view of the
+        # global min clock admits them; every clock/exclusion change drains
+        for t in tables.values():
+            t.bind_consistency(self)
+        self.gossip.add_listener(self._drain_parked)
+
+    def admit_pull(self, clk: int) -> bool:
+        """Reference ``model->Get`` admission: serve a pull stamped with
+        requester clock ``clk`` iff global_min >= clk - staleness."""
+        if self.staleness == float("inf"):
+            return True
+        return self.gossip.global_min() >= clk - int(self.staleness)
+
+    def _drain_parked(self) -> None:
+        for t in self.tables.values():
+            t.serve_parked()
+
+    def _on_flush(self, sender: int, payload: dict) -> None:
+        # FIFO per link: every push `sender` addressed to me precedes its
+        # flush broadcast, so by now my shards hold all its updates.
+        with self._fin_cond:
+            self._flushed.add(sender)
+            self._fin_cond.notify_all()
+        self.bus.send(sender, "psFlushAck", {})
+
+    def _on_flush_ack(self, sender: int, payload: dict) -> None:
+        with self._fin_cond:
+            self._acked.add(sender)
+            self._fin_cond.notify_all()
+
+    def _on_bye(self, sender: int, payload: dict) -> None:
+        with self._fin_cond:
+            self._byes.add(sender)
+            self._fin_cond.notify_all()
+
+    # ------------------------------------------------------------------ api
+    def table(self, name: str) -> ShardedTable:
+        return self.tables[name]
+
+    def tick(self) -> None:
+        """Advance my clock, gossip it, and gate (BSP/SSP/ASP rule) —
+        ``KVClientTable::Clock()``."""
+        self.clock += 1
+        self.gossip.publish_local([self.clock])
+        self.gate.wait(self.clock)
+
+    def finalize(self, timeout: float = 30.0) -> None:
+        """Two-sided quiesce: my pushes applied at all owners (their acks)
+        AND all peers' pushes applied at my shards (their flushes). After
+        this, pull/pull_all return identical rows on every live process."""
+        self.bus.publish("psFlush", {"clock": self.clock})
+        self.gossip.publish_local([self.clock])
+        peers = set(range(self.num_processes)) - {self.bus.my_id}
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._fin_cond:
+                live = peers - self.gossip.excluded
+                if live <= self._flushed and live <= self._acked:
+                    return
+                self._fin_cond.wait(timeout=0.5)
+            dead = self.monitor.check() if self.monitor is not None else set()
+            for p in dead:
+                self.gossip.exclude(p)
+            if time.monotonic() > deadline:
+                with self._fin_cond:
+                    live = peers - self.gossip.excluded
+                    missing = sorted((live - self._flushed)
+                                     | (live - self._acked))
+                raise TimeoutError(
+                    f"finalize: peers {missing} never quiesced")
+
+    def shutdown_barrier(self, timeout: float = 10.0) -> None:
+        """Rendezvous before closing the bus: finalize() only quiesces
+        PUSHES; a peer's post-finalize pull_all still needs my server
+        alive. Everyone announces 'bye' after its last pull and waits for
+        all live peers' byes — then nobody's close() can strand a peer's
+        in-flight pull. A timeout is tolerated (the straggler is either
+        dead, which the monitor reports, or about to finish without us)."""
+        self.bus.publish("psBye", {})
+        peers = set(range(self.num_processes)) - {self.bus.my_id}
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._fin_cond:
+                if peers - self.gossip.excluded <= self._byes:
+                    return
+                self._fin_cond.wait(timeout=0.25)
+            dead = self.monitor.check() if self.monitor is not None else set()
+            for p in dead:
+                self.gossip.exclude(p)
+            if time.monotonic() > deadline:
+                return
+
+    # ------------------------------------------------------------- metrics
+    @property
+    def gate_waits(self) -> int:
+        return self.gate.gate_waits
+
+    @property
+    def max_skew_seen(self) -> int:
+        return self.gate.max_skew_seen
+
+    @property
+    def bytes_pushed(self) -> int:
+        return sum(t.bytes_pushed for t in self.tables.values())
+
+    @property
+    def bytes_pulled(self) -> int:
+        return sum(t.bytes_pulled for t in self.tables.values())
+
+    def local_bytes(self) -> int:
+        return sum(t.local_bytes() for t in self.tables.values())

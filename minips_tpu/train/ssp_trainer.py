@@ -45,17 +45,11 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 
 from minips_tpu.comm.bus import ClockGossip, ControlBus
+from minips_tpu.consistency.gate import PeerFailureError, StalenessGate
+
+__all__ = ["SSPTrainer", "PeerFailureError"]  # PeerFailureError re-exported
 
 PyTree = Any
-
-
-class PeerFailureError(RuntimeError):
-    """Raised when the staleness gate times out and heartbeats show dead
-    peers — the caller's cue to run recovery (SURVEY.md §5.3)."""
-
-    def __init__(self, dead: set[int]):
-        super().__init__(f"peer process(es) {sorted(dead)} failed")
-        self.dead = dead
 
 
 class SSPTrainer:
@@ -94,8 +88,6 @@ class SSPTrainer:
         monitor=None,
         compress: float = 1.0,
     ):
-        if staleness < 0:
-            raise ValueError("staleness must be >= 0")
         if not 0.0 < compress <= 1.0:
             raise ValueError("compress must be in (0, 1]")
         self.step_fn = step_fn
@@ -103,7 +95,6 @@ class SSPTrainer:
         self.num_processes = num_processes
         self.staleness = staleness
         self.push_every = max(int(push_every), 1)
-        self.gate_timeout = gate_timeout
         self.monitor = monitor
         self.compress = compress
         self.bytes_pushed = 0    # wire accounting (the compression payoff)
@@ -116,11 +107,11 @@ class SSPTrainer:
         self._inbox: deque[np.ndarray] = deque()
         self._inbox_lock = threading.Lock()
         self.clock = 0
-        self.gate_waits = 0      # times the SSP gate actually blocked
-        self.max_skew_seen = 0   # max (my_clock - global_min) observed
         self.deltas_applied = 0
 
         self.gossip = ClockGossip(bus, num_processes, workers_per_process=1)
+        self._gate_obj = StalenessGate(self.gossip, staleness,
+                                       timeout=gate_timeout, monitor=monitor)
         self._flushed: set[int] = set()
         self._flush_cond = threading.Condition()
         bus.on("delta", self._on_delta)
@@ -195,30 +186,17 @@ class SSPTrainer:
 
     # ------------------------------------------------------------------ gate
     def _gate(self) -> None:
-        """Block until global_min >= my_clock - staleness (SSP rule)."""
-        if self.staleness == float("inf"):
-            return
-        threshold = self.clock - int(self.staleness)
-        if threshold <= 0:
-            return
-        gmin = self.gossip.global_min()
-        self.max_skew_seen = max(self.max_skew_seen, self.clock - gmin)
-        if gmin >= threshold:
-            return
-        self.gate_waits += 1
-        deadline = time.monotonic() + self.gate_timeout
-        while not self.gossip.wait_global_min(
-                threshold, timeout=min(1.0, self.gate_timeout)):
-            dead = self.monitor.check() if self.monitor is not None else set()
-            if dead:
-                for p in dead:
-                    self.gossip.exclude(p)
-                raise PeerFailureError(dead)
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"SSP gate timed out at clock {self.clock} "
-                    f"(global_min={self.gossip.global_min()}, "
-                    f"staleness={self.staleness})")
+        """Block until global_min >= my_clock - staleness (SSP rule) —
+        shared StalenessGate (consistency/gate.py)."""
+        self._gate_obj.wait(self.clock)
+
+    @property
+    def gate_waits(self) -> int:
+        return self._gate_obj.gate_waits
+
+    @property
+    def max_skew_seen(self) -> int:
+        return self._gate_obj.max_skew_seen
 
     # ------------------------------------------------------------------ step
     def step(self, batch) -> float:

@@ -152,3 +152,49 @@ def test_heartbeat_live_peer_not_flagged():
     for b in buses:
         b.close()
     assert dead == [set(), set()]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_bus_directed_send_reaches_only_dest(backend):
+    """send(dest, ...) delivers to exactly one peer — the reference
+    Mailbox's per-id addressing, the sharded-PS routing primitive."""
+    buses = _mk_buses(3, 15900 if backend == "zmq" else 16900,
+                      backend=backend)
+    got = {i: [] for i in range(3)}
+    for i, b in enumerate(buses):
+        b.on("slice", lambda s, p, i=i: got[i].append((s, p["v"])))
+    buses[0].send(2, "slice", {"v": "a"}, blob=b"\x01\x02")
+    buses[1].send(0, "slice", {"v": "b"})
+    buses[0].publish("slice", {"v": "all"})
+    deadline = time.time() + 5
+    while (len(got[2]) < 2 or len(got[0]) < 1
+           or len(got[1]) < 1) and time.time() < deadline:
+        time.sleep(0.01)
+    for b in buses:
+        b.close()
+    assert (0, "a") in got[2] and (0, "all") in got[2]
+    assert got[1] == [(0, "all")]       # never saw the directed frames
+    assert got[0] == [(1, "b")]         # broadcast skips the sender itself
+    assert all(b.bytes_sent > 0 for b in buses[:2])
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_bus_directed_then_broadcast_ordering(backend):
+    """A directed frame to peer p enqueued BEFORE a broadcast must arrive
+    at p first — the ordering the sharded-PS push→clock contract needs."""
+    buses = _mk_buses(2, 15910 if backend == "zmq" else 16910,
+                      backend=backend)
+    seen = []
+    buses[1].on("a", lambda s, p: seen.append(("a", p["i"])))
+    buses[1].on("b", lambda s, p: seen.append(("b", p["i"])))
+    for i in range(50):
+        buses[0].send(1, "a", {"i": i})
+        buses[0].publish("b", {"i": i})
+    deadline = time.time() + 5
+    while len(seen) < 100 and time.time() < deadline:
+        time.sleep(0.01)
+    for b in buses:
+        b.close()
+    assert len(seen) == 100
+    for i in range(50):  # a_i precedes b_i for every i
+        assert seen.index(("a", i)) < seen.index(("b", i))
