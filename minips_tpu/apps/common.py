@@ -14,7 +14,8 @@ from minips_tpu.data.loader import BatchIterator
 from minips_tpu.utils.metrics import MetricsLogger
 
 
-def app_main(name: str, default_cfg: Config, run, extra_flags=None):
+def app_main(name: str, default_cfg: Config, run, extra_flags=None,
+             exec_choices=("spmd", "threaded")):
     # Dev escape hatch: MINIPS_FORCE_CPU=1 runs on (fake multi-) CPU devices.
     # Must happen before the first backend-touching JAX call; the sandbox's
     # TPU plugin ignores the JAX_PLATFORMS env var, hence config.update.
@@ -25,10 +26,12 @@ def app_main(name: str, default_cfg: Config, run, extra_flags=None):
     parser = argparse.ArgumentParser(prog=name)
     add_config_flags(parser)
     parser.add_argument("--exec", dest="exec_mode", default="spmd",
-                        choices=["spmd", "threaded"],
+                        choices=list(exec_choices),
                         help="spmd: fused collective step (TPU fast path); "
                              "threaded: per-worker threads with the "
-                             "consistency gate (reference semantics)")
+                             "consistency gate (reference semantics); "
+                             "multiproc (where offered): key-range-sharded "
+                             "PS across launcher processes")
     if extra_flags is not None:
         extra_flags(parser)
     args = parser.parse_args()
@@ -97,3 +100,47 @@ def threaded_train(engine: Engine, cfg: Config, data: dict, step_fn,
     n = min(len(v) for v in losses_by_worker.values())
     return [float(np.mean([losses_by_worker[w][i]
                            for w in losses_by_worker])) for i in range(n)]
+
+
+def init_multiproc(consistency: str, staleness: int):
+    """Shared launcher-side bootstrap for the sharded-PS apps: env wiring,
+    heartbeat monitor, bsp/ssp/asp → staleness value. Exits rc 2 with the
+    protocol error line when run without the launcher."""
+    import json
+    import sys
+
+    from minips_tpu.comm.heartbeat import HeartbeatMonitor
+    from minips_tpu.launch import init_from_env
+
+    rank, nprocs, bus = init_from_env()
+    if bus is None:
+        print(json.dumps({"rank": 0, "event": "error",
+                          "err": "multiproc mode needs the launcher "
+                                 "(n >= 2)"}), flush=True)
+        sys.exit(2)
+    s = {"bsp": 0, "ssp": staleness, "asp": float("inf")}[consistency]
+    monitor = HeartbeatMonitor(bus, peer_ids=list(range(nprocs)),
+                               interval=0.2, timeout=2.0).start()
+    return rank, nprocs, bus, monitor, s
+
+
+def run_multiproc_body(rank: int, trainer, body) -> int:
+    """Run ``body()`` under the smoke/bench failure protocol: a
+    PeerFailureError prints the peer_failure event and maps to exit 42, a
+    TimeoutError to gate_timeout/43 (the codes the fault drills assert)."""
+    import json
+
+    from minips_tpu.consistency.gate import PeerFailureError
+
+    try:
+        body()
+        return 0
+    except PeerFailureError as e:
+        print(json.dumps({"rank": rank, "event": "peer_failure",
+                          "dead": sorted(e.dead),
+                          "at_clock": trainer.clock}), flush=True)
+        return 42
+    except TimeoutError as e:
+        print(json.dumps({"rank": rank, "event": "gate_timeout",
+                          "err": str(e)}), flush=True)
+        return 43

@@ -38,8 +38,10 @@ def main(argv=None) -> int:
     ap.add_argument("--model", choices=["dense", "sparse"], default="dense")
     ap.add_argument("--iters", type=int, default=40)
     ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--dim", type=int, default=64,
-                    help="dense: feature dim; sparse: key-space size")
+    ap.add_argument("--dim", type=int, default=None,
+                    help="dense: feature dim (default 64); sparse: "
+                         "key-space size, rounded up to a power of two "
+                         "(default 2^14)")
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--updater", choices=["sgd", "adagrad"], default="sgd")
     ap.add_argument("--mode", choices=["bsp", "ssp", "asp"], default="ssp")
@@ -57,33 +59,25 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    from minips_tpu.comm.heartbeat import HeartbeatMonitor
+    from minips_tpu.apps.common import init_multiproc, run_multiproc_body
     from minips_tpu.data import synthetic
-    from minips_tpu.launch import init_from_env
     from minips_tpu.models import lr as lr_model
-    from minips_tpu.train.sharded_ps import (PeerFailureError, ShardedTable,
-                                             ShardedPSTrainer)
+    from minips_tpu.tables.sparse import next_pow2
+    from minips_tpu.train.sharded_ps import (ShardedTable, ShardedPSTrainer)
 
-    rank, nprocs, bus = init_from_env()
-    if bus is None:
-        print(json.dumps({"rank": 0, "event": "error",
-                          "err": "sharded PS needs the launcher (n >= 2)"}),
-              flush=True)
-        return 2
-    staleness = {"bsp": 0, "ssp": args.staleness,
-                 "asp": float("inf")}[args.mode]
-    monitor = HeartbeatMonitor(bus, peer_ids=list(range(nprocs)),
-                               interval=0.2, timeout=2.0).start()
+    rank, nprocs, bus, monitor, staleness = init_multiproc(
+        args.mode, args.staleness)
 
     sparse = args.model == "sparse"
     if sparse:
-        num_rows = args.dim if args.dim > 64 else 1 << 14
+        num_rows = next_pow2(args.dim) if args.dim else 1 << 14
         data = synthetic.classification_sparse(
             n=args.batch * 8, dim=num_rows, seed=100 + rank)
     else:
-        num_rows = args.dim + 1  # weights + bias row
+        dim = args.dim if args.dim else 64
+        num_rows = dim + 1  # weights + bias row
         data = synthetic.classification_dense(
-            n=args.batch * 8, dim=args.dim, seed=100 + rank)
+            n=args.batch * 8, dim=dim, seed=100 + rank)
 
     table = ShardedTable("w", num_rows, 1, bus, rank, nprocs,
                          updater=args.updater, lr=args.lr,
@@ -111,9 +105,11 @@ def main(argv=None) -> int:
 
     losses = []
     rng = np.random.default_rng(rank)
-    code = 0
+    final = None
     t0 = time.monotonic()
-    try:
+
+    def body():
+        nonlocal final
         for i in range(args.iters):
             if args.kill_at and rank == args.kill_rank and i == args.kill_at:
                 os._exit(137)
@@ -138,23 +134,15 @@ def main(argv=None) -> int:
             if rank == args.slow_rank and args.slow_ms > 0:
                 time.sleep(args.slow_ms / 1000.0)
         trainer.finalize(timeout=20.0)
-        # inside the try: a peer that already printed and closed its bus
-        # can look heartbeat-dead while we assemble — that must surface as
-        # the structured peer_failure/gate_timeout event, not a traceback
+        # inside the guarded body: a peer that already printed and closed
+        # its bus can look heartbeat-dead while we assemble — that must
+        # surface as the structured peer_failure event, not a traceback
         final = table.pull_all()
         # finalize quiesced pushes only; peers' pull_alls still need my
         # server — rendezvous before anyone closes
         trainer.shutdown_barrier(timeout=10.0)
-    except PeerFailureError as e:
-        print(json.dumps({"rank": rank, "event": "peer_failure",
-                          "dead": sorted(e.dead),
-                          "at_clock": trainer.clock}), flush=True)
-        code = 42
-    except TimeoutError as e:
-        print(json.dumps({"rank": rank, "event": "gate_timeout",
-                          "err": str(e)}), flush=True)
-        code = 43
 
+    code = run_multiproc_body(rank, trainer, body)
     if code == 0:
         table_bytes = final.nbytes * (2 if args.updater == "adagrad" else 1)
         print(json.dumps({

@@ -64,6 +64,8 @@ def build(cfg: Config, *, use_fm: bool, mesh=None, seed: int = 0,
 
 def run(cfg: Config, args, metrics) -> dict:
     use_fm = getattr(args, "model", "widedeep") == "deepfm"
+    if getattr(args, "exec_mode", "spmd") == "multiproc":
+        return _run_multiproc(cfg, args, metrics, use_fm=use_fm)
     path = getattr(args, "data_file", None)
     if path:  # real Criteo TSV through the native/python reader
         from minips_tpu.data.criteo import log_transform, read_criteo
@@ -72,7 +74,8 @@ def run(cfg: Config, args, metrics) -> dict:
                 "cat": raw["cat"], "y": raw["y"]}
     else:
         data = synthetic.criteo_like(16384, seed=cfg.train.seed)
-    data, holdout = holdout_split(data, getattr(args, "eval_frac", 0.0),
+    data, holdout = holdout_split(data,
+                                  getattr(args, "eval_frac", None) or 0.0,
                                   seed=cfg.train.seed)
     ps, tables = build(cfg, use_fm=use_fm, seed=cfg.train.seed,
                        compute_dtype=(jnp.bfloat16
@@ -100,6 +103,179 @@ def run(cfg: Config, args, metrics) -> dict:
          "tables": tables}, metrics)
 
 
+def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
+    """The flagship sparse workload on the key-range-sharded PS
+    (VERDICT r1 #3): N launcher processes, each with its own Criteo data
+    shard; wide/emb tables PARTITIONED across processes (per-process
+    memory ~1/N), pushes ship only the batch's touched rows per owner —
+    row-sparse, never a table-sized blob; the deep tower rides the dense
+    range path; BSP/SSP/ASP via the owner-side staleness gate. Prints the
+    one-JSON-line launcher protocol (smoke tests / bench)."""
+    import json
+    import os
+    import sys
+    import time
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from minips_tpu.apps.common import (holdout_split, init_multiproc,
+                                        run_multiproc_body)
+    from minips_tpu.data import synthetic
+    from minips_tpu.tables.sparse import hash_to_slots_np
+    from minips_tpu.train.sharded_ps import (ShardedTable, ShardedPSTrainer)
+    from minips_tpu.utils.evaluation import StreamingAUC, padded_chunks
+
+    rank, nprocs, bus, monitor, staleness = init_multiproc(
+        cfg.table.consistency, cfg.table.staleness)
+
+    path = getattr(args, "data_file", None)
+    if path:  # real Criteo TSV; round-robin row shard per rank
+        from minips_tpu.data.criteo import log_transform, read_criteo
+        raw = read_criteo(path)
+        data = {"dense": log_transform(raw["dense"], raw["dense_mask"]),
+                "cat": raw["cat"], "y": raw["y"]}
+        data = {k: v[rank::nprocs] for k, v in data.items()}
+    else:  # per-rank synthetic shard (disjoint seeds, shared signal)
+        data = synthetic.criteo_like(8192, seed=100 + rank)
+    # explicit --eval_frac 0 disables eval (the flag's contract); only an
+    # UNSET flag takes the multiproc default of 0.2
+    frac = getattr(args, "eval_frac", None)
+    frac = 0.2 if frac is None else frac
+    data, holdout = holdout_split(data, frac, seed=cfg.train.seed)
+
+    slots = cfg.table.num_slots
+    emb_dim = cfg.table.dim
+    updater = "adagrad" if cfg.table.updater == "adam" else cfg.table.updater
+    mk = lambda name, dim, scale, seed: ShardedTable(  # noqa: E731
+        name, slots, dim, bus, rank, nprocs, updater=updater,
+        lr=cfg.table.lr, init_scale=scale, seed=seed, monitor=monitor,
+        pull_timeout=30.0)
+    wide_t = mk("wide", 1, 0.0, 1)
+    emb_t = mk("emb", emb_dim, 0.01, 2)
+    # deep tower: flat param vector on the dense range path (adagrad
+    # server-side — the reference's dense-updater family)
+    import jax
+    from jax.flatten_util import ravel_pytree
+    deep0 = wd_model.init_deep(jax.random.PRNGKey(cfg.train.seed + 2),
+                               NUM_CAT, emb_dim, NUM_DENSE)
+    deep_flat0, unravel = ravel_pytree(deep0)
+    deep_t = ShardedTable("deep", deep_flat0.shape[0], 1, bus, rank, nprocs,
+                          updater="adagrad", lr=0.02, monitor=monitor,
+                          pull_timeout=30.0)
+    trainer = ShardedPSTrainer(
+        {"wide": wide_t, "emb": emb_t, "deep": deep_t}, bus, nprocs,
+        staleness=staleness, gate_timeout=30.0, monitor=monitor)
+    bus.handshake(nprocs)
+    # the deep table stores the DELTA from a shared deterministic init
+    # (every rank derives deep_flat0 from the same PRNGKey): the zero
+    # table needs no init broadcast, and range pushes stay pure grads
+
+    @jax.jit
+    def wd_grads(wide_rows, emb_rows, deep_vec, batch):
+        def f(w, e, dv):
+            return wd_model.loss(w, e, unravel(dv[:, 0] + deep_flat0),
+                                 batch, use_fm=use_fm)
+        loss, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(
+            wide_rows, emb_rows, deep_vec)
+        return (loss,) + grads
+
+    B = cfg.train.batch_size
+    rng = np.random.default_rng(rank)
+    losses = []
+    auc_val = None
+    fp = 0.0
+    t0 = time.monotonic()
+
+    def body():
+        nonlocal auc_val, fp
+        for i in range(cfg.train.num_iters):
+            kill_at = getattr(args, "kill_at", 0)
+            if kill_at and rank == getattr(args, "kill_rank", -1) \
+                    and i == kill_at:
+                os._exit(137)
+            sel = rng.integers(0, data["y"].shape[0], size=B)
+            cats = data["cat"][sel]
+            wide_keys = hash_to_slots_np(cats, slots, 1).reshape(-1)
+            emb_keys = hash_to_slots_np(cats, slots, 2).reshape(-1)
+            wide_rows = wide_t.pull(wide_keys).reshape(B, NUM_CAT, 1)
+            emb_rows = emb_t.pull(emb_keys).reshape(B, NUM_CAT, emb_dim)
+            deep_vec = deep_t.pull_all()
+            loss, gw, ge, gd = wd_grads(
+                jnp.asarray(wide_rows), jnp.asarray(emb_rows),
+                jnp.asarray(deep_vec),
+                {"dense": jnp.asarray(data["dense"][sel]),
+                 "y": jnp.asarray(data["y"][sel])})
+            wide_t.push(wide_keys, np.asarray(gw).reshape(-1, 1))
+            emb_t.push(emb_keys, np.asarray(ge).reshape(-1, emb_dim))
+            deep_t.push_dense(np.asarray(gd))
+            losses.append(float(loss))
+            trainer.tick()
+            slow_rank = getattr(args, "slow_rank", -1)
+            if rank == slow_rank and getattr(args, "slow_ms", 0) > 0:
+                time.sleep(args.slow_ms / 1000.0)
+        trainer.finalize(timeout=30.0)
+        # ---- streaming holdout AUC on the FINAL shared tables
+        if holdout is not None:
+            auc = StreamingAUC()
+            deep_final = unravel(deep_t.pull_all()[:, 0] + deep_flat0)
+            for chunk, n_valid in padded_chunks(holdout, 4096):
+                cats = chunk["cat"]
+                cb = cats.shape[0]
+                w_rows = wide_t.pull(
+                    hash_to_slots_np(cats, slots, 1).reshape(-1)
+                ).reshape(cb, NUM_CAT, 1)
+                e_rows = emb_t.pull(
+                    hash_to_slots_np(cats, slots, 2).reshape(-1)
+                ).reshape(cb, NUM_CAT, emb_dim)
+                lg = wd_model.logits(
+                    jnp.asarray(w_rows), jnp.asarray(e_rows), deep_final,
+                    {"dense": jnp.asarray(chunk["dense"])}, use_fm=use_fm)
+                auc.update(np.asarray(lg)[:n_valid], chunk["y"][:n_valid])
+            auc_val = auc.result()
+        # fingerprints for the replica-agreement assertion
+        fp = (float(np.sum(wide_t.pull_all()))
+              + float(np.sum(emb_t.pull_all()))
+              + float(np.sum(deep_t.pull_all())))
+        trainer.shutdown_barrier(timeout=10.0)
+
+    code = run_multiproc_body(rank, trainer, body)
+    if code == 0:
+        sparse_mult = 2 if updater == "adagrad" else 1
+        # deep table is always adagrad server-side (shard + accumulator)
+        table_bytes = (slots * (1 + emb_dim) * 4 * sparse_mult
+                       + deep_flat0.shape[0] * 4 * 2)
+        # metrics BEFORE the protocol line: the launcher harvests the LAST
+        # JSON line on stdout as the result dict
+        metrics.log(final_loss=losses[-1] if losses else None,
+                    holdout_auc=auc_val)
+        print(json.dumps({
+            "rank": rank, "event": "done",
+            "wall_s": round(time.monotonic() - t0, 4),
+            "loss_first": losses[0] if losses else None,
+            "loss_last": float(np.mean(losses[-5:])) if losses else None,
+            "auc": auc_val,
+            "gate_waits": trainer.gate_waits,
+            "max_skew_seen": trainer.max_skew_seen,
+            "bytes_pushed": trainer.bytes_pushed,
+            # embedding-table wire alone: the row-sparse claim is about
+            # these (the deep tower is inherently dense-range traffic)
+            "sparse_bytes_pushed": (wide_t.bytes_pushed
+                                    + emb_t.bytes_pushed),
+            "bytes_pulled": trainer.bytes_pulled,
+            "local_bytes": trainer.local_bytes(),
+            "table_bytes": int(table_bytes),
+            "param_fingerprint": fp,
+            "clock": trainer.clock,
+        }), flush=True)
+    monitor.stop()
+    bus.close()
+    if code:
+        sys.exit(code)
+    return {"losses": losses, "auc": auc_val}
+
+
 def _flags(parser):
     parser.add_argument("--model", default="widedeep",
                         choices=["widedeep", "deepfm"])
@@ -109,13 +285,24 @@ def _flags(parser):
                         choices=["float32", "bfloat16"],
                         help="worker-math precision (master tables stay "
                              "float32)")
-    parser.add_argument("--eval_frac", type=float, default=0.0,
-                        help="opt-in: fraction of rows held out and scored "
-                             "by streaming ROC-AUC after training")
+    parser.add_argument("--eval_frac", type=float, default=None,
+                        help="fraction of rows held out and scored by "
+                             "streaming ROC-AUC after training; 0 disables "
+                             "(default: 0 for spmd/threaded, 0.2 for "
+                             "multiproc)")
+    # multiproc straggler/fault injection (smoke tests)
+    parser.add_argument("--slow-rank", dest="slow_rank", type=int,
+                        default=-1)
+    parser.add_argument("--slow-ms", dest="slow_ms", type=float,
+                        default=0.0)
+    parser.add_argument("--kill-at", dest="kill_at", type=int, default=0)
+    parser.add_argument("--kill-rank", dest="kill_rank", type=int,
+                        default=-1)
 
 
 def main():
-    return app_main("wide_deep_example", DEFAULT, run, extra_flags=_flags)
+    return app_main("wide_deep_example", DEFAULT, run, extra_flags=_flags,
+                    exec_choices=("spmd", "threaded", "multiproc"))
 
 
 if __name__ == "__main__":

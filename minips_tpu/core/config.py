@@ -87,6 +87,8 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--updater", type=str, default=None,
                         choices=["sgd", "adagrad", "adam"])
     parser.add_argument("--lr", type=float, default=None)
+    parser.add_argument("--num_slots", type=int, default=None,
+                        help="sparse table capacity (power of two)")
     parser.add_argument("--batch_size", type=int, default=None)
     parser.add_argument("--num_iters", type=int, default=None)
     parser.add_argument("--num_workers", type=int, default=None)
@@ -104,7 +106,7 @@ def config_from_args(args: argparse.Namespace,
     if getattr(args, "config_file", None):
         with open(args.config_file) as f:
             cfg = Config.from_json(f.read())
-    for name in ("consistency", "staleness", "updater", "lr"):
+    for name in ("consistency", "staleness", "updater", "lr", "num_slots"):
         val = getattr(args, name, None)
         if val is not None:
             setattr(cfg.table, name, val)

@@ -55,6 +55,17 @@ def hash_to_slots(keys: jnp.ndarray, num_slots: int, salt: int = 0,
     return (h & jnp.uint32(num_slots - 1)).astype(jnp.int32)
 
 
+def hash_to_slots_np(keys: np.ndarray, num_slots: int,
+                     salt: int = 0) -> np.ndarray:
+    """NumPy twin of :func:`hash_to_slots` for host-side key routing (the
+    sharded multi-process PS hashes before splitting by owner — no device
+    round-trip). Bit-identical to the jax version by test."""
+    assert num_slots & (num_slots - 1) == 0, "num_slots must be a power of 2"
+    k = np.asarray(keys).astype(np.uint32)
+    h = (k * _HASH_MULT) ^ (k >> np.uint32(16)) ^ np.uint32(salt)
+    return (h & np.uint32(num_slots - 1)).astype(np.int64)
+
+
 def next_pow2(n: int, floor: int = 1) -> int:
     """Smallest power of two ≥ max(n, floor) — SparseTable capacities must
     be powers of two (masked hash above)."""

@@ -97,16 +97,16 @@ class ShardedTable:
         self.part = RangePartitioner(self.num_rows, num_processes)
         self.shard_lo = rank * self.part.shard_size
         # ---- server shard: ONLY my row range lives here (the 1/N memory
-        # claim); padding rows in the last shard are allocated but unused
-        rng = np.random.default_rng(seed)  # same stream every process...
-        full_like = rng.normal(scale=init_scale, size=(
-            self.part.padded, self.dim)) if init_scale else None
+        # claim, materialization included — a multi-GB Criteo table must
+        # never exist whole on any host); per-(seed, rank) stream keeps
+        # init deterministic, and no other process ever materializes these
+        # rows (single-owner), so cross-replica init equality is moot
         self._w = (np.zeros((self.part.shard_size, self.dim), np.float32)
-                   if full_like is None else
-                   full_like[self.shard_lo:self.shard_lo
-                             + self.part.shard_size].astype(np.float32))
-        # ...so shard init equals the slice of one global init (replica-
-        # independent); only the shard is RETAINED (full_like is transient)
+                   if not init_scale else
+                   np.random.default_rng((seed, rank)).normal(
+                       scale=init_scale,
+                       size=(self.part.shard_size, self.dim)
+                   ).astype(np.float32))
         self._acc = (np.full((self.part.shard_size, self.dim),
                              adagrad_init, np.float32)
                      if updater == "adagrad" else None)

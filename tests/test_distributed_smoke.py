@@ -181,3 +181,55 @@ def test_run_local_job_tolerates_non_json_brace_lines():
                 "import json; print(json.dumps({'metrics': 1})); "
                 "print({'result': 2})"],
             base_port=_PORT[0], timeout=60)
+
+
+@pytest.mark.slow
+def test_wide_deep_multiproc_ssp_staleness4():
+    """VERDICT r1 #3: the flagship sparse workload (W&D embedding tables)
+    on the key-range-sharded PS at SSP staleness 4 — row-sparse wire,
+    replica agreement after finalize, AUC above chance and improving."""
+    _PORT[0] += 6
+    slots = 1 << 18  # Criteo-sized enough that batches touch a sliver
+    res = launch.run_local_job(
+        3, [sys.executable, "-m", "minips_tpu.apps.wide_deep_example",
+            "--exec", "multiproc", "--consistency", "ssp", "--staleness",
+            "4", "--num_slots", str(slots), "--num_iters", "40",
+            "--batch_size", "256", "--slow-rank", "1", "--slow-ms", "25"],
+        base_port=_PORT[0],
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
+        timeout=300.0)
+    assert all(r["event"] == "done" for r in res)
+    for r in res:
+        assert r["loss_last"] < r["loss_first"], r
+        assert r["auc"] > 0.65, r["auc"]          # improving vs 0.5 chance
+        assert r["max_skew_seen"] <= 5            # s + 1
+        # embedding tables partitioned: each process holds ~1/3
+        assert r["local_bytes"] * 3 <= r["table_bytes"] * 1.01 + 64
+        # row-sparse deltas: embedding wire scales with TOUCHED rows
+        # (256 samples * 26 fields * ≤2 remote owners * (wide 12B +
+        # emb-row 40B) ≈ 0.7 MB/step), never with table size — a delta
+        # relay ships slots*(1+8)*4B * 2 peers ≈ 18.9 MB/step
+        full_relay = r["clock"] * slots * 9 * 4 * 2
+        assert r["sparse_bytes_pushed"] < full_relay / 20, (
+            r["sparse_bytes_pushed"], full_relay)
+    fps = [r["param_fingerprint"] for r in res]
+    assert max(fps) - min(fps) < 1e-4, fps
+
+
+@pytest.mark.slow
+def test_wide_deep_multiproc_asp_never_waits():
+    _PORT[0] += 6
+    res = launch.run_local_job(
+        3, [sys.executable, "-m", "minips_tpu.apps.wide_deep_example",
+            "--exec", "multiproc", "--consistency", "asp", "--num_slots",
+            "16384", "--num_iters", "30", "--batch_size", "256",
+            "--slow-rank", "2", "--slow-ms", "20"],
+        base_port=_PORT[0],
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
+        timeout=300.0)
+    assert all(r["event"] == "done" for r in res)
+    for r in res:
+        assert r["gate_waits"] == 0       # ASP never blocks
+        assert r["loss_last"] < r["loss_first"], r
+    fps = [r["param_fingerprint"] for r in res]
+    assert max(fps) - min(fps) < 1e-4, fps

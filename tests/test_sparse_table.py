@@ -238,3 +238,18 @@ def test_identity_mapping_exact_rows(mesh8):
     emb = np.asarray(t.emb)
     np.testing.assert_allclose(emb[5], -1.0)
     assert np.all(emb[np.arange(128) != 5] == 0.0)  # only row 5 touched
+
+
+def test_hash_to_slots_np_matches_jax_twin():
+    """hash_to_slots_np routes multiproc keys host-side; it must stay
+    bit-identical to the jax version it mirrors (incl. negative ids and
+    nonzero salts — both wrap through uint32 the same way)."""
+    from minips_tpu.tables.sparse import hash_to_slots_np
+
+    rng = np.random.default_rng(7)
+    keys = rng.integers(-2**62, 2**62, size=4096)
+    for slots in (1 << 10, 1 << 18):
+        for salt in (0, 1, 2, 12345):
+            got = hash_to_slots_np(keys, slots, salt)
+            want = np.asarray(hash_to_slots(jnp.asarray(keys), slots, salt))
+            np.testing.assert_array_equal(got, want.astype(np.int64))
