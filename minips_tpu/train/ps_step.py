@@ -162,6 +162,9 @@ class PSTrainStep:
                                                g_rows[name])
             return new_state, loss
 
+        # un-jitted pure transition, exposed for scan-chained microbenching
+        # (bench.py chains K steps in one dispatch to defeat host overhead)
+        self.step_fn_pure = step
         return jax.jit(step, donate_argnums=(0,))
 
     # -------------------------------------------------------------------- run
