@@ -61,6 +61,17 @@ def main(argv=None) -> int:
                          "beats BSP wall-clock — the slack window absorbs "
                          "stalls instead of propagating them)")
     ap.add_argument("--jitter-prob", type=float, default=0.2)
+    ap.add_argument("--data-file", default=None,
+                    help="libsvm file fed via DYNAMIC block assignment "
+                         "(rank 0 = BlockMaster, SURVEY.md §1 L5): fast "
+                         "ranks take more blocks, a dead rank's blocks "
+                         "re-queue to survivors. --model lr only.")
+    ap.add_argument("--block-lines", type=int, default=200,
+                    help="lines per assigned block (--data-file mode)")
+    ap.add_argument("--max-nnz", type=int, default=32,
+                    help="--data-file mode: padded features per row; rows "
+                         "with more index:value pairs are TRUNCATED to "
+                         "this many")
     ap.add_argument("--checkpoint-dir", default="")
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--resume", action="store_true")
@@ -86,6 +97,23 @@ def main(argv=None) -> int:
     staleness = {"bsp": 0, "ssp": args.staleness,
                  "asp": float("inf")}[args.mode]
 
+    # --- dynamic block assignment (--data-file): rank 0 coordinates
+    master = client = None
+    requeued = {"n": 0}
+    if args.data_file:
+        if args.model != "lr":
+            ap.error("--data-file implies --model lr")
+        from minips_tpu.data import blocks as blk
+
+        if bus is None:  # single-process: plain list, no coordination
+            client = blk.split_file_lines(args.data_file, args.block_lines)
+        else:
+            if rank == 0:
+                master = blk.BlockMaster(
+                    bus, blk.split_file_lines(args.data_file,
+                                              args.block_lines))
+            client = blk.BlockClient(bus, local_master=master)
+
     # my shard: different seed per rank = disjoint data (SURVEY.md §2.2 DP)
     if args.model == "mlp":
         if args.dim is not None:
@@ -100,8 +128,10 @@ def main(argv=None) -> int:
     else:
         from minips_tpu.models import lr as lr_model
 
-        dim = args.dim if args.dim is not None else 64
-        data = synthetic.classification_dense(
+        # file mode defaults to the a9a feature space (123, SURVEY.md §7.3)
+        dim = args.dim if args.dim is not None else (
+            123 if args.data_file else 64)
+        data = None if args.data_file else synthetic.classification_dense(
             n=args.batch * 8, dim=dim, seed=100 + rank)
         params = lr_model.init(dim)
         loss_fn = lr_model.loss_dense
@@ -114,9 +144,13 @@ def main(argv=None) -> int:
 
     monitor = None
     if bus is not None:
+        on_fail = None
+        if master is not None:
+            def on_fail(pid):  # dead rank's blocks back to the survivors
+                requeued["n"] += master.handle_failure(pid)
         monitor = HeartbeatMonitor(
             bus, peer_ids=list(range(nprocs)),
-            interval=0.2, timeout=2.0).start()
+            interval=0.2, timeout=2.0, on_failure=on_fail).start()
 
     trainer = SSPTrainer(local_step, params, bus, nprocs,
                          staleness=staleness, push_every=args.push_every,
@@ -140,29 +174,86 @@ def main(argv=None) -> int:
             start_step = ckpt.restore()
 
     losses = []
+    consumed = {"n": 0}
     rng = np.random.default_rng(rank)
     jitter_rng = np.random.default_rng(1000 + rank)
     code = 0
     t_loop0 = time.monotonic()
+
+    def step_tail(i, loss):
+        losses.append(loss)
+        if rank == args.slow_rank and args.slow_ms > 0:
+            time.sleep(args.slow_ms / 1000.0)
+        if args.jitter_ms > 0 and jitter_rng.random() < args.jitter_prob:
+            time.sleep(args.jitter_ms / 1000.0)
+        if (ckpt is not None and rank == 0 and args.checkpoint_every
+                and (i + 1) % args.checkpoint_every == 0):
+            ckpt.save(step=i + 1)
+
     try:
-        for i in range(start_step, args.iters):
-            if args.kill_at and rank == args.kill_rank and i == args.kill_at:
-                os._exit(137)  # abrupt death: no close(), no flush
-            sel = rng.integers(0, data["y"].shape[0], size=args.batch)
-            batch = {"x": data["x"][sel], "y": data["y"][sel]}
+        if args.data_file:
+            # ---- dynamic block-driven loop: batches stream out of blocks
+            # the master hands this rank; fast ranks naturally take more
+            from minips_tpu.data.blocks import (iter_block_batches,
+                                                read_block_lines)
+            from minips_tpu.data.libsvm import (densify,
+                                                parse_libsvm_lines)
+
+            # 1-based-vs-0-based is a WHOLE-FILE property: decide it once
+            # from the head (per-block detection would silently shift only
+            # the blocks that happen to lack feature 0)
+            with open(args.data_file, "rb") as f:
+                head = parse_libsvm_lines(
+                    [ln for ln, _ in zip(f, range(1000))])
+            present = head["mask"] > 0
+            one_based = bool(present.any()
+                             and head["idx"][present].min() >= 1)
+
+            def counting(it):
+                for b in it:
+                    consumed["n"] += 1
+                    yield b
+
+            def parse_block(b):
+                d = parse_libsvm_lines(read_block_lines(b),
+                                       width=args.max_nnz)
+                if one_based:
+                    d["idx"] = np.where(d["mask"] > 0, d["idx"] - 1,
+                                        0).astype(np.int32)
+                return densify(d, dim)
+
+            i = start_step
+            for batch in iter_block_batches(counting(client), parse_block,
+                                            args.batch):
+                if (args.kill_at and rank == args.kill_rank
+                        and i == args.kill_at):
+                    os._exit(137)
+                if trainer is not None:
+                    loss = trainer.step(batch)
+                else:
+                    params, loss = local_step(params, batch)
+                    loss = float(loss)
+                step_tail(i, loss)
+                i += 1
+                if i >= args.iters:
+                    break
             if trainer is not None:
-                loss = trainer.step(batch)
-            else:  # single-process degenerate case
-                params, loss = local_step(params, batch)
-                loss = float(loss)
-            losses.append(loss)
-            if rank == args.slow_rank and args.slow_ms > 0:
-                time.sleep(args.slow_ms / 1000.0)
-            if args.jitter_ms > 0 and jitter_rng.random() < args.jitter_prob:
-                time.sleep(args.jitter_ms / 1000.0)
-            if (ckpt is not None and rank == 0 and args.checkpoint_every
-                    and (i + 1) % args.checkpoint_every == 0):
-                ckpt.save(step=i + 1)
+                # unequal per-rank step counts are the point of dynamic
+                # assignment: a finished rank must never stall peers' gates
+                trainer.retire()
+        else:
+            for i in range(start_step, args.iters):
+                if (args.kill_at and rank == args.kill_rank
+                        and i == args.kill_at):
+                    os._exit(137)  # abrupt death: no close(), no flush
+                sel = rng.integers(0, data["y"].shape[0], size=args.batch)
+                batch = {"x": data["x"][sel], "y": data["y"][sel]}
+                if trainer is not None:
+                    loss = trainer.step(batch)
+                else:  # single-process degenerate case
+                    params, loss = local_step(params, batch)
+                    loss = float(loss)
+                step_tail(i, loss)
         if trainer is not None:
             final = trainer.finalize(timeout=20.0)
     except PeerFailureError as e:
@@ -192,6 +283,10 @@ def main(argv=None) -> int:
             "param_sum": float(flat.sum()),
             "param_norm": float(np.linalg.norm(flat)),
             "clock": trainer.clock,
+            "blocks_consumed": consumed["n"],
+            "blocks_requeued": requeued["n"],
+            "blocks_remaining": (master.assigner.remaining
+                                 if master is not None else None),
         }), flush=True)
 
     if monitor is not None:

@@ -149,6 +149,12 @@ class LocalBlockAssigner:
         with self._lock:
             return len(self._q)
 
+    @property
+    def outstanding_total(self) -> int:
+        """Blocks popped but not yet done/re-queued, across all workers."""
+        with self._lock:
+            return sum(len(v) for v in self._outstanding.values())
+
 
 class BlockMaster:
     """Bus-side coordinator (runs on one process, conventionally id 0):
@@ -158,17 +164,30 @@ class BlockMaster:
     reply (lost frame, slow master) retries the SAME req id and gets the
     SAME block back — without this, a timed-out request would strand its
     already-popped block on a live worker forever (never trained, never
-    re-queued by ``handle_failure`` because the worker isn't dead)."""
+    re-queued by ``handle_failure`` because the worker isn't dead).
 
-    def __init__(self, bus, blocks: list[Block]):
+    Exhaustion is answered with "wait, retry" for up to ``wait_grace``
+    seconds while blocks are still outstanding on other workers: a dead
+    holder's blocks come back via ``handle_failure`` within the heartbeat
+    timeout, and answering None in that window would let survivors exit
+    with those blocks stranded. The wait MUST be bounded: a live holder can
+    be SSP-gate-blocked precisely because the starved requester stopped
+    clocking — an unbounded wait is a three-way deadlock (requester waits
+    for a block, holder's gate waits for the requester's clock)."""
+
+    def __init__(self, bus, blocks: list[Block], wait_grace: float = 6.0):
         self.bus = bus
         self.assigner = LocalBlockAssigner(blocks)
+        self.wait_grace = wait_grace
         # last (req, block) served per sender; client reqs are sequential,
         # so one entry per sender bounds memory
         self._last: dict[int, tuple] = {}
+        self._wait_since: dict[int, float] = {}
         self._lock = threading.Lock()
         bus.on("blk_req", self._on_req)
         bus.on("blk_done", self._on_done)
+
+    _WAIT = object()  # _last marker: this req was answered "retry later"
 
     def _on_req(self, sender: int, payload: dict) -> None:
         req = payload.get("req")
@@ -176,8 +195,35 @@ class BlockMaster:
             last = self._last.get(sender)
             if last is not None and last[0] == req:
                 block = last[1]  # duplicate request: re-serve, don't re-pop
+                # a wait'd req must KEEP answering wait: the client has
+                # moved on to a fresh req id, so popping a real block for
+                # the stale id would be dropped as stale and stranded
+                if block is self._WAIT:
+                    self.bus.publish("blk_asn", {"to": sender, "req": req,
+                                                 "wait": True})
+                    return
             else:
+                import time as _time
+
                 block = self.assigner.next_block(sender)
+                if (block is None
+                        and self.assigner.outstanding_total > 0
+                        and (_time.monotonic()
+                             - self._wait_since.setdefault(
+                                 sender, _time.monotonic()))
+                        < self.wait_grace):
+                    # queue empty but blocks are still OUT — a dead
+                    # worker's come back via handle_failure within the
+                    # heartbeat timeout, so retry for wait_grace; past
+                    # that the holders are live (they will finish their
+                    # own blocks) and the requester must be released to
+                    # retire, or a gate-blocked holder deadlocks with it
+                    self._last[sender] = (req, self._WAIT)
+                    self.bus.publish("blk_asn", {"to": sender, "req": req,
+                                                 "wait": True})
+                    return
+                if block is not None:
+                    self._wait_since.pop(sender, None)
                 self._last[sender] = (req, block)
         self.bus.publish("blk_asn", {"to": sender, "req": req,
                                      "block": block})
@@ -214,7 +260,7 @@ class BlockClient:
         with self._cond:
             if payload.get("req") != self._waiting:
                 return  # stale reply for an abandoned request: don't leak
-            self._replies[payload.get("req")] = payload.get("block")
+            self._replies[payload.get("req")] = payload
             self._cond.notify_all()
 
     def next_block(self) -> Optional[Block]:
@@ -225,28 +271,50 @@ class BlockClient:
         import time
 
         if self._local is not None:
-            return self._local.assigner.next_block(self.bus.my_id)
-        with self._cond:
-            self._req += 1
-            req = self._req
-            self._waiting = req
-        deadline = time.monotonic() + self.timeout
-        try:
+            # same bounded wait as the master gives remote clients
+            deadline = time.monotonic() + self._local.wait_grace
             while True:
-                self.bus.publish("blk_req", {"req": req})
-                with self._cond:
-                    if self._cond.wait_for(
-                            lambda: req in self._replies,
-                            min(self.retry_every,
-                                max(deadline - time.monotonic(), 0.01))):
-                        return self._replies.pop(req)
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"block request {req} unanswered after "
-                        f"{self.timeout}s (master process dead?)")
-        finally:
+                b = self._local.assigner.next_block(self.bus.my_id)
+                if (b is None
+                        and self._local.assigner.outstanding_total > 0
+                        and time.monotonic() < deadline):
+                    time.sleep(min(self.retry_every, 0.25))
+                    continue
+                return b
+        deadline = time.monotonic() + self.timeout
+        while True:
             with self._cond:
-                self._waiting = None
+                self._req += 1
+                req = self._req
+                self._waiting = req
+            try:
+                reply = None
+                while reply is None:
+                    self.bus.publish("blk_req", {"req": req})
+                    with self._cond:
+                        if self._cond.wait_for(
+                                lambda: req in self._replies,
+                                min(self.retry_every,
+                                    max(deadline - time.monotonic(),
+                                        0.01))):
+                            reply = self._replies.pop(req)
+                    if reply is None and time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"block request {req} unanswered after "
+                            f"{self.timeout}s (master process dead?)")
+            finally:
+                with self._cond:
+                    self._waiting = None
+            if not reply.get("wait"):
+                return reply.get("block")
+            # queue empty but blocks outstanding elsewhere: retry with a
+            # FRESH req id (the master served this one) until they either
+            # come back (dead-worker re-queue) or all complete
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "block queue drained but blocks still outstanding "
+                    f"after {self.timeout}s")
+            time.sleep(self.retry_every)
 
     def done(self, block: Block) -> None:
         if self._local is not None:
@@ -256,10 +324,16 @@ class BlockClient:
 
     def __iter__(self) -> Iterator[Block]:
         """Drain: yields blocks and acks each one after the loop body ran
-        (ack-on-next-yield keeps at most one block outstanding per worker)."""
+        (ack-on-next-yield keeps at most one block outstanding per worker).
+        The ack sits in a ``finally`` so a consumer that stops early (break
+        → GeneratorExit) still retires its in-flight block — otherwise it
+        would sit outstanding on a live worker forever and spin peers'
+        queue-drained wait loops into TimeoutError."""
         while True:
             b = self.next_block()
             if b is None:
                 return
-            yield b
-            self.done(b)
+            try:
+                yield b
+            finally:
+                self.done(b)

@@ -47,21 +47,33 @@ def read_libsvm(path: str, max_features: int | None = None,
                 return out
         except ImportError:
             pass
-    rows = []
     with open(path) as f:
-        for line in f:
-            parts = line.split()
-            if not parts:
-                continue
-            label = float(parts[0])
-            pairs = [p.split(":") for p in parts[1:]]
-            rows.append((label,
-                         np.array([int(i) for i, _ in pairs], np.int32),
-                         np.array([float(v) for _, v in pairs], np.float32)))
+        return parse_libsvm_lines(f, max_features=max_features)
+
+
+def parse_libsvm_lines(lines, max_features: int | None = None,
+                       width: int | None = None) -> dict:
+    """Parse an iterable of libsvm lines (str or bytes) into the same
+    padded dict as :func:`read_libsvm`. ``width`` fixes the padded feature
+    count — block-wise streaming (data/blocks.py) needs every block to
+    produce the same static shape regardless of which rows landed in it."""
+    rows = []
+    for line in lines:
+        if isinstance(line, bytes):
+            line = line.decode()
+        parts = line.split()
+        if not parts:
+            continue
+        label = float(parts[0])
+        pairs = [p.split(":") for p in parts[1:]]
+        rows.append((label,
+                     np.array([int(i) for i, _ in pairs], np.int32),
+                     np.array([float(v) for _, v in pairs], np.float32)))
     n = len(rows)
-    width = max((len(r[1]) for r in rows), default=0)
-    if max_features is not None:
-        width = min(width, max_features)
+    if width is None:
+        width = max((len(r[1]) for r in rows), default=0)
+        if max_features is not None:
+            width = min(width, max_features)
     y = np.zeros(n, np.float32)
     idx = np.zeros((n, width), np.int32)
     val = np.zeros((n, width), np.float32)

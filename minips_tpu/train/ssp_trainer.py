@@ -214,6 +214,23 @@ class SSPTrainer:
         return float(loss)
 
     # -------------------------------------------------------------- lifecycle
+    RETIRED_CLOCK = 1 << 30
+
+    def retire(self) -> None:
+        """Announce this worker is out of data: publish a sentinel clock so
+        peers' SSP gates never wait on a finished worker (dynamic block
+        assignment makes per-worker step counts unequal — the reference's
+        data-exhaustion barrier analog). Call before finalize(); sticky —
+        later clock publishes (finalize) must not clobber the sentinel or
+        still-running peers would gate-block on this worker again."""
+        self._retired = True
+        self.gossip.publish_local([self.RETIRED_CLOCK])
+
+    def _publish_clock(self) -> None:
+        self.gossip.publish_local(
+            [self.RETIRED_CLOCK if getattr(self, "_retired", False)
+             else self.clock])
+
     def finalize(self, timeout: float = 30.0) -> PyTree:
         """Flush my remaining delta, wait for all live peers to reach my
         clock, merge their tail — after this every live replica holds the
@@ -226,7 +243,7 @@ class SSPTrainer:
         # our inbox (clock gossip alone cannot promise that: a peer's last
         # clock precedes its finalize-time residual).
         self.bus.publish("flush", {"clock": self.clock})
-        self.gossip.publish_local([self.clock])
+        self._publish_clock()
         deadline = time.monotonic() + timeout
         peers = set(range(self.num_processes)) - {self.bus.my_id}
         while True:

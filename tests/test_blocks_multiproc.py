@@ -1,0 +1,125 @@
+"""Dynamic block assignment feeding REAL multi-process training jobs —
+VERDICT r1 #4: rank 0's BlockMaster hands split_file_lines blocks to
+ssp_lr workers; a slowed rank consumes fewer blocks (straggler mitigation
+actually mitigating), and a killed rank's outstanding blocks re-queue to
+survivors (exactly-once completion)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from minips_tpu import launch
+from minips_tpu.data import synthetic
+from minips_tpu.data.libsvm import write_libsvm
+
+APP = "minips_tpu.apps.ssp_lr_example"
+_PORT = [6400]
+
+
+@pytest.fixture(scope="module")
+def libsvm_file(tmp_path_factory):
+    d = synthetic.classification_sparse(n=6000, dim=123, nnz_per_row=14,
+                                        seed=5)
+    path = tmp_path_factory.mktemp("blk") / "train.libsvm"
+    write_libsvm(str(path), d["y"], d["idx"], d["val"], d["mask"])
+    return str(path)
+
+
+def _run(n, extra, timeout=240.0, kill_on_failure=False):
+    _PORT[0] += n + 3
+    hosts = ["localhost"] * n
+    outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
+    procs = []
+    for rank in range(n):
+        env = launch.child_env(rank, hosts, _PORT[0])
+        env.update({"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", APP] + extra,
+            env=env, stdout=outs[rank], stderr=subprocess.STDOUT))
+    rc = launch.wait(procs, timeout=timeout,
+                     kill_on_failure=kill_on_failure)
+    events = []
+    for f in outs:
+        f.flush(); f.seek(0)
+        text = f.read()
+        f.close(); os.unlink(f.name)
+        evs = []
+        for ln in text.splitlines():
+            if ln.strip().startswith("{"):
+                try:
+                    evs.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    pass
+        events.append(evs)
+    return rc, events
+
+
+@pytest.mark.slow
+def test_straggler_consumes_fewer_blocks(libsvm_file):
+    """ASP, 60 blocks, rank 1 slowed 60ms/step: dynamic assignment routes
+    more blocks to the fast ranks; every block is consumed exactly once."""
+    rc, events = _run(3, ["--data-file", libsvm_file, "--block-lines",
+                          "100", "--batch", "100", "--iters", "10000",
+                          "--mode", "asp", "--slow-rank", "1",
+                          "--slow-ms", "60"])
+    assert rc == 0, events
+    dones = [ev[-1] for ev in events]
+    assert all(d["event"] == "done" for d in dones), dones
+    consumed = {d["rank"]: d["blocks_consumed"] for d in dones}
+    assert sum(consumed.values()) == 60, consumed   # exactly once
+    fast = [consumed[r] for r in (0, 2)]
+    assert consumed[1] < min(fast), consumed        # mitigation mitigated
+    assert dones[0]["blocks_remaining"] == 0
+    for d in dones:
+        if d["blocks_consumed"]:                    # trained ranks learn
+            assert d["loss_last"] < d["loss_first"] + 1e-6, d
+    # replicas agree after finalize (same PS invariant as synthetic mode)
+    sums = [d["param_sum"] for d in dones]
+    assert max(sums) - min(sums) < 1e-4, sums
+
+
+@pytest.mark.slow
+def test_ssp_blocks_respect_staleness(libsvm_file):
+    """SSP s=2 over dynamic blocks WITH a straggler and multi-batch blocks
+    (4 batches per 100-line block): ranks retire at different clocks and
+    peers still have >s steps of buffered batches left — the retire()
+    sentinel must stay sticky through finalize's clock publish or the
+    running ranks gate-deadlock (code-review round 2 regression)."""
+    rc, events = _run(3, ["--data-file", libsvm_file, "--block-lines",
+                          "100", "--batch", "25", "--iters", "10000",
+                          "--mode", "ssp", "--staleness", "2",
+                          "--slow-rank", "1", "--slow-ms", "25"])
+    assert rc == 0, events
+    dones = [ev[-1] for ev in events]
+    assert all(d["event"] == "done" for d in dones), dones
+    assert sum(d["blocks_consumed"] for d in dones) == 60
+    for d in dones:
+        assert d["max_skew_seen"] <= 3              # s + 1
+
+
+@pytest.mark.slow
+def test_killed_ranks_blocks_requeue_to_survivors(libsvm_file):
+    """Fault drill: rank 2 dies abruptly mid-consumption (ASP so the gate
+    never stalls); the heartbeat failure handler re-queues its outstanding
+    blocks and survivors drain the queue to zero."""
+    rc, events = _run(3, ["--data-file", libsvm_file, "--block-lines",
+                          "100", "--batch", "100", "--iters", "10000",
+                          "--mode", "asp", "--slow-rank", "0",
+                          "--slow-ms", "120",        # keep the job alive
+                          "--kill-at", "3", "--kill-rank", "2"])
+    assert rc != 0                                   # the kill happened
+    dones = {ev[-1]["rank"]: ev[-1] for r, ev in enumerate(events)
+             if r != 2 and ev and ev[-1]["event"] == "done"}
+    assert set(dones) == {0, 1}, events
+    master = dones[0]
+    assert master["blocks_requeued"] >= 1, master    # corpse's block back
+    assert master["blocks_remaining"] == 0, master   # ...and consumed
+    # survivors covered everything the dead rank didn't finish
+    assert sum(d["blocks_consumed"] for d in dones.values()) >= 60 - 4
