@@ -1,0 +1,153 @@
+// Native sanitizer drill (SURVEY.md §5.2): exercises the lock-heavy C++
+// components — the mailbox's full mesh (accept/reader/sender actors,
+// ThreadsafeQueue, concurrent publish/directed send vs close) and the
+// multi-threaded libsvm parser — under -fsanitize=address / thread.
+// Built and run by `make -C cpp sanitize`; any data race / leak / UB the
+// sanitizers find fails the build with a report.
+//
+// Links the component .cpp files directly (the C ABI is declared here, the
+// implementations live in the instrumented objects).
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* mailbox_create(int listen_port);
+int mailbox_port(void* h);
+int mailbox_connect(void* h, const char* host, int port, int timeout_ms);
+void mailbox_publish(void* h, const char* msg, int64_t msg_len,
+                     const uint8_t* blob, int64_t blob_len);
+void mailbox_send(void* h, int peer_index, const char* msg, int64_t msg_len,
+                  const uint8_t* blob, int64_t blob_len);
+int mailbox_recv(void* h, int timeout_ms, char** msg_out, int64_t* msg_len,
+                 uint8_t** blob_out, int64_t* blob_len);
+void mailbox_free_buf(void* p);
+void mailbox_close(void* h);
+
+int libsvm_count(const char* path, int64_t* n_rows, int64_t* max_width);
+int libsvm_parse_mt(const char* path, int64_t n_rows, int64_t width,
+                    float* y, int32_t* idx, float* val, float* mask,
+                    int n_threads);
+}
+
+namespace {
+
+int drain(void* mb, int expect, int timeout_ms = 5000) {
+  // Count frames until `expect` arrived or timeout; frees every buffer.
+  int got = 0;
+  char* msg = nullptr;
+  int64_t msg_len = 0, blob_len = 0;
+  uint8_t* blob = nullptr;
+  while (got < expect &&
+         mailbox_recv(mb, timeout_ms, &msg, &msg_len, &blob, &blob_len)) {
+    assert(msg_len > 0);
+    mailbox_free_buf(msg);
+    if (blob) mailbox_free_buf(blob);
+    blob = nullptr;
+    ++got;
+  }
+  return got;
+}
+
+void mailbox_drill() {
+  // 3-node full mesh on ephemeral ports.
+  void* mb[3];
+  int port[3];
+  for (int i = 0; i < 3; ++i) {
+    mb[i] = mailbox_create(0);
+    assert(mb[i]);
+    port[i] = mailbox_port(mb[i]);
+  }
+  for (int i = 0; i < 3; ++i)
+    for (int j = 0; j < 3; ++j)
+      if (i != j)
+        assert(mailbox_connect(mb[i], "127.0.0.1", port[j], 5000) == 0);
+
+  // Concurrent publishers: every node broadcasts 200 frames (half with
+  // blobs) and directs 100 frames at each peer, from 2 threads each —
+  // hammering the Sender actor, the per-connection readers and the
+  // ThreadsafeQueue from both sides.
+  const char* payload = "{\"kind\":\"x\",\"sender\":0,\"payload\":{}}";
+  const int64_t plen = static_cast<int64_t>(std::strlen(payload));
+  std::vector<uint8_t> blob(4096, 7);
+  std::vector<std::thread> senders;
+  for (int i = 0; i < 3; ++i) {
+    for (int t = 0; t < 2; ++t) {
+      senders.emplace_back([&, i] {
+        for (int k = 0; k < 100; ++k) {
+          mailbox_publish(mb[i], payload, plen,
+                          (k & 1) ? blob.data() : nullptr,
+                          (k & 1) ? static_cast<int64_t>(blob.size()) : -1);
+          mailbox_send(mb[i], k % 2, payload, plen, nullptr, -1);
+        }
+      });
+    }
+  }
+  for (auto& t : senders) t.join();
+  // Each node: 2 peers * 200 broadcasts = 400, plus directed frames.
+  // Directed: each node sends 2 threads * 100 to peer_index k%2 (50/50
+  // split across its two peers * 2 threads = 100 per peer link).
+  for (int i = 0; i < 3; ++i) {
+    int got = drain(mb[i], 400 + 200);
+    assert(got == 600);
+  }
+  // Close while a late publisher is still running (publish-after-close
+  // must be handled; the Python layer serializes this, the C layer must
+  // at least not crash when a publish races the drain/teardown).
+  std::thread late([&] {
+    for (int k = 0; k < 50; ++k)
+      mailbox_publish(mb[0], payload, plen, nullptr, -1);
+  });
+  late.join();  // join BEFORE close: the C ABI contract is no-publish-
+                // after-close (native_bus.py holds a lock for this)
+  for (int i = 0; i < 3; ++i) mailbox_close(mb[i]);
+  std::printf("mailbox drill: ok\n");
+}
+
+void reader_drill() {
+  // Multi-threaded parse vs single-scan: byte-identical, no races.
+  std::string path = "/tmp/sanitize_test.libsvm";
+  {
+    FILE* f = std::fopen(path.c_str(), "w");
+    assert(f);
+    for (int r = 0; r < 5000; ++r) {
+      std::fprintf(f, "%d", (r * 7 % 2) ? 1 : -1);
+      for (int k = 0; k < 1 + r % 13; ++k)
+        std::fprintf(f, " %d:%.3f", (r + k * 31) % 123 + 1,
+                     0.01 * ((r + k) % 97));
+      std::fputc('\n', f);
+    }
+    std::fclose(f);
+  }
+  int64_t n = 0, w = 0;
+  assert(libsvm_count(path.c_str(), &n, &w) == 0);
+  assert(n == 5000 && w == 13);
+  std::vector<float> y1(n), y4(n);
+  std::vector<int32_t> i1(n * w), i4(n * w);
+  std::vector<float> v1(n * w), v4(n * w), m1(n * w), m4(n * w);
+  assert(libsvm_parse_mt(path.c_str(), n, w, y1.data(), i1.data(),
+                         v1.data(), m1.data(), 1) == 0);
+  assert(libsvm_parse_mt(path.c_str(), n, w, y4.data(), i4.data(),
+                         v4.data(), m4.data(), 4) == 0);
+  assert(std::memcmp(y1.data(), y4.data(), sizeof(float) * n) == 0);
+  assert(std::memcmp(i1.data(), i4.data(), sizeof(int32_t) * n * w) == 0);
+  assert(std::memcmp(v1.data(), v4.data(), sizeof(float) * n * w) == 0);
+  assert(std::memcmp(m1.data(), m4.data(), sizeof(float) * n * w) == 0);
+  std::remove(path.c_str());
+  std::printf("reader drill: ok\n");
+}
+
+}  // namespace
+
+int main() {
+  mailbox_drill();
+  reader_drill();
+  std::printf("sanitize_test: ALL OK\n");
+  return 0;
+}

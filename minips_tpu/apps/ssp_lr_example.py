@@ -196,18 +196,17 @@ def main(argv=None) -> int:
             # the master hands this rank; fast ranks naturally take more
             from minips_tpu.data.blocks import (iter_block_batches,
                                                 read_block_lines)
-            from minips_tpu.data.libsvm import (densify,
+            from minips_tpu.data.libsvm import (apply_one_based_shift,
+                                                densify,
+                                                detect_one_based,
                                                 parse_libsvm_lines)
 
             # 1-based-vs-0-based is a WHOLE-FILE property: decide it once
             # from the head (per-block detection would silently shift only
             # the blocks that happen to lack feature 0)
             with open(args.data_file, "rb") as f:
-                head = parse_libsvm_lines(
-                    [ln for ln, _ in zip(f, range(1000))])
-            present = head["mask"] > 0
-            one_based = bool(present.any()
-                             and head["idx"][present].min() >= 1)
+                one_based = detect_one_based(parse_libsvm_lines(
+                    [ln for ln, _ in zip(f, range(1000))]))
 
             def counting(it):
                 for b in it:
@@ -218,8 +217,7 @@ def main(argv=None) -> int:
                 d = parse_libsvm_lines(read_block_lines(b),
                                        width=args.max_nnz)
                 if one_based:
-                    d["idx"] = np.where(d["mask"] > 0, d["idx"] - 1,
-                                        0).astype(np.int32)
+                    apply_one_based_shift(d)
                 return densify(d, dim)
 
             i = start_step

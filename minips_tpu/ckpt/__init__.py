@@ -1,1 +1,28 @@
 from minips_tpu.ckpt.checkpoint import Checkpointer  # noqa: F401
+
+
+def convert_checkpoint(src_dir: str, dst_dir: str, tables: dict,
+                       controllers: dict | None = None, *,
+                       src_backend: str, dst_backend: str,
+                       step: int | None = None) -> int:
+    """Migrate a checkpoint between the native (npz-dir) and orbax
+    (TensorStore) formats — the concrete meaning of the two backends being
+    "drop-in interchangeable" (SURVEY.md §5.4): same content, so a restore
+    through one and a save through the other is lossless. ``tables`` (and
+    optional ``controllers``) provide the live objects whose state carries
+    the checkpoint across; their state is overwritten by ``src`` and then
+    persisted to ``dst``. Returns the migrated step."""
+    from minips_tpu.ckpt.orbax_backend import make_checkpointer
+
+    src = make_checkpointer(src_dir, tables, controllers,
+                            backend=src_backend)
+    step = src.restore(step)
+    if hasattr(src, "close"):
+        src.close()
+    dst = make_checkpointer(dst_dir, tables, controllers,
+                            backend=dst_backend)
+    dst.save(step=step)
+    dst.wait()
+    if hasattr(dst, "close"):
+        dst.close()
+    return step

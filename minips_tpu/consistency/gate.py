@@ -18,6 +18,20 @@ from __future__ import annotations
 import time
 
 
+# A retired (out-of-data) worker's published clock: far above any real
+# clock so it never gates peers. Sticky — finalize-time clock publishes
+# must go through publish_clock() so they cannot clobber the sentinel
+# (a clobber re-gates still-running peers on the finished worker:
+# straggler+SSP deadlock).
+RETIRED_CLOCK = 1 << 30
+
+
+def publish_clock(gossip, clock: int, retired: bool) -> None:
+    """The one place trainer clocks reach the gossip layer — retirement
+    stickiness lives here so every trainer gets it."""
+    gossip.publish_local([RETIRED_CLOCK if retired else clock])
+
+
 class PeerFailureError(RuntimeError):
     """Raised when the staleness gate times out and heartbeats show dead
     peers — the caller's cue to run recovery (SURVEY.md §5.3)."""

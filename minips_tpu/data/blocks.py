@@ -325,15 +325,17 @@ class BlockClient:
     def __iter__(self) -> Iterator[Block]:
         """Drain: yields blocks and acks each one after the loop body ran
         (ack-on-next-yield keeps at most one block outstanding per worker).
-        The ack sits in a ``finally`` so a consumer that stops early (break
-        → GeneratorExit) still retires its in-flight block — otherwise it
-        would sit outstanding on a live worker forever and spin peers'
-        queue-drained wait loops into TimeoutError."""
+
+        Deliberately NOT ack-on-close: a consumer that stops early —
+        whether by ``break`` or because its step raised — reaches the
+        generator identically as GeneratorExit, and acking there would
+        retire a block a FAILING worker never trained, so handle_failure
+        could not re-queue it (silent data loss). Leaving it outstanding
+        costs the benign break case at most the master's bounded
+        ``wait_grace`` before peers see true exhaustion."""
         while True:
             b = self.next_block()
             if b is None:
                 return
-            try:
-                yield b
-            finally:
-                self.done(b)
+            yield b
+            self.done(b)

@@ -90,15 +90,30 @@ def parse_libsvm_lines(lines, max_features: int | None = None,
     return {"y": y, "idx": idx, "val": val, "mask": mask}
 
 
+def detect_one_based(data: dict) -> bool:
+    """True iff every present feature index is >= 1 — the canonical
+    libsvm convention (a9a/RCV1 index from 1)."""
+    present = data["mask"] > 0
+    return bool(present.any() and data["idx"][present].min() >= 1)
+
+
+def apply_one_based_shift(data: dict) -> dict:
+    """Shift present indices down by one (masked padding stays 0), in
+    place. Callers that decide once per FILE (block streaming) pair this
+    with :func:`detect_one_based` on a head sample."""
+    present = data["mask"] > 0
+    data["idx"] = np.where(present, data["idx"] - 1, 0).astype(np.int32)
+    return data
+
+
 def shift_one_based(data: dict) -> dict:
     """Canonical libsvm files (a9a/RCV1) index features from 1; the
     framework's key spaces are 0-based. If every present index is >= 1,
     shift down by one (masked padding cells stay 0). Without this, densify
     at dim=D silently drops feature D of a 1-based file. Returns the same
     dict, modified in place."""
-    present = data["mask"] > 0
-    if present.any() and data["idx"][present].min() >= 1:
-        data["idx"] = np.where(present, data["idx"] - 1, 0).astype(np.int32)
+    if detect_one_based(data):
+        apply_one_based_shift(data)
     return data
 
 
@@ -110,6 +125,8 @@ def densify(data: dict, dim: int) -> dict:
     rows = np.repeat(np.arange(n), width)
     cols = data["idx"].reshape(-1)
     vals = (data["val"] * data["mask"]).reshape(-1)
-    keep = cols < dim
+    # cols >= 0 too: a mistaken one-based shift of a 0-based row yields
+    # idx -1, and numpy would silently wrap it into column dim-1
+    keep = (cols >= 0) & (cols < dim)
     np.add.at(X, (rows[keep], cols[keep]), vals[keep])
     return {"x": X, "y": data["y"]}

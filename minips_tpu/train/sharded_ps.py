@@ -495,24 +495,25 @@ class ShardedPSTrainer:
         self.gossip.publish_local([self.clock])
         self.gate.wait(self.clock)
 
-    RETIRED_CLOCK = 1 << 30
-
     def retire(self) -> None:
-        """Out of data: sentinel clock so peers' gates (and owner-side pull
-        admission) never wait on this finished worker — dynamic block
-        assignment makes per-worker step counts unequal. Sticky: finalize's
-        clock publish must not clobber the sentinel."""
+        """Out of data: the shared sentinel clock (gate.py RETIRED_CLOCK)
+        so peers' gates (and owner-side pull admission) never wait on this
+        finished worker — dynamic block assignment makes per-worker step
+        counts unequal."""
+        from minips_tpu.consistency.gate import publish_clock
+
         self._retired = True
-        self.gossip.publish_local([self.RETIRED_CLOCK])
+        publish_clock(self.gossip, self.clock, True)
 
     def finalize(self, timeout: float = 30.0) -> None:
         """Two-sided quiesce: my pushes applied at all owners (their acks)
         AND all peers' pushes applied at my shards (their flushes). After
         this, pull/pull_all return identical rows on every live process."""
         self.bus.publish("psFlush", {"clock": self.clock})
-        self.gossip.publish_local(
-            [self.RETIRED_CLOCK if getattr(self, "_retired", False)
-             else self.clock])
+        from minips_tpu.consistency.gate import publish_clock
+
+        publish_clock(self.gossip, self.clock,
+                      getattr(self, "_retired", False))
         peers = set(range(self.num_processes)) - {self.bus.my_id}
         deadline = time.monotonic() + timeout
         while True:

@@ -214,22 +214,21 @@ class SSPTrainer:
         return float(loss)
 
     # -------------------------------------------------------------- lifecycle
-    RETIRED_CLOCK = 1 << 30
-
     def retire(self) -> None:
-        """Announce this worker is out of data: publish a sentinel clock so
-        peers' SSP gates never wait on a finished worker (dynamic block
-        assignment makes per-worker step counts unequal — the reference's
-        data-exhaustion barrier analog). Call before finalize(); sticky —
-        later clock publishes (finalize) must not clobber the sentinel or
-        still-running peers would gate-block on this worker again."""
+        """Announce this worker is out of data: publish the shared sentinel
+        clock (consistency/gate.py RETIRED_CLOCK) so peers' SSP gates never
+        wait on a finished worker — dynamic block assignment makes
+        per-worker step counts unequal. Call before finalize()."""
+        from minips_tpu.consistency.gate import publish_clock
+
         self._retired = True
-        self.gossip.publish_local([self.RETIRED_CLOCK])
+        publish_clock(self.gossip, self.clock, True)
 
     def _publish_clock(self) -> None:
-        self.gossip.publish_local(
-            [self.RETIRED_CLOCK if getattr(self, "_retired", False)
-             else self.clock])
+        from minips_tpu.consistency.gate import publish_clock
+
+        publish_clock(self.gossip, self.clock,
+                      getattr(self, "_retired", False))
 
     def finalize(self, timeout: float = 30.0) -> PyTree:
         """Flush my remaining delta, wait for all live peers to reach my

@@ -29,3 +29,30 @@ def test_bench_cpu_emits_accounted_json():
     assert s["tflops_per_chip"] > 0
     assert "mfu_vs_bf16_peak" in s and s["mfu_vs_bf16_peak"] is None
     assert "warning" not in s
+
+
+def test_ssp_schedule_simulation_invariants():
+    """The event-driven gate schedule (bench_ssp.simulate_schedule) obeys
+    the theory: BSP pays the union of stalls, staleness only helps, zero
+    jitter makes all modes equal, and large s approaches the no-barrier
+    bound (slowest worker's own work)."""
+    sys.path.insert(0, REPO)
+    from bench_ssp import simulate_schedule
+
+    kw = dict(n=3, iters=200, step_ms=20.0, jitter_ms=40.0,
+              jitter_prob=0.25, seed=1)
+    bsp = simulate_schedule(staleness=0, **kw)
+    ssp = simulate_schedule(staleness=4, **kw)
+    free = simulate_schedule(staleness=10**6, **kw)
+    assert free <= ssp <= bsp
+    assert bsp > ssp * 1.05            # jitter regime: SSP genuinely wins
+    # no jitter: the barrier costs nothing, every mode identical
+    kw0 = dict(kw, jitter_ms=0.0)
+    assert simulate_schedule(staleness=0, **kw0) == \
+        simulate_schedule(staleness=4, **kw0)
+    # the no-barrier bound equals the slowest worker's own serial time
+    import numpy as np
+    rng = np.random.default_rng(1)
+    stall = (rng.random((3, 200)) < 0.25) * 40.0
+    serial = (200 * 20.0 + stall.sum(axis=1)).max() / 1000.0
+    assert abs(free - serial) < 1e-9

@@ -290,3 +290,50 @@ def test_legacy_checkpoint_refused_for_nonzero_salt(mesh8, tmp_path):
     t2 = SparseTable(64, 2, mesh8, salt=7)
     with pytest.raises(ValueError, match="predates layout"):
         Checkpointer(str(tmp_path), {"s": t2}).restore()
+
+
+def test_cross_backend_convert_native_to_orbax_and_back(mesh8, tmp_path):
+    """VERDICT r1 #10: native save → orbax restore (via convert) and vice
+    versa are lossless, including optimizer state — the two backends stay
+    honestly drop-in. Post-restore push parity proves the state is live,
+    not just byte-equal."""
+    pytest.importorskip("orbax.checkpoint")
+    from minips_tpu.ckpt import convert_checkpoint
+    from minips_tpu.ckpt.orbax_backend import make_checkpointer
+
+    d1, s1 = _trained_tables(mesh8)
+    Checkpointer(str(tmp_path / "nat"), {"d": d1, "s": s1}).save(step=5)
+
+    # native → orbax: migrate through scratch tables, then restore into
+    # FRESH tables purely from the orbax copy
+    dm, sm = _trained_tables(mesh8)
+    assert convert_checkpoint(
+        str(tmp_path / "nat"), str(tmp_path / "orb"), {"d": dm, "s": sm},
+        src_backend="native", dst_backend="orbax") == 5
+    d2, s2 = _trained_tables(mesh8)
+    d2.push({"w": jnp.ones(8) * 50})  # diverge; restore must overwrite
+    ck = make_checkpointer(str(tmp_path / "orb"), {"d": d2, "s": s2},
+                           backend="orbax")
+    assert ck.restore() == 5
+    ck.close()
+    np.testing.assert_allclose(np.asarray(d2.params), np.asarray(d1.params),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s2.emb), np.asarray(s1.emb),
+                               rtol=1e-6)
+
+    # orbax → native, restored into fresh tables again
+    dn, sn = _trained_tables(mesh8)
+    assert convert_checkpoint(
+        str(tmp_path / "orb"), str(tmp_path / "nat2"), {"d": dn, "s": sn},
+        src_backend="orbax", dst_backend="native") == 5
+    d3, s3 = _trained_tables(mesh8)
+    Checkpointer(str(tmp_path / "nat2"), {"d": d3, "s": s3}).restore()
+    # optimizer state survived BOTH hops: identical further pushes give
+    # identical state (adam moments / adagrad accumulators intact)
+    for d, s in ((d1, s1), (d3, s3)):
+        d.push({"w": jnp.arange(8.0)})
+        s.push(jnp.array([2, 3]), jnp.ones((2, 4)))
+    np.testing.assert_allclose(np.asarray(d3.params), np.asarray(d1.params),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s3.emb), np.asarray(s1.emb),
+                               rtol=1e-6)

@@ -1,0 +1,18 @@
+"""`make -C cpp sanitize` — the asan/tsan drill for the native components
+(SURVEY.md §5.2, VERDICT r1 #8). Skips when no compiler is present."""
+
+import shutil
+import subprocess
+
+import pytest
+
+
+@pytest.mark.slow
+def test_native_components_clean_under_sanitizers():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    proc = subprocess.run(
+        ["make", "-C", "cpp", "sanitize"], capture_output=True, text=True,
+        timeout=600, cwd=str(__import__("pathlib").Path(__file__).resolve().parents[1]))
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    assert "asan + tsan clean" in proc.stdout
