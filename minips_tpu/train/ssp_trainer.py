@@ -286,4 +286,7 @@ class SSPTrainer:
         self._pending_push = np.zeros(self._nparam, np.float32)
         with self._inbox_lock:
             self._inbox.clear()
-        self.gossip.publish_local([self.clock])
+        # through the chokepoint: a restore on a retired trainer must not
+        # clobber the sentinel and re-gate peers on a worker that will
+        # never step again (gate.py RETIRED_CLOCK stickiness)
+        self._publish_clock()

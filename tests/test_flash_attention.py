@@ -180,6 +180,7 @@ def test_ring_flash_default_path_off_tpu():
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # fast tier: test_transformer_apply_flash_matches_reference
 def test_lm_sp_flash_trajectory_matches_reference():
     """lm_example --layout sp --attn flash trains to the same losses as
     --attn reference (ring flash is a drop-in inside the fused PS step)."""

@@ -141,6 +141,7 @@ class TestMoELM:
         rng = jax.random.PRNGKey(seed)
         return jax.random.randint(rng, (B, T), 0, self.CFG["vocab"])
 
+    @pytest.mark.slow  # fast tier: test_ep_matches_dense_oracle
     def test_ep_lm_matches_dense(self, mesh8, lm_params):
         from minips_tpu.models import transformer as tfm
 
@@ -157,6 +158,7 @@ class TestMoELM:
                                    rtol=2e-4, atol=2e-4)
         assert abs(float(aux_got) - float(aux_want)) < 1e-5
 
+    @pytest.mark.slow  # fast tier: test_ep_grads_match_dense
     def test_ep_lm_trains(self, mesh8, lm_params):
         """value_and_grad outside the shard_map; loss decreases."""
         import optax
@@ -256,6 +258,7 @@ class TestTopK:
         np.testing.assert_array_equal(a1, a2)
 
 
+@pytest.mark.slow  # app-level ep sweep; library ep parity stays fast
 def test_lm_example_ep_layout_trains(mesh8):
     """The ep layout trains the MoE-LM end-to-end from the app surface
     (experts sharded over the 8-device mesh, top-2 routing)."""

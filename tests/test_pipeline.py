@@ -80,6 +80,7 @@ def test_pp_logits_match_full(mesh_pp, params, M):
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow  # fast tier keeps pp logits parity (3 microbatch cfgs)
 def test_pp_grad_matches_full(mesh_pp, params):
     toks = _toks(4, 17, seed=1)
     sp = _stacked(params)

@@ -1,0 +1,116 @@
+"""Sharded-embedding collective traffic scales with touched rows, not table
+size (VERDICT round-1 task 6; SURVEY.md §7.4.2 hard part 2).
+
+The reference ships per-batch key/val slices through its Mailbox, never the
+table (SURVEY.md §3.3) — so a TPU rebuild whose sharded gather degraded to
+"all-gather the table" would be an asymptotic regression hiding behind
+GSPMD. These tests pin the compiled behavior: we lower the REAL
+SparseTable pull/push on the 8-device mesh, parse the partitioned HLO, and
+assert the collective payload is independent of table capacity and linear
+in the batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from minips_tpu.tables.sparse import SparseTable
+from minips_tpu.utils.comm_analysis import (collective_bytes, collective_ops,
+                                            traffic_report)
+
+DIM = 32
+BATCH = 512
+
+
+def _sharded_keys(mesh, batch):
+    return jax.device_put(
+        jnp.arange(batch, dtype=jnp.int32),
+        NamedSharding(mesh, P("data")))
+
+
+def _pull_bytes(mesh, slots, batch):
+    t = SparseTable(slots, DIM, mesh, updater="sgd")
+    comp = t._jit_pull.lower(t.emb, _sharded_keys(mesh, batch)).compile()
+    return collective_bytes(comp)
+
+
+def _push_bytes(mesh, slots, batch):
+    t = SparseTable(slots, DIM, mesh, updater="adagrad")
+    grads = jax.device_put(
+        jnp.ones((batch, DIM)), NamedSharding(mesh, P("data", None)))
+    comp = t._jit_push.lower(
+        t.emb, t.opt_state(), _sharded_keys(mesh, batch), grads).compile()
+    return collective_bytes(comp)
+
+
+def test_pull_traffic_independent_of_table_size(mesh8):
+    small = _pull_bytes(mesh8, 1 << 12, BATCH)
+    large = _pull_bytes(mesh8, 1 << 18, BATCH)  # 64x the capacity
+    assert small == large, (
+        f"pull collectives grew with table size: {small} -> {large}")
+    # and the traffic is batch-sized, nowhere near one table shard
+    table_shard_bytes = (1 << 18) * DIM * 4 // 8
+    assert large < table_shard_bytes / 8
+
+
+def test_push_traffic_independent_of_table_size(mesh8):
+    small = _push_bytes(mesh8, 1 << 12, BATCH)
+    large = _push_bytes(mesh8, 1 << 18, BATCH)
+    assert small == large, (
+        f"push collectives grew with table size: {small} -> {large}")
+
+
+def test_traffic_linear_in_batch(mesh8):
+    b1 = _pull_bytes(mesh8, 1 << 14, BATCH)
+    b4 = _pull_bytes(mesh8, 1 << 14, 4 * BATCH)
+    # linear within fuzz (key all-gather adds a small constant-ish term)
+    assert b1 * 3 < b4 <= b1 * 4 + 1024
+
+
+def test_no_table_sized_collective_op(mesh8):
+    """No single collective touches anything with the table's row count —
+    the literal 'did GSPMD all-gather the table' check."""
+    slots = 1 << 16
+    t = SparseTable(slots, DIM, mesh8)
+    comp = t._jit_pull.lower(t.emb, _sharded_keys(mesh8, BATCH)).compile()
+    for op in collective_ops(comp.as_text()):
+        assert str(slots) not in op.shape and str(slots // 8) not in op.shape, (
+            f"table-sized collective scheduled: {op}")
+
+
+def test_traffic_report_shape(mesh8):
+    t = SparseTable(1 << 12, DIM, mesh8)
+    comp = t._jit_pull.lower(t.emb, _sharded_keys(mesh8, BATCH)).compile()
+    rep = traffic_report(comp)
+    assert rep["total_bytes"] == sum(o["bytes"] for o in rep["ops"])
+    assert all(o["kind"] in ("all-gather", "all-reduce", "all-to-all",
+                             "reduce-scatter", "collective-permute")
+               for o in rep["ops"])
+    assert rep["total_bytes"] > 0  # a sharded gather must communicate
+
+
+def test_collective_parser_on_known_hlo():
+    """Parser unit-check against hand-written HLO lines: sync variadic
+    tuples sum, async start/done pairs count once, and a -start tuple
+    (operand alias + output) counts only the output — the real-TPU shape
+    of all-gather-start, where summing would ~double the payload."""
+    hlo = "\n".join([
+        "%ar = f32[128,64]{1,0} all-reduce(%x), replica_groups={}",
+        "%ag = (s32[8]{0}, s32[8]{0}) all-gather(%y)",
+        "%st = f32[256]{0} collective-permute-start(%z)",
+        "%dn = f32[256]{0} collective-permute-done(%st)",
+        "%ags = (f32[512,32]{1,0}, f32[4096,32]{1,0}) all-gather-start(%w)",
+        "%agd = f32[4096,32]{1,0} all-gather-done(%ags)",
+        "%not_a_collective = f32[999]{0} add(%a, %b)",
+    ])
+    ops = collective_ops(hlo)
+    kinds = sorted(o.kind for o in ops)
+    assert kinds == ["all-gather", "all-gather", "all-reduce",
+                     "collective-permute"]
+    total = sum(o.bytes for o in ops)
+    assert total == (128 * 64 * 4      # all-reduce
+                     + 2 * 8 * 4       # variadic sync all-gather: sums
+                     + 256 * 4         # permute start counted once
+                     + 4096 * 32 * 4)  # async start: output only

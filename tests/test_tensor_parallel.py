@@ -47,6 +47,7 @@ def test_tp_logits_match_full(mesh42, params):
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow  # fast tier keeps tp logits parity + dpxtp compose
 def test_tp_grad_matches_full(mesh42, params):
     toks = _toks(2, 17, seed=1)
 

@@ -53,6 +53,8 @@ def test_sp_forward_matches_full(mesh8, params):
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow  # heaviest compile; fast tier keeps sp-vs-dp grad
+# coverage via test_dp_and_sp_training_steps_match
 def test_sp_grad_matches_full(mesh8, params):
     """d(loss)/d(params) identical whether the sequence is sharded 8 ways
     (ring attention, pmean'd loss) or computed in one program."""
