@@ -144,3 +144,32 @@ def run_multiproc_body(rank: int, trainer, body) -> int:
         print(json.dumps({"rank": rank, "event": "gate_timeout",
                           "err": str(e)}), flush=True)
         return 43
+
+
+def emit_multiproc_done(trainer, rank: int, t0: float, losses,
+                        table_bytes: int, fingerprint: float,
+                        **extra) -> None:
+    """The launcher-protocol result line shared by every sharded-PS app:
+    the launcher harvests the LAST JSON line on stdout, smoke tests assert
+    these fields (replica agreement via param_fingerprint, 1/N memory via
+    local_bytes vs table_bytes, skew bound, wire accounting)."""
+    import json
+    import time
+
+    import numpy as np
+
+    print(json.dumps({
+        "rank": rank, "event": "done",
+        "wall_s": round(time.monotonic() - t0, 4),
+        "loss_first": losses[0] if losses else None,
+        "loss_last": float(np.mean(losses[-5:])) if losses else None,
+        "gate_waits": trainer.gate_waits,
+        "max_skew_seen": trainer.max_skew_seen,
+        "bytes_pushed": trainer.bytes_pushed,
+        "bytes_pulled": trainer.bytes_pulled,
+        "local_bytes": trainer.local_bytes(),
+        "table_bytes": int(table_bytes),
+        "param_fingerprint": fingerprint,
+        "clock": trainer.clock,
+        **extra,
+    }), flush=True)

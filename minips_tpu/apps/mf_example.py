@@ -48,19 +48,25 @@ def _make_tables(cfg, mesh, users=1024, items=2048):
             mk(next_pow2(items, 1 << 11), cfg.table.dim, seed=2, name="item"))
 
 
-def run(cfg: Config, args, metrics) -> dict:
+def _load_ratings(cfg, args) -> dict:
     path = getattr(args, "data_file", None)
     if path:  # real MovieLens ratings (csv/dat/u.data)
         from minips_tpu.data.movielens import read_ratings
         raw = read_ratings(path)
-        data = {k: raw[k] for k in ("user", "item", "rating")}
-    else:
-        data = synthetic.movielens_like(seed=cfg.train.seed)
+        return {k: raw[k] for k in ("user", "item", "rating")}
+    return synthetic.movielens_like(seed=cfg.train.seed)
+
+
+def run(cfg: Config, args, metrics) -> dict:
+    if getattr(args, "exec_mode", "spmd") == "multiproc":
+        return _run_multiproc(cfg, args, metrics)
+    data = _load_ratings(cfg, args)
     mesh = make_mesh()
     user_t, item_t = _make_tables(cfg, mesh,
                                   users=int(data["user"].max()) + 1,
                                   items=int(data["item"].max()) + 1)
-    data, holdout = holdout_split(data, getattr(args, "eval_frac", 0.0),
+    data, holdout = holdout_split(data,
+                                  getattr(args, "eval_frac", None) or 0.0,
                                   seed=cfg.train.seed)
 
     if getattr(args, "exec_mode", "spmd") == "threaded":
@@ -144,17 +150,124 @@ def _run_threaded(cfg, metrics, data, user_t, item_t, holdout=None) -> dict:
         user_t, item_t, metrics)
 
 
+def _run_multiproc(cfg: Config, args, metrics) -> dict:
+    """MF on the key-range-sharded PS: user/item factor tables PARTITIONED
+    across launcher processes (the reference's server-per-node MapStorage,
+    SURVEY.md §1 L2) with EXACT per-key rows — MovieLens ids are dense and
+    0-based, so the range partitioner owns them directly, no hashing. The
+    BASELINE config is ASP (BASELINE.json:9 "async ASP"): pulls are never
+    parked, pushes land whenever they arrive — the gate only engages under
+    --consistency bsp/ssp."""
+    import sys
+    import time
+
+    from minips_tpu.apps.common import (emit_multiproc_done, holdout_split,
+                                        init_multiproc, run_multiproc_body)
+    from minips_tpu.train.sharded_ps import ShardedPSTrainer, ShardedTable
+
+    rank, nprocs, bus, monitor, staleness = init_multiproc(
+        cfg.table.consistency, cfg.table.staleness)
+
+    full = _load_ratings(cfg, args)
+    # user/item universes are GLOBAL (every rank must agree on table
+    # sizes); the rating rows are what shards round-robin
+    num_users = int(full["user"].max()) + 1
+    num_items = int(full["item"].max()) + 1
+    data = {k: v[rank::nprocs] for k, v in full.items()}
+    frac = getattr(args, "eval_frac", None)
+    frac = 0.1 if frac is None else frac
+    data, holdout = holdout_split(data, frac, seed=cfg.train.seed)
+
+    # adam has no row-lazy server-side variant on the sharded PS; adagrad
+    # is the nearest adaptive updater (same substitution as wide_deep)
+    updater = "adagrad" if cfg.table.updater == "adam" else cfg.table.updater
+    dim = cfg.table.dim
+    mk = lambda name, rows, seed: ShardedTable(  # noqa: E731
+        name, rows, dim, bus, rank, nprocs, updater=updater,
+        lr=cfg.table.lr, init_scale=0.1, seed=seed, monitor=monitor,
+        pull_timeout=30.0)
+    user_t = mk("user", num_users, 1)
+    item_t = mk("item", num_items, 2)
+    trainer = ShardedPSTrainer({"user": user_t, "item": item_t}, bus,
+                               nprocs, staleness=staleness,
+                               gate_timeout=30.0, monitor=monitor)
+    bus.handshake(nprocs)
+
+    g = jax.jit(functools.partial(mf_model.grad_fn, mu=MU))
+    B = cfg.train.batch_size
+    rng = np.random.default_rng(rank)
+    losses = []
+    rmse = None
+    fp = 0.0
+    t0 = time.monotonic()
+
+    def body():
+        nonlocal rmse, fp
+        for _ in range(cfg.train.num_iters):
+            sel = rng.integers(0, data["rating"].shape[0], size=B)
+            u_keys, i_keys = data["user"][sel], data["item"][sel]
+            u_rows = user_t.pull(u_keys)
+            i_rows = item_t.pull(i_keys)
+            loss, gu, gi = g(jnp.asarray(u_rows), jnp.asarray(i_rows),
+                             {"rating": jnp.asarray(data["rating"][sel])})
+            # x B: per-sample server-add magnitude (see the spmd path's
+            # grad_scale and the threaded UDF — same rule here)
+            user_t.push(u_keys, np.asarray(gu) * float(B))
+            item_t.push(i_keys, np.asarray(gi) * float(B))
+            losses.append(float(loss))
+            trainer.tick()
+            if rank == getattr(args, "slow_rank", -1) \
+                    and getattr(args, "slow_ms", 0) > 0:
+                time.sleep(args.slow_ms / 1000.0)
+        trainer.finalize(timeout=30.0)
+        if holdout is not None and len(holdout["rating"]):
+            from minips_tpu.utils.evaluation import padded_chunks
+            n = len(holdout["rating"])
+            sq = 0.0
+            for chunk, n_valid in padded_chunks(holdout, 8192):
+                pred = np.asarray(mf_model.predict(
+                    jnp.asarray(user_t.pull(chunk["user"])),
+                    jnp.asarray(item_t.pull(chunk["item"])), mu=MU))
+                err = pred[:n_valid] - chunk["rating"][:n_valid]
+                sq += float(np.sum(err * err))
+            rmse = float(np.sqrt(sq / n))
+        fp = (float(np.sum(user_t.pull_all()))
+              + float(np.sum(item_t.pull_all())))
+        trainer.shutdown_barrier(timeout=10.0)
+
+    code = run_multiproc_body(rank, trainer, body)
+    if code == 0:
+        mult = 2 if updater == "adagrad" else 1
+        metrics.log(final_loss=losses[-1] if losses else None,
+                    holdout_rmse=rmse)
+        emit_multiproc_done(
+            trainer, rank, t0, losses,
+            (num_users + num_items) * dim * 4 * mult, fp, rmse=rmse)
+    monitor.stop()
+    bus.close()
+    if code:
+        sys.exit(code)
+    return {"losses": losses, "rmse": rmse}
+
+
 def _flags(parser):
     parser.add_argument("--data_file", default=None,
                         help="MovieLens ratings file (ratings.csv, "
                              "ratings.dat, or u.data) instead of synthetic")
-    parser.add_argument("--eval_frac", type=float, default=0.0,
-                        help="opt-in: fraction of ratings held out and "
-                             "scored by RMSE after training")
+    parser.add_argument("--eval_frac", type=float, default=None,
+                        help="fraction of ratings held out and scored by "
+                             "RMSE after training; 0 disables (default: 0 "
+                             "for spmd/threaded, 0.1 for multiproc)")
+    # multiproc straggler injection (smoke tests)
+    parser.add_argument("--slow-rank", dest="slow_rank", type=int,
+                        default=-1)
+    parser.add_argument("--slow-ms", dest="slow_ms", type=float,
+                        default=0.0)
 
 
 def main():
-    return app_main("mf_example", DEFAULT, run, extra_flags=_flags)
+    return app_main("mf_example", DEFAULT, run, extra_flags=_flags,
+                    exec_choices=("spmd", "threaded", "multiproc"))
 
 
 if __name__ == "__main__":

@@ -111,7 +111,6 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
     row-sparse, never a table-sized blob; the deep tower rides the dense
     range path; BSP/SSP/ASP via the owner-side staleness gate. Prints the
     one-JSON-line launcher protocol (smoke tests / bench)."""
-    import json
     import os
     import sys
     import time
@@ -120,8 +119,8 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
 
     import jax.numpy as jnp
 
-    from minips_tpu.apps.common import (holdout_split, init_multiproc,
-                                        run_multiproc_body)
+    from minips_tpu.apps.common import (emit_multiproc_done, holdout_split,
+                                        init_multiproc, run_multiproc_body)
     from minips_tpu.data import synthetic
     from minips_tpu.tables.sparse import hash_to_slots_np
     from minips_tpu.train.sharded_ps import (ShardedTable, ShardedPSTrainer)
@@ -250,25 +249,12 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
         # JSON line on stdout as the result dict
         metrics.log(final_loss=losses[-1] if losses else None,
                     holdout_auc=auc_val)
-        print(json.dumps({
-            "rank": rank, "event": "done",
-            "wall_s": round(time.monotonic() - t0, 4),
-            "loss_first": losses[0] if losses else None,
-            "loss_last": float(np.mean(losses[-5:])) if losses else None,
-            "auc": auc_val,
-            "gate_waits": trainer.gate_waits,
-            "max_skew_seen": trainer.max_skew_seen,
-            "bytes_pushed": trainer.bytes_pushed,
+        emit_multiproc_done(
+            trainer, rank, t0, losses, table_bytes, fp,
+            auc=auc_val,
             # embedding-table wire alone: the row-sparse claim is about
             # these (the deep tower is inherently dense-range traffic)
-            "sparse_bytes_pushed": (wide_t.bytes_pushed
-                                    + emb_t.bytes_pushed),
-            "bytes_pulled": trainer.bytes_pulled,
-            "local_bytes": trainer.local_bytes(),
-            "table_bytes": int(table_bytes),
-            "param_fingerprint": fp,
-            "clock": trainer.clock,
-        }), flush=True)
+            sparse_bytes_pushed=wide_t.bytes_pushed + emb_t.bytes_pushed)
     monitor.stop()
     bus.close()
     if code:

@@ -68,6 +68,8 @@ def _pair_batches(cfg, args, vocab=10_000):
 
 
 def run(cfg: Config, args, metrics) -> dict:
+    if getattr(args, "exec_mode", "spmd") == "multiproc":
+        return _run_multiproc(cfg, args, metrics)
     mesh = make_mesh()
     in_t = SparseTable(cfg.table.num_slots, cfg.table.dim, mesh, name="in",
                        updater=cfg.table.updater, lr=cfg.table.lr,
@@ -154,6 +156,99 @@ def _run_threaded(cfg, args, metrics, in_t, out_t) -> dict:
             "tables": (in_t, out_t)}
 
 
+def _run_multiproc(cfg: Config, args, metrics, vocab: int = 10_000) -> dict:
+    """Skip-gram negative sampling on the key-range-sharded PS: in/out
+    embedding tables partitioned across launcher processes by vocab-id
+    range — exact per-word rows, the reference's MapStorage-per-server.
+    Default consistency is ASP (BASELINE.json:11 "async push"): a pull
+    never parks and pushes land as they arrive, so a fast rank trains
+    ahead exactly like the reference's asynchronous word2vec; switch
+    --consistency ssp/bsp to bound or remove the drift."""
+    import sys
+    import time
+
+    import jax
+
+    from minips_tpu.apps.common import (emit_multiproc_done, init_multiproc,
+                                        run_multiproc_body)
+    from minips_tpu.train.sharded_ps import ShardedPSTrainer, ShardedTable
+
+    rank, nprocs, bus, monitor, staleness = init_multiproc(
+        cfg.table.consistency, cfg.table.staleness)
+
+    # tokenize once per rank (same corpus, deterministic), shard the PAIR
+    # stream round-robin; counts (and so the vocab + negative-sampling
+    # distribution) stay global and identical on every rank
+    centers, contexts, counts = _pairs(cfg, args, vocab)
+    centers, contexts = centers[rank::nprocs], contexts[rank::nprocs]
+    vocab = len(counts)
+
+    dim = cfg.table.dim
+    # adam → adagrad: same substitution as the other sharded-PS apps
+    updater = "adagrad" if cfg.table.updater == "adam" else cfg.table.updater
+    mk = lambda name, scale, seed: ShardedTable(  # noqa: E731
+        name, vocab, dim, bus, rank, nprocs, updater=updater,
+        lr=cfg.table.lr, init_scale=scale, seed=seed, monitor=monitor,
+        pull_timeout=30.0)
+    in_t = mk("in", 0.01, 1)
+    out_t = mk("out", 0.0, 2)
+    trainer = ShardedPSTrainer({"in": in_t, "out": out_t}, bus, nprocs,
+                               staleness=staleness, gate_timeout=30.0,
+                               monitor=monitor)
+    bus.handshake(nprocs)
+
+    import jax.numpy as jnp
+
+    g = jax.jit(w2v.grad_fn)
+    B = cfg.train.batch_size
+    batches = _batch_gen(cfg, centers, contexts, counts,
+                         cfg.train.seed + rank)
+    losses = []
+    fp = 0.0
+    t0 = time.monotonic()
+
+    def body():
+        nonlocal fp
+        for _ in range(cfg.train.num_iters):
+            b = next(batches)
+            out_keys = np.concatenate([b["pos"][:, None], b["neg"]],
+                                      axis=1)  # [B, 1+NEG]
+            c_rows = in_t.pull(b["center"])
+            o_rows = out_t.pull(out_keys.reshape(-1)).reshape(
+                B, 1 + NEG, dim)
+            loss, gc, gp, gn = g(jnp.asarray(c_rows),
+                                 jnp.asarray(o_rows[:, 0]),
+                                 jnp.asarray(o_rows[:, 1:]))
+            # x B: per-sample server-add magnitude (the classic per-pair
+            # SGNS update; matches grad_scale on the spmd path)
+            in_t.push(b["center"], np.asarray(gc) * float(B))
+            out_t.push(out_keys.reshape(-1),
+                       np.concatenate([np.asarray(gp)[:, None],
+                                       np.asarray(gn)], axis=1)
+                       .reshape(-1, dim) * float(B))
+            losses.append(float(loss))
+            trainer.tick()
+            if rank == getattr(args, "slow_rank", -1) \
+                    and getattr(args, "slow_ms", 0) > 0:
+                time.sleep(args.slow_ms / 1000.0)
+        trainer.finalize(timeout=30.0)
+        fp = (float(np.sum(in_t.pull_all()))
+              + float(np.sum(out_t.pull_all())))
+        trainer.shutdown_barrier(timeout=10.0)
+
+    code = run_multiproc_body(rank, trainer, body)
+    if code == 0:
+        mult = 2 if updater == "adagrad" else 1
+        metrics.log(final_loss=losses[-1] if losses else None)
+        emit_multiproc_done(trainer, rank, t0, losses,
+                            2 * vocab * dim * 4 * mult, fp)
+    monitor.stop()
+    bus.close()
+    if code:
+        sys.exit(code)
+    return {"losses": losses}
+
+
 def _flags(parser):
     parser.add_argument("--data_file", default=None,
                         help="text file (enwiki-style) tokenized at word "
@@ -162,10 +257,16 @@ def _flags(parser):
                         help="frequent-word subsampling threshold t "
                              "(classic 1e-5 for enwiki-scale corpora; "
                              "0 disables)")
+    # multiproc straggler injection (smoke tests)
+    parser.add_argument("--slow-rank", dest="slow_rank", type=int,
+                        default=-1)
+    parser.add_argument("--slow-ms", dest="slow_ms", type=float,
+                        default=0.0)
 
 
 def main():
-    return app_main("word2vec_example", DEFAULT, run, extra_flags=_flags)
+    return app_main("word2vec_example", DEFAULT, run, extra_flags=_flags,
+                    exec_choices=("spmd", "threaded", "multiproc"))
 
 
 if __name__ == "__main__":

@@ -34,7 +34,12 @@ def enable_compile_cache(cache_dir: str | None = None) -> str | None:
     path = (cache_dir
             or os.environ.get("MINIPS_COMPILE_CACHE")
             or os.path.expanduser("~/.cache/minips_tpu/xla"))
-    os.makedirs(path, exist_ok=True)
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        # unwritable/absent HOME (read-only CI sandboxes): run without a
+        # warm cache rather than aborting the caller at import time
+        return None
     jax.config.update("jax_compilation_cache_dir", path)
     # default thresholds skip sub-second compiles; the suite's cost is the
     # long tail of many 1-10s CPU compiles, so cache everything

@@ -233,3 +233,53 @@ def test_wide_deep_multiproc_asp_never_waits():
         assert r["loss_last"] < r["loss_first"], r
     fps = [r["param_fingerprint"] for r in res]
     assert max(fps) - min(fps) < 1e-4, fps
+
+
+@pytest.mark.slow
+def test_mf_multiproc_asp_partitioned_factors():
+    """MF (BASELINE config 3, 'async ASP') on the key-range-sharded PS:
+    user/item factor tables partitioned by id range (exact per-key rows,
+    no hashing), ASP pulls never gated, replicas agree after finalize,
+    holdout RMSE beats the rating scale's trivial spread."""
+    _PORT[0] += 6
+    res = launch.run_local_job(
+        3, [sys.executable, "-m", "minips_tpu.apps.mf_example",
+            "--exec", "multiproc", "--consistency", "asp",
+            "--num_iters", "80", "--batch_size", "256"],
+        base_port=_PORT[0],
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
+        timeout=300.0)
+    assert all(r["event"] == "done" for r in res)
+    for r in res:
+        assert r["gate_waits"] == 0       # ASP never blocks
+        assert r["loss_last"] < r["loss_first"], r
+        assert r["rmse"] is not None and r["rmse"] < 1.5, r["rmse"]
+        # factor tables partitioned: each process holds ~1/3
+        assert r["local_bytes"] * 3 <= r["table_bytes"] * 1.01 + 6 * 9 * 4
+    fps = [r["param_fingerprint"] for r in res]
+    assert max(fps) - min(fps) < 1e-4, fps
+
+
+@pytest.mark.slow
+def test_word2vec_multiproc_ssp_partitioned_vocab():
+    """Word2vec (BASELINE config 5, 'async push') on the sharded PS with
+    the vocab range-partitioned; run at SSP s=2 with a straggler to prove
+    the same gate bounds skew for the embedding workload too."""
+    _PORT[0] += 6
+    res = launch.run_local_job(
+        3, [sys.executable, "-m", "minips_tpu.apps.word2vec_example",
+            "--exec", "multiproc", "--consistency", "ssp",
+            "--staleness", "2", "--num_iters", "50", "--batch_size", "128",
+            "--slow-rank", "1", "--slow-ms", "25"],
+        base_port=_PORT[0],
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
+        timeout=300.0)
+    assert all(r["event"] == "done" for r in res)
+    for r in res:
+        assert r["loss_last"] < r["loss_first"], r
+        assert r["max_skew_seen"] <= 3    # s + 1
+        assert r["local_bytes"] * 3 <= r["table_bytes"] * 1.01 + 6 * 64 * 4
+    # the straggler actually engaged the gate on at least one fast rank
+    assert any(r["gate_waits"] > 0 for r in res), res
+    fps = [r["param_fingerprint"] for r in res]
+    assert max(fps) - min(fps) < 1e-4, fps
