@@ -146,6 +146,52 @@ def run_multiproc_body(rank: int, trainer, body) -> int:
         return 43
 
 
+def step_negotiator(bus, nprocs: int):
+    """Cross-rank agreement on which checkpoint step to resume from.
+
+    Shard checkpoints are rank-local (each process dumps its own row
+    range); a valid resume needs ONE global step every rank can restore —
+    shards restored at mixed steps would be a torn table. Ranks exchange
+    their FULL held-step lists and take the newest step in the
+    intersection: min-of-newest is not enough, because the checkpointer's
+    retention GC (keep=N) may already have deleted the straggler's newest
+    step on ranks that ran ahead (ASP, or SSP slack, lets survivors save
+    several steps past a corpse before detecting it). Returns 0 (fresh
+    start) when no common step exists. Call BEFORE ``bus.handshake``
+    (handler registration), then invoke the returned ``agree(my_steps)``
+    after it.
+    """
+    import threading
+    import time
+
+    held: dict[int, set] = {}
+    cond = threading.Condition()
+
+    def on_steps(sender, payload):
+        with cond:
+            held[sender] = set(int(s) for s in payload["steps"])
+            cond.notify_all()
+
+    bus.on("ckptSteps", on_steps)
+
+    def agree(my_steps, timeout: float = 10.0) -> int:
+        bus.publish("ckptSteps", {"steps": [int(s) for s in my_steps]})
+        deadline = time.monotonic() + timeout
+        with cond:
+            while len(held) < nprocs - 1:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "checkpoint-step negotiation timed out "
+                        f"(heard from {sorted(held)} of {nprocs - 1} peers)")
+                cond.wait(0.25)
+            common = set(int(s) for s in my_steps)
+            for s in held.values():
+                common &= s
+        return max(common, default=0)
+
+    return agree
+
+
 def emit_multiproc_done(trainer, rank: int, t0: float, losses,
                         table_bytes: int, fingerprint: float,
                         **extra) -> None:

@@ -98,16 +98,19 @@ def _score_holdout_rmse(out, holdout, user_t, item_t, metrics,
     the MovieLens-standard number (CTR apps use AUC instead). Streams the
     holdout in fixed-size chunks like utils.evaluation.evaluate_auc so a
     ML-20M-sized holdout never materializes one giant gather."""
-    if holdout is None:
+    if holdout is None or not len(holdout["rating"]):
         return out
     from minips_tpu.utils.evaluation import padded_chunks
 
     n = len(holdout["rating"])
     sq_err = 0.0
     for batch, n_valid in padded_chunks(holdout, chunk):
+        # .pull accepts raw key arrays on both table families (SparseTable
+        # jits + hashes; ShardedTable routes to owners) — this one scorer
+        # serves the spmd, threaded AND multiproc paths
         pred = np.asarray(mf_model.predict(
-            user_t.pull(jnp.asarray(batch["user"])),
-            item_t.pull(jnp.asarray(batch["item"])), mu=MU))
+            jnp.asarray(user_t.pull(batch["user"])),
+            jnp.asarray(item_t.pull(batch["item"])), mu=MU))
         err = pred[:n_valid] - batch["rating"][:n_valid]
         sq_err += float(np.sum(err * err))
     out["rmse"] = float(np.sqrt(sq_err / n))
@@ -220,17 +223,8 @@ def _run_multiproc(cfg: Config, args, metrics) -> dict:
                     and getattr(args, "slow_ms", 0) > 0:
                 time.sleep(args.slow_ms / 1000.0)
         trainer.finalize(timeout=30.0)
-        if holdout is not None and len(holdout["rating"]):
-            from minips_tpu.utils.evaluation import padded_chunks
-            n = len(holdout["rating"])
-            sq = 0.0
-            for chunk, n_valid in padded_chunks(holdout, 8192):
-                pred = np.asarray(mf_model.predict(
-                    jnp.asarray(user_t.pull(chunk["user"])),
-                    jnp.asarray(item_t.pull(chunk["item"])), mu=MU))
-                err = pred[:n_valid] - chunk["rating"][:n_valid]
-                sq += float(np.sum(err * err))
-            rmse = float(np.sqrt(sq / n))
+        rmse = _score_holdout_rmse({}, holdout, user_t, item_t,
+                                   metrics).get("rmse")
         fp = (float(np.sum(user_t.pull_all()))
               + float(np.sum(item_t.pull_all())))
         trainer.shutdown_barrier(timeout=10.0)
@@ -238,8 +232,7 @@ def _run_multiproc(cfg: Config, args, metrics) -> dict:
     code = run_multiproc_body(rank, trainer, body)
     if code == 0:
         mult = 2 if updater == "adagrad" else 1
-        metrics.log(final_loss=losses[-1] if losses else None,
-                    holdout_rmse=rmse)
+        metrics.log(final_loss=losses[-1] if losses else None)
         emit_multiproc_done(
             trainer, rank, t0, losses,
             (num_users + num_items) * dim * 4 * mult, fp, rmse=rmse)

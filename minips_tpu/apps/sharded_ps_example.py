@@ -50,6 +50,11 @@ def main(argv=None) -> int:
     ap.add_argument("--slow-ms", type=float, default=0.0)
     ap.add_argument("--kill-at", type=int, default=0)
     ap.add_argument("--kill-rank", type=int, default=-1)
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="per-rank shard checkpoints under "
+                         "<dir>/rank<r>/; on start, ranks negotiate the "
+                         "newest step ALL of them hold and resume there")
+    ap.add_argument("--checkpoint-every", type=int, default=0)
     args = ap.parse_args(argv)
 
     import jax
@@ -59,7 +64,8 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    from minips_tpu.apps.common import init_multiproc, run_multiproc_body
+    from minips_tpu.apps.common import (init_multiproc, run_multiproc_body,
+                                        step_negotiator)
     from minips_tpu.data import synthetic
     from minips_tpu.models import lr as lr_model
     from minips_tpu.tables.sparse import next_pow2
@@ -85,7 +91,25 @@ def main(argv=None) -> int:
     trainer = ShardedPSTrainer({"w": table}, bus, nprocs,
                                staleness=staleness, gate_timeout=30.0,
                                monitor=monitor)
+    negotiate = (step_negotiator(bus, nprocs)
+                 if args.checkpoint_dir else None)
     bus.handshake(nprocs)  # after ALL handlers are registered
+
+    # ---- shard checkpoint/resume (reference Dump/Load, SURVEY.md §3.5):
+    # every rank dumps ITS row range + the clock; resume restores the
+    # newest step every rank holds (min over ranks — shards restored at
+    # mixed steps would be a torn table)
+    ck = None
+    start_iter = 0
+    if args.checkpoint_dir:
+        from minips_tpu.ckpt.checkpoint import Checkpointer
+
+        ck = Checkpointer(os.path.join(args.checkpoint_dir, f"rank{rank}"),
+                          {"w": table, "trainer": trainer})
+        common = negotiate(ck.list_steps())
+        if common > 0:
+            ck.restore(common)  # trainer restore publishes the clock
+            start_iter = common
 
     if sparse:
         @jax.jit
@@ -104,13 +128,15 @@ def main(argv=None) -> int:
             return loss, g
 
     losses = []
-    rng = np.random.default_rng(rank)
+    # resumed runs reseed on (rank, start): batch sampling is with-
+    # replacement, so resume is convergence-equivalent, not bit-exact
+    rng = np.random.default_rng((rank, start_iter))
     final = None
     t0 = time.monotonic()
 
     def body():
         nonlocal final
-        for i in range(args.iters):
+        for i in range(start_iter, args.iters):
             if args.kill_at and rank == args.kill_rank and i == args.kill_at:
                 os._exit(137)
             sel = rng.integers(0, data["y"].shape[0], size=args.batch)
@@ -131,6 +157,9 @@ def main(argv=None) -> int:
                 table.push_dense(np.asarray(g) / nprocs)
             losses.append(float(loss))
             trainer.tick()
+            if ck is not None and args.checkpoint_every and \
+                    (i + 1) % args.checkpoint_every == 0:
+                ck.save(i + 1)  # clock == i+1 after tick
             if rank == args.slow_rank and args.slow_ms > 0:
                 time.sleep(args.slow_ms / 1000.0)
         trainer.finalize(timeout=20.0)
@@ -159,6 +188,7 @@ def main(argv=None) -> int:
             "param_sum": float(final.sum()),
             "param_norm": float(np.linalg.norm(final)),
             "clock": trainer.clock,
+            "resumed_from": start_iter,
         }), flush=True)
 
     monitor.stop()

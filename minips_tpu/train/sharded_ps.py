@@ -420,6 +420,13 @@ class ShardedTable:
                     raise ValueError("checkpoint lacks adagrad accumulator")
                 self._acc[...] = state["acc"]
 
+    # Checkpointer-protocol aliases: each process checkpoints ITS OWN
+    # shard (the reference dumps per-server KVTable state, SURVEY.md §3.5)
+    # into a rank-scoped directory — recovery = relaunch at the same world
+    # size, every rank reloading its range (ckpt/checkpoint.py interface).
+    state_dict = shard_state_dict
+    load_state_dict = load_shard_state_dict
+
 
 class ShardedPSTrainer:
     """Clock/gate/finalize driver over a set of ShardedTables — the Engine-
@@ -553,6 +560,25 @@ class ShardedPSTrainer:
                 self.gossip.exclude(p)
             if time.monotonic() > deadline:
                 return
+
+    # ------------------------------------------------------------ checkpoint
+    # The trainer is a "table" to ckpt.Checkpointer — PS state includes the
+    # clock (SURVEY.md §5.4 "checkpointing optimizer state + clock vector").
+    def state_dict(self) -> dict:
+        return {"clock": np.asarray(self.clock)}
+
+    def load_state_dict(self, state: dict) -> None:
+        from minips_tpu.consistency.gate import publish_clock
+
+        self.clock = int(state["clock"])
+        # publish the restored clock NOW (not at the first tick): a resumed
+        # rank's first pull is stamped with this clock, and owners park it
+        # until their view of every peer reaches clock - s — peers that
+        # haven't announced their restored clocks still read as 0. All
+        # ranks restore before stepping, so these publishes un-park each
+        # other; without them resume deadlocks at the first pull.
+        publish_clock(self.gossip, self.clock,
+                      getattr(self, "_retired", False))
 
     # ------------------------------------------------------------- metrics
     @property

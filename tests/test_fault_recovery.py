@@ -25,12 +25,13 @@ import pytest
 from minips_tpu import launch
 
 APP = "minips_tpu.apps.ssp_lr_example"
+SHARDED_APP = "minips_tpu.apps.sharded_ps_example"
 _PORT = [6100]
 
 
 def _run(n: int, extra: list[str], timeout: float = 240.0,
-         kill_on_failure: bool = False):
-    """Launch n workers; return (rc, per-rank JSON events)."""
+         kill_on_failure: bool = False, app: str = APP):
+    """Launch n workers of ``app``; return (rc, per-rank JSON events)."""
     _PORT[0] += n + 3
     hosts = ["localhost"] * n
     outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
@@ -39,7 +40,7 @@ def _run(n: int, extra: list[str], timeout: float = 240.0,
         env = launch.child_env(rank, hosts, _PORT[0])
         env.update({"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"})
         procs.append(subprocess.Popen(
-            [sys.executable, "-m", APP] + extra,
+            [sys.executable, "-m", app] + extra,
             env=env, stdout=outs[rank], stderr=subprocess.STDOUT))
     # kill_on_failure=False: survivors must detect the death THEMSELVES via
     # heartbeat — the launcher must not mercy-kill them first.
@@ -99,3 +100,41 @@ def test_clean_job_leaves_no_failure_events(tmp_path):
         assert ev[-1]["event"] == "done"
         assert all(e["event"] != "peer_failure" for e in ev)
     assert len([d for d in os.listdir(ckpt) if d.startswith("step_")]) == 2
+
+
+@pytest.mark.slow
+def test_sharded_ps_kill_detect_resume(tmp_path):
+    """The SAME drill on the key-range-sharded PS: every rank dumps ITS
+    OWN shard (per-rank checkpoint dirs); on relaunch the ranks negotiate
+    the newest step all of them hold, restore their shards there, and
+    finish with replica agreement — the reference's per-server Dump/Load
+    recovery (SURVEY.md §3.5) on the round-2 server topology."""
+    ckpt = str(tmp_path / "spck")
+    base = ["--model", "sparse", "--mode", "ssp", "--staleness", "2",
+            "--iters", "40", "--batch", "128",
+            "--checkpoint-dir", ckpt, "--checkpoint-every", "5"]
+
+    # --- phase 1: rank 2 dies at step 12 (checkpoints exist at 5, 10) ---
+    rc, events = _run(3, base + ["--kill-at", "12", "--kill-rank", "2"],
+                      app=SHARDED_APP)
+    assert rc != 0
+    survivors = [ev[-1] for r, ev in enumerate(events) if r != 2 and ev]
+    assert len(survivors) == 2, events
+    for ev in survivors:
+        assert ev["event"] == "peer_failure", events
+        assert 2 in ev["dead"]
+    for r in range(3):
+        steps = os.listdir(os.path.join(ckpt, f"rank{r}"))
+        assert "step_0000000010" in steps, (r, steps)
+
+    # --- phase 2: relaunch; negotiate the common step; resume ------------
+    rc, events = _run(3, base, app=SHARDED_APP)
+    assert rc == 0, events
+    dones = [ev[-1] for ev in events]
+    for d in dones:
+        assert d["event"] == "done", events
+        assert d["resumed_from"] == 10, d
+        assert d["clock"] == 40
+        assert d["max_skew_seen"] <= 3
+    sums = [d["param_sum"] for d in dones]
+    assert max(sums) - min(sums) < 1e-5, sums
