@@ -310,9 +310,9 @@ def bench_e2e(args, n_chips):
     import numpy as np
 
     from minips_tpu.data import synthetic
-    from minips_tpu.data.criteo import (log_transform, read_criteo,
-                                        write_criteo)
-    from minips_tpu.data.loader import BatchIterator, prefetch_to_device
+    from minips_tpu.data.criteo import (log_transform,
+                                        stream_criteo_batches, write_criteo)
+    from minips_tpu.data.loader import prefetch_to_device
     from minips_tpu.models import lr as lr_model
     from minips_tpu.models import mlp as mlp_model
     from minips_tpu.models import wide_deep as wd_model
@@ -372,22 +372,25 @@ def bench_e2e(args, n_chips):
         jax.block_until_ready(loss)
 
         t0 = time.perf_counter()
-        raw, native = None, False
-        try:  # native parser when actually available — flag what RAN
-            from minips_tpu.data.native import read_criteo_native
-            raw = read_criteo_native(path)
-            native = raw is not None
+        try:  # flag which parser actually RAN inside the stream
+            from minips_tpu.data.native import native_mem_available
+            native = native_mem_available()
         except ImportError:
-            pass
-        if raw is None:
-            raw = read_criteo(path, use_native=False)
-        data = {"dense": log_transform(raw["dense"], raw["dense_mask"]),
-                "cat": raw["cat"], "y": raw["y"]}
-        it = BatchIterator(data, B, seed=0, drop_last=True)
+            native = False
+
+        def xform(d):  # runs on the producer thread, off the train thread
+            return {"dense": log_transform(d["dense"], d["dense_mask"]),
+                    "cat": d["cat"], "y": d["y"]}
+
+        # streaming ingestion: blocks parse on a producer thread WHILE
+        # prior batches train — parse overlaps compute, working set is one
+        # block, never the file (the Criteo-1TB posture, SURVEY.md §7.4.4)
+        batches = stream_criteo_batches(path, B, chunk_bytes=4 << 20,
+                                        transform=xform)
         n_done = 0
         loss = None
         for batch in prefetch_to_device(
-                iter(it), lr_step.shard_batch, depth=2):
+                batches, lr_step.shard_batch, depth=2):
             lr_step(batch)
             loss = mlp_step(batch)
             n_done += B

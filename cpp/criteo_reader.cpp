@@ -164,6 +164,29 @@ int criteo_parse(const char* path, int64_t n_rows, float* y, float* dense,
   return criteo_parse_mt(path, n_rows, y, dense, dense_mask, cat, 1);
 }
 
+// In-memory variants for streaming ingestion: the Python producer thread
+// reads the file ONCE, sequentially, in line-aligned chunks and parses
+// each chunk straight from its buffer — no pre-scan of the whole file, no
+// re-reads, working set of one chunk (SURVEY.md §7.4.4; the Criteo-1TB
+// posture). Same strict error codes as the whole-file entries.
+int criteo_count_mem(const char* data, int64_t len, int64_t* n_rows) {
+  if (len < 0) return 1;
+  *n_rows = count_rows_range(data, data + len);
+  return 0;
+}
+
+int criteo_parse_mem(const char* data, int64_t len, int64_t max_rows,
+                     float* y, float* dense, float* dense_mask,
+                     int64_t* cat, int64_t* rows_done) {
+  if (len < 0) return 1;
+  std::memset(dense, 0,
+              sizeof(float) * static_cast<size_t>(max_rows * kDense));
+  std::memset(dense_mask, 0,
+              sizeof(float) * static_cast<size_t>(max_rows * kDense));
+  return parse_criteo_range(data, data + len, max_rows, y, dense,
+                            dense_mask, cat, rows_done);
+}
+
 // Multi-threaded variant: the file is split into line-aligned chunks, row
 // offsets come from a parallel counting pass, then chunks parse in
 // parallel into disjoint output slices. Same strict error codes.

@@ -174,6 +174,15 @@ def step_negotiator(bus, nprocs: int):
 
     bus.on("ckptSteps", on_steps)
 
+    ready: set = set()
+
+    def on_ready(sender, payload):
+        with cond:
+            ready.add(sender)
+            cond.notify_all()
+
+    bus.on("ckptReady", on_ready)
+
     def agree(my_steps, timeout: float = 10.0) -> int:
         bus.publish("ckptSteps", {"steps": [int(s) for s in my_steps]})
         deadline = time.monotonic() + timeout
@@ -189,7 +198,24 @@ def step_negotiator(bus, nprocs: int):
                 common &= s
         return max(common, default=0)
 
-    return agree
+    def restore_barrier(timeout: float = 30.0) -> None:
+        """Rendezvous AFTER every rank finished restoring its shard and
+        BEFORE anyone trains: under ASP (or SSP slack ≥ the restored
+        clock) a fast rank's first pushes could otherwise land in a
+        peer's shard mid-restore and be wiped by its ``_w[...] =``
+        overwrite — unbounded silent update loss unique to resume."""
+        bus.publish("ckptReady", {})
+        deadline = time.monotonic() + timeout
+        with cond:
+            while len(ready) < nprocs - 1:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "post-restore barrier timed out "
+                        f"(heard from {sorted(ready)} of {nprocs - 1} "
+                        "peers)")
+                cond.wait(0.25)
+
+    return agree, restore_barrier
 
 
 def emit_multiproc_done(trainer, rank: int, t0: float, losses,

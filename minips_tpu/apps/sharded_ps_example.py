@@ -104,12 +104,20 @@ def main(argv=None) -> int:
     if args.checkpoint_dir:
         from minips_tpu.ckpt.checkpoint import Checkpointer
 
+        agree, restore_barrier = negotiate
         ck = Checkpointer(os.path.join(args.checkpoint_dir, f"rank{rank}"),
                           {"w": table, "trainer": trainer})
-        common = negotiate(ck.list_steps())
+        common = agree(ck.list_steps())
+        # steps above the agreed one belong to a dead incarnation; left
+        # behind they could win a LATER negotiation with mixed-incarnation
+        # shards (torn table) — purge before training
+        ck.prune_above(common)
         if common > 0:
             ck.restore(common)  # trainer restore publishes the clock
             start_iter = common
+        # nobody trains until every rank's shard overwrite is done: an
+        # early rank's pushes into a mid-restore peer shard would be wiped
+        restore_barrier()
 
     if sparse:
         @jax.jit

@@ -90,6 +90,19 @@ class Checkpointer:
         for s in steps[: -self.keep] if self.keep > 0 else []:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
+    def prune_above(self, step: int) -> list[int]:
+        """Delete checkpoints NEWER than ``step`` and return the pruned
+        step numbers. Used after a cross-rank resume negotiation: local
+        steps above the agreed step belong to a dead incarnation — left
+        in place, a later crash could negotiate onto a step whose shards
+        mix incarnations (a torn table nothing would detect)."""
+        pruned = []
+        for s in self.list_steps():
+            if s > step:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+                pruned.append(s)
+        return pruned
+
     # --------------------------------------------------------------- restore
     def list_steps(self) -> list[int]:
         out = []

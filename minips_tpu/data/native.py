@@ -54,6 +54,22 @@ def _declare(lib: ctypes.CDLL) -> None:
     except AttributeError:
         lib.criteo_parse_mt = None
         lib.libsvm_parse_mt = None
+    try:  # in-memory streaming entry points (parse a bytes chunk)
+        lib.criteo_count_mem.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.criteo_count_mem.restype = ctypes.c_int
+        lib.criteo_parse_mem.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.criteo_parse_mem.restype = ctypes.c_int
+    except AttributeError:
+        lib.criteo_count_mem = None
+        lib.criteo_parse_mem = None
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -128,4 +144,43 @@ def read_criteo_native(path: str,
         rc = lib.criteo_parse(path.encode(), rows, y, dense, dense_mask, cat)
     if rc != 0:
         raise ValueError(f"criteo_parse failed with code {rc} on {path}")
+    return {"y": y, "dense": dense, "dense_mask": dense_mask, "cat": cat}
+
+
+def native_mem_available() -> bool:
+    """True when the in-memory Criteo entry points are loadable (bench and
+    tests report which parser actually ran)."""
+    lib = _load()
+    return lib is not None and getattr(lib, "criteo_parse_mem",
+                                       None) is not None
+
+
+def parse_criteo_bytes(data: bytes,
+                       where: str = "<bytes>") -> Optional[dict]:
+    """Parse a Criteo TSV chunk already in memory (whole lines). Returns
+    None when the native library (or the mem entry points) is
+    unavailable; the caller falls back to the Python line parser."""
+    from minips_tpu.data.criteo import NUM_CAT, NUM_DENSE
+
+    lib = _load()
+    if lib is None or getattr(lib, "criteo_parse_mem", None) is None:
+        return None
+    n = ctypes.c_int64()
+    if lib.criteo_count_mem(data, len(data), ctypes.byref(n)):
+        return None
+    rows = n.value
+    y = np.zeros(rows, np.float32)
+    dense = np.zeros((rows, NUM_DENSE), np.float32)
+    dense_mask = np.zeros((rows, NUM_DENSE), np.float32)
+    cat = np.zeros((rows, NUM_CAT), np.int64)
+    done = ctypes.c_int64()
+    rc = lib.criteo_parse_mem(data, len(data), rows, y, dense, dense_mask,
+                              cat, ctypes.byref(done))
+    if rc != 0:
+        raise ValueError(
+            f"criteo_parse_mem failed with code {rc} on {where}")
+    if done.value != rows:
+        raise ValueError(
+            f"criteo_parse_mem parsed {done.value} of {rows} rows on "
+            f"{where}")
     return {"y": y, "dense": dense, "dense_mask": dense_mask, "cat": cat}

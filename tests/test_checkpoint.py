@@ -337,3 +337,28 @@ def test_cross_backend_convert_native_to_orbax_and_back(mesh8, tmp_path):
                                rtol=1e-6)
     np.testing.assert_allclose(np.asarray(s3.emb), np.asarray(s1.emb),
                                rtol=1e-6)
+
+
+def test_prune_above_deletes_newer_steps(tmp_path):
+    """prune_above removes dead-incarnation checkpoints so a later resume
+    negotiation can never land on a mixed-incarnation step."""
+    from minips_tpu.ckpt.checkpoint import Checkpointer
+
+    class T:
+        def __init__(self):
+            self.v = np.zeros(4, np.float32)
+
+        def state_dict(self):
+            return {"v": self.v}
+
+        def load_state_dict(self, s):
+            self.v = s["v"]
+
+    ck = Checkpointer(str(tmp_path), {"t": T()}, keep=0)
+    for s in (5, 10, 15, 20):
+        ck.save(s)
+    assert ck.list_steps() == [5, 10, 15, 20]
+    assert ck.prune_above(10) == [15, 20]
+    assert ck.list_steps() == [5, 10]
+    assert ck.prune_above(10) == []  # idempotent
+    ck.restore(10)  # the kept step still restores
