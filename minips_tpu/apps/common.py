@@ -218,6 +218,51 @@ def step_negotiator(bus, nprocs: int):
     return agree, restore_barrier
 
 
+def shard_checkpointing(bus, nprocs: int, checkpoint_dir, rank: int):
+    """The sharded-PS apps' whole recovery bootstrap in one place (the
+    protocol is subtle enough that hand-synced copies would drift —
+    docs/architecture.md "Sharded-PS recovery protocol").
+
+    Call BEFORE ``bus.handshake`` (it registers the negotiation
+    handlers). Returns ``resume(tables, every)`` to call AFTER the
+    handshake, which negotiates the newest step every rank holds, prunes
+    dead-incarnation steps above it, restores, rendezvouses, and returns
+    ``(start_iter, save_hook)`` — call ``save_hook(i)`` after each
+    ``trainer.tick()`` (clock == i+1 there, which is what gets stamped).
+    With no ``checkpoint_dir`` the returned ``resume`` is a no-op
+    yielding ``(0, save_hook=no-op)``.
+    """
+    import os
+
+    if not checkpoint_dir:
+        return lambda tables, every=0: (0, lambda i: None)
+    agree, restore_barrier = step_negotiator(bus, nprocs)
+
+    def resume(tables: dict, every: int = 0):
+        from minips_tpu.ckpt.checkpoint import Checkpointer
+
+        ck = Checkpointer(os.path.join(checkpoint_dir, f"rank{rank}"),
+                          tables)
+        common = agree(ck.list_steps())
+        # steps above the agreed one belong to a dead incarnation; left
+        # behind they could win a LATER negotiation with mixed-incarnation
+        # shards (torn table) — purge before training
+        ck.prune_above(common)
+        if common > 0:
+            ck.restore(common)  # trainer restore publishes the clock
+        # nobody trains until every rank's shard overwrite is done: an
+        # early rank's pushes into a mid-restore peer shard would be wiped
+        restore_barrier()
+
+        def save_hook(i: int) -> None:
+            if every and (i + 1) % every == 0:
+                ck.save(i + 1)
+
+        return common, save_hook
+
+    return resume
+
+
 def emit_multiproc_done(trainer, rank: int, t0: float, losses,
                         table_bytes: int, fingerprint: float,
                         **extra) -> None:

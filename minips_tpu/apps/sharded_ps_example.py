@@ -65,7 +65,7 @@ def main(argv=None) -> int:
     import numpy as np
 
     from minips_tpu.apps.common import (init_multiproc, run_multiproc_body,
-                                        step_negotiator)
+                                        shard_checkpointing)
     from minips_tpu.data import synthetic
     from minips_tpu.models import lr as lr_model
     from minips_tpu.tables.sparse import next_pow2
@@ -91,33 +91,13 @@ def main(argv=None) -> int:
     trainer = ShardedPSTrainer({"w": table}, bus, nprocs,
                                staleness=staleness, gate_timeout=30.0,
                                monitor=monitor)
-    negotiate = (step_negotiator(bus, nprocs)
-                 if args.checkpoint_dir else None)
+    # shard checkpoint/resume (reference Dump/Load, SURVEY.md §3.5): the
+    # whole negotiate→prune→restore→rendezvous protocol lives in
+    # apps.common.shard_checkpointing, shared with the flagship W&D app
+    resume = shard_checkpointing(bus, nprocs, args.checkpoint_dir, rank)
     bus.handshake(nprocs)  # after ALL handlers are registered
-
-    # ---- shard checkpoint/resume (reference Dump/Load, SURVEY.md §3.5):
-    # every rank dumps ITS row range + the clock; resume restores the
-    # newest step every rank holds (min over ranks — shards restored at
-    # mixed steps would be a torn table)
-    ck = None
-    start_iter = 0
-    if args.checkpoint_dir:
-        from minips_tpu.ckpt.checkpoint import Checkpointer
-
-        agree, restore_barrier = negotiate
-        ck = Checkpointer(os.path.join(args.checkpoint_dir, f"rank{rank}"),
-                          {"w": table, "trainer": trainer})
-        common = agree(ck.list_steps())
-        # steps above the agreed one belong to a dead incarnation; left
-        # behind they could win a LATER negotiation with mixed-incarnation
-        # shards (torn table) — purge before training
-        ck.prune_above(common)
-        if common > 0:
-            ck.restore(common)  # trainer restore publishes the clock
-            start_iter = common
-        # nobody trains until every rank's shard overwrite is done: an
-        # early rank's pushes into a mid-restore peer shard would be wiped
-        restore_barrier()
+    start_iter, save_hook = resume({"w": table, "trainer": trainer},
+                                   args.checkpoint_every)
 
     if sparse:
         @jax.jit
@@ -165,9 +145,7 @@ def main(argv=None) -> int:
                 table.push_dense(np.asarray(g) / nprocs)
             losses.append(float(loss))
             trainer.tick()
-            if ck is not None and args.checkpoint_every and \
-                    (i + 1) % args.checkpoint_every == 0:
-                ck.save(i + 1)  # clock == i+1 after tick
+            save_hook(i)
             if rank == args.slow_rank and args.slow_ms > 0:
                 time.sleep(args.slow_ms / 1000.0)
         trainer.finalize(timeout=20.0)

@@ -120,7 +120,8 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
     import jax.numpy as jnp
 
     from minips_tpu.apps.common import (emit_multiproc_done, holdout_split,
-                                        init_multiproc, run_multiproc_body)
+                                        init_multiproc, run_multiproc_body,
+                                        shard_checkpointing)
     from minips_tpu.data import synthetic
     from minips_tpu.tables.sparse import hash_to_slots_np
     from minips_tpu.train.sharded_ps import (ShardedTable, ShardedPSTrainer)
@@ -166,10 +167,15 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
     trainer = ShardedPSTrainer(
         {"wide": wide_t, "emb": emb_t, "deep": deep_t}, bus, nprocs,
         staleness=staleness, gate_timeout=30.0, monitor=monitor)
+    resume = shard_checkpointing(bus, nprocs, cfg.train.checkpoint_dir,
+                                 rank)
     bus.handshake(nprocs)
     # the deep table stores the DELTA from a shared deterministic init
     # (every rank derives deep_flat0 from the same PRNGKey): the zero
     # table needs no init broadcast, and range pushes stay pure grads
+    start_iter, save_hook = resume(
+        {"wide": wide_t, "emb": emb_t, "deep": deep_t, "trainer": trainer},
+        cfg.train.checkpoint_every)
 
     @jax.jit
     def wd_grads(wide_rows, emb_rows, deep_vec, batch):
@@ -181,7 +187,9 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
         return (loss,) + grads
 
     B = cfg.train.batch_size
-    rng = np.random.default_rng(rank)
+    # resumed runs reseed on (rank, start): sampling is with-replacement,
+    # so resume is convergence-equivalent, not bit-exact
+    rng = np.random.default_rng((rank, start_iter))
     losses = []
     auc_val = None
     fp = 0.0
@@ -189,7 +197,7 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
 
     def body():
         nonlocal auc_val, fp
-        for i in range(cfg.train.num_iters):
+        for i in range(start_iter, cfg.train.num_iters):
             kill_at = getattr(args, "kill_at", 0)
             if kill_at and rank == getattr(args, "kill_rank", -1) \
                     and i == kill_at:
@@ -211,6 +219,7 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
             deep_t.push_dense(np.asarray(gd))
             losses.append(float(loss))
             trainer.tick()
+            save_hook(i)
             slow_rank = getattr(args, "slow_rank", -1)
             if rank == slow_rank and getattr(args, "slow_ms", 0) > 0:
                 time.sleep(args.slow_ms / 1000.0)
@@ -251,7 +260,7 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
                     holdout_auc=auc_val)
         emit_multiproc_done(
             trainer, rank, t0, losses, table_bytes, fp,
-            auc=auc_val,
+            auc=auc_val, resumed_from=start_iter,
             # embedding-table wire alone: the row-sparse claim is about
             # these (the deep tower is inherently dense-range traffic)
             sparse_bytes_pushed=wide_t.bytes_pushed + emb_t.bytes_pushed)
