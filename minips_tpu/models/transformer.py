@@ -379,18 +379,23 @@ def nll(logits, targets):
 
 
 def loss(params, batch, *, heads=4, compute_dtype=jnp.bfloat16,
-         attn_impl="reference"):
-    """Next-token cross-entropy; batch = {"tokens": [B, T+1] int32}."""
+         attn_impl="reference", remat=False):
+    """Next-token cross-entropy; batch = {"tokens": [B, T+1] int32}.
+    ``remat=True`` recomputes block activations in the backward pass —
+    activation memory stops scaling with depth, the standard trade for
+    fitting larger models (SURVEY brief: jax.checkpoint to trade FLOPs
+    for HBM)."""
     toks = batch["tokens"]
     logits = apply(params, toks[:, :-1], heads=heads,
-                   compute_dtype=compute_dtype, attn_impl=attn_impl)
+                   compute_dtype=compute_dtype, attn_impl=attn_impl,
+                   remat=remat)
     return nll(logits, toks[:, 1:])
 
 
-def grad_fn(params, batch, *, heads=4, attn_impl="reference"):
+def grad_fn(params, batch, *, heads=4, attn_impl="reference", remat=False):
     l, g = jax.value_and_grad(
-        lambda p, b: loss(p, b, heads=heads, attn_impl=attn_impl))(
-        params, batch)
+        lambda p, b: loss(p, b, heads=heads, attn_impl=attn_impl,
+                          remat=remat))(params, batch)
     return l, g
 
 
