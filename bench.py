@@ -209,7 +209,7 @@ def bench_lm(args, n_chips, peak):
 
     mesh = make_mesh()
     B, T = args.lm_batch, args.lm_seq
-    D, depth, heads = args.lm_dim, args.lm_depth, max(args.lm_dim // 64, 1)
+    D, depth, heads = args.lm_dim, args.lm_depth, args.lm_dim // 64
     vocab = 1 << 14
     params = tfm.init(jax.random.PRNGKey(0), vocab=vocab, dim=D,
                       heads=heads, depth=depth, max_len=T)

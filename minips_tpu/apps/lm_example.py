@@ -81,6 +81,10 @@ def _flags(parser):
     parser.add_argument("--resume", action="store_true",
                         help="dp/sp: restore newest checkpoint before "
                              "training")
+    parser.add_argument("--remat", action="store_true",
+                        help="recompute block activations in backward "
+                             "(jax.checkpoint): depth stops driving peak "
+                             "HBM — fits larger --dim/--depth (dp layout)")
     parser.add_argument("--attn", default="reference",
                         choices=["reference", "flash"],
                         help="dp/sp layout attention: full-scores XLA or "
@@ -143,6 +147,11 @@ def run(cfg: Config, args, metrics) -> dict:
             if getattr(args, flag, default) != default:
                 raise SystemExit(f"--{flag} is only wired into --layout "
                                  f"dp/sp (got {layout})")
+    if layout != "dp" and getattr(args, "remat", False):
+        # loss_sp's ring forward has its own memory story (T/N activations
+        # per shard); silently ignoring the flag would misreport memory
+        raise SystemExit(f"--remat is only wired into --layout dp "
+                         f"(got {layout})")
     if layout in ("tp", "pp"):
         return _run_model_parallel(cfg, args, metrics, layout, seq_len)
     if layout == "ep":
@@ -169,7 +178,8 @@ def run(cfg: Config, args, metrics) -> dict:
     if layout == "dp":
         step = table.make_step(
             functools.partial(tfm.grad_fn, heads=heads,
-                              attn_impl=getattr(args, "attn", "reference")),
+                              attn_impl=getattr(args, "attn", "reference"),
+                              remat=getattr(args, "remat", False)),
             batch_spec=P(DATA_AXIS), accum=accum,
             compute_dtype=compute_dtype, comm=comm)
         batch_sharding = NamedSharding(mesh, P(DATA_AXIS))

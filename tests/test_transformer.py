@@ -199,3 +199,45 @@ def test_remat_matches_no_remat(mesh8, params):
 
     l_sp = sp_loss(params)
     assert abs(float(l_sp) - float(l0)) < 1e-5
+
+
+def test_lm_example_remat_matches_no_remat(mesh8):
+    """--remat changes memory, not math: dp trajectories agree."""
+    import argparse
+
+    from minips_tpu.apps import lm_example as app
+    from minips_tpu.core.config import Config, TableConfig, TrainConfig
+    from minips_tpu.utils.metrics import MetricsLogger
+
+    cfg = Config(
+        table=TableConfig(name="lm", kind="dense", updater="adam", lr=3e-3),
+        train=TrainConfig(batch_size=16, num_iters=6, log_every=100),
+    )
+    finals = {}
+    for remat in (False, True):
+        out = app.run(cfg, argparse.Namespace(layout="dp", seq_len=32,
+                                              tp=2, microbatches=2,
+                                              remat=remat),
+                      MetricsLogger(None, verbose=False))
+        finals[remat] = out["losses"]
+    np.testing.assert_allclose(finals[False], finals[True],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_lm_example_remat_rejected_off_dp():
+    import argparse
+
+    import pytest as _pytest
+
+    from minips_tpu.apps import lm_example as app
+    from minips_tpu.core.config import Config, TableConfig, TrainConfig
+    from minips_tpu.utils.metrics import MetricsLogger
+
+    cfg = Config(
+        table=TableConfig(name="lm", kind="dense", updater="adam", lr=3e-3),
+        train=TrainConfig(batch_size=16, num_iters=2, log_every=100),
+    )
+    with _pytest.raises(SystemExit, match="remat"):
+        app.run(cfg, argparse.Namespace(layout="sp", seq_len=32, tp=2,
+                                        microbatches=2, remat=True),
+                MetricsLogger(None, verbose=False))
