@@ -62,6 +62,18 @@ def build(cfg: Config, *, use_fm: bool, mesh=None, seed: int = 0,
     return ps, (wide_t, emb_t, deep_t)
 
 
+def _make_predict(wide_t, emb_t, deep_params, use_fm: bool):
+    """Holdout scorer over the live tables + a pulled deep snapshot —
+    shared by the spmd and threaded paths so their AUC is computed by one
+    code path."""
+    def predict(b):
+        cats = jnp.asarray(b["cat"])
+        return wd_model.logits(
+            wide_t.pull(cats), emb_t.pull(cats), deep_params,
+            {"dense": jnp.asarray(b["dense"])}, use_fm=use_fm)
+    return predict
+
+
 def run(cfg: Config, args, metrics) -> dict:
     use_fm = getattr(args, "model", "widedeep") == "deepfm"
     if getattr(args, "exec_mode", "spmd") == "multiproc":
@@ -77,6 +89,9 @@ def run(cfg: Config, args, metrics) -> dict:
     data, holdout = holdout_split(data,
                                   getattr(args, "eval_frac", None) or 0.0,
                                   seed=cfg.train.seed)
+    if getattr(args, "exec_mode", "spmd") == "threaded":
+        return _run_threaded(cfg, args, metrics, data, holdout,
+                             use_fm=use_fm)
     ps, tables = build(cfg, use_fm=use_fm, seed=cfg.train.seed,
                        compute_dtype=(jnp.bfloat16
                                       if getattr(args, "dtype", "float32")
@@ -89,18 +104,71 @@ def run(cfg: Config, args, metrics) -> dict:
     metrics.log(final_loss=losses[-1],
                 samples_per_sec=loop.timer.samples_per_sec)
     wide_t, emb_t, deep_t = tables
-    deep_params = deep_t.pull()
-
-    def predict(b):
-        cats = jnp.asarray(b["cat"])
-        return wd_model.logits(
-            wide_t.pull(cats), emb_t.pull(cats), deep_params,
-            {"dense": jnp.asarray(b["dense"])}, use_fm=use_fm)
-
     return score_holdout(
-        predict, holdout,
+        _make_predict(wide_t, emb_t, deep_t.pull(), use_fm), holdout,
         {"losses": losses, "samples_per_sec": loop.timer.samples_per_sec,
          "tables": tables}, metrics)
+
+
+def _run_threaded(cfg: Config, args, metrics, data, holdout, *,
+                  use_fm: bool) -> dict:
+    """Reference-semantics worker threads for the flagship workload: each
+    thread pulls the batch's embedding rows + the deep tower through the
+    consistency gate, pushes grads, clocks — the threaded Engine path the
+    other apps already have (SURVEY.md §3.3 hot loop, thread-per-worker)."""
+    from minips_tpu.consistency import make_controller
+    from minips_tpu.core.engine import Engine
+    from minips_tpu.apps.common import threaded_train
+
+    if getattr(args, "dtype", "float32") != "float32":
+        # loud beats silently training f32 while reporting bf16 (same
+        # convention as lm_example's --remat off-dp rejection)
+        raise SystemExit("--dtype is only wired into --exec spmd/multiproc")
+    _, (wide_t, emb_t, deep_t) = build(cfg, use_fm=use_fm,
+                                       seed=cfg.train.seed)
+    engine = Engine(num_workers=cfg.train.num_workers).start_everything()
+    for name, t in (("wide", wide_t), ("emb", emb_t), ("deep", deep_t)):
+        engine.register_table(name, t, make_controller(
+            cfg.table.consistency, engine.num_workers,
+            staleness=cfg.table.staleness, sync_every=0))
+
+    @jax.jit
+    def g(wide_rows, emb_rows, deep_params, batch):
+        def f(w, e, dp):
+            return wd_model.loss(w, e, dp, batch, use_fm=use_fm)
+        loss, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(
+            wide_rows, emb_rows, deep_params)
+        return (loss,) + grads
+
+    NW = engine.num_workers
+
+    def step_fn(info, batch):
+        wt, et, dt = (info.table(n) for n in ("wide", "emb", "deep"))
+        cats = jnp.asarray(batch["cat"])
+        w_rows = wt.pull(keys=cats)  # [B, NUM_CAT, 1]
+        e_rows = et.pull(keys=cats)  # [B, NUM_CAT, dim]
+        deep_params = dt.pull()
+        loss, gw, ge, gd = g(w_rows, e_rows, deep_params,
+                             {"dense": jnp.asarray(batch["dense"]),
+                              "y": jnp.asarray(batch["y"])})
+        # NW workers each push once per clock; /NW keeps the per-round
+        # update magnitude equal to the spmd path's single mean-loss push
+        # for EVERY updater (adagrad normalizes constants away, sgd does
+        # not — unscaled pushes would be an NW-times effective lr)
+        wt.push(gw / NW, keys=cats)
+        et.push(ge / NW, keys=cats)
+        dt.push(jax.tree.map(lambda x: x / NW, gd))
+        return loss
+
+    mean_losses = threaded_train(engine, cfg, data, step_fn,
+                                 clock_tables=["wide", "emb", "deep"])
+    deep_params = deep_t.pull()
+    engine.stop_everything()
+    metrics.log(final_loss=mean_losses[-1])
+    return score_holdout(
+        _make_predict(wide_t, emb_t, deep_params, use_fm), holdout,
+        {"losses": mean_losses, "samples_per_sec": 0.0,
+         "tables": (wide_t, emb_t, deep_t)}, metrics)
 
 
 def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:

@@ -264,3 +264,26 @@ def test_lm_example_quantized_comm():
         finals[comm] = losses[-1]
     assert abs(finals["bfloat16"] - finals["float32"]) < 0.05, finals
     assert abs(finals["int8"] - finals["float32"]) < 0.15, finals
+
+
+def test_wide_deep_threaded_trains_with_gate():
+    """--exec threaded was silently falling through to the spmd path; now
+    the flagship runs the reference-semantics worker threads too: gated
+    pulls, per-key sparse pushes, dense tower split across pushers."""
+    from minips_tpu.apps import wide_deep_example as app
+    from minips_tpu.core.config import Config, TableConfig, TrainConfig
+
+    cfg = Config(
+        table=TableConfig(name="ctr", kind="sparse", consistency="ssp",
+                          staleness=2, updater="adagrad", lr=0.05, dim=8,
+                          num_slots=1 << 14),
+        train=TrainConfig(batch_size=256, num_iters=25, num_workers=3),
+    )
+    out = app.run(cfg, _args(exec_mode="threaded", model="deepfm",
+                             data_file=None, eval_frac=0.2,
+                             dtype="float32"),
+                  MetricsLogger(None, verbose=False))
+    losses = out["losses"]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    assert out["auc"] > 0.7, out["auc"]
