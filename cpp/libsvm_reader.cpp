@@ -84,9 +84,14 @@ int64_t count_rows_only(const char* p, const char* endp) {
 // Parse whole lines in [p, endp) into row-0-based outputs; reports rows
 // written and whether any label was negative (the {-1,1} convention —
 // normalization is a global post-pass, it cannot run per chunk).
+// ``strict``: a malformed line (non-numeric label, feat token without
+// ':', empty value) sets *malformed instead of silently fabricating a
+// zero row — the Python parser raises on such lines, and the block
+// ingestion path must be exactly as loud (test_data.py parity).
 int64_t parse_range(const char* p, const char* endp, int64_t max_rows,
                     int64_t width, float* y, int32_t* idx, float* val,
-                    float* mask, bool* saw_negative_label) {
+                    float* mask, bool* saw_negative_label,
+                    bool strict = false, bool* malformed = nullptr) {
   int64_t r = 0;
   while (p < endp && r < max_rows) {
     const char* line_end = static_cast<const char*>(
@@ -94,21 +99,43 @@ int64_t parse_range(const char* p, const char* endp, int64_t max_rows,
     if (!line_end) line_end = endp;
     p = skip_ws(p);
     if (p < line_end) {
+      const char* lp = p;
       float label = parse_float(p);
+      if (strict && p == lp) { *malformed = true; return r; }
       if (label < 0.0f) *saw_negative_label = true;
       y[r] = label;
       int64_t c = 0;
-      while (p < line_end && c < width) {
+      // strict keeps scanning past the width cap (stores nothing there):
+      // the Python parser tokenizes the WHOLE line before truncating, so
+      // garbage after the cap must be malformed on both paths
+      while (p < line_end && (c < width || strict)) {
         p = skip_ws(p);
         if (p >= line_end || *p == '\n') break;
+        const char* fp = p;
         long feature = parse_long(p);
-        if (*p != ':') break;  // malformed token: stop this row
+        if (*p != ':') {  // malformed token: stop this row
+          if (strict) { *malformed = true; return r; }
+          break;
+        }
+        if (strict && p == fp) { *malformed = true; return r; }
         ++p;
+        // the value must start HERE, on this line: strtof skips ALL
+        // leading whitespace including '\n', so an empty value at
+        // end-of-line would silently steal the next line's label
+        if (strict && (p >= line_end || *p == ' ' || *p == '\t'
+                       || *p == '\r' || *p == '\n')) {
+          *malformed = true;
+          return r;
+        }
+        const char* vp = p;
         float v = parse_float(p);
-        int64_t off = r * width + c;
-        idx[off] = static_cast<int32_t>(feature);
-        val[off] = v;
-        mask[off] = 1.0f;
+        if (strict && p == vp) { *malformed = true; return r; }
+        if (c < width) {
+          int64_t off = r * width + c;
+          idx[off] = static_cast<int32_t>(feature);
+          val[off] = v;
+          mask[off] = 1.0f;
+        }
         ++c;
       }
       ++r;
@@ -139,6 +166,43 @@ int libsvm_count(const char* path, int64_t* n_rows, int64_t* max_width) {
 int libsvm_parse(const char* path, int64_t n_rows, int64_t width,
                  float* y, int32_t* idx, float* val, float* mask) {
   return libsvm_parse_mt(path, n_rows, width, y, idx, val, mask, 1);
+}
+
+// In-memory variants for block/streaming ingestion (the criteo reader has
+// the same pair): parse a chunk of whole lines already in a buffer — the
+// distributed block path reads its assigned byte range once and parses it
+// natively instead of through the 6x-slower Python line parser. Label
+// normalization is per-chunk, exactly like the Python block parser
+// (data/libsvm.py parse_libsvm_lines).
+int libsvm_count_mem(const char* data, int64_t len, int64_t* n_rows) {
+  if (len < 0) return 1;
+  // rows-only: callers bring their own fixed width, so the per-byte ':'
+  // tokenization of count_range would be a wasted pass per block
+  *n_rows = count_rows_only(data, data + len);
+  return 0;
+}
+
+// rc 3 = malformed line — strict like the Python block parser, which
+// raises; the block ingestion path must never train on fabricated rows.
+int libsvm_parse_mem(const char* data, int64_t len, int64_t max_rows,
+                     int64_t width, float* y, int32_t* idx, float* val,
+                     float* mask, int64_t* rows_done) {
+  if (len < 0) return 1;
+  std::memset(idx, 0,
+              sizeof(int32_t) * static_cast<size_t>(max_rows * width));
+  std::memset(val, 0,
+              sizeof(float) * static_cast<size_t>(max_rows * width));
+  std::memset(mask, 0,
+              sizeof(float) * static_cast<size_t>(max_rows * width));
+  bool saw_neg = false;
+  bool malformed = false;
+  *rows_done = parse_range(data, data + len, max_rows, width, y, idx, val,
+                           mask, &saw_neg, true, &malformed);
+  if (malformed) return 3;
+  if (saw_neg)  // {-1,1} -> {0,1}, per chunk like the Python block parser
+    for (int64_t i = 0; i < *rows_done; ++i)
+      y[i] = y[i] > 0.0f ? 1.0f : 0.0f;
+  return 0;
 }
 
 // Multi-threaded variant: line-aligned chunks, parallel counting pass for

@@ -76,12 +76,17 @@ def split_file_lines(path: str, lines_per_block: int) -> list[Block]:
     return blocks
 
 
-def read_block_lines(block: Block) -> list[bytes]:
-    """Read one ``split_file_lines`` block back as its lines."""
+def read_block_bytes(block: Block) -> bytes:
+    """Read one ``split_file_lines`` block back as raw bytes (whole lines
+    by construction) — feed to a mem parser without a splitlines pass."""
     with open(block["path"], "rb") as f:
         f.seek(block["offset"])
-        raw = f.read(block["nbytes"])
-    return raw.splitlines()
+        return f.read(block["nbytes"])
+
+
+def read_block_lines(block: Block) -> list[bytes]:
+    """Read one ``split_file_lines`` block back as its lines."""
+    return read_block_bytes(block).splitlines()
 
 
 def iter_block_batches(client, parse_block, batch_size: int,

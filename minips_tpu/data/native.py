@@ -54,6 +54,22 @@ def _declare(lib: ctypes.CDLL) -> None:
     except AttributeError:
         lib.criteo_parse_mt = None
         lib.libsvm_parse_mt = None
+    try:  # in-memory libsvm entry points (parse a bytes chunk)
+        lib.libsvm_count_mem.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.libsvm_count_mem.restype = ctypes.c_int
+        lib.libsvm_parse_mem.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.libsvm_parse_mem.restype = ctypes.c_int
+    except AttributeError:
+        lib.libsvm_count_mem = None
+        lib.libsvm_parse_mem = None
     try:  # in-memory streaming entry points (parse a bytes chunk)
         lib.criteo_count_mem.argtypes = [
             ctypes.c_char_p, ctypes.c_int64,
@@ -145,6 +161,35 @@ def read_criteo_native(path: str,
     if rc != 0:
         raise ValueError(f"criteo_parse failed with code {rc} on {path}")
     return {"y": y, "dense": dense, "dense_mask": dense_mask, "cat": cat}
+
+
+def parse_libsvm_bytes(data: bytes, width: int,
+                       where: str = "<bytes>") -> Optional[dict]:
+    """Parse a libsvm chunk already in memory to the padded block schema
+    (fixed ``width``). Returns None when the native library (or the mem
+    entry points) is unavailable — the caller falls back to the Python
+    line parser. Per-chunk {-1,1}→{0,1} label normalization, matching
+    data/libsvm.py ``parse_libsvm_lines``."""
+    lib = _load()
+    if lib is None or getattr(lib, "libsvm_parse_mem", None) is None:
+        return None
+    n = ctypes.c_int64()
+    if lib.libsvm_count_mem(data, len(data), ctypes.byref(n)):
+        return None
+    rows = n.value
+    y = np.zeros(rows, np.float32)
+    idx = np.zeros((rows, width), np.int32)
+    val = np.zeros((rows, width), np.float32)
+    mask = np.zeros((rows, width), np.float32)
+    done = ctypes.c_int64()
+    rc = lib.libsvm_parse_mem(data, len(data), rows, width, y, idx, val,
+                              mask, ctypes.byref(done))
+    if rc != 0 or done.value != rows:
+        # rc 3 = malformed line — strict like the Python parser's raise
+        raise ValueError(
+            f"libsvm_parse_mem parsed {done.value}/{rows} rows "
+            f"(rc={rc}) on {where}")
+    return {"y": y, "idx": idx, "val": val, "mask": mask}
 
 
 def native_mem_available() -> bool:

@@ -417,3 +417,45 @@ def test_stream_criteo_batches_abandonment_stops_producer(tmp_path):
             break
         time.sleep(0.05)
     assert not leaked, leaked
+
+
+def test_libsvm_block_parse_native_matches_python(tmp_path):
+    """parse_libsvm_block (native mem parse) is byte-identical to the
+    Python line parser on block-shaped chunks, including fixed-width
+    truncation and the per-chunk {-1,1}->{0,1} label normalization."""
+    from minips_tpu.data.blocks import read_block_bytes, split_file_lines
+    from minips_tpu.data.libsvm import (parse_libsvm_block,
+                                        parse_libsvm_lines, write_libsvm)
+    from minips_tpu.data import synthetic
+
+    from minips_tpu.data.native import parse_libsvm_bytes
+
+    if parse_libsvm_bytes(b"1 2:3.0\n", 4) is None:
+        pytest.skip("native lib unavailable")  # else native==python vacuously
+    d = synthetic.classification_sparse(600, dim=500, nnz_per_row=7,
+                                        seed=21)
+    path = str(tmp_path / "b.libsvm")
+    y_pm = np.where(d["y"] > 0, 1.0, -1.0)  # a9a-style ±1 labels
+    write_libsvm(path, y_pm, d["idx"], d["val"], d["mask"])
+    for b in split_file_lines(path, 111):
+        raw = read_block_bytes(b)
+        want = parse_libsvm_lines(raw.splitlines(), width=5)  # truncating
+        got = parse_libsvm_bytes(raw, 5)  # the native path, directly
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+        got_py = parse_libsvm_block(raw, width=5, use_native=False)
+        for k in want:
+            np.testing.assert_array_equal(got_py[k], want[k], err_msg=k)
+    # strictness parity: malformed lines raise on BOTH paths instead of
+    # fabricating rows (the block path must never train on garbage)
+    for bad in (
+        b"1 2:3.0\nnotanumber\n-1 1:1.0\n",  # non-numeric label
+        b"1 2:\n0 3:1.5\n",      # empty value at EOL (strtof would skip
+                                 # the newline and steal the next label)
+        b"1 1:1 2:1 3:1 junk\n",  # garbage beyond the width cap
+        b"1 2:3:4\n",            # double-colon token
+    ):
+        with pytest.raises(ValueError):
+            parse_libsvm_bytes(bad, 2)
+        with pytest.raises(ValueError):
+            parse_libsvm_block(bad, width=2, use_native=False)
