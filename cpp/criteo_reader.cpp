@@ -175,14 +175,13 @@ int criteo_count_mem(const char* data, int64_t len, int64_t* n_rows) {
   return 0;
 }
 
+// CONTRACT: dense/dense_mask must arrive ZERO-INITIALIZED (np.zeros at
+// the ctypes caller) — missing fields only skip writes, and a memset here
+// would re-dirty copy-on-write-zero pages on the hot per-chunk path.
 int criteo_parse_mem(const char* data, int64_t len, int64_t max_rows,
                      float* y, float* dense, float* dense_mask,
                      int64_t* cat, int64_t* rows_done) {
   if (len < 0) return 1;
-  std::memset(dense, 0,
-              sizeof(float) * static_cast<size_t>(max_rows * kDense));
-  std::memset(dense_mask, 0,
-              sizeof(float) * static_cast<size_t>(max_rows * kDense));
   return parse_criteo_range(data, data + len, max_rows, y, dense,
                             dense_mask, cat, rows_done);
 }

@@ -117,7 +117,13 @@ int64_t parse_range(const char* p, const char* endp, int64_t max_rows,
           if (strict) { *malformed = true; return r; }
           break;
         }
-        if (strict && p == fp) { *malformed = true; return r; }
+        if (strict && (p == fp || feature > INT32_MAX
+                       || feature < INT32_MIN)) {
+          // the Python oracle raises OverflowError on indices that don't
+          // fit int32; silently wrapping would scatter to wrong features
+          *malformed = true;
+          return r;
+        }
         ++p;
         // the value must start HERE, on this line: strtof skips ALL
         // leading whitespace including '\n', so an empty value at
@@ -184,16 +190,14 @@ int libsvm_count_mem(const char* data, int64_t len, int64_t* n_rows) {
 
 // rc 3 = malformed line — strict like the Python block parser, which
 // raises; the block ingestion path must never train on fabricated rows.
+// CONTRACT: idx/val/mask must arrive ZERO-INITIALIZED (np.zeros at the
+// ctypes caller) — sparse rows only write their nnz slots, and a memset
+// here would re-dirty pages calloc left copy-on-write-zero, wasting
+// bandwidth on the hot per-block path.
 int libsvm_parse_mem(const char* data, int64_t len, int64_t max_rows,
                      int64_t width, float* y, int32_t* idx, float* val,
                      float* mask, int64_t* rows_done) {
   if (len < 0) return 1;
-  std::memset(idx, 0,
-              sizeof(int32_t) * static_cast<size_t>(max_rows * width));
-  std::memset(val, 0,
-              sizeof(float) * static_cast<size_t>(max_rows * width));
-  std::memset(mask, 0,
-              sizeof(float) * static_cast<size_t>(max_rows * width));
   bool saw_neg = false;
   bool malformed = false;
   *rows_done = parse_range(data, data + len, max_rows, width, y, idx, val,

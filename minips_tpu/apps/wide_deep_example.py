@@ -62,6 +62,42 @@ def build(cfg: Config, *, use_fm: bool, mesh=None, seed: int = 0,
     return ps, (wide_t, emb_t, deep_t)
 
 
+def _run_streaming(cfg: Config, args, metrics, path: str, *,
+                   use_fm: bool) -> dict:
+    """One-pass streaming training: the Criteo file is NEVER resident —
+    a producer thread parses ~4MB chunks while earlier batches train
+    (data/criteo.py stream_criteo_batches; the Criteo-1TB posture). The
+    loop ends at min(num_iters, file exhaustion). Holdout eval needs
+    resident rows, so --eval_frac is rejected loudly here."""
+    if getattr(args, "eval_frac", None):
+        raise SystemExit("--eval_frac needs resident rows; it is not "
+                         "available with --stream (run a separate "
+                         "non-stream eval pass)")
+    from minips_tpu.data.criteo import log_transform, stream_criteo_batches
+
+    ps, tables = build(cfg, use_fm=use_fm, seed=cfg.train.seed,
+                       compute_dtype=(jnp.bfloat16
+                                      if getattr(args, "dtype", "float32")
+                                      == "bfloat16" else None))
+
+    def xform(d):  # producer-thread preprocessing
+        return {"dense": log_transform(d["dense"], d["dense_mask"]),
+                "cat": d["cat"], "y": d["y"]}
+
+    batches = stream_criteo_batches(path, cfg.train.batch_size,
+                                    transform=xform)
+    loop = TrainLoop(lambda b: ps(ps.shard_batch(b)), batches,
+                     metrics=metrics, log_every=cfg.train.log_every,
+                     batch_size=cfg.train.batch_size)
+    losses = loop.run(cfg.train.num_iters)
+    metrics.log(final_loss=losses[-1] if losses else None,
+                samples_per_sec=loop.timer.samples_per_sec,
+                streamed=True)
+    return {"losses": losses,
+            "samples_per_sec": loop.timer.samples_per_sec,
+            "tables": tables}
+
+
 def _make_predict(wide_t, emb_t, deep_params, use_fm: bool):
     """Holdout scorer over the live tables + a pulled deep snapshot —
     shared by the spmd and threaded paths so their AUC is computed by one
@@ -76,9 +112,18 @@ def _make_predict(wide_t, emb_t, deep_params, use_fm: bool):
 
 def run(cfg: Config, args, metrics) -> dict:
     use_fm = getattr(args, "model", "widedeep") == "deepfm"
+    if getattr(args, "stream", False) \
+            and getattr(args, "exec_mode", "spmd") != "spmd":
+        # loud beats silently dropping either flag (same convention as
+        # _run_threaded's --dtype rejection)
+        raise SystemExit("--stream is only wired into --exec spmd")
     if getattr(args, "exec_mode", "spmd") == "multiproc":
         return _run_multiproc(cfg, args, metrics, use_fm=use_fm)
     path = getattr(args, "data_file", None)
+    if path and getattr(args, "stream", False):
+        return _run_streaming(cfg, args, metrics, path, use_fm=use_fm)
+    if getattr(args, "stream", False):
+        raise SystemExit("--stream needs --data_file (a file to stream)")
     if path:  # real Criteo TSV through the native/python reader
         from minips_tpu.data.criteo import log_transform, read_criteo
         raw = read_criteo(path)
@@ -344,6 +389,11 @@ def _flags(parser):
                         choices=["widedeep", "deepfm"])
     parser.add_argument("--data_file", default=None,
                         help="Criteo TSV file instead of synthetic data")
+    parser.add_argument("--stream", action="store_true",
+                        help="one-pass streaming read of --data_file: a "
+                             "producer thread parses chunks while training "
+                             "runs; the file is never resident (Criteo-1TB "
+                             "posture). Ends at min(num_iters, EOF)")
     parser.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16"],
                         help="worker-math precision (master tables stay "

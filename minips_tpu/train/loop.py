@@ -75,7 +75,14 @@ class TrainLoop:
         for i in range(num_iters):
             if self.profiler is not None:
                 self.profiler.on_step(i)
-            batch = next(it)
+            try:
+                batch = next(it)
+            except StopIteration:
+                # finite sources (one-pass streams) end the loop cleanly;
+                # BatchIterator-style sources cycle and never raise
+                self.metrics.log(event="stream_exhausted",
+                                 step=self.step_offset + i)
+                break
             loss = self.step(batch)
             n = (self.batch_size if self.batch_size is not None
                  else _leading_dim(batch))

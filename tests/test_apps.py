@@ -287,3 +287,33 @@ def test_wide_deep_threaded_trains_with_gate():
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
     assert out["auc"] > 0.7, out["auc"]
+
+
+def test_wide_deep_stream_one_pass(tmp_path):
+    """--stream trains from a one-pass producer-thread read: loss falls,
+    the loop ends at EOF when num_iters overshoots the file, and
+    --eval_frac is rejected loudly (rows are never resident)."""
+    from minips_tpu.apps import wide_deep_example as app
+    from minips_tpu.data.criteo import write_criteo
+
+    d = synthetic.criteo_like(4096, seed=9)
+    dense = np.round(np.abs(d["dense"]) * 5).astype(np.float32)
+    path = str(tmp_path / "c.tsv")
+    write_criteo(path, d["y"], dense, d["cat"])
+
+    cfg = Config(
+        table=TableConfig(name="ctr", kind="sparse", updater="adagrad",
+                          lr=0.05, dim=4, num_slots=1 << 12),
+        train=TrainConfig(batch_size=256, num_iters=999, log_every=100),
+    )
+    out = app.run(cfg, _args(model="deepfm", data_file=path, stream=True,
+                             eval_frac=None), MetricsLogger(None,
+                                                            verbose=False))
+    losses = out["losses"]
+    assert len(losses) == 4096 // 256  # ended at EOF, not at 999
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    with pytest.raises(SystemExit, match="eval_frac"):
+        app.run(cfg, _args(model="deepfm", data_file=path, stream=True,
+                           eval_frac=0.2), MetricsLogger(None,
+                                                         verbose=False))

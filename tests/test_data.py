@@ -454,8 +454,12 @@ def test_libsvm_block_parse_native_matches_python(tmp_path):
                                  # the newline and steal the next label)
         b"1 1:1 2:1 3:1 junk\n",  # garbage beyond the width cap
         b"1 2:3:4\n",            # double-colon token
+        b"1 3000000000:1.0\n",   # index overflows int32 (python:
+                                 # OverflowError; native must not wrap)
     ):
         with pytest.raises(ValueError):
             parse_libsvm_bytes(bad, 2)
-        with pytest.raises(ValueError):
+        # python raises OverflowError for the int32-overflow case and
+        # ValueError otherwise — loud either way
+        with pytest.raises((ValueError, OverflowError)):
             parse_libsvm_block(bad, width=2, use_native=False)
