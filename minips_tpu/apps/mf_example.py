@@ -161,6 +161,7 @@ def _run_multiproc(cfg: Config, args, metrics) -> dict:
     BASELINE config is ASP (BASELINE.json:9 "async ASP"): pulls are never
     parked, pushes land whenever they arrive — the gate only engages under
     --consistency bsp/ssp."""
+    import os
     import sys
     import time
 
@@ -194,11 +195,17 @@ def _run_multiproc(cfg: Config, args, metrics) -> dict:
     trainer = ShardedPSTrainer({"user": user_t, "item": item_t}, bus,
                                nprocs, staleness=staleness,
                                gate_timeout=30.0, monitor=monitor)
+    from minips_tpu.apps.common import shard_checkpointing
+    resume = shard_checkpointing(bus, nprocs, cfg.train.checkpoint_dir,
+                                 rank)
     bus.handshake(nprocs)
+    start_iter, save_hook = resume(
+        {"user": user_t, "item": item_t, "trainer": trainer},
+        cfg.train.checkpoint_every)
 
     g = jax.jit(functools.partial(mf_model.grad_fn, mu=MU))
     B = cfg.train.batch_size
-    rng = np.random.default_rng(rank)
+    rng = np.random.default_rng((rank, start_iter))
     losses = []
     rmse = None
     fp = 0.0
@@ -206,7 +213,11 @@ def _run_multiproc(cfg: Config, args, metrics) -> dict:
 
     def body():
         nonlocal rmse, fp
-        for _ in range(cfg.train.num_iters):
+        for i in range(start_iter, cfg.train.num_iters):
+            if getattr(args, "kill_at", 0) \
+                    and rank == getattr(args, "kill_rank", -1) \
+                    and i == args.kill_at:
+                os._exit(137)
             sel = rng.integers(0, data["rating"].shape[0], size=B)
             u_keys, i_keys = data["user"][sel], data["item"][sel]
             u_rows = user_t.pull(u_keys)
@@ -219,6 +230,7 @@ def _run_multiproc(cfg: Config, args, metrics) -> dict:
             item_t.push(i_keys, np.asarray(gi) * float(B))
             losses.append(float(loss))
             trainer.tick()
+            save_hook(i)
             if rank == getattr(args, "slow_rank", -1) \
                     and getattr(args, "slow_ms", 0) > 0:
                 time.sleep(args.slow_ms / 1000.0)
@@ -235,7 +247,8 @@ def _run_multiproc(cfg: Config, args, metrics) -> dict:
         metrics.log(final_loss=losses[-1] if losses else None)
         emit_multiproc_done(
             trainer, rank, t0, losses,
-            (num_users + num_items) * dim * 4 * mult, fp, rmse=rmse)
+            (num_users + num_items) * dim * 4 * mult, fp, rmse=rmse,
+            resumed_from=start_iter)
     monitor.stop()
     bus.close()
     if code:
@@ -251,11 +264,14 @@ def _flags(parser):
                         help="fraction of ratings held out and scored by "
                              "RMSE after training; 0 disables (default: 0 "
                              "for spmd/threaded, 0.1 for multiproc)")
-    # multiproc straggler injection (smoke tests)
+    # multiproc straggler/fault injection (smoke tests)
     parser.add_argument("--slow-rank", dest="slow_rank", type=int,
                         default=-1)
     parser.add_argument("--slow-ms", dest="slow_ms", type=float,
                         default=0.0)
+    parser.add_argument("--kill-at", dest="kill_at", type=int, default=0)
+    parser.add_argument("--kill-rank", dest="kill_rank", type=int,
+                        default=-1)
 
 
 def main():

@@ -164,6 +164,7 @@ def _run_multiproc(cfg: Config, args, metrics, vocab: int = 10_000) -> dict:
     never parks and pushes land as they arrive, so a fast rank trains
     ahead exactly like the reference's asynchronous word2vec; switch
     --consistency ssp/bsp to bound or remove the drift."""
+    import os
     import sys
     import time
 
@@ -195,21 +196,33 @@ def _run_multiproc(cfg: Config, args, metrics, vocab: int = 10_000) -> dict:
     trainer = ShardedPSTrainer({"in": in_t, "out": out_t}, bus, nprocs,
                                staleness=staleness, gate_timeout=30.0,
                                monitor=monitor)
+    from minips_tpu.apps.common import shard_checkpointing
+    resume = shard_checkpointing(bus, nprocs, cfg.train.checkpoint_dir,
+                                 rank)
     bus.handshake(nprocs)
+    start_iter, save_hook = resume(
+        {"in": in_t, "out": out_t, "trainer": trainer},
+        cfg.train.checkpoint_every)
 
     import jax.numpy as jnp
 
     g = jax.jit(w2v.grad_fn)
     B = cfg.train.batch_size
+    # resumed runs reseed on start_iter: sampling is with-replacement, so
+    # resume is convergence-equivalent, not bit-exact
     batches = _batch_gen(cfg, centers, contexts, counts,
-                         cfg.train.seed + rank)
+                         (cfg.train.seed + rank, start_iter))
     losses = []
     fp = 0.0
     t0 = time.monotonic()
 
     def body():
         nonlocal fp
-        for _ in range(cfg.train.num_iters):
+        for i in range(start_iter, cfg.train.num_iters):
+            if getattr(args, "kill_at", 0) \
+                    and rank == getattr(args, "kill_rank", -1) \
+                    and i == args.kill_at:
+                os._exit(137)
             b = next(batches)
             out_keys = np.concatenate([b["pos"][:, None], b["neg"]],
                                       axis=1)  # [B, 1+NEG]
@@ -228,6 +241,7 @@ def _run_multiproc(cfg: Config, args, metrics, vocab: int = 10_000) -> dict:
                        .reshape(-1, dim) * float(B))
             losses.append(float(loss))
             trainer.tick()
+            save_hook(i)
             if rank == getattr(args, "slow_rank", -1) \
                     and getattr(args, "slow_ms", 0) > 0:
                 time.sleep(args.slow_ms / 1000.0)
@@ -241,7 +255,8 @@ def _run_multiproc(cfg: Config, args, metrics, vocab: int = 10_000) -> dict:
         mult = 2 if updater == "adagrad" else 1
         metrics.log(final_loss=losses[-1] if losses else None)
         emit_multiproc_done(trainer, rank, t0, losses,
-                            2 * vocab * dim * 4 * mult, fp)
+                            2 * vocab * dim * 4 * mult, fp,
+                            resumed_from=start_iter)
     monitor.stop()
     bus.close()
     if code:
@@ -257,11 +272,14 @@ def _flags(parser):
                         help="frequent-word subsampling threshold t "
                              "(classic 1e-5 for enwiki-scale corpora; "
                              "0 disables)")
-    # multiproc straggler injection (smoke tests)
+    # multiproc straggler/fault injection (smoke tests)
     parser.add_argument("--slow-rank", dest="slow_rank", type=int,
                         default=-1)
     parser.add_argument("--slow-ms", dest="slow_ms", type=float,
                         default=0.0)
+    parser.add_argument("--kill-at", dest="kill_at", type=int, default=0)
+    parser.add_argument("--kill-rank", dest="kill_rank", type=int,
+                        default=-1)
 
 
 def main():

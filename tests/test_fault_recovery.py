@@ -172,3 +172,35 @@ def test_wide_deep_multiproc_kill_detect_resume(tmp_path):
         assert d["auc"] is None or d["auc"] > 0.6
     fps = [d["param_fingerprint"] for d in dones]
     assert max(fps) - min(fps) < 1e-4, fps
+
+
+@pytest.mark.slow
+def test_mf_multiproc_kill_detect_resume(tmp_path):
+    """The negotiated shard resume on MF's exact-per-id factor tables
+    (word2vec's in/out tables are structurally identical — two pure
+    ShardedTables + the trainer clock — so this drill covers that shape
+    once for both apps)."""
+    ckpt = str(tmp_path / "mfck")
+    base = ["--exec", "multiproc", "--consistency", "ssp",
+            "--staleness", "2", "--num_iters", "30", "--batch_size", "256",
+            "--checkpoint_dir", ckpt, "--checkpoint_every", "5"]
+    app = "minips_tpu.apps.mf_example"
+
+    rc, events = _run(3, base + ["--kill-at", "12", "--kill-rank", "1"],
+                      app=app)
+    assert rc != 0
+    survivors = [ev[-1] for r, ev in enumerate(events) if r != 1 and ev]
+    assert len(survivors) == 2 and all(
+        ev["event"] == "peer_failure" and 1 in ev["dead"]
+        for ev in survivors), events
+
+    rc, events = _run(3, base, app=app)
+    assert rc == 0, events
+    dones = [ev[-1] for ev in events]
+    for d in dones:
+        assert d["event"] == "done", events
+        assert d["resumed_from"] == 10, d
+        assert d["clock"] == 30
+        assert d["rmse"] is not None and d["rmse"] < 1.5
+    fps = [d["param_fingerprint"] for d in dones]
+    assert max(fps) - min(fps) < 1e-4, fps
