@@ -387,8 +387,9 @@ def bench_e2e(args, n_chips):
         # streaming ingestion: blocks parse on a producer thread WHILE
         # prior batches train — parse overlaps compute, working set is one
         # block, never the file (the Criteo-1TB posture, SURVEY.md §7.4.4)
+        stream_stats: dict = {}
         batches = stream_criteo_batches(path, B, chunk_bytes=4 << 20,
-                                        transform=xform)
+                                        transform=xform, stats=stream_stats)
         n_done = 0
         loss = None
         for batch in prefetch_to_device(
@@ -404,6 +405,9 @@ def bench_e2e(args, n_chips):
         os.unlink(path)
     return {"samples_per_sec_per_chip": round(n_done / dt / n_chips, 1),
             "rows": n_done, "native_parser": native,
+            # no-silent-caps: rows short of a final batch (0 when the break
+            # above fires before EOF — the stream was abandoned, not short)
+            "dropped_rows": stream_stats.get("dropped_rows", 0),
             "includes_io": True}
 
 

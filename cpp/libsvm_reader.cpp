@@ -38,11 +38,14 @@ inline float parse_float(const char*& p) {
   return v;
 }
 
-inline long parse_long(const char*& p) {
+// int64_t, not long: on LP32 platforms strtol saturates at INT32_MAX (with
+// only errno set), which would defeat the int32-overflow guard below —
+// strtoll keeps the comparison platform-independent.
+inline int64_t parse_long(const char*& p) {
   char* end = nullptr;
-  long v = std::strtol(p, &end, 10);
+  long long v = std::strtoll(p, &end, 10);
   p = end;
-  return v;
+  return static_cast<int64_t>(v);
 }
 
 // rows + max nnz width over whole lines in [p, endp).
@@ -112,7 +115,7 @@ int64_t parse_range(const char* p, const char* endp, int64_t max_rows,
         p = skip_ws(p);
         if (p >= line_end || *p == '\n') break;
         const char* fp = p;
-        long feature = parse_long(p);
+        int64_t feature = parse_long(p);
         if (*p != ':') {  // malformed token: stop this row
           if (strict) { *malformed = true; return r; }
           break;

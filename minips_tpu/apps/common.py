@@ -284,6 +284,8 @@ def emit_multiproc_done(trainer, rank: int, t0: float, losses,
         "max_skew_seen": trainer.max_skew_seen,
         "bytes_pushed": trainer.bytes_pushed,
         "bytes_pulled": trainer.bytes_pulled,
+        # a dropped frame is a silently-lost gradient — smokes assert 0
+        "frames_dropped": trainer.frames_dropped,
         "local_bytes": trainer.local_bytes(),
         "table_bytes": int(table_bytes),
         "param_fingerprint": fingerprint,

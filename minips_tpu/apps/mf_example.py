@@ -182,9 +182,7 @@ def _run_multiproc(cfg: Config, args, metrics) -> dict:
     frac = 0.1 if frac is None else frac
     data, holdout = holdout_split(data, frac, seed=cfg.train.seed)
 
-    # adam has no row-lazy server-side variant on the sharded PS; adagrad
-    # is the nearest adaptive updater (same substitution as wide_deep)
-    updater = "adagrad" if cfg.table.updater == "adam" else cfg.table.updater
+    updater = cfg.table.updater  # sgd/adagrad/adam all server-side now
     dim = cfg.table.dim
     mk = lambda name, rows, seed: ShardedTable(  # noqa: E731
         name, rows, dim, bus, rank, nprocs, updater=updater,
@@ -243,11 +241,11 @@ def _run_multiproc(cfg: Config, args, metrics) -> dict:
 
     code = run_multiproc_body(rank, trainer, body)
     if code == 0:
-        mult = 2 if updater == "adagrad" else 1
+        from minips_tpu.train.sharded_ps import table_state_bytes
+        table_bytes = table_state_bytes(num_users + num_items, dim, updater)
         metrics.log(final_loss=losses[-1] if losses else None)
         emit_multiproc_done(
-            trainer, rank, t0, losses,
-            (num_users + num_items) * dim * 4 * mult, fp, rmse=rmse,
+            trainer, rank, t0, losses, table_bytes, fp, rmse=rmse,
             resumed_from=start_iter)
     monitor.stop()
     bus.close()

@@ -43,7 +43,8 @@ def main(argv=None) -> int:
                          "key-space size, rounded up to a power of two "
                          "(default 2^14)")
     ap.add_argument("--lr", type=float, default=0.1)
-    ap.add_argument("--updater", choices=["sgd", "adagrad"], default="sgd")
+    ap.add_argument("--updater", choices=["sgd", "adagrad", "adam"],
+                    default="sgd")
     ap.add_argument("--mode", choices=["bsp", "ssp", "asp"], default="ssp")
     ap.add_argument("--staleness", type=int, default=2)
     ap.add_argument("--slow-rank", type=int, default=-1)
@@ -159,7 +160,8 @@ def main(argv=None) -> int:
 
     code = run_multiproc_body(rank, trainer, body)
     if code == 0:
-        table_bytes = final.nbytes * (2 if args.updater == "adagrad" else 1)
+        from minips_tpu.train.sharded_ps import table_state_bytes
+        table_bytes = table_state_bytes(num_rows, 1, args.updater)
         print(json.dumps({
             "rank": rank, "event": "done",
             "wall_s": round(time.monotonic() - t0, 4),
@@ -169,6 +171,7 @@ def main(argv=None) -> int:
             "max_skew_seen": trainer.max_skew_seen,
             "bytes_pushed": trainer.bytes_pushed,
             "bytes_pulled": trainer.bytes_pulled,
+            "frames_dropped": trainer.frames_dropped,
             "local_bytes": trainer.local_bytes(),
             "table_bytes": int(table_bytes),
             "param_sum": float(final.sum()),

@@ -84,14 +84,18 @@ def _run_streaming(cfg: Config, args, metrics, path: str, *,
         return {"dense": log_transform(d["dense"], d["dense_mask"]),
                 "cat": d["cat"], "y": d["y"]}
 
+    stream_stats: dict = {}
     batches = stream_criteo_batches(path, cfg.train.batch_size,
-                                    transform=xform)
+                                    transform=xform, stats=stream_stats)
     loop = TrainLoop(lambda b: ps(ps.shard_batch(b)), batches,
                      metrics=metrics, log_every=cfg.train.log_every,
                      batch_size=cfg.train.batch_size)
     losses = loop.run(cfg.train.num_iters)
     metrics.log(final_loss=losses[-1] if losses else None,
                 samples_per_sec=loop.timer.samples_per_sec,
+                # no-silent-caps: rows short of one final batch (absent
+                # when num_iters ended the loop before EOF)
+                stream_dropped_rows=stream_stats.get("dropped_rows"),
                 streamed=True)
     return {"losses": losses,
             "samples_per_sec": loop.timer.samples_per_sec,
@@ -260,7 +264,7 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
 
     slots = cfg.table.num_slots
     emb_dim = cfg.table.dim
-    updater = "adagrad" if cfg.table.updater == "adam" else cfg.table.updater
+    updater = cfg.table.updater  # sgd/adagrad/adam all server-side now
     mk = lambda name, dim, scale, seed: ShardedTable(  # noqa: E731
         name, slots, dim, bus, rank, nprocs, updater=updater,
         lr=cfg.table.lr, init_scale=scale, seed=seed, monitor=monitor,
@@ -363,10 +367,12 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
 
     code = run_multiproc_body(rank, trainer, body)
     if code == 0:
-        sparse_mult = 2 if updater == "adagrad" else 1
+        from minips_tpu.train.sharded_ps import table_state_bytes
         # deep table is always adagrad server-side (shard + accumulator)
-        table_bytes = (slots * (1 + emb_dim) * 4 * sparse_mult
-                       + deep_flat0.shape[0] * 4 * 2)
+        table_bytes = (table_state_bytes(slots, 1, updater)        # wide
+                       + table_state_bytes(slots, emb_dim, updater)  # emb
+                       + table_state_bytes(deep_flat0.shape[0], 1,
+                                           "adagrad"))             # deep
         # metrics BEFORE the protocol line: the launcher harvests the LAST
         # JSON line on stdout as the result dict
         metrics.log(final_loss=losses[-1] if losses else None,

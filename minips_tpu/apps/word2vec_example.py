@@ -185,8 +185,7 @@ def _run_multiproc(cfg: Config, args, metrics, vocab: int = 10_000) -> dict:
     vocab = len(counts)
 
     dim = cfg.table.dim
-    # adam → adagrad: same substitution as the other sharded-PS apps
-    updater = "adagrad" if cfg.table.updater == "adam" else cfg.table.updater
+    updater = cfg.table.updater  # sgd/adagrad/adam all server-side now
     mk = lambda name, scale, seed: ShardedTable(  # noqa: E731
         name, vocab, dim, bus, rank, nprocs, updater=updater,
         lr=cfg.table.lr, init_scale=scale, seed=seed, monitor=monitor,
@@ -252,10 +251,10 @@ def _run_multiproc(cfg: Config, args, metrics, vocab: int = 10_000) -> dict:
 
     code = run_multiproc_body(rank, trainer, body)
     if code == 0:
-        mult = 2 if updater == "adagrad" else 1
+        from minips_tpu.train.sharded_ps import table_state_bytes
+        table_bytes = table_state_bytes(2 * vocab, dim, updater)
         metrics.log(final_loss=losses[-1] if losses else None)
-        emit_multiproc_done(trainer, rank, t0, losses,
-                            2 * vocab * dim * 4 * mult, fp,
+        emit_multiproc_done(trainer, rank, t0, losses, table_bytes, fp,
                             resumed_from=start_iter)
     monitor.stop()
     bus.close()

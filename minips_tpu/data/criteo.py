@@ -144,14 +144,16 @@ def parse_criteo_chunk(data: bytes, use_native: bool = True,
 def stream_criteo_batches(path: str, batch_size: int, *,
                           chunk_bytes: int = 8 << 20,
                           use_native: bool = True, prefetch: int = 2,
-                          transform=None):
+                          transform=None, stats: dict | None = None):
     """Streaming ingestion: a producer thread reads the file ONCE,
     sequentially, in ~``chunk_bytes`` line-aligned chunks and parses each
     straight from memory while the consumer trains on earlier batches —
     parse overlaps compute, the first batch exists after one chunk, and
     the working set is one chunk, never the file (SURVEY.md §7.4.4; the
     Criteo-1TB posture). Yields dict batches of exactly ``batch_size``
-    rows (tails carry across chunks; a final short batch is dropped).
+    rows (tails carry across chunks; a final short batch is dropped — pass
+    ``stats={}`` to read back ``stats["dropped_rows"]`` after exhaustion,
+    the repo's no-silent-caps convention).
     ``transform(block_dict) -> block_dict`` runs ON THE PRODUCER THREAD
     (e.g. log_transform of dense), keeping that cost off the training
     thread too. Abandoning the generator (close/GC/exception) stops the
@@ -223,6 +225,8 @@ def stream_criteo_batches(path: str, batch_size: int, *,
             while pos + batch_size <= n:
                 yield {k: v[pos:pos + batch_size] for k, v in buf.items()}
                 pos += batch_size
+        if stats is not None:  # rows short of one final batch, dropped
+            stats["dropped_rows"] = (len(buf["y"]) - pos) if buf else 0
     finally:
         stop.set()
 

@@ -11,9 +11,9 @@ frames, not full-model broadcasts. This replaces the replicated delta relay
   sharded identically — PS state *is* optimizer state);
 - wire traffic per push is the touched rows, split by owner (the sparse
   Criteo/W&D case ships only the batch's embedding rows, SURVEY.md §7.4.2);
-- the server applies the updater (SGD/Adagrad, reference ``updater->
-  Update(keys, grads)`` semantics with duplicate keys summed first) on
-  receipt, exactly the reference's server-side optimizer;
+- the server applies the updater (SGD/Adagrad/lazy-Adam, reference
+  ``updater->Update(keys, grads)`` semantics with duplicate keys summed
+  first) on receipt, exactly the reference's server-side optimizer;
 - consistency is the same StalenessGate + ClockGossip as the delta relay —
   BSP/SSP/ASP admission is unchanged (consistency/gate.py).
 
@@ -32,8 +32,9 @@ promise this: the pusher→owner link and the pusher→reader clock broadcast
 are different links).
 
 Numerics: the server-side numpy updaters match ops/sparse_update.py's
-row_sgd/row_adagrad (sum-duplicates-then-update) bit-for-bit at f32 — the
-parity tests in tests/test_sharded_ps.py assert it against those oracles.
+row_sgd/row_adagrad/row_adam (sum-duplicates-then-update; lazy moments for
+adam) bit-for-bit at f32 — the parity tests in tests/test_sharded_ps.py
+assert it against those oracles.
 """
 
 from __future__ import annotations
@@ -48,7 +49,21 @@ from minips_tpu.comm.bus import ClockGossip
 from minips_tpu.consistency.gate import PeerFailureError, StalenessGate
 from minips_tpu.parallel.partition import RangePartitioner
 
-__all__ = ["ShardedTable", "ShardedPSTrainer", "PeerFailureError"]
+__all__ = ["ShardedTable", "ShardedPSTrainer", "PeerFailureError",
+           "table_state_bytes"]
+
+
+def table_state_bytes(num_rows: int, dim: int, updater: str) -> int:
+    """Whole-table bytes of weights + optimizer state for one table — the
+    accounting twin of ``ShardedTable.local_bytes`` summed over all shards
+    (modulo partition padding). The apps' smoke protocol compares
+    ``local_bytes * N <= table_bytes`` against this ONE formula so a state-
+    layout change can't leave stale copies behind."""
+    mult = {"sgd": 1, "adagrad": 2, "adam": 3}[updater]
+    n = num_rows * dim * 4 * mult
+    if updater == "adam":  # per-row lazy step counters (int32)
+        n += num_rows * 4
+    return n
 
 
 class ShardedTable:
@@ -75,14 +90,17 @@ class ShardedTable:
         updater: str = "sgd",
         lr: float = 0.05,
         adagrad_init: float = 0.1,
-        eps: float = 1e-10,
+        eps: Optional[float] = None,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
         init_scale: float = 0.0,
         seed: int = 0,
         pull_timeout: float = 30.0,
         monitor=None,
     ):
-        if updater not in ("sgd", "adagrad"):
-            raise ValueError("sharded-PS updater must be 'sgd' or 'adagrad'")
+        if updater not in ("sgd", "adagrad", "adam"):
+            raise ValueError(
+                "sharded-PS updater must be 'sgd', 'adagrad' or 'adam'")
         self.name = name
         self.num_rows = int(num_rows)
         self.dim = int(dim)
@@ -91,7 +109,12 @@ class ShardedTable:
         self.num_processes = num_processes
         self.updater = updater
         self.lr = lr
-        self.eps = eps
+        # defaults match the jax oracles (ops/sparse_update.py): adagrad
+        # divides by sqrt(accum)+1e-10, adam by sqrt(v_hat)+1e-8
+        self.eps = (1e-8 if updater == "adam" else 1e-10) \
+            if eps is None else eps
+        self.beta1 = beta1
+        self.beta2 = beta2
         self.pull_timeout = pull_timeout
         self.monitor = monitor
         self.part = RangePartitioner(self.num_rows, num_processes)
@@ -110,7 +133,24 @@ class ShardedTable:
         self._acc = (np.full((self.part.shard_size, self.dim),
                              adagrad_init, np.float32)
                      if updater == "adagrad" else None)
+        # lazy adam: moments + a per-row step counter for bias correction
+        # (the server-side numpy twin of ops/sparse_update.row_adam —
+        # untouched rows decay nothing, the standard sparse/CTR semantics)
+        if updater == "adam":
+            self._m = np.zeros((self.part.shard_size, self.dim), np.float32)
+            self._v = np.zeros((self.part.shard_size, self.dim), np.float32)
+            self._steps = np.zeros(self.part.shard_size, np.int32)
+        else:
+            self._m = self._v = self._steps = None
         self._state_lock = threading.Lock()
+        # dropped-frame accounting (VERDICT r2 weak #2): a dropped push is
+        # a silently-lost gradient, so every early return below is counted,
+        # exposed through the trainer's metrics, and asserted zero by the
+        # multiproc smokes. A config mismatch (relaunch at a different
+        # world size / table shape) additionally poisons the table — the
+        # next client op raises instead of training garbage.
+        self.drops = {"malformed": 0, "misrouted": 0, "config": 0}
+        self._fatal: Optional[str] = None
         # ---- server-side admission (bound by ShardedPSTrainer): parked
         # pull requests waiting for the staleness rule — the reference's
         # PendingBuffer (SURVEY.md §2 ProgressTracker/PendingBuffer row)
@@ -143,10 +183,30 @@ class ShardedTable:
             np.add.at(g, inv, grads)
             if self.updater == "sgd":
                 self._w[uniq] -= self.lr * g
-            else:  # adagrad: accum += g², step by rsqrt of NEW accum
+            elif self.updater == "adagrad":
+                # accum += g², step by rsqrt of NEW accum
                 self._acc[uniq] += g * g
                 self._w[uniq] -= self.lr * g / (
                     np.sqrt(self._acc[uniq]) + self.eps)
+            else:
+                self._adam_rows(uniq, g)
+
+    def _adam_rows(self, uniq: np.ndarray, g: np.ndarray) -> None:
+        """Lazy adam on the (deduped) touched rows — one full Adam step per
+        row with per-row bias correction, matching row_adam's f32 math
+        (caller holds the state lock)."""
+        b1, b2 = np.float32(self.beta1), np.float32(self.beta2)
+        t_new = self._steps[uniq] + 1
+        m_new = b1 * self._m[uniq] + (np.float32(1) - b1) * g
+        v_new = b2 * self._v[uniq] + (np.float32(1) - b2) * g * g
+        tf = t_new.astype(np.float32)[:, None]
+        bc1 = np.float32(1) - b1 ** tf
+        bc2 = np.float32(1) - b2 ** tf
+        self._w[uniq] -= np.float32(self.lr) * (m_new / bc1) / (
+            np.sqrt(v_new / bc2) + np.float32(self.eps))
+        self._m[uniq] = m_new
+        self._v[uniq] = v_new
+        self._steps[uniq] = t_new
 
     def _apply_range(self, lo_local: int, grads: np.ndarray) -> None:
         grads = grads.reshape(-1, self.dim)
@@ -154,46 +214,90 @@ class ShardedTable:
         with self._state_lock:
             if self.updater == "sgd":
                 self._w[sl] -= self.lr * grads
-            else:
+            elif self.updater == "adagrad":
                 self._acc[sl] += grads * grads
                 self._w[sl] -= self.lr * grads / (
                     np.sqrt(self._acc[sl]) + self.eps)
+            else:  # every row in the range is touched: plain lazy-adam rows
+                self._adam_rows(np.arange(sl.start, sl.stop), grads)
+
+    def _drop(self, reason: str, sender: int, detail: str) -> None:
+        """Count a dropped frame; config mismatches (a peer launched at a
+        different world size or table shape would route keys wrong forever)
+        also poison the table so the next client op raises loudly."""
+        self.drops[reason] += 1
+        if reason == "config" and self._fatal is None:
+            self._fatal = (f"table {self.name}: dropped frame from peer "
+                           f"{sender}: {detail}")
+
+    def _check_peer_config(self, sender: int, payload: dict) -> bool:
+        ws = int(payload.get("ws", self.num_processes))
+        nr = int(payload.get("nr", self.num_rows))
+        dm = int(payload.get("dm", self.dim))
+        if ws != self.num_processes or nr != self.num_rows \
+                or dm != self.dim:
+            self._drop("config", sender,
+                       f"peer sees world_size={ws} num_rows={nr} dim={dm},"
+                       f" mine are {self.num_processes}/{self.num_rows}/"
+                       f"{self.dim}")
+            return False
+        return True
+
+    def _cfg_header(self) -> dict:
+        """Per-frame config stamp: a peer relaunched at a different world
+        size / table shape must poison the receiver (loud failure), never
+        silently train garbage."""
+        return {"ws": self.num_processes, "nr": self.num_rows,
+                "dm": self.dim}
 
     def _on_push(self, sender: int, payload: dict) -> None:
         blob = payload.get("__blob__")
         n = int(payload.get("n", 0))
+        if not self._check_peer_config(sender, payload):
+            return
         if blob is None or len(blob) != n * (8 + 4 * self.dim):
-            return  # malformed frame from a stale run; drop
+            self._drop("malformed", sender, "bad push blob size")
+            return  # malformed frame from a stale run
         keys = np.frombuffer(blob[: 8 * n], np.int64)
         offs = keys - self.shard_lo
         if n and (offs.min() < 0 or offs.max() >= self.part.shard_size):
-            return  # mis-routed; drop
+            self._drop("misrouted", sender, "push keys outside my range")
+            return
         grads = np.frombuffer(blob[8 * n:], np.float32)
         self._apply_rows(offs, grads)  # read-only view is fine: never written
 
     def _on_push_range(self, sender: int, payload: dict) -> None:
         blob = payload.get("__blob__")
         lo = int(payload.get("lo", -1))
+        if not self._check_peer_config(sender, payload):
+            return
         if blob is None:
+            self._drop("malformed", sender, "range push without blob")
             return
         grads = np.frombuffer(blob, np.float32)
         if grads.size % self.dim:
+            self._drop("malformed", sender, "range blob not row-aligned")
             return
         k = grads.size // self.dim
         lo_local = lo - self.shard_lo
         if lo_local < 0 or lo_local + k > self.part.shard_size:
+            self._drop("misrouted", sender, "range outside my shard")
             return
         self._apply_range(lo_local, grads)
 
     def _on_pull(self, sender: int, payload: dict) -> None:
         blob = payload.get("__blob__")
         req = int(payload.get("req", -1))
+        if not self._check_peer_config(sender, payload):
+            return  # requester times out loudly; my next tick raises
         if blob is None:
+            self._drop("malformed", sender, "pull without key blob")
             return
         keys = np.frombuffer(blob, np.int64)
         offs = keys - self.shard_lo
         if keys.size and (offs.min() < 0
                           or offs.max() >= self.part.shard_size):
+            self._drop("misrouted", sender, "pull keys outside my range")
             return
         clk = int(payload.get("clk", 0))
         if self._cons is not None and not self._cons.admit_pull(clk):
@@ -215,6 +319,8 @@ class ShardedTable:
 
     def _on_pull_all(self, sender: int, payload: dict) -> None:
         req = int(payload.get("req", -1))
+        if not self._check_peer_config(sender, payload):
+            return  # requester times out loudly; my next tick raises
         clk = int(payload.get("clk", 0))
         if self._cons is not None and not self._cons.admit_pull(clk):
             with self._park_lock:
@@ -255,6 +361,7 @@ class ShardedTable:
         blob = payload.get("__blob__")
         req = int(payload.get("req", -1))
         if blob is None:
+            self._drop("malformed", sender, "pull reply without blob")
             return
         rows = np.frombuffer(blob, np.float32).reshape(-1, self.dim)
         with self._reply_cond:
@@ -266,6 +373,17 @@ class ShardedTable:
     def bind_consistency(self, cons) -> None:
         """Attach the trainer's admission rule (server-side SSP gate)."""
         self._cons = cons
+
+    @property
+    def frames_dropped(self) -> int:
+        return sum(self.drops.values())
+
+    def check_fatal(self) -> None:
+        """Raise if a config-mismatched peer frame poisoned this table —
+        called from the trainer's tick so a bad relaunch fails within one
+        step instead of silently discarding that peer's gradients."""
+        if self._fatal is not None:
+            raise RuntimeError(self._fatal)
 
     def _my_clk(self) -> int:
         return self._cons.clock if self._cons is not None else 0
@@ -316,7 +434,8 @@ class ShardedTable:
                 continue
             kslice = keys[mask]
             self.bus.send(o, f"psG:{self.name}",
-                          {"req": req, "clk": self._my_clk()},
+                          {"req": req, "clk": self._my_clk(),
+                           **self._cfg_header()},
                           blob=kslice.tobytes())
             self.bytes_pulled += kslice.nbytes
             remote.append((o, mask))
@@ -339,7 +458,8 @@ class ShardedTable:
         peers = set(range(self.num_processes)) - {self.rank}
         for o in peers:
             self.bus.send(o, f"psA:{self.name}",
-                          {"req": req, "clk": self._my_clk()})
+                          {"req": req, "clk": self._my_clk(),
+                           **self._cfg_header()})
         out = np.empty((self.part.padded, self.dim), np.float32)
         with self._state_lock:
             out[self.shard_lo:self.shard_lo + self.part.shard_size] = self._w
@@ -367,7 +487,8 @@ class ShardedTable:
             kb = keys[mask].tobytes()
             gb = grads[mask].tobytes()
             self.bus.send(o, f"psP:{self.name}",
-                          {"n": int(mask.sum())}, blob=kb + gb)
+                          {"n": int(mask.sum()), **self._cfg_header()},
+                          blob=kb + gb)
             self.bytes_pushed += len(kb) + len(gb)
         self.rows_pushed += keys.size
 
@@ -387,7 +508,8 @@ class ShardedTable:
                 self._apply_range(0, grad[lo:hi])
                 continue
             gb = grad[lo:hi].tobytes()
-            self.bus.send(o, f"psR:{self.name}", {"lo": lo}, blob=gb)
+            self.bus.send(o, f"psR:{self.name}",
+                          {"lo": lo, **self._cfg_header()}, blob=gb)
             self.bytes_pushed += len(gb)
         self.rows_pushed += self.num_rows
 
@@ -398,6 +520,8 @@ class ShardedTable:
         n = self._w.nbytes
         if self._acc is not None:
             n += self._acc.nbytes
+        if self._m is not None:
+            n += self._m.nbytes + self._v.nbytes + self._steps.nbytes
         return n
 
     # ------------------------------------------------------------- state I/O
@@ -406,6 +530,10 @@ class ShardedTable:
             out = {"w": self._w.copy(), "lo": np.asarray(self.shard_lo)}
             if self._acc is not None:
                 out["acc"] = self._acc.copy()
+            if self._m is not None:
+                out["m"] = self._m.copy()
+                out["v"] = self._v.copy()
+                out["steps"] = self._steps.copy()
         return out
 
     def load_shard_state_dict(self, state: dict) -> None:
@@ -419,6 +547,13 @@ class ShardedTable:
                 if "acc" not in state:
                     raise ValueError("checkpoint lacks adagrad accumulator")
                 self._acc[...] = state["acc"]
+            if self._m is not None:
+                if not {"m", "v", "steps"} <= set(state):
+                    raise ValueError(
+                        "checkpoint lacks adam moments/step counters")
+                self._m[...] = state["m"]
+                self._v[...] = state["v"]
+                self._steps[...] = state["steps"]
 
     # Checkpointer-protocol aliases: each process checkpoints ITS OWN
     # shard (the reference dumps per-server KVTable state, SURVEY.md §3.5)
@@ -498,6 +633,8 @@ class ShardedPSTrainer:
     def tick(self) -> None:
         """Advance my clock, gossip it, and gate (BSP/SSP/ASP rule) —
         ``KVClientTable::Clock()``."""
+        for t in self.tables.values():
+            t.check_fatal()  # config-mismatched peer ⇒ fail, don't train on
         self.clock += 1
         self.gossip.publish_local([self.clock])
         self.gate.wait(self.clock)
@@ -588,6 +725,17 @@ class ShardedPSTrainer:
     @property
     def max_skew_seen(self) -> int:
         return self.gate.max_skew_seen
+
+    @property
+    def frames_dropped(self) -> int:
+        return sum(t.frames_dropped for t in self.tables.values())
+
+    def drop_detail(self) -> dict:
+        out = {"malformed": 0, "misrouted": 0, "config": 0}
+        for t in self.tables.values():
+            for k, v in t.drops.items():
+                out[k] += v
+        return out
 
     @property
     def bytes_pushed(self) -> int:

@@ -32,10 +32,15 @@ _COLLECTIVES = (
     "collective-permute",
 )
 
-# HLO primitive-type → bytes per element. Collectives only move numeric
-# payloads, so this table is the closed set we expect to see.
+# HLO primitive-type → bytes per element. Sub-byte types (u4/s4, fp8) round
+# up to 1; anything not listed falls back to a conservative 8 bytes with a
+# warning (overestimating keeps the "traffic is small" guards sound) rather
+# than crashing on newer-hardware HLO.
 _DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1,
+    "pred": 1, "s8": 1, "u8": 1, "s4": 1, "u4": 1,
+    "f8e5m2": 1, "f8e4m3": 1, "f8e4m3fn": 1, "f8e4m3b11fnuz": 1,
+    "f8e5m2fnuz": 1, "f8e4m3fnuz": 1, "f8e3m4": 1, "f4e2m1fn": 1,
+    "f8e8m0fnu": 1,
     "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
     "s32": 4, "u32": 4, "f32": 4,
     "s64": 8, "u64": 8, "f64": 8, "c64": 8,
@@ -47,7 +52,10 @@ _DTYPE_BYTES = {
 _OP_RE = re.compile(
     r"=\s*(?P<result>\([^)]*\)|\S+?)\s+"
     r"(?P<op>" + "|".join(_COLLECTIVES) + r")(?:-start|-done)?\(")
-_SHAPE_RE = re.compile(r"(?P<dtype>[a-z]+\d*)\[(?P<dims>[\d,]*)\]")
+# full HLO primitive-type names (f8e4m3fn, bf16, u4, ...): letters and
+# digits interleave, so the name is letter-led alphanumeric — anchored by
+# the [dims] bracket that only type names carry in shape position
+_SHAPE_RE = re.compile(r"(?P<dtype>[a-z][a-z0-9]*)\[(?P<dims>[\d,]*)\]")
 
 
 @dataclass(frozen=True)
@@ -56,13 +64,20 @@ class CollectiveOp:
     kind: str      # all-gather / all-reduce / ...
     shape: str     # e.g. "f32[1024,64]"
     bytes: int     # payload size of the result
+    # parsed result dims, one tuple per array in the (possibly tuple-)
+    # result — guards compare these as INTEGERS (substring matching on
+    # `shape` false-positives, e.g. 16384 inside f32[163840])
+    dims: tuple = ()
+
+    def has_dim(self, n: int) -> bool:
+        return any(n in d for d in self.dims)
 
 
-def _shape_bytes(shape_text: str, largest: bool = False) -> tuple[int, list[str]]:
-    """(bytes, shapes) across every array shape in ``shape_text``;
+def _shape_bytes(shape_text: str, largest: bool = False):
+    """(bytes, shapes, dims) across every array shape in ``shape_text``;
     ``largest=True`` returns only the biggest element's bytes (async
     ``-start`` tuples alias the operand next to the output)."""
-    sizes, shapes = [], []
+    sizes, shapes, dims = [], [], []
     for m in _SHAPE_RE.finditer(shape_text):
         dt = m.group("dtype")
         if dt == "token":  # control-dependency tokens carry no payload
@@ -71,10 +86,17 @@ def _shape_bytes(shape_text: str, largest: bool = False) -> tuple[int, list[str]
         for d in m.group("dims").split(","):
             if d:
                 n *= int(d)
-        sizes.append(n * _DTYPE_BYTES[dt])
+        per_elem = _DTYPE_BYTES.get(dt)
+        if per_elem is None:
+            import warnings
+            warnings.warn(f"unknown HLO primitive type {dt!r}; assuming "
+                          "16 bytes/element (conservative)", stacklevel=3)
+            per_elem = 16  # >= the widest known type (c128)
+        sizes.append(n * per_elem)
         shapes.append(f"{dt}[{m.group('dims')}]")
+        dims.append(tuple(int(d) for d in m.group("dims").split(",") if d))
     total = (max(sizes) if largest else sum(sizes)) if sizes else 0
-    return total, shapes
+    return total, shapes, tuple(dims)
 
 
 def collective_ops(hlo_text: str) -> list[CollectiveOp]:
@@ -98,8 +120,10 @@ def collective_ops(hlo_text: str) -> list[CollectiveOp]:
         if m is None or f"{m.group('op')}-done(" in line:
             continue
         is_start = f"{m.group('op')}-start(" in line
-        nbytes, shapes = _shape_bytes(m.group("result"), largest=is_start)
-        ops.append(CollectiveOp(m.group("op"), " ".join(shapes), nbytes))
+        nbytes, shapes, dims = _shape_bytes(m.group("result"),
+                                            largest=is_start)
+        ops.append(CollectiveOp(m.group("op"), " ".join(shapes), nbytes,
+                                dims))
     return ops
 
 

@@ -361,9 +361,10 @@ def test_stream_criteo_batches_covers_rows_in_order(tmp_path):
                 "cat": blk["cat"], "y": blk["y"]}
 
     n, B = 0, 256
+    stats: dict = {}
     # tiny chunk_bytes forces many chunks + carried tails
     for b in stream_criteo_batches(path, B, chunk_bytes=10_000,
-                                   transform=xform):
+                                   transform=xform, stats=stats):
         np.testing.assert_array_equal(b["cat"], whole["cat"][n:n + B])
         np.testing.assert_allclose(
             b["dense"],
@@ -371,6 +372,8 @@ def test_stream_criteo_batches_covers_rows_in_order(tmp_path):
             rtol=1e-6)
         n += B
     assert n == (1500 // B) * B  # final short batch dropped by contract
+    # ... and the drop is accounted, not silent (ADVICE r2)
+    assert stats["dropped_rows"] == 1500 - n
 
 
 def test_stream_criteo_batches_surfaces_parse_errors(tmp_path):

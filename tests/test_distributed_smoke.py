@@ -200,6 +200,7 @@ def test_wide_deep_multiproc_ssp_staleness4():
         timeout=300.0)
     assert all(r["event"] == "done" for r in res)
     for r in res:
+        assert r["frames_dropped"] == 0, r  # no silently-lost gradients
         assert r["loss_last"] < r["loss_first"], r
         assert r["auc"] > 0.65, r["auc"]          # improving vs 0.5 chance
         assert r["max_skew_seen"] <= 5            # s + 1
@@ -229,6 +230,7 @@ def test_wide_deep_multiproc_asp_never_waits():
         timeout=300.0)
     assert all(r["event"] == "done" for r in res)
     for r in res:
+        assert r["frames_dropped"] == 0, r
         assert r["gate_waits"] == 0       # ASP never blocks
         assert r["loss_last"] < r["loss_first"], r
     fps = [r["param_fingerprint"] for r in res]
@@ -251,6 +253,7 @@ def test_mf_multiproc_asp_partitioned_factors():
         timeout=300.0)
     assert all(r["event"] == "done" for r in res)
     for r in res:
+        assert r["frames_dropped"] == 0, r
         assert r["gate_waits"] == 0       # ASP never blocks
         assert r["loss_last"] < r["loss_first"], r
         assert r["rmse"] is not None and r["rmse"] < 1.5, r["rmse"]
@@ -276,6 +279,7 @@ def test_word2vec_multiproc_ssp_partitioned_vocab():
         timeout=300.0)
     assert all(r["event"] == "done" for r in res)
     for r in res:
+        assert r["frames_dropped"] == 0, r
         assert r["loss_last"] < r["loss_first"], r
         assert r["max_skew_seen"] <= 3    # s + 1
         assert r["local_bytes"] * 3 <= r["table_bytes"] * 1.01 + 6 * 64 * 4

@@ -76,7 +76,8 @@ def test_no_table_sized_collective_op(mesh8):
     t = SparseTable(slots, DIM, mesh8)
     comp = t._jit_pull.lower(t.emb, _sharded_keys(mesh8, BATCH)).compile()
     for op in collective_ops(comp.as_text()):
-        assert str(slots) not in op.shape and str(slots // 8) not in op.shape, (
+        # integer dim comparison, not substring (16384 inside f32[163840])
+        assert not op.has_dim(slots) and not op.has_dim(slots // 8), (
             f"table-sized collective scheduled: {op}")
 
 
@@ -114,3 +115,28 @@ def test_collective_parser_on_known_hlo():
                      + 2 * 8 * 4       # variadic sync all-gather: sums
                      + 256 * 4         # permute start counted once
                      + 4096 * 32 * 4)  # async start: output only
+
+
+def test_collective_parser_fp8_and_unknown_dtypes():
+    """ADVICE r2: fp8/u4 HLO names must parse (full-name tokenization, not
+    the trailing 'fn'), and an unknown primitive type degrades to a warned
+    conservative estimate instead of a KeyError crash."""
+    import warnings
+
+    ops = collective_ops(
+        "%q = f8e4m3fn[1024,64]{1,0} all-reduce(%x)\n"
+        "%u = u4[256]{0} all-gather(%y)")
+    assert [o.bytes for o in ops] == [1024 * 64 * 1, 256 * 1]
+    assert ops[0].shape == "f8e4m3fn[1024,64]"
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ops = collective_ops("%z = zz9[100]{0} all-reduce(%x)")
+    assert ops[0].bytes == 100 * 16  # conservative: >= widest known type
+    assert any("unknown HLO primitive" in str(x.message) for x in w)
+
+
+def test_collective_op_has_dim_is_integer_exact():
+    """16384 as a dim must not match f32[163840] (the substring trap)."""
+    ops = collective_ops("%a = f32[163840]{0} all-reduce(%x)")
+    assert not ops[0].has_dim(16384)
+    assert ops[0].has_dim(163840)
