@@ -1,0 +1,169 @@
+"""Multi-host SPMD data plane — the real-pod story, smoke-sized.
+
+The reference actually runs N processes on N nodes glued by the mailbox
+(SURVEY.md §1 L7, §3.1); the rebuild's equivalent for the SPMD data plane
+is ``jax.distributed.initialize`` + ONE global mesh spanning every
+process's devices (SURVEY.md §2.3 "DCN"): the same fused
+pull→grad→push→update step (tables/dense.py) compiles unchanged, XLA
+routes its collectives across the process boundary (ICI intra-host, DCN
+inter-host; Gloo on the CPU loopback smoke), and batches are fed
+per-process via ``make_array_from_process_local_data`` — each host
+contributes the rows it loaded.
+
+Run under the launcher (which exports MINIPS_COORDINATOR + ranks):
+
+    python -m minips_tpu.launch --n 2 --base-port 59XX -- \
+        python -m minips_tpu.apps.multihost_example --iters 30
+
+Each rank prints ONE JSON line (smoke protocol): losses, process/device
+counts, a post-training parameter fingerprint (process-allgathered, so
+ranks can be compared for SPMD agreement), and the result of a
+globally-sharded orbax checkpoint save→restore drill in which every
+process writes/reads only its addressable shards (SURVEY.md §5.4).
+
+Single-process (no launcher) the exact same code runs on the local
+devices — that run is the loss-parity oracle for the 2-process smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=64,
+                    help="GLOBAL batch size (split across processes)")
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.3)
+    ap.add_argument("--updater", default="adagrad",
+                    choices=["sgd", "adagrad", "adam"])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="shared dir for the globally-sharded orbax "
+                         "save→restore drill (skipped when absent)")
+    ap.add_argument("--save-at", type=int, default=0,
+                    help="iteration AFTER which to save (0 = at the end)")
+    args = ap.parse_args(argv)
+    if args.save_at > args.iters:
+        ap.error(f"--save-at {args.save_at} exceeds --iters {args.iters}: "
+                 "the restore drill would read a checkpoint never saved")
+
+    # CPU smoke path: fake local devices BEFORE any backend-touching call
+    # (the sandbox TPU plugin ignores JAX_PLATFORMS env, hence
+    # config.update — same bootstrap as tests/conftest.py)
+    local_devs = int(os.environ.get("MINIPS_MH_LOCAL_DEVICES", "0"))
+    if local_devs:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={local_devs}")
+    import jax
+
+    if os.environ.get("MINIPS_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from minips_tpu.comm import cluster
+
+    multi = cluster.initialize()
+    rank = jax.process_index()
+    nprocs = jax.process_count()
+
+    import numpy as np
+
+    from minips_tpu.models import lr as lr_model
+    from minips_tpu.parallel.mesh import make_mesh
+    from minips_tpu.tables.dense import DenseTable
+
+    mesh = make_mesh(len(jax.devices()))  # ONE mesh over every process
+    dt = DenseTable(lr_model.init(args.dim), mesh, updater=args.updater,
+                    lr=args.lr)
+    step = dt.make_step(lr_model.grad_fn_dense)
+
+    B, D = args.batch, args.dim
+    if B % nprocs:
+        raise SystemExit(f"--batch {B} must divide by {nprocs} processes")
+    per = B // nprocs
+    # every rank generates the identical GLOBAL batch stream and feeds its
+    # own row slice — so an n-process run and the single-process oracle
+    # train on the same data and must produce the same losses (the smoke's
+    # parity assertion)
+    rng = np.random.default_rng(args.seed)
+    w_true = rng.normal(size=D)
+
+    def next_global():
+        x = rng.normal(size=(B, D)).astype(np.float32)
+        y = (x @ w_true > 0).astype(np.float32)
+        return x, y
+
+    ckpt_fp = None
+    save_at = args.save_at or args.iters
+    ckptr = None
+    if args.checkpoint_dir:
+        import orbax.checkpoint as ocp
+
+        # synchronous Checkpointer: its primary-host dir creation +
+        # barrier protocol is what coordinates a multi-process save (the
+        # async StandardCheckpointer races per-process signaling threads
+        # on the shared tmp dir in this orbax version)
+        ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+        ocp_args = ocp.args
+
+    losses = []
+    t0 = time.monotonic()
+    for i in range(args.iters):
+        x, y = next_global()
+        batch = cluster.global_batch(
+            mesh, {"x": x[rank * per:(rank + 1) * per],
+                   "y": y[rank * per:(rank + 1) * per]})
+        losses.append(float(dt.step_inplace(step, batch)))
+        if ckptr is not None and i + 1 == save_at:
+            # coordinated multi-host save: every process writes ONLY its
+            # addressable shards of the live sharded arrays (TensorStore
+            # under orbax) — no host gather, no full copy anywhere
+            ckptr.save(os.path.join(args.checkpoint_dir, f"step{i + 1}"),
+                       args=ocp_args.StandardSave(dt.global_arrays()),
+                       force=True)
+            ckpt_fp = float(cluster.host_copy(dt.params).sum())
+
+    # SPMD agreement fingerprint (allgathered => comparable across ranks)
+    fp = float(cluster.host_copy(dt.params).sum())
+
+    ckpt_ok = None
+    if ckptr is not None:
+        # restore into a FRESH table (same template/shardings) and check
+        # it reproduces the state that was saved — the recovery path of
+        # SURVEY.md §3.5 with globally-sharded state
+        dt2 = DenseTable(lr_model.init(args.dim), mesh,
+                         updater=args.updater, lr=args.lr)
+        restored = ckptr.restore(
+            os.path.join(args.checkpoint_dir, f"step{save_at}"),
+            args=ocp_args.StandardRestore(dt2.global_arrays()))
+        dt2.params = restored["params"]
+        dt2.opt_state = restored["opt_state"]
+        ckpt_ok = bool(abs(float(cluster.host_copy(dt2.params).sum())
+                           - ckpt_fp) < 1e-5)
+        ckptr.close()
+
+    cluster.barrier("multihost_done")  # reference Engine::Barrier
+    print(json.dumps({
+        "rank": rank, "event": "done",
+        "wall_s": round(time.monotonic() - t0, 4),
+        "multi": multi,
+        "process_count": nprocs,
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+        "loss_first": losses[0], "loss_last": losses[-1],
+        "losses": [round(x, 8) for x in losses],
+        "param_fingerprint": fp,
+        "ckpt_roundtrip_ok": ckpt_ok,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

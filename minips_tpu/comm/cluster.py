@@ -8,7 +8,13 @@ coordination service wires processes into one JAX runtime, after which the
 (SURVEY.md §2.3). Only the SSP clock gossip + heartbeats keep a socket bus
 (minips_tpu/comm/bus.py).
 
-Single-process (this sandbox) everything degrades to no-ops.
+The launcher (minips_tpu/launch.py) exports ``MINIPS_COORDINATOR`` +
+``MINIPS_PROC_ID``/``MINIPS_NUM_PROCS`` for every rank, so a worker that
+calls :func:`initialize` with no arguments joins the job it was spawned
+into; single-process (this sandbox, no launcher) everything degrades to
+no-ops. The 2-process loopback smoke (tests/test_multihost.py) runs this
+exact path on the CPU backend — the "threads as nodes" trick one level up:
+processes as hosts.
 """
 
 from __future__ import annotations
@@ -21,17 +27,38 @@ import jax
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> None:
+               process_id: Optional[int] = None) -> bool:
     """Join the cluster. Mirrors the reference's ``--my_id`` flag surface:
-    pass explicit args or set JAX's standard env vars; single-process if
-    neither is present."""
-    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ:
-        return  # single-process
+    pass explicit args, or rely on the launcher's ``MINIPS_*`` env (or
+    JAX's own ``JAX_COORDINATOR_ADDRESS``); single-process if none is
+    present. Returns True iff a multi-process runtime was initialized.
+
+    On the CPU loopback smoke each process fakes its local devices via
+    ``xla_force_host_platform_device_count`` BEFORE calling this (see
+    apps/multihost_example.py); jax.distributed then registers them with
+    the coordination service automatically.
+    """
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MINIPS_COORDINATOR") \
+            or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "MINIPS_NUM_PROCS" in os.environ:
+        num_processes = int(os.environ["MINIPS_NUM_PROCS"])
+    if process_id is None and "MINIPS_PROC_ID" in os.environ:
+        process_id = int(os.environ["MINIPS_PROC_ID"])
+    if coordinator_address is None:
+        return False  # single-process (no launcher, no JAX cluster env)
+    if num_processes is not None and num_processes <= 1:
+        return False  # launcher run with --n 1
+    # num_processes/process_id may legitimately still be None here (pure
+    # JAX-standard env: JAX_NUM_PROCESSES/JAX_PROCESS_ID) — pass through
+    # and let jax.distributed resolve them itself rather than silently
+    # degrading a pod job to N independent single-process runs
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
     )
+    return True
 
 
 def process_index() -> int:
@@ -49,3 +76,30 @@ def barrier(name: str = "minips_barrier", timeout_s: int = 120) -> None:
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(name)
+
+
+def global_batch(mesh, batch: dict, axis: str = "data") -> dict:
+    """Per-process local batch rows → ONE global array dict sharded along
+    ``axis`` — the multi-host feeding step (each host contributes the rows
+    it loaded; SURVEY.md §1 L5 "data shards per worker"). Single-process
+    this is a plain device_put with the same sharding."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = NamedSharding(mesh, PartitionSpec(axis))
+    if jax.process_count() == 1:
+        return {k: jax.device_put(v, sh) for k, v in batch.items()}
+    return {k: jax.make_array_from_process_local_data(sh, v)
+            for k, v in batch.items()}
+
+
+def host_copy(x):
+    """Full host value of a (possibly non-addressable, multi-process
+    sharded) array — the multi-host-safe ``np.asarray``. Collective: every
+    process must call it on the same array."""
+    import numpy as np
+
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))

@@ -308,11 +308,24 @@ class DenseTable:
 
     # ------------------------------------------------------------- state I/O
     def state_dict(self) -> dict:
-        """Host copies for checkpointing (params + opt state)."""
+        """Host copies for checkpointing (params + opt state). Multi-host
+        safe: non-addressable (cross-process sharded) leaves are fetched
+        with a process allgather — a collective, so every process must
+        call this together (the reference's Dump is likewise coordinated,
+        SURVEY.md §3.5)."""
+        from minips_tpu.comm.cluster import host_copy
+
         return {
-            "params": np.asarray(self.params),
-            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "params": host_copy(self.params),
+            "opt_state": jax.tree.map(host_copy, self.opt_state),
         }
+
+    def global_arrays(self) -> dict:
+        """The live (sharded) jax arrays, for coordinated multi-host
+        checkpointing: hand these to orbax so every process writes only
+        its addressable shards (no host gather, no full copy anywhere) —
+        the globally-sharded checkpoint path (SURVEY.md §5.4)."""
+        return {"params": self.params, "opt_state": self.opt_state}
 
     def load_state_dict(self, state: dict) -> None:
         self.params = jax.device_put(

