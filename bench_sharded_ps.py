@@ -1,0 +1,100 @@
+"""Sharded multi-process PS throughput curve (VERDICT r2 #2).
+
+Measures train/sharded_ps.py — the key-range-sharded multi-process server —
+via apps/sharded_ps_bench.py workers: rows/sec and wire-bytes/sec of the
+pull→push cycle per process, with model math stripped out so the number
+isolates routing + serialization + bus + server-side updater (the
+reference's Mailbox/ServerThread hot path, SURVEY.md §3.3 hot spots b+c).
+
+The sweep:
+- world size 1 (standalone, zero wire: the pure server-apply ceiling)
+  then 2→4 real processes over loopback;
+- zmq vs the native C++ TCP mailbox at world size 3;
+- sparse key-slice path vs dense contiguous-range path at world size 3.
+
+Everything here is HOST-CPU loopback — the sharded PS is the control-plane
+topology (real pods put one process per node); these are deliberately NOT
+chip rates and never feed vs_baseline. Emits ONE JSON line.
+
+Usage: python bench_sharded_ps.py [--iters 60] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+
+_PORT = [6600 + (os.getpid() % 389)]
+
+
+def _worker_argv(path: str, iters: int, warmup: int) -> list[str]:
+    return [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
+            "--path", path, "--iters", str(iters), "--warmup", str(warmup)]
+
+
+def _run(n: int, path: str, iters: int, warmup: int, bus: str) -> dict:
+    """One sweep point → {rows_per_sec_per_process, aggregate, wire...}."""
+    argv = _worker_argv(path, iters, warmup)
+    if n == 1:  # standalone zero-wire baseline (no launcher, no bus)
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=240)
+        if proc.returncode != 0:
+            raise RuntimeError(f"standalone worker failed: {proc.stderr}")
+        res = [json.loads([ln for ln in proc.stdout.splitlines()
+                           if ln.startswith("{")][-1])]
+    else:
+        from minips_tpu import launch
+
+        _PORT[0] += n + 3
+        res = launch.run_local_job(
+            n, argv, base_port=_PORT[0],
+            env_extra={"MINIPS_BUS": bus} if bus != "zmq" else None,
+            timeout=300.0)
+    per = [r["rows_per_sec"] for r in res]
+    wire = [r["wire_push_bytes_per_sec"] + r["wire_pull_bytes_per_sec"]
+            for r in res]
+    return {
+        "rows_per_sec_per_process": round(statistics.mean(per), 1),
+        "aggregate_rows_per_sec": round(sum(per), 1),
+        "wire_bytes_per_sec_per_process": round(statistics.mean(wire), 1),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=60)
+    ap.add_argument("--quick", action="store_true",
+                    help="short iters (harness validation, not numbers)")
+    args = ap.parse_args()
+    iters = 15 if args.quick else args.iters
+    warmup = max(2, iters // 6)
+
+    curve = {}  # world-size scaling, sparse path, zmq
+    for n in (1, 2, 3, 4):
+        curve[str(n)] = _run(n, "sparse", iters, warmup, "zmq")
+    buses = {"zmq": curve["3"],
+             "native": _run(3, "sparse", iters, warmup, "native")}
+    paths = {"sparse": curve["3"],
+             "dense": _run(3, "dense", iters, warmup, "zmq")}
+
+    headline = curve["3"]["rows_per_sec_per_process"]
+    print(json.dumps({
+        "metric": "sharded-PS rows/sec/process (sparse pull+push, "
+                  "3 procs, zmq, CPU loopback control plane)",
+        "value": headline,
+        "unit": "rows/sec/process",
+        "vs_baseline": None,  # control-plane rate; not a chip number
+        "device": "cpu-loopback",
+        "scaling_sparse_zmq": curve,
+        "bus_comparison_3proc": buses,
+        "path_comparison_3proc": paths,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,10 @@
 // heartbeats, barriers, host-relayed deltas) — but that plane is still
 // native C++, matching the reference's runtime layering: raw TCP sockets,
 // a ThreadsafeQueue<Message> inbox, an accept/reader actor per connection
-// and a Sender actor draining an outgoing queue so publish() never blocks
+// and a Sender actor draining a BOUNDED outgoing queue: publish() is
+// nonblocking until the outbox holds outbox_cap_ frames, then it applies
+// producer backpressure (blocks up to 30s, after which the frame is
+// counted dropped — never silently lost)
 // the training thread.
 //
 // Wire frame (little-endian):
@@ -59,6 +62,30 @@ class ThreadsafeQueue {
     }
     cv_.notify_one();
   }
+  // Bounded push: BLOCKS while the queue holds >= cap items (producer
+  // backpressure — an ASP worker outrunning the Sender actor must slow
+  // down, not grow the queue without bound). cap 0 = unbounded. Returns
+  // false (item NOT enqueued) only after timeout_ms of no space or on a
+  // closed queue — the caller counts that as a dropped frame.
+  bool push_bounded(T v, size_t cap, int timeout_ms) {
+    std::unique_lock<std::mutex> g(mu_);
+    if (cap > 0) {
+      auto ok = space_cv_.wait_for(
+          g, std::chrono::milliseconds(timeout_ms),
+          [&] { return q_.size() < cap || closed_; });
+      if (!ok || closed_) return false;
+    } else if (closed_) {
+      return false;
+    }
+    q_.push_back(std::move(v));
+    g.unlock();
+    cv_.notify_one();
+    return true;
+  }
+  size_t size() {
+    std::lock_guard<std::mutex> g(mu_);
+    return q_.size();
+  }
   // Returns false on timeout or close-with-empty-queue.
   bool pop(T* out, int timeout_ms) {
     std::unique_lock<std::mutex> g(mu_);
@@ -72,6 +99,8 @@ class ThreadsafeQueue {
     if (q_.empty()) return false;  // closed
     *out = std::move(q_.front());
     q_.pop_front();
+    g.unlock();
+    space_cv_.notify_all();  // wake bounded producers
     return true;
   }
   void close() {
@@ -80,6 +109,7 @@ class ThreadsafeQueue {
       closed_ = true;
     }
     cv_.notify_all();
+    space_cv_.notify_all();
   }
   bool drain_wait(int timeout_ms) {  // wait until empty (sender flush)
     std::unique_lock<std::mutex> g(mu_);
@@ -96,6 +126,7 @@ class ThreadsafeQueue {
   std::mutex mu_;
   std::condition_variable cv_;
   std::condition_variable drained_cv_;
+  std::condition_variable space_cv_;  // bounded-push producers wait here
   std::deque<T> q_;
   bool closed_ = false;
 };
@@ -203,8 +234,24 @@ class Mailbox {
     return false;
   }
 
-  // Nonblocking publish: enqueue for the Sender actor.
-  void Publish(Msg m) { outbox_.push(std::move(m)); }
+  // Enqueue for the Sender actor. Bounded: when the outbox holds
+  // outbox_cap_ frames the producer BLOCKS (backpressure) up to 30s;
+  // only then is the frame counted dropped — the Python layer surfaces
+  // dropped_ so a send-side loss can never be silent.
+  void Publish(Msg m) {
+    if (!outbox_.push_bounded(std::move(m), outbox_cap_.load(), 30000))
+      dropped_.fetch_add(1);
+  }
+
+  void SetOutboxCap(size_t cap) { outbox_cap_.store(cap); }
+
+  // Wake any bounded-push producer immediately (they see closed_ and
+  // return false → counted drop). Safe concurrently with Publish; used
+  // by the Python close() path so teardown never waits out a 30s
+  // backpressure stall.
+  void InterruptOutbox() { outbox_.close(); }
+  int64_t OutboxDepth() { return static_cast<int64_t>(outbox_.size()); }
+  int64_t Dropped() const { return dropped_.load(); }
 
   bool Recv(Msg* out, int timeout_ms) { return inbox_.pop(out, timeout_ms); }
 
@@ -324,6 +371,8 @@ class Mailbox {
 
   int listen_fd_ = -1;
   int bound_port_ = 0;
+  std::atomic<size_t> outbox_cap_{8192};  // frames; see Publish()
+  std::atomic<int64_t> dropped_{0};
   std::atomic<bool> stop_{false};
   ThreadsafeQueue<Msg> inbox_;
   ThreadsafeQueue<Msg> outbox_;
@@ -402,6 +451,26 @@ int mailbox_recv(void* h, int timeout_ms, char** msg_out, int64_t* msg_len,
 }
 
 void mailbox_free_buf(void* p) { ::free(p); }
+
+// Outgoing-queue observability: depth (frames awaiting the Sender actor),
+// the producer-side drop counter (bounded-push timeouts; must stay 0 in a
+// healthy job), and the cap setter (0 = unbounded).
+int64_t mailbox_outbox_depth(void* h) {
+  return static_cast<Mailbox*>(h)->OutboxDepth();
+}
+
+int64_t mailbox_dropped(void* h) {
+  return static_cast<Mailbox*>(h)->Dropped();
+}
+
+void mailbox_set_outbox_cap(void* h, int64_t cap) {
+  static_cast<Mailbox*>(h)->SetOutboxCap(
+      cap < 0 ? 0 : static_cast<size_t>(cap));
+}
+
+void mailbox_interrupt(void* h) {
+  static_cast<Mailbox*>(h)->InterruptOutbox();
+}
 
 void mailbox_close(void* h) {
   auto* mb = static_cast<Mailbox*>(h);

@@ -286,6 +286,8 @@ def emit_multiproc_done(trainer, rank: int, t0: float, losses,
         "bytes_pulled": trainer.bytes_pulled,
         # a dropped frame is a silently-lost gradient — smokes assert 0
         "frames_dropped": trainer.frames_dropped,
+        # bus-level wire loss (HWM drops, torn links) — smokes assert 0
+        "wire_frames_lost": trainer.wire_frames_lost,
         "local_bytes": trainer.local_bytes(),
         "table_bytes": int(table_bytes),
         "param_fingerprint": fingerprint,

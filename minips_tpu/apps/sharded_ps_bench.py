@@ -1,0 +1,122 @@
+"""Sharded-PS throughput worker — measures the multi-process PS itself.
+
+The correctness smokes (tests/test_sharded_ps.py) prove the key-range-
+sharded server's semantics; this worker measures its THROUGHPUT: rows/sec
+and wire-bytes/sec of the pull→push cycle, per process, with the model
+math stripped out so the number isolates routing + serialization + bus +
+server-side updater (the reference's Mailbox/ServerThread hot path,
+SURVEY.md §3.3 hot spots b+c). Driven by bench_sharded_ps.py across world
+sizes and bus backends; one rank standalone (no launcher) measures the
+pure in-process server apply as the zero-wire baseline.
+
+Two paths, matching the table's two wire formats:
+- ``sparse``: per-iter random key batch → ``pull(keys)`` + ``push(keys,
+  grads)`` — per-owner key-slice frames (the W&D/Criteo pattern).
+- ``dense``: ``pull_all()`` + ``push_dense(grad)`` — contiguous range
+  frames, no key lists (the LR weight-vector pattern).
+
+Consistency is ASP (never gates) so the measurement is the PS data path,
+not the staleness rule. Emits ONE JSON line per rank (launcher protocol).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--path", choices=["sparse", "dense"], default="sparse")
+    ap.add_argument("--rows", type=int, default=1 << 16)
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=4096,
+                    help="keys per pull/push cycle (sparse path)")
+    ap.add_argument("--iters", type=int, default=60)
+    ap.add_argument("--warmup", type=int, default=10)
+    ap.add_argument("--updater", choices=["sgd", "adagrad", "adam"],
+                    default="adagrad")
+    args = ap.parse_args(argv)
+
+    from minips_tpu.train.sharded_ps import ShardedPSTrainer, ShardedTable
+
+    rank = int(os.environ.get("MINIPS_PROC_ID", "0"))
+    nprocs = int(os.environ.get("MINIPS_NUM_PROCS", "1"))
+    if nprocs > 1:
+        from minips_tpu.apps.common import init_multiproc
+
+        rank, nprocs, bus, monitor, _ = init_multiproc("asp", 0)
+    else:  # standalone: zero-wire baseline, pure server-side apply
+        bus = monitor = None
+
+    table = ShardedTable("b", args.rows, args.dim, bus, rank, nprocs,
+                         updater=args.updater, lr=0.05,
+                         pull_timeout=60.0, monitor=monitor)
+    trainer = None
+    if bus is not None:
+        trainer = ShardedPSTrainer({"b": table}, bus, nprocs,
+                                   staleness=float("inf"),
+                                   gate_timeout=60.0, monitor=monitor)
+        bus.handshake(nprocs)
+
+    rng = np.random.default_rng(rank)
+    B, dim = args.batch, args.dim
+    grads = rng.normal(size=(B, dim)).astype(np.float32)
+    dense_grad = rng.normal(size=(args.rows, dim)).astype(np.float32)
+
+    def cycle():
+        if args.path == "sparse":
+            keys = rng.integers(0, args.rows, size=B)
+            table.pull(keys)
+            table.push(keys, grads)
+            return 2 * B  # rows moved (pulled + pushed)
+        table.pull_all()
+        table.push_dense(dense_grad)
+        return 2 * args.rows
+
+    rows_moved = 0
+    b_push0 = b_pull0 = 0.0
+    t0 = 0.0
+    for i in range(args.iters):
+        if i == args.warmup:
+            rows_moved = 0
+            b_push0, b_pull0 = table.bytes_pushed, table.bytes_pulled
+            t0 = time.perf_counter()
+        rows_moved += cycle()
+        if trainer is not None:
+            trainer.tick()  # ASP: publishes clock, never waits
+    dt = time.perf_counter() - t0
+    if trainer is not None:
+        trainer.finalize(timeout=60.0)
+        assert trainer.frames_dropped == 0, trainer.drop_detail()
+        trainer.shutdown_barrier(timeout=15.0)
+
+    timed = args.iters - args.warmup
+    print(json.dumps({
+        "rank": rank, "event": "done",
+        "path": args.path, "nprocs": nprocs,
+        "bus": os.environ.get("MINIPS_BUS", "zmq") if bus else "none",
+        "rows": args.rows, "dim": args.dim, "batch": B,
+        "iters_timed": timed,
+        "rows_per_sec": round(rows_moved / dt, 1),
+        "cycles_per_sec": round(timed / dt, 2),
+        "wire_push_bytes_per_sec": round(
+            (table.bytes_pushed - b_push0) / dt, 1),
+        "wire_pull_bytes_per_sec": round(
+            (table.bytes_pulled - b_pull0) / dt, 1),
+        "wall_s": round(dt, 4),
+    }), flush=True)
+    if monitor is not None:
+        monitor.stop()
+    if bus is not None:
+        bus.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

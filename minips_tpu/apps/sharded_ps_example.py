@@ -172,6 +172,7 @@ def main(argv=None) -> int:
             "bytes_pushed": trainer.bytes_pushed,
             "bytes_pulled": trainer.bytes_pulled,
             "frames_dropped": trainer.frames_dropped,
+            "wire_frames_lost": trainer.wire_frames_lost,
             "local_bytes": trainer.local_bytes(),
             "table_bytes": int(table_bytes),
             "param_sum": float(final.sum()),

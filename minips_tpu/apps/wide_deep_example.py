@@ -114,6 +114,22 @@ def _make_predict(wide_t, emb_t, deep_params, use_fm: bool):
     return predict
 
 
+def _log_collisions(metrics, cats, num_slots) -> dict:
+    """Measured key→slot collision rate of the hashed tables over this
+    run's key stream (sampled) — hash merging is invisible quality loss
+    unless logged (VERDICT r2 #5; sizing guidance in docs/api.md). Both
+    tables hash the same cat keys under their own salt."""
+    from minips_tpu.tables.sparse import collision_stats
+
+    out = {}
+    for name, salt in (("wide", 1), ("emb", 2)):
+        st = collision_stats(cats, num_slots, salt=salt)
+        out[name] = st
+        metrics.log(table=name, **{f"collision_{k}": v
+                                   for k, v in st.items()})
+    return out
+
+
 def run(cfg: Config, args, metrics) -> dict:
     use_fm = getattr(args, "model", "widedeep") == "deepfm"
     if getattr(args, "stream", False) \
@@ -145,6 +161,7 @@ def run(cfg: Config, args, metrics) -> dict:
                        compute_dtype=(jnp.bfloat16
                                       if getattr(args, "dtype", "float32")
                                       == "bfloat16" else None))
+    _log_collisions(metrics, data["cat"], cfg.table.num_slots)
     batches = BatchIterator(data, cfg.train.batch_size, seed=cfg.train.seed)
     loop = TrainLoop(lambda b: ps(ps.shard_batch(b)), batches,
                      metrics=metrics, log_every=cfg.train.log_every,
@@ -264,6 +281,9 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
 
     slots = cfg.table.num_slots
     emb_dim = cfg.table.dim
+    # per-rank measured collision accounting for the hashed tables (the
+    # multiproc twin of _log_collisions; same salts)
+    coll = _log_collisions(metrics, data["cat"], slots)
     updater = cfg.table.updater  # sgd/adagrad/adam all server-side now
     mk = lambda name, dim, scale, seed: ShardedTable(  # noqa: E731
         name, slots, dim, bus, rank, nprocs, updater=updater,
@@ -380,6 +400,8 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
         emit_multiproc_done(
             trainer, rank, t0, losses, table_bytes, fp,
             auc=auc_val, resumed_from=start_iter,
+            emb_collision_rate=coll["emb"]["collision_rate"],
+            emb_unique_keys=coll["emb"]["unique_keys"],
             # embedding-table wire alone: the row-sparse claim is about
             # these (the deep tower is inherently dense-range traffic)
             sparse_bytes_pushed=wide_t.bytes_pushed + emb_t.bytes_pushed)

@@ -51,6 +51,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.mailbox_free_buf.restype = None
     lib.mailbox_close.argtypes = [ctypes.c_void_p]
     lib.mailbox_close.restype = None
+    lib.mailbox_outbox_depth.argtypes = [ctypes.c_void_p]
+    lib.mailbox_outbox_depth.restype = ctypes.c_int64
+    lib.mailbox_dropped.argtypes = [ctypes.c_void_p]
+    lib.mailbox_dropped.restype = ctypes.c_int64
+    lib.mailbox_set_outbox_cap.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.mailbox_set_outbox_cap.restype = None
+    lib.mailbox_interrupt.argtypes = [ctypes.c_void_p]
+    lib.mailbox_interrupt.restype = None
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -83,8 +91,14 @@ class NativeControlBus:
         lib = _load()
         if lib is None:
             raise RuntimeError("native mailbox library unavailable")
+        from minips_tpu.comm.bus import FrameLossTracker
+
         self.my_id = my_id
         self.bytes_sent = 0
+        self.loss = FrameLossTracker()
+        self._n_world = len(peer_addrs) + 1
+        self._bseq = 0                       # broadcast-stream seq
+        self._dseq = [0] * self._n_world     # per-dest directed seq
         self._lib = lib
         _, port = _parse_addr(my_addr)
         self._h = lib.mailbox_create(port)
@@ -96,10 +110,14 @@ class NativeControlBus:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._closed = False
-        # Serializes publish() against close(): the C publish call must
-        # never run concurrently with (or after) mailbox_close freeing the
-        # Mailbox — a late heartbeat publish would be a use-after-free.
+        # Guards handle liveness + seq stamping. The C publish itself can
+        # BLOCK under backpressure (bounded outbox), so it runs OUTSIDE
+        # the lock with an in-flight count; close() interrupts pending
+        # bounded pushes, waits the count to zero, then frees the handle
+        # — no use-after-free, and no 30s teardown stall.
         self._h_lock = threading.Lock()
+        self._h_cond = threading.Condition(self._h_lock)
+        self._inflight = 0
 
     @staticmethod
     def available() -> bool:
@@ -134,9 +152,12 @@ class NativeControlBus:
 
     def publish(self, kind: str, payload: dict,
                 blob: Optional[bytes] = None) -> None:
-        """Nonblocking: enqueues onto the C++ Sender actor's queue.
-        A publish after close() is a silent no-op (matches zmq's at-worst-
-        an-error behavior rather than a use-after-free)."""
+        """Enqueues onto the C++ Sender actor's bounded queue: nonblocking
+        until the outbox holds its cap (default 8192 frames), then applies
+        producer BACKPRESSURE — blocks up to 30s, after which the frame is
+        counted in ``send_drops`` (never silently lost). A publish after
+        close() is a silent no-op (matches zmq's at-worst-an-error
+        behavior rather than a use-after-free)."""
         self._emit(-1, kind, payload, blob)
 
     def send(self, dest: int, kind: str, payload: dict,
@@ -150,29 +171,80 @@ class NativeControlBus:
         idx = dest if dest < self.my_id else dest - 1
         if not 0 <= idx < len(self._peer_addrs):
             raise ValueError(f"dest rank {dest} out of range")
-        self._emit(idx, kind, payload, blob)
+        self._emit(idx, kind, payload, blob, dest_rank=dest)
 
     def _emit(self, peer_index: int, kind: str, payload: dict,
-              blob: Optional[bytes]) -> None:
-        msg = json.dumps({"kind": kind, "sender": self.my_id,
-                          "payload": payload}).encode()
-        if len(msg) > self.MAX_MSG:
-            raise ValueError(f"control frame {len(msg)}B exceeds the "
-                             f"{self.MAX_MSG}B protocol cap")
+              blob: Optional[bytes], dest_rank: int = -1) -> None:
+        # size caps validated BEFORE seq stamping: a raise after an
+        # increment would leave a permanent stream gap the receiver's
+        # loss tracker reads as a wire drop
         if blob is not None and len(blob) > self.MAX_BLOB:
             raise ValueError(f"blob {len(blob)}B exceeds the "
                              f"{self.MAX_BLOB}B protocol cap")
-        with self._h_lock:
+        head = {"kind": kind, "sender": self.my_id, "payload": payload}
+        probe = json.dumps(head).encode()
+        # stamped header adds <= ~24B ('"bs": <int64>' etc.)
+        if len(probe) + 24 > self.MAX_MSG:
+            raise ValueError(f"control frame {len(probe)}B exceeds the "
+                             f"{self.MAX_MSG}B protocol cap")
+        with self._h_cond:
             if self._closed:
                 return
-            data = None if blob is None else bytes(blob)
-            blen = -1 if blob is None else len(blob)
+            # seq stamping mirrors the zmq backend (FrameLossTracker):
+            # TCP never drops post-connect, so established-stream loss
+            # here means a torn link's tail. Stamped under the lock; the
+            # possibly-BLOCKING C enqueue runs outside it (in-flight
+            # counted) so observability/close() never stall behind 30s of
+            # backpressure. Per-thread program order — what the sharded
+            # PS's push-before-clock argument needs — is unaffected.
+            if not kind.startswith("__"):
+                if peer_index < 0:
+                    head["bs"] = self._bseq
+                    self._bseq += 1
+                else:
+                    head["ds"] = self._dseq[dest_rank]
+                    self._dseq[dest_rank] += 1
+            msg = json.dumps(head).encode()
+            self._inflight += 1
+        data = None if blob is None else bytes(blob)
+        blen = -1 if blob is None else len(blob)
+        try:
             if peer_index < 0:
                 self._lib.mailbox_publish(self._h, msg, len(msg), data, blen)
             else:
                 self._lib.mailbox_send(self._h, peer_index, msg, len(msg),
                                        data, blen)
-            self.bytes_sent += len(msg) + (blen if blen > 0 else 0)
+        finally:
+            with self._h_cond:
+                self._inflight -= 1
+                self.bytes_sent += len(msg) + (blen if blen > 0 else 0)
+                if self._closed and self._inflight == 0:
+                    self._h_cond.notify_all()
+
+    # ---------------------------------------------- queue observability
+    def out_queue_depth(self) -> int:
+        """Frames waiting on the C++ Sender actor (real depth — the zmq
+        backend cannot observe its library-internal queues)."""
+        with self._h_lock:
+            return 0 if self._closed else int(
+                self._lib.mailbox_outbox_depth(self._h))
+
+    @property
+    def send_drops(self) -> int:
+        """Producer-side drops: bounded-outbox pushes that timed out
+        (30s of a full queue). Zero in any healthy job."""
+        with self._h_lock:
+            return 0 if self._closed else int(
+                self._lib.mailbox_dropped(self._h))
+
+    def set_outbox_cap(self, cap: int) -> None:
+        with self._h_lock:
+            if not self._closed:
+                self._lib.mailbox_set_outbox_cap(self._h, int(cap))
+
+    @property
+    def frames_lost(self) -> int:
+        return self.loss.lost
 
     def _recv_loop(self) -> None:
         msg_p = ctypes.c_char_p()
@@ -194,7 +266,7 @@ class NativeControlBus:
                 if blob_p:
                     self._lib.mailbox_free_buf(blob_p)
                 blob_p = ctypes.POINTER(ctypes.c_uint8)()
-            dispatch_message(self._handlers, raw, blob)
+            dispatch_message(self._handlers, raw, blob, loss=self.loss)
 
     def handshake(self, num_processes: int, timeout: float = 15.0) -> None:
         """TCP never drops post-connect, but a peer may publish before OUR
@@ -204,10 +276,17 @@ class NativeControlBus:
         run_handshake(self, num_processes, timeout)
 
     def close(self) -> None:
-        with self._h_lock:  # waits out any in-flight publish, blocks new ones
+        with self._h_cond:
             if self._closed:
                 return
             self._closed = True
+            # wake any publisher blocked in bounded-push backpressure
+            # (its frame counts as dropped — teardown is an error path),
+            # then wait in-flight C calls out before freeing the handle
+            self._lib.mailbox_interrupt(self._h)
+            if not self._h_cond.wait_for(lambda: self._inflight == 0,
+                                         timeout=35.0):
+                return  # a wedged C call: leak the handle, never free it live
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)

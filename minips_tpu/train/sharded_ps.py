@@ -730,6 +730,13 @@ class ShardedPSTrainer:
     def frames_dropped(self) -> int:
         return sum(t.frames_dropped for t in self.tables.values())
 
+    @property
+    def wire_frames_lost(self) -> int:
+        """Bus-level frames provably lost on established streams (zmq HWM
+        drops / torn link tails — comm/bus.py FrameLossTracker). Disjoint
+        from frames_dropped (frames that ARRIVED but were rejected)."""
+        return getattr(self.bus, "frames_lost", 0)
+
     def drop_detail(self) -> dict:
         out = {"malformed": 0, "misrouted": 0, "config": 0}
         for t in self.tables.values():

@@ -31,6 +31,42 @@ def test_bench_cpu_emits_accounted_json():
     assert "warning" not in s
 
 
+def test_sharded_ps_bench_worker_standalone():
+    """Zero-wire baseline mode (no launcher): the worker runs, counts, and
+    reports the protocol fields — the n=1 point of bench_sharded_ps.py."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
+         "--path", "sparse", "--iters", "8", "--warmup", "2",
+         "--rows", "4096", "--batch", "512"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads([ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert out["event"] == "done" and out["nprocs"] == 1
+    assert out["bus"] == "none"
+    assert out["rows_per_sec"] > 0
+    assert out["wire_push_bytes_per_sec"] == 0  # nothing rides a wire
+
+
+@pytest.mark.slow
+def test_sharded_ps_bench_floor_two_processes():
+    """Regression floor for the sharded-PS data path (VERDICT r2 #2): a
+    2-process loopback sparse pull+push must sustain >100k rows/sec per
+    process (measured ~1.5M on this class of host — 15x headroom so CI
+    noise can't flake it) and drop zero frames (asserted in-worker)."""
+    from minips_tpu import launch
+
+    res = launch.run_local_job(
+        2, [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
+            "--path", "sparse", "--iters", "24", "--warmup", "4"],
+        base_port=6590, timeout=240.0)
+    assert len(res) == 2
+    for r in res:
+        assert r["event"] == "done" and r["nprocs"] == 2
+        assert r["rows_per_sec"] > 100_000, r
+        assert r["wire_push_bytes_per_sec"] > 0  # wire actually engaged
+
+
 def test_ssp_schedule_simulation_invariants():
     """The event-driven gate schedule (bench_ssp.simulate_schedule) obeys
     the theory: BSP pays the union of stalls, staleness only helps, zero

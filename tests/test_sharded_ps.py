@@ -290,6 +290,7 @@ def test_sharded_sparse_ssp_three_processes():
     assert all(r["event"] == "done" for r in res)
     for r in res:
         assert r["frames_dropped"] == 0, r  # no silently-lost gradients
+        assert r["wire_frames_lost"] == 0, r  # no HWM/link losses
         assert r["loss_last"] < r["loss_first"], r
         assert r["max_skew_seen"] <= 3  # s + 1 transient bound
         # per-process memory ~ 1/3 of the table (sgd: exactly shard bytes)
@@ -317,6 +318,7 @@ def test_sharded_dense_bsp_agreement():
     assert all(r["event"] == "done" for r in res)
     for r in res:
         assert r["frames_dropped"] == 0, r  # no silently-lost gradients
+        assert r["wire_frames_lost"] == 0, r  # no HWM/link losses
         assert r["loss_last"] < r["loss_first"] * 0.9, r
         assert r["max_skew_seen"] <= 1  # BSP lockstep
         # adam: shard + moments + step counters, still 1/3 each

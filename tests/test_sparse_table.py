@@ -253,3 +253,51 @@ def test_hash_to_slots_np_matches_jax_twin():
             got = hash_to_slots_np(keys, slots, salt)
             want = np.asarray(hash_to_slots(jnp.asarray(keys), slots, salt))
             np.testing.assert_array_equal(got, want.astype(np.int64))
+
+
+def test_hash_to_slots_np_identity_matches_jax_twin():
+    from minips_tpu.tables.sparse import hash_to_slots_np
+
+    keys = np.array([0, 5, 127, 128, 300, -1])
+    got = hash_to_slots_np(keys, 128, identity=True)
+    want = np.asarray(hash_to_slots(jnp.asarray(keys), 128, identity=True))
+    np.testing.assert_array_equal(got, want.astype(np.int64))
+
+
+def test_collision_stats_identity_dense_ids_zero():
+    """Identity mapping on a dense 0-based id space that fits the table =
+    the reference's exact per-key MapStorage semantics: measured collision
+    rate must be exactly 0 (VERDICT r2 #5 done-criterion)."""
+    from minips_tpu.tables.sparse import collision_stats
+
+    st = collision_stats(np.arange(1000), 1 << 10, identity=True)
+    assert st["collision_rate"] == 0.0
+    assert st["expected_rate"] == 0.0
+    assert st["unique_keys"] == st["unique_slots"] == 1000
+    assert st["sampled"] is False
+
+
+def test_collision_stats_hashed_tracks_uniform_expectation():
+    """The multiplicative hash's measured rate must sit near the uniform-
+    hash expectation 1 - S(1-(1-1/S)^U)/U — a clumpy hash (or a sizing
+    bug) shows up as measured >> expected."""
+    from minips_tpu.tables.sparse import collision_stats
+
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 1 << 40, size=20000)
+    st = collision_stats(keys, 1 << 16, salt=2)
+    assert 0 < st["collision_rate"] < 1
+    assert st["expected_rate"] > 0
+    # within 2x either way of the uniform model (binomial fluctuation at
+    # U=20k is far tighter; 2x headroom keeps the test hash-seed-proof)
+    assert st["expected_rate"] / 2 < st["collision_rate"] \
+        < st["expected_rate"] * 2, st
+
+
+def test_collision_stats_sampling_path():
+    from minips_tpu.tables.sparse import collision_stats
+
+    keys = np.arange(5000) % 700  # duplicates: U=700
+    st = collision_stats(keys, 1 << 12, max_sample=1000)
+    assert st["sampled"] is True
+    assert st["unique_keys"] <= 700
