@@ -242,7 +242,8 @@ def bench_lm(args, n_chips, peak):
     attn = "flash" if jax.default_backend() == "tpu" else "reference"
     step = table.make_step(
         functools.partial(tfm.grad_fn, heads=heads, attn_impl=attn,
-                          remat=bool(args.lm_remat)),
+                          remat=bool(args.lm_remat),
+                          head_chunk=args.lm_head_chunk),
         jit=False, compute_dtype=jnp.bfloat16)
 
     from jax.sharding import NamedSharding
@@ -486,26 +487,13 @@ def bench_ps(args) -> dict:
     updater) on host CPUs; it is deliberately NOT a chip rate and never
     feeds vs_baseline. bench_sharded_ps.py publishes the full curve
     (world sizes 1–4, zmq vs native mailbox, sparse vs dense range)."""
-    import os
+    from bench_sharded_ps import _run  # ONE spawn/aggregate protocol
 
-    from minips_tpu import launch
-
-    port = 6500 + (os.getpid() % 397)
-    res = launch.run_local_job(
-        3, [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
-            "--path", "sparse", "--iters", str(args.ps_iters),
-            "--warmup", str(max(2, args.ps_iters // 6))],
-        base_port=port, timeout=240.0)
-    per_proc = [r["rows_per_sec"] for r in res]
-    wire = [r["wire_push_bytes_per_sec"] + r["wire_pull_bytes_per_sec"]
-            for r in res]
-    return {
-        "rows_per_sec_per_process": round(statistics.mean(per_proc), 1),
-        "aggregate_rows_per_sec": round(sum(per_proc), 1),
-        "wire_bytes_per_sec_per_process": round(statistics.mean(wire), 1),
-        "nprocs": 3, "bus": "zmq", "path": "sparse",
-        "compute": "cpu-loopback-control-plane",
-    }
+    out = _run(3, "sparse", args.ps_iters, max(2, args.ps_iters // 6),
+               "zmq")
+    out.update(nprocs=3, bus="zmq", path="sparse",
+               compute="cpu-loopback-control-plane")
+    return out
 
 
 def _run_all(args) -> int:
@@ -533,6 +521,7 @@ def _run_all(args) -> int:
                 "--lm-dim", str(args.lm_dim),
                 "--lm-depth", str(args.lm_depth),
                 *(["--lm-remat"] if args.lm_remat else []),
+                "--lm-head-chunk", str(args.lm_head_chunk),
                 "--wd-slots", str(args.wd_slots),
                 "--e2e-rows", str(args.e2e_rows),
                 "--e2e-batch", str(args.e2e_batch),
@@ -594,6 +583,10 @@ def main() -> int:
     ap.add_argument("--lm-remat", action="store_true",
                     help="recompute block activations in backward "
                          "(fits larger --lm-dim/--lm-depth in HBM)")
+    ap.add_argument("--lm-head-chunk", type=int, default=0,
+                    help="sequence-chunked tied head + CE: the [B,T,vocab]"
+                         " logits never materialize (models/transformer.py"
+                         " nll_chunked); 0 = plain head")
     ap.add_argument("--wd-slots", type=int, default=1 << 22)
     # 512k rows ≈ 0.7s of steady-state pipeline at the measured rate — a
     # 131k-row run finishes in ~0.2s, short enough for tunnel jitter to

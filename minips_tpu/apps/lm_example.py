@@ -81,6 +81,10 @@ def _flags(parser):
     parser.add_argument("--resume", action="store_true",
                         help="dp/sp: restore newest checkpoint before "
                              "training")
+    parser.add_argument("--head_chunk", type=int, default=0,
+                        help="sequence-chunked tied head + cross-entropy "
+                             "(the [B,T,vocab] logits never materialize); "
+                             "0 = plain head. dp layout only")
     parser.add_argument("--remat", action="store_true",
                         help="recompute block activations in backward "
                              "(jax.checkpoint): depth stops driving peak "
@@ -152,6 +156,9 @@ def run(cfg: Config, args, metrics) -> dict:
         # per shard); silently ignoring the flag would misreport memory
         raise SystemExit(f"--remat is only wired into --layout dp "
                          f"(got {layout})")
+    if layout != "dp" and getattr(args, "head_chunk", 0):
+        raise SystemExit(f"--head_chunk is only wired into --layout dp "
+                         f"(got {layout})")
     if layout in ("tp", "pp"):
         return _run_model_parallel(cfg, args, metrics, layout, seq_len)
     if layout == "ep":
@@ -179,7 +186,8 @@ def run(cfg: Config, args, metrics) -> dict:
         step = table.make_step(
             functools.partial(tfm.grad_fn, heads=heads,
                               attn_impl=getattr(args, "attn", "reference"),
-                              remat=getattr(args, "remat", False)),
+                              remat=getattr(args, "remat", False),
+                              head_chunk=getattr(args, "head_chunk", 0)),
             batch_spec=P(DATA_AXIS), accum=accum,
             compute_dtype=compute_dtype, comm=comm)
         batch_sharding = NamedSharding(mesh, P(DATA_AXIS))

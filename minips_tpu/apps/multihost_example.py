@@ -37,10 +37,19 @@ import time
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--model", default="lr", choices=["lr", "wd"],
+                    help="lr: DenseTable LR (checkpoint drill supported); "
+                         "wd: the flagship DeepFM fused step — hashed "
+                         "SparseTables + deep tower over the GLOBAL mesh, "
+                         "collectives crossing the process boundary")
+    ap.add_argument("--num-slots", type=int, default=1 << 14)
     ap.add_argument("--batch", type=int, default=64,
                     help="GLOBAL batch size (split across processes)")
-    ap.add_argument("--dim", type=int, default=16)
-    ap.add_argument("--lr", type=float, default=0.3)
+    ap.add_argument("--dim", type=int, default=None,
+                    help="lr: feature dim (default 16); wd: embedding "
+                         "dim (default 8)")
+    ap.add_argument("--lr", type=float, default=None,
+                    help="default: 0.3 (lr model) / 0.05 (wd)")
     ap.add_argument("--updater", default="adagrad",
                     choices=["sgd", "adagrad", "adam"])
     ap.add_argument("--seed", type=int, default=0)
@@ -50,6 +59,10 @@ def main(argv=None) -> int:
     ap.add_argument("--save-at", type=int, default=0,
                     help="iteration AFTER which to save (0 = at the end)")
     args = ap.parse_args(argv)
+    if args.dim is None:  # per-model default: lr feature dim / wd emb dim
+        args.dim = 16 if args.model == "lr" else 8
+    if args.lr is None:
+        args.lr = 0.3 if args.model == "lr" else 0.05
     if args.save_at > args.iters:
         ap.error(f"--save-at {args.save_at} exceeds --iters {args.iters}: "
                  "the restore drill would read a checkpoint never saved")
@@ -80,10 +93,6 @@ def main(argv=None) -> int:
     from minips_tpu.tables.dense import DenseTable
 
     mesh = make_mesh(len(jax.devices()))  # ONE mesh over every process
-    dt = DenseTable(lr_model.init(args.dim), mesh, updater=args.updater,
-                    lr=args.lr)
-    step = dt.make_step(lr_model.grad_fn_dense)
-
     B, D = args.batch, args.dim
     if B % nprocs:
         raise SystemExit(f"--batch {B} must divide by {nprocs} processes")
@@ -93,6 +102,13 @@ def main(argv=None) -> int:
     # train on the same data and must produce the same losses (the smoke's
     # parity assertion)
     rng = np.random.default_rng(args.seed)
+
+    if args.model == "wd":
+        return _run_wd(args, mesh, rank, nprocs, per, multi, rng)
+
+    dt = DenseTable(lr_model.init(args.dim), mesh, updater=args.updater,
+                    lr=args.lr)
+    step = dt.make_step(lr_model.grad_fn_dense)
     w_true = rng.normal(size=D)
 
     def next_global():
@@ -161,6 +177,63 @@ def main(argv=None) -> int:
         "losses": [round(x, 8) for x in losses],
         "param_fingerprint": fp,
         "ckpt_roundtrip_ok": ckpt_ok,
+    }), flush=True)
+    return 0
+
+
+def _run_wd(args, mesh, rank, nprocs, per, multi, rng):
+    """Flagship DeepFM over the global multi-process mesh: hashed
+    SparseTables (wide + field embeddings) and the dense deep tower,
+    one fused PSTrainStep whose gathers/scatters and grad collectives
+    cross the process boundary — the sparse-embedding-PS-on-a-pod story
+    (BASELINE.json config 4) on real processes. Traffic stays batch-sized
+    by the same GSPMD shardings tests/test_sharded_traffic.py pins."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from minips_tpu.apps.wide_deep_example import build
+    from minips_tpu.comm import cluster
+    from minips_tpu.core.config import Config, TableConfig, TrainConfig
+    from minips_tpu.data import synthetic
+
+    t0 = time.monotonic()
+    cfg = Config(
+        table=TableConfig(name="ctr", kind="sparse", updater=args.updater,
+                          lr=args.lr, dim=args.dim,
+                          num_slots=args.num_slots),
+        train=TrainConfig(batch_size=args.batch, num_iters=args.iters),
+    )
+    ps, (wide_t, emb_t, deep_t) = build(cfg, use_fm=True, mesh=mesh,
+                                        seed=args.seed)
+    # ONE dataset (one ground truth), identical on every rank; batches are
+    # sampled from it with a shared stream and each rank feeds its slice
+    data = synthetic.criteo_like(8192, seed=args.seed)
+    losses = []
+    for i in range(args.iters):
+        sel = rng.integers(0, data["y"].shape[0], size=args.batch)
+        lo, hi = rank * per, (rank + 1) * per
+        batch = cluster.global_batch(
+            mesh, {k: v[sel][lo:hi] for k, v in data.items()})
+        losses.append(float(ps(batch)))
+
+    fp = float(cluster.host_copy(emb_t.emb).sum()) \
+        + float(cluster.host_copy(deep_t.params).sum())
+    cluster.barrier("multihost_wd_done")
+    import json
+    print(json.dumps({
+        "rank": rank, "event": "done", "model": "wd",
+        "wall_s": round(time.monotonic() - t0, 4),
+        "multi": multi,
+        "process_count": nprocs,
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+        "loss_first": losses[0], "loss_last": losses[-1],
+        "losses": [round(x, 8) for x in losses],
+        "param_fingerprint": fp,
+        "ckpt_roundtrip_ok": None,
+        "emb_slots": int(args.num_slots),
     }), flush=True)
     return 0
 

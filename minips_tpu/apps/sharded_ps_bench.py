@@ -42,6 +42,10 @@ def main(argv=None) -> int:
     ap.add_argument("--updater", choices=["sgd", "adagrad", "adam"],
                     default="adagrad")
     args = ap.parse_args(argv)
+    if args.warmup >= args.iters:
+        ap.error(f"--warmup {args.warmup} must be < --iters {args.iters} "
+                 "(otherwise the timer never starts and every rate is "
+                 "garbage)")
 
     from minips_tpu.train.sharded_ps import ShardedPSTrainer, ShardedTable
 

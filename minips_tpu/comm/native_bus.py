@@ -110,13 +110,19 @@ class NativeControlBus:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._closed = False
-        # Guards handle liveness + seq stamping. The C publish itself can
-        # BLOCK under backpressure (bounded outbox), so it runs OUTSIDE
-        # the lock with an in-flight count; close() interrupts pending
-        # bounded pushes, waits the count to zero, then frees the handle
-        # — no use-after-free, and no 30s teardown stall.
+        # TWO locks, two concerns:
+        # - _seq_lock holds across stamp AND the C enqueue, so wire order
+        #   equals seq order even with concurrent publishers (a stamped-
+        #   then-preempted frame enqueued late would read as phantom
+        #   wire loss at every receiver).
+        # - _life (condition) tracks handle liveness + in-flight C calls:
+        #   close() interrupts pending bounded pushes, waits the count to
+        #   zero, then frees the handle — no use-after-free, and depth/
+        #   drop observability never queues behind a 30s backpressure
+        #   stall (it takes only _life).
+        self._seq_lock = threading.Lock()
         self._h_lock = threading.Lock()
-        self._h_cond = threading.Condition(self._h_lock)
+        self._life = threading.Condition(self._h_lock)
         self._inflight = 0
 
     @staticmethod
@@ -187,16 +193,16 @@ class NativeControlBus:
         if len(probe) + 24 > self.MAX_MSG:
             raise ValueError(f"control frame {len(probe)}B exceeds the "
                              f"{self.MAX_MSG}B protocol cap")
-        with self._h_cond:
-            if self._closed:
-                return
+        with self._seq_lock:
+            with self._life:
+                if self._closed:
+                    return
+                self._inflight += 1
             # seq stamping mirrors the zmq backend (FrameLossTracker):
             # TCP never drops post-connect, so established-stream loss
-            # here means a torn link's tail. Stamped under the lock; the
-            # possibly-BLOCKING C enqueue runs outside it (in-flight
-            # counted) so observability/close() never stall behind 30s of
-            # backpressure. Per-thread program order — what the sharded
-            # PS's push-before-clock argument needs — is unaffected.
+            # here means a torn link's tail. Stamp AND enqueue under
+            # _seq_lock: wire order must equal seq order across threads
+            # (a reordered pair would count as phantom loss forever).
             if not kind.startswith("__"):
                 if peer_index < 0:
                     head["bs"] = self._bseq
@@ -205,21 +211,25 @@ class NativeControlBus:
                     head["ds"] = self._dseq[dest_rank]
                     self._dseq[dest_rank] += 1
             msg = json.dumps(head).encode()
-            self._inflight += 1
-        data = None if blob is None else bytes(blob)
-        blen = -1 if blob is None else len(blob)
-        try:
-            if peer_index < 0:
-                self._lib.mailbox_publish(self._h, msg, len(msg), data, blen)
-            else:
-                self._lib.mailbox_send(self._h, peer_index, msg, len(msg),
-                                       data, blen)
-        finally:
-            with self._h_cond:
-                self._inflight -= 1
-                self.bytes_sent += len(msg) + (blen if blen > 0 else 0)
-                if self._closed and self._inflight == 0:
-                    self._h_cond.notify_all()
+            data = None if blob is None else bytes(blob)
+            blen = -1 if blob is None else len(blob)
+            try:
+                # may BLOCK under backpressure (bounded outbox); close()
+                # unblocks it via mailbox_interrupt without needing
+                # _seq_lock, and the in-flight count keeps the handle
+                # alive until this call returns
+                if peer_index < 0:
+                    self._lib.mailbox_publish(self._h, msg, len(msg),
+                                              data, blen)
+                else:
+                    self._lib.mailbox_send(self._h, peer_index, msg,
+                                           len(msg), data, blen)
+            finally:
+                with self._life:
+                    self._inflight -= 1
+                    self.bytes_sent += len(msg) + (blen if blen > 0 else 0)
+                    if self._closed and self._inflight == 0:
+                        self._life.notify_all()
 
     # ---------------------------------------------- queue observability
     def out_queue_depth(self) -> int:
@@ -276,7 +286,7 @@ class NativeControlBus:
         run_handshake(self, num_processes, timeout)
 
     def close(self) -> None:
-        with self._h_cond:
+        with self._life:
             if self._closed:
                 return
             self._closed = True
@@ -284,8 +294,8 @@ class NativeControlBus:
             # (its frame counts as dropped — teardown is an error path),
             # then wait in-flight C calls out before freeing the handle
             self._lib.mailbox_interrupt(self._h)
-            if not self._h_cond.wait_for(lambda: self._inflight == 0,
-                                         timeout=35.0):
+            if not self._life.wait_for(lambda: self._inflight == 0,
+                                       timeout=35.0):
                 return  # a wedged C call: leak the handle, never free it live
         self._stop.set()
         if self._thread is not None:

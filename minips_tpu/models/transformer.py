@@ -107,7 +107,8 @@ def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None,
 
 
 def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
-             psum_axis=None, apply_blocks=None, ffn_fn=None, remat=False):
+             psum_axis=None, apply_blocks=None, ffn_fn=None, remat=False,
+             head=True):
     """Returns (logits, total aux loss) — aux is nonzero only for MoE
     ``ffn_fn`` blocks; the plain ``apply*`` wrappers drop it. ``remat``
     wraps each block in ``jax.checkpoint`` so the backward pass recomputes
@@ -136,6 +137,8 @@ def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
                               psum_axis, ffn_fn)
             aux_total = aux_total + aux
     h = _ln(h, params["ln_f"])
+    if not head:  # chunked-CE path applies the tied head itself
+        return h, aux_total
     # weight-tied head
     logits = (h.astype(compute_dtype)
               @ params["tok_emb"].T.astype(compute_dtype)).astype(jnp.float32)
@@ -378,24 +381,74 @@ def nll(logits, targets):
         -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0])
 
 
+def nll_chunked(h, tok_emb, targets, chunk, compute_dtype=jnp.bfloat16):
+    """Tied-head projection + cross-entropy, scanned over sequence chunks
+    so the full ``[B, T, vocab]`` f32 logits tensor NEVER exists — in the
+    forward (each chunk's logits die inside its scan step) or the backward
+    (``jax.checkpoint`` recomputes one chunk's logits to form its
+    ``dlogits``/``dh``). At bench shapes (B=64, T=1024, V=16384) that
+    tensor is 4.3 GB of f32 each way; chunking trades it for one extra
+    per-chunk head matmul in the backward (~vocab·dim of the 6·P budget).
+    Numerics: identical reduction tree to :func:`nll` per chunk, summed in
+    f32 — oracle-equality tested in tests/test_transformer.py."""
+    B, T, D = h.shape
+    if T % chunk:
+        raise ValueError(f"seq len {T} must divide by head chunk {chunk}")
+    n = T // chunk
+    hs = jnp.moveaxis(h.reshape(B, n, chunk, D), 1, 0)        # [n,B,c,D]
+    ts = jnp.moveaxis(targets.reshape(B, n, chunk), 1, 0)     # [n,B,c]
+
+    @jax.checkpoint
+    def chunk_nll_sum(hc, tc):
+        logits = (hc.astype(compute_dtype)
+                  @ tok_emb.T.astype(compute_dtype)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, tc[..., None], axis=-1).sum()
+
+    def body(acc, xt):
+        hc, tc = xt
+        return acc + chunk_nll_sum(hc, tc), None
+
+    # under shard_map the fresh carry is axis-invariant but the chunk sums
+    # vary with the sharded inputs — pcast keeps the scan carry type fixed
+    # (same treatment as DenseTable.make_step's accum fold)
+    acc0 = jnp.zeros((), jnp.float32)
+    vma = (getattr(jax.typeof(h), "vma", frozenset())
+           | getattr(jax.typeof(targets), "vma", frozenset()))
+    if vma:
+        acc0 = jax.lax.pcast(acc0, tuple(sorted(vma)), to="varying")
+    total, _ = jax.lax.scan(body, acc0, (hs, ts))
+    return total / (B * T)
+
+
 def loss(params, batch, *, heads=4, compute_dtype=jnp.bfloat16,
-         attn_impl="reference", remat=False):
+         attn_impl="reference", remat=False, head_chunk=0):
     """Next-token cross-entropy; batch = {"tokens": [B, T+1] int32}.
     ``remat=True`` recomputes block activations in the backward pass —
     activation memory stops scaling with depth, the standard trade for
     fitting larger models (SURVEY brief: jax.checkpoint to trade FLOPs
-    for HBM)."""
+    for HBM). ``head_chunk > 0`` computes the tied head + CE in sequence
+    chunks of that size (:func:`nll_chunked`) so the [B, T, vocab] logits
+    never materialize."""
     toks = batch["tokens"]
+    if head_chunk:
+        T = toks.shape[1] - 1
+        h, _ = _forward(params, toks[:, :-1], jnp.arange(T), heads,
+                        _attn_fn(attn_impl), compute_dtype, remat=remat,
+                        head=False)
+        return nll_chunked(h, params["tok_emb"], toks[:, 1:], head_chunk,
+                           compute_dtype)
     logits = apply(params, toks[:, :-1], heads=heads,
                    compute_dtype=compute_dtype, attn_impl=attn_impl,
                    remat=remat)
     return nll(logits, toks[:, 1:])
 
 
-def grad_fn(params, batch, *, heads=4, attn_impl="reference", remat=False):
+def grad_fn(params, batch, *, heads=4, attn_impl="reference", remat=False,
+            head_chunk=0):
     l, g = jax.value_and_grad(
         lambda p, b: loss(p, b, heads=heads, attn_impl=attn_impl,
-                          remat=remat))(params, batch)
+                          remat=remat, head_chunk=head_chunk))(params, batch)
     return l, g
 
 

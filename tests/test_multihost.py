@@ -139,6 +139,25 @@ def test_two_process_global_mesh_trains_and_checkpoints(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_wd_sparse_tables_on_global_mesh():
+    """The flagship sparse workload multi-host: DeepFM's hashed
+    SparseTables + deep tower as ONE fused step over the 2-process global
+    mesh — embedding gathers/scatter-adds and grad collectives cross the
+    process boundary; both ranks converge identically (the 2-proc ≡
+    1-proc equality itself is pinned by the LR parity test below — one
+    oracle rerun in the tier is enough for the suite's time budget)."""
+    res = _run_multihost(2, ["--model", "wd", "--iters", "12",
+                             "--batch", "64"])
+    assert len(res) == 2
+    for r in res:
+        assert r["event"] == "done" and r["multi"] is True
+        assert r["global_devices"] == 8
+        assert r["loss_last"] < r["loss_first"], r
+    assert res[0]["losses"] == res[1]["losses"]
+    assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
+
+
+@pytest.mark.slow
 def test_two_process_loss_parity_with_single_process():
     """2 processes x 4 devices must train EXACTLY like 1 process x 8
     devices on the same global batch stream — the distributed data plane
