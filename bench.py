@@ -240,10 +240,13 @@ def bench_lm(args, n_chips, peak):
                       heads=heads, depth=depth, max_len=T)
     table = DenseTable(params, mesh, name="lm", updater="adam", lr=1e-3)
     attn = "flash" if jax.default_backend() == "tpu" else "reference"
+    remat = False
+    if args.lm_remat:
+        remat = (True if args.lm_remat_mode == "full"
+                 else args.lm_remat_mode)
     step = table.make_step(
         functools.partial(tfm.grad_fn, heads=heads, attn_impl=attn,
-                          remat=bool(args.lm_remat),
-                          head_chunk=args.lm_head_chunk),
+                          remat=remat, head_chunk=args.lm_head_chunk),
         jit=False, compute_dtype=jnp.bfloat16)
 
     from jax.sharding import NamedSharding
@@ -521,6 +524,7 @@ def _run_all(args) -> int:
                 "--lm-dim", str(args.lm_dim),
                 "--lm-depth", str(args.lm_depth),
                 *(["--lm-remat"] if args.lm_remat else []),
+                "--lm-remat-mode", args.lm_remat_mode,
                 "--lm-head-chunk", str(args.lm_head_chunk),
                 "--wd-slots", str(args.wd_slots),
                 "--e2e-rows", str(args.e2e_rows),
@@ -583,6 +587,12 @@ def main() -> int:
     ap.add_argument("--lm-remat", action="store_true",
                     help="recompute block activations in backward "
                          "(fits larger --lm-dim/--lm-depth in HBM)")
+    ap.add_argument("--lm-remat-mode", default="full",
+                    choices=["full", "attn", "dots"],
+                    help="with --lm-remat: full = recompute whole blocks; "
+                         "attn = save attention outputs (backward never "
+                         "re-runs attention); dots = save matmul outputs "
+                         "(recompute only elementwise)")
     ap.add_argument("--lm-head-chunk", type=int, default=0,
                     help="sequence-chunked tied head + CE: the [B,T,vocab]"
                          " logits never materialize (models/transformer.py"

@@ -85,6 +85,12 @@ def _flags(parser):
                         help="sequence-chunked tied head + cross-entropy "
                              "(the [B,T,vocab] logits never materialize); "
                              "0 = plain head. dp layout only")
+    parser.add_argument("--remat_mode", default="full",
+                        choices=["full", "attn", "dots"],
+                        help="with --remat: full = recompute whole "
+                             "blocks; attn = save attention outputs; "
+                             "dots = save matmul outputs (see "
+                             "transformer._remat_policy)")
     parser.add_argument("--remat", action="store_true",
                         help="recompute block activations in backward "
                              "(jax.checkpoint): depth stops driving peak "
@@ -183,10 +189,13 @@ def run(cfg: Config, args, metrics) -> dict:
                      if getattr(args, "dtype", "float32") == "bfloat16"
                      else None)
     if layout == "dp":
+        remat = getattr(args, "remat", False)
+        if remat and getattr(args, "remat_mode", "full") != "full":
+            remat = args.remat_mode
         step = table.make_step(
             functools.partial(tfm.grad_fn, heads=heads,
                               attn_impl=getattr(args, "attn", "reference"),
-                              remat=getattr(args, "remat", False),
+                              remat=remat,
                               head_chunk=getattr(args, "head_chunk", 0)),
             batch_spec=P(DATA_AXIS), accum=accum,
             compute_dtype=compute_dtype, comm=comm)

@@ -89,6 +89,12 @@ def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None,
     k = k.reshape(B, T, local_heads, hd)
     v = v.reshape(B, T, local_heads, hd)
     a = attn_fn(q, k, v).reshape(B, T, -1)
+    # named for selective remat: remat="attn" saves exactly this tensor,
+    # so the backward never re-runs the attention itself (the priciest
+    # recompute per byte: flash kernels + T^2 math) while everything else
+    # still recomputes
+    from jax.ad_checkpoint import checkpoint_name
+    a = checkpoint_name(a, "attn_out")
     att = (a.astype(compute_dtype)
            @ blk["proj"].astype(compute_dtype)).astype(jnp.float32)
     if psum_axis is not None:
@@ -131,7 +137,8 @@ def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
         block_fn = _block
         if remat:
             block_fn = jax.checkpoint(
-                _block, static_argnums=(2, 3, 4, 5, 6))
+                _block, static_argnums=(2, 3, 4, 5, 6),
+                policy=_remat_policy(remat))
         for blk in params["blocks"]:
             h, aux = block_fn(h, blk, heads, attn_fn, compute_dtype,
                               psum_axis, ffn_fn)
@@ -143,6 +150,30 @@ def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
     logits = (h.astype(compute_dtype)
               @ params["tok_emb"].T.astype(compute_dtype)).astype(jnp.float32)
     return logits, aux_total
+
+
+def _remat_policy(remat):
+    """Rematerialization spectrum for the block checkpoint — the
+    FLOPs↔HBM dial (SURVEY brief: jax.checkpoint to trade FLOPs for
+    memory):
+
+    - ``True``  — save only block inputs; backward recomputes the whole
+      block (max memory savings, +1/3 executed FLOPs).
+    - ``"attn"`` — additionally save each block's attention output
+      (checkpoint_name above): the backward re-runs the matmuls but never
+      the attention itself. Costs one [B, T, D] f32 per block.
+    - ``"dots"`` — save every matmul output, recompute only elementwise
+      (LN/gelu/softmax): near-zero recompute, the memory win is only the
+      elementwise intermediates.
+    """
+    if remat is True:
+        return None
+    if remat == "attn":
+        return jax.checkpoint_policies.save_only_these_names("attn_out")
+    if remat == "dots":
+        return jax.checkpoint_policies.checkpoint_dots
+    raise ValueError(f"unknown remat mode {remat!r} "
+                     "(expected True/False, 'attn' or 'dots')")
 
 
 def _attn_fn(attn_impl: str):
