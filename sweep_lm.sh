@@ -1,0 +1,25 @@
+#!/bin/bash
+# LM MFU frontier sweep (VERDICT r2 #7). Run on an idle chip; each line
+# prints "config -> tok/s TF/s MFU". Results land in BASELINE.md.
+cd "$(dirname "$0")"
+run() {
+  echo "=== $*"
+  timeout 500 python bench.py --suite lm "$@" 2>/dev/null | python -c "
+import sys, json
+try:
+    d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+    s = d['suites']['lm']
+    print(' ', s['samples_per_sec_per_chip'], 'tok/s,', s['tflops_per_chip'], 'TF/s, MFU', s['mfu_vs_bf16_peak'], '('+d['device']+')')
+except Exception as e:
+    print('  FAILED', e)
+"
+}
+run --lm-dim 512  --lm-depth 4 --lm-batch 64                                     # r2 baseline 26.7%
+run --lm-dim 2048 --lm-depth 8 --lm-batch 64 --lm-remat --lm-head-chunk 128      # r2 35.8% + chunked head
+run --lm-dim 2048 --lm-depth 8 --lm-batch 64 --lm-remat --lm-remat-mode attn --lm-head-chunk 128
+run --lm-dim 2048 --lm-depth 8 --lm-batch 32 --lm-remat --lm-remat-mode attn --lm-head-chunk 128
+run --lm-dim 2048 --lm-depth 8 --lm-batch 32 --lm-remat --lm-remat-mode dots --lm-head-chunk 128
+run --lm-dim 2048 --lm-depth 4 --lm-batch 32 --lm-head-chunk 128                 # no remat at all
+run --lm-dim 1024 --lm-depth 8 --lm-batch 32 --lm-head-chunk 128
+run --lm-dim 1024 --lm-depth 8 --lm-batch 64 --lm-remat --lm-remat-mode dots --lm-head-chunk 128
+run --lm-dim 4096 --lm-depth 4 --lm-batch 32 --lm-remat --lm-head-chunk 128
