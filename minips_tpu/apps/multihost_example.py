@@ -34,6 +34,51 @@ import sys
 import time
 
 
+class _Watchdog:
+    """Fast failure detection for multi-host SPMD jobs (SURVEY §5.3): a
+    peer death leaves the survivors BLOCKED inside a collective — the
+    host thread cannot poll anything — so detection rides the
+    HeartbeatMonitor's own thread via its ``on_failure`` callback (2s
+    timeout over the launcher's control bus): print the structured
+    peer_failure event and exit 42, the same protocol as the sharded-PS
+    apps. Recovery is the all-or-nothing relaunch + checkpoint restore
+    the reference uses (SURVEY §3.5, §7.4.5); jax's own coordination
+    service is the ~100s backstop for deaths in the disarm→barrier
+    window."""
+
+    def __init__(self, rank: int):
+        from minips_tpu.comm.heartbeat import HeartbeatMonitor
+        from minips_tpu.launch import init_from_env
+
+        _, n, self.bus = init_from_env()
+        self.monitor = None
+        self._armed = True
+        if self.bus is None:
+            return
+
+        def on_dead(peer: int) -> None:
+            if self._armed:
+                print(json.dumps({"rank": rank, "event": "peer_failure",
+                                  "dead": [peer]}), flush=True)
+                os._exit(42)
+
+        self.monitor = HeartbeatMonitor(
+            self.bus, peer_ids=list(range(n)), interval=0.2,
+            timeout=2.0, on_failure=on_dead).start()
+
+    def disarm(self) -> None:
+        """Call once training is complete, BEFORE the final barrier: a
+        peer closing its bus after finishing must not read as a death."""
+        self._armed = False
+
+    def close(self) -> None:
+        self.disarm()
+        if self.monitor is not None:
+            self.monitor.stop()
+        if self.bus is not None:
+            self.bus.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=30)
@@ -58,6 +103,11 @@ def main(argv=None) -> int:
                          "save→restore drill (skipped when absent)")
     ap.add_argument("--save-at", type=int, default=0,
                     help="iteration AFTER which to save (0 = at the end)")
+    ap.add_argument("--restore-from", type=int, default=0,
+                    help="restore the step-N checkpoint before training "
+                         "(the relaunch leg of the recovery drill)")
+    ap.add_argument("--kill-at", type=int, default=0)
+    ap.add_argument("--kill-rank", type=int, default=-1)
     args = ap.parse_args(argv)
     if args.dim is None:  # per-model default: lr feature dim / wd emb dim
         args.dim = 16 if args.model == "lr" else 8
@@ -66,6 +116,9 @@ def main(argv=None) -> int:
     if args.save_at > args.iters:
         ap.error(f"--save-at {args.save_at} exceeds --iters {args.iters}: "
                  "the restore drill would read a checkpoint never saved")
+    if args.restore_from >= args.iters:
+        ap.error(f"--restore-from {args.restore_from} must be < --iters "
+                 f"{args.iters} (nothing left to train)")
 
     # CPU smoke path: fake local devices BEFORE any backend-touching call
     # (the sandbox TPU plugin ignores JAX_PLATFORMS env, hence
@@ -85,6 +138,7 @@ def main(argv=None) -> int:
     multi = cluster.initialize()
     rank = jax.process_index()
     nprocs = jax.process_count()
+    watchdog = _Watchdog(rank)
 
     import numpy as np
 
@@ -104,7 +158,8 @@ def main(argv=None) -> int:
     rng = np.random.default_rng(args.seed)
 
     if args.model == "wd":
-        return _run_wd(args, mesh, rank, nprocs, per, multi, rng)
+        return _run_wd(args, mesh, rank, nprocs, per, multi, rng,
+                       watchdog)
 
     dt = DenseTable(lr_model.init(args.dim), mesh, updater=args.updater,
                     lr=args.lr)
@@ -129,9 +184,27 @@ def main(argv=None) -> int:
         ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
         ocp_args = ocp.args
 
+    start = 0
+    if args.restore_from:  # relaunch leg of the recovery drill
+        if ckptr is None:
+            raise SystemExit("--restore-from needs --checkpoint-dir")
+        restored = ckptr.restore(
+            os.path.join(args.checkpoint_dir, f"step{args.restore_from}"),
+            args=ocp_args.StandardRestore(dt.global_arrays()))
+        dt.params = restored["params"]
+        dt.opt_state = restored["opt_state"]
+        start = args.restore_from
+        # replay the shared batch stream up to the restore point so the
+        # resumed run continues the SAME data sequence (TrainLoop's
+        # fast-forward semantics, here at the multihost smoke's scale)
+        for _ in range(start):
+            next_global()
+
     losses = []
     t0 = time.monotonic()
-    for i in range(args.iters):
+    for i in range(start, args.iters):
+        if args.kill_at and rank == args.kill_rank and i == args.kill_at:
+            os._exit(137)
         x, y = next_global()
         batch = cluster.global_batch(
             mesh, {"x": x[rank * per:(rank + 1) * per],
@@ -150,7 +223,7 @@ def main(argv=None) -> int:
     fp = float(cluster.host_copy(dt.params).sum())
 
     ckpt_ok = None
-    if ckptr is not None:
+    if ckptr is not None and ckpt_fp is not None:
         # restore into a FRESH table (same template/shardings) and check
         # it reproduces the state that was saved — the recovery path of
         # SURVEY.md §3.5 with globally-sharded state
@@ -163,8 +236,10 @@ def main(argv=None) -> int:
         dt2.opt_state = restored["opt_state"]
         ckpt_ok = bool(abs(float(cluster.host_copy(dt2.params).sum())
                            - ckpt_fp) < 1e-5)
+    if ckptr is not None:
         ckptr.close()
 
+    watchdog.disarm()  # peers closing their buses after finishing is fine
     cluster.barrier("multihost_done")  # reference Engine::Barrier
     print(json.dumps({
         "rank": rank, "event": "done",
@@ -177,11 +252,13 @@ def main(argv=None) -> int:
         "losses": [round(x, 8) for x in losses],
         "param_fingerprint": fp,
         "ckpt_roundtrip_ok": ckpt_ok,
+        "resumed_from": start,
     }), flush=True)
+    watchdog.close()
     return 0
 
 
-def _run_wd(args, mesh, rank, nprocs, per, multi, rng):
+def _run_wd(args, mesh, rank, nprocs, per, multi, rng, watchdog):
     """Flagship DeepFM over the global multi-process mesh: hashed
     SparseTables (wide + field embeddings) and the dense deep tower,
     one fused PSTrainStep whose gathers/scatters and grad collectives
@@ -220,6 +297,7 @@ def _run_wd(args, mesh, rank, nprocs, per, multi, rng):
 
     fp = float(cluster.host_copy(emb_t.emb).sum()) \
         + float(cluster.host_copy(deep_t.params).sum())
+    watchdog.disarm()
     cluster.barrier("multihost_wd_done")
     import json
     print(json.dumps({
@@ -235,6 +313,7 @@ def _run_wd(args, mesh, rank, nprocs, per, multi, rng):
         "ckpt_roundtrip_ok": None,
         "emb_slots": int(args.num_slots),
     }), flush=True)
+    watchdog.close()
     return 0
 
 

@@ -200,6 +200,47 @@ def run_local_job(n: int, argv: list[str], *, base_port: int,
     return results
 
 
+def run_local_job_raw(n: int, argv: list[str], *, base_port: int,
+                      env_extra: Optional[dict] = None,
+                      timeout: float = 240.0,
+                      kill_on_failure: bool = False):
+    """Spawn ``n`` local ranks and harvest ALL JSON lines per rank,
+    tolerating failures — the fault-drill twin of :func:`run_local_job`
+    (which asserts success and returns only result lines). Returns
+    ``(rc, events)`` with ``events[rank]`` the rank's parsed JSON lines.
+    ``kill_on_failure=False`` by default: kill drills need survivors to
+    detect a death THEMSELVES, not be mercy-killed by the launcher."""
+    import json
+    import tempfile
+
+    hosts = ["localhost"] * n
+    outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
+    procs = []
+    for rank in range(n):
+        env = child_env(rank, hosts, base_port)
+        if env_extra:
+            env.update(env_extra)
+        procs.append(subprocess.Popen(
+            argv, env=env, stdout=outs[rank], stderr=subprocess.STDOUT))
+    rc = wait(procs, timeout=timeout, kill_on_failure=kill_on_failure)
+    events = []
+    for f in outs:
+        f.flush()
+        f.seek(0)
+        text = f.read()
+        f.close()
+        os.unlink(f.name)
+        rank_events = []
+        for ln in text.splitlines():
+            if ln.strip().startswith("{"):
+                try:
+                    rank_events.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    pass  # log lines that merely start with a brace
+        events.append(rank_events)
+    return rc, events
+
+
 def init_from_env():
     """Worker-side: build my ControlBus from the launcher's env vars.
     Returns ``(proc_id, num_procs, bus)``; bus is None single-process.

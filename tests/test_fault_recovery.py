@@ -14,11 +14,8 @@ exactly the reference's recovery semantics (SURVEY.md §7.4.5).
 
 from __future__ import annotations
 
-import json
 import os
-import subprocess
 import sys
-import tempfile
 
 import pytest
 
@@ -31,28 +28,14 @@ _PORT = [6100]
 
 def _run(n: int, extra: list[str], timeout: float = 240.0,
          kill_on_failure: bool = False, app: str = APP):
-    """Launch n workers of ``app``; return (rc, per-rank JSON events)."""
+    """Launch n workers of ``app``; return (rc, per-rank JSON events).
+    kill_on_failure=False: survivors must detect the death THEMSELVES via
+    heartbeat — the launcher must not mercy-kill them first."""
     _PORT[0] += n + 3
-    hosts = ["localhost"] * n
-    outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
-    procs = []
-    for rank, host in enumerate(hosts):
-        env = launch.child_env(rank, hosts, _PORT[0])
-        env.update({"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"})
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", app] + extra,
-            env=env, stdout=outs[rank], stderr=subprocess.STDOUT))
-    # kill_on_failure=False: survivors must detect the death THEMSELVES via
-    # heartbeat — the launcher must not mercy-kill them first.
-    rc = launch.wait(procs, timeout=timeout, kill_on_failure=kill_on_failure)
-    events = []
-    for f in outs:
-        f.flush(); f.seek(0)
-        text = f.read()
-        f.close(); os.unlink(f.name)
-        events.append([json.loads(l) for l in text.splitlines()
-                       if l.strip().startswith("{")])
-    return rc, events
+    return launch.run_local_job_raw(
+        n, [sys.executable, "-m", app] + extra, base_port=_PORT[0],
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
+        timeout=timeout, kill_on_failure=kill_on_failure)
 
 
 @pytest.mark.slow

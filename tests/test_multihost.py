@@ -158,6 +158,41 @@ def test_two_process_wd_sparse_tables_on_global_mesh():
 
 
 @pytest.mark.slow
+def test_multihost_kill_detect_relaunch_resume(tmp_path):
+    """The recovery story on the pod path (reference §3.5 semantics,
+    all-or-nothing per SURVEY §7.4.5): a peer death leaves the survivor
+    BLOCKED in a collective, so the bus-heartbeat watchdog thread detects
+    it (~2s, vs the coordination service's ~100s backstop), emits
+    peer_failure and exits 42; recovery = relaunch + coordinated orbax
+    restore, after which the trajectory continues EXACTLY where the
+    uninterrupted run would be (shared-stream replay)."""
+    ck = str(tmp_path / "ck")
+    # leg 1: save at 6, rank 1 dies at 9 -> survivor must self-detect
+    _PORT[0] += 7
+    rc, events = launch.run_local_job_raw(
+        2, [sys.executable, "-m", APP, "--iters", "16",
+            "--checkpoint-dir", ck, "--save-at", "6",
+            "--kill-at", "9", "--kill-rank", "1"],
+        base_port=_PORT[0],
+        env_extra={"MINIPS_FORCE_CPU": "1",
+                   "MINIPS_MH_LOCAL_DEVICES": "4"},
+        timeout=240.0)
+    assert rc != 0
+    surv = [e for e in events[0] if e.get("event") == "peer_failure"]
+    assert surv and 1 in surv[0]["dead"], events[0][-3:]
+
+    # leg 2: relaunch at the same world size, restore step 6, finish
+    res = _run_multihost(
+        2, ["--iters", "16", "--checkpoint-dir", ck,
+            "--restore-from", "6"])
+    assert all(r["event"] == "done" and r["resumed_from"] == 6
+               for r in res)
+    assert res[0]["losses"] == res[1]["losses"]
+    assert len(res[0]["losses"]) == 10  # iters 6..15
+    assert res[0]["loss_last"] < res[0]["losses"][0]
+
+
+@pytest.mark.slow
 def test_two_process_loss_parity_with_single_process():
     """2 processes x 4 devices must train EXACTLY like 1 process x 8
     devices on the same global batch stream — the distributed data plane
