@@ -301,3 +301,27 @@ def test_native_outbox_depth_observability():
     # post-close: observability calls are safe no-ops, not use-after-free
     assert buses[0].out_queue_depth() == 0
     assert buses[0].send_drops == 0
+
+
+def test_frame_loss_tracker_property_counts_exact_missing():
+    """Property: for ANY delivery pattern (first sighting = sync), lost
+    equals exactly the holes between the first and last delivered seq."""
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    from minips_tpu.comm.bus import FrameLossTracker
+
+    @settings(max_examples=200, deadline=None)
+    @given(st.lists(st.booleans(), min_size=1, max_size=128))
+    def prop(mask):
+        delivered = [i for i, m in enumerate(mask) if m]
+        t = FrameLossTracker()
+        for s in delivered:
+            t.observe(3, "b", s)
+        if delivered:
+            span = delivered[-1] - delivered[0] + 1
+            assert t.lost == span - len(delivered)
+        else:
+            assert t.lost == 0
+
+    prop()
