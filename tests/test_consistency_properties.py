@@ -18,6 +18,7 @@ Invariants under ANY interleaving of clock/admit calls:
 
 from __future__ import annotations
 
+import numpy as np
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
@@ -127,3 +128,55 @@ def test_skew_of_gated_execution_never_exceeds_staleness_plus_one(
         if c.admit(worker):
             c.clock(worker)
         assert c.skew <= staleness + 1
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    # interleaved script: ("pull", clk) requests and ("min", m) advances
+    st.lists(st.one_of(
+        st.tuples(st.just("pull"), st.integers(0, 8)),
+        st.tuples(st.just("min"), st.integers(0, 10))),
+        min_size=1, max_size=40),
+    st.integers(0, 3))
+def test_owner_side_park_serves_each_admitted_pull_exactly_once(
+        script, staleness):
+    """The sharded-PS owner's PendingBuffer (reference server-side
+    ``model->Get``): for ANY interleaving of pull requests and min-clock
+    advances, every pull is served exactly once as soon as (and never
+    before) global_min >= clk - s, and pulls whose bound is never reached
+    stay parked. Serves are recorded via the reply path with bus=None
+    stubbed out."""
+    from minips_tpu.train.sharded_ps import ShardedTable
+
+    t = ShardedTable("t", 8, 1, None, 0, 1, updater="sgd")
+    served = []
+    t._serve_pull = lambda sender, req, keys: served.append(req)
+
+    class Cons:
+        gmin = 0
+
+        def admit_pull(self, clk):
+            return self.gmin >= clk - staleness
+
+    cons = Cons()
+    t.bind_consistency(cons)
+
+    issued = []  # (req, clk)
+    req = 0
+    for op, val in script:
+        if op == "pull":
+            req += 1
+            issued.append((req, val))
+            t._on_pull(0, {"req": req, "clk": val,
+                           "__blob__": np.int64(3).tobytes()})
+        else:
+            cons.gmin = max(cons.gmin, val)  # clocks only advance
+            t.serve_parked()
+    # final drain at the terminal min
+    t.serve_parked()
+    should_serve = sorted(r for r, c in issued
+                          if cons.gmin >= c - staleness)
+    assert sorted(served) == should_serve  # exactly once, all admitted
+    parked_reqs = sorted(p[1] for p in t._parked)
+    assert parked_reqs == sorted(r for r, c in issued
+                                 if cons.gmin < c - staleness)
