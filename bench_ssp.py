@@ -45,15 +45,18 @@ import sys
 
 
 def run_job(n: int, iters: int, mode: str, staleness: int, port: int,
-            jitter_ms: float, jitter_prob: float, timeout: float) -> list[dict]:
+            jitter_ms: float, jitter_prob: float, timeout: float,
+            app: str = "minips_tpu.apps.ssp_lr_example",
+            extra: list[str] = ()) -> list[dict]:
     from minips_tpu import launch
 
     return launch.run_local_job(
         n,
-        [sys.executable, "-m", "minips_tpu.apps.ssp_lr_example",
+        [sys.executable, "-m", app,
          "--iters", str(iters), "--mode", mode,
          "--staleness", str(staleness),
-         "--jitter-ms", str(jitter_ms), "--jitter-prob", str(jitter_prob)],
+         "--jitter-ms", str(jitter_ms), "--jitter-prob", str(jitter_prob),
+         *extra],
         base_port=port,
         env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
         timeout=timeout)
@@ -115,6 +118,11 @@ def main() -> int:
     ap.add_argument("--jitter-prob", type=float, default=0.25)
     ap.add_argument("--base-port", type=int, default=6200)
     ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--sharded", action="store_true",
+                    help="run the gate comparison on the key-range-"
+                         "sharded multi-process PS (sharded_ps_example, "
+                         "sparse model) instead of the delta relay — "
+                         "same owner-side SSP admission, server topology")
     ap.add_argument("--tpu-grounded", action="store_true",
                     help="measure the chip's step time, simulate the "
                          "N-worker schedule (see module docstring)")
@@ -153,12 +161,16 @@ def main() -> int:
         }))
         return 0
 
+    app = ("minips_tpu.apps.sharded_ps_example" if args.sharded
+           else "minips_tpu.apps.ssp_lr_example")
+    extra = ["--model", "sparse"] if args.sharded else []
     walls = {}
     finals = {}
     for i, (mode, s) in enumerate([("bsp", 0), ("ssp", args.staleness)]):
         rs = run_job(args.n, args.iters, mode, s,
                      args.base_port + i * (args.n + 3),
-                     args.jitter_ms, args.jitter_prob, args.timeout)
+                     args.jitter_ms, args.jitter_prob, args.timeout,
+                     app=app, extra=extra)
         walls[mode] = max(r["wall_s"] for r in rs)  # job ends with slowest
         finals[mode] = max(r["loss_last"] for r in rs)
         skews = [r["max_skew_seen"] for r in rs]
@@ -166,9 +178,10 @@ def main() -> int:
               f"loss_last={finals[mode]:.4f} max_skew={max(skews)}",
               file=sys.stderr)
 
+    topo = "sharded multiproc PS" if args.sharded else "delta relay"
     print(json.dumps({
         "metric": "ssp_vs_bsp_wallclock_speedup (transient stalls, "
-                  f"{args.n} procs, jitter {args.jitter_ms}ms"
+                  f"{topo}, {args.n} procs, jitter {args.jitter_ms}ms"
                   f"@p={args.jitter_prob})",
         "value": round(walls["bsp"] / walls["ssp"], 4),
         "unit": "x",

@@ -49,6 +49,12 @@ def main(argv=None) -> int:
     ap.add_argument("--staleness", type=int, default=2)
     ap.add_argument("--slow-rank", type=int, default=-1)
     ap.add_argument("--slow-ms", type=float, default=0.0)
+    ap.add_argument("--jitter-ms", type=float, default=0.0,
+                    help="transient-stall injection (every rank, random "
+                         "--jitter-prob fraction of steps, rank-seeded) — "
+                         "the regime where SSP beats BSP wall-clock; used "
+                         "by bench_ssp.py --sharded")
+    ap.add_argument("--jitter-prob", type=float, default=0.2)
     ap.add_argument("--kill-at", type=int, default=0)
     ap.add_argument("--kill-rank", type=int, default=-1)
     ap.add_argument("--checkpoint-dir", default=None,
@@ -120,6 +126,7 @@ def main(argv=None) -> int:
     # resumed runs reseed on (rank, start): batch sampling is with-
     # replacement, so resume is convergence-equivalent, not bit-exact
     rng = np.random.default_rng((rank, start_iter))
+    jitter_rng = np.random.default_rng(1000 + rank)
     final = None
     t0 = time.monotonic()
 
@@ -149,6 +156,9 @@ def main(argv=None) -> int:
             save_hook(i)
             if rank == args.slow_rank and args.slow_ms > 0:
                 time.sleep(args.slow_ms / 1000.0)
+            if args.jitter_ms > 0 \
+                    and jitter_rng.random() < args.jitter_prob:
+                time.sleep(args.jitter_ms / 1000.0)
         trainer.finalize(timeout=20.0)
         # inside the guarded body: a peer that already printed and closed
         # its bus can look heartbeat-dead while we assemble — that must
