@@ -31,3 +31,6 @@ from minips_tpu.train.ps_step import PSTrainStep  # noqa: F401
 from minips_tpu.utils.evaluation import (StreamingAUC,  # noqa: F401
                                          auc_exact, evaluate_auc)
 from minips_tpu.utils.metrics import MetricsLogger  # noqa: F401
+from minips_tpu.comm import cluster  # noqa: F401  (multi-host bootstrap)
+from minips_tpu.train.sharded_ps import (ShardedPSTrainer,  # noqa: F401
+                                         ShardedTable, table_state_bytes)
