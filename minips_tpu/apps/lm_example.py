@@ -205,34 +205,21 @@ def run(cfg: Config, args, metrics) -> dict:
             return jax.device_put({"tokens": jnp.asarray(batch["tokens"])},
                                   batch_sharding)
     else:
-        T_local = seq_len // n_shards
-
-        def sp_grad(p, b):
-            # batch replicated, sequence sharded: inside shard_map each
-            # device sees its token slice; ring attention stitches them
-            def shard_loss(p_, inp, tgt):
-                shift = jax.lax.axis_index(DATA_AXIS) * T_local
-                return tfm.loss_sp(p_, inp, tgt, shift, heads=heads,
-                                   reduce="local",
-                                   attn_impl=getattr(args, "attn",
-                                                     "reference"))
-            toks = b["tokens"]
-            return jax.value_and_grad(shard_loss)(p, toks["inp"], toks["tgt"])
-
+        # batch replicated, sequence sharded: inside shard_map each
+        # device sees its token slice; ring attention stitches them.
         # make_step all-gathers params per shard and psum_scatters grads —
         # the same PS shape; only the batch specs change (sequence axis)
-        step = table.make_step(
-            sp_grad,
-            batch_spec={"tokens": {"inp": P(None, DATA_AXIS),
-                                   "tgt": P(None, DATA_AXIS)}},
-            accum=accum, compute_dtype=compute_dtype, comm=comm)
+        sp_grad, sp_spec = tfm.sp_train_wiring(
+            heads, seq_len // n_shards,
+            attn_impl=getattr(args, "attn", "reference"))
+        step = table.make_step(sp_grad, batch_spec=sp_spec, accum=accum,
+                               compute_dtype=compute_dtype, comm=comm)
         seq_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
 
         def prep(batch):
             t = jnp.asarray(batch["tokens"])
-            return {"tokens": {
-                "inp": jax.device_put(t[:, :-1], seq_sharding),
-                "tgt": jax.device_put(t[:, 1:], seq_sharding)}}
+            return {"inp": jax.device_put(t[:, :-1], seq_sharding),
+                    "tgt": jax.device_put(t[:, 1:], seq_sharding)}
 
     # TrainLoop fast-forwards the iterator to step_offset, so the resumed
     # trajectory continues the stream instead of replaying it.

@@ -82,11 +82,16 @@ class _Watchdog:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=30)
-    ap.add_argument("--model", default="lr", choices=["lr", "wd"],
+    ap.add_argument("--model", default="lr", choices=["lr", "wd", "lm"],
                     help="lr: DenseTable LR (checkpoint drill supported); "
                          "wd: the flagship DeepFM fused step — hashed "
                          "SparseTables + deep tower over the GLOBAL mesh, "
-                         "collectives crossing the process boundary")
+                         "collectives crossing the process boundary; "
+                         "lm: ring-attention SEQUENCE parallelism over "
+                         "the global mesh — each host feeds its sequence "
+                         "slice, the K/V ring ppermutes cross the "
+                         "process boundary (long-context x multi-host)")
+    ap.add_argument("--seq-len", type=int, default=64)
     ap.add_argument("--num-slots", type=int, default=1 << 14)
     ap.add_argument("--batch", type=int, default=64,
                     help="GLOBAL batch size (split across processes)")
@@ -160,6 +165,8 @@ def main(argv=None) -> int:
     if args.model == "wd":
         return _run_wd(args, mesh, rank, nprocs, per, multi, rng,
                        watchdog)
+    if args.model == "lm":
+        return _run_lm_sp(args, mesh, rank, nprocs, multi, watchdog)
 
     dt = DenseTable(lr_model.init(args.dim), mesh, updater=args.updater,
                     lr=args.lr)
@@ -253,6 +260,76 @@ def main(argv=None) -> int:
         "param_fingerprint": fp,
         "ckpt_roundtrip_ok": ckpt_ok,
         "resumed_from": start,
+    }), flush=True)
+    watchdog.close()
+    return 0
+
+
+def _run_lm_sp(args, mesh, rank, nprocs, multi, watchdog):
+    """Long-context x multi-host: the transformer LM with ring-attention
+    SEQUENCE parallelism over the global multi-process mesh. The sequence
+    axis is sharded across every device of every process, each host feeds
+    only its own sequence slice, and the ring's K/V ppermute hops cross
+    the process boundary — the 'ring attention ... scales to multi-host'
+    requirement made literal (SURVEY brief; parallel/ring_attention.py).
+    Deterministic data (same stream everywhere) so ranks must agree and a
+    1-process run with the same global devices is an exact oracle."""
+    import time
+
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from minips_tpu.comm import cluster
+    from minips_tpu.models import transformer as tfm
+    from minips_tpu.parallel.mesh import DATA_AXIS
+    from minips_tpu.tables.dense import DenseTable
+
+    t0 = time.monotonic()
+    n_shards = len(jax.devices())
+    T = args.seq_len
+    if T % n_shards:
+        raise SystemExit(f"--seq-len {T} must divide by the {n_shards}-"
+                         "device global mesh")
+    model = dict(vocab=64, dim=32, heads=2, depth=2, max_len=T)
+    params = tfm.init(jax.random.PRNGKey(args.seed), **model)
+    dt = DenseTable(params, mesh, updater=args.updater, lr=args.lr,
+                    name="lm_sp")
+    T_local = T // n_shards
+    sp_grad, sp_spec = tfm.sp_train_wiring(model["heads"], T_local)
+    step = dt.make_step(sp_grad, batch_spec=sp_spec)
+    seq_spec = P(None, DATA_AXIS)
+    B = args.batch
+    rng = np.random.default_rng(args.seed)
+    # my PROCESS's sequence span (devices within split it further)
+    dev_per_proc = n_shards // nprocs
+    lo = rank * dev_per_proc * T_local
+    hi = lo + dev_per_proc * T_local
+    losses = []
+    for i in range(args.iters):
+        toks = rng.integers(0, model["vocab"], size=(B, T + 1))
+        batch = cluster.global_batch(
+            mesh,
+            {"inp": toks[:, :-1][:, lo:hi].astype(np.int32),
+             "tgt": toks[:, 1:][:, lo:hi].astype(np.int32)},
+            spec=seq_spec)
+        losses.append(float(dt.step_inplace(step, batch)))
+
+    fp = float(cluster.host_copy(dt.params).sum())
+    watchdog.disarm()
+    cluster.barrier("multihost_lm_done")
+    print(json.dumps({
+        "rank": rank, "event": "done", "model": "lm",
+        "wall_s": round(time.monotonic() - t0, 4),
+        "multi": multi,
+        "process_count": nprocs,
+        "global_devices": n_shards,
+        "local_devices": len(jax.local_devices()),
+        "seq_len": T, "seq_local": hi - lo,
+        "loss_first": losses[0], "loss_last": losses[-1],
+        "losses": [round(x, 8) for x in losses],
+        "param_fingerprint": fp,
+        "ckpt_roundtrip_ok": None,
     }), flush=True)
     watchdog.close()
     return 0

@@ -78,17 +78,34 @@ def barrier(name: str = "minips_barrier", timeout_s: int = 120) -> None:
     multihost_utils.sync_global_devices(name)
 
 
-def global_batch(mesh, batch: dict, axis: str = "data") -> dict:
-    """Per-process local batch rows → ONE global array dict sharded along
-    ``axis`` — the multi-host feeding step (each host contributes the rows
-    it loaded; SURVEY.md §1 L5 "data shards per worker"). Single-process
-    this is a plain device_put with the same sharding."""
+def global_batch(mesh, batch: dict, axis: str = "data",
+                 spec=None) -> dict:
+    """Per-process local batch leaves → ONE global array dict — the
+    multi-host feeding step (each host contributes the slice it loaded;
+    SURVEY.md §1 L5 "data shards per worker"). Default: rows sharded
+    along ``axis`` (axis 0); pass ``spec`` (a PartitionSpec, or a dict of
+    them keyed like ``batch``) to shard other axes — e.g.
+    ``P(None, "data")`` feeds per-process SEQUENCE slices for ring-
+    attention sequence parallelism. Single-process this is a plain
+    device_put with the same sharding."""
     from jax.sharding import NamedSharding, PartitionSpec
 
-    sh = NamedSharding(mesh, PartitionSpec(axis))
+    def sharding_for(k):
+        if isinstance(spec, dict):
+            if k not in spec:  # a typo'd key must not silently row-shard
+                raise KeyError(
+                    f"global_batch spec has no entry for batch key {k!r} "
+                    f"(spec keys: {sorted(spec)})")
+            s = spec[k]
+        else:
+            s = spec
+        return NamedSharding(mesh, s if s is not None
+                             else PartitionSpec(axis))
+
     if jax.process_count() == 1:
-        return {k: jax.device_put(v, sh) for k, v in batch.items()}
-    return {k: jax.make_array_from_process_local_data(sh, v)
+        return {k: jax.device_put(v, sharding_for(k))
+                for k, v in batch.items()}
+    return {k: jax.make_array_from_process_local_data(sharding_for(k), v)
             for k, v in batch.items()}
 
 

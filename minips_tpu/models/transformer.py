@@ -232,6 +232,27 @@ def apply_sp(params, tokens_local, shift, *, heads=4, axis_name=DATA_AXIS,
                     compute_dtype, remat=remat)[0]
 
 
+def sp_train_wiring(heads, T_local, axis_name=DATA_AXIS,
+                    attn_impl="reference"):
+    """``(grad_fn, batch_spec)`` for SEQUENCE-parallel training through
+    ``DenseTable.make_step``: the batch is ``{"inp", "tgt"}`` of [B, T]
+    tokens sharded on the sequence axis; each shard computes its local
+    loss at its global shift and ring attention stitches the sequence.
+    One wiring shared by ``lm_example --layout sp`` and the multi-host
+    lm path (apps/multihost_example.py) so the shift/reduce semantics
+    cannot drift between them."""
+    from jax.sharding import PartitionSpec as P
+
+    def sp_grad(p, b):
+        def shard_loss(p_, inp, tgt):
+            shift = jax.lax.axis_index(axis_name) * T_local
+            return loss_sp(p_, inp, tgt, shift, heads=heads,
+                           reduce="local", attn_impl=attn_impl)
+        return jax.value_and_grad(shard_loss)(p, b["inp"], b["tgt"])
+
+    return sp_grad, {"inp": P(None, axis_name), "tgt": P(None, axis_name)}
+
+
 def apply_tp(params, tokens, *, heads=4, axis_name="model",
              compute_dtype=jnp.bfloat16):
     """Megatron-style tensor-parallel logits — call INSIDE shard_map with
