@@ -288,6 +288,10 @@ def bench_lm(args, n_chips, peak):
         extra += (m_mat + m_attn) / 3.0      # whole forward again
     elif remat == "attn":
         extra += m_mat / 3.0                 # forward minus attention
+    elif remat == "hybrid":
+        extra += m_mat / 9.0            # qkv + attn out-proj: 8/24 of fwd
+    elif remat == "hybrid_qkv":
+        extra += m_mat / 36.0           # attn out-proj only: 2/24 of fwd
     # "dots" recomputes only elementwise: ~0 extra matmul FLOPs
     if args.lm_head_chunk:
         # backward re-runs the tied-head matmul once per chunk
@@ -618,7 +622,8 @@ def main() -> int:
                     help="recompute block activations in backward "
                          "(fits larger --lm-dim/--lm-depth in HBM)")
     ap.add_argument("--lm-remat-mode", default="full",
-                    choices=["full", "attn", "dots"],
+                    choices=["full", "attn", "dots", "hybrid",
+                             "hybrid_qkv"],
                     help="with --lm-remat: full = recompute whole blocks; "
                          "attn = save attention outputs (backward never "
                          "re-runs attention); dots = save matmul outputs "

@@ -86,7 +86,8 @@ def _flags(parser):
                              "(the [B,T,vocab] logits never materialize); "
                              "0 = plain head. dp layout only")
     parser.add_argument("--remat_mode", default="full",
-                        choices=["full", "attn", "dots"],
+                        choices=["full", "attn", "dots", "hybrid",
+                                 "hybrid_qkv"],
                         help="with --remat: full = recompute whole "
                              "blocks; attn = save attention outputs; "
                              "dots = save matmul outputs (see "

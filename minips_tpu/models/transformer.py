@@ -81,9 +81,18 @@ def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None,
     B, T, _ = h.shape
     tp = 1 if psum_axis is None else jax.lax.axis_size(psum_axis)
     local_heads = heads // tp
+    from jax.ad_checkpoint import checkpoint_name
     x = _ln(h, blk["ln1"]).astype(compute_dtype)
     qkv = jnp.einsum("btd,dce->btce", x, blk["qkv"].astype(compute_dtype))
-    q, k, v = (qkv[:, :, i].astype(jnp.float32) for i in range(3))
+    # named so "hybrid_qkv" can save it — with qkv, attn_out and
+    # mlp_hidden all resident, backward recomputes only the attention
+    # output projection (2 of 24 D^2-units per block)
+    qkv = checkpoint_name(qkv, "qkv")
+    # q/k/v stay in compute_dtype: the flash kernel runs its dots at the
+    # input dtype's MXU rate with f32 accumulation, so a bf16 run keeps
+    # bf16 VMEM/HBM traffic end-to-end (upcasting here doubled both and
+    # forced f32-rate attention matmuls)
+    q, k, v = (qkv[:, :, i] for i in range(3))
     hd = q.shape[-1] // local_heads
     q = q.reshape(B, T, local_heads, hd)
     k = k.reshape(B, T, local_heads, hd)
@@ -93,7 +102,6 @@ def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None,
     # so the backward never re-runs the attention itself (the priciest
     # recompute per byte: flash kernels + T^2 math) while everything else
     # still recomputes
-    from jax.ad_checkpoint import checkpoint_name
     a = checkpoint_name(a, "attn_out")
     att = (a.astype(compute_dtype)
            @ blk["proj"].astype(compute_dtype)).astype(jnp.float32)
@@ -105,7 +113,15 @@ def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None,
         y, aux = ffn_fn(blk, _ln(h, blk["ln2"]).reshape(B * T, D))
         return h + y.reshape(B, T, D), aux
     x = _ln(h, blk["ln2"]).astype(compute_dtype)
-    x = jax.nn.gelu(x @ blk["mlp_in"].astype(compute_dtype))
+    z = x @ blk["mlp_in"].astype(compute_dtype)
+    # the [B*T, 4D] PRE-gelu tensor is the bulk of a block's activation
+    # memory; the "hybrid" policies save it (with attn_out) so backward
+    # skips the expensive up-projection recompute while still shedding
+    # the dots-policy tensors that blow HBM at batch 32. It must be the
+    # pre-activation: gelu's VJP reads its input, so saving gelu(z)
+    # would force the up-projection to be recomputed anyway.
+    z = checkpoint_name(z, "mlp_hidden")
+    x = jax.nn.gelu(z)
     m = (x @ blk["mlp_out"].astype(compute_dtype)).astype(jnp.float32)
     if psum_axis is not None:
         m = jax.lax.psum(m, psum_axis)
@@ -161,10 +177,18 @@ def _remat_policy(remat):
       block (max memory savings, +1/3 executed FLOPs).
     - ``"attn"`` — additionally save each block's attention output
       (checkpoint_name above): the backward re-runs the matmuls but never
-      the attention itself. Costs one [B, T, D] f32 per block.
+      the attention itself. Costs one [B, T, D] compute_dtype tensor
+      (bf16 in the default mixed-precision run) per block.
     - ``"dots"`` — save every matmul output, recompute only elementwise
       (LN/gelu/softmax): near-zero recompute, the memory win is only the
       elementwise intermediates.
+    - ``"hybrid"`` — save attn_out + the [B*T, 4D] pre-gelu mlp_hidden:
+      backward recomputes only qkv + the attention output projection
+      (~8 of 24 D^2-units per block, ~1.1x total FLOPs) at a fraction
+      of dots' residency — for batch sizes where dots spills HBM.
+    - ``"hybrid_qkv"`` — hybrid plus the qkv tensor: recompute drops to
+      the attention output projection alone (~1.03x) for +3 D-units of
+      residency.
     """
     if remat is True:
         return None
@@ -172,8 +196,15 @@ def _remat_policy(remat):
         return jax.checkpoint_policies.save_only_these_names("attn_out")
     if remat == "dots":
         return jax.checkpoint_policies.checkpoint_dots
+    if remat == "hybrid":
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "mlp_hidden")
+    if remat == "hybrid_qkv":
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "mlp_hidden", "qkv")
     raise ValueError(f"unknown remat mode {remat!r} "
-                     "(expected True/False, 'attn' or 'dots')")
+                     "(expected True/False, 'attn', 'dots', 'hybrid' "
+                     "or 'hybrid_qkv')")
 
 
 def _attn_fn(attn_impl: str):

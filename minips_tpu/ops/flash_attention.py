@@ -180,10 +180,13 @@ def _flash_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(_block_live(masked, i, j, bq, bk, q_off, k_off))
     def _fold():
-        qb = q_ref[0, 0, :, :].astype(jnp.float32) * scale
-        kb = k_ref[0, 0, :, :].astype(jnp.float32)
-        vb = v_ref[0, 0, :, :].astype(jnp.float32)
-        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)
+        # dots run in the INPUT dtype (bf16 inputs → bf16 MXU rate, half
+        # the VMEM traffic) with f32 accumulation; all online-softmax
+        # state stays f32. f32 inputs behave exactly as before.
+        qb = q_ref[0, 0, :, :]
+        kb = k_ref[0, 0, :, :]
+        vb = v_ref[0, 0, :, :]
+        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
         s = _mask_scores(s, masked, i, j, bq, bk, q_off, k_off)
         m = m_ref[:]
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))  # [bq, 1]
@@ -191,7 +194,8 @@ def _flash_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         alpha = jnp.exp(m - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = (acc_ref[:] * alpha
-                      + jnp.dot(p, vb, preferred_element_type=jnp.float32))
+                      + jnp.dot(p.astype(vb.dtype), vb,
+                                preferred_element_type=jnp.float32))
         m_ref[:] = m_new
 
     @pl.when(j == num_k - 1)
@@ -274,17 +278,19 @@ def _flash_bwd_dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
 
     @pl.when(_block_live(masked, i, j, bq, bk, q_off, k_off))
     def _fold():
-        qb = q_ref[0, 0, :, :].astype(jnp.float32)
-        kb = k_ref[0, 0, :, :].astype(jnp.float32)
-        vb = v_ref[0, 0, :, :].astype(jnp.float32)
-        dob = do_ref[0, 0, :, :].astype(jnp.float32)
+        # native-dtype dots, f32 accumulation/softmax state (see _fold in
+        # _flash_kernel); ds is cast back to the input dtype for its dot
+        qb = q_ref[0, 0, :, :]
+        kb = k_ref[0, 0, :, :]
+        vb = v_ref[0, 0, :, :]
+        dob = do_ref[0, 0, :, :]
         s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
         s = _mask_scores(s, masked, i, j, bq, bk, q_off, k_off)
-        p = jnp.exp(s - lse_ref[0, 0, :, :])            # [bq, bk]
+        p = jnp.exp(s - lse_ref[0, 0, :, :])            # [bq, bk] f32
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - dvec_ref[0, 0, :, :]) * scale
         dq_acc[:] = dq_acc[:] + jnp.dot(
-            ds, kb, preferred_element_type=jnp.float32)
+            ds.astype(kb.dtype), kb, preferred_element_type=jnp.float32)
 
     @pl.when(j == num_k - 1)
     def _write():
@@ -307,19 +313,19 @@ def _flash_bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
 
     @pl.when(_block_live(masked, i, j, bq, bk, q_off, k_off))
     def _fold():
-        qb = q_ref[0, 0, :, :].astype(jnp.float32)
-        kb = k_ref[0, 0, :, :].astype(jnp.float32)
-        vb = v_ref[0, 0, :, :].astype(jnp.float32)
-        dob = do_ref[0, 0, :, :].astype(jnp.float32)
+        qb = q_ref[0, 0, :, :]
+        kb = k_ref[0, 0, :, :]
+        vb = v_ref[0, 0, :, :]
+        dob = do_ref[0, 0, :, :]
         s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
         s = _mask_scores(s, masked, i, j, bq, bk, q_off, k_off)
-        p = jnp.exp(s - lse_ref[0, 0, :, :])            # [bq, bk]
+        p = jnp.exp(s - lse_ref[0, 0, :, :])            # [bq, bk] f32
         dv_acc[:] = dv_acc[:] + jnp.dot(
-            p.T, dob, preferred_element_type=jnp.float32)
+            p.T.astype(dob.dtype), dob, preferred_element_type=jnp.float32)
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - dvec_ref[0, 0, :, :]) * scale
         dk_acc[:] = dk_acc[:] + jnp.dot(
-            ds.T, qb, preferred_element_type=jnp.float32)
+            ds.T.astype(qb.dtype), qb, preferred_element_type=jnp.float32)
 
     @pl.when(i == num_q - 1)
     def _write():
@@ -507,8 +513,11 @@ def ring_flash_attention_local(
     # Pallas path: compiled on TPU, interpreter only if explicitly asked
     # (the interpreter can't track varying-manual-axes, so it only works
     # under check_vma=False — kernel-level tests). Everywhere else the
-    # per-step math runs as the pure-jnp offset blockwise scan: identical
-    # numerics, ordinary AD, no pallas involved.
+    # per-step math runs as the pure-jnp offset blockwise scan: same
+    # algorithm and f32 softmax state, ordinary AD, no pallas involved.
+    # Numerics match exactly for f32 inputs; for bf16 inputs the scan
+    # upcasts q/k/v to f32 before its dots while the kernel runs
+    # bf16-input dots with f32 accumulation (≤ bf16-rounding apart).
     use_kernel = (kernel_supported(q.shape, k.shape, block_q, block_k)
                   and (interpret is True
                        or (interpret is None

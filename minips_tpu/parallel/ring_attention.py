@@ -43,10 +43,15 @@ def _online_block(o, m, l, q, k, v, mask, scale):
     """Fold one K/V block into the (o, m, l) online-softmax state.
 
     q: [T_q, H, D]; k/v: [T_k, H, D]; mask: [T_q, T_k] bool or None.
-    o: [T_q, H, D]; m, l: [T_q, H].
+    o: [T_q, H, D]; m, l: [T_q, H] — all f32: the online-softmax state
+    accumulates in f32 whatever the input dtype. With bf16 inputs the
+    QK^T einsum keeps bf16 operands (f32 accumulation); the PV einsum
+    still runs f32 because p is f32 — only the fused kernel casts p back
+    down for full bf16-rate attention.
     """
     # scores [T_q, T_k, H] — batched over heads via einsum (MXU-shaped)
-    s = jnp.einsum("qhd,khd->qkh", q, k) * scale
+    s = jnp.einsum("qhd,khd->qkh", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask[:, :, None], s, _NEG_INF)
     m_blk = jnp.max(s, axis=1)                        # [T_q, H]
@@ -111,17 +116,20 @@ def ring_attention_local(
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return o, m, l, k_nxt, v_nxt
 
-    o = jnp.zeros_like(q)
+    # f32 carries regardless of input dtype — the running max/normalizer/
+    # accumulator must not round at bf16 across ring steps
+    o = jnp.zeros(q.shape, jnp.float32)
     # fresh arrays are axis-invariant; mark them varying over the ring axis
     # so the fori_loop carry type stays fixed (shard_map VMA tracking)
-    m = jax.lax.pcast(jnp.full((B, Tq, H), _NEG_INF, q.dtype),
+    m = jax.lax.pcast(jnp.full((B, Tq, H), _NEG_INF, jnp.float32),
                       axis_name, to="varying")
-    l = jax.lax.pcast(jnp.zeros((B, Tq, H), q.dtype),
+    l = jax.lax.pcast(jnp.zeros((B, Tq, H), jnp.float32),
                       axis_name, to="varying")
+    o = jax.lax.pcast(o, axis_name, to="varying")
 
     o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
 
-    return o / jnp.maximum(l, 1e-30)[:, :, :, None]
+    return (o / jnp.maximum(l, 1e-30)[:, :, :, None]).astype(q.dtype)
 
 
 def make_ring_attention(
@@ -150,14 +158,17 @@ def make_ring_attention(
 
 
 def reference_attention(q, k, v, *, causal=False, scale=None):
-    """O(T^2)-memory oracle for tests: plain softmax(QK^T)V."""
+    """O(T^2)-memory oracle for tests: plain softmax(QK^T)V. Scores and
+    softmax run in f32 whatever the input dtype; output is q.dtype."""
     D = q.shape[-1]
     if scale is None:
         scale = D ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bqkh", q, k) * scale
+    s = jnp.einsum("bqhd,bkhd->bqkh", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         T, S = q.shape[1], k.shape[1]
         mask = jnp.arange(T)[:, None] >= jnp.arange(S)[None, :]
         s = jnp.where(mask[None, :, :, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=2)
-    return jnp.einsum("bqkh,bkhd->bqhd", p, v)
+    return jnp.einsum("bqkh,bkhd->bqhd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)

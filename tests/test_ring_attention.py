@@ -88,3 +88,30 @@ def test_memory_is_blockwise(mesh8):
     out = attn(sq, sk, sv)
     assert out.sharding.spec == jax.sharding.PartitionSpec(None, "data")
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_bf16_inputs_keep_f32_softmax_state(mesh4):
+    """bf16 q/k/v may round the matmul INPUTS, but the softmax statistics
+    (running max / normalizer / accumulator) must stay f32 — both the
+    oracle and the ring path should sit within bf16-input rounding of the
+    all-f32 result, and the ring must agree with the oracle at much
+    tighter than bf16 resolution (both consume identical bf16 inputs)."""
+    B, T, H, D = 1, 32, 2, 8
+    q, k, v = _qkv(B, T, H, D, seed=7)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    want = reference_attention(q, k, v, causal=True)
+    ref_b = reference_attention(qb, kb, vb, causal=True)
+    assert ref_b.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(ref_b, np.float32),
+                               np.asarray(want), rtol=5e-2, atol=5e-2)
+
+    attn = make_ring_attention(mesh4, causal=True)
+    out_b = attn(attn.shard(qb), attn.shard(kb), attn.shard(vb))
+    assert out_b.dtype == jnp.bfloat16
+    # same bf16 inputs on both sides: only the (f32) accumulation order
+    # differs, so agreement must be near-exact — this catches any
+    # regression to bf16 carries, which would drift with ring steps
+    np.testing.assert_allclose(np.asarray(out_b, np.float32),
+                               np.asarray(ref_b, np.float32),
+                               rtol=1e-2, atol=1e-2)

@@ -314,7 +314,7 @@ def test_remat_modes_grad_parity():
                                remat=remat))(p)
 
     l0, g0 = f(False)
-    for mode in (True, "attn", "dots"):
+    for mode in (True, "attn", "dots", "hybrid", "hybrid_qkv"):
         l1, g1 = f(mode)
         np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
         for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
