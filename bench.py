@@ -87,14 +87,25 @@ def _mlp_flops_per_sample(sizes) -> float:
     return 3.0 * fwd
 
 
+_PROFILE_DIR = None  # set by --profile: capture one steady-state rep
+
+
 def _chain_timed(jitted_chain, state, reps):
     """Median seconds per chained call. The chain is compiled once; each
     timed call is one dispatch running K steps on device; block on the
-    returned loss so the timer covers the device work."""
+    returned loss so the timer covers the device work. With --profile one
+    EXTRA steady-state rep runs under jax.profiler before the timed loop
+    — captured but never timed, so profiler overhead can't leak into the
+    reported numbers at any --reps."""
     import jax
 
     state, loss = jitted_chain(state)          # compile + warmup
     jax.block_until_ready(loss)
+    if _PROFILE_DIR:
+        from minips_tpu.utils.profiling import profile_trace
+        with profile_trace(_PROFILE_DIR):
+            state, loss = jitted_chain(state)  # captured, untimed
+            jax.block_until_ready(loss)
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -606,6 +617,11 @@ def main() -> int:
                     choices=["all", "lrmlp", "lm", "wd", "e2e", "ps"])
     ap.add_argument("--ps-iters", type=int, default=40,
                     help="pull/push cycles per rank in the ps suite")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="capture a jax.profiler trace of one steady-state"
+                         " rep into DIR and attach the top-op table to the"
+                         " suite result (single-suite runs only; --suite "
+                         "all forks children and ignores it)")
     # defaults = the measured sweet spots on the v5-lite here (2026-07-30
     # sweep: 16k->65k batch buys +13% lrmlp and +11% wd; lm saturates MFU
     # at micro-batch 64 and regresses at 128)
@@ -647,6 +663,14 @@ def main() -> int:
         # heads = lm_dim/64 (64-dim heads, MXU-shaped); a non-multiple
         # would derive a head count that doesn't divide the model dim
         ap.error("--lm-dim must be a positive multiple of 64")
+
+    if args.profile and args.suite not in ("lrmlp", "lm", "wd"):
+        # only the chained-scan suites run under _chain_timed and can
+        # capture; ps is jax-free, e2e times a streaming loop, and "all"
+        # forks children without forwarding the flag
+        print(f"bench: --profile is ignored for --suite {args.suite} "
+              "(profilable: lrmlp, lm, wd)", file=sys.stderr)
+        args.profile = None
 
     if args.suite == "ps":
         # control-plane suite: loopback subprocesses, no chip, no jax in
@@ -701,6 +725,10 @@ def main() -> int:
     on_tpu = device_note == "tpu"
     peak = _peak_for(jax.devices()[0]) if on_tpu else None
 
+    global _PROFILE_DIR
+    _PROFILE_DIR = args.profile
+    profile_t0 = time.time()
+
     suites = {}
     want = [args.suite]
     if "lrmlp" in want:
@@ -711,6 +739,23 @@ def main() -> int:
         suites["wd"] = bench_wd(args, n_chips, peak)
     if "e2e" in want:
         suites["e2e"] = bench_e2e(args, n_chips)
+
+    if _PROFILE_DIR and suites:
+        import os
+
+        from minips_tpu.utils.trace_analysis import (latest_trace_file,
+                                                     summarize)
+        # one suite per invocation when profiling; the table lands on it.
+        # Freshness-gate: a pre-existing trace in a reused dir (or a
+        # swallowed start_trace failure) must not be misattributed to
+        # this run as its profile.
+        newest = latest_trace_file(_PROFILE_DIR)
+        if newest is not None and os.path.getmtime(newest) >= profile_t0:
+            prof = summarize(_PROFILE_DIR, top=12)
+        else:
+            prof = {"error": "no trace captured during this run "
+                             "(profiler unavailable on this backend?)"}
+        suites[next(iter(suites))]["profile"] = prof
 
     # only the lrmlp suite measures the BASELINE metric; a run that skipped
     # it must not label another suite's rate as LR+MLP or ratio it against

@@ -1,0 +1,101 @@
+"""Trace analysis — the read side of the profiling subsystem (SURVEY.md
+§5.1). The capture side (profiling.profile_trace) writes Chrome-trace
+files; these tests pin the aggregation semantics on a synthetic trace and
+round-trip a real capture on the CPU backend."""
+
+import gzip
+import json
+import os
+
+from minips_tpu.utils.trace_analysis import (
+    latest_trace_file,
+    load_events,
+    op_table,
+    summarize,
+)
+
+
+def _write_trace(path, events):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def _meta(pid, name):
+    return {"ph": "M", "pid": pid, "name": "process_name",
+            "args": {"name": name}}
+
+
+def _ev(pid, name, ts, dur):
+    return {"ph": "X", "pid": pid, "name": name, "ts": ts, "dur": dur}
+
+
+def test_device_events_win_and_aggregate(tmp_path):
+    """With a device process present, host events are excluded; durations
+    sum by op name; pct is of device busy time."""
+    p = str(tmp_path / "run" / "host.trace.json.gz")
+    _write_trace(p, [
+        _meta(1, "/host:CPU"),
+        _meta(2, "/device:TPU:0"),
+        _ev(1, "python_overhead", 0, 1000.0),
+        _ev(2, "fusion.1", 0, 30.0),
+        _ev(2, "fusion.1", 40, 30.0),
+        _ev(2, "dot.7", 70, 40.0),
+    ])
+    events, pids = load_events(p)
+    table = op_table(events, pids)
+    assert table["source"] == "device"
+    assert table["busy_us"] == 100.0
+    by_name = {o["name"]: o for o in table["ops"]}
+    assert by_name["fusion.1"]["total_us"] == 60.0
+    assert by_name["fusion.1"]["count"] == 2
+    assert by_name["fusion.1"]["pct_of_busy"] == 60.0
+    assert by_name["dot.7"]["pct_of_busy"] == 40.0
+    assert "python_overhead" not in by_name
+    # span covers first ts to last ts+dur of the included events
+    assert table["span_us"] == 110.0
+
+
+def test_host_fallback_when_no_device(tmp_path):
+    """CPU-backend traces carry only host events — report those rather
+    than an empty table."""
+    p = str(tmp_path / "r" / "vm.trace.json.gz")
+    _write_trace(p, [_meta(1, "/host:CPU"), _ev(1, "Execute", 0, 5.0)])
+    events, pids = load_events(p)
+    table = op_table(events, pids)
+    assert table["source"] == "host"
+    assert table["ops"][0]["name"] == "Execute"
+
+
+def test_latest_trace_file_picks_newest(tmp_path):
+    old = str(tmp_path / "a" / "x.trace.json.gz")
+    new = str(tmp_path / "b" / "y.trace.json.gz")
+    _write_trace(old, [])
+    _write_trace(new, [])
+    os.utime(old, (1, 1))
+    assert latest_trace_file(str(tmp_path)) == new
+    assert "error" not in summarize(str(tmp_path))
+
+
+def test_summarize_missing_dir(tmp_path):
+    out = summarize(str(tmp_path / "nothing"))
+    assert "error" in out
+
+
+def test_roundtrip_real_capture(tmp_path):
+    """profile_trace -> summarize on the CPU backend: the capture the
+    bench --profile flag takes must be analyzable by the same package."""
+    import jax
+    import jax.numpy as jnp
+
+    from minips_tpu.utils.profiling import profile_trace
+
+    f = jax.jit(lambda x: (x @ x.T).sum())
+    x = jnp.ones((256, 256))
+    f(x).block_until_ready()
+    with profile_trace(str(tmp_path)):
+        f(x).block_until_ready()
+    out = summarize(str(tmp_path))
+    assert "error" not in out, out
+    assert out["ops"], out
+    assert out["busy_us"] > 0
