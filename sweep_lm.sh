@@ -1,6 +1,11 @@
 #!/bin/bash
 # LM MFU frontier sweep (VERDICT r2 #7). Run on an idle chip; each line
 # prints "config -> tok/s TF/s MFU". Results land in BASELINE.md.
+#
+# Measured 2026-07-31 (TPU v5 lite): winner is d=2048x8 B=16 remat=dots
+# head-chunk=128 at 43.5% model MFU / 85.7 TF/s. The commented configs
+# below OOM on a 16 GB chip (adam state for ~436M params is 5.2 GB
+# before activations) — kept as the documented memory boundary.
 cd "$(dirname "$0")"
 run() {
   echo "=== $*"
@@ -9,17 +14,19 @@ import sys, json
 try:
     d = json.loads(sys.stdin.read().strip().splitlines()[-1])
     s = d['suites']['lm']
-    print(' ', s['samples_per_sec_per_chip'], 'tok/s,', s['tflops_per_chip'], 'TF/s, MFU', s['mfu_vs_bf16_peak'], '('+d['device']+')')
+    print(' ', s['samples_per_sec_per_chip'], 'tok/s,', s['tflops_per_chip'], 'TF/s, MFU', s['mfu_vs_bf16_peak'], 'hw', s.get('mfu_hw_vs_bf16_peak'), '('+d['device']+')')
 except Exception as e:
     print('  FAILED', e)
 "
 }
-run --lm-dim 512  --lm-depth 4 --lm-batch 64                                     # r2 baseline 26.7%
-run --lm-dim 2048 --lm-depth 8 --lm-batch 64 --lm-remat --lm-head-chunk 128      # r2 35.8% + chunked head
-run --lm-dim 2048 --lm-depth 8 --lm-batch 64 --lm-remat --lm-remat-mode attn --lm-head-chunk 128
-run --lm-dim 2048 --lm-depth 8 --lm-batch 32 --lm-remat --lm-remat-mode attn --lm-head-chunk 128
-run --lm-dim 2048 --lm-depth 8 --lm-batch 32 --lm-remat --lm-remat-mode dots --lm-head-chunk 128
-run --lm-dim 2048 --lm-depth 4 --lm-batch 32 --lm-head-chunk 128                 # no remat at all
-run --lm-dim 1024 --lm-depth 8 --lm-batch 32 --lm-head-chunk 128
-run --lm-dim 1024 --lm-depth 8 --lm-batch 64 --lm-remat --lm-remat-mode dots --lm-head-chunk 128
-run --lm-dim 4096 --lm-depth 4 --lm-batch 32 --lm-remat --lm-head-chunk 128
+run --lm-dim 512  --lm-depth 4 --lm-batch 64                                     # r2 base: 32.0% (2026-07-31)
+run --lm-dim 1024 --lm-depth 8 --lm-batch 32 --lm-head-chunk 128                 # 40.5%, no remat
+run --lm-dim 2048 --lm-depth 8 --lm-batch 32 --lm-remat --lm-remat-mode attn --lm-head-chunk 128  # 40.9%
+run --lm-dim 2048 --lm-depth 8 --lm-batch 16 --lm-remat --lm-remat-mode dots --lm-head-chunk 128  # 43.5% WINNER
+run --lm-dim 2048 --lm-depth 12 --lm-batch 16 --lm-remat --lm-remat-mode attn --lm-head-chunk 128 # 39.8% model / 53.3% hw
+# unmeasured (tunnel died mid-pass): candidates between the fit/OOM line
+run --lm-dim 2048 --lm-depth 8 --lm-batch 24 --lm-remat --lm-remat-mode dots --lm-head-chunk 128
+run --lm-dim 2048 --lm-depth 8 --lm-batch 8 --lm-seq 2048 --lm-remat --lm-remat-mode dots --lm-head-chunk 128
+# OOM boundary on 16 GB (RESOURCE_EXHAUSTED), do not re-run blindly:
+#   d=2048x8 B=64 (any remat); d=2048x8 B=32 remat=dots/hybrid/hybrid_qkv
+#   d=2048x4 B=32 no remat; d=1024x16 B=32 no remat; d=4096x4 B=32 full remat
