@@ -29,7 +29,10 @@ Round-2 credibility upgrades (VERDICT r1 "Next round" #2):
   samples/sec INCLUDING input IO, which the microbench deliberately
   excludes.
 
-Usage: python bench.py [--cpu] [--suite all|lrmlp|lm|wd|e2e]
+Usage: python bench.py [--cpu] [--suite all|lrmlp|lm|wd|mf|w2v|e2e|ps]
+
+Round 3 adds ``mf`` and ``w2v`` so every BASELINE.json workload config
+(1-2 lrmlp, 3 mf, 4 wd, 5 w2v) has a measured per-config rate.
 """
 
 from __future__ import annotations
@@ -119,9 +122,10 @@ def _suite_result(samples, dt, n_chips, flops_per_step, peak):
     sps_chip = samples / dt / n_chips
     tflops = flops_per_step / dt / 1e12 / n_chips  # per chip
     out = {"samples_per_sec_per_chip": round(sps_chip, 1),
-           # 6 decimals: tiny CPU-validation runs live in the micro-TFLOP
-           # range and must not round to a (test-failing) hard zero
-           "tflops_per_chip": round(tflops, 6),
+           # 9 decimals: tiny CPU-validation runs live in the micro-TFLOP
+           # range (the mf suite's analytic cost is ~200k FLOPs/call at
+           # test shapes) and must not round to a test-failing hard zero
+           "tflops_per_chip": round(tflops, 9),
            "mfu_vs_bf16_peak": (round(tflops * 1e12 / peak, 4)
                                 if peak else None)}
     if peak and tflops * 1e12 > peak:
@@ -149,6 +153,33 @@ def _pick(stacked, i):
     import jax
 
     return jax.tree.map(lambda l: l[i], stacked)
+
+
+def _ps_chain_timed(ps, batches, args, k_div=2):
+    """Chained-scan timing for one PSTrainStep: rotate the given distinct
+    sharded batches through K = max(chain//k_div, 2) steps in a single
+    donated-state ``lax.scan`` dispatch (shared by the wd/mf/w2v suites —
+    the timing contract lives in exactly one place). Returns
+    ``(K, dt, final_state)``; final_state is live (the initial state's
+    buffers were donated into the chain)."""
+    import functools
+
+    import jax
+
+    K = max(args.chain // k_div, 2)
+    stacked, idx = _batch_rotation(batches, K)
+    pure = ps.step_fn_pure
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def chained(state):
+        def body(s, i):
+            s2, loss = pure(s, _pick(stacked, i))
+            return s2, loss
+        s, losses = jax.lax.scan(body, state, idx)
+        return s, losses[-1]
+
+    state, dt = _chain_timed(chained, ps._collect_state(), args.reps)
+    return K, dt, state
 
 
 # --------------------------------------------------------------- suites
@@ -321,8 +352,6 @@ def bench_wd(args, n_chips, peak):
     scale direction): the memory-bound end — gathers/scatter-adds over a
     268 MB table dominate, so MFU is expected to be tiny; the honest
     numbers are rows/sec and achieved TFLOP/s."""
-    import functools
-
     import jax
     import jax.numpy as jnp
 
@@ -337,21 +366,9 @@ def bench_wd(args, n_chips, peak):
         train=TrainConfig(batch_size=args.batch, num_iters=1),
     )
     ps, _tables = build(cfg, use_fm=True, compute_dtype=jnp.bfloat16)
-    pure = ps.step_fn_pure
-    K = max(args.chain // 2, 2)
-    stacked, idx = _batch_rotation(
-        [ps.shard_batch(synthetic.criteo_like(args.batch, seed=s))
-         for s in (0, 1)], K)
-
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def chained(state):
-        def body(s, i):
-            s2, loss = pure(s, _pick(stacked, i))
-            return s2, loss
-        s, losses = jax.lax.scan(body, state, idx)
-        return s, losses[-1]
-
-    state, dt = _chain_timed(chained, ps._collect_state(), args.reps)
+    batches = [ps.shard_batch(synthetic.criteo_like(args.batch, seed=s))
+               for s in (0, 1)]
+    K, dt, state = _ps_chain_timed(ps, batches, args)
     flops_step = args.batch * K * _mlp_flops_per_sample(
         (13 + 26 * 8, 256, 128, 1))
     out = _suite_result(K * args.batch, dt, n_chips, flops_step, peak)
@@ -359,13 +376,123 @@ def bench_wd(args, n_chips, peak):
     if n_chips > 1:
         # collective traffic of ONE fused step: must be batch-sized, never
         # table-sized (VERDICT task 6; tests/test_sharded_traffic.py pins
-        # the same invariant on the raw SparseTable ops). `state` (the
-        # post-timing live state) is used because the initial state's
-        # buffers were donated into the chain.
+        # the same invariant on the raw SparseTable ops). `state` is the
+        # post-timing live state from the helper.
         from minips_tpu.utils.comm_analysis import traffic_report
         rep = traffic_report(
-            jax.jit(pure).lower(state, _pick(stacked, 0)).compile())
+            jax.jit(ps.step_fn_pure).lower(state, batches[0]).compile())
         out["step_collective_bytes"] = rep["total_bytes"]
+    return out
+
+
+def bench_mf(args, n_chips, peak):
+    """Matrix factorization (BASELINE config 3's workload shape —
+    MovieLens-scale id spaces): per-key pull/push of user and item factor
+    rows through two SparseTables, the pure embedding-bound end of the
+    suite family. The honest numbers are ratings/sec and achieved
+    TFLOP/s; MFU is expected to be tiny (dot products, no matmul)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from minips_tpu.models import mf as mf_model
+    from minips_tpu.parallel.mesh import make_mesh
+    from minips_tpu.tables.sparse import SparseTable
+    from minips_tpu.train.ps_step import PSTrainStep
+
+    mesh = make_mesh()
+    B, dim = args.batch, args.mf_dim
+    users, items = args.mf_users, args.mf_items
+    # sgd, matching the app's default updater — under sgd grad_scale=B
+    # below genuinely restores per-sample server-add magnitude (adagrad
+    # rows would be invariant to a constant scale)
+    user_t = SparseTable(users, dim, mesh, name="user",
+                         updater="sgd", lr=0.05, init_scale=0.1,
+                         seed=1)
+    item_t = SparseTable(items, dim, mesh, name="item",
+                         updater="sgd", lr=0.05, init_scale=0.1,
+                         seed=2)
+
+    def loss_fn(dense_params, rows, batch):
+        return mf_model.loss(rows["user"], rows["item"], batch["rating"],
+                             mu=3.5, reg=0.02)
+
+    # grad_scale=B: per-sample server-add magnitude (see mf_example)
+    ps = PSTrainStep(loss_fn, sparse={"user": user_t, "item": item_t},
+                     key_fns={"user": lambda b: b["user"],
+                              "item": lambda b: b["item"]},
+                     grad_scale=B)
+
+    def batch(seed):
+        r = np.random.default_rng(seed)
+        return ps.shard_batch({
+            "user": jnp.asarray(r.integers(0, users, size=B)),
+            "item": jnp.asarray(r.integers(0, items, size=B)),
+            "rating": jnp.asarray(
+                r.integers(1, 6, size=B).astype(np.float32))})
+
+    K, dt, _ = _ps_chain_timed(ps, [batch(0), batch(1)], args)
+    # fwd = the u·i dot (2·dim FLOPs/sample); bwd ≈ 2x fwd
+    flops_step = K * B * 3.0 * 2.0 * dim
+    out = _suite_result(K * B, dt, n_chips, flops_step, peak)
+    out["factor_dim"] = dim
+    out["id_space"] = [users, items]
+    return out
+
+
+def bench_w2v(args, n_chips, peak):
+    """Word2vec SGNS (BASELINE config 5's workload shape — enwiki-scale
+    vocab): center/context/negative rows through two SparseTables with
+    host-side alias-table negative sampling baked into the rotated
+    batches, per-pair update magnitude via grad_scale. pairs/sec is the
+    headline; like mf this is gather/scatter-bound."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from minips_tpu.models import word2vec as w2v
+    from minips_tpu.parallel.mesh import make_mesh
+    from minips_tpu.tables.sparse import SparseTable
+    from minips_tpu.train.ps_step import PSTrainStep
+
+    mesh = make_mesh()
+    B, dim, vocab, neg = (args.batch, args.w2v_dim, args.w2v_vocab,
+                          args.w2v_neg)
+    # sgd per the app's default — see the bench_mf updater note
+    in_t = SparseTable(vocab, dim, mesh, name="in", updater="sgd",
+                       lr=0.05, init_scale=0.01, seed=1)
+    out_t = SparseTable(vocab, dim, mesh, name="out", updater="sgd",
+                        lr=0.05, init_scale=0.0, seed=2)
+
+    def loss_fn(dense_params, rows, batch):
+        return w2v.sgns_loss(rows["in"], rows["out"][:, 0],
+                             rows["out"][:, 1:])
+
+    ps = PSTrainStep(
+        loss_fn, sparse={"in": in_t, "out": out_t},
+        key_fns={"in": lambda b: b["center"],
+                 "out": lambda b: jnp.concatenate(
+                     [b["pos"][:, None], b["neg"]], axis=1)},
+        grad_scale=B)
+
+    # zipf-shaped unigram counts -> the classic 0.75-power alias table;
+    # negatives are drawn per rotated batch on the host, exactly like
+    # the app's batch generator (word2vec_example._batch_gen)
+    counts = 1.0 / np.arange(1, vocab + 1)
+    sampler = w2v.UnigramSampler(np.asarray(counts), power=0.75, seed=0)
+
+    def batch(seed):
+        r = np.random.default_rng(seed)
+        return ps.shard_batch({
+            "center": jnp.asarray(r.integers(0, vocab, size=B)),
+            "pos": jnp.asarray(r.integers(0, vocab, size=B)),
+            "neg": jnp.asarray(sampler.sample((B, neg)))})
+
+    K, dt, _ = _ps_chain_timed(ps, [batch(0), batch(1)], args)
+    # fwd = (1 pos + neg) center·context dots of 2·dim each; bwd ≈ 2x
+    flops_step = K * B * 3.0 * 2.0 * dim * (1 + neg)
+    out = _suite_result(K * B, dt, n_chips, flops_step, peak)
+    out["vocab"] = vocab
+    out["dim"] = dim
+    out["negatives"] = neg
     return out
 
 
@@ -558,7 +685,7 @@ def _run_all(args) -> int:
               "back to CPU", file=sys.stderr)
         args.cpu = True
         device_note = "cpu-fallback(tpu-unresponsive)"
-    for s in ("lrmlp", "lm", "wd", "e2e", "ps"):
+    for s in ("lrmlp", "lm", "wd", "mf", "w2v", "e2e", "ps"):
         argv = [sys.executable, os.path.abspath(__file__),
                 "--suite", s,
                 "--batch", str(args.batch),
@@ -572,6 +699,12 @@ def _run_all(args) -> int:
                 "--lm-remat-mode", args.lm_remat_mode,
                 "--lm-head-chunk", str(args.lm_head_chunk),
                 "--wd-slots", str(args.wd_slots),
+                "--mf-users", str(args.mf_users),
+                "--mf-items", str(args.mf_items),
+                "--mf-dim", str(args.mf_dim),
+                "--w2v-vocab", str(args.w2v_vocab),
+                "--w2v-dim", str(args.w2v_dim),
+                "--w2v-neg", str(args.w2v_neg),
                 "--e2e-rows", str(args.e2e_rows),
                 "--e2e-batch", str(args.e2e_batch),
                 "--ps-iters", str(args.ps_iters)]
@@ -614,7 +747,8 @@ def main() -> int:
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU (8 fake devices) for development")
     ap.add_argument("--suite", default="all",
-                    choices=["all", "lrmlp", "lm", "wd", "e2e", "ps"])
+                    choices=["all", "lrmlp", "lm", "wd", "mf", "w2v",
+                             "e2e", "ps"])
     ap.add_argument("--ps-iters", type=int, default=40,
                     help="pull/push cycles per rank in the ps suite")
     ap.add_argument("--profile", default=None, metavar="DIR",
@@ -649,6 +783,14 @@ def main() -> int:
                          " logits never materialize (models/transformer.py"
                          " nll_chunked); 0 = plain head")
     ap.add_argument("--wd-slots", type=int, default=1 << 22)
+    # mf: ML-20M-scale id spaces (138k users / 27k movies, next pow2)
+    ap.add_argument("--mf-users", type=int, default=1 << 18)
+    ap.add_argument("--mf-items", type=int, default=1 << 15)
+    ap.add_argument("--mf-dim", type=int, default=32)
+    # w2v: enwiki-scale vocab, classic SGNS hyperparams
+    ap.add_argument("--w2v-vocab", type=int, default=1 << 20)
+    ap.add_argument("--w2v-dim", type=int, default=128)
+    ap.add_argument("--w2v-neg", type=int, default=5)
     # 512k rows ≈ 0.7s of steady-state pipeline at the measured rate — a
     # 131k-row run finishes in ~0.2s, short enough for tunnel jitter to
     # dominate the reading
@@ -664,12 +806,13 @@ def main() -> int:
         # would derive a head count that doesn't divide the model dim
         ap.error("--lm-dim must be a positive multiple of 64")
 
-    if args.profile and args.suite not in ("lrmlp", "lm", "wd"):
+    if args.profile and args.suite not in ("lrmlp", "lm", "wd", "mf",
+                                           "w2v"):
         # only the chained-scan suites run under _chain_timed and can
         # capture; ps is jax-free, e2e times a streaming loop, and "all"
         # forks children without forwarding the flag
         print(f"bench: --profile is ignored for --suite {args.suite} "
-              "(profilable: lrmlp, lm, wd)", file=sys.stderr)
+              "(profilable: lrmlp, lm, wd, mf, w2v)", file=sys.stderr)
         args.profile = None
 
     if args.suite == "ps":
@@ -710,6 +853,9 @@ def main() -> int:
         args.e2e_batch = min(args.e2e_batch, 2048)
         args.lm_batch = min(args.lm_batch, 8)
         args.wd_slots = min(args.wd_slots, 1 << 18)
+        args.mf_users = min(args.mf_users, 1 << 14)
+        args.mf_items = min(args.mf_items, 1 << 12)
+        args.w2v_vocab = min(args.w2v_vocab, 1 << 14)
         args.e2e_rows = min(args.e2e_rows, 16384)
         args.lm_seq = min(args.lm_seq, 256)
         args.lm_dim = min(args.lm_dim, 512)
@@ -737,6 +883,10 @@ def main() -> int:
         suites["lm"] = bench_lm(args, n_chips, peak)
     if "wd" in want:
         suites["wd"] = bench_wd(args, n_chips, peak)
+    if "mf" in want:
+        suites["mf"] = bench_mf(args, n_chips, peak)
+    if "w2v" in want:
+        suites["w2v"] = bench_w2v(args, n_chips, peak)
     if "e2e" in want:
         suites["e2e"] = bench_e2e(args, n_chips)
 

@@ -31,6 +31,30 @@ def test_bench_cpu_emits_accounted_json():
     assert "warning" not in s
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("suite", ["mf", "w2v"])
+def test_bench_embedding_suites_cpu(suite):
+    """Round-3 suites for BASELINE configs 3 (MF/MovieLens) and 5
+    (word2vec/enwiki): same harness contract — one JSON line, accounted
+    fields, off-TPU vs_baseline refusal."""
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--cpu", "--suite", suite,
+         "--batch", "512", "--chain", "2", "--reps", "2"],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    out = json.loads(line)
+    assert out["unit"] == "samples/sec/chip"
+    assert out["value"] > 0
+    assert out["vs_baseline"] is None          # off-TPU refusal holds
+    assert suite in out["metric"]              # never labeled as LR+MLP
+    s = out["suites"][suite]
+    assert s["tflops_per_chip"] > 0
+    assert s["mfu_vs_bf16_peak"] is None
+    assert "warning" not in s
+
+
 def test_sharded_ps_bench_worker_standalone():
     """Zero-wire baseline mode (no launcher): the worker runs, counts, and
     reports the protocol fields — the n=1 point of bench_sharded_ps.py."""
