@@ -279,7 +279,8 @@ def bench_lm(args, n_chips, peak):
     D, depth, heads = args.lm_dim, args.lm_depth, args.lm_dim // 64
     vocab = 1 << 14
     params = tfm.init(jax.random.PRNGKey(0), vocab=vocab, dim=D,
-                      heads=heads, depth=depth, max_len=T)
+                      heads=heads, depth=depth, max_len=T,
+                      kv_heads=args.lm_kv_heads)
     table = DenseTable(params, mesh, name="lm", updater="adam", lr=1e-3)
     attn = "flash" if jax.default_backend() == "tpu" else "reference"
     remat = False
@@ -320,6 +321,8 @@ def bench_lm(args, n_chips, peak):
     m_attn = 12.0 * B * T * T * D * depth * 0.5     # causal attn fwd+bwd
     flops_step = K * (m_mat + m_attn)
     out = _suite_result(K * tokens, dt, n_chips, flops_step, peak)
+    if args.lm_kv_heads:
+        out["kv_heads"] = args.lm_kv_heads
     # HONEST dual accounting: mfu_vs_bf16_peak above is MODEL-FLOPs MFU
     # (the number people compare across systems); remat/chunked-CE
     # recompute is real chip work that the model number hides, so also
@@ -696,6 +699,8 @@ def _run_all(args) -> int:
                 "--lm-dim", str(args.lm_dim),
                 "--lm-depth", str(args.lm_depth),
                 *(["--lm-remat"] if args.lm_remat else []),
+                *(["--lm-kv-heads", str(args.lm_kv_heads)]
+                  if args.lm_kv_heads else []),
                 "--lm-remat-mode", args.lm_remat_mode,
                 "--lm-head-chunk", str(args.lm_head_chunk),
                 "--wd-slots", str(args.wd_slots),
@@ -768,6 +773,10 @@ def main() -> int:
     ap.add_argument("--lm-seq", type=int, default=1024)
     ap.add_argument("--lm-dim", type=int, default=512)
     ap.add_argument("--lm-depth", type=int, default=4)
+    ap.add_argument("--lm-kv-heads", type=int, default=None,
+                    help="grouped-query attention KV heads (1 = MQA; "
+                         "default = dim/64 q-heads, classic MHA) — "
+                         "shrinks KV projection + activations")
     ap.add_argument("--lm-remat", action="store_true",
                     help="recompute block activations in backward "
                          "(fits larger --lm-dim/--lm-depth in HBM)")

@@ -114,6 +114,12 @@ def _flags(parser):
                         help=f"transformer blocks (default {MODEL['depth']})")
     parser.add_argument("--heads", type=int, default=None,
                         help=f"attention heads (default {MODEL['heads']})")
+    parser.add_argument("--kv_heads", type=int, default=None,
+                        help="grouped-query attention: KV heads shared by "
+                             "groups of q-heads (1 = MQA; default = "
+                             "--heads, classic MHA). Shrinks KV "
+                             "projection + activations + sp ring wire by "
+                             "heads/kv_heads")
     parser.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16"],
                         help="dp/sp: worker-math precision (bfloat16 = "
@@ -141,6 +147,12 @@ def _model_cfg(args, seq_len: int) -> dict:
     if m["heads"] < 1 or m["dim"] % m["heads"]:
         raise SystemExit(f"--dim {m['dim']} must divide by --heads "
                          f"{m['heads']} (>= 1)")
+    kv = getattr(args, "kv_heads", None)
+    if kv is not None:
+        if kv < 1 or m["heads"] % kv:
+            raise SystemExit(f"--kv_heads {kv} must divide --heads "
+                             f"{m['heads']} (>= 1)")
+        m["kv_heads"] = kv
     m["max_len"] = max(getattr(args, "max_len", None) or m["max_len"],
                        seq_len)
     return m
@@ -404,7 +416,8 @@ def _run_ep(cfg, args, metrics, seq_len) -> dict:
     params = tfm.init_moe_lm(
         jax.random.PRNGKey(cfg.train.seed), vocab=model["vocab"],
         dim=model["dim"], heads=heads, depth=model["depth"],
-        max_len=model["max_len"], num_experts=experts)
+        max_len=model["max_len"], num_experts=experts,
+        kv_heads=model.get("kv_heads"))
     specs = tfm.ep_lm_specs(params)
     shardings = jax.tree.map(lambda sp: NamedSharding(mesh, sp), specs,
                              is_leaf=lambda x: isinstance(x, P))

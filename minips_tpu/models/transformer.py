@@ -33,9 +33,21 @@ from minips_tpu.parallel.ring_attention import (
 
 
 def init(key, *, vocab: int = 256, dim: int = 64, heads: int = 4,
-         depth: int = 2, max_len: int = 1024, mlp_mult: int = 4):
+         depth: int = 2, max_len: int = 1024, mlp_mult: int = 4,
+         kv_heads: int = None):
+    """``kv_heads < heads`` builds a grouped-query model (1 = MQA): the
+    K/V projection emits ``kv_heads`` heads that every group of
+    ``heads // kv_heads`` q-heads shares — the projection weights, the
+    attention K/V activations, and (under sp) the ring's ppermute wire
+    all shrink by the group factor. ``None``/``heads`` keeps the classic
+    fused [dim, 3, dim] qkv layout (same param tree as before GQA)."""
     if dim % heads:
         raise ValueError(f"dim {dim} not divisible by heads {heads}")
+    gqa = kv_heads is not None and kv_heads != heads
+    if gqa and (kv_heads < 1 or heads % kv_heads):
+        raise ValueError(f"kv_heads {kv_heads} must be >= 1 and divide "
+                         f"heads {heads}")
+    hd = dim // heads
     ks = iter(jax.random.split(key, 2 + depth))
     scale = dim ** -0.5
     params = {
@@ -45,19 +57,28 @@ def init(key, *, vocab: int = 256, dim: int = 64, heads: int = 4,
         "blocks": [],
     }
     for _ in range(depth):
-        kq, kp, ki, ko = jax.random.split(next(ks), 4)
-        params["blocks"].append({
+        kq, kp, ki, ko, kk = jax.random.split(next(ks), 5)
+        blk = {
             "ln1": {"g": jnp.ones(dim), "b": jnp.zeros(dim)},
             "ln2": {"g": jnp.ones(dim), "b": jnp.zeros(dim)},
-            # one [dim, 3, dim] tensor, axis 1 = (q, k, v); the last dim is
-            # the head dim (heads contiguous), so tensor parallelism can
-            # shard it at head boundaries
-            "qkv": jax.random.normal(kq, (dim, 3, dim)) * scale,
             "proj": jax.random.normal(kp, (dim, dim)) * scale,
             "mlp_in": jax.random.normal(ki, (dim, mlp_mult * dim)) * scale,
             "mlp_out": jax.random.normal(ko, (mlp_mult * dim, dim))
                        * (mlp_mult * dim) ** -0.5,
-        })
+        }
+        if gqa:
+            # split layout: full-width Q, narrow fused KV ([dim, 2, kv
+            # width], axis 1 = (k, v)); head dim contiguous in the last
+            # axis so TP shards both at head boundaries
+            blk["wq"] = jax.random.normal(kq, (dim, dim)) * scale
+            blk["wkv"] = (jax.random.normal(kk, (dim, 2, kv_heads * hd))
+                          * scale)
+        else:
+            # one [dim, 3, dim] tensor, axis 1 = (q, k, v); the last dim
+            # is the head dim (heads contiguous), so tensor parallelism
+            # can shard it at head boundaries
+            blk["qkv"] = jax.random.normal(kq, (dim, 3, dim)) * scale
+        params["blocks"].append(blk)
     return params
 
 
@@ -83,20 +104,37 @@ def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None,
     local_heads = heads // tp
     from jax.ad_checkpoint import checkpoint_name
     x = _ln(h, blk["ln1"]).astype(compute_dtype)
-    qkv = jnp.einsum("btd,dce->btce", x, blk["qkv"].astype(compute_dtype))
-    # named so "hybrid_qkv" can save it — with qkv, attn_out and
-    # mlp_hidden all resident, backward recomputes only the attention
-    # output projection (2 of 24 D^2-units per block)
-    qkv = checkpoint_name(qkv, "qkv")
     # q/k/v stay in compute_dtype: the flash kernel runs its dots at the
     # input dtype's MXU rate with f32 accumulation, so a bf16 run keeps
     # bf16 VMEM/HBM traffic end-to-end (upcasting here doubled both and
     # forced f32-rate attention matmuls)
-    q, k, v = (qkv[:, :, i] for i in range(3))
-    hd = q.shape[-1] // local_heads
-    q = q.reshape(B, T, local_heads, hd)
-    k = k.reshape(B, T, local_heads, hd)
-    v = v.reshape(B, T, local_heads, hd)
+    if "wkv" in blk:
+        # grouped-query layout: full-width Q, narrow fused KV; the
+        # attention impls map q-head h onto kv head h // g themselves
+        q = x @ blk["wq"].astype(compute_dtype)
+        kv = jnp.einsum("btd,dce->btce", x,
+                        blk["wkv"].astype(compute_dtype))
+        # same checkpoint names as the fused layout, so every remat
+        # policy ("hybrid_qkv" saves the projections) works unchanged
+        q = checkpoint_name(q, "qkv")
+        kv = checkpoint_name(kv, "qkv")
+        hd = q.shape[-1] // local_heads
+        local_kv = kv.shape[-1] // hd
+        q = q.reshape(B, T, local_heads, hd)
+        k = kv[:, :, 0].reshape(B, T, local_kv, hd)
+        v = kv[:, :, 1].reshape(B, T, local_kv, hd)
+    else:
+        qkv = jnp.einsum("btd,dce->btce", x,
+                         blk["qkv"].astype(compute_dtype))
+        # named so "hybrid_qkv" can save it — with qkv, attn_out and
+        # mlp_hidden all resident, backward recomputes only the attention
+        # output projection (2 of 24 D^2-units per block)
+        qkv = checkpoint_name(qkv, "qkv")
+        q, k, v = (qkv[:, :, i] for i in range(3))
+        hd = q.shape[-1] // local_heads
+        q = q.reshape(B, T, local_heads, hd)
+        k = k.reshape(B, T, local_heads, hd)
+        v = v.reshape(B, T, local_heads, hd)
     a = attn_fn(q, k, v).reshape(B, T, -1)
     # named for selective remat: remat="attn" saves exactly this tensor,
     # so the backward never re-runs the attention itself (the priciest
@@ -301,6 +339,18 @@ def apply_tp(params, tokens, *, heads=4, axis_name="model",
     if heads % tp:
         raise ValueError(f"heads {heads} not divisible by tensor-parallel "
                          f"size {tp} (head-boundary sharding)")
+    blk0 = params["blocks"][0]
+    if "wkv" in blk0:
+        # params arrive SHARDED here: wkv's local width must still be a
+        # whole number of kv heads, else the head-boundary sharding split
+        # a kv head across model shards
+        hd = params["tok_emb"].shape[1] // heads
+        local_w = blk0["wkv"].shape[2]
+        if local_w % hd:
+            raise ValueError(
+                f"GQA kv_heads {local_w * tp // hd} not divisible by "
+                f"tensor-parallel size {tp} (each shard needs whole kv "
+                f"heads)")
     T = tokens.shape[1]
     return _forward(params, tokens, jnp.arange(T), heads,
                     lambda q, k, v: reference_attention(q, k, v, causal=True),
@@ -314,14 +364,19 @@ def tp_specs(params, axis_name="model"):
     from jax.sharding import PartitionSpec as P
 
     def one_block(blk):
-        return {
+        out = {
             "ln1": jax.tree.map(lambda _: P(), blk["ln1"]),
             "ln2": jax.tree.map(lambda _: P(), blk["ln2"]),
-            "qkv": P(None, None, axis_name),
             "proj": P(axis_name, None),
             "mlp_in": P(None, axis_name),
             "mlp_out": P(axis_name, None),
         }
+        if "wkv" in blk:   # GQA: both projections column-parallel at
+            out["wq"] = P(None, axis_name)         # head boundaries
+            out["wkv"] = P(None, None, axis_name)
+        else:
+            out["qkv"] = P(None, None, axis_name)
+        return out
 
     return {
         "tok_emb": P(),
@@ -382,16 +437,17 @@ def pp_specs(params_stacked, axis_name="model"):
 
 def init_moe_lm(key, *, vocab: int = 256, dim: int = 64, heads: int = 4,
                 depth: int = 2, max_len: int = 1024, num_experts: int = 8,
-                expert_hidden: int = 256):
+                expert_hidden: int = 256, kv_heads: int = None):
     """LM variant whose FFNs are Switch-style MoE layers (parallel/moe.py):
-    same attention as ``init``, each block's MLP replaced by router +
-    stacked expert weights. Use with ``apply_ep`` under shard_map (experts
-    sharded over the data axis) or with moe_apply_dense on one device."""
+    same attention as ``init`` (incl. grouped-query via ``kv_heads``),
+    each block's MLP replaced by router + stacked expert weights. Use with
+    ``apply_ep`` under shard_map (experts sharded over the data axis) or
+    with moe_apply_dense on one device."""
     from minips_tpu.parallel.moe import init_moe
 
     k_base, k_moe = jax.random.split(key)
     base = init(k_base, vocab=vocab, dim=dim, heads=heads, depth=depth,
-                max_len=max_len, mlp_mult=1)
+                max_len=max_len, mlp_mult=1, kv_heads=kv_heads)
     ks = jax.random.split(k_moe, depth)
     for i, blk in enumerate(base["blocks"]):
         del blk["mlp_in"], blk["mlp_out"]
@@ -440,13 +496,17 @@ def ep_lm_specs(params, axis_name=DATA_AXIS):
     from minips_tpu.parallel.moe import ep_specs
 
     def one_block(blk):
-        return {
+        out = {
             "ln1": jax.tree.map(lambda _: P(), blk["ln1"]),
             "ln2": jax.tree.map(lambda _: P(), blk["ln2"]),
-            "qkv": P(),
             "proj": P(),
             "moe": ep_specs(axis_name),
         }
+        # attention projections replicate either layout (fused or GQA)
+        for name in ("qkv", "wq", "wkv"):
+            if name in blk:
+                out[name] = P()
+        return out
 
     return {
         "tok_emb": P(),

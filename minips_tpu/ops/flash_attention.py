@@ -61,6 +61,27 @@ def _pcast_varying(x, axes):
     return jax.lax.pcast(x, need, to="varying") if need else x
 
 
+def gqa_group_size(num_q_heads: int, num_kv_heads: int) -> int:
+    """Q-heads per KV head (grouped-query attention). 1 = classic MHA,
+    num_q_heads = MQA. Raises unless kv divides q."""
+    if num_q_heads % num_kv_heads:
+        raise ValueError(
+            f"GQA needs kv_heads ({num_kv_heads}) to divide q heads "
+            f"({num_q_heads})")
+    return num_q_heads // num_kv_heads
+
+
+def _expand_kv(q, k, v):
+    """Repeat K/V heads up to Q's head count for the pure-jnp paths.
+    This forfeits GQA's memory saving (it exists only for oracle/fallback
+    exactness off-TPU); the Pallas kernels instead map each q-head's
+    block index onto its kv head and never materialize the repeat."""
+    g = gqa_group_size(q.shape[2], k.shape[2])
+    if g == 1:
+        return k, v
+    return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+
+
 # --------------------------------------------------------------- blockwise
 def blockwise_attention(
     q: jnp.ndarray,
@@ -86,6 +107,7 @@ def blockwise_attention(
     the pure-jnp twin of the Pallas kernels.
     """
     B, Tq, H, D = q.shape
+    k, v = _expand_kv(q, k, v)   # GQA: exact repeat on this oracle path
     Tk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
@@ -222,8 +244,12 @@ def _vma_of(*xs):
 
 def _flash_forward(q, k, v, q_off, k_off, masked, scale, block_q, block_k,
                    interpret):
-    """[B, T, H, D] in/out; kernel runs on [B, H, T, D]."""
+    """[B, T, H, D] in/out; kernel runs on [B, H, T, D]. K/V may carry
+    fewer heads (GQA): each q-head's K/V block index maps onto kv head
+    h // g — the repeat never materializes, so KV HBM traffic shrinks by
+    the group factor."""
     B, Tq, H, D = q.shape
+    g = gqa_group_size(H, k.shape[2])
     Tk = k.shape[1]
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
@@ -241,8 +267,10 @@ def _flash_forward(q, k, v, q_off, k_off, masked, scale, block_q, block_k,
         in_specs=[
             _smem_spec(), _smem_spec(),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, i, j: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, i, j: (b, h // g, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -299,14 +327,17 @@ def _flash_bwd_dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
 
 def _flash_bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
                           lse_ref, dvec_ref, dk_ref, dv_ref, dk_acc,
-                          dv_acc, *, scale, masked, num_q):
-    # Grid (B, H, nK, nQ), Q innermost; dK/dV for one K block accumulate
-    # in scratch across the Q sweep (the transposed iteration of dq).
+                          dv_acc, *, scale, masked, num_q, q_per_kv):
+    # Grid (B, Hk, nK, q_per_kv*nQ), the combined (group q-head, Q block)
+    # sweep innermost; dK/dV for one KV-head K block accumulate in scratch
+    # across BOTH — under GQA every kv head receives gradient from all
+    # q_per_kv q-heads of its group (the transposed iteration of dq).
     bq, bk = q_ref.shape[2], k_ref.shape[2]
-    j, i = pl.program_id(2), pl.program_id(3)   # j: K block, i: Q block
+    j, t = pl.program_id(2), pl.program_id(3)   # j: K block
+    i = jax.lax.rem(t, num_q)                   # i: Q block within head
     q_off, k_off = qoff_ref[0], koff_ref[0]
 
-    @pl.when(i == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -327,27 +358,31 @@ def _flash_bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
         dk_acc[:] = dk_acc[:] + jnp.dot(
             ds.T.astype(qb.dtype), qb, preferred_element_type=jnp.float32)
 
-    @pl.when(i == num_q - 1)
+    @pl.when(t == num_q * q_per_kv - 1)
     def _write():
         dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, q_off, k_off, g, lse, dvec, masked, scale,
+def _flash_backward(q, k, v, q_off, k_off, g_out, lse, dvec, masked, scale,
                     block_q, block_k, interpret):
     """dQ/dK/dV via the two backward kernels; [B, T, H, D] layout.
-    ``dvec`` is [B, H, Tq, 1] — rowsum(dO*O) minus the lse cotangent."""
+    ``dvec`` is [B, H, Tq, 1] — rowsum(dO*O) minus the lse cotangent.
+    Under GQA dk/dv come back at the kv head count."""
     B, Tq, H, D = q.shape
+    Hk = k.shape[2]
+    g = gqa_group_size(H, Hk)
     Tk = k.shape[1]
     bq = min(block_q, Tq)
     bk = min(block_k, Tk)
-    qt, kt, vt, dot = (x.transpose(0, 2, 1, 3) for x in (q, k, v, g))
-    vma = _vma_of(q, k, v, q_off, k_off, g)
+    qt, kt, vt, dot = (x.transpose(0, 2, 1, 3) for x in (q, k, v, g_out))
+    vma = _vma_of(q, k, v, q_off, k_off, g_out)
     offs = (jnp.asarray(q_off, jnp.int32).reshape(1),
             jnp.asarray(k_off, jnp.int32).reshape(1))
 
     q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
-    kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, D),
+                           lambda b, h, i, j: (b, h // g, j, 0))
     row_spec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale, masked=masked,
@@ -361,21 +396,26 @@ def _flash_backward(q, k, v, q_off, k_off, g, lse, dvec, masked, scale,
         interpret=interpret,
     )(*offs, qt, kt, vt, dot, lse, dvec)
 
-    # transposed grid: K outer, Q inner
-    q_spec_t = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
-    kv_spec_t = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
-    row_spec_t = pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0))
+    # transposed grid: K outer, (group q-head, Q block) inner — grid dim 1
+    # walks KV heads, the q-head within the group rides the inner sweep
+    nq = Tq // bq
+    q_spec_t = pl.BlockSpec(
+        (1, 1, bq, D), lambda b, hk, j, t: (b, hk * g + t // nq, t % nq, 0))
+    kv_spec_t = pl.BlockSpec((1, 1, bk, D),
+                             lambda b, hk, j, t: (b, hk, j, 0))
+    row_spec_t = pl.BlockSpec(
+        (1, 1, bq, 1), lambda b, hk, j, t: (b, hk * g + t // nq, t % nq, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale,
-                          masked=masked, num_q=Tq // bq),
-        grid=(B, H, Tk // bk, Tq // bq),
+                          masked=masked, num_q=nq, q_per_kv=g),
+        grid=(B, Hk, Tk // bk, g * nq),
         in_specs=[_smem_spec(), _smem_spec(),
                   q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
                   row_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Tk, D), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((B, H, Tk, D), v.dtype, vma=vma),
+            jax.ShapeDtypeStruct((B, Hk, Tk, D), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((B, Hk, Tk, D), v.dtype, vma=vma),
         ],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
@@ -442,6 +482,8 @@ def kernel_supported(q_shape, k_shape, block_q: int, block_k: int) -> bool:
     B, Tq, H, D = q_shape
     Tk = k_shape[1]
     bq, bk = min(block_q, Tq), min(block_k, Tk)
+    if q_shape[2] % k_shape[2]:   # GQA: kv heads must divide q heads
+        return False
     return Tq % bq == 0 and Tk % bk == 0 and D % 8 == 0
 
 
@@ -460,6 +502,11 @@ def flash_attention(
     ``ring_attention.reference_attention`` but never materializes the full
     score matrix. Uses the Pallas kernel on TPU (or ``interpret=True``
     anywhere, for tests); otherwise the blockwise scan — both exact.
+
+    Grouped-query attention: K/V may carry fewer heads than Q (kv divides
+    q, q-head h reads kv head h // group). The kernel path streams the
+    small K/V straight from HBM — traffic and ring wire bytes shrink by
+    the group factor; the fallback repeats heads (exact, memory-expanded).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5

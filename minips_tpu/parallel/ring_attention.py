@@ -34,6 +34,11 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from minips_tpu.parallel.mesh import DATA_AXIS
+# GQA head expansion shared with the kernel module (ONE implementation of
+# the repeat + divisibility check). NOTE: under ring attention the repeat
+# happens AFTER each shard arrives, so the ppermute wire still carries
+# only the small kv heads.
+from minips_tpu.ops.flash_attention import _expand_kv
 
 _NEG_INF = -1e30  # mask value; avoids -inf NaNs in (m - m_new) when a whole
                   # row is masked at an early ring step
@@ -106,10 +111,13 @@ def ring_attention_local(
     def body(step, carry):
         o, m, l, k_cur, v_cur = carry
         mask = block_mask(step)
+        # GQA: expand the VISITING shard only — the rotating carry (and so
+        # the ppermute wire) stays at the small kv head count
+        k_exp, v_exp = _expand_kv(q, k_cur, v_cur)
         o, m, l = jax.vmap(
             lambda o_, m_, l_, q_, k_, v_: _online_block(
                 o_, m_, l_, q_, k_, v_, mask, scale)
-        )(o, m, l, q, k_cur, v_cur)
+        )(o, m, l, q, k_exp, v_exp)
         # rotate K/V one hop for the next step (last rotation is redundant
         # but keeps the loop body uniform; XLA overlaps it with the matmuls)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -159,8 +167,10 @@ def make_ring_attention(
 
 def reference_attention(q, k, v, *, causal=False, scale=None):
     """O(T^2)-memory oracle for tests: plain softmax(QK^T)V. Scores and
-    softmax run in f32 whatever the input dtype; output is q.dtype."""
+    softmax run in f32 whatever the input dtype; output is q.dtype.
+    K/V with fewer heads (GQA) are repeated up to Q's head count."""
     D = q.shape[-1]
+    k, v = _expand_kv(q, k, v)
     if scale is None:
         scale = D ** -0.5
     s = jnp.einsum("bqhd,bkhd->bqkh", q, k,

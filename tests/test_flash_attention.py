@@ -39,6 +39,64 @@ def test_pallas_kernel_matches_oracle_interpret(causal):
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
 
+def _qkv_gqa(B=2, T=64, H=4, Hk=2, D=16, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D), dtype)
+    k = jax.random.normal(ks[1], (B, T, Hk, D), dtype)
+    v = jax.random.normal(ks[2], (B, T, Hk, D), dtype)
+    return q, k, v
+
+
+def _gqa_oracle(q, k, v, causal):
+    """Explicit repeat-KV + full-head oracle: the defining semantics of
+    grouped-query attention (q-head h attends through kv head h // g)."""
+    g = q.shape[2] // k.shape[2]
+    return reference_attention(q, jnp.repeat(k, g, axis=2),
+                               jnp.repeat(v, g, axis=2), causal=causal)
+
+
+@pytest.mark.parametrize("hk", [1, 2])   # 1 = MQA, 2 = 2-way GQA of H=4
+@pytest.mark.parametrize("causal", [False, True])
+def test_gqa_forward_matches_repeat_oracle(causal, hk):
+    q, k, v = _qkv_gqa(Hk=hk)
+    ref = _gqa_oracle(q, k, v, causal)
+    out_bw = blockwise_attention(q, k, v, causal=causal, block_k=16)
+    np.testing.assert_allclose(out_bw, ref, atol=1e-5, rtol=1e-5)
+    assert kernel_supported(q.shape, k.shape, 32, 16)
+    out_kn = flash_attention(q, k, v, causal=causal, block_q=32,
+                             block_k=16, interpret=True)
+    np.testing.assert_allclose(out_kn, ref, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("hk", [1, 2])
+@pytest.mark.parametrize("causal", [False, True])
+def test_gqa_gradients_match_repeat_oracle(causal, hk):
+    """dK/dV under GQA must aggregate over every q-head in the group —
+    the kernel's combined (group-head, Q-block) sweep vs AD through the
+    explicit repeat (whose transpose is exactly that group-sum)."""
+    q, k, v = _qkv_gqa(T=32, Hk=hk)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_gqa_oracle(q, k, v, causal) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=16,
+                                       block_k=16, interpret=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        assert a.shape == b.shape    # dk/dv at the SMALL kv head count
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+def test_gqa_rejects_nondivisible_heads():
+    q, k, v = _qkv_gqa(H=4, Hk=3)
+    assert not kernel_supported(q.shape, k.shape, 32, 16)
+    with pytest.raises(ValueError, match="divide"):
+        blockwise_attention(q, k, v, causal=True, block_k=16)
+
+
 def test_blockwise_ragged_tail_still_exact():
     q, k, v = _qkv(T=48)
     out = blockwise_attention(q, k, v, causal=True, block_k=32)  # 48 % 32 != 0

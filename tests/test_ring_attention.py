@@ -46,6 +46,26 @@ def test_matches_oracle_4way(mesh4, causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("hk", [1, 2])
+@pytest.mark.parametrize("causal", [False, True])
+def test_gqa_matches_repeat_oracle(mesh4, causal, hk):
+    """GQA through the ring: K/V shards rotate at the SMALL head count
+    (the ppermute wire shrinks by the group factor); result must equal
+    the explicit repeat-KV full-head oracle."""
+    B, T, H, D = 1, 32, 4, 8
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, hk, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, hk, D), jnp.float32)
+    attn = make_ring_attention(mesh4, causal=causal)
+    out = attn(attn.shard(q), attn.shard(k), attn.shard(v))
+    want = reference_attention(q, jnp.repeat(k, H // hk, axis=2),
+                               jnp.repeat(v, H // hk, axis=2),
+                               causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_single_device_degenerates_to_full_attention():
     """n=1 ring = one online-softmax pass over the whole sequence."""
     B, T, H, D = 2, 16, 2, 8

@@ -129,3 +129,32 @@ def test_tp_heads_not_divisible_raises(mesh42, params):
             lambda p, t: tfm.apply_tp(p, t, heads=3),
             mesh=mesh42, in_specs=(specs, P()), out_specs=P()
         )(params, _toks(1, 8))
+
+
+def test_tp_gqa_logits_match_full(mesh42):
+    """GQA under TP: wq/wkv shard column-parallel at head boundaries
+    (each model shard computes 2 q-heads over 1 kv head here); logits
+    must match the unsharded oracle."""
+    p = tfm.init(jax.random.PRNGKey(7), **{**CFG, "kv_heads": 2})
+    tokens = _toks(2, 16, seed=7)
+    want = tfm.apply(p, tokens, heads=CFG["heads"], **F32)
+    specs = tfm.tp_specs(p)
+    f = jax.shard_map(
+        lambda q, t: tfm.apply_tp(q, t, heads=CFG["heads"], **F32),
+        mesh=mesh42, in_specs=(specs, P()), out_specs=P())
+    got = f(p, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_tp_gqa_kv_not_divisible_raises(mesh42):
+    """kv_heads=1 cannot split across model=2 shards — apply_tp must
+    refuse loudly instead of computing garbage."""
+    p = tfm.init(jax.random.PRNGKey(7), **{**CFG, "kv_heads": 1})
+    tokens = _toks(1, 8)
+    specs = tfm.tp_specs(p)
+    f = jax.shard_map(
+        lambda q, t: tfm.apply_tp(q, t, heads=CFG["heads"], **F32),
+        mesh=mesh42, in_specs=(specs, P()), out_specs=P())
+    with pytest.raises(ValueError, match="kv_heads"):
+        f(p, tokens)
