@@ -280,7 +280,7 @@ def bench_lm(args, n_chips, peak):
     vocab = 1 << 14
     params = tfm.init(jax.random.PRNGKey(0), vocab=vocab, dim=D,
                       heads=heads, depth=depth, max_len=T,
-                      kv_heads=args.lm_kv_heads)
+                      kv_heads=args.lm_kv_heads, rope=args.lm_rope)
     table = DenseTable(params, mesh, name="lm", updater="adam", lr=1e-3)
     attn = "flash" if jax.default_backend() == "tpu" else "reference"
     remat = False
@@ -323,6 +323,8 @@ def bench_lm(args, n_chips, peak):
     out = _suite_result(K * tokens, dt, n_chips, flops_step, peak)
     if args.lm_kv_heads:
         out["kv_heads"] = args.lm_kv_heads
+    if args.lm_rope:
+        out["rope"] = True
     # HONEST dual accounting: mfu_vs_bf16_peak above is MODEL-FLOPs MFU
     # (the number people compare across systems); remat/chunked-CE
     # recompute is real chip work that the model number hides, so also
@@ -701,6 +703,7 @@ def _run_all(args) -> int:
                 *(["--lm-remat"] if args.lm_remat else []),
                 *(["--lm-kv-heads", str(args.lm_kv_heads)]
                   if args.lm_kv_heads else []),
+                *(["--lm-rope"] if args.lm_rope else []),
                 "--lm-remat-mode", args.lm_remat_mode,
                 "--lm-head-chunk", str(args.lm_head_chunk),
                 "--wd-slots", str(args.wd_slots),
@@ -777,6 +780,9 @@ def main() -> int:
                     help="grouped-query attention KV heads (1 = MQA; "
                          "default = dim/64 q-heads, classic MHA) — "
                          "shrinks KV projection + activations")
+    ap.add_argument("--lm-rope", action="store_true",
+                    help="rotary position embeddings instead of the "
+                         "learned table")
     ap.add_argument("--lm-remat", action="store_true",
                     help="recompute block activations in backward "
                          "(fits larger --lm-dim/--lm-depth in HBM)")

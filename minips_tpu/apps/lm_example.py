@@ -120,6 +120,10 @@ def _flags(parser):
                              "--heads, classic MHA). Shrinks KV "
                              "projection + activations + sp ring wire by "
                              "heads/kv_heads")
+    parser.add_argument("--rope", action="store_true",
+                        help="rotary position embeddings instead of the "
+                             "learned table: no pos_emb params, no "
+                             "max_len sequence cap (--max_len ignored)")
     parser.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16"],
                         help="dp/sp: worker-math precision (bfloat16 = "
@@ -153,6 +157,11 @@ def _model_cfg(args, seq_len: int) -> dict:
             raise SystemExit(f"--kv_heads {kv} must divide --heads "
                              f"{m['heads']} (>= 1)")
         m["kv_heads"] = kv
+    if getattr(args, "rope", False):
+        if (m["dim"] // m["heads"]) % 2:
+            raise SystemExit(f"--rope needs an even head dim "
+                             f"(--dim {m['dim']} / --heads {m['heads']})")
+        m["rope"] = True
     m["max_len"] = max(getattr(args, "max_len", None) or m["max_len"],
                        seq_len)
     return m
@@ -417,7 +426,7 @@ def _run_ep(cfg, args, metrics, seq_len) -> dict:
         jax.random.PRNGKey(cfg.train.seed), vocab=model["vocab"],
         dim=model["dim"], heads=heads, depth=model["depth"],
         max_len=model["max_len"], num_experts=experts,
-        kv_heads=model.get("kv_heads"))
+        kv_heads=model.get("kv_heads"), rope=model.get("rope", False))
     specs = tfm.ep_lm_specs(params)
     shardings = jax.tree.map(lambda sp: NamedSharding(mesh, sp), specs,
                              is_leaf=lambda x: isinstance(x, P))

@@ -34,13 +34,18 @@ from minips_tpu.parallel.ring_attention import (
 
 def init(key, *, vocab: int = 256, dim: int = 64, heads: int = 4,
          depth: int = 2, max_len: int = 1024, mlp_mult: int = 4,
-         kv_heads: int = None):
+         kv_heads: int = None, rope: bool = False):
     """``kv_heads < heads`` builds a grouped-query model (1 = MQA): the
     K/V projection emits ``kv_heads`` heads that every group of
     ``heads // kv_heads`` q-heads shares — the projection weights, the
     attention K/V activations, and (under sp) the ring's ppermute wire
     all shrink by the group factor. ``None``/``heads`` keeps the classic
-    fused [dim, 3, dim] qkv layout (same param tree as before GQA)."""
+    fused [dim, 3, dim] qkv layout (same param tree as before GQA).
+
+    ``rope=True`` replaces the learned positional table with rotary
+    embeddings (:func:`rope_rotate` on Q/K inside every attention call):
+    no ``pos_emb`` params, no ``max_len`` sequence cap — the long-context
+    positional scheme (``max_len`` is ignored)."""
     if dim % heads:
         raise ValueError(f"dim {dim} not divisible by heads {heads}")
     gqa = kv_heads is not None and kv_heads != heads
@@ -48,14 +53,20 @@ def init(key, *, vocab: int = 256, dim: int = 64, heads: int = 4,
         raise ValueError(f"kv_heads {kv_heads} must be >= 1 and divide "
                          f"heads {heads}")
     hd = dim // heads
+    if rope and hd % 2:
+        raise ValueError(f"rope needs an even head dim (dim/heads = {hd})")
     ks = iter(jax.random.split(key, 2 + depth))
     scale = dim ** -0.5
     params = {
         "tok_emb": jax.random.normal(next(ks), (vocab, dim)) * scale,
-        "pos_emb": jax.random.normal(next(ks), (max_len, dim)) * scale,
         "ln_f": {"g": jnp.ones(dim), "b": jnp.zeros(dim)},
         "blocks": [],
     }
+    if not rope:
+        params["pos_emb"] = (jax.random.normal(next(ks), (max_len, dim))
+                             * scale)
+    else:
+        next(ks)  # burn the key so rope=True doesn't reshuffle block init
     for _ in range(depth):
         kq, kp, ki, ko, kk = jax.random.split(next(ks), 5)
         blk = {
@@ -174,14 +185,21 @@ def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
     wraps each block in ``jax.checkpoint`` so the backward pass recomputes
     block activations instead of storing them — the standard HBM-for-FLOPs
     trade that long-context training needs."""
-    # static check: jax clamps out-of-range indices silently, so an
-    # oversized sequence would reuse the last positional embedding row
-    # for every tail position instead of erroring
-    max_len = params["pos_emb"].shape[0]
-    if pos.shape[0] > max_len:
-        raise ValueError(f"sequence length {pos.shape[0]} exceeds the "
-                         f"model's max_len {max_len}")
-    h = params["tok_emb"][tokens] + params["pos_emb"][pos]
+    if "pos_emb" in params:
+        # static check: jax clamps out-of-range indices silently, so an
+        # oversized sequence would reuse the last positional embedding row
+        # for every tail position instead of erroring
+        max_len = params["pos_emb"].shape[0]
+        if pos.shape[0] > max_len:
+            raise ValueError(f"sequence length {pos.shape[0]} exceeds the "
+                             f"model's max_len {max_len}")
+        h = params["tok_emb"][tokens] + params["pos_emb"][pos]
+    else:
+        # rope model: positions enter through the attention rotation
+        # (below); no table, no sequence-length cap
+        h = params["tok_emb"][tokens]
+        if attn_fn is not None:
+            attn_fn = _rope_wrap(attn_fn, pos)
     aux_total = 0.0
     if apply_blocks is not None:
         # parallel schedules (e.g. the GPipe pipeline) replace the
@@ -243,6 +261,30 @@ def _remat_policy(remat):
     raise ValueError(f"unknown remat mode {remat!r} "
                      "(expected True/False, 'attn', 'dots', 'hybrid' "
                      "or 'hybrid_qkv')")
+
+
+def rope_rotate(x, pos, theta: float = 10000.0):
+    """Rotary position embedding: rotate half-split head-dim pairs of
+    ``x`` [B, T, H, hd] by angles ``pos · theta^(-2i/hd)`` (``pos`` [T],
+    GLOBAL positions — the sp path passes each shard's offset range, so
+    K rows are rotated at their home shard before the ring moves them).
+    Angles/trig run in f32; the product drops back to x.dtype so bf16
+    runs keep bf16-rate attention dots."""
+    half = x.shape[-1] // 2
+    freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * freq[None, :]      # [T, half]
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
+def _rope_wrap(attn_fn, pos):
+    """Attention wrapper applying RoPE to Q and K (never V). Works for
+    any head layout — GQA's narrow K rotates the same way."""
+    return lambda q, k, v: attn_fn(rope_rotate(q, pos),
+                                   rope_rotate(k, pos), v)
 
 
 def _attn_fn(attn_impl: str):
@@ -380,7 +422,7 @@ def tp_specs(params, axis_name="model"):
 
     return {
         "tok_emb": P(),
-        "pos_emb": P(),
+        **({"pos_emb": P()} if "pos_emb" in params else {}),
         "ln_f": jax.tree.map(lambda _: P(), params["ln_f"]),
         "blocks": [one_block(b) for b in params["blocks"]],
     }
@@ -403,13 +445,14 @@ def apply_pp(params, tokens, *, heads=4, axis_name="model",
         raise ValueError(f"batch {B} not divisible into "
                          f"{num_microbatches} microbatches")
     blocks_local = params["blocks"]  # leading depth axis, local slice
+    attn = lambda q, k, v: reference_attention(  # noqa: E731
+        q, k, v, causal=True)
+    if "pos_emb" not in params:   # rope: _forward's wrap can't reach the
+        attn = _rope_wrap(attn, jnp.arange(T))   # stage closure, wrap here
 
     def stage_fn(x):
         def one(hc, blk):
-            h2, _ = _block(hc, blk, heads,
-                           lambda q, k, v: reference_attention(
-                               q, k, v, causal=True),
-                           compute_dtype)
+            h2, _ = _block(hc, blk, heads, attn, compute_dtype)
             return h2, None
         return jax.lax.scan(one, x, blocks_local)[0]
 
@@ -428,7 +471,7 @@ def pp_specs(params_stacked, axis_name="model"):
 
     return {
         "tok_emb": P(),
-        "pos_emb": P(),
+        **({"pos_emb": P()} if "pos_emb" in params_stacked else {}),
         "ln_f": jax.tree.map(lambda _: P(), params_stacked["ln_f"]),
         "blocks": jax.tree.map(lambda _: P(axis_name),
                                params_stacked["blocks"]),
@@ -437,7 +480,8 @@ def pp_specs(params_stacked, axis_name="model"):
 
 def init_moe_lm(key, *, vocab: int = 256, dim: int = 64, heads: int = 4,
                 depth: int = 2, max_len: int = 1024, num_experts: int = 8,
-                expert_hidden: int = 256, kv_heads: int = None):
+                expert_hidden: int = 256, kv_heads: int = None,
+                rope: bool = False):
     """LM variant whose FFNs are Switch-style MoE layers (parallel/moe.py):
     same attention as ``init`` (incl. grouped-query via ``kv_heads``),
     each block's MLP replaced by router + stacked expert weights. Use with
@@ -447,7 +491,7 @@ def init_moe_lm(key, *, vocab: int = 256, dim: int = 64, heads: int = 4,
 
     k_base, k_moe = jax.random.split(key)
     base = init(k_base, vocab=vocab, dim=dim, heads=heads, depth=depth,
-                max_len=max_len, mlp_mult=1, kv_heads=kv_heads)
+                max_len=max_len, mlp_mult=1, kv_heads=kv_heads, rope=rope)
     ks = jax.random.split(k_moe, depth)
     for i, blk in enumerate(base["blocks"]):
         del blk["mlp_in"], blk["mlp_out"]
@@ -510,7 +554,7 @@ def ep_lm_specs(params, axis_name=DATA_AXIS):
 
     return {
         "tok_emb": P(),
-        "pos_emb": P(),
+        **({"pos_emb": P()} if "pos_emb" in params else {}),
         "ln_f": jax.tree.map(lambda _: P(), params["ln_f"]),
         "blocks": [one_block(b) for b in params["blocks"]],
     }

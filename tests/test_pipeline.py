@@ -119,3 +119,21 @@ def test_pp_bad_microbatch_raises(mesh_pp, params):
                                       num_microbatches=3),
             mesh=mesh_pp, in_specs=(specs, P()), out_specs=P()
         )(sp, _toks(4, 8))
+
+
+def test_pp_rope_logits_match_full(mesh_pp):
+    """RoPE through the pipeline: the stage closure applies the rotation
+    (the _forward wrap can't reach it) — logits must match the
+    single-program oracle. depth=4 -> one block per stage."""
+    p = tfm.init(jax.random.PRNGKey(12), vocab=CFG["vocab"], dim=32,
+                 heads=4, depth=4, rope=True)
+    tokens = _toks(4, 16, seed=12)
+    want = tfm.apply(p, tokens, heads=4, **F32)
+    sp = {**p, "blocks": stack_layers(p["blocks"])}
+    specs = tfm.pp_specs(sp)
+    got = jax.shard_map(
+        lambda q, t: tfm.apply_pp(q, t, heads=4, num_microbatches=2,
+                                  **F32),
+        mesh=mesh_pp, in_specs=(specs, P()), out_specs=P())(sp, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)

@@ -32,12 +32,13 @@ def params():
     return tfm.init(jax.random.PRNGKey(0), **CFG)
 
 
-def _sp_logits(mesh, params, tokens, n):
+def _sp_logits(mesh, params, tokens, n, attn_impl="reference"):
     T_local = tokens.shape[1] // n
 
     def shard_fn(p, toks):
         shift = jax.lax.axis_index("data") * T_local
-        return tfm.apply_sp(p, toks, shift, heads=CFG["heads"], **F32)
+        return tfm.apply_sp(p, toks, shift, heads=CFG["heads"],
+                            attn_impl=attn_impl, **F32)
 
     f = jax.shard_map(shard_fn, mesh=mesh,
                       in_specs=(P(), P(None, "data")),
@@ -409,3 +410,106 @@ def test_gqa_trains_through_dense_table(mesh8):
     losses = [float(table.step_inplace(step, {"tokens": toks}))
               for _ in range(12)]
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+# ---------------------------------------------------------------- RoPE
+def test_rope_dot_depends_on_relative_position_only():
+    """The defining RoPE identity: <rotate(q, p1), rotate(k, p2)> equals
+    <rotate(q, p1-p2), rotate(k, 0)> — scores see relative offsets."""
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 1, 1, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 1, 1, 16)), jnp.float32)
+    for p1, p2 in ((5, 3), (40, 11), (7, 7)):
+        a = jnp.sum(tfm.rope_rotate(q, jnp.array([p1]))
+                    * tfm.rope_rotate(k, jnp.array([p2])))
+        b = jnp.sum(tfm.rope_rotate(q, jnp.array([p1 - p2]))
+                    * tfm.rope_rotate(k, jnp.array([0])))
+        np.testing.assert_allclose(float(a), float(b), rtol=1e-5)
+
+
+def test_rope_param_tree_has_no_pos_emb():
+    p = tfm.init(jax.random.PRNGKey(0), vocab=61, dim=32, heads=4,
+                 depth=1, rope=True)
+    assert "pos_emb" not in p
+    with pytest.raises(ValueError, match="even head dim"):
+        tfm.init(jax.random.PRNGKey(0), vocab=61, dim=36, heads=4,
+                 depth=1, rope=True)   # hd=9
+
+
+def test_rope_unbounded_sequence_length():
+    """No positional table -> no max_len cap: a rope model runs sequences
+    far past the (ignored) max_len where the learned table raises."""
+    p_learned = tfm.init(jax.random.PRNGKey(0), vocab=61, dim=32, heads=4,
+                         depth=1, max_len=16)
+    p_rope = tfm.init(jax.random.PRNGKey(0), vocab=61, dim=32, heads=4,
+                      depth=1, max_len=16, rope=True)
+    toks = _toks(1, 48, seed=6)
+    with pytest.raises(ValueError, match="max_len"):
+        tfm.apply(p_learned, toks, heads=4, **F32)
+    logits = tfm.apply(p_rope, toks, heads=4, **F32)
+    assert logits.shape == (1, 48, 61)
+
+
+def test_rope_flash_matches_reference_impl():
+    """Rotation happens before either attention impl — parity must hold
+    (incl. composed with GQA)."""
+    p = tfm.init(jax.random.PRNGKey(8), vocab=61, dim=32, heads=4,
+                 depth=2, rope=True, kv_heads=2)
+    toks = _toks(2, 32, seed=8)
+    ref = tfm.apply(p, toks, heads=4, attn_impl="reference", **F32)
+    fl = tfm.apply(p, toks, heads=4, attn_impl="flash", **F32)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(fl),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("attn_impl", ["reference", "flash"])
+def test_rope_sp_forward_matches_full(mesh8, attn_impl):
+    """Sequence-parallel RoPE: each shard rotates its resident Q and its
+    HOME K rows by their global positions before the ring moves K — the
+    sharded logits must match the single-program oracle through BOTH ring
+    impls (the flash impl runs its exact offset-blockwise path off-TPU)."""
+    p = tfm.init(jax.random.PRNGKey(9), vocab=61, dim=32, heads=4,
+                 depth=2, rope=True)
+    tokens = _toks(2, 64, seed=9)
+    want = tfm.apply(p, tokens, heads=4, **F32)
+    got = _sp_logits(mesh8, p, tokens, 8, attn_impl=attn_impl)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_rope_trains_through_dense_table(mesh8):
+    from minips_tpu.tables.dense import DenseTable
+    from minips_tpu.parallel.mesh import make_mesh
+
+    p = tfm.init(jax.random.PRNGKey(10), vocab=61, dim=32, heads=4,
+                 depth=1, rope=True)
+    mesh = make_mesh()
+    table = DenseTable(p, mesh, name="rope_lm", updater="adam", lr=1e-2)
+    step = table.make_step(functools.partial(tfm.grad_fn, heads=4))
+    toks = _toks(8, 33, seed=10)
+    losses = [float(table.step_inplace(step, {"tokens": toks}))
+              for _ in range(12)]
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_rope_remat_modes_grad_parity():
+    """Remat must stay a pure memory-schedule change with the rotation
+    inside the block's attention call."""
+    p = tfm.init(jax.random.PRNGKey(11), vocab=32, dim=32, heads=4,
+                 depth=2, rope=True)
+    batch = {"tokens": jnp.asarray(
+        np.random.default_rng(11).integers(0, 32, size=(2, 17)))}
+
+    def f(remat):
+        return jax.value_and_grad(
+            lambda q: tfm.loss(q, batch, heads=4,
+                               compute_dtype=jnp.float32,
+                               remat=remat))(p)
+
+    l0, g0 = f(False)
+    for mode in (True, "attn", "dots", "hybrid", "hybrid_qkv"):
+        l1, g1 = f(mode)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
