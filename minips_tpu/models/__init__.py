@@ -1,1 +1,9 @@
-from minips_tpu.models import lr, mf, mlp, transformer, wide_deep, word2vec  # noqa: F401
+from minips_tpu.models import (  # noqa: F401
+    decode,
+    lr,
+    mf,
+    mlp,
+    transformer,
+    wide_deep,
+    word2vec,
+)

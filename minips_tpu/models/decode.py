@@ -1,0 +1,194 @@
+"""KV-cached autoregressive decoding for the transformer LM family.
+
+The reference is a training-only parameter server (SURVEY.md §2 — no
+attention models at all), so inference is beyond parity: this module
+completes the LM family (models/transformer.py) with the serving half —
+prefill + single-token decode steps over a static-shape KV cache, driven
+by one ``lax.scan`` (TPU-shaped: no dynamic shapes, no host round-trips
+per token).
+
+Design:
+
+- The cache is per-block ``{"k", "v"}`` of shape ``[B, max_T, Hk, hd]``
+  where ``Hk`` is the model's KV head count — a grouped-query model
+  (``init(kv_heads=...)``) shrinks the cache by the group factor, which
+  is GQA's raison d'être at serving time.
+- ``_cached_block`` is one implementation for BOTH phases: prefill runs
+  it with the whole prompt (``T_cur = prompt_len``, causal mask among
+  the prompt), decode with ``T_cur = 1``; each call writes its K/V rows
+  into the cache at ``pos_off`` via ``dynamic_update_slice`` and attends
+  over the full static cache under the mask ``k_pos <= q_pos`` — masked
+  (not sliced) attention keeps every shape static for XLA.
+- Positions are global: learned ``pos_emb`` rows or RoPE rotation
+  (``rope_rotate``), matching training exactly — greedy decode equals
+  argmax over ``transformer.apply`` on the growing sequence
+  (tests/test_decode.py pins this against the incremental oracle for
+  every layout combination).
+
+MoE blocks are not wired (decode-time expert routing has a different
+capacity story); ``init_cache`` refuses them loudly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from minips_tpu.models.transformer import _block_tail, _ln, rope_rotate
+
+_NEG_INF = -1e30
+
+
+def _head_dims(params, heads):
+    dim = params["tok_emb"].shape[1]
+    hd = dim // heads
+    blk0 = params["blocks"][0]
+    if "moe" in blk0:
+        raise ValueError("decode does not support MoE blocks")
+    hk = blk0["wkv"].shape[2] // hd if "wkv" in blk0 else heads
+    return hd, hk
+
+
+def init_cache(params, batch: int, max_len: int, *, heads: int = 4,
+               dtype=jnp.bfloat16):
+    """Zeroed per-block KV cache ``[B, max_len, Hk, hd]`` (Hk = the
+    model's KV head count — a GQA model's cache is heads/kv_heads times
+    smaller). ``dtype`` is the cache storage dtype; attention runs its
+    softmax in f32 regardless."""
+    hd, hk = _head_dims(params, heads)
+    if "pos_emb" in params and max_len > params["pos_emb"].shape[0]:
+        raise ValueError(
+            f"max_len {max_len} exceeds the learned positional table "
+            f"({params['pos_emb'].shape[0]} rows); use a rope model for "
+            "unbounded decode")
+    return [{"k": jnp.zeros((batch, max_len, hk, hd), dtype),
+             "v": jnp.zeros((batch, max_len, hk, hd), dtype)}
+            for _ in params["blocks"]]
+
+
+def _cached_block(h, blk, cache, pos_off, heads, rope, compute_dtype):
+    """One block over ``T_cur`` new positions starting at ``pos_off`` (a
+    traced scalar), reading/writing the static-shape cache. The causal
+    mask ``k_pos <= q_pos`` covers both phases: among-prompt causality in
+    prefill and everything-before-me in decode. Returns (h', cache')."""
+    B, T_cur, D = h.shape
+    x = _ln(h, blk["ln1"]).astype(compute_dtype)
+    if "wkv" in blk:
+        q = x @ blk["wq"].astype(compute_dtype)
+        kv = jnp.einsum("btd,dce->btce", x,
+                        blk["wkv"].astype(compute_dtype))
+        k_new, v_new = kv[:, :, 0], kv[:, :, 1]
+    else:
+        qkv = jnp.einsum("btd,dce->btce", x,
+                         blk["qkv"].astype(compute_dtype))
+        q, k_new, v_new = (qkv[:, :, i] for i in range(3))
+    hd = D // heads
+    hk = k_new.shape[-1] // hd
+    g = heads // hk
+    q = q.reshape(B, T_cur, heads, hd)
+    k_new = k_new.reshape(B, T_cur, hk, hd)
+    v_new = v_new.reshape(B, T_cur, hk, hd)
+    pos = pos_off + jnp.arange(T_cur)
+    if rope:
+        q = rope_rotate(q, pos)
+        k_new = rope_rotate(k_new, pos)   # rotated rows enter the cache
+
+    ck = jax.lax.dynamic_update_slice(
+        cache["k"], k_new.astype(cache["k"].dtype), (0, pos_off, 0, 0))
+    cv = jax.lax.dynamic_update_slice(
+        cache["v"], v_new.astype(cache["v"].dtype), (0, pos_off, 0, 0))
+
+    # grouped attention over the WHOLE static cache, masked to the live
+    # prefix: q [B, T_cur, Hk, g, hd] x cache [B, max_T, Hk, hd]
+    max_T = ck.shape[1]
+    qg = q.reshape(B, T_cur, hk, g, hd)
+    s = jnp.einsum("bqhgd,bkhd->bqkhg", qg,
+                   ck.astype(compute_dtype),
+                   preferred_element_type=jnp.float32) * (hd ** -0.5)
+    k_pos = jnp.arange(max_T)
+    keep = k_pos[None, :] <= pos[:, None]            # [T_cur, max_T]
+    s = jnp.where(keep[None, :, :, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=2)
+    o = jnp.einsum("bqkhg,bkhd->bqhgd", p.astype(compute_dtype),
+                   cv.astype(compute_dtype))
+    a = o.reshape(B, T_cur, D)
+    # shared tail (projection + residual + MLP): decode-time block math
+    # is the training block's by construction
+    h, _ = _block_tail(h, blk, a, compute_dtype)
+    return h, {"k": ck, "v": cv}
+
+
+def forward_cached(params, tokens, caches, pos_off, *, heads: int = 4,
+                   compute_dtype=jnp.bfloat16):
+    """Logits for ``tokens`` [B, T_cur] placed at global positions
+    ``pos_off .. pos_off+T_cur-1``, attending to everything at or before
+    each position through the caches. Returns (logits [B, T_cur, vocab],
+    caches')."""
+    rope = "pos_emb" not in params
+    if not rope and caches[0]["k"].shape[1] > params["pos_emb"].shape[0]:
+        # static guard (cache capacity vs table rows): without it a too-
+        # long prefill would silently CLAMP both the pos_emb gather and
+        # the cache-write start — wrong logits, corrupted cache rows —
+        # the same hazard _forward's max_len check covers in training.
+        # (pos_off itself is traced and must be kept < cache capacity by
+        # the caller; generate's arithmetic guarantees it.)
+        raise ValueError(
+            f"cache capacity {caches[0]['k'].shape[1]} exceeds the "
+            f"learned positional table ({params['pos_emb'].shape[0]} "
+            "rows); use a rope model for unbounded decode")
+    pos = pos_off + jnp.arange(tokens.shape[1])
+    h = params["tok_emb"][tokens]
+    if not rope:
+        h = h + params["pos_emb"][pos]
+    new_caches = []
+    for blk, cache in zip(params["blocks"], caches):
+        h, cache = _cached_block(h, blk, cache, pos_off, heads, rope,
+                                 compute_dtype)
+        new_caches.append(cache)
+    h = _ln(h, params["ln_f"])
+    logits = (h.astype(compute_dtype)
+              @ params["tok_emb"].T.astype(compute_dtype))
+    return logits.astype(jnp.float32), new_caches
+
+
+def generate(params, prompt, steps: int, *, heads: int = 4,
+             temperature: float = 0.0, key=None,
+             compute_dtype=jnp.bfloat16, cache_dtype=jnp.bfloat16):
+    """Autoregressive generation: prefill the prompt [B, T_p] in ONE
+    forward, then ``steps`` single-token decode steps under ``lax.scan``.
+    ``temperature=0`` is greedy (equals argmax over the training-time
+    ``apply`` on the growing sequence); otherwise softmax sampling at
+    ``temperature`` with per-step keys folded from ``key``.
+
+    Returns ``[B, steps]`` generated tokens. Jit-friendly: wrap in
+    ``jax.jit(..., static_argnames=("steps", "heads", "temperature"))``
+    or close over the statics.
+    """
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    B, T_p = prompt.shape
+    max_T = T_p + steps
+    caches = init_cache(params, B, max_T, heads=heads, dtype=cache_dtype)
+
+    logits, caches = forward_cached(params, prompt, caches, 0,
+                                    heads=heads,
+                                    compute_dtype=compute_dtype)
+    last = logits[:, -1]
+
+    def pick(lg, i):
+        if temperature <= 0.0:
+            return jnp.argmax(lg, axis=-1).astype(prompt.dtype)
+        kk = jax.random.fold_in(key, i)
+        return jax.random.categorical(
+            kk, lg / temperature, axis=-1).astype(prompt.dtype)
+
+    def step(carry, i):
+        lg, caches = carry
+        tok = pick(lg, i)
+        lg2, caches = forward_cached(params, tok[:, None], caches,
+                                     T_p + i, heads=heads,
+                                     compute_dtype=compute_dtype)
+        return (lg2[:, -1], caches), tok
+
+    (_, _), toks = jax.lax.scan(step, (last, caches), jnp.arange(steps))
+    return toks.T                                    # [B, steps]

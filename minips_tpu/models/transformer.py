@@ -147,6 +147,17 @@ def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None,
         k = k.reshape(B, T, local_heads, hd)
         v = v.reshape(B, T, local_heads, hd)
     a = attn_fn(q, k, v).reshape(B, T, -1)
+    return _block_tail(h, blk, a, compute_dtype, psum_axis, ffn_fn)
+
+
+def _block_tail(h, blk, a, compute_dtype, psum_axis=None, ffn_fn=None):
+    """Everything after attention — output projection + residual, then
+    MLP (or ``ffn_fn``) + residual. ONE implementation shared by the
+    training block above and the KV-cached decode block
+    (models/decode.py), so the block math cannot drift between them."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    B, T, _ = h.shape
     # named for selective remat: remat="attn" saves exactly this tensor,
     # so the backward never re-runs the attention itself (the priciest
     # recompute per byte: flash kernels + T^2 math) while everything else

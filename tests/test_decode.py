@@ -1,0 +1,86 @@
+"""KV-cached decoding vs the incremental training-forward oracle.
+
+The pinned property: greedy ``generate`` must pick exactly the tokens an
+oracle picks by re-running the full training-time ``transformer.apply``
+on the growing sequence and taking argmax of the last position — for
+every layout combination (fused MHA / GQA / MQA x learned / rope). That
+equivalence proves the cache write/mask logic, the grouped attention,
+and the position handling all match training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from minips_tpu.models import decode, transformer as tfm
+
+F32 = dict(compute_dtype=jnp.float32)
+
+
+def _greedy_oracle(params, prompt, steps, heads):
+    seq = prompt
+    out = []
+    for _ in range(steps):
+        logits = tfm.apply(params, seq, heads=heads, **F32)
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+        out.append(tok)
+        seq = jnp.concatenate([seq, tok[:, None]], axis=1)
+    return jnp.stack(out, axis=1)                    # [B, steps]
+
+
+@pytest.mark.parametrize("kv_heads,rope", [(None, False), (2, True),
+                                           (1, False), (None, True)])
+def test_greedy_matches_incremental_oracle(kv_heads, rope):
+    p = tfm.init(jax.random.PRNGKey(0), vocab=61, dim=32, heads=4,
+                 depth=2, max_len=32, kv_heads=kv_heads, rope=rope)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 61, size=(2, 5)), jnp.int32)
+    want = _greedy_oracle(p, prompt, 6, heads=4)
+    got = decode.generate(p, prompt, 6, heads=4,
+                          compute_dtype=jnp.float32,
+                          cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_gqa_cache_is_group_factor_smaller():
+    p_full = tfm.init(jax.random.PRNGKey(0), vocab=31, dim=32, heads=4,
+                      depth=1, max_len=16)
+    p_mqa = tfm.init(jax.random.PRNGKey(0), vocab=31, dim=32, heads=4,
+                     depth=1, max_len=16, kv_heads=1)
+    c_full = decode.init_cache(p_full, 2, 16, heads=4)
+    c_mqa = decode.init_cache(p_mqa, 2, 16, heads=4)
+    assert c_full[0]["k"].shape == (2, 16, 4, 8)
+    assert c_mqa[0]["k"].shape == (2, 16, 1, 8)      # 4x smaller
+
+
+def test_learned_positions_cap_decode_length():
+    p = tfm.init(jax.random.PRNGKey(0), vocab=31, dim=32, heads=4,
+                 depth=1, max_len=8)
+    with pytest.raises(ValueError, match="max_len"):
+        decode.init_cache(p, 1, 9, heads=4)
+    # rope: same length is fine (no table)
+    pr = tfm.init(jax.random.PRNGKey(0), vocab=31, dim=32, heads=4,
+                  depth=1, rope=True)
+    decode.init_cache(pr, 1, 9, heads=4)
+
+
+def test_sampling_is_keyed_and_in_range():
+    p = tfm.init(jax.random.PRNGKey(0), vocab=31, dim=32, heads=4,
+                 depth=1, rope=True)
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    a = decode.generate(p, prompt, 5, heads=4, temperature=1.0,
+                        key=jax.random.PRNGKey(7))
+    b = decode.generate(p, prompt, 5, heads=4, temperature=1.0,
+                        key=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (2, 5)
+    assert int(jnp.min(a)) >= 0 and int(jnp.max(a)) < 31
+    with pytest.raises(ValueError, match="PRNG key"):
+        decode.generate(p, prompt, 2, heads=4, temperature=0.5)
+
+
+def test_moe_blocks_refused():
+    p = tfm.init_moe_lm(jax.random.PRNGKey(0), vocab=31, dim=32, heads=4,
+                        depth=1, num_experts=2)
+    with pytest.raises(ValueError, match="MoE"):
+        decode.init_cache(p, 1, 8, heads=4)
