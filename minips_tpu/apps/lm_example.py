@@ -124,6 +124,19 @@ def _flags(parser):
                         help="rotary position embeddings instead of the "
                              "learned table: no pos_emb params, no "
                              "max_len sequence cap (--max_len ignored)")
+    parser.add_argument("--clip_norm", type=float, default=0.0,
+                        help="global-norm gradient clipping (0 = off); "
+                             "any --updater")
+    parser.add_argument("--weight_decay", type=float, default=None,
+                        help="with --updater adamw (default 0.01 there): "
+                             "decoupled weight decay on matrices only "
+                             "(LN gains/biases never decay — "
+                             "transformer.decay_mask); refused with "
+                             "other updaters")
+    parser.add_argument("--warmup_steps", type=int, default=0,
+                        help="> 0: linear warmup then cosine decay to "
+                             "10%% of --lr over --num_iters (an optax "
+                             "schedule fed straight into the updater)")
     parser.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16"],
                         help="dp/sp: worker-math precision (bfloat16 = "
@@ -167,6 +180,38 @@ def _model_cfg(args, seq_len: int) -> dict:
     return m
 
 
+def _lr_schedule(cfg, args):
+    """--warmup_steps > 0: linear warmup -> cosine decay to 10% of peak
+    over the run; else the constant --lr. Returns what DenseTable's lr
+    accepts (float or optax schedule)."""
+    warmup = getattr(args, "warmup_steps", 0)
+    if not warmup:
+        return cfg.table.lr
+    import optax
+
+    total = max(cfg.train.num_iters, warmup + 1)
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=cfg.table.lr, warmup_steps=warmup,
+        decay_steps=total, end_value=0.1 * cfg.table.lr)
+
+
+def _updater_kwargs(cfg, args, params):
+    kw = {}
+    clip = getattr(args, "clip_norm", 0.0)
+    if clip:
+        kw["clip_norm"] = clip
+    wd = getattr(args, "weight_decay", None)
+    if cfg.table.updater == "adamw":
+        kw["weight_decay"] = 0.01 if wd is None else wd
+        kw["decay_mask"] = tfm.decay_mask(params)
+    elif wd is not None:
+        # only adamw applies decoupled decay — dropping the flag quietly
+        # would be the silent-downgrade bug again
+        raise SystemExit("--weight_decay needs --updater adamw "
+                         f"(got {cfg.table.updater})")
+    return kw
+
+
 def run(cfg: Config, args, metrics) -> dict:
     seq_len = getattr(args, "seq_len", 128)
     layout = getattr(args, "layout", "dp")
@@ -175,10 +220,16 @@ def run(cfg: Config, args, metrics) -> dict:
     # than requested on tp/pp/ep.
     if layout not in ("dp", "sp"):
         for flag, default in (("attn", "reference"), ("accum", 1),
-                              ("dtype", "float32"), ("comm", "float32")):
+                              ("dtype", "float32"), ("comm", "float32"),
+                              ("clip_norm", 0.0), ("warmup_steps", 0)):
             if getattr(args, flag, default) != default:
                 raise SystemExit(f"--{flag} is only wired into --layout "
                                  f"dp/sp (got {layout})")
+        if cfg.table.updater == "adamw":
+            # the tp/pp/ep tail hardcodes plain adam; silently dropping
+            # the decay would be the r2 silent-downgrade bug again
+            raise SystemExit("--updater adamw is only wired into "
+                             f"--layout dp/sp (got {layout})")
     if layout != "dp" and getattr(args, "remat", False):
         # loss_sp's ring forward has its own memory story (T/N activations
         # per shard); silently ignoring the flag would misreport memory
@@ -200,7 +251,8 @@ def run(cfg: Config, args, metrics) -> dict:
     data = _load_data(cfg, args, seq_len)
     params = tfm.init(jax.random.PRNGKey(cfg.train.seed), **model)
     table = DenseTable(params, mesh, updater=cfg.table.updater,
-                       lr=cfg.table.lr, name=cfg.table.name)
+                       lr=_lr_schedule(cfg, args), name=cfg.table.name,
+                       updater_kwargs=_updater_kwargs(cfg, args, params))
     heads = model["heads"]
 
     ckpt, start_step = _maybe_checkpointer(cfg, args, table)

@@ -85,7 +85,9 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
                         choices=["bsp", "ssp", "asp"])
     parser.add_argument("--staleness", type=int, default=None)
     parser.add_argument("--updater", type=str, default=None,
-                        choices=["sgd", "adagrad", "adam"])
+                        choices=["sgd", "adagrad", "adam", "adamw"])
+    # adamw is dense-table-only (lm_example dp/sp); the sparse/sharded
+    # tables refuse it loudly at construction
     parser.add_argument("--lr", type=float, default=None)
     parser.add_argument("--num_slots", type=int, default=None,
                         help="sparse table capacity (power of two)")

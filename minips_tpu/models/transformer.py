@@ -274,6 +274,17 @@ def _remat_policy(remat):
                      "or 'hybrid_qkv')")
 
 
+def decay_mask(params):
+    """Params-shaped 0/1 pytree for AdamW's decoupled weight decay: decay
+    matrices (ndim >= 2 — projections, embeddings), never LayerNorm
+    gains/biases (the standard rule). Feed to
+    ``DenseTable(updater="adamw", updater_kwargs={"decay_mask": ...})``,
+    which ravels it alongside the params."""
+    return jax.tree.map(
+        lambda x: jnp.full(x.shape, float(jnp.ndim(x) >= 2), x.dtype),
+        params)
+
+
 def rope_rotate(x, pos, theta: float = 10000.0):
     """Rotary position embedding: rotate half-split head-dim pairs of
     ``x`` [B, T, H, hd] by angles ``pos · theta^(-2i/hd)`` (``pos`` [T],

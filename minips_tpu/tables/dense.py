@@ -70,6 +70,7 @@ class DenseTable:
         lr: LearningRate = 0.1,
         grad_reduce: str = "mean",
         tx: Optional[optax.GradientTransformation] = None,
+        updater_kwargs: Optional[dict] = None,
     ):
         if grad_reduce not in ("mean", "sum"):
             raise ValueError("grad_reduce must be 'mean' or 'sum'")
@@ -77,13 +78,30 @@ class DenseTable:
         self.mesh = mesh
         self.grad_reduce = grad_reduce
         self.num_shards = mesh.shape[DATA_AXIS]
-        self.tx = tx if tx is not None else make_updater(updater, lr)
 
         flat, self._unravel = ravel_pytree(template)
         self.num_keys = int(flat.shape[0])
         self.partitioner = RangePartitioner(self.num_keys, self.num_shards)
         self.padded = self.partitioner.padded
         self._shard_shape = (self.padded // self.num_shards,)
+
+        kw = dict(updater_kwargs or {})
+        # clip-by-global-norm must see the GLOBAL gradient, but the optax
+        # transform runs on one owner shard inside shard_map — intercept
+        # and apply it in the fused step with a cross-shard psum instead
+        self._clip_norm = float(kw.pop("clip_norm", 0.0) or 0.0)
+        if kw.get("decay_mask") is not None:
+            # a params-shaped pytree mask (e.g. transformer.decay_mask)
+            # travels the same ravel as the params; padding rows never
+            # decay (they are zeros and must stay zeros)
+            mflat, _ = ravel_pytree(kw["decay_mask"])
+            if mflat.shape != flat.shape:
+                raise ValueError(
+                    f"decay_mask ravels to {mflat.shape}, params to "
+                    f"{flat.shape} — the mask must be params-shaped")
+            kw["decay_mask"] = (jnp.zeros(self.padded, flat.dtype)
+                                .at[: self.num_keys].set(mflat))
+        self.tx = tx if tx is not None else make_updater(updater, lr, **kw)
 
         self._pspec = P(DATA_AXIS)
         self._sharding = NamedSharding(mesh, self._pspec)
@@ -157,7 +175,18 @@ class DenseTable:
         in_specs = (self._pspec, self._opt_specs, self._pspec) + (
             (self._pspec,) if masked else ())
 
+        clip_norm = self._clip_norm
+
         def apply_shard(p_shard, opt_shard, g_shard, *mask):
+            if clip_norm:
+                # same cross-shard global-norm clip as the fused step —
+                # a clip_norm kwarg must never be a silent no-op on the
+                # push()/push_keys() paths
+                sumsq = jax.lax.psum(jnp.sum(g_shard * g_shard),
+                                     DATA_AXIS)
+                g_shard = g_shard * jnp.minimum(
+                    1.0, clip_norm * jax.lax.rsqrt(
+                        jnp.maximum(sumsq, 1e-16)))
             updates, new_opt = self.tx.update(g_shard, opt_shard, p_shard)
             if masked:
                 m = mask[0]
@@ -224,6 +253,7 @@ class DenseTable:
         """
         n, padded = self.num_keys, self.padded
         num_workers = self.num_shards
+        clip_norm = self._clip_norm
         unravel, tx, reduce = self._unravel, self.tx, self.grad_reduce
         bspec = batch_spec if batch_spec is not None else P(DATA_AXIS)
         if accum < 1:
@@ -287,6 +317,14 @@ class DenseTable:
             g_shard = quantized_psum_scatter(gpad, DATA_AXIS, comm)    # push
             if reduce == "mean":
                 g_shard = g_shard / num_workers
+            if clip_norm:
+                # global-norm clip across ALL shards (the optax transform
+                # would only see this shard's slice)
+                sumsq = jax.lax.psum(jnp.sum(g_shard * g_shard),
+                                     DATA_AXIS)
+                g_shard = g_shard * jnp.minimum(
+                    1.0, clip_norm * jax.lax.rsqrt(
+                        jnp.maximum(sumsq, 1e-16)))
             updates, opt_shard = tx.update(g_shard, opt_shard, p_shard)
             p_shard = optax.apply_updates(p_shard, updates)
             return p_shard, opt_shard, jax.lax.pmean(loss, DATA_AXIS)

@@ -10,11 +10,11 @@ it costs nothing under optax and apps want it.
 
 from __future__ import annotations
 
-from typing import Callable, Union
+from typing import Any, Callable, NamedTuple, Union
 
 import optax
 
-UPDATERS = ("sgd", "adagrad", "adam")
+UPDATERS = ("sgd", "adagrad", "adam", "adamw")
 
 # a float or an optax schedule (step -> lr); optax consumes either
 # directly, so warmup/cosine/decay schedules work on every updater:
@@ -22,15 +22,69 @@ UPDATERS = ("sgd", "adagrad", "adam")
 LearningRate = Union[float, Callable[[int], float]]
 
 
+class MaskedDecayState(NamedTuple):
+    # the mask rides IN the optimizer state (not a closure) so that
+    # DenseTable's state sharding machinery shards it alongside the
+    # params — inside the fused step's shard_map, updates/params/mask all
+    # arrive as aligned per-shard slices
+    mask: Any
+
+
+def masked_weight_decay(weight_decay: float,
+                        mask) -> optax.GradientTransformation:
+    """Decoupled weight decay applied only where ``mask`` is 1 — the
+    standard "decay matrices, not LN/bias" rule, but elementwise so it
+    survives DenseTable's ravel into one flat vector (optax.masked is
+    leaf-level and cannot express a per-element mask)."""
+    import jax
+
+    def init(params):
+        del params
+        return MaskedDecayState(mask=mask)
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("masked_weight_decay needs params")
+        updates = jax.tree.map(
+            lambda g, p, m: g + weight_decay * p * m, updates, params,
+            state.mask)
+        return updates, state
+
+    return optax.GradientTransformation(init, update)
+
+
 def make_updater(name: str, lr: LearningRate,
                  **kwargs) -> optax.GradientTransformation:
+    """``clip_norm`` (any updater) prepends global-norm gradient
+    clipping — over whatever params THIS transform sees: DenseTable
+    intercepts the kwarg and instead clips by the cross-shard global
+    norm inside its fused step (a psum), because the transform only ever
+    sees one owner shard there. ``adamw`` takes ``weight_decay``
+    (default 0.01) and an optional elementwise ``decay_mask``
+    (DenseTable ravels+pads a params-shaped pytree mask for you)."""
     name = name.lower()
+    clip = kwargs.get("clip_norm")
+    chain = [optax.clip_by_global_norm(clip)] if clip else []
     if name == "sgd":
-        return optax.sgd(lr, momentum=kwargs.get("momentum", 0.0) or None)
-    if name == "adagrad":
+        tx = optax.sgd(lr, momentum=kwargs.get("momentum", 0.0) or None)
+    elif name == "adagrad":
         # Reference Adagrad accumulates squared grads per key; optax matches.
-        return optax.adagrad(lr, initial_accumulator_value=kwargs.get(
+        tx = optax.adagrad(lr, initial_accumulator_value=kwargs.get(
             "initial_accumulator_value", 0.1))
-    if name == "adam":
-        return optax.adam(lr, b1=kwargs.get("b1", 0.9), b2=kwargs.get("b2", 0.999))
-    raise ValueError(f"unknown updater {name!r}; expected one of {UPDATERS}")
+    elif name == "adam":
+        tx = optax.adam(lr, b1=kwargs.get("b1", 0.9),
+                        b2=kwargs.get("b2", 0.999))
+    elif name == "adamw":
+        wd = kwargs.get("weight_decay", 0.01)
+        mask = kwargs.get("decay_mask")
+        decay = (optax.add_decayed_weights(wd) if mask is None
+                 else masked_weight_decay(wd, mask))
+        tx = optax.chain(
+            optax.scale_by_adam(b1=kwargs.get("b1", 0.9),
+                                b2=kwargs.get("b2", 0.999)),
+            decay,
+            optax.scale_by_learning_rate(lr))   # handles schedules too
+    else:
+        raise ValueError(
+            f"unknown updater {name!r}; expected one of {UPDATERS}")
+    return optax.chain(*chain, tx) if chain else tx

@@ -287,3 +287,77 @@ def test_make_step_bfloat16_composes_with_accum_and_comm(mesh8):
     ls = [float(t.step_inplace(step, batch)) for _ in range(20)]
     assert np.isfinite(ls).all()
     assert ls[-1] < ls[0]
+
+
+def test_clip_norm_bounds_update():
+    """clip_norm: a huge constant gradient is clipped to the given global
+    norm before SGD applies it — the update magnitude equals lr * clip /
+    ||g|| * g elementwise."""
+    from minips_tpu.models import lr as lr_model
+
+    mesh = make_mesh(8)
+    t = DenseTable(lr_model.init(4), mesh, name="clip", updater="sgd",
+                   lr=1.0, updater_kwargs={"clip_norm": 1.0})
+    grad_fn = lambda p, b: (jnp.zeros(()),  # noqa: E731
+                            jax.tree.map(
+                                lambda x: 100.0 * jnp.ones_like(x), p))
+    step = t.make_step(grad_fn)
+    n = t.num_keys
+    before = np.asarray(t.params)[:n]
+    t.step_inplace(step, {"x": jnp.zeros((8, 4))})
+    delta = before - np.asarray(t.params)[:n]
+    # clipped GLOBAL norm (cross-shard psum, not per-owner-shard) = 1.0
+    # -> each of n entries moves by 1/sqrt(n)
+    np.testing.assert_allclose(delta, 1.0 / np.sqrt(n), rtol=1e-5)
+
+
+def test_adamw_masked_decay_only_decays_masked_rows():
+    """adamw + decay_mask: with ZERO gradients, masked entries shrink by
+    wd * lr per step while unmasked entries (the 'LN/bias' rows) stay
+    exactly put — the decoupled decay never leaks across the mask."""
+    mesh = make_mesh(8)
+    template = {"w": jnp.ones((4, 4)), "b": jnp.ones(4)}
+    mask = {"w": jnp.ones((4, 4)), "b": jnp.zeros(4)}
+    t = DenseTable(template, mesh, name="adamw", updater="adamw", lr=0.5,
+                   updater_kwargs={"weight_decay": 0.1,
+                                   "decay_mask": mask})
+    grad_fn = lambda p, b: (jnp.zeros(()),  # noqa: E731
+                            jax.tree.map(jnp.zeros_like, p))
+    step = t.make_step(grad_fn)
+    t.step_inplace(step, {"x": jnp.zeros((8, 2))})
+    out = t.pull()
+    # w: 1 - lr * wd * 1 = 0.95;  b: untouched
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.95, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["b"]), 1.0, rtol=1e-6)
+
+
+def test_adamw_decay_mask_shape_mismatch_raises():
+    mesh = make_mesh(8)
+    template = {"w": jnp.ones((4, 4))}
+    with pytest.raises(ValueError, match="params-shaped"):
+        DenseTable(template, mesh, name="bad", updater="adamw",
+                   updater_kwargs={"decay_mask": {"w": jnp.ones(3)}})
+
+
+def test_transformer_decay_mask_rule():
+    """decay_mask: 1 on matrices (ndim >= 2), 0 on LN gains/biases."""
+    from minips_tpu.models import transformer as tfm
+
+    p = tfm.init(jax.random.PRNGKey(0), vocab=16, dim=32, heads=4,
+                 depth=1)
+    m = tfm.decay_mask(p)
+    assert float(m["blocks"][0]["qkv"][0, 0, 0]) == 1.0
+    assert float(m["tok_emb"][0, 0]) == 1.0
+    assert float(m["ln_f"]["g"][0]) == 0.0
+    assert float(m["blocks"][0]["ln1"]["b"][0]) == 0.0
+
+
+def test_clip_norm_applies_on_push_path_too():
+    """clip_norm must never be a silent no-op: the raw push() path clips
+    by the same cross-shard global norm as the fused step."""
+    mesh = make_mesh(8)
+    t = DenseTable({"w": jnp.zeros(8)}, mesh, name="clip2", updater="sgd",
+                   lr=1.0, updater_kwargs={"clip_norm": 1.0})
+    t.push({"w": 100.0 * jnp.ones(8)})
+    delta = -np.asarray(t.pull()["w"])
+    np.testing.assert_allclose(delta, 1.0 / np.sqrt(8), rtol=1e-5)
