@@ -137,6 +137,11 @@ def _flags(parser):
                         help="> 0: linear warmup then cosine decay to "
                              "10%% of --lr over --num_iters (an optax "
                              "schedule fed straight into the updater)")
+    parser.add_argument("--dropout", type=float, default=0.0,
+                        help="GPT-style embedding + residual dropout "
+                             "(train-time; per-step keys ride the batch "
+                             "into the pure fused step). --layout dp "
+                             "only; incompatible with --accum")
     parser.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16"],
                         help="dp/sp: worker-math precision (bfloat16 = "
@@ -262,6 +267,14 @@ def run(cfg: Config, args, metrics) -> dict:
     compute_dtype = (jnp.bfloat16
                      if getattr(args, "dtype", "float32") == "bfloat16"
                      else None)
+    dropout = getattr(args, "dropout", 0.0)
+    if dropout and layout != "dp":
+        raise SystemExit(f"--dropout is only wired into --layout dp "
+                         f"(got {layout})")
+    if dropout and accum > 1:
+        # the accum fold reshapes every batch leaf into microbatches,
+        # which a [2]-shaped key cannot survive
+        raise SystemExit("--dropout is incompatible with --accum > 1")
     if layout == "dp":
         remat = getattr(args, "remat", False)
         if remat and getattr(args, "remat_mode", "full") != "full":
@@ -270,14 +283,30 @@ def run(cfg: Config, args, metrics) -> dict:
             functools.partial(tfm.grad_fn, heads=heads,
                               attn_impl=getattr(args, "attn", "reference"),
                               remat=remat,
-                              head_chunk=getattr(args, "head_chunk", 0)),
-            batch_spec=P(DATA_AXIS), accum=accum,
-            compute_dtype=compute_dtype, comm=comm)
+                              head_chunk=getattr(args, "head_chunk", 0),
+                              dropout=dropout),
+            # per-WORKER keys shard with the data axis (distinct masks
+            # per shard — a replicated key would correlate regularization
+            # noise across workers); tokens shard over workers
+            batch_spec=({"tokens": P(DATA_AXIS), "rng": P(DATA_AXIS)}
+                        if dropout else P(DATA_AXIS)),
+            accum=accum, compute_dtype=compute_dtype, comm=comm)
         batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        drop_key = jax.random.PRNGKey(cfg.train.seed + 71)
+        n_prepped = [start_step]
 
         def prep(batch):
-            return jax.device_put({"tokens": jnp.asarray(batch["tokens"])},
-                                  batch_sharding)
+            out = {"tokens": jax.device_put(
+                jnp.asarray(batch["tokens"]), batch_sharding)}
+            if dropout:
+                # fresh key per (resume-offset) step, then one key per
+                # worker; loss() takes each shard's [1, 2] slice
+                step_key = jax.random.fold_in(drop_key, n_prepped[0])
+                n_prepped[0] += 1
+                out["rng"] = jax.device_put(
+                    jax.vmap(lambda i: jax.random.fold_in(step_key, i))(
+                        jnp.arange(n_shards)), batch_sharding)
+            return out
     else:
         # batch replicated, sequence sharded: inside shard_map each
         # device sees its token slice; ring attention stitches them.

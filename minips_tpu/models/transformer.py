@@ -99,8 +99,17 @@ def _ln(x, p):
     return (x - mu) * jax.lax.rsqrt(var + 1e-5) * p["g"] + p["b"]
 
 
+def _dropout(x, rate, key):
+    """Inverted dropout; identity when rate is 0 or no key is given
+    (eval). ``rate`` is static, ``key`` traced."""
+    if not rate or key is None:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
 def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None,
-           ffn_fn=None):
+           ffn_fn=None, dropout=0.0, rng=None):
     """One pre-LN block. With ``psum_axis`` the block runs Megatron-style
     tensor parallel under shard_map: qkv/mlp_in arrive sharded on their
     OUTPUT feature dim (this device computes heads/k heads and hidden/k
@@ -147,10 +156,12 @@ def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None,
         k = k.reshape(B, T, local_heads, hd)
         v = v.reshape(B, T, local_heads, hd)
     a = attn_fn(q, k, v).reshape(B, T, -1)
-    return _block_tail(h, blk, a, compute_dtype, psum_axis, ffn_fn)
+    return _block_tail(h, blk, a, compute_dtype, psum_axis, ffn_fn,
+                       dropout, rng)
 
 
-def _block_tail(h, blk, a, compute_dtype, psum_axis=None, ffn_fn=None):
+def _block_tail(h, blk, a, compute_dtype, psum_axis=None, ffn_fn=None,
+                dropout=0.0, rng=None):
     """Everything after attention — output projection + residual, then
     MLP (or ``ffn_fn``) + residual. ONE implementation shared by the
     training block above and the KV-cached decode block
@@ -167,6 +178,8 @@ def _block_tail(h, blk, a, compute_dtype, psum_axis=None, ffn_fn=None):
            @ blk["proj"].astype(compute_dtype)).astype(jnp.float32)
     if psum_axis is not None:
         att = jax.lax.psum(att, psum_axis)
+    if dropout and rng is not None:   # GPT-style residual dropout
+        att = _dropout(att, dropout, jax.random.fold_in(rng, 0))
     h = h + att
     if ffn_fn is not None:
         D = h.shape[-1]
@@ -185,12 +198,14 @@ def _block_tail(h, blk, a, compute_dtype, psum_axis=None, ffn_fn=None):
     m = (x @ blk["mlp_out"].astype(compute_dtype)).astype(jnp.float32)
     if psum_axis is not None:
         m = jax.lax.psum(m, psum_axis)
+    if dropout and rng is not None:
+        m = _dropout(m, dropout, jax.random.fold_in(rng, 1))
     return h + m, 0.0
 
 
 def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
              psum_axis=None, apply_blocks=None, ffn_fn=None, remat=False,
-             head=True):
+             head=True, dropout=0.0, rng=None):
     """Returns (logits, total aux loss) — aux is nonzero only for MoE
     ``ffn_fn`` blocks; the plain ``apply*`` wrappers drop it. ``remat``
     wraps each block in ``jax.checkpoint`` so the backward pass recomputes
@@ -211,7 +226,11 @@ def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
         h = params["tok_emb"][tokens]
         if attn_fn is not None:
             attn_fn = _rope_wrap(attn_fn, pos)
+    if not 0.0 <= dropout < 1.0:
+        raise ValueError(f"dropout rate {dropout} outside [0, 1)")
     aux_total = 0.0
+    if dropout and rng is not None:   # embedding dropout (GPT-style)
+        h = _dropout(h, dropout, jax.random.fold_in(rng, 2 ** 20))
     if apply_blocks is not None:
         # parallel schedules (e.g. the GPipe pipeline) replace the
         # sequential layer loop but share embedding/head/LN code
@@ -219,12 +238,16 @@ def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
     else:
         block_fn = _block
         if remat:
+            # dropout (7) is static config like its neighbours; the rng
+            # key (8) is a traced array and replays exactly in recompute
             block_fn = jax.checkpoint(
-                _block, static_argnums=(2, 3, 4, 5, 6),
+                _block, static_argnums=(2, 3, 4, 5, 6, 7),
                 policy=_remat_policy(remat))
-        for blk in params["blocks"]:
+        for i, blk in enumerate(params["blocks"]):
+            blk_rng = (jax.random.fold_in(rng, i)
+                       if dropout and rng is not None else None)
             h, aux = block_fn(h, blk, heads, attn_fn, compute_dtype,
-                              psum_axis, ffn_fn)
+                              psum_axis, ffn_fn, dropout, blk_rng)
             aux_total = aux_total + aux
     h = _ln(h, params["ln_f"])
     if not head:  # chunked-CE path applies the tied head itself
@@ -324,15 +347,18 @@ def _attn_fn(attn_impl: str):
 
 
 def apply(params, tokens, *, heads=4, compute_dtype=jnp.bfloat16,
-          remat=False, attn_impl="reference"):
+          remat=False, attn_impl="reference", dropout=0.0, rng=None):
     """Logits [B, T, vocab]; plain causal attention in one program.
     ``heads`` is static model structure, not table state — pass the value
     used at ``init``. ``remat=True`` recomputes block activations in the
     backward pass (jax.checkpoint) to cut peak HBM on long sequences.
-    ``attn_impl="flash"`` swaps in the fused O(T)-memory attention."""
+    ``attn_impl="flash"`` swaps in the fused O(T)-memory attention.
+    ``dropout`` (with an ``rng`` key) enables GPT-style embedding +
+    residual dropout — train-time only; omit both at eval."""
     T = tokens.shape[1]
     return _forward(params, tokens, jnp.arange(T), heads,
-                    _attn_fn(attn_impl), compute_dtype, remat=remat)[0]
+                    _attn_fn(attn_impl), compute_dtype, remat=remat,
+                    dropout=dropout, rng=rng)[0]
 
 
 def apply_sp(params, tokens_local, shift, *, heads=4, axis_name=DATA_AXIS,
@@ -631,33 +657,45 @@ def nll_chunked(h, tok_emb, targets, chunk, compute_dtype=jnp.bfloat16):
 
 
 def loss(params, batch, *, heads=4, compute_dtype=jnp.bfloat16,
-         attn_impl="reference", remat=False, head_chunk=0):
+         attn_impl="reference", remat=False, head_chunk=0, dropout=0.0):
     """Next-token cross-entropy; batch = {"tokens": [B, T+1] int32}.
     ``remat=True`` recomputes block activations in the backward pass —
     activation memory stops scaling with depth, the standard trade for
     fitting larger models (SURVEY brief: jax.checkpoint to trade FLOPs
     for HBM). ``head_chunk > 0`` computes the tied head + CE in sequence
     chunks of that size (:func:`nll_chunked`) so the [B, T, vocab] logits
-    never materialize."""
+    never materialize. ``dropout > 0`` reads the step's PRNG key from
+    ``batch["rng"]`` (the fused step is pure, so randomness must ride the
+    batch) and raises if it is absent."""
     toks = batch["tokens"]
+    rng = batch.get("rng")
+    if dropout and rng is None:
+        raise ValueError('dropout > 0 needs a per-step key in '
+                         'batch["rng"] (the fused step is pure)')
+    if rng is not None and rng.ndim == 2:
+        # per-WORKER keys sharded over the data axis (a [W, 2] stack fed
+        # with batch_spec P(DATA_AXIS)): each shard sees its [1, 2] slice
+        # — distinct dropout masks per worker, not one replicated pattern
+        rng = rng[0]
     if head_chunk:
         T = toks.shape[1] - 1
         h, _ = _forward(params, toks[:, :-1], jnp.arange(T), heads,
                         _attn_fn(attn_impl), compute_dtype, remat=remat,
-                        head=False)
+                        head=False, dropout=dropout, rng=rng)
         return nll_chunked(h, params["tok_emb"], toks[:, 1:], head_chunk,
                            compute_dtype)
     logits = apply(params, toks[:, :-1], heads=heads,
                    compute_dtype=compute_dtype, attn_impl=attn_impl,
-                   remat=remat)
+                   remat=remat, dropout=dropout, rng=rng)
     return nll(logits, toks[:, 1:])
 
 
 def grad_fn(params, batch, *, heads=4, attn_impl="reference", remat=False,
-            head_chunk=0):
+            head_chunk=0, dropout=0.0):
     l, g = jax.value_and_grad(
         lambda p, b: loss(p, b, heads=heads, attn_impl=attn_impl,
-                          remat=remat, head_chunk=head_chunk))(params, batch)
+                          remat=remat, head_chunk=head_chunk,
+                          dropout=dropout))(params, batch)
     return l, g
 
 
