@@ -243,6 +243,11 @@ def run(cfg: Config, args, metrics) -> dict:
     if layout != "dp" and getattr(args, "head_chunk", 0):
         raise SystemExit(f"--head_chunk is only wired into --layout dp "
                          f"(got {layout})")
+    if layout != "dp" and getattr(args, "dropout", 0.0):
+        # must precede the tp/pp/ep early returns below, or those layouts
+        # would silently train without the requested regularization
+        raise SystemExit(f"--dropout is only wired into --layout dp "
+                         f"(got {layout})")
     if layout in ("tp", "pp"):
         return _run_model_parallel(cfg, args, metrics, layout, seq_len)
     if layout == "ep":
@@ -268,9 +273,6 @@ def run(cfg: Config, args, metrics) -> dict:
                      if getattr(args, "dtype", "float32") == "bfloat16"
                      else None)
     dropout = getattr(args, "dropout", 0.0)
-    if dropout and layout != "dp":
-        raise SystemExit(f"--dropout is only wired into --layout dp "
-                         f"(got {layout})")
     if dropout and accum > 1:
         # the accum fold reshapes every batch leaf into microbatches,
         # which a [2]-shaped key cannot survive

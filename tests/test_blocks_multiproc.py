@@ -85,7 +85,6 @@ def test_straggler_consumes_fewer_blocks(libsvm_file):
     assert max(sums) - min(sums) < 1e-4, sums
 
 
-@pytest.mark.slow
 def test_ssp_blocks_respect_staleness(libsvm_file):
     """SSP s=2 over dynamic blocks WITH a straggler and multi-batch blocks
     (4 batches per 100-line block): ranks retire at different clocks and
@@ -104,7 +103,6 @@ def test_ssp_blocks_respect_staleness(libsvm_file):
         assert d["max_skew_seen"] <= 3              # s + 1
 
 
-@pytest.mark.slow
 def test_killed_ranks_blocks_requeue_to_survivors(libsvm_file):
     """Fault drill: rank 2 dies abruptly mid-consumption (ASP so the gate
     never stalls); the heartbeat failure handler re-queues its outstanding
