@@ -321,6 +321,10 @@ def bench_lm(args, n_chips, peak):
     m_attn = 12.0 * B * T * T * D * depth * 0.5     # causal attn fwd+bwd
     flops_step = K * (m_mat + m_attn)
     out = _suite_result(K * tokens, dt, n_chips, flops_step, peak)
+    out["config"] = {"dim": D, "depth": depth, "batch": B, "seq": T,
+                     "remat": (args.lm_remat_mode if args.lm_remat
+                               else False),
+                     "head_chunk": args.lm_head_chunk}
     if args.lm_kv_heads:
         out["kv_heads"] = args.lm_kv_heads
     if args.lm_rope:
@@ -700,7 +704,7 @@ def _run_all(args) -> int:
                 "--lm-seq", str(args.lm_seq),
                 "--lm-dim", str(args.lm_dim),
                 "--lm-depth", str(args.lm_depth),
-                *(["--lm-remat"] if args.lm_remat else []),
+                ("--lm-remat" if args.lm_remat else "--no-lm-remat"),
                 *(["--lm-kv-heads", str(args.lm_kv_heads)]
                   if args.lm_kv_heads else []),
                 *(["--lm-rope"] if args.lm_rope else []),
@@ -772,10 +776,15 @@ def main() -> int:
                     help="steps folded into one dispatch (lax.scan)")
     ap.add_argument("--reps", type=int, default=5,
                     help="timed chained calls; median reported")
-    ap.add_argument("--lm-batch", type=int, default=64)
+    # lm defaults = the measured 2026-07-31 frontier winner (43.5% model
+    # MFU on the v5 lite: d=2048x8, B=16, remat=dots, chunked head 128 —
+    # BASELINE.md / sweep_lm.sh); the r2 base config is reproducible with
+    # --lm-dim 512 --lm-depth 4 --lm-batch 64 --no-lm-remat
+    # --lm-head-chunk 0. CPU validation runs clamp the shapes anyway.
+    ap.add_argument("--lm-batch", type=int, default=16)
     ap.add_argument("--lm-seq", type=int, default=1024)
-    ap.add_argument("--lm-dim", type=int, default=512)
-    ap.add_argument("--lm-depth", type=int, default=4)
+    ap.add_argument("--lm-dim", type=int, default=2048)
+    ap.add_argument("--lm-depth", type=int, default=8)
     ap.add_argument("--lm-kv-heads", type=int, default=None,
                     help="grouped-query attention KV heads (1 = MQA; "
                          "default = dim/64 q-heads, classic MHA) — "
@@ -783,17 +792,18 @@ def main() -> int:
     ap.add_argument("--lm-rope", action="store_true",
                     help="rotary position embeddings instead of the "
                          "learned table")
-    ap.add_argument("--lm-remat", action="store_true",
+    ap.add_argument("--lm-remat", action=argparse.BooleanOptionalAction,
+                    default=True,
                     help="recompute block activations in backward "
                          "(fits larger --lm-dim/--lm-depth in HBM)")
-    ap.add_argument("--lm-remat-mode", default="full",
+    ap.add_argument("--lm-remat-mode", default="dots",
                     choices=["full", "attn", "dots", "hybrid",
                              "hybrid_qkv"],
                     help="with --lm-remat: full = recompute whole blocks; "
                          "attn = save attention outputs (backward never "
                          "re-runs attention); dots = save matmul outputs "
                          "(recompute only elementwise)")
-    ap.add_argument("--lm-head-chunk", type=int, default=0,
+    ap.add_argument("--lm-head-chunk", type=int, default=128,
                     help="sequence-chunked tied head + CE: the [B,T,vocab]"
                          " logits never materialize (models/transformer.py"
                          " nll_chunked); 0 = plain head")
