@@ -830,6 +830,14 @@ def main() -> int:
         # heads = lm_dim/64 (64-dim heads, MXU-shaped); a non-multiple
         # would derive a head count that doesn't divide the model dim
         ap.error("--lm-dim must be a positive multiple of 64")
+    if args.lm_head_chunk and args.lm_seq % args.lm_head_chunk:
+        # the chunked head scans whole chunks; with a default chunk of
+        # 128 an odd --lm-seq must not crash the suite — drop to the
+        # plain head and say so
+        print(f"bench: --lm-seq {args.lm_seq} not divisible by "
+              f"--lm-head-chunk {args.lm_head_chunk}; using the plain "
+              "head (--lm-head-chunk 0)", file=sys.stderr)
+        args.lm_head_chunk = 0
 
     if args.profile and args.suite not in ("lrmlp", "lm", "wd", "mf",
                                            "w2v"):

@@ -3,9 +3,11 @@
 # prints "config -> tok/s TF/s MFU". Results land in BASELINE.md.
 #
 # Measured 2026-07-31 (TPU v5 lite): winner is d=2048x8 B=16 remat=dots
-# head-chunk=128 at 43.5% model MFU / 85.7 TF/s. The commented configs
-# below OOM on a 16 GB chip (adam state for ~436M params is 5.2 GB
-# before activations) — kept as the documented memory boundary.
+# head-chunk=128 at 43.5% model MFU / 85.7 TF/s — now bench.py's lm
+# DEFAULTS, so every line here pins its full config explicitly (the
+# annotations were measured with exactly these flags). The commented
+# configs at the bottom OOM on a 16 GB chip (adam state for ~436M params
+# is 5.2 GB before activations) — the documented memory boundary.
 cd "$(dirname "$0")"
 run() {
   echo "=== $*"
@@ -14,15 +16,15 @@ import sys, json
 try:
     d = json.loads(sys.stdin.read().strip().splitlines()[-1])
     s = d['suites']['lm']
-    print(' ', s['samples_per_sec_per_chip'], 'tok/s,', s['tflops_per_chip'], 'TF/s, MFU', s['mfu_vs_bf16_peak'], 'hw', s.get('mfu_hw_vs_bf16_peak'), '('+d['device']+')')
+    print(' ', s['samples_per_sec_per_chip'], 'tok/s,', s['tflops_per_chip'], 'TF/s, MFU', s['mfu_vs_bf16_peak'], 'hw', s.get('mfu_hw_vs_bf16_peak'), s['config'], '('+d['device']+')')
 except Exception as e:
     print('  FAILED', e)
 "
 }
-run --lm-dim 512  --lm-depth 4 --lm-batch 64                                     # r2 base: 32.0% (2026-07-31)
-run --lm-dim 1024 --lm-depth 8 --lm-batch 32 --lm-head-chunk 128                 # 40.5%, no remat
+run --lm-dim 512  --lm-depth 4 --lm-batch 64 --no-lm-remat --lm-head-chunk 0                      # r2 base: 32.0% (2026-07-31)
+run --lm-dim 1024 --lm-depth 8 --lm-batch 32 --no-lm-remat --lm-head-chunk 128                    # 40.5%, no remat
 run --lm-dim 2048 --lm-depth 8 --lm-batch 32 --lm-remat --lm-remat-mode attn --lm-head-chunk 128  # 40.9%
-run --lm-dim 2048 --lm-depth 8 --lm-batch 16 --lm-remat --lm-remat-mode dots --lm-head-chunk 128  # 43.5% WINNER
+run --lm-dim 2048 --lm-depth 8 --lm-batch 16 --lm-remat --lm-remat-mode dots --lm-head-chunk 128  # 43.5% WINNER (= bench defaults)
 run --lm-dim 2048 --lm-depth 12 --lm-batch 16 --lm-remat --lm-remat-mode attn --lm-head-chunk 128 # 39.8% model / 53.3% hw
 # unmeasured (tunnel died mid-pass): candidates between the fit/OOM line
 run --lm-dim 2048 --lm-depth 8 --lm-batch 24 --lm-remat --lm-remat-mode dots --lm-head-chunk 128
