@@ -137,6 +137,14 @@ def _flags(parser):
                         help="> 0: linear warmup then cosine decay to "
                              "10%% of --lr over --num_iters (an optax "
                              "schedule fed straight into the updater)")
+    parser.add_argument("--generate", type=int, default=0,
+                        help="after training, decode this many tokens "
+                             "from a prompt of the training stream via "
+                             "the KV cache (models/decode.py); greedy "
+                             "unless --temperature")
+    parser.add_argument("--temperature", type=float, default=0.0,
+                        help="sampling temperature for --generate "
+                             "(0 = greedy)")
     parser.add_argument("--dropout", type=float, default=0.0,
                         help="GPT-style embedding + residual dropout "
                              "(train-time; per-step keys ride the batch "
@@ -226,7 +234,8 @@ def run(cfg: Config, args, metrics) -> dict:
     if layout not in ("dp", "sp"):
         for flag, default in (("attn", "reference"), ("accum", 1),
                               ("dtype", "float32"), ("comm", "float32"),
-                              ("clip_norm", 0.0), ("warmup_steps", 0)):
+                              ("clip_norm", 0.0), ("warmup_steps", 0),
+                              ("generate", 0)):
             if getattr(args, flag, default) != default:
                 raise SystemExit(f"--{flag} is only wired into --layout "
                                  f"dp/sp (got {layout})")
@@ -346,9 +355,23 @@ def run(cfg: Config, args, metrics) -> dict:
     if losses:
         metrics.log(final_loss=losses[-1], layout=layout, seq_len=seq_len,
                     tokens_per_sec=loop.timer.samples_per_sec * seq_len)
-    return {"losses": losses, "table": table, "layout": layout,
-            "start_step": start_step,
-            "samples_per_sec": loop.timer.samples_per_sec}
+    gen = getattr(args, "generate", 0)
+    out = {"losses": losses, "table": table, "layout": layout,
+           "start_step": start_step,
+           "samples_per_sec": loop.timer.samples_per_sec}
+    if gen:
+        # serving demo: pull the trained params and decode through the
+        # KV cache (models/decode.py) — greedy unless --temperature
+        from minips_tpu.models import decode as dec
+
+        prompt = jnp.asarray(data["tokens"][:1, : min(8, seq_len)])
+        temp = getattr(args, "temperature", 0.0)
+        toks = dec.generate(
+            table.pull(), prompt, gen, heads=heads, temperature=temp,
+            key=(jax.random.PRNGKey(cfg.train.seed) if temp else None))
+        out["generated"] = toks[0].tolist()
+        metrics.log(generated=out["generated"])
+    return out
 
 
 def _load_data(cfg, args, seq_len):

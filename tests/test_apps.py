@@ -317,3 +317,19 @@ def test_wide_deep_stream_one_pass(tmp_path):
         app.run(cfg, _args(model="deepfm", data_file=path, stream=True,
                            eval_frac=0.2), MetricsLogger(None,
                                                          verbose=False))
+
+
+def test_lm_example_generate_after_training():
+    """--generate N: the trained table's params decode N tokens through
+    the KV cache; dropout composes (train-time masks, eval-clean decode)."""
+    from minips_tpu.apps import lm_example as app
+
+    cfg = Config(
+        table=TableConfig(name="lm", kind="dense", updater="adam", lr=3e-3),
+        train=TrainConfig(batch_size=16, num_iters=4, log_every=100),
+    )
+    metrics = MetricsLogger(None, verbose=False)
+    out = app.run(cfg, _args(layout="dp", seq_len=32, generate=6,
+                             dropout=0.1), metrics)
+    assert len(out["generated"]) == 6
+    assert all(0 <= t < 256 for t in out["generated"])
