@@ -366,8 +366,12 @@ def run(cfg: Config, args, metrics) -> dict:
 
         prompt = jnp.asarray(data["tokens"][:1, : min(8, seq_len)])
         temp = getattr(args, "temperature", 0.0)
+        # decode at the TRAINING precision (f32 unless --dtype bfloat16)
+        # so greedy decode stays pinned to the training forward
+        dd = compute_dtype if compute_dtype is not None else jnp.float32
         toks = dec.generate(
             table.pull(), prompt, gen, heads=heads, temperature=temp,
+            compute_dtype=dd, cache_dtype=dd,
             key=(jax.random.PRNGKey(cfg.train.seed) if temp else None))
         out["generated"] = toks[0].tolist()
         metrics.log(generated=out["generated"])
