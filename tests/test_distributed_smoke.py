@@ -185,7 +185,6 @@ def test_run_local_job_tolerates_non_json_brace_lines():
             base_port=_PORT[0], timeout=60)
 
 
-@pytest.mark.slow
 def test_wide_deep_multiproc_ssp_staleness4():
     """VERDICT r1 #3: the flagship sparse workload (W&D embedding tables)
     on the key-range-sharded PS at SSP staleness 4 — row-sparse wire,

@@ -38,7 +38,6 @@ def _run(n: int, extra: list[str], timeout: float = 240.0,
         timeout=timeout, kill_on_failure=kill_on_failure)
 
 
-@pytest.mark.slow
 def test_kill_detect_resume(tmp_path):
     ckpt = str(tmp_path / "ckpt")
     base = ["--iters", "40", "--mode", "ssp", "--staleness", "2",
@@ -157,7 +156,6 @@ def test_wide_deep_multiproc_kill_detect_resume(tmp_path):
     assert max(fps) - min(fps) < 1e-4, fps
 
 
-@pytest.mark.slow
 def test_mf_multiproc_kill_detect_resume(tmp_path):
     """The negotiated shard resume on MF's exact-per-id factor tables
     (word2vec's in/out tables are structurally identical — two pure
