@@ -228,6 +228,16 @@ def _forward(params, tokens, pos, heads, attn_fn, compute_dtype,
             attn_fn = _rope_wrap(attn_fn, pos)
     if not 0.0 <= dropout < 1.0:
         raise ValueError(f"dropout rate {dropout} outside [0, 1)")
+    if dropout and apply_blocks is not None:
+        # the parallel-schedule path replaces the sequential layer loop,
+        # so the per-block residual dropout below would be silently
+        # skipped — only embedding dropout would apply, and a library
+        # caller would under-regularize without noticing (lm_example
+        # guards this at the CLI; the library must refuse too, like the
+        # adamw-on-tp/pp/ep refusals)
+        raise ValueError("dropout > 0 is not supported on parallel-"
+                         "schedule (apply_blocks) paths: per-block "
+                         "residual dropout lives in the sequential loop")
     aux_total = 0.0
     if dropout and rng is not None:   # embedding dropout (GPT-style)
         h = _dropout(h, dropout, jax.random.fold_in(rng, 2 ** 20))
@@ -666,16 +676,35 @@ def loss(params, batch, *, heads=4, compute_dtype=jnp.bfloat16,
     chunks of that size (:func:`nll_chunked`) so the [B, T, vocab] logits
     never materialize. ``dropout > 0`` reads the step's PRNG key from
     ``batch["rng"]`` (the fused step is pure, so randomness must ride the
-    batch) and raises if it is absent."""
+    batch) and raises if it is absent.
+
+    ``batch["rng"]`` contract: a RAW uint32 key array — ``[2]`` (one key,
+    replicated), or ``[W, 2]`` fed through shard_map with ``batch_spec
+    P(DATA_AXIS)`` so each worker's shard sees its own ``[1, 2]`` slice
+    (distinct masks per worker). New-style typed keys
+    (``jax.random.key``) are rejected: a typed ``[W]`` stack would bypass
+    the per-worker slice below and silently broadcast one mask."""
     toks = batch["tokens"]
     rng = batch.get("rng")
     if dropout and rng is None:
         raise ValueError('dropout > 0 needs a per-step key in '
                          'batch["rng"] (the fused step is pure)')
+    if dropout and jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
+        # only when the rng will actually be consumed: an eval call
+        # (dropout=0) reusing a training batch dict must not start
+        # rejecting a key it never reads
+        raise TypeError('batch["rng"] must be a RAW uint32 key array '
+                        '([2] or [W, 2] via jax.random.PRNGKey), not a '
+                        'typed jax.random.key array: the per-worker '
+                        '[W, 2] slicing below cannot see typed-key '
+                        'stacks and would silently reuse one mask')
     if rng is not None and rng.ndim == 2:
         # per-WORKER keys sharded over the data axis (a [W, 2] stack fed
         # with batch_spec P(DATA_AXIS)): each shard sees its [1, 2] slice
         # — distinct dropout masks per worker, not one replicated pattern
+        if dropout and rng.shape[-1] != 2:
+            raise ValueError(f'batch["rng"] 2-D stack must be [W, 2] raw '
+                             f'uint32 keys, got {rng.shape}')
         rng = rng[0]
     if head_chunk:
         T = toks.shape[1] - 1

@@ -617,3 +617,40 @@ def test_dropout_per_worker_key_stack():
     np.testing.assert_allclose(float(l_flat), float(l_stack), rtol=1e-6)
     with pytest.raises(ValueError, match="outside"):
         tfm.loss(p, {"tokens": toks, "rng": key}, heads=4, dropout=1.0)
+
+
+def test_dropout_rng_contract_rejects_typed_and_malformed_keys():
+    """ADVICE r3: loss() infers the per-worker stack from ndim == 2 on
+    RAW uint32 keys, so typed jax.random.key arrays (which would bypass
+    the slice and silently broadcast one mask) and non-[W, 2] stacks
+    must fail loudly, not degrade."""
+    p = tfm.init(jax.random.PRNGKey(0), vocab=31, dim=32, heads=4,
+                 depth=1, max_len=32)
+    toks = _toks(2, 17, vocab=31)  # stay in THIS model's id range
+    with pytest.raises(TypeError, match="typed"):
+        tfm.loss(p, {"tokens": toks, "rng": jax.random.key(3)}, heads=4,
+                 dropout=0.1)
+    with pytest.raises(ValueError, match=r"\[W, 2\]"):
+        tfm.loss(p, {"tokens": toks,
+                     "rng": jnp.zeros((4, 3), jnp.uint32)}, heads=4,
+                 dropout=0.1)
+    # eval convention: dropout=0 never reads the key, so a reused
+    # training batch carrying a typed key must NOT start raising
+    l_eval = tfm.loss(p, {"tokens": toks, "rng": jax.random.key(3)},
+                      heads=4)
+    assert np.isfinite(float(l_eval))
+
+
+def test_dropout_refused_on_parallel_schedule_paths():
+    """ADVICE r3: per-block residual dropout lives in the sequential
+    layer loop; an apply_blocks (pipeline-style) caller asking for
+    dropout > 0 must get a loud refusal, not silent embedding-only
+    regularization."""
+    p = tfm.init(jax.random.PRNGKey(0), vocab=31, dim=32, heads=4,
+                 depth=1, max_len=32)
+    toks = _toks(2, 16)
+    with pytest.raises(ValueError, match="apply_blocks"):
+        tfm._forward(p, toks, jnp.arange(16), 4,
+                     tfm._attn_fn("reference"), jnp.float32,
+                     apply_blocks=lambda h: h, dropout=0.1,
+                     rng=jax.random.PRNGKey(1))
