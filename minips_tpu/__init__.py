@@ -34,3 +34,4 @@ from minips_tpu.utils.metrics import MetricsLogger  # noqa: F401
 from minips_tpu.comm import cluster  # noqa: F401  (multi-host bootstrap)
 from minips_tpu.train.sharded_ps import (ShardedPSTrainer,  # noqa: F401
                                          ShardedTable, table_state_bytes)
+from minips_tpu.train.ssp_spmd import CollectiveSSP  # noqa: F401

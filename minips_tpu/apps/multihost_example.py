@@ -82,6 +82,29 @@ class _Watchdog:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--mode", default="fused",
+                    choices=["fused", "bsp", "ssp", "asp"],
+                    help="fused: the one-global-mesh BSP data plane "
+                         "(implicit-barrier collectives, the default); "
+                         "bsp/ssp/asp: CollectiveSSP (train/ssp_spmd.py) "
+                         "— per-process local fused steps under the "
+                         "host-side staleness gate, cross-process sync "
+                         "as an XLA collective (SURVEY §7.4.1)")
+    ap.add_argument("--staleness", type=int, default=4,
+                    help="SSP bound s for --mode ssp (bsp pins 0, "
+                         "asp pins inf)")
+    ap.add_argument("--sync-every", type=int, default=1,
+                    help="collective merge every k local steps "
+                         "(CollectiveSSP modes)")
+    ap.add_argument("--slow-rank", type=int, default=-1)
+    ap.add_argument("--slow-ms", type=int, default=0,
+                    help="straggler injection: sleep this long before "
+                         "each of --slow-rank's local steps")
+    ap.add_argument("--oracle-hosts", type=int, default=0,
+                    help="single-process: SIMULATE this many hosts "
+                         "sequentially (disjoint submeshes, same merge "
+                         "schedule) — the bitwise loss oracle for the "
+                         "real N-process CollectiveSSP run")
     ap.add_argument("--model", default="lr", choices=["lr", "wd", "lm"],
                     help="lr: DenseTable LR (checkpoint drill supported); "
                          "wd: the flagship DeepFM fused step — hashed "
@@ -162,6 +185,12 @@ def main(argv=None) -> int:
     # parity assertion)
     rng = np.random.default_rng(args.seed)
 
+    if args.mode != "fused":
+        if args.model != "lr":
+            raise SystemExit("--mode bsp/ssp/asp runs the lr model")
+        from minips_tpu.train.ssp_spmd import run_ssp_spmd
+
+        return run_ssp_spmd(args, rank, nprocs, multi, watchdog)
     if args.model == "wd":
         return _run_wd(args, mesh, rank, nprocs, per, multi, rng,
                        watchdog)

@@ -1,0 +1,395 @@
+"""CollectiveSSP — BSP/SSP/ASP whose SYNC is an XLA collective.
+
+This is SURVEY.md §7.4.1 implemented as written — the one north-star
+clause ("the consistency controller gates XLA collective barriers",
+BASELINE.json:5) the host-relay paths don't embody:
+
+- each process drives its OWN jitted shard-local fused step
+  (``DenseTable.make_step`` over a per-process mesh: pull/push collectives
+  stay on intra-host ICI);
+- the cross-host sync is an explicit COLLECTIVE the host chooses to
+  launch — a ``psum`` of parameter deltas over a ``(proc, local)`` global
+  mesh, compiled by XLA into an all-reduce whose replica groups cross the
+  process boundary (DCN on a pod; Gloo on the CPU loopback smoke). No
+  parameter bytes ever ride the zmq bus;
+- the SSP gate is host-side: the clock vector gossips over the control
+  bus (``ClockGossip``) and the shared ``StalenessGate`` blocks a fast
+  host before local step ``c+1`` until ``global_min >= c + 1 - s``
+  (s=0 BSP lockstep, s>0 SSP, inf ASP-never-waits) — SURVEY §7.4.1's
+  "blocking the fast host's sync when my_clock − min_clock > s".
+
+Sync semantics are the relay path's additive replicated-PS rule
+(train/ssp_trainer.py): every process applies the SUM of all processes'
+parameter deltas since the last sync, so after a sync every replica holds
+``base + Σ_p delta_p`` — bitwise-identical state across processes (the
+all-reduce gives every participant the same reduction result). Between
+syncs, replicas drift by their own local updates; the staleness gate
+bounds that drift in CLOCK distance, exactly SSP's contract.
+
+Collective rendezvous constraint (inherent, documented): sync rounds are
+launched at fixed clocks (every ``sync_every`` local steps), so every
+process must take the same number of steps — XLA collectives need all
+participants. Dynamic retirement / uneven step counts stay on the
+host-relay paths (SSPTrainer), which have no such constraint. ASP here is
+therefore bounded-rendezvous local SGD: the gate never blocks, but the
+periodic merge still does — the same drift honesty as
+docs/consistency.md's SPMD-ASP note, now with the merge on the collective
+plane.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from minips_tpu.comm.bus import ClockGossip
+from minips_tpu.consistency.gate import StalenessGate, publish_clock
+from minips_tpu.parallel.mesh import DATA_AXIS
+from minips_tpu.tables.dense import DenseTable
+
+__all__ = ["CollectiveSSP"]
+
+PyTree = Any
+
+
+def _process_local_devices(all_devices, proc_index):
+    """The global view of one process's devices, in the order every
+    process can reconstruct (jax.devices() is globally ordered)."""
+    return [d for d in all_devices if d.process_index == proc_index]
+
+
+class CollectiveSSP:
+    """Local jitted steps per process; staleness-gated collective syncs.
+
+    Parameters
+    ----------
+    template: parameter pytree (identical on every process).
+    grad_fn: ``(params, batch) -> (loss, grads)`` for the local fused
+        step (``DenseTable.make_step`` semantics, run on the per-process
+        mesh).
+    staleness: 0 = BSP lockstep, s = SSP bounded staleness,
+        ``float('inf')`` = ASP (gate never blocks; syncs still rendezvous).
+    sync_every: launch the collective merge every k local steps. The skew
+        the gate can actually permit is ``min(staleness, steps to the
+        next sync boundary)`` — the collective is its own barrier.
+    bus: the launcher's ControlBus for clock gossip (None single-process).
+    monitor: optional HeartbeatMonitor; a gate timeout consults it so a
+        dead peer raises PeerFailureError instead of hanging the gate.
+    """
+
+    def __init__(
+        self,
+        template: PyTree,
+        grad_fn: Callable,
+        *,
+        updater: str = "sgd",
+        lr=0.1,
+        staleness: float = 0,
+        sync_every: int = 1,
+        bus=None,
+        monitor=None,
+        gate_timeout: float = 60.0,
+        name: str = "cssp",
+    ):
+        if sync_every < 1:
+            raise ValueError("sync_every must be >= 1")
+        self.staleness = staleness
+        self.sync_every = int(sync_every)
+        self.nprocs = jax.process_count()
+        self._me = jax.process_index()
+        if bus is None and self.nprocs > 1 and staleness < sync_every:
+            # without the bus there is NO clock gossip: skew would grow
+            # to sync_every (the collective is the only barrier left)
+            # while gate_waits/max_skew_seen report zeros — the requested
+            # consistency contract silently not enforced. Refuse loudly
+            # (house rule); staleness >= sync_every is allowed bus-less
+            # because the rendezvous itself bounds skew below s.
+            raise ValueError(
+                f"staleness {staleness} < sync_every {sync_every} needs "
+                "the control bus for clock gossip in a multi-process "
+                "run; pass bus= (launch.init_from_env) or raise "
+                "staleness/sync alignment")
+
+        # ---- local data plane: the fused step on MY devices only -----
+        all_devs = list(jax.devices())
+        mine = _process_local_devices(all_devs, self._me)
+        if mine != list(jax.local_devices()):
+            # the (proc, local) sync mesh below assumes the global device
+            # order restricted to one process IS that process's local
+            # order; true for every backend here, but a silent mismatch
+            # would scatter delta shards to wrong columns
+            raise RuntimeError("jax.devices() per-process order differs "
+                               "from jax.local_devices() — sync mesh "
+                               "construction needs them equal")
+        self.local_mesh = Mesh(np.asarray(mine), (DATA_AXIS,))
+        self.table = DenseTable(template, self.local_mesh, name=name,
+                                updater=updater, lr=lr)
+        self._step = self.table.make_step(grad_fn)
+        self._n_local = len(mine)
+
+        # ---- global sync plane: (proc, local) mesh + psum over proc --
+        grid = np.array(
+            [_process_local_devices(all_devs, p)
+             for p in range(self.nprocs)])
+        self.sync_mesh = Mesh(grid, ("proc", "local"))
+        self._gspec = NamedSharding(self.sync_mesh, P("proc", "local"))
+
+        def merge(delta_block):       # [1, padded/L] on each device
+            return jax.lax.psum(delta_block, "proc")
+
+        self._merge = jax.jit(jax.shard_map(
+            merge, mesh=self.sync_mesh,
+            in_specs=P("proc", "local"), out_specs=P(None, "local")))
+
+        self._copy = jax.jit(jnp.copy)
+        # params = base + sum_of_deltas; base snapshot is refreshed to a
+        # SEPARATE buffer after each sync (the fused step donates its
+        # params argument, so base must never alias the live params)
+        self._apply = jax.jit(lambda base, merged: base + merged)
+        self._delta = jax.jit(lambda params, base: params - base)
+        self._base = self._copy(self.table.params)
+
+        # ---- host-side control plane: clock gossip + staleness gate --
+        self.clock = 0
+        self.sync_rounds = 0
+        self._synced_at = 0  # clock of the last merge (finalize idempotence)
+        self._gate = None
+        if bus is not None and self.nprocs > 1:
+            self.gossip = ClockGossip(bus, self.nprocs,
+                                      workers_per_process=1)
+            self._gate = StalenessGate(self.gossip, staleness,
+                                       timeout=gate_timeout,
+                                       monitor=monitor)
+
+    # ------------------------------------------------------------ metrics
+    @property
+    def gate_waits(self) -> int:
+        return self._gate.gate_waits if self._gate else 0
+
+    @property
+    def max_skew_seen(self) -> int:
+        return self._gate.max_skew_seen if self._gate else 0
+
+    @property
+    def params(self) -> PyTree:
+        return self.table.pull()
+
+    # ------------------------------------------------------------- plumbing
+    def _to_sync_plane(self, delta) -> jax.Array:
+        """My local delta vector -> one ROW of the (nprocs, padded) global
+        array, device-to-device (each local shard becomes its column
+        block; no host copy)."""
+        shards = sorted(delta.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        rows = [s.data.reshape(1, -1) for s in shards]
+        return jax.make_array_from_single_device_arrays(
+            (self.nprocs, self.table.padded), self._gspec, rows)
+
+    def _from_sync_plane(self, merged) -> jax.Array:
+        """The replicated merge result back to a local-mesh vector."""
+        shards = sorted(merged.addressable_shards,
+                        key=lambda s: s.index[1].start or 0)
+        cols = [s.data.reshape(-1) for s in shards]
+        return jax.make_array_from_single_device_arrays(
+            (self.table.padded,), self.table.params.sharding, cols)
+
+    def sync_hlo(self) -> str:
+        """Compiled HLO of the sync program — the comm_analysis hook: the
+        test/smoke asserts the cross-host sync IS a collective op (and
+        nothing else ever leaves the process on the data plane)."""
+        shape = jax.ShapeDtypeStruct(
+            (self.nprocs, self.table.padded),
+            self.table.params.dtype, sharding=self._gspec)
+        return self._merge.lower(shape).compile().as_text()
+
+    # ------------------------------------------------------------------ api
+    def step(self, batch) -> float:
+        """One LOCAL step, clock tick, SSP gate, then (at sync-every
+        boundaries) the collective merge. ``batch`` is my process's local
+        rows; leaves are placed sharded over my local mesh.
+
+        Gate placement matches SSPTrainer (step, clock++, publish, wait):
+        after completing step ``c`` block until ``global_min >= c - s`` —
+        at s=0 that is BSP lockstep with transient skew <= 1, and the
+        smoke-suite invariant ``max_skew_seen <= s + 1`` holds for both
+        trainers by the same argument. (Gating BEFORE the step with a
+        ``c+1`` threshold would deadlock at s=0: every process would wait
+        for the others to finish a step none has started.)"""
+        sharding = NamedSharding(self.local_mesh, P(DATA_AXIS))
+        local = {k: jax.device_put(v, sharding) for k, v in batch.items()}
+        loss = self.table.step_inplace(self._step, local)
+        self.clock += 1
+        if self._gate is not None:
+            publish_clock(self.gossip, self.clock, False)
+            self._gate.wait(self.clock)
+        if self.clock % self.sync_every == 0:
+            self._sync()
+        return float(loss)
+
+    def _sync(self) -> None:
+        """base + psum_over_processes(delta) -> every replica identical.
+        The all-reduce is the rendezvous: a fast host blocks HERE (inside
+        XLA, on the DCN plane) until every process launches the round."""
+        delta = self._delta(self.table.params, self._base)
+        merged = self._merge(self._to_sync_plane(delta))
+        new_params = self._apply(self._base, self._from_sync_plane(merged))
+        self.table.params = new_params
+        self._base = self._copy(new_params)
+        self.sync_rounds += 1
+        self._synced_at = self.clock
+
+    def finalize(self) -> PyTree:
+        """Merge any tail of local steps not yet synced; afterwards every
+        process holds identical parameters. All processes must call this
+        together (it may launch one last collective). Idempotent: a
+        second finalize at the same clock launches nothing — an UNMATCHED
+        extra collective on one process would hang the job."""
+        if self.clock != self._synced_at:
+            self._sync()
+        return self.params
+
+
+def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
+                 watchdog) -> int:
+    """The multihost_example ``--mode bsp|ssp|asp`` runner: LR on
+    synthetic data, per-process batch slices, CollectiveSSP training,
+    one JSON result line per rank (smoke protocol).
+
+    ``--oracle-hosts K`` (single-process only) instead SIMULATES K hosts
+    sequentially — same local-step math on K disjoint submeshes, same
+    fixed-clock merge schedule — producing the exact per-host loss
+    streams the real K-process run must reproduce: the gate changes
+    overlap/timing, never math, so ssp/bsp/asp runs all match this
+    oracle bitwise (up to float reduction noise).
+    """
+    import json
+
+    from minips_tpu.comm import cluster
+    from minips_tpu.models import lr as lr_model
+
+    B, D = args.batch, args.dim
+    staleness = {"bsp": 0, "ssp": args.staleness,
+                 "asp": float("inf")}[args.mode]
+    rng = np.random.default_rng(args.seed)
+    w_true = rng.normal(size=D)
+
+    def next_global():
+        x = rng.normal(size=(B, D)).astype(np.float32)
+        y = (x @ w_true > 0).astype(np.float32)
+        return x, y
+
+    if args.oracle_hosts:
+        if nprocs > 1:
+            # under the launcher every rank would simulate ALL K hosts,
+            # print duplicate oracle lines, and skip the watchdog
+            # disarm/barrier protocol (spurious peer_failure exit 42)
+            raise SystemExit("--oracle-hosts is a single-process "
+                             "simulation; run it without the launcher")
+        return _run_oracle(args, rng, next_global)
+
+    if B % nprocs:
+        raise SystemExit(f"--batch {B} must divide by {nprocs} processes")
+    per = B // nprocs
+    t0 = time.monotonic()
+    trainer = CollectiveSSP(
+        lr_model.init(D), lr_model.grad_fn_dense, updater=args.updater,
+        lr=args.lr, staleness=staleness, sync_every=args.sync_every,
+        bus=getattr(watchdog, "bus", None),
+        monitor=getattr(watchdog, "monitor", None))
+    losses = []
+    for i in range(args.iters):
+        x, y = next_global()
+        if args.slow_ms and rank == args.slow_rank:
+            time.sleep(args.slow_ms / 1000.0)
+        losses.append(trainer.step(
+            {"x": x[rank * per:(rank + 1) * per],
+             "y": y[rank * per:(rank + 1) * per]}))
+    trainer.finalize()
+    fp = float(cluster.host_copy(trainer.table.params).sum())
+    hlo = trainer.sync_hlo()
+
+    watchdog.disarm()
+    cluster.barrier("cssp_done")
+    print(json.dumps({
+        "rank": rank, "event": "done", "mode": args.mode,
+        "wall_s": round(time.monotonic() - t0, 4),
+        "multi": multi, "process_count": nprocs,
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+        "staleness": (None if staleness == float("inf")
+                      else int(staleness)),
+        "sync_every": args.sync_every,
+        "loss_first": losses[0], "loss_last": losses[-1],
+        "losses": [round(x, 8) for x in losses],
+        "param_fingerprint": fp,
+        "gate_waits": trainer.gate_waits,
+        "max_skew_seen": trainer.max_skew_seen,
+        "sync_rounds": trainer.sync_rounds,
+        "sync_hlo_has_all_reduce": "all-reduce" in hlo,
+        "sync_plane_devices": len(trainer.sync_mesh.devices.ravel()),
+    }), flush=True)
+    watchdog.close()
+    return 0
+
+
+def _run_oracle(args, rng, next_global) -> int:
+    """Sequential K-virtual-host simulation (single process): DenseTables
+    on disjoint submeshes run the identical local-step program, and the
+    merge applies the delta SUM at the same fixed clocks — the bitwise
+    reference for the real K-process run."""
+    import json
+
+    from minips_tpu.models import lr as lr_model
+
+    K = args.oracle_hosts
+    devs = jax.devices()
+    if len(devs) % K:
+        raise SystemExit(f"{len(devs)} devices do not split into "
+                         f"{K} oracle hosts")
+    L = len(devs) // K
+    B = args.batch
+    if B % K:
+        raise SystemExit(f"--batch {B} must divide by {K} oracle hosts")
+    per = B // K
+    tables, steps, bases = [], [], []
+    copy = jax.jit(jnp.copy)
+    for h in range(K):
+        mesh = Mesh(np.asarray(devs[h * L:(h + 1) * L]), (DATA_AXIS,))
+        t = DenseTable(lr_model.init(args.dim), mesh, name=f"h{h}",
+                       updater=args.updater, lr=args.lr)
+        tables.append(t)
+        steps.append(t.make_step(lr_model.grad_fn_dense))
+        bases.append(copy(t.params))
+    losses = [[] for _ in range(K)]
+    for i in range(args.iters):
+        x, y = next_global()
+        for h in range(K):
+            sh = NamedSharding(tables[h].mesh, P(DATA_AXIS))
+            batch = {"x": jax.device_put(x[h * per:(h + 1) * per], sh),
+                     "y": jax.device_put(y[h * per:(h + 1) * per], sh)}
+            losses[h].append(float(
+                tables[h].step_inplace(steps[h], batch)))
+        if (i + 1) % args.sync_every == 0 or i + 1 == args.iters:
+            # merged = base + sum of per-host deltas, like the collective
+            deltas = [np.asarray(tables[h].params)
+                      - np.asarray(bases[h]) for h in range(K)]
+            total = np.sum(deltas, axis=0)
+            for h in range(K):
+                merged = jnp.asarray(np.asarray(bases[h]) + total)
+                tables[h].params = jax.device_put(
+                    merged, tables[h].params.sharding)
+                bases[h] = copy(tables[h].params)
+    fps = [float(np.asarray(t.params).sum()) for t in tables]
+    print(json.dumps({
+        "rank": 0, "event": "done", "mode": args.mode, "oracle": True,
+        "oracle_hosts": K, "sync_every": args.sync_every,
+        "losses_per_host": [[round(x, 8) for x in ls] for ls in losses],
+        "param_fingerprints": fps,
+    }), flush=True)
+    return 0

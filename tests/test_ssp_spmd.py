@@ -1,0 +1,104 @@
+"""CollectiveSSP (train/ssp_spmd.py) — fast-tier units.
+
+The real 2-process legs (gate engagement under a straggler, oracle loss
+parity, cross-rank agreement) live in tests/test_multihost.py's slow
+tier; here the single-process degenerate forms pin the local math: a
+P=1 sync must be an exact no-op on the parameters (base + own delta =
+params), the merge program must compile to a collective, and the
+schedule bookkeeping must match the configuration.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from minips_tpu.models import lr as lr_model
+from minips_tpu.train.ssp_spmd import CollectiveSSP
+
+
+def _batch(rng, n=32, d=16):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def test_collective_ssp_single_process_trains(mesh8):
+    rng = np.random.default_rng(0)
+    tr = CollectiveSSP(lr_model.init(16), lr_model.grad_fn_dense,
+                       lr=0.3, sync_every=2)
+    losses = [tr.step(_batch(rng)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    assert tr.clock == 6 and tr.sync_rounds == 3
+    assert np.isfinite(np.asarray(tr.table.params)).all()
+
+
+def test_collective_ssp_sync_is_identity_at_world_one(mesh8):
+    """P=1: psum over a size-1 proc axis returns my own delta, so
+    base + delta must EXACTLY reproduce the pre-sync parameters — any
+    drift here would be silent corruption at every world size."""
+    rng = np.random.default_rng(1)
+    tr = CollectiveSSP(lr_model.init(16), lr_model.grad_fn_dense,
+                       lr=0.3, sync_every=10_000)  # never auto-syncs
+    for _ in range(3):
+        tr.step(_batch(rng))
+    before = np.asarray(tr.table.params).copy()
+    tr._sync()
+    np.testing.assert_array_equal(np.asarray(tr.table.params), before)
+    assert tr.sync_rounds == 1
+    # and base was refreshed: an immediate re-sync is also the identity
+    tr._sync()
+    np.testing.assert_array_equal(np.asarray(tr.table.params), before)
+
+
+def test_collective_ssp_finalize_merges_tail(mesh8):
+    rng = np.random.default_rng(2)
+    tr = CollectiveSSP(lr_model.init(16), lr_model.grad_fn_dense,
+                       lr=0.3, sync_every=4)
+    for _ in range(6):  # 4 + a 2-step tail
+        tr.step(_batch(rng))
+    assert tr.sync_rounds == 1
+    tr.finalize()
+    assert tr.sync_rounds == 2
+    tr.finalize()  # aligned clock: no extra collective
+    assert tr.sync_rounds == 2
+
+
+def test_collective_ssp_sync_program_is_a_collective(mesh8):
+    """The comm_analysis hook: the cross-host sync compiles to an XLA
+    all-reduce — parameter bytes ride the collective plane, never the
+    zmq bus (SURVEY §7.4.1, BASELINE.json:5)."""
+    tr = CollectiveSSP(lr_model.init(16), lr_model.grad_fn_dense)
+    assert "all-reduce" in tr.sync_hlo()
+
+
+def test_collective_ssp_rejects_bad_sync_every(mesh8):
+    with pytest.raises(ValueError, match="sync_every"):
+        CollectiveSSP(lr_model.init(8), lr_model.grad_fn_dense,
+                      sync_every=0)
+
+
+def test_collective_ssp_multiprocess_without_bus_refuses(mesh8,
+                                                         monkeypatch):
+    """nprocs > 1 with no control bus means no clock gossip: the gate
+    would silently not exist while skew grows to sync_every. Refuse
+    loudly unless staleness >= sync_every (where the collective
+    rendezvous itself is the tighter bound)."""
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(ValueError, match="control bus"):
+        CollectiveSSP(lr_model.init(8), lr_model.grad_fn_dense,
+                      staleness=2, sync_every=8)
+
+
+def test_collective_ssp_local_step_stays_on_local_devices(mesh8):
+    """The local data plane must never enlist remote devices: params and
+    opt state live on the per-process mesh only."""
+    tr = CollectiveSSP(lr_model.init(16), lr_model.grad_fn_dense)
+    local = set(jax.local_devices())
+    assert set(tr.table.params.sharding.device_set) <= local
+    for leaf in jax.tree.leaves(tr.table.opt_state):
+        if hasattr(leaf, "sharding"):
+            assert set(leaf.sharding.device_set) <= local
+    # while the sync plane spans every device of every process
+    assert (set(tr.sync_mesh.devices.ravel().tolist())
+            == set(jax.devices()))
