@@ -31,8 +31,10 @@ class TableConfig:
     # consistency model: "bsp" | "ssp" | "asp" (SURVEY.md §2 consistency rows)
     consistency: str = "bsp"
     staleness: int = 0  # SSP bound s; north-star s <= 4 (BASELINE.json:4)
-    # server-side updater applied on push (SURVEY.md §2 "Updaters")
-    updater: str = "sgd"  # "sgd" | "adagrad" | "adam"
+    # server-side updater applied on push (SURVEY.md §2 "Updaters");
+    # adam_bf16 / adam8 store moments in bf16 / blockwise int8 — the
+    # optimizer-state HBM levers (tables/updaters.py)
+    updater: str = "sgd"  # sgd | adagrad | adam | adamw | adam_bf16 | adam8
     lr: float = 0.1
     # sparse-only: fixed slot capacity + embedding dim + init scale
     num_slots: int = 1 << 16
@@ -85,7 +87,8 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
                         choices=["bsp", "ssp", "asp"])
     parser.add_argument("--staleness", type=int, default=None)
     parser.add_argument("--updater", type=str, default=None,
-                        choices=["sgd", "adagrad", "adam", "adamw"])
+                        choices=["sgd", "adagrad", "adam", "adamw",
+                                 "adam_bf16", "adam8"])
     # adamw is dense-table-only (lm_example dp/sp); the sparse/sharded
     # tables refuse it loudly at construction
     parser.add_argument("--lr", type=float, default=None)

@@ -18,10 +18,16 @@ from minips_tpu.parallel.mesh import padded_size
 
 
 class RangePartitioner:
-    def __init__(self, num_keys: int, num_shards: int):
+    def __init__(self, num_keys: int, num_shards: int, align: int = 1):
+        """``align > 1`` pads each SHARD to a multiple of ``align`` keys —
+        for consumers whose per-shard state has block granularity (e.g.
+        adam8's one-scale-per-block quantized moments). Padding keys are
+        zeros and stay zeros; only the pad fraction changes."""
+        if align < 1:
+            raise ValueError(f"align must be >= 1, got {align}")
         self.num_keys = int(num_keys)
         self.num_shards = int(num_shards)
-        self.padded = padded_size(self.num_keys, self.num_shards)
+        self.padded = padded_size(self.num_keys, self.num_shards * align)
         self.shard_size = self.padded // self.num_shards
 
     def shard_of(self, keys: np.ndarray) -> np.ndarray:

@@ -81,11 +81,16 @@ class DenseTable:
 
         flat, self._unravel = ravel_pytree(template)
         self.num_keys = int(flat.shape[0])
-        self.partitioner = RangePartitioner(self.num_keys, self.num_shards)
+        kw = dict(updater_kwargs or {})
+        # adam8's blockwise-quantized moments need whole blocks per shard
+        # (one f32 scale per `block` contiguous elements); align the
+        # range padding instead of erroring — padding keys are zeros with
+        # zero grads, so they quantize to zero codes and never move
+        align = int(kw.get("block", 256)) if updater == "adam8" else 1
+        self.partitioner = RangePartitioner(self.num_keys, self.num_shards,
+                                            align=align)
         self.padded = self.partitioner.padded
         self._shard_shape = (self.padded // self.num_shards,)
-
-        kw = dict(updater_kwargs or {})
         # clip-by-global-norm must see the GLOBAL gradient, but the optax
         # transform runs on one owner shard inside shard_map — intercept
         # and apply it in the fused step with a cross-shard psum instead
@@ -110,19 +115,28 @@ class DenseTable:
 
         opt_state = jax.eval_shape(self.tx.init, self.params)
         opt_shardings = jax.tree.map(
-            lambda l: NamedSharding(
-                mesh, P(DATA_AXIS) if l.shape == (self.padded,) else P()
-            ),
-            opt_state,
-        )
+            lambda l: NamedSharding(mesh, self._opt_spec_for(l)), opt_state)
         # Note: specs below describe the *global* opt leaves; inside shard_map
         # sharded leaves have the per-shard shape.
         self.opt_state = jax.jit(
             self.tx.init, out_shardings=opt_shardings
         )(self.params)
-        self._opt_specs = jax.tree.map(
-            lambda l: P(DATA_AXIS) if l.shape == (self.padded,) else P(), opt_state
-        )
+        self._opt_specs = jax.tree.map(self._opt_spec_for, opt_state)
+
+    def _opt_spec_for(self, leaf) -> P:
+        """Range-shard params-length opt leaves AND their sub-padded
+        companions (e.g. adam8's one-scale-per-256-elements arrays):
+        contiguous range shards hold whole blocks, so a 1-D leaf whose
+        length divides ``padded`` and splits evenly over the shards
+        slices in alignment with the params inside shard_map. Scalars
+        (adam's count) and anything else stay replicated."""
+        if leaf.ndim == 1 and leaf.shape[0] == self.padded:
+            return P(DATA_AXIS)
+        if (leaf.ndim == 1 and leaf.shape[0] > 1
+                and self.padded % leaf.shape[0] == 0
+                and leaf.shape[0] % self.num_shards == 0):
+            return P(DATA_AXIS)
+        return P()
 
     # ------------------------------------------------------------------ pull
     def pull(self) -> PyTree:

@@ -361,3 +361,149 @@ def test_clip_norm_applies_on_push_path_too():
     t.push({"w": 100.0 * jnp.ones(8)})
     delta = -np.asarray(t.pull()["w"])
     np.testing.assert_allclose(delta, 1.0 / np.sqrt(8), rtol=1e-5)
+
+
+# --------------------------------------------- low-precision adam states
+def _lr_batches(n, d=127, bsz=256):
+    from minips_tpu.models import lr as lr_model  # noqa: F401 (template)
+
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=d)
+    data = np.random.default_rng(1)
+    out = []
+    for _ in range(n):
+        x = data.normal(size=(bsz, d)).astype(np.float32)
+        out.append({"x": x, "y": (x @ w_true > 0).astype(np.float32)})
+    return out
+
+
+def _adam_run(updater, kw, batches):
+    from minips_tpu.models import lr as lr_model
+
+    t = DenseTable(lr_model.init(127), make_mesh(8), name=f"t_{updater}",
+                   updater=updater, lr=0.01, updater_kwargs=kw)
+    step = t.make_step(lr_model.grad_fn_dense)
+    losses = [float(t.step_inplace(step, b)) for b in batches]
+    st = [x for x in jax.tree.leaves(t.opt_state) if hasattr(x, "dtype")]
+    return losses, sum(x.size * x.dtype.itemsize for x in st), t
+
+
+def test_adam_bf16_matches_adam_trajectory(mesh8):
+    """VERDICT r3 next #4: the frontier is HBM-bound by f32 adam state.
+    bf16 moments must HALVE moment bytes while staying on adam's loss
+    trajectory (only moment STORAGE loses mantissa; math is f32)."""
+    bs = _lr_batches(40)
+    ref, ref_bytes, _ = _adam_run("adam", {}, bs)
+    lowp, lowp_bytes, t = _adam_run("adam_bf16", {}, bs)
+    # moments halve; the int32 step count rides along in both
+    assert lowp_bytes <= ref_bytes // 2 + 8
+    np.testing.assert_allclose(lowp, ref, atol=2e-3)
+    assert lowp[-1] < lowp[0] * 0.6
+    # moments really are stored bf16 and sharded like the params
+    vecs = [x for x in jax.tree.leaves(t.opt_state)
+            if getattr(x, "ndim", 0) == 1 and x.shape[0] == t.padded]
+    assert vecs and all(x.dtype == jnp.bfloat16 for x in vecs)
+
+
+def test_adam8_blockwise_matches_adam_trajectory(mesh8):
+    """int8 blockwise moments: ~4.03 bytes/param of state (codes + one
+    f32 scale per block) vs adam's 8, same trajectory within quantization
+    tolerance; the per-block scale leaves shard over the data axis
+    alongside the codes (dense.py sub-padded sharding rule)."""
+    bs = _lr_batches(40)
+    ref, ref_bytes, _ = _adam_run("adam", {}, bs)
+    q, q_bytes, t = _adam_run("adam8", {"block": 8}, bs)
+    assert q_bytes < ref_bytes * 0.55   # 2*(1 + 4/8) + 4 ≈ 3/8 of 8B here
+    np.testing.assert_allclose(q, ref, atol=5e-3)
+    assert q[-1] < q[0] * 0.6
+    from jax.sharding import PartitionSpec as P
+
+    scales = [x for x in jax.tree.leaves(t.opt_state)
+              if getattr(x, "ndim", 0) == 1 and x.dtype == jnp.float32
+              and 1 < x.shape[0] < t.padded]
+    assert scales and all(
+        x.sharding.spec == P("data") for x in scales)
+
+
+def test_adam8_odd_size_aligns_padding(mesh8):
+    """A param count that doesn't divide into whole blocks per shard must
+    ALIGN the range padding (RangePartitioner align=block), not error and
+    not mis-slice: 65 keys over 8 shards with block 8 pads to 128 (16 per
+    shard = 2 whole blocks), trains, and padding stays zero."""
+    from minips_tpu.models import lr as lr_model
+
+    t = DenseTable(lr_model.init(64), make_mesh(8), name="odd8",
+                   updater="adam8", lr=0.05, updater_kwargs={"block": 8})
+    assert t.padded == 128 and t.partitioner.shard_size == 16
+    bs = _lr_batches(10, d=64)
+    step = t.make_step(lr_model.grad_fn_dense)
+    losses = [float(t.step_inplace(step, b)) for b in bs]
+    assert losses[-1] < losses[0]
+    flat = np.asarray(t.params)
+    assert (flat[t.num_keys:] == 0).all()  # padding never moved
+
+
+def test_quantize_roundtrip_log_codebook_relative_error():
+    """Blockwise dynamic 8-bit: the LOG codebook keeps ~6 decades of
+    RELATIVE precision inside a block, so roundtrip error is bounded
+    per element at ~6% of the value (plus the codebook floor for values
+    ~1e6x below the block absmax) — not at scale/2 as linear absmax
+    codes would be."""
+    from minips_tpu.tables.updaters import (_dequantize_block,
+                                            _quantize_block)
+
+    for signed in (True, False):
+        x = np.abs(np.random.default_rng(3).normal(size=512)) \
+            if not signed else np.random.default_rng(3).normal(size=512)
+        # heterogeneous magnitudes inside each block: spread 4 decades
+        x = (x * 10.0 ** np.random.default_rng(4).uniform(
+            -4, 0, size=512)).astype(np.float32)
+        xj = jnp.asarray(x)
+        q, s = _quantize_block(xj, 64, signed=signed)
+        back = np.asarray(_dequantize_block(q, s, 64, signed=signed))
+        scale = np.repeat(np.asarray(s), 64)
+        rel_ok = np.abs(back - x) <= 0.07 * np.abs(x) + 1e-12
+        floor_ok = np.abs(x) <= 2e-6 * scale  # below the codebook floor
+        assert (rel_ok | floor_ok).all(), (
+            np.abs(back - x) / np.maximum(np.abs(x), 1e-30)).max()
+
+
+def test_adam8_outlier_block_does_not_spike_updates(mesh8):
+    """r4 review finding: with LINEAR absmax codes, a small-|g| element
+    sharing a block with a large-|g| outlier had its second moment
+    quantized to zero and its update spiked ~45x vs f32 adam. The log
+    codebook must keep every element's update within a tight factor of
+    f32 adam in exactly that scenario."""
+    import optax
+
+    from minips_tpu.tables.updaters import make_updater
+
+    n, block = 64, 64
+    g_scale = np.ones(n, np.float32) * 0.01
+    g_scale[7] = 10.0   # one outlier dominates the block absmax
+    g_scale[9] = 1e-3   # ~7 decades of v below the outlier: sub-floor
+    # (exercises the round-UP-to-floor-code rule — a positive v stored
+    # as exactly zero would collapse the denominator and spike ~30x)
+    rng = np.random.default_rng(5)
+    tx8 = make_updater("adam8", 0.001, block=block)
+    txf = make_updater("adam", 0.001)
+    p = jnp.zeros(n)
+    s8, sf = tx8.init(p), txf.init(p)
+    peak8 = peakf = 0.0
+    err_num = err_den = 0.0
+    for i in range(200):
+        g = jnp.asarray(rng.normal(size=n).astype(np.float32) * g_scale)
+        u8, s8 = tx8.update(g, s8, p)
+        uf, sf = txf.update(g, sf, p)
+        if i > 20:  # steady state
+            a8, af = np.asarray(u8), np.asarray(uf)
+            peak8 = max(peak8, float(np.abs(a8).max()))
+            peakf = max(peakf, float(np.abs(af).max()))
+            err_num += float(np.square(a8 - af).sum())
+            err_den += float(np.square(af).sum())
+    # the spike signature: quantized updates exceeding adam's own peak
+    # magnitude by a large factor (elementwise per-step RATIOS are not
+    # meaningful — f32 updates cross zero). Log codes: peak8/peakf ~1.03.
+    assert peak8 < 2.0 * peakf, (peak8, peakf)
+    # and the whole update stream stays close in RMS
+    assert err_num / err_den < 0.05, err_num / err_den
