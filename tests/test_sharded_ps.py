@@ -311,35 +311,50 @@ def test_sharded_sparse_ssp_three_processes():
 
 @pytest.mark.slow
 def test_sharded_dense_bsp_agreement():
-    # adam exercises the full lazy-moment server path over the wire
-    # (adagrad multiproc stays covered by the W&D flagship smoke).
-    # One retry: this smoke is load-sensitive inside the full tier on a
-    # 1-core host (observed intermittent under back-to-back suite runs);
-    # a systematic regression fails BOTH attempts, a scheduling hiccup
-    # only one.
+    """Dense BSP over the wire with server-side lazy adam (adagrad
+    multiproc stays covered by the W&D flagship smoke).
+
+    ROOT CAUSE of the r3 intermittency (diagnosed r4, 30 instrumented
+    runs under /tmp-style stress loops): the old ``loss_last <
+    0.9 * loss_first`` bound was MARGINAL, not racy. Every failure was
+    the loss-ratio check on rank 2 — never replica agreement, skew,
+    drops, or wire loss (all zero across every run). Mechanism: each
+    rank's loss stream is computed on state it PULLS, and under BSP's
+    transient skew-1 window whether a peer's same-clock push has landed
+    before the pull varies run-to-run; server-side adam is
+    arrival-order-dependent, so per-rank loss trajectories are genuinely
+    nondeterministic. Rank 2's stream (seed 102) converges slowest:
+    ratio mean 0.883, observed range 0.860-0.908 — straddling the 0.9
+    threshold (~17% failure rate standalone, worse under tier load).
+    Recalibration: per-rank bound 0.95 (≈4 sigma above rank 2's mean)
+    plus a mean-across-ranks bound 0.88 (observed run means <= 0.839),
+    which still fails on any real convergence regression. The retry
+    shield now covers ONLY RuntimeError (run_job launch timeout / rank
+    death under 1-core tier load) — an AssertionError is a correctness
+    signal and fails on first occurrence (ADVICE r3 #1)."""
     last = None
     for attempt in range(2):
         try:
-            # inside the try: a rank stalling past the launch timeout or
-            # dying raises RuntimeError from run_job — the load-induced
-            # mode the shield exists for — not AssertionError
             res = run_job(3, ["--model", "dense", "--mode", "bsp",
                               "--dim", "96", "--updater", "adam",
                               "--lr", "0.05"])
-            assert all(r["event"] == "done" for r in res)
-            for r in res:
-                assert r["frames_dropped"] == 0, r   # no lost gradients
-                assert r["wire_frames_lost"] == 0, r  # no HWM/link losses
-                assert r["loss_last"] < r["loss_first"] * 0.9, r
-                assert r["max_skew_seen"] <= 1  # BSP lockstep
-                # adam: shard + moments + step counters, still 1/3 each
-                assert r["local_bytes"] * 3 <= r["table_bytes"] * 1.01 + 64
-            sums = [r["param_sum"] for r in res]
-            assert max(sums) - min(sums) < 1e-4, sums
-            return
-        except (AssertionError, RuntimeError) as e:  # noqa: PERF203
+        except RuntimeError as e:  # noqa: PERF203
             last = e
             print(f"attempt {attempt}: {e}")
+            continue
+        assert all(r["event"] == "done" for r in res)
+        for r in res:
+            assert r["frames_dropped"] == 0, r   # no lost gradients
+            assert r["wire_frames_lost"] == 0, r  # no HWM/link losses
+            assert r["loss_last"] < r["loss_first"] * 0.95, r
+            assert r["max_skew_seen"] <= 1  # BSP lockstep
+            # adam: shard + moments + step counters, still 1/3 each
+            assert r["local_bytes"] * 3 <= r["table_bytes"] * 1.01 + 64
+        ratios = [r["loss_last"] / r["loss_first"] for r in res]
+        assert np.mean(ratios) < 0.88, ratios  # aggregate convergence
+        sums = [r["param_sum"] for r in res]
+        assert max(sums) - min(sums) < 1e-4, sums
+        return
     raise last
 
 
