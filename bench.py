@@ -366,7 +366,12 @@ def bench_lm(args, n_chips, peak):
     params = tfm.init(jax.random.PRNGKey(0), vocab=vocab, dim=D,
                       heads=heads, depth=depth, max_len=T,
                       kv_heads=args.lm_kv_heads, rope=args.lm_rope)
-    table = DenseTable(params, mesh, name="lm", updater="adam", lr=1e-3)
+    # optimizer-state memory lever (tables/updaters.py): f32 adam state
+    # is what HBM-bounds the frontier (BASELINE.md); bf16 moments halve
+    # it, int8 blockwise quarters it — buying batch/seq headroom
+    updater = {"f32": "adam", "bf16": "adam_bf16",
+               "int8": "adam8"}[args.lm_opt_state]
+    table = DenseTable(params, mesh, name="lm", updater=updater, lr=1e-3)
     attn = "flash" if jax.default_backend() == "tpu" else "reference"
     remat = False
     if args.lm_remat:
@@ -409,7 +414,12 @@ def bench_lm(args, n_chips, peak):
     out["config"] = {"dim": D, "depth": depth, "batch": B, "seq": T,
                      "remat": (args.lm_remat_mode if args.lm_remat
                                else False),
-                     "head_chunk": args.lm_head_chunk}
+                     "head_chunk": args.lm_head_chunk,
+                     "opt_state": args.lm_opt_state}
+    opt_leaves = [x for x in jax.tree.leaves(state[1])
+                  if hasattr(x, "dtype")]
+    out["opt_state_bytes"] = int(sum(
+        x.size * x.dtype.itemsize for x in opt_leaves))
     if args.lm_kv_heads:
         out["kv_heads"] = args.lm_kv_heads
     if args.lm_rope:
@@ -719,11 +729,13 @@ def _emit(suites, on_tpu, device_note, device_kind, peak_tflops,
         sps = suites[only].get("samples_per_sec_per_chip")
         metric = f"samples/sec/chip ({only} suite — NOT the primary " \
                  "LR+MLP metric)"
-        if sps is None:  # ps suite: a control-plane rate, not a chip rate
+        if sps is None:  # ps suites: control-plane rates, not chip rates
             sps = suites[only]["rows_per_sec_per_process"]
             unit = "rows/sec/process"
-            metric = (f"rows/sec/process ({only} suite, CPU loopback "
-                      "control plane — NOT the primary LR+MLP metric)")
+            metric = suites[only].get(
+                "metric_note",
+                f"rows/sec/process ({only} suite, CPU loopback "
+                "control plane — NOT the primary LR+MLP metric)")
         vs = None
     out = {
         "metric": metric,
@@ -757,6 +769,27 @@ def bench_ps(args) -> dict:
     return out
 
 
+def bench_ps_tpu(args, force_cpu: bool) -> dict:
+    """The PS topology the north star actually describes (VERDICT r3
+    next #5): sharded host PS + workers whose grad math is a REAL jitted
+    step — rank 0 on the chip when it is alive, peers on CPU — so the
+    row rate includes pull → device → MLP fwd+bwd → host → push
+    overlapped with the wire. ``force_cpu`` (parent probe said the chip
+    is dead) keeps rank 0 off the tunnel so a hung backend can't stall
+    the suite; the labels say which ran."""
+    from bench_sharded_ps import _run
+
+    out = _run(3, "sparse", args.ps_iters, max(2, args.ps_iters // 6),
+               "zmq", compute="jit", force_cpu=force_cpu,
+               hidden=args.ps_hidden)
+    out.update(nprocs=3, bus="zmq", path="sparse",
+               metric_note="rows/sec/process (sharded PS + jitted worker"
+                           " compute; rank 0 on "
+                           + ("cpu-fallback" if force_cpu else "chip")
+                           + ", peers cpu)")
+    return out
+
+
 def _run_all(args) -> int:
     """Parent for ``--suite all``: fork one child per suite (the parent
     never initializes JAX — see the call site), merge their JSON, publish
@@ -774,13 +807,13 @@ def _run_all(args) -> int:
     if not args.cpu and not _tpu_available(args.probe_window):
         # probe ONCE here (with the full retry window), not once per
         # child: a dead tunnel would otherwise cost every chip suite its
-        # own probe window before ITS fallback — 7x the wall clock for
+        # own probe window before ITS fallback — 8x the wall clock for
         # the same answer
         print("bench: TPU unresponsive (parent probe window); all suites "
               "fall back to CPU", file=sys.stderr)
         args.cpu = True
         device_note = "cpu-fallback(tpu-unresponsive)"
-    for s in ("lrmlp", "lm", "wd", "mf", "w2v", "e2e", "ps"):
+    for s in ("lrmlp", "lm", "wd", "mf", "w2v", "e2e", "ps", "ps_tpu"):
         argv = [sys.executable, os.path.abspath(__file__),
                 "--suite", s,
                 "--batch", str(args.batch),
@@ -796,6 +829,7 @@ def _run_all(args) -> int:
                 *(["--lm-rope"] if args.lm_rope else []),
                 "--lm-remat-mode", args.lm_remat_mode,
                 "--lm-head-chunk", str(args.lm_head_chunk),
+                "--lm-opt-state", args.lm_opt_state,
                 "--wd-slots", str(args.wd_slots),
                 "--mf-users", str(args.mf_users),
                 "--mf-items", str(args.mf_items),
@@ -806,6 +840,7 @@ def _run_all(args) -> int:
                 "--e2e-rows", str(args.e2e_rows),
                 "--e2e-batch", str(args.e2e_batch),
                 "--ps-iters", str(args.ps_iters),
+                "--ps-hidden", str(args.ps_hidden),
                 # parent already proved liveness with the full window;
                 # a child's probe only guards against a MID-RUN flap, so
                 # it gets a short window (one retry) — seven children
@@ -827,10 +862,11 @@ def _run_all(args) -> int:
             continue
         child = json.loads(lines[-1])
         suites.update(child.get("suites", {}))
-        if s == "ps":
-            # loopback control-plane suite: never touches the chip, so it
-            # must not taint the run's device label (sticky-downgrade is
-            # about chip suites silently falling back to CPU)
+        if s in ("ps", "ps_tpu"):
+            # PS-topology suites label themselves (loopback control
+            # plane / mixed rank0-chip) and must not taint the run's
+            # device label (sticky-downgrade is about chip suites
+            # silently falling back to CPU)
             continue
         dev = child.get("device", "?")
         if device_note is None:
@@ -855,9 +891,12 @@ def main() -> int:
                     help="force CPU (8 fake devices) for development")
     ap.add_argument("--suite", default="all",
                     choices=["all", "lrmlp", "lm", "wd", "mf", "w2v",
-                             "e2e", "ps"])
+                             "e2e", "ps", "ps_tpu"])
     ap.add_argument("--ps-iters", type=int, default=40,
                     help="pull/push cycles per rank in the ps suite")
+    ap.add_argument("--ps-hidden", type=int, default=256,
+                    help="ps_tpu suite: hidden width of the jitted "
+                         "worker MLP (the MXU work per cycle)")
     ap.add_argument("--probe-window", type=float, default=None,
                     help="TPU probe retry window in seconds (0 = single "
                          "attempt; default: MINIPS_PROBE_WINDOW env or "
@@ -903,6 +942,11 @@ def main() -> int:
                          "attn = save attention outputs (backward never "
                          "re-runs attention); dots = save matmul outputs "
                          "(recompute only elementwise)")
+    ap.add_argument("--lm-opt-state", default="f32",
+                    choices=["f32", "bf16", "int8"],
+                    help="adam moment storage (tables/updaters.py): "
+                         "bf16 halves, int8 (blockwise) quarters the "
+                         "optimizer-state HBM that bounds the frontier")
     ap.add_argument("--lm-head-chunk", type=int, default=128,
                     help="sequence-chunked tied head + CE: the [B,T,vocab]"
                          " logits never materialize (models/transformer.py"
@@ -958,6 +1002,16 @@ def main() -> int:
         # this process — runs before (and independent of) the TPU probe
         _emit({"ps": bench_ps(args)}, False, "cpu-loopback(control-plane)",
               None, None)
+        return 0
+
+    if args.suite == "ps_tpu":
+        # the PS wire + jitted worker compute row: rank 0 of the worker
+        # job takes the chip IF the probe says it is alive; this parent
+        # still never initializes jax
+        chip = not args.cpu and _tpu_available(args.probe_window)
+        _emit({"ps_tpu": bench_ps_tpu(args, force_cpu=not chip)}, False,
+              ("mixed(rank0-tpu,peers-cpu)" if chip
+               else "cpu-loopback(tpu-unavailable)"), None, None)
         return 0
 
     if args.suite == "all":

@@ -31,17 +31,38 @@ import sys
 _PORT = [6600 + (os.getpid() % 389)]
 
 
-def _worker_argv(path: str, iters: int, warmup: int) -> list[str]:
-    return [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
+def _worker_argv(path: str, iters: int, warmup: int,
+                 compute: str = "none",
+                 hidden: int | None = None) -> list[str]:
+    argv = [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
             "--path", path, "--iters", str(iters), "--warmup", str(warmup)]
+    if compute != "none":
+        argv += ["--compute", compute]
+    if hidden is not None:
+        argv += ["--hidden", str(hidden)]
+    return argv
 
 
-def _run(n: int, path: str, iters: int, warmup: int, bus: str) -> dict:
-    """One sweep point → {rows_per_sec_per_process, aggregate, wire...}."""
-    argv = _worker_argv(path, iters, warmup)
+def _run(n: int, path: str, iters: int, warmup: int, bus: str,
+         compute: str = "none", force_cpu: bool = False,
+         hidden: int | None = None) -> dict:
+    """One sweep point → {rows_per_sec_per_process, aggregate, wire...}.
+
+    ``compute="jit"`` adds a real jitted model-grad step between pull and
+    push on every worker — rank 0 on the default backend (the chip when
+    alive and ``force_cpu`` is False), peers on CPU — the north-star
+    topology (accelerator workers against a sharded host PS) instead of
+    the bare control plane. ``hidden`` sizes that step's MLP."""
+    argv = _worker_argv(path, iters, warmup, compute, hidden)
+    env_extra = {}
+    if bus != "zmq":
+        env_extra["MINIPS_BUS"] = bus
+    if force_cpu:
+        env_extra["MINIPS_FORCE_CPU"] = "1"
     if n == 1:  # standalone zero-wire baseline (no launcher, no bus)
         proc = subprocess.run(argv, capture_output=True, text=True,
-                              timeout=240)
+                              timeout=240,
+                              env={**os.environ, **env_extra})
         if proc.returncode != 0:
             raise RuntimeError(f"standalone worker failed: {proc.stderr}")
         res = [json.loads([ln for ln in proc.stdout.splitlines()
@@ -52,16 +73,20 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str) -> dict:
         _PORT[0] += n + 3
         res = launch.run_local_job(
             n, argv, base_port=_PORT[0],
-            env_extra={"MINIPS_BUS": bus} if bus != "zmq" else None,
+            env_extra=env_extra or None,
             timeout=300.0)
     per = [r["rows_per_sec"] for r in res]
     wire = [r["wire_push_bytes_per_sec"] + r["wire_pull_bytes_per_sec"]
             for r in res]
-    return {
+    out = {
         "rows_per_sec_per_process": round(statistics.mean(per), 1),
         "aggregate_rows_per_sec": round(sum(per), 1),
         "wire_bytes_per_sec_per_process": round(statistics.mean(wire), 1),
     }
+    if compute != "none":
+        out["worker_compute"] = sorted({r.get("compute", "?")
+                                        for r in res})
+    return out
 
 
 def main() -> int:

@@ -41,7 +41,21 @@ def main(argv=None) -> int:
     ap.add_argument("--warmup", type=int, default=10)
     ap.add_argument("--updater", choices=["sgd", "adagrad", "adam"],
                     default="adagrad")
+    ap.add_argument("--compute", choices=["none", "jit"], default="none",
+                    help="jit: between pull and push, run a REAL jitted "
+                         "model-grad step on the pulled rows (rank 0 on "
+                         "the default backend — the chip when alive — "
+                         "peers on CPU). This measures the north-star "
+                         "topology: PS wire + accelerator worker compute "
+                         "overlapped, not the bare control plane")
+    ap.add_argument("--hidden", type=int, default=256,
+                    help="--compute jit: MLP hidden width over the "
+                         "pulled rows (the MXU work per cycle)")
     args = ap.parse_args(argv)
+    if args.compute == "jit" and args.path != "sparse":
+        # the grad step runs on pulled ROWS; the dense path never calls
+        # it — a dense rate must not get labeled as compute-overlapped
+        ap.error("--compute jit requires --path sparse")
     if args.warmup >= args.iters:
         ap.error(f"--warmup {args.warmup} must be < --iters {args.iters} "
                  "(otherwise the timer never starts and every rate is "
@@ -51,6 +65,41 @@ def main(argv=None) -> int:
 
     rank = int(os.environ.get("MINIPS_PROC_ID", "0"))
     nprocs = int(os.environ.get("MINIPS_NUM_PROCS", "1"))
+
+    grad_step = None
+    backend = "none"
+    if args.compute == "jit":
+        # one chip in this sandbox: rank 0 takes the default backend
+        # (TPU when the tunnel is alive); peers pin CPU BEFORE jax
+        # initializes — libtpu is exclusive per process
+        import jax
+
+        if rank != 0 or os.environ.get("MINIPS_FORCE_CPU"):
+            jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        backend = jax.default_backend()
+        W1 = jnp.asarray(np.random.default_rng(7).normal(
+            scale=0.05, size=(args.dim, args.hidden)), jnp.float32)
+        W2 = jnp.asarray(np.random.default_rng(8).normal(
+            scale=0.05, size=(args.hidden,)), jnp.float32)
+
+        @jax.jit
+        def _row_grads(rows, y):
+            def loss(r):
+                h = jax.nn.relu(r @ W1)
+                logit = h @ W2
+                return jnp.mean(
+                    jnp.maximum(logit, 0) - logit * y
+                    + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+            l, g = jax.value_and_grad(loss)(rows)
+            return l, g
+
+        def grad_step(rows, y):
+            # host->device, jitted fwd+bwd, device->host: the honest
+            # per-cycle cost of accelerator workers against a host PS
+            l, g = _row_grads(jnp.asarray(rows), jnp.asarray(y))
+            return np.asarray(g)
     if nprocs > 1:
         from minips_tpu.apps.common import init_multiproc
 
@@ -73,11 +122,15 @@ def main(argv=None) -> int:
     grads = rng.normal(size=(B, dim)).astype(np.float32)
     dense_grad = rng.normal(size=(args.rows, dim)).astype(np.float32)
 
+    y_lab = (rng.random(B) > 0.5).astype(np.float32)
+
     def cycle():
         if args.path == "sparse":
             keys = rng.integers(0, args.rows, size=B)
-            table.pull(keys)
-            table.push(keys, grads)
+            rows = table.pull(keys)
+            g = (grad_step(rows, y_lab) if grad_step is not None
+                 else grads)
+            table.push(keys, g)
             return 2 * B  # rows moved (pulled + pushed)
         table.pull_all()
         table.push_dense(dense_grad)
@@ -104,6 +157,8 @@ def main(argv=None) -> int:
     print(json.dumps({
         "rank": rank, "event": "done",
         "path": args.path, "nprocs": nprocs,
+        "compute": (f"jit({backend})" if args.compute == "jit"
+                    else "none"),
         "bus": os.environ.get("MINIPS_BUS", "zmq") if bus else "none",
         "rows": args.rows, "dim": args.dim, "batch": B,
         "iters_timed": timed,
