@@ -29,6 +29,18 @@ run --lm-dim 2048 --lm-depth 12 --lm-batch 16 --lm-remat --lm-remat-mode attn --
 # unmeasured (tunnel died mid-pass): candidates between the fit/OOM line
 run --lm-dim 2048 --lm-depth 8 --lm-batch 24 --lm-remat --lm-remat-mode dots --lm-head-chunk 128
 run --lm-dim 2048 --lm-depth 8 --lm-batch 8 --lm-seq 2048 --lm-remat --lm-remat-mode dots --lm-head-chunk 128
-# OOM boundary on 16 GB (RESOURCE_EXHAUSTED), do not re-run blindly:
+# round-4 optimizer-state levers (tables/updaters.py): f32 adam state is
+# what bounds the frontier (5.2 GB at 436M params). bf16 moments halve
+# it, int8 quarters it — the freed HBM buys batch (B=24/32 at the winner
+# config) and deeper/wider points that used to OOM. Run these the next
+# time the tunnel is alive; past-50%-model-MFU is the round-4 target.
+run --lm-dim 2048 --lm-depth 8 --lm-batch 16 --lm-remat --lm-remat-mode dots --lm-head-chunk 128 --lm-opt-state bf16   # state-dtype control at the winner
+run --lm-dim 2048 --lm-depth 8 --lm-batch 24 --lm-remat --lm-remat-mode dots --lm-head-chunk 128 --lm-opt-state bf16
+run --lm-dim 2048 --lm-depth 8 --lm-batch 32 --lm-remat --lm-remat-mode dots --lm-head-chunk 128 --lm-opt-state bf16
+run --lm-dim 2048 --lm-depth 8 --lm-batch 32 --lm-remat --lm-remat-mode dots --lm-head-chunk 128 --lm-opt-state int8
+run --lm-dim 2048 --lm-depth 12 --lm-batch 16 --lm-remat --lm-remat-mode dots --lm-head-chunk 128 --lm-opt-state bf16
+run --lm-dim 4096 --lm-depth 4 --lm-batch 16 --lm-remat --lm-remat-mode dots --lm-head-chunk 128 --lm-opt-state int8
+# OOM boundary on 16 GB (RESOURCE_EXHAUSTED) with f32 adam state, do not
+# re-run blindly WITHOUT an opt-state lever:
 #   d=2048x8 B=64 (any remat); d=2048x8 B=32 remat=dots/hybrid/hybrid_qkv
 #   d=2048x4 B=32 no remat; d=1024x16 B=32 no remat; d=4096x4 B=32 full remat
