@@ -124,7 +124,10 @@ def main(argv=None) -> int:
     ap.add_argument("--lr", type=float, default=None,
                     help="default: 0.3 (lr model) / 0.05 (wd)")
     ap.add_argument("--updater", default="adagrad",
-                    choices=["sgd", "adagrad", "adam"])
+                    choices=["sgd", "adagrad", "adam", "adam_bf16",
+                             "adam8"])  # dense-table paths (fused +
+    # CollectiveSSP) take the low-precision states too; the sharded-PS
+    # apps keep their numpy-twin trio and refuse these loudly
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--checkpoint-dir", default=None,
                     help="shared dir for the globally-sharded orbax "
