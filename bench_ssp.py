@@ -47,7 +47,8 @@ import sys
 def run_job(n: int, iters: int, mode: str, staleness: int, port: int,
             jitter_ms: float, jitter_prob: float, timeout: float,
             app: str = "minips_tpu.apps.ssp_lr_example",
-            extra: list[str] = ()) -> list[dict]:
+            extra: list[str] = (), env_extra: dict | None = None
+            ) -> list[dict]:
     from minips_tpu import launch
 
     return launch.run_local_job(
@@ -58,7 +59,8 @@ def run_job(n: int, iters: int, mode: str, staleness: int, port: int,
          "--jitter-ms", str(jitter_ms), "--jitter-prob", str(jitter_prob),
          *extra],
         base_port=port,
-        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                   **(env_extra or {})},
         timeout=timeout)
 
 
@@ -109,6 +111,58 @@ def simulate_schedule(n: int, iters: int, step_ms: float, staleness: int,
     return float(finish[:, iters].max()) / 1000.0
 
 
+def _run_collective(args) -> int:
+    """SSP-vs-BSP on the collective-sync path (train/ssp_spmd.py): same
+    jitter regime as the relay/sharded comparisons, but the merge is a
+    psum over the multi-process mesh and the gate is the only host-side
+    wait. The gate changes overlap, never math — both modes must land on
+    IDENTICAL losses; a divergence means a mode-dependent-math
+    regression, so the run exits nonzero (the published speedup would be
+    meaningless)."""
+    walls, finals, losses = {}, {}, {}
+    for i, (mode, s) in enumerate([("bsp", 0), ("ssp", args.staleness)]):
+        rs = run_job(
+            args.n, args.iters, mode, s,
+            args.base_port + i * (args.n + 3),
+            args.jitter_ms, args.jitter_prob, args.timeout,
+            app="minips_tpu.apps.multihost_example",
+            extra=["--sync-every", str(args.sync_every),
+                   "--batch", str(16 * args.n)],
+            env_extra={"MINIPS_MH_LOCAL_DEVICES":
+                       str(args.local_devices)})
+        walls[mode] = max(r["wall_s"] for r in rs)
+        finals[mode] = max(r["loss_last"] for r in rs)
+        losses[mode] = sorted(
+            (r["rank"], tuple(r["losses"])) for r in rs)
+        print(f"# {mode}: wall={walls[mode]:.2f}s "
+              f"loss_last={finals[mode]:.4f} "
+              f"max_skew={max(r['max_skew_seen'] for r in rs)} "
+              f"sync_rounds={rs[0]['sync_rounds']}", file=sys.stderr)
+    identical = losses["bsp"] == losses["ssp"]
+    if not identical:
+        print("# ERROR: bsp/ssp loss streams differ — the gate must "
+              "not change math; the speedup below is not trustworthy",
+              file=sys.stderr)
+    print(json.dumps({
+        "metric": "ssp_vs_bsp_wallclock_speedup (transient stalls, "
+                  f"collective-sync CollectiveSSP, {args.n} procs x "
+                  f"{args.local_devices} devices, sync_every="
+                  f"{args.sync_every}, jitter {args.jitter_ms}ms"
+                  f"@p={args.jitter_prob})",
+        "value": round(walls["bsp"] / walls["ssp"], 4),
+        "unit": "x",
+        "bsp_wall_s": walls["bsp"],
+        "ssp_wall_s": walls["ssp"],
+        "bsp_loss": round(finals["bsp"], 4),
+        "ssp_loss": round(finals["ssp"], 4),
+        "losses_identical": identical,
+        "staleness": args.staleness,
+        "sync_every": args.sync_every,
+        "compute": "cpu-loopback (the topology a pod runs on ICI/DCN)",
+    }))
+    return 0 if identical else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=3)
@@ -123,6 +177,19 @@ def main() -> int:
                          "sharded multi-process PS (sharded_ps_example, "
                          "sparse model) instead of the delta relay — "
                          "same owner-side SSP admission, server topology")
+    ap.add_argument("--collective", action="store_true",
+                    help="run the gate comparison on the COLLECTIVE-SYNC "
+                         "path (CollectiveSSP: per-process fused steps, "
+                         "psum-of-deltas merges over the multi-process "
+                         "mesh every --sync-every steps, staleness gate "
+                         "on the gossiped clocks) — the SURVEY 7.4.1 "
+                         "topology a pod would run; CPU loopback here")
+    ap.add_argument("--sync-every", type=int, default=8,
+                    help="--collective: local steps per merge (must "
+                         "exceed --staleness for the gate, not the "
+                         "collective barrier, to be what binds)")
+    ap.add_argument("--local-devices", type=int, default=2,
+                    help="--collective: fake devices per process")
     ap.add_argument("--tpu-grounded", action="store_true",
                     help="measure the chip's step time, simulate the "
                          "N-worker schedule (see module docstring)")
@@ -160,6 +227,9 @@ def main() -> int:
             "device": device,
         }))
         return 0
+
+    if args.collective:
+        return _run_collective(args)
 
     app = ("minips_tpu.apps.sharded_ps_example" if args.sharded
            else "minips_tpu.apps.ssp_lr_example")

@@ -100,6 +100,13 @@ def main(argv=None) -> int:
     ap.add_argument("--slow-ms", type=int, default=0,
                     help="straggler injection: sleep this long before "
                          "each of --slow-rank's local steps")
+    ap.add_argument("--jitter-ms", type=float, default=0.0,
+                    help="TRANSIENT stall injection on every rank "
+                         "(rank-seeded): sleep this long before a step "
+                         "with --jitter-prob — the regime where SSP's "
+                         "slack window beats BSP's stall union "
+                         "(bench_ssp --collective)")
+    ap.add_argument("--jitter-prob", type=float, default=0.0)
     ap.add_argument("--oracle-hosts", type=int, default=0,
                     help="single-process: SIMULATE this many hosts "
                          "sequentially (disjoint submeshes, same merge "

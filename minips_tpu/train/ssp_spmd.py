@@ -303,10 +303,13 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
         bus=getattr(watchdog, "bus", None),
         monitor=getattr(watchdog, "monitor", None))
     losses = []
+    jitter_rng = np.random.default_rng(1000 + rank)
     for i in range(args.iters):
         x, y = next_global()
         if args.slow_ms and rank == args.slow_rank:
             time.sleep(args.slow_ms / 1000.0)
+        if args.jitter_ms and jitter_rng.random() < args.jitter_prob:
+            time.sleep(args.jitter_ms / 1000.0)
         losses.append(trainer.step(
             {"x": x[rank * per:(rank + 1) * per],
              "y": y[rank * per:(rank + 1) * per]}))

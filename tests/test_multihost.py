@@ -295,6 +295,40 @@ def test_collective_bsp_two_process_lockstep():
 
 
 @pytest.mark.slow
+def test_collective_ssp_beats_bsp_under_transient_stalls():
+    """The SSP win measured on the COLLECTIVE-SYNC path (bench_ssp
+    --collective): with random per-rank transient stalls, BSP (s=0)
+    locksteps every local step and pays the union of all stalls, while
+    SSP's slack window absorbs them — and on this path the gate changes
+    ONLY overlap, so the loss streams must be IDENTICAL, making the
+    speedup pure wall-clock. Tolerant bound (0.95) for a loaded 1-core
+    host; bench_ssp publishes the real number (~1.2x at these knobs)."""
+    jitter = ["--jitter-ms", "40", "--jitter-prob", "0.3",
+              "--sync-every", "8", "--iters", "40", "--batch", "64"]
+    last = None
+    for attempt in range(2):  # RuntimeError-only shield: launch timeout
+        try:                  # under tier load, same policy as the
+            walls, streams, skews = {}, {}, {}   # sharded-PS smoke
+            for mode, s in [("bsp", 0), ("ssp", 4)]:
+                res = _run_multihost(
+                    2, ["--mode", mode, "--staleness", str(s)] + jitter,
+                    local_devices=2)
+                walls[mode] = max(r["wall_s"] for r in res)
+                streams[mode] = sorted((r["rank"], tuple(r["losses"]))
+                                       for r in res)
+                skews[mode] = max(r["max_skew_seen"] for r in res)
+        except RuntimeError as e:  # noqa: PERF203
+            last = e
+            print(f"attempt {attempt}: {e}")
+            continue
+        assert walls["ssp"] < walls["bsp"] * 0.95, (walls, skews)
+        assert streams["ssp"] == streams["bsp"]  # gate never changes math
+        assert skews["ssp"] <= 5  # s + 1
+        return
+    raise last
+
+
+@pytest.mark.slow
 def test_two_process_loss_parity_with_single_process():
     """2 processes x 4 devices must train EXACTLY like 1 process x 8
     devices on the same global batch stream — the distributed data plane
