@@ -97,13 +97,18 @@ def _flags(parser):
                              "(jax.checkpoint): depth stops driving peak "
                              "HBM — fits larger --dim/--depth (dp layout)")
     parser.add_argument("--attn", default="reference",
-                        choices=["reference", "flash"],
+                        choices=["reference", "flash", "a2a",
+                                 "a2a_flash"],
                         help="dp/sp layout attention: full-scores XLA or "
                              "the fused O(T)-memory flash kernels "
                              "(ops/flash_attention.py; on sp this is ring "
                              "flash attention) — the win is at long "
                              "--seq_len, where full scores thrash or OOM "
-                             "HBM")
+                             "HBM. a2a / a2a_flash (sp only): all-to-all "
+                             "sequence parallelism (Ulysses-style, "
+                             "parallel/a2a_attention.py) — two "
+                             "collectives per attention and a fully "
+                             "LOCAL kernel; needs heads %% devices == 0")
     parser.add_argument("--accum", type=int, default=1,
                         help="dp/sp: gradient-accumulation microbatches "
                              "per step (effective batch = batch_size, "
@@ -228,6 +233,12 @@ def _updater_kwargs(cfg, args, params):
 def run(cfg: Config, args, metrics) -> dict:
     seq_len = getattr(args, "seq_len", 128)
     layout = getattr(args, "layout", "dp")
+    if (getattr(args, "attn", "reference") in ("a2a", "a2a_flash")
+            and layout != "sp"):
+        # a2a IS a sequence-parallel strategy; on dp there is no sequence
+        # sharding to exchange
+        raise SystemExit("--attn a2a/a2a_flash is sequence parallelism: "
+                         f"use --layout sp (got {layout})")
     # These flags only thread through the dp/sp fused-step path; failing
     # loud beats silently training with different memory/perf/precision
     # than requested on tp/pp/ep.

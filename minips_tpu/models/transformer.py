@@ -377,11 +377,19 @@ def apply_sp(params, tokens_local, shift, *, heads=4, axis_name=DATA_AXIS,
     """Sequence-parallel logits for a local token shard [B, T_local].
 
     Call inside ``shard_map``: ``shift`` is this shard's global sequence
-    offset (``axis_index * T_local``); attention is a causal ring over
-    ``axis_name``. Full params, sharded activations — sequence parallelism
-    in its pure form. ``attn_impl="flash"`` runs each ring step through the
-    offset-masked flash kernel (ops/flash_attention.py) instead of the
-    full per-step score block.
+    offset (``axis_index * T_local``); full params, sharded activations —
+    sequence parallelism in its pure form. Two SP strategies x two
+    attention impls (``attn_impl``):
+
+    - ``"reference"`` / ``"flash"`` — causal RING over ``axis_name``
+      (K/V rotate via ppermute; flash = the offset-masked kernel per
+      ring step). O(T/N) K/V memory; any head count.
+    - ``"a2a"`` / ``"a2a_flash"`` — ALL-TO-ALL re-shard to head groups
+      with the full sequence local (parallel/a2a_attention.py, Ulysses
+      lineage): two collectives total, attention fully local (a2a_flash
+      = the fused kernel at full rate, no ring bookkeeping). Needs
+      ``heads`` divisible by the axis size. RoPE rotates before the
+      exchange, so positions stay correct.
     """
     T_local = tokens_local.shape[1]
     pos = shift + jnp.arange(T_local)
@@ -394,9 +402,19 @@ def apply_sp(params, tokens_local, shift, *, heads=4, axis_name=DATA_AXIS,
     elif attn_impl == "reference":
         attn = lambda q, k, v: ring_attention_local(  # noqa: E731
             q, k, v, axis_name=axis_name, causal=True)
+    elif attn_impl in ("a2a", "a2a_flash"):
+        from minips_tpu.parallel.a2a_attention import a2a_attention_local
+
+        inner = None
+        if attn_impl == "a2a_flash":
+            from minips_tpu.ops.flash_attention import flash_attention
+
+            inner = flash_attention  # causal/scale threaded by a2a
+        attn = lambda q, k, v: a2a_attention_local(  # noqa: E731
+            q, k, v, axis_name=axis_name, causal=True, inner=inner)
     else:
-        raise ValueError(f"unknown attn_impl {attn_impl!r} "
-                         "(expected 'reference' or 'flash')")
+        raise ValueError(f"unknown attn_impl {attn_impl!r} (expected "
+                         "'reference', 'flash', 'a2a', or 'a2a_flash')")
     return _forward(params, tokens_local, pos, heads, attn,
                     compute_dtype, remat=remat)[0]
 
