@@ -122,6 +122,15 @@ def main(argv=None) -> int:
                          "slice, the K/V ring ppermutes cross the "
                          "process boundary (long-context x multi-host)")
     ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--sp-attn", default="reference",
+                    choices=["reference", "flash", "a2a", "a2a_flash"],
+                    help="lm model: the sequence-parallel strategy over "
+                         "the GLOBAL mesh — ring (reference/flash, K/V "
+                         "ppermute hops cross the process boundary) or "
+                         "all-to-all (a2a/a2a_flash: the head/sequence "
+                         "exchange crosses it instead; needs heads "
+                         "divisible by the global device count — the "
+                         "model auto-widens to that head count)")
     ap.add_argument("--num-slots", type=int, default=1 << 14)
     ap.add_argument("--batch", type=int, default=64,
                     help="GLOBAL batch size (split across processes)")
@@ -330,12 +339,22 @@ def _run_lm_sp(args, mesh, rank, nprocs, multi, watchdog):
     if T % n_shards:
         raise SystemExit(f"--seq-len {T} must divide by the {n_shards}-"
                          "device global mesh")
-    model = dict(vocab=64, dim=32, heads=2, depth=2, max_len=T)
+    heads = 2
+    if args.sp_attn in ("a2a", "a2a_flash"):
+        # all-to-all shards HEAD groups over the global mesh: widen to
+        # one head per device
+        heads = n_shards
+    # dim must divide by heads AND keep head_dim >= 4; a plain
+    # max(32, 4*heads) breaks divisibility for device counts that don't
+    # divide 32 (e.g. a 2x3 mesh -> heads 6)
+    model = dict(vocab=64, dim=heads * max(4, -(-32 // heads)),
+                 heads=heads, depth=2, max_len=T)
     params = tfm.init(jax.random.PRNGKey(args.seed), **model)
     dt = DenseTable(params, mesh, updater=args.updater, lr=args.lr,
                     name="lm_sp")
     T_local = T // n_shards
-    sp_grad, sp_spec = tfm.sp_train_wiring(model["heads"], T_local)
+    sp_grad, sp_spec = tfm.sp_train_wiring(model["heads"], T_local,
+                                           attn_impl=args.sp_attn)
     step = dt.make_step(sp_grad, batch_spec=sp_spec)
     seq_spec = P(None, DATA_AXIS)
     B = args.batch
@@ -365,6 +384,7 @@ def _run_lm_sp(args, mesh, rank, nprocs, multi, watchdog):
         "global_devices": n_shards,
         "local_devices": len(jax.local_devices()),
         "seq_len": T, "seq_local": hi - lo,
+        "sp_attn": args.sp_attn, "heads": heads,
         "loss_first": losses[0], "loss_last": losses[-1],
         "losses": [round(x, 8) for x in losses],
         "param_fingerprint": fp,
