@@ -71,36 +71,80 @@ def _tpu_responsive(timeout_s: float = 180.0) -> tuple[bool, bool]:
     normally).
 
     Returns ``(ok, permanent)``: ``permanent=True`` when the failure is
-    deterministic absence (the subprocess came back FAST with the
-    backend assert — no TPU runtime registered on this host), which the
-    retry window must not burn ~600s on. A timeout or a slow crash is
-    the flapping-tunnel shape and stays retryable."""
+    deterministic absence (no TPU platform registered with jax on this
+    host at all), which the retry window must not burn ~600s on. A
+    timeout, an init failure, or a crash is the flapping-tunnel shape
+    and stays retryable.
+
+    The CLASSIFICATION happens inside the probe subprocess itself, which
+    emits one of three sentinels (ADVICE r4 low: the old parent-side
+    heuristic parsed jax's stderr for exact message substrings plus a
+    wall-clock bound — a jax version changing either message would
+    either stall TPU-less hosts the full window or write a flapping
+    tunnel off as permanent):
+
+    - ``MINIPS_PROBE_OK``          — chip answered a real matmul
+    - ``MINIPS_PROBE_NO_TPU``      — ``jax.devices('tpu')`` says no such
+      platform exists here (deterministic absence → permanent)
+    - ``MINIPS_PROBE_INIT_FAILED`` — a TPU platform exists but failed to
+      initialize (flap shape → retryable)"""
     import subprocess
 
-    code = ("import jax, jax.numpy as jnp;"
-            "assert jax.default_backend() == 'tpu', jax.default_backend();"
-            "x = jnp.ones((8, 8));"
-            "jax.block_until_ready(x @ x);"
-            "print('ok')")
-    t0 = time.time()
+    code = (
+        "import sys\n"
+        "import jax, jax.numpy as jnp\n"
+        "def ok(ds):\n"
+        "    x = jax.device_put(jnp.ones((8, 8)), ds[0])\n"
+        "    jax.block_until_ready(x @ x)\n"
+        "    print('MINIPS_PROBE_OK')\n"
+        "try:\n"
+        "    ds = jax.devices('tpu')\n"
+        "except RuntimeError as e:\n"
+        "    # an alive accelerator registered under a non-'tpu' platform\n"
+        "    # name must still count as OK: this sandbox's plugin\n"
+        "    # registers platform name 'axon' (jax logs \\\"Platform\n"
+        "    # 'axon' is experimental\\\"). But ONLY tpu-ish platforms —\n"
+        "    # a CUDA/METAL host must not masquerade as a chip in the\n"
+        "    # captured artifact\n"
+        "    if jax.default_backend() != 'cpu':\n"
+        "        tds = [d for d in jax.devices()\n"
+        "               if 'tpu' in d.platform.lower()\n"
+        "               or 'axon' in d.platform.lower()]\n"
+        "        if tds:\n"
+        "            ok(tds)\n"
+        "            sys.exit(0)\n"
+        "    # jax raises RuntimeError both when no tpu platform exists\n"
+        "    # and when one failed to init; only DETERMINISTIC absence\n"
+        "    # is permanent. The distinction is made HERE, in the\n"
+        "    # subprocess, against the exception for the 'tpu' request\n"
+        "    # we made — not by the parent parsing whatever jax logged\n"
+        "    # while falling back. jax registers a 'tpu' factory\n"
+        "    # unconditionally, so on a TPU-less host the shape is\n"
+        "    # 'failed to initialize: <libtpu IMPORT error>'. Only the\n"
+        "    # module-import family counts as absent — a device-file or\n"
+        "    # tunnel error ('could not open /dev/accel0: no such\n"
+        "    # file', gRPC 'not found') is a restartable-runtime flap\n"
+        "    # and must stay retryable.\n"
+        "    msg = str(e).lower()\n"
+        "    absent = ('unknown backend' in msg or 'no platforms' in msg\n"
+        "              or 'no module named' in msg\n"
+        "              or ('libtpu' in msg and any(s in msg for s in (\n"
+        "                  'cannot open shared object', 'not installed',\n"
+        "                  'no such file'))))\n"
+        "    print('MINIPS_PROBE_NO_TPU' if absent\n"
+        "          else 'MINIPS_PROBE_INIT_FAILED')\n"
+        "    print(repr(e), file=sys.stderr)\n"
+        "    sys.exit(3)\n"
+        "ok(ds)\n")
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code], timeout=timeout_s,
             capture_output=True, text=True)
     except subprocess.TimeoutExpired:
         return False, False
-    if proc.returncode == 0 and "ok" in proc.stdout:
+    if proc.returncode == 0 and "MINIPS_PROBE_OK" in proc.stdout:
         return True, False
-    # fast backend-assert = jax silently fell back to cpu. That is
-    # deterministic absence ONLY if no TPU plugin tried and failed to
-    # initialize — a flapping tunnel can also fail init FAST (not just
-    # hang), and jax then logs "Unable to initialize backend" before
-    # falling back; that shape must stay retryable or a momentary flap
-    # would skip the whole window this probe exists to provide.
-    permanent = (time.time() - t0 < 30.0
-                 and "AssertionError" in proc.stderr
-                 and "Unable to initialize backend" not in proc.stderr)
-    return False, permanent
+    return False, "MINIPS_PROBE_NO_TPU" in proc.stdout
 
 
 def _default_probe_window() -> float:
@@ -149,12 +193,14 @@ def _tpu_available(window_s: float | None = None) -> bool:
                       file=sys.stderr)
             return True
         if permanent:
-            # no TPU runtime on this host at all (fast backend-assert
-            # failure): retrying is futile — fall back now instead of
-            # stalling a TPU-less machine ~window seconds at startup
-            print(f"bench: no TPU backend on this host (probe attempt "
-                  f"{attempt} failed fast, {took:.0f}s); not retrying",
-                  file=sys.stderr)
+            # the probe subprocess classified the failure as
+            # deterministic absence (MINIPS_PROBE_NO_TPU: no tpu-ish
+            # platform, libtpu not installed): retrying is futile — fall
+            # back now instead of stalling a TPU-less machine ~window
+            # seconds at startup
+            print(f"bench: no TPU runtime on this host (probe attempt "
+                  f"{attempt} reported deterministic absence, "
+                  f"{took:.0f}s); not retrying", file=sys.stderr)
             return False
         remaining = deadline - time.time()
         if remaining <= 0:

@@ -36,7 +36,8 @@ from jax.sharding import PartitionSpec as P
 
 from minips_tpu.parallel.mesh import DATA_AXIS, padded_size
 from minips_tpu.parallel.partition import RangePartitioner
-from minips_tpu.tables.updaters import LearningRate, make_updater
+from minips_tpu.tables.updaters import (Adam8bitState, LearningRate,
+                                        make_updater, masked_merge_adam8)
 
 PyTree = Any
 
@@ -114,29 +115,55 @@ class DenseTable:
         self.params = jax.device_put(padded_flat, self._sharding)
 
         opt_state = jax.eval_shape(self.tx.init, self.params)
+        a8 = [x for x in jax.tree.leaves(
+                  opt_state, is_leaf=lambda l: isinstance(l, Adam8bitState))
+              if isinstance(x, Adam8bitState)]
+        block = a8[0].mu_q.shape[0] // a8[0].mu_s.shape[0] if a8 else 0
+        if block and self._shard_shape[0] % block:
+            raise ValueError(
+                f"quantized opt state with block={block} does not align "
+                f"with shard size {self._shard_shape[0]}: each contiguous "
+                "range shard must hold whole blocks (use updater='adam8' "
+                "so the table aligns its padding, or pick a block that "
+                "divides the shard size)")
+        self._opt_specs = self._opt_specs_tree(opt_state)
         opt_shardings = jax.tree.map(
-            lambda l: NamedSharding(mesh, self._opt_spec_for(l)), opt_state)
-        # Note: specs below describe the *global* opt leaves; inside shard_map
+            lambda s: NamedSharding(mesh, s), self._opt_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        # Note: specs describe the *global* opt leaves; inside shard_map
         # sharded leaves have the per-shard shape.
         self.opt_state = jax.jit(
             self.tx.init, out_shardings=opt_shardings
         )(self.params)
-        self._opt_specs = jax.tree.map(self._opt_spec_for, opt_state)
 
-    def _opt_spec_for(self, leaf) -> P:
-        """Range-shard params-length opt leaves AND their sub-padded
-        companions (e.g. adam8's one-scale-per-256-elements arrays):
-        contiguous range shards hold whole blocks, so a 1-D leaf whose
-        length divides ``padded`` and splits evenly over the shards
-        slices in alignment with the params inside shard_map. Scalars
-        (adam's count) and anything else stay replicated."""
-        if leaf.ndim == 1 and leaf.shape[0] == self.padded:
-            return P(DATA_AXIS)
-        if (leaf.ndim == 1 and leaf.shape[0] > 1
-                and self.padded % leaf.shape[0] == 0
-                and leaf.shape[0] % self.num_shards == 0):
-            return P(DATA_AXIS)
-        return P()
+    def _opt_specs_tree(self, opt_state) -> PyTree:
+        """Spec tree for the opt state: params-length 1-D leaves range-
+        shard; an ``Adam8bitState``'s OWN scale fields (``mu_s``/``nu_s``)
+        are tagged structurally — by position in that state, never by
+        shape inference (ADVICE r4 low: a foreign 1-D leaf that happens
+        to length-match padded/block must stay replicated, or shard_map
+        would silently hand its transform a slice). Scalars (adam's
+        count) and everything else stay replicated. Works for
+        updater='adam8' and for a user-supplied quantized tx alike."""
+        def leaf_spec(leaf) -> P:
+            if getattr(leaf, "ndim", None) == 1 \
+                    and leaf.shape[0] == self.padded:
+                return P(DATA_AXIS)
+            return P()
+
+        def node_spec(x):
+            if isinstance(x, Adam8bitState):
+                # codes are params-length (leaf rule would shard them
+                # anyway); scales are tagged BECAUSE they are this
+                # state's scales — contiguous range shards hold whole
+                # blocks, so they slice in alignment with the codes
+                return Adam8bitState(P(), P(DATA_AXIS), P(DATA_AXIS),
+                                     P(DATA_AXIS), P(DATA_AXIS))
+            return leaf_spec(x)  # the outer map decomposed other nodes
+
+        return jax.tree.map(
+            node_spec, opt_state,
+            is_leaf=lambda x: isinstance(x, Adam8bitState))
 
     # ------------------------------------------------------------------ pull
     def pull(self) -> PyTree:
@@ -205,10 +232,21 @@ class DenseTable:
             if masked:
                 m = mask[0]
                 updates = updates * m
+
+                def restore(new, old):
+                    # quantized moments restore at BLOCK granularity —
+                    # an elementwise where() on the codes alone leaves
+                    # them paired with recomputed scales (ADVICE r4
+                    # medium: silent moment drift on untouched keys)
+                    if isinstance(new, Adam8bitState):
+                        return masked_merge_adam8(new, old, m)
+                    return (jnp.where(m > 0, new, old)
+                            if getattr(new, "shape", ()) == vec_shard
+                            else new)
+
                 new_opt = jax.tree.map(
-                    lambda new, old: jnp.where(m > 0, new, old)
-                    if getattr(new, "shape", ()) == vec_shard else new,
-                    new_opt, opt_shard)
+                    restore, new_opt, opt_shard,
+                    is_leaf=lambda x: isinstance(x, Adam8bitState))
             return optax.apply_updates(p_shard, updates), new_opt
 
         return jax.jit(

@@ -181,6 +181,51 @@ def _dequantize_block(q, s, block: int, signed: bool = True):
             * s[:, None]).reshape(-1)
 
 
+def masked_merge_adam8(new_state: "Adam8bitState",
+                       old_state: "Adam8bitState",
+                       mask) -> "Adam8bitState":
+    """Block-granular masked restore for quantized moments (ADVICE r4
+    medium): an elementwise ``where(mask, new, old)`` restores adam8's
+    CODES but cannot restore the per-block SCALES they are meaningless
+    without — untouched keys' dequantized moments would silently change
+    (pure-decay drift where a whole block is untouched; arbitrary rescale
+    in blocks mixing touched and untouched keys). Correct semantics per
+    block:
+
+    - no touched key in the block → restore codes AND scale exactly
+      (bit-identical moments);
+    - mixed block → merge in f32 (dequantize both states, select by
+      mask) and re-quantize the merged block; untouched keys in such a
+      block take one extra quantize round-trip, bounded by the codebook's
+      ~±5.6% relative error — never a rescale against a foreign absmax.
+
+    ``block`` is inferred from the state itself (codes are params-length,
+    scales are one-per-block), so this works on any shard slice."""
+    import jax.numpy as jnp
+
+    block = new_state.mu_q.shape[0] // new_state.mu_s.shape[0]
+    m = jnp.where(
+        mask > 0,
+        _dequantize_block(new_state.mu_q, new_state.mu_s, block),
+        _dequantize_block(old_state.mu_q, old_state.mu_s, block))
+    v = jnp.where(
+        mask > 0,
+        _dequantize_block(new_state.nu_q, new_state.nu_s, block,
+                          signed=False),
+        _dequantize_block(old_state.nu_q, old_state.nu_s, block,
+                          signed=False))
+    mq, ms = _quantize_block(m, block)
+    vq, vs = _quantize_block(v, block, signed=False)
+    touched = mask.reshape(-1, block).max(axis=1) > 0
+    telem = jnp.repeat(touched, block)
+    return Adam8bitState(
+        new_state.count,
+        jnp.where(telem, mq, old_state.mu_q),
+        jnp.where(touched, ms, old_state.mu_s),
+        jnp.where(telem, vq, old_state.nu_q),
+        jnp.where(touched, vs, old_state.nu_s))
+
+
 def scale_by_adam_8bit(b1: float = 0.9, b2: float = 0.999,
                        eps: float = 1e-8,
                        block: int = 256) -> optax.GradientTransformation:

@@ -111,6 +111,39 @@ def test_sharded_ps_bench_floor_two_processes():
         assert r["wire_push_bytes_per_sec"] > 0  # wire actually engaged
 
 
+def test_tpu_probe_sentinel_classification(monkeypatch):
+    """ADVICE r4 low: the probe's permanent-vs-retryable call keys on
+    sentinels the probe SUBPROCESS emits, not on parsing jax's stderr in
+    the parent with a wall-clock bound. Absent platform → permanent;
+    init failure, crash, or hang → retryable."""
+    import types
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    def fake(stdout, rc):
+        def run(cmd, timeout=None, capture_output=None, text=None):
+            return types.SimpleNamespace(returncode=rc, stdout=stdout,
+                                         stderr="")
+        return run
+
+    monkeypatch.setattr("subprocess.run", fake("MINIPS_PROBE_OK\n", 0))
+    assert bench._tpu_responsive(5) == (True, False)
+    monkeypatch.setattr("subprocess.run", fake("MINIPS_PROBE_NO_TPU\n", 3))
+    assert bench._tpu_responsive(5) == (False, True)
+    monkeypatch.setattr("subprocess.run",
+                        fake("MINIPS_PROBE_INIT_FAILED\n", 3))
+    assert bench._tpu_responsive(5) == (False, False)
+    monkeypatch.setattr("subprocess.run", fake("", 1))  # raw crash
+    assert bench._tpu_responsive(5) == (False, False)
+
+    def hang(cmd, timeout=None, capture_output=None, text=None):
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr("subprocess.run", hang)
+    assert bench._tpu_responsive(5) == (False, False)
+
+
 def test_ssp_schedule_simulation_invariants():
     """The event-driven gate schedule (bench_ssp.simulate_schedule) obeys
     the theory: BSP pays the union of stalls, staleness only helps, zero
