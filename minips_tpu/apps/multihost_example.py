@@ -205,11 +205,23 @@ def main(argv=None) -> int:
     rng = np.random.default_rng(args.seed)
 
     if args.mode != "fused":
-        if args.model != "lr":
-            raise SystemExit("--mode bsp/ssp/asp runs the lr model")
-        from minips_tpu.train.ssp_spmd import run_ssp_spmd
+        # the staleness axis covers the flagship workloads, not just LR:
+        # lr = dense CollectiveSSP (+ the bitwise oracle), wd = row-sparse
+        # CollectiveSSPPS over the DeepFM tables, lm = dense CollectiveSSP
+        # over the transformer (per-process DP islands)
+        if args.model == "lr":
+            from minips_tpu.train.ssp_spmd import run_ssp_spmd
 
-        return run_ssp_spmd(args, rank, nprocs, multi, watchdog)
+            return run_ssp_spmd(args, rank, nprocs, multi, watchdog)
+        if args.oracle_hosts:
+            raise SystemExit("--oracle-hosts is the lr model's bitwise "
+                             "oracle; wd/lm assert replica agreement "
+                             "via fingerprints instead")
+        from minips_tpu.train.cssp_ps import run_lm_cssp, run_wd_cssp
+
+        if args.model == "wd":
+            return run_wd_cssp(args, rank, nprocs, multi, watchdog)
+        return run_lm_cssp(args, rank, nprocs, multi, watchdog)
     if args.model == "wd":
         return _run_wd(args, mesh, rank, nprocs, per, multi, rng,
                        watchdog)

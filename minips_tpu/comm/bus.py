@@ -400,3 +400,90 @@ class ClockGossip:
             vals = [c for p, v in self._clocks.items()
                     if v and p not in self._excluded for c in v]
             return (max(vals) - min(vals)) if vals else 0
+
+
+class BlobExchange:
+    """Host-side allgather of one ndarray per process per (round, tag).
+
+    The touched-row UNION exchange for row-sparse collective syncs
+    (train/cssp_ps.py): before each merge round every process publishes
+    the slot ids its local steps touched; every process then holds the
+    same per-rank arrays and computes the same sorted union — the index
+    set the batch-rows-sized delta collective runs over. Arrays ride the
+    bus's binary blob frame (no base64 inflation); the JSON head carries
+    (round, tag, dtype).
+
+    Early arrivals PARK in the store until consumed: under SSP skew a
+    fast process may receive a peer's round-r+1 array while still
+    draining round r — keying the store by (round, tag, sender) makes
+    that reordering harmless. A timed-out wait consults the heartbeat
+    monitor so a dead peer raises PeerFailureError instead of hanging
+    forever (the staleness gate's contract, SURVEY.md §5.3)."""
+
+    KIND = "blobx"
+
+    def __init__(self, bus: ControlBus, num_processes: int):
+        self.bus = bus
+        self.n = int(num_processes)
+        self._store: dict = {}
+        self._cond = threading.Condition()
+        bus.on(self.KIND, self._on)
+
+    def _on(self, sender: int, payload: dict) -> None:
+        import numpy as np
+
+        raw = payload.get("__blob__") or b""
+        arr = np.frombuffer(raw, dtype=np.dtype(payload["dtype"])).copy()
+        with self._cond:
+            self._store[(int(payload["round"]), str(payload["tag"]),
+                         sender)] = arr
+            self._cond.notify_all()
+
+    def allgather(self, rnd: int, tag: str, arr, *,
+                  timeout: float = 120.0, monitor=None) -> list:
+        """Every process's array for (rnd, tag), ordered by rank (mine
+        included). All processes must call this together — it blocks for
+        the peers, like the collective it fronts."""
+        import numpy as np
+
+        arr = np.ascontiguousarray(arr)
+        self.bus.publish(self.KIND, {"round": int(rnd), "tag": str(tag),
+                                     "dtype": str(arr.dtype)},
+                         blob=arr.tobytes())
+        out: list = [None] * self.n
+        out[self.bus.my_id] = arr
+        peers = [p for p in range(self.n) if p != self.bus.my_id]
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                missing = [p for p in peers
+                           if (rnd, tag, p) not in self._store]
+                if not missing:
+                    for p in peers:
+                        out[p] = self._store.pop((rnd, tag, p))
+                    return out
+                quiet = not self._cond.wait(timeout=1.0)
+                if quiet and monitor is not None:
+                    dead = monitor.check()
+                    if dead:
+                        self._purge(rnd, tag)
+                        from minips_tpu.consistency.gate import \
+                            PeerFailureError
+                        raise PeerFailureError(dead)
+                # the deadline binds even while OTHER traffic keeps the
+                # cond busy (a peer's next-round publishes must not let
+                # this wait overshoot its timeout indefinitely)
+                if time.monotonic() > deadline:
+                    self._purge(rnd, tag)
+                    raise TimeoutError(
+                        f"BlobExchange round {rnd} tag {tag!r}: "
+                        f"peers {missing} never arrived")
+
+    def _purge(self, rnd: int, tag: str) -> None:
+        """Drop this round/tag's parked arrivals on a failed gather —
+        the caller will not come back for them (recovery relaunches with
+        fresh state), and repeated partial failures must not grow the
+        store without bound. Caller holds the cond lock."""
+        for key in [k for k in self._store
+                    if k[0] == rnd and k[1] == tag]:
+            del self._store[key]

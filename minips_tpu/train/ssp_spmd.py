@@ -53,7 +53,7 @@ from minips_tpu.consistency.gate import StalenessGate, publish_clock
 from minips_tpu.parallel.mesh import DATA_AXIS
 from minips_tpu.tables.dense import DenseTable
 
-__all__ = ["CollectiveSSP"]
+__all__ = ["CollectiveSSP", "SyncPlane", "make_control"]
 
 PyTree = Any
 
@@ -62,6 +62,89 @@ def _process_local_devices(all_devices, proc_index):
     """The global view of one process's devices, in the order every
     process can reconstruct (jax.devices() is globally ordered)."""
     return [d for d in all_devices if d.process_index == proc_index]
+
+
+class SyncPlane:
+    """The (proc, local) global mesh + the jitted psum-over-proc merge —
+    the collective sync plumbing shared by every CollectiveSSP-family
+    trainer (dense vector deltas here; row-sparse blocks in
+    train/cssp_ps.py ride the same plane with different lengths — the
+    one jitted merge retraces per shape/dtype, so callers round lengths
+    to powers of two to keep the compile count small)."""
+
+    def __init__(self):
+        all_devs = list(jax.devices())
+        self.nprocs = jax.process_count()
+        me = jax.process_index()
+        mine = _process_local_devices(all_devs, me)
+        if mine != list(jax.local_devices()):
+            # the (proc, local) sync mesh below assumes the global device
+            # order restricted to one process IS that process's local
+            # order; true for every backend here, but a silent mismatch
+            # would scatter delta shards to wrong columns
+            raise RuntimeError("jax.devices() per-process order differs "
+                               "from jax.local_devices() — sync mesh "
+                               "construction needs them equal")
+        self.local_mesh = Mesh(np.asarray(mine), (DATA_AXIS,))
+        self.n_local = len(mine)
+        grid = np.array(
+            [_process_local_devices(all_devs, p)
+             for p in range(self.nprocs)])
+        self.mesh = Mesh(grid, ("proc", "local"))
+        self._gspec = NamedSharding(self.mesh, P("proc", "local"))
+
+        def merge(block):             # [1, length/L] on each device
+            return jax.lax.psum(block, "proc")
+
+        self._merge = jax.jit(jax.shard_map(
+            merge, mesh=self.mesh,
+            in_specs=P("proc", "local"), out_specs=P(None, "local")))
+
+    def allreduce_sum(self, vec: jax.Array) -> jax.Array:
+        """Sum a local-mesh-sharded vector across processes: local shards
+        become one ROW of the (nprocs, length) global array device-to-
+        device (no host copy), the psum's replica groups cross the
+        process boundary (DCN on a pod), and the replicated result maps
+        back to a local-mesh vector with the caller's sharding."""
+        n = int(vec.shape[0])
+        shards = sorted(vec.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        rows = [s.data.reshape(1, -1) for s in shards]
+        garr = jax.make_array_from_single_device_arrays(
+            (self.nprocs, n), self._gspec, rows)
+        merged = self._merge(garr)
+        cols = sorted(merged.addressable_shards,
+                      key=lambda s: s.index[1].start or 0)
+        return jax.make_array_from_single_device_arrays(
+            (n,), vec.sharding, [s.data.reshape(-1) for s in cols])
+
+    def sync_hlo(self, length: int, dtype=jnp.float32) -> str:
+        """Compiled HLO of the merge at this length — the comm_analysis
+        hook: tests/smokes assert the cross-host sync IS a collective op
+        (and, for the row-sparse plane, that its operand is union-sized,
+        not table-sized)."""
+        shape = jax.ShapeDtypeStruct((self.nprocs, length), dtype,
+                                     sharding=self._gspec)
+        return self._merge.lower(shape).compile().as_text()
+
+
+def staleness_for(mode: str, ssp_staleness: int) -> float:
+    """The one mode→staleness encoding (bsp pins 0, asp pins inf) shared
+    by every CollectiveSSP-family runner — lr, wd, and lm must not be
+    able to drift on what a mode means."""
+    return {"bsp": 0, "ssp": ssp_staleness, "asp": float("inf")}[mode]
+
+
+def make_control(bus, nprocs: int, staleness: float, *,
+                 monitor=None, timeout: float = 60.0):
+    """(gossip, gate) for the host-side consistency control plane, or
+    (None, None) when single-process or bus-less — callers enforce their
+    own bus-requirement rules before this."""
+    if bus is None or nprocs <= 1:
+        return None, None
+    gossip = ClockGossip(bus, nprocs, workers_per_process=1)
+    return gossip, StalenessGate(gossip, staleness, timeout=timeout,
+                                 monitor=monitor)
 
 
 class CollectiveSSP:
@@ -117,35 +200,13 @@ class CollectiveSSP:
                 "staleness/sync alignment")
 
         # ---- local data plane: the fused step on MY devices only -----
-        all_devs = list(jax.devices())
-        mine = _process_local_devices(all_devs, self._me)
-        if mine != list(jax.local_devices()):
-            # the (proc, local) sync mesh below assumes the global device
-            # order restricted to one process IS that process's local
-            # order; true for every backend here, but a silent mismatch
-            # would scatter delta shards to wrong columns
-            raise RuntimeError("jax.devices() per-process order differs "
-                               "from jax.local_devices() — sync mesh "
-                               "construction needs them equal")
-        self.local_mesh = Mesh(np.asarray(mine), (DATA_AXIS,))
+        self.plane = SyncPlane()
+        self.local_mesh = self.plane.local_mesh
+        self.sync_mesh = self.plane.mesh
         self.table = DenseTable(template, self.local_mesh, name=name,
                                 updater=updater, lr=lr)
         self._step = self.table.make_step(grad_fn)
-        self._n_local = len(mine)
-
-        # ---- global sync plane: (proc, local) mesh + psum over proc --
-        grid = np.array(
-            [_process_local_devices(all_devs, p)
-             for p in range(self.nprocs)])
-        self.sync_mesh = Mesh(grid, ("proc", "local"))
-        self._gspec = NamedSharding(self.sync_mesh, P("proc", "local"))
-
-        def merge(delta_block):       # [1, padded/L] on each device
-            return jax.lax.psum(delta_block, "proc")
-
-        self._merge = jax.jit(jax.shard_map(
-            merge, mesh=self.sync_mesh,
-            in_specs=P("proc", "local"), out_specs=P(None, "local")))
+        self._n_local = self.plane.n_local
 
         self._copy = jax.jit(jnp.copy)
         # params = base + sum_of_deltas; base snapshot is refreshed to a
@@ -159,13 +220,9 @@ class CollectiveSSP:
         self.clock = 0
         self.sync_rounds = 0
         self._synced_at = 0  # clock of the last merge (finalize idempotence)
-        self._gate = None
-        if bus is not None and self.nprocs > 1:
-            self.gossip = ClockGossip(bus, self.nprocs,
-                                      workers_per_process=1)
-            self._gate = StalenessGate(self.gossip, staleness,
-                                       timeout=gate_timeout,
-                                       monitor=monitor)
+        self.gossip, self._gate = make_control(
+            bus, self.nprocs, staleness, monitor=monitor,
+            timeout=gate_timeout)
 
     # ------------------------------------------------------------ metrics
     @property
@@ -181,32 +238,12 @@ class CollectiveSSP:
         return self.table.pull()
 
     # ------------------------------------------------------------- plumbing
-    def _to_sync_plane(self, delta) -> jax.Array:
-        """My local delta vector -> one ROW of the (nprocs, padded) global
-        array, device-to-device (each local shard becomes its column
-        block; no host copy)."""
-        shards = sorted(delta.addressable_shards,
-                        key=lambda s: s.index[0].start or 0)
-        rows = [s.data.reshape(1, -1) for s in shards]
-        return jax.make_array_from_single_device_arrays(
-            (self.nprocs, self.table.padded), self._gspec, rows)
-
-    def _from_sync_plane(self, merged) -> jax.Array:
-        """The replicated merge result back to a local-mesh vector."""
-        shards = sorted(merged.addressable_shards,
-                        key=lambda s: s.index[1].start or 0)
-        cols = [s.data.reshape(-1) for s in shards]
-        return jax.make_array_from_single_device_arrays(
-            (self.table.padded,), self.table.params.sharding, cols)
-
     def sync_hlo(self) -> str:
         """Compiled HLO of the sync program — the comm_analysis hook: the
         test/smoke asserts the cross-host sync IS a collective op (and
         nothing else ever leaves the process on the data plane)."""
-        shape = jax.ShapeDtypeStruct(
-            (self.nprocs, self.table.padded),
-            self.table.params.dtype, sharding=self._gspec)
-        return self._merge.lower(shape).compile().as_text()
+        return self.plane.sync_hlo(self.table.padded,
+                                   self.table.params.dtype)
 
     # ------------------------------------------------------------------ api
     def step(self, batch) -> float:
@@ -237,8 +274,8 @@ class CollectiveSSP:
         The all-reduce is the rendezvous: a fast host blocks HERE (inside
         XLA, on the DCN plane) until every process launches the round."""
         delta = self._delta(self.table.params, self._base)
-        merged = self._merge(self._to_sync_plane(delta))
-        new_params = self._apply(self._base, self._from_sync_plane(merged))
+        merged = self.plane.allreduce_sum(delta)
+        new_params = self._apply(self._base, merged)
         self.table.params = new_params
         self._base = self._copy(new_params)
         self.sync_rounds += 1
@@ -274,8 +311,7 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
     from minips_tpu.models import lr as lr_model
 
     B, D = args.batch, args.dim
-    staleness = {"bsp": 0, "ssp": args.staleness,
-                 "asp": float("inf")}[args.mode]
+    staleness = staleness_for(args.mode, args.staleness)
     rng = np.random.default_rng(args.seed)
     w_true = rng.normal(size=D)
 

@@ -1,0 +1,274 @@
+"""CollectiveSSPPS — the consistency axis over the flagship DeepFM and
+LM workloads (VERDICT r4 next #2): row-sparse collective merges for the
+hashed SparseTables, dense vector merges for the deep tower / the
+transformer, with the batch-sized-traffic invariant asserted.
+
+Fast tier: single-process exactness (a 1-process sync must be an exact
+no-op, so the CSSP trajectory is bitwise the raw fused-step trajectory),
+BlobExchange unit behavior, and the union-merge row accounting. Slow
+tier: 2-real-process launcher smokes with skew bound + replica agreement
++ union-sized sync proof.
+"""
+
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu import launch
+
+APP = "minips_tpu.apps.multihost_example"
+_PORT = [6840]
+
+
+def _run_multihost(n, extra, *, local_devices=2, timeout=300.0):
+    _PORT[0] += 9
+    return launch.run_local_job(
+        n, [sys.executable, "-m", APP] + extra,
+        base_port=_PORT[0],
+        env_extra={"MINIPS_FORCE_CPU": "1",
+                   "MINIPS_MH_LOCAL_DEVICES": str(local_devices)},
+        timeout=timeout)
+
+
+# ------------------------------------------------------------- fast tier
+def _tiny_build(mesh, updater="adagrad", num_slots=4096, seed=0):
+    from minips_tpu.apps.wide_deep_example import build
+    from minips_tpu.core.config import Config, TableConfig, TrainConfig
+
+    cfg = Config(
+        table=TableConfig(name="ctr", kind="sparse", updater=updater,
+                          lr=0.05, dim=4, num_slots=num_slots),
+        train=TrainConfig(batch_size=32, num_iters=4),
+    )
+    ps, (wide_t, emb_t, deep_t) = build(cfg, use_fm=True, mesh=mesh,
+                                        seed=seed)
+    return ps, {"wide": wide_t, "emb": emb_t, "deep": deep_t}
+
+
+def _batches(n, bsz=32, seed=0):
+    from minips_tpu.data import synthetic
+
+    data = synthetic.criteo_like(1024, seed=seed)
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        sel = rng.integers(0, data["y"].shape[0], size=bsz)
+        out.append({k: v[sel] for k, v in data.items()})
+    return out
+
+
+@pytest.mark.parametrize("updater", ["sgd", "adagrad", "adam"])
+def test_single_process_cssp_is_bitwise_the_fused_step(updater):
+    """With one process the merge is ``base + 1·delta`` = the live params
+    — CSSP must not perturb the trajectory AT ALL: losses equal the raw
+    PSTrainStep run bitwise, for every sparse updater (the merge touches
+    emb AND optimizer rows)."""
+    from minips_tpu.train.cssp_ps import CollectiveSSPPS
+
+    bs = _batches(6)
+    trainer = CollectiveSSPPS(
+        lambda m: _tiny_build(m, updater=updater), staleness=2,
+        sync_every=2)
+    cssp_losses = [trainer.step(b) for b in bs]
+    trainer.finalize()
+
+    from minips_tpu.parallel.mesh import make_mesh
+
+    ps, _ = _tiny_build(make_mesh(8), updater=updater)
+    raw_losses = [float(ps(ps.shard_batch(b))) for b in bs]
+    assert cssp_losses == raw_losses
+    assert trainer.sync_rounds == 3
+
+
+def test_sync_leaves_untouched_rows_and_bases_consistent():
+    """After a sync: bases equal the live state (next round's deltas
+    start at zero), and rows never touched keep their init values."""
+    from minips_tpu.train.cssp_ps import CollectiveSSPPS
+
+    trainer = CollectiveSSPPS(lambda m: _tiny_build(m), sync_every=1)
+    emb_t = trainer.sparse["emb"]
+    init_emb = np.asarray(emb_t.emb).copy()
+    touched: set = set()
+    for b in _batches(3):
+        trainer.step(b)
+        from minips_tpu.tables.sparse import hash_to_slots_np
+
+        touched.update(hash_to_slots_np(
+            b["cat"].reshape(-1), emb_t.num_slots, emb_t.salt).tolist())
+    for name, t in trainer.sparse.items():
+        for lname, leaf in trainer._leaves(t):
+            np.testing.assert_array_equal(
+                np.asarray(leaf),
+                np.asarray(trainer._sparse_base[name][lname]))
+    untouched = np.setdiff1d(np.arange(emb_t.num_slots),
+                             np.fromiter(touched, dtype=np.int64))
+    now = np.asarray(emb_t.emb)
+    np.testing.assert_array_equal(now[untouched], init_emb[untouched])
+    # and the touched rows DID move
+    assert np.abs(now - init_emb).sum() > 0
+
+
+def test_row_merge_programs_roundtrip():
+    """The jitted row-sparse merge programs directly (the multi-process
+    arithmetic, runnable without peers): delta gathers fill 0 for the
+    out-of-bounds padding sentinel, apply lands ``base + merged`` on
+    exactly the union rows (padding DROPS), and bases track the result."""
+    import jax
+    import jax.numpy as jnp
+
+    from minips_tpu.train.cssp_ps import CollectiveSSPPS
+
+    trainer = CollectiveSSPPS(lambda m: _tiny_build(m, num_slots=64))
+    emb_t = trainer.sparse["emb"]
+    dim = emb_t.dim
+    base = trainer._sparse_base["emb"]["emb"]
+    # move three rows locally, one of them twice
+    rng = np.random.default_rng(0)
+    bump = rng.normal(size=(3, dim)).astype(np.float32)
+    emb_t.emb = emb_t.emb.at[jnp.array([3, 9, 40])].add(jnp.asarray(bump))
+    idx = np.full(8, emb_t.num_slots, np.int64)   # C=8, union size 3
+    idx[:3] = [3, 9, 40]
+    idxd = jax.device_put(jnp.asarray(idx, jnp.int32),
+                          trainer._rep_sharding)
+    delta = trainer._rows_delta(emb_t.emb, base, idxd)
+    d = np.asarray(delta).reshape(8, dim)
+    np.testing.assert_allclose(d[:3], bump, rtol=1e-6)
+    np.testing.assert_array_equal(d[3:], 0.0)     # padding gathers zero
+    # simulate the psum result of 2 procs (mine twice) and apply
+    merged = jax.device_put(delta * 2.0, delta.sharding)
+    new_leaf, new_base = trainer._apply_for(emb_t.emb.sharding)(
+        emb_t.emb, base, idxd, merged)
+    out = np.asarray(new_leaf)
+    np.testing.assert_allclose(out[[3, 9, 40]],
+                               np.asarray(base)[[3, 9, 40]] + 2.0 * bump,
+                               rtol=1e-6)
+    untouched = np.setdiff1d(np.arange(64), [3, 9, 40])
+    np.testing.assert_array_equal(out[untouched],
+                                  np.asarray(emb_t.emb)[untouched])
+    np.testing.assert_array_equal(np.asarray(new_base), out)
+
+
+def test_blob_exchange_allgather_and_early_arrival():
+    """BlobExchange: both directions deliver, order is by rank, and an
+    early round-r+1 arrival parks until that round is consumed."""
+    from tests.test_comm import _mk_buses
+
+    from minips_tpu.comm.bus import BlobExchange
+
+    buses = _mk_buses(2, 15910)
+    try:
+        ex0, ex1 = (BlobExchange(buses[0], 2), BlobExchange(buses[1], 2))
+        a0 = np.array([3, 1, 2], np.int64)
+        a1 = np.array([7, 1], np.int64)
+        b1 = np.array([9], np.int64)
+        # bus 1 publishes rounds 0 AND 1 before bus 0 starts round 0
+        import threading
+
+        res1 = {}
+
+        def side1():
+            res1["r0"] = ex1.allgather(0, "emb", a1, timeout=20)
+            res1["r1"] = ex1.allgather(1, "emb", b1, timeout=20)
+
+        th = threading.Thread(target=side1)
+        th.start()
+        time.sleep(0.3)
+        got0 = ex0.allgather(0, "emb", a0, timeout=20)
+        np.testing.assert_array_equal(got0[0], a0)
+        np.testing.assert_array_equal(got0[1], a1)
+        got0b = ex0.allgather(1, "emb", np.array([], np.int64), timeout=20)
+        np.testing.assert_array_equal(got0b[1], b1)
+        th.join(timeout=20)
+        np.testing.assert_array_equal(res1["r0"][0], a0)
+        np.testing.assert_array_equal(res1["r1"][1], b1)
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_cssp_ps_refuses_foreign_tables_and_busless_multiproc():
+    from minips_tpu.train.cssp_ps import CollectiveSSPPS
+
+    with pytest.raises(TypeError, match="syncs DenseTable"):
+        def bad_build(mesh):
+            ps, tables = _tiny_build(mesh)
+            tables["oops"] = object()
+            return ps, tables
+        CollectiveSSPPS(bad_build)
+
+
+# ------------------------------------------------------------- slow tier
+@pytest.mark.slow
+def test_wd_collective_ssp_two_process():
+    """VERDICT r4 next #2 as written: the flagship sparse workload on the
+    collective-sync consistency plane — 2 real processes, straggler on
+    rank 1, staleness 2, merge every 4 steps. Asserts the skew bound,
+    the fast rank's gate engagement, post-finalize replica agreement,
+    an all-reduce in the compiled merge, and the batch-sized-traffic
+    invariant: the row merge is union-sized (< slots/4 at these shapes),
+    and the host-wire union exchange actually carried ids."""
+    res = _run_multihost(
+        2, ["--model", "wd", "--mode", "ssp", "--staleness", "1",
+            "--sync-every", "4", "--iters", "8", "--batch", "64",
+            "--num-slots", "65536", "--slow-rank", "1", "--slow-ms",
+            "150"])
+    assert len(res) == 2
+    for r in res:
+        assert r["event"] == "done" and r["multi"] is True
+        assert r["max_skew_seen"] <= 2, r  # s + 1
+        assert r["loss_last"] < r["loss_first"], r
+        assert r["sync_rounds"] == 2
+        assert r["sync_hlo_has_all_reduce"] is True
+        assert 0 < r["sync_rows_max"] < r["num_slots"] // 4, r
+        assert r["union_wire_bytes"] > 0, r
+        assert r["sync_plane_devices"] == 4
+    fast = res[0] if res[0]["rank"] == 0 else res[1]
+    assert fast["gate_waits"] > 0, fast
+    assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
+
+
+@pytest.mark.slow
+def test_wd_collective_bsp_lockstep_and_asp_never_blocks():
+    """The two ends of the axis on the wd workload: bsp holds skew <= 1
+    with one merge per step; asp's gate never blocks (gate_waits == 0
+    everywhere) while the rendezvous still bounds drift."""
+    res = _run_multihost(
+        2, ["--model", "wd", "--mode", "bsp", "--iters", "4",
+            "--batch", "64", "--num-slots", "65536"])
+    for r in res:
+        assert r["event"] == "done"
+        assert r["max_skew_seen"] <= 1
+        assert r["sync_rounds"] == 4
+    assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
+
+    res = _run_multihost(
+        2, ["--model", "wd", "--mode", "asp", "--sync-every", "2",
+            "--iters", "4", "--batch", "64", "--num-slots", "65536",
+            "--slow-rank", "1", "--slow-ms", "20"])
+    for r in res:
+        assert r["event"] == "done"
+        assert r["gate_waits"] == 0, r
+    assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
+
+
+@pytest.mark.slow
+def test_lm_collective_ssp_two_process():
+    """The LM family on the collective consistency axis: per-process DP
+    islands over the transformer, dense delta merges, same skew bound
+    and replica-agreement observables as the wd leg."""
+    res = _run_multihost(
+        2, ["--model", "lm", "--mode", "ssp", "--staleness", "2",
+            "--sync-every", "4", "--iters", "8", "--batch", "8",
+            "--seq-len", "32", "--slow-rank", "1", "--slow-ms", "150",
+            "--updater", "adagrad", "--lr", "0.1"])
+    assert len(res) == 2
+    for r in res:
+        assert r["event"] == "done" and r["multi"] is True
+        assert r["max_skew_seen"] <= 3, r
+        assert r["loss_last"] < r["loss_first"], r
+        assert r["sync_hlo_has_all_reduce"] is True
+    fast = res[0] if res[0]["rank"] == 0 else res[1]
+    assert fast["gate_waits"] > 0, fast
+    assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
