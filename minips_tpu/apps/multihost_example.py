@@ -96,6 +96,13 @@ def main(argv=None) -> int:
     ap.add_argument("--sync-every", type=int, default=1,
                     help="collective merge every k local steps "
                          "(CollectiveSSP modes)")
+    ap.add_argument("--opt-sync", default="local",
+                    choices=["local", "avg"],
+                    help="CollectiveSSP modes, stateful updaters: "
+                         "'local' keeps each process's moments (drift "
+                         "documented in docs/consistency.md); 'avg' "
+                         "psum-averages float moments alongside the "
+                         "param deltas at every merge")
     ap.add_argument("--slow-rank", type=int, default=-1)
     ap.add_argument("--slow-ms", type=int, default=0,
                     help="straggler injection: sleep this long before "

@@ -416,9 +416,22 @@ class BlobExchange:
     Early arrivals PARK in the store until consumed: under SSP skew a
     fast process may receive a peer's round-r+1 array while still
     draining round r — keying the store by (round, tag, sender) makes
-    that reordering harmless. A timed-out wait consults the heartbeat
-    monitor so a dead peer raises PeerFailureError instead of hanging
-    forever (the staleness gate's contract, SURVEY.md §5.3)."""
+    that reordering harmless. Two hardenings against the pub/sub
+    transport's nature:
+
+    - a blob published before a peer REGISTERED this handler is dropped
+      by the bus (one-shot, unlike the clock gossip's steady republish)
+      — so a waiting ``allgather`` re-publishes its own frame every
+      couple of seconds; duplicates are idempotent (same key, same
+      bytes), and the slow joiner eventually sees the fast sender's
+      frame;
+    - late/duplicate arrivals for rounds already consumed or abandoned
+      would re-park forever, so a per-tag ROUND WATERMARK drops them at
+      receive time (rounds are monotone per tag by construction).
+
+    A timed-out wait consults the heartbeat monitor so a dead peer
+    raises PeerFailureError instead of hanging forever (the staleness
+    gate's contract, SURVEY.md §5.3)."""
 
     KIND = "blobx"
 
@@ -426,17 +439,20 @@ class BlobExchange:
         self.bus = bus
         self.n = int(num_processes)
         self._store: dict = {}
+        self._done: dict = {}     # tag -> highest consumed/abandoned round
         self._cond = threading.Condition()
         bus.on(self.KIND, self._on)
 
     def _on(self, sender: int, payload: dict) -> None:
         import numpy as np
 
+        rnd, tag = int(payload["round"]), str(payload["tag"])
         raw = payload.get("__blob__") or b""
         arr = np.frombuffer(raw, dtype=np.dtype(payload["dtype"])).copy()
         with self._cond:
-            self._store[(int(payload["round"]), str(payload["tag"]),
-                         sender)] = arr
+            if rnd <= self._done.get(tag, -1):
+                return  # re-publish duplicate of a finished round
+            self._store[(rnd, tag, sender)] = arr
             self._cond.notify_all()
 
     def allgather(self, rnd: int, tag: str, arr, *,
@@ -447,13 +463,14 @@ class BlobExchange:
         import numpy as np
 
         arr = np.ascontiguousarray(arr)
-        self.bus.publish(self.KIND, {"round": int(rnd), "tag": str(tag),
-                                     "dtype": str(arr.dtype)},
-                         blob=arr.tobytes())
+        head = {"round": int(rnd), "tag": str(tag), "dtype": str(arr.dtype)}
+        blob = arr.tobytes()
+        self.bus.publish(self.KIND, head, blob=blob)
         out: list = [None] * self.n
         out[self.bus.my_id] = arr
         peers = [p for p in range(self.n) if p != self.bus.my_id]
         deadline = time.monotonic() + timeout
+        last_pub = time.monotonic()
         with self._cond:
             while True:
                 missing = [p for p in peers
@@ -461,12 +478,13 @@ class BlobExchange:
                 if not missing:
                     for p in peers:
                         out[p] = self._store.pop((rnd, tag, p))
+                    self._finish(rnd, tag)
                     return out
                 quiet = not self._cond.wait(timeout=1.0)
                 if quiet and monitor is not None:
                     dead = monitor.check()
                     if dead:
-                        self._purge(rnd, tag)
+                        self._finish(rnd, tag)
                         from minips_tpu.consistency.gate import \
                             PeerFailureError
                         raise PeerFailureError(dead)
@@ -474,16 +492,25 @@ class BlobExchange:
                 # cond busy (a peer's next-round publishes must not let
                 # this wait overshoot its timeout indefinitely)
                 if time.monotonic() > deadline:
-                    self._purge(rnd, tag)
+                    self._finish(rnd, tag)
                     raise TimeoutError(
                         f"BlobExchange round {rnd} tag {tag!r}: "
                         f"peers {missing} never arrived")
+                if time.monotonic() - last_pub > 2.0:
+                    # slow-joiner repair: a peer that registered its
+                    # handler after our first publish missed it for good
+                    # (pub/sub has no replay) — keep re-sending while we
+                    # wait; receivers de-dup by key or watermark
+                    self.bus.publish(self.KIND, head, blob=blob)
+                    last_pub = time.monotonic()
 
-    def _purge(self, rnd: int, tag: str) -> None:
-        """Drop this round/tag's parked arrivals on a failed gather —
-        the caller will not come back for them (recovery relaunches with
-        fresh state), and repeated partial failures must not grow the
-        store without bound. Caller holds the cond lock."""
+    def _finish(self, rnd: int, tag: str) -> None:
+        """Mark the round consumed/abandoned and drop any parked leftovers
+        for it: the caller never comes back for an abandoned round
+        (recovery relaunches with fresh state), and re-published
+        duplicates of finished rounds must not re-park — the watermark
+        makes _on reject them at receive time. Caller holds the lock."""
+        self._done[tag] = max(self._done.get(tag, -1), rnd)
         for key in [k for k in self._store
-                    if k[0] == rnd and k[1] == tag]:
+                    if k[0] <= rnd and k[1] == tag]:
             del self._store[key]

@@ -98,9 +98,14 @@ class CollectiveSSPPS:
         monitor=None,
         gate_timeout: float = 60.0,
         exchange_timeout: float = 120.0,
+        opt_sync: str = "local",
     ):
         if sync_every < 1:
             raise ValueError("sync_every must be >= 1")
+        if opt_sync not in ("local", "avg"):
+            raise ValueError(f"opt_sync must be 'local' or 'avg', got "
+                             f"{opt_sync!r}")
+        self.opt_sync = opt_sync
         self.staleness = staleness
         self.sync_every = int(sync_every)
         self.nprocs = jax.process_count()
@@ -109,6 +114,13 @@ class CollectiveSSPPS:
                 "CollectiveSSPPS needs the control bus in multi-process "
                 "runs: clock gossip AND the touched-row union exchange "
                 "ride it (pass bus= from launch.init_from_env)")
+        # register the blob handler BEFORE build_fn: a fast peer may
+        # publish its first union while we are still compiling in
+        # build_fn, and pub/sub drops frames with no handler (the
+        # exchange also re-publishes while waiting, so either side of
+        # the race is covered)
+        self.exchange = (BlobExchange(bus, self.nprocs)
+                         if bus is not None and self.nprocs > 1 else None)
 
         self.plane = SyncPlane()
         self.local_mesh = self.plane.local_mesh
@@ -123,6 +135,15 @@ class CollectiveSSPPS:
                       if isinstance(t, DenseTable)}
         self.sparse = {k: t for k, t in tables.items()
                        if isinstance(t, SparseTable)}
+        if opt_sync == "avg":
+            from minips_tpu.train.ssp_spmd import \
+                check_avg_opt_sync_supported
+
+            for t in self.dense.values():
+                check_avg_opt_sync_supported(t)
+            # sparse opt ROWS already merge additively in _sync_sparse —
+            # exact for adagrad (order-free sums), documented heuristic
+            # for adam moments; 'avg' only changes the DENSE tables
         for name, t in self.sparse.items():
             if self.ps.key_fns.get(name) is None:
                 raise ValueError(
@@ -162,8 +183,6 @@ class CollectiveSSPPS:
         self.gossip, self._gate = make_control(
             bus, self.nprocs, staleness, monitor=monitor,
             timeout=gate_timeout)
-        self.exchange = (BlobExchange(bus, self.nprocs)
-                         if bus is not None and self.nprocs > 1 else None)
         self._touched: dict[str, set] = {k: set() for k in self.sparse}
         self.sync_rows_max = 0       # largest padded union C seen
         self.union_wire_bytes = 0    # host-wire bytes of the id exchange
@@ -257,6 +276,10 @@ class CollectiveSSPPS:
             new = self._add(self._dense_base[name], merged)
             t.params = new
             self._dense_base[name] = self._copy(new)
+            if self.opt_sync == "avg":
+                from minips_tpu.train.ssp_spmd import avg_table_opt_state
+
+                avg_table_opt_state(t, self.plane)
         for name in sorted(self.sparse):
             self._sync_sparse(rnd, name)
         self.sync_rounds += 1
@@ -266,15 +289,15 @@ class CollectiveSSPPS:
         t = self.sparse[name]
         mine = np.asarray(sorted(self._touched[name]), dtype=np.int64)
         self._touched[name].clear()
-        if self.exchange is not None:
-            parts = self.exchange.allgather(rnd, name, mine,
-                                            timeout=self._xt,
-                                            monitor=self._monitor)
-            self.union_wire_bytes += sum(int(p.nbytes) for p in parts)
-            union = np.unique(np.concatenate(parts)) if any(
-                p.size for p in parts) else mine
-        else:
-            union = mine
+        # multi-process by construction: nprocs==1 took _sync's identity
+        # path, and __init__ rejected bus=None for nprocs>1
+        assert self.exchange is not None
+        parts = self.exchange.allgather(rnd, name, mine,
+                                        timeout=self._xt,
+                                        monitor=self._monitor)
+        self.union_wire_bytes += sum(int(p.nbytes) for p in parts)
+        union = (np.unique(np.concatenate(parts))
+                 if any(p.size for p in parts) else mine)
         if union.size == 0:
             return  # nobody touched this table: replicas already agree
         C = max(next_pow2(int(union.size)), self.plane.n_local)
@@ -306,15 +329,25 @@ class CollectiveSSPPS:
             self._sync()
 
     def fingerprint(self) -> float:
-        """One float over all synced state — equal across processes after
-        finalize (the replica-agreement observable)."""
+        """One float over ALL synced state — dense params, sparse emb AND
+        the sparse optimizer rows (they merge additively every round),
+        plus dense opt state when opt_sync='avg' reconciles it. Equal
+        across processes after finalize; a broken merge of ANY synced
+        leaf breaks the equality, not just a param one."""
         total = 0.0
         for name in sorted(self.dense):
-            total += float(np.asarray(self.dense[name].params,
-                                      dtype=np.float64).sum())
+            t = self.dense[name]
+            total += float(np.asarray(t.params, dtype=np.float64).sum())
+            if self.opt_sync == "avg":
+                for leaf in jax.tree.leaves(t.opt_state):
+                    if (getattr(leaf, "ndim", None) == 1
+                            and leaf.shape[0] == t.padded
+                            and jnp.issubdtype(leaf.dtype, jnp.floating)):
+                        total += float(np.asarray(leaf,
+                                                  dtype=np.float64).sum())
         for name in sorted(self.sparse):
-            total += float(np.asarray(self.sparse[name].emb,
-                                      dtype=np.float64).sum())
+            for _, leaf in self._leaves(self.sparse[name]):
+                total += float(np.asarray(leaf, dtype=np.float64).sum())
         return total
 
 
@@ -352,7 +385,8 @@ def run_wd_cssp(args, rank: int, nprocs: int, multi: bool,
     trainer = CollectiveSSPPS(
         build_fn, staleness=staleness, sync_every=args.sync_every,
         bus=getattr(watchdog, "bus", None),
-        monitor=getattr(watchdog, "monitor", None))
+        monitor=getattr(watchdog, "monitor", None),
+        opt_sync=getattr(args, "opt_sync", "local"))
     # ONE dataset (one ground truth) on every rank; batches sampled with
     # a shared stream, each rank training on its row slice
     data = synthetic.criteo_like(8192, seed=args.seed)
@@ -385,6 +419,7 @@ def run_wd_cssp(args, rank: int, nprocs: int, multi: bool,
         "staleness": (None if staleness == float("inf")
                       else int(staleness)),
         "sync_every": args.sync_every,
+        "opt_sync": getattr(args, "opt_sync", "local"),
         "loss_first": losses[0], "loss_last": losses[-1],
         "losses": [round(x, 8) for x in losses],
         "param_fingerprint": fp,
@@ -432,7 +467,8 @@ def run_lm_cssp(args, rank: int, nprocs: int, multi: bool,
         template, grad, updater=args.updater, lr=args.lr,
         staleness=staleness, sync_every=args.sync_every,
         bus=getattr(watchdog, "bus", None),
-        monitor=getattr(watchdog, "monitor", None), name="lm_cssp")
+        monitor=getattr(watchdog, "monitor", None), name="lm_cssp",
+        opt_sync=getattr(args, "opt_sync", "local"))
     rng = np.random.default_rng(args.seed)
     jitter_rng = np.random.default_rng(1000 + rank)
     losses = []
@@ -462,6 +498,7 @@ def run_lm_cssp(args, rank: int, nprocs: int, multi: bool,
         "staleness": (None if staleness == float("inf")
                       else int(staleness)),
         "sync_every": args.sync_every,
+        "opt_sync": getattr(args, "opt_sync", "local"),
         "loss_first": losses[0], "loss_last": losses[-1],
         "losses": [round(x, 8) for x in losses],
         "param_fingerprint": fp,

