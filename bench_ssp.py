@@ -127,7 +127,8 @@ def _run_collective(args) -> int:
             args.jitter_ms, args.jitter_prob, args.timeout,
             app="minips_tpu.apps.multihost_example",
             extra=["--sync-every", str(args.sync_every),
-                   "--batch", str(16 * args.n)],
+                   "--batch", str(16 * args.n),
+                   "--sync-comm", args.sync_comm],
             env_extra={"MINIPS_MH_LOCAL_DEVICES":
                        str(args.local_devices)})
         walls[mode] = max(r["wall_s"] for r in rs)
@@ -158,6 +159,9 @@ def _run_collective(args) -> int:
         "losses_identical": identical,
         "staleness": args.staleness,
         "sync_every": args.sync_every,
+        "sync_comm": args.sync_comm,
+        "local_devices": args.local_devices,
+        "n_procs": args.n,
         "compute": "cpu-loopback (the topology a pod runs on ICI/DCN)",
     }))
     return 0 if identical else 1
@@ -190,6 +194,10 @@ def main() -> int:
                          "collective barrier, to be what binds)")
     ap.add_argument("--local-devices", type=int, default=2,
                     help="--collective: fake devices per process")
+    ap.add_argument("--sync-comm", default="float32",
+                    choices=["float32", "bfloat16", "int8"],
+                    help="--collective: wire format of the delta merge "
+                         "(error-feedback compressed collective)")
     ap.add_argument("--tpu-grounded", action="store_true",
                     help="measure the chip's step time, simulate the "
                          "N-worker schedule (see module docstring)")

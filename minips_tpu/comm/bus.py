@@ -416,32 +416,43 @@ class BlobExchange:
     Early arrivals PARK in the store until consumed: under SSP skew a
     fast process may receive a peer's round-r+1 array while still
     draining round r — keying the store by (round, tag, sender) makes
-    that reordering harmless. Two hardenings against the pub/sub
-    transport's nature:
+    that reordering harmless. Hardenings against the pub/sub transport's
+    nature (frames published before a peer registered its handler are
+    dropped, and there is no replay):
 
-    - a blob published before a peer REGISTERED this handler is dropped
-      by the bus (one-shot, unlike the clock gossip's steady republish)
-      — so a waiting ``allgather`` re-publishes its own frame every
-      couple of seconds; duplicates are idempotent (same key, same
-      bytes), and the slow joiner eventually sees the fast sender's
-      frame;
+    - a waiting ``allgather`` RE-PUBLISHES its own frame every couple of
+      seconds (duplicates are idempotent — same key, same bytes);
+    - a waiting ``allgather`` also REQUESTS missing frames: each
+      instance retains its latest (head, blob) per tag and answers a
+      ``blobx_req`` by re-sending — this covers the sender whose own
+      gather already completed and who therefore stopped re-publishing
+      (it no longer waits, but it still serves);
     - late/duplicate arrivals for rounds already consumed or abandoned
-      would re-park forever, so a per-tag ROUND WATERMARK drops them at
-      receive time (rounds are monotone per tag by construction).
+      are dropped at receive time by a per-tag ROUND WATERMARK (rounds
+      are monotone per tag by construction).
+
+    All publishes happen OUTSIDE the store lock: the bus receive thread
+    needs that lock in ``_on``, and it also delivers clock gossip and
+    heartbeats — a blocking publish (the native bus's bounded outbox)
+    must never freeze failure detection. Request replies go through a
+    one-shot thread for the same reason.
 
     A timed-out wait consults the heartbeat monitor so a dead peer
     raises PeerFailureError instead of hanging forever (the staleness
     gate's contract, SURVEY.md §5.3)."""
 
     KIND = "blobx"
+    REQ_KIND = "blobx_req"
 
     def __init__(self, bus: ControlBus, num_processes: int):
         self.bus = bus
         self.n = int(num_processes)
         self._store: dict = {}
         self._done: dict = {}     # tag -> highest consumed/abandoned round
+        self._sent: dict = {}     # tag -> {round: (head, blob)}, last 2
         self._cond = threading.Condition()
         bus.on(self.KIND, self._on)
+        bus.on(self.REQ_KIND, self._on_req)
 
     def _on(self, sender: int, payload: dict) -> None:
         import numpy as np
@@ -455,6 +466,21 @@ class BlobExchange:
             self._store[(rnd, tag, sender)] = arr
             self._cond.notify_all()
 
+    def _on_req(self, sender: int, payload: dict) -> None:
+        """A peer missed our frame (registered its handler after our
+        publishes, and our own gather may already be done): re-send the
+        retained copy. Off-thread — the receive thread must not block
+        in a publish."""
+        rnd, tag = int(payload["round"]), str(payload["tag"])
+        with self._cond:
+            kept = self._sent.get(tag, {}).get(rnd)
+        if kept is None:
+            return  # nothing retained for that round (it will time out)
+        head, blob = kept
+        threading.Thread(target=self.bus.publish,
+                         args=(self.KIND, head, blob),
+                         daemon=True).start()
+
     def allgather(self, rnd: int, tag: str, arr, *,
                   timeout: float = 120.0, monitor=None) -> list:
         """Every process's array for (rnd, tag), ordered by rank (mine
@@ -465,46 +491,71 @@ class BlobExchange:
         arr = np.ascontiguousarray(arr)
         head = {"round": int(rnd), "tag": str(tag), "dtype": str(arr.dtype)}
         blob = arr.tobytes()
+        with self._cond:
+            # retain the last TWO rounds per tag: within one round the
+            # collective merges after each gather rendezvous the whole
+            # group, so a peer lags at most one round behind a server —
+            # except when every union in a round was empty (no psum
+            # launched), which is why one round of retention is not
+            # enough
+            kept = self._sent.setdefault(tag, {})
+            kept[int(rnd)] = (head, blob)
+            for old_rnd in [r for r in kept if r < rnd - 1]:
+                del kept[old_rnd]
         self.bus.publish(self.KIND, head, blob=blob)
         out: list = [None] * self.n
         out[self.bus.my_id] = arr
         peers = [p for p in range(self.n) if p != self.bus.my_id]
         deadline = time.monotonic() + timeout
-        last_pub = time.monotonic()
-        with self._cond:
-            while True:
+        last_repair = time.monotonic()
+        while True:
+            with self._cond:
                 missing = [p for p in peers
                            if (rnd, tag, p) not in self._store]
                 if not missing:
                     for p in peers:
                         out[p] = self._store.pop((rnd, tag, p))
-                    self._finish(rnd, tag)
+                    self._finish_locked(rnd, tag)
                     return out
-                quiet = not self._cond.wait(timeout=1.0)
-                if quiet and monitor is not None:
-                    dead = monitor.check()
-                    if dead:
-                        self._finish(rnd, tag)
-                        from minips_tpu.consistency.gate import \
-                            PeerFailureError
-                        raise PeerFailureError(dead)
-                # the deadline binds even while OTHER traffic keeps the
-                # cond busy (a peer's next-round publishes must not let
-                # this wait overshoot its timeout indefinitely)
-                if time.monotonic() > deadline:
-                    self._finish(rnd, tag)
-                    raise TimeoutError(
-                        f"BlobExchange round {rnd} tag {tag!r}: "
-                        f"peers {missing} never arrived")
-                if time.monotonic() - last_pub > 2.0:
-                    # slow-joiner repair: a peer that registered its
-                    # handler after our first publish missed it for good
-                    # (pub/sub has no replay) — keep re-sending while we
-                    # wait; receivers de-dup by key or watermark
-                    self.bus.publish(self.KIND, head, blob=blob)
-                    last_pub = time.monotonic()
+                self._cond.wait(timeout=1.0)
+                missing = [p for p in peers
+                           if (rnd, tag, p) not in self._store]
+                if not missing:
+                    for p in peers:
+                        out[p] = self._store.pop((rnd, tag, p))
+                    self._finish_locked(rnd, tag)
+                    return out
+            # ---- lock released: monitor/deadline/repair — run EVERY
+            # iteration: other traffic keeping the cond busy (peers'
+            # re-publishes, other tags) must not starve failure
+            # detection or let the wait overshoot its deadline
+            if monitor is not None:
+                dead = monitor.check()
+                if dead:
+                    with self._cond:
+                        self._finish_locked(rnd, tag)
+                    from minips_tpu.consistency.gate import \
+                        PeerFailureError
+                    raise PeerFailureError(dead)
+            if time.monotonic() > deadline:
+                with self._cond:
+                    self._finish_locked(rnd, tag)
+                raise TimeoutError(
+                    f"BlobExchange round {rnd} tag {tag!r}: "
+                    f"peers {missing} never arrived")
+            if time.monotonic() - last_repair > 2.0:
+                # slow-joiner repair, both directions: re-offer my frame
+                # (a peer may have registered after my first publish)
+                # and request theirs (a peer whose gather already
+                # finished no longer re-publishes, but it still serves
+                # requests from its retained copies)
+                self.bus.publish(self.KIND, head, blob=blob)
+                for p in missing:
+                    self.bus.send(p, self.REQ_KIND,
+                                  {"round": int(rnd), "tag": str(tag)})
+                last_repair = time.monotonic()
 
-    def _finish(self, rnd: int, tag: str) -> None:
+    def _finish_locked(self, rnd: int, tag: str) -> None:
         """Mark the round consumed/abandoned and drop any parked leftovers
         for it: the caller never comes back for an abandoned round
         (recovery relaunches with fresh state), and re-published

@@ -78,29 +78,64 @@ def quantized_all_gather(x: jnp.ndarray, axis_name: str,
     return _dequantize_blocks(qs, ss, shard).reshape(-1)
 
 
-def quantized_psum_scatter(gpad: jnp.ndarray, axis_name: str,
-                           comm: str = "float32") -> jnp.ndarray:
-    """Reduce-scatter a [n * shard] f32 gradient to this device's [shard]
-    chunk, summing over the axis. Compressed modes ship chunks via
-    all-to-all (same bytes on wire as a reduce-scatter ring) and accumulate
-    in f32 after decompression — the cross-worker sum NEVER runs in the
-    compressed dtype, so error stays per-hop bounded instead of growing
-    with worker count."""
-    _check(comm)
-    if comm == "float32":
-        return jax.lax.psum_scatter(gpad, axis_name, tiled=True)
-    n = jax.lax.axis_size(axis_name)
-    chunks = gpad.reshape(n, -1)                            # [n, shard]
-    shard = chunks.shape[1]
+def a2a_reduce(chunks: jnp.ndarray, axis_name: str,
+               comm: str) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The compressed REDUCE leg, shared by the pull/push plane and the
+    CollectiveSSP sync wire: ship ``[n, c]`` per-destination chunks via
+    all-to-all (same bytes on wire as a reduce-scatter ring) in the
+    compressed dtype and accumulate in f32 after decompression — the
+    cross-worker sum NEVER runs compressed, so error stays per-hop
+    bounded instead of growing with worker count. Returns ``(reduced_c,
+    sent)``: my reduced chunk and exactly what I contributed AFTER
+    compression (the error-feedback hook: residual = input − sent)."""
+    c = chunks.shape[1]
     if comm == "bfloat16":
+        sent = chunks.astype(jnp.bfloat16).astype(jnp.float32)
         recv = jax.lax.all_to_all(chunks.astype(jnp.bfloat16), axis_name,
                                   split_axis=0, concat_axis=0, tiled=False)
-        return jnp.sum(recv.astype(jnp.float32), axis=0)
+        return jnp.sum(recv.astype(jnp.float32), axis=0), sent
     q, scale = _quantize_blocks(chunks)                     # [n, nb, block]
+    sent = _dequantize_blocks(q, scale, c)
     # chunk j of every device -> device j; received rows are the n devices'
     # contributions to MY chunk
     q_recv = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
                                 tiled=False)
     s_recv = jax.lax.all_to_all(scale, axis_name, split_axis=0,
                                 concat_axis=0, tiled=False)
-    return jnp.sum(_dequantize_blocks(q_recv, s_recv, shard), axis=0)
+    return jnp.sum(_dequantize_blocks(q_recv, s_recv, c), axis=0), sent
+
+
+def gather_broadcast(chunk: jnp.ndarray, axis_name: str,
+                     comm: str) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The compressed REPLICATE leg: all-gather my ``[c]`` chunk in the
+    compressed dtype; every participant dequantizes the SAME bytes, so
+    the assembled ``[n*c]`` result is bitwise identical everywhere.
+    Returns ``(full, gap)`` with ``gap = chunk − what the others will
+    decode of it`` — the second compression's error, which the chunk
+    owner can fold into its error-feedback residual so BOTH legs'
+    bias is compensated, not just the reduce leg's."""
+    c = chunk.shape[0]
+    if comm == "bfloat16":
+        low = chunk.astype(jnp.bfloat16)
+        g = jax.lax.all_gather(low, axis_name, tiled=False)
+        return g.astype(jnp.float32).reshape(-1), \
+            chunk - low.astype(jnp.float32)
+    q, s = _quantize_blocks(chunk[None, :])
+    decoded = _dequantize_blocks(q, s, c)[0]
+    qg = jax.lax.all_gather(q, axis_name, tiled=False)
+    sg = jax.lax.all_gather(s, axis_name, tiled=False)
+    return _dequantize_blocks(qg[:, 0], sg[:, 0], c).reshape(-1), \
+        chunk - decoded
+
+
+def quantized_psum_scatter(gpad: jnp.ndarray, axis_name: str,
+                           comm: str = "float32") -> jnp.ndarray:
+    """Reduce-scatter a [n * shard] f32 gradient to this device's [shard]
+    chunk, summing over the axis (compressed modes via
+    :func:`a2a_reduce`)."""
+    _check(comm)
+    if comm == "float32":
+        return jax.lax.psum_scatter(gpad, axis_name, tiled=True)
+    n = jax.lax.axis_size(axis_name)
+    reduced, _ = a2a_reduce(gpad.reshape(n, -1), axis_name, comm)
+    return reduced

@@ -62,7 +62,9 @@ from minips_tpu.consistency.gate import publish_clock
 from minips_tpu.parallel.mesh import DATA_AXIS
 from minips_tpu.tables.dense import DenseTable
 from minips_tpu.tables.sparse import SparseTable, hash_to_slots_np, next_pow2
-from minips_tpu.train.ssp_spmd import (SyncPlane, make_control,
+from minips_tpu.train.ssp_spmd import (SyncPlane, avg_table_opt_state,
+                                        check_avg_opt_sync_supported,
+                                        is_avg_leaf, make_control,
                                         staleness_for)
 
 __all__ = ["CollectiveSSPPS"]
@@ -136,9 +138,6 @@ class CollectiveSSPPS:
         self.sparse = {k: t for k, t in tables.items()
                        if isinstance(t, SparseTable)}
         if opt_sync == "avg":
-            from minips_tpu.train.ssp_spmd import \
-                check_avg_opt_sync_supported
-
             for t in self.dense.values():
                 check_avg_opt_sync_supported(t)
             # sparse opt ROWS already merge additively in _sync_sparse —
@@ -277,8 +276,6 @@ class CollectiveSSPPS:
             t.params = new
             self._dense_base[name] = self._copy(new)
             if self.opt_sync == "avg":
-                from minips_tpu.train.ssp_spmd import avg_table_opt_state
-
                 avg_table_opt_state(t, self.plane)
         for name in sorted(self.sparse):
             self._sync_sparse(rnd, name)
@@ -340,9 +337,7 @@ class CollectiveSSPPS:
             total += float(np.asarray(t.params, dtype=np.float64).sum())
             if self.opt_sync == "avg":
                 for leaf in jax.tree.leaves(t.opt_state):
-                    if (getattr(leaf, "ndim", None) == 1
-                            and leaf.shape[0] == t.padded
-                            and jnp.issubdtype(leaf.dtype, jnp.floating)):
+                    if is_avg_leaf(leaf, t.padded):
                         total += float(np.asarray(leaf,
                                                   dtype=np.float64).sum())
         for name in sorted(self.sparse):
@@ -365,6 +360,12 @@ def run_wd_cssp(args, rank: int, nprocs: int, multi: bool,
     from minips_tpu.data import synthetic
 
     staleness = staleness_for(args.mode, args.staleness)
+    if getattr(args, "sync_comm", "float32") != "float32":
+        raise SystemExit(
+            "--sync-comm compression is not wired for the wd row-sparse "
+            "merge (the error-feedback residual is defined over a "
+            "per-round-changing row union — per-slot EF bookkeeping is "
+            "future work); use --model lr or lm")
     if args.batch % nprocs:
         raise SystemExit(f"--batch {args.batch} must divide by {nprocs} "
                          "processes")
@@ -468,7 +469,8 @@ def run_lm_cssp(args, rank: int, nprocs: int, multi: bool,
         staleness=staleness, sync_every=args.sync_every,
         bus=getattr(watchdog, "bus", None),
         monitor=getattr(watchdog, "monitor", None), name="lm_cssp",
-        opt_sync=getattr(args, "opt_sync", "local"))
+        opt_sync=getattr(args, "opt_sync", "local"),
+        sync_comm=getattr(args, "sync_comm", "float32"))
     rng = np.random.default_rng(args.seed)
     jitter_rng = np.random.default_rng(1000 + rank)
     losses = []

@@ -39,6 +39,7 @@ plane.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, Optional
 
@@ -100,6 +101,9 @@ class SyncPlane:
             merge, mesh=self.mesh,
             in_specs=P("proc", "local"), out_specs=P(None, "local")))
         self._mean_cache: dict = {}
+        self._qmerge_cache: dict = {}
+        self._pad_cache: dict = {}
+        self._slice_cache: dict = {}
 
     def allreduce_sum(self, vec: jax.Array) -> jax.Array:
         """Sum a local-mesh-sharded vector across processes: local shards
@@ -127,6 +131,113 @@ class SyncPlane:
         shape = jax.ShapeDtypeStruct((self.nprocs, length), dtype,
                                      sharding=self._gspec)
         return self._merge.lower(shape).compile().as_text()
+
+    # ---------------------------------------------- quantized sync wire
+    def _q_merge_for(self, comm: str):
+        """Jitted quantized all-reduce over 'proc' (cached per comm),
+        built on the SAME wire primitives as the pull/push plane
+        (ops/quantized_comm.py: ``a2a_reduce`` + ``gather_broadcast`` —
+        one source of truth for the wire format): reduce leg = a2a of
+        compressed chunks + f32 accumulation; replicate leg = all-gather
+        of the compressed merged chunk, which every process dequantizes
+        IDENTICALLY — replicas stay bitwise equal, the CollectiveSSP
+        invariant. Returns (merged, sent, gap): ``sent`` is my
+        contribution after the reduce-leg compression; ``gap`` is the
+        replicate-leg compression error of MY reduced chunk, placed at
+        its position in my vector — folding BOTH into the residual makes
+        error feedback cover both legs, so neither bias accumulates."""
+        fn = self._qmerge_cache.get(comm)
+        if fn is not None:
+            return fn
+        from minips_tpu.ops.quantized_comm import (a2a_reduce,
+                                                   gather_broadcast)
+
+        def merge_q(block):            # [1, Lb] on each device
+            n = jax.lax.axis_size("proc")
+            v = block.reshape(n, -1)   # my row split into per-proc chunks
+            c = v.shape[1]
+            mine, sent = a2a_reduce(v, "proc", comm)
+            full, gap_c = gather_broadcast(mine, "proc", comm)
+            # my reduced chunk sits at offset p*c of this Lb segment —
+            # scatter its gap there so it folds into my residual
+            p = jax.lax.axis_index("proc")
+            gap = jax.lax.dynamic_update_slice(
+                jnp.zeros(n * c, jnp.float32), gap_c, (p * c,))
+            return (full.reshape(1, -1), sent.reshape(1, -1),
+                    gap.reshape(1, -1))
+
+        # check_vma=False: the merged output IS replicated over 'proc'
+        # (every process all-gathers the same compressed chunks and
+        # dequantizes identically), but the varying-axis checker cannot
+        # infer replication through all_gather the way it can through
+        # psum
+        fn = jax.jit(jax.shard_map(
+            merge_q, mesh=self.mesh, in_specs=P("proc", "local"),
+            out_specs=(P(None, "local"), P("proc", "local"),
+                       P("proc", "local")),
+            check_vma=False))
+        self._qmerge_cache[comm] = fn
+        return fn
+
+    def allreduce_sum_ef(self, vec: jax.Array, comm: str):
+        """Quantized-wire all-reduce with the error-feedback hook:
+        returns ``(merged, sent, gap)`` as local-mesh vectors. Callers
+        keep ``residual = send − sent + gap`` and add it to the next
+        round's delta — EF over BOTH compression points (my reduce-leg
+        contribution and my chunk's replicate-leg broadcast), so
+        compression bias cannot accumulate. The vector is zero-padded so
+        each device row splits evenly into per-process chunks; padding
+        compresses to zeros and is sliced off on return."""
+        if comm == "float32":
+            raise ValueError("allreduce_sum_ef is for compressed wires; "
+                             "use allreduce_sum for float32")
+        L = int(vec.shape[0])
+        M = self.n_local * self.nprocs
+        padded = -(-L // M) * M
+        if padded != L:
+            key = (L, padded, vec.dtype, vec.sharding)
+            pad_fn = self._pad_cache.get(key)
+            if pad_fn is None:
+                pad_fn = jax.jit(
+                    lambda x: jnp.zeros(padded, x.dtype).at[:L].set(x),
+                    out_shardings=vec.sharding)
+                self._pad_cache[key] = pad_fn
+            vec_p = pad_fn(vec)
+        else:
+            vec_p = vec
+        shards = sorted(vec_p.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        rows = [s.data.reshape(1, -1) for s in shards]
+        garr = jax.make_array_from_single_device_arrays(
+            (self.nprocs, padded), self._gspec, rows)
+        merged_g, sent_g, gap_g = self._q_merge_for(comm)(garr)
+
+        def back(arr):
+            cols = sorted(arr.addressable_shards,
+                          key=lambda s: s.index[1].start or 0)
+            return jax.make_array_from_single_device_arrays(
+                (padded,), vec_p.sharding,
+                [s.data.reshape(-1) for s in cols])
+
+        outs = [back(merged_g), back(sent_g), back(gap_g)]
+        if padded != L:
+            key = (L, vec.dtype, vec.sharding)
+            slice_fn = self._slice_cache.get(key)
+            if slice_fn is None:
+                slice_fn = jax.jit(lambda x: x[:L],
+                                   out_shardings=vec.sharding)
+                self._slice_cache[key] = slice_fn
+            outs = [slice_fn(o) for o in outs]
+        return tuple(outs)
+
+    def sync_hlo_q(self, length: int, comm: str) -> str:
+        """Compiled HLO of the quantized merge — smokes assert the wire
+        collectives are all-to-all/all-gather of the COMPRESSED dtype."""
+        M = self.n_local * self.nprocs
+        padded = -(-length // M) * M
+        shape = jax.ShapeDtypeStruct((self.nprocs, padded), jnp.float32,
+                                     sharding=self._gspec)
+        return self._q_merge_for(comm).lower(shape).compile().as_text()
 
     def allreduce_mean(self, vec: jax.Array) -> jax.Array:
         """psum-AVERAGE a float leaf across processes — the
@@ -180,22 +291,27 @@ def check_avg_opt_sync_supported(table: DenseTable) -> None:
             "docs/consistency.md) or adam/adam_bf16")
 
 
+def is_avg_leaf(leaf, padded: int) -> bool:
+    """THE predicate for which opt-state leaves opt_sync='avg' touches:
+    float params-length vectors (adam/adam_bf16 moments, adagrad
+    accumulators, momentum traces). One definition — the reconciliation,
+    the fingerprint, the oracle simulation, and the drift test all key
+    on it, so 'which leaves count' cannot silently diverge between the
+    implementation and its spec/observables."""
+    return (getattr(leaf, "ndim", None) == 1 and leaf.shape[0] == padded
+            and jnp.issubdtype(leaf.dtype, jnp.floating))
+
+
 def avg_table_opt_state(table: DenseTable, plane: SyncPlane) -> None:
     """The ``opt_sync='avg'`` reconciliation for one dense table: every
-    float params-length opt leaf (adam/adam_bf16 moments, adagrad
-    accumulators, momentum traces) is psum-averaged across processes.
-    Scalar counts stay local — sync rounds happen at fixed clocks, so
-    they are equal everywhere already. Runs INSIDE the sync round, so
-    it is part of the same rendezvous as the param merge."""
-    padded = table.padded
-
-    def merge_leaf(leaf):
-        if (getattr(leaf, "ndim", None) == 1 and leaf.shape[0] == padded
-                and jnp.issubdtype(leaf.dtype, jnp.floating)):
-            return plane.allreduce_mean(leaf)
-        return leaf
-
-    table.opt_state = jax.tree.map(merge_leaf, table.opt_state)
+    ``is_avg_leaf`` opt leaf is psum-averaged across processes. Scalar
+    counts stay local — sync rounds happen at fixed clocks, so they are
+    equal everywhere already. Runs INSIDE the sync round, so it is part
+    of the same rendezvous as the param merge."""
+    table.opt_state = jax.tree.map(
+        lambda leaf: (plane.allreduce_mean(leaf)
+                      if is_avg_leaf(leaf, table.padded) else leaf),
+        table.opt_state)
 
 
 class CollectiveSSP:
@@ -241,11 +357,21 @@ class CollectiveSSP:
         gate_timeout: float = 60.0,
         name: str = "cssp",
         opt_sync: str = "local",
+        sync_comm: str = "float32",
     ):
         if opt_sync not in ("local", "avg"):
             raise ValueError(f"opt_sync must be 'local' or 'avg', got "
                              f"{opt_sync!r}")
         self.opt_sync = opt_sync
+        from minips_tpu.ops.quantized_comm import _check as _check_comm
+        _check_comm(sync_comm)
+        self.sync_comm = sync_comm
+        if sync_comm != "float32" and opt_sync == "avg":
+            raise ValueError(
+                "sync_comm compression + opt_sync='avg' is not wired: "
+                "the moment average would ride the full-precision plane "
+                "while the deltas ride the compressed one — a misleading "
+                "half-measure; pick one lever per run")
         if sync_every < 1:
             raise ValueError("sync_every must be >= 1")
         self.staleness = staleness
@@ -283,6 +409,16 @@ class CollectiveSSP:
         self._apply = jax.jit(lambda base, merged: base + merged)
         self._delta = jax.jit(lambda params, base: params - base)
         self._base = self._copy(self.table.params)
+        self._residual = None
+        if sync_comm != "float32":
+            # error-feedback state: what compression dropped last round
+            # rides into this round's delta, so the bias cannot
+            # accumulate (the standard EF-SGD recipe, over both wire
+            # legs — see SyncPlane.allreduce_sum_ef)
+            self._residual = self._copy(
+                jax.jit(jnp.zeros_like)(self.table.params))
+            self._ef = jax.jit(
+                lambda send, sent, gap: send - sent + gap)
 
         # ---- host-side control plane: clock gossip + staleness gate --
         self.clock = 0
@@ -307,9 +443,14 @@ class CollectiveSSP:
 
     # ------------------------------------------------------------- plumbing
     def sync_hlo(self) -> str:
-        """Compiled HLO of the sync program — the comm_analysis hook: the
-        test/smoke asserts the cross-host sync IS a collective op (and
-        nothing else ever leaves the process on the data plane)."""
+        """Compiled HLO of the ACTIVE sync program — the comm_analysis
+        hook: the test/smoke asserts the cross-host sync IS a collective
+        op (and, compressed, that the wire ops carry the compressed
+        dtype; nothing else ever leaves the process on the data
+        plane)."""
+        if self.sync_comm != "float32":
+            return self.plane.sync_hlo_q(self.table.padded,
+                                         self.sync_comm)
         return self.plane.sync_hlo(self.table.padded,
                                    self.table.params.dtype)
 
@@ -342,7 +483,16 @@ class CollectiveSSP:
         The all-reduce is the rendezvous: a fast host blocks HERE (inside
         XLA, on the DCN plane) until every process launches the round."""
         delta = self._delta(self.table.params, self._base)
-        merged = self.plane.allreduce_sum(delta)
+        if self.sync_comm == "float32":
+            merged = self.plane.allreduce_sum(delta)
+        else:
+            send = self._apply(delta, self._residual)  # delta + residual
+            merged, sent, gap = self.plane.allreduce_sum_ef(
+                send, self.sync_comm)
+            # EF over both compression points: what the reduce leg
+            # dropped of MY contribution + what the replicate leg
+            # dropped of MY chunk of the merge
+            self._residual = self._ef(send, sent, gap)
         new_params = self._apply(self._base, merged)
         self.table.params = new_params
         self._base = self._copy(new_params)
@@ -391,6 +541,12 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
         return x, y
 
     if args.oracle_hosts:
+        if getattr(args, "sync_comm", "float32") != "float32":
+            raise SystemExit(
+                "--oracle-hosts is the BITWISE float32 oracle; the "
+                "compressed wire has its own tolerance test "
+                "(tests/test_cssp_ps.py) — run the oracle without "
+                "--sync-comm")
         if nprocs > 1:
             # under the launcher every rank would simulate ALL K hosts,
             # print duplicate oracle lines, and skip the watchdog
@@ -408,10 +564,94 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
         lr=args.lr, staleness=staleness, sync_every=args.sync_every,
         bus=getattr(watchdog, "bus", None),
         monitor=getattr(watchdog, "monitor", None),
-        opt_sync=getattr(args, "opt_sync", "local"))
+        opt_sync=getattr(args, "opt_sync", "local"),
+        sync_comm=getattr(args, "sync_comm", "float32"))
+
+    # ---- checkpoint/recovery drill plumbing (SURVEY §5.3 on the
+    # collective-SSP path): snapshots are only meaningful at SYNC
+    # boundaries (replicas are bitwise-identical right after a merge, so
+    # every rank can save/restore its own copy and the clock vector
+    # restarts coherent — an off-boundary snapshot would save N
+    # different divergent replicas)
+    ckpt_dir = getattr(args, "checkpoint_dir", None)
+    save_at = getattr(args, "save_at", 0)
+    restore_from = getattr(args, "restore_from", 0)
+    if ckpt_dir and not save_at and not restore_from:
+        # --save-at 0 means "at the end" (the fused path's semantics);
+        # here the end must be a sync boundary, so round DOWN — silently
+        # writing nothing would strand the restore leg
+        save_at = (args.iters // args.sync_every) * args.sync_every
+        if save_at == 0:
+            raise SystemExit(
+                f"--checkpoint-dir with --iters {args.iters} < "
+                f"--sync-every {args.sync_every}: no sync boundary ever "
+                "happens, nothing to snapshot")
+    for flag, val in (("--save-at", save_at),
+                      ("--restore-from", restore_from)):
+        if val and val % args.sync_every:
+            raise SystemExit(
+                f"{flag} {val} is not a sync boundary (sync-every "
+                f"{args.sync_every}); CollectiveSSP snapshots must land "
+                "right after a merge, where replicas are identical")
+    if (save_at or restore_from) and not ckpt_dir:
+        raise SystemExit("--save-at/--restore-from need --checkpoint-dir")
+
+    start = 0
+    if restore_from:
+        state = np.load(os.path.join(
+            ckpt_dir, f"cssp_step{restore_from}_r{rank}.npz"))
+        trainer.table.params = jax.device_put(
+            jnp.asarray(state["params"]), trainer.table.params.sharding)
+        opt_leaves, treedef = jax.tree.flatten(trainer.table.opt_state)
+        n_saved = len([k for k in state.files if k.startswith("opt")])
+        if n_saved != len(opt_leaves):
+            raise SystemExit(
+                f"checkpoint carries {n_saved} optimizer leaves but "
+                f"this run's --updater produces {len(opt_leaves)} — "
+                "resume with the updater the snapshot was saved under")
+        for j, cur in enumerate(opt_leaves):
+            if tuple(state[f"opt{j}"].shape) != tuple(cur.shape):
+                raise SystemExit(
+                    f"checkpoint optimizer leaf {j} has shape "
+                    f"{state[f'opt{j}'].shape}, this run expects "
+                    f"{cur.shape} — different updater or model shape")
+        trainer.table.opt_state = jax.tree.unflatten(treedef, [
+            jax.device_put(jnp.asarray(state[f"opt{j}"]), cur.sharding)
+            for j, cur in enumerate(opt_leaves)])
+        trainer._base = trainer._copy(trainer.table.params)
+        if trainer._residual is not None:
+            # the error-feedback residual is part of the trajectory: a
+            # compressed-wire resume with a zeroed residual would
+            # silently diverge from the uninterrupted run
+            if "residual" not in state:
+                raise SystemExit(
+                    "checkpoint has no error-feedback residual but this "
+                    "run uses --sync-comm compression — it was written "
+                    "by a float32-wire run; resume with the same "
+                    "--sync-comm it was saved under")
+            trainer._residual = jax.device_put(
+                jnp.asarray(state["residual"]),
+                trainer.table.params.sharding)
+        elif "residual" in state:
+            raise SystemExit(
+                "checkpoint carries an error-feedback residual (written "
+                "under --sync-comm compression) but this run uses the "
+                "float32 wire — resume with the same --sync-comm")
+        # the CLOCK VECTOR restarts where the snapshot was taken: the
+        # next step publishes restore_from+1, so gossiped clocks and the
+        # sync schedule continue exactly as the uninterrupted run's
+        trainer.clock = trainer._synced_at = int(state["clock"])
+        trainer.sync_rounds = int(state["sync_rounds"])
+        start = restore_from
+        for _ in range(start):      # shared-stream fast-forward
+            next_global()
+
     losses = []
     jitter_rng = np.random.default_rng(1000 + rank)
-    for i in range(args.iters):
+    for i in range(start, args.iters):
+        if getattr(args, "kill_at", 0) and rank == args.kill_rank \
+                and i == args.kill_at:
+            os._exit(137)
         x, y = next_global()
         if args.slow_ms and rank == args.slow_rank:
             time.sleep(args.slow_ms / 1000.0)
@@ -420,9 +660,38 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
         losses.append(trainer.step(
             {"x": x[rank * per:(rank + 1) * per],
              "y": y[rank * per:(rank + 1) * per]}))
+        if save_at and i + 1 == save_at:
+            # the merge for this boundary already ran inside step(), so
+            # PARAMS are identical on every replica — but with
+            # opt_sync='local' the optimizer moments are rank-PRIVATE
+            # state (exactly the drift docs/consistency.md documents),
+            # so each rank snapshots its own copy, like the reference's
+            # per-server-shard Dump. Atomic tmp+rename: a crash
+            # mid-write must not leave a truncated snapshot that parses.
+            os.makedirs(ckpt_dir, exist_ok=True)
+            opt_leaves = jax.tree.leaves(trainer.table.opt_state)
+            path = os.path.join(ckpt_dir,
+                                f"cssp_step{save_at}_r{rank}.npz")
+            extra = ({"residual": np.asarray(trainer._residual)}
+                     if trainer._residual is not None else {})
+            np.savez(path + ".tmp.npz",
+                     params=np.asarray(trainer.table.params),
+                     clock=trainer.clock,
+                     sync_rounds=trainer.sync_rounds,
+                     **extra,
+                     **{f"opt{j}": np.asarray(leaf)
+                        for j, leaf in enumerate(opt_leaves)})
+            os.replace(path + ".tmp.npz", path)
     trainer.finalize()
     fp = float(cluster.host_copy(trainer.table.params).sum())
     hlo = trainer.sync_hlo()
+    comm = getattr(args, "sync_comm", "float32")
+    # wire proof per format: f32 sync is ONE all-reduce; compressed syncs
+    # are all-to-all (reduce leg) + all-gather (replicate leg) carrying
+    # the compressed dtype (HLO spells int8 as s8)
+    wire_ok = ("all-reduce" in hlo if comm == "float32" else
+               ("all-to-all" in hlo and "all-gather" in hlo
+                and ("s8" if comm == "int8" else "bf16") in hlo))
 
     watchdog.disarm()
     cluster.barrier("cssp_done")
@@ -436,6 +705,7 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
                       else int(staleness)),
         "sync_every": args.sync_every,
         "opt_sync": getattr(args, "opt_sync", "local"),
+        "sync_comm": getattr(args, "sync_comm", "float32"),
         "loss_first": losses[0], "loss_last": losses[-1],
         "losses": [round(x, 8) for x in losses],
         "param_fingerprint": fp,
@@ -443,7 +713,9 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
         "max_skew_seen": trainer.max_skew_seen,
         "sync_rounds": trainer.sync_rounds,
         "sync_hlo_has_all_reduce": "all-reduce" in hlo,
+        "sync_hlo_wire_ok": wire_ok,
         "sync_plane_devices": len(trainer.sync_mesh.devices.ravel()),
+        "resumed_from": start,
     }), flush=True)
     watchdog.close()
     return 0
@@ -504,9 +776,7 @@ def _run_oracle(args, rng, next_global) -> int:
                 flat = [jax.tree.leaves(t.opt_state) for t in tables]
                 for j in range(len(flat[0])):
                     leaf = flat[0][j]
-                    if not (getattr(leaf, "ndim", None) == 1
-                            and leaf.shape[0] == padded
-                            and jnp.issubdtype(leaf.dtype, jnp.floating)):
+                    if not is_avg_leaf(leaf, padded):
                         continue
                     mean = np.mean(
                         [np.asarray(f[j], np.float32) for f in flat],

@@ -266,13 +266,12 @@ def test_adam_moment_reconciliation_vs_centralized():
                         merged, tables[h].params.sharding)
                     bases[h] = copy(tables[h].params)
                 if opt_sync == "avg":   # avg_table_opt_state's rule
+                    from minips_tpu.train.ssp_spmd import is_avg_leaf
+
                     flats = [jax.tree.flatten(t.opt_state)
                              for t in tables]
                     for j, leaf in enumerate(flats[0][0]):
-                        if not (getattr(leaf, "ndim", None) == 1
-                                and leaf.shape[0] == tables[0].padded
-                                and jnp.issubdtype(leaf.dtype,
-                                                   jnp.floating)):
+                        if not is_avg_leaf(leaf, tables[0].padded):
                             continue
                         mean = np.mean(
                             [np.asarray(f[0][j], np.float32)
@@ -314,6 +313,59 @@ def test_adam_moment_reconciliation_vs_centralized():
     assert d_avg <= d_local * 1.1, (d_avg, d_local)
     # neither walks out of centralized's neighborhood at this scale
     assert d_avg < 0.5 * np.linalg.norm(central) + 1.0, d_avg
+
+
+@pytest.mark.parametrize("comm", ["bfloat16", "int8"])
+def test_sync_comm_compressed_wire_tolerance(comm):
+    """VERDICT r4 next #5: the CollectiveSSP delta merge with a
+    compressed wire + error-feedback residual. Same data stream as the
+    f32 run: the compressed trajectory must converge to the same
+    neighborhood (EF keeps the bias from accumulating), the residual
+    must actually be engaged (nonzero — compression IS lossy, EF is
+    what makes it safe), and the compiled sync program must carry the
+    compressed dtype on its wire collectives."""
+    from minips_tpu.models import lr as lr_model
+    from minips_tpu.train.ssp_spmd import CollectiveSSP
+
+    D = 64
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=D)
+    bs = []
+    for _ in range(30):
+        x = rng.normal(size=(64, D)).astype(np.float32)
+        bs.append({"x": x, "y": (x @ w_true > 0).astype(np.float32)})
+
+    def run(sync_comm):
+        tr = CollectiveSSP(lr_model.init(D), lr_model.grad_fn_dense,
+                           updater="adagrad", lr=0.3, sync_every=2,
+                           sync_comm=sync_comm, name=f"q{sync_comm}")
+        ls = [tr.step(b) for b in bs]
+        tr.finalize()
+        return ls, tr
+
+    f32_ls, _ = run("float32")
+    q_ls, tr = run(comm)
+    assert q_ls[-1] < q_ls[0] * 0.5             # converges
+    assert abs(q_ls[-1] - f32_ls[-1]) < 0.02    # lands by the f32 run
+    assert float(np.abs(np.asarray(tr._residual)).sum()) > 0
+    # (wire-dtype HLO assertions live in the 2-process slow smoke — on a
+    # 1-process plane the all-to-all/all-gather compile away entirely)
+
+
+def test_sync_comm_refusals():
+    """sync_comm composes honestly or not at all: opt_sync='avg' would
+    ride the full-precision plane next to a compressed delta (half-
+    measure → refuse); unknown formats refuse via the shared comm
+    check."""
+    from minips_tpu.models import lr as lr_model
+    from minips_tpu.train.ssp_spmd import CollectiveSSP
+
+    with pytest.raises(ValueError, match="one lever per run"):
+        CollectiveSSP(lr_model.init(16), lr_model.grad_fn_dense,
+                      updater="adam", opt_sync="avg", sync_comm="int8")
+    with pytest.raises(ValueError, match="comm must be"):
+        CollectiveSSP(lr_model.init(16), lr_model.grad_fn_dense,
+                      sync_comm="int4")
 
 
 def test_opt_sync_avg_refuses_adam8():
@@ -411,6 +463,87 @@ def test_opt_sync_avg_real_processes_match_oracle():
     for r in res:
         np.testing.assert_allclose(
             r["losses"], oracle["losses_per_host"][r["rank"]], rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_collective_ssp_kill_detect_relaunch_resume(tmp_path):
+    """VERDICT r4 next #4: the fault drill on the collective-SSP path.
+    CollectiveSSP's failure surface is worse than the fused path's — a
+    peer dying inside the psum rendezvous leaves survivors BLOCKED in
+    XLA, and the gate's monitor hook only covers the host-side wait —
+    so detection must ride the watchdog's own thread. Drill: rank 1 dies
+    mid-run under --mode ssp; the survivor emits peer_failure and exits
+    42 within the heartbeat timeout; relaunch restores the sync-boundary
+    snapshot WITH the clock vector, and the resumed trajectory equals
+    the uninterrupted run's tail (same sync schedule, same math)."""
+    import json
+
+    ck = str(tmp_path / "ck")
+    common = ["--mode", "ssp", "--staleness", "2", "--sync-every", "2",
+              "--iters", "10", "--batch", "64", "--updater", "adam",
+              "--lr", "0.05"]
+    # leg 0: the uninterrupted oracle run (same flags, no kill)
+    ref = _run_multihost(2, list(common), local_devices=2)
+    assert all(r["event"] == "done" for r in ref)
+
+    # leg 1: save at the step-4 sync boundary, rank 1 dies at step 7
+    _PORT[0] += 9
+    rc, events = launch.run_local_job_raw(
+        2, [sys.executable, "-m", APP] + common + [
+            "--checkpoint-dir", ck, "--save-at", "4",
+            "--kill-at", "7", "--kill-rank", "1"],
+        base_port=_PORT[0],
+        env_extra={"MINIPS_FORCE_CPU": "1",
+                   "MINIPS_MH_LOCAL_DEVICES": "2"},
+        timeout=300.0)
+    assert rc != 0
+    surv = [e for e in events[0] if e.get("event") == "peer_failure"]
+    assert surv and 1 in surv[0]["dead"], events[0][-3:]
+
+    # leg 2: relaunch, restore step 4 — clock vector restarts there
+    res = _run_multihost(
+        2, list(common) + ["--checkpoint-dir", ck,
+                           "--restore-from", "4"], local_devices=2)
+    for r in res:
+        assert r["event"] == "done" and r["resumed_from"] == 4
+        assert len(r["losses"]) == 6            # iters 4..9
+    assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
+    # trajectory continuation: the resumed tail equals the uninterrupted
+    # run's steps 4..9 (the snapshot is a sync boundary, so state AND
+    # clocks are exactly the uninterrupted run's at that point)
+    for r in res:
+        ref_rank = ref[0] if ref[0]["rank"] == r["rank"] else ref[1]
+        np.testing.assert_allclose(r["losses"], ref_rank["losses"][4:],
+                                   rtol=1e-6)
+    # snapshots off a sync boundary refuse loudly
+    _PORT[0] += 9
+    rc2, ev2 = launch.run_local_job_raw(
+        2, [sys.executable, "-m", APP] + common + [
+            "--checkpoint-dir", ck, "--save-at", "3"],
+        base_port=_PORT[0],
+        env_extra={"MINIPS_FORCE_CPU": "1",
+                   "MINIPS_MH_LOCAL_DEVICES": "2"},
+        timeout=120.0)
+    assert rc2 != 0
+
+
+@pytest.mark.slow
+def test_sync_comm_int8_two_process_replicas_identical():
+    """The compressed sync wire on real processes: the gather leg means
+    every replica dequantizes the SAME compressed chunks, so post-
+    finalize fingerprints must still be bitwise EQUAL — compression
+    changes the trajectory (within EF-bounded tolerance), never the
+    replica agreement. The compiled merge must carry int8 (s8) on
+    all-to-all + all-gather wire ops."""
+    res = _run_multihost(
+        2, ["--mode", "ssp", "--staleness", "2", "--sync-every", "4",
+            "--iters", "8", "--batch", "64", "--sync-comm", "int8"])
+    for r in res:
+        assert r["event"] == "done" and r["sync_comm"] == "int8"
+        assert r["loss_last"] < r["loss_first"], r
+        assert r["sync_hlo_wire_ok"] is True, r
+        assert r["max_skew_seen"] <= 3
+    assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
 
 
 @pytest.mark.slow
