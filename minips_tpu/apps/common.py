@@ -23,9 +23,6 @@ def app_main(name: str, default_cfg: Config, run, extra_flags=None,
     if os.environ.get("MINIPS_FORCE_CPU"):
         import jax
         jax.config.update("jax_platforms", "cpu")
-    from minips_tpu.utils.compile_cache import enable_compile_cache
-
-    enable_compile_cache()  # app processes: warm-cache repeat compiles
     parser = argparse.ArgumentParser(prog=name)
     add_config_flags(parser)
     parser.add_argument("--exec", dest="exec_mode", default="spmd",

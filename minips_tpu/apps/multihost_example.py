@@ -199,13 +199,6 @@ def main(argv=None) -> int:
 
     if os.environ.get("MINIPS_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
-    # launcher children pay XLA compiles per process; the persistent
-    # cache turns repeat smoke invocations of the same tiny programs
-    # into hits (the tier budget is compile-dominated — VERDICT r1 #6)
-    from minips_tpu.utils.compile_cache import enable_compile_cache
-
-    enable_compile_cache()
-
     from minips_tpu.comm import cluster
 
     multi = cluster.initialize()

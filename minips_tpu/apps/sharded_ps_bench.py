@@ -76,9 +76,6 @@ def main(argv=None) -> int:
 
         if rank != 0 or os.environ.get("MINIPS_FORCE_CPU"):
             jax.config.update("jax_platforms", "cpu")
-        from minips_tpu.utils.compile_cache import enable_compile_cache
-
-        enable_compile_cache()  # warm-cache repeat compiles
         import jax.numpy as jnp
 
         backend = jax.default_backend()

@@ -68,9 +68,6 @@ def main(argv=None) -> int:
 
     if os.environ.get("MINIPS_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
-    from minips_tpu.utils.compile_cache import enable_compile_cache
-
-    enable_compile_cache()  # launcher children: warm-cache repeat compiles
     import jax.numpy as jnp
     import numpy as np
 

@@ -86,9 +86,6 @@ def main(argv=None) -> int:
     # ignores JAX_PLATFORMS, so force via config before any backend touch.
     if os.environ.get("MINIPS_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
-    from minips_tpu.utils.compile_cache import enable_compile_cache
-
-    enable_compile_cache()  # launcher children: warm-cache repeat compiles
     import numpy as np
 
     from minips_tpu.comm.heartbeat import HeartbeatMonitor

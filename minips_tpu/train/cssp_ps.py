@@ -271,6 +271,8 @@ class CollectiveSSPPS:
         for name in sorted(self.dense):
             t = self.dense[name]
             delta = self._sub(t.params, self._dense_base[name])
+            # the plane blocks per collective (SyncPlane.allreduce_sum:
+            # one in flight at a time, or Gloo communicator setup races)
             merged = self.plane.allreduce_sum(delta)
             new = self._add(self._dense_base[name], merged)
             t.params = new

@@ -110,14 +110,24 @@ class SyncPlane:
         become one ROW of the (nprocs, length) global array device-to-
         device (no host copy), the psum's replica groups cross the
         process boundary (DCN on a pod), and the replicated result maps
-        back to a local-mesh vector with the caller's sharding."""
+        back to a local-mesh vector with the caller's sharding.
+
+        BLOCKS before returning — the plane runs one collective in
+        flight at a time. A sync round launches MANY distinct collective
+        programs (per table, per optimizer leaf, row merges retraced per
+        union size); letting them pile up in the async dispatch queue
+        intermittently deadlocked the Gloo communicator setups on the
+        loopback smokes (both ranks stuck inside a LOCAL jit while the
+        backend blocked on a half-constructed communicator). The sync is
+        a rendezvous anyway, so serializing costs only pipelining the
+        merge with local work it never overlapped usefully."""
         n = int(vec.shape[0])
         shards = sorted(vec.addressable_shards,
                         key=lambda s: s.index[0].start or 0)
         rows = [s.data.reshape(1, -1) for s in shards]
         garr = jax.make_array_from_single_device_arrays(
             (self.nprocs, n), self._gspec, rows)
-        merged = self._merge(garr)
+        merged = jax.block_until_ready(self._merge(garr))
         cols = sorted(merged.addressable_shards,
                       key=lambda s: s.index[1].start or 0)
         return jax.make_array_from_single_device_arrays(
@@ -210,7 +220,9 @@ class SyncPlane:
         rows = [s.data.reshape(1, -1) for s in shards]
         garr = jax.make_array_from_single_device_arrays(
             (self.nprocs, padded), self._gspec, rows)
-        merged_g, sent_g, gap_g = self._q_merge_for(comm)(garr)
+        # block: one collective in flight at a time (see allreduce_sum)
+        merged_g, sent_g, gap_g = jax.block_until_ready(
+            self._q_merge_for(comm)(garr))
 
         def back(arr):
             cols = sorted(arr.addressable_shards,
@@ -512,6 +524,37 @@ class CollectiveSSP:
         return self.params
 
 
+def validate_snapshot_schedule(ckpt_dir, save_at: int, restore_from: int,
+                               iters: int, sync_every: int) -> int:
+    """Checkpoint/recovery drill plumbing (SURVEY §5.3 on the
+    collective-SSP path): snapshots are only meaningful at SYNC
+    boundaries (replicas are bitwise-identical right after a merge, so
+    every rank can save/restore its own copy and the clock vector
+    restarts coherent — an off-boundary snapshot would save N different
+    divergent replicas). Returns the resolved save step; refuses loudly
+    (SystemExit) on any schedule that would violate the invariant."""
+    if ckpt_dir and not save_at and not restore_from:
+        # --save-at 0 means "at the end" (the fused path's semantics);
+        # here the end must be a sync boundary, so round DOWN — silently
+        # writing nothing would strand the restore leg
+        save_at = (iters // sync_every) * sync_every
+        if save_at == 0:
+            raise SystemExit(
+                f"--checkpoint-dir with --iters {iters} < "
+                f"--sync-every {sync_every}: no sync boundary ever "
+                "happens, nothing to snapshot")
+    for flag, val in (("--save-at", save_at),
+                      ("--restore-from", restore_from)):
+        if val and val % sync_every:
+            raise SystemExit(
+                f"{flag} {val} is not a sync boundary (sync-every "
+                f"{sync_every}); CollectiveSSP snapshots must land "
+                "right after a merge, where replicas are identical")
+    if (save_at or restore_from) and not ckpt_dir:
+        raise SystemExit("--save-at/--restore-from need --checkpoint-dir")
+    return save_at
+
+
 def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
                  watchdog) -> int:
     """The multihost_example ``--mode bsp|ssp|asp`` runner: LR on
@@ -567,34 +610,11 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
         opt_sync=getattr(args, "opt_sync", "local"),
         sync_comm=getattr(args, "sync_comm", "float32"))
 
-    # ---- checkpoint/recovery drill plumbing (SURVEY §5.3 on the
-    # collective-SSP path): snapshots are only meaningful at SYNC
-    # boundaries (replicas are bitwise-identical right after a merge, so
-    # every rank can save/restore its own copy and the clock vector
-    # restarts coherent — an off-boundary snapshot would save N
-    # different divergent replicas)
     ckpt_dir = getattr(args, "checkpoint_dir", None)
-    save_at = getattr(args, "save_at", 0)
+    save_at = validate_snapshot_schedule(
+        ckpt_dir, getattr(args, "save_at", 0),
+        getattr(args, "restore_from", 0), args.iters, args.sync_every)
     restore_from = getattr(args, "restore_from", 0)
-    if ckpt_dir and not save_at and not restore_from:
-        # --save-at 0 means "at the end" (the fused path's semantics);
-        # here the end must be a sync boundary, so round DOWN — silently
-        # writing nothing would strand the restore leg
-        save_at = (args.iters // args.sync_every) * args.sync_every
-        if save_at == 0:
-            raise SystemExit(
-                f"--checkpoint-dir with --iters {args.iters} < "
-                f"--sync-every {args.sync_every}: no sync boundary ever "
-                "happens, nothing to snapshot")
-    for flag, val in (("--save-at", save_at),
-                      ("--restore-from", restore_from)):
-        if val and val % args.sync_every:
-            raise SystemExit(
-                f"{flag} {val} is not a sync boundary (sync-every "
-                f"{args.sync_every}); CollectiveSSP snapshots must land "
-                "right after a merge, where replicas are identical")
-    if (save_at or restore_from) and not ckpt_dir:
-        raise SystemExit("--save-at/--restore-from need --checkpoint-dir")
 
     start = 0
     if restore_from:

@@ -26,7 +26,28 @@ def enable_compile_cache(cache_dir: str | None = None) -> str | None:
     Default location: ``$MINIPS_COMPILE_CACHE`` if set, else
     ``~/.cache/minips_tpu/xla`` — deliberately OUTSIDE the repo so driver
     checkouts/clean trees keep their warm cache.
-    """
+
+    Multi-process jobs get a PER-RANK subdirectory: two ranks of one job
+    compile the same programs at the same moment, and sharing one cache
+    dir between them deadlocked the BSP lockstep smokes (a rank stalled
+    >60s inside compilation while its peer waited at the consistency
+    gate). No in-tree caller is ranked today (see next paragraph) —
+    the branch is defensive, for any future ranked caller.
+
+    LAUNCHER CHILDREN DO NOT CALL THIS (round-5 finding, re-attempted
+    twice — do not try a third time without new evidence). Attempt 1:
+    per-rank dirs, warm reads hung children intermittently with XLA
+    logging ``cpu_aot_loader ... could lead to execution errors such as
+    SIGILL`` (persistent ~/.cache artifacts from a different sandbox
+    host's CPU). Attempt 2: host-fingerprint-scoped dirs (CPU flags +
+    jaxlib hash) to rule out foreign artifacts — the wd collective
+    smokes then ran 2.5x SLOWER and the bsp leg reproducibly died on
+    Gloo's 30s rendezvous deadline (``GetKeyValue() timed out``): with
+    min-compile-time 0 every tiny program pays a serialize+write, and
+    on this 1-core box that inflates and SKEWS the two ranks' arrival
+    at their first collective past the deadline. The single-process
+    test runner and bench keep the cache (no rendezvous to miss); the
+    multi-process smokes run cache-less and eat the compiles."""
     if os.environ.get("MINIPS_NO_COMPILE_CACHE"):
         return None
     import jax
@@ -34,6 +55,9 @@ def enable_compile_cache(cache_dir: str | None = None) -> str | None:
     path = (cache_dir
             or os.environ.get("MINIPS_COMPILE_CACHE")
             or os.path.expanduser("~/.cache/minips_tpu/xla"))
+    rank = os.environ.get("MINIPS_PROC_ID")
+    if rank is not None:
+        path = os.path.join(path, f"rank{rank}")
     try:
         os.makedirs(path, exist_ok=True)
     except OSError:

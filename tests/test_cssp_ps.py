@@ -409,10 +409,13 @@ def test_wd_collective_ssp_two_process():
 
 
 @pytest.mark.slow
-def test_wd_collective_bsp_lockstep_and_asp_never_blocks():
-    """The two ends of the axis on the wd workload: bsp holds skew <= 1
-    with one merge per step; asp's gate never blocks (gate_waits == 0
-    everywhere) while the rendezvous still bounds drift."""
+def test_wd_collective_bsp_lockstep():
+    """The strict end of the axis on the wd workload: bsp holds skew <= 1
+    with one merge per step and identical replicas. (asp's never-blocks
+    property is mode-generic — staleness_for pins asp = staleness inf for
+    every runner, and the lr-path smokes + bench_ssp assert gate_waits==0
+    under asp; a wd-specific asp launcher job re-proved the same gate
+    constant at ~15s of tier budget.)"""
     res = _run_multihost(
         2, ["--model", "wd", "--mode", "bsp", "--iters", "4",
             "--batch", "64", "--num-slots", "65536"])
@@ -422,14 +425,33 @@ def test_wd_collective_bsp_lockstep_and_asp_never_blocks():
         assert r["sync_rounds"] == 4
     assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
 
-    res = _run_multihost(
-        2, ["--model", "wd", "--mode", "asp", "--sync-every", "2",
-            "--iters", "4", "--batch", "64", "--num-slots", "65536",
-            "--slow-rank", "1", "--slow-ms", "20"])
-    for r in res:
-        assert r["event"] == "done"
-        assert r["gate_waits"] == 0, r
-    assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
+
+def test_snapshot_schedule_refuses_off_boundary():
+    """The sync-boundary snapshot invariant, unit-level (the launcher
+    drill covers the happy path; the refusals are pure schedule logic —
+    ssp_spmd.validate_snapshot_schedule): off-boundary --save-at /
+    --restore-from refuse, iters below one sync window refuse, save
+    without a dir refuses, and the default save step rounds DOWN to the
+    last boundary."""
+    from minips_tpu.train.ssp_spmd import validate_snapshot_schedule
+
+    # off-boundary save and restore refuse loudly
+    with pytest.raises(SystemExit, match="not a sync boundary"):
+        validate_snapshot_schedule("/tmp/ck", 3, 0, iters=16, sync_every=4)
+    with pytest.raises(SystemExit, match="not a sync boundary"):
+        validate_snapshot_schedule("/tmp/ck", 0, 6, iters=16, sync_every=4)
+    # a job too short to ever sync has nothing coherent to snapshot
+    with pytest.raises(SystemExit, match="no sync boundary"):
+        validate_snapshot_schedule("/tmp/ck", 0, 0, iters=3, sync_every=8)
+    # snapshot flags without a directory refuse
+    with pytest.raises(SystemExit, match="need --checkpoint-dir"):
+        validate_snapshot_schedule(None, 8, 0, iters=16, sync_every=4)
+    # default (--save-at 0) resolves to the LAST boundary, rounded down
+    assert validate_snapshot_schedule(
+        "/tmp/ck", 0, 0, iters=14, sync_every=4) == 12
+    # explicit boundary-aligned values pass through unchanged
+    assert validate_snapshot_schedule(
+        "/tmp/ck", 8, 4, iters=16, sync_every=4) == 8
 
 
 @pytest.mark.slow
@@ -515,16 +537,6 @@ def test_collective_ssp_kill_detect_relaunch_resume(tmp_path):
         ref_rank = ref[0] if ref[0]["rank"] == r["rank"] else ref[1]
         np.testing.assert_allclose(r["losses"], ref_rank["losses"][4:],
                                    rtol=1e-6)
-    # snapshots off a sync boundary refuse loudly
-    _PORT[0] += 9
-    rc2, ev2 = launch.run_local_job_raw(
-        2, [sys.executable, "-m", APP] + common + [
-            "--checkpoint-dir", ck, "--save-at", "3"],
-        base_port=_PORT[0],
-        env_extra={"MINIPS_FORCE_CPU": "1",
-                   "MINIPS_MH_LOCAL_DEVICES": "2"},
-        timeout=120.0)
-    assert rc2 != 0
 
 
 @pytest.mark.slow
