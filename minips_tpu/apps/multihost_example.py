@@ -32,6 +32,7 @@ import json
 import os
 import sys
 import time
+from contextlib import contextmanager
 
 
 class _Watchdog:
@@ -71,12 +72,52 @@ class _Watchdog:
         peer closing its bus after finishing must not read as a death."""
         self._armed = False
 
+    def absorb_collective_failure(self, exc: BaseException) -> None:
+        """A dead peer does NOT always leave survivors blocked: on the
+        Gloo loopback transport the broken TCP pair surfaces INSTANTLY
+        as a JaxRuntimeError in whoever touches the collective's output
+        — faster than the heartbeat timeout, so the structured
+        peer_failure protocol would lose the race to a raw traceback.
+        Hold the rank here long enough for the monitor to confirm and
+        NAME the corpse (its on_failure callback prints peer_failure
+        and exits 42); if no peer is confirmed dead the error was not a
+        death — re-raise it."""
+        if self.monitor is not None and self._armed:
+            deadline = time.monotonic() + 3 * self.monitor.timeout + 2.0
+            while time.monotonic() < deadline:
+                self.monitor.check()  # on_failure → print + exit 42
+                time.sleep(0.1)
+        raise exc
+
+    @contextmanager
+    def absorbing(self):
+        """Run a training loop under the instant-Gloo-error →
+        peer_failure translation (one spelling for every runner — see
+        absorb_collective_failure)."""
+        import jax
+
+        try:
+            yield
+        except jax.errors.JaxRuntimeError as e:
+            self.absorb_collective_failure(e)
+
     def close(self) -> None:
         self.disarm()
         if self.monitor is not None:
             self.monitor.stop()
         if self.bus is not None:
             self.bus.close()
+
+
+def _finish(rc: int) -> int:
+    """Clean-exit join point: coordinated jax.distributed disconnect
+    (cluster.shutdown) AFTER the result line is printed — without it the
+    coordinator rank's exit races the followers' error-polling threads
+    and a finished follower can be fatally terminated into rc!=0."""
+    from minips_tpu.comm import cluster
+
+    cluster.shutdown()
+    return rc
 
 
 def main(argv=None) -> int:
@@ -231,7 +272,7 @@ def main(argv=None) -> int:
         if args.model == "lr":
             from minips_tpu.train.ssp_spmd import run_ssp_spmd
 
-            return run_ssp_spmd(args, rank, nprocs, multi, watchdog)
+            return _finish(run_ssp_spmd(args, rank, nprocs, multi, watchdog))
         if args.oracle_hosts:
             raise SystemExit("--oracle-hosts is the lr model's bitwise "
                              "oracle; wd/lm assert replica agreement "
@@ -249,13 +290,14 @@ def main(argv=None) -> int:
         from minips_tpu.train.cssp_ps import run_lm_cssp, run_wd_cssp
 
         if args.model == "wd":
-            return run_wd_cssp(args, rank, nprocs, multi, watchdog)
-        return run_lm_cssp(args, rank, nprocs, multi, watchdog)
+            return _finish(run_wd_cssp(args, rank, nprocs, multi, watchdog))
+        return _finish(run_lm_cssp(args, rank, nprocs, multi, watchdog))
     if args.model == "wd":
-        return _run_wd(args, mesh, rank, nprocs, per, multi, rng,
-                       watchdog)
+        return _finish(_run_wd(args, mesh, rank, nprocs, per, multi,
+                               rng, watchdog))
     if args.model == "lm":
-        return _run_lm_sp(args, mesh, rank, nprocs, multi, watchdog)
+        return _finish(_run_lm_sp(args, mesh, rank, nprocs, multi,
+                               watchdog))
 
     dt = DenseTable(lr_model.init(args.dim), mesh, updater=args.updater,
                     lr=args.lr)
@@ -298,22 +340,26 @@ def main(argv=None) -> int:
 
     losses = []
     t0 = time.monotonic()
-    for i in range(start, args.iters):
-        if args.kill_at and rank == args.kill_rank and i == args.kill_at:
-            os._exit(137)
-        x, y = next_global()
-        batch = cluster.global_batch(
-            mesh, {"x": x[rank * per:(rank + 1) * per],
-                   "y": y[rank * per:(rank + 1) * per]})
-        losses.append(float(dt.step_inplace(step, batch)))
-        if ckptr is not None and i + 1 == save_at:
-            # coordinated multi-host save: every process writes ONLY its
-            # addressable shards of the live sharded arrays (TensorStore
-            # under orbax) — no host gather, no full copy anywhere
-            ckptr.save(os.path.join(args.checkpoint_dir, f"step{i + 1}"),
-                       args=ocp_args.StandardSave(dt.global_arrays()),
-                       force=True)
-            ckpt_fp = float(cluster.host_copy(dt.params).sum())
+    with watchdog.absorbing():
+        for i in range(start, args.iters):
+            if args.kill_at and rank == args.kill_rank \
+                    and i == args.kill_at:
+                os._exit(137)
+            x, y = next_global()
+            batch = cluster.global_batch(
+                mesh, {"x": x[rank * per:(rank + 1) * per],
+                       "y": y[rank * per:(rank + 1) * per]})
+            losses.append(float(dt.step_inplace(step, batch)))
+            if ckptr is not None and i + 1 == save_at:
+                # coordinated multi-host save: every process writes ONLY
+                # its addressable shards of the live sharded arrays
+                # (TensorStore under orbax) — no host gather, no full
+                # copy anywhere
+                ckptr.save(
+                    os.path.join(args.checkpoint_dir, f"step{i + 1}"),
+                    args=ocp_args.StandardSave(dt.global_arrays()),
+                    force=True)
+                ckpt_fp = float(cluster.host_copy(dt.params).sum())
 
     # SPMD agreement fingerprint (allgathered => comparable across ranks)
     fp = float(cluster.host_copy(dt.params).sum())
@@ -351,7 +397,7 @@ def main(argv=None) -> int:
         "resumed_from": start,
     }), flush=True)
     watchdog.close()
-    return 0
+    return _finish(0)
 
 
 def _run_lm_sp(args, mesh, rank, nprocs, multi, watchdog):
@@ -405,14 +451,15 @@ def _run_lm_sp(args, mesh, rank, nprocs, multi, watchdog):
     lo = rank * dev_per_proc * T_local
     hi = lo + dev_per_proc * T_local
     losses = []
-    for i in range(args.iters):
-        toks = rng.integers(0, model["vocab"], size=(B, T + 1))
-        batch = cluster.global_batch(
-            mesh,
-            {"inp": toks[:, :-1][:, lo:hi].astype(np.int32),
-             "tgt": toks[:, 1:][:, lo:hi].astype(np.int32)},
-            spec=seq_spec)
-        losses.append(float(dt.step_inplace(step, batch)))
+    with watchdog.absorbing():
+        for i in range(args.iters):
+            toks = rng.integers(0, model["vocab"], size=(B, T + 1))
+            batch = cluster.global_batch(
+                mesh,
+                {"inp": toks[:, :-1][:, lo:hi].astype(np.int32),
+                 "tgt": toks[:, 1:][:, lo:hi].astype(np.int32)},
+                spec=seq_spec)
+            losses.append(float(dt.step_inplace(step, batch)))
 
     fp = float(cluster.host_copy(dt.params).sum())
     watchdog.disarm()
@@ -465,12 +512,13 @@ def _run_wd(args, mesh, rank, nprocs, per, multi, rng, watchdog):
     # sampled from it with a shared stream and each rank feeds its slice
     data = synthetic.criteo_like(8192, seed=args.seed)
     losses = []
-    for i in range(args.iters):
-        sel = rng.integers(0, data["y"].shape[0], size=args.batch)
-        lo, hi = rank * per, (rank + 1) * per
-        batch = cluster.global_batch(
-            mesh, {k: v[sel][lo:hi] for k, v in data.items()})
-        losses.append(float(ps(batch)))
+    with watchdog.absorbing():
+        for i in range(args.iters):
+            sel = rng.integers(0, data["y"].shape[0], size=args.batch)
+            lo, hi = rank * per, (rank + 1) * per
+            batch = cluster.global_batch(
+                mesh, {k: v[sel][lo:hi] for k, v in data.items()})
+            losses.append(float(ps(batch)))
 
     fp = float(cluster.host_copy(emb_t.emb).sum()) \
         + float(cluster.host_copy(deep_t.params).sum())

@@ -78,6 +78,21 @@ def barrier(name: str = "minips_barrier", timeout_s: int = 120) -> None:
     multihost_utils.sync_global_devices(name)
 
 
+def shutdown() -> None:
+    """Leave the cluster COORDINATED: barrier, then disconnect from the
+    coordination service. Without the explicit disconnect, ranks race at
+    interpreter exit — the coordinator (process 0) can die while a
+    follower's error-polling thread is still attached, and that follower
+    then terminates itself with a fatal 'leader task died' error AFTER
+    its work (and its result line) completed: a clean run reported as
+    rc!=0. Call this as the last cluster op of every multi-process job;
+    single-process it is a no-op."""
+    if jax.process_count() == 1:
+        return
+    barrier("minips_shutdown")
+    jax.distributed.shutdown()
+
+
 def global_batch(mesh, batch: dict, axis: str = "data",
                  spec=None) -> dict:
     """Per-process local batch leaves → ONE global array dict — the

@@ -143,6 +143,124 @@ def wait(procs: list[subprocess.Popen], timeout: Optional[float] = None,
     return rc
 
 
+# --------------------------------------------------------------- fork spawn
+#
+# Local smoke/bench jobs spawn O(100) short-lived ranks per test tier, and
+# each subprocess rank pays ~2.5s just importing jax before it runs a line
+# of app code — on the 1-core CI box that import bill alone was blowing the
+# driver's per-tier budget. The forkserver path preloads jax ONCE in a
+# clean server process (started fresh via exec, so no inherited XLA
+# threads from the pytest runner) and forks ranks from it in ~100ms;
+# the app module itself is imported post-fork from disk, so children run
+# current code with the process isolation the drills rely on (own pid,
+# own backend, killable with SIGKILL). Production spawns (`spawn()`, ssh,
+# TPU-bound ranks) keep plain subprocess: PJRT plugins and fork don't mix,
+# so only ranks pinned to CPU (MINIPS_FORCE_CPU) take the fast path.
+# Opt out with MINIPS_SPAWN=subprocess.
+
+_FORK_CTX = None
+
+
+def _fork_ctx():
+    global _FORK_CTX
+    if _FORK_CTX is None:
+        import multiprocessing as mp
+
+        ctx = mp.get_context("forkserver")
+        # preloading minips_tpu (not just jax) means ranks fork with the
+        # whole framework imported — the app module itself is the only
+        # import left post-fork. The package has no import-time state
+        # that differs from a fresh import (no module-level pids/uuids/
+        # clocks; atexit hooks register at runtime, and the forked rank
+        # replays them at exit — see _fork_child_main's finally), so the
+        # fork copy behaves like a cold import. Caveat: the server lives
+        # for the parent process's lifetime, so code edits between two
+        # jobs of ONE parent are invisible to the second job — a fresh
+        # pytest/bench invocation gets a fresh server.
+        ctx.set_forkserver_preload(["jax", "minips_tpu"])
+        _FORK_CTX = ctx
+    return _FORK_CTX
+
+
+def _fork_child_main(argv: list[str], env: dict, out_path: str) -> None:
+    """Runs inside the forked rank: adopt the launcher-built env, wire
+    stdout+stderr to the harvest file (the smoke protocol reads JSON
+    lines from it), then execute ``python -m <module>`` semantics via
+    runpy. SystemExit propagates to multiprocessing's bootstrap, which
+    maps it to the process exit code exactly like a subprocess would."""
+    import runpy
+
+    fd = os.open(out_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    os.dup2(fd, 1)
+    os.dup2(fd, 2)
+    os.close(fd)
+    os.environ.clear()
+    os.environ.update(env)
+    i = argv.index("-m")
+    mod, args = argv[i + 1], argv[i + 2:]
+    sys.argv = [mod] + list(args)
+    try:
+        runpy.run_module(mod, run_name="__main__", alter_sys=True)
+    finally:
+        # multiprocessing's bootstrap leaves via os._exit, which skips
+        # atexit — but a subprocess rank WOULD have run its atexit hooks
+        # (the shm_store leader's segment unlink registers there, and so
+        # do jax's own teardown hooks). Run them explicitly so the fork
+        # path keeps subprocess exit semantics; then flush the block-
+        # buffered file stdout so the harvester sees the result line.
+        import atexit
+
+        try:
+            atexit._run_exitfuncs()
+        except Exception:
+            pass
+        try:
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:
+            pass
+
+
+class _ForkProc:
+    """Popen-shaped handle over a forked rank — just enough surface for
+    :func:`wait` (poll/terminate/kill/wait) and the drills (.pid)."""
+
+    def __init__(self, proc):
+        self._p = proc
+        self.pid = proc.pid
+
+    def poll(self):
+        return self._p.exitcode  # None while running; -signum on kill
+
+    def terminate(self):
+        self._p.terminate()
+
+    def kill(self):
+        self._p.kill()
+
+    def wait(self, timeout=None):
+        self._p.join(timeout)
+        if self._p.exitcode is None:
+            raise subprocess.TimeoutExpired(cmd="<forked rank>",
+                                            timeout=timeout)
+        return self._p.exitcode
+
+
+def _spawn_rank(argv: list[str], env: dict, outfile):
+    """One local rank: forked from the jax-warm server when eligible
+    (CPU-pinned, ``python -m`` form), else a plain subprocess."""
+    if (os.environ.get("MINIPS_SPAWN", "fork") != "subprocess"
+            and env.get("MINIPS_FORCE_CPU")
+            and len(argv) >= 3 and argv[0] == sys.executable
+            and argv[1] == "-m"):
+        p = _fork_ctx().Process(
+            target=_fork_child_main, args=(argv, env, outfile.name))
+        p.start()
+        return _ForkProc(p)
+    return subprocess.Popen(argv, env=env, stdout=outfile,
+                            stderr=subprocess.STDOUT)
+
+
 def run_local_job(n: int, argv: list[str], *, base_port: int,
                   env_extra: Optional[dict] = None,
                   timeout: float = 240.0) -> list[dict]:
@@ -162,41 +280,47 @@ def run_local_job(n: int, argv: list[str], *, base_port: int,
         env = child_env(rank, hosts, base_port)
         if env_extra:
             env.update(env_extra)
-        procs.append(subprocess.Popen(
-            argv, env=env, stdout=outs[rank], stderr=subprocess.STDOUT))
+        procs.append(_spawn_rank(argv, env, outs[rank]))
     rc = wait(procs, timeout=timeout)
+    # read EVERY rank's output before judging any single one: the rank
+    # that violates the protocol is often an innocent victim (killed by
+    # the launcher after the real culprit crashed), so error messages
+    # always carry all ranks' tails, not just the first bad one's
+    texts = []
+    for f in outs:
+        f.flush()
+        f.seek(0)
+        texts.append(f.read())
+        f.close()
+        os.unlink(f.name)
+    raw = "\n".join(f"--- rank {r} output tail ---\n{t[-1200:]}"
+                    for r, t in enumerate(texts))
     results = []
-    try:
-        for f in outs:
-            f.flush()
-            f.seek(0)
-            text = f.read()
-            lines = []
-            last_brace_ok = True
-            for ln in text.splitlines():
-                if not ln.strip().startswith("{"):
-                    continue
-                try:  # tolerate non-JSON log lines that start with '{'
-                    lines.append(json.loads(ln))
-                    last_brace_ok = True
-                except json.JSONDecodeError:
-                    last_brace_ok = False
-            if not lines:
-                raise RuntimeError(
-                    f"worker produced no JSON output (rc={rc}):\n{text}")
-            if not last_brace_ok:
-                # the FINAL brace line is the result-dict protocol slot; if
-                # it is malformed, surfacing an earlier metrics line as the
-                # "result" would silently corrupt the harvest
-                raise RuntimeError(
-                    f"worker's final brace line is not JSON (rc={rc}):\n{text}")
-            results.append(lines[-1])
-    finally:
-        for f in outs:
-            f.close()
-            os.unlink(f.name)
+    for text in texts:
+        lines = []
+        last_brace_ok = True
+        for ln in text.splitlines():
+            if not ln.strip().startswith("{"):
+                continue
+            try:  # tolerate non-JSON log lines that start with '{'
+                lines.append(json.loads(ln))
+                last_brace_ok = True
+            except json.JSONDecodeError:
+                last_brace_ok = False
+        if not lines:
+            raise RuntimeError(
+                f"worker produced no JSON output (rc={rc}):\n{raw}")
+        if not last_brace_ok:
+            # the FINAL brace line is the result-dict protocol slot; if
+            # it is malformed, surfacing an earlier metrics line as the
+            # "result" would silently corrupt the harvest
+            raise RuntimeError(
+                f"worker's final brace line is not JSON (rc={rc}):\n{raw}")
+        results.append(lines[-1])
     if rc != 0:
-        raise RuntimeError(f"job failed rc={rc}: {results}")
+        # a rank can print its done line and STILL exit nonzero (teardown
+        # failure); the parsed results alone would hide the traceback
+        raise RuntimeError(f"job failed rc={rc}: {results}\n{raw}")
     return results
 
 
@@ -220,8 +344,7 @@ def run_local_job_raw(n: int, argv: list[str], *, base_port: int,
         env = child_env(rank, hosts, base_port)
         if env_extra:
             env.update(env_extra)
-        procs.append(subprocess.Popen(
-            argv, env=env, stdout=outs[rank], stderr=subprocess.STDOUT))
+        procs.append(_spawn_rank(argv, env, outs[rank]))
     rc = wait(procs, timeout=timeout, kill_on_failure=kill_on_failure)
     events = []
     for f in outs:

@@ -396,15 +396,16 @@ def run_wd_cssp(args, rank: int, nprocs: int, multi: bool,
     rng = np.random.default_rng(args.seed)
     jitter_rng = np.random.default_rng(1000 + rank)
     losses = []
-    for i in range(args.iters):
-        sel = rng.integers(0, data["y"].shape[0], size=args.batch)
-        if args.slow_ms and rank == args.slow_rank:
-            time.sleep(args.slow_ms / 1000.0)
-        if args.jitter_ms and jitter_rng.random() < args.jitter_prob:
-            time.sleep(args.jitter_ms / 1000.0)
-        lo, hi = rank * per, (rank + 1) * per
-        losses.append(trainer.step(
-            {k: v[sel][lo:hi] for k, v in data.items()}))
+    with watchdog.absorbing():  # dead peer ⇒ instant Gloo error in sync
+        for i in range(args.iters):
+            sel = rng.integers(0, data["y"].shape[0], size=args.batch)
+            if args.slow_ms and rank == args.slow_rank:
+                time.sleep(args.slow_ms / 1000.0)
+            if args.jitter_ms and jitter_rng.random() < args.jitter_prob:
+                time.sleep(args.jitter_ms / 1000.0)
+            lo, hi = rank * per, (rank + 1) * per
+            losses.append(trainer.step(
+                {k: v[sel][lo:hi] for k, v in data.items()}))
     trainer.finalize()
     fp = trainer.fingerprint()
     hlo = trainer.sync_hlo() if trainer._last_emb_len else ""
@@ -476,15 +477,16 @@ def run_lm_cssp(args, rank: int, nprocs: int, multi: bool,
     rng = np.random.default_rng(args.seed)
     jitter_rng = np.random.default_rng(1000 + rank)
     losses = []
-    for i in range(args.iters):
-        toks = rng.integers(0, model["vocab"],
-                            size=(args.batch, T + 1)).astype(np.int32)
-        if args.slow_ms and rank == args.slow_rank:
-            time.sleep(args.slow_ms / 1000.0)
-        if args.jitter_ms and jitter_rng.random() < args.jitter_prob:
-            time.sleep(args.jitter_ms / 1000.0)
-        losses.append(trainer.step(
-            {"tokens": toks[rank * per:(rank + 1) * per]}))
+    with watchdog.absorbing():  # dead peer ⇒ instant Gloo error in sync
+        for i in range(args.iters):
+            toks = rng.integers(0, model["vocab"],
+                                size=(args.batch, T + 1)).astype(np.int32)
+            if args.slow_ms and rank == args.slow_rank:
+                time.sleep(args.slow_ms / 1000.0)
+            if args.jitter_ms and jitter_rng.random() < args.jitter_prob:
+                time.sleep(args.jitter_ms / 1000.0)
+            losses.append(trainer.step(
+                {"tokens": toks[rank * per:(rank + 1) * per]}))
     trainer.finalize()
 
     from minips_tpu.comm import cluster

@@ -668,40 +668,50 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
 
     losses = []
     jitter_rng = np.random.default_rng(1000 + rank)
-    for i in range(start, args.iters):
-        if getattr(args, "kill_at", 0) and rank == args.kill_rank \
-                and i == args.kill_at:
-            os._exit(137)
-        x, y = next_global()
-        if args.slow_ms and rank == args.slow_rank:
-            time.sleep(args.slow_ms / 1000.0)
-        if args.jitter_ms and jitter_rng.random() < args.jitter_prob:
-            time.sleep(args.jitter_ms / 1000.0)
-        losses.append(trainer.step(
-            {"x": x[rank * per:(rank + 1) * per],
-             "y": y[rank * per:(rank + 1) * per]}))
-        if save_at and i + 1 == save_at:
-            # the merge for this boundary already ran inside step(), so
-            # PARAMS are identical on every replica — but with
-            # opt_sync='local' the optimizer moments are rank-PRIVATE
-            # state (exactly the drift docs/consistency.md documents),
-            # so each rank snapshots its own copy, like the reference's
-            # per-server-shard Dump. Atomic tmp+rename: a crash
-            # mid-write must not leave a truncated snapshot that parses.
-            os.makedirs(ckpt_dir, exist_ok=True)
-            opt_leaves = jax.tree.leaves(trainer.table.opt_state)
-            path = os.path.join(ckpt_dir,
-                                f"cssp_step{save_at}_r{rank}.npz")
-            extra = ({"residual": np.asarray(trainer._residual)}
-                     if trainer._residual is not None else {})
-            np.savez(path + ".tmp.npz",
-                     params=np.asarray(trainer.table.params),
-                     clock=trainer.clock,
-                     sync_rounds=trainer.sync_rounds,
-                     **extra,
-                     **{f"opt{j}": np.asarray(leaf)
-                        for j, leaf in enumerate(opt_leaves)})
-            os.replace(path + ".tmp.npz", path)
+
+    def run_steps():
+        for i in range(start, args.iters):
+            if getattr(args, "kill_at", 0) and rank == args.kill_rank \
+                    and i == args.kill_at:
+                os._exit(137)
+            x, y = next_global()
+            if args.slow_ms and rank == args.slow_rank:
+                time.sleep(args.slow_ms / 1000.0)
+            if args.jitter_ms and jitter_rng.random() < args.jitter_prob:
+                time.sleep(args.jitter_ms / 1000.0)
+            losses.append(trainer.step(
+                {"x": x[rank * per:(rank + 1) * per],
+                 "y": y[rank * per:(rank + 1) * per]}))
+            if save_at and i + 1 == save_at:
+                # the merge for this boundary already ran inside step(),
+                # so PARAMS are identical on every replica — but with
+                # opt_sync='local' the optimizer moments are rank-PRIVATE
+                # state (exactly the drift docs/consistency.md documents),
+                # so each rank snapshots its own copy, like the
+                # reference's per-server-shard Dump. Atomic tmp+rename: a
+                # crash mid-write must not leave a truncated snapshot
+                # that parses.
+                os.makedirs(ckpt_dir, exist_ok=True)
+                opt_leaves = jax.tree.leaves(trainer.table.opt_state)
+                path = os.path.join(ckpt_dir,
+                                    f"cssp_step{save_at}_r{rank}.npz")
+                extra = ({"residual": np.asarray(trainer._residual)}
+                         if trainer._residual is not None else {})
+                np.savez(path + ".tmp.npz",
+                         params=np.asarray(trainer.table.params),
+                         clock=trainer.clock,
+                         sync_rounds=trainer.sync_rounds,
+                         **extra,
+                         **{f"opt{j}": np.asarray(leaf)
+                            for j, leaf in enumerate(opt_leaves)})
+                os.replace(path + ".tmp.npz", path)
+
+    # a dead peer surfaces as an INSTANT Gloo transport error in the
+    # sync collective, beating the heartbeat watchdog — absorbing() holds
+    # for the monitor to confirm+name the corpse (prints peer_failure,
+    # exits 42) or re-raises if nobody is dead
+    with watchdog.absorbing():
+        run_steps()
     trainer.finalize()
     fp = float(cluster.host_copy(trainer.table.params).sum())
     hlo = trainer.sync_hlo()
