@@ -361,25 +361,29 @@ def main(argv=None) -> int:
                     force=True)
                 ckpt_fp = float(cluster.host_copy(dt.params).sum())
 
-    # SPMD agreement fingerprint (allgathered => comparable across ranks)
-    fp = float(cluster.host_copy(dt.params).sum())
+    # fingerprint + checkpoint roundtrip are collectives too — same
+    # death translation as the training loop
+    with watchdog.absorbing():
+        # SPMD agreement fingerprint (allgathered => comparable across
+        # ranks)
+        fp = float(cluster.host_copy(dt.params).sum())
 
-    ckpt_ok = None
-    if ckptr is not None and ckpt_fp is not None:
-        # restore into a FRESH table (same template/shardings) and check
-        # it reproduces the state that was saved — the recovery path of
-        # SURVEY.md §3.5 with globally-sharded state
-        dt2 = DenseTable(lr_model.init(args.dim), mesh,
-                         updater=args.updater, lr=args.lr)
-        restored = ckptr.restore(
-            os.path.join(args.checkpoint_dir, f"step{save_at}"),
-            args=ocp_args.StandardRestore(dt2.global_arrays()))
-        dt2.params = restored["params"]
-        dt2.opt_state = restored["opt_state"]
-        ckpt_ok = bool(abs(float(cluster.host_copy(dt2.params).sum())
-                           - ckpt_fp) < 1e-5)
-    if ckptr is not None:
-        ckptr.close()
+        ckpt_ok = None
+        if ckptr is not None and ckpt_fp is not None:
+            # restore into a FRESH table (same template/shardings) and
+            # check it reproduces the state that was saved — the
+            # recovery path of SURVEY.md §3.5 with globally-sharded state
+            dt2 = DenseTable(lr_model.init(args.dim), mesh,
+                             updater=args.updater, lr=args.lr)
+            restored = ckptr.restore(
+                os.path.join(args.checkpoint_dir, f"step{save_at}"),
+                args=ocp_args.StandardRestore(dt2.global_arrays()))
+            dt2.params = restored["params"]
+            dt2.opt_state = restored["opt_state"]
+            ckpt_ok = bool(abs(float(cluster.host_copy(dt2.params).sum())
+                               - ckpt_fp) < 1e-5)
+        if ckptr is not None:
+            ckptr.close()
 
     watchdog.disarm()  # peers closing their buses after finishing is fine
     cluster.barrier("multihost_done")  # reference Engine::Barrier
@@ -461,7 +465,8 @@ def _run_lm_sp(args, mesh, rank, nprocs, multi, watchdog):
                 spec=seq_spec)
             losses.append(float(dt.step_inplace(step, batch)))
 
-    fp = float(cluster.host_copy(dt.params).sum())
+    with watchdog.absorbing():  # the fingerprint allgather too
+        fp = float(cluster.host_copy(dt.params).sum())
     watchdog.disarm()
     cluster.barrier("multihost_lm_done")
     print(json.dumps({
@@ -520,8 +525,9 @@ def _run_wd(args, mesh, rank, nprocs, per, multi, rng, watchdog):
                 mesh, {k: v[sel][lo:hi] for k, v in data.items()})
             losses.append(float(ps(batch)))
 
-    fp = float(cluster.host_copy(emb_t.emb).sum()) \
-        + float(cluster.host_copy(deep_t.params).sum())
+    with watchdog.absorbing():  # the fingerprint allgathers too
+        fp = float(cluster.host_copy(emb_t.emb).sum()) \
+            + float(cluster.host_copy(deep_t.params).sum())
     watchdog.disarm()
     cluster.barrier("multihost_wd_done")
     import json

@@ -249,7 +249,9 @@ class _ForkProc:
 def _spawn_rank(argv: list[str], env: dict, outfile):
     """One local rank: forked from the jax-warm server when eligible
     (CPU-pinned, ``python -m`` form), else a plain subprocess."""
-    if (os.environ.get("MINIPS_SPAWN", "fork") != "subprocess"
+    spawn_mode = (env.get("MINIPS_SPAWN")
+                  or os.environ.get("MINIPS_SPAWN", "fork"))
+    if (spawn_mode != "subprocess"
             and env.get("MINIPS_FORCE_CPU")
             and len(argv) >= 3 and argv[0] == sys.executable
             and argv[1] == "-m"):

@@ -406,8 +406,10 @@ def run_wd_cssp(args, rank: int, nprocs: int, multi: bool,
             lo, hi = rank * per, (rank + 1) * per
             losses.append(trainer.step(
                 {k: v[sel][lo:hi] for k, v in data.items()}))
-    trainer.finalize()
-    fp = trainer.fingerprint()
+        # finalize + fingerprint are collectives too — keep them under
+        # the same death translation
+        trainer.finalize()
+        fp = trainer.fingerprint()
     hlo = trainer.sync_hlo() if trainer._last_emb_len else ""
 
     from minips_tpu.comm import cluster
@@ -487,11 +489,13 @@ def run_lm_cssp(args, rank: int, nprocs: int, multi: bool,
                 time.sleep(args.jitter_ms / 1000.0)
             losses.append(trainer.step(
                 {"tokens": toks[rank * per:(rank + 1) * per]}))
-    trainer.finalize()
+        # finalize + fingerprint are collectives too — keep them under
+        # the same death translation
+        trainer.finalize()
 
-    from minips_tpu.comm import cluster
+        from minips_tpu.comm import cluster
 
-    fp = float(cluster.host_copy(trainer.table.params).sum())
+        fp = float(cluster.host_copy(trainer.table.params).sum())
     hlo = trainer.sync_hlo()
     watchdog.disarm()
     cluster.barrier("cssp_lm_done")

@@ -709,11 +709,12 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
     # a dead peer surfaces as an INSTANT Gloo transport error in the
     # sync collective, beating the heartbeat watchdog — absorbing() holds
     # for the monitor to confirm+name the corpse (prints peer_failure,
-    # exits 42) or re-raises if nobody is dead
+    # exits 42) or re-raises if nobody is dead. finalize() and the
+    # fingerprint allgather are collectives too, so they stay inside.
     with watchdog.absorbing():
         run_steps()
-    trainer.finalize()
-    fp = float(cluster.host_copy(trainer.table.params).sum())
+        trainer.finalize()
+        fp = float(cluster.host_copy(trainer.table.params).sum())
     hlo = trainer.sync_hlo()
     comm = getattr(args, "sync_comm", "float32")
     # wire proof per format: f32 sync is ONE all-reduce; compressed syncs

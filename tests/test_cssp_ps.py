@@ -411,11 +411,7 @@ def test_wd_collective_ssp_two_process():
 @pytest.mark.slow
 def test_wd_collective_bsp_lockstep():
     """The strict end of the axis on the wd workload: bsp holds skew <= 1
-    with one merge per step and identical replicas. (asp's never-blocks
-    property is mode-generic — staleness_for pins asp = staleness inf for
-    every runner, and the lr-path smokes + bench_ssp assert gate_waits==0
-    under asp; a wd-specific asp launcher job re-proved the same gate
-    constant at ~15s of tier budget.)"""
+    with one merge per step and identical replicas."""
     res = _run_multihost(
         2, ["--model", "wd", "--mode", "bsp", "--iters", "4",
             "--batch", "64", "--num-slots", "65536"])
@@ -424,6 +420,39 @@ def test_wd_collective_bsp_lockstep():
         assert r["max_skew_seen"] <= 1
         assert r["sync_rounds"] == 4
     assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
+
+
+@pytest.mark.slow
+def test_wd_collective_asp_never_blocks():
+    """The loose end of the axis on the wd workload: asp's gate never
+    blocks (gate_waits == 0 on every rank, straggler included) while the
+    sync rendezvous still bounds drift — replicas agree after finalize."""
+    res = _run_multihost(
+        2, ["--model", "wd", "--mode", "asp", "--sync-every", "2",
+            "--iters", "4", "--batch", "64", "--num-slots", "65536",
+            "--slow-rank", "1", "--slow-ms", "20"])
+    for r in res:
+        assert r["event"] == "done"
+        assert r["gate_waits"] == 0, r
+    assert res[0]["param_fingerprint"] == res[1]["param_fingerprint"]
+
+
+@pytest.mark.slow
+def test_multihost_clean_exit_is_rc_zero_repeatedly():
+    """Regression pin for the jax.distributed teardown race: before
+    cluster.shutdown() (barrier + explicit coordination-service
+    disconnect, routed through multihost_example._finish), a COMPLETED
+    follower rank was fatally terminated by its error-polling thread
+    whenever the coordinator won the exit race — a clean run reported
+    rc!=0 roughly half the time once spawn got fast. Three consecutive
+    clean jobs through run_local_job (which raises on rc!=0) keep the
+    protocol honest; the wd model dispatches the most distinct
+    collective programs, making it the raciest exit."""
+    for i in range(3):
+        res = _run_multihost(
+            2, ["--model", "wd", "--mode", "bsp", "--iters", "2",
+                "--batch", "64", "--num-slots", "65536"])
+        assert all(r["event"] == "done" for r in res), (i, res)
 
 
 def test_snapshot_schedule_refuses_off_boundary():

@@ -23,6 +23,7 @@ commute, so every process's merged state matches up to float reorder noise.
 
 from __future__ import annotations
 
+import os
 import sys
 
 import numpy as np
@@ -183,6 +184,51 @@ def test_run_local_job_tolerates_non_json_brace_lines():
                 "import json; print(json.dumps({'metrics': 1})); "
                 "print({'result': 2})"],
             base_port=_PORT[0], timeout=60)
+
+
+def test_spawn_rank_path_selection(tmp_path, monkeypatch):
+    """The fork fast path is opt-in by SHAPE, not a mode switch: only
+    CPU-pinned ``python -m`` ranks fork from the jax-warm server —
+    anything else (TPU-eligible ranks, script paths, explicit opt-out)
+    must stay a plain subprocess, because PJRT plugins and fork don't
+    mix and non-module argv can't be re-run via runpy."""
+    out = (tmp_path / "o.txt").open("w+")
+    argv_m = [sys.executable, "-m", "json.tool", "--help"]
+    # a dev shell may export the escape hatches this test manipulates —
+    # start from a base env without them so each case sets its own
+    base_env = {k: v for k, v in os.environ.items()
+                if k not in ("MINIPS_FORCE_CPU", "MINIPS_SPAWN")}
+
+    # no MINIPS_FORCE_CPU in the child env => TPU-eligible => subprocess
+    p = launch._spawn_rank(argv_m, dict(base_env), out)
+    assert not isinstance(p, launch._ForkProc)
+    assert p.wait(timeout=60) == 0
+
+    env_cpu = dict(base_env)
+    env_cpu["MINIPS_FORCE_CPU"] = "1"
+    # script-path argv (not -m) => subprocess even when CPU-pinned
+    p = launch._spawn_rank([sys.executable, "-c", "pass"], env_cpu, out)
+    assert not isinstance(p, launch._ForkProc)
+    assert p.wait(timeout=60) == 0
+
+    # explicit opt-out wins over eligibility
+    monkeypatch.setenv("MINIPS_SPAWN", "subprocess")
+    p = launch._spawn_rank(argv_m, env_cpu, out)
+    assert not isinstance(p, launch._ForkProc)
+    assert p.wait(timeout=60) == 0
+    monkeypatch.delenv("MINIPS_SPAWN")
+
+    # the eligible shape forks; exit code and output land like a
+    # subprocess's would (argparse error => rc 2, message in the file)
+    fout = (tmp_path / "f.txt").open("w+")
+    p = launch._spawn_rank(
+        [sys.executable, "-m", "minips_tpu.launch", "--n", "0"],
+        env_cpu, fout)
+    assert isinstance(p, launch._ForkProc)
+    assert p.wait(timeout=120) == 2  # need --hostfile or --n
+    fout.flush()
+    fout.seek(0)
+    assert "hostfile" in fout.read()
 
 
 def test_wide_deep_multiproc_ssp_staleness4():
