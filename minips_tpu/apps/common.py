@@ -239,20 +239,65 @@ def shard_checkpointing(bus, nprocs: int, checkpoint_dir, rank: int):
     agree, restore_barrier = step_negotiator(bus, nprocs)
 
     def resume(tables: dict, every: int = 0):
+        from minips_tpu.ckpt import elastic
         from minips_tpu.ckpt.checkpoint import Checkpointer
 
-        ck = Checkpointer(os.path.join(checkpoint_dir, f"rank{rank}"),
-                          tables)
-        common = agree(ck.list_steps())
-        # steps above the agreed one belong to a dead incarnation; left
-        # behind they could win a LATER negotiation with mixed-incarnation
-        # shards (torn table) — purge before training
-        ck.prune_above(common)
-        if common > 0:
+        my_dir = os.path.join(checkpoint_dir, f"rank{rank}")
+        ck = Checkpointer(my_dir, tables)
+        # ---- decision phase: READS ONLY. agree() is a rendezvous, the
+        # elastic scan reads the shared dir, and no rank writes until
+        # past restore_barrier — so every rank reaches the SAME decision
+        # (a pre-barrier prune could race a peer's scan into a divergent
+        # one).
+        #
+        # Negotiate only over steps saved under MY CURRENT partition: a
+        # surviving rank relaunched into a different world size still
+        # holds old-world steps whose lo/shard_size don't fit this table
+        # — offering them would crash (or corrupt) the restore.
+        mine = [s for s in ck.list_steps()
+                if elastic.step_matches_layout(my_dir, s, tables)]
+        common = agree(mine)
+        # The newest complete checkpoint wins REGARDLESS of world size:
+        # a same-layout common step can be OLDER than another world's
+        # newest one (this rank's pre-shrink saves vs the shrunk world's
+        # later training) — restoring it would silently roll training
+        # back, and the prune below would then delete the newer world's
+        # checkpoint.
+        found = elastic.find_elastic_step(checkpoint_dir, tables)
+        if found is not None and found[0] > common:
+            # ELASTIC path (ckpt/elastic.py; requires a shared
+            # checkpoint_dir — the reference's HDFS assumption): the
+            # newest complete checkpoint belongs to a DIFFERENT world
+            # size, so each rank reassembles its row range from the old
+            # shards' overlapping slices, optimizer state included.
+            step, old_n = found
+            clock = elastic.read_saved_clock(checkpoint_dir, step)
+            for name, t in tables.items():
+                if hasattr(t, "shard_lo"):  # a ShardedTable
+                    t.load_shard_state_dict(
+                        elastic.reshard_table_state(
+                            checkpoint_dir, step, old_n, name,
+                            t.num_rows, t.shard_lo, t.part.shard_size))
+                else:  # the trainer: clock vector (publishes it)
+                    t.load_state_dict({"clock": np.asarray(clock)})
+            common = step
+        elif common > 0:
             ck.restore(common)  # trainer restore publishes the clock
         # nobody trains until every rank's shard overwrite is done: an
         # early rank's pushes into a mid-restore peer shard would be wiped
         restore_barrier()
+        # ---- write phase. Steps above the chosen one belong to a dead
+        # incarnation; left behind they could win a LATER negotiation
+        # with mixed-incarnation shards (torn table). With common == 0
+        # (fresh start) this wipes all local steps — nothing complete
+        # exists anywhere, so they are torn junk. The elastic path
+        # deliberately does NOT re-publish the resharded state at the
+        # restored step: overwriting the old world's files would be
+        # non-atomic across ranks, and a crash mid-republish would
+        # destroy the only consistent copy — instead the next crash
+        # simply reshards again, until the first post-resume save
+        # creates new-layout steps.
+        ck.prune_above(common)
 
         def save_hook(i: int) -> None:
             if every and (i + 1) % every == 0:

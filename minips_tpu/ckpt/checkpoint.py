@@ -15,8 +15,11 @@ the clock vector, so that is exactly what a checkpoint holds here
   orbax async checkpointing without requiring it.
 
 Recovery = construct the same tables, ``restore()`` the newest step, resume
-the loop at ``step`` (SURVEY.md §5.3: recovery is relaunch + reload; no
-elastic resize, same as the reference's fixed node set).
+the loop at ``step`` (SURVEY.md §5.3: recovery is relaunch + reload at the
+reference's fixed node set). Relaunching at a DIFFERENT world size is
+handled a layer up: ``ckpt/elastic.py`` reshards the rank-local shard
+files across partitions (beyond parity — the reference has no elastic
+resize).
 """
 
 from __future__ import annotations

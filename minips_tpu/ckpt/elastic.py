@@ -1,0 +1,224 @@
+"""Elastic resume — reshard rank-local checkpoints across WORLD SIZES.
+
+The reference's recovery is relaunch at the SAME node count + per-server
+Dump/Load (SURVEY.md §3.5: "no elastic resize, same as the reference's
+fixed node set"). minips_tpu keeps that fast path untouched and adds an
+elastic one on top: a job checkpointed by N processes can relaunch at
+M != N. Each new rank reassembles its M-way row range from the
+overlapping row slices of the N old shard files — parameters AND
+optimizer state are row-aligned in a ShardedTable (w/acc/m/v per-row,
+steps per-row), so ONE slicing rule re-partitions everything, adam
+moments included. A grown world (M > N) and a shrunk one (M < N) are the
+same math.
+
+Requirements, stated honestly:
+
+- ``checkpoint_dir`` must be a SHARED filesystem: a new rank reads OLD
+  ranks' shard files. That is the assumption the reference's HDFS-backed
+  dumps already make; per-host local dirs support only same-size resume
+  (the existing fast path).
+- resharding is only meaningful at the rank-dir layout
+  ``<checkpoint_dir>/rank<r>/step_<s>/<table>.npz`` written by
+  ``apps.common.shard_checkpointing``; the step chosen is the NEWEST one
+  whose holders form a complete old world (rank dirs 0..k-1 all hold
+  it) — a partial holder set means that incarnation's save was torn and
+  is skipped.
+
+After an elastic restore the caller should re-publish the resharded
+state at the same step under its NEW rank dir (``Checkpointer.save``),
+so the next crash resumes through the ordinary same-size path.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+import numpy as np
+
+
+def _rank_dirs(checkpoint_dir: str) -> dict[int, str]:
+    out = {}
+    try:
+        entries = os.listdir(checkpoint_dir)
+    except OSError:
+        return out
+    for d in entries:
+        m = re.fullmatch(r"rank(\d+)", d)
+        if m and os.path.isdir(os.path.join(checkpoint_dir, d)):
+            out[int(m.group(1))] = os.path.join(checkpoint_dir, d)
+    return out
+
+
+def _steps_in(rank_dir: str) -> set[int]:
+    out = set()
+    try:
+        entries = os.listdir(rank_dir)
+    except OSError:
+        return out
+    for d in entries:
+        m = re.fullmatch(r"step_(\d+)", d)
+        if m and os.path.exists(os.path.join(rank_dir, d, "manifest.json")):
+            out.add(int(m.group(1)))
+    return out
+
+
+def _fits_partition(checkpoint_dir: str, step: int, r: int, tables: dict,
+                    k: int) -> bool:
+    """True iff rank ``r``'s files at ``step`` were saved under a
+    ``k``-process partition (lo == r*shard_size(k) and padded rows ==
+    shard_size(k) for every ShardedTable)."""
+    d = os.path.join(checkpoint_dir, f"rank{r}", f"step_{step:010d}")
+    for name, t in tables.items():
+        if not hasattr(t, "shard_lo"):
+            continue
+        sz = -(-t.num_rows // k)  # RangePartitioner.shard_size at k
+        if _shard_layout(d, name) != (r * sz, sz):
+            return False
+    return True
+
+
+def find_elastic_step(checkpoint_dir: str,
+                      tables: dict) -> Optional[tuple[int, int]]:
+    """Newest ``(step, old_n)`` such that ranks 0..old_n-1 all hold
+    ``step`` saved under a CONSISTENT old_n-process partition. None if no
+    complete old world exists (fresh start).
+
+    The partition-fit check matters because one step NUMBER can carry
+    mixed layouts: an earlier elastic resume re-publishes the resharded
+    state at the same step under the new world's rank dirs, while ranks
+    beyond the new world still hold the old world's files. Candidate
+    world sizes are tried largest-first so the most complete consistent
+    layout wins."""
+    dirs = _rank_dirs(checkpoint_dir)
+    if not dirs:
+        return None
+    holders: dict[int, set[int]] = {}
+    for r, d in dirs.items():
+        for s in _steps_in(d):
+            holders.setdefault(s, set()).add(r)
+    for s in sorted(holders, reverse=True):
+        ranks = holders[s]
+        for k in range(len(ranks), 0, -1):
+            if not set(range(k)) <= ranks:
+                continue
+            if all(_fits_partition(checkpoint_dir, s, r, tables, k)
+                   for r in range(k)):
+                return s, k
+    return None
+
+
+def _shard_layout(step_dir: str,
+                  name: str) -> Optional[tuple[int, int]]:
+    """(lo, padded row count) recorded in one table's shard file, or
+    None when the file is absent/unreadable — the ONE place both layout
+    checks read, so the negotiation filter and the elastic scan cannot
+    drift apart on what 'fits' means."""
+    path = os.path.join(step_dir, f"{name}.npz")
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            return int(z["lo"]), int(z["w"].shape[0])
+    except (OSError, KeyError, ValueError):
+        return None
+
+
+def step_matches_layout(rank_dir: str, step: int, tables: dict) -> bool:
+    """True iff ``step`` in ``rank_dir`` was saved under the CALLER'S
+    partition — same shard origin (``lo``) and same padded shard row
+    count for every ShardedTable. A surviving rank relaunched into a
+    DIFFERENT world size still holds its old-world steps; offering those
+    to the resume negotiation would either crash the restore (shape/lo
+    mismatch) or, worse, silently restore the wrong rows. Steps that
+    fail this filter stay on disk — they are exactly what the elastic
+    path reshards from."""
+    d = os.path.join(rank_dir, f"step_{step:010d}")
+    for name, t in tables.items():
+        if not hasattr(t, "shard_lo"):
+            continue
+        if _shard_layout(d, name) != (t.shard_lo, t.part.shard_size):
+            return False
+    return True
+
+
+def _load_table_npz(checkpoint_dir: str, step: int, old_rank: int,
+                    name: str) -> dict[str, np.ndarray]:
+    path = os.path.join(checkpoint_dir, f"rank{old_rank}",
+                        f"step_{step:010d}", f"{name}.npz")
+    with np.load(path) as z:
+        return dict(z.items())
+
+
+def reshard_table_state(checkpoint_dir: str, step: int, old_n: int,
+                        name: str, num_rows: int, new_lo: int,
+                        new_shard_size: int) -> dict[str, np.ndarray]:
+    """Assemble the state dict for the new shard ``[new_lo, new_lo +
+    new_shard_size)`` of table ``name`` from the ``old_n`` old shard
+    files at ``step``.
+
+    Slicing rule: any leaf whose leading dimension equals the OLD
+    shard_size is row-aligned (w, acc, m, v, steps — shards are PADDED to
+    shard_size, so only the rows inside ``num_rows`` are real); ``lo`` is
+    replaced by the new shard origin; any other leaf must be identical
+    across old shards (there are none today — the assert is the tripwire
+    for a future leaf this rule cannot place)."""
+    old_sz = -(-num_rows // old_n)  # RangePartitioner.shard_size
+    new_hi = min(new_lo + new_shard_size, num_rows)
+    pieces: dict[str, list[np.ndarray]] = {}
+    passthrough: dict[str, np.ndarray] = {}
+    if new_hi <= new_lo:
+        # a grown world's last shard can lie ENTIRELY in padding
+        # (shard_lo >= num_rows): there are no rows to assemble, but the
+        # live table still expects every leaf at full shard shape — use
+        # old rank 0's leaves as the shape/dtype template, zero-filled
+        state = _load_table_npz(checkpoint_dir, step, 0, name)
+        out = {"lo": np.asarray(new_lo)}
+        for key, arr in state.items():
+            if key == "lo":
+                continue
+            if arr.ndim >= 1 and arr.shape[0] == old_sz:
+                out[key] = np.zeros((new_shard_size,) + arr.shape[1:],
+                                    arr.dtype)
+            else:
+                out[key] = arr
+        return out
+    for o in range(old_n):
+        lo_o = o * old_sz
+        hi_o = min(lo_o + old_sz, num_rows)
+        a, b = max(lo_o, new_lo), min(hi_o, new_hi)
+        if a >= b:
+            continue
+        state = _load_table_npz(checkpoint_dir, step, o, name)
+        for key, arr in state.items():
+            if key == "lo":
+                continue
+            if arr.ndim >= 1 and arr.shape[0] == old_sz:
+                pieces.setdefault(key, []).append(arr[a - lo_o:b - lo_o])
+            else:
+                prev = passthrough.get(key)
+                assert prev is None or np.array_equal(prev, arr), (
+                    f"elastic reshard: leaf {name}.{key} is neither "
+                    "row-aligned nor identical across old shards")
+                passthrough[key] = arr
+    out: dict[str, np.ndarray] = {"lo": np.asarray(new_lo)}
+    for key, parts in pieces.items():
+        rows = np.concatenate(parts, axis=0)
+        pad = new_shard_size - rows.shape[0]
+        if pad:  # last shard: pad back up to shard_size, like __init__
+            rows = np.concatenate(
+                [rows, np.zeros((pad,) + rows.shape[1:], rows.dtype)],
+                axis=0)
+        out[key] = rows
+    out.update(passthrough)
+    return out
+
+
+def read_saved_clock(checkpoint_dir: str, step: int,
+                     name: str = "trainer") -> int:
+    """The clock stamped into rank 0's trainer snapshot at ``step`` — at
+    a save boundary every rank stamps the same value (save_hook runs at
+    clock == i+1), so one representative suffices."""
+    state = _load_table_npz(checkpoint_dir, step, 0, name)
+    return int(state["clock"])

@@ -1,0 +1,214 @@
+"""Elastic resume — reshard rank-local checkpoints across world sizes.
+
+Beyond-parity capability (the reference restarts at a FIXED node count,
+SURVEY.md §3.5); the elastic path lets a job checkpointed by N processes
+relaunch at M != N by reassembling each new rank's row range from the
+old shard files (ckpt/elastic.py), parameters and optimizer state alike.
+
+Unit tier: the reshard slicing rule, the layout filter that keeps
+old-world steps out of the same-size negotiation, and the
+partition-fit-aware elastic-step scan (one step number can carry MIXED
+layouts after a previous elastic republish).
+
+Slow tier: the real drill — 3-rank training with shard checkpoints, a
+2-rank relaunch whose pure restore reproduces the 3-rank run's final
+parameter sum exactly, then continued training and a GROW relaunch at 4.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from minips_tpu import launch
+from minips_tpu.ckpt import elastic
+
+APP = "minips_tpu.apps.sharded_ps_example"
+_PORT = [6700]
+
+
+class _FakeTable:
+    """Just enough surface for the elastic helpers: partition geometry."""
+
+    def __init__(self, num_rows: int, nprocs: int, rank: int):
+        class _P:
+            shard_size = -(-num_rows // nprocs)
+
+        self.num_rows = num_rows
+        self.part = _P()
+        self.shard_lo = rank * _P.shard_size
+
+
+def _write_step(ckdir, rank, step, name, num_rows, nprocs, *, value_of,
+                extra=None):
+    """Handcraft one rank's shard file in Checkpointer's on-disk layout:
+    rows carry ``value_of(global_row_index)`` so reshards are checkable."""
+    sz = -(-num_rows // nprocs)
+    lo = rank * sz
+    d = os.path.join(ckdir, f"rank{rank}", f"step_{step:010d}")
+    os.makedirs(d, exist_ok=True)
+    w = np.zeros((sz, 2), np.float32)
+    for i in range(max(0, min(num_rows - lo, sz))):
+        w[i] = value_of(lo + i)
+    state = {"w": w, "lo": np.asarray(lo)}
+    if extra:
+        state.update(extra)
+    np.savez(os.path.join(d, f"{name}.npz"), **state)
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump({"step": step, "tables": [name], "clocks": {}}, f)
+
+
+def test_reshard_slices_rows_and_repads(tmp_path):
+    """10 rows over 3 old shards (size 4, last padded) → 2 new shards
+    (size 5): every new row must carry its global row's value, for the
+    params AND a row-aligned optimizer leaf; the new last shard is
+    zero-padded back to shard_size."""
+    ck = str(tmp_path)
+    rows = 10
+    for r in range(3):
+        sz = 4
+        lo = r * sz
+        m = np.zeros((sz, 2), np.float32)
+        for i in range(max(0, min(rows - lo, sz))):
+            m[i] = 100 + lo + i
+        _write_step(ck, r, 5, "w", rows, 3,
+                    value_of=lambda g: g, extra={"m": m})
+
+    for new_rank in range(2):
+        new_sz = 5
+        st = elastic.reshard_table_state(ck, 5, 3, "w", rows,
+                                         new_rank * new_sz, new_sz)
+        assert int(st["lo"]) == new_rank * new_sz
+        assert st["w"].shape == (new_sz, 2)
+        for i in range(new_sz):
+            g = new_rank * new_sz + i
+            want = g if g < rows else 0.0   # pad rows zeroed
+            assert st["w"][i, 0] == want, (new_rank, i)
+            want_m = 100 + g if g < rows else 0.0
+            assert st["m"][i, 0] == want_m, (new_rank, i)
+
+
+def test_layout_filter_and_elastic_scan(tmp_path):
+    """step_matches_layout rejects old-world steps; find_elastic_step
+    picks the newest CONSISTENT world, including when one step number
+    carries mixed layouts (the post-republish state) and when the newest
+    step's holder set is torn."""
+    ck = str(tmp_path)
+    rows = 12
+    # a complete 3-world at step 5
+    for r in range(3):
+        _write_step(ck, r, 5, "w", rows, 3, value_of=lambda g: g)
+    # step 9 exists only on ranks 0 and 2 — torn (rank 1 lost it)
+    _write_step(ck, 0, 9, "w", rows, 3, value_of=lambda g: g)
+    _write_step(ck, 2, 9, "w", rows, 3, value_of=lambda g: g)
+
+    t2 = {"w": _FakeTable(rows, 2, 1)}
+    # rank 1's old step 5 (3-world layout) must NOT look resumable at 2
+    assert not elastic.step_matches_layout(
+        os.path.join(ck, "rank1"), 5, t2)
+    # the scan skips torn step 9 and lands on the complete 3-world at 5
+    assert elastic.find_elastic_step(ck, t2) == (5, 3)
+
+    # mixed layouts at ONE step number: ranks 0-1 republish step 5 under
+    # a 2-world partition (what an elastic resume does); rank 2 still
+    # holds its 3-world file. k=3 no longer fits at step 5; k=2 does.
+    for r in range(2):
+        _write_step(ck, r, 5, "w", rows, 2, value_of=lambda g: 50 + g)
+    assert elastic.find_elastic_step(ck, t2) == (5, 2)
+    # and the republished 2-world rows (not the stale 3-world ones) are
+    # what a 3-world regrow reshards from
+    st = elastic.reshard_table_state(ck, 5, 2, "w", rows, 0, 4)
+    assert st["w"][0, 0] == 50
+
+
+def test_reshard_all_padding_shard(tmp_path):
+    """A grown world's last shard can lie entirely in padding
+    (shard_lo >= num_rows): the reshard must still produce full-shape
+    zero leaves, mirroring what the same-size save/restore does with the
+    padded arrays."""
+    ck = str(tmp_path)
+    rows = 9
+    for r in range(3):
+        _write_step(ck, r, 7, "w", rows, 3, value_of=lambda g: g,
+                    extra={"m": np.ones((3, 2), np.float32)})
+    # 4-world: shard_size=3, rank 3's range [9, 12) is all padding
+    st = elastic.reshard_table_state(ck, 7, 3, "w", rows, 9, 3)
+    assert int(st["lo"]) == 9
+    assert st["w"].shape == (3, 2) and not st["w"].any()
+    assert st["m"].shape == (3, 2) and not st["m"].any()
+
+
+@pytest.mark.slow
+def test_elastic_shrink_then_grow_end_to_end(tmp_path):
+    """The drill: 3 ranks train 20 iters with shard checkpoints; a
+    2-rank relaunch reshards — its pure restore (iters == saved step)
+    reproduces the same-size restore's parameter sum; continued 2-rank
+    training resumes from the step and keeps replica agreement; a
+    REGROW back to 3 ranks must prefer the 2-world's NEWER checkpoint
+    over the stale-but-layout-compatible 3-world steps the surviving
+    ranks still hold (the silent-rollback hazard)."""
+    ck = str(tmp_path / "eck")
+    base = ["--model", "sparse", "--mode", "ssp", "--staleness", "2",
+            "--batch", "128", "--checkpoint-dir", ck,
+            "--checkpoint-every", "5"]
+
+    def run(n, iters):
+        _PORT[0] += n + 3
+        return launch.run_local_job(
+            n, [sys.executable, "-m", APP] + base + ["--iters",
+                                                     str(iters)],
+            base_port=_PORT[0],
+            env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
+            timeout=240.0)
+
+    res3 = run(3, 20)
+    assert all(r["event"] == "done" and r["clock"] == 20 for r in res3)
+
+    # oracle: a SAME-SIZE pure restore (iters == saved step → zero
+    # training) reports the snapshot's partition-invariant parameter
+    # sum. (The live run's final sum is NOT that oracle: peers' in-
+    # flight pushes land after the step-20 save and before finalize.)
+    res3r = run(3, 20)
+    for r in res3r:
+        assert r["event"] == "done"
+        assert r["resumed_from"] == 20, r
+    snap_sum = res3r[0]["param_sum"]
+
+    # SHRINK, pure restore: 2 ranks reshard the same snapshot — the sum
+    # must match the same-size restore up to float summation order
+    res2 = run(2, 20)
+    for r in res2:
+        assert r["event"] == "done"
+        assert r["resumed_from"] == 20, r
+    assert abs(res2[0]["param_sum"] - snap_sum) < 1e-3, (
+        res2[0]["param_sum"], snap_sum)
+
+    # SHRINK, continue: training picks up at 20 and carries to 30 with
+    # replica agreement and the SSP bound intact. (30, not further: the
+    # retention GC keeps 3 steps per dir, and the REGROW below needs the
+    # surviving ranks to still hold a 3-layout step alongside the
+    # 2-world's newer ones.)
+    res2b = run(2, 30)
+    for r in res2b:
+        assert r["event"] == "done"
+        assert r["resumed_from"] == 20, r
+        assert r["clock"] == 30
+        assert r["max_skew_seen"] <= 3
+    assert abs(res2b[0]["param_sum"] - res2b[1]["param_sum"]) < 1e-4
+
+    # REGROW to 3 — the silent-rollback hazard, exercised for real: all
+    # three ranks still hold 3-layout step 20 (ranks 0-1 kept it through
+    # the GC, rank 2 untouched), so the same-size negotiation agrees on
+    # 20 — but the 2-world trained to 30, and restoring 20 would roll
+    # training back and prune the newer checkpoint. The newest complete
+    # checkpoint (30, 2-world) must win.
+    res3b = run(3, 50)
+    for r in res3b:
+        assert r["event"] == "done"
+        assert r["resumed_from"] == 30, r
+        assert r["clock"] == 50
+    assert abs(res3b[0]["param_sum"] - res3b[2]["param_sum"]) < 1e-4
