@@ -618,8 +618,33 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
 
     start = 0
     if restore_from:
-        state = np.load(os.path.join(
-            ckpt_dir, f"cssp_step{restore_from}_r{rank}.npz"))
+        path = os.path.join(ckpt_dir,
+                            f"cssp_step{restore_from}_r{rank}.npz")
+        if not os.path.exists(path):
+            # the replica plane deliberately has NO elastic resume (the
+            # sharded PS does — ckpt/elastic.py): CSSP snapshots are
+            # per-rank because optimizer moments are rank-PRIVATE state
+            # under opt_sync='local' (docs/consistency.md), so a new
+            # world size would need moments that never existed. Refuse
+            # loudly rather than np.load's bare FileNotFoundError.
+            raise SystemExit(
+                f"no CSSP snapshot for rank {rank} at step "
+                f"{restore_from} under {ckpt_dir} — CollectiveSSP "
+                "resumes at the world size that saved (per-rank "
+                "optimizer moments cannot be resharded); relaunch with "
+                "the original process count or start fresh")
+        state = np.load(path)
+        # the exists-check above only catches GROWS; a shrink finds its
+        # file and would silently resume with a smaller world (dropped
+        # ranks' private moments, different batch slicing) — the saved
+        # world size is the authority for both directions
+        saved_n = int(state["nprocs"]) if "nprocs" in state.files else None
+        if saved_n is not None and saved_n != nprocs:
+            raise SystemExit(
+                f"CSSP snapshot at step {restore_from} was saved by "
+                f"{saved_n} processes, this relaunch has {nprocs} — "
+                "CollectiveSSP resumes at the world size that saved "
+                "(per-rank optimizer moments cannot be resharded)")
         trainer.table.params = jax.device_put(
             jnp.asarray(state["params"]), trainer.table.params.sharding)
         opt_leaves, treedef = jax.tree.flatten(trainer.table.opt_state)
@@ -701,6 +726,7 @@ def run_ssp_spmd(args, rank: int, nprocs: int, multi: bool,
                          params=np.asarray(trainer.table.params),
                          clock=trainer.clock,
                          sync_rounds=trainer.sync_rounds,
+                         nprocs=nprocs,
                          **extra,
                          **{f"opt{j}": np.asarray(leaf)
                             for j, leaf in enumerate(opt_leaves)})
