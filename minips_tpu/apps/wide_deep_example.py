@@ -285,12 +285,15 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
     # multiproc twin of _log_collisions; same salts)
     coll = _log_collisions(metrics, data["cat"], slots)
     updater = cfg.table.updater  # sgd/adagrad/adam all server-side now
-    mk = lambda name, dim, scale, seed: ShardedTable(  # noqa: E731
+    push_comm = getattr(args, "push_comm", "float32")
+    mk = lambda name, dim, scale, seed, comm="float32": ShardedTable(  # noqa: E731
         name, slots, dim, bus, rank, nprocs, updater=updater,
         lr=cfg.table.lr, init_scale=scale, seed=seed, monitor=monitor,
-        pull_timeout=30.0)
+        pull_timeout=30.0, push_comm=comm)
+    # --push-comm compresses only wide-DIMENSION tables (the emb table):
+    # at dim 1 (wide_t) the per-row f32 scale outweighs the int8 saving
     wide_t = mk("wide", 1, 0.0, 1)
-    emb_t = mk("emb", emb_dim, 0.01, 2)
+    emb_t = mk("emb", emb_dim, 0.01, 2, comm=push_comm)
     # deep tower: flat param vector on the dense range path (adagrad
     # server-side — the reference's dense-updater family)
     import jax
@@ -400,11 +403,13 @@ def _run_multiproc(cfg: Config, args, metrics, *, use_fm: bool) -> dict:
         emit_multiproc_done(
             trainer, rank, t0, losses, table_bytes, fp,
             auc=auc_val, resumed_from=start_iter,
+            push_comm=push_comm,
             emb_collision_rate=coll["emb"]["collision_rate"],
             emb_unique_keys=coll["emb"]["unique_keys"],
             # embedding-table wire alone: the row-sparse claim is about
             # these (the deep tower is inherently dense-range traffic)
-            sparse_bytes_pushed=wide_t.bytes_pushed + emb_t.bytes_pushed)
+            sparse_bytes_pushed=wide_t.bytes_pushed + emb_t.bytes_pushed,
+            emb_bytes_pushed=emb_t.bytes_pushed)
     monitor.stop()
     bus.close()
     if code:
@@ -431,6 +436,15 @@ def _flags(parser):
                              "streaming ROC-AUC after training; 0 disables "
                              "(default: 0 for spmd/threaded, 0.2 for "
                              "multiproc)")
+    parser.add_argument("--push-comm", dest="push_comm",
+                        default="float32", choices=["float32", "int8"],
+                        help="multiproc: wire format of cross-process "
+                             "gradient pushes — int8 ships per-row absmax "
+                             "codes with stochastic rounding (unbiased, "
+                             "no residual), ~(4+dim)/(4*dim) of the f32 "
+                             "bytes on the embedding tables; the wide "
+                             "table (dim 1) stays f32, compression would "
+                             "only add scale overhead there")
     # multiproc straggler/fault injection (smoke tests)
     parser.add_argument("--slow-rank", dest="slow_rank", type=int,
                         default=-1)

@@ -50,7 +50,40 @@ from minips_tpu.consistency.gate import PeerFailureError, StalenessGate
 from minips_tpu.parallel.partition import RangePartitioner
 
 __all__ = ["ShardedTable", "ShardedPSTrainer", "PeerFailureError",
-           "table_state_bytes"]
+           "table_state_bytes", "quantize_rows_int8",
+           "dequantize_rows_int8"]
+
+
+def quantize_rows_int8(rows: np.ndarray,
+                       rng: np.random.Generator
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """Per-row absmax int8 with STOCHASTIC rounding — the compressed
+    push-wire codec (``push_comm='int8'``).
+
+    Stochastic rounding (round to floor with probability 1-frac, up with
+    probability frac) makes the codec UNBIASED: E[decode(encode(g))] = g,
+    so quantization noise averages out across steps instead of
+    accumulating as drift. That is why this wire needs no error-feedback
+    residual — EF would require a residual the size of the FULL table on
+    every pusher (pushes hit arbitrary rows), which breaks the sharded
+    PS's 1/N-memory-per-process claim. The relay plane (SSPTrainer
+    compress) and the CollectiveSSP sync keep EF because their state is
+    replicated anyway.
+
+    Returns ``(codes int8 [n, dim], scale f32 [n])``; decode is
+    ``codes * scale[:, None]``. All-zero rows get scale 0."""
+    rows = np.asarray(rows, np.float32)
+    scale = (np.abs(rows).max(axis=1) / 127.0).astype(np.float32)
+    safe = np.where(scale > 0, scale, 1.0).astype(np.float32)
+    x = rows / safe[:, None]
+    low = np.floor(x)
+    codes = low + (rng.random(rows.shape) < (x - low))
+    return np.clip(codes, -127, 127).astype(np.int8), scale
+
+
+def dequantize_rows_int8(codes: np.ndarray,
+                         scale: np.ndarray) -> np.ndarray:
+    return codes.astype(np.float32) * scale[:, None]
 
 
 def table_state_bytes(num_rows: int, dim: int, updater: str) -> int:
@@ -97,10 +130,13 @@ class ShardedTable:
         seed: int = 0,
         pull_timeout: float = 30.0,
         monitor=None,
+        push_comm: str = "float32",
     ):
         if updater not in ("sgd", "adagrad", "adam"):
             raise ValueError(
                 "sharded-PS updater must be 'sgd', 'adagrad' or 'adam'")
+        if push_comm not in ("float32", "int8"):
+            raise ValueError("push_comm must be 'float32' or 'int8'")
         self.name = name
         self.num_rows = int(num_rows)
         self.dim = int(dim)
@@ -117,6 +153,10 @@ class ShardedTable:
         self.beta2 = beta2
         self.pull_timeout = pull_timeout
         self.monitor = monitor
+        self.push_comm = push_comm
+        # quantization noise stream: per-(seed, rank) so reruns are
+        # deterministic and ranks draw independent rounding noise
+        self._q_rng = np.random.default_rng((seed, rank, 0x9e37))
         self.part = RangePartitioner(self.num_rows, num_processes)
         self.shard_lo = rank * self.part.shard_size
         # ---- server shard: ONLY my row range lives here (the 1/N memory
@@ -253,9 +293,13 @@ class ShardedTable:
     def _on_push(self, sender: int, payload: dict) -> None:
         blob = payload.get("__blob__")
         n = int(payload.get("n", 0))
+        comm = payload.get("comm", "float32")
         if not self._check_peer_config(sender, payload):
             return
-        if blob is None or len(blob) != n * (8 + 4 * self.dim):
+        # frames self-describe their wire format, so a mixed fleet (one
+        # pusher compressed, another not) decodes correctly per frame
+        row_bytes = (4 + self.dim) if comm == "int8" else 4 * self.dim
+        if blob is None or len(blob) != n * (8 + row_bytes):
             self._drop("malformed", sender, "bad push blob size")
             return  # malformed frame from a stale run
         keys = np.frombuffer(blob[: 8 * n], np.int64)
@@ -263,22 +307,44 @@ class ShardedTable:
         if n and (offs.min() < 0 or offs.max() >= self.part.shard_size):
             self._drop("misrouted", sender, "push keys outside my range")
             return
-        grads = np.frombuffer(blob[8 * n:], np.float32)
+        if comm == "int8":
+            scale = np.frombuffer(blob[8 * n: 12 * n], np.float32)
+            codes = np.frombuffer(blob[12 * n:], np.int8
+                                  ).reshape(n, self.dim)
+            grads = dequantize_rows_int8(codes, scale)
+        else:
+            grads = np.frombuffer(blob[8 * n:], np.float32)
         self._apply_rows(offs, grads)  # read-only view is fine: never written
 
     def _on_push_range(self, sender: int, payload: dict) -> None:
         blob = payload.get("__blob__")
         lo = int(payload.get("lo", -1))
+        comm = payload.get("comm", "float32")
         if not self._check_peer_config(sender, payload):
             return
         if blob is None:
             self._drop("malformed", sender, "range push without blob")
             return
-        grads = np.frombuffer(blob, np.float32)
-        if grads.size % self.dim:
-            self._drop("malformed", sender, "range blob not row-aligned")
-            return
-        k = grads.size // self.dim
+        if comm == "int8":
+            row_bytes = 4 + self.dim  # f32 scale + int8 codes per row
+            if len(blob) % row_bytes:
+                self._drop("malformed", sender,
+                           "range blob not row-aligned")
+                return
+            k = len(blob) // row_bytes
+            scale = np.frombuffer(blob[: 4 * k], np.float32)
+            codes = np.frombuffer(blob[4 * k:], np.int8).reshape(k,
+                                                                 self.dim)
+            grads = dequantize_rows_int8(codes, scale)
+        else:
+            # validate BEFORE decoding: a torn frame must land in the
+            # malformed-drop accounting, not escape as a raised ValueError
+            if len(blob) % (4 * self.dim):
+                self._drop("malformed", sender,
+                           "range blob not row-aligned")
+                return
+            grads = np.frombuffer(blob, np.float32)
+            k = grads.size // self.dim
         lo_local = lo - self.shard_lo
         if lo_local < 0 or lo_local + k > self.part.shard_size:
             self._drop("misrouted", sender, "range outside my shard")
@@ -482,12 +548,18 @@ class ShardedTable:
             if not mask.any():
                 continue
             if o == self.rank:
+                # local rows never cross a wire — full precision always
                 self._apply_rows(keys[mask] - self.shard_lo, grads[mask])
                 continue
             kb = keys[mask].tobytes()
-            gb = grads[mask].tobytes()
+            if self.push_comm == "int8":
+                codes, scale = quantize_rows_int8(grads[mask], self._q_rng)
+                gb = scale.tobytes() + codes.tobytes()
+            else:
+                gb = grads[mask].tobytes()
             self.bus.send(o, f"psP:{self.name}",
-                          {"n": int(mask.sum()), **self._cfg_header()},
+                          {"n": int(mask.sum()), "comm": self.push_comm,
+                           **self._cfg_header()},
                           blob=kb + gb)
             self.bytes_pushed += len(kb) + len(gb)
         self.rows_pushed += keys.size
@@ -507,9 +579,14 @@ class ShardedTable:
             if o == self.rank:
                 self._apply_range(0, grad[lo:hi])
                 continue
-            gb = grad[lo:hi].tobytes()
+            if self.push_comm == "int8":
+                codes, scale = quantize_rows_int8(grad[lo:hi], self._q_rng)
+                gb = scale.tobytes() + codes.tobytes()
+            else:
+                gb = grad[lo:hi].tobytes()
             self.bus.send(o, f"psR:{self.name}",
-                          {"lo": lo, **self._cfg_header()}, blob=gb)
+                          {"lo": lo, "comm": self.push_comm,
+                           **self._cfg_header()}, blob=gb)
             self.bytes_pushed += len(gb)
         self.rows_pushed += self.num_rows
 

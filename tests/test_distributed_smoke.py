@@ -284,6 +284,46 @@ def test_wide_deep_multiproc_asp_never_waits():
 
 
 @pytest.mark.slow
+def test_wide_deep_multiproc_int8_push_wire():
+    """The compressed cross-process push wire on the flagship: identical
+    seeds make the two runs push the SAME key streams, so the embedding
+    table's wire bytes must land at exactly the codec's ratio — per
+    remote row, f32 ships 8 (key) + 4*dim and int8 ships 8 + 4 (scale) +
+    dim, i.e. 20/40 at dim 8 — while training still converges with a
+    live AUC and bitwise replica agreement (quantization happens on the
+    PUSH; owner state and the pulls everyone shares stay f32)."""
+    def run(comm):
+        _PORT[0] += 6
+        return launch.run_local_job(
+            2, [sys.executable, "-m", "minips_tpu.apps.wide_deep_example",
+                "--exec", "multiproc", "--consistency", "ssp",
+                "--staleness", "2", "--num_slots", "16384",
+                "--num_iters", "30", "--batch_size", "256",
+                "--push-comm", comm],
+            base_port=_PORT[0],
+            env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
+            timeout=300.0)
+
+    f32 = run("float32")
+    q8 = run("int8")
+    for r in q8:
+        assert r["event"] == "done"
+        assert r["push_comm"] == "int8"
+        assert r["frames_dropped"] == 0, r
+        assert r["loss_last"] < r["loss_first"], r
+        assert r["auc"] > 0.6, r["auc"]
+    fps = [r["param_fingerprint"] for r in q8]
+    assert max(fps) - min(fps) < 1e-4, fps
+    # exact wire ratio, rank for rank (same key streams): (8+4+8)/(8+32)
+    for rf, rq in zip(f32, q8):
+        ratio = rq["emb_bytes_pushed"] / rf["emb_bytes_pushed"]
+        assert abs(ratio - 0.5) < 0.02, ratio
+    # and compressed pushes must not cost convergence at smoke scale
+    assert (max(r["loss_last"] for r in q8)
+            < max(r["loss_last"] for r in f32) + 0.05)
+
+
+@pytest.mark.slow
 def test_mf_multiproc_asp_partitioned_factors():
     """MF (BASELINE config 3, 'async ASP') on the key-range-sharded PS:
     user/item factor tables partitioned by id range (exact per-key rows,

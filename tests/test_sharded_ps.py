@@ -280,6 +280,41 @@ def test_inprocess_pull_timeout_when_owner_gone():
         buses[0].close()
 
 
+def test_push_wire_int8_codec():
+    """The compressed push-wire codec (push_comm='int8'): per-element
+    error bounded by one quantization step (absmax/127), exact zeros for
+    zero rows, and UNBIASED under stochastic rounding — E[decode] = x,
+    the property that lets the wire skip error feedback (an EF residual
+    would need full-table memory on every pusher, breaking 1/N)."""
+    from minips_tpu.train.sharded_ps import (dequantize_rows_int8,
+                                             quantize_rows_int8)
+
+    rng = np.random.default_rng(0)
+    rows = rng.normal(scale=3.0, size=(64, 16)).astype(np.float32)
+    rows[7] = 0.0  # an all-zero row must encode/decode exactly
+    codes, scale = quantize_rows_int8(rows, np.random.default_rng(1))
+    assert codes.dtype == np.int8 and scale.dtype == np.float32
+    out = dequantize_rows_int8(codes, scale)
+    step = np.abs(rows).max(axis=1, keepdims=True) / 127.0
+    assert np.all(np.abs(out - rows) <= step + 1e-7)
+    assert not out[7].any() and scale[7] == 0.0
+
+    # unbiasedness: average decode over many independent rounding draws
+    # converges to the input (tolerance ~ step / sqrt(draws))
+    x = rng.normal(scale=2.0, size=(4, 16)).astype(np.float32)
+    acc = np.zeros_like(x, np.float64)
+    draws = 3000
+    qrng = np.random.default_rng(2)
+    for _ in range(draws):
+        c, s = quantize_rows_int8(x, qrng)
+        acc += dequantize_rows_int8(c, s)
+    mean = (acc / draws).astype(np.float32)
+    tol = 4 * (np.abs(x).max(axis=1, keepdims=True) / 127.0) \
+        / np.sqrt(draws)
+    assert np.all(np.abs(mean - x) <= tol + 1e-7), \
+        np.abs(mean - x).max()
+
+
 # ------------------------------------------------------------ multi-process
 @pytest.mark.slow
 def test_sharded_sparse_ssp_three_processes():
