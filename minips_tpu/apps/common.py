@@ -308,6 +308,17 @@ def shard_checkpointing(bus, nprocs: int, checkpoint_dir, rank: int):
     return resume
 
 
+def add_push_comm_flag(parser) -> None:
+    """The shared --push-comm flag (one canonical definition for every
+    sharded-PS app): int8-compress cross-process gradient pushes with
+    per-row absmax codes + stochastic rounding (unbiased, no residual —
+    see train/sharded_ps.quantize_rows_int8). Apps apply it to tables
+    wide enough to profit (dim >= ~8; at dim 1 the per-row f32 scale
+    outweighs the saving)."""
+    parser.add_argument("--push-comm", dest="push_comm",
+                        default="float32", choices=["float32", "int8"])
+
+
 def emit_multiproc_done(trainer, rank: int, t0: float, losses,
                         table_bytes: int, fingerprint: float,
                         **extra) -> None:

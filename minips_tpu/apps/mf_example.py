@@ -184,10 +184,11 @@ def _run_multiproc(cfg: Config, args, metrics) -> dict:
 
     updater = cfg.table.updater  # sgd/adagrad/adam all server-side now
     dim = cfg.table.dim
+    push_comm = getattr(args, "push_comm", "float32")
     mk = lambda name, rows, seed: ShardedTable(  # noqa: E731
         name, rows, dim, bus, rank, nprocs, updater=updater,
         lr=cfg.table.lr, init_scale=0.1, seed=seed, monitor=monitor,
-        pull_timeout=30.0)
+        pull_timeout=30.0, push_comm=push_comm)
     user_t = mk("user", num_users, 1)
     item_t = mk("item", num_items, 2)
     trainer = ShardedPSTrainer({"user": user_t, "item": item_t}, bus,
@@ -245,7 +246,8 @@ def _run_multiproc(cfg: Config, args, metrics) -> dict:
         table_bytes = table_state_bytes(num_users + num_items, dim, updater)
         metrics.log(final_loss=losses[-1] if losses else None)
         emit_multiproc_done(
-            trainer, rank, t0, losses, table_bytes, fp, rmse=rmse,
+            trainer, rank, t0, losses, table_bytes, fp,
+            push_comm=push_comm, rmse=rmse,
             resumed_from=start_iter)
     monitor.stop()
     bus.close()
@@ -262,6 +264,9 @@ def _flags(parser):
                         help="fraction of ratings held out and scored by "
                              "RMSE after training; 0 disables (default: 0 "
                              "for spmd/threaded, 0.1 for multiproc)")
+    from minips_tpu.apps.common import add_push_comm_flag
+
+    add_push_comm_flag(parser)
     # multiproc straggler/fault injection (smoke tests)
     parser.add_argument("--slow-rank", dest="slow_rank", type=int,
                         default=-1)

@@ -436,15 +436,9 @@ def _flags(parser):
                              "streaming ROC-AUC after training; 0 disables "
                              "(default: 0 for spmd/threaded, 0.2 for "
                              "multiproc)")
-    parser.add_argument("--push-comm", dest="push_comm",
-                        default="float32", choices=["float32", "int8"],
-                        help="multiproc: wire format of cross-process "
-                             "gradient pushes — int8 ships per-row absmax "
-                             "codes with stochastic rounding (unbiased, "
-                             "no residual), ~(4+dim)/(4*dim) of the f32 "
-                             "bytes on the embedding tables; the wide "
-                             "table (dim 1) stays f32, compression would "
-                             "only add scale overhead there")
+    from minips_tpu.apps.common import add_push_comm_flag
+
+    add_push_comm_flag(parser)
     # multiproc straggler/fault injection (smoke tests)
     parser.add_argument("--slow-rank", dest="slow_rank", type=int,
                         default=-1)

@@ -186,10 +186,11 @@ def _run_multiproc(cfg: Config, args, metrics, vocab: int = 10_000) -> dict:
 
     dim = cfg.table.dim
     updater = cfg.table.updater  # sgd/adagrad/adam all server-side now
+    push_comm = getattr(args, "push_comm", "float32")
     mk = lambda name, scale, seed: ShardedTable(  # noqa: E731
         name, vocab, dim, bus, rank, nprocs, updater=updater,
         lr=cfg.table.lr, init_scale=scale, seed=seed, monitor=monitor,
-        pull_timeout=30.0)
+        pull_timeout=30.0, push_comm=push_comm)
     in_t = mk("in", 0.01, 1)
     out_t = mk("out", 0.0, 2)
     trainer = ShardedPSTrainer({"in": in_t, "out": out_t}, bus, nprocs,
@@ -255,7 +256,7 @@ def _run_multiproc(cfg: Config, args, metrics, vocab: int = 10_000) -> dict:
         table_bytes = table_state_bytes(2 * vocab, dim, updater)
         metrics.log(final_loss=losses[-1] if losses else None)
         emit_multiproc_done(trainer, rank, t0, losses, table_bytes, fp,
-                            resumed_from=start_iter)
+                            resumed_from=start_iter, push_comm=push_comm)
     monitor.stop()
     bus.close()
     if code:
@@ -271,6 +272,9 @@ def _flags(parser):
                         help="frequent-word subsampling threshold t "
                              "(classic 1e-5 for enwiki-scale corpora; "
                              "0 disables)")
+    from minips_tpu.apps.common import add_push_comm_flag
+
+    add_push_comm_flag(parser)
     # multiproc straggler/fault injection (smoke tests)
     parser.add_argument("--slow-rank", dest="slow_rank", type=int,
                         default=-1)
