@@ -33,19 +33,22 @@ _PORT = [6600 + (os.getpid() % 389)]
 
 def _worker_argv(path: str, iters: int, warmup: int,
                  compute: str = "none",
-                 hidden: int | None = None) -> list[str]:
+                 hidden: int | None = None,
+                 push_comm: str = "float32") -> list[str]:
     argv = [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
             "--path", path, "--iters", str(iters), "--warmup", str(warmup)]
     if compute != "none":
         argv += ["--compute", compute]
     if hidden is not None:
         argv += ["--hidden", str(hidden)]
+    if push_comm != "float32":
+        argv += ["--push-comm", push_comm]
     return argv
 
 
 def _run(n: int, path: str, iters: int, warmup: int, bus: str,
          compute: str = "none", force_cpu: bool = False,
-         hidden: int | None = None) -> dict:
+         hidden: int | None = None, push_comm: str = "float32") -> dict:
     """One sweep point → {rows_per_sec_per_process, aggregate, wire...}.
 
     ``compute="jit"`` adds a real jitted model-grad step between pull and
@@ -53,7 +56,8 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     alive and ``force_cpu`` is False), peers on CPU — the north-star
     topology (accelerator workers against a sharded host PS) instead of
     the bare control plane. ``hidden`` sizes that step's MLP."""
-    argv = _worker_argv(path, iters, warmup, compute, hidden)
+    argv = _worker_argv(path, iters, warmup, compute, hidden,
+                        push_comm)
     env_extra = {}
     if bus != "zmq":
         env_extra["MINIPS_BUS"] = bus
@@ -86,6 +90,10 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     if compute != "none":
         out["worker_compute"] = sorted({r.get("compute", "?")
                                         for r in res})
+    # the workers echo their wire format — a silent flag-plumbing
+    # regression must not publish a float32 number labeled int8
+    echoed = {r.get("push_comm", "float32") for r in res}
+    assert echoed == {push_comm}, (push_comm, echoed)
     return out
 
 
@@ -105,6 +113,13 @@ def main() -> int:
              "native": _run(3, "sparse", iters, warmup, "native")}
     paths = {"sparse": curve["3"],
              "dense": _run(3, "dense", iters, warmup, "zmq")}
+    # the compressed push wire: same rows/sec workload, int8 codes on the
+    # cross-process push leg — wire bytes/sec drops toward the codec
+    # ratio while the pull leg (f32 rows, deliberately uncompressed so
+    # replicas stay exact) is unchanged
+    wires = {"float32": curve["3"],
+             "int8": _run(3, "sparse", iters, warmup, "zmq",
+                          push_comm="int8")}
 
     headline = curve["3"]["rows_per_sec_per_process"]
     print(json.dumps({
@@ -117,6 +132,7 @@ def main() -> int:
         "scaling_sparse_zmq": curve,
         "bus_comparison_3proc": buses,
         "path_comparison_3proc": paths,
+        "push_wire_comparison_3proc": wires,
     }))
     return 0
 

@@ -48,6 +48,9 @@ def main(argv=None) -> int:
                          "peers on CPU). This measures the north-star "
                          "topology: PS wire + accelerator worker compute "
                          "overlapped, not the bare control plane")
+    from minips_tpu.apps.common import add_push_comm_flag
+
+    add_push_comm_flag(ap)
     ap.add_argument("--hidden", type=int, default=256,
                     help="--compute jit: MLP hidden width over the "
                          "pulled rows (the MXU work per cycle)")
@@ -109,7 +112,8 @@ def main(argv=None) -> int:
 
     table = ShardedTable("b", args.rows, args.dim, bus, rank, nprocs,
                          updater=args.updater, lr=0.05,
-                         pull_timeout=60.0, monitor=monitor)
+                         pull_timeout=60.0, monitor=monitor,
+                         push_comm=args.push_comm)
     trainer = None
     if bus is not None:
         trainer = ShardedPSTrainer({"b": table}, bus, nprocs,
@@ -157,6 +161,7 @@ def main(argv=None) -> int:
     print(json.dumps({
         "rank": rank, "event": "done",
         "path": args.path, "nprocs": nprocs,
+        "push_comm": args.push_comm,
         "compute": (f"jit({backend})" if args.compute == "jit"
                     else "none"),
         "bus": os.environ.get("MINIPS_BUS", "zmq") if bus else "none",
