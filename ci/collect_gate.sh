@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Fast CI gate: the whole test tree must COLLECT clean before anything
+# runs. Catches import-time breakage (a renamed module, a missing
+# optional dep that should importorskip, a syntax error in a slow-tier
+# file) in seconds instead of failing 8 minutes into the tier — the two
+# seed collection errors this gate exists for were exactly that shape
+# (`ModuleNotFoundError: hypothesis` crashed collection of two files).
+#
+# Usage: ci/collect_gate.sh  (exit 0 = collects clean, non-zero = gate
+# failed; output is the pytest collection summary)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m pytest tests/ --collect-only -q -p no:cacheprovider

@@ -312,11 +312,39 @@ def add_push_comm_flag(parser) -> None:
     """The shared --push-comm flag (one canonical definition for every
     sharded-PS app): int8-compress cross-process gradient pushes with
     per-row absmax codes + stochastic rounding (unbiased, no residual —
-    see train/sharded_ps.quantize_rows_int8). Apps apply it to tables
+    see ops/quantized_comm.quantize_rows_int8). Apps apply it to tables
     wide enough to profit (dim >= ~8; at dim 1 the per-row f32 scale
     outweighs the saving)."""
     parser.add_argument("--push-comm", dest="push_comm",
                         default="float32", choices=["float32", "int8"])
+
+
+def add_wire_flags(parser) -> None:
+    """The full overlapped-pipeline knob set, one canonical definition:
+    ``--push-comm`` (compressed push wire, above), ``--pull-wire``
+    (int8-compress pull REPLIES — per-row absmax codes, round-to-nearest
+    so every puller decodes identical bytes; same dim ≳ 8 economics),
+    ``--overlap`` (async ack-windowed pushes + double-buffered pull
+    prefetch — the latency levers; consistency is preserved by the hard
+    drain at clock boundaries and future-clock-stamped prefetches), and
+    ``--push-window`` (max unacked cross-process push frames)."""
+    add_push_comm_flag(parser)
+    parser.add_argument("--pull-wire", dest="pull_wire",
+                        default="f32", choices=["f32", "int8"])
+    parser.add_argument("--overlap", action="store_true",
+                        help="async push + pull prefetch (overlapped "
+                             "PS pipeline)")
+    parser.add_argument("--overlap-legs", dest="overlap_legs",
+                        default="both", choices=["both", "pull", "push"],
+                        help="which overlap levers --overlap enables: "
+                             "the levers are independently gated and "
+                             "cost differently — pull prefetch is pure "
+                             "latency hiding, async push adds a sender "
+                             "thread + ack traffic that can cost more "
+                             "than it hides on CPU-oversubscribed "
+                             "hosts (the bench sweeps both)")
+    parser.add_argument("--push-window", dest="push_window",
+                        type=int, default=32)
 
 
 def emit_multiproc_done(trainer, rank: int, t0: float, losses,

@@ -48,9 +48,9 @@ def main(argv=None) -> int:
                          "peers on CPU). This measures the north-star "
                          "topology: PS wire + accelerator worker compute "
                          "overlapped, not the bare control plane")
-    from minips_tpu.apps.common import add_push_comm_flag
+    from minips_tpu.apps.common import add_wire_flags
 
-    add_push_comm_flag(ap)
+    add_wire_flags(ap)
     ap.add_argument("--hidden", type=int, default=256,
                     help="--compute jit: MLP hidden width over the "
                          "pulled rows (the MXU work per cycle)")
@@ -113,7 +113,11 @@ def main(argv=None) -> int:
     table = ShardedTable("b", args.rows, args.dim, bus, rank, nprocs,
                          updater=args.updater, lr=0.05,
                          pull_timeout=60.0, monitor=monitor,
-                         push_comm=args.push_comm)
+                         push_comm=args.push_comm,
+                         pull_wire=args.pull_wire,
+                         async_push=(args.overlap and
+                                     args.overlap_legs != "pull"),
+                         push_window=args.push_window)
     trainer = None
     if bus is not None:
         trainer = ShardedPSTrainer({"b": table}, bus, nprocs,
@@ -128,10 +132,32 @@ def main(argv=None) -> int:
 
     y_lab = (rng.random(B) > 0.5).astype(np.float32)
 
+    # Overlapped pipeline (--overlap): batch t+1's pull is ISSUED before
+    # batch t's compute/push, stamped one clock ahead (owners admit it
+    # under exactly the rule the consuming step would face — a no-op
+    # here under ASP), and pushes drain on the sender thread until the
+    # tick's hard drain. The synchronous cycle is the off-arm of the
+    # overlap_on_off_3proc sweep.
+    pending: list = [None, None]  # [keys, PullFuture]
+
+    def draw_keys():
+        return rng.integers(0, args.rows, size=B)
+
     def cycle():
         if args.path == "sparse":
-            keys = rng.integers(0, args.rows, size=B)
-            rows = table.pull(keys)
+            if args.overlap and args.overlap_legs != "push":
+                if pending[1] is None:  # first iteration: nothing ahead
+                    pending[0] = draw_keys()
+                    pending[1] = table.prefetch_pull(pending[0],
+                                                     clock_ahead=0)
+                keys, fut = pending
+                nxt = draw_keys()
+                pending[0] = nxt
+                pending[1] = table.prefetch_pull(nxt)  # overlaps below
+                rows = fut.wait()
+            else:
+                keys = draw_keys()
+                rows = table.pull(keys)
             g = (grad_step(rows, y_lab) if grad_step is not None
                  else grads)
             table.push(keys, g)
@@ -151,7 +177,11 @@ def main(argv=None) -> int:
         rows_moved += cycle()
         if trainer is not None:
             trainer.tick()  # ASP: publishes clock, never waits
+    table.flush_pushes()  # standalone/async tail: count only drained work
     dt = time.perf_counter() - t0
+    b_push1, b_pull1 = table.bytes_pushed, table.bytes_pulled
+    if pending[1] is not None:
+        pending[1].cancel()  # dangling last prefetch: never consumed
     if trainer is not None:
         trainer.finalize(timeout=60.0)
         assert trainer.frames_dropped == 0, trainer.drop_detail()
@@ -162,6 +192,9 @@ def main(argv=None) -> int:
         "rank": rank, "event": "done",
         "path": args.path, "nprocs": nprocs,
         "push_comm": args.push_comm,
+        "pull_wire": args.pull_wire,   # echo: bench asserts negotiation
+        "overlap": bool(args.overlap),
+        "overlap_legs": args.overlap_legs if args.overlap else None,
         "compute": (f"jit({backend})" if args.compute == "jit"
                     else "none"),
         "bus": os.environ.get("MINIPS_BUS", "zmq") if bus else "none",
@@ -169,10 +202,12 @@ def main(argv=None) -> int:
         "iters_timed": timed,
         "rows_per_sec": round(rows_moved / dt, 1),
         "cycles_per_sec": round(timed / dt, 2),
-        "wire_push_bytes_per_sec": round(
-            (table.bytes_pushed - b_push0) / dt, 1),
-        "wire_pull_bytes_per_sec": round(
-            (table.bytes_pulled - b_pull0) / dt, 1),
+        "wire_push_bytes_per_sec": round((b_push1 - b_push0) / dt, 1),
+        "wire_pull_bytes_per_sec": round((b_pull1 - b_pull0) / dt, 1),
+        "wire_bytes_per_row_moved": round(
+            (b_push1 - b_push0 + b_pull1 - b_pull0)
+            / max(rows_moved, 1), 3),
+        "timing": table.timers.summary(),  # per-leg latency + overlap
         "wall_s": round(dt, 4),
     }), flush=True)
     if monitor is not None:

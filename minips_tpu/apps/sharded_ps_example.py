@@ -62,6 +62,9 @@ def main(argv=None) -> int:
                          "<dir>/rank<r>/; on start, ranks negotiate the "
                          "newest step ALL of them hold and resume there")
     ap.add_argument("--checkpoint-every", type=int, default=0)
+    from minips_tpu.apps.common import add_wire_flags
+
+    add_wire_flags(ap)
     args = ap.parse_args(argv)
 
     import jax
@@ -77,6 +80,7 @@ def main(argv=None) -> int:
     from minips_tpu.models import lr as lr_model
     from minips_tpu.tables.sparse import next_pow2
     from minips_tpu.train.sharded_ps import (ShardedTable, ShardedPSTrainer)
+    from minips_tpu.utils.metrics import wire_record
 
     rank, nprocs, bus, monitor, staleness = init_multiproc(
         args.mode, args.staleness)
@@ -94,7 +98,12 @@ def main(argv=None) -> int:
 
     table = ShardedTable("w", num_rows, 1, bus, rank, nprocs,
                          updater=args.updater, lr=args.lr,
-                         monitor=monitor, pull_timeout=20.0)
+                         monitor=monitor, pull_timeout=20.0,
+                         push_comm=args.push_comm,
+                         pull_wire=args.pull_wire,
+                         async_push=(args.overlap and
+                                     args.overlap_legs != "pull"),
+                         push_window=args.push_window)
     trainer = ShardedPSTrainer({"w": table}, bus, nprocs,
                                staleness=staleness, gate_timeout=30.0,
                                monitor=monitor)
@@ -132,20 +141,46 @@ def main(argv=None) -> int:
 
     def body():
         nonlocal final
+        # --overlap double buffer (sparse path): [sel, keys, PullFuture]
+        # for the NEXT batch, issued before this batch computes. Draw
+        # order is unchanged — draws stay sequential, each iteration
+        # consumes its own draw — so loss streams are comparable across
+        # the overlap on/off arms.
+        ahead: list = [None, None, None]
+
+        def draw_sel():
+            return rng.integers(0, data["y"].shape[0], size=args.batch)
+
         for i in range(start_iter, args.iters):
             if args.kill_at and rank == args.kill_rank and i == args.kill_at:
                 os._exit(137)
-            sel = rng.integers(0, data["y"].shape[0], size=args.batch)
             if sparse:
+                if args.overlap and args.overlap_legs != "push":
+                    if ahead[2] is None:  # first batch: nothing in flight
+                        s0 = draw_sel()
+                        k0 = data["idx"][s0].reshape(-1)
+                        ahead[:] = [s0, k0,
+                                    table.prefetch_pull(k0, clock_ahead=0)]
+                    sel, keys, fut = ahead
+                    s1 = draw_sel()  # issue batch t+1 before t computes:
+                    k1 = data["idx"][s1].reshape(-1)
+                    ahead[:] = [s1, k1, table.prefetch_pull(k1)]
+                    rows = fut.wait().reshape(args.batch, -1, 1)
+                else:
+                    sel = draw_sel()
+                    keys = data["idx"][sel].reshape(-1)
+                    rows = table.pull(keys).reshape(args.batch, -1, 1)
                 batch = {k: jnp.asarray(data[k][sel])
                          for k in ("val", "mask", "y")}
-                keys = data["idx"][sel].reshape(-1)
-                rows = table.pull(keys).reshape(args.batch, -1, 1)
                 loss, g = grads_sparse(jnp.asarray(rows), batch)
                 # scale 1/nprocs: N workers push per clock; keeps the
                 # effective per-clock step comparable across world sizes
                 table.push(keys, np.asarray(g).reshape(-1, 1) / nprocs)
             else:
+                # dense path: pull_all has no prefetch twin (the whole
+                # vector is the working set); --overlap still buys the
+                # async push-leg below
+                sel = draw_sel()
                 batch = {"x": jnp.asarray(data["x"][sel]),
                          "y": jnp.asarray(data["y"][sel])}
                 vec = table.pull_all()
@@ -159,6 +194,8 @@ def main(argv=None) -> int:
             if args.jitter_ms > 0 \
                     and jitter_rng.random() < args.jitter_prob:
                 time.sleep(args.jitter_ms / 1000.0)
+        if ahead[2] is not None:
+            ahead[2].cancel()  # dangling last prefetch: never consumed
         trainer.finalize(timeout=20.0)
         # inside the guarded body: a peer that already printed and closed
         # its bus can look heartbeat-dead while we assemble — that must
@@ -174,15 +211,18 @@ def main(argv=None) -> int:
         table_bytes = table_state_bytes(num_rows, 1, args.updater)
         print(json.dumps({
             "rank": rank, "event": "done",
+            # wire-knob echo: sweeps assert the negotiated config so a
+            # flag-plumbing regression can't publish a mislabeled number
+            "push_comm": args.push_comm,
+            "pull_wire": args.pull_wire,
+            "overlap": bool(args.overlap),
+            "overlap_legs": args.overlap_legs if args.overlap else None,
             "wall_s": round(time.monotonic() - t0, 4),
             "loss_first": losses[0] if losses else None,
             "loss_last": float(np.mean(losses[-5:])) if losses else None,
             "gate_waits": trainer.gate_waits,
             "max_skew_seen": trainer.max_skew_seen,
-            "bytes_pushed": trainer.bytes_pushed,
-            "bytes_pulled": trainer.bytes_pulled,
-            "frames_dropped": trainer.frames_dropped,
-            "wire_frames_lost": trainer.wire_frames_lost,
+            **wire_record(trainer),
             "local_bytes": trainer.local_bytes(),
             "table_bytes": int(table_bytes),
             "param_sum": float(final.sum()),

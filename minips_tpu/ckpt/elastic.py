@@ -198,9 +198,14 @@ def reshard_table_state(checkpoint_dir: str, step: int, old_n: int,
                 pieces.setdefault(key, []).append(arr[a - lo_o:b - lo_o])
             else:
                 prev = passthrough.get(key)
-                assert prev is None or np.array_equal(prev, arr), (
-                    f"elastic reshard: leaf {name}.{key} is neither "
-                    "row-aligned nor identical across old shards")
+                # a hard refusal, not an assert: resharding a leaf that
+                # is neither row-aligned nor shard-invariant would
+                # silently pick one shard's copy — and `python -O`
+                # strips asserts, so the tripwire must be a real raise
+                if prev is not None and not np.array_equal(prev, arr):
+                    raise ValueError(
+                        f"elastic reshard: leaf {name}.{key} is neither "
+                        "row-aligned nor identical across old shards")
                 passthrough[key] = arr
     out: dict[str, np.ndarray] = {"lo": np.asarray(new_lo)}
     for key, parts in pieces.items():

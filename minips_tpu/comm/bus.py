@@ -175,15 +175,22 @@ class ControlBus:
         while not self._stop.is_set():
             if not dict(poller.poll(timeout=50)):
                 continue
-            try:
-                frames = self._sub.recv_multipart(zmq.NOBLOCK)
-            except zmq.ZMQError:
-                continue
-            if len(frames) < 2:
-                continue  # topic-only frame: malformed
-            dispatch_message(self._handlers, frames[1],
-                             frames[2] if len(frames) > 2 else None,
-                             loss=self.loss)
+            # drain the socket per wake, not one frame per poll(): each
+            # poll releases the GIL, and when the main thread is busy
+            # (the overlapped pipeline's whole point) a per-frame poll
+            # lets it steal the timeslice between every frame — the
+            # receive thread then drains at ~1 frame per GIL handoff and
+            # ack/reply latency balloons from microseconds to tens of ms
+            while not self._stop.is_set():
+                try:
+                    frames = self._sub.recv_multipart(zmq.NOBLOCK)
+                except zmq.ZMQError:
+                    break  # EAGAIN: queue empty, back to poll()
+                if len(frames) < 2:
+                    continue  # topic-only frame: malformed
+                dispatch_message(self._handlers, frames[1],
+                                 frames[2] if len(frames) > 2 else None,
+                                 loss=self.loss)
 
     def handshake(self, num_processes: int, timeout: float = 15.0) -> None:
         """Rendezvous before real traffic: PUB/SUB drops messages published
@@ -492,15 +499,19 @@ class BlobExchange:
         head = {"round": int(rnd), "tag": str(tag), "dtype": str(arr.dtype)}
         blob = arr.tobytes()
         with self._cond:
-            # retain the last TWO rounds per tag: within one round the
+            # retain the last FOUR rounds per tag: within one round the
             # collective merges after each gather rendezvous the whole
-            # group, so a peer lags at most one round behind a server —
-            # except when every union in a round was empty (no psum
-            # launched), which is why one round of retention is not
-            # enough
+            # group, so a peer normally lags at most one round behind a
+            # server — but a round whose every union is empty launches
+            # no psum (no rendezvous), and SEVERAL consecutive empty
+            # rounds let a lagging peer fall further behind than a
+            # 2-round window before anything re-synchronizes it. Four
+            # rounds covers 3 empty rounds back-to-back; a peer lagging
+            # deeper than that has missed a real rendezvous and is the
+            # monitor's problem, not retention's.
             kept = self._sent.setdefault(tag, {})
             kept[int(rnd)] = (head, blob)
-            for old_rnd in [r for r in kept if r < rnd - 1]:
+            for old_rnd in [r for r in kept if r < rnd - 3]:
                 del kept[old_rnd]
         self.bus.publish(self.KIND, head, blob=blob)
         out: list = [None] * self.n

@@ -25,8 +25,52 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 VALID = ("float32", "bfloat16", "int8")
+
+
+# --------------------------------------------------------------- host codec
+# The per-ROW absmax int8 codec for the host PS wire (train/sharded_ps.py):
+# the numpy twin of the blockwise device codec below, with the row (not a
+# 256-element block) as the scale unit — PS frames already move row-major
+# key slices, so one f32 scale per row is the natural framing. Both the
+# push leg (gradients, stochastic rounding) and the pull leg (weights,
+# nearest rounding) of the sharded PS speak this codec; it lives here so
+# the device collectives and the host wire share one quantization home.
+
+def quantize_rows_int8(rows: np.ndarray,
+                       rng: np.random.Generator | None = None
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """Per-row absmax int8. With ``rng``, rounding is STOCHASTIC (round
+    to floor with probability 1-frac, up with probability frac), making
+    the codec UNBIASED: E[decode(encode(g))] = g — quantization noise
+    averages out across steps instead of accumulating as drift, which is
+    why the gradient push wire needs no error-feedback residual (EF
+    would require a residual the size of the FULL table on every pusher,
+    breaking the sharded PS's 1/N-memory-per-process claim).
+
+    With ``rng=None``, rounding is round-to-NEAREST — the pull-wire mode
+    for weights: deterministic, so every puller of an unchanged row
+    decodes identical bytes, and half the worst-case per-element error.
+
+    Returns ``(codes int8 [n, dim], scale f32 [n])``; decode is
+    ``codes * scale[:, None]``. All-zero rows get scale 0."""
+    rows = np.asarray(rows, np.float32)
+    scale = (np.abs(rows).max(axis=1) / 127.0).astype(np.float32)
+    safe = np.where(scale > 0, scale, 1.0).astype(np.float32)
+    x = rows / safe[:, None]
+    if rng is None:
+        codes = np.rint(x)
+    else:
+        low = np.floor(x)
+        codes = low + (rng.random(rows.shape) < (x - low))
+    return np.clip(codes, -127, 127).astype(np.int8), scale
+
+
+def dequantize_rows_int8(codes: np.ndarray,
+                         scale: np.ndarray) -> np.ndarray:
+    return codes.astype(np.float32) * scale[:, None]
 
 BLOCK = 256  # int8 quantization block: one f32 scale per 256 elements
              # (1.6% wire overhead). Per-BLOCK scales matter because a

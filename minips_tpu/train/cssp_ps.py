@@ -67,9 +67,21 @@ from minips_tpu.train.ssp_spmd import (SyncPlane, avg_table_opt_state,
                                         is_avg_leaf, make_control,
                                         staleness_for)
 
-__all__ = ["CollectiveSSPPS"]
+__all__ = ["CollectiveSSPPS", "sync_block_rows"]
 
 PyTree = Any
+
+
+def sync_block_rows(union_size: int, n_local: int) -> int:
+    """Rows of the per-sync delta block: the union size rounded up to a
+    power of two (keeps the retrace count small — the jitted merge
+    recompiles per shape) and then up to a MULTIPLE of ``n_local``
+    (shard_map over the local mesh axis needs even divisibility; a
+    6-device host would otherwise get C=8 and abort in the sharding
+    check, since next_pow2 is only divisible by non-power-of-two device
+    counts by luck)."""
+    c = max(next_pow2(int(union_size)), int(n_local))
+    return -(-c // int(n_local)) * int(n_local)
 
 
 class CollectiveSSPPS:
@@ -299,7 +311,7 @@ class CollectiveSSPPS:
                  if any(p.size for p in parts) else mine)
         if union.size == 0:
             return  # nobody touched this table: replicas already agree
-        C = max(next_pow2(int(union.size)), self.plane.n_local)
+        C = sync_block_rows(union.size, self.plane.n_local)
         self.sync_rows_max = max(self.sync_rows_max, C)
         idx = np.full(C, t.num_slots, np.int64)
         idx[: union.size] = union

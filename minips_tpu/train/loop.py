@@ -30,9 +30,18 @@ class TrainLoop:
         step_offset: int = 0,
         profile_dir: Optional[str] = None,
         profile_range: tuple[int, int] = (10, 13),
+        prefetch: Optional[Callable[[Any], None]] = None,
     ):
         self.step = step
         self.data = data
+        # ``prefetch(next_batch)`` is called with batch t+1 BEFORE
+        # ``step(batch t)`` runs — the overlap hook for PS-backed steps:
+        # a sharded-PS app passes a callable that issues
+        # ``table.prefetch_pull(keys_of(next_batch))`` so the pull round
+        # trip rides under this step's compute (train/sharded_ps.py
+        # pipeline). Costs one batch of lookahead in the data stream;
+        # None (the default) keeps the loop strictly sequential.
+        self.prefetch = prefetch
         self.metrics = metrics or MetricsLogger(verbose=False)
         self.log_every = log_every
         self.batch_size = batch_size
@@ -72,17 +81,34 @@ class TrainLoop:
                     warning="resume: data source has no iter_from; stream "
                             "starts wherever the caller left it")
             it = iter(self.data)
+        ahead = None  # batch t+1, already announced through prefetch
         for i in range(num_iters):
             if self.profiler is not None:
                 self.profiler.on_step(i)
-            try:
-                batch = next(it)
-            except StopIteration:
-                # finite sources (one-pass streams) end the loop cleanly;
-                # BatchIterator-style sources cycle and never raise
-                self.metrics.log(event="stream_exhausted",
-                                 step=self.step_offset + i)
-                break
+            if ahead is not None:
+                batch, ahead = ahead, None
+            else:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    # finite sources (one-pass streams) end the loop
+                    # cleanly; BatchIterator-style sources cycle and
+                    # never raise
+                    self.metrics.log(event="stream_exhausted",
+                                     step=self.step_offset + i)
+                    break
+            if self.prefetch is not None:
+                # announce batch t+1 before stepping batch t, so a
+                # PS-backed step's pull round trip overlaps this step's
+                # compute; a batch prefetched but never stepped (the
+                # num_iters bound lands between them) is the callback
+                # owner's cleanup (PullFuture.cancel)
+                try:
+                    ahead = next(it)
+                except StopIteration:
+                    ahead = None
+                else:
+                    self.prefetch(ahead)
             loss = self.step(batch)
             n = (self.batch_size if self.batch_size is not None
                  else _leading_dim(batch))

@@ -35,10 +35,56 @@ Numerics: the server-side numpy updaters match ops/sparse_update.py's
 row_sgd/row_adagrad/row_adam (sum-duplicates-then-update; lazy moments for
 adam) bit-for-bit at f32 — the parity tests in tests/test_sharded_ps.py
 assert it against those oracles.
+
+The OVERLAPPED pipeline (this PR's tentpole): the synchronous loop pays
+full round-trip latency on every leg, so the hot path grows three
+independently-gated levers —
+
+- **async push** (``async_push=True``): ``push()``/``push_dense()``
+  enqueue and return; a per-table sender thread routes/encodes/sends,
+  and every cross-process frame carries a sequence number the owner
+  ACKS after applying. Under a FINITE staleness bound (BSP/SSP) every
+  ``tick()`` drains the queue to the EMIT barrier before the clock
+  frame goes out — all step-``k`` push frames precede the clock-``k``
+  frame on the same ordered per-link stream, so the FIFO staleness
+  argument above holds unchanged (bound preserved at send cost, no
+  per-step ack round trip). Under ASP (``staleness=inf``) admission
+  always passes — there is no bound for a drain to protect — so the
+  clock frame goes out without waiting and the sender drains behind
+  the next step's compute. Acks are pure loss detection and cost
+  ~zero frames in steady state: owners BATCH ack seqs and piggyback
+  them on their next pull reply to the pusher (one per PS cycle),
+  with dedicated psK frames only on the batch threshold, clock events
+  (``serve_parked``), or a drain's psQ solicitation. ``push_window``
+  bounds both the unacked-frame window and the unsent queue depth
+  (backpressure), and ``finalize()`` runs the HARD drain — queue
+  empty AND every ack in, soliciting stragglers. A lost ack cannot
+  hang the loop: a jammed window or drain deadline poisons the table
+  and ``check_fatal()`` raises at the next tick.
+- **pull prefetch** (``prefetch_pull(keys)``): issue batch ``t+1``'s
+  pull while batch ``t`` computes. The request is stamped with a FUTURE
+  clock (``clock_ahead``, default 1 — the clock the consuming step will
+  run at), so the owner parks it under exactly the admission rule a
+  synchronous pull at that step would face; the reply rides back while
+  the worker computes/pushes/ticks, and ``wait()`` (or a later
+  ``pull()`` with the same keys, which consumes the registered
+  prefetch) picks it up, re-checking LOCAL admission before reading the
+  local shard slice.
+- **int8 pull wire** (``pull_wire="int8"``): pull replies ship per-row
+  absmax int8 codes + f32 scales (round-to-nearest — deterministic, so
+  identical bytes decode identically everywhere) instead of raw f32
+  rows, mirroring the push codec in ops/quantized_comm.py. Frames
+  self-describe their wire (mixed fleets decode per frame), and workers
+  echo the negotiated format so the bench can assert it.
+
+Per-leg timing (issue→reply latency, blocked time, overlap fraction,
+ack latency) runs through ``utils/timing.CommTimers``; wire bytes both
+directions count ACTUAL bytes on the wire (compressed when compressed).
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from typing import Optional
@@ -47,43 +93,14 @@ import numpy as np
 
 from minips_tpu.comm.bus import ClockGossip
 from minips_tpu.consistency.gate import PeerFailureError, StalenessGate
+from minips_tpu.ops.quantized_comm import (dequantize_rows_int8,
+                                           quantize_rows_int8)
 from minips_tpu.parallel.partition import RangePartitioner
+from minips_tpu.utils.timing import CommTimers
 
 __all__ = ["ShardedTable", "ShardedPSTrainer", "PeerFailureError",
-           "table_state_bytes", "quantize_rows_int8",
+           "PullFuture", "table_state_bytes", "quantize_rows_int8",
            "dequantize_rows_int8"]
-
-
-def quantize_rows_int8(rows: np.ndarray,
-                       rng: np.random.Generator
-                       ) -> tuple[np.ndarray, np.ndarray]:
-    """Per-row absmax int8 with STOCHASTIC rounding — the compressed
-    push-wire codec (``push_comm='int8'``).
-
-    Stochastic rounding (round to floor with probability 1-frac, up with
-    probability frac) makes the codec UNBIASED: E[decode(encode(g))] = g,
-    so quantization noise averages out across steps instead of
-    accumulating as drift. That is why this wire needs no error-feedback
-    residual — EF would require a residual the size of the FULL table on
-    every pusher (pushes hit arbitrary rows), which breaks the sharded
-    PS's 1/N-memory-per-process claim. The relay plane (SSPTrainer
-    compress) and the CollectiveSSP sync keep EF because their state is
-    replicated anyway.
-
-    Returns ``(codes int8 [n, dim], scale f32 [n])``; decode is
-    ``codes * scale[:, None]``. All-zero rows get scale 0."""
-    rows = np.asarray(rows, np.float32)
-    scale = (np.abs(rows).max(axis=1) / 127.0).astype(np.float32)
-    safe = np.where(scale > 0, scale, 1.0).astype(np.float32)
-    x = rows / safe[:, None]
-    low = np.floor(x)
-    codes = low + (rng.random(rows.shape) < (x - low))
-    return np.clip(codes, -127, 127).astype(np.int8), scale
-
-
-def dequantize_rows_int8(codes: np.ndarray,
-                         scale: np.ndarray) -> np.ndarray:
-    return codes.astype(np.float32) * scale[:, None]
 
 
 def table_state_bytes(num_rows: int, dim: int, updater: str) -> int:
@@ -97,6 +114,81 @@ def table_state_bytes(num_rows: int, dim: int, updater: str) -> int:
     if updater == "adam":  # per-row lazy step counters (int32)
         n += num_rows * 4
     return n
+
+
+class PullFuture:
+    """Handle for an in-flight (possibly prefetched) pull: the requests
+    are already on the wire; ``wait()`` blocks only for whatever has not
+    yet arrived, reads the LOCAL shard slice after re-checking admission
+    for the stamped clock, and assembles the row matrix. Single-consumer:
+    ``wait()`` may be called once."""
+
+    def __init__(self, table: "ShardedTable", req: int, keys: np.ndarray,
+                 remote: list, local_mask, clk: int):
+        self._table = table
+        self._req = req
+        self._keys = keys
+        self._remote = remote          # [(owner, mask)] cross-process legs
+        self._local_mask = local_mask  # bool mask of keys my shard owns
+        self.clk = clk
+        self._t_issue = time.monotonic()
+        self._done = False
+        self._pf_key: Optional[bytes] = None  # prefetch-registry slot
+
+    def _deregister(self) -> None:
+        if self._pf_key is None:
+            return
+        t = self._table
+        with t._prefetch_lock:
+            if t._prefetched.get(self._pf_key) is self:
+                del t._prefetched[self._pf_key]
+
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        if self._done:
+            raise RuntimeError("PullFuture.wait() called twice")
+        self._done = True
+        self._deregister()
+        t = self._table
+        t_block0 = time.monotonic()
+        out = np.empty((self._keys.size, t.dim), np.float32)
+        if self._remote:
+            got = t._await_replies(self._req, {o for o, _ in self._remote},
+                                   timeout=timeout)
+            for o, mask in self._remote:
+                out[mask] = got[o]
+        else:
+            with t._reply_cond:
+                t._replies.pop(self._req, None)
+        with t._reply_cond:
+            t_arrived = t._reply_t.pop(self._req, t_block0)
+        if self._local_mask is not None:
+            # the local slice obeys the SAME admission rule the remote
+            # owners applied: read only once my view admits the stamped
+            # clock (matters for prefetches stamped clock_ahead > 0 —
+            # a synchronous pull passes instantly, its own gate already
+            # waited for this)
+            t._wait_local_admission(self.clk, timeout)
+            offs = self._keys[self._local_mask] - t.shard_lo
+            with t._state_lock:
+                out[self._local_mask] = t._w[offs]
+        now = time.monotonic()
+        # latency is issue -> reply PROCESSED (t_arrived), not wait() —
+        # a fully-prefetched pull whose reply landed mid-compute must
+        # report the real RTT, not the compute window it hid under
+        t.timers.record_pull(latency_s=t_arrived - self._t_issue,
+                             blocked_s=now - t_block0)
+        return out
+
+    def cancel(self) -> None:
+        """Abandon an un-waited prefetch (e.g. past the last batch):
+        releases the reply slot so late replies don't accumulate."""
+        if self._done:
+            return
+        self._done = True
+        self._deregister()
+        with self._table._reply_cond:
+            self._table._replies.pop(self._req, None)
+            self._table._reply_t.pop(self._req, None)
 
 
 class ShardedTable:
@@ -131,12 +223,21 @@ class ShardedTable:
         pull_timeout: float = 30.0,
         monitor=None,
         push_comm: str = "float32",
+        pull_wire: str = "f32",
+        async_push: bool = False,
+        push_window: int = 32,
     ):
         if updater not in ("sgd", "adagrad", "adam"):
             raise ValueError(
                 "sharded-PS updater must be 'sgd', 'adagrad' or 'adam'")
         if push_comm not in ("float32", "int8"):
             raise ValueError("push_comm must be 'float32' or 'int8'")
+        if pull_wire == "float32":  # accept the push-knob spelling too
+            pull_wire = "f32"
+        if pull_wire not in ("f32", "int8"):
+            raise ValueError("pull_wire must be 'f32' or 'int8'")
+        if push_window < 1:
+            raise ValueError("push_window must be >= 1")
         self.name = name
         self.num_rows = int(num_rows)
         self.dim = int(dim)
@@ -154,6 +255,10 @@ class ShardedTable:
         self.pull_timeout = pull_timeout
         self.monitor = monitor
         self.push_comm = push_comm
+        self.pull_wire = pull_wire
+        self.async_push = bool(async_push)
+        self.push_window = int(push_window)
+        self.timers = CommTimers()
         # quantization noise stream: per-(seed, rank) so reruns are
         # deterministic and ranks draw independent rounding noise
         self._q_rng = np.random.default_rng((seed, rank, 0x9e37))
@@ -201,16 +306,47 @@ class ShardedTable:
         self._req = 0
         self._req_lock = threading.Lock()
         self._replies: dict[int, dict[int, np.ndarray]] = {}
+        self._reply_t: dict[int, float] = {}  # req -> last-reply arrival
         self._reply_cond = threading.Condition()
+        self._prefetched: dict[bytes, PullFuture] = {}
+        self._prefetch_lock = threading.Lock()
         self.bytes_pushed = 0
         self.bytes_pulled = 0
         self.rows_pushed = 0
+        # ---- async-push pipeline: a bounded-window sender thread + an
+        # in-flight ledger. Every cross-process frame carries a seq the
+        # owner acks after handling (applied OR counted-dropped — a
+        # withheld ack would stack a window stall on top of an already-
+        # loud drop). Acks are BATCHED at the owner and mostly ride
+        # PIGGYBACKED on pull replies (the PS cycle sends one per owner
+        # per step anyway) — a dedicated psK frame goes out only on the
+        # batch threshold, a clock event, or a drain's solicitation, so
+        # steady state pays ~zero extra frames for loss detection (a
+        # per-frame ack wire measurably LOST the overlap_on_off sweep
+        # on CPU-bound hosts: +1 frame per push frame).
+        # ``_inflight`` maps seq -> (send time, owner); its size is
+        # the unacked window ``push_window`` bounds, and a seq that
+        # never leaves it is exactly what the hard drain's deadline
+        # turns into a poisoned table.
+        self._push_seq = 0
+        self._inflight: dict[int, tuple[float, int]] = {}
+        self._ack_pending: dict[int, list[int]] = {}  # sender -> seqs
+        self._ack_lock = threading.Lock()
+        self._push_cond = threading.Condition()
+        self._q_pending = 0            # queued items not yet fully sent
+        self._push_q: Optional[queue.Queue] = None
+        if self.async_push:
+            self._push_q = queue.Queue()
+            threading.Thread(target=self._push_loop, daemon=True,
+                             name=f"ps-push:{name}").start()
         if bus is not None:
             bus.on(f"psP:{name}", self._on_push)
             bus.on(f"psR:{name}", self._on_push_range)
             bus.on(f"psG:{name}", self._on_pull)
             bus.on(f"psA:{name}", self._on_pull_all)
             bus.on(f"psr:{name}", self._on_pull_reply)
+            bus.on(f"psK:{name}", self._on_push_ack)
+            bus.on(f"psQ:{name}", self._on_ack_solicit)
 
     # --------------------------------------------------------- server side
     def _apply_rows(self, offs: np.ndarray, grads: np.ndarray) -> None:
@@ -291,6 +427,64 @@ class ShardedTable:
                 "dm": self.dim}
 
     def _on_push(self, sender: int, payload: dict) -> None:
+        try:
+            self._handle_push(sender, payload)
+        finally:
+            self._ack_push(sender, payload)
+
+    def _on_push_range(self, sender: int, payload: dict) -> None:
+        try:
+            self._handle_push_range(sender, payload)
+        finally:
+            self._ack_push(sender, payload)
+
+    def _ack_push(self, sender: int, payload: dict) -> None:
+        """Ack EVERY seq-stamped frame, applied or dropped: a dropped
+        frame is already loud at this end (drop counters; config drops
+        poison my table), and withholding the ack would stall the
+        pusher's window on top of it — one fault, one failure path.
+
+        Acks are BATCHED, not per-frame: the seq lands in a per-sender
+        pending list and rides out piggybacked on my next pull reply to
+        that sender (one per PS cycle in steady state — zero extra
+        frames), or in a dedicated psK frame when the batch threshold
+        trips, a clock event lands (serve_parked), or the sender's
+        drain solicits (psQ)."""
+        seq = payload.get("seq")
+        if seq is None or self.bus is None:
+            return
+        with self._ack_lock:
+            pend = self._ack_pending.setdefault(sender, [])
+            pend.append(int(seq))
+            if len(pend) < max(1, self.push_window // 4):
+                return
+            seqs, self._ack_pending[sender] = pend, []
+        self.bus.send(sender, f"psK:{self.name}", {"seqs": seqs})
+
+    def _drain_acks_for(self, sender: int) -> list[int]:
+        with self._ack_lock:
+            return self._ack_pending.pop(sender, None) or []
+
+    def _flush_acks(self, sender: Optional[int] = None) -> None:
+        """Send out pending ack batches — for one sender (drain
+        solicitation) or all (clock events): liveness when no pull
+        reply is flowing to piggyback on."""
+        with self._ack_lock:
+            if sender is None:
+                out = [(s, q) for s, q in self._ack_pending.items() if q]
+                self._ack_pending.clear()
+            else:
+                q = self._ack_pending.pop(sender, None)
+                out = [(sender, q)] if q else []
+        for s, seqs in out:
+            self.bus.send(s, f"psK:{self.name}", {"seqs": seqs})
+
+    def _on_ack_solicit(self, sender: int, payload: dict) -> None:
+        # per-link FIFO: the solicit was sent after the frames it wants
+        # acked, so their seqs are already in my pending list
+        self._flush_acks(sender)
+
+    def _handle_push(self, sender: int, payload: dict) -> None:
         blob = payload.get("__blob__")
         n = int(payload.get("n", 0))
         comm = payload.get("comm", "float32")
@@ -316,7 +510,7 @@ class ShardedTable:
             grads = np.frombuffer(blob[8 * n:], np.float32)
         self._apply_rows(offs, grads)  # read-only view is fine: never written
 
-    def _on_push_range(self, sender: int, payload: dict) -> None:
+    def _handle_push_range(self, sender: int, payload: dict) -> None:
         blob = payload.get("__blob__")
         lo = int(payload.get("lo", -1))
         comm = payload.get("comm", "float32")
@@ -376,12 +570,27 @@ class ShardedTable:
             return
         self._serve_pull(sender, req, keys)
 
+    def _reply_head_blob(self, req: int, rows: np.ndarray) -> tuple:
+        """Encode a pull reply on MY configured pull wire. Frames
+        self-describe the format (like push frames), so a mixed fleet —
+        one owner compressed, another not — decodes correctly per frame;
+        the done-line echo + bench assert catch flag-plumbing drift."""
+        if self.pull_wire == "int8":
+            codes, scale = quantize_rows_int8(rows)  # nearest: no rng
+            return ({"req": req, "wire": "int8", "n": rows.shape[0]},
+                    scale.tobytes() + codes.tobytes())
+        return {"req": req, "wire": "f32"}, np.ascontiguousarray(
+            rows, np.float32).tobytes()
+
     def _serve_pull(self, sender: int, req: int, keys: np.ndarray) -> None:
         offs = keys - self.shard_lo
         with self._state_lock:
             rows = self._w[offs]  # fancy indexing: already a fresh array
-        self.bus.send(sender, f"psr:{self.name}", {"req": req},
-                      blob=rows.tobytes())
+        head, blob = self._reply_head_blob(req, rows)
+        acks = self._drain_acks_for(sender)
+        if acks:
+            head["acks"] = acks  # piggyback: the free ack ride home
+        self.bus.send(sender, f"psr:{self.name}", head, blob=blob)
 
     def _on_pull_all(self, sender: int, payload: dict) -> None:
         req = int(payload.get("req", -1))
@@ -399,14 +608,26 @@ class ShardedTable:
     def _serve_pull_all(self, sender: int, req: int) -> None:
         with self._state_lock:
             rows = self._w.copy()  # full shard: copy out of the lock
-        self.bus.send(sender, f"psr:{self.name}",
-                      {"req": req, "lo": self.shard_lo},
-                      blob=rows.tobytes())
+        head, blob = self._reply_head_blob(req, rows)
+        head["lo"] = self.shard_lo
+        acks = self._drain_acks_for(sender)
+        if acks:
+            head["acks"] = acks
+        self.bus.send(sender, f"psr:{self.name}", head, blob=blob)
 
     def serve_parked(self) -> None:
         """Re-check parked pulls against the admission rule — called by the
         trainer on every clock/exclusion change (the PendingBuffer drain,
-        reference ``Clock → may unpark others' Gets``, SURVEY.md §3.3)."""
+        reference ``Clock → may unpark others' Gets``, SURVEY.md §3.3).
+        Also the opportunistic ack-drain point: flush my pending ack
+        batches (liveness when no pull reply is flowing to piggyback
+        on) and wake any window/drain waiter so in-flight accounting is
+        re-read at every clock event, not only when an ack frame
+        lands."""
+        if self.bus is not None:
+            self._flush_acks()
+        with self._push_cond:
+            self._push_cond.notify_all()
         if self._cons is None:
             return
         # admission is evaluated ONCE per entry: global_min advances
@@ -424,15 +645,40 @@ class ShardedTable:
                 self._serve_pull(sender, req, keys)
 
     def _on_pull_reply(self, sender: int, payload: dict) -> None:
+        acks = payload.get("acks")
+        if acks:  # piggybacked push acks: settle before anything else
+            self._settle_acks(acks)
         blob = payload.get("__blob__")
         req = int(payload.get("req", -1))
         if blob is None:
             self._drop("malformed", sender, "pull reply without blob")
             return
-        rows = np.frombuffer(blob, np.float32).reshape(-1, self.dim)
+        wire = payload.get("wire", "f32")
+        if wire == "int8":
+            n = int(payload.get("n", 0))
+            if len(blob) != n * (4 + self.dim):
+                self._drop("malformed", sender, "bad int8 reply size")
+                return
+            scale = np.frombuffer(blob[: 4 * n], np.float32)
+            codes = np.frombuffer(blob[4 * n:], np.int8).reshape(n,
+                                                                 self.dim)
+            rows = dequantize_rows_int8(codes, scale)
+        else:
+            if len(blob) % (4 * self.dim):
+                self._drop("malformed", sender, "bad f32 reply size")
+                return
+            rows = np.frombuffer(blob, np.float32).reshape(-1, self.dim)
         with self._reply_cond:
             if req in self._replies:
+                # wire accounting counts ACTUAL bytes received
+                # (compressed when compressed) — the pull leg's half of
+                # bytes/row-moved. Under the lock (the issue side bumps
+                # the same counter from the training thread) and only
+                # for live requests: a late reply to a cancelled
+                # prefetch must not inflate the counter.
+                self.bytes_pulled += len(blob)
                 self._replies[req][sender] = rows
+                self._reply_t[req] = time.monotonic()
                 self._reply_cond.notify_all()
 
     # --------------------------------------------------------- client side
@@ -459,8 +705,10 @@ class ShardedTable:
             self._req += 1
             return self._req
 
-    def _await_replies(self, req: int, owners: set[int]) -> dict:
-        deadline = time.monotonic() + self.pull_timeout
+    def _await_replies(self, req: int, owners: set[int],
+                       timeout: Optional[float] = None) -> dict:
+        deadline = time.monotonic() + (self.pull_timeout
+                                       if timeout is None else timeout)
         with self._reply_cond:
             while set(self._replies[req]) < owners:
                 self._reply_cond.wait(timeout=0.5)
@@ -470,23 +718,52 @@ class ShardedTable:
                         if self.monitor is not None else set())
                 if dead & owners:
                     self._replies.pop(req, None)
+                    self._reply_t.pop(req, None)
                     raise PeerFailureError(dead & owners)
                 if time.monotonic() > deadline:
                     missing = sorted(owners - set(self._replies[req]))
                     self._replies.pop(req, None)
+                    self._reply_t.pop(req, None)
                     raise TimeoutError(
                         f"pull({self.name}): owners {missing} never "
                         "replied")
             return self._replies.pop(req)
 
-    def pull(self, keys: np.ndarray) -> np.ndarray:
-        """Gather rows for global ``keys`` from their owners —
-        KVClientTable::Pull with RangeManager routing (SURVEY.md §3.3)."""
+    def _wait_local_admission(self, clk: int,
+                              timeout: Optional[float] = None) -> None:
+        """Block until MY admission view serves clock ``clk`` — the local
+        shard's twin of the owner-side park. Synchronous pulls pass
+        instantly (their gate already waited); prefetches stamped ahead
+        wait here only if consumed before the staleness rule catches up."""
+        if self._cons is None or self._cons.admit_pull(clk):
+            return
+        wait_fn = getattr(self._cons, "wait_admit_pull", None)
+        deadline = time.monotonic() + (self.pull_timeout
+                                       if timeout is None else timeout)
+        while not self._cons.admit_pull(clk):
+            if wait_fn is not None:
+                wait_fn(clk, timeout=0.5)
+            else:
+                time.sleep(0.005)
+            if self._cons.admit_pull(clk):
+                return
+            dead = (self.monitor.check()
+                    if self.monitor is not None else set())
+            if dead:
+                raise PeerFailureError(dead)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pull({self.name}): local admission for clock "
+                    f"{clk} never opened")
+
+    def _issue_pull(self, keys: np.ndarray, clk: int) -> PullFuture:
+        """Send the per-owner key slices for ``keys`` stamped ``clk`` and
+        return the future; the local slice is read at ``wait()`` time."""
         keys = np.asarray(keys, np.int64).reshape(-1)
         owners = self.part.shard_of(keys)
-        out = np.empty((keys.size, self.dim), np.float32)
         req = self._next_req()
         remote: list[tuple[int, np.ndarray]] = []
+        local_mask = None
         with self._reply_cond:
             self._replies[req] = {}
         for o in range(self.num_processes):
@@ -494,26 +771,63 @@ class ShardedTable:
             if not mask.any():
                 continue
             if o == self.rank:
-                offs = keys[mask] - self.shard_lo
-                with self._state_lock:
-                    out[mask] = self._w[offs]
+                local_mask = mask
                 continue
             kslice = keys[mask]
             self.bus.send(o, f"psG:{self.name}",
-                          {"req": req, "clk": self._my_clk(),
-                           **self._cfg_header()},
+                          {"req": req, "clk": clk, **self._cfg_header()},
                           blob=kslice.tobytes())
-            self.bytes_pulled += kslice.nbytes
-            remote.append((o, mask))
-        if remote:
-            got = self._await_replies(req, {o for o, _ in remote})
-            for o, mask in remote:
-                out[mask] = got[o]
-                self.bytes_pulled += got[o].nbytes
-        else:
+            # under the reply lock: replies land on the receive thread
+            # and bump the same counter (non-atomic read-modify-write)
             with self._reply_cond:
-                self._replies.pop(req, None)
-        return out
+                self.bytes_pulled += kslice.nbytes
+            remote.append((o, mask))
+        return PullFuture(self, req, keys, remote, local_mask, clk)
+
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        """Gather rows for global ``keys`` from their owners —
+        KVClientTable::Pull with RangeManager routing (SURVEY.md §3.3).
+        A pending ``prefetch_pull`` for the SAME keys is consumed instead
+        of issuing a second round trip — but only if its clock stamp is
+        current: a dangling prefetch from an earlier step was admitted
+        under an OLDER global-min view, and consuming it now would read
+        rows staler than a synchronous pull at my present clock is
+        allowed to see. A stale stamp is cancelled and the pull
+        round-trips fresh — the staleness bound outranks the saved
+        RTT."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        with self._prefetch_lock:
+            fut = self._prefetched.pop(keys.tobytes(), None)
+        if fut is not None:
+            if fut.clk >= self._my_clk():
+                return fut.wait()
+            fut.cancel()
+        return self._issue_pull(keys, self._my_clk()).wait()
+
+    def prefetch_pull(self, keys: np.ndarray, *,
+                      clock_ahead: int = 1) -> PullFuture:
+        """Double-buffered pull: issue the NEXT batch's pull now, stamped
+        with the clock the consuming step will run at (``_my_clk() +
+        clock_ahead``), so owners park it under exactly the admission
+        rule a synchronous pull at that step would face — overlap never
+        weakens BSP/SSP. Returns the future; a later ``pull()`` with the
+        same keys consumes it (or call ``wait()`` directly). One
+        registry slot per distinct key set: re-prefetching the same keys
+        points the slot at the NEW future, and the displaced one stays
+        WAITABLE — the double-buffer pattern holds batch t's future
+        while issuing batch t+1's, so two consecutive batches drawing
+        byte-identical keys must not invalidate the handle in the
+        caller's hand (cancelling it here made ``fut.wait()`` raise)."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        fut = self._issue_pull(keys, self._my_clk() + int(clock_ahead))
+        kb = keys.tobytes()
+        fut._pf_key = kb
+        with self._prefetch_lock:
+            old = self._prefetched.get(kb)
+            self._prefetched[kb] = fut
+        if old is not None:
+            old._pf_key = None  # displaced, not cancelled
+        return fut
 
     def pull_all(self) -> np.ndarray:
         """Assemble the full table (dense pulls / finalize / eval): each
@@ -530,18 +844,183 @@ class ShardedTable:
         with self._state_lock:
             out[self.shard_lo:self.shard_lo + self.part.shard_size] = self._w
         if peers:
+            # wire bytes are counted at reply receipt (_on_pull_reply),
+            # actual bytes — an int8 wire's replies count compressed
             got = self._await_replies(req, peers)
             for o, rows in got.items():
                 lo = o * self.part.shard_size
                 out[lo:lo + rows.shape[0]] = rows
-                self.bytes_pulled += rows.nbytes
+        with self._reply_cond:
+            self._replies.pop(req, None)
+            self._reply_t.pop(req, None)
         return out[: self.num_rows]
+
+    # ------------------------------------------------------- push pipeline
+    def _take_push_seq(self, owner: int) -> int:
+        """Claim an in-flight slot (blocks while the window is full) and
+        stamp the send time — the ack latency timer's zero point. A full
+        window SOLICITS the owners' pending ack batches while it waits:
+        batching must never convert into a stall."""
+        deadline = time.monotonic() + self.pull_timeout
+        with self._push_cond:
+            while len(self._inflight) >= self.push_window:
+                self._solicit_acks_locked()
+                self._push_cond.wait(timeout=0.2)
+                if len(self._inflight) < self.push_window:
+                    break
+                dead = (self.monitor.check()
+                        if self.monitor is not None else set())
+                if dead:
+                    raise PeerFailureError(dead)
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"push({self.name}): ack window jammed "
+                        f"({len(self._inflight)} unacked)")
+            self._push_seq += 1
+            self._inflight[self._push_seq] = (time.monotonic(), owner)
+            return self._push_seq
+
+    def _solicit_acks_locked(self) -> None:
+        """Ask every owner holding an unacked frame of mine to flush its
+        pending ack batch (caller holds ``_push_cond``). Per-link FIFO:
+        the psQ frame trails the frames it wants acked, so the owner's
+        pending list already contains their seqs when it lands."""
+        for o in {own for _, own in self._inflight.values()}:
+            self.bus.send(o, f"psQ:{self.name}", {})
+
+    def _settle_acks(self, seqs) -> None:
+        now = time.monotonic()
+        t0s = []
+        with self._push_cond:
+            for s in seqs:
+                rec = self._inflight.pop(int(s), None)
+                if rec is not None:
+                    t0s.append(rec[0])
+            if t0s:
+                self._push_cond.notify_all()
+        for t0 in t0s:
+            self.timers.record_push_ack(now - t0)
+
+    def _on_push_ack(self, sender: int, payload: dict) -> None:
+        seqs = payload.get("seqs")
+        if seqs is None:  # single-seq spelling kept for compatibility
+            seq = payload.get("seq")
+            seqs = [] if seq is None else [seq]
+        self._settle_acks(seqs)
+
+    def _push_loop(self) -> None:
+        """Sender thread (async_push): drains the queue, doing the
+        per-owner split / codec / serialize / send OFF the training
+        thread. A raised send poisons the table (check_fatal at the next
+        tick) rather than dying silently on a daemon thread."""
+        while True:
+            kind, a = self._push_q.get()
+            try:
+                if kind == "sparse":
+                    self._push_now(a[0], a[1])
+                else:
+                    self._push_dense_now(a)
+            except Exception as e:  # noqa: BLE001 - surfaced via fatal
+                if self._fatal is None:
+                    self._fatal = (f"table {self.name}: async push "
+                                   f"failed: {e!r}")
+            finally:
+                with self._push_cond:
+                    self._q_pending -= 1
+                    self._push_cond.notify_all()
+
+    def flush_pushes(self, timeout: Optional[float] = None, *,
+                     acks: bool = True) -> None:
+        """Drain the async-push pipeline. Two levels:
+
+        ``acks=False`` — the CLOCK-BOUNDARY drain (trainer ``tick()``):
+        wait until every enqueued push has been HANDED TO THE BUS. That
+        is exactly the barrier BSP/SSP need: the clock frame is emitted
+        after all of step ``k``'s push frames on the same ordered
+        per-link stream, so an owner whose view says I reached ``k`` has
+        already processed those pushes — the identical FIFO argument the
+        synchronous path's staleness proof uses (module docstring), at
+        microsecond cost instead of an ack round trip per step.
+
+        ``acks=True`` — the HARD drain (``finalize()``, fault drills):
+        additionally wait until every in-flight frame is ACKED as
+        received by its owner — the loss-detection point. In between,
+        ``push_window`` bounds how many frames can ever be unacked.
+
+        A drain that cannot complete (lost ack, wedged owner) POISONS
+        the table instead of hanging — the caller's ``check_fatal()``
+        raises."""
+        if not self.async_push:
+            return
+        deadline = time.monotonic() + (self.pull_timeout
+                                       if timeout is None else timeout)
+
+        def drained() -> bool:
+            return not (self._q_pending
+                        or (acks and self._inflight))
+        with self._push_cond:
+            while not drained():
+                if acks and not self._q_pending:
+                    # everything is on the wire; batched acks may be
+                    # sitting at the owners below their flush threshold
+                    # — solicit them (FIFO: the psQ trails the frames)
+                    self._solicit_acks_locked()
+                self._push_cond.wait(timeout=0.2)
+                if drained():
+                    break
+                dead = (self.monitor.check()
+                        if self.monitor is not None else set())
+                if dead:
+                    raise PeerFailureError(dead)
+                if time.monotonic() > deadline:
+                    if self._fatal is None:
+                        self._fatal = (
+                            f"table {self.name}: push drain timed out "
+                            f"({self._q_pending} queued, "
+                            f"{len(self._inflight)} unacked — lost ack "
+                            "or wedged owner)")
+                    return
+
+    def _enqueue_push(self, kind: str, arg) -> None:
+        """Hand one push to the sender thread, with BACKPRESSURE: at most
+        ``push_window`` steps may sit unsent in the queue (on top of the
+        unacked-frame window the sender itself honors), so a wedged owner
+        stalls the training thread here — bounded memory — until the
+        sender's own deadline poisons the table and the fatal check below
+        raises instead of hanging."""
+        self.check_fatal()
+        deadline = time.monotonic() + self.pull_timeout
+        with self._push_cond:
+            while self._q_pending >= self.push_window:
+                self._push_cond.wait(timeout=0.2)
+                self.check_fatal()  # sender poisoned while we waited
+                if self._q_pending < self.push_window:
+                    break
+                dead = (self.monitor.check()
+                        if self.monitor is not None else set())
+                if dead:
+                    raise PeerFailureError(dead)
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"push({self.name}): send queue jammed "
+                        f"({self._q_pending} steps unsent)")
+            self._q_pending += 1
+        self._push_q.put((kind, arg))
 
     def push(self, keys: np.ndarray, grads: np.ndarray) -> None:
         """Route per-owner (keys, grads) slices; owners apply the updater.
-        Duplicate keys in one push are summed first (reference Add)."""
+        Duplicate keys in one push are summed first (reference Add).
+        With ``async_push`` this enqueues (copies, so callers may reuse
+        buffers) and returns; the wire work happens on the sender thread
+        inside the ack window."""
         keys = np.asarray(keys, np.int64).reshape(-1)
         grads = np.asarray(grads, np.float32).reshape(keys.size, self.dim)
+        if self.async_push:
+            self._enqueue_push("sparse", (keys.copy(), grads.copy()))
+            return
+        self._push_now(keys, grads)
+
+    def _push_now(self, keys: np.ndarray, grads: np.ndarray) -> None:
         owners = self.part.shard_of(keys)
         for o in range(self.num_processes):
             mask = owners == o
@@ -557,20 +1036,28 @@ class ShardedTable:
                 gb = scale.tobytes() + codes.tobytes()
             else:
                 gb = grads[mask].tobytes()
-            self.bus.send(o, f"psP:{self.name}",
-                          {"n": int(mask.sum()), "comm": self.push_comm,
-                           **self._cfg_header()},
-                          blob=kb + gb)
+            head = {"n": int(mask.sum()), "comm": self.push_comm,
+                    **self._cfg_header()}
+            if self.async_push:
+                head["seq"] = self._take_push_seq(o)
+            self.bus.send(o, f"psP:{self.name}", head, blob=kb + gb)
             self.bytes_pushed += len(kb) + len(gb)
         self.rows_pushed += keys.size
 
     def push_dense(self, grad: np.ndarray) -> None:
         """Whole-vector gradient push, split into per-owner contiguous
-        ranges (no key lists on the wire) — the dense-table fast path."""
+        ranges (no key lists on the wire) — the dense-table fast path.
+        Async mode enqueues like :meth:`push`."""
         grad = np.asarray(grad, np.float32).reshape(-1, self.dim)
         if grad.shape[0] != self.num_rows:
             raise ValueError(
                 f"push_dense expects [{self.num_rows}, {self.dim}]")
+        if self.async_push:
+            self._enqueue_push("dense", grad.copy())
+            return
+        self._push_dense_now(grad)
+
+    def _push_dense_now(self, grad: np.ndarray) -> None:
         sz = self.part.shard_size
         for o in range(self.num_processes):
             lo, hi = o * sz, min((o + 1) * sz, self.num_rows)
@@ -584,9 +1071,11 @@ class ShardedTable:
                 gb = scale.tobytes() + codes.tobytes()
             else:
                 gb = grad[lo:hi].tobytes()
-            self.bus.send(o, f"psR:{self.name}",
-                          {"lo": lo, "comm": self.push_comm,
-                           **self._cfg_header()}, blob=gb)
+            head = {"lo": lo, "comm": self.push_comm,
+                    **self._cfg_header()}
+            if self.async_push:
+                head["seq"] = self._take_push_seq(o)
+            self.bus.send(o, f"psR:{self.name}", head, blob=gb)
             self.bytes_pushed += len(gb)
         self.rows_pushed += self.num_rows
 
@@ -681,6 +1170,15 @@ class ShardedPSTrainer:
             return True
         return self.gossip.global_min() >= clk - int(self.staleness)
 
+    def wait_admit_pull(self, clk: int,
+                        timeout: Optional[float] = None) -> bool:
+        """Condition-variable wait for :meth:`admit_pull` — the local-
+        shard admission hook PullFuture.wait uses instead of polling."""
+        if self.staleness == float("inf"):
+            return True
+        return self.gossip.wait_global_min(clk - int(self.staleness),
+                                           timeout=timeout)
+
     def _drain_parked(self) -> None:
         for t in self.tables.values():
             t.serve_parked()
@@ -709,9 +1207,23 @@ class ShardedPSTrainer:
 
     def tick(self) -> None:
         """Advance my clock, gossip it, and gate (BSP/SSP/ASP rule) —
-        ``KVClientTable::Clock()``."""
+        ``KVClientTable::Clock()``. With async push under a FINITE
+        staleness bound the clock boundary DRAINS the send queue first:
+        every step-``k`` push frame must be on the wire BEFORE my
+        clock-``k`` frame so per-link FIFO keeps the staleness proof
+        intact (an undrained queue would silently widen staleness past
+        the bound). Under ASP (``staleness=inf``) there is no bound for
+        the drain to protect — admission always passes — so the clock
+        frame goes out immediately and the sender keeps draining behind
+        the next step's compute: the fully-overlapped pipeline the bench
+        measures. Ack settlement — pure loss detection — stays off the
+        step path in both regimes: the window/queue backpressure bounds
+        it and finalize() hard-drains it."""
+        drain = self.staleness != float("inf")
         for t in self.tables.values():
-            t.check_fatal()  # config-mismatched peer ⇒ fail, don't train on
+            if drain:
+                t.flush_pushes(acks=False)  # a jammed drain poisons…
+            t.check_fatal()                 # …and this raises, no hang
         self.clock += 1
         self.gossip.publish_local([self.clock])
         self.gate.wait(self.clock)
@@ -730,6 +1242,9 @@ class ShardedPSTrainer:
         """Two-sided quiesce: my pushes applied at all owners (their acks)
         AND all peers' pushes applied at my shards (their flushes). After
         this, pull/pull_all return identical rows on every live process."""
+        for t in self.tables.values():
+            t.flush_pushes()  # async tail: drained before the flush frame
+            t.check_fatal()
         self.bus.publish("psFlush", {"clock": self.clock})
         from minips_tpu.consistency.gate import publish_clock
 
@@ -820,6 +1335,13 @@ class ShardedPSTrainer:
             for k, v in t.drops.items():
                 out[k] += v
         return out
+
+    def comm_timing(self) -> dict:
+        """Aggregate per-leg wire timing over all tables: pull issue→
+        reply latency, blocked time, overlap fraction, push ack latency
+        (utils/timing.CommTimers.summary fields)."""
+        return CommTimers.aggregate(
+            [t.timers for t in self.tables.values()])
 
     @property
     def bytes_pushed(self) -> int:

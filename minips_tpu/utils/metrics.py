@@ -42,3 +42,19 @@ class MetricsLogger:
 
     def __exit__(self, *exc: Any) -> None:
         self.close()
+
+
+def wire_record(trainer) -> dict:
+    """One JSON-able record of a sharded-PS trainer's wire health: bytes
+    both directions, loss/drop accounting, and the per-leg timing
+    (utils/timing.CommTimers) the overlapped pipeline exposes, nested
+    under ``"timing"`` — the done-line shape the apps splat into their
+    result line (and the bench worker mirrors with per-window deltas),
+    so sweep tooling scrapes one layout."""
+    return {
+        "bytes_pushed": trainer.bytes_pushed,
+        "bytes_pulled": trainer.bytes_pulled,
+        "frames_dropped": trainer.frames_dropped,
+        "wire_frames_lost": trainer.wire_frames_lost,
+        "timing": trainer.comm_timing(),
+    }

@@ -10,7 +10,84 @@ construction time.
 
 from __future__ import annotations
 
+import threading
 import time
+
+
+class CommTimers:
+    """Per-leg wire timing for the overlapped PS pipeline
+    (train/sharded_ps.py): pull issue→last-reply latency vs. the time the
+    caller actually spent BLOCKED waiting for it, and push send→ack
+    latency. The interesting derived number is ``pull_overlap_fraction``
+    — the share of pull latency hidden behind other work (1.0 = fully
+    prefetched, 0.0 = fully synchronous); it is what the
+    ``overlap_on_off_3proc`` bench sweep exists to move.
+
+    Thread-safe: replies and acks land on the bus receive thread while
+    the training thread records its blocked time."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pulls = 0
+        self.pull_latency_s = 0.0   # issue → last reply ARRIVED
+        self.pull_blocked_s = 0.0   # caller actually waiting in wait()
+        self.push_acks = 0
+        self.push_ack_latency_s = 0.0  # frame send → ack received
+
+    def record_pull(self, latency_s: float, blocked_s: float) -> None:
+        with self._lock:
+            self.pulls += 1
+            self.pull_latency_s += max(latency_s, 0.0)
+            self.pull_blocked_s += max(blocked_s, 0.0)
+
+    def record_push_ack(self, latency_s: float) -> None:
+        with self._lock:
+            self.push_acks += 1
+            self.push_ack_latency_s += max(latency_s, 0.0)
+
+    @property
+    def pull_overlap_fraction(self) -> float | None:
+        """1 − blocked/latency over all pulls; None before any pull.
+        Clamped at 0 (scheduling jitter can make blocked ≥ latency)."""
+        with self._lock:
+            if self.pull_latency_s <= 0.0:
+                return None
+            return max(0.0, 1.0 - self.pull_blocked_s
+                       / self.pull_latency_s)
+
+    def summary(self) -> dict:
+        """Flat JSON-able record for metrics/bench lines."""
+        with self._lock:
+            out = {
+                "pulls": self.pulls,
+                "pull_latency_ms_mean": round(
+                    1e3 * self.pull_latency_s / self.pulls, 4)
+                if self.pulls else None,
+                "pull_blocked_ms_mean": round(
+                    1e3 * self.pull_blocked_s / self.pulls, 4)
+                if self.pulls else None,
+                "push_acks": self.push_acks,
+                "push_ack_ms_mean": round(
+                    1e3 * self.push_ack_latency_s / self.push_acks, 4)
+                if self.push_acks else None,
+            }
+        frac = self.pull_overlap_fraction
+        out["pull_overlap_fraction"] = (round(frac, 4)
+                                        if frac is not None else None)
+        return out
+
+    @staticmethod
+    def aggregate(timers: "list[CommTimers]") -> dict:
+        """One summary over several tables' timers (count-weighted)."""
+        agg = CommTimers()
+        for t in timers:
+            with t._lock:
+                agg.pulls += t.pulls
+                agg.pull_latency_s += t.pull_latency_s
+                agg.pull_blocked_s += t.pull_blocked_s
+                agg.push_acks += t.push_acks
+                agg.push_ack_latency_s += t.push_ack_latency_s
+        return agg.summary()
 
 
 class StepTimer:

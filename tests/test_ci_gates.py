@@ -1,0 +1,82 @@
+"""CI gate contracts: the collect-only gate catches import-time
+breakage, and the bench-regression comparator fails on >10% rows/sec
+drops or silently-dropped sweep points (never on new points or wire-byte
+movement)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "ci"))
+
+from bench_regression import compare, main, throughput_points  # noqa: E402
+
+
+def _art(points):
+    """Artifact with one sweep dict of {name: rows_per_sec_per_process}."""
+    return {"metric": "m", "value": 1.0,
+            "sweep": {k: {"rows_per_sec_per_process": v,
+                          "wire_bytes_per_row_moved": 26.7}
+                      for k, v in points.items()}}
+
+
+def test_throughput_points_flattens_by_path():
+    pts = throughput_points(_art({"a": 100.0, "b": 200.0}))
+    assert pts == {"sweep/a": 100.0, "sweep/b": 200.0}
+
+
+def test_within_tolerance_passes():
+    prior, new = _art({"a": 100.0}), _art({"a": 91.0})
+    assert compare(prior, new, 0.10) == []
+
+
+def test_regression_beyond_tolerance_fails():
+    prior, new = _art({"a": 100.0}), _art({"a": 89.0})
+    problems = compare(prior, new, 0.10)
+    assert len(problems) == 1 and "REGRESSED" in problems[0]
+    assert "sweep/a" in problems[0]
+
+
+def test_dropped_sweep_point_fails_new_point_passes():
+    prior = _art({"a": 100.0})
+    new = _art({"b": 50.0})  # 'a' vanished, 'b' is new
+    problems = compare(prior, new, 0.10)
+    assert len(problems) == 1 and "MISSING" in problems[0]
+    # a brand-new point has no prior floor — never a failure by itself
+    assert all("sweep/b" not in p for p in problems)
+
+
+def test_zero_prior_point_cannot_define_a_floor():
+    assert compare(_art({"a": 0.0}), _art({"a": 0.0}), 0.10) == []
+
+
+def test_wire_bytes_are_not_gated():
+    prior, new = _art({"a": 100.0}), _art({"a": 100.0})
+    new["sweep"]["a"]["wire_bytes_per_row_moved"] = 999.0
+    assert compare(prior, new, 0.10) == []
+
+
+def test_main_end_to_end_exit_codes(tmp_path):
+    p, n = tmp_path / "prior.json", tmp_path / "new.json"
+    p.write_text(json.dumps(_art({"a": 100.0})))
+    n.write_text(json.dumps(_art({"a": 95.0})))
+    assert main([str(p), str(n)]) == 0
+    n.write_text(json.dumps(_art({"a": 50.0})))
+    assert main([str(p), str(n)]) == 1
+
+
+@pytest.mark.slow
+def test_collect_gate_collects_clean():
+    """The real gate against the real tree: `pytest --collect-only` must
+    exit 0 — the two seed collection errors (missing hypothesis) are the
+    regression this pins."""
+    proc = subprocess.run(
+        ["bash", str(REPO / "ci" / "collect_gate.sh")],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    summary = proc.stdout.strip().splitlines()[-1]
+    assert "collected" in summary and "error" not in summary, summary

@@ -306,6 +306,8 @@ def test_native_outbox_depth_observability():
 def test_frame_loss_tracker_property_counts_exact_missing():
     """Property: for ANY delivery pattern (first sighting = sync), lost
     equals exactly the holes between the first and last delivered seq."""
+    pytest.importorskip("hypothesis", reason="property test needs "
+                        "hypothesis (pip install -e .[test])")
     from hypothesis import given, settings
     from hypothesis import strategies as st
 

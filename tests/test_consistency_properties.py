@@ -19,8 +19,13 @@ Invariants under ANY interleaving of clock/admit calls:
 from __future__ import annotations
 
 import numpy as np
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need hypothesis "
+    "(pip install -e .[test])")
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from minips_tpu.consistency.controllers import ASP, BSP, SSP, make_controller
 from minips_tpu.consistency.tracker import PendingBuffer, ProgressTracker

@@ -150,6 +150,81 @@ def test_row_merge_programs_roundtrip():
     np.testing.assert_array_equal(np.asarray(new_base), out)
 
 
+def test_sync_block_rows_divisible_for_any_device_count():
+    """Regression for the shard_map divisibility bug: the padded union
+    block C was ``max(next_pow2(union), n_local)``, which a
+    non-power-of-two device count divides only by luck (n_local=6,
+    union=5 gave C=8 → 8 % 6 != 0 and the sharded merge aborts). The
+    fixed ``sync_block_rows`` must cover the union AND divide evenly."""
+    from minips_tpu.tables.sparse import next_pow2
+    from minips_tpu.train.cssp_ps import sync_block_rows
+
+    for n_local in (1, 2, 3, 4, 6, 8, 12):
+        for union in (1, 2, 5, 6, 7, 31, 100):
+            c = sync_block_rows(union, n_local)
+            assert c >= union
+            assert c % n_local == 0, (union, n_local, c)
+            # never smaller than the old retrace-friendly floor
+            assert c >= max(next_pow2(union), n_local)
+    # the exact case from the bug report: 6 local devices, union of 5
+    assert max(next_pow2(5), 6) % 6 != 0      # old formula: broken
+    assert sync_block_rows(5, 6) == 12        # fixed: 2 rows/device
+
+
+def test_sync_block_rows_six_device_mesh_shards_evenly():
+    """The same property on a REAL fake-6-device mesh: run the jitted
+    rows_delta program with the CSSP vector sharding on a host forced to
+    6 CPU devices and require every device to hold an equal shard of the
+    C*dim delta (the old C=8, dim=4 block split 32 elements over 6
+    devices unevenly; shard_map refuses exactly that layout)."""
+    script = r"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from minips_tpu.parallel.mesh import DATA_AXIS
+from minips_tpu.train.cssp_ps import sync_block_rows
+
+devs = jax.devices()
+assert len(devs) == 6, f"expected 6 fake devices, got {len(devs)}"
+mesh = Mesh(np.asarray(devs), (DATA_AXIS,))
+vec_sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+dim, union, n_local = 4, 5, len(devs)
+C = sync_block_rows(union, n_local)
+assert C % n_local == 0, (C, n_local)
+
+def rows_delta(cur, base, idx):
+    d = (cur.at[idx].get(mode="fill", fill_value=0)
+         - base.at[idx].get(mode="fill", fill_value=0))
+    return d.reshape(-1)
+
+cur = jnp.arange(16 * dim, dtype=jnp.float32).reshape(16, dim)
+base = jnp.zeros_like(cur)
+idx = np.full(C, 16, np.int64)        # out-of-bounds padding sentinel
+idx[:union] = np.arange(union)
+out = jax.jit(rows_delta, out_shardings=vec_sharding)(
+    cur, base, jnp.asarray(idx, jnp.int32))
+shapes = {s.data.shape for s in out.addressable_shards}
+assert shapes == {(C * dim // n_local,)}, shapes
+print("SIX_DEV_OK", C)
+"""
+    import os
+    import pathlib
+    import subprocess
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=6"
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=300,
+                          cwd=repo, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "SIX_DEV_OK 12" in proc.stdout
+
+
 def test_blob_exchange_allgather_and_early_arrival():
     """BlobExchange: both directions deliver, order is by rank, and an
     early round-r+1 arrival parks until that round is consumed."""

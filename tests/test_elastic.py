@@ -23,8 +23,28 @@ import sys
 
 import numpy as np
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+
+# hypothesis drives only the reshard roundtrip property below; the unit
+# and slow tiers must keep running (and the module keep collecting)
+# in environments without it — pip install -e .[test] brings it in.
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+    HAS_HYPOTHESIS = True
+except ImportError:  # pragma: no cover - exercised on bare environments
+    HAS_HYPOTHESIS = False
+
+    def given(*a, **k):  # noqa: D103 - no-op decorator stand-ins
+        return lambda f: f
+
+    def settings(*a, **k):
+        return lambda f: f
+
+    class _StrategyStub:
+        def __getattr__(self, name):
+            return lambda *a, **k: None
+
+    st = _StrategyStub()
 
 from minips_tpu import launch
 from minips_tpu.ckpt import elastic
@@ -144,6 +164,8 @@ def test_reshard_all_padding_shard(tmp_path):
     assert st["m"].shape == (3, 2) and not st["m"].any()
 
 
+@pytest.mark.skipif(not HAS_HYPOTHESIS,
+                    reason="needs hypothesis (pip install -e .[test])")
 @settings(max_examples=40, deadline=None)
 @given(num_rows=st.integers(1, 60), old_n=st.integers(1, 6),
        new_n=st.integers(1, 6), seed=st.integers(0, 2**31))

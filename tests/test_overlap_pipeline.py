@@ -1,0 +1,426 @@
+"""Overlapped PS pipeline (train/sharded_ps.py): async push, pull
+prefetch, int8 pull wire.
+
+Fast tier: threads-as-nodes over real loopback buses (the reference's
+in-process multi-node trick, SURVEY.md §4) proving the three levers'
+semantics — codec fidelity + mixed fleets on the pull wire, prefetch
+consumption/admission, the async EMIT-barrier ordering the BSP/SSP
+staleness proof rests on, and the dropped-ack drill (poison, never
+hang). Slow tier: the sharded_ps_example smoke with --overlap under a
+real SSP launcher run asserting the staleness bound and replica
+agreement survive the in-flight window.
+"""
+
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu import launch
+from minips_tpu.ops.quantized_comm import (dequantize_rows_int8,
+                                           quantize_rows_int8)
+from minips_tpu.train.sharded_ps import ShardedTable
+
+APP = "minips_tpu.apps.sharded_ps_example"
+_PORT = [6500]
+
+
+def _mk_buses(n):
+    from minips_tpu.comm.bus import make_bus
+
+    _PORT[0] += n + 1
+    addrs = [f"tcp://127.0.0.1:{_PORT[0] + i}" for i in range(n)]
+    buses = [make_bus(addrs[i], [a for j, a in enumerate(addrs) if j != i],
+                      my_id=i) for i in range(n)]
+    for b in buses:
+        b.start()
+    time.sleep(0.25)  # PUB/SUB slow-joiner settle
+    return buses
+
+
+# ------------------------------------------------------------ pull wire
+def test_pull_wire_nearest_codec_deterministic_and_bounded():
+    """rng=None selects round-to-NEAREST — the pull-wire mode for
+    weights: per-element error <= half a quantization step (half the
+    stochastic wire's worst case) and bit-identical across calls, so
+    every puller of an unchanged row decodes the same bytes."""
+    rng = np.random.default_rng(3)
+    rows = rng.normal(scale=2.0, size=(32, 16)).astype(np.float32)
+    rows[5] = 0.0
+    c1, s1 = quantize_rows_int8(rows)
+    c2, s2 = quantize_rows_int8(rows)
+    np.testing.assert_array_equal(c1, c2)
+    np.testing.assert_array_equal(s1, s2)
+    out = dequantize_rows_int8(c1, s1)
+    half_step = np.abs(rows).max(axis=1, keepdims=True) / 127.0 / 2.0
+    assert np.all(np.abs(out - rows) <= half_step + 1e-7)
+    assert not out[5].any() and s1[5] == 0.0
+
+
+def test_pull_wire_int8_and_mixed_fleet():
+    """pull_wire='int8' compresses pull REPLIES (errors within one
+    quantization step); frames self-describe their wire, so a MIXED
+    fleet — one owner compressed, one not — decodes correctly per frame,
+    and bytes_pulled counts actual (compressed) wire bytes."""
+    buses = _mk_buses(3)
+    # rank 1 serves int8 replies, rank 2 serves f32 — the puller (rank
+    # 0, itself configured int8) must decode both per-frame
+    tables = [ShardedTable("t", 96, 4, buses[i], i, 3, updater="sgd",
+                           lr=1.0, pull_timeout=10.0,
+                           pull_wire=("int8" if i < 2 else "f32"))
+              for i in range(3)]
+    try:
+        vals = np.arange(96 * 4, dtype=np.float32).reshape(96, 4) / 7.0
+        for t in tables:  # owners hold distinct known rows
+            t._w[...] = vals[t.shard_lo:t.shard_lo + 32]
+        keys = np.array([2, 40, 70])  # one row per owner
+        rows = tables[0].pull(keys)
+        # own shard exact; remote rows within one codec step of truth
+        np.testing.assert_array_equal(rows[0], vals[2])
+        for i, k in ((1, 40), (2, 70)):
+            step = np.abs(vals[k]).max() / 127.0
+            assert np.all(np.abs(rows[i] - vals[k]) <= step + 1e-6), k
+        # wire accounting: keys out (2*8B) + int8 reply (4B scale + 4B
+        # codes) + f32 reply (16B) — compressed counted compressed
+        assert tables[0].bytes_pulled == 2 * 8 + (4 + 4) + 16
+        # pull_all: the mixed wires assemble the same table everywhere
+        full0 = tables[0].pull_all()
+        full1 = tables[1].pull_all()
+        step = np.abs(vals).max() / 127.0
+        assert np.all(np.abs(full0 - vals) <= step + 1e-6)
+        assert np.all(np.abs(full1 - vals) <= step + 1e-6)
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_pull_wire_flag_validation():
+    with pytest.raises(ValueError, match="pull_wire"):
+        ShardedTable("t", 8, 2, None, 0, 1, pull_wire="bf16")
+    # the push-knob spelling is accepted as an alias
+    t = ShardedTable("t", 8, 2, None, 0, 1, pull_wire="float32")
+    assert t.pull_wire == "f32"
+
+
+# ------------------------------------------------------------- prefetch
+def test_prefetch_consumed_by_pull_without_second_round_trip():
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, pull_timeout=10.0)
+    try:
+        t1._w[...] = 5.0
+        keys = np.array([40, 41])
+        fut = t0.prefetch_pull(keys, clock_ahead=0)
+        reqs_after_prefetch = t0._req
+        rows = t0.pull(keys)  # must consume fut, not issue a new pull
+        assert t0._req == reqs_after_prefetch, "pull() re-issued on wire"
+        np.testing.assert_allclose(rows, 5.0)
+        with pytest.raises(RuntimeError, match="twice"):
+            fut.wait()
+        # a fresh pull (nothing prefetched) still round-trips normally
+        np.testing.assert_allclose(t0.pull(keys), 5.0)
+        assert t0._req == reqs_after_prefetch + 1
+        # cancel releases the reply slot of an unconsumed prefetch
+        fut2 = t0.prefetch_pull(keys)
+        fut2.cancel()
+        assert not t0._replies and not t0._prefetched
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_prefetch_same_keys_twice_keeps_held_future_waitable():
+    """The double-buffer pattern holds batch t's future while issuing
+    batch t+1's; when consecutive batches draw byte-identical keys the
+    new prefetch displaces the old registry slot but must NOT invalidate
+    the handle the caller still holds (regression: cancelling it made
+    ``fut.wait()`` raise RuntimeError — guaranteed crash on iteration 2
+    of ``--overlap`` runs over tiny key spaces)."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, pull_timeout=10.0)
+    try:
+        t1._w[...] = 4.0
+        keys = np.array([40, 41])
+        f1 = t0.prefetch_pull(keys)            # batch t
+        f2 = t0.prefetch_pull(keys.copy())     # batch t+1, same bytes
+        np.testing.assert_allclose(f1.wait(), 4.0)  # t consumes its own
+        np.testing.assert_allclose(t0.pull(keys), 4.0)  # consumes f2
+        assert f2._done and not t0._prefetched and not t0._replies
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_stale_prefetch_not_consumed_by_later_pull():
+    """A dangling prefetch from an earlier step was admitted under an
+    OLDER global-min view; a pull() many clocks later with byte-
+    identical keys must NOT consume it (that would read past the
+    staleness bound silently) — it cancels the stale future and
+    round-trips fresh."""
+
+    class Cons:
+        def __init__(self):
+            self.clock = 5
+
+        def admit_pull(self, clk):
+            return True  # admission open: staleness isn't the guard here
+
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, pull_timeout=10.0)
+    c0 = Cons()
+    t0.bind_consistency(c0)
+    try:
+        t1._w[...] = 1.0
+        keys = np.array([40, 41])
+        fut = t0.prefetch_pull(keys)  # stamped clock 6
+        time.sleep(0.3)               # served + replied with rows = 1.0
+        t1._w[...] = 9.0              # owner state moves on...
+        c0.clock = 9                  # ...and so does my clock
+        rows = t0.pull(keys)          # stamp 6 < clock 9: must re-issue
+        np.testing.assert_allclose(rows, 9.0)
+        assert fut._done and not t0._prefetched and not t0._replies
+        # a CURRENT prefetch (stamped clock+1) is still consumed
+        fut2 = t0.prefetch_pull(keys)
+        assert t0.pull(keys) is not None and fut2._done
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_prefetch_future_clock_parks_until_admitted():
+    """A prefetch stamped one clock AHEAD is parked at the owner under
+    exactly the admission rule the consuming step would face — overlap
+    never weakens the staleness bound — and the LOCAL shard slice obeys
+    the same rule on the requester."""
+
+    class Cons:  # controllable admission stub (same as test_sharded_ps)
+        clock = 5
+
+        def __init__(self):
+            self.ok = False
+
+        def admit_pull(self, clk):
+            return self.ok or clk <= self.clock
+
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, pull_timeout=10.0)
+    c0, c1 = Cons(), Cons()
+    t0.bind_consistency(c0)
+    t1.bind_consistency(c1)
+    try:
+        t1._w[...] = 3.0
+        t0._w[...] = 7.0
+        # keys span the remote owner AND my own shard: both legs gate
+        fut = t0.prefetch_pull(np.array([40, 3]))  # stamped clock 6
+        got = {}
+
+        def waiter():
+            got["rows"] = fut.wait()
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        deadline = time.time() + 5
+        while not t1._parked and time.time() < deadline:
+            time.sleep(0.02)
+        assert t1._parked, "future-stamped prefetch was served early"
+        assert th.is_alive()  # wait() blocked on remote + local admission
+        c1.ok = True
+        t1.serve_parked()
+        time.sleep(0.2)
+        assert th.is_alive(), "local slice read before local admission"
+        c0.ok = True  # my own view catches up
+        th.join(timeout=5)
+        assert not th.is_alive()
+        np.testing.assert_allclose(got["rows"], [[3.0, 3.0], [7.0, 7.0]])
+    finally:
+        for b in buses:
+            b.close()
+
+
+# ----------------------------------------------------------- async push
+def test_async_push_applies_acks_and_hard_drains():
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="sgd", lr=1.0,
+                      pull_timeout=10.0, async_push=True, push_window=4)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="sgd", lr=1.0,
+                      pull_timeout=10.0)
+    try:
+        for k in range(3):
+            t0.push(np.array([40 + k, k]), np.ones((2, 2), np.float32))
+        t0.flush_pushes()  # hard drain: queue empty AND every ack in
+        assert t0._q_pending == 0 and not t0._inflight
+        assert t0.timers.push_acks == 3  # one acked frame per push
+        for k in range(3):  # owner applied every frame, local leg too
+            np.testing.assert_allclose(t1._w[40 + k - 32], -1.0)
+            np.testing.assert_allclose(t0._w[k], -1.0)
+        # callers may reuse their buffers: push() copies
+        buf = np.ones((1, 2), np.float32)
+        t0.push(np.array([50]), buf)
+        buf[...] = 99.0
+        t0.flush_pushes()
+        np.testing.assert_allclose(t1._w[50 - 32], -1.0)
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_async_push_emit_barrier_orders_before_clock_frame():
+    """The EMIT-barrier contract behind the BSP/SSP staleness proof:
+    after flush_pushes(acks=False) — the clock-boundary drain tick()
+    runs under a finite bound — a frame sent on the SAME link is
+    ordered AFTER every drained push, so an owner that has seen my
+    clock frame has already applied my step's pushes."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="sgd", lr=1.0,
+                      pull_timeout=10.0, async_push=True, push_window=8)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="sgd", lr=1.0,
+                      pull_timeout=10.0)
+    seen = []
+    # a stand-in for the clock frame, riding the same rank0->rank1 link
+    buses[1].on("probe", lambda s, p: seen.append(t1._w[40 - 32].copy()))
+    try:
+        for _ in range(5):
+            seen.clear()
+            w0 = t1._w[40 - 32, 0]
+            t0.push(np.array([40]), np.ones((1, 2), np.float32))
+            t0.flush_pushes(acks=False)  # queue handed to the bus...
+            buses[0].send(1, "probe", {})  # ...then the "clock" frame
+            deadline = time.time() + 5
+            while not seen and time.time() < deadline:
+                time.sleep(0.01)
+            assert seen, "probe frame lost"
+            # FIFO per link: the probe observed the push already applied
+            np.testing.assert_allclose(seen[0], w0 - 1.0)
+        t0.flush_pushes()
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_async_push_dropped_ack_poisons_via_check_fatal_not_hang():
+    """Fault drill (the acceptance criterion): the owner receives and
+    APPLIES pushes but its acks are lost. The sender's window jams, the
+    drain deadline poisons the table, and check_fatal() raises — the
+    loop fails loudly instead of hanging."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="sgd", lr=1.0,
+                      pull_timeout=1.0, async_push=True, push_window=2)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="sgd", lr=1.0,
+                      pull_timeout=1.0)
+    t1._ack_push = lambda sender, payload: None  # ack loss injection
+    try:
+        t_start = time.monotonic()
+        for k in range(3):  # window 2: frame 3 queues behind lost acks
+            t0.push(np.array([40 + k]), np.ones((1, 2), np.float32))
+        t0.flush_pushes(timeout=1.0)  # returns (poisoned), never hangs
+        with pytest.raises(RuntimeError, match="push"):
+            t0.check_fatal()  # what trainer.tick() runs every step
+        assert time.monotonic() - t_start < 10.0  # bounded, not a hang
+        # the pushes that DID get out were applied — loss detection is
+        # about the sender's knowledge, not the owner's state
+        np.testing.assert_allclose(t1._w[40 - 32], -1.0)
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_async_push_backpressure_bounds_queue():
+    """With no bus (standalone) pushes apply inline; with a dead owner
+    the queue is bounded by push_window and surfaces a loud error."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="sgd", lr=1.0,
+                      pull_timeout=0.5, async_push=True, push_window=1)
+    ShardedTable("t", 64, 2, buses[1], 1, 2)
+    buses[1].close()  # owner gone: no acks ever
+    try:
+        with pytest.raises((TimeoutError, RuntimeError)):
+            for k in range(8):  # window 1 jams almost immediately
+                t0.push(np.array([40]), np.ones((1, 2), np.float32))
+                time.sleep(0.05)
+        assert t0._q_pending <= 1 + t0.push_window
+    finally:
+        buses[0].close()
+
+
+# ------------------------------------------------------------ TrainLoop
+def test_train_loop_prefetch_announces_next_batch_first():
+    from minips_tpu.train.loop import TrainLoop
+
+    events = []
+    loop = TrainLoop(lambda b: events.append(("step", b)) or 0.0,
+                     iter([0, 1, 2, 3]),
+                     prefetch=lambda b: events.append(("prefetch", b)),
+                     log_every=0, batch_size=1)
+    losses = loop.run(3)
+    assert len(losses) == 3
+    # batch t+1 is announced before batch t steps; batch 3 was
+    # prefetched but never stepped (num_iters bound) — caller cleanup
+    assert events == [("prefetch", 1), ("step", 0),
+                      ("prefetch", 2), ("step", 1),
+                      ("prefetch", 3), ("step", 2)]
+
+    # a finite stream ends cleanly with lookahead active
+    events.clear()
+    loop = TrainLoop(lambda b: events.append(("step", b)) or 0.0,
+                     iter([0, 1]),
+                     prefetch=lambda b: events.append(("prefetch", b)),
+                     log_every=0, batch_size=1)
+    assert len(loop.run(5)) == 2
+    assert events == [("prefetch", 1), ("step", 0), ("step", 1)]
+
+
+# ------------------------------------------------------- multi-process
+@pytest.mark.slow
+def test_overlap_ssp_three_processes_staleness_bound_holds():
+    """The BSP/SSP consistency proof under the full pipeline: --overlap
+    (async ack-windowed push + prefetch stamped one clock ahead) with a
+    straggler must still honor the s+1 transient skew bound, lose no
+    frames, and agree across replicas after finalize — the in-flight
+    window may never widen staleness."""
+    _PORT[0] += 8
+    res = launch.run_local_job(
+        3, [sys.executable, "-m", APP, "--iters", "40", "--model",
+            "sparse", "--mode", "ssp", "--staleness", "2",
+            "--slow-rank", "1", "--slow-ms", "30", "--overlap",
+            "--pull-wire", "int8"],
+        base_port=_PORT[0],
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
+        timeout=240.0)
+    assert all(r["event"] == "done" for r in res)
+    for r in res:
+        assert r["frames_dropped"] == 0, r
+        assert r["wire_frames_lost"] == 0, r
+        assert r["max_skew_seen"] <= 3, r  # s + 1 transient bound
+        assert r["loss_last"] < r["loss_first"], r
+        # the knob echo the sweeps assert on
+        assert r["overlap"] is True and r["pull_wire"] == "int8", r
+        # the pipeline actually overlapped: pull wait left the step path
+        frac = r["timing"]["pull_overlap_fraction"]
+        assert frac is not None and frac > 0.3, r["timing"]
+    sums = [r["param_sum"] for r in res]
+    assert max(sums) - min(sums) < 1e-4, sums
+
+
+@pytest.mark.slow
+def test_overlap_bsp_two_processes_lockstep_holds():
+    """BSP + --overlap: the drain at the clock boundary keeps lockstep
+    (skew <= 1) with the async window active."""
+    _PORT[0] += 8
+    res = launch.run_local_job(
+        2, [sys.executable, "-m", APP, "--iters", "30", "--model",
+            "sparse", "--mode", "bsp", "--overlap"],
+        base_port=_PORT[0],
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
+        timeout=240.0)
+    assert all(r["event"] == "done" for r in res)
+    for r in res:
+        assert r["frames_dropped"] == 0, r
+        assert r["wire_frames_lost"] == 0, r
+        assert r["max_skew_seen"] <= 1, r  # BSP lockstep
+    sums = [r["param_sum"] for r in res]
+    assert max(sums) - min(sums) < 1e-4, sums
