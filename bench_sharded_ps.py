@@ -37,7 +37,14 @@ def _worker_argv(path: str, iters: int, warmup: int,
                  push_comm: str = "float32",
                  pull_wire: str = "f32",
                  overlap: bool = False,
-                 overlap_legs: str = "both") -> list[str]:
+                 overlap_legs: str = "both",
+                 key_dist: str = "uniform",
+                 staleness: float | None = None,
+                 cache_bytes: int = 0,
+                 pull_dedup: bool = True,
+                 push_dedup: bool = True,
+                 rows: int | None = None,
+                 updater: str | None = None) -> list[str]:
     argv = [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
             "--path", path, "--iters", str(iters), "--warmup", str(warmup)]
     if compute != "none":
@@ -52,6 +59,20 @@ def _worker_argv(path: str, iters: int, warmup: int,
         argv += ["--overlap"]
         if overlap_legs != "both":
             argv += ["--overlap-legs", overlap_legs]
+    if key_dist != "uniform":
+        argv += ["--key-dist", key_dist]
+    if staleness is not None:
+        argv += ["--staleness", str(staleness)]
+    if cache_bytes:
+        argv += ["--cache-bytes", str(cache_bytes)]
+    if not pull_dedup:
+        argv += ["--no-pull-dedup"]
+    if not push_dedup:
+        argv += ["--no-push-dedup"]
+    if rows is not None:
+        argv += ["--rows", str(rows)]
+    if updater is not None:
+        argv += ["--updater", updater]
     return argv
 
 
@@ -59,7 +80,11 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
          compute: str = "none", force_cpu: bool = False,
          hidden: int | None = None, push_comm: str = "float32",
          pull_wire: str = "f32", overlap: bool = False,
-         overlap_legs: str = "both") -> dict:
+         overlap_legs: str = "both", key_dist: str = "uniform",
+         staleness: float | None = None, cache_bytes: int = 0,
+         pull_dedup: bool = True, push_dedup: bool = True,
+         rows: int | None = None,
+         updater: str | None = None) -> dict:
     """One sweep point → {rows_per_sec_per_process, aggregate, wire...}.
 
     ``compute="jit"`` adds a real jitted model-grad step between pull and
@@ -68,7 +93,9 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     topology (accelerator workers against a sharded host PS) instead of
     the bare control plane. ``hidden`` sizes that step's MLP."""
     argv = _worker_argv(path, iters, warmup, compute, hidden,
-                        push_comm, pull_wire, overlap, overlap_legs)
+                        push_comm, pull_wire, overlap, overlap_legs,
+                        key_dist, staleness, cache_bytes, pull_dedup,
+                        push_dedup, rows, updater)
     env_extra = {}
     if bus != "zmq":
         env_extra["MINIPS_BUS"] = bus
@@ -110,6 +137,19 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     if compute != "none":
         out["worker_compute"] = sorted({r.get("compute", "?")
                                         for r in res})
+    # row-flow + cache observables (the dedup/cache sweep's evidence):
+    # wire-row fraction from the per-rank timers; hit rate from the
+    # caches (None — distinct from 0.0 — when the arm runs cache-off)
+    reqs = sum(r["timing"].get("pull_rows_requested", 0) for r in res)
+    wires = sum(r["timing"].get("pull_rows_wire", 0) for r in res)
+    if reqs:
+        out["pull_rows_wire_frac"] = round(wires / reqs, 4)
+    caches = [r.get("cache") for r in res]
+    if any(c is not None for c in caches):
+        hits = sum(c["hits"] for c in caches if c)
+        looks = sum(c["lookups"] for c in caches if c)
+        out["cache_hit_rate"] = (round(hits / looks, 4) if looks
+                                 else 0.0)
     # the workers echo their wire formats — a silent flag-plumbing
     # regression must not publish a float32 number labeled int8 (nor a
     # synchronous number labeled overlapped)
@@ -122,6 +162,17 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     echoed_legs = {r.get("overlap_legs") for r in res}
     assert echoed_legs == {overlap_legs if overlap else None}, (
         overlap_legs, echoed_legs)
+    echoed_kd = {r.get("key_dist", "uniform") for r in res}
+    assert echoed_kd == {key_dist}, (key_dist, echoed_kd)
+    echoed_cb = {r.get("cache_bytes", 0) for r in res}
+    assert echoed_cb == {cache_bytes}, (cache_bytes, echoed_cb)
+    echoed_dd = {r.get("pull_dedup", True) for r in res}
+    assert echoed_dd == {pull_dedup}, (pull_dedup, echoed_dd)
+    echoed_pd = {r.get("push_dedup", True) for r in res}
+    assert echoed_pd == {push_dedup}, (push_dedup, echoed_pd)
+    if staleness is not None:
+        echoed_s = {r.get("staleness") for r in res}
+        assert echoed_s == {int(staleness)}, (staleness, echoed_s)
     return out
 
 
@@ -204,6 +255,58 @@ def main() -> int:
     n_fit = min(3, os.cpu_count() or 3)
     over_fit = _overlap_arms(n_fit, o_reps) if n_fit != 3 else over
 
+    # client row cache + deduplicated pull wire: "off" is the SEED wire
+    # (duplicate keys verbatim, no cache) — the before/after this PR's
+    # tentpole is judged on; "on" is unique-key wire + clock-versioned
+    # row cache. The grid crosses key distribution with staleness
+    # because the cache's validity window IS the staleness budget: the
+    # uniform arms keep the standard 64k-row table (keys essentially
+    # never recur — the no-win control, dedup/locality only), the zipf
+    # arms shrink the table to the HOT WORKING SET a zipf(1.1) head
+    # concentrates on, so re-draws land within the staleness window.
+    # Same alternating-median honesty rules as the overlap sweep.
+    # Fixed knobs: sgd updater + f32 push wire (the write-through
+    # regime — adagrad/adam invalidate on push, pinning hit rate to ~0
+    # in a pull+push cycle; see docs/consistency.md); cache ample (no
+    # LRU pressure — the byte bound has its own tests). READ THE
+    # ROWS/SEC COLUMN WITH THE HOST IN MIND (the overlap sweep's
+    # caveat, again): on this CPU-loopback container wire bytes are
+    # memcpys — shipping 5x the rows costs almost nothing — so the
+    # on-arm's saved bytes buy no wall-clock, while its bursty misses
+    # (same-step fills share a stamp and expire TOGETHER) hit the
+    # owner park / gate wake instead of riding an amortized stream:
+    # measured medians put the zipf on-arm ~5-15% under the off-arm
+    # at s>=1 (with --compute jit filling the freed time the arms tie
+    # within drift). The levers this sweep PROVES are hit rate > 0
+    # rising with s (the staleness budget buying locality) and
+    # B/row-moved down ~84% on zipf — the currency that converts to
+    # rows/sec exactly where the wire is a real network or the worker
+    # has its own compute, the deployments the north star names.
+    ZIPF_ROWS, CACHE_BYTES = 2048, 1 << 22
+
+    def _cache_arms(reps: int) -> dict:
+        arms = {"off": {"cache_bytes": 0, "pull_dedup": False,
+                        "push_dedup": False},  # = the full seed wire
+                "on": {"cache_bytes": CACHE_BYTES}}
+        dists = {"uniform": None, "zipf": ZIPF_ROWS}  # dist -> rows
+        runs: dict[tuple, list[dict]] = {}
+        for _ in range(reps):
+            for dist, rows in dists.items():
+                for s in (0, 1, 2):
+                    for a, kw in arms.items():
+                        runs.setdefault((dist, s, a), []).append(
+                            _run(3, "sparse", iters, warmup, "zmq",
+                                 key_dist=dist, staleness=s,
+                                 rows=rows, updater="sgd", **kw))
+        grid: dict = {"zipf_rows": ZIPF_ROWS, "cache_bytes": CACHE_BYTES}
+        for (dist, s, a), rs in runs.items():
+            by = sorted(rs, key=lambda r: r["rows_per_sec_per_process"])
+            point = {**by[len(by) // 2], "reps": reps}
+            grid.setdefault(dist, {}).setdefault(f"s{s}", {})[a] = point
+        return grid
+
+    cache_grid = _cache_arms(o_reps)
+
     headline = curve["3"]["rows_per_sec_per_process"]
     print(json.dumps({
         "metric": "sharded-PS rows/sec/process (sparse pull+push, "
@@ -219,6 +322,7 @@ def main() -> int:
         "pull_wire_comparison_3proc": pull_wires,
         "overlap_on_off_3proc": over,
         "overlap_on_off_fit": {"nprocs": n_fit, **over_fit},
+        "cache_comparison_3proc": cache_grid,
     }))
     return 0
 

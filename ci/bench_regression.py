@@ -14,6 +14,11 @@ throughput every sweep reports. Wire-bytes numbers are deliberately NOT
 gated on direction (a codec change moves them on purpose); they are
 printed for the reviewer instead.
 
+One absolute (prior-free) gate rides along: ``cache_tripwires`` fails a
+new artifact whose ``cache_comparison_3proc`` zipf arms report a zero
+hit rate with the cache on and staleness >= 1 — the "cache silently
+disabled" failure mode, which a pure throughput comparison can miss.
+
 Usage:
     python ci/bench_regression.py PRIOR.json NEW.json [--tolerance 0.10]
     python ci/bench_regression.py --against-git [NEW.json]
@@ -56,6 +61,34 @@ def throughput_points(artifact: dict) -> dict[str, float]:
 
     walk(artifact, "")
     return out
+
+
+def cache_tripwires(new: dict) -> list[str]:
+    """The 'cache silently disabled' tripwire: in the
+    ``cache_comparison_3proc`` sweep, the zipf arms with staleness >= 1
+    and the cache ON must show a hit rate strictly above 0 — a zipfian
+    batch re-draws hot rows every step, and with SSP slack the cache
+    serving NONE of them means the lever quietly fell off (flag
+    plumbing, stamp regression, over-eager invalidation) while
+    rows/sec alone might still look fine. s=0 (BSP) arms are exempt:
+    a stamp can never satisfy the next clock's bound there, so ~0 is
+    the CORRECT hit rate. Arms missing entirely are the generic
+    MISSING check's job (dropped sweep points fail there)."""
+    problems = []
+    zipf = (new.get("cache_comparison_3proc") or {}).get("zipf") or {}
+    for sname, arms in sorted(zipf.items()):
+        try:
+            s = int(sname.lstrip("s"))
+        except ValueError:
+            continue
+        on = (arms or {}).get("on") or {}
+        hr = on.get("cache_hit_rate")
+        if s >= 1 and not (isinstance(hr, (int, float)) and hr > 0):
+            problems.append(
+                f"CACHE-DEAD cache_comparison_3proc/zipf/{sname}/on: "
+                f"hit-rate {hr!r} with staleness {s} — the client row "
+                "cache is silently disabled")
+    return problems
 
 
 def compare(prior: dict, new: dict, tolerance: float) -> list[str]:
@@ -108,7 +141,7 @@ def main(argv: list[str] | None = None) -> int:
     with open(new_path) as f:
         new = json.load(f)
 
-    problems = compare(prior, new, args.tolerance)
+    problems = compare(prior, new, args.tolerance) + cache_tripwires(new)
     pts = throughput_points(new)
     print(f"bench-regression: {len(pts)} throughput points checked "
           f"against {len(throughput_points(prior))} prior")

@@ -345,6 +345,39 @@ def add_wire_flags(parser) -> None:
                              "hosts (the bench sweeps both)")
     parser.add_argument("--push-window", dest="push_window",
                         type=int, default=32)
+    parser.add_argument("--cache-bytes", dest="cache_bytes",
+                        type=int, default=0,
+                        help="clock-versioned client row cache, LRU "
+                             "byte bound (0 = off): pulls are served "
+                             "locally for rows whose reply stamp still "
+                             "satisfies the SSP admission rule — a hit "
+                             "is provably no staler than a synchronous "
+                             "pull (docs/consistency.md)")
+    parser.add_argument("--no-pull-dedup", dest="pull_dedup",
+                        action="store_false", default=True,
+                        help="ship pull requests verbatim (duplicate "
+                             "keys and all) instead of unique keys — "
+                             "the pre-cache wire, kept as the bench's "
+                             "A/B baseline; incompatible with "
+                             "--cache-bytes > 0")
+    parser.add_argument("--no-push-dedup", dest="push_dedup",
+                        action="store_false", default=True,
+                        help="ship pushes per-occurrence instead of "
+                             "coalescing duplicate keys client-side "
+                             "(the seed wire; the server still sums) "
+                             "— the bench's A/B baseline")
+
+
+def table_wire_kwargs(args) -> dict:
+    """The ShardedTable kwargs every sharded-PS app derives from
+    add_wire_flags — one mapping so a new wire knob can't silently miss
+    an app (async_push stays per-app: it also depends on
+    --overlap-legs)."""
+    return {"push_comm": args.push_comm, "pull_wire": args.pull_wire,
+            "push_window": args.push_window,
+            "cache_bytes": args.cache_bytes,
+            "pull_dedup": args.pull_dedup,
+            "push_dedup": args.push_dedup}
 
 
 def emit_multiproc_done(trainer, rank: int, t0: float, losses,

@@ -41,6 +41,21 @@ def main(argv=None) -> int:
     ap.add_argument("--warmup", type=int, default=10)
     ap.add_argument("--updater", choices=["sgd", "adagrad", "adam"],
                     default="adagrad")
+    ap.add_argument("--key-dist", choices=["uniform", "zipf"],
+                    default="uniform",
+                    help="sparse-path key distribution: uniform, or "
+                         "seeded zipf(--zipf-alpha) with hot ranks "
+                         "spread across shards "
+                         "(data/synthetic.make_zipf_sampler) — the "
+                         "workload where the client row cache and the "
+                         "deduplicated pull wire earn their keep")
+    ap.add_argument("--zipf-alpha", type=float, default=1.1)
+    ap.add_argument("--staleness", type=float, default=float("inf"),
+                    help="consistency bound for the run: inf = ASP "
+                         "(the default; measures the bare data path), "
+                         "finite s = SSP(s) — the cache_comparison "
+                         "sweep runs s in {0,1,2} because the cache's "
+                         "validity window IS the staleness budget")
     ap.add_argument("--compute", choices=["none", "jit"], default="none",
                     help="jit: between pull and push, run a REAL jitted "
                          "model-grad step on the pulled rows (rank 0 on "
@@ -110,18 +125,18 @@ def main(argv=None) -> int:
     else:  # standalone: zero-wire baseline, pure server-side apply
         bus = monitor = None
 
+    from minips_tpu.apps.common import table_wire_kwargs
+
     table = ShardedTable("b", args.rows, args.dim, bus, rank, nprocs,
                          updater=args.updater, lr=0.05,
                          pull_timeout=60.0, monitor=monitor,
-                         push_comm=args.push_comm,
-                         pull_wire=args.pull_wire,
                          async_push=(args.overlap and
                                      args.overlap_legs != "pull"),
-                         push_window=args.push_window)
+                         **table_wire_kwargs(args))
     trainer = None
     if bus is not None:
         trainer = ShardedPSTrainer({"b": table}, bus, nprocs,
-                                   staleness=float("inf"),
+                                   staleness=args.staleness,
                                    gate_timeout=60.0, monitor=monitor)
         bus.handshake(nprocs)
 
@@ -129,6 +144,14 @@ def main(argv=None) -> int:
     B, dim = args.batch, args.dim
     grads = rng.normal(size=(B, dim)).astype(np.float32)
     dense_grad = rng.normal(size=(args.rows, dim)).astype(np.float32)
+    zipf_sample = None
+    if args.key_dist == "zipf":
+        from minips_tpu.data.synthetic import make_zipf_sampler
+
+        # spread_seed shared across ranks: every process sees the SAME
+        # hot rows (a real workload's skew), scattered across shards
+        zipf_sample = make_zipf_sampler(args.rows, args.zipf_alpha,
+                                        spread_seed=7)
 
     y_lab = (rng.random(B) > 0.5).astype(np.float32)
 
@@ -141,6 +164,8 @@ def main(argv=None) -> int:
     pending: list = [None, None]  # [keys, PullFuture]
 
     def draw_keys():
+        if zipf_sample is not None:
+            return zipf_sample(rng, B)
         return rng.integers(0, args.rows, size=B)
 
     def cycle():
@@ -195,6 +220,16 @@ def main(argv=None) -> int:
         "pull_wire": args.pull_wire,   # echo: bench asserts negotiation
         "overlap": bool(args.overlap),
         "overlap_legs": args.overlap_legs if args.overlap else None,
+        # cache/key-dist echo: the sweep asserts these so a flag-
+        # plumbing regression can't publish a mislabeled arm
+        "key_dist": args.key_dist,
+        "zipf_alpha": args.zipf_alpha if args.key_dist == "zipf" else None,
+        "staleness": (None if args.staleness == float("inf")
+                      else int(args.staleness)),
+        "cache_bytes": args.cache_bytes,
+        "pull_dedup": bool(args.pull_dedup),
+        "push_dedup": bool(args.push_dedup),
+        "cache": table.cache_stats(),
         "compute": (f"jit({backend})" if args.compute == "jit"
                     else "none"),
         "bus": os.environ.get("MINIPS_BUS", "zmq") if bus else "none",

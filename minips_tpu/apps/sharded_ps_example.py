@@ -75,7 +75,8 @@ def main(argv=None) -> int:
     import numpy as np
 
     from minips_tpu.apps.common import (init_multiproc, run_multiproc_body,
-                                        shard_checkpointing)
+                                        shard_checkpointing,
+                                        table_wire_kwargs)
     from minips_tpu.data import synthetic
     from minips_tpu.models import lr as lr_model
     from minips_tpu.tables.sparse import next_pow2
@@ -99,11 +100,9 @@ def main(argv=None) -> int:
     table = ShardedTable("w", num_rows, 1, bus, rank, nprocs,
                          updater=args.updater, lr=args.lr,
                          monitor=monitor, pull_timeout=20.0,
-                         push_comm=args.push_comm,
-                         pull_wire=args.pull_wire,
                          async_push=(args.overlap and
                                      args.overlap_legs != "pull"),
-                         push_window=args.push_window)
+                         **table_wire_kwargs(args))
     trainer = ShardedPSTrainer({"w": table}, bus, nprocs,
                                staleness=staleness, gate_timeout=30.0,
                                monitor=monitor)
@@ -217,6 +216,8 @@ def main(argv=None) -> int:
             "pull_wire": args.pull_wire,
             "overlap": bool(args.overlap),
             "overlap_legs": args.overlap_legs if args.overlap else None,
+            "cache_bytes": args.cache_bytes,
+            "pull_dedup": bool(args.pull_dedup),
             "wall_s": round(time.monotonic() - t0, 4),
             "loss_first": losses[0] if losses else None,
             "loss_last": float(np.mean(losses[-5:])) if losses else None,

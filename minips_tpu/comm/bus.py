@@ -381,6 +381,24 @@ class ClockGossip:
         with self._cond:
             return self._min_locked()
 
+    def min_excluding(self, process_id: int) -> int:
+        """min clock over live processes OTHER than ``process_id`` — the
+        freshness certificate an owner stamps on a pull reply to that
+        process (train/sharded_ps.py row cache). The requester's own
+        entry is excluded because its contribution to the reply's
+        freshness is certified by a different mechanism: per-link FIFO
+        means the owner has applied every push the requester sent before
+        the pull, regardless of how stale the requester's *gossiped*
+        clock looks here — including it would only let the slowest
+        reader invalidate its own cache. With no other live process
+        left to certify, fall back to the plain global min (which then
+        includes the requester's own gossiped clock — conservative: a
+        lower stamp only costs cache hits, never staleness)."""
+        with self._cond:
+            vals = [min(v) for p, v in self._clocks.items()
+                    if v and p not in self._excluded and p != process_id]
+            return min(vals) if vals else self._min_locked()
+
     @property
     def excluded(self) -> set[int]:
         with self._cond:

@@ -26,6 +26,25 @@ import time
 RETIRED_CLOCK = 1 << 30
 
 
+def admits(global_min: float, clk: int, staleness: float) -> bool:
+    """THE BSP/SSP/ASP admission predicate, in one place: a read stamped
+    with requester clock ``clk`` may be served from state whose freshness
+    certificate is ``global_min`` iff ``global_min >= clk − staleness``
+    (BSP: s=0, SSP: bounded s, ASP: ∞ ⇒ always).
+
+    Two call sites share it deliberately: the owner-side pull admission
+    (``ShardedPSTrainer.admit_pull`` — serve or park) and the client row
+    cache's validity rule (``train/sharded_ps.RowCache`` — a cached row
+    whose pull reply was stamped ``global_min = g`` by its owner may
+    satisfy a later pull at clock ``c`` iff ``admits(g, c, s)``). One
+    predicate means a cache hit is admissible exactly when a synchronous
+    pull served under min-view ``g`` would have been — the staleness
+    proof lives in the stamp, not in a second, weaker rule."""
+    if staleness == float("inf"):
+        return True
+    return global_min >= clk - int(staleness)
+
+
 def publish_clock(gossip, clock: int, retired: bool) -> None:
     """The one place trainer clocks reach the gossip layer — retirement
     stickiness lives here so every trainer gets it."""

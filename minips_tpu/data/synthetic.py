@@ -12,6 +12,36 @@ from __future__ import annotations
 import numpy as np
 
 
+def zipf_popularity(num_keys: int, alpha: float) -> np.ndarray:
+    """Normalized zipf(``alpha``) popularity over ``num_keys`` ranks —
+    the one definition every skewed-key generator here shares (sparse
+    features, Criteo categoricals, token unigrams, the PS bench's hot-row
+    traffic) instead of ad-hoc ``1/rank**a`` copies."""
+    p = 1.0 / np.arange(1, num_keys + 1, dtype=np.float64) ** alpha
+    return p / p.sum()
+
+
+def make_zipf_sampler(num_keys: int, alpha: float = 1.1, *,
+                      spread_seed: int = 0):
+    """Seeded zipfian KEY sampler: returns ``sample(rng, size) ->
+    int64[size]`` drawing keys with zipf(``alpha``) popularity, with the
+    rank→key mapping scrambled by a FIXED permutation (``spread_seed``).
+
+    The permutation matters for anything range-sharded (the sharded PS):
+    raw zipf puts all the head mass in keys 0..k, i.e. entirely inside
+    shard 0 — every hot row would be one owner's local traffic and the
+    skew would never exercise the wire. Sharing ``spread_seed`` across
+    ranks keeps every process's notion of 'hot rows' identical, like a
+    real workload's."""
+    p = zipf_popularity(num_keys, alpha)
+    perm = np.random.default_rng(spread_seed).permutation(num_keys)
+
+    def sample(rng: np.random.Generator, size: int) -> np.ndarray:
+        return perm[rng.choice(num_keys, size=size, p=p)].astype(np.int64)
+
+    return sample
+
+
 def classification_dense(n: int = 4096, dim: int = 123, seed: int = 0):
     """a9a-like dense binary classification: [N, dim] features, {0,1} labels,
     linearly separable-ish with noise."""
@@ -28,9 +58,7 @@ def classification_sparse(n: int = 4096, dim: int = 47_236,
     zipf-ish so hot keys exist (realistic PS traffic skew)."""
     rng = np.random.default_rng(seed)
     w = rng.normal(size=dim).astype(np.float32) / np.sqrt(nnz_per_row)
-    # zipf-weighted feature popularity
-    pop = 1.0 / np.arange(1, dim + 1) ** 0.7
-    pop /= pop.sum()
+    pop = zipf_popularity(dim, 0.7)  # zipf-weighted feature popularity
     idx = rng.choice(dim, size=(n, nnz_per_row), p=pop).astype(np.int32)
     val = np.abs(rng.normal(size=(n, nnz_per_row))).astype(np.float32)
     mask = np.ones((n, nnz_per_row), np.float32)
@@ -68,8 +96,7 @@ def criteo_like(n: int = 8192, num_dense: int = 13, num_cat: int = 26,
     zipf-skewed), binary click label correlated with a hidden linear model."""
     rng = np.random.default_rng(seed)
     dense = rng.normal(size=(n, num_dense)).astype(np.float32)
-    pop = 1.0 / np.arange(1, cat_cardinality + 1) ** 1.05
-    pop /= pop.sum()
+    pop = zipf_popularity(cat_cardinality, 1.05)
     cats = rng.choice(cat_cardinality, size=(n, num_cat), p=pop).astype(
         np.int64)
     # distinct id spaces per field (like Criteo's per-column vocabularies)
@@ -86,8 +113,7 @@ def text_corpus(vocab: int = 10_000, n_tokens: int = 200_000, seed: int = 0):
     """enwiki-shaped token stream: zipf unigram distribution with weak
     bigram structure (neighbors correlated) for skip-gram training."""
     rng = np.random.default_rng(seed)
-    p = 1.0 / np.arange(1, vocab + 1) ** 1.05
-    p /= p.sum()
+    p = zipf_popularity(vocab, 1.05)
     tokens = rng.choice(vocab, size=n_tokens, p=p).astype(np.int32)
     # weak local structure: every other token copies a neighbor's topic bucket
     tokens[1::2] = (tokens[::2][: len(tokens[1::2])] + rng.integers(

@@ -31,6 +31,7 @@ class TrainLoop:
         profile_dir: Optional[str] = None,
         profile_range: tuple[int, int] = (10, 13),
         prefetch: Optional[Callable[[Any], None]] = None,
+        extra_metrics: Optional[Callable[[], dict]] = None,
     ):
         self.step = step
         self.data = data
@@ -42,6 +43,13 @@ class TrainLoop:
         # pipeline). Costs one batch of lookahead in the data stream;
         # None (the default) keeps the loop strictly sequential.
         self.prefetch = prefetch
+        # ``extra_metrics()`` is splatted into every periodic log line —
+        # the hook PS-backed loops use to surface wire/cache health
+        # (``utils.metrics.wire_record``: bytes both ways, per-leg
+        # timing, row-cache hit rate) next to loss without the loop
+        # knowing what a trainer is. Keep it cheap: it runs every
+        # ``log_every`` steps on the training thread.
+        self.extra_metrics = extra_metrics
         self.metrics = metrics or MetricsLogger(verbose=False)
         self.log_every = log_every
         self.batch_size = batch_size
@@ -116,8 +124,11 @@ class TrainLoop:
             losses.append(float(loss))
             gstep = self.step_offset + i + 1
             if self.log_every and (i + 1) % self.log_every == 0:
+                extra = (self.extra_metrics()
+                         if self.extra_metrics is not None else {})
                 self.metrics.log(step=gstep, loss=float(loss),
-                                 samples_per_sec=self.timer.samples_per_sec)
+                                 samples_per_sec=self.timer.samples_per_sec,
+                                 **extra)
             # GLOBAL-step modulo: a resumed run keeps the same checkpoint
             # cadence as an uninterrupted one (local modulo would drift by
             # start_step and can leave resumed tail steps never saved)

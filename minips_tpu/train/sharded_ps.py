@@ -77,9 +77,41 @@ independently-gated levers —
   self-describe their wire (mixed fleets decode per frame), and workers
   echo the negotiated format so the bench can assert it.
 
+The DEDUPLICATED PULL WIRE + CLOCK-VERSIONED ROW CACHE (this PR's
+tentpole — the reference ``KVClientTable``'s process-level parameter
+cache, rebuilt with the SSP rule as its validity predicate):
+
+- ``pull()`` requests ship UNIQUE keys only (``np.unique`` client-side,
+  scatter by ``return_inverse`` on reply) — a zipfian batch no longer
+  pays full row traffic per occurrence of the same hot row. The owner
+  is oblivious: it serves whatever keys arrive. ``pull_dedup=False``
+  restores the verbatim wire (the bench's A/B lever; refused when the
+  cache is on).
+- ``cache_bytes > 0`` enables the worker-side row cache: every pull
+  reply is STAMPED by its owner with ``min_excluding(requester)`` — the
+  owner's view of every OTHER worker's applied clock (its own
+  included; the requester's excluded because per-link FIFO already
+  certifies its pushes, see comm/bus.py). A later pull at clock ``c``
+  is served from cache for rows whose stamp satisfies
+  ``consistency.gate.admits(stamp, c, s)`` — the EXACT owner-side
+  admission predicate — so a hit is provably no staler than a
+  synchronous pull admitted under the same min-view. Misses (and only
+  misses) go to the wire, deduplicated. Local pushes WRITE THROUGH the
+  cached rows they touch (sgd + float32 push wire: the delta is exact
+  and additive, bitwise the server's op) or INVALIDATE them (stateful
+  updaters / quantized pushes: the client cannot reproduce the
+  server's step), so read-your-own-writes holds either way.
+  ``tick()`` ages out rows that can never be admitted again, an LRU
+  byte bound evicts beyond ``cache_bytes``, ``finalize()`` clears (the
+  post-finalize agreement guarantee is exact, not staleness-bounded),
+  and prefetches populate/consult the same cache under the same stamp
+  rule (a fully-cached prefetch never touches the wire).
+
 Per-leg timing (issue→reply latency, blocked time, overlap fraction,
-ack latency) runs through ``utils/timing.CommTimers``; wire bytes both
-directions count ACTUAL bytes on the wire (compressed when compressed).
+ack latency) runs through ``utils/timing.CommTimers`` — which now also
+carries rows-requested vs rows-over-wire and cache hit/lookup counts
+into the done lines; wire bytes both directions count ACTUAL bytes on
+the wire (compressed when compressed).
 """
 
 from __future__ import annotations
@@ -87,20 +119,182 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import OrderedDict
 from typing import Optional
 
 import numpy as np
 
 from minips_tpu.comm.bus import ClockGossip
-from minips_tpu.consistency.gate import PeerFailureError, StalenessGate
+from minips_tpu.consistency.gate import (PeerFailureError, StalenessGate,
+                                         admits)
 from minips_tpu.ops.quantized_comm import (dequantize_rows_int8,
                                            quantize_rows_int8)
 from minips_tpu.parallel.partition import RangePartitioner
 from minips_tpu.utils.timing import CommTimers
 
 __all__ = ["ShardedTable", "ShardedPSTrainer", "PeerFailureError",
-           "PullFuture", "table_state_bytes", "quantize_rows_int8",
-           "dequantize_rows_int8"]
+           "PullFuture", "RowCache", "table_state_bytes",
+           "quantize_rows_int8", "dequantize_rows_int8"]
+
+
+class RowCache:
+    """Clock-versioned LRU cache of REMOTE rows — the reference
+    KVClientTable's process-level parameter cache, with the SSP rule as
+    its validity predicate instead of a freshness heuristic.
+
+    Storage is a SLAB: one preallocated ``[cap_rows, dim]`` f32 buffer
+    plus a parallel stamp vector, with an insertion-ordered ``key →
+    slot`` map for LRU. Every float op (gather on lookup, scatter on
+    insert, the write-through add) is a single vectorized numpy call —
+    a per-key Python loop here costs more than the loopback wire it
+    saves, which is exactly the per-row-overhead failure mode the
+    motivation cites. Python-level work per op is one cheap
+    ``dict.get`` pass over the keys.
+
+    ``stamp`` is the freshness certificate the owning shard put on the
+    pull reply that delivered the row (its min-view over every other
+    worker's applied clock at serve time). ``lookup`` at clock ``c``
+    under staleness ``s`` serves exactly the rows
+    ``consistency.gate.admits`` would admit — the one predicate the
+    owner-side park uses — so a hit can never read past the staleness
+    bound a synchronous pull enforces.
+
+    The byte bound counts row payload (``4*dim`` per entry, the slab's
+    real allocation); eviction is LRU — hits and re-inserts refresh
+    recency. Thread-safe: pushes from the training thread race replies
+    consumed in ``wait()``.
+    """
+
+    def __init__(self, dim: int, cache_bytes: int):
+        self.dim = int(dim)
+        self.row_bytes = 4 * self.dim
+        self.cap = int(cache_bytes)
+        self.cap_rows = max(int(cache_bytes) // self.row_bytes, 1)
+        self._buf = np.empty((self.cap_rows, self.dim), np.float32)
+        self._stamp = np.zeros(self.cap_rows, np.int64)
+        self._slot: OrderedDict[int, int] = OrderedDict()  # key -> slot
+        self._free: list[int] = list(range(self.cap_rows - 1, -1, -1))
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.lookups = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.write_throughs = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._slot)
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return len(self._slot) * self.row_bytes
+
+    def lookup(self, keys: np.ndarray, clk: int,
+               staleness: float) -> tuple[np.ndarray, np.ndarray]:
+        """Serve what the admission rule allows: returns
+        ``(rows [n, dim], miss bool [n])`` — ``rows[i]`` is valid where
+        ``miss[i]`` is False, i.e. the cached stamp admits clock
+        ``clk`` under ``staleness``."""
+        with self._lock:
+            self.lookups += keys.size
+            get = self._slot.get
+            slots = np.fromiter((get(k, -1) for k in keys.tolist()),
+                                np.int64, count=keys.size)
+            held = slots >= 0
+            hit = held.copy()
+            if staleness != float("inf"):
+                # vectorized admits(): stamp >= clk - s, slot-wise
+                hit[held] = (self._stamp[slots[held]]
+                             >= clk - int(staleness))
+            out = np.empty((keys.size, self.dim), np.float32)
+            hs = slots[hit]
+            out[hit] = self._buf[hs]          # one gather, no row loop
+            for k in keys[hit].tolist():      # LRU refresh: dict ops only
+                self._slot.move_to_end(k)
+            self.hits += int(hit.sum())
+        return out, ~hit
+
+    def _take_slot_locked(self, key: int) -> int:
+        slot = self._slot.get(key)
+        if slot is not None:
+            self._slot.move_to_end(key)
+            return slot
+        if not self._free:  # full: evict the LRU entry, reuse its slot
+            _, slot = self._slot.popitem(last=False)
+            self.evictions += 1
+        else:
+            slot = self._free.pop()
+        self._slot[key] = slot
+        return slot
+
+    def insert(self, keys: np.ndarray, rows: np.ndarray,
+               stamp: int) -> None:
+        """Fill from a pull reply stamped ``stamp`` by its owner; evicts
+        LRU entries beyond the byte bound (slab capacity)."""
+        with self._lock:
+            slots = np.fromiter(
+                (self._take_slot_locked(k) for k in keys.tolist()),
+                np.int64, count=keys.size)
+            self._buf[slots] = rows           # one scatter
+            self._stamp[slots] = stamp
+
+    def write_through(self, keys: np.ndarray, deltas: np.ndarray) -> None:
+        """Apply ``row += delta`` to cached rows (missing keys are
+        no-ops). Only sound when the delta is exactly what the server
+        applies (sgd over a float32 push wire): additivity keeps the
+        entry equal to 'stamped state + my subsequent updates', a legal
+        read wherever the stamp is."""
+        with self._lock:
+            get = self._slot.get
+            slots = np.fromiter((get(k, -1) for k in keys.tolist()),
+                                np.int64, count=keys.size)
+            held = slots >= 0
+            # keys are unique (push dedup upstream): plain indexed add
+            self._buf[slots[held]] += deltas[held]
+            self.write_throughs += int(held.sum())
+
+    def invalidate(self, keys: np.ndarray) -> None:
+        """Drop cached rows a push touched — read-your-own-writes when
+        the client cannot reproduce the server's update."""
+        with self._lock:
+            for k in keys.tolist():
+                slot = self._slot.pop(k, None)
+                if slot is not None:
+                    self._free.append(slot)
+                    self.invalidations += 1
+
+    def age(self, clk: int, staleness: float) -> None:
+        """Drop rows that can never be admitted again — clocks only
+        advance, so ``not admits(stamp, clk, s)`` is terminal. Called
+        from ``tick()``; keeps BSP's cache near-empty instead of
+        carrying a table of dead stamps to the LRU bound."""
+        if staleness == float("inf"):
+            return
+        with self._lock:
+            dead = [k for k, s in self._slot.items()
+                    if self._stamp[s] < clk - int(staleness)]
+            for k in dead:
+                self._free.append(self._slot.pop(k))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._slot.clear()
+            self._free = list(range(self.cap_rows - 1, -1, -1))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "lookups": self.lookups,
+                "hit_rate": (round(self.hits / self.lookups, 4)
+                             if self.lookups else None),
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "write_throughs": self.write_throughs,
+                "rows": len(self._slot),
+                "bytes": len(self._slot) * self.row_bytes,
+            }
 
 
 def table_state_bytes(num_rows: int, dim: int, updater: str) -> int:
@@ -118,22 +312,30 @@ def table_state_bytes(num_rows: int, dim: int, updater: str) -> int:
 
 class PullFuture:
     """Handle for an in-flight (possibly prefetched) pull: the requests
-    are already on the wire; ``wait()`` blocks only for whatever has not
-    yet arrived, reads the LOCAL shard slice after re-checking admission
-    for the stamped clock, and assembles the row matrix. Single-consumer:
-    ``wait()`` may be called once."""
+    are already on the wire (unique MISS keys only — dupes scatter by
+    inverse, cache hits were filled at issue time); ``wait()`` blocks
+    only for whatever has not yet arrived, reads the LOCAL shard slice
+    after re-checking admission for the stamped clock, assembles the
+    unique-row matrix, inserts fetched rows into the row cache with
+    their owner stamps, and scatters back to request order.
+    Single-consumer: ``wait()`` may be called once."""
 
     def __init__(self, table: "ShardedTable", req: int, keys: np.ndarray,
-                 remote: list, local_mask, clk: int):
+                 uniq: np.ndarray, inv: Optional[np.ndarray],
+                 out_u: np.ndarray, remote: list, local_idx, clk: int):
         self._table = table
         self._req = req
         self._keys = keys
-        self._remote = remote          # [(owner, mask)] cross-process legs
-        self._local_mask = local_mask  # bool mask of keys my shard owns
+        self._uniq = uniq              # unique keys (== keys if no dedup)
+        self._inv = inv                # scatter map uniq -> keys order
+        self._out_u = out_u            # [uniq.size, dim]; hits pre-filled
+        self._remote = remote          # [(owner, idx-into-uniq)] wire legs
+        self._local_idx = local_idx    # idx-into-uniq my shard owns
         self.clk = clk
         self._t_issue = time.monotonic()
         self._done = False
         self._pf_key: Optional[bytes] = None  # prefetch-registry slot
+        self._issue_epoch = 0  # cache push-log position at issue time
 
     def _deregister(self) -> None:
         if self._pf_key is None:
@@ -150,34 +352,53 @@ class PullFuture:
         self._deregister()
         t = self._table
         t_block0 = time.monotonic()
-        out = np.empty((self._keys.size, t.dim), np.float32)
-        if self._remote:
-            got = t._await_replies(self._req, {o for o, _ in self._remote},
-                                   timeout=timeout)
-            for o, mask in self._remote:
-                out[mask] = got[o]
-        else:
-            with t._reply_cond:
-                t._replies.pop(self._req, None)
+        out_u = self._out_u
+        try:
+            if self._remote:
+                got = t._await_replies(self._req,
+                                       {o for o, _ in self._remote},
+                                       timeout=timeout)
+                for o, idx in self._remote:
+                    rows, stamp = got[o]
+                    out_u[idx] = rows
+                    if t._cache is not None:
+                        # the prefetch path populates the same cache
+                        # under the same stamp rule — this is the one
+                        # fill point; keys pushed since issue are
+                        # DROPPED from the insert (the reply may sit on
+                        # either side of the push — read-your-own-
+                        # writes over the in-flight window, see
+                        # _cache_insert_guarded)
+                        t._cache_insert_guarded(self, self._uniq[idx],
+                                                rows, stamp)
+            else:
+                with t._reply_cond:
+                    t._replies.pop(self._req, None)
+        finally:
+            # even on timeout/peer-failure: a leaked registration would
+            # pin the push-journal floor forever and churn the cache
+            # through the overflow valve on every later push
+            if t._cache is not None:
+                t._cache_close_issue(self)
         with t._reply_cond:
             t_arrived = t._reply_t.pop(self._req, t_block0)
-        if self._local_mask is not None:
+        if self._local_idx is not None:
             # the local slice obeys the SAME admission rule the remote
             # owners applied: read only once my view admits the stamped
             # clock (matters for prefetches stamped clock_ahead > 0 —
             # a synchronous pull passes instantly, its own gate already
             # waited for this)
             t._wait_local_admission(self.clk, timeout)
-            offs = self._keys[self._local_mask] - t.shard_lo
+            offs = self._uniq[self._local_idx] - t.shard_lo
             with t._state_lock:
-                out[self._local_mask] = t._w[offs]
+                out_u[self._local_idx] = t._w[offs]
         now = time.monotonic()
         # latency is issue -> reply PROCESSED (t_arrived), not wait() —
         # a fully-prefetched pull whose reply landed mid-compute must
         # report the real RTT, not the compute window it hid under
         t.timers.record_pull(latency_s=t_arrived - self._t_issue,
                              blocked_s=now - t_block0)
-        return out
+        return out_u[self._inv] if self._inv is not None else out_u
 
     def cancel(self) -> None:
         """Abandon an un-waited prefetch (e.g. past the last batch):
@@ -186,6 +407,8 @@ class PullFuture:
             return
         self._done = True
         self._deregister()
+        if self._table._cache is not None:
+            self._table._cache_close_issue(self)
         with self._table._reply_cond:
             self._table._replies.pop(self._req, None)
             self._table._reply_t.pop(self._req, None)
@@ -226,6 +449,9 @@ class ShardedTable:
         pull_wire: str = "f32",
         async_push: bool = False,
         push_window: int = 32,
+        cache_bytes: int = 0,
+        pull_dedup: bool = True,
+        push_dedup: bool = True,
     ):
         if updater not in ("sgd", "adagrad", "adam"):
             raise ValueError(
@@ -238,6 +464,12 @@ class ShardedTable:
             raise ValueError("pull_wire must be 'f32' or 'int8'")
         if push_window < 1:
             raise ValueError("push_window must be >= 1")
+        if cache_bytes < 0:
+            raise ValueError("cache_bytes must be >= 0 (0 = cache off)")
+        if cache_bytes and not pull_dedup:
+            # a cache keyed on unique rows over a duplicate wire would
+            # double-count hits and mis-stamp scattered fills
+            raise ValueError("cache_bytes > 0 requires pull_dedup=True")
         self.name = name
         self.num_rows = int(num_rows)
         self.dim = int(dim)
@@ -258,6 +490,43 @@ class ShardedTable:
         self.pull_wire = pull_wire
         self.async_push = bool(async_push)
         self.push_window = int(push_window)
+        self.pull_dedup = bool(pull_dedup)
+        self.push_dedup = bool(push_dedup)
+        self.cache_bytes = int(cache_bytes)
+        # the clock-versioned client row cache (module docstring): holds
+        # REMOTE rows only — my own shard is always read directly
+        self._cache = RowCache(dim, cache_bytes) if cache_bytes else None
+        # read-your-own-writes for IN-FLIGHT pulls: a reply served
+        # before my push reached the owner would be inserted into the
+        # cache AFTER push() ran its write-through/invalidation — a
+        # no-op for the not-yet-cached row — storing a pre-own-push row
+        # every later hit would silently serve. And the converse is
+        # just as possible: a PARKED pull is served after the push
+        # applied, so the reply already contains the delta — the client
+        # cannot tell which side of the push the serve landed on. So
+        # pushes are journaled in a LOG while pulls are outstanding,
+        # and an insert DROPS the keys any entry newer than its pull's
+        # issue point touched: ambiguous rows are simply not cached
+        # (the future's RESULT is untouched; the next pull of such a
+        # key round-trips once). Single-writer in practice (all ops on
+        # the training thread); the lock is belt-and-braces.
+        self._cache_epoch = 0           # cache-maintenance ops so far
+        self._cache_log: list[tuple] = []     # (epoch, sorted keys)
+        self._cache_open: dict[int, int] = {}  # id(fut) -> issue epoch
+        self._cache_broken_floor = -1   # valve: pre-floor issues skip
+        self._cache_log_lock = threading.Lock()
+        if self._cache is not None and self.async_push:
+            # an async push frame can reach the owner AFTER a
+            # later-issued pull was served, with no client-side event
+            # marking the window — read-your-own-writes would need the
+            # ack plumbing to certify arrival. Refuse loudly; the
+            # cache composes with the prefetch leg (--overlap-legs
+            # pull), which is the overlap lever that pays anyway.
+            raise ValueError(
+                "cache_bytes > 0 is not supported with async_push "
+                "(use overlap_legs='pull'): an unacked push frame can "
+                "trail a later pull, and a cached reply could then "
+                "silently miss this worker's own update")
         self.timers = CommTimers()
         # quantization noise stream: per-(seed, rank) so reruns are
         # deterministic and ranks draw independent rounding noise
@@ -568,7 +837,19 @@ class ShardedTable:
             if self._cons.admit_pull(clk):
                 self.serve_parked()
             return
-        self._serve_pull(sender, req, keys)
+        self._serve_pull(sender, req, keys, clk)
+
+    def _serve_stamp(self, sender: int, clk: int) -> int:
+        """The freshness certificate stamped on every pull reply: my view
+        of every OTHER worker's applied clock (gossip min excluding the
+        requester — its own pushes are certified by per-link FIFO, see
+        ClockGossip.min_excluding). The requester's row cache admits the
+        delivered rows at a later clock ``c`` iff ``admits(stamp, c, s)``
+        — exactly the admission this serve just passed, re-evaluated at
+        read time. Falls back to the request clock when no trainer is
+        bound (raw-table tests): admission was vacuous there too."""
+        sc = getattr(self._cons, "serving_clock", None)
+        return int(sc(sender)) if callable(sc) else int(clk)
 
     def _reply_head_blob(self, req: int, rows: np.ndarray) -> tuple:
         """Encode a pull reply on MY configured pull wire. Frames
@@ -582,11 +863,16 @@ class ShardedTable:
         return {"req": req, "wire": "f32"}, np.ascontiguousarray(
             rows, np.float32).tobytes()
 
-    def _serve_pull(self, sender: int, req: int, keys: np.ndarray) -> None:
+    def _serve_pull(self, sender: int, req: int, keys: np.ndarray,
+                    clk: int = 0) -> None:
+        # stamp BEFORE reading state: the certificate must be a lower
+        # bound on what the rows contain, and clocks only advance
+        stamp = self._serve_stamp(sender, clk)
         offs = keys - self.shard_lo
         with self._state_lock:
             rows = self._w[offs]  # fancy indexing: already a fresh array
         head, blob = self._reply_head_blob(req, rows)
+        head["stamp"] = stamp
         acks = self._drain_acks_for(sender)
         if acks:
             head["acks"] = acks  # piggyback: the free ack ride home
@@ -603,13 +889,16 @@ class ShardedTable:
             if self._cons.admit_pull(clk):  # same park/drain race as above
                 self.serve_parked()
             return
-        self._serve_pull_all(sender, req)
+        self._serve_pull_all(sender, req, clk)
 
-    def _serve_pull_all(self, sender: int, req: int) -> None:
+    def _serve_pull_all(self, sender: int, req: int,
+                        clk: int = 0) -> None:
+        stamp = self._serve_stamp(sender, clk)
         with self._state_lock:
             rows = self._w.copy()  # full shard: copy out of the lock
         head, blob = self._reply_head_blob(req, rows)
         head["lo"] = self.shard_lo
+        head["stamp"] = stamp
         acks = self._drain_acks_for(sender)
         if acks:
             head["acks"] = acks
@@ -638,11 +927,11 @@ class ShardedTable:
             for p in self._parked:
                 (ready if self._cons.admit_pull(p[3]) else still).append(p)
             self._parked = still
-        for sender, req, keys, _clk in ready:
+        for sender, req, keys, clk in ready:
             if keys is None:
-                self._serve_pull_all(sender, req)
+                self._serve_pull_all(sender, req, clk)
             else:
-                self._serve_pull(sender, req, keys)
+                self._serve_pull(sender, req, keys, clk)
 
     def _on_pull_reply(self, sender: int, payload: dict) -> None:
         acks = payload.get("acks")
@@ -677,7 +966,8 @@ class ShardedTable:
                 # for live requests: a late reply to a cancelled
                 # prefetch must not inflate the counter.
                 self.bytes_pulled += len(blob)
-                self._replies[req][sender] = rows
+                self._replies[req][sender] = (
+                    rows, int(payload.get("stamp", 0)))
                 self._reply_t[req] = time.monotonic()
                 self._reply_cond.notify_all()
 
@@ -699,6 +989,99 @@ class ShardedTable:
 
     def _my_clk(self) -> int:
         return self._cons.clock if self._cons is not None else 0
+
+    def _cache_staleness(self) -> float:
+        """The staleness bound the cache's validity predicate runs under
+        — the trainer's; 0 (BSP, the strictest) when none is bound."""
+        return getattr(self._cons, "staleness", 0) \
+            if self._cons is not None else 0
+
+    def cache_age(self) -> None:
+        """Drop cache rows that can never be admitted again (tick)."""
+        if self._cache is not None:
+            self._cache.age(self._my_clk(), self._cache_staleness())
+
+    def cache_clear(self) -> None:
+        """Drop the whole cache (finalize: post-finalize agreement is
+        exact, not staleness-bounded — a cached row must not outlive
+        the quiesce)."""
+        if self._cache is not None:
+            self._cache.clear()
+
+    def cache_stats(self) -> Optional[dict]:
+        return self._cache.stats() if self._cache is not None else None
+
+    def _cache_on_push(self, keys: np.ndarray, deltas: np.ndarray,
+                       sorted_keys: np.ndarray) -> None:
+        """Keep read-your-own-writes across the cache, ON THE PUSHING
+        THREAD (before an async enqueue — a pull issued right after
+        push() must already see the maintenance). ``keys``/``deltas``
+        are the aligned unique pairs ``push()`` computed (summed when
+        the batch had duplicates, the original pairing when it did
+        not); ``sorted_keys`` is the same key set sorted, for the
+        journal. sgd over a float32 DEDUPED push wire write-throughs
+        the exact additive delta the server will apply (the SAME
+        summed rows ride the wire, so cache and server move in bitwise
+        lock-step); stateful updaters, quantized pushes, and the
+        per-occurrence wire (``push_dedup=False`` — the server re-sums
+        in f32 there, last-ulp different from our f64 bincount)
+        invalidate instead — the client cannot reproduce the server's
+        step bit-for-bit. Every op is journaled in the push log so
+        in-flight pulls' inserts can drop the keys it touched (see
+        __init__)."""
+        if self.updater == "sgd" and self.push_comm == "float32" \
+                and self.push_dedup:
+            self._cache.write_through(keys, -self.lr * deltas)
+        else:
+            self._cache.invalidate(keys)
+        with self._cache_log_lock:
+            if self._cache_open:  # journal only while pulls in flight
+                self._cache_log.append((self._cache_epoch, sorted_keys))
+                if len(self._cache_log) > 1024:
+                    # leaked futures (never waited/cancelled) would pin
+                    # the log forever; drop it and poison pre-floor
+                    # inserts instead (they skip — safe, just cold)
+                    self._cache_log.clear()
+                    self._cache_broken_floor = self._cache_epoch
+            self._cache_epoch += 1
+
+    def _cache_note_issue(self, fut: "PullFuture") -> None:
+        with self._cache_log_lock:
+            fut._issue_epoch = self._cache_epoch
+            self._cache_open[id(fut)] = self._cache_epoch
+
+    def _cache_close_issue(self, fut: "PullFuture") -> None:
+        with self._cache_log_lock:
+            self._cache_open.pop(id(fut), None)
+            floor = min(self._cache_open.values(),
+                        default=self._cache_epoch)
+            self._cache_log = [e for e in self._cache_log
+                               if e[0] >= floor]
+
+    def _cache_insert_guarded(self, fut: "PullFuture", keys: np.ndarray,
+                              rows: np.ndarray, stamp: int) -> None:
+        """Insert freshly-fetched rows, DROPPING any key a push touched
+        between the pull's issue and now: the reply may predate the
+        push at the owner (immediate serve) or already include it
+        (parked serve after the push applied) — the client cannot tell
+        which, so the ambiguous row is not cached at all. The future's
+        RESULT is untouched (a pull returns whatever the owner served);
+        only the cache refuses rows it cannot certify."""
+        with self._cache_log_lock:
+            if fut._issue_epoch <= self._cache_broken_floor:
+                return  # log overflowed past this pull: no safe insert
+            entries = [e for e in self._cache_log
+                       if e[0] >= fut._issue_epoch]
+        if entries:
+            keep = np.ones(keys.size, bool)
+            for _, ek in entries:  # ek sorted unique (np.unique)
+                pos = np.clip(np.searchsorted(ek, keys), 0, ek.size - 1)
+                keep &= ek[pos] != keys
+            if not keep.any():
+                return
+            if not keep.all():
+                keys, rows = keys[keep], rows[keep]
+        self._cache.insert(keys, rows, stamp)
 
     def _next_req(self) -> int:
         with self._req_lock:
@@ -757,32 +1140,65 @@ class ShardedTable:
                     f"{clk} never opened")
 
     def _issue_pull(self, keys: np.ndarray, clk: int) -> PullFuture:
-        """Send the per-owner key slices for ``keys`` stamped ``clk`` and
-        return the future; the local slice is read at ``wait()`` time."""
+        """Send the per-owner UNIQUE-key slices for ``keys`` stamped
+        ``clk`` and return the future. Duplicates never ride the wire
+        (scatter by ``return_inverse`` at ``wait()``), rows the cache
+        can serve under ``admits(stamp, clk, s)`` never ride it either
+        — only true misses do. The local slice is read at ``wait()``
+        time."""
         keys = np.asarray(keys, np.int64).reshape(-1)
-        owners = self.part.shard_of(keys)
-        req = self._next_req()
+        if self.pull_dedup:
+            uniq, inv = np.unique(keys, return_inverse=True)
+        else:  # the verbatim seed wire (bench A/B arm; cache refused)
+            uniq, inv = keys, None
+        owners = self.part.shard_of(uniq)
+        out_u = np.empty((uniq.size, self.dim), np.float32)
+        need = np.ones(uniq.size, bool)  # rows still to fetch over wire
+        local_idx = None
+        lmask = owners == self.rank
+        if lmask.any():
+            local_idx = np.nonzero(lmask)[0]
+            need[lmask] = False
+        hits = lookups = 0
+        if self._cache is not None and need.any():
+            ridx = np.nonzero(need)[0]
+            rows, miss = self._cache.lookup(uniq[ridx], clk,
+                                            self._cache_staleness())
+            lookups = ridx.size
+            hit_idx = ridx[~miss]
+            hits = hit_idx.size
+            if hits:
+                out_u[hit_idx] = rows[~miss]
+                need[hit_idx] = False
         remote: list[tuple[int, np.ndarray]] = []
-        local_mask = None
-        with self._reply_cond:
-            self._replies[req] = {}
+        wire_rows = 0
         for o in range(self.num_processes):
-            mask = owners == o
-            if not mask.any():
-                continue
-            if o == self.rank:
-                local_mask = mask
-                continue
-            kslice = keys[mask]
-            self.bus.send(o, f"psG:{self.name}",
-                          {"req": req, "clk": clk, **self._cfg_header()},
-                          blob=kslice.tobytes())
-            # under the reply lock: replies land on the receive thread
-            # and bump the same counter (non-atomic read-modify-write)
+            mask = need & (owners == o)
+            if mask.any():
+                remote.append((o, np.nonzero(mask)[0]))
+        req = 0  # a fully-local pull (own shard + cache hits) allocates
+        if remote:  # no request slot and touches no wire state at all
+            req = self._next_req()
             with self._reply_cond:
-                self.bytes_pulled += kslice.nbytes
-            remote.append((o, mask))
-        return PullFuture(self, req, keys, remote, local_mask, clk)
+                self._replies[req] = {}
+            for o, idx in remote:
+                kslice = uniq[idx]
+                self.bus.send(o, f"psG:{self.name}",
+                              {"req": req, "clk": clk,
+                               **self._cfg_header()},
+                              blob=kslice.tobytes())
+                # under the reply lock: replies land on the receive
+                # thread and bump the same counter (non-atomic RMW)
+                with self._reply_cond:
+                    self.bytes_pulled += kslice.nbytes
+                wire_rows += idx.size
+        self.timers.record_pull_rows(requested=keys.size, wire=wire_rows,
+                                     hits=hits, lookups=lookups)
+        fut = PullFuture(self, req, keys, uniq, inv, out_u, remote,
+                         local_idx, clk)
+        if self._cache is not None and remote:
+            self._cache_note_issue(fut)  # push-log replay anchor
+        return fut
 
     def pull(self, keys: np.ndarray) -> np.ndarray:
         """Gather rows for global ``keys`` from their owners —
@@ -845,9 +1261,12 @@ class ShardedTable:
             out[self.shard_lo:self.shard_lo + self.part.shard_size] = self._w
         if peers:
             # wire bytes are counted at reply receipt (_on_pull_reply),
-            # actual bytes — an int8 wire's replies count compressed
+            # actual bytes — an int8 wire's replies count compressed.
+            # Shards deliberately bypass the row cache: a full-table
+            # assembly would evict the working set for rows finalize/
+            # eval reads once.
             got = self._await_replies(req, peers)
-            for o, rows in got.items():
+            for o, (rows, _stamp) in got.items():
                 lo = o * self.part.shard_size
                 out[lo:lo + rows.shape[0]] = rows
         with self._reply_cond:
@@ -917,7 +1336,7 @@ class ShardedTable:
             kind, a = self._push_q.get()
             try:
                 if kind == "sparse":
-                    self._push_now(a[0], a[1])
+                    self._push_now(a[0], a[1], a[2])
                 else:
                     self._push_dense_now(a)
             except Exception as e:  # noqa: BLE001 - surfaced via fatal
@@ -1015,12 +1434,61 @@ class ShardedTable:
         inside the ack window."""
         keys = np.asarray(keys, np.int64).reshape(-1)
         grads = np.asarray(grads, np.float32).reshape(keys.size, self.dim)
+        n_orig = keys.size
         if self.async_push:
-            self._enqueue_push("sparse", (keys.copy(), grads.copy()))
+            # cache is None here (the constructor refuses the combo),
+            # so no maintenance needs this thread — the coalesce rides
+            # the sender thread with the rest of the wire work, keeping
+            # the step window clean (the point of async push)
+            self._enqueue_push("sparse",
+                               (keys.copy(), grads.copy(), n_orig))
             return
-        self._push_now(keys, grads)
+        keys, grads = self._coalesce_for_wire(keys, grads)
+        self._push_now(keys, grads, n_orig, coalesced=True)
 
-    def _push_now(self, keys: np.ndarray, grads: np.ndarray) -> None:
+    def _coalesce_for_wire(self, keys: np.ndarray,
+                           grads: np.ndarray) -> tuple:
+        """Client-side dedup + cache maintenance: duplicate keys
+        coalesce to ONE summed row BEFORE the codec, so int8
+        quantization error is paid once per row, not once per
+        occurrence — and the wire ships each row once. The summed row
+        IS what the server applies (deduped frames have nothing left to
+        sum), so cache write-through and server state stay bitwise in
+        lock-step; vs the seed's unsummed wire the result agrees to f32
+        rounding (the per-dim bincount accumulates in f64 — at least as
+        accurate as the server's old sequential f32 sum, and ~3x faster
+        than np.add.at on this hot path). ``push_dedup=False`` restores
+        the per-occurrence seed wire (bench A/B baseline; the server
+        still sums). Returns the (keys, grads) to ship."""
+        n = keys.size
+        if not n or not (self.push_dedup or self._cache is not None):
+            return keys, grads
+        uniq, inv = np.unique(keys, return_inverse=True)
+        if uniq.size != n:
+            summed = np.empty((uniq.size, self.dim), np.float32)
+            for d in range(self.dim):
+                summed[:, d] = np.bincount(inv, weights=grads[:, d],
+                                           minlength=uniq.size)
+            ckeys, cdeltas = uniq, summed
+            if self.push_dedup:
+                keys, grads = uniq, summed
+        else:
+            # no duplicates: NOTHING to coalesce — keep the original
+            # (keys[i], grads[i]) pairing. uniq is SORTED; pairing it
+            # with grads in request order would scramble every
+            # gradient-row association (regression-tested:
+            # test_push_all_unique_unsorted_keys_pair_correctly)
+            ckeys, cdeltas = keys, grads
+        if self._cache is not None:
+            self._cache_on_push(ckeys, cdeltas, uniq)
+        return keys, grads
+
+    def _push_now(self, keys: np.ndarray, grads: np.ndarray,
+                  n_rows: Optional[int] = None,
+                  coalesced: bool = False) -> None:
+        self.rows_pushed += keys.size if n_rows is None else n_rows
+        if not coalesced:  # async path: dedup on the sender thread
+            keys, grads = self._coalesce_for_wire(keys, grads)
         owners = self.part.shard_of(keys)
         for o in range(self.num_processes):
             mask = owners == o
@@ -1042,7 +1510,6 @@ class ShardedTable:
                 head["seq"] = self._take_push_seq(o)
             self.bus.send(o, f"psP:{self.name}", head, blob=kb + gb)
             self.bytes_pushed += len(kb) + len(gb)
-        self.rows_pushed += keys.size
 
     def push_dense(self, grad: np.ndarray) -> None:
         """Whole-vector gradient push, split into per-owner contiguous
@@ -1052,6 +1519,18 @@ class ShardedTable:
         if grad.shape[0] != self.num_rows:
             raise ValueError(
                 f"push_dense expects [{self.num_rows}, {self.dim}]")
+        if self._cache is not None:
+            # a dense push touches every row: conservatively drop the
+            # cache (dense workloads read via pull_all, which bypasses
+            # it anyway) rather than write through a whole table — and
+            # poison IN-FLIGHT pulls' inserts too (broken floor): their
+            # replies may sit on either side of this push, and clearing
+            # alone would let them re-cache pre-push rows
+            self._cache.clear()
+            with self._cache_log_lock:
+                self._cache_broken_floor = self._cache_epoch
+                self._cache_epoch += 1
+                self._cache_log.clear()
         if self.async_push:
             self._enqueue_push("dense", grad.copy())
             return
@@ -1165,10 +1644,18 @@ class ShardedPSTrainer:
 
     def admit_pull(self, clk: int) -> bool:
         """Reference ``model->Get`` admission: serve a pull stamped with
-        requester clock ``clk`` iff global_min >= clk - staleness."""
-        if self.staleness == float("inf"):
-            return True
-        return self.gossip.global_min() >= clk - int(self.staleness)
+        requester clock ``clk`` iff global_min >= clk - staleness — the
+        shared ``consistency.gate.admits`` predicate, which the client
+        row cache also runs as its validity rule."""
+        return admits(self.gossip.global_min(), clk, self.staleness)
+
+    def serving_clock(self, requester: int) -> int:
+        """The freshness certificate a table stamps on pull replies to
+        ``requester``: my view of every OTHER worker's applied clock
+        (``ClockGossip.min_excluding`` — per-link FIFO certifies the
+        requester's own pushes separately, and the client keeps
+        read-your-own-writes via push write-through/invalidation)."""
+        return int(self.gossip.min_excluding(requester))
 
     def wait_admit_pull(self, clk: int,
                         timeout: Optional[float] = None) -> bool:
@@ -1227,6 +1714,8 @@ class ShardedPSTrainer:
         self.clock += 1
         self.gossip.publish_local([self.clock])
         self.gate.wait(self.clock)
+        for t in self.tables.values():
+            t.cache_age()  # rows un-admittable at the new clock die here
 
     def retire(self) -> None:
         """Out of data: the shared sentinel clock (gate.py RETIRED_CLOCK)
@@ -1245,6 +1734,7 @@ class ShardedPSTrainer:
         for t in self.tables.values():
             t.flush_pushes()  # async tail: drained before the flush frame
             t.check_fatal()
+            t.cache_clear()   # post-finalize reads are exact, not bounded
         self.bus.publish("psFlush", {"clock": self.clock})
         from minips_tpu.consistency.gate import publish_clock
 
@@ -1338,10 +1828,25 @@ class ShardedPSTrainer:
 
     def comm_timing(self) -> dict:
         """Aggregate per-leg wire timing over all tables: pull issue→
-        reply latency, blocked time, overlap fraction, push ack latency
+        reply latency, blocked time, overlap fraction, push ack latency,
+        plus rows-requested/rows-wire and cache hit counters
         (utils/timing.CommTimers.summary fields)."""
         return CommTimers.aggregate(
             [t.timers for t in self.tables.values()])
+
+    def cache_stats(self) -> Optional[dict]:
+        """Merged row-cache counters over all tables (None when every
+        table runs cache-off) — the done-line 'cache' field."""
+        per = [s for s in (t.cache_stats() for t in self.tables.values())
+               if s is not None]
+        if not per:
+            return None
+        out = {k: sum(s[k] for s in per)
+               for k in ("hits", "lookups", "evictions", "invalidations",
+                         "write_throughs", "rows", "bytes")}
+        out["hit_rate"] = (round(out["hits"] / out["lookups"], 4)
+                           if out["lookups"] else None)
+        return out
 
     @property
     def bytes_pushed(self) -> int:

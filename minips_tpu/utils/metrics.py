@@ -57,4 +57,7 @@ def wire_record(trainer) -> dict:
         "frames_dropped": trainer.frames_dropped,
         "wire_frames_lost": trainer.wire_frames_lost,
         "timing": trainer.comm_timing(),
+        # row-cache counters (train/sharded_ps.RowCache): None when every
+        # table runs cache-off, so scrapers can tell "off" from "cold"
+        "cache": trainer.cache_stats(),
     }

@@ -33,12 +33,30 @@ class CommTimers:
         self.pull_blocked_s = 0.0   # caller actually waiting in wait()
         self.push_acks = 0
         self.push_ack_latency_s = 0.0  # frame send → ack received
+        # pull-leg ROW flow (the dedup + row-cache observables): how many
+        # rows callers asked for vs how many actually crossed the wire —
+        # the gap is dupes collapsed, own-shard rows, and cache hits
+        self.pull_rows_requested = 0
+        self.pull_rows_wire = 0
+        self.cache_hits = 0
+        self.cache_lookups = 0
 
     def record_pull(self, latency_s: float, blocked_s: float) -> None:
         with self._lock:
             self.pulls += 1
             self.pull_latency_s += max(latency_s, 0.0)
             self.pull_blocked_s += max(blocked_s, 0.0)
+
+    def record_pull_rows(self, requested: int, wire: int,
+                         hits: int = 0, lookups: int = 0) -> None:
+        """Per-issue row accounting: ``requested`` keys asked for,
+        ``wire`` unique miss rows actually sent to owners, and the row
+        cache's hit/lookup counts for this issue (0/0 when cache-off)."""
+        with self._lock:
+            self.pull_rows_requested += int(requested)
+            self.pull_rows_wire += int(wire)
+            self.cache_hits += int(hits)
+            self.cache_lookups += int(lookups)
 
     def record_push_ack(self, latency_s: float) -> None:
         with self._lock:
@@ -70,6 +88,17 @@ class CommTimers:
                 "push_ack_ms_mean": round(
                     1e3 * self.push_ack_latency_s / self.push_acks, 4)
                 if self.push_acks else None,
+                # rows-local vs rows-wire: requested − wire = dupes +
+                # own-shard rows + cache hits served without a frame
+                "pull_rows_requested": self.pull_rows_requested,
+                "pull_rows_wire": self.pull_rows_wire,
+                "pull_rows_local": (self.pull_rows_requested
+                                    - self.pull_rows_wire),
+                "cache_hits": self.cache_hits,
+                "cache_lookups": self.cache_lookups,
+                "cache_hit_rate": round(
+                    self.cache_hits / self.cache_lookups, 4)
+                if self.cache_lookups else None,
             }
         frac = self.pull_overlap_fraction
         out["pull_overlap_fraction"] = (round(frac, 4)
@@ -87,6 +116,10 @@ class CommTimers:
                 agg.pull_blocked_s += t.pull_blocked_s
                 agg.push_acks += t.push_acks
                 agg.push_ack_latency_s += t.push_ack_latency_s
+                agg.pull_rows_requested += t.pull_rows_requested
+                agg.pull_rows_wire += t.pull_rows_wire
+                agg.cache_hits += t.cache_hits
+                agg.cache_lookups += t.cache_lookups
         return agg.summary()
 
 

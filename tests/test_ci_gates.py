@@ -13,7 +13,8 @@ import pytest
 REPO = pathlib.Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO / "ci"))
 
-from bench_regression import compare, main, throughput_points  # noqa: E402
+from bench_regression import (cache_tripwires, compare, main,  # noqa: E402
+                              throughput_points)
 
 
 def _art(points):
@@ -58,6 +59,45 @@ def test_wire_bytes_are_not_gated():
     prior, new = _art({"a": 100.0}), _art({"a": 100.0})
     new["sweep"]["a"]["wire_bytes_per_row_moved"] = 999.0
     assert compare(prior, new, 0.10) == []
+
+
+def _cache_art(hit_rates: dict) -> dict:
+    """Artifact with a cache_comparison_3proc zipf grid:
+    {s-name: on-arm hit rate}."""
+    return {"cache_comparison_3proc": {"zipf": {
+        s: {"on": {"rows_per_sec_per_process": 1.0,
+                   "cache_hit_rate": hr},
+            "off": {"rows_per_sec_per_process": 1.0}}
+        for s, hr in hit_rates.items()}}}
+
+
+def test_cache_tripwire_fails_on_zero_zipf_hit_rate_with_slack():
+    """The 'cache silently disabled' tripwire: zipf + s >= 1 + cache on
+    must show hit-rate > 0 — zero (or missing) means the lever fell off
+    even if rows/sec still looks plausible."""
+    problems = cache_tripwires(_cache_art({"s1": 0.0, "s2": 0.31}))
+    assert len(problems) == 1 and "zipf/s1" in problems[0]
+    assert cache_tripwires(_cache_art({"s1": None, "s2": 0.31}))
+    assert cache_tripwires(_cache_art({"s2": {}}))  # field absent
+
+
+def test_cache_tripwire_exempts_bsp_and_healthy_arms():
+    # s=0 (BSP) CANNOT hit across clocks — zero is the correct reading
+    assert cache_tripwires(_cache_art({"s0": 0.0, "s1": 0.2,
+                                       "s2": 0.4})) == []
+    # an artifact without the sweep (other benches) is not this gate's
+    # business; a DROPPED sweep is the generic MISSING check's
+    assert cache_tripwires({"metric": "m"}) == []
+
+
+def test_cache_sweep_points_count_toward_missing_detection():
+    """Every cache_comparison arm carries rows_per_sec_per_process, so
+    the generic dropped-point gate covers the sweep with no extra
+    wiring — dropping the zipf/s2 'on' arm fails."""
+    prior = _cache_art({"s1": 0.2, "s2": 0.4})
+    new = _cache_art({"s1": 0.2})
+    problems = compare(prior, new, 0.10)
+    assert any("MISSING" in p and "s2" in p for p in problems)
 
 
 def test_main_end_to_end_exit_codes(tmp_path):

@@ -155,7 +155,7 @@ def test_owner_side_park_serves_each_admitted_pull_exactly_once(
 
     t = ShardedTable("t", 8, 1, None, 0, 1, updater="sgd")
     served = []
-    t._serve_pull = lambda sender, req, keys: served.append(req)
+    t._serve_pull = lambda sender, req, keys, clk=0: served.append(req)
 
     class Cons:
         gmin = 0
@@ -185,3 +185,81 @@ def test_owner_side_park_serves_each_admitted_pull_exactly_once(
     parked_reqs = sorted(p[1] for p in t._parked)
     assert parked_reqs == sorted(r for r, c in issued
                                  if cons.gmin < c - staleness)
+
+
+# --------------------------------------------------- client row cache
+# a cache script interleaves inserts (stamped at/below the current
+# clock, like real replies), lookups, pushes (invalidate), and ticks
+cache_ops = st.lists(
+    st.tuples(st.sampled_from(["insert", "lookup", "tick", "invalidate"]),
+              st.integers(0, 7),      # key (small domain: collisions)
+              st.integers(0, 4)),     # insert: stamp lag below clk
+    min_size=1, max_size=120)
+
+
+@given(ops=cache_ops, staleness=st.integers(0, 3))
+@settings(max_examples=200, deadline=None)
+def test_cache_served_row_never_older_than_clk_minus_staleness(
+        ops, staleness):
+    """The tentpole's safety property (train/sharded_ps.RowCache): for
+    ANY interleaving of reply-inserts, pulls, pushes, and clock ticks
+    under SSP(s), a cache-SERVED row carries a stamp >= clk − s — the
+    exact owner-side admission bound — and the LRU byte bound is never
+    exceeded. Row payloads encode their own stamp so the assertion
+    checks delivered DATA, not bookkeeping."""
+    from minips_tpu.consistency.gate import admits
+    from minips_tpu.train.sharded_ps import RowCache
+
+    cap = 5 * 8  # room for five dim-2 rows: eviction pressure is real
+    cache = RowCache(dim=2, cache_bytes=cap)
+    clk = 0
+    for op, key, lag in ops:
+        if op == "insert":
+            stamp = max(clk - lag, 0)  # replies are stamped <= my clock
+            cache.insert(np.array([key]),
+                         np.full((1, 2), stamp, np.float32), stamp)
+        elif op == "lookup":
+            rows, miss = cache.lookup(np.array([key]), clk, staleness)
+            if not miss[0]:
+                stamp = int(rows[0, 0])
+                assert admits(stamp, clk, staleness)
+                assert stamp >= clk - staleness
+        elif op == "tick":
+            clk += 1
+            cache.age(clk, staleness)
+        else:
+            cache.invalidate(np.array([key]))
+        assert cache.nbytes <= cap
+
+
+@given(ops=cache_ops)
+@settings(max_examples=100, deadline=None)
+def test_cache_bsp_never_serves_across_a_tick(ops):
+    """BSP (s=0) degenerate case: after any tick, every earlier insert
+    is un-servable — the cache can only satisfy re-reads within one
+    clock frame, which is why BSP cache-on runs are bitwise identical
+    to cache-off (test_cache_on_off_bitwise_equal_under_bsp)."""
+    from minips_tpu.train.sharded_ps import RowCache
+
+    cache = RowCache(dim=1, cache_bytes=1 << 12)
+    clk = 0
+    stamped_at = {}  # key -> clk at insert
+    for op, key, _ in ops:
+        if op == "insert":
+            cache.insert(np.array([key]),
+                         np.zeros((1, 1), np.float32), clk)
+            stamped_at[key] = clk
+        elif op == "lookup":
+            _, miss = cache.lookup(np.array([key]), clk, 0)
+            if not miss[0]:
+                assert stamped_at.get(key) == clk
+        elif op == "tick":
+            clk += 1
+            cache.age(clk, 0)
+            assert len(cache) == 0  # s=0: a tick empties the cache
+
+
+# The BSP bitwise cache-on/off equivalence drill lives in
+# tests/test_row_cache.py (test_cache_on_off_bitwise_equal_under_bsp):
+# it needs no hypothesis, and parking it here would silently skip it on
+# installs without the test extra.

@@ -67,6 +67,29 @@ def test_prefetch_preserves_order_and_transform():
     assert out == [i * 2 for i in range(10)]
 
 
+def test_zipf_sampler_seeded_skewed_and_spread():
+    """The shared zipfian key sampler (data/synthetic.make_zipf_sampler):
+    deterministic under its seeds, genuinely head-heavy, and with the
+    hot ranks PERMUTED across the key space — contiguous range sharding
+    must see hot rows in every shard, not all in shard 0."""
+    sample = synthetic.make_zipf_sampler(4096, 1.1, spread_seed=7)
+    a = sample(np.random.default_rng(3), 8192)
+    b = sample(np.random.default_rng(3), 8192)
+    np.testing.assert_array_equal(a, b)  # same rng seed -> same keys
+    assert a.dtype == np.int64 and a.min() >= 0 and a.max() < 4096
+    # skew: far fewer distinct keys than a uniform draw would produce
+    assert len(np.unique(a)) < 0.6 * len(np.unique(
+        np.random.default_rng(3).integers(0, 4096, 8192)))
+    # spread: each third of the key space (a 3-shard partition) holds a
+    # non-trivial share of the draws — unpermuted zipf gives shard 0
+    # essentially everything
+    shares = np.bincount(a // 1366, minlength=3) / a.size
+    assert shares.min() > 0.15, shares
+    # popularity helper: normalized, monotone over ranks
+    p = synthetic.zipf_popularity(100, 1.05)
+    assert abs(p.sum() - 1.0) < 1e-12 and (np.diff(p) < 0).all()
+
+
 def test_criteo_like_schema():
     d = synthetic.criteo_like(100, seed=0)
     assert d["dense"].shape == (100, 13)

@@ -374,6 +374,30 @@ def test_train_loop_prefetch_announces_next_batch_first():
     assert events == [("prefetch", 1), ("step", 0), ("step", 1)]
 
 
+def test_train_loop_extra_metrics_ride_the_log_line():
+    """The extra_metrics hook (wire/cache health next to loss): its dict
+    is splatted into every periodic metrics record."""
+    from minips_tpu.train.loop import TrainLoop
+    from minips_tpu.utils.metrics import MetricsLogger
+
+    records = []
+    logger = MetricsLogger(verbose=False)
+    logger.log = lambda **r: records.append(r)  # capture, don't print
+    calls = [0]
+
+    def extra():
+        calls[0] += 1
+        return {"cache_hit_rate": 0.5, "pull_rows_wire": 7}
+
+    loop = TrainLoop(lambda b: 0.0, iter(range(6)), metrics=logger,
+                     log_every=2, batch_size=1, extra_metrics=extra)
+    loop.run(6)
+    logged = [r for r in records if "loss" in r]
+    assert len(logged) == 3 and calls[0] == 3
+    for r in logged:
+        assert r["cache_hit_rate"] == 0.5 and r["pull_rows_wire"] == 7
+
+
 # ------------------------------------------------------- multi-process
 @pytest.mark.slow
 def test_overlap_ssp_three_processes_staleness_bound_holds():

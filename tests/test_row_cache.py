@@ -1,0 +1,668 @@
+"""Clock-versioned client row cache + deduplicated pull/push wires
+(train/sharded_ps.py tentpole).
+
+Fast tier, threads-as-nodes over real loopback buses: the dedup wire
+ships unique keys and scatters correctly; a cache hit is served without
+wire traffic exactly while the SSP admission predicate admits its stamp;
+pushes keep read-your-own-writes (write-through for sgd/f32, invalidate
+for stateful/quantized); the LRU byte bound evicts; prefetches populate
+and consult the same cache; push-side dedup pays quantization once per
+row; and pull_all's wire parity with pull() is pinned.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu.ops.quantized_comm import quantize_rows_int8
+from minips_tpu.train.sharded_ps import RowCache, ShardedTable
+
+_PORT = [6800]
+
+
+def _mk_buses(n):
+    from minips_tpu.comm.bus import make_bus
+
+    _PORT[0] += n + 1
+    addrs = [f"tcp://127.0.0.1:{_PORT[0] + i}" for i in range(n)]
+    buses = [make_bus(addrs[i], [a for j, a in enumerate(addrs) if j != i],
+                      my_id=i) for i in range(n)]
+    for b in buses:
+        b.start()
+    time.sleep(0.25)  # PUB/SUB slow-joiner settle
+    return buses
+
+
+class Cons:
+    """Controllable admission stub: my clock, my staleness, and the
+    min-view I serve replies under (serving_clock)."""
+
+    def __init__(self, clock=0, staleness=0, gmin=0):
+        self.clock = clock
+        self.staleness = staleness
+        self.gmin = gmin
+
+    def admit_pull(self, clk):
+        from minips_tpu.consistency.gate import admits
+
+        return admits(self.gmin, clk, self.staleness)
+
+    def serving_clock(self, requester):
+        return self.gmin
+
+
+# ------------------------------------------------------- dedup pull wire
+def test_pull_dedup_ships_unique_keys_and_scatters():
+    """A batch with duplicate keys round-trips each unique key ONCE; the
+    reply scatters back to request order — same rows the verbatim wire
+    returned, a third of the bytes on a 3x-duplicated batch."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 4, buses[0], 0, 2, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 4, buses[1], 1, 2, pull_timeout=10.0)
+    try:
+        t1._w[...] = np.arange(32 * 4, dtype=np.float32).reshape(32, 4)
+        keys = np.array([40, 41, 40, 3, 40, 41])  # 40 x3, 41 x2, 3 local
+        rows = t0.pull(keys)
+        for i, k in enumerate(keys):
+            expect = t1._w[k - 32] if k >= 32 else t0._w[k]
+            np.testing.assert_array_equal(rows[i], expect)
+        # wire: 2 unique remote keys out (8B each) + 2 rows back (16B)
+        assert t0.bytes_pulled == 2 * 8 + 2 * 16
+        s = t0.timers.summary()
+        assert s["pull_rows_requested"] == 6
+        assert s["pull_rows_wire"] == 2
+        assert s["pull_rows_local"] == 4  # 3 dupes + 1 own-shard row
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_pull_dedup_off_restores_verbatim_wire():
+    """The bench's A/B baseline: pull_dedup=False ships every occurrence
+    (the seed wire), and refuses to combine with the cache."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 4, buses[0], 0, 2, pull_timeout=10.0,
+                      pull_dedup=False)
+    t1 = ShardedTable("t", 64, 4, buses[1], 1, 2, pull_timeout=10.0)
+    try:
+        t1._w[...] = 5.0
+        rows = t0.pull(np.array([40, 40, 40]))
+        np.testing.assert_allclose(rows, 5.0)
+        assert t0.bytes_pulled == 3 * 8 + 3 * 16  # all three occurrences
+    finally:
+        for b in buses:
+            b.close()
+    with pytest.raises(ValueError, match="pull_dedup"):
+        ShardedTable("t", 8, 2, None, 0, 1, cache_bytes=1024,
+                     pull_dedup=False)
+    with pytest.raises(ValueError, match="cache_bytes"):
+        ShardedTable("t", 8, 2, None, 0, 1, cache_bytes=-1)
+    # async push can trail a later pull with no client-side marker —
+    # the cache refuses the combination (docs/consistency.md)
+    with pytest.raises(ValueError, match="async_push"):
+        ShardedTable("t", 8, 2, None, 0, 1, cache_bytes=1024,
+                     async_push=True)
+
+
+# ----------------------------------------------------------- cache hits
+def test_cache_hit_is_exactly_the_admission_window():
+    """The tentpole's contract: a cached row is served while
+    admits(stamp, clk, s) holds and re-fetched the moment it does not —
+    the stamp carries the staleness proof, clock by clock."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 4, buses[0], 0, 2, pull_timeout=10.0,
+                      cache_bytes=1 << 16)
+    t1 = ShardedTable("t", 64, 4, buses[1], 1, 2, updater="sgd", lr=1.0,
+                      pull_timeout=10.0)
+    c0 = Cons(clock=5, staleness=1, gmin=5)
+    c1 = Cons(clock=5, staleness=1, gmin=5)
+    t0.bind_consistency(c0)
+    t1.bind_consistency(c1)
+    try:
+        t1._w[...] = 7.0
+        keys = np.array([40, 41])
+        t0.pull(keys)                      # miss: fetched, stamped gmin=5
+        reqs = t0._req
+        c0.clock = 6                       # next step; 5 >= 6-1 still ok
+        np.testing.assert_allclose(t0.pull(keys), 7.0)
+        assert t0._req == reqs, "valid cached rows went to the wire"
+        c0.clock = 7                       # 5 < 7-1: window closed
+        c1.gmin = 7                        # owner will serve + restamp
+        t1._w[...] = 9.0
+        np.testing.assert_allclose(t0.pull(keys), 9.0)
+        assert t0._req == reqs + 1, "expired rows must re-fetch"
+        st = t0.cache_stats()
+        assert st["hits"] == 2 and st["lookups"] == 6
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_cache_mixed_hit_miss_single_wire_leg():
+    """A batch that is part hit / part miss ships ONLY the misses."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 4, buses[0], 0, 2, pull_timeout=10.0,
+                      cache_bytes=1 << 16)
+    t1 = ShardedTable("t", 64, 4, buses[1], 1, 2, pull_timeout=10.0)
+    try:
+        t1._w[...] = 3.0
+        t0.pull(np.array([40]))            # cache row 40
+        b0 = t0.bytes_pulled
+        rows = t0.pull(np.array([40, 41, 40]))  # 41 is the only miss
+        np.testing.assert_allclose(rows, 3.0)
+        assert t0.bytes_pulled == b0 + 8 + 16  # one key out, one row in
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_tick_ages_and_finalize_clears():
+    """tick() drops rows that can never be admitted again; finalize
+    clears outright (post-finalize agreement is exact). Driven through
+    the table-level hooks the trainer calls."""
+    t = ShardedTable("t", 64, 4, None, 0, 1, cache_bytes=1 << 16)
+    cons = Cons(clock=0, staleness=1)
+    t.bind_consistency(cons)
+    t._cache.insert(np.array([1, 2]), np.zeros((2, 4), np.float32), 3)
+    t._cache.insert(np.array([3]), np.zeros((1, 4), np.float32), 9)
+    cons.clock = 5
+    t.cache_age()   # stamp 3 < 5-1 dies; stamp 9 survives
+    assert len(t._cache) == 1
+    t.cache_clear()
+    assert len(t._cache) == 0
+
+
+# --------------------------------------------------- push read-your-writes
+def test_push_write_through_sgd_f32_tracks_server_bitwise():
+    """sgd over the f32 push wire WRITE-THROUGHS: a cache hit after my
+    own push returns bitwise the row a synchronous pull would (dup keys
+    summed in the same np.add.at order the server uses)."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 4, buses[0], 0, 2, updater="sgd", lr=0.3,
+                      pull_timeout=10.0, cache_bytes=1 << 16)
+    t1 = ShardedTable("t", 64, 4, buses[1], 1, 2, updater="sgd", lr=0.3,
+                      pull_timeout=10.0)
+    try:
+        t1._w[...] = np.random.default_rng(0).normal(
+            size=(32, 4)).astype(np.float32)
+        keys = np.array([40, 40, 41])
+        t0.pull(np.array([40, 41]))        # fill cache (f32 wire: exact)
+        g = np.random.default_rng(1).normal(size=(3, 4)).astype(np.float32)
+        w_before = t1._w[40 - 32].copy()
+        t0.push(keys, g)
+        deadline = time.time() + 5         # wait for the owner to apply
+        while time.time() < deadline \
+                and np.array_equal(t1._w[40 - 32], w_before):
+            time.sleep(0.02)
+        reqs = t0._req
+        rows = t0.pull(np.array([40, 41]))
+        assert t0._req == reqs, "write-through rows should still hit"
+        np.testing.assert_array_equal(rows[0], t1._w[40 - 32])
+        np.testing.assert_array_equal(rows[1], t1._w[41 - 32])
+        assert t0._cache.write_throughs == 2
+    finally:
+        for b in buses:
+            b.close()
+
+
+@pytest.mark.parametrize("kw", [{"updater": "adagrad"},
+                                {"updater": "sgd", "push_comm": "int8"}])
+def test_push_invalidates_when_delta_not_reproducible(kw):
+    """Stateful updaters (server-side accumulator decides the step) and
+    quantized pushes (wire noise) cannot write through — the touched
+    rows invalidate, and the next pull round-trips fresh."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 4, buses[0], 0, 2, pull_timeout=10.0,
+                      cache_bytes=1 << 16, **kw)
+    t1 = ShardedTable("t", 64, 4, buses[1], 1, 2, pull_timeout=10.0, **kw)
+    try:
+        t1._w[...] = 2.0
+        t0.pull(np.array([40, 41]))
+        t0.push(np.array([40]), np.ones((1, 4), np.float32))
+        time.sleep(0.3)
+        reqs = t0._req
+        t0.pull(np.array([40, 41]))
+        assert t0._req == reqs + 1         # 40 invalidated: re-fetched
+        assert t0._cache.invalidations == 1
+        b0 = t0.bytes_pulled
+        t0.pull(np.array([41]))            # 41 untouched: still cached
+        assert t0.bytes_pulled == b0
+    finally:
+        for b in buses:
+            b.close()
+
+
+# ------------------------------------------------------------ LRU bound
+def test_lru_byte_bound_evicts_oldest_first():
+    c = RowCache(dim=4, cache_bytes=3 * 16)  # room for exactly 3 rows
+    c.insert(np.array([1, 2, 3]), np.ones((3, 4), np.float32), 0)
+    c.lookup(np.array([1]), 0, 0)            # touch 1: now 2 is LRU
+    c.insert(np.array([4]), np.ones((1, 4), np.float32), 0)
+    assert c.evictions == 1 and len(c) == 3
+    _, miss = c.lookup(np.array([1, 2, 3, 4]), 0, 0)
+    np.testing.assert_array_equal(miss, [False, True, False, False])
+    assert c.nbytes == 3 * 16
+
+
+def test_cache_off_by_default():
+    t = ShardedTable("t", 8, 2, None, 0, 1)
+    assert t._cache is None and t.cache_stats() is None
+    t.cache_age()    # hooks are no-ops, not crashes
+    t.cache_clear()
+
+
+# ------------------------------------------------------------- prefetch
+def test_prefetch_populates_and_consults_the_same_cache():
+    """The prefetch path rides the same cache under the same stamp rule:
+    a prefetch fills it, and a prefetch whose keys all hit issues NO
+    wire traffic while its wait() still returns the rows."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 4, buses[0], 0, 2, pull_timeout=10.0,
+                      cache_bytes=1 << 16)
+    t1 = ShardedTable("t", 64, 4, buses[1], 1, 2, pull_timeout=10.0)
+    try:
+        t1._w[...] = 4.0
+        keys = np.array([40, 41])
+        fut = t0.prefetch_pull(keys, clock_ahead=0)
+        np.testing.assert_allclose(fut.wait(), 4.0)   # populates cache
+        b0 = t0.bytes_pulled
+        fut2 = t0.prefetch_pull(keys, clock_ahead=0)  # fully cached
+        assert t0.bytes_pulled == b0
+        np.testing.assert_allclose(fut2.wait(), 4.0)
+        # a future-stamped prefetch checks the cache AT ITS OWN CLOCK:
+        # under s=0 a stamp-0 row cannot satisfy clock 1 — must miss
+        t0.bind_consistency(Cons(clock=0, staleness=0, gmin=0))
+        t1.bind_consistency(Cons(clock=0, staleness=0, gmin=1))
+        fut3 = t0.prefetch_pull(keys)                 # stamped clock 1
+        assert t0.bytes_pulled > b0, "stale-for-tomorrow row hit anyway"
+        fut3.cancel()
+    finally:
+        for b in buses:
+            b.close()
+
+
+# ------------------------------------------------- push dedup (satellite)
+def test_push_all_unique_unsorted_keys_pair_correctly():
+    """Review regression: an all-unique batch in NON-sorted key order
+    must keep every (key, grad) pair intact — the no-duplicates
+    shortcut once paired SORTED unique keys with request-order grads,
+    scrambling every gradient-row association (and the cache
+    write-through with it)."""
+    t = ShardedTable("t", 64, 2, None, 0, 1, updater="sgd", lr=1.0)
+    keys = np.array([5, 2, 40])              # unsorted, no duplicates
+    grads = np.array([[1.0, 1.0], [100.0, 100.0], [7.0, 7.0]],
+                     np.float32)
+    t.push(keys, grads)
+    np.testing.assert_allclose(t._w[5], -1.0)
+    np.testing.assert_allclose(t._w[2], -100.0)
+    np.testing.assert_allclose(t._w[40], -7.0)
+    # same pairing through the cache write-through path
+    t2 = ShardedTable("t", 64, 2, None, 0, 1, updater="sgd", lr=1.0,
+                      cache_bytes=1 << 12)
+    t2._cache.insert(keys, np.zeros((3, 2), np.float32), 0)
+    t2.push(keys, grads)
+    rows, miss = t2._cache.lookup(keys, 0, 0)
+    assert not miss.any()
+    np.testing.assert_allclose(rows[:, 0], [-1.0, -100.0, -7.0])
+
+
+def test_push_dense_poisons_inflight_cache_inserts():
+    """Review regression: push_dense touches EVERY row, so a pull in
+    flight across it must not re-populate the cache with possibly
+    pre-push rows — the dense push journals a broken floor the insert
+    honors, on top of clearing the live cache."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 4, 2, buses[0], 0, 2, updater="sgd", lr=1.0,
+                      pull_timeout=10.0, cache_bytes=1 << 12)
+    t1 = ShardedTable("t", 4, 2, buses[1], 1, 2, updater="sgd", lr=1.0,
+                      pull_timeout=10.0)
+
+    class Gate:
+        ok = False
+        clock = 0
+        staleness = 0
+
+        def admit_pull(self, clk):
+            return self.ok
+
+        def serving_clock(self, requester):
+            return 0
+
+    g1 = Gate()
+    t1.bind_consistency(g1)
+    try:
+        t1._w[...] = 5.0
+        fut = t0.prefetch_pull(np.array([2, 3]), clock_ahead=0)  # parked
+        time.sleep(0.2)
+        t0.push_dense(np.ones((4, 2), np.float32))  # in-flight dense
+        time.sleep(0.2)
+        g1.ok = True
+        t1.serve_parked()
+        fut.wait()
+        _, miss = t0._cache.lookup(np.array([2, 3]), 0, 0)
+        assert miss.all(), "in-flight rows re-entered a dense-cleared cache"
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_push_dedup_f32_matches_unsummed_wire_to_rounding():
+    """Regression vs the seed's unsummed f32 wire: client-side
+    coalescing lands the state the server-side sum produced, to f32
+    rounding — the client accumulates per-dim in f64 (bincount), which
+    is at least as accurate as the server's old sequential f32 sum and
+    can differ from it only in the last ulp of 3+-occurrence keys.
+    Keys without duplicates are bitwise-untouched."""
+    rng = np.random.default_rng(3)
+    keys = np.array([5, 9, 5, 9, 9, 11])
+    grads = rng.normal(size=(6, 4)).astype(np.float32)
+    t_ref = ShardedTable("t", 64, 4, None, 0, 1, updater="sgd", lr=0.3)
+    t_ded = ShardedTable("t", 64, 4, None, 0, 1, updater="sgd", lr=0.3)
+    t_ref._apply_rows(keys, grads)     # the server-side (unsummed) path
+    t_ded.push(keys, grads)            # client dedup + local apply
+    np.testing.assert_allclose(t_ref._w, t_ded._w, rtol=1e-6, atol=1e-7)
+    np.testing.assert_array_equal(t_ref._w[11], t_ded._w[11])  # no dup
+
+
+def test_push_dedup_off_restores_per_occurrence_wire():
+    """The seed-wire A/B lever, push leg: push_dedup=False ships every
+    occurrence (the server still sums, so state is unchanged)."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="sgd", lr=1.0,
+                      pull_timeout=10.0, push_dedup=False)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="sgd", lr=1.0,
+                      pull_timeout=10.0)
+    try:
+        t0.push(np.array([40, 40, 40]), np.ones((3, 2), np.float32))
+        deadline = time.time() + 5
+        while time.time() < deadline and not t1._w[8].any():
+            time.sleep(0.02)
+        assert t0.bytes_pushed == 3 * (8 + 8)  # all three occurrences
+        np.testing.assert_allclose(t1._w[40 - 32], -3.0)
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_push_dedup_int8_pays_quantization_once_per_row():
+    """Regression vs the per-occurrence wire: k duplicate rows now
+    quantize as ONE summed row, so the error versus the f32 oracle is
+    bounded by a single quantization step of the SUM — the unsummed
+    wire's worst case is k steps (and its rounding draws never cancel
+    deterministically)."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 8, buses[0], 0, 2, updater="sgd", lr=1.0,
+                      pull_timeout=10.0, push_comm="int8")
+    t1 = ShardedTable("t", 64, 8, buses[1], 1, 2, updater="sgd", lr=1.0,
+                      pull_timeout=10.0, push_comm="int8")
+    try:
+        k = 5
+        g = np.full((k, 8), 0.37, np.float32)
+        keys = np.full(k, 40)
+        t0.push(keys, g)
+        deadline = time.time() + 5
+        while time.time() < deadline and not t1._w[40 - 32].any():
+            time.sleep(0.02)
+        expect = -g.sum(0)                  # f32 oracle (lr=1 sgd)
+        step = np.abs(g.sum(0)).max() / 127.0
+        assert np.all(np.abs(t1._w[40 - 32] - expect) <= step + 1e-7), \
+            (t1._w[40 - 32], expect)
+        # exactly one row on the wire: 8B key + 4B scale + 8B codes
+        assert t0.bytes_pushed == 8 + 4 + 8
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_inflight_pull_insert_drops_pushed_keys():
+    """Read-your-own-writes across the in-flight window (review
+    finding): a prefetch issued BEFORE a push may be served by the
+    owner on either side of that push — immediately (reply lacks the
+    delta) or from the park after it applied (reply includes it). The
+    client cannot tell which, so the cache insert must DROP the pushed
+    key instead of storing a row that might silently miss this
+    worker's own update; untouched keys still cache."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 4, buses[0], 0, 2, updater="sgd", lr=1.0,
+                      pull_timeout=10.0, cache_bytes=1 << 16)
+    t1 = ShardedTable("t", 64, 4, buses[1], 1, 2, updater="sgd", lr=1.0,
+                      pull_timeout=10.0)
+    # park the pull at the owner so the push provably lands in between
+    class Gate:
+        ok = False
+        clock = 0
+        staleness = 0
+
+        def admit_pull(self, clk):
+            return self.ok
+
+        def serving_clock(self, requester):
+            return 0
+
+    g1 = Gate()
+    t1.bind_consistency(g1)
+    try:
+        t1._w[...] = 5.0
+        keys = np.array([40, 41])
+        fut = t0.prefetch_pull(keys, clock_ahead=0)  # parked at owner
+        time.sleep(0.2)
+        t0.push(np.array([40]), np.ones((1, 4), np.float32))  # interim
+        deadline = time.time() + 5
+        while time.time() < deadline and t1._w[8, 0] == 5.0:
+            time.sleep(0.02)                 # owner applied: 5 -> 4
+        g1.ok = True
+        t1.serve_parked()                    # NOW the pull is served
+        rows = fut.wait()
+        np.testing.assert_allclose(rows[1], 5.0)
+        # the future's result reflects serve-time server state (4.0 —
+        # this parked serve happened after the push applied)...
+        np.testing.assert_allclose(rows[0], 4.0)
+        # ...but the pushed key must NOT have been cached (ambiguous
+        # window), while the untouched key 41 was
+        _, miss = t0._cache.lookup(np.array([40, 41]), 0, 0)
+        assert miss[0], "ambiguous in-flight row entered the cache"
+        assert not miss[1]
+        # the next pull of 40 round-trips once and caches cleanly
+        reqs = t0._req
+        np.testing.assert_allclose(t0.pull(np.array([40]))[0], 4.0)
+        assert t0._req == reqs + 1
+        _, miss = t0._cache.lookup(np.array([40]), 0, 0)
+        assert not miss[0]
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_inflight_pull_insert_drops_invalidated_rows():
+    """Same window, invalidate regime (adagrad): rows pushed while the
+    pull was in flight must NOT enter the cache at all — the client
+    cannot reconstruct the server's accumulator step."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 4, buses[0], 0, 2, updater="adagrad",
+                      pull_timeout=10.0, cache_bytes=1 << 16)
+    t1 = ShardedTable("t", 64, 4, buses[1], 1, 2, updater="adagrad",
+                      pull_timeout=10.0)
+
+    class Gate:
+        ok = False
+        clock = 0
+        staleness = 0
+
+        def admit_pull(self, clk):
+            return self.ok
+
+        def serving_clock(self, requester):
+            return 0
+
+    g1 = Gate()
+    t1.bind_consistency(g1)
+    try:
+        t1._w[...] = 5.0
+        fut = t0.prefetch_pull(np.array([40, 41]), clock_ahead=0)
+        time.sleep(0.2)
+        t0.push(np.array([40]), np.ones((1, 4), np.float32))
+        time.sleep(0.2)
+        g1.ok = True
+        t1.serve_parked()
+        fut.wait()
+        _, miss = t0._cache.lookup(np.array([40, 41]), 0, 0)
+        assert miss[0], "invalidated-in-flight row entered the cache"
+        assert not miss[1]                  # untouched row cached fine
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_write_through_requires_deduped_push_wire():
+    """push_dedup=False ships per-occurrence rows the server re-sums in
+    f32 — not necessarily bit-equal to the client's sum — so the cache
+    must INVALIDATE on push instead of writing through."""
+    t2 = ShardedTable("t", 64, 4, None, 0, 1, updater="sgd", lr=1.0,
+                      cache_bytes=1 << 16, push_dedup=False)
+    t2._cache.insert(np.array([7]), np.ones((1, 4), np.float32), 0)
+    t2.push(np.array([7, 7]), np.ones((2, 4), np.float32))
+    assert t2._cache.write_throughs == 0
+    assert t2._cache.invalidations == 1
+    _, miss = t2._cache.lookup(np.array([7]), 0, 0)
+    assert miss[0]
+
+
+# ------------------------------------------------------- BSP bitwise
+def test_cache_on_off_bitwise_equal_under_bsp():
+    """Under BSP, cache-on vs cache-off runs produce BITWISE-identical
+    final weights: within a clock frame a hit returns exactly the bytes
+    a wire pull would (no push intervened, or my own write-through is
+    the server's op replayed), and across frames s=0 never serves.
+    Deterministic lockstep over real loopback buses; disjoint per-rank
+    key sets keep the cross-rank push/pull race out of the comparison;
+    grads are a function of pulled rows so any read deviation would
+    propagate into the weights."""
+    def run(cache_bytes):
+        buses = _mk_buses(2)
+
+        class LockstepCons:  # shared lockstep clock vector (BSP: s = 0)
+            clocks = [0, 0]
+            staleness = 0
+
+            def __init__(self, rank):
+                self.rank = rank
+
+            @property
+            def clock(self):
+                return self.clocks[self.rank]
+
+            def admit_pull(self, clk):
+                return min(self.clocks) >= clk
+
+            def serving_clock(self, requester):
+                return min(self.clocks)
+
+        tables = [ShardedTable("t", 64, 2, buses[i], i, 2, updater="sgd",
+                               lr=0.5, pull_timeout=10.0,
+                               cache_bytes=cache_bytes)
+                  for i in range(2)]
+        LockstepCons.clocks = [0, 0]
+        for i, t in enumerate(tables):
+            t.bind_consistency(LockstepCons(i))
+            t._w[...] = np.arange(32 * 2, dtype=np.float32
+                                  ).reshape(32, 2) / 7.0
+        # disjoint cross-shard keys: rank 0 works rows 33..47, rank 1
+        # rows 1..15 — each rank's pushes touch only its OWN keys
+        keysets = [np.array([33, 40, 33, 47]), np.array([1, 8, 1, 15])]
+        try:
+            for _ in range(4):
+                rows = [tables[r].pull(keysets[r]) for r in (0, 1)]
+                for r in (0, 1):  # second read, same frame: hits when on
+                    again = tables[r].pull(keysets[r])
+                    np.testing.assert_array_equal(again, rows[r])
+                for r in (0, 1):
+                    tables[r].push(keysets[r], 0.1 * rows[r] + 1.0)
+                for r in (0, 1):  # read-own-writes, same frame
+                    tables[r].pull(keysets[r])
+                # FIFO barrier: a post-push pull on each link proves the
+                # pushes applied before the next frame's reads
+                tables[0].pull(np.array([32]))
+                tables[1].pull(np.array([0]))
+                LockstepCons.clocks[0] += 1
+                LockstepCons.clocks[1] += 1
+                for t in tables:
+                    t.cache_age()
+            return [t._w.copy() for t in tables]
+        finally:
+            for b in buses:
+                b.close()
+
+    w_off = run(cache_bytes=0)
+    w_on = run(cache_bytes=1 << 16)
+    for off, on in zip(w_off, w_on):
+        np.testing.assert_array_equal(off, on)  # bitwise, not allclose
+
+
+# ------------------------------------------------------- multi-process
+@pytest.mark.slow
+def test_cache_ssp_three_processes_trains_and_bounds_staleness():
+    """The cache under a REAL SSP launcher run: training still
+    converges, replicas agree after finalize, the s+1 transient skew
+    bound holds, no frames drop — and the cache actually engages
+    (hits > 0 under the zipf-ish sparse workload with write-through
+    active)."""
+    import sys
+
+    from minips_tpu import launch
+
+    _PORT[0] += 8
+    res = launch.run_local_job(
+        3, [sys.executable, "-m", "minips_tpu.apps.sharded_ps_example",
+            "--iters", "40", "--model", "sparse", "--mode", "ssp",
+            "--staleness", "2", "--cache-bytes", str(1 << 22)],
+        base_port=_PORT[0],
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
+        timeout=240.0)
+    assert all(r["event"] == "done" for r in res)
+    for r in res:
+        assert r["frames_dropped"] == 0, r
+        assert r["wire_frames_lost"] == 0, r
+        assert r["max_skew_seen"] <= 3, r  # s + 1 transient bound
+        assert r["loss_last"] < r["loss_first"], r
+        assert r["cache_bytes"] == 1 << 22, r  # knob echo
+        cache = r["cache"]
+        assert cache is not None and cache["hits"] > 0, cache
+        # the done-line row-flow counters ride the timing record
+        tm = r["timing"]
+        assert tm["pull_rows_wire"] < tm["pull_rows_requested"], tm
+    sums = [r["param_sum"] for r in res]
+    assert max(sums) - min(sums) < 1e-4, sums
+
+
+# ------------------------------------------- pull_all wire parity (audit)
+def test_pull_all_ships_on_configured_wire():
+    """Audit pin: pull_all rides the SAME configured pull wire as
+    pull() — int8 shards decode within one codec step and the wire
+    accounting counts compressed bytes. The cost accepted with it:
+    post-finalize fingerprints agree within codec tolerance, not
+    bitwise, because each rank's OWN shard stays exact f32 while
+    peers' shards decode from int8 (docs/api.md)."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 8, buses[0], 0, 2, pull_timeout=10.0,
+                      pull_wire="int8")
+    t1 = ShardedTable("t", 64, 8, buses[1], 1, 2, pull_timeout=10.0,
+                      pull_wire="int8")
+    try:
+        vals = np.random.default_rng(0).normal(
+            size=(64, 8)).astype(np.float32)
+        t0._w[...] = vals[:32]
+        t1._w[...] = vals[32:]
+        full0 = t0.pull_all()
+        # compressed bytes: 32 remote rows x (4B scale + 8B codes)
+        assert t0.bytes_pulled == 32 * (4 + 8)
+        step = np.abs(vals).max(axis=1, keepdims=True) / 127.0
+        assert np.all(np.abs(full0 - vals) <= step + 1e-6)
+        # own shard exact, remote shard quantized — the documented trade
+        np.testing.assert_array_equal(full0[:32], vals[:32])
+        full1 = t1.pull_all()
+        np.testing.assert_array_equal(full1[32:], vals[32:])
+        assert np.all(np.abs(full0 - full1) <= 2 * step + 1e-6)
+    finally:
+        for b in buses:
+            b.close()

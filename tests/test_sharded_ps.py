@@ -261,8 +261,10 @@ def test_inprocess_route_push_pull_three_shards():
         # 1/N memory: each shard holds exactly 32 of 96 rows
         for t in tables:
             assert t.local_bytes() == 32 * 2 * 4
-        # wire: pusher shipped ONLY its 3 remote rows (8B key + 8B row)
-        assert tables[0].bytes_pushed == 3 * (8 + 8)
+        # wire: pusher shipped ONLY its remote rows, DEDUPED — key 40's
+        # two occurrences coalesce to one summed row client-side, so 2
+        # unique remote rows cross the wire (8B key + 8B row each)
+        assert tables[0].bytes_pushed == 2 * (8 + 8)
     finally:
         for b in buses:
             b.close()
