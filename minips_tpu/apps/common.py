@@ -403,8 +403,14 @@ def emit_multiproc_done(trainer, rank: int, t0: float, losses,
         "bytes_pulled": trainer.bytes_pulled,
         # a dropped frame is a silently-lost gradient — smokes assert 0
         "frames_dropped": trainer.frames_dropped,
-        # bus-level wire loss (HWM drops, torn links) — smokes assert 0
+        # bus-level wire loss (HWM drops, torn links; UNRECOVERED loss
+        # when the reliable channel is on) — smokes assert 0
         "wire_frames_lost": trainer.wire_frames_lost,
+        # torn frames counted at receive, not silently swallowed
+        "wire_frames_malformed": trainer.wire_frames_malformed,
+        # retransmit/chaos counters (None = layer off)
+        "reliable": trainer.reliable_stats(),
+        "chaos": trainer.chaos_stats(),
         "local_bytes": trainer.local_bytes(),
         "table_bytes": int(table_bytes),
         "param_fingerprint": fingerprint,

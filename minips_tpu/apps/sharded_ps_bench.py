@@ -56,6 +56,12 @@ def main(argv=None) -> int:
                          "finite s = SSP(s) — the cache_comparison "
                          "sweep runs s in {0,1,2} because the cache's "
                          "validity window IS the staleness budget")
+    ap.add_argument("--pull-timeout", dest="pull_timeout", type=float,
+                    default=60.0,
+                    help="table pull/ack deadline — the chaos sweep "
+                         "shortens it so the retransmit-off arms die "
+                         "in seconds instead of the default minute "
+                         "(the poison path is the measurement there)")
     ap.add_argument("--compute", choices=["none", "jit"], default="none",
                     help="jit: between pull and push, run a REAL jitted "
                          "model-grad step on the pulled rows (rank 0 on "
@@ -129,7 +135,7 @@ def main(argv=None) -> int:
 
     table = ShardedTable("b", args.rows, args.dim, bus, rank, nprocs,
                          updater=args.updater, lr=0.05,
-                         pull_timeout=60.0, monitor=monitor,
+                         pull_timeout=args.pull_timeout, monitor=monitor,
                          async_push=(args.overlap and
                                      args.overlap_legs != "pull"),
                          **table_wire_kwargs(args))
@@ -229,6 +235,18 @@ def main(argv=None) -> int:
         "cache_bytes": args.cache_bytes,
         "pull_dedup": bool(args.pull_dedup),
         "push_dedup": bool(args.push_dedup),
+        # chaos/reliable echo + wire health: the resilience sweep asserts
+        # the arm config and reads the recovery counters
+        "chaos_spec": os.environ.get("MINIPS_CHAOS") or None,
+        "reliable_on": os.environ.get("MINIPS_RELIABLE", "")
+        not in ("", "0"),
+        "wire_frames_lost": (trainer.wire_frames_lost
+                             if trainer is not None else 0),
+        "wire_frames_malformed": (trainer.wire_frames_malformed
+                                  if trainer is not None else 0),
+        "reliable": (trainer.reliable_stats()
+                     if trainer is not None else None),
+        "chaos": (trainer.chaos_stats() if trainer is not None else None),
         "cache": table.cache_stats(),
         "compute": (f"jit({backend})" if args.compute == "jit"
                     else "none"),

@@ -218,6 +218,11 @@ def main(argv=None) -> int:
             "overlap_legs": args.overlap_legs if args.overlap else None,
             "cache_bytes": args.cache_bytes,
             "pull_dedup": bool(args.pull_dedup),
+            # chaos/reliable echo (env-configured, launcher-inherited):
+            # the e2e drill asserts the arm it thinks it ran really ran
+            "chaos_spec": os.environ.get("MINIPS_CHAOS") or None,
+            "reliable_on": os.environ.get("MINIPS_RELIABLE", "")
+            not in ("", "0"),
             "wall_s": round(time.monotonic() - t0, 4),
             "loss_first": losses[0] if losses else None,
             "loss_last": float(np.mean(losses[-5:])) if losses else None,

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, Optional
 
 try:
@@ -38,11 +39,27 @@ class FrameLossTracker:
     report. The FIRST frame seen per stream only synchronizes (frames
     published before a subscription lands are droppable by design; the
     handshake rendezvous bounds that window), so ``lost`` counts losses
-    in ESTABLISHED streams — which must be zero in a healthy job."""
+    in ESTABLISHED streams — which must be zero in a healthy job.
+
+    A gap is kept as an OUTSTANDING set, not a terminal verdict: a
+    reordered, duplicated, or retransmitted frame whose seq eventually
+    arrives reconciles ``lost`` back down — under the reliable-delivery
+    layer (comm/reliable.py) a retransmit that lands late must not be
+    double-booked as both 'lost' and 'delivered', and a mere adjacent
+    swap (chaos reorder, a multi-path wire) was never a loss at all.
+    ``dups`` counts late frames whose seq was already accounted
+    delivered. The outstanding set is bounded (``GAP_CAP`` per stream);
+    gaps evicted past the cap stay counted lost forever — the seed
+    behavior, now only for pathological floods."""
+
+    GAP_CAP = 4096  # outstanding gap seqs retained per (sender, stream)
 
     def __init__(self):
         self._next: dict[tuple, int] = {}
+        self._gaps: dict[tuple, "OrderedDict[int, None]"] = {}
         self.lost = 0
+        self.dups = 0
+        self.malformed = 0
         self._lock = threading.Lock()
 
     def observe(self, sender: int, stream: str, seq: int) -> None:
@@ -52,9 +69,42 @@ class FrameLossTracker:
             if exp is None:  # sync point: pre-subscription frames
                 self._next[k] = seq + 1
                 return
-            if seq > exp:
-                self.lost += seq - exp
-            self._next[k] = max(exp, seq + 1)
+            if seq >= exp:
+                if seq > exp:
+                    self.lost += seq - exp  # O(1), like the seed
+                    gaps = self._gaps.setdefault(k, OrderedDict())
+                    # materialize at most GAP_CAP seqs of the jump: a
+                    # stale-run/corrupt frame carrying a huge seq must
+                    # not build a gap entry per missing seq under the
+                    # receive thread's lock — everything below the cap
+                    # stays counted lost forever (seed behavior)
+                    for s in range(max(exp, seq - self.GAP_CAP), seq):
+                        gaps[s] = None
+                    while len(gaps) > self.GAP_CAP:
+                        gaps.popitem(last=False)
+                self._next[k] = seq + 1
+                return
+            # late frame (seq < exp): a reordered/duplicated/retransmitted
+            # arrival — reconcile if its seq is an outstanding gap
+            gaps = self._gaps.get(k)
+            if gaps is not None and gaps.pop(seq, -1) is None:
+                self.lost -= 1
+            else:
+                self.dups += 1
+
+    def note_malformed(self) -> None:
+        with self._lock:
+            self.malformed += 1
+
+    def prime(self, sender: int, stream: str, seq: int = 0) -> None:
+        """Pin the stream's sync point (idempotent): the reliable
+        channel defines every stream as starting at seq 0 — with it
+        installed, a hole the journal could not repair must COUNT as
+        lost even when it precedes the first delivered frame, instead
+        of being forgiven by first-frame sync (which exists for the
+        bare bus's pre-subscription window)."""
+        with self._lock:
+            self._next.setdefault((sender, stream), seq)
 
 
 class ControlBus:
@@ -154,6 +204,14 @@ class ControlBus:
                     head["ds"] = self._dseq[dest]
                     self._dseq[dest] += 1
             msg = json.dumps(head).encode()
+            rel = getattr(self, "reliable", None)
+            if rel is not None and ("bs" in head or "ds" in head):
+                # journal under the pub lock: journal order == wire order,
+                # so a NACKed seq is always findable or provably evicted
+                rel.journal_stamped(
+                    "b" if "bs" in head else "d",
+                    -1 if "bs" in head else int(topic[1:-1]),
+                    head.get("bs", head.get("ds")), msg, blob)
             frames = [topic, msg] if blob is None else [topic, msg, blob]
             self._pub.send_multipart(frames)
             self.bytes_sent += len(msg) + (len(blob) if blob else 0)
@@ -161,8 +219,17 @@ class ControlBus:
     @property
     def frames_lost(self) -> int:
         """Wire frames provably lost on established (sender → me) streams
-        — nonzero means HWM drops or a torn link tail; see FrameLossTracker."""
+        — nonzero means HWM drops or a torn link tail; see FrameLossTracker.
+        With the reliable channel installed, recovered frames never count:
+        this is UNRECOVERED loss."""
         return self.loss.lost
+
+    @property
+    def frames_malformed(self) -> int:
+        """Undecodable control frames dropped at receive (torn JSON — a
+        stale run's tail or wire corruption), counted instead of silently
+        swallowed; surfaced next to frames_lost in wire_record."""
+        return self.loss.malformed
 
     def out_queue_depth(self) -> Optional[int]:
         """zmq queues live inside the library; depth is not observable —
@@ -187,10 +254,10 @@ class ControlBus:
                 except zmq.ZMQError:
                     break  # EAGAIN: queue empty, back to poll()
                 if len(frames) < 2:
+                    self.loss.note_malformed()
                     continue  # topic-only frame: malformed
-                dispatch_message(self._handlers, frames[1],
-                                 frames[2] if len(frames) > 2 else None,
-                                 loss=self.loss)
+                deliver_frame(self, frames[1],
+                              frames[2] if len(frames) > 2 else None)
 
     def handshake(self, num_processes: int, timeout: float = 15.0) -> None:
         """Rendezvous before real traffic: PUB/SUB drops messages published
@@ -202,6 +269,7 @@ class ControlBus:
         run_handshake(self, num_processes, timeout)
 
     def close(self) -> None:
+        stop_bus_layers(self)  # chaos scheduler + reliable repair thread
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=1.0)
@@ -219,13 +287,36 @@ def dispatch_message(handlers: dict, raw, blob: Optional[bytes],
                      loss: Optional[FrameLossTracker] = None) -> None:
     """Shared receive-side tail for every bus backend: decode the JSON
     control frame, run it past the wire-loss tracker, attach the blob at
-    ``__blob__``, invoke the handler. A raising handler is reported, not
+    ``__blob__``, invoke the handler. A malformed frame is COUNTED
+    (``loss.malformed`` → ``frames_malformed``) and reported once to
+    stderr instead of silently swallowed — a torn frame is a wire-health
+    signal the done lines must carry. A raising handler is reported, not
     propagated — one bad handler must not kill the backend's receive
     thread (clocks/heartbeats ride the same thread)."""
     try:
         msg = json.loads(raw)
     except (json.JSONDecodeError, UnicodeDecodeError):
+        _note_malformed(loss, raw)
         return
+    dispatch_parsed(handlers, msg, blob, loss=loss)
+
+
+def _note_malformed(loss: Optional[FrameLossTracker], raw) -> None:
+    if loss is None:
+        return
+    loss.note_malformed()
+    if loss.malformed == 1:  # first sighting: say it once, count the rest
+        import sys
+
+        head = bytes(raw[:64]) if raw is not None else b""
+        print(f"bus: malformed control frame dropped (head={head!r}); "
+              "counting in frames_malformed", file=sys.stderr)
+
+
+def dispatch_parsed(handlers: dict, msg: dict, blob: Optional[bytes],
+                    loss: Optional[FrameLossTracker] = None) -> None:
+    """``dispatch_message`` minus the decode — the reliable channel's
+    sequencer re-dispatches already-parsed frames through this."""
     if loss is not None:
         if "bs" in msg:
             loss.observe(msg.get("sender", -1), "b", int(msg["bs"]))
@@ -246,6 +337,46 @@ def dispatch_message(handlers: dict, raw, blob: Optional[bytes],
         print(f"bus: handler for {msg.get('kind')!r} raised:",
               file=sys.stderr)
         traceback.print_exc()
+
+
+def deliver_frame(bus, raw, blob: Optional[bytes]) -> None:
+    """Receive chain shared by every backend, layered like the wire it
+    models: (1) the chaos injector, when installed, plays the lossy
+    network — it may drop, duplicate, delay, or reorder the frame;
+    (2) the reliable channel, when installed, runs surviving stamped
+    frames through its deliver-once in-order sequencer (gap → NACK →
+    retransmit, comm/reliable.py); (3) plain handler dispatch. With
+    neither installed this is byte-for-byte the seed path."""
+    try:
+        msg = json.loads(raw)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        _note_malformed(getattr(bus, "loss", None), raw)
+        return
+    chaos = getattr(bus, "chaos", None)
+    if chaos is not None:
+        chaos.on_wire(msg, blob)  # forwards survivors to deliver_post_wire
+    else:
+        deliver_post_wire(bus, msg, blob)
+
+
+def deliver_post_wire(bus, msg: dict, blob: Optional[bytes]) -> None:
+    """Above-the-wire half of :func:`deliver_frame` — the chaos injector
+    re-enters here for frames it held (so a delayed frame is not
+    re-chaosed on release)."""
+    rel = getattr(bus, "reliable", None)
+    if rel is not None and ("bs" in msg or "ds" in msg):
+        rel.on_stamped(msg, blob)
+    else:
+        dispatch_parsed(bus._handlers, msg, blob, loss=bus.loss)
+
+
+def stop_bus_layers(bus) -> None:
+    """Quiesce the optional chaos/reliable layers before a backend tears
+    its sockets down (both run their own timer threads)."""
+    for attr in ("chaos", "reliable"):
+        layer = getattr(bus, attr, None)
+        if layer is not None:
+            layer.stop()
 
 
 def run_handshake(bus, num_processes: int, timeout: float = 15.0) -> None:
@@ -298,7 +429,9 @@ def run_handshake(bus, num_processes: int, timeout: float = 15.0) -> None:
 
 
 def make_bus(my_addr: str, peer_addrs: list[str], my_id: int = 0,
-             backend: Optional[str] = None):
+             backend: Optional[str] = None, *,
+             chaos: Optional[str] = None,
+             reliable: Optional[str] = None):
     """Bus factory. ``backend``: ``"zmq"`` (pyzmq PUB/SUB, default) or
     ``"native"`` (the C++ TCP mailbox, cpp/mailbox.cpp — the reference's
     native-runtime analog); default from ``$MINIPS_BUS``.
@@ -306,7 +439,19 @@ def make_bus(my_addr: str, peer_addrs: list[str], my_id: int = 0,
     An explicit native request that cannot be satisfied raises instead of
     silently falling back: the two wire formats do not interoperate, so a
     quiet fallback on one host of a multi-host job would produce a mixed
-    mesh that fails 15s later with a misleading handshake timeout."""
+    mesh that fails 15s later with a misleading handshake timeout.
+
+    Two optional layers install on whichever backend was built (same
+    observable interface either way):
+
+    - ``reliable`` (or ``$MINIPS_RELIABLE``): the retransmission protocol
+      riding the per-link seqs (comm/reliable.py) — transient wire loss
+      degrades to latency instead of a timeout poison. ``"1"`` for
+      defaults, or a knob string (``"journal=1024,budget=12"``).
+    - ``chaos`` (or ``$MINIPS_CHAOS``): the deterministic seeded fault
+      injector (comm/chaos.py), ``"<seed>:drop=0.01,dup=0.005,..."`` —
+      every process must run the SAME spec for a reproducible drill.
+    """
     import os
 
     backend = backend or os.environ.get("MINIPS_BUS", "zmq")
@@ -318,11 +463,27 @@ def make_bus(my_addr: str, peer_addrs: list[str], my_id: int = 0,
                 "MINIPS_BUS=native requested but the C++ mailbox library "
                 "is unavailable (no compiler?); every host must use the "
                 "same backend — set MINIPS_BUS=zmq explicitly to fall back")
-        return NativeControlBus(my_addr, peer_addrs, my_id=my_id)
-    if backend != "zmq":
+        bus = NativeControlBus(my_addr, peer_addrs, my_id=my_id)
+    elif backend == "zmq":
+        bus = ControlBus(my_addr, peer_addrs, my_id=my_id)
+    else:
         raise ValueError(f"unknown bus backend {backend!r} "
                          "(expected 'zmq' or 'native')")
-    return ControlBus(my_addr, peer_addrs, my_id=my_id)
+    # layer order matters only conceptually: chaos models the wire (runs
+    # first on receive), reliable rides above it. Install reliable first
+    # so chaos-released frames find the sequencer already in place.
+    reliable = (os.environ.get("MINIPS_RELIABLE", "")
+                if reliable is None else reliable)
+    if reliable and reliable != "0":
+        from minips_tpu.comm.reliable import ReliableChannel
+
+        ReliableChannel.install(bus, reliable)
+    chaos = os.environ.get("MINIPS_CHAOS", "") if chaos is None else chaos
+    if chaos:
+        from minips_tpu.comm.chaos import ChaosBus
+
+        ChaosBus.install(bus, chaos)
+    return bus
 
 
 class ClockGossip:
@@ -353,7 +514,17 @@ class ClockGossip:
         with self._cond:
             if sender not in self._clocks:
                 return  # stray sender (stale run / port reuse): no ghosts
-            self._clocks[sender] = list(payload.get("clocks", []))
+            new = list(payload.get("clocks", []))
+            cur = self._clocks[sender]
+            if len(cur) == len(new):
+                # MONOTONE merge: clocks only advance within one bus
+                # incarnation, so a clock frame arriving LATE (wire
+                # reorder, a retransmit landing after fresher gossip)
+                # must never regress the view — a regressed min would
+                # re-park admitted pulls and stamp replies with a
+                # freshness certificate older than what the rows hold
+                new = [max(a, b) for a, b in zip(cur, new)]
+            self._clocks[sender] = new
             self._cond.notify_all()
         self._notify_listeners()
 

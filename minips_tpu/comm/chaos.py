@@ -1,0 +1,307 @@
+"""ChaosBus — deterministic seeded fault injection for the PS wire.
+
+The fault story so far is detect-then-restart (heartbeats find a corpse,
+everyone reloads the checkpoint) plus *counting* wire loss
+(``FrameLossTracker``). What it lacked was a way to MAKE loss happen on
+demand: zmq over loopback essentially never drops below the HWM, so the
+recovery machinery (comm/reliable.py retransmits, the timeout poisons,
+the heartbeat ladder) ran only in production-shaped accidents. This
+module is the missing half: a fault injector installed on a bus's
+RECEIVE path (``deliver_frame`` in comm/bus.py) that drops, duplicates,
+delays, and reorders frames from a seeded, hash-based decision function
+— the same spec + seed reproduces the same fate for every frame, on
+either backend, regardless of thread interleaving, so every failure mode
+is a unit test instead of a 3am page.
+
+Injection is receiver-side on purpose: a sender-side drop would happen
+BEFORE the per-link sequence number is consumed, leaving no gap for the
+loss tracker or the reliable channel to detect — indistinguishable from
+the frame never having been sent. Dropping after the seq is on the wire
+is exactly what real loss (HWM overflow, a torn link tail, a lossy
+network hop) looks like to the receiver.
+
+Spec grammar (``$MINIPS_CHAOS`` or ``make_bus(..., chaos=...)``)::
+
+    <seed>:<entry>,<entry>,...
+    entry   := <knob>=<value>
+    knob    := op[@kindprefix][#senderid] | delay_ms | reorder_ms
+    op      := drop | dup | delay | reorder
+
+e.g. ``MINIPS_CHAOS="1234:drop=0.01,dup=0.005,delay=0.01,delay_ms=20"``
+or per-kind/per-link: ``"7:drop=0,drop@psr=0.05,drop#2=0.1"`` (pull
+replies 5%, anything from rank 2 10%). The most specific matching entry
+wins (kind+sender > kind > sender > global; longer kind prefixes beat
+shorter ones).
+
+Determinism: each frame's fate is ``H(seed, my_id, sender, stream, seq,
+op) / 2^64`` (blake2b) — a pure function of the frame's identity, not of
+arrival order or RNG consumption, so two runs with the same spec and the
+same frame streams inject identical faults even though threads
+interleave differently. Unstamped frames (handshake, NACK/retransmit
+control traffic) are keyed by a per-(sender, kind) arrival counter
+instead of a seq — deterministic per receiver because each such stream
+rides one FIFO link.
+
+Every process in a drill should run the SAME spec (the launcher's env
+inheritance does this for free); per-link knobs then shape asymmetry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import json
+import struct
+import threading
+import time
+from typing import Optional
+
+__all__ = ["ChaosSpec", "ChaosBus"]
+
+_OPS = ("drop", "dup", "delay", "reorder")
+
+
+class ChaosSpec:
+    """Parsed chaos schedule: seed + per-op rate entries + hold params."""
+
+    def __init__(self, seed: int, rates: dict, delay_ms: float = 20.0,
+                 reorder_ms: float = 50.0):
+        # rates: op -> list of (kind_prefix | None, sender | None, rate)
+        self.seed = int(seed)
+        self.rates = rates
+        self.delay_ms = float(delay_ms)
+        self.reorder_ms = float(reorder_ms)
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosSpec":
+        spec = spec.strip()
+        if ":" in spec:
+            seed_s, _, body = spec.partition(":")
+        else:  # bare seed: chaos armed but all rates zero (bench control)
+            seed_s, body = spec, ""
+        try:
+            seed = int(seed_s)
+        except ValueError:
+            raise ValueError(
+                f"chaos spec must start with '<int seed>:', got {spec!r}")
+        rates: dict = {op: [] for op in _OPS}
+        delay_ms, reorder_ms = 20.0, 50.0
+        for entry in filter(None, (e.strip() for e in body.split(","))):
+            if "=" not in entry:
+                raise ValueError(f"chaos entry {entry!r} lacks '='")
+            knob, _, val = entry.partition("=")
+            if knob == "delay_ms":
+                delay_ms = float(val)
+                continue
+            if knob == "reorder_ms":
+                reorder_ms = float(val)
+                continue
+            sender: Optional[int] = None
+            if "#" in knob:
+                knob, _, snd = knob.partition("#")
+                sender = int(snd)
+            kind: Optional[str] = None
+            if "@" in knob:
+                knob, _, kind = knob.partition("@")
+            if knob not in _OPS:
+                raise ValueError(
+                    f"unknown chaos op {knob!r} (expected one of {_OPS})")
+            rate = float(val)
+            if not 0.0 <= rate <= 1.0:
+                raise ValueError(f"chaos rate {entry!r} outside [0, 1]")
+            rates[knob].append((kind, sender, rate))
+        return cls(seed, rates, delay_ms, reorder_ms)
+
+    def rate(self, op: str, kind: str, sender: int) -> float:
+        """Most specific matching entry wins; 0.0 when none match."""
+        best, best_score = 0.0, -1
+        for kprefix, snd, rate in self.rates.get(op, ()):
+            if snd is not None and snd != sender:
+                continue
+            if kprefix is not None and not kind.startswith(kprefix):
+                continue
+            score = ((len(kprefix) + 1) if kprefix is not None else 0) * 2 \
+                + (1 if snd is not None else 0)
+            if score > best_score:
+                best, best_score = rate, score
+        return best
+
+    def active(self) -> bool:
+        return any(e for e in self.rates.values())
+
+
+class ChaosBus:
+    """The injector object installed at ``bus.chaos``; ``deliver_frame``
+    routes every received frame through :meth:`on_wire`, which forwards
+    the survivors (possibly late, possibly twice, possibly swapped) to
+    ``deliver_post_wire`` — i.e. to the reliable channel / handlers,
+    which sit ABOVE the simulated wire and never see the injector."""
+
+    def __init__(self, bus, spec: "ChaosSpec | str"):
+        if isinstance(spec, str):
+            spec = ChaosSpec.parse(spec)
+        self.bus = bus
+        self.spec = spec
+        self.stats = {"frames": 0, "dropped": 0, "duplicated": 0,
+                      "delayed": 0, "reordered": 0}
+        self._lock = threading.Lock()
+        self._uctr: dict[tuple, int] = {}   # (sender, kind) -> arrivals
+        self._held: dict[tuple, tuple] = {}  # link -> (due, msg, blob)
+        self._heap: list[tuple] = []         # (due, tie, msg, blob)
+        self._tie = 0
+        self._cond = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="chaos-sched")
+        self._thread.start()
+
+    @classmethod
+    def install(cls, bus, spec: "ChaosSpec | str") -> "ChaosBus":
+        bus.chaos = cls(bus, spec)
+        return bus.chaos
+
+    # ----------------------------------------------------------- decisions
+    def _u(self, op: str, sender: int, stream: str, seq: int) -> float:
+        """Uniform [0,1) that is a pure function of the frame identity —
+        the whole determinism story lives here."""
+        key = f"{self.spec.seed}|{self.bus.my_id}|{sender}|{stream}|" \
+              f"{seq}|{op}".encode()
+        h = hashlib.blake2b(key, digest_size=8).digest()
+        return struct.unpack("<Q", h)[0] / 2.0 ** 64
+
+    # ------------------------------------------------------------- receive
+    def on_wire(self, msg: dict, blob: Optional[bytes]) -> None:
+        sender = int(msg.get("sender", -1))
+        kind = str(msg.get("kind", ""))
+        if "bs" in msg:
+            stream, seq = "b", int(msg["bs"])
+        elif "ds" in msg:
+            stream, seq = "d", int(msg["ds"])
+        else:
+            with self._lock:
+                k = (sender, kind)
+                seq = self._uctr[k] = self._uctr.get(k, -1) + 1
+            stream = f"u:{kind}"
+        spec = self.spec
+        with self._lock:
+            self.stats["frames"] += 1
+
+        def hit(op: str) -> bool:
+            # rate first, hash only when armed: a zero-rate op must cost
+            # nothing on the hot receive path (the drop-0 control arm
+            # exists to measure exactly this), and skipping the draw
+            # cannot change any armed op's decision — the hash is a pure
+            # function of (frame identity, op), not of draw order
+            r = spec.rate(op, kind, sender)
+            return r > 0.0 and self._u(op, sender, stream, seq) < r
+
+        if hit("drop"):
+            with self._lock:
+                self.stats["dropped"] += 1
+            self._release_held((sender, stream))  # a drop still advances
+            return
+        dup_copy = None
+        if hit("dup"):
+            # copy BEFORE the first dispatch: handlers receive the payload
+            # dict itself (blob attached in place) and may mutate it
+            dup_copy = (json.loads(json.dumps(msg)), blob)
+            with self._lock:
+                self.stats["duplicated"] += 1
+        if hit("delay"):
+            # hold for ~delay_ms (deterministically jittered ±50%): later
+            # frames on every link overtake it — delay IS reordering on
+            # release, which is the point
+            jit = 0.5 + self._u("delayj", sender, stream, seq)
+            self._schedule(spec.delay_ms * jit / 1e3, msg, blob)
+            with self._lock:
+                self.stats["delayed"] += 1
+        elif hit("reorder"):
+            # adjacent swap: park until the NEXT frame on the same
+            # (sender, stream) link passes, or reorder_ms elapses with no
+            # successor (trailing frame: plain delay)
+            link = (sender, stream)
+            with self._lock:
+                parked = self._held.pop(link, None)
+                self._held[link] = (time.monotonic()
+                                    + spec.reorder_ms / 1e3, msg, blob)
+                self.stats["reordered"] += 1
+                self._cond.notify()
+            if parked is not None:  # two in a row: the first-held goes now
+                self._forward(parked[1], parked[2])
+        else:
+            self._release_held_after((sender, stream), msg, blob)
+        if dup_copy is not None:
+            # the duplicate lands a beat later — exercises dedup across
+            # time, not just back-to-back
+            self._schedule(spec.delay_ms / 1e3, *dup_copy)
+
+    def _release_held_after(self, link: tuple, msg: dict,
+                            blob: Optional[bytes]) -> None:
+        """Deliver ``msg`` now; if a reorder-parked frame was waiting on
+        this link, deliver it right after — the adjacent swap."""
+        with self._lock:
+            parked = self._held.pop(link, None)
+        self._forward(msg, blob)
+        if parked is not None:
+            self._forward(parked[1], parked[2])
+
+    def _release_held(self, link: tuple) -> None:
+        with self._lock:
+            parked = self._held.pop(link, None)
+        if parked is not None:
+            self._forward(parked[1], parked[2])
+
+    def _forward(self, msg: dict, blob: Optional[bytes]) -> None:
+        from minips_tpu.comm.bus import deliver_post_wire
+
+        deliver_post_wire(self.bus, msg, blob)
+
+    # ----------------------------------------------------------- scheduler
+    def _schedule(self, delay_s: float, msg: dict,
+                  blob: Optional[bytes]) -> None:
+        with self._lock:
+            self._tie += 1
+            heapq.heappush(self._heap,
+                           (time.monotonic() + delay_s, self._tie, msg,
+                            blob))
+            self._cond.notify()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            now = time.monotonic()
+            due: list[tuple] = []
+            with self._lock:
+                while self._heap and self._heap[0][0] <= now:
+                    due.append(heapq.heappop(self._heap))
+                for link in [k for k, v in self._held.items()
+                             if v[0] <= now]:
+                    _, m, b = self._held.pop(link)
+                    due.append((now, self._tie + 1, m, b))
+                if not due:
+                    if not self._heap and not self._held:
+                        # fully idle: block until _schedule/park/stop
+                        # notifies — an idle 20Hz poll would tax the
+                        # oversubscribed host the drop-0 bench arm
+                        # exists to keep honest (the repair thread's
+                        # event-driven lesson, comm/reliable.py)
+                        self._cond.wait()
+                    else:
+                        cands = [v[0] for v in self._held.values()]
+                        if self._heap:
+                            cands.append(self._heap[0][0])
+                        self._cond.wait(timeout=max(
+                            min(min(cands) - now, 0.05), 0.001))
+            for _, _, m, b in due:
+                if self._stop.is_set():
+                    return
+                self._forward(m, b)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.stats)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            self._cond.notify_all()
+        self._thread.join(timeout=2.0)

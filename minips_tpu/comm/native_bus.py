@@ -21,7 +21,7 @@ import json
 import threading
 from typing import Callable, Optional
 
-from minips_tpu.comm.bus import dispatch_message
+from minips_tpu.comm.bus import deliver_frame, stop_bus_layers
 from minips_tpu.utils.native_lib import load_native_lib
 
 
@@ -211,6 +211,14 @@ class NativeControlBus:
                     head["ds"] = self._dseq[dest_rank]
                     self._dseq[dest_rank] += 1
             msg = json.dumps(head).encode()
+            rel = getattr(self, "reliable", None)
+            if rel is not None and ("bs" in head or "ds" in head):
+                # under _seq_lock like the zmq backend: journal order
+                # must equal wire order for NACK lookups to be sound
+                rel.journal_stamped(
+                    "b" if "bs" in head else "d",
+                    -1 if "bs" in head else dest_rank,
+                    head.get("bs", head.get("ds")), msg, blob)
             data = None if blob is None else bytes(blob)
             blen = -1 if blob is None else len(blob)
             try:
@@ -256,6 +264,10 @@ class NativeControlBus:
     def frames_lost(self) -> int:
         return self.loss.lost
 
+    @property
+    def frames_malformed(self) -> int:
+        return self.loss.malformed
+
     def _recv_loop(self) -> None:
         msg_p = ctypes.c_char_p()
         msg_len = ctypes.c_int64()
@@ -276,7 +288,7 @@ class NativeControlBus:
                 if blob_p:
                     self._lib.mailbox_free_buf(blob_p)
                 blob_p = ctypes.POINTER(ctypes.c_uint8)()
-            dispatch_message(self._handlers, raw, blob, loss=self.loss)
+            deliver_frame(self, raw, blob)
 
     def handshake(self, num_processes: int, timeout: float = 15.0) -> None:
         """TCP never drops post-connect, but a peer may publish before OUR
@@ -286,6 +298,7 @@ class NativeControlBus:
         run_handshake(self, num_processes, timeout)
 
     def close(self) -> None:
+        stop_bus_layers(self)  # chaos scheduler + reliable repair thread
         with self._life:
             if self._closed:
                 return

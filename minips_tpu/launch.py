@@ -27,9 +27,57 @@ import os
 import signal
 import subprocess
 import sys
+import time
 from typing import Optional
 
 _LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
+
+
+def find_free_base_port(span: int, *, tries: int = 128,
+                        extra_offsets: tuple = (1000,)) -> int:
+    """A base port such that ``base .. base+span-1`` are all bindable
+    RIGHT NOW, chosen by asking the OS instead of hand-maintained bump
+    lists (the cross-test port-collision flake class: every multiproc
+    test file kept its own ``_PORT = [...]`` counter, and two files
+    landing on overlapping ranges — or a straggler process from the
+    previous test still holding its socket — produced bind failures or,
+    worse, frames from a stale run).
+
+    The check binds each port on the wildcard interface (what the bus's
+    ``tcp://*:port`` bind uses) and releases it, so a small TOCTOU
+    window remains — but the randomized base makes two concurrent
+    pickers collide with probability ~span/36000 instead of always, and
+    a straggler's held port now FAILS the probe instead of silently
+    swallowing frames.
+
+    ``extra_offsets`` probes derived ports too: ``child_env`` hands out
+    ``base_port + 1000`` as the jax.distributed coordinator
+    (MINIPS_COORDINATOR), so a base whose +1000 neighbor is taken would
+    reintroduce the multihost flavor of the very flake this kills."""
+    import random
+    import socket
+
+    rng = random.Random((os.getpid() << 16) ^ time.monotonic_ns())
+    ports = list(range(span)) + list(extra_offsets)  # +1000 = coordinator
+    for _ in range(tries):
+        base = rng.randrange(20000, 60000 - span - max(extra_offsets,
+                                                       default=0))
+        socks = []
+        try:
+            for p in ports:
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.bind(("", base + p))
+                socks.append(s)
+        except OSError:
+            continue
+        else:
+            return base
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(
+        f"find_free_base_port: no free {span}-port block after "
+        f"{tries} tries")
 
 
 def read_hostfile(path: str) -> list[str]:
@@ -115,7 +163,6 @@ def wait(procs: list[subprocess.Popen], timeout: Optional[float] = None,
          kill_on_failure: bool = True) -> int:
     """Join all; on first nonzero exit (optionally) terminate the rest and
     return that code. Returns 0 when everyone exited clean."""
-    import time
     deadline = None if timeout is None else time.monotonic() + timeout
     live = list(procs)
     rc = 0
@@ -263,7 +310,8 @@ def _spawn_rank(argv: list[str], env: dict, outfile):
                             stderr=subprocess.STDOUT)
 
 
-def run_local_job(n: int, argv: list[str], *, base_port: int,
+def run_local_job(n: int, argv: list[str], *,
+                  base_port: Optional[int] = None,
                   env_extra: Optional[dict] = None,
                   timeout: float = 240.0) -> list[dict]:
     """Spawn ``n`` local ranks of ``argv`` over loopback, wait, and harvest
@@ -271,10 +319,13 @@ def run_local_job(n: int, argv: list[str], *, base_port: int,
     worker prints one result dict). Raises with the worker's captured
     output if a rank produced no JSON or the job failed — shared by
     tests/test_distributed_smoke.py and bench_ssp.py so the spawn/harvest
-    protocol lives in one place."""
+    protocol lives in one place. ``base_port=None`` (the default) asks
+    the OS for a free block via :func:`find_free_base_port`."""
     import json
     import tempfile
 
+    if base_port is None:
+        base_port = find_free_base_port(n)
     hosts = ["localhost"] * n
     outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
     procs = []
@@ -326,7 +377,8 @@ def run_local_job(n: int, argv: list[str], *, base_port: int,
     return results
 
 
-def run_local_job_raw(n: int, argv: list[str], *, base_port: int,
+def run_local_job_raw(n: int, argv: list[str], *,
+                      base_port: Optional[int] = None,
                       env_extra: Optional[dict] = None,
                       timeout: float = 240.0,
                       kill_on_failure: bool = False):
@@ -335,10 +387,13 @@ def run_local_job_raw(n: int, argv: list[str], *, base_port: int,
     (which asserts success and returns only result lines). Returns
     ``(rc, events)`` with ``events[rank]`` the rank's parsed JSON lines.
     ``kill_on_failure=False`` by default: kill drills need survivors to
-    detect a death THEMSELVES, not be mercy-killed by the launcher."""
+    detect a death THEMSELVES, not be mercy-killed by the launcher.
+    ``base_port=None`` auto-picks a free block (find_free_base_port)."""
     import json
     import tempfile
 
+    if base_port is None:
+        base_port = find_free_base_port(n)
     hosts = ["localhost"] * n
     outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
     procs = []

@@ -112,6 +112,22 @@ ack latency) runs through ``utils/timing.CommTimers`` — which now also
 carries rows-requested vs rows-over-wire and cache hit/lookup counts
 into the done lines; wire bytes both directions count ACTUAL bytes on
 the wire (compressed when compressed).
+
+WIRE LOSS (this PR): everything above assumes frames arrive, and one
+dropped frame anywhere — a pull reply, a push ack, a clock broadcast —
+used to cost a deadline poison or a gate stall misread as death. With
+``MINIPS_RELIABLE=1`` the bus installs the retransmission protocol
+(comm/reliable.py): per-link send journals, receiver gap detection
+soliciting NACK/retransmit with backoff under a retry budget, and
+deliver-once in-order sequencing — so a lost pull reply or push ack
+retransmits (milliseconds) long before the deadline poison fires, a
+duplicated/retransmitted push frame is never applied twice (the summed
+rows land exactly once — the row cache's write-through depends on it),
+and clock gossip stays monotone (ClockGossip max-merges besides). Retry
+exhaustion and heartbeat-confirmed death still poison through every
+path below, unchanged: loss degrades to latency, never to silence.
+Drills are seeded + deterministic via ``MINIPS_CHAOS`` (comm/chaos.py);
+the whole ladder: docs/fault_tolerance.md.
 """
 
 from __future__ import annotations
@@ -1816,8 +1832,31 @@ class ShardedPSTrainer:
     def wire_frames_lost(self) -> int:
         """Bus-level frames provably lost on established streams (zmq HWM
         drops / torn link tails — comm/bus.py FrameLossTracker). Disjoint
-        from frames_dropped (frames that ARRIVED but were rejected)."""
+        from frames_dropped (frames that ARRIVED but were rejected). With
+        the reliable channel on (comm/reliable.py) this is UNRECOVERED
+        loss only — a retransmitted frame that landed never counts."""
         return getattr(self.bus, "frames_lost", 0)
+
+    @property
+    def wire_frames_malformed(self) -> int:
+        """Undecodable control frames dropped at receive — counted, not
+        silently swallowed (comm/bus.py dispatch_message); nonzero means
+        a stale run's tail or genuine wire corruption."""
+        return getattr(self.bus, "frames_malformed", 0)
+
+    def reliable_stats(self) -> Optional[dict]:
+        """Retransmission-protocol counters (comm/reliable.py snapshot):
+        None when the channel is off, so scrapers can tell 'off' from
+        'clean'. nacks/retransmits > 0 with frames_lost == 0 is the
+        layer working as designed — loss became latency."""
+        rel = getattr(self.bus, "reliable", None)
+        return rel.snapshot() if rel is not None else None
+
+    def chaos_stats(self) -> Optional[dict]:
+        """Fault-injection counters (comm/chaos.py) when a chaos drill
+        is armed; None in production runs."""
+        ch = getattr(self.bus, "chaos", None)
+        return ch.snapshot() if ch is not None else None
 
     def drop_detail(self) -> dict:
         out = {"malformed": 0, "misrouted": 0, "config": 0}

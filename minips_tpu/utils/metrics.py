@@ -56,8 +56,17 @@ def wire_record(trainer) -> dict:
         "bytes_pulled": trainer.bytes_pulled,
         "frames_dropped": trainer.frames_dropped,
         "wire_frames_lost": trainer.wire_frames_lost,
+        # torn/undecodable frames, counted instead of silently swallowed
+        # (comm/bus.py dispatch_message) — nonzero means a stale run's
+        # tail or real wire corruption, next to the loss counter on
+        # purpose: both are wire-health signals the done line must carry
+        "wire_frames_malformed": trainer.wire_frames_malformed,
         "timing": trainer.comm_timing(),
         # row-cache counters (train/sharded_ps.RowCache): None when every
         # table runs cache-off, so scrapers can tell "off" from "cold"
         "cache": trainer.cache_stats(),
+        # retransmission-protocol + fault-injection counters: None when
+        # the respective layer is off ('off' vs 'clean' distinguishable)
+        "reliable": trainer.reliable_stats(),
+        "chaos": trainer.chaos_stats(),
     }

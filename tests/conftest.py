@@ -32,6 +32,34 @@ enable_compile_cache()
 import pytest  # noqa: E402
 
 
+def mk_loopback_buses(n, backend="zmq", settle=0.25, **bus_kw):
+    """Threads-as-nodes loopback buses on an OS-assigned free port block
+    — THE bus-construction helper for every bus-level test file (five
+    hand-copied variants drifted apart before it lived here). Extra
+    ``bus_kw`` reach ``make_bus`` (e.g. ``chaos=``/``reliable=``)."""
+    import time
+
+    from minips_tpu.comm.bus import make_bus
+    from minips_tpu.launch import find_free_base_port
+
+    if backend == "native":
+        # probed here, not at import: collection must not trigger the
+        # lazy `make -C cpp` build for runs that deselect native tests
+        from minips_tpu.comm.native_bus import NativeControlBus
+
+        if not NativeControlBus.available():
+            pytest.skip("native mailbox unavailable")
+    base = find_free_base_port(n)
+    addrs = [f"tcp://127.0.0.1:{base + i}" for i in range(n)]
+    buses = [make_bus(addrs[i], [a for j, a in enumerate(addrs) if j != i],
+                      my_id=i, backend=backend, **bus_kw)
+             for i in range(n)]
+    for b in buses:
+        b.start()
+    time.sleep(settle)  # PUB/SUB slow-joiner settle
+    return buses
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from minips_tpu.parallel.mesh import make_mesh

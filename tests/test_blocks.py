@@ -105,19 +105,14 @@ def test_iter_block_batches_static_shapes_across_blocks(tmp_path):
     assert len(tail["y"]) == 6
 
 
-def _mk_buses(n, base_port):
-    from minips_tpu.comm.bus import ControlBus
-    addrs = [f"tcp://127.0.0.1:{base_port + i}" for i in range(n)]
-    buses = [ControlBus(addrs[i], [a for j, a in enumerate(addrs) if j != i],
-                        my_id=i) for i in range(n)]
-    for b in buses:
-        b.start()
-    time.sleep(0.2)  # PUB/SUB slow-joiner settle
-    return buses
+def _mk_buses(n):
+    from tests.conftest import mk_loopback_buses
+
+    return mk_loopback_buses(n)
 
 
 def test_block_master_client_over_bus():
-    buses = _mk_buses(3, 15880)
+    buses = _mk_buses(3)
     try:
         master = BlockMaster(buses[0], split_rows(120, 10))  # 12 blocks
         clients = [BlockClient(buses[0], local_master=master),
@@ -146,7 +141,7 @@ def test_block_master_client_over_bus():
 
 
 def test_block_master_requeues_on_failure():
-    buses = _mk_buses(2, 15920)
+    buses = _mk_buses(2)
     try:
         master = BlockMaster(buses[0], split_rows(20, 10))  # blocks 0, 1
         remote = BlockClient(buses[1])
@@ -199,7 +194,7 @@ def test_master_reserves_duplicate_request_idempotently():
 
 
 def test_client_retries_until_answered():
-    buses = _mk_buses(2, 15970)
+    buses = _mk_buses(2)
     try:
         client = BlockClient(buses[1], timeout=10.0, retry_every=0.2)
         # master comes up LATE — first request frames are lost to the void
@@ -221,7 +216,7 @@ def test_client_retries_until_answered():
 
 
 def test_client_timeout_without_master():
-    buses = _mk_buses(2, 15950)
+    buses = _mk_buses(2)
     try:
         client = BlockClient(buses[1], timeout=0.3)  # nobody serves blk_req
         with pytest.raises(TimeoutError):

@@ -20,7 +20,6 @@ from minips_tpu.data import synthetic
 from minips_tpu.data.libsvm import write_libsvm
 
 APP = "minips_tpu.apps.ssp_lr_example"
-_PORT = [6400]
 
 
 @pytest.fixture(scope="module")
@@ -33,12 +32,12 @@ def libsvm_file(tmp_path_factory):
 
 
 def _run(n, extra, timeout=240.0, kill_on_failure=False):
-    _PORT[0] += n + 3
+    base_port = launch.find_free_base_port(n)
     hosts = ["localhost"] * n
     outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
     procs = []
     for rank in range(n):
-        env = launch.child_env(rank, hosts, _PORT[0])
+        env = launch.child_env(rank, hosts, base_port)
         env.update({"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"})
         procs.append(subprocess.Popen(
             [sys.executable, "-m", APP] + extra,

@@ -1,0 +1,667 @@
+"""Reliable delivery + deterministic chaos injection (comm/chaos.py,
+comm/reliable.py) — this PR's tentpole.
+
+Three layers of drill:
+
+- pure-logic protocol tests driving ReliableChannel against fake buses
+  with an injectable clock (no sockets, no threads): gap → NACK →
+  retransmit, retry budget exhaustion, journal eviction (``__rl_gone``),
+  deliver-once dedup, trailing-loss top adverts — plus hypothesis
+  property tests that under ARBITRARY drop/dup/delay schedules the
+  channel delivers every frame exactly once in per-link order;
+- the ``chaos_smoke`` tier: real loopback zmq buses with the seeded
+  injector armed — exactly-once in-order delivery with zero unrecovered
+  loss where the bare bus (retransmit off) measurably loses frames; an
+  in-proc 2-rank SSP trainer run whose skew bound and replica agreement
+  survive chaos; and a BSP run that is BITWISE-equal with chaos on vs
+  off;
+- the slow tier: the acceptance drill — a real 3-process sharded-PS SSP
+  launcher run under seeded 1% drop completes with zero poisons and
+  converging loss with retransmit ON, and dies through the existing
+  poison path with retransmit OFF, same schedule.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu import launch
+from minips_tpu.comm.bus import FrameLossTracker, make_bus
+from minips_tpu.comm.chaos import ChaosBus, ChaosSpec
+from minips_tpu.comm.reliable import (GONE_KIND, NACK_KIND, RT_KIND,
+                                      ReliableChannel)
+from minips_tpu.train.sharded_ps import ShardedPSTrainer, ShardedTable
+
+
+# ------------------------------------------------------------ spec parsing
+def test_chaos_spec_parses_rates_and_params():
+    s = ChaosSpec.parse("123:drop=0.01,dup=0.005,delay=0.1,delay_ms=7,"
+                        "reorder=0.02,reorder_ms=33")
+    assert s.seed == 123
+    assert s.rate("drop", "psP:t", 1) == 0.01
+    assert s.rate("dup", "clock", 0) == 0.005
+    assert s.delay_ms == 7 and s.reorder_ms == 33
+    assert s.active()
+    # bare seed = armed but silent (the bench's drop-0 control arm)
+    s0 = ChaosSpec.parse("99")
+    assert s0.seed == 99 and not s0.active()
+    assert s0.rate("drop", "x", 0) == 0.0
+
+
+def test_chaos_spec_specificity_most_specific_wins():
+    s = ChaosSpec.parse("7:drop=0.01,drop@psr=0.5,drop#2=0.2,"
+                        "drop@psr#2=0.9")
+    assert s.rate("drop", "clock", 0) == 0.01      # global
+    assert s.rate("drop", "psr:t", 0) == 0.5       # kind prefix
+    assert s.rate("drop", "clock", 2) == 0.2       # per-link
+    assert s.rate("drop", "psr:t", 2) == 0.9       # kind + link
+    # longer kind prefixes beat shorter ones
+    s2 = ChaosSpec.parse("7:drop@ps=0.1,drop@psr=0.4")
+    assert s2.rate("drop", "psr:t", 0) == 0.4
+    assert s2.rate("drop", "psP:t", 0) == 0.1
+
+
+def test_chaos_spec_rejects_garbage():
+    with pytest.raises(ValueError, match="seed"):
+        ChaosSpec.parse("notanint:drop=0.1")
+    with pytest.raises(ValueError, match="unknown chaos op"):
+        ChaosSpec.parse("1:explode=0.5")
+    with pytest.raises(ValueError, match="outside"):
+        ChaosSpec.parse("1:drop=1.5")
+
+
+def test_chaos_decisions_are_pure_functions_of_frame_identity():
+    """The same (seed, receiver, sender, stream, seq, op) always draws
+    the same fate — reproducibility does not depend on arrival order or
+    RNG consumption."""
+
+    class _Stub:
+        my_id = 1
+
+    cb = ChaosBus.__new__(ChaosBus)
+    cb.bus = _Stub()
+    cb.spec = ChaosSpec.parse("42:drop=0.5")
+    draws = [cb._u("drop", 0, "d", s) for s in range(64)]
+    assert draws == [cb._u("drop", 0, "d", s) for s in range(64)]
+    assert all(0.0 <= u < 1.0 for u in draws)
+    # different seeds decorrelate
+    cb2 = ChaosBus.__new__(ChaosBus)
+    cb2.bus = _Stub()
+    cb2.spec = ChaosSpec.parse("43:drop=0.5")
+    assert [cb2._u("drop", 0, "d", s) for s in range(64)] != draws
+
+
+# ------------------------------------------- protocol logic (fake buses)
+class _FakeBus:
+    """Just enough bus for ReliableChannel: handlers, loss tracker, and
+    a sent-frame log the test routes by hand."""
+
+    def __init__(self, my_id: int):
+        self.my_id = my_id
+        self._handlers: dict = {}
+        self.loss = FrameLossTracker()
+        self.sent: list = []
+        self._bseq = 0
+        self._dseq = ()
+
+    def on(self, kind, handler):
+        self._handlers[kind] = handler
+
+    def send(self, dest, kind, payload, blob=None):
+        self.sent.append((dest, kind, payload, blob))
+
+    def publish(self, kind, payload, blob=None):
+        self.sent.append((-1, kind, payload, blob))
+
+
+def _mk_pair(clk, **kw):
+    """(sender_ch, recv_ch, sender_bus, recv_bus) with a shared fake
+    clock and no repair threads — the test pumps by hand."""
+    tx_bus, rx_bus = _FakeBus(0), _FakeBus(1)
+    tx = ReliableChannel(tx_bus, clock=lambda: clk[0],
+                         start_thread=False, **kw)
+    rx = ReliableChannel(rx_bus, clock=lambda: clk[0],
+                         start_thread=False, **kw)
+    return tx, rx, tx_bus, rx_bus
+
+
+def _stamped(i: int, sender: int = 0) -> tuple[dict, bytes]:
+    head = {"kind": "x", "sender": sender, "payload": {"i": i}, "ds": i}
+    return head, json.dumps(head).encode()
+
+
+def _route(tx, rx, tx_bus, rx_bus, clk, rounds: int = 64) -> None:
+    """Pump the receiver's repair pass and hand-route NACK/RT/GONE
+    frames between the two fake buses until gaps settle."""
+    for _ in range(rounds):
+        clk[0] += 0.1
+        rx.pump(clk[0])
+        for _dest, kind, payload, _blob in rx_bus.sent:
+            if kind == NACK_KIND:
+                tx._on_nack(rx_bus.my_id, payload)
+        rx_bus.sent.clear()
+        for _dest, kind, payload, blob in tx_bus.sent:
+            if kind == RT_KIND:
+                p = dict(payload)
+                if blob is not None:
+                    p["__blob__"] = blob
+                rx._on_rt(tx_bus.my_id, p)
+            elif kind == GONE_KIND:
+                rx._on_gone(tx_bus.my_id, payload)
+        tx_bus.sent.clear()
+        if rx.outstanding_gaps() == 0:
+            return
+
+
+def _got(rx_bus) -> list:
+    out = []
+    rx_bus.on("x", lambda s, p: out.append(p["i"]))
+    return out
+
+
+def test_gap_nack_retransmit_recovers_in_order():
+    clk = [0.0]
+    tx, rx, tx_bus, rx_bus = _mk_pair(clk)
+    got = _got(rx_bus)
+    frames = [_stamped(i) for i in range(6)]
+    for _h, m in frames:
+        tx.journal_stamped("d", 1, json.loads(m)["ds"], m, None)
+    # deliver 0, 1, skip 2 and 3 (the wire ate them), deliver 4, 5
+    for i in (0, 1, 4, 5):
+        rx.on_stamped(frames[i][0], None)
+    assert got == [0, 1]               # in-order: 4, 5 buffered
+    assert rx.outstanding_gaps() == 2
+    _route(tx, rx, tx_bus, rx_bus, clk)
+    assert got == [0, 1, 2, 3, 4, 5]   # recovered, exactly once, ordered
+    assert rx_bus.loss.lost == 0       # no unrecovered loss
+    assert rx.stats["recovered"] == 2
+    assert tx.stats["retransmits_sent"] == 2
+
+
+def test_duplicates_and_retransmit_races_deliver_once():
+    """A chaos-duplicated frame, and a retransmit racing its late
+    original, must both apply exactly once — the property the summed-row
+    push wire and clock monotonicity depend on."""
+    clk = [0.0]
+    _tx, rx, _tx_bus, rx_bus = _mk_pair(clk)
+    got = _got(rx_bus)
+    f = [_stamped(i) for i in range(3)]
+    rx.on_stamped(f[0][0], None)
+    rx.on_stamped(f[0][0], None)       # dup of delivered
+    rx.on_stamped(f[2][0], None)       # 1 missing -> buffered
+    rx.on_stamped(f[2][0], None)       # dup of buffered
+    rx.on_stamped(f[1][0], None)       # gap fills
+    rx.on_stamped(f[1][0], None)       # retransmit raced the original
+    assert got == [0, 1, 2]
+    assert rx.stats["dups_dropped"] == 3
+
+
+def test_budget_exhaustion_gives_up_loudly_and_advances():
+    """Retry exhaustion converts the gap to a counted loss (the seq jump
+    lands in FrameLossTracker) and delivery continues in order — loss
+    degrades the stream, never wedges it."""
+    clk = [0.0]
+    tx, rx, tx_bus, rx_bus = _mk_pair(clk, retry_budget=3)
+    got = _got(rx_bus)
+    f = [_stamped(i) for i in range(4)]
+    # journal holds NOTHING (sender restarted, say): NACKs go nowhere
+    rx.on_stamped(f[0][0], None)
+    rx.on_stamped(f[2][0], None)
+    rx.on_stamped(f[3][0], None)
+    for _ in range(64):                 # pump without routing: NACK void
+        clk[0] += 0.5
+        rx.pump(clk[0])
+        rx_bus.sent.clear()
+        if rx.outstanding_gaps() == 0:
+            break
+    assert got == [0, 2, 3]             # advanced past the hole
+    assert rx.stats["gave_up"] == 1
+    assert rx_bus.loss.lost == 1        # counted, not silent
+    assert tx_bus.sent == []
+
+
+def test_journal_eviction_answers_gone_and_receiver_skips():
+    clk = [0.0]
+    tx, rx, tx_bus, rx_bus = _mk_pair(clk, journal_frames=2)
+    got = _got(rx_bus)
+    frames = [_stamped(i) for i in range(5)]
+    for _h, m in frames:                # ring keeps only seqs 3, 4
+        tx.journal_stamped("d", 1, json.loads(m)["ds"], m, None)
+    rx.on_stamped(frames[4][0], None)   # 0..3 missing
+    _route(tx, rx, tx_bus, rx_bus, clk)
+    assert got == [3, 4]                # 3 recovered; 0..2 gone -> skip
+    assert rx_bus.loss.lost == 3
+    assert tx.stats["gone_sent"] == 3
+
+
+def test_top_advert_reveals_trailing_loss():
+    """A dropped FINAL frame has no successor to expose the gap — the
+    sender's periodic ``__rl_top`` advert opens it."""
+    clk = [0.0]
+    tx, rx, tx_bus, rx_bus = _mk_pair(clk)
+    got = _got(rx_bus)
+    frames = [_stamped(i) for i in range(3)]
+    for _h, m in frames:
+        tx.journal_stamped("d", 1, json.loads(m)["ds"], m, None)
+    rx.on_stamped(frames[0][0], None)   # 1 and 2 vanish, nothing follows
+    assert rx.outstanding_gaps() == 0   # invisible without the advert
+    rx._on_top(0, {"b": 0, "d": {"1": 3}})
+    assert rx.outstanding_gaps() == 2
+    _route(tx, rx, tx_bus, rx_bus, clk)
+    assert got == [0, 1, 2]
+    assert rx_bus.loss.lost == 0
+
+
+def test_gone_seqs_stay_given_up_and_are_not_renacked():
+    """Review regression: a seq the sender declared GONE must not be
+    re-opened as a gap by a later arriving frame — re-NACK/re-GONE loops
+    and double-counted ``gave_up`` inflated the published recovery
+    counters during exactly the episodes the layer should quiet."""
+    clk = [0.0]
+    _tx, rx, _tx_bus, rx_bus = _mk_pair(clk)
+    got = _got(rx_bus)
+    f = [_stamped(i) for i in range(9)]
+    rx.on_stamped(f[0][0], None)
+    rx.on_stamped(f[6][0], None)        # gaps 1..5
+    rx._on_gone(0, {"s": "d", "seqs": [1, 2, 3, 4, 5]})
+    assert rx.stats["gave_up"] == 5
+    assert got == [0, 6]                # advanced past the gone range
+    rx.on_stamped(f[8][0], None)        # later frame: gap for 7 only
+    assert rx.outstanding_gaps() == 1
+    assert rx.stats["gave_up"] == 5     # gone seqs NOT re-counted
+    rx.on_stamped(f[7][0], None)
+    assert got == [0, 6, 7, 8]
+
+
+def test_pathological_seq_jump_does_not_materialize_gap_per_seq():
+    """Review regression: a stale-run/corrupt frame carrying a huge seq
+    must cost O(cap), not O(jump) — neither the loss tracker nor the
+    sequencer may build an entry per missing seq under the receive
+    thread's lock."""
+    t = FrameLossTracker()
+    t.observe(3, "b", 0)
+    t0 = time.perf_counter()
+    t.observe(3, "b", 50_000_000)       # would be ~GBs at 1 entry/seq
+    assert time.perf_counter() - t0 < 1.0
+    assert t.lost == 49_999_999         # O(1) accounting unchanged
+    assert len(t._gaps[(3, "b")]) == t.GAP_CAP
+
+    clk = [0.0]
+    _tx, rx, _tx_bus, rx_bus = _mk_pair(clk)
+    got = _got(rx_bus)
+    frames = [_stamped(0), _stamped(50_000_000),
+              _stamped(50_000_001)]
+    rx.on_stamped(frames[0][0], None)
+    t0 = time.perf_counter()
+    rx.on_stamped(frames[1][0], None)   # resync, not per-seq gaps
+    assert time.perf_counter() - t0 < 1.0
+    assert rx.outstanding_gaps() <= rx.buffer_cap
+    rx.on_stamped(frames[2][0], None)
+    # the stream stays live: give up the materialized tail and the new
+    # frames deliver in order
+    for _ in range(600):
+        clk[0] += 1.0
+        rx.pump(clk[0])
+        rx_bus.sent.clear()
+        if rx.outstanding_gaps() == 0:
+            break
+    assert got[0] == 0 and got[-2:] == [50_000_000, 50_000_001]
+
+
+def test_wide_gap_burst_never_burns_budget_without_a_nack():
+    """Review regression: a pump pass NACKs at most _NACK_BATCH seqs —
+    seqs beyond the batch must stay due with their budget UNTOUCHED (a
+    try charged for a NACK never sent would exhaust wide bursts
+    unasked), draining batch-by-batch across passes until every
+    journal-repairable frame is recovered."""
+    from minips_tpu.comm.reliable import _NACK_BATCH
+
+    clk = [0.0]
+    tx, rx, tx_bus, rx_bus = _mk_pair(clk, retry_budget=2)
+    got = _got(rx_bus)
+    n = _NACK_BATCH + 300                  # wider than one NACK frame
+    frames = [_stamped(i) for i in range(n + 1)]
+    for _h, m in frames:
+        tx.journal_stamped("d", 1, json.loads(m)["ds"], m, None)
+    rx.on_stamped(frames[n][0], None)      # everything below missing
+    clk[0] += 1.0
+    rx.pump(clk[0])                        # one pass: ONE batched NACK
+    nacked = [f for f in rx_bus.sent if f[1] == NACK_KIND]
+    assert len(nacked) == 1
+    assert len(nacked[0][2]["seqs"]) == _NACK_BATCH
+    # un-asked seqs still hold their full budget (tries == 0)
+    with rx._lock:
+        untried = sum(1 for s, g in rx._rx[(0, "d")].gaps.items()
+                      if g.tries == 0)
+    assert untried == n - _NACK_BATCH
+    rx_bus.sent.clear()
+    _route(tx, rx, tx_bus, rx_bus, clk, rounds=16)  # batches drain
+    assert got == list(range(n + 1))       # all recovered despite budget=2
+    assert rx.stats["gave_up"] == 0
+
+
+def test_top_advert_refreshes_after_loss_window():
+    """The advert itself can be lost; with traffic stopped, unchanged
+    tops must still re-advertise at a slow cadence or a trailing gap
+    stays invisible until a deadline poison."""
+    clk = [100.0]
+    bus = _FakeBus(0)
+    ch = ReliableChannel(bus, clock=lambda: clk[0], start_thread=False)
+    bus._bseq = 7                       # traffic happened
+    ch.pump(clk[0])
+    adverts = [f for f in bus.sent if f[1] == "__rl_top"]
+    assert len(adverts) == 1 and adverts[0][2]["b"] == 7
+    clk[0] += ch.advert_s + 0.01        # tops unchanged, inside window
+    ch.pump(clk[0])
+    assert len([f for f in bus.sent if f[1] == "__rl_top"]) == 1
+    clk[0] += 10 * ch.advert_s + 0.01   # past the refresh window
+    ch.pump(clk[0])
+    assert len([f for f in bus.sent if f[1] == "__rl_top"]) == 2
+
+
+def test_reliable_channel_property_exactly_once_in_order():
+    """Property: for ANY seeded schedule of drops, duplicates, and
+    delays over the wire, the channel delivers every frame exactly once
+    in per-link order with zero unrecovered loss (journal large enough
+    to cover everything — the bounded-journal failure mode has its own
+    test above)."""
+    pytest.importorskip("hypothesis", reason="property test needs "
+                        "hypothesis (pip install -e .[test])")
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    @settings(max_examples=120, deadline=None)
+    @given(st.lists(
+        st.tuples(st.booleans(),                    # dropped on the wire
+                  st.booleans(),                    # duplicated
+                  st.integers(min_value=0, max_value=5)),  # delay slots
+        min_size=1, max_size=48))
+    def prop(schedule):
+        clk = [0.0]
+        tx, rx, tx_bus, rx_bus = _mk_pair(clk)
+        got = _got(rx_bus)
+        n = len(schedule)
+        frames = [_stamped(i) for i in range(n)]
+        for _h, m in frames:
+            tx.journal_stamped("d", 1, json.loads(m)["ds"], m, None)
+        arrivals: list[tuple[int, int]] = []  # (slot, seq), stable sort
+        for i, (dropped, dup, delay) in enumerate(schedule):
+            if not dropped:
+                arrivals.append((i + delay, i))
+            if dup:
+                arrivals.append((i + delay + 2, i))
+        arrivals.sort(key=lambda t: t[0])
+        for _slot, i in arrivals:
+            rx.on_stamped(frames[i][0], None)
+        rx._on_top(0, {"b": 0, "d": {"1": n}})  # reveal trailing drops
+        _route(tx, rx, tx_bus, rx_bus, clk, rounds=128)
+        assert got == list(range(n))
+        assert rx_bus.loss.lost == 0
+        assert rx.outstanding_gaps() == 0
+
+    prop()
+
+
+# --------------------------------------------- chaos_smoke: real buses
+def _mk_chaos_buses(n, chaos="", reliable=""):
+    from tests.conftest import mk_loopback_buses
+
+    return mk_loopback_buses(n, chaos=chaos, reliable=reliable)
+
+
+CHAOS_SMOKE_SPEC = "424242:drop=0.05,dup=0.02,reorder=0.03,delay=0.02," \
+                   "delay_ms=10"
+
+
+def test_chaos_smoke_reliable_delivers_exactly_once_in_order():
+    """The fast-tier chaos smoke: seeded drop/dup/reorder on a real zmq
+    wire, retransmit on — every frame lands exactly once, in per-link
+    order, with zero unrecovered loss, and the counters prove the layer
+    (not luck) did it."""
+    buses = _mk_chaos_buses(2, chaos=CHAOS_SMOKE_SPEC, reliable="1")
+    got, gob = [], []
+    buses[1].on("x", lambda s, p: got.append(p["i"]))
+    buses[1].on("xb", lambda s, p: gob.append(p["i"]))
+    try:
+        n = 300
+        for i in range(n):
+            buses[0].send(1, "x", {"i": i})
+            if i % 3 == 0:
+                buses[0].publish("xb", {"i": i})
+        nb = len(range(0, n, 3))
+        deadline = time.time() + 30
+        while (len(got) < n or len(gob) < nb) and time.time() < deadline:
+            time.sleep(0.02)
+        assert got == list(range(n)), (len(got), got[:10])
+        assert gob == list(range(0, n, 3)), len(gob)
+        assert buses[1].frames_lost == 0
+        ch = buses[1].chaos.snapshot()
+        rl = buses[1].reliable.snapshot()
+        assert ch["dropped"] > 0, ch          # chaos really dropped...
+        assert rl["retransmits_got"] > 0, rl  # ...and recovery carried it
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_chaos_without_retransmit_loses_frames_loudly():
+    """The before/after pinned at bus level: the SAME chaos schedule
+    with the reliable channel OFF loses frames — counted in frames_lost
+    (the seed's honest accounting), not silently."""
+    buses = _mk_chaos_buses(2, chaos=CHAOS_SMOKE_SPEC, reliable="")
+    got = []
+    buses[1].on("x", lambda s, p: got.append(p["i"]))
+    try:
+        n = 300
+        for i in range(n):
+            buses[0].send(1, "x", {"i": i})
+        deadline = time.time() + 10
+        last = -1
+        while time.time() < deadline:
+            time.sleep(0.3)
+            if len(got) == last:
+                break
+            last = len(got)
+        assert len(got) < n                  # drops really lost frames
+        assert buses[1].frames_lost > 0      # ...and were counted
+        assert buses[1].chaos.snapshot()["dropped"] > 0
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_chaos_drops_are_deterministic_across_runs():
+    """Same spec + same frame stream ⇒ the SAME frames get dropped —
+    the reproducibility claim that makes chaos schedules unit-testable."""
+    def run():
+        buses = _mk_chaos_buses(2, chaos="77:drop=0.1", reliable="")
+        got = []
+        buses[1].on("x", lambda s, p: got.append(p["i"]))
+        try:
+            for i in range(200):
+                buses[0].send(1, "x", {"i": i})
+            deadline = time.time() + 10
+            last = -1
+            while time.time() < deadline:
+                time.sleep(0.25)
+                if len(got) == last:
+                    break
+                last = len(got)
+            return list(got), buses[1].chaos.snapshot()["dropped"]
+        finally:
+            for b in buses:
+                b.close()
+
+    got1, d1 = run()
+    got2, d2 = run()
+    assert d1 > 0
+    assert (got1, d1) == (got2, d2)
+
+
+# ----------------------------------- chaos_smoke: in-proc sharded PS
+def test_ssp_trainer_survives_chaos_with_bounds_intact():
+    """2-rank in-proc SSP run under seeded chaos with retransmit on:
+    completes with zero poisons, zero unrecovered frames, the s+1
+    transient skew bound intact, and exact replica agreement after
+    finalize — loss became latency, not corruption."""
+    staleness = 1
+    buses = _mk_chaos_buses(2, chaos="2024:drop=0.03,dup=0.01,"
+                            "reorder=0.02", reliable="1")
+    tables = [ShardedTable("t", 64, 4, buses[i], i, 2, updater="sgd",
+                           lr=0.1, pull_timeout=20.0) for i in range(2)]
+    trainers = [ShardedPSTrainer({"t": tables[i]}, buses[i], 2,
+                                 staleness=staleness, gate_timeout=30.0)
+                for i in range(2)]
+    finals: list = [None, None]
+    errs: list = []
+
+    def worker(r):
+        try:
+            rng = np.random.default_rng(r)
+            for _ in range(12):
+                keys = rng.integers(0, 64, size=16)
+                rows = tables[r].pull(keys)
+                tables[r].push(keys, (0.05 * rows + 1.0) / 2.0)
+                trainers[r].tick()
+            trainers[r].finalize(timeout=30.0)
+            finals[r] = tables[r].pull_all()
+        except Exception as e:  # noqa: BLE001 - surfaced via errs
+            errs.append((r, repr(e)))
+
+    try:
+        ts = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120.0)
+        assert not any(t.is_alive() for t in ts), "chaos run wedged"
+        assert not errs, errs
+        for tr in trainers:
+            assert tr.frames_dropped == 0, tr.drop_detail()
+            assert tr.wire_frames_lost == 0
+            assert tr.max_skew_seen <= staleness + 1
+        np.testing.assert_array_equal(finals[0], finals[1])
+        dropped = sum(b.chaos.snapshot()["dropped"] for b in buses)
+        assert dropped > 0, "chaos never fired — the drill proved nothing"
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_bsp_run_is_bitwise_equal_with_chaos_on_and_off():
+    """Determinism under recovery: a BSP lockstep run produces BITWISE
+    identical final weights with chaos+retransmit on vs a clean wire —
+    deliver-once in-order recovery reconstructs the exact frame stream,
+    so not one bit of training state may differ."""
+    def run(chaos, reliable):
+        buses = _mk_chaos_buses(2, chaos=chaos, reliable=reliable)
+
+        class LockstepCons:  # shared lockstep clock vector (BSP: s = 0)
+            clocks = [0, 0]
+            staleness = 0
+
+            def __init__(self, rank):
+                self.rank = rank
+
+            @property
+            def clock(self):
+                return self.clocks[self.rank]
+
+            def admit_pull(self, clk):
+                return min(self.clocks) >= clk
+
+            def serving_clock(self, requester):
+                return min(self.clocks)
+
+        tables = [ShardedTable("t", 64, 2, buses[i], i, 2, updater="sgd",
+                               lr=0.5, pull_timeout=20.0)
+                  for i in range(2)]
+        LockstepCons.clocks = [0, 0]
+        for i, t in enumerate(tables):
+            t.bind_consistency(LockstepCons(i))
+            t._w[...] = np.arange(32 * 2, dtype=np.float32
+                                  ).reshape(32, 2) / 7.0
+        # disjoint cross-shard keys (same shape as the row-cache bitwise
+        # drill): each shard receives pushes from exactly one peer, so
+        # per-link in-order delivery fixes the apply order bit-for-bit
+        keysets = [np.array([33, 40, 33, 47]), np.array([1, 8, 1, 15])]
+        try:
+            for _ in range(4):
+                rows = [tables[r].pull(keysets[r]) for r in (0, 1)]
+                for r in (0, 1):
+                    tables[r].push(keysets[r], 0.1 * rows[r] + 1.0)
+                for r in (0, 1):  # read-your-own-writes, same frame
+                    tables[r].pull(keysets[r])
+                LockstepCons.clocks[0] += 1
+                LockstepCons.clocks[1] += 1
+            lost = [b.frames_lost for b in buses]
+            return [t._w.copy() for t in tables], lost
+        finally:
+            for b in buses:
+                b.close()
+
+    w_clean, _ = run(chaos="", reliable="")
+    w_chaos, lost = run(chaos="31337:drop=0.04,dup=0.02,reorder=0.03",
+                        reliable="1")
+    assert lost == [0, 0]
+    for off, on in zip(w_clean, w_chaos):
+        np.testing.assert_array_equal(off, on)  # bitwise, not allclose
+
+
+# ----------------------------------------------- slow tier: e2e drill
+CHAOS_E2E_SPEC = "1337:drop=0.01,dup=0.005,reorder=0.01"
+_E2E_ARGS = ["--iters", "40", "--model", "sparse", "--mode", "ssp",
+             "--staleness", "2", "--batch", "128"]
+
+
+@pytest.mark.slow
+def test_e2e_3proc_chaos_retransmit_on_completes_clean():
+    """ACCEPTANCE: 3-process sharded-PS SSP with seeded 1% frame drop,
+    retransmit on — runs to completion with zero poisons, zero
+    unrecovered frames, converging loss, replica agreement, and the
+    retransmit counters proving the layer carried it."""
+    res = launch.run_local_job(
+        3, [sys.executable, "-m", "minips_tpu.apps.sharded_ps_example"]
+        + _E2E_ARGS,
+        base_port=None,
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                   "MINIPS_CHAOS": CHAOS_E2E_SPEC, "MINIPS_RELIABLE": "1"},
+        timeout=240.0)
+    assert all(r["event"] == "done" for r in res)
+    for r in res:
+        assert r["chaos_spec"] == CHAOS_E2E_SPEC and r["reliable_on"], r
+        assert r["frames_dropped"] == 0, r
+        assert r["wire_frames_lost"] == 0, r      # recovered, all of it
+        assert r["wire_frames_malformed"] == 0, r
+        assert r["clock"] == 40, r
+        assert r["max_skew_seen"] <= 3, r         # s + 1 transient bound
+        assert r["loss_last"] < r["loss_first"], r
+    assert sum(r["chaos"]["dropped"] for r in res) > 0
+    assert sum(r["reliable"]["retransmits_got"] for r in res) > 0
+    assert sum(r["reliable"]["gave_up"] for r in res) == 0
+    sums = [r["param_sum"] for r in res]
+    assert max(sums) - min(sums) < 1e-4, sums
+
+
+@pytest.mark.slow
+def test_e2e_3proc_chaos_retransmit_off_dies_via_poison_path():
+    """ACCEPTANCE, other half: the SAME chaos schedule with retransmit
+    off dies through the EXISTING poison paths (pull/gate timeout or
+    heartbeat-confirmed peer failure) — loudly, never silently."""
+    rc, events = launch.run_local_job_raw(
+        3, [sys.executable, "-m", "minips_tpu.apps.sharded_ps_example"]
+        + _E2E_ARGS,
+        base_port=None,
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                   "MINIPS_CHAOS": CHAOS_E2E_SPEC, "MINIPS_RELIABLE": ""},
+        timeout=240.0, kill_on_failure=False)
+    assert rc != 0, events
+    flat = [e for ev in events for e in ev]
+    assert any(e.get("event") in ("gate_timeout", "peer_failure")
+               for e in flat), flat

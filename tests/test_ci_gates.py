@@ -13,8 +13,8 @@ import pytest
 REPO = pathlib.Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO / "ci"))
 
-from bench_regression import (cache_tripwires, compare, main,  # noqa: E402
-                              throughput_points)
+from bench_regression import (cache_tripwires, chaos_tripwires,  # noqa: E402
+                              compare, main, throughput_points)
 
 
 def _art(points):
@@ -98,6 +98,75 @@ def test_cache_sweep_points_count_toward_missing_detection():
     new = _cache_art({"s1": 0.2})
     problems = compare(prior, new, 0.10)
     assert any("MISSING" in p and "s2" in p for p in problems)
+
+
+def _chaos_art(clean=100.0, d0=95.0, d1=(90.0, True, 0),
+               d5=(80.0, True, 0)) -> dict:
+    """chaos_resilience_3proc artifact: drop>0 on-arms as (rate,
+    completed, unrecovered-frames); off arms carry NO throughput metric
+    (their outcome is bimodal by design — the bench strips it)."""
+    def arm(rate, completed=True, lost=0, key="rows_per_sec_per_process"):
+        return {key: rate, "completed": completed,
+                "wire_frames_lost": lost}
+
+    def lossy(rate, completed=True, lost=0):
+        # drop>0 on-arms: rate under the gate-invisible key (completion
+        # gates, never run-to-run comparisons)
+        return arm(rate, completed, lost, key="rows_per_sec_lossy")
+    return {"chaos_resilience_3proc": {
+        "clean": arm(clean), "drop0_on": arm(d0),
+        "drop1_on": lossy(*d1), "drop5_on": lossy(*d5),
+        "drop1_off": {"completed": False, "error": "died (expected)"},
+        "drop5_off": {"completed": False, "error": "died (expected)"}}}
+
+
+def test_chaos_tripwire_tax_on_clean_path_fails():
+    """The reliable layer may not tax the lossless path: drop-0 chaos
+    arm beyond slack of the clean arm fails; within slack passes."""
+    assert chaos_tripwires(_chaos_art(clean=100.0, d0=80.0)) == []
+    probs = chaos_tripwires(_chaos_art(clean=100.0, d0=70.0))
+    assert len(probs) == 1 and "CHAOS-TAX" in probs[0]
+    # a missing drop0 arm is a tax failure too, not a silent pass
+    art = _chaos_art()
+    del art["chaos_resilience_3proc"]["drop0_on"]
+    assert any("CHAOS-TAX" in p for p in chaos_tripwires(art))
+
+
+def test_chaos_tripwire_dead_or_leaky_on_arm_fails():
+    """drop>0 with retransmit ON must complete (rows/sec > 0) with zero
+    unrecovered frames — a dead or leaky arm means the delivery layer
+    quietly stopped converting loss to latency."""
+    assert chaos_tripwires(_chaos_art()) == []
+    probs = chaos_tripwires(_chaos_art(d1=(0.0, False, 0)))
+    assert len(probs) == 1 and "CHAOS-DEAD" in probs[0] \
+        and "drop1_on" in probs[0]
+    probs = chaos_tripwires(_chaos_art(d5=(80.0, True, 7)))
+    assert len(probs) == 1 and "CHAOS-LEAK" in probs[0]
+    # the retransmit-OFF twins are EXPECTED to die: never gated
+    art = _chaos_art()
+    art["chaos_resilience_3proc"]["drop5_off"]["completed"] = False
+    assert chaos_tripwires(art) == []
+
+
+def test_chaos_tripwire_vacuous_without_the_sweep():
+    assert chaos_tripwires({"metric": "m"}) == []
+
+
+def test_chaos_off_arms_never_enter_the_throughput_gate():
+    """The retransmit-off arms' outcome is bimodal BY DESIGN (death is
+    the expected measurement; survival is luck): they carry no
+    rows_per_sec_per_process in either state, so a prior where one
+    survived can never make a later honest death read as a 100%
+    regression — nor can a dead prior MISSING-fail a surviving new."""
+    art = _chaos_art()
+    pts = throughput_points(art)
+    assert not any(p.endswith(("_off", "drop1_on", "drop5_on"))
+                   for p in pts), pts
+    # survived off arm: evidence kept under a gate-invisible name
+    art["chaos_resilience_3proc"]["drop1_off"] = {
+        "completed": True, "rows_per_sec_survived": 123.0}
+    assert compare(_chaos_art(), art, 0.10) == []
+    assert compare(art, _chaos_art(), 0.10) == []
 
 
 def test_main_end_to_end_exit_codes(tmp_path):

@@ -12,18 +12,10 @@ from minips_tpu.comm.heartbeat import HeartbeatMonitor
 from minips_tpu.comm.native_bus import NativeControlBus
 
 
-def _mk_buses(n, base_port, backend="zmq"):
-    if backend == "native" and not NativeControlBus.available():
-        # probed here, not at import: collection must not trigger the
-        # lazy `make -C cpp` build for runs that deselect native tests
-        pytest.skip("native mailbox unavailable")
-    addrs = [f"tcp://127.0.0.1:{base_port + i}" for i in range(n)]
-    buses = [make_bus(addrs[i], [a for j, a in enumerate(addrs) if j != i],
-                      my_id=i, backend=backend) for i in range(n)]
-    for b in buses:
-        b.start()
-    time.sleep(0.2)  # PUB/SUB slow-joiner settle
-    return buses
+def _mk_buses(n, backend="zmq", **bus_kw):
+    from tests.conftest import mk_loopback_buses
+
+    return mk_loopback_buses(n, backend=backend, settle=0.2, **bus_kw)
 
 
 BACKENDS = ["zmq", "native"]
@@ -31,8 +23,7 @@ BACKENDS = ["zmq", "native"]
 
 @pytest.mark.parametrize("backend", BACKENDS)
 def test_bus_pubsub_roundtrip(backend):
-    buses = _mk_buses(2, 15730 if backend == "zmq" else 16730,
-                      backend=backend)
+    buses = _mk_buses(2, backend=backend)
     if backend == "native":
         assert all(isinstance(b, NativeControlBus) for b in buses)
     got = []
@@ -50,8 +41,7 @@ def test_bus_pubsub_roundtrip(backend):
 def test_bus_blob_frame(backend):
     """Binary blob rides as a second frame, surfacing at __blob__ —
     the host-relay delta path (ASP push payloads) depends on this."""
-    buses = _mk_buses(2, 15860 if backend == "zmq" else 16860,
-                      backend=backend)
+    buses = _mk_buses(2, backend=backend)
     got = []
     buses[0].on("delta", lambda s, p: got.append((s, p["step"],
                                                   p["__blob__"])))
@@ -69,7 +59,7 @@ def test_native_bus_handshake_and_ordering():
     """Per-sender FIFO over the native mailbox: TCP preserves order, the
     inbox queue preserves arrival order, so one sender's messages arrive
     in publish order."""
-    buses = _mk_buses(3, 16930, backend="native")
+    buses = _mk_buses(3, backend="native")
     try:
         import threading
 
@@ -98,8 +88,7 @@ def test_native_bus_handshake_and_ordering():
 
 @pytest.mark.parametrize("backend", BACKENDS)
 def test_clock_gossip_global_min(backend):
-    buses = _mk_buses(3, 15760 if backend == "zmq" else 16760,
-                      backend=backend)
+    buses = _mk_buses(3, backend=backend)
     gossips = [ClockGossip(b, 3, workers_per_process=2) for b in buses]
     gossips[0].publish_local([5, 6])
     gossips[1].publish_local([3, 9])
@@ -117,7 +106,7 @@ def test_clock_gossip_global_min(backend):
 
 
 def test_heartbeat_detects_dead_peer():
-    buses = _mk_buses(2, 15790)
+    buses = _mk_buses(2)
     failures = []
     fake_time = [0.0]
     mon = HeartbeatMonitor(buses[0], peer_ids=[0, 1], interval=0.05,
@@ -140,7 +129,7 @@ def test_heartbeat_detects_dead_peer():
 
 
 def test_heartbeat_live_peer_not_flagged():
-    buses = _mk_buses(2, 15820)
+    buses = _mk_buses(2)
     mons = [HeartbeatMonitor(b, peer_ids=[0, 1], interval=0.05, timeout=2.0)
             for b in buses]
     for m in mons:
@@ -158,8 +147,7 @@ def test_heartbeat_live_peer_not_flagged():
 def test_bus_directed_send_reaches_only_dest(backend):
     """send(dest, ...) delivers to exactly one peer — the reference
     Mailbox's per-id addressing, the sharded-PS routing primitive."""
-    buses = _mk_buses(3, 15900 if backend == "zmq" else 16900,
-                      backend=backend)
+    buses = _mk_buses(3, backend=backend)
     got = {i: [] for i in range(3)}
     for i, b in enumerate(buses):
         b.on("slice", lambda s, p, i=i: got[i].append((s, p["v"])))
@@ -182,8 +170,7 @@ def test_bus_directed_send_reaches_only_dest(backend):
 def test_bus_directed_then_broadcast_ordering(backend):
     """A directed frame to peer p enqueued BEFORE a broadcast must arrive
     at p first — the ordering the sharded-PS push→clock contract needs."""
-    buses = _mk_buses(2, 15910 if backend == "zmq" else 16910,
-                      backend=backend)
+    buses = _mk_buses(2, backend=backend)
     seen = []
     buses[1].on("a", lambda s, p: seen.append(("a", p["i"])))
     buses[1].on("b", lambda s, p: seen.append(("b", p["i"])))
@@ -201,6 +188,80 @@ def test_bus_directed_then_broadcast_ordering(backend):
 
 
 # ------------------------------------------------- backpressure / loss
+def test_frame_loss_tracker_reorder_reconciles_lost():
+    """A reordered/late frame is NOT lost forever: the gap it left is
+    tracked as outstanding and reconciled downward when the missing seq
+    finally arrives (retransmit or plain adjacent swap) — the honest
+    accounting the reliable layer's retransmits require."""
+    from minips_tpu.comm.bus import FrameLossTracker
+
+    t = FrameLossTracker()
+    t.observe(0, "b", 0)
+    t.observe(0, "b", 2)       # 1 missing -> provisionally lost
+    assert t.lost == 1
+    t.observe(0, "b", 1)       # ...until it shows up late
+    assert t.lost == 0 and t.dups == 0
+    t.observe(0, "b", 5)       # 3, 4 missing
+    assert t.lost == 2
+    t.observe(0, "b", 4)
+    assert t.lost == 1         # partial reconcile
+    t.observe(0, "b", 4)       # a second copy IS a duplicate
+    assert t.lost == 1 and t.dups == 1
+
+
+def test_frame_loss_tracker_dup_of_delivered_counts_dup():
+    """A duplicate of an already-delivered seq never touches ``lost`` —
+    it lands in ``dups`` (deliver-once accounting for chaos dup /
+    retransmit-raced frames)."""
+    from minips_tpu.comm.bus import FrameLossTracker
+
+    t = FrameLossTracker()
+    for s in (0, 1, 2):
+        t.observe(1, "d", s)
+    t.observe(1, "d", 1)
+    t.observe(1, "d", 0)
+    assert t.lost == 0 and t.dups == 2
+
+
+def test_dispatch_counts_malformed_frames():
+    """Satellite: a torn JSON frame is counted (frames_malformed), not
+    silently swallowed — the wire_record surfaces it next to
+    frames_lost."""
+    from minips_tpu.comm.bus import FrameLossTracker, dispatch_message
+
+    loss = FrameLossTracker()
+    dispatch_message({}, b"{torn json!!", None, loss=loss)
+    dispatch_message({}, b"\xff\xfe not utf8", None, loss=loss)
+    assert loss.malformed == 2
+    # well-formed frames don't touch the counter
+    dispatch_message({}, b'{"kind": "x", "sender": 0}', None, loss=loss)
+    assert loss.malformed == 2
+
+
+def test_clock_gossip_merge_is_monotone():
+    """A clock frame arriving LATE (wire reorder / a retransmit landing
+    after fresher gossip) must never regress the merged view — clocks
+    only advance within one bus incarnation. Pure merge logic: a stub
+    bus suffices (no sockets)."""
+
+    class _StubBus:
+        my_id = 0
+
+        def __init__(self):
+            self._handlers = {}
+
+        def on(self, kind, handler):
+            self._handlers[kind] = handler
+
+        def publish(self, kind, payload, blob=None):
+            pass
+
+    g = ClockGossip(_StubBus(), 2, workers_per_process=2)
+    g._on_clock(1, {"clocks": [5, 7]})
+    g._on_clock(1, {"clocks": [3, 9]})  # stale first slot, fresh 2nd
+    assert g.snapshot()[1] == [5, 9]    # element-wise max
+
+
 def test_frame_loss_tracker_sync_and_gaps():
     """First frame per stream only synchronizes (pre-subscription frames
     are droppable by design); gaps in an ESTABLISHED stream count."""
@@ -224,8 +285,7 @@ def test_flood_default_settings_loses_nothing(backend):
     consumer must not lose frames at default settings — zmq's 65536 HWM
     absorbs the burst; the native bounded outbox BLOCKS the producer
     (backpressure) instead of growing without bound."""
-    buses = _mk_buses(2, 15950 if backend == "zmq" else 16950,
-                      backend=backend)
+    buses = _mk_buses(2, backend=backend)
     n = 3000
     got = []
     buses[1].on("fl", lambda s, p: got.append(p["i"]))
@@ -254,7 +314,7 @@ def test_zmq_hwm_drops_are_counted_not_silent(monkeypatch):
     accounting COUNTS the loss instead of training on a silently-thinned
     stream (VERDICT r2 weak #3 done-criterion)."""
     monkeypatch.setenv("MINIPS_ZMQ_HWM", "16")
-    buses = _mk_buses(2, 15990)
+    buses = _mk_buses(2)
     n = 4000
     got = []
 
@@ -290,7 +350,7 @@ def test_native_outbox_depth_observability():
 
     if not NativeControlBus.available():
         pytest.skip("native mailbox unavailable")
-    buses = _mk_buses(2, 16994, backend="native")
+    buses = _mk_buses(2, backend="native")
     try:
         assert buses[0].out_queue_depth() == 0
         assert buses[0].send_drops == 0

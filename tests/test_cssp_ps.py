@@ -19,14 +19,12 @@ import pytest
 from minips_tpu import launch
 
 APP = "minips_tpu.apps.multihost_example"
-_PORT = [6840]
 
 
 def _run_multihost(n, extra, *, local_devices=2, timeout=300.0):
-    _PORT[0] += 9
     return launch.run_local_job(
         n, [sys.executable, "-m", APP] + extra,
-        base_port=_PORT[0],
+        base_port=None,
         env_extra={"MINIPS_FORCE_CPU": "1",
                    "MINIPS_MH_LOCAL_DEVICES": str(local_devices)},
         timeout=timeout)
@@ -232,7 +230,7 @@ def test_blob_exchange_allgather_and_early_arrival():
 
     from minips_tpu.comm.bus import BlobExchange
 
-    buses = _mk_buses(2, 15910)
+    buses = _mk_buses(2)
     try:
         ex0, ex1 = (BlobExchange(buses[0], 2), BlobExchange(buses[1], 2))
         a0 = np.array([3, 1, 2], np.int64)
@@ -613,12 +611,11 @@ def test_collective_ssp_kill_detect_relaunch_resume(tmp_path):
     assert all(r["event"] == "done" for r in ref)
 
     # leg 1: save at the step-4 sync boundary, rank 1 dies at step 7
-    _PORT[0] += 9
     rc, events = launch.run_local_job_raw(
         2, [sys.executable, "-m", APP] + common + [
             "--checkpoint-dir", ck, "--save-at", "4",
             "--kill-at", "7", "--kill-rank", "1"],
-        base_port=_PORT[0],
+        base_port=None,
         env_extra={"MINIPS_FORCE_CPU": "1",
                    "MINIPS_MH_LOCAL_DEVICES": "2"},
         timeout=300.0)

@@ -32,7 +32,6 @@ import pytest
 from minips_tpu import launch
 
 APP = "minips_tpu.apps.ssp_lr_example"
-_PORT = [5800]  # bumped per spawn so tests never collide on TIME_WAIT ports
 
 
 def run_job(n: int, extra: list[str], iters: int = 30,
@@ -40,13 +39,12 @@ def run_job(n: int, extra: list[str], iters: int = 30,
             ) -> list[dict]:
     """Launch n local worker processes, harvest one JSON line per rank
     (the shared spawn/harvest protocol lives in launch.run_local_job)."""
-    _PORT[0] += n + 3
     env_patch = {"MINIPS_FORCE_CPU": "1",
                  "JAX_PLATFORMS": "cpu"}
     env_patch.update(env_extra or {})
     return launch.run_local_job(
         n, [sys.executable, "-m", APP, "--iters", str(iters)] + extra,
-        base_port=_PORT[0], env_extra=env_patch, timeout=timeout)
+        base_port=None, env_extra=env_patch, timeout=timeout)
 
 
 def assert_replicas_agree(results: list[dict]) -> None:
@@ -170,20 +168,18 @@ def test_run_local_job_tolerates_non_json_brace_lines():
     code = ("print({'pyrepr': 1}); "
             "print('{not json either'); "
             "import json; print(json.dumps({'ok': 1}))")
-    _PORT[0] += 2
     res = launch.run_local_job(1, [sys.executable, "-c", code],
-                               base_port=_PORT[0], timeout=60)
+                               base_port=None, timeout=60)
     assert res == [{"ok": 1}]
 
     # but a malformed FINAL brace line must fail loudly, not silently
     # surface an earlier metrics line as the result
-    _PORT[0] += 2
     with pytest.raises(RuntimeError, match="final brace line"):
         launch.run_local_job(
             1, [sys.executable, "-c",
                 "import json; print(json.dumps({'metrics': 1})); "
                 "print({'result': 2})"],
-            base_port=_PORT[0], timeout=60)
+            base_port=None, timeout=60)
 
 
 def test_spawn_rank_path_selection(tmp_path, monkeypatch):
@@ -235,14 +231,13 @@ def test_wide_deep_multiproc_ssp_staleness4():
     """VERDICT r1 #3: the flagship sparse workload (W&D embedding tables)
     on the key-range-sharded PS at SSP staleness 4 — row-sparse wire,
     replica agreement after finalize, AUC above chance and improving."""
-    _PORT[0] += 6
     slots = 1 << 18  # Criteo-sized enough that batches touch a sliver
     res = launch.run_local_job(
         3, [sys.executable, "-m", "minips_tpu.apps.wide_deep_example",
             "--exec", "multiproc", "--consistency", "ssp", "--staleness",
             "4", "--num_slots", str(slots), "--num_iters", "40",
             "--batch_size", "256", "--slow-rank", "1", "--slow-ms", "25"],
-        base_port=_PORT[0],
+        base_port=None,
         env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
         timeout=300.0)
     assert all(r["event"] == "done" for r in res)
@@ -265,13 +260,12 @@ def test_wide_deep_multiproc_ssp_staleness4():
 
 
 def test_wide_deep_multiproc_asp_never_waits():
-    _PORT[0] += 6
     res = launch.run_local_job(
         3, [sys.executable, "-m", "minips_tpu.apps.wide_deep_example",
             "--exec", "multiproc", "--consistency", "asp", "--num_slots",
             "16384", "--num_iters", "30", "--batch_size", "256",
             "--slow-rank", "2", "--slow-ms", "20"],
-        base_port=_PORT[0],
+        base_port=None,
         env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
         timeout=300.0)
     assert all(r["event"] == "done" for r in res)
@@ -293,14 +287,13 @@ def test_wide_deep_multiproc_int8_push_wire():
     live AUC and bitwise replica agreement (quantization happens on the
     PUSH; owner state and the pulls everyone shares stay f32)."""
     def run(comm):
-        _PORT[0] += 6
         return launch.run_local_job(
             2, [sys.executable, "-m", "minips_tpu.apps.wide_deep_example",
                 "--exec", "multiproc", "--consistency", "ssp",
                 "--staleness", "2", "--num_slots", "16384",
                 "--num_iters", "30", "--batch_size", "256",
                 "--push-comm", comm],
-            base_port=_PORT[0],
+            base_port=None,
             env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
             timeout=300.0)
 
@@ -329,12 +322,11 @@ def test_mf_multiproc_asp_partitioned_factors():
     user/item factor tables partitioned by id range (exact per-key rows,
     no hashing), ASP pulls never gated, replicas agree after finalize,
     holdout RMSE beats the rating scale's trivial spread."""
-    _PORT[0] += 6
     res = launch.run_local_job(
         3, [sys.executable, "-m", "minips_tpu.apps.mf_example",
             "--exec", "multiproc", "--consistency", "asp",
             "--num_iters", "80", "--batch_size", "256"],
-        base_port=_PORT[0],
+        base_port=None,
         env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
         timeout=300.0)
     assert all(r["event"] == "done" for r in res)
@@ -354,13 +346,12 @@ def test_word2vec_multiproc_ssp_partitioned_vocab():
     """Word2vec (BASELINE config 5, 'async push') on the sharded PS with
     the vocab range-partitioned; run at SSP s=2 with a straggler to prove
     the same gate bounds skew for the embedding workload too."""
-    _PORT[0] += 6
     res = launch.run_local_job(
         3, [sys.executable, "-m", "minips_tpu.apps.word2vec_example",
             "--exec", "multiproc", "--consistency", "ssp",
             "--staleness", "2", "--num_iters", "50", "--batch_size", "128",
             "--slow-rank", "1", "--slow-ms", "25"],
-        base_port=_PORT[0],
+        base_port=None,
         env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
         timeout=300.0)
     assert all(r["event"] == "done" for r in res)

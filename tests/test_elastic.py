@@ -50,7 +50,6 @@ from minips_tpu import launch
 from minips_tpu.ckpt import elastic
 
 APP = "minips_tpu.apps.sharded_ps_example"
-_PORT = [6700]
 
 
 class _FakeTable:
@@ -225,11 +224,10 @@ def test_elastic_shrink_then_grow_end_to_end(tmp_path):
             "--checkpoint-every", "5"]
 
     def run(n, iters):
-        _PORT[0] += n + 3
         return launch.run_local_job(
             n, [sys.executable, "-m", APP] + base + ["--iters",
                                                      str(iters)],
-            base_port=_PORT[0],
+            base_port=None,
             env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
             timeout=240.0)
 
@@ -296,11 +294,10 @@ def test_elastic_resume_wd_flagship(tmp_path):
     app = "minips_tpu.apps.wide_deep_example"
 
     def run(n, iters):
-        _PORT[0] += n + 3
         return launch.run_local_job(
             n, [sys.executable, "-m", app] + base + ["--num_iters",
                                                      str(iters)],
-            base_port=_PORT[0],
+            base_port=None,
             env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
             timeout=240.0)
 

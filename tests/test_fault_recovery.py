@@ -23,7 +23,6 @@ from minips_tpu import launch
 
 APP = "minips_tpu.apps.ssp_lr_example"
 SHARDED_APP = "minips_tpu.apps.sharded_ps_example"
-_PORT = [6100]
 
 
 def _run(n: int, extra: list[str], timeout: float = 240.0,
@@ -31,9 +30,8 @@ def _run(n: int, extra: list[str], timeout: float = 240.0,
     """Launch n workers of ``app``; return (rc, per-rank JSON events).
     kill_on_failure=False: survivors must detect the death THEMSELVES via
     heartbeat — the launcher must not mercy-kill them first."""
-    _PORT[0] += n + 3
     return launch.run_local_job_raw(
-        n, [sys.executable, "-m", app] + extra, base_port=_PORT[0],
+        n, [sys.executable, "-m", app] + extra, base_port=None,
         env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
         timeout=timeout, kill_on_failure=kill_on_failure)
 

@@ -24,7 +24,6 @@ import pytest
 from minips_tpu import launch
 
 APP = "minips_tpu.apps.multihost_example"
-_PORT = [6300]
 
 
 # ------------------------------------------------------------ fast tier
@@ -108,10 +107,9 @@ def test_host_copy_addressable(mesh8):
 
 # ------------------------------------------------------------ slow tier
 def _run_multihost(n, extra, *, local_devices=4, timeout=240.0):
-    _PORT[0] += 7
     return launch.run_local_job(
         n, [sys.executable, "-m", APP] + extra,
-        base_port=_PORT[0],
+        base_port=None,
         env_extra={"MINIPS_FORCE_CPU": "1",
                    "MINIPS_MH_LOCAL_DEVICES": str(local_devices)},
         timeout=timeout)
@@ -202,12 +200,11 @@ def test_multihost_kill_detect_relaunch_resume(tmp_path):
     uninterrupted run would be (shared-stream replay)."""
     ck = str(tmp_path / "ck")
     # leg 1: save at 6, rank 1 dies at 9 -> survivor must self-detect
-    _PORT[0] += 7
     rc, events = launch.run_local_job_raw(
         2, [sys.executable, "-m", APP, "--iters", "16",
             "--checkpoint-dir", ck, "--save-at", "6",
             "--kill-at", "9", "--kill-rank", "1"],
-        base_port=_PORT[0],
+        base_port=None,
         env_extra={"MINIPS_FORCE_CPU": "1",
                    "MINIPS_MH_LOCAL_DEVICES": "4"},
         timeout=240.0)

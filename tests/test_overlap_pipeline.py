@@ -24,20 +24,12 @@ from minips_tpu.ops.quantized_comm import (dequantize_rows_int8,
 from minips_tpu.train.sharded_ps import ShardedTable
 
 APP = "minips_tpu.apps.sharded_ps_example"
-_PORT = [6500]
 
 
 def _mk_buses(n):
-    from minips_tpu.comm.bus import make_bus
+    from tests.conftest import mk_loopback_buses
 
-    _PORT[0] += n + 1
-    addrs = [f"tcp://127.0.0.1:{_PORT[0] + i}" for i in range(n)]
-    buses = [make_bus(addrs[i], [a for j, a in enumerate(addrs) if j != i],
-                      my_id=i) for i in range(n)]
-    for b in buses:
-        b.start()
-    time.sleep(0.25)  # PUB/SUB slow-joiner settle
-    return buses
+    return mk_loopback_buses(n)
 
 
 # ------------------------------------------------------------ pull wire
@@ -406,13 +398,12 @@ def test_overlap_ssp_three_processes_staleness_bound_holds():
     straggler must still honor the s+1 transient skew bound, lose no
     frames, and agree across replicas after finalize — the in-flight
     window may never widen staleness."""
-    _PORT[0] += 8
     res = launch.run_local_job(
         3, [sys.executable, "-m", APP, "--iters", "40", "--model",
             "sparse", "--mode", "ssp", "--staleness", "2",
             "--slow-rank", "1", "--slow-ms", "30", "--overlap",
             "--pull-wire", "int8"],
-        base_port=_PORT[0],
+        base_port=None,
         env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
         timeout=240.0)
     assert all(r["event"] == "done" for r in res)
@@ -434,11 +425,10 @@ def test_overlap_ssp_three_processes_staleness_bound_holds():
 def test_overlap_bsp_two_processes_lockstep_holds():
     """BSP + --overlap: the drain at the clock boundary keeps lockstep
     (skew <= 1) with the async window active."""
-    _PORT[0] += 8
     res = launch.run_local_job(
         2, [sys.executable, "-m", APP, "--iters", "30", "--model",
             "sparse", "--mode", "bsp", "--overlap"],
-        base_port=_PORT[0],
+        base_port=None,
         env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
         timeout=240.0)
     assert all(r["event"] == "done" for r in res)

@@ -18,20 +18,11 @@ import pytest
 from minips_tpu.ops.quantized_comm import quantize_rows_int8
 from minips_tpu.train.sharded_ps import RowCache, ShardedTable
 
-_PORT = [6800]
-
 
 def _mk_buses(n):
-    from minips_tpu.comm.bus import make_bus
+    from tests.conftest import mk_loopback_buses
 
-    _PORT[0] += n + 1
-    addrs = [f"tcp://127.0.0.1:{_PORT[0] + i}" for i in range(n)]
-    buses = [make_bus(addrs[i], [a for j, a in enumerate(addrs) if j != i],
-                      my_id=i) for i in range(n)]
-    for b in buses:
-        b.start()
-    time.sleep(0.25)  # PUB/SUB slow-joiner settle
-    return buses
+    return mk_loopback_buses(n)
 
 
 class Cons:
@@ -610,13 +601,11 @@ def test_cache_ssp_three_processes_trains_and_bounds_staleness():
     import sys
 
     from minips_tpu import launch
-
-    _PORT[0] += 8
     res = launch.run_local_job(
         3, [sys.executable, "-m", "minips_tpu.apps.sharded_ps_example",
             "--iters", "40", "--model", "sparse", "--mode", "ssp",
             "--staleness", "2", "--cache-bytes", str(1 << 22)],
-        base_port=_PORT[0],
+        base_port=None,
         env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
         timeout=240.0)
     assert all(r["event"] == "done" for r in res)

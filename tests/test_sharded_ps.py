@@ -18,14 +18,12 @@ from minips_tpu import launch
 from minips_tpu.train.sharded_ps import ShardedPSTrainer, ShardedTable
 
 APP = "minips_tpu.apps.sharded_ps_example"
-_PORT = [6100]
 
 
 def run_job(n, extra, iters=40, timeout=240.0):
-    _PORT[0] += n + 3
     return launch.run_local_job(
         n, [sys.executable, "-m", APP, "--iters", str(iters)] + extra,
-        base_port=_PORT[0],
+        base_port=None,
         env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
         timeout=timeout)
 
@@ -215,16 +213,9 @@ def test_shard_state_roundtrip_and_rank_guard():
 
 # ------------------------------------------------------- threads-as-nodes
 def _mk_buses(n):
-    from minips_tpu.comm.bus import make_bus
+    from tests.conftest import mk_loopback_buses
 
-    _PORT[0] += n + 1
-    addrs = [f"tcp://127.0.0.1:{_PORT[0] + i}" for i in range(n)]
-    buses = [make_bus(addrs[i], [a for j, a in enumerate(addrs) if j != i],
-                      my_id=i) for i in range(n)]
-    for b in buses:
-        b.start()
-    time.sleep(0.25)  # PUB/SUB slow-joiner settle
-    return buses
+    return mk_loopback_buses(n)
 
 
 def test_inprocess_route_push_pull_three_shards():
@@ -406,12 +397,12 @@ def test_sharded_ps_peer_death_detected():
     import tempfile
 
     n = 3
-    _PORT[0] += n + 3
+    base_port = launch.find_free_base_port(n)
     hosts = ["localhost"] * n
     outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
     procs = []
     for rank in range(n):
-        env = launch.child_env(rank, hosts, _PORT[0])
+        env = launch.child_env(rank, hosts, base_port)
         env.update({"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"})
         procs.append(subprocess.Popen(
             [sys.executable, "-m", APP, "--iters", "60", "--model",
