@@ -42,7 +42,8 @@ def _worker_argv(path: str, iters: int, warmup: int,
                  push_dedup: bool = True,
                  rows: int | None = None,
                  updater: str | None = None,
-                 pull_timeout: float | None = None) -> list[str]:
+                 pull_timeout: float | None = None,
+                 zipf_permute_hot: bool = True) -> list[str]:
     argv = [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
             "--path", path, "--iters", str(iters), "--warmup", str(warmup)]
     if compute != "none":
@@ -59,6 +60,8 @@ def _worker_argv(path: str, iters: int, warmup: int,
             argv += ["--overlap-legs", overlap_legs]
     if key_dist != "uniform":
         argv += ["--key-dist", key_dist]
+    if not zipf_permute_hot:
+        argv += ["--no-zipf-permute-hot"]
     if staleness is not None:
         argv += ["--staleness", str(staleness)]
     if cache_bytes:
@@ -87,6 +90,7 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
          updater: str | None = None,
          chaos: str | None = None, reliable: bool = False,
          pull_timeout: float | None = None,
+         zipf_permute_hot: bool = True, rebalance: str | None = None,
          may_fail: bool = False, timeout: float = 300.0) -> dict:
     """One sweep point → {rows_per_sec_per_process, aggregate, wire...}.
 
@@ -98,7 +102,8 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     argv = _worker_argv(path, iters, warmup, compute, hidden,
                         push_comm, pull_wire, overlap, overlap_legs,
                         key_dist, staleness, cache_bytes, pull_dedup,
-                        push_dedup, rows, updater, pull_timeout)
+                        push_dedup, rows, updater, pull_timeout,
+                        zipf_permute_hot)
     env_extra = {}
     if bus != "zmq":
         env_extra["MINIPS_BUS"] = bus
@@ -109,6 +114,7 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     # environment from leaking into the clean arms
     env_extra["MINIPS_CHAOS"] = chaos or ""
     env_extra["MINIPS_RELIABLE"] = "1" if reliable else ""
+    env_extra["MINIPS_REBALANCE"] = rebalance or ""
     if n == 1:  # standalone zero-wire baseline (no launcher, no bus)
         proc = subprocess.run(argv, capture_output=True, text=True,
                               timeout=timeout,
@@ -192,6 +198,27 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     assert echoed_ch == {chaos or None}, (chaos, echoed_ch)
     echoed_rl = {bool(r.get("reliable_on")) for r in res}
     assert echoed_rl == {bool(reliable)}, (reliable, echoed_rl)
+    echoed_rb = {r.get("rebalance_spec") for r in res}
+    assert echoed_rb == {rebalance or None}, (rebalance, echoed_rb)
+    if key_dist == "zipf":
+        echoed_ph = {r.get("zipf_permute_hot") for r in res}
+        assert echoed_ph == {zipf_permute_hot}, (zipf_permute_hot,
+                                                 echoed_ph)
+    # per-owner serve load: max/mean across ranks is the partition-
+    # imbalance observable (1.0 = balanced) — the rebalance sweep's
+    # REBAL-SKEW tripwire compares it between arms
+    srv = [r.get("serve") for r in res]
+    if all(s is not None for s in srv):
+        rows_served = [s["pull_rows"] + s["push_rows"] for s in srv]
+        mean_served = sum(rows_served) / len(rows_served)
+        out["serve_rows_per_rank"] = rows_served
+        if mean_served > 0:
+            out["serve_load_imbalance"] = round(
+                max(rows_served) / mean_served, 4)
+    rbs = [r.get("rebalance") for r in res if r.get("rebalance")]
+    if rbs:
+        out["migrations"] = sum(r["blocks_in"] for r in rbs)
+        out["routing_epoch"] = max(r["epoch"] for r in rbs)
     # wire-health roll-up for the resilience sweep: unrecovered loss must
     # read 0 on every completed chaos arm, and the recovery counters are
     # the evidence the layer (not luck) carried the run
@@ -396,6 +423,43 @@ def main() -> int:
 
     chaos_grid = _chaos_arms(o_reps)
 
+    # heat-aware rebalancing (this PR): UNPERMUTED zipf(1.1) — the whole
+    # head inside shard 0's range, the pathology the permuted default
+    # hides — static partition vs MINIPS_REBALANCE on, SSP(1). These are
+    # IMBALANCE/COMPLETION gates, not throughput comparisons: a skewed
+    # arm's rows/sec is one hot owner's serial serve rate and swings
+    # with scheduling luck, so it lives under a gate-invisible key
+    # (rows_per_sec_skewed) exactly like the chaos arms' — the numbers
+    # the REBAL-SKEW tripwire (ci/bench_regression.py) gates are
+    # serve_load_imbalance (max/mean per-shard serve rows: rebalance arm
+    # strictly below static), migrations >= 1, and zero drops/losses.
+    # The permuted arm rides along as the balanced reference point.
+    REBAL_SPEC = ("interval=0.25,threshold=1.2,max_blocks=16,"
+                  "block=16,topk=64")
+
+    def _rebalance_arms() -> dict:
+        grid: dict = {"spec": REBAL_SPEC}
+        arms = {
+            "permuted": {"key_dist": "zipf"},
+            "static": {"key_dist": "zipf", "zipf_permute_hot": False},
+            "rebalance": {"key_dist": "zipf", "zipf_permute_hot": False,
+                          "rebalance": REBAL_SPEC},
+        }
+        for name, kw in arms.items():
+            # skewed arms record failure as completed=False (the
+            # REBAL-DEAD tripwire's input) instead of killing the whole
+            # artifact — same contract as the chaos arms
+            res = _run(3, "sparse", iters, warmup, "zmq", staleness=1,
+                       may_fail=(name != "permuted"), timeout=240.0,
+                       **kw)
+            if name != "permuted" and "rows_per_sec_per_process" in res:
+                res["rows_per_sec_skewed"] = res.pop(
+                    "rows_per_sec_per_process")
+            grid[name] = res
+        return grid
+
+    rebalance_grid = _rebalance_arms()
+
     headline = curve["3"]["rows_per_sec_per_process"]
     print(json.dumps({
         "metric": "sharded-PS rows/sec/process (sparse pull+push, "
@@ -413,6 +477,7 @@ def main() -> int:
         "overlap_on_off_fit": {"nprocs": n_fit, **over_fit},
         "cache_comparison_3proc": cache_grid,
         "chaos_resilience_3proc": chaos_grid,
+        "rebalance_3proc": rebalance_grid,
     }))
     return 0
 

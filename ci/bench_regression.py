@@ -23,6 +23,11 @@ the drop-0 chaos arm must stay within slack of the clean arm (the
 reliable layer may not tax the lossless path) and every drop>0
 retransmit-on arm must have completed with zero unrecovered frames
 (seeded loss must degrade to latency, never to death).
+``rebalance_tripwires`` (REBAL-SKEW/REBAL-DEAD) guards the
+``rebalance_3proc`` sweep: the unpermuted-zipf rebalancer-on arm must
+complete with >= 1 migration and max/mean per-shard serve load
+strictly below the static arm's — skewed-arm rows/sec stay
+gate-invisible (``rows_per_sec_skewed``) like the chaos arms'.
 
 Usage:
     python ci/bench_regression.py PRIOR.json NEW.json [--tolerance 0.10]
@@ -153,6 +158,48 @@ def chaos_tripwires(new: dict) -> list[str]:
     return problems
 
 
+def rebalance_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``rebalance_3proc`` sweep;
+    vacuous when the sweep is absent (other benches).
+
+    - REBAL-SKEW: the unpermuted-zipf arm with the rebalancer ON must
+      end with max/mean per-shard serve load STRICTLY below the static
+      arm's, having performed >= 1 migration — otherwise the subsystem
+      is silently disabled (env plumbing, heat dead, planner never
+      firing) while the run still completes.
+    - REBAL-DEAD: the rebalance arm must COMPLETE with zero unrecovered
+      frames (migration must never convert skew into poisons). Skewed
+      arms' rows/sec live under a gate-invisible key
+      (``rows_per_sec_skewed``) like the chaos arms — one hot owner's
+      serve rate must never feed the run-to-run ±10% gate."""
+    grid = new.get("rebalance_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    static = grid.get("static") or {}
+    rb = grid.get("rebalance") or {}
+    if not rb.get("completed") or rb.get("wire_frames_lost", 0):
+        problems.append(
+            f"REBAL-DEAD rebalance_3proc/rebalance: completed="
+            f"{rb.get('completed')!r} frames_lost="
+            f"{rb.get('wire_frames_lost')!r} — the rebalancer arm must "
+            "complete cleanly")
+        return problems
+    if not rb.get("migrations"):
+        problems.append(
+            "REBAL-SKEW rebalance_3proc/rebalance: 0 migrations on "
+            "unpermuted zipf — the rebalancer is silently disabled")
+    si = static.get("serve_load_imbalance")
+    ri = rb.get("serve_load_imbalance")
+    if not (isinstance(si, (int, float)) and isinstance(ri, (int, float))
+            and ri < si):
+        problems.append(
+            f"REBAL-SKEW rebalance_3proc: serve-load imbalance "
+            f"{ri!r} (rebalance) is not strictly below {si!r} (static) "
+            "— migration is not flattening the hot shard")
+    return problems
+
+
 def compare(prior: dict, new: dict, tolerance: float) -> list[str]:
     """Regression report lines; empty means the gate passes."""
     p, n = throughput_points(prior), throughput_points(new)
@@ -204,7 +251,8 @@ def main(argv: list[str] | None = None) -> int:
         new = json.load(f)
 
     problems = (compare(prior, new, args.tolerance)
-                + cache_tripwires(new) + chaos_tripwires(new))
+                + cache_tripwires(new) + chaos_tripwires(new)
+                + rebalance_tripwires(new))
     pts = throughput_points(new)
     print(f"bench-regression: {len(pts)} throughput points checked "
           f"against {len(throughput_points(prior))} prior")

@@ -411,6 +411,10 @@ def emit_multiproc_done(trainer, rank: int, t0: float, losses,
         # retransmit/chaos counters (None = layer off)
         "reliable": trainer.reliable_stats(),
         "chaos": trainer.chaos_stats(),
+        # per-owner serve load (always on) + rebalancer counters (None
+        # = off): the partition-imbalance observables
+        "serve": trainer.serve_stats(),
+        "rebalance": trainer.rebalance_stats(),
         "local_bytes": trainer.local_bytes(),
         "table_bytes": int(table_bytes),
         "param_fingerprint": fingerprint,

@@ -50,6 +50,14 @@ def main(argv=None) -> int:
                          "workload where the client row cache and the "
                          "deduplicated pull wire earn their keep")
     ap.add_argument("--zipf-alpha", type=float, default=1.1)
+    ap.add_argument("--no-zipf-permute-hot", dest="zipf_permute_hot",
+                    action="store_false", default=True,
+                    help="draw zipf keys WITHOUT the hot-rank "
+                         "permutation: the whole head lands in shard "
+                         "0's range — the static-partition pathology "
+                         "the heat-aware rebalancer (MINIPS_REBALANCE) "
+                         "exists to fix; the rebalance_3proc sweep's "
+                         "arms run this")
     ap.add_argument("--staleness", type=float, default=float("inf"),
                     help="consistency bound for the run: inf = ASP "
                          "(the default; measures the bare data path), "
@@ -157,7 +165,8 @@ def main(argv=None) -> int:
         # spread_seed shared across ranks: every process sees the SAME
         # hot rows (a real workload's skew), scattered across shards
         zipf_sample = make_zipf_sampler(args.rows, args.zipf_alpha,
-                                        spread_seed=7)
+                                        spread_seed=7,
+                                        permute_hot=args.zipf_permute_hot)
 
     y_lab = (rng.random(B) > 0.5).astype(np.float32)
 
@@ -230,6 +239,16 @@ def main(argv=None) -> int:
         # plumbing regression can't publish a mislabeled arm
         "key_dist": args.key_dist,
         "zipf_alpha": args.zipf_alpha if args.key_dist == "zipf" else None,
+        "zipf_permute_hot": (bool(args.zipf_permute_hot)
+                             if args.key_dist == "zipf" else None),
+        # rebalancer echo (env-configured, launcher-inherited) + the
+        # per-owner serve-load counters the rebalance sweep computes
+        # max/mean imbalance from
+        "rebalance_spec": os.environ.get("MINIPS_REBALANCE") or None,
+        "rebalance": (trainer.rebalance_stats()
+                      if trainer is not None else None),
+        "serve": (trainer.serve_stats() if trainer is not None
+                  else dict(table.serve)),
         "staleness": (None if args.staleness == float("inf")
                       else int(args.staleness)),
         "cache_bytes": args.cache_bytes,

@@ -223,6 +223,9 @@ def main(argv=None) -> int:
             "chaos_spec": os.environ.get("MINIPS_CHAOS") or None,
             "reliable_on": os.environ.get("MINIPS_RELIABLE", "")
             not in ("", "0"),
+            # rebalancer echo (env-configured): wire_record below
+            # carries the serve/rebalance counter blocks themselves
+            "rebalance_spec": os.environ.get("MINIPS_REBALANCE") or None,
             "wall_s": round(time.monotonic() - t0, 4),
             "loss_first": losses[0] if losses else None,
             "loss_last": float(np.mean(losses[-5:])) if losses else None,

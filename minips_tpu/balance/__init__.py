@@ -1,0 +1,23 @@
+"""Heat-aware shard rebalancing for the sharded PS (train/sharded_ps.py).
+
+Two halves, deliberately separable:
+
+- :mod:`minips_tpu.balance.heat` — decayed per-key-block touch counters
+  kept by every owner on its serve path (bounded memory, vectorized),
+  the observability that makes range-partition skew measurable before
+  it is fixed;
+- :mod:`minips_tpu.balance.rebalancer` — the coordinator that collects
+  per-shard heat, computes a new block→owner assignment (greedy
+  bin-pack with hysteresis) and drives the epoch-fenced online
+  migration through the tables' wire protocol.
+
+Enabled by ``MINIPS_REBALANCE`` (off by default) — knob reference in
+docs/api.md, the protocol walkthrough in docs/architecture.md.
+"""
+
+from minips_tpu.balance.heat import HeatAccountant
+from minips_tpu.balance.rebalancer import (RebalanceConfig, Rebalancer,
+                                           plan_assignment)
+
+__all__ = ["HeatAccountant", "RebalanceConfig", "Rebalancer",
+           "plan_assignment"]

@@ -1,0 +1,314 @@
+"""The heat-aware shard rebalancer: planner + coordinator.
+
+The sharded PS partitions tables by STATIC contiguous key range
+(parallel/partition.RangePartitioner), so zipf-skewed traffic lands its
+whole head on one owner and that shard paces the system. This module
+closes the loop online:
+
+1. every owner keeps decayed per-key-block heat on its serve path
+   (balance/heat.py) and gossips a bounded report to the coordinator
+   (rank 0) every clock: ``rbH:{table}`` — epoch, settled flag, total
+   owned heat, and its top-k hottest blocks;
+2. once every live rank is SETTLED at the same routing epoch, the
+   report interval has elapsed, and the max/mean per-shard heat ratio
+   exceeds the hysteresis threshold, the coordinator greedily bin-packs
+   hot blocks away from the hottest shard (:func:`plan_assignment`) and
+   broadcasts the FULL new block→owner overlay stamped with the next
+   routing epoch (``rbP:{table}``);
+3. every rank adopts the plan at its next clock boundary
+   (``ShardedPSTrainer.tick``) — the epoch-fenced migration itself
+   (state ship, stale-frame forward/refuse, rbA/rbF fencing) lives in
+   train/sharded_ps.py, where the storage and locks are.
+
+Config rides ``MINIPS_REBALANCE`` (off by default), e.g.::
+
+    MINIPS_REBALANCE="interval=1.0,threshold=1.3,max_blocks=8,block=64"
+
+``"1"`` selects all defaults. Knob reference: docs/api.md; protocol and
+safety argument: docs/architecture.md "Heat-aware shard rebalancer".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["RebalanceConfig", "Rebalancer", "plan_assignment"]
+
+
+class RebalanceConfig:
+    """Parsed ``MINIPS_REBALANCE`` knobs (all optional, ``k=v`` comma
+    list; the bare string ``"1"`` = every default)."""
+
+    def __init__(self, *, interval: float = 1.0, threshold: float = 1.3,
+                 max_blocks: int = 8, block: int = 0, decay: float = 0.8,
+                 topk: int = 32, min_heat: float = 1.0):
+        if interval < 0:
+            raise ValueError("interval must be >= 0")
+        if threshold < 1.0:
+            raise ValueError("threshold must be >= 1.0 (a max/mean "
+                             "ratio below 1 is impossible)")
+        if max_blocks < 1:
+            raise ValueError("max_blocks must be >= 1")
+        if block < 0:
+            raise ValueError("block must be >= 0 (0 = auto)")
+        self.interval = float(interval)   # min seconds between plans
+        self.threshold = float(threshold)  # max/mean heat arming ratio
+        self.max_blocks = int(max_blocks)  # blocks moved per plan
+        self.block = int(block)            # keys per block (0 = auto)
+        self.decay = float(decay)          # per-tick heat decay
+        self.topk = int(topk)              # movable candidates per report
+        self.min_heat = float(min_heat)    # don't plan on noise
+
+    @classmethod
+    def parse(cls, spec: str) -> "RebalanceConfig":
+        spec = (spec or "").strip()
+        if spec in ("", "1", "on", "true"):
+            return cls()
+        kw: dict = {}
+        casts = {"interval": float, "threshold": float, "decay": float,
+                 "min_heat": float, "max_blocks": int, "block": int,
+                 "topk": int}
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" not in item:
+                raise ValueError(f"MINIPS_REBALANCE: expected k=v, "
+                                 f"got {item!r}")
+            k, v = item.split("=", 1)
+            k = k.strip()
+            if k not in casts:
+                raise ValueError(f"MINIPS_REBALANCE: unknown knob {k!r}")
+            try:
+                kw[k] = casts[k](v)
+            except ValueError as e:
+                raise ValueError(
+                    f"MINIPS_REBALANCE: bad value for {k}: {v!r}") from e
+        return cls(**kw)
+
+
+def plan_assignment(loads, candidates: dict, threshold: float,
+                    max_blocks: int) -> list[tuple[int, int, int]]:
+    """Greedy bin-pack of hot blocks, with hysteresis.
+
+    ``loads`` is per-shard total heat; ``candidates`` maps movable
+    ``block -> (current_owner, heat)``. Returns up to ``max_blocks``
+    moves ``(block, src, dst)``, or ``[]`` when the imbalance is under
+    ``threshold`` (hysteresis: the rebalancer only fires past the
+    arming ratio, so balanced traffic never migrates anything).
+
+    Invariants (property-tested): each move takes the hottest movable
+    block of the CURRENTLY hottest shard whose heat fits strictly
+    inside the hot→cool gap — so the pairwise imbalance strictly
+    decreases on every move and the plan can never overshoot into a
+    new, worse hotspot; a block is moved at most once per plan."""
+    loads = np.asarray(loads, np.float64).copy()
+    n = loads.size
+    mean = loads.sum() / n if n else 0.0
+    if mean <= 0.0 or loads.max() / mean < threshold:
+        return []
+    by_owner: dict[int, list[tuple[float, int]]] = {}
+    for b, (o, h) in candidates.items():
+        if h > 0.0:
+            by_owner.setdefault(int(o), []).append((float(h), int(b)))
+    for o in by_owner:
+        by_owner[o].sort(reverse=True)
+    moves: list[tuple[int, int, int]] = []
+    while len(moves) < max_blocks:
+        if loads.max() / mean < threshold:
+            break  # balanced enough: stop early (the other hysteresis)
+        hot = int(np.argmax(loads))
+        cool = int(np.argmin(loads))
+        gap = loads[hot] - loads[cool]
+        pick = None
+        for i, (h, _b) in enumerate(by_owner.get(hot, ())):
+            if h < gap:  # strictly improving and non-flipping
+                pick = i
+                break
+        if pick is None:
+            break  # nothing movable improves the hottest shard
+        h, b = by_owner[hot].pop(pick)
+        moves.append((b, hot, cool))
+        loads[hot] -= h
+        loads[cool] += h
+    return moves
+
+
+class Rebalancer:
+    """Per-trainer rebalance driver: heat reports every clock, plans at
+    the coordinator (rank 0), plan adoption at each rank's own clock
+    boundary. The migration mechanics (state ship, fences, stale-frame
+    handling) live on the tables; this object is the control loop."""
+
+    HEAT_KIND = "rbH"
+    PLAN_KIND = "rbP"
+
+    def __init__(self, trainer, cfg: RebalanceConfig):
+        self.trainer = trainer
+        self.cfg = cfg
+        self.bus = trainer.bus
+        self.rank = trainer.bus.my_id
+        self.n = trainer.num_processes
+        self.coord = 0
+        self.plans = 0
+        self._stopped = False
+        self._lock = threading.Lock()
+        self._pending: dict[str, dict] = {}        # table -> newest plan
+        self._reports: dict[str, dict[int, dict]] = {}  # table -> rank ->
+        self._last_plan: dict[str, float] = {}
+        self._t0 = time.monotonic()
+        for name, t in trainer.tables.items():
+            t.attach_rebalancer(self, cfg)
+            self.bus.on(f"{self.PLAN_KIND}:{name}",
+                        self._mk_on_plan(name))
+            self.bus.on(f"{self.HEAT_KIND}:{name}",
+                        self._mk_on_heat(name))
+
+    # ------------------------------------------------------------ handlers
+    def _mk_on_plan(self, name: str):
+        def on_plan(sender: int, payload: dict) -> None:
+            self.note_plan(name, int(payload.get("ep", 0)),
+                           dict(zip(payload.get("ovb", ()),
+                                    payload.get("ovo", ()))))
+        return on_plan
+
+    def note_plan(self, name: str, ep: int, ov: dict) -> None:
+        """Stash a routing table for the table's owner thread to adopt
+        at its next clock boundary / pull-wait poll. Adoption NEVER
+        happens on the bus receive thread: the adoption ack's ordering
+        promise ('my stale pushes all precede it') only holds from the
+        thread that drives pushes."""
+        with self._lock:
+            cur = self._pending.get(name)
+            if cur is None or ep > cur["ep"]:
+                self._pending[name] = {"ep": ep, "ov": dict(ov)}
+
+    def _mk_on_heat(self, name: str):
+        def on_heat(sender: int, payload: dict) -> None:
+            with self._lock:
+                self._reports.setdefault(name, {})[sender] = payload
+        return on_heat
+
+    # ------------------------------------------------------------ the loop
+    def on_tick(self) -> None:
+        """Called from ``ShardedPSTrainer.tick`` at the clock boundary,
+        after the push drain and before the clock advances: adopt any
+        pending plan (the epoch fence point), decay heat, gossip the
+        report, and — at the coordinator — maybe plan."""
+        now = time.monotonic()
+        for name, t in self.trainer.tables.items():
+            self._adopt_one(name, t)
+            if t._heat is not None:
+                t._heat.tick()
+            self._send_heat(name, t)
+            if self.rank == self.coord and not self._stopped:
+                self._maybe_plan(name, t, now)
+
+    def adopt_now(self) -> None:
+        """Adopt pending plans outside the tick path — finalize and
+        pull_all call this so a plan landing after a rank's last tick
+        still gets its adoption ack (a missing ack would hold peers'
+        fences open until their pull deadline poisons)."""
+        for name, t in self.trainer.tables.items():
+            self._adopt_one(name, t)
+
+    def stop(self) -> None:
+        """No further plans (finalize): migrations already in flight
+        still settle through the normal fence path."""
+        self._stopped = True
+
+    def _adopt_one(self, name: str, t) -> None:
+        with self._lock:
+            plan = self._pending.pop(name, None)
+        if plan is not None:
+            t.adopt_table(plan["ep"], plan["ov"])
+
+    def _send_heat(self, name: str, t) -> None:
+        ep, _ov = t.router.table()
+        owned = np.nonzero(t.router.owner_of_blocks() == self.rank)[0]
+        rep = t._heat.report(owned, self.cfg.topk)
+        rep["ep"] = ep
+        rep["settled"] = t.rebalance_settled()
+        if self.rank == self.coord:
+            with self._lock:
+                self._reports.setdefault(name, {})[self.rank] = rep
+        else:
+            self.bus.send(self.coord, f"{self.HEAT_KIND}:{name}", rep)
+
+    def _live_ranks(self) -> set[int]:
+        excluded = getattr(self.trainer.gossip, "excluded", set())
+        return set(range(self.n)) - set(excluded)
+
+    def _maybe_plan(self, name: str, t, now: float) -> None:
+        last = self._last_plan.get(name, self._t0)
+        if now - last < self.cfg.interval:
+            return
+        ep, ov = t.router.table()
+        live = self._live_ranks()
+        with self._lock:
+            reports = dict(self._reports.get(name, {}))
+        if not live <= set(reports):
+            return
+        # plan only over a SETTLED fleet at the current epoch: a rank
+        # mid-migration (fences pending) or still on the old table would
+        # make the diff-based adoption ambiguous — one plan in flight
+        # at a time, by construction
+        if any(reports[r].get("ep") != ep or not reports[r].get("settled")
+               for r in live):
+            return
+        # plan over LIVE ranks only, in a compact index space: a dead
+        # excluded rank must never appear as a zero-load migration
+        # target (state shipped to a corpse is state lost), nor deflate
+        # the mean into spuriously arming the threshold
+        live_sorted = sorted(live)
+        if len(live_sorted) < 2:
+            return
+        loads = np.zeros(len(live_sorted), np.float64)
+        candidates: dict[int, tuple[int, float]] = {}
+        for i, r in enumerate(live_sorted):
+            rep = reports[r]
+            loads[i] = float(rep.get("total", 0.0))
+            for b, h in zip(rep.get("blocks", ()), rep.get("heat", ())):
+                candidates[int(b)] = (i, float(h))
+        if loads.sum() < self.cfg.min_heat:
+            return
+        moves = [(b, live_sorted[s], live_sorted[d])
+                 for b, s, d in plan_assignment(
+                     loads, candidates, self.cfg.threshold,
+                     self.cfg.max_blocks)]
+        if not moves:
+            return
+        new_ov = dict(ov)
+        for b, _src, dst in moves:
+            if dst == t.router.home_of(b):
+                new_ov.pop(b, None)  # moving home: leave the base map
+            else:
+                new_ov[b] = dst
+        new_ep = ep + 1
+        self.bus.publish(f"{self.PLAN_KIND}:{name}",
+                         {"ep": new_ep,
+                          "ovb": [int(b) for b in new_ov],
+                          "ovo": [int(o) for o in new_ov.values()]})
+        self.plans += 1
+        self._last_plan[name] = now
+        # the coordinator is at its own clock boundary right now: adopt
+        # immediately (peers adopt at theirs; the epoch fence covers the
+        # window in between)
+        t.adopt_table(new_ep, new_ov)
+
+    # ------------------------------------------------------------- metrics
+    def stats(self) -> dict:
+        out = {"plans": self.plans}
+        per = {}
+        for name, t in self.trainer.tables.items():
+            per[name] = t.rebalance_table_stats()
+        out["tables"] = per
+        out["epoch"] = max((p["epoch"] for p in per.values()), default=0)
+        for k in ("blocks_in", "blocks_out", "forwarded_pushes",
+                  "refused_pulls", "migrated_rows"):
+            out[k] = sum(p[k] for p in per.values())
+        return out

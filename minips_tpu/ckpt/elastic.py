@@ -164,6 +164,18 @@ def reshard_table_state(checkpoint_dir: str, step: int, old_n: int,
     replaced by the new shard origin; any other leaf must be identical
     across old shards (there are none today — the assert is the tripwire
     for a future leaf this rule cannot place)."""
+    probe = _load_table_npz(checkpoint_dir, step, 0, name)
+    if int(probe.get("ep", np.zeros(()))):
+        # a rebalanced checkpoint's rows are NOT where the range map
+        # says (overlay blocks live in other ranks' xtra sections, home
+        # slab copies of moved-out blocks are dead) — slicing by range
+        # would assemble a silently-torn table
+        raise ValueError(
+            f"elastic reshard: step {step} of table {name!r} was saved "
+            f"with a rebalanced routing table (epoch "
+            f"{int(probe['ep'])}); elastic resize cannot place overlay "
+            "blocks — restore at the original world size (with "
+            "MINIPS_REBALANCE armed) first")
     old_sz = -(-num_rows // old_n)  # RangePartitioner.shard_size
     new_hi = min(new_lo + new_shard_size, num_rows)
     pieces: dict[str, list[np.ndarray]] = {}

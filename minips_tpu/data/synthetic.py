@@ -22,7 +22,7 @@ def zipf_popularity(num_keys: int, alpha: float) -> np.ndarray:
 
 
 def make_zipf_sampler(num_keys: int, alpha: float = 1.1, *,
-                      spread_seed: int = 0):
+                      spread_seed: int = 0, permute_hot: bool = True):
     """Seeded zipfian KEY sampler: returns ``sample(rng, size) ->
     int64[size]`` drawing keys with zipf(``alpha``) popularity, with the
     rank→key mapping scrambled by a FIXED permutation (``spread_seed``).
@@ -32,9 +32,19 @@ def make_zipf_sampler(num_keys: int, alpha: float = 1.1, *,
     shard 0 — every hot row would be one owner's local traffic and the
     skew would never exercise the wire. Sharing ``spread_seed`` across
     ranks keeps every process's notion of 'hot rows' identical, like a
-    real workload's."""
+    real workload's.
+
+    ``permute_hot=False`` keeps the raw rank→key identity — the
+    PATHOLOGICAL case for a static range partition (the whole head on
+    one owner), which is exactly what the heat-aware rebalancer exists
+    to fix (balance/): the bench's unpermuted-zipf arms measure that
+    imbalance instead of hiding it behind the permutation. The
+    permuted default stays, but the skewed case is testable."""
     p = zipf_popularity(num_keys, alpha)
-    perm = np.random.default_rng(spread_seed).permutation(num_keys)
+    if permute_hot:
+        perm = np.random.default_rng(spread_seed).permutation(num_keys)
+    else:
+        perm = np.arange(num_keys)
 
     def sample(rng: np.random.Generator, size: int) -> np.ndarray:
         return perm[rng.choice(num_keys, size=size, p=p)].astype(np.int64)

@@ -128,10 +128,46 @@ exhaustion and heartbeat-confirmed death still poison through every
 path below, unchanged: loss degrades to latency, never to silence.
 Drills are seeded + deterministic via ``MINIPS_CHAOS`` (comm/chaos.py);
 the whole ladder: docs/fault_tolerance.md.
+
+HEAT-AWARE SHARD REBALANCING (this PR): the static range partition
+above puts a zipf head's whole hot range on ONE owner — that shard
+becomes the system's straggler, and nothing here could fix it short of
+relaunching. With ``MINIPS_REBALANCE`` set (off by default):
+
+- every owner keeps decayed per-key-block heat on its serve path
+  (balance/heat.py) plus always-on per-owner request/row serve
+  counters (in ``wire_record``/done lines even with the rebalancer
+  off — imbalance is observable before it is fixed);
+- the coordinator (rank 0) collects heat, bin-packs hot blocks away
+  from the hottest shard past a hysteresis threshold
+  (balance/rebalancer.py), and broadcasts the new block→owner overlay
+  stamped with the next ROUTING EPOCH;
+- each rank adopts the table at its own clock boundary (``tick``) —
+  the epoch-fenced migration: the old owner snapshots the block's
+  rows AND optimizer state under its state lock, ships them (``rbS``),
+  and afterwards FORWARDS stale-routed pushes to the current owner;
+  stale-routed pulls are REFUSED with the new table (``psE``) and the
+  client retries the leg against the right owner; frames stamped with
+  a FUTURE epoch park until the local table catches up;
+- the SSP bound holds across the move: the new owner serves NO pull
+  of a migrated block until the fence releases (``rbF``), and the
+  fence releases only after every live rank's adoption ack (``rbA``)
+  arrived at the old owner — each rbA rides the same per-link stream
+  as that rank's pushes, so every stale push precedes it, and the rbF
+  rides the old→new link AFTER every forwarded push. A pull admitted
+  mid-migration therefore still reads state containing every peer's
+  updates up to ``clk − s`` (property-tested in
+  tests/test_rebalance.py), and read-your-own-writes survives the
+  two-hop window for the same per-link-FIFO reason.
+
+Checkpoints record the routing epoch + overlay + migrated block state
+so a restored fleet agrees with itself; protocol walkthrough:
+docs/architecture.md "Heat-aware shard rebalancer".
 """
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -145,7 +181,7 @@ from minips_tpu.consistency.gate import (PeerFailureError, StalenessGate,
                                          admits)
 from minips_tpu.ops.quantized_comm import (dequantize_rows_int8,
                                            quantize_rows_int8)
-from minips_tpu.parallel.partition import RangePartitioner
+from minips_tpu.parallel.partition import BlockRouter, RangePartitioner
 from minips_tpu.utils.timing import CommTimers
 
 __all__ = ["ShardedTable", "ShardedPSTrainer", "PeerFailureError",
@@ -369,13 +405,15 @@ class PullFuture:
         t = self._table
         t_block0 = time.monotonic()
         out_u = self._out_u
+        extra_local: list = []
         try:
             if self._remote:
-                got = t._await_replies(self._req,
-                                       {o for o, _ in self._remote},
-                                       timeout=timeout)
-                for o, idx in self._remote:
-                    rows, stamp = got[o]
+                got = t._await_replies(self._req, timeout=timeout)
+                # the FINAL leg map: the psE re-router may have re-split
+                # legs (and turned some local) since issue
+                legs, extra_local = t._take_group(self._req)
+                for rid, (o, idx) in legs.items():
+                    rows, stamp = got[rid][0], got[rid][1]
                     out_u[idx] = rows
                     if t._cache is not None:
                         # the prefetch path populates the same cache
@@ -398,16 +436,21 @@ class PullFuture:
                 t._cache_close_issue(self)
         with t._reply_cond:
             t_arrived = t._reply_t.pop(self._req, t_block0)
-        if self._local_idx is not None:
+        local_parts = ([self._local_idx]
+                       if self._local_idx is not None else [])
+        local_parts += [ix for ix in extra_local if ix.size]
+        if local_parts:
             # the local slice obeys the SAME admission rule the remote
             # owners applied: read only once my view admits the stamped
             # clock (matters for prefetches stamped clock_ahead > 0 —
             # a synchronous pull passes instantly, its own gate already
-            # waited for this)
+            # waited for this); _read_local additionally honors the
+            # migration fences a remote owner would have parked under
             t._wait_local_admission(self.clk, timeout)
-            offs = self._uniq[self._local_idx] - t.shard_lo
-            with t._state_lock:
-                out_u[self._local_idx] = t._w[offs]
+            idxs = (local_parts[0] if len(local_parts) == 1
+                    else np.concatenate(local_parts))
+            out_u[idxs] = t._read_local(self._uniq[idxs], self.clk,
+                                        timeout)
         now = time.monotonic()
         # latency is issue -> reply PROCESSED (t_arrived), not wait() —
         # a fully-prefetched pull whose reply landed mid-compute must
@@ -426,8 +469,7 @@ class PullFuture:
         if self._table._cache is not None:
             self._table._cache_close_issue(self)
         with self._table._reply_cond:
-            self._table._replies.pop(self._req, None)
-            self._table._reply_t.pop(self._req, None)
+            self._table._cleanup_group_locked(self._req)
 
 
 class ShardedTable:
@@ -549,6 +591,32 @@ class ShardedTable:
         self._q_rng = np.random.default_rng((seed, rank, 0x9e37))
         self.part = RangePartitioner(self.num_rows, num_processes)
         self.shard_lo = rank * self.part.shard_size
+        # ---- heat-aware rebalancing (balance/; OFF unless a Rebalancer
+        # attaches): the epoch-versioned block router overlays hot-block
+        # reassignments on the base range map. With no rebalancer bound
+        # every path below falls through to the seed behavior exactly.
+        self.router = BlockRouter(self.part)
+        self._rb = None            # balance.rebalancer.Rebalancer
+        self._heat = None          # balance.heat.HeatAccountant
+        self._mig_cond = threading.Condition()  # guards the sets below
+        self._xtra: dict[int, dict] = {}        # migrated-in block state
+        self._fenced: set[int] = set()          # pulls park until rbF
+        self._pending_state: set[int] = set()   # inbound, rbS not here
+        self._early_state: dict[int, dict] = {}  # rbS beat my adoption
+        self._early_release: set[tuple] = set()  # rbF beat my adoption
+        self._parked_pushes: list[tuple] = []    # future-epoch / pending
+        self._adopt_acks: dict[int, set[int]] = {}  # ep -> acked ranks
+        self._await_acks: dict[int, list] = {}   # ep -> [(block, dst)]
+        self.rb_stats = {"blocks_in": 0, "blocks_out": 0,
+                         "forwarded_pushes": 0, "refused_pulls": 0,
+                         "parked_frames": 0, "migrated_rows": 0}
+        # ---- per-owner serve counters (ALWAYS on — the observability
+        # half of heat accounting): requests/rows this shard served
+        # (wire) and rows read/applied on this shard's storage (wire +
+        # local) — utils/metrics.wire_record "serve", done lines
+        self._serve_lock = threading.Lock()
+        self.serve = {"pull_requests": 0, "pull_rows": 0,
+                      "push_frames": 0, "push_rows": 0}
         # ---- server shard: ONLY my row range lives here (the 1/N memory
         # claim, materialization included — a multi-GB Criteo table must
         # never exist whole on any host); per-(seed, rank) stream keeps
@@ -590,8 +658,16 @@ class ShardedTable:
         # ---- client plumbing
         self._req = 0
         self._req_lock = threading.Lock()
-        self._replies: dict[int, dict[int, np.ndarray]] = {}
-        self._reply_t: dict[int, float] = {}  # req -> last-reply arrival
+        # Pull bookkeeping is LEG-keyed: every per-owner slice of a pull
+        # gets its own wire request id (rid), grouped under a group id
+        # (gid) the PullFuture holds. The server is oblivious (it serves
+        # whatever "req" it was sent) — what legs buy is RE-ROUTING: an
+        # epoch-refused leg (psE, mid-migration) is re-split by the new
+        # table and re-sent without disturbing the group's other legs.
+        self._replies: dict[int, dict[int, tuple]] = {}  # gid->rid->reply
+        self._reply_t: dict[int, float] = {}  # gid -> last-reply arrival
+        self._rid_gid: dict[int, int] = {}    # live leg rid -> gid
+        self._groups: dict[int, dict] = {}    # gid -> legs/clk/uniq
         self._reply_cond = threading.Condition()
         self._prefetched: dict[bytes, PullFuture] = {}
         self._prefetch_lock = threading.Lock()
@@ -634,43 +710,61 @@ class ShardedTable:
             bus.on(f"psQ:{name}", self._on_ack_solicit)
 
     # --------------------------------------------------------- server side
+    def _base_state(self) -> dict:
+        """The base-slab state arrays as the dict shape block updates
+        operate on — migrated-in blocks (``_xtra``) carry the identical
+        shape, so the updater math below has exactly one implementation
+        wherever a row lives."""
+        return {"w": self._w, "acc": self._acc, "m": self._m,
+                "v": self._v, "steps": self._steps}
+
+    def _update_block(self, st: dict, uniq: np.ndarray,
+                      g: np.ndarray) -> None:
+        """One updater step on deduped rows of ONE storage (base slab or
+        a migrated block) — caller holds the state lock, ``uniq`` are
+        row indices into ``st``'s arrays."""
+        if self.updater == "sgd":
+            st["w"][uniq] -= self.lr * g
+        elif self.updater == "adagrad":
+            # accum += g², step by rsqrt of NEW accum
+            st["acc"][uniq] += g * g
+            st["w"][uniq] -= self.lr * g / (
+                np.sqrt(st["acc"][uniq]) + self.eps)
+        else:
+            self._adam_rows(st, uniq, g)
+
     def _apply_rows(self, offs: np.ndarray, grads: np.ndarray) -> None:
         """Reference ``updater->Update``: sum duplicate keys, then one
         update per touched row (ops/sparse_update.py semantics)."""
         grads = grads.reshape(offs.size, self.dim)
+        self._count_serve(push_rows=offs.size)
         with self._state_lock:
             uniq, inv = np.unique(offs, return_inverse=True)
             g = np.zeros((uniq.size, self.dim), np.float32)
             np.add.at(g, inv, grads)
-            if self.updater == "sgd":
-                self._w[uniq] -= self.lr * g
-            elif self.updater == "adagrad":
-                # accum += g², step by rsqrt of NEW accum
-                self._acc[uniq] += g * g
-                self._w[uniq] -= self.lr * g / (
-                    np.sqrt(self._acc[uniq]) + self.eps)
-            else:
-                self._adam_rows(uniq, g)
+            self._update_block(self._base_state(), uniq, g)
 
-    def _adam_rows(self, uniq: np.ndarray, g: np.ndarray) -> None:
+    def _adam_rows(self, st: dict, uniq: np.ndarray,
+                   g: np.ndarray) -> None:
         """Lazy adam on the (deduped) touched rows — one full Adam step per
         row with per-row bias correction, matching row_adam's f32 math
         (caller holds the state lock)."""
         b1, b2 = np.float32(self.beta1), np.float32(self.beta2)
-        t_new = self._steps[uniq] + 1
-        m_new = b1 * self._m[uniq] + (np.float32(1) - b1) * g
-        v_new = b2 * self._v[uniq] + (np.float32(1) - b2) * g * g
+        t_new = st["steps"][uniq] + 1
+        m_new = b1 * st["m"][uniq] + (np.float32(1) - b1) * g
+        v_new = b2 * st["v"][uniq] + (np.float32(1) - b2) * g * g
         tf = t_new.astype(np.float32)[:, None]
         bc1 = np.float32(1) - b1 ** tf
         bc2 = np.float32(1) - b2 ** tf
-        self._w[uniq] -= np.float32(self.lr) * (m_new / bc1) / (
+        st["w"][uniq] -= np.float32(self.lr) * (m_new / bc1) / (
             np.sqrt(v_new / bc2) + np.float32(self.eps))
-        self._m[uniq] = m_new
-        self._v[uniq] = v_new
-        self._steps[uniq] = t_new
+        st["m"][uniq] = m_new
+        st["v"][uniq] = v_new
+        st["steps"][uniq] = t_new
 
     def _apply_range(self, lo_local: int, grads: np.ndarray) -> None:
         grads = grads.reshape(-1, self.dim)
+        self._count_serve(push_rows=grads.shape[0])
         sl = slice(lo_local, lo_local + grads.shape[0])
         with self._state_lock:
             if self.updater == "sgd":
@@ -680,7 +774,451 @@ class ShardedTable:
                 self._w[sl] -= self.lr * grads / (
                     np.sqrt(self._acc[sl]) + self.eps)
             else:  # every row in the range is touched: plain lazy-adam rows
-                self._adam_rows(np.arange(sl.start, sl.stop), grads)
+                self._adam_rows(self._base_state(),
+                                np.arange(sl.start, sl.stop), grads)
+
+    def _count_serve(self, pull_requests: int = 0, pull_rows: int = 0,
+                     push_frames: int = 0, push_rows: int = 0) -> None:
+        """Per-owner serve-load counters (always on): ``*_rows`` count
+        rows read from / applied to THIS shard's storage, local or
+        wire; ``pull_requests``/``push_frames`` count served wire
+        frames. Done lines and ``wire_record`` carry them so partition
+        imbalance is observable with the rebalancer off."""
+        with self._serve_lock:
+            s = self.serve
+            s["pull_requests"] += pull_requests
+            s["pull_rows"] += pull_rows
+            s["push_frames"] += push_frames
+            s["push_rows"] += push_rows
+
+    # ------------------------------------------- heat-aware rebalancing
+    def attach_rebalancer(self, rb, cfg) -> None:
+        """Bind the migration machinery (balance/rebalancer.Rebalancer):
+        rebuilds the router at the configured block granularity, arms
+        heat accounting, and registers the migration control frames.
+        Must happen before any traffic (the trainer's constructor does,
+        which precedes the bus handshake in every app)."""
+        from minips_tpu.balance.heat import HeatAccountant
+
+        self._rb = rb
+        self.router = BlockRouter(self.part, cfg.block)
+        self._heat = HeatAccountant(self.router.num_blocks, cfg.decay)
+        if self.bus is not None:
+            self.bus.on(f"rbS:{self.name}", self._on_migrate_state)
+            self.bus.on(f"rbA:{self.name}", self._on_adopt_ack)
+            self.bus.on(f"rbF:{self.name}", self._on_fence_release)
+            self.bus.on(f"psE:{self.name}", self._on_epoch_nack)
+
+    def _owners_of(self, keys: np.ndarray) -> np.ndarray:
+        return (self.router.shard_of(keys) if self._rb is not None
+                else self.part.shard_of(keys))
+
+    def _ep_header(self) -> dict:
+        return {"ep": self.router.epoch} if self._rb is not None else {}
+
+    def _excluded_ranks(self) -> set[int]:
+        g = getattr(self._cons, "gossip", None)
+        return set(g.excluded) if g is not None else set()
+
+    def adopt_table(self, ep: int, overlay: dict) -> bool:
+        """Adopt routing epoch ``ep`` — THE epoch fence point. Only ever
+        run from the PUSH-DRIVING thread (trainer tick / finalize /
+        pull_all / the pull-wait poll): the adoption ack's promise is
+        'every stale-routed push of mine precedes this ack per link',
+        which a bus-thread adoption racing a mid-flight send could
+        break. Everything the fence's safety argument needs happens
+        here, in order:
+
+        1. (async push only) drain the send queue to the bus, so every
+           stale-routed push of mine is on its per-link wire BEFORE my
+           adoption ack;
+        2. atomically with the serve-path verdicts (one lock): swap the
+           routing table, SNAPSHOT outbound blocks' rows + optimizer
+           state out of storage, and fence inbound blocks
+           (state-pending until their ``rbS`` lands, pull-fenced until
+           the old owner's ``rbF``);
+        3. ship outbound state (``rbS``) and send my adoption ack
+           (``rbA``) DIRECTED to every source owner — the same per-link
+           stream my stale pushes rode, which is what lets the source
+           conclude 'no more stale pushes from this rank' on receipt;
+        4. drop row-cache entries of moved blocks and re-evaluate
+           everything parked.
+        """
+        if ep <= self.router.epoch:  # cheap duplicate cut (benign race;
+            return False             # the locked apply re-checks)
+        if self.async_push:
+            try:
+                self.flush_pushes(acks=False)
+            except Exception as e:  # noqa: BLE001 - poison, don't hide
+                if self._fatal is None:
+                    self._fatal = (f"table {self.name}: adoption drain "
+                                   f"failed: {e!r}")
+        ships: list[tuple[int, int, dict]] = []
+        moved: list[tuple[int, int, int]] = []
+        with self._mig_cond:
+            prev = self.router.apply(ep, overlay)
+            if prev is None:
+                return False
+            home = self.router.home_of
+            for b in set(prev) | set(overlay):
+                o_old = prev.get(b, home(b))
+                o_new = overlay.get(b, home(b))
+                if o_old != o_new:
+                    moved.append((int(b), int(o_old), int(o_new)))
+            with self._state_lock:
+                for b, src, dst in moved:
+                    if src == self.rank:
+                        ships.append((b, dst,
+                                      self._take_block_locked(b)))
+                    if dst == self.rank:
+                        early = self._early_state.pop(b, None)
+                        if early is not None:
+                            self._install_block_locked(b, early)
+                            self.rb_stats["blocks_in"] += 1
+                        else:
+                            self._pending_state.add(b)
+                        if (b, ep) in self._early_release:
+                            self._early_release.discard((b, ep))
+                        else:
+                            self._fenced.add(b)
+            if ships:
+                self._await_acks[ep] = [(b, dst) for b, dst, _ in ships]
+            self._adopt_acks.setdefault(ep, set()).add(self.rank)
+            # prune ack bookkeeping for long-released epochs
+            for stale in [e for e in self._adopt_acks
+                          if e < ep - 4 and e not in self._await_acks]:
+                del self._adopt_acks[stale]
+            self._mig_cond.notify_all()
+        for b, dst, st in ships:
+            head, blob = self._encode_block_state(b, ep, st)
+            self.bus.send(dst, f"rbS:{self.name}", head, blob=blob)
+            self.rb_stats["blocks_out"] += 1
+            self.rb_stats["migrated_rows"] += int(head["n"])
+        for src in sorted({s for _b, s, _d in moved if s != self.rank}):
+            self.bus.send(src, f"rbA:{self.name}", {"ep": ep})
+        if self._cache is not None:
+            for b, _src, _dst in moved:
+                lo, ln = self.router.block_span(b)
+                self._cache.invalidate(np.arange(lo, lo + ln, dtype=np.int64))
+        self._maybe_release_fences(ep)
+        self._drain_parked_pushes()
+        self.serve_parked()
+        return True
+
+    def _take_block_locked(self, b: int) -> dict:
+        """Snapshot-and-remove block ``b``'s live state (caller holds
+        the state lock): a home block's slab rows are copied out (the
+        slab copy is dead until the block migrates back), a migrated-in
+        block's arrays leave ``_xtra`` wholesale."""
+        if self.router.home_of(b) == self.rank:
+            lo, ln = self.router.block_span(b)
+            sl = slice(lo - self.shard_lo, lo - self.shard_lo + ln)
+            st = {"w": self._w[sl].copy()}
+            if self._acc is not None:
+                st["acc"] = self._acc[sl].copy()
+            if self._m is not None:
+                st["m"] = self._m[sl].copy()
+                st["v"] = self._v[sl].copy()
+                st["steps"] = self._steps[sl].copy()
+            return st
+        return self._xtra.pop(b)
+
+    def _install_block_locked(self, b: int, st: dict) -> None:
+        if self.router.home_of(b) == self.rank:
+            lo, ln = self.router.block_span(b)
+            sl = slice(lo - self.shard_lo, lo - self.shard_lo + ln)
+            self._w[sl] = st["w"]
+            if self._acc is not None:
+                self._acc[sl] = st["acc"]
+            if self._m is not None:
+                self._m[sl] = st["m"]
+                self._v[sl] = st["v"]
+                self._steps[sl] = st["steps"]
+        else:
+            self._xtra[b] = st
+
+    def _encode_block_state(self, b: int, ep: int, st: dict) -> tuple:
+        """rbS wire format: rows AND optimizer state AND the shipper's
+        min-clock view at snapshot time (stamp metadata — recorded so
+        drills can audit that a migrated block's content was at least
+        as fresh as the bound requires)."""
+        n = st["w"].shape[0]
+        parts = [np.ascontiguousarray(st["w"], np.float32).tobytes()]
+        for k in ("acc", "m", "v"):
+            if st.get(k) is not None:
+                parts.append(np.ascontiguousarray(st[k],
+                                                  np.float32).tobytes())
+        if st.get("steps") is not None:
+            parts.append(np.ascontiguousarray(st["steps"],
+                                              np.int32).tobytes())
+        g = getattr(self._cons, "gossip", None)
+        stamp = int(g.global_min()) if g is not None else 0
+        head = {"b": int(b), "ep": int(ep), "n": int(n), "stamp": stamp,
+                "u": self.updater, **self._cfg_header()}
+        return head, b"".join(parts)
+
+    def _decode_block_state(self, payload: dict) -> Optional[dict]:
+        n = int(payload.get("n", 0))
+        blob = payload.get("__blob__") or b""
+        row = n * self.dim * 4
+        need = row * {"sgd": 1, "adagrad": 2, "adam": 3}[self.updater] \
+            + (n * 4 if self.updater == "adam" else 0)
+        if payload.get("u") != self.updater or len(blob) != need:
+            return None
+        st = {"w": np.frombuffer(blob[:row], np.float32
+                                 ).reshape(n, self.dim).copy()}
+        off = row
+        if self.updater == "adagrad":
+            st["acc"] = np.frombuffer(blob[off:off + row], np.float32
+                                      ).reshape(n, self.dim).copy()
+        elif self.updater == "adam":
+            st["m"] = np.frombuffer(blob[off:off + row], np.float32
+                                    ).reshape(n, self.dim).copy()
+            st["v"] = np.frombuffer(blob[off + row:off + 2 * row],
+                                    np.float32).reshape(n, self.dim).copy()
+            st["steps"] = np.frombuffer(blob[off + 2 * row:],
+                                        np.int32).copy()
+        return st
+
+    def _on_migrate_state(self, sender: int, payload: dict) -> None:
+        b = int(payload.get("b", -1))
+        if not self._check_peer_config(sender, payload):
+            return
+        st = self._decode_block_state(payload)
+        if st is None:
+            self._drop("malformed", sender, "bad rbS block state")
+            return
+        with self._mig_cond:
+            with self._state_lock:
+                if b in self._pending_state:
+                    self._install_block_locked(b, st)
+                    self._pending_state.discard(b)
+                    self.rb_stats["blocks_in"] += 1
+                elif int(self.router.owner_of_blocks()[b]) == self.rank:
+                    pass  # duplicate of an installed block: a re-install
+                    # would roll back updates applied since — drop it
+                else:
+                    # rbS beat my plan adoption: stash until it arrives
+                    self._early_state[b] = st
+            self._mig_cond.notify_all()
+        self._drain_parked_pushes()
+        self.serve_parked()
+
+    def _on_adopt_ack(self, sender: int, payload: dict) -> None:
+        ep = int(payload.get("ep", 0))
+        with self._mig_cond:
+            self._adopt_acks.setdefault(ep, set()).add(sender)
+        self._maybe_release_fences(ep)
+
+    def _maybe_release_fences(self, ep: int) -> None:
+        """Old-owner side: once every LIVE rank acked adoption of ``ep``,
+        no more stale-routed pushes can arrive here (each rbA trails
+        that rank's last stale push on its per-link stream) — so the
+        fence release (rbF) sent NOW on the old→new link is ordered
+        after every forwarded push. Re-checked on exclusions too, so a
+        dead rank can't hold fences forever."""
+        with self._mig_cond:
+            out = self._await_acks.get(ep)
+            if out is None:
+                return
+            live = set(range(self.num_processes)) - self._excluded_ranks()
+            if not live <= self._adopt_acks.get(ep, set()):
+                return
+            del self._await_acks[ep]
+        for b, dst in out:
+            self.bus.send(dst, f"rbF:{self.name}",
+                          {"b": int(b), "ep": int(ep)})
+
+    def _on_fence_release(self, sender: int, payload: dict) -> None:
+        b, ep = int(payload.get("b", -1)), int(payload.get("ep", 0))
+        with self._mig_cond:
+            if b in self._fenced and self.router.epoch >= ep:
+                self._fenced.discard(b)
+            else:  # rbF beat my plan adoption (reordered control plane)
+                self._early_release.add((b, ep))
+            self._mig_cond.notify_all()
+        self.serve_parked()
+
+    def rebalance_settled(self) -> bool:
+        """No migration in flight at this rank: nothing fenced, no state
+        pending, no acks awaited, nothing parked — the coordinator only
+        plans over a fleet that reports settled at one epoch."""
+        with self._mig_cond:
+            return not (self._fenced or self._pending_state
+                        or self._await_acks or self._parked_pushes
+                        or self._early_state)
+
+    def _wait_settled(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        while True:
+            # adopt pending plans while waiting: a plan landing in this
+            # window stashes rbS state as early_state here (unsettled),
+            # and only THIS thread can adopt it — blocking without
+            # adopting would wedge until the deadline
+            if self._rb is not None:
+                self._rb.adopt_now()
+            with self._mig_cond:
+                if not (self._fenced or self._pending_state
+                        or self._await_acks or self._parked_pushes
+                        or self._early_state):
+                    return
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"table {self.name}: migration never settled "
+                        f"(fenced={sorted(self._fenced)}, "
+                        f"pending={sorted(self._pending_state)})")
+                self._mig_cond.wait(timeout=0.2)
+
+    def rebalance_table_stats(self) -> dict:
+        with self._mig_cond:
+            extra = {"fenced": len(self._fenced),
+                     "pending_state": len(self._pending_state),
+                     "xtra_blocks": len(self._xtra)}
+        return {"epoch": self.router.epoch, **self.rb_stats, **extra}
+
+    # ---- serve-path classification (rebalancer on)
+    def _pull_verdict(self, keys: np.ndarray, ep: int,
+                      owners: Optional[np.ndarray] = None) -> str:
+        """'serve' | 'park' | 'refuse' for a pull slice under MY current
+        table: keys not mine → the sender's table is stale (refuse with
+        mine) unless the FRAME's is newer (park until my adoption
+        catches up); keys mine but fenced/state-pending → park.
+        ``owners`` lets a caller that already routed the keys skip the
+        recompute (the hot serve path routes once per frame)."""
+        if owners is None:
+            owners = self.router.shard_of(keys)
+        if (owners != self.rank).any():
+            return "park" if ep > self.router.epoch else "refuse"
+        with self._mig_cond:
+            if self._fenced or self._pending_state:
+                blocks = {int(x)
+                          for x in np.unique(self.router.blocks_of(keys))}
+                if blocks & (self._fenced | self._pending_state):
+                    return "park"
+        return "serve"
+
+    def _pull_all_verdict(self) -> str:
+        with self._mig_cond:
+            return "park" if (self._fenced or self._pending_state) \
+                else "serve"
+
+    def _send_epoch_nack(self, sender: int, req: int) -> None:
+        ep, ov = self.router.table()
+        self.rb_stats["refused_pulls"] += 1
+        self.bus.send(sender, f"psE:{self.name}",
+                      {"req": int(req), "ep": ep,
+                       "ovb": [int(b) for b in ov],
+                       "ovo": [int(o) for o in ov.values()]})
+
+    # ---- push ingest (rebalancer on): classify → apply/forward/park
+    def _ingest_push(self, keys: np.ndarray, grads: np.ndarray,
+                     ep: int) -> None:
+        forwards: list[tuple[int, np.ndarray, np.ndarray]] = []
+        with self._mig_cond:
+            owners = self.router.shard_of(keys)
+            bad = (owners < 0) | (owners >= self.num_processes)
+            if bad.any():  # garbage keys from a stale run
+                self._drop("misrouted", -1, "push keys outside key space")
+                keys, grads, owners = (keys[~bad], grads[~bad],
+                                       owners[~bad])
+            mine = owners == self.rank
+            if not mine.all():
+                if ep > self.router.epoch:
+                    # the sender runs a NEWER table than me: park the
+                    # whole frame until my adoption catches up
+                    self._parked_pushes.append((keys, grads, ep))
+                    self.rb_stats["parked_frames"] += 1
+                    return
+                for o in np.unique(owners[~mine]):
+                    m = owners == o
+                    forwards.append((int(o), keys[m], grads[m]))
+                keys, grads = keys[mine], grads[mine]
+            if keys.size:
+                pend = self._pending_state
+                if pend:
+                    blocks = self.router.blocks_of(keys)
+                    pm = np.isin(blocks,
+                                 np.fromiter(pend, np.int64, len(pend)))
+                    if pm.any():  # inbound block, state still in transit
+                        self._parked_pushes.append(
+                            (keys[pm], grads[pm], ep))
+                        self.rb_stats["parked_frames"] += 1
+                        keys, grads = keys[~pm], grads[~pm]
+            if keys.size:
+                self._heat.touch(self.router.blocks_of(keys))
+                self._apply_keys_locked(keys, grads)
+        for o, k, g in forwards:
+            # forwarded slice: decoded f32 rows, no seq (the ORIGINAL
+            # frame was acked by this hop; the reliable layer covers
+            # the second hop like any other frame)
+            self.rb_stats["forwarded_pushes"] += 1
+            blob = k.tobytes() + np.ascontiguousarray(g,
+                                                      np.float32).tobytes()
+            self.bus.send(o, f"psP:{self.name}",
+                          {"n": int(k.size), "comm": "float32",
+                           "ep": self.router.epoch, **self._cfg_header()},
+                          blob=blob)
+
+    def _apply_keys_locked(self, keys: np.ndarray,
+                           grads: np.ndarray) -> None:
+        """Global-key twin of :meth:`_apply_rows` (caller holds the mig
+        lock; takes the state lock): dedup-sum over the WHOLE frame
+        first — identical math to the seed path — then split the unique
+        rows between the base slab and migrated-in blocks."""
+        grads = grads.reshape(keys.size, self.dim)
+        self._count_serve(push_rows=keys.size)
+        with self._state_lock:
+            uniq, inv = np.unique(keys, return_inverse=True)
+            g = np.zeros((uniq.size, self.dim), np.float32)
+            np.add.at(g, inv, grads)
+            base = (uniq >= self.shard_lo) \
+                & (uniq < self.shard_lo + self.part.shard_size)
+            if base.any():
+                self._update_block(self._base_state(),
+                                   uniq[base] - self.shard_lo, g[base])
+            if (~base).any():
+                rk, rg = uniq[~base], g[~base]
+                blocks = self.router.blocks_of(rk)
+                for b in np.unique(blocks):
+                    m = blocks == b
+                    st = self._xtra.get(int(b))
+                    if st is None:  # protocol hole — loud, not silent
+                        raise RuntimeError(
+                            f"table {self.name}: no state for migrated "
+                            f"block {int(b)} (keys routed here without "
+                            "an installed rbS)")
+                    lo, _ln = self.router.block_span(int(b))
+                    self._update_block(st, rk[m] - lo, rg[m])
+
+    def _drain_parked_pushes(self) -> None:
+        with self._mig_cond:
+            take, self._parked_pushes = self._parked_pushes, []
+        for keys, grads, ep in take:
+            self._ingest_push(keys, grads, ep)
+
+    def _read_rows_locked(self, keys: np.ndarray) -> np.ndarray:
+        """Gather rows for keys THIS shard currently owns, wherever they
+        live (base slab or migrated-in blocks); caller holds the state
+        lock and has already classified ownership."""
+        out = np.empty((keys.size, self.dim), np.float32)
+        base = (keys >= self.shard_lo) \
+            & (keys < self.shard_lo + self.part.shard_size)
+        if base.any():
+            out[base] = self._w[keys[base] - self.shard_lo]
+        if (~base).any():
+            rk = keys[~base]
+            ri = np.nonzero(~base)[0]
+            blocks = self.router.blocks_of(rk)
+            for b in np.unique(blocks):
+                m = blocks == b
+                st = self._xtra.get(int(b))
+                if st is None:
+                    raise RuntimeError(
+                        f"table {self.name}: no state for migrated "
+                        f"block {int(b)} on pull")
+                lo, _ln = self.router.block_span(int(b))
+                out[ri[m]] = st["w"][rk[m] - lo]
+        return out
 
     def _drop(self, reason: str, sender: int, detail: str) -> None:
         """Count a dropped frame; config mismatches (a peer launched at a
@@ -691,25 +1229,37 @@ class ShardedTable:
             self._fatal = (f"table {self.name}: dropped frame from peer "
                            f"{sender}: {detail}")
 
+    def _rb_cfg(self) -> int:
+        """The rebalance config a frame stamps: the key-block size when
+        the subsystem is armed, 0 when off. Divergence is a config
+        mismatch like a wrong world size — an rb-off peer would
+        silently drop overlay-routed pushes as misrouted and hang its
+        refused pulls to timeout, and a different block granularity
+        makes every overlay block id mean a different key range."""
+        return self.router.block_size if self._rb is not None else 0
+
     def _check_peer_config(self, sender: int, payload: dict) -> bool:
         ws = int(payload.get("ws", self.num_processes))
         nr = int(payload.get("nr", self.num_rows))
         dm = int(payload.get("dm", self.dim))
+        rb = int(payload.get("rb", 0))
         if ws != self.num_processes or nr != self.num_rows \
-                or dm != self.dim:
+                or dm != self.dim or rb != self._rb_cfg():
             self._drop("config", sender,
-                       f"peer sees world_size={ws} num_rows={nr} dim={dm},"
-                       f" mine are {self.num_processes}/{self.num_rows}/"
-                       f"{self.dim}")
+                       f"peer sees world_size={ws} num_rows={nr} dim={dm}"
+                       f" rebalance_block={rb}, mine are "
+                       f"{self.num_processes}/{self.num_rows}/"
+                       f"{self.dim}/{self._rb_cfg()}")
             return False
         return True
 
     def _cfg_header(self) -> dict:
         """Per-frame config stamp: a peer relaunched at a different world
-        size / table shape must poison the receiver (loud failure), never
-        silently train garbage."""
+        size / table shape — or with a divergent rebalance config —
+        must poison the receiver (loud failure), never silently train
+        garbage."""
         return {"ws": self.num_processes, "nr": self.num_rows,
-                "dm": self.dim}
+                "dm": self.dim, "rb": self._rb_cfg()}
 
     def _on_push(self, sender: int, payload: dict) -> None:
         try:
@@ -782,10 +1332,7 @@ class ShardedTable:
             self._drop("malformed", sender, "bad push blob size")
             return  # malformed frame from a stale run
         keys = np.frombuffer(blob[: 8 * n], np.int64)
-        offs = keys - self.shard_lo
-        if n and (offs.min() < 0 or offs.max() >= self.part.shard_size):
-            self._drop("misrouted", sender, "push keys outside my range")
-            return
+        self._count_serve(push_frames=1)
         if comm == "int8":
             scale = np.frombuffer(blob[8 * n: 12 * n], np.float32)
             codes = np.frombuffer(blob[12 * n:], np.int8
@@ -793,6 +1340,16 @@ class ShardedTable:
             grads = dequantize_rows_int8(codes, scale)
         else:
             grads = np.frombuffer(blob[8 * n:], np.float32)
+        if self._rb is not None:
+            # classify under the CURRENT table: apply what is mine,
+            # forward what migrated away, park what outruns my epoch
+            self._ingest_push(keys, grads.reshape(n, self.dim),
+                              int(payload.get("ep", 0)))
+            return
+        offs = keys - self.shard_lo
+        if n and (offs.min() < 0 or offs.max() >= self.part.shard_size):
+            self._drop("misrouted", sender, "push keys outside my range")
+            return
         self._apply_rows(offs, grads)  # read-only view is fine: never written
 
     def _handle_push_range(self, sender: int, payload: dict) -> None:
@@ -828,6 +1385,17 @@ class ShardedTable:
         if lo_local < 0 or lo_local + k > self.part.shard_size:
             self._drop("misrouted", sender, "range outside my shard")
             return
+        self._count_serve(push_frames=1)
+        if self._rb is not None and (self.router._overlay
+                                     or not self.rebalance_settled()):
+            # some of this home range may live elsewhere now: fall back
+            # to the keyed ingest (forwards the migrated rows) — range
+            # pushes are rare in rebalanced (sparse-hot) workloads, so
+            # the key materialization is paid only when it must be
+            self._ingest_push(np.arange(lo, lo + k, dtype=np.int64),
+                              grads.reshape(k, self.dim),
+                              int(payload.get("ep", 0)))
+            return
         self._apply_range(lo_local, grads)
 
     def _on_pull(self, sender: int, payload: dict) -> None:
@@ -839,15 +1407,40 @@ class ShardedTable:
             self._drop("malformed", sender, "pull without key blob")
             return
         keys = np.frombuffer(blob, np.int64)
+        clk = int(payload.get("clk", 0))
+        ep = int(payload.get("ep", 0))
+        if self._rb is not None:
+            owners = self.router.shard_of(keys)
+            if keys.size and ((owners < 0)
+                              | (owners >= self.num_processes)).any():
+                self._drop("misrouted", sender,
+                           "pull keys outside key space")
+                return
+            v = self._pull_verdict(keys, ep, owners=owners)
+            if v == "refuse":
+                self._send_epoch_nack(sender, req)
+                return
+            admitted = self._cons is None or self._cons.admit_pull(clk)
+            if v == "park" or not admitted:
+                with self._park_lock:
+                    self._parked.append((sender, req, keys, clk, ep))
+                # re-check (park/drain race, same as the seed path):
+                # adoption/unfence/clock between verdict and append
+                # would have drained an empty buffer and never retried
+                if self._pull_verdict(keys, ep) == "serve" and (
+                        self._cons is None or self._cons.admit_pull(clk)):
+                    self.serve_parked()
+                return
+            self._serve_pull(sender, req, keys, clk)
+            return
         offs = keys - self.shard_lo
         if keys.size and (offs.min() < 0
                           or offs.max() >= self.part.shard_size):
             self._drop("misrouted", sender, "pull keys outside my range")
             return
-        clk = int(payload.get("clk", 0))
         if self._cons is not None and not self._cons.admit_pull(clk):
             with self._park_lock:  # reference PendingBuffer: park the Get
-                self._parked.append((sender, req, keys, clk))
+                self._parked.append((sender, req, keys, clk, 0))
             # re-check: a clock change between the admission test and the
             # append would have drained an empty buffer and never retried
             if self._cons.admit_pull(clk):
@@ -884,9 +1477,35 @@ class ShardedTable:
         # stamp BEFORE reading state: the certificate must be a lower
         # bound on what the rows contain, and clocks only advance
         stamp = self._serve_stamp(sender, clk)
-        offs = keys - self.shard_lo
-        with self._state_lock:
-            rows = self._w[offs]  # fancy indexing: already a fresh array
+        if self._rb is not None:
+            # re-verify ownership/fences ATOMICALLY with the read: a
+            # concurrent adoption between the caller's verdict and here
+            # may have shipped a block away (its xtra gone, or a home
+            # block's slab copy now dead) — serving would be stale or
+            # crash. A failed re-check re-parks; the parked path
+            # re-evaluates (including refusal) on the next event.
+            with self._mig_cond:
+                owners = self.router.shard_of(keys)
+                ok = bool((owners == self.rank).all())
+                if ok and (self._fenced or self._pending_state):
+                    blocks = {int(x) for x in
+                              np.unique(self.router.blocks_of(keys))}
+                    ok = not (blocks
+                              & (self._fenced | self._pending_state))
+                if ok:
+                    with self._state_lock:
+                        rows = self._read_rows_locked(keys)
+            if not ok:
+                with self._park_lock:
+                    self._parked.append((sender, req, keys, clk, 0))
+                self.serve_parked()
+                return
+            self._heat.touch(self.router.blocks_of(keys))
+        else:
+            offs = keys - self.shard_lo
+            with self._state_lock:
+                rows = self._w[offs]  # fancy indexing: a fresh array
+        self._count_serve(pull_requests=1, pull_rows=keys.size)
         head, blob = self._reply_head_blob(req, rows)
         head["stamp"] = stamp
         acks = self._drain_acks_for(sender)
@@ -899,21 +1518,61 @@ class ShardedTable:
         if not self._check_peer_config(sender, payload):
             return  # requester times out loudly; my next tick raises
         clk = int(payload.get("clk", 0))
-        if self._cons is not None and not self._cons.admit_pull(clk):
+        admitted = self._cons is None or self._cons.admit_pull(clk)
+        parked = not admitted or (
+            self._rb is not None and self._pull_all_verdict() == "park")
+        if parked:
+            # a shard assembly must not ship while a migrated block is
+            # in transit: the live copy would be on neither side
             with self._park_lock:
-                self._parked.append((sender, req, None, clk))
-            if self._cons.admit_pull(clk):  # same park/drain race as above
-                self.serve_parked()
+                self._parked.append((sender, req, None, clk, 0))
+            if (self._cons is None or self._cons.admit_pull(clk)) and (
+                    self._rb is None
+                    or self._pull_all_verdict() == "serve"):
+                self.serve_parked()  # park/drain race, as above
             return
         self._serve_pull_all(sender, req, clk)
 
     def _serve_pull_all(self, sender: int, req: int,
                         clk: int = 0) -> None:
         stamp = self._serve_stamp(sender, clk)
-        with self._state_lock:
-            rows = self._w.copy()  # full shard: copy out of the lock
+        xb: list[int] = []
+        xl: list[int] = []
+        if self._rb is not None:
+            # settled-check ATOMIC with the read (same race as
+            # _serve_pull): a block shipping away between the caller's
+            # verdict and this copy would vanish from every reply
+            with self._mig_cond:
+                ok = not (self._fenced or self._pending_state)
+                if ok:
+                    with self._state_lock:
+                        rows = self._w.copy()
+                        if self._xtra:
+                            # migrated-in blocks ride along after the
+                            # base shard; the assembler overlays them
+                            # over every (stale) home copy in pass 2
+                            parts = [rows]
+                            for b in sorted(self._xtra):
+                                arr = self._xtra[b]["w"]
+                                xb.append(int(b))
+                                xl.append(int(arr.shape[0]))
+                                parts.append(arr.copy())
+                            rows = np.concatenate(parts)
+            if not ok:
+                with self._park_lock:
+                    self._parked.append((sender, req, None, clk, 0))
+                self.serve_parked()
+                return
+        else:
+            with self._state_lock:
+                rows = self._w.copy()  # full shard: copy out of the lock
+        self._count_serve(pull_requests=1, pull_rows=rows.shape[0])
         head, blob = self._reply_head_blob(req, rows)
         head["lo"] = self.shard_lo
+        head["nb"] = int(self.part.shard_size)
+        if xb:
+            head["xb"] = xb
+            head["xl"] = xl
         head["stamp"] = stamp
         acks = self._drain_acks_for(sender)
         if acks:
@@ -933,17 +1592,37 @@ class ShardedTable:
             self._flush_acks()
         with self._push_cond:
             self._push_cond.notify_all()
-        if self._cons is None:
+        self._maybe_release_fences(self.router.epoch)  # exclusions advance
+        if self._cons is None and self._rb is None:
             return
         # admission is evaluated ONCE per entry: global_min advances
         # concurrently, and a flip between two evaluations must not let an
-        # entry fall between "not ready" and "not kept"
+        # entry fall between "not ready" and "not kept". With the
+        # rebalancer on, an entry additionally waits for its blocks'
+        # fences — and a parked slice whose keys MOVED AWAY while it
+        # waited is refused with the new table instead of served wrong.
         with self._park_lock:
-            ready, still = [], []
+            ready, still, refuse = [], [], []
             for p in self._parked:
-                (ready if self._cons.admit_pull(p[3]) else still).append(p)
+                admitted = self._cons is None \
+                    or self._cons.admit_pull(p[3])
+                if self._rb is not None:
+                    v = (self._pull_all_verdict() if p[2] is None
+                         else self._pull_verdict(p[2], p[4]))
+                    if v == "refuse":
+                        refuse.append(p)
+                        continue
+                    if v == "park" or not admitted:
+                        still.append(p)
+                        continue
+                elif not admitted:
+                    still.append(p)
+                    continue
+                ready.append(p)
             self._parked = still
-        for sender, req, keys, clk in ready:
+        for sender, req, _keys, _clk, _ep in refuse:
+            self._send_epoch_nack(sender, req)
+        for sender, req, keys, clk, _ep in ready:
             if keys is None:
                 self._serve_pull_all(sender, req, clk)
             else:
@@ -954,7 +1633,7 @@ class ShardedTable:
         if acks:  # piggybacked push acks: settle before anything else
             self._settle_acks(acks)
         blob = payload.get("__blob__")
-        req = int(payload.get("req", -1))
+        rid = int(payload.get("req", -1))
         if blob is None:
             self._drop("malformed", sender, "pull reply without blob")
             return
@@ -974,7 +1653,8 @@ class ShardedTable:
                 return
             rows = np.frombuffer(blob, np.float32).reshape(-1, self.dim)
         with self._reply_cond:
-            if req in self._replies:
+            gid = self._rid_gid.get(rid)
+            if gid is not None and gid in self._replies:
                 # wire accounting counts ACTUAL bytes received
                 # (compressed when compressed) — the pull leg's half of
                 # bytes/row-moved. Under the lock (the issue side bumps
@@ -982,10 +1662,63 @@ class ShardedTable:
                 # for live requests: a late reply to a cancelled
                 # prefetch must not inflate the counter.
                 self.bytes_pulled += len(blob)
-                self._replies[req][sender] = (
-                    rows, int(payload.get("stamp", 0)))
-                self._reply_t[req] = time.monotonic()
+                self._replies[gid][rid] = (
+                    rows, int(payload.get("stamp", 0)), payload)
+                self._reply_t[gid] = time.monotonic()
                 self._reply_cond.notify_all()
+
+    def _on_epoch_nack(self, sender: int, payload: dict) -> None:
+        """Client side of the pull-leg epoch fence: the owner I routed a
+        slice to no longer owns some of its keys — it refused the WHOLE
+        leg and sent its routing table. The leg re-routes IMMEDIATELY
+        using the refusal's table (progress must not wait for my next
+        tick), but table ADOPTION itself is deferred to the training
+        thread (tick / finalize / the pull-wait poll): adoption sends
+        the rbA whose per-link ordering promises 'no more stale pushes
+        from me', and this handler runs on the bus receive thread —
+        concurrent with a possibly mid-flight old-table push send, so
+        an ack from HERE could overtake that push and release a fence
+        early. Keys the new table makes LOCAL join the group's
+        extra-local set and are read at wait() time, under the same
+        fence rules."""
+        rid = int(payload.get("req", -1))
+        ep = int(payload.get("ep", 0))
+        ov = {int(b): int(o) for b, o in
+              zip(payload.get("ovb", ()), payload.get("ovo", ()))}
+        if self._rb is not None and ep > self.router.epoch:
+            note = getattr(self._rb, "note_plan", None)
+            if note is not None:
+                note(self.name, ep, ov)  # training thread adopts it
+        sends: list[tuple[int, int, int, np.ndarray]] = []
+        with self._reply_cond:
+            gid = self._rid_gid.pop(rid, None)
+            grp = self._groups.get(gid) if gid is not None else None
+            if grp is None:
+                return  # finished/cancelled group: nothing to re-route
+            leg = grp["legs"].pop(rid, None)
+            if leg is None:
+                return
+            _old_owner, idx = leg
+            keys = grp["uniq"][idx]
+            if ep >= self.router.epoch:  # route by the fresher table
+                owners = self.router.shard_of_with(keys, ov)
+            else:
+                owners = self._owners_of(keys)
+            for o in np.unique(owners):
+                m = owners == o
+                if o == self.rank:
+                    grp["extra_local"].append(idx[m])
+                    continue
+                rid2 = self._next_req()
+                grp["legs"][rid2] = (int(o), idx[m])
+                self._rid_gid[rid2] = gid
+                self.bytes_pulled += keys[m].nbytes
+                sends.append((int(o), rid2, grp["clk"], keys[m]))
+            self._reply_cond.notify_all()
+        for o, rid2, clk, kslice in sends:
+            self.bus.send(o, f"psG:{self.name}",
+                          {"req": rid2, "clk": clk, **self._ep_header(),
+                           **self._cfg_header()}, blob=kslice.tobytes())
 
     # --------------------------------------------------------- client side
     def bind_consistency(self, cons) -> None:
@@ -1104,29 +1837,116 @@ class ShardedTable:
             self._req += 1
             return self._req
 
-    def _await_replies(self, req: int, owners: set[int],
+    def _missing_legs_locked(self, gid: int) -> dict[int, int]:
+        """Outstanding wire legs of a pull group: ``rid -> owner`` for
+        every leg without a reply (own-rank legs are read locally at
+        wait() and never awaited). Caller holds the reply cond."""
+        grp = self._groups.get(gid)
+        if grp is None:
+            return {}
+        got = self._replies.get(gid, {})
+        return {rid: o for rid, (o, _i) in grp["legs"].items()
+                if o != self.rank and rid not in got}
+
+    def _cleanup_group_locked(self, gid: int) -> None:
+        self._replies.pop(gid, None)
+        self._reply_t.pop(gid, None)
+        grp = self._groups.pop(gid, None)
+        if grp is not None:
+            for rid in grp["legs"]:
+                self._rid_gid.pop(rid, None)
+
+    def _take_group(self, gid: int) -> tuple[dict, list]:
+        """Detach a completed group's final leg map + extra-local idx
+        lists (the psE re-router may have reshaped both since issue)."""
+        with self._reply_cond:
+            grp = self._groups.pop(gid, None)
+            if grp is None:
+                return {}, []
+            for rid in grp["legs"]:
+                self._rid_gid.pop(rid, None)
+            return grp["legs"], grp["extra_local"]
+
+    def _await_replies(self, gid: int,
                        timeout: Optional[float] = None) -> dict:
         deadline = time.monotonic() + (self.pull_timeout
                                        if timeout is None else timeout)
-        with self._reply_cond:
-            while set(self._replies[req]) < owners:
+        while True:
+            with self._reply_cond:
+                if not self._missing_legs_locked(gid):
+                    return self._replies.pop(gid)
                 self._reply_cond.wait(timeout=0.5)
-                if set(self._replies[req]) >= owners:
-                    break
-                dead = (self.monitor.check()
-                        if self.monitor is not None else set())
-                if dead & owners:
-                    self._replies.pop(req, None)
-                    self._reply_t.pop(req, None)
-                    raise PeerFailureError(dead & owners)
-                if time.monotonic() > deadline:
-                    missing = sorted(owners - set(self._replies[req]))
-                    self._replies.pop(req, None)
-                    self._reply_t.pop(req, None)
-                    raise TimeoutError(
-                        f"pull({self.name}): owners {missing} never "
-                        "replied")
-            return self._replies.pop(req)
+                miss = self._missing_legs_locked(gid)
+                if not miss:
+                    return self._replies.pop(gid)
+                owners = set(miss.values())
+            # ---- lock released: adoption / monitor / deadline. This
+            # runs on the TRAINING thread — the one context where table
+            # adoption is race-free against the push path — and a
+            # refused leg re-routed mid-migration may be PARKED at its
+            # new owner waiting for exactly this rank's adoption ack,
+            # so the wait loop must adopt pending plans to make
+            # progress (not only tick())
+            if self._rb is not None:
+                self._rb.adopt_now()
+            dead = (self.monitor.check()
+                    if self.monitor is not None else set())
+            if dead & owners:
+                with self._reply_cond:
+                    self._cleanup_group_locked(gid)
+                raise PeerFailureError(dead & owners)
+            if time.monotonic() > deadline:
+                with self._reply_cond:
+                    self._cleanup_group_locked(gid)
+                raise TimeoutError(
+                    f"pull({self.name}): owners {sorted(owners)} "
+                    "never replied")
+
+    def _read_local(self, gkeys: np.ndarray, clk: int,
+                    timeout: Optional[float] = None) -> np.ndarray:
+        """Read rows of ``gkeys`` from the LOCAL shard (PullFuture's
+        local leg). Seed path: a direct slab gather. With the
+        rebalancer on this must honor the same rules a remote owner
+        would: blocks fenced or state-pending WAIT (a fenced serve
+        could be staler than the bound), and keys that migrated AWAY
+        since issue round-trip to their current owner."""
+        if self._rb is None:
+            self._count_serve(pull_rows=gkeys.size)
+            with self._state_lock:
+                return self._w[gkeys - self.shard_lo]
+        deadline = time.monotonic() + (self.pull_timeout
+                                       if timeout is None else timeout)
+        while True:
+            with self._mig_cond:
+                owners = self.router.shard_of(gkeys)
+                mine = owners == self.rank
+                blocked = False
+                if mine.any() and (self._fenced or self._pending_state):
+                    bl = {int(x) for x in
+                          np.unique(self.router.blocks_of(gkeys[mine]))}
+                    blocked = bool(bl & (self._fenced
+                                         | self._pending_state))
+                if blocked:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"pull({self.name}): local rows fenced "
+                            "mid-migration and never released")
+                    self._mig_cond.wait(timeout=0.1)
+                    continue
+                if mine.all():
+                    self._count_serve(pull_rows=gkeys.size)
+                    self._heat.touch(self.router.blocks_of(gkeys))
+                    with self._state_lock:
+                        return self._read_rows_locked(gkeys)
+            # some keys moved away since issue: fetch them from their
+            # current owner (rare — only a migration window hits this)
+            out = np.empty((gkeys.size, self.dim), np.float32)
+            out[~mine] = self._issue_pull(gkeys[~mine], clk).wait(
+                timeout=max(deadline - time.monotonic(), 0.1))
+            out[mine] = self._read_local(gkeys[mine], clk,
+                                         max(deadline - time.monotonic(),
+                                             0.1))
+            return out
 
     def _wait_local_admission(self, clk: int,
                               timeout: Optional[float] = None) -> None:
@@ -1167,7 +1987,7 @@ class ShardedTable:
             uniq, inv = np.unique(keys, return_inverse=True)
         else:  # the verbatim seed wire (bench A/B arm; cache refused)
             uniq, inv = keys, None
-        owners = self.part.shard_of(uniq)
+        owners = self._owners_of(uniq)
         out_u = np.empty((uniq.size, self.dim), np.float32)
         need = np.ones(uniq.size, bool)  # rows still to fetch over wire
         local_idx = None
@@ -1192,25 +2012,34 @@ class ShardedTable:
             mask = need & (owners == o)
             if mask.any():
                 remote.append((o, np.nonzero(mask)[0]))
-        req = 0  # a fully-local pull (own shard + cache hits) allocates
+        gid = 0  # a fully-local pull (own shard + cache hits) allocates
         if remote:  # no request slot and touches no wire state at all
-            req = self._next_req()
+            gid = self._next_req()
             with self._reply_cond:
-                self._replies[req] = {}
+                self._replies[gid] = {}
+                grp = {"clk": clk, "uniq": uniq, "legs": {},
+                       "extra_local": []}
+                self._groups[gid] = grp
             for o, idx in remote:
+                # one wire request id PER LEG, registered BEFORE the
+                # send (a reply must never beat its bookkeeping); the
+                # psE re-router re-splits a refused leg mid-flight
+                rid = self._next_req()
                 kslice = uniq[idx]
-                self.bus.send(o, f"psG:{self.name}",
-                              {"req": req, "clk": clk,
-                               **self._cfg_header()},
-                              blob=kslice.tobytes())
-                # under the reply lock: replies land on the receive
-                # thread and bump the same counter (non-atomic RMW)
                 with self._reply_cond:
+                    grp["legs"][rid] = (o, idx)
+                    self._rid_gid[rid] = gid
+                    # under the reply lock: replies land on the receive
+                    # thread and bump the same counter (non-atomic RMW)
                     self.bytes_pulled += kslice.nbytes
+                self.bus.send(o, f"psG:{self.name}",
+                              {"req": rid, "clk": clk,
+                               **self._ep_header(), **self._cfg_header()},
+                              blob=kslice.tobytes())
                 wire_rows += idx.size
         self.timers.record_pull_rows(requested=keys.size, wire=wire_rows,
                                      hits=hits, lookups=lookups)
-        fut = PullFuture(self, req, keys, uniq, inv, out_u, remote,
+        fut = PullFuture(self, gid, keys, uniq, inv, out_u, remote,
                          local_idx, clk)
         if self._cache is not None and remote:
             self._cache_note_issue(fut)  # push-log replay anchor
@@ -1263,31 +2092,71 @@ class ShardedTable:
 
     def pull_all(self) -> np.ndarray:
         """Assemble the full table (dense pulls / finalize / eval): each
-        owner ships its shard once — an all-gather over the bus."""
-        req = self._next_req()
-        with self._reply_cond:
-            self._replies[req] = {}
+        owner ships its shard once — an all-gather over the bus. With
+        the rebalancer on, every owner's reply additionally carries its
+        migrated-IN blocks, and assembly runs two passes: base shards
+        first, then every overlay block over its (stale) home copy —
+        the overlay entry is the authoritative one by construction
+        (exactly one current owner per block)."""
+        if self._rb is not None:
+            self._rb.adopt_now()  # a plan landing post-last-tick still
+            self._wait_settled(self.pull_timeout)  # needs my rbA; and my
+            # own in-transit blocks must land before I can assemble
         peers = set(range(self.num_processes)) - {self.rank}
-        for o in peers:
-            self.bus.send(o, f"psA:{self.name}",
-                          {"req": req, "clk": self._my_clk(),
-                           **self._cfg_header()})
+        gid = 0
+        legs: dict[int, tuple] = {}
+        if peers:
+            gid = self._next_req()
+            with self._reply_cond:
+                self._replies[gid] = {}
+                grp = {"clk": self._my_clk(), "uniq": None, "legs": {},
+                       "extra_local": []}
+                self._groups[gid] = grp
+            for o in sorted(peers):
+                rid = self._next_req()
+                with self._reply_cond:
+                    grp["legs"][rid] = (o, None)
+                    self._rid_gid[rid] = gid
+                self.bus.send(o, f"psA:{self.name}",
+                              {"req": rid, "clk": self._my_clk(),
+                               **self._ep_header(), **self._cfg_header()})
         out = np.empty((self.part.padded, self.dim), np.float32)
         with self._state_lock:
             out[self.shard_lo:self.shard_lo + self.part.shard_size] = self._w
+        self._count_serve(pull_rows=self.part.shard_size)
         if peers:
             # wire bytes are counted at reply receipt (_on_pull_reply),
             # actual bytes — an int8 wire's replies count compressed.
             # Shards deliberately bypass the row cache: a full-table
             # assembly would evict the working set for rows finalize/
             # eval reads once.
-            got = self._await_replies(req, peers)
-            for o, (rows, _stamp) in got.items():
-                lo = o * self.part.shard_size
-                out[lo:lo + rows.shape[0]] = rows
+            got = self._await_replies(gid)
+            legs, _extra = self._take_group(gid)
+            for rid, (o, _none) in legs.items():  # pass 1: base shards
+                rows = got[rid][0]
+                pl = got[rid][2]
+                lo = int(pl.get("lo", o * self.part.shard_size))
+                nb = int(pl.get("nb", rows.shape[0]))
+                out[lo:lo + nb] = rows[:nb]
+        if self._rb is not None:
+            # pass 2: overlay blocks (peers' and my own) overwrite the
+            # stale home-slab copies pass 1 placed
+            for rid, (o, _none) in legs.items():
+                rows = got[rid][0]
+                pl = got[rid][2]
+                off = int(pl.get("nb", rows.shape[0]))
+                for b, ln in zip(pl.get("xb") or (), pl.get("xl") or ()):
+                    blo, _bln = self.router.block_span(int(b))
+                    out[blo:blo + int(ln)] = rows[off:off + int(ln)]
+                    off += int(ln)
+            with self._state_lock:
+                for b, st in self._xtra.items():
+                    blo, _bln = self.router.block_span(int(b))
+                    out[blo:blo + st["w"].shape[0]] = st["w"]
         with self._reply_cond:
-            self._replies.pop(req, None)
-            self._reply_t.pop(req, None)
+            # _await_replies popped the reply map and _take_group the
+            # legs; only the arrival timestamp is left to drop
+            self._reply_t.pop(gid, None)
         return out[: self.num_rows]
 
     # ------------------------------------------------------- push pipeline
@@ -1505,14 +2374,21 @@ class ShardedTable:
         self.rows_pushed += keys.size if n_rows is None else n_rows
         if not coalesced:  # async path: dedup on the sender thread
             keys, grads = self._coalesce_for_wire(keys, grads)
-        owners = self.part.shard_of(keys)
+        owners = self._owners_of(keys)
         for o in range(self.num_processes):
             mask = owners == o
             if not mask.any():
                 continue
             if o == self.rank:
                 # local rows never cross a wire — full precision always
-                self._apply_rows(keys[mask] - self.shard_lo, grads[mask])
+                if self._rb is not None:
+                    # the classify-under-lock ingest: a concurrent
+                    # adoption may have just shipped these rows away
+                    self._ingest_push(keys[mask], grads[mask],
+                                      self.router.epoch)
+                else:
+                    self._apply_rows(keys[mask] - self.shard_lo,
+                                     grads[mask])
                 continue
             kb = keys[mask].tobytes()
             if self.push_comm == "int8":
@@ -1521,7 +2397,7 @@ class ShardedTable:
             else:
                 gb = grads[mask].tobytes()
             head = {"n": int(mask.sum()), "comm": self.push_comm,
-                    **self._cfg_header()}
+                    **self._ep_header(), **self._cfg_header()}
             if self.async_push:
                 head["seq"] = self._take_push_seq(o)
             self.bus.send(o, f"psP:{self.name}", head, blob=kb + gb)
@@ -1559,7 +2435,17 @@ class ShardedTable:
             if hi <= lo:
                 continue
             if o == self.rank:
-                self._apply_range(0, grad[lo:hi])
+                if self._rb is not None and (self.router._overlay
+                                             or not
+                                             self.rebalance_settled()):
+                    # part of my home range may live elsewhere now: the
+                    # keyed ingest forwards migrated rows instead of
+                    # writing them into the dead slab copy (the same
+                    # fallback _handle_push_range applies on receive)
+                    self._ingest_push(np.arange(lo, hi, dtype=np.int64),
+                                      grad[lo:hi], self.router.epoch)
+                else:
+                    self._apply_range(0, grad[lo:hi])
                 continue
             if self.push_comm == "int8":
                 codes, scale = quantize_rows_int8(grad[lo:hi], self._q_rng)
@@ -1567,7 +2453,7 @@ class ShardedTable:
             else:
                 gb = grad[lo:hi].tobytes()
             head = {"lo": lo, "comm": self.push_comm,
-                    **self._cfg_header()}
+                    **self._ep_header(), **self._cfg_header()}
             if self.async_push:
                 head["seq"] = self._take_push_seq(o)
             self.bus.send(o, f"psR:{self.name}", head, blob=gb)
@@ -1577,16 +2463,25 @@ class ShardedTable:
     # ------------------------------------------------------------- accounting
     def local_bytes(self) -> int:
         """Bytes of table + optimizer state THIS process holds — the ~1/N
-        sharding claim the smoke test asserts."""
+        sharding claim the smoke test asserts (migrated-in blocks count:
+        they are live state only this process holds)."""
         n = self._w.nbytes
         if self._acc is not None:
             n += self._acc.nbytes
         if self._m is not None:
             n += self._m.nbytes + self._v.nbytes + self._steps.nbytes
+        with self._state_lock:
+            for st in self._xtra.values():
+                n += sum(a.nbytes for a in st.values() if a is not None)
         return n
 
     # ------------------------------------------------------------- state I/O
     def shard_state_dict(self) -> dict:
+        if self._rb is not None:
+            # a checkpoint must never capture a block mid-flight (the
+            # old owner already shipped it, the new owner has not
+            # installed it: the step would restore without that state)
+            self._wait_settled(self.pull_timeout)
         with self._state_lock:
             out = {"w": self._w.copy(), "lo": np.asarray(self.shard_lo)}
             if self._acc is not None:
@@ -1595,6 +2490,25 @@ class ShardedTable:
                 out["m"] = self._m.copy()
                 out["v"] = self._v.copy()
                 out["steps"] = self._steps.copy()
+            ep, ov = self.router.table()
+            if ov or self._xtra:
+                # the ROUTING EPOCH + overlay + migrated-in block state
+                # ride the checkpoint, so a restored fleet routes (and
+                # serves) exactly like the live peers it rejoins. An
+                # EMPTY overlay (every block back home) is deliberately
+                # not recorded even at epoch > 0: the layout is exactly
+                # the base partition again, so the checkpoint stays
+                # elastic-reshardable and restores epoch-0 everywhere
+                # (consistent fleet-wide — all ranks restore one step)
+                out["ep"] = np.asarray(ep)
+                out["rb_block"] = np.asarray(self.router.block_size)
+                out["ovb"] = np.asarray(sorted(ov), np.int64)
+                out["ovo"] = np.asarray([ov[b] for b in sorted(ov)],
+                                        np.int64)
+                out["xtra"] = {
+                    str(b): {k: v.copy() for k, v in st.items()
+                             if v is not None}
+                    for b, st in self._xtra.items()}
         return out
 
     def load_shard_state_dict(self, state: dict) -> None:
@@ -1602,6 +2516,13 @@ class ShardedTable:
             raise ValueError(
                 f"shard checkpoint lo={int(state['lo'])} belongs to a "
                 f"different rank/partition (mine starts at {self.shard_lo})")
+        ep = int(state["ep"]) if "ep" in state else 0
+        if ep and self._rb is None:
+            raise ValueError(
+                "checkpoint was saved with a rebalanced (epoch "
+                f"{ep}) routing table; restoring it requires "
+                "MINIPS_REBALANCE so the overlay routing/serving "
+                "machinery is armed")
         with self._state_lock:
             self._w[...] = state["w"]
             if self._acc is not None:
@@ -1615,6 +2536,29 @@ class ShardedTable:
                 self._m[...] = state["m"]
                 self._v[...] = state["v"]
                 self._steps[...] = state["steps"]
+            if ep:
+                blk = int(state.get("rb_block", self.router.block_size))
+                if blk != self.router.block_size:
+                    # the overlay's block ids are meaningless at another
+                    # granularity — rebuild the router at the saved one,
+                    # and the heat accountant with it (its counters are
+                    # indexed by the router's block id space)
+                    from minips_tpu.balance.heat import HeatAccountant
+
+                    self.router = BlockRouter(self.part, blk)
+                    self._heat = HeatAccountant(self.router.num_blocks,
+                                                self._heat.decay)
+                ov = {int(b): int(o) for b, o in
+                      zip(np.asarray(state["ovb"]).tolist(),
+                          np.asarray(state["ovo"]).tolist())}
+                if self.router.apply(ep, ov) is None and \
+                        self.router.epoch != ep:
+                    raise ValueError(
+                        f"checkpoint routing epoch {ep} is older than "
+                        f"the live table's {self.router.epoch}")
+                self._xtra = {
+                    int(b): {k: np.array(v) for k, v in st.items()}
+                    for b, st in (state.get("xtra") or {}).items()}
 
     # Checkpointer-protocol aliases: each process checkpoints ITS OWN
     # shard (the reference dumps per-server KVTable state, SURVEY.md §3.5)
@@ -1635,7 +2579,8 @@ class ShardedPSTrainer:
 
     def __init__(self, tables: dict[str, ShardedTable], bus,
                  num_processes: int, *, staleness: float = 0,
-                 gate_timeout: float = 60.0, monitor=None):
+                 gate_timeout: float = 60.0, monitor=None,
+                 rebalance: Optional[str] = None):
         self.tables = tables
         self.bus = bus
         self.num_processes = num_processes
@@ -1657,6 +2602,16 @@ class ShardedPSTrainer:
         for t in tables.values():
             t.bind_consistency(self)
         self.gossip.add_listener(self._drain_parked)
+        # heat-aware shard rebalancing (balance/): OFF by default —
+        # explicit spec wins, else $MINIPS_REBALANCE, else disabled
+        spec = rebalance if rebalance is not None \
+            else os.environ.get("MINIPS_REBALANCE", "")
+        self.rebalancer = None
+        if spec and spec != "0":
+            from minips_tpu.balance.rebalancer import (RebalanceConfig,
+                                                       Rebalancer)
+
+            self.rebalancer = Rebalancer(self, RebalanceConfig.parse(spec))
 
     def admit_pull(self, clk: int) -> bool:
         """Reference ``model->Get`` admission: serve a pull stamped with
@@ -1727,6 +2682,12 @@ class ShardedPSTrainer:
             if drain:
                 t.flush_pushes(acks=False)  # a jammed drain poisons…
             t.check_fatal()                 # …and this raises, no hang
+        if self.rebalancer is not None:
+            # THE clock boundary: step-k pushes are drained to the bus
+            # above, the clock frame has not gone out yet — adopt any
+            # pending routing table here (epoch fence point), decay +
+            # gossip heat, and (coordinator) maybe plan a migration
+            self.rebalancer.on_tick()
         self.clock += 1
         self.gossip.publish_local([self.clock])
         self.gate.wait(self.clock)
@@ -1747,6 +2708,11 @@ class ShardedPSTrainer:
         """Two-sided quiesce: my pushes applied at all owners (their acks)
         AND all peers' pushes applied at my shards (their flushes). After
         this, pull/pull_all return identical rows on every live process."""
+        if self.rebalancer is not None:
+            # no further plans; a plan that landed after my last tick
+            # still gets adopted + acked here so peers' fences release
+            self.rebalancer.stop()
+            self.rebalancer.adopt_now()
         for t in self.tables.values():
             t.flush_pushes()  # async tail: drained before the flush frame
             t.check_fatal()
@@ -1872,6 +2838,25 @@ class ShardedPSTrainer:
         (utils/timing.CommTimers.summary fields)."""
         return CommTimers.aggregate(
             [t.timers for t in self.tables.values()])
+
+    def serve_stats(self) -> dict:
+        """Per-owner serve-load counters summed over tables (always on):
+        requests/rows THIS process served as an owner — the done-line
+        field sweeps compute max/mean per-shard serve load from, i.e.
+        the partition-imbalance observable the rebalancer acts on."""
+        out = {"pull_requests": 0, "pull_rows": 0,
+               "push_frames": 0, "push_rows": 0}
+        for t in self.tables.values():
+            with t._serve_lock:
+                for k in out:
+                    out[k] += t.serve[k]
+        return out
+
+    def rebalance_stats(self) -> Optional[dict]:
+        """Rebalancer counters (balance/rebalancer.py) — None when the
+        subsystem is off, so scrapers can tell 'off' from 'idle'."""
+        return (self.rebalancer.stats()
+                if self.rebalancer is not None else None)
 
     def cache_stats(self) -> Optional[dict]:
         """Merged row-cache counters over all tables (None when every

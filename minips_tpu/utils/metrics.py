@@ -69,4 +69,12 @@ def wire_record(trainer) -> dict:
         # the respective layer is off ('off' vs 'clean' distinguishable)
         "reliable": trainer.reliable_stats(),
         "chaos": trainer.chaos_stats(),
+        # per-owner serve-load counters (ALWAYS on): requests/rows this
+        # process served as an owner — max/mean across ranks is the
+        # partition-imbalance observable the heat-aware rebalancer acts
+        # on, measurable even with the rebalancer off
+        "serve": trainer.serve_stats(),
+        # rebalancer counters (balance/): None when the subsystem is
+        # off (distinguishable from an armed-but-idle run)
+        "rebalance": trainer.rebalance_stats(),
     }

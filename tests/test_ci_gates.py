@@ -14,7 +14,8 @@ REPO = pathlib.Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO / "ci"))
 
 from bench_regression import (cache_tripwires, chaos_tripwires,  # noqa: E402
-                              compare, main, throughput_points)
+                              compare, main, rebalance_tripwires,
+                              throughput_points)
 
 
 def _art(points):
@@ -167,6 +168,53 @@ def test_chaos_off_arms_never_enter_the_throughput_gate():
         "completed": True, "rows_per_sec_survived": 123.0}
     assert compare(_chaos_art(), art, 0.10) == []
     assert compare(art, _chaos_art(), 0.10) == []
+
+
+def _rebal_art(static_imb=2.8, rb_imb=1.4, migrations=3,
+               completed=True, lost=0):
+    return {"rebalance_3proc": {
+        "permuted": {"completed": True,
+                     "rows_per_sec_per_process": 100.0},
+        "static": {"completed": True, "rows_per_sec_skewed": 40.0,
+                   "serve_load_imbalance": static_imb,
+                   "wire_frames_lost": 0},
+        "rebalance": {"completed": completed,
+                      "rows_per_sec_skewed": 60.0,
+                      "serve_load_imbalance": rb_imb,
+                      "migrations": migrations,
+                      "wire_frames_lost": lost},
+    }}
+
+
+def test_rebalance_tripwire_passes_on_healthy_sweep():
+    assert rebalance_tripwires(_rebal_art()) == []
+    assert rebalance_tripwires({"metric": "m"}) == []  # vacuous
+
+
+def test_rebalance_tripwire_fails_without_migration_or_improvement():
+    probs = rebalance_tripwires(_rebal_art(migrations=0))
+    assert any("REBAL-SKEW" in p and "0 migrations" in p for p in probs)
+    # imbalance must be STRICTLY below the static arm's
+    probs = rebalance_tripwires(_rebal_art(rb_imb=2.8))
+    assert any("REBAL-SKEW" in p and "not strictly below" in p
+               for p in probs)
+    probs = rebalance_tripwires(_rebal_art(rb_imb=None))
+    assert any("REBAL-SKEW" in p for p in probs)
+
+
+def test_rebalance_tripwire_dead_arm_fails():
+    probs = rebalance_tripwires(_rebal_art(completed=False))
+    assert any("REBAL-DEAD" in p for p in probs)
+    probs = rebalance_tripwires(_rebal_art(lost=2))
+    assert any("REBAL-DEAD" in p for p in probs)
+
+
+def test_rebalance_skewed_arms_never_enter_the_throughput_gate():
+    """Skewed-arm rows/sec is one hot owner's serve rate (static) or a
+    mid-migration transient (rebalance) — both live under the
+    gate-invisible rows_per_sec_skewed key, like the chaos arms."""
+    pts = throughput_points(_rebal_art())
+    assert [p for p in pts] == ["rebalance_3proc/permuted"], pts
 
 
 def test_main_end_to_end_exit_codes(tmp_path):

@@ -113,7 +113,7 @@ def test_prefetch_consumed_by_pull_without_second_round_trip():
             fut.wait()
         # a fresh pull (nothing prefetched) still round-trips normally
         np.testing.assert_allclose(t0.pull(keys), 5.0)
-        assert t0._req == reqs_after_prefetch + 1
+        assert t0._req == reqs_after_prefetch + 2  # group + leg id
         # cancel releases the reply slot of an unconsumed prefetch
         fut2 = t0.prefetch_pull(keys)
         fut2.cancel()

@@ -2,7 +2,8 @@ import numpy as np
 import pytest
 
 from minips_tpu.parallel.mesh import padded_size
-from minips_tpu.parallel.partition import RangePartitioner
+from minips_tpu.parallel.partition import (BlockRouter, HashPartitioner,
+                                           RangePartitioner)
 
 
 def test_padded_size():
@@ -36,3 +37,124 @@ def test_local_offset_roundtrip():
     keys = np.arange(64)
     recon = p.shard_of(keys) * p.shard_size + p.local_offset(keys)
     np.testing.assert_array_equal(recon, keys)
+
+
+# ------------------------------------------------ partition properties
+# (previously only exercised incidentally: align > 1 padding and
+# non-divisible num_keys must keep split/local_offset/shard_of
+# coherent). Seeded randomized sweeps, not hypothesis: the property
+# must RUN even where the test extra isn't installed.
+def _partition_specs(n=120, seed=42):
+    rng = np.random.default_rng(seed)
+    specs = [(int(rng.integers(0, 500)),   # num_keys (0 = empty ok)
+              int(rng.integers(1, 10)),    # num_shards
+              int(rng.integers(1, 6)))     # align
+             for _ in range(n)]
+    # pin the classic corners alongside the random sweep
+    return specs + [(0, 4, 1), (1, 8, 3), (10, 4, 1), (7, 3, 5),
+                    (500, 9, 5)]
+
+
+def test_range_partitioner_roundtrip_properties():
+    for num_keys, shards, align in _partition_specs():
+        p = RangePartitioner(num_keys, shards, align=align)
+        # padding invariants: every shard padded to a multiple of
+        # align, the padded space covers num_keys
+        assert p.padded >= max(num_keys, 1)
+        assert p.padded == p.shard_size * shards
+        assert p.shard_size % align == 0
+        keys = np.arange(p.padded)
+        owners = p.shard_of(keys)
+        assert owners.min() >= 0 and owners.max() < shards
+        # shard_of * shard_size + local_offset round-trips every key
+        np.testing.assert_array_equal(
+            owners * p.shard_size + p.local_offset(keys), keys)
+        # split() is a partition: disjoint, order-preserving, complete
+        sl = p.split(keys)
+        assert len(sl) == shards
+        np.testing.assert_array_equal(np.concatenate(sl), keys)
+        for s, part in enumerate(sl):
+            assert (p.shard_of(part) == s).all()
+            assert part.size == p.shard_size
+
+
+def test_hash_partitioner_roundtrip_properties():
+    for num_keys, shards, align in _partition_specs():
+        p = HashPartitioner(num_keys, shards, align=align)
+        keys = np.arange(max(num_keys, 1))
+        owners = p.shard_of(keys)
+        assert owners.min() >= 0 and owners.max() < shards
+        # interleave round-trip: key = local_offset * shards + owner
+        np.testing.assert_array_equal(
+            p.local_offset(keys) * shards + owners, keys)
+        sl = p.split(keys)
+        np.testing.assert_array_equal(np.sort(np.concatenate(sl)), keys)
+        for s, part in enumerate(sl):
+            assert (p.shard_of(part) == s).all()
+            if part.size > 1:  # order preserved (Gen(keys) contract)
+                assert (np.diff(part) > 0).all()
+
+
+def test_hash_partitioner_spreads_contiguous_hot_range():
+    """The static answer to head skew: a contiguous hot range lands on
+    EVERY shard (vs all-on-shard-0 under range partition)."""
+    h = HashPartitioner(1 << 12, 4)
+    r = RangePartitioner(1 << 12, 4)
+    hot = np.arange(64)  # the zipf head
+    assert set(h.shard_of(hot).tolist()) == {0, 1, 2, 3}
+    assert set(r.shard_of(hot).tolist()) == {0}
+
+
+# ------------------------------------------------------- block router
+def test_block_router_spans_tile_each_shard():
+    rng = np.random.default_rng(7)
+    cases = [(num_keys, shards, align, int(rng.integers(1, 41)))
+             for num_keys, shards, align in _partition_specs(60, seed=9)]
+    for num_keys, shards, align, block_size in cases:
+        part = RangePartitioner(num_keys, shards, align=align)
+        r = BlockRouter(part, block_size)
+        # block spans tile the padded key space disjointly and
+        # completely, never straddling a shard boundary
+        covered = np.zeros(part.padded, bool)
+        for b in range(r.num_blocks):
+            lo, ln = r.block_span(b)
+            assert ln >= 1
+            assert not covered[lo:lo + ln].any()
+            covered[lo:lo + ln] = True
+            assert lo // part.shard_size \
+                == (lo + ln - 1) // part.shard_size
+            assert r.home_of(b) == lo // part.shard_size
+            keys = np.arange(lo, lo + ln)
+            assert (r.blocks_of(keys) == b).all()
+        assert covered.all()
+
+
+def test_block_router_overlay_routing_and_epochs():
+    part = RangePartitioner(64, 4)  # shard_size 16
+    r = BlockRouter(part, 8)        # 2 blocks per shard
+    keys = np.arange(64)
+    np.testing.assert_array_equal(r.shard_of(keys), part.shard_of(keys))
+    assert r.apply(1, {0: 3}) == {}          # adopted; previous empty
+    assert (r.shard_of(np.arange(0, 8)) == 3).all()   # block 0 moved
+    assert (r.shard_of(np.arange(8, 16)) == 0).all()  # block 1 home
+    assert r.apply(1, {0: 2}) is None        # stale epoch: ignored
+    assert r.apply(0, {}) is None
+    assert r.shard_of(np.array([0]))[0] == 3
+    # newer table replaces wholesale; returns the PREVIOUS overlay
+    assert r.apply(2, {4: 1}) == {0: 3}
+    assert r.shard_of(np.array([0]))[0] == 0          # moved back home
+    assert (r.shard_of(np.arange(32, 40)) == 1).all()  # block 4 moved
+    owners = r.owner_of_blocks()
+    assert owners[4] == 1 and owners[0] == 0
+    ep, ov = r.table()
+    assert ep == 2 and ov == {4: 1}
+
+
+def test_block_router_rejects_bad_overlays():
+    r = BlockRouter(RangePartitioner(64, 4), 8)
+    with pytest.raises(ValueError, match="home"):
+        r.apply(1, {0: 0})  # block 0's home IS shard 0
+    with pytest.raises(ValueError, match="out of range"):
+        r.apply(1, {999: 1})
+    with pytest.raises(ValueError, match="out of range"):
+        r.apply(1, {0: 9})

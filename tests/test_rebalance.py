@@ -1,0 +1,643 @@
+"""Heat-aware shard rebalancer (minips_tpu/balance/ + the epoch-fenced
+migration in train/sharded_ps.py) — this PR's tentpole.
+
+Three layers of drill:
+
+- pure logic: MINIPS_REBALANCE spec parsing, the greedy bin-pack
+  planner's hysteresis/improvement invariants (hypothesis), and the
+  decayed heat accountant;
+- threads-as-nodes over real loopback buses: a forced migration moves
+  parameter rows AND optimizer state intact, stale-routed pushes
+  forward to the new owner, stale-routed pulls are refused with the
+  new table and transparently retried, the row cache drops migrated
+  blocks, checkpoints round-trip the routing epoch/overlay/block
+  state (and refuse to load without the subsystem armed; elastic
+  reshard refuses rebalanced checkpoints), a BSP run with the
+  rebalancer ON is bitwise-equal to OFF on uniform traffic
+  (hysteresis: balanced traffic never migrates), a hypothesis property
+  shows pulls admitted MID-MIGRATION never read staler than the SSP
+  bound, and the whole protocol composes with seeded chaos + the
+  retransmit layer (migration control frames survive drops);
+- the slow tier: the acceptance drill — a real 3-process SSP launcher
+  run on UNPERMUTED zipf(1.1) with MINIPS_REBALANCE on performs >= 1
+  migration and ends with max/mean per-shard serve load strictly below
+  the static-partition arm, zero poisons.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu.balance.heat import HeatAccountant
+from minips_tpu.balance.rebalancer import RebalanceConfig, plan_assignment
+from minips_tpu.train.sharded_ps import ShardedPSTrainer, ShardedTable
+
+
+def _mk_buses(n, **kw):
+    from tests.conftest import mk_loopback_buses
+
+    return mk_loopback_buses(n, **kw)
+
+
+class _StubRB:
+    """Table-level rebalancer stand-in for raw-table protocol tests —
+    `is not None` gating, adopt_now(), and a note_plan that adopts
+    directly (raw-table tests drive no concurrent pushes, so the
+    production rule 'adopt only on the push-driving thread' is moot)."""
+
+    def __init__(self):
+        self.tables = []
+
+    def adopt_now(self):
+        pass
+
+    def note_plan(self, name, ep, ov):
+        for t in self.tables:
+            if t.name == name:
+                t.adopt_table(ep, ov)
+
+
+def _attach(tables, spec="block=4"):
+    rb = _StubRB()
+    rb.tables = list(tables)
+    cfg = RebalanceConfig.parse(spec)
+    for t in tables:
+        t.attach_rebalancer(rb, cfg)
+    return cfg
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.01)
+
+
+# --------------------------------------------------------- config spec
+def test_rebalance_config_parses_and_rejects_garbage():
+    c = RebalanceConfig.parse(
+        "interval=0.5,threshold=1.25,max_blocks=4,block=16,decay=0.9,"
+        "topk=8,min_heat=2")
+    assert (c.interval, c.threshold, c.max_blocks, c.block,
+            c.decay, c.topk, c.min_heat) == (0.5, 1.25, 4, 16, 0.9, 8, 2)
+    d = RebalanceConfig.parse("1")
+    assert d.threshold >= 1.0 and d.block == 0  # defaults, block auto
+    with pytest.raises(ValueError, match="unknown knob"):
+        RebalanceConfig.parse("explode=1")
+    with pytest.raises(ValueError, match="k=v"):
+        RebalanceConfig.parse("interval")
+    with pytest.raises(ValueError, match="bad value"):
+        RebalanceConfig.parse("interval=abc")
+    with pytest.raises(ValueError, match="threshold"):
+        RebalanceConfig.parse("threshold=0.5")
+
+
+# ------------------------------------------------------------- planner
+def test_plan_assignment_invariants():
+    """Seeded randomized property sweep (hypothesis-in-spirit; the
+    sweep must run even where the test extra isn't installed): for
+    arbitrary loads/candidates the planner never exceeds max_blocks,
+    never fires under the hysteresis threshold, never moves a block
+    twice, and never increases the global max load."""
+    rng = np.random.default_rng(3)
+    for _case in range(150):
+        n = int(rng.integers(2, 7))
+        loads = rng.uniform(0.0, 1000.0, size=n)
+        threshold = float(rng.uniform(1.0, 3.0))
+        max_blocks = int(rng.integers(1, 9))
+        candidates = {}
+        for b in rng.choice(64, size=int(rng.integers(0, 17)),
+                            replace=False):
+            o = int(rng.integers(0, n))
+            # candidates live on the shard the load says (heat <= load)
+            candidates[int(b)] = (o, min(float(rng.uniform(0.01, 300.0)),
+                                         float(loads[o])))
+        moves = plan_assignment(loads, candidates, threshold, max_blocks)
+        mean = loads.sum() / n
+        if mean > 0 and loads.max() / mean < threshold:
+            assert moves == []  # hysteresis: below the ratio, never
+            continue
+        assert len(moves) <= max_blocks
+        seen = set()
+        new = loads.copy()
+        for b, src, dst in moves:
+            assert b not in seen  # a block moves at most once per plan
+            seen.add(b)
+            o, h = candidates[b]
+            assert o == src  # moved FROM its reported owner
+            assert 0 <= dst < n
+            new[src] -= h
+            new[dst] += h
+        if moves:
+            # every move strictly improves the pair it touches, so the
+            # global max can never increase — and never goes negative
+            assert new.max() <= loads.max() + 1e-9
+            assert new.min() >= -1e-9
+
+
+def test_plan_assignment_flattens_a_hot_shard():
+    loads = [90.0, 5.0, 5.0]
+    cands = {0: (0, 40.0), 1: (0, 25.0), 2: (0, 15.0), 3: (1, 2.0)}
+    moves = plan_assignment(loads, cands, 1.3, 8)
+    assert moves  # fired
+    new = np.asarray(loads)
+    for b, src, dst in moves:
+        h = cands[b][1]
+        new[src] -= h
+        new[dst] += h
+    assert new.max() < 90.0  # strictly better than static
+
+
+# ---------------------------------------------------------------- heat
+def test_heat_accountant_touch_decay_report():
+    h = HeatAccountant(8, decay=0.5)
+    h.touch(np.array([0, 0, 0, 1, 7]))
+    assert h.total == 5.0
+    h.tick()
+    np.testing.assert_allclose(h.snapshot()[[0, 1, 7]], [1.5, 0.5, 0.5])
+    h.touch(np.array([99, -3]))  # out-of-range ids are dropped, not grown
+    assert h.total == 2.5
+    rep = h.report(np.arange(8), topk=2)
+    assert rep["blocks"] == [0, 1] or rep["blocks"] == [0, 7]
+    assert rep["heat"][0] == 1.5
+    assert rep["total"] == 2.5
+    # cold blocks are not offered as candidates
+    assert all(x > 0 for x in rep["heat"])
+    with pytest.raises(ValueError):
+        HeatAccountant(0)
+
+
+# ---------------------------------------- migration protocol, in-proc
+def test_migration_moves_rows_and_optimizer_state():
+    """The core move: block 0 (keys 0..3) migrates rank0 -> rank1 with
+    its adagrad accumulator; post-migration pushes step EXACTLY like an
+    unmigrated oracle (state moved, never perturbed), and pulls route
+    to the new owner transparently."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="adagrad",
+                      lr=0.1, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="adagrad",
+                      lr=0.1, pull_timeout=10.0)
+    _attach([t0, t1])
+    # oracle: a standalone 1-shard table receiving the same frames
+    oracle = ShardedTable("o", 64, 2, None, 0, 1, updater="adagrad",
+                          lr=0.1)
+    try:
+        keys = np.arange(4, dtype=np.int64)  # block 0, home = rank 0
+        g1 = np.full((4, 2), 2.0, np.float32)
+        t0.push(keys, g1)  # pre-migration: accumulates real opt state
+        oracle.push(keys, g1)
+        w_pre = t0._w[:4].copy()
+        acc_pre = t0._acc[:4].copy()
+        t0.adopt_table(1, {0: 1})
+        t1.adopt_table(1, {0: 1})
+        _wait(lambda: t0.rebalance_settled() and t1.rebalance_settled(),
+              msg="migration settle")
+        np.testing.assert_array_equal(t1._xtra[0]["w"], w_pre)
+        np.testing.assert_array_equal(t1._xtra[0]["acc"], acc_pre)
+        assert t0.rb_stats["blocks_out"] == 1
+        assert t1.rb_stats["blocks_in"] == 1
+        # post-migration push routes to the NEW owner and steps the
+        # MOVED accumulator — bitwise the oracle's trajectory
+        g2 = np.full((4, 2), 1.0, np.float32)
+        t0.push(keys, g2)
+        oracle.push(keys, g2)
+        _wait(lambda: t1.serve["push_rows"] >= 4, msg="push applied")
+        np.testing.assert_array_equal(t1._xtra[0]["w"], oracle._w[:4])
+        np.testing.assert_array_equal(t1._xtra[0]["acc"],
+                                      oracle._acc[:4])
+        # pulls (from both sides) see the migrated rows
+        np.testing.assert_array_equal(t0.pull(keys), oracle._w[:4])
+        np.testing.assert_array_equal(t1.pull(keys), oracle._w[:4])
+        # pull_all assembles the overlay over the dead home copy
+        np.testing.assert_array_equal(t0.pull_all()[:4], oracle._w[:4])
+        np.testing.assert_array_equal(t1.pull_all()[:4], oracle._w[:4])
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_migration_moves_adam_moments_and_steps():
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="adam",
+                      lr=0.05, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="adam",
+                      lr=0.05, pull_timeout=10.0)
+    _attach([t0, t1])
+    oracle = ShardedTable("o", 64, 2, None, 0, 1, updater="adam",
+                          lr=0.05)
+    try:
+        keys = np.arange(4, dtype=np.int64)
+        for g in (2.0, -1.0):
+            grads = np.full((4, 2), g, np.float32)
+            t0.push(keys, grads)
+            oracle.push(keys, grads)
+        t0.adopt_table(1, {0: 1})
+        t1.adopt_table(1, {0: 1})
+        _wait(lambda: t0.rebalance_settled() and t1.rebalance_settled(),
+              msg="migration settle")
+        g3 = np.full((4, 2), 0.5, np.float32)
+        t1.push(keys, g3)  # new owner's LOCAL push hits the xtra block
+        oracle.push(keys, g3)
+        st_ = t1._xtra[0]
+        np.testing.assert_array_equal(st_["w"], oracle._w[:4])
+        np.testing.assert_array_equal(st_["m"], oracle._m[:4])
+        np.testing.assert_array_equal(st_["v"], oracle._v[:4])
+        np.testing.assert_array_equal(st_["steps"], oracle._steps[:4])
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_stale_push_is_forwarded_to_the_current_owner():
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 1, buses[0], 0, 2, updater="sgd",
+                      lr=1.0, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 1, buses[1], 1, 2, updater="sgd",
+                      lr=1.0, pull_timeout=10.0)
+    _attach([t0, t1])
+    try:
+        t0.adopt_table(1, {0: 1})
+        t1.adopt_table(1, {0: 1})
+        _wait(lambda: t0.rebalance_settled() and t1.rebalance_settled(),
+              msg="migration settle")
+        # a STALE-ROUTED frame (epoch 0 wire stamp, old owner target):
+        # the old owner must forward it, not drop or misapply it
+        keys = np.arange(2, dtype=np.int64)
+        grads = np.ones((2, 1), np.float32)
+        buses[1].send(0, "psP:t",
+                      {"n": 2, "comm": "float32", "ep": 0,
+                       "ws": 2, "nr": 64, "dm": 1, "rb": 4},
+                      blob=keys.tobytes() + grads.tobytes())
+        _wait(lambda: t1._xtra.get(0) is not None
+              and t1._xtra[0]["w"][0, 0] == -1.0, msg="forwarded apply")
+        assert t0.rb_stats["forwarded_pushes"] == 1
+        assert t0.frames_dropped == 0 and t1.frames_dropped == 0
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_stale_pull_is_refused_and_transparently_retried():
+    """Rank 1 never hears the plan (its adoption comes via the psE
+    refusal itself): its pull of a migrated block round-trips to the
+    OLD owner, gets refused-with-table, re-splits to the new owner,
+    and still returns the right rows — the client-visible API never
+    sees the migration."""
+    buses = _mk_buses(3)
+    tabs = [ShardedTable("t", 96, 1, buses[i], i, 3, updater="sgd",
+                         lr=1.0, pull_timeout=15.0) for i in range(3)]
+    _attach(tabs)
+    try:
+        tabs[0]._w[:4] = 7.0  # block 0 content before migration
+        tabs[0].adopt_table(1, {0: 2})  # block 0: rank0 -> rank2
+        tabs[2].adopt_table(1, {0: 2})
+        keys = np.arange(4, dtype=np.int64)
+        rows = tabs[1].pull(keys)  # rank1 still routes by the OLD table
+        np.testing.assert_array_equal(rows, np.full((4, 1), 7.0))
+        assert tabs[1].router.epoch == 1  # adopted via the refusal
+        assert tabs[0].rb_stats["refused_pulls"] >= 1
+        _wait(lambda: all(t.rebalance_settled() for t in tabs),
+              msg="fences settle")
+        assert all(t.frames_dropped == 0 for t in tabs)
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_row_cache_drops_migrated_blocks_on_adoption():
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="sgd",
+                      lr=0.5, pull_timeout=10.0, cache_bytes=1 << 16)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="sgd",
+                      lr=0.5, pull_timeout=10.0)
+    _attach([t0, t1], spec="block=8")  # shard_size 32 -> block 8 keys
+    try:
+        keys = np.arange(32, 36, dtype=np.int64)  # t1's home block 4
+        t1._w[...] = 3.0
+        t0.pull(keys)  # cached
+        assert len(t0._cache) == 4
+        # block 4 (keys 32..39) migrates t1 -> t0: the adopter drops
+        # its cached copies of every moved block
+        t1.adopt_table(1, {4: 0})
+        t0.adopt_table(1, {4: 0})
+        _wait(lambda: t0.rebalance_settled() and t1.rebalance_settled(),
+              msg="migration settle")
+        assert len(t0._cache) == 0
+        assert t0._cache.invalidations >= 4
+        np.testing.assert_array_equal(t0.pull(keys),
+                                      np.full((4, 2), 3.0))
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_checkpoint_roundtrips_epoch_overlay_and_block_state(tmp_path):
+    from minips_tpu.ckpt.checkpoint import _flatten, _unflatten
+
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="adagrad",
+                      lr=0.1, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="adagrad",
+                      lr=0.1, pull_timeout=10.0)
+    _attach([t0, t1])
+    try:
+        keys = np.arange(4, dtype=np.int64)
+        t0.push(keys, np.full((4, 2), 2.0, np.float32))
+        t0.adopt_table(1, {0: 1})
+        t1.adopt_table(1, {0: 1})
+        _wait(lambda: t0.rebalance_settled() and t1.rebalance_settled(),
+              msg="migration settle")
+        # the npz round trip (flatten -> unflatten) preserves the
+        # routing epoch, the overlay, and the migrated block's state
+        sd1 = _unflatten(_flatten(t1.shard_state_dict()))
+        assert int(sd1["ep"]) == 1
+        f0 = ShardedTable("t", 64, 2, None, 0, 2, updater="adagrad",
+                          lr=0.1)
+        f1 = ShardedTable("t", 64, 2, None, 1, 2, updater="adagrad",
+                          lr=0.1)
+        _attach([f0, f1])
+        f0.load_shard_state_dict(
+            _unflatten(_flatten(t0.shard_state_dict())))
+        f1.load_shard_state_dict(sd1)
+        assert f0.router.epoch == 1 and f1.router.epoch == 1
+        assert f0.router.table()[1] == {0: 1} == f1.router.table()[1]
+        np.testing.assert_array_equal(f1._xtra[0]["w"],
+                                      t1._xtra[0]["w"])
+        np.testing.assert_array_equal(f1._xtra[0]["acc"],
+                                      t1._xtra[0]["acc"])
+        # restoring a rebalanced checkpoint WITHOUT the subsystem armed
+        # would serve moved blocks from the wrong shard: refuse loudly
+        cold = ShardedTable("t", 64, 2, None, 1, 2, updater="adagrad")
+        with pytest.raises(ValueError, match="MINIPS_REBALANCE"):
+            cold.load_shard_state_dict(sd1)
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_elastic_reshard_refuses_rebalanced_checkpoints(tmp_path):
+    from minips_tpu.ckpt.elastic import reshard_table_state
+
+    d = tmp_path / "rank0" / "step_0000000001"
+    d.mkdir(parents=True)
+    np.savez(d / "t.npz", w=np.zeros((4, 2), np.float32),
+             lo=np.asarray(0), ep=np.asarray(2),
+             ovb=np.asarray([0]), ovo=np.asarray([1]))
+    with pytest.raises(ValueError, match="rebalanced"):
+        reshard_table_state(str(tmp_path), 1, 2, "t", 8, 0, 4)
+
+
+def test_all_blocks_home_checkpoint_stays_elastic_reshardable():
+    """Once every block migrates back home the layout IS the base
+    partition again: the checkpoint must not record a routing epoch
+    (which would lock elastic resize out forever — epochs never
+    reset), and a cold rb-off table must accept it."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 1, buses[0], 0, 2, updater="sgd",
+                      lr=1.0, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 1, buses[1], 1, 2, updater="sgd",
+                      lr=1.0, pull_timeout=10.0)
+    _attach([t0, t1])
+    try:
+        t0.adopt_table(1, {0: 1})   # away...
+        t1.adopt_table(1, {0: 1})
+        _wait(lambda: t0.rebalance_settled() and t1.rebalance_settled(),
+              msg="migration settle")
+        t0.adopt_table(2, {})       # ...and back home
+        t1.adopt_table(2, {})
+        _wait(lambda: t0.rebalance_settled() and t1.rebalance_settled(),
+              msg="return settle")
+        sd = t0.shard_state_dict()
+        assert "ep" not in sd and "xtra" not in sd
+        cold = ShardedTable("t", 64, 1, None, 0, 2, updater="sgd")
+        cold.load_shard_state_dict(sd)  # rb off: accepted
+        np.testing.assert_array_equal(cold._w, t0._w)
+    finally:
+        for b in buses:
+            b.close()
+
+
+# --------------------------------------------- trainer-level, in-proc
+def _run_trainers(n, spec, body, *, staleness=1, rows=64, dim=1,
+                  updater="sgd", lr=1.0, bus_kw=None, steps=12):
+    """Threads-as-nodes trainer run; body(r, table, trainer, step) runs
+    per rank per step. Returns (tables, trainers, finals, chaos_drops)."""
+    buses = _mk_buses(n, **(bus_kw or {}))
+    tables = [ShardedTable("t", rows, dim, buses[i], i, n,
+                           updater=updater, lr=lr, pull_timeout=20.0)
+              for i in range(n)]
+    trainers = [ShardedPSTrainer({"t": tables[i]}, buses[i], n,
+                                 staleness=staleness, gate_timeout=30.0,
+                                 rebalance=spec) for i in range(n)]
+    finals: list = [None] * n
+    errs: list = []
+
+    def worker(r):
+        try:
+            for i in range(steps):
+                body(r, tables[r], trainers[r], i)
+                trainers[r].tick()
+            trainers[r].finalize(timeout=30.0)
+            finals[r] = tables[r].pull_all()
+        except Exception as e:  # noqa: BLE001 - surfaced via errs
+            import traceback
+
+            traceback.print_exc()
+            errs.append((r, repr(e)))
+
+    try:
+        ts = [threading.Thread(target=worker, args=(r,))
+              for r in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120.0)
+        assert not any(t.is_alive() for t in ts), "run wedged"
+        assert not errs, errs
+        drops = sum(getattr(b, "chaos").snapshot()["dropped"]
+                    for b in buses if getattr(b, "chaos", None))
+        return tables, trainers, finals, drops
+    finally:
+        for b in buses:
+            b.close()
+
+
+HOT_SPEC = ("interval=0.05,threshold=1.05,max_blocks=4,block=4,"
+            "topk=16,min_heat=1")
+
+
+@pytest.mark.parametrize("staleness,seed",
+                         [(0, 11), (1, 23), (1, 57), (2, 101)])
+def test_pulls_mid_migration_respect_the_staleness_bound(staleness,
+                                                         seed):
+    """THE safety property: with sgd lr=1 and +1 gradients, a row's
+    value counts applied pushes — at any pull admitted at clock c,
+    every peer's pushes through c − s must already be readable, WHILE
+    blocks migrate under the reader. Any interleaving of plan adoption,
+    state ship, fences, refusals and forwards must keep that bound."""
+    hot = np.arange(8, dtype=np.int64)  # blocks 0,1 of shard 0
+    n = 2
+    bad: list = []
+
+    def body(r, table, trainer, i):
+        rows = table.pull(hot)
+        counts = -rows[:, 0]
+        need = i + max(0, i - staleness) * (n - 1)
+        if not (counts >= need - 1e-6).all():
+            bad.append((r, i, counts.min(), need))
+        table.push(hot, np.ones((hot.size, 1), np.float32))
+        time.sleep(0.01 * (1 + (seed + r) % 3) / 2)
+
+    tables, trainers, finals, _ = _run_trainers(
+        n, HOT_SPEC, body, staleness=staleness, steps=12)
+    assert not bad, f"staleness bound violated mid-migration: {bad[:4]}"
+    migrated = sum(t.rb_stats["blocks_in"] for t in tables)
+    assert migrated >= 1, "no migration fired — the drill proved nothing"
+    for tr in trainers:
+        assert tr.frames_dropped == 0, tr.drop_detail()
+        assert tr.wire_frames_lost == 0
+        assert tr.max_skew_seen <= staleness + 1
+    np.testing.assert_array_equal(finals[0], finals[1])
+
+
+def test_bsp_uniform_is_bitwise_equal_with_rebalancer_on_and_off():
+    """Acceptance pin: arming the rebalancer must not perturb one bit
+    of training state when nothing migrates. BSP lockstep drive (the
+    deterministic harness the chaos BSP drill uses — free-running BSP
+    threads may LEGALLY read fresher-than-bound rows, so only lockstep
+    order is comparable bitwise), uniform traffic, rb-armed vs seed
+    path: final shards must be bitwise equal."""
+    def run(rb_on):
+        buses = _mk_buses(2)
+        tabs = [ShardedTable("t", 64, 1, buses[i], i, 2, updater="sgd",
+                             lr=0.5, pull_timeout=10.0)
+                for i in range(2)]
+        if rb_on:
+            _attach(tabs, spec="block=4")
+        try:
+            for i in range(6):
+                for r in (0, 1):
+                    rng = np.random.default_rng((7, r, i))
+                    keys = rng.integers(0, 64, size=16)
+                    rows = tabs[r].pull(keys)
+                    tabs[r].push(keys, (0.125 * rows + 1.0))
+                # FIFO barrier per link: the next frame's reads prove
+                # this step's pushes applied (deterministic order)
+                tabs[0].pull(np.array([32]))
+                tabs[1].pull(np.array([0]))
+            return [t._w.copy() for t in tabs]
+        finally:
+            for b in buses:
+                b.close()
+
+    w_off = run(False)
+    w_on = run(True)
+    for a, b in zip(w_off, w_on):
+        np.testing.assert_array_equal(a, b)  # bitwise, not allclose
+
+
+def test_uniform_traffic_never_trips_the_hysteresis():
+    """Balanced traffic + the default threshold: the planner must stay
+    idle (zero migrations) on a full trainer run — the observable half
+    of the bitwise pin above."""
+    def body(r, table, trainer, i):
+        rng = np.random.default_rng((7, r, i))
+        keys = rng.integers(0, 64, size=16)
+        rows = table.pull(keys)
+        table.push(keys, (0.125 * rows + 1.0))
+
+    tables, trainers, _finals, _ = _run_trainers(
+        2, "interval=0.01,block=4", body, staleness=0, steps=8, lr=0.5)
+    for tr in trainers:
+        s = tr.rebalance_stats()
+        assert s is not None and s["blocks_in"] == 0, s
+        assert tr.frames_dropped == 0
+
+
+def test_migration_composes_with_chaos_and_reliable():
+    """Migration control frames (rbP/rbS/rbA/rbF/psE) ride the same
+    reliable layer as everything else: under seeded drop/dup the run
+    completes, migrates, loses nothing unrecovered, and replicas agree."""
+    def body(r, table, trainer, i):
+        rows = table.pull(np.arange(8, dtype=np.int64))
+        table.push(np.arange(8, dtype=np.int64),
+                   (0.01 * rows + 1.0))
+        time.sleep(0.01)
+
+    tables, trainers, finals, drops = _run_trainers(
+        2, HOT_SPEC, body, staleness=1, steps=15,
+        bus_kw={"chaos": "2025:drop=0.03,dup=0.01", "reliable": "1"})
+    assert drops > 0, "chaos never fired — the drill proved nothing"
+    assert sum(t.rb_stats["blocks_in"] for t in tables) >= 1
+    for tr in trainers:
+        assert tr.frames_dropped == 0, tr.drop_detail()
+        assert tr.wire_frames_lost == 0
+    np.testing.assert_array_equal(finals[0], finals[1])
+
+
+def test_serve_counters_ride_wire_record():
+    from minips_tpu.utils.metrics import wire_record
+
+    def body(r, table, trainer, i):
+        keys = np.arange(4, dtype=np.int64)
+        table.pull(keys)
+        table.push(keys, np.ones((4, 1), np.float32))
+
+    tables, trainers, _finals, _ = _run_trainers(
+        2, None, body, staleness=1, steps=3)
+    rec = wire_record(trainers[0])
+    assert rec["serve"]["pull_rows"] > 0
+    assert rec["serve"]["push_rows"] > 0
+    assert rec["rebalance"] is None  # off = None, not zeros
+
+
+# ------------------------------------------------------- multi-process
+@pytest.mark.slow
+def test_rebalance_3proc_unpermuted_zipf_beats_static():
+    """The acceptance drill: 3-process SSP(1) on UNPERMUTED zipf(1.1)
+    (the whole head in shard 0's range). With MINIPS_REBALANCE on the
+    run must perform >= 1 migration and end with max/mean per-shard
+    serve load STRICTLY below the static arm's, with zero poisons,
+    drops, or unrecovered frames on both arms."""
+    from minips_tpu import launch
+
+    argv = [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
+            "--path", "sparse", "--rows", "4096", "--batch", "1024",
+            "--iters", "30", "--warmup", "4", "--key-dist", "zipf",
+            "--no-zipf-permute-hot", "--staleness", "1",
+            "--updater", "sgd", "--pull-timeout", "30"]
+    spec = ("interval=0.2,threshold=1.2,max_blocks=16,block=8,"
+            "topk=64,min_heat=100")
+
+    def run(rebalance):
+        res = launch.run_local_job(
+            3, argv, base_port=None,
+            env_extra={"MINIPS_REBALANCE": rebalance,
+                       "JAX_PLATFORMS": "cpu"},
+            timeout=240.0)
+        assert all(r["event"] == "done" for r in res)
+        for r in res:
+            assert r["wire_frames_lost"] == 0, r
+            assert r["rebalance_spec"] == (rebalance or None), r
+        served = [r["serve"]["pull_rows"] + r["serve"]["push_rows"]
+                  for r in res]
+        imb = max(served) / (sum(served) / len(served))
+        moved = sum((r.get("rebalance") or {}).get("blocks_in", 0)
+                    for r in res)
+        return imb, moved
+
+    static_imb, static_moved = run("")
+    rb_imb, rb_moved = run(spec)
+    assert static_moved == 0
+    assert rb_moved >= 1, "rebalancer never migrated under head skew"
+    # the whole zipf head sits in shard 0's range: static is heavily
+    # imbalanced, and the rebalancer must land strictly below it
+    assert static_imb > 1.5, static_imb
+    assert rb_imb < static_imb, (rb_imb, static_imb)

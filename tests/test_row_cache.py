@@ -122,7 +122,8 @@ def test_cache_hit_is_exactly_the_admission_window():
         c1.gmin = 7                        # owner will serve + restamp
         t1._w[...] = 9.0
         np.testing.assert_allclose(t0.pull(keys), 9.0)
-        assert t0._req == reqs + 1, "expired rows must re-fetch"
+        # +2: a wire pull allocates a group id AND a per-leg id
+        assert t0._req == reqs + 2, "expired rows must re-fetch"
         st = t0.cache_stats()
         assert st["hits"] == 2 and st["lookups"] == 6
     finally:
@@ -214,7 +215,7 @@ def test_push_invalidates_when_delta_not_reproducible(kw):
         time.sleep(0.3)
         reqs = t0._req
         t0.pull(np.array([40, 41]))
-        assert t0._req == reqs + 1         # 40 invalidated: re-fetched
+        assert t0._req == reqs + 2         # 40 invalidated: re-fetched
         assert t0._cache.invalidations == 1
         b0 = t0.bytes_pulled
         t0.pull(np.array([41]))            # 41 untouched: still cached
@@ -457,7 +458,7 @@ def test_inflight_pull_insert_drops_pushed_keys():
         # the next pull of 40 round-trips once and caches cleanly
         reqs = t0._req
         np.testing.assert_allclose(t0.pull(np.array([40]))[0], 4.0)
-        assert t0._req == reqs + 1
+        assert t0._req == reqs + 2  # one group + one leg id
         _, miss = t0._cache.lookup(np.array([40]), 0, 0)
         assert not miss[0]
     finally:
