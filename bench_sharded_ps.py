@@ -43,9 +43,12 @@ def _worker_argv(path: str, iters: int, warmup: int,
                  rows: int | None = None,
                  updater: str | None = None,
                  pull_timeout: float | None = None,
-                 zipf_permute_hot: bool = True) -> list[str]:
+                 zipf_permute_hot: bool = True,
+                 trace: str | None = None) -> list[str]:
     argv = [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
             "--path", path, "--iters", str(iters), "--warmup", str(warmup)]
+    if trace:
+        argv += ["--trace", trace]
     if compute != "none":
         argv += ["--compute", compute]
     if hidden is not None:
@@ -91,6 +94,7 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
          chaos: str | None = None, reliable: bool = False,
          pull_timeout: float | None = None,
          zipf_permute_hot: bool = True, rebalance: str | None = None,
+         trace: str | None = None,
          may_fail: bool = False, timeout: float = 300.0) -> dict:
     """One sweep point → {rows_per_sec_per_process, aggregate, wire...}.
 
@@ -103,7 +107,7 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
                         push_comm, pull_wire, overlap, overlap_legs,
                         key_dist, staleness, cache_bytes, pull_dedup,
                         push_dedup, rows, updater, pull_timeout,
-                        zipf_permute_hot)
+                        zipf_permute_hot, trace)
     env_extra = {}
     if bus != "zmq":
         env_extra["MINIPS_BUS"] = bus
@@ -111,10 +115,13 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
         env_extra["MINIPS_FORCE_CPU"] = "1"
     # chaos/reliable arms configure via env (launcher-inherited, no
     # per-app flag plumbing); explicit empty strings keep an armed
-    # environment from leaking into the clean arms
+    # environment from leaking into the clean arms — MINIPS_TRACE too:
+    # the traced arm uses the worker's --trace flag, and an armed
+    # environment must not silently trace (and tax) every other arm
     env_extra["MINIPS_CHAOS"] = chaos or ""
     env_extra["MINIPS_RELIABLE"] = "1" if reliable else ""
     env_extra["MINIPS_REBALANCE"] = rebalance or ""
+    env_extra["MINIPS_TRACE"] = ""
     if n == 1:  # standalone zero-wire baseline (no launcher, no bus)
         proc = subprocess.run(argv, capture_output=True, text=True,
                               timeout=timeout,
@@ -200,6 +207,9 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     assert echoed_rl == {bool(reliable)}, (reliable, echoed_rl)
     echoed_rb = {r.get("rebalance_spec") for r in res}
     assert echoed_rb == {rebalance or None}, (rebalance, echoed_rb)
+    if trace:  # every rank of a traced arm must have dumped its file
+        assert all(r.get("trace_file") for r in res), \
+            [r.get("trace_file") for r in res]
     if key_dist == "zipf":
         echoed_ph = {r.get("zipf_permute_hot") for r in res}
         assert echoed_ph == {zipf_permute_hot}, (zipf_permute_hot,
@@ -243,6 +253,11 @@ def main() -> int:
     ap.add_argument("--iters", type=int, default=60)
     ap.add_argument("--quick", action="store_true",
                     help="short iters (harness validation, not numbers)")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="artifact dir for the traced arm's per-rank "
+                         "wire traces + merged_trace.json (default: a "
+                         "tempdir; the merged path is recorded in the "
+                         "bench JSON either way)")
     args = ap.parse_args()
     iters = 15 if args.quick else args.iters
     warmup = max(2, iters // 6)
@@ -460,6 +475,57 @@ def main() -> int:
 
     rebalance_grid = _rebalance_arms()
 
+    # wire tracing (this PR): the TRACE-TAX pair — untraced vs
+    # MINIPS_TRACE-armed, same workload, alternating-median like every
+    # other throughput comparison on this drifting host. The traced
+    # arm's per-rank Chrome traces land in the artifact dir
+    # (--trace, default a tempdir), the merge CLI combines them, and
+    # the merged path + flow-link count ride the bench JSON — the
+    # ci/bench_regression TRACE-TAX/TRACE-MERGE tripwires gate both
+    # (tracing may not tax the wire beyond 15%, and the traces it
+    # pays for must actually merge with >= 1 cross-rank flow).
+    def _trace_arms(reps: int) -> dict:
+        import tempfile
+
+        trace_root = args.trace or tempfile.mkdtemp(
+            prefix="minips-trace-")
+        trace_dir = os.path.join(trace_root, "traced_3proc")
+        arms = {"untraced": {}, "traced": {"trace": trace_dir}}
+        runs: dict[str, list[dict]] = {a: [] for a in arms}
+        for _ in range(reps):
+            for a, kw in arms.items():
+                runs[a].append(_run(3, "sparse", iters, warmup, "zmq",
+                                    staleness=1, **kw))
+
+        def med(arm: str) -> dict:
+            by = sorted(runs[arm],
+                        key=lambda r: r["rows_per_sec_per_process"])
+            return {**by[len(by) // 2], "reps": reps}
+        grid = {a: med(a) for a in arms}
+        # merge the LAST rep's per-rank traces (each rep's dump
+        # overwrites rank-wise: one coherent set remains)
+        merged_path = os.path.join(trace_dir, "merged_trace.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "minips_tpu.obs.merge", trace_dir,
+             "-o", merged_path],
+            capture_output=True, text=True, timeout=120.0)
+        summary = {}
+        if proc.returncode == 0:
+            try:
+                summary = json.loads(proc.stdout.splitlines()[-1])
+            except (json.JSONDecodeError, IndexError):
+                pass
+        grid["traced"].update({
+            "trace_dir": trace_dir,
+            "merged_trace": merged_path if proc.returncode == 0
+            else None,
+            "merge_ok": proc.returncode == 0,
+            "flows_linked": summary.get("flows_linked", 0),
+        })
+        return grid
+
+    trace_grid = _trace_arms(o_reps)
+
     headline = curve["3"]["rows_per_sec_per_process"]
     print(json.dumps({
         "metric": "sharded-PS rows/sec/process (sparse pull+push, "
@@ -478,6 +544,7 @@ def main() -> int:
         "cache_comparison_3proc": cache_grid,
         "chaos_resilience_3proc": chaos_grid,
         "rebalance_3proc": rebalance_grid,
+        "trace_overhead_3proc": trace_grid,
     }))
     return 0
 

@@ -28,6 +28,10 @@ retransmit-on arm must have completed with zero unrecovered frames
 complete with >= 1 migration and max/mean per-shard serve load
 strictly below the static arm's — skewed-arm rows/sec stay
 gate-invisible (``rows_per_sec_skewed``) like the chaos arms'.
+``trace_tripwires`` (TRACE-TAX/TRACE-MERGE) guards the
+``trace_overhead_3proc`` sweep: the MINIPS_TRACE-armed arm must stay
+within 15% of the untraced arm AND its per-rank traces must merge
+(merge CLI exit 0, >= 1 cross-rank flow).
 
 Usage:
     python ci/bench_regression.py PRIOR.json NEW.json [--tolerance 0.10]
@@ -200,6 +204,49 @@ def rebalance_tripwires(new: dict) -> list[str]:
     return problems
 
 
+TRACE_TAX_TOLERANCE = 0.15  # traced arm vs untraced arm slack. The
+# tracer's on-path cost is one monotonic() call + a tuple + a deque
+# append per event; on the CPU-saturated loopback host that books as a
+# few percent. The failure classes this gate exists for — an event
+# formatter on the hot path, an unbounded ring growing into swap, a
+# lock on the record path — cost integer factors, not percent.
+
+
+def trace_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``trace_overhead_3proc``
+    sweep; vacuous when the sweep is absent (other benches).
+
+    - TRACE-TAX: the MINIPS_TRACE-armed arm must stay within
+      ``TRACE_TAX_TOLERANCE`` of the untraced arm (alternating-median,
+      same honesty rules as CHAOS-TAX) — observability may not tax the
+      wire it observes.
+    - TRACE-MERGE: the traced arm must have produced traces the merge
+      CLI combined (exit 0) with >= 1 cross-rank flow — a trace that
+      exists but no longer links client pulls to owner serves is the
+      'silently disabled' failure mode of this layer."""
+    grid = new.get("trace_overhead_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    un = (grid.get("untraced") or {}).get(METRIC)
+    tr = grid.get("traced") or {}
+    rate = tr.get(METRIC)
+    if isinstance(un, (int, float)) and un > 0:
+        if not isinstance(rate, (int, float)) or \
+                rate / un < 1.0 - TRACE_TAX_TOLERANCE:
+            problems.append(
+                f"TRACE-TAX trace_overhead_3proc/traced: {rate!r} vs "
+                f"untraced {un:.1f} rows/s/proc — tracing is taxing "
+                f"the wire beyond {TRACE_TAX_TOLERANCE * 100:.0f}%")
+    if not tr.get("merge_ok") or not tr.get("flows_linked"):
+        problems.append(
+            f"TRACE-MERGE trace_overhead_3proc/traced: merge_ok="
+            f"{tr.get('merge_ok')!r} flows_linked="
+            f"{tr.get('flows_linked')!r} — the traced arm must emit a "
+            "merge-able trace with >= 1 cross-rank flow")
+    return problems
+
+
 def compare(prior: dict, new: dict, tolerance: float) -> list[str]:
     """Regression report lines; empty means the gate passes."""
     p, n = throughput_points(prior), throughput_points(new)
@@ -252,7 +299,7 @@ def main(argv: list[str] | None = None) -> int:
 
     problems = (compare(prior, new, args.tolerance)
                 + cache_tripwires(new) + chaos_tripwires(new)
-                + rebalance_tripwires(new))
+                + rebalance_tripwires(new) + trace_tripwires(new))
     pts = throughput_points(new)
     print(f"bench-regression: {len(pts)} throughput points checked "
           f"against {len(throughput_points(prior))} prior")

@@ -118,6 +118,12 @@ def init_multiproc(consistency: str, staleness: int):
                           "err": "multiproc mode needs the launcher "
                                  "(n >= 2)"}), flush=True)
         sys.exit(2)
+    # arm the wire tracer (MINIPS_TRACE; no-op when unset) BEFORE the
+    # heartbeat monitor starts: the hb receipts it records are the
+    # merge tool's clock-alignment samples, earliest beats included
+    from minips_tpu.obs import tracer as _trc
+
+    _trc.maybe_init(rank)
     s = {"bsp": 0, "ssp": staleness, "asp": float("inf")}[consistency]
     monitor = HeartbeatMonitor(bus, peer_ids=list(range(nprocs)),
                                interval=0.2, timeout=2.0).start()
@@ -386,11 +392,19 @@ def emit_multiproc_done(trainer, rank: int, t0: float, losses,
     """The launcher-protocol result line shared by every sharded-PS app:
     the launcher harvests the LAST JSON line on stdout, smoke tests assert
     these fields (replica agreement via param_fingerprint, 1/N memory via
-    local_bytes vs table_bytes, skew bound, wire accounting)."""
+    local_bytes vs table_bytes, skew bound, wire accounting).
+
+    The wire-health block is ``utils/metrics.wire_record`` SPLATTED, not
+    hand-copied: every field it grows (the ``hist`` p50/p95/p99 block,
+    the ``timing``/``cache`` sub-records) reaches every app's done line
+    the day it lands — hand-synced copies are how the sweep scrapers
+    desynced before (tests/test_obs_trace.py pins the layout)."""
     import json
     import time
 
     import numpy as np
+
+    from minips_tpu.utils.metrics import wire_record
 
     print(json.dumps({
         "rank": rank, "event": "done",
@@ -399,22 +413,10 @@ def emit_multiproc_done(trainer, rank: int, t0: float, losses,
         "loss_last": float(np.mean(losses[-5:])) if losses else None,
         "gate_waits": trainer.gate_waits,
         "max_skew_seen": trainer.max_skew_seen,
-        "bytes_pushed": trainer.bytes_pushed,
-        "bytes_pulled": trainer.bytes_pulled,
-        # a dropped frame is a silently-lost gradient — smokes assert 0
-        "frames_dropped": trainer.frames_dropped,
-        # bus-level wire loss (HWM drops, torn links; UNRECOVERED loss
-        # when the reliable channel is on) — smokes assert 0
-        "wire_frames_lost": trainer.wire_frames_lost,
-        # torn frames counted at receive, not silently swallowed
-        "wire_frames_malformed": trainer.wire_frames_malformed,
-        # retransmit/chaos counters (None = layer off)
-        "reliable": trainer.reliable_stats(),
-        "chaos": trainer.chaos_stats(),
-        # per-owner serve load (always on) + rebalancer counters (None
-        # = off): the partition-imbalance observables
-        "serve": trainer.serve_stats(),
-        "rebalance": trainer.rebalance_stats(),
+        # bytes both ways, drop/loss/malformed counters, per-leg timing
+        # + histograms, cache/reliable/chaos/serve/rebalance blocks
+        # (None = that layer off, {}/zero-count = armed but idle)
+        **wire_record(trainer),
         "local_bytes": trainer.local_bytes(),
         "table_bytes": int(table_bytes),
         "param_fingerprint": fingerprint,

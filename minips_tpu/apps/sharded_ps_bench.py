@@ -83,6 +83,13 @@ def main(argv=None) -> int:
     ap.add_argument("--hidden", type=int, default=256,
                     help="--compute jit: MLP hidden width over the "
                          "pulled rows (the MXU work per cycle)")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="write this rank's wire trace (Chrome-trace "
+                         "JSON, obs/tracer.py) into DIR — the flag "
+                         "spelling of MINIPS_TRACE; the bench driver's "
+                         "trace arm uses it to drop per-rank traces "
+                         "into the sweep artifact dir for "
+                         "minips_tpu.obs.merge")
     args = ap.parse_args(argv)
     if args.compute == "jit" and args.path != "sparse":
         # the grad step runs on pulled ROWS; the dense path never calls
@@ -97,6 +104,11 @@ def main(argv=None) -> int:
 
     rank = int(os.environ.get("MINIPS_PROC_ID", "0"))
     nprocs = int(os.environ.get("MINIPS_NUM_PROCS", "1"))
+
+    from minips_tpu.obs import tracer as _trc
+
+    if args.trace:  # flag spelling of MINIPS_TRACE (env works too)
+        _trc.init(args.trace, rank)
 
     grad_step = None
     backend = "none"
@@ -228,6 +240,26 @@ def main(argv=None) -> int:
         trainer.shutdown_barrier(timeout=15.0)
 
     timed = args.iters - args.warmup
+    # the full wire_record layout rides the done line (the schema test
+    # pins it, scrapers rely on it); the standalone path builds the
+    # SAME record through a view so the layout is defined exactly once
+    from types import SimpleNamespace
+
+    from minips_tpu.train.sharded_ps import tables_hist_stats
+    from minips_tpu.utils.metrics import wire_record
+
+    solo = SimpleNamespace(
+        bytes_pushed=table.bytes_pushed,
+        bytes_pulled=table.bytes_pulled,
+        frames_dropped=table.frames_dropped,
+        wire_frames_lost=0, wire_frames_malformed=0,
+        comm_timing=table.timers.summary,
+        hist_stats=lambda: tables_hist_stats([table]),
+        cache_stats=table.cache_stats,
+        reliable_stats=lambda: None, chaos_stats=lambda: None,
+        serve_stats=lambda: dict(table.serve),
+        rebalance_stats=lambda: None)
+    trace_file = _trc.dump_now()  # standalone has no finalize dump
     print(json.dumps({
         "rank": rank, "event": "done",
         "path": args.path, "nprocs": nprocs,
@@ -241,32 +273,21 @@ def main(argv=None) -> int:
         "zipf_alpha": args.zipf_alpha if args.key_dist == "zipf" else None,
         "zipf_permute_hot": (bool(args.zipf_permute_hot)
                              if args.key_dist == "zipf" else None),
-        # rebalancer echo (env-configured, launcher-inherited) + the
-        # per-owner serve-load counters the rebalance sweep computes
-        # max/mean imbalance from
+        # rebalancer/chaos/reliable/trace echoes (env- or flag-
+        # configured): the sweep asserts the arm config
         "rebalance_spec": os.environ.get("MINIPS_REBALANCE") or None,
-        "rebalance": (trainer.rebalance_stats()
-                      if trainer is not None else None),
-        "serve": (trainer.serve_stats() if trainer is not None
-                  else dict(table.serve)),
         "staleness": (None if args.staleness == float("inf")
                       else int(args.staleness)),
         "cache_bytes": args.cache_bytes,
         "pull_dedup": bool(args.pull_dedup),
         "push_dedup": bool(args.push_dedup),
-        # chaos/reliable echo + wire health: the resilience sweep asserts
-        # the arm config and reads the recovery counters
         "chaos_spec": os.environ.get("MINIPS_CHAOS") or None,
         "reliable_on": os.environ.get("MINIPS_RELIABLE", "")
         not in ("", "0"),
-        "wire_frames_lost": (trainer.wire_frames_lost
-                             if trainer is not None else 0),
-        "wire_frames_malformed": (trainer.wire_frames_malformed
-                                  if trainer is not None else 0),
-        "reliable": (trainer.reliable_stats()
-                     if trainer is not None else None),
-        "chaos": (trainer.chaos_stats() if trainer is not None else None),
-        "cache": table.cache_stats(),
+        "trace_file": trace_file,
+        # bytes/drops/loss/timing/hist/cache/reliable/chaos/serve/
+        # rebalance — the one wire-health layout (utils/metrics.py)
+        **wire_record(trainer if trainer is not None else solo),
         "compute": (f"jit({backend})" if args.compute == "jit"
                     else "none"),
         "bus": os.environ.get("MINIPS_BUS", "zmq") if bus else "none",
@@ -279,7 +300,6 @@ def main(argv=None) -> int:
         "wire_bytes_per_row_moved": round(
             (b_push1 - b_push0 + b_pull1 - b_pull0)
             / max(rows_moved, 1), 3),
-        "timing": table.timers.summary(),  # per-leg latency + overlap
         "wall_s": round(dt, 4),
     }), flush=True)
     if monitor is not None:

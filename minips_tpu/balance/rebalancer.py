@@ -36,6 +36,8 @@ from typing import Optional
 
 import numpy as np
 
+from minips_tpu.obs import tracer as _trc
+
 __all__ = ["RebalanceConfig", "Rebalancer", "plan_assignment"]
 
 
@@ -289,6 +291,12 @@ class Rebalancer:
             else:
                 new_ov[b] = dst
         new_ep = ep + 1
+        tr = _trc.TRACER
+        if tr is not None:
+            tr.instant("rebalance", "rb_plan",
+                       {"table": name, "ep": new_ep,
+                        "moves": [[int(b), int(s), int(d)]
+                                  for b, s, d in moves]})
         self.bus.publish(f"{self.PLAN_KIND}:{name}",
                          {"ep": new_ep,
                           "ovb": [int(b) for b in new_ov],

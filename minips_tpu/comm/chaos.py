@@ -56,6 +56,8 @@ import threading
 import time
 from typing import Optional
 
+from minips_tpu.obs import tracer as _trc
+
 __all__ = ["ChaosSpec", "ChaosBus"]
 
 _OPS = ("drop", "dup", "delay", "reorder")
@@ -186,6 +188,14 @@ class ChaosBus:
         with self._lock:
             self.stats["frames"] += 1
 
+        def note(op: str) -> None:
+            tr = _trc.TRACER
+            if tr is not None:
+                # the injected fault on the timeline, next to the
+                # recovery it provokes (reliable retransmit spans)
+                tr.instant("chaos", op, {"kind": kind, "sender": sender,
+                                         "seq": seq})
+
         def hit(op: str) -> bool:
             # rate first, hash only when armed: a zero-rate op must cost
             # nothing on the hot receive path (the drop-0 control arm
@@ -198,6 +208,7 @@ class ChaosBus:
         if hit("drop"):
             with self._lock:
                 self.stats["dropped"] += 1
+            note("drop")
             self._release_held((sender, stream))  # a drop still advances
             return
         dup_copy = None
@@ -207,6 +218,7 @@ class ChaosBus:
             dup_copy = (json.loads(json.dumps(msg)), blob)
             with self._lock:
                 self.stats["duplicated"] += 1
+            note("dup")
         if hit("delay"):
             # hold for ~delay_ms (deterministically jittered ±50%): later
             # frames on every link overtake it — delay IS reordering on
@@ -215,6 +227,7 @@ class ChaosBus:
             self._schedule(spec.delay_ms * jit / 1e3, msg, blob)
             with self._lock:
                 self.stats["delayed"] += 1
+            note("delay")
         elif hit("reorder"):
             # adjacent swap: park until the NEXT frame on the same
             # (sender, stream) link passes, or reorder_ms elapses with no
@@ -226,6 +239,7 @@ class ChaosBus:
                                     + spec.reorder_ms / 1e3, msg, blob)
                 self.stats["reordered"] += 1
                 self._cond.notify()
+            note("reorder")
             if parked is not None:  # two in a row: the first-held goes now
                 self._forward(parked[1], parked[2])
         else:

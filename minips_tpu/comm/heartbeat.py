@@ -16,6 +16,7 @@ import time
 from typing import Callable, Optional
 
 from minips_tpu.comm.bus import ControlBus
+from minips_tpu.obs import tracer as _trc
 
 
 class HeartbeatMonitor:
@@ -37,6 +38,15 @@ class HeartbeatMonitor:
         bus.on("heartbeat", self._on_beat)
 
     def _on_beat(self, sender: int, payload: dict) -> None:
+        tr = _trc.TRACER
+        if tr is not None and "t" in payload:
+            # the cross-rank clock-alignment sample obs/merge.py feeds
+            # on: my receive timestamp (the event ts) paired with the
+            # sender's send timestamp, both monotonic — min-filtered
+            # NTP-style across both directions, the one-way delays
+            # cancel and the per-rank clock offsets fall out
+            tr.instant("hb", "hb", {"from": sender,
+                                    "t_sent": float(payload["t"])})
         with self._lock:
             if sender in self._last_seen:
                 self._last_seen[sender] = self._clock()

@@ -71,6 +71,8 @@ import time
 from collections import OrderedDict
 from typing import Optional
 
+from minips_tpu.obs import tracer as _trc
+
 __all__ = ["ReliableChannel"]
 
 NACK_KIND = "__rl_nack"
@@ -82,11 +84,12 @@ _NACK_BATCH = 256  # max seqs per NACK frame (flood valve)
 
 
 class _Gap:
-    __slots__ = ("tries", "due")
+    __slots__ = ("tries", "due", "t0")
 
-    def __init__(self, due: float):
+    def __init__(self, due: float, t0: float = 0.0):
         self.tries = 0
         self.due = due
+        self.t0 = t0  # gap registration time: the retransmit span start
 
 
 class _Rx:
@@ -235,8 +238,16 @@ class ReliableChannel:
             if seq < rx.exp or seq in rx.buf:
                 self.stats["dups_dropped"] += 1
                 return
-            if rx.gaps.pop(seq, None) is not None:
+            gap = rx.gaps.pop(seq, None)
+            if gap is not None:
                 self.stats["recovered"] += 1
+                tr = _trc.TRACER
+                if tr is not None:
+                    # the retransmit span: gap open -> frame recovered
+                    tr.complete("reliable", "retransmit", gap.t0,
+                                {"sender": sender, "stream": stream,
+                                 "seq": seq, "tries": gap.tries},
+                                t1=now)
             if seq == rx.exp:
                 self._deliver(msg, blob)
                 rx.exp += 1
@@ -267,7 +278,7 @@ class ReliableChannel:
                 for s in range(rx.exp, seq):
                     if s not in rx.buf and s not in rx.gaps \
                             and s not in rx.skip:  # given-up stays given up
-                        rx.gaps[s] = _Gap(now + self.settle_s)
+                        rx.gaps[s] = _Gap(now + self.settle_s, now)
                         opened = True
                 if opened:
                     self._wake.set()  # repair thread: leave the idle tick
@@ -333,10 +344,15 @@ class ReliableChannel:
             rx = self._rx.get((sender, stream))
             if rx is None:
                 return
+            tr = _trc.TRACER
             for s in (int(x) for x in payload.get("seqs", [])):
                 if rx.gaps.pop(s, None) is not None:
                     rx.skip.add(s)
                     self.stats["gave_up"] += 1
+                    if tr is not None:
+                        tr.instant("reliable", "gave_up",
+                                   {"sender": sender, "stream": stream,
+                                    "seq": s, "why": "gone"})
             self._drain(rx)
 
     def _on_top(self, sender: int, payload: dict) -> None:
@@ -355,7 +371,7 @@ class ReliableChannel:
                 for s in range(rx.exp, min(top, rx.exp + self.buffer_cap)):
                     if s not in rx.buf and s not in rx.gaps \
                             and s not in rx.skip:
-                        rx.gaps[s] = _Gap(now + self.settle_s)
+                        rx.gaps[s] = _Gap(now + self.settle_s, now)
                         self._wake.set()
 
     # -------------------------------------------------------- repair thread
@@ -379,6 +395,11 @@ class ReliableChannel:
                         rx.gaps.pop(s)
                         rx.skip.add(s)
                         self.stats["gave_up"] += 1
+                        tr = _trc.TRACER
+                        if tr is not None:
+                            tr.instant("reliable", "gave_up",
+                                       {"sender": sender,
+                                        "stream": stream, "seq": s})
                     else:
                         if len(ask) >= _NACK_BATCH:
                             # this pass's NACK is full: leave the rest
@@ -396,6 +417,12 @@ class ReliableChannel:
                 if ask:
                     nacks.append((sender, stream, ask))
                     self.stats["nacks_sent"] += 1
+        tr = _trc.TRACER
+        if tr is not None:
+            for sender, stream, seqs in nacks:
+                tr.instant("reliable", "nack",
+                           {"to": sender, "stream": stream,
+                            "n": len(seqs)})
         for sender, stream, seqs in nacks:  # outside the lock: sends can
             try:                            # block (native bounded outbox)
                 self.bus.send(sender, NACK_KIND,

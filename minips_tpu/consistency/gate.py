@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import time
 
+from minips_tpu.obs import tracer as _trc
+
 
 # A retired (out-of-data) worker's published clock: far above any real
 # clock so it never gates peers. Sticky — finalize-time clock publishes
@@ -84,16 +86,33 @@ class StalenessGate:
         if gmin >= threshold:
             return
         self.gate_waits += 1
+        t_wait0 = time.monotonic()
+        tr = _trc.TRACER
+        behind: list[int] = []
+        if tr is not None:
+            # WHO the gate is missing — the blocked-time attribution
+            # the straggler report is built from (obs/report.py)
+            snap = self.gossip.snapshot()
+            excluded = self.gossip.excluded
+            behind = sorted(p for p, v in snap.items()
+                            if v and p not in excluded
+                            and min(v) < threshold)
         deadline = time.monotonic() + self.timeout
-        while not self.gossip.wait_global_min(
-                threshold, timeout=min(1.0, self.timeout)):
-            dead = self.monitor.check() if self.monitor is not None else set()
-            if dead:
-                for p in dead:
-                    self.gossip.exclude(p)
-                raise PeerFailureError(dead)
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"SSP gate timed out at clock {clock} "
-                    f"(global_min={self.gossip.global_min()}, "
-                    f"staleness={self.staleness})")
+        try:
+            while not self.gossip.wait_global_min(
+                    threshold, timeout=min(1.0, self.timeout)):
+                dead = (self.monitor.check()
+                        if self.monitor is not None else set())
+                if dead:
+                    for p in dead:
+                        self.gossip.exclude(p)
+                    raise PeerFailureError(dead)
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"SSP gate timed out at clock {clock} "
+                        f"(global_min={self.gossip.global_min()}, "
+                        f"staleness={self.staleness})")
+        finally:
+            if tr is not None:
+                tr.complete("clock", "gate_wait", t_wait0,
+                            {"clock": clock, "behind": behind})

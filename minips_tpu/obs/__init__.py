@@ -1,0 +1,21 @@
+"""Observability layer for the sharded PS (this PR's tentpole).
+
+Four pieces, all reading the same per-rank event stream:
+
+- :mod:`minips_tpu.obs.tracer` — the env-gated (``MINIPS_TRACE``)
+  bounded ring buffer of typed wire events, dumped as Chrome-trace JSON
+  per rank;
+- :mod:`minips_tpu.obs.hist` — fixed-bucket log2 latency histograms
+  (always on, independent of the tracer) feeding p50/p95/p99 into the
+  done lines next to the means;
+- :mod:`minips_tpu.obs.merge` — the cross-rank merger: clock alignment
+  from heartbeat exchange, flow arrows linking client pull legs to
+  owner serves, optional XLA device-trace interleave;
+- :mod:`minips_tpu.obs.report` — blocked-time attribution over a merged
+  trace (per-rank: fraction blocked on which owner / gate peer /
+  fence).
+
+Everything here is import-light on purpose: the tracer module is
+imported by every hot-path module (bus, tables, gate) and must cost one
+attribute lookup + one branch when the layer is off.
+"""

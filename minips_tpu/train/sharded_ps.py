@@ -179,6 +179,9 @@ import numpy as np
 from minips_tpu.comm.bus import ClockGossip
 from minips_tpu.consistency.gate import (PeerFailureError, StalenessGate,
                                          admits)
+from minips_tpu.obs import tracer as _trc
+from minips_tpu.obs.hist import Log2Histogram, merge_counts, \
+    summarize_counts
 from minips_tpu.ops.quantized_comm import (dequantize_rows_int8,
                                            quantize_rows_int8)
 from minips_tpu.parallel.partition import BlockRouter, RangePartitioner
@@ -186,7 +189,8 @@ from minips_tpu.utils.timing import CommTimers
 
 __all__ = ["ShardedTable", "ShardedPSTrainer", "PeerFailureError",
            "PullFuture", "RowCache", "table_state_bytes",
-           "quantize_rows_int8", "dequantize_rows_int8"]
+           "tables_hist_stats", "quantize_rows_int8",
+           "dequantize_rows_int8"]
 
 
 class RowCache:
@@ -457,6 +461,12 @@ class PullFuture:
         # report the real RTT, not the compute window it hid under
         t.timers.record_pull(latency_s=t_arrived - self._t_issue,
                              blocked_s=now - t_block0)
+        tr = _trc.TRACER
+        if tr is not None and self._remote:
+            tr.complete("pull", "pull_wait", t_block0,
+                        {"owners": sorted({int(o)
+                                           for o, _i in self._remote}),
+                         "clk": self.clk}, t1=now)
         return out_u[self._inv] if self._inv is not None else out_u
 
     def cancel(self) -> None:
@@ -617,6 +627,18 @@ class ShardedTable:
         self._serve_lock = threading.Lock()
         self.serve = {"pull_requests": 0, "pull_rows": 0,
                       "push_frames": 0, "push_rows": 0}
+        # ---- observability (obs/): always-on server-side latency
+        # histograms (serve duration, park duration — the tail half of
+        # the serve counters above), and the env-gated wire tracer.
+        # ``_trc.maybe_init`` arms the process tracer from MINIPS_TRACE
+        # on first construction and is a no-op (one env read) when off;
+        # ``_leg_t0``/``_fence_t0`` are trace-only bookkeeping (empty
+        # forever when the tracer is off).
+        self.hist_serve = Log2Histogram()
+        self.hist_park = Log2Histogram()
+        _trc.maybe_init(rank)
+        self._leg_t0: dict[int, tuple] = {}   # rid -> (t0, owner)
+        self._fence_t0: dict[int, float] = {}  # block -> fence start
         # ---- server shard: ONLY my row range lives here (the 1/N memory
         # claim, materialization included — a multi-GB Criteo table must
         # never exist whole on any host); per-(seed, rank) stream keeps
@@ -653,7 +675,10 @@ class ShardedTable:
         # pull requests waiting for the staleness rule — the reference's
         # PendingBuffer (SURVEY.md §2 ProgressTracker/PendingBuffer row)
         self._cons = None  # object with admit_pull(clk) + clock
-        self._parked: list[tuple] = []  # (sender, req, keys|None, clk)
+        # parked pulls: (sender, req, keys|None, clk, ep, t_parked) —
+        # the timestamp feeds the park-duration histogram (and the
+        # tracer's 'parked' spans) when the entry is finally served
+        self._parked: list[tuple] = []
         self._park_lock = threading.Lock()
         # ---- client plumbing
         self._req = 0
@@ -846,6 +871,7 @@ class ShardedTable:
         """
         if ep <= self.router.epoch:  # cheap duplicate cut (benign race;
             return False             # the locked apply re-checks)
+        t_adopt0 = time.monotonic()
         if self.async_push:
             try:
                 self.flush_pushes(acks=False)
@@ -881,6 +907,8 @@ class ShardedTable:
                             self._early_release.discard((b, ep))
                         else:
                             self._fenced.add(b)
+                            if _trc.TRACER is not None:
+                                self._fence_t0[b] = time.monotonic()
             if ships:
                 self._await_acks[ep] = [(b, dst) for b, dst, _ in ships]
             self._adopt_acks.setdefault(ep, set()).add(self.rank)
@@ -889,11 +917,16 @@ class ShardedTable:
                           if e < ep - 4 and e not in self._await_acks]:
                 del self._adopt_acks[stale]
             self._mig_cond.notify_all()
+        tr = _trc.TRACER
         for b, dst, st in ships:
             head, blob = self._encode_block_state(b, ep, st)
             self.bus.send(dst, f"rbS:{self.name}", head, blob=blob)
             self.rb_stats["blocks_out"] += 1
             self.rb_stats["migrated_rows"] += int(head["n"])
+            if tr is not None:
+                tr.instant("rebalance", "rb_ship",
+                           {"b": int(b), "dst": int(dst),
+                            "rows": int(head["n"]), "ep": ep})
         for src in sorted({s for _b, s, _d in moved if s != self.rank}):
             self.bus.send(src, f"rbA:{self.name}", {"ep": ep})
         if self._cache is not None:
@@ -903,6 +936,10 @@ class ShardedTable:
         self._maybe_release_fences(ep)
         self._drain_parked_pushes()
         self.serve_parked()
+        if tr is not None:
+            tr.complete("rebalance", "rb_adopt", t_adopt0,
+                        {"ep": ep, "out": len(ships),
+                         "moved": len(moved)})
         return True
 
     def _take_block_locked(self, b: int) -> dict:
@@ -988,12 +1025,15 @@ class ShardedTable:
         if st is None:
             self._drop("malformed", sender, "bad rbS block state")
             return
+        tr = _trc.TRACER
         with self._mig_cond:
             with self._state_lock:
                 if b in self._pending_state:
                     self._install_block_locked(b, st)
                     self._pending_state.discard(b)
                     self.rb_stats["blocks_in"] += 1
+                    if tr is not None:
+                        tr.instant("rebalance", "rb_install", {"b": b})
                 elif int(self.router.owner_of_blocks()[b]) == self.rank:
                     pass  # duplicate of an installed block: a re-install
                     # would roll back updates applied since — drop it
@@ -1031,12 +1071,20 @@ class ShardedTable:
 
     def _on_fence_release(self, sender: int, payload: dict) -> None:
         b, ep = int(payload.get("b", -1)), int(payload.get("ep", 0))
+        released = False
         with self._mig_cond:
             if b in self._fenced and self.router.epoch >= ep:
                 self._fenced.discard(b)
+                released = True
             else:  # rbF beat my plan adoption (reordered control plane)
                 self._early_release.add((b, ep))
             self._mig_cond.notify_all()
+        tr = _trc.TRACER
+        if tr is not None and released:
+            t0 = self._fence_t0.pop(b, None)
+            if t0 is not None:
+                tr.complete("rebalance", "rb_fence", t0,
+                            {"b": b, "ep": ep})
         self.serve_parked()
 
     def rebalance_settled(self) -> bool:
@@ -1105,6 +1153,10 @@ class ShardedTable:
     def _send_epoch_nack(self, sender: int, req: int) -> None:
         ep, ov = self.router.table()
         self.rb_stats["refused_pulls"] += 1
+        tr = _trc.TRACER
+        if tr is not None:
+            tr.instant("serve", "pull_refused",
+                       {"from": sender, "rid": req, "ep": ep})
         self.bus.send(sender, f"psE:{self.name}",
                       {"req": int(req), "ep": ep,
                        "ovb": [int(b) for b in ov],
@@ -1147,11 +1199,15 @@ class ShardedTable:
             if keys.size:
                 self._heat.touch(self.router.blocks_of(keys))
                 self._apply_keys_locked(keys, grads)
+        tr = _trc.TRACER
         for o, k, g in forwards:
             # forwarded slice: decoded f32 rows, no seq (the ORIGINAL
             # frame was acked by this hop; the reliable layer covers
             # the second hop like any other frame)
             self.rb_stats["forwarded_pushes"] += 1
+            if tr is not None:
+                tr.instant("push", "push_forward",
+                           {"to": int(o), "n": int(k.size)})
             blob = k.tobytes() + np.ascontiguousarray(g,
                                                       np.float32).tobytes()
             self.bus.send(o, f"psP:{self.name}",
@@ -1320,9 +1376,11 @@ class ShardedTable:
         self._flush_acks(sender)
 
     def _handle_push(self, sender: int, payload: dict) -> None:
+        t_apply0 = time.monotonic()
         blob = payload.get("__blob__")
         n = int(payload.get("n", 0))
         comm = payload.get("comm", "float32")
+        tr = _trc.TRACER
         if not self._check_peer_config(sender, payload):
             return
         # frames self-describe their wire format, so a mixed fleet (one
@@ -1345,12 +1403,23 @@ class ShardedTable:
             # forward what migrated away, park what outruns my epoch
             self._ingest_push(keys, grads.reshape(n, self.dim),
                               int(payload.get("ep", 0)))
-            return
-        offs = keys - self.shard_lo
-        if n and (offs.min() < 0 or offs.max() >= self.part.shard_size):
-            self._drop("misrouted", sender, "push keys outside my range")
-            return
-        self._apply_rows(offs, grads)  # read-only view is fine: never written
+        else:
+            offs = keys - self.shard_lo
+            if n and (offs.min() < 0
+                      or offs.max() >= self.part.shard_size):
+                self._drop("misrouted", sender,
+                           "push keys outside my range")
+                return
+            self._apply_rows(offs, grads)  # read-only view: never written
+        if tr is not None:
+            # flow finish AFTER validation, next to the apply span: a
+            # dropped (misrouted/config/malformed) frame must not draw
+            # a completed cross-rank arrow for a discarded gradient
+            if payload.get("seq") is not None:
+                tr.flow("f", _trc.flow_id(f"push:{self.name}", sender,
+                                          int(payload["seq"])), "push")
+            tr.complete("push", "push_apply", t_apply0,
+                        {"from": sender, "n": n})
 
     def _handle_push_range(self, sender: int, payload: dict) -> None:
         blob = payload.get("__blob__")
@@ -1422,8 +1491,14 @@ class ShardedTable:
                 return
             admitted = self._cons is None or self._cons.admit_pull(clk)
             if v == "park" or not admitted:
+                tr = _trc.TRACER
+                if tr is not None:
+                    tr.instant("serve", "pull_park",
+                               {"from": sender, "rid": req, "clk": clk,
+                                "why": v if v == "park" else "admission"})
                 with self._park_lock:
-                    self._parked.append((sender, req, keys, clk, ep))
+                    self._parked.append((sender, req, keys, clk, ep,
+                                         time.monotonic()))
                 # re-check (park/drain race, same as the seed path):
                 # adoption/unfence/clock between verdict and append
                 # would have drained an empty buffer and never retried
@@ -1439,8 +1514,14 @@ class ShardedTable:
             self._drop("misrouted", sender, "pull keys outside my range")
             return
         if self._cons is not None and not self._cons.admit_pull(clk):
+            tr = _trc.TRACER
+            if tr is not None:
+                tr.instant("serve", "pull_park",
+                           {"from": sender, "rid": req, "clk": clk,
+                            "why": "admission"})
             with self._park_lock:  # reference PendingBuffer: park the Get
-                self._parked.append((sender, req, keys, clk, 0))
+                self._parked.append((sender, req, keys, clk, 0,
+                                     time.monotonic()))
             # re-check: a clock change between the admission test and the
             # append would have drained an empty buffer and never retried
             if self._cons.admit_pull(clk):
@@ -1474,6 +1555,7 @@ class ShardedTable:
 
     def _serve_pull(self, sender: int, req: int, keys: np.ndarray,
                     clk: int = 0) -> None:
+        t_serve0 = time.monotonic()
         # stamp BEFORE reading state: the certificate must be a lower
         # bound on what the rows contain, and clocks only advance
         stamp = self._serve_stamp(sender, clk)
@@ -1497,7 +1579,8 @@ class ShardedTable:
                         rows = self._read_rows_locked(keys)
             if not ok:
                 with self._park_lock:
-                    self._parked.append((sender, req, keys, clk, 0))
+                    self._parked.append((sender, req, keys, clk, 0,
+                                         time.monotonic()))
                 self.serve_parked()
                 return
             self._heat.touch(self.router.blocks_of(keys))
@@ -1512,6 +1595,16 @@ class ShardedTable:
         if acks:
             head["acks"] = acks  # piggyback: the free ack ride home
         self.bus.send(sender, f"psr:{self.name}", head, blob=blob)
+        self.hist_serve.record_s(time.monotonic() - t_serve0)
+        tr = _trc.TRACER
+        if tr is not None:
+            # the flow finish pairs with the requester's 's' event —
+            # both derive the id from (requester rank, wire rid)
+            tr.flow("f", _trc.flow_id(f"pull:{self.name}", sender, req),
+                    "pull")
+            tr.complete("serve", "serve_pull", t_serve0,
+                        {"from": sender, "rid": req,
+                         "rows": int(keys.size), "stamp": stamp})
 
     def _on_pull_all(self, sender: int, payload: dict) -> None:
         req = int(payload.get("req", -1))
@@ -1525,7 +1618,8 @@ class ShardedTable:
             # a shard assembly must not ship while a migrated block is
             # in transit: the live copy would be on neither side
             with self._park_lock:
-                self._parked.append((sender, req, None, clk, 0))
+                self._parked.append((sender, req, None, clk, 0,
+                                     time.monotonic()))
             if (self._cons is None or self._cons.admit_pull(clk)) and (
                     self._rb is None
                     or self._pull_all_verdict() == "serve"):
@@ -1535,6 +1629,7 @@ class ShardedTable:
 
     def _serve_pull_all(self, sender: int, req: int,
                         clk: int = 0) -> None:
+        t_serve0 = time.monotonic()
         stamp = self._serve_stamp(sender, clk)
         xb: list[int] = []
         xl: list[int] = []
@@ -1560,7 +1655,8 @@ class ShardedTable:
                             rows = np.concatenate(parts)
             if not ok:
                 with self._park_lock:
-                    self._parked.append((sender, req, None, clk, 0))
+                    self._parked.append((sender, req, None, clk, 0,
+                                         time.monotonic()))
                 self.serve_parked()
                 return
         else:
@@ -1578,6 +1674,14 @@ class ShardedTable:
         if acks:
             head["acks"] = acks
         self.bus.send(sender, f"psr:{self.name}", head, blob=blob)
+        self.hist_serve.record_s(time.monotonic() - t_serve0)
+        tr = _trc.TRACER
+        if tr is not None:
+            tr.flow("f", _trc.flow_id(f"pull:{self.name}", sender, req),
+                    "pull")
+            tr.complete("serve", "serve_pull_all", t_serve0,
+                        {"from": sender, "rid": req,
+                         "rows": int(rows.shape[0])})
 
     def serve_parked(self) -> None:
         """Re-check parked pulls against the admission rule — called by the
@@ -1620,9 +1724,23 @@ class ShardedTable:
                     continue
                 ready.append(p)
             self._parked = still
-        for sender, req, _keys, _clk, _ep in refuse:
+        # park-duration accounting happens at UNPARK (serve or refuse):
+        # a parked request's cost is the time it sat, however it left
+        now = time.monotonic()
+        tr = _trc.TRACER
+        for sender, req, _keys, _clk, _ep, t_park in refuse:
+            self.hist_park.record_s(now - t_park)
+            if tr is not None:
+                tr.complete("serve", "parked", t_park,
+                            {"from": sender, "rid": req,
+                             "why": "refused"}, t1=now)
             self._send_epoch_nack(sender, req)
-        for sender, req, keys, clk, _ep in ready:
+        for sender, req, keys, clk, _ep, t_park in ready:
+            self.hist_park.record_s(now - t_park)
+            if tr is not None:
+                tr.complete("serve", "parked", t_park,
+                            {"from": sender, "rid": req,
+                             "why": "served"}, t1=now)
             if keys is None:
                 self._serve_pull_all(sender, req, clk)
             else:
@@ -1652,6 +1770,7 @@ class ShardedTable:
                 self._drop("malformed", sender, "bad f32 reply size")
                 return
             rows = np.frombuffer(blob, np.float32).reshape(-1, self.dim)
+        leg = None
         with self._reply_cond:
             gid = self._rid_gid.get(rid)
             if gid is not None and gid in self._replies:
@@ -1665,7 +1784,14 @@ class ShardedTable:
                 self._replies[gid][rid] = (
                     rows, int(payload.get("stamp", 0)), payload)
                 self._reply_t[gid] = time.monotonic()
+                leg = self._leg_t0.pop(rid, None)
                 self._reply_cond.notify_all()
+        if leg is not None:
+            tr = _trc.TRACER
+            if tr is not None:
+                tr.complete("pull", "pull_leg", leg[0],
+                            {"owner": leg[1], "rid": rid,
+                             "bytes": len(blob)})
 
     def _on_epoch_nack(self, sender: int, payload: dict) -> None:
         """Client side of the pull-leg epoch fence: the owner I routed a
@@ -1690,8 +1816,10 @@ class ShardedTable:
             if note is not None:
                 note(self.name, ep, ov)  # training thread adopts it
         sends: list[tuple[int, int, int, np.ndarray]] = []
+        tr = _trc.TRACER
         with self._reply_cond:
             gid = self._rid_gid.pop(rid, None)
+            self._leg_t0.pop(rid, None)  # refused leg: span abandoned
             grp = self._groups.get(gid) if gid is not None else None
             if grp is None:
                 return  # finished/cancelled group: nothing to re-route
@@ -1713,9 +1841,19 @@ class ShardedTable:
                 grp["legs"][rid2] = (int(o), idx[m])
                 self._rid_gid[rid2] = gid
                 self.bytes_pulled += keys[m].nbytes
+                if tr is not None:
+                    self._leg_t0[rid2] = (time.monotonic(), int(o))
                 sends.append((int(o), rid2, grp["clk"], keys[m]))
             self._reply_cond.notify_all()
+        if tr is not None:
+            tr.instant("serve", "pull_releg",
+                       {"rid": rid, "ep": ep, "relegs": len(sends)})
         for o, rid2, clk, kslice in sends:
+            if tr is not None:
+                tr.flow("s",
+                        _trc.flow_id(f"pull:{self.name}",
+                                     self.rank, rid2),
+                        "pull", {"owner": o, "rid": rid2})
             self.bus.send(o, f"psG:{self.name}",
                           {"req": rid2, "clk": clk, **self._ep_header(),
                            **self._cfg_header()}, blob=kslice.tobytes())
@@ -1831,6 +1969,10 @@ class ShardedTable:
             if not keep.all():
                 keys, rows = keys[keep], rows[keep]
         self._cache.insert(keys, rows, stamp)
+        tr = _trc.TRACER
+        if tr is not None:
+            tr.instant("pull", "cache_insert",
+                       {"n": int(keys.size), "stamp": int(stamp)})
 
     def _next_req(self) -> int:
         with self._req_lock:
@@ -1855,6 +1997,7 @@ class ShardedTable:
         if grp is not None:
             for rid in grp["legs"]:
                 self._rid_gid.pop(rid, None)
+                self._leg_t0.pop(rid, None)
 
     def _take_group(self, gid: int) -> tuple[dict, list]:
         """Detach a completed group's final leg map + extra-local idx
@@ -1916,6 +2059,13 @@ class ShardedTable:
                 return self._w[gkeys - self.shard_lo]
         deadline = time.monotonic() + (self.pull_timeout
                                        if timeout is None else timeout)
+        t_fence0: Optional[float] = None  # first time the read blocked
+
+        def _trace_fence_wait() -> None:
+            tr = _trc.TRACER
+            if tr is not None and t_fence0 is not None:
+                tr.complete("pull", "fence_wait", t_fence0,
+                            {"n": int(gkeys.size)})
         while True:
             with self._mig_cond:
                 owners = self.router.shard_of(gkeys)
@@ -1927,17 +2077,23 @@ class ShardedTable:
                     blocked = bool(bl & (self._fenced
                                          | self._pending_state))
                 if blocked:
+                    if t_fence0 is None:
+                        t_fence0 = time.monotonic()
                     if time.monotonic() > deadline:
+                        _trace_fence_wait()
                         raise TimeoutError(
                             f"pull({self.name}): local rows fenced "
                             "mid-migration and never released")
                     self._mig_cond.wait(timeout=0.1)
                     continue
                 if mine.all():
+                    _trace_fence_wait()
                     self._count_serve(pull_rows=gkeys.size)
                     self._heat.touch(self.router.blocks_of(gkeys))
                     with self._state_lock:
                         return self._read_rows_locked(gkeys)
+            _trace_fence_wait()
+            t_fence0 = None
             # some keys moved away since issue: fetch them from their
             # current owner (rare — only a migration window hits this)
             out = np.empty((gkeys.size, self.dim), np.float32)
@@ -2020,6 +2176,7 @@ class ShardedTable:
                 grp = {"clk": clk, "uniq": uniq, "legs": {},
                        "extra_local": []}
                 self._groups[gid] = grp
+            tr = _trc.TRACER
             for o, idx in remote:
                 # one wire request id PER LEG, registered BEFORE the
                 # send (a reply must never beat its bookkeeping); the
@@ -2032,6 +2189,13 @@ class ShardedTable:
                     # under the reply lock: replies land on the receive
                     # thread and bump the same counter (non-atomic RMW)
                     self.bytes_pulled += kslice.nbytes
+                    if tr is not None:
+                        self._leg_t0[rid] = (time.monotonic(), o)
+                if tr is not None:
+                    tr.flow("s",
+                            _trc.flow_id(f"pull:{self.name}",
+                                         self.rank, rid),
+                            "pull", {"owner": o, "rid": rid})
                 self.bus.send(o, f"psG:{self.name}",
                               {"req": rid, "clk": clk,
                                **self._ep_header(), **self._cfg_header()},
@@ -2194,16 +2358,20 @@ class ShardedTable:
 
     def _settle_acks(self, seqs) -> None:
         now = time.monotonic()
-        t0s = []
+        settled = []  # (seq, t_sent, owner)
         with self._push_cond:
             for s in seqs:
                 rec = self._inflight.pop(int(s), None)
                 if rec is not None:
-                    t0s.append(rec[0])
-            if t0s:
+                    settled.append((int(s), rec[0], rec[1]))
+            if settled:
                 self._push_cond.notify_all()
-        for t0 in t0s:
+        tr = _trc.TRACER
+        for seq, t0, owner in settled:
             self.timers.record_push_ack(now - t0)
+            if tr is not None:
+                tr.complete("push", "push_ack", t0,
+                            {"owner": owner, "seq": seq}, t1=now)
 
     def _on_push_ack(self, sender: int, payload: dict) -> None:
         seqs = payload.get("seqs")
@@ -2400,6 +2568,11 @@ class ShardedTable:
                     **self._ep_header(), **self._cfg_header()}
             if self.async_push:
                 head["seq"] = self._take_push_seq(o)
+                tr = _trc.TRACER
+                if tr is not None:
+                    tr.flow("s", _trc.flow_id(f"push:{self.name}", self.rank,
+                                              head["seq"]), "push",
+                            {"owner": o, "seq": head["seq"]})
             self.bus.send(o, f"psP:{self.name}", head, blob=kb + gb)
             self.bytes_pushed += len(kb) + len(gb)
 
@@ -2568,6 +2741,28 @@ class ShardedTable:
     load_state_dict = load_shard_state_dict
 
 
+def tables_hist_stats(tables) -> dict:
+    """The done-line ``hist`` block over a set of tables: client-side
+    pull latency / blocked time / push-ack latency (CommTimers) plus
+    server-side serve duration / park duration, each as a log2-bucket
+    p50/p95/p99 summary. Shared by the trainer and the bench worker's
+    standalone (no-trainer) path so the layout cannot fork."""
+    tables = list(tables)
+    tsnap = CommTimers.merge_snapshots(
+        [t.timers.snapshot() for t in tables])
+    serve = merge_counts([t.hist_serve.snapshot() for t in tables])
+    park = merge_counts([t.hist_park.snapshot() for t in tables])
+    return {
+        "pull_latency_ms": summarize_counts(
+            tsnap["hists"]["pull_latency"]),
+        "pull_blocked_ms": summarize_counts(
+            tsnap["hists"]["pull_blocked"]),
+        "push_ack_ms": summarize_counts(tsnap["hists"]["push_ack"]),
+        "serve_ms": summarize_counts(serve),
+        "park_ms": summarize_counts(park),
+    }
+
+
 class ShardedPSTrainer:
     """Clock/gate/finalize driver over a set of ShardedTables — the Engine-
     side loop of the sharded PS (pull → compute → push → clock → gate).
@@ -2587,6 +2782,7 @@ class ShardedPSTrainer:
         self.staleness = staleness
         self.monitor = monitor
         self.clock = 0
+        _trc.maybe_init(bus.my_id)  # MINIPS_TRACE: arm the wire tracer
         self.gossip = ClockGossip(bus, num_processes, workers_per_process=1)
         self.gate = StalenessGate(self.gossip, staleness,
                                   timeout=gate_timeout, monitor=monitor)
@@ -2689,6 +2885,9 @@ class ShardedPSTrainer:
             # gossip heat, and (coordinator) maybe plan a migration
             self.rebalancer.on_tick()
         self.clock += 1
+        tr = _trc.TRACER
+        if tr is not None:
+            tr.instant("clock", "tick", {"clock": self.clock})
         self.gossip.publish_local([self.clock])
         self.gate.wait(self.clock)
         for t in self.tables.values():
@@ -2724,22 +2923,29 @@ class ShardedPSTrainer:
                       getattr(self, "_retired", False))
         peers = set(range(self.num_processes)) - {self.bus.my_id}
         deadline = time.monotonic() + timeout
-        while True:
-            with self._fin_cond:
-                live = peers - self.gossip.excluded
-                if live <= self._flushed and live <= self._acked:
-                    return
-                self._fin_cond.wait(timeout=0.5)
-            dead = self.monitor.check() if self.monitor is not None else set()
-            for p in dead:
-                self.gossip.exclude(p)
-            if time.monotonic() > deadline:
+        try:
+            while True:
                 with self._fin_cond:
                     live = peers - self.gossip.excluded
-                    missing = sorted((live - self._flushed)
-                                     | (live - self._acked))
-                raise TimeoutError(
-                    f"finalize: peers {missing} never quiesced")
+                    if live <= self._flushed and live <= self._acked:
+                        return
+                    self._fin_cond.wait(timeout=0.5)
+                dead = (self.monitor.check()
+                        if self.monitor is not None else set())
+                for p in dead:
+                    self.gossip.exclude(p)
+                if time.monotonic() > deadline:
+                    with self._fin_cond:
+                        live = peers - self.gossip.excluded
+                        missing = sorted((live - self._flushed)
+                                         | (live - self._acked))
+                    raise TimeoutError(
+                        f"finalize: peers {missing} never quiesced")
+        finally:
+            # the per-rank trace survives the run either way: a clean
+            # finalize dumps here, a poisoned one dumps here AND again
+            # at atexit (idempotent) with whatever events followed
+            _trc.dump_now()
 
     def shutdown_barrier(self, timeout: float = 10.0) -> None:
         """Rendezvous before closing the bus: finalize() only quiesces
@@ -2838,6 +3044,13 @@ class ShardedPSTrainer:
         (utils/timing.CommTimers.summary fields)."""
         return CommTimers.aggregate(
             [t.timers for t in self.tables.values()])
+
+    def hist_stats(self) -> dict:
+        """Log2 latency histograms over all tables, as p50/p95/p99
+        summary blocks (obs/hist.py) — the done-line ``hist`` field.
+        Always a dict (the layer is always on); a quantity with no
+        samples yet reports ``{"count": 0}`` — idle, not off."""
+        return tables_hist_stats(self.tables.values())
 
     def serve_stats(self) -> dict:
         """Per-owner serve-load counters summed over tables (always on):

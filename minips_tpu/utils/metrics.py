@@ -10,32 +10,44 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
 from typing import IO, Any, Optional
 
 
 class MetricsLogger:
-    """Append-only JSONL metrics sink; also mirrors to stderr when verbose."""
+    """Append-only JSONL metrics sink; also mirrors to stderr when verbose.
+
+    Thread-safe: the sharded-PS stack logs from the bus receive thread
+    (drop notes, failure events) while the training thread logs step
+    records — an unguarded ``write`` + ``flush`` pair can interleave two
+    records into one torn JSONL line, which downstream scrapers then
+    drop silently. One lock around the whole emit keeps every line
+    atomic (``print`` to stderr included: the mirrored stream is
+    scraped by the launcher harvest too)."""
 
     def __init__(self, path: Optional[str] = None, verbose: bool = True):
         self._fh: Optional[IO[str]] = open(path, "a") if path else None
         self._verbose = verbose
         self._t0 = time.monotonic()
+        self._lock = threading.Lock()
 
     def log(self, **record: Any) -> dict:
         record.setdefault("t", round(time.monotonic() - self._t0, 6))
         line = json.dumps(record, sort_keys=True)
-        if self._fh is not None:
-            self._fh.write(line + "\n")
-            self._fh.flush()
-        if self._verbose:
-            print(line, file=sys.stderr)
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            if self._verbose:
+                print(line, file=sys.stderr)
         return record
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def __enter__(self) -> "MetricsLogger":
         return self
@@ -62,6 +74,11 @@ def wire_record(trainer) -> dict:
         # purpose: both are wire-health signals the done line must carry
         "wire_frames_malformed": trainer.wire_frames_malformed,
         "timing": trainer.comm_timing(),
+        # log2 latency histograms (obs/hist.py) as p50/p95/p99 blocks:
+        # ALWAYS a dict (the layer is always on); a quantity that saw
+        # no samples reports {"count": 0} — "idle", distinct from the
+        # None an OFF layer (cache/reliable/chaos/rebalance) reports
+        "hist": trainer.hist_stats(),
         # row-cache counters (train/sharded_ps.RowCache): None when every
         # table runs cache-off, so scrapers can tell "off" from "cold"
         "cache": trainer.cache_stats(),

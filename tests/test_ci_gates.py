@@ -15,7 +15,7 @@ sys.path.insert(0, str(REPO / "ci"))
 
 from bench_regression import (cache_tripwires, chaos_tripwires,  # noqa: E402
                               compare, main, rebalance_tripwires,
-                              throughput_points)
+                              throughput_points, trace_tripwires)
 
 
 def _art(points):
@@ -215,6 +215,38 @@ def test_rebalance_skewed_arms_never_enter_the_throughput_gate():
     gate-invisible rows_per_sec_skewed key, like the chaos arms."""
     pts = throughput_points(_rebal_art())
     assert [p for p in pts] == ["rebalance_3proc/permuted"], pts
+
+
+def _trace_art(un=100.0, tr=95.0, merge_ok=True, flows=12):
+    return {"metric": "m", "trace_overhead_3proc": {
+        "untraced": {"rows_per_sec_per_process": un},
+        "traced": {"rows_per_sec_per_process": tr,
+                   "merge_ok": merge_ok, "flows_linked": flows,
+                   "merged_trace": "/tmp/x/merged_trace.json"},
+    }}
+
+
+def test_trace_tripwire_passes_on_healthy_sweep():
+    assert trace_tripwires(_trace_art()) == []
+    assert trace_tripwires({"metric": "m"}) == []  # vacuous
+    # 15% is the line: 85.0 exactly passes, just below fails
+    assert trace_tripwires(_trace_art(tr=85.0)) == []
+
+
+def test_trace_tripwire_tax_beyond_15pct_fails():
+    probs = trace_tripwires(_trace_art(tr=80.0))
+    assert len(probs) == 1 and "TRACE-TAX" in probs[0]
+    # a missing traced rate is a tax failure too, not a silent pass
+    art = _trace_art()
+    del art["trace_overhead_3proc"]["traced"]["rows_per_sec_per_process"]
+    assert any("TRACE-TAX" in p for p in trace_tripwires(art))
+
+
+def test_trace_tripwire_unmergeable_or_flowless_trace_fails():
+    probs = trace_tripwires(_trace_art(merge_ok=False))
+    assert any("TRACE-MERGE" in p for p in probs)
+    probs = trace_tripwires(_trace_art(flows=0))
+    assert any("TRACE-MERGE" in p for p in probs)
 
 
 def test_main_end_to_end_exit_codes(tmp_path):
