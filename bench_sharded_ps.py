@@ -526,6 +526,131 @@ def main() -> int:
 
     trace_grid = _trace_arms(o_reps)
 
+    # THE PULL STORM (this PR): the PS measured as a SERVICE — 6 read-
+    # only clients (2 threads x 3 ranks) firing request-sized zipf
+    # reads (8 keys: a user lookup, not a training batch) against 1
+    # pusher, unpermuted zipf(1.1) so the hot head sits in shard 0.
+    # Arms: replicas OFF (every hot read pays a wire RTT to the one
+    # hot owner) vs the serving plane ON (owners promote the warm
+    # working set to replica ranks; a reader holding a replica serves
+    # hot keys LOCALLY, zero wire) vs SHED (admission rate throttled
+    # so the owner sheds/backpressures — the refuse-with-retry path
+    # must complete, never poison). Alternating medians like every
+    # throughput pair. Storm rates live under gate-invisible keys
+    # (read_rows_per_sec) — the absolute SERVE-* tripwires in
+    # ci/bench_regression.py gate them, not the ±10% run-to-run
+    # comparison (the off arm is one hot owner's serve rate, which
+    # swings like the rebalance static arm). HONESTY NOTE (the PR1
+    # overlap caveat again): on this 2-core container both arms'
+    # latency TAILS are scheduler noise that swings integer factors
+    # run to run — reads/sec and p50 separate the arms robustly
+    # (local replica hits are ~free), p99 only within a slack band.
+    STORM_SPEC = ("replicas=2,hot=512,interval=0,min_heat=0.5,"
+                  "decay=0.9,lease=2.0")
+    STORM_SHED_SPEC = STORM_SPEC + ",rate=50,burst=4"
+
+    def _storm_args() -> list:
+        return ["--storm", "2", "--storm-pushers", "1",
+                "--storm-batch", "8", "--storm-think-ms", "2",
+                "--storm-step-s", "0.03", "--batch", "128",
+                "--rows", "4096", "--key-dist", "zipf",
+                "--no-zipf-permute-hot", "--staleness", "1",
+                "--updater", "sgd", "--pull-timeout", "30"]
+
+    def _run_storm(serve: str | None, iters_s: int,
+                   timeout: float = 240.0) -> dict:
+        argv = [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
+                "--path", "sparse", "--iters", str(iters_s),
+                "--warmup", str(max(2, iters_s // 6))] \
+            + _storm_args()
+        if serve:
+            argv += ["--serve", serve]
+        from minips_tpu import launch
+
+        try:
+            res = launch.run_local_job(
+                3, argv, base_port=None,
+                env_extra={"MINIPS_CHAOS": "", "MINIPS_RELIABLE": "",
+                           "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
+                           "MINIPS_SERVE": ""},
+                timeout=timeout)
+        except Exception as e:  # noqa: BLE001 - completion-gated arms
+            return {"completed": False, "error": str(e)[:300]}
+        echoed_sv = {r.get("serve_spec") for r in res}
+        assert echoed_sv == {serve or None}, (serve, echoed_sv)
+        rep = [r["serve"]["replica"] for r in res]
+
+        def tot(k: str) -> int:
+            return sum((x or {}).get(k) or 0 for x in rep)
+        hists = [r["hist"]["pull_latency_ms"] or {} for r in res]
+        out = {
+            "completed": True,
+            "read_rows_per_sec": round(
+                sum(r["read_rows_per_sec"] for r in res), 1),
+            "pull_p50_ms": max((h.get("p50_ms") or 0.0)
+                               for h in hists),
+            "pull_p99_ms": max((h.get("p99_ms") or 0.0)
+                               for h in hists),
+            "wire_frames_lost": sum(r["wire_frames_lost"]
+                                    for r in res),
+            "frames_dropped": sum(r["frames_dropped"] for r in res),
+        }
+        if serve:
+            out.update({
+                "replica_local_rows": tot("replica_local_rows"),
+                "replica_wire_rows": tot("replica_served_rows"),
+                "stale_reads": tot("stale_reads"),
+                "shed_redirects": tot("shed_redirects"),
+                "backpressure": tot("backpressure"),
+                "lease_refused": (tot("lease_refused")
+                                  + tot("stale_refused")),
+            })
+        return out
+
+    def _storm_grid(reps: int) -> dict:
+        s_iters = 15 if args.quick else 60
+        arms = {"off": None, "on": STORM_SPEC}
+        runs: dict[str, list[dict]] = {a: [] for a in arms}
+        for _ in range(reps):
+            for a, spec in arms.items():
+                runs[a].append(_run_storm(spec, s_iters))
+
+        def med(arm: str) -> dict:
+            ok = [r for r in runs[arm] if r.get("completed")]
+            if not ok:
+                return runs[arm][-1]
+            by = sorted(ok, key=lambda r: r["read_rows_per_sec"])
+            return {**by[len(by) // 2], "reps": reps}
+        grid = {"spec": STORM_SPEC, "off": med("off"), "on": med("on")}
+        # the shed arm is a COMPLETION gate (SERVE-SHED): with the
+        # admission bucket throttled the run must still finish —
+        # refusals become explicit redirects/backoffs, never timeouts
+        grid["shed"] = _run_storm(STORM_SHED_SPEC, s_iters)
+        grid["shed"]["spec"] = STORM_SHED_SPEC
+        return grid
+
+    storm_grid = _storm_grid(o_reps)
+
+    # resolved JAX backend stamp (satellite): probed in a SUBPROCESS so
+    # the driver never grabs the TPU out from under a worker (libtpu is
+    # exclusive per process) — ci/bench_regression.py refuses to
+    # compare artifacts whose backends differ (the r03-r05
+    # cpu-fallback runs were silently incomparable to r01/r02)
+    def _resolve_jax_backend() -> str:
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, sys; sys.stdout.write("
+                 "jax.default_backend())"],
+                capture_output=True, text=True, timeout=120.0,
+                env={**os.environ, "JAX_PLATFORMS": os.environ.get(
+                    "JAX_PLATFORMS", "")})
+            out = (probe.stdout or "").strip().splitlines()
+            return out[-1] if probe.returncode == 0 and out \
+                else "unknown"
+        except Exception:  # noqa: BLE001 - a stamp, not a gate
+            return "unknown"
+
     headline = curve["3"]["rows_per_sec_per_process"]
     print(json.dumps({
         "metric": "sharded-PS rows/sec/process (sparse pull+push, "
@@ -534,6 +659,9 @@ def main() -> int:
         "unit": "rows/sec/process",
         "vs_baseline": None,  # control-plane rate; not a chip number
         "device": "cpu-loopback",
+        # the resolved JAX platform these numbers were measured under:
+        # the regression gate refuses cross-backend comparisons
+        "jax_backend": _resolve_jax_backend(),
         "scaling_sparse_zmq": curve,
         "bus_comparison_3proc": buses,
         "path_comparison_3proc": paths,
@@ -545,6 +673,7 @@ def main() -> int:
         "chaos_resilience_3proc": chaos_grid,
         "rebalance_3proc": rebalance_grid,
         "trace_overhead_3proc": trace_grid,
+        "pull_storm_3proc": storm_grid,
     }))
     return 0
 

@@ -31,7 +31,16 @@ gate-invisible (``rows_per_sec_skewed``) like the chaos arms'.
 ``trace_tripwires`` (TRACE-TAX/TRACE-MERGE) guards the
 ``trace_overhead_3proc`` sweep: the MINIPS_TRACE-armed arm must stay
 within 15% of the untraced arm AND its per-rank traces must merge
-(merge CLI exit 0, >= 1 cross-rank flow).
+(merge CLI exit 0, >= 1 cross-rank flow). ``serve_tripwires``
+(SERVE-SLO/SERVE-STALE/SERVE-SHED) guards the ``pull_storm_3proc``
+sweep: the replicas-on arm must beat the off arm on read rows/sec and
+median latency with replicas actually engaged (p99 inside a slack
+band — the tail is scheduler noise on the CI container), zero reads
+may violate the staleness bound, and the admission-throttled arm must
+complete via explicit refusal, never a timeout poison. Artifacts also
+carry a resolved ``jax_backend`` stamp, and the gate REFUSES to
+compare artifacts across backends (cross-backend rates differ by
+integer factors; re-base instead).
 
 Usage:
     python ci/bench_regression.py PRIOR.json NEW.json [--tolerance 0.10]
@@ -247,6 +256,143 @@ def trace_tripwires(new: dict) -> list[str]:
     return problems
 
 
+SERVE_P99_SLACK = 2.5  # storm on-arm p99 guard vs the off arm. On the
+# 2-core CI container both arms' latency TAILS are scheduler noise
+# (single reps swing 4x run to run; the PR1 overlap caveat applies),
+# and the on arm's readers complete ~5x more requests, so their
+# residual wire pulls queue behind genuinely more work — the measured
+# honest ratio is ~1.3-2x AT HIGHER THROUGHPUT, a closed-loop
+# throughput/latency tradeoff, not a regression. reads/sec and p50
+# separate the arms robustly (a local replica hit is ~free), so those
+# gate strictly; the p99 guard sits at 2.5x to catch the
+# integer-factor failure classes (a sleep/lock on the replica serve
+# path, refusal loops re-routing every leg twice) without flaking on
+# the tradeoff band.
+
+
+def serve_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``pull_storm_3proc`` sweep
+    (the serving plane); vacuous when the sweep is absent.
+
+    - SERVE-SLO: the replicas-ON storm arm must hold read rows/sec at
+      or above the off arm (10% drift band; strictly above is the
+      commit-time acceptance the artifact records) and beat it on
+      median pull latency, with p99 inside ``SERVE_P99_SLACK``, and
+      must actually have served rows from replicas — a zero replica
+      count means the plane silently fell off (env plumbing,
+      promotion dead, leases never granted) while the run still
+      completes.
+    - SERVE-STALE: zero reads older than ``clk − s`` recorded by any
+      storm arm (every consumed reply re-checks the admission rule its
+      serve claimed; a nonzero counter is a protocol bug, never load).
+    - SERVE-SHED: the admission-throttled arm must COMPLETE with the
+      shed path exercised (redirects/backpressure > 0) — refusal must
+      degrade to explicit retry, never to a timeout poison."""
+    grid = new.get("pull_storm_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    off = grid.get("off") or {}
+    on = grid.get("on") or {}
+    if not on.get("completed") or not off.get("completed"):
+        problems.append(
+            f"SERVE-SLO pull_storm_3proc: off completed="
+            f"{off.get('completed')!r} on completed="
+            f"{on.get('completed')!r} — the storm arms must complete")
+        return problems
+    rep_rows = (on.get("replica_local_rows") or 0) \
+        + (on.get("replica_wire_rows") or 0)
+    if not rep_rows:
+        problems.append(
+            "SERVE-SLO pull_storm_3proc/on: 0 replica-served rows — "
+            "the serving plane is silently disabled")
+    # the commit-time acceptance is reads STRICTLY above (the
+    # committed artifact records it); the standing gate tolerates a
+    # 10% drift band — the off arm is one hot owner's serve rate and
+    # swings run-to-run, and the 'plane silently fell off' mode
+    # (on == off exactly) is the replica-rows check's job above. What
+    # this trips on is replication actively COSTING read throughput.
+    r_off, r_on = off.get("read_rows_per_sec"), \
+        on.get("read_rows_per_sec")
+    if not (isinstance(r_off, (int, float))
+            and isinstance(r_on, (int, float))
+            and r_on >= r_off * 0.9):
+        problems.append(
+            f"SERVE-SLO pull_storm_3proc: on-arm reads {r_on!r} below "
+            f"the off arm's {r_off!r} rows/s (beyond the 10% drift "
+            "band) — replica fan-out is costing read throughput")
+    p50_off, p50_on = off.get("pull_p50_ms"), on.get("pull_p50_ms")
+    if isinstance(p50_off, (int, float)) \
+            and isinstance(p50_on, (int, float)) and p50_on > p50_off:
+        problems.append(
+            f"SERVE-SLO pull_storm_3proc: on-arm p50 {p50_on} ms above "
+            f"off-arm {p50_off} ms — local replica serving is not "
+            "cutting the median read latency")
+    p99_off, p99_on = off.get("pull_p99_ms"), on.get("pull_p99_ms")
+    if isinstance(p99_off, (int, float)) and p99_off > 0 \
+            and isinstance(p99_on, (int, float)) \
+            and p99_on > p99_off * SERVE_P99_SLACK:
+        problems.append(
+            f"SERVE-SLO pull_storm_3proc: on-arm p99 {p99_on} ms "
+            f"beyond {SERVE_P99_SLACK}x the off arm's {p99_off} ms — "
+            "the serve plane is taxing the read tail")
+    for arm in ("on", "shed"):
+        a = grid.get(arm) or {}
+        if a.get("stale_reads"):
+            problems.append(
+                f"SERVE-STALE pull_storm_3proc/{arm}: "
+                f"{a['stale_reads']} reads staler than the admission "
+                "bound — the snapshot stamp protocol is broken")
+    shed = grid.get("shed") or {}
+    if not shed.get("completed"):
+        problems.append(
+            f"SERVE-SHED pull_storm_3proc/shed: completed="
+            f"{shed.get('completed')!r} — admission throttling must "
+            "degrade to explicit refusal, never a timeout poison")
+    elif not ((shed.get("shed_redirects") or 0)
+              + (shed.get("backpressure") or 0)):
+        problems.append(
+            "SERVE-SHED pull_storm_3proc/shed: 0 shed/backpressure "
+            "events with the bucket throttled — admission control is "
+            "silently disabled")
+    return problems
+
+
+def backend_mismatch(prior: dict, new: dict) -> list[str]:
+    """Refuse to compare artifacts measured on different JAX backends
+    (satellite): the r03-r05 ``cpu-fallback(tpu-unresponsive)`` runs
+    were silently incomparable to the r01/r02 TPU runs — absolute
+    rates across backends differ by integer factors, so every
+    REGRESSED/MISSING verdict would be noise. An artifact predating
+    the stamp compares with a warning (we cannot refuse what was never
+    recorded); re-basing on the new backend is the fix, as with any
+    host change."""
+    pb, nb = prior.get("jax_backend"), new.get("jax_backend")
+    # "unknown" is the probe-failure sentinel bench_sharded_ps stamps
+    # when the resolver subprocess dies — a stamp that carries no
+    # information, treated exactly like a missing one (warn, compare):
+    # a transient probe timeout must not hard-fail the gate
+    if pb == "unknown":
+        pb = None
+    if nb == "unknown":
+        nb = None
+    if pb is None or nb is None:
+        if pb != nb or (prior.get("jax_backend")
+                        != new.get("jax_backend")):
+            print("bench-regression: WARNING — artifact missing a "
+                  "usable jax_backend stamp (prior="
+                  f"{prior.get('jax_backend')!r}, new="
+                  f"{new.get('jax_backend')!r}); cross-backend drift "
+                  "undetectable for this pair")
+        return []
+    if pb != nb:
+        return [f"BACKEND-MISMATCH: prior artifact measured on "
+                f"{pb!r}, new on {nb!r} — absolute rates across "
+                "backends are incomparable; re-base the artifact on "
+                "the new backend instead of comparing"]
+    return []
+
+
 def compare(prior: dict, new: dict, tolerance: float) -> list[str]:
     """Regression report lines; empty means the gate passes."""
     p, n = throughput_points(prior), throughput_points(new)
@@ -297,9 +443,16 @@ def main(argv: list[str] | None = None) -> int:
     with open(new_path) as f:
         new = json.load(f)
 
+    mismatch = backend_mismatch(prior, new)
+    if mismatch:
+        # cross-backend: run-to-run comparison is refused outright (the
+        # absolute tripwires would be as meaningless as the ratios)
+        print("\n".join(mismatch), file=sys.stderr)
+        return 1
     problems = (compare(prior, new, args.tolerance)
                 + cache_tripwires(new) + chaos_tripwires(new)
-                + rebalance_tripwires(new) + trace_tripwires(new))
+                + rebalance_tripwires(new) + trace_tripwires(new)
+                + serve_tripwires(new))
     pts = throughput_points(new)
     print(f"bench-regression: {len(pts)} throughput points checked "
           f"against {len(throughput_points(prior))} prior")

@@ -83,6 +83,42 @@ def main(argv=None) -> int:
     ap.add_argument("--hidden", type=int, default=256,
                     help="--compute jit: MLP hidden width over the "
                          "pulled rows (the MXU work per cycle)")
+    ap.add_argument("--storm", type=int, default=0, metavar="N",
+                    help="PULL-STORM mode: N read-only client threads "
+                         "per process hammer pull() while only the "
+                         "first --storm-pushers ranks push — the PS "
+                         "measured as a SERVICE (read fan-out) instead "
+                         "of a training gang. Requires --path sparse "
+                         "and a launcher run (nprocs > 1); the done "
+                         "line grows read_rows_per_sec")
+    ap.add_argument("--storm-pushers", type=int, default=1,
+                    help="storm mode: ranks below this push every "
+                         "iteration (the 'few pushers'); every rank "
+                         "still ticks so clocks advance fleet-wide")
+    ap.add_argument("--storm-batch", type=int, default=16,
+                    help="storm mode: keys per READ request — the "
+                         "serving request shape (a user lookup reads a "
+                         "handful of embedding rows, not a training "
+                         "batch). Small requests are what replica "
+                         "fan-out converts: a request whose keys are "
+                         "all held locally (own shard + replica "
+                         "snapshots) completes with ZERO wire legs")
+    ap.add_argument("--storm-think-ms", type=float, default=1.0,
+                    help="storm mode: per-request client think time — "
+                         "serving clients are open-loop (a user isn't "
+                         "a spin loop), and on an oversubscribed host "
+                         "a zero-think closed loop burns the CPU the "
+                         "serve path needs, drowning the latency tail "
+                         "in scheduler noise for both arms")
+    ap.add_argument("--storm-step-s", type=float, default=0.02,
+                    help="storm mode: main-loop pacing per iteration — "
+                         "the pusher cadence; readers free-run")
+    ap.add_argument("--serve", default=None, metavar="SPEC",
+                    help="arm the read-mostly serving plane "
+                         "(minips_tpu/serve/) with this MINIPS_SERVE "
+                         "spec — the flag spelling of the env knob; "
+                         "hot-block replicas, admission control, SLO "
+                         "gate (docs/serving.md)")
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="write this rank's wire trace (Chrome-trace "
                          "JSON, obs/tracer.py) into DIR — the flag "
@@ -99,6 +135,14 @@ def main(argv=None) -> int:
         ap.error(f"--warmup {args.warmup} must be < --iters {args.iters} "
                  "(otherwise the timer never starts and every rate is "
                  "garbage)")
+    if args.storm:
+        if args.path != "sparse":
+            ap.error("--storm requires --path sparse")
+        if args.compute != "none":
+            ap.error("--storm measures the serve path, not worker "
+                     "compute — drop --compute")
+        if args.storm_pushers < 1:
+            ap.error("--storm-pushers must be >= 1 (clocks must advance)")
 
     from minips_tpu.train.sharded_ps import ShardedPSTrainer, ShardedTable
 
@@ -159,11 +203,18 @@ def main(argv=None) -> int:
                          async_push=(args.overlap and
                                      args.overlap_legs != "pull"),
                          **table_wire_kwargs(args))
+    if args.storm and bus is None:
+        print(json.dumps({"rank": 0, "event": "error",
+                          "err": "--storm needs the launcher (n >= 2): "
+                                 "a standalone rank has no peers to "
+                                 "read from"}), flush=True)
+        return 2
     trainer = None
     if bus is not None:
         trainer = ShardedPSTrainer({"b": table}, bus, nprocs,
                                    staleness=args.staleness,
-                                   gate_timeout=60.0, monitor=monitor)
+                                   gate_timeout=60.0, monitor=monitor,
+                                   serve=args.serve)
         bus.handshake(nprocs)
 
     rng = np.random.default_rng(rank)
@@ -195,7 +246,54 @@ def main(argv=None) -> int:
             return zipf_sample(rng, B)
         return rng.integers(0, args.rows, size=B)
 
+    # ---- pull-storm mode: N read-only client THREADS per process
+    # free-run pull() against the fleet while the main thread paces
+    # pushes (pusher ranks only) + ticks. Reader counts are snapshotted
+    # at the warmup boundary so read_rows_per_sec covers exactly the
+    # timed window. Concurrent reader pulls are safe on the table (leg
+    # bookkeeping is per-group and locked; adoption stays on the
+    # push-driving thread — balance/rebalancer.py adopt_now guard).
+    import threading
+
+    storm_stop = threading.Event()
+    storm_errs: list = []
+    storm_counts = [0] * max(args.storm, 1)
+    storm_threads: list = []
+
+    def _storm_reader(j: int) -> None:
+        rrng = np.random.default_rng((rank, j, 1717))
+        SB = args.storm_batch
+        think = args.storm_think_ms / 1e3
+        while not storm_stop.is_set():
+            if think > 0:
+                time.sleep(think)
+            keys = (zipf_sample(rrng, SB) if zipf_sample is not None
+                    else rrng.integers(0, args.rows, size=SB))
+            try:
+                # the serving read clock: admission already proven
+                # fleet-wide, so reads never park on the in-flight step
+                table.pull_serving(keys)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                if not storm_stop.is_set():
+                    storm_errs.append(repr(e))
+                return
+            storm_counts[j] += SB
+
+    if args.storm:
+        for j in range(args.storm):
+            th = threading.Thread(target=_storm_reader, args=(j,),
+                                  daemon=True, name=f"storm-reader-{j}")
+            storm_threads.append(th)
+            th.start()
+
     def cycle():
+        if args.storm:
+            time.sleep(args.storm_step_s)  # pusher cadence
+            if rank < args.storm_pushers:
+                keys = draw_keys()
+                table.push(keys, grads)
+                return B
+            return 0
         if args.path == "sparse":
             if args.overlap and args.overlap_legs != "push":
                 if pending[1] is None:  # first iteration: nothing ahead
@@ -220,17 +318,29 @@ def main(argv=None) -> int:
 
     rows_moved = 0
     b_push0 = b_pull0 = 0.0
+    read0 = 0
     t0 = 0.0
     for i in range(args.iters):
         if i == args.warmup:
             rows_moved = 0
             b_push0, b_pull0 = table.bytes_pushed, table.bytes_pulled
+            read0 = sum(storm_counts)
             t0 = time.perf_counter()
         rows_moved += cycle()
         if trainer is not None:
             trainer.tick()  # ASP: publishes clock, never waits
     table.flush_pushes()  # standalone/async tail: count only drained work
     dt = time.perf_counter() - t0
+    read_rows = sum(storm_counts) - read0
+    if args.storm:
+        # stop the readers BEFORE finalize (post-finalize agreement is
+        # exact; a still-running reader would race the quiesce)
+        storm_stop.set()
+        for th in storm_threads:
+            th.join(timeout=30.0)
+        assert not any(th.is_alive() for th in storm_threads), \
+            "storm reader wedged"
+        assert not storm_errs, storm_errs
     b_push1, b_pull1 = table.bytes_pushed, table.bytes_pulled
     if pending[1] is not None:
         pending[1].cancel()  # dangling last prefetch: never consumed
@@ -257,7 +367,9 @@ def main(argv=None) -> int:
         hist_stats=lambda: tables_hist_stats([table]),
         cache_stats=table.cache_stats,
         reliable_stats=lambda: None, chaos_stats=lambda: None,
-        serve_stats=lambda: dict(table.serve),
+        # the standalone path has no trainer, hence no serve plane:
+        # the replica sub-block is None (off) like the other layers
+        serve_stats=lambda: {**table.serve, "replica": None},
         rebalance_stats=lambda: None)
     trace_file = _trc.dump_now()  # standalone has no finalize dump
     print(json.dumps({
@@ -276,6 +388,13 @@ def main(argv=None) -> int:
         # rebalancer/chaos/reliable/trace echoes (env- or flag-
         # configured): the sweep asserts the arm config
         "rebalance_spec": os.environ.get("MINIPS_REBALANCE") or None,
+        "serve_spec": (args.serve or os.environ.get("MINIPS_SERVE")
+                       or None),
+        "storm_readers": args.storm or None,
+        "storm_pushers": args.storm_pushers if args.storm else None,
+        "read_rows": int(read_rows) if args.storm else None,
+        "read_rows_per_sec": (round(read_rows / dt, 1) if args.storm
+                              else None),
         "staleness": (None if args.staleness == float("inf")
                       else int(args.staleness)),
         "cache_bytes": args.cache_bytes,

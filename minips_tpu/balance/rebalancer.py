@@ -158,6 +158,7 @@ class Rebalancer:
         self.coord = 0
         self.plans = 0
         self._stopped = False
+        self._drive_thread: Optional[int] = None  # push-driving thread
         self._lock = threading.Lock()
         self._pending: dict[str, dict] = {}        # table -> newest plan
         self._reports: dict[str, dict[int, dict]] = {}  # table -> rank ->
@@ -202,6 +203,9 @@ class Rebalancer:
         pending plan (the epoch fence point), decay heat, gossip the
         report, and — at the coordinator — maybe plan."""
         now = time.monotonic()
+        # the tick caller IS the push-driving thread by contract; record
+        # it so adopt_now() can refuse other threads (see below)
+        self._drive_thread = threading.get_ident()
         for name, t in self.trainer.tables.items():
             self._adopt_one(name, t)
             if t._heat is not None:
@@ -214,14 +218,42 @@ class Rebalancer:
         """Adopt pending plans outside the tick path — finalize and
         pull_all call this so a plan landing after a rank's last tick
         still gets its adoption ack (a missing ack would hold peers'
-        fences open until their pull deadline poisons)."""
+        fences open until their pull deadline poisons).
+
+        THREAD-GUARDED (serving plane): the pull-wait poll also calls
+        this, and under a read storm pulls run on READER threads
+        concurrent with the training thread's pushes — an adoption from
+        a reader could emit its rbA around a mid-flight old-table push
+        send and void the fence (the exact bus-thread hazard PR4's
+        review fixed). Once a tick has identified the push-driving
+        thread, every other thread's adopt_now is a no-op; the driving
+        thread's next tick (bounded — it ticks every step) adopts
+        instead. Before the first tick any thread may adopt (raw-table
+        drills drive no concurrent pushes)."""
+        if self._drive_thread is not None \
+                and self._drive_thread != threading.get_ident():
+            return
         for name, t in self.trainer.tables.items():
             self._adopt_one(name, t)
 
+    def has_pending(self, name: str) -> bool:
+        """A plan for ``name`` is noted but not yet adopted — readers
+        blocked on keys the pending table re-homes wait for the
+        driving thread's adoption instead of re-issuing pulls the old
+        table routes straight back (train/sharded_ps._read_local)."""
+        with self._lock:
+            return name in self._pending
+
     def stop(self) -> None:
         """No further plans (finalize): migrations already in flight
-        still settle through the normal fence path."""
+        still settle through the normal fence path. The CALLING thread
+        becomes the push-driving thread: finalize() drains pushes on
+        this thread next, so the thread-guard must let ITS adopt_now
+        through even when ticks ran elsewhere — otherwise the final
+        pending plan's rbA never goes out and peers' fences hold to
+        their pull deadline (the exact poison adopt_now prevents)."""
         self._stopped = True
+        self._drive_thread = threading.get_ident()
 
     def _adopt_one(self, name: str, t) -> None:
         with self._lock:

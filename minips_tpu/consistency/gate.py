@@ -34,14 +34,19 @@ def admits(global_min: float, clk: int, staleness: float) -> bool:
     certificate is ``global_min`` iff ``global_min >= clk − staleness``
     (BSP: s=0, SSP: bounded s, ASP: ∞ ⇒ always).
 
-    Two call sites share it deliberately: the owner-side pull admission
-    (``ShardedPSTrainer.admit_pull`` — serve or park) and the client row
-    cache's validity rule (``train/sharded_ps.RowCache`` — a cached row
-    whose pull reply was stamped ``global_min = g`` by its owner may
-    satisfy a later pull at clock ``c`` iff ``admits(g, c, s)``). One
-    predicate means a cache hit is admissible exactly when a synchronous
-    pull served under min-view ``g`` would have been — the staleness
-    proof lives in the stamp, not in a second, weaker rule."""
+    Three call sites share it deliberately: the owner-side pull
+    admission (``ShardedPSTrainer.admit_pull`` — serve or park), the
+    client row cache's validity rule (``train/sharded_ps.RowCache`` — a
+    cached row whose pull reply was stamped ``global_min = g`` by its
+    owner may satisfy a later pull at clock ``c`` iff
+    ``admits(g, c, s)``), and the serving plane's replica admission
+    (``serve/plane.TableServeState._on_replica_pull`` — a replica
+    serves from a snapshot stamped ``g`` iff the same predicate holds,
+    else it refuses and the client falls back to the owner). One
+    predicate means a cache hit or a replica hit is admissible exactly
+    when a synchronous pull served under min-view ``g`` would have been
+    — the staleness proof lives in the stamp, not in a second, weaker
+    rule."""
     if staleness == float("inf"):
         return True
     return global_min >= clk - int(staleness)

@@ -21,7 +21,8 @@ from __future__ import annotations
 
 import threading
 
-__all__ = ["Log2Histogram", "summarize_counts", "merge_counts"]
+__all__ = ["Log2Histogram", "summarize_counts", "merge_counts",
+           "slo_check"]
 
 N_BUCKETS = 40  # 2^39 us ~ 9.1 min: past every deadline in the repo
 
@@ -114,6 +115,26 @@ def summarize_counts(counts: list[int]) -> dict:
     last = max(i for i, c in enumerate(counts) if c)
     out["max_le_ms"] = round(_bucket_bounds(last)[1] / 1e3, 4)
     return out
+
+
+def slo_check(counts: list[int], target_ms: float,
+              q: float = 0.99) -> dict:
+    """SLO latency gate over one histogram (the serving plane's
+    done-line ``serve.replica.slo`` block and the bench SERVE-SLO
+    tripwire's runtime twin): the observed ``q``-quantile against a
+    millisecond target. An EMPTY histogram is not a violation (idle is
+    not slow) — ``violated`` is None there, mirroring the count-0
+    convention above."""
+    total = sum(counts)
+    if total == 0:
+        return {"count": 0, "target_ms": float(target_ms),
+                "q": q, "observed_ms": None, "violated": None}
+    v = quantile_us(counts, q)
+    observed = round(v / 1e3, 4) if v is not None else None
+    return {"count": total, "target_ms": float(target_ms), "q": q,
+            "observed_ms": observed,
+            "violated": bool(observed is not None
+                             and observed > target_ms)}
 
 
 def merge_counts(many: "list[list[int]]") -> list[int]:

@@ -1,0 +1,16 @@
+"""Read-mostly serving plane for the sharded PS (ROADMAP item 4).
+
+Snapshot-consistent hot-range read replicas, lease/epoch invalidation
+riding the rebalance fence machinery, per-owner token-bucket admission
+with a replica shed path, and SLO latency gates over the obs/ log2
+histograms — the first subsystem that treats the PS as a SERVICE
+(many read-only clients) rather than a fixed training gang.
+
+Env-gated via ``MINIPS_SERVE`` (off by default); protocol walkthrough
+and the staleness argument: docs/serving.md.
+"""
+
+from minips_tpu.serve.admission import TokenBucket
+from minips_tpu.serve.plane import ServeConfig, ServePlane, TableServeState
+
+__all__ = ["ServeConfig", "ServePlane", "TableServeState", "TokenBucket"]

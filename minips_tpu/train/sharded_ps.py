@@ -163,6 +163,41 @@ relaunching. With ``MINIPS_REBALANCE`` set (off by default):
 Checkpoints record the routing epoch + overlay + migrated block state
 so a restored fleet agrees with itself; protocol walkthrough:
 docs/architecture.md "Heat-aware shard rebalancer".
+
+THE READ-MOSTLY SERVING PLANE (this PR, ``minips_tpu/serve/``): all of
+the above measures a fixed training gang; the north star serves
+parameter reads at user scale, where the workload is MANY read-only
+clients against few pushers and a hot key range saturates one owner's
+receive thread. With ``MINIPS_SERVE`` set (off by default):
+
+- owners promote their hottest blocks (the same heat accounting the
+  rebalancer reads) to REPLICA ranks — a full-block snapshot grant,
+  then stamped delta frames each refresh interval carrying only the
+  rows pushes dirtied (rows ride the configured pull wire, int8 when
+  configured);
+- every grant/delta is stamped with the owner's gossip ``global_min``
+  read BEFORE the state read, and a replica serves a pull at requester
+  clock ``c`` only when ``admits(stamp, c, s)`` — the same predicate
+  the owner-side park and the row cache run — so a replica hit is
+  provably no staler than an owner pull (the owner stamp
+  ``min_excluding(requester)`` is ≥ this one) and the RowCache ingests
+  replica replies unchanged;
+- replica grants are LEASES: owners revoke them at the ``adopt_table``
+  epoch-fence point when a granted block migrates away, and expiry
+  (renewed by every refresh) turns a mute owner's replicas dark; a
+  replica that cannot serve refuses (``svN``) and the client re-issues
+  the leg against the owner — serving composes with online migration
+  instead of fighting it;
+- per-owner token-bucket ADMISSION on the wire pull path sheds
+  overload to replicas (``svS`` redirect) or refuses with explicit
+  backpressure (``svB`` + delayed retry); retried legs are
+  force-admitted, so every path is bounded and nothing times out to
+  a silent poison;
+- clients fan hot-block pull legs across ``{owner} ∪ holders``
+  round-robin, which is what converts replication into read
+  throughput (the ``pull_storm`` bench arm's lever).
+
+Protocol, knobs, and the staleness argument: docs/serving.md.
 """
 
 from __future__ import annotations
@@ -419,6 +454,11 @@ class PullFuture:
                 for rid, (o, idx) in legs.items():
                     rows, stamp = got[rid][0], got[rid][1]
                     out_u[idx] = rows
+                    if t._sv is not None:
+                        # the SERVE-STALE observable: every consumed
+                        # reply (owner- OR replica-served) must satisfy
+                        # the admission rule its serve claimed
+                        t._sv.check_reply_stamp(int(stamp), self.clk)
                     if t._cache is not None:
                         # the prefetch path populates the same cache
                         # under the same stamp rule — this is the one
@@ -608,6 +648,7 @@ class ShardedTable:
         self.router = BlockRouter(self.part)
         self._rb = None            # balance.rebalancer.Rebalancer
         self._heat = None          # balance.heat.HeatAccountant
+        self._sv = None            # serve.plane.TableServeState
         self._mig_cond = threading.Condition()  # guards the sets below
         self._xtra: dict[int, dict] = {}        # migrated-in block state
         self._fenced: set[int] = set()          # pulls park until rbF
@@ -763,11 +804,20 @@ class ShardedTable:
         update per touched row (ops/sparse_update.py semantics)."""
         grads = grads.reshape(offs.size, self.dim)
         self._count_serve(push_rows=offs.size)
+        if self._heat is not None:
+            # the serve plane's promotion signal on the seed (rb-off)
+            # path — the rb path's _ingest_push already touches
+            self._heat.touch(self.router.blocks_of(offs + self.shard_lo))
         with self._state_lock:
             uniq, inv = np.unique(offs, return_inverse=True)
             g = np.zeros((uniq.size, self.dim), np.float32)
             np.add.at(g, inv, grads)
             self._update_block(self._base_state(), uniq, g)
+        if self._sv is not None:
+            # dirty-row tracking for replica delta refresh: noted in the
+            # same handler call as the apply, so per-link FIFO keeps
+            # 'covered by a refresh stamp' implying 'noted or shipped'
+            self._sv.note_push(offs + self.shard_lo)
 
     def _adam_rows(self, st: dict, uniq: np.ndarray,
                    g: np.ndarray) -> None:
@@ -790,6 +840,10 @@ class ShardedTable:
     def _apply_range(self, lo_local: int, grads: np.ndarray) -> None:
         grads = grads.reshape(-1, self.dim)
         self._count_serve(push_rows=grads.shape[0])
+        if self._sv is not None:
+            self._sv.note_push_range(
+                self.shard_lo + lo_local,
+                self.shard_lo + lo_local + grads.shape[0])
         sl = slice(lo_local, lo_local + grads.shape[0])
         with self._state_lock:
             if self.updater == "sgd":
@@ -833,6 +887,25 @@ class ShardedTable:
             self.bus.on(f"rbA:{self.name}", self._on_adopt_ack)
             self.bus.on(f"rbF:{self.name}", self._on_fence_release)
             self.bus.on(f"psE:{self.name}", self._on_epoch_nack)
+
+    def attach_serve_plane(self, plane, cfg) -> None:
+        """Bind the read-mostly serving plane (serve/plane.py): arms
+        heat accounting when the rebalancer hasn't already, and
+        registers the serve control/data frames. Must run AFTER
+        ``attach_rebalancer`` when both are armed (the rebalancer
+        rebuilds the router and heat at its own block granularity —
+        the trainer constructs them in that order) and before any
+        traffic, like the rebalancer."""
+        from minips_tpu.balance.heat import HeatAccountant
+        from minips_tpu.serve.plane import TableServeState
+
+        self._sv = TableServeState(self, plane, cfg)
+        if self._heat is None:
+            self._heat = HeatAccountant(self.router.num_blocks,
+                                        cfg.decay)
+        if self.bus is not None:
+            for kind, fn in self._sv.handlers():
+                self.bus.on(f"{kind}:{self.name}", fn)
 
     def _owners_of(self, keys: np.ndarray) -> np.ndarray:
         return (self.router.shard_of(keys) if self._rb is not None
@@ -929,6 +1002,11 @@ class ShardedTable:
                             "rows": int(head["n"]), "ep": ep})
         for src in sorted({s for _b, s, _d in moved if s != self.rank}):
             self.bus.send(src, f"rbA:{self.name}", {"ep": ep})
+        if self._sv is not None and moved:
+            # lease/epoch invalidation: every replica lease I granted on
+            # a block that just migrated away dies AT the fence point —
+            # serving composes with online migration (docs/serving.md)
+            self._sv.on_blocks_moved(moved)
         if self._cache is not None:
             for b, _src, _dst in moved:
                 lo, ln = self.router.block_span(b)
@@ -1245,6 +1323,8 @@ class ShardedTable:
                             "an installed rbS)")
                     lo, _ln = self.router.block_span(int(b))
                     self._update_block(st, rk[m] - lo, rg[m])
+        if self._sv is not None:
+            self._sv.note_push(keys)  # replica delta dirty tracking
 
     def _drain_parked_pushes(self) -> None:
         with self._mig_cond:
@@ -1478,6 +1558,9 @@ class ShardedTable:
         keys = np.frombuffer(blob, np.int64)
         clk = int(payload.get("clk", 0))
         ep = int(payload.get("ep", 0))
+        if self._sv is not None and not self._sv.admit_request(
+                sender, req, keys, payload):
+            return  # shed to a replica (svS) or refused loudly (svB)
         if self._rb is not None:
             owners = self.router.shard_of(keys)
             if keys.size and ((owners < 0)
@@ -1588,6 +1671,8 @@ class ShardedTable:
             offs = keys - self.shard_lo
             with self._state_lock:
                 rows = self._w[offs]  # fancy indexing: a fresh array
+            if self._heat is not None:  # serve plane armed, rb off
+                self._heat.touch(self.router.blocks_of(keys))
         self._count_serve(pull_requests=1, pull_rows=keys.size)
         head, blob = self._reply_head_blob(req, rows)
         head["stamp"] = stamp
@@ -1858,6 +1943,53 @@ class ShardedTable:
                           {"req": rid2, "clk": clk, **self._ep_header(),
                            **self._cfg_header()}, blob=kslice.tobytes())
 
+    def _resend_leg(self, rid: int, plan) -> None:
+        """Detach live wire leg ``rid`` (no reply yet) and re-issue its
+        keys as fresh legs — the serving plane's fallback/redirect
+        primitive (the epoch-nack re-router above is the hand-rolled
+        sibling). ``plan(keys) -> [(target, kind, extra_head, mask)]``
+        with boolean masks partitioning the leg's keys; a target equal
+        to this rank joins the group's extra-local set and is read at
+        ``wait()``. A leg already answered/cancelled is a no-op (late
+        svB timers, crossed refusals)."""
+        sends: list[tuple] = []
+        tr = _trc.TRACER
+        with self._reply_cond:
+            gid = self._rid_gid.pop(rid, None)
+            self._leg_t0.pop(rid, None)
+            grp = self._groups.get(gid) if gid is not None else None
+            if grp is None:
+                return
+            leg = grp["legs"].pop(rid, None)
+            if leg is None:
+                return
+            _old, idx = leg
+            keys = grp["uniq"][idx]
+            for target, kind, extra, mask in plan(keys):
+                if not mask.any():
+                    continue
+                if target == self.rank:
+                    grp["extra_local"].append(idx[mask])
+                    continue
+                rid2 = self._next_req()
+                grp["legs"][rid2] = (int(target), idx[mask])
+                self._rid_gid[rid2] = gid
+                self.bytes_pulled += keys[mask].nbytes
+                if tr is not None:
+                    self._leg_t0[rid2] = (time.monotonic(), int(target))
+                sends.append((int(target), kind, rid2, grp["clk"],
+                              keys[mask], extra))
+            self._reply_cond.notify_all()
+        for target, kind, rid2, clk, kslice, extra in sends:
+            if tr is not None:
+                tr.flow("s", _trc.flow_id(f"pull:{self.name}",
+                                          self.rank, rid2),
+                        "pull", {"owner": target, "rid": rid2})
+            self.bus.send(target, f"{kind}:{self.name}",
+                          {"req": rid2, "clk": clk, **extra,
+                           **self._ep_header(), **self._cfg_header()},
+                          blob=kslice.tobytes())
+
     # --------------------------------------------------------- client side
     def bind_consistency(self, cons) -> None:
         """Attach the trainer's admission rule (server-side SSP gate)."""
@@ -2094,8 +2226,37 @@ class ShardedTable:
                         return self._read_rows_locked(gkeys)
             _trace_fence_wait()
             t_fence0 = None
-            # some keys moved away since issue: fetch them from their
-            # current owner (rare — only a migration window hits this)
+            # some keys are not mine under MY CURRENT table. Two very
+            # different cases hide here:
+            #
+            # (a) my table is BEHIND — a psE refusal re-routed these
+            #     keys into the local set under a PENDING newer table
+            #     this rank has not adopted yet. Re-issuing now would
+            #     route by the stale table, be refused straight back
+            #     into this local set, and recurse without bound (the
+            #     wait->_read_local->wait mutual recursion blew the
+            #     stack under the serving plane's replica-miss
+            #     traffic, which hits this window constantly). Adopt
+            #     the pending plan first (push-driving thread), or
+            #     WAIT for the driving thread's adoption (reader
+            #     threads — adopt_now is thread-guarded), then
+            #     re-evaluate ownership.
+            # (b) the keys genuinely migrated away since issue and my
+            #     table is current: round-trip to the real owner.
+            if self._rb is not None:
+                self._rb.adopt_now()  # no-op off the driving thread
+                pend = getattr(self._rb, "has_pending", None)
+                if pend is not None and pend(self.name):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"pull({self.name}): routing table "
+                            "adoption never caught up mid-migration")
+                    time.sleep(0.005)
+                    continue
+                with self._mig_cond:
+                    if not np.array_equal(
+                            self.router.shard_of(gkeys), owners):
+                        continue  # adoption changed routing: re-check
             out = np.empty((gkeys.size, self.dim), np.float32)
             out[~mine] = self._issue_pull(gkeys[~mine], clk).wait(
                 timeout=max(deadline - time.monotonic(), 0.1))
@@ -2151,6 +2312,11 @@ class ShardedTable:
         if lmask.any():
             local_idx = np.nonzero(lmask)[0]
             need[lmask] = False
+        if self._sv is not None and need.any():
+            # zero-wire replica read: keys whose block THIS rank holds
+            # as a replica (live lease, stamp admits clk) serve from
+            # the local snapshot — no leg, no frame (serve/plane.py)
+            self._sv.serve_local(uniq, out_u, need, clk)
         hits = lookups = 0
         if self._cache is not None and need.any():
             ridx = np.nonzero(need)[0]
@@ -2162,12 +2328,30 @@ class ShardedTable:
             if hits:
                 out_u[hit_idx] = rows[~miss]
                 need[hit_idx] = False
+        # client-side replica fan-out (serve plane): keys in replicated
+        # hot blocks may route to a replica holder instead of the owner
+        # — a REPLICA leg rides the svP wire (the holder serves from
+        # its snapshot or refuses and the leg falls back to the owner)
+        targets, rep_mask = owners, None
+        if self._sv is not None and need.any():
+            targets, rep_mask = self._sv.route_targets(uniq, owners,
+                                                       need)
         remote: list[tuple[int, np.ndarray]] = []
+        rep_legs: set[int] = set()  # positions in `remote` riding svP
         wire_rows = 0
         for o in range(self.num_processes):
-            mask = need & (owners == o)
-            if mask.any():
-                remote.append((o, np.nonzero(mask)[0]))
+            tmask = need & (targets == o)
+            if not tmask.any():
+                continue
+            if rep_mask is None:
+                remote.append((o, np.nonzero(tmask)[0]))
+                continue
+            for isrep in (False, True):  # owner + replica legs split:
+                m = tmask & (rep_mask == isrep)  # different wire kinds
+                if m.any():
+                    if isrep:
+                        rep_legs.add(len(remote))
+                    remote.append((o, np.nonzero(m)[0]))
         gid = 0  # a fully-local pull (own shard + cache hits) allocates
         if remote:  # no request slot and touches no wire state at all
             gid = self._next_req()
@@ -2177,7 +2361,7 @@ class ShardedTable:
                        "extra_local": []}
                 self._groups[gid] = grp
             tr = _trc.TRACER
-            for o, idx in remote:
+            for li, (o, idx) in enumerate(remote):
                 # one wire request id PER LEG, registered BEFORE the
                 # send (a reply must never beat its bookkeeping); the
                 # psE re-router re-splits a refused leg mid-flight
@@ -2196,7 +2380,8 @@ class ShardedTable:
                             _trc.flow_id(f"pull:{self.name}",
                                          self.rank, rid),
                             "pull", {"owner": o, "rid": rid})
-                self.bus.send(o, f"psG:{self.name}",
+                kind = "svP" if li in rep_legs else "psG"
+                self.bus.send(o, f"{kind}:{self.name}",
                               {"req": rid, "clk": clk,
                                **self._ep_header(), **self._cfg_header()},
                               blob=kslice.tobytes())
@@ -2228,6 +2413,27 @@ class ShardedTable:
                 return fut.wait()
             fut.cancel()
         return self._issue_pull(keys, self._my_clk()).wait()
+
+    def _serving_clk(self) -> int:
+        c = getattr(self._cons, "gated_clock", None)
+        return int(c) if c is not None else self._my_clk()
+
+    def pull_serving(self, keys: np.ndarray) -> np.ndarray:
+        """Read-only client pull at the last GATED clock — the serving
+        plane's read clock (docs/serving.md). A training pull stamps
+        the IN-FLIGHT clock, which nobody fleet-wide has proven
+        admissible yet: owners park it until gossip catches up and
+        replicas refuse it — correct, but the read pays a wait either
+        way. The last gated clock is the newest stamp whose admission
+        the local gate already PROVED (``global_min >= gated − s`` held
+        when its tick completed), so owners serve it immediately and
+        replica snapshots refreshed at the same boundary admit it —
+        the read still sees every peer's updates through
+        ``gated_clock − s``, one step behind the trainer's in-flight
+        step, which is exactly the SSP serving contract. Falls back to
+        the training clock when no trainer is bound."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        return self._issue_pull(keys, self._serving_clk()).wait()
 
     def prefetch_pull(self, keys: np.ndarray, *,
                       clock_ahead: int = 1) -> PullFuture:
@@ -2752,6 +2958,12 @@ def tables_hist_stats(tables) -> dict:
         [t.timers.snapshot() for t in tables])
     serve = merge_counts([t.hist_serve.snapshot() for t in tables])
     park = merge_counts([t.hist_park.snapshot() for t in tables])
+    # replica serve durations (serve/plane.py): merge_counts([]) is all
+    # zeros, so plane-off runs report {"count": 0} like every idle
+    # quantity here — the serve plane's own off-vs-idle distinction
+    # lives in the done line's serve.replica block (None = off)
+    replica = merge_counts([t._sv.hist_replica.snapshot()
+                            for t in tables if t._sv is not None])
     return {
         "pull_latency_ms": summarize_counts(
             tsnap["hists"]["pull_latency"]),
@@ -2760,6 +2972,7 @@ def tables_hist_stats(tables) -> dict:
         "push_ack_ms": summarize_counts(tsnap["hists"]["push_ack"]),
         "serve_ms": summarize_counts(serve),
         "park_ms": summarize_counts(park),
+        "replica_serve_ms": summarize_counts(replica),
     }
 
 
@@ -2775,13 +2988,18 @@ class ShardedPSTrainer:
     def __init__(self, tables: dict[str, ShardedTable], bus,
                  num_processes: int, *, staleness: float = 0,
                  gate_timeout: float = 60.0, monitor=None,
-                 rebalance: Optional[str] = None):
+                 rebalance: Optional[str] = None,
+                 serve: Optional[str] = None):
         self.tables = tables
         self.bus = bus
         self.num_processes = num_processes
         self.staleness = staleness
         self.monitor = monitor
         self.clock = 0
+        # the newest clock whose gate has PASSED — the serving plane's
+        # read stamp (pull_serving): admission for it is already proven
+        # fleet-wide, so serving reads never park on the in-flight step
+        self.gated_clock = 0
         _trc.maybe_init(bus.my_id)  # MINIPS_TRACE: arm the wire tracer
         self.gossip = ClockGossip(bus, num_processes, workers_per_process=1)
         self.gate = StalenessGate(self.gossip, staleness,
@@ -2808,6 +3026,18 @@ class ShardedPSTrainer:
                                                        Rebalancer)
 
             self.rebalancer = Rebalancer(self, RebalanceConfig.parse(spec))
+        # read-mostly serving plane (serve/): OFF by default — explicit
+        # spec wins, else $MINIPS_SERVE, else disabled. Constructed
+        # AFTER the rebalancer: attach_rebalancer rebuilds router+heat
+        # at its block granularity and the serve plane must see the
+        # final ones.
+        sspec = serve if serve is not None \
+            else os.environ.get("MINIPS_SERVE", "")
+        self.serve_plane = None
+        if sspec and sspec != "0":
+            from minips_tpu.serve.plane import ServeConfig, ServePlane
+
+            self.serve_plane = ServePlane(self, ServeConfig.parse(sspec))
 
     def admit_pull(self, clk: int) -> bool:
         """Reference ``model->Get`` admission: serve a pull stamped with
@@ -2890,6 +3120,15 @@ class ShardedPSTrainer:
             tr.instant("clock", "tick", {"clock": self.clock})
         self.gossip.publish_local([self.clock])
         self.gate.wait(self.clock)
+        self.gated_clock = self.clock
+        if self.serve_plane is not None:
+            # AFTER the gate on purpose: the gate just proved
+            # global_min >= clock - s, so a replica refresh stamped
+            # HERE is admissible at the current clock for the whole
+            # upcoming step window — refreshing before the gate ships
+            # stamps one step staler and replicas refuse most reads
+            # (measured: the storm's replica hit rate collapses)
+            self.serve_plane.on_tick()
         for t in self.tables.values():
             t.cache_age()  # rows un-admittable at the new clock die here
 
@@ -2912,6 +3151,12 @@ class ShardedPSTrainer:
             # still gets adopted + acked here so peers' fences release
             self.rebalancer.stop()
             self.rebalancer.adopt_now()
+        if self.serve_plane is not None:
+            # post-finalize agreement is EXACT, not staleness-bounded:
+            # stop granting and stop routing my own pulls to replicas
+            # (their leases go dark by expiry; no revoke frames race
+            # the shutdown barrier)
+            self.serve_plane.quiesce()
         for t in self.tables.values():
             t.flush_pushes()  # async tail: drained before the flush frame
             t.check_fatal()
@@ -2978,6 +3223,7 @@ class ShardedPSTrainer:
         from minips_tpu.consistency.gate import publish_clock
 
         self.clock = int(state["clock"])
+        self.gated_clock = self.clock  # restored state is settled state
         # publish the restored clock NOW (not at the first tick): a resumed
         # rank's first pull is stamped with this clock, and owners park it
         # until their view of every peer reaches clock - s — peers that
@@ -3056,13 +3302,19 @@ class ShardedPSTrainer:
         """Per-owner serve-load counters summed over tables (always on):
         requests/rows THIS process served as an owner — the done-line
         field sweeps compute max/mean per-shard serve load from, i.e.
-        the partition-imbalance observable the rebalancer acts on."""
+        the partition-imbalance observable the rebalancer acts on.
+        The ``replica`` sub-block carries the serving plane's counters
+        (replica-served rows, shed/backpressure, lease refusals, SLO):
+        None when the plane is OFF, all-zero counters when armed but
+        idle — the PR5 off-vs-idle convention."""
         out = {"pull_requests": 0, "pull_rows": 0,
                "push_frames": 0, "push_rows": 0}
         for t in self.tables.values():
             with t._serve_lock:
                 for k in out:
                     out[k] += t.serve[k]
+        out["replica"] = (self.serve_plane.stats_record()
+                          if self.serve_plane is not None else None)
         return out
 
     def rebalance_stats(self) -> Optional[dict]:

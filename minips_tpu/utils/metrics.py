@@ -89,7 +89,11 @@ def wire_record(trainer) -> dict:
         # per-owner serve-load counters (ALWAYS on): requests/rows this
         # process served as an owner — max/mean across ranks is the
         # partition-imbalance observable the heat-aware rebalancer acts
-        # on, measurable even with the rebalancer off
+        # on, measurable even with the rebalancer off. Its "replica"
+        # sub-block carries the read-mostly serving plane's counters
+        # (replica-served/shed/lease-refused/stale-reads + the SLO
+        # check): None when the plane is OFF, zero counters when armed
+        # but idle — the same off-vs-idle convention as the hist block
         "serve": trainer.serve_stats(),
         # rebalancer counters (balance/): None when the subsystem is
         # off (distinguishable from an armed-but-idle run)

@@ -13,8 +13,9 @@ import pytest
 REPO = pathlib.Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO / "ci"))
 
-from bench_regression import (cache_tripwires, chaos_tripwires,  # noqa: E402
-                              compare, main, rebalance_tripwires,
+from bench_regression import (backend_mismatch, cache_tripwires,  # noqa: E402
+                              chaos_tripwires, compare, main,
+                              rebalance_tripwires, serve_tripwires,
                               throughput_points, trace_tripwires)
 
 
@@ -247,6 +248,106 @@ def test_trace_tripwire_unmergeable_or_flowless_trace_fails():
     assert any("TRACE-MERGE" in p for p in probs)
     probs = trace_tripwires(_trace_art(flows=0))
     assert any("TRACE-MERGE" in p for p in probs)
+
+
+def _storm_art(*, off_reads=2000.0, on_reads=3000.0, off_p50=15.0,
+               on_p50=0.1, off_p99=100.0, on_p99=120.0, local=4000,
+               wire=500, stale=0, shed_completed=True, shed=30,
+               backpressure=5, on_completed=True) -> dict:
+    return {"pull_storm_3proc": {
+        "off": {"completed": True, "read_rows_per_sec": off_reads,
+                "pull_p50_ms": off_p50, "pull_p99_ms": off_p99},
+        "on": {"completed": on_completed, "read_rows_per_sec": on_reads,
+               "pull_p50_ms": on_p50, "pull_p99_ms": on_p99,
+               "replica_local_rows": local, "replica_wire_rows": wire,
+               "stale_reads": stale},
+        "shed": {"completed": shed_completed, "shed_redirects": shed,
+                 "backpressure": backpressure, "stale_reads": 0}}}
+
+
+def test_serve_tripwire_passes_on_healthy_sweep():
+    assert serve_tripwires(_storm_art()) == []
+    # absent sweep (other benches): not this gate's business
+    assert serve_tripwires({"metric": "m"}) == []
+
+
+def test_serve_tripwire_slo_fails_on_no_win_or_disengaged_plane():
+    # reads below the off arm beyond the drift band fail; a tie (the
+    # 'silently off' shape) is the replica-rows check's job, and small
+    # drift passes — the off arm is one hot owner's noisy serve rate
+    probs = serve_tripwires(_storm_art(on_reads=1700.0))
+    assert any("SERVE-SLO" in p and "costing read throughput" in p
+               for p in probs)
+    assert serve_tripwires(_storm_art(on_reads=1900.0)) == []
+    # zero replica-served rows = plane silently disabled
+    probs = serve_tripwires(_storm_art(local=0, wire=0))
+    assert any("SERVE-SLO" in p and "silently disabled" in p
+               for p in probs)
+    # median latency regressing fails; p99 has a slack band
+    probs = serve_tripwires(_storm_art(on_p50=20.0))
+    assert any("SERVE-SLO" in p and "p50" in p for p in probs)
+    assert serve_tripwires(_storm_art(on_p99=240.0)) == []  # in band
+    probs = serve_tripwires(_storm_art(on_p99=260.0))  # beyond 2.5x
+    assert any("SERVE-SLO" in p and "p99" in p for p in probs)
+    # a dead arm fails loudly instead of comparing garbage
+    probs = serve_tripwires(_storm_art(on_completed=False))
+    assert any("SERVE-SLO" in p and "must complete" in p
+               for p in probs)
+
+
+def test_serve_tripwire_stale_reads_fail():
+    probs = serve_tripwires(_storm_art(stale=3))
+    assert any("SERVE-STALE" in p for p in probs)
+
+
+def test_serve_tripwire_shed_must_complete_and_fire():
+    probs = serve_tripwires(_storm_art(shed_completed=False))
+    assert any("SERVE-SHED" in p and "poison" in p for p in probs)
+    probs = serve_tripwires(_storm_art(shed=0, backpressure=0))
+    assert any("SERVE-SHED" in p and "silently disabled" in p
+               for p in probs)
+    # either counter alone satisfies the gate
+    assert serve_tripwires(_storm_art(shed=0, backpressure=9)) == []
+
+
+def test_storm_arms_never_enter_the_throughput_gate():
+    """Storm rates live under read_rows_per_sec (gate-invisible): the
+    off arm is one hot owner's serve rate and must never feed the
+    run-to-run ±10% comparison."""
+    art = _storm_art()
+    assert throughput_points(art) == {}
+
+
+def test_backend_mismatch_refuses_cross_backend_compare(capsys):
+    prior = {"jax_backend": "tpu", "metric": "m"}
+    new = {"jax_backend": "cpu", "metric": "m"}
+    probs = backend_mismatch(prior, new)
+    assert len(probs) == 1 and "BACKEND-MISMATCH" in probs[0]
+    # same backend: clean pass
+    assert backend_mismatch(new, dict(new)) == []
+    # unstamped prior (pre-stamp artifact): warn, don't refuse — the
+    # stamp cannot be invented retroactively
+    assert backend_mismatch({"metric": "m"}, new) == []
+    assert "WARNING" in capsys.readouterr().out
+    assert backend_mismatch({"metric": "m"}, {"metric": "m"}) == []
+    # the probe-failure sentinel is a MISSING stamp, not a backend: a
+    # transient resolver timeout must warn, never hard-fail the gate
+    assert backend_mismatch({"jax_backend": "unknown"}, new) == []
+    assert "WARNING" in capsys.readouterr().out
+    assert backend_mismatch(prior, {"jax_backend": "unknown"}) == []
+    assert backend_mismatch({"jax_backend": "unknown"},
+                            {"jax_backend": "unknown"}) == []
+
+
+def test_backend_mismatch_fails_main_end_to_end(tmp_path):
+    p, n = tmp_path / "prior.json", tmp_path / "new.json"
+    prior = {**_art({"a": 100.0}), "jax_backend": "tpu"}
+    new = {**_art({"a": 100.0}), "jax_backend": "cpu"}
+    p.write_text(json.dumps(prior))
+    n.write_text(json.dumps(new))
+    assert main([str(p), str(n)]) == 1
+    n.write_text(json.dumps({**new, "jax_backend": "tpu"}))
+    assert main([str(p), str(n)]) == 0
 
 
 def test_main_end_to_end_exit_codes(tmp_path):

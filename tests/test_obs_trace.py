@@ -409,8 +409,13 @@ def test_wire_record_schema_full_layout():
     # quantiles, idle ones carry {"count": 0}
     hist = rec["hist"]
     assert set(hist) == {"pull_latency_ms", "pull_blocked_ms",
-                         "push_ack_ms", "serve_ms", "park_ms"}
+                         "push_ack_ms", "serve_ms", "park_ms",
+                         "replica_serve_ms"}
     assert hist["pull_latency_ms"]["count"] > 0
+    assert hist["replica_serve_ms"] == {"count": 0}  # plane off: idle
+    # the serving plane's off-vs-idle marker rides INSIDE the serve
+    # block: None here (plane off; an armed-idle run reports zeros)
+    assert rec["serve"]["replica"] is None
     assert {"p50_ms", "p95_ms", "p99_ms"} <= set(
         hist["pull_latency_ms"])
     assert hist["push_ack_ms"] == {"count": 0}  # async push off: idle
