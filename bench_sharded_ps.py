@@ -94,7 +94,7 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
          chaos: str | None = None, reliable: bool = False,
          pull_timeout: float | None = None,
          zipf_permute_hot: bool = True, rebalance: str | None = None,
-         trace: str | None = None,
+         trace: str | None = None, wire_fmt: str | None = None,
          may_fail: bool = False, timeout: float = 300.0) -> dict:
     """One sweep point → {rows_per_sec_per_process, aggregate, wire...}.
 
@@ -108,9 +108,10 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
                         key_dist, staleness, cache_bytes, pull_dedup,
                         push_dedup, rows, updater, pull_timeout,
                         zipf_permute_hot, trace)
-    env_extra = {}
-    if bus != "zmq":
-        env_extra["MINIPS_BUS"] = bus
+    # ALWAYS pinned, even for the zmq arms: an armed MINIPS_BUS=shm in
+    # the invoking shell must not silently move the zmq baseline arms
+    # onto the shm backend (TRANSPORT-WIN would then compare shm vs shm)
+    env_extra = {"MINIPS_BUS": bus}
     if force_cpu:
         env_extra["MINIPS_FORCE_CPU"] = "1"
     # chaos/reliable arms configure via env (launcher-inherited, no
@@ -122,6 +123,9 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     env_extra["MINIPS_RELIABLE"] = "1" if reliable else ""
     env_extra["MINIPS_REBALANCE"] = rebalance or ""
     env_extra["MINIPS_TRACE"] = ""
+    # head-codec arm config (the transport sweep): explicit empty keeps
+    # an armed environment from leaking a format into the other arms
+    env_extra["MINIPS_WIRE_FMT"] = wire_fmt or ""
     if n == 1:  # standalone zero-wire baseline (no launcher, no bus)
         proc = subprocess.run(argv, capture_output=True, text=True,
                               timeout=timeout,
@@ -207,6 +211,9 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     assert echoed_rl == {bool(reliable)}, (reliable, echoed_rl)
     echoed_rb = {r.get("rebalance_spec") for r in res}
     assert echoed_rb == {rebalance or None}, (rebalance, echoed_rb)
+    if n > 1:  # wire-format echo (standalone runs have no bus)
+        echoed_wf = {r.get("wire_fmt") for r in res}
+        assert echoed_wf == {wire_fmt or "bin"}, (wire_fmt, echoed_wf)
     if trace:  # every rank of a traced arm must have dumped its file
         assert all(r.get("trace_file") for r in res), \
             [r.get("trace_file") for r in res]
@@ -267,6 +274,47 @@ def main() -> int:
         curve[str(n)] = _run(n, "sparse", iters, warmup, "zmq")
     buses = {"zmq": curve["3"],
              "native": _run(3, "sparse", iters, warmup, "native")}
+
+    # THE TRANSPORT COMPARISON (this PR): seed JSON framing over zmq vs
+    # binary framing over zmq vs the shared-memory ring transport —
+    # same workload, back-to-back, alternating medians (the standard
+    # honesty rules on this drifting host). The claims the TRANSPORT-*
+    # tripwires (ci/bench_regression.py) gate: the shm arm's rows/sec
+    # strictly above zmq-json (the loopback bench finally measures
+    # protocol cost, not codec cost) with bytes/row UNCHANGED across
+    # arms (framing moves head bytes, never blob bytes), and the
+    # compose arm — seeded chaos drop>=1% + retransmit ON the shm
+    # backend — must COMPLETE with zero unrecovered frames (the
+    # chaos/reliable/trace layers wrap the bus, so they must stack on
+    # the new transport unchanged; its lossy-arm rate stays
+    # gate-invisible like every chaos arm's).
+    def _transport_arms(reps: int) -> dict:
+        arms = {"zmq_json": {"bus": "zmq", "wire_fmt": "json"},
+                "zmq_bin": {"bus": "zmq", "wire_fmt": "bin"},
+                "shm": {"bus": "shm", "wire_fmt": "bin"}}
+        runs: dict[str, list[dict]] = {a: [] for a in arms}
+        for _ in range(reps):
+            for a, kw in arms.items():
+                runs[a].append(_run(3, "sparse", iters, warmup,
+                                    kw["bus"], wire_fmt=kw["wire_fmt"]))
+
+        def med(arm: str) -> dict:
+            by = sorted(runs[arm],
+                        key=lambda r: r["rows_per_sec_per_process"])
+            return {**by[len(by) // 2], "reps": reps}
+        grid = {a: med(a) for a in arms}
+        compose = _run(3, "sparse", iters, warmup, "shm",
+                       wire_fmt="bin", chaos="1234:drop=0.01,dup=0.005",
+                       reliable=True, pull_timeout=8.0, may_fail=True,
+                       timeout=120.0)
+        if "rows_per_sec_per_process" in compose:
+            # completion gate, not a comparable throughput point
+            compose["rows_per_sec_lossy"] = compose.pop(
+                "rows_per_sec_per_process")
+        grid["shm_compose"] = compose
+        return grid
+
+    transport_grid = _transport_arms(3 if not args.quick else 1)
     paths = {"sparse": curve["3"],
              "dense": _run(3, "dense", iters, warmup, "zmq")}
     # the compressed push wire: same rows/sec workload, int8 codes on the
@@ -572,7 +620,8 @@ def main() -> int:
                 3, argv, base_port=None,
                 env_extra={"MINIPS_CHAOS": "", "MINIPS_RELIABLE": "",
                            "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
-                           "MINIPS_SERVE": ""},
+                           "MINIPS_SERVE": "", "MINIPS_BUS": "",
+                           "MINIPS_WIRE_FMT": ""},
                 timeout=timeout)
         except Exception as e:  # noqa: BLE001 - completion-gated arms
             return {"completed": False, "error": str(e)[:300]}
@@ -664,6 +713,7 @@ def main() -> int:
         "jax_backend": _resolve_jax_backend(),
         "scaling_sparse_zmq": curve,
         "bus_comparison_3proc": buses,
+        "transport_comparison_3proc": transport_grid,
         "path_comparison_3proc": paths,
         "push_wire_comparison_3proc": wires,
         "pull_wire_comparison_3proc": pull_wires,

@@ -23,6 +23,11 @@ the drop-0 chaos arm must stay within slack of the clean arm (the
 reliable layer may not tax the lossless path) and every drop>0
 retransmit-on arm must have completed with zero unrecovered frames
 (seeded loss must degrade to latency, never to death).
+``transport_tripwires`` (TRANSPORT-WIN/TRANSPORT-COMPOSE) guards the
+``transport_comparison_3proc`` sweep: the shm-ring arm must beat the
+seed zmq-JSON arm on rows/sec with bytes/row unchanged, and the seeded
+chaos+reliable arm on the shm backend must complete with zero
+unrecovered frames (the fault layers must stack on the new transport).
 ``rebalance_tripwires`` (REBAL-SKEW/REBAL-DEAD) guards the
 ``rebalance_3proc`` sweep: the unpermuted-zipf rebalancer-on arm must
 complete with >= 1 migration and max/mean per-shard serve load
@@ -168,6 +173,71 @@ def chaos_tripwires(new: dict) -> list[str]:
                 f"CHAOS-LEAK chaos_resilience_3proc/{arm}: "
                 f"{a['wire_frames_lost']} unrecovered frames with the "
                 "retransmit layer on — recovery is silently failing")
+    return problems
+
+
+TRANSPORT_BYTES_SLACK = 0.02  # bytes/row must match across transport
+# arms: framing moves HEAD bytes, never blob bytes, and bytes/row-moved
+# is computed from the table-level blob counters — a divergence means a
+# codec started re-encoding (or dropping) payload rows.
+
+
+def transport_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``transport_comparison_3proc``
+    sweep; vacuous when the sweep is absent (other benches).
+
+    - TRANSPORT-WIN: the shm-ring arm must beat the seed zmq-JSON arm
+      on rows/sec STRICTLY (alternating medians — the whole point of
+      the transport is that loopback benches stop paying codec+socket
+      tax), with bytes/row-moved unchanged across arms (framing must
+      never touch blob bytes).
+    - TRANSPORT-COMPOSE: the seeded chaos(drop>=1%)+reliable arm ON THE
+      SHM BACKEND must have completed with zero unrecovered frames and
+      the counters proving both layers engaged — the chaos/reliable
+      stack wraps the bus, so a new backend that quietly bypasses it
+      would still post a fast number while losing its fault story."""
+    grid = new.get("transport_comparison_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    zj = (grid.get("zmq_json") or {}).get(METRIC)
+    shm = (grid.get("shm") or {}).get(METRIC)
+    if not (isinstance(zj, (int, float)) and isinstance(shm, (int, float))
+            and shm > zj):
+        problems.append(
+            f"TRANSPORT-WIN transport_comparison_3proc: shm arm "
+            f"{shm!r} rows/s/proc is not strictly above zmq-json "
+            f"{zj!r} — the ring transport is not beating the seed "
+            "wire on loopback")
+    bj = (grid.get("zmq_json") or {}).get("wire_bytes_per_row_moved")
+    for arm in ("zmq_bin", "shm"):
+        ba = (grid.get(arm) or {}).get("wire_bytes_per_row_moved")
+        if isinstance(bj, (int, float)) and isinstance(ba, (int, float)) \
+                and bj > 0 and abs(ba - bj) / bj > TRANSPORT_BYTES_SLACK:
+            problems.append(
+                f"TRANSPORT-WIN transport_comparison_3proc/{arm}: "
+                f"bytes/row {ba} vs zmq-json {bj} — framing changed "
+                "payload bytes, not just head bytes")
+    comp = grid.get("shm_compose") or {}
+    rate = comp.get("rows_per_sec_lossy")
+    if not comp.get("completed") or \
+            not (isinstance(rate, (int, float)) and rate > 0):
+        problems.append(
+            f"TRANSPORT-COMPOSE transport_comparison_3proc/shm_compose: "
+            f"rate {rate!r} completed={comp.get('completed')!r} — "
+            "seeded chaos+reliable on the shm backend must complete "
+            "(loss should degrade to latency on every transport)")
+    elif comp.get("wire_frames_lost", 0):
+        problems.append(
+            f"TRANSPORT-COMPOSE transport_comparison_3proc/shm_compose: "
+            f"{comp['wire_frames_lost']} unrecovered frames — recovery "
+            "is silently failing on the shm backend")
+    elif not comp.get("chaos_dropped") or not comp.get("retransmits_got"):
+        problems.append(
+            f"TRANSPORT-COMPOSE transport_comparison_3proc/shm_compose: "
+            f"chaos_dropped={comp.get('chaos_dropped')!r} "
+            f"retransmits_got={comp.get('retransmits_got')!r} — the "
+            "drill proved nothing (injector or repair never engaged)")
     return problems
 
 
@@ -451,6 +521,7 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     problems = (compare(prior, new, args.tolerance)
                 + cache_tripwires(new) + chaos_tripwires(new)
+                + transport_tripwires(new)
                 + rebalance_tripwires(new) + trace_tripwires(new)
                 + serve_tripwires(new))
     pts = throughput_points(new)

@@ -44,6 +44,7 @@ from minips_tpu.models import transformer as tfm
 from minips_tpu.parallel.mesh import DATA_AXIS, make_mesh
 from minips_tpu.tables.dense import DenseTable
 from minips_tpu.train.loop import TrainLoop
+from minips_tpu.utils import jaxcompat
 
 DEFAULT = Config(
     table=TableConfig(name="lm", kind="dense", updater="adam", lr=3e-3),
@@ -511,7 +512,7 @@ def _run_model_parallel(cfg, args, metrics, layout, seq_len) -> dict:
                 logits = tfm.apply_tp(p_, t_[:, :-1], heads=heads,
                                       axis_name=MODEL_AXIS)
             return jax.lax.pmean(tfm.nll(logits, t_[:, 1:]), DATA_AXIS)
-        return jax.shard_map(
+        return jaxcompat.shard_map(
             shard_fn, mesh=mesh,
             in_specs=(specs, P(DATA_AXIS)), out_specs=P())(p, toks)
 
@@ -559,7 +560,7 @@ def _run_ep(cfg, args, metrics, seq_len) -> dict:
                                        capacity=capacity, k_top=k_top)
             nll = jax.lax.pmean(tfm.nll(logits, t_[:, 1:]), DATA_AXIS)
             return nll + 0.01 * aux   # router load-balance pressure
-        return jax.shard_map(
+        return jaxcompat.shard_map(
             shard_fn, mesh=mesh,
             in_specs=(specs, P(DATA_AXIS)), out_specs=P())(p, toks)
 

@@ -410,6 +410,8 @@ def main(argv=None) -> int:
         "compute": (f"jit({backend})" if args.compute == "jit"
                     else "none"),
         "bus": os.environ.get("MINIPS_BUS", "zmq") if bus else "none",
+        "wire_fmt": ((os.environ.get("MINIPS_WIRE_FMT") or "bin")
+                     if bus else None),
         "rows": args.rows, "dim": args.dim, "batch": B,
         "iters_timed": timed,
         "rows_per_sec": round(rows_moved / dt, 1),

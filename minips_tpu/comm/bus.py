@@ -6,8 +6,10 @@ rebuild the data plane is XLA collectives, so the only traffic that still
 needs sockets is the control plane: SSP clock gossip and heartbeats, which
 must stay nonblocking while a TPU step runs (SURVEY.md §2.3 "Control
 plane"). This is a deliberately tiny pub/sub bus: every process binds one
-PUB socket and subscribes to all peers; messages are small JSON dicts
-``{kind, sender, payload}``.
+PUB socket and subscribes to all peers; messages are small
+``{kind, sender, payload}`` heads framed by the shared wire codec
+(comm/framing.py — binary by default, the seed JSON via
+``MINIPS_WIRE_FMT=json``; receivers sniff per frame).
 
 Tested over loopback in-process (the reference tests its mailbox the same
 way — threads as nodes, SURVEY.md §4).
@@ -15,11 +17,13 @@ way — threads as nodes, SURVEY.md §4).
 
 from __future__ import annotations
 
-import json
 import threading
 import time
 from collections import OrderedDict
 from typing import Callable, Optional
+
+from minips_tpu.comm.framing import (decode_head, encode_head,
+                                     wire_fmt_from_env)
 
 try:
     import zmq
@@ -125,12 +129,16 @@ class ControlBus:
     outbox) — same observable interface, stricter guarantee."""
 
     def __init__(self, my_addr: str, peer_addrs: list[str],
-                 my_id: int = 0):
+                 my_id: int = 0, wire_fmt: Optional[str] = None):
         import os
 
         if not _HAS_ZMQ:
             raise RuntimeError("pyzmq not available")
         self.my_id = my_id
+        # head codec (comm/framing.py): binary by default, the seed JSON
+        # framing via MINIPS_WIRE_FMT=json — receive sniffs per frame,
+        # so the knob only shapes what THIS rank emits
+        self.wire_fmt = wire_fmt or wire_fmt_from_env()
         self.bytes_sent = 0  # wire accounting (sharded-PS slice assertions)
         self.loss = FrameLossTracker()
         self._n_world = len(peer_addrs) + 1
@@ -203,7 +211,7 @@ class ControlBus:
                     dest = int(topic[1:-1])
                     head["ds"] = self._dseq[dest]
                     self._dseq[dest] += 1
-            msg = json.dumps(head).encode()
+            msg = encode_head(head, self.wire_fmt)
             rel = getattr(self, "reliable", None)
             if rel is not None and ("bs" in head or "ds" in head):
                 # journal under the pub lock: journal order == wire order,
@@ -285,17 +293,18 @@ class ControlBus:
 
 def dispatch_message(handlers: dict, raw, blob: Optional[bytes],
                      loss: Optional[FrameLossTracker] = None) -> None:
-    """Shared receive-side tail for every bus backend: decode the JSON
-    control frame, run it past the wire-loss tracker, attach the blob at
-    ``__blob__``, invoke the handler. A malformed frame is COUNTED
-    (``loss.malformed`` → ``frames_malformed``) and reported once to
-    stderr instead of silently swallowed — a torn frame is a wire-health
-    signal the done lines must carry. A raising handler is reported, not
-    propagated — one bad handler must not kill the backend's receive
-    thread (clocks/heartbeats ride the same thread)."""
-    try:
-        msg = json.loads(raw)
-    except (json.JSONDecodeError, UnicodeDecodeError):
+    """Shared receive-side tail for every bus backend: decode the
+    control frame (format-sniffed: binary or the seed JSON,
+    comm/framing.py), run it past the wire-loss tracker, attach the
+    blob at ``__blob__``, invoke the handler. A malformed frame is
+    COUNTED (``loss.malformed`` → ``frames_malformed``) and reported
+    once to stderr instead of silently swallowed — a torn frame is a
+    wire-health signal the done lines must carry. A raising handler is
+    reported, not propagated — one bad handler must not kill the
+    backend's receive thread (clocks/heartbeats ride the same
+    thread)."""
+    msg = decode_head(raw)
+    if msg is None:
         _note_malformed(loss, raw)
         return
     dispatch_parsed(handlers, msg, blob, loss=loss)
@@ -347,9 +356,8 @@ def deliver_frame(bus, raw, blob: Optional[bytes]) -> None:
     frames through its deliver-once in-order sequencer (gap → NACK →
     retransmit, comm/reliable.py); (3) plain handler dispatch. With
     neither installed this is byte-for-byte the seed path."""
-    try:
-        msg = json.loads(raw)
-    except (json.JSONDecodeError, UnicodeDecodeError):
+    msg = decode_head(raw)
+    if msg is None:
         _note_malformed(getattr(bus, "loss", None), raw)
         return
     chaos = getattr(bus, "chaos", None)
@@ -431,15 +439,23 @@ def run_handshake(bus, num_processes: int, timeout: float = 15.0) -> None:
 def make_bus(my_addr: str, peer_addrs: list[str], my_id: int = 0,
              backend: Optional[str] = None, *,
              chaos: Optional[str] = None,
-             reliable: Optional[str] = None):
-    """Bus factory. ``backend``: ``"zmq"`` (pyzmq PUB/SUB, default) or
+             reliable: Optional[str] = None,
+             wire_fmt: Optional[str] = None):
+    """Bus factory. ``backend``: ``"zmq"`` (pyzmq PUB/SUB, default),
     ``"native"`` (the C++ TCP mailbox, cpp/mailbox.cpp — the reference's
-    native-runtime analog); default from ``$MINIPS_BUS``.
+    native-runtime analog), or ``"shm"`` (same-host shared-memory SPSC
+    rings, comm/shm_bus.py — the zero-copy loopback transport); default
+    from ``$MINIPS_BUS``. ``wire_fmt`` picks the head codec
+    (``$MINIPS_WIRE_FMT``: ``bin`` default, ``json`` = the seed
+    framing) — receivers sniff per frame, so mixed-fmt fleets decode.
 
     An explicit native request that cannot be satisfied raises instead of
     silently falling back: the two wire formats do not interoperate, so a
     quiet fallback on one host of a multi-host job would produce a mixed
-    mesh that fails 15s later with a misleading handshake timeout.
+    mesh that fails 15s later with a misleading handshake timeout. An
+    shm request across hosts fails the same loud way (the ring files
+    simply don't exist on the other machine — the attach times out
+    naming the missing link).
 
     Two optional layers install on whichever backend was built (same
     observable interface either way):
@@ -454,7 +470,9 @@ def make_bus(my_addr: str, peer_addrs: list[str], my_id: int = 0,
     """
     import os
 
-    backend = backend or os.environ.get("MINIPS_BUS", "zmq")
+    # explicit-empty = default, like every other MINIPS_* knob (the
+    # bench arms pin "" to keep an armed environment from leaking)
+    backend = backend or os.environ.get("MINIPS_BUS", "").strip() or "zmq"
     if backend == "native":
         from minips_tpu.comm.native_bus import NativeControlBus
 
@@ -463,12 +481,19 @@ def make_bus(my_addr: str, peer_addrs: list[str], my_id: int = 0,
                 "MINIPS_BUS=native requested but the C++ mailbox library "
                 "is unavailable (no compiler?); every host must use the "
                 "same backend — set MINIPS_BUS=zmq explicitly to fall back")
-        bus = NativeControlBus(my_addr, peer_addrs, my_id=my_id)
+        bus = NativeControlBus(my_addr, peer_addrs, my_id=my_id,
+                               wire_fmt=wire_fmt)
     elif backend == "zmq":
-        bus = ControlBus(my_addr, peer_addrs, my_id=my_id)
+        bus = ControlBus(my_addr, peer_addrs, my_id=my_id,
+                         wire_fmt=wire_fmt)
+    elif backend == "shm":
+        from minips_tpu.comm.shm_bus import ShmControlBus
+
+        bus = ShmControlBus(my_addr, peer_addrs, my_id=my_id,
+                            wire_fmt=wire_fmt)
     else:
         raise ValueError(f"unknown bus backend {backend!r} "
-                         "(expected 'zmq' or 'native')")
+                         "(expected 'zmq', 'native', or 'shm')")
     # layer order matters only conceptually: chaos models the wire (runs
     # first on receive), reliable rides above it. Install reliable first
     # so chaos-released frames find the sequencer already in place.

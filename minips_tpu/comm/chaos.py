@@ -50,12 +50,12 @@ from __future__ import annotations
 
 import hashlib
 import heapq
-import json
 import struct
 import threading
 import time
 from typing import Optional
 
+from minips_tpu.comm.framing import dup_msg
 from minips_tpu.obs import tracer as _trc
 
 __all__ = ["ChaosSpec", "ChaosBus"]
@@ -214,8 +214,12 @@ class ChaosBus:
         dup_copy = None
         if hit("dup"):
             # copy BEFORE the first dispatch: handlers receive the payload
-            # dict itself (blob attached in place) and may mutate it
-            dup_copy = (json.loads(json.dumps(msg)), blob)
+            # dict itself (blob attached in place) and may mutate it.
+            # Codec-agnostic deep copy (framing.dup_msg): the seed's
+            # json.loads(json.dumps(msg)) double-paid the codec on every
+            # dup and raised on binary-only values (bytes in a
+            # retransmit wrapper)
+            dup_copy = (dup_msg(msg), blob)
             with self._lock:
                 self.stats["duplicated"] += 1
             note("dup")

@@ -17,11 +17,11 @@ callers degrade to the zmq backend when no compiler is available.
 from __future__ import annotations
 
 import ctypes
-import json
 import threading
 from typing import Callable, Optional
 
 from minips_tpu.comm.bus import deliver_frame, stop_bus_layers
+from minips_tpu.comm.framing import encode_head, wire_fmt_from_env
 from minips_tpu.utils.native_lib import load_native_lib
 
 
@@ -87,13 +87,15 @@ class NativeControlBus:
     full mesh of outgoing TCP connections made in ``start()``."""
 
     def __init__(self, my_addr: str, peer_addrs: list[str], my_id: int = 0,
-                 connect_timeout: float = 15.0):
+                 connect_timeout: float = 15.0,
+                 wire_fmt: Optional[str] = None):
         lib = _load()
         if lib is None:
             raise RuntimeError("native mailbox library unavailable")
         from minips_tpu.comm.bus import FrameLossTracker
 
         self.my_id = my_id
+        self.wire_fmt = wire_fmt or wire_fmt_from_env()
         self.bytes_sent = 0
         self.loss = FrameLossTracker()
         self._n_world = len(peer_addrs) + 1
@@ -188,8 +190,9 @@ class NativeControlBus:
             raise ValueError(f"blob {len(blob)}B exceeds the "
                              f"{self.MAX_BLOB}B protocol cap")
         head = {"kind": kind, "sender": self.my_id, "payload": payload}
-        probe = json.dumps(head).encode()
-        # stamped header adds <= ~24B ('"bs": <int64>' etc.)
+        probe = encode_head(head, self.wire_fmt)
+        # a stamped header adds <= ~24B (JSON '"bs": <int64>'; the
+        # binary prefix carries the seq field either way)
         if len(probe) + 24 > self.MAX_MSG:
             raise ValueError(f"control frame {len(probe)}B exceeds the "
                              f"{self.MAX_MSG}B protocol cap")
@@ -210,7 +213,7 @@ class NativeControlBus:
                 else:
                     head["ds"] = self._dseq[dest_rank]
                     self._dseq[dest_rank] += 1
-            msg = json.dumps(head).encode()
+            msg = encode_head(head, self.wire_fmt)
             rel = getattr(self, "reliable", None)
             if rel is not None and ("bs" in head or "ds" in head):
                 # under _seq_lock like the zmq backend: journal order

@@ -65,12 +65,12 @@ Enable with ``MINIPS_RELIABLE=1`` (or a knob string like
 
 from __future__ import annotations
 
-import json
 import threading
 import time
 from collections import OrderedDict
 from typing import Optional
 
+from minips_tpu.comm.framing import decode_head, rt_wrap
 from minips_tpu.obs import tracer as _trc
 
 __all__ = ["ReliableChannel"]
@@ -158,6 +158,15 @@ class ReliableChannel:
         if start_thread:
             self._thread = threading.Thread(target=self._loop, daemon=True,
                                             name="rl-repair")
+            # a bus whose sends can block on backpressure (shm rings)
+            # must bound THIS thread's sends like its own recv thread's:
+            # pump's _drain dispatches recovered frames' handlers under
+            # self._lock, which on_stamped (recv thread) also takes — a
+            # repair-thread handler reply stuck the full send budget
+            # would park inbound draining transitively
+            note = getattr(bus, "note_drain_critical", None)
+            if note is not None:
+                note(self._thread)
             self._thread.start()
 
     @classmethod
@@ -218,8 +227,10 @@ class ReliableChannel:
         for _s, (msg, blob) in found:
             # wrap the ORIGINAL stamped head: the wrapper is unstamped
             # (no new seq, never journaled), the receiver's sequencer
-            # slots the inner frame by its original seq
-            self.bus.send(sender, RT_KIND, {"m": msg.decode()}, blob=blob)
+            # slots the inner frame by its original seq. The wrapper
+            # shape lives in framing.rt_wrap — the shm backend's
+            # record-cap pre-check must size the SAME wrapper
+            self.bus.send(sender, RT_KIND, rt_wrap(msg), blob=blob)
         if missing:
             self.bus.send(sender, GONE_KIND,
                           {"s": stream, "seqs": missing})
@@ -328,9 +339,9 @@ class ReliableChannel:
 
     def _on_rt(self, sender: int, payload: dict) -> None:
         blob = payload.get("__blob__")
-        try:
-            inner = json.loads(payload.get("m", ""))
-        except (json.JSONDecodeError, TypeError):
+        raw = payload.get("m2", payload.get("m", ""))
+        inner = decode_head(raw) if raw else None
+        if inner is None:
             self.bus.loss.note_malformed()
             return
         with self._lock:

@@ -96,8 +96,10 @@ def sweep_stale_segments(directory: Optional[str] = None) -> int:
         run = name[len(_PREFIX) + 1:].split("_", 1)[0]
         if not run.isdigit():
             continue  # non-pid run id (e.g. tests): not ours to judge
-        if os.path.exists(f"/proc/{run}"):
-            continue  # launcher still alive
+        from minips_tpu.comm.shm_bus import _pid_alive
+        if _pid_alive(int(run)):
+            continue  # launcher still alive (portable: /proc is
+            # Linux-only and this store runs wherever the bus does)
         try:
             os.unlink(os.path.join(directory, name))
             removed += 1

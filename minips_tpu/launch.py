@@ -133,14 +133,24 @@ def child_env(rank: int, hosts: list[str], base_port: int) -> dict[str, str]:
     return env
 
 
+def _sweep_shm() -> None:
+    """Reclaim shared-memory leftovers of DEAD runs before spawning: a
+    SIGKILLed job never reaches its atexit/close cleanup, and both the
+    sample store's segments (dataset-sized) and the shm bus's ring
+    files (ring-sized per link) live in tmpfs — host RAM. Each sweeper
+    pid-checks the MINIPS_RUN_ID baked into the file name."""
+    from minips_tpu.comm.shm_bus import \
+        sweep_stale_segments as sweep_bus_segments
+    from minips_tpu.data.shm_store import sweep_stale_segments
+
+    sweep_stale_segments()
+    sweep_bus_segments()
+
+
 def spawn(hosts: list[str], argv: list[str], base_port: int = 5700,
           stdout=None) -> list[subprocess.Popen]:
     """Spawn one process per host entry; returns live Popen handles."""
-    from minips_tpu.data.shm_store import sweep_stale_segments
-
-    # a SIGKILLed run never reaches its atexit cleanup — reclaim any
-    # dataset-sized shared-store segments whose launcher is dead
-    sweep_stale_segments()
+    _sweep_shm()
     procs = []
     for rank, host in enumerate(hosts):
         env = child_env(rank, hosts, base_port)
@@ -326,6 +336,7 @@ def run_local_job(n: int, argv: list[str], *,
 
     if base_port is None:
         base_port = find_free_base_port(n)
+    _sweep_shm()
     hosts = ["localhost"] * n
     outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
     procs = []
@@ -394,6 +405,7 @@ def run_local_job_raw(n: int, argv: list[str], *,
 
     if base_port is None:
         base_port = find_free_base_port(n)
+    _sweep_shm()
     hosts = ["localhost"] * n
     outs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in hosts]
     procs = []
@@ -424,7 +436,8 @@ def run_local_job_raw(n: int, argv: list[str], *,
 def init_from_env():
     """Worker-side: build my ControlBus from the launcher's env vars.
     Returns ``(proc_id, num_procs, bus)``; bus is None single-process.
-    Backend honors ``$MINIPS_BUS`` (zmq | native C++ mailbox)."""
+    Backend honors ``$MINIPS_BUS`` (zmq | native C++ mailbox | shm
+    same-host rings); head codec honors ``$MINIPS_WIRE_FMT``."""
     from minips_tpu.comm.bus import make_bus
 
     rank = int(os.environ.get("MINIPS_PROC_ID", "0"))

@@ -30,6 +30,7 @@ from minips_tpu.parallel.ring_attention import (
     reference_attention,
     ring_attention_local,
 )
+from minips_tpu.utils import jaxcompat
 
 
 def init(key, *, vocab: int = 256, dim: int = 64, heads: int = 4,
@@ -676,8 +677,8 @@ def nll_chunked(h, tok_emb, targets, chunk, compute_dtype=jnp.bfloat16):
     # vary with the sharded inputs — pcast keeps the scan carry type fixed
     # (same treatment as DenseTable.make_step's accum fold)
     acc0 = jnp.zeros((), jnp.float32)
-    vma = (getattr(jax.typeof(h), "vma", frozenset())
-           | getattr(jax.typeof(targets), "vma", frozenset()))
+    vma = (getattr(jaxcompat.typeof(h), "vma", frozenset())
+           | getattr(jaxcompat.typeof(targets), "vma", frozenset()))
     if vma:
         acc0 = jax.lax.pcast(acc0, tuple(sorted(vma)), to="varying")
     total, _ = jax.lax.scan(body, acc0, (hs, ts))

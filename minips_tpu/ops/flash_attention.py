@@ -42,6 +42,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from minips_tpu.utils import jaxcompat
+
 try:  # pallas imports can fail on exotic backends; degrade to blockwise
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
@@ -56,7 +58,7 @@ _NEG_INF = -1e30  # finite mask value (matches ring_attention) — avoids
 def _pcast_varying(x, axes):
     """pcast x to varying over exactly the axes it isn't already varying
     over (pcast rejects varying→varying)."""
-    have = getattr(jax.typeof(x), "vma", frozenset())
+    have = getattr(jaxcompat.typeof(x), "vma", frozenset())
     need = tuple(a for a in axes if a not in have)
     return jax.lax.pcast(x, need, to="varying") if need else x
 
@@ -238,7 +240,7 @@ def _vma_of(*xs):
     # varies over (VMA tracking); it varies exactly where the inputs do.
     vma = frozenset()
     for x in xs:
-        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+        vma = vma | getattr(jaxcompat.typeof(x), "vma", frozenset())
     return vma
 
 

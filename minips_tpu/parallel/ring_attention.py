@@ -39,6 +39,7 @@ from minips_tpu.parallel.mesh import DATA_AXIS
 # happens AFTER each shard arrives, so the ppermute wire still carries
 # only the small kv heads.
 from minips_tpu.ops.flash_attention import _expand_kv
+from minips_tpu.utils import jaxcompat
 
 _NEG_INF = -1e30  # mask value; avoids -inf NaNs in (m - m_new) when a whole
                   # row is masked at an early ring step
@@ -155,7 +156,7 @@ def make_ring_attention(
     def attn(q, k, v):
         f = functools.partial(ring_attention_local, axis_name=axis_name,
                               causal=causal, scale=scale)
-        return jax.shard_map(
+        return jaxcompat.shard_map(
             f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
 
     def sharded(x):

@@ -149,7 +149,7 @@ class ServeConfig:
 _COUNTERS = (
     # owner side
     "grants", "revokes", "refresh_frames", "refresh_rows",
-    "shed_redirects", "backpressure", "forced_admits",
+    "shed_redirects", "shed_partial", "backpressure", "forced_admits",
     # replica side
     "replica_served_requests", "replica_served_rows",
     "replica_local_rows", "lease_refused", "stale_refused",
@@ -528,12 +528,13 @@ class TableServeState:
         dead = t._excluded_ranks()
         common: Optional[set] = None
         with self._ow_lock:
-            for b in blocks:
-                hs = set(self._granted.get(int(b), ())) \
-                    - {sender} - dead  # never shed at a dead holder
-                common = hs if common is None else (common & hs)
-                if not common:
-                    break
+            per_block = {int(b): set(self._granted.get(int(b), ()))
+                         - {sender} - dead  # never shed at a dead holder
+                         for b in blocks}
+        for hs in per_block.values():
+            common = hs if common is None else (common & hs)
+            if not common:
+                break
         tr = _trc.TRACER
         if common:
             self._count("shed_redirects")
@@ -543,6 +544,34 @@ class TableServeState:
                             "holders": sorted(common)})
             t.bus.send(sender, f"svS:{t.name}",
                        {"req": int(req), "h": sorted(common)})
+            return False
+        # replica-aware PARTIAL shed (PR6's documented headroom): no
+        # single holder covers every block, but one may cover some —
+        # redirect that covered half (the client peels it onto an svP
+        # leg) and backpressure only the REMAINDER (re-issued without
+        # ``rt``, so the owner's admission re-judges it and the no-
+        # holder blocks take the bounded svB → delayed-retry path)
+        # instead of refusing the whole leg. Every round either peels
+        # covered blocks off or ends in svB, so the loop is bounded by
+        # the number of distinct holder sets.
+        cover: dict[int, list[int]] = {}
+        for b, hs in per_block.items():
+            for h in hs:
+                cover.setdefault(h, []).append(b)
+        if cover:
+            # the holder covering the most blocks takes its half
+            # (rank-ascending tie-break keeps the choice deterministic)
+            pick = max(sorted(cover), key=lambda h: len(cover[h]))
+            covered = sorted(cover[pick])
+            self._count("shed_redirects")
+            self._count("shed_partial")
+            if tr is not None:
+                tr.instant("serve", "sv_shed_partial",
+                           {"from": sender, "rid": req, "holder": pick,
+                            "blocks": covered})
+            t.bus.send(sender, f"svS:{t.name}",
+                       {"req": int(req), "h": [int(pick)],
+                        "bs": covered})
         else:
             self._count("backpressure")
             if tr is not None:
@@ -837,21 +866,41 @@ class TableServeState:
     def _on_shed(self, sender: int, payload: dict) -> None:
         """svS: the owner shed my leg — re-issue it against one of the
         replica holders it named (falling back to the owner with
-        ``rt=1`` if none is usable from here)."""
+        ``rt=1`` if none is usable from here). A PARTIAL shed carries
+        ``bs``, the blocks the named holder covers: only those keys
+        ride the svP leg; the remainder re-issues to its owners
+        WITHOUT ``rt`` — the admission bucket judges it again, so only
+        the uncovered half feels the backpressure."""
         self._count("shed_redirected_legs")
         cands = [int(h) for h in payload.get("h", ())
                  if int(h) != self.table.rank]
-        if cands:
-            self._rr += 1
-            pick = cands[self._rr % len(cands)]
+        rid = int(payload.get("req", -1))
+        if not cands:
             self.table._resend_leg(
-                int(payload.get("req", -1)),
-                lambda keys: [(pick, "svP", {},
-                               np.ones(keys.size, bool))])
-        else:
+                rid, lambda keys: self._plan_by_owner(keys, 1))
+            return
+        self._rr += 1
+        pick = cands[self._rr % len(cands)]
+        bs = payload.get("bs")
+        if bs is None:  # full-coverage shed: the whole leg rides svP
             self.table._resend_leg(
-                int(payload.get("req", -1)),
-                lambda keys: self._plan_by_owner(keys, 1))
+                rid, lambda keys: [(pick, "svP", {},
+                                    np.ones(keys.size, bool))])
+            return
+        t = self.table
+        cov = np.asarray([int(b) for b in bs], np.int64)
+
+        def plan(keys: np.ndarray) -> list:
+            m = np.isin(t.router.blocks_of(keys), cov)
+            entries: list = [(pick, "svP", {}, m)]
+            rem = ~m
+            if rem.any():
+                owners = t._owners_of(keys)
+                entries += [(int(o), "psG", {}, rem & (owners == o))
+                            for o in np.unique(owners[rem])]
+            return entries
+
+        self.table._resend_leg(rid, plan)
 
     def _on_backpressure(self, sender: int, payload: dict) -> None:
         """svB: explicit refuse-with-retry — schedule the leg's re-issue

@@ -38,6 +38,7 @@ from minips_tpu.parallel.mesh import DATA_AXIS, padded_size
 from minips_tpu.parallel.partition import RangePartitioner
 from minips_tpu.tables.updaters import (Adam8bitState, LearningRate,
                                         make_updater, masked_merge_adam8)
+from minips_tpu.utils import jaxcompat
 
 PyTree = Any
 
@@ -250,7 +251,7 @@ class DenseTable:
             return optax.apply_updates(p_shard, updates), new_opt
 
         return jax.jit(
-            jax.shard_map(apply_shard, mesh=self.mesh, in_specs=in_specs,
+            jaxcompat.shard_map(apply_shard, mesh=self.mesh, in_specs=in_specs,
                           out_specs=(self._pspec, self._opt_specs)),
             donate_argnums=(0, 1))
 
@@ -349,7 +350,7 @@ class DenseTable:
             # scan carry type fixed
             vma = frozenset()
             for leaf in jax.tree.leaves((params, batch)):
-                vma = vma | getattr(jax.typeof(leaf), "vma", frozenset())
+                vma = vma | getattr(jaxcompat.typeof(leaf), "vma", frozenset())
             loss0, g0 = jnp.zeros((), jnp.float32), jnp.zeros(n)
             need = tuple(sorted(vma))
             if need:
@@ -381,7 +382,7 @@ class DenseTable:
             p_shard = optax.apply_updates(p_shard, updates)
             return p_shard, opt_shard, jax.lax.pmean(loss, DATA_AXIS)
 
-        step = jax.shard_map(
+        step = jaxcompat.shard_map(
             local_step,
             mesh=self.mesh,
             in_specs=(self._pspec, self._opt_specs, bspec),

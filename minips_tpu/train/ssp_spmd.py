@@ -53,6 +53,7 @@ from minips_tpu.comm.bus import ClockGossip
 from minips_tpu.consistency.gate import StalenessGate, publish_clock
 from minips_tpu.parallel.mesh import DATA_AXIS
 from minips_tpu.tables.dense import DenseTable
+from minips_tpu.utils import jaxcompat
 
 __all__ = ["CollectiveSSP", "SyncPlane", "make_control"]
 
@@ -97,7 +98,7 @@ class SyncPlane:
         def merge(block):             # [1, length/L] on each device
             return jax.lax.psum(block, "proc")
 
-        self._merge = jax.jit(jax.shard_map(
+        self._merge = jax.jit(jaxcompat.shard_map(
             merge, mesh=self.mesh,
             in_specs=P("proc", "local"), out_specs=P(None, "local")))
         self._mean_cache: dict = {}
@@ -181,7 +182,7 @@ class SyncPlane:
         # dequantizes identically), but the varying-axis checker cannot
         # infer replication through all_gather the way it can through
         # psum
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(jaxcompat.shard_map(
             merge_q, mesh=self.mesh, in_specs=P("proc", "local"),
             out_specs=(P(None, "local"), P("proc", "local"),
                        P("proc", "local")),

@@ -554,58 +554,69 @@ def test_ssp_trainer_survives_chaos_with_bounds_intact():
             b.close()
 
 
+def run_bsp_lockstep(backend: str = "zmq", chaos: str = "",
+                     reliable: str = ""):
+    """2-rank in-proc BSP lockstep run → (final weights per rank,
+    frames_lost per rank). THE bitwise-drill harness: identical frame
+    streams must produce identical state whatever transport/fault layer
+    carried them — reused by the chaos drill below and the zmq-vs-shm
+    transport drill (tests/test_shm_bus.py)."""
+    from tests.conftest import mk_loopback_buses
+
+    buses = mk_loopback_buses(2, backend=backend, chaos=chaos,
+                              reliable=reliable)
+
+    class LockstepCons:  # shared lockstep clock vector (BSP: s = 0)
+        clocks = [0, 0]
+        staleness = 0
+
+        def __init__(self, rank):
+            self.rank = rank
+
+        @property
+        def clock(self):
+            return self.clocks[self.rank]
+
+        def admit_pull(self, clk):
+            return min(self.clocks) >= clk
+
+        def serving_clock(self, requester):
+            return min(self.clocks)
+
+    tables = [ShardedTable("t", 64, 2, buses[i], i, 2, updater="sgd",
+                           lr=0.5, pull_timeout=20.0)
+              for i in range(2)]
+    LockstepCons.clocks = [0, 0]
+    for i, t in enumerate(tables):
+        t.bind_consistency(LockstepCons(i))
+        t._w[...] = np.arange(32 * 2, dtype=np.float32
+                              ).reshape(32, 2) / 7.0
+    # disjoint cross-shard keys (same shape as the row-cache bitwise
+    # drill): each shard receives pushes from exactly one peer, so
+    # per-link in-order delivery fixes the apply order bit-for-bit
+    keysets = [np.array([33, 40, 33, 47]), np.array([1, 8, 1, 15])]
+    try:
+        for _ in range(4):
+            rows = [tables[r].pull(keysets[r]) for r in (0, 1)]
+            for r in (0, 1):
+                tables[r].push(keysets[r], 0.1 * rows[r] + 1.0)
+            for r in (0, 1):  # read-your-own-writes, same frame
+                tables[r].pull(keysets[r])
+            LockstepCons.clocks[0] += 1
+            LockstepCons.clocks[1] += 1
+        lost = [b.frames_lost for b in buses]
+        return [t._w.copy() for t in tables], lost
+    finally:
+        for b in buses:
+            b.close()
+
+
 def test_bsp_run_is_bitwise_equal_with_chaos_on_and_off():
     """Determinism under recovery: a BSP lockstep run produces BITWISE
     identical final weights with chaos+retransmit on vs a clean wire —
     deliver-once in-order recovery reconstructs the exact frame stream,
     so not one bit of training state may differ."""
-    def run(chaos, reliable):
-        buses = _mk_chaos_buses(2, chaos=chaos, reliable=reliable)
-
-        class LockstepCons:  # shared lockstep clock vector (BSP: s = 0)
-            clocks = [0, 0]
-            staleness = 0
-
-            def __init__(self, rank):
-                self.rank = rank
-
-            @property
-            def clock(self):
-                return self.clocks[self.rank]
-
-            def admit_pull(self, clk):
-                return min(self.clocks) >= clk
-
-            def serving_clock(self, requester):
-                return min(self.clocks)
-
-        tables = [ShardedTable("t", 64, 2, buses[i], i, 2, updater="sgd",
-                               lr=0.5, pull_timeout=20.0)
-                  for i in range(2)]
-        LockstepCons.clocks = [0, 0]
-        for i, t in enumerate(tables):
-            t.bind_consistency(LockstepCons(i))
-            t._w[...] = np.arange(32 * 2, dtype=np.float32
-                                  ).reshape(32, 2) / 7.0
-        # disjoint cross-shard keys (same shape as the row-cache bitwise
-        # drill): each shard receives pushes from exactly one peer, so
-        # per-link in-order delivery fixes the apply order bit-for-bit
-        keysets = [np.array([33, 40, 33, 47]), np.array([1, 8, 1, 15])]
-        try:
-            for _ in range(4):
-                rows = [tables[r].pull(keysets[r]) for r in (0, 1)]
-                for r in (0, 1):
-                    tables[r].push(keysets[r], 0.1 * rows[r] + 1.0)
-                for r in (0, 1):  # read-your-own-writes, same frame
-                    tables[r].pull(keysets[r])
-                LockstepCons.clocks[0] += 1
-                LockstepCons.clocks[1] += 1
-            lost = [b.frames_lost for b in buses]
-            return [t._w.copy() for t in tables], lost
-        finally:
-            for b in buses:
-                b.close()
-
+    run = run_bsp_lockstep
     w_clean, _ = run(chaos="", reliable="")
     w_chaos, lost = run(chaos="31337:drop=0.04,dup=0.02,reorder=0.03",
                         reliable="1")

@@ -16,7 +16,8 @@ sys.path.insert(0, str(REPO / "ci"))
 from bench_regression import (backend_mismatch, cache_tripwires,  # noqa: E402
                               chaos_tripwires, compare, main,
                               rebalance_tripwires, serve_tripwires,
-                              throughput_points, trace_tripwires)
+                              throughput_points, trace_tripwires,
+                              transport_tripwires)
 
 
 def _art(points):
@@ -169,6 +170,76 @@ def test_chaos_off_arms_never_enter_the_throughput_gate():
         "completed": True, "rows_per_sec_survived": 123.0}
     assert compare(_chaos_art(), art, 0.10) == []
     assert compare(art, _chaos_art(), 0.10) == []
+
+
+def _transport_art(zj=100.0, zb=105.0, shm=130.0, bytes_row=4.4,
+                   shm_bytes=None, compose_rate=90.0, completed=True,
+                   lost=0, dropped=12, rts=10) -> dict:
+    """transport_comparison_3proc artifact: three comparable arms plus
+    the compose completion arm (rate gate-invisible, like chaos)."""
+    def arm(rate, br):
+        return {"rows_per_sec_per_process": rate, "completed": True,
+                "wire_bytes_per_row_moved": br}
+    return {"transport_comparison_3proc": {
+        "zmq_json": arm(zj, bytes_row),
+        "zmq_bin": arm(zb, bytes_row),
+        "shm": arm(shm, shm_bytes if shm_bytes is not None
+                   else bytes_row),
+        "shm_compose": {"completed": completed,
+                        "rows_per_sec_lossy": compose_rate,
+                        "wire_frames_lost": lost,
+                        "chaos_dropped": dropped,
+                        "retransmits_got": rts}}}
+
+
+def test_transport_tripwire_passes_on_healthy_sweep():
+    assert transport_tripwires(_transport_art()) == []
+    assert transport_tripwires({"metric": "m"}) == []  # vacuous
+
+
+def test_transport_win_requires_shm_strictly_above_zmq_json():
+    probs = transport_tripwires(_transport_art(zj=100.0, shm=100.0))
+    assert any("TRANSPORT-WIN" in p for p in probs)
+    probs = transport_tripwires(_transport_art(zj=100.0, shm=95.0))
+    assert any("TRANSPORT-WIN" in p for p in probs)
+    # a missing shm arm is a WIN failure, not a silent pass
+    art = _transport_art()
+    del art["transport_comparison_3proc"]["shm"]
+    assert any("TRANSPORT-WIN" in p for p in transport_tripwires(art))
+
+
+def test_transport_win_requires_bytes_per_row_unchanged():
+    """Framing moves head bytes, never blob bytes: a bytes/row drift
+    between arms means a codec touched payload rows."""
+    probs = transport_tripwires(_transport_art(bytes_row=4.4,
+                                               shm_bytes=5.0))
+    assert any("TRANSPORT-WIN" in p and "bytes/row" in p for p in probs)
+    assert transport_tripwires(_transport_art(bytes_row=4.4,
+                                              shm_bytes=4.4)) == []
+
+
+def test_transport_compose_must_complete_clean_and_engaged():
+    probs = transport_tripwires(_transport_art(completed=False,
+                                               compose_rate=None))
+    assert any("TRANSPORT-COMPOSE" in p for p in probs)
+    probs = transport_tripwires(_transport_art(lost=3))
+    assert any("TRANSPORT-COMPOSE" in p and "unrecovered" in p
+               for p in probs)
+    # a compose arm whose injector or repair never fired proves nothing
+    probs = transport_tripwires(_transport_art(dropped=0))
+    assert any("TRANSPORT-COMPOSE" in p for p in probs)
+    probs = transport_tripwires(_transport_art(rts=0))
+    assert any("TRANSPORT-COMPOSE" in p for p in probs)
+
+
+def test_transport_compose_arm_never_enters_the_throughput_gate():
+    """The compose arm runs under active seeded loss: its rate lives
+    under rows_per_sec_lossy, invisible to the run-to-run ±10% gate in
+    both directions (same contract as the chaos arms)."""
+    pts = throughput_points(_transport_art())
+    assert not any(p.endswith("shm_compose") for p in pts), pts
+    assert compare(_transport_art(compose_rate=200.0),
+                   _transport_art(compose_rate=10.0), 0.10) == []
 
 
 def _rebal_art(static_imb=2.8, rb_imb=1.4, migrations=3,

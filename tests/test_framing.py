@@ -1,0 +1,239 @@
+"""Binary wire framing (comm/framing.py) — this PR's tentpole codec.
+
+The contract under test: every control head the stack emits round-trips
+BITWISE through the binary codec (encode is deterministic, decode
+reproduces the exact payload object, the blob rides untouched), the
+decoded object is indistinguishable from what the seed JSON codec would
+have delivered (handlers must not care which codec framed the wire),
+and a mixed fleet — one rank still on ``MINIPS_WIRE_FMT=json`` —
+decodes per frame via the magic-byte sniff.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from minips_tpu.comm import framing as F
+
+
+def _cfg_header() -> dict:
+    return {"ws": 3, "nr": 65536, "dm": 8, "rb": "block=16,topk=64"}
+
+
+def _frame_corpus(rng: np.random.Generator) -> list[tuple[str, dict,
+                                                          bytes]]:
+    """One representative (kind, payload, blob) per frame kind the stack
+    emits — the shapes mirror the real send sites in train/sharded_ps.py,
+    serve/plane.py, comm/reliable.py, and comm/bus.py. Blobs cover the
+    empty case, dtype variety (i64 keys, f32 rows, int8 codes + f32
+    scales, f64), and a max-size row block."""
+    dim = 8
+    n = int(rng.integers(1, 64))
+    keys = rng.integers(0, 65536, size=n).astype(np.int64)
+    rows = rng.standard_normal((n, dim)).astype(np.float32)
+    codes = rng.integers(-128, 128, size=(n, dim)).astype(np.int8)
+    scales = rng.random(n).astype(np.float32)
+    maxrows = np.ones((4096, dim), np.float32)  # a full-block grant
+    ints = lambda k: [int(x) for x in rng.integers(0, 1 << 20, size=k)]
+    corpus = [
+        # push / pull / ack / epoch-nack (psh family)
+        ("psP:t", {"n": n, "comm": "int8", "seq": 17, "ep": 2,
+                   **_cfg_header()},
+         keys.tobytes() + scales.tobytes() + codes.tobytes()),
+        ("psR:t", {"lo": 0, "hi": n, "comm": "float32", "ep": 2,
+                   **_cfg_header()}, rows.tobytes()),
+        ("psG:t", {"req": 912, "clk": 5, "ep": 2, "rt": 1,
+                   **_cfg_header()}, keys.tobytes()),
+        ("psA:t", {"req": 913, "clk": 5, **_cfg_header()}, b""),
+        ("psr:t", {"req": 912, "wire": "int8", "n": n, "stamp": 4,
+                   "acks": ints(32)},
+         scales.tobytes() + codes.tobytes()),
+        ("psK:t", {"seqs": ints(48)}, b""),
+        ("psE:t", {"req": 912, "ep": 3, "ovb": ints(6), "ovo": ints(6)},
+         b""),
+        ("psQ:t", {}, b""),
+        ("psFlush", {"clock": 41}, b""),
+        ("psFlushAck", {}, b""),
+        ("psBye", {}, b""),
+        ("clock", {"clocks": ints(4)}, b""),
+        # rebalancer (rb family)
+        ("rbS:t", {"b": 7, "ep": 4, "lo": 112, "n": n, "u": "adam",
+                   **_cfg_header()},
+         rows.tobytes() + rows.astype(np.float64).tobytes()),
+        ("rbA:t", {"ep": 4}, b""),
+        ("rbF:t", {"b": 7, "ep": 4}, b""),
+        # serving plane (sv family)
+        ("svU:t", {"stamp": 9, "lease": 2.0, "ep": 3, "wire": "f32",
+                   "bs": ints(16), "fl": [0] * 16, "ns": [n] * 16,
+                   "renew": 1, **_cfg_header()},
+         keys.tobytes() + maxrows.tobytes()),
+        ("svR:t", {"bs": ints(5), "ep": 3}, b""),
+        ("svM:t", {"bs": ints(8), "hs": [ints(2) for _ in range(8)],
+                   "ep": 3}, b""),
+        ("svN:t", {"req": 912, "why": "stale"}, b""),
+        ("svS:t", {"req": 912, "h": ints(2), "bs": ints(3)}, b""),
+        ("svB:t", {"req": 912, "ms": 2.0}, b""),
+        ("svP:t", {"req": 912, "clk": 5, **_cfg_header()},
+         keys.tobytes()),
+        # reliable-delivery control plane
+        ("__rl_nack", {"s": "d", "seqs": ints(256)}, b""),
+        ("__rl_gone", {"s": "b", "seqs": ints(3)}, b""),
+        ("__rl_top", {"b": 512, "d": {"0": 31, "2": 7}}, b""),
+        ("__rt", {"m2": F.encode_head_bin(
+            {"kind": "psK:t", "sender": 1,
+             "payload": {"seqs": ints(4)}, "ds": 9})}, rows.tobytes()),
+        ("__rt", {"m": json.dumps({"kind": "psK:t", "sender": 1,
+                                   "payload": {"seqs": ints(4)},
+                                   "ds": 9})}, b""),
+        # bus-level exchange + handshake
+        ("blobx", {"round": 3, "tag": "union", "dtype": "int64"},
+         keys.tobytes()),
+        ("blobx_req", {"round": 3, "tag": "union"}, b""),
+        ("__hello", {}, b""),
+        ("__ready", {}, b""),
+    ]
+    return corpus
+
+
+def _stamp(head: dict, i: int, rng: np.random.Generator) -> dict:
+    kind = head["kind"]
+    if kind.startswith("__"):
+        return head  # handshake/control: unstamped, like the backends
+    if rng.random() < 0.5:
+        head["bs"] = i
+    else:
+        head["ds"] = i
+    return head
+
+
+def test_every_frame_kind_roundtrips_bitwise():
+    """Seeded sweep over the full frame corpus: binary decode must
+    reproduce the head EXACTLY (and agree with what the JSON codec
+    delivers, where JSON can express it), re-encode must be
+    byte-identical (deterministic canonical encoding — what makes the
+    zmq-vs-shm lockstep drill meaningful), and the blob must pass
+    through untouched."""
+    rng = np.random.default_rng(20260803)
+    for rep in range(8):
+        for i, (kind, payload, blob) in enumerate(_frame_corpus(rng)):
+            head = _stamp({"kind": kind, "sender": int(rng.integers(3)),
+                           "payload": payload}, i, rng)
+            wire = F.encode_head_bin(head)
+            dec = F.decode_head(wire)
+            assert dec == head, kind
+            assert F.encode_head_bin(dec) == wire, kind  # bitwise stable
+            # JSON parity wherever JSON can express the payload
+            try:
+                jwire = json.dumps(head).encode()
+            except TypeError:
+                jwire = None  # bytes-bearing payload (__rt m2): bin-only
+            if jwire is not None:
+                assert F.decode_head(jwire) == dec, kind
+            # the blob slot never passes through the codec at all, but
+            # pin the bytes anyway: the transport contract is bitwise
+            assert bytes(blob) == blob
+            assert F.decode_head(F.encode_head(head, "bin")) == dec
+
+
+def test_empty_and_maximal_payloads():
+    empty = {"kind": "psQ:t", "sender": 0, "payload": {}}
+    assert F.decode_head(F.encode_head_bin(empty)) == empty
+    big = {"kind": "psr:t", "sender": 2,
+           "payload": {"acks": list(range(100_000))}, "ds": 1}
+    wire = F.encode_head_bin(big)
+    assert F.decode_head(wire) == big
+    # int64 range edges + arbitrary precision beyond them
+    edges = {"kind": "x", "sender": 0,
+             "payload": {"a": 2**63 - 1, "b": -(2**63), "c": 2**80,
+                         "d": -(2**80)}}
+    assert F.decode_head(F.encode_head_bin(edges)) == edges
+
+
+def test_decoded_payload_matches_json_semantics():
+    """Handlers must not see codec-dependent shapes: tuples decode as
+    lists, non-str dict keys coerce the way json.dumps coerces them,
+    bools survive inside int lists (the int64 fast path must not
+    swallow them), floats stay floats."""
+    head = {"kind": "x", "sender": 1, "payload": {
+        "tup": (1, 2, 3), "mixed": [1, True, 2.5, "s", None],
+        "nested": {"a": [{"b": []}]}, "f": 1.0, "i": 1,
+    }, "bs": 7}
+    dec = F.decode_head(F.encode_head_bin(head))
+    jdec = json.loads(json.dumps(head))
+    assert dec == jdec
+    assert isinstance(dec["payload"]["f"], float)
+    assert isinstance(dec["payload"]["i"], int)
+    assert dec["payload"]["mixed"][1] is True
+    ik = {"kind": "x", "sender": 1, "payload": {1: "a", True: "b"}}
+    assert F.decode_head(F.encode_head_bin(ik)) \
+        == json.loads(json.dumps(ik))
+
+
+def test_malformed_binary_frames_decode_to_none_not_raise():
+    good = F.encode_head_bin({"kind": "psr:t", "sender": 1,
+                              "payload": {"req": 3, "acks": [1, 2]},
+                              "ds": 5})
+    assert F.decode_head(good) is not None
+    for bad in (b"", b"\x00", bytes([F.MAGIC]), good[:-3], good[:7],
+                bytes([F.MAGIC ^ 1]) + good[1:],
+                good + b"trailing", b"not json at all", b"[1, 2]",
+                b"{torn json"):
+        assert F.decode_head(bad) is None, bad[:16]
+    # a truncated length field inside the TLV must not over-read
+    assert F.decode_head(good[: len(good) // 2]) is None
+
+
+def test_mixed_fleet_sniffs_per_frame():
+    """A json-fmt rank and a bin-fmt rank interoperate: the receive
+    path sniffs the first byte, so both decode to the same dict."""
+    head = {"kind": "clock", "sender": 0,
+            "payload": {"clocks": [3, 4]}, "bs": 12}
+    assert F.decode_head(F.encode_head(head, "json")) \
+        == F.decode_head(F.encode_head(head, "bin")) == head
+    assert F.encode_head(head, "json")[:1] == b"{"
+    assert F.encode_head(head, "bin")[0] == F.MAGIC
+
+
+def test_wire_fmt_env_resolution(monkeypatch):
+    monkeypatch.delenv("MINIPS_WIRE_FMT", raising=False)
+    assert F.wire_fmt_from_env() == "bin"
+    monkeypatch.setenv("MINIPS_WIRE_FMT", "json")
+    assert F.wire_fmt_from_env() == "json"
+    monkeypatch.setenv("MINIPS_WIRE_FMT", "base64")
+    with pytest.raises(ValueError, match="MINIPS_WIRE_FMT"):
+        F.wire_fmt_from_env()
+
+
+def test_dup_msg_is_deep_and_codec_agnostic():
+    """The chaos injector's duplicate op (satellite): the copy must be
+    independent at every nesting level (handlers mutate payloads in
+    place) and must carry values JSON cannot (bytes in a retransmit
+    wrapper) — the seed's json.loads(json.dumps(...)) raised there."""
+    msg = {"kind": "__rt", "sender": 1,
+           "payload": {"m2": b"\x00\xb6raw", "seqs": [1, 2],
+                       "nest": {"a": [1, {"b": 2}]}, "t": (1, 2)}}
+    dup = F.dup_msg(msg)
+    assert dup["payload"]["m2"] == b"\x00\xb6raw"
+    assert dup["payload"]["t"] == [1, 2]  # JSON parity: tuples -> lists
+    dup["payload"]["nest"]["a"][1]["b"] = 99
+    dup["payload"]["seqs"].append(3)
+    assert msg["payload"]["nest"]["a"][1]["b"] == 2
+    assert msg["payload"]["seqs"] == [1, 2]
+
+
+def test_reliable_retransmit_carries_binary_heads():
+    """The __rt wrapper round-trip at the codec level: a journaled
+    binary head re-ships as raw bytes ("m2") and decodes back to the
+    exact original frame — the reliable channel's recovery path under
+    MINIPS_WIRE_FMT=bin."""
+    inner = {"kind": "psP:t", "sender": 0,
+             "payload": {"n": 4, "comm": "int8", **_cfg_header()},
+             "ds": 41}
+    journaled = F.encode_head_bin(inner)
+    wrap = {"kind": "__rt", "sender": 0, "payload": {"m2": journaled}}
+    wire = F.encode_head_bin(wrap)
+    got = F.decode_head(wire)
+    assert F.decode_head(got["payload"]["m2"]) == inner

@@ -15,8 +15,11 @@ from tests.conftest import load_jax_compat_manifest
 
 # the byte-identical failure set every Tier-1 run since seed carried
 # (CHANGES.md PR1-PR5: "failure set identical, 146 pre-existing
-# jax-version failures") — the manifest may never grow past it
-SEED_FAILURE_COUNT = 146
+# jax-version failures") — the manifest may never grow past it. PR7
+# fixed 63 for real (the utils/jaxcompat.py shard_map/typeof shims:
+# checkpoint, cssp, dense-table, ssp_spmd, engine, mnist, transformer,
+# flash-attention, apps) and lowered the ceiling to match.
+SEED_FAILURE_COUNT = 83
 
 
 def test_manifest_only_shrinks():

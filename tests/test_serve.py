@@ -226,6 +226,64 @@ def test_admission_sheds_and_backpressures_loudly():
     np.testing.assert_array_equal(finals[0], finals[1])
 
 
+def test_partial_shed_redirects_covered_half_only():
+    """Replica-aware shed (PR6's documented headroom): when the
+    admission bucket is empty and a pull leg's blocks are only
+    PARTIALLY covered by a replica holder, the owner redirects the
+    covered half (svS carrying ``bs``, the client peels those keys
+    onto an svP leg) and backpressures only the remainder — never
+    refuses the whole leg. Deterministic by construction:
+    auto-promotion is disabled (min_heat astronomical; interval huge,
+    so no refresh/demote tick ever runs) and the grant is issued
+    through the owner's own ``_grant_blocks`` — the exact path
+    ``_promote_hot`` takes — pinning the granted set to block 0
+    forever, so EVERY mixed leg must split. (The heat-driven flavor of
+    this drill raced the promotion tick: ``hot=1`` caps promotions per
+    TICK, not in total, so the cold block joined the holder set a few
+    ticks later, every later mixed leg had full coverage, and the
+    partial window closed — vacuous under suite load.)"""
+    buses = _mk_buses(3, reliable="1")
+    try:
+        tables = [ShardedTable("t", 96, 2, buses[i], i, 3,
+                               updater="sgd", lr=1.0, pull_timeout=20.0)
+                  for i in range(3)]
+        trainers = [ShardedPSTrainer(
+            {"t": tables[i]}, buses[i], 3, staleness=2,
+            serve="replicas=1,hot=1,interval=1e9,min_heat=1e18,"
+                  "lease=30,rate=0.001,burst=1")
+            for i in range(3)]
+        sv0, sv1 = tables[0]._sv, tables[1]._sv
+        span = tables[0].router.block_span(0)[1]
+        hot = np.arange(span, dtype=np.int64)        # block 0
+        both = np.arange(2 * span, dtype=np.int64)   # blocks 0 + 1
+        seed = np.arange(2 * span * 2,
+                         dtype=np.float32).reshape(-1, 2)
+        tables[0]._w[: 2 * span] = seed              # known owner rows
+        sv0._grant_blocks([0], (1,))                 # pinned grant
+        deadline = time.monotonic() + 5.0
+        while sv1.held_blocks() == 0:
+            assert time.monotonic() < deadline, "grant never arrived"
+            time.sleep(0.02)
+        # drain the one-token bucket with an admitted covered pull
+        tables[2].pull(hot)
+        for rep in range(1, 4):
+            # every mixed leg must split: svP rides the replica for
+            # block 0, the block-1 remainder re-judges (svB -> timered
+            # rt=1 retry, force-admitted) — and the values must be the
+            # owner's rows bit-for-bit whichever side served them
+            got = tables[2].pull(both)
+            np.testing.assert_array_equal(got, seed)
+            assert sv0.counters["shed_partial"] == rep, sv0.counters
+        assert sv0.counters["backpressure"] >= 3     # uncovered half
+        assert sv1.counters["replica_served_requests"] >= 3  # covered
+        assert _tot(trainers, "stale_reads") == 0
+        for tr in trainers:
+            assert tr.frames_dropped == 0, tr.drop_detail()
+    finally:
+        for b in buses:
+            b.close()
+
+
 def test_lease_expiry_goes_dark_then_refuses():
     """A replica whose owner stops refreshing must refuse (expired
     lease) instead of serving an ever-staler snapshot — and the

@@ -1,0 +1,474 @@
+"""Shared-memory ring transport (comm/shm_bus.py) — this PR's tentpole.
+
+Three tiers, all in-proc (threads as nodes — the reference's own test
+idiom, and what keeps these in tier-1):
+
+- ring mechanics: directed/broadcast delivery with blobs across wrap
+  boundaries, per-link FIFO order, backpressure-when-full (bounded,
+  counted — never silent), oversize rejection at the source, segment
+  creation/attach/unlink lifecycle, the stale-run sweeper;
+- layer composition: seeded chaos(drop>=1%)+reliable on the shm backend
+  completes with zero unrecovered frames (TRANSPORT-COMPOSE's claim,
+  proven at bus level), and the layers are the SAME objects make_bus
+  stacks on zmq;
+- the acceptance drill: a BSP lockstep sharded-PS run over shm is
+  BITWISE equal to the same run over zmq (the chaos drill harness,
+  reused) — the transport may change how bytes move, never what they
+  say.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.conftest import mk_loopback_buses
+
+
+def _seg_dir():
+    # the bus's own directory resolution (/dev/shm when present, else
+    # tempdir — the macOS-on-x86 fallback the TSO check permits)
+    from minips_tpu.comm import shm_bus
+    return shm_bus._shm_dir()
+
+
+def _seg_files():
+    return {f for f in os.listdir(_seg_dir())
+            if f.startswith("minips_bus_")}
+
+
+def _mk(n, **kw):
+    buses = mk_loopback_buses(n, backend="shm", settle=0.05, **kw)
+    ts = [threading.Thread(target=b.handshake, args=(n,)) for b in buses]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=15.0)
+    assert not any(t.is_alive() for t in ts), "shm handshake wedged"
+    return buses
+
+
+def _close(buses):
+    for b in buses:
+        b.close()
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while not pred() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert pred(), "timed out waiting for frames"
+
+
+# ------------------------------------------------------------ ring basics
+def test_directed_broadcast_and_blobs_deliver_in_order():
+    buses = _mk(3)
+    got: list = []
+    gotb = {0: [], 2: []}
+    buses[1].on("x", lambda s, p: got.append((s, p["i"],
+                                              p.get("__blob__"))))
+    for r in (0, 2):
+        buses[r].on("bc", (lambda r: lambda s, p:
+                           gotb[r].append(p["i"]))(r))
+    arr = np.arange(4096, dtype=np.float32)
+    try:
+        for i in range(200):
+            buses[0].send(1, "x", {"i": i, "acks": [i, i + 1]},
+                          blob=arr.tobytes() if i % 3 == 0 else None)
+            buses[1].publish("bc", {"i": i})
+        _wait(lambda: len(got) >= 200 and all(len(v) >= 200
+                                              for v in gotb.values()))
+        assert [g[1] for g in got] == list(range(200))  # per-link FIFO
+        assert gotb[0] == list(range(200)) == gotb[2]
+        blobs = [g[2] for g in got if g[2] is not None]
+        assert len(blobs) == 67
+        for b in blobs:  # bitwise through the ring, every wrap included
+            assert np.array_equal(np.frombuffer(b, np.float32), arr)
+        assert all(b.frames_lost == 0 for b in buses)
+        assert all(b.frames_malformed == 0 for b in buses)
+    finally:
+        _close(buses)
+
+
+def test_wrap_boundary_survives_large_blob_stream(monkeypatch):
+    """Frames sized to force many wrap-marker paths through the ring:
+    every byte must land bitwise intact, in order."""
+    monkeypatch.setenv("MINIPS_SHM_RING", str(1 << 18))
+    buses = _mk(2)  # 256KiB ring, ~90KiB frames
+    got: list = []
+    buses[1].on("big", lambda s, p: got.append((p["i"],
+                                                p["__blob__"])))
+    rng = np.random.default_rng(7)
+    payloads = [rng.integers(0, 255, size=90_000).astype(np.uint8)
+                for _ in range(24)]
+    try:
+        for i, arr in enumerate(payloads):
+            buses[0].send(1, "big", {"i": i}, blob=arr.tobytes())
+        _wait(lambda: len(got) >= 24)
+        assert [g[0] for g in got] == list(range(24))
+        for (_, blob), arr in zip(got, payloads):
+            assert np.array_equal(np.frombuffer(blob, np.uint8), arr)
+        assert buses[0].send_drops == 0  # backpressure blocked, not lost
+    finally:
+        _close(buses)
+
+
+def test_oversize_frame_rejected_at_source(monkeypatch):
+    monkeypatch.setenv("MINIPS_SHM_RING", str(1 << 16))
+    buses = _mk(2)
+    try:
+        with pytest.raises(ValueError, match="MINIPS_SHM_RING"):
+            buses[0].send(1, "x", {}, blob=b"z" * (1 << 16))
+        # the stream stays live and gap-free after the raise: the
+        # rejected frame's seq stamp is ROLLED BACK (native ordering —
+        # a consumed-but-never-sent seq would read as a permanent wire
+        # drop under the reliable layer's NACK/GONE machinery)
+        got: list = []
+        buses[1].on("x", lambda s, p: got.append(p["i"]))
+        buses[0].send(1, "x", {"i": 1})
+        _wait(lambda: got == [1])
+        assert buses[1].frames_lost == 0
+        assert buses[0]._dseq[1] == 1  # oversize send consumed no seq
+    finally:
+        _close(buses)
+
+
+def test_near_cap_frame_reserves_retransmit_wrapper(monkeypatch):
+    """A journaled frame sized within the record cap but whose __rt
+    retransmit wrapper would NOT fit must be rejected at first send:
+    otherwise the NACK-path re-send raises on the recv thread (where
+    dispatch swallows it), the retransmit never goes out, and the
+    stream stalls to give-up — unrecovered loss on a reliable run.
+    Without the reliable layer no retransmit can exist, so the same
+    frame must still be accepted."""
+    from minips_tpu.comm import framing
+
+    monkeypatch.setenv("MINIPS_SHM_RING", str(1 << 16))
+    buses = _mk(2, reliable="1")
+    try:
+        cap = buses[0]._max_rec
+        head = {"kind": "x", "sender": 0, "payload": {}, "ds": 0}
+        msg = framing.encode_head(head, buses[0].wire_fmt)
+        wmsg = framing.encode_head(
+            {"kind": "__rt", "sender": 0, "payload": framing.rt_wrap(msg)},
+            buses[0].wire_fmt)
+        ov = len(wmsg) - len(msg)  # the wrapper's head-byte overhead
+        assert ov > 0
+        # raw record = 4 + 4 + len(msg) + 8 + blen: land it cap - ov//2
+        # under the cap — fits bare, cannot fit re-wrapped
+        blen = cap - 16 - len(msg) - ov // 2
+        with pytest.raises(ValueError, match="MINIPS_SHM_RING"):
+            buses[0].send(1, "x", {}, blob=b"z" * blen)
+        # stream stays live and gap-free: the seq stamp rolled back
+        got: list = []
+        buses[1].on("x", lambda s, p: got.append(p["i"]))
+        buses[0].send(1, "x", {"i": 1})
+        _wait(lambda: got == [1])
+        assert buses[1].frames_lost == 0
+        assert buses[0]._dseq[1] == 1
+    finally:
+        _close(buses)
+    # no reliable layer ⇒ no journal, no retransmit: same frame sends
+    buses = _mk(2)
+    got2: list = []
+    buses[1].on("x", lambda s, p: got2.append(len(p["__blob__"])))
+    try:
+        buses[0].send(1, "x", {}, blob=b"z" * blen)
+        _wait(lambda: got2 == [blen])
+    finally:
+        _close(buses)
+
+
+def test_segment_lifecycle_create_unlink_and_sweep():
+    from minips_tpu.comm import shm_bus
+
+    before = _seg_files()
+    buses = _mk(2)
+    ns_files = _seg_files() - before
+    assert len(ns_files) == 4  # 2 rings + 2 doorbells
+    _close(buses)
+    after = _seg_files()
+    assert not (after - before), "close() leaked segments"
+    # the sweeper reclaims a dead run's leftovers but spares live ones
+    dead = os.path.join(_seg_dir(),
+                        "minips_bus_999999999_feed_0to1.ring")
+    live = os.path.join(_seg_dir(),
+                        f"minips_bus_{os.getpid()}_feed_0to1.ring")
+    for p in (dead, live):
+        with open(p, "wb") as f:
+            f.write(b"\0" * 128)
+    try:
+        shm_bus.sweep_stale_segments()
+        assert not os.path.exists(dead)
+        assert os.path.exists(live)
+    finally:
+        for p in (dead, live):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+def test_post_close_publish_is_silent_noop():
+    buses = _mk(2)
+    _close(buses)
+    buses[0].publish("x", {"i": 1})  # zmq-parity: no use-after-close
+
+
+def test_empty_ring_env_knob_means_default(monkeypatch):
+    """MINIPS_SHM_RING="" is DEFAULT, like every other MINIPS_* knob
+    (the bench arms pin empty strings to keep an armed environment
+    from leaking) — int('') must not blow up construction."""
+    from minips_tpu.comm import shm_bus
+
+    monkeypatch.setenv("MINIPS_SHM_RING", "")
+    buses = _mk(2)
+    try:
+        assert all(b._cap == shm_bus.DEFAULT_RING for b in buses)
+    finally:
+        _close(buses)
+
+
+def test_recv_thread_send_budget_is_bounded(monkeypatch):
+    """A send issued from the recv thread (handler replies, reliable
+    NACK/retransmit) must not sit the full 30s backpressure budget: it
+    stops draining inbound rings while it waits (for ring space or its
+    write turn — the seq lock itself is never held across the wait),
+    so two symmetric recv threads would stall each other for
+    the whole budget. The recv-thread budget is recv_send_timeout
+    (250ms) and the overflow drops COUNTED — journal+NACK (or the
+    pull-deadline poison) owns recovery."""
+    monkeypatch.setenv("MINIPS_SHM_RING", str(1 << 16))
+    buses = _mk(2)
+    real_thread = buses[0]._thread
+    try:
+        # park the consumer so the 0->1 ring genuinely fills
+        buses[1]._stop.set()
+        buses[1]._thread.join(timeout=5.0)
+        # impersonate the recv thread: _write keys the budget off it
+        buses[0]._thread = threading.current_thread()
+        blob = b"z" * 8000
+        t0 = time.monotonic()
+        for i in range(20):  # ~160KB into a 64KiB ring: must overflow
+            buses[0].send(1, "x", {"i": i}, blob=blob)
+        dt = time.monotonic() - t0
+        assert buses[0].send_drops > 0  # counted, never silent
+        # full-budget behavior would be 30s PER overflowing frame
+        assert dt < 15.0, f"recv-thread sends blocked {dt:.1f}s"
+    finally:
+        buses[0]._thread = real_thread
+        _close(buses)
+
+
+def test_repair_thread_sends_get_short_budget(monkeypatch):
+    """The reliable repair thread dispatches recovered frames' handlers
+    while holding the channel lock the recv thread's on_stamped needs
+    (reliable.py pump -> _drain): its sends must ride the recv thread's
+    short budget, or two ranks' repair threads stuck writing into each
+    other's full ring would hold both locks for the full 30s budget and
+    neither recv thread could drain — the symmetric stall the
+    recv_send_timeout exists to break, re-formed one lock up."""
+    monkeypatch.setenv("MINIPS_SHM_RING", str(1 << 16))
+    buses = _mk(2, reliable="1")
+    try:
+        # install() registered the repair thread at construction
+        assert buses[0].reliable._thread in buses[0]._drain_critical
+        # park the consumer so the 0->1 ring genuinely fills, then send
+        # from a registered drain-critical thread: the budget must be
+        # recv_send_timeout, not the 30s default
+        buses[1]._stop.set()
+        buses[1]._thread.join(timeout=5.0)
+        buses[0].note_drain_critical(threading.current_thread())
+        blob = b"z" * 8000
+        t0 = time.monotonic()
+        for i in range(20):  # ~160KB into a 64KiB ring: must overflow
+            buses[0].send(1, "x", {"i": i}, blob=blob)
+        dt = time.monotonic() - t0
+        assert buses[0].send_drops > 0  # counted, never silent
+        assert dt < 15.0, f"drain-critical sends blocked {dt:.1f}s"
+    finally:
+        _close(buses)
+
+
+def test_shm_refuses_weakly_ordered_hosts(monkeypatch):
+    """The lock-free cursor protocol's data-then-head visibility order
+    is an x86-TSO property; pure Python can emit no release fence, so
+    a weakly-ordered host (aarch64) could dispatch torn frames.
+    Construction must refuse LOUDLY there, not deliver garbage."""
+    from minips_tpu.comm import shm_bus
+
+    monkeypatch.setattr(shm_bus.platform, "machine", lambda: "aarch64")
+    with pytest.raises(RuntimeError, match="TSO"):
+        shm_bus.ShmControlBus("tcp://127.0.0.1:19001",
+                              ["tcp://127.0.0.1:19002"], my_id=0)
+    # 32-bit x86 is TSO but splits the 8-byte cursor store into two
+    # 4-byte moves — a peer can read a torn cursor, so refuse there too
+    monkeypatch.setattr(shm_bus.platform, "machine", lambda: "i686")
+    with pytest.raises(RuntimeError, match="TSO"):
+        shm_bus.ShmControlBus("tcp://127.0.0.1:19001",
+                              ["tcp://127.0.0.1:19002"], my_id=0)
+
+
+def test_backpressured_send_does_not_hold_seq_lock(monkeypatch):
+    """The seq lock is never held across a full ring's backpressure
+    wait: a blocked producer holding it would stall every other sender
+    on the lock itself — where no per-thread budget can apply — so the
+    recv thread would stop draining and the symmetric stall would
+    re-form one level up from the ring wait."""
+    monkeypatch.setenv("MINIPS_SHM_RING", str(1 << 16))
+    buses = _mk(2)
+    try:
+        buses[1]._stop.set()  # park the consumer: the ring will fill
+        buses[1]._thread.join(timeout=5.0)
+        buses[0].send_timeout = 0.5
+        blob = b"z" * 8000
+        done = threading.Event()
+
+        def flood():
+            for i in range(20):  # ~160KB into 64KiB: overflows mid-way
+                buses[0].send(1, "x", {"i": i}, blob=blob)
+            done.set()
+
+        t = threading.Thread(target=flood, daemon=True)
+        t.start()
+        time.sleep(0.25)  # flood is now inside a backpressure wait
+        assert not done.is_set(), "ring never filled — test is vacuous"
+        assert buses[0]._seq_lock.acquire(timeout=1.0), \
+            "seq lock held across ring backpressure"
+        buses[0]._seq_lock.release()
+        t.join(timeout=30.0)
+        assert done.is_set()
+        assert buses[0].send_drops > 0
+    finally:
+        _close(buses)
+
+
+def test_concurrent_senders_preserve_per_link_stream_integrity():
+    """Multiple sender threads share the tx rings in real runs (train
+    thread, recv-thread replies, the reliable repair thread): the
+    write tickets must keep delivery exactly-once with zero gaps/dups
+    and per-thread FIFO intact, whatever the interleaving."""
+    buses = _mk(2)
+    got: list = []
+    buses[1].on("x", lambda s, p: got.append(p["i"]))
+    try:
+        def flood(base):
+            for i in range(150):
+                buses[0].send(1, "x", {"i": base + i})
+
+        ts = [threading.Thread(target=flood, args=(k * 1000,))
+              for k in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30.0)
+        _wait(lambda: len(got) >= 600)
+        assert sorted(got) == sorted(k * 1000 + i for k in range(4)
+                                     for i in range(150))
+        assert buses[1].frames_lost == 0
+        assert buses[1].loss.dups == 0
+        assert buses[0].send_drops == 0
+        for k in range(4):  # ring order == stamp order per sender
+            mine = [i for i in got if i // 1000 == k]
+            assert mine == sorted(mine)
+    finally:
+        _close(buses)
+
+
+def test_close_with_held_view_still_unlinks_segments():
+    """A recv thread that outlives close()'s bounded join still holds
+    views into the maps — mm.close() raises BufferError. The segment
+    FILES must unlink regardless: a live-pid leak in /dev/shm is
+    invisible to the stale-run sweeper."""
+    before = _seg_files()
+    buses = _mk(2)
+    held = buses[0]._rx[1].buf[0:8]  # simulates an in-flight record view
+    _close(buses)
+    after = _seg_files()
+    assert not (after - before), "close() leaked segments under a view"
+    held.release()
+
+
+# ------------------------------------------------------- layer composition
+def test_chaos_reliable_compose_on_shm_exactly_once_in_order():
+    """TRANSPORT-COMPOSE at bus level: the seeded injector drops/dups/
+    reorders on the shm receive path, the reliable channel repairs —
+    every frame exactly once, in per-link order, zero unrecovered loss,
+    with the counters proving the layer (not luck) carried it."""
+    spec = "424242:drop=0.05,dup=0.02,reorder=0.03,delay=0.02," \
+           "delay_ms=10"
+    buses = _mk(2, chaos=spec, reliable="1")
+    got: list = []
+    buses[1].on("x", lambda s, p: got.append(p["i"]))
+    try:
+        n = 300
+        for i in range(n):
+            buses[0].send(1, "x", {"i": i})
+        _wait(lambda: len(got) >= n, timeout=30.0)
+        assert got == list(range(n))
+        assert buses[1].frames_lost == 0
+        ch = buses[1].chaos.snapshot()
+        rl = buses[1].reliable.snapshot()
+        assert ch["dropped"] > 0, ch
+        assert rl["retransmits_got"] > 0, rl
+    finally:
+        _close(buses)
+
+
+def test_chaos_without_retransmit_loses_frames_loudly_on_shm():
+    """The honest before/after on the new transport too: same chaos
+    schedule, reliable off — frames are lost AND counted."""
+    buses = _mk(2, chaos="77:drop=0.1")
+    got: list = []
+    buses[1].on("x", lambda s, p: got.append(p["i"]))
+    try:
+        for i in range(200):
+            buses[0].send(1, "x", {"i": i})
+        deadline = time.monotonic() + 10
+        last = -1
+        while time.monotonic() < deadline:
+            time.sleep(0.25)
+            if len(got) == last:
+                break
+            last = len(got)
+        assert len(got) < 200
+        assert buses[1].frames_lost > 0
+        assert buses[1].chaos.snapshot()["dropped"] > 0
+    finally:
+        _close(buses)
+
+
+# --------------------------------------------------- the acceptance drill
+def test_bsp_lockstep_zmq_vs_shm_is_bitwise_equal():
+    """ACCEPTANCE: the same BSP lockstep sharded-PS run (the chaos
+    drill's harness, tests/test_chaos_reliable.py) over zmq and over
+    shm ends in BITWISE-identical replicas on both ranks — the
+    transport moves bytes differently, it may not change one bit of
+    training state."""
+    from tests.test_chaos_reliable import run_bsp_lockstep
+
+    w_zmq, lost_zmq = run_bsp_lockstep(backend="zmq")
+    w_shm, lost_shm = run_bsp_lockstep(backend="shm")
+    assert lost_zmq == [0, 0] and lost_shm == [0, 0]
+    for a, b in zip(w_zmq, w_shm):
+        np.testing.assert_array_equal(a, b)  # bitwise, not allclose
+
+
+def test_bsp_lockstep_shm_survives_seeded_chaos_bitwise():
+    """Chaos(drop>=1%)+reliable ON THE SHM BACKEND reconstructs the
+    exact frame stream: bitwise equality against the clean zmq run —
+    the full layer-composition claim (transport x chaos x reliable),
+    proven, not assumed."""
+    from tests.test_chaos_reliable import run_bsp_lockstep
+
+    w_clean, _ = run_bsp_lockstep(backend="zmq")
+    w_chaos, lost = run_bsp_lockstep(
+        backend="shm", chaos="31337:drop=0.04,dup=0.02,reorder=0.03",
+        reliable="1")
+    assert lost == [0, 0]
+    for a, b in zip(w_clean, w_chaos):
+        np.testing.assert_array_equal(a, b)
