@@ -123,6 +123,11 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     env_extra["MINIPS_RELIABLE"] = "1" if reliable else ""
     env_extra["MINIPS_REBALANCE"] = rebalance or ""
     env_extra["MINIPS_TRACE"] = ""
+    # elastic membership + kill/liveness knobs pinned off for the same
+    # reason: an armed environment must not leak into non-elastic arms
+    env_extra["MINIPS_ELASTIC"] = ""
+    env_extra["MINIPS_CHAOS_KILL"] = ""
+    env_extra["MINIPS_HEARTBEAT"] = ""
     # head-codec arm config (the transport sweep): explicit empty keeps
     # an armed environment from leaking a format into the other arms
     env_extra["MINIPS_WIRE_FMT"] = wire_fmt or ""
@@ -621,7 +626,9 @@ def main() -> int:
                 env_extra={"MINIPS_CHAOS": "", "MINIPS_RELIABLE": "",
                            "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
                            "MINIPS_SERVE": "", "MINIPS_BUS": "",
-                           "MINIPS_WIRE_FMT": ""},
+                           "MINIPS_WIRE_FMT": "", "MINIPS_ELASTIC": "",
+                           "MINIPS_CHAOS_KILL": "",
+                           "MINIPS_HEARTBEAT": ""},
                 timeout=timeout)
         except Exception as e:  # noqa: BLE001 - completion-gated arms
             return {"completed": False, "error": str(e)[:300]}
@@ -680,6 +687,122 @@ def main() -> int:
 
     storm_grid = _storm_grid(o_reps)
 
+    # ELASTIC MEMBERSHIP (this PR): the join/leave/death state machine
+    # (balance/membership.py) drilled as bench arms on the example app
+    # (it owns the checkpoint/recovery protocol the death path needs).
+    # These are COMPLETION gates, not throughput comparisons — the
+    # kill arm's wall-clock contains a heartbeat-detection stall and
+    # the join arm changes world size mid-run, so no arm carries
+    # rows_per_sec_per_process (steps/sec rides a gate-invisible key,
+    # the PR3 lossy-arm convention). The ci/bench_regression ELASTIC-*
+    # tripwires gate: ELASTIC-DEAD — the seeded-SIGKILL arm's
+    # survivors complete with >= 1 range restored from the elastic
+    # checkpoint, zero unrecovered frames, and a finite final loss;
+    # ELASTIC-JOIN — the standby-admission arm completes with the
+    # joiner serving > 0 rows.
+    def _elastic_arms() -> dict:
+        import tempfile
+
+        from minips_tpu import launch as _launch
+
+        e_iters = 15 if args.quick else 30
+        base = [sys.executable, "-m",
+                "minips_tpu.apps.sharded_ps_example",
+                "--model", "sparse", "--mode", "ssp",
+                "--staleness", "2", "--iters", str(e_iters),
+                "--batch", "128", "--checkpoint-every", "5"]
+        env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                "MINIPS_CHAOS": "", "MINIPS_RELIABLE": "",
+                "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
+                "MINIPS_SERVE": "", "MINIPS_BUS": "",
+                "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
+                "MINIPS_HEARTBEAT": ""}
+        kill_step = max(2, e_iters // 3)
+        grid: dict = {"iters": e_iters, "kill_step": kill_step}
+
+        def summarize(dones: list[dict]) -> dict:
+            sums = [d.get("param_sum") for d in dones]
+            losses = [d.get("loss_last") for d in dones
+                      if d.get("loss_last") is not None]
+            mships = [d.get("membership") or {} for d in dones]
+            return {
+                "completed": True,
+                "steps_per_sec_elastic": round(
+                    e_iters / max(max(d["wall_s"] for d in dones),
+                                  1e-9), 2),
+                "wire_frames_lost": sum(d.get("wire_frames_lost", 0)
+                                        for d in dones),
+                "frames_dropped": sum(d.get("frames_dropped", 0)
+                                      for d in dones),
+                "loss_last": max(losses) if losses else None,
+                "blocks_restored": sum(m.get("blocks_restored", 0)
+                                       for m in mships),
+                "finals_agree": len({s for s in sums
+                                     if s is not None}) <= 1,
+            }
+
+        # -------- steady: armed but idle — the plane's tax must be
+        # invisible (the bitwise lockstep drill pins the numerics;
+        # this arm pins that an armed fleet completes cleanly)
+        with tempfile.TemporaryDirectory() as ck:
+            try:
+                res = _launch.run_local_job(
+                    3, base + ["--checkpoint-dir", ck],
+                    base_port=None,
+                    env_extra={**env0, "MINIPS_ELASTIC": "1"},
+                    timeout=240.0)
+                grid["steady"] = summarize(res)
+            except Exception as e:  # noqa: BLE001 - completion-gated
+                grid["steady"] = {"completed": False,
+                                  "error": str(e)[:300]}
+        # -------- kill: seeded SIGKILL of rank 2 mid-run; survivors
+        # restore its ranges from the elastic checkpoint and finish
+        with tempfile.TemporaryDirectory() as ck:
+            try:
+                rc, events = _launch.run_local_job_raw(
+                    3, base + ["--checkpoint-dir", ck],
+                    base_port=None,
+                    env_extra={**env0, "MINIPS_ELASTIC": "1",
+                               "MINIPS_CHAOS_KILL":
+                                   f"7:rank=2,step={kill_step}",
+                               "MINIPS_HEARTBEAT":
+                                   "interval=0.1,timeout=1.0"},
+                    timeout=240.0, kill_on_failure=False)
+                dones = [ev[-1] for r, ev in enumerate(events)
+                         if r != 2 and ev
+                         and ev[-1].get("event") == "done"]
+                if len(dones) == 2:
+                    grid["kill"] = summarize(dones)
+                else:
+                    grid["kill"] = {"completed": False,
+                                    "error": f"survivors rc={rc}: "
+                                             f"{events}"[:300]}
+            except Exception as e:  # noqa: BLE001 - completion-gated
+                grid["kill"] = {"completed": False,
+                                "error": str(e)[:300]}
+        # -------- join: 3 live + 1 standby admitted mid-run; the
+        # joiner must end OWNING blocks and SERVING pulls
+        with tempfile.TemporaryDirectory() as ck:
+            try:
+                res = _launch.run_local_job(
+                    4, base + ["--checkpoint-dir", ck, "--join-at",
+                               str(kill_step)],
+                    base_port=None,
+                    env_extra={**env0, "MINIPS_ELASTIC": "live=0-2"},
+                    timeout=240.0)
+                point = summarize(res)
+                joiner = res[3].get("serve") or {}
+                point["joiner_serve_rows"] = joiner.get("pull_rows", 0)
+                point["joiner_serve_requests"] = joiner.get(
+                    "pull_requests", 0)
+                grid["join"] = point
+            except Exception as e:  # noqa: BLE001 - completion-gated
+                grid["join"] = {"completed": False,
+                                "error": str(e)[:300]}
+        return grid
+
+    elastic_grid = _elastic_arms()
+
     # resolved JAX backend stamp (satellite): probed in a SUBPROCESS so
     # the driver never grabs the TPU out from under a worker (libtpu is
     # exclusive per process) — ci/bench_regression.py refuses to
@@ -724,6 +847,7 @@ def main() -> int:
         "rebalance_3proc": rebalance_grid,
         "trace_overhead_3proc": trace_grid,
         "pull_storm_3proc": storm_grid,
+        "elastic_membership_3proc": elastic_grid,
     }))
     return 0
 

@@ -42,7 +42,13 @@ sweep: the replicas-on arm must beat the off arm on read rows/sec and
 median latency with replicas actually engaged (p99 inside a slack
 band — the tail is scheduler noise on the CI container), zero reads
 may violate the staleness bound, and the admission-throttled arm must
-complete via explicit refusal, never a timeout poison. Artifacts also
+complete via explicit refusal, never a timeout poison.
+``elastic_tripwires`` (ELASTIC-DEAD/ELASTIC-JOIN) guards the
+``elastic_membership_3proc`` sweep: the seeded-SIGKILL arm's
+survivors must complete with >= 1 range restored from the elastic
+checkpoint, zero unrecovered frames, finite loss and bitwise-agreeing
+finals, and the standby-admission arm must complete with the joiner
+serving > 0 rows. Artifacts also
 carry a resolved ``jax_backend`` stamp, and the gate REFUSES to
 compare artifacts across backends (cross-backend rates differ by
 integer factors; re-base instead).
@@ -428,6 +434,79 @@ def serve_tripwires(new: dict) -> list[str]:
     return problems
 
 
+def elastic_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``elastic_membership_3proc``
+    sweep (balance/membership.py); vacuous when the sweep is absent.
+    All three arms are COMPLETION gates — their rates live under
+    gate-invisible keys (``steps_per_sec_elastic``) like every chaos
+    arm's, so none enters the run-to-run ±10% comparison.
+
+    - ELASTIC-DEAD: the seeded-SIGKILL arm's survivors must COMPLETE
+      with >= 1 range restored from the elastic checkpoint, zero
+      unrecovered frames, a finite final loss, and bitwise-agreeing
+      finals — a kill that survives without restoring anything means
+      the death path silently fell off, and one that restores but
+      diverges means the fence/restore protocol is torn.
+    - ELASTIC-JOIN: the standby-admission arm must COMPLETE with the
+      joiner serving > 0 rows — a join that 'works' while the joiner
+      owns nothing is the silently-disabled failure mode of the admit
+      plan.
+    - The steady (armed-idle) arm must complete cleanly: the plane may
+      not tax correctness when nothing joins or leaves."""
+    grid = new.get("elastic_membership_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    steady = grid.get("steady") or {}
+    if not steady.get("completed"):
+        problems.append(
+            f"ELASTIC-DEAD elastic_membership_3proc/steady: completed="
+            f"{steady.get('completed')!r} — an armed-but-idle fleet "
+            "must complete cleanly")
+    kill = grid.get("kill") or {}
+    if not kill.get("completed"):
+        problems.append(
+            f"ELASTIC-DEAD elastic_membership_3proc/kill: completed="
+            f"{kill.get('completed')!r} — the seeded-SIGKILL arm's "
+            "survivors must finish the run (death should degrade to "
+            "reduced capacity, not a poisoned job)")
+    else:
+        if not kill.get("blocks_restored"):
+            problems.append(
+                "ELASTIC-DEAD elastic_membership_3proc/kill: 0 ranges "
+                "restored from the elastic checkpoint — the death "
+                "path is silently disabled")
+        if kill.get("wire_frames_lost", 0):
+            problems.append(
+                f"ELASTIC-DEAD elastic_membership_3proc/kill: "
+                f"{kill['wire_frames_lost']} unrecovered frames — the "
+                "transition is leaking wire loss")
+        loss = kill.get("loss_last")
+        if not (isinstance(loss, (int, float))
+                and loss == loss and abs(loss) != float("inf")):
+            problems.append(
+                f"ELASTIC-DEAD elastic_membership_3proc/kill: final "
+                f"loss {loss!r} is not finite — the restored state is "
+                "poisoning training")
+        if not kill.get("finals_agree"):
+            problems.append(
+                "ELASTIC-DEAD elastic_membership_3proc/kill: "
+                "survivors' final tables disagree — the restore/fence "
+                "protocol is torn")
+    join = grid.get("join") or {}
+    if not join.get("completed"):
+        problems.append(
+            f"ELASTIC-JOIN elastic_membership_3proc/join: completed="
+            f"{join.get('completed')!r} — the standby-admission arm "
+            "must finish with the joiner in the fleet")
+    elif not join.get("joiner_serve_rows"):
+        problems.append(
+            "ELASTIC-JOIN elastic_membership_3proc/join: the joiner "
+            "served 0 rows — it was admitted but owns nothing (the "
+            "admit plan is silently disabled)")
+    return problems
+
+
 def backend_mismatch(prior: dict, new: dict) -> list[str]:
     """Refuse to compare artifacts measured on different JAX backends
     (satellite): the r03-r05 ``cpu-fallback(tpu-unresponsive)`` runs
@@ -523,7 +602,7 @@ def main(argv: list[str] | None = None) -> int:
                 + cache_tripwires(new) + chaos_tripwires(new)
                 + transport_tripwires(new)
                 + rebalance_tripwires(new) + trace_tripwires(new)
-                + serve_tripwires(new))
+                + serve_tripwires(new) + elastic_tripwires(new))
     pts = throughput_points(new)
     print(f"bench-regression: {len(pts)} throughput points checked "
           f"against {len(throughput_points(prior))} prior")

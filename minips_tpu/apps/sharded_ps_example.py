@@ -57,6 +57,17 @@ def main(argv=None) -> int:
     ap.add_argument("--jitter-prob", type=float, default=0.2)
     ap.add_argument("--kill-at", type=int, default=0)
     ap.add_argument("--kill-rank", type=int, default=-1)
+    ap.add_argument("--join-at", type=int, default=None,
+                    help="elastic membership (MINIPS_ELASTIC with this "
+                         "rank standby): announce the join once the "
+                         "live fleet's clock reaches this step "
+                         "(default: announce immediately)")
+    ap.add_argument("--drain-at", type=int, default=0,
+                    help="elastic membership: --drain-rank initiates a "
+                         "graceful leave at this iteration (SIGTERM "
+                         "and the mbDr control frame trigger the same "
+                         "path)")
+    ap.add_argument("--drain-rank", type=int, default=-1)
     ap.add_argument("--checkpoint-dir", default=None,
                     help="per-rank shard checkpoints under "
                          "<dir>/rank<r>/; on start, ranks negotiate the "
@@ -106,6 +117,27 @@ def main(argv=None) -> int:
     trainer = ShardedPSTrainer({"w": table}, bus, nprocs,
                                staleness=staleness, gate_timeout=30.0,
                                monitor=monitor)
+    # elastic membership (MINIPS_ELASTIC, balance/membership.py): bind
+    # the death path's checkpoint dir and the preemption signal before
+    # any traffic — SIGTERM and the mbDr control frame both drain
+    mb = trainer.membership
+    if mb is not None:
+        if mb.standby and args.model == "dense":
+            # dense pull_all assembles whole shards from LIVE ranks: a
+            # standby's home range is covered only once the bootstrap
+            # plan lands, and the dense loop reads before the first
+            # tick — refuse loudly instead of assembling torn rows
+            print(json.dumps({
+                "rank": rank, "event": "error",
+                "err": "MINIPS_ELASTIC with standby ranks requires "
+                       "--model sparse (dense pull_all reads before "
+                       "the bootstrap migration lands)"}), flush=True)
+            return 2
+        import signal as _signal
+
+        mb.bind_checkpoint(args.checkpoint_dir)
+        _signal.signal(_signal.SIGTERM,
+                       lambda *_a: mb.begin_drain())
     # shard checkpoint/resume (reference Dump/Load, SURVEY.md §3.5): the
     # whole negotiate→prune→restore→rendezvous protocol lives in
     # apps.common.shard_checkpointing, shared with the flagship W&D app
@@ -113,6 +145,10 @@ def main(argv=None) -> int:
     bus.handshake(nprocs)  # after ALL handlers are registered
     start_iter, save_hook = resume({"w": table, "trainer": trainer},
                                    args.checkpoint_every)
+    if mb is not None and mb.i_am_standby:
+        # standby rank: serve (bus threads) and adopt plans until the
+        # fleet admits me; train from the catch-up clock it hands over
+        start_iter = mb.standby_loop(args.join_at)
 
     if sparse:
         @jax.jit
@@ -153,6 +189,16 @@ def main(argv=None) -> int:
         for i in range(start_iter, args.iters):
             if args.kill_at and rank == args.kill_rank and i == args.kill_at:
                 os._exit(137)
+            if mb is not None and (mb.draining or (
+                    args.drain_at and rank == args.drain_rank
+                    and i == args.drain_at)):
+                # graceful leave: stop training, hand my blocks to
+                # survivors under the fence, exit clean (rc 0) — the
+                # done line below says "drained", never "done"
+                if ahead[2] is not None:
+                    ahead[2].cancel()
+                mb.leave()
+                return
             if sparse:
                 if args.overlap and args.overlap_legs != "push":
                     if ahead[2] is None:  # first batch: nothing in flight
@@ -205,7 +251,23 @@ def main(argv=None) -> int:
         trainer.shutdown_barrier(timeout=10.0)
 
     code = run_multiproc_body(rank, trainer, body)
-    if code == 0:
+    drained = mb is not None and rank in mb.left
+    if code == 0 and drained:
+        # the graceful-leave exit line: rc 0, zero restored state, no
+        # finalize (the survivors quiesce among themselves)
+        print(json.dumps({
+            "rank": rank, "event": "drained",
+            "wall_s": round(time.monotonic() - t0, 4),
+            "loss_last": (float(np.mean(losses[-5:]))
+                          if losses else None),
+            "clock": trainer.clock,
+            "elastic_spec": os.environ.get("MINIPS_ELASTIC") or None,
+            "membership": trainer.membership_stats(),
+            "frames_dropped": trainer.frames_dropped,
+            "wire_frames_lost": trainer.wire_frames_lost,
+            "resumed_from": start_iter,
+        }), flush=True)
+    elif code == 0:
         from minips_tpu.train.sharded_ps import table_state_bytes
         table_bytes = table_state_bytes(num_rows, 1, args.updater)
         print(json.dumps({
@@ -226,6 +288,11 @@ def main(argv=None) -> int:
             # rebalancer echo (env-configured): wire_record below
             # carries the serve/rebalance counter blocks themselves
             "rebalance_spec": os.environ.get("MINIPS_REBALANCE") or None,
+            # elastic membership echo + chaos-kill spec: the drills
+            # assert the arm they think they ran really ran
+            "elastic_spec": os.environ.get("MINIPS_ELASTIC") or None,
+            "chaos_kill_spec": os.environ.get("MINIPS_CHAOS_KILL")
+            or None,
             "wall_s": round(time.monotonic() - t0, 4),
             "loss_first": losses[0] if losses else None,
             "loss_last": float(np.mean(losses[-5:])) if losses else None,

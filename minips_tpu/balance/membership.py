@@ -1,0 +1,715 @@
+"""Elastic membership — server ranks join and leave a LIVE job.
+
+The reference MiniPs lineage answers a dead server with detect-and-
+restart: the whole gang dies and resumes from the last checkpoint
+(PARITY.md failure-model rows). This module is the production
+alternative the roadmap names (item 3): PR3's reliable delivery plus
+PR4's epoch-fenced key-range migration are 80% of online resharding —
+the membership state machine here is the remaining 20%, composing them
+into a training SERVICE that survives preemptible fleets. Loss of a
+rank degrades to latency and reduced capacity, never to a poisoned run.
+
+Armed by ``MINIPS_ELASTIC`` (off by default — armed-but-idle is pinned
+bitwise-equal to off by the lockstep drill). The world is launched at a
+fixed ADDRESS SPACE of ``num_processes`` bus slots; membership is which
+slots are LIVE. Three transitions, all riding the existing machinery:
+
+**Join.** A rank configured standby (``MINIPS_ELASTIC="live=0-2"`` in a
+4-slot world makes rank 3 a standby) connects and handshakes like
+everyone, but is EXCLUDED from clock gossip (its idle clock must not
+gate the fleet) and trains nothing. The coordinator's bootstrap plan —
+a normal epoch-fenced migration at step ~0 — moves the standby's home
+blocks onto live ranks (the standby ships its freshly-initialized
+state via ``rbS``, so seeded init survives). When the standby announces
+(``mbJ`` — at its configured join step, or whenever its operator says),
+the coordinator admits it at a routing-epoch boundary: ``mbA`` carries
+the catch-up clock, the admit plan returns the joiner's home blocks to
+it (rows + optimizer state hand off under the existing rbS/rbA/rbF
+fence — the SSP bound holds mid-join exactly as it does mid-migration),
+the joiner publishes the catch-up clock and THEN its live announce
+(``mbL``, same FIFO link — so every rank re-includes it in gossip only
+after a current clock is stored; including a clock-0 ghost would wedge
+every gate), and trains from there.
+
+**Leave (graceful).** A rank receiving a preemption signal (SIGTERM, a
+``mbDr`` control frame, or the drill's ``--drain-at``) stops training,
+hard-drains its in-flight pushes, publishes the RETIRED clock sentinel
+(gates never wait on it again), and asks the coordinator to plan it out
+(``mbQ``, refreshed with its settle state — the coordinator plans only
+over a settled leaver, the one real precondition of the fence
+protocol). The leave plan is a normal migration: the leaver SHIPS its
+owned blocks to survivors and releases fences only after every live
+rank's adoption ack — per-link FIFO then guarantees no frame addressed
+to the leaver is still in flight when it announces ``mbG`` and exits
+clean: rc 0, zero restored state, zero poisons.
+
+**Death (ungraceful).** When the ``HeartbeatMonitor`` declares a rank
+dead, every rank immediately excludes it from gossip (the SSP gate
+recomputes over the shrunken membership — a corpse cannot hold the
+clock hostage) and unjams waits aimed at it (push windows drop their
+unacked seqs, counted). The coordinator picks the newest checkpoint
+step every rank holds under the current partition
+(``ckpt/elastic.find_live_step``) and broadcasts a DEATH plan: the
+corpse's owned blocks re-home onto survivors with the plan's ``dead``
+extras, and each new owner installs ``ckpt/elastic.load_block_state``
+— which reads THROUGH the save-time rebalance overlay — instead of
+waiting for an rbS no corpse will send. Restored blocks serve
+un-fenced: no stale push can be forwarded from a corpse, so the fence
+would protect nothing; the recovery semantics are exactly "that rank's
+ranges roll back to the last checkpoint". Workers re-route refused or
+orphaned legs via the existing ``psE``/resend machinery; replicas on
+the dead rank demote by lease expiry (PR6). A death the plane CANNOT
+own — no checkpoint anywhere, a dead coordinator, a verdict that never
+arrives within the grace window — stays exactly as loud as before:
+``PeerFailureError``, exit 42, the gang-restart drill.
+
+Spec grammar (``$MINIPS_ELASTIC``)::
+
+    1                        # armed, all ranks live (idle plane)
+    live=0-2                 # ranks 0..2 live, the rest standby
+    live=0+2,grace=20        # '+'-separated list; death-verdict grace
+
+Knob table: docs/fault_tolerance.md "The membership ladder".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from minips_tpu.consistency.gate import PeerFailureError, publish_clock
+from minips_tpu.obs import tracer as _trc
+
+__all__ = ["MembershipConfig", "Membership", "plan_evacuation",
+           "plan_admission"]
+
+
+def _parse_ranks(val: str) -> set[int]:
+    out: set[int] = set()
+    for part in filter(None, (p.strip() for p in val.split("+"))):
+        lo, dash, hi = part.partition("-")
+        if dash:
+            out.update(range(int(lo), int(hi) + 1))
+        else:
+            out.add(int(lo))
+    return out
+
+
+class MembershipConfig:
+    """Parsed ``MINIPS_ELASTIC`` knobs (``k=v`` comma list; the bare
+    string ``"1"`` arms the plane with every rank live)."""
+
+    def __init__(self, *, live: Optional[set[int]] = None,
+                 grace: float = 15.0):
+        if grace <= 0:
+            raise ValueError("grace must be > 0 seconds")
+        self.live = None if live is None else {int(r) for r in live}
+        self.grace = float(grace)  # death-verdict wait before poisoning
+
+    @classmethod
+    def parse(cls, spec: str) -> "MembershipConfig":
+        spec = (spec or "").strip()
+        if spec in ("", "1", "on", "true"):
+            return cls()
+        kw: dict = {}
+        for item in filter(None, (e.strip() for e in spec.split(","))):
+            if "=" not in item:
+                raise ValueError(
+                    f"MINIPS_ELASTIC: expected k=v, got {item!r}")
+            k, _, v = item.partition("=")
+            k = k.strip()
+            if k == "live":
+                kw["live"] = _parse_ranks(v)
+            elif k == "grace":
+                try:
+                    kw["grace"] = float(v)
+                except ValueError as e:
+                    raise ValueError(
+                        f"MINIPS_ELASTIC: bad value for grace: "
+                        f"{v!r}") from e
+            else:
+                raise ValueError(
+                    f"MINIPS_ELASTIC: unknown knob {k!r}")
+        return cls(**kw)
+
+
+def plan_evacuation(router, victims: set[int],
+                    targets: list[int]) -> dict[int, int]:
+    """New FULL overlay with every block currently owned by a rank in
+    ``victims`` re-homed round-robin onto ``targets`` — the leave /
+    death / bootstrap planner (pure, deterministic: every rank handed
+    the same router state computes the same table). A block whose
+    round-robin slot IS its home rank leaves the overlay (home blocks
+    must be absent, BlockRouter.apply's invariant)."""
+    if not targets:
+        raise ValueError("plan_evacuation: no live targets left")
+    _ep, ov = router.table()
+    owner = router.owner_of_blocks()
+    new_ov = {int(b): int(o) for b, o in ov.items()
+              if int(o) not in victims}
+    vb = sorted(int(b) for v in victims
+                for b in np.nonzero(owner == v)[0])
+    for i, b in enumerate(vb):
+        dst = int(targets[i % len(targets)])
+        if dst == router.home_of(b):
+            new_ov.pop(b, None)
+        else:
+            new_ov[b] = dst
+    return new_ov
+
+
+def plan_admission(router, joiner: int) -> dict[int, int]:
+    """New FULL overlay admitting ``joiner``: its home blocks return
+    home (their interim owners ship state under the normal fence);
+    everything else keeps its current assignment."""
+    _ep, ov = router.table()
+    return {int(b): int(o) for b, o in ov.items()
+            if router.home_of(int(b)) != joiner and int(o) != joiner}
+
+
+class Membership:
+    """The membership state machine riding a ShardedPSTrainer — module
+    docstring for the protocol. One instance per process; rank 0 is the
+    coordinator (its death is the documented unrecoverable case)."""
+
+    JOIN_KIND = "mbJ"     # standby -> coordinator: admit me
+    ADMIT_KIND = "mbA"    # coordinator broadcast: rank + catch-up clock
+    LIVE_KIND = "mbL"     # joiner broadcast: include me (clock published)
+    LEAVE_KIND = "mbQ"    # leaver -> coordinator: plan me out (+settle)
+    GONE_KIND = "mbG"     # leaver broadcast: fences done, exiting clean
+    DEATH_KIND = "mbD"    # coordinator broadcast: verdict (rstep | -1)
+    DRAIN_KIND = "mbDr"   # operator -> rank: please drain (the --drain
+    #                       control frame; SIGTERM is the other trigger)
+
+    def __init__(self, trainer, cfg: MembershipConfig):
+        self.trainer = trainer
+        self.cfg = cfg
+        self.bus = trainer.bus
+        self.rank = int(trainer.bus.my_id)
+        self.n = int(trainer.num_processes)
+        self.coord = 0
+        self.rb = trainer.rebalancer
+        if self.rb is None:
+            raise RuntimeError(
+                "elastic membership needs the rebalancer machinery "
+                "(the trainer arms it when MINIPS_ELASTIC is set)")
+        all_ranks = set(range(self.n))
+        live = all_ranks if cfg.live is None else set(cfg.live) & all_ranks
+        if self.coord not in live:
+            raise ValueError(
+                "MINIPS_ELASTIC: rank 0 (the membership coordinator) "
+                "must be in the initial live set")
+        self._lock = threading.Lock()
+        self.live: set[int] = set(live)
+        self.standby: set[int] = all_ranks - live
+        self.dead: set[int] = set()
+        self.left: set[int] = set()
+        self._unrecoverable: set[int] = set()
+        self._death_t: dict[int, float] = {}   # rank -> detection time
+        self._verdicts: dict[int, int] = {}    # rank -> rstep (-1 bad)
+        self._pending_deaths: list[int] = []   # coordinator queue
+        self._pending_joins: list[int] = []    # coordinator queue
+        self._leave_reqs: dict[int, dict] = {}  # rank -> latest mbQ
+        self._bootstrapped = not self.standby
+        self._admit_clk: Optional[int] = None  # my mbA, standby side
+        self._drain = False
+        self._last_join_tx = 0.0
+        self._ckpt_dir: Optional[str] = None
+        self.counters = {"joins": 0, "leaves": 0, "deaths": 0,
+                         "plans": 0}
+        # standbys are OUT of every rank's gossip view from the first
+        # frame (their clocks sit at 0 and must gate nobody — the
+        # joiner re-enters via include() after its catch-up publish)
+        for s in self.standby:
+            trainer.gossip.exclude(s)
+        # death detection hook: the monitor's sweep thread fires this
+        # the moment a peer's silence crosses the timeout
+        if trainer.monitor is not None:
+            trainer.monitor.on_failure = self._on_peer_dead
+        bus = self.bus
+        bus.on(self.JOIN_KIND, self._on_join_req)
+        bus.on(self.ADMIT_KIND, self._on_admit)
+        bus.on(self.LIVE_KIND, self._on_live)
+        bus.on(self.LEAVE_KIND, self._on_leave_req)
+        bus.on(self.GONE_KIND, self._on_gone)
+        bus.on(self.DEATH_KIND, self._on_death_verdict)
+        bus.on(self.DRAIN_KIND, lambda _s, _p: self.begin_drain())
+
+    # ------------------------------------------------------------- plumbing
+    def bind_checkpoint(self, checkpoint_dir: Optional[str]) -> None:
+        """Point the death path at the shared elastic checkpoint dir
+        (the app knows it; the trainer doesn't). Without one, death
+        stays the reference's gang-restart failure."""
+        self._ckpt_dir = checkpoint_dir or None
+
+    @property
+    def i_am_standby(self) -> bool:
+        with self._lock:
+            return self.rank in self.standby
+
+    @property
+    def busy(self) -> bool:
+        """A membership transition is queued or mid-flight — the heat
+        planner yields (one planner stream at a time)."""
+        with self._lock:
+            return bool(self._pending_deaths or self._pending_joins
+                        or self._leave_reqs or not self._bootstrapped)
+
+    def membership_epoch(self) -> int:
+        """Max routing epoch across tables — the 'versioned membership
+        epoch' observability stamp (every transition bumps it)."""
+        return max((t.router.epoch
+                    for t in self.trainer.tables.values()), default=0)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {"live": sorted(self.live),
+                   "standby": sorted(self.standby),
+                   "dead": sorted(self.dead),
+                   "left": sorted(self.left),
+                   **self.counters}
+        out["epoch"] = self.membership_epoch()
+        out["blocks_restored"] = sum(
+            t.rb_stats["blocks_restored"]
+            for t in self.trainer.tables.values())
+        out["pushes_lost_to_dead"] = sum(
+            t.rb_stats["pushes_lost_to_dead"]
+            for t in self.trainer.tables.values())
+        return out
+
+    def _live_targets(self, exclude: set[int] = frozenset()) -> list:
+        with self._lock:
+            return sorted(self.live - set(exclude))
+
+    # --------------------------------------------------------------- death
+    def _on_peer_dead(self, r: int) -> None:
+        """Monitor verdict (heartbeat thread): exclude NOW — the gate
+        must recompute over the shrunken membership immediately — and
+        unjam every wait aimed at the corpse. The plan (or the
+        unrecoverable verdict) follows from the coordinator."""
+        # the free-vs-planned verdict keys on OWNERSHIP, not membership
+        # category: a standby normally owns nothing (bootstrap moved
+        # its home range away) — but a PRE-bootstrap standby or a
+        # mid-admission joiner does own blocks, and skipping its death
+        # plan would strand those ranges on a corpse forever
+        owns = any((t.router.owner_of_blocks() == r).any()
+                   for t in self.trainer.tables.values())
+        free = False
+        with self._lock:
+            if r in self.dead or r in self.left:
+                return
+            self.dead.add(r)
+            self.live.discard(r)
+            self.standby.discard(r)
+            self._death_t[r] = time.monotonic()
+            self.counters["deaths"] += 1
+            self._pending_joins = [j for j in self._pending_joins
+                                   if j != r]
+            # a leaver that died mid-drain must not leave a stale
+            # request pinning `busy` (and pausing the heat planner)
+            # for the rest of the run
+            self._leave_reqs.pop(r, None)
+            if r == self.coord:
+                # the coordinator is the planner: nobody can issue the
+                # transition. Documented limit — gang restart.
+                self._unrecoverable.add(r)
+            elif not owns:
+                # nothing routed to it, gated nobody: death is free
+                self._verdicts[r] = 0
+                free = True
+            elif self.rank == self.coord:
+                self._pending_deaths.append(r)
+        if free and self.rank == self.coord:
+            # converge laggards whose tables still route to the corpse
+            # (mid-adoption views): rstep 0 = free verdict, no plan
+            self.bus.publish(self.DEATH_KIND,
+                             {"rank": int(r), "rstep": 0})
+        self.trainer.gossip.exclude(r)
+        for t in self.trainer.tables.values():
+            t.on_ranks_dead({r})
+        tr = _trc.TRACER
+        if tr is not None:
+            tr.instant("membership", "mb_dead", {"rank": int(r)})
+
+    def _on_death_verdict(self, sender: int, payload: dict) -> None:
+        r, rstep = int(payload.get("rank", -1)), int(
+            payload.get("rstep", -1))
+        with self._lock:
+            self._verdicts[r] = rstep
+            if rstep < 0:
+                self._unrecoverable.add(r)
+
+    def fatal_dead(self, dead: set[int]) -> set[int]:
+        """The subset of monitor-dead ranks that must still POISON a
+        wait. Survivable: a completed leave, a dead standby, a live
+        death whose transition is planned or pending within the grace
+        window. Fatal: an unrecoverable verdict (no checkpoint / dead
+        coordinator), or a verdict that never arrived in time."""
+        fatal: set[int] = set()
+        now = time.monotonic()
+        for r in set(dead):
+            with self._lock:
+                if r in self._unrecoverable:
+                    fatal.add(r)
+                    continue
+                known = r in self.dead or r in self.left
+                has_verdict = r in self._verdicts
+                t0 = self._death_t.get(r, now)
+            if not known:
+                # monitor saw it before our hook did (foreign monitor
+                # instance): register and re-judge next check
+                self._on_peer_dead(r)
+                continue
+            if r in self.left or has_verdict:
+                continue
+            if now - t0 > self.cfg.grace:
+                fatal.add(r)  # no verdict came: stop limping, restart
+        return fatal
+
+    def block_restorer(self, name: str, extras: dict):
+        """The per-table restore closure a death plan's adoption runs
+        (train/sharded_ps.adopt_table): block -> checkpoint state read
+        through the save-time overlay (ckpt/elastic.load_block_state).
+        Returns None when the plan carries no usable step (adoption
+        then poisons loudly — a survivable death always carries one)."""
+        step = int(extras.get("rstep", -1))
+        ckpt = self._ckpt_dir
+        if step < 0 or not ckpt:
+            return None
+        t = self.trainer.tables[name]
+        # shared across one adoption's restores: a dead rank's B-block
+        # restore must load each shard file once, not B times (the
+        # loads run under the table's locks)
+        npz_cache: dict[int, dict] = {}
+
+        def restore(b: int) -> dict:
+            from minips_tpu.ckpt import elastic
+
+            blo, bln = t.router.block_span(b)
+            return elastic.load_block_state(
+                ckpt, step, name, b, blo, bln, t.router.home_of(b),
+                t.part.shard_size, t.router.block_size,
+                cache=npz_cache)
+        return restore
+
+    # ---------------------------------------------------------------- join
+    def _on_join_req(self, sender: int, payload: dict) -> None:
+        r = int(payload.get("rank", sender))
+        with self._lock:
+            if (self.rank == self.coord and r in self.standby
+                    and r not in self._pending_joins):
+                self._pending_joins.append(r)
+
+    def _on_admit(self, sender: int, payload: dict) -> None:
+        if int(payload.get("rank", -1)) == self.rank:
+            self._admit_clk = int(payload.get("clk", 0))
+
+    def _on_live(self, sender: int, payload: dict) -> None:
+        r = int(payload.get("rank", sender))
+        with self._lock:
+            self.standby.discard(r)
+            self.live.add(r)
+            if self.rank == self.coord:
+                self.counters["joins"] += 1
+        # include AFTER its catch-up clock (same link, FIFO: the clock
+        # frame precedes this announce) — gossip now gates on it
+        self.trainer.gossip.include(r)
+        tr = _trc.TRACER
+        if tr is not None:
+            tr.instant("membership", "mb_live", {"rank": int(r)})
+
+    def standby_loop(self, join_at: Optional[int] = None, *,
+                     poll: float = 0.05,
+                     timeout: float = 600.0) -> int:
+        """The standby rank's whole pre-join life: serve (bus threads),
+        adopt plans, announce at ``join_at`` (max live clock observed
+        via gossip; None = announce immediately), block until admitted.
+        Returns the catch-up clock to train from."""
+        deadline = time.monotonic() + timeout
+        while True:
+            self.rb.adopt_now()  # pre-tick: any thread may adopt
+            with self._lock:
+                if self._unrecoverable:
+                    raise PeerFailureError(set(self._unrecoverable))
+            if self._admit_clk is not None:
+                break
+            if self._join_due(join_at) \
+                    and time.monotonic() - self._last_join_tx > 0.5:
+                # repeat until admitted: the announce may race the
+                # coordinator's handler registration or simply drop
+                self.bus.send(self.coord, self.JOIN_KIND,
+                              {"rank": self.rank})
+                self._last_join_tx = time.monotonic()
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"standby rank {self.rank}: never admitted")
+            time.sleep(poll)
+        clk = int(self._admit_clk)
+        tr = self.trainer
+        tr.clock = clk
+        tr.gated_clock = clk
+        # ORDER IS THE PROTOCOL: catch-up clock first, live announce
+        # second, same FIFO link — every rank stores the clock before
+        # it re-includes me, so the gate never sees a clock-0 ghost
+        publish_clock(tr.gossip, clk, False)
+        tr.gossip.include(self.rank)
+        with self._lock:
+            self.standby.discard(self.rank)
+            self.live.add(self.rank)
+        self.bus.publish(self.LIVE_KIND, {"rank": self.rank})
+        self.rb.adopt_now()  # the admit plan may already be pending
+        if _trc.TRACER is not None:
+            _trc.TRACER.instant("membership", "mb_join",
+                                {"rank": self.rank, "clk": clk})
+        return clk
+
+    def _join_due(self, join_at: Optional[int]) -> bool:
+        if join_at is None:
+            return True
+        snap = self.trainer.gossip.snapshot()
+        with self._lock:
+            live = set(self.live)
+        mx = max((max(v) for p, v in snap.items()
+                  if v and p in live), default=0)
+        return mx >= int(join_at)
+
+    # --------------------------------------------------------------- leave
+    def begin_drain(self) -> None:
+        """Preemption signal landed (SIGTERM / mbDr / --drain-at): the
+        training loop polls ``draining`` and hands over to leave()."""
+        self._drain = True
+
+    @property
+    def draining(self) -> bool:
+        return self._drain
+
+    def _on_leave_req(self, sender: int, payload: dict) -> None:
+        if self.rank != self.coord:
+            return
+        r = int(payload.get("rank", sender))
+        with self._lock:
+            if r in self.live and r != self.coord:
+                self._leave_reqs[r] = dict(payload)
+
+    def _on_gone(self, sender: int, payload: dict) -> None:
+        r = int(payload.get("rank", sender))
+        with self._lock:
+            if r not in self.live and r not in self.standby:
+                return
+            self.live.discard(r)
+            self.standby.discard(r)
+            self.left.add(r)
+            self._leave_reqs.pop(r, None)
+            if self.rank == self.coord:
+                self.counters["leaves"] += 1
+        # the leaver published RETIRED before mbG; exclusion is the
+        # belt-and-braces half (finalize/pull_all live sets, fence acks)
+        self.trainer.gossip.exclude(r)
+        tr = _trc.TRACER
+        if tr is not None:
+            tr.instant("membership", "mb_gone", {"rank": int(r)})
+
+    def leave(self, timeout: float = 60.0) -> None:
+        """Graceful exit of THIS rank (after its training loop broke on
+        ``draining``): drain pushes, retire my clock, keep serving and
+        re-asking the coordinator until every block I own has handed
+        off and my fences released, then announce gone. Zero restored
+        state anywhere — this is a migration, not a failure."""
+        if self.rank == self.coord:
+            raise RuntimeError(
+                "the membership coordinator (rank 0) cannot drain — "
+                "it is the planner (documented limit; restart instead)")
+        tr = self.trainer
+        self.rb.claim_drive_thread()  # adoption moves to THIS thread
+        for t in tr.tables.values():
+            t.flush_pushes()  # hard drain: owners hold all my updates
+            t.check_fatal()
+        # retire: gates and owner-side admission never wait on me again
+        publish_clock(tr.gossip, tr.clock, True)
+        deadline = time.monotonic() + timeout
+        last_tx = 0.0
+        while True:
+            self.rb.adopt_now()
+            with self._lock:
+                if self._unrecoverable:
+                    raise PeerFailureError(set(self._unrecoverable))
+            done = all(
+                not (t.router.owner_of_blocks() == self.rank).any()
+                and t.rebalance_settled()
+                for t in tr.tables.values())
+            if done:
+                break
+            if time.monotonic() - last_tx > 0.25:
+                self.bus.send(self.coord, self.LEAVE_KIND, {
+                    "rank": self.rank,
+                    "eps": {name: t.router.epoch
+                            for name, t in tr.tables.items()},
+                    "settled": all(t.rebalance_settled()
+                                   for t in tr.tables.values())})
+                last_tx = time.monotonic()
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"drain of rank {self.rank}: blocks never handed "
+                    "off (coordinator mute, or fleet fences stuck)")
+            time.sleep(0.05)
+        with self._lock:
+            self.live.discard(self.rank)
+            self.left.add(self.rank)
+        self.bus.publish(self.GONE_KIND, {"rank": self.rank})
+        if _trc.TRACER is not None:
+            _trc.TRACER.instant("membership", "mb_leave",
+                                {"rank": self.rank})
+        # grace: my fences are released (rbF sent), so per-link FIFO
+        # says every frame addressed to me has arrived — this sleep
+        # only covers the mbG fan-out itself
+        time.sleep(0.25)
+
+    # ------------------------------------------------------ the tick hook
+    def on_tick(self) -> None:
+        """Called from ShardedPSTrainer.tick at the clock boundary,
+        BEFORE the rebalancer's adoption point (a plan issued here is
+        adopted in the same tick). Every rank: raise on unrecoverable
+        deaths. Coordinator: run the transition queues."""
+        with self._lock:
+            if self._unrecoverable:
+                raise PeerFailureError(set(self._unrecoverable))
+        if self.rank != self.coord:
+            return
+        self._coord_step()
+
+    def poll(self) -> None:
+        """Death transitions from the pull/fence WAIT paths: a
+        coordinator blocked on a corpse-owned pull leg would otherwise
+        wait for its own next tick to issue the very plan that unblocks
+        it. Runs only on the push-driving thread (the adopt_now rule —
+        plan issuance adopts locally) and only handles deaths:
+        joins/leaves/bootstrap can wait for a real clock boundary."""
+        if self.rank != self.coord:
+            return
+        drive = self.rb._drive_thread
+        if drive is not None and drive != threading.get_ident():
+            return
+        while True:
+            with self._lock:
+                if not self._pending_deaths:
+                    return
+                r = self._pending_deaths.pop(0)
+            self._issue_death(r)
+
+    def quiesce(self) -> None:
+        """Finalize-time: no further transitions (in-flight migrations
+        settle through the normal fence path)."""
+        with self._lock:
+            self._pending_deaths.clear()
+            self._pending_joins.clear()
+            self._leave_reqs.clear()
+            self._bootstrapped = True
+
+    def _next_eps(self) -> dict[str, int]:
+        return {name: t.router.epoch + 1
+                for name, t in self.trainer.tables.items()}
+
+    def _issue(self, overlays: dict[str, dict],
+               extras: Optional[dict] = None) -> None:
+        for name, t in self.trainer.tables.items():
+            self.rb.issue_plan(name, t.router.epoch + 1,
+                               overlays[name], extras=extras)
+        with self._lock:
+            self.counters["plans"] += 1
+
+    def _coord_step(self) -> None:
+        tables = self.trainer.tables
+        # -------- bootstrap: standby home ranges onto the live set
+        # (a normal migration at the first boundary — standbys are live
+        # SERVERS until it lands, so their seeded init ships via rbS)
+        with self._lock:
+            boot_needed = not self._bootstrapped
+            standby = set(self.standby)
+        if boot_needed:
+            targets = self._live_targets()
+            self._issue({name: plan_evacuation(t.router, standby,
+                                               targets)
+                         for name, t in tables.items()})
+            with self._lock:
+                self._bootstrapped = True
+            return  # one transition per boundary
+        # -------- deaths first: a corpse's ranges are unreachable
+        with self._lock:
+            death = self._pending_deaths.pop(0) \
+                if self._pending_deaths else None
+        if death is not None:
+            self._issue_death(death)
+            return
+        # -------- leaves: only over a settled leaver at current epochs
+        with self._lock:
+            leave = next(
+                (r for r, req in self._leave_reqs.items()
+                 if req.get("settled")
+                 and all(int(req.get("eps", {}).get(name, -1))
+                         == t.router.epoch
+                         for name, t in tables.items())), None)
+            if leave is not None:
+                del self._leave_reqs[leave]
+        if leave is not None:
+            targets = self._live_targets(exclude={leave})
+            self._issue({name: plan_evacuation(t.router, {leave},
+                                               targets)
+                         for name, t in tables.items()})
+            return
+        # -------- joins: admit one rank per boundary
+        with self._lock:
+            join = self._pending_joins.pop(0) \
+                if self._pending_joins else None
+            if join is not None and join not in self.standby:
+                join = None  # died (or already admitted) meanwhile
+        if join is not None:
+            # clock first (the joiner trains from it), plans second —
+            # both on my one FIFO link, so the joiner sees them in order
+            self.bus.publish(self.ADMIT_KIND,
+                             {"rank": join, "clk": self.trainer.clock})
+            self._issue({name: plan_admission(t.router, join)
+                         for name, t in tables.items()})
+
+    def _issue_death(self, r: int) -> None:
+        """The death transition: verdict + plan. Unrecoverable (no
+        complete checkpoint, no dir bound) broadcasts ``rstep=-1`` and
+        poisons locally — the honest fallback to gang restart."""
+        from minips_tpu.ckpt import elastic
+
+        step = None
+        if self._ckpt_dir:
+            with self._lock:
+                # live ranks + the corpse must share the step (their
+                # files hold the state); standbys/leavers need not —
+                # a never-checkpointed standby's missing dir must not
+                # veto recovery of somebody else's death
+                required = self.live | {r}
+            try:
+                step = elastic.find_live_step(
+                    self._ckpt_dir, self.trainer.tables, self.n,
+                    required=required)
+            except Exception:  # noqa: BLE001 - scan failure = no step
+                step = None
+        if step is None:
+            self.bus.publish(self.DEATH_KIND,
+                             {"rank": int(r), "rstep": -1})
+            with self._lock:
+                self._verdicts[r] = -1
+                self._unrecoverable.add(r)
+            return
+        targets = self._live_targets()
+        extras = {"dead": [int(r)], "rstep": int(step)}
+        self.bus.publish(self.DEATH_KIND,
+                         {"rank": int(r), "rstep": int(step)})
+        with self._lock:
+            self._verdicts[r] = int(step)
+        self._issue({name: plan_evacuation(t.router, {r}, targets)
+                     for name, t in self.trainer.tables.items()},
+                    extras=extras)
+        tr = _trc.TRACER
+        if tr is not None:
+            tr.instant("membership", "mb_death_plan",
+                       {"rank": int(r), "rstep": int(step)})

@@ -149,9 +149,15 @@ class Rebalancer:
     HEAT_KIND = "rbH"
     PLAN_KIND = "rbP"
 
-    def __init__(self, trainer, cfg: RebalanceConfig):
+    def __init__(self, trainer, cfg: RebalanceConfig, *,
+                 plan_heat: bool = True):
+        """``plan_heat=False`` arms the migration MACHINERY (router,
+        heat accounting, plan adoption, fences) without the heat-driven
+        planner — the elastic membership plane (balance/membership.py)
+        needs the former even when nobody asked for the latter."""
         self.trainer = trainer
         self.cfg = cfg
+        self.plan_heat = bool(plan_heat)
         self.bus = trainer.bus
         self.rank = trainer.bus.my_id
         self.n = trainer.num_processes
@@ -174,21 +180,51 @@ class Rebalancer:
     # ------------------------------------------------------------ handlers
     def _mk_on_plan(self, name: str):
         def on_plan(sender: int, payload: dict) -> None:
+            extras = {k: payload[k] for k in ("dead", "rstep")
+                      if k in payload}
             self.note_plan(name, int(payload.get("ep", 0)),
                            dict(zip(payload.get("ovb", ()),
-                                    payload.get("ovo", ()))))
+                                    payload.get("ovo", ()))),
+                           extras=extras or None)
         return on_plan
 
-    def note_plan(self, name: str, ep: int, ov: dict) -> None:
+    def note_plan(self, name: str, ep: int, ov: dict,
+                  extras: Optional[dict] = None) -> None:
         """Stash a routing table for the table's owner thread to adopt
         at its next clock boundary / pull-wait poll. Adoption NEVER
         happens on the bus receive thread: the adoption ack's ordering
         promise ('my stale pushes all precede it') only holds from the
-        thread that drives pushes."""
+        thread that drives pushes. ``extras`` carry a membership
+        transition's metadata (dead sources + restore step) through to
+        ``adopt_table``."""
         with self._lock:
             cur = self._pending.get(name)
             if cur is None or ep > cur["ep"]:
-                self._pending[name] = {"ep": ep, "ov": dict(ov)}
+                self._pending[name] = {"ep": ep, "ov": dict(ov),
+                                       "extras": extras}
+
+    def issue_plan(self, name: str, ep: int, ov: dict,
+                   extras: Optional[dict] = None) -> None:
+        """Coordinator-side plan broadcast + immediate local adoption —
+        the membership plane's transition emitter (and the one path a
+        plan's extras ride, so death restores dispatch identically at
+        every rank). The caller must be at its clock boundary on the
+        push-driving thread, like ``_maybe_plan``."""
+        payload = {"ep": int(ep), "ovb": [int(b) for b in ov],
+                   "ovo": [int(o) for o in ov.values()]}
+        if extras:
+            payload.update(extras)
+        self.bus.publish(f"{self.PLAN_KIND}:{name}", payload)
+        self.plans += 1
+        self.note_plan(name, ep, ov, extras=extras)
+        self._adopt_one(name, self.trainer.tables[name])
+
+    def claim_drive_thread(self) -> None:
+        """Declare the CALLING thread the push-driving thread (the
+        ``stop()`` rule, without stopping planning): a draining rank's
+        leave loop adopts plans from its own thread after its last
+        tick ran elsewhere."""
+        self._drive_thread = threading.get_ident()
 
     def _mk_on_heat(self, name: str):
         def on_heat(sender: int, payload: dict) -> None:
@@ -258,8 +294,17 @@ class Rebalancer:
     def _adopt_one(self, name: str, t) -> None:
         with self._lock:
             plan = self._pending.pop(name, None)
-        if plan is not None:
-            t.adopt_table(plan["ep"], plan["ov"])
+        if plan is None:
+            return
+        extras = plan.get("extras") or {}
+        dead = frozenset(int(r) for r in extras.get("dead") or ())
+        restore = None
+        if dead:
+            mb = getattr(self.trainer, "membership", None)
+            if mb is not None:
+                restore = mb.block_restorer(name, extras)
+        t.adopt_table(plan["ep"], plan["ov"], dead=dead,
+                      restore=restore)
 
     def _send_heat(self, name: str, t) -> None:
         ep, _ov = t.router.table()
@@ -278,6 +323,14 @@ class Rebalancer:
         return set(range(self.n)) - set(excluded)
 
     def _maybe_plan(self, name: str, t, now: float) -> None:
+        if not self.plan_heat:
+            return
+        mb = getattr(self.trainer, "membership", None)
+        if mb is not None and mb.busy:
+            # a membership transition is in flight: its plan must not
+            # interleave with a heat plan (the planner's one-plan-at-a-
+            # time quality rule; adoption itself tolerates pipelining)
+            return
         last = self._last_plan.get(name, self._t0)
         if now - last < self.cfg.interval:
             return
@@ -349,6 +402,7 @@ class Rebalancer:
         out["tables"] = per
         out["epoch"] = max((p["epoch"] for p in per.values()), default=0)
         for k in ("blocks_in", "blocks_out", "forwarded_pushes",
-                  "refused_pulls", "migrated_rows"):
-            out[k] = sum(p[k] for p in per.values())
+                  "refused_pulls", "migrated_rows", "blocks_restored",
+                  "pushes_lost_to_dead"):
+            out[k] = sum(p.get(k, 0) for p in per.values())
         return out

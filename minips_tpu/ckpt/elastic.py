@@ -151,6 +151,35 @@ def _load_table_npz(checkpoint_dir: str, step: int, old_rank: int,
         return dict(z.items())
 
 
+_META_KEYS = ("lo", "ep", "ovb", "ovo", "rb_block")
+
+
+def saved_overlay(state: dict) -> tuple[int, int, dict[int, int]]:
+    """``(epoch, block_size, {block: owner})`` recorded in one shard's
+    flat state dict — empty when the step was saved unrebalanced. Every
+    rank records the SAME routing table at a settled save boundary, so
+    any one shard file is authoritative for the fleet's overlay."""
+    ep = int(np.asarray(state.get("ep", 0)))
+    if not ep:
+        return 0, 0, {}
+    blk = int(np.asarray(state.get("rb_block", 0)))
+    ov = {int(b): int(o) for b, o in
+          zip(np.asarray(state.get("ovb", np.zeros(0))).tolist(),
+              np.asarray(state.get("ovo", np.zeros(0))).tolist())}
+    return ep, blk, ov
+
+
+def _block_span(old_sz: int, block_size: int, b: int) -> tuple[int, int]:
+    """Global ``(lo, length)`` of block ``b`` under an ``old_sz``-row
+    partition cut into ``block_size``-key blocks — the on-disk twin of
+    ``BlockRouter.block_span`` (blocks are cut per shard, the last block
+    of a shard possibly short)."""
+    bps = -(-old_sz // block_size)
+    shard, loc = divmod(int(b), bps)
+    lo = shard * old_sz + loc * block_size
+    return lo, min(block_size, old_sz - loc * block_size)
+
+
 def reshard_table_state(checkpoint_dir: str, step: int, old_n: int,
                         name: str, num_rows: int, new_lo: int,
                         new_shard_size: int) -> dict[str, np.ndarray]:
@@ -163,19 +192,25 @@ def reshard_table_state(checkpoint_dir: str, step: int, old_n: int,
     shard_size, so only the rows inside ``num_rows`` are real); ``lo`` is
     replaced by the new shard origin; any other leaf must be identical
     across old shards (there are none today — the assert is the tripwire
-    for a future leaf this rule cannot place)."""
+    for a future leaf this rule cannot place).
+
+    A REBALANCED checkpoint (saved routing epoch > 0) reshards through
+    its overlay instead of refusing: the home-slab slices land first
+    (dead copies of moved-out blocks included), then every overlay
+    block's live state — held in its save-time owner's ``xtra`` section
+    — overwrites its span, optimizer leaves alike. The result is the
+    FLATTENED table at the new partition: rows live where the base range
+    map says, no overlay survives the resize (the restored fleet starts
+    at routing epoch 0, consistent because every rank reshards from the
+    same files)."""
     probe = _load_table_npz(checkpoint_dir, step, 0, name)
-    if int(probe.get("ep", np.zeros(()))):
-        # a rebalanced checkpoint's rows are NOT where the range map
-        # says (overlay blocks live in other ranks' xtra sections, home
-        # slab copies of moved-out blocks are dead) — slicing by range
-        # would assemble a silently-torn table
+    saved_ep, saved_blk, saved_ov = saved_overlay(probe)
+    if saved_ep and saved_blk <= 0:
         raise ValueError(
-            f"elastic reshard: step {step} of table {name!r} was saved "
-            f"with a rebalanced routing table (epoch "
-            f"{int(probe['ep'])}); elastic resize cannot place overlay "
-            "blocks — restore at the original world size (with "
-            "MINIPS_REBALANCE armed) first")
+            f"elastic reshard: step {step} of table {name!r} records a "
+            f"rebalanced routing table (epoch {saved_ep}) without its "
+            "block granularity — torn save, overlay blocks cannot be "
+            "placed")
     old_sz = -(-num_rows // old_n)  # RangePartitioner.shard_size
     new_hi = min(new_lo + new_shard_size, num_rows)
     pieces: dict[str, list[np.ndarray]] = {}
@@ -184,11 +219,12 @@ def reshard_table_state(checkpoint_dir: str, step: int, old_n: int,
         # a grown world's last shard can lie ENTIRELY in padding
         # (shard_lo >= num_rows): there are no rows to assemble, but the
         # live table still expects every leaf at full shard shape — use
-        # old rank 0's leaves as the shape/dtype template, zero-filled
-        state = _load_table_npz(checkpoint_dir, step, 0, name)
+        # old rank 0's leaves as the shape/dtype template, zero-filled.
+        # Overlay metadata and xtra subtrees never ride a resharded
+        # state: the resize flattens the routing table.
         out = {"lo": np.asarray(new_lo)}
-        for key, arr in state.items():
-            if key == "lo":
+        for key, arr in probe.items():
+            if key in _META_KEYS or "/" in key:
                 continue
             if arr.ndim >= 1 and arr.shape[0] == old_sz:
                 out[key] = np.zeros((new_shard_size,) + arr.shape[1:],
@@ -204,8 +240,8 @@ def reshard_table_state(checkpoint_dir: str, step: int, old_n: int,
             continue
         state = _load_table_npz(checkpoint_dir, step, o, name)
         for key, arr in state.items():
-            if key == "lo":
-                continue
+            if key in _META_KEYS or "/" in key:
+                continue  # routing metadata / xtra subtrees: overlay pass
             if arr.ndim >= 1 and arr.shape[0] == old_sz:
                 pieces.setdefault(key, []).append(arr[a - lo_o:b - lo_o])
             else:
@@ -229,7 +265,149 @@ def reshard_table_state(checkpoint_dir: str, step: int, old_n: int,
                 axis=0)
         out[key] = rows
     out.update(passthrough)
+    if saved_ep:
+        # overlay pass: every moved block's LIVE rows sit in its
+        # save-time owner's xtra section; the home-slab slice placed
+        # above is a dead copy. Overwrite the intersection of each
+        # overlay block's span with my new range, every row-aligned
+        # leaf alike (optimizer state migrates with its rows).
+        loaded: dict[int, dict] = {}
+        for blk_id, owner in sorted(saved_ov.items()):
+            blo, bln = _block_span(old_sz, saved_blk, blk_id)
+            a, b = max(blo, new_lo), min(blo + bln, new_hi)
+            if a >= b:
+                continue
+            if owner not in loaded:
+                loaded[owner] = _load_table_npz(checkpoint_dir, step,
+                                                owner, name)
+            prefix = f"xtra/{blk_id}/"
+            xs = {k[len(prefix):]: v for k, v in loaded[owner].items()
+                  if k.startswith(prefix)}
+            if not set(pieces) <= set(xs):
+                # EVERY row-aligned leaf must come from the live copy:
+                # a subset (say w without m) would silently mix live
+                # params with a dead home copy's optimizer state
+                raise ValueError(
+                    f"elastic reshard: step {step} of table {name!r} "
+                    f"maps block {blk_id} to rank {owner}, but that "
+                    "rank's shard file lacks "
+                    f"{sorted(set(pieces) - set(xs))} for it — torn "
+                    "rebalanced save")
+            for key, arr in xs.items():
+                if key in out:
+                    out[key][a - new_lo:b - new_lo] = arr[a - blo:b - blo]
     return out
+
+
+def find_live_step(checkpoint_dir: str, tables: dict, n: int,
+                   required=None) -> Optional[int]:
+    """Newest step that every rank in ``required`` (default: all of
+    ``0..n-1``) holds under the CALLER'S ``n``-way partition (overlays
+    allowed — the slab layout is what the fit check reads). The
+    elastic-membership death path restores a dead rank's blocks from
+    this step, passing ``required = live ∪ {corpse}``: one
+    coordinator-chosen step keeps every survivor's restore consistent,
+    and a never-checkpointed STANDBY's missing rank dir must not veto
+    recovery (it owns nothing a checkpoint could hold — its home range
+    was evacuated into live ranks' files at bootstrap). Ranks in
+    ``required`` that never created a dir are skipped for the same
+    reason; no dirs at all means no recovery."""
+    dirs = _rank_dirs(checkpoint_dir)
+    need = sorted((set(range(n)) if required is None
+                   else {int(r) for r in required}) & set(dirs))
+    if not need:
+        return None
+    common: Optional[set[int]] = None
+    for r in need:
+        steps = _steps_in(dirs[r])
+        common = steps if common is None else common & steps
+    for s in sorted(common or (), reverse=True):
+        if all(_fits_partition(checkpoint_dir, s, r, tables, n)
+               for r in need):
+            return s
+    return None
+
+
+def load_block_state(checkpoint_dir: str, step: int, name: str,
+                     block: int, blo: int, bln: int, home_rank: int,
+                     shard_size: int, block_size: int,
+                     cache: Optional[dict] = None
+                     ) -> dict[str, np.ndarray]:
+    """State of ONE key block at ``step``, read through the save-time
+    routing table — the elastic-membership death path's restore unit
+    (a dead rank's blocks reassemble onto survivors from exactly what
+    the checkpoint holds, wherever the overlay had parked them).
+
+    ``blo``/``bln``/``home_rank`` are the block's LIVE geometry
+    (``BlockRouter.block_span``/``home_of``); the saved block size must
+    match the live router's, else block ids name different key ranges
+    and the restore would be silently torn — refused loudly instead.
+    ``cache`` (rank -> loaded flat state, caller-held across one
+    adoption) keeps a dead rank's B-block restore from decompressing
+    the same shard files B times — under the table locks, that cost
+    was serialized against every serve."""
+
+    def _load(rank: int) -> dict:
+        if cache is None:
+            return _load_table_npz(checkpoint_dir, step, rank, name)
+        if rank not in cache:
+            cache[rank] = _load_table_npz(checkpoint_dir, step, rank,
+                                          name)
+        return cache[rank]
+
+    # the routing metadata is identical in every shard file, so read it
+    # from the home rank when possible and fall back to ANY holder: the
+    # home rank may be a corpse that never checkpointed (an admitted-
+    # then-killed joiner), whose blocks' live state sits in other
+    # ranks' files per the overlay
+    meta = None
+    for rank in [home_rank] + sorted(set(_rank_dirs(checkpoint_dir))
+                                     - {home_rank}):
+        try:
+            meta = _load(rank)
+            break
+        except (OSError, ValueError, KeyError):
+            continue
+    if meta is None:
+        raise ValueError(
+            f"elastic restore: no readable shard file at step {step} "
+            f"of table {name!r} — nothing to restore block {block} "
+            "from")
+    saved_ep, saved_blk, saved_ov = saved_overlay(meta)
+    if saved_ep and saved_blk != block_size:
+        raise ValueError(
+            f"elastic restore: step {step} of table {name!r} was saved "
+            f"at block granularity {saved_blk}, live router runs "
+            f"{block_size} — block ids are incomparable")
+    owner = saved_ov.get(int(block), home_rank)
+    if owner == home_rank:
+        try:
+            home = _load(home_rank)
+        except (OSError, ValueError, KeyError) as e:
+            # the state lived only on the (dir-less) home rank: gone
+            raise ValueError(
+                f"elastic restore: step {step} of table {name!r} holds "
+                f"no file for rank {home_rank}, the save-time owner of "
+                f"block {block}") from e
+        lo_local = blo - home_rank * shard_size
+        st = {}
+        for key, arr in home.items():
+            if key in _META_KEYS or "/" in key:
+                continue
+            if arr.ndim >= 1 and arr.shape[0] == shard_size:
+                st[key] = np.array(arr[lo_local:lo_local + bln])
+    else:
+        state = _load(owner)
+        prefix = f"xtra/{block}/"
+        st = {k[len(prefix):]: np.array(v) for k, v in state.items()
+              if k.startswith(prefix)}
+    if st.get("w") is None or st["w"].shape[0] != bln:
+        raise ValueError(
+            f"elastic restore: step {step} of table {name!r} holds no "
+            f"usable state for block {block} "
+            f"(expected {bln} rows at rank "
+            f"{owner})")
+    return st
 
 
 def read_saved_clock(checkpoint_dir: str, step: int,

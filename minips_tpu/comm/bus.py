@@ -568,6 +568,19 @@ class ClockGossip:
             self._cond.notify_all()
         self._notify_listeners()
 
+    def include(self, process_id: int) -> None:
+        """Re-admit a rank into min-clock computation — the elastic-
+        membership join path (balance/membership.py): a standby rank is
+        excluded at startup so its idle clock can't gate the fleet, and
+        included only AFTER it published a catch-up clock (its live
+        announce trails that publish on the same FIFO link, so by
+        include time the stored entry is current — including a clock-0
+        ghost would wedge every gate)."""
+        with self._cond:
+            self._excluded.discard(process_id)
+            self._cond.notify_all()
+        self._notify_listeners()
+
     def _min_locked(self) -> int:
         vals = [min(v) for p, v in self._clocks.items()
                 if v and p not in self._excluded]

@@ -323,3 +323,101 @@ class ChaosBus:
         with self._lock:
             self._cond.notify_all()
         self._thread.join(timeout=2.0)
+
+
+# --------------------------------------------------------------- kill drill
+class KillSpec:
+    """Parsed ``MINIPS_CHAOS_KILL`` — seeded deterministic process death,
+    the launcher-level sibling of the frame-level injector above. The
+    launcher exports the spec to every rank (env inheritance, same as
+    ``MINIPS_CHAOS``); the matching rank SIGKILLs ITSELF at the chosen
+    clock boundary — abrupt as an OOM kill (no atexit, no flush, no
+    close), reproducible bit-for-bit because the trigger is a clock
+    value, not wall time.
+
+    Grammar::
+
+        <seed>:rank=<r>,step=<s>
+
+    ``rank=-1`` picks a seeded-uniform victim among ranks 1..n-1 (rank 0
+    is the membership coordinator — killing it is the gang-restart
+    drill, not this one); ``step=<a>-<b>`` picks a seeded-uniform step
+    in ``[a, b]``. Fixed values make the seed inert but keep the spec
+    shape aligned with ``MINIPS_CHAOS``.
+    """
+
+    def __init__(self, seed: int, rank: int, step_lo: int, step_hi: int):
+        if step_lo < 1 or step_hi < step_lo:
+            raise ValueError("chaos-kill step must be >= 1 (clock "
+                             "boundaries start at 1) with a non-empty "
+                             "range")
+        self.seed = int(seed)
+        self.rank = int(rank)
+        self.step_lo = int(step_lo)
+        self.step_hi = int(step_hi)
+
+    @classmethod
+    def parse(cls, spec: str) -> "KillSpec":
+        spec = spec.strip()
+        seed_s, _, body = spec.partition(":")
+        try:
+            seed = int(seed_s)
+        except ValueError:
+            raise ValueError(
+                f"MINIPS_CHAOS_KILL must start with '<int seed>:', "
+                f"got {spec!r}")
+        rank: Optional[int] = None
+        step: Optional[str] = None
+        for entry in filter(None, (e.strip() for e in body.split(","))):
+            knob, _, val = entry.partition("=")
+            if knob == "rank":
+                rank = int(val)
+            elif knob == "step":
+                step = val
+            else:
+                raise ValueError(
+                    f"MINIPS_CHAOS_KILL: unknown knob {knob!r} "
+                    "(expected rank=, step=)")
+        if rank is None or step is None:
+            raise ValueError(
+                "MINIPS_CHAOS_KILL needs both rank= and step=")
+        lo, _, hi = step.partition("-")
+        return cls(seed, rank, int(lo), int(hi) if hi else int(lo))
+
+    def resolve(self, nprocs: int) -> tuple[int, int]:
+        """The concrete ``(victim rank, kill clock)`` for an
+        ``nprocs``-rank job — a pure function of (seed, nprocs), so
+        every rank computes the same verdict without coordination."""
+        import numpy as np
+
+        rng = np.random.default_rng((self.seed, 0x6b11, nprocs))
+        rank = self.rank
+        if rank == -1:
+            rank = int(rng.integers(1, max(nprocs, 2)))
+        step = self.step_lo
+        if self.step_hi > self.step_lo:
+            step = int(rng.integers(self.step_lo, self.step_hi + 1))
+        return rank, step
+
+
+def install_chaos_kill(rank: int, nprocs: int):
+    """Arm the seeded kill for this process from ``$MINIPS_CHAOS_KILL``:
+    returns ``check(clock)`` to call at every clock boundary (the
+    trainer's tick does), or None when unarmed or aimed elsewhere. The
+    kill is ``SIGKILL`` to self — delivered mid-step, before the clock
+    frame goes out, so the corpse's last completed clock is ``step-1``
+    exactly like a machine loss between two ticks."""
+    import os
+    import signal
+
+    spec = os.environ.get("MINIPS_CHAOS_KILL", "").strip()
+    if not spec:
+        return None
+    victim, kill_step = KillSpec.parse(spec).resolve(nprocs)
+    if victim != rank:
+        return None
+
+    def check(clock: int) -> None:
+        if clock == kill_step:
+            os.kill(os.getpid(), signal.SIGKILL)
+    return check

@@ -11,6 +11,7 @@ caller's, delivered via the ``on_failure`` callback.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Optional
@@ -19,11 +20,53 @@ from minips_tpu.comm.bus import ControlBus
 from minips_tpu.obs import tracer as _trc
 
 
+def liveness_knobs(interval: float,
+                   timeout: float) -> tuple[float, float]:
+    """Resolve the heartbeat liveness knobs against
+    ``$MINIPS_HEARTBEAT`` — ``"interval=0.1,timeout=0.8"``, either knob
+    optional, empty string (or unset, or ``"1"``) meaning the caller's
+    defaults — the same explicit-empty convention as ``MINIPS_BUS`` /
+    ``MINIPS_SHM_RING``. Exists so the death drills can run CI-fast
+    detection timeouts (and production can run lazier ones) without
+    patching every app's hardcoded monitor numbers."""
+    spec = os.environ.get("MINIPS_HEARTBEAT", "").strip()
+    if not spec or spec in ("1", "on", "true"):
+        return interval, timeout
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        if "=" not in entry:
+            raise ValueError(
+                f"MINIPS_HEARTBEAT: expected k=v, got {entry!r}")
+        k, _, v = entry.partition("=")
+        k = k.strip()
+        if k not in ("interval", "timeout"):
+            raise ValueError(f"MINIPS_HEARTBEAT: unknown knob {k!r}")
+        try:
+            val = float(v)
+        except ValueError as e:
+            raise ValueError(
+                f"MINIPS_HEARTBEAT: bad value for {k}: {v!r}") from e
+        if val <= 0:
+            raise ValueError(f"MINIPS_HEARTBEAT: {k} must be > 0")
+        if k == "interval":
+            interval = val
+        else:
+            timeout = val
+    if timeout <= interval:
+        raise ValueError(
+            f"MINIPS_HEARTBEAT: timeout {timeout} must exceed the "
+            f"interval {interval} (a beat must be able to land)")
+    return interval, timeout
+
+
 class HeartbeatMonitor:
     def __init__(self, bus: ControlBus, peer_ids: list[int],
                  interval: float = 1.0, timeout: float = 5.0,
                  on_failure: Optional[Callable[[int], None]] = None,
                  clock: Callable[[], float] = time.monotonic):
+        # env knobs override the caller's numbers (liveness_knobs):
+        # drills tune detection latency fleet-wide via the launcher's
+        # env inheritance instead of per-app flag plumbing
+        interval, timeout = liveness_knobs(interval, timeout)
         self.bus = bus
         self.interval = interval
         self.timeout = timeout

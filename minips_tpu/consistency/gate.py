@@ -76,6 +76,11 @@ class StalenessGate:
         self.staleness = staleness
         self.timeout = timeout
         self.monitor = monitor
+        # elastic membership plane (balance/membership.py), when armed:
+        # a death the plane owns excludes the corpse from gossip (the
+        # gate recomputes over the shrunken membership) and is NOT
+        # fatal here — only unrecoverable deaths still raise
+        self.membership = None
         self.gate_waits = 0      # times the gate actually blocked
         self.max_skew_seen = 0   # max (my_clock - global_min) observed
 
@@ -106,8 +111,10 @@ class StalenessGate:
         try:
             while not self.gossip.wait_global_min(
                     threshold, timeout=min(1.0, self.timeout)):
-                dead = (self.monitor.check()
-                        if self.monitor is not None else set())
+                dead = set(self.monitor.check()
+                           if self.monitor is not None else ())
+                if dead and self.membership is not None:
+                    dead = self.membership.fatal_dead(dead)
                 if dead:
                     for p in dead:
                         self.gossip.exclude(p)

@@ -323,6 +323,7 @@ def _spawn_rank(argv: list[str], env: dict, outfile):
 def run_local_job(n: int, argv: list[str], *,
                   base_port: Optional[int] = None,
                   env_extra: Optional[dict] = None,
+                  env_per_rank: Optional[dict] = None,
                   timeout: float = 240.0) -> list[dict]:
     """Spawn ``n`` local ranks of ``argv`` over loopback, wait, and harvest
     the last JSON line each rank printed (the smoke/bench protocol: every
@@ -330,7 +331,10 @@ def run_local_job(n: int, argv: list[str], *,
     output if a rank produced no JSON or the job failed — shared by
     tests/test_distributed_smoke.py and bench_ssp.py so the spawn/harvest
     protocol lives in one place. ``base_port=None`` (the default) asks
-    the OS for a free block via :func:`find_free_base_port`."""
+    the OS for a free block via :func:`find_free_base_port`.
+    ``env_per_rank`` maps rank -> extra env for THAT rank only — the
+    elastic-membership drills aim per-rank knobs (a joiner's standby
+    config, a drain trigger) without giving every rank the flag."""
     import json
     import tempfile
 
@@ -344,6 +348,8 @@ def run_local_job(n: int, argv: list[str], *,
         env = child_env(rank, hosts, base_port)
         if env_extra:
             env.update(env_extra)
+        if env_per_rank and rank in env_per_rank:
+            env.update(env_per_rank[rank])
         procs.append(_spawn_rank(argv, env, outs[rank]))
     rc = wait(procs, timeout=timeout)
     # read EVERY rank's output before judging any single one: the rank
@@ -391,6 +397,7 @@ def run_local_job(n: int, argv: list[str], *,
 def run_local_job_raw(n: int, argv: list[str], *,
                       base_port: Optional[int] = None,
                       env_extra: Optional[dict] = None,
+                      env_per_rank: Optional[dict] = None,
                       timeout: float = 240.0,
                       kill_on_failure: bool = False):
     """Spawn ``n`` local ranks and harvest ALL JSON lines per rank,
@@ -399,7 +406,8 @@ def run_local_job_raw(n: int, argv: list[str], *,
     ``(rc, events)`` with ``events[rank]`` the rank's parsed JSON lines.
     ``kill_on_failure=False`` by default: kill drills need survivors to
     detect a death THEMSELVES, not be mercy-killed by the launcher.
-    ``base_port=None`` auto-picks a free block (find_free_base_port)."""
+    ``base_port=None`` auto-picks a free block (find_free_base_port);
+    ``env_per_rank`` aims per-rank drill knobs like run_local_job's."""
     import json
     import tempfile
 
@@ -413,6 +421,8 @@ def run_local_job_raw(n: int, argv: list[str], *,
         env = child_env(rank, hosts, base_port)
         if env_extra:
             env.update(env_extra)
+        if env_per_rank and rank in env_per_rank:
+            env.update(env_per_rank[rank])
         procs.append(_spawn_rank(argv, env, outs[rank]))
     rc = wait(procs, timeout=timeout, kill_on_failure=kill_on_failure)
     events = []

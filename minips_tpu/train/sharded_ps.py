@@ -228,6 +228,35 @@ __all__ = ["ShardedTable", "ShardedPSTrainer", "PeerFailureError",
            "dequantize_rows_int8"]
 
 
+def _as_blob(arr: np.ndarray) -> memoryview:
+    """Zero-copy byte view of an array for the bus's blob slot — every
+    backend accepts bytes-likes (PR7's framing ships blobs as raw
+    views), so the ``tobytes()`` this replaces was a full payload copy
+    per frame on the hot path. ONLY sound for arrays this process owns
+    and never mutates after the send (fresh fancy-index/copy results):
+    the reliable journal and the chaos injector retain the blob past
+    the call, so an aliased caller buffer would retransmit whatever the
+    caller wrote next."""
+    return memoryview(np.ascontiguousarray(arr)).cast("B")
+
+
+def _cat_blob(*parts) -> bytearray:
+    """Single-allocation multi-part blob assembly: each part (array or
+    bytes-like) is copied ONCE into the result — vs the seed pattern
+    ``a.tobytes() + b.tobytes()`` which paid one copy per part plus the
+    concatenation. The bytearray is freshly owned, so journal retention
+    is alias-safe."""
+    views = [memoryview(np.ascontiguousarray(p)).cast("B")
+             if isinstance(p, np.ndarray) else memoryview(p)
+             for p in parts]
+    out = bytearray(sum(v.nbytes for v in views))
+    off = 0
+    for v in views:
+        out[off:off + v.nbytes] = v
+        off += v.nbytes
+    return out
+
+
 class RowCache:
     """Clock-versioned LRU cache of REMOTE rows — the reference
     KVClientTable's process-level parameter cache, with the SSP rule as
@@ -399,6 +428,14 @@ def table_state_bytes(num_rows: int, dim: int, updater: str) -> int:
     if updater == "adam":  # per-row lazy step counters (int32)
         n += num_rows * 4
     return n
+
+
+class _ReissuePullAll(Exception):
+    """A shard-assembly (psA) leg was addressed to a now-dead rank and
+    the death plan has re-homed its blocks: the whole pull_all must
+    re-issue at the new epoch (a psA leg asks one rank for ITS shard —
+    there is no per-leg re-route that can recover the corpse's half).
+    Internal to this module: pull_all catches it and retries."""
 
 
 class PullFuture:
@@ -647,12 +684,17 @@ class ShardedTable:
         # every path below falls through to the seed behavior exactly.
         self.router = BlockRouter(self.part)
         self._rb = None            # balance.rebalancer.Rebalancer
+        self._mb = None            # balance.membership.Membership
         self._heat = None          # balance.heat.HeatAccountant
         self._sv = None            # serve.plane.TableServeState
-        self._mig_cond = threading.Condition()  # guards the sets below
+        self._mig_cond = threading.Condition()  # guards the maps below
         self._xtra: dict[int, dict] = {}        # migrated-in block state
-        self._fenced: set[int] = set()          # pulls park until rbF
-        self._pending_state: set[int] = set()   # inbound, rbS not here
+        # fenced/pending carry the COUNTERPART rank (the old owner whose
+        # rbF releases the fence / whose rbS is in transit): the elastic
+        # membership plane resolves entries stuck on a corpse by source
+        # instead of guessing
+        self._fenced: dict[int, int] = {}        # block -> old owner
+        self._pending_state: dict[int, int] = {}  # block -> shipper
         self._early_state: dict[int, dict] = {}  # rbS beat my adoption
         self._early_release: set[tuple] = set()  # rbF beat my adoption
         self._parked_pushes: list[tuple] = []    # future-epoch / pending
@@ -660,7 +702,8 @@ class ShardedTable:
         self._await_acks: dict[int, list] = {}   # ep -> [(block, dst)]
         self.rb_stats = {"blocks_in": 0, "blocks_out": 0,
                          "forwarded_pushes": 0, "refused_pulls": 0,
-                         "parked_frames": 0, "migrated_rows": 0}
+                         "parked_frames": 0, "migrated_rows": 0,
+                         "blocks_restored": 0, "pushes_lost_to_dead": 0}
         # ---- per-owner serve counters (ALWAYS on — the observability
         # half of heat accounting): requests/rows this shard served
         # (wire) and rows read/applied on this shard's storage (wire +
@@ -757,6 +800,7 @@ class ShardedTable:
         # turns into a poisoned table.
         self._push_seq = 0
         self._inflight: dict[int, tuple[float, int]] = {}
+        self._dead_ranks: set[int] = set()  # membership deaths (sticky)
         self._ack_pending: dict[int, list[int]] = {}  # sender -> seqs
         self._ack_lock = threading.Lock()
         self._push_cond = threading.Condition()
@@ -907,6 +951,92 @@ class ShardedTable:
             for kind, fn in self._sv.handlers():
                 self.bus.on(f"{kind}:{self.name}", fn)
 
+    def attach_membership(self, mb) -> None:
+        """Bind the elastic membership plane (balance/membership.py).
+        Requires the rebalancer machinery (membership transitions ARE
+        epoch-fenced migrations); arms the death-survival paths below:
+        a heartbeat-dead peer whose transition the plane owns unjams
+        waits and re-routes legs instead of poisoning the run."""
+        if self._rb is None:
+            raise RuntimeError(
+                "attach_membership requires the rebalancer machinery "
+                "(attach_rebalancer first): membership transitions ride "
+                "the epoch-fenced migration protocol")
+        self._mb = mb
+
+    def _fatal_dead(self, dead) -> set[int]:
+        """The subset of heartbeat-dead peers that must still POISON a
+        wait: everything, until the elastic membership plane is armed —
+        then only deaths it cannot own (no checkpoint to restore from,
+        a dead coordinator, verdict timeout). A survivable death keeps
+        the wait alive until the membership plan re-homes the corpse's
+        blocks and the wait's own re-check path unblocks it."""
+        dead = set(dead)
+        if not dead or self._mb is None:
+            return dead
+        return self._mb.fatal_dead(dead)
+
+    def on_ranks_dead(self, dead: set[int]) -> None:
+        """Detection-time unjam (membership death path, called the
+        moment the monitor's verdict lands — BEFORE any plan): unacked
+        push frames addressed to the corpse will never ack, so drop
+        them from the window (counted — a lost push is a lost gradient,
+        never silent) and wake every waiter so the re-check paths see
+        the new world. The dead set is STICKY: frames the sender thread
+        registers after this sweep (already-queued async pushes, or
+        pushes the pre-plan table still routes to the corpse) are
+        dropped by the wait loops' re-sweep and skipped at send time —
+        a one-shot sweep would let a later-registered seq jam the
+        window to its deadline."""
+        with self._push_cond:
+            self._dead_ranks |= set(dead)
+            self._drop_dead_inflight_locked()
+            self._push_cond.notify_all()
+        with self._reply_cond:
+            self._reply_cond.notify_all()
+        with self._mig_cond:
+            self._mig_cond.notify_all()
+
+    def _drop_dead_inflight_locked(self) -> None:
+        gone = [s for s, (_t, o) in self._inflight.items()
+                if o in self._dead_ranks]
+        for s in gone:
+            del self._inflight[s]
+        if gone:
+            self.rb_stats["pushes_lost_to_dead"] += len(gone)
+
+    def _reroute_dead_legs(self, gid: int, dead: set[int]) -> None:
+        """Re-issue a pull group's legs addressed to dead ranks by the
+        CURRENT routing table — the elastic twin of the psE re-router.
+        Only legs whose keys no longer route to a corpse move (the
+        membership plan must land first; until then the caller keeps
+        waiting, bounded by its own deadline)."""
+        with self._reply_cond:
+            grp = self._groups.get(gid)
+            assembly = grp is not None and grp.get("uniq") is None
+            miss = dict(self._missing_legs_locked(gid))
+        owner_map = self.router.owner_of_blocks()
+        if assembly:
+            if any(o in dead and not (owner_map == o).any()
+                   for o in miss.values()):
+                # a psA leg asks one rank for ITS shard — nothing to
+                # re-route leg-wise once that rank is a corpse. The
+                # death plan has re-homed its blocks (owner_map check),
+                # so the whole assembly re-issues at the new epoch.
+                with self._reply_cond:
+                    self._cleanup_group_locked(gid)
+                raise _ReissuePullAll()
+            return
+        for rid, o in miss.items():
+            if o not in dead or (owner_map == o).any():
+                continue  # alive, or the plan hasn't re-homed it yet
+
+            def _plan(keys: np.ndarray):
+                owners = self._owners_of(keys)
+                return [(int(t), "psG", {}, owners == t)
+                        for t in np.unique(owners)]
+            self._resend_leg(rid, _plan)
+
     def _owners_of(self, keys: np.ndarray) -> np.ndarray:
         return (self.router.shard_of(keys) if self._rb is not None
                 else self.part.shard_of(keys))
@@ -918,7 +1048,9 @@ class ShardedTable:
         g = getattr(self._cons, "gossip", None)
         return set(g.excluded) if g is not None else set()
 
-    def adopt_table(self, ep: int, overlay: dict) -> bool:
+    def adopt_table(self, ep: int, overlay: dict, *,
+                    dead: frozenset = frozenset(),
+                    restore=None) -> bool:
         """Adopt routing epoch ``ep`` — THE epoch fence point. Only ever
         run from the PUSH-DRIVING thread (trainer tick / finalize /
         pull_all / the pull-wait poll): the adoption ack's promise is
@@ -941,6 +1073,19 @@ class ShardedTable:
            conclude 'no more stale pushes from this rank' on receipt;
         4. drop row-cache entries of moved blocks and re-evaluate
            everything parked.
+
+        DEATH plans (elastic membership, balance/membership.py) ride the
+        same fence point with two extra arguments: blocks whose source
+        is in ``dead`` cannot ship an rbS or release an rbF — the new
+        owner instead installs ``restore(block)`` (the coordinator-chosen
+        elastic-checkpoint state, ckpt/elastic.load_block_state) and
+        serves immediately, un-fenced: no stale push can ever be
+        forwarded from a corpse, so the fence would protect against
+        nothing, and the restored content IS the recovery semantics
+        (loss of a rank rolls exactly its ranges back to the last
+        checkpoint, nothing else). Blocks stuck mid-migration ON the
+        corpse (pending rbS / fenced on its rbF from an earlier epoch)
+        resolve the same way.
         """
         if ep <= self.router.epoch:  # cheap duplicate cut (benign race;
             return False             # the locked apply re-checks)
@@ -954,6 +1099,24 @@ class ShardedTable:
                                    f"failed: {e!r}")
         ships: list[tuple[int, int, dict]] = []
         moved: list[tuple[int, int, int]] = []
+
+        def _restore_locked(b: int) -> None:
+            try:
+                st = restore(b) if restore is not None else None
+            except Exception as e:  # noqa: BLE001 - poison, don't hide
+                st = None
+                if self._fatal is None:
+                    self._fatal = (f"table {self.name}: elastic restore "
+                                   f"of block {b} failed: {e!r}")
+            if st is None:
+                if self._fatal is None:
+                    self._fatal = (
+                        f"table {self.name}: block {b} owned by a dead "
+                        "rank has no restorable checkpoint state")
+                return
+            self._install_block_locked(b, st)
+            self.rb_stats["blocks_restored"] += 1
+
         with self._mig_cond:
             prev = self.router.apply(ep, overlay)
             if prev is None:
@@ -970,18 +1133,39 @@ class ShardedTable:
                         ships.append((b, dst,
                                       self._take_block_locked(b)))
                     if dst == self.rank:
+                        if src in dead:
+                            # no rbS/rbF will ever come from the corpse:
+                            # restore from the elastic checkpoint and
+                            # serve un-fenced (docstring above)
+                            self._early_state.pop(b, None)
+                            _restore_locked(b)
+                            continue
                         early = self._early_state.pop(b, None)
                         if early is not None:
                             self._install_block_locked(b, early)
                             self.rb_stats["blocks_in"] += 1
                         else:
-                            self._pending_state.add(b)
+                            self._pending_state[b] = src
                         if (b, ep) in self._early_release:
                             self._early_release.discard((b, ep))
                         else:
-                            self._fenced.add(b)
+                            self._fenced[b] = src
                             if _trc.TRACER is not None:
                                 self._fence_t0[b] = time.monotonic()
+                if dead:
+                    # blocks stuck MID-MIGRATION on the corpse from an
+                    # earlier epoch: a pending rbS that will never
+                    # arrive restores from checkpoint; a fence whose
+                    # rbF died with its old owner releases (no source
+                    # left to forward a stale push)
+                    for b in [b for b, s in self._pending_state.items()
+                              if s in dead]:
+                        del self._pending_state[b]
+                        _restore_locked(b)
+                    for b in [b for b, s in self._fenced.items()
+                              if s in dead]:
+                        del self._fenced[b]
+                        self._fence_t0.pop(b, None)
             if ships:
                 self._await_acks[ep] = [(b, dst) for b, dst, _ in ships]
             self._adopt_acks.setdefault(ep, set()).add(self.rank)
@@ -1000,7 +1184,8 @@ class ShardedTable:
                 tr.instant("rebalance", "rb_ship",
                            {"b": int(b), "dst": int(dst),
                             "rows": int(head["n"]), "ep": ep})
-        for src in sorted({s for _b, s, _d in moved if s != self.rank}):
+        for src in sorted({s for _b, s, _d in moved
+                           if s != self.rank and s not in dead}):
             self.bus.send(src, f"rbA:{self.name}", {"ep": ep})
         if self._sv is not None and moved:
             # lease/epoch invalidation: every replica lease I granted on
@@ -1058,19 +1243,17 @@ class ShardedTable:
         drills can audit that a migrated block's content was at least
         as fresh as the bound requires)."""
         n = st["w"].shape[0]
-        parts = [np.ascontiguousarray(st["w"], np.float32).tobytes()]
+        parts = [np.ascontiguousarray(st["w"], np.float32)]
         for k in ("acc", "m", "v"):
             if st.get(k) is not None:
-                parts.append(np.ascontiguousarray(st[k],
-                                                  np.float32).tobytes())
+                parts.append(np.ascontiguousarray(st[k], np.float32))
         if st.get("steps") is not None:
-            parts.append(np.ascontiguousarray(st["steps"],
-                                              np.int32).tobytes())
+            parts.append(np.ascontiguousarray(st["steps"], np.int32))
         g = getattr(self._cons, "gossip", None)
         stamp = int(g.global_min()) if g is not None else 0
         head = {"b": int(b), "ep": int(ep), "n": int(n), "stamp": stamp,
                 "u": self.updater, **self._cfg_header()}
-        return head, b"".join(parts)
+        return head, _cat_blob(*parts)
 
     def _decode_block_state(self, payload: dict) -> Optional[dict]:
         n = int(payload.get("n", 0))
@@ -1108,7 +1291,7 @@ class ShardedTable:
             with self._state_lock:
                 if b in self._pending_state:
                     self._install_block_locked(b, st)
-                    self._pending_state.discard(b)
+                    self._pending_state.pop(b, None)
                     self.rb_stats["blocks_in"] += 1
                     if tr is not None:
                         tr.instant("rebalance", "rb_install", {"b": b})
@@ -1152,7 +1335,7 @@ class ShardedTable:
         released = False
         with self._mig_cond:
             if b in self._fenced and self.router.epoch >= ep:
-                self._fenced.discard(b)
+                self._fenced.pop(b, None)
                 released = True
             else:  # rbF beat my plan adoption (reordered control plane)
                 self._early_release.add((b, ep))
@@ -1219,11 +1402,21 @@ class ShardedTable:
             if self._fenced or self._pending_state:
                 blocks = {int(x)
                           for x in np.unique(self.router.blocks_of(keys))}
-                if blocks & (self._fenced | self._pending_state):
+                if blocks & (self._fenced.keys()
+                             | self._pending_state.keys()):
                     return "park"
         return "serve"
 
-    def _pull_all_verdict(self) -> str:
+    def _pull_all_verdict(self, ep: int = 0) -> str:
+        """'serve' | 'park' for a shard-assembly request stamped with
+        the REQUESTER's routing epoch ``ep``: park while a migrated
+        block is in transit here, and park requests from a NEWER epoch
+        until my adoption catches up — a pre-adoption reply would omit
+        every block the new table assigns to me (a death plan's
+        restored blocks have no other live holder, so the assembler
+        would read uninitialized rows for their span)."""
+        if ep > self.router.epoch:
+            return "park"
         with self._mig_cond:
             return "park" if (self._fenced or self._pending_state) \
                 else "serve"
@@ -1286,8 +1479,7 @@ class ShardedTable:
             if tr is not None:
                 tr.instant("push", "push_forward",
                            {"to": int(o), "n": int(k.size)})
-            blob = k.tobytes() + np.ascontiguousarray(g,
-                                                      np.float32).tobytes()
+            blob = _cat_blob(k, np.ascontiguousarray(g, np.float32))
             self.bus.send(o, f"psP:{self.name}",
                           {"n": int(k.size), "comm": "float32",
                            "ep": self.router.epoch, **self._cfg_header()},
@@ -1632,9 +1824,11 @@ class ShardedTable:
         if self.pull_wire == "int8":
             codes, scale = quantize_rows_int8(rows)  # nearest: no rng
             return ({"req": req, "wire": "int8", "n": rows.shape[0]},
-                    scale.tobytes() + codes.tobytes())
-        return {"req": req, "wire": "f32"}, np.ascontiguousarray(
-            rows, np.float32).tobytes()
+                    _cat_blob(scale, codes))
+        # zero-copy: `rows` is always freshly materialized by the serve
+        # path (fancy index / .copy()), so the view is alias-safe
+        return {"req": req, "wire": "f32"}, _as_blob(
+            np.asarray(rows, np.float32))
 
     def _serve_pull(self, sender: int, req: int, keys: np.ndarray,
                     clk: int = 0) -> None:
@@ -1655,8 +1849,8 @@ class ShardedTable:
                 if ok and (self._fenced or self._pending_state):
                     blocks = {int(x) for x in
                               np.unique(self.router.blocks_of(keys))}
-                    ok = not (blocks
-                              & (self._fenced | self._pending_state))
+                    ok = not (blocks & (self._fenced.keys()
+                                        | self._pending_state.keys()))
                 if ok:
                     with self._state_lock:
                         rows = self._read_rows_locked(keys)
@@ -1696,18 +1890,20 @@ class ShardedTable:
         if not self._check_peer_config(sender, payload):
             return  # requester times out loudly; my next tick raises
         clk = int(payload.get("clk", 0))
+        ep = int(payload.get("ep", 0))
         admitted = self._cons is None or self._cons.admit_pull(clk)
         parked = not admitted or (
-            self._rb is not None and self._pull_all_verdict() == "park")
+            self._rb is not None
+            and self._pull_all_verdict(ep) == "park")
         if parked:
             # a shard assembly must not ship while a migrated block is
             # in transit: the live copy would be on neither side
             with self._park_lock:
-                self._parked.append((sender, req, None, clk, 0,
+                self._parked.append((sender, req, None, clk, ep,
                                      time.monotonic()))
             if (self._cons is None or self._cons.admit_pull(clk)) and (
                     self._rb is None
-                    or self._pull_all_verdict() == "serve"):
+                    or self._pull_all_verdict(ep) == "serve"):
                 self.serve_parked()  # park/drain race, as above
             return
         self._serve_pull_all(sender, req, clk)
@@ -1796,7 +1992,7 @@ class ShardedTable:
                 admitted = self._cons is None \
                     or self._cons.admit_pull(p[3])
                 if self._rb is not None:
-                    v = (self._pull_all_verdict() if p[2] is None
+                    v = (self._pull_all_verdict(p[4]) if p[2] is None
                          else self._pull_verdict(p[2], p[4]))
                     if v == "refuse":
                         refuse.append(p)
@@ -1941,7 +2137,7 @@ class ShardedTable:
                         "pull", {"owner": o, "rid": rid2})
             self.bus.send(o, f"psG:{self.name}",
                           {"req": rid2, "clk": clk, **self._ep_header(),
-                           **self._cfg_header()}, blob=kslice.tobytes())
+                           **self._cfg_header()}, blob=_as_blob(kslice))
 
     def _resend_leg(self, rid: int, plan) -> None:
         """Detach live wire leg ``rid`` (no reply yet) and re-issue its
@@ -1988,7 +2184,7 @@ class ShardedTable:
             self.bus.send(target, f"{kind}:{self.name}",
                           {"req": rid2, "clk": clk, **extra,
                            **self._ep_header(), **self._cfg_header()},
-                          blob=kslice.tobytes())
+                          blob=_as_blob(kslice))
 
     # --------------------------------------------------------- client side
     def bind_consistency(self, cons) -> None:
@@ -2164,12 +2360,22 @@ class ShardedTable:
             # progress (not only tick())
             if self._rb is not None:
                 self._rb.adopt_now()
-            dead = (self.monitor.check()
+            if self._mb is not None:
+                self._mb.poll()  # coordinator: issue a blocking death
+            dead = (set(self.monitor.check())
                     if self.monitor is not None else set())
-            if dead & owners:
-                with self._reply_cond:
-                    self._cleanup_group_locked(gid)
-                raise PeerFailureError(dead & owners)
+            dead_owned = dead & owners
+            if dead_owned:
+                fatal = self._fatal_dead(dead_owned)
+                if fatal:
+                    with self._reply_cond:
+                        self._cleanup_group_locked(gid)
+                    raise PeerFailureError(fatal)
+                # survivable death (elastic membership): once the death
+                # plan re-homed the corpse's keys, its legs re-issue by
+                # the current table; until then keep waiting (bounded
+                # by this wait's own deadline)
+                self._reroute_dead_legs(gid, dead_owned)
             if time.monotonic() > deadline:
                 with self._reply_cond:
                     self._cleanup_group_locked(gid)
@@ -2199,6 +2405,15 @@ class ShardedTable:
                 tr.complete("pull", "fence_wait", t_fence0,
                             {"n": int(gkeys.size)})
         while True:
+            # adopt pending plans BEFORE re-evaluating fences (outside
+            # the cond — adopt_table takes it): a fence whose releaser
+            # died opens only at the death plan's adoption, and that
+            # adoption happens on this thread; both calls are no-ops
+            # off the driving thread / with nothing pending
+            if self._rb is not None:
+                self._rb.adopt_now()
+            if self._mb is not None:
+                self._mb.poll()
             with self._mig_cond:
                 owners = self.router.shard_of(gkeys)
                 mine = owners == self.rank
@@ -2206,8 +2421,8 @@ class ShardedTable:
                 if mine.any() and (self._fenced or self._pending_state):
                     bl = {int(x) for x in
                           np.unique(self.router.blocks_of(gkeys[mine]))}
-                    blocked = bool(bl & (self._fenced
-                                         | self._pending_state))
+                    blocked = bool(bl & (self._fenced.keys()
+                                         | self._pending_state.keys()))
                 if blocked:
                     if t_fence0 is None:
                         t_fence0 = time.monotonic()
@@ -2283,8 +2498,9 @@ class ShardedTable:
                 time.sleep(0.005)
             if self._cons.admit_pull(clk):
                 return
-            dead = (self.monitor.check()
-                    if self.monitor is not None else set())
+            dead = self._fatal_dead(
+                self.monitor.check()
+                if self.monitor is not None else set())
             if dead:
                 raise PeerFailureError(dead)
             if time.monotonic() > deadline:
@@ -2384,7 +2600,7 @@ class ShardedTable:
                 self.bus.send(o, f"{kind}:{self.name}",
                               {"req": rid, "clk": clk,
                                **self._ep_header(), **self._cfg_header()},
-                              blob=kslice.tobytes())
+                              blob=_as_blob(kslice))
                 wire_rows += idx.size
         self.timers.record_pull_rows(requested=keys.size, wire=wire_rows,
                                      hits=hits, lookups=lookups)
@@ -2467,12 +2683,44 @@ class ShardedTable:
         migrated-IN blocks, and assembly runs two passes: base shards
         first, then every overlay block over its (stale) home copy —
         the overlay entry is the authoritative one by construction
-        (exactly one current owner per block)."""
+        (exactly one current owner per block). A rank dying mid-
+        assembly (elastic membership) re-issues the whole gather at
+        the post-death epoch — survivors' replies then carry the
+        restored blocks (owners park future-epoch psA requests until
+        their own adoption, so no reply can predate the plan)."""
+        for _attempt in range(4):
+            try:
+                return self._pull_all_once()
+            except _ReissuePullAll:
+                continue
+        raise TimeoutError(
+            f"pull_all({self.name}): shard assembly kept losing owners "
+            "mid-gather (membership churn outran the retry budget)")
+
+    def _pull_all_once(self) -> np.ndarray:
         if self._rb is not None:
             self._rb.adopt_now()  # a plan landing post-last-tick still
             self._wait_settled(self.pull_timeout)  # needs my rbA; and my
             # own in-transit blocks must land before I can assemble
-        peers = set(range(self.num_processes)) - {self.rank}
+        # the assembly's peer set is the CURRENT ROUTING TABLE's owner
+        # set, not the gossip view: every row lives at exactly one
+        # block owner, so polling the owners covers the table by
+        # construction — a rank my table routes nothing to contributes
+        # nothing (its home range is in other owners' xtra), and a
+        # rank my table DOES route to must be polled even if my gossip
+        # hasn't re-included it yet (a freshly-admitted joiner's live
+        # announce rides a different link than the admit plan — using
+        # the exclusion set here silently dropped its range from the
+        # gather in that window). The rb-off path keeps the exclusion
+        # rule: no overlay exists to re-home a corpse's rows, and
+        # exclusions only appear once a death already doomed the run.
+        if self._rb is not None:
+            peers = {int(o)
+                     for o in np.unique(self.router.owner_of_blocks())
+                     } - {self.rank}
+        else:
+            peers = (set(range(self.num_processes)) - {self.rank}
+                     - self._excluded_ranks())
         gid = 0
         legs: dict[int, tuple] = {}
         if peers:
@@ -2538,12 +2786,15 @@ class ShardedTable:
         deadline = time.monotonic() + self.pull_timeout
         with self._push_cond:
             while len(self._inflight) >= self.push_window:
+                if self._dead_ranks:
+                    self._drop_dead_inflight_locked()  # sticky deaths
                 self._solicit_acks_locked()
                 self._push_cond.wait(timeout=0.2)
                 if len(self._inflight) < self.push_window:
                     break
-                dead = (self.monitor.check()
-                        if self.monitor is not None else set())
+                dead = self._fatal_dead(
+                    self.monitor.check()
+                    if self.monitor is not None else set())
                 if dead:
                     raise PeerFailureError(dead)
                 if time.monotonic() > deadline:
@@ -2638,6 +2889,10 @@ class ShardedTable:
                         or (acks and self._inflight))
         with self._push_cond:
             while not drained():
+                if self._dead_ranks:
+                    self._drop_dead_inflight_locked()
+                    if drained():
+                        break
                 if acks and not self._q_pending:
                     # everything is on the wire; batched acks may be
                     # sitting at the owners below their flush threshold
@@ -2646,8 +2901,9 @@ class ShardedTable:
                 self._push_cond.wait(timeout=0.2)
                 if drained():
                     break
-                dead = (self.monitor.check()
-                        if self.monitor is not None else set())
+                dead = self._fatal_dead(
+                    self.monitor.check()
+                    if self.monitor is not None else set())
                 if dead:
                     raise PeerFailureError(dead)
                 if time.monotonic() > deadline:
@@ -2674,8 +2930,9 @@ class ShardedTable:
                 self.check_fatal()  # sender poisoned while we waited
                 if self._q_pending < self.push_window:
                     break
-                dead = (self.monitor.check()
-                        if self.monitor is not None else set())
+                dead = self._fatal_dead(
+                    self.monitor.check()
+                    if self.monitor is not None else set())
                 if dead:
                     raise PeerFailureError(dead)
                 if time.monotonic() > deadline:
@@ -2753,6 +3010,11 @@ class ShardedTable:
             mask = owners == o
             if not mask.any():
                 continue
+            if self._mb is not None and o in self._dead_ranks:
+                # pre-plan window: the old table still routes here —
+                # the corpse can neither apply nor ack; counted lost
+                self.rb_stats["pushes_lost_to_dead"] += 1
+                continue
             if o == self.rank:
                 # local rows never cross a wire — full precision always
                 if self._rb is not None:
@@ -2764,12 +3026,11 @@ class ShardedTable:
                     self._apply_rows(keys[mask] - self.shard_lo,
                                      grads[mask])
                 continue
-            kb = keys[mask].tobytes()
             if self.push_comm == "int8":
                 codes, scale = quantize_rows_int8(grads[mask], self._q_rng)
-                gb = scale.tobytes() + codes.tobytes()
+                blob = _cat_blob(keys[mask], scale, codes)
             else:
-                gb = grads[mask].tobytes()
+                blob = _cat_blob(keys[mask], grads[mask])
             head = {"n": int(mask.sum()), "comm": self.push_comm,
                     **self._ep_header(), **self._cfg_header()}
             if self.async_push:
@@ -2779,8 +3040,8 @@ class ShardedTable:
                     tr.flow("s", _trc.flow_id(f"push:{self.name}", self.rank,
                                               head["seq"]), "push",
                             {"owner": o, "seq": head["seq"]})
-            self.bus.send(o, f"psP:{self.name}", head, blob=kb + gb)
-            self.bytes_pushed += len(kb) + len(gb)
+            self.bus.send(o, f"psP:{self.name}", head, blob=blob)
+            self.bytes_pushed += len(blob)
 
     def push_dense(self, grad: np.ndarray) -> None:
         """Whole-vector gradient push, split into per-owner contiguous
@@ -2989,7 +3250,8 @@ class ShardedPSTrainer:
                  num_processes: int, *, staleness: float = 0,
                  gate_timeout: float = 60.0, monitor=None,
                  rebalance: Optional[str] = None,
-                 serve: Optional[str] = None):
+                 serve: Optional[str] = None,
+                 elastic: Optional[str] = None):
         self.tables = tables
         self.bus = bus
         self.num_processes = num_processes
@@ -3017,15 +3279,27 @@ class ShardedPSTrainer:
             t.bind_consistency(self)
         self.gossip.add_listener(self._drain_parked)
         # heat-aware shard rebalancing (balance/): OFF by default —
-        # explicit spec wins, else $MINIPS_REBALANCE, else disabled
+        # explicit spec wins, else $MINIPS_REBALANCE, else disabled.
+        # The elastic membership plane (below) needs the migration
+        # MACHINERY either way: when only MINIPS_ELASTIC is armed the
+        # rebalancer is constructed with its heat planner disabled —
+        # here, not later, because attach_rebalancer rebuilds the
+        # router/heat that the serve plane must see final.
         spec = rebalance if rebalance is not None \
             else os.environ.get("MINIPS_REBALANCE", "")
+        espec = elastic if elastic is not None \
+            else os.environ.get("MINIPS_ELASTIC", "")
+        if espec == "0":
+            espec = ""
         self.rebalancer = None
-        if spec and spec != "0":
+        if (spec and spec != "0") or espec:
             from minips_tpu.balance.rebalancer import (RebalanceConfig,
                                                        Rebalancer)
 
-            self.rebalancer = Rebalancer(self, RebalanceConfig.parse(spec))
+            heat_on = bool(spec and spec != "0")
+            self.rebalancer = Rebalancer(
+                self, RebalanceConfig.parse(spec if heat_on else ""),
+                plan_heat=heat_on)
         # read-mostly serving plane (serve/): OFF by default — explicit
         # spec wins, else $MINIPS_SERVE, else disabled. Constructed
         # AFTER the rebalancer: attach_rebalancer rebuilds router+heat
@@ -3038,6 +3312,26 @@ class ShardedPSTrainer:
             from minips_tpu.serve.plane import ServeConfig, ServePlane
 
             self.serve_plane = ServePlane(self, ServeConfig.parse(sspec))
+        # elastic membership (balance/membership.py): OFF by default —
+        # ranks join/leave the live job, deaths restore from the
+        # elastic checkpoint onto survivors. Constructed LAST: it rides
+        # the rebalancer (armed above) and hooks the monitor/gate.
+        self.membership = None
+        if espec:
+            from minips_tpu.balance.membership import (Membership,
+                                                       MembershipConfig)
+
+            self.membership = Membership(self,
+                                         MembershipConfig.parse(espec))
+            self.gate.membership = self.membership
+            for t in tables.values():
+                t.attach_membership(self.membership)
+        # seeded process-death injection (comm/chaos.py,
+        # $MINIPS_CHAOS_KILL): armed per-rank, checked at every tick —
+        # the launcher-level kill drill every sharded app inherits
+        from minips_tpu.comm.chaos import install_chaos_kill
+
+        self._kill_check = install_chaos_kill(bus.my_id, num_processes)
 
     def admit_pull(self, clk: int) -> bool:
         """Reference ``model->Get`` admission: serve a pull stamped with
@@ -3103,11 +3397,21 @@ class ShardedPSTrainer:
         measures. Ack settlement — pure loss detection — stays off the
         step path in both regimes: the window/queue backpressure bounds
         it and finalize() hard-drains it."""
+        if self._kill_check is not None:
+            # seeded death drill: SIGKILL lands HERE, before the drain
+            # and before the clock frame — the corpse's last published
+            # clock is the previous step's, exactly a mid-step loss
+            self._kill_check(self.clock)
         drain = self.staleness != float("inf")
         for t in self.tables.values():
             if drain:
                 t.flush_pushes(acks=False)  # a jammed drain poisons…
             t.check_fatal()                 # …and this raises, no hang
+        if self.membership is not None:
+            # BEFORE the rebalancer's adoption point: a transition plan
+            # issued here is adopted in this same tick at the
+            # coordinator, at the next boundary everywhere else
+            self.membership.on_tick()
         if self.rebalancer is not None:
             # THE clock boundary: step-k pushes are drained to the bus
             # above, the clock frame has not gone out yet — adopt any
@@ -3146,6 +3450,8 @@ class ShardedPSTrainer:
         """Two-sided quiesce: my pushes applied at all owners (their acks)
         AND all peers' pushes applied at my shards (their flushes). After
         this, pull/pull_all return identical rows on every live process."""
+        if self.membership is not None:
+            self.membership.quiesce()  # no further transitions
         if self.rebalancer is not None:
             # no further plans; a plan that landed after my last tick
             # still gets adopted + acked here so peers' fences release
@@ -3322,6 +3628,13 @@ class ShardedPSTrainer:
         subsystem is off, so scrapers can tell 'off' from 'idle'."""
         return (self.rebalancer.stats()
                 if self.rebalancer is not None else None)
+
+    def membership_stats(self) -> Optional[dict]:
+        """Elastic-membership counters (balance/membership.py): the
+        live/standby/dead/left sets, transition counts, and restored
+        blocks — None when MINIPS_ELASTIC is off (off vs idle)."""
+        return (self.membership.stats()
+                if self.membership is not None else None)
 
     def cache_stats(self) -> Optional[dict]:
         """Merged row-cache counters over all tables (None when every

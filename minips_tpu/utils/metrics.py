@@ -98,4 +98,10 @@ def wire_record(trainer) -> dict:
         # rebalancer counters (balance/): None when the subsystem is
         # off (distinguishable from an armed-but-idle run)
         "rebalance": trainer.rebalance_stats(),
+        # elastic membership plane (balance/membership.py): None when
+        # MINIPS_ELASTIC is off; armed runs carry the live/standby/
+        # dead/left sets and transition counters (getattr: the bench
+        # worker's standalone record has no trainer behind it)
+        "membership": getattr(trainer, "membership_stats",
+                              lambda: None)(),
     }

@@ -14,7 +14,8 @@ REPO = pathlib.Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO / "ci"))
 
 from bench_regression import (backend_mismatch, cache_tripwires,  # noqa: E402
-                              chaos_tripwires, compare, main,
+                              chaos_tripwires, compare,
+                              elastic_tripwires, main,
                               rebalance_tripwires, serve_tripwires,
                               throughput_points, trace_tripwires,
                               transport_tripwires)
@@ -428,6 +429,62 @@ def test_main_end_to_end_exit_codes(tmp_path):
     assert main([str(p), str(n)]) == 0
     n.write_text(json.dumps(_art({"a": 50.0})))
     assert main([str(p), str(n)]) == 1
+
+
+def _elastic_art(kill: dict, join: dict, steady=None) -> dict:
+    return {"elastic_membership_3proc": {
+        "steady": ({"completed": True} if steady is None else steady),
+        "kill": kill, "join": join}}
+
+
+_GOOD_KILL = {"completed": True, "blocks_restored": 12,
+              "wire_frames_lost": 0, "loss_last": 0.69,
+              "finals_agree": True}
+_GOOD_JOIN = {"completed": True, "joiner_serve_rows": 431,
+              "joiner_serve_requests": 17}
+
+
+def test_elastic_tripwires_pass_on_healthy_arms():
+    assert elastic_tripwires(_elastic_art(_GOOD_KILL, _GOOD_JOIN)) == []
+    # absent sweep (other benches): vacuous
+    assert elastic_tripwires({}) == []
+
+
+def test_elastic_dead_trips_on_each_failure_mode():
+    # survivors died
+    probs = elastic_tripwires(_elastic_art(
+        {"completed": False, "error": "x"}, _GOOD_JOIN))
+    assert len(probs) == 1 and "ELASTIC-DEAD" in probs[0]
+    # completed but nothing restored = death path silently disabled
+    probs = elastic_tripwires(_elastic_art(
+        {**_GOOD_KILL, "blocks_restored": 0}, _GOOD_JOIN))
+    assert any("0 ranges restored" in p for p in probs)
+    # unrecovered frames leaked through the transition
+    probs = elastic_tripwires(_elastic_art(
+        {**_GOOD_KILL, "wire_frames_lost": 3}, _GOOD_JOIN))
+    assert any("unrecovered" in p for p in probs)
+    # non-finite loss / missing loss
+    for bad in (float("nan"), float("inf"), None):
+        probs = elastic_tripwires(_elastic_art(
+            {**_GOOD_KILL, "loss_last": bad}, _GOOD_JOIN))
+        assert any("not finite" in p for p in probs), bad
+    # survivors diverged
+    probs = elastic_tripwires(_elastic_art(
+        {**_GOOD_KILL, "finals_agree": False}, _GOOD_JOIN))
+    assert any("disagree" in p for p in probs)
+    # an armed-idle fleet failing to complete also trips
+    probs = elastic_tripwires(_elastic_art(
+        _GOOD_KILL, _GOOD_JOIN, steady={"completed": False}))
+    assert any("steady" in p for p in probs)
+
+
+def test_elastic_join_trips_on_dead_or_idle_joiner():
+    probs = elastic_tripwires(_elastic_art(
+        _GOOD_KILL, {"completed": False, "error": "x"}))
+    assert len(probs) == 1 and "ELASTIC-JOIN" in probs[0]
+    probs = elastic_tripwires(_elastic_art(
+        _GOOD_KILL, {**_GOOD_JOIN, "joiner_serve_rows": 0}))
+    assert len(probs) == 1 and "served 0 rows" in probs[0]
 
 
 @pytest.mark.slow

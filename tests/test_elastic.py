@@ -209,6 +209,128 @@ def test_reshard_roundtrip_property(num_rows, old_n, new_n, seed):
         np.testing.assert_array_equal(got_m, moments)
 
 
+def _write_rebalanced_world(ck, step, table_vals, moments, old_n, blk,
+                            overlay):
+    """Handcraft a REBALANCED world's shard files: every rank records
+    the same routing metadata; each overlay block's LIVE rows sit in
+    its owner's flat ``xtra/<b>/...`` section while the home slab keeps
+    garbage (a dead copy, as the live system leaves it)."""
+    rows = table_vals.shape[0]
+    old_sz = -(-rows // old_n)
+    bps = -(-old_sz // blk)
+    meta = {"ep": np.asarray(3), "rb_block": np.asarray(blk),
+            "ovb": np.asarray(sorted(overlay), np.int64),
+            "ovo": np.asarray([overlay[b] for b in sorted(overlay)],
+                              np.int64)}
+    for r in range(old_n):
+        lo = r * old_sz
+        w = np.zeros((old_sz, 2), np.float32)
+        m = np.zeros((old_sz, 2), np.float32)
+        valid = max(0, min(rows - lo, old_sz))
+        w[:valid] = table_vals[lo:lo + valid]
+        m[:valid] = moments[lo:lo + valid]
+        extra = dict(meta)
+        extra["m"] = m
+        for b, o in overlay.items():
+            shard, loc = divmod(b, bps)
+            blo = shard * old_sz + loc * blk
+            bln = min(blk, old_sz - loc * blk)
+            if shard == r:  # home slab: poison the dead copy
+                w[loc * blk:loc * blk + bln] = -777.0
+            if o == r:      # owner: the live rows ride xtra
+                bv = np.zeros((bln, 2), np.float32)
+                bm = np.zeros((bln, 2), np.float32)
+                v = max(0, min(rows - blo, bln))
+                bv[:v] = table_vals[blo:blo + v]
+                bm[:v] = moments[blo:blo + v]
+                extra[f"xtra/{b}/w"] = bv
+                extra[f"xtra/{b}/m"] = bm
+        _write_step(ck, r, step, "w", rows, old_n,
+                    value_of=lambda g: 0.0, extra={"w": w, **extra})
+
+
+def test_reshard_through_overlay_matches_unmigrated_oracle(tmp_path):
+    """The overlay-aware elastic restore (membership satellite): a
+    checkpoint saved MID-REBALANCE at 3 ranks reshards to 2 AND to 4
+    with every row (params and optimizer leaf) BITWISE equal to the
+    unmigrated oracle table — overlay blocks read from their owners'
+    xtra sections, dead home copies ignored, no routing metadata
+    surviving the resize."""
+    ck = str(tmp_path)
+    rows, old_n, blk = 24, 3, 2
+    rng = np.random.default_rng(11)
+    oracle_w = rng.normal(size=(rows, 2)).astype(np.float32)
+    oracle_m = rng.normal(size=(rows, 2)).astype(np.float32)
+    # blocks 0 (rank0 home) -> rank 2, and 9 (rank2 home) -> rank 1
+    _write_rebalanced_world(ck, 5, oracle_w, oracle_m, old_n, blk,
+                            overlay={0: 2, 9: 1})
+    for new_n in (2, 4):
+        new_sz = -(-rows // new_n)
+        got_w = np.zeros((rows, 2), np.float32)
+        got_m = np.zeros((rows, 2), np.float32)
+        for r in range(new_n):
+            st = elastic.reshard_table_state(ck, 5, old_n, "w", rows,
+                                             r * new_sz, new_sz)
+            assert not ({"ep", "ovb", "ovo", "rb_block"} & set(st))
+            valid = max(0, min(rows - r * new_sz, new_sz))
+            got_w[r * new_sz:r * new_sz + valid] = st["w"][:valid]
+            got_m[r * new_sz:r * new_sz + valid] = st["m"][:valid]
+        np.testing.assert_array_equal(got_w, oracle_w)
+        np.testing.assert_array_equal(got_m, oracle_m)
+
+
+def test_load_block_state_reads_through_saved_overlay(tmp_path):
+    """The death path's restore unit: block state reads from wherever
+    the save-time overlay parked it — the owner's xtra for a moved
+    block, the home slab otherwise — and refuses a block-granularity
+    mismatch loudly."""
+    ck = str(tmp_path)
+    rows, old_n, blk = 24, 3, 2
+    old_sz = 8
+    rng = np.random.default_rng(12)
+    oracle_w = rng.normal(size=(rows, 2)).astype(np.float32)
+    oracle_m = rng.normal(size=(rows, 2)).astype(np.float32)
+    _write_rebalanced_world(ck, 5, oracle_w, oracle_m, old_n, blk,
+                            overlay={0: 2})
+    # block 0 (home rank 0, keys [0, 2)) lives in rank 2's xtra
+    st = elastic.load_block_state(ck, 5, "w", 0, 0, 2, 0, old_sz, blk)
+    np.testing.assert_array_equal(st["w"], oracle_w[:2])
+    np.testing.assert_array_equal(st["m"], oracle_m[:2])
+    # block 5 (home rank 1, keys [10, 12)) never moved: slab read
+    st5 = elastic.load_block_state(ck, 5, "w", 5, 10, 2, 1, old_sz,
+                                   blk)
+    np.testing.assert_array_equal(st5["w"], oracle_w[10:12])
+    with pytest.raises(ValueError, match="granularity"):
+        elastic.load_block_state(ck, 5, "w", 0, 0, 4, 0, old_sz, 4)
+
+
+def test_find_live_step_newest_complete_current_partition(tmp_path):
+    """The death-plan step pick: newest step ALL n ranks hold under
+    the caller's partition — torn steps skipped, other-world layouts
+    rejected."""
+    ck = str(tmp_path)
+    rows = 12
+    for r in range(3):
+        _write_step(ck, r, 5, "w", rows, 3, value_of=lambda g: g)
+        _write_step(ck, r, 10, "w", rows, 3, value_of=lambda g: g)
+    # step 12 torn (rank 2 missing)
+    for r in range(2):
+        _write_step(ck, r, 12, "w", rows, 3, value_of=lambda g: g)
+    t3 = {"w": _FakeTable(rows, 3, 0)}
+    assert elastic.find_live_step(ck, t3, 3) == 10
+    # a 2-way caller rejects every 3-way layout
+    t2 = {"w": _FakeTable(rows, 2, 0)}
+    assert elastic.find_live_step(ck, t2, 2) is None
+    # a never-checkpointed standby (required but dir-less) must not
+    # veto recovery: its home range lives in live ranks' files
+    t4 = {"w": _FakeTable(rows, 4, 0)}
+    assert elastic.find_live_step(ck, t3, 3,
+                                  required={0, 1, 2, 3}) == 10
+    # ...but a world with NO dirs at all has nothing to restore from
+    assert elastic.find_live_step(str(tmp_path / "empty"), t4, 4) \
+        is None
+
+
 @pytest.mark.slow
 def test_elastic_shrink_then_grow_end_to_end(tmp_path):
     """The drill: 3 ranks train 20 iters with shard checkpoints; a

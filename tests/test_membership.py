@@ -1,0 +1,432 @@
+"""Elastic membership — ranks join and leave a live job (this PR).
+
+Unit tier: the MINIPS_ELASTIC / MINIPS_CHAOS_KILL / MINIPS_HEARTBEAT
+spec parsers, the evacuation/admission planners' invariants, gossip
+re-inclusion, and the zero-copy blob satellites.
+
+Drill tier (real processes over loopback, the acceptance criteria):
+
+- DEATH: a 3-proc SSP run with a seeded SIGKILL of one server rank
+  mid-run COMPLETES — the corpse's ranges restore from the elastic
+  checkpoint onto survivors (through the rebalance overlay machinery),
+  the staleness bound holds throughout, zero poisons, zero unrecovered
+  frames, and the survivors' finals agree bitwise.
+- JOIN: a 3-live/1-standby run admits the 4th rank mid-run; the joiner
+  ends owning migrated blocks and serving pulls, SSP bound held.
+- DRAIN (slow): the graceful twin — the drained rank exits rc 0 with
+  zero restored state while survivors finish.
+- BITWISE (in-proc lockstep): MINIPS_ELASTIC armed but idle is
+  bitwise-equal to the elastic-off run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu import launch
+from minips_tpu.balance.membership import (MembershipConfig,
+                                           plan_admission,
+                                           plan_evacuation)
+from minips_tpu.comm.chaos import KillSpec
+from minips_tpu.comm.heartbeat import liveness_knobs
+from minips_tpu.parallel.partition import BlockRouter, RangePartitioner
+
+APP = "minips_tpu.apps.sharded_ps_example"
+
+
+# ------------------------------------------------------------ spec parsing
+def test_membership_config_parses_and_rejects_garbage():
+    c = MembershipConfig.parse("live=0-2,grace=20")
+    assert c.live == {0, 1, 2} and c.grace == 20.0
+    assert MembershipConfig.parse("1").live is None  # all ranks live
+    assert MembershipConfig.parse("live=0+3").live == {0, 3}
+    with pytest.raises(ValueError, match="unknown knob"):
+        MembershipConfig.parse("explode=1")
+    with pytest.raises(ValueError, match="k=v"):
+        MembershipConfig.parse("live")
+    with pytest.raises(ValueError, match="grace"):
+        MembershipConfig.parse("grace=abc")
+
+
+def test_kill_spec_parses_resolves_deterministically():
+    ks = KillSpec.parse("77:rank=2,step=12")
+    assert ks.resolve(3) == (2, 12)
+    # seeded forms: same (seed, nprocs) -> same verdict, every time
+    ks2 = KillSpec.parse("77:rank=-1,step=10-20")
+    assert ks2.resolve(3) == ks2.resolve(3)
+    r, s = ks2.resolve(3)
+    assert 1 <= r < 3 and 10 <= s <= 20  # rank 0 (coordinator) exempt
+    assert ks2.resolve(4) == ks2.resolve(4)
+    with pytest.raises(ValueError, match="seed"):
+        KillSpec.parse("x:rank=1,step=2")
+    with pytest.raises(ValueError, match="unknown knob"):
+        KillSpec.parse("1:rank=1,step=2,boom=3")
+    with pytest.raises(ValueError, match="both"):
+        KillSpec.parse("1:rank=1")
+    with pytest.raises(ValueError, match="step"):
+        KillSpec.parse("1:rank=1,step=0")
+
+
+def test_heartbeat_env_knobs(monkeypatch):
+    monkeypatch.delenv("MINIPS_HEARTBEAT", raising=False)
+    assert liveness_knobs(0.2, 2.0) == (0.2, 2.0)  # unset = defaults
+    monkeypatch.setenv("MINIPS_HEARTBEAT", "")
+    assert liveness_knobs(0.2, 2.0) == (0.2, 2.0)  # explicit empty too
+    monkeypatch.setenv("MINIPS_HEARTBEAT", "interval=0.05,timeout=0.5")
+    assert liveness_knobs(0.2, 2.0) == (0.05, 0.5)
+    monkeypatch.setenv("MINIPS_HEARTBEAT", "timeout=9")
+    assert liveness_knobs(0.2, 2.0) == (0.2, 9.0)  # knobs independent
+    monkeypatch.setenv("MINIPS_HEARTBEAT", "pulse=1")
+    with pytest.raises(ValueError, match="unknown knob"):
+        liveness_knobs(0.2, 2.0)
+    monkeypatch.setenv("MINIPS_HEARTBEAT", "interval=2,timeout=1")
+    with pytest.raises(ValueError, match="exceed"):
+        liveness_knobs(0.2, 2.0)
+
+
+# --------------------------------------------------------------- planners
+def _router(rows=64, shards=4, block=4):
+    return BlockRouter(RangePartitioner(rows, shards), block)
+
+
+def test_plan_evacuation_covers_victim_and_respects_home_rule():
+    r = _router()
+    ov = plan_evacuation(r, {3}, [0, 1, 2])
+    r.apply(1, ov)  # raises if any entry maps a block home
+    owners = r.owner_of_blocks()
+    assert not (owners == 3).any()  # the victim owns NOTHING
+    # round-robin: targets share the victim's blocks within +/-1
+    counts = [int((owners[12:16] == t).sum()) for t in (0, 1, 2)]
+    assert max(counts) - min(counts) <= 1
+    with pytest.raises(ValueError, match="no live targets"):
+        plan_evacuation(r, {0}, [])
+
+
+def test_plan_admission_returns_home_blocks():
+    r = _router()
+    r.apply(1, plan_evacuation(r, {3}, [0, 1, 2]))  # bootstrap: 3 out
+    ov = plan_admission(r, 3)
+    assert ov == {}  # every rank-3 home block comes home
+    r.apply(2, ov)
+    assert (r.owner_of_blocks()[12:16] == 3).all()
+
+
+def test_plan_evacuation_preserves_unrelated_overlay_entries():
+    r = _router()
+    r.apply(1, {0: 2})  # a heat migration parked block 0 on rank 2
+    ov = plan_evacuation(r, {3}, [0, 1])
+    assert ov[0] == 2  # untouched by rank 3's evacuation
+    assert all(o != 3 for o in ov.values())
+
+
+# ----------------------------------------------------------------- gossip
+def test_clock_gossip_include_restores_min_membership():
+    from tests.conftest import mk_loopback_buses
+
+    from minips_tpu.comm.bus import ClockGossip
+
+    buses = mk_loopback_buses(2)
+    try:
+        g0 = ClockGossip(buses[0], 2, workers_per_process=1)
+        ClockGossip(buses[1], 2, workers_per_process=1)
+        g0.exclude(1)
+        g0.publish_local([5])
+        assert g0.global_min() == 5  # rank 1 out of the view
+        g0._on_clock(1, {"clocks": [3]})  # stored even while excluded
+        g0.include(1)
+        assert g0.global_min() == 3  # back in, with its stored clock
+    finally:
+        for b in buses:
+            b.close()
+
+
+# ------------------------------------------------------- zero-copy blobs
+def test_as_blob_and_cat_blob_are_single_copy():
+    from minips_tpu.train.sharded_ps import _as_blob, _cat_blob
+
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    view = _as_blob(arr)
+    # the view aliases the array: NO copy happened
+    assert np.shares_memory(np.frombuffer(view, np.float32), arr)
+    assert len(view) == arr.nbytes
+    cat = _cat_blob(arr, np.int8([1, 2, 3]))
+    assert bytes(cat) == arr.tobytes() + bytes([1, 2, 3])
+
+
+def test_pull_reply_f32_wire_is_zero_copy():
+    """The no-copy pin (PR7's documented free win): the f32 pull-reply
+    blob must BE the served rows' memory, not a tobytes() copy."""
+    from minips_tpu.train.sharded_ps import ShardedTable
+
+    t = ShardedTable("t", 16, 4, None, 0, 1, updater="sgd")
+    rows = np.random.default_rng(0).normal(
+        size=(5, 4)).astype(np.float32)
+    head, blob = t._reply_head_blob(1, rows)
+    assert head["wire"] == "f32"
+    assert isinstance(blob, memoryview)
+    assert np.shares_memory(np.frombuffer(blob, np.float32), rows)
+    # int8 replies: one single-allocation assembly, layout unchanged
+    t.pull_wire = "int8"
+    head8, blob8 = t._reply_head_blob(2, rows)
+    from minips_tpu.ops.quantized_comm import quantize_rows_int8
+
+    codes, scale = quantize_rows_int8(rows)
+    assert bytes(blob8) == scale.tobytes() + codes.tobytes()
+
+
+def test_pull_all_parks_future_epoch_requests():
+    """A shard-assembly request stamped with a NEWER routing epoch than
+    mine must park until my adoption catches up: a pre-adoption reply
+    would omit every block the new table assigns to me (a death plan's
+    restored blocks have no other live holder — the assembler would
+    read uninitialized rows)."""
+    from minips_tpu.train.sharded_ps import ShardedTable
+
+    class _RB:
+        def adopt_now(self):
+            pass
+
+    t = ShardedTable("t", 64, 1, None, 0, 2, updater="sgd")
+    from minips_tpu.balance.rebalancer import RebalanceConfig
+
+    t.attach_rebalancer(_RB(), RebalanceConfig.parse("block=4"))
+    assert t._pull_all_verdict(0) == "serve"
+    assert t._pull_all_verdict(3) == "park"   # requester is ahead
+    t.router.apply(3, {})
+    assert t._pull_all_verdict(3) == "serve"  # caught up
+
+
+# ----------------------------------------------- in-proc bitwise lockstep
+def _lockstep_trainer_run(elastic: str):
+    """2-rank threads-as-nodes BSP run with DISJOINT cross-shard key
+    sets (single-writer rows: per-link FIFO fixes the fp apply order
+    bit-for-bit) — the armed-idle-vs-off bitwise harness."""
+    from tests.conftest import mk_loopback_buses
+
+    from minips_tpu.train.sharded_ps import (ShardedPSTrainer,
+                                             ShardedTable)
+
+    buses = mk_loopback_buses(2)
+    tables = [ShardedTable("t", 64, 2, buses[i], i, 2, updater="sgd",
+                           lr=0.5, pull_timeout=20.0)
+              for i in range(2)]
+    trainers = [ShardedPSTrainer({"t": tables[i]}, buses[i], 2,
+                                 staleness=0, gate_timeout=30.0,
+                                 rebalance="", serve="",
+                                 elastic=elastic)
+                for i in range(2)]
+    for t in tables:
+        t._w[...] = np.arange(32 * 2, dtype=np.float32
+                              ).reshape(32, 2) / 7.0
+    keysets = [np.array([33, 40, 33, 47]), np.array([1, 8, 1, 15])]
+    errs: list = []
+    finals: list = [None, None]
+
+    import threading
+
+    def worker(r):
+        try:
+            for _ in range(5):
+                rows = tables[r].pull(keysets[r])
+                tables[r].push(keysets[r], 0.1 * rows + 1.0)
+                trainers[r].tick()
+            trainers[r].finalize(timeout=20.0)
+            finals[r] = tables[r].pull_all()
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    try:
+        ths = [threading.Thread(target=worker, args=(r,))
+               for r in (0, 1)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=60.0)
+        assert not errs, errs
+        assert finals[0] is not None
+        np.testing.assert_array_equal(finals[0], finals[1])
+        return finals[0]
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_elastic_armed_idle_is_bitwise_equal_to_off():
+    """The BSP bitwise drill (acceptance): MINIPS_ELASTIC armed with
+    every rank live and no join/leave/death must be BITWISE equal to
+    the elastic-off run — the plane's tax is frames, never numerics."""
+    off = _lockstep_trainer_run("")
+    on = _lockstep_trainer_run("1")
+    np.testing.assert_array_equal(off, on)
+
+
+# ------------------------------------------------------- process drills
+def _run_raw(n, extra, env, timeout=200.0):
+    return launch.run_local_job_raw(
+        n, [sys.executable, "-m", APP] + extra, base_port=None,
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                   **env},
+        timeout=timeout, kill_on_failure=False)
+
+
+BASE = ["--model", "sparse", "--mode", "ssp", "--staleness", "2",
+        "--iters", "30", "--batch", "64"]
+
+
+def test_death_drill_seeded_sigkill_survivors_complete(tmp_path):
+    """THE acceptance drill: seeded SIGKILL of server rank 2 at clock
+    12; survivors restore its ranges from the step-10 elastic
+    checkpoint (through the overlay machinery), hold the SSP bound,
+    finish all 30 steps, and agree bitwise — zero poisons, zero
+    unrecovered frames. Deterministic: the same MINIPS_CHAOS_KILL spec
+    reproduces the same death."""
+    ck = str(tmp_path / "ck")
+    rc, events = _run_raw(
+        3, BASE + ["--checkpoint-dir", ck, "--checkpoint-every", "5"],
+        {"MINIPS_ELASTIC": "1",
+         "MINIPS_CHAOS_KILL": "7:rank=2,step=12",
+         "MINIPS_HEARTBEAT": "interval=0.1,timeout=1.0"})
+    # the victim dies by SIGKILL (rc reflects it); the SURVIVORS are
+    # the drill: both must print full done lines
+    dones = {r: ev[-1] for r, ev in enumerate(events)
+             if ev and ev[-1].get("event") == "done"}
+    assert set(dones) == {0, 1}, (rc, events)
+    for d in dones.values():
+        assert d["clock"] == 30
+        assert d["max_skew_seen"] <= 3          # SSP bound held
+        assert d["frames_dropped"] == 0          # zero poisons
+        assert d["wire_frames_lost"] == 0        # zero unrecovered
+        assert np.isfinite(d["loss_last"])
+        m = d["membership"]
+        assert m["dead"] == [2] and m["live"] == [0, 1]
+    # >= 1 range restored from the elastic checkpoint, fleet-wide
+    assert sum(d["membership"]["blocks_restored"]
+               for d in dones.values()) >= 1
+    # survivors agree BITWISE on the final table
+    sums = [d["param_sum"] for d in dones.values()]
+    norms = [d["param_norm"] for d in dones.values()]
+    assert sums[0] == sums[1] and norms[0] == norms[1], (sums, norms)
+
+
+def test_join_drill_standby_admitted_mid_run(tmp_path):
+    """The join acceptance drill: a 4-slot world starts with ranks 0-2
+    live; rank 3 announces at clock 10, is admitted at an epoch
+    boundary, receives its home blocks under the rbS/rbA/rbF fence,
+    and finishes the run OWNING blocks and SERVING pulls, SSP bound
+    held throughout the handoff."""
+    ck = str(tmp_path / "ck")
+    rc, events = _run_raw(
+        4, BASE + ["--join-at", "10", "--checkpoint-dir", ck,
+                   "--checkpoint-every", "5"],
+        {"MINIPS_ELASTIC": "live=0-2"})
+    assert rc == 0, events
+    dones = [ev[-1] for ev in events]
+    assert all(d["event"] == "done" for d in dones), events
+    for d in dones:
+        assert d["clock"] == 30
+        assert d["max_skew_seen"] <= 3
+        assert d["frames_dropped"] == 0 and d["wire_frames_lost"] == 0
+        assert d["membership"]["live"] == [0, 1, 2, 3]
+    joiner = dones[3]
+    # the admit clock is the COORDINATOR's clock at the boundary it
+    # planned — it may trail the fleet max (the --join-at trigger) by
+    # up to the staleness bound
+    assert joiner["resumed_from"] >= 10 - 2    # trained from the admit
+    assert joiner["serve"]["pull_requests"] > 0  # serving pulls
+    assert joiner["serve"]["pull_rows"] > 0
+    # all four agree bitwise post-finalize
+    assert len({d["param_sum"] for d in dones}) == 1, dones
+
+
+@pytest.mark.slow
+def test_drain_drill_graceful_leave_rc0_no_restore(tmp_path):
+    """The graceful-drain twin: rank 2 drains at step 12 — ships its
+    blocks to survivors under the fence, exits rc 0 with event
+    'drained' and ZERO restored state anywhere; survivors finish with
+    agreement."""
+    ck = str(tmp_path / "ck")
+    res = launch.run_local_job(
+        3, [sys.executable, "-m", APP] + BASE
+        + ["--drain-at", "12", "--drain-rank", "2",
+           "--checkpoint-dir", ck, "--checkpoint-every", "5"],
+        base_port=None,
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                   "MINIPS_ELASTIC": "1"},
+        timeout=200.0)
+    assert res[2]["event"] == "drained"
+    assert res[2]["membership"]["left"] == [2]
+    for r in res:
+        assert (r.get("membership") or {}).get("blocks_restored",
+                                               0) == 0
+        assert r.get("wire_frames_lost", 0) == 0
+    dones = res[:2]
+    assert all(d["event"] == "done" and d["clock"] == 30
+               for d in dones)
+    assert dones[0]["param_sum"] == dones[1]["param_sum"]
+
+
+@pytest.mark.slow
+def test_sigterm_triggers_drain(tmp_path):
+    """SIGTERM is the preemption signal: delivered mid-run to rank 1,
+    the app drains instead of dying — same path as --drain-at."""
+    import subprocess
+    import tempfile
+
+    ck = str(tmp_path / "ck")
+    n = 3
+    base_port = launch.find_free_base_port(n)
+    hosts = ["localhost"] * n
+    outs = [tempfile.NamedTemporaryFile("w+", delete=False)
+            for _ in hosts]
+    procs = []
+    for rank in range(n):
+        env = launch.child_env(rank, hosts, base_port)
+        env.update({"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                    "MINIPS_ELASTIC": "1"})
+        # a paced long run (rank 0 sleeps 25ms/step) so the SIGTERM
+        # below reliably lands MID-run, not after completion
+        procs.append(launch._spawn_rank(
+            [sys.executable, "-m", APP] + BASE
+            + ["--iters", "400", "--slow-rank", "0", "--slow-ms", "25",
+               "--checkpoint-dir", ck, "--checkpoint-every", "50"],
+            env, outs[rank]))
+    # let training start, then preempt rank 1
+    time.sleep(5.0)
+    procs[1].terminate()  # SIGTERM
+    rc = launch.wait(procs, timeout=180.0, kill_on_failure=False)
+    texts = []
+    for f in outs:
+        f.flush()
+        f.seek(0)
+        texts.append(f.read())
+        f.close()
+        os.unlink(f.name)
+    assert rc == 0, texts
+    lines1 = [json.loads(ln) for ln in texts[1].splitlines()
+              if ln.strip().startswith("{")]
+    assert lines1 and lines1[-1]["event"] == "drained", texts[1][-800:]
+
+
+@pytest.mark.slow
+def test_death_without_checkpoint_falls_back_to_gang_restart(tmp_path):
+    """A death the plane cannot own (no checkpoint anywhere) must stay
+    exactly as loud as the reference: PeerFailureError, exit 42 — not
+    a limping run of timeouts."""
+    rc, events = _run_raw(
+        3, BASE,  # no --checkpoint-dir
+        {"MINIPS_ELASTIC": "1",
+         "MINIPS_CHAOS_KILL": "7:rank=2,step=12",
+         "MINIPS_HEARTBEAT": "interval=0.1,timeout=1.0"})
+    assert rc != 0
+    survivors = [ev[-1] for r, ev in enumerate(events)
+                 if r != 2 and ev]
+    assert len(survivors) == 2, events
+    for ev in survivors:
+        assert ev["event"] == "peer_failure", events
+        assert 2 in ev["dead"]

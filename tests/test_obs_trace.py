@@ -398,13 +398,14 @@ def test_wire_record_schema_full_layout():
     expected = {"bytes_pushed", "bytes_pulled", "frames_dropped",
                 "wire_frames_lost", "wire_frames_malformed", "timing",
                 "hist", "cache", "reliable", "chaos", "serve",
-                "rebalance"}
+                "rebalance", "membership"}
     assert expected <= set(rec)
     # layers OFF in this run report None — not {} — and vice versa
     assert rec["cache"] is None
     assert rec["reliable"] is None
     assert rec["chaos"] is None
     assert rec["rebalance"] is None
+    assert rec["membership"] is None
     # the hist block is ALWAYS a dict; populated quantities carry the
     # quantiles, idle ones carry {"count": 0}
     hist = rec["hist"]

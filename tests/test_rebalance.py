@@ -12,7 +12,7 @@ Three layers of drill:
   new table and transparently retried, the row cache drops migrated
   blocks, checkpoints round-trip the routing epoch/overlay/block
   state (and refuse to load without the subsystem armed; elastic
-  reshard refuses rebalanced checkpoints), a BSP run with the
+  reshard restores THROUGH rebalanced checkpoints), a BSP run with the
   rebalancer ON is bitwise-equal to OFF on uniform traffic
   (hysteresis: balanced traffic never migrates), a hypothesis property
   shows pulls admitted MID-MIGRATION never read staler than the SSP
@@ -382,16 +382,46 @@ def test_checkpoint_roundtrips_epoch_overlay_and_block_state(tmp_path):
             b.close()
 
 
-def test_elastic_reshard_refuses_rebalanced_checkpoints(tmp_path):
+def test_elastic_reshard_restores_through_overlay(tmp_path):
+    """The overlay-aware reshard (elastic membership): a rebalanced
+    checkpoint's moved blocks live in their owner's xtra section and
+    the home slab holds dead copies — the reshard must place the LIVE
+    rows (and optimizer leaves) wherever the new partition puts them,
+    and flatten the overlay away (no routing metadata survives)."""
     from minips_tpu.ckpt.elastic import reshard_table_state
 
-    d = tmp_path / "rank0" / "step_0000000001"
-    d.mkdir(parents=True)
-    np.savez(d / "t.npz", w=np.zeros((4, 2), np.float32),
-             lo=np.asarray(0), ep=np.asarray(2),
+    # 8 rows, 2 old ranks (shard 4), block=2: block 0 = keys [0, 2)
+    # moved from rank 0's home range to rank 1
+    d0 = tmp_path / "rank0" / "step_0000000001"
+    d0.mkdir(parents=True)
+    w0 = np.arange(8, dtype=np.float32).reshape(4, 2)  # rows 0-3 (dead b0)
+    np.savez(d0 / "t.npz", w=w0, m=w0 + 100, lo=np.asarray(0),
+             ep=np.asarray(2), rb_block=np.asarray(2),
              ovb=np.asarray([0]), ovo=np.asarray([1]))
-    with pytest.raises(ValueError, match="rebalanced"):
-        reshard_table_state(str(tmp_path), 1, 2, "t", 8, 0, 4)
+    d1 = tmp_path / "rank1" / "step_0000000001"
+    d1.mkdir(parents=True)
+    w1 = np.arange(8, 16, dtype=np.float32).reshape(4, 2)  # rows 4-7
+    live_b0 = np.full((2, 2), 55.0, np.float32)  # block 0's LIVE rows
+    np.savez(d1 / "t.npz", w=w1, m=w1 + 100, lo=np.asarray(4),
+             ep=np.asarray(2), rb_block=np.asarray(2),
+             ovb=np.asarray([0]), ovo=np.asarray([1]),
+             **{"xtra/0/w": live_b0, "xtra/0/m": live_b0 + 1})
+
+    # reshard 2 -> 1 (whole table on one shard of 8)
+    st = reshard_table_state(str(tmp_path), 1, 2, "t", 8, 0, 8)
+    assert not ({"ep", "ovb", "ovo", "rb_block"} & set(st))
+    np.testing.assert_array_equal(st["w"][:2], live_b0)   # overlay wins
+    np.testing.assert_array_equal(st["m"][:2], live_b0 + 1)
+    np.testing.assert_array_equal(st["w"][2:4], w0[2:4])  # home rows
+    np.testing.assert_array_equal(st["w"][4:], w1)
+
+    # a torn rebalanced save (overlay recorded, owner's xtra missing)
+    # still refuses loudly instead of assembling dead rows
+    np.savez(d1 / "t.npz", w=w1, m=w1 + 100, lo=np.asarray(4),
+             ep=np.asarray(2), rb_block=np.asarray(2),
+             ovb=np.asarray([0]), ovo=np.asarray([1]))
+    with pytest.raises(ValueError, match="torn"):
+        reshard_table_state(str(tmp_path), 1, 2, "t", 8, 0, 8)
 
 
 def test_all_blocks_home_checkpoint_stays_elastic_reshardable():
