@@ -131,6 +131,11 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     # head-codec arm config (the transport sweep): explicit empty keeps
     # an armed environment from leaking a format into the other arms
     env_extra["MINIPS_WIRE_FMT"] = wire_fmt or ""
+    # push-wire tier rides the --push-comm flag; the env spelling is
+    # pinned EMPTY so an armed MINIPS_PUSH_COMM can't silently move a
+    # baseline arm onto the compressed wire (the table's env default
+    # only fires when the flag is absent — which is every f32 arm)
+    env_extra["MINIPS_PUSH_COMM"] = ""
     if n == 1:  # standalone zero-wire baseline (no launcher, no bus)
         proc = subprocess.run(argv, capture_output=True, text=True,
                               timeout=timeout,
@@ -168,7 +173,21 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
         # (26.7 f32 both legs → 20.0 one int8 leg → 13.3 both)
         "wire_bytes_per_row_moved": round(statistics.mean(
             [r["wire_bytes_per_row_moved"] for r in res]), 1),
+        # the push leg alone (WIRE-BYTES gates it: the compressed push
+        # tiers move push bytes only, so the pull leg must not dilute
+        # the comparison) — same rows-moved denominator as above
+        "wire_push_bytes_per_row_moved": round(statistics.mean(
+            [r["wire_push_bytes_per_sec"] / max(r["rows_per_sec"], 1e-9)
+             for r in res]), 3),
     }
+    efs = [r.get("ef") for r in res]
+    if any(e is not None for e in efs):
+        out["ef_resident_rows"] = sum((e or {}).get("resident_rows", 0)
+                                      for e in efs)
+        out["ef_flushed_rows"] = sum(
+            (e or {}).get("flushed_age", 0)
+            + (e or {}).get("flushed_fence", 0)
+            + (e or {}).get("flushed_overflow", 0) for e in efs)
     fracs = [r["timing"].get("pull_overlap_fraction")
              for r in res if r.get("timing")]
     fracs = [f for f in fracs if f is not None]
@@ -437,6 +456,87 @@ def main() -> int:
 
     cache_grid = _cache_arms(o_reps)
 
+    # THE COMPRESSED PUSH WIRE (this PR): the wire ladder's sparse tiers
+    # measured where they earn their keep — the zipf HOT-SET workload
+    # (same shape as the cache sweep's zipf arms: hot rows re-drawn
+    # every step) under SSP(1), sgd. Arms: f32 (seed), int8 (per-row
+    # absmax), topk8/topk4 (sparse top-k index+code streams with
+    # blockwise sub-8-bit quantization + error-feedback residuals,
+    # train/sharded_ps.ResidualStore). The number the WIRE-BYTES
+    # tripwire (ci/bench_regression.py) gates is PUSH bytes/row-moved:
+    # topk8 must beat int8 by >= 2x — rows/sec columns carry the same
+    # CPU-loopback caveat as the cache sweep (saved bytes are memcpys
+    # here; the byte lever converts to wall-clock on a real wire).
+    # WIRE-CONVERGE gates the convergence drill below: error feedback
+    # must pin the lr loss trajectory to the dense wire within
+    # tolerance, with zero residual mass stranded at finalize.
+    def _wire_comp_arms(reps: int) -> dict:
+        arms = {"f32": {}, "int8": {"push_comm": "int8"},
+                "topk8": {"push_comm": "topk8"},
+                "topk4": {"push_comm": "topk4"}}
+        runs: dict[str, list[dict]] = {a: [] for a in arms}
+        for _ in range(reps):
+            for a, kw in arms.items():
+                runs[a].append(_run(3, "sparse", iters, warmup, "zmq",
+                                    key_dist="zipf", staleness=1,
+                                    rows=ZIPF_ROWS, updater="sgd",
+                                    **kw))
+
+        def med(arm: str) -> dict:
+            by = sorted(runs[arm],
+                        key=lambda r: r["rows_per_sec_per_process"])
+            return {**by[len(by) // 2], "reps": reps}
+        grid: dict = {"zipf_rows": ZIPF_ROWS}
+        grid.update({a: med(a) for a in arms})
+        grid["converge"] = _wire_converge()
+        return grid
+
+    def _wire_converge() -> dict:
+        """The convergence drill arm (WIRE-CONVERGE): the sparse-LR
+        example at SSP(1), dense wire vs topk8 + error feedback —
+        completion-gated (no rows/sec key), the gate compares final
+        losses and asserts zero resident residual mass at exit."""
+        from minips_tpu import launch as _launch
+
+        e_iters = 15 if args.quick else 40
+        base = [sys.executable, "-m",
+                "minips_tpu.apps.sharded_ps_example",
+                "--model", "sparse", "--mode", "ssp",
+                "--staleness", "1", "--iters", str(e_iters),
+                "--batch", "256", "--updater", "sgd"]
+        env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                "MINIPS_CHAOS": "", "MINIPS_RELIABLE": "",
+                "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
+                "MINIPS_SERVE": "", "MINIPS_BUS": "",
+                "MINIPS_WIRE_FMT": "", "MINIPS_ELASTIC": "",
+                "MINIPS_CHAOS_KILL": "", "MINIPS_HEARTBEAT": "",
+                "MINIPS_PUSH_COMM": ""}
+        out: dict = {"iters": e_iters}
+        for arm, comm in (("f32", "float32"), ("topk8", "topk8")):
+            try:
+                res = _launch.run_local_job(
+                    3, base + ["--push-comm", comm], base_port=None,
+                    env_extra=env0, timeout=240.0)
+                losses = [r.get("loss_last") for r in res
+                          if r.get("loss_last") is not None]
+                fps = {r.get("param_fingerprint") for r in res}
+                efs = [r.get("ef") for r in res]
+                out[arm] = {
+                    "completed": True,
+                    "loss_last": max(losses) if losses else None,
+                    "finals_agree": len(fps) <= 1,
+                    "ef_resident_rows": sum(
+                        (e or {}).get("resident_rows", 0)
+                        for e in efs),
+                    "wire_frames_lost": sum(
+                        r.get("wire_frames_lost", 0) for r in res),
+                }
+            except Exception as e:  # noqa: BLE001 - completion-gated
+                out[arm] = {"completed": False, "error": str(e)[:300]}
+        return out
+
+    wire_comp_grid = _wire_comp_arms(o_reps)
+
     # chaos resilience (this PR): seeded frame loss on the live wire,
     # drop ∈ {0, 1%, 5%} × retransmit on/off, against a clean reference.
     # The claims each arm pins: "clean" vs "drop0_on" bounds the reliable
@@ -628,7 +728,8 @@ def main() -> int:
                            "MINIPS_SERVE": "", "MINIPS_BUS": "",
                            "MINIPS_WIRE_FMT": "", "MINIPS_ELASTIC": "",
                            "MINIPS_CHAOS_KILL": "",
-                           "MINIPS_HEARTBEAT": ""},
+                           "MINIPS_HEARTBEAT": "",
+                           "MINIPS_PUSH_COMM": ""},
                 timeout=timeout)
         except Exception as e:  # noqa: BLE001 - completion-gated arms
             return {"completed": False, "error": str(e)[:300]}
@@ -716,7 +817,7 @@ def main() -> int:
                 "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
                 "MINIPS_SERVE": "", "MINIPS_BUS": "",
                 "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
-                "MINIPS_HEARTBEAT": ""}
+                "MINIPS_HEARTBEAT": "", "MINIPS_PUSH_COMM": ""}
         kill_step = max(2, e_iters // 3)
         grid: dict = {"iters": e_iters, "kill_step": kill_step}
 
@@ -843,6 +944,7 @@ def main() -> int:
         "overlap_on_off_3proc": over,
         "overlap_on_off_fit": {"nprocs": n_fit, **over_fit},
         "cache_comparison_3proc": cache_grid,
+        "wire_compression_3proc": wire_comp_grid,
         "chaos_resilience_3proc": chaos_grid,
         "rebalance_3proc": rebalance_grid,
         "trace_overhead_3proc": trace_grid,

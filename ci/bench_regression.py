@@ -28,6 +28,11 @@ retransmit-on arm must have completed with zero unrecovered frames
 seed zmq-JSON arm on rows/sec with bytes/row unchanged, and the seeded
 chaos+reliable arm on the shm backend must complete with zero
 unrecovered frames (the fault layers must stack on the new transport).
+``wire_compression_tripwires`` (WIRE-BYTES/WIRE-CONVERGE) guards the
+``wire_compression_3proc`` sweep: the sparse top-k push wire must beat
+the int8 wire's push bytes/row by >= 2x on zipf with zero residual
+mass stranded, and the error-feedback convergence drill must pin the
+loss trajectory to the dense wire within tolerance.
 ``rebalance_tripwires`` (REBAL-SKEW/REBAL-DEAD) guards the
 ``rebalance_3proc`` sweep: the unpermuted-zipf rebalancer-on arm must
 complete with >= 1 migration and max/mean per-shard serve load
@@ -244,6 +249,96 @@ def transport_tripwires(new: dict) -> list[str]:
             f"chaos_dropped={comp.get('chaos_dropped')!r} "
             f"retransmits_got={comp.get('retransmits_got')!r} — the "
             "drill proved nothing (injector or repair never engaged)")
+    return problems
+
+
+WIRE_BYTES_FACTOR = 2.0  # topk8 push bytes/row must beat int8 by this
+# factor on the zipf hot-set arm — the integer-factor lever the sparse
+# index+code wire exists for (selection ships the mass, error feedback
+# repays the remainder compressed-or-aged, so paying MORE than half the
+# int8 wire means selection or the residual fold silently fell off).
+
+WIRE_CONVERGE_SLACK = 1.3  # topk8 final loss vs the dense wire's, plus
+# a small absolute epsilon: error feedback provably repays withheld
+# mass within the staleness bound, so the trajectories track within
+# run-to-run noise — a blowout here means residuals are stranded or
+# double-folded, which rows/sec alone would never catch.
+
+
+def wire_compression_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``wire_compression_3proc``
+    sweep (the sparse top-k + error-feedback push wire); vacuous when
+    the sweep is absent (other benches).
+
+    - WIRE-BYTES: the topk8 arm's PUSH bytes/row-moved must beat the
+      int8 arm's by >= ``WIRE_BYTES_FACTOR`` on the zipf workload,
+      with the arm completed, zero unrecovered frames, and zero
+      resident residual rows at exit (mass conservation is part of the
+      byte claim: a wire that 'saves' bytes by stranding gradient is
+      lying).
+    - WIRE-CONVERGE: the convergence drill (sparse LR at SSP(1), f32
+      vs topk8 + error feedback) must complete on both arms with the
+      topk8 final loss finite and within ``WIRE_CONVERGE_SLACK`` of
+      the dense wire's, survivors' finals bitwise-agreeing, and no
+      residual mass resident after finalize."""
+    grid = new.get("wire_compression_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    for arm in ("topk8", "topk4"):
+        a = grid.get(arm) or {}
+        if not a.get("completed"):
+            problems.append(
+                f"WIRE-BYTES wire_compression_3proc/{arm}: completed="
+                f"{a.get('completed')!r} — the compressed-push arm "
+                "must complete")
+        elif a.get("wire_frames_lost", 0):
+            problems.append(
+                f"WIRE-BYTES wire_compression_3proc/{arm}: "
+                f"{a['wire_frames_lost']} unrecovered frames")
+        elif a.get("ef_resident_rows"):
+            problems.append(
+                f"WIRE-BYTES wire_compression_3proc/{arm}: "
+                f"{a['ef_resident_rows']} residual rows resident after "
+                "finalize — error-feedback mass was stranded")
+    bi = (grid.get("int8") or {}).get("wire_push_bytes_per_row_moved")
+    bt = (grid.get("topk8") or {}).get("wire_push_bytes_per_row_moved")
+    if not (isinstance(bi, (int, float)) and isinstance(bt, (int, float))
+            and bi > 0 and bt <= bi / WIRE_BYTES_FACTOR):
+        problems.append(
+            f"WIRE-BYTES wire_compression_3proc: topk8 push "
+            f"bytes/row {bt!r} does not beat int8's {bi!r} by "
+            f">= {WIRE_BYTES_FACTOR}x on zipf — the sparse wire's "
+            "selection or residual fold is silently disabled")
+    conv = grid.get("converge") or {}
+    f32 = conv.get("f32") or {}
+    tk8 = conv.get("topk8") or {}
+    if not (f32.get("completed") and tk8.get("completed")):
+        problems.append(
+            f"WIRE-CONVERGE wire_compression_3proc/converge: f32 "
+            f"completed={f32.get('completed')!r} topk8 completed="
+            f"{tk8.get('completed')!r} — the drill arms must complete")
+        return problems
+    lf, lt = f32.get("loss_last"), tk8.get("loss_last")
+    finite = (isinstance(lt, (int, float)) and lt == lt
+              and abs(lt) != float("inf"))
+    if not finite or not isinstance(lf, (int, float)) \
+            or lt > lf * WIRE_CONVERGE_SLACK + 0.02:
+        problems.append(
+            f"WIRE-CONVERGE wire_compression_3proc/converge: topk8 "
+            f"loss {lt!r} vs dense {lf!r} (slack "
+            f"{WIRE_CONVERGE_SLACK}x) — error feedback is not "
+            "preserving the loss trajectory")
+    if not tk8.get("finals_agree"):
+        problems.append(
+            "WIRE-CONVERGE wire_compression_3proc/converge: topk8 "
+            "finals disagree across ranks — the residual flush left "
+            "replicas torn")
+    if tk8.get("ef_resident_rows"):
+        problems.append(
+            f"WIRE-CONVERGE wire_compression_3proc/converge: "
+            f"{tk8['ef_resident_rows']} residual rows resident after "
+            "finalize — mass stranded")
     return problems
 
 
@@ -601,6 +696,7 @@ def main(argv: list[str] | None = None) -> int:
     problems = (compare(prior, new, args.tolerance)
                 + cache_tripwires(new) + chaos_tripwires(new)
                 + transport_tripwires(new)
+                + wire_compression_tripwires(new)
                 + rebalance_tripwires(new) + trace_tripwires(new)
                 + serve_tripwires(new) + elastic_tripwires(new))
     pts = throughput_points(new)

@@ -316,13 +316,21 @@ def shard_checkpointing(bus, nprocs: int, checkpoint_dir, rank: int):
 
 def add_push_comm_flag(parser) -> None:
     """The shared --push-comm flag (one canonical definition for every
-    sharded-PS app): int8-compress cross-process gradient pushes with
-    per-row absmax codes + stochastic rounding (unbiased, no residual —
-    see ops/quantized_comm.quantize_rows_int8). Apps apply it to tables
-    wide enough to profit (dim >= ~8; at dim 1 the per-row f32 scale
-    outweighs the saving)."""
-    parser.add_argument("--push-comm", dest="push_comm",
-                        default="float32", choices=["float32", "int8"])
+    sharded-PS app) — the push-wire compression ladder:
+
+    - ``int8``: per-row absmax codes + stochastic rounding (unbiased,
+      no residual — ops/quantized_comm.quantize_rows_int8);
+    - ``topk8``/``topk4``: sparse top-k index+code streams — magnitude
+      selection over the owner-split gradient plus blockwise absmax
+      quantization at 8/4 bits, with the unsent mass kept in a
+      client-side error-feedback residual store flushed under the
+      staleness bound (train/sharded_ps.ResidualStore; docs/api.md
+      wire ladder).
+
+    Default None = ``$MINIPS_PUSH_COMM`` (empty = float32), resolved
+    by the table so env-armed sweeps need no flag plumbing."""
+    parser.add_argument("--push-comm", dest="push_comm", default=None,
+                        choices=["float32", "int8", "topk8", "topk4"])
 
 
 def add_wire_flags(parser) -> None:

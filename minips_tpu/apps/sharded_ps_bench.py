@@ -366,6 +366,7 @@ def main(argv=None) -> int:
         comm_timing=table.timers.summary,
         hist_stats=lambda: tables_hist_stats([table]),
         cache_stats=table.cache_stats,
+        ef_stats=table.ef_stats,
         reliable_stats=lambda: None, chaos_stats=lambda: None,
         # the standalone path has no trainer, hence no serve plane:
         # the replica sub-block is None (off) like the other layers
@@ -375,7 +376,7 @@ def main(argv=None) -> int:
     print(json.dumps({
         "rank": rank, "event": "done",
         "path": args.path, "nprocs": nprocs,
-        "push_comm": args.push_comm,
+        "push_comm": table.push_comm,  # resolved (None defers to env)
         "pull_wire": args.pull_wire,   # echo: bench asserts negotiation
         "overlap": bool(args.overlap),
         "overlap_legs": args.overlap_legs if args.overlap else None,

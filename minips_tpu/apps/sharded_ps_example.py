@@ -261,6 +261,9 @@ def main(argv=None) -> int:
             "loss_last": (float(np.mean(losses[-5:]))
                           if losses else None),
             "clock": trainer.clock,
+            # a leaver exiting with resident residual rows would be
+            # silently-lost gradient mass: the drain drill asserts 0
+            "ef": trainer.ef_stats(),
             "elastic_spec": os.environ.get("MINIPS_ELASTIC") or None,
             "membership": trainer.membership_stats(),
             "frames_dropped": trainer.frames_dropped,
@@ -274,7 +277,9 @@ def main(argv=None) -> int:
             "rank": rank, "event": "done",
             # wire-knob echo: sweeps assert the negotiated config so a
             # flag-plumbing regression can't publish a mislabeled number
-            "push_comm": args.push_comm,
+            # (the RESOLVED value: --push-comm default None defers to
+            # $MINIPS_PUSH_COMM, and the echo must name what ran)
+            "push_comm": table.push_comm,
             "pull_wire": args.pull_wire,
             "overlap": bool(args.overlap),
             "overlap_legs": args.overlap_legs if args.overlap else None,

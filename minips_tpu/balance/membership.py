@@ -161,13 +161,64 @@ def plan_evacuation(router, victims: set[int],
     return new_ov
 
 
-def plan_admission(router, joiner: int) -> dict[int, int]:
+def plan_admission(router, joiner: int, *,
+                   reports: Optional[dict] = None,
+                   live: Optional[set] = None,
+                   threshold: float = 1.0,
+                   max_blocks: int = 8) -> dict[int, int]:
     """New FULL overlay admitting ``joiner``: its home blocks return
     home (their interim owners ship state under the normal fence);
-    everything else keeps its current assignment."""
+    everything else keeps its current assignment.
+
+    HEAT-AWARE PLACEMENT (ROADMAP item 3's remaining headroom, 'one
+    planner call away'): with the coordinator's per-rank heat
+    ``reports`` (balance/rebalancer.py ``rbH`` payloads, ``live`` =
+    the pre-join live set they cover), the admission plan additionally
+    runs the PR4 bin-packer (:func:`plan_assignment`) over the
+    POST-ADMISSION load picture — the joiner starts at the heat of its
+    returning home blocks, interim owners are debited the same — so a
+    rank joining a skewed fleet immediately absorbs hot blocks instead
+    of idling on its (typically cold, freshly-bootstrapped) home range
+    until the ordinary rebalance loop notices. ``threshold`` defaults
+    to 1.0 here (not the steady-state hysteresis): an empty joiner IS
+    the imbalance, and admission is already a migration — extra moves
+    ride the same fence for free. Every rank in ``live`` must have
+    reported; otherwise (or with no reports) the plan degrades to
+    home-blocks-only, exactly the pre-heat behavior."""
     _ep, ov = router.table()
-    return {int(b): int(o) for b, o in ov.items()
-            if router.home_of(int(b)) != joiner and int(o) != joiner}
+    new_ov = {int(b): int(o) for b, o in ov.items()
+              if router.home_of(int(b)) != joiner and int(o) != joiner}
+    if not reports or live is None or not live <= set(reports):
+        return new_ov
+    home = router.home_of
+    joiner_home = {b for b in range(router.num_blocks)
+                   if home(b) == joiner}
+    ranks = sorted(set(live) | {joiner})
+    idx = {r: i for i, r in enumerate(ranks)}
+    loads = np.zeros(len(ranks), np.float64)
+    candidates: dict[int, tuple[int, float]] = {}
+    for r in sorted(set(live)):
+        rep = reports[r]
+        loads[idx[r]] = float(rep.get("total", 0.0))
+        for b, h in zip(rep.get("blocks", ()), rep.get("heat", ())):
+            b, h = int(b), float(h)
+            if b in joiner_home:
+                # this block is returning to the joiner under the
+                # admission overlay: credit the joiner, debit the
+                # interim owner — the planner sees the POST-join world
+                loads[idx[joiner]] += h
+                loads[idx[r]] -= h
+                continue
+            candidates[b] = (idx[r], h)
+    from minips_tpu.balance.rebalancer import plan_assignment
+
+    for b, _src, dst in plan_assignment(loads, candidates, threshold,
+                                        max_blocks):
+        if ranks[dst] == home(b):
+            new_ov.pop(b, None)
+        else:
+            new_ov[b] = ranks[dst]
+    return new_ov
 
 
 class Membership:
@@ -525,6 +576,13 @@ class Membership:
         tr = self.trainer
         self.rb.claim_drive_thread()  # adoption moves to THIS thread
         for t in tr.tables.values():
+            # queue drain FIRST (a queued topk push retains fresh
+            # residuals as it encodes on the sender thread), THEN the
+            # residual flush — a leaver exiting rc 0 with retained
+            # residuals would be silently-lost gradient — then the
+            # hard ack drain covers the flush frames too
+            t.flush_pushes(acks=False)
+            t.residual_flush(reason="fence")
             t.flush_pushes()  # hard drain: owners hold all my updates
             t.check_fatal()
         # retire: gates and owner-side admission never wait on me again
@@ -670,8 +728,17 @@ class Membership:
             # both on my one FIFO link, so the joiner sees them in order
             self.bus.publish(self.ADMIT_KIND,
                              {"rank": join, "clk": self.trainer.clock})
-            self._issue({name: plan_admission(t.router, join)
-                         for name, t in tables.items()})
+            # heat-aware placement: the admit plan runs the PR4
+            # bin-packer over the coordinator's stored heat reports
+            # (rbH flows even in elastic-only mode), so the joiner
+            # absorbs hot blocks at admission instead of idling on its
+            # cold home range; missing reports degrade to
+            # home-blocks-only (plan_admission docstring)
+            live = self._live_targets()
+            self._issue({name: plan_admission(
+                t.router, join, reports=self.rb.heat_reports(name),
+                live=set(live), max_blocks=self.rb.cfg.max_blocks)
+                for name, t in tables.items()})
 
     def _issue_death(self, r: int) -> None:
         """The death transition: verdict + plan. Unrecoverable (no

@@ -272,6 +272,15 @@ class Rebalancer:
         for name, t in self.trainer.tables.items():
             self._adopt_one(name, t)
 
+    def heat_reports(self, name: str) -> dict[int, dict]:
+        """Snapshot of the coordinator's stored per-rank heat reports
+        for ``name`` — the membership plane's admission planner reads
+        them so a joiner's placement can be heat-aware instead of
+        home-blocks-only (balance/membership.plan_admission)."""
+        with self._lock:
+            return {r: dict(rep)
+                    for r, rep in self._reports.get(name, {}).items()}
+
     def has_pending(self, name: str) -> bool:
         """A plan for ``name`` is noted but not yet adopted — readers
         blocked on keys the pending table re-homes wait for the

@@ -91,11 +91,14 @@ import struct
 import tempfile
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
 
 from minips_tpu.comm.bus import (FrameLossTracker, deliver_frame,
-                                 run_handshake, stop_bus_layers)
-from minips_tpu.comm.framing import encode_head, rt_wrap, wire_fmt_from_env
+                                 dispatch_parsed, run_handshake,
+                                 stop_bus_layers)
+from minips_tpu.comm.framing import (dup_msg, encode_head, rt_wrap,
+                                     wire_fmt_from_env)
 
 __all__ = ["ShmControlBus", "sweep_stale_segments"]
 
@@ -298,7 +301,22 @@ class _Ring:
 class ShmControlBus:
     """``ControlBus``-shaped bus over per-link shared-memory rings.
     Same-host only by construction (the ring files live in this host's
-    tmpfs); a cross-host job selects zmq/native instead."""
+    tmpfs); a cross-host job selects zmq/native instead.
+
+    Unlike zmq/native (which refuse a directed send to self — a PUB
+    socket would have to loop a frame through the kernel to deliver
+    it), this backend accepts ``send(my_id, ...)`` as an IN-PROCESS
+    LOOPBACK: the decoded head and blob go straight onto a local queue
+    the recv thread drains ahead of the rings — no codec round-trip,
+    no ring, no syscall (``loopback_frames`` counts them; they are
+    deliberately absent from ``bytes_sent`` — nothing crossed a wire).
+    Handlers still run on the recv thread (their locking assumes it),
+    per-caller FIFO holds (one deque), and the chaos/reliable layers
+    are bypassed by design: a function call is not a wire, so there is
+    nothing to drop or retransmit — the serving plane's self-shed path
+    (serve/plane.py) is the consumer, probing ``supports_loopback``."""
+
+    supports_loopback = True
 
     def __init__(self, my_addr: str, peer_addrs: list[str], my_id: int = 0,
                  connect_timeout: float = 15.0,
@@ -356,6 +374,11 @@ class ShmControlBus:
         # re-form the symmetric two-rank stall one lock up
         self._drain_critical: set = set()
         self._handlers: dict[str, Callable[[int, dict], None]] = {}
+        # the in-process loopback lane (send-to-self): deque append /
+        # popleft are GIL-atomic, so the recv thread drains without a
+        # lock; loopback frames never touch a ring or the seq space
+        self._loop: deque = deque()
+        self.loopback_frames = 0
         self._seq_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -432,11 +455,33 @@ class ShmControlBus:
 
     def send(self, dest: int, kind: str, payload: dict,
              blob: Optional[bytes] = None) -> None:
-        if dest == self.my_id:
-            raise ValueError("directed send to self (serve locally instead)")
         if not 0 <= dest < self._n_world:
             raise ValueError(f"dest rank {dest} out of range")
+        if dest == self.my_id:
+            self._emit_loopback(kind, payload, blob)
+            return
         self._emit(dest, kind, payload, blob)
+
+    def _emit_loopback(self, kind: str, payload: dict,
+                       blob: Optional[bytes]) -> None:
+        """rank→self without the ring round-trip: the payload is
+        deep-copied with the codec's own semantics (``dup_msg`` — the
+        handler may mutate it, and dispatch attaches ``__blob__``) and
+        the blob MATERIALIZED (a handler may retain it past a caller's
+        buffer reuse, the same retention contract the ring's copy-out
+        gives), then queued for the recv thread — handler threading
+        identical to a wire frame, zero codec/ring/syscall cost."""
+        if self._closed:
+            return
+        head = {"kind": kind, "sender": self.my_id,
+                "payload": dup_msg(payload)}
+        self._loop.append(
+            (head, bytes(blob) if blob is not None else None))
+        self.loopback_frames += 1
+        try:  # wake a parked recv thread: our own RDWR fd is a writer
+            os.write(self._db_r, b"x")
+        except (BlockingIOError, OSError):
+            pass  # full pipe = doorbell already pending
 
     def _emit(self, dest: int, kind: str, payload: dict,
               blob: Optional[bytes]) -> None:
@@ -626,10 +671,23 @@ class ShmControlBus:
             deliver_frame(self, raw, blob)
         return n
 
+    def _drain_loopback(self) -> int:
+        """Dispatch queued rank→self frames (the loopback lane) — on
+        THIS thread, like every ring frame, so handler locking sees one
+        delivery context whichever lane a frame took."""
+        n = 0
+        while True:
+            try:
+                head, blob = self._loop.popleft()
+            except IndexError:
+                return n
+            n += 1
+            dispatch_parsed(self._handlers, head, blob, loss=self.loss)
+
     def _recv_loop(self) -> None:
         rings = sorted(self._rx.items())
         while not self._stop.is_set():
-            got = 0
+            got = self._drain_loopback()
             for src, ring in rings:
                 got += self._drain_ring(src, ring)
             if got:
@@ -640,7 +698,8 @@ class ShmControlBus:
             for _src, ring in rings:
                 ring.set_sleeping(True)
             try:
-                if any(r.tail() != r.head() for _s, r in rings):
+                if self._loop \
+                        or any(r.tail() != r.head() for _s, r in rings):
                     continue
                 try:
                     rd, _, _ = select.select([self._db_r], [], [], 0.05)

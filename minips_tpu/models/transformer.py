@@ -23,8 +23,10 @@ Two attention modes, numerically identical:
 from __future__ import annotations
 
 import jax
+
 import jax.numpy as jnp
 
+from minips_tpu.utils.jaxcompat import axis_size as _axis_size
 from minips_tpu.parallel.mesh import DATA_AXIS
 from minips_tpu.parallel.ring_attention import (
     reference_attention,
@@ -121,7 +123,7 @@ def _block(h, blk, heads, attn_fn, compute_dtype, psum_axis=None,
     ``ffn_fn(blk, x_2d [B*T, D]) -> (y_2d, aux)`` replaces the dense MLP
     (the MoE variant); the dense path reports aux 0. Returns (h, aux)."""
     B, T, _ = h.shape
-    tp = 1 if psum_axis is None else jax.lax.axis_size(psum_axis)
+    tp = 1 if psum_axis is None else _axis_size(psum_axis)
     local_heads = heads // tp
     from jax.ad_checkpoint import checkpoint_name
     x = _ln(h, blk["ln1"]).astype(compute_dtype)
@@ -454,7 +456,7 @@ def apply_tp(params, tokens, *, heads=4, axis_name="model",
     taken inside would mis-reduce the replicated params
     (tests/test_tensor_parallel.py::test_tp_composes_with_dp).
     """
-    tp = jax.lax.axis_size(axis_name)
+    tp = _axis_size(axis_name)
     if heads % tp:
         raise ValueError(f"heads {heads} not divisible by tensor-parallel "
                          f"size {tp} (head-boundary sharding)")

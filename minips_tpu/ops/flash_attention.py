@@ -40,9 +40,11 @@ import functools
 from typing import Optional
 
 import jax
+
 import jax.numpy as jnp
 
 from minips_tpu.utils import jaxcompat
+from minips_tpu.utils.jaxcompat import axis_size as _axis_size
 
 try:  # pallas imports can fail on exotic backends; degrade to blockwise
     from jax.experimental import pallas as pl
@@ -553,7 +555,7 @@ def ring_flash_attention_local(
     overlaps the hop with the kernel. Gradients flow through the kernels'
     custom VJP at every step.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     r = jax.lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]

@@ -24,8 +24,10 @@ training converges to the f32 loss within noise.
 from __future__ import annotations
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
+from minips_tpu.utils.jaxcompat import axis_size as _axis_size
 
 VALID = ("float32", "bfloat16", "int8")
 
@@ -71,6 +73,115 @@ def quantize_rows_int8(rows: np.ndarray,
 def dequantize_rows_int8(codes: np.ndarray,
                          scale: np.ndarray) -> np.ndarray:
     return codes.astype(np.float32) * scale[:, None]
+
+# ------------------------------------- sparse top-k + blockwise host codec
+# The compressed push wire's two levers (SparCML + EQuARX, PAPERS.md):
+# magnitude top-k ROW selection over the owner-split gradient (ship the
+# mass, not the touch set) and blockwise absmax quantization at 8 or 4
+# bits (one f32 scale per HOST_BLOCK flattened elements — the numpy twin
+# of the device codec's ``_quantize_blocks`` below, block size tunable).
+# The pusher keeps ``g - decode(encode(g))`` plus every unselected row in
+# its error-feedback residual store (train/sharded_ps.ResidualStore), so
+# unlike the per-row int8 codec above, BIASED nearest rounding is sound
+# here: the bias is measured and re-shipped, never accumulated.
+
+HOST_BLOCK = 64  # default blockwise-scale unit for the host topk wire
+                 # (f32-scale overhead = 4/HOST_BLOCK bytes per element;
+                 # at 64 that is 1/16 the 8-bit code stream)
+
+
+def topk_rows(rows: np.ndarray, *, mass: float = 0.9,
+              frac_cap: float = 0.5) -> np.ndarray:
+    """SORTED indices of the smallest row set capturing ``mass`` of the
+    squared-L2 gradient mass, capped at ``ceil(frac_cap * n)`` rows —
+    'k adaptive to the touched set': a zipf push whose summed hot rows
+    dominate selects a few rows; a flat push selects up to the cap and
+    leaves the rest to error feedback. Deterministic (stable sort);
+    always selects at least one row of a nonzero gradient."""
+    n = rows.shape[0]
+    if n == 0:
+        return np.empty(0, np.int64)
+    mag = np.einsum("ij,ij->i", rows, rows, dtype=np.float64)
+    total = float(mag.sum())
+    cap = max(1, int(np.ceil(frac_cap * n)))
+    if total <= 0.0:
+        return np.arange(min(1, n), dtype=np.int64)
+    order = np.argsort(-mag, kind="stable")
+    k = int(np.searchsorted(np.cumsum(mag[order]), mass * total)) + 1
+    return np.sort(order[: min(k, cap)])
+
+
+def _block_grid(flat: np.ndarray, block: int) -> tuple[np.ndarray, int]:
+    """Zero-pad a flat f32 array up to a block multiple and view it
+    ``[nb, block]`` (zeros never move an absmax)."""
+    L = flat.size
+    nb = -(-L // block) if L else 0
+    pad = nb * block - L
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    return flat.reshape(nb, block), L
+
+
+def quantize_blockwise(rows: np.ndarray, bits: int, *,
+                       block: int = HOST_BLOCK,
+                       rng: np.random.Generator | None = None
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """Blockwise absmax quantization of ``[n, dim]`` f32 rows flattened
+    row-major: one f32 scale per ``block`` elements, codes at 8 bits
+    (int8 stream) or 4 bits (two codes per byte, uint8 stream, offset
+    +8 so the sign needs no second pass). ``rng`` selects stochastic
+    rounding (unbiased); None is round-to-nearest (deterministic — the
+    serve-plane refresh mode, where every replica must decode the same
+    bytes). Returns ``(codes, scales f32 [nb])``."""
+    if bits not in (4, 8):
+        raise ValueError("blockwise codec supports 4 or 8 bits")
+    levels = 127 if bits == 8 else 7
+    flat = np.ascontiguousarray(rows, np.float32).reshape(-1)
+    grid, L = _block_grid(flat, block)
+    scale = (np.abs(grid).max(axis=1) / levels).astype(np.float32)
+    safe = np.where(scale > 0, scale, 1.0).astype(np.float32)
+    x = grid / safe[:, None]
+    if rng is None:
+        q = np.rint(x)
+    else:
+        low = np.floor(x)
+        q = low + (rng.random(x.shape) < (x - low))
+    q = np.clip(q, -levels, levels).astype(np.int8).reshape(-1)[:L]
+    if bits == 8:
+        return q, scale
+    u = (q.astype(np.int16) + 8).astype(np.uint8)  # 1..15, 0 unused
+    if u.size % 2:
+        u = np.concatenate([u, np.zeros(1, np.uint8)])
+    return (u[0::2] << 4) | u[1::2], scale
+
+
+def dequantize_blockwise(codes: np.ndarray, scales: np.ndarray,
+                         n: int, dim: int, bits: int, *,
+                         block: int = HOST_BLOCK) -> np.ndarray:
+    """Inverse of :func:`quantize_blockwise` back to ``[n, dim]`` f32."""
+    L = n * dim
+    if bits == 8:
+        q = np.frombuffer(codes, np.int8)[:L].astype(np.float32)
+    else:
+        packed = np.frombuffer(codes, np.uint8)
+        u = np.empty(packed.size * 2, np.uint8)
+        u[0::2] = packed >> 4
+        u[1::2] = packed & 0x0F
+        q = (u[:L].astype(np.int16) - 8).astype(np.float32)
+    grid, _ = _block_grid(q, block)
+    out = grid * np.asarray(scales, np.float32)[:, None]
+    return out.reshape(-1)[:L].reshape(n, dim)
+
+
+def blockwise_stream_bytes(n: int, dim: int, bits: int,
+                           block: int = HOST_BLOCK) -> tuple[int, int]:
+    """(code bytes, scale bytes) of the blockwise stream for ``n`` rows —
+    the one size formula encoder, decoder, and frame validators share."""
+    L = n * dim
+    nb = -(-L // block) if L else 0
+    code = L if bits == 8 else -(-L // 2)
+    return code, 4 * nb
+
 
 BLOCK = 256  # int8 quantization block: one f32 scale per 256 elements
              # (1.6% wire overhead). Per-BLOCK scales matter because a
@@ -180,6 +291,6 @@ def quantized_psum_scatter(gpad: jnp.ndarray, axis_name: str,
     _check(comm)
     if comm == "float32":
         return jax.lax.psum_scatter(gpad, axis_name, tiled=True)
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     reduced, _ = a2a_reduce(gpad.reshape(n, -1), axis_name, comm)
     return reduced

@@ -22,7 +22,9 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+
 import jax.numpy as jnp
+from minips_tpu.utils.jaxcompat import axis_size as _axis_size
 
 
 def gpipe(
@@ -39,7 +41,7 @@ def gpipe(
     last stage's outputs are collected and broadcast, so the return value
     [M, ...] is valid on every device (replicated).
     """
-    k = jax.lax.axis_size(axis_name)
+    k = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     M = x_microbatches.shape[0]
     # stage i sends to stage i+1; the wrap edge (k-1 -> 0) carries values

@@ -29,10 +29,12 @@ import functools
 from typing import Optional
 
 import jax
+
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from minips_tpu.utils.jaxcompat import axis_size as _axis_size
 from minips_tpu.parallel.mesh import DATA_AXIS
 # GQA head expansion shared with the kernel module (ONE implementation of
 # the repeat + divisibility check). NOTE: under ring attention the repeat
@@ -89,7 +91,7 @@ def ring_attention_local(
     H, D] attention output, exactly equal to softmax(QK^T)V over the full
     gathered sequence.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     r = jax.lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]

@@ -156,7 +156,8 @@ _COUNTERS = (
     "orphan_frames",
     # client side
     "replica_rows_routed", "replica_fallbacks",
-    "shed_redirected_legs", "backpressure_waits", "stale_reads",
+    "shed_redirected_legs", "shed_local_legs", "backpressure_waits",
+    "stale_reads",
 )
 
 
@@ -298,21 +299,56 @@ class TableServeState:
             self._grant_blocks(fresh, holders)
         return bool(fresh)
 
-    def _encode_rows(self, rows: np.ndarray) -> tuple[str, bytes]:
-        """Grant/delta payload on the table's configured pull wire —
-        int8 per-row absmax (round-to-nearest, the pull-reply codec)
-        when configured, raw f32 otherwise."""
+    def _serve_wire(self) -> tuple[str, int]:
+        """The grant/delta row codec this owner emits: the blockwise
+        sub-8-bit codec when the table runs a compressed push wire
+        (``blk8``/``blk4`` — the refresh stream gets the same byte win
+        as the push leg, ops/quantized_comm blockwise codec at the
+        table's block size), else the pull wire's per-row int8, else
+        raw f32. Frames carry the tag + block, so replicas decode per
+        frame like every other wire here."""
         t = self.table
+        if t.push_comm in ("topk8", "topk4"):
+            return ("blk8" if t.push_comm == "topk8" else "blk4",
+                    t.topk_block)
         if t.pull_wire == "int8":
+            return "int8", 0
+        return "f32", 0
+
+    def _encode_rows(self, rows: np.ndarray) -> tuple[str, bytes]:
+        """Grant/delta row payload on :meth:`_serve_wire` — nearest
+        rounding always (deterministic: every replica of one refresh
+        decodes identical bytes, the pull-wire rule)."""
+        wire, blk = self._serve_wire()
+        if wire in ("blk8", "blk4"):
+            from minips_tpu.ops.quantized_comm import quantize_blockwise
+
+            codes, scales = quantize_blockwise(
+                rows, 8 if wire == "blk8" else 4, block=blk)
+            return wire, scales.tobytes() + codes.tobytes()
+        if wire == "int8":
             from minips_tpu.ops.quantized_comm import quantize_rows_int8
 
             codes, scale = quantize_rows_int8(rows)
             return "int8", scale.tobytes() + codes.tobytes()
         return "f32", np.ascontiguousarray(rows, np.float32).tobytes()
 
-    def _decode_rows(self, wire: str, n: int,
+    def _decode_rows(self, wire: str, blk: int, n: int,
                      blob: bytes) -> Optional[np.ndarray]:
         t = self.table
+        if wire in ("blk8", "blk4"):
+            from minips_tpu.ops.quantized_comm import (
+                blockwise_stream_bytes, dequantize_blockwise)
+
+            bits = 8 if wire == "blk8" else 4
+            if blk < 1:
+                return None
+            code_b, scale_b = blockwise_stream_bytes(n, t.dim, bits, blk)
+            if len(blob) != scale_b + code_b:
+                return None
+            scales = np.frombuffer(blob[:scale_b], np.float32)
+            return dequantize_blockwise(blob[scale_b:], scales, n,
+                                        t.dim, bits, block=blk)
         if wire == "int8":
             if len(blob) != n * (4 + t.dim):
                 return None
@@ -347,10 +383,10 @@ class TableServeState:
                 parts.append(keys.tobytes())
             if n:
                 parts.append(self._encode_rows(rows)[1])
-        wire = "int8" if t.pull_wire == "int8" else "f32"
+        wire, blk = self._serve_wire()
         head = {"stamp": int(stamp), "lease": self.cfg.lease,
-                "ep": t.router.epoch, "wire": wire, "bs": bs,
-                "fl": fl, "ns": ns, **t._cfg_header()}
+                "ep": t.router.epoch, "wire": wire, "blk": blk,
+                "bs": bs, "fl": fl, "ns": ns, **t._cfg_header()}
         if renew:
             # renew the lease + stamp of EVERY block this holder holds
             # from me — constant-size, replaces per-block renewal
@@ -527,15 +563,32 @@ class TableServeState:
         blocks = np.unique(t.router.blocks_of(keys))
         dead = t._excluded_ranks()
         common: Optional[set] = None
+        self_common = True  # sender holds every touched block itself
         with self._ow_lock:
-            per_block = {int(b): set(self._granted.get(int(b), ()))
-                         - {sender} - dead  # never shed at a dead holder
-                         for b in blocks}
+            per_block = {}
+            for b in blocks:
+                hs = set(self._granted.get(int(b), ())) - dead
+                self_common &= sender in hs
+                # peers first: never shed at a dead holder, and the
+                # requester itself only as the loopback fallback below
+                per_block[int(b)] = hs - {sender}
         for hs in per_block.values():
             common = hs if common is None else (common & hs)
             if not common:
                 break
         tr = _trc.TRACER
+        if not common and self_common and getattr(
+                t.bus, "supports_loopback", False):
+            # no PEER covers the leg, but the REQUESTER holds every
+            # touched block (a grant that raced its pull — per-link
+            # FIFO means the svU preceded this svS on my link to it, so
+            # by the time the redirect lands the snapshot is installed)
+            # and its transport can deliver rank→self in process: shed
+            # the leg back at the requester — it serves itself with
+            # ZERO wire instead of riding the partial/backpressure
+            # ladder at the very owner that is refusing load (an svN
+            # still falls back here with rt=1, bounded as ever)
+            common = {sender}
         if common:
             self._count("shed_redirects")
             if tr is not None:
@@ -582,10 +635,18 @@ class TableServeState:
         return False
 
     # ------------------------------------------------------------ replica
-    def _row_seg_bytes(self, n: int) -> int:
+    def _row_seg_bytes(self, wire: str, blk: int, n: int) -> int:
         t = self.table
-        return n * (4 + t.dim) if t.pull_wire == "int8" \
-            else n * 4 * t.dim
+        if wire in ("blk8", "blk4"):
+            from minips_tpu.ops.quantized_comm import \
+                blockwise_stream_bytes
+
+            code_b, scale_b = blockwise_stream_bytes(
+                n, t.dim, 8 if wire == "blk8" else 4, max(blk, 1))
+            return code_b + scale_b
+        if wire == "int8":
+            return n * (4 + t.dim)
+        return n * 4 * t.dim
 
     def _on_update(self, sender: int, payload: dict) -> None:
         """Multi-block grant/delta frame: apply each segment to the
@@ -596,6 +657,7 @@ class TableServeState:
         if not t._check_peer_config(sender, payload):
             return
         wire = payload.get("wire", "f32")
+        blk = int(payload.get("blk", 0))
         blob = payload.get("__blob__") or b""
         now = time.monotonic()
         exp = now + float(payload.get("lease", self.cfg.lease))
@@ -615,11 +677,12 @@ class TableServeState:
                     keys = np.frombuffer(blob[off: off + 8 * n],
                                          np.int64)
                     off += 8 * n
-                seg = self._row_seg_bytes(n)
+                seg = self._row_seg_bytes(wire, blk, n)
                 if len(blob) < off + seg:
                     t._drop("malformed", sender, "torn svU frame")
                     return
-                rows = self._decode_rows(wire, n, blob[off: off + seg])
+                rows = self._decode_rows(wire, blk, n,
+                                         blob[off: off + seg])
                 off += seg
                 if rows is None:
                     t._drop("malformed", sender, "bad svU rows")
@@ -872,15 +935,28 @@ class TableServeState:
         WITHOUT ``rt`` — the admission bucket judges it again, so only
         the uncovered half feels the backpressure."""
         self._count("shed_redirected_legs")
-        cands = [int(h) for h in payload.get("h", ())
-                 if int(h) != self.table.rank]
+        t = self.table
+        named = [int(h) for h in payload.get("h", ())]
+        cands = [h for h in named if h != t.rank]
         rid = int(payload.get("req", -1))
-        if not cands:
-            self.table._resend_leg(
+        if t.rank in named and getattr(t.bus, "supports_loopback",
+                                       False):
+            # the owner shed my leg at a holder set that includes ME:
+            # on a loopback-capable transport (shm) the svP leg rides
+            # rank→self in process — the replica serve costs zero wire
+            # instead of a forced-admit fallback hop at the very owner
+            # that just shed us (the local-replica transport win the
+            # shm ring's loopback lane exists for; an svN still falls
+            # back to the owner with rt=1, bounded as ever)
+            self._count("shed_local_legs")
+            pick = t.rank
+        elif not cands:
+            t._resend_leg(
                 rid, lambda keys: self._plan_by_owner(keys, 1))
             return
-        self._rr += 1
-        pick = cands[self._rr % len(cands)]
+        else:
+            self._rr += 1
+            pick = cands[self._rr % len(cands)]
         bs = payload.get("bs")
         if bs is None:  # full-coverage shed: the whole leg rides svP
             self.table._resend_leg(

@@ -217,15 +217,21 @@ from minips_tpu.consistency.gate import (PeerFailureError, StalenessGate,
 from minips_tpu.obs import tracer as _trc
 from minips_tpu.obs.hist import Log2Histogram, merge_counts, \
     summarize_counts
-from minips_tpu.ops.quantized_comm import (dequantize_rows_int8,
-                                           quantize_rows_int8)
+from minips_tpu.ops.quantized_comm import (HOST_BLOCK,
+                                           blockwise_stream_bytes,
+                                           dequantize_blockwise,
+                                           dequantize_rows_int8,
+                                           quantize_blockwise,
+                                           quantize_rows_int8, topk_rows)
 from minips_tpu.parallel.partition import BlockRouter, RangePartitioner
 from minips_tpu.utils.timing import CommTimers
 
 __all__ = ["ShardedTable", "ShardedPSTrainer", "PeerFailureError",
-           "PullFuture", "RowCache", "table_state_bytes",
+           "PullFuture", "RowCache", "ResidualStore", "table_state_bytes",
            "tables_hist_stats", "quantize_rows_int8",
            "dequantize_rows_int8"]
+
+VALID_PUSH_COMM = ("float32", "int8", "topk8", "topk4")
 
 
 def _as_blob(arr: np.ndarray) -> memoryview:
@@ -417,6 +423,161 @@ class RowCache:
             }
 
 
+class ResidualStore:
+    """Error-feedback residuals for the compressed push wire (the
+    SparCML rule: what the codec did not send is KEPT, not dropped).
+
+    Every ``topk8``/``topk4`` push retains two kinds of unsent mass per
+    key: the full gradient row of every row the top-k selection left
+    out, and the quantization error ``g - decode(encode(g))`` of every
+    row it shipped. The NEXT push touching the same key FOLDS the
+    residual into its gradient before selection, so hot rows
+    self-repair within a step; cold rows are bounded by the staleness
+    accounting instead — every entry carries a BIRTH clock (the oldest
+    clock whose mass it holds; folding preserves the minimum, so age
+    can never reset by re-touching), and the trainer's clock boundary
+    flushes entries older than the staleness bound ``s`` as plain f32
+    pushes — the RowCache stamp rule run in reverse: a cached read may
+    be up to ``s`` behind, and symmetrically a withheld write may trail
+    at most ``s`` clock boundaries before it is forced onto the wire.
+    Epoch fences (rebalance adoption, membership transitions) and
+    ``finalize()`` flush the WHOLE store, so migration, drains, and the
+    exact post-finalize agreement never strand mass.
+
+    Storage is a slab like the RowCache: a preallocated ``[cap, dim]``
+    f32 buffer + parallel birth/key vectors with a dict for key lookup
+    — all float work vectorized. A full slab cannot drop mass: retain
+    overflow is returned to the caller, which ships it dense
+    immediately (counted; the byte win shrinks, correctness does not).
+    Thread-safe: the async-push sender thread retains while the
+    training thread age-flushes at the boundary."""
+
+    INF = np.iinfo(np.int64).max
+
+    def __init__(self, dim: int, cap_bytes: int = 1 << 24):
+        self.dim = int(dim)
+        # byte-bounded, with a row-count ceiling: the parallel birth /
+        # key vectors cost 16 B/row whatever the dim, so a dim-1 table
+        # must not turn the 16 MiB byte budget into 4M preallocated
+        # slots (overflow past the cap ships dense — graceful, counted)
+        self.cap_rows = min(max(int(cap_bytes) // (4 * self.dim), 1024),
+                            1 << 18)
+        self._buf = np.zeros((self.cap_rows, self.dim), np.float32)
+        self._birth = np.zeros(self.cap_rows, np.int64)
+        self._key = np.full(self.cap_rows, -1, np.int64)
+        self._slot: dict[int, int] = {}
+        self._free: list[int] = list(range(self.cap_rows - 1, -1, -1))
+        self._lock = threading.Lock()
+        self.folded_rows = 0
+        self.retained_rows = 0
+        self.flushed_age = 0
+        self.flushed_fence = 0
+        self.flushed_overflow = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._slot)
+
+    def fold(self, keys: np.ndarray, grads: np.ndarray) -> np.ndarray:
+        """Add stored residuals into ``grads`` (in place) for every key
+        present, release those entries, and return each key's former
+        birth clock (``INF`` where nothing was stored) — the caller
+        re-retains unsent mass under ``min(birth, current clock)`` so
+        residual age survives the fold."""
+        births = np.full(keys.size, self.INF, np.int64)
+        with self._lock:
+            if not self._slot:
+                return births
+            get = self._slot.get
+            slots = np.fromiter((get(k, -1) for k in keys.tolist()),
+                                np.int64, count=keys.size)
+            held = slots >= 0
+            if not held.any():
+                return births
+            hs = slots[held]
+            grads[held] += self._buf[hs]
+            births[held] = self._birth[hs]
+            self._key[hs] = -1
+            for k in keys[held].tolist():
+                self._free.append(self._slot.pop(k))
+            self.folded_rows += int(held.sum())
+        return births
+
+    def retain(self, keys: np.ndarray, rows: np.ndarray,
+               births: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Store unsent mass (all-zero rows are skipped — nothing to
+        repay). Returns the ``(keys, rows)`` OVERFLOW the slab had no
+        room for; the caller must ship it dense — mass is conserved
+        whatever the slab pressure."""
+        live = rows.any(axis=1)
+        if not live.all():
+            keys, rows, births = keys[live], rows[live], births[live]
+        if not keys.size:
+            return keys, rows
+        ov_from = keys.size
+        with self._lock:
+            get = self._slot.get
+            for i, k in enumerate(keys.tolist()):
+                slot = get(k)
+                if slot is not None:  # belt-and-braces: fold removed it
+                    self._buf[slot] += rows[i]
+                    self._birth[slot] = min(self._birth[slot],
+                                            int(births[i]))
+                    continue
+                if not self._free:
+                    ov_from = i
+                    break
+                slot = self._free.pop()
+                self._slot[k] = slot
+                self._key[slot] = k
+                self._buf[slot] = rows[i]
+                self._birth[slot] = int(births[i])
+            stored = min(ov_from, keys.size)
+            self.retained_rows += stored
+            if ov_from < keys.size:
+                self.flushed_overflow += keys.size - ov_from
+        return keys[ov_from:], rows[ov_from:]
+
+    def take(self, up_to_birth: Optional[int] = None
+             ) -> tuple[np.ndarray, np.ndarray]:
+        """Pop every entry with ``birth <= up_to_birth`` (None = all),
+        sorted by key (deterministic flush frames)."""
+        with self._lock:
+            used = self._key >= 0
+            if up_to_birth is not None:
+                used &= self._birth <= up_to_birth
+            slots = np.nonzero(used)[0]
+            if not slots.size:
+                return (np.empty(0, np.int64),
+                        np.empty((0, self.dim), np.float32))
+            keys = self._key[slots].copy()
+            rows = self._buf[slots].copy()
+            self._key[slots] = -1
+            for k in keys.tolist():
+                self._free.append(self._slot.pop(k))
+        order = np.argsort(keys, kind="stable")
+        return keys[order], rows[order]
+
+    def note_flushed(self, n: int, reason: str) -> None:
+        with self._lock:
+            if reason == "age":
+                self.flushed_age += n
+            else:
+                self.flushed_fence += n
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "folded_rows": self.folded_rows,
+                "retained_rows": self.retained_rows,
+                "flushed_age": self.flushed_age,
+                "flushed_fence": self.flushed_fence,
+                "flushed_overflow": self.flushed_overflow,
+                "resident_rows": len(self._slot),
+                "resident_bytes": len(self._slot) * 4 * self.dim,
+            }
+
+
 def table_state_bytes(num_rows: int, dim: int, updater: str) -> int:
     """Whole-table bytes of weights + optimizer state for one table — the
     accounting twin of ``ShardedTable.local_bytes`` summed over all shards
@@ -590,19 +751,30 @@ class ShardedTable:
         seed: int = 0,
         pull_timeout: float = 30.0,
         monitor=None,
-        push_comm: str = "float32",
+        push_comm: Optional[str] = None,
         pull_wire: str = "f32",
         async_push: bool = False,
         push_window: int = 32,
         cache_bytes: int = 0,
         pull_dedup: bool = True,
         push_dedup: bool = True,
+        topk_mass: float = 0.9,
+        topk_cap: float = 0.5,
+        topk_block: int = HOST_BLOCK,
     ):
         if updater not in ("sgd", "adagrad", "adam"):
             raise ValueError(
                 "sharded-PS updater must be 'sgd', 'adagrad' or 'adam'")
-        if push_comm not in ("float32", "int8"):
-            raise ValueError("push_comm must be 'float32' or 'int8'")
+        if push_comm is None:
+            # the env spelling of the wire ladder (explicit-empty =
+            # default, every MINIPS_* knob's convention); an explicit
+            # constructor/flag value always wins — the bench pins ""
+            # so an armed environment can't leak into baseline arms
+            push_comm = os.environ.get("MINIPS_PUSH_COMM",
+                                       "").strip() or "float32"
+        if push_comm not in VALID_PUSH_COMM:
+            raise ValueError(
+                f"push_comm must be one of {VALID_PUSH_COMM}")
         if pull_wire == "float32":  # accept the push-knob spelling too
             pull_wire = "f32"
         if pull_wire not in ("f32", "int8"):
@@ -615,6 +787,19 @@ class ShardedTable:
             # a cache keyed on unique rows over a duplicate wire would
             # double-count hits and mis-stamp scattered fills
             raise ValueError("cache_bytes > 0 requires pull_dedup=True")
+        if push_comm in ("topk8", "topk4") and not push_dedup:
+            # error feedback is keyed per unique row: a per-occurrence
+            # wire would fold one key's residual into whichever
+            # occurrence happened first — dedup is the codec's contract
+            raise ValueError(
+                f"push_comm={push_comm!r} requires push_dedup=True "
+                "(error-feedback residuals are keyed per unique row)")
+        if not 0.0 < topk_mass <= 1.0:
+            raise ValueError("topk_mass must be in (0, 1]")
+        if not 0.0 < topk_cap <= 1.0:
+            raise ValueError("topk_cap must be in (0, 1]")
+        if topk_block < 1:
+            raise ValueError("topk_block must be >= 1")
         self.name = name
         self.num_rows = int(num_rows)
         self.dim = int(dim)
@@ -632,6 +817,13 @@ class ShardedTable:
         self.pull_timeout = pull_timeout
         self.monitor = monitor
         self.push_comm = push_comm
+        self.topk_mass = float(topk_mass)
+        self.topk_cap = float(topk_cap)
+        self.topk_block = int(topk_block)
+        # the error-feedback residual store (module class docstring):
+        # only the compressed-push tiers carry unsent mass to repay
+        self._ef = (ResidualStore(dim)
+                    if push_comm in ("topk8", "topk4") else None)
         self.pull_wire = pull_wire
         self.async_push = bool(async_push)
         self.push_window = int(push_window)
@@ -1097,6 +1289,17 @@ class ShardedTable:
                 if self._fatal is None:
                     self._fatal = (f"table {self.name}: adoption drain "
                                    f"failed: {e!r}")
+        # error-feedback residuals flush BEFORE the router swap: the
+        # dense frames route by the OLD table and precede my rbA on
+        # every per-link stream, so the fence's promise ('no more stale
+        # pushes from this rank') covers withheld mass too — migration
+        # and elastic transitions can never strand a residual
+        try:
+            self.residual_flush(reason="fence")
+        except Exception as e:  # noqa: BLE001 - poison, don't hide
+            if self._fatal is None:
+                self._fatal = (f"table {self.name}: residual fence "
+                               f"flush failed: {e!r}")
         ships: list[tuple[int, int, dict]] = []
         moved: list[tuple[int, int, int]] = []
 
@@ -1657,19 +1860,45 @@ class ShardedTable:
             return
         # frames self-describe their wire format, so a mixed fleet (one
         # pusher compressed, another not) decodes correctly per frame
-        row_bytes = (4 + self.dim) if comm == "int8" else 4 * self.dim
-        if blob is None or len(blob) != n * (8 + row_bytes):
-            self._drop("malformed", sender, "bad push blob size")
-            return  # malformed frame from a stale run
-        keys = np.frombuffer(blob[: 8 * n], np.int64)
-        self._count_serve(push_frames=1)
-        if comm == "int8":
-            scale = np.frombuffer(blob[8 * n: 12 * n], np.float32)
-            codes = np.frombuffer(blob[12 * n:], np.int8
-                                  ).reshape(n, self.dim)
-            grads = dequantize_rows_int8(codes, scale)
+        if comm in ("topk8", "topk4"):
+            # the sparse top-k index+code stream: int32/int64 indices,
+            # blockwise f32 scales, then 8- or 4-bit codes — decoded
+            # into plain f32 rows here, so the updaters below (and the
+            # rebalancer's forward/park classification) never know the
+            # wire was compressed (ops/sparse_update.py semantics
+            # already match sparse index-value application)
+            bits = 8 if comm == "topk8" else 4
+            blk = int(payload.get("blk", HOST_BLOCK))
+            kw = int(payload.get("kw", 8))
+            code_b, scale_b = blockwise_stream_bytes(n, self.dim, bits,
+                                                     blk)
+            if blob is None or kw not in (2, 4, 8) or blk < 1 \
+                    or len(blob) != n * kw + scale_b + code_b:
+                self._drop("malformed", sender, "bad topk push blob")
+                return
+            kdt = {2: np.uint16, 4: np.int32, 8: np.int64}[kw]
+            keys = np.frombuffer(blob[: n * kw], kdt).astype(np.int64)
+            scales = np.frombuffer(blob[n * kw: n * kw + scale_b],
+                                   np.float32)
+            grads = dequantize_blockwise(
+                blob[n * kw + scale_b:], scales, n, self.dim, bits,
+                block=blk)
+            self._count_serve(push_frames=1)
         else:
-            grads = np.frombuffer(blob[8 * n:], np.float32)
+            row_bytes = (4 + self.dim) if comm == "int8" \
+                else 4 * self.dim
+            if blob is None or len(blob) != n * (8 + row_bytes):
+                self._drop("malformed", sender, "bad push blob size")
+                return  # malformed frame from a stale run
+            keys = np.frombuffer(blob[: 8 * n], np.int64)
+            self._count_serve(push_frames=1)
+            if comm == "int8":
+                scale = np.frombuffer(blob[8 * n: 12 * n], np.float32)
+                codes = np.frombuffer(blob[12 * n:], np.int8
+                                      ).reshape(n, self.dim)
+                grads = dequantize_rows_int8(codes, scale)
+            else:
+                grads = np.frombuffer(blob[8 * n:], np.float32)
         if self._rb is not None:
             # classify under the CURRENT table: apply what is mine,
             # forward what migrated away, park what outruns my epoch
@@ -2060,8 +2289,10 @@ class ShardedTable:
                 # bytes/row-moved. Under the lock (the issue side bumps
                 # the same counter from the training thread) and only
                 # for live requests: a late reply to a cancelled
-                # prefetch must not inflate the counter.
-                self.bytes_pulled += len(blob)
+                # prefetch must not inflate the counter. A loopback
+                # reply (self-shed svP, sender == me) crossed no wire.
+                if sender != self.rank:
+                    self.bytes_pulled += len(blob)
                 self._replies[gid][rid] = (
                     rows, int(payload.get("stamp", 0)), payload)
                 self._reply_t[gid] = time.monotonic()
@@ -2164,13 +2395,19 @@ class ShardedTable:
             for target, kind, extra, mask in plan(keys):
                 if not mask.any():
                     continue
-                if target == self.rank:
+                if target == self.rank and kind == "psG":
+                    # owner reads of my own shard never need a frame;
+                    # a non-psG self target (the serve plane's svP
+                    # self-shed) is a REAL leg riding the transport's
+                    # in-process loopback lane — the plan only names
+                    # it on a loopback-capable bus
                     grp["extra_local"].append(idx[mask])
                     continue
                 rid2 = self._next_req()
                 grp["legs"][rid2] = (int(target), idx[mask])
                 self._rid_gid[rid2] = gid
-                self.bytes_pulled += keys[mask].nbytes
+                if target != self.rank:  # loopback legs cross no wire
+                    self.bytes_pulled += keys[mask].nbytes
                 if tr is not None:
                     self._leg_t0[rid2] = (time.monotonic(), int(target))
                 sends.append((int(target), kind, rid2, grp["clk"],
@@ -2308,15 +2545,17 @@ class ShardedTable:
             return self._req
 
     def _missing_legs_locked(self, gid: int) -> dict[int, int]:
-        """Outstanding wire legs of a pull group: ``rid -> owner`` for
-        every leg without a reply (own-rank legs are read locally at
-        wait() and never awaited). Caller holds the reply cond."""
+        """Outstanding legs of a pull group: ``rid -> target`` for
+        every leg without a reply. Own-shard reads never REGISTER a leg
+        (they ride ``extra_local``), so a registered self-rank leg here
+        is a loopback leg (the serve plane's svP self-shed) and is
+        awaited like any other. Caller holds the reply cond."""
         grp = self._groups.get(gid)
         if grp is None:
             return {}
         got = self._replies.get(gid, {})
         return {rid: o for rid, (o, _i) in grp["legs"].items()
-                if o != self.rank and rid not in got}
+                if rid not in got}
 
     def _cleanup_group_locked(self, gid: int) -> None:
         self._replies.pop(gid, None)
@@ -3026,13 +3265,22 @@ class ShardedTable:
                     self._apply_rows(keys[mask] - self.shard_lo,
                                      grads[mask])
                 continue
-            if self.push_comm == "int8":
+            overflow = None
+            if self.push_comm in ("topk8", "topk4"):
+                # the compressed-push pipeline: fold residuals, select
+                # top-k rows by mass, blockwise-quantize, retain the
+                # unsent remainder (overflow ships dense right after)
+                head0, blob, overflow = self._encode_push_topk(
+                    keys[mask], np.ascontiguousarray(grads[mask],
+                                                     np.float32))
+            elif self.push_comm == "int8":
                 codes, scale = quantize_rows_int8(grads[mask], self._q_rng)
+                head0 = {"n": int(mask.sum()), "comm": "int8"}
                 blob = _cat_blob(keys[mask], scale, codes)
             else:
+                head0 = {"n": int(mask.sum()), "comm": "float32"}
                 blob = _cat_blob(keys[mask], grads[mask])
-            head = {"n": int(mask.sum()), "comm": self.push_comm,
-                    **self._ep_header(), **self._cfg_header()}
+            head = {**head0, **self._ep_header(), **self._cfg_header()}
             if self.async_push:
                 head["seq"] = self._take_push_seq(o)
                 tr = _trc.TRACER
@@ -3042,6 +3290,170 @@ class ShardedTable:
                             {"owner": o, "seq": head["seq"]})
             self.bus.send(o, f"psP:{self.name}", head, blob=blob)
             self.bytes_pushed += len(blob)
+            if overflow is not None and overflow[0].size:
+                # residual-slab overflow: mass the store had no room
+                # for ships dense NOW — the byte win shrinks under
+                # pressure, correctness never does
+                self._send_f32_push(o, overflow[0], overflow[1])
+
+    def _encode_push_topk(self, keys: np.ndarray, grads: np.ndarray
+                          ) -> tuple[dict, bytearray, tuple]:
+        """One owner slice through the compressed-push pipeline:
+
+        1. FOLD: stored residuals of this slice's keys join the
+           gradient (in place — ``grads`` is a fresh fancy-index copy),
+           remembering each key's oldest birth clock;
+        2. SELECT: ``topk_rows`` keeps the rows carrying ``topk_mass``
+           of the squared mass (capped at ``topk_cap`` of the slice) —
+           the wire pays for the mass, not the touch set;
+        3. ENCODE: blockwise absmax at 8/4 bits, stochastic rounding
+           (the same ``_q_rng`` stream as the int8 wire), emitted as an
+           index+code stream — int32 indices when the key space fits;
+        4. RETAIN: unselected rows whole, plus the selected rows'
+           quantization error, under ``min(birth, clock)`` so age
+           survives folding. Slab overflow is returned for an
+           immediate dense send — mass is conserved unconditionally.
+
+        Returns ``(head fields, blob, (overflow keys, overflow rows))``.
+        """
+        clk = self._my_clk()
+        bits = 8 if self.push_comm == "topk8" else 4
+        births = self._ef.fold(keys, grads)
+        births = np.minimum(births, clk)
+        sel = topk_rows(grads, mass=self.topk_mass,
+                        frac_cap=self.topk_cap)
+        selmask = np.zeros(keys.size, bool)
+        selmask[sel] = True
+        g_sel = grads[sel]
+        codes, scales = quantize_blockwise(g_sel, bits,
+                                           block=self.topk_block,
+                                           rng=self._q_rng)
+        sent = dequantize_blockwise(codes, scales, sel.size, self.dim,
+                                    bits, block=self.topk_block)
+        ovk = np.empty(0, np.int64)
+        ovr = np.empty((0, self.dim), np.float32)
+        k1, r1 = self._ef.retain(keys[~selmask], grads[~selmask],
+                                 births[~selmask])
+        k2, r2 = self._ef.retain(keys[sel], g_sel - sent, births[sel])
+        if k1.size or k2.size:
+            ovk = np.concatenate([k1, k2])
+            ovr = np.concatenate([r1, r2])
+        idx = keys[sel].astype(self._key_dtype())
+        head = {"n": int(sel.size), "comm": self.push_comm,
+                "blk": self.topk_block, "kw": int(idx.dtype.itemsize)}
+        return head, _cat_blob(idx, scales, codes), (ovk, ovr)
+
+    def _key_dtype(self):
+        """The narrowest index-stream dtype the key space fits — the
+        other half of 'index+code streams' (the seed wire's int64 keys
+        cost as much as an 8-bit row at dim 8): u16 under 64Ki rows,
+        i32 under 2Gi, i64 beyond."""
+        if self.num_rows <= 1 << 16:
+            return np.uint16
+        if self.num_rows <= np.iinfo(np.int32).max:
+            return np.int32
+        return np.int64
+
+    def _send_f32_push(self, o: int, k: np.ndarray,
+                       g: np.ndarray) -> None:
+        """A plain full-precision push frame to one owner — the
+        residual-flush/overflow sender (seq-stamped under async push
+        like any other frame, so the drain and ack machinery cover
+        it)."""
+        if self._mb is not None and o in self._dead_ranks:
+            self.rb_stats["pushes_lost_to_dead"] += 1
+            return
+        blob = _cat_blob(k, np.ascontiguousarray(g, np.float32))
+        head = {"n": int(k.size), "comm": "float32",
+                **self._ep_header(), **self._cfg_header()}
+        if self.async_push:
+            head["seq"] = self._take_push_seq(o)
+        self.bus.send(o, f"psP:{self.name}", head, blob=blob)
+        self.bytes_pushed += len(blob)
+
+    def residual_flush(self, *, aged_only: bool = False,
+                       reason: str = "fence") -> int:
+        """Ship retained error-feedback mass, routed by the CURRENT
+        table (local rows apply locally, full precision).
+
+        ``aged_only`` is the clock-boundary rule (trainer ``tick``,
+        BEFORE the clock frame goes out, so flushed frames precede the
+        clock on every per-link stream exactly like the async drain):
+        flush entries whose birth clock is ``<= clock - s`` — a
+        residual may trail its push by at most the staleness bound,
+        the RowCache stamp rule mirrored onto the write path (ASP
+        never age-flushes: there is no bound to protect). The aged set
+        ships DENSE in keys (no top-k selection — every aged row goes)
+        but compressed in value: the blockwise 4-bit stream with
+        STOCHASTIC rounding, whose quantization error is dropped, not
+        re-retained — exactly the int8 wire's unbiased-noise contract
+        (E[decoded] = residual), so aged mass is delivered in
+        expectation at ~4 bits/element instead of re-aging a
+        second-order error forever (zipf tails age out every window;
+        an f32 aged flush measurably cost MORE than the int8 wire it
+        was supposed to beat).
+
+        The full flush (``aged_only=False``) is EXACT f32: it runs at
+        every epoch fence (``adopt_table``, before the router swap —
+        flushed frames ride the old table's links AHEAD of my rbA, so
+        fences release only after the mass landed), at membership
+        drains, and at ``finalize()`` — post-finalize agreement and
+        the migration oracle drills are bitwise, not in-expectation.
+        Returns rows flushed."""
+        if self._ef is None:
+            return 0
+        if aged_only:
+            s = self._cache_staleness()
+            if s == float("inf"):
+                return 0
+            keys, rows = self._ef.take(self._my_clk() - int(s))
+        else:
+            keys, rows = self._ef.take()
+        if not keys.size:
+            return 0
+        self._ef.note_flushed(int(keys.size),
+                              "age" if aged_only else reason)
+        owners = self._owners_of(keys)
+        for o in np.unique(owners):
+            m = owners == o
+            if int(o) == self.rank:
+                if self._rb is not None:
+                    self._ingest_push(keys[m], rows[m],
+                                      self.router.epoch)
+                else:
+                    self._apply_rows(keys[m] - self.shard_lo, rows[m])
+            elif aged_only:
+                self._send_blk4_push(int(o), keys[m], rows[m])
+            else:
+                self._send_f32_push(int(o), keys[m], rows[m])
+        return int(keys.size)
+
+    def _send_blk4_push(self, o: int, k: np.ndarray,
+                        g: np.ndarray) -> None:
+        """The aged-flush frame: the same topk4 index+code stream the
+        selected path emits (one wire format, the receiver cannot tell
+        a flush from a fresh push), stochastic rounding, error
+        dropped — see :meth:`residual_flush`."""
+        if self._mb is not None and o in self._dead_ranks:
+            self.rb_stats["pushes_lost_to_dead"] += 1
+            return
+        codes, scales = quantize_blockwise(g, 4, block=self.topk_block,
+                                           rng=self._q_rng)
+        idx = k.astype(self._key_dtype())
+        head = {"n": int(k.size), "comm": "topk4",
+                "blk": self.topk_block,
+                "kw": int(idx.dtype.itemsize),
+                **self._ep_header(), **self._cfg_header()}
+        if self.async_push:
+            head["seq"] = self._take_push_seq(o)
+        blob = _cat_blob(idx, scales, codes)
+        self.bus.send(o, f"psP:{self.name}", head, blob=blob)
+        self.bytes_pushed += len(blob)
+
+    def ef_stats(self) -> Optional[dict]:
+        """Error-feedback residual counters — None when the compressed
+        push wire is off (off vs idle, the done-line convention)."""
+        return self._ef.stats() if self._ef is not None else None
 
     def push_dense(self, grad: np.ndarray) -> None:
         """Whole-vector gradient push, split into per-owner contiguous
@@ -3087,12 +3499,19 @@ class ShardedTable:
                 else:
                     self._apply_range(0, grad[lo:hi])
                 continue
-            if self.push_comm == "int8":
+            if self.push_comm != "float32":
+                # the range fast path has no key stream to sparsify:
+                # the topk tiers fall back to the per-row int8 codec
+                # here (dense pushes touch every row anyway — there is
+                # no top-k win, and EF residuals would just be the
+                # whole table; docs/api.md wire-ladder note)
                 codes, scale = quantize_rows_int8(grad[lo:hi], self._q_rng)
                 gb = scale.tobytes() + codes.tobytes()
+                wire_comm = "int8"
             else:
                 gb = grad[lo:hi].tobytes()
-            head = {"lo": lo, "comm": self.push_comm,
+                wire_comm = "float32"
+            head = {"lo": lo, "comm": wire_comm,
                     **self._ep_header(), **self._cfg_header()}
             if self.async_push:
                 head["seq"] = self._take_push_seq(o)
@@ -3406,6 +3825,11 @@ class ShardedPSTrainer:
         for t in self.tables.values():
             if drain:
                 t.flush_pushes(acks=False)  # a jammed drain poisons…
+            # aged error-feedback residuals ship BEFORE the clock frame
+            # (same per-link ordering argument as the drain above): a
+            # withheld write may trail its push by at most `staleness`
+            # boundaries — the compressed wire's half of the SSP story
+            t.residual_flush(aged_only=True)
             t.check_fatal()                 # …and this raises, no hang
         if self.membership is not None:
             # BEFORE the rebalancer's adoption point: a transition plan
@@ -3464,6 +3888,15 @@ class ShardedPSTrainer:
             # the shutdown barrier)
             self.serve_plane.quiesce()
         for t in self.tables.values():
+            # order matters (the adopt_table pattern): drain the async
+            # queue FIRST — a queued topk push encodes on the sender
+            # thread and RETAINS fresh residuals, so flushing before
+            # the drain would strand exactly the mass the flush exists
+            # to ship — then flush the whole store (post-finalize
+            # agreement is exact), then the hard ack drain covers the
+            # flush frames too
+            t.flush_pushes(acks=False)
+            t.residual_flush(reason="fence")
             t.flush_pushes()  # async tail: drained before the flush frame
             t.check_fatal()
             t.cache_clear()   # post-finalize reads are exact, not bounded
@@ -3635,6 +4068,16 @@ class ShardedPSTrainer:
         blocks — None when MINIPS_ELASTIC is off (off vs idle)."""
         return (self.membership.stats()
                 if self.membership is not None else None)
+
+    def ef_stats(self) -> Optional[dict]:
+        """Merged error-feedback residual counters over all tables —
+        the done-line ``ef`` field (None when no table runs a
+        compressed push wire; zero counters = armed but idle)."""
+        per = [s for s in (t.ef_stats() for t in self.tables.values())
+               if s is not None]
+        if not per:
+            return None
+        return {k: sum(s[k] for s in per) for k in per[0]}
 
     def cache_stats(self) -> Optional[dict]:
         """Merged row-cache counters over all tables (None when every

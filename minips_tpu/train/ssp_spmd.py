@@ -44,11 +44,13 @@ import time
 from typing import Any, Callable, Optional
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from minips_tpu.utils.jaxcompat import axis_size as _axis_size
 from minips_tpu.comm.bus import ClockGossip
 from minips_tpu.consistency.gate import StalenessGate, publish_clock
 from minips_tpu.parallel.mesh import DATA_AXIS
@@ -164,7 +166,7 @@ class SyncPlane:
                                                    gather_broadcast)
 
         def merge_q(block):            # [1, Lb] on each device
-            n = jax.lax.axis_size("proc")
+            n = _axis_size("proc")
             v = block.reshape(n, -1)   # my row split into per-proc chunks
             c = v.shape[1]
             mine, sent = a2a_reduce(v, "proc", comm)

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["shard_map", "typeof"]
+__all__ = ["shard_map", "typeof", "axis_size"]
 
 _NEW_SHARD_MAP = getattr(jax, "shard_map", None)
 
@@ -34,6 +34,17 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
     kw = {} if check_vma is None else {"check_rep": check_vma}
     return _EXP_SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, **kw)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` where it exists (0.6+); the classic
+    ``psum(1, axis)`` spelling otherwise — jax constant-folds a psum of
+    a Python literal over a named axis to the static axis size, so both
+    return a value usable as a shape dimension inside shard_map."""
+    sz = getattr(jax.lax, "axis_size", None)
+    if sz is not None:
+        return sz(axis_name)
+    return jax.lax.psum(1, axis_name)
 
 
 def typeof(x):

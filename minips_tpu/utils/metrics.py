@@ -82,6 +82,11 @@ def wire_record(trainer) -> dict:
         # row-cache counters (train/sharded_ps.RowCache): None when every
         # table runs cache-off, so scrapers can tell "off" from "cold"
         "cache": trainer.cache_stats(),
+        # error-feedback residual counters (compressed push wire,
+        # train/sharded_ps.ResidualStore): None when every table runs
+        # an exact push wire — fold/retain/flush accounting is the
+        # evidence no gradient mass is stranded
+        "ef": getattr(trainer, "ef_stats", lambda: None)(),
         # retransmission-protocol + fault-injection counters: None when
         # the respective layer is off ('off' vs 'clean' distinguishable)
         "reliable": trainer.reliable_stats(),

@@ -11,6 +11,7 @@ training-grad path, and the loud head-divisibility refusal.
 import functools
 
 import jax
+
 import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
@@ -18,6 +19,7 @@ import pytest
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
+from minips_tpu.utils.jaxcompat import shard_map
 from minips_tpu.models import transformer as tfm
 from minips_tpu.parallel.a2a_attention import a2a_attention_local
 from minips_tpu.parallel.ring_attention import reference_attention
@@ -48,7 +50,7 @@ def test_a2a_local_matches_reference(mesh8, causal, kv_heads):
     v = jnp.asarray(rng.normal(size=(B, T, kv_heads, D)), jnp.float32)
     want = reference_attention(q, k, v, causal=causal)
     spec = P(None, "data")
-    got = jax.jit(jax.shard_map(
+    got = jax.jit(shard_map(
         functools.partial(a2a_attention_local, axis_name="data",
                           causal=causal),
         mesh=_mesh(n), in_specs=(spec, spec, spec), out_specs=spec,
@@ -61,7 +63,7 @@ def test_a2a_rejects_indivisible_heads(mesh8):
     q = jnp.zeros((1, 8, 4, 4))  # 4 heads over an 8-way axis
     spec = P(None, "data")
     with pytest.raises(ValueError, match="divisible"):
-        jax.jit(jax.shard_map(
+        jax.jit(shard_map(
             functools.partial(a2a_attention_local, axis_name="data"),
             mesh=_mesh(8), in_specs=(spec, spec, spec), out_specs=spec,
         ))(q, q, q)
@@ -76,7 +78,7 @@ def _sp_logits_n(n, params, tokens, heads, attn_impl):
         return tfm.apply_sp(p, toks, shift, heads=heads,
                             attn_impl=attn_impl, **F32)
 
-    return jax.shard_map(shard_fn, mesh=_mesh(n),
+    return shard_map(shard_fn, mesh=_mesh(n),
                          in_specs=(P(), P(None, "data")),
                          out_specs=P(None, "data"))(params, tokens)
 
@@ -132,7 +134,7 @@ def test_a2a_grad_matches_full(mesh8):
         return tfm.loss_sp(p_, i_, t_, shift, heads=8,
                            attn_impl="a2a", **F32)
 
-    l_a2a, g_a2a = jax.value_and_grad(lambda q: jax.shard_map(
+    l_a2a, g_a2a = jax.value_and_grad(lambda q: shard_map(
         shard_fn, mesh=_mesh(n),
         in_specs=(P(), P(None, "data"), P(None, "data")),
         out_specs=P())(q, toks[:, :-1], toks[:, 1:]))(p)

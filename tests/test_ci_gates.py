@@ -498,3 +498,80 @@ def test_collect_gate_collects_clean():
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     summary = proc.stdout.strip().splitlines()[-1]
     assert "collected" in summary and "error" not in summary, summary
+
+
+# ------------------------------------------- wire-compression tripwires
+def _wirecomp_art(*, bi=1.3, bt=0.59, completed=True, lost=0,
+                  resident=0, lf=0.69, lt=0.69, agree=True,
+                  conv_completed=True) -> dict:
+    from ci.bench_regression import WIRE_BYTES_FACTOR  # noqa: F401
+
+    return {"wire_compression_3proc": {
+        "zipf_rows": 2048,
+        "f32": {"completed": True, "rows_per_sec_per_process": 900.0,
+                "wire_push_bytes_per_row_moved": 2.6},
+        "int8": {"completed": True, "rows_per_sec_per_process": 880.0,
+                 "wire_push_bytes_per_row_moved": bi},
+        "topk8": {"completed": completed,
+                  "rows_per_sec_per_process": 400.0,
+                  "wire_push_bytes_per_row_moved": bt,
+                  "wire_frames_lost": lost,
+                  "ef_resident_rows": resident},
+        "topk4": {"completed": True,
+                  "rows_per_sec_per_process": 420.0,
+                  "wire_push_bytes_per_row_moved": 0.47,
+                  "wire_frames_lost": 0, "ef_resident_rows": 0},
+        "converge": {
+            "f32": {"completed": conv_completed, "loss_last": lf,
+                    "finals_agree": True, "ef_resident_rows": 0},
+            "topk8": {"completed": conv_completed, "loss_last": lt,
+                      "finals_agree": agree, "ef_resident_rows": 0},
+        },
+    }}
+
+
+def test_wire_compression_tripwires_pass_on_healthy_sweep():
+    from ci.bench_regression import wire_compression_tripwires
+
+    assert wire_compression_tripwires(_wirecomp_art()) == []
+    assert wire_compression_tripwires({"metric": "m"}) == []  # vacuous
+
+
+def test_wire_bytes_requires_2x_over_int8():
+    from ci.bench_regression import wire_compression_tripwires
+
+    probs = wire_compression_tripwires(_wirecomp_art(bt=0.7))
+    assert any("WIRE-BYTES" in p and "2.0x" in p for p in probs)
+    # exactly at the factor passes (<=)
+    assert wire_compression_tripwires(_wirecomp_art(bt=0.65)) == []
+    # a missing arm is a BYTES failure, not a silent pass
+    art = _wirecomp_art()
+    del art["wire_compression_3proc"]["topk8"]
+    probs = wire_compression_tripwires(art)
+    assert any("WIRE-BYTES" in p for p in probs)
+
+
+def test_wire_bytes_fails_on_loss_or_stranded_mass():
+    from ci.bench_regression import wire_compression_tripwires
+
+    probs = wire_compression_tripwires(_wirecomp_art(lost=2))
+    assert any("unrecovered" in p for p in probs)
+    probs = wire_compression_tripwires(_wirecomp_art(resident=5))
+    assert any("stranded" in p for p in probs)
+    probs = wire_compression_tripwires(_wirecomp_art(completed=False))
+    assert any("must complete" in p for p in probs)
+
+
+def test_wire_converge_gates_loss_and_finals():
+    from ci.bench_regression import wire_compression_tripwires
+
+    probs = wire_compression_tripwires(_wirecomp_art(lf=0.3, lt=0.6))
+    assert any("WIRE-CONVERGE" in p and "loss" in p for p in probs)
+    probs = wire_compression_tripwires(
+        _wirecomp_art(lt=float("nan")))
+    assert any("WIRE-CONVERGE" in p for p in probs)
+    probs = wire_compression_tripwires(_wirecomp_art(agree=False))
+    assert any("finals disagree" in p for p in probs)
+    probs = wire_compression_tripwires(
+        _wirecomp_art(conv_completed=False))
+    assert any("must complete" in p for p in probs)

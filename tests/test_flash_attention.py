@@ -5,10 +5,12 @@ is exercised by bench.py on the real chip). Oracle equality is the same
 test discipline as ring attention (test_ring_attention.py)."""
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from minips_tpu.utils.jaxcompat import shard_map
 from minips_tpu.ops.flash_attention import (blockwise_attention,
                                             flash_attention,
                                             kernel_supported)
@@ -147,7 +149,7 @@ def test_ring_flash_matches_oracle(causal):
     # check_vma=False: the interpret-mode pallas interpreter can't track
     # varying-manual-axes through its internal dynamic_slices (JAX issue);
     # the compiled TPU path carries real vma via ShapeDtypeStruct
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda q_, k_, v_: ring_flash_attention_local(
             q_, k_, v_, axis_name="data", causal=causal, block_q=8,
             block_k=8, interpret=True),
@@ -172,7 +174,7 @@ def test_ring_flash_gradients_match_oracle():
     q, k, v = _qkv(B=1, T=64, H=2, D=16, seed=4)
 
     def loss_ring(q, k, v):
-        out = jax.shard_map(
+        out = shard_map(
             lambda q_, k_, v_: ring_flash_attention_local(
                 q_, k_, v_, axis_name="data", causal=True, block_k=8),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
@@ -230,7 +232,7 @@ def test_ring_flash_default_path_off_tpu():
     P = shd.PartitionSpec
     spec = P(None, "data")
     q, k, v = _qkv(B=2, T=64, H=2, D=16, seed=6)
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda q_, k_, v_: ring_flash_attention_local(
             q_, k_, v_, axis_name="data", causal=True),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))(q, k, v)

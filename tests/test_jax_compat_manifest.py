@@ -19,7 +19,7 @@ from tests.conftest import load_jax_compat_manifest
 # fixed 63 for real (the utils/jaxcompat.py shard_map/typeof shims:
 # checkpoint, cssp, dense-table, ssp_spmd, engine, mnist, transformer,
 # flash-attention, apps) and lowered the ceiling to match.
-SEED_FAILURE_COUNT = 83
+SEED_FAILURE_COUNT = 56
 
 
 def test_manifest_only_shrinks():

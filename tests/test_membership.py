@@ -430,3 +430,53 @@ def test_death_without_checkpoint_falls_back_to_gang_restart(tmp_path):
     for ev in survivors:
         assert ev["event"] == "peer_failure", events
         assert 2 in ev["dead"]
+
+
+def test_plan_admission_heat_aware_places_hot_blocks_on_joiner():
+    """Heat-aware joiner placement (ROADMAP item 3's 'one planner call
+    away'): with the coordinator's heat reports, the admit plan runs
+    the PR4 bin-packer over the POST-admission load picture — the
+    joiner absorbs hot blocks at admission instead of idling on its
+    cold home range. Missing/partial reports degrade to
+    home-blocks-only."""
+    r = _router()  # 4 shards x 4 blocks
+    r.apply(1, plan_evacuation(r, {3}, [0, 1, 2]))  # bootstrap: 3 out
+    # rank 0 is scorching on two non-home-of-3 hot blocks
+    reports = {
+        0: {"total": 1000.0, "blocks": [0, 1], "heat": [600.0, 380.0]},
+        1: {"total": 20.0, "blocks": [4], "heat": [10.0]},
+        2: {"total": 20.0, "blocks": [8], "heat": [10.0]},
+    }
+    ov = plan_admission(r, 3, reports=reports, live={0, 1, 2},
+                        max_blocks=8)
+    r2 = _router()
+    r2.apply(1, plan_evacuation(r2, {3}, [0, 1, 2]))
+    r2.apply(2, ov)
+    owners = r2.owner_of_blocks()
+    assert (owners[12:16] == 3).all()  # home blocks still come home
+    hot_on_joiner = {b for b in (0, 1) if owners[b] == 3}
+    assert hot_on_joiner, owners.tolist()  # >= 1 hot block moved over
+    # a live rank missing from the reports: home-blocks-only fallback
+    ov_fallback = plan_admission(r, 3, reports={0: reports[0]},
+                                 live={0, 1, 2})
+    assert ov_fallback == plan_admission(r, 3)
+
+
+def test_plan_admission_heat_debits_interim_owners_of_home_blocks():
+    """The joiner's returning home blocks move load in the planner's
+    picture: their heat is debited from the interim owner and credited
+    to the joiner, so a joiner whose home range is ALREADY hot does
+    not additionally swallow other ranks' hot blocks."""
+    r = _router()
+    r.apply(1, plan_evacuation(r, {3}, [0]))  # all of 3's home on 0
+    # rank 0's heat is ENTIRELY the joiner's home blocks (12..15)
+    reports = {
+        0: {"total": 1000.0, "blocks": [12, 13],
+            "heat": [600.0, 380.0]},
+        1: {"total": 900.0, "blocks": [4], "heat": [500.0]},
+        2: {"total": 900.0, "blocks": [8], "heat": [500.0]},
+    }
+    ov = plan_admission(r, 3, reports=reports, live={0, 1, 2})
+    # post-join the joiner already carries ~1000 heat: nothing else
+    # should pile onto it
+    assert all(o != 3 for o in ov.values())

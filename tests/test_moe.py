@@ -6,11 +6,13 @@ layer. With non-binding capacity the distributed output must equal the
 oracle token-for-token; grads (router included) must match too."""
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from minips_tpu.utils.jaxcompat import shard_map
 from minips_tpu.parallel.mesh import make_mesh
 from minips_tpu.parallel.moe import (
     ep_specs,
@@ -33,7 +35,7 @@ def _x(N, seed=0):
 
 
 def _ep_apply(mesh, params, x, capacity):
-    f = jax.shard_map(
+    f = shard_map(
         lambda p, x_: moe_apply_local(p, x_, axis_name="data",
                                       capacity=capacity, **F32),
         mesh=mesh, in_specs=(ep_specs("data"), P("data")),
@@ -63,7 +65,7 @@ def test_ep_grads_match_dense(mesh8, params):
                                      capacity=64, **F32)
             return (jax.lax.pmean(jnp.mean((y - t_) ** 2), "data")
                     + 0.01 * aux)
-        return jax.shard_map(
+        return shard_map(
             shard_fn, mesh=mesh8,
             in_specs=(ep_specs("data"), P("data"), P("data")),
             out_specs=P())(p, x, tgt)
@@ -148,7 +150,7 @@ class TestMoELM:
         toks = self._toks(8, 12)
         want, aux_want = tfm.apply_moe_dense(
             lm_params, toks, heads=2, capacity=2048, **F32)
-        f = jax.shard_map(
+        f = shard_map(
             lambda p, t: tfm.apply_ep(p, t, heads=2, capacity=256, **F32),
             mesh=mesh8,
             in_specs=(tfm.ep_lm_specs(lm_params), P("data")),
@@ -172,7 +174,7 @@ class TestMoELM:
                                            capacity=256, **F32)
                 return jax.lax.pmean(
                     tfm.nll(logits, t_[:, 1:]), "data") + 0.01 * aux
-            return jax.shard_map(
+            return shard_map(
                 shard_fn, mesh=mesh8,
                 in_specs=(tfm.ep_lm_specs(lm_params), P("data")),
                 out_specs=P())(p, toks)
@@ -218,7 +220,7 @@ class TestTopK:
     def test_top2_ep_matches_dense(self, mesh8, params):
         x = _x(64, seed=4)
         yd, auxd = moe_apply_dense(params, x, capacity=64, k_top=2, **F32)
-        f = jax.shard_map(
+        f = shard_map(
             lambda p, x_: moe_apply_local(p, x_, axis_name="data",
                                           capacity=64, k_top=2, **F32),
             mesh=mesh8, in_specs=(ep_specs("data"), P("data")),

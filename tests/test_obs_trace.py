@@ -397,11 +397,12 @@ def test_wire_record_schema_full_layout():
         h.close()
     expected = {"bytes_pushed", "bytes_pulled", "frames_dropped",
                 "wire_frames_lost", "wire_frames_malformed", "timing",
-                "hist", "cache", "reliable", "chaos", "serve",
+                "hist", "cache", "ef", "reliable", "chaos", "serve",
                 "rebalance", "membership"}
     assert expected <= set(rec)
     # layers OFF in this run report None — not {} — and vice versa
     assert rec["cache"] is None
+    assert rec["ef"] is None  # exact push wire: no residual store
     assert rec["reliable"] is None
     assert rec["chaos"] is None
     assert rec["rebalance"] is None
@@ -458,8 +459,8 @@ def test_bench_done_line_carries_wire_record_layout(capsys):
     line = [ln for ln in capsys.readouterr().out.splitlines()
             if ln.startswith("{")][-1]
     rec = json.loads(line)
-    for k in ("hist", "timing", "cache", "reliable", "chaos", "serve",
-              "rebalance", "bytes_pushed", "bytes_pulled",
+    for k in ("hist", "timing", "cache", "ef", "reliable", "chaos",
+              "serve", "rebalance", "bytes_pushed", "bytes_pulled",
               "frames_dropped", "wire_frames_lost",
               "wire_frames_malformed", "trace_file"):
         assert k in rec, k

@@ -6,11 +6,13 @@ Beyond parity (reference has no PP, SURVEY.md §2.2)."""
 import functools
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from minips_tpu.utils.jaxcompat import shard_map
 from minips_tpu.models import transformer as tfm
 from minips_tpu.parallel.mesh import make_mesh
 from minips_tpu.parallel.pipeline import gpipe, stack_layers, unstack_layers
@@ -57,7 +59,7 @@ def test_gpipe_schedule_identity():
     def run(x_mb, c):
         def shard_fn(x_, c_):
             return gpipe(lambda h: h + c_[0], x_, axis_name="model")
-        return jax.shard_map(
+        return shard_map(
             shard_fn, mesh=mesh, in_specs=(P(), P("model")),
             out_specs=P())(x_mb, c)
 
@@ -72,7 +74,7 @@ def test_pp_logits_match_full(mesh_pp, params, M):
     want = tfm.apply(params, tokens, heads=CFG["heads"], **F32)
     sp = _stacked(params)
     specs = tfm.pp_specs(sp)
-    got = jax.shard_map(
+    got = shard_map(
         lambda p, t: tfm.apply_pp(p, t, heads=CFG["heads"],
                                   num_microbatches=M, **F32),
         mesh=mesh_pp, in_specs=(specs, P()), out_specs=P())(sp, tokens)
@@ -93,7 +95,7 @@ def test_pp_grad_matches_full(mesh_pp, params):
             logp = jax.nn.log_softmax(logits)
             return jnp.mean(
                 -jnp.take_along_axis(logp, t_[:, 1:, None], axis=-1)[..., 0])
-        return jax.shard_map(shard_fn, mesh=mesh_pp,
+        return shard_map(shard_fn, mesh=mesh_pp,
                              in_specs=(specs, P()), out_specs=P())(p, toks)
 
     def full_loss(p):
@@ -114,7 +116,7 @@ def test_pp_bad_microbatch_raises(mesh_pp, params):
     sp = _stacked(params)
     specs = tfm.pp_specs(sp)
     with pytest.raises(ValueError, match="microbatch"):
-        jax.shard_map(
+        shard_map(
             lambda p, t: tfm.apply_pp(p, t, heads=CFG["heads"],
                                       num_microbatches=3),
             mesh=mesh_pp, in_specs=(specs, P()), out_specs=P()
@@ -131,7 +133,7 @@ def test_pp_rope_logits_match_full(mesh_pp):
     want = tfm.apply(p, tokens, heads=4, **F32)
     sp = {**p, "blocks": stack_layers(p["blocks"])}
     specs = tfm.pp_specs(sp)
-    got = jax.shard_map(
+    got = shard_map(
         lambda q, t: tfm.apply_pp(q, t, heads=4, num_microbatches=2,
                                   **F32),
         mesh=mesh_pp, in_specs=(specs, P()), out_specs=P())(sp, tokens)

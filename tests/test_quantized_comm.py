@@ -17,7 +17,9 @@ from minips_tpu.tables.dense import DenseTable
 
 
 def _run(mesh, fn, *xs):
-    return jax.jit(jax.shard_map(
+    from minips_tpu.utils.jaxcompat import shard_map
+
+    return jax.jit(shard_map(
         fn, mesh=mesh, in_specs=(P("data"),) * len(xs),
         out_specs=P("data")))(*xs)
 

@@ -7,10 +7,12 @@ attention bit-for-bit (up to fp tolerance) for every (causal, shape) combo.
 """
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from minips_tpu.utils.jaxcompat import shard_map
 from minips_tpu.parallel.ring_attention import (
     make_ring_attention,
     reference_attention,
@@ -73,7 +75,7 @@ def test_single_device_degenerates_to_full_attention():
     # run under a size-1 shard_map so axis_name resolves
     from jax.sharding import Mesh, PartitionSpec as P
     mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
-    f = jax.shard_map(
+    f = shard_map(
         lambda a, b, c: ring_attention_local(a, b, c, causal=True),
         mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
         out_specs=P("data"))

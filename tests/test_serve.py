@@ -541,3 +541,103 @@ def test_pull_storm_3proc_replicas_engage_and_stay_fresh():
         assert r["frames_dropped"] == 0, r
         assert r["storm_readers"] == 2
         assert r["read_rows_per_sec"] > 0
+
+
+# ---------------------------------------------- loopback self-shed
+def test_admit_request_sheds_back_at_loopback_capable_requester():
+    """Owner-side half of the self-shed: with NO peer holder covering
+    the leg but the REQUESTER holding every touched block, a
+    loopback-capable transport gets an svS naming the requester itself
+    — zero-wire self-serve instead of the backpressure ladder. A
+    transport without the capability keeps the seed svB behavior."""
+    from minips_tpu.serve.plane import TableServeState
+
+    sent = []
+
+    class _Bus:
+        supports_loopback = True
+
+        def on(self, *_a):
+            pass
+
+        def send(self, dest, kind, head, blob=None):
+            sent.append((dest, kind, head))
+
+    t = ShardedTable("t", 96, 2, _Bus(), 0, 3, updater="sgd")
+    sv = TableServeState(t, None, ServeConfig.parse("rate=0.001,burst=1"))
+    t._sv = sv
+    span = t.router.block_span(0)[1]
+    keys = np.arange(span, dtype=np.int64)  # block 0
+    with sv._ow_lock:
+        sv._granted[0] = (1,)  # only the requester holds it
+    sv.bucket.take()  # drain the one-token bucket
+    assert not sv.admit_request(1, 7, keys, {})
+    dest, kind, head = sent[-1]
+    assert (dest, kind) == (1, "svS:t") and head["h"] == [1]
+    # same situation on a loopback-less transport: svB backpressure
+    _Bus.supports_loopback = False
+    sent.clear()
+    assert not sv.admit_request(1, 8, keys, {})
+    assert sent[-1][1] == "svB:t"
+    # a PEER holder always wins over the self-shed
+    _Bus.supports_loopback = True
+    with sv._ow_lock:
+        sv._granted[0] = (1, 2)
+    sent.clear()
+    assert not sv.admit_request(1, 9, keys, {})
+    assert sent[-1][2]["h"] == [2]
+
+
+def test_self_shed_leg_serves_from_own_snapshot_over_loopback():
+    """Client half, over the real shm loopback: a shed naming THIS
+    rank re-issues the leg as an svP to self — served from the held
+    snapshot entirely in process (grant raced the pull: per-link FIFO
+    guarantees the svU precedes the svS, so the snapshot is installed
+    by redirect time), no owner fallback, no wire."""
+    buses = _mk_buses(2, backend="shm", settle=0.05)
+    ths = [threading.Thread(target=b.handshake, args=(2,))
+           for b in buses]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(timeout=15.0)
+    try:
+        tables = [ShardedTable("t", 64, 2, buses[i], i, 2,
+                               updater="sgd", lr=1.0,
+                               pull_timeout=10.0)
+                  for i in range(2)]
+        trainers = [ShardedPSTrainer(
+            {"t": tables[i]}, buses[i], 2, staleness=2,
+            serve="replicas=1,hot=1,interval=1e9,min_heat=1e18,"
+                  "lease=30")
+            for i in range(2)]
+        del trainers
+        t0, t1 = tables
+        span = t0.router.block_span(0)[1]
+        seed = np.arange(span * 2, dtype=np.float32).reshape(-1, 2)
+        t0._w[:span] = seed
+        # rank 1's leg to the owner is OUTSTANDING (the owner's pull
+        # handler is parked aside to freeze the race window open)
+        t0.bus._handlers.pop("psG:t")
+        keys = np.arange(span, dtype=np.int64)
+        fut = t1._issue_pull(keys, 0)
+        t0._sv._grant_blocks([0], (1,))  # the racing grant
+        deadline = time.monotonic() + 5.0
+        while t1._sv.held_blocks() == 0:
+            assert time.monotonic() < deadline, "grant never arrived"
+            time.sleep(0.02)
+        rid = next(iter(fut._remote and
+                        {r for r in t1._rid_gid}))  # the live leg
+        pulled0 = t1.bytes_pulled
+        t1._sv._on_shed(0, {"req": int(rid), "h": [1]})
+        rows = fut.wait(timeout=10.0)
+        np.testing.assert_array_equal(rows, seed)
+        st = t1._sv.stats()
+        assert st["shed_local_legs"] == 1
+        assert st["replica_served_requests"] == 1
+        assert st["replica_fallbacks"] == 0  # never bounced to owner
+        assert t1.bytes_pulled == pulled0  # the serve crossed no wire
+        assert buses[1].loopback_frames >= 2  # svP out + psr back
+    finally:
+        for b in buses:
+            b.close()

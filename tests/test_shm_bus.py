@@ -472,3 +472,93 @@ def test_bsp_lockstep_shm_survives_seeded_chaos_bitwise():
     assert lost == [0, 0]
     for a, b in zip(w_clean, w_chaos):
         np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------------- loopback lane
+def test_loopback_send_to_self_delivers_without_ring():
+    """rank→self rides the in-process loopback lane: delivered on the
+    recv thread in FIFO order, blob materialized, zero ring/wire bytes
+    (the serving plane's local-replica transport win)."""
+    buses = _mk(2)
+    got: list = []
+    threads: set = set()
+    buses[0].on("self", lambda s, p: (got.append((s, p["i"],
+                                                  p.get("__blob__"))),
+                                      threads.add(
+                                          threading.current_thread())))
+    sent0 = buses[0].bytes_sent
+    try:
+        arr = np.arange(64, dtype=np.int64)
+        for i in range(50):
+            buses[0].send(0, "self", {"i": i},
+                          blob=arr.tobytes() if i % 2 else None)
+        _wait(lambda: len(got) >= 50)
+        assert [g[1] for g in got] == list(range(50))  # FIFO
+        assert all(g[0] == 0 for g in got)  # sender is myself
+        for g in got:
+            if g[2] is not None:
+                assert np.array_equal(np.frombuffer(g[2], np.int64),
+                                      arr)
+        assert buses[0].bytes_sent == sent0  # nothing crossed a wire
+        assert buses[0].loopback_frames == 50
+        assert threads == {buses[0]._thread}  # recv-thread dispatch
+        assert buses[0].frames_lost == 0
+    finally:
+        _close(buses)
+
+
+def test_loopback_payload_is_deep_copied():
+    """The handler's payload must not alias the caller's dict (dispatch
+    mutates it with __blob__, handlers may mutate further)."""
+    buses = _mk(2)
+    seen: list = []
+    buses[0].on("m", lambda s, p: seen.append(p))
+    try:
+        payload = {"keys": [1, 2, 3], "nested": {"a": 1}}
+        buses[0].send(0, "m", payload, blob=b"bb")
+        _wait(lambda: len(seen) >= 1)
+        assert seen[0]["keys"] == [1, 2, 3]
+        seen[0]["nested"]["a"] = 99
+        assert payload["nested"]["a"] == 1  # caller's dict untouched
+        assert "__blob__" not in payload
+    finally:
+        _close(buses)
+
+
+def test_loopback_interleaves_fifo_with_ring_frames():
+    """Self frames and ring frames both dispatch on the one recv
+    thread; the self lane keeps ITS OWN order (cross-lane order is
+    unspecified, like any two senders)."""
+    buses = _mk(2)
+    got: list = []
+    buses[0].on("y", lambda s, p: got.append((s, p["i"])))
+    try:
+        for i in range(100):
+            buses[1].send(0, "y", {"i": i})
+            buses[0].send(0, "y", {"i": i})
+        _wait(lambda: len(got) >= 200)
+        from_self = [i for s, i in got if s == 0]
+        from_peer = [i for s, i in got if s == 1]
+        assert from_self == list(range(100))
+        assert from_peer == list(range(100))
+    finally:
+        _close(buses)
+
+
+def test_loopback_post_close_is_noop_and_zmq_still_refuses():
+    buses = _mk(2)
+    try:
+        buses[0].close()
+        buses[0].send(0, "x", {"i": 1})  # silent no-op, like publish
+    finally:
+        _close(buses)
+    # the zmq/native backends keep refusing self-sends: only the shm
+    # backend advertises the capability the serve plane probes
+    zbuses = mk_loopback_buses(2)
+    try:
+        assert not getattr(zbuses[0], "supports_loopback", False)
+        with pytest.raises(ValueError, match="self"):
+            zbuses[0].send(0, "x", {})
+    finally:
+        for b in zbuses:
+            b.close()

@@ -7,11 +7,13 @@ with data parallelism on a 2D (data=4, model=2) mesh.
 """
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from minips_tpu.utils.jaxcompat import shard_map
 from minips_tpu.models import transformer as tfm
 from minips_tpu.parallel.mesh import make_mesh
 
@@ -39,7 +41,7 @@ def test_tp_logits_match_full(mesh42, params):
     want = tfm.apply(params, tokens, heads=CFG["heads"], **F32)
 
     specs = tfm.tp_specs(params)
-    f = jax.shard_map(
+    f = shard_map(
         lambda p, t: tfm.apply_tp(p, t, heads=CFG["heads"], **F32),
         mesh=mesh42, in_specs=(specs, P()), out_specs=P())
     got = f(params, tokens)
@@ -63,7 +65,7 @@ def test_tp_grad_matches_full(mesh42, params):
             nll = -jnp.take_along_axis(logp, t_[:, 1:, None], axis=-1)[..., 0]
             return jnp.mean(nll)
 
-        return jax.shard_map(shard_fn, mesh=mesh42,
+        return shard_map(shard_fn, mesh=mesh42,
                              in_specs=(specs, P()), out_specs=P())(p, toks)
 
     l_f, g_f = jax.value_and_grad(full_loss)(params)
@@ -96,7 +98,7 @@ def test_tp_composes_with_dp(mesh42, params):
             nll = -jnp.take_along_axis(
                 logp, t_[:, 1:, None], axis=-1)[..., 0]
             return jax.lax.pmean(jnp.mean(nll), "data")
-        return jax.shard_map(shard_fn, mesh=mesh42,
+        return shard_map(shard_fn, mesh=mesh42,
                              in_specs=(specs, P("data")),
                              out_specs=P())(p, toks)
 
@@ -125,7 +127,7 @@ def test_tp_composes_with_dp(mesh42, params):
 def test_tp_heads_not_divisible_raises(mesh42, params):
     specs = tfm.tp_specs(params)
     with pytest.raises(ValueError, match="divisible"):
-        jax.shard_map(
+        shard_map(
             lambda p, t: tfm.apply_tp(p, t, heads=3),
             mesh=mesh42, in_specs=(specs, P()), out_specs=P()
         )(params, _toks(1, 8))
@@ -139,7 +141,7 @@ def test_tp_gqa_logits_match_full(mesh42):
     tokens = _toks(2, 16, seed=7)
     want = tfm.apply(p, tokens, heads=CFG["heads"], **F32)
     specs = tfm.tp_specs(p)
-    f = jax.shard_map(
+    f = shard_map(
         lambda q, t: tfm.apply_tp(q, t, heads=CFG["heads"], **F32),
         mesh=mesh42, in_specs=(specs, P()), out_specs=P())
     got = f(p, tokens)
@@ -153,7 +155,7 @@ def test_tp_gqa_kv_not_divisible_raises(mesh42):
     p = tfm.init(jax.random.PRNGKey(7), **{**CFG, "kv_heads": 1})
     tokens = _toks(1, 8)
     specs = tfm.tp_specs(p)
-    f = jax.shard_map(
+    f = shard_map(
         lambda q, t: tfm.apply_tp(q, t, heads=CFG["heads"], **F32),
         mesh=mesh42, in_specs=(specs, P()), out_specs=P())
     with pytest.raises(ValueError, match="kv_heads"):

@@ -10,12 +10,14 @@ without changing numerics.
 import functools
 
 import jax
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from minips_tpu.utils.jaxcompat import shard_map
 from minips_tpu.models import transformer as tfm
 
 CFG = dict(vocab=61, dim=32, heads=4, depth=2, max_len=128)
@@ -40,7 +42,7 @@ def _sp_logits(mesh, params, tokens, n, attn_impl="reference"):
         return tfm.apply_sp(p, toks, shift, heads=CFG["heads"],
                             attn_impl=attn_impl, **F32)
 
-    f = jax.shard_map(shard_fn, mesh=mesh,
+    f = shard_map(shard_fn, mesh=mesh,
                       in_specs=(P(), P(None, "data")),
                       out_specs=P(None, "data"))
     return f(params, tokens)
@@ -72,7 +74,7 @@ def test_sp_grad_matches_full(mesh8, params):
         def shard_fn(p_, i_, t_):
             shift = jax.lax.axis_index("data") * T_local
             return tfm.loss_sp(p_, i_, t_, shift, heads=CFG["heads"], **F32)
-        return jax.shard_map(
+        return shard_map(
             shard_fn, mesh=mesh8,
             in_specs=(P(), P(None, "data"), P(None, "data")),
             out_specs=P())(p, inp, tgt)
@@ -193,7 +195,7 @@ def test_remat_matches_no_remat(mesh8, params):
             logits = tfm.apply_sp(p_, inp, shift, heads=CFG["heads"],
                                   remat=True, **F32)
             return jax.lax.pmean(tfm.nll(logits, tgt), "data")
-        return jax.shard_map(
+        return shard_map(
             shard_fn, mesh=mesh8,
             in_specs=(P(), P(None, "data"), P(None, "data")),
             out_specs=P())(p, toks[:, :-1], toks[:, 1:])

@@ -1,0 +1,664 @@
+"""The sparse top-k + error-feedback push wire (ISSUE 10 tentpole).
+
+Codec units (top-k mass selection, blockwise 4/8-bit quantization),
+ResidualStore semantics (fold/retain/age, overflow never drops mass),
+wire integration (decode at the owner, gated-off path byte-identical to
+the seed frames), the staleness-bounded age flush, the EXACT residual
+flush across a rebalance epoch fence (bitwise vs an uncompressed
+oracle), and the convergence drills: lr + mlp training through the
+compressed wire pins the loss trajectory to the dense wire within
+tolerance — the SparCML claim this whole subsystem rides on.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from minips_tpu.ops.quantized_comm import (HOST_BLOCK,
+                                           blockwise_stream_bytes,
+                                           dequantize_blockwise,
+                                           quantize_blockwise, topk_rows)
+from minips_tpu.train.sharded_ps import (ResidualStore, ShardedPSTrainer,
+                                         ShardedTable)
+
+
+def _mk_buses(n, **kw):
+    from tests.conftest import mk_loopback_buses
+
+    return mk_loopback_buses(n, **kw)
+
+
+# ------------------------------------------------------------ codec units
+def test_topk_rows_selects_mass_not_touch_set():
+    g = np.zeros((10, 4), np.float32)
+    g[3] = 100.0  # one row carries ~all the mass
+    g[7] = 0.01
+    sel = topk_rows(g, mass=0.9, frac_cap=0.5)
+    assert sel.tolist() == [3]
+    # flat mass: selection runs into the cap
+    flat = np.ones((10, 4), np.float32)
+    sel = topk_rows(flat, mass=0.99, frac_cap=0.5)
+    assert sel.size == 5
+    assert np.array_equal(sel, np.sort(sel))  # sorted, deterministic
+
+
+def test_topk_rows_edge_cases():
+    assert topk_rows(np.empty((0, 4), np.float32)).size == 0
+    # all-zero gradient still selects one row (a frame must ship)
+    assert topk_rows(np.zeros((5, 4), np.float32)).size == 1
+    # mass=1.0 selects everything up to the cap
+    g = np.random.default_rng(0).normal(size=(8, 2)).astype(np.float32)
+    assert topk_rows(g, mass=1.0, frac_cap=1.0).size == 8
+
+
+@pytest.mark.parametrize("bits,tol", [(8, 1 / 127), (4, 1 / 7)])
+def test_blockwise_roundtrip_error_bounded(bits, tol):
+    rng = np.random.default_rng(1)
+    g = rng.normal(size=(37, 8)).astype(np.float32)  # ragged last block
+    codes, scales = quantize_blockwise(g, bits, block=64)
+    back = dequantize_blockwise(codes, scales, 37, 8, bits, block=64)
+    # nearest rounding: error <= scale/2 per element, scale = absmax/L
+    grid = np.concatenate([g.reshape(-1),
+                           np.zeros(64 * 5 - 37 * 8, np.float32)]
+                          ).reshape(-1, 64)
+    bound = (np.abs(grid).max(axis=1) * tol / 2 + 1e-7)[:, None]
+    err = np.abs((back - g).reshape(-1))
+    assert (err.reshape(-1) <= np.repeat(bound, 64)[: 37 * 8]).all()
+    cb, sb = blockwise_stream_bytes(37, 8, bits, 64)
+    assert codes.nbytes == cb and scales.nbytes == sb
+
+
+def test_blockwise_exact_on_integer_grid():
+    """Integer values whose block absmax equals the code range quantize
+    EXACTLY (scale 1.0) — the grid the bitwise fence oracle rides."""
+    rng = np.random.default_rng(2)
+    g = rng.integers(-7, 8, size=(16, 8)).astype(np.float32)
+    g.reshape(-1, 8)[:, 0] = 7.0  # every block's absmax = 7
+    codes, scales = quantize_blockwise(g, 4, block=8)
+    assert (scales == 1.0).all()
+    back = dequantize_blockwise(codes, scales, 16, 8, 4, block=8)
+    np.testing.assert_array_equal(back, g)
+    # stochastic rounding is a no-op on exactly-representable values
+    codes2, _ = quantize_blockwise(g, 4, block=8,
+                                   rng=np.random.default_rng(3))
+    np.testing.assert_array_equal(codes2, codes)
+
+
+def test_blockwise_stochastic_rounding_is_unbiased():
+    g = np.full((4, 8), 0.3, np.float32)
+    g[:, 0] = 7.0  # scale 1.0 at 4 bits, block 8
+    draws = [float(dequantize_blockwise(
+        *quantize_blockwise(g, 4, block=8,
+                            rng=np.random.default_rng(s)),
+        4, 8, 4, block=8)[:, 1:].mean()) for s in range(300)]
+    # 300 seeds x 28 positions: sigma of the grand mean ~ 0.005
+    assert abs(float(np.mean(draws)) - 0.3) < 0.02
+
+
+def test_blockwise_4bit_packs_two_codes_per_byte():
+    g = np.ones((4, 8), np.float32)
+    codes8, _ = quantize_blockwise(g, 8)
+    codes4, _ = quantize_blockwise(g, 4)
+    assert codes8.nbytes == 32 and codes4.nbytes == 16
+
+
+# ------------------------------------------------------ residual store
+def test_residual_store_fold_retain_birth_min():
+    rs = ResidualStore(2)
+    k = np.array([3, 7], np.int64)
+    rows = np.ones((2, 2), np.float32)
+    ov = rs.retain(k, rows, np.array([5, 9], np.int64))
+    assert ov[0].size == 0
+    g = np.full((3, 2), 0.5, np.float32)
+    births = rs.fold(np.array([3, 4, 7], np.int64), g)
+    # stored residuals joined the gradient; absent key untouched
+    np.testing.assert_array_equal(g[0], [1.5, 1.5])
+    np.testing.assert_array_equal(g[1], [0.5, 0.5])
+    assert births.tolist()[0] == 5 and births.tolist()[2] == 9
+    assert births[1] == ResidualStore.INF
+    assert len(rs) == 0  # fold releases the entries
+
+
+def test_residual_store_take_aged_and_all():
+    rs = ResidualStore(1)
+    rs.retain(np.array([1, 2, 3], np.int64),
+              np.ones((3, 1), np.float32),
+              np.array([0, 5, 10], np.int64))
+    k, r = rs.take(5)  # aged: birth <= 5
+    assert k.tolist() == [1, 2] and len(rs) == 1
+    k, r = rs.take()
+    assert k.tolist() == [3] and len(rs) == 0
+
+
+def test_residual_store_zero_rows_and_overflow():
+    rs = ResidualStore(1, cap_bytes=1)  # cap_rows floors at 1024
+    z = np.zeros((2, 1), np.float32)
+    rs.retain(np.array([1, 2], np.int64), z, np.zeros(2, np.int64))
+    assert len(rs) == 0  # nothing to repay: not stored
+    n = rs.cap_rows + 5
+    keys = np.arange(n, dtype=np.int64)
+    ovk, ovr = rs.retain(keys, np.ones((n, 1), np.float32),
+                         np.zeros(n, np.int64))
+    # overflow RETURNED (caller ships it dense), never dropped
+    assert ovk.size == 5 and rs.stats()["flushed_overflow"] == 5
+    assert len(rs) == rs.cap_rows
+
+
+# ------------------------------------------------------ wire validation
+def test_push_comm_validation_and_env_resolution(monkeypatch):
+    with pytest.raises(ValueError, match="push_comm"):
+        ShardedTable("t", 16, 2, None, 0, 1, push_comm="int4")
+    with pytest.raises(ValueError, match="push_dedup"):
+        ShardedTable("t", 16, 2, None, 0, 1, push_comm="topk8",
+                     push_dedup=False)
+    monkeypatch.setenv("MINIPS_PUSH_COMM", "topk4")
+    t = ShardedTable("t", 16, 2, None, 0, 1)
+    assert t.push_comm == "topk4" and t._ef is not None
+    # explicit wins over env; empty env means default
+    t2 = ShardedTable("t", 16, 2, None, 0, 1, push_comm="float32")
+    assert t2.push_comm == "float32" and t2._ef is None
+    monkeypatch.setenv("MINIPS_PUSH_COMM", "")
+    t3 = ShardedTable("t", 16, 2, None, 0, 1)
+    assert t3.push_comm == "float32"
+
+
+def test_gated_off_f32_frames_are_seed_bytes():
+    """The bitwise A/B half of the acceptance: with push_comm left at
+    the default the wire frames are BYTE-IDENTICAL to the seed layout
+    (int64 keys + f32 rows, head {"n", "comm"} + epoch/config stamps)
+    — the compressed pipeline must be invisible when gated off."""
+    sent = []
+
+    class _Bus:
+        def on(self, *_a):
+            pass
+
+        def send(self, dest, kind, head, blob=None):
+            sent.append((dest, kind, head, bytes(blob)))
+
+    t = ShardedTable("t", 64, 2, _Bus(), 0, 2, updater="sgd", lr=0.1)
+    assert t._ef is None
+    keys = np.array([40, 33, 47], np.int64)  # rank 1's range
+    g = np.random.default_rng(0).normal(size=(3, 2)).astype(np.float32)
+    t.push(keys, g)
+    (dest, kind, head, blob), = sent
+    assert (dest, kind) == (1, "psP:t")
+    assert head == {"n": 3, "comm": "float32", "ws": 2, "nr": 64,
+                    "dm": 2, "rb": 0}
+    uniq = np.sort(keys)
+    order = np.argsort(keys, kind="stable")
+    assert blob == uniq.tobytes() + g[order].tobytes() or \
+        blob == keys.tobytes() + g.tobytes()
+
+
+def test_topk_push_decodes_at_owner_within_tolerance():
+    """One compressed push: the owner's rows move by the DECODED top-k
+    mass; the pusher's residual holds exactly the remainder."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="sgd",
+                      lr=1.0, push_comm="topk8", topk_mass=0.5,
+                      topk_cap=0.5, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="sgd",
+                      lr=1.0, push_comm="topk8", pull_timeout=10.0)
+    try:
+        keys = np.arange(32, 40, dtype=np.int64)  # rank 1's shard
+        g = np.ones((8, 2), np.float32)
+        g[0] = 100.0  # the mass row
+        t0.push(keys, g)
+        import time
+        deadline = time.monotonic() + 5
+        while not t1._w[:8].any() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # the mass row landed (quantized), the tail is in the residual
+        assert abs(float(t1._w[0, 0]) + 100.0) < 1.0
+        ef = t0.ef_stats()
+        assert ef["retained_rows"] >= 7
+        assert len(t0._ef) >= 7
+        # the flush delivers the remainder exactly (f32 fence flush)
+        t0.residual_flush()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                not (np.abs(t1._w[:8] + (g * 1.0)) < 0.5).all():
+            time.sleep(0.01)
+        np.testing.assert_allclose(t1._w[:8], -g, atol=0.5)
+        assert len(t0._ef) == 0
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_fold_repays_quantization_error():
+    """Two pushes of the same keys: the second fold brings the first
+    push's quantization error back into the gradient, so the owner's
+    total converges on the exact sum — E2E error feedback."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="sgd",
+                      lr=1.0, push_comm="topk8", topk_mass=1.0,
+                      topk_cap=1.0, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="sgd",
+                      lr=1.0, push_comm="topk8", pull_timeout=10.0)
+    try:
+        rng = np.random.default_rng(7)
+        keys = np.arange(32, 48, dtype=np.int64)
+        total = np.zeros((16, 2), np.float32)
+        for _ in range(20):
+            g = rng.normal(size=(16, 2)).astype(np.float32)
+            total += g
+            t0.push(keys, g)
+        t0.residual_flush()  # exact tail
+        import time
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if np.allclose(t1._w[:16], -total, atol=1e-2):
+                break
+            time.sleep(0.02)
+        np.testing.assert_allclose(t1._w[:16], -total, atol=1e-2)
+    finally:
+        for b in buses:
+            b.close()
+
+
+# ------------------------------------------------- staleness age flush
+def test_age_flush_bounds_residual_life_under_ssp():
+    """SSP(1): a residual born at clock c must be on the wire by the
+    boundary where clock - s reaches c — the RowCache stamp rule
+    mirrored onto the write path. ASP never age-flushes."""
+    t = ShardedTable("t", 64, 2, None, 0, 1, updater="sgd",
+                     push_comm="topk8")
+
+    class _Cons:
+        clock = 0
+        staleness = 1
+
+    t._cons = _Cons()
+    t._ef.retain(np.array([1], np.int64), np.ones((1, 2), np.float32),
+                 np.array([0], np.int64))
+    _Cons.clock = 0
+    assert t.residual_flush(aged_only=True) == 0  # bound not reached
+    _Cons.clock = 1
+    assert t.residual_flush(aged_only=True) == 1  # birth <= 1 - 1
+    assert len(t._ef) == 0
+    # ASP: no bound, no age flush ever
+    _Cons.staleness = float("inf")
+    t._ef.retain(np.array([2], np.int64), np.ones((1, 2), np.float32),
+                 np.array([0], np.int64))
+    _Cons.clock = 99
+    assert t.residual_flush(aged_only=True) == 0
+    assert len(t._ef) == 1
+
+
+def test_aged_flush_rides_the_4bit_stream():
+    """The aged flush ships the whole aged set on the topk4 index+code
+    stream (unbiased stochastic rounding, error dropped — the int8
+    wire's contract), NOT f32: an f32 age flush measurably cost more
+    than the int8 wire the tentpole must beat."""
+    sent = []
+
+    class _Bus:
+        def on(self, *_a):
+            pass
+
+        def send(self, dest, kind, head, blob=None):
+            sent.append((kind, head))
+
+    t = ShardedTable("t", 64, 2, _Bus(), 0, 2, updater="sgd",
+                     push_comm="topk8")
+
+    class _Cons:
+        clock = 5
+        staleness = 1
+
+    t._cons = _Cons()
+    t._ef.retain(np.array([40], np.int64),  # rank 1's range: wire flush
+                 np.ones((1, 2), np.float32), np.array([0], np.int64))
+    assert t.residual_flush(aged_only=True) == 1
+    (kind, head), = sent
+    assert kind == "psP:t" and head["comm"] == "topk4"
+    assert head["kw"] == 2  # 64-row key space: u16 index stream
+    sent.clear()
+    # fence flushes stay EXACT f32 (the bitwise oracle contract)
+    t._ef.retain(np.array([41], np.int64),
+                 np.ones((1, 2), np.float32), np.array([0], np.int64))
+    t.residual_flush()
+    (kind, head), = sent
+    assert head["comm"] == "float32"
+
+
+# ------------------------------------- the epoch-fence bitwise oracle
+def test_residual_flushed_across_rebalance_fence_bitwise():
+    """THE acceptance drill: push on an exact-arithmetic grid (integer
+    grads, per-block absmax pinned to the 4-bit code range, lr a power
+    of two), adopt a rebalance epoch — the fence flush must deliver
+    every retained row BEFORE the migration ships, so the assembled
+    table is BITWISE equal to an uncompressed oracle."""
+    from tests.test_rebalance import _StubRB
+
+    from minips_tpu.balance.rebalancer import RebalanceConfig
+
+    buses = _mk_buses(2)
+    mk = lambda r, bus: ShardedTable(  # noqa: E731
+        "t", 64, 2, bus, r, 2, updater="sgd", lr=0.125,
+        push_comm="topk4", topk_mass=0.5, topk_cap=0.25, topk_block=8,
+        pull_timeout=10.0)
+    t0, t1 = mk(0, buses[0]), mk(1, buses[1])
+    rb = _StubRB()
+    rb.tables = [t0, t1]
+    cfg = RebalanceConfig.parse("block=4")
+    for t in (t0, t1):
+        t.attach_rebalancer(rb, cfg)
+    oracle = ShardedTable("o", 64, 2, None, 0, 1, updater="sgd",
+                          lr=0.125)
+    try:
+        rng = np.random.default_rng(11)
+        keys = np.arange(32, 48, dtype=np.int64)  # rank 1's shard
+        g = rng.integers(-7, 8, size=(16, 2)).astype(np.float32)
+        g.reshape(-1, 8)[:, 0] = 7.0  # every codec block absmax = 7:
+        # the 4-bit stream is EXACT, so selected rows ship whole and
+        # retained rows are whole-row exact — nothing is split
+        t0.push(keys, g)
+        oracle.push(keys, g)
+        assert len(t0._ef) > 0  # unselected mass retained
+        import time
+        time.sleep(0.3)  # let the compressed frame land at t1
+        # the epoch fence: block 8 (keys 32..35) migrates 1 -> 0; t0's
+        # adoption flushes its WHOLE residual store (f32, old table,
+        # ahead of its rbA) before anything ships
+        t0.adopt_table(1, {8: 0})
+        t1.adopt_table(1, {8: 0})
+        deadline = time.monotonic() + 10
+        while not (t0.rebalance_settled() and t1.rebalance_settled()):
+            assert time.monotonic() < deadline, "migration never settled"
+            time.sleep(0.01)
+        assert len(t0._ef) == 0  # provably flushed at the fence
+        assert t0.ef_stats()["flushed_fence"] > 0
+        got = np.empty((64, 2), np.float32)
+        got[:32] = t0._w[:32]
+        got[32:36] = t0._xtra[8]["w"]  # the migrated block
+        got[36:] = t1._w[4:]
+        want = oracle.pull_all()
+        np.testing.assert_array_equal(got, want)  # BITWISE
+    finally:
+        for b in buses:
+            b.close()
+
+
+# ---------------------------------------------------- convergence drills
+def _train_lr(push_comm, iters=30, staleness=1):
+    """2-rank threads-as-nodes logistic regression through the sharded
+    PS (dim-1 rows, the lr-example shape): returns the loss curve."""
+    buses = _mk_buses(2)
+    dim_feat = 32
+    tables = [ShardedTable("w", dim_feat, 1, buses[i], i, 2,
+                           updater="sgd", lr=0.5, push_comm=push_comm,
+                           pull_timeout=20.0)
+              for i in range(2)]
+    trainers = [ShardedPSTrainer({"w": tables[i]}, buses[i], 2,
+                                 staleness=staleness, gate_timeout=30.0)
+                for i in range(2)]
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=dim_feat)
+    X = rng.normal(size=(256, dim_feat)).astype(np.float32)
+    y = (X @ w_true > 0).astype(np.float32)
+    losses = [[], []]
+    errs: list = []
+
+    def worker(r):
+        try:
+            Xr, yr = X[r::2], y[r::2]
+            keys = np.arange(dim_feat, dtype=np.int64)
+            for i in range(iters):
+                w = tables[r].pull(keys).reshape(-1)
+                logits = Xr @ w
+                p = 1.0 / (1.0 + np.exp(-logits))
+                loss = float(np.mean(
+                    np.maximum(logits, 0) - logits * yr
+                    + np.log1p(np.exp(-np.abs(logits)))))
+                g = (Xr.T @ (p - yr) / len(yr) / 2).astype(np.float32)
+                tables[r].push(keys, g.reshape(-1, 1))
+                trainers[r].tick()
+                losses[r].append(loss)
+            trainers[r].finalize(timeout=20.0)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    try:
+        ths = [threading.Thread(target=worker, args=(r,))
+               for r in (0, 1)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=120.0)
+        assert not errs, errs
+        ef = trainers[0].ef_stats()
+        if push_comm.startswith("topk"):
+            assert ef is not None and ef["resident_rows"] == 0
+        return np.mean(losses, axis=0)
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_lr_convergence_topk8_tracks_dense_wire():
+    """The convergence acceptance: lr training through topk8 + error
+    feedback pins the loss trajectory within tolerance of the dense
+    wire — withheld mass is repaid, never lost."""
+    dense = _train_lr("float32")
+    topk = _train_lr("topk8")
+    assert topk[-1] < 0.35, topk[-1]  # well below log(2) chance
+    assert abs(topk[-1] - dense[-1]) < 0.08, (topk[-1], dense[-1])
+    # the whole tail tracks, not just the endpoint
+    assert float(np.mean(np.abs(topk[-5:] - dense[-5:]))) < 0.1
+
+
+def test_mlp_convergence_topk8_tracks_dense_wire():
+    """The mlp flavor: embedding rows (dim 8) trained through a numpy
+    2-layer MLP head, compressed vs dense wire — the wide-row regime
+    where blockwise scales and the index stream actually pay."""
+    def run(push_comm, iters=40):
+        buses = _mk_buses(2)
+        rows, dim, hid = 32, 8, 16
+        tables = [ShardedTable("e", rows, dim, buses[i], i, 2,
+                               updater="sgd", lr=0.3, init_scale=0.5,
+                               seed=9, push_comm=push_comm,
+                               pull_timeout=20.0)
+                  for i in range(2)]
+        trainers = [ShardedPSTrainer({"e": tables[i]}, buses[i], 2,
+                                     staleness=1, gate_timeout=30.0)
+                    for i in range(2)]
+        rng = np.random.default_rng(5)
+        W1 = rng.normal(scale=0.5, size=(dim, hid)).astype(np.float32)
+        W2 = rng.normal(scale=0.5, size=hid).astype(np.float32)
+        ids = rng.integers(0, rows, size=256)
+        y = (ids % 2).astype(np.float32)  # learnable per-row labels
+        losses = [[], []]
+        errs: list = []
+
+        def worker(r):
+            try:
+                idr, yr = ids[r::2], y[r::2]
+                for i in range(iters):
+                    e = tables[r].pull(idr)
+                    h = np.maximum(e @ W1, 0)
+                    logits = h @ W2
+                    p = 1 / (1 + np.exp(-logits))
+                    loss = float(np.mean(
+                        np.maximum(logits, 0) - logits * yr
+                        + np.log1p(np.exp(-np.abs(logits)))))
+                    dl = (p - yr) / len(yr) / 2
+                    dh = np.outer(dl, W2) * (h > 0)
+                    ge = (dh @ W1.T).astype(np.float32)
+                    tables[r].push(idr, ge)
+                    trainers[r].tick()
+                    losses[r].append(loss)
+                trainers[r].finalize(timeout=20.0)
+            except Exception as ex:  # noqa: BLE001 - surfaced below
+                errs.append(ex)
+
+        try:
+            ths = [threading.Thread(target=worker, args=(r,))
+                   for r in (0, 1)]
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join(timeout=120.0)
+            assert not errs, errs
+            return np.mean(losses, axis=0)
+        finally:
+            for b in buses:
+                b.close()
+
+    dense = run("float32")
+    topk = run("topk8")
+    assert topk[-1] < dense[0], (topk[-1], dense[0])  # it learned
+    assert abs(topk[-1] - dense[-1]) < 0.1, (topk[-1], dense[-1])
+
+
+# -------------------------------------------------- serve-plane codec
+def test_serve_delta_rides_blockwise_codec():
+    """The serving plane's grant/delta refreshes ride the same
+    blockwise codec when the table runs a compressed push wire —
+    replicas get the byte win too."""
+    from minips_tpu.serve.plane import ServeConfig, TableServeState
+
+    t = ShardedTable("t", 64, 8, None, 0, 1, push_comm="topk8",
+                     topk_block=16)
+    sv = TableServeState(t, None, ServeConfig())
+    wire, blk = sv._serve_wire()
+    assert (wire, blk) == ("blk8", 16)
+    rows = np.random.default_rng(0).normal(size=(6, 8)
+                                           ).astype(np.float32)
+    tag, payload = sv._encode_rows(rows)
+    assert tag == "blk8"
+    assert len(payload) == sv._row_seg_bytes("blk8", 16, 6)
+    back = sv._decode_rows("blk8", 16, 6, payload)
+    np.testing.assert_allclose(back, rows, atol=np.abs(rows).max() / 64)
+    # int8 < blockwise on bytes: the win the refresh stream inherits
+    t2 = ShardedTable("t2", 64, 8, None, 0, 1, pull_wire="int8")
+    sv2 = TableServeState(t2, None, ServeConfig())
+    assert sv._row_seg_bytes("blk8", 16, 6) \
+        < sv2._row_seg_bytes("int8", 0, 6)
+    # f32 tables keep the seed wire
+    t3 = ShardedTable("t3", 64, 8, None, 0, 1)
+    sv3 = TableServeState(t3, None, ServeConfig())
+    assert sv3._serve_wire() == ("f32", 0)
+
+
+# --------------------------------------------------- elastic drain flush
+@pytest.mark.slow
+def test_drain_flushes_residuals_before_leaving(tmp_path):
+    """The elastic half of the acceptance: a graceful drain on the
+    compressed wire ships every retained residual before mbG — the
+    leaver exits rc 0 with ZERO resident rows and survivors agree."""
+    import sys
+
+    from minips_tpu import launch
+
+    res = launch.run_local_job(
+        3, [sys.executable, "-m", "minips_tpu.apps.sharded_ps_example",
+            "--model", "sparse", "--mode", "ssp", "--staleness", "2",
+            "--iters", "30", "--batch", "64", "--push-comm", "topk8",
+            "--drain-at", "12", "--drain-rank", "2",
+            "--checkpoint-dir", str(tmp_path / "ck"),
+            "--checkpoint-every", "5"],
+        base_port=None,
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                   "MINIPS_ELASTIC": "1", "MINIPS_PUSH_COMM": ""},
+        timeout=200.0)
+    assert res[2]["event"] == "drained"
+    for r in res:
+        ef = r.get("ef")
+        assert ef is not None and ef["resident_rows"] == 0, (
+            r["rank"], ef)
+        assert r.get("wire_frames_lost", 0) == 0
+    dones = res[:2]
+    assert dones[0]["param_sum"] == dones[1]["param_sum"]
+
+
+def test_ef_counters_ride_wire_record():
+    """The done-line `ef` block: None on an exact wire, counters when
+    the compressed wire is armed (off vs idle, the PR5 convention)."""
+    from minips_tpu.utils.metrics import wire_record
+
+    class _Tr:
+        bytes_pushed = bytes_pulled = frames_dropped = 0
+        wire_frames_lost = wire_frames_malformed = 0
+
+        def comm_timing(self):
+            return {}
+
+        def hist_stats(self):
+            return {}
+
+        def cache_stats(self):
+            return None
+
+        def ef_stats(self):
+            return {"resident_rows": 0, "folded_rows": 3}
+
+        def reliable_stats(self):
+            return None
+
+        def chaos_stats(self):
+            return None
+
+        def serve_stats(self):
+            return {}
+
+        def rebalance_stats(self):
+            return None
+
+    rec = wire_record(_Tr())
+    assert rec["ef"] == {"resident_rows": 0, "folded_rows": 3}
+
+
+def test_finalize_flushes_residuals_of_queued_async_pushes():
+    """Regression (review finding): finalize() must drain the async
+    queue BEFORE the residual flush — a queued topk push encodes on
+    the sender thread and RETAINS fresh residuals, so the old
+    flush-then-drain order stranded exactly the mass the flush exists
+    to ship (resident_rows > 0 on exit, silent gradient loss)."""
+    buses = _mk_buses(2)
+    tables = [ShardedTable("t", 64, 2, buses[i], i, 2, updater="sgd",
+                           lr=1.0, push_comm="topk8", topk_mass=0.5,
+                           topk_cap=0.5, async_push=True,
+                           pull_timeout=15.0)
+              for i in range(2)]
+    trainers = [ShardedPSTrainer({"t": tables[i]}, buses[i], 2,
+                                 staleness=float("inf"))
+                for i in range(2)]
+    errs: list = []
+    finals: list = [None, None]
+
+    def worker(r):
+        try:
+            rng = np.random.default_rng(3 + r)
+            other = np.arange(32, 48) if r == 0 else np.arange(0, 16)
+            for _ in range(4):
+                tables[r].push(other.astype(np.int64),
+                               rng.normal(size=(16, 2)
+                                          ).astype(np.float32))
+            # the LAST push sits queued when finalize starts: its
+            # encode (and retain) happens inside finalize's drain
+            trainers[r].finalize(timeout=20.0)
+            finals[r] = tables[r].pull_all()
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    try:
+        ths = [threading.Thread(target=worker, args=(r,))
+               for r in (0, 1)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=60.0)
+        assert not errs, errs
+        for r in (0, 1):
+            ef = tables[r].ef_stats()
+            assert ef["resident_rows"] == 0, (r, ef)
+            assert ef["retained_rows"] > 0  # the drill exercised EF
+        np.testing.assert_array_equal(finals[0], finals[1])
+    finally:
+        for b in buses:
+            b.close()
