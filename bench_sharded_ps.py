@@ -128,6 +128,9 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     env_extra["MINIPS_ELASTIC"] = ""
     env_extra["MINIPS_CHAOS_KILL"] = ""
     env_extra["MINIPS_HEARTBEAT"] = ""
+    # the in-mesh collective plane rides its own sweep via --plane; an
+    # armed MINIPS_MESH must not reroute (or refuse) the wire arms
+    env_extra["MINIPS_MESH"] = ""
     # head-codec arm config (the transport sweep): explicit empty keeps
     # an armed environment from leaking a format into the other arms
     env_extra["MINIPS_WIRE_FMT"] = wire_fmt or ""
@@ -510,7 +513,7 @@ def main() -> int:
                 "MINIPS_SERVE": "", "MINIPS_BUS": "",
                 "MINIPS_WIRE_FMT": "", "MINIPS_ELASTIC": "",
                 "MINIPS_CHAOS_KILL": "", "MINIPS_HEARTBEAT": "",
-                "MINIPS_PUSH_COMM": ""}
+                "MINIPS_PUSH_COMM": "", "MINIPS_MESH": ""}
         out: dict = {"iters": e_iters}
         for arm, comm in (("f32", "float32"), ("topk8", "topk8")):
             try:
@@ -729,7 +732,7 @@ def main() -> int:
                            "MINIPS_WIRE_FMT": "", "MINIPS_ELASTIC": "",
                            "MINIPS_CHAOS_KILL": "",
                            "MINIPS_HEARTBEAT": "",
-                           "MINIPS_PUSH_COMM": ""},
+                           "MINIPS_PUSH_COMM": "", "MINIPS_MESH": ""},
                 timeout=timeout)
         except Exception as e:  # noqa: BLE001 - completion-gated arms
             return {"completed": False, "error": str(e)[:300]}
@@ -817,7 +820,7 @@ def main() -> int:
                 "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
                 "MINIPS_SERVE": "", "MINIPS_BUS": "",
                 "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
-                "MINIPS_HEARTBEAT": "", "MINIPS_PUSH_COMM": ""}
+                "MINIPS_HEARTBEAT": "", "MINIPS_PUSH_COMM": "", "MINIPS_MESH": ""}
         kill_step = max(2, e_iters // 3)
         grid: dict = {"iters": e_iters, "kill_step": kill_step}
 
@@ -904,6 +907,105 @@ def main() -> int:
 
     elastic_grid = _elastic_arms()
 
+    # THE IN-MESH COLLECTIVE DATA PLANE (this PR): the fused sweep
+    # point — dense pull_all/push_dense cycles, the lrmlp weight-vector
+    # shape — measured on the host wire (3 procs, zmq, ASP: its best
+    # case) vs the mesh plane (one process, 3 logical ranks over 3
+    # devices, push/pull as reduce-scatter/all-gather with pjit-sharded
+    # table + updater state, BSP: the collective IS the barrier) vs the
+    # mesh quantized tier (blk8: blockwise absmax int8 inside the
+    # collective — the PR9 wire codec's second transport). Alternating
+    # medians like every throughput pair. The ci/bench_regression
+    # MESH-* tripwires gate: MESH-WIN — the mesh arm's rows/sec/rank
+    # strictly above the wire arm's (the whole point: the data plane
+    # stops paying socket+codec+frame tax and bridges toward the
+    # fused-SPMD numbers); MESH-BITWISE — the BSP zmq-vs-mesh lockstep
+    # drill (run in a subprocess against this tree) must report
+    # bitwise-equal finals, so the transport swap provably preserves
+    # the consistency contract. NOTE the rows/sec columns compare a
+    # process boundary against a device mesh — integer factors by
+    # design, which is the measurement (same caveat family as the
+    # overlap sweep: the wire's deficit here is protocol cost).
+    MESH_RANKS = 3
+
+    def _run_mesh_arm(comm: str) -> dict:
+        argv = [sys.executable, "-m",
+                "minips_tpu.apps.sharded_ps_bench",
+                "--path", "dense", "--plane", "mesh",
+                "--mesh-ranks", str(MESH_RANKS), "--mesh-comm", comm,
+                "--iters", str(iters), "--warmup", str(warmup),
+                "--staleness", "0"]
+        env = {**os.environ, "MINIPS_FORCE_CPU": "1",
+               "JAX_PLATFORMS": "cpu", "MINIPS_MESH": ""}
+        try:
+            proc = subprocess.run(argv, capture_output=True, text=True,
+                                  timeout=300.0, env=env)
+            if proc.returncode != 0:
+                raise RuntimeError(proc.stderr[-300:])
+            res = json.loads([ln for ln in proc.stdout.splitlines()
+                              if ln.startswith("{")][-1])
+        except Exception as e:  # noqa: BLE001 - completion-gated
+            return {"completed": False, "error": str(e)[:300]}
+        assert res.get("plane") == "mesh" and \
+            res.get("mesh_comm") == comm, res
+        return {
+            "completed": True,
+            "plane": "mesh", "mesh_comm": comm,
+            "mesh_ranks": res["mesh_ranks"],
+            "device_count": res["device_count"],
+            "jax_backend": res["jax_backend"],
+            "rows_per_sec_per_process": res["rows_per_sec"],
+            "aggregate_rows_per_sec": res["aggregate_rows_per_sec"],
+            "waves": res["waves"],
+            "collective_bytes_per_row_moved":
+                res["collective_bytes_per_row_moved"],
+        }
+
+    def _mesh_arms(reps: int) -> dict:
+        arms = {"wire": lambda: {
+                    **_run(3, "dense", iters, warmup, "zmq"),
+                    "plane": "wire"},
+                "mesh": lambda: _run_mesh_arm("float32"),
+                "mesh_blk8": lambda: _run_mesh_arm("blk8")}
+        runs: dict[str, list[dict]] = {a: [] for a in arms}
+        for _ in range(reps):
+            for a, fn in arms.items():
+                runs[a].append(fn())
+
+        def med(arm: str) -> dict:
+            ok = [r for r in runs[arm] if r.get("completed")]
+            if not ok:
+                return runs[arm][-1]
+            by = sorted(ok, key=lambda r: r["rows_per_sec_per_process"])
+            return {**by[len(by) // 2], "reps": reps}
+        grid = {a: med(a) for a in arms}
+        # MESH-BITWISE: the zmq-vs-mesh BSP lockstep drill, run from the
+        # repo root (it drives the tests/ harness) in a subprocess so
+        # the driver never initializes a jax backend itself
+        drill_argv = [sys.executable, "-m",
+                      "minips_tpu.apps.sharded_ps_bench",
+                      "--mesh-bitwise-drill"]
+        try:
+            proc = subprocess.run(
+                drill_argv, capture_output=True, text=True,
+                timeout=300.0,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env={**os.environ, "MINIPS_FORCE_CPU": "1",
+                     "JAX_PLATFORMS": "cpu", "MINIPS_MESH": ""})
+            res = json.loads([ln for ln in proc.stdout.splitlines()
+                              if ln.startswith("{")][-1])
+            grid["bitwise"] = {"equal": bool(res.get("bitwise_equal")),
+                               "rows_checked":
+                                   int(res.get("rows_checked", 0))}
+            if res.get("error"):
+                grid["bitwise"]["error"] = res["error"]
+        except Exception as e:  # noqa: BLE001 - the gate reads this
+            grid["bitwise"] = {"equal": False, "rows_checked": 0,
+                               "error": str(e)[:300]}
+        return grid
+
+    mesh_grid = _mesh_arms(o_reps)
+
     # resolved JAX backend stamp (satellite): probed in a SUBPROCESS so
     # the driver never grabs the TPU out from under a worker (libtpu is
     # exclusive per process) — ci/bench_regression.py refuses to
@@ -924,6 +1026,17 @@ def main() -> int:
         except Exception:  # noqa: BLE001 - a stamp, not a gate
             return "unknown"
 
+    # resolved mesh/device SHAPE stamp (satellite): backend:device-count
+    # as the mesh arms saw it — ci/bench_regression.py refuses to
+    # compare artifacts across shapes the way it refuses cross-backend
+    # pairs (a mesh point at 8 devices is incomparable to one at 3; the
+    # collective cost scales with the ring)
+    def _resolve_device_shape() -> str:
+        shape = (mesh_grid.get("mesh") or {})
+        if shape.get("completed"):
+            return f"{shape['jax_backend']}:{shape['device_count']}"
+        return "unknown"
+
     headline = curve["3"]["rows_per_sec_per_process"]
     print(json.dumps({
         "metric": "sharded-PS rows/sec/process (sparse pull+push, "
@@ -935,6 +1048,10 @@ def main() -> int:
         # the resolved JAX platform these numbers were measured under:
         # the regression gate refuses cross-backend comparisons
         "jax_backend": _resolve_jax_backend(),
+        # the mesh/device shape the collective-plane arms ran at
+        # (backend:device-count) — the gate refuses cross-shape
+        # comparisons the same way
+        "device_shape": _resolve_device_shape(),
         "scaling_sparse_zmq": curve,
         "bus_comparison_3proc": buses,
         "transport_comparison_3proc": transport_grid,
@@ -950,6 +1067,7 @@ def main() -> int:
         "trace_overhead_3proc": trace_grid,
         "pull_storm_3proc": storm_grid,
         "elastic_membership_3proc": elastic_grid,
+        "mesh_plane_fused": mesh_grid,
     }))
     return 0
 

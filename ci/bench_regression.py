@@ -53,10 +53,19 @@ complete via explicit refusal, never a timeout poison.
 survivors must complete with >= 1 range restored from the elastic
 checkpoint, zero unrecovered frames, finite loss and bitwise-agreeing
 finals, and the standby-admission arm must complete with the joiner
-serving > 0 rows. Artifacts also
-carry a resolved ``jax_backend`` stamp, and the gate REFUSES to
-compare artifacts across backends (cross-backend rates differ by
-integer factors; re-base instead).
+serving > 0 rows.
+``mesh_tripwires`` (MESH-WIN/MESH-BITWISE) guards the
+``mesh_plane_fused`` sweep: the in-mesh collective plane's arm must
+beat the host-wire arm on rows/sec strictly (the data plane exists to
+stop paying socket+codec tax), the quantized blk8 arm must complete,
+and the BSP zmq-vs-mesh lockstep drill must report bitwise-equal
+finals (the transport swap may not move one bit of training state).
+Artifacts also carry a resolved ``jax_backend`` stamp, and the gate
+REFUSES to compare artifacts across backends (cross-backend rates
+differ by integer factors; re-base instead) — and likewise a
+``device_shape`` stamp (backend:device-count of the mesh arms), with
+cross-SHAPE comparisons refused the same way (collective cost scales
+with the ring).
 
 Usage:
     python ci/bench_regression.py PRIOR.json NEW.json [--tolerance 0.10]
@@ -602,6 +611,87 @@ def elastic_tripwires(new: dict) -> list[str]:
     return problems
 
 
+def mesh_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``mesh_plane_fused`` sweep
+    (the in-mesh collective data plane, train/mesh_plane.py); vacuous
+    when the sweep is absent (other benches).
+
+    - MESH-WIN: the mesh arm must COMPLETE and beat the host-wire arm's
+      rows/sec/rank STRICTLY (alternating medians) on the fused dense
+      point — a mesh plane at or below the socket wire means the
+      collective path silently degraded to host round-trips. The blk8
+      quantized arm must complete too (its rate is recorded, not
+      ordered: quantize/dequantize costs compute on CPU; the byte win
+      converts on a real interconnect).
+    - MESH-BITWISE: the BSP zmq-vs-mesh lockstep drill must have run
+      (> 0 rows checked) and reported bitwise-EQUAL finals — the
+      consistency contract must survive the transport swap, bit for
+      bit, or the plane is not a data plane but a different trainer."""
+    grid = new.get("mesh_plane_fused") or {}
+    if not grid:
+        return []
+    problems = []
+    wire = (grid.get("wire") or {}).get(METRIC)
+    mesh_arm = grid.get("mesh") or {}
+    mesh = mesh_arm.get(METRIC)
+    if not mesh_arm.get("completed") or \
+            not (isinstance(mesh, (int, float))
+                 and isinstance(wire, (int, float)) and mesh > wire):
+        problems.append(
+            f"MESH-WIN mesh_plane_fused: mesh arm {mesh!r} rows/s/rank "
+            f"is not strictly above the host-wire arm's {wire!r} "
+            f"(completed={mesh_arm.get('completed')!r}) — the "
+            "collective data plane is not beating the socket wire on "
+            "the fused point")
+    blk = grid.get("mesh_blk8") or {}
+    if not blk.get("completed"):
+        problems.append(
+            f"MESH-WIN mesh_plane_fused/mesh_blk8: completed="
+            f"{blk.get('completed')!r} — the quantized collective tier "
+            "must complete")
+    bit = grid.get("bitwise") or {}
+    if not bit.get("equal") or not bit.get("rows_checked"):
+        problems.append(
+            f"MESH-BITWISE mesh_plane_fused/bitwise: equal="
+            f"{bit.get('equal')!r} rows_checked="
+            f"{bit.get('rows_checked')!r}"
+            + (f" error={bit.get('error')!r}" if bit.get("error")
+               else "")
+            + " — BSP on the mesh plane must be bitwise-equal to the "
+            "zmq wire path under the lockstep drill")
+    return problems
+
+
+def shape_mismatch(prior: dict, new: dict) -> list[str]:
+    """Refuse cross-SHAPE comparisons (satellite): ``device_shape``
+    stamps the backend:device-count the mesh arms measured under —
+    collective cost scales with the ring, so a mesh point at 8 devices
+    is incomparable to one at 3 exactly the way cross-backend rates
+    are. Same conventions as :func:`backend_mismatch`: ``unknown`` (the
+    probe-failure / mesh-arm-failed sentinel) and a missing stamp warn
+    and compare (we cannot refuse what was never recorded)."""
+    ps, ns = prior.get("device_shape"), new.get("device_shape")
+    if ps == "unknown":
+        ps = None
+    if ns == "unknown":
+        ns = None
+    if ps is None or ns is None:
+        if ps != ns or (prior.get("device_shape")
+                        != new.get("device_shape")):
+            print("bench-regression: WARNING — artifact missing a "
+                  "usable device_shape stamp (prior="
+                  f"{prior.get('device_shape')!r}, new="
+                  f"{new.get('device_shape')!r}); cross-shape drift "
+                  "undetectable for this pair")
+        return []
+    if ps != ns:
+        return [f"SHAPE-MISMATCH: prior artifact measured at "
+                f"{ps!r}, new at {ns!r} — collective rates across "
+                "device shapes are incomparable; re-base the artifact "
+                "at the new shape instead of comparing"]
+    return []
+
+
 def backend_mismatch(prior: dict, new: dict) -> list[str]:
     """Refuse to compare artifacts measured on different JAX backends
     (satellite): the r03-r05 ``cpu-fallback(tpu-unresponsive)`` runs
@@ -687,10 +777,10 @@ def main(argv: list[str] | None = None) -> int:
     with open(new_path) as f:
         new = json.load(f)
 
-    mismatch = backend_mismatch(prior, new)
+    mismatch = backend_mismatch(prior, new) + shape_mismatch(prior, new)
     if mismatch:
-        # cross-backend: run-to-run comparison is refused outright (the
-        # absolute tripwires would be as meaningless as the ratios)
+        # cross-backend/shape: run-to-run comparison is refused outright
+        # (the absolute tripwires would be as meaningless as the ratios)
         print("\n".join(mismatch), file=sys.stderr)
         return 1
     problems = (compare(prior, new, args.tolerance)
@@ -698,7 +788,8 @@ def main(argv: list[str] | None = None) -> int:
                 + transport_tripwires(new)
                 + wire_compression_tripwires(new)
                 + rebalance_tripwires(new) + trace_tripwires(new)
-                + serve_tripwires(new) + elastic_tripwires(new))
+                + serve_tripwires(new) + elastic_tripwires(new)
+                + mesh_tripwires(new))
     pts = throughput_points(new)
     print(f"bench-regression: {len(pts)} throughput points checked "
           f"against {len(throughput_points(prior))} prior")

@@ -30,6 +30,158 @@ import time
 import numpy as np
 
 
+def _arm_mesh_devices(n: int) -> None:
+    """CPU runs (``MINIPS_FORCE_CPU`` / ``JAX_PLATFORMS=cpu``) force
+    ``n`` host devices BEFORE the first backend touch (the repo's
+    established pattern, tests/conftest.py) so the mesh plane's logical
+    ranks each map to a device; on a real accelerator host neither knob
+    is set and the plane runs on the real device list (MeshPlane raises
+    with guidance when there are fewer than ``n``). A no-op when the
+    flag is already armed (driver-provided env wins)."""
+    if not (os.environ.get("MINIPS_FORCE_CPU")
+            or os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"):
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _run_mesh_drill() -> int:
+    """MESH-BITWISE: the BSP lockstep drill (tests/test_chaos_reliable.
+    run_bsp_lockstep) on the zmq wire vs the mesh plane — the bench
+    artifact's bitwise stamp. Emits one JSON line; any failure reports
+    ``bitwise_equal: false`` so the CI gate fails loudly instead of
+    silently skipping the check."""
+    out = {"event": "drill", "bitwise_equal": False, "rows_checked": 0}
+    try:
+        # the canonical harness lives with the transport drills in
+        # tests/ (the ISSUE-pinned home every backend's bitwise drill
+        # shares); resolve the source checkout from the package path so
+        # the drill works from any cwd — a tests-less install reports
+        # the ImportError loudly through the stamp below
+        import minips_tpu
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(minips_tpu.__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from tests.test_chaos_reliable import run_bsp_lockstep
+
+        w_wire, lost = run_bsp_lockstep(backend="zmq")
+        w_mesh, _ = run_bsp_lockstep(backend="mesh")
+        eq = all(np.array_equal(a, b) for a, b in zip(w_wire, w_mesh))
+        out.update({
+            "bitwise_equal": bool(eq) and lost == [0, 0],
+            "rows_checked": int(sum(a.shape[0] for a in w_wire)),
+        })
+    except Exception as e:  # noqa: BLE001 - the gate reads the stamp
+        out["error"] = repr(e)[:300]
+    print(json.dumps(out), flush=True)
+    return 0 if out["bitwise_equal"] else 1
+
+
+def _run_mesh(args) -> int:
+    """The in-mesh collective data plane bench: one process, ``--mesh-
+    ranks`` logical ranks as threads over as many devices, pushes/pulls
+    riding reduce-scatter/all-gather (train/mesh_plane.py) instead of
+    the host wire. Emits ONE JSON line shaped like a done line."""
+    import threading
+
+    import jax
+
+    from minips_tpu.train.mesh_plane import MeshPlane
+
+    n = args.mesh_ranks
+    plane = MeshPlane(n, staleness=args.staleness, comm=args.mesh_comm)
+    table = plane.add_table("b", args.rows, args.dim,
+                            updater=args.updater, lr=0.05)
+    B, dim = args.batch, args.dim
+    rates = [0.0] * n
+    rows_counts = [0] * n
+    cb_at_warmup = [0] * n  # collective-bytes snapshot at each rank's
+    # warmup boundary: the B/row metric must cover the same timed
+    # window as the wire arms' byte counters (which snapshot
+    # bytes_pushed/pulled at warmup), not the compile-warmup waves
+    errs: list = []
+
+    def worker(r: int) -> None:
+        try:
+            rng = np.random.default_rng(r)
+            grads = rng.normal(size=(B, dim)).astype(np.float32)
+            dense_grad = rng.normal(size=(args.rows, dim)
+                                    ).astype(np.float32)
+            h = plane.rank(r)
+            t = h.tables["b"]
+            moved = 0
+            t0 = time.perf_counter()
+            for i in range(args.iters):
+                if i == args.warmup:
+                    moved = 0
+                    cb_at_warmup[r] = table.collective_bytes
+                    t0 = time.perf_counter()
+                if args.path == "sparse":
+                    keys = rng.integers(0, args.rows, size=B)
+                    t.pull(keys)
+                    t.push(keys, grads)
+                    moved += 2 * B
+                else:
+                    t.pull_all()
+                    t.push_dense(dense_grad)
+                    moved += 2 * args.rows
+                h.tick()
+            h.finalize(timeout=60.0)
+            dt = time.perf_counter() - t0
+            rates[r] = moved / dt
+            rows_counts[r] = moved
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append((r, repr(e)))
+
+    ths = [threading.Thread(target=worker, args=(r,), name=f"mesh-{r}")
+           for r in range(n)]
+    t_all0 = time.perf_counter()
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(timeout=600.0)
+    wall = time.perf_counter() - t_all0
+    if any(th.is_alive() for th in ths) or errs:
+        print(json.dumps({"event": "error", "plane": "mesh",
+                          "errs": [repr(e)[:300] for e in errs]
+                          or "wedged"}), flush=True)
+        return 2
+    stats = plane.stats()
+    # timed-window collective bytes: everything after the LAST rank's
+    # warmup boundary (ranks run near-lockstep under the BSP gate, so
+    # the max snapshot is the tightest shared boundary)
+    cb_timed = stats["collective_bytes"] - max(cb_at_warmup)
+    print(json.dumps({
+        "event": "done", "plane": "mesh",
+        "mesh_ranks": n, "mesh_comm": args.mesh_comm,
+        "device_count": len(jax.devices()),
+        "jax_backend": jax.default_backend(),
+        "path": args.path, "updater": args.updater,
+        "staleness": (None if plane.staleness == float("inf")
+                      else int(plane.staleness)),
+        "rows": args.rows, "dim": args.dim, "batch": B,
+        "iters_timed": args.iters - args.warmup,
+        "rows_per_sec_ranks": [round(x, 1) for x in rates],
+        "rows_per_sec": round(sum(rates) / n, 1),
+        "aggregate_rows_per_sec": round(sum(rates), 1),
+        "waves": stats["waves"]["b"],
+        "gate_waits": stats["gate_waits"],
+        "collective_bytes": stats["collective_bytes"],
+        "collective_bytes_per_row_moved": round(
+            cb_timed / max(sum(rows_counts), 1), 3),
+        "wall_s": round(wall, 4),
+    }), flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--path", choices=["sparse", "dense"], default="sparse")
@@ -119,6 +271,27 @@ def main(argv=None) -> int:
                          "spec — the flag spelling of the env knob; "
                          "hot-block replicas, admission control, SLO "
                          "gate (docs/serving.md)")
+    ap.add_argument("--plane", choices=["wire", "mesh"], default=None,
+                    help="data plane: 'wire' (the multi-process host "
+                         "bus, default) or 'mesh' — the in-mesh "
+                         "collective plane (train/mesh_plane.py): one "
+                         "process, --mesh-ranks logical ranks over as "
+                         "many devices, push/pull as reduce-scatter/"
+                         "all-gather. Env spelling: MINIPS_MESH=1 "
+                         "(explicit flag wins)")
+    ap.add_argument("--mesh-ranks", type=int, default=3,
+                    help="mesh plane: logical ranks = mesh devices "
+                         "(CPU runs force that many host devices)")
+    ap.add_argument("--mesh-comm", choices=["float32", "blk8"],
+                    default="float32",
+                    help="mesh plane collective tier: f32 reduce-"
+                         "scatter, or blk8 — blockwise absmax int8 "
+                         "codes inside the collective (EQuARX-style; "
+                         "the PR9 host-wire codec, second transport)")
+    ap.add_argument("--mesh-bitwise-drill", action="store_true",
+                    help="run the BSP zmq-vs-mesh bitwise lockstep "
+                         "drill and emit its stamp instead of a bench "
+                         "(the artifact's MESH-BITWISE input)")
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="write this rank's wire trace (Chrome-trace "
                          "JSON, obs/tracer.py) into DIR — the flag "
@@ -127,6 +300,21 @@ def main(argv=None) -> int:
                          "into the sweep artifact dir for "
                          "minips_tpu.obs.merge")
     args = ap.parse_args(argv)
+    from minips_tpu.train.mesh_plane import resolve_plane
+
+    plane_kind = resolve_plane(args.plane)
+    if args.mesh_bitwise_drill:
+        _arm_mesh_devices(max(args.mesh_ranks, 2))
+        return _run_mesh_drill()
+    if plane_kind == "mesh":
+        if args.storm or args.overlap or args.cache_bytes \
+                or args.serve or args.compute != "none":
+            ap.error("--plane mesh measures the collective data plane: "
+                     "storm/overlap/cache/serve/compute are host-wire "
+                     "levers (see docs/architecture.md 'device data "
+                     "plane')")
+        _arm_mesh_devices(max(args.mesh_ranks, 2))
+        return _run_mesh(args)
     if args.compute == "jit" and args.path != "sparse":
         # the grad step runs on pulled ROWS; the dense path never calls
         # it — a dense rate must not get labeled as compute-overlapped

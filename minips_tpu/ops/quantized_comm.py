@@ -183,6 +183,60 @@ def blockwise_stream_bytes(n: int, dim: int, bits: int,
     return code, 4 * nb
 
 
+# ------------------------------------------- sorted-run key delta codec
+# The other half of the index-stream bill (ROADMAP item 5's "cheap
+# adjacent win"): the topk push wire ships SORTED unique keys (np.unique
+# upstream, topk_rows returns sorted positions), and a hot zipf working
+# set is near-contiguous in key space — so the gaps between adjacent
+# keys fit a byte where the absolute keys need 2-8. Encode the first
+# key absolute (i64) and the rest as unsigned run deltas at the
+# narrowest width the largest gap fits. Strictly-increasing input only
+# (deltas >= 1 by construction after dedup); the encoder is the one
+# place that checks, so a caller with unsorted keys must sort first.
+
+def delta_stream_bytes(n: int, dw: int) -> int:
+    """Byte size of the delta key stream for ``n`` keys at delta width
+    ``dw`` — shared by encoder and frame validators."""
+    return 0 if n == 0 else 8 + (n - 1) * dw
+
+
+def encode_key_deltas(keys: np.ndarray) -> tuple[int, bytes]:
+    """Delta-encode strictly-increasing int64 ``keys``: 8-byte i64 base
+    + ``n-1`` gaps at the narrowest unsigned width ∈ {1, 2, 4, 8} that
+    fits the largest gap. Returns ``(delta_width, stream)``."""
+    keys = np.ascontiguousarray(keys, np.int64)
+    n = keys.size
+    if n == 0:
+        return 1, b""
+    if n == 1:
+        return 1, keys.tobytes()
+    gaps = np.diff(keys)
+    if gaps.min() <= 0:
+        raise ValueError("delta key codec requires strictly "
+                         "increasing keys")
+    top = int(gaps.max())
+    dw = 1 if top <= 0xFF else 2 if top <= 0xFFFF \
+        else 4 if top <= 0xFFFFFFFF else 8
+    dt = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}[dw]
+    return dw, keys[:1].tobytes() + gaps.astype(dt).tobytes()
+
+
+def decode_key_deltas(buf, n: int, dw: int) -> np.ndarray:
+    """Inverse of :func:`encode_key_deltas` back to int64 keys."""
+    if n == 0:
+        return np.empty(0, np.int64)
+    base = np.frombuffer(buf[:8], np.int64)
+    if n == 1:
+        return base.copy()
+    dt = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}[dw]
+    gaps = np.frombuffer(buf[8:8 + (n - 1) * dw], dt).astype(np.int64)
+    out = np.empty(n, np.int64)
+    out[0] = base[0]
+    np.cumsum(gaps, out=out[1:])
+    out[1:] += base[0]
+    return out
+
+
 BLOCK = 256  # int8 quantization block: one f32 scale per 256 elements
              # (1.6% wire overhead). Per-BLOCK scales matter because a
              # raveled model mixes magnitudes (layernorm ~1.0, attention
@@ -234,7 +288,8 @@ def quantized_all_gather(x: jnp.ndarray, axis_name: str,
 
 
 def a2a_reduce(chunks: jnp.ndarray, axis_name: str,
-               comm: str) -> tuple[jnp.ndarray, jnp.ndarray]:
+               comm: str, *, block: int = BLOCK
+               ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """The compressed REDUCE leg, shared by the pull/push plane and the
     CollectiveSSP sync wire: ship ``[n, c]`` per-destination chunks via
     all-to-all (same bytes on wire as a reduce-scatter ring) in the
@@ -249,7 +304,7 @@ def a2a_reduce(chunks: jnp.ndarray, axis_name: str,
         recv = jax.lax.all_to_all(chunks.astype(jnp.bfloat16), axis_name,
                                   split_axis=0, concat_axis=0, tiled=False)
         return jnp.sum(recv.astype(jnp.float32), axis=0), sent
-    q, scale = _quantize_blocks(chunks)                     # [n, nb, block]
+    q, scale = _quantize_blocks(chunks, block)              # [n, nb, block]
     sent = _dequantize_blocks(q, scale, c)
     # chunk j of every device -> device j; received rows are the n devices'
     # contributions to MY chunk
@@ -284,13 +339,18 @@ def gather_broadcast(chunk: jnp.ndarray, axis_name: str,
 
 
 def quantized_psum_scatter(gpad: jnp.ndarray, axis_name: str,
-                           comm: str = "float32") -> jnp.ndarray:
+                           comm: str = "float32", *,
+                           block: int = BLOCK) -> jnp.ndarray:
     """Reduce-scatter a [n * shard] f32 gradient to this device's [shard]
     chunk, summing over the axis (compressed modes via
-    :func:`a2a_reduce`)."""
+    :func:`a2a_reduce`). ``block`` is the absmax scale unit — the mesh
+    data plane (train/mesh_plane.py) passes the host wire's block size
+    here so the collective tier and the compressed-wire tier are one
+    codec with two transports (EQuARX, PAPERS.md)."""
     _check(comm)
     if comm == "float32":
         return jax.lax.psum_scatter(gpad, axis_name, tiled=True)
     n = _axis_size(axis_name)
-    reduced, _ = a2a_reduce(gpad.reshape(n, -1), axis_name, comm)
+    reduced, _ = a2a_reduce(gpad.reshape(n, -1), axis_name, comm,
+                            block=block)
     return reduced

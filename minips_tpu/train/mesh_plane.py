@@ -1,0 +1,700 @@
+"""In-mesh collective data plane for the sharded PS (``MINIPS_MESH=1``).
+
+The third data plane next to the zmq/native/shm HOST wire (comm/bus.py):
+instead of routing per-owner key slices over sockets or rings, the whole
+gang lives on one device mesh and exchanges owner-split rows with XLA
+collectives — the retrieval target's endgame (SNIPPETS.md header,
+ROADMAP item 1) and the bridge between the host-wire PS and the
+fused-SPMD numbers (bench r02's ~915k samples/sec/chip vs the wire
+path's control-plane rates):
+
+- **server state is pjit-sharded**: each table's rows AND its updater
+  state (adagrad accumulator, adam moments/steps) live as device arrays
+  range-sharded across the mesh's ``shard`` axis
+  (``NamedSharding(mesh, P("shard"))``) — the updater step itself runs
+  sharded per "Automatic Cross-Replica Sharding of Weight Update in
+  Data-Parallel Training" (PAPERS.md): no replicated optimizer math, no
+  host round-trip on the hot path;
+- **push ≡ reduce-scatter**: each logical rank's dense row-space
+  contribution rides a ``shard_map``-level ``psum_scatter`` that sums
+  across ranks and leaves every device exactly its owned row range;
+- **pull ≡ all-gather**: the updated owner shards reassemble on every
+  device with one ``all_gather`` fused into the same XLA program;
+- **BSP/SSP gate the collective, not wire frames**: the plane keeps a
+  DEVICE-SIDE clock vector (one entry per logical rank); pull admission
+  is the shared ``consistency.gate.admits`` predicate evaluated against
+  ``min`` of that vector — the same clk−s bound as the owner-side park
+  on the wire planes, and under BSP the apply wave is the barrier;
+- **optional quantized tier** (``comm="blk8"``): the reduce leg runs
+  ``ops.quantized_comm.quantized_psum_scatter`` — quantize to blockwise
+  absmax int8 codes, exchange, dequantize-ACCUMULATE in f32
+  (EQuARX-style), sharing the blockwise codec with the PR9 compressed
+  host wire so there is one compression story with two transports.
+
+Semantics vs the wire planes (the consistency contract survives the
+transport swap):
+
+- Pushes DEPOSIT into a per-rank dense row-space buffer (duplicate keys
+  coalesced exactly like the wire's client-side dedup: per-dim f64
+  bincount, rounded once to f32 — bitwise the frame the wire would
+  ship). An APPLY WAVE — one jitted program: reduce-scatter, sharded
+  updater, all-gather — fires when every live rank has a deposit, when
+  a depositing rank pulls (read-your-own-writes), and at every
+  ``tick``/``finalize`` (so a rank's step-k pushes are in the shared
+  state BEFORE its clock reads k — the wire's per-link-FIFO staleness
+  argument, enforced by program order instead of frame order).
+- BSP + sgd is BITWISE-equal to the zmq wire path (the
+  ``run_bsp_lockstep`` drill pins it): a wave with one push per rank
+  applies ``w -= lr * Σ_r g_r`` where cross-rank zeros are exact, i.e.
+  exactly the per-push server apply.
+- Stateful updaters apply ONE step per wave to each touched row (adam
+  stays lazy via a reduced touch mask): when two ranks hit the same row
+  in one wave the gradients sum before the update — gradient
+  aggregation semantics, vs the wire's update-per-frame. Same
+  fixed-point family, documented divergence (docs/architecture.md).
+
+Development and tier-1 run on CPU via the repo's established
+``--xla_force_host_platform_device_count`` pattern (tests/conftest.py);
+real meshes swap the device list, nothing else.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from minips_tpu.consistency.gate import RETIRED_CLOCK, admits
+
+MESH_AXIS = "shard"
+VALID_MESH_COMM = ("float32", "blk8")
+# BSP tick-flush grace: how long a ticking rank lets the eager full
+# wave fire before solo-flushing its own deposits (see
+# MeshPlane._flush_rank_locked) — generous vs a step, invisible vs the
+# gate timeout
+_BSP_FLUSH_GRACE = 0.05
+
+__all__ = ["MeshPlane", "MeshRank", "MeshTable", "resolve_plane",
+           "MESH_AXIS", "VALID_MESH_COMM"]
+
+
+def resolve_plane(plane: Optional[str]) -> str:
+    """The data-plane selection rule every entrypoint shares (same
+    explicit-wins-over-env convention as ``make_bus``): an explicit
+    ``plane`` wins, else ``MINIPS_MESH`` (any value but ''/'0') selects
+    the in-mesh collective plane, else the host wire."""
+    if plane:
+        if plane not in ("wire", "mesh"):
+            raise ValueError(f"plane must be 'wire' or 'mesh', "
+                             f"got {plane!r}")
+        return plane
+    env = os.environ.get("MINIPS_MESH", "").strip()
+    return "mesh" if env not in ("", "0") else "wire"
+
+
+def _padded(rows: int, shards: int) -> int:
+    return shards * (-(-max(rows, 1) // shards))
+
+
+class MeshTable:
+    """One pjit-sharded KVTable + updater state on the plane's mesh,
+    with per-logical-rank deposit buffers. All mutation runs under the
+    plane lock; rank-facing entrypoints take the rank explicitly (the
+    :class:`MeshRank` handle binds it)."""
+
+    def __init__(self, plane: "MeshPlane", name: str, num_rows: int,
+                 dim: int, *, updater: str = "sgd", lr: float = 0.05,
+                 adagrad_init: float = 0.1, eps: Optional[float] = None,
+                 beta1: float = 0.9, beta2: float = 0.999):
+        if updater not in ("sgd", "adagrad", "adam"):
+            raise ValueError(
+                "mesh-plane updater must be 'sgd', 'adagrad' or 'adam'")
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        self.plane = plane
+        self.name = name
+        self.num_rows = int(num_rows)
+        self.dim = int(dim)
+        self.updater = updater
+        self.lr = float(lr)
+        # same defaults as the wire table (train/sharded_ps.py), which
+        # themselves match the ops/sparse_update.py oracles
+        self.eps = float((1e-8 if updater == "adam" else 1e-10)
+                         if eps is None else eps)
+        self.beta1 = float(beta1)
+        self.beta2 = float(beta2)
+        n = plane.num_ranks
+        self.padded = _padded(self.num_rows, n)
+        self.shard_rows = self.padded // n
+        self._row_sh = NamedSharding(plane.mesh, P(MESH_AXIS))
+        # the rank axis of the stacked deposits shards the same way: each
+        # device holds exactly its own logical rank's contribution —
+        # data-parallel layout in, range-sharded state out
+        self._stack_sh = NamedSharding(plane.mesh, P(MESH_AXIS))
+        z = jnp.zeros((self.padded, self.dim), jnp.float32)
+        self._w = jax.device_put(z, self._row_sh)
+        self._acc = (jax.device_put(
+            jnp.full((self.padded, self.dim), float(adagrad_init),
+                     jnp.float32), self._row_sh)
+            if updater == "adagrad" else None)
+        if updater == "adam":
+            self._m = jax.device_put(z, self._row_sh)
+            self._v = jax.device_put(z, self._row_sh)
+            self._steps = jax.device_put(
+                jnp.zeros(self.padded, jnp.int32), self._row_sh)
+        else:
+            self._m = self._v = self._steps = None
+        # per-rank host deposit buffers, PRE-STACKED: the wave's input is
+        # this one [n, padded, dim] array (each rank deposits into its
+        # row — clean ranks contribute exact zeros), so a wave pays one
+        # device_put and zero stacking copies
+        self._gbuf = np.zeros((n, self.padded, self.dim), np.float32)
+        self._tstack = (np.zeros((n, self.padded), np.float32)
+                        if updater == "adam" else None)
+        self._dirty = [False] * n
+        # the replicated pull mirror: the wave's fused all-gather output,
+        # host-resident (and read-only: pull_all serves VIEWS — the
+        # mirror is REPLACED per wave, never mutated, so an outstanding
+        # view stays a valid snapshot) so reads between waves are plain
+        # numpy indexing
+        self._mirror = np.zeros((self.padded, self.dim), np.float32)
+        self._mirror.setflags(write=False)
+        self.waves = 0
+        self.rows_pushed = 0
+        self.rows_pulled = 0
+        # collective traffic accounting (the MESH analog of wire bytes):
+        # what the reduce-scatter + all-gather move per wave, summed over
+        # ranks — ring cost (n-1)/n of the buffer each way, codes+scales
+        # for the blk8 tier (blockwise_stream_bytes is the shared bill)
+        self.collective_bytes = 0
+        self._wave_fn = self._build_wave_fn()
+
+    # ------------------------------------------------------------ wave
+    def _build_wave_fn(self):
+        """One jitted XLA program per table — THE collective data plane:
+        reduce-scatter the stacked rank deposits (push), run the updater
+        on the owner shard (sharded server math — no replicated
+        optimizer state), all-gather the new rows (pull). The signature
+        varies by updater so only real state is donated; the updater
+        math mirrors the wire table's numpy updaters op for op
+        (sharded_ps._update_block/_adam_rows)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from minips_tpu.ops.quantized_comm import quantized_psum_scatter
+        from minips_tpu.utils import jaxcompat
+
+        dim = self.dim
+        lr = np.float32(self.lr)
+        eps = np.float32(self.eps)
+        b1 = np.float32(self.beta1)
+        b2 = np.float32(self.beta2)
+        one_m_b1 = np.float32(1) - b1
+        one_m_b2 = np.float32(1) - b2
+        comm, block = self.plane.comm, self.plane.block
+        upd = self.updater
+        S = P(MESH_AXIS)
+
+        def _reduce(g_mine):
+            # g_mine [padded, dim]: my rank's full-row-space contribution;
+            # the reduce-scatter leaves me the summed rows I own
+            if comm == "float32":
+                return jax.lax.psum_scatter(
+                    g_mine, MESH_AXIS, scatter_dimension=0, tiled=True)
+            red = quantized_psum_scatter(
+                g_mine.reshape(-1), MESH_AXIS, comm="int8", block=block)
+            return red.reshape(-1, dim)
+
+        if upd == "sgd":
+            def body(w, g_stack):
+                g = _reduce(g_stack[0])
+                w = w - lr * g
+                full = jax.lax.all_gather(w, MESH_AXIS, axis=0,
+                                          tiled=True)
+                return (w,), full
+            n_state = 1
+        elif upd == "adagrad":
+            def body(w, acc, g_stack):
+                g = _reduce(g_stack[0])
+                acc = acc + g * g
+                w = w - lr * g / (jnp.sqrt(acc) + eps)
+                full = jax.lax.all_gather(w, MESH_AXIS, axis=0,
+                                          tiled=True)
+                return (w, acc), full
+            n_state = 2
+        else:
+            def body(w, m, v, steps, g_stack, t_stack):
+                # lazy adam: the touch-mask reduce keeps untouched rows'
+                # moments and step counters frozen, matching the wire's
+                # per-key server semantics (sharded_ps._adam_rows)
+                g = _reduce(g_stack[0])
+                t = jax.lax.psum_scatter(
+                    t_stack[0], MESH_AXIS, scatter_dimension=0,
+                    tiled=True)
+                mask = t > 0
+                mcol = mask[:, None]
+                steps = steps + mask.astype(jnp.int32)
+                m = jnp.where(mcol, b1 * m + one_m_b1 * g, m)
+                v = jnp.where(mcol, b2 * v + one_m_b2 * (g * g), v)
+                tf = steps.astype(jnp.float32)[:, None]
+                bc1 = np.float32(1) - b1 ** tf
+                bc2 = np.float32(1) - b2 ** tf
+                w = jnp.where(
+                    mcol,
+                    w - lr * (m / bc1) / (jnp.sqrt(v / bc2) + eps), w)
+                full = jax.lax.all_gather(w, MESH_AXIS, axis=0,
+                                          tiled=True)
+                return (w, m, v, steps), full
+            n_state = 4
+
+        n_in = n_state + (2 if upd == "adam" else 1)
+        # check_vma/check_rep off: the all-gathered output is replicated
+        # by construction, but older checkers cannot infer it through
+        # the quantized a2a path
+        mapped = jaxcompat.shard_map(
+            body, mesh=self.plane.mesh, in_specs=(S,) * n_in,
+            out_specs=((S,) * n_state, P()), check_vma=False)
+        return jax.jit(mapped, donate_argnums=tuple(range(n_state)))
+
+    def _deposit(self, rank: int, keys: np.ndarray,
+                 grads: np.ndarray) -> None:
+        """Coalesce duplicates via THE shared client-side dedup kernel
+        (sharded_ps.sum_duplicate_keys — the bitwise-parity drill
+        depends on both planes summing identically), then accumulate
+        into the rank's buffer."""
+        from minips_tpu.train.sharded_ps import sum_duplicate_keys
+
+        keys = np.asarray(keys, np.int64)
+        grads = np.asarray(grads, np.float32).reshape(keys.size, self.dim)
+        if keys.size and (keys.min() < 0 or keys.max() >= self.num_rows):
+            raise ValueError("push keys outside the table's key space")
+        uniq, summed, _ = sum_duplicate_keys(keys, grads, self.dim)
+        np.add.at(self._gbuf[rank], uniq, summed)
+        if self._tstack is not None:
+            self._tstack[rank][uniq] = 1.0
+        self._dirty[rank] = True
+        self.rows_pushed += keys.size
+
+    def _deposit_dense(self, rank: int, grad: np.ndarray) -> None:
+        grad = np.asarray(grad, np.float32).reshape(-1, self.dim)
+        if grad.shape[0] != self.num_rows:
+            raise ValueError(
+                f"push_dense expects [{self.num_rows}, {self.dim}]")
+        self._gbuf[rank, : self.num_rows] += grad
+        if self._tstack is not None:
+            self._tstack[rank, : self.num_rows] = 1.0
+        self._dirty[rank] = True
+        self.rows_pushed += self.num_rows
+
+    def _wave_locked(self) -> None:
+        """One apply wave: ship the pre-stacked deposits (clean ranks
+        contribute exact zeros), reduce-scatter + sharded update +
+        all-gather in one jitted program, refresh the pull mirror, zero
+        the dirty rows. Caller holds the plane lock."""
+        import jax
+
+        g_stack = jax.device_put(self._gbuf, self._stack_sh)
+        if self.updater == "sgd":
+            (self._w,), full = self._wave_fn(self._w, g_stack)
+        elif self.updater == "adagrad":
+            (self._w, self._acc), full = self._wave_fn(
+                self._w, self._acc, g_stack)
+        else:
+            t_stack = jax.device_put(self._tstack, self._stack_sh)
+            (self._w, self._m, self._v, self._steps), full = \
+                self._wave_fn(self._w, self._m, self._v, self._steps,
+                              g_stack, t_stack)
+        mirror = np.asarray(full)
+        mirror.setflags(write=False)
+        self._mirror = mirror
+        for r in range(self.plane.num_ranks):
+            if self._dirty[r]:
+                self._gbuf[r].fill(0.0)
+                if self._tstack is not None:
+                    self._tstack[r].fill(0.0)
+                self._dirty[r] = False
+        self.waves += 1
+        self.collective_bytes += self._wave_bytes()
+
+    def _wave_bytes(self) -> int:
+        """Collective bytes one wave moves, summed over ranks: ring
+        reduce-scatter + ring all-gather each move (n-1)/n of the buffer
+        per rank; the blk8 reduce leg ships codes + blockwise scales
+        (the shared ``blockwise_stream_bytes`` bill) instead of f32."""
+        from minips_tpu.ops.quantized_comm import blockwise_stream_bytes
+
+        n = self.plane.num_ranks
+        full = self.padded * self.dim * 4
+        gather = (n - 1) * full  # (n-1)/n per rank, n ranks
+        if self.plane.comm == "blk8":
+            code, scale = blockwise_stream_bytes(
+                self.padded, self.dim, 8, self.plane.block)
+            reduce = (n - 1) * (code + scale)
+        else:
+            reduce = (n - 1) * full
+        return reduce + gather
+
+    # ------------------------------------------------------- rank-facing
+    def push(self, rank: int, keys: np.ndarray,
+             grads: np.ndarray) -> None:
+        plane = self.plane
+        with plane._cond:
+            self._deposit(rank, keys, grads)
+            plane._maybe_wave_locked(self)
+
+    def push_dense(self, rank: int, grad: np.ndarray) -> None:
+        plane = self.plane
+        with plane._cond:
+            self._deposit_dense(rank, grad)
+            plane._maybe_wave_locked(self)
+
+    def pull(self, rank: int, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys, np.int64)
+        if keys.size and (keys.min() < 0
+                          or keys.max() >= self.num_rows):
+            # same contract as the wire plane (a misrouted pull is
+            # refused, never served): without this a padding row or a
+            # numpy-wrapped negative index would silently read zeros
+            raise ValueError("pull keys outside the table's key space")
+        plane = self.plane
+        with plane._cond:
+            plane._admit_locked(rank)
+            if self._dirty[rank]:  # read-your-own-writes: flush first
+                self._wave_locked()
+            self.rows_pulled += keys.size
+            return self._mirror[keys].copy()
+
+    def pull_all(self, rank: int) -> np.ndarray:
+        """Full-table read: a READ-ONLY view of the current pull mirror
+        (waves REPLACE the mirror, never mutate it, so the view is a
+        stable snapshot — and the full-table hot path pays zero copy,
+        exactly the all-gather-once-per-wave story)."""
+        plane = self.plane
+        with plane._cond:
+            plane._admit_locked(rank)
+            if self._dirty[rank]:
+                self._wave_locked()
+            self.rows_pulled += self.num_rows
+            return self._mirror[: self.num_rows]
+
+    def load_dense(self, w: np.ndarray) -> None:
+        """Install a full [num_rows, dim] weight table (drill/checkpoint
+        seeding) — re-sharded onto the mesh, mirror refreshed."""
+        import jax
+        import jax.numpy as jnp
+
+        w = np.asarray(w, np.float32).reshape(self.num_rows, self.dim)
+        padded = np.zeros((self.padded, self.dim), np.float32)
+        padded[: self.num_rows] = w
+        with self.plane._cond:
+            self._w = jax.device_put(jnp.asarray(padded), self._row_sh)
+            padded.setflags(write=False)
+            self._mirror = padded
+
+    def shard_slice(self, rank: int) -> np.ndarray:
+        """Rank ``rank``'s owner rows of the CURRENT table (mirror read)
+        — the per-rank final-state view the lockstep drill compares
+        against the wire tables' local shards."""
+        with self.plane._cond:
+            lo = rank * self.shard_rows
+            hi = min(lo + self.shard_rows, self.num_rows)
+            return self._mirror[lo:hi].copy()
+
+    def local_bytes(self) -> int:
+        """Device bytes of table + updater state PER SHARD — the same
+        ~1/N claim as the wire table's local_bytes."""
+        n = self.shard_rows * self.dim * 4
+        if self._acc is not None:
+            n += self.shard_rows * self.dim * 4
+        if self._m is not None:
+            n += 2 * self.shard_rows * self.dim * 4 + self.shard_rows * 4
+        return n
+
+
+class MeshRank:
+    """A logical rank's handle on the plane: the per-rank API surface
+    the wire path spreads across (ShardedTable, ShardedPSTrainer)."""
+
+    def __init__(self, plane: "MeshPlane", rank: int):
+        self.plane = plane
+        self.rank = rank
+        self.tables = _RankTables(plane, rank)
+
+    @property
+    def clock(self) -> int:
+        return int(self.plane._clk_host[self.rank])
+
+    @property
+    def staleness(self) -> float:
+        return self.plane.staleness
+
+    def tick(self, *, wait: bool = True) -> None:
+        self.plane.tick(self.rank, wait=wait)
+
+    def finalize(self, timeout: float = 30.0) -> None:
+        self.plane.finalize(self.rank, timeout=timeout)
+
+
+class _RankTables:
+    def __init__(self, plane, rank):
+        self._plane, self._rank = plane, rank
+
+    def __getitem__(self, name: str) -> "_BoundTable":
+        return _BoundTable(self._plane.tables[name], self._rank)
+
+    def __iter__(self):
+        return iter(self._plane.tables)
+
+
+class _BoundTable:
+    """MeshTable with the rank argument bound — pull/push read like the
+    wire ShardedTable's client surface."""
+
+    def __init__(self, table: MeshTable, rank: int):
+        self._t, self._r = table, rank
+
+    def __getattr__(self, item):
+        return getattr(self._t, item)
+
+    def pull(self, keys):
+        return self._t.pull(self._r, keys)
+
+    def pull_all(self):
+        return self._t.pull_all(self._r)
+
+    def push(self, keys, grads):
+        self._t.push(self._r, keys, grads)
+
+    def push_dense(self, grad):
+        self._t.push_dense(self._r, grad)
+
+
+class MeshPlane:
+    """The gang: one process, ``num_ranks`` logical ranks mapped onto
+    ``num_ranks`` mesh devices, tables sharded across all of them.
+
+    Construction order: ``MeshPlane(...)`` → ``add_table(...)`` per
+    table → ``rank(r)`` handles for the worker threads. BSP/SSP comes
+    from ``staleness`` exactly like the wire trainer's; the gate is the
+    shared ``admits`` predicate over the plane's device-side clock
+    vector."""
+
+    def __init__(self, num_ranks: int, *, staleness: float = 0.0,
+                 comm: str = "float32", block: Optional[int] = None,
+                 devices=None, gate_timeout: float = 60.0):
+        if comm not in VALID_MESH_COMM:
+            raise ValueError(f"mesh comm must be one of "
+                             f"{VALID_MESH_COMM}, got {comm!r}")
+        if staleness < 0:
+            raise ValueError("staleness must be >= 0")
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from minips_tpu.ops.quantized_comm import HOST_BLOCK
+
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if len(devs) < num_ranks:
+            raise ValueError(
+                f"mesh plane needs {num_ranks} devices, have "
+                f"{len(devs)} — set "
+                f"--xla_force_host_platform_device_count on CPU")
+        self.num_ranks = int(num_ranks)
+        self.staleness = float(staleness)
+        self.comm = comm
+        # the quantized tier defaults to the HOST wire's block size:
+        # one codec (blockwise absmax), two transports
+        self.block = int(HOST_BLOCK if block is None else block)
+        self.gate_timeout = float(gate_timeout)
+        self.mesh = Mesh(np.array(devs[: self.num_ranks]), (MESH_AXIS,))
+        self._rep_sh = NamedSharding(self.mesh, P())
+        self.tables: dict[str, MeshTable] = {}
+        self._cond = threading.Condition(threading.RLock())
+        # the device-side clock vector: pull admission and the SSP gate
+        # evaluate min() of THIS array (gate.admits, the one predicate)
+        # int32 on device (x64 is off repo-wide); RETIRED_CLOCK = 2^30
+        # fits with headroom
+        self._clk_dev = jax.device_put(
+            jnp.zeros(self.num_ranks, jnp.int32), self._rep_sh)
+        self._clk_host = np.zeros(self.num_ranks, np.int64)
+        self._retired = np.zeros(self.num_ranks, bool)
+        self.gate_waits = 0
+        self.max_skew_seen = 0
+
+    # ------------------------------------------------------------- setup
+    def add_table(self, name: str, num_rows: int, dim: int,
+                  **kwargs) -> MeshTable:
+        if name in self.tables:
+            raise ValueError(f"table {name!r} already exists")
+        t = MeshTable(self, name, num_rows, dim, **kwargs)
+        self.tables[name] = t
+        return t
+
+    def rank(self, r: int) -> MeshRank:
+        if not 0 <= r < self.num_ranks:
+            raise ValueError(f"rank {r} out of range")
+        return MeshRank(self, r)
+
+    # -------------------------------------------------------- gang logic
+    def _global_min(self) -> int:
+        """min of the clock vector — the freshness certificate the
+        admission predicate runs on (the mesh analog of
+        ClockGossip.global_min). Reads the host mirror: it is updated
+        in lockstep with the device vector under the plane lock
+        (bitwise the same values), and the gate wait loops poll this
+        every iteration — a jitted device reduction per poll would put
+        dispatch churn on the admission hot path for no information.
+        Once the poll passes, admission CERTIFIES against the device
+        vector (:meth:`_device_min` — one dispatch per admission, not
+        per poll), so the predicate's final word is device state."""
+        return int(self._clk_host.min())
+
+    def _device_min(self) -> int:
+        """min of the DEVICE-side clock vector — the authoritative
+        replicated copy every clock write updates under the plane
+        lock; the admission certificate reads THIS."""
+        return int(self._clk_dev.min())
+
+    def clocks(self) -> np.ndarray:
+        """Host copy of the device-side clock vector (tests/obs)."""
+        return np.asarray(self._clk_dev)
+
+    def _maybe_wave_locked(self, table: MeshTable) -> None:
+        """Fire the apply wave eagerly once every live rank deposited —
+        the full wave is the natural BSP barrier and keeps the state
+        fresh without waiting for the tick boundary."""
+        live = [r for r in range(self.num_ranks) if not self._retired[r]]
+        if live and all(table._dirty[r] for r in live):
+            table._wave_locked()
+            self._cond.notify_all()
+
+    def _admit_locked(self, rank: int) -> bool:
+        """Pull admission: wait until ``admits(min(clock_vec), clk, s)``
+        — the owner-side park rule. The host mirror screens each poll;
+        the admission that actually serves is certified against the
+        DEVICE clock vector."""
+        clk = int(self._clk_host[rank])
+        if not admits(self._global_min(), clk, self.staleness):
+            self.gate_waits += 1
+            deadline = time.monotonic() + self.gate_timeout
+            while not admits(self._global_min(), clk, self.staleness):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"mesh plane gate timed out at clock {clk} "
+                        f"(global_min={self._global_min()}, "
+                        f"staleness={self.staleness})")
+                self._cond.wait(timeout=min(0.2, left))
+        if not admits(self._device_min(), clk, self.staleness):
+            # cannot happen while mirror and device update under one
+            # lock — but the predicate's final word is device state,
+            # so a torn update surfaces as a loud refusal, not a
+            # silently-early read
+            raise RuntimeError(
+                "mesh clock mirror ahead of the device vector "
+                f"({self._clk_host.tolist()} vs {self.clocks().tolist()})")
+        return True
+
+    def _flush_rank_locked(self, rank: int) -> None:
+        """Flush rank ``rank``'s deposits ahead of a clock advance.
+        Under BSP every live rank deposits every step, so a solo flush
+        here would triple the wave count (one per rank's tick instead
+        of one full wave per step — measured 2-3x off the fused bench):
+        give the eager full wave a short grace to fire first (peers'
+        pushes run while we cond-wait), then flush whatever is left —
+        correctness (pushes before clock) never depends on the grace."""
+        if not any(t._dirty[rank] for t in self.tables.values()):
+            return
+        if self.staleness == 0:
+            deadline = time.monotonic() + _BSP_FLUSH_GRACE
+            while any(t._dirty[rank] for t in self.tables.values()):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(timeout=min(0.01, left))
+        for t in self.tables.values():
+            if t._dirty[rank]:
+                t._wave_locked()
+                self._cond.notify_all()
+
+    def tick(self, rank: int, *, wait: bool = True) -> None:
+        """Clock boundary: flush the rank's deposits (an apply wave —
+        its step-k pushes enter the shared state BEFORE the clock reads
+        k), advance the device-side clock vector, then gate
+        (BSP/SSP/ASP rule) unless ``wait=False`` (single-threaded
+        drivers gate at pull admission instead)."""
+        with self._cond:
+            self._flush_rank_locked(rank)
+            new = int(self._clk_host[rank]) + 1
+            self._clk_host[rank] = new
+            self._clk_dev = self._clk_dev.at[rank].set(new)
+            self._cond.notify_all()
+            # skew is recorded in EVERY mode (ASP and wait=False
+            # included) — the observable must not go vacuous just
+            # because the gate does not block
+            self.max_skew_seen = max(self.max_skew_seen,
+                                     new - self._global_min())
+            if not wait or self.staleness == float("inf"):
+                return
+            threshold = new - int(self.staleness)
+            if self._global_min() < threshold:
+                self.gate_waits += 1
+            deadline = time.monotonic() + self.gate_timeout
+            while self._global_min() < threshold:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"mesh plane gate timed out at clock {new} "
+                        f"(global_min={self._global_min()}, "
+                        f"staleness={self.staleness})")
+                self._cond.wait(timeout=min(0.2, left))
+            if self._device_min() < threshold:  # certify: device word
+                raise RuntimeError(
+                    "mesh clock mirror ahead of the device vector "
+                    f"({self._clk_host.tolist()} vs "
+                    f"{self.clocks().tolist()})")
+
+    def finalize(self, rank: int, timeout: float = 30.0) -> None:
+        """Flush, retire (the shared RETIRED_CLOCK sentinel so nobody
+        gates on a finished rank), and barrier until every rank
+        finalized — after which pull/pull_all return identical rows for
+        every rank (there is only ONE state; the barrier guarantees it
+        contains everyone's mass)."""
+        with self._cond:
+            for t in self.tables.values():
+                if t._dirty[rank]:
+                    t._wave_locked()
+            self._retired[rank] = True
+            self._clk_host[rank] = RETIRED_CLOCK
+            self._clk_dev = self._clk_dev.at[rank].set(RETIRED_CLOCK)
+            self._cond.notify_all()
+            deadline = time.monotonic() + timeout
+            while not self._retired.all():
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    missing = [r for r in range(self.num_ranks)
+                               if not self._retired[r]]
+                    raise TimeoutError(
+                        f"mesh finalize: ranks {missing} never retired")
+                self._cond.wait(timeout=min(0.2, left))
+
+    def stats(self) -> dict:
+        return {
+            "plane": "mesh",
+            "comm": self.comm,
+            "block": self.block if self.comm == "blk8" else None,
+            "ranks": self.num_ranks,
+            "devices": len(self.mesh.devices.ravel()),
+            "waves": {n: t.waves for n, t in self.tables.items()},
+            "collective_bytes": sum(t.collective_bytes
+                                    for t in self.tables.values()),
+            "gate_waits": self.gate_waits,
+        }

@@ -219,8 +219,11 @@ from minips_tpu.obs.hist import Log2Histogram, merge_counts, \
     summarize_counts
 from minips_tpu.ops.quantized_comm import (HOST_BLOCK,
                                            blockwise_stream_bytes,
+                                           decode_key_deltas,
+                                           delta_stream_bytes,
                                            dequantize_blockwise,
                                            dequantize_rows_int8,
+                                           encode_key_deltas,
                                            quantize_blockwise,
                                            quantize_rows_int8, topk_rows)
 from minips_tpu.parallel.partition import BlockRouter, RangePartitioner
@@ -229,7 +232,7 @@ from minips_tpu.utils.timing import CommTimers
 __all__ = ["ShardedTable", "ShardedPSTrainer", "PeerFailureError",
            "PullFuture", "RowCache", "ResidualStore", "table_state_bytes",
            "tables_hist_stats", "quantize_rows_int8",
-           "dequantize_rows_int8"]
+           "dequantize_rows_int8", "sum_duplicate_keys"]
 
 VALID_PUSH_COMM = ("float32", "int8", "topk8", "topk4")
 
@@ -261,6 +264,28 @@ def _cat_blob(*parts) -> bytearray:
         out[off:off + v.nbytes] = v
         off += v.nbytes
     return out
+
+
+def sum_duplicate_keys(keys: np.ndarray, grads: np.ndarray,
+                       dim: int) -> tuple[np.ndarray, np.ndarray, bool]:
+    """THE client-side duplicate-key coalesce kernel: sum each key's
+    occurrences via per-dim f64 bincount, rounded ONCE to f32 — at
+    least as accurate as a sequential f32 sum, ~3x faster than
+    np.add.at on the hot path. Shared by the wire plane's
+    ``_coalesce_for_wire`` and the mesh plane's deposit
+    (train/mesh_plane.py) deliberately: the BSP bitwise-parity drill
+    depends on both planes summing duplicates identically, so the
+    kernel exists exactly once. Returns ``(uniq, summed, had_dups)``
+    — the ORIGINAL pairing when there is nothing to coalesce (uniq
+    would be sorted; re-pairing grads against it scrambles rows)."""
+    uniq, inv = np.unique(keys, return_inverse=True)
+    if uniq.size == keys.size:
+        return keys, grads, False
+    summed = np.empty((uniq.size, dim), np.float32)
+    for d in range(dim):
+        summed[:, d] = np.bincount(inv, weights=grads[:, d],
+                                   minlength=uniq.size)
+    return uniq, summed, True
 
 
 class RowCache:
@@ -1869,19 +1894,32 @@ class ShardedTable:
             # already match sparse index-value application)
             bits = 8 if comm == "topk8" else 4
             blk = int(payload.get("blk", HOST_BLOCK))
-            kw = int(payload.get("kw", 8))
             code_b, scale_b = blockwise_stream_bytes(n, self.dim, bits,
                                                      blk)
-            if blob is None or kw not in (2, 4, 8) or blk < 1 \
-                    or len(blob) != n * kw + scale_b + code_b:
-                self._drop("malformed", sender, "bad topk push blob")
-                return
-            kdt = {2: np.uint16, 4: np.int32, 8: np.int64}[kw]
-            keys = np.frombuffer(blob[: n * kw], kdt).astype(np.int64)
-            scales = np.frombuffer(blob[n * kw: n * kw + scale_b],
+            if "dw" in payload:
+                # sorted-run delta key stream (i64 base + narrow gaps —
+                # ops/quantized_comm codec); frames self-describe, so a
+                # plain-width pusher interoperates
+                dw = int(payload["dw"])
+                key_b = delta_stream_bytes(n, dw)
+                if blob is None or dw not in (1, 2, 4, 8) or blk < 1 \
+                        or len(blob) != key_b + scale_b + code_b:
+                    self._drop("malformed", sender, "bad topk push blob")
+                    return
+                keys = decode_key_deltas(blob[:key_b], n, dw)
+            else:
+                kw = int(payload.get("kw", 8))
+                key_b = n * kw
+                if blob is None or kw not in (2, 4, 8) or blk < 1 \
+                        or len(blob) != key_b + scale_b + code_b:
+                    self._drop("malformed", sender, "bad topk push blob")
+                    return
+                kdt = {2: np.uint16, 4: np.int32, 8: np.int64}[kw]
+                keys = np.frombuffer(blob[:key_b], kdt).astype(np.int64)
+            scales = np.frombuffer(blob[key_b: key_b + scale_b],
                                    np.float32)
             grads = dequantize_blockwise(
-                blob[n * kw + scale_b:], scales, n, self.dim, bits,
+                blob[key_b + scale_b:], scales, n, self.dim, bits,
                 block=blk)
             self._count_serve(push_frames=1)
         else:
@@ -3218,24 +3256,18 @@ class ShardedTable:
         n = keys.size
         if not n or not (self.push_dedup or self._cache is not None):
             return keys, grads
-        uniq, inv = np.unique(keys, return_inverse=True)
-        if uniq.size != n:
-            summed = np.empty((uniq.size, self.dim), np.float32)
-            for d in range(self.dim):
-                summed[:, d] = np.bincount(inv, weights=grads[:, d],
-                                           minlength=uniq.size)
-            ckeys, cdeltas = uniq, summed
-            if self.push_dedup:
-                keys, grads = uniq, summed
-        else:
-            # no duplicates: NOTHING to coalesce — keep the original
-            # (keys[i], grads[i]) pairing. uniq is SORTED; pairing it
-            # with grads in request order would scramble every
-            # gradient-row association (regression-tested:
-            # test_push_all_unique_unsorted_keys_pair_correctly)
-            ckeys, cdeltas = keys, grads
+        # the shared coalesce kernel keeps the original (keys[i],
+        # grads[i]) pairing when there are no duplicates — uniq is
+        # SORTED, and re-pairing grads against it would scramble every
+        # gradient-row association (regression-tested:
+        # test_push_all_unique_unsorted_keys_pair_correctly)
+        ckeys, cdeltas, had_dups = sum_duplicate_keys(keys, grads,
+                                                      self.dim)
+        if had_dups and self.push_dedup:
+            keys, grads = ckeys, cdeltas
         if self._cache is not None:
-            self._cache_on_push(ckeys, cdeltas, uniq)
+            self._cache_on_push(ckeys, cdeltas,
+                                ckeys if had_dups else np.unique(keys))
         return keys, grads
 
     def _push_now(self, keys: np.ndarray, grads: np.ndarray,
@@ -3338,10 +3370,10 @@ class ShardedTable:
         if k1.size or k2.size:
             ovk = np.concatenate([k1, k2])
             ovr = np.concatenate([r1, r2])
-        idx = keys[sel].astype(self._key_dtype())
+        khead, kstream = self._key_stream(keys[sel])
         head = {"n": int(sel.size), "comm": self.push_comm,
-                "blk": self.topk_block, "kw": int(idx.dtype.itemsize)}
-        return head, _cat_blob(idx, scales, codes), (ovk, ovr)
+                "blk": self.topk_block, **khead}
+        return head, _cat_blob(kstream, scales, codes), (ovk, ovr)
 
     def _key_dtype(self):
         """The narrowest index-stream dtype the key space fits — the
@@ -3353,6 +3385,27 @@ class ShardedTable:
         if self.num_rows <= np.iinfo(np.int32).max:
             return np.int32
         return np.int64
+
+    def _key_stream(self, k: np.ndarray) -> tuple[dict, bytes]:
+        """Index stream for SORTED unique keys, at the cheaper of two
+        codecs: the sorted-run delta stream (i64 base + gaps at the
+        narrowest unsigned width, ops/quantized_comm.encode_key_deltas
+        — hot zipf key sets are near-contiguous, so gaps usually fit a
+        byte where absolute keys need 2-8) vs the plain narrowest-width
+        stream. The head self-describes (``dw`` delta width vs ``kw``
+        plain width), so mixed fleets decode per frame like every other
+        wire knob."""
+        kw = int(np.dtype(self._key_dtype()).itemsize)
+        n = int(k.size)
+        if n >= 2:
+            try:
+                dw, stream = encode_key_deltas(k)
+            except ValueError:  # not strictly increasing: plain stream
+                pass
+            else:
+                if delta_stream_bytes(n, dw) < n * kw:
+                    return {"dw": dw}, stream
+        return {"kw": kw}, k.astype(self._key_dtype()).tobytes()
 
     def _send_f32_push(self, o: int, k: np.ndarray,
                        g: np.ndarray) -> None:
@@ -3437,16 +3490,19 @@ class ShardedTable:
         if self._mb is not None and o in self._dead_ranks:
             self.rb_stats["pushes_lost_to_dead"] += 1
             return
+        order = np.argsort(k, kind="stable")  # residual-store order is
+        # arbitrary; the delta key codec needs sorted runs, and sorting
+        # before the quantize keeps codes/keys paired
+        k, g = k[order], np.ascontiguousarray(g[order])
         codes, scales = quantize_blockwise(g, 4, block=self.topk_block,
                                            rng=self._q_rng)
-        idx = k.astype(self._key_dtype())
+        khead, kstream = self._key_stream(k)
         head = {"n": int(k.size), "comm": "topk4",
-                "blk": self.topk_block,
-                "kw": int(idx.dtype.itemsize),
+                "blk": self.topk_block, **khead,
                 **self._ep_header(), **self._cfg_header()}
         if self.async_push:
             head["seq"] = self._take_push_seq(o)
-        blob = _cat_blob(idx, scales, codes)
+        blob = _cat_blob(kstream, scales, codes)
         self.bus.send(o, f"psP:{self.name}", head, blob=blob)
         self.bytes_pushed += len(blob)
 
@@ -3670,7 +3726,29 @@ class ShardedPSTrainer:
                  gate_timeout: float = 60.0, monitor=None,
                  rebalance: Optional[str] = None,
                  serve: Optional[str] = None,
-                 elastic: Optional[str] = None):
+                 elastic: Optional[str] = None,
+                 plane: Optional[str] = None):
+        # data-plane selection at the same altitude as the bus backends
+        # (train/mesh_plane.resolve_plane: explicit wins, else
+        # $MINIPS_MESH): this bus-backed trainer IS the host-wire plane;
+        # plane="mesh" names the in-mesh collective plane, which has no
+        # bus or per-process tables to drive — construct it via
+        # train/mesh_plane.MeshPlane (apps route on the same knob,
+        # e.g. sharded_ps_bench --plane mesh)
+        from minips_tpu.train.mesh_plane import resolve_plane
+
+        self.plane = resolve_plane(plane)
+        if self.plane == "mesh":
+            raise ValueError(
+                "plane='mesh' selects the in-mesh collective data plane "
+                "(one process, device gang) — build it with "
+                "minips_tpu.train.mesh_plane.MeshPlane(num_ranks, ...) "
+                "instead of the bus-backed ShardedPSTrainer. Entrypoints "
+                "with mesh support route on this knob themselves "
+                "(sharded_ps_bench --plane mesh); one without it refuses "
+                "HERE rather than silently publishing host-wire numbers "
+                "under a mesh selection — unset MINIPS_MESH to run this "
+                "app on the host wire")
         self.tables = tables
         self.bus = bus
         self.num_processes = num_processes

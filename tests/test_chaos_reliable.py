@@ -559,8 +559,14 @@ def run_bsp_lockstep(backend: str = "zmq", chaos: str = "",
     """2-rank in-proc BSP lockstep run → (final weights per rank,
     frames_lost per rank). THE bitwise-drill harness: identical frame
     streams must produce identical state whatever transport/fault layer
-    carried them — reused by the chaos drill below and the zmq-vs-shm
-    transport drill (tests/test_shm_bus.py)."""
+    carried them — reused by the chaos drill below, the zmq-vs-shm
+    transport drill (tests/test_shm_bus.py), and the in-mesh collective
+    data plane's BSP drill (``backend="mesh"`` runs the same loop
+    against train/mesh_plane.py — no bus, the collective is the
+    transport — and returns the per-rank owner-shard views so the
+    caller compares bitwise against a wire run)."""
+    if backend == "mesh":
+        return _run_bsp_lockstep_mesh()
     from tests.conftest import mk_loopback_buses
 
     buses = mk_loopback_buses(2, backend=backend, chaos=chaos,
@@ -609,6 +615,31 @@ def run_bsp_lockstep(backend: str = "zmq", chaos: str = "",
     finally:
         for b in buses:
             b.close()
+
+
+def _run_bsp_lockstep_mesh():
+    """The mesh half of the lockstep drill: SAME workload, keysets, lr,
+    and init as the wire run above, driven through the collective data
+    plane. Zero frames can be lost (there are no frames)."""
+    from minips_tpu.train.mesh_plane import MeshPlane
+
+    plane = MeshPlane(2, staleness=0)
+    t = plane.add_table("t", 64, 2, updater="sgd", lr=0.5)
+    w0 = (np.arange(32 * 2, dtype=np.float32) / 7.0).reshape(32, 2)
+    # the wire drill initializes each rank's LOCAL shard to the same
+    # pattern — the global table is that pattern twice
+    t.load_dense(np.concatenate([w0, w0]))
+    ranks = [plane.rank(0), plane.rank(1)]
+    keysets = [np.array([33, 40, 33, 47]), np.array([1, 8, 1, 15])]
+    for _ in range(4):
+        rows = [ranks[r].tables["t"].pull(keysets[r]) for r in (0, 1)]
+        for r in (0, 1):
+            ranks[r].tables["t"].push(keysets[r], 0.1 * rows[r] + 1.0)
+        for r in (0, 1):  # read-your-own-writes, same step
+            ranks[r].tables["t"].pull(keysets[r])
+        for r in (0, 1):  # single-threaded driver: gate at pull instead
+            ranks[r].tick(wait=False)
+    return [t.shard_slice(0), t.shard_slice(1)], [0, 0]
 
 
 def test_bsp_run_is_bitwise_equal_with_chaos_on_and_off():

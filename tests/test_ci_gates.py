@@ -16,7 +16,8 @@ sys.path.insert(0, str(REPO / "ci"))
 from bench_regression import (backend_mismatch, cache_tripwires,  # noqa: E402
                               chaos_tripwires, compare,
                               elastic_tripwires, main,
-                              rebalance_tripwires, serve_tripwires,
+                              mesh_tripwires, rebalance_tripwires,
+                              serve_tripwires, shape_mismatch,
                               throughput_points, trace_tripwires,
                               transport_tripwires)
 
@@ -575,3 +576,78 @@ def test_wire_converge_gates_loss_and_finals():
     probs = wire_compression_tripwires(
         _wirecomp_art(conv_completed=False))
     assert any("must complete" in p for p in probs)
+
+
+# -------------------------------- mesh-plane tripwires (MESH-WIN/BITWISE)
+def _mesh_art(wire=250_000.0, mesh=7_000_000.0, blk8=3_900_000.0,
+              mesh_completed=True, blk8_completed=True,
+              equal=True, checked=64) -> dict:
+    return {"mesh_plane_fused": {
+        "wire": {"completed": True, "plane": "wire",
+                 "rows_per_sec_per_process": wire},
+        "mesh": {"completed": mesh_completed, "plane": "mesh",
+                 "mesh_comm": "float32",
+                 "rows_per_sec_per_process": mesh},
+        "mesh_blk8": {"completed": blk8_completed, "plane": "mesh",
+                      "mesh_comm": "blk8",
+                      "rows_per_sec_per_process": blk8},
+        "bitwise": {"equal": equal, "rows_checked": checked}}}
+
+
+def test_mesh_tripwires_pass_on_healthy_sweep():
+    assert mesh_tripwires(_mesh_art()) == []
+    # absent sweep (other benches): vacuous
+    assert mesh_tripwires({}) == []
+
+
+def test_mesh_win_requires_mesh_strictly_above_wire():
+    probs = mesh_tripwires(_mesh_art(wire=300_000.0, mesh=290_000.0))
+    assert any("MESH-WIN" in p and "not strictly above" in p
+               for p in probs)
+    # a tie is a loss: the collective plane must WIN
+    probs = mesh_tripwires(_mesh_art(wire=100.0, mesh=100.0))
+    assert any("MESH-WIN" in p for p in probs)
+    # an incomplete mesh arm can never pass
+    probs = mesh_tripwires(_mesh_art(mesh_completed=False))
+    assert any("MESH-WIN" in p for p in probs)
+    # the quantized tier must complete (its rate is recorded, not
+    # ordered — quantize costs compute on CPU)
+    probs = mesh_tripwires(_mesh_art(blk8_completed=False))
+    assert any("mesh_blk8" in p for p in probs)
+    assert mesh_tripwires(_mesh_art(blk8=10.0)) == []
+
+
+def test_mesh_bitwise_requires_equal_finals_and_a_real_drill():
+    probs = mesh_tripwires(_mesh_art(equal=False))
+    assert any("MESH-BITWISE" in p for p in probs)
+    # a drill that checked zero rows proved nothing
+    probs = mesh_tripwires(_mesh_art(checked=0))
+    assert any("MESH-BITWISE" in p for p in probs)
+
+
+def test_shape_mismatch_refuses_cross_shape_compare(capsys):
+    prior = {"device_shape": "cpu:3", "metric": "m"}
+    new = {"device_shape": "cpu:8", "metric": "m"}
+    probs = shape_mismatch(prior, new)
+    assert len(probs) == 1 and "SHAPE-MISMATCH" in probs[0]
+    # same shape: clean pass
+    assert shape_mismatch(new, dict(new)) == []
+    # unstamped prior (pre-stamp artifact): warn, don't refuse
+    assert shape_mismatch({"metric": "m"}, new) == []
+    assert "WARNING" in capsys.readouterr().out
+    # the mesh-arm-failed sentinel is a MISSING stamp, never a shape
+    assert shape_mismatch({"device_shape": "unknown"}, new) == []
+    assert "WARNING" in capsys.readouterr().out
+    assert shape_mismatch({"device_shape": "unknown"},
+                          {"device_shape": "unknown"}) == []
+
+
+def test_shape_mismatch_fails_main_end_to_end(tmp_path):
+    p, n = tmp_path / "prior.json", tmp_path / "new.json"
+    prior = {**_art({"a": 100.0}), "device_shape": "cpu:3"}
+    new = {**_art({"a": 100.0}), "device_shape": "cpu:8"}
+    p.write_text(json.dumps(prior))
+    n.write_text(json.dumps(new))
+    assert main([str(p), str(n)]) == 1
+    n.write_text(json.dumps({**new, "device_shape": "cpu:3"}))
+    assert main([str(p), str(n)]) == 0

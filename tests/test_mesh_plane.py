@@ -1,0 +1,302 @@
+"""The in-mesh collective data plane (train/mesh_plane.py): BSP bitwise
+parity with the zmq wire path, SSP gating on the device-side clock
+vector, the quantized collective tier, and the plane's API contracts.
+
+Everything runs on the 8 fake CPU devices tests/conftest.py forces —
+the established threads-as-nodes pattern, with devices as the nodes.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from minips_tpu.consistency.gate import RETIRED_CLOCK
+from minips_tpu.train.mesh_plane import (MeshPlane, VALID_MESH_COMM,
+                                         resolve_plane)
+
+
+# --------------------------------------------- THE bitwise acceptance
+def test_bsp_mesh_is_bitwise_equal_to_zmq_wire_lockstep():
+    """ACCEPTANCE: the same BSP lockstep workload produces BITWISE
+    identical final weights whether the frames rode the zmq host wire
+    or the push/pull rode reduce-scatter/all-gather on the mesh — the
+    consistency contract survives the transport swap with not one bit
+    of training state different."""
+    from tests.test_chaos_reliable import run_bsp_lockstep
+
+    w_wire, lost = run_bsp_lockstep(backend="zmq")
+    w_mesh, lost_mesh = run_bsp_lockstep(backend="mesh")
+    assert lost == [0, 0] and lost_mesh == [0, 0]
+    for a, b in zip(w_wire, w_mesh):
+        np.testing.assert_array_equal(a, b)  # bitwise, not allclose
+
+
+# ------------------------------------------------------ SSP property
+def test_ssp_gate_bounds_skew_on_device_clock_vector():
+    """SSP staleness property on the DEVICE-side clock vector: a fast
+    rank must block at the clk−s bound (the shared gate.admits rule),
+    and every admitted pull must read state containing each peer's
+    pushes through clk−s — verified by per-rank counter keys whose
+    value IS the number of that rank's applied steps."""
+    s = 1
+    plane = MeshPlane(2, staleness=s, gate_timeout=30.0)
+    t = plane.add_table("t", 8, 1, updater="sgd", lr=1.0)
+    steps = 12
+    errs: list = []
+    violations: list = []
+
+    def worker(r: int, slow: float) -> None:
+        # rank r pushes grad −1.0 to key r each step: with sgd lr=1.0
+        # (w -= lr·g) the table value at key r equals the number of
+        # APPLIED steps of rank r
+        try:
+            h = plane.rank(r)
+            for i in range(steps):
+                if slow:
+                    import time
+
+                    time.sleep(slow)
+                clk = h.clock
+                rows = h.tables["t"].pull(np.array([0, 1]))
+                peer = 1 - r
+                applied_peer = rows[peer, 0]
+                if applied_peer < clk - s:
+                    violations.append((r, clk, float(applied_peer)))
+                h.tables["t"].push(np.array([r]),
+                                   -np.ones((1, 1), np.float32))
+                h.tick()
+            h.finalize(timeout=30.0)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append((r, repr(e)))
+
+    ths = [threading.Thread(target=worker, args=(0, 0.0)),
+           threading.Thread(target=worker, args=(1, 0.01))]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(timeout=60.0)
+    assert not any(th.is_alive() for th in ths), "mesh SSP run wedged"
+    assert not errs, errs
+    assert not violations, violations
+    # the fast rank genuinely gated (the bound did some work), and the
+    # observed skew stayed within s (+1 transient, matching the wire
+    # trainer's bound)
+    assert plane.gate_waits > 0
+    assert plane.max_skew_seen <= s + 1
+    # retirement rides the device-side vector too
+    assert (plane.clocks() == RETIRED_CLOCK).all()
+    # post-finalize agreement is trivial and exact: one shared state
+    final = t.pull_all(0)
+    np.testing.assert_array_equal(final, t.pull_all(1))
+    assert final[0, 0] == steps and final[1, 0] == steps
+
+
+def test_bsp_tick_gate_blocks_until_peers_arrive():
+    plane = MeshPlane(2, staleness=0, gate_timeout=0.3)
+    h = plane.rank(0)
+    h.tables  # noqa: B018 - handle exists without tables too
+    plane.add_table("t", 4, 1)
+    with pytest.raises(TimeoutError):
+        h.tick()  # BSP: rank 1 never ticks — the gate must time out
+
+
+# --------------------------------------------------- quantized tier
+def test_blk8_collective_tier_converges_with_dense_tier():
+    """Convergence drill pinned against the dense collective: a toy
+    regression (push = pulled − target, sgd) must drive the table to
+    the target under both tiers, with the blk8 end error within an
+    absolute band of the dense tier's — EQuARX-style quantize →
+    exchange → dequantize-accumulate must not bend the trajectory."""
+    target = np.random.default_rng(3).normal(
+        size=(64, 4)).astype(np.float32)
+
+    def run(comm: str) -> float:
+        plane = MeshPlane(2, staleness=0, comm=comm)
+        t = plane.add_table("t", 64, 4, updater="sgd", lr=0.4)
+        keys = [np.arange(0, 64, 2), np.arange(1, 64, 2)]
+        errs: list = []
+
+        def worker(r: int) -> None:
+            try:
+                h = plane.rank(r)
+                for _ in range(30):
+                    rows = h.tables["t"].pull(keys[r])
+                    h.tables["t"].push(keys[r], rows - target[keys[r]])
+                    h.tick()
+                h.finalize(timeout=30.0)
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        ths = [threading.Thread(target=worker, args=(r,))
+               for r in (0, 1)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=60.0)
+        assert not errs, (comm, errs)
+        return float(np.abs(plane.tables["t"].pull_all(0)
+                            - target).max())
+
+    dense_err = run("float32")
+    blk8_err = run("blk8")
+    assert dense_err < 1e-4  # the dense tier nails the fixed point
+    # blk8's per-hop-bounded quantization noise keeps it in a tight
+    # band of the same fixed point (f32 accumulation: error does not
+    # compound with rank count)
+    assert blk8_err < 0.05, (dense_err, blk8_err)
+
+
+def test_blk8_moves_fewer_collective_bytes_than_f32():
+    def bytes_for(comm: str) -> int:
+        plane = MeshPlane(2, staleness=float("inf"), comm=comm)
+        t = plane.add_table("t", 256, 8)
+        t.push(0, np.arange(16, dtype=np.int64),
+               np.ones((16, 8), np.float32))
+        t.push(1, np.arange(16, dtype=np.int64),
+               np.ones((16, 8), np.float32))
+        assert t.waves == 1  # all ranks deposited: eager wave fired
+        return t.collective_bytes
+
+    assert bytes_for("blk8") < bytes_for("float32")
+
+
+# ------------------------------------------------------ API contracts
+def test_push_coalesces_duplicates_like_the_wire():
+    """Duplicate keys in one push sum before the update, bitwise the
+    wire's client-side dedup (f64 bincount, one rounding)."""
+    a = MeshPlane(2, staleness=float("inf"))
+    ta = a.add_table("t", 8, 2, updater="sgd", lr=1.0)
+    b = MeshPlane(2, staleness=float("inf"))
+    tb = b.add_table("t", 8, 2, updater="sgd", lr=1.0)
+    g = np.array([[0.1, 0.2], [0.3, 0.4], [0.5, 0.6]], np.float32)
+    ta.push(0, np.array([3, 5, 3]), g)
+    summed = np.array(
+        [np.float32(np.float64(g[0, 0]) + np.float64(g[2, 0])),
+         np.float32(np.float64(g[0, 1]) + np.float64(g[2, 1]))])
+    tb.push(0, np.array([3, 5]), np.stack([summed, g[1]]))
+    for t in (ta, tb):
+        t.push(1, np.array([0]), np.zeros((1, 2), np.float32))
+    np.testing.assert_array_equal(ta.pull_all(0), tb.pull_all(0))
+
+
+def test_out_of_range_keys_refused_on_both_legs():
+    """The wire plane refuses misrouted keys; the mesh plane must too —
+    numpy would otherwise serve padding zeros (or wrap negatives)
+    silently."""
+    plane = MeshPlane(3, staleness=float("inf"))
+    t = plane.add_table("t", 64, 2)  # padded to 66: rows 64-65 exist
+    for bad in (np.array([64]), np.array([-1]), np.array([3, 65])):
+        with pytest.raises(ValueError, match="key space"):
+            t.pull(0, bad)
+        with pytest.raises(ValueError, match="key space"):
+            t.push(0, bad, np.ones((bad.size, 2), np.float32))
+
+
+def test_read_your_own_writes_within_a_step():
+    plane = MeshPlane(2, staleness=float("inf"))
+    t = plane.add_table("t", 16, 2, updater="sgd", lr=0.5)
+    keys = np.array([2, 9])
+    before = t.pull(0, keys)
+    t.push(0, keys, np.ones((2, 2), np.float32))
+    after = t.pull(0, keys)  # same step, peers never deposited
+    np.testing.assert_array_equal(after, before - 0.5)
+
+
+def test_lazy_adam_freezes_untouched_rows():
+    plane = MeshPlane(2, staleness=float("inf"))
+    t = plane.add_table("t", 8, 2, updater="adam", lr=0.1)
+    w0 = np.random.default_rng(0).normal(size=(8, 2)).astype(np.float32)
+    t.load_dense(w0)
+    t.push(0, np.array([1]), np.ones((1, 2), np.float32))
+    t.push(1, np.array([2]), np.ones((1, 2), np.float32))
+    out = t.pull_all(0)
+    touched = np.array([1, 2])
+    untouched = np.array([0, 3, 4, 5, 6, 7])
+    np.testing.assert_array_equal(out[untouched], w0[untouched])
+    assert (out[touched] != w0[touched]).all()
+    # step counters moved only for touched rows (device-side state)
+    steps = np.asarray(t._steps)
+    assert steps[1] == 1 and steps[2] == 1 and steps[0] == 0
+
+
+def test_stateful_updaters_match_wire_oracle_on_disjoint_keys():
+    """adagrad/adam vs the wire table's numpy server apply on disjoint
+    per-rank keysets — same semantics, float-rounding-close (the wire
+    runs numpy, the mesh runs XLA)."""
+    from minips_tpu.train.sharded_ps import ShardedTable
+
+    for upd in ("adagrad", "adam"):
+        plane = MeshPlane(2, staleness=float("inf"))
+        mt = plane.add_table("t", 64, 4, updater=upd, lr=0.05)
+        oracle = ShardedTable("o", 64, 4, None, 0, 1, updater=upd,
+                              lr=0.05)
+        rng = np.random.default_rng(7)
+        for _ in range(4):
+            for r, lo in ((0, 0), (1, 32)):
+                keys = rng.integers(lo, lo + 32, size=16)
+                g = rng.normal(size=(16, 4)).astype(np.float32)
+                mt.push(r, keys, g)
+                oracle.push(keys, g)
+            plane.tick(0, wait=False)
+            plane.tick(1, wait=False)
+        np.testing.assert_allclose(mt.pull_all(0), oracle.pull_all(),
+                                   rtol=0, atol=1e-6)
+
+
+def test_sharded_state_is_one_over_n_per_shard():
+    plane = MeshPlane(4, staleness=0)
+    t = plane.add_table("t", 1024, 8, updater="adam")
+    # full adam state = w + m + v (f32) + steps (i32), quartered
+    full = 3 * 1024 * 8 * 4 + 1024 * 4
+    assert t.local_bytes() == full // 4
+    # and it genuinely lives sharded on the mesh (one shard per device)
+    assert len(t._w.sharding.device_set) == 4
+
+
+def test_plane_validation_and_selection():
+    with pytest.raises(ValueError, match="comm"):
+        MeshPlane(2, comm="int4")
+    with pytest.raises(ValueError, match="devices"):
+        MeshPlane(64)  # only 8 fake devices
+    assert "blk8" in VALID_MESH_COMM
+    assert resolve_plane("wire") == "wire"
+    assert resolve_plane("mesh") == "mesh"
+    with pytest.raises(ValueError, match="plane"):
+        resolve_plane("shm")
+
+
+def test_resolve_plane_honors_env(monkeypatch):
+    monkeypatch.delenv("MINIPS_MESH", raising=False)
+    assert resolve_plane(None) == "wire"
+    monkeypatch.setenv("MINIPS_MESH", "1")
+    assert resolve_plane(None) == "mesh"
+    monkeypatch.setenv("MINIPS_MESH", "0")
+    assert resolve_plane(None) == "wire"
+    # explicit wins over env, the shared convention
+    monkeypatch.setenv("MINIPS_MESH", "1")
+    assert resolve_plane("wire") == "wire"
+
+
+def test_bus_backed_trainer_refuses_the_mesh_plane(monkeypatch):
+    """ShardedPSTrainer(plane='mesh') (or MINIPS_MESH=1) must refuse
+    loudly with a pointer to MeshPlane — the bus-backed trainer IS the
+    host-wire plane; silently running the wire under a mesh selection
+    would publish mislabeled numbers."""
+    from minips_tpu.train.sharded_ps import ShardedPSTrainer
+
+    with pytest.raises(ValueError, match="mesh_plane"):
+        ShardedPSTrainer({}, None, 1, plane="mesh")
+    monkeypatch.setenv("MINIPS_MESH", "1")
+    with pytest.raises(ValueError, match="mesh_plane"):
+        ShardedPSTrainer({}, None, 1)
+
+
+def test_stats_and_shape_stamp_fields():
+    plane = MeshPlane(3, staleness=0, comm="blk8", block=64)
+    plane.add_table("t", 32, 2)
+    st = plane.stats()
+    assert st["plane"] == "mesh" and st["ranks"] == 3
+    assert st["comm"] == "blk8" and st["block"] == 64
+    assert st["devices"] == 3
+    assert st["waves"] == {"t": 0}
