@@ -820,7 +820,8 @@ def main() -> int:
                 "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
                 "MINIPS_SERVE": "", "MINIPS_BUS": "",
                 "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
-                "MINIPS_HEARTBEAT": "", "MINIPS_PUSH_COMM": "", "MINIPS_MESH": ""}
+                "MINIPS_HEARTBEAT": "", "MINIPS_PUSH_COMM": "",
+                "MINIPS_MESH": "", "MINIPS_AUTOSCALE": ""}
         kill_step = max(2, e_iters // 3)
         grid: dict = {"iters": e_iters, "kill_step": kill_step}
 
@@ -906,6 +907,171 @@ def main() -> int:
         return grid
 
     elastic_grid = _elastic_arms()
+
+    # PRODUCTION CONTROL PLANE (this PR): the coordinator LEASE
+    # (balance/control_plane.py) + the closed-loop autoscaler
+    # (balance/autoscaler.py), drilled as three COMPLETION arms on the
+    # example app. Rates ride the gate-invisible ``steps_per_sec_ctrl``
+    # key (the chaos-arm convention — the kill arm's wall contains a
+    # detection stall and the storm arm changes world size mid-run).
+    # The ci/bench_regression CTRL-* tripwires gate: CTRL-FAILOVER —
+    # the rank-0 (lease holder) seeded-SIGKILL arm's survivors finish
+    # the FULL step count with the lease advanced exactly once, >= 1
+    # range restored, zero unrecovered frames, bitwise agreement;
+    # CTRL-SCALE — the storm arm completes with >= 1 autoscaler admit
+    # and >= 1 drain and post-admit shed rate at or below pre-admit;
+    # the steady armed-idle arm completes with zero membership changes.
+    def _control_plane_arms() -> dict:
+        import tempfile
+
+        from minips_tpu import launch as _launch
+
+        c_iters = 20 if args.quick else 40
+        base = [sys.executable, "-m",
+                "minips_tpu.apps.sharded_ps_example",
+                "--model", "sparse", "--mode", "ssp",
+                "--staleness", "2", "--iters", str(c_iters),
+                "--batch", "128", "--checkpoint-every", "5"]
+        env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                "MINIPS_CHAOS": "", "MINIPS_RELIABLE": "",
+                "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
+                "MINIPS_SERVE": "", "MINIPS_BUS": "",
+                "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
+                "MINIPS_HEARTBEAT": "", "MINIPS_PUSH_COMM": "",
+                "MINIPS_MESH": "", "MINIPS_AUTOSCALE": ""}
+        grid: dict = {"iters": c_iters}
+
+        def rate(dones: list[dict]) -> float:
+            return round(c_iters / max(max(d["wall_s"] for d in dones),
+                                       1e-9), 2)
+
+        # -------- steady: lease + autoscaler armed, zero load — must
+        # complete with ZERO membership changes (hysteresis honesty;
+        # the in-proc lockstep drill pins the numerics bitwise)
+        with tempfile.TemporaryDirectory() as ck:
+            try:
+                res = _launch.run_local_job(
+                    3, base + ["--checkpoint-dir", ck],
+                    base_port=None,
+                    env_extra={**env0, "MINIPS_ELASTIC": "1",
+                               "MINIPS_AUTOSCALE": "1"},
+                    timeout=240.0)
+                mships = [d.get("membership") or {} for d in res]
+                ascale = [d.get("autoscale") or {} for d in res]
+                grid["steady"] = {
+                    "completed": True,
+                    "steps_per_sec_ctrl": rate(res),
+                    "joins": sum(m.get("joins", 0) for m in mships),
+                    "leaves": sum(m.get("leaves", 0) for m in mships),
+                    "admits": sum(a.get("admits", 0) for a in ascale),
+                    "drains": sum(a.get("drains", 0) for a in ascale),
+                    "wire_frames_lost": sum(
+                        d.get("wire_frames_lost", 0) for d in res),
+                }
+            except Exception as e:  # noqa: BLE001 - completion-gated
+                grid["steady"] = {"completed": False,
+                                  "error": str(e)[:300]}
+        # -------- kill: seeded SIGKILL of RANK 0, the lease holder.
+        # Survivors must elect rank 1 exactly once (every done line's
+        # lease term == 1), restore the corpse's ranges, and lose no
+        # step — the anti-SPOF acceptance.
+        kill_step = max(8, c_iters // 3)
+        with tempfile.TemporaryDirectory() as ck:
+            try:
+                rc, events = _launch.run_local_job_raw(
+                    3, base + ["--checkpoint-dir", ck],
+                    base_port=None,
+                    env_extra={**env0, "MINIPS_ELASTIC": "1",
+                               "MINIPS_CHAOS_KILL":
+                                   f"7:rank=0,step={kill_step}",
+                               "MINIPS_HEARTBEAT":
+                                   "interval=0.1,timeout=1.0"},
+                    timeout=240.0, kill_on_failure=False)
+                dones = [ev[-1] for r, ev in enumerate(events)
+                         if r != 0 and ev
+                         and ev[-1].get("event") == "done"]
+                if len(dones) == 2:
+                    terms = [((d.get("membership") or {}).get("lease")
+                              or {}).get("term") for d in dones]
+                    sums = {d.get("param_sum") for d in dones}
+                    grid["kill"] = {
+                        "completed": True,
+                        "steps_per_sec_ctrl": rate(dones),
+                        "lease_term": max(t for t in terms
+                                          if t is not None),
+                        "terms_agree": len(set(terms)) == 1,
+                        "clock_min": min(d["clock"] for d in dones),
+                        "iters": c_iters,
+                        "blocks_restored": sum(
+                            (d.get("membership") or {}).get(
+                                "blocks_restored", 0) for d in dones),
+                        "wire_frames_lost": sum(
+                            d.get("wire_frames_lost", 0)
+                            for d in dones),
+                        "finals_agree": len(sums) == 1,
+                    }
+                else:
+                    grid["kill"] = {"completed": False,
+                                    "error": f"survivors rc={rc}: "
+                                             f"{events}"[:300]}
+            except Exception as e:  # noqa: BLE001 - completion-gated
+                grid["kill"] = {"completed": False,
+                                "error": str(e)[:300]}
+        # -------- storm: 3 live + 1 held standby; a pull storm trips
+        # admission shedding at the hot owner, the autoscaler admits
+        # the standby under load (heat-aware placement), the storm ebbs
+        # and the autoscaler drains its own growth — the closed loop.
+        s_from = 4 if args.quick else 8
+        s_until = (c_iters - 10) if args.quick else (c_iters - 14)
+        with tempfile.TemporaryDirectory() as ck:
+            try:
+                res = _launch.run_local_job(
+                    4, base + ["--checkpoint-dir", ck,
+                               # pace the fleet so the serve rate below
+                               # clears steady traffic on any host —
+                               # only the storm sheds, so the drain's
+                               # calm streak is clean calm
+                               "--slow-rank", "1", "--slow-ms", "15",
+                               "--storm-from", str(s_from),
+                               "--storm-until", str(s_until),
+                               # 12 pulls/step: the 3-rank storm sheds
+                               # decisively at any step rate above
+                               # ~6/s against rate=200, while steady
+                               # traffic (3 legs/step/owner) stays
+                               # inside the bucket up to the pacing cap
+                               "--storm-pulls", "12",
+                               "--storm-keys", "64"],
+                    base_port=None,
+                    env_extra={**env0, "MINIPS_ELASTIC": "live=0-2",
+                               "MINIPS_AUTOSCALE":
+                                   "up_shed=4,up_after=2,"
+                                   "down_after=4,cool=2",
+                               "MINIPS_SERVE":
+                                   "rate=200,burst=16,min_heat=1e9"},
+                    timeout=300.0)
+                dones = [d for d in res if d.get("event") == "done"]
+                ascale = [d.get("autoscale") or {} for d in res]
+                pre = [a.get("shed_rate_pre") for a in ascale
+                       if a.get("shed_rate_pre") is not None]
+                post = [a.get("shed_rate_post") for a in ascale
+                        if a.get("shed_rate_post") is not None]
+                grid["storm"] = {
+                    "completed": len(dones) == 3,
+                    "steps_per_sec_ctrl": rate(dones) if dones else None,
+                    "admits": sum(a.get("admits", 0) for a in ascale),
+                    "drains": sum(a.get("drains", 0) for a in ascale),
+                    "shed_rate_pre": pre[0] if pre else None,
+                    "shed_rate_post": post[0] if post else None,
+                    "joiner_drained": res[3].get("event") == "drained",
+                    "wire_frames_lost": sum(
+                        d.get("wire_frames_lost", 0) for d in res),
+                }
+            except Exception as e:  # noqa: BLE001 - completion-gated
+                grid["storm"] = {"completed": False,
+                                 "error": str(e)[:300]}
+        return grid
+
+    control_grid = _control_plane_arms()
 
     # THE IN-MESH COLLECTIVE DATA PLANE (this PR): the fused sweep
     # point — dense pull_all/push_dense cycles, the lrmlp weight-vector
@@ -1067,6 +1233,7 @@ def main() -> int:
         "trace_overhead_3proc": trace_grid,
         "pull_storm_3proc": storm_grid,
         "elastic_membership_3proc": elastic_grid,
+        "control_plane_3proc": control_grid,
         "mesh_plane_fused": mesh_grid,
     }))
     return 0

@@ -54,6 +54,15 @@ survivors must complete with >= 1 range restored from the elastic
 checkpoint, zero unrecovered frames, finite loss and bitwise-agreeing
 finals, and the standby-admission arm must complete with the joiner
 serving > 0 rows.
+``control_plane_tripwires`` (CTRL-FAILOVER/CTRL-SCALE) guards the
+``control_plane_3proc`` sweep: the coordinator-kill arm's survivors
+must complete the full step count with the lease advanced exactly
+once, >= 1 range restored, zero unrecovered frames and bitwise
+agreement; the storm-autoscale arm must complete with >= 1 autoscaler
+admit and >= 1 drain and the post-admit shed rate at or below the
+pre-admit rate; the steady armed-idle arm must complete with zero
+membership changes. Rates ride gate-invisible keys
+(``steps_per_sec_ctrl``) like every chaos arm.
 ``mesh_tripwires`` (MESH-WIN/MESH-BITWISE) guards the
 ``mesh_plane_fused`` sweep: the in-mesh collective plane's arm must
 beat the host-wire arm on rows/sec strictly (the data plane exists to
@@ -611,6 +620,119 @@ def elastic_tripwires(new: dict) -> list[str]:
     return problems
 
 
+def control_plane_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``control_plane_3proc``
+    sweep (coordinator lease failover + the closed-loop autoscaler —
+    balance/control_plane.py, balance/autoscaler.py); vacuous when the
+    sweep is absent. Every arm is a COMPLETION gate: rates live under
+    the gate-invisible ``steps_per_sec_ctrl`` key (the chaos-arm
+    convention), so none enters the run-to-run ±10% comparison.
+
+    - CTRL-FAILOVER: the coordinator-kill arm's survivors must
+      COMPLETE the full step count (zero lost steps) with the lease
+      advanced EXACTLY once (every survivor at term 1 — zero means
+      succession silently fell off, two means it flapped), >= 1 range
+      restored from the elastic checkpoint, zero unrecovered frames,
+      and bitwise-agreeing finals.
+    - CTRL-SCALE: the storm-autoscale arm must COMPLETE with >= 1
+      autoscaler admit and >= 1 drain (the closed loop actually
+      closed), a recorded positive pre-admit shed rate (the admit
+      happened UNDER measured load, not by coincidence), and the
+      post-admit rate — the calm-streak mean that triggered the drain
+      — at or below it: shed pressure measurably FELL after the admit
+      before the loop shrank the fleet, so both actions were signal-
+      driven, not timer-driven.
+    - The steady (armed-idle) arm must complete with ZERO membership
+      changes: a calm fleet may not flap (hysteresis honesty)."""
+    grid = new.get("control_plane_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    steady = grid.get("steady") or {}
+    if not steady.get("completed"):
+        problems.append(
+            f"CTRL-FAILOVER control_plane_3proc/steady: completed="
+            f"{steady.get('completed')!r} — an armed-but-idle control "
+            "plane must complete cleanly")
+    elif (steady.get("joins") or steady.get("leaves")
+          or steady.get("admits") or steady.get("drains")):
+        problems.append(
+            f"CTRL-SCALE control_plane_3proc/steady: membership "
+            f"changed on a calm run (joins={steady.get('joins')!r} "
+            f"leaves={steady.get('leaves')!r} "
+            f"admits={steady.get('admits')!r} "
+            f"drains={steady.get('drains')!r}) — the autoscaler is "
+            "flapping without load")
+    kill = grid.get("kill") or {}
+    if not kill.get("completed"):
+        problems.append(
+            f"CTRL-FAILOVER control_plane_3proc/kill: completed="
+            f"{kill.get('completed')!r} — the coordinator-kill arm's "
+            "survivors must finish under the successor (holder death "
+            "should degrade to a lease handover, not a gang restart)")
+    else:
+        if kill.get("lease_term") != 1 or not kill.get("terms_agree"):
+            problems.append(
+                f"CTRL-FAILOVER control_plane_3proc/kill: lease_term="
+                f"{kill.get('lease_term')!r} terms_agree="
+                f"{kill.get('terms_agree')!r} — the successor must be "
+                "elected exactly once (0 = succession silently "
+                "disabled, > 1 = the lease flapped)")
+        if kill.get("clock_min") != kill.get("iters"):
+            problems.append(
+                f"CTRL-FAILOVER control_plane_3proc/kill: clock_min="
+                f"{kill.get('clock_min')!r} of iters="
+                f"{kill.get('iters')!r} — steps were lost across the "
+                "failover")
+        if not kill.get("blocks_restored"):
+            problems.append(
+                "CTRL-FAILOVER control_plane_3proc/kill: 0 ranges "
+                "restored — the successor never issued the old "
+                "holder's death plan")
+        if kill.get("wire_frames_lost", 0):
+            problems.append(
+                f"CTRL-FAILOVER control_plane_3proc/kill: "
+                f"{kill['wire_frames_lost']} unrecovered frames — the "
+                "handover is leaking wire loss")
+        if not kill.get("finals_agree"):
+            problems.append(
+                "CTRL-FAILOVER control_plane_3proc/kill: survivors' "
+                "final tables disagree — the restore/fence protocol "
+                "is torn across the failover")
+    storm = grid.get("storm") or {}
+    if not storm.get("completed"):
+        problems.append(
+            f"CTRL-SCALE control_plane_3proc/storm: completed="
+            f"{storm.get('completed')!r} — the storm-autoscale arm "
+            "must finish (shed bursts should scale the fleet, not "
+            "poison the run)")
+    else:
+        if not storm.get("admits"):
+            problems.append(
+                "CTRL-SCALE control_plane_3proc/storm: 0 autoscaler "
+                "admits under a shedding storm — the scale-up signal "
+                "path is silently disabled")
+        if not storm.get("drains"):
+            problems.append(
+                "CTRL-SCALE control_plane_3proc/storm: 0 autoscaler "
+                "drains after the storm ebbed — the scale-down half "
+                "of the loop never closed")
+        pre = storm.get("shed_rate_pre")
+        post = storm.get("shed_rate_post")
+        if not (isinstance(pre, (int, float)) and pre > 0):
+            problems.append(
+                f"CTRL-SCALE control_plane_3proc/storm: shed_rate_pre="
+                f"{pre!r} — the admit fired without recorded shed "
+                "load (the signal wire is broken)")
+        elif not (isinstance(post, (int, float)) and post <= pre):
+            problems.append(
+                f"CTRL-SCALE control_plane_3proc/storm: post-admit "
+                f"shed rate {post!r} did not fall from pre-admit "
+                f"{pre!r} — the admitted capacity absorbed nothing "
+                "(heat-aware placement silently disabled?)")
+    return problems
+
+
 def mesh_tripwires(new: dict) -> list[str]:
     """Absolute (prior-free) gates on the ``mesh_plane_fused`` sweep
     (the in-mesh collective data plane, train/mesh_plane.py); vacuous
@@ -789,7 +911,7 @@ def main(argv: list[str] | None = None) -> int:
                 + wire_compression_tripwires(new)
                 + rebalance_tripwires(new) + trace_tripwires(new)
                 + serve_tripwires(new) + elastic_tripwires(new)
-                + mesh_tripwires(new))
+                + control_plane_tripwires(new) + mesh_tripwires(new))
     pts = throughput_points(new)
     print(f"bench-regression: {len(pts)} throughput points checked "
           f"against {len(throughput_points(prior))} prior")

@@ -68,6 +68,22 @@ def main(argv=None) -> int:
                          "and the mbDr control frame trigger the same "
                          "path)")
     ap.add_argument("--drain-rank", type=int, default=-1)
+    ap.add_argument("--storm-from", type=int, default=0,
+                    help="pull-storm window start (sparse model only): "
+                         "every rank issues --storm-pulls extra "
+                         "read-only pulls of a fixed hot key range per "
+                         "step in [from, until) — the admission-shed "
+                         "load the closed-loop autoscaler "
+                         "(MINIPS_AUTOSCALE) watches")
+    ap.add_argument("--storm-until", type=int, default=0,
+                    help="pull-storm window end (0 = no storm)")
+    ap.add_argument("--storm-pulls", type=int, default=4,
+                    help="extra hot-range pull batches per step inside "
+                         "the storm window")
+    ap.add_argument("--storm-keys", type=int, default=64,
+                    help="keys per storm pull batch (a contiguous hot "
+                         "range in the SECOND shard, so the hot owner "
+                         "survives coordinator-kill drills)")
     ap.add_argument("--checkpoint-dir", default=None,
                     help="per-rank shard checkpoints under "
                          "<dir>/rank<r>/; on start, ranks negotiate the "
@@ -147,8 +163,29 @@ def main(argv=None) -> int:
                                    args.checkpoint_every)
     if mb is not None and mb.i_am_standby:
         # standby rank: serve (bus threads) and adopt plans until the
-        # fleet admits me; train from the catch-up clock it hands over
-        start_iter = mb.standby_loop(args.join_at)
+        # fleet admits me; train from the catch-up clock it hands over.
+        # A pre-admission unrecoverable verdict exits with the same
+        # structured peer_failure/42 protocol as the training body —
+        # a raw traceback here broke the drill harvesters
+        from minips_tpu.consistency.gate import PeerFailureError
+
+        try:
+            start_iter = mb.standby_loop(args.join_at)
+        except PeerFailureError as e:
+            print(json.dumps({"rank": rank, "event": "peer_failure",
+                              "dead": sorted(e.dead),
+                              "at_clock": trainer.clock}), flush=True)
+            return 42
+        if start_iter < 0:
+            # the fleet finished calm without ever needing me (mbEnd):
+            # a standby that was never admitted exits clean, rc 0
+            print(json.dumps({"rank": rank, "event": "standby_unused",
+                              "elastic_spec":
+                                  os.environ.get("MINIPS_ELASTIC")
+                                  or None}), flush=True)
+            monitor.stop()
+            bus.close()
+            return 0
 
     if sparse:
         @jax.jit
@@ -165,6 +202,31 @@ def main(argv=None) -> int:
                 return lr_model.loss_dense(params, batch)
             loss, g = jax.value_and_grad(f)(vec)
             return loss, g
+
+    storm_keys = None
+    if args.storm_until:
+        if not sparse:
+            print(json.dumps({
+                "rank": rank, "event": "error",
+                "err": "--storm-until requires --model sparse (the "
+                       "storm is per-key pull load)"}), flush=True)
+            return 2
+        # the hot range sits in the SECOND shard: coordinator-kill
+        # drills SIGKILL rank 0, and a hot range on the corpse would
+        # measure restore latency, not autoscaling. A table too small
+        # to hold the range in shard 1 refuses loudly — silently
+        # landing it in shard 0 would break exactly that guarantee
+        shard = -(-num_rows // nprocs)
+        if shard + args.storm_keys > num_rows:
+            print(json.dumps({
+                "rank": rank, "event": "error",
+                "err": f"--storm-keys {args.storm_keys} does not fit "
+                       f"in the second shard (rows {num_rows}, shard "
+                       f"{shard}) — grow --dim or shrink the storm "
+                       "range (it must avoid rank 0, the "
+                       "coordinator-kill target)"}), flush=True)
+            return 2
+        storm_keys = shard + np.arange(args.storm_keys, dtype=np.int64)
 
     losses = []
     # resumed runs reseed on (rank, start): batch sampling is with-
@@ -231,6 +293,15 @@ def main(argv=None) -> int:
                 vec = table.pull_all()
                 loss, g = grads_dense(jnp.asarray(vec), batch)
                 table.push_dense(np.asarray(g) / nprocs)
+            if storm_keys is not None \
+                    and args.storm_from <= i < args.storm_until:
+                # the read storm: extra hot-range pulls on top of the
+                # training traffic — with MINIPS_SERVE admission armed
+                # the hot owner sheds/backpressures these (explicit
+                # refusal + bounded retry, never silence), and those
+                # shed counters are the autoscaler's scale-up signal
+                for _ in range(args.storm_pulls):
+                    table.pull(storm_keys)
             losses.append(float(loss))
             trainer.tick()
             save_hook(i)
@@ -266,6 +337,7 @@ def main(argv=None) -> int:
             "ef": trainer.ef_stats(),
             "elastic_spec": os.environ.get("MINIPS_ELASTIC") or None,
             "membership": trainer.membership_stats(),
+            "autoscale": trainer.autoscale_stats(),
             "frames_dropped": trainer.frames_dropped,
             "wire_frames_lost": trainer.wire_frames_lost,
             "resumed_from": start_iter,
@@ -296,6 +368,7 @@ def main(argv=None) -> int:
             # elastic membership echo + chaos-kill spec: the drills
             # assert the arm they think they ran really ran
             "elastic_spec": os.environ.get("MINIPS_ELASTIC") or None,
+            "autoscale_spec": os.environ.get("MINIPS_AUTOSCALE") or None,
             "chaos_kill_spec": os.environ.get("MINIPS_CHAOS_KILL")
             or None,
             "wall_s": round(time.monotonic() - t0, 4),

@@ -1,6 +1,6 @@
-"""Heat-aware shard rebalancing for the sharded PS (train/sharded_ps.py).
+"""Load balance + control plane for the sharded PS (train/sharded_ps.py).
 
-Two halves, deliberately separable:
+Four cooperating modules, deliberately separable:
 
 - :mod:`minips_tpu.balance.heat` — decayed per-key-block touch counters
   kept by every owner on its serve path (bounded memory, vectorized),
@@ -9,10 +9,18 @@ Two halves, deliberately separable:
 - :mod:`minips_tpu.balance.rebalancer` — the coordinator that collects
   per-shard heat, computes a new block→owner assignment (greedy
   bin-pack with hysteresis) and drives the epoch-fenced online
-  migration through the tables' wire protocol.
+  migration through the tables' wire protocol (``MINIPS_REBALANCE``);
+- :mod:`minips_tpu.balance.membership` — elastic membership over the
+  same migration machinery: ranks join, drain, and die without killing
+  the job (``MINIPS_ELASTIC``);
+- :mod:`minips_tpu.balance.control_plane` +
+  :mod:`minips_tpu.balance.autoscaler` — the production control plane:
+  the coordinator as a LEASE with deterministic succession and
+  term-fenced broadcasts, and the closed-loop autoscaler that drives
+  membership from load signals (``MINIPS_AUTOSCALE``).
 
-Enabled by ``MINIPS_REBALANCE`` (off by default) — knob reference in
-docs/api.md, the protocol walkthrough in docs/architecture.md.
+Knob reference in docs/api.md; protocol walkthroughs in
+docs/architecture.md and docs/fault_tolerance.md.
 """
 
 from minips_tpu.balance.heat import HeatAccountant

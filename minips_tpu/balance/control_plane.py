@@ -1,0 +1,152 @@
+"""Coordinator lease + deterministic succession — half one of the
+production control plane (ROADMAP item 3).
+
+Until this PR the coordinator was a RANK: ``Membership`` and
+``Rebalancer`` both hardcoded rank 0 as the planner, and a heartbeat-dead
+verdict against it was the documented unrecoverable case — exit 42, gang
+restart — even though every survivor already held the state a successor
+needs (the membership table from the broadcast protocol, heat reports
+re-gossiped every rbH tick, the newest complete checkpoint step via
+``ckpt/elastic.find_live_step``). This module makes the coordinator a
+LEASE over that rank space instead.
+
+**The succession rule — no election wire protocol.** The lease is a
+``(term, holder)`` pair every rank tracks. On a heartbeat-dead verdict
+against the holder, every rank advances the lease LOCALLY and
+identically: term += 1, holder = the lowest-ranked live rank
+(:func:`successor_of`). The heartbeat verdict plus the membership table
+already give every rank the same inputs, so no ballots ride the wire —
+the "election" is a pure function, exactly like ``KillSpec.resolve``.
+The successor then reconstructs coordinator state from what survivors
+re-advertise: heat reports re-arrive on the next ``rbH`` tick (the
+rebalancer re-gossips every clock), the membership table was never
+centralized to begin with, and the newest complete step is re-derived
+from the shared checkpoint dir when the death plan needs it. In-flight
+``mbJ``/``mbQ`` conversations re-target automatically because their
+retry loops address ``membership.coord``, which succession updates.
+
+**Fencing — why the term exists.** A partitioned ex-coordinator that
+comes back must not be able to broadcast a conflicting plan. Two
+complementary fences:
+
+- RECEIVE fence (:meth:`CoordinatorLease.admit`): every coordinator
+  broadcast (``rbP`` plans, ``mbA`` admits, ``mbD`` verdicts) is stamped
+  with the issuer's ``lt``/``lh``; receivers DROP frames whose term is
+  below their own (counted in ``fenced``). A stale ex-coordinator's
+  post-partition plan dies at every receiver.
+- SELF fence (:meth:`CoordinatorLease.observe`): lease stamps also ride
+  every heartbeat (``HeartbeatMonitor.payload_extra``), max-merged on
+  receive — the returning ex-coordinator learns the newer term from the
+  first beat it hears and stops planning on its own (``_coord_step``
+  checks ``rank != coord``), before it can even try.
+
+The lease holder at term 0 is rank 0 (the launch-time default), so an
+armed-but-idle fleet behaves exactly as before — the lockstep harness
+pins armed-idle bitwise-equal to off. The successor's ENDPOINT needs no
+renegotiation either: the control bus is a full mesh wired at spawn
+(``launch.bus_endpoint_of`` maps the membership-table rank back to the
+address the launcher advertised), so succession is a rank-id change, not
+a respawn.
+
+What still gang-restarts, honestly: a holder death with NO live rank
+left to succeed, and a successor that finds no complete checkpoint for
+the corpse's owned blocks (``rstep=-1`` — the simultaneous
+coordinator+owner death with no checkpoint case docs/fault_tolerance.md
+names). The lease narrows the unrecoverable set; it does not pretend to
+empty it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional
+
+__all__ = ["CoordinatorLease", "successor_of"]
+
+
+def successor_of(live: Iterable[int]) -> Optional[int]:
+    """THE succession rule: the lowest-ranked live rank, or None when
+    nobody is left to hold the lease. A pure function of the membership
+    table so every rank computes the same successor without a ballot."""
+    live = set(live)
+    return min(live) if live else None
+
+
+class CoordinatorLease:
+    """``(term, holder)`` with max-merge observation and stale-term
+    fencing — one instance per rank, shared by the membership plane and
+    the rebalancer's plan wire. Thread-safe: the monitor's sweep thread
+    advances it while bus receive threads admit/observe."""
+
+    def __init__(self, initial_holder: int = 0):
+        self._lock = threading.Lock()
+        self.term = 0
+        self.holder = int(initial_holder)
+        self.successions = 0   # times THIS rank advanced the lease
+        self.fenced = 0        # stale-term frames dropped at this rank
+
+    # ------------------------------------------------------------- stamps
+    def stamp(self) -> dict:
+        """The wire stamp coordinator broadcasts (and every heartbeat)
+        carry: current term + holder. Receivers :meth:`admit` against
+        the term and :meth:`observe` the pair."""
+        with self._lock:
+            return {"lt": self.term, "lh": self.holder}
+
+    def current(self) -> tuple[int, int]:
+        with self._lock:
+            return self.term, self.holder
+
+    # ------------------------------------------------------------- fences
+    def admit(self, payload: dict) -> bool:
+        """The receive fence: False (and counted) for a frame stamped
+        with a STALE term — a partitioned ex-coordinator's plan must die
+        at every receiver. Unstamped frames pass: they predate the lease
+        (mixed fleet) or come from unit rigs that never armed it."""
+        lt = payload.get("lt")
+        if lt is None:
+            return True
+        with self._lock:
+            if int(lt) < self.term:
+                self.fenced += 1
+                return False
+        return True
+
+    def observe(self, payload: dict) -> bool:
+        """Max-merge a term seen on the wire (heartbeat stamps, plan
+        stamps). Returns True when the payload taught us a NEWER term —
+        the caller re-targets its coordinator view; an ex-holder that
+        gets True here has just been fenced out of the role it thinks it
+        still holds (the partition-return self fence)."""
+        lt, lh = payload.get("lt"), payload.get("lh")
+        if lt is None or lh is None:
+            return False
+        with self._lock:
+            if int(lt) > self.term:
+                self.term, self.holder = int(lt), int(lh)
+                return True
+        return False
+
+    # --------------------------------------------------------- succession
+    def succeed(self, dead_holder: int, live: Iterable[int]) -> Optional[int]:
+        """Advance the lease past a dead holder: term += 1, holder = the
+        lowest-ranked live rank. Returns the new holder, the current
+        holder unchanged when ``dead_holder`` no longer holds the lease
+        (a second verdict racing the first rank's advance), or None when
+        no live rank remains (genuinely unrecoverable)."""
+        with self._lock:
+            if int(dead_holder) != self.holder:
+                return self.holder
+            succ = successor_of(set(live) - {int(dead_holder)})
+            if succ is None:
+                return None
+            self.term += 1
+            self.holder = int(succ)
+            self.successions += 1
+            return self.holder
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"term": self.term, "holder": self.holder,
+                    "successions": self.successions,
+                    "fenced": self.fenced}

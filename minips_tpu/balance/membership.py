@@ -58,8 +58,16 @@ un-fenced: no stale push can be forwarded from a corpse, so the fence
 would protect nothing; the recovery semantics are exactly "that rank's
 ranges roll back to the last checkpoint". Workers re-route refused or
 orphaned legs via the existing ``psE``/resend machinery; replicas on
-the dead rank demote by lease expiry (PR6). A death the plane CANNOT
-own — no checkpoint anywhere, a dead coordinator, a verdict that never
+the dead rank demote by lease expiry (PR6). A DEAD COORDINATOR is no
+longer the SPOF it was: the coordinator role is a lease
+(balance/control_plane.py) — on the holder's death verdict every rank
+advances the term and the lowest-ranked live rank succeeds
+deterministically, re-targets the in-flight ``mbJ``/``mbQ`` retry
+loops (they address ``self.coord``, which succession updates), and
+issues the old holder's death plan itself; a stale ex-coordinator
+returning from a partition is fenced by term on every coordinator
+broadcast it attempts. A death the plane CANNOT own — no checkpoint
+anywhere, no live rank left to take the lease, a verdict that never
 arrives within the grace window — stays exactly as loud as before:
 ``PeerFailureError``, exit 42, the gang-restart drill.
 
@@ -80,6 +88,7 @@ from typing import Optional
 
 import numpy as np
 
+from minips_tpu.balance.control_plane import CoordinatorLease
 from minips_tpu.consistency.gate import PeerFailureError, publish_clock
 from minips_tpu.obs import tracer as _trc
 
@@ -223,8 +232,9 @@ def plan_admission(router, joiner: int, *,
 
 class Membership:
     """The membership state machine riding a ShardedPSTrainer — module
-    docstring for the protocol. One instance per process; rank 0 is the
-    coordinator (its death is the documented unrecoverable case)."""
+    docstring for the protocol. One instance per process; rank 0 holds
+    the coordinator LEASE at launch (balance/control_plane.py — on its
+    death the lowest-ranked live rank succeeds deterministically)."""
 
     JOIN_KIND = "mbJ"     # standby -> coordinator: admit me
     ADMIT_KIND = "mbA"    # coordinator broadcast: rank + catch-up clock
@@ -234,6 +244,9 @@ class Membership:
     DEATH_KIND = "mbD"    # coordinator broadcast: verdict (rstep | -1)
     DRAIN_KIND = "mbDr"   # operator -> rank: please drain (the --drain
     #                       control frame; SIGTERM is the other trigger)
+    END_KIND = "mbEnd"    # coordinator broadcast at finalize: no more
+    #                       admissions — un-admitted standbys exit clean
+    #                       instead of timing out against a gone fleet
 
     def __init__(self, trainer, cfg: MembershipConfig):
         self.trainer = trainer
@@ -251,8 +264,17 @@ class Membership:
         live = all_ranks if cfg.live is None else set(cfg.live) & all_ranks
         if self.coord not in live:
             raise ValueError(
-                "MINIPS_ELASTIC: rank 0 (the membership coordinator) "
-                "must be in the initial live set")
+                "MINIPS_ELASTIC: rank 0 (the launch-time coordinator "
+                "lease holder) must be in the initial live set")
+        # the coordinator lease (control_plane.py): rank 0 holds term 0;
+        # a holder death advances the term to the lowest live rank at
+        # every rank identically — no election frames
+        self.lease = CoordinatorLease(self.coord)
+        # autoscaler plumbing (balance/autoscaler.py): with hold_joins
+        # armed, announced standbys queue until a grant_join() credit —
+        # scale-up becomes a load decision instead of an auto-admit
+        self.hold_joins = False
+        self._join_credits = 0
         self._lock = threading.Lock()
         self.live: set[int] = set(live)
         self.standby: set[int] = all_ranks - live
@@ -280,6 +302,12 @@ class Membership:
         # the moment a peer's silence crosses the timeout
         if trainer.monitor is not None:
             trainer.monitor.on_failure = self._on_peer_dead
+            # lease stamps ride every heartbeat: peers max-merge the
+            # term, so a partitioned ex-coordinator learns it lost the
+            # lease from the FIRST beat it hears on return (the self
+            # fence — control_plane.py module docstring)
+            trainer.monitor.payload_extra = self.lease.stamp
+            trainer.monitor.on_beat_extra = self._on_lease_beat
         bus = self.bus
         bus.on(self.JOIN_KIND, self._on_join_req)
         bus.on(self.ADMIT_KIND, self._on_admit)
@@ -287,7 +315,9 @@ class Membership:
         bus.on(self.LEAVE_KIND, self._on_leave_req)
         bus.on(self.GONE_KIND, self._on_gone)
         bus.on(self.DEATH_KIND, self._on_death_verdict)
-        bus.on(self.DRAIN_KIND, lambda _s, _p: self.begin_drain())
+        bus.on(self.DRAIN_KIND, self._on_drain)
+        self._fleet_done = False
+        bus.on(self.END_KIND, self._on_end)
 
     # ------------------------------------------------------------- plumbing
     def bind_checkpoint(self, checkpoint_dir: Optional[str]) -> None:
@@ -300,6 +330,58 @@ class Membership:
     def i_am_standby(self) -> bool:
         with self._lock:
             return self.rank in self.standby
+
+    def live_view(self) -> set[int]:
+        """Snapshot of the live set (the autoscaler's fleet picture)."""
+        with self._lock:
+            return set(self.live)
+
+    def pending_joins(self) -> int:
+        """Announced standbys queued at this (coordinator) rank."""
+        with self._lock:
+            return len(self._pending_joins)
+
+    def grant_join(self) -> None:
+        """Autoscaler hook: release ONE held standby admission — the
+        next ``_coord_step`` boundary pops the queue. A no-op credit
+        (nothing queued) is consumed by the next announce."""
+        with self._lock:
+            self._join_credits += 1
+
+    # ---------------------------------------------------------- the lease
+    def _retarget(self, succ: int) -> None:
+        """Point every coordinator-addressed loop at the new lease
+        holder: ``self.coord`` (the mbJ/mbQ retry loops and the
+        coordinator-only guards read it live) and the rebalancer's rbH
+        destination. Idempotent — verdict, beat-stamp, and plan-stamp
+        observation may all land it."""
+        self.coord = int(succ)
+        self.rb.coord = int(succ)
+        tr = _trc.TRACER
+        if tr is not None:
+            term, holder = self.lease.current()
+            tr.instant("membership", "mb_lease",
+                       {"term": term, "holder": holder})
+
+    def _on_lease_beat(self, sender: int, payload: dict) -> None:
+        """Heartbeat receive hook (monitor thread): max-merge the lease
+        stamp. Learning a newer term here is the partition-return self
+        fence — an ex-holder stops planning the moment it hears the
+        fleet moved on, and every receiver re-targets without waiting
+        for its own death verdict."""
+        if self.lease.observe(payload):
+            self._retarget(self.lease.holder)
+
+    def fence_frame(self, payload: dict) -> bool:
+        """THE receive fence, in one place for every coordinator-
+        originated frame (rbP plans, mbA admits, mbD verdicts, mbEnd,
+        mbDr): max-merge the stamp's term (re-targeting on a newer
+        one), then admit/drop by term. False = stale ex-coordinator
+        frame, counted at the lease — the handler must return without
+        acting."""
+        if self.lease.observe(payload):
+            self._retarget(self.lease.holder)
+        return self.lease.admit(payload)
 
     @property
     def busy(self) -> bool:
@@ -321,7 +403,18 @@ class Membership:
                    "standby": sorted(self.standby),
                    "dead": sorted(self.dead),
                    "left": sorted(self.left),
+                   "coord": self.coord,
+                   "held_joins": len(self._pending_joins)
+                   if self.hold_joins else 0,
                    **self.counters}
+        out["lease"] = self.lease.stats()
+        # the successor's ADDRESS derives from the membership table, not
+        # the spawn-time env: the bus is a full mesh wired at launch, so
+        # succession is a rank-id change (launch.bus_endpoint_of) — the
+        # endpoint here is observability, never renegotiation
+        from minips_tpu.launch import bus_endpoint_of
+
+        out["coord_endpoint"] = bus_endpoint_of(out["coord"])
         out["epoch"] = self.membership_epoch()
         out["blocks_restored"] = sum(
             t.rb_stats["blocks_restored"]
@@ -349,6 +442,7 @@ class Membership:
         owns = any((t.router.owner_of_blocks() == r).any()
                    for t in self.trainer.tables.values())
         free = False
+        succeeded = None
         with self._lock:
             if r in self.dead or r in self.left:
                 return
@@ -364,20 +458,38 @@ class Membership:
             # for the rest of the run
             self._leave_reqs.pop(r, None)
             if r == self.coord:
-                # the coordinator is the planner: nobody can issue the
-                # transition. Documented limit — gang restart.
-                self._unrecoverable.add(r)
-            elif not owns:
-                # nothing routed to it, gated nobody: death is free
-                self._verdicts[r] = 0
-                free = True
-            elif self.rank == self.coord:
-                self._pending_deaths.append(r)
+                # LEASE SUCCESSION (control_plane.py): the verdict plus
+                # the membership table give every rank the same answer —
+                # term += 1, holder = lowest live rank. The successor
+                # plans the old holder's death itself below; only a
+                # fleet with NOBODY left to take the lease stays the
+                # reference's gang-restart case.
+                succ = self.lease.succeed(r, self.live)
+                if succ is None:
+                    self._unrecoverable.add(r)
+                else:
+                    self.coord = succ
+                    self.rb.coord = succ
+                    succeeded = succ
+            if r not in self._unrecoverable:
+                if not owns:
+                    # nothing routed to it, gated nobody: death is free
+                    self._verdicts[r] = 0
+                    free = True
+                elif self.rank == self.coord:
+                    self._pending_deaths.append(r)
+        if succeeded is not None:
+            tr = _trc.TRACER
+            if tr is not None:
+                term, holder = self.lease.current()
+                tr.instant("membership", "mb_lease",
+                           {"term": term, "holder": holder})
         if free and self.rank == self.coord:
             # converge laggards whose tables still route to the corpse
             # (mid-adoption views): rstep 0 = free verdict, no plan
             self.bus.publish(self.DEATH_KIND,
-                             {"rank": int(r), "rstep": 0})
+                             {"rank": int(r), "rstep": 0,
+                              **self.lease.stamp()})
         self.trainer.gossip.exclude(r)
         for t in self.trainer.tables.values():
             t.on_ranks_dead({r})
@@ -386,6 +498,8 @@ class Membership:
             tr.instant("membership", "mb_dead", {"rank": int(r)})
 
     def _on_death_verdict(self, sender: int, payload: dict) -> None:
+        if not self.fence_frame(payload):
+            return  # stale ex-coordinator's verdict: fenced by term
         r, rstep = int(payload.get("rank", -1)), int(
             payload.get("rstep", -1))
         with self._lock:
@@ -455,6 +569,8 @@ class Membership:
                 self._pending_joins.append(r)
 
     def _on_admit(self, sender: int, payload: dict) -> None:
+        if not self.fence_frame(payload):
+            return  # a stale ex-coordinator cannot admit anybody
         if int(payload.get("rank", -1)) == self.rank:
             self._admit_clk = int(payload.get("clk", 0))
 
@@ -478,13 +594,17 @@ class Membership:
         """The standby rank's whole pre-join life: serve (bus threads),
         adopt plans, announce at ``join_at`` (max live clock observed
         via gossip; None = announce immediately), block until admitted.
-        Returns the catch-up clock to train from."""
+        Returns the catch-up clock to train from — or ``-1`` when the
+        fleet FINISHED without admitting me (``mbEnd``): the run ended
+        calm, which is a clean outcome for a standby, not a failure."""
         deadline = time.monotonic() + timeout
         while True:
             self.rb.adopt_now()  # pre-tick: any thread may adopt
             with self._lock:
                 if self._unrecoverable:
                     raise PeerFailureError(set(self._unrecoverable))
+            if self._fleet_done:
+                return -1
             if self._admit_clk is not None:
                 break
             if self._join_due(join_at) \
@@ -528,6 +648,14 @@ class Membership:
         return mx >= int(join_at)
 
     # --------------------------------------------------------------- leave
+    def _on_drain(self, sender: int, payload: dict) -> None:
+        # fenced like every coordinator frame: a partitioned
+        # ex-coordinator's autoscaler must not shrink the fleet it no
+        # longer runs (operator mbDr frames are unstamped and pass)
+        if not self.fence_frame(payload):
+            return
+        self.begin_drain()
+
     def begin_drain(self) -> None:
         """Preemption signal landed (SIGTERM / mbDr / --drain-at): the
         training loop polls ``draining`` and hands over to leave()."""
@@ -571,8 +699,10 @@ class Membership:
         state anywhere — this is a migration, not a failure."""
         if self.rank == self.coord:
             raise RuntimeError(
-                "the membership coordinator (rank 0) cannot drain — "
-                "it is the planner (documented limit; restart instead)")
+                "the coordinator lease holder cannot drain itself — it "
+                "is the planner (documented limit: hand the lease over "
+                "by restarting this rank; the autoscaler never targets "
+                "the holder)")
         tr = self.trainer
         self.rb.claim_drive_thread()  # adoption moves to THIS thread
         for t in tr.tables.values():
@@ -657,14 +787,27 @@ class Membership:
                 r = self._pending_deaths.pop(0)
             self._issue_death(r)
 
+    def _on_end(self, sender: int, payload: dict) -> None:
+        if not self.fence_frame(payload):
+            return
+        self._fleet_done = True
+
     def quiesce(self) -> None:
         """Finalize-time: no further transitions (in-flight migrations
-        settle through the normal fence path)."""
+        settle through the normal fence path). The COORDINATOR also
+        tells any still-waiting standby the fleet is done (``mbEnd``):
+        a run can legitimately end with held admissions (the autoscaler
+        never saw load), and without this the orphaned standby would
+        watch the fleet's heartbeats die one by one and convict the
+        whole world instead of exiting clean."""
         with self._lock:
             self._pending_deaths.clear()
             self._pending_joins.clear()
             self._leave_reqs.clear()
             self._bootstrapped = True
+            standbys_waiting = bool(self.standby)
+        if standbys_waiting and self.rank == self.coord:
+            self.bus.publish(self.END_KIND, {**self.lease.stamp()})
 
     def _next_eps(self) -> dict[str, int]:
         return {name: t.router.epoch + 1
@@ -717,17 +860,24 @@ class Membership:
                                                targets)
                          for name, t in tables.items()})
             return
-        # -------- joins: admit one rank per boundary
+        # -------- joins: admit one rank per boundary. With hold_joins
+        # (the autoscaler armed) an announced standby WAITS in the queue
+        # until a grant_join() credit — scale-up is a load decision
         with self._lock:
-            join = self._pending_joins.pop(0) \
-                if self._pending_joins else None
-            if join is not None and join not in self.standby:
-                join = None  # died (or already admitted) meanwhile
+            join = None
+            if self._pending_joins and (not self.hold_joins
+                                        or self._join_credits > 0):
+                join = self._pending_joins.pop(0)
+                if join not in self.standby:
+                    join = None  # died (or already admitted) meanwhile
+                elif self.hold_joins:
+                    self._join_credits -= 1
         if join is not None:
             # clock first (the joiner trains from it), plans second —
             # both on my one FIFO link, so the joiner sees them in order
             self.bus.publish(self.ADMIT_KIND,
-                             {"rank": join, "clk": self.trainer.clock})
+                             {"rank": join, "clk": self.trainer.clock,
+                              **self.lease.stamp()})
             # heat-aware placement: the admit plan runs the PR4
             # bin-packer over the coordinator's stored heat reports
             # (rbH flows even in elastic-only mode), so the joiner
@@ -762,7 +912,8 @@ class Membership:
                 step = None
         if step is None:
             self.bus.publish(self.DEATH_KIND,
-                             {"rank": int(r), "rstep": -1})
+                             {"rank": int(r), "rstep": -1,
+                              **self.lease.stamp()})
             with self._lock:
                 self._verdicts[r] = -1
                 self._unrecoverable.add(r)
@@ -770,7 +921,8 @@ class Membership:
         targets = self._live_targets()
         extras = {"dead": [int(r)], "rstep": int(step)}
         self.bus.publish(self.DEATH_KIND,
-                         {"rank": int(r), "rstep": int(step)})
+                         {"rank": int(r), "rstep": int(step),
+                          **self.lease.stamp()})
         with self._lock:
             self._verdicts[r] = int(step)
         self._issue({name: plan_evacuation(t.router, {r}, targets)

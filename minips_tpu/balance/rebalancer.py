@@ -163,6 +163,7 @@ class Rebalancer:
         self.n = trainer.num_processes
         self.coord = 0
         self.plans = 0
+        self.stale_plans_fenced = 0  # rbP frames dropped by lease term
         self._stopped = False
         self._drive_thread: Optional[int] = None  # push-driving thread
         self._lock = threading.Lock()
@@ -178,8 +179,28 @@ class Rebalancer:
                         self._mk_on_heat(name))
 
     # ------------------------------------------------------------ handlers
+    def _lease(self):
+        """The coordinator lease when the membership plane is armed
+        (balance/control_plane.py) — plan broadcasts are stamped with
+        its term and stale-term plans fenced at receive; None keeps the
+        pre-lease wire for rebalance-only fleets."""
+        mb = getattr(self.trainer, "membership", None)
+        return mb.lease if mb is not None else None
+
+    def _lease_stamp(self) -> dict:
+        lease = self._lease()
+        return lease.stamp() if lease is not None else {}
+
     def _mk_on_plan(self, name: str):
         def on_plan(sender: int, payload: dict) -> None:
+            mb = getattr(self.trainer, "membership", None)
+            if mb is not None and not mb.fence_frame(payload):
+                # a partitioned ex-coordinator's post-return plan:
+                # fenced by lease term, never adopted — the epoch
+                # check alone cannot save us (the stale holder may
+                # stamp any epoch it likes)
+                self.stale_plans_fenced += 1
+                return
             extras = {k: payload[k] for k in ("dead", "rstep")
                       if k in payload}
             self.note_plan(name, int(payload.get("ep", 0)),
@@ -211,7 +232,8 @@ class Rebalancer:
         every rank). The caller must be at its clock boundary on the
         push-driving thread, like ``_maybe_plan``."""
         payload = {"ep": int(ep), "ovb": [int(b) for b in ov],
-                   "ovo": [int(o) for o in ov.values()]}
+                   "ovo": [int(o) for o in ov.values()],
+                   **self._lease_stamp()}
         if extras:
             payload.update(extras)
         self.bus.publish(f"{self.PLAN_KIND}:{name}", payload)
@@ -321,6 +343,19 @@ class Rebalancer:
         rep = t._heat.report(owned, self.cfg.topk)
         rep["ep"] = ep
         rep["settled"] = t.rebalance_settled()
+        if getattr(self.trainer, "autoscaler", None) is not None:
+            # autoscaler load signals ride the heat report (balance/
+            # autoscaler.py): cumulative serve-plane shed counters plus
+            # the always-on pull p99 — re-gossiped every tick, so a
+            # lease successor's autoscaler reconstructs the fleet load
+            # picture in one boundary with no extra wire
+            if t._sv is not None:
+                rep["sv"] = t._sv.load_signal()
+            from minips_tpu.obs.hist import summarize_counts
+
+            rep["p99"] = summarize_counts(
+                t.timers.snapshot()["hists"]["pull_latency"]).get(
+                    "p99_ms")
         if self.rank == self.coord:
             with self._lock:
                 self._reports.setdefault(name, {})[self.rank] = rep
@@ -394,7 +429,8 @@ class Rebalancer:
         self.bus.publish(f"{self.PLAN_KIND}:{name}",
                          {"ep": new_ep,
                           "ovb": [int(b) for b in new_ov],
-                          "ovo": [int(o) for o in new_ov.values()]})
+                          "ovo": [int(o) for o in new_ov.values()],
+                          **self._lease_stamp()})
         self.plans += 1
         self._last_plan[name] = now
         # the coordinator is at its own clock boundary right now: adopt
@@ -404,7 +440,8 @@ class Rebalancer:
 
     # ------------------------------------------------------------- metrics
     def stats(self) -> dict:
-        out = {"plans": self.plans}
+        out = {"plans": self.plans,
+               "stale_plans_fenced": self.stale_plans_fenced}
         per = {}
         for name, t in self.trainer.tables.items():
             per[name] = t.rebalance_table_stats()

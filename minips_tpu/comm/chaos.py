@@ -330,31 +330,43 @@ class KillSpec:
     """Parsed ``MINIPS_CHAOS_KILL`` — seeded deterministic process death,
     the launcher-level sibling of the frame-level injector above. The
     launcher exports the spec to every rank (env inheritance, same as
-    ``MINIPS_CHAOS``); the matching rank SIGKILLs ITSELF at the chosen
+    ``MINIPS_CHAOS``); each matching rank SIGKILLs ITSELF at its chosen
     clock boundary — abrupt as an OOM kill (no atexit, no flush, no
     close), reproducible bit-for-bit because the trigger is a clock
     value, not wall time.
 
     Grammar::
 
-        <seed>:rank=<r>,step=<s>
+        <seed>:rank=<r>,step=<s>[,rank=<r2>,step=<s2>,...]
 
-    ``rank=-1`` picks a seeded-uniform victim among ranks 1..n-1 (rank 0
-    is the membership coordinator — killing it is the gang-restart
-    drill, not this one); ``step=<a>-<b>`` picks a seeded-uniform step
-    in ``[a, b]``. Fixed values make the seed inert but keep the spec
+    Each ``rank=`` opens a kill ENTRY and the ``step=`` that follows
+    binds to it, so one spec can schedule several deaths (a coordinator
+    kill composed with a server kill, the double-fault drill).
+    ``rank=0`` is a legal target: since the coordinator became a LEASE
+    (balance/control_plane.py) its death is a drill the plane owns, not
+    an automatic gang restart — the failover drills aim the seeded kill
+    at the holder on purpose. ``rank=-1`` still picks a seeded-uniform
+    victim among ranks 1..n-1 (the pre-lease server-death drills keep
+    their schedules); ``step=<a>-<b>`` picks a seeded-uniform step in
+    ``[a, b]``. Fixed values make the seed inert but keep the spec
     shape aligned with ``MINIPS_CHAOS``.
     """
 
-    def __init__(self, seed: int, rank: int, step_lo: int, step_hi: int):
-        if step_lo < 1 or step_hi < step_lo:
-            raise ValueError("chaos-kill step must be >= 1 (clock "
-                             "boundaries start at 1) with a non-empty "
-                             "range")
+    def __init__(self, seed: int, entries: list[tuple[int, int, int]]):
+        if not entries:
+            raise ValueError(
+                "MINIPS_CHAOS_KILL needs both rank= and step=")
+        for _rank, lo, hi in entries:
+            if lo < 1 or hi < lo:
+                raise ValueError("chaos-kill step must be >= 1 (clock "
+                                 "boundaries start at 1) with a "
+                                 "non-empty range")
         self.seed = int(seed)
-        self.rank = int(rank)
-        self.step_lo = int(step_lo)
-        self.step_hi = int(step_hi)
+        self.entries = [(int(r), int(lo), int(hi))
+                        for r, lo, hi in entries]
+        # first-entry views: the single-kill call sites and specs
+        # predate the entry list and keep reading these
+        self.rank, self.step_lo, self.step_hi = self.entries[0]
 
     @classmethod
     def parse(cls, spec: str) -> "KillSpec":
@@ -366,58 +378,83 @@ class KillSpec:
             raise ValueError(
                 f"MINIPS_CHAOS_KILL must start with '<int seed>:', "
                 f"got {spec!r}")
-        rank: Optional[int] = None
-        step: Optional[str] = None
+        entries: list[tuple[int, int, int]] = []
+        cur: Optional[list] = None  # [rank, lo, hi] being assembled
         for entry in filter(None, (e.strip() for e in body.split(","))):
             knob, _, val = entry.partition("=")
             if knob == "rank":
-                rank = int(val)
+                if cur is not None:
+                    if cur[1] is None:
+                        raise ValueError(
+                            "MINIPS_CHAOS_KILL needs both rank= and "
+                            "step= (entry opened without a step)")
+                    entries.append(tuple(cur))
+                cur = [int(val), None, None]
             elif knob == "step":
-                step = val
+                if cur is None:
+                    raise ValueError(
+                        "MINIPS_CHAOS_KILL needs both rank= and step= "
+                        "(step= before any rank=)")
+                lo, _, hi = val.partition("-")
+                cur[1], cur[2] = int(lo), int(hi) if hi else int(lo)
             else:
                 raise ValueError(
                     f"MINIPS_CHAOS_KILL: unknown knob {knob!r} "
                     "(expected rank=, step=)")
-        if rank is None or step is None:
+        if cur is None or cur[1] is None:
             raise ValueError(
                 "MINIPS_CHAOS_KILL needs both rank= and step=")
-        lo, _, hi = step.partition("-")
-        return cls(seed, rank, int(lo), int(hi) if hi else int(lo))
+        entries.append(tuple(cur))
+        return cls(seed, entries)
 
     def resolve(self, nprocs: int) -> tuple[int, int]:
-        """The concrete ``(victim rank, kill clock)`` for an
-        ``nprocs``-rank job — a pure function of (seed, nprocs), so
-        every rank computes the same verdict without coordination."""
+        """The FIRST entry's concrete ``(victim rank, kill clock)`` —
+        the pre-list surface single-kill drills assert against."""
+        return self.resolve_all(nprocs)[0]
+
+    def resolve_all(self, nprocs: int) -> list[tuple[int, int]]:
+        """Every entry's ``(victim rank, kill clock)`` for an
+        ``nprocs``-rank job — a pure function of (seed, nprocs, entry
+        index), so every rank computes the same schedule without
+        coordination. Entry 0 draws from the exact pre-list stream
+        (same rng key), keeping committed seeded drills' verdicts."""
         import numpy as np
 
-        rng = np.random.default_rng((self.seed, 0x6b11, nprocs))
-        rank = self.rank
-        if rank == -1:
-            rank = int(rng.integers(1, max(nprocs, 2)))
-        step = self.step_lo
-        if self.step_hi > self.step_lo:
-            step = int(rng.integers(self.step_lo, self.step_hi + 1))
-        return rank, step
+        out = []
+        for i, (rank, lo, hi) in enumerate(self.entries):
+            key = (self.seed, 0x6b11, nprocs) if i == 0 \
+                else (self.seed, 0x6b11, nprocs, i)
+            rng = np.random.default_rng(key)
+            if rank == -1:
+                rank = int(rng.integers(1, max(nprocs, 2)))
+            step = lo
+            if hi > lo:
+                step = int(rng.integers(lo, hi + 1))
+            out.append((rank, step))
+        return out
 
 
 def install_chaos_kill(rank: int, nprocs: int):
-    """Arm the seeded kill for this process from ``$MINIPS_CHAOS_KILL``:
-    returns ``check(clock)`` to call at every clock boundary (the
-    trainer's tick does), or None when unarmed or aimed elsewhere. The
-    kill is ``SIGKILL`` to self — delivered mid-step, before the clock
-    frame goes out, so the corpse's last completed clock is ``step-1``
-    exactly like a machine loss between two ticks."""
+    """Arm the seeded kill(s) for this process from
+    ``$MINIPS_CHAOS_KILL``: returns ``check(clock)`` to call at every
+    clock boundary (the trainer's tick does), or None when unarmed or
+    every entry is aimed elsewhere. The kill is ``SIGKILL`` to self —
+    delivered mid-step, before the clock frame goes out, so the
+    corpse's last completed clock is ``step-1`` exactly like a machine
+    loss between two ticks."""
     import os
     import signal
 
     spec = os.environ.get("MINIPS_CHAOS_KILL", "").strip()
     if not spec:
         return None
-    victim, kill_step = KillSpec.parse(spec).resolve(nprocs)
-    if victim != rank:
+    kill_steps = {step for victim, step
+                  in KillSpec.parse(spec).resolve_all(nprocs)
+                  if victim == rank}
+    if not kill_steps:
         return None
 
     def check(clock: int) -> None:
-        if clock == kill_step:
+        if clock in kill_steps:
             os.kill(os.getpid(), signal.SIGKILL)
     return check

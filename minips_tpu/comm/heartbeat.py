@@ -20,25 +20,21 @@ from minips_tpu.comm.bus import ControlBus
 from minips_tpu.obs import tracer as _trc
 
 
-def liveness_knobs(interval: float,
-                   timeout: float) -> tuple[float, float]:
-    """Resolve the heartbeat liveness knobs against
-    ``$MINIPS_HEARTBEAT`` — ``"interval=0.1,timeout=0.8"``, either knob
-    optional, empty string (or unset, or ``"1"``) meaning the caller's
-    defaults — the same explicit-empty convention as ``MINIPS_BUS`` /
-    ``MINIPS_SHM_RING``. Exists so the death drills can run CI-fast
-    detection timeouts (and production can run lazier ones) without
-    patching every app's hardcoded monitor numbers."""
+def _parse_heartbeat_spec() -> dict[str, float]:
+    """``$MINIPS_HEARTBEAT`` as a knob dict — empty (or ``"1"``) means
+    every caller default, unknown knobs and non-positive values refuse
+    loudly (the shared env-spec hygiene)."""
     spec = os.environ.get("MINIPS_HEARTBEAT", "").strip()
+    out: dict[str, float] = {}
     if not spec or spec in ("1", "on", "true"):
-        return interval, timeout
+        return out
     for entry in filter(None, (e.strip() for e in spec.split(","))):
         if "=" not in entry:
             raise ValueError(
                 f"MINIPS_HEARTBEAT: expected k=v, got {entry!r}")
         k, _, v = entry.partition("=")
         k = k.strip()
-        if k not in ("interval", "timeout"):
+        if k not in ("interval", "timeout", "stall"):
             raise ValueError(f"MINIPS_HEARTBEAT: unknown knob {k!r}")
         try:
             val = float(v)
@@ -47,15 +43,38 @@ def liveness_knobs(interval: float,
                 f"MINIPS_HEARTBEAT: bad value for {k}: {v!r}") from e
         if val <= 0:
             raise ValueError(f"MINIPS_HEARTBEAT: {k} must be > 0")
-        if k == "interval":
-            interval = val
-        else:
-            timeout = val
+        out[k] = val
+    return out
+
+
+def liveness_knobs(interval: float,
+                   timeout: float) -> tuple[float, float]:
+    """Resolve the heartbeat liveness knobs against
+    ``$MINIPS_HEARTBEAT`` — ``"interval=0.1,timeout=0.8"``, either knob
+    optional, empty string (or unset, or ``"1"``) meaning the caller's
+    defaults — the same explicit-empty convention as ``MINIPS_BUS`` /
+    ``MINIPS_SHM_RING``. Exists so the death drills can run CI-fast
+    detection timeouts (and production can run lazier ones) without
+    patching every app's hardcoded monitor numbers. The third knob,
+    ``stall=`` (observer-stall forgiveness, seconds), is resolved by
+    :func:`stall_knob` — it shapes the SWEEP, not the liveness pair."""
+    kn = _parse_heartbeat_spec()
+    interval = kn.get("interval", interval)
+    timeout = kn.get("timeout", timeout)
     if timeout <= interval:
         raise ValueError(
             f"MINIPS_HEARTBEAT: timeout {timeout} must exceed the "
             f"interval {interval} (a beat must be able to land)")
     return interval, timeout
+
+
+def stall_knob(default: float = 0.0) -> float:
+    """The ``stall=`` knob of ``$MINIPS_HEARTBEAT`` (0 = off): the
+    observer-stall forgiveness window in seconds — see
+    ``HeartbeatMonitor.check``. Off by default: forgiveness trades
+    detection latency after a stall for immunity to the oversubscribed-
+    host false positive, and that trade is the operator's."""
+    return _parse_heartbeat_spec().get("stall", default)
 
 
 class HeartbeatMonitor:
@@ -71,6 +90,23 @@ class HeartbeatMonitor:
         self.interval = interval
         self.timeout = timeout
         self.on_failure = on_failure
+        # control-plane piggyback (balance/control_plane.py): the lease
+        # stamp provider merged into every outgoing beat, and the
+        # receive hook peers observe terms through — heartbeats are the
+        # one channel guaranteed to keep flowing around a partition's
+        # edge, which is exactly when the lease fence matters
+        self.payload_extra: Optional[Callable[[], dict]] = None
+        self.on_beat_extra: Optional[Callable[[int, dict], None]] = None
+        self.stall = stall_knob()
+        if self.stall and self.stall <= self.interval:
+            # a stall budget at or below the sweep cadence would make
+            # EVERY monitor-thread sweep "forgive" and re-baseline —
+            # death detection silently disabled. Refuse as loudly as
+            # timeout <= interval above.
+            raise ValueError(
+                f"MINIPS_HEARTBEAT: stall {self.stall} must exceed the "
+                f"interval {self.interval} (every sweep would forgive)")
+        self._last_sweep: Optional[float] = None
         self._clock = clock
         now = clock()
         self._last_seen = {p: now for p in peer_ids if p != bus.my_id}
@@ -93,12 +129,33 @@ class HeartbeatMonitor:
         with self._lock:
             if sender in self._last_seen:
                 self._last_seen[sender] = self._clock()
+        hook = self.on_beat_extra
+        if hook is not None:
+            hook(sender, payload)
 
     def check(self) -> set[int]:
-        """Sweep for newly-dead peers; fires on_failure once per peer."""
+        """Sweep for newly-dead peers; fires on_failure once per peer.
+
+        With ``stall=`` armed (MINIPS_HEARTBEAT): a sweep arriving more
+        than ``stall`` seconds after the previous one means THIS
+        process was descheduled — on an oversubscribed host (the
+        1-core CI box running 4-rank failover drills) a whole idle
+        process can starve for seconds while its peers' beats sit
+        undrained in the receive queue. An observer that was in a coma
+        cannot date anyone else's silence, so it re-baselines every
+        live peer instead of convicting them (a genuinely dead peer is
+        re-detected one timeout after we wake — the honest earliest
+        date). Off by default: existing fleets keep exact semantics."""
         newly_dead = []
         with self._lock:
             now = self._clock()
+            last, self._last_sweep = self._last_sweep, now
+            if self.stall > 0 and last is not None \
+                    and now - last > self.stall:
+                for p in self._last_seen:
+                    if p not in self._dead:
+                        self._last_seen[p] = now
+                return set(self._dead)
             for p, seen in self._last_seen.items():
                 if p not in self._dead and now - seen > self.timeout:
                     self._dead.add(p)
@@ -111,7 +168,11 @@ class HeartbeatMonitor:
     def start(self) -> "HeartbeatMonitor":
         def loop() -> None:
             while not self._stop.wait(self.interval):
-                self.bus.publish("heartbeat", {"t": self._clock()})
+                payload = {"t": self._clock()}
+                extra = self.payload_extra
+                if extra is not None:
+                    payload.update(extra())
+                self.bus.publish("heartbeat", payload)
                 self.check()
 
         self._thread = threading.Thread(target=loop, daemon=True)

@@ -81,6 +81,17 @@ class StalenessGate:
         # gate recomputes over the shrunken membership) and is NOT
         # fatal here — only unrecoverable deaths still raise
         self.membership = None
+        # optional per-iteration hook run while BLOCKED (the sharded
+        # trainer wires plan adoption + coordinator death-transition
+        # polling here): the gate runs on the push-driving thread, and
+        # a plan that lands while this rank is gate-blocked must still
+        # be adopted — a peer whose pull is epoch-parked against our
+        # un-adopted table may be the very rank whose clock this gate
+        # is waiting on (the gate-block/epoch-park deadlock the
+        # control-plane failover drill exposed: the successor's death
+        # plan arrived at a rank already inside its gate wait, two
+        # clocks ahead of the paced successor)
+        self.poll_hook = None
         self.gate_waits = 0      # times the gate actually blocked
         self.max_skew_seen = 0   # max (my_clock - global_min) observed
 
@@ -111,6 +122,8 @@ class StalenessGate:
         try:
             while not self.gossip.wait_global_min(
                     threshold, timeout=min(1.0, self.timeout)):
+                if self.poll_hook is not None:
+                    self.poll_hook()
                 dead = set(self.monitor.check()
                            if self.monitor is not None else ())
                 if dead and self.membership is not None:

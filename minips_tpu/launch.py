@@ -108,6 +108,31 @@ def bus_addresses(hosts: list[str], base_port: int) -> list[str]:
     return addrs
 
 
+def bus_endpoint_of(rank: int,
+                    addrs: Optional[list[str]] = None) -> Optional[str]:
+    """The control-bus endpoint of ``rank`` as the launcher advertised
+    it (``MINIPS_BUS_ADDRS``) — how a running rank turns a
+    membership-table successor into an ADDRESS without respawn.
+
+    The coordinator-succession audit this encodes: the bus is a FULL
+    MESH wired at spawn (every rank binds its own slot and connects to
+    all peers from the same env list), so the coordinator role was
+    never an endpoint — it is a rank id, and lease succession
+    (balance/control_plane.py) changes only that id. Nothing about the
+    port plumbing needs renegotiating mid-run. The one genuinely
+    rank-0-pinned address, ``MINIPS_COORDINATOR``, is
+    ``jax.distributed``'s spawn-time rendezvous and is consumed exactly
+    once at startup — a dead rank 0 after initialization does not
+    invalidate it. Returns None outside a launched job (or for a rank
+    beyond the address space)."""
+    if addrs is None:
+        addrs = [a for a in os.environ.get("MINIPS_BUS_ADDRS",
+                                           "").split(",") if a]
+    if 0 <= int(rank) < len(addrs):
+        return addrs[int(rank)]
+    return None
+
+
 def child_env(rank: int, hosts: list[str], base_port: int) -> dict[str, str]:
     env = dict(os.environ)
     env["MINIPS_PROC_ID"] = str(rank)

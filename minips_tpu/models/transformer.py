@@ -682,7 +682,7 @@ def nll_chunked(h, tok_emb, targets, chunk, compute_dtype=jnp.bfloat16):
     vma = (getattr(jaxcompat.typeof(h), "vma", frozenset())
            | getattr(jaxcompat.typeof(targets), "vma", frozenset()))
     if vma:
-        acc0 = jax.lax.pcast(acc0, tuple(sorted(vma)), to="varying")
+        acc0 = jaxcompat.pcast(acc0, tuple(sorted(vma)), to="varying")
     total, _ = jax.lax.scan(body, acc0, (hs, ts))
     return total / (B * T)
 

@@ -62,7 +62,7 @@ def _pcast_varying(x, axes):
     over (pcast rejects varying→varying)."""
     have = getattr(jaxcompat.typeof(x), "vma", frozenset())
     need = tuple(a for a in axes if a not in have)
-    return jax.lax.pcast(x, need, to="varying") if need else x
+    return jaxcompat.pcast(x, need, to="varying") if need else x
 
 
 def gqa_group_size(num_q_heads: int, num_kv_heads: int) -> int:

@@ -24,6 +24,7 @@ from typing import Callable
 import jax
 
 import jax.numpy as jnp
+from minips_tpu.utils import jaxcompat
 from minips_tpu.utils.jaxcompat import axis_size as _axis_size
 
 
@@ -49,10 +50,10 @@ def gpipe(
     perm = [(i, (i + 1) % k) for i in range(k)]
     # fresh zeros are axis-invariant; the scan carry becomes varying after
     # one tick, so pre-cast both (shard_map VMA tracking)
-    out0 = jax.lax.pcast(jnp.zeros_like(x_microbatches), axis_name,
-                         to="varying")
-    buf0 = jax.lax.pcast(jnp.zeros_like(x_microbatches[0]), axis_name,
-                         to="varying")
+    out0 = jaxcompat.pcast(jnp.zeros_like(x_microbatches), axis_name,
+                           to="varying")
+    buf0 = jaxcompat.pcast(jnp.zeros_like(x_microbatches[0]), axis_name,
+                           to="varying")
 
     def tick(carry, t):
         buf_in, outputs = carry

@@ -132,11 +132,11 @@ def ring_attention_local(
     o = jnp.zeros(q.shape, jnp.float32)
     # fresh arrays are axis-invariant; mark them varying over the ring axis
     # so the fori_loop carry type stays fixed (shard_map VMA tracking)
-    m = jax.lax.pcast(jnp.full((B, Tq, H), _NEG_INF, jnp.float32),
-                      axis_name, to="varying")
-    l = jax.lax.pcast(jnp.zeros((B, Tq, H), jnp.float32),
-                      axis_name, to="varying")
-    o = jax.lax.pcast(o, axis_name, to="varying")
+    m = jaxcompat.pcast(jnp.full((B, Tq, H), _NEG_INF, jnp.float32),
+                        axis_name, to="varying")
+    l = jaxcompat.pcast(jnp.zeros((B, Tq, H), jnp.float32),
+                        axis_name, to="varying")
+    o = jaxcompat.pcast(o, axis_name, to="varying")
 
     o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
 

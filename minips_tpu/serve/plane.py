@@ -1016,6 +1016,21 @@ class TableServeState:
             self._maps.clear()
             self._merged = {}
 
+    def load_signal(self) -> dict:
+        """The autoscaler's per-rank load export (balance/autoscaler.py):
+        CUMULATIVE admission-pressure counters, shipped to the lease
+        holder inside the rbH heat report every clock. Cumulative on
+        purpose — the reader diffs consecutive observations, so a
+        report tick lost to scheduling never loses a shed; and sheds
+        (not raw request counts) are the signal because a shed is the
+        admission layer itself saying this owner is past capacity."""
+        with self._cnt_lock:
+            c = self.counters
+            return {"shed": int(c["shed_redirects"] + c["shed_partial"]
+                                + c["backpressure"]),
+                    "bp": int(c["backpressure"]),
+                    "redirects": int(c["shed_redirects"])}
+
     def stats(self) -> dict:
         with self._cnt_lock:
             out = dict(self.counters)

@@ -354,8 +354,8 @@ class DenseTable:
             loss0, g0 = jnp.zeros((), jnp.float32), jnp.zeros(n)
             need = tuple(sorted(vma))
             if need:
-                loss0 = jax.lax.pcast(loss0, need, to="varying")
-                g0 = jax.lax.pcast(g0, need, to="varying")
+                loss0 = jaxcompat.pcast(loss0, need, to="varying")
+                g0 = jaxcompat.pcast(g0, need, to="varying")
             (loss_sum, gsum), _ = jax.lax.scan(fold, (loss0, g0), micro)
             if reduce == "sum":
                 # sum-semantics grad_fns: microbatch sums add up to the

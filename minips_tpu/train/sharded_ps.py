@@ -3727,6 +3727,7 @@ class ShardedPSTrainer:
                  rebalance: Optional[str] = None,
                  serve: Optional[str] = None,
                  elastic: Optional[str] = None,
+                 autoscale: Optional[str] = None,
                  plane: Optional[str] = None):
         # data-plane selection at the same altitude as the bus backends
         # (train/mesh_plane.resolve_plane: explicit wins, else
@@ -3823,12 +3824,50 @@ class ShardedPSTrainer:
             self.gate.membership = self.membership
             for t in tables.values():
                 t.attach_membership(self.membership)
+        # closed-loop autoscaler (balance/autoscaler.py): OFF by
+        # default — a decision loop on the coordinator lease holder
+        # that watches serve-plane shed counters / SERVE-SLO p99 /
+        # heat imbalance off the rbH wire and drives mbJ admits + mbDr
+        # drains with hysteresis. Rides the membership plane.
+        aspec = autoscale if autoscale is not None \
+            else os.environ.get("MINIPS_AUTOSCALE", "")
+        self.autoscaler = None
+        if aspec and aspec != "0":
+            if self.membership is None:
+                raise ValueError(
+                    "MINIPS_AUTOSCALE drives elastic membership "
+                    "transitions — arm MINIPS_ELASTIC too (the "
+                    "autoscaler has nothing to scale without it)")
+            from minips_tpu.balance.autoscaler import (AutoscaleConfig,
+                                                       Autoscaler)
+
+            self.autoscaler = Autoscaler(
+                self, self.membership, AutoscaleConfig.parse(aspec))
+        if self.rebalancer is not None:
+            # adopt plans (and, at the coordinator, issue pending death
+            # transitions) while GATE-blocked too, not just while
+            # pull-blocked: the gate runs on the push-driving thread at
+            # the clock boundary (post-drain), so adoption here is the
+            # same fence point as the next tick's — and without it a
+            # rank gate-blocked on a lagging peer can deadlock against
+            # that peer's epoch-parked pull (gate.py poll_hook note)
+            self.gate.poll_hook = self._gate_poll
         # seeded process-death injection (comm/chaos.py,
         # $MINIPS_CHAOS_KILL): armed per-rank, checked at every tick —
         # the launcher-level kill drill every sharded app inherits
         from minips_tpu.comm.chaos import install_chaos_kill
 
         self._kill_check = install_chaos_kill(bus.my_id, num_processes)
+
+    def _gate_poll(self) -> None:
+        """Gate-wait poll (StalenessGate.poll_hook): the adoption and
+        death-transition work the pull-wait loops already do, run from
+        inside a blocked gate so a plan landing mid-wait is adopted on
+        the push-driving thread instead of waiting for a tick that may
+        never come."""
+        if self.membership is not None:
+            self.membership.poll()
+        self.rebalancer.adopt_now()
 
     def admit_pull(self, clk: int) -> bool:
         """Reference ``model->Get`` admission: serve a pull stamped with
@@ -3909,6 +3948,11 @@ class ShardedPSTrainer:
             # boundaries — the compressed wire's half of the SSP story
             t.residual_flush(aged_only=True)
             t.check_fatal()                 # …and this raises, no hang
+        if self.autoscaler is not None:
+            # BEFORE the membership queues run: an admit credit granted
+            # here is consumed by membership.on_tick at this same
+            # boundary on the lease holder (non-holders no-op)
+            self.autoscaler.on_tick()
         if self.membership is not None:
             # BEFORE the rebalancer's adoption point: a transition plan
             # issued here is adopted in this same tick at the
@@ -4142,10 +4186,20 @@ class ShardedPSTrainer:
 
     def membership_stats(self) -> Optional[dict]:
         """Elastic-membership counters (balance/membership.py): the
-        live/standby/dead/left sets, transition counts, and restored
-        blocks — None when MINIPS_ELASTIC is off (off vs idle)."""
+        live/standby/dead/left sets, the coordinator lease (term,
+        holder, successions, fenced frames), transition counts, and
+        restored blocks — None when MINIPS_ELASTIC is off (off vs
+        idle)."""
         return (self.membership.stats()
                 if self.membership is not None else None)
+
+    def autoscale_stats(self) -> Optional[dict]:
+        """Closed-loop autoscaler counters (balance/autoscaler.py):
+        admits/drains, hot/calm tick streaks, pre/post-admit shed
+        rates, p99 watermarks — None when MINIPS_AUTOSCALE is off
+        (off vs idle)."""
+        return (self.autoscaler.stats()
+                if self.autoscaler is not None else None)
 
     def ef_stats(self) -> Optional[dict]:
         """Merged error-feedback residual counters over all tables —

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["shard_map", "typeof", "axis_size"]
+__all__ = ["shard_map", "typeof", "axis_size", "pcast"]
 
 _NEW_SHARD_MAP = getattr(jax, "shard_map", None)
 
@@ -45,6 +45,30 @@ def axis_size(axis_name):
     if sz is not None:
         return sz(axis_name)
     return jax.lax.psum(1, axis_name)
+
+
+def pcast(x, axis_name, *, to="varying"):
+    """``jax.lax.pcast`` where it exists (the varying-manual-axes
+    surface); ``jax.lax.pvary`` on releases that grew the varying cast
+    under that name; IDENTITY on pre-vma jax. The identity fallback is
+    semantically exact, not a approximation: the cast exists only to
+    satisfy the newer tracer's varying-axis type discipline (scan/
+    fori_loop carries must enter with their post-fold type) — the old
+    ``check_rep`` tracer has no varying-axis type to cast, so there is
+    nothing to do. Only ``to="varying"`` is routed here (the one
+    direction this codebase uses); an invariant-cast caller should go
+    through ``jax.lax.pcast`` directly and quarantine, because dropping
+    THAT direction silently would change psum semantics."""
+    if to != "varying":
+        raise ValueError(
+            "jaxcompat.pcast shims only to='varying' — see docstring")
+    pc = getattr(jax.lax, "pcast", None)
+    if pc is not None:
+        return pc(x, axis_name, to=to)
+    pv = getattr(jax.lax, "pvary", None)
+    if pv is not None:
+        return pv(x, axis_name)
+    return x
 
 
 def typeof(x):

@@ -109,4 +109,10 @@ def wire_record(trainer) -> dict:
         # worker's standalone record has no trainer behind it)
         "membership": getattr(trainer, "membership_stats",
                               lambda: None)(),
+        # closed-loop autoscaler (balance/autoscaler.py): None when
+        # MINIPS_AUTOSCALE is off; armed runs carry admit/drain counts,
+        # hysteresis streaks, and the pre/post-admit shed rates the
+        # CTRL-SCALE tripwire gates
+        "autoscale": getattr(trainer, "autoscale_stats",
+                             lambda: None)(),
     }

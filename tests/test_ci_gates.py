@@ -15,6 +15,7 @@ sys.path.insert(0, str(REPO / "ci"))
 
 from bench_regression import (backend_mismatch, cache_tripwires,  # noqa: E402
                               chaos_tripwires, compare,
+                              control_plane_tripwires,
                               elastic_tripwires, main,
                               mesh_tripwires, rebalance_tripwires,
                               serve_tripwires, shape_mismatch,
@@ -486,6 +487,93 @@ def test_elastic_join_trips_on_dead_or_idle_joiner():
     probs = elastic_tripwires(_elastic_art(
         _GOOD_KILL, {**_GOOD_JOIN, "joiner_serve_rows": 0}))
     assert len(probs) == 1 and "served 0 rows" in probs[0]
+
+
+def _ctrl_art(kill: dict, storm: dict, steady=None) -> dict:
+    return {"control_plane_3proc": {
+        "steady": ({"completed": True, "joins": 0, "leaves": 0,
+                    "admits": 0, "drains": 0}
+                   if steady is None else steady),
+        "kill": kill, "storm": storm}}
+
+
+_GOOD_CTRL_KILL = {"completed": True, "lease_term": 1,
+                   "terms_agree": True, "clock_min": 40, "iters": 40,
+                   "blocks_restored": 7, "wire_frames_lost": 0,
+                   "finals_agree": True}
+_GOOD_CTRL_STORM = {"completed": True, "admits": 1, "drains": 1,
+                    "shed_rate_pre": 12.5, "shed_rate_post": 3.0}
+
+
+def test_control_plane_tripwires_pass_on_healthy_arms():
+    assert control_plane_tripwires(
+        _ctrl_art(_GOOD_CTRL_KILL, _GOOD_CTRL_STORM)) == []
+    # absent sweep (other benches): vacuous
+    assert control_plane_tripwires({}) == []
+    # post == pre is the boundary: at-or-below passes
+    assert control_plane_tripwires(_ctrl_art(
+        _GOOD_CTRL_KILL,
+        {**_GOOD_CTRL_STORM, "shed_rate_post": 12.5})) == []
+
+
+def test_ctrl_failover_trips_on_each_failure_mode():
+    # survivors died under the successor
+    probs = control_plane_tripwires(_ctrl_art(
+        {"completed": False, "error": "x"}, _GOOD_CTRL_STORM))
+    assert len(probs) == 1 and "CTRL-FAILOVER" in probs[0]
+    # lease never advanced (succession silently disabled)...
+    probs = control_plane_tripwires(_ctrl_art(
+        {**_GOOD_CTRL_KILL, "lease_term": 0}, _GOOD_CTRL_STORM))
+    assert any("exactly once" in p for p in probs)
+    # ...or advanced twice (flapped), or survivors disagree on the term
+    probs = control_plane_tripwires(_ctrl_art(
+        {**_GOOD_CTRL_KILL, "lease_term": 2}, _GOOD_CTRL_STORM))
+    assert any("exactly once" in p for p in probs)
+    probs = control_plane_tripwires(_ctrl_art(
+        {**_GOOD_CTRL_KILL, "terms_agree": False}, _GOOD_CTRL_STORM))
+    assert any("exactly once" in p for p in probs)
+    # a lost step across the failover
+    probs = control_plane_tripwires(_ctrl_art(
+        {**_GOOD_CTRL_KILL, "clock_min": 38}, _GOOD_CTRL_STORM))
+    assert any("steps were lost" in p for p in probs)
+    # nothing restored: the successor never planned the old holder out
+    probs = control_plane_tripwires(_ctrl_art(
+        {**_GOOD_CTRL_KILL, "blocks_restored": 0}, _GOOD_CTRL_STORM))
+    assert any("death plan" in p for p in probs)
+    # leaked loss / torn finals
+    probs = control_plane_tripwires(_ctrl_art(
+        {**_GOOD_CTRL_KILL, "wire_frames_lost": 2}, _GOOD_CTRL_STORM))
+    assert any("unrecovered" in p for p in probs)
+    probs = control_plane_tripwires(_ctrl_art(
+        {**_GOOD_CTRL_KILL, "finals_agree": False}, _GOOD_CTRL_STORM))
+    assert any("disagree" in p for p in probs)
+
+
+def test_ctrl_scale_trips_on_dead_loop_or_unmoved_sheds():
+    # the storm arm died
+    probs = control_plane_tripwires(_ctrl_art(
+        _GOOD_CTRL_KILL, {"completed": False, "error": "x"}))
+    assert len(probs) == 1 and "CTRL-SCALE" in probs[0]
+    # no admit / no drain: the loop never closed
+    probs = control_plane_tripwires(_ctrl_art(
+        _GOOD_CTRL_KILL, {**_GOOD_CTRL_STORM, "admits": 0}))
+    assert any("0 autoscaler admits" in p for p in probs)
+    probs = control_plane_tripwires(_ctrl_art(
+        _GOOD_CTRL_KILL, {**_GOOD_CTRL_STORM, "drains": 0}))
+    assert any("0 autoscaler drains" in p for p in probs)
+    # admit without recorded load, or sheds that never fell
+    probs = control_plane_tripwires(_ctrl_art(
+        _GOOD_CTRL_KILL, {**_GOOD_CTRL_STORM, "shed_rate_pre": None}))
+    assert any("without recorded shed load" in p for p in probs)
+    probs = control_plane_tripwires(_ctrl_art(
+        _GOOD_CTRL_KILL, {**_GOOD_CTRL_STORM, "shed_rate_post": 20.0}))
+    assert any("did not fall" in p for p in probs)
+    # a calm armed fleet that flapped membership
+    probs = control_plane_tripwires(_ctrl_art(
+        _GOOD_CTRL_KILL, _GOOD_CTRL_STORM,
+        steady={"completed": True, "joins": 1, "leaves": 0,
+                "admits": 1, "drains": 0}))
+    assert any("flapping without load" in p for p in probs)
 
 
 @pytest.mark.slow

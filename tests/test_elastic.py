@@ -331,6 +331,76 @@ def test_find_live_step_newest_complete_current_partition(tmp_path):
         is None
 
 
+# --- the successor path's edge cases (control-plane PR): a coordinator
+# lease successor calls find_live_step with required = live ∪ {corpse}
+# and must get a DETERMINISTIC verdict — a step, or None — never a hang
+# and never a torn pick.
+def test_find_live_step_zero_complete_steps_is_none_not_hang(tmp_path):
+    """Rank dirs exist but no step is common to every required rank
+    (disjoint saves — e.g. a fleet killed before its first aligned
+    boundary): the verdict is None, the caller's honest rstep=-1
+    gang-restart path, not a scan that spins or picks a torn step."""
+    ck = str(tmp_path)
+    rows = 24
+    _write_step(ck, 0, 5, "w", rows, 3, value_of=lambda g: g)
+    _write_step(ck, 1, 10, "w", rows, 3, value_of=lambda g: g)
+    _write_step(ck, 2, 15, "w", rows, 3, value_of=lambda g: g)
+    t3 = {"w": _FakeTable(rows, 3, 0)}
+    assert elastic.find_live_step(ck, t3, 3) is None
+    # a step dir without its manifest is a torn save-in-progress: it
+    # must not count as held (the crash-mid-save case)
+    os.makedirs(os.path.join(ck, "rank0", "step_0000000010"),
+                exist_ok=True)
+    os.makedirs(os.path.join(ck, "rank2", "step_0000000010"),
+                exist_ok=True)
+    assert elastic.find_live_step(ck, t3, 3) is None
+
+
+def test_find_live_step_partial_corpse_falls_back_to_older(tmp_path):
+    """The newest step is complete on the SURVIVORS but partial on the
+    corpse (it died mid-save: manifest written, table file torn away).
+    The verdict must fall back to the newest step the corpse's files
+    genuinely complete — restoring its blocks from a half-written step
+    would be silent corruption."""
+    ck = str(tmp_path)
+    rows = 24
+    for r in range(3):
+        _write_step(ck, r, 10, "w", rows, 3, value_of=lambda g: g)
+        _write_step(ck, r, 15, "w", rows, 3, value_of=lambda g: g)
+    # the corpse (rank 2) holds step 15's manifest but not its table
+    os.unlink(os.path.join(ck, "rank2", "step_0000000015", "w.npz"))
+    t3 = {"w": _FakeTable(rows, 3, 0)}
+    assert elastic.find_live_step(ck, t3, 3,
+                                  required={0, 1, 2}) == 10
+    # survivors alone would be happy with 15 — the corpse's membership
+    # in `required` is what forces the honest older verdict
+    assert elastic.find_live_step(ck, t3, 3, required={0, 1}) == 15
+
+
+def test_find_live_step_accepts_rebalance_overlay_checkpoint(tmp_path):
+    """A checkpoint saved mid-rebalance (routing epoch > 0, overlay
+    metadata + xtra sections in every shard) still fits the slab
+    layout: the scan must return it — the death path then reads block
+    state THROUGH the overlay via load_block_state, which is exactly
+    the save-time-owner indirection the xtra sections exist for."""
+    ck = str(tmp_path)
+    rows = 24
+    old_n, blk = 3, 2
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(rows, 2)).astype(np.float32)
+    m = rng.normal(size=(rows, 2)).astype(np.float32)
+    _write_rebalanced_world(ck, 20, w, m, old_n, blk, overlay={0: 2})
+    t3 = {"w": _FakeTable(rows, 3, 0)}
+    assert elastic.find_live_step(ck, t3, 3,
+                                  required={0, 1, 2}) == 20
+    # and the block the overlay moved restores from its save-time
+    # owner's xtra — the slab's dead copy never leaks
+    old_sz = -(-rows // old_n)
+    st = elastic.load_block_state(ck, 20, "w", 0, 0, blk, 0, old_sz,
+                                  blk)
+    np.testing.assert_array_equal(st["w"], w[:blk])
+
+
 @pytest.mark.slow
 def test_elastic_shrink_then_grow_end_to_end(tmp_path):
     """The drill: 3 ranks train 20 iters with shard checkpoints; a
