@@ -95,6 +95,7 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
          pull_timeout: float | None = None,
          zipf_permute_hot: bool = True, rebalance: str | None = None,
          trace: str | None = None, wire_fmt: str | None = None,
+         obs: str | None = None, flight: str | None = None,
          may_fail: bool = False, timeout: float = 300.0) -> dict:
     """One sweep point → {rows_per_sec_per_process, aggregate, wire...}.
 
@@ -139,6 +140,11 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     # baseline arm onto the compressed wire (the table's env default
     # only fires when the flag is absent — which is every f32 arm)
     env_extra["MINIPS_PUSH_COMM"] = ""
+    # windowed-metrics + flight-recorder layers: empty = their DEFAULT
+    # (both always-on — that is the point of this layer), "0" = off
+    # (only the obs_tax_3proc off arm passes it: the honesty A/B)
+    env_extra["MINIPS_OBS"] = obs or ""
+    env_extra["MINIPS_FLIGHT"] = flight or ""
     if n == 1:  # standalone zero-wire baseline (no launcher, no bus)
         proc = subprocess.run(argv, capture_output=True, text=True,
                               timeout=timeout,
@@ -682,6 +688,29 @@ def main() -> int:
 
     trace_grid = _trace_arms(o_reps)
 
+    # ALWAYS-ON OBSERVABILITY TAX (this PR): the windowed-metrics layer
+    # + flight recorder are on by DEFAULT, so unlike TRACE-TAX (where
+    # the armed arm is the special one) here the DEFAULT arm is the
+    # measured product and the off arm (MINIPS_OBS=0 MINIPS_FLIGHT=0)
+    # exists only to price it. Same alternating-median honesty rules;
+    # the ci/bench_regression OBS-TAX tripwire holds the on arm within
+    # the TRACE-TAX-style band of off.
+    def _obs_tax_arms(reps: int) -> dict:
+        arms = {"obs_off": {"obs": "0", "flight": "0"}, "obs_on": {}}
+        runs: dict[str, list[dict]] = {a: [] for a in arms}
+        for _ in range(reps):
+            for a, kw in arms.items():
+                runs[a].append(_run(3, "sparse", iters, warmup, "zmq",
+                                    staleness=1, **kw))
+
+        def med(arm: str) -> dict:
+            by = sorted(runs[arm],
+                        key=lambda r: r["rows_per_sec_per_process"])
+            return {**by[len(by) // 2], "reps": reps}
+        return {a: med(a) for a in arms}
+
+    obs_tax_grid = _obs_tax_arms(o_reps)
+
     # THE PULL STORM (this PR): the PS measured as a SERVICE — 6 read-
     # only clients (2 threads x 3 ranks) firing request-sized zipf
     # reads (8 keys: a user lookup, not a training batch) against 1
@@ -821,7 +850,8 @@ def main() -> int:
                 "MINIPS_SERVE": "", "MINIPS_BUS": "",
                 "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
                 "MINIPS_HEARTBEAT": "", "MINIPS_PUSH_COMM": "",
-                "MINIPS_MESH": "", "MINIPS_AUTOSCALE": ""}
+                "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
+                "MINIPS_OBS": "", "MINIPS_FLIGHT": ""}
         kill_step = max(2, e_iters // 3)
         grid: dict = {"iters": e_iters, "kill_step": kill_step}
 
@@ -938,7 +968,8 @@ def main() -> int:
                 "MINIPS_SERVE": "", "MINIPS_BUS": "",
                 "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
                 "MINIPS_HEARTBEAT": "", "MINIPS_PUSH_COMM": "",
-                "MINIPS_MESH": "", "MINIPS_AUTOSCALE": ""}
+                "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
+                "MINIPS_OBS": "", "MINIPS_FLIGHT": ""}
         grid: dict = {"iters": c_iters}
 
         def rate(dones: list[dict]) -> float:
@@ -978,15 +1009,30 @@ def main() -> int:
         kill_step = max(8, c_iters // 3)
         with tempfile.TemporaryDirectory() as ck:
             try:
+                # the flight recorder is ALWAYS ON — the kill arm only
+                # pins its dump DIR so the FLIGHT-DUMP gate can count
+                # the survivors' black boxes and run the merge CLI on
+                # them (the gate's whole claim: a chaos kill leaves a
+                # post-mortem artifact with zero pre-arming)
+                fdir = os.path.join(ck, "flight")
                 rc, events = _launch.run_local_job_raw(
                     3, base + ["--checkpoint-dir", ck],
                     base_port=None,
                     env_extra={**env0, "MINIPS_ELASTIC": "1",
+                               "MINIPS_FLIGHT": fdir,
                                "MINIPS_CHAOS_KILL":
                                    f"7:rank=0,step={kill_step}",
                                "MINIPS_HEARTBEAT":
                                    "interval=0.1,timeout=1.0"},
                     timeout=240.0, kill_on_failure=False)
+                import glob as _glob
+
+                flight_files = sorted(_glob.glob(
+                    os.path.join(fdir, "flight-rank*.json")))
+                fproc = subprocess.run(
+                    [sys.executable, "-m", "minips_tpu.obs.flight",
+                     fdir], capture_output=True, text=True,
+                    timeout=60.0)
                 dones = [ev[-1] for r, ev in enumerate(events)
                          if r != 0 and ev
                          and ev[-1].get("event") == "done"]
@@ -1009,6 +1055,11 @@ def main() -> int:
                             d.get("wire_frames_lost", 0)
                             for d in dones),
                         "finals_agree": len(sums) == 1,
+                        # FLIGHT-DUMP gate inputs: >= 1 valid dump per
+                        # survivor (the SIGKILLed rank 0 leaves none —
+                        # nothing can) and the merge CLI exits 0
+                        "flight_dumps": len(flight_files),
+                        "flight_merge_ok": fproc.returncode == 0,
                     }
                 else:
                     grid["kill"] = {"completed": False,
@@ -1231,6 +1282,7 @@ def main() -> int:
         "chaos_resilience_3proc": chaos_grid,
         "rebalance_3proc": rebalance_grid,
         "trace_overhead_3proc": trace_grid,
+        "obs_tax_3proc": obs_tax_grid,
         "pull_storm_3proc": storm_grid,
         "elastic_membership_3proc": elastic_grid,
         "control_plane_3proc": control_grid,

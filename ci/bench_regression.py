@@ -41,7 +41,14 @@ gate-invisible (``rows_per_sec_skewed``) like the chaos arms'.
 ``trace_tripwires`` (TRACE-TAX/TRACE-MERGE) guards the
 ``trace_overhead_3proc`` sweep: the MINIPS_TRACE-armed arm must stay
 within 15% of the untraced arm AND its per-rank traces must merge
-(merge CLI exit 0, >= 1 cross-rank flow). ``serve_tripwires``
+(merge CLI exit 0, >= 1 cross-rank flow). ``obs_tripwires``
+(OBS-TAX/FLIGHT-DUMP) guards the always-on observability layer: the
+default arm (windowed metrics + flight recorder on) must stay within
+the TRACE-TAX-style band of a ``MINIPS_OBS=0 MINIPS_FLIGHT=0`` build
+on the ``obs_tax_3proc`` point, and the control-plane kill arm must
+leave >= 1 valid flight dump per survivor with the flight merge CLI
+exiting 0 — the zero-pre-arming post-mortem claim, gated per artifact.
+``serve_tripwires``
 (SERVE-SLO/SERVE-STALE/SERVE-SHED) guards the ``pull_storm_3proc``
 sweep: the replicas-on arm must beat the off arm on read rows/sec and
 median latency with replicas actually engaged (p99 inside a slack
@@ -442,6 +449,69 @@ def trace_tripwires(new: dict) -> list[str]:
             f"{tr.get('merge_ok')!r} flows_linked="
             f"{tr.get('flows_linked')!r} — the traced arm must emit a "
             "merge-able trace with >= 1 cross-rank flow")
+    return problems
+
+
+OBS_TAX_TOLERANCE = 0.15  # always-on windowed layer + flight ring vs a
+# build with both disabled — the TRACE-TAX band: the on-path cost is one
+# snapshot pass per CLOCK BOUNDARY (window roll) plus branch-guarded
+# ring appends at decision sites, nothing per frame. The failure classes
+# this catches — a roll on the frame path, an unbounded ring, dump I/O
+# on a hot path — cost integer factors, not percent.
+
+FLIGHT_SURVIVORS = 2  # the control-plane kill arm's surviving ranks
+
+
+def obs_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the always-on observability layer
+    (this PR); vacuous when the inputs are absent (other benches, or an
+    artifact measured before the layer existed).
+
+    - OBS-TAX: the DEFAULT arm (windowed layer + flight recorder on)
+      must stay within ``OBS_TAX_TOLERANCE`` of the
+      ``MINIPS_OBS=0 MINIPS_FLIGHT=0`` arm on the 3-proc point
+      (alternating-median, the TRACE-TAX honesty rules) — an always-on
+      layer that taxes the wire would be a regression every production
+      run pays.
+    - FLIGHT-DUMP: the control-plane kill arm must leave >= 1 valid
+      flight dump PER SURVIVOR with the merge CLI exiting 0 — zero
+      dumps means the black box silently fell off exactly where it
+      exists to testify. Keyed on the arm carrying the flight fields
+      (an older bench's artifact is not judged for a gate its code
+      predates; a NEW bench that collected zero dumps records 0 and
+      trips)."""
+    problems = []
+    grid = new.get("obs_tax_3proc") or {}
+    if grid:
+        off = (grid.get("obs_off") or {}).get(METRIC)
+        on = grid.get("obs_on") or {}
+        rate = on.get(METRIC)
+        if isinstance(off, (int, float)) and off > 0:
+            if not isinstance(rate, (int, float)) or \
+                    rate / off < 1.0 - OBS_TAX_TOLERANCE:
+                problems.append(
+                    f"OBS-TAX obs_tax_3proc/obs_on: {rate!r} vs "
+                    f"obs_off {off:.1f} rows/s/proc — the always-on "
+                    f"windowed+flight layer is taxing the wire beyond "
+                    f"{OBS_TAX_TOLERANCE * 100:.0f}%")
+        else:
+            problems.append(
+                f"OBS-TAX obs_tax_3proc/obs_off: {off!r} — the off "
+                "arm must record a positive rate to price the layer")
+    kill = (new.get("control_plane_3proc") or {}).get("kill") or {}
+    if kill.get("completed") and ("flight_dumps" in kill
+                                  or "flight_merge_ok" in kill):
+        if (kill.get("flight_dumps") or 0) < FLIGHT_SURVIVORS:
+            problems.append(
+                f"FLIGHT-DUMP control_plane_3proc/kill: "
+                f"{kill.get('flight_dumps')!r} flight dumps for "
+                f"{FLIGHT_SURVIVORS} survivors — every survivor must "
+                "leave its black box")
+        if not kill.get("flight_merge_ok"):
+            problems.append(
+                f"FLIGHT-DUMP control_plane_3proc/kill: flight_merge_"
+                f"ok={kill.get('flight_merge_ok')!r} — the merge CLI "
+                "must reconstruct the failure timeline (exit 0)")
     return problems
 
 
@@ -910,6 +980,7 @@ def main(argv: list[str] | None = None) -> int:
                 + transport_tripwires(new)
                 + wire_compression_tripwires(new)
                 + rebalance_tripwires(new) + trace_tripwires(new)
+                + obs_tripwires(new)
                 + serve_tripwires(new) + elastic_tripwires(new)
                 + control_plane_tripwires(new) + mesh_tripwires(new))
     pts = throughput_points(new)

@@ -557,9 +557,13 @@ def main(argv=None) -> int:
         ef_stats=table.ef_stats,
         reliable_stats=lambda: None, chaos_stats=lambda: None,
         # the standalone path has no trainer, hence no serve plane:
-        # the replica sub-block is None (off) like the other layers
+        # the replica sub-block is None (off) like the other layers —
+        # and no clock boundary, hence no windowed layer or heartbeat
+        # monitor (None = off, the same convention)
         serve_stats=lambda: {**table.serve, "replica": None},
-        rebalance_stats=lambda: None)
+        rebalance_stats=lambda: None,
+        window_stats=lambda: None,
+        heartbeat_stats=lambda: None)
     trace_file = _trc.dump_now()  # standalone has no finalize dump
     print(json.dumps({
         "rank": rank, "event": "done",

@@ -16,7 +16,14 @@ signals the system already exports and drives the same ``mbJ`` admit /
   This is the primary storm signal: admission refusing load is the
   system itself saying it is over capacity.
 - **SERVE-SLO p99** — the always-on pull-latency histograms, summarized
-  into the same report (``up_p99_ms`` arms it).
+  into the same report (``up_p99_ms`` arms it). Since the windowed
+  metrics layer (obs/window.py) the reported value is the WINDOWED
+  quantile over the last ``MINIPS_OBS window=`` clock boundaries, not
+  the cumulative-since-boot hist: a storm that ends leaves the signal
+  within one window, so the loop can DISARM — the cumulative quantile
+  could arm but provably never forget a storm (ROADMAP item 3
+  carry-forward (b), closed). Ranks running ``MINIPS_OBS=0`` fall back
+  to the cumulative value, honestly reintroducing that limit.
 - **per-owner heat imbalance** — max/mean of the reports' ``total``
   heat (``imb`` arms it), the same observable the rebalancer's
   hysteresis reads.
@@ -48,6 +55,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from minips_tpu.obs import flight as _fl
 from minips_tpu.obs import tracer as _trc
 
 __all__ = ["AutoscaleConfig", "Autoscaler"]
@@ -233,9 +241,20 @@ class Autoscaler:
         live = self.mb.live_view()
         if cfg.max_live and len(live) >= cfg.max_live:
             return
-        if self.counters["admits"] == 0 and self._streak_rates:
-            self.shed_rate_pre = round(
-                sum(self._streak_rates) / len(self._streak_rates), 3)
+        # the hot-streak mean shed rate, computed ONCE: it is both the
+        # first-admit evidence stat (shed_rate_pre) and the decision's
+        # recorded WHY — captured BEFORE the streak state is cleared
+        # below, because the signal values at decision time are what a
+        # post-mortem needs to judge the loop
+        rate_now = (round(sum(self._streak_rates)
+                          / len(self._streak_rates), 3)
+                    if self._streak_rates else None)
+        if self.counters["admits"] == 0 and rate_now is not None:
+            self.shed_rate_pre = rate_now
+        why = {"live": sorted(live),
+               "shed_rate": rate_now,
+               "p99_ms": self.p99_last_ms,
+               "hot_streak": self._hot}
         self.mb.grant_join()
         with self._lock:
             self.counters["admits"] += 1
@@ -247,6 +266,11 @@ class Autoscaler:
             tr.instant("autoscale", "as_admit",
                        {"live": sorted(live),
                         "pre_rate": self.shed_rate_pre})
+        # a scaling DECISION, not a failure: recorded + dumped via
+        # checkpoint() so the box always carries the latest action
+        # without growing the poison reasons list or flagging healthy
+        # autoscaling as a poison on the merged timeline
+        _fl.checkpoint("as_admit", why)
 
     def _try_drain(self) -> None:
         from minips_tpu.balance.membership import Membership
@@ -262,10 +286,19 @@ class Autoscaler:
             self._calm_rates.clear()
             return
         victim = cands[0]
+        # the decision-relevant calm rate: the SAME last-down_after
+        # slice the loop judged (the full-list mean can differ after a
+        # long calm tail, and the box must carry the value consulted)
+        rate_now = (round(sum(self._calm_rates[-self.cfg.down_after:])
+                          / min(len(self._calm_rates),
+                                self.cfg.down_after), 3)
+                    if self._calm_rates else 0.0)
         if self.counters["drains"] == 0 and self._calm_rates:
-            self.shed_rate_post = round(
-                sum(self._calm_rates[-self.cfg.down_after:])
-                / min(len(self._calm_rates), self.cfg.down_after), 3)
+            self.shed_rate_post = rate_now
+        why = {"rank": int(victim),
+               "shed_rate": rate_now,
+               "p99_ms": self.p99_last_ms,
+               "calm_streak": self._calm}
         self.trainer.bus.send(victim, Membership.DRAIN_KIND,
                               {**self.mb.lease.stamp()})
         with self._lock:
@@ -276,6 +309,7 @@ class Autoscaler:
         tr = _trc.TRACER
         if tr is not None:
             tr.instant("autoscale", "as_drain", {"rank": int(victim)})
+        _fl.checkpoint("as_drain", why)
 
     # ------------------------------------------------------------- metrics
     def stats(self) -> dict:

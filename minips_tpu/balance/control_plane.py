@@ -61,6 +61,8 @@ from __future__ import annotations
 import threading
 from typing import Iterable, Optional
 
+from minips_tpu.obs import flight as _fl
+
 __all__ = ["CoordinatorLease", "successor_of"]
 
 
@@ -109,8 +111,16 @@ class CoordinatorLease:
         with self._lock:
             if int(lt) < self.term:
                 self.fenced += 1
-                return False
-        return True
+                term = self.term
+            else:
+                return True
+        # the fence DECISION and its why (stale term vs held term) into
+        # the black box — rare by construction (a partitioned
+        # ex-coordinator's tail), so the record is off the hot path
+        _fl.record("lease_fenced",
+                   {"lt": int(lt), "lh": payload.get("lh"),
+                    "term": term})
+        return False
 
     def observe(self, payload: dict) -> bool:
         """Max-merge a term seen on the wire (heartbeat stamps, plan

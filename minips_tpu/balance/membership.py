@@ -90,6 +90,7 @@ import numpy as np
 
 from minips_tpu.balance.control_plane import CoordinatorLease
 from minips_tpu.consistency.gate import PeerFailureError, publish_clock
+from minips_tpu.obs import flight as _fl
 from minips_tpu.obs import tracer as _trc
 
 __all__ = ["MembershipConfig", "Membership", "plan_evacuation",
@@ -478,12 +479,27 @@ class Membership:
                     free = True
                 elif self.rank == self.coord:
                     self._pending_deaths.append(r)
+        with self._lock:
+            live_snap = sorted(self.live)
+        # the heartbeat DEATH VERDICT is a poison-class event whether or
+        # not the plane can own it: record + dump FIRST so every
+        # survivor's box opens with the verdict — the post-mortem
+        # sequence reads verdict → term advance → death plan
+        _fl.poison("hb_death", {"rank": int(r), "owns": bool(owns),
+                                "live": live_snap})
         if succeeded is not None:
+            term, holder = self.lease.current()
             tr = _trc.TRACER
             if tr is not None:
-                term, holder = self.lease.current()
                 tr.instant("membership", "mb_lease",
                            {"term": term, "holder": holder})
+            # LEASE DECISION into the black box, with its WHY — the
+            # ballot inputs every rank advanced on (verdict + live set)
+            # — then dump: a term advance is exactly the decision a
+            # post-mortem reconstructs ("who took over, from what")
+            _fl.poison("term_advance",
+                       {"term": term, "holder": holder,
+                        "dead": int(r), "live": live_snap})
         if free and self.rank == self.coord:
             # converge laggards whose tables still route to the corpse
             # (mid-adoption views): rstep 0 = free verdict, no plan
@@ -917,6 +933,9 @@ class Membership:
             with self._lock:
                 self._verdicts[r] = -1
                 self._unrecoverable.add(r)
+            _fl.poison("death_plan",
+                       {"rank": int(r), "rstep": -1,
+                        "why": "no complete checkpoint"})
             return
         targets = self._live_targets()
         extras = {"dead": [int(r)], "rstep": int(step)}
@@ -932,3 +951,9 @@ class Membership:
         if tr is not None:
             tr.instant("membership", "mb_death_plan",
                        {"rank": int(r), "rstep": int(step)})
+        # the plan the successor issued, with its WHY (the restore step
+        # chosen and who received the ranges) — the third line of the
+        # post-mortem sequence verdict → term advance → death plan
+        _fl.poison("death_plan",
+                   {"rank": int(r), "rstep": int(step),
+                    "targets": [int(t) for t in targets]})

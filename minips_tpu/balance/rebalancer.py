@@ -346,16 +346,27 @@ class Rebalancer:
         if getattr(self.trainer, "autoscaler", None) is not None:
             # autoscaler load signals ride the heat report (balance/
             # autoscaler.py): cumulative serve-plane shed counters plus
-            # the always-on pull p99 — re-gossiped every tick, so a
-            # lease successor's autoscaler reconstructs the fleet load
-            # picture in one boundary with no extra wire
+            # the pull p99 — re-gossiped every tick, so a lease
+            # successor's autoscaler reconstructs the fleet load
+            # picture in one boundary with no extra wire. The p99 is
+            # the WINDOWED quantile (obs/window.py, rolled by the
+            # trainer at this same clock boundary): an idle window
+            # reports None (calm), and a storm that ENDED leaves the
+            # signal within one window — the disarm the cumulative
+            # hist could never produce. MINIPS_OBS=0 falls back to the
+            # cumulative quantile (the pre-window behavior, kept only
+            # for the tax A/B arm).
             if t._sv is not None:
                 rep["sv"] = t._sv.load_signal()
-            from minips_tpu.obs.hist import summarize_counts
+            ow = getattr(self.trainer, "obs_window", None)
+            if ow is not None:
+                rep["p99"] = ow.quantile_ms("pull_latency", 0.99)
+            else:
+                from minips_tpu.obs.hist import summarize_counts
 
-            rep["p99"] = summarize_counts(
-                t.timers.snapshot()["hists"]["pull_latency"]).get(
-                    "p99_ms")
+                rep["p99"] = summarize_counts(
+                    t.timers.snapshot()["hists"]["pull_latency"]).get(
+                        "p99_ms")
         if self.rank == self.coord:
             with self._lock:
                 self._reports.setdefault(name, {})[self.rank] = rep

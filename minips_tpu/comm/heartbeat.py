@@ -17,6 +17,7 @@ import time
 from typing import Callable, Optional
 
 from minips_tpu.comm.bus import ControlBus
+from minips_tpu.obs import flight as _fl
 from minips_tpu.obs import tracer as _trc
 
 
@@ -107,6 +108,12 @@ class HeartbeatMonitor:
                 f"MINIPS_HEARTBEAT: stall {self.stall} must exceed the "
                 f"interval {self.interval} (every sweep would forgive)")
         self._last_sweep: Optional[float] = None
+        # observer-stall forgiveness hits (the PR12 stall= window):
+        # WITHOUT this counter a forgiven stall is invisible — an
+        # operator cannot tell forgiveness from health, and a fleet
+        # whose every sweep forgives is a fleet with detection silently
+        # degraded. Surfaced via stats() -> wire_record "heartbeat".
+        self.stall_forgiven = 0
         self._clock = clock
         now = clock()
         self._last_seen = {p: now for p in peer_ids if p != bus.my_id}
@@ -126,6 +133,13 @@ class HeartbeatMonitor:
             # cancel and the per-rank clock offsets fall out
             tr.instant("hb", "hb", {"from": sender,
                                     "t_sent": float(payload["t"])})
+        fl = _fl.FLIGHT
+        if fl is not None and "t" in payload:
+            # the flight recorder keeps only the min-filtered delay per
+            # sender (a dict op per beat, no ring traffic): enough for
+            # its merge CLI to align post-mortem timelines the same
+            # NTP-style way with zero pre-arming
+            fl.hb_sample(sender, float(payload["t"]), time.monotonic())
         with self._lock:
             if sender in self._last_seen:
                 self._last_seen[sender] = self._clock()
@@ -155,6 +169,12 @@ class HeartbeatMonitor:
                 for p in self._last_seen:
                     if p not in self._dead:
                         self._last_seen[p] = now
+                self.stall_forgiven += 1
+                fl = _fl.FLIGHT
+                if fl is not None:
+                    fl.ev("hb_stall_forgiven",
+                          {"gap_s": round(now - last, 3),
+                           "stall_s": self.stall})
                 return set(self._dead)
             for p, seen in self._last_seen.items():
                 if p not in self._dead and now - seen > self.timeout:
@@ -183,6 +203,18 @@ class HeartbeatMonitor:
     def dead(self) -> set[int]:
         with self._lock:
             return set(self._dead)
+
+    def stats(self) -> dict:
+        """Liveness-layer counters for the done line (``wire_record``
+        "heartbeat" block): the stall-forgiveness window's arming and
+        hits, plus the dead set size. A forgiven stall must be VISIBLE
+        — it is detection latency the operator traded for."""
+        with self._lock:
+            return {"interval_s": self.interval,
+                    "timeout_s": self.timeout,
+                    "stall_s": self.stall or None,
+                    "stall_forgiven": self.stall_forgiven,
+                    "dead": sorted(self._dead)}
 
     def stop(self) -> None:
         self._stop.set()

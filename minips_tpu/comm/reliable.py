@@ -71,6 +71,7 @@ from collections import OrderedDict
 from typing import Optional
 
 from minips_tpu.comm.framing import decode_head, rt_wrap
+from minips_tpu.obs import flight as _fl
 from minips_tpu.obs import tracer as _trc
 
 __all__ = ["ReliableChannel"]
@@ -351,6 +352,7 @@ class ReliableChannel:
 
     def _on_gone(self, sender: int, payload: dict) -> None:
         stream = str(payload.get("s", "b"))
+        gone = 0
         with self._lock:
             rx = self._rx.get((sender, stream))
             if rx is None:
@@ -360,11 +362,19 @@ class ReliableChannel:
                 if rx.gaps.pop(s, None) is not None:
                     rx.skip.add(s)
                     self.stats["gave_up"] += 1
+                    gone += 1
                     if tr is not None:
                         tr.instant("reliable", "gave_up",
                                    {"sender": sender, "stream": stream,
                                     "seq": s, "why": "gone"})
             self._drain(rx)
+        if gone:
+            # a journal-evicted seq is UNRECOVERED loss on a reliable
+            # stream: poison-class, dump the black box (outside the
+            # channel lock — the dump is file I/O)
+            _fl.poison("reliable_give_up",
+                       {"sender": sender, "stream": stream, "n": gone,
+                        "why": "gone"})
 
     def _on_top(self, sender: int, payload: dict) -> None:
         """A sender's advertised stream tops: open gaps for trailing
@@ -392,6 +402,7 @@ class ReliableChannel:
         protocol is unit-testable without threads."""
         now = self._clock() if now is None else now
         nacks: list[tuple[int, str, list[int]]] = []
+        gave_up: list[tuple[int, str, int]] = []
         with self._lock:
             # snapshot: _drain dispatches handlers under the lock, and a
             # handler must not invalidate this iteration by touching _rx
@@ -406,6 +417,7 @@ class ReliableChannel:
                         rx.gaps.pop(s)
                         rx.skip.add(s)
                         self.stats["gave_up"] += 1
+                        gave_up.append((sender, stream, s))
                         tr = _trc.TRACER
                         if tr is not None:
                             tr.instant("reliable", "gave_up",
@@ -428,6 +440,15 @@ class ReliableChannel:
                 if ask:
                     nacks.append((sender, stream, ask))
                     self.stats["nacks_sent"] += 1
+        if gave_up:
+            # retry budget exhausted: the stream hole is now permanent
+            # loss the wire will book at the next delivery jump —
+            # poison-class, one dump per pump pass (outside the lock)
+            _fl.poison("reliable_give_up",
+                       {"why": "budget",
+                        "links": sorted({(s, st)
+                                         for s, st, _ in gave_up}),
+                        "n": len(gave_up)})
         tr = _trc.TRACER
         if tr is not None:
             for sender, stream, seqs in nacks:
@@ -489,10 +510,31 @@ class ReliableChannel:
         with self._lock:
             return sum(len(rx.gaps) for rx in self._rx.values())
 
+    def gap_ages(self) -> dict[str, float]:
+        """Oldest OUTSTANDING gap age in seconds per link
+        (``"<sender>:<stream>"``) — the per-link health observable the
+        windowed layer gauges: a gap that keeps aging is a repair loop
+        losing, visible long before the give-up poison."""
+        now = self._clock()
+        with self._lock:
+            return {f"{s}:{st}": round(now - min(g.t0 for g in
+                                                 rx.gaps.values()), 4)
+                    for (s, st), rx in self._rx.items() if rx.gaps}
+
+    def oldest_gap_age(self) -> float:
+        """Max over links of :meth:`gap_ages` (0.0 when gap-free) —
+        the scalar the windowed layer registers as a gauge."""
+        ages = self.gap_ages()
+        return max(ages.values()) if ages else 0.0
+
     def snapshot(self) -> dict:
         with self._lock:
             out = dict(self.stats)
         out["outstanding_gaps"] = self.outstanding_gaps()
+        ages = self.gap_ages()
+        out["oldest_gap_age_s"] = (round(max(ages.values()), 4)
+                                   if ages else 0.0)
+        out["gap_ages_s"] = ages or None
         return out
 
     def stop(self) -> None:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import time
 
+from minips_tpu.obs import flight as _fl
 from minips_tpu.obs import tracer as _trc
 
 
@@ -131,8 +132,14 @@ class StalenessGate:
                 if dead:
                     for p in dead:
                         self.gossip.exclude(p)
+                    _fl.poison("gate_peer_failure",
+                               {"clock": clock, "dead": sorted(dead)})
                     raise PeerFailureError(dead)
                 if time.monotonic() > deadline:
+                    _fl.poison("gate_deadline",
+                               {"clock": clock,
+                                "global_min": self.gossip.global_min(),
+                                "staleness": self.staleness})
                     raise TimeoutError(
                         f"SSP gate timed out at clock {clock} "
                         f"(global_min={self.gossip.global_min()}, "

@@ -163,13 +163,17 @@ def _sweep_shm() -> None:
     SIGKILLed job never reaches its atexit/close cleanup, and both the
     sample store's segments (dataset-sized) and the shm bus's ring
     files (ring-sized per link) live in tmpfs — host RAM. Each sweeper
-    pid-checks the MINIPS_RUN_ID baked into the file name."""
+    pid-checks the MINIPS_RUN_ID baked into the file name. The flight
+    recorder's default dump dirs (obs/flight.py — small, but also
+    keyed by run id in tmp) ride the same hygiene contract."""
     from minips_tpu.comm.shm_bus import \
         sweep_stale_segments as sweep_bus_segments
     from minips_tpu.data.shm_store import sweep_stale_segments
+    from minips_tpu.obs.flight import sweep_stale_dirs
 
     sweep_stale_segments()
     sweep_bus_segments()
+    sweep_stale_dirs()
 
 
 def spawn(hosts: list[str], argv: list[str], base_port: int = 5700,

@@ -234,10 +234,13 @@ class Tracer:
         return path
 
 
-def _parse_spec(spec: str) -> tuple[str, dict]:
+def _parse_spec(spec: str, env: str = "MINIPS_TRACE"
+                ) -> tuple[str, dict]:
     """``<dir>[:k=v,...]`` — the dir may itself contain ':' only on
     platforms where that's pathological anyway; the FIRST ':' followed
-    by a ``k=`` form splits."""
+    by a ``k=`` form splits. Shared with the flight recorder
+    (obs/flight.py), whose ``MINIPS_FLIGHT`` speaks the same grammar —
+    ``env`` only names the knob in the error."""
     out_dir, kw = spec, {}
     if ":" in spec:
         head, _, tail = spec.rpartition(":")
@@ -248,7 +251,7 @@ def _parse_spec(spec: str) -> tuple[str, dict]:
                 k, _, v = entry.partition("=")
                 if k != "cap":
                     raise ValueError(
-                        f"MINIPS_TRACE: unknown option {k!r} "
+                        f"{env}: unknown option {k!r} "
                         "(expected cap=<events>)")
                 kw["cap"] = int(v)
     return out_dir, kw

@@ -71,6 +71,7 @@ from typing import Optional
 import numpy as np
 
 from minips_tpu.consistency.gate import admits
+from minips_tpu.obs import flight as _fl
 from minips_tpu.obs import tracer as _trc
 from minips_tpu.obs.hist import Log2Histogram, merge_counts, slo_check
 from minips_tpu.serve.admission import TokenBucket
@@ -589,12 +590,29 @@ class TableServeState:
             # ladder at the very owner that is refusing load (an svN
             # still falls back here with rt=1, bounded as ever)
             common = {sender}
+        # admission decisions into the black box, SAMPLED: during a
+        # storm sheds fire at request rate, and one ring entry per
+        # denial would rotate the decisions a post-mortem actually
+        # needs (term advances, autoscaler actions) out of the bounded
+        # ring while taxing the exact path that is already refusing
+        # load — so record the first few and then every 64th denial,
+        # with the bucket's cumulative denied count carrying the true
+        # volume in each sampled entry
+        fl = _fl.FLIGHT
+        if fl is not None:
+            denied = self.bucket.denied  # GIL-read, approximate is fine
+            if denied > 4 and denied % 64:
+                fl = None
         if common:
             self._count("shed_redirects")
             if tr is not None:
                 tr.instant("serve", "sv_shed",
                            {"from": sender, "rid": req,
                             "holders": sorted(common)})
+            if fl is not None:
+                fl.ev("sv_shed", {"from": sender,
+                                  "why": "bucket_empty",
+                                  **self.bucket.snapshot()})
             t.bus.send(sender, f"svS:{t.name}",
                        {"req": int(req), "h": sorted(common)})
             return False
@@ -622,6 +640,10 @@ class TableServeState:
                 tr.instant("serve", "sv_shed_partial",
                            {"from": sender, "rid": req, "holder": pick,
                             "blocks": covered})
+            if fl is not None:
+                fl.ev("sv_shed", {"from": sender, "why": "partial",
+                                  "holder": int(pick),
+                                  **self.bucket.snapshot()})
             t.bus.send(sender, f"svS:{t.name}",
                        {"req": int(req), "h": [int(pick)],
                         "bs": covered})
@@ -630,6 +652,10 @@ class TableServeState:
             if tr is not None:
                 tr.instant("serve", "sv_backpressure",
                            {"from": sender, "rid": req})
+            if fl is not None:
+                fl.ev("sv_bp", {"from": sender,
+                                "retry_ms": self.cfg.retry_ms,
+                                **self.bucket.snapshot()})
             t.bus.send(sender, f"svB:{t.name}",
                        {"req": int(req), "ms": self.cfg.retry_ms})
         return False

@@ -68,6 +68,9 @@ from typing import Optional
 import numpy as np
 
 from minips_tpu.consistency.gate import RETIRED_CLOCK, admits
+from minips_tpu.obs import flight as _fl
+from minips_tpu.obs import window as _ow
+from minips_tpu.obs.hist import Log2Histogram, summarize_counts
 
 MESH_AXIS = "shard"
 VALID_MESH_COMM = ("float32", "blk8")
@@ -300,6 +303,7 @@ class MeshTable:
         the dirty rows. Caller holds the plane lock."""
         import jax
 
+        t_wave0 = time.monotonic()
         g_stack = jax.device_put(self._gbuf, self._stack_sh)
         if self.updater == "sgd":
             (self._w,), full = self._wave_fn(self._w, g_stack)
@@ -322,6 +326,9 @@ class MeshTable:
                 self._dirty[r] = False
         self.waves += 1
         self.collective_bytes += self._wave_bytes()
+        # the step-phase observable: one wave = one collective program
+        # dispatch; its duration hist feeds the plane's windowed layer
+        self.plane.hist_wave.record_s(time.monotonic() - t_wave0)
 
     def _wave_bytes(self) -> int:
         """Collective bytes one wave moves, summed over ranks: ring
@@ -528,6 +535,26 @@ class MeshPlane:
         self._retired = np.zeros(self.num_ranks, bool)
         self.gate_waits = 0
         self.max_skew_seen = 0
+        # ---- observability: always-on step-PHASE histograms (apply-
+        # wave duration, tick-gate blocked time) + the windowed layer
+        # over them — the mesh plane's analog of the wire trainer's
+        # hist/window blocks; MINIPS_OBS=0 disables the window only
+        # (the tax arm), the hists are as free as the wire's
+        self.hist_wave = Log2Histogram()
+        self.hist_gate = Log2Histogram()
+        self.obs_window = _ow.maybe_build()
+        if self.obs_window is not None:
+            self.obs_window.register_hist(
+                "wave", lambda: self.hist_wave.snapshot())
+            self.obs_window.register_hist(
+                "gate", lambda: self.hist_gate.snapshot())
+            self.obs_window.register_counter(
+                "waves", lambda: sum(t.waves
+                                     for t in self.tables.values()))
+            self.obs_window.register_counter(
+                "collective_bytes",
+                lambda: sum(t.collective_bytes
+                            for t in self.tables.values()))
 
     # ------------------------------------------------------------- setup
     def add_table(self, name: str, num_rows: int, dim: int,
@@ -631,36 +658,59 @@ class MeshPlane:
         k), advance the device-side clock vector, then gate
         (BSP/SSP/ASP rule) unless ``wait=False`` (single-threaded
         drivers gate at pull admission instead)."""
-        with self._cond:
-            self._flush_rank_locked(rank)
-            new = int(self._clk_host[rank]) + 1
-            self._clk_host[rank] = new
-            self._clk_dev = self._clk_dev.at[rank].set(new)
-            self._cond.notify_all()
-            # skew is recorded in EVERY mode (ASP and wait=False
-            # included) — the observable must not go vacuous just
-            # because the gate does not block
-            self.max_skew_seen = max(self.max_skew_seen,
-                                     new - self._global_min())
-            if not wait or self.staleness == float("inf"):
-                return
-            threshold = new - int(self.staleness)
-            if self._global_min() < threshold:
-                self.gate_waits += 1
-            deadline = time.monotonic() + self.gate_timeout
-            while self._global_min() < threshold:
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    raise TimeoutError(
-                        f"mesh plane gate timed out at clock {new} "
-                        f"(global_min={self._global_min()}, "
-                        f"staleness={self.staleness})")
-                self._cond.wait(timeout=min(0.2, left))
-            if self._device_min() < threshold:  # certify: device word
-                raise RuntimeError(
-                    "mesh clock mirror ahead of the device vector "
-                    f"({self._clk_host.tolist()} vs "
-                    f"{self.clocks().tolist()})")
+        poison_args = None
+        try:
+            with self._cond:
+                self._flush_rank_locked(rank)
+                new = int(self._clk_host[rank]) + 1
+                self._clk_host[rank] = new
+                self._clk_dev = self._clk_dev.at[rank].set(new)
+                self._cond.notify_all()
+                if rank == 0 and self.obs_window is not None:
+                    # one roll per full clock (rank 0's boundary): the
+                    # plane's windowed intervals track steps like the
+                    # wire trainer's tick-time roll
+                    self.obs_window.roll()
+                # skew is recorded in EVERY mode (ASP and wait=False
+                # included) — the observable must not go vacuous just
+                # because the gate does not block
+                self.max_skew_seen = max(self.max_skew_seen,
+                                         new - self._global_min())
+                if not wait or self.staleness == float("inf"):
+                    return
+                threshold = new - int(self.staleness)
+                t_gate0 = time.monotonic()
+                if self._global_min() < threshold:
+                    self.gate_waits += 1
+                deadline = time.monotonic() + self.gate_timeout
+                try:
+                    while self._global_min() < threshold:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            poison_args = {
+                                "rank": rank, "clock": new,
+                                "global_min": self._global_min(),
+                                "staleness": self.staleness}
+                            raise TimeoutError(
+                                f"mesh plane gate timed out at clock "
+                                f"{new} "
+                                f"(global_min={self._global_min()}, "
+                                f"staleness={self.staleness})")
+                        self._cond.wait(timeout=min(0.2, left))
+                finally:
+                    self.hist_gate.record_s(time.monotonic() - t_gate0)
+                if self._device_min() < threshold:  # certify: device
+                    raise RuntimeError(
+                        "mesh clock mirror ahead of the device vector "
+                        f"({self._clk_host.tolist()} vs "
+                        f"{self.clocks().tolist()})")
+        except TimeoutError:
+            # the dump is file I/O: it must not run under the plane
+            # lock (every other rank's tick would block behind it —
+            # the same outside-the-lock rule comm/reliable.py keeps)
+            if poison_args is not None:
+                _fl.poison("mesh_gate_deadline", poison_args)
+            raise
 
     def finalize(self, rank: int, timeout: float = 30.0) -> None:
         """Flush, retire (the shared RETIRED_CLOCK sentinel so nobody
@@ -668,23 +718,33 @@ class MeshPlane:
         finalized — after which pull/pull_all return identical rows for
         every rank (there is only ONE state; the barrier guarantees it
         contains everyone's mass)."""
-        with self._cond:
-            for t in self.tables.values():
-                if t._dirty[rank]:
-                    t._wave_locked()
-            self._retired[rank] = True
-            self._clk_host[rank] = RETIRED_CLOCK
-            self._clk_dev = self._clk_dev.at[rank].set(RETIRED_CLOCK)
-            self._cond.notify_all()
-            deadline = time.monotonic() + timeout
-            while not self._retired.all():
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    missing = [r for r in range(self.num_ranks)
-                               if not self._retired[r]]
-                    raise TimeoutError(
-                        f"mesh finalize: ranks {missing} never retired")
-                self._cond.wait(timeout=min(0.2, left))
+        poison_args = None
+        try:
+            with self._cond:
+                for t in self.tables.values():
+                    if t._dirty[rank]:
+                        t._wave_locked()
+                self._retired[rank] = True
+                self._clk_host[rank] = RETIRED_CLOCK
+                self._clk_dev = self._clk_dev.at[rank].set(
+                    RETIRED_CLOCK)
+                self._cond.notify_all()
+                deadline = time.monotonic() + timeout
+                while not self._retired.all():
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        missing = [r for r in range(self.num_ranks)
+                                   if not self._retired[r]]
+                        poison_args = {"rank": rank,
+                                       "missing": missing}
+                        raise TimeoutError(
+                            f"mesh finalize: ranks {missing} never "
+                            "retired")
+                    self._cond.wait(timeout=min(0.2, left))
+        except TimeoutError:
+            if poison_args is not None:  # dump OUTSIDE the plane lock
+                _fl.poison("mesh_finalize_deadline", poison_args)
+            raise
 
     def stats(self) -> dict:
         return {
@@ -697,4 +757,13 @@ class MeshPlane:
             "collective_bytes": sum(t.collective_bytes
                                     for t in self.tables.values()),
             "gate_waits": self.gate_waits,
+            # step-phase hists + windowed layer, the wire trainer's
+            # hist/window done-line convention ({"count": 0} idle,
+            # None = window layer off)
+            "hist": {"wave_ms": summarize_counts(
+                         self.hist_wave.snapshot()),
+                     "gate_ms": summarize_counts(
+                         self.hist_gate.snapshot())},
+            "window": (self.obs_window.record()
+                       if self.obs_window is not None else None),
         }

@@ -214,7 +214,9 @@ import numpy as np
 from minips_tpu.comm.bus import ClockGossip
 from minips_tpu.consistency.gate import (PeerFailureError, StalenessGate,
                                          admits)
+from minips_tpu.obs import flight as _fl
 from minips_tpu.obs import tracer as _trc
+from minips_tpu.obs import window as _ow
 from minips_tpu.obs.hist import Log2Histogram, merge_counts, \
     summarize_counts
 from minips_tpu.ops.quantized_comm import (HOST_BLOCK,
@@ -930,14 +932,20 @@ class ShardedTable:
                       "push_frames": 0, "push_rows": 0}
         # ---- observability (obs/): always-on server-side latency
         # histograms (serve duration, park duration — the tail half of
-        # the serve counters above), and the env-gated wire tracer.
-        # ``_trc.maybe_init`` arms the process tracer from MINIPS_TRACE
-        # on first construction and is a no-op (one env read) when off;
-        # ``_leg_t0``/``_fence_t0`` are trace-only bookkeeping (empty
-        # forever when the tracer is off).
+        # the serve counters above — and rebalance-fence duration: a
+        # fence that keeps aging is a migration losing, feed for the
+        # windowed layer), the env-gated wire tracer, and the always-on
+        # flight recorder. ``_trc.maybe_init`` arms the process tracer
+        # from MINIPS_TRACE on first construction and is a no-op (one
+        # env read) when off; ``_leg_t0`` is trace-only bookkeeping
+        # (empty forever when the tracer is off), while ``_fence_t0``
+        # is now ALWAYS stamped (the fence hist needs it; a dict insert
+        # per fenced block per migration, nowhere near the frame path).
         self.hist_serve = Log2Histogram()
         self.hist_park = Log2Histogram()
+        self.hist_fence = Log2Histogram()
         _trc.maybe_init(rank)
+        _fl.maybe_init(rank)
         self._leg_t0: dict[int, tuple] = {}   # rid -> (t0, owner)
         self._fence_t0: dict[int, float] = {}  # block -> fence start
         # ---- server shard: ONLY my row range lives here (the 1/N memory
@@ -1378,8 +1386,7 @@ class ShardedTable:
                             self._early_release.discard((b, ep))
                         else:
                             self._fenced[b] = src
-                            if _trc.TRACER is not None:
-                                self._fence_t0[b] = time.monotonic()
+                            self._fence_t0[b] = time.monotonic()
                 if dead:
                     # blocks stuck MID-MIGRATION on the corpse from an
                     # earlier epoch: a pending rbS that will never
@@ -1568,12 +1575,16 @@ class ShardedTable:
             else:  # rbF beat my plan adoption (reordered control plane)
                 self._early_release.add((b, ep))
             self._mig_cond.notify_all()
-        tr = _trc.TRACER
-        if tr is not None and released:
+        if released:
             t0 = self._fence_t0.pop(b, None)
             if t0 is not None:
-                tr.complete("rebalance", "rb_fence", t0,
-                            {"b": b, "ep": ep})
+                # always-on fence-duration hist (the windowed layer's
+                # rebalance signal); the tracer span rides when armed
+                self.hist_fence.record_s(time.monotonic() - t0)
+                tr = _trc.TRACER
+                if tr is not None:
+                    tr.complete("rebalance", "rb_fence", t0,
+                                {"b": b, "ep": ep})
         self.serve_parked()
 
     def rebalance_settled(self) -> bool:
@@ -1600,11 +1611,21 @@ class ShardedTable:
                         or self._early_state):
                     return
                 if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"table {self.name}: migration never settled "
-                        f"(fenced={sorted(self._fenced)}, "
-                        f"pending={sorted(self._pending_state)})")
+                    # flight dump OUTSIDE the lock below (file I/O +
+                    # the windowed snapshot hook must never run under
+                    # a table lock a reliable-dispatched handler may
+                    # want — the outside-the-lock rule every poison
+                    # site in this file follows)
+                    fenced = sorted(self._fenced)
+                    pending = sorted(self._pending_state)
+                    break
                 self._mig_cond.wait(timeout=0.2)
+        _fl.poison("settle_deadline",
+                   {"table": self.name, "fenced": fenced,
+                    "pending": pending})
+        raise TimeoutError(
+            f"table {self.name}: migration never settled "
+            f"(fenced={fenced}, pending={pending})")
 
     def rebalance_table_stats(self) -> dict:
         with self._mig_cond:
@@ -2473,8 +2494,13 @@ class ShardedTable:
     def check_fatal(self) -> None:
         """Raise if a config-mismatched peer frame poisoned this table —
         called from the trainer's tick so a bad relaunch fails within one
-        step instead of silently discarding that peer's gradients."""
+        step instead of silently discarding that peer's gradients.
+        Flight: RECORD-only (no dump) — this runs under _push_cond in
+        the enqueue backpressure loop, and the raise propagates to a
+        path that dumps lock-free (finalize's dump_now, atexit)."""
         if self._fatal is not None:
+            _fl.record("table_fatal",
+                       {"table": self.name, "why": self._fatal[:200]})
             raise RuntimeError(self._fatal)
 
     def _my_clk(self) -> int:
@@ -2647,6 +2673,9 @@ class ShardedTable:
                 if fatal:
                     with self._reply_cond:
                         self._cleanup_group_locked(gid)
+                    _fl.poison("pull_peer_failure",
+                               {"table": self.name,
+                                "dead": sorted(fatal)})
                     raise PeerFailureError(fatal)
                 # survivable death (elastic membership): once the death
                 # plan re-homed the corpse's keys, its legs re-issue by
@@ -2656,6 +2685,9 @@ class ShardedTable:
             if time.monotonic() > deadline:
                 with self._reply_cond:
                     self._cleanup_group_locked(gid)
+                _fl.poison("pull_deadline",
+                           {"table": self.name,
+                            "owners": sorted(int(o) for o in owners)})
                 raise TimeoutError(
                     f"pull({self.name}): owners {sorted(owners)} "
                     "never replied")
@@ -2705,9 +2737,7 @@ class ShardedTable:
                         t_fence0 = time.monotonic()
                     if time.monotonic() > deadline:
                         _trace_fence_wait()
-                        raise TimeoutError(
-                            f"pull({self.name}): local rows fenced "
-                            "mid-migration and never released")
+                        break  # poison + raise BELOW, outside the lock
                     self._mig_cond.wait(timeout=0.1)
                     continue
                 if mine.all():
@@ -2740,6 +2770,8 @@ class ShardedTable:
                 pend = getattr(self._rb, "has_pending", None)
                 if pend is not None and pend(self.name):
                     if time.monotonic() > deadline:
+                        _fl.poison("adopt_deadline",
+                                   {"table": self.name})
                         raise TimeoutError(
                             f"pull({self.name}): routing table "
                             "adoption never caught up mid-migration")
@@ -2756,6 +2788,13 @@ class ShardedTable:
                                          max(deadline - time.monotonic(),
                                              0.1))
             return out
+        # only the fence-deadline break reaches here — the flight dump
+        # (file I/O + the windowed snapshot hook) must not run under
+        # _mig_cond: a reliable-dispatched handler may be waiting on it
+        _fl.poison("fence_deadline", {"table": self.name})
+        raise TimeoutError(
+            f"pull({self.name}): local rows fenced mid-migration and "
+            "never released")
 
     def _wait_local_admission(self, clk: int,
                               timeout: Optional[float] = None) -> None:
@@ -2779,8 +2818,13 @@ class ShardedTable:
                 self.monitor.check()
                 if self.monitor is not None else set())
             if dead:
+                _fl.poison("pull_peer_failure",
+                           {"table": self.name, "dead": sorted(dead),
+                            "where": "local_admission"})
                 raise PeerFailureError(dead)
             if time.monotonic() > deadline:
+                _fl.poison("admission_deadline",
+                           {"table": self.name, "clk": int(clk)})
                 raise TimeoutError(
                     f"pull({self.name}): local admission for clock "
                     f"{clk} never opened")
@@ -2970,6 +3014,7 @@ class ShardedTable:
                 return self._pull_all_once()
             except _ReissuePullAll:
                 continue
+        _fl.poison("pull_all_churn", {"table": self.name})
         raise TimeoutError(
             f"pull_all({self.name}): shard assembly kept losing owners "
             "mid-gather (membership churn outran the retry budget)")
@@ -3061,26 +3106,39 @@ class ShardedTable:
         window SOLICITS the owners' pending ack batches while it waits:
         batching must never convert into a stall."""
         deadline = time.monotonic() + self.pull_timeout
-        with self._push_cond:
-            while len(self._inflight) >= self.push_window:
-                if self._dead_ranks:
-                    self._drop_dead_inflight_locked()  # sticky deaths
-                self._solicit_acks_locked()
-                self._push_cond.wait(timeout=0.2)
-                if len(self._inflight) < self.push_window:
-                    break
-                dead = self._fatal_dead(
-                    self.monitor.check()
-                    if self.monitor is not None else set())
-                if dead:
-                    raise PeerFailureError(dead)
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"push({self.name}): ack window jammed "
-                        f"({len(self._inflight)} unacked)")
-            self._push_seq += 1
-            self._inflight[self._push_seq] = (time.monotonic(), owner)
-            return self._push_seq
+        poison = None  # (reason, args): dump OUTSIDE _push_cond below
+        try:
+            with self._push_cond:
+                while len(self._inflight) >= self.push_window:
+                    if self._dead_ranks:
+                        self._drop_dead_inflight_locked()  # sticky
+                    self._solicit_acks_locked()
+                    self._push_cond.wait(timeout=0.2)
+                    if len(self._inflight) < self.push_window:
+                        break
+                    dead = self._fatal_dead(
+                        self.monitor.check()
+                        if self.monitor is not None else set())
+                    if dead:
+                        poison = ("push_peer_failure",
+                                  {"table": self.name,
+                                   "dead": sorted(dead)})
+                        raise PeerFailureError(dead)
+                    if time.monotonic() > deadline:
+                        poison = ("ack_window_deadline",
+                                  {"table": self.name,
+                                   "unacked": len(self._inflight)})
+                        raise TimeoutError(
+                            f"push({self.name}): ack window jammed "
+                            f"({len(self._inflight)} unacked)")
+                self._push_seq += 1
+                self._inflight[self._push_seq] = (time.monotonic(),
+                                                  owner)
+                return self._push_seq
+        except (PeerFailureError, TimeoutError):
+            if poison is not None:
+                _fl.poison(*poison)
+            raise
 
     def _solicit_acks_locked(self) -> None:
         """Ask every owner holding an unacked frame of mine to flush its
@@ -3164,33 +3222,49 @@ class ShardedTable:
         def drained() -> bool:
             return not (self._q_pending
                         or (acks and self._inflight))
-        with self._push_cond:
-            while not drained():
-                if self._dead_ranks:
-                    self._drop_dead_inflight_locked()
+        poison = None  # (reason, args): dump OUTSIDE _push_cond below
+        try:
+            with self._push_cond:
+                while not drained():
+                    if self._dead_ranks:
+                        self._drop_dead_inflight_locked()
+                        if drained():
+                            break
+                    if acks and not self._q_pending:
+                        # everything is on the wire; batched acks may
+                        # be sitting at the owners below their flush
+                        # threshold — solicit them (FIFO: the psQ
+                        # trails the frames)
+                        self._solicit_acks_locked()
+                    self._push_cond.wait(timeout=0.2)
                     if drained():
                         break
-                if acks and not self._q_pending:
-                    # everything is on the wire; batched acks may be
-                    # sitting at the owners below their flush threshold
-                    # — solicit them (FIFO: the psQ trails the frames)
-                    self._solicit_acks_locked()
-                self._push_cond.wait(timeout=0.2)
-                if drained():
-                    break
-                dead = self._fatal_dead(
-                    self.monitor.check()
-                    if self.monitor is not None else set())
-                if dead:
-                    raise PeerFailureError(dead)
-                if time.monotonic() > deadline:
-                    if self._fatal is None:
-                        self._fatal = (
-                            f"table {self.name}: push drain timed out "
-                            f"({self._q_pending} queued, "
-                            f"{len(self._inflight)} unacked — lost ack "
-                            "or wedged owner)")
-                    return
+                    dead = self._fatal_dead(
+                        self.monitor.check()
+                        if self.monitor is not None else set())
+                    if dead:
+                        poison = ("drain_peer_failure",
+                                  {"table": self.name,
+                                   "dead": sorted(dead)})
+                        raise PeerFailureError(dead)
+                    if time.monotonic() > deadline:
+                        if self._fatal is None:
+                            self._fatal = (
+                                f"table {self.name}: push drain timed "
+                                f"out ({self._q_pending} queued, "
+                                f"{len(self._inflight)} unacked — "
+                                "lost ack or wedged owner)")
+                        poison = ("drain_deadline",
+                                  {"table": self.name,
+                                   "queued": self._q_pending,
+                                   "unacked": len(self._inflight)})
+                        break  # the caller sees the poisoned table
+        except PeerFailureError:
+            if poison is not None:
+                _fl.poison(*poison)
+            raise
+        if poison is not None:  # the drain-deadline (non-raising) exit
+            _fl.poison(*poison)
 
     def _enqueue_push(self, kind: str, arg) -> None:
         """Hand one push to the sender thread, with BACKPRESSURE: at most
@@ -3201,22 +3275,35 @@ class ShardedTable:
         raises instead of hanging."""
         self.check_fatal()
         deadline = time.monotonic() + self.pull_timeout
-        with self._push_cond:
-            while self._q_pending >= self.push_window:
-                self._push_cond.wait(timeout=0.2)
-                self.check_fatal()  # sender poisoned while we waited
-                if self._q_pending < self.push_window:
-                    break
-                dead = self._fatal_dead(
-                    self.monitor.check()
-                    if self.monitor is not None else set())
-                if dead:
-                    raise PeerFailureError(dead)
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"push({self.name}): send queue jammed "
-                        f"({self._q_pending} steps unsent)")
-            self._q_pending += 1
+        poison = None  # (reason, args): dump OUTSIDE _push_cond below
+        try:
+            with self._push_cond:
+                while self._q_pending >= self.push_window:
+                    self._push_cond.wait(timeout=0.2)
+                    self.check_fatal()  # sender poisoned while we wait
+                    if self._q_pending < self.push_window:
+                        break
+                    dead = self._fatal_dead(
+                        self.monitor.check()
+                        if self.monitor is not None else set())
+                    if dead:
+                        poison = ("push_peer_failure",
+                                  {"table": self.name,
+                                   "dead": sorted(dead),
+                                   "where": "send_queue"})
+                        raise PeerFailureError(dead)
+                    if time.monotonic() > deadline:
+                        poison = ("send_queue_deadline",
+                                  {"table": self.name,
+                                   "queued": self._q_pending})
+                        raise TimeoutError(
+                            f"push({self.name}): send queue jammed "
+                            f"({self._q_pending} steps unsent)")
+                self._q_pending += 1
+        except (PeerFailureError, TimeoutError):
+            if poison is not None:
+                _fl.poison(*poison)
+            raise
         self._push_q.put((kind, arg))
 
     def push(self, keys: np.ndarray, grads: np.ndarray) -> None:
@@ -3694,6 +3781,7 @@ def tables_hist_stats(tables) -> dict:
         [t.timers.snapshot() for t in tables])
     serve = merge_counts([t.hist_serve.snapshot() for t in tables])
     park = merge_counts([t.hist_park.snapshot() for t in tables])
+    fence = merge_counts([t.hist_fence.snapshot() for t in tables])
     # replica serve durations (serve/plane.py): merge_counts([]) is all
     # zeros, so plane-off runs report {"count": 0} like every idle
     # quantity here — the serve plane's own off-vs-idle distinction
@@ -3708,6 +3796,7 @@ def tables_hist_stats(tables) -> dict:
         "push_ack_ms": summarize_counts(tsnap["hists"]["push_ack"]),
         "serve_ms": summarize_counts(serve),
         "park_ms": summarize_counts(park),
+        "fence_ms": summarize_counts(fence),
         "replica_serve_ms": summarize_counts(replica),
     }
 
@@ -3761,6 +3850,7 @@ class ShardedPSTrainer:
         # fleet-wide, so serving reads never park on the in-flight step
         self.gated_clock = 0
         _trc.maybe_init(bus.my_id)  # MINIPS_TRACE: arm the wire tracer
+        _fl.maybe_init(bus.my_id)   # flight recorder: ON unless =0
         self.gossip = ClockGossip(bus, num_processes, workers_per_process=1)
         self.gate = StalenessGate(self.gossip, staleness,
                                   timeout=gate_timeout, monitor=monitor)
@@ -3858,6 +3948,88 @@ class ShardedPSTrainer:
         from minips_tpu.comm.chaos import install_chaos_kill
 
         self._kill_check = install_chaos_kill(bus.my_id, num_processes)
+        # windowed metrics layer (obs/window.py): ALWAYS ON
+        # (MINIPS_OBS=0 only for the OBS-TAX honesty arm) — rolled at
+        # every clock boundary, it is what turns the cumulative hists/
+        # counters above into "now" signals: the autoscaler's p99
+        # arming reads it (balance/rebalancer._send_heat), the done
+        # line's "window" block reports it, and the flight recorder
+        # snapshots it into every dump. Built LAST so registration can
+        # see every armed subsystem.
+        self.obs_window = _ow.maybe_build()
+        if self.obs_window is not None:
+            self._register_window_signals()
+        fl = _fl.FLIGHT
+        if fl is not None:
+            # the black box's final windowed-metrics snapshot: every
+            # dump carries the fleet's last K intervals, not the
+            # since-boot aggregate (None when the window layer is off)
+            fl.snapshot_hook = (self.window_stats
+                                if self.obs_window is not None
+                                else None)
+
+    def _register_window_signals(self) -> None:
+        """Point the windowed layer at every cumulative signal the
+        stack already keeps — no second recording path anywhere: the
+        hot paths keep feeding the one histogram/counter, the window
+        snapshots deltas once per clock boundary. Layers that are off
+        simply never register (their done-line window entries are
+        absent, matching their None top-level blocks)."""
+        ow = self.obs_window
+
+        def _hist_fn(hists):
+            if len(hists) == 1:
+                # the common one-table shape: hand the ROLL the live
+                # counts list — roll's own list(fn()) is the only copy
+                # (reading int buckets under the GIL is safe; a racing
+                # increment lands in the next interval's delta). The
+                # roll runs once per clock boundary, but a 3-way
+                # oversubscribed host still notices every extra lock
+                # hop and copy in it.
+                h = hists[0]
+                return lambda: h.counts
+            return lambda: merge_counts([h.snapshot() for h in hists])
+
+        tables = list(self.tables.values())
+        for name in ("pull_latency", "pull_blocked", "push_ack"):
+            ow.register_hist(name, _hist_fn(
+                [t.timers.hists[name] for t in tables]))
+        ow.register_hist("serve",
+                         _hist_fn([t.hist_serve for t in tables]))
+        ow.register_hist("park",
+                         _hist_fn([t.hist_park for t in tables]))
+        ow.register_hist("fence",
+                         _hist_fn([t.hist_fence for t in tables]))
+        ow.register_counter("frames_dropped",
+                            lambda: self.frames_dropped)
+        ow.register_counter("wire_frames_lost",
+                            lambda: self.wire_frames_lost)
+        ow.register_counter("gate_waits",
+                            lambda: self.gate.gate_waits)
+        if self.serve_plane is not None:
+            ow.register_hist("replica_serve", lambda: merge_counts(
+                [t._sv.hist_replica.snapshot() for t in tables
+                 if t._sv is not None]))
+
+            def _sv_sig(key):
+                return lambda: sum(
+                    t._sv.load_signal()[key] for t in tables
+                    if t._sv is not None)
+
+            ow.register_counter("shed", _sv_sig("shed"))
+            ow.register_counter("backpressure", _sv_sig("bp"))
+        rel = getattr(self.bus, "reliable", None)
+        if rel is not None:
+            ow.register_counter(
+                "retransmits", lambda: rel.stats["retransmits_got"])
+            ow.register_counter(
+                "gave_up", lambda: rel.stats["gave_up"])
+            ow.register_gauge("gap_age_s", rel.oldest_gap_age)
+        if self.monitor is not None and hasattr(self.monitor,
+                                                "stall_forgiven"):
+            ow.register_counter(
+                "hb_stall_forgiven",
+                lambda: self.monitor.stall_forgiven)
 
     def _gate_poll(self) -> None:
         """Gate-wait poll (StalenessGate.poll_hook): the adoption and
@@ -3938,6 +4110,12 @@ class ShardedPSTrainer:
             # and before the clock frame — the corpse's last published
             # clock is the previous step's, exactly a mid-step loss
             self._kill_check(self.clock)
+        if self.obs_window is not None:
+            # close the previous step's metrics interval BEFORE any
+            # control decision below (autoscaler signals, rbH reports)
+            # reads a windowed value — the roll is this boundary's one
+            # snapshot pass over the cumulative hists/counters
+            self.obs_window.roll()
         drain = self.staleness != float("inf")
         for t in self.tables.values():
             if drain:
@@ -4045,13 +4223,17 @@ class ShardedPSTrainer:
                         live = peers - self.gossip.excluded
                         missing = sorted((live - self._flushed)
                                          | (live - self._acked))
+                    _fl.poison("finalize_deadline",
+                               {"missing": missing})
                     raise TimeoutError(
                         f"finalize: peers {missing} never quiesced")
         finally:
-            # the per-rank trace survives the run either way: a clean
-            # finalize dumps here, a poisoned one dumps here AND again
-            # at atexit (idempotent) with whatever events followed
+            # the per-rank trace AND the flight box survive the run
+            # either way: a clean finalize dumps here, a poisoned one
+            # dumps here AND again at atexit (idempotent) with
+            # whatever events followed
             _trc.dump_now()
+            _fl.dump_now()
 
     def shutdown_barrier(self, timeout: float = 10.0) -> None:
         """Rendezvous before closing the bus: finalize() only quiesces
@@ -4158,6 +4340,25 @@ class ShardedPSTrainer:
         Always a dict (the layer is always on); a quantity with no
         samples yet reports ``{"count": 0}`` — idle, not off."""
         return tables_hist_stats(self.tables.values())
+
+    def window_stats(self) -> Optional[dict]:
+        """The done-line ``window`` block (obs/window.py record): per-
+        signal quantiles/rates over the last K clock boundaries. None
+        when the layer is OFF (``MINIPS_OBS=0``); an armed-but-idle
+        window reports ``{"count": 0}`` per hist — the PR5/PR6
+        off-vs-idle convention, pinned by the schema test."""
+        return (self.obs_window.record()
+                if self.obs_window is not None else None)
+
+    def heartbeat_stats(self) -> Optional[dict]:
+        """Liveness-layer counters (comm/heartbeat.py stats): the
+        ``stall=`` forgiveness window's arming and HITS — a forgiven
+        stall is detection latency the operator traded for and must be
+        visible, not silent. None when no monitor is attached."""
+        mon = self.monitor
+        if mon is None or not hasattr(mon, "stats"):
+            return None
+        return mon.stats()
 
     def serve_stats(self) -> dict:
         """Per-owner serve-load counters summed over tables (always on):

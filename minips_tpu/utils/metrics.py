@@ -79,6 +79,18 @@ def wire_record(trainer) -> dict:
         # no samples reports {"count": 0} — "idle", distinct from the
         # None an OFF layer (cache/reliable/chaos/rebalance) reports
         "hist": trainer.hist_stats(),
+        # WINDOWED metrics (obs/window.py): quantiles/rates over the
+        # last K clock boundaries, next to the cumulative hist block —
+        # None when the layer is off (MINIPS_OBS=0, the tax arm), idle
+        # quantities {"count": 0} as above (getattr: the bench worker's
+        # standalone record has no trainer behind it)
+        "window": getattr(trainer, "window_stats", lambda: None)(),
+        # heartbeat liveness-layer counters (comm/heartbeat.py): the
+        # stall= forgiveness window's hits — a forgiven stall must be
+        # visible, an operator can't tell forgiveness from health
+        # otherwise. None when no monitor rides this trainer.
+        "heartbeat": getattr(trainer, "heartbeat_stats",
+                             lambda: None)(),
         # row-cache counters (train/sharded_ps.RowCache): None when every
         # table runs cache-off, so scrapers can tell "off" from "cold"
         "cache": trainer.cache_stats(),

@@ -17,7 +17,8 @@ from bench_regression import (backend_mismatch, cache_tripwires,  # noqa: E402
                               chaos_tripwires, compare,
                               control_plane_tripwires,
                               elastic_tripwires, main,
-                              mesh_tripwires, rebalance_tripwires,
+                              mesh_tripwires, obs_tripwires,
+                              rebalance_tripwires,
                               serve_tripwires, shape_mismatch,
                               throughput_points, trace_tripwires,
                               transport_tripwires)
@@ -322,6 +323,59 @@ def test_trace_tripwire_unmergeable_or_flowless_trace_fails():
     assert any("TRACE-MERGE" in p for p in probs)
     probs = trace_tripwires(_trace_art(flows=0))
     assert any("TRACE-MERGE" in p for p in probs)
+
+
+def _obs_art(off=100.0, on=95.0, dumps=2, merge_ok=True,
+             kill_completed=True, flight_fields=True):
+    kill = {"completed": kill_completed, "lease_term": 1}
+    if flight_fields:
+        kill["flight_dumps"] = dumps
+        kill["flight_merge_ok"] = merge_ok
+    return {"metric": "m",
+            "obs_tax_3proc": {
+                "obs_off": {"rows_per_sec_per_process": off},
+                "obs_on": {"rows_per_sec_per_process": on}},
+            "control_plane_3proc": {"kill": kill}}
+
+
+def test_obs_tripwire_passes_on_healthy_artifact():
+    assert obs_tripwires(_obs_art()) == []
+    # vacuous on artifacts without the sweep / flight fields (an older
+    # bench's artifact is not judged for gates its code predates)
+    assert obs_tripwires({"metric": "m"}) == []
+    art = _obs_art(flight_fields=False)
+    del art["obs_tax_3proc"]
+    assert obs_tripwires(art) == []
+    # 15% is the line: 85.0 exactly passes
+    assert obs_tripwires(_obs_art(on=85.0)) == []
+
+
+def test_obs_tripwire_tax_beyond_band_fails():
+    probs = obs_tripwires(_obs_art(on=80.0))
+    assert len(probs) == 1 and "OBS-TAX" in probs[0]
+    # a missing on-arm rate is a tax failure, not a silent pass
+    art = _obs_art()
+    del art["obs_tax_3proc"]["obs_on"]["rows_per_sec_per_process"]
+    assert any("OBS-TAX" in p for p in obs_tripwires(art))
+    # and so is a dead/missing OFF arm: the layer can't be priced
+    art = _obs_art()
+    del art["obs_tax_3proc"]["obs_off"]["rows_per_sec_per_process"]
+    assert any("OBS-TAX" in p for p in obs_tripwires(art))
+
+
+def test_obs_tripwire_flight_dump_gate():
+    # fewer dumps than survivors = a black box silently fell off
+    probs = obs_tripwires(_obs_art(dumps=1))
+    assert any("FLIGHT-DUMP" in p for p in probs)
+    probs = obs_tripwires(_obs_art(dumps=0))
+    assert any("FLIGHT-DUMP" in p for p in probs)
+    # merge CLI failure trips independently of the dump count
+    probs = obs_tripwires(_obs_art(merge_ok=False))
+    assert any("FLIGHT-DUMP" in p for p in probs)
+    # an arm that did not complete is the CTRL-FAILOVER gate's problem,
+    # not this one's (its flight fields may be missing or partial)
+    assert obs_tripwires(_obs_art(kill_completed=False,
+                                  dumps=0, merge_ok=False)) == []
 
 
 def _storm_art(*, off_reads=2000.0, on_reads=3000.0, off_p50=15.0,

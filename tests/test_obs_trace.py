@@ -397,8 +397,8 @@ def test_wire_record_schema_full_layout():
         h.close()
     expected = {"bytes_pushed", "bytes_pulled", "frames_dropped",
                 "wire_frames_lost", "wire_frames_malformed", "timing",
-                "hist", "cache", "ef", "reliable", "chaos", "serve",
-                "rebalance", "membership"}
+                "hist", "window", "heartbeat", "cache", "ef",
+                "reliable", "chaos", "serve", "rebalance", "membership"}
     assert expected <= set(rec)
     # layers OFF in this run report None — not {} — and vice versa
     assert rec["cache"] is None
@@ -407,14 +407,16 @@ def test_wire_record_schema_full_layout():
     assert rec["chaos"] is None
     assert rec["rebalance"] is None
     assert rec["membership"] is None
+    assert rec["heartbeat"] is None  # no monitor attached: off
     # the hist block is ALWAYS a dict; populated quantities carry the
     # quantiles, idle ones carry {"count": 0}
     hist = rec["hist"]
     assert set(hist) == {"pull_latency_ms", "pull_blocked_ms",
                          "push_ack_ms", "serve_ms", "park_ms",
-                         "replica_serve_ms"}
+                         "fence_ms", "replica_serve_ms"}
     assert hist["pull_latency_ms"]["count"] > 0
     assert hist["replica_serve_ms"] == {"count": 0}  # plane off: idle
+    assert hist["fence_ms"] == {"count": 0}  # no migrations: idle
     # the serving plane's off-vs-idle marker rides INSIDE the serve
     # block: None here (plane off; an armed-idle run reports zeros)
     assert rec["serve"]["replica"] is None
@@ -424,6 +426,17 @@ def test_wire_record_schema_full_layout():
     # the timing block carries quantiles next to the means
     assert rec["timing"]["pull_latency_ms_p50"] is not None
     assert rec["timing"]["pull_latency_ms_mean"] is not None
+    # the WINDOWED layer (obs/window.py) is always on by default: the
+    # window block is a dict whose per-signal entries follow the same
+    # off-vs-idle convention ({"count": 0} idle window), and the
+    # pull-latency window saw this run's pulls
+    win = rec["window"]
+    assert win is not None and win["rolls"] >= 4
+    assert win["hist"]["pull_latency"]["count"] > 0
+    assert win["hist"]["fence"] == {"count": 0}
+    # layers that are off never register their window signals
+    assert "shed" not in win["rate_per_s"]
+    assert "retransmits" not in win["rate_per_s"]
 
 
 def test_app_done_line_splats_wire_record(capsys):

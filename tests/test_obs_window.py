@@ -1,0 +1,590 @@
+"""Windowed metrics + flight recorder (this PR's tentpole).
+
+Unit tier: the MINIPS_OBS spec parser, window rotation and the
+delta-sum == recompute property, off-vs-idle conventions, counter
+rates/re-baselining, gauges; the flight recorder's bounded typed ring,
+atomic + RE-ENTRANT dump (two poison paths firing concurrently — the
+satellite-6 regression), env gating, default-dir run-id keying, stale
+sweep, and the merge CLI's offset-aligned timeline.
+
+Autoscaler tier: the ROADMAP item 3(b) close — the windowed p99 arms
+STRICTLY no later than the cumulative signal under a storm breaking on
+long calm history, and DISARMS within one window after the storm ends,
+where the cumulative hist provably cannot (it never forgets a storm —
+the old behavior, asserted gone from the rbH report).
+
+Drill tier (slow): a seeded 3-proc MINIPS_CHAOS_KILL run with NO
+observability env armed leaves per-rank flight dumps in the DEFAULT
+directory from which the merge CLI reconstructs the failure sequence
+(death verdict → term advance → death plan, with signal values).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu.obs import flight as fl
+from minips_tpu.obs.hist import Log2Histogram, summarize_counts
+from minips_tpu.obs.window import (ObsWindowConfig, WindowedMetrics,
+                                   maybe_build)
+
+APP = "minips_tpu.apps.sharded_ps_example"
+
+
+# ------------------------------------------------------------ spec parsing
+def test_obs_config_parse_defaults_off_and_knobs():
+    cfg = ObsWindowConfig.parse("")
+    assert (cfg.window, cfg.ring) == (8, 32)
+    assert ObsWindowConfig.parse("1").window == 8
+    assert ObsWindowConfig.parse("0") is None          # the tax arm
+    cfg = ObsWindowConfig.parse("window=4,ring=16")
+    assert (cfg.window, cfg.ring) == (4, 16)
+    with pytest.raises(ValueError, match="unknown knob"):
+        ObsWindowConfig.parse("cap=9")
+    with pytest.raises(ValueError, match="k=v"):
+        ObsWindowConfig.parse("window")
+    with pytest.raises(ValueError, match="ring"):
+        ObsWindowConfig.parse("window=16,ring=4")  # ring < window
+    assert maybe_build("0") is None
+    assert maybe_build("window=2,ring=4").window == 2
+
+
+# --------------------------------------------------------- window semantics
+def test_window_quantile_forgets_a_storm_the_cumulative_hist_cannot():
+    """THE carry-forward pin (ROADMAP 3(b)): after a storm ends, the
+    windowed p99 returns to calm within `window` rolls; the cumulative
+    hist's p99 keeps reporting the storm forever."""
+    h = Log2Histogram()
+    w = WindowedMetrics(window=3, ring=8)
+    w.register_hist("lat", lambda: h.counts)
+    for _ in range(50):
+        h.record_us(400_000.0)  # the storm: 400ms tails
+    w.roll()
+    assert w.quantile_ms("lat", 0.99) > 100.0
+    for _ in range(3):          # calm: no samples at all
+        w.roll()
+    assert w.quantile_ms("lat", 0.99) is None  # idle window = calm
+    # the OLD signal never forgets — this is exactly why it was replaced
+    assert summarize_counts(h.counts)["p99_ms"] > 100.0
+    # calm traffic (fast samples) keeps the window honest too
+    for _ in range(3):
+        for _ in range(50):
+            h.record_us(100.0)
+        w.roll()
+    assert w.quantile_ms("lat", 0.99) < 1.0
+    assert summarize_counts(h.counts)["p99_ms"] > 100.0
+
+
+def test_window_delta_sum_equals_recompute_over_window():
+    """Property (seeded): for any sample/roll schedule and any window
+    k, the ring's elementwise delta sum equals the difference of the
+    cumulative snapshots at the window's edges — the fixed-bucket merge
+    argument, applied over time."""
+    rng = np.random.default_rng(7)
+    h = Log2Histogram()
+    w = WindowedMetrics(window=4, ring=16)
+    w.register_hist("lat", lambda: h.counts)
+    snaps = [list(h.counts)]  # cumulative snapshot at each roll edge
+    for _ in range(12):
+        for us in rng.integers(1, 10_000_000, rng.integers(0, 40)):
+            h.record_us(float(us))
+        w.roll()
+        snaps.append(list(h.counts))
+    for k in (1, 2, 4, 7, 16):
+        got = w.window_counts("lat", window=k)
+        kk = min(k, len(snaps) - 1)
+        want = [a - b for a, b in zip(snaps[-1], snaps[-1 - kk])]
+        assert got == want, (k, got, want)
+
+
+def test_window_rotation_ring_bound_and_clamping():
+    h = Log2Histogram()
+    w = WindowedMetrics(window=2, ring=3)
+    w.register_hist("lat", lambda: h.counts)
+    for i in range(10):
+        h.record_us(10.0)
+        w.roll()
+    # ring holds only the last 3 deltas; a wider window clamps to it
+    assert sum(w.window_counts("lat", window=100)) == 3
+    assert sum(w.window_counts("lat")) == 2  # the default window
+    assert w.rolls == 10
+    with pytest.raises(ValueError):
+        w.window_counts("lat", window=0)
+    assert w.window_counts("nope") is None
+    assert w.summarize("nope") is None
+
+
+def test_counter_rate_rebaseline_and_registration_priming():
+    c = {"v": 100.0}  # pre-registration history must never be counted
+    t = [0.0]
+    w = WindowedMetrics(window=4, ring=8, clock=lambda: t[0])
+    w.register_counter("shed", lambda: c["v"])
+    c["v"] += 10
+    t[0] = 1.0
+    w.roll()
+    assert w.delta_sum("shed") == 10.0
+    assert w.rate("shed") == 10.0  # 10 events / 1s span
+    # a BACKWARD counter (restarted layer) re-baselines, never negative
+    c["v"] = 3.0
+    t[0] = 2.0
+    w.roll()
+    assert w.delta_sum("shed") == 10.0  # 10 + max(3-110, 0)
+    c["v"] = 5.0
+    t[0] = 3.0
+    w.roll()
+    assert w.delta_sum("shed") == 12.0  # rebaselined at 3 → +2
+
+
+def test_gauge_last_and_max():
+    g = {"v": 0.0}
+    w = WindowedMetrics(window=3, ring=8)
+    w.register_gauge("gap_age", lambda: g["v"])
+    assert w.gauge("gap_age") is None  # no rolls yet
+    for v in (1.0, 5.0, 2.0):
+        g["v"] = v
+        w.roll()
+    assert w.gauge("gap_age") == 2.0
+    assert w.gauge("gap_age", agg="max") == 5.0
+    assert w.gauge("gap_age", agg="max", window=1) == 2.0
+
+
+def test_record_follows_off_vs_idle_convention():
+    h = Log2Histogram()
+    w = WindowedMetrics(window=2, ring=4)
+    w.register_hist("lat", lambda: h.counts)
+    w.register_counter("shed", lambda: 0.0)
+    rec = w.record()
+    assert rec["hist"]["lat"] == {"count": 0}  # armed but idle
+    assert rec["events"]["shed"] == 0
+    h.record_us(500.0)
+    w.roll()
+    rec = w.record()
+    assert rec["hist"]["lat"]["count"] == 1
+    assert rec["rolls"] == 1 and rec["window"] == 2
+
+
+# ------------------------------------------- autoscaler signal A/B drill
+def _p99_streams(schedule_ms, window):
+    """One latency schedule (list of per-tick sample lists, ms) →
+    (windowed p99 stream, cumulative p99 stream) — the two candidate
+    autoscaler signals derived from the SAME histogram."""
+    h = Log2Histogram()
+    w = WindowedMetrics(window=window, ring=window * 2)
+    w.register_hist("lat", lambda: h.counts)
+    windowed, cumulative = [], []
+    for tick in schedule_ms:
+        for ms in tick:
+            h.record_us(ms * 1e3)
+        w.roll()
+        windowed.append(w.quantile_ms("lat", 0.99))
+        cumulative.append(summarize_counts(h.counts).get("p99_ms"))
+    return windowed, cumulative
+
+
+def _drive_autoscaler(p99_stream, spec):
+    """Feed a p99-per-tick stream through a fake-backed Autoscaler
+    (the rbH report shape) and return its hot-tick count per tick."""
+    from tests.test_control_plane import _mk_autoscaler
+
+    tr, mb, a = _mk_autoscaler(spec)
+    hot = []
+    for p in p99_stream:
+        tr.rebalancer.reports = {
+            r: {"total": 10.0, "sv": {"shed": 0.0}, "p99": p}
+            for r in (0, 1, 2)}
+        a.on_tick()
+        hot.append(a.counters["hot_ticks"])
+    return hot
+
+
+def test_windowed_p99_arms_no_later_and_disarms_where_cumulative_cannot():
+    """The acceptance A/B: a storm breaking on long calm history ARMS
+    the windowed signal strictly no later than the cumulative one
+    (fresh deltas vs history-diluted quantile), and after the storm
+    ends the windowed signal DISARMS within one window while the
+    cumulative hist keeps the autoscaler hot forever."""
+    WINDOW = 4
+    # 50 calm ticks × 2000 samples: 100k of history — old enough that
+    # the window has forgotten all but the last 3 ticks of it, big
+    # enough that the cumulative p99 needs several storm ticks before
+    # the slow tail crosses its 1% mass
+    calm_hist = [[0.1] * 2000 for _ in range(50)]
+    storm = [[400.0] * 400 for _ in range(4)]
+    calm_after = [[0.1] * 50 for _ in range(12)]
+    schedule = calm_hist + storm + calm_after
+    windowed, cumulative = _p99_streams(schedule, WINDOW)
+    spec = "up_shed=1e9,up_p99_ms=100,up_after=1,down_after=2,cool=0"
+    hot_w = _drive_autoscaler(windowed, spec)
+    hot_c = _drive_autoscaler(cumulative, spec)
+
+    def arm_tick(hot):
+        return next(i for i, hcount in enumerate(hot) if hcount > 0)
+
+    # ARMING: windowed strictly no later (here strictly earlier: the
+    # cumulative p99 needs the slow tail to exceed 1% of ALL history)
+    assert arm_tick(hot_w) < arm_tick(hot_c)
+    assert arm_tick(hot_w) == len(calm_hist)  # the FIRST storm tick
+    # DISARMING: within one window of the storm's end the windowed
+    # signal reads calm and hot_ticks STOPS growing...
+    settle = len(calm_hist) + len(storm) + WINDOW
+    assert hot_w[settle:] == [hot_w[settle]] * len(hot_w[settle:])
+    # ...while the cumulative signal stays hot EVERY tick to the end of
+    # the horizon — the old behavior, now confined to MINIPS_OBS=0
+    assert hot_c[-1] == len(hot_c) - arm_tick(hot_c)
+    assert windowed[-1] is None or windowed[-1] < 100
+    assert cumulative[-1] > 100
+
+
+def test_send_heat_reports_windowed_p99_not_cumulative():
+    """Integration pin on the rbH wire: with the window layer armed the
+    report's p99 field is the WINDOWED quantile (None once a storm ages
+    out — the disarm evidence), not the cumulative summary."""
+    from tests.test_control_plane import _mk_lockstep_pair
+
+    buses, tables, trainers = _mk_lockstep_pair(elastic="1",
+                                                autoscale="1")
+    try:
+        tr0 = trainers[0]
+        assert tr0.obs_window is not None  # always-on by default
+        rb = tr0.rebalancer
+        for _ in range(20):
+            tables[0].timers.record_pull(0.4, 0.4)  # 400ms storm
+        tr0.obs_window.roll()
+        rb._send_heat("t", tables[0])
+        rep = rb.heat_reports("t")[0]
+        assert rep["p99"] is not None and rep["p99"] > 100.0
+        for _ in range(tr0.obs_window.window):
+            tr0.obs_window.roll()  # the storm ages out of the window
+        rb._send_heat("t", tables[0])
+        rep = rb.heat_reports("t")[0]
+        # the OLD behavior (cumulative — never forgets) is GONE:
+        assert rep["p99"] is None
+        assert summarize_counts(
+            tables[0].timers.snapshot()["hists"]["pull_latency"]
+        )["p99_ms"] > 100.0
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_obs_off_env_disables_window_and_keeps_cumulative_signal(
+        monkeypatch):
+    """MINIPS_OBS=0 (the tax arm): the trainer builds no window layer,
+    window_stats reports None (off ≠ idle), and the rbH p99 falls back
+    to the cumulative quantile."""
+    monkeypatch.setenv("MINIPS_OBS", "0")
+    from tests.test_control_plane import _mk_lockstep_pair
+
+    buses, tables, trainers = _mk_lockstep_pair(elastic="1",
+                                                autoscale="1")
+    try:
+        tr0 = trainers[0]
+        assert tr0.obs_window is None
+        assert tr0.window_stats() is None
+        for _ in range(5):
+            tables[0].timers.record_pull(0.2, 0.2)
+        tr0.rebalancer._send_heat("t", tables[0])
+        rep = tr0.rebalancer.heat_reports("t")[0]
+        assert rep["p99"] is not None and rep["p99"] > 100.0
+    finally:
+        for b in buses:
+            b.close()
+
+
+# ------------------------------------------------------- flight recorder
+@pytest.fixture
+def flight_box(tmp_path):
+    """A fresh recorder in a tmp dir; restores the global after."""
+    fl.reset_for_tests()
+    rec = fl.init(0, str(tmp_path / "box"))
+    yield rec
+    fl.reset_for_tests()
+
+
+def test_flight_ring_is_bounded_and_drops_oldest(tmp_path):
+    fl.reset_for_tests()
+    try:
+        rec = fl.init(3, str(tmp_path), cap=4)
+        for i in range(10):
+            rec.ev("e", {"i": i})
+        rec.dump()
+        doc = json.load(open(rec.out_path))
+        assert doc["rank"] == 3 and doc["cap"] == 4
+        assert [e["args"]["i"] for e in doc["events"]] == [6, 7, 8, 9]
+    finally:
+        fl.reset_for_tests()
+
+
+def test_flight_dump_is_atomic_idempotent_and_carries_window(flight_box):
+    rec = flight_box
+    rec.ev("hb_death", {"rank": 1})
+    rec.snapshot_hook = lambda: {"rolls": 7}
+    p1 = rec.dump()
+    p2 = rec.dump()  # idempotent: re-dump rewrites whole
+    assert p1 == p2 == rec.out_path
+    assert not [f for f in os.listdir(os.path.dirname(p1))
+                if ".tmp" in f]  # no torn tmp left behind
+    doc = json.load(open(p1))
+    assert doc["window"] == {"rolls": 7}
+    assert doc["events"][0]["kind"] == "hb_death"
+    assert doc["reasons"] == []
+    # a snapshot hook that BLOWS UP must not lose the box
+    rec.snapshot_hook = lambda: 1 / 0
+    rec.dump()
+    doc = json.load(open(p1))
+    assert doc["window"] == {"error": "snapshot_hook failed"}
+
+
+def test_flight_poison_reentrant_concurrent_paths(flight_box):
+    """THE satellite-6 regression: two poison paths firing concurrently
+    (gate timeout racing the heartbeat verdict) must both land — the
+    dump serializes on its lock, the reasons list is append-only, and
+    the file is complete valid JSON after every interleaving."""
+    rec = flight_box
+    n_threads, n_each = 6, 5
+    barrier = threading.Barrier(n_threads)
+
+    def path(i):
+        barrier.wait()
+        for j in range(n_each):
+            rec.poison(f"poison_{i}", {"j": j})
+
+    ths = [threading.Thread(target=path, args=(i,))
+           for i in range(n_threads)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    doc = json.load(open(rec.out_path))  # parses = never torn
+    kinds = [r["kind"] for r in doc["reasons"]]
+    assert len(kinds) == n_threads * n_each
+    for i in range(n_threads):
+        assert kinds.count(f"poison_{i}") == n_each
+    assert rec.dumps == n_threads * n_each
+
+
+def test_flight_checkpoint_is_not_a_poison(flight_box):
+    """Autoscaler actions dump via checkpoint(): recorded + dumped,
+    but NOT a reason — healthy scaling must not read as failure on the
+    merged timeline (review-round fix)."""
+    fl.checkpoint("as_admit", {"shed_rate": 5.0})
+    doc = json.load(open(flight_box.out_path))
+    assert [e["kind"] for e in doc["events"]] == ["as_admit"]
+    assert doc["reasons"] == []
+    merged, _ = fl.merge_dumps({0: doc})
+    assert merged["flight"][0]["poison"] is False
+
+
+def test_flight_reasons_list_is_bounded(tmp_path):
+    """A poison LOOP must not grow the reasons list without bound —
+    past the cap the dropped counter testifies instead."""
+    fl.reset_for_tests()
+    try:
+        rec = fl.init(0, str(tmp_path), cap=16)
+        for i in range(rec._MAX_REASONS + 7):
+            if len(rec._reasons) < rec._MAX_REASONS:
+                rec._reasons.append((0.0, f"p{i}", None))
+            else:
+                rec.poison(f"p{i}")
+        assert len(rec._reasons) == rec._MAX_REASONS
+        assert rec.reasons_dropped == 7
+        doc = json.load(open(rec.out_path))
+        assert doc["reasons_dropped"] == 7
+    finally:
+        fl.reset_for_tests()
+
+
+def test_flight_env_gate_and_default_dir(monkeypatch, tmp_path):
+    fl.reset_for_tests()
+    try:
+        monkeypatch.setenv("MINIPS_FLIGHT", "0")
+        assert fl.maybe_init(0) is None          # the tax arm
+        fl.record("x")                           # no-ops, never raise
+        fl.poison("x")
+        assert fl.dump_now() is None
+        monkeypatch.setenv("MINIPS_FLIGHT",
+                           str(tmp_path / "explicit") + ":cap=9")
+        rec = fl.maybe_init(1)
+        assert rec.cap == 9
+        assert rec.out_dir == str(tmp_path / "explicit")
+        fl.reset_for_tests()
+        monkeypatch.delenv("MINIPS_FLIGHT", raising=False)
+        monkeypatch.setenv("MINIPS_RUN_ID", "424242")
+        assert fl.default_dir() == os.path.join(
+            tempfile.gettempdir(), "minips-flight-424242")
+        with pytest.raises(ValueError, match="unknown option"):
+            fl._parse_spec("/x:zap=1")
+    finally:
+        fl.reset_for_tests()
+
+
+def test_flight_cli_merges_offset_aligned_timeline(tmp_path):
+    """Two synthetic rank dumps with asymmetric heartbeat delays merge
+    onto one aligned timeline (the NTP two-sample estimate), poisons
+    flagged, exit 0 — and exit 1 with nothing to merge."""
+    d = tmp_path / "boxes"
+    d.mkdir()
+
+    def box(rank, t0, events, reasons, hb):
+        json.dump({"rank": rank, "cap": 64,
+                   "events": [{"t_us": t, "kind": k} for t, k in events],
+                   "reasons": [{"t_us": t, "kind": k}
+                               for t, k in reasons],
+                   "hb_delays_us": hb},
+                  open(d / f"flight-rank{rank}.json", "w"))
+
+    # rank 1's clock runs 1000us ahead: its min delay of rank 0's beats
+    # reads 500+1000, rank 0's of rank 1's reads 500-1000 → offset 1000
+    box(0, 0, [(100.0, "hb_death")], [(200.0, "term_advance")],
+        {"1": -500.0})
+    box(1, 0, [(1150.0, "late_event")], [], {"0": 1500.0})
+    out = d / "merged.json"
+    rc = fl.main([str(d), "-o", str(out)])
+    assert rc == 0
+    doc = json.load(open(out))
+    assert doc["summary"]["clock_offsets_us"] == {"0": 0.0,
+                                                  "1": 1000.0}
+    kinds = [e["kind"] for e in doc["flight"]]
+    assert kinds == ["hb_death", "late_event", "term_advance"]
+    assert doc["flight"][2]["poison"] is True
+    # aligned: rank 1's 1150us event lands at 150us, between the two
+    assert doc["flight"][1]["t_us"] == 150.0
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert fl.main([str(empty)]) == 1
+
+
+def test_flight_sweep_reclaims_dead_runs_only(tmp_path, monkeypatch):
+    tmp = tmp_path / "tmp"
+    tmp.mkdir()
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp))
+    alive = tmp / f"minips-flight-{os.getpid()}"
+    dead = tmp / "minips-flight-99999999"  # beyond pid_max: dead
+    named = tmp / "minips-flight-mybox"    # operator's: never touched
+    for p in (alive, dead, named):
+        p.mkdir()
+        (p / "flight-rank0.json").write_text("{}")
+    removed = fl.sweep_stale_dirs()
+    assert removed == 1
+    assert alive.exists() and named.exists() and not dead.exists()
+
+
+def test_heartbeat_stall_forgiveness_is_counted(monkeypatch):
+    """Satellite: a forgiven stall is VISIBLE — the monitor counts it
+    and stats() (the wire_record heartbeat block) carries it; before
+    this a forgiven stall was indistinguishable from health."""
+    from tests.conftest import mk_loopback_buses
+
+    from minips_tpu.comm.heartbeat import HeartbeatMonitor
+
+    monkeypatch.setenv("MINIPS_HEARTBEAT",
+                       "interval=0.05,timeout=1.0,stall=2.0")
+    buses = mk_loopback_buses(2)
+    try:
+        fake = [0.0]
+        mon = HeartbeatMonitor(buses[0], [0, 1], interval=0.05,
+                               timeout=1.0, clock=lambda: fake[0])
+        mon._on_beat(1, {})
+        fake[0] = 0.5
+        mon.check()                      # baseline sweep
+        assert mon.stall_forgiven == 0
+        fake[0] = 5.5                    # 5s observer coma
+        mon.check()                      # forgiven — and COUNTED now
+        assert mon.stall_forgiven == 1
+        st = mon.stats()
+        assert st["stall_s"] == 2.0 and st["stall_forgiven"] == 1
+        assert st["dead"] == []
+    finally:
+        for b in buses:
+            b.close()
+
+
+# ------------------------------------------------------------ slow drill
+@pytest.mark.slow
+def test_chaos_kill_leaves_flight_dumps_with_no_obs_env(tmp_path):
+    """THE acceptance drill: a seeded 3-proc SIGKILL of rank 0 (the
+    lease holder) with NO observability env armed — MINIPS_TRACE,
+    MINIPS_FLIGHT, MINIPS_OBS all explicitly empty — leaves per-rank
+    flight dumps in the DEFAULT directory; every survivor's box carries
+    the death verdict and the term advance with its signal values, and
+    the merge CLI (exit 0) reconstructs verdict → term advance →
+    death plan."""
+    import subprocess
+
+    from minips_tpu import launch
+
+    run_id = str(90_000_000 + os.getpid())  # synthetic, beyond pid_max
+    flight_dir = os.path.join(tempfile.gettempdir(),
+                              f"minips-flight-{run_id}")
+    ck = str(tmp_path / "ck")
+    rc, events = launch.run_local_job_raw(
+        3, [sys.executable, "-m", APP, "--model", "sparse", "--mode",
+            "ssp", "--staleness", "2", "--iters", "30", "--batch",
+            "64", "--checkpoint-dir", ck, "--checkpoint-every", "5"],
+        base_port=None,
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                   "MINIPS_ELASTIC": "1",
+                   "MINIPS_CHAOS_KILL": "7:rank=0,step=12",
+                   "MINIPS_HEARTBEAT": "interval=0.1,timeout=1.0",
+                   # ZERO pre-arming — the whole point of the box:
+                   "MINIPS_TRACE": "", "MINIPS_FLIGHT": "",
+                   "MINIPS_OBS": "",
+                   # namespace the default dir for this drill only (a
+                   # launcher id, not an observability knob)
+                   "MINIPS_RUN_ID": run_id},
+        timeout=240.0, kill_on_failure=False)
+    dones = {r: ev[-1] for r, ev in enumerate(events)
+             if ev and ev[-1].get("event") == "done"}
+    assert set(dones) == {1, 2}, (rc, events)
+    # every SURVIVOR left a box (rank 0 was SIGKILLed: nothing can)
+    all_reasons: list[str] = []
+    for r in (1, 2):
+        path = os.path.join(flight_dir, f"flight-rank{r}.json")
+        assert os.path.exists(path), os.listdir(flight_dir)
+        doc = json.load(open(path))
+        reasons = [e["kind"] for e in doc["reasons"]]
+        all_reasons += reasons
+        assert "hb_death" in reasons, reasons
+        # the final windowed-metrics snapshot rides the dump
+        assert doc["window"] is not None
+        assert doc["window"]["rolls"] > 0
+    # the term ADVANCE decision lands in at least one box — the first
+    # rank to convict decides; a survivor whose own verdict lost the
+    # race to the successor's beat stamp only OBSERVED the new term
+    # (its done line still reads term 1) and legitimately records no
+    # decision of its own
+    assert "term_advance" in all_reasons, all_reasons
+    boxes = {r: json.load(open(os.path.join(
+        flight_dir, f"flight-rank{r}.json"))) for r in (1, 2)}
+    adv = next(e for doc in boxes.values()
+               for e in doc["reasons"] if e["kind"] == "term_advance")
+    # the decision's WHY: the ballot inputs at decision time
+    assert adv["args"]["term"] == 1
+    assert adv["args"]["holder"] == 1
+    assert adv["args"]["dead"] == 0
+    # the successor (rank 1) also planned the death
+    r1_reasons = [e["kind"] for e in boxes[1]["reasons"]]
+    assert "death_plan" in r1_reasons, r1_reasons
+    plan = next(e for e in boxes[1]["reasons"]
+                if e["kind"] == "death_plan")
+    assert plan["args"]["rank"] == 0 and plan["args"]["rstep"] >= 0
+    # the merge CLI reconstructs the sequence with exit 0, on the
+    # MERGED cross-rank timeline (whichever rank decided each step)
+    proc = subprocess.run(
+        [sys.executable, "-m", "minips_tpu.obs.flight", flight_dir],
+        capture_output=True, text=True, timeout=60.0)
+    assert proc.returncode == 0, proc.stderr
+    lines = proc.stdout.splitlines()
+    summary = json.loads(lines[-1])
+    assert sorted(int(r) for r in summary["ranks"]) == [1, 2]
+    timeline = "\n".join(lines[:-1])
+    assert timeline.index("hb_death") < timeline.index("term_advance") \
+        < timeline.index("death_plan")
