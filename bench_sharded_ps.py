@@ -1124,6 +1124,184 @@ def main() -> int:
 
     control_grid = _control_plane_arms()
 
+    # THE PARTITION-TOLERANCE SWEEP (this PR): (1) fence_heal — a
+    # seeded symmetric link cut isolates rank 0 (the lease holder) for
+    # a wall-clock window; the majority convicts it by suspicion
+    # QUORUM (the minority island, suspecting everyone, convicts
+    # nobody — it cannot mint a term), rank 1 takes the lease, the
+    # corpse-that-isn't restores from checkpoint, and post-heal the
+    # reliable layer recovers every cut frame — including the stale
+    # plan the ex-holder issued INSIDE the window (--coord-plan-at),
+    # which must be FENCED by term at every survivor while the
+    # ex-holder itself exits fenced_out (rc 44). (2) handover — the
+    # holder drains ITSELF: lease transferred (term 1 exactly once,
+    # coordinator state shipped in the mbH frame), then the PR8 drain
+    # path, rc 0, zero deaths.
+    def _partition_arms() -> dict:
+        import tempfile
+
+        from minips_tpu import launch as _launch
+
+        p_iters = 40 if args.quick else 80
+        part_at = 8                      # cut opens at receiver clock 8
+        plan_at = part_at + 2            # the ex-holder's stale plan:
+        # issued at A+2, the deepest boundary its own gate (s=2) can
+        # reach once the cut freezes the peers' clocks it heard at A
+        base = [sys.executable, "-m",
+                "minips_tpu.apps.sharded_ps_example",
+                "--model", "sparse", "--mode", "ssp",
+                "--staleness", "2", "--iters", str(p_iters),
+                "--batch", "64", "--checkpoint-every", "4",
+                # rank 0 trails (its stale plan must fire while BOTH
+                # peers are already inside their cut windows) and pulls
+                # only its own shard (no remote pull legs: it wedges at
+                # its gate ~A+2, late enough to issue the plan)
+                "--slow-rank", "0", "--slow-ms", "20",
+                "--own-keys-rank", "0",
+                "--coord-plan-at", str(plan_at),
+                # survivors pace ~25ms/step so they are still training
+                # when the window heals — the stale-plan recovery needs
+                # live receivers
+                "--jitter-ms", "30", "--jitter-prob", "0.8"]
+        env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
+                "MINIPS_SERVE": "", "MINIPS_BUS": "",
+                "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
+                "MINIPS_PUSH_COMM": "", "MINIPS_MESH": "",
+                "MINIPS_AUTOSCALE": "", "MINIPS_OBS": "",
+                "MINIPS_FLIGHT": ""}
+        grid: dict = {"iters": p_iters}
+
+        def rate(dones: list[dict]) -> float:
+            return round(p_iters / max(max(d["wall_s"] for d in dones),
+                                       1e-9), 2)
+
+        with tempfile.TemporaryDirectory() as ck:
+            try:
+                rc, events = _launch.run_local_job_raw(
+                    3, base + ["--checkpoint-dir", ck],
+                    base_port=None,
+                    env_extra={
+                        **env0, "MINIPS_ELASTIC": "1",
+                        # small budget + fast backoff: gaps opened
+                        # against the cut exhaust INSIDE the window
+                        # (give-up), so the post-heal advert must
+                        # REOPEN them — the satellite path, engaged on
+                        # the committed artifact
+                        "MINIPS_RELIABLE":
+                            "budget=4,backoff_ms=25,backoff_max_ms=150,"
+                            "advert_ms=100",
+                        "MINIPS_CHAOS":
+                            f"5:part=1,links=0-1+0-2,at={part_at},"
+                            "for=1.5s",
+                        "MINIPS_HEARTBEAT":
+                            "interval=0.1,timeout=0.7"},
+                    timeout=300.0, kill_on_failure=False)
+                by_last = {r: (ev[-1] if ev else {})
+                           for r, ev in enumerate(events)}
+                dones = [by_last[r] for r in (1, 2)
+                         if by_last[r].get("event") == "done"]
+                if len(dones) == 2:
+                    mships = [d.get("membership") or {} for d in dones]
+                    terms = [(m.get("lease") or {}).get("term")
+                             for m in mships]
+                    sums = {d.get("param_sum") for d in dones}
+                    grid["fence_heal"] = {
+                        "completed": True,
+                        "steps_per_sec_ctrl": rate(dones),
+                        "iters": p_iters,
+                        "clock_min": min(d["clock"] for d in dones),
+                        "lease_term": max(t for t in terms
+                                          if t is not None),
+                        "terms_agree": len(set(terms)) == 1,
+                        # the PARTITION-FENCE evidence: stale-term
+                        # frames dropped at the survivors (lease admit
+                        # fence + rbP plan fence)
+                        "fenced_total": sum(
+                            (m.get("lease") or {}).get("fenced", 0)
+                            for m in mships) + sum(
+                            (d.get("rebalance") or {}).get(
+                                "stale_plans_fenced", 0)
+                            for d in dones),
+                        "ex_coord_fenced_out":
+                            by_last[0].get("event") == "fenced_out",
+                        "part_dropped": sum(
+                            (d.get("chaos") or {}).get(
+                                "part_dropped", 0) for d in dones),
+                        "reliable_reopened": sum(
+                            (d.get("reliable") or {}).get(
+                                "reopened", 0) for d in dones),
+                        "blocks_restored": sum(
+                            m.get("blocks_restored", 0)
+                            for m in mships),
+                        "wire_frames_lost": sum(
+                            d.get("wire_frames_lost", 0)
+                            for d in dones),
+                        "finals_agree": len(sums) == 1,
+                    }
+                else:
+                    grid["fence_heal"] = {
+                        "completed": False,
+                        "error": f"rc={rc}: {by_last}"[:400]}
+            except Exception as e:  # noqa: BLE001 - completion-gated
+                grid["fence_heal"] = {"completed": False,
+                                      "error": str(e)[:300]}
+        # -------- handover: the holder drains itself mid-run
+        h_iters = 20 if args.quick else 30
+        hbase = [sys.executable, "-m",
+                 "minips_tpu.apps.sharded_ps_example",
+                 "--model", "sparse", "--mode", "ssp",
+                 "--staleness", "2", "--iters", str(h_iters),
+                 "--batch", "64",
+                 "--drain-rank", "0", "--drain-at", "10"]
+        try:
+            rc, events = _launch.run_local_job_raw(
+                3, hbase, base_port=None,
+                env_extra={**env0, "MINIPS_ELASTIC": "1",
+                           "MINIPS_AUTOSCALE": "1",
+                           "MINIPS_HEARTBEAT":
+                               "interval=0.1,timeout=2.0"},
+                timeout=240.0, kill_on_failure=False)
+            by_last = {r: (ev[-1] if ev else {})
+                       for r, ev in enumerate(events)}
+            dones = [by_last[r] for r in (1, 2)
+                     if by_last[r].get("event") == "done"]
+            if rc == 0 and len(dones) == 2:
+                mships = [d.get("membership") or {} for d in dones]
+                terms = [(m.get("lease") or {}).get("term")
+                         for m in mships]
+                sums = {d.get("param_sum") for d in dones}
+                drained = by_last[0]
+                grid["handover"] = {
+                    "completed": True,
+                    "steps_per_sec_ctrl": round(
+                        h_iters / max(max(d["wall_s"] for d in dones),
+                                      1e-9), 2),
+                    "lease_term": max(t for t in terms
+                                      if t is not None),
+                    "terms_agree": len(set(terms)) == 1,
+                    "leaver_drained":
+                        drained.get("event") == "drained",
+                    "leaver_handovers": ((drained.get("membership")
+                                          or {}).get("lease")
+                                         or {}).get("handovers"),
+                    "deaths": sum(m.get("deaths", 0) for m in mships),
+                    "clock_min": min(d["clock"] for d in dones),
+                    "iters": h_iters,
+                    "wire_frames_lost": sum(
+                        d.get("wire_frames_lost", 0) for d in dones),
+                    "finals_agree": len(sums) == 1,
+                }
+            else:
+                grid["handover"] = {"completed": False,
+                                    "error": f"rc={rc}: {by_last}"[:400]}
+        except Exception as e:  # noqa: BLE001 - completion-gated
+            grid["handover"] = {"completed": False,
+                                "error": str(e)[:300]}
+        return grid
+
+    partition_grid = _partition_arms()
+
     # THE IN-MESH COLLECTIVE DATA PLANE (this PR): the fused sweep
     # point — dense pull_all/push_dense cycles, the lrmlp weight-vector
     # shape — measured on the host wire (3 procs, zmq, ASP: its best
@@ -1286,6 +1464,7 @@ def main() -> int:
         "pull_storm_3proc": storm_grid,
         "elastic_membership_3proc": elastic_grid,
         "control_plane_3proc": control_grid,
+        "partition_3proc": partition_grid,
         "mesh_plane_fused": mesh_grid,
     }))
     return 0

@@ -70,6 +70,15 @@ admit and >= 1 drain and the post-admit shed rate at or below the
 pre-admit rate; the steady armed-idle arm must complete with zero
 membership changes. Rates ride gate-invisible keys
 (``steps_per_sec_ctrl``) like every chaos arm.
+``partition_tripwires`` (PARTITION-FENCE/PARTITION-HEAL/HANDOVER)
+guards the ``partition_3proc`` sweep: the link-cut arm's minority
+ex-coordinator must exit fenced_out with its recovered stale-term
+plan dropped (fenced) at the survivors, who must complete every step
+at term 1 exactly with zero unrecovered frames, bitwise agreement,
+and the injector provably engaged (part_dropped > 0); the
+holder-self-drain arm must complete with the term advanced exactly
+once, zero deaths, the leaver exiting rc 0 via the drain path, and
+bitwise agreement.
 ``mesh_tripwires`` (MESH-WIN/MESH-BITWISE) guards the
 ``mesh_plane_fused`` sweep: the in-mesh collective plane's arm must
 beat the host-wire arm on rows/sec strictly (the data plane exists to
@@ -803,6 +812,127 @@ def control_plane_tripwires(new: dict) -> list[str]:
     return problems
 
 
+def partition_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``partition_3proc`` sweep
+    (link-level chaos partitions + quorum fencing + graceful lease
+    handover — comm/chaos.py part= entries, balance/control_plane.py,
+    balance/membership.py); vacuous when the sweep is absent. Every
+    arm is a COMPLETION gate (rates under ``steps_per_sec_ctrl``).
+
+    - PARTITION-FENCE: on the fence/heal arm, the minority-side
+      ex-coordinator must end FENCED OUT (convicted alive, exits via
+      the fenced_out poison, never a silent zombie) and its stale-term
+      plan — journaled behind the cut, recovered post-heal — must be
+      DROPPED at >= 1 survivor (``fenced_total`` counts the lease
+      ``fenced`` + rebalancer ``stale_plans_fenced`` sums); the lease
+      must sit at term 1 exactly (the quorum minted one term, the
+      minority minted none).
+    - PARTITION-HEAL: the same arm's survivors must complete the full
+      step count with ZERO unrecovered frames (the partition's cut
+      frames all recovered or fenced — never silently lost; the
+      reliable reopen path exists for exactly this) and bitwise-
+      agreeing finals; the injector must have provably engaged
+      (``part_dropped`` > 0 — a window that never opened gates
+      nothing). ``reliable_reopened`` is recorded but NOT gated:
+      whether a gap's budget exhausts inside the cut (and so needs
+      the reopen) depends on whether any gap opened BEFORE the cut —
+      timing the drill cannot pin; the reopen mechanics are pinned by
+      the tests/test_partition_plane.py protocol regressions instead.
+    - HANDOVER: the holder-self-drain arm must complete with the term
+      advanced EXACTLY once (the voluntary transfer — zero means the
+      holder never handed over, two means something also died), ZERO
+      deaths (nobody was convicted during a graceful drain), the
+      leaver exiting rc 0 via the drain path, zero unrecovered
+      frames, and bitwise survivor agreement."""
+    grid = new.get("partition_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    fence = grid.get("fence_heal") or {}
+    if not fence.get("completed"):
+        problems.append(
+            f"PARTITION-FENCE partition_3proc/fence_heal: completed="
+            f"{fence.get('completed')!r} — the asymmetric-partition "
+            "arm's survivors must finish under the quorum successor")
+    else:
+        if not fence.get("ex_coord_fenced_out"):
+            problems.append(
+                "PARTITION-FENCE partition_3proc/fence_heal: the "
+                "minority ex-coordinator did not exit fenced_out — a "
+                "convicted-but-alive rank kept running (zombie "
+                "writes)")
+        if not fence.get("fenced_total"):
+            problems.append(
+                "PARTITION-FENCE partition_3proc/fence_heal: 0 "
+                "stale-term frames fenced at the survivors — the "
+                "ex-coordinator's recovered plan was adopted (or "
+                "never recovered: both break the drill's claim)")
+        if fence.get("lease_term") != 1 or not fence.get("terms_agree"):
+            problems.append(
+                f"PARTITION-FENCE partition_3proc/fence_heal: "
+                f"lease_term={fence.get('lease_term')!r} terms_agree="
+                f"{fence.get('terms_agree')!r} — the quorum must mint "
+                "exactly one term (the minority island none)")
+        if fence.get("clock_min") != fence.get("iters"):
+            problems.append(
+                f"PARTITION-HEAL partition_3proc/fence_heal: "
+                f"clock_min={fence.get('clock_min')!r} of iters="
+                f"{fence.get('iters')!r} — survivors lost steps "
+                "across the partition")
+        if fence.get("wire_frames_lost", 0):
+            problems.append(
+                f"PARTITION-HEAL partition_3proc/fence_heal: "
+                f"{fence['wire_frames_lost']} unrecovered frames — "
+                "the heal leaked wire loss (reopen path broken?)")
+        if not fence.get("part_dropped"):
+            problems.append(
+                "PARTITION-HEAL partition_3proc/fence_heal: "
+                "part_dropped=0 — the partition injector never "
+                "engaged, the arm proved nothing")
+        if not fence.get("finals_agree"):
+            problems.append(
+                "PARTITION-HEAL partition_3proc/fence_heal: "
+                "survivors' final tables disagree after the heal")
+    ho = grid.get("handover") or {}
+    if not ho.get("completed"):
+        problems.append(
+            f"HANDOVER partition_3proc/handover: completed="
+            f"{ho.get('completed')!r} — the holder-self-drain arm "
+            "must finish under the successor")
+    else:
+        if ho.get("lease_term") != 1 or not ho.get("terms_agree"):
+            problems.append(
+                f"HANDOVER partition_3proc/handover: lease_term="
+                f"{ho.get('lease_term')!r} terms_agree="
+                f"{ho.get('terms_agree')!r} — a graceful handover "
+                "advances the term exactly once")
+        if ho.get("deaths", 0):
+            problems.append(
+                f"HANDOVER partition_3proc/handover: {ho['deaths']} "
+                "death verdicts during a graceful drain — the "
+                "handover raced the failure detector")
+        if ho.get("clock_min") != ho.get("iters"):
+            problems.append(
+                f"HANDOVER partition_3proc/handover: clock_min="
+                f"{ho.get('clock_min')!r} of iters="
+                f"{ho.get('iters')!r} — survivors lost steps across "
+                "the handover")
+        if not ho.get("leaver_drained"):
+            problems.append(
+                "HANDOVER partition_3proc/handover: the ex-holder "
+                "did not exit via the drain path (rc 0 + drained "
+                "event) — poisoned instead")
+        if ho.get("wire_frames_lost", 0):
+            problems.append(
+                f"HANDOVER partition_3proc/handover: "
+                f"{ho['wire_frames_lost']} unrecovered frames")
+        if not ho.get("finals_agree"):
+            problems.append(
+                "HANDOVER partition_3proc/handover: survivors' final "
+                "tables disagree after the handover")
+    return problems
+
+
 def mesh_tripwires(new: dict) -> list[str]:
     """Absolute (prior-free) gates on the ``mesh_plane_fused`` sweep
     (the in-mesh collective data plane, train/mesh_plane.py); vacuous
@@ -982,7 +1112,8 @@ def main(argv: list[str] | None = None) -> int:
                 + rebalance_tripwires(new) + trace_tripwires(new)
                 + obs_tripwires(new)
                 + serve_tripwires(new) + elastic_tripwires(new)
-                + control_plane_tripwires(new) + mesh_tripwires(new))
+                + control_plane_tripwires(new)
+                + partition_tripwires(new) + mesh_tripwires(new))
     pts = throughput_points(new)
     print(f"bench-regression: {len(pts)} throughput points checked "
           f"against {len(throughput_points(prior))} prior")

@@ -133,14 +133,21 @@ def init_multiproc(consistency: str, staleness: int):
 def run_multiproc_body(rank: int, trainer, body) -> int:
     """Run ``body()`` under the smoke/bench failure protocol: a
     PeerFailureError prints the peer_failure event and maps to exit 42, a
-    TimeoutError to gate_timeout/43 (the codes the fault drills assert)."""
+    TimeoutError to gate_timeout/43, and a FencedOutError — the fleet
+    convicted THIS (alive) rank during a partition and moved on — to
+    fenced_out/44 (the codes the fault drills assert)."""
     import json
 
-    from minips_tpu.consistency.gate import PeerFailureError
+    from minips_tpu.consistency.gate import FencedOutError, PeerFailureError
 
     try:
         body()
         return 0
+    except FencedOutError as e:
+        print(json.dumps({"rank": rank, "event": "fenced_out",
+                          "term": e.term,
+                          "at_clock": trainer.clock}), flush=True)
+        return 44
     except PeerFailureError as e:
         print(json.dumps({"rank": rank, "event": "peer_failure",
                           "dead": sorted(e.dead),

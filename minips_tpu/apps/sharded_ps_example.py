@@ -68,6 +68,23 @@ def main(argv=None) -> int:
                          "and the mbDr control frame trigger the same "
                          "path)")
     ap.add_argument("--drain-rank", type=int, default=-1)
+    ap.add_argument("--coord-plan-at", type=int, default=0,
+                    help="at step N the rank that BELIEVES it holds the "
+                         "coordinator lease issues one no-op epoch-bump "
+                         "plan (same overlay, epoch+1) — deterministic "
+                         "coordinator-broadcast noise for the partition "
+                         "fence drill: a plan issued inside a cut "
+                         "window is journaled, recovered post-heal, and "
+                         "must then be FENCED by term at every receiver "
+                         "(0 = off)")
+    ap.add_argument("--own-keys-rank", type=int, default=-1,
+                    help="this rank draws its batch keys from its OWN "
+                         "shard only (sparse model) — zero remote pull "
+                         "legs, so a partitioned coordinator wedges at "
+                         "its GATE (s boundaries late) instead of in "
+                         "the first cut pull: the partition drill's "
+                         "way of keeping the minority holder ticking "
+                         "long enough to issue its stale plan")
     ap.add_argument("--storm-from", type=int, default=0,
                     help="pull-storm window start (sparse model only): "
                          "every rank issues --storm-pulls extra "
@@ -228,6 +245,19 @@ def main(argv=None) -> int:
             return 2
         storm_keys = shard + np.arange(args.storm_keys, dtype=np.int64)
 
+    own_keys = None
+    if args.own_keys_rank == rank:
+        if not sparse or (args.overlap and args.overlap_legs != "push"):
+            print(json.dumps({
+                "rank": rank, "event": "error",
+                "err": "--own-keys-rank requires --model sparse without "
+                       "pull overlap (the localization rewrites the "
+                       "plain pull path's keys)"}), flush=True)
+            return 2
+        shard = -(-num_rows // nprocs)
+        lo = rank * shard
+        own_keys = (lo, max(1, min(shard, num_rows - lo)))
+
     losses = []
     # resumed runs reseed on (rank, start): batch sampling is with-
     # replacement, so resume is convergence-equivalent, not bit-exact
@@ -276,6 +306,11 @@ def main(argv=None) -> int:
                 else:
                     sel = draw_sel()
                     keys = data["idx"][sel].reshape(-1)
+                    if own_keys is not None:
+                        # drill localization (--own-keys-rank): fold
+                        # every key into my own shard — zero remote
+                        # pull legs, identical wire shape otherwise
+                        keys = own_keys[0] + (keys % own_keys[1])
                     rows = table.pull(keys).reshape(args.batch, -1, 1)
                 batch = {k: jnp.asarray(data[k][sel])
                          for k in ("val", "mask", "y")}
@@ -304,6 +339,20 @@ def main(argv=None) -> int:
                     table.pull(storm_keys)
             losses.append(float(loss))
             trainer.tick()
+            if (args.coord_plan_at and i == args.coord_plan_at
+                    and mb is not None and mb.coord == mb.rank
+                    and not mb.busy):
+                # fence-drill plan noise (see the flag help): issued
+                # POST-tick on the push-driving thread, the same
+                # contract as the planner's own issuance point
+                rb = trainer.rebalancer
+                for name, t in trainer.tables.items():
+                    # one atomic snapshot: epoch AND overlay from the
+                    # same table() read — re-reading router.epoch could
+                    # straddle a concurrent adoption and stamp a stale
+                    # overlay with a fresh epoch
+                    ep, ov = t.router.table()
+                    rb.issue_plan(name, ep + 1, dict(ov))
             save_hook(i)
             if rank == args.slow_rank and args.slow_ms > 0:
                 time.sleep(args.slow_ms / 1000.0)

@@ -311,6 +311,43 @@ class Autoscaler:
             tr.instant("autoscale", "as_drain", {"rank": int(victim)})
         _fl.checkpoint("as_drain", why)
 
+    # -------------------------------------------------- handover transfer
+    def export_state(self) -> dict:
+        """The hysteresis state a graceful lease handover ships to the
+        successor (``Membership.handover`` → ``mbH``): streaks, the
+        cool-down window, the rates being averaged, and the per-(table,
+        rank) shed-counter baselines — WITHOUT the baselines the
+        successor's first diff re-baselines and silently swallows one
+        tick of sheds. Counters and evidence stats stay local: they are
+        per-rank observability, not loop state."""
+        with self._lock:
+            return {
+                "hot": self._hot, "calm": self._calm,
+                "cooldown": self._cooldown,
+                "streak_rates": list(self._streak_rates),
+                "calm_rates": list(self._calm_rates),
+                # wire-safe encoding: framing str-coerces dict keys, so
+                # tuple keys ride as a row list
+                "prev": [[name, int(r), float(v)]
+                         for (name, r), v in self._prev.items()],
+            }
+
+    def install_state(self, state: dict) -> None:
+        """Install a handed-over hysteresis state (the successor's side
+        of ``mbH``). The next ``on_tick`` on the new holder then
+        decides exactly as an uninterrupted coordinator would —
+        pinned by the handover oracle test."""
+        with self._lock:
+            self._hot = int(state.get("hot", 0))
+            self._calm = int(state.get("calm", 0))
+            self._cooldown = int(state.get("cooldown", 0))
+            self._streak_rates = [float(x) for x in
+                                  state.get("streak_rates", ())]
+            self._calm_rates = [float(x) for x in
+                                state.get("calm_rates", ())]
+            self._prev = {(str(name), int(r)): float(v)
+                          for name, r, v in state.get("prev", ())}
+
     # ------------------------------------------------------------- metrics
     def stats(self) -> dict:
         with self._lock:

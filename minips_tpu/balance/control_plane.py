@@ -63,7 +63,8 @@ from typing import Iterable, Optional
 
 from minips_tpu.obs import flight as _fl
 
-__all__ = ["CoordinatorLease", "successor_of"]
+__all__ = ["CoordinatorLease", "SuspicionQuorum", "successor_of",
+           "quorum_needed"]
 
 
 def successor_of(live: Iterable[int]) -> Optional[int]:
@@ -72,6 +73,123 @@ def successor_of(live: Iterable[int]) -> Optional[int]:
     table so every rank computes the same successor without a ballot."""
     live = set(live)
     return min(live) if live else None
+
+
+def quorum_needed(live: set[int], suspect: int) -> int:
+    """Votes required to convict ``suspect`` out of ``live``: a strict
+    majority of the live view, capped at the number of ranks that can
+    physically vote (everyone live except the suspect — it cannot vote
+    for its own death), floored at 1.
+
+    Why this shape, case by case (``n = |live|``):
+
+    - n = 3, suspect inside: majority 2, voters 2 → BOTH survivors must
+      agree — a minority island of one (the asymmetric-partition
+      ex-coordinator) can never convict the majority, so it cannot mint
+      a term or issue plans. THE split-brain case this PR hardens.
+    - n = 4 split 2/2: majority 3, each island has 2 votes → NEITHER
+      side convicts. An even split is detected (gates stall, deadlines
+      poison loudly), never resolved by a coin-flip conviction.
+    - n = 2: majority would be 2 but only 1 rank can vote → cap at 1,
+      the solo conviction of the pre-quorum fleet. Two ranks genuinely
+      cannot distinguish a partition from a death — an honest,
+      documented limit (docs/fault_tolerance.md), not a regression.
+    """
+    n = len(live)
+    voters = n - (1 if suspect in live else 0)
+    return max(1, min(n // 2 + 1, voters))
+
+
+class SuspicionQuorum:
+    """Corroborated death verdicts — the split-brain hardening half of
+    the control plane (this PR). Each rank's ``HeartbeatMonitor`` turns
+    timeout silence into a SUSPICION instead of a verdict; suspicions
+    gossip piggybacked on the heartbeats themselves (``sus`` next to
+    the lease stamp — the one channel still flowing around a
+    partition's edge), and a rank CONVICTS only when the suspect's
+    silence is corroborated by :func:`quorum_needed` live ranks. One
+    instance per rank, fed by the monitor's sweep thread (my own
+    ballot) and the bus receive thread (peers' ballots)."""
+
+    def __init__(self, rank: int):
+        self.rank = int(rank)
+        self._lock = threading.Lock()
+        self._ballots: dict[int, set[int]] = {}  # voter -> suspects
+        self.verdicts = 0   # quorum convictions this rank reached
+        #                     (incremented by the membership plane at
+        #                     the moment it convicts)
+
+    def set_local(self, suspects: Iterable[int]) -> None:
+        """Replace MY ballot (monitor sweep: suspicion set changed)."""
+        self.vote(self.rank, suspects)
+
+    def mark_local(self, suspect: int, suspected: bool) -> list[int]:
+        """Atomically add/remove ONE rank from my ballot and return
+        the new ballot — the monitor's suspect hook and the beat
+        thread's retraction both mutate it, and a read-modify-write
+        outside the lock could lose a retraction to an interleave."""
+        with self._lock:
+            mine = set(self._ballots.get(self.rank, ()))
+            if suspected:
+                mine.add(int(suspect))
+            else:
+                mine.discard(int(suspect))
+            if mine:
+                self._ballots[self.rank] = mine
+            else:
+                self._ballots.pop(self.rank, None)
+            return sorted(mine)
+
+    def vote(self, voter: int, suspects: Iterable[int]) -> None:
+        """Replace ``voter``'s ballot with its latest gossiped
+        suspicion set — a beat with an empty ``sus`` retracts."""
+        s = {int(x) for x in suspects}
+        with self._lock:
+            if s:
+                self._ballots[int(voter)] = s
+            else:
+                self._ballots.pop(int(voter), None)
+
+    def drop_voter(self, voter: int) -> None:
+        """A convicted/left rank's standing ballot is void."""
+        with self._lock:
+            self._ballots.pop(int(voter), None)
+
+    def my_suspects(self) -> list[int]:
+        """My current ballot, for the heartbeat payload."""
+        with self._lock:
+            return sorted(self._ballots.get(self.rank, ()))
+
+    def convictable(self, live: set[int]) -> list[int]:
+        """Suspects whose silence a majority of ``live`` corroborates
+        right now (votes counted from live ranks only — a dead voter's
+        stale ballot must not convict anybody)."""
+        live = set(live)
+        with self._lock:
+            tally: dict[int, int] = {}
+            for voter, suspects in self._ballots.items():
+                if voter not in live and voter != self.rank:
+                    continue
+                for s in suspects:
+                    if s != voter:
+                        tally[s] = tally.get(s, 0) + 1
+        return sorted(s for s, n in tally.items()
+                      if n >= quorum_needed(live, s))
+
+    def voters_for(self, suspect: int, live: set[int]) -> list[int]:
+        """Who corroborates ``suspect`` right now — the verdict's WHY,
+        recorded into the flight box next to the conviction."""
+        live = set(live)
+        with self._lock:
+            return sorted(v for v, s in self._ballots.items()
+                          if suspect in s and v != suspect
+                          and (v in live or v == self.rank))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"verdicts": self.verdicts,
+                    "ballots": {str(v): sorted(s)
+                                for v, s in sorted(self._ballots.items())}}
 
 
 class CoordinatorLease:
@@ -85,6 +203,7 @@ class CoordinatorLease:
         self.term = 0
         self.holder = int(initial_holder)
         self.successions = 0   # times THIS rank advanced the lease
+        self.handovers = 0     # voluntary transfers THIS rank initiated
         self.fenced = 0        # stale-term frames dropped at this rank
 
     # ------------------------------------------------------------- stamps
@@ -155,8 +274,27 @@ class CoordinatorLease:
             self.successions += 1
             return self.holder
 
+    def transfer(self, new_holder: int) -> tuple[int, int]:
+        """VOLUNTARY handover by the current holder (graceful drain of
+        the coordinator, balance/membership.Membership.handover): term
+        += 1, holder = the chosen successor — the same term advance a
+        death verdict would cause, minus the death. Advancing the term
+        here is what makes the handover partition-proof: any frame the
+        old holder has still in flight (or journaled behind a cut link)
+        is stamped with the OLD term and dies at every receiver's
+        :meth:`admit` fence, exactly like an ex-coordinator returning
+        from a partition. Only the holder may call this — the
+        membership plane's ``handover()`` enforces it (this object does
+        not know the caller's rank)."""
+        with self._lock:
+            self.term += 1
+            self.holder = int(new_holder)
+            self.handovers += 1
+            return self.term, self.holder
+
     def stats(self) -> dict:
         with self._lock:
             return {"term": self.term, "holder": self.holder,
                     "successions": self.successions,
+                    "handovers": self.handovers,
                     "fenced": self.fenced}

@@ -88,8 +88,10 @@ from typing import Optional
 
 import numpy as np
 
-from minips_tpu.balance.control_plane import CoordinatorLease
-from minips_tpu.consistency.gate import PeerFailureError, publish_clock
+from minips_tpu.balance.control_plane import (CoordinatorLease,
+                                              SuspicionQuorum)
+from minips_tpu.consistency.gate import (FencedOutError,
+                                         PeerFailureError, publish_clock)
 from minips_tpu.obs import flight as _fl
 from minips_tpu.obs import tracer as _trc
 
@@ -248,6 +250,10 @@ class Membership:
     END_KIND = "mbEnd"    # coordinator broadcast at finalize: no more
     #                       admissions — un-admitted standbys exit clean
     #                       instead of timing out against a gone fleet
+    HANDOVER_KIND = "mbH"  # holder broadcast: lease transferred (new
+    #                        term + holder + the coordinator state the
+    #                        successor installs — heat reports, queues,
+    #                        autoscaler hysteresis)
 
     def __init__(self, trainer, cfg: MembershipConfig):
         self.trainer = trainer
@@ -301,13 +307,27 @@ class Membership:
             trainer.gossip.exclude(s)
         # death detection hook: the monitor's sweep thread fires this
         # the moment a peer's silence crosses the timeout
+        # split-brain hardening (this PR): death verdicts are
+        # CORROBORATED — the monitor's timeout makes a SUSPECT, the
+        # suspicion gossips on the heartbeat wire next to the lease
+        # stamp, and conviction needs a majority of the live view
+        # (control_plane.SuspicionQuorum). A minority island cannot
+        # convict the majority, so it cannot mint a term or issue plans.
+        self.quorum = SuspicionQuorum(self.rank)
+        self._quorum_claimed: set[int] = set()  # verdicts this rank
+        #                                         recorded (dedup across
+        #                                         sweep + beat threads)
+        self._convicted_term: Optional[int] = None  # fleet declared ME dead
         if trainer.monitor is not None:
             trainer.monitor.on_failure = self._on_peer_dead
-            # lease stamps ride every heartbeat: peers max-merge the
-            # term, so a partitioned ex-coordinator learns it lost the
-            # lease from the FIRST beat it hears on return (the self
-            # fence — control_plane.py module docstring)
-            trainer.monitor.payload_extra = self.lease.stamp
+            trainer.monitor.on_suspect = self._on_suspect
+            # lease stamps + my suspicion ballot ride every heartbeat:
+            # peers max-merge the term, so a partitioned ex-coordinator
+            # learns it lost the lease from the FIRST beat it hears on
+            # return (the self fence — control_plane.py module
+            # docstring), and ballots reach exactly the ranks a
+            # partition still lets us reach
+            trainer.monitor.payload_extra = self._beat_payload
             trainer.monitor.on_beat_extra = self._on_lease_beat
         bus = self.bus
         bus.on(self.JOIN_KIND, self._on_join_req)
@@ -319,6 +339,7 @@ class Membership:
         bus.on(self.DRAIN_KIND, self._on_drain)
         self._fleet_done = False
         bus.on(self.END_KIND, self._on_end)
+        bus.on(self.HANDOVER_KIND, self._on_handover)
 
     # ------------------------------------------------------------- plumbing
     def bind_checkpoint(self, checkpoint_dir: Optional[str]) -> None:
@@ -364,14 +385,83 @@ class Membership:
             tr.instant("membership", "mb_lease",
                        {"term": term, "holder": holder})
 
+    def _beat_payload(self) -> dict:
+        """Every outgoing heartbeat: lease stamp + my suspicion ballot
+        (empty list = explicit retraction — a voter that calmed down
+        must clear its stale ballot at every receiver)."""
+        return {**self.lease.stamp(), "sus": self.quorum.my_suspects()}
+
     def _on_lease_beat(self, sender: int, payload: dict) -> None:
         """Heartbeat receive hook (monitor thread): max-merge the lease
         stamp. Learning a newer term here is the partition-return self
         fence — an ex-holder stops planning the moment it hears the
         fleet moved on, and every receiver re-targets without waiting
-        for its own death verdict."""
+        for its own death verdict. Then bank the sender's suspicion
+        ballot and re-check quorum — a verdict completes the moment
+        the corroborating vote lands, whichever rank's beat carried
+        it."""
         if self.lease.observe(payload):
             self._retarget(self.lease.holder)
+        sus = payload.get("sus")
+        if sus is not None:
+            self.quorum.vote(sender, sus)
+            self._check_quorum()
+
+    def _on_suspect(self, r: int, suspected: bool) -> None:
+        """Monitor sweep hook: MY suspicion of ``r`` began/retracted.
+        The ballot updates locally and rides the next beat; quorum is
+        re-checked immediately (a 2-rank fleet's solo quorum, or the
+        case where peers' votes arrived before mine)."""
+        mine = self.quorum.mark_local(r, suspected)
+        # suspicion into the black box: the post-mortem sequence reads
+        # suspicion -> quorum verdict -> term advance -> death plan
+        _fl.record("hb_suspect" if suspected else "hb_unsuspect",
+                   {"rank": int(r), "ballot": mine})
+        if suspected:
+            self._check_quorum()
+
+    def _check_quorum(self) -> None:
+        """Convict every suspect a majority of the live view now
+        corroborates. Runs on the monitor thread (my sweep) and the
+        bus receive thread (a peer's beat) — conviction itself is
+        idempotent (``monitor.convict`` fires on_failure once, and
+        ``_on_peer_dead`` re-checks under its lock)."""
+        mon = self.trainer.monitor
+        if mon is None:
+            return
+        with self._lock:
+            live = set(self.live)
+            already = self.dead | self.left | self._quorum_claimed
+        for r in self.quorum.convictable(live):
+            if r in already:
+                continue
+            with self._lock:
+                # claim the verdict: the sweep thread (my vote) and
+                # the beat thread (a peer's vote) can both reach
+                # convictable at the same instant — exactly one may
+                # record the quorum_verdict and convict
+                if r in self._quorum_claimed:
+                    continue
+                self._quorum_claimed.add(r)
+            if r == self.rank:
+                # peers' gossiped ballots corroborate MY death (the
+                # asymmetric half-partition: my outbound is cut, my
+                # inbound flows) — I must not convict myself through
+                # the PEER-death path (self-exclusion from my own
+                # gossip, succession against myself). The majority
+                # will convict on its side and its mbD reaches me on
+                # the working inbound; the fenced-out path owns it.
+                continue
+            voters = self.quorum.voters_for(r, live)
+            # the QUORUM VERDICT with its why — who corroborated, over
+            # which live view — before the conviction cascades into
+            # hb_death/term_advance/death_plan
+            _fl.record("quorum_verdict",
+                       {"rank": int(r), "voters": voters,
+                        "live": sorted(live)})
+            self.quorum.verdicts += 1
+            self.quorum.drop_voter(r)
+            mon.convict(r)
 
     def fence_frame(self, payload: dict) -> bool:
         """THE receive fence, in one place for every coordinator-
@@ -409,6 +499,8 @@ class Membership:
                    if self.hold_joins else 0,
                    **self.counters}
         out["lease"] = self.lease.stats()
+        out["quorum"] = self.quorum.stats()
+        out["fenced_out"] = self._convicted_term is not None
         # the successor's ADDRESS derives from the membership table, not
         # the spawn-time env: the bus is a full mesh wired at launch, so
         # succession is a rank-id change (launch.bus_endpoint_of) — the
@@ -487,6 +579,18 @@ class Membership:
         # sequence reads verdict → term advance → death plan
         _fl.poison("hb_death", {"rank": int(r), "owns": bool(owns),
                                 "live": live_snap})
+        # a corpse's standing suspicion ballot is void — it must not
+        # keep corroborating verdicts against ranks it can no longer
+        # see. MY vote against the corpse deliberately PERSISTS: every
+        # rank reaches its own quorum verdict independently, and the
+        # first convictor retracting would starve a slower survivor of
+        # the corroborating vote it still needs (its next beat would
+        # gossip "sus": [] and RETRACT the vote at every receiver —
+        # reproduced: the seeded-kill drills wedged with one survivor
+        # convicted and the other forever one vote short). A
+        # convicted-dead rank's lingering ballot entry is the settled
+        # evidence, not noise.
+        self.quorum.drop_voter(r)
         if succeeded is not None:
             term, holder = self.lease.current()
             tr = _trc.TRACER
@@ -518,10 +622,52 @@ class Membership:
             return  # stale ex-coordinator's verdict: fenced by term
         r, rstep = int(payload.get("rank", -1)), int(
             payload.get("rstep", -1))
+        if r == self.rank:
+            # the fleet convicted ME dead and moved on (a partition
+            # outlasted the quorum verdict): record, dump, and let the
+            # training thread exit via FencedOutError at its next
+            # boundary — continuing would write zombie gradients into
+            # ranges the fleet already rolled back
+            if self._convicted_term is None:
+                self._convicted_term = int(payload.get(
+                    "lt", self.lease.current()[0]))
+                _fl.poison("fenced_out",
+                           {"rank": self.rank, "rstep": rstep,
+                            "term": self._convicted_term})
+            return
         with self._lock:
             self._verdicts[r] = rstep
             if rstep < 0:
                 self._unrecoverable.add(r)
+
+    def refuses_own_death_plan(self, payload: dict) -> bool:
+        """Plan receive guard (balance/rebalancer._mk_on_plan): a death
+        plan whose ``dead`` extras name THIS rank must not be adopted
+        here — adoption would snapshot-and-ship rbS state for blocks
+        whose new owners restore from the checkpoint instead (the
+        double-apply the heal drill forbids). The convicted rank stops
+        participating and exits via the FencedOutError path."""
+        dead = payload.get("dead")
+        if not dead or self.rank not in {int(d) for d in dead}:
+            return False
+        if self._convicted_term is None:  # mbD normally precedes (FIFO)
+            self._convicted_term = int(payload.get(
+                "lt", self.lease.current()[0]))
+            _fl.poison("fenced_out",
+                       {"rank": self.rank, "via": "death_plan",
+                        "term": self._convicted_term})
+        return True
+
+    def _raise_if_fenced_out(self) -> None:
+        term = self._convicted_term
+        if term is None:
+            return
+        # lame-duck linger: peers may still be NACK-recovering my
+        # journaled partition-era frames (the repair loops ride the bus
+        # threads, not this one) — one beat of grace keeps the heal's
+        # zero-unrecovered-frames contract, then the poison fires
+        time.sleep(1.0)
+        raise FencedOutError(self.rank, term)
 
     def fatal_dead(self, dead: set[int]) -> set[int]:
         """The subset of monitor-dead ranks that must still POISON a
@@ -616,6 +762,7 @@ class Membership:
         deadline = time.monotonic() + timeout
         while True:
             self.rb.adopt_now()  # pre-tick: any thread may adopt
+            self._raise_if_fenced_out()
             with self._lock:
                 if self._unrecoverable:
                     raise PeerFailureError(set(self._unrecoverable))
@@ -703,22 +850,142 @@ class Membership:
         # the leaver published RETIRED before mbG; exclusion is the
         # belt-and-braces half (finalize/pull_all live sets, fence acks)
         self.trainer.gossip.exclude(r)
+        self.quorum.drop_voter(r)  # a left rank's ballot is void too
         tr = _trc.TRACER
         if tr is not None:
             tr.instant("membership", "mb_gone", {"rank": int(r)})
+
+    # ------------------------------------------------------------ handover
+    def handover(self) -> int:
+        """GRACEFUL LEASE HANDOVER (ROADMAP item 3 headroom (a),
+        closed): the holder gives the lease away instead of dying with
+        it. Term += 1 (``CoordinatorLease.transfer`` — any in-flight or
+        journaled frame of mine is now stale-term and fences at every
+        receiver, so handover is partition-proof by the same mechanism
+        as succession), then ONE broadcast (``mbH``) carries the new
+        ``(term, holder)`` plus the coordinator state succession would
+        otherwise re-derive over several boundaries: the transition
+        queues (pending joins / join credits / leave requests), the
+        stored heat reports, and the autoscaler's hysteresis state —
+        so the successor's next autoscale decision equals an
+        uninterrupted coordinator's (pinned by test). Returns the
+        successor's rank. Only the holder may call (raises
+        otherwise); the caller then proceeds to :meth:`leave` — the
+        PR8 drain path, which now addresses the NEW coordinator."""
+        if self.rank != self.coord:
+            raise RuntimeError(
+                f"rank {self.rank} does not hold the lease "
+                f"(holder: {self.coord}) — nothing to hand over")
+        targets = self._live_targets(exclude={self.rank})
+        if not targets:
+            raise RuntimeError(
+                "handover: no live rank left to take the lease — the "
+                "last rank drains by just finishing (finalize)")
+        succ = targets[0]  # sorted: the lowest live survivor, the same
+        #                    pick succession would make
+        tr = self.trainer
+        with self._lock:
+            # snapshot the coordinator queues under the lock: bus-
+            # thread handlers (_on_leave_req, _on_join_req) mutate
+            # them concurrently with this training-thread drain
+            state: dict = {
+                "joins": [int(j) for j in self._pending_joins],
+                "credits": int(self._join_credits),
+                "leave_reqs": {str(r): dict(req)
+                               for r, req in self._leave_reqs.items()},
+            }
+        # heat reports re-gossip every tick anyway; shipping the store
+        # means the successor's FIRST boundary sees the same load
+        # picture the old holder did, not a cold start
+        state["reports"] = {
+            name: {str(r): dict(rep)
+                   for r, rep in self.rb.heat_reports(name).items()}
+            for name in tr.tables}
+        a = getattr(tr, "autoscaler", None)
+        if a is not None:
+            state["autoscale"] = a.export_state()
+        term, holder = self.lease.transfer(succ)
+        self.bus.publish(self.HANDOVER_KIND,
+                         {"rank": int(succ), "state": state,
+                          **self.lease.stamp()})
+        self._retarget(succ)
+        tr2 = _trc.TRACER
+        if tr2 is not None:
+            tr2.instant("membership", "mb_handover",
+                        {"term": term, "holder": holder})
+        # a scaling-class DECISION, not a failure: checkpoint() dumps
+        # the box with the transfer's why without flagging a poison
+        _fl.checkpoint("lease_handover",
+                       {"term": term, "holder": holder,
+                        "from": self.rank})
+        return int(succ)
+
+    def _on_handover(self, sender: int, payload: dict) -> None:
+        """Every receiver: observe the new term (fence_frame max-merges
+        and re-targets). The NAMED successor additionally installs the
+        transferred coordinator state before its next boundary runs
+        the queues."""
+        if not self.fence_frame(payload):
+            return  # a stale ex-holder cannot hand over what it lost
+        if int(payload.get("rank", -1)) != self.rank:
+            return
+        state = payload.get("state") or {}
+        with self._lock:
+            self._pending_joins = [
+                int(j) for j in state.get("joins", ())
+                if int(j) in self.standby
+                and int(j) not in self._pending_joins] \
+                + [j for j in self._pending_joins]
+            self._join_credits = max(self._join_credits,
+                                     int(state.get("credits", 0)))
+            for r_s, req in (state.get("leave_reqs") or {}).items():
+                self._leave_reqs.setdefault(int(r_s), dict(req))
+        reports = state.get("reports") or {}
+        if reports:
+            self.rb.install_reports(
+                {name: {int(r): dict(rep) for r, rep in by_rank.items()}
+                 for name, by_rank in reports.items()})
+        a = getattr(self.trainer, "autoscaler", None)
+        a_state = state.get("autoscale")
+        if a is not None and a_state:
+            a.install_state(a_state)
+        tr = _trc.TRACER
+        if tr is not None:
+            term, holder = self.lease.current()
+            tr.instant("membership", "mb_handover_installed",
+                       {"term": term, "holder": holder})
+        _fl.record("lease_handover_installed",
+                   {"from": int(sender), "holder": self.rank})
 
     def leave(self, timeout: float = 60.0) -> None:
         """Graceful exit of THIS rank (after its training loop broke on
         ``draining``): drain pushes, retire my clock, keep serving and
         re-asking the coordinator until every block I own has handed
         off and my fences released, then announce gone. Zero restored
-        state anywhere — this is a migration, not a failure."""
+        state anywhere — this is a migration, not a failure. THE LEASE
+        HOLDER drains too (this PR): it hands the lease (and the
+        coordinator state) to the lowest live survivor first —
+        :meth:`handover`, term advances exactly once — then leaves
+        like any other rank, addressing the new coordinator. The LAST
+        live rank has nobody to hand to or ship blocks at: it drains
+        by just finishing — flush, retire, announce gone, rc 0."""
         if self.rank == self.coord:
-            raise RuntimeError(
-                "the coordinator lease holder cannot drain itself — it "
-                "is the planner (documented limit: hand the lease over "
-                "by restarting this rank; the autoscaler never targets "
-                "the holder)")
+            if not self._live_targets(exclude={self.rank}):
+                # sole survivor: no successor, no evacuation target —
+                # the drain degenerates to a clean local quiesce
+                for t in self.trainer.tables.values():
+                    t.flush_pushes(acks=False)
+                    t.residual_flush(reason="fence")
+                    t.flush_pushes()
+                    t.check_fatal()
+                publish_clock(self.trainer.gossip,
+                              self.trainer.clock, True)
+                with self._lock:
+                    self.live.discard(self.rank)
+                    self.left.add(self.rank)
+                self.bus.publish(self.GONE_KIND, {"rank": self.rank})
+                return
+            self.handover()
         tr = self.trainer
         self.rb.claim_drive_thread()  # adoption moves to THIS thread
         for t in tr.tables.values():
@@ -776,7 +1043,9 @@ class Membership:
         """Called from ShardedPSTrainer.tick at the clock boundary,
         BEFORE the rebalancer's adoption point (a plan issued here is
         adopted in the same tick). Every rank: raise on unrecoverable
-        deaths. Coordinator: run the transition queues."""
+        deaths (or on having been fenced out). Coordinator: run the
+        transition queues."""
+        self._raise_if_fenced_out()
         with self._lock:
             if self._unrecoverable:
                 raise PeerFailureError(set(self._unrecoverable))
@@ -791,6 +1060,7 @@ class Membership:
         it. Runs only on the push-driving thread (the adopt_now rule —
         plan issuance adopts locally) and only handles deaths:
         joins/leaves/bootstrap can wait for a real clock boundary."""
+        self._raise_if_fenced_out()
         if self.rank != self.coord:
             return
         drive = self.rb._drive_thread

@@ -201,6 +201,11 @@ class Rebalancer:
                 # stamp any epoch it likes)
                 self.stale_plans_fenced += 1
                 return
+            if mb is not None and mb.refuses_own_death_plan(payload):
+                # a death plan naming THIS rank: the convicted-but-
+                # alive rank (partition survivor the fleet gave up on)
+                # must not adopt its own death — see membership.py
+                return
             extras = {k: payload[k] for k in ("dead", "rstep")
                       if k in payload}
             self.note_plan(name, int(payload.get("ep", 0)),
@@ -293,6 +298,19 @@ class Rebalancer:
             return
         for name, t in self.trainer.tables.items():
             self._adopt_one(name, t)
+
+    def install_reports(self, reports: dict[str, dict[int, dict]]) -> None:
+        """Install a handed-over report store (graceful lease handover,
+        balance/membership.Membership._on_handover): the successor's
+        first coordinator boundary sees the old holder's load picture
+        instead of a cold start. Fresher reports that already arrived
+        here win — a transferred snapshot must never roll a rank's
+        report backward past one the rank re-gossiped directly."""
+        with self._lock:
+            for name, by_rank in reports.items():
+                store = self._reports.setdefault(name, {})
+                for r, rep in by_rank.items():
+                    store.setdefault(int(r), dict(rep))
 
     def heat_reports(self, name: str) -> dict[int, dict]:
         """Snapshot of the coordinator's stored per-rank heat reports

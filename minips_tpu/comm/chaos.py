@@ -25,13 +25,47 @@ Spec grammar (``$MINIPS_CHAOS`` or ``make_bus(..., chaos=...)``)::
     <seed>:<entry>,<entry>,...
     entry   := <knob>=<value>
     knob    := op[@kindprefix][#senderid] | delay_ms | reorder_ms
+             | slow#<link> | part | links | at | for
     op      := drop | dup | delay | reorder
+    link    := <a>-<b>   (symmetric)  |  <a>><b>  (a's frames to b only)
 
 e.g. ``MINIPS_CHAOS="1234:drop=0.01,dup=0.005,delay=0.01,delay_ms=20"``
 or per-kind/per-link: ``"7:drop=0,drop@psr=0.05,drop#2=0.1"`` (pull
 replies 5%, anything from rank 2 10%). The most specific matching entry
 wins (kind+sender > kind > sender > global; longer kind prefixes beat
 shorter ones).
+
+**Link-level partitions (this PR).** ``part=<pseed>`` opens a partition
+ENTRY (the ``MINIPS_CHAOS_KILL`` entry-assembly grammar); the
+``links=``, ``at=`` and ``for=`` that follow bind to it::
+
+    MINIPS_CHAOS="7:part=1,links=0-1+0-2,at=8,for=3s"
+
+cuts EVERY frame on the rank-0↔1 and 0↔2 links (a full isolation of
+rank 0, both directions — ``0>1`` would cut only 0's frames arriving at
+1, the asymmetric half-partition) from the receiver's clock boundary 8
+until 3 wall seconds later. ``at=`` and ``for=`` each take either a
+step count (clock boundaries, via :meth:`ChaosBus.on_clock` — the
+trainer's tick feeds it) or a wall-seconds value with an ``s`` suffix;
+ranges (``at=8-12``) draw seeded-uniform from ``H(seed, pseed, tag)``
+so every rank computes the same window without coordination. Caveat a
+drill author must know: a duration in STEPS only closes when the
+receiver's own clock advances, and a partition that stalls the whole
+fleet stalls every clock — fleet-stalling cuts must use wall-second
+durations (``for=3s``) or they never heal (docs/fault_tolerance.md
+names the trap; the parser cannot, it does not know the fleet shape).
+Partition drops land on the receive path exactly like ``drop`` fates
+(after the seq is consumed, so the reliable layer sees a gap it can
+repair post-heal) and are counted separately (``part_dropped``).
+
+**Sustained per-link degradation.** ``slow#<a>-<b>=<ms>`` (or
+``slow#<a>><b>=<ms>``) delays every frame on that link by a FIXED
+``ms`` — latency, not loss: the constant delay preserves per-link
+order, modeling a congested or long-haul link rather than a lossy one.
+A frame that also draws the ``delay`` fate pays the jittered delay
+PLUS the link tax; a frame that draws ``reorder`` rides the reorder
+park untaxed (the park IS its delay — stacking the tax on top would
+double-charge the swap window).
 
 Determinism: each frame's fate is ``H(seed, my_id, sender, stream, seq,
 op) / 2^64`` (blake2b) — a pure function of the frame's identity, not of
@@ -56,23 +90,122 @@ import time
 from typing import Optional
 
 from minips_tpu.comm.framing import dup_msg
+from minips_tpu.obs import flight as _fl
 from minips_tpu.obs import tracer as _trc
 
-__all__ = ["ChaosSpec", "ChaosBus"]
+__all__ = ["ChaosSpec", "ChaosBus", "PartitionEntry"]
 
 _OPS = ("drop", "dup", "delay", "reorder")
 
 
+def _parse_link(tok: str, ctx: str) -> tuple[int, int, bool]:
+    """One link token → ``(a, b, bidirectional)``. ``a-b`` cuts/slows
+    both directions, ``a>b`` only frames FROM a arriving AT b. Refuses
+    self-links and non-int ranks loudly, naming the token — the fuzzer
+    contract: a bad spec never half-configures an injector."""
+    if ">" in tok:
+        a_s, _, b_s = tok.partition(">")
+        bidir = False
+    else:
+        a_s, _, b_s = tok.partition("-")
+        bidir = True
+    try:
+        a, b = int(a_s), int(b_s)
+    except ValueError:
+        raise ValueError(f"{ctx}: bad link token {tok!r} "
+                         "(expected <rank>-<rank> or <rank>><rank>)")
+    if a < 0 or b < 0:
+        raise ValueError(f"{ctx}: negative rank in link {tok!r}")
+    if a == b:
+        raise ValueError(f"{ctx}: self-link {tok!r} cuts nothing")
+    return a, b, bidir
+
+
+def _parse_window_val(val: str, knob: str) -> tuple[str, int, int,
+                                                    float, float]:
+    """``at=``/``for=`` value → ``(unit, lo, hi, flo, fhi)``: a step
+    count (clock boundaries) or, with an ``s`` suffix, wall seconds;
+    either may be a ``lo-hi`` range drawn seeded at resolve time."""
+    val = val.strip()
+    unit = "step"
+    if val.endswith("s"):
+        unit, val = "sec", val[:-1]
+    lo_s, dash, hi_s = val.partition("-")
+    try:
+        if unit == "sec":
+            flo = float(lo_s)
+            fhi = float(hi_s) if dash else flo
+            lo = hi = 0
+        else:
+            lo = int(lo_s)
+            hi = int(hi_s) if dash else lo
+            flo = fhi = 0.0
+    except ValueError:
+        raise ValueError(f"chaos {knob}={val!r}: expected <n>[-<m>] "
+                         "steps or <sec>[-<sec>]s")
+    if (unit == "step" and (lo < 0 or hi < lo)) \
+            or (unit == "sec" and (flo < 0 or fhi < flo)):
+        raise ValueError(f"chaos {knob}={val!r}: empty/negative range")
+    return unit, lo, hi, flo, fhi
+
+
+class PartitionEntry:
+    """One seeded partition window over a set of directed links."""
+
+    __slots__ = ("pseed", "links", "at", "dur")
+
+    def __init__(self, pseed: int, links: list[tuple[int, int, bool]],
+                 at: tuple, dur: tuple):
+        self.pseed = int(pseed)
+        self.links = links      # [(a, b, bidir), ...]
+        self.at = at            # window-val tuple (see _parse_window_val)
+        self.dur = dur
+
+    def cuts(self, sender: int, receiver: int) -> bool:
+        for a, b, bidir in self.links:
+            if (a == sender and b == receiver) \
+                    or (bidir and a == receiver and b == sender):
+                return True
+        return False
+
+    def resolve(self, seed: int) -> tuple:
+        """``(at_unit, at_value, dur_unit, dur_value)`` with ranges
+        drawn from ``H(seed, pseed, tag)`` — pure, every rank agrees."""
+        def draw(tag: str, lo, hi):
+            if hi <= lo:
+                return lo
+            key = f"{seed}|part|{self.pseed}|{tag}".encode()
+            h = struct.unpack(
+                "<Q", hashlib.blake2b(key, digest_size=8).digest())[0]
+            if isinstance(lo, int):
+                return lo + h % (hi - lo + 1)
+            return lo + (h / 2.0 ** 64) * (hi - lo)
+
+        at_u, alo, ahi, aflo, afhi = self.at
+        d_u, dlo, dhi, dflo, dfhi = self.dur
+        at_v = draw("at", alo, ahi) if at_u == "step" \
+            else draw("at", aflo, afhi)
+        d_v = draw("for", dlo, dhi) if d_u == "step" \
+            else draw("for", dflo, dfhi)
+        return at_u, at_v, d_u, d_v
+
+
 class ChaosSpec:
-    """Parsed chaos schedule: seed + per-op rate entries + hold params."""
+    """Parsed chaos schedule: seed + per-op rate entries + hold params
+    + partition windows + sustained slow links."""
 
     def __init__(self, seed: int, rates: dict, delay_ms: float = 20.0,
-                 reorder_ms: float = 50.0):
+                 reorder_ms: float = 50.0,
+                 partitions: Optional[list] = None,
+                 slow: Optional[list] = None):
         # rates: op -> list of (kind_prefix | None, sender | None, rate)
         self.seed = int(seed)
         self.rates = rates
         self.delay_ms = float(delay_ms)
         self.reorder_ms = float(reorder_ms)
+        self.partitions: list[PartitionEntry] = partitions or []
+        # slow: [(a, b, bidir, ms)] — sustained per-link delay
+        self.slow: list[tuple[int, int, bool, float]] = slow or []
 
     @classmethod
     def parse(cls, spec: str) -> "ChaosSpec":
@@ -88,6 +221,26 @@ class ChaosSpec:
                 f"chaos spec must start with '<int seed>:', got {spec!r}")
         rates: dict = {op: [] for op in _OPS}
         delay_ms, reorder_ms = 20.0, 50.0
+        partitions: list[PartitionEntry] = []
+        slow: list[tuple[int, int, bool, float]] = []
+        # part= opens a partition ENTRY; links=/at=/for= bind to it
+        # (the MINIPS_CHAOS_KILL entry-assembly grammar)
+        cur: Optional[dict] = None
+
+        def close_part() -> None:
+            nonlocal cur
+            if cur is None:
+                return
+            if not cur["links"]:
+                raise ValueError(
+                    f"chaos part={cur['pseed']}: no links= bound to "
+                    "the entry (a partition must name what it cuts)")
+            partitions.append(PartitionEntry(
+                cur["pseed"], cur["links"],
+                cur["at"] or ("step", 0, 0, 0.0, 0.0),
+                cur["dur"] or ("sec", 0, 0, 1e18, 1e18)))
+            cur = None
+
         for entry in filter(None, (e.strip() for e in body.split(","))):
             if "=" not in entry:
                 raise ValueError(f"chaos entry {entry!r} lacks '='")
@@ -98,21 +251,72 @@ class ChaosSpec:
             if knob == "reorder_ms":
                 reorder_ms = float(val)
                 continue
+            if knob == "part":
+                close_part()
+                try:
+                    pseed = int(val)
+                except ValueError:
+                    raise ValueError(
+                        f"chaos part={val!r}: entry seed must be an int")
+                cur = {"pseed": pseed, "links": [], "at": None,
+                       "dur": None}
+                continue
+            if knob in ("links", "at", "for"):
+                if cur is None:
+                    raise ValueError(
+                        f"chaos {entry!r}: {knob}= outside a part= "
+                        "entry (part=<seed> opens one)")
+                if knob == "links":
+                    for tok in filter(None, (t.strip()
+                                             for t in val.split("+"))):
+                        cur["links"].append(_parse_link(tok, "chaos"))
+                    if not cur["links"]:
+                        raise ValueError(
+                            f"chaos {entry!r}: empty link list")
+                elif knob == "at":
+                    cur["at"] = _parse_window_val(val, "at")
+                else:
+                    cur["dur"] = _parse_window_val(val, "for")
+                continue
+            if knob.startswith("slow#"):
+                a, b, bidir = _parse_link(knob[len("slow#"):],
+                                          "chaos slow")
+                try:
+                    ms = float(val)
+                except ValueError:
+                    raise ValueError(
+                        f"chaos {entry!r}: slow needs a float ms value")
+                if ms <= 0:
+                    raise ValueError(
+                        f"chaos {entry!r}: slow ms must be > 0")
+                slow.append((a, b, bidir, ms))
+                continue
             sender: Optional[int] = None
             if "#" in knob:
                 knob, _, snd = knob.partition("#")
-                sender = int(snd)
+                try:
+                    sender = int(snd)
+                except ValueError:
+                    raise ValueError(
+                        f"chaos entry {entry!r}: sender id after '#' "
+                        "must be an int")
             kind: Optional[str] = None
             if "@" in knob:
                 knob, _, kind = knob.partition("@")
             if knob not in _OPS:
                 raise ValueError(
                     f"unknown chaos op {knob!r} (expected one of {_OPS})")
-            rate = float(val)
+            try:
+                rate = float(val)
+            except ValueError:
+                raise ValueError(
+                    f"chaos entry {entry!r}: rate must be a float")
             if not 0.0 <= rate <= 1.0:
                 raise ValueError(f"chaos rate {entry!r} outside [0, 1]")
             rates[knob].append((kind, sender, rate))
-        return cls(seed, rates, delay_ms, reorder_ms)
+        close_part()
+        return cls(seed, rates, delay_ms, reorder_ms,
+                   partitions=partitions, slow=slow)
 
     def rate(self, op: str, kind: str, sender: int) -> float:
         """Most specific matching entry wins; 0.0 when none match."""
@@ -129,7 +333,8 @@ class ChaosSpec:
         return best
 
     def active(self) -> bool:
-        return any(e for e in self.rates.values())
+        return (any(e for e in self.rates.values())
+                or bool(self.partitions) or bool(self.slow))
 
 
 class ChaosBus:
@@ -145,7 +350,32 @@ class ChaosBus:
         self.bus = bus
         self.spec = spec
         self.stats = {"frames": 0, "dropped": 0, "duplicated": 0,
-                      "delayed": 0, "reordered": 0}
+                      "delayed": 0, "reordered": 0, "part_dropped": 0,
+                      "slowed": 0}
+        # partition windows: receiver-local clock fed by the trainer's
+        # tick (on_clock); wall anchor for the 's'-suffixed windows and
+        # for step-opened/seconds-long mixed windows (the fleet-stalling
+        # drill shape — a cut that stalls every clock must heal by wall
+        # time). _part_open maps entry index -> wall open time once a
+        # step-opened window fires, so its seconds duration has an
+        # anchor.
+        self._clock = 0
+        self._t0 = time.monotonic()
+        self._part_open: dict[int, float] = {}
+        self._part_state: dict[int, bool] = {}  # for open/close records
+        # resolve every entry's window once (pure function of seeds)
+        self._parts = [(p, p.resolve(spec.seed))
+                       for p in spec.partitions]
+        # sustained slow links: my inbound tax per sender, precomputed —
+        # the per-frame cost of an armed-but-elsewhere slow spec is one
+        # dict lookup that misses
+        self._slow_in: dict[int, float] = {}
+        me = int(getattr(bus, "my_id", -1))
+        for a, b, bidir, ms in spec.slow:
+            if b == me:
+                self._slow_in[a] = max(self._slow_in.get(a, 0.0), ms)
+            if bidir and a == me:
+                self._slow_in[b] = max(self._slow_in.get(b, 0.0), ms)
         self._lock = threading.Lock()
         self._uctr: dict[tuple, int] = {}   # (sender, kind) -> arrivals
         self._held: dict[tuple, tuple] = {}  # link -> (due, msg, blob)
@@ -161,6 +391,61 @@ class ChaosBus:
     def install(cls, bus, spec: "ChaosSpec | str") -> "ChaosBus":
         bus.chaos = cls(bus, spec)
         return bus.chaos
+
+    # ---------------------------------------------------------- partitions
+    def on_clock(self, clock: int) -> None:
+        """Clock-boundary feed from the trainer's tick (the same point
+        the seeded kill check runs): advances the receiver-local step
+        the partition windows key on. A plain int store — GIL-atomic,
+        no lock on the tick path."""
+        self._clock = int(clock)
+
+    def _partition_cuts(self, sender: int) -> bool:
+        """Is any partition window currently cutting ``sender`` → me?
+        Called per frame ONLY when partitions are configured (the
+        injector's zero-config paths never reach here)."""
+        me = int(self.bus.my_id)
+        now = time.monotonic()
+        clock = self._clock
+        cut = False
+        for i, (p, (at_u, at_v, d_u, d_v)) in enumerate(self._parts):
+            # window OPEN test (receiver-local): step windows open at
+            # the configured boundary, second windows at wall offset
+            if at_u == "step":
+                opened = clock >= at_v
+            else:
+                opened = (now - self._t0) >= at_v
+            if opened and i not in self._part_open:
+                self._part_open[i] = now
+            # window CLOSE test: step durations close by clock, second
+            # durations by wall time since the window actually opened
+            active = False
+            if opened:
+                if d_u == "step" and at_u == "step":
+                    active = clock < at_v + d_v
+                elif d_u == "step":  # sec-open: clock anchor at open
+                    active = clock < d_v + self._clock_at_open(i)
+                else:
+                    active = now - self._part_open[i] < d_v
+            if active != self._part_state.get(i, False):
+                self._part_state[i] = active
+                _fl.record("chaos_part_open" if active
+                           else "chaos_part_heal",
+                           {"entry": p.pseed, "clock": clock,
+                            "links": [f"{a}{'-' if bi else '>'}{b}"
+                                      for a, b, bi in p.links]})
+            if active and p.cuts(sender, me):
+                cut = True
+        return cut
+
+    def _clock_at_open(self, i: int) -> int:
+        # sec-opened + step-duration windows need the clock at open;
+        # approximate with the clock seen at first activation (stored
+        # lazily) — a corner combination the drills do not use
+        key = ("clk", i)
+        if key not in self._part_open:
+            self._part_open[key] = self._clock
+        return self._part_open[key]
 
     # ----------------------------------------------------------- decisions
     def _u(self, op: str, sender: int, stream: str, seq: int) -> float:
@@ -187,6 +472,21 @@ class ChaosBus:
         spec = self.spec
         with self._lock:
             self.stats["frames"] += 1
+        if self._parts and self._partition_cuts(sender):
+            # the link is CUT: every frame dies here, fates unconsulted
+            # — counted apart from probabilistic drops so a drill can
+            # prove the partition (not the drop rate) did the cutting.
+            # The seq is already consumed, so the reliable layer sees a
+            # repairable gap once the link heals — partition loss is
+            # recoverable loss, by construction.
+            with self._lock:
+                self.stats["part_dropped"] += 1
+            tr = _trc.TRACER
+            if tr is not None:
+                tr.instant("chaos", "part_drop",
+                           {"kind": kind, "sender": sender, "seq": seq})
+            self._release_held((sender, stream))
+            return
 
         def note(op: str) -> None:
             tr = _trc.TRACER
@@ -223,12 +523,15 @@ class ChaosBus:
             with self._lock:
                 self.stats["duplicated"] += 1
             note("dup")
+        slow_ms = self._slow_in.get(sender, 0.0)
         if hit("delay"):
             # hold for ~delay_ms (deterministically jittered ±50%): later
             # frames on every link overtake it — delay IS reordering on
-            # release, which is the point
+            # release, which is the point. A slowed link's tax stacks on
+            # top (congestion under long-haul latency).
             jit = 0.5 + self._u("delayj", sender, stream, seq)
-            self._schedule(spec.delay_ms * jit / 1e3, msg, blob)
+            self._schedule((spec.delay_ms * jit + slow_ms) / 1e3,
+                           msg, blob)
             with self._lock:
                 self.stats["delayed"] += 1
             note("delay")
@@ -246,6 +549,15 @@ class ChaosBus:
             note("reorder")
             if parked is not None:  # two in a row: the first-held goes now
                 self._forward(parked[1], parked[2])
+        elif slow_ms > 0.0:
+            # sustained link degradation: a FIXED delay per frame — the
+            # constant offset preserves per-link arrival order (every
+            # frame on the link pays the same tax), so a slowed link is
+            # latency the stack must absorb, never reordering
+            with self._lock:
+                self.stats["slowed"] += 1
+            self._release_held((sender, stream))
+            self._schedule(slow_ms / 1e3, msg, blob)
         else:
             self._release_held_after((sender, stream), msg, blob)
         if dup_copy is not None:

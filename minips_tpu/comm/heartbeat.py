@@ -98,6 +98,15 @@ class HeartbeatMonitor:
         # edge, which is exactly when the lease fence matters
         self.payload_extra: Optional[Callable[[], dict]] = None
         self.on_beat_extra: Optional[Callable[[int, dict], None]] = None
+        # QUORUM mode (balance/control_plane.SuspicionQuorum, armed by
+        # the membership plane): with on_suspect set, a peer past the
+        # timeout becomes a SUSPECT — ``on_suspect(rank, True)`` — not
+        # a corpse; conviction waits for :meth:`convict` once the
+        # fleet's suspicion gossip reaches a majority. A beat from a
+        # suspect retracts (``on_suspect(rank, False)``). With the hook
+        # unset (standalone monitors, pre-quorum fleets) the timeout
+        # convicts solo, exactly the old semantics.
+        self.on_suspect: Optional[Callable[[int, bool], None]] = None
         self.stall = stall_knob()
         if self.stall and self.stall <= self.interval:
             # a stall budget at or below the sweep cadence would make
@@ -118,6 +127,16 @@ class HeartbeatMonitor:
         now = clock()
         self._last_seen = {p: now for p in peer_ids if p != bus.my_id}
         self._dead: set[int] = set()
+        self._suspect: set[int] = set()
+        # serializes suspect-state TRANSITIONS together with their
+        # on_suspect hook calls (sweep thread suspects, beat thread
+        # retracts): firing the hook outside any lock let a sweep's
+        # deferred suspected=True land AFTER a beat's retraction,
+        # leaving a permanently stale ballot for a live rank. Ordering:
+        # _sus_lock is taken FIRST, the main lock (briefly) inside —
+        # never the reverse; convict() uses only the main lock, so a
+        # hook that reaches convict() cannot deadlock.
+        self._sus_lock = threading.Lock()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -143,6 +162,19 @@ class HeartbeatMonitor:
         with self._lock:
             if sender in self._last_seen:
                 self._last_seen[sender] = self._clock()
+        sus_hook = self.on_suspect
+        if sus_hook is not None:
+            # the suspect spoke: retract my vote before processing the
+            # payload (a returning rank's first beat must not race its
+            # own conviction through a stale ballot). Transition + hook
+            # under _sus_lock so it serializes against the sweep's
+            # suspected=True (see __init__)
+            with self._sus_lock:
+                with self._lock:
+                    retracted = sender in self._suspect
+                    self._suspect.discard(sender)
+                if retracted:
+                    sus_hook(sender, False)
         hook = self.on_beat_extra
         if hook is not None:
             hook(sender, payload)
@@ -161,6 +193,9 @@ class HeartbeatMonitor:
         re-detected one timeout after we wake — the honest earliest
         date). Off by default: existing fleets keep exact semantics."""
         newly_dead = []
+        candidates = []
+        forgave = False
+        sus_hook = self.on_suspect
         with self._lock:
             now = self._clock()
             last, self._last_sweep = self._last_sweep, now
@@ -169,21 +204,77 @@ class HeartbeatMonitor:
                 for p in self._last_seen:
                     if p not in self._dead:
                         self._last_seen[p] = now
+                forgave = True
                 self.stall_forgiven += 1
                 fl = _fl.FLIGHT
                 if fl is not None:
                     fl.ev("hb_stall_forgiven",
                           {"gap_s": round(now - last, 3),
                            "stall_s": self.stall})
-                return set(self._dead)
-            for p, seen in self._last_seen.items():
-                if p not in self._dead and now - seen > self.timeout:
-                    self._dead.add(p)
-                    newly_dead.append(p)
+            else:
+                for p, seen in self._last_seen.items():
+                    if p in self._dead or now - seen <= self.timeout:
+                        continue
+                    if sus_hook is not None:
+                        # quorum mode: silence makes a SUSPECT, not a
+                        # corpse — the verdict needs corroboration.
+                        # Transition deferred below: the add and its
+                        # hook must be one atom under _sus_lock, or a
+                        # concurrent beat's retraction can be
+                        # overwritten by our deferred suspected=True
+                        candidates.append(p)
+                    else:
+                        self._dead.add(p)
+                        newly_dead.append(p)
+        if forgave and sus_hook is not None:
+            # a coma observer's standing suspicions are as undateable
+            # as its convictions would have been: retract them along
+            # with the re-baseline
+            with self._sus_lock:
+                with self._lock:
+                    forgiven = sorted(self._suspect)
+                    self._suspect.clear()
+                for p in forgiven:
+                    sus_hook(p, False)
+        for p in candidates:
+            with self._sus_lock:
+                with self._lock:
+                    fresh = self._clock()
+                    seen = self._last_seen.get(p, fresh)
+                    # re-verify under the transition lock: a beat that
+                    # landed since the sweep snapshot retracts the case
+                    begin = (p not in self._dead
+                             and p not in self._suspect
+                             and fresh - seen > self.timeout)
+                    if begin:
+                        self._suspect.add(p)
+                if begin:
+                    sus_hook(p, True)
         for p in newly_dead:
             if self.on_failure is not None:
                 self.on_failure(p)
-        return set(self._dead)
+        with self._lock:
+            return set(self._dead)
+
+    def convict(self, r: int) -> None:
+        """Quorum-mode conviction (balance/membership.py, once the
+        fleet's suspicion gossip reached a majority): promote the rank
+        to DEAD and fire ``on_failure`` exactly once — the same verdict
+        path a solo timeout takes when quorum is off."""
+        with self._lock:
+            if r in self._dead:
+                return
+            self._dead.add(r)
+            self._suspect.discard(r)
+        if self.on_failure is not None:
+            self.on_failure(r)
+
+    @property
+    def suspects(self) -> set[int]:
+        """Peers past the timeout awaiting corroboration (quorum mode;
+        always empty when on_suspect is unset)."""
+        with self._lock:
+            return set(self._suspect)
 
     def start(self) -> "HeartbeatMonitor":
         def loop() -> None:
@@ -214,7 +305,8 @@ class HeartbeatMonitor:
                     "timeout_s": self.timeout,
                     "stall_s": self.stall or None,
                     "stall_forgiven": self.stall_forgiven,
-                    "dead": sorted(self._dead)}
+                    "dead": sorted(self._dead),
+                    "suspects": sorted(self._suspect)}
 
     def stop(self) -> None:
         self._stop.set()

@@ -85,24 +85,41 @@ _NACK_BATCH = 256  # max seqs per NACK frame (flood valve)
 
 
 class _Gap:
-    __slots__ = ("tries", "due", "t0")
+    __slots__ = ("tries", "due", "t0", "reopened")
 
-    def __init__(self, due: float, t0: float = 0.0):
+    def __init__(self, due: float, t0: float = 0.0,
+                 reopened: bool = False):
         self.tries = 0
         self.due = due
         self.t0 = t0  # gap registration time: the retransmit span start
+        self.reopened = reopened  # second-chance gap: no third chance
 
 
 class _Rx:
     """Per-(sender, stream) sequencer state."""
 
-    __slots__ = ("exp", "buf", "gaps", "skip")
+    __slots__ = ("exp", "buf", "gaps", "skip", "heal", "gone", "dhi")
 
     def __init__(self):
         self.exp = 0          # next seq to deliver
         self.buf: dict = {}   # seq -> (msg, blob), seq > exp
         self.gaps: dict = {}  # seq -> _Gap, outstanding missing seqs
         self.skip: set = set()  # given-up seqs awaiting advance
+        # PARTITION-HEAL reopen state (this PR): seqs given up by
+        # BUDGET exhaustion (NACKs into a cut link's void — the sender
+        # may still hold them journaled) and never delivered around —
+        # candidates to reopen when the link proves alive again. Seqs
+        # given up by __rl_gone (journal evicted: genuinely
+        # unrecoverable) never enter this set.
+        self.heal: set = set()
+        # seqs the sender declared __rl_gone (journal-evicted): a
+        # reopen spanning them must re-skip, never re-NACK — the
+        # sender already confessed, and a second gone round-trip would
+        # double-count gave_up. Bounded alongside heal.
+        self.gone: set = set()
+        self.dhi = 0          # delivery high-water: 1 + highest seq
+        #                       actually DELIVERED (skip-advances do
+        #                       not move it) — the reopen soundness bar
 
 
 class ReliableChannel:
@@ -144,7 +161,7 @@ class ReliableChannel:
         self.stats = {"nacks_sent": 0, "nacks_got": 0,
                       "retransmits_sent": 0, "retransmits_got": 0,
                       "recovered": 0, "gave_up": 0, "dups_dropped": 0,
-                      "gone_sent": 0}
+                      "gone_sent": 0, "reopened": 0}
         self._last_advert = (0, ())  # (bseq, dseq tuple) last advertised
         self._advert_due = 0.0
         self._advert_sent_t = 0.0
@@ -247,6 +264,12 @@ class ReliableChannel:
         now = self._clock()
         with self._lock:
             rx = self._rx_for(sender, stream)
+            if rx.heal:
+                # the link is speaking again: any frame from the sender
+                # is the heal signal — reopen the budget-given-up hole
+                # BEFORE judging this seq against exp (the reopen may
+                # rewind exp below it)
+                self._try_reopen(rx, sender, stream, now)
             if seq < rx.exp or seq in rx.buf:
                 self.stats["dups_dropped"] += 1
                 return
@@ -263,6 +286,7 @@ class ReliableChannel:
             if seq == rx.exp:
                 self._deliver(msg, blob)
                 rx.exp += 1
+                rx.dhi = rx.exp
                 self._drain(rx)
             else:
                 if seq - rx.exp > self.buffer_cap:
@@ -279,10 +303,13 @@ class ReliableChannel:
                                if s >= rx.exp}
                     rx.buf = {s: v for s, v in rx.buf.items()
                               if s >= rx.exp}
+                    rx.heal.clear()  # a resync abandons the healable
+                    #                  hole: its range is unreachable now
                     self._drain(rx)
                     if seq == rx.exp:  # the drain caught up to this frame
                         self._deliver(msg, blob)
                         rx.exp += 1
+                        rx.dhi = rx.exp
                         self._drain(rx)
                         return
                 rx.buf[seq] = (msg, blob)
@@ -303,6 +330,58 @@ class ReliableChannel:
                     rx.skip.add(oldest)
                     self.stats["gave_up"] += 1
                     self._drain(rx)
+
+    def _try_reopen(self, rx: _Rx, sender: int, stream: str,
+                    now: float) -> None:
+        """POST-HEAL RECOVERY REOPEN (caller holds the lock): a
+        partition outlasting the NACK budget marked its seqs skipped
+        and the sequencer advanced past the hole — but nothing LATER
+        was ever delivered (the cut silenced the whole link), so the
+        hole is still repairable in order if the sender's journal held
+        on. The first frame (or top advert) from the sender proves the
+        link healed: rewind ``exp`` to the hole's base, open fresh
+        gaps with a fresh budget, and let the normal NACK loop finish
+        the job. Sound iff no seq at or above the hole was delivered
+        (``dhi`` is the bar — a delivered successor makes late
+        delivery an ordering violation, and the hole stays the counted
+        loss it already is). Bounded: each seq reopens at most ONCE
+        (``_Gap.reopened`` — a second exhaustion is permanent), the
+        heal set is capped at ``buffer_cap``, and the count lands in
+        ``stats["reopened"]``."""
+        lo = min(rx.heal)
+        n = rx.exp - lo
+        if lo < rx.dhi or n <= 0 or n > self.buffer_cap:
+            rx.heal.clear()
+            return
+        reopened = 0
+        for s in range(lo, rx.exp):
+            if s in rx.gone:
+                # the sender already confessed eviction for this seq:
+                # re-skip it directly — re-NACKing would just buy a
+                # second gone round-trip and double-count gave_up
+                rx.skip.add(s)
+                continue
+            rx.gaps[s] = _Gap(now + self.settle_s, now, reopened=True)
+            rx.skip.discard(s)
+            reopened += 1
+        rx.exp = lo
+        rx.heal.clear()
+        if reopened == 0:
+            # every seq in the hole was gone: nothing to ask — drain
+            # straight past the re-skipped range
+            self._drain(rx)
+            return
+        self.stats["reopened"] += reopened
+        self._wake.set()
+        tr = _trc.TRACER
+        if tr is not None:
+            tr.instant("reliable", "reopened",
+                       {"sender": sender, "stream": stream,
+                        "lo": lo, "n": reopened})
+        # a heal-reopen is a recovery DECISION worth the black box (the
+        # partition drill reconstructs cut -> give-up -> heal -> reopen)
+        _fl.record("reliable_reopen",
+                   {"sender": sender, "stream": stream, "n": reopened})
 
     def _rx_for(self, sender: int, stream: str) -> _Rx:
         """Stream state, created on first touch (caller holds the lock).
@@ -327,6 +406,7 @@ class ReliableChannel:
                 msg, blob = rx.buf.pop(rx.exp)
                 self._deliver(msg, blob)
                 rx.exp += 1
+                rx.dhi = rx.exp
             elif rx.exp in rx.skip:
                 rx.skip.discard(rx.exp)
                 rx.exp += 1
@@ -359,6 +439,10 @@ class ReliableChannel:
                 return
             tr = _trc.TRACER
             for s in (int(x) for x in payload.get("seqs", [])):
+                rx.heal.discard(s)  # journal-evicted: never reopenable
+                if len(rx.gone) >= self.buffer_cap:
+                    rx.gone.discard(min(rx.gone))
+                rx.gone.add(s)      # a reopen spanning s re-skips it
                 if rx.gaps.pop(s, None) is not None:
                     rx.skip.add(s)
                     self.stats["gave_up"] += 1
@@ -389,6 +473,10 @@ class ReliableChannel:
                     continue
                 top = int(top)
                 rx = self._rx_for(sender, stream)
+                if rx.heal:
+                    # post-heal advert: the link speaks again — reopen
+                    # the budget-given-up hole before judging the top
+                    self._try_reopen(rx, sender, stream, now)
                 for s in range(rx.exp, min(top, rx.exp + self.buffer_cap)):
                     if s not in rx.buf and s not in rx.gaps \
                             and s not in rx.skip:
@@ -417,6 +505,16 @@ class ReliableChannel:
                         rx.gaps.pop(s)
                         rx.skip.add(s)
                         self.stats["gave_up"] += 1
+                        if not g.reopened:
+                            # budget exhausted into a (possibly cut)
+                            # void — the sender may still hold the
+                            # frame journaled: remember the hole so a
+                            # post-heal advert/frame can reopen it ONCE
+                            # (bounded; a reopened gap's second
+                            # exhaustion is permanent)
+                            if len(rx.heal) >= self.buffer_cap:
+                                rx.heal.discard(min(rx.heal))
+                            rx.heal.add(s)
                         gave_up.append((sender, stream, s))
                         tr = _trc.TRACER
                         if tr is not None:

@@ -68,6 +68,25 @@ class PeerFailureError(RuntimeError):
         self.dead = dead
 
 
+class FencedOutError(PeerFailureError):
+    """Raised on a rank that learns the fleet CONVICTED IT dead and
+    moved on (a partition outlasted the quorum verdict; the death plan
+    re-homed this rank's ranges from a checkpoint). The convicted-but-
+    alive rank must stop participating — its term is fenced at every
+    receiver, but its pushes would still land as zombie writes — so it
+    lingers briefly for journal drain (peers recover its cut frames)
+    and exits via this distinct poison. Subclasses PeerFailureError on
+    purpose: to every generic handler this IS a peer failure — the
+    failed peer is us."""
+
+    def __init__(self, rank: int, term: int):
+        super().__init__({int(rank)})
+        self.args = (f"rank {rank} was convicted dead by the fleet "
+                     f"(lease term {term}) — fenced out",)
+        self.rank = int(rank)
+        self.term = int(term)
+
+
 class StalenessGate:
     def __init__(self, gossip, staleness: float, *,
                  timeout: float = 60.0, monitor=None):

@@ -356,9 +356,18 @@ def sweep_stale_dirs() -> int:
 
 
 # ------------------------------------------------------------ merge CLI
-def load_dumps(paths: list[str]) -> dict[int, dict]:
+def load_dumps(paths: list[str],
+               skipped: Optional[list] = None) -> dict[int, dict]:
     """``{rank: dump doc}`` from files and/or directories (directories
-    glob ``flight-rank*.json``)."""
+    glob ``flight-rank*.json``).
+
+    A truncated or corrupt dump — a SIGKILL mid-write leaves a partial
+    tmp file; disks fill; bit-rot happens — is SKIPPED and reported
+    (appended to ``skipped`` as ``(path, reason)``), never raised: the
+    merge CLI is the post-mortem tool, and a post-mortem that crashes
+    on the one rank that died hardest loses every OTHER rank's box
+    with it. The atomic-rename dump discipline makes corruption rare;
+    the skip makes it survivable."""
     import glob
 
     files: list[str] = []
@@ -370,9 +379,18 @@ def load_dumps(paths: list[str]) -> dict[int, dict]:
             files.append(p)
     out: dict[int, dict] = {}
     for f in files:
-        with open(f) as fh:
-            doc = json.load(fh)
-        out[int(doc.get("rank", len(out)))] = doc
+        try:
+            with open(f) as fh:
+                doc = json.load(fh)
+            if not isinstance(doc, dict):
+                raise ValueError("dump is not a JSON object")
+            rank = int(doc.get("rank", len(out)))
+        except (OSError, json.JSONDecodeError, ValueError,
+                TypeError) as e:
+            if skipped is not None:
+                skipped.append((f, f"{type(e).__name__}: {e}"))
+            continue
+        out[rank] = doc
     return out
 
 
@@ -388,14 +406,24 @@ def _estimate_offsets_us(dumps: dict[int, dict]
     ref = ranks[0]
     offsets = {ref: 0.0}
     unaligned: list[int] = []
+
+    def hb(r):
+        # a structurally-broken box (hb table not a dict, delays not
+        # numeric) merges unaligned at offset 0 — never crashes the
+        # merge (the load_dumps skip contract, one layer down)
+        t = dumps[r].get("hb_delays_us")
+        return t if isinstance(t, dict) else {}
+
     for r in ranks[1:]:
-        d_r_ref = (dumps[r].get("hb_delays_us") or {}).get(str(ref))
-        d_ref_r = (dumps[ref].get("hb_delays_us") or {}).get(str(r))
-        if d_r_ref is None or d_ref_r is None:
+        try:
+            d_r_ref = hb(r).get(str(ref))
+            d_ref_r = hb(ref).get(str(r))
+            if d_r_ref is None or d_ref_r is None:
+                raise ValueError("no bidirectional sample")
+            offsets[r] = (float(d_r_ref) - float(d_ref_r)) / 2.0
+        except (ValueError, TypeError):
             offsets[r] = 0.0
             unaligned.append(r)
-        else:
-            offsets[r] = (float(d_r_ref) - float(d_ref_r)) / 2.0
     return offsets, unaligned
 
 
@@ -404,30 +432,59 @@ def merge_dumps(dumps: dict[int, dict]) -> tuple[dict, dict]:
     offset-aligned timeline, sorted by aligned time."""
     offsets, unaligned = _estimate_offsets_us(dumps)
     rows: list[dict] = []
+    malformed: list[int] = []
     for rank, doc in sorted(dumps.items()):
         off = offsets.get(rank, 0.0)
-        # a poison lands in the ring AND the append-only reasons list
-        # (the ring may rotate it out, the list never drops) — on the
-        # merged timeline each appears once, flagged
-        seen_reasons = {(e["t_us"], e["kind"])
-                        for e in doc.get("reasons", ())}
-        for src, mark in (("events", False), ("reasons", True)):
-            for e in doc.get(src, ()):
-                if not mark and (e["t_us"], e["kind"]) in seen_reasons:
-                    continue
-                rows.append({"t_us": round(float(e["t_us"]) - off, 1),
-                             "rank": rank, "kind": e["kind"],
-                             "args": e.get("args"),
-                             "poison": mark})
+        try:
+            # a poison lands in the ring AND the append-only reasons
+            # list (the ring may rotate it out, the list never drops)
+            # — on the merged timeline each appears once, flagged
+            seen_reasons = {(e["t_us"], e["kind"])
+                            for e in doc.get("reasons", ())}
+            rank_rows = []
+            for src, mark in (("events", False), ("reasons", True)):
+                for e in doc.get(src, ()):
+                    if not mark \
+                            and (e["t_us"], e["kind"]) in seen_reasons:
+                        continue
+                    rank_rows.append(
+                        {"t_us": round(float(e["t_us"]) - off, 1),
+                         "rank": rank, "kind": e["kind"],
+                         "args": e.get("args"), "poison": mark})
+        except (KeyError, TypeError, ValueError):
+            # a structurally-broken (but valid-JSON) box: report the
+            # rank, keep every other rank's timeline — the load_dumps
+            # skip contract, one layer up
+            malformed.append(rank)
+            continue
+        rows.extend(rank_rows)
     rows.sort(key=lambda e: e["t_us"])
+
+    def reason_kinds(doc):
+        # same tolerance as the row loop: a torn-but-parsing box must
+        # not crash the SUMMARY either (reproduced in review: a reason
+        # entry missing "kind" survived the row loop's catch only to
+        # KeyError here, losing every other rank's timeline)
+        try:
+            return [e["kind"] for e in doc.get("reasons", ())]
+        except (KeyError, TypeError):
+            return ["<malformed>"]
+
+    def n_events(doc):
+        try:
+            return len(doc.get("events", ()))
+        except TypeError:
+            return 0
+
     summary = {
         "ranks": sorted(dumps),
-        "events": sum(len(d.get("events", ())) for d in dumps.values()),
-        "reasons": {r: [e["kind"] for e in d.get("reasons", ())]
+        "events": sum(n_events(d) for d in dumps.values()),
+        "reasons": {r: reason_kinds(d)
                     for r, d in sorted(dumps.items())},
         "clock_offsets_us": {str(r): round(o, 1)
                              for r, o in sorted(offsets.items())},
         "unaligned_ranks": unaligned,
+        "malformed_ranks": malformed,
     }
     doc = {"flight": rows, "windows": {str(r): d.get("window")
                                        for r, d in sorted(dumps.items())},
@@ -449,16 +506,20 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument("--tail", type=int, default=0, metavar="N",
                     help="print only the last N timeline lines")
     args = ap.parse_args(argv)
-    try:
-        dumps = load_dumps(args.paths)
-    except (OSError, json.JSONDecodeError, ValueError) as e:
-        print(f"flight: {e}", file=sys.stderr)
-        return 1
-    if not dumps:
-        print(f"flight: no flight-rank*.json under {args.paths!r}",
+    skipped: list = []
+    dumps = load_dumps(args.paths, skipped=skipped)
+    for path, why in skipped:
+        # skip-and-REPORT: the operator must see which rank's box was
+        # torn (a SIGKILL mid-write), but the merge of the survivors'
+        # boxes must proceed — exit 0 iff >= 1 dump loaded
+        print(f"flight: skipped corrupt dump {path}: {why}",
               file=sys.stderr)
+    if not dumps:
+        print(f"flight: no loadable flight-rank*.json under "
+              f"{args.paths!r}", file=sys.stderr)
         return 1
     doc, summary = merge_dumps(dumps)
+    summary["skipped_files"] = [p for p, _w in skipped]
     rows = doc["flight"]
     t0 = rows[0]["t_us"] if rows else 0.0
     shown = rows[-args.tail:] if args.tail else rows

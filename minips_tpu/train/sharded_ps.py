@@ -1605,6 +1605,15 @@ class ShardedTable:
             # adopting would wedge until the deadline
             if self._rb is not None:
                 self._rb.adopt_now()
+            if self._mb is not None:
+                # membership poll too (the gate poll_hook rule): a
+                # partitioned ex-coordinator can sit HERE awaiting acks
+                # for a plan the survivors FENCED — acks that will
+                # never come from peers it cannot convict. Its own
+                # death verdict (FencedOutError) must be able to
+                # resolve the wait instead of the settle deadline
+                # mislabeling it a gate_timeout.
+                self._mb.poll()
             with self._mig_cond:
                 if not (self._fenced or self._pending_state
                         or self._await_acks or self._parked_pushes
@@ -3948,6 +3957,14 @@ class ShardedPSTrainer:
         from minips_tpu.comm.chaos import install_chaos_kill
 
         self._kill_check = install_chaos_kill(bus.my_id, num_processes)
+        # step-windowed partition injection (comm/chaos.py part=
+        # entries): the injector keys its windows on the RECEIVER's
+        # clock, fed from the same tick point as the kill check — None
+        # when chaos is off or carries no partition entries, so the
+        # common tick pays one attribute load
+        ch = getattr(bus, "chaos", None)
+        self._chaos_clock = (ch.on_clock if ch is not None
+                             and ch.spec.partitions else None)
         # windowed metrics layer (obs/window.py): ALWAYS ON
         # (MINIPS_OBS=0 only for the OBS-TAX honesty arm) — rolled at
         # every clock boundary, it is what turns the cumulative hists/
@@ -4110,6 +4127,11 @@ class ShardedPSTrainer:
             # and before the clock frame — the corpse's last published
             # clock is the previous step's, exactly a mid-step loss
             self._kill_check(self.clock)
+        if self._chaos_clock is not None:
+            # partition windows advance on the same boundary currency
+            # as the kill drill: "at=8" cuts from the moment this rank
+            # reaches clock 8
+            self._chaos_clock(self.clock)
         if self.obs_window is not None:
             # close the previous step's metrics interval BEFORE any
             # control decision below (autoscaler signals, rbH reports)

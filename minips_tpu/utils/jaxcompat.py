@@ -21,6 +21,23 @@ _NEW_SHARD_MAP = getattr(jax, "shard_map", None)
 if _NEW_SHARD_MAP is None:  # pre-0.5 jax: the experimental spelling
     from jax.experimental.shard_map import shard_map as _EXP_SHARD_MAP
 
+    # checkpoint_name under shard_map: the old check_rep tracer has no
+    # replication rule for the `name` primitive and raises
+    # NotImplementedError ("No replication rule for name") the moment a
+    # remat-annotated model runs sharded. `name` is an identity marker
+    # — it neither mixes nor splits axes — so the STANDARD rules
+    # (replication preserved elementwise) are exactly its semantics;
+    # the newer vma tracer ships them built in. setdefault-registered:
+    # a jax that grows its own rule wins.
+    try:
+        from jax._src.ad_checkpoint import name_p as _NAME_P
+        from jax.experimental import shard_map as _SM_MOD
+
+        _SM_MOD.register_standard_check(_NAME_P)
+        _SM_MOD.register_standard_rewrite(_NAME_P)
+    except (ImportError, AttributeError):  # surface moved: the tests
+        pass                               # stay quarantined, loudly
+
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
     """``jax.shard_map`` with the keyword surface both lineages accept.

@@ -1,0 +1,1009 @@
+"""Partition-tolerant control plane (this PR): link-level chaos
+partitions (comm/chaos.py ``part=``/``slow#``), quorum-corroborated
+death verdicts (balance/control_plane.SuspicionQuorum), graceful lease
+handover (``mbH``), the reliable channel's post-heal reopen, and the
+flight merge CLI's corrupt-dump tolerance.
+
+Unit tier: the extended MINIPS_CHAOS grammar + a seeded spec FUZZER
+(every generated spec parses or refuses with ValueError naming the
+offense — never a half-configured injector), window/cut mechanics on a
+stub bus, slow-link ordering on a real bus, the quorum rule case table,
+heartbeat suspect/retract/convict, the reliable reopen protocol (and
+its refusal when reopening would violate in-order delivery), the
+autoscaler handover state-transfer oracle, flight merge on truncated
+dumps, and the three new bench tripwires (PARTITION-FENCE /
+PARTITION-HEAL / HANDOVER) red and green.
+
+Drill tier:
+
+- HANDOVER (fast 3-proc): the lease HOLDER drains itself mid-run —
+  term advances exactly once via the voluntary transfer, zero deaths,
+  the leaver exits rc 0 through the PR8 drain path, survivors finish
+  every step with bitwise agreement.
+- PARTITION (slow 3-proc): a seeded symmetric link cut isolates the
+  holder; the majority convicts it by suspicion quorum (the minority
+  island convicts nobody), the stale plan the ex-holder issued inside
+  the cut is recovered post-heal and FENCED at every survivor, the
+  ex-holder exits fenced_out, survivors complete bitwise with zero
+  unrecovered frames — and the flight boxes (NO observability env
+  armed) reconstruct suspicion → quorum verdict → term advance.
+- BITWISE: a partition-armed-but-idle spec (window never opens) is
+  bitwise-equal to the clean wire via the existing lockstep harness.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu import launch
+from minips_tpu.balance.autoscaler import AutoscaleConfig, Autoscaler
+from minips_tpu.balance.control_plane import (SuspicionQuorum,
+                                              quorum_needed)
+from minips_tpu.comm.chaos import ChaosBus, ChaosSpec
+
+APP = "minips_tpu.apps.sharded_ps_example"
+
+
+# ------------------------------------------------- spec grammar: part=
+def test_chaos_spec_parses_partition_entries():
+    s = ChaosSpec.parse("7:part=1,links=0-1+0-2,at=8,for=3s,drop=0.01")
+    assert len(s.partitions) == 1
+    p = s.partitions[0]
+    assert p.links == [(0, 1, True), (0, 2, True)]
+    assert p.resolve(7) == ("step", 8, "sec", 3.0)
+    assert s.rate("drop", "x", 0) == 0.01  # rates compose unchanged
+    assert s.active()
+    # asymmetric direction + step duration + ranges
+    s2 = ChaosSpec.parse("7:part=2,links=1>2,at=4-9,for=2-5")
+    (p2,) = s2.partitions
+    assert p2.links == [(1, 2, False)]
+    at_u, at_v, d_u, d_v = p2.resolve(7)
+    assert at_u == "step" and 4 <= at_v <= 9
+    assert d_u == "step" and 2 <= d_v <= 5
+    # seeded draws are deterministic and per-entry-seed decorrelated
+    assert p2.resolve(7) == p2.resolve(7)
+    s3 = ChaosSpec.parse("7:part=3,links=1>2,at=4-9,for=2-5")
+    assert s3.partitions[0].resolve(7) != p2.resolve(7) \
+        or s3.partitions[0].pseed != p2.pseed
+    # two entries in one spec
+    s4 = ChaosSpec.parse("7:part=1,links=0-1,at=2,for=1,"
+                         "part=2,links=1-2,at=5,for=2s")
+    assert len(s4.partitions) == 2
+
+
+def test_chaos_spec_parses_slow_links():
+    s = ChaosSpec.parse("7:slow#0-1=12.5,slow#2>0=3")
+    assert s.slow == [(0, 1, True, 12.5), (2, 0, False, 3.0)]
+    assert s.active()
+
+
+def test_chaos_spec_partition_refusals_name_the_offense():
+    cases = {
+        "7:part=1,at=3": "links",              # entry without links
+        "7:links=0-1": "outside",              # links without part
+        "7:at=3": "outside",
+        "7:for=3": "outside",
+        "7:part=1,links=0-0,at=1,for=1": "self-link",
+        "7:part=x,links=0-1": "int",
+        "7:part=1,links=0-1,at=-2,for=1": "at",
+        "7:part=1,links=abc,at=1,for=1": "link",
+        "7:slow#1-1=5": "self-link",
+        "7:slow#0-1=abc": "float",
+        "7:slow#0-1=-4": "> 0",
+    }
+    for spec, frag in cases.items():
+        with pytest.raises(ValueError, match=frag):
+            ChaosSpec.parse(spec)
+
+
+def test_chaos_spec_fuzzer_parses_or_refuses_loudly():
+    """Satellite: seeded random specs assembled from the grammar's
+    alphabet (plus mutations) must either parse into a ChaosSpec or
+    raise ValueError — never a KeyError/IndexError/TypeError (a
+    half-parsed injector), and deterministically either way."""
+    rng = np.random.default_rng(20260804)
+    vocab = ["drop", "dup", "delay", "reorder", "part", "links", "at",
+             "for", "slow#0-1", "slow#1>2", "slow#x", "delay_ms",
+             "reorder_ms", "drop@psr", "drop#2", "bogus", "drop@ps#1"]
+    vals = ["0.1", "1", "3", "0-2", "0-1+1-2", "2>0", "3s", "2-5",
+            "1.5", "-1", "abc", "", "0.5s", "9-4"]
+    for _ in range(400):
+        seed = rng.integers(0, 100)
+        n = int(rng.integers(0, 6))
+        body = ",".join(
+            f"{vocab[rng.integers(0, len(vocab))]}"
+            f"={vals[rng.integers(0, len(vals))]}" for _ in range(n))
+        spec = f"{seed}:{body}"
+        outcomes = []
+        for _rep in range(2):
+            try:
+                s = ChaosSpec.parse(spec)
+                outcomes.append(("ok", len(s.partitions), len(s.slow)))
+            except ValueError as e:
+                outcomes.append(("refused", str(e)))
+            except Exception as e:  # noqa: BLE001 - the fuzz contract
+                pytest.fail(f"spec {spec!r} raised {type(e).__name__}: "
+                            f"{e} (must be ValueError or parse)")
+        assert outcomes[0] == outcomes[1], spec  # deterministic
+
+
+def _stub_chaos(spec: str, my_id: int = 1) -> ChaosBus:
+    """A ChaosBus with window state but no threads — enough to drive
+    ``on_clock``/``_partition_cuts`` directly."""
+    import threading
+
+    class _Stub:
+        pass
+
+    stub = _Stub()
+    stub.my_id = my_id
+    cb = ChaosBus.__new__(ChaosBus)
+    cb.bus = stub
+    cb.spec = ChaosSpec.parse(spec)
+    cb.stats = {k: 0 for k in ("frames", "dropped", "duplicated",
+                               "delayed", "reordered", "part_dropped",
+                               "slowed")}
+    cb._clock = 0
+    cb._t0 = time.monotonic()
+    cb._part_open = {}
+    cb._part_state = {}
+    cb._parts = [(p, p.resolve(cb.spec.seed))
+                 for p in cb.spec.partitions]
+    cb._slow_in = {}
+    cb._lock = threading.Lock()
+    return cb
+
+
+def test_partition_window_opens_by_clock_and_heals_by_wall_time():
+    cb = _stub_chaos("7:part=1,links=0-1,at=3,for=0.4s")
+    assert not cb._partition_cuts(0)     # clock 0: window closed
+    cb.on_clock(3)
+    assert cb._partition_cuts(0)         # symmetric: 0 -> me cut
+    assert not cb._partition_cuts(2)     # other links untouched
+    deadline = time.monotonic() + 5.0
+    while cb._partition_cuts(0):
+        assert time.monotonic() < deadline, "seconds window never healed"
+        time.sleep(0.02)                 # heals by WALL time at a
+    #                                      stalled clock — the trap a
+    #                                      step duration would hit
+
+
+def test_partition_asymmetric_direction_cuts_one_way_only():
+    # I am rank 1; entry cuts only frames FROM 0 arriving AT 1
+    cb = _stub_chaos("7:part=1,links=0>1,at=1,for=100", my_id=1)
+    cb.on_clock(1)
+    assert cb._partition_cuts(0)
+    # the reverse receiver: frames from 1 at rank 0 flow
+    cb0 = _stub_chaos("7:part=1,links=0>1,at=1,for=100", my_id=0)
+    cb0.on_clock(1)
+    assert not cb0._partition_cuts(1)
+
+
+def test_partition_cut_counts_and_reliable_recovers_post_heal():
+    """Real loopback buses: a seconds-windowed full cut eats frames
+    (counted under part_dropped, NOT dropped), and with the reliable
+    layer on, every cut frame is recovered after the heal — partition
+    loss is recoverable loss."""
+    from tests.conftest import mk_loopback_buses
+
+    buses = mk_loopback_buses(
+        2, chaos="11:part=1,links=0>1,at=0s,for=1.2s", reliable="1")
+    got: list[int] = []
+    buses[1].on("x", lambda s, p: got.append(p["i"]))
+    try:
+        for i in range(20):              # all inside the cut window
+            buses[0].send(1, "x", {"i": i})
+        time.sleep(0.4)
+        assert got == []                 # the link is CUT
+        ch = buses[1].chaos.snapshot()
+        assert ch["part_dropped"] >= 20
+        assert ch["dropped"] == 0        # distinct counters
+        deadline = time.time() + 20.0
+        while len(got) < 20 and time.time() < deadline:
+            time.sleep(0.05)
+        assert got == list(range(20)), (len(got), got[:5])
+        assert buses[1].frames_lost == 0  # recovered, all of it
+        assert buses[1].reliable.snapshot()["retransmits_got"] > 0
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_slow_link_delays_but_preserves_order():
+    from tests.conftest import mk_loopback_buses
+
+    buses = mk_loopback_buses(2, chaos="3:slow#0>1=120")
+    got: list[int] = []
+    buses[1].on("x", lambda s, p: got.append(p["i"]))
+    try:
+        t0 = time.monotonic()
+        for i in range(10):
+            buses[0].send(1, "x", {"i": i})
+        deadline = time.time() + 10.0
+        while len(got) < 10 and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == list(range(10))      # order preserved exactly
+        assert time.monotonic() - t0 >= 0.12  # the tax was paid
+        assert buses[1].chaos.snapshot()["slowed"] == 10
+        assert buses[1].frames_lost == 0
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_partition_armed_idle_is_bitwise_equal_to_clean_wire():
+    """Acceptance: a part= entry whose window never opens (and a bare
+    seed) perturbs NOTHING — the lockstep harness pins it bitwise."""
+    from tests.test_chaos_reliable import run_bsp_lockstep
+
+    w_clean, _ = run_bsp_lockstep(chaos="", reliable="")
+    w_armed, lost = run_bsp_lockstep(
+        chaos="9:part=1,links=0-1,at=1000,for=5", reliable="")
+    assert lost == [0, 0]
+    for off, on in zip(w_clean, w_armed):
+        np.testing.assert_array_equal(off, on)
+
+
+# ---------------------------------------------------- the quorum rule
+def test_quorum_needed_case_table():
+    assert quorum_needed({0, 1, 2}, 0) == 2   # 3-fleet: both survivors
+    assert quorum_needed({0, 1, 2}, 1) == 2
+    assert quorum_needed({0, 1}, 1) == 1      # 2-fleet: solo (honest
+    #                                           documented limit)
+    assert quorum_needed({0, 1, 2, 3}, 0) == 3  # even split: neither
+    #                                             side reaches 3
+    assert quorum_needed({1, 2}, 2) == 1      # 3-fleet remnant pair
+    assert quorum_needed({0, 1, 2, 3, 4}, 4) == 3
+
+
+def test_suspicion_quorum_minority_island_cannot_convict():
+    """THE split-brain case: rank 0 (minority) suspects everyone; no
+    quorum. The majority pair suspecting rank 0 convicts."""
+    q0 = SuspicionQuorum(0)
+    q0.set_local({1, 2})
+    assert q0.convictable({0, 1, 2}) == []    # 1 vote < needed 2
+    q1 = SuspicionQuorum(1)
+    q1.set_local({0})
+    assert q1.convictable({0, 1, 2}) == []    # own vote alone: no
+    q1.vote(2, [0])                           # the corroboration lands
+    assert q1.convictable({0, 1, 2}) == [0]
+    assert q1.voters_for(0, {0, 1, 2}) == [1, 2]
+
+
+def test_suspicion_quorum_retraction_and_dead_voters():
+    q = SuspicionQuorum(1)
+    q.set_local({0})
+    q.vote(2, [0])
+    assert q.convictable({0, 1, 2}) == [0]
+    q.vote(2, [])                             # rank 2 heard a beat
+    assert q.convictable({0, 1, 2}) == []
+    q.vote(2, [0])
+    q.drop_voter(2)                           # rank 2 died meanwhile
+    assert q.convictable({0, 1, 2}) == []
+    # a dead rank's stale ballot never counts
+    q.vote(3, [0])
+    assert q.convictable({0, 1, 2}) == []     # 3 not in live view
+
+
+def test_heartbeat_quorum_mode_suspects_then_convicts():
+    """With on_suspect armed, silence makes a suspect (hook fired
+    once), a beat retracts, and convict() promotes to dead + fires
+    on_failure exactly once."""
+    from tests.conftest import mk_loopback_buses
+
+    from minips_tpu.comm.heartbeat import HeartbeatMonitor
+
+    buses = mk_loopback_buses(1)
+    try:
+        fake = [0.0]
+        sus_events: list = []
+        deaths: list = []
+        mon = HeartbeatMonitor(buses[0], [0, 1, 2], interval=0.1,
+                               timeout=1.0, clock=lambda: fake[0],
+                               on_failure=deaths.append)
+        mon.on_suspect = lambda r, s: sus_events.append((r, s))
+        fake[0] = 1.5
+        assert mon.check() == set()           # suspects, NOT dead
+        assert mon.suspects == {1, 2}
+        assert sorted(sus_events) == [(1, True), (2, True)]
+        assert deaths == []
+        mon.check()                           # idempotent per suspect
+        assert sorted(sus_events) == [(1, True), (2, True)]
+        mon._on_beat(2, {})                   # rank 2 speaks: retract
+        assert mon.suspects == {1}
+        assert (2, False) in sus_events
+        mon.convict(1)
+        assert deaths == [1] and mon.dead == {1}
+        mon.convict(1)                        # exactly once
+        assert deaths == [1]
+        assert mon.stats()["suspects"] == []
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_stall_forgiveness_retracts_standing_suspicions():
+    from tests.conftest import mk_loopback_buses
+
+    from minips_tpu.comm.heartbeat import HeartbeatMonitor
+
+    os.environ["MINIPS_HEARTBEAT"] = "interval=0.1,timeout=1.0,stall=2.0"
+    buses = mk_loopback_buses(1)
+    try:
+        fake = [0.0]
+        sus_events: list = []
+        mon = HeartbeatMonitor(buses[0], [0, 1], interval=0.1,
+                               timeout=1.0, clock=lambda: fake[0])
+        mon.on_suspect = lambda r, s: sus_events.append((r, s))
+        fake[0] = 1.5
+        mon.check()
+        assert mon.suspects == {1}
+        fake[0] = 8.0                         # 6.5s observer coma
+        mon.check()                           # forgive + retract
+        assert mon.suspects == set()
+        assert (1, False) in sus_events
+    finally:
+        os.environ.pop("MINIPS_HEARTBEAT", None)
+        for b in buses:
+            b.close()
+
+
+def test_false_conviction_drill_delay_near_timeout_with_stall():
+    """Satellite: seeded chaos ``delay`` pushing heartbeat latency
+    NEAR the timeout must not convict a live rank while ``stall=``
+    forgiveness is armed — the PR12 forgiveness window pinned against
+    chaos-injected latency instead of scheduler comas."""
+    from tests.conftest import mk_loopback_buses
+
+    from minips_tpu.comm.heartbeat import HeartbeatMonitor
+
+    os.environ["MINIPS_HEARTBEAT"] = \
+        "interval=0.1,timeout=1.0,stall=2.0"
+    # every heartbeat delayed ~0.7s +/-50% jitter: arrival gaps swing
+    # toward (but under) the 1.0s timeout
+    buses = mk_loopback_buses(
+        2, chaos="77:delay@heartbeat=1.0,delay_ms=700")
+    mons = []
+    try:
+        deaths: list = []
+        for i in (0, 1):
+            m = HeartbeatMonitor(buses[i], [0, 1], interval=0.1,
+                                 timeout=1.0,
+                                 on_failure=deaths.append)
+            m.on_suspect = lambda r, s: None  # quorum mode: suspicion
+            #                                   alone must never convict
+            mons.append(m.start())
+        time.sleep(3.0)
+        assert deaths == []
+        for m in mons:
+            assert m.dead == set(), m.stats()
+        assert sum(b.chaos.snapshot()["delayed"]
+                   for b in buses) > 0   # the injector really fired
+    finally:
+        os.environ.pop("MINIPS_HEARTBEAT", None)
+        for m in mons:
+            m.stop()
+        for b in buses:
+            b.close()
+
+
+# ------------------------------------------------- reliable: reopen
+def _mk_reliable_pair(clk, **kw):
+    from minips_tpu.comm.bus import FrameLossTracker
+    from minips_tpu.comm.reliable import ReliableChannel
+
+    class _FakeBus:
+        def __init__(self, my_id):
+            self.my_id = my_id
+            self._handlers = {}
+            self.loss = FrameLossTracker()
+            self.sent = []
+            self._bseq = 0
+            self._dseq = ()
+
+        def on(self, k, h):
+            self._handlers[k] = h
+
+        def send(self, d, k, p, blob=None):
+            self.sent.append((d, k, p, blob))
+
+        def publish(self, k, p, blob=None):
+            self.sent.append((-1, k, p, blob))
+
+    tx_bus, rx_bus = _FakeBus(0), _FakeBus(1)
+    tx = ReliableChannel(tx_bus, clock=lambda: clk[0],
+                         start_thread=False, **kw)
+    rx = ReliableChannel(rx_bus, clock=lambda: clk[0],
+                         start_thread=False, **kw)
+    return tx, rx, tx_bus, rx_bus
+
+
+def _stamped(i: int) -> tuple[dict, bytes]:
+    head = {"kind": "x", "sender": 0, "payload": {"i": i}, "ds": i}
+    return head, json.dumps(head).encode()
+
+
+def _route_once(tx, rx, tx_bus, rx_bus):
+    from minips_tpu.comm.reliable import GONE_KIND, NACK_KIND, RT_KIND
+
+    for _d, k, p, _b in rx_bus.sent:
+        if k == NACK_KIND:
+            tx._on_nack(1, p)
+    rx_bus.sent.clear()
+    for _d, k, p, b in tx_bus.sent:
+        if k == RT_KIND:
+            pp = dict(p)
+            if b is not None:
+                pp["__blob__"] = b
+            rx._on_rt(0, pp)
+        elif k == GONE_KIND:
+            rx._on_gone(0, p)
+    tx_bus.sent.clear()
+
+
+def test_reopen_recovers_journal_resident_seqs_after_heal():
+    """Satellite regression: a partition outlasting the NACK budget
+    gives the hole up — a post-heal ``__rl_top`` advert must REOPEN it
+    (counted) and the journal-resident seqs recover with zero
+    unrecovered loss."""
+    clk = [0.0]
+    tx, rx, tx_bus, rx_bus = _mk_reliable_pair(clk, retry_budget=3)
+    got: list[int] = []
+    rx_bus.on("x", lambda s, p: got.append(p["i"]))
+    frames = [_stamped(i) for i in range(8)]
+    for h, m in frames:
+        tx.journal_stamped("d", 1, h["ds"], m, None)
+    rx.on_stamped(frames[0][0], None)
+    rx._on_top(0, {"b": 0, "d": {"1": 6}})   # 1..5 missing, cut link:
+    for _ in range(40):                       # NACKs go into the void
+        clk[0] += 0.7
+        rx.pump(clk[0])
+        rx_bus.sent.clear()
+        if rx.outstanding_gaps() == 0:
+            break
+    assert rx.stats["gave_up"] == 5 and got == [0]
+    # HEAL: the advert returns; this time NACKs route for real
+    rx._on_top(0, {"b": 0, "d": {"1": 6}})
+    assert rx.stats["reopened"] == 5
+    for _ in range(40):
+        clk[0] += 0.7
+        rx.pump(clk[0])
+        _route_once(tx, rx, tx_bus, rx_bus)
+        if rx.outstanding_gaps() == 0:
+            break
+    assert got == [0, 1, 2, 3, 4, 5]
+    assert rx_bus.loss.lost == 0
+    # live traffic continues in order past the healed hole
+    rx.on_stamped(frames[6][0], None)
+    rx.on_stamped(frames[7][0], None)
+    assert got == list(range(8))
+
+
+def test_reopen_refused_when_later_frames_were_delivered():
+    """Late delivery would violate per-link order: once any seq past
+    the hole has been DELIVERED, the heal must not rewind — the hole
+    stays the counted loss it already is."""
+    clk = [0.0]
+    _tx, rx, _tx_bus, rx_bus = _mk_reliable_pair(clk, retry_budget=2)
+    got: list[int] = []
+    rx_bus.on("x", lambda s, p: got.append(p["i"]))
+    frames = [_stamped(i) for i in range(6)]
+    rx.on_stamped(frames[0][0], None)
+    rx.on_stamped(frames[4][0], None)        # 1..3 gap, 4 buffered
+    for _ in range(40):                       # exhaust into the void
+        clk[0] += 0.7
+        rx.pump(clk[0])
+        rx_bus.sent.clear()
+        if rx.outstanding_gaps() == 0:
+            break
+    assert got == [0, 4]                      # 4 DELIVERED past hole
+    lost_before = rx_bus.loss.lost
+    assert lost_before == 3
+    rx._on_top(0, {"b": 0, "d": {"1": 5}})    # heal signal
+    assert rx.stats["reopened"] == 0          # refused: order holds
+    rx.on_stamped(frames[5][0], None)
+    assert got == [0, 4, 5]
+    assert rx_bus.loss.lost == lost_before
+
+
+def test_reopen_is_once_only_per_seq():
+    """A reopened gap that exhausts its budget AGAIN is permanent —
+    the reopen path is bounded, not a retry-forever loop."""
+    clk = [0.0]
+    _tx, rx, _tx_bus, rx_bus = _mk_reliable_pair(clk, retry_budget=2)
+    got: list[int] = []
+    rx_bus.on("x", lambda s, p: got.append(p["i"]))
+    rx.on_stamped(_stamped(0)[0], None)
+    rx._on_top(0, {"b": 0, "d": {"1": 3}})
+
+    def exhaust():
+        for _ in range(40):
+            clk[0] += 0.7
+            rx.pump(clk[0])
+            rx_bus.sent.clear()
+            if rx.outstanding_gaps() == 0:
+                return
+
+    exhaust()
+    assert rx.stats["gave_up"] == 2
+    rx._on_top(0, {"b": 0, "d": {"1": 3}})    # first heal: reopen
+    assert rx.stats["reopened"] == 2
+    exhaust()                                  # void again: exhaust
+    with rx._lock:
+        heal = set(rx._rx[(0, "d")].heal)
+    assert heal == set()                       # NOT healable again
+    rx._on_top(0, {"b": 0, "d": {"1": 3}})
+    assert rx.stats["reopened"] == 2           # no second reopen
+
+
+def test_reopen_reskips_gone_seqs_without_renacking():
+    """Review regression: a seq the sender declared __rl_gone inside a
+    budget-exhausted hole must be RE-SKIPPED by the reopen, never
+    re-NACKed — the sender already confessed, and a second gone
+    round-trip would double-count gave_up."""
+    clk = [0.0]
+    tx, rx, tx_bus, rx_bus = _mk_reliable_pair(clk, retry_budget=2)
+    got: list[int] = []
+    rx_bus.on("x", lambda s, p: got.append(p["i"]))
+    frames = [_stamped(i) for i in range(6)]
+    for h, m in frames:
+        if h["ds"] != 2:                  # seq 2 never journaled: the
+            tx.journal_stamped("d", 1, h["ds"], m, None)  # gone case
+    rx.on_stamped(frames[0][0], None)
+    rx._on_top(0, {"b": 0, "d": {"1": 5}})   # 1..4 missing
+    rx._on_gone(0, {"s": "d", "seqs": [2]})  # sender confesses seq 2
+    gave_after_gone = rx.stats["gave_up"]
+    for _ in range(40):                       # budget-exhaust the rest
+        clk[0] += 0.7
+        rx.pump(clk[0])
+        rx_bus.sent.clear()
+        if rx.outstanding_gaps() == 0:
+            break
+    rx._on_top(0, {"b": 0, "d": {"1": 5}})   # HEAL
+    assert rx.stats["reopened"] == 3          # 1, 3, 4 — never 2
+    for _ in range(40):
+        clk[0] += 0.7
+        rx.pump(clk[0])
+        _route_once(tx, rx, tx_bus, rx_bus)
+        if rx.outstanding_gaps() == 0:
+            break
+    assert got == [0, 1, 3, 4]                # 2 stays the one loss
+    assert rx_bus.loss.lost == 1
+    assert rx.stats["gave_up"] == gave_after_gone + 3  # no recount of
+    #                                                    the gone seq
+    # seq 2's confession was injected by hand pre-heal; the post-heal
+    # recovery rounds must not re-NACK it (a re-ask would make the
+    # sender confess AGAIN — gone_sent stays zero)
+    assert tx.stats["gone_sent"] == 0
+    rx.on_stamped(frames[5][0], None)
+    assert got == [0, 1, 3, 4, 5]
+
+
+def test_sole_survivor_holder_drains_by_finishing():
+    """Review regression: the LAST live rank asked to drain has nobody
+    to hand the lease to or ship blocks at — leave() must quiesce
+    cleanly (no handover RuntimeError escaping the drain path)."""
+    from tests.conftest import mk_loopback_buses
+
+    from minips_tpu.train.sharded_ps import (ShardedPSTrainer,
+                                             ShardedTable)
+
+    buses = mk_loopback_buses(2)
+    try:
+        tables = [ShardedTable("t", 64, 2, buses[i], i, 2,
+                               updater="sgd", pull_timeout=10.0)
+                  for i in range(2)]
+        trainers = [ShardedPSTrainer({"t": tables[i]}, buses[i], 2,
+                                     staleness=0, rebalance="",
+                                     serve="", elastic="1")
+                    for i in range(2)]
+        mb0 = trainers[0].membership
+        mb0._on_gone(1, {"rank": 1})     # rank 1 already left
+        assert mb0.live_view() == {0}
+        mb0.leave(timeout=5.0)           # sole survivor: clean quiesce
+        assert 0 in mb0.left
+        assert mb0.lease.stats()["handovers"] == 0  # nothing to hand
+    finally:
+        for b in buses:
+            b.close()
+
+
+# ------------------------------------------- flight: corrupt dumps
+def _mini_dump(rank: int) -> dict:
+    return {"rank": rank, "pid": 1, "run_id": None, "cap": 16,
+            "t0_mono_us": 0.0, "t0_wall": 0.0,
+            "events": [{"t_us": 10.0 * rank, "kind": "hb_death",
+                        "args": {"rank": 0}}],
+            "reasons": [{"t_us": 10.0 * rank, "kind": "hb_death",
+                         "args": {"rank": 0}}],
+            "reasons_dropped": 0, "hb_delays_us": {}, "window": None}
+
+
+def test_flight_merge_skips_truncated_dump_and_exits_zero(tmp_path):
+    """Satellite: a SIGKILL mid-write leaves a partial file — the
+    merge CLI must skip-and-report that rank, keep every other rank's
+    box, and exit 0."""
+    from minips_tpu.obs import flight as fl
+
+    d = tmp_path / "flight"
+    d.mkdir()
+    for r in (1, 2):
+        (d / f"flight-rank{r}.json").write_text(
+            json.dumps(_mini_dump(r)))
+    full = json.dumps(_mini_dump(0))
+    (d / "flight-rank0.json").write_text(full[:len(full) // 2])  # torn
+    skipped: list = []
+    dumps = fl.load_dumps([str(d)], skipped=skipped)
+    assert sorted(dumps) == [1, 2]
+    assert len(skipped) == 1 and "rank0" in skipped[0][0]
+    rc = fl.main([str(d)])
+    assert rc == 0
+    # structurally-broken but valid JSON: rank demoted, merge survives
+    # — including the summary/offset paths (a reason entry missing
+    # "kind" and a non-dict hb table both parse fine and must not
+    # crash the CLI one layer up from the row loop's catch)
+    (d / "flight-rank3.json").write_text(
+        json.dumps({"rank": 3, "events": [{"nope": 1}],
+                    "reasons": [{"t_us": 5.0}],
+                    "hb_delays_us": "torn"}))
+    dumps = fl.load_dumps([str(d)])
+    merged, summary = fl.merge_dumps(dumps)
+    assert summary["malformed_ranks"] == [3]
+    assert sorted(summary["ranks"]) == [1, 2, 3]
+    assert summary["reasons"][3] == ["<malformed>"]
+    assert fl.main([str(d)]) == 0
+
+
+def test_flight_merge_all_corrupt_exits_one(tmp_path):
+    from minips_tpu.obs import flight as fl
+
+    d = tmp_path / "flight"
+    d.mkdir()
+    (d / "flight-rank0.json").write_text("{this is not json")
+    assert fl.main([str(d)]) == 1
+
+
+# ------------------------------------- handover: state-transfer oracle
+class _FakeLease:
+    def current(self):
+        return (0, 0)
+
+    def stamp(self):
+        return {"lt": 0, "lh": 0}
+
+
+class _FakeMB:
+    def __init__(self, live, coord=0):
+        self._live = set(live)
+        self.coord = coord
+        self.hold_joins = False
+        self.lease = _FakeLease()
+        self.pending = 1
+        self.credits = 0
+
+    def live_view(self):
+        return set(self._live)
+
+    def pending_joins(self):
+        return self.pending
+
+    def grant_join(self):
+        self.credits += 1
+
+
+class _FakeRB:
+    def __init__(self):
+        self.reports = {}
+
+    def heat_reports(self, name):
+        return {r: dict(rep) for r, rep in self.reports.items()}
+
+
+class _FakeBus:
+    def __init__(self, my_id=0):
+        self.my_id = my_id
+        self.sent = []
+
+    def send(self, to, kind, payload):
+        self.sent.append((int(to), kind))
+
+
+class _FakeTrainer:
+    def __init__(self, rank=0):
+        self.tables = {"w": None}
+        self.rebalancer = _FakeRB()
+        self.bus = _FakeBus(rank)
+
+
+def test_autoscaler_handover_state_transfer_matches_oracle():
+    """Acceptance satellite: the successor's next autoscale decision
+    equals an uninterrupted oracle's — streaks, cool-down, rates, AND
+    the shed-counter baselines all cross the mbH frame."""
+    spec = "up_shed=5,up_after=3,down_after=3,cool=1"
+
+    def feed(tr, shed):
+        tr.rebalancer.reports = {
+            r: {"total": 10.0, "sv": {"shed": shed}} for r in (0, 1, 2)}
+
+    # oracle: one holder sees the whole signal history
+    tr_a = _FakeTrainer(0)
+    mb_a = _FakeMB({0, 1, 2})
+    a = Autoscaler(tr_a, mb_a, AutoscaleConfig.parse(spec))
+    # interrupted: holder 0 runs two hot ticks, hands over, holder 1
+    # (a fresh Autoscaler on another rank) installs and continues
+    tr_b0 = _FakeTrainer(0)
+    mb_b = _FakeMB({0, 1, 2})
+    b0 = Autoscaler(tr_b0, mb_b, AutoscaleConfig.parse(spec))
+    tr_b1 = _FakeTrainer(1)
+    mb_b1 = _FakeMB({0, 1, 2}, coord=1)
+    b1 = Autoscaler(tr_b1, mb_b1, AutoscaleConfig.parse(spec))
+
+    sig = [0.0, 10.0, 20.0]               # baseline + 2 hot ticks
+    for s in sig:
+        feed(tr_a, s)
+        a.on_tick()
+        feed(tr_b0, s)
+        b0.on_tick()
+    assert a.counters["admits"] == 0      # streak at 2 of 3
+    state = b0.export_state()             # the mbH payload
+    b1.install_state(state)
+    # round-trip through the wire codec shapes (str keys, lists)
+    assert b1.export_state() == state
+    feed(tr_a, 30.0)
+    a.on_tick()                           # oracle: 3rd hot tick fires
+    feed(tr_b1, 30.0)
+    b1.on_tick()
+    assert a.counters["admits"] == 1
+    assert b1.counters["admits"] == 1     # same decision, same tick
+    assert mb_b1.credits == 1
+    # without the transferred baselines the successor's first diff
+    # would re-baseline and see zero sheds — the admit would slip a
+    # tick; prove the baseline crossed:
+    assert b1.shed_rate_pre == a.shed_rate_pre
+
+
+def test_membership_handover_transfers_lease_and_state():
+    """In-proc pair: the holder's handover() advances the term exactly
+    once, re-targets both ranks, and installs the queues + heat
+    reports at the successor."""
+    from tests.conftest import mk_loopback_buses
+
+    from minips_tpu.train.sharded_ps import (ShardedPSTrainer,
+                                             ShardedTable)
+
+    buses = mk_loopback_buses(2)
+    try:
+        tables = [ShardedTable("t", 64, 2, buses[i], i, 2,
+                               updater="sgd", lr=0.5,
+                               pull_timeout=20.0) for i in range(2)]
+        trainers = [ShardedPSTrainer({"t": tables[i]}, buses[i], 2,
+                                     staleness=0, gate_timeout=30.0,
+                                     rebalance="", serve="",
+                                     elastic="1") for i in range(2)]
+        mb0, mb1 = trainers[0].membership, trainers[1].membership
+        # seed some coordinator-only state at the holder
+        mb0.rb.install_reports(
+            {"t": {1: {"total": 7.0, "blocks": [], "heat": []}}})
+        with mb0._lock:
+            mb0._join_credits = 2
+        succ = mb0.handover()
+        assert succ == 1
+        assert mb0.lease.current() == (1, 1)
+        assert mb0.coord == 1 and mb0.rb.coord == 1
+        assert mb0.lease.stats()["handovers"] == 1
+        deadline = time.monotonic() + 5.0
+        while mb1.coord != 1:
+            assert time.monotonic() < deadline, "mbH never landed"
+            time.sleep(0.01)
+        assert mb1.lease.current() == (1, 1)
+        assert mb1.lease.stats()["successions"] == 0  # voluntary, not
+        #                                               a death ballot
+        deadline = time.monotonic() + 5.0
+        while mb1._join_credits < 2:
+            assert time.monotonic() < deadline, "credits never crossed"
+            time.sleep(0.01)
+        assert mb1.rb.heat_reports("t")[1]["total"] == 7.0
+        # a second handover attempt from the NON-holder refuses
+        with pytest.raises(RuntimeError, match="does not hold"):
+            mb0.handover()
+    finally:
+        for b in buses:
+            b.close()
+
+
+# --------------------------------------------- the three new tripwires
+def _gate(new):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from ci.bench_regression import partition_tripwires
+
+    return partition_tripwires(new)
+
+
+def _green_grid():
+    return {"partition_3proc": {
+        "iters": 80,
+        "fence_heal": {
+            "completed": True, "iters": 80, "clock_min": 80,
+            "lease_term": 1, "terms_agree": True, "fenced_total": 2,
+            "ex_coord_fenced_out": True, "part_dropped": 29,
+            "wire_frames_lost": 0, "finals_agree": True},
+        "handover": {
+            "completed": True, "iters": 30, "clock_min": 30,
+            "lease_term": 1, "terms_agree": True,
+            "leaver_drained": True, "deaths": 0,
+            "wire_frames_lost": 0, "finals_agree": True}}}
+
+
+def test_partition_tripwires_pass_on_green_artifact():
+    assert _gate(_green_grid()) == []
+    assert _gate({}) == []                # vacuous without the sweep
+
+
+def test_partition_fence_tripwire_trips_on_unfenced_or_zombie():
+    g = _green_grid()
+    g["partition_3proc"]["fence_heal"]["fenced_total"] = 0
+    probs = _gate(g)
+    assert any("PARTITION-FENCE" in p and "fenced" in p for p in probs)
+    g = _green_grid()
+    g["partition_3proc"]["fence_heal"]["ex_coord_fenced_out"] = False
+    assert any("zombie" in p for p in _gate(g))
+    g = _green_grid()
+    g["partition_3proc"]["fence_heal"]["lease_term"] = 2
+    assert any("exactly one term" in p for p in _gate(g))
+
+
+def test_partition_heal_tripwire_trips_on_loss_or_idle_injector():
+    g = _green_grid()
+    g["partition_3proc"]["fence_heal"]["wire_frames_lost"] = 3
+    assert any("PARTITION-HEAL" in p and "unrecovered" in p
+               for p in _gate(g))
+    g = _green_grid()
+    g["partition_3proc"]["fence_heal"]["part_dropped"] = 0
+    assert any("never engaged" in p for p in _gate(g))
+    g = _green_grid()
+    g["partition_3proc"]["fence_heal"]["clock_min"] = 79
+    assert any("lost steps" in p for p in _gate(g))
+    g = _green_grid()
+    g["partition_3proc"]["fence_heal"]["completed"] = False
+    assert any("PARTITION-FENCE" in p for p in _gate(g))
+
+
+def test_handover_tripwire_trips_on_flap_death_or_poison():
+    g = _green_grid()
+    g["partition_3proc"]["handover"]["lease_term"] = 2
+    assert any("HANDOVER" in p and "exactly once" in p
+               for p in _gate(g))
+    g = _green_grid()
+    g["partition_3proc"]["handover"]["deaths"] = 1
+    assert any("raced the failure detector" in p for p in _gate(g))
+    g = _green_grid()
+    g["partition_3proc"]["handover"]["leaver_drained"] = False
+    assert any("drain path" in p for p in _gate(g))
+
+
+# ------------------------------------------------------- process drills
+def _run_raw(n, extra, env, timeout=240.0):
+    return launch.run_local_job_raw(
+        n, [sys.executable, "-m", APP] + extra, base_port=None,
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                   **env},
+        timeout=timeout, kill_on_failure=False)
+
+
+def test_holder_self_drain_drill_term_advances_exactly_once():
+    """HANDOVER acceptance (fast): the lease holder drains itself —
+    voluntary transfer (term 1, exactly once, zero deaths), leaver rc
+    0 via the drain path with the handover counter set, survivors
+    complete every step and agree bitwise."""
+    rc, events = _run_raw(
+        3, ["--model", "sparse", "--mode", "ssp", "--staleness", "2",
+            "--iters", "30", "--batch", "64",
+            "--drain-rank", "0", "--drain-at", "10"],
+        {"MINIPS_ELASTIC": "1", "MINIPS_AUTOSCALE": "1",
+         "MINIPS_HEARTBEAT": "interval=0.1,timeout=2.0"})
+    assert rc == 0, events
+    by_last = {r: ev[-1] for r, ev in enumerate(events) if ev}
+    drained = by_last[0]
+    assert drained.get("event") == "drained", drained
+    m0 = drained["membership"]
+    assert m0["lease"]["term"] == 1
+    assert m0["lease"]["handovers"] == 1
+    assert m0["lease"]["successions"] == 0
+    assert m0["coord"] == 1 and m0["dead"] == []
+    # a leaver exiting with resident residuals would be lost gradient
+    assert not (drained.get("ef") or {}).get("resident_rows")
+    dones = {r: by_last[r] for r in (1, 2)
+             if by_last[r].get("event") == "done"}
+    assert set(dones) == {1, 2}, by_last
+    for d in dones.values():
+        assert d["clock"] == 30              # zero lost steps
+        assert d["wire_frames_lost"] == 0
+        m = d["membership"]
+        assert m["lease"]["term"] == 1       # exactly once
+        assert m["coord"] == 1
+        assert m["dead"] == [] and m["left"] == [0]
+        assert m["deaths"] == 0              # zero convictions: the
+        #                                      handover beat the
+        #                                      failure detector
+    assert len({d["param_sum"] for d in dones.values()}) == 1
+
+
+@pytest.mark.slow
+def test_partition_drill_quorum_fences_minority_ex_coordinator(
+        tmp_path):
+    """THE partition acceptance drill (slow): seeded symmetric link
+    cut isolates rank 0 (the holder) for 1.5 wall seconds. The
+    majority convicts it by QUORUM, takes the lease (term 1 exactly
+    once), restores its ranges; the stale plan rank 0 issued inside
+    the cut is recovered post-heal and FENCED at every survivor;
+    rank 0 exits fenced_out; survivors complete every step bitwise
+    with zero unrecovered frames. The flight boxes — NO observability
+    env armed — reconstruct suspicion → quorum verdict → term
+    advance."""
+    run_id = str(91_000_000 + os.getpid())
+    flight_dir = os.path.join(tempfile.gettempdir(),
+                              f"minips-flight-{run_id}")
+    ck = str(tmp_path / "ck")
+    rc, events = _run_raw(
+        3, ["--model", "sparse", "--mode", "ssp", "--staleness", "2",
+            "--iters", "80", "--batch", "64",
+            "--checkpoint-dir", ck, "--checkpoint-every", "4",
+            "--slow-rank", "0", "--slow-ms", "20",
+            "--own-keys-rank", "0", "--coord-plan-at", "10",
+            "--jitter-ms", "30", "--jitter-prob", "0.8"],
+        {"MINIPS_ELASTIC": "1",
+         "MINIPS_RELIABLE": "budget=4,backoff_ms=25,"
+                            "backoff_max_ms=150,advert_ms=100",
+         "MINIPS_CHAOS": "5:part=1,links=0-1+0-2,at=8,for=1.5s",
+         "MINIPS_HEARTBEAT": "interval=0.1,timeout=0.7",
+         "MINIPS_TRACE": "", "MINIPS_FLIGHT": "", "MINIPS_OBS": "",
+         "MINIPS_RUN_ID": run_id},
+        timeout=300.0)
+    by_last = {r: (ev[-1] if ev else {}) for r, ev in enumerate(events)}
+    # the minority ex-coordinator: convicted alive, exits fenced out
+    assert by_last[0].get("event") == "fenced_out", by_last[0]
+    assert by_last[0]["term"] == 1
+    dones = {r: by_last[r] for r in (1, 2)
+             if by_last[r].get("event") == "done"}
+    assert set(dones) == {1, 2}, (rc, by_last)
+    fenced_total = 0
+    for d in dones.values():
+        assert d["clock"] == 80              # zero lost steps
+        assert d["wire_frames_lost"] == 0    # zero unrecovered frames
+        m = d["membership"]
+        assert m["lease"]["term"] == 1       # the quorum minted ONE
+        assert m["coord"] == 1 and m["dead"] == [0]
+        assert (d["chaos"] or {})["part_dropped"] > 0
+        fenced_total += m["lease"]["fenced"] \
+            + (d["rebalance"] or {}).get("stale_plans_fenced", 0)
+    assert fenced_total >= 1                 # the stale plan DIED at
+    #                                          the survivors' fences
+    assert sum(d["membership"]["blocks_restored"]
+               for d in dones.values()) >= 1
+    assert len({d["param_sum"] for d in dones.values()}) == 1
+    # flight reconstruction, zero pre-arming: suspicion → quorum
+    # verdict → term advance on the merged timeline
+    for r in (1, 2):
+        assert os.path.exists(os.path.join(
+            flight_dir, f"flight-rank{r}.json"))
+    proc = subprocess.run(
+        [sys.executable, "-m", "minips_tpu.obs.flight", flight_dir],
+        capture_output=True, text=True, timeout=60.0)
+    assert proc.returncode == 0, proc.stderr
+    timeline = "\n".join(proc.stdout.splitlines()[:-1])
+    assert timeline.index("hb_suspect") \
+        < timeline.index("quorum_verdict") \
+        < timeline.index("term_advance")
+    # the ex-coordinator's own box records its fencing-out
+    r0_box = os.path.join(flight_dir, "flight-rank0.json")
+    if os.path.exists(r0_box):  # rank 0 unwound (not SIGKILLed): box
+        doc = json.load(open(r0_box))
+        assert any(e["kind"] == "fenced_out" for e in doc["reasons"])
