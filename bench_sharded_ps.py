@@ -288,6 +288,141 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     return out
 
 
+def fail_slow_arms(quick: bool = False) -> dict:
+    import glob as _glob
+    import tempfile
+
+    from minips_tpu import launch as _launch
+
+    f_iters = 30 if quick else 40
+    fbase = [sys.executable, "-m",
+             "minips_tpu.apps.sharded_ps_example",
+             "--model", "sparse", "--mode", "ssp",
+             "--staleness", "2", "--iters", str(f_iters),
+             "--batch", "64",
+             # the read storm aims at rank 1's hot range from step
+             # 2 THROUGH the last step so the windowed (last-K-
+             # rolls) p99 measures warmed steady-state reads, past
+             # the cold-start replica promotion window
+             "--storm-from", "2", "--storm-until", str(f_iters),
+             "--storm-pulls", "6", "--storm-keys", "64"]
+    env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+            "MINIPS_RELIABLE": "", "MINIPS_REBALANCE": "",
+            "MINIPS_TRACE": "", "MINIPS_SERVE": "",
+            "MINIPS_BUS": "", "MINIPS_WIRE_FMT": "",
+            "MINIPS_CHAOS_KILL": "", "MINIPS_PUSH_COMM": "",
+            "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
+            "MINIPS_ELASTIC": "", "MINIPS_SLOW": "",
+            "MINIPS_HEDGE": "", "MINIPS_OBS": "",
+            "MINIPS_FLIGHT": "", "MINIPS_HEARTBEAT": "",
+            # the injection: every frame FROM rank 1 arrives 40ms
+            # late at both peers (replies, acks, clock gossip —
+            # the whole outbound plane of a sick NIC), jittered
+            # ~8ms on the 1->2 link so detection sees variance
+            "MINIPS_CHAOS": "11:slow#1>0=40,slow#1>2=40~8"}
+    serve = ("replicas=1,hot=200,topk=200,interval=0.05,"
+             "min_heat=1")
+    grid: dict = {"iters": f_iters, "sick_rank": 1,
+                  "reader_rank": 0}
+
+    def arm(name: str, extra_env: dict, flight: str = "") -> dict:
+        try:
+            res = _launch.run_local_job(
+                3, list(fbase), base_port=None,
+                env_extra={**env0, **extra_env}, timeout=240.0)
+            win = [(((d.get("window") or {}).get("hist") or {})
+                    .get("pull_latency") or {}) for d in res]
+            sums = {d.get("param_sum") for d in res}
+            hedges = [d.get("hedge") or {} for d in res]
+            slw = [d.get("slowness") or {} for d in res]
+            out = {
+                "completed": all(d.get("event") == "done"
+                                 for d in res),
+                "steps_per_sec_slow": round(
+                    f_iters / max(max(d["wall_s"] for d in res),
+                                  1e-9), 2),
+                "clock_min": min(d.get("clock", 0) for d in res),
+                # the SLOW-HEDGE observable: the designated
+                # reader's WARMED windowed read p99 (rank 0 — not
+                # a holder, so its slow legs must hedge over the
+                # wire; cumulative p99 would charge the arm for
+                # the pre-promotion cold start)
+                "reader_p99_ms": win[0].get("p99_ms"),
+                "p99_ms_by_rank": [w.get("p99_ms") for w in win],
+                "hedges_fired": sum(h.get("fired", 0)
+                                    for h in hedges),
+                "hedges_won": sum(h.get("won", 0)
+                                  for h in hedges),
+                "slow_suspects_raised": sum(
+                    s.get("suspects_raised", 0) for s in slw),
+                "slow_verdicts": sum(
+                    (d.get("membership") or {}).get(
+                        "slow_verdicts", 0) for d in res),
+                "sick_blocks_out": (res[1].get("rebalance")
+                                    or {}).get("blocks_out", 0),
+                "slowed": sum((d.get("chaos") or {}).get(
+                    "slowed", 0) for d in res),
+                "wire_frames_lost": sum(
+                    d.get("wire_frames_lost", 0) for d in res),
+                "finals_agree": len(sums) == 1,
+            }
+            if flight:
+                files = sorted(_glob.glob(os.path.join(
+                    flight, "flight-rank*.json")))
+                kinds: set = set()
+                for fp in files:
+                    with open(fp) as fh:
+                        doc = json.load(fh)
+                    kinds |= {e.get("kind")
+                              for e in doc.get("events", ())}
+                want = {"slow_suspect", "slow_verdict",
+                        "hedge_fired", "demote"}
+                out["flight_dumps"] = len(files)
+                out["flight_events"] = sorted(kinds & want)
+                out["flight_events_ok"] = want <= kinds
+            return out
+        except Exception as e:  # noqa: BLE001 - completion-gated
+            return {"completed": False, "error": str(e)[:300]}
+
+    grid["unmitigated"] = arm("unmitigated", {})
+    grid["hedged"] = arm("hedged", {
+        "MINIPS_SERVE": serve, "MINIPS_HEDGE": "delay_ms=15"})
+    with tempfile.TemporaryDirectory() as fdir:
+        grid["demote"] = arm("demote", {
+            "MINIPS_SERVE": serve, "MINIPS_HEDGE": "delay_ms=15",
+            "MINIPS_ELASTIC": "1",
+            "MINIPS_SLOW": ("factor=3,windows=2,window=5,"
+                            "min_ms=15,min_samples=2,demote=4"),
+            "MINIPS_REBALANCE": ("block=2048,threshold=3,"
+                                 "interval=0.3,min_heat=1"),
+            "MINIPS_HEARTBEAT": "interval=0.1,timeout=2.0",
+            "MINIPS_FLIGHT": fdir}, flight=fdir)
+    # SLOW-IDLE: hedge-armed vs off on a clean wire, bitwise
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "minips_tpu.apps.sharded_ps_bench",
+             "--fail-slow-idle-drill"],
+            capture_output=True, text=True, timeout=300.0,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env={**os.environ, "MINIPS_FORCE_CPU": "1",
+                 "JAX_PLATFORMS": "cpu", "MINIPS_MESH": "",
+                 "MINIPS_CHAOS": "", "MINIPS_HEDGE": "",
+                 "MINIPS_SLOW": ""})
+        res = json.loads([ln for ln in proc.stdout.splitlines()
+                          if ln.startswith("{")][-1])
+        grid["idle"] = {"equal": bool(res.get("bitwise_equal")),
+                        "rows_checked":
+                            int(res.get("rows_checked", 0))}
+        if res.get("error"):
+            grid["idle"]["error"] = res["error"]
+    except Exception as e:  # noqa: BLE001 - the gate reads this
+        grid["idle"] = {"equal": False, "rows_checked": 0,
+                        "error": str(e)[:300]}
+    return grid
+
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=60)
@@ -1401,6 +1536,23 @@ def main() -> int:
 
     mesh_grid = _mesh_arms(o_reps)
 
+    # THE FAIL-SLOW SWEEP (this PR): a seeded slow# link tax makes
+    # rank 1 (the storm range's owner) slow-but-alive — its beats
+    # land, nothing dies, every read to it rides the tax. Three arms +
+    # the armed-idle bitwise stamp: (1) unmitigated — the gray failure
+    # as the pre-this-PR fleet lives it (reads pay the tail, steps
+    # complete); (2) hedged — serve-plane replicas + MINIPS_HEDGE:
+    # rank 0 (the designated reader: NOT a holder — rank 2 holds the
+    # sick rank's replicas and serves itself locally) must land its
+    # warmed windowed read p99 STRICTLY below the unmitigated arm's
+    # (SLOW-HEDGE); (3) demote — + MINIPS_SLOW detection, quorum slow
+    # verdict over heartbeat ballots, and the rebalancer's demote pass
+    # migrating the sick rank's hot blocks off it (SLOW-DRAIN: >= 1
+    # block out of rank 1, zero lost steps, bitwise survivors, the
+    # four flight events in the post-mortem boxes). SLOW-IDLE rides
+    # the --fail-slow-idle-drill lockstep stamp.
+    fail_slow_grid = fail_slow_arms(quick=args.quick)
+
     # resolved JAX backend stamp (satellite): probed in a SUBPROCESS so
     # the driver never grabs the TPU out from under a worker (libtpu is
     # exclusive per process) — ci/bench_regression.py refuses to
@@ -1465,6 +1617,7 @@ def main() -> int:
         "elastic_membership_3proc": elastic_grid,
         "control_plane_3proc": control_grid,
         "partition_3proc": partition_grid,
+        "fail_slow_3proc": fail_slow_grid,
         "mesh_plane_fused": mesh_grid,
     }))
     return 0

@@ -79,6 +79,17 @@ and the injector provably engaged (part_dropped > 0); the
 holder-self-drain arm must complete with the term advanced exactly
 once, zero deaths, the leaver exiting rc 0 via the drain path, and
 bitwise agreement.
+``fail_slow_tripwires`` (SLOW-HEDGE/SLOW-DRAIN/SLOW-IDLE) guards the
+``fail_slow_3proc`` sweep: under a seeded ``slow#`` link tax on one
+rank, the hedged arm's designated reader must land its warmed windowed
+read p99 STRICTLY below the unmitigated arm's with >= 1 hedge actually
+fired and the injector provably engaged; the demote arm must complete
+every step with >= 1 quorum slow verdict, >= 1 hot block migrated off
+the sick rank, zero unrecovered frames, bitwise survivors, and the
+four fail-slow flight events (slow_suspect/slow_verdict/hedge_fired/
+demote) present in the post-mortem boxes; the armed-idle lockstep
+drill must report bitwise-equal finals. Rates ride gate-invisible
+keys (``steps_per_sec_slow``).
 ``mesh_tripwires`` (MESH-WIN/MESH-BITWISE) guards the
 ``mesh_plane_fused`` sweep: the in-mesh collective plane's arm must
 beat the host-wire arm on rows/sec strictly (the data plane exists to
@@ -933,6 +944,124 @@ def partition_tripwires(new: dict) -> list[str]:
     return problems
 
 
+def fail_slow_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``fail_slow_3proc`` sweep
+    (fail-slow detection + hedged reads + quorum-fenced demotion —
+    obs/slowness.py, serve/hedge.py, the rebalancer's demote pass);
+    vacuous when the sweep is absent. Every arm is a COMPLETION gate
+    (rates under the gate-invisible ``steps_per_sec_slow``).
+
+    - SLOW-HEDGE: both the unmitigated and hedged arms must complete
+      under the injection (a slow-but-alive rank poisons NOTHING —
+      that is the pre-mitigation baseline this repo already held);
+      the injector must have provably engaged on both
+      (``slowed`` > 0); the hedged arm must have actually hedged
+      (``hedges_fired`` > 0 — a zero here means the plane silently
+      disarmed and any p99 win is a fluke) and its designated
+      reader's warmed windowed read p99 must sit STRICTLY below the
+      unmitigated arm's.
+    - SLOW-DRAIN: the demote arm must complete every step
+      (clock_min == iters: demotion loses zero steps) with >= 1
+      quorum slow verdict reached, >= 1 hot block migrated OFF the
+      sick rank, zero unrecovered frames, bitwise-agreeing finals,
+      and the four fail-slow flight events present in the merged
+      post-mortem boxes (slow_suspect → slow_verdict → hedge_fired →
+      demote — the black box must tell the story with zero
+      pre-arming).
+    - SLOW-IDLE: the armed-idle lockstep drill (hedge plane on, no
+      slow link) must report bitwise-equal finals over > 0 rows —
+      arming the mitigation may not perturb one bit of a healthy
+      run."""
+    grid = new.get("fail_slow_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    unm = grid.get("unmitigated") or {}
+    hed = grid.get("hedged") or {}
+    if not unm.get("completed"):
+        problems.append(
+            f"SLOW-HEDGE fail_slow_3proc/unmitigated: completed="
+            f"{unm.get('completed')!r} — a slow-but-alive rank must "
+            "degrade reads, never poison the run")
+    if not hed.get("completed"):
+        problems.append(
+            f"SLOW-HEDGE fail_slow_3proc/hedged: completed="
+            f"{hed.get('completed')!r} — the hedged arm must finish")
+    if unm.get("completed") and hed.get("completed"):
+        if not unm.get("slowed") or not hed.get("slowed"):
+            problems.append(
+                f"SLOW-HEDGE fail_slow_3proc: slowed="
+                f"{unm.get('slowed')!r}/{hed.get('slowed')!r} — the "
+                "slow# injector never engaged, the arms prove nothing")
+        if not hed.get("hedges_fired"):
+            problems.append(
+                "SLOW-HEDGE fail_slow_3proc/hedged: 0 hedges fired — "
+                "the hedge plane silently disarmed (any p99 win would "
+                "be replicas alone)")
+        up99, hp99 = unm.get("reader_p99_ms"), hed.get("reader_p99_ms")
+        if not (isinstance(up99, (int, float))
+                and isinstance(hp99, (int, float)) and hp99 < up99):
+            problems.append(
+                f"SLOW-HEDGE fail_slow_3proc: hedged reader p99 "
+                f"{hp99!r} ms not strictly below unmitigated "
+                f"{up99!r} ms — the read mitigation bought nothing")
+    dem = grid.get("demote") or {}
+    if not dem.get("completed"):
+        problems.append(
+            f"SLOW-DRAIN fail_slow_3proc/demote: completed="
+            f"{dem.get('completed')!r} — the demote arm must finish "
+            "(demotion is a migration, not a failure)")
+    else:
+        if dem.get("clock_min") != grid.get("iters"):
+            problems.append(
+                f"SLOW-DRAIN fail_slow_3proc/demote: clock_min="
+                f"{dem.get('clock_min')!r} of iters="
+                f"{grid.get('iters')!r} — demotion lost steps")
+        if not dem.get("slow_verdicts"):
+            problems.append(
+                "SLOW-DRAIN fail_slow_3proc/demote: 0 quorum slow "
+                "verdicts — detection never convicted the seeded sick "
+                "rank")
+        if not dem.get("sick_blocks_out"):
+            problems.append(
+                "SLOW-DRAIN fail_slow_3proc/demote: 0 blocks migrated "
+                "off the sick rank — the demote pass never moved its "
+                "hot blocks")
+        if dem.get("wire_frames_lost", 0):
+            problems.append(
+                f"SLOW-DRAIN fail_slow_3proc/demote: "
+                f"{dem['wire_frames_lost']} unrecovered frames")
+        if not dem.get("finals_agree"):
+            problems.append(
+                "SLOW-DRAIN fail_slow_3proc/demote: survivors' final "
+                "tables disagree after demotion")
+        if not dem.get("flight_events_ok"):
+            problems.append(
+                f"SLOW-DRAIN fail_slow_3proc/demote: flight boxes "
+                f"missing fail-slow events (got "
+                f"{dem.get('flight_events')!r}; need slow_suspect, "
+                "slow_verdict, hedge_fired, demote) — the post-mortem "
+                "cannot tell the story")
+    idle = grid.get("idle") or {}
+    if not idle.get("equal") or not idle.get("rows_checked"):
+        problems.append(
+            f"SLOW-IDLE fail_slow_3proc/idle: equal="
+            f"{idle.get('equal')!r} rows_checked="
+            f"{idle.get('rows_checked')!r}"
+            + (f" error={idle.get('error')!r}" if idle.get("error")
+               else "")
+            + " — armed-idle hedging must be bitwise-equal to off")
+    elif idle.get("hedges_fired", 0):
+        # bitwise-equal AND hedges fired would mean loopback replicas
+        # happened to serve identical rows — equal by luck, not by
+        # the min_ms floor keeping the plane idle
+        problems.append(
+            f"SLOW-IDLE fail_slow_3proc/idle: {idle['hedges_fired']} "
+            "hedges fired on a clean wire — armed-IDLE means the "
+            "min_ms floor keeps every leg unhedged")
+    return problems
+
+
 def mesh_tripwires(new: dict) -> list[str]:
     """Absolute (prior-free) gates on the ``mesh_plane_fused`` sweep
     (the in-mesh collective data plane, train/mesh_plane.py); vacuous
@@ -1113,7 +1242,8 @@ def main(argv: list[str] | None = None) -> int:
                 + obs_tripwires(new)
                 + serve_tripwires(new) + elastic_tripwires(new)
                 + control_plane_tripwires(new)
-                + partition_tripwires(new) + mesh_tripwires(new))
+                + partition_tripwires(new) + fail_slow_tripwires(new)
+                + mesh_tripwires(new))
     pts = throughput_points(new)
     print(f"bench-regression: {len(pts)} throughput points checked "
           f"against {len(throughput_points(prior))} prior")

@@ -85,6 +85,42 @@ def _run_mesh_drill() -> int:
     return 0 if out["bitwise_equal"] else 1
 
 
+def _run_fail_slow_idle_drill() -> int:
+    """SLOW-IDLE: the BSP lockstep drill with the fail-slow hedge
+    plane ARMED on a clean wire vs off — armed-but-idle must be
+    BITWISE equal (no slow link → the min_ms floor keeps every leg
+    unhedged → the armed bookkeeping perturbs nothing). Emits one JSON
+    line; failures report ``bitwise_equal: false`` so the CI gate
+    fails loudly instead of silently skipping."""
+    out = {"event": "drill", "bitwise_equal": False, "rows_checked": 0,
+           "hedges_fired": None}
+    try:
+        import minips_tpu
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(minips_tpu.__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from tests.test_chaos_reliable import run_bsp_lockstep
+
+        w_off, lost_off = run_bsp_lockstep(backend="zmq")
+        st: dict = {}
+        w_on, lost_on = run_bsp_lockstep(backend="zmq", hedge="1",
+                                         stats=st)
+        eq = all(np.array_equal(a, b) for a, b in zip(w_off, w_on))
+        out.update({
+            "bitwise_equal": bool(eq) and lost_off == lost_on == [0, 0],
+            "rows_checked": int(sum(a.shape[0] for a in w_off)),
+            # armed-IDLE means zero hedges actually fired — stamp the
+            # evidence, not just the bitwise verdict
+            "hedges_fired": st.get("hedges_fired"),
+        })
+    except Exception as e:  # noqa: BLE001 - the gate reads the stamp
+        out["error"] = repr(e)[:300]
+    print(json.dumps(out), flush=True)
+    return 0 if out["bitwise_equal"] else 1
+
+
 def _run_mesh(args) -> int:
     """The in-mesh collective data plane bench: one process, ``--mesh-
     ranks`` logical ranks as threads over as many devices, pushes/pulls
@@ -292,6 +328,11 @@ def main(argv=None) -> int:
                     help="run the BSP zmq-vs-mesh bitwise lockstep "
                          "drill and emit its stamp instead of a bench "
                          "(the artifact's MESH-BITWISE input)")
+    ap.add_argument("--fail-slow-idle-drill", action="store_true",
+                    help="run the BSP lockstep drill hedge-armed vs "
+                         "off on a clean wire and emit its bitwise "
+                         "stamp (the artifact's SLOW-IDLE input: "
+                         "armed-but-idle must equal off bit-for-bit)")
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="write this rank's wire trace (Chrome-trace "
                          "JSON, obs/tracer.py) into DIR — the flag "
@@ -306,6 +347,8 @@ def main(argv=None) -> int:
     if args.mesh_bitwise_drill:
         _arm_mesh_devices(max(args.mesh_ranks, 2))
         return _run_mesh_drill()
+    if args.fail_slow_idle_drill:
+        return _run_fail_slow_idle_drill()
     if plane_kind == "mesh":
         if args.storm or args.overlap or args.cache_bytes \
                 or args.serve or args.compute != "none":

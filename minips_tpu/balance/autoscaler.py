@@ -186,6 +186,24 @@ class Autoscaler:
         ratio = (max(totals) / mean) if mean > 0 else 0.0
         return shed_d, (max(p99s) if p99s else None), ratio
 
+    def _slow_pressure(self) -> float:
+        """Fail-slow coupling: a quorum-corroborated SLOW VERDICT is
+        shed pressure by definition — the fleet's effective capacity
+        shrank by the sick rank even though no bucket refused yet.
+        One arming quantum per verdicted rank per tick, folded into
+        the HOT decision ONLY (never into ``sheds_seen`` or the
+        streak-rate evidence stats, which are documented to count
+        real refusals); the pressure disappears with the verdict."""
+        view = getattr(self.mb, "slow_view", None)
+        if view is None:
+            return 0.0
+        nslow = len(view())
+        if nslow:
+            with self._lock:
+                self.counters["slow_pressure_ticks"] = \
+                    self.counters.get("slow_pressure_ticks", 0) + 1
+        return nslow * self.cfg.up_shed
+
     # --------------------------------------------------------------- tick
     def on_tick(self) -> None:
         """Called from ``ShardedPSTrainer.tick`` just before the
@@ -203,7 +221,7 @@ class Autoscaler:
             self.counters["sheds_seen"] += int(shed_d)
         self.p99_last_ms = p99
         cfg = self.cfg
-        hot = (shed_d >= cfg.up_shed
+        hot = (shed_d + self._slow_pressure() >= cfg.up_shed
                or (cfg.up_p99_ms > 0 and p99 is not None
                    and p99 >= cfg.up_p99_ms)
                or (cfg.imb > 0 and ratio >= cfg.imb))

@@ -318,6 +318,22 @@ class Membership:
         #                                         recorded (dedup across
         #                                         sweep + beat threads)
         self._convicted_term: Optional[int] = None  # fleet declared ME dead
+        # fail-slow quorum (obs/slowness.py, bound via bind_slowness):
+        # a SECOND SuspicionQuorum over the same heartbeat gossip wire
+        # — slow ballots ride as ``slw`` next to the death ballot's
+        # ``sus``, and a SLOW VERDICT needs the same strict majority.
+        # Unlike death, a slow verdict is NOT sticky: it stands only
+        # while the quorum stands (slow_view recomputes), so a
+        # recovered rank's demotion bias lifts by itself.
+        self.slow_quorum = SuspicionQuorum(self.rank)
+        self._slow_lock = threading.Lock()
+        self._slow_verdicts: set[int] = set()
+        self._slow_since: dict[int, int] = {}  # rank -> holder ticks
+        self._slow_drained: set[int] = set()   # escalations issued
+        self._slowness = None                  # obs.slowness monitor
+        self._slow_cfg = None                  # its SlownessConfig
+        self.counters["slow_verdicts"] = 0
+        self.counters["slow_drains"] = 0
         if trainer.monitor is not None:
             trainer.monitor.on_failure = self._on_peer_dead
             trainer.monitor.on_suspect = self._on_suspect
@@ -388,8 +404,14 @@ class Membership:
     def _beat_payload(self) -> dict:
         """Every outgoing heartbeat: lease stamp + my suspicion ballot
         (empty list = explicit retraction — a voter that calmed down
-        must clear its stale ballot at every receiver)."""
-        return {**self.lease.stamp(), "sus": self.quorum.my_suspects()}
+        must clear its stale ballot at every receiver). With the
+        fail-slow plane bound, my SLOW ballot rides next to it as
+        ``slw`` — same channel, same retraction semantics; unbound
+        fleets ship byte-identical beats to pre-slow ones."""
+        out = {**self.lease.stamp(), "sus": self.quorum.my_suspects()}
+        if self._slowness is not None:
+            out["slw"] = self.slow_quorum.my_suspects()
+        return out
 
     def _on_lease_beat(self, sender: int, payload: dict) -> None:
         """Heartbeat receive hook (monitor thread): max-merge the lease
@@ -406,6 +428,10 @@ class Membership:
         if sus is not None:
             self.quorum.vote(sender, sus)
             self._check_quorum()
+        slw = payload.get("slw")
+        if slw is not None:
+            self.slow_quorum.vote(sender, slw)
+            self._update_slow_verdicts()
 
     def _on_suspect(self, r: int, suspected: bool) -> None:
         """Monitor sweep hook: MY suspicion of ``r`` began/retracted.
@@ -462,6 +488,130 @@ class Membership:
             self.quorum.verdicts += 1
             self.quorum.drop_voter(r)
             mon.convict(r)
+
+    # ---------------------------------------------------- fail-slow quorum
+    def bind_slowness(self, sm, cfg) -> None:
+        """Wire the fail-slow detector (obs/slowness.py) into the
+        gossip/quorum plane: local suspicion transitions update my
+        ``slw`` ballot (next beat carries it), and heartbeat STALL
+        forgiveness retracts slow ballots exactly like death ballots —
+        a coma observer's latency samples are as undateable as its
+        timeout verdicts (the false-positive drill pins both)."""
+        self._slowness = sm
+        self._slow_cfg = cfg
+        sm.on_slow = self._on_slow_suspect
+        mon = self.trainer.monitor
+        if mon is not None and hasattr(mon, "on_stall_forgiven"):
+            mon.on_stall_forgiven = sm.retract_all
+
+    def _on_slow_suspect(self, r: int, suspected: bool) -> None:
+        """SlownessMonitor transition (push-driving thread, its roll):
+        MY slow ballot changed — gossip rides the next beat; the
+        quorum re-checks immediately (a peer's corroborating vote may
+        already be banked)."""
+        mine = self.slow_quorum.mark_local(r, suspected)
+        _fl.record("slow_suspect" if suspected else "slow_unsuspect",
+                   {"rank": int(r), "ballot": mine})
+        self._update_slow_verdicts()
+
+    def _update_slow_verdicts(self) -> None:
+        """Recompute the quorum's CURRENT slow-verdict set — strict
+        majority of the live view, exactly :func:`quorum_needed` (a
+        single complainer never convicts; a minority island cannot
+        demote the majority). Not sticky: a verdict whose
+        corroboration fell away CLEARS, and the demotion bias lifts
+        with it. Runs on the monitor/beat threads and the roll thread;
+        the transition record is deduped under ``_slow_lock``."""
+        with self._lock:
+            live = set(self.live)
+            gone = self.dead | self.left
+        cur = {r for r in self.slow_quorum.convictable(live)
+               if r not in gone}
+        with self._slow_lock:
+            new = cur - self._slow_verdicts
+            cleared = self._slow_verdicts - cur
+            self._slow_verdicts = cur
+            for r in new:
+                self.counters["slow_verdicts"] += 1
+                self._slow_since.setdefault(r, 0)
+            for r in cleared:
+                self._slow_since.pop(r, None)
+        for r in new:
+            _fl.record("slow_verdict",
+                       {"rank": int(r),
+                        "voters": self.slow_quorum.voters_for(r, live),
+                        "live": sorted(live)})
+        for r in cleared:
+            _fl.record("slow_cleared", {"rank": int(r)})
+
+    def slow_view(self) -> set[int]:
+        """The current quorum-corroborated slow set — read by the
+        hedge plane (immediate hedging), the rebalancer's planner
+        (demotion bias), and the autoscaler (shed pressure)."""
+        with self._slow_lock:
+            return set(self._slow_verdicts)
+
+    def slow_demote_bias(self) -> float:
+        """The planner's load multiplier for a slow-verdict rank
+        (``MINIPS_SLOW demote=``; 0/1 = no bias)."""
+        cfg = self._slow_cfg
+        return float(cfg.demote) if cfg is not None else 0.0
+
+    def _slow_escalate(self) -> None:
+        """The second threshold — drain-not-convict: on the LEASE
+        HOLDER, a rank whose slow verdict has stood ``drain_after``
+        consecutive boundaries is drained through the PR 8 leave path
+        (graceful: blocks ship to survivors under the fence, rc 0 —
+        and if the sick rank IS the holder, ``leave()`` hands the
+        lease over first). Never shrinks the fleet below 2: with one
+        rank left there is nobody to absorb the blocks — the verdict
+        then stays a demotion bias only."""
+        cfg = self._slow_cfg
+        if cfg is None or cfg.drain_after <= 0:
+            return
+        with self._slow_lock:
+            standing = sorted(self._slow_verdicts)
+            due = []
+            for r in standing:
+                if r in self._slow_drained:
+                    continue
+                self._slow_since[r] = self._slow_since.get(r, 0) + 1
+                if self._slow_since[r] >= cfg.drain_after:
+                    due.append(r)
+        if not due:
+            return
+        with self._lock:
+            live = set(self.live)
+        for r in due:
+            if r not in live or len(live) < 3:
+                # len < 3: draining from a 2-fleet leaves a 1-fleet —
+                # and a 2-fleet slow verdict cannot exist anyway (one
+                # complainer, quorum 2); belt and braces
+                continue
+            with self._slow_lock:
+                if r in self._slow_drained:
+                    continue
+                self._slow_drained.add(r)
+            self.counters["slow_drains"] += 1
+            _fl.checkpoint("slow_drain",
+                           {"rank": int(r),
+                            "since_ticks": self._slow_since.get(r),
+                            "holder": self.rank})
+            if r == self.rank:
+                # the sick rank is the holder itself: leave() hands the
+                # lease (mbH) before draining — lease-handover-aware by
+                # construction
+                self.begin_drain()
+            else:
+                self.bus.send(r, self.DRAIN_KIND,
+                              {**self.lease.stamp()})
+
+    def slow_stats(self) -> dict:
+        with self._slow_lock:
+            return {"slow_verdict_ranks": sorted(self._slow_verdicts),
+                    "slow_drained": sorted(self._slow_drained),
+                    "slow_ballots": self.slow_quorum.stats()["ballots"],
+                    "demote_bias": self.slow_demote_bias() or None}
 
     def fence_frame(self, payload: dict) -> bool:
         """THE receive fence, in one place for every coordinator-
@@ -591,6 +741,12 @@ class Membership:
         # convicted-dead rank's lingering ballot entry is the settled
         # evidence, not noise.
         self.quorum.drop_voter(r)
+        # the corpse's SLOW ballot is void outright (both directions:
+        # its votes and any verdict against it — death outranks slow)
+        self.slow_quorum.drop_voter(r)
+        if self._slowness is not None:
+            self._slowness.exclude(r)
+        self._update_slow_verdicts()
         if succeeded is not None:
             term, holder = self.lease.current()
             tr = _trc.TRACER
@@ -851,6 +1007,10 @@ class Membership:
         # belt-and-braces half (finalize/pull_all live sets, fence acks)
         self.trainer.gossip.exclude(r)
         self.quorum.drop_voter(r)  # a left rank's ballot is void too
+        self.slow_quorum.drop_voter(r)
+        if self._slowness is not None:
+            self._slowness.exclude(r)
+        self._update_slow_verdicts()
         tr = _trc.TRACER
         if tr is not None:
             tr.instant("membership", "mb_gone", {"rank": int(r)})
@@ -1051,6 +1211,9 @@ class Membership:
                 raise PeerFailureError(set(self._unrecoverable))
         if self.rank != self.coord:
             return
+        # fail-slow escalation before the transition queues: a drain
+        # issued here rides the same boundary's queue machinery
+        self._slow_escalate()
         self._coord_step()
 
     def poll(self) -> None:

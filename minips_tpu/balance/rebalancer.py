@@ -36,6 +36,7 @@ from typing import Optional
 
 import numpy as np
 
+from minips_tpu.obs import flight as _fl
 from minips_tpu.obs import tracer as _trc
 
 __all__ = ["RebalanceConfig", "Rebalancer", "plan_assignment"]
@@ -436,12 +437,52 @@ class Rebalancer:
                 candidates[int(b)] = (i, float(h))
         if loads.sum() < self.cfg.min_heat:
             return
-        moves = [(b, live_sorted[s], live_sorted[d])
-                 for b, s, d in plan_assignment(
-                     loads, candidates, self.cfg.threshold,
-                     self.cfg.max_blocks)]
+        # fail-slow DEMOTION (obs/slowness.py, the write/placement
+        # mitigation): while a quorum-corroborated slow verdict stands
+        # the planner runs a DEMOTE pass instead of the heat pass —
+        # the sick rank's load is multiplied by the demote bias (its
+        # effective capacity shrank by that factor), candidates narrow
+        # to blocks the sick rank owns, and the arming ratio drops to
+        # 1.0: a verdict IS the arming — demotion must move hot blocks
+        # off the sick rank even when raw heat looks balanced (a
+        # ratio threshold can provably never clear cfg.threshold >= 3
+        # in a small fleet: one biased rank tops out at 3b/(2+b) < 3).
+        # plan_assignment's strictly-inside-the-gap rule still bounds
+        # every move, so demotion cannot overshoot into a new hotspot;
+        # the bias lifts by itself when the verdict clears (slow_view
+        # recomputes), so a recovered rank's blocks stay put.
+        slow: set[int] = set()
+        bias = 0.0
+        if mb is not None:
+            view = getattr(mb, "slow_view", None)
+            if view is not None:
+                slow = view()
+                bias = mb.slow_demote_bias()
+        sick_idx = {i for i, r in enumerate(live_sorted) if r in slow}
+        if sick_idx and bias > 1.0:
+            for i in sick_idx:
+                loads[i] *= bias
+            sick_cands = {b: ih for b, ih in candidates.items()
+                          if ih[0] in sick_idx}
+            moves = [(b, live_sorted[s], live_sorted[d])
+                     for b, s, d in plan_assignment(
+                         loads, sick_cands, 1.0, self.cfg.max_blocks)]
+        else:
+            moves = [(b, live_sorted[s], live_sorted[d])
+                     for b, s, d in plan_assignment(
+                         loads, candidates, self.cfg.threshold,
+                         self.cfg.max_blocks)]
         if not moves:
             return
+        demoted = sorted({s for _b, s, _d in moves if s in slow})
+        if demoted:
+            # the DEMOTE decision into the black box: which sick
+            # rank(s) lost how many blocks, under which verdict view
+            _fl.record("demote",
+                       {"table": name, "ranks": demoted,
+                        "blocks": sum(1 for _b, s, _d in moves
+                                      if s in slow),
+                        "bias": bias, "ep": ep + 1})
         new_ov = dict(ov)
         for b, _src, dst in moves:
             if dst == t.router.home_of(b):

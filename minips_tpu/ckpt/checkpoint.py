@@ -116,24 +116,87 @@ class Checkpointer:
                 out.append(int(m.group(1)))
         return sorted(out)
 
-    def restore(self, step: Optional[int] = None) -> int:
-        """Load the given (or newest) step into the live tables/controllers.
-        Returns the restored step number."""
-        steps = self.list_steps()
-        if not steps:
-            raise FileNotFoundError(f"no checkpoints under {self.dir}")
-        step = steps[-1] if step is None else step
+    def _validate_step(self, step: int) -> dict:
+        """Read a step's manifest and force-read EVERY table's npz —
+        ONE TABLE AT A TIME, discarding each after the read — applying
+        nothing. Validation before mutation: a torn checkpoint
+        (truncated npz, corrupt manifest, missing table file) must
+        fail HERE, while the live tables are still untouched, so the
+        caller can walk back to an older step instead of relaunching
+        half-loaded. Reading per-table keeps the validation pass at
+        the OLD peak memory (largest single table, not the whole
+        checkpoint next to the live tables)."""
         d = self._step_dir(step)
         with open(os.path.join(d, "manifest.json")) as f:
             manifest = json.load(f)
-        for name, t in self.tables.items():
+        if not isinstance(manifest, dict) or "step" not in manifest:
+            raise ValueError(f"manifest.json in {d} lacks 'step'")
+        for name in self.tables:
             path = os.path.join(d, f"{name}.npz")
             with np.load(path) as z:
-                t.load_state_dict(_unflatten(dict(z.items())))
-        for name, c in self.controllers.items():
-            if name in manifest.get("clocks", {}):
-                c.load_state_dict(manifest["clocks"][name])
-        return manifest["step"]
+                # dict(z.items()) forces every array to decompress NOW
+                # — a truncated/corrupt member raises inside this read,
+                # not later during load_state_dict — and the dict dies
+                # at the end of this iteration
+                _unflatten(dict(z.items()))
+        return manifest
+
+    def restore(self, step: Optional[int] = None) -> int:
+        """Load the given (or newest restorable) step into the live
+        tables/controllers; returns the restored step number.
+
+        With ``step=None`` (the relaunch path) a TORN checkpoint —
+        unreadable npz, corrupt manifest, a table file missing — is
+        skipped with a loud stderr warning (+ flight-recorder event)
+        and the walk continues to the next-newest step: a crash that
+        tore the latest checkpoint must cost one checkpoint interval
+        of progress, not the relaunch. An EXPLICIT ``step`` keeps the
+        strict semantics (the caller asked for that step; silently
+        substituting another would be worse than failing). All state
+        for a step is read and validated BEFORE any of it is applied,
+        so a failed candidate leaves the live tables untouched."""
+        steps = self.list_steps()
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {self.dir}")
+        explicit = step is not None
+        cands = [step] if explicit else list(reversed(steps))
+        skipped: list[str] = []
+        for s in cands:
+            try:
+                manifest = self._validate_step(s)
+            except Exception as e:  # noqa: BLE001 - torn-ckpt walkback
+                if explicit:
+                    raise
+                import sys
+
+                note = f"step_{s}: {type(e).__name__}: {e}"
+                print(f"[ckpt] WARNING: skipping torn checkpoint "
+                      f"{note} — walking back to the previous step",
+                      file=sys.stderr, flush=True)
+                try:
+                    from minips_tpu.obs import flight as _fl
+
+                    _fl.record("ckpt_skip_torn",
+                               {"dir": self.dir, "step": int(s),
+                                "err": str(e)[:200]})
+                except Exception:  # noqa: BLE001 - obs must not block
+                    pass
+                skipped.append(note)
+                continue
+            # apply pass: re-read one table at a time (old peak
+            # memory — double I/O only on the restore path, where the
+            # validation read is usually still in the page cache)
+            d = self._step_dir(s)
+            for name, t in self.tables.items():
+                with np.load(os.path.join(d, f"{name}.npz")) as z:
+                    t.load_state_dict(_unflatten(dict(z.items())))
+            for name, c in self.controllers.items():
+                if name in manifest.get("clocks", {}):
+                    c.load_state_dict(manifest["clocks"][name])
+            return manifest["step"]
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {self.dir}: every "
+            f"candidate was torn ({'; '.join(skipped)})")
 
 
 # --------------------------------------------------------------------- utils

@@ -67,6 +67,16 @@ PLUS the link tax; a frame that draws ``reorder`` rides the reorder
 park untaxed (the park IS its delay — stacking the tax on top would
 double-charge the swap window).
 
+An optional JITTER term ``slow#<a>-<b>=<ms>~<jitter_ms>`` draws each
+frame's tax seeded-uniform from ``[ms - jitter, ms + jitter]``
+(clamped at 0; the draw is ``H(frame identity, "slowj")``, so the
+same spec reproduces the same per-frame taxes) — the variance a real
+sick NIC shows, which a fail-slow DETECTOR must not be fooled by.
+Trade the drill author accepts: with jitter, two frames' taxes can
+differ enough for the later one to overtake — jittered slow links may
+REORDER, unlike the plain fixed tax (arm MINIPS_RELIABLE when the
+workload needs per-link order back).
+
 Determinism: each frame's fate is ``H(seed, my_id, sender, stream, seq,
 op) / 2^64`` (blake2b) — a pure function of the frame's identity, not of
 arrival order or RNG consumption, so two runs with the same spec and the
@@ -204,8 +214,10 @@ class ChaosSpec:
         self.delay_ms = float(delay_ms)
         self.reorder_ms = float(reorder_ms)
         self.partitions: list[PartitionEntry] = partitions or []
-        # slow: [(a, b, bidir, ms)] — sustained per-link delay
-        self.slow: list[tuple[int, int, bool, float]] = slow or []
+        # slow: [(a, b, bidir, ms, jitter_ms)] — sustained per-link
+        # delay; legacy 4-tuples (pre-jitter callers) normalize to 0
+        self.slow = [(t + (0.0,) if len(t) == 4 else t)
+                     for t in (slow or [])]
 
     @classmethod
     def parse(cls, spec: str) -> "ChaosSpec":
@@ -281,15 +293,21 @@ class ChaosSpec:
             if knob.startswith("slow#"):
                 a, b, bidir = _parse_link(knob[len("slow#"):],
                                           "chaos slow")
+                ms_s, tilde, jit_s = val.partition("~")
                 try:
-                    ms = float(val)
+                    ms = float(ms_s)
+                    jit = float(jit_s) if tilde else 0.0
                 except ValueError:
                     raise ValueError(
-                        f"chaos {entry!r}: slow needs a float ms value")
+                        f"chaos {entry!r}: slow needs "
+                        "<ms>[~<jitter_ms>] float values")
                 if ms <= 0:
                     raise ValueError(
                         f"chaos {entry!r}: slow ms must be > 0")
-                slow.append((a, b, bidir, ms))
+                if jit < 0:
+                    raise ValueError(
+                        f"chaos {entry!r}: slow jitter must be >= 0")
+                slow.append((a, b, bidir, ms, jit))
                 continue
             sender: Optional[int] = None
             if "#" in knob:
@@ -366,16 +384,23 @@ class ChaosBus:
         # resolve every entry's window once (pure function of seeds)
         self._parts = [(p, p.resolve(spec.seed))
                        for p in spec.partitions]
-        # sustained slow links: my inbound tax per sender, precomputed —
-        # the per-frame cost of an armed-but-elsewhere slow spec is one
-        # dict lookup that misses
-        self._slow_in: dict[int, float] = {}
+        # sustained slow links: my inbound (tax, jitter) per sender,
+        # precomputed — the per-frame cost of an armed-but-elsewhere
+        # slow spec is one dict lookup that misses. Ties break by the
+        # LARGER base tax (the worse link wins, like per-link drops).
+        self._slow_in: dict[int, tuple[float, float]] = {}
+
+        def _merge_slow(snd: int, ms: float, jit: float) -> None:
+            cur = self._slow_in.get(snd)
+            if cur is None or ms > cur[0]:
+                self._slow_in[snd] = (ms, jit)
+
         me = int(getattr(bus, "my_id", -1))
-        for a, b, bidir, ms in spec.slow:
+        for a, b, bidir, ms, jit in spec.slow:
             if b == me:
-                self._slow_in[a] = max(self._slow_in.get(a, 0.0), ms)
+                _merge_slow(a, ms, jit)
             if bidir and a == me:
-                self._slow_in[b] = max(self._slow_in.get(b, 0.0), ms)
+                _merge_slow(b, ms, jit)
         self._lock = threading.Lock()
         self._uctr: dict[tuple, int] = {}   # (sender, kind) -> arrivals
         self._held: dict[tuple, tuple] = {}  # link -> (due, msg, blob)
@@ -511,6 +536,20 @@ class ChaosBus:
             note("drop")
             self._release_held((sender, stream))  # a drop still advances
             return
+        def slow_tax() -> float:
+            # the sustained link tax for this frame, in ms: the fixed
+            # base, plus the seeded per-frame jitter when configured —
+            # uniform in [ms - j, ms + j] clamped at 0, a pure function
+            # of the frame identity like every other fate here
+            ent = self._slow_in.get(sender)
+            if ent is None:
+                return 0.0
+            base, jit = ent
+            if jit <= 0.0:
+                return base
+            u = self._u("slowj", sender, stream, seq)
+            return max(base + (2.0 * u - 1.0) * jit, 0.0)
+
         dup_copy = None
         if hit("dup"):
             # copy BEFORE the first dispatch: handlers receive the payload
@@ -523,7 +562,7 @@ class ChaosBus:
             with self._lock:
                 self.stats["duplicated"] += 1
             note("dup")
-        slow_ms = self._slow_in.get(sender, 0.0)
+        slow_ms = slow_tax()
         if hit("delay"):
             # hold for ~delay_ms (deterministically jittered ±50%): later
             # frames on every link overtake it — delay IS reordering on
@@ -550,10 +589,12 @@ class ChaosBus:
             if parked is not None:  # two in a row: the first-held goes now
                 self._forward(parked[1], parked[2])
         elif slow_ms > 0.0:
-            # sustained link degradation: a FIXED delay per frame — the
-            # constant offset preserves per-link arrival order (every
-            # frame on the link pays the same tax), so a slowed link is
-            # latency the stack must absorb, never reordering
+            # sustained link degradation: a fixed tax preserves
+            # per-link arrival order (every frame pays the same); a
+            # JITTERED tax (slow#..=ms~jit) can differ per frame by up
+            # to 2*jit, so the later frame may overtake — the reorder
+            # trade the module docstring documents (arm MINIPS_RELIABLE
+            # when the workload needs per-link order back)
             with self._lock:
                 self.stats["slowed"] += 1
             self._release_held((sender, stream))

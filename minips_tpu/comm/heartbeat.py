@@ -107,6 +107,11 @@ class HeartbeatMonitor:
         # unset (standalone monitors, pre-quorum fleets) the timeout
         # convicts solo, exactly the old semantics.
         self.on_suspect: Optional[Callable[[int, bool], None]] = None
+        # fail-slow plumbing (obs/slowness.py via balance/membership):
+        # fired once per FORGIVEN sweep — a coma observer's slow
+        # ballots are retracted alongside its death suspicions (its
+        # latency samples are as undateable as its silences)
+        self.on_stall_forgiven: Optional[Callable[[], None]] = None
         self.stall = stall_knob()
         if self.stall and self.stall <= self.interval:
             # a stall budget at or below the sweep cadence would make
@@ -236,6 +241,10 @@ class HeartbeatMonitor:
                     self._suspect.clear()
                 for p in forgiven:
                     sus_hook(p, False)
+        if forgave and self.on_stall_forgiven is not None:
+            # ...and so are its fail-slow ballots (obs/slowness.py):
+            # the same coma inflated every latency sample it took
+            self.on_stall_forgiven()
         for p in candidates:
             with self._sus_lock:
                 with self._lock:

@@ -112,6 +112,13 @@ class StalenessGate:
         # plan arrived at a rank already inside its gate wait, two
         # clocks ahead of the paced successor)
         self.poll_hook = None
+        # fail-slow corroboration feed (obs/slowness.py, wired by the
+        # trainer when MINIPS_SLOW is armed): fired with the behind
+        # list whenever the gate actually blocks — gate-behind COUNTS,
+        # an observable the SlownessMonitor surfaces next to its
+        # latency evidence (it does not vote: gate lag is often the
+        # victim of slowness elsewhere)
+        self.on_behind = None
         self.gate_waits = 0      # times the gate actually blocked
         self.max_skew_seen = 0   # max (my_clock - global_min) observed
 
@@ -130,14 +137,17 @@ class StalenessGate:
         t_wait0 = time.monotonic()
         tr = _trc.TRACER
         behind: list[int] = []
-        if tr is not None:
+        if tr is not None or self.on_behind is not None:
             # WHO the gate is missing — the blocked-time attribution
-            # the straggler report is built from (obs/report.py)
+            # the straggler report is built from (obs/report.py), and
+            # the fail-slow monitor's gate-behind observable
             snap = self.gossip.snapshot()
             excluded = self.gossip.excluded
             behind = sorted(p for p, v in snap.items()
                             if v and p not in excluded
                             and min(v) < threshold)
+            if self.on_behind is not None and behind:
+                self.on_behind(behind)
         deadline = time.monotonic() + self.timeout
         try:
             while not self.gossip.wait_global_min(

@@ -281,8 +281,8 @@ def _flash_forward(q, k, v, q_off, k_off, masked, scale, block_q, block_k,
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32, vma=vma),
+            jaxcompat.sds((B, H, Tq, D), q.dtype, vma=vma),
+            jaxcompat.sds((B, H, Tq, 1), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),   # acc
@@ -395,7 +395,7 @@ def _flash_backward(q, k, v, q_off, k_off, g_out, lse, dvec, masked, scale,
         in_specs=[_smem_spec(), _smem_spec(),
                   q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype, vma=vma),
+        out_shape=jaxcompat.sds((B, H, Tq, D), q.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
     )(*offs, qt, kt, vt, dot, lse, dvec)
@@ -418,8 +418,8 @@ def _flash_backward(q, k, v, q_off, k_off, g_out, lse, dvec, masked, scale,
                   row_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
         out_shape=[
-            jax.ShapeDtypeStruct((B, Hk, Tk, D), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((B, Hk, Tk, D), v.dtype, vma=vma),
+            jaxcompat.sds((B, Hk, Tk, D), k.dtype, vma=vma),
+            jaxcompat.sds((B, Hk, Tk, D), v.dtype, vma=vma),
         ],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],

@@ -936,6 +936,37 @@ class TableServeState:
             self._count("replica_rows_routed", int(mask.sum()))
         return targets, rep
 
+    def hedge_holder(self, keys: np.ndarray,
+                     exclude: set[int]) -> Optional[int]:
+        """A live replica holder covering EVERY block ``keys`` touch —
+        the hedged pull leg's re-issue target (serve/hedge.py). The
+        hedge re-sends one leg verbatim, so one holder must cover the
+        whole slice (owners grant all their hot blocks to one holder
+        set, so a slow owner's hot legs usually find one); ``exclude``
+        carries the slow owner (hedging back at the sick rank buys
+        nothing) and the requester itself (its own snapshot already
+        declined these keys at issue — ``serve_local``). Monitor-dead
+        ranks are excluded like every other read route. None = no
+        second copy exists: the honest no-replica limit, counted by
+        the caller."""
+        m = self._merged
+        if not m:
+            return None
+        t = self.table
+        common: Optional[set] = None
+        for b in np.unique(t.router.blocks_of(keys)):
+            hs = set(m.get(int(b), ()))
+            common = hs if common is None else (common & hs)
+            if not common:
+                return None
+        if common is None:
+            return None
+        cands = sorted(common - set(exclude) - t._excluded_ranks())
+        if not cands:
+            return None
+        self._rr += 1
+        return cands[self._rr % len(cands)]
+
     def _plan_by_owner(self, keys: np.ndarray, rt: int) -> list:
         t = self.table
         owners = t._owners_of(keys)

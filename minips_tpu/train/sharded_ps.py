@@ -937,16 +937,36 @@ class ShardedTable:
         # windowed layer), the env-gated wire tracer, and the always-on
         # flight recorder. ``_trc.maybe_init`` arms the process tracer
         # from MINIPS_TRACE on first construction and is a no-op (one
-        # env read) when off; ``_leg_t0`` is trace-only bookkeeping
-        # (empty forever when the tracer is off), while ``_fence_t0``
-        # is now ALWAYS stamped (the fence hist needs it; a dict insert
-        # per fenced block per migration, nowhere near the frame path).
+        # env read) when off; ``_leg_t0`` is ALWAYS stamped since the
+        # fail-slow plane (one dict insert/pop per wire leg): the hedge
+        # timer needs each leg's issue time and the SlownessMonitor
+        # needs the per-peer round trip a reply closes, tracer or not.
+        # ``_fence_t0`` is likewise always stamped (the fence hist).
         self.hist_serve = Log2Histogram()
         self.hist_park = Log2Histogram()
         self.hist_fence = Log2Histogram()
         _trc.maybe_init(rank)
         _fl.maybe_init(rank)
-        self._leg_t0: dict[int, tuple] = {}   # rid -> (t0, owner)
+        self._leg_t0: dict[int, tuple] = {}   # rid -> (t0, target)
+        # ---- fail-slow plane (serve/hedge.py + obs/slowness.py; OFF
+        # unless the trainer attaches them): hedged pull legs against
+        # replica holders, and the per-peer latency feed for the
+        # SlownessMonitor. _hedges_live bounds outstanding hedges
+        # (budget); counters follow the serve-plane convention.
+        self._hedge = None           # serve.hedge.HedgeConfig
+        self._slowness = None        # obs.slowness.SlownessMonitor
+        self._hedges_live: set[int] = set()
+        # legs whose group completed WITHOUT their reply (a hedge won,
+        # or the pull timed out): rid -> (t0, target), bounded. The
+        # late reply is precisely the tail evidence that indicts a
+        # slow rank — dropping it with the group would blind the
+        # detector exactly when the mitigation works (measured: with
+        # hedging on, every slow-owner sample went late). Insertion-
+        # ordered; oldest evicted past the cap.
+        self._late_t0: dict[int, tuple] = {}
+        self.hedge_counters = {k: 0 for k in
+                               ("fired", "won", "lost", "no_holder",
+                                "denied")}
         self._fence_t0: dict[int, float] = {}  # block -> fence start
         # ---- server shard: ONLY my row range lives here (the 1/N memory
         # claim, materialization included — a multi-GB Criteo table must
@@ -1175,6 +1195,21 @@ class ShardedTable:
         if self.bus is not None:
             for kind, fn in self._sv.handlers():
                 self.bus.on(f"{kind}:{self.name}", fn)
+
+    def attach_hedge(self, cfg) -> None:
+        """Arm hedged pull legs (serve/hedge.py): a leg outstanding
+        past the hedge delay — or aimed at a slow-verdict owner — is
+        re-issued to a replica holder under the identical admission
+        stamp, first admissible reply wins. Pure client-side state; a
+        table with no serving plane attached simply never finds a
+        holder (counted ``no_holder``, the documented honest limit)."""
+        self._hedge = cfg
+
+    def bind_slowness(self, sm) -> None:
+        """Feed the fail-slow detector (obs/slowness.py): pull-leg
+        round trips and push-ack lags recorded at the call sites that
+        already hold the timestamps — no second measurement path."""
+        self._slowness = sm
 
     def attach_membership(self, mb) -> None:
         """Bind the elastic membership plane (balance/membership.py).
@@ -2349,8 +2384,15 @@ class ShardedTable:
                 return
             rows = np.frombuffer(blob, np.float32).reshape(-1, self.dim)
         leg = None
+        hedge_role = None  # "won" (hedge beat the owner) | "lost"
         with self._reply_cond:
             gid = self._rid_gid.get(rid)
+            if gid is None or gid not in self._replies:
+                # straggler past its group's death: the stashed issue
+                # stamp (if any) turns it into the slowness sample it
+                # is — the slow owner's true round trip, which the
+                # hedge that out-raced it must not erase
+                leg = self._late_t0.pop(rid, None)
             if gid is not None and gid in self._replies:
                 # wire accounting counts ACTUAL bytes received
                 # (compressed when compressed) — the pull leg's half of
@@ -2359,19 +2401,59 @@ class ShardedTable:
                 # for live requests: a late reply to a cancelled
                 # prefetch must not inflate the counter. A loopback
                 # reply (self-shed svP, sender == me) crossed no wire.
+                # A hedged pair's LOSER still crossed the wire — both
+                # replies' bytes count; that duplication IS the cost
+                # hedging pays and the B/row accounting must show it.
                 if sender != self.rank:
                     self.bytes_pulled += len(blob)
-                self._replies[gid][rid] = (
-                    rows, int(payload.get("stamp", 0)), payload)
-                self._reply_t[gid] = time.monotonic()
+                # hedged legs: the hedge rid maps back to its PRIMARY
+                # leg — the reply (whichever wing it rode) satisfies
+                # the primary slot. First-ADMISSIBLE-reply-wins is
+                # first-reply-wins here: owners park and replicas
+                # refuse until `gate.admits` holds, so any reply that
+                # exists is admissible; the second one is the loser,
+                # discarded by its rid.
+                grp = self._groups.get(gid)
+                hmap = grp.get("hedges") if grp is not None else None
+                prim = hmap.get(rid, rid) if hmap else rid
                 leg = self._leg_t0.pop(rid, None)
-                self._reply_cond.notify_all()
+                if prim in self._replies[gid]:
+                    self._rid_gid.pop(rid, None)
+                    self._hedges_live.discard(rid)
+                    if leg is not None and hmap \
+                            and (rid in hmap
+                                 or prim in (grp.get("hedged") or ())):
+                        # the hedged pair's second wing — discarded by
+                        # rid, counted AT MOST ONCE per pair: `leg`
+                        # non-None means this is the wing's FIRST
+                        # arrival (the t0 stamp pops exactly once), so
+                        # a chaos-DUPLICATED reply of either wing can
+                        # never inflate `lost` past `fired`.
+                        self.hedge_counters["lost"] += 1
+                        hedge_role = "lost"
+                else:
+                    self._replies[gid][prim] = (
+                        rows, int(payload.get("stamp", 0)), payload)
+                    self._reply_t[gid] = time.monotonic()
+                    if prim != rid:
+                        self._hedges_live.discard(rid)
+                        self.hedge_counters["won"] += 1
+                        hedge_role = "won"
+                    self._reply_cond.notify_all()
         if leg is not None:
+            if self._slowness is not None and sender != self.rank:
+                # the per-peer service-latency feed: issue -> reply,
+                # attributed to the rank that actually replied (a
+                # hedged pair feeds BOTH wings — the slow owner's
+                # eventual reply records the true tail that indicts it)
+                self._slowness.note(sender, time.monotonic() - leg[0])
             tr = _trc.TRACER
             if tr is not None:
                 tr.complete("pull", "pull_leg", leg[0],
                             {"owner": leg[1], "rid": rid,
-                             "bytes": len(blob)})
+                             "bytes": len(blob),
+                             **({"hedge": hedge_role}
+                                if hedge_role else {})})
 
     def _on_epoch_nack(self, sender: int, payload: dict) -> None:
         """Client side of the pull-leg epoch fence: the owner I routed a
@@ -2400,6 +2482,8 @@ class ShardedTable:
         with self._reply_cond:
             gid = self._rid_gid.pop(rid, None)
             self._leg_t0.pop(rid, None)  # refused leg: span abandoned
+            self._hedges_live.discard(rid)  # a refused hedge twin's
+            #                                 budget slot frees here
             grp = self._groups.get(gid) if gid is not None else None
             if grp is None:
                 return  # finished/cancelled group: nothing to re-route
@@ -2421,8 +2505,7 @@ class ShardedTable:
                 grp["legs"][rid2] = (int(o), idx[m])
                 self._rid_gid[rid2] = gid
                 self.bytes_pulled += keys[m].nbytes
-                if tr is not None:
-                    self._leg_t0[rid2] = (time.monotonic(), int(o))
+                self._leg_t0[rid2] = (time.monotonic(), int(o))
                 sends.append((int(o), rid2, grp["clk"], keys[m]))
             self._reply_cond.notify_all()
         if tr is not None:
@@ -2452,6 +2535,9 @@ class ShardedTable:
         with self._reply_cond:
             gid = self._rid_gid.pop(rid, None)
             self._leg_t0.pop(rid, None)
+            self._hedges_live.discard(rid)  # an svN-refused hedge twin
+            #                                 dies here (leg is None
+            #                                 below — primary still out)
             grp = self._groups.get(gid) if gid is not None else None
             if grp is None:
                 return
@@ -2476,8 +2562,7 @@ class ShardedTable:
                 self._rid_gid[rid2] = gid
                 if target != self.rank:  # loopback legs cross no wire
                     self.bytes_pulled += keys[mask].nbytes
-                if tr is not None:
-                    self._leg_t0[rid2] = (time.monotonic(), int(target))
+                self._leg_t0[rid2] = (time.monotonic(), int(target))
                 sends.append((int(target), kind, rid2, grp["clk"],
                               keys[mask], extra))
             self._reply_cond.notify_all()
@@ -2630,6 +2715,31 @@ class ShardedTable:
         return {rid: o for rid, (o, _i) in grp["legs"].items()
                 if rid not in got}
 
+    def _release_hedges_locked(self, grp: dict) -> None:
+        """Drop a dying/completed group's hedge twins: a hedge whose
+        reply never came must release its budget slot and its rid
+        mapping (a late reply then drops at the gid lookup, the same
+        path as any post-cleanup straggler). Caller holds the cond."""
+        for hrid in grp.get("hedges") or ():
+            self._rid_gid.pop(hrid, None)
+            self._stash_late_locked(hrid)
+            self._leg_t0.pop(hrid, None)
+            self._hedges_live.discard(hrid)
+
+    def _stash_late_locked(self, rid: int) -> None:
+        """Keep an unanswered leg's issue stamp past its group's death
+        so the LATE reply still feeds the slowness monitor (the slow
+        owner's true round trip — see ``_late_t0``). Bounded: oldest
+        evicted; only armed when a detector is bound."""
+        if self._slowness is None:
+            return
+        t0 = self._leg_t0.get(rid)
+        if t0 is None:
+            return
+        if len(self._late_t0) >= 512:
+            self._late_t0.pop(next(iter(self._late_t0)))
+        self._late_t0[rid] = t0
+
     def _cleanup_group_locked(self, gid: int) -> None:
         self._replies.pop(gid, None)
         self._reply_t.pop(gid, None)
@@ -2637,7 +2747,9 @@ class ShardedTable:
         if grp is not None:
             for rid in grp["legs"]:
                 self._rid_gid.pop(rid, None)
+                self._stash_late_locked(rid)
                 self._leg_t0.pop(rid, None)
+            self._release_hedges_locked(grp)
 
     def _take_group(self, gid: int) -> tuple[dict, list]:
         """Detach a completed group's final leg map + extra-local idx
@@ -2648,7 +2760,163 @@ class ShardedTable:
                 return {}, []
             for rid in grp["legs"]:
                 self._rid_gid.pop(rid, None)
+                # a leg whose slot was satisfied by its hedge twin has
+                # NOT replied itself — keep its stamp for the late
+                # reply (still in _leg_t0 iff unanswered)
+                self._stash_late_locked(rid)
+                self._leg_t0.pop(rid, None)
+            self._release_hedges_locked(grp)
             return grp["legs"], grp["extra_local"]
+
+    # ------------------------------------------------------- hedged legs
+    def _hedge_delay_s(self) -> float:
+        """The hedge delay: a fixed ``delay_ms`` when pinned, else the
+        p99-derived delay — ``factor`` x the WINDOWED pull-latency p99
+        (obs/window.py via the bound trainer), floored at ``min_ms``.
+        The floor is what keeps armed-idle runs hedge-free: loopback
+        legs answer orders of magnitude under it (SLOW-IDLE)."""
+        cfg = self._hedge
+        if cfg.delay_ms > 0:
+            return cfg.delay_ms / 1e3
+        p99 = None
+        ow = getattr(self._cons, "obs_window", None)
+        if ow is not None:
+            p99 = ow.quantile_ms("pull_latency", 0.99)
+        if p99 is None:
+            return cfg.min_ms / 1e3
+        return max(cfg.min_ms, cfg.factor * p99) / 1e3
+
+    def _slow_verdicts(self) -> set[int]:
+        """Current fleet slow verdicts (quorum-corroborated, membership
+        plane) — a leg aimed at one hedges at the ``min_ms`` FLOOR
+        instead of the p99-derived delay (which the sick rank's own
+        tail has inflated). Not at zero: a hedge fired the instant of
+        issue races the holder's refresh stamp and buys a guaranteed
+        svN refusal + fallback (measured — the verdicted arm's p99
+        went BACK to the unmitigated tail). Empty without the
+        membership plane."""
+        mb = self._mb
+        if mb is None:
+            return set()
+        view = getattr(mb, "slow_view", None)
+        return view() if view is not None else set()
+
+    def _hedge_due(self, t0: float, target: int, delay: float,
+                   slow: set) -> float:
+        if target in slow:
+            return t0 + min(delay, self._hedge.min_ms / 1e3)
+        return t0 + delay
+
+    def _maybe_hedge(self, gid: int) -> None:
+        """Fire hedges for this group's overdue legs. Runs ONLY from
+        the pull-wait loop (training/reader thread) — never the bus
+        receive thread. One hedge per leg, ``budget`` outstanding per
+        table; a leg with no replica holder covering its blocks stays
+        unhedged (counted — the honest no-replica limit). With NO
+        serve plane attached every overdue leg takes the no_holder
+        path — marked, counted, never re-probed — so the wait loop
+        cannot busy-wake at the 1ms floor forever."""
+        sv = self._sv
+        cfg = self._hedge
+        now = time.monotonic()
+        delay = self._hedge_delay_s()
+        slow = self._slow_verdicts()
+        sends: list[tuple] = []
+        tr = _trc.TRACER
+        with self._reply_cond:
+            grp = self._groups.get(gid)
+            if grp is None or grp.get("uniq") is None:
+                return  # gone, or a pull_all group (no key space)
+            hedged = grp.setdefault("hedged", set())
+            hmap = grp.setdefault("hedges", {})
+            got = self._replies.get(gid, {})
+            for rid, (target, idx) in list(grp["legs"].items()):
+                if rid in got or rid in hedged or rid in hmap:
+                    continue  # answered, already hedged, or IS a hedge
+                t0 = self._leg_t0.get(rid)
+                if t0 is None:
+                    continue
+                due = self._hedge_due(t0[0], target, delay, slow)
+                if now < due:
+                    continue
+                if len(self._hedges_live) >= cfg.budget:
+                    # the budget valve is a LOAD SHED, not a queue:
+                    # the denied leg is marked hedged (counted once,
+                    # never re-probed) — leaving it eligible would
+                    # busy-wake the wait loop at the 1ms floor and
+                    # inflate `denied` into a wake count
+                    self.hedge_counters["denied"] += 1
+                    hedged.add(rid)
+                    continue
+                keys = grp["uniq"][idx]
+                holder = (sv.hedge_holder(
+                    keys, exclude={int(target), self.rank})
+                    if sv is not None else None)
+                if holder is None:
+                    self.hedge_counters["no_holder"] += 1
+                    hedged.add(rid)  # don't re-probe every wake
+                    continue
+                rid2 = self._next_req()
+                hmap[rid2] = rid
+                hedged.add(rid)
+                self._rid_gid[rid2] = gid
+                self._hedges_live.add(rid2)
+                self._leg_t0[rid2] = (now, int(holder))
+                self.bytes_pulled += keys.nbytes
+                self.hedge_counters["fired"] += 1
+                sends.append((int(holder), rid2, grp["clk"], keys,
+                              int(target)))
+        for holder, rid2, clk, kslice, slow_tgt in sends:
+            # the hedge rides the svP wire under the SAME clk stamp as
+            # the primary — the holder's `admits(stamp, clk, s)` is the
+            # identical predicate the owner's park runs, so whichever
+            # reply wins satisfies the same staleness bound
+            _fl_rec = _fl.FLIGHT
+            if _fl_rec is not None:
+                fired = self.hedge_counters["fired"]
+                if fired <= 8 or fired % 32 == 0:
+                    # sampled like sv_shed: a long drill's hedges must
+                    # not rotate the post-mortem ring, the cumulative
+                    # count in each entry carries the true volume
+                    _fl_rec.ev("hedge_fired",
+                               {"table": self.name, "owner": slow_tgt,
+                                "holder": holder, "rid": rid2,
+                                "fired_total": fired})
+            if tr is not None:
+                tr.instant("pull", "hedge_fired",
+                           {"owner": slow_tgt, "holder": holder,
+                            "rid": rid2})
+                tr.flow("s", _trc.flow_id(f"pull:{self.name}",
+                                          self.rank, rid2),
+                        "pull", {"owner": holder, "rid": rid2})
+            self.bus.send(holder, f"svP:{self.name}",
+                          {"req": rid2, "clk": clk,
+                           **self._ep_header(), **self._cfg_header()},
+                          blob=_as_blob(kslice))
+
+    def _hedge_wait_s(self, gid: int) -> float:
+        """Time until the EARLIEST unhedged leg of ``gid`` comes due —
+        the wait-loop's timeout so a hedge fires on schedule instead
+        of at the next 0.5 s poll. Caller holds the reply cond."""
+        grp = self._groups.get(gid)
+        if grp is None or grp.get("uniq") is None:
+            return 0.5
+        delay = self._hedge_delay_s()
+        slow = self._slow_verdicts()
+        got = self._replies.get(gid, {})
+        hedged = grp.get("hedged") or ()
+        hmap = grp.get("hedges") or {}
+        now = time.monotonic()
+        best = 0.5
+        for rid, (target, _idx) in grp["legs"].items():
+            if rid in got or rid in hedged or rid in hmap:
+                continue
+            t0 = self._leg_t0.get(rid)
+            if t0 is None:
+                continue
+            due = self._hedge_due(t0[0], target, delay, slow)
+            best = min(best, max(due - now, 0.001))
+        return best
 
     def _await_replies(self, gid: int,
                        timeout: Optional[float] = None) -> dict:
@@ -2658,11 +2926,19 @@ class ShardedTable:
             with self._reply_cond:
                 if not self._missing_legs_locked(gid):
                     return self._replies.pop(gid)
-                self._reply_cond.wait(timeout=0.5)
+                self._reply_cond.wait(
+                    timeout=(self._hedge_wait_s(gid)
+                             if self._hedge is not None else 0.5))
                 miss = self._missing_legs_locked(gid)
                 if not miss:
                     return self._replies.pop(gid)
                 owners = set(miss.values())
+            if self._hedge is not None:
+                # hedge overdue legs BEFORE the adoption/death checks:
+                # this thread is the pull waiter (training or storm
+                # reader), never the bus receive thread — the send-from-
+                # recv-thread deadlock class stays impossible here
+                self._maybe_hedge(gid)
             # ---- lock released: adoption / monitor / deadline. This
             # runs on the TRAINING thread — the one context where table
             # adoption is race-free against the push path — and a
@@ -2919,8 +3195,7 @@ class ShardedTable:
                     # under the reply lock: replies land on the receive
                     # thread and bump the same counter (non-atomic RMW)
                     self.bytes_pulled += kslice.nbytes
-                    if tr is not None:
-                        self._leg_t0[rid] = (time.monotonic(), o)
+                    self._leg_t0[rid] = (time.monotonic(), o)
                 if tr is not None:
                     tr.flow("s",
                             _trc.flow_id(f"pull:{self.name}",
@@ -3170,6 +3445,11 @@ class ShardedTable:
         tr = _trc.TRACER
         for seq, t0, owner in settled:
             self.timers.record_push_ack(now - t0)
+            if self._slowness is not None and owner != self.rank:
+                # push-ack lag per owner: the write path's half of the
+                # fail-slow service-latency feed (a sick owner acks
+                # late even when its beats land on time)
+                self._slowness.note(owner, now - t0)
             if tr is not None:
                 tr.complete("push", "push_ack", t0,
                             {"owner": owner, "seq": seq}, t1=now)
@@ -3826,6 +4106,8 @@ class ShardedPSTrainer:
                  serve: Optional[str] = None,
                  elastic: Optional[str] = None,
                  autoscale: Optional[str] = None,
+                 hedge: Optional[str] = None,
+                 slow: Optional[str] = None,
                  plane: Optional[str] = None):
         # data-plane selection at the same altitude as the bus backends
         # (train/mesh_plane.resolve_plane: explicit wins, else
@@ -3942,6 +4224,32 @@ class ShardedPSTrainer:
 
             self.autoscaler = Autoscaler(
                 self, self.membership, AutoscaleConfig.parse(aspec))
+        # fail-slow plane (serve/hedge.py + obs/slowness.py): OFF by
+        # default — explicit specs win, else $MINIPS_HEDGE /
+        # $MINIPS_SLOW. Hedging is pure client-side read mitigation
+        # (it needs the serve plane's replica holders to have a target
+        # — armed without one it only ever counts no_holder, the
+        # documented limit). The SlownessMonitor is the detection
+        # rung: per-peer latency fed from the leg/ack paths, rolled at
+        # every clock boundary; with the membership plane armed its
+        # suspicions gossip on heartbeats (slw ballots) and convict by
+        # the same strict-majority quorum as death — bind AFTER
+        # membership so the hook wiring sees it.
+        from minips_tpu.obs import slowness as _slw
+        from minips_tpu.serve import hedge as _hg
+
+        self.hedge_cfg = _hg.maybe_config(hedge)
+        if self.hedge_cfg is not None:
+            for t in tables.values():
+                t.attach_hedge(self.hedge_cfg)
+        self.slowness = _slw.maybe_build(bus.my_id, num_processes, slow)
+        if self.slowness is not None:
+            for t in tables.values():
+                t.bind_slowness(self.slowness)
+            self.gate.on_behind = self.slowness.note_behind
+            if self.membership is not None:
+                self.membership.bind_slowness(self.slowness,
+                                              self.slowness.cfg)
         if self.rebalancer is not None:
             # adopt plans (and, at the coordinator, issue pending death
             # transitions) while GATE-blocked too, not just while
@@ -4035,6 +4343,11 @@ class ShardedPSTrainer:
 
             ow.register_counter("shed", _sv_sig("shed"))
             ow.register_counter("backpressure", _sv_sig("bp"))
+        if self.hedge_cfg is not None:
+            ow.register_counter(
+                "hedges_fired",
+                lambda: sum(t.hedge_counters["fired"]
+                            for t in tables))
         rel = getattr(self.bus, "reliable", None)
         if rel is not None:
             ow.register_counter(
@@ -4138,6 +4451,16 @@ class ShardedPSTrainer:
             # reads a windowed value — the roll is this boundary's one
             # snapshot pass over the cumulative hists/counters
             self.obs_window.roll()
+        if self.slowness is not None:
+            # the fail-slow judgment rolls on the same boundary, BEFORE
+            # the membership/rebalancer decisions below read verdicts:
+            # a suspicion raised here rides this boundary's heartbeat
+            # ballot, and the planner's demotion bias sees the freshest
+            # quorum view. Dead/left ranks leave the judged set first —
+            # a corpse's tail is the death path's business.
+            for p in self.gossip.excluded:
+                self.slowness.exclude(p)
+            self.slowness.roll()
         drain = self.staleness != float("inf")
         for t in self.tables.values():
             if drain:
@@ -4381,6 +4704,36 @@ class ShardedPSTrainer:
         if mon is None or not hasattr(mon, "stats"):
             return None
         return mon.stats()
+
+    def hedge_stats(self) -> Optional[dict]:
+        """Hedged-pull counters summed over tables (serve/hedge.py):
+        None when hedging is OFF, all-zero when armed-but-idle — the
+        off-vs-idle done-line convention. ``fired``/``won``/``lost``
+        prove engagement; ``no_holder`` counts the honest no-replica
+        ceiling; ``denied`` the budget valve."""
+        if self.hedge_cfg is None:
+            return None
+        out = {k: 0 for k in ("fired", "won", "lost", "no_holder",
+                              "denied")}
+        for t in self.tables.values():
+            for k, v in t.hedge_counters.items():
+                out[k] += v
+        out["delay_ms"] = self.hedge_cfg.delay_ms or None
+        out["budget"] = self.hedge_cfg.budget
+        return out
+
+    def slowness_stats(self) -> Optional[dict]:
+        """Fail-slow detection state (obs/slowness.py): None when
+        MINIPS_SLOW is off; armed runs carry the suspect set, per-peer
+        windowed p99s, streaks, and — with the membership plane armed
+        — the quorum's slow-verdict view."""
+        if self.slowness is None:
+            return None
+        out = self.slowness.stats()
+        mb = self.membership
+        if mb is not None and hasattr(mb, "slow_stats"):
+            out.update(mb.slow_stats())
+        return out
 
     def serve_stats(self) -> dict:
         """Per-owner serve-load counters summed over tables (always on):

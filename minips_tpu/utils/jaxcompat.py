@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["shard_map", "typeof", "axis_size", "pcast"]
+__all__ = ["shard_map", "typeof", "axis_size", "pcast", "sds"]
 
 _NEW_SHARD_MAP = getattr(jax, "shard_map", None)
 
@@ -96,3 +96,24 @@ def typeof(x):
     if t is not None:
         return t(x)
     return jax.core.get_aval(x)
+
+
+try:  # does this jax's ShapeDtypeStruct speak the vma kwarg?
+    jax.ShapeDtypeStruct((1,), "float32", vma=frozenset())
+    _SDS_HAS_VMA = True
+except TypeError:
+    _SDS_HAS_VMA = False
+
+
+def sds(shape, dtype, *, vma=None):
+    """``jax.ShapeDtypeStruct`` with the optional varying-manual-axes
+    annotation, on both lineages. Newer jax's pallas_call under the
+    vma tracer needs out_shapes stamped with the inputs' varying axes
+    (``vma=``); pre-vma jax (< the varying-axis type discipline) has
+    no such kwarg AND no such type to annotate — dropping it there is
+    semantically exact, the same identity argument as :func:`pcast`
+    (the old check_rep tracer carries no varying-axis types, so there
+    is nothing the annotation could change)."""
+    if _SDS_HAS_VMA and vma is not None:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)

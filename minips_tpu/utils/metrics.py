@@ -99,6 +99,14 @@ def wire_record(trainer) -> dict:
         # an exact push wire — fold/retain/flush accounting is the
         # evidence no gradient mass is stranded
         "ef": getattr(trainer, "ef_stats", lambda: None)(),
+        # fail-slow plane (serve/hedge.py + obs/slowness.py): hedged
+        # pull-leg counters (fired/won/lost/no_holder/denied) and the
+        # detection state (suspects, per-peer windowed p99s, slow
+        # verdicts when the quorum is armed) — None when the
+        # respective knob is off, zeros/empty when armed-but-idle
+        "hedge": getattr(trainer, "hedge_stats", lambda: None)(),
+        "slowness": getattr(trainer, "slowness_stats",
+                            lambda: None)(),
         # retransmission-protocol + fault-injection counters: None when
         # the respective layer is off ('off' vs 'clean' distinguishable)
         "reliable": trainer.reliable_stats(),

@@ -555,7 +555,8 @@ def test_ssp_trainer_survives_chaos_with_bounds_intact():
 
 
 def run_bsp_lockstep(backend: str = "zmq", chaos: str = "",
-                     reliable: str = ""):
+                     reliable: str = "", hedge: str = "",
+                     stats: "dict | None" = None):
     """2-rank in-proc BSP lockstep run → (final weights per rank,
     frames_lost per rank). THE bitwise-drill harness: identical frame
     streams must produce identical state whatever transport/fault layer
@@ -595,6 +596,14 @@ def run_bsp_lockstep(backend: str = "zmq", chaos: str = "",
     LockstepCons.clocks = [0, 0]
     for i, t in enumerate(tables):
         t.bind_consistency(LockstepCons(i))
+        if hedge:
+            # SLOW-IDLE arm (fail-slow plane): hedging ARMED with no
+            # slow link — the min_ms floor must keep every leg
+            # unhedged, and the armed bookkeeping (leg stamps, group
+            # hedge maps, wait-timeout math) must not perturb one bit
+            from minips_tpu.serve.hedge import HedgeConfig
+
+            t.attach_hedge(HedgeConfig.parse(hedge))
         t._w[...] = np.arange(32 * 2, dtype=np.float32
                               ).reshape(32, 2) / 7.0
     # disjoint cross-shard keys (same shape as the row-cache bitwise
@@ -611,6 +620,12 @@ def run_bsp_lockstep(backend: str = "zmq", chaos: str = "",
             LockstepCons.clocks[0] += 1
             LockstepCons.clocks[1] += 1
         lost = [b.frames_lost for b in buses]
+        if stats is not None:
+            # engagement evidence for the armed-idle drills: the
+            # SLOW-IDLE stamp must distinguish 'fired 0' from 'not
+            # measured'
+            stats["hedges_fired"] = sum(
+                t.hedge_counters["fired"] for t in tables)
         return [t._w.copy() for t in tables], lost
     finally:
         for b in buses:

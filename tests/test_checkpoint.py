@@ -103,6 +103,43 @@ def test_partial_tmp_dir_ignored(mesh8, tmp_path):
     assert ck.restore() == 1
 
 
+def test_restore_walks_back_past_torn_checkpoint(mesh8, tmp_path, capfd):
+    """Fail-slow PR satellite: a TORN newest checkpoint — truncated
+    npz, corrupt manifest, or a missing table file — is skipped with a
+    loud warning and ``restore()`` walks back to the newest VALID step
+    instead of crashing the relaunch. The live tables stay untouched
+    by the failed candidate (validate-before-apply)."""
+    d, s = _trained_tables(mesh8)
+    ck = Checkpointer(str(tmp_path), {"d": d, "s": s})
+    ck.save(step=1)
+    ck.save(step=2)
+    ck.save(step=3)
+    # tear step 3: truncate its npz mid-file (the crash-mid-write shape
+    # the atomic rename cannot protect against — e.g. disk-full after
+    # publish, or bit rot)
+    p3 = tmp_path / "step_0000000003" / "d.npz"
+    raw = p3.read_bytes()
+    p3.write_bytes(raw[: len(raw) // 2])
+    d2, s2 = _trained_tables(mesh8)
+    ck2 = Checkpointer(str(tmp_path), {"d": d2, "s": s2})
+    assert ck2.restore() == 2
+    err = capfd.readouterr().err
+    assert "skipping torn checkpoint" in err and "step_3" in err
+    # an EXPLICIT step keeps strict semantics: asking for the torn one
+    # raises instead of silently substituting an older step
+    with pytest.raises(Exception):
+        ck2.restore(step=3)
+    # corrupt manifest on the next-newest: walk back twice
+    (tmp_path / "step_0000000002" / "manifest.json").write_text("{tor")
+    d3, s3 = _trained_tables(mesh8)
+    assert Checkpointer(str(tmp_path), {"d": d3, "s": s3}).restore() == 1
+    # a missing table file is a torn checkpoint too
+    os.remove(str(tmp_path / "step_0000000001" / "d.npz"))
+    d4, s4 = _trained_tables(mesh8)
+    with pytest.raises(FileNotFoundError, match="every candidate"):
+        Checkpointer(str(tmp_path), {"d": d4, "s": s4}).restore()
+
+
 def test_sgd_roundtrip_leafless_opt_state(mesh8, tmp_path):
     """sgd's opt state has zero leaves (EmptyStates), so no 'opt_state' key
     lands in the npz at all — restore must tolerate the absent key."""

@@ -767,6 +767,100 @@ def test_mesh_bitwise_requires_equal_finals_and_a_real_drill():
     assert any("MESH-BITWISE" in p for p in probs)
 
 
+# ------------------- fail-slow tripwires (SLOW-HEDGE/DRAIN/IDLE)
+def _fail_slow_art(u_p99=62.0, h_p99=28.0, fired=120, slowed=300,
+                   u_completed=True, h_completed=True,
+                   d_completed=True, d_clock=40, verdicts=1,
+                   blocks_out=3, d_lost=0, d_agree=True,
+                   events=("slow_suspect", "slow_verdict",
+                           "hedge_fired", "demote"),
+                   idle_equal=True, idle_checked=64,
+                   idle_fired=0) -> dict:
+    want = {"slow_suspect", "slow_verdict", "hedge_fired", "demote"}
+    return {"fail_slow_3proc": {
+        "iters": 40, "sick_rank": 1, "reader_rank": 0,
+        "unmitigated": {"completed": u_completed,
+                        "steps_per_sec_slow": 9.0,
+                        "reader_p99_ms": u_p99, "slowed": slowed,
+                        "hedges_fired": 0, "wire_frames_lost": 0,
+                        "finals_agree": True},
+        "hedged": {"completed": h_completed,
+                   "steps_per_sec_slow": 11.0,
+                   "reader_p99_ms": h_p99, "slowed": slowed,
+                   "hedges_fired": fired, "hedges_won": fired,
+                   "wire_frames_lost": 0, "finals_agree": True},
+        "demote": {"completed": d_completed, "clock_min": d_clock,
+                   "steps_per_sec_slow": 12.0,
+                   "slow_verdicts": verdicts,
+                   "sick_blocks_out": blocks_out,
+                   "wire_frames_lost": d_lost,
+                   "finals_agree": d_agree,
+                   "flight_events": sorted(events),
+                   "flight_events_ok": want <= set(events)},
+        "idle": {"equal": idle_equal, "rows_checked": idle_checked,
+                 "hedges_fired": idle_fired}}}
+
+
+def test_fail_slow_tripwires_pass_on_healthy_sweep():
+    from ci.bench_regression import fail_slow_tripwires
+
+    assert fail_slow_tripwires(_fail_slow_art()) == []
+    assert fail_slow_tripwires({}) == []  # absent sweep: vacuous
+
+
+def test_slow_hedge_requires_strict_p99_win_and_engagement():
+    from ci.bench_regression import fail_slow_tripwires
+
+    probs = fail_slow_tripwires(_fail_slow_art(u_p99=30.0, h_p99=30.0))
+    assert any("SLOW-HEDGE" in p and "strictly below" in p
+               for p in probs)
+    probs = fail_slow_tripwires(_fail_slow_art(h_p99=90.0))
+    assert any("strictly below" in p for p in probs)
+    # zero hedges fired = silently disarmed plane, whatever the p99
+    probs = fail_slow_tripwires(_fail_slow_art(fired=0))
+    assert any("0 hedges fired" in p for p in probs)
+    # the injector must provably engage
+    probs = fail_slow_tripwires(_fail_slow_art(slowed=0))
+    assert any("never engaged" in p for p in probs)
+    # dead arms can never pass
+    probs = fail_slow_tripwires(_fail_slow_art(u_completed=False))
+    assert any("unmitigated" in p for p in probs)
+    probs = fail_slow_tripwires(_fail_slow_art(h_completed=False))
+    assert any("hedged" in p for p in probs)
+
+
+def test_slow_drain_requires_verdict_migration_and_story():
+    from ci.bench_regression import fail_slow_tripwires
+
+    probs = fail_slow_tripwires(_fail_slow_art(d_completed=False))
+    assert any("SLOW-DRAIN" in p for p in probs)
+    probs = fail_slow_tripwires(_fail_slow_art(d_clock=38))
+    assert any("lost steps" in p for p in probs)
+    probs = fail_slow_tripwires(_fail_slow_art(verdicts=0))
+    assert any("0 quorum slow verdicts" in p for p in probs)
+    probs = fail_slow_tripwires(_fail_slow_art(blocks_out=0))
+    assert any("0 blocks migrated" in p for p in probs)
+    probs = fail_slow_tripwires(_fail_slow_art(d_lost=3))
+    assert any("unrecovered" in p for p in probs)
+    probs = fail_slow_tripwires(_fail_slow_art(d_agree=False))
+    assert any("disagree" in p for p in probs)
+    probs = fail_slow_tripwires(_fail_slow_art(
+        events=("slow_suspect", "hedge_fired")))
+    assert any("flight boxes missing" in p for p in probs)
+
+
+def test_slow_idle_requires_bitwise_and_a_real_drill():
+    from ci.bench_regression import fail_slow_tripwires
+
+    probs = fail_slow_tripwires(_fail_slow_art(idle_equal=False))
+    assert any("SLOW-IDLE" in p for p in probs)
+    probs = fail_slow_tripwires(_fail_slow_art(idle_checked=0))
+    assert any("SLOW-IDLE" in p for p in probs)
+    # bitwise-equal with hedges fired = equal by luck, not by the floor
+    probs = fail_slow_tripwires(_fail_slow_art(idle_fired=3))
+    assert any("fired on a clean wire" in p for p in probs)
+
+
 def test_shape_mismatch_refuses_cross_shape_compare(capsys):
     prior = {"device_shape": "cpu:3", "metric": "m"}
     new = {"device_shape": "cpu:8", "metric": "m"}

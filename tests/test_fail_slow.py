@@ -1,0 +1,613 @@
+"""Fail-slow plane (obs/slowness.py + serve/hedge.py + the membership
+slow quorum + the rebalancer demote pass) — this PR's tentpole.
+
+Three layers of drill, mirroring the partition plane's test shape:
+
+- pure logic: MINIPS_SLOW / MINIPS_HEDGE spec parsing (+ seeded
+  fuzzers: parse or ValueError, never a half-configured plane), the
+  lower-median rule, and the SlownessMonitor judgment under an
+  injected clock — suspicion after N consecutive windows, retraction
+  on recovery, the 2-fleet/one-peer honest limit, the min_ms floor,
+  observer-stall forgiveness, and the slow-quorum reuse of
+  ``quorum_needed`` (a single complainer never convicts);
+- threads-as-nodes over real loopback buses with a seeded ``slow#``
+  link tax: hedged pull legs fire against replica holders, win, lose
+  by rid, stay budget-bounded, keep every read inside the admission
+  bound, and leave bitwise-agreeing finals — while the LATE loser
+  replies still feed the slowness monitor (the hedge must not erase
+  the evidence that indicts the sick rank);
+- armed-idle: the BSP lockstep drill with hedging armed on a clean
+  wire is BITWISE equal to off (the SLOW-IDLE claim), and a seeded
+  sub-threshold ``delay@`` latency arms nothing (the false-positive
+  ladder's first rung).
+
+The full quorum-verdict → demotion → flight-post-mortem story is
+pinned by the ``fail_slow_3proc`` bench sweep's SLOW-HEDGE /
+SLOW-DRAIN gates (ci/bench_regression.py) and the slow-tier drill at
+the bottom.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu.balance.control_plane import (SuspicionQuorum,
+                                              quorum_needed)
+from minips_tpu.obs.slowness import (SlownessConfig, SlownessMonitor,
+                                     lower_median)
+from minips_tpu.serve.hedge import HedgeConfig
+from minips_tpu.train.sharded_ps import ShardedPSTrainer, ShardedTable
+
+
+def _mk_buses(n, **kw):
+    from tests.conftest import mk_loopback_buses
+
+    return mk_loopback_buses(n, **kw)
+
+
+# ------------------------------------------------------------- configs
+def test_hedge_config_parses_and_refuses():
+    c = HedgeConfig.parse("delay_ms=30,factor=4,min_ms=10,budget=2")
+    assert (c.delay_ms, c.factor, c.min_ms, c.budget) == (30, 4, 10, 2)
+    d = HedgeConfig.parse("1")
+    assert d.delay_ms == 0 and d.budget >= 1 and d.min_ms > 0
+    assert HedgeConfig.parse("") is None
+    assert HedgeConfig.parse("0") is None
+    for bad, frag in {"explode=1": "unknown knob",
+                      "delay_ms": "k=v",
+                      "delay_ms=abc": "bad value",
+                      "min_ms=0": "min_ms",
+                      "factor=0.5": "factor",
+                      "budget=0": "budget",
+                      "delay_ms=-1": "delay_ms"}.items():
+        with pytest.raises(ValueError, match=frag):
+            HedgeConfig.parse(bad)
+
+
+def test_slow_config_parses_and_refuses():
+    c = SlownessConfig.parse("factor=2.5,windows=4,window=6,min_ms=5,"
+                             "min_samples=3,demote=8,drain_after=10,"
+                             "stall=1.5")
+    assert (c.factor, c.windows, c.window, c.min_ms, c.min_samples,
+            c.demote, c.drain_after, c.stall) \
+        == (2.5, 4, 6, 5, 3, 8, 10, 1.5)
+    d = SlownessConfig.parse("1")
+    assert d.factor > 1 and d.windows >= 1 and d.drain_after == 0
+    assert SlownessConfig.parse("") is None
+    assert SlownessConfig.parse("0") is None
+    for bad, frag in {"explode=1": "unknown knob",
+                      "factor": "k=v",
+                      "factor=abc": "bad value",
+                      "factor=1.0": "factor",
+                      "windows=0": "windows",
+                      "min_samples=0": "min_samples",
+                      "demote=0.5": "demote",
+                      "drain_after=-1": "drain_after",
+                      "stall=-1": "stall"}.items():
+        with pytest.raises(ValueError, match=frag):
+            SlownessConfig.parse(bad)
+
+
+def test_fail_slow_knob_fuzzers_parse_or_refuse_loudly():
+    """Satellite: the hedge/demote knob grammars share the chaos-spec
+    fuzzer contract — seeded random specs from the alphabet parse or
+    raise ValueError, deterministically, never a half-configured
+    plane."""
+    rng = np.random.default_rng(20260804)
+    keys = {"hedge": ["delay_ms", "factor", "min_ms", "budget",
+                      "bogus"],
+            "slow": ["factor", "windows", "window", "min_ms",
+                     "min_samples", "demote", "drain_after", "stall",
+                     "bogus"]}
+    vals = ["0", "1", "3", "2.5", "-1", "abc", "", "1e9", "0.5"]
+    parsers = {"hedge": HedgeConfig.parse, "slow": SlownessConfig.parse}
+    for which, parse in parsers.items():
+        vocab = keys[which]
+        for _ in range(200):
+            n = int(rng.integers(0, 5))
+            spec = ",".join(
+                f"{vocab[rng.integers(0, len(vocab))]}"
+                f"={vals[rng.integers(0, len(vals))]}"
+                for _ in range(n))
+            outcomes = []
+            for _rep in range(2):
+                try:
+                    c = parse(spec)
+                    outcomes.append(("ok", c is None))
+                except ValueError as e:
+                    outcomes.append(("refused", str(e)))
+                except Exception as e:  # noqa: BLE001 - the contract
+                    pytest.fail(f"{which} spec {spec!r} raised "
+                                f"{type(e).__name__}: {e}")
+            assert outcomes[0] == outcomes[1], spec
+
+
+def test_lower_median_anchors_on_the_healthy_half():
+    assert lower_median([]) is None
+    assert lower_median([5.0]) == 5.0
+    assert lower_median([1.0, 100.0]) == 1.0   # n=2: the healthy one
+    assert lower_median([1.0, 2.0, 100.0]) == 2.0
+    assert lower_median([1.0, 2.0, 3.0, 100.0]) == 2.0
+
+
+# ------------------------------------------------- detection judgment
+def _mk_monitor(nprocs=3, rank=0, clock=None, **kw):
+    cfg = SlownessConfig(**{"factor": 3.0, "windows": 2, "window": 2,
+                            "min_ms": 5.0, "min_samples": 2, **kw})
+    return SlownessMonitor(rank, nprocs, cfg,
+                           clock=clock or time.monotonic)
+
+
+def test_slowness_suspects_after_n_windows_and_retracts():
+    sm = _mk_monitor()
+    log: list = []
+    sm.on_slow = lambda p, s: log.append((p, s))
+    for _ in range(4):  # peer 1 slow (200ms), peer 2 healthy (1ms)
+        for _s in range(3):
+            sm.note(1, 0.200)
+            sm.note(2, 0.001)
+        sm.roll()
+    assert sm.suspects == {1}
+    assert log[-1] == (1, True)
+    assert sm.counters["suspects_raised"] == 1
+    # recovery: the suspect's window falls back under the bar — the
+    # suspicion RETRACTS (a slow verdict is never sticky)
+    for _ in range(4):
+        for _s in range(3):
+            sm.note(1, 0.001)
+            sm.note(2, 0.001)
+        sm.roll()
+    assert sm.suspects == set()
+    assert log[-1] == (1, False)
+    assert sm.counters["suspects_retracted"] == 1
+
+
+def test_one_consecutive_miss_resets_the_streak():
+    # window=1: each roll is judged alone, so the alternation below
+    # really does break the streak (a wider window would smear the
+    # slow samples across rolls — correct, but not this test's claim)
+    sm = _mk_monitor(windows=3, window=1)
+    for i in range(5):
+        for _s in range(3):
+            # peer 1 alternates slow/fast: the streak never reaches 3
+            sm.note(1, 0.200 if i % 2 == 0 else 0.001)
+            sm.note(2, 0.001)
+        sm.roll()
+    assert sm.suspects == set()
+
+
+def test_single_peer_fleet_never_suspects():
+    """The honest 2-fleet limit: one peer's p99 IS the median — no
+    relative signal exists, so the monitor never suspects (mirror of
+    the death quorum's 2-rank solo-conviction caveat, refused here
+    because slowness has no binary ground truth to fall back on)."""
+    sm = _mk_monitor(nprocs=2, rank=0)
+    for _ in range(6):
+        for _s in range(4):
+            sm.note(1, 0.500)  # absurdly slow — and still no verdict
+        sm.roll()
+    assert sm.suspects == set()
+
+
+def test_min_ms_floor_blocks_conviction():
+    """Relative slowness BELOW the absolute floor is noise, not gray
+    failure: 0.9ms vs 0.1ms is 9x the median and still healthy."""
+    sm = _mk_monitor(min_ms=20.0)
+    for _ in range(6):
+        for _s in range(4):
+            sm.note(1, 0.0009)
+            sm.note(2, 0.0001)
+        sm.roll()
+    assert sm.suspects == set()
+
+
+def test_no_evidence_retracts_standing_suspicion():
+    """A window with fewer than min_samples has no evidence — no
+    ballot: a standing suspicion retracts rather than coasting on
+    stale windows (the death path owns total silence)."""
+    sm = _mk_monitor()
+    for _ in range(3):
+        for _s in range(3):
+            sm.note(1, 0.200)
+            sm.note(2, 0.001)
+        sm.roll()
+    assert sm.suspects == {1}
+    for _ in range(3):  # evidence dries up entirely
+        sm.roll()
+    assert sm.suspects == set()
+
+
+def test_observer_stall_forgiveness_rebaselines_and_retracts():
+    now = [0.0]
+    sm = _mk_monitor(stall=1.0, clock=lambda: now[0])
+    for _ in range(3):
+        for _s in range(3):
+            sm.note(1, 0.200)
+            sm.note(2, 0.001)
+        now[0] += 0.1
+        sm.roll()
+    assert sm.suspects == {1}
+    # the observer comas for 5s: every sample it took is undateable —
+    # re-baseline, retract, count, judge nothing this boundary
+    for _s in range(3):
+        sm.note(1, 9.0)
+        sm.note(2, 9.0)
+    now[0] += 5.0
+    sm.roll()
+    assert sm.suspects == set()
+    assert sm.counters["stall_forgiven"] == 1
+    assert sm.stats()["streaks"] == {}
+
+
+def test_retract_all_mirrors_heartbeat_forgiveness():
+    sm = _mk_monitor()
+    log: list = []
+    sm.on_slow = lambda p, s: log.append((p, s))
+    for _ in range(3):
+        for _s in range(3):
+            sm.note(1, 0.200)
+            sm.note(2, 0.001)
+        sm.roll()
+    assert sm.suspects == {1}
+    sm.retract_all()
+    assert sm.suspects == set() and (1, False) in log
+    assert sm.counters["stall_forgiven"] == 1
+
+
+def test_heartbeat_stall_fires_slow_retraction_hook(monkeypatch):
+    """comm/heartbeat.py: a FORGIVEN sweep (the PR12 stall= window)
+    fires ``on_stall_forgiven`` — the membership plane wires it to
+    ``SlownessMonitor.retract_all`` so a coma observer's slow ballots
+    die with its death suspicions."""
+    from minips_tpu.comm.heartbeat import HeartbeatMonitor
+
+    monkeypatch.setenv("MINIPS_HEARTBEAT",
+                       "interval=0.5,timeout=2.0,stall=1.0")
+
+    class _Bus:
+        my_id = 0
+
+        def on(self, *a):
+            pass
+
+        def publish(self, *a, **k):
+            pass
+
+    now = [0.0]
+    mon = HeartbeatMonitor(_Bus(), [0, 1, 2], clock=lambda: now[0])
+    fired = []
+    mon.on_stall_forgiven = lambda: fired.append(True)
+    mon.check()          # baseline sweep
+    now[0] += 0.6
+    mon.check()          # normal cadence: no forgiveness
+    assert not fired
+    now[0] += 5.0        # coma past the stall budget
+    mon.check()
+    assert fired and mon.stall_forgiven == 1
+
+
+def test_exclude_drops_peer_and_retracts():
+    sm = _mk_monitor()
+    log: list = []
+    sm.on_slow = lambda p, s: log.append((p, s))
+    for _ in range(3):
+        for _s in range(3):
+            sm.note(1, 0.200)
+            sm.note(2, 0.001)
+        sm.roll()
+    assert sm.suspects == {1}
+    sm.exclude(1)
+    assert sm.suspects == set() and (1, False) in log
+    sm.note(1, 0.2)  # post-exclusion notes are dropped, not resurrected
+    sm.roll()
+    assert "1" not in sm.stats()["p99_ms"]
+
+
+def test_slow_quorum_single_complainer_never_convicts():
+    """The quorum rung (satellite false-positive ladder): the slow
+    verdict reuses the PR14 SuspicionQuorum + quorum_needed — one bad
+    inbound link makes ONE complainer, and one ballot out of a 3-rank
+    live view convicts nobody; the second corroborating ballot does."""
+    live = {0, 1, 2}
+    assert quorum_needed(live, 1) == 2
+    q = SuspicionQuorum(0)
+    q.mark_local(1, True)            # my ballot alone
+    assert q.convictable(live) == []
+    q.vote(2, [1])                   # the corroborating peer
+    assert q.convictable(live) == [1]
+    q.vote(2, [])                    # peer retracts (recovered)
+    assert q.convictable(live) == []
+
+
+# -------------------------------------------- hedged legs, in-proc
+class _Cons:
+    """Shared lockstep clock vector (the run_bsp_lockstep stub)."""
+
+    def __init__(self, clocks, rank, staleness=1):
+        self._clocks = clocks
+        self.rank = rank
+        self.staleness = staleness
+
+    @property
+    def clock(self):
+        return self._clocks[self.rank]
+
+    def admit_pull(self, clk):
+        return min(self._clocks) >= clk - self.staleness
+
+    def serving_clock(self, requester):
+        return min(self._clocks)
+
+
+def _run_fail_slow(n, body, *, chaos="", serve=None, hedge=None,
+                   slow=None, staleness=2, rows=96, dim=2, steps=18,
+                   pace=0.002):
+    """Threads-as-nodes trainer run with the fail-slow knobs passed
+    EXPLICITLY (no env) — the serving-harness shape of test_serve.py
+    plus hedge/slow."""
+    buses = _mk_buses(n, chaos=chaos)
+    tables = [ShardedTable("t", rows, dim, buses[i], i, n,
+                           updater="sgd", lr=1.0, pull_timeout=20.0)
+              for i in range(n)]
+    trainers = [ShardedPSTrainer({"t": tables[i]}, buses[i], n,
+                                 staleness=staleness, gate_timeout=30.0,
+                                 serve=serve, hedge=hedge, slow=slow)
+                for i in range(n)]
+    finals: list = [None] * n
+    errs: list = []
+
+    def worker(r):
+        try:
+            for i in range(steps):
+                body(r, tables[r], trainers[r], i)
+                trainers[r].tick()
+                if pace:
+                    time.sleep(pace)
+            trainers[r].finalize(timeout=30.0)
+            finals[r] = tables[r].pull_all()
+        except Exception as e:  # noqa: BLE001 - surfaced via errs
+            import traceback
+
+            traceback.print_exc()
+            errs.append((r, repr(e)))
+
+    try:
+        ts = [threading.Thread(target=worker, args=(r,))
+              for r in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120.0)
+        assert not any(t.is_alive() for t in ts), "run wedged"
+        assert not errs, errs
+        return tables, trainers, finals
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_hedged_pull_beats_slow_owner_and_agrees():
+    """The read-mitigation drill: rank 1's outbound frames pay a
+    seeded 80ms link tax (slow-but-alive: nothing dies, nothing
+    drops); with replicas + hedging armed, rank 0's legs to rank 1
+    hedge to the replica holder and WIN, every consumed read respects
+    the admission bound (stale_reads == 0), the slow owner's LATE
+    loser replies still feed the slowness monitor, and the finals
+    agree bitwise across all ranks."""
+    hot = 32 + np.arange(8, dtype=np.int64)  # rank 1's shard
+
+    def body(r, table, trainer, i):
+        table.pull(hot)
+        table.push(hot, np.ones((hot.size, table.dim), np.float32))
+
+    tables, trainers, finals = _run_fail_slow(
+        3, body, chaos="9:slow#1>0=80,slow#1>2=80",
+        serve="replicas=1,hot=16,interval=0,min_heat=2,lease=3.0",
+        hedge="delay_ms=20", slow="factor=3,windows=2,window=3,"
+                                  "min_ms=10,min_samples=2")
+    fired = sum(t.hedge_counters["fired"] for t in tables)
+    won = sum(t.hedge_counters["won"] for t in tables)
+    assert fired > 0, "no hedge ever fired against the slow owner"
+    assert won > 0, "no hedge ever won (holders refused everything?)"
+    for tr in trainers:
+        rep = tr.serve_stats()["replica"]
+        assert (rep or {}).get("stale_reads", 0) == 0
+        assert tr.wire_frames_lost == 0
+        assert tr.frames_dropped == 0
+    # the LATE loser replies fed the detector: rank 0 measured rank 1
+    # (cumulative per-peer summary — the drill is too short to demand
+    # a windowed conviction, which the 3proc bench arm pins)
+    sm0 = trainers[0].slowness
+    assert sm0 is not None
+    assert sm0.peer_summary(1)["count"] > 0, \
+        "hedging erased the slow owner's latency evidence"
+    np.testing.assert_array_equal(finals[0], finals[1])
+    np.testing.assert_array_equal(finals[0], finals[2])
+
+
+def test_hedge_budget_denies_and_no_holder_counts():
+    """White-box: the budget valve refuses a hedge when the table's
+    outstanding-hedge set is full (counted ``denied``), and a leg
+    whose blocks no holder covers counts ``no_holder`` and is never
+    re-probed. Uses a real slow leg held open by an 800ms link tax."""
+    from minips_tpu.serve.hedge import HedgeConfig as _HC
+    from minips_tpu.serve.plane import ServeConfig, TableServeState
+
+    buses = _mk_buses(2, chaos="5:slow#1>0=800")
+    clocks = [0, 0]
+    try:
+        ts = [ShardedTable("t", 64, 1, buses[i], i, 2,
+                           pull_timeout=10.0) for i in range(2)]
+        for i, t in enumerate(ts):
+            t.bind_consistency(_Cons(clocks, i, staleness=1))
+        t0 = ts[0]
+        t0.attach_hedge(_HC(delay_ms=1.0, budget=1))
+        t0._sv = TableServeState(t0, None, ServeConfig())  # no holders
+        fut = t0._issue_pull(np.array([40, 41], np.int64), 0)
+        time.sleep(0.02)  # the leg is now overdue (delay_ms=1)
+        t0._hedges_live.add(999999)  # budget exhausted by a twin
+        t0._maybe_hedge(fut._req)
+        assert t0.hedge_counters["denied"] == 1
+        assert t0.hedge_counters["fired"] == 0
+        t0._maybe_hedge(fut._req)   # a shed, not a queue: counted
+        assert t0.hedge_counters["denied"] == 1  # ONCE, never re-probed
+        t0._hedges_live.clear()     # (else the wait loop busy-wakes)
+        fut.wait()                  # the slow reply eventually lands
+        # a fresh overdue leg with budget free but NO holder coverage
+        # counts the no-replica ceiling, once
+        fut2 = t0._issue_pull(np.array([42, 43], np.int64), 0)
+        time.sleep(0.02)
+        t0._maybe_hedge(fut2._req)
+        assert t0.hedge_counters["no_holder"] == 1
+        t0._maybe_hedge(fut2._req)  # marked hedged: not re-probed
+        assert t0.hedge_counters["no_holder"] == 1
+        fut2.wait()
+        # NO serve plane attached at all: the overdue leg still takes
+        # the no_holder path — marked + counted, so the wait loop
+        # cannot busy-wake at the 1ms floor forever
+        t0._sv = None
+        fut3 = t0._issue_pull(np.array([44], np.int64), 0)
+        time.sleep(0.02)
+        t0._maybe_hedge(fut3._req)
+        assert t0.hedge_counters["no_holder"] == 2
+        fut3.wait()
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_armed_idle_hedge_is_bitwise_equal_to_off():
+    """SLOW-IDLE: hedging armed on a clean wire fires nothing (the
+    min_ms floor) and the run is bitwise-identical to off — the
+    lockstep harness, the same oracle every transport/fault layer
+    pins against."""
+    from tests.test_chaos_reliable import run_bsp_lockstep
+
+    w_off, _ = run_bsp_lockstep()
+    w_on, lost = run_bsp_lockstep(hedge="1")
+    assert lost == [0, 0]
+    for a, b in zip(w_off, w_on):
+        np.testing.assert_array_equal(a, b)  # bitwise, not allclose
+
+
+def test_sub_threshold_delay_arms_nothing():
+    """False-positive ladder: seeded ``delay@`` latency BELOW the
+    hedge threshold and the suspicion floor arms neither plane — no
+    hedges, no suspects, bitwise finals."""
+    hot = np.arange(8, dtype=np.int64)
+
+    def body(r, table, trainer, i):
+        table.pull(hot)
+        table.push(hot, np.ones((hot.size, table.dim), np.float32))
+
+    tables, trainers, finals = _run_fail_slow(
+        3, body, chaos="7:delay=1.0,delay_ms=4",
+        serve="replicas=1,hot=16,interval=0,min_heat=2,lease=3.0",
+        hedge="delay_ms=60",
+        slow="factor=3,windows=2,window=3,min_ms=30,min_samples=2")
+    assert sum(t.hedge_counters["fired"] for t in tables) == 0
+    for tr in trainers:
+        assert tr.slowness.suspects == set()
+        assert tr.slowness.counters["suspects_raised"] == 0
+    np.testing.assert_array_equal(finals[0], finals[1])
+    np.testing.assert_array_equal(finals[0], finals[2])
+
+
+def test_demote_pass_moves_sick_blocks_with_threshold_unreachable():
+    """balance/rebalancer.plan_assignment via the demote pass's
+    calling convention: a 3-rank fleet with EQUAL loads can never
+    clear a ratio threshold of 3 by biasing one rank (tops out at
+    3b/(2+b) < 3) — the demote pass's threshold-1.0 call with
+    sick-only candidates must still move the sick rank's blocks, and
+    plan_assignment's gap rule must bound it."""
+    from minips_tpu.balance.rebalancer import plan_assignment
+
+    loads = np.array([100.0, 100.0, 100.0])
+    cands = {7: (1, 30.0), 9: (1, 20.0), 3: (0, 25.0)}
+    # the heat pass at threshold=3: balanced fleet, nothing moves
+    assert plan_assignment(loads, dict(cands), 3.0, 8) == []
+    # the demote pass: bias rank 1 by 4, restrict to its candidates,
+    # threshold 1.0 — its hot blocks move off, none of rank 0's do
+    biased = loads.copy()
+    biased[1] *= 4.0
+    sick_only = {b: ih for b, ih in cands.items() if ih[0] == 1}
+    moves = plan_assignment(biased, sick_only, 1.0, 8)
+    assert moves and all(src == 1 for _b, src, _d in moves)
+    assert {b for b, *_ in moves} <= {7, 9}
+
+
+def test_wire_record_carries_fail_slow_blocks():
+    """Done-line schema: hedge/slowness are None when off (vs zeroed
+    when armed-but-idle) — the off-vs-idle convention."""
+    from minips_tpu.utils.metrics import wire_record
+
+    hot = np.arange(4, dtype=np.int64)
+
+    def body(r, table, trainer, i):
+        table.pull(hot)
+        table.push(hot, np.ones((hot.size, table.dim), np.float32))
+
+    _t, trainers, _f = _run_fail_slow(2, body, steps=3)
+    rec = wire_record(trainers[0])
+    assert rec["hedge"] is None and rec["slowness"] is None
+    _t, trainers, _f = _run_fail_slow(
+        2, body, steps=3, hedge="delay_ms=50", slow="1")
+    rec = wire_record(trainers[0])
+    assert rec["hedge"]["fired"] == 0 and rec["hedge"]["budget"] >= 1
+    assert rec["slowness"]["suspects"] == []
+    assert rec["slowness"]["rolls"] >= 3
+
+
+# ------------------------------------------------ slow tier: e2e drill
+@pytest.mark.slow
+def test_e2e_3proc_fail_slow_demote_drill():
+    """ACCEPTANCE (the bench demote arm's twin): a seeded slow# link
+    tax on rank 1, detection + hedging + demotion armed — the quorum
+    convicts the sick rank, the rebalancer migrates >= 1 hot block off
+    it, zero steps are lost, zero frames are unrecovered, and the
+    survivors' finals agree bitwise."""
+    import json
+    import sys
+
+    from minips_tpu import launch
+
+    env = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+           "MINIPS_CHAOS": "11:slow#1>0=40,slow#1>2=40~8",
+           "MINIPS_SERVE": ("replicas=1,hot=200,topk=200,"
+                            "interval=0.05,min_heat=1"),
+           "MINIPS_HEDGE": "delay_ms=15",
+           "MINIPS_SLOW": ("factor=3,windows=2,window=5,min_ms=15,"
+                           "min_samples=2,demote=4"),
+           "MINIPS_ELASTIC": "1",
+           "MINIPS_REBALANCE": ("block=2048,threshold=3,interval=0.3,"
+                                "min_heat=1"),
+           "MINIPS_HEARTBEAT": "interval=0.1,timeout=2.0",
+           "MINIPS_RELIABLE": "", "MINIPS_TRACE": "", "MINIPS_OBS": "",
+           "MINIPS_FLIGHT": "", "MINIPS_AUTOSCALE": "",
+           "MINIPS_BUS": "", "MINIPS_CHAOS_KILL": ""}
+    iters = 40
+    res = launch.run_local_job(
+        3, [sys.executable, "-m",
+            "minips_tpu.apps.sharded_ps_example",
+            "--model", "sparse", "--mode", "ssp", "--staleness", "2",
+            "--iters", str(iters), "--batch", "64",
+            "--storm-from", "2", "--storm-until", str(iters),
+            "--storm-pulls", "6", "--storm-keys", "64"],
+        base_port=None, env_extra=env, timeout=240.0)
+    assert all(d.get("event") == "done" for d in res), \
+        json.dumps([d.get("event") for d in res])
+    assert min(d["clock"] for d in res) == iters  # zero lost steps
+    assert sum(d.get("wire_frames_lost", 0) for d in res) == 0
+    assert len({d["param_sum"] for d in res}) == 1  # bitwise
+    assert sum((d.get("chaos") or {}).get("slowed", 0)
+               for d in res) > 0, "the injector never engaged"
+    assert sum((d.get("membership") or {}).get("slow_verdicts", 0)
+               for d in res) >= 1, "the quorum never convicted"
+    assert (res[1].get("rebalance") or {}).get("blocks_out", 0) >= 1, \
+        "no hot block migrated off the sick rank"
+    assert sum((d.get("hedge") or {}).get("fired", 0)
+               for d in res) > 0

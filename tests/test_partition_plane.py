@@ -81,8 +81,42 @@ def test_chaos_spec_parses_partition_entries():
 
 def test_chaos_spec_parses_slow_links():
     s = ChaosSpec.parse("7:slow#0-1=12.5,slow#2>0=3")
-    assert s.slow == [(0, 1, True, 12.5), (2, 0, False, 3.0)]
+    assert s.slow == [(0, 1, True, 12.5, 0.0), (2, 0, False, 3.0, 0.0)]
     assert s.active()
+
+
+def test_chaos_spec_parses_slow_jitter_term():
+    """Satellite: ``slow#a-b=<ms>~<jitter>`` — variance for fail-slow
+    drills; jitterless specs keep an exact 0.0 (byte-identical fates)."""
+    s = ChaosSpec.parse("7:slow#0-1=50~10")
+    assert s.slow == [(0, 1, True, 50.0, 10.0)]
+    # legacy 4-tuple constructor args normalize to jitter 0
+    s2 = ChaosSpec(7, {op: [] for op in ("drop", "dup", "delay",
+                                         "reorder")},
+                   slow=[(0, 1, True, 5.0)])
+    assert s2.slow == [(0, 1, True, 5.0, 0.0)]
+    for bad, frag in {"7:slow#0-1=50~-3": ">= 0",
+                      "7:slow#0-1=50~x": "float",
+                      "7:slow#0-1=~10": "float"}.items():
+        with pytest.raises(ValueError, match=frag):
+            ChaosSpec.parse(bad)
+
+
+def test_chaos_slow_jitter_is_deterministic_and_bounded():
+    """Each frame's jittered tax is a pure function of the frame
+    identity, within [ms - j, ms + j] clamped at 0 — and a jitterless
+    link keeps the exact fixed tax."""
+    cb = _stub_chaos("7:slow#0>1=20~15", my_id=1)
+    cb._slow_in = {0: (20.0, 15.0)}
+    taxes = []
+    for seq in range(64):
+        u = cb._u("slowj", 0, "b", seq)
+        tax = max(20.0 + (2.0 * u - 1.0) * 15.0, 0.0)
+        taxes.append(tax)
+        assert 5.0 <= tax <= 35.0
+        # determinism: the same identity re-draws the same tax
+        assert cb._u("slowj", 0, "b", seq) == u
+    assert len({round(t, 6) for t in taxes}) > 8  # variance is real
 
 
 def test_chaos_spec_partition_refusals_name_the_offense():
@@ -114,7 +148,11 @@ def test_chaos_spec_fuzzer_parses_or_refuses_loudly():
              "for", "slow#0-1", "slow#1>2", "slow#x", "delay_ms",
              "reorder_ms", "drop@psr", "drop#2", "bogus", "drop@ps#1"]
     vals = ["0.1", "1", "3", "0-2", "0-1+1-2", "2>0", "3s", "2-5",
-            "1.5", "-1", "abc", "", "0.5s", "9-4"]
+            "1.5", "-1", "abc", "", "0.5s", "9-4",
+            # the slow# jitter grammar (this PR): well-formed, torn,
+            # negative, and bare-tilde spellings must all parse or
+            # ValueError deterministically
+            "50~10", "50~", "~10", "50~-3", "50~x", "5~0"]
     for _ in range(400):
         seed = rng.integers(0, 100)
         n = int(rng.integers(0, 6))
