@@ -422,6 +422,131 @@ def fail_slow_arms(quick: bool = False) -> dict:
     return grid
 
 
+def hier_arms(quick: bool = False) -> dict:
+    """HIER-WIN / HIER-IDLE (the two-level push tree, balance/hier.py):
+    3 procs with host groups {0,1} | {2} — ranks 0 and 1 are co-host
+    workers whose owner-2 slices ride the tree; rank 2 is a singleton
+    (always flat, the degenerate clause). Both arms run the SAME seeded
+    sparse workload under topk8:
+
+    - ``hier``  (``group=2``):       member->leader exact contributions,
+      ONE compressed frame per owner per boundary from the leader;
+    - ``flat``  (``group=2,agg=0``): accounting-only — per-worker flat
+      frames with the SAME per-level byte classification, so the two
+      arms' ``l2_tx_bytes`` (the cross-host leader leg, summed over the
+      tree ranks 0+1) are like-for-like.
+
+    The win is overlap capture: co-host workers drawing zipf-skewed
+    keys hit mostly the SAME rows, and the leader ships the union once
+    instead of each worker shipping its own copy. The gate (HIER-WIN,
+    ci/bench_regression.py) wants flat/hier l2 bytes >= 1.7x and the
+    loss trajectories matching; the bitwise drills below are the
+    exactness legs (compression off: tree == flat bit-for-bit; armed-
+    idle == off bit-for-bit).
+
+    No alternating-median reps here, deliberately: the comparison is a
+    seeded BYTE count and a seeded loss stream (both bit-deterministic
+    given the workload seeds), not a rows/sec timing number — the
+    drifting-host honesty rules buy nothing, and rates from this sweep
+    are never published as throughput points."""
+    from minips_tpu import launch as _launch
+
+    h_iters = 25 if quick else 40
+    hbase = [sys.executable, "-m",
+             "minips_tpu.apps.sharded_ps_example",
+             "--model", "sparse", "--mode", "bsp",
+             # 256 rows / batch 128 x 14 nnz: each worker's draws
+             # cover most of owner 2's shard every step — the co-host
+             # overlap regime the tree exists for (one union frame vs
+             # two near-identical per-worker frames)
+             "--dim", "256", "--batch", "128",
+             "--iters", str(h_iters)]
+    env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+            "MINIPS_RELIABLE": "", "MINIPS_REBALANCE": "",
+            "MINIPS_TRACE": "", "MINIPS_SERVE": "",
+            "MINIPS_BUS": "", "MINIPS_WIRE_FMT": "",
+            "MINIPS_CHAOS": "", "MINIPS_CHAOS_KILL": "",
+            "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
+            "MINIPS_ELASTIC": "", "MINIPS_SLOW": "",
+            "MINIPS_HEDGE": "", "MINIPS_OBS": "",
+            "MINIPS_FLIGHT": "", "MINIPS_HEARTBEAT": "",
+            "MINIPS_PUSH_COMM": "topk8"}
+    grid: dict = {"iters": h_iters, "group": 2,
+                  "tree_ranks": [0, 1], "owner_rank": 2}
+
+    def arm(name: str, hier_spec: str) -> dict:
+        try:
+            res = _launch.run_local_job(
+                3, list(hbase), base_port=None,
+                env_extra={**env0, "MINIPS_HIER": hier_spec},
+                timeout=240.0)
+            hier = [d.get("hier") or {} for d in res]
+            sums = {d.get("param_sum") for d in res}
+            return {
+                "completed": all(d.get("event") == "done"
+                                 for d in res),
+                "hier_spec": hier_spec,
+                # the HIER-WIN observable: cross-host bytes/frames
+                # out of the multi-rank group (ranks 0+1 — rank 2's
+                # singleton sends stay flat in both arms and would
+                # dilute the comparison)
+                "l2_tx_bytes": sum(hier[r].get("l2_tx_bytes", 0)
+                                   for r in (0, 1)),
+                "l2_frames": sum(hier[r].get("l2_frames", 0)
+                                 for r in (0, 1)),
+                "l1_tx_bytes": sum(hier[r].get("l1_tx_bytes", 0)
+                                   for r in (0, 1)),
+                "agg_frames": sum(h.get("agg_frames", 0)
+                                  for h in hier),
+                "contribs": sum(h.get("contribs", 0) for h in hier),
+                "fallbacks": sum(h.get("fallbacks", 0) for h in hier),
+                # trajectory leg: same seeds, same draws — the arms'
+                # loss streams must tell the same story
+                "loss_first": res[0].get("loss_first"),
+                "loss_last": res[0].get("loss_last"),
+                "loss_last_by_rank": [d.get("loss_last") for d in res],
+                "finals_agree": len(sums) == 1,
+                "wire_frames_lost": sum(
+                    d.get("wire_frames_lost", 0) for d in res),
+            }
+        except Exception as e:  # noqa: BLE001 - completion-gated
+            return {"completed": False, "error": str(e)[:300]}
+
+    grid["hier"] = arm("hier", "group=2")
+    grid["flat"] = arm("flat", "group=2,agg=0")
+    hb, fb = (grid["hier"].get("l2_tx_bytes") or 0,
+              grid["flat"].get("l2_tx_bytes") or 0)
+    grid["l2_bytes_ratio"] = round(fb / hb, 3) if hb else None
+
+    # the exactness legs: compression-off tree bitwise == flat, and
+    # armed-idle bitwise == off (subprocess drills, stamp protocol)
+    def drill(flag: str) -> dict:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m",
+                 "minips_tpu.apps.sharded_ps_bench", flag],
+                capture_output=True, text=True, timeout=300.0,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env={**os.environ, "MINIPS_FORCE_CPU": "1",
+                     "JAX_PLATFORMS": "cpu", "MINIPS_MESH": "",
+                     "MINIPS_HIER": "", "MINIPS_PUSH_COMM": ""})
+            res = json.loads([ln for ln in proc.stdout.splitlines()
+                              if ln.startswith("{")][-1])
+            out = {"equal": bool(res.get("bitwise_equal")),
+                   "rows_checked": int(res.get("rows_checked", 0)),
+                   "agg_frames": res.get("agg_frames")}
+            if res.get("error"):
+                out["error"] = res["error"]
+            return out
+        except Exception as e:  # noqa: BLE001 - the gate reads this
+            return {"equal": False, "rows_checked": 0,
+                    "error": str(e)[:300]}
+
+    grid["bitwise"] = drill("--hier-bitwise-drill")
+    grid["idle"] = drill("--hier-idle-drill")
+    return grid
+
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()
@@ -1553,6 +1678,12 @@ def main() -> int:
     # the --fail-slow-idle-drill lockstep stamp.
     fail_slow_grid = fail_slow_arms(quick=args.quick)
 
+    # THE HIER SWEEP (this PR): the two-level push tree vs the flat
+    # per-worker wire on the same seeded zipf-overlap workload —
+    # HIER-WIN wants the tree's cross-host leader leg >= 1.7x fewer
+    # bytes with matching loss; the bitwise/idle drills pin exactness
+    hier_grid = hier_arms(quick=args.quick)
+
     # resolved JAX backend stamp (satellite): probed in a SUBPROCESS so
     # the driver never grabs the TPU out from under a worker (libtpu is
     # exclusive per process) — ci/bench_regression.py refuses to
@@ -1618,6 +1749,7 @@ def main() -> int:
         "control_plane_3proc": control_grid,
         "partition_3proc": partition_grid,
         "fail_slow_3proc": fail_slow_grid,
+        "hier_agg_3proc": hier_grid,
         "mesh_plane_fused": mesh_grid,
     }))
     return 0

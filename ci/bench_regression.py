@@ -90,6 +90,15 @@ four fail-slow flight events (slow_suspect/slow_verdict/hedge_fired/
 demote) present in the post-mortem boxes; the armed-idle lockstep
 drill must report bitwise-equal finals. Rates ride gate-invisible
 keys (``steps_per_sec_slow``).
+``hier_tripwires`` (HIER-WIN/HIER-IDLE) guards the ``hier_agg_3proc``
+sweep: the two-level push tree's arm must complete the same seeded
+zipf-overlap workload as the accounting-only flat arm with the tree
+provably engaged (aggregate frames + contributions, zero fallbacks),
+its cross-host leader-leg bytes >= 1.7x below the flat arm's, the
+loss trajectories matching, and both bitwise drills green — the
+compression-off tree equal to the flat wire bit-for-bit (with
+aggregation provably ON in the stamp), and armed-idle (group=1)
+equal to off bit-for-bit with zero aggregate frames.
 ``mesh_tripwires`` (MESH-WIN/MESH-BITWISE) guards the
 ``mesh_plane_fused`` sweep: the in-mesh collective plane's arm must
 beat the host-wire arm on rows/sec strictly (the data plane exists to
@@ -1062,6 +1071,106 @@ def fail_slow_tripwires(new: dict) -> list[str]:
     return problems
 
 
+def hier_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``hier_agg_3proc`` sweep
+    (the two-level topology-aware push tree, balance/hier.py);
+    vacuous when the sweep is absent.
+
+    - HIER-WIN: both arms (tree vs accounting-only flat, SAME seeded
+      workload) must complete with zero unrecovered frames and
+      bitwise-agreeing finals; the tree must have provably engaged
+      (``agg_frames`` > 0, ``contribs`` > 0, zero fallbacks on the
+      clean wire); the flat arm's cross-host leader-leg bytes must be
+      >= 1.7x the tree's (``l2_bytes_ratio`` — the whole point: one
+      union frame per host per owner instead of per-worker copies);
+      the arms' loss trajectories must match (within 5% at the last
+      window — aggregation relocates error feedback, it must not
+      change what the model learns); and the compression-off bitwise
+      drill must report equal finals with the tree provably on
+      (``agg_frames`` > 0 in the stamp).
+    - HIER-IDLE: the armed-idle drill (``MINIPS_HIER=1``, group=1 —
+      no pair in hier mode) must report bitwise-equal finals over
+      > 0 rows with ZERO aggregate frames — arming the layer may not
+      perturb one bit of a flat-topology run."""
+    grid = new.get("hier_agg_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    hier = grid.get("hier") or {}
+    flat = grid.get("flat") or {}
+    for name, a in (("hier", hier), ("flat", flat)):
+        if not a.get("completed"):
+            problems.append(
+                f"HIER-WIN hier_agg_3proc/{name}: completed="
+                f"{a.get('completed')!r} — both arms must finish on "
+                "the clean wire")
+        else:
+            if a.get("wire_frames_lost", 0):
+                problems.append(
+                    f"HIER-WIN hier_agg_3proc/{name}: "
+                    f"{a['wire_frames_lost']} unrecovered frames")
+            if not a.get("finals_agree"):
+                problems.append(
+                    f"HIER-WIN hier_agg_3proc/{name}: final tables "
+                    "disagree across ranks")
+    if hier.get("completed") and flat.get("completed"):
+        if not hier.get("agg_frames") or not hier.get("contribs"):
+            problems.append(
+                f"HIER-WIN hier_agg_3proc/hier: agg_frames="
+                f"{hier.get('agg_frames')!r} contribs="
+                f"{hier.get('contribs')!r} — the tree never engaged, "
+                "any byte win is mislabeled flat traffic")
+        if hier.get("fallbacks", 0):
+            problems.append(
+                f"HIER-WIN hier_agg_3proc/hier: {hier['fallbacks']} "
+                "fallbacks on a clean wire — the leader lane is sick "
+                "and the arms are not comparable")
+        ratio = grid.get("l2_bytes_ratio")
+        if not (isinstance(ratio, (int, float)) and ratio >= 1.7):
+            problems.append(
+                f"HIER-WIN hier_agg_3proc: l2_bytes_ratio={ratio!r} "
+                "< 1.7 — the leader leg is not earning its keep "
+                "(flat cross-host bytes / tree cross-host bytes)")
+        hl, fl = hier.get("loss_last"), flat.get("loss_last")
+        if not (isinstance(hl, (int, float))
+                and isinstance(fl, (int, float))
+                and abs(hl - fl) <= 0.05 * max(abs(fl), 1e-9)):
+            problems.append(
+                f"HIER-WIN hier_agg_3proc: loss_last {hl!r} (tree) vs "
+                f"{fl!r} (flat) diverge > 5% — aggregated error "
+                "feedback changed the trajectory")
+    bit = grid.get("bitwise") or {}
+    if not bit.get("equal") or not bit.get("rows_checked"):
+        problems.append(
+            f"HIER-WIN hier_agg_3proc/bitwise: equal="
+            f"{bit.get('equal')!r} rows_checked="
+            f"{bit.get('rows_checked')!r}"
+            + (f" error={bit.get('error')!r}" if bit.get("error")
+               else "")
+            + " — the compression-off tree must be bitwise-equal to "
+            "the flat wire")
+    elif not bit.get("agg_frames"):
+        problems.append(
+            "HIER-WIN hier_agg_3proc/bitwise: 0 aggregate frames in "
+            "the drill stamp — equal because the tree silently "
+            "disarmed, not because aggregation is exact")
+    idle = grid.get("idle") or {}
+    if not idle.get("equal") or not idle.get("rows_checked"):
+        problems.append(
+            f"HIER-IDLE hier_agg_3proc/idle: equal="
+            f"{idle.get('equal')!r} rows_checked="
+            f"{idle.get('rows_checked')!r}"
+            + (f" error={idle.get('error')!r}" if idle.get("error")
+               else "")
+            + " — armed-idle (group=1) must be bitwise-equal to off")
+    elif idle.get("agg_frames", 0):
+        problems.append(
+            f"HIER-IDLE hier_agg_3proc/idle: {idle['agg_frames']} "
+            "aggregate frames fired under group=1 — armed-IDLE means "
+            "no pair is ever in hier mode")
+    return problems
+
+
 def mesh_tripwires(new: dict) -> list[str]:
     """Absolute (prior-free) gates on the ``mesh_plane_fused`` sweep
     (the in-mesh collective data plane, train/mesh_plane.py); vacuous
@@ -1243,7 +1352,7 @@ def main(argv: list[str] | None = None) -> int:
                 + serve_tripwires(new) + elastic_tripwires(new)
                 + control_plane_tripwires(new)
                 + partition_tripwires(new) + fail_slow_tripwires(new)
-                + mesh_tripwires(new))
+                + hier_tripwires(new) + mesh_tripwires(new))
     pts = throughput_points(new)
     print(f"bench-regression: {len(pts)} throughput points checked "
           f"against {len(throughput_points(prior))} prior")

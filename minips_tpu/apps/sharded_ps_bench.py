@@ -121,6 +121,46 @@ def _run_fail_slow_idle_drill() -> int:
     return 0 if out["bitwise_equal"] else 1
 
 
+def _run_hier_drill(hier_spec: str) -> int:
+    """HIER-IDLE / HIER-WIN bitwise leg: the 3-rank hier lockstep drill
+    (tests/test_hier.run_hier_lockstep — host groups {0,1} | {2},
+    disjoint keysets, exact f32 wire) with ``hier_spec`` armed vs off.
+    Armed-idle (``"1"``) and the full tree (``"group=2"``) must BOTH be
+    bitwise equal to off: the tree re-lanes identical exact
+    contributions, it never changes the math. Emits one JSON stamp
+    line; failures report ``bitwise_equal: false`` so the CI gate fails
+    loudly instead of silently skipping."""
+    out = {"event": "drill", "hier_spec": hier_spec,
+           "bitwise_equal": False, "rows_checked": 0,
+           "agg_frames": None, "l2_frames": None}
+    try:
+        import minips_tpu
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(minips_tpu.__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from tests.test_hier import run_hier_lockstep
+
+        w_off, lost_off = run_hier_lockstep("")
+        st: dict = {}
+        w_on, lost_on = run_hier_lockstep(hier_spec, stats=st)
+        eq = all(np.array_equal(a, b) for a, b in zip(w_off, w_on))
+        out.update({
+            "bitwise_equal": bool(eq)
+            and lost_off == lost_on == [0, 0, 0],
+            "rows_checked": int(sum(a.shape[0] for a in w_off)),
+            # evidence the armed lane really ran (or really idled):
+            # the gate checks the counters, not just the verdict
+            "agg_frames": st.get("agg_frames"),
+            "l2_frames": st.get("l2_frames"),
+        })
+    except Exception as e:  # noqa: BLE001 - the gate reads the stamp
+        out["error"] = repr(e)[:300]
+    print(json.dumps(out), flush=True)
+    return 0 if out["bitwise_equal"] else 1
+
+
 def _run_mesh(args) -> int:
     """The in-mesh collective data plane bench: one process, ``--mesh-
     ranks`` logical ranks as threads over as many devices, pushes/pulls
@@ -333,6 +373,18 @@ def main(argv=None) -> int:
                          "off on a clean wire and emit its bitwise "
                          "stamp (the artifact's SLOW-IDLE input: "
                          "armed-but-idle must equal off bit-for-bit)")
+    ap.add_argument("--hier-idle-drill", action="store_true",
+                    help="run the 3-rank hier lockstep drill armed-"
+                         "idle (MINIPS_HIER=1, group=1 — no pair in "
+                         "hier mode) vs off and emit its bitwise "
+                         "stamp (the artifact's HIER-IDLE input)")
+    ap.add_argument("--hier-bitwise-drill", action="store_true",
+                    help="run the 3-rank hier lockstep drill with the "
+                         "full tree (group=2, compression off) vs off "
+                         "and emit its bitwise stamp (HIER-WIN's "
+                         "exactness leg: aggregation re-lanes exact "
+                         "contributions, bitwise equal by "
+                         "construction)")
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="write this rank's wire trace (Chrome-trace "
                          "JSON, obs/tracer.py) into DIR — the flag "
@@ -349,6 +401,10 @@ def main(argv=None) -> int:
         return _run_mesh_drill()
     if args.fail_slow_idle_drill:
         return _run_fail_slow_idle_drill()
+    if args.hier_idle_drill:
+        return _run_hier_drill("1")
+    if args.hier_bitwise_drill:
+        return _run_hier_drill("group=2")
     if plane_kind == "mesh":
         if args.storm or args.overlap or args.cache_bytes \
                 or args.serve or args.compute != "none":

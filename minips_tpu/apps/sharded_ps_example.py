@@ -420,6 +420,10 @@ def main(argv=None) -> int:
             "autoscale_spec": os.environ.get("MINIPS_AUTOSCALE") or None,
             "chaos_kill_spec": os.environ.get("MINIPS_CHAOS_KILL")
             or None,
+            # hier-tree echo: the leader-death drill asserts the tree
+            # it thinks it ran really ran (wire_record carries the
+            # per-level counters themselves)
+            "hier_spec": os.environ.get("MINIPS_HIER") or None,
             "wall_s": round(time.monotonic() - t0, 4),
             "loss_first": losses[0] if losses else None,
             "loss_last": float(np.mean(losses[-5:])) if losses else None,

@@ -354,3 +354,25 @@ def quantized_psum_scatter(gpad: jnp.ndarray, axis_name: str,
     reduced, _ = a2a_reduce(gpad.reshape(n, -1), axis_name, comm,
                             block=block)
     return reduced
+
+
+def quantized_psum_scatter_ef(gpad: jnp.ndarray, axis_name: str,
+                              comm: str = "float32", *,
+                              block: int = BLOCK
+                              ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`quantized_psum_scatter` with the error-feedback hook kept:
+    also returns this device's compression RESIDUAL — input minus what
+    :func:`a2a_reduce` actually shipped after quantization, reshaped to
+    ``gpad``'s layout so the caller can fold it into its next
+    contribution (the leader-side ResidualStore contract,
+    train/sharded_ps.py, now shared by the mesh plane's blk8 reduce
+    leg). ``float32`` ships exactly, so its residual is exact zeros —
+    one signature, the caller never branches on the codec."""
+    _check(comm)
+    if comm == "float32":
+        return (jax.lax.psum_scatter(gpad, axis_name, tiled=True),
+                jnp.zeros_like(gpad))
+    n = _axis_size(axis_name)
+    chunks = gpad.reshape(n, -1)
+    reduced, sent = a2a_reduce(chunks, axis_name, comm, block=block)
+    return reduced, (chunks - sent).reshape(gpad.shape)

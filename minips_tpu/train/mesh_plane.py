@@ -176,10 +176,21 @@ class MeshTable:
         # ranks — ring cost (n-1)/n of the buffer each way, codes+scales
         # for the blk8 tier (blockwise_stream_bytes is the shared bill)
         self.collective_bytes = 0
+        # blk8 error feedback (plane.mesh_ef): each device's quantization
+        # residual from the reduce leg — input minus what a2a_reduce
+        # actually shipped — retained host-side and folded into the next
+        # wave's contribution, with an exact-f32 repayment wave at
+        # finalize: the wire ResidualStore's fold/flush contract
+        # (train/sharded_ps.py) on the collective transport
+        self._rbuf = (np.zeros((n, self.padded, self.dim), np.float32)
+                      if plane.mesh_ef else None)
+        self._fence_fn = None  # exact repayment program, built lazily
+        self.ef_waves = 0        # waves that folded + re-captured resid
+        self.ef_fence_waves = 0  # exact repayment waves (finalize)
         self._wave_fn = self._build_wave_fn()
 
     # ------------------------------------------------------------ wave
-    def _build_wave_fn(self):
+    def _build_wave_fn(self, *, exact: bool = False):
         """One jitted XLA program per table — THE collective data plane:
         reduce-scatter the stacked rank deposits (push), run the updater
         on the owner shard (sharded server math — no replicated
@@ -191,7 +202,8 @@ class MeshTable:
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
-        from minips_tpu.ops.quantized_comm import quantized_psum_scatter
+        from minips_tpu.ops.quantized_comm import (
+            quantized_psum_scatter, quantized_psum_scatter_ef)
         from minips_tpu.utils import jaxcompat
 
         dim = self.dim
@@ -201,43 +213,61 @@ class MeshTable:
         b2 = np.float32(self.beta2)
         one_m_b1 = np.float32(1) - b1
         one_m_b2 = np.float32(1) - b2
-        comm, block = self.plane.comm, self.plane.block
+        comm = "float32" if exact else self.plane.comm
+        block = self.plane.block
+        # EF only rides the lossy leg: the exact (fence) program ships
+        # f32 and must NOT re-capture a residual — it repays one
+        ef = bool(self.plane.mesh_ef and comm == "blk8")
         upd = self.updater
         S = P(MESH_AXIS)
 
         def _reduce(g_mine):
             # g_mine [padded, dim]: my rank's full-row-space contribution;
-            # the reduce-scatter leaves me the summed rows I own
+            # the reduce-scatter leaves me the summed rows I own. Second
+            # return is this device's compression residual (EF mode) —
+            # what the quantizer did NOT ship, folded into the next wave
             if comm == "float32":
                 return jax.lax.psum_scatter(
-                    g_mine, MESH_AXIS, scatter_dimension=0, tiled=True)
+                    g_mine, MESH_AXIS, scatter_dimension=0,
+                    tiled=True), None
+            if ef:
+                red, resid = quantized_psum_scatter_ef(
+                    g_mine.reshape(-1), MESH_AXIS, comm="int8",
+                    block=block)
+                return red.reshape(-1, dim), resid.reshape(g_mine.shape)
             red = quantized_psum_scatter(
                 g_mine.reshape(-1), MESH_AXIS, comm="int8", block=block)
-            return red.reshape(-1, dim)
+            return red.reshape(-1, dim), None
+
+        def _out(full, resid):
+            # resid rides out stacked over the shard axis ([1,...] per
+            # device -> [n,...]); non-EF programs keep the bare-full
+            # output shape so their jitted artifacts are untouched
+            return (full, resid[None]) if ef else full
 
         if upd == "sgd":
             def body(w, g_stack):
-                g = _reduce(g_stack[0])
+                g, resid = _reduce(g_stack[0])
                 w = w - lr * g
                 full = jax.lax.all_gather(w, MESH_AXIS, axis=0,
                                           tiled=True)
-                return (w,), full
+                return (w,), _out(full, resid)
             n_state = 1
         elif upd == "adagrad":
             def body(w, acc, g_stack):
-                g = _reduce(g_stack[0])
+                g, resid = _reduce(g_stack[0])
                 acc = acc + g * g
                 w = w - lr * g / (jnp.sqrt(acc) + eps)
                 full = jax.lax.all_gather(w, MESH_AXIS, axis=0,
                                           tiled=True)
-                return (w, acc), full
+                return (w, acc), _out(full, resid)
             n_state = 2
         else:
             def body(w, m, v, steps, g_stack, t_stack):
                 # lazy adam: the touch-mask reduce keeps untouched rows'
                 # moments and step counters frozen, matching the wire's
                 # per-key server semantics (sharded_ps._adam_rows)
-                g = _reduce(g_stack[0])
+                g, resid = _reduce(g_stack[0])
                 t = jax.lax.psum_scatter(
                     t_stack[0], MESH_AXIS, scatter_dimension=0,
                     tiled=True)
@@ -254,16 +284,46 @@ class MeshTable:
                     w - lr * (m / bc1) / (jnp.sqrt(v / bc2) + eps), w)
                 full = jax.lax.all_gather(w, MESH_AXIS, axis=0,
                                           tiled=True)
-                return (w, m, v, steps), full
+                if ef:
+                    # rows NO rank touched this wave skip the update
+                    # entirely — shipped mass for them is discarded by
+                    # the where, so the residual keeps the FULL input
+                    # (nothing landed), not input - sent; without this
+                    # a residual-only row would leak its mass
+                    mask_full = jax.lax.all_gather(
+                        mask, MESH_AXIS, axis=0, tiled=True)
+                    resid = jnp.where(mask_full[:, None], resid,
+                                      g_stack[0])
+                return (w, m, v, steps), _out(full, resid)
             n_state = 4
 
-        n_in = n_state + (2 if upd == "adam" else 1)
+        if ef:
+            # the retained residual stays a DEVICE array between waves
+            # (r_stack, last input): folding on device instead of a
+            # host-side _gbuf + _rbuf add keeps the wave's hot path
+            # free of a full-buffer device->host->device round trip
+            # per wave — the residual only ever crosses to the host
+            # for the one-time fence and the stats probe
+            inner = body
+            if upd == "adam":
+                def body(w, m, v, steps, g_stack, t_stack, r_stack):
+                    return inner(w, m, v, steps, g_stack + r_stack,
+                                 t_stack)
+            elif upd == "adagrad":
+                def body(w, acc, g_stack, r_stack):
+                    return inner(w, acc, g_stack + r_stack)
+            else:
+                def body(w, g_stack, r_stack):
+                    return inner(w, g_stack + r_stack)
+
+        n_in = n_state + (2 if upd == "adam" else 1) + (1 if ef else 0)
         # check_vma/check_rep off: the all-gathered output is replicated
         # by construction, but older checkers cannot infer it through
         # the quantized a2a path
         mapped = jaxcompat.shard_map(
             body, mesh=self.plane.mesh, in_specs=(S,) * n_in,
-            out_specs=((S,) * n_state, P()), check_vma=False)
+            out_specs=((S,) * n_state, ((P(), S) if ef else P())),
+            check_vma=False)
         return jax.jit(mapped, donate_argnums=tuple(range(n_state)))
 
     def _deposit(self, rank: int, keys: np.ndarray,
@@ -296,25 +356,63 @@ class MeshTable:
         self._dirty[rank] = True
         self.rows_pushed += self.num_rows
 
-    def _wave_locked(self) -> None:
+    def _wave_locked(self, *, fence: bool = False) -> None:
         """One apply wave: ship the pre-stacked deposits (clean ranks
         contribute exact zeros), reduce-scatter + sharded update +
         all-gather in one jitted program, refresh the pull mirror, zero
-        the dirty rows. Caller holds the plane lock."""
+        the dirty rows. EF mode folds the retained residual into the
+        input and re-captures the wave's new residual; ``fence=True``
+        swaps in the exact-f32 program (built lazily — the repayment
+        wave at finalize, after which the residual is zero by
+        construction). Caller holds the plane lock."""
         import jax
 
         t_wave0 = time.monotonic()
-        g_stack = jax.device_put(self._gbuf, self._stack_sh)
+        ef = self._rbuf is not None
+        g_in = self._gbuf
+        if ef and fence:
+            # the exact program has no r_stack input — fold the
+            # residual on the host for this one-time repayment wave
+            g_in = self._gbuf + np.asarray(self._rbuf)
+        t_in = self._tstack
+        fn = self._wave_fn
+        extra = ()
+        if ef and not fence:
+            # residual rides as a device-resident input (a no-op put
+            # when it is last wave's output, already stack-sharded)
+            extra = (jax.device_put(self._rbuf, self._stack_sh),)
+        if fence:
+            if self._fence_fn is None:
+                self._fence_fn = self._build_wave_fn(exact=True)
+            fn = self._fence_fn
+            if ef and t_in is not None:
+                # the fence repays residual as a real (exact) push:
+                # residual-only rows must pass the lazy-adam touch mask,
+                # exactly like the wire's f32 residual fence arrives as
+                # a normal push frame and advances server state
+                t_in = np.maximum(
+                    t_in, (np.abs(g_in).sum(axis=-1) > 0
+                           ).astype(np.float32))
+        g_stack = jax.device_put(g_in, self._stack_sh)
         if self.updater == "sgd":
-            (self._w,), full = self._wave_fn(self._w, g_stack)
+            (self._w,), out = fn(self._w, g_stack, *extra)
         elif self.updater == "adagrad":
-            (self._w, self._acc), full = self._wave_fn(
-                self._w, self._acc, g_stack)
+            (self._w, self._acc), out = fn(self._w, self._acc,
+                                           g_stack, *extra)
         else:
-            t_stack = jax.device_put(self._tstack, self._stack_sh)
-            (self._w, self._m, self._v, self._steps), full = \
-                self._wave_fn(self._w, self._m, self._v, self._steps,
-                              g_stack, t_stack)
+            t_stack = jax.device_put(t_in, self._stack_sh)
+            (self._w, self._m, self._v, self._steps), out = \
+                fn(self._w, self._m, self._v, self._steps,
+                   g_stack, t_stack, *extra)
+        if ef and not fence:
+            full, resid = out
+            self._rbuf = resid  # stays on device until fence/stats
+            self.ef_waves += 1
+        else:
+            full = out
+            if ef:
+                self._rbuf = np.zeros_like(self._gbuf)
+                self.ef_fence_waves += 1
         mirror = np.asarray(full)
         mirror.setflags(write=False)
         self._mirror = mirror
@@ -413,6 +511,19 @@ class MeshTable:
             lo = rank * self.shard_rows
             hi = min(lo + self.shard_rows, self.num_rows)
             return self._mirror[lo:hi].copy()
+
+    def ef_stats(self) -> Optional[dict]:
+        """blk8 error-feedback accounting — None when EF is off (the
+        off-vs-idle convention every wire stats block keeps); resident
+        rows are the residual mass currently awaiting its next fold."""
+        if self._rbuf is None:
+            return None
+        return {
+            "folded_waves": int(self.ef_waves),
+            "fence_waves": int(self.ef_fence_waves),
+            "resident_rows": int(
+                (np.abs(self._rbuf).sum(axis=-1) > 0).sum()),
+        }
 
     def local_bytes(self) -> int:
         """Device bytes of table + updater state PER SHARD — the same
@@ -520,6 +631,14 @@ class MeshPlane:
         # the quantized tier defaults to the HOST wire's block size:
         # one codec (blockwise absmax), two transports
         self.block = int(HOST_BLOCK if block is None else block)
+        # error feedback on the blk8 reduce leg (default ON): each
+        # device retains its quantization residual and folds it into
+        # the next wave — unbiased in the limit, exact repayment at
+        # finalize. MINIPS_MESH_EF=0 is the kill switch (A/B arm);
+        # float32 ships exactly, nothing to feed back
+        self.mesh_ef = (comm == "blk8"
+                        and os.environ.get("MINIPS_MESH_EF",
+                                           "1").strip() != "0")
         self.gate_timeout = float(gate_timeout)
         self.mesh = Mesh(np.array(devs[: self.num_ranks]), (MESH_AXIS,))
         self._rep_sh = NamedSharding(self.mesh, P())
@@ -728,6 +847,18 @@ class MeshPlane:
                 self._clk_host[rank] = RETIRED_CLOCK
                 self._clk_dev = self._clk_dev.at[rank].set(
                     RETIRED_CLOCK)
+                if self._retired.all():
+                    # LAST rank out repays the blk8 EF residual with one
+                    # exact-f32 fence wave per table that still holds
+                    # mass — nobody deposits after this point, and the
+                    # finalize barrier below means every rank returns
+                    # AFTER the repayment refreshed the mirror: no
+                    # gradient mass is stranded in the residual at exit
+                    # (the wire ResidualStore's fence contract)
+                    for t in self.tables.values():
+                        if (t._rbuf is not None
+                                and np.any(t._rbuf)):
+                            t._wave_locked(fence=True)
                 self._cond.notify_all()
                 deadline = time.monotonic() + timeout
                 while not self._retired.all():
@@ -756,6 +887,12 @@ class MeshPlane:
             "waves": {n: t.waves for n, t in self.tables.items()},
             "collective_bytes": sum(t.collective_bytes
                                     for t in self.tables.values()),
+            # blk8 reduce-leg error feedback: None when off
+            # (float32 plane or MINIPS_MESH_EF=0), per-table
+            # fold/fence/resident accounting when armed
+            "ef": ({n: t.ef_stats()
+                    for n, t in self.tables.items()}
+                   if self.mesh_ef else None),
             "gate_waits": self.gate_waits,
             # step-phase hists + windowed layer, the wire trainer's
             # hist/window done-line convention ({"count": 0} idle,

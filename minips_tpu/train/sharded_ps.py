@@ -212,8 +212,8 @@ from typing import Optional
 import numpy as np
 
 from minips_tpu.comm.bus import ClockGossip
-from minips_tpu.consistency.gate import (PeerFailureError, StalenessGate,
-                                         admits)
+from minips_tpu.consistency.gate import (RETIRED_CLOCK, PeerFailureError,
+                                         StalenessGate, admits)
 from minips_tpu.obs import flight as _fl
 from minips_tpu.obs import tracer as _trc
 from minips_tpu.obs import window as _ow
@@ -895,6 +895,7 @@ class ShardedTable:
         # quantization noise stream: per-(seed, rank) so reruns are
         # deterministic and ranks draw independent rounding noise
         self._q_rng = np.random.default_rng((seed, rank, 0x9e37))
+        self._seed = int(seed)  # hier leader lane derives its own rng
         self.part = RangePartitioner(self.num_rows, num_processes)
         self.shard_lo = rank * self.part.shard_size
         # ---- heat-aware rebalancing (balance/; OFF unless a Rebalancer
@@ -968,6 +969,50 @@ class ShardedTable:
                                ("fired", "won", "lost", "no_holder",
                                 "denied")}
         self._fence_t0: dict[int, float] = {}  # block -> fence start
+        # ---- hierarchical push tree (balance/hier.py; OFF unless the
+        # trainer attaches a HierConfig). Member side: the elected
+        # leader, the unacked retained window (re-pushed on fallback),
+        # and the direct-mode latch. Leader side: per-owner buckets of
+        # member contributions plus per-member boundary floors — the
+        # flush trigger is the GROUP-MIN floor advancing, so whichever
+        # boundary frame completes a step (training thread or recv
+        # thread) ships exactly one aggregated frame per owner. Owner
+        # side: per-contributor floors folded into pull admission.
+        # _hier_lock guards all of it; _hier_flush_lock serializes the
+        # flush critical section (snapshot + sends) so a later flush's
+        # floor claim can never overtake an earlier flush's mass.
+        self._hier = None                    # balance.hier.HierConfig
+        self._hier_lock = threading.Lock()
+        self._hier_flush_lock = threading.Lock()
+        self._hier_floor: dict[int, int] = {}         # owner side
+        self._hier_leader: Optional[int] = None       # member side
+        self._hier_retained: list[tuple] = []  # (step, owner, keys, g)
+        self._hier_direct = False            # fallback latch
+        self._hier_buckets: dict[int, list] = {}      # leader side
+        self._hier_member_floor: dict[int, int] = {}
+        self._hier_own_floor = 0
+        self._hier_flushed_floor = 0
+        self._hier_members: list[int] = []
+        self._hier_cross: list[int] = []
+        self._hier_group: list[int] = []
+        self._hier_shunned: Optional[int] = None  # leader I fell back from
+        self._hier_expelled: set[int] = set()     # members gone direct
+        self._hier_claimed: dict[int, int] = {}   # floors I flushed
+        self._hier_xa: Optional[int] = None       # expel-ack floor
+        self._hier_host_of = None
+        self._hier_elect_fn = None
+        # leader-lane EF: a DEDICATED store + rng — flushes can fire
+        # from the recv thread (a member boundary completes the step),
+        # and sharing _ef/_q_rng with the training thread's flat-path
+        # encodes would race both the slab and the rng stream
+        self._hier_ef = None
+        self._hier_rng = None
+        self.hier_counters = {k: 0 for k in (
+            "l1_tx_bytes", "l1_frames", "l2_tx_bytes", "l2_frames",
+            "agg_frames", "agg_rows", "floor_frames", "contribs",
+            "elections", "fallbacks", "repushed_steps", "repush_drops",
+            "stale_leader_drops")}
+        self.hist_hier = Log2Histogram()     # leader flush latency
         # ---- server shard: ONLY my row range lives here (the 1/N memory
         # claim, materialization included — a multi-GB Criteo table must
         # never exist whole on any host); per-(seed, rank) stream keeps
@@ -1204,6 +1249,60 @@ class ShardedTable:
         table with no serving plane attached simply never finds a
         holder (counted ``no_holder``, the documented honest limit)."""
         self._hedge = cfg
+
+    def attach_hier(self, cfg) -> None:
+        """Arm the two-level push tree (balance/hier.py, MINIPS_HIER).
+        Refusals here mirror the ctor's validation ladder: the retained
+        window is the fallback's replay source, so any path that lets
+        pushes leave the table without passing ``_push_now`` — the
+        async push window re-frames sends on the flush thread, and the
+        RowCache turns pulls into local reads the floor wait cannot
+        see — would break the zero-lost-steps contract. With
+        ``group=1`` (armed-idle) no pair is ever in hier mode and the
+        push path is bitwise the flat wire."""
+        from minips_tpu.balance.hier import elect, group_ranks, host_of
+        if cfg is None:
+            return
+        if self.async_push:
+            raise ValueError(
+                "MINIPS_HIER is incompatible with async_push/"
+                "MINIPS_PUSH_WINDOW: the hier retained window replays "
+                "exact member contributions on leader fallback, and "
+                "the async window re-frames sends outside that "
+                "bookkeeping — pick one push discipline")
+        if self._cache is not None:
+            raise ValueError(
+                "MINIPS_HIER is incompatible with the client RowCache "
+                "(MINIPS_CACHE_BYTES): cached reads bypass the owner's "
+                "per-contributor floor wait, so a cache hit could "
+                "observe a staleness bound the hier floors have not "
+                "certified yet")
+        self._hier = cfg
+        self._hier_host_of = lambda r: host_of(r, cfg.group)
+        self._hier_elect_fn = elect
+        g, n = cfg.group, self.num_processes
+        self._hier_group = group_ranks(self.rank, g, n)
+        self._hier_members = [r for r in self._hier_group
+                              if r != self.rank]
+        self._hier_cross = [r for r in range(n)
+                            if host_of(r, g) != host_of(self.rank, g)]
+        if cfg.agg and g > 1:
+            # owner side: pre-register a floor of 0 for every cross-
+            # group contributor from a multi-rank group BEFORE any
+            # frame flows — an empty floor dict must mean "no hier
+            # contributors", never "none heard from yet", or the
+            # admission gate would ignore them at startup
+            self._hier_floor = {
+                r: 0 for r in self._hier_cross
+                if len(group_ranks(r, g, n)) >= 2}
+            self._hier_member_floor = {r: 0 for r in self._hier_members}
+            if self.push_comm in ("topk8", "topk4"):
+                self._hier_ef = ResidualStore(self.dim)
+            self._hier_rng = np.random.default_rng(
+                (self._seed, self.rank, 0x48e5))
+            if self.bus is not None:
+                self.bus.on(f"psH:{self.name}", self._on_hier)
+        self._hier_leader = self._hier_elect()
 
     def bind_slowness(self, sm) -> None:
         """Feed the fail-slow detector (obs/slowness.py): pull-leg
@@ -1948,6 +2047,24 @@ class ShardedTable:
         tr = _trc.TRACER
         if not self._check_peer_config(sender, payload):
             return
+        if self._hier is not None and self._hier_floor:
+            # stale-leader fence: an aggregated frame (it carries hfr
+            # floor claims) from a sender the quorum has since convicted
+            # must be dropped WHOLE — its members re-push that mass on
+            # fallback, so applying the zombie copy would double-apply
+            if "hfr" in payload and sender in (
+                    self._excluded_ranks() | self._dead_ranks):
+                self.hier_counters["stale_leader_drops"] += 1
+                return
+            # fallback re-push dedup: the step tag rides the exact f32
+            # frame; tags below the floor the (now dead) leader already
+            # delivered were applied via its last flush — exactly-once
+            # across the handoff
+            hst = payload.get("hst")
+            if hst is not None and int(hst) < self._hier_floor.get(
+                    sender, 0):
+                self.hier_counters["repush_drops"] += 1
+                return
         # frames self-describe their wire format, so a mixed fleet (one
         # pusher compressed, another not) decodes correctly per frame
         if comm in ("topk8", "topk4"):
@@ -2015,6 +2132,12 @@ class ShardedTable:
                            "push keys outside my range")
                 return
             self._apply_rows(offs, grads)  # read-only view: never written
+        if "hfr" in payload and self._hier is not None:
+            # floor claims ride the SAME frame as the aggregated mass
+            # (per-link FIFO: mass applied above before the claim is
+            # honored here), then parked pulls re-check admission
+            self._hier_merge_floors(payload)
+            self.serve_parked()
         if tr is not None:
             # flow finish AFTER validation, next to the apply span: a
             # dropped (misrouted/config/malformed) frame must not draw
@@ -2096,7 +2219,7 @@ class ShardedTable:
             if v == "refuse":
                 self._send_epoch_nack(sender, req)
                 return
-            admitted = self._cons is None or self._cons.admit_pull(clk)
+            admitted = self._admit_clk(clk)
             if v == "park" or not admitted:
                 tr = _trc.TRACER
                 if tr is not None:
@@ -2109,8 +2232,8 @@ class ShardedTable:
                 # re-check (park/drain race, same as the seed path):
                 # adoption/unfence/clock between verdict and append
                 # would have drained an empty buffer and never retried
-                if self._pull_verdict(keys, ep) == "serve" and (
-                        self._cons is None or self._cons.admit_pull(clk)):
+                if self._pull_verdict(keys, ep) == "serve" \
+                        and self._admit_clk(clk):
                     self.serve_parked()
                 return
             self._serve_pull(sender, req, keys, clk)
@@ -2120,7 +2243,7 @@ class ShardedTable:
                           or offs.max() >= self.part.shard_size):
             self._drop("misrouted", sender, "pull keys outside my range")
             return
-        if self._cons is not None and not self._cons.admit_pull(clk):
+        if not self._admit_clk(clk):
             tr = _trc.TRACER
             if tr is not None:
                 tr.instant("serve", "pull_park",
@@ -2131,7 +2254,7 @@ class ShardedTable:
                                      time.monotonic()))
             # re-check: a clock change between the admission test and the
             # append would have drained an empty buffer and never retried
-            if self._cons.admit_pull(clk):
+            if self._admit_clk(clk):
                 self.serve_parked()
             return
         self._serve_pull(sender, req, keys, clk)
@@ -2146,7 +2269,15 @@ class ShardedTable:
         read time. Falls back to the request clock when no trainer is
         bound (raw-table tests): admission was vacuous there too."""
         sc = getattr(self._cons, "serving_clock", None)
-        return int(sc(sender)) if callable(sc) else int(clk)
+        stamp = int(sc(sender)) if callable(sc) else int(clk)
+        fm = self._hier_floor_min()
+        if fm is not None:
+            # hier contributors' pushes ride two links (member ->
+            # leader -> owner), so min_excluding's FIFO self-exemption
+            # no longer covers them — the certificate folds the floors,
+            # SENDER INCLUDED: its own cross-host mass rides its leader
+            stamp = min(stamp, int(fm))
+        return stamp
 
     def _reply_head_blob(self, req: int, rows: np.ndarray) -> tuple:
         """Encode a pull reply on MY configured pull wire. Frames
@@ -2223,7 +2354,7 @@ class ShardedTable:
             return  # requester times out loudly; my next tick raises
         clk = int(payload.get("clk", 0))
         ep = int(payload.get("ep", 0))
-        admitted = self._cons is None or self._cons.admit_pull(clk)
+        admitted = self._admit_clk(clk)
         parked = not admitted or (
             self._rb is not None
             and self._pull_all_verdict(ep) == "park")
@@ -2233,7 +2364,7 @@ class ShardedTable:
             with self._park_lock:
                 self._parked.append((sender, req, None, clk, ep,
                                      time.monotonic()))
-            if (self._cons is None or self._cons.admit_pull(clk)) and (
+            if self._admit_clk(clk) and (
                     self._rb is None
                     or self._pull_all_verdict(ep) == "serve"):
                 self.serve_parked()  # park/drain race, as above
@@ -2310,7 +2441,8 @@ class ShardedTable:
         with self._push_cond:
             self._push_cond.notify_all()
         self._maybe_release_fences(self.router.epoch)  # exclusions advance
-        if self._cons is None and self._rb is None:
+        if self._cons is None and self._rb is None \
+                and not self._hier_floor:
             return
         # admission is evaluated ONCE per entry: global_min advances
         # concurrently, and a flip between two evaluations must not let an
@@ -2321,8 +2453,7 @@ class ShardedTable:
         with self._park_lock:
             ready, still, refuse = [], [], []
             for p in self._parked:
-                admitted = self._cons is None \
-                    or self._cons.admit_pull(p[3])
+                admitted = self._admit_clk(p[3])
                 if self._rb is not None:
                     v = (self._pull_all_verdict(p[4]) if p[2] is None
                          else self._pull_verdict(p[2], p[4]))
@@ -2950,6 +3081,7 @@ class ShardedTable:
                 self._rb.adopt_now()
             if self._mb is not None:
                 self._mb.poll()  # coordinator: issue a blocking death
+            self._hier_poll()  # leader death mid-pull: fall back here
             dead = (set(self.monitor.check())
                     if self.monitor is not None else set())
             dead_owned = dead & owners
@@ -3087,17 +3219,22 @@ class ShardedTable:
         shard's twin of the owner-side park. Synchronous pulls pass
         instantly (their gate already waited); prefetches stamped ahead
         wait here only if consumed before the staleness rule catches up."""
-        if self._cons is None or self._cons.admit_pull(clk):
+        if self._admit_clk(clk):
             return
         wait_fn = getattr(self._cons, "wait_admit_pull", None)
         deadline = time.monotonic() + (self.pull_timeout
                                        if timeout is None else timeout)
-        while not self._cons.admit_pull(clk):
-            if wait_fn is not None:
+        while not self._admit_clk(clk):
+            self._hier_poll()  # a dead leader blocks floors, not clocks
+            if wait_fn is not None and not (
+                    self._cons is None or self._cons.admit_pull(clk)):
                 wait_fn(clk, timeout=0.5)
             else:
-                time.sleep(0.005)
-            if self._cons.admit_pull(clk):
+                # the gossip min already admits — the hier floor is the
+                # blocker, and floor advances land on the recv thread
+                # with no condvar to wake this one: short poll
+                time.sleep(0.002)
+            if self._admit_clk(clk):
                 return
             dead = self._fatal_dead(
                 self.monitor.check()
@@ -3652,6 +3789,7 @@ class ShardedTable:
         self.rows_pushed += keys.size if n_rows is None else n_rows
         if not coalesced:  # async path: dedup on the sender thread
             keys, grads = self._coalesce_for_wire(keys, grads)
+        self._hier_poll()  # election/fallback on the training thread
         owners = self._owners_of(keys)
         for o in range(self.num_processes):
             mask = owners == o
@@ -3672,6 +3810,16 @@ class ShardedTable:
                 else:
                     self._apply_rows(keys[mask] - self.shard_lo,
                                      grads[mask])
+                continue
+            lead = self._hier_route(o)
+            if lead is not None:
+                # level 1: this (worker, owner) pair rides the tree —
+                # the slice goes to my host leader (or straight into my
+                # own buckets when I am it), exact f32, and the flat
+                # encode below never runs for it
+                self._hier_contribute(lead, o, keys[mask],
+                                      np.ascontiguousarray(
+                                          grads[mask], np.float32))
                 continue
             overflow = None
             if self.push_comm in ("topk8", "topk4"):
@@ -3698,13 +3846,16 @@ class ShardedTable:
                             {"owner": o, "seq": head["seq"]})
             self.bus.send(o, f"psP:{self.name}", head, blob=blob)
             self.bytes_pushed += len(blob)
+            self._hier_count_tx(o, len(blob))
             if overflow is not None and overflow[0].size:
                 # residual-slab overflow: mass the store had no room
                 # for ships dense NOW — the byte win shrinks under
                 # pressure, correctness never does
                 self._send_f32_push(o, overflow[0], overflow[1])
 
-    def _encode_push_topk(self, keys: np.ndarray, grads: np.ndarray
+    def _encode_push_topk(self, keys: np.ndarray, grads: np.ndarray,
+                          birth_clk: Optional[int] = None,
+                          ef=None, rng=None
                           ) -> tuple[dict, bytearray, tuple]:
         """One owner slice through the compressed-push pipeline:
 
@@ -3723,10 +3874,17 @@ class ShardedTable:
            immediate dense send — mass is conserved unconditionally.
 
         Returns ``(head fields, blob, (overflow keys, overflow rows))``.
+
+        ``birth_clk`` overrides the residual birth stamp: a hier leader
+        encodes an aggregate whose oldest contributor may be BEHIND
+        this rank's clock, and the retained error must age from that
+        min stamp or the age-flush bound would silently relax.
         """
-        clk = self._my_clk()
+        clk = self._my_clk() if birth_clk is None else int(birth_clk)
+        ef = self._ef if ef is None else ef
+        rng = self._q_rng if rng is None else rng
         bits = 8 if self.push_comm == "topk8" else 4
-        births = self._ef.fold(keys, grads)
+        births = ef.fold(keys, grads)
         births = np.minimum(births, clk)
         sel = topk_rows(grads, mass=self.topk_mass,
                         frac_cap=self.topk_cap)
@@ -3735,14 +3893,14 @@ class ShardedTable:
         g_sel = grads[sel]
         codes, scales = quantize_blockwise(g_sel, bits,
                                            block=self.topk_block,
-                                           rng=self._q_rng)
+                                           rng=rng)
         sent = dequantize_blockwise(codes, scales, sel.size, self.dim,
                                     bits, block=self.topk_block)
         ovk = np.empty(0, np.int64)
         ovr = np.empty((0, self.dim), np.float32)
-        k1, r1 = self._ef.retain(keys[~selmask], grads[~selmask],
-                                 births[~selmask])
-        k2, r2 = self._ef.retain(keys[sel], g_sel - sent, births[sel])
+        k1, r1 = ef.retain(keys[~selmask], grads[~selmask],
+                           births[~selmask])
+        k2, r2 = ef.retain(keys[sel], g_sel - sent, births[sel])
         if k1.size or k2.size:
             ovk = np.concatenate([k1, k2])
             ovr = np.concatenate([r1, r2])
@@ -3784,21 +3942,24 @@ class ShardedTable:
         return {"kw": kw}, k.astype(self._key_dtype()).tobytes()
 
     def _send_f32_push(self, o: int, k: np.ndarray,
-                       g: np.ndarray) -> None:
+                       g: np.ndarray, *,
+                       extra_head: Optional[dict] = None) -> None:
         """A plain full-precision push frame to one owner — the
         residual-flush/overflow sender (seq-stamped under async push
         like any other frame, so the drain and ack machinery cover
-        it)."""
+        it). ``extra_head`` carries hier step tags / floor claims."""
         if self._mb is not None and o in self._dead_ranks:
             self.rb_stats["pushes_lost_to_dead"] += 1
             return
         blob = _cat_blob(k, np.ascontiguousarray(g, np.float32))
         head = {"n": int(k.size), "comm": "float32",
-                **self._ep_header(), **self._cfg_header()}
+                **self._ep_header(), **self._cfg_header(),
+                **(extra_head or {})}
         if self.async_push:
             head["seq"] = self._take_push_seq(o)
         self.bus.send(o, f"psP:{self.name}", head, blob=blob)
         self.bytes_pushed += len(blob)
+        self._hier_count_tx(o, len(blob))
 
     def residual_flush(self, *, aged_only: bool = False,
                        reason: str = "fence") -> int:
@@ -3881,11 +4042,560 @@ class ShardedTable:
         blob = _cat_blob(kstream, scales, codes)
         self.bus.send(o, f"psP:{self.name}", head, blob=blob)
         self.bytes_pushed += len(blob)
+        self._hier_count_tx(o, len(blob))
 
     def ef_stats(self) -> Optional[dict]:
         """Error-feedback residual counters — None when the compressed
         push wire is off (off vs idle, the done-line convention)."""
         return self._ef.stats() if self._ef is not None else None
+
+    # ---- hierarchical push tree (balance/hier.py, MINIPS_HIER) ------
+    #
+    # Protocol, one psH wire per table:
+    #   "c"  member -> leader   contribution: one owner slice, exact f32
+    #   "b"  member -> leader   boundary: "my pushes < f are with you"
+    #   "a"  leader -> member   ack: "your steps < f were flushed"
+    #   "f"  leader -> owner    floor-only claim (no mass this boundary)
+    #   "x"  member -> leader   expel me (sick-leader fallback handshake)
+    #   "xa" leader -> member   expel-ack: the floor already flushed
+    #   "r"  member -> owner    waive my floor (I am direct again)
+    #   "m"  member -> owner    re-arm my floor at f (re-entered a tree)
+    # Aggregated MASS rides the ordinary psP wire with head extras:
+    # hfr/hfv (per-contributor floor claims, max-merged at the owner)
+    # and hmin (min contributor stamp — the aggregate's birth clock).
+
+    def _hier_elect(self) -> Optional[int]:
+        """My group's current leader under THE deterministic rule
+        (balance/hier.elect: lowest live rank) — every member computes
+        it locally from the shared gossip exclusion set."""
+        return self._hier_elect_fn(
+            self._hier_group,
+            self._excluded_ranks() | self._dead_ranks)
+
+    def _hier_route(self, o: int) -> Optional[int]:
+        """Level-1 routing for one owner: my group's leader when the
+        (me, owner) pair is in hier mode, else None = flat wire.
+        In-group owners, singleton groups, the accounting-only arm
+        (agg=0), and the direct-fallback latch all stay flat."""
+        cfg = self._hier
+        if cfg is None or not cfg.agg or cfg.group < 2:
+            return None
+        if self._hier_direct or len(self._hier_group) < 2:
+            return None
+        if self._hier_host_of(o) == self._hier_host_of(self.rank):
+            return None
+        return self._hier_leader  # None while leaderless -> flat
+
+    def _hier_count_tx(self, o: int, nbytes: int) -> None:
+        """Per-level byte/frame classification at every push-frame
+        send: in-group traffic is level 1, cross-group is level 2 (the
+        HIER-WIN gate reads l2 — the leader leg). ``group=1``
+        (armed-idle) counts nothing: the tree is degenerate and the
+        zeros-when-idle wire_record contract holds."""
+        cfg = self._hier
+        if cfg is None or cfg.group < 2:
+            return
+        h = self.hier_counters
+        if self._hier_host_of(o) == self._hier_host_of(self.rank):
+            h["l1_tx_bytes"] += nbytes
+            h["l1_frames"] += 1
+        else:
+            h["l2_tx_bytes"] += nbytes
+            h["l2_frames"] += 1
+
+    def _hier_floor_min(self) -> Optional[int]:
+        """Min floor over LIVE registered hier contributors — None when
+        no contributor is registered (hier off, group=1, or a fleet
+        with no cross-group multi-rank pusher). Excluded/dead
+        contributors stop gating: their mass either landed or is
+        counted lost, exactly like the gossip min's exclusion rule."""
+        fl = self._hier_floor
+        if not fl:
+            return None
+        exc = self._excluded_ranks() | self._dead_ranks
+        vals = [f for r, f in fl.items() if r not in exc]
+        return min(vals) if vals else None
+
+    def _admit_clk(self, clk: int) -> bool:
+        """THE owner-side pull admission: the gossip staleness rule AND
+        the per-contributor hier floors. A hier contributor's clock
+        frame no longer certifies its cross-host pushes (they ride two
+        links; per-link FIFO does not compose), so the same
+        ``gate.admits`` predicate is re-evaluated against the floor min
+        — semantics preserved, evidence source swapped."""
+        if self._cons is not None and not self._cons.admit_pull(clk):
+            return False
+        fm = self._hier_floor_min()
+        if fm is None:
+            return True
+        return admits(int(fm), int(clk), self._cache_staleness())
+
+    def _hier_contribute(self, lead: int, o: int, k: np.ndarray,
+                         g: np.ndarray) -> None:
+        """Ship one owner slice up the tree (or straight into my own
+        buckets when I am the leader). The slice is RETAINED until the
+        leader acks its flush — the fallback's replay source, so a
+        leader death costs bytes (an exact re-push), never steps."""
+        step = self._my_clk()
+        if lead == self.rank:
+            with self._hier_lock:
+                self._hier_buckets.setdefault(int(o), []).append(
+                    (k, g, step, self.rank))
+            return
+        blob = _cat_blob(k, g)
+        head = {"op": "c", "o": int(o), "n": int(k.size),
+                "clk": int(step), **self._cfg_header()}
+        with self._hier_lock:
+            self._hier_retained.append((step, int(o), k, g))
+        self.bus.send(lead, f"psH:{self.name}", head, blob=blob)
+        h = self.hier_counters
+        h["contribs"] += 1
+        h["l1_frames"] += 1
+        h["l1_tx_bytes"] += len(blob)
+
+    def _on_hier(self, sender: int, payload: dict) -> None:
+        """The psH wire handler (bus recv thread) — see the protocol
+        table above. Mutates hier state under ``_hier_lock``; the only
+        sends it issues are replies/flushes, never waits."""
+        op = payload.get("op")
+        if op == "c":
+            if not self._check_peer_config(sender, payload):
+                return
+            if sender in self._hier_expelled:
+                return  # late frame from a member that went direct
+            n = int(payload.get("n", 0))
+            blob = payload.get("__blob__")
+            if blob is None or len(blob) != n * (8 + 4 * self.dim):
+                self._drop("malformed", sender,
+                           "bad hier contribution blob")
+                return
+            k = np.frombuffer(blob[:8 * n], np.int64)
+            g = np.frombuffer(blob[8 * n:], np.float32
+                              ).reshape(n, self.dim)
+            with self._hier_lock:
+                self._hier_buckets.setdefault(
+                    int(payload.get("o", -1)), []).append(
+                    (k, g, int(payload.get("clk", 0)), sender))
+        elif op == "b":
+            f = int(payload.get("f", 0))
+            with self._hier_lock:
+                if sender not in self._hier_expelled:
+                    cur = self._hier_member_floor.get(sender, 0)
+                    self._hier_member_floor[sender] = max(cur, f)
+            # whichever boundary completes the step flushes it: the
+            # group-min trigger fires exactly once per boundary in
+            # every interleaving, and running it HERE (recv thread)
+            # is what keeps two groups' lockstep free of deadlock
+            self._hier_maybe_flush()
+        elif op == "a":
+            f = int(payload.get("f", 0))
+            with self._hier_lock:
+                self._hier_retained = [e for e in self._hier_retained
+                                       if e[0] >= f]
+        elif op == "f":
+            if sender in (self._excluded_ranks() | self._dead_ranks):
+                self.hier_counters["stale_leader_drops"] += 1
+                return
+            self._hier_merge_floors(payload)
+            self.serve_parked()
+        elif op == "x":
+            with self._hier_lock:
+                self._hier_expelled.add(sender)
+                self._hier_member_floor.pop(sender, None)
+                for o in list(self._hier_buckets):
+                    self._hier_buckets[o] = [
+                        e for e in self._hier_buckets[o]
+                        if e[3] != sender]
+                f = int(self._hier_claimed.get(sender, 0))
+            self.bus.send(sender, f"psH:{self.name}",
+                          {"op": "xa", "f": f})
+            self._hier_maybe_flush()  # gmin may advance without them
+        elif op == "xa":
+            with self._hier_lock:
+                self._hier_xa = int(payload.get("f", 0))
+        elif op == "r":
+            with self._hier_lock:
+                if sender in self._hier_floor:
+                    self._hier_floor[sender] = RETIRED_CLOCK
+            self.serve_parked()
+        elif op == "m":
+            with self._hier_lock:
+                if sender in self._hier_floor:
+                    self._hier_floor[sender] = int(payload.get("f", 0))
+
+    def _hier_merge_floors(self, payload: dict) -> None:
+        """Max-merge a frame's hfr/hfv floor claims into the owner-side
+        floors. Max, monotone: a zombie leader's stale (lower) claim
+        can never roll a floor back, and the member's own ``r``/``m``
+        frames are the only lowering path (same-link FIFO with its
+        re-pushes, so the lowered claim is always true)."""
+        hfr = payload.get("hfr") or ()
+        hfv = payload.get("hfv") or ()
+        with self._hier_lock:
+            for r, f in zip(hfr, hfv):
+                r, f = int(r), int(f)
+                cur = self._hier_floor.get(r)
+                if cur is not None and f > cur:
+                    self._hier_floor[r] = f
+
+    def _hier_maybe_flush(self, force: bool = False) -> None:
+        """Leader flush: fires when the GROUP-MIN boundary floor
+        advances past the last flush — per owner, concat + exact f64
+        dedup-sum, then ONE frame on the configured push wire with the
+        floor claims and the min contributor stamp. ``_hier_flush_lock``
+        spans snapshot AND sends: a later flush's floor claim must
+        never overtake an earlier flush's mass on an owner link."""
+        cfg = self._hier
+        if cfg is None or not cfg.agg:
+            return
+        with self._hier_flush_lock:
+            with self._hier_lock:
+                if self._hier_leader != self.rank or self._hier_direct:
+                    return
+                exc = self._excluded_ranks() | self._dead_ranks
+                live = [r for r in self._hier_member_floor
+                        if r not in exc]
+                gmin = min([self._hier_own_floor]
+                           + [self._hier_member_floor[r] for r in live])
+                if gmin <= self._hier_flushed_floor and not force:
+                    return
+                self._hier_flushed_floor = gmin
+                buckets, self._hier_buckets = self._hier_buckets, {}
+                floors = {self.rank: self._hier_own_floor}
+                floors.update({r: self._hier_member_floor[r]
+                               for r in live})
+                self._hier_claimed.update(floors)
+            t0 = time.monotonic()
+            extra = {"hfr": [int(r) for r in sorted(floors)],
+                     "hfv": [int(floors[r]) for r in sorted(floors)]}
+            sent_to = set()
+            for o in sorted(buckets):
+                entries = buckets[o]
+                if not entries or o < 0:
+                    continue
+                ks = np.concatenate([e[0] for e in entries])
+                gs = np.concatenate([e[1] for e in entries])
+                hmin = min(int(e[2]) for e in entries)
+                k, g, _ = sum_duplicate_keys(ks, gs, self.dim)
+                self._hier_send_agg(int(o), k, g, hmin, extra)
+                sent_to.add(int(o))
+            for o in self._hier_cross:
+                # owners with no mass this boundary still need the
+                # claim, or their admission would stall on my group
+                if o in sent_to or o in self._dead_ranks:
+                    continue
+                self.bus.send(o, f"psH:{self.name}",
+                              {"op": "f", **extra})
+                self.hier_counters["floor_frames"] += 1
+            for m in live:
+                self.bus.send(m, f"psH:{self.name}",
+                              {"op": "a", "f": int(floors[m])})
+            self.hist_hier.record_s(time.monotonic() - t0)
+
+    def _hier_send_agg(self, o: int, k: np.ndarray, g: np.ndarray,
+                       hmin: int, extra: dict) -> None:
+        """One aggregated frame to one owner on the configured push
+        wire (the receiver cannot tell an aggregate from a flat push
+        except by its head extras). Level-2 EF folds in the leader's
+        DEDICATED store under the aggregate's min stamp."""
+        if self._mb is not None and o in self._dead_ranks:
+            self.rb_stats["pushes_lost_to_dead"] += 1
+            return
+        extra = {**extra, "hmin": int(hmin)}
+        if self.push_comm in ("topk8", "topk4"):
+            head0, blob, overflow = self._encode_push_topk(
+                k, np.ascontiguousarray(g, np.float32),
+                birth_clk=hmin, ef=self._hier_ef, rng=self._hier_rng)
+            if overflow is not None and overflow[0].size:
+                # overflow FIRST: the floor claim rides the aggregate,
+                # which must be the LAST frame of this flush on the
+                # owner link — a claim overtaking its own mass would
+                # admit a pull that misses it
+                self._send_f32_push(o, overflow[0], overflow[1])
+        elif self.push_comm == "int8":
+            codes, scale = quantize_rows_int8(g, self._hier_rng)
+            head0 = {"n": int(k.size), "comm": "int8"}
+            blob = _cat_blob(k, scale, codes)
+        else:
+            head0 = {"n": int(k.size), "comm": "float32"}
+            blob = _cat_blob(k, np.ascontiguousarray(g, np.float32))
+        head = {**head0, **self._ep_header(), **self._cfg_header(),
+                **extra}
+        self.bus.send(o, f"psP:{self.name}", head, blob=blob)
+        self.bytes_pushed += len(blob)
+        h = self.hier_counters
+        h["agg_frames"] += 1
+        h["agg_rows"] += int(k.size)
+        self._hier_count_tx(o, len(blob))
+
+    def _hier_poll(self) -> None:
+        """Election/fallback state machine, driven from the training
+        thread's natural poll points (push, tick boundary, pull waits):
+        re-run THE deterministic election; a convicted leader triggers
+        fallback (replay the retained window direct, waive my floors);
+        a live-but-sick leader (retained window past ``retain``) is
+        expelled via the x/xa handshake; a NEW live leader (myself
+        included) re-enters the tree."""
+        cfg = self._hier
+        if cfg is None or not cfg.agg or cfg.group < 2:
+            return
+        new = self._hier_elect()
+        repush = None
+        with self._hier_lock:
+            old = self._hier_leader
+            if new != old:
+                self._hier_leader = new
+                self.hier_counters["elections"] += 1
+                if not self._hier_direct and old is not None \
+                        and old != self.rank:
+                    # my leader was convicted with my window in flight
+                    self._hier_direct = True
+                    self._hier_shunned = old
+                    repush = list(self._hier_retained)
+                    self._hier_retained.clear()
+                    self.hier_counters["fallbacks"] += 1
+        if new != old:
+            _fl.record("hier_leader_elect",
+                       {"table": self.name,
+                        "old": -1 if old is None else int(old),
+                        "new": -1 if new is None else int(new)})
+        if repush is not None:
+            self._hier_replay(repush, old, "leader_dead")
+        with self._hier_lock:
+            sick = (not self._hier_direct
+                    and self._hier_leader not in (None, self.rank)
+                    and len(self._hier_retained) > cfg.retain)
+        if sick:
+            self._hier_expel_and_go_direct()
+        with self._hier_lock:
+            direct = self._hier_direct
+            shunned = self._hier_shunned
+            cur = self._hier_leader
+        if direct and cur is not None and cur != shunned:
+            self._hier_reenter(cur)
+
+    def _hier_replay(self, repush: list, old, why: str) -> None:
+        """The fallback's second half: re-push the retained window
+        DIRECT (exact f32, step-tagged so the owner's floor filter
+        dedups anything the dead leader's last flush already
+        delivered), then waive my floor at every owner — the ``r``
+        rides AFTER the re-pushes on each owner link, so the waiver is
+        true when it lands. Zero lost steps; the cost is bytes."""
+        _fl.record("hier_fallback",
+                   {"table": self.name,
+                    "leader": -1 if old is None else int(old),
+                    "why": why, "steps": len(repush)})
+        h = self.hier_counters
+        for step, o, k, g in repush:
+            self._send_f32_push(o, k, g, extra_head={"hst": int(step)})
+            h["repushed_steps"] += 1
+        dead = self._excluded_ranks() | self._dead_ranks
+        for o in self._hier_cross:
+            if o not in dead:
+                self.bus.send(o, f"psH:{self.name}", {"op": "r"})
+
+    def _hier_expel_and_go_direct(self) -> None:
+        """Sick-leader fallback against a LIVE leader: the x/xa
+        handshake makes the handoff exactly-once — the leader discards
+        my pending bucket mass (I will re-push it), stops claiming my
+        floor, and tells me the floor it already flushed so I replay
+        only the steps above it. A leader too sick to even ack within
+        the grace degrades to the dead-leader replay (floor filter
+        still dedups whatever it managed to flush)."""
+        with self._hier_lock:
+            lead = self._hier_leader
+            if self._hier_direct or lead in (None, self.rank):
+                return
+            self._hier_xa = None
+        self.bus.send(lead, f"psH:{self.name}", {"op": "x"})
+        t_end = time.monotonic() + 2.0
+        f = 0
+        while time.monotonic() < t_end:
+            with self._hier_lock:
+                if self._hier_xa is not None:
+                    f = int(self._hier_xa)
+                    break
+            if lead in (self._excluded_ranks() | self._dead_ranks):
+                break
+            time.sleep(0.005)
+        with self._hier_lock:
+            self._hier_direct = True
+            self._hier_shunned = lead
+            repush = [e for e in self._hier_retained if e[0] >= f]
+            self._hier_retained.clear()
+            self.hier_counters["fallbacks"] += 1
+        self._hier_replay(repush, lead, "expelled")
+
+    def _hier_reenter(self, lead: int) -> None:
+        """Re-enter the tree under a NEW live leader (myself included:
+        a surviving lowest rank starts leading its remaining members).
+        The ``m`` frame re-arms my floor at the current clock — valid
+        because everything below it went direct on the same owner link
+        while I was fallen back."""
+        f = int(self._my_clk())
+        with self._hier_lock:
+            self._hier_direct = False
+            self._hier_shunned = None
+            if lead == self.rank:
+                self._hier_own_floor = max(self._hier_own_floor, f)
+        dead = self._excluded_ranks() | self._dead_ranks
+        for o in self._hier_cross:
+            if o not in dead:
+                self.bus.send(o, f"psH:{self.name}",
+                              {"op": "m", "f": f})
+
+    def hier_boundary(self) -> None:
+        """The trainer-tick hook, called AFTER the step's pushes and
+        residual flushes and BEFORE the clock frame goes out (the same
+        per-link-FIFO slot the async drain uses): members hand the
+        leader a boundary certifying this step's contributions are
+        complete; the leader advances its own floor and flushes if that
+        completes the group."""
+        cfg = self._hier
+        if cfg is None or not cfg.agg or cfg.group < 2:
+            return
+        self._hier_poll()
+        f = int(self._my_clk()) + 1
+        with self._hier_lock:
+            lead = self._hier_leader
+            direct = self._hier_direct
+        if direct or lead is None:
+            return
+        if lead == self.rank:
+            with self._hier_lock:
+                self._hier_own_floor = max(self._hier_own_floor, f)
+            self._hier_maybe_flush()
+            self._hier_residual_boundary()
+        else:
+            self.bus.send(lead, f"psH:{self.name}",
+                          {"op": "b", "f": f})
+            self.hier_counters["l1_frames"] += 1
+
+    def _hier_residual_boundary(self) -> None:
+        """Leader-lane aged residual flush — the level-2 twin of
+        ``residual_flush(aged_only=True)``: retained aggregate error
+        older than the staleness bound ships as the blk4 stream,
+        straight to its owner (leader -> owner IS the hier lane)."""
+        if self._hier_ef is None:
+            return
+        s = self._cache_staleness()
+        if s == float("inf"):
+            return
+        with self._hier_flush_lock:
+            keys, rows = self._hier_ef.take(self._my_clk() - int(s))
+            if not keys.size:
+                return
+            self._hier_ef.note_flushed(int(keys.size), "age")
+            owners = self._owners_of(keys)
+            for o in np.unique(owners):
+                m = owners == o
+                if int(o) == self.rank:
+                    if self._rb is not None:
+                        self._ingest_push(keys[m], rows[m],
+                                          self.router.epoch)
+                    else:
+                        self._apply_rows(keys[m] - self.shard_lo,
+                                         rows[m])
+                else:
+                    self._send_blk4_push(int(o), keys[m], rows[m])
+
+    def hier_finalize(self, timeout: float = 20.0) -> None:
+        """Quiesce the tree BEFORE the psFlush barrier: a member's
+        psFlush no longer certifies its cross-host mass (it may sit in
+        the leader's buckets), so the member hands the leader a RETIRED
+        boundary and waits for its retained window to drain — falling
+        back (bytes, not loss) if the leader dies or hangs — and the
+        leader drives its floor to RETIRED, flushing as the members'
+        RETIRED boundaries land, so its own psFlush rides AFTER the
+        last aggregated frame on every owner link."""
+        cfg = self._hier
+        if cfg is None or not cfg.agg or cfg.group < 2:
+            return
+        deadline = time.monotonic() + timeout
+        self._hier_poll()
+        with self._hier_lock:
+            lead = self._hier_leader
+            direct = self._hier_direct
+        if not direct and lead not in (None, self.rank):
+            self.bus.send(lead, f"psH:{self.name}",
+                          {"op": "b", "f": int(RETIRED_CLOCK)})
+            while True:
+                with self._hier_lock:
+                    if not self._hier_retained or self._hier_direct:
+                        break
+                self._hier_poll()  # a death here falls back + replays
+                if time.monotonic() > deadline:
+                    self._hier_expel_and_go_direct()
+                    break
+                time.sleep(0.005)
+        with self._hier_lock:
+            lead = self._hier_leader
+        if lead == self.rank:
+            with self._hier_lock:
+                self._hier_own_floor = int(RETIRED_CLOCK)
+            while True:
+                self._hier_maybe_flush()
+                with self._hier_lock:
+                    exc = (self._excluded_ranks()
+                           | self._dead_ranks)
+                    waiting = [
+                        r for r in self._hier_member_floor
+                        if r not in exc
+                        and self._hier_member_floor[r] < RETIRED_CLOCK]
+                if not waiting:
+                    break
+                if time.monotonic() > deadline:
+                    _fl.record("hier_finalize_timeout",
+                               {"table": self.name,
+                                "waiting": sorted(waiting)})
+                    break
+                time.sleep(0.005)
+            self._hier_maybe_flush(force=True)
+            self._hier_residual_fence()
+
+    def _hier_residual_fence(self) -> None:
+        """Exact f32 fence flush of the leader-lane residual store —
+        the finalize twin of ``residual_flush(reason="fence")``:
+        post-finalize agreement is bitwise, so no leader-side error
+        mass may outlive the run."""
+        if self._hier_ef is None:
+            return
+        with self._hier_flush_lock:
+            keys, rows = self._hier_ef.take()
+            if not keys.size:
+                return
+            self._hier_ef.note_flushed(int(keys.size), "fence")
+            owners = self._owners_of(keys)
+            for o in np.unique(owners):
+                m = owners == o
+                if int(o) == self.rank:
+                    if self._rb is not None:
+                        self._ingest_push(keys[m], rows[m],
+                                          self.router.epoch)
+                    else:
+                        self._apply_rows(keys[m] - self.shard_lo,
+                                         rows[m])
+                else:
+                    self._send_f32_push(int(o), keys[m], rows[m])
+
+    def hier_stats(self) -> Optional[dict]:
+        """Hier counters + live tree state — None when hier is off
+        (the off-vs-idle done-line convention; ``group=1`` keeps every
+        byte/frame counter at zero)."""
+        if self._hier is None:
+            return None
+        out = {k: int(v) for k, v in self.hier_counters.items()}
+        with self._hier_lock:
+            out["retained_steps"] = len(self._hier_retained)
+            out["leader"] = (-1 if self._hier_leader is None
+                             else int(self._hier_leader))
+            out["direct"] = int(self._hier_direct)
+        fm = self._hier_floor_min()
+        out["floor_min"] = -1 if fm is None else int(fm)
+        if self._hier_ef is not None:
+            out["ef_rows"] = int(
+                self._hier_ef.stats()["resident_rows"])
+        return out
 
     def push_dense(self, grad: np.ndarray) -> None:
         """Whole-vector gradient push, split into per-owner contiguous
@@ -4108,6 +4818,7 @@ class ShardedPSTrainer:
                  autoscale: Optional[str] = None,
                  hedge: Optional[str] = None,
                  slow: Optional[str] = None,
+                 hier: Optional[str] = None,
                  plane: Optional[str] = None):
         # data-plane selection at the same altitude as the bus backends
         # (train/mesh_plane.resolve_plane: explicit wins, else
@@ -4242,6 +4953,37 @@ class ShardedPSTrainer:
         if self.hedge_cfg is not None:
             for t in tables.values():
                 t.attach_hedge(self.hedge_cfg)
+        # hierarchical push tree (balance/hier.py): OFF by default —
+        # explicit spec wins, else $MINIPS_HIER. Armed AFTER
+        # bind_consistency (the tables' _my_clk/_excluded_ranks feeds)
+        # and checked against the heat rebalancer: a mid-run routing
+        # overlay would re-home keys whose mass sits in a leader's
+        # buckets, and the leader flushes by the MEMBER's routing —
+        # elastic membership stays allowed (death plans only move a
+        # corpse's keys, and a dead leader's members fall back first).
+        from minips_tpu.balance import hier as _hr
+
+        self.hier_cfg = _hr.maybe_config(hier)
+        if self.hier_cfg is not None:
+            if self.hier_cfg.agg and self.hier_cfg.group > 1 \
+                    and self.rebalancer is not None \
+                    and getattr(self.rebalancer, "plan_heat", False):
+                raise ValueError(
+                    "MINIPS_HIER aggregation is incompatible with the "
+                    "heat rebalancer (MINIPS_REBALANCE): a routing "
+                    "overlay adopted mid-boundary would re-route keys "
+                    "already bucketed at a leader under the old table. "
+                    "Run hier with MINIPS_ELASTIC only, or keep the "
+                    "flat wire under rebalancing")
+            for t in tables.values():
+                t.attach_hier(self.hier_cfg)
+            if self.hier_cfg.agg and self.hier_cfg.group > 1:
+                _fl.record("hier_leader_elect", {
+                    "table": "*", "old": -1,
+                    "new": -1 if (lead := _hr.elect(
+                        _hr.group_ranks(bus.my_id, self.hier_cfg.group,
+                                        num_processes))) is None
+                    else int(lead)})
         self.slowness = _slw.maybe_build(bus.my_id, num_processes, slow)
         if self.slowness is not None:
             for t in tables.values():
@@ -4348,6 +5090,20 @@ class ShardedPSTrainer:
                 "hedges_fired",
                 lambda: sum(t.hedge_counters["fired"]
                             for t in tables))
+        if self.hier_cfg is not None:
+
+            def _hier_sig(key):
+                return lambda: sum(t.hier_counters[key]
+                                   for t in tables)
+
+            ow.register_counter("hier_l2_bytes",
+                                _hier_sig("l2_tx_bytes"))
+            ow.register_counter("hier_agg_frames",
+                                _hier_sig("agg_frames"))
+            ow.register_counter("hier_fallbacks",
+                                _hier_sig("fallbacks"))
+            ow.register_hist("hier_flush", _hist_fn(
+                [t.hist_hier for t in tables]))
         rel = getattr(self.bus, "reliable", None)
         if rel is not None:
             ow.register_counter(
@@ -4470,6 +5226,12 @@ class ShardedPSTrainer:
             # withheld write may trail its push by at most `staleness`
             # boundaries — the compressed wire's half of the SSP story
             t.residual_flush(aged_only=True)
+            # hier boundary LAST in the per-table block and ALWAYS
+            # (ASP included — floors advance even when admission is
+            # vacuous): this step's contributions and residual flushes
+            # are on their links, so the boundary certificate is true,
+            # and it precedes my clock frame like everything above
+            t.hier_boundary()
             t.check_fatal()                 # …and this raises, no hang
         if self.autoscaler is not None:
             # BEFORE the membership queues run: an admit credit granted
@@ -4533,13 +5295,18 @@ class ShardedPSTrainer:
             # the shutdown barrier)
             self.serve_plane.quiesce()
         for t in self.tables.values():
-            # order matters (the adopt_table pattern): drain the async
-            # queue FIRST — a queued topk push encodes on the sender
-            # thread and RETAINS fresh residuals, so flushing before
-            # the drain would strand exactly the mass the flush exists
-            # to ship — then flush the whole store (post-finalize
-            # agreement is exact), then the hard ack drain covers the
-            # flush frames too
+            # order matters (the adopt_table pattern): quiesce the hier
+            # tree FIRST — a member's cross-host mass may sit in its
+            # leader's buckets, and the psFlush below only certifies
+            # MY links, so the tree must drain (leader flush or member
+            # fallback) before the flush broadcast means anything —
+            # then drain the async queue (a queued topk push encodes
+            # on the sender thread and RETAINS fresh residuals, so
+            # flushing before the drain would strand exactly the mass
+            # the flush exists to ship), then flush the whole store
+            # (post-finalize agreement is exact), then the hard ack
+            # drain covers the flush frames too
+            t.hier_finalize(timeout=timeout * 0.66)
             t.flush_pushes(acks=False)
             t.residual_flush(reason="fence")
             t.flush_pushes()  # async tail: drained before the flush frame
@@ -4720,6 +5487,34 @@ class ShardedPSTrainer:
                 out[k] += v
         out["delay_ms"] = self.hedge_cfg.delay_ms or None
         out["budget"] = self.hedge_cfg.budget
+        return out
+
+    def hier_stats(self) -> Optional[dict]:
+        """Two-level push-tree counters summed over tables
+        (balance/hier.py): None when MINIPS_HIER is off, all-zero
+        byte/frame counters when armed-but-idle (``group=1``) — the
+        off-vs-idle done-line convention. ``l1_*``/``l2_*`` split the
+        wire by level (the HIER-WIN gate reads l2, the leader leg);
+        ``elections``/``fallbacks``/``repushed_steps`` tell the
+        leader-death story; ``stale_leader_drops``/``repush_drops``
+        count the exactly-once fences doing their job."""
+        if self.hier_cfg is None:
+            return None
+        out: dict = {}
+        for t in self.tables.values():
+            for k, v in t.hier_counters.items():
+                out[k] = out.get(k, 0) + int(v)
+        out["group"] = self.hier_cfg.group
+        out["agg"] = self.hier_cfg.agg
+        out["retain"] = self.hier_cfg.retain
+        for t in self.tables.values():
+            # every table elects from the same gossip inputs — one
+            # table's live tree state speaks for the trainer (the
+            # leader-death drill reads the post-heal leader here)
+            st = t.hier_stats()
+            out["leader"] = st["leader"]
+            out["direct"] = st["direct"]
+            break
         return out
 
     def slowness_stats(self) -> Optional[dict]:

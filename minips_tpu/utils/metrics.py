@@ -107,6 +107,11 @@ def wire_record(trainer) -> dict:
         "hedge": getattr(trainer, "hedge_stats", lambda: None)(),
         "slowness": getattr(trainer, "slowness_stats",
                             lambda: None)(),
+        # hierarchical push tree (balance/hier.py): per-level byte/
+        # frame split (l1 intra-group, l2 the cross-group leader leg),
+        # aggregation + election/fallback counters — None when
+        # MINIPS_HIER is off, zero counters when armed-idle (group=1)
+        "hier": getattr(trainer, "hier_stats", lambda: None)(),
         # retransmission-protocol + fault-injection counters: None when
         # the respective layer is off ('off' vs 'clean' distinguishable)
         "reliable": trainer.reliable_stats(),

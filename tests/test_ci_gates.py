@@ -861,6 +861,90 @@ def test_slow_idle_requires_bitwise_and_a_real_drill():
     assert any("fired on a clean wire" in p for p in probs)
 
 
+def _hier_art(h_completed=True, f_completed=True, ratio=2.2,
+              agg=25, contribs=25, fallbacks=0, h_lost=0,
+              h_agree=True, h_loss=0.672, f_loss=0.672,
+              bit_equal=True, bit_checked=96, bit_agg=4,
+              idle_equal=True, idle_checked=96,
+              idle_agg=0) -> dict:
+    return {"hier_agg_3proc": {
+        "iters": 40, "group": 2, "tree_ranks": [0, 1],
+        "owner_rank": 2,
+        "hier": {"completed": h_completed, "hier_spec": "group=2",
+                 "l2_tx_bytes": 5000, "l2_frames": 44,
+                 "agg_frames": agg, "contribs": contribs,
+                 "fallbacks": fallbacks, "loss_last": h_loss,
+                 "wire_frames_lost": h_lost, "finals_agree": h_agree},
+        "flat": {"completed": f_completed,
+                 "hier_spec": "group=2,agg=0",
+                 "l2_tx_bytes": 11000, "l2_frames": 100,
+                 "agg_frames": 0, "contribs": 0, "fallbacks": 0,
+                 "loss_last": f_loss, "wire_frames_lost": 0,
+                 "finals_agree": True},
+        "l2_bytes_ratio": ratio,
+        "bitwise": {"equal": bit_equal, "rows_checked": bit_checked,
+                    "agg_frames": bit_agg},
+        "idle": {"equal": idle_equal, "rows_checked": idle_checked,
+                 "agg_frames": idle_agg}}}
+
+
+def test_hier_tripwires_pass_on_healthy_sweep():
+    from ci.bench_regression import hier_tripwires
+
+    assert hier_tripwires(_hier_art()) == []
+    assert hier_tripwires({}) == []  # absent sweep: vacuous
+
+
+def test_hier_win_requires_ratio_engagement_and_trajectory():
+    from ci.bench_regression import hier_tripwires
+
+    # the byte win is the whole point: below 1.7x (or absent) trips
+    probs = hier_tripwires(_hier_art(ratio=1.4))
+    assert any("HIER-WIN" in p and "l2_bytes_ratio" in p
+               for p in probs)
+    probs = hier_tripwires(_hier_art(ratio=None))
+    assert any("l2_bytes_ratio" in p for p in probs)
+    # a disengaged tree makes any byte win mislabeled flat traffic
+    probs = hier_tripwires(_hier_art(agg=0))
+    assert any("never engaged" in p for p in probs)
+    probs = hier_tripwires(_hier_art(contribs=0))
+    assert any("never engaged" in p for p in probs)
+    # fallbacks on a clean wire poison the comparison
+    probs = hier_tripwires(_hier_art(fallbacks=2))
+    assert any("fallbacks on a clean wire" in p for p in probs)
+    # trajectory: aggregated EF must not change what the model learns
+    probs = hier_tripwires(_hier_art(h_loss=0.80, f_loss=0.67))
+    assert any("diverge" in p for p in probs)
+    # dead arms, lost frames, disagreeing finals can never pass
+    probs = hier_tripwires(_hier_art(h_completed=False))
+    assert any("hier_agg_3proc/hier" in p for p in probs)
+    probs = hier_tripwires(_hier_art(f_completed=False))
+    assert any("hier_agg_3proc/flat" in p for p in probs)
+    probs = hier_tripwires(_hier_art(h_lost=2))
+    assert any("unrecovered" in p for p in probs)
+    probs = hier_tripwires(_hier_art(h_agree=False))
+    assert any("disagree" in p for p in probs)
+
+
+def test_hier_bitwise_and_idle_require_real_drills():
+    from ci.bench_regression import hier_tripwires
+
+    probs = hier_tripwires(_hier_art(bit_equal=False))
+    assert any("bitwise-equal" in p for p in probs)
+    probs = hier_tripwires(_hier_art(bit_checked=0))
+    assert any("hier_agg_3proc/bitwise" in p for p in probs)
+    # equal with zero aggregate frames = the tree silently disarmed
+    probs = hier_tripwires(_hier_art(bit_agg=0))
+    assert any("silently disarmed" in p for p in probs)
+    probs = hier_tripwires(_hier_art(idle_equal=False))
+    assert any("HIER-IDLE" in p for p in probs)
+    probs = hier_tripwires(_hier_art(idle_checked=0))
+    assert any("HIER-IDLE" in p for p in probs)
+    # aggregate frames under group=1 = a pair wrongly entered hier mode
+    probs = hier_tripwires(_hier_art(idle_agg=3))
+    assert any("under group=1" in p for p in probs)
+
+
 def test_shape_mismatch_refuses_cross_shape_compare(capsys):
     prior = {"device_shape": "cpu:3", "metric": "m"}
     new = {"device_shape": "cpu:8", "metric": "m"}

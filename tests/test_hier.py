@@ -1,0 +1,475 @@
+"""Hierarchical topology-aware push aggregation (balance/hier.py +
+train/sharded_ps.py psH lane) — PR16 acceptance:
+
+- knob grammar: parse-or-refuse-loudly + the shared seeded fuzzer
+  convention (MINIPS_HEDGE/MINIPS_SLOW, PR15);
+- topology/election units: host_of, group_ranks, elect;
+- stamp folding: an aggregated frame's stamp is the MIN over its
+  contributors' clocks, and owner-side admission with hier floors is
+  identical to the worst contributor pushing alone;
+- the 3-rank BSP lockstep drills: group=2 with compression off is
+  BITWISE equal to the flat wire (HIER-WIN's bitwise leg), group=1
+  (armed-idle) and agg=0 (accounting-only) are bitwise equal too
+  (HIER-IDLE), with the per-level byte counters as engagement
+  evidence;
+- the slow tier: seeded SIGKILL of a LEADER mid-run — survivors
+  complete bitwise with zero lost frames, and the flight boxes carry
+  ``hier_leader_elect``/``hier_fallback``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu.balance.hier import (HierConfig, elect, group_ranks,
+                                     host_of, maybe_config)
+from minips_tpu.consistency.gate import RETIRED_CLOCK, admits
+from minips_tpu.train.sharded_ps import ShardedTable
+
+# ------------------------------------------------------------- grammar
+
+
+def test_hier_config_parses_and_refuses():
+    c = HierConfig.parse("group=2,retain=8,agg=1")
+    assert (c.group, c.retain, c.agg) == (2, 8, 1)
+    d = HierConfig.parse("1")
+    assert (d.group, d.retain, d.agg) == (1, 64, 1)
+    assert HierConfig.parse("") is None
+    assert HierConfig.parse("0") is None
+    assert HierConfig.parse("group=2,agg=0").agg == 0
+    for bad, frag in {"explode=1": "unknown knob",
+                      "group": "k=v",
+                      "group=abc": "bad value",
+                      "group=0": "group",
+                      "retain=0": "retain",
+                      "agg=2": "agg",
+                      "agg=0.5": "bad value"}.items():
+        with pytest.raises(ValueError, match=frag):
+            HierConfig.parse(bad)
+
+
+def test_hier_group_local_follows_the_launcher(monkeypatch):
+    monkeypatch.delenv("MINIPS_LOCAL_PROCS", raising=False)
+    # outside a launcher 'local' degrades to 1: armed-idle, never a
+    # wrong tree
+    assert HierConfig.parse("group=local").group == 1
+    monkeypatch.setenv("MINIPS_LOCAL_PROCS", "4")
+    assert HierConfig.parse("group=local").group == 4
+    monkeypatch.setenv("MINIPS_HIER", "group=local,retain=7")
+    c = maybe_config(None)
+    assert (c.group, c.retain) == (4, 7)
+    # explicit spec wins over the env
+    assert maybe_config("0") is None
+
+
+def test_hier_knob_fuzzer_parse_or_refuse_loudly():
+    """The shared MINIPS_* spec-hygiene fuzzer (PR15 convention):
+    seeded random specs from the alphabet parse or raise ValueError,
+    deterministically — never a half-configured tree."""
+    rng = np.random.default_rng(20260804)
+    vocab = ["group", "retain", "agg", "bogus"]
+    vals = ["0", "1", "3", "2.5", "-1", "abc", "", "1e9", "0.5",
+            "local"]
+    for _ in range(200):
+        n = int(rng.integers(0, 5))
+        spec = ",".join(
+            f"{vocab[rng.integers(0, len(vocab))]}"
+            f"={vals[rng.integers(0, len(vals))]}"
+            for _ in range(n))
+        outcomes = []
+        for _rep in range(2):
+            try:
+                c = HierConfig.parse(spec)
+                outcomes.append(("ok", c is None))
+            except ValueError as e:
+                outcomes.append(("refused", str(e)))
+            except Exception as e:  # noqa: BLE001 - the contract
+                pytest.fail(f"hier spec {spec!r} raised "
+                            f"{type(e).__name__}: {e}")
+        assert outcomes[0] == outcomes[1], spec
+
+
+# ------------------------------------------------------ topology units
+
+
+def test_host_of_and_group_ranks_contiguous():
+    assert [host_of(r, 2) for r in range(5)] == [0, 0, 1, 1, 2]
+    assert group_ranks(0, 2, 3) == [0, 1]
+    assert group_ranks(1, 2, 3) == [0, 1]
+    assert group_ranks(2, 2, 3) == [2]       # the tail singleton
+    assert group_ranks(5, 4, 6) == [4, 5]
+    assert group_ranks(0, 1, 3) == [0]       # group=1: every group
+
+
+def test_elect_lowest_live_rank():
+    assert elect([0, 1]) == 0
+    assert elect([0, 1], excluded=[0]) == 1
+    assert elect([0, 1], excluded=[0, 1]) is None
+    assert elect([3, 2, 5], excluded=[2]) == 3  # deterministic order
+
+
+# --------------------------------------------------------- in-proc rig
+
+
+class _LockstepCons:
+    """Shared lockstep clock vector (the run_bsp_lockstep stub,
+    tests/test_chaos_reliable.py) widened to 3 ranks."""
+
+    clocks = [0, 0, 0]
+    staleness = 0
+
+    def __init__(self, rank):
+        self.rank = rank
+
+    @property
+    def clock(self):
+        return self.clocks[self.rank]
+
+    def admit_pull(self, clk):
+        return min(self.clocks) >= clk
+
+    def serving_clock(self, requester):
+        return min(self.clocks)
+
+
+def _mk_tables(buses, name, hier_spec=""):
+    _LockstepCons.clocks = [0, 0, 0]
+    tables = [ShardedTable(name, 96, 2, buses[i], i, 3, updater="sgd",
+                           lr=0.5, pull_timeout=20.0)
+              for i in range(3)]
+    for i, t in enumerate(tables):
+        t.bind_consistency(_LockstepCons(i))
+        if hier_spec:
+            t.attach_hier(HierConfig.parse(hier_spec))
+        t._w[...] = np.arange(32 * 2, dtype=np.float32
+                              ).reshape(32, 2) / 7.0
+    return tables
+
+
+# ------------------------------------------------------- stamp folding
+
+
+def test_aggregate_stamp_is_min_over_contributors():
+    """The flush's psP head carries hmin = min over the bucketed
+    contributions' clocks, and its hfr/hfv floor claims carry exactly
+    the group boundary floors that released the flush."""
+    from tests.conftest import mk_loopback_buses
+
+    buses = mk_loopback_buses(3)
+    try:
+        tables = _mk_tables(buses, "st", "group=2")
+        t0 = tables[0]                       # leader of group {0, 1}
+        sent = []
+        real_send = t0.bus.send
+
+        def spy(dest, kind, head, blob=b"", **kw):
+            if kind.startswith("psP:"):
+                sent.append((dest, dict(head)))
+            return real_send(dest, kind, head, blob=blob, **kw)
+
+        t0.bus.send = spy
+        _LockstepCons.clocks = [5, 3, 5]
+        k0 = np.array([65, 70], np.int64)
+        g0 = np.ones((2, 2), np.float32)
+        t0._hier_contribute(0, 2, k0, g0)    # my own slice, clk 5
+        # the member's contribution arrives on the psH lane at clk 3
+        k1 = np.array([72, 80], np.int64)
+        g1 = np.full((2, 2), 2.0, np.float32)
+        blob = k1.tobytes() + g1.tobytes()
+        t0._on_hier(1, {"op": "c", "o": 2, "n": 2, "clk": 3,
+                        "__blob__": blob, **t0._cfg_header()})
+        # both boundaries land -> group min advances -> flush
+        t0._on_hier(1, {"op": "b", "f": 9})
+        t0.hier_boundary()                   # own floor = clk + 1 = 6
+        aggs = [h for _, h in sent if "hmin" in h]
+        assert len(aggs) == 1, sent
+        head = aggs[0]
+        assert head["hmin"] == 3             # min(5, 3)
+        floors = dict(zip(head["hfr"], head["hfv"]))
+        assert floors == {0: 6, 1: 9}
+        assert t0.hier_counters["agg_frames"] == 1
+        assert t0.hier_counters["agg_rows"] == 4
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_owner_admission_equals_worst_contributor_alone():
+    """Owner-side ``_admit_clk`` with hier floors is the shared
+    ``gate.admits`` predicate evaluated at min(floors): a fleet of
+    contributors admits exactly like the WORST one pushing alone, and
+    a retired contributor stops gating."""
+    from tests.conftest import mk_loopback_buses
+
+    buses = mk_loopback_buses(3)
+    try:
+        tables = _mk_tables(buses, "ad", "group=2")
+        t2 = tables[2]                       # owner across the group
+        assert t2._hier_floor == {0: 0, 1: 0}
+        _LockstepCons.clocks = [50, 50, 50]  # gossip never the binder
+        t2._on_hier(0, {"op": "f", "hfr": [0, 1], "hfv": [6, 9]})
+        assert t2._hier_floor_min() == 6
+        for clk in range(0, 12):
+            assert t2._admit_clk(clk) == admits(6, clk, 0)
+        # worst-alone: a floor dict holding ONLY the worst contributor
+        # admits identically
+        t2._hier_floor = {0: 6}
+        for clk in range(0, 12):
+            assert t2._admit_clk(clk) == admits(6, clk, 0)
+        # max-merge: a zombie's stale (lower) claim cannot roll back
+        t2._hier_floor = {0: 6, 1: 9}
+        t2._on_hier(0, {"op": "f", "hfr": [0, 1], "hfv": [2, 2]})
+        assert t2._hier_floor == {0: 6, 1: 9}
+        # the member's own waiver is the only lowering path — and a
+        # RETIRED contributor stops gating entirely
+        t2._on_hier(0, {"op": "r"})
+        t2._on_hier(1, {"op": "r"})
+        assert t2._hier_floor_min() == RETIRED_CLOCK
+        # floors no longer bind — only the gossip bound remains
+        assert t2._admit_clk(50)
+        assert not t2._admit_clk(51)
+    finally:
+        for b in buses:
+            b.close()
+
+
+# -------------------------------------------------- lockstep bitwise
+
+
+def run_hier_lockstep(hier_spec: str, stats: "dict | None" = None):
+    """3-rank in-proc BSP lockstep (the run_bsp_lockstep harness shape,
+    tests/test_chaos_reliable.py) with host groups {0,1} and {2}:
+    ranks 0 and 1 push DISJOINT key sets into rank 2's shard (the
+    cross-group tree lane; rank 0 leads, rank 1 contributes over psH),
+    rank 2 pushes flat into shards 0 and 1 (singleton group). Every
+    shard's rows are touched by exactly one pusher, so apply order
+    commutes bitwise — identical streams must produce identical state
+    whatever lane carried them. Returns (final weights per rank,
+    frames_lost per rank)."""
+    from tests.conftest import mk_loopback_buses
+
+    buses = mk_loopback_buses(3)
+    keysets = [np.array([65, 70, 65, 79]),   # rank0 -> owner2 rows
+               np.array([72, 80, 72, 88]),   # rank1 -> owner2, disjoint
+               np.array([1, 40, 1, 50])]     # rank2 -> owners 0 and 1
+    try:
+        tables = _mk_tables(buses, "t", hier_spec)
+        for _ in range(4):
+            rows = [tables[r].pull(keysets[r]) for r in range(3)]
+            for r in range(3):
+                tables[r].push(keysets[r], 0.1 * rows[r] + 1.0)
+            for r in range(3):   # read-your-own-writes, same step
+                tables[r].pull(keysets[r])
+            for r in range(3):   # the trainer-tick boundary slot
+                tables[r].hier_boundary()
+            for r in range(3):
+                _LockstepCons.clocks[r] += 1
+        # quiesce the tree exactly like trainer finalize: member and
+        # leader rendezvous, so run concurrently
+        ths = [threading.Thread(target=tables[r].hier_finalize,
+                                args=(15.0,)) for r in range(3)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=30.0)
+        assert not any(th.is_alive() for th in ths), "finalize wedged"
+        if hier_spec and HierConfig.parse(hier_spec).group > 1 \
+                and HierConfig.parse(hier_spec).agg:
+            # settle: the owner's floors hit RETIRED only AFTER the
+            # last aggregated frame applied (same handler, in order)
+            deadline = time.monotonic() + 10.0
+            while tables[2]._hier_floor_min() != RETIRED_CLOCK:
+                assert time.monotonic() < deadline, \
+                    tables[2]._hier_floor
+                time.sleep(0.005)
+        if stats is not None:
+            for key in ("l1_tx_bytes", "l2_tx_bytes", "l1_frames",
+                        "l2_frames", "agg_frames", "contribs"):
+                stats[key] = sum(t.hier_counters[key] for t in tables)
+        lost = [b.frames_lost for b in buses]
+        return [t._w.copy() for t in tables], lost
+    finally:
+        for b in buses:
+            b.close()
+
+
+@pytest.fixture(scope="module")
+def flat_lockstep():
+    return run_hier_lockstep("")
+
+
+def test_hier_group2_exact_wire_is_bitwise_equal_to_flat(
+        flat_lockstep):
+    """THE tentpole bitwise pin (HIER-WIN's exactness leg): BSP with
+    compression off through the two-level tree — member contributions
+    summed at the leader, one aggregate per owner — lands bitwise the
+    flat wire's state, with the tree demonstrably engaged."""
+    flat, lost_flat = flat_lockstep
+    stats: dict = {}
+    hier, lost_hier = run_hier_lockstep("group=2", stats=stats)
+    assert lost_flat == [0, 0, 0] and lost_hier == [0, 0, 0]
+    for r in range(3):
+        np.testing.assert_array_equal(flat[r], hier[r])
+    # engagement evidence: the member->leader lane and the leader leg
+    # both carried frames
+    assert stats["contribs"] > 0
+    assert stats["agg_frames"] > 0
+    assert stats["l1_tx_bytes"] > 0 and stats["l2_tx_bytes"] > 0
+
+
+def test_hier_armed_idle_is_bitwise_equal_to_off(flat_lockstep):
+    """HIER-IDLE: group=1 arms the layer but leaves every pair flat —
+    bitwise equal to off AND zero per-level counters (the
+    zeros-when-idle wire_record contract)."""
+    flat, _ = flat_lockstep
+    stats: dict = {}
+    idle, lost = run_hier_lockstep("1", stats=stats)
+    assert lost == [0, 0, 0]
+    for r in range(3):
+        np.testing.assert_array_equal(flat[r], idle[r])
+    assert all(v == 0 for v in stats.values()), stats
+
+
+def test_hier_accounting_only_arm_is_bitwise_with_counters(
+        flat_lockstep):
+    """The HIER-WIN flat arm (group=2,agg=0): pushes stay on the flat
+    wire — bitwise equal to off — while the per-level classification
+    still counts, so the bench can compare leader-leg bytes against a
+    like-accounted baseline."""
+    flat, _ = flat_lockstep
+    stats: dict = {}
+    acc, lost = run_hier_lockstep("group=2,agg=0", stats=stats)
+    assert lost == [0, 0, 0]
+    for r in range(3):
+        np.testing.assert_array_equal(flat[r], acc[r])
+    assert stats["agg_frames"] == 0 and stats["contribs"] == 0
+    assert stats["l2_tx_bytes"] > 0   # flat cross-group sends, counted
+
+
+def test_degenerate_tree_one_worker_per_host_is_flat(flat_lockstep):
+    """A fleet with one worker per host group is the degenerate tree:
+    no pair is ever in hier mode, no psH frame flows, state is bitwise
+    the flat wire's (the satellite's one-worker-per-host clause —
+    group=1 IS that topology under contiguous grouping)."""
+    from tests.conftest import mk_loopback_buses
+
+    flat, _ = flat_lockstep
+    buses = mk_loopback_buses(3)
+    try:
+        tables = _mk_tables(buses, "t", "group=1")
+        for t in tables:
+            # degenerate tree: every group is a singleton, nothing
+            # registered, routing always flat
+            assert t._hier_floor == {}
+            assert t._hier_route(2) is None or t.rank == 2
+    finally:
+        for b in buses:
+            b.close()
+    idle, _ = run_hier_lockstep("group=1")
+    for r in range(3):
+        np.testing.assert_array_equal(flat[r], idle[r])
+
+
+def test_hier_table_refusals_and_stats_shape():
+    """attach_hier's validation ladder (async push window, row cache)
+    and the hier_stats off-vs-armed shape."""
+    from tests.conftest import mk_loopback_buses
+
+    buses = mk_loopback_buses(3)
+    try:
+        t = ShardedTable("rf", 96, 2, buses[0], 0, 3, updater="sgd",
+                         lr=0.5, async_push=True)
+        with pytest.raises(ValueError, match="async_push"):
+            t.attach_hier(HierConfig.parse("group=2"))
+        t2 = ShardedTable("rf2", 96, 2, buses[1], 1, 3, updater="sgd",
+                          lr=0.5, cache_bytes=1 << 16)
+        with pytest.raises(ValueError, match="RowCache"):
+            t2.attach_hier(HierConfig.parse("group=2"))
+        t3 = ShardedTable("rf3", 96, 2, buses[2], 2, 3, updater="sgd",
+                          lr=0.5)
+        assert t3.hier_stats() is None       # off: None, not zeros
+        t3.attach_hier(HierConfig.parse("group=2"))
+        st = t3.hier_stats()
+        assert st is not None
+        assert st["l2_tx_bytes"] == 0 and st["agg_frames"] == 0
+        assert st["leader"] == 2             # singleton: leads itself
+        assert st["floor_min"] >= 0          # contributors registered
+    finally:
+        for b in buses:
+            b.close()
+
+
+# ------------------------------------------------------------ slow tier
+
+
+@pytest.mark.slow
+def test_leader_death_drill_survivors_bitwise_with_flight_events(
+        tmp_path):
+    """The leader-death drill: seeded SIGKILL of rank 0 — the leader
+    of host group {0,1} — mid-aggregation. Rank 1 falls back to direct
+    push (zero lost steps, zero unrecovered frames), re-elects itself,
+    survivors finish all steps and agree BITWISE; the flight boxes
+    carry ``hier_leader_elect`` and ``hier_fallback``."""
+    import tempfile
+
+    from minips_tpu import launch
+
+    run_id = str(91_000_000 + os.getpid())
+    flight_dir = os.path.join(tempfile.gettempdir(),
+                              f"minips-flight-{run_id}")
+    ck = str(tmp_path / "ck")
+    rc, events = launch.run_local_job_raw(
+        3, [sys.executable, "-m", "minips_tpu.apps.sharded_ps_example",
+            "--model", "sparse", "--mode", "ssp", "--staleness", "2",
+            "--iters", "30", "--batch", "64",
+            "--checkpoint-dir", ck, "--checkpoint-every", "5"],
+        base_port=None,
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                   "MINIPS_ELASTIC": "1",
+                   "MINIPS_HIER": "group=2",
+                   "MINIPS_CHAOS_KILL": "7:rank=0,step=12",
+                   "MINIPS_HEARTBEAT": "interval=0.1,timeout=1.0",
+                   "MINIPS_RUN_ID": run_id},
+        timeout=240.0, kill_on_failure=False)
+    dones = {r: ev[-1] for r, ev in enumerate(events)
+             if ev and ev[-1].get("event") == "done"}
+    assert set(dones) == {1, 2}, (rc, events)
+    for d in dones.values():
+        assert d["clock"] == 30
+        assert d["max_skew_seen"] <= 3           # SSP bound held
+        assert d["frames_dropped"] == 0          # zero poisons
+        assert d["wire_frames_lost"] == 0        # zero unrecovered
+        assert np.isfinite(d["loss_last"])
+        assert d["hier"] is not None
+        assert d["hier_spec"] == "group=2"
+    # rank 1 fell back when its leader died, then led its own group
+    h1 = dones[1]["hier"]
+    assert h1["fallbacks"] >= 1
+    assert h1["elections"] >= 1
+    assert h1["leader"] == 1
+    # survivors agree BITWISE on the final table
+    sums = [d["param_sum"] for d in dones.values()]
+    norms = [d["param_norm"] for d in dones.values()]
+    assert sums[0] == sums[1] and norms[0] == norms[1], (sums, norms)
+    # the post-mortem boxes carry the election and the fallback
+    kinds: list[str] = []
+    for r in (1, 2):
+        path = os.path.join(flight_dir, f"flight-rank{r}.json")
+        assert os.path.exists(path), os.listdir(flight_dir)
+        doc = json.load(open(path))
+        kinds += [e["kind"] for e in doc["events"]]
+    assert "hier_leader_elect" in kinds, sorted(set(kinds))
+    assert "hier_fallback" in kinds, sorted(set(kinds))
+    fb = next(e for r in (1, 2)
+              for e in json.load(open(os.path.join(
+                  flight_dir, f"flight-rank{r}.json")))["events"]
+              if e["kind"] == "hier_fallback")
+    assert fb["args"]["leader"] == 0
+    assert fb["args"]["why"] == "leader_dead"

@@ -300,3 +300,53 @@ def test_stats_and_shape_stamp_fields():
     assert st["comm"] == "blk8" and st["block"] == 64
     assert st["devices"] == 3
     assert st["waves"] == {"t": 0}
+
+
+def test_blk8_error_feedback_folds_and_fences(monkeypatch):
+    """Satellite (PR16): the blk8 reduce leg wires the error-feedback
+    hook a2a_reduce always returned — each device retains its
+    quantization residual, folds it into the next wave, and the LAST
+    finalize repays it with one exact-f32 fence wave, so no gradient
+    mass outlives the run. MINIPS_MESH_EF=0 is the kill switch (stats
+    report None, the off-vs-idle convention)."""
+    target = np.random.default_rng(5).normal(
+        size=(64, 4)).astype(np.float32)
+
+    def run(ef: bool):
+        monkeypatch.setenv("MINIPS_MESH_EF", "1" if ef else "0")
+        plane = MeshPlane(2, staleness=0, comm="blk8")
+        t = plane.add_table("t", 64, 4, updater="sgd", lr=0.4)
+        keys = [np.arange(0, 64, 2), np.arange(1, 64, 2)]
+        errs: list = []
+
+        def worker(r: int) -> None:
+            try:
+                h = plane.rank(r)
+                for _ in range(30):
+                    rows = h.tables["t"].pull(keys[r])
+                    h.tables["t"].push(keys[r], rows - target[keys[r]])
+                    h.tick()
+                h.finalize(timeout=30.0)
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        ths = [threading.Thread(target=worker, args=(r,))
+               for r in (0, 1)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=60.0)
+        assert not errs, errs
+        err = float(np.abs(plane.tables["t"].pull_all(0)
+                           - target).max())
+        return plane, err
+
+    plane_on, err_on = run(True)
+    st = plane_on.stats()["ef"]["t"]
+    assert st["folded_waves"] > 0          # EF engaged every wave
+    assert st["resident_rows"] == 0        # fence left nothing behind
+    assert st["fence_waves"] <= 1          # at most one repayment
+    assert err_on < 0.05                   # same band as the EF-less pin
+    plane_off, err_off = run(False)
+    assert plane_off.stats()["ef"] is None  # kill switch: off, not idle
+    assert plane_off.tables["t"]._rbuf is None

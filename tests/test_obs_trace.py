@@ -399,13 +399,14 @@ def test_wire_record_schema_full_layout():
                 "wire_frames_lost", "wire_frames_malformed", "timing",
                 "hist", "window", "heartbeat", "cache", "ef",
                 "reliable", "chaos", "serve", "rebalance", "membership",
-                "hedge", "slowness"}
+                "hedge", "slowness", "hier"}
     assert expected <= set(rec)
     # layers OFF in this run report None — not {} — and vice versa
     assert rec["cache"] is None
     assert rec["ef"] is None  # exact push wire: no residual store
     assert rec["hedge"] is None     # fail-slow plane off: both None
     assert rec["slowness"] is None
+    assert rec["hier"] is None      # two-level push tree off: None
     assert rec["reliable"] is None
     assert rec["chaos"] is None
     assert rec["rebalance"] is None
