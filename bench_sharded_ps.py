@@ -132,6 +132,13 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     # the in-mesh collective plane rides its own sweep via --plane; an
     # armed MINIPS_MESH must not reroute (or refuse) the wire arms
     env_extra["MINIPS_MESH"] = ""
+    # the hierarchical push tree + its hybrid (agg=mesh) backend ride
+    # their own sweeps; an armed MINIPS_HIER must not silently re-lane
+    # every wire arm's pushes through a tree (each arm's rate would
+    # then measure the tree, not the lever under test)
+    env_extra["MINIPS_HIER"] = ""
+    env_extra["MINIPS_HIER_MESH_COMM"] = ""
+    env_extra["MINIPS_HIER_MESH_DEVS"] = ""
     # head-codec arm config (the transport sweep): explicit empty keeps
     # an armed environment from leaking a format into the other arms
     env_extra["MINIPS_WIRE_FMT"] = wire_fmt or ""
@@ -546,6 +553,184 @@ def hier_arms(quick: bool = False) -> dict:
     grid["idle"] = drill("--hier-idle-drill")
     return grid
 
+
+def hybrid_arms(quick: bool = False) -> dict:
+    """HYBRID-WIN / HYBRID-IDLE (the hybrid data plane: the PR16 tree
+    with the leader's host-side f64 dedup loop swapped for a device
+    reduce over the in-host mesh, ``agg=mesh``). Three legs:
+
+    - TIMED: the bench worker, 3 procs, the seeded zipf sparse point
+      rows=128/dim=4096/batch=32 — small table, fat rows, small
+      batches: the host kernel's per-dim Python bincount loop costs
+      ~dim interpreter calls per owner per flush REGARDLESS of row
+      count, which is exactly what one jitted segment-sum +
+      reduce-scatter amortizes. f32 mesh comm (the quantizer is a net
+      tax on CPU hosts — docs/architecture.md carries the caveat; on a
+      real accelerator the blk8 tier is the bytes win). Alternating
+      rep pairs, median of rows/sec/proc: HYBRID-WIN wants hybrid
+      STRICTLY above the host-agg tree with cross-host bytes no worse
+      (identical flush protocol — the reduce backend never touches the
+      wire, so l2 bytes must match, not just not-regress).
+    - LOSS: the example-app trajectory leg (hier_arms' convention,
+      same seeds both arms) — the speed must not come from different
+      math.
+    - DRILLS: armed-idle (group=1,agg=mesh == off bitwise) and the
+      one-device degenerate mesh (== agg=host bitwise — THE shared
+      f64 kernel, deposit order preserved)."""
+    from minips_tpu import launch as _launch
+
+    reps = 2 if quick else 5
+    workload = {"path": "sparse", "rows": 128, "dim": 4096,
+                "batch": 32, "iters": 36, "warmup": 12,
+                "key_dist": "zipf", "staleness": 2,
+                "mesh_comm": "float32", "mesh_devices": 2}
+    argv = [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
+            "--path", "sparse", "--rows", "128", "--dim", "4096",
+            "--batch", "32", "--iters", "36", "--warmup", "12",
+            "--key-dist", "zipf", "--staleness", "2"]
+    env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+            # 2 host devices per proc: the in-host mesh the leader's
+            # reduce-scatter runs over (members' slots map onto it)
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "MINIPS_HIER_MESH_COMM": "float32",
+            "MINIPS_HIER_MESH_DEVS": "",
+            "MINIPS_RELIABLE": "", "MINIPS_REBALANCE": "",
+            "MINIPS_TRACE": "", "MINIPS_SERVE": "",
+            "MINIPS_BUS": "", "MINIPS_WIRE_FMT": "",
+            "MINIPS_CHAOS": "", "MINIPS_CHAOS_KILL": "",
+            "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
+            "MINIPS_ELASTIC": "", "MINIPS_SLOW": "",
+            "MINIPS_HEDGE": "", "MINIPS_OBS": "",
+            "MINIPS_FLIGHT": "", "MINIPS_HEARTBEAT": "",
+            "MINIPS_PUSH_COMM": ""}
+
+    def arm_once(hier_spec: str) -> dict:
+        try:
+            res = _launch.run_local_job(
+                3, list(argv), base_port=None,
+                env_extra={**env0, "MINIPS_HIER": hier_spec},
+                timeout=240.0)
+        except Exception as e:  # noqa: BLE001 - completion-gated
+            return {"completed": False, "error": str(e)[:300]}
+        hier = [d.get("hier") or {} for d in res]
+        hyb = [d.get("hybrid") or {} for d in res]
+        return {
+            "completed": all(d.get("event") == "done" for d in res),
+            "hier_spec": hier_spec,
+            "rows_per_sec_per_process": round(statistics.mean(
+                [d["rows_per_sec"] for d in res]), 1),
+            # cross-host evidence: the leader leg out of the tree
+            # ranks (0+1) — identical flush protocol, so the arms'
+            # bytes must MATCH (the no-worse gate reads both)
+            "l2_tx_bytes": sum(hier[r].get("l2_tx_bytes", 0)
+                               for r in (0, 1)),
+            "agg_frames": sum(h.get("agg_frames", 0) for h in hier),
+            "contribs": sum(h.get("contribs", 0) for h in hier),
+            "fallbacks": sum(h.get("fallbacks", 0) for h in hier),
+            # hybrid-block evidence (None-vs-zeros per wire_record):
+            # the mesh arm must show reduces on a REAL (>=2 device)
+            # mesh with zero fallbacks/demotions; the tree arm None
+            "mesh_reduces": sum(h.get("mesh_reduces", 0)
+                                for h in hyb),
+            "mesh_agg_fallbacks": sum(h.get("mesh_agg_fallbacks", 0)
+                                      for h in hyb),
+            "domain_demotions": sum(h.get("domain_demotions", 0)
+                                    for h in hyb),
+            "backend_mesh": max((h.get("backend_mesh", 0)
+                                 for h in hyb), default=0),
+            "wire_frames_lost": sum(d.get("wire_frames_lost", 0)
+                                    for d in res),
+        }
+
+    # alternating rep PAIRS (the drifting-host honesty rule): each rep
+    # runs tree then hybrid back-to-back, so thermal/background drift
+    # taxes both arms alike; the median rep is what the gate reads
+    runs: dict[str, list[dict]] = {"tree": [], "hybrid": []}
+    for _ in range(reps):
+        runs["tree"].append(arm_once("group=2"))
+        runs["hybrid"].append(arm_once("group=2,agg=mesh"))
+
+    def med(a: str) -> dict:
+        ok = [r for r in runs[a] if r.get("completed")]
+        if not ok:
+            return runs[a][-1]
+        by = sorted(ok, key=lambda r: r["rows_per_sec_per_process"])
+        return {**by[len(by) // 2], "reps": reps}
+
+    grid: dict = {"workload": workload, "group": 2,
+                  "tree_ranks": [0, 1], "owner_rank": 2,
+                  "tree": med("tree"), "hybrid": med("hybrid")}
+    t, h = grid["tree"], grid["hybrid"]
+    if t.get("completed") and h.get("completed"):
+        grid["rows_ratio"] = round(
+            h["rows_per_sec_per_process"]
+            / max(t["rows_per_sec_per_process"], 1e-9), 3)
+
+    # the trajectory leg: the example app's seeded loss stream under
+    # both backends (hier_arms' convention — dim-1 table, so this leg
+    # carries NO timing signal, deliberately: it answers "same math?",
+    # the timed leg above answers "faster?")
+    l_iters = 25 if quick else 40
+    lbase = [sys.executable, "-m",
+             "minips_tpu.apps.sharded_ps_example",
+             "--model", "sparse", "--mode", "bsp",
+             "--dim", "256", "--batch", "128",
+             "--iters", str(l_iters)]
+
+    def loss_arm(hier_spec: str) -> dict:
+        try:
+            res = _launch.run_local_job(
+                3, list(lbase), base_port=None,
+                env_extra={**env0, "MINIPS_PUSH_COMM": "topk8",
+                           "MINIPS_HIER": hier_spec},
+                timeout=240.0)
+            sums = {d.get("param_sum") for d in res}
+            return {
+                "completed": all(d.get("event") == "done"
+                                 for d in res),
+                "loss_first": res[0].get("loss_first"),
+                "loss_last": res[0].get("loss_last"),
+                "finals_agree": len(sums) == 1,
+                "mesh_reduces": sum((d.get("hybrid") or {}).get(
+                    "mesh_reduces", 0) for d in res),
+            }
+        except Exception as e:  # noqa: BLE001 - completion-gated
+            return {"completed": False, "error": str(e)[:300]}
+
+    grid["loss_tree"] = loss_arm("group=2")
+    grid["loss_hybrid"] = loss_arm("group=2,agg=mesh")
+
+    # the exactness legs (subprocess drills, stamp protocol): armed-
+    # idle == off bitwise; one-device degenerate mesh == host bitwise
+    def drill(flag: str) -> dict:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m",
+                 "minips_tpu.apps.sharded_ps_bench", flag],
+                capture_output=True, text=True, timeout=300.0,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env={**os.environ, "MINIPS_FORCE_CPU": "1",
+                     "JAX_PLATFORMS": "cpu", "MINIPS_MESH": "",
+                     "MINIPS_HIER": "", "MINIPS_PUSH_COMM": "",
+                     "MINIPS_HIER_MESH_DEVS": ""})
+            res = json.loads([ln for ln in proc.stdout.splitlines()
+                              if ln.startswith("{")][-1])
+            out = {"equal": bool(res.get("bitwise_equal")),
+                   "rows_checked": int(res.get("rows_checked", 0)),
+                   "agg_frames": res.get("agg_frames"),
+                   "mesh_reduces": res.get("mesh_reduces"),
+                   "mesh_agg_fallbacks": res.get("mesh_agg_fallbacks"),
+                   "domain_demotions": res.get("domain_demotions")}
+            if res.get("error"):
+                out["error"] = res["error"]
+            return out
+        except Exception as e:  # noqa: BLE001 - the gate reads this
+            return {"equal": False, "rows_checked": 0,
+                    "error": str(e)[:300]}
+
+    grid["idle"] = drill("--hybrid-idle-drill")
+    grid["degenerate"] = drill("--hybrid-degenerate-drill")
+    return grid
 
 
 def main() -> int:
@@ -1616,6 +1801,74 @@ def main() -> int:
                 res["collective_bytes_per_row_moved"],
         }
 
+    # the deposit-buffer A/B (this PR): the SPARSE path at the
+    # embedding shape — a big table (64Ki rows) of skinny rows where
+    # each wave touches a few hundred keys. The dense deposit stages a
+    # full [rows, dim] host buffer per logical rank regardless; the
+    # sparse deposit stages COO streams and densifies via segment-sum
+    # scatter ON DEVICE, so peak host bytes scale with TOUCHED rows.
+    # MESH-SPARSE gates: >= 4x peak-byte reduction, throughput no
+    # worse (same collective — the exchange is untouched, only the
+    # staging layout changes)
+    def _run_mesh_deposit_arm(dep: str) -> dict:
+        argv = [sys.executable, "-m",
+                "minips_tpu.apps.sharded_ps_bench",
+                "--path", "sparse", "--plane", "mesh",
+                "--mesh-ranks", "2", "--mesh-comm", "float32",
+                "--mesh-deposit", dep,
+                "--rows", str(1 << 16), "--dim", "8", "--batch", "64",
+                "--iters", str(iters), "--warmup", str(warmup),
+                "--staleness", "0"]
+        env = {**os.environ, "MINIPS_FORCE_CPU": "1",
+               "JAX_PLATFORMS": "cpu", "MINIPS_MESH": "",
+               "MINIPS_MESH_SPARSE": ""}
+        try:
+            proc = subprocess.run(argv, capture_output=True, text=True,
+                                  timeout=300.0, env=env)
+            if proc.returncode != 0:
+                raise RuntimeError(proc.stderr[-300:])
+            res = json.loads([ln for ln in proc.stdout.splitlines()
+                              if ln.startswith("{")][-1])
+        except Exception as e:  # noqa: BLE001 - completion-gated
+            return {"completed": False, "error": str(e)[:300]}
+        assert res.get("deposit") == dep, res
+        return {
+            "completed": True, "deposit": dep,
+            "rows_per_sec_per_process": res["rows_per_sec"],
+            "peak_deposit_bytes": res["peak_deposit_bytes"],
+            "sparse_waves": res["sparse_waves"],
+            "collective_bytes_per_row_moved":
+                res["collective_bytes_per_row_moved"],
+        }
+
+    def _mesh_sparse_arms(reps: int) -> dict:
+        runs: dict[str, list[dict]] = {"dense": [], "sparse": []}
+        for _ in range(reps):  # alternating pairs, like every A/B
+            runs["dense"].append(_run_mesh_deposit_arm("dense"))
+            runs["sparse"].append(_run_mesh_deposit_arm("sparse"))
+
+        def med(a: str) -> dict:
+            ok = [r for r in runs[a] if r.get("completed")]
+            if not ok:
+                return runs[a][-1]
+            by = sorted(ok,
+                        key=lambda r: r["rows_per_sec_per_process"])
+            return {**by[len(by) // 2], "reps": reps}
+
+        g = {"workload": {"path": "sparse", "rows": 1 << 16,
+                          "dim": 8, "batch": 64, "mesh_ranks": 2,
+                          "mesh_comm": "float32"},
+             "dense": med("dense"), "sparse": med("sparse")}
+        dn, sp = g["dense"], g["sparse"]
+        if dn.get("completed") and sp.get("completed"):
+            g["peak_bytes_ratio"] = round(
+                dn["peak_deposit_bytes"]
+                / max(sp["peak_deposit_bytes"], 1), 3)
+            g["rows_ratio"] = round(
+                sp["rows_per_sec_per_process"]
+                / max(dn["rows_per_sec_per_process"], 1e-9), 3)
+        return g
+
     def _mesh_arms(reps: int) -> dict:
         arms = {"wire": lambda: {
                     **_run(3, "dense", iters, warmup, "zmq"),
@@ -1657,6 +1910,7 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 - the gate reads this
             grid["bitwise"] = {"equal": False, "rows_checked": 0,
                                "error": str(e)[:300]}
+        grid["sparse_deposit"] = _mesh_sparse_arms(reps)
         return grid
 
     mesh_grid = _mesh_arms(o_reps)
@@ -1683,6 +1937,13 @@ def main() -> int:
     # HIER-WIN wants the tree's cross-host leader leg >= 1.7x fewer
     # bytes with matching loss; the bitwise/idle drills pin exactness
     hier_grid = hier_arms(quick=args.quick)
+
+    # THE HYBRID SWEEP (this PR): the tree's leader reduce moved onto
+    # the in-host device mesh — HYBRID-WIN wants the hybrid arm
+    # strictly faster than the host-agg tree at matching loss with
+    # cross-host bytes no worse; HYBRID-IDLE and the one-device
+    # degenerate drill pin exactness
+    hybrid_grid = hybrid_arms(quick=args.quick)
 
     # resolved JAX backend stamp (satellite): probed in a SUBPROCESS so
     # the driver never grabs the TPU out from under a worker (libtpu is
@@ -1750,6 +2011,7 @@ def main() -> int:
         "partition_3proc": partition_grid,
         "fail_slow_3proc": fail_slow_grid,
         "hier_agg_3proc": hier_grid,
+        "hybrid_agg_3proc": hybrid_grid,
         "mesh_plane_fused": mesh_grid,
     }))
     return 0

@@ -1171,6 +1171,144 @@ def hier_tripwires(new: dict) -> list[str]:
     return problems
 
 
+def hybrid_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``hybrid_agg_3proc`` sweep
+    (the hybrid data plane: the PR16 tree with the leader's reduce
+    moved onto the in-host device mesh, ``MINIPS_HIER agg=mesh``);
+    vacuous when the sweep is absent.
+
+    - HYBRID-WIN: both arms (host-agg tree vs mesh-agg hybrid, SAME
+      seeded zipf workload, alternating rep medians) must complete
+      with zero unrecovered frames; the hybrid arm must have reduced
+      on a REAL mesh (``backend_mesh`` = 1, ``mesh_reduces`` > 0,
+      zero ``mesh_agg_fallbacks``/``domain_demotions`` on the clean
+      wire); its rows/sec/proc must be STRICTLY above the tree's; its
+      cross-host leader-leg bytes must be no worse than the tree's
+      within 10% (the flush protocol is identical — the tolerance
+      absorbs SSP flush-boundary jitter moving dedup opportunities
+      between flushes, nothing else; a re-laned wire shows up as 2x);
+      and the example-app loss trajectories must match within 5% (the
+      speed must not come from different math).
+    - HYBRID-IDLE: the armed-idle drill (group=1,agg=mesh) must be
+      bitwise-equal to off with ZERO mesh reduces, and the one-device
+      DEGENERATE drill bitwise-equal too with the mesh lane provably
+      on (``mesh_reduces`` > 0, zero fallbacks) — the degenerate tier
+      is THE shared f64 dedup kernel, so off == host == 1-dev mesh."""
+    grid = new.get("hybrid_agg_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    tree = grid.get("tree") or {}
+    hyb = grid.get("hybrid") or {}
+    for name, a in (("tree", tree), ("hybrid", hyb)):
+        if not a.get("completed"):
+            problems.append(
+                f"HYBRID-WIN hybrid_agg_3proc/{name}: completed="
+                f"{a.get('completed')!r} — both arms must finish on "
+                "the clean wire")
+        elif a.get("wire_frames_lost", 0):
+            problems.append(
+                f"HYBRID-WIN hybrid_agg_3proc/{name}: "
+                f"{a['wire_frames_lost']} unrecovered frames")
+    if tree.get("completed") and hyb.get("completed"):
+        if not hyb.get("backend_mesh") or not hyb.get("mesh_reduces"):
+            problems.append(
+                f"HYBRID-WIN hybrid_agg_3proc/hybrid: backend_mesh="
+                f"{hyb.get('backend_mesh')!r} mesh_reduces="
+                f"{hyb.get('mesh_reduces')!r} — the mesh backend "
+                "never engaged; the arm is mislabeled host-agg")
+        if hyb.get("mesh_agg_fallbacks", 0) \
+                or hyb.get("domain_demotions", 0):
+            problems.append(
+                f"HYBRID-WIN hybrid_agg_3proc/hybrid: "
+                f"mesh_agg_fallbacks={hyb.get('mesh_agg_fallbacks')!r} "
+                f"domain_demotions={hyb.get('domain_demotions')!r} on "
+                "a clean wire — the mesh lane is sick and the arms "
+                "are not comparable")
+        if tree.get("mesh_reduces", 0):
+            problems.append(
+                f"HYBRID-WIN hybrid_agg_3proc/tree: "
+                f"{tree['mesh_reduces']} mesh reduces in the HOST-agg "
+                "arm — the baseline silently ran the hybrid backend")
+        tr, hr = (tree.get("rows_per_sec_per_process"),
+                  hyb.get("rows_per_sec_per_process"))
+        if not (isinstance(tr, (int, float))
+                and isinstance(hr, (int, float)) and hr > tr):
+            problems.append(
+                f"HYBRID-WIN hybrid_agg_3proc: hybrid {hr!r} "
+                f"rows/s/proc is not strictly above the host-agg "
+                f"tree's {tr!r} — the device reduce is not beating "
+                "the host f64 kernel on the seeded point")
+        tb, hb = tree.get("l2_tx_bytes"), hyb.get("l2_tx_bytes")
+        if not (isinstance(tb, (int, float))
+                and isinstance(hb, (int, float)) and tb > 0
+                and hb <= 1.10 * tb):
+            problems.append(
+                f"HYBRID-WIN hybrid_agg_3proc: hybrid cross-host "
+                f"bytes {hb!r} exceed the tree's {tb!r} by > 10% — "
+                "the reduce backend must not touch the wire (the "
+                "tolerance absorbs SSP flush-boundary jitter only)")
+    lt, lh = grid.get("loss_tree") or {}, grid.get("loss_hybrid") or {}
+    if not lt.get("completed") or not lh.get("completed") \
+            or not lt.get("finals_agree") or not lh.get("finals_agree"):
+        problems.append(
+            f"HYBRID-WIN hybrid_agg_3proc/loss: completed="
+            f"({lt.get('completed')!r}, {lh.get('completed')!r}) "
+            f"finals_agree=({lt.get('finals_agree')!r}, "
+            f"{lh.get('finals_agree')!r}) — the trajectory leg must "
+            "finish with rank-agreeing finals in both arms")
+    else:
+        tl, hl = lt.get("loss_last"), lh.get("loss_last")
+        if not (isinstance(tl, (int, float))
+                and isinstance(hl, (int, float))
+                and abs(hl - tl) <= 0.05 * max(abs(tl), 1e-9)):
+            problems.append(
+                f"HYBRID-WIN hybrid_agg_3proc: loss_last {hl!r} "
+                f"(hybrid) vs {tl!r} (tree) diverge > 5% — the mesh "
+                "reduce changed what the model learns")
+        if not lh.get("mesh_reduces"):
+            problems.append(
+                "HYBRID-WIN hybrid_agg_3proc/loss_hybrid: 0 mesh "
+                "reduces — the trajectory leg never exercised the "
+                "backend it certifies")
+    idle = grid.get("idle") or {}
+    if not idle.get("equal") or not idle.get("rows_checked"):
+        problems.append(
+            f"HYBRID-IDLE hybrid_agg_3proc/idle: equal="
+            f"{idle.get('equal')!r} rows_checked="
+            f"{idle.get('rows_checked')!r}"
+            + (f" error={idle.get('error')!r}" if idle.get("error")
+               else "")
+            + " — armed-idle (group=1,agg=mesh) must be bitwise-equal "
+            "to off")
+    elif idle.get("mesh_reduces", 0) or idle.get("agg_frames", 0):
+        problems.append(
+            f"HYBRID-IDLE hybrid_agg_3proc/idle: mesh_reduces="
+            f"{idle.get('mesh_reduces')!r} agg_frames="
+            f"{idle.get('agg_frames')!r} fired under group=1 — "
+            "armed-IDLE means no flush ever runs")
+    deg = grid.get("degenerate") or {}
+    if not deg.get("equal") or not deg.get("rows_checked"):
+        problems.append(
+            f"HYBRID-IDLE hybrid_agg_3proc/degenerate: equal="
+            f"{deg.get('equal')!r} rows_checked="
+            f"{deg.get('rows_checked')!r}"
+            + (f" error={deg.get('error')!r}" if deg.get("error")
+               else "")
+            + " — the one-device mesh must be bitwise-equal to the "
+            "host path (THE shared dedup kernel, deposit order "
+            "preserved)")
+    elif not deg.get("mesh_reduces") or deg.get("mesh_agg_fallbacks",
+                                               0):
+        problems.append(
+            f"HYBRID-IDLE hybrid_agg_3proc/degenerate: mesh_reduces="
+            f"{deg.get('mesh_reduces')!r} mesh_agg_fallbacks="
+            f"{deg.get('mesh_agg_fallbacks')!r} — equal because the "
+            "mesh lane silently disarmed (or fell back), not because "
+            "the degenerate tier is exact")
+    return problems
+
+
 def mesh_tripwires(new: dict) -> list[str]:
     """Absolute (prior-free) gates on the ``mesh_plane_fused`` sweep
     (the in-mesh collective data plane, train/mesh_plane.py); vacuous
@@ -1219,6 +1357,46 @@ def mesh_tripwires(new: dict) -> list[str]:
                else "")
             + " — BSP on the mesh plane must be bitwise-equal to the "
             "zmq wire path under the lockstep drill")
+    # MESH-SPARSE (this PR): the deposit-buffer A/B at the embedding
+    # shape — the COO/segment-sum staging must cut PEAK host deposit
+    # bytes >= 4x vs the dense pre-stacked buffers (it scales with
+    # touched rows, the dense one with the table) at throughput no
+    # worse than 10% below dense (same collective; only the staging
+    # layout changes), with the sparse waves provably the ones that
+    # ran. Vacuous when the sub-grid is absent (older artifacts).
+    sd = grid.get("sparse_deposit")
+    if sd is not None:
+        dn, sp = sd.get("dense") or {}, sd.get("sparse") or {}
+        if not dn.get("completed") or not sp.get("completed"):
+            problems.append(
+                f"MESH-SPARSE mesh_plane_fused/sparse_deposit: "
+                f"completed=({dn.get('completed')!r}, "
+                f"{sp.get('completed')!r}) — both deposit arms must "
+                "finish")
+        else:
+            ratio = sd.get("peak_bytes_ratio")
+            if not (isinstance(ratio, (int, float)) and ratio >= 4.0):
+                problems.append(
+                    f"MESH-SPARSE mesh_plane_fused/sparse_deposit: "
+                    f"peak_bytes_ratio={ratio!r} < 4.0 — the COO "
+                    "staging is not earning its keep at the "
+                    "embedding shape (dense peak / sparse peak)")
+            rr = sd.get("rows_ratio")
+            if not (isinstance(rr, (int, float)) and rr >= 0.90):
+                problems.append(
+                    f"MESH-SPARSE mesh_plane_fused/sparse_deposit: "
+                    f"rows_ratio={rr!r} < 0.90 — the per-wave gather "
+                    "is eating more than the staging win is worth")
+            if not sp.get("sparse_waves"):
+                problems.append(
+                    "MESH-SPARSE mesh_plane_fused/sparse_deposit: 0 "
+                    "sparse waves in the sparse arm — the peak-byte "
+                    "win is mislabeled dense staging")
+            if dn.get("sparse_waves", 0):
+                problems.append(
+                    f"MESH-SPARSE mesh_plane_fused/sparse_deposit: "
+                    f"{dn['sparse_waves']} sparse waves in the DENSE "
+                    "arm — the baseline silently ran the sparse path")
     return problems
 
 
@@ -1352,7 +1530,8 @@ def main(argv: list[str] | None = None) -> int:
                 + serve_tripwires(new) + elastic_tripwires(new)
                 + control_plane_tripwires(new)
                 + partition_tripwires(new) + fail_slow_tripwires(new)
-                + hier_tripwires(new) + mesh_tripwires(new))
+                + hier_tripwires(new) + hybrid_tripwires(new)
+                + mesh_tripwires(new))
     pts = throughput_points(new)
     print(f"bench-regression: {len(pts)} throughput points checked "
           f"against {len(throughput_points(prior))} prior")

@@ -132,7 +132,9 @@ def _run_hier_drill(hier_spec: str) -> int:
     loudly instead of silently skipping."""
     out = {"event": "drill", "hier_spec": hier_spec,
            "bitwise_equal": False, "rows_checked": 0,
-           "agg_frames": None, "l2_frames": None}
+           "agg_frames": None, "l2_frames": None,
+           "mesh_reduces": None, "mesh_agg_fallbacks": None,
+           "domain_demotions": None}
     try:
         import minips_tpu
 
@@ -154,6 +156,12 @@ def _run_hier_drill(hier_spec: str) -> int:
             # the gate checks the counters, not just the verdict
             "agg_frames": st.get("agg_frames"),
             "l2_frames": st.get("l2_frames"),
+            # the hybrid (agg=mesh) drills add the backend's counters:
+            # the degenerate drill must show reduces with ZERO
+            # fallbacks/demotions, the idle drill all-zero
+            "mesh_reduces": st.get("mesh_reduces"),
+            "mesh_agg_fallbacks": st.get("mesh_agg_fallbacks"),
+            "domain_demotions": st.get("domain_demotions"),
         })
     except Exception as e:  # noqa: BLE001 - the gate reads the stamp
         out["error"] = repr(e)[:300]
@@ -173,7 +181,8 @@ def _run_mesh(args) -> int:
     from minips_tpu.train.mesh_plane import MeshPlane
 
     n = args.mesh_ranks
-    plane = MeshPlane(n, staleness=args.staleness, comm=args.mesh_comm)
+    plane = MeshPlane(n, staleness=args.staleness, comm=args.mesh_comm,
+                      deposit=args.mesh_deposit)
     table = plane.add_table("b", args.rows, args.dim,
                             updater=args.updater, lr=0.05)
     B, dim = args.batch, args.dim
@@ -250,6 +259,13 @@ def _run_mesh(args) -> int:
         "aggregate_rows_per_sec": round(sum(rates), 1),
         "waves": stats["waves"]["b"],
         "gate_waits": stats["gate_waits"],
+        # deposit-stage accounting (the mesh_sparse arm's evidence):
+        # dense = fixed pre-stacked [rows, dim] buffers, sparse = COO
+        # staging + segment-sum densify on device — peak host bytes is
+        # the number the arm's >=4x reduction gate reads
+        "deposit": stats["deposit"],
+        "peak_deposit_bytes": stats["peak_deposit_bytes"]["b"],
+        "sparse_waves": stats["sparse_waves"],
         "collective_bytes": stats["collective_bytes"],
         "collective_bytes_per_row_moved": round(
             cb_timed / max(sum(rows_counts), 1), 3),
@@ -364,6 +380,17 @@ def main(argv=None) -> int:
                          "scatter, or blk8 — blockwise absmax int8 "
                          "codes inside the collective (EQuARX-style; "
                          "the PR9 host-wire codec, second transport)")
+    ap.add_argument("--mesh-deposit", choices=["dense", "sparse"],
+                    default=None,
+                    help="mesh plane deposit-buffer shape: 'dense' "
+                         "pre-stacked [rows, dim] host buffers (the "
+                         "PR11 layout), or 'sparse' — COO staging + "
+                         "on-device segment-sum densify, trading a "
+                         "per-wave gather for peak host memory that "
+                         "scales with TOUCHED rows instead of the "
+                         "table (the embedding-shaped regime). Env "
+                         "spelling: MINIPS_MESH_SPARSE=1 (explicit "
+                         "flag wins); default dense")
     ap.add_argument("--mesh-bitwise-drill", action="store_true",
                     help="run the BSP zmq-vs-mesh bitwise lockstep "
                          "drill and emit its stamp instead of a bench "
@@ -385,6 +412,21 @@ def main(argv=None) -> int:
                          "exactness leg: aggregation re-lanes exact "
                          "contributions, bitwise equal by "
                          "construction)")
+    ap.add_argument("--hybrid-idle-drill", action="store_true",
+                    help="run the 3-rank hier lockstep drill with the "
+                         "hybrid plane armed-idle (group=1,agg=mesh — "
+                         "every group a singleton, no flush ever runs) "
+                         "vs off and emit its bitwise stamp (the "
+                         "artifact's HYBRID-IDLE input: armed "
+                         "bookkeeping must perturb nothing)")
+    ap.add_argument("--hybrid-degenerate-drill", action="store_true",
+                    help="run the 3-rank hier lockstep drill with the "
+                         "hybrid plane on a ONE-device mesh "
+                         "(group=2,agg=mesh + MINIPS_HIER_MESH_DEVS=1) "
+                         "vs off and emit its bitwise stamp: the "
+                         "degenerate tier runs THE shared f64 dedup "
+                         "kernel in deposit order, so off == agg=host "
+                         "== one-device mesh bit-for-bit")
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="write this rank's wire trace (Chrome-trace "
                          "JSON, obs/tracer.py) into DIR — the flag "
@@ -405,6 +447,14 @@ def main(argv=None) -> int:
         return _run_hier_drill("1")
     if args.hier_bitwise_drill:
         return _run_hier_drill("group=2")
+    if args.hybrid_idle_drill:
+        return _run_hier_drill("group=1,agg=mesh")
+    if args.hybrid_degenerate_drill:
+        # pin the one-device tier BEFORE the lockstep builds its
+        # aggregators — the driver may also set it; either spelling
+        # lands on the same degenerate host-kernel path
+        os.environ["MINIPS_HIER_MESH_DEVS"] = "1"
+        return _run_hier_drill("group=2,agg=mesh")
     if plane_kind == "mesh":
         if args.storm or args.overlap or args.cache_bytes \
                 or args.serve or args.compute != "none":

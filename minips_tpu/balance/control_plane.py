@@ -64,7 +64,7 @@ from typing import Iterable, Optional
 from minips_tpu.obs import flight as _fl
 
 __all__ = ["CoordinatorLease", "SuspicionQuorum", "successor_of",
-           "quorum_needed"]
+           "quorum_needed", "expand_to_domains"]
 
 
 def successor_of(live: Iterable[int]) -> Optional[int]:
@@ -73,6 +73,23 @@ def successor_of(live: Iterable[int]) -> Optional[int]:
     table so every rank computes the same successor without a ballot."""
     live = set(live)
     return min(live) if live else None
+
+
+def expand_to_domains(ranks: Iterable[int], group: int,
+                      nprocs: int) -> set[int]:
+    """Expand a conviction set to WHOLE failure domains: under the
+    hybrid data plane (``MINIPS_HIER agg=mesh``) a host's ranks share
+    one device mesh, so any member's verdict implicates every rank of
+    its contiguous host group (the same ``rank // group`` topology as
+    ``balance/hier.host_of``). A pure function of the same inputs at
+    every rank — domain verdicts need no extra protocol round, exactly
+    like succession. ``group<=1`` is the identity (no domains)."""
+    g = max(1, int(group))
+    out: set[int] = set()
+    for r in ranks:
+        h = int(r) // g
+        out.update(range(h * g, min((h + 1) * g, int(nprocs))))
+    return out
 
 
 def quorum_needed(live: set[int], suspect: int) -> int:

@@ -49,6 +49,11 @@ Armed by ``MINIPS_HIER`` (off by default)::
     MINIPS_HIER="group=2,agg=0"     # accounting-only: flat wire +
                                     # per-level byte counters (the
                                     # HIER-WIN flat arm)
+    MINIPS_HIER="group=2,agg=mesh"  # hybrid plane: the leader reduces
+                                    # members' contributions on the
+                                    # host's device mesh (blk8 + EF)
+                                    # and ships the same one frame per
+                                    # owner cross-host
 
 Knob table: docs/api.md "Hierarchical aggregation"; protocol and
 honest limits: docs/architecture.md "The two-level push tree".
@@ -68,7 +73,7 @@ class HierConfig:
     string ``"1"`` = every default = armed-idle)."""
 
     def __init__(self, *, group: int = 1, retain: int = 64,
-                 agg: int = 1):
+                 agg=1):
         if group < 1:
             raise ValueError("MINIPS_HIER: group must be >= 1 rank "
                              "per host group (1 = armed-idle, every "
@@ -77,12 +82,19 @@ class HierConfig:
             raise ValueError("MINIPS_HIER: retain must be >= 1 unacked "
                              "step before the fallback hysteresis "
                              "trips")
-        if agg not in (0, 1):
+        if agg not in (0, 1, "mesh"):
             raise ValueError("MINIPS_HIER: agg must be 0 (accounting-"
-                             "only flat arm) or 1 (aggregate)")
+                             "only flat arm), 1 (host f64 aggregate) "
+                             "or 'mesh' (leader reduces on the host's "
+                             "device mesh)")
         self.group = int(group)    # ranks per contiguous host group
         self.retain = int(retain)  # unacked-step window before fallback
-        self.agg = int(agg)        # 0 = flat wire + per-level counters
+        # 0 = flat wire + per-level counters; 1 = leader host f64
+        # dedup; "mesh" = leader deposits members' contributions into
+        # a MeshAggregator and one device reduce-scatter produces the
+        # cross-host aggregate (falls back to the bitwise host kernel
+        # on degenerate one-device meshes)
+        self.agg = agg if agg == "mesh" else int(agg)
 
     @classmethod
     def parse(cls, spec: str) -> "Optional[HierConfig]":
@@ -95,7 +107,7 @@ class HierConfig:
         if spec in ("1", "on", "true"):
             return cls()
         kw: dict = {}
-        casts = {"group": _cast_group, "retain": int, "agg": int}
+        casts = {"group": _cast_group, "retain": int, "agg": _cast_agg}
         for item in filter(None, (e.strip() for e in spec.split(","))):
             if "=" not in item:
                 raise ValueError(
@@ -110,6 +122,14 @@ class HierConfig:
                 raise ValueError(
                     f"MINIPS_HIER: bad value for {k}: {v!r}") from e
         return cls(**kw)
+
+
+def _cast_agg(v: str):
+    """``agg=`` accepts 0/1 or the string ``mesh`` — the hybrid data
+    plane's in-host device reduce (train/mesh_plane.MeshAggregator)."""
+    if v.strip().lower() == "mesh":
+        return "mesh"
+    return int(v)
 
 
 def _cast_group(v: str) -> int:

@@ -89,7 +89,8 @@ from typing import Optional
 import numpy as np
 
 from minips_tpu.balance.control_plane import (CoordinatorLease,
-                                              SuspicionQuorum)
+                                              SuspicionQuorum,
+                                              expand_to_domains)
 from minips_tpu.consistency.gate import (FencedOutError,
                                          PeerFailureError, publish_clock)
 from minips_tpu.obs import flight as _fl
@@ -332,6 +333,7 @@ class Membership:
         self._slow_drained: set[int] = set()   # escalations issued
         self._slowness = None                  # obs.slowness monitor
         self._slow_cfg = None                  # its SlownessConfig
+        self._domain_group = 1                 # hybrid-plane domains
         self.counters["slow_verdicts"] = 0
         self.counters["slow_drains"] = 0
         if trainer.monitor is not None:
@@ -504,6 +506,16 @@ class Membership:
         if mon is not None and hasattr(mon, "on_stall_forgiven"):
             mon.on_stall_forgiven = sm.retract_all
 
+    def bind_failure_domains(self, group: int) -> None:
+        """Arm whole-host failure domains (the hybrid data plane,
+        ``MINIPS_HIER agg=mesh``): slow verdicts expand to the
+        convicted rank's entire contiguous host group via
+        ``control_plane.expand_to_domains`` — a mesh host's ranks
+        share one reduce group, so demoting one member without its
+        peers would leave the planner shedding load onto ranks whose
+        collectives still stall behind the sick one."""
+        self._domain_group = max(1, int(group))
+
     def _on_slow_suspect(self, r: int, suspected: bool) -> None:
         """SlownessMonitor transition (push-driving thread, its roll):
         MY slow ballot changed — gossip rides the next beat; the
@@ -527,6 +539,17 @@ class Membership:
             gone = self.dead | self.left
         cur = {r for r in self.slow_quorum.convictable(live)
                if r not in gone}
+        dom_added: set[int] = set()
+        if cur and self._domain_group > 1:
+            # hybrid-plane failure domains: a verdict against one mesh
+            # member implicates its whole host group (live peers only
+            # — the dead are the death quorum's problem). Not sticky
+            # either: the expansion recomputes from the base set, so a
+            # cleared member verdict lifts the whole domain with it
+            full = expand_to_domains(cur, self._domain_group, self.n)
+            dom_added = {r for r in full
+                         if r in live and r not in gone} - cur
+            cur |= dom_added
         with self._slow_lock:
             new = cur - self._slow_verdicts
             cleared = self._slow_verdicts - cur
@@ -537,10 +560,17 @@ class Membership:
             for r in cleared:
                 self._slow_since.pop(r, None)
         for r in new:
-            _fl.record("slow_verdict",
-                       {"rank": int(r),
-                        "voters": self.slow_quorum.voters_for(r, live),
-                        "live": sorted(live)})
+            if r in dom_added:
+                _fl.record("slow_domain_verdict",
+                           {"rank": int(r),
+                            "group": self._domain_group,
+                            "live": sorted(live)})
+            else:
+                _fl.record("slow_verdict",
+                           {"rank": int(r),
+                            "voters": self.slow_quorum.voters_for(
+                                r, live),
+                            "live": sorted(live)})
         for r in cleared:
             _fl.record("slow_cleared", {"rank": int(r)})
 

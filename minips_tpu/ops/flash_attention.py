@@ -556,7 +556,6 @@ def ring_flash_attention_local(
     custom VJP at every step.
     """
     n = _axis_size(axis_name)
-    r = jax.lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     if scale is None:
@@ -575,7 +574,17 @@ def ring_flash_attention_local(
                            and jax.default_backend() == "tpu")))
     interpret = bool(interpret) if interpret is not None else False
     perm = [(i, (i + 1) % n) for i in range(n)]
-    q_off = (r * Tq).astype(jnp.int32)
+    # With causal=False no step masks, so the global offsets cannot affect
+    # the math — and materializing axis_index here would leave an orphaned
+    # partition-id in the lowered module (no path to a manual-sharded
+    # operand for sharding propagation to infer {manual} from), which the
+    # SPMD partitioner rejects. Only mint r when masking consumes it.
+    if causal:
+        r = jax.lax.axis_index(axis_name)
+        q_off = (r * Tq).astype(jnp.int32)
+    else:
+        r = jnp.zeros((), jnp.int32)
+        q_off = jnp.zeros((), jnp.int32)
 
     def step_fn(carry, s):
         acc, lse_run, k_cur, v_cur = carry
@@ -600,8 +609,9 @@ def ring_flash_attention_local(
 
     acc0 = jnp.zeros((B, Tq, H, D), jnp.float32)
     lse0 = jnp.full((B, Tq, H), _NEG_INF, jnp.float32)
-    # the axis index r makes every step output vary over the ring axis, so
-    # ALL carries must be varying — even when the inputs arrive replicated
+    # the visiting K/V shards (and, under causal, the axis index r) make
+    # every step output vary over the ring axis, so ALL carries must be
+    # varying — even when the inputs arrive replicated
     acc0, lse0, k, v = (_pcast_varying(x, (axis_name,))
                         for x in (acc0, lse0, k, v))
     (acc, _, _, _), _ = jax.lax.scan(

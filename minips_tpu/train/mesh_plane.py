@@ -80,8 +80,9 @@ VALID_MESH_COMM = ("float32", "blk8")
 # gate timeout
 _BSP_FLUSH_GRACE = 0.05
 
-__all__ = ["MeshPlane", "MeshRank", "MeshTable", "resolve_plane",
-           "MESH_AXIS", "VALID_MESH_COMM"]
+__all__ = ["MeshPlane", "MeshRank", "MeshTable", "MeshAggregator",
+           "resolve_plane", "resolve_deposit", "MESH_AXIS",
+           "VALID_MESH_COMM"]
 
 
 def resolve_plane(plane: Optional[str]) -> str:
@@ -96,6 +97,23 @@ def resolve_plane(plane: Optional[str]) -> str:
         return plane
     env = os.environ.get("MINIPS_MESH", "").strip()
     return "mesh" if env not in ("", "0") else "wire"
+
+
+def resolve_deposit(deposit: Optional[str] = None) -> str:
+    """Deposit-buffer selection, same explicit-wins-over-env rule:
+    ``dense`` stages pushes in the pre-stacked ``[n, padded, dim]``
+    host buffers; ``sparse`` stages COO (keys, rows) streams and
+    densifies ON DEVICE with a segment-sum scatter inside the wave —
+    an embedding-table-sized key space with a small touched set stops
+    materializing host buffers that scale with ``num_rows``.
+    ``MINIPS_MESH_SPARSE`` (any value but ''/'0') selects sparse."""
+    if deposit:
+        if deposit not in ("dense", "sparse"):
+            raise ValueError(f"mesh deposit must be 'dense' or "
+                             f"'sparse', got {deposit!r}")
+        return deposit
+    env = os.environ.get("MINIPS_MESH_SPARSE", "").strip()
+    return "sparse" if env not in ("", "0") else "dense"
 
 
 def _padded(rows: int, shards: int) -> int:
@@ -153,13 +171,30 @@ class MeshTable:
                 jnp.zeros(self.padded, jnp.int32), self._row_sh)
         else:
             self._m = self._v = self._steps = None
-        # per-rank host deposit buffers, PRE-STACKED: the wave's input is
-        # this one [n, padded, dim] array (each rank deposits into its
-        # row — clean ranks contribute exact zeros), so a wave pays one
-        # device_put and zero stacking copies
-        self._gbuf = np.zeros((n, self.padded, self.dim), np.float32)
-        self._tstack = (np.zeros((n, self.padded), np.float32)
-                        if updater == "adam" else None)
+        self.deposit = plane.deposit
+        if self.deposit == "sparse":
+            # sparse device waves: deposits stage as per-rank COO
+            # (keys, rows) streams and densify ON DEVICE with a
+            # segment-sum scatter inside the wave — host staging
+            # scales with the TOUCHED set, not ``num_rows`` (the
+            # embedding-table shape PR 11 carried as headroom)
+            self._gbuf = None
+            self._tstack = None
+            self._ckeys: Optional[list] = [[] for _ in range(n)]
+            self._cvals: Optional[list] = [[] for _ in range(n)]
+            self.peak_deposit_bytes = 0
+        else:
+            # per-rank host deposit buffers, PRE-STACKED: the wave's
+            # input is this one [n, padded, dim] array (each rank
+            # deposits into its row — clean ranks contribute exact
+            # zeros), so a wave pays one device_put and zero stacking
+            # copies
+            self._gbuf = np.zeros((n, self.padded, self.dim), np.float32)
+            self._tstack = (np.zeros((n, self.padded), np.float32)
+                            if updater == "adam" else None)
+            self._ckeys = self._cvals = None
+            self.peak_deposit_bytes = self._gbuf.nbytes + (
+                self._tstack.nbytes if self._tstack is not None else 0)
         self._dirty = [False] * n
         # the replicated pull mirror: the wave's fused all-gather output,
         # host-resident (and read-only: pull_all serves VIEWS — the
@@ -181,23 +216,39 @@ class MeshTable:
         # actually shipped — retained host-side and folded into the next
         # wave's contribution, with an exact-f32 repayment wave at
         # finalize: the wire ResidualStore's fold/flush contract
-        # (train/sharded_ps.py) on the collective transport
-        self._rbuf = (np.zeros((n, self.padded, self.dim), np.float32)
-                      if plane.mesh_ef else None)
+        # (train/sharded_ps.py) on the collective transport. Born as a
+        # DEVICE array (stack-sharded zeros): between waves it is the
+        # wave's own device output, and a host-side [n, padded, dim]
+        # zeros block would charge sparse mode a dense host buffer it
+        # exists to avoid
+        self._rbuf = (jax.device_put(
+            jnp.zeros((n, self.padded, self.dim), jnp.float32),
+            self._stack_sh) if plane.mesh_ef else None)
         self._fence_fn = None  # exact repayment program, built lazily
         self.ef_waves = 0        # waves that folded + re-captured resid
         self.ef_fence_waves = 0  # exact repayment waves (finalize)
-        self._wave_fn = self._build_wave_fn()
+        self.sparse_waves = 0    # waves that densified on device
+        self._wave_fns: dict = {}  # sparse: one program per L bucket
+        self._wave_len = 8         # grow-only L (compile-thrash guard)
+        self._wave_fn = (self._build_wave_fn()
+                         if self.deposit == "dense" else None)
 
     # ------------------------------------------------------------ wave
-    def _build_wave_fn(self, *, exact: bool = False):
+    def _build_wave_fn(self, *, exact: bool = False,
+                       sparse_len: Optional[int] = None):
         """One jitted XLA program per table — THE collective data plane:
         reduce-scatter the stacked rank deposits (push), run the updater
         on the owner shard (sharded server math — no replicated
         optimizer state), all-gather the new rows (pull). The signature
         varies by updater so only real state is donated; the updater
         math mirrors the wire table's numpy updaters op for op
-        (sharded_ps._update_block/_adam_rows)."""
+        (sharded_ps._update_block/_adam_rows).
+
+        ``sparse_len=L`` swaps the dense ``[n, padded, dim]`` deposit
+        input for COO streams (``[n, L]`` keys + ``[n, L, dim]`` rows,
+        sentinel key = ``padded`` → dropped): each device densifies ITS
+        rank's stream with a segment-sum scatter before the identical
+        reduce leg — one cached program per power-of-two L bucket."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -316,7 +367,42 @@ class MeshTable:
                 def body(w, g_stack, r_stack):
                     return inner(w, g_stack + r_stack)
 
-        n_in = n_state + (2 if upd == "adam" else 1) + (1 if ef else 0)
+        if sparse_len is not None:
+            # COO front end: densify my rank's staged stream on device
+            # (scatter-add; the sentinel key == padded is out of range
+            # and mode="drop" discards it), then run the identical
+            # dense body — adam's touch mask is the scatter of ones
+            # over the same keys, so semantics are byte-for-byte the
+            # dense path's
+            padded = self.padded
+
+            def _densify(k, v):
+                return jnp.zeros((padded, dim), jnp.float32
+                                 ).at[k].add(v, mode="drop")
+
+            def _touch(k):
+                return jnp.zeros((padded,), jnp.float32
+                                 ).at[k].add(1.0, mode="drop")
+
+            dense_body = body
+            if upd == "adam":
+                def body(w, m, v, steps, k_stack, v_stack, *rest):
+                    g = _densify(k_stack[0], v_stack[0])
+                    t = _touch(k_stack[0])
+                    return dense_body(w, m, v, steps, g[None], t[None],
+                                      *rest)
+            elif upd == "adagrad":
+                def body(w, acc, k_stack, v_stack, *rest):
+                    g = _densify(k_stack[0], v_stack[0])
+                    return dense_body(w, acc, g[None], *rest)
+            else:
+                def body(w, k_stack, v_stack, *rest):
+                    g = _densify(k_stack[0], v_stack[0])
+                    return dense_body(w, g[None], *rest)
+            n_in = n_state + 2 + (1 if ef else 0)
+        else:
+            n_in = (n_state + (2 if upd == "adam" else 1)
+                    + (1 if ef else 0))
         # check_vma/check_rep off: the all-gathered output is replicated
         # by construction, but older checkers cannot infer it through
         # the quantized a2a path
@@ -339,9 +425,17 @@ class MeshTable:
         if keys.size and (keys.min() < 0 or keys.max() >= self.num_rows):
             raise ValueError("push keys outside the table's key space")
         uniq, summed, _ = sum_duplicate_keys(keys, grads, self.dim)
-        np.add.at(self._gbuf[rank], uniq, summed)
-        if self._tstack is not None:
-            self._tstack[rank][uniq] = 1.0
+        if self._ckeys is not None:
+            # sparse: stage the deduped COO slice; cross-deposit
+            # duplicates coalesce on device (two-term f32 adds are
+            # commutative, so the wave equals the dense accumulate)
+            self._ckeys[rank].append(np.asarray(uniq, np.int64))
+            self._cvals[rank].append(
+                np.ascontiguousarray(summed, np.float32))
+        else:
+            np.add.at(self._gbuf[rank], uniq, summed)
+            if self._tstack is not None:
+                self._tstack[rank][uniq] = 1.0
         self._dirty[rank] = True
         self.rows_pushed += keys.size
 
@@ -350,9 +444,18 @@ class MeshTable:
         if grad.shape[0] != self.num_rows:
             raise ValueError(
                 f"push_dense expects [{self.num_rows}, {self.dim}]")
-        self._gbuf[rank, : self.num_rows] += grad
-        if self._tstack is not None:
-            self._tstack[rank, : self.num_rows] = 1.0
+        if self._ckeys is not None:
+            # a dense push touches every row — COO staging degrades to
+            # the full key list (dense workloads should run deposit=
+            # dense; the sparse plane stays correct, not clever)
+            self._ckeys[rank].append(
+                np.arange(self.num_rows, dtype=np.int64))
+            self._cvals[rank].append(
+                np.ascontiguousarray(grad, np.float32))
+        else:
+            self._gbuf[rank, : self.num_rows] += grad
+            if self._tstack is not None:
+                self._tstack[rank, : self.num_rows] = 1.0
         self._dirty[rank] = True
         self.rows_pushed += self.num_rows
 
@@ -367,13 +470,29 @@ class MeshTable:
         construction). Caller holds the plane lock."""
         import jax
 
+        if self._ckeys is not None and not fence:
+            self._wave_sparse_locked()
+            return
         t_wave0 = time.monotonic()
+        n = self.plane.num_ranks
         ef = self._rbuf is not None
         g_in = self._gbuf
         if ef and fence:
             # the exact program has no r_stack input — fold the
-            # residual on the host for this one-time repayment wave
-            g_in = self._gbuf + np.asarray(self._rbuf)
+            # residual on the host for this one-time repayment wave.
+            # Sparse mode densifies any still-staged COO here too (the
+            # fence is the one wave that MUST see a dense input — the
+            # honest limit the architecture doc states): at finalize
+            # the per-rank flushes already drained the stages, so this
+            # is normally residual-only
+            if self._ckeys is not None:
+                g_in = np.zeros((n, self.padded, self.dim), np.float32)
+                for r in range(n):
+                    for k, v in zip(self._ckeys[r], self._cvals[r]):
+                        np.add.at(g_in[r], k, v)
+                g_in += np.asarray(self._rbuf)
+            else:
+                g_in = self._gbuf + np.asarray(self._rbuf)
         t_in = self._tstack
         fn = self._wave_fn
         extra = ()
@@ -385,14 +504,15 @@ class MeshTable:
             if self._fence_fn is None:
                 self._fence_fn = self._build_wave_fn(exact=True)
             fn = self._fence_fn
-            if ef and t_in is not None:
+            if ef and self.updater == "adam":
                 # the fence repays residual as a real (exact) push:
                 # residual-only rows must pass the lazy-adam touch mask,
                 # exactly like the wire's f32 residual fence arrives as
                 # a normal push frame and advances server state
-                t_in = np.maximum(
-                    t_in, (np.abs(g_in).sum(axis=-1) > 0
-                           ).astype(np.float32))
+                mass = (np.abs(g_in).sum(axis=-1) > 0
+                        ).astype(np.float32)
+                t_in = (mass if t_in is None
+                        else np.maximum(t_in, mass))
         g_stack = jax.device_put(g_in, self._stack_sh)
         if self.updater == "sgd":
             (self._w,), out = fn(self._w, g_stack, *extra)
@@ -411,21 +531,105 @@ class MeshTable:
         else:
             full = out
             if ef:
-                self._rbuf = np.zeros_like(self._gbuf)
+                # repaid: reset to device-born zeros (explicit shape —
+                # sparse mode has no _gbuf to zeros_like)
+                import jax.numpy as jnp
+                self._rbuf = jax.device_put(
+                    jnp.zeros((n, self.padded, self.dim), jnp.float32),
+                    self._stack_sh)
                 self.ef_fence_waves += 1
         mirror = np.asarray(full)
         mirror.setflags(write=False)
         self._mirror = mirror
         for r in range(self.plane.num_ranks):
             if self._dirty[r]:
-                self._gbuf[r].fill(0.0)
-                if self._tstack is not None:
-                    self._tstack[r].fill(0.0)
+                if self._gbuf is not None:
+                    self._gbuf[r].fill(0.0)
+                    if self._tstack is not None:
+                        self._tstack[r].fill(0.0)
+                else:
+                    self._ckeys[r].clear()
+                    self._cvals[r].clear()
                 self._dirty[r] = False
         self.waves += 1
         self.collective_bytes += self._wave_bytes()
         # the step-phase observable: one wave = one collective program
         # dispatch; its duration hist feeds the plane's windowed layer
+        self.plane.hist_wave.record_s(time.monotonic() - t_wave0)
+
+    def _wave_sparse_locked(self) -> None:
+        """Sparse apply wave: pack each rank's staged COO stream into
+        ``[n, L]`` keys + ``[n, L, dim]`` rows (pad slots carry the
+        sentinel key ``padded`` — out of range, dropped by the device
+        scatter's ``mode="drop"``), densify ON DEVICE with a
+        segment-sum scatter, then run the identical reduce/update/
+        gather body. ``L`` rounds up to a power of two so recompiles
+        stay O(log max-touched); peak host bytes are the staged slices
+        plus these stacks — they scale with the TOUCHED set, never
+        ``num_rows``. Caller holds the plane lock."""
+        import jax
+
+        t_wave0 = time.monotonic()
+        n = self.plane.num_ranks
+        ef = self._rbuf is not None
+        counts = [sum(k.size for k in self._ckeys[r]) for r in range(n)]
+        need = max(max(counts), 1)
+        # MONOTONIC stack length: grow-only, so a touched-set count
+        # that oscillates across waves reuses ONE compiled program
+        # instead of ping-ponging between L buckets (each bucket is a
+        # fresh XLA compile — worth 10-100ms, easily dwarfing the wave)
+        L = self._wave_len
+        while L < need:
+            L *= 2
+        self._wave_len = L
+        k_stack = np.full((n, L), self.padded, np.int32)
+        v_stack = np.zeros((n, L, self.dim), np.float32)
+        for r in range(n):
+            o = 0
+            for k, v in zip(self._ckeys[r], self._cvals[r]):
+                k_stack[r, o:o + k.size] = k
+                v_stack[r, o:o + k.size] = v
+                o += k.size
+        staged = sum(k.nbytes + v.nbytes
+                     for r in range(n)
+                     for k, v in zip(self._ckeys[r], self._cvals[r]))
+        self.peak_deposit_bytes = max(
+            self.peak_deposit_bytes,
+            staged + k_stack.nbytes + v_stack.nbytes)
+        fn = self._wave_fns.get(L)
+        if fn is None:
+            fn = self._wave_fns[L] = self._build_wave_fn(sparse_len=L)
+        ks = jax.device_put(k_stack, self._stack_sh)
+        vs = jax.device_put(v_stack, self._stack_sh)
+        extra = ()
+        if ef:
+            extra = (jax.device_put(self._rbuf, self._stack_sh),)
+        if self.updater == "sgd":
+            (self._w,), out = fn(self._w, ks, vs, *extra)
+        elif self.updater == "adagrad":
+            (self._w, self._acc), out = fn(self._w, self._acc,
+                                           ks, vs, *extra)
+        else:
+            (self._w, self._m, self._v, self._steps), out = \
+                fn(self._w, self._m, self._v, self._steps,
+                   ks, vs, *extra)
+        if ef:
+            full, resid = out
+            self._rbuf = resid
+            self.ef_waves += 1
+        else:
+            full = out
+        mirror = np.asarray(full)
+        mirror.setflags(write=False)
+        self._mirror = mirror
+        for r in range(n):
+            if self._dirty[r]:
+                self._ckeys[r].clear()
+                self._cvals[r].clear()
+                self._dirty[r] = False
+        self.waves += 1
+        self.sparse_waves += 1
+        self.collective_bytes += self._wave_bytes()
         self.plane.hist_wave.record_s(time.monotonic() - t_wave0)
 
     def _wave_bytes(self) -> int:
@@ -606,7 +810,8 @@ class MeshPlane:
 
     def __init__(self, num_ranks: int, *, staleness: float = 0.0,
                  comm: str = "float32", block: Optional[int] = None,
-                 devices=None, gate_timeout: float = 60.0):
+                 deposit: Optional[str] = None, devices=None,
+                 gate_timeout: float = 60.0):
         if comm not in VALID_MESH_COMM:
             raise ValueError(f"mesh comm must be one of "
                              f"{VALID_MESH_COMM}, got {comm!r}")
@@ -631,6 +836,9 @@ class MeshPlane:
         # the quantized tier defaults to the HOST wire's block size:
         # one codec (blockwise absmax), two transports
         self.block = int(HOST_BLOCK if block is None else block)
+        # deposit buffer shape: dense pre-stacked host buffers vs COO
+        # staging + on-device segment-sum densify (sparse device waves)
+        self.deposit = resolve_deposit(deposit)
         # error feedback on the blk8 reduce leg (default ON): each
         # device retains its quantization residual and folds it into
         # the next wave — unbiased in the limit, exact repayment at
@@ -882,9 +1090,16 @@ class MeshPlane:
             "plane": "mesh",
             "comm": self.comm,
             "block": self.block if self.comm == "blk8" else None,
+            "deposit": self.deposit,
             "ranks": self.num_ranks,
             "devices": len(self.mesh.devices.ravel()),
             "waves": {n: t.waves for n, t in self.tables.items()},
+            # peak host bytes the deposit stage held (dense: the fixed
+            # pre-stacked buffers; sparse: the high-water COO staging)
+            "peak_deposit_bytes": {n: t.peak_deposit_bytes
+                                   for n, t in self.tables.items()},
+            "sparse_waves": sum(t.sparse_waves
+                                for t in self.tables.values()),
             "collective_bytes": sum(t.collective_bytes
                                     for t in self.tables.values()),
             # blk8 reduce-leg error feedback: None when off
@@ -903,4 +1118,212 @@ class MeshPlane:
                          self.hist_gate.snapshot())},
             "window": (self.obs_window.record()
                        if self.obs_window is not None else None),
+        }
+
+
+class MeshAggregator:
+    """The hier leader's in-host reduce backend (``MINIPS_HIER``
+    ``agg=mesh``): member contributions deposit as per-slot COO
+    streams, and ONE device program — segment-sum densify per slot,
+    then a reduce-scatter over the mesh axis (blk8 quantized tier with
+    error-feedback residual out, or exact f32) — produces the
+    aggregate the leader ships cross-host. This swaps PR 16's
+    host-side per-owner f64 dedup loop for XLA collectives while the
+    CROSS-host leg (one topk8/topk4 ``psH`` frame per owner) is
+    untouched: the reduce-scatter never leaves the host's mesh, so
+    cross-host bytes are identical by construction.
+
+    Degenerate meshes (fewer than 2 usable devices, or
+    ``MINIPS_HIER_MESH_DEVS=1``) reduce on the host via THE shared
+    dedup kernel in the exact deposit order the f64 path uses —
+    bitwise-equal to ``agg=host`` (the stamp-folding test pins it).
+    The ``reduce()`` residual return feeds the leader's ResidualStore
+    so the unbiased-flush contract holds end-to-end."""
+
+    def __init__(self, num_rows: int, dim: int, *, slots: int,
+                 comm: str = "blk8", block: Optional[int] = None,
+                 devices=None):
+        if comm not in VALID_MESH_COMM:
+            raise ValueError(f"aggregator comm must be one of "
+                             f"{VALID_MESH_COMM}, got {comm!r}")
+        import jax
+
+        from minips_tpu.ops.quantized_comm import HOST_BLOCK
+
+        self.num_rows = int(num_rows)
+        self.dim = int(dim)
+        self.block = int(HOST_BLOCK if block is None else block)
+        devs = (list(devices) if devices is not None
+                else list(jax.devices()))
+        m = min(int(slots), len(devs))
+        cap = os.environ.get("MINIPS_HIER_MESH_DEVS", "").strip()
+        if cap:
+            m = min(m, max(int(cap), 1))
+        self.m = max(m, 1)
+        # one usable device -> nothing to reduce-scatter ACROSS: the
+        # degenerate tier is the host dedup kernel, and it reports
+        # comm=float32 because that is what it ships (exactly)
+        self.comm = comm if self.m >= 2 else "float32"
+        self.reduces = 0
+        self.rows_reduced = 0
+        self.collective_bytes = 0
+        self.peak_stage_bytes = 0
+        self._staged: list = [[] for _ in range(self.m)]
+        self._order: list = []  # (slot-stream flattening) deposit order
+        self._L = 8             # grow-only stack length (see reduce())
+        if self.m >= 2:
+            from jax.sharding import Mesh
+            self.mesh = Mesh(np.array(devs[: self.m]), (MESH_AXIS,))
+            self.padded = _padded(self.num_rows, self.m)
+            self._fns: dict = {}
+        else:
+            self.mesh = None
+            self.padded = self.num_rows
+            self._fns = None
+
+    def _build_reduce_fn(self, L: int):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from minips_tpu.ops.quantized_comm import \
+            quantized_psum_scatter_ef
+        from minips_tpu.utils import jaxcompat
+
+        padded, dim = self.padded, self.dim
+        comm = "int8" if self.comm == "blk8" else "float32"
+        block = self.block
+        S = P(MESH_AXIS)
+
+        def body(k_stack, v_stack):
+            # densify my slot's COO stream (sentinel key == padded is
+            # dropped), then reduce-scatter across slots — the same
+            # one-signature EF collective the mesh plane's wave runs:
+            # float32 returns exact zeros for the residual, so the
+            # caller never branches on the codec
+            dense = jnp.zeros((padded, dim), jnp.float32
+                              ).at[k_stack[0]].add(v_stack[0],
+                                                   mode="drop")
+            red, resid = quantized_psum_scatter_ef(
+                dense.reshape(-1), MESH_AXIS, comm=comm, block=block)
+            return red.reshape(-1, dim), resid.reshape(padded, dim)[None]
+
+        mapped = jaxcompat.shard_map(
+            body, mesh=self.mesh, in_specs=(S, S), out_specs=(S, S),
+            check_vma=False)
+        return jax.jit(mapped)
+
+    def deposit(self, slot: int, keys: np.ndarray,
+                grads: np.ndarray) -> None:
+        """Stage one member contribution. ``slot`` is the member's
+        index within the host group (wrapped onto the mesh)."""
+        keys = np.asarray(keys, np.int64)
+        grads = np.asarray(grads, np.float32).reshape(keys.size,
+                                                      self.dim)
+        if keys.size == 0:
+            return
+        if keys.min() < 0 or keys.max() >= self.num_rows:
+            raise ValueError("aggregator keys outside the key space")
+        self._staged[slot % self.m].append((keys, grads))
+        self._order.append((keys, grads))
+
+    def reduce(self):
+        """Run the reduce over everything staged since the last call.
+
+        Returns ``(keys, rows, resid_keys, resid_rows)``: the touched
+        keys with their aggregated rows, plus the quantizer's residual
+        (what the blk8 exchange did NOT ship) for the leader's
+        ResidualStore. Exact tiers return empty residuals."""
+        if not self._order:
+            return (np.zeros(0, np.int64),
+                    np.zeros((0, self.dim), np.float32),
+                    np.zeros(0, np.int64),
+                    np.zeros((0, self.dim), np.float32))
+        from minips_tpu.train.sharded_ps import sum_duplicate_keys
+
+        empty_r = (np.zeros(0, np.int64),
+                   np.zeros((0, self.dim), np.float32))
+        if self.m < 2:
+            # host tier: concat in deposit order, THE shared f64 dedup
+            # kernel — bitwise what agg=host would have shipped
+            ks = np.concatenate([k for k, _ in self._order])
+            gs = np.concatenate([g for _, g in self._order])
+            self._staged = [[] for _ in range(self.m)]
+            self._order = []
+            k, g, _ = sum_duplicate_keys(ks, gs, self.dim)
+            if k.size and not np.all(k[1:] >= k[:-1]):
+                # the kernel keeps the ORIGINAL pairing when nothing
+                # coalesced — reduce() contracts SORTED keys (callers
+                # searchsorted into them), so restore the order the
+                # dedup branch would have produced
+                order = np.argsort(k, kind="stable")
+                k, g = k[order], g[order]
+            self.reduces += 1
+            self.rows_reduced += int(k.size)
+            return (k, g) + empty_r
+        import jax
+
+        counts = [sum(k.size for k, _ in s) for s in self._staged]
+        need = max(max(counts), 1)
+        # grow-only L: per-flush contribution counts jitter, and every
+        # fresh L bucket is a fresh XLA compile — monotonic growth
+        # keeps steady state on ONE compiled program
+        L = self._L
+        while L < need:
+            L *= 2
+        self._L = L
+        k_stack = np.full((self.m, L), self.padded, np.int32)
+        v_stack = np.zeros((self.m, L, self.dim), np.float32)
+        for s in range(self.m):
+            o = 0
+            for k, v in self._staged[s]:
+                k_stack[s, o:o + k.size] = k
+                v_stack[s, o:o + k.size] = v
+                o += k.size
+        staged_bytes = sum(k.nbytes + g.nbytes for k, g in self._order)
+        self.peak_stage_bytes = max(
+            self.peak_stage_bytes,
+            staged_bytes + k_stack.nbytes + v_stack.nbytes)
+        touched = np.unique(np.concatenate(
+            [k for k, _ in self._order]))
+        self._staged = [[] for _ in range(self.m)]
+        self._order = []
+        fn = self._fns.get(L)
+        if fn is None:
+            fn = self._fns[L] = self._build_reduce_fn(L)
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        sh = NamedSharding(self.mesh, P(MESH_AXIS))
+        agg, resid = fn(jax.device_put(k_stack, sh),
+                        jax.device_put(v_stack, sh))
+        agg = np.asarray(agg)          # [padded, dim], owner-reassembled
+        rows = agg[touched]
+        if self.comm == "blk8":
+            resid_total = np.asarray(resid).sum(axis=0)
+            rk = np.flatnonzero(
+                np.abs(resid_total).sum(axis=1) > 0)
+            rk = rk[rk < self.num_rows]
+            rrows = resid_total[rk]
+            from minips_tpu.ops.quantized_comm import \
+                blockwise_stream_bytes
+            code, scale = blockwise_stream_bytes(
+                self.padded, self.dim, 8, self.block)
+            self.collective_bytes += (self.m - 1) * (code + scale)
+        else:
+            rk, rrows = empty_r
+            self.collective_bytes += (
+                (self.m - 1) * self.padded * self.dim * 4)
+        self.reduces += 1
+        self.rows_reduced += int(touched.size)
+        return touched, rows, np.asarray(rk, np.int64), rrows
+
+    def stats(self) -> dict:
+        return {
+            "backend": "mesh" if self.m >= 2 else "host-degenerate",
+            "slots": self.m,
+            "comm": self.comm,
+            "reduces": int(self.reduces),
+            "rows_reduced": int(self.rows_reduced),
+            "collective_bytes": int(self.collective_bytes),
+            "peak_stage_bytes": int(self.peak_stage_bytes),
         }

@@ -1007,11 +1007,19 @@ class ShardedTable:
         # encodes would race both the slab and the rng stream
         self._hier_ef = None
         self._hier_rng = None
+        # agg=mesh: the leader's device-reduce backend (lazy — only a
+        # LEADER that actually flushes pays the mesh build), plus the
+        # whole-host failure-domain latch (sticky: a mesh host demotes
+        # as ONE unit and never re-enters this incarnation)
+        self._hier_mesh = None
+        self._hier_mesh_failed = False
+        self._hier_domain_down = False
         self.hier_counters = {k: 0 for k in (
             "l1_tx_bytes", "l1_frames", "l2_tx_bytes", "l2_frames",
             "agg_frames", "agg_rows", "floor_frames", "contribs",
             "elections", "fallbacks", "repushed_steps", "repush_drops",
-            "stale_leader_drops")}
+            "stale_leader_drops", "mesh_reduces", "mesh_agg_fallbacks",
+            "domain_demotions")}
         self.hist_hier = Log2Histogram()     # leader flush latency
         # ---- server shard: ONLY my row range lives here (the 1/N memory
         # claim, materialization included — a multi-GB Criteo table must
@@ -4268,17 +4276,22 @@ class ShardedTable:
             t0 = time.monotonic()
             extra = {"hfr": [int(r) for r in sorted(floors)],
                      "hfv": [int(floors[r]) for r in sorted(floors)]}
-            sent_to = set()
-            for o in sorted(buckets):
-                entries = buckets[o]
-                if not entries or o < 0:
-                    continue
-                ks = np.concatenate([e[0] for e in entries])
-                gs = np.concatenate([e[1] for e in entries])
-                hmin = min(int(e[2]) for e in entries)
-                k, g, _ = sum_duplicate_keys(ks, gs, self.dim)
-                self._hier_send_agg(int(o), k, g, hmin, extra)
-                sent_to.add(int(o))
+            agg = (self._hier_mesh_agg()
+                   if cfg.agg == "mesh" else None)
+            if agg is not None:
+                sent_to = self._hier_mesh_flush(agg, buckets, extra)
+            else:
+                sent_to = set()
+                for o in sorted(buckets):
+                    entries = buckets[o]
+                    if not entries or o < 0:
+                        continue
+                    ks = np.concatenate([e[0] for e in entries])
+                    gs = np.concatenate([e[1] for e in entries])
+                    hmin = min(int(e[2]) for e in entries)
+                    k, g, _ = sum_duplicate_keys(ks, gs, self.dim)
+                    self._hier_send_agg(int(o), k, g, hmin, extra)
+                    sent_to.add(int(o))
             for o in self._hier_cross:
                 # owners with no mass this boundary still need the
                 # claim, or their admission would stall on my group
@@ -4328,6 +4341,94 @@ class ShardedTable:
         h["agg_rows"] += int(k.size)
         self._hier_count_tx(o, len(blob))
 
+    def _hier_mesh_agg(self):
+        """The leader's lazy MeshAggregator (``agg=mesh``). A build
+        failure — no jax devices, bad env — latches a STICKY fallback
+        to the host f64 kernel: the tree keeps running with identical
+        frames and semantics, only the reduce engine degrades
+        (flight-recorded once, never retried this incarnation)."""
+        cfg = self._hier
+        if cfg is None or cfg.agg != "mesh" or self._hier_mesh_failed:
+            return None
+        if self._hier_mesh is None:
+            try:
+                from minips_tpu.train.mesh_plane import MeshAggregator
+                comm = (os.environ.get("MINIPS_HIER_MESH_COMM",
+                                       "blk8").strip() or "blk8")
+                self._hier_mesh = MeshAggregator(
+                    self.num_rows, self.dim,
+                    slots=max(len(self._hier_group), 1), comm=comm)
+            except Exception as e:  # noqa: BLE001 — degrade, not die
+                self._hier_mesh_failed = True
+                self.hier_counters["mesh_agg_fallbacks"] += 1
+                _fl.record("hier_mesh_fallback",
+                           {"table": self.name, "err": repr(e)})
+                return None
+        return self._hier_mesh
+
+    def _hier_mesh_flush(self, agg, buckets: dict,
+                         extra: dict) -> set:
+        """The ``agg=mesh`` reduce leg of one leader flush: every
+        bucket entry deposits into the host's device mesh (one slot
+        per group member), ONE reduce-scatter produces the aggregate,
+        and the same per-owner ``psP`` frames ship cross-host — the
+        wire cannot tell which engine reduced. The device quantizer's
+        residual feeds the leader-lane ResidualStore under each
+        owner's min contributor stamp (topk wire: the next encode
+        folds it back — the unbiased-flush contract end-to-end); exact
+        wires repay it straight into the aggregate, so every flush
+        ships exact sums. Caller holds ``_hier_flush_lock``."""
+        sent_to: set = set()
+        hmins: dict[int, int] = {}
+        okeys: dict[int, np.ndarray] = {}
+        slot_of = {r: i for i, r in enumerate(self._hier_group)}
+        for o in sorted(buckets):
+            entries = buckets[o]
+            if not entries or o < 0:
+                continue
+            o = int(o)
+            hmins[o] = min(int(e[2]) for e in entries)
+            # deposit in bucket order — the exact occurrence order the
+            # f64 path concatenates, so the degenerate one-device tier
+            # is bitwise agg=host
+            for k, g, _clk, sender in entries:
+                agg.deposit(slot_of.get(int(sender), 0), k, g)
+            okeys[o] = np.unique(np.concatenate(
+                [e[0] for e in entries]))
+        if not hmins:
+            return sent_to
+        keys, rows, rk, rr = agg.reduce()
+        self.hier_counters["mesh_reduces"] += 1
+        if rk.size:
+            # stamp each residual key with ITS owner's min contributor
+            # clock (per-owner bucket membership, not a router re-read:
+            # a rebalance mid-flush must not re-home retained error)
+            hmin_of = np.full(keys.size, self._my_clk(), np.int64)
+            owner_of = np.full(keys.size, -1, np.int64)
+            for o, ok in okeys.items():
+                idx = np.searchsorted(keys, ok)
+                hmin_of[idx] = hmins[o]
+                owner_of[idx] = o
+            ridx = np.searchsorted(keys, rk)
+            if self._hier_ef is not None:
+                ovk, ovr = self._hier_ef.retain(rk, rr, hmin_of[ridx])
+                if ovk.size:
+                    # slab overflow ships dense NOW, before any
+                    # aggregate: mass conserved, claims still last
+                    ov_owner = owner_of[np.searchsorted(keys, ovk)]
+                    for o in np.unique(ov_owner):
+                        m = ov_owner == o
+                        self._send_f32_push(int(o), ovk[m], ovr[m])
+            else:
+                rows[ridx] += rr
+        for o in sorted(okeys):
+            ok = okeys[o]
+            g = np.ascontiguousarray(
+                rows[np.searchsorted(keys, ok)], np.float32)
+            self._hier_send_agg(o, ok, g, hmins[o], extra)
+            sent_to.add(o)
+        return sent_to
+
     def _hier_poll(self) -> None:
         """Election/fallback state machine, driven from the training
         thread's natural poll points (push, tick boundary, pull waits):
@@ -4339,6 +4440,25 @@ class ShardedTable:
         cfg = self._hier
         if cfg is None or not cfg.agg or cfg.group < 2:
             return
+        if (cfg.agg == "mesh" and not self._hier_domain_down
+                and len(self._hier_group) >= 2):
+            # agg=mesh makes the host ONE failure domain: the mesh
+            # plane's collectives span every member, so a single
+            # convicted/dead member invalidates the whole reduce
+            # group. Latch sticky, demote the group as one unit —
+            # everyone (leader included) degrades to direct pushes
+            # and nobody re-enters this incarnation
+            exc = self._excluded_ranks() | self._dead_ranks
+            gone = sorted(r for r in self._hier_group if r in exc)
+            if gone:
+                self._hier_domain_down = True
+                self.hier_counters["domain_demotions"] += 1
+                _fl.record("hier_domain_down",
+                           {"table": self.name, "rank": self.rank,
+                            "gone": [int(r) for r in gone],
+                            "group": [int(r) for r in
+                                      self._hier_group]})
+                self._hier_domain_demote()
         new = self._hier_elect()
         repush = None
         with self._hier_lock:
@@ -4371,8 +4491,38 @@ class ShardedTable:
             direct = self._hier_direct
             shunned = self._hier_shunned
             cur = self._hier_leader
-        if direct and cur is not None and cur != shunned:
+        if (direct and cur is not None and cur != shunned
+                and not self._hier_domain_down):
             self._hier_reenter(cur)
+
+    def _hier_domain_demote(self) -> None:
+        """Demote my whole host group after the domain latch tripped.
+        A live LEADER force-flushes its buckets (its own contributions
+        have no retained copy — the flush is their only exit), then
+        goes direct and waives its floor; a live MEMBER runs the x/xa
+        expel handshake against a live leader (exactly-once handoff)
+        or, when the leader is the dead one, lets the election
+        fallback replay the retained window — both paths end direct
+        with floors waived, zero lost steps."""
+        with self._hier_lock:
+            lead = self._hier_leader
+            direct = self._hier_direct
+        if direct:
+            return
+        if lead == self.rank:
+            self._hier_maybe_flush(force=True)
+            with self._hier_lock:
+                self._hier_direct = True
+                self._hier_shunned = self.rank
+                self.hier_counters["fallbacks"] += 1
+            dead = self._excluded_ranks() | self._dead_ranks
+            for o in self._hier_cross:
+                if o not in dead:
+                    self.bus.send(o, f"psH:{self.name}", {"op": "r"})
+        elif lead is not None and lead not in (
+                self._excluded_ranks() | self._dead_ranks):
+            self._hier_expel_and_go_direct()
+        # dead-leader case: _hier_poll's election fallback replays
 
     def _hier_replay(self, repush: list, old, why: str) -> None:
         """The fallback's second half: re-push the retained window
@@ -4530,7 +4680,11 @@ class ShardedTable:
                 time.sleep(0.005)
         with self._hier_lock:
             lead = self._hier_leader
-        if lead == self.rank:
+            direct = self._hier_direct
+        if lead == self.rank and not direct:
+            # a demoted (domain-down) leader has nothing to drive: its
+            # members went direct and will never send RETIRED
+            # boundaries — waiting here would just burn the timeout
             with self._hier_lock:
                 self._hier_own_floor = int(RETIRED_CLOCK)
             while True:
@@ -4595,6 +4749,9 @@ class ShardedTable:
         if self._hier_ef is not None:
             out["ef_rows"] = int(
                 self._hier_ef.stats()["resident_rows"])
+        out["domain_down"] = int(self._hier_domain_down)
+        if self._hier_mesh is not None:
+            out["mesh"] = self._hier_mesh.stats()
         return out
 
     def push_dense(self, grad: np.ndarray) -> None:
@@ -4977,6 +5134,13 @@ class ShardedPSTrainer:
                     "flat wire under rebalancing")
             for t in tables.values():
                 t.attach_hier(self.hier_cfg)
+            if (self.hier_cfg.agg == "mesh"
+                    and self.hier_cfg.group > 1
+                    and self.membership is not None):
+                # hybrid plane: a mesh host is ONE failure domain —
+                # slow verdicts demote the whole host group
+                self.membership.bind_failure_domains(
+                    self.hier_cfg.group)
             if self.hier_cfg.agg and self.hier_cfg.group > 1:
                 _fl.record("hier_leader_elect", {
                     "table": "*", "old": -1,
@@ -5515,6 +5679,38 @@ class ShardedPSTrainer:
             out["leader"] = st["leader"]
             out["direct"] = st["direct"]
             break
+        return out
+
+    def hybrid_stats(self) -> Optional[dict]:
+        """Hybrid data plane (``agg=mesh``) block for ``wire_record``:
+        None when hier is off or the host f64 backend is configured,
+        ALL-ZERO when armed but idle (``group=1`` never flushes) — the
+        off-vs-idle convention, and all-NUMERIC by contract so sweep
+        tooling can diff any two arms field-by-field (schema test)."""
+        if self.hier_cfg is None or self.hier_cfg.agg != "mesh":
+            return None
+        out = {"backend_mesh": 0, "mesh_reduces": 0,
+               "rows_reduced": 0, "mesh_collective_bytes": 0,
+               "peak_stage_bytes": 0, "mesh_agg_fallbacks": 0,
+               "domain_demotions": 0, "domain_down": 0}
+        for t in self.tables.values():
+            out["mesh_reduces"] += int(
+                t.hier_counters["mesh_reduces"])
+            out["mesh_agg_fallbacks"] += int(
+                t.hier_counters["mesh_agg_fallbacks"])
+            out["domain_demotions"] += int(
+                t.hier_counters["domain_demotions"])
+            out["domain_down"] = max(out["domain_down"],
+                                     int(t._hier_domain_down))
+            m = t._hier_mesh
+            if m is not None:
+                out["backend_mesh"] = max(out["backend_mesh"],
+                                          int(m.m >= 2))
+                out["rows_reduced"] += int(m.rows_reduced)
+                out["mesh_collective_bytes"] += int(
+                    m.collective_bytes)
+                out["peak_stage_bytes"] = max(
+                    out["peak_stage_bytes"], int(m.peak_stage_bytes))
         return out
 
     def slowness_stats(self) -> Optional[dict]:

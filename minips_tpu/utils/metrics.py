@@ -112,6 +112,12 @@ def wire_record(trainer) -> dict:
         # aggregation + election/fallback counters — None when
         # MINIPS_HIER is off, zero counters when armed-idle (group=1)
         "hier": getattr(trainer, "hier_stats", lambda: None)(),
+        # hybrid data plane (MINIPS_HIER agg=mesh): the leader's
+        # in-host device-reduce counters — None when hier is off or
+        # the host f64 backend is configured, ALL-ZERO when armed-idle
+        # (group=1 never flushes); all-numeric by contract (the
+        # schema test pins it)
+        "hybrid": getattr(trainer, "hybrid_stats", lambda: None)(),
         # retransmission-protocol + fault-injection counters: None when
         # the respective layer is off ('off' vs 'clean' distinguishable)
         "reliable": trainer.reliable_stats(),

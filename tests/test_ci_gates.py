@@ -945,6 +945,177 @@ def test_hier_bitwise_and_idle_require_real_drills():
     assert any("under group=1" in p for p in probs)
 
 
+# ------------- hybrid-plane tripwires (HYBRID-WIN/HYBRID-IDLE)
+def _hybrid_art(t_completed=True, h_completed=True, t_rate=1990.0,
+                h_rate=2210.0, t_bytes=5_200_000, h_bytes=5_300_000,
+                backend=1, reduces=60, fallbacks=0, demotions=0,
+                t_reduces=0, h_lost=0, lt_completed=True,
+                lh_completed=True, lh_agree=True, t_loss=0.672,
+                h_loss=0.672, l_reduces=40, idle_equal=True,
+                idle_checked=96, idle_reduces=0, idle_agg=0,
+                deg_equal=True, deg_checked=96, deg_reduces=4,
+                deg_fallbacks=0) -> dict:
+    return {"hybrid_agg_3proc": {
+        "group": 2, "tree_ranks": [0, 1], "owner_rank": 2,
+        "tree": {"completed": t_completed,
+                 "rows_per_sec_per_process": t_rate,
+                 "l2_tx_bytes": t_bytes, "agg_frames": 25,
+                 "contribs": 25, "fallbacks": 0,
+                 "mesh_reduces": t_reduces, "mesh_agg_fallbacks": 0,
+                 "domain_demotions": 0, "backend_mesh": 0,
+                 "wire_frames_lost": 0},
+        "hybrid": {"completed": h_completed,
+                   "rows_per_sec_per_process": h_rate,
+                   "l2_tx_bytes": h_bytes, "agg_frames": 25,
+                   "contribs": 25, "fallbacks": 0,
+                   "mesh_reduces": reduces,
+                   "mesh_agg_fallbacks": fallbacks,
+                   "domain_demotions": demotions,
+                   "backend_mesh": backend,
+                   "wire_frames_lost": h_lost},
+        "loss_tree": {"completed": lt_completed, "loss_last": t_loss,
+                      "finals_agree": True, "mesh_reduces": 0},
+        "loss_hybrid": {"completed": lh_completed,
+                        "loss_last": h_loss, "finals_agree": lh_agree,
+                        "mesh_reduces": l_reduces},
+        "idle": {"equal": idle_equal, "rows_checked": idle_checked,
+                 "mesh_reduces": idle_reduces,
+                 "agg_frames": idle_agg},
+        "degenerate": {"equal": deg_equal,
+                       "rows_checked": deg_checked,
+                       "mesh_reduces": deg_reduces,
+                       "mesh_agg_fallbacks": deg_fallbacks}}}
+
+
+def test_hybrid_tripwires_pass_on_healthy_sweep():
+    from ci.bench_regression import hybrid_tripwires
+
+    assert hybrid_tripwires(_hybrid_art()) == []
+    assert hybrid_tripwires({}) == []  # absent sweep: vacuous
+
+
+def test_hybrid_win_requires_strict_rate_win_on_a_real_mesh():
+    from ci.bench_regression import hybrid_tripwires
+
+    # the rate win is the whole point: slower (or tied) hybrid trips
+    probs = hybrid_tripwires(_hybrid_art(h_rate=1800.0))
+    assert any("HYBRID-WIN" in p and "not strictly above" in p
+               for p in probs)
+    probs = hybrid_tripwires(_hybrid_art(h_rate=1990.0))
+    assert any("not strictly above" in p for p in probs)
+    # the mesh backend must provably engage — else mislabeled host-agg
+    probs = hybrid_tripwires(_hybrid_art(backend=0))
+    assert any("never engaged" in p for p in probs)
+    probs = hybrid_tripwires(_hybrid_art(reduces=0))
+    assert any("never engaged" in p for p in probs)
+    # fallbacks or demotions on a clean wire poison the comparison
+    probs = hybrid_tripwires(_hybrid_art(fallbacks=2))
+    assert any("mesh lane is sick" in p for p in probs)
+    probs = hybrid_tripwires(_hybrid_art(demotions=1))
+    assert any("mesh lane is sick" in p for p in probs)
+    # mesh reduces in the HOST arm = the baseline ran the lever
+    probs = hybrid_tripwires(_hybrid_art(t_reduces=3))
+    assert any("silently ran the hybrid backend" in p for p in probs)
+    # dead arms and lost frames can never pass
+    probs = hybrid_tripwires(_hybrid_art(t_completed=False))
+    assert any("hybrid_agg_3proc/tree" in p for p in probs)
+    probs = hybrid_tripwires(_hybrid_art(h_completed=False))
+    assert any("hybrid_agg_3proc/hybrid" in p for p in probs)
+    probs = hybrid_tripwires(_hybrid_art(h_lost=2))
+    assert any("unrecovered" in p for p in probs)
+
+
+def test_hybrid_win_bounds_cross_host_bytes_and_trajectory():
+    from ci.bench_regression import hybrid_tripwires
+
+    # cross-host bytes: > 10% over the tree = the reduce backend
+    # touched the wire (10% only absorbs SSP flush-boundary jitter)
+    probs = hybrid_tripwires(
+        _hybrid_art(t_bytes=5_000_000, h_bytes=6_000_000))
+    assert any("> 10%" in p for p in probs)
+    assert hybrid_tripwires(
+        _hybrid_art(t_bytes=5_000_000, h_bytes=5_400_000)) == []
+    # trajectory: the speed must not come from different math
+    probs = hybrid_tripwires(_hybrid_art(h_loss=0.80))
+    assert any("diverge" in p for p in probs)
+    probs = hybrid_tripwires(_hybrid_art(lt_completed=False))
+    assert any("rank-agreeing" in p for p in probs)
+    probs = hybrid_tripwires(_hybrid_art(lh_agree=False))
+    assert any("rank-agreeing" in p for p in probs)
+    # a trajectory leg that never reduced certifies nothing
+    probs = hybrid_tripwires(_hybrid_art(l_reduces=0))
+    assert any("never exercised" in p for p in probs)
+
+
+def test_hybrid_idle_and_degenerate_require_real_drills():
+    from ci.bench_regression import hybrid_tripwires
+
+    probs = hybrid_tripwires(_hybrid_art(idle_equal=False))
+    assert any("HYBRID-IDLE" in p and "bitwise-equal" in p
+               for p in probs)
+    probs = hybrid_tripwires(_hybrid_art(idle_checked=0))
+    assert any("HYBRID-IDLE" in p for p in probs)
+    # reduces or frames under group=1 = a pair wrongly entered hier
+    probs = hybrid_tripwires(_hybrid_art(idle_reduces=2))
+    assert any("fired under group=1" in p for p in probs)
+    probs = hybrid_tripwires(_hybrid_art(idle_agg=3))
+    assert any("fired under group=1" in p for p in probs)
+    probs = hybrid_tripwires(_hybrid_art(deg_equal=False))
+    assert any("one-device mesh" in p for p in probs)
+    probs = hybrid_tripwires(_hybrid_art(deg_checked=0))
+    assert any("degenerate" in p for p in probs)
+    # equal with zero reduces (or with fallbacks) = equal by luck
+    probs = hybrid_tripwires(_hybrid_art(deg_reduces=0))
+    assert any("silently disarmed" in p for p in probs)
+    probs = hybrid_tripwires(_hybrid_art(deg_fallbacks=1))
+    assert any("silently disarmed" in p for p in probs)
+
+
+# ------------- sparse-deposit tripwires (MESH-SPARSE, in mesh grid)
+def _mesh_sparse_art(d_completed=True, s_completed=True, ratio=585.0,
+                     rows_ratio=1.05, s_waves=36, d_waves=0) -> dict:
+    art = _mesh_art()
+    art["mesh_plane_fused"]["sparse_deposit"] = {
+        "dense": {"completed": d_completed, "deposit": "dense",
+                  "peak_deposit_bytes": 4_194_304,
+                  "sparse_waves": d_waves,
+                  "rows_per_sec_per_process": 13_600.0},
+        "sparse": {"completed": s_completed, "deposit": "sparse",
+                   "peak_deposit_bytes": 7_168,
+                   "sparse_waves": s_waves,
+                   "rows_per_sec_per_process": 14_300.0},
+        "peak_bytes_ratio": ratio, "rows_ratio": rows_ratio}
+    return art
+
+
+def test_mesh_sparse_passes_healthy_and_is_vacuous_when_absent():
+    assert mesh_tripwires(_mesh_sparse_art()) == []
+    # an older artifact without the sub-grid (pre-sparse): vacuous —
+    # the plain _mesh_art() healthy test above already covers it
+    assert mesh_tripwires(_mesh_art()) == []
+
+
+def test_mesh_sparse_requires_peak_win_rate_floor_and_engagement():
+    probs = mesh_tripwires(_mesh_sparse_art(ratio=3.0))
+    assert any("MESH-SPARSE" in p and "peak_bytes_ratio" in p
+               for p in probs)
+    probs = mesh_tripwires(_mesh_sparse_art(ratio=None))
+    assert any("peak_bytes_ratio" in p for p in probs)
+    probs = mesh_tripwires(_mesh_sparse_art(rows_ratio=0.80))
+    assert any("rows_ratio" in p for p in probs)
+    # the sparse arm must provably run sparse waves, and the dense
+    # baseline must provably NOT
+    probs = mesh_tripwires(_mesh_sparse_art(s_waves=0))
+    assert any("0 sparse waves" in p for p in probs)
+    probs = mesh_tripwires(_mesh_sparse_art(d_waves=2))
+    assert any("DENSE" in p for p in probs)
+    # dead arms can never pass
+    probs = mesh_tripwires(_mesh_sparse_art(d_completed=False))
+    assert any("both deposit arms" in p for p in probs)
+    probs = mesh_tripwires(_mesh_sparse_art(s_completed=False))
+    assert any("both deposit arms" in p for p in probs)
+
+
 def test_shape_mismatch_refuses_cross_shape_compare(capsys):
     prior = {"device_shape": "cpu:3", "metric": "m"}
     new = {"device_shape": "cpu:8", "metric": "m"}

@@ -291,7 +291,9 @@ def run_hier_lockstep(hier_spec: str, stats: "dict | None" = None):
                 time.sleep(0.005)
         if stats is not None:
             for key in ("l1_tx_bytes", "l2_tx_bytes", "l1_frames",
-                        "l2_frames", "agg_frames", "contribs"):
+                        "l2_frames", "agg_frames", "contribs",
+                        "mesh_reduces", "mesh_agg_fallbacks",
+                        "domain_demotions"):
                 stats[key] = sum(t.hier_counters[key] for t in tables)
         lost = [b.frames_lost for b in buses]
         return [t._w.copy() for t in tables], lost

@@ -1,0 +1,530 @@
+"""Hybrid data plane (``MINIPS_HIER`` ``agg=mesh``,
+train/mesh_plane.MeshAggregator + the sharded-PS psH lane) — PR17
+acceptance:
+
+- MeshAggregator units: the sorted-keys contract (callers searchsorted
+  into the returned keys, including the dedup kernel's no-coalesce
+  early-out), degenerate-tier bitwise equivalence with THE shared f64
+  dedup kernel, key-space refusal, stats shape;
+- stamp folding: a MESH-aggregated flush carries the same hmin/floor
+  claims the host f64 path ships — consistency semantics do not depend
+  on the reduce backend;
+- the 3-rank BSP lockstep drills: degenerate one-device mesh is
+  BITWISE equal to ``agg=host``; the device tiers (f32 exact, blk8 +
+  residual repay) are BITWISE equal to the flat wire; armed-idle
+  (``group=1,agg=mesh``) is bitwise equal to off with all-zero
+  counters (HYBRID-IDLE);
+- whole-host failure domains: ``expand_to_domains`` units, the
+  membership quorum's domain-expanded slow verdicts, and the in-proc
+  domain-demotion state machine (leader force-flush → direct; member
+  under a dead leader → election fallback replay; the latch is
+  sticky — no re-entry this incarnation);
+- trainer ``hybrid_stats``: None when off/host-backend, all-zero when
+  armed-idle, all-numeric always (the wire_record schema contract);
+- the slow tier: seeded SIGKILL of a mesh MEMBER mid-run — the whole
+  host group demotes as ONE domain, survivors re-enter direct push and
+  finish bitwise with zero lost steps; the flight boxes carry
+  ``hier_domain_down``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from minips_tpu.balance.control_plane import (SuspicionQuorum,
+                                              expand_to_domains)
+from minips_tpu.balance.hier import HierConfig
+from minips_tpu.balance.membership import Membership
+from minips_tpu.train.mesh_plane import MeshAggregator
+from minips_tpu.train.sharded_ps import (ShardedPSTrainer,
+                                         sum_duplicate_keys)
+from tests.test_hier import _LockstepCons, _mk_tables, run_hier_lockstep
+
+# ------------------------------------------------- aggregator units
+
+
+def test_mesh_agg_degenerate_is_the_host_kernel_sorted(monkeypatch):
+    """The degenerate (one-device) tier IS the shared f64 dedup kernel
+    in deposit order — including the kernel's no-coalesce early-out,
+    which returns the ORIGINAL (unsorted) pairing: reduce() contracts
+    SORTED keys, so the tier must restore the order callers
+    searchsorted into."""
+    monkeypatch.setenv("MINIPS_HIER_MESH_DEVS", "1")
+    agg = MeshAggregator(32, 2, slots=2)
+    assert agg.m == 1 and agg.mesh is None
+    # no duplicates anywhere -> the kernel early-outs unsorted
+    agg.deposit(0, np.array([7, 3], np.int64),
+                np.arange(4, dtype=np.float32).reshape(2, 2))
+    agg.deposit(1, np.array([5, 1], np.int64),
+                np.arange(4, 8, dtype=np.float32).reshape(2, 2))
+    k, rows, rk, rr = agg.reduce()
+    assert k.tolist() == [1, 3, 5, 7]          # SORTED, the contract
+    np.testing.assert_array_equal(rows, np.array(
+        [[6.0, 7.0], [2.0, 3.0], [4.0, 5.0], [0.0, 1.0]], np.float32))
+    assert rk.size == 0 and rr.size == 0       # exact tier: no residual
+    # duplicates across slots -> bitwise what the f64 kernel ships
+    ks = np.array([3, 7, 3], np.int64)
+    gs = np.full((3, 2), 0.1, np.float32)
+    agg.deposit(0, ks, gs)
+    agg.deposit(1, np.array([7], np.int64),
+                np.full((1, 2), 0.2, np.float32))
+    k2, rows2, _, _ = agg.reduce()
+    ek, eg, _ = sum_duplicate_keys(
+        np.concatenate([ks, [7]]),
+        np.concatenate([gs, np.full((1, 2), 0.2, np.float32)]), 2)
+    assert k2.tolist() == sorted(ek.tolist()) == [3, 7]
+    np.testing.assert_array_equal(rows2, eg)
+    st = agg.stats()
+    assert st["backend"] == "host-degenerate"
+    assert st["comm"] == "float32"             # what it ships, exactly
+    assert st["reduces"] == 2 and st["rows_reduced"] == 6
+    assert st["collective_bytes"] == 0         # nothing crossed devices
+
+
+def test_mesh_agg_refuses_keys_outside_the_space_and_empty_reduce():
+    agg = MeshAggregator(16, 2, slots=1)
+    with pytest.raises(ValueError, match="key space"):
+        agg.deposit(0, np.array([16], np.int64),
+                    np.zeros((1, 2), np.float32))
+    with pytest.raises(ValueError, match="key space"):
+        agg.deposit(0, np.array([-1], np.int64),
+                    np.zeros((1, 2), np.float32))
+    agg.deposit(0, np.zeros(0, np.int64), np.zeros((0, 2), np.float32))
+    k, rows, rk, rr = agg.reduce()             # nothing staged
+    assert k.size == 0 and rows.shape == (0, 2)
+    assert rk.size == 0 and rr.shape == (0, 2)
+    assert agg.reduces == 0                    # an idle flush is free
+    with pytest.raises(ValueError, match="comm"):
+        MeshAggregator(16, 2, slots=2, comm="int4")
+
+
+def test_mesh_agg_device_tier_matches_host_kernel(monkeypatch):
+    """The REAL device path (conftest arms 8 host devices): COO stage →
+    segment-sum densify → reduce-scatter. The f32 tier must match the
+    host kernel's sums on disjoint-per-slot keys, and the grow-only
+    stack length must never shrink (the compile-thrash guard)."""
+    monkeypatch.delenv("MINIPS_HIER_MESH_DEVS", raising=False)
+    agg = MeshAggregator(32, 4, slots=2, comm="float32")
+    assert agg.m == 2 and agg.stats()["backend"] == "mesh"
+    rng = np.random.default_rng(17)
+    k0 = np.array([1, 9, 1, 30], np.int64)     # in-slot duplicate
+    g0 = rng.standard_normal((4, 4)).astype(np.float32)
+    k1 = np.array([9, 2], np.int64)            # cross-slot duplicate
+    g1 = rng.standard_normal((2, 4)).astype(np.float32)
+    agg.deposit(0, k0, g0)
+    agg.deposit(1, k1, g1)
+    k, rows, rk, rr = agg.reduce()
+    ek, eg, _ = sum_duplicate_keys(np.concatenate([k0, k1]),
+                                   np.concatenate([g0, g1]), 4)
+    order = np.argsort(ek, kind="stable")
+    assert k.tolist() == ek[order].tolist()
+    np.testing.assert_allclose(rows, eg[order], rtol=0, atol=1e-6)
+    assert rk.size == 0                        # f32: exact, no residual
+    assert agg.collective_bytes > 0            # the exchange is counted
+    L0 = agg._L
+    agg.deposit(0, np.array([5], np.int64), np.ones((1, 4), np.float32))
+    agg.reduce()
+    assert agg._L == L0                        # grow-only, never shrinks
+    assert agg.peak_stage_bytes > 0
+
+
+# ------------------------------------------------------- stamp folding
+
+
+def test_mesh_aggregate_stamp_is_min_over_contributors(monkeypatch):
+    """Same drill as the host-path stamp test (tests/test_hier.py) with
+    the MESH backend flushing: the psP head must carry the identical
+    hmin = min over contributors' clocks and the identical boundary
+    floor claims — the reduce backend is invisible to consistency."""
+    from tests.conftest import mk_loopback_buses
+
+    monkeypatch.setenv("MINIPS_HIER_MESH_DEVS", "1")
+    buses = mk_loopback_buses(3)
+    try:
+        tables = _mk_tables(buses, "ms", "group=2,agg=mesh")
+        t0 = tables[0]                       # leader of group {0, 1}
+        sent = []
+        real_send = t0.bus.send
+
+        def spy(dest, kind, head, blob=b"", **kw):
+            if kind.startswith("psP:"):
+                sent.append((dest, dict(head)))
+            return real_send(dest, kind, head, blob=blob, **kw)
+
+        t0.bus.send = spy
+        _LockstepCons.clocks = [5, 3, 5]
+        k0 = np.array([65, 70], np.int64)
+        g0 = np.ones((2, 2), np.float32)
+        t0._hier_contribute(0, 2, k0, g0)    # my own slice, clk 5
+        k1 = np.array([72, 80], np.int64)
+        g1 = np.full((2, 2), 2.0, np.float32)
+        blob = k1.tobytes() + g1.tobytes()
+        t0._on_hier(1, {"op": "c", "o": 2, "n": 2, "clk": 3,
+                        "__blob__": blob, **t0._cfg_header()})
+        t0._on_hier(1, {"op": "b", "f": 9})
+        t0.hier_boundary()                   # own floor = clk + 1 = 6
+        aggs = [h for _, h in sent if "hmin" in h]
+        assert len(aggs) == 1, sent
+        head = aggs[0]
+        assert head["hmin"] == 3             # min(5, 3) — backend-free
+        floors = dict(zip(head["hfr"], head["hfv"]))
+        assert floors == {0: 6, 1: 9}
+        assert t0.hier_counters["agg_frames"] == 1
+        assert t0.hier_counters["agg_rows"] == 4
+        # and the mesh backend demonstrably did the reduce
+        assert t0.hier_counters["mesh_reduces"] == 1
+        assert t0.hier_counters["mesh_agg_fallbacks"] == 0
+        assert t0._hier_mesh is not None
+        assert t0._hier_mesh.stats()["backend"] == "host-degenerate"
+    finally:
+        for b in buses:
+            b.close()
+
+
+# -------------------------------------------------- lockstep bitwise
+
+
+@pytest.fixture(scope="module")
+def flat_lockstep():
+    return run_hier_lockstep("")
+
+
+def test_hybrid_degenerate_mesh_is_bitwise_equal_to_host_agg(
+        flat_lockstep, monkeypatch):
+    """Satellite pin: a one-device mesh (``MINIPS_HIER_MESH_DEVS=1``)
+    runs the SAME f64 dedup kernel the host backend runs, in the same
+    deposit order — bitwise equal to ``agg=host`` (which is itself
+    pinned bitwise to the flat wire), with the mesh lane engaged."""
+    monkeypatch.setenv("MINIPS_HIER_MESH_DEVS", "1")
+    flat, _ = flat_lockstep
+    host_stats: dict = {}
+    host, lost_h = run_hier_lockstep("group=2", stats=host_stats)
+    mesh_stats: dict = {}
+    mesh, lost_m = run_hier_lockstep("group=2,agg=mesh",
+                                     stats=mesh_stats)
+    assert lost_h == [0, 0, 0] and lost_m == [0, 0, 0]
+    for r in range(3):
+        np.testing.assert_array_equal(host[r], mesh[r])
+        np.testing.assert_array_equal(flat[r], mesh[r])
+    assert mesh_stats["mesh_reduces"] > 0      # the backend engaged
+    assert mesh_stats["mesh_agg_fallbacks"] == 0
+    assert mesh_stats["domain_demotions"] == 0
+    assert mesh_stats["agg_frames"] == host_stats["agg_frames"]
+    assert mesh_stats["l2_tx_bytes"] == host_stats["l2_tx_bytes"]
+    assert host_stats["mesh_reduces"] == 0     # host backend: none
+
+
+def test_hybrid_device_f32_tier_is_bitwise_equal_to_flat(
+        flat_lockstep, monkeypatch):
+    """THE tentpole bitwise pin, exact tier: shm pre-reduce → device
+    reduce-scatter over the (conftest-armed) host mesh, f32 comm —
+    bitwise the flat wire's state, reduces on REAL devices."""
+    monkeypatch.delenv("MINIPS_HIER_MESH_DEVS", raising=False)
+    monkeypatch.setenv("MINIPS_HIER_MESH_COMM", "float32")
+    flat, _ = flat_lockstep
+    stats: dict = {}
+    mesh, lost = run_hier_lockstep("group=2,agg=mesh", stats=stats)
+    assert lost == [0, 0, 0]
+    for r in range(3):
+        np.testing.assert_array_equal(flat[r], mesh[r])
+    assert stats["mesh_reduces"] > 0
+    assert stats["mesh_agg_fallbacks"] == 0
+
+
+def test_hybrid_device_blk8_tier_is_bitwise_equal_to_flat(
+        flat_lockstep, monkeypatch):
+    """THE tentpole bitwise pin, quantized tier: the blk8 exchange's
+    quantization error comes back as reduce()'s residual and — with an
+    exact push wire — is repaid f32 within the SAME flush, so the
+    owner's applied state is bitwise the flat wire's."""
+    monkeypatch.delenv("MINIPS_HIER_MESH_DEVS", raising=False)
+    monkeypatch.setenv("MINIPS_HIER_MESH_COMM", "blk8")
+    flat, _ = flat_lockstep
+    stats: dict = {}
+    mesh, lost = run_hier_lockstep("group=2,agg=mesh", stats=stats)
+    assert lost == [0, 0, 0]
+    for r in range(3):
+        np.testing.assert_array_equal(flat[r], mesh[r])
+    assert stats["mesh_reduces"] > 0
+    assert stats["mesh_agg_fallbacks"] == 0
+
+
+def test_hybrid_armed_idle_is_bitwise_equal_to_off(flat_lockstep,
+                                                   monkeypatch):
+    """HYBRID-IDLE: ``group=1,agg=mesh`` arms the plane but every
+    group is a singleton — no flush ever runs, state is bitwise off,
+    and every counter is zero (the zeros-when-idle contract the
+    wire_record hybrid block rides)."""
+    monkeypatch.delenv("MINIPS_HIER_MESH_DEVS", raising=False)
+    flat, _ = flat_lockstep
+    stats: dict = {}
+    idle, lost = run_hier_lockstep("group=1,agg=mesh", stats=stats)
+    assert lost == [0, 0, 0]
+    for r in range(3):
+        np.testing.assert_array_equal(flat[r], idle[r])
+    assert all(v == 0 for v in stats.values()), stats
+
+
+# --------------------------------------------------- failure domains
+
+
+def test_expand_to_domains_is_contiguous_and_pure():
+    assert expand_to_domains({3}, 2, 4) == {2, 3}
+    assert expand_to_domains({0}, 2, 5) == {0, 1}
+    assert expand_to_domains({4}, 2, 5) == {4}      # tail singleton
+    assert expand_to_domains({2}, 2, 3) == {2}
+    assert expand_to_domains({0, 5}, 3, 7) == {0, 1, 2, 3, 4, 5}
+    assert expand_to_domains({2}, 1, 4) == {2}      # group<=1 identity
+    assert expand_to_domains({2}, 0, 4) == {2}
+    assert expand_to_domains(set(), 4, 8) == set()
+
+
+def _mk_membership_stub(n: int, live: set, group: int) -> Membership:
+    """A Membership with exactly the state ``_update_slow_verdicts``
+    reads — the quorum-logic unit rig (tests/test_fail_slow.py's
+    convention), no trainer or wire behind it."""
+    mb = object.__new__(Membership)
+    mb._lock = threading.Lock()
+    mb._slow_lock = threading.Lock()
+    mb.live = set(live)
+    mb.dead = set()
+    mb.left = set()
+    mb.n = n
+    mb.slow_quorum = SuspicionQuorum(0)
+    mb._domain_group = group
+    mb._slow_verdicts = set()
+    mb._slow_since = {}
+    mb.counters = {"slow_verdicts": 0}
+    return mb
+
+
+def test_membership_slow_verdict_expands_to_the_whole_domain():
+    """A quorum-corroborated slow verdict against ONE mesh member
+    implicates its whole contiguous host group — and clears with it:
+    domain verdicts are recomputed from the base set every pass, never
+    latched (the demotion bias must lift when the corroboration
+    does)."""
+    mb = _mk_membership_stub(4, {0, 1, 2, 3}, group=2)
+    # 3 of 4 live ranks corroborate rank 3 (quorum_needed = 3)
+    mb.slow_quorum.mark_local(3, True)
+    mb.slow_quorum.vote(1, [3])
+    mb.slow_quorum.vote(2, [3])
+    mb._update_slow_verdicts()
+    assert mb.slow_view() == {2, 3}            # 3's verdict drags 2
+    assert mb.counters["slow_verdicts"] == 2
+    # one voter retracts -> below quorum -> base verdict clears AND
+    # the domain expansion lifts with it
+    mb.slow_quorum.vote(1, [])
+    mb._update_slow_verdicts()
+    assert mb.slow_view() == set()
+    assert mb._slow_since == {}
+    # domains off (group=1): the same ballots convict only rank 3
+    mb2 = _mk_membership_stub(4, {0, 1, 2, 3}, group=1)
+    mb2.slow_quorum.mark_local(3, True)
+    mb2.slow_quorum.vote(1, [3])
+    mb2.slow_quorum.vote(2, [3])
+    mb2._update_slow_verdicts()
+    assert mb2.slow_view() == {3}
+
+
+def test_membership_domain_expansion_skips_dead_ranks():
+    """The expansion implicates LIVE peers only — a dead domain peer
+    is the death quorum's problem, not a slow verdict."""
+    mb = _mk_membership_stub(4, {0, 1, 3}, group=2)
+    mb.dead = {2}
+    mb.slow_quorum.mark_local(3, True)
+    mb.slow_quorum.vote(1, [3])
+    mb._update_slow_verdicts()                 # quorum of {0,1,3} = 2
+    assert mb.slow_view() == {3}               # 2 is dead: not dragged
+
+
+# --------------------------------------- in-proc domain demotion
+
+
+def test_domain_demote_leader_force_flushes_then_goes_direct(
+        monkeypatch):
+    """A mesh MEMBER dies: the leader's whole host is one failure
+    domain — the latch trips, the leader force-flushes its buckets
+    (its own contributions have no retained copy; the flush is their
+    only exit), goes direct, and never re-enters this incarnation."""
+    from tests.conftest import mk_loopback_buses
+
+    monkeypatch.setenv("MINIPS_HIER_MESH_DEVS", "1")
+    buses = mk_loopback_buses(3)
+    try:
+        tables = _mk_tables(buses, "dd", "group=2,agg=mesh")
+        t0 = tables[0]                       # leader of group {0, 1}
+        _LockstepCons.clocks = [1, 1, 1]
+        t0._hier_contribute(0, 2, np.array([65, 70], np.int64),
+                            np.ones((2, 2), np.float32))
+        assert t0._hier_buckets              # mass pending in-tree
+        t0._dead_ranks.add(1)                # the member is convicted
+        t0._hier_poll()
+        assert t0._hier_domain_down and t0._hier_direct
+        h = t0.hier_counters
+        assert h["domain_demotions"] == 1
+        assert h["fallbacks"] == 1
+        assert h["agg_frames"] == 1          # the force-flush shipped
+        assert not t0._hier_buckets
+        # sticky: polls neither re-demote nor re-enter the tree
+        t0._hier_poll()
+        assert h["domain_demotions"] == 1 and t0._hier_direct
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_domain_demote_member_replays_when_the_leader_is_the_dead_one(
+        monkeypatch):
+    """The dead rank IS the leader: the member's domain latch trips
+    and the election fallback replays the retained window direct —
+    zero lost steps, the floor waiver rides after the re-pushes."""
+    from tests.conftest import mk_loopback_buses
+
+    monkeypatch.setenv("MINIPS_HIER_MESH_DEVS", "1")
+    buses = mk_loopback_buses(3)
+    try:
+        tables = _mk_tables(buses, "dm", "group=2,agg=mesh")
+        t1 = tables[1]                       # member under leader 0
+        _LockstepCons.clocks = [1, 1, 1]
+        t1._hier_contribute(0, 2, np.array([72, 80], np.int64),
+                            np.ones((2, 2), np.float32))
+        assert len(t1._hier_retained) == 1
+        t1._dead_ranks.add(0)                # the LEADER is convicted
+        t1._hier_poll()
+        assert t1._hier_domain_down and t1._hier_direct
+        h = t1.hier_counters
+        assert h["domain_demotions"] == 1
+        assert h["fallbacks"] == 1
+        assert h["repushed_steps"] == 1      # the window replayed
+        assert not t1._hier_retained
+        with t1._hier_lock:
+            assert t1._hier_leader == 1      # leads itself now
+        # sticky even though the new leader (itself) is live
+        t1._hier_poll()
+        assert h["domain_demotions"] == 1 and t1._hier_direct
+    finally:
+        for b in buses:
+            b.close()
+
+
+# ------------------------------------------------ trainer-level stats
+
+
+def test_trainer_hybrid_stats_off_vs_idle_vs_engaged(monkeypatch):
+    """wire_record's ``hybrid`` block contract: None when hier is off
+    OR the host backend is configured; ALL-ZERO when armed-idle;
+    all-NUMERIC always, so sweep tooling diffs arms field-by-field."""
+    tr = object.__new__(ShardedPSTrainer)
+    tr.hier_cfg = None
+    assert tr.hybrid_stats() is None           # hier off
+    tr.hier_cfg = HierConfig.parse("group=2")
+    assert tr.hybrid_stats() is None           # host f64 backend
+    tr.hier_cfg = HierConfig.parse("group=1,agg=mesh")
+    tr.tables = {}
+    st = tr.hybrid_stats()
+    assert st is not None
+    assert all(isinstance(v, int) for v in st.values()), st
+    assert all(v == 0 for v in st.values()), st
+    assert set(st) == {"backend_mesh", "mesh_reduces", "rows_reduced",
+                       "mesh_collective_bytes", "peak_stage_bytes",
+                       "mesh_agg_fallbacks", "domain_demotions",
+                       "domain_down"}
+    # an engaged table's counters surface through the block
+    from tests.conftest import mk_loopback_buses
+
+    monkeypatch.setenv("MINIPS_HIER_MESH_DEVS", "1")
+    buses = mk_loopback_buses(3)
+    try:
+        tables = _mk_tables(buses, "hs", "group=2,agg=mesh")
+        t0 = tables[0]
+        _LockstepCons.clocks = [1, 1, 1]
+        t0._hier_contribute(0, 2, np.array([65], np.int64),
+                            np.ones((1, 2), np.float32))
+        t0._hier_maybe_flush(force=True)
+        tr.tables = {"hs": t0}
+        st = tr.hybrid_stats()
+        assert st["mesh_reduces"] == 1 and st["rows_reduced"] == 1
+        assert st["backend_mesh"] == 0         # degenerate: host tier
+        assert st["domain_down"] == 0
+        assert all(isinstance(v, int) for v in st.values()), st
+    finally:
+        for b in buses:
+            b.close()
+
+
+# ------------------------------------------------------------ slow tier
+
+
+@pytest.mark.slow
+def test_whole_host_failure_drill_demotes_the_domain_as_one(tmp_path):
+    """The whole-host drill: seeded SIGKILL of rank 1 — a mesh MEMBER
+    of host group {0,1} — mid-run under ``agg=mesh``. The host is ONE
+    failure domain: the surviving leader force-flushes, demotes the
+    whole group, and re-enters direct push; survivors finish all steps
+    and agree BITWISE with zero lost frames; the flight boxes carry
+    ``hier_domain_down``."""
+    import tempfile
+
+    from minips_tpu import launch
+
+    run_id = str(92_000_000 + os.getpid())
+    flight_dir = os.path.join(tempfile.gettempdir(),
+                              f"minips-flight-{run_id}")
+    ck = str(tmp_path / "ck")
+    rc, events = launch.run_local_job_raw(
+        3, [sys.executable, "-m", "minips_tpu.apps.sharded_ps_example",
+            "--model", "sparse", "--mode", "ssp", "--staleness", "2",
+            "--iters", "30", "--batch", "64",
+            "--checkpoint-dir", ck, "--checkpoint-every", "5"],
+        base_port=None,
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                   "XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=2",
+                   "MINIPS_ELASTIC": "1",
+                   "MINIPS_HIER": "group=2,agg=mesh",
+                   "MINIPS_CHAOS_KILL": "7:rank=1,step=12",
+                   "MINIPS_HEARTBEAT": "interval=0.1,timeout=1.0",
+                   "MINIPS_RUN_ID": run_id},
+        timeout=240.0, kill_on_failure=False)
+    dones = {r: ev[-1] for r, ev in enumerate(events)
+             if ev and ev[-1].get("event") == "done"}
+    assert set(dones) == {0, 2}, (rc, events)
+    for d in dones.values():
+        assert d["clock"] == 30
+        assert d["max_skew_seen"] <= 3           # SSP bound held
+        assert d["frames_dropped"] == 0          # zero poisons
+        assert d["wire_frames_lost"] == 0        # zero unrecovered
+        assert np.isfinite(d["loss_last"])
+        assert d["hier_spec"] == "group=2,agg=mesh"
+        assert d["hybrid"] is not None
+    # rank 0 led the broken domain: it demoted the group AS ONE and
+    # its mesh backend had demonstrably engaged before the kill
+    h0 = dones[0]["hybrid"]
+    assert h0["domain_demotions"] >= 1
+    assert h0["domain_down"] == 1
+    assert h0["backend_mesh"] == 1               # 2 devices were armed
+    assert h0["mesh_reduces"] >= 1
+    assert h0["mesh_agg_fallbacks"] == 0
+    assert dones[0]["hier"]["fallbacks"] >= 1    # re-entered direct
+    # rank 2's singleton group never had a domain to lose
+    assert dones[2]["hybrid"]["domain_demotions"] == 0
+    # survivors agree BITWISE on the final table
+    sums = [d["param_sum"] for d in dones.values()]
+    norms = [d["param_norm"] for d in dones.values()]
+    assert sums[0] == sums[1] and norms[0] == norms[1], (sums, norms)
+    # the post-mortem box carries the domain demotion with its WHY
+    path = os.path.join(flight_dir, "flight-rank0.json")
+    assert os.path.exists(path), os.listdir(flight_dir)
+    doc = json.load(open(path))
+    downs = [e for e in doc["events"]
+             if e["kind"] == "hier_domain_down"]
+    assert downs, sorted({e["kind"] for e in doc["events"]})
+    assert downs[0]["args"]["gone"] == [1]
+    assert downs[0]["args"]["group"] == [0, 1]

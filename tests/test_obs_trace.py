@@ -399,7 +399,7 @@ def test_wire_record_schema_full_layout():
                 "wire_frames_lost", "wire_frames_malformed", "timing",
                 "hist", "window", "heartbeat", "cache", "ef",
                 "reliable", "chaos", "serve", "rebalance", "membership",
-                "hedge", "slowness", "hier"}
+                "hedge", "slowness", "hier", "hybrid"}
     assert expected <= set(rec)
     # layers OFF in this run report None — not {} — and vice versa
     assert rec["cache"] is None
@@ -407,6 +407,7 @@ def test_wire_record_schema_full_layout():
     assert rec["hedge"] is None     # fail-slow plane off: both None
     assert rec["slowness"] is None
     assert rec["hier"] is None      # two-level push tree off: None
+    assert rec["hybrid"] is None    # hybrid data plane off: None
     assert rec["reliable"] is None
     assert rec["chaos"] is None
     assert rec["rebalance"] is None
