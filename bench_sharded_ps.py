@@ -129,6 +129,10 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     env_extra["MINIPS_ELASTIC"] = ""
     env_extra["MINIPS_CHAOS_KILL"] = ""
     env_extra["MINIPS_HEARTBEAT"] = ""
+    # planned redistribution schedules migration state rounds — an
+    # armed MINIPS_RESHARD must not silently re-lane (or refuse, with
+    # no rebalancer armed) the non-reshard arms
+    env_extra["MINIPS_RESHARD"] = ""
     # the in-mesh collective plane rides its own sweep via --plane; an
     # armed MINIPS_MESH must not reroute (or refuse) the wire arms
     env_extra["MINIPS_MESH"] = ""
@@ -314,6 +318,7 @@ def fail_slow_arms(quick: bool = False) -> dict:
              "--storm-from", "2", "--storm-until", str(f_iters),
              "--storm-pulls", "6", "--storm-keys", "64"]
     env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+            "MINIPS_RESHARD": "",
             "MINIPS_RELIABLE": "", "MINIPS_REBALANCE": "",
             "MINIPS_TRACE": "", "MINIPS_SERVE": "",
             "MINIPS_BUS": "", "MINIPS_WIRE_FMT": "",
@@ -429,6 +434,219 @@ def fail_slow_arms(quick: bool = False) -> dict:
     return grid
 
 
+def reshard_arms(quick: bool = False) -> dict:
+    """RESHARD-MEM / RESHARD-SAFE (planned collective redistribution,
+    balance/redistribute.py): the memory-bounded N->M resharding plane
+    drilled four ways.
+
+    - ``mem``: the streaming checkpoint-restore drill (mover (c)) at a
+      RAM-visible table size — capped read bitwise-equal to uncapped,
+      measured peak staging <= cap, legacy whole-member staging > cap.
+    - ``drain_planned`` vs ``drain_p2p``: the SAME whole-rank drain
+      (rank 0 hands its shard over mid-run) with the planner armed at a
+      small cap vs the legacy one-shot p2p ship. Both complete bitwise;
+      the planned arm's measured ``reshard.peak_stage_bytes`` stays
+      under the cap while the p2p arm's ``rebalance.peak_stage_bytes``
+      (the whole staged shard) provably exceeds it at the same size —
+      RESHARD-MEM's live-wire leg.
+    - ``kill``: seeded SIGKILL of a gainer mid-run with the planner and
+      an aggressive rebalancer armed; survivors restore the dead
+      ranges from the elastic checkpoint and finish with zero
+      unrecovered frames and agreeing finals — RESHARD-SAFE's crash
+      leg (the exact mid-round resume/abort semantics are pinned by
+      tests/test_reshard.py; this arm pins process-level survival).
+    - ``part``: a seeded link cut opens across the drain window
+      (sender->gainer) with the reliable plane armed; the plan's slice
+      rounds retransmit through the heal, everyone completes with zero
+      unrecovered frames, and the post-mortem flight boxes carry the
+      ``reshard_round`` evidence with ZERO pre-arming.
+    """
+    import glob as _glob
+    import tempfile
+
+    from minips_tpu import launch as _launch
+
+    cap = 4096                       # bytes: far below one shard
+    r_iters = 20 if quick else 30
+    drain_at = 8
+    base = [sys.executable, "-m",
+            "minips_tpu.apps.sharded_ps_example",
+            "--model", "sparse", "--mode", "ssp",
+            "--staleness", "2", "--iters", str(r_iters),
+            "--batch", "64", "--checkpoint-every", "5",
+            "--drain-rank", "0", "--drain-at", str(drain_at)]
+    env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+            "MINIPS_CHAOS": "", "MINIPS_RELIABLE": "",
+            "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
+            "MINIPS_SERVE": "", "MINIPS_BUS": "",
+            "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
+            "MINIPS_HEARTBEAT": "interval=0.1,timeout=2.0",
+            "MINIPS_PUSH_COMM": "", "MINIPS_MESH": "",
+            "MINIPS_AUTOSCALE": "1", "MINIPS_OBS": "",
+            "MINIPS_FLIGHT": "", "MINIPS_SLOW": "",
+            "MINIPS_HEDGE": "", "MINIPS_ELASTIC": "1",
+            "MINIPS_RESHARD": ""}
+    grid: dict = {"iters": r_iters, "cap": cap,
+                  "drain_at": drain_at}
+
+    def drain_arm(extra_env: dict, flight: str = "") -> dict:
+        try:
+            with tempfile.TemporaryDirectory() as ck:
+                rc, events = _launch.run_local_job_raw(
+                    3, base + ["--checkpoint-dir", ck],
+                    base_port=None, env_extra={**env0, **extra_env},
+                    timeout=240.0, kill_on_failure=False)
+            by_last = {r: (ev[-1] if ev else {})
+                       for r, ev in enumerate(events)}
+            dones = [by_last[r] for r in (1, 2)
+                     if by_last[r].get("event") == "done"]
+            if rc != 0 or len(dones) != 2:
+                return {"completed": False,
+                        "error": f"rc={rc}: {by_last}"[:400]}
+            stamps = list(by_last.values())
+            rsh = [d.get("reshard") for d in stamps]
+            reb = [d.get("rebalance") or {} for d in stamps]
+            sums = {d.get("param_sum") for d in dones}
+            out = {
+                "completed": True,
+                "leaver_drained":
+                    by_last[0].get("event") == "drained",
+                "blocks_moved": sum(r.get("blocks_out", 0)
+                                    for r in reb),
+                # max, not sum: the cap bounds each rank's worst
+                # simultaneous snapshot
+                "peak_p2p": max(r.get("peak_stage_bytes", 0)
+                                for r in reb),
+                "wire_frames_lost": sum(
+                    d.get("wire_frames_lost", 0) for d in dones),
+                "finals_agree": len(sums) == 1,
+            }
+            if any(r is not None for r in rsh):
+                live = [r for r in rsh if r]
+                out["reshard"] = {
+                    "plans": sum(r.get("plans", 0) for r in live),
+                    "rounds": sum(r.get("rounds", 0) for r in live),
+                    "slices": sum(r.get("slices", 0) for r in live),
+                    "dup_slices": sum(r.get("dup_slices", 0)
+                                      for r in live),
+                    "aborts": sum(r.get("aborts", 0) for r in live),
+                    "peak_planned": max(r.get("peak_stage_bytes", 0)
+                                        for r in live),
+                }
+            else:
+                out["reshard_absent"] = all(r is None for r in rsh)
+            if flight:
+                files = sorted(_glob.glob(os.path.join(
+                    flight, "flight-rank*.json")))
+                kinds: set = set()
+                for fp in files:
+                    with open(fp) as fh:
+                        doc = json.load(fh)
+                    kinds |= {e.get("kind")
+                              for e in doc.get("events", ())}
+                seen = {"reshard_round", "reshard_resume",
+                        "reshard_abort"}
+                out["flight_dumps"] = len(files)
+                out["flight_events"] = sorted(kinds & seen)
+                out["flight_events_ok"] = "reshard_round" in kinds
+            return out
+        except Exception as e:  # noqa: BLE001 - completion-gated
+            return {"completed": False, "error": str(e)[:300]}
+
+    # -------- the live-wire staging A/B: same drain, planner on/off
+    grid["drain_planned"] = drain_arm(
+        {"MINIPS_RESHARD": f"cap={cap}"})
+    grid["drain_p2p"] = drain_arm({})
+
+    # -------- kill: seeded SIGKILL of gainer rank 2 mid-run; the
+    # planner and an eager rebalancer are both armed so state rounds
+    # are in flight around the kill window
+    kill_step = max(2, r_iters // 3)
+    grid["kill_step"] = kill_step
+    try:
+        with tempfile.TemporaryDirectory() as ck:
+            kbase = [sys.executable, "-m",
+                     "minips_tpu.apps.sharded_ps_example",
+                     "--model", "sparse", "--mode", "ssp",
+                     "--staleness", "2", "--iters", str(r_iters),
+                     "--batch", "64", "--checkpoint-every", "5",
+                     "--checkpoint-dir", ck]
+            rc, events = _launch.run_local_job_raw(
+                3, kbase, base_port=None,
+                env_extra={**env0,
+                           "MINIPS_RESHARD": f"cap={cap}",
+                           "MINIPS_REBALANCE":
+                               ("block=2048,threshold=3,"
+                                "interval=0.3,min_heat=1"),
+                           "MINIPS_CHAOS_KILL":
+                               f"7:rank=2,step={kill_step}",
+                           "MINIPS_HEARTBEAT":
+                               "interval=0.1,timeout=1.0"},
+                timeout=240.0, kill_on_failure=False)
+        dones = [ev[-1] for r, ev in enumerate(events)
+                 if r != 2 and ev and ev[-1].get("event") == "done"]
+        if len(dones) == 2:
+            sums = {d.get("param_sum") for d in dones}
+            grid["kill"] = {
+                "completed": True,
+                "blocks_restored": sum(
+                    (d.get("membership") or {}).get(
+                        "blocks_restored", 0) for d in dones),
+                "reshard_aborts": sum(
+                    (d.get("reshard") or {}).get("aborts", 0)
+                    for d in dones),
+                "wire_frames_lost": sum(
+                    d.get("wire_frames_lost", 0) for d in dones),
+                "finals_agree": len(sums) == 1,
+            }
+        else:
+            grid["kill"] = {"completed": False,
+                            "error": f"survivors rc={rc}: "
+                                     f"{events}"[:300]}
+    except Exception as e:  # noqa: BLE001 - completion-gated
+        grid["kill"] = {"completed": False, "error": str(e)[:300]}
+
+    # -------- part: the 0->2 link (sender -> one gainer) cut for 1s
+    # across the drain window; reliable retransmits carry the slice
+    # rounds through the heal; flight boxes carry the evidence
+    with tempfile.TemporaryDirectory() as fdir:
+        grid["part"] = drain_arm(
+            {"MINIPS_RESHARD": f"cap={cap}",
+             "MINIPS_RELIABLE":
+                 "budget=4,backoff_ms=25,backoff_max_ms=150,"
+                 "advert_ms=100",
+             "MINIPS_CHAOS":
+                 f"9:part=1,links=0-2,at={drain_at},for=1.0s",
+             "MINIPS_FLIGHT": fdir},
+            flight=fdir)
+
+    # -------- mem: the streaming restore drill (subprocess stamp)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "minips_tpu.apps.sharded_ps_bench",
+             "--reshard-mem-drill"],
+            capture_output=True, text=True, timeout=300.0,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env={**os.environ, "MINIPS_FORCE_CPU": "1",
+                 "JAX_PLATFORMS": "cpu", "MINIPS_MESH": "",
+                 "MINIPS_CHAOS": "", "MINIPS_RESHARD": ""})
+        res = json.loads([ln for ln in proc.stdout.splitlines()
+                          if ln.startswith("{")][-1])
+        grid["mem"] = {
+            "equal": bool(res.get("bitwise_equal")),
+            "cap": int(res.get("cap", 0)),
+            "peak_planned": res.get("peak_planned"),
+            "peak_p2p": res.get("peak_p2p"),
+            "chunks": int(res.get("chunks", 0)),
+        }
+        if res.get("error"):
+            grid["mem"]["error"] = res["error"]
+    except Exception as e:  # noqa: BLE001 - the gate reads this
+        grid["mem"] = {"equal": False, "error": str(e)[:300]}
+    return grid
+
+
 def hier_arms(quick: bool = False) -> dict:
     """HIER-WIN / HIER-IDLE (the two-level push tree, balance/hier.py):
     3 procs with host groups {0,1} | {2} — ranks 0 and 1 are co-host
@@ -469,6 +687,7 @@ def hier_arms(quick: bool = False) -> dict:
              "--dim", "256", "--batch", "128",
              "--iters", str(h_iters)]
     env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+            "MINIPS_RESHARD": "",
             "MINIPS_RELIABLE": "", "MINIPS_REBALANCE": "",
             "MINIPS_TRACE": "", "MINIPS_SERVE": "",
             "MINIPS_BUS": "", "MINIPS_WIRE_FMT": "",
@@ -589,6 +808,7 @@ def hybrid_arms(quick: bool = False) -> dict:
             "--batch", "32", "--iters", "36", "--warmup", "12",
             "--key-dist", "zipf", "--staleness", "2"]
     env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+            "MINIPS_RESHARD": "",
             # 2 host devices per proc: the in-host mesh the leader's
             # reduce-scatter runs over (members' slots map onto it)
             "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
@@ -959,6 +1179,7 @@ def main() -> int:
                 "--staleness", "1", "--iters", str(e_iters),
                 "--batch", "256", "--updater", "sgd"]
         env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                "MINIPS_RESHARD": "",
                 "MINIPS_CHAOS": "", "MINIPS_RELIABLE": "",
                 "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
                 "MINIPS_SERVE": "", "MINIPS_BUS": "",
@@ -1290,6 +1511,7 @@ def main() -> int:
                 "--staleness", "2", "--iters", str(e_iters),
                 "--batch", "128", "--checkpoint-every", "5"]
         env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                "MINIPS_RESHARD": "",
                 "MINIPS_CHAOS": "", "MINIPS_RELIABLE": "",
                 "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
                 "MINIPS_SERVE": "", "MINIPS_BUS": "",
@@ -1408,6 +1630,7 @@ def main() -> int:
                 "--staleness", "2", "--iters", str(c_iters),
                 "--batch", "128", "--checkpoint-every", "5"]
         env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                "MINIPS_RESHARD": "",
                 "MINIPS_CHAOS": "", "MINIPS_RELIABLE": "",
                 "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
                 "MINIPS_SERVE": "", "MINIPS_BUS": "",
@@ -1609,6 +1832,7 @@ def main() -> int:
                 # live receivers
                 "--jitter-ms", "30", "--jitter-prob", "0.8"]
         env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                "MINIPS_RESHARD": "",
                 "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
                 "MINIPS_SERVE": "", "MINIPS_BUS": "",
                 "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
@@ -1932,6 +2156,8 @@ def main() -> int:
     # the --fail-slow-idle-drill lockstep stamp.
     fail_slow_grid = fail_slow_arms(quick=args.quick)
 
+    reshard_grid = reshard_arms(quick=args.quick)
+
     # THE HIER SWEEP (this PR): the two-level push tree vs the flat
     # per-worker wire on the same seeded zipf-overlap workload —
     # HIER-WIN wants the tree's cross-host leader leg >= 1.7x fewer
@@ -2010,6 +2236,7 @@ def main() -> int:
         "control_plane_3proc": control_grid,
         "partition_3proc": partition_grid,
         "fail_slow_3proc": fail_slow_grid,
+        "reshard_3proc": reshard_grid,
         "hier_agg_3proc": hier_grid,
         "hybrid_agg_3proc": hybrid_grid,
         "mesh_plane_fused": mesh_grid,

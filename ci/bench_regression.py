@@ -1071,6 +1071,147 @@ def fail_slow_tripwires(new: dict) -> list[str]:
     return problems
 
 
+def reshard_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``reshard_3proc`` sweep
+    (planned collective redistribution — balance/redistribute.py, the
+    trainer's slice rounds, ckpt/elastic's streaming restore); vacuous
+    when the sweep is absent.
+
+    - RESHARD-MEM: memory-boundedness must be MEASURED, twice. The
+      streaming-restore drill (``mem``): capped read bitwise-equal to
+      the uncapped read, measured peak staging within the cap, and the
+      legacy whole-member staging provably ABOVE it at the same size.
+      The live wire (``drain_planned`` vs ``drain_p2p``): the same
+      whole-rank drain must move the same blocks both ways, the
+      planned arm's measured per-round peak within the cap, and the
+      p2p arm's one-shot staging above it — no cap, no claim.
+    - RESHARD-SAFE: every chaos arm completes with zero unrecovered
+      frames and bitwise-agreeing survivors. ``kill`` (gainer
+      SIGKILLed mid-run, planner + eager rebalancer armed) must
+      restore >= 1 block from the elastic checkpoint; ``part`` (the
+      sender->gainer link cut across the drain window) must still
+      drain the leaver, ship >= 1 slice, and leave the
+      ``reshard_round`` evidence in the zero-pre-arming flight
+      boxes."""
+    grid = new.get("reshard_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    cap = grid.get("cap") or 0
+    mem = grid.get("mem") or {}
+    if not mem.get("equal"):
+        problems.append(
+            f"RESHARD-MEM reshard_3proc/mem: equal={mem.get('equal')!r}"
+            + (f" error={mem.get('error')!r}" if mem.get("error")
+               else "")
+            + " — the cap-bounded streaming restore must be bitwise-"
+            "equal to the uncapped read")
+    else:
+        mp, mb = mem.get("peak_planned"), mem.get("peak_p2p")
+        mc = mem.get("cap") or 0
+        if not (isinstance(mp, int) and 0 < mp <= mc):
+            problems.append(
+                f"RESHARD-MEM reshard_3proc/mem: measured peak "
+                f"{mp!r} B outside (0, cap={mc}] — streaming never "
+                "engaged or the cap is a promise, not a measurement")
+        if not (isinstance(mb, int) and mb > mc):
+            problems.append(
+                f"RESHARD-MEM reshard_3proc/mem: legacy whole-member "
+                f"peak {mb!r} B not above cap={mc} — the table is too "
+                "small for the drill to prove anything")
+    pl, pp = grid.get("drain_planned") or {}, grid.get("drain_p2p") or {}
+    part = grid.get("part") or {}
+    for name, arm in (("drain_planned", pl), ("drain_p2p", pp),
+                      ("part", part)):
+        if not arm.get("completed"):
+            problems.append(
+                f"RESHARD-SAFE reshard_3proc/{name}: completed="
+                f"{arm.get('completed')!r} — a whole-rank drain is a "
+                "migration, not a failure"
+                + (f" ({arm.get('error')!r})" if arm.get("error")
+                   else ""))
+            continue
+        if not arm.get("leaver_drained"):
+            problems.append(
+                f"RESHARD-SAFE reshard_3proc/{name}: the leaver never "
+                "reached its drained exit")
+        if arm.get("wire_frames_lost", 0):
+            problems.append(
+                f"RESHARD-SAFE reshard_3proc/{name}: "
+                f"{arm['wire_frames_lost']} unrecovered frames")
+        if not arm.get("finals_agree"):
+            problems.append(
+                f"RESHARD-SAFE reshard_3proc/{name}: survivors' final "
+                "tables disagree after the drain")
+    if pl.get("completed") and pp.get("completed"):
+        rsh = pl.get("reshard") or {}
+        if not (pl.get("blocks_moved") and pp.get("blocks_moved")):
+            problems.append(
+                f"RESHARD-MEM reshard_3proc: blocks_moved="
+                f"{pl.get('blocks_moved')!r}/{pp.get('blocks_moved')!r}"
+                " — the drain arms moved nothing, the staging A/B "
+                "proves nothing")
+        if not rsh.get("slices") or not rsh.get("rounds"):
+            problems.append(
+                f"RESHARD-MEM reshard_3proc/drain_planned: rounds="
+                f"{rsh.get('rounds')!r} slices={rsh.get('slices')!r} "
+                "— the planner never shipped a slice round (armed but "
+                "routed p2p?)")
+        peak_pl = rsh.get("peak_planned")
+        peak_pp = pp.get("peak_p2p")
+        if not (isinstance(peak_pl, int) and 0 < peak_pl <= cap):
+            problems.append(
+                f"RESHARD-MEM reshard_3proc/drain_planned: measured "
+                f"peak {peak_pl!r} B outside (0, cap={cap}] — the "
+                "per-round staging cap did not hold on the live wire")
+        if not (isinstance(peak_pp, int) and peak_pp > cap):
+            problems.append(
+                f"RESHARD-MEM reshard_3proc/drain_p2p: one-shot "
+                f"staging {peak_pp!r} B not above cap={cap} — the "
+                "shard is too small for the A/B to prove the cap "
+                "matters")
+        if pp.get("reshard_absent") is False:
+            problems.append(
+                "RESHARD-MEM reshard_3proc/drain_p2p: reshard "
+                "counters present on the baseline arm — the planner "
+                "leaked into the p2p arm, the A/B compares planned "
+                "vs planned")
+    kill = grid.get("kill") or {}
+    if not kill.get("completed"):
+        problems.append(
+            f"RESHARD-SAFE reshard_3proc/kill: completed="
+            f"{kill.get('completed')!r} — survivors of a mid-run "
+            "gainer SIGKILL must finish"
+            + (f" ({kill.get('error')!r})" if kill.get("error")
+               else ""))
+    else:
+        if not kill.get("blocks_restored"):
+            problems.append(
+                "RESHARD-SAFE reshard_3proc/kill: 0 blocks restored — "
+                "the dead gainer's ranges never came back from the "
+                "elastic checkpoint")
+        if kill.get("wire_frames_lost", 0):
+            problems.append(
+                f"RESHARD-SAFE reshard_3proc/kill: "
+                f"{kill['wire_frames_lost']} unrecovered frames")
+        if not kill.get("finals_agree"):
+            problems.append(
+                "RESHARD-SAFE reshard_3proc/kill: survivors' final "
+                "tables disagree after the kill")
+    if part.get("completed"):
+        if not (part.get("reshard") or {}).get("slices"):
+            problems.append(
+                "RESHARD-SAFE reshard_3proc/part: 0 slices shipped — "
+                "the cut arm never exercised the planner")
+        if not part.get("flight_events_ok"):
+            problems.append(
+                f"RESHARD-SAFE reshard_3proc/part: flight boxes "
+                f"missing reshard_round (got "
+                f"{part.get('flight_events')!r}) — the post-mortem "
+                "cannot tell the redistribution story")
+    return problems
+
+
 def hier_tripwires(new: dict) -> list[str]:
     """Absolute (prior-free) gates on the ``hier_agg_3proc`` sweep
     (the two-level topology-aware push tree, balance/hier.py);
@@ -1530,6 +1671,7 @@ def main(argv: list[str] | None = None) -> int:
                 + serve_tripwires(new) + elastic_tripwires(new)
                 + control_plane_tripwires(new)
                 + partition_tripwires(new) + fail_slow_tripwires(new)
+                + reshard_tripwires(new)
                 + hier_tripwires(new) + hybrid_tripwires(new)
                 + mesh_tripwires(new))
     pts = throughput_points(new)

@@ -285,12 +285,20 @@ def shard_checkpointing(bus, nprocs: int, checkpoint_dir, rank: int):
             # shards' overlapping slices, optimizer state included.
             step, old_n = found
             clock = elastic.read_saved_clock(checkpoint_dir, step)
+            # the MINIPS_RESHARD staging cap bounds the restore's
+            # transient chunks too (mover (c) of the planned
+            # redistribution); unarmed, the streamer's own 64 MiB
+            # default still keeps peak staging shard-independent
+            from minips_tpu.balance.redistribute import maybe_config
+            rcfg = maybe_config()
             for name, t in tables.items():
                 if hasattr(t, "shard_lo"):  # a ShardedTable
                     t.load_shard_state_dict(
                         elastic.reshard_table_state(
                             checkpoint_dir, step, old_n, name,
-                            t.num_rows, t.shard_lo, t.part.shard_size))
+                            t.num_rows, t.shard_lo, t.part.shard_size,
+                            cap_bytes=(rcfg.cap if rcfg is not None
+                                       else None)))
                 else:  # the trainer: clock vector (publishes it)
                     t.load_state_dict({"clock": np.asarray(clock)})
             common = step

@@ -121,6 +121,81 @@ def _run_fail_slow_idle_drill() -> int:
     return 0 if out["bitwise_equal"] else 1
 
 
+def _run_reshard_mem_drill() -> int:
+    """RESHARD-MEM: the streaming N->M checkpoint reshard (mover (c),
+    ckpt/elastic.reshard_table_state) at a RAM-visible table size —
+    the capped read must assemble BITWISE the same new shard as the
+    uncapped read while its MEASURED peak transient staging stays
+    under the cap, and the legacy whole-member read (what restore did
+    before the planner: np.load materialises every leaf of every
+    touched old shard at once) must provably EXCEED that cap at the
+    same size. 2 old shards of ~12 MiB state each, cap 1 MiB, new
+    world 3 ranks — the drilled shard is the middle one, straddling
+    both sources. Emits one JSON stamp line; any failure reports
+    ``bitwise_equal: false`` so the CI gate fails loudly instead of
+    silently skipping."""
+    import tempfile
+
+    out = {"event": "drill", "bitwise_equal": False, "cap": 0,
+           "peak_planned": None, "peak_p2p": None, "chunks": 0}
+    try:
+        from minips_tpu.ckpt.elastic import (NpzSliceReader,
+                                             _shard_path,
+                                             reshard_table_state)
+
+        rows, dim, old_n, new_n = 12288, 256, 2, 3
+        cap = 1 << 20                    # 1 MiB staging budget
+        rng = np.random.default_rng(20260807)
+        with tempfile.TemporaryDirectory() as ck:
+            old_sz = -(-rows // old_n)
+            for r in range(old_n):
+                path = _shard_path(ck, 1, r, "t")
+                os.makedirs(os.path.dirname(path))
+                np.savez(path,
+                         w=rng.standard_normal(
+                             (old_sz, dim)).astype(np.float32),
+                         acc=rng.standard_normal(
+                             (old_sz, dim)).astype(np.float32),
+                         lo=np.asarray(r * old_sz))
+            new_sz = -(-rows // new_n)
+            lo = new_sz                  # shard 1 of 3: both sources
+            full = reshard_table_state(ck, 1, old_n, "t", rows,
+                                       lo, new_sz)
+            st: dict = {}
+            capped = reshard_table_state(ck, 1, old_n, "t", rows,
+                                         lo, new_sz, cap_bytes=cap,
+                                         stats=st)
+            eq = set(full) == set(capped) and all(
+                np.array_equal(full[k], capped[k]) for k in full)
+            # the legacy baseline, MEASURED not modelled: whole-member
+            # staging materialises every row-aligned leaf of an old
+            # shard at once — its peak is one shard's full state bytes
+            peak_p2p = 0
+            for r in range(old_n):
+                with NpzSliceReader(_shard_path(ck, 1, r, "t")) as rd:
+                    peak_p2p = max(peak_p2p, sum(
+                        int(rd.read(k).nbytes) for k in rd.keys()
+                        if k != "lo"))
+            out.update({
+                "bitwise_equal": bool(eq),
+                "cap": int(cap),
+                "peak_planned": int(st.get("peak_stage_bytes", 0)),
+                "peak_p2p": int(peak_p2p),
+                "chunks": int(st.get("chunks", 0)),
+                "rows": rows, "dim": dim,
+                "old_n": old_n, "new_n": new_n,
+            })
+    except Exception as e:  # noqa: BLE001 - the gate reads the stamp
+        out["error"] = repr(e)[:300]
+    ok = (out["bitwise_equal"]
+          and out["peak_planned"] is not None
+          and 0 < out["peak_planned"] <= out["cap"]
+          and out["peak_p2p"] is not None
+          and out["peak_p2p"] > out["cap"])
+    print(json.dumps(out), flush=True)
+    return 0 if ok else 1
+
+
 def _run_hier_drill(hier_spec: str) -> int:
     """HIER-IDLE / HIER-WIN bitwise leg: the 3-rank hier lockstep drill
     (tests/test_hier.run_hier_lockstep — host groups {0,1} | {2},
@@ -400,6 +475,13 @@ def main(argv=None) -> int:
                          "off on a clean wire and emit its bitwise "
                          "stamp (the artifact's SLOW-IDLE input: "
                          "armed-but-idle must equal off bit-for-bit)")
+    ap.add_argument("--reshard-mem-drill", action="store_true",
+                    help="run the streaming N->M checkpoint reshard "
+                         "drill at a RAM-visible table size and emit "
+                         "its stamp (the artifact's RESHARD-MEM "
+                         "input: capped read bitwise-equal to the "
+                         "uncapped read with measured peak staging "
+                         "<= cap, legacy whole-member staging > cap)")
     ap.add_argument("--hier-idle-drill", action="store_true",
                     help="run the 3-rank hier lockstep drill armed-"
                          "idle (MINIPS_HIER=1, group=1 — no pair in "
@@ -443,6 +525,8 @@ def main(argv=None) -> int:
         return _run_mesh_drill()
     if args.fail_slow_idle_drill:
         return _run_fail_slow_idle_drill()
+    if args.reshard_mem_drill:
+        return _run_reshard_mem_drill()
     if args.hier_idle_drill:
         return _run_hier_drill("1")
     if args.hier_bitwise_drill:

@@ -387,6 +387,13 @@ def main(argv=None) -> int:
             "elastic_spec": os.environ.get("MINIPS_ELASTIC") or None,
             "membership": trainer.membership_stats(),
             "autoscale": trainer.autoscale_stats(),
+            # sender-side staging evidence: the leaver is the drain's
+            # SOURCE, so its rebalance peak (one-shot p2p ship) and
+            # reshard round/slice counters + per-round peak (planned
+            # mode) are the numbers the RESHARD-MEM live-wire leg
+            # compares against the cap
+            "rebalance": trainer.rebalance_stats(),
+            "reshard": trainer.reshard_stats(),
             "frames_dropped": trainer.frames_dropped,
             "wire_frames_lost": trainer.wire_frames_lost,
             "resumed_from": start_iter,

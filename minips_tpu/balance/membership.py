@@ -894,9 +894,11 @@ class Membership:
             return None
         t = self.trainer.tables[name]
         # shared across one adoption's restores: a dead rank's B-block
-        # restore must load each shard file once, not B times (the
-        # loads run under the table's locks)
-        npz_cache: dict[int, dict] = {}
+        # restore must OPEN each shard file once, not B times (rank ->
+        # NpzSliceReader — the reader slices block rows instead of
+        # materializing whole shards, so the restore stages only what
+        # it returns; the reads run under the table's locks)
+        npz_cache: dict = {}
 
         def restore(b: int) -> dict:
             from minips_tpu.ckpt import elastic
@@ -1197,9 +1199,16 @@ class Membership:
             with self._lock:
                 if self._unrecoverable:
                     raise PeerFailureError(set(self._unrecoverable))
+            for t in tr.tables.values():
+                # a partition can eat an rbF after I stop training —
+                # once I exit, nobody can ever release that gainer's
+                # fence, so keep re-sending until every release is
+                # CONFIRMED (rbG) before announcing gone
+                t.resend_stale_releases()
             done = all(
                 not (t.router.owner_of_blocks() == self.rank).any()
                 and t.rebalance_settled()
+                and t.releases_confirmed()
                 for t in tr.tables.values())
             if done:
                 break
@@ -1323,21 +1332,29 @@ class Membership:
         if death is not None:
             self._issue_death(death)
             return
-        # -------- leaves: only over a settled leaver at current epochs
+        # -------- leaves: ALL settled leavers at current epochs drain
+        # in ONE evacuation plan — a whole-host drain (every rank of a
+        # failure domain leaving together) is a single planned
+        # redistribution instead of N independent leave transitions,
+        # each of which would re-shuffle the previous one's re-homed
+        # blocks (still one transition per boundary: one plan)
         with self._lock:
-            leave = next(
-                (r for r, req in self._leave_reqs.items()
-                 if req.get("settled")
-                 and all(int(req.get("eps", {}).get(name, -1))
-                         == t.router.epoch
-                         for name, t in tables.items())), None)
-            if leave is not None:
-                del self._leave_reqs[leave]
-        if leave is not None:
-            targets = self._live_targets(exclude={leave})
-            self._issue({name: plan_evacuation(t.router, {leave},
+            leavers = [r for r, req in self._leave_reqs.items()
+                       if req.get("settled")
+                       and all(int(req.get("eps", {}).get(name, -1))
+                               == t.router.epoch
+                               for name, t in tables.items())]
+            for r in leavers:
+                del self._leave_reqs[r]
+        if leavers:
+            targets = self._live_targets(exclude=set(leavers))
+            self._issue({name: plan_evacuation(t.router, set(leavers),
                                                targets)
                          for name, t in tables.items()})
+            if len(leavers) > 1:
+                _fl.record("mb_evacuation",
+                           {"ranks": sorted(int(r) for r in leavers),
+                            "targets": [int(t) for t in targets]})
             return
         # -------- joins: admit one rank per boundary. With hold_joins
         # (the autoscaler armed) an announced standby WAITS in the queue

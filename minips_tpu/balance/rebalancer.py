@@ -521,4 +521,9 @@ class Rebalancer:
                   "refused_pulls", "migrated_rows", "blocks_restored",
                   "pushes_lost_to_dead"):
             out[k] = sum(p.get(k, 0) for p in per.values())
+        # a MAX, not a sum: the staging cap bounds each rank's worst
+        # simultaneous snapshot — the RESHARD-MEM gate's p2p baseline
+        out["peak_stage_bytes"] = max(
+            (p.get("peak_stage_bytes", 0) for p in per.values()),
+            default=0)
         return out

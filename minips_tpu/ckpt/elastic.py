@@ -33,9 +33,11 @@ from __future__ import annotations
 
 import os
 import re
+import zipfile
 from typing import Optional
 
 import numpy as np
+from numpy.lib import format as _npfmt
 
 
 def _rank_dirs(checkpoint_dir: str) -> dict[int, str]:
@@ -151,6 +153,109 @@ def _load_table_npz(checkpoint_dir: str, step: int, old_rank: int,
         return dict(z.items())
 
 
+def _shard_path(checkpoint_dir: str, step: int, rank: int,
+                name: str) -> str:
+    return os.path.join(checkpoint_dir, f"rank{rank}",
+                        f"step_{step:010d}", f"{name}.npz")
+
+
+class NpzSliceReader:
+    """Row-range reads out of ONE ``np.savez`` shard file without
+    materializing whole arrays — the cap-bounded staging primitive the
+    planned-redistribution restore paths stream through.
+
+    ``np.savez`` stores members uncompressed (ZIP_STORED), so a
+    member's ``.npy`` payload is a flat seekable byte range: after
+    parsing the npy header once, rows ``[a, b)`` of a C-contiguous
+    row-aligned leaf are ``(b-a) * row_bytes`` bytes at a computed
+    offset. Fortran-order or exotically-versioned members fall back to
+    a whole-member read (none exist in minitpups checkpoints today —
+    the fallback is the honest escape hatch, not a fast path)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._zf = zipfile.ZipFile(path, "r")
+        self._members = {n[:-4]: n for n in self._zf.namelist()
+                         if n.endswith(".npy")}
+        self._hdr: dict[str, tuple] = {}
+
+    def keys(self):
+        return self._members.keys()
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._members
+
+    def _header(self, key: str) -> tuple:
+        """(shape, dtype, data_offset | None) — offset None means
+        'stream-unsliceable, use a whole read' (fortran order or an
+        npy version this parser does not know)."""
+        if key not in self._hdr:
+            with self._zf.open(self._members[key]) as fp:
+                ver = _npfmt.read_magic(fp)
+                if ver == (1, 0):
+                    shape, fortran, dt = \
+                        _npfmt.read_array_header_1_0(fp)
+                elif ver == (2, 0):
+                    shape, fortran, dt = \
+                        _npfmt.read_array_header_2_0(fp)
+                else:
+                    shape, fortran, dt = None, True, None
+                if fortran or shape is None:
+                    arr = self.read(key)
+                    self._hdr[key] = (arr.shape, arr.dtype, None)
+                else:
+                    self._hdr[key] = (shape, dt, fp.tell())
+        return self._hdr[key]
+
+    def shape(self, key: str) -> tuple:
+        return tuple(self._header(key)[0])
+
+    def dtype(self, key: str):
+        return self._header(key)[1]
+
+    def read(self, key: str) -> np.ndarray:
+        """Whole-member read (meta scalars, passthrough leaves, the
+        fallback path)."""
+        with self._zf.open(self._members[key]) as fp:
+            return _npfmt.read_array(fp, allow_pickle=False)
+
+    def read_rows(self, key: str, a: int, b: int) -> np.ndarray:
+        """Rows ``[a, b)`` of a row-aligned leaf, staged as exactly
+        ``(b-a) * row_bytes`` bytes — never the whole array."""
+        shape, dt, off = self._header(key)
+        if b <= a:
+            return np.zeros((0,) + tuple(shape[1:]),
+                            dt if dt is not None else np.float32)
+        if off is None:  # fallback: unsliceable member layout
+            return np.array(self.read(key)[a:b])
+        row = int(dt.itemsize * np.prod(shape[1:], dtype=np.int64)) \
+            if len(shape) > 1 else int(dt.itemsize)
+        with self._zf.open(self._members[key]) as fp:
+            fp.seek(off + a * row)
+            buf = fp.read((b - a) * row)
+        if len(buf) != (b - a) * row:
+            raise ValueError(
+                f"{self.path}: short read of {key!r} rows [{a},{b}) — "
+                "truncated shard file")
+        return np.frombuffer(buf, dt).reshape(
+            (b - a,) + tuple(shape[1:])).copy()
+
+    def close(self) -> None:
+        self._zf.close()
+
+    def __enter__(self) -> "NpzSliceReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # cache-held readers close on collection
+        try:
+            self._zf.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+
 _META_KEYS = ("lo", "ep", "ovb", "ovo", "rb_block")
 
 
@@ -182,7 +287,10 @@ def _block_span(old_sz: int, block_size: int, b: int) -> tuple[int, int]:
 
 def reshard_table_state(checkpoint_dir: str, step: int, old_n: int,
                         name: str, num_rows: int, new_lo: int,
-                        new_shard_size: int) -> dict[str, np.ndarray]:
+                        new_shard_size: int, *,
+                        cap_bytes: Optional[int] = None,
+                        stats: Optional[dict] = None
+                        ) -> dict[str, np.ndarray]:
     """Assemble the state dict for the new shard ``[new_lo, new_lo +
     new_shard_size)`` of table ``name`` from the ``old_n`` old shard
     files at ``step``.
@@ -202,101 +310,161 @@ def reshard_table_state(checkpoint_dir: str, step: int, old_n: int,
     FLATTENED table at the new partition: rows live where the base range
     map says, no overlay survives the resize (the restored fleet starts
     at routing epoch 0, consistent because every rank reshards from the
-    same files)."""
-    probe = _load_table_npz(checkpoint_dir, step, 0, name)
-    saved_ep, saved_blk, saved_ov = saved_overlay(probe)
-    if saved_ep and saved_blk <= 0:
-        raise ValueError(
-            f"elastic reshard: step {step} of table {name!r} records a "
-            f"rebalanced routing table (epoch {saved_ep}) without its "
-            "block granularity — torn save, overlay blocks cannot be "
-            "placed")
-    old_sz = -(-num_rows // old_n)  # RangePartitioner.shard_size
-    new_hi = min(new_lo + new_shard_size, num_rows)
-    pieces: dict[str, list[np.ndarray]] = {}
-    passthrough: dict[str, np.ndarray] = {}
-    if new_hi <= new_lo:
-        # a grown world's last shard can lie ENTIRELY in padding
-        # (shard_lo >= num_rows): there are no rows to assemble, but the
-        # live table still expects every leaf at full shard shape — use
-        # old rank 0's leaves as the shape/dtype template, zero-filled.
-        # Overlay metadata and xtra subtrees never ride a resharded
-        # state: the resize flattens the routing table.
-        out = {"lo": np.asarray(new_lo)}
-        for key, arr in probe.items():
-            if key in _META_KEYS or "/" in key:
-                continue
-            if arr.ndim >= 1 and arr.shape[0] == old_sz:
-                out[key] = np.zeros((new_shard_size,) + arr.shape[1:],
-                                    arr.dtype)
-            else:
-                out[key] = arr
-        return out
-    for o in range(old_n):
-        lo_o = o * old_sz
-        hi_o = min(lo_o + old_sz, num_rows)
-        a, b = max(lo_o, new_lo), min(hi_o, new_hi)
-        if a >= b:
-            continue
-        state = _load_table_npz(checkpoint_dir, step, o, name)
-        for key, arr in state.items():
-            if key in _META_KEYS or "/" in key:
-                continue  # routing metadata / xtra subtrees: overlay pass
-            if arr.ndim >= 1 and arr.shape[0] == old_sz:
-                pieces.setdefault(key, []).append(arr[a - lo_o:b - lo_o])
-            else:
-                prev = passthrough.get(key)
-                # a hard refusal, not an assert: resharding a leaf that
-                # is neither row-aligned nor shard-invariant would
-                # silently pick one shard's copy — and `python -O`
-                # strips asserts, so the tripwire must be a real raise
-                if prev is not None and not np.array_equal(prev, arr):
-                    raise ValueError(
-                        f"elastic reshard: leaf {name}.{key} is neither "
-                        "row-aligned nor identical across old shards")
-                passthrough[key] = arr
-    out: dict[str, np.ndarray] = {"lo": np.asarray(new_lo)}
-    for key, parts in pieces.items():
-        rows = np.concatenate(parts, axis=0)
-        pad = new_shard_size - rows.shape[0]
-        if pad:  # last shard: pad back up to shard_size, like __init__
-            rows = np.concatenate(
-                [rows, np.zeros((pad,) + rows.shape[1:], rows.dtype)],
-                axis=0)
-        out[key] = rows
-    out.update(passthrough)
-    if saved_ep:
-        # overlay pass: every moved block's LIVE rows sit in its
-        # save-time owner's xtra section; the home-slab slice placed
-        # above is a dead copy. Overwrite the intersection of each
-        # overlay block's span with my new range, every row-aligned
-        # leaf alike (optimizer state migrates with its rows).
-        loaded: dict[int, dict] = {}
-        for blk_id, owner in sorted(saved_ov.items()):
-            blo, bln = _block_span(old_sz, saved_blk, blk_id)
-            a, b = max(blo, new_lo), min(blo + bln, new_hi)
+    same files).
+
+    STREAMING (planned redistribution's mover (c)): old shard files are
+    read through :class:`NpzSliceReader` in row chunks of at most
+    ``cap_bytes`` (default 64 MiB, the MINIPS_RESHARD cap default) —
+    peak transient staging is CAP-bounded, never source-shard- or
+    block-bounded, which is what lets a 1/N-memory rank reshard a table
+    bigger than its RAM budget. ``stats`` (optional dict out-param)
+    records the measured ``peak_stage_bytes`` and ``chunks`` — the
+    RESHARD-MEM gate reads the measurement, it does not trust the
+    promise."""
+    cap = 64 << 20 if cap_bytes is None else max(1, int(cap_bytes))
+    peak = chunks = 0
+    readers: dict[int, NpzSliceReader] = {}
+
+    def _rd(rank: int) -> NpzSliceReader:
+        if rank not in readers:
+            readers[rank] = NpzSliceReader(
+                _shard_path(checkpoint_dir, step, rank, name))
+        return readers[rank]
+
+    try:
+        probe = _rd(0)
+        meta = {k: probe.read(k) for k in _META_KEYS if k in probe}
+        saved_ep, saved_blk, saved_ov = saved_overlay(meta)
+        if saved_ep and saved_blk <= 0:
+            raise ValueError(
+                f"elastic reshard: step {step} of table {name!r} "
+                f"records a rebalanced routing table (epoch {saved_ep}) "
+                "without its block granularity — torn save, overlay "
+                "blocks cannot be placed")
+        old_sz = -(-num_rows // old_n)  # RangePartitioner.shard_size
+        new_hi = min(new_lo + new_shard_size, num_rows)
+        row_keys = sorted(
+            k for k in probe.keys()
+            if k not in _META_KEYS and "/" not in k
+            and len(probe.shape(k)) >= 1 and probe.shape(k)[0] == old_sz)
+        if new_hi <= new_lo:
+            # a grown world's last shard can lie ENTIRELY in padding
+            # (shard_lo >= num_rows): there are no rows to assemble, but
+            # the live table still expects every leaf at full shard
+            # shape — use old rank 0's leaves as the shape/dtype
+            # template, zero-filled. Overlay metadata and xtra subtrees
+            # never ride a resharded state: the resize flattens the
+            # routing table.
+            out = {"lo": np.asarray(new_lo)}
+            for key in sorted(probe.keys()):
+                if key in _META_KEYS or "/" in key:
+                    continue
+                if key in row_keys:
+                    out[key] = np.zeros(
+                        (new_shard_size,) + probe.shape(key)[1:],
+                        probe.dtype(key))
+                else:
+                    out[key] = probe.read(key)
+            return out
+        # preallocate the DESTINATION arrays once (they are the final
+        # storage, not staging) — streamed chunks land in place, the
+        # last shard's padding stays zero exactly like __init__ pads
+        out: dict[str, np.ndarray] = {"lo": np.asarray(new_lo)}
+        for key in row_keys:
+            out[key] = np.zeros(
+                (new_shard_size,) + probe.shape(key)[1:],
+                probe.dtype(key))
+        passthrough: dict[str, np.ndarray] = {}
+        for o in range(old_n):
+            lo_o = o * old_sz
+            hi_o = min(lo_o + old_sz, num_rows)
+            a, b = max(lo_o, new_lo), min(hi_o, new_hi)
             if a >= b:
                 continue
-            if owner not in loaded:
-                loaded[owner] = _load_table_npz(checkpoint_dir, step,
-                                                owner, name)
-            prefix = f"xtra/{blk_id}/"
-            xs = {k[len(prefix):]: v for k, v in loaded[owner].items()
-                  if k.startswith(prefix)}
-            if not set(pieces) <= set(xs):
-                # EVERY row-aligned leaf must come from the live copy:
-                # a subset (say w without m) would silently mix live
-                # params with a dead home copy's optimizer state
-                raise ValueError(
-                    f"elastic reshard: step {step} of table {name!r} "
-                    f"maps block {blk_id} to rank {owner}, but that "
-                    "rank's shard file lacks "
-                    f"{sorted(set(pieces) - set(xs))} for it — torn "
-                    "rebalanced save")
-            for key, arr in xs.items():
-                if key in out:
-                    out[key][a - new_lo:b - new_lo] = arr[a - blo:b - blo]
-    return out
+            r = _rd(o)
+            for key in sorted(r.keys()):
+                if key in _META_KEYS or "/" in key:
+                    continue  # routing metadata / xtra: overlay pass
+                shape = r.shape(key)
+                if len(shape) >= 1 and shape[0] == old_sz:
+                    row_b = max(1, int(r.dtype(key).itemsize
+                                       * np.prod(shape[1:],
+                                                 dtype=np.int64)))
+                    step_rows = max(1, cap // row_b)
+                    for ca in range(a, b, step_rows):
+                        cb = min(ca + step_rows, b)
+                        rows = r.read_rows(key, ca - lo_o, cb - lo_o)
+                        out[key][ca - new_lo:cb - new_lo] = rows
+                        peak = max(peak, int(rows.nbytes))
+                        chunks += 1
+                        del rows
+                else:
+                    arr = r.read(key)
+                    prev = passthrough.get(key)
+                    # a hard refusal, not an assert: resharding a leaf
+                    # that is neither row-aligned nor shard-invariant
+                    # would silently pick one shard's copy — and
+                    # `python -O` strips asserts, so the tripwire must
+                    # be a real raise
+                    if prev is not None \
+                            and not np.array_equal(prev, arr):
+                        raise ValueError(
+                            f"elastic reshard: leaf {name}.{key} is "
+                            "neither row-aligned nor identical across "
+                            "old shards")
+                    passthrough[key] = arr
+        out.update(passthrough)
+        if saved_ep:
+            # overlay pass: every moved block's LIVE rows sit in its
+            # save-time owner's xtra section; the home-slab slice
+            # placed above is a dead copy. Overwrite the intersection
+            # of each overlay block's span with my new range, every
+            # row-aligned leaf alike (optimizer state migrates with
+            # its rows) — streamed in the same cap-bounded chunks.
+            for blk_id, owner in sorted(saved_ov.items()):
+                blo, bln = _block_span(old_sz, saved_blk, blk_id)
+                a, b = max(blo, new_lo), min(blo + bln, new_hi)
+                if a >= b:
+                    continue
+                r = _rd(int(owner))
+                prefix = f"xtra/{blk_id}/"
+                xs = sorted(k[len(prefix):] for k in r.keys()
+                            if k.startswith(prefix))
+                if not set(row_keys) <= set(xs):
+                    # EVERY row-aligned leaf must come from the live
+                    # copy: a subset (say w without m) would silently
+                    # mix live params with a dead home copy's
+                    # optimizer state
+                    raise ValueError(
+                        f"elastic reshard: step {step} of table "
+                        f"{name!r} maps block {blk_id} to rank "
+                        f"{owner}, but that rank's shard file lacks "
+                        f"{sorted(set(row_keys) - set(xs))} for it — "
+                        "torn rebalanced save")
+                for key in xs:
+                    if key not in out:
+                        continue
+                    member = prefix + key
+                    shape = r.shape(member)
+                    row_b = max(1, int(r.dtype(member).itemsize
+                                       * np.prod(shape[1:],
+                                                 dtype=np.int64)))
+                    step_rows = max(1, cap // row_b)
+                    for ca in range(a, b, step_rows):
+                        cb = min(ca + step_rows, b)
+                        rows = r.read_rows(member, ca - blo, cb - blo)
+                        out[key][ca - new_lo:cb - new_lo] = rows
+                        peak = max(peak, int(rows.nbytes))
+                        chunks += 1
+                        del rows
+        return out
+    finally:
+        if stats is not None:
+            stats["peak_stage_bytes"] = max(
+                stats.get("peak_stage_bytes", 0), peak)
+            stats["chunks"] = stats.get("chunks", 0) + chunks
+        for r in readers.values():
+            r.close()
 
 
 def find_live_step(checkpoint_dir: str, tables: dict, n: int,
@@ -342,17 +510,20 @@ def load_block_state(checkpoint_dir: str, step: int, name: str,
     (``BlockRouter.block_span``/``home_of``); the saved block size must
     match the live router's, else block ids name different key ranges
     and the restore would be silently torn — refused loudly instead.
-    ``cache`` (rank -> loaded flat state, caller-held across one
-    adoption) keeps a dead rank's B-block restore from decompressing
-    the same shard files B times — under the table locks, that cost
-    was serialized against every serve."""
+    ``cache`` (rank -> open :class:`NpzSliceReader`, caller-held across
+    one adoption) keeps a dead rank's B-block restore from re-opening
+    the same shard files B times — and because the reader SLICES rows
+    instead of materializing whole shards, a B-block restore stages
+    only the blocks it returns, never a full old shard (the planned-
+    redistribution memory contract, satellite of the same PR)."""
 
-    def _load(rank: int) -> dict:
+    def _rd(rank: int) -> NpzSliceReader:
         if cache is None:
-            return _load_table_npz(checkpoint_dir, step, rank, name)
+            return NpzSliceReader(
+                _shard_path(checkpoint_dir, step, rank, name))
         if rank not in cache:
-            cache[rank] = _load_table_npz(checkpoint_dir, step, rank,
-                                          name)
+            cache[rank] = NpzSliceReader(
+                _shard_path(checkpoint_dir, step, rank, name))
         return cache[rank]
 
     # the routing metadata is identical in every shard file, so read it
@@ -364,7 +535,8 @@ def load_block_state(checkpoint_dir: str, step: int, name: str,
     for rank in [home_rank] + sorted(set(_rank_dirs(checkpoint_dir))
                                      - {home_rank}):
         try:
-            meta = _load(rank)
+            r = _rd(rank)
+            meta = {k: r.read(k) for k in _META_KEYS if k in r}
             break
         except (OSError, ValueError, KeyError):
             continue
@@ -382,7 +554,7 @@ def load_block_state(checkpoint_dir: str, step: int, name: str,
     owner = saved_ov.get(int(block), home_rank)
     if owner == home_rank:
         try:
-            home = _load(home_rank)
+            home = _rd(home_rank)
         except (OSError, ValueError, KeyError) as e:
             # the state lived only on the (dir-less) home rank: gone
             raise ValueError(
@@ -391,15 +563,16 @@ def load_block_state(checkpoint_dir: str, step: int, name: str,
                 f"block {block}") from e
         lo_local = blo - home_rank * shard_size
         st = {}
-        for key, arr in home.items():
+        for key in sorted(home.keys()):
             if key in _META_KEYS or "/" in key:
                 continue
-            if arr.ndim >= 1 and arr.shape[0] == shard_size:
-                st[key] = np.array(arr[lo_local:lo_local + bln])
+            shape = home.shape(key)
+            if len(shape) >= 1 and shape[0] == shard_size:
+                st[key] = home.read_rows(key, lo_local, lo_local + bln)
     else:
-        state = _load(owner)
+        state = _rd(int(owner))
         prefix = f"xtra/{block}/"
-        st = {k[len(prefix):]: np.array(v) for k, v in state.items()
+        st = {k[len(prefix):]: state.read(k) for k in state.keys()
               if k.startswith(prefix)}
     if st.get("w") is None or st["w"].shape[0] != bln:
         raise ValueError(

@@ -920,10 +920,35 @@ class ShardedTable:
         self._parked_pushes: list[tuple] = []    # future-epoch / pending
         self._adopt_acks: dict[int, set[int]] = {}  # ep -> acked ranks
         self._await_acks: dict[int, list] = {}   # ep -> [(block, dst)]
+        # rbF releases awaiting the gainer's rbG confirmation:
+        # (block, dst) -> (epoch, last-send monotonic). Fire-and-forget
+        # releases are fine for a STAYING old owner (the reliable plane
+        # retransmits for live senders), but a LEAVER whose last rbF is
+        # eaten by a partition would strand the gainer's fence forever —
+        # leave() re-sends until this map drains (releases_confirmed)
+        self._release_unacked: dict[tuple[int, int], tuple] = {}
         self.rb_stats = {"blocks_in": 0, "blocks_out": 0,
                          "forwarded_pushes": 0, "refused_pulls": 0,
                          "parked_frames": 0, "migrated_rows": 0,
-                         "blocks_restored": 0, "pushes_lost_to_dead": 0}
+                         "blocks_restored": 0, "pushes_lost_to_dead": 0,
+                         # max bytes of outbound state staged at once on
+                         # the ship path — measured on BOTH the planned
+                         # and the point-to-point path, it is the
+                         # RESHARD-MEM observable (the p2p arm's proof
+                         # that whole-plan staging exceeds the cap)
+                         "peak_stage_bytes": 0}
+        # ---- planned collective redistribution (balance/redistribute;
+        # OFF unless attach_reshard): slice-granular migration shipping
+        # in cap-bounded rounds. Inbound slice progress rides NEXT TO
+        # _pending_state (block granularity is still the fence unit);
+        # _early_prog mirrors _early_state for slices that beat my plan
+        # adoption.
+        self._reshard = None       # balance.redistribute.ReshardConfig
+        self._slice_prog: dict[int, dict] = {}  # block -> {got, seen}
+        self._early_prog: dict[int, dict] = {}  # pre-adoption twin
+        self.rs_stats = {"plans": 0, "rounds": 0, "slices": 0,
+                         "dup_slices": 0, "aborts": 0,
+                         "peak_stage_bytes": 0}
         # ---- per-owner serve counters (ALWAYS on — the observability
         # half of heat accounting): requests/rows this shard served
         # (wire) and rows read/applied on this shard's storage (wire +
@@ -1228,7 +1253,22 @@ class ShardedTable:
             self.bus.on(f"rbS:{self.name}", self._on_migrate_state)
             self.bus.on(f"rbA:{self.name}", self._on_adopt_ack)
             self.bus.on(f"rbF:{self.name}", self._on_fence_release)
+            self.bus.on(f"rbG:{self.name}", self._on_release_ack)
             self.bus.on(f"psE:{self.name}", self._on_epoch_nack)
+
+    def attach_reshard(self, cfg) -> None:
+        """Arm planned collective redistribution (balance/redistribute,
+        MINIPS_RESHARD): migration state ships as cap-bounded slice
+        ROUNDS computed identically at every rank from the overlay diff
+        instead of whole-block point-to-point snapshots. Requires the
+        rebalancer machinery (the plan's input IS the epoch-fenced
+        overlay diff; there is nothing to schedule without it)."""
+        if self._rb is None:
+            raise ValueError(
+                "MINIPS_RESHARD schedules the epoch-fenced migration's "
+                "state rounds — arm MINIPS_REBALANCE or MINIPS_ELASTIC "
+                "too (attach_rebalancer first)")
+        self._reshard = cfg
 
     def attach_serve_plane(self, plane, cfg) -> None:
         """Bind the read-mostly serving plane (serve/plane.py): arms
@@ -1495,6 +1535,8 @@ class ShardedTable:
             self._install_block_locked(b, st)
             self.rb_stats["blocks_restored"] += 1
 
+        planned = self._reshard is not None
+        out_blocks: list[tuple[int, int]] = []
         with self._mig_cond:
             prev = self.router.apply(ep, overlay)
             if prev is None:
@@ -1508,20 +1550,38 @@ class ShardedTable:
             with self._state_lock:
                 for b, src, dst in moved:
                     if src == self.rank:
-                        ships.append((b, dst,
-                                      self._take_block_locked(b)))
+                        if planned:
+                            # planned mode defers the snapshot: the
+                            # block is quiescent the moment the router
+                            # swapped (pushes forward, residuals
+                            # flushed pre-swap), so each ROUND stages
+                            # only its cap-bounded slice set later —
+                            # the whole point of the schedule
+                            out_blocks.append((b, dst))
+                        else:
+                            ships.append((b, dst,
+                                          self._take_block_locked(b)))
                     if dst == self.rank:
                         if src in dead:
                             # no rbS/rbF will ever come from the corpse:
                             # restore from the elastic checkpoint and
                             # serve un-fenced (docstring above)
                             self._early_state.pop(b, None)
+                            self._abort_slices_locked(b, "early")
                             _restore_locked(b)
                             continue
                         early = self._early_state.pop(b, None)
-                        if early is not None:
+                        if early is not None \
+                                and b not in self._early_prog:
                             self._install_block_locked(b, early)
                             self.rb_stats["blocks_in"] += 1
+                        elif early is not None:
+                            # a PARTIAL slice set beat my adoption: the
+                            # buffer becomes the destination storage,
+                            # remaining slices land via the pending path
+                            self._install_block_locked(b, early)
+                            self._slice_prog[b] = self._early_prog.pop(b)
+                            self._pending_state[b] = src
                         else:
                             self._pending_state[b] = src
                         if (b, ep) in self._early_release:
@@ -1538,6 +1598,7 @@ class ShardedTable:
                     for b in [b for b, s in self._pending_state.items()
                               if s in dead]:
                         del self._pending_state[b]
+                        self._abort_slices_locked(b, "pending")
                         _restore_locked(b)
                     for b in [b for b, s in self._fenced.items()
                               if s in dead]:
@@ -1545,6 +1606,8 @@ class ShardedTable:
                         self._fence_t0.pop(b, None)
             if ships:
                 self._await_acks[ep] = [(b, dst) for b, dst, _ in ships]
+            if out_blocks:
+                self._await_acks[ep] = list(out_blocks)
             self._adopt_acks.setdefault(ep, set()).add(self.rank)
             # prune ack bookkeeping for long-released epochs
             for stale in [e for e in self._adopt_acks
@@ -1552,6 +1615,16 @@ class ShardedTable:
                 del self._adopt_acks[stale]
             self._mig_cond.notify_all()
         tr = _trc.TRACER
+        if out_blocks:
+            self._ship_planned(ep, moved, dead)
+        if ships:
+            # point-to-point path: EVERY outbound block's full state is
+            # staged at once (the list above) — record it honestly, it
+            # is the baseline the RESHARD-MEM gate compares against
+            staged = sum(sum(int(a.nbytes) for a in st.values())
+                         for _b, _dst, st in ships)
+            self.rb_stats["peak_stage_bytes"] = max(
+                self.rb_stats["peak_stage_bytes"], staged)
         for b, dst, st in ships:
             head, blob = self._encode_block_state(b, ep, st)
             self.bus.send(dst, f"rbS:{self.name}", head, blob=blob)
@@ -1614,6 +1687,232 @@ class ShardedTable:
         else:
             self._xtra[b] = st
 
+    # ---------------- planned collective redistribution (MINIPS_RESHARD)
+    def _ship_planned(self, ep: int, moved: list,
+                      dead: frozenset) -> None:
+        """Planned-mode shipper: compile the GLOBAL round schedule from
+        the overlay diff — every rank derives the identical ``moved``
+        set from prev/overlay at the shared epoch, so the plan needs no
+        coordination wire — then stage and ship only MY slices, one
+        cap-bounded round at a time. Runs on the push-driving thread
+        right after the fence swap: every outbound block is quiescent
+        from that moment (pushes forward under the new table, residuals
+        flushed pre-swap), so per-round lazy snapshots are consistent
+        by construction. Rounds are journaled in the frame head (``rd``
+        next to ws/nr/dm/rb) and as ``reshard_round`` flight events;
+        redelivered slices resume idempotently at the receiver
+        (``reshard_resume``), a death mid-plan aborts the affected
+        blocks back to checkpoint state (``reshard_abort``)."""
+        from minips_tpu.balance import redistribute as _rd
+
+        cfg = self._reshard
+        rbytes = _rd.state_row_bytes(self.dim, self.updater)
+        live_moves = [(b, s, d) for b, s, d in moved if s not in dead]
+        rounds = _rd.plan_rounds(
+            live_moves, lambda b: self.router.block_span(b)[1], rbytes,
+            cap=cfg.cap, fanout=cfg.fanout)
+        self.rs_stats["plans"] += 1
+        tr = _trc.TRACER
+        total = {b: self.router.block_span(b)[1]
+                 for b, s, _d in live_moves if s == self.rank}
+        shipped = dict.fromkeys(total, 0)
+        nrd = len(rounds)
+        for rd, exchanges in enumerate(rounds):
+            mine = [ex for ex in exchanges if ex.src == self.rank]
+            if not mine:
+                continue
+            staged = []
+            with self._state_lock:
+                for ex in mine:
+                    staged.append((ex, self._take_slice_locked(ex)))
+                    shipped[ex.block] += ex.rows
+                    if shipped[ex.block] >= total[ex.block]:
+                        # the block's last slice just staged: a
+                        # migrated-in block's arrays leave _xtra now —
+                        # the planned twin of _take_block_locked's pop
+                        self._xtra.pop(ex.block, None)
+                        self.rb_stats["blocks_out"] += 1
+            round_bytes = sum(sum(int(a.nbytes) for a in st.values())
+                              for _ex, st in staged)
+            self.rs_stats["peak_stage_bytes"] = max(
+                self.rs_stats["peak_stage_bytes"], round_bytes)
+            self.rb_stats["peak_stage_bytes"] = max(
+                self.rb_stats["peak_stage_bytes"], round_bytes)
+            for ex, st in staged:
+                head, blob = self._encode_block_state(ex.block, ep, st)
+                head.update({"rd": int(rd), "nrd": int(nrd),
+                             "sl": int(ex.lo),
+                             "bn": int(total[ex.block])})
+                self.bus.send(ex.dst, f"rbS:{self.name}", head,
+                              blob=blob)
+                self.rs_stats["slices"] += 1
+                self.rb_stats["migrated_rows"] += int(ex.rows)
+                if tr is not None:
+                    tr.instant("rebalance", "rb_ship",
+                               {"b": int(ex.block), "dst": int(ex.dst),
+                                "rows": int(ex.rows), "ep": ep,
+                                "rd": int(rd), "sl": int(ex.lo)})
+            self.rs_stats["rounds"] += 1
+            _fl.record("reshard_round",
+                       {"table": self.name, "ep": int(ep),
+                        "rd": int(rd), "nrd": int(nrd),
+                        "ships": len(mine), "bytes": int(round_bytes)})
+
+    def _take_slice_locked(self, ex) -> dict:
+        """Copy rows ``[lo, lo+rows)`` of block ``ex.block``'s live
+        state WITHOUT removing it (caller holds the state lock): the
+        block stays readable for later rounds' slices; removal happens
+        once its last slice is staged (_ship_planned)."""
+        b, lo, n = ex.block, ex.lo, ex.rows
+        if self.router.home_of(b) == self.rank:
+            blo, _ln = self.router.block_span(b)
+            s = blo - self.shard_lo + lo
+            sl = slice(s, s + n)
+            st = {"w": self._w[sl].copy()}
+            if self._acc is not None:
+                st["acc"] = self._acc[sl].copy()
+            if self._m is not None:
+                st["m"] = self._m[sl].copy()
+                st["v"] = self._v[sl].copy()
+                st["steps"] = self._steps[sl].copy()
+            return st
+        src = self._xtra[b]
+        return {k: v[lo:lo + n].copy() for k, v in src.items()}
+
+    def _zero_block_state(self, n: int) -> dict:
+        """A zero-filled full-block state dict in the rbS layout — the
+        destination allocation slice writes land in (it IS the block's
+        final storage for a non-home gainer, not extra staging)."""
+        st = {"w": np.zeros((n, self.dim), np.float32)}
+        if self._acc is not None:
+            st["acc"] = np.zeros((n, self.dim), np.float32)
+        if self._m is not None:
+            st["m"] = np.zeros((n, self.dim), np.float32)
+            st["v"] = np.zeros((n, self.dim), np.float32)
+            st["steps"] = np.zeros(n, np.int32)
+        return st
+
+    def _write_slice_locked(self, b: int, lo: int, st: dict,
+                            bn: int) -> None:
+        """Install one slice's rows straight into destination storage
+        (caller holds the state lock): the block is fenced + state-
+        pending for the whole plan, so nothing reads or writes these
+        rows until completion flips the pending bit — receiver staging
+        stays one in-flight frame, never a buffered block."""
+        n = st["w"].shape[0]
+        if self.router.home_of(b) == self.rank:
+            blo, _ln = self.router.block_span(b)
+            s = blo - self.shard_lo + lo
+            sl = slice(s, s + n)
+            self._w[sl] = st["w"]
+            if self._acc is not None:
+                self._acc[sl] = st["acc"]
+            if self._m is not None:
+                self._m[sl] = st["m"]
+                self._v[sl] = st["v"]
+                self._steps[sl] = st["steps"]
+            return
+        dst = self._xtra.get(b)
+        if dst is None:
+            dst = self._zero_block_state(bn)
+            self._xtra[b] = dst
+        for k, arr in st.items():
+            dst[k][lo:lo + n] = arr
+
+    def _abort_slices_locked(self, b: int, where: str) -> None:
+        """Discard partial slice progress for block ``b`` (its source
+        died mid-plan): the checkpoint restore that follows IS the
+        abort-to-known-state contract — partially landed slices are
+        overwritten wholesale, never mixed with restored rows."""
+        prog = self._slice_prog.pop(b, None)
+        eprog = self._early_prog.pop(b, None)
+        got = (prog or eprog or {}).get("got", 0)
+        if prog is not None or eprog is not None:
+            self.rs_stats["aborts"] += 1
+            _fl.record("reshard_abort",
+                       {"table": self.name, "b": int(b),
+                        "rows_got": int(got), "where": where})
+
+    def _ingest_slice(self, sender: int, payload: dict,
+                      st: dict) -> None:
+        """Receiver half of the planned shipper: one slice frame lands
+        in destination storage exactly-once. The journal is the per-
+        block ``seen`` offset set — a redelivered slice (partition
+        heal, reliable-channel retransmit) is counted and dropped
+        (``reshard_resume``), never double-applied; completion routes
+        through the same install bookkeeping as a whole-block rbS."""
+        b = int(payload.get("b", -1))
+        lo = int(payload.get("sl", 0))
+        bn = int(payload.get("bn", 0))
+        rd = int(payload.get("rd", 0))
+        n = st["w"].shape[0]
+        done = dup = False
+        with self._mig_cond:
+            with self._state_lock:
+                if b in self._pending_state:
+                    prog = self._slice_prog.setdefault(
+                        b, {"got": 0, "seen": set()})
+                    if lo in prog["seen"]:
+                        dup = True
+                    else:
+                        self._write_slice_locked(b, lo, st, bn)
+                        prog["seen"].add(lo)
+                        prog["got"] += n
+                        if prog["got"] >= bn:
+                            del self._slice_prog[b]
+                            self._pending_state.pop(b, None)
+                            self.rb_stats["blocks_in"] += 1
+                            done = True
+                elif int(self.router.owner_of_blocks()[b]) == self.rank:
+                    # slice of an already-installed block (full replay
+                    # after a heal): a re-write would roll back updates
+                    # applied since — drop it, count it
+                    dup = True
+                else:
+                    # slices beat my plan adoption: accumulate into a
+                    # full-block buffer exactly like _early_state (the
+                    # reorder window is bounded; adoption installs a
+                    # complete buffer, or carries a partial one into
+                    # the pending path with its progress journal)
+                    prog = self._early_prog.setdefault(
+                        b, {"got": 0, "seen": set()})
+                    if lo in prog["seen"]:
+                        dup = True
+                    else:
+                        buf = self._early_state.get(b)
+                        if buf is None:
+                            buf = self._zero_block_state(bn)
+                            self._early_state[b] = buf
+                        for k, arr in st.items():
+                            buf[k][lo:lo + n] = arr
+                        prog["seen"].add(lo)
+                        prog["got"] += n
+                        if prog["got"] >= bn:
+                            del self._early_prog[b]
+            self._mig_cond.notify_all()
+        if dup:
+            self.rs_stats["dup_slices"] += 1
+            _fl.record("reshard_resume",
+                       {"table": self.name, "b": int(b), "sl": int(lo),
+                        "rd": int(rd), "from": int(sender)})
+        if done:
+            tr = _trc.TRACER
+            if tr is not None:
+                tr.instant("rebalance", "rb_install", {"b": b})
+            self._drain_parked_pushes()
+            self.serve_parked()
+
+    def reshard_table_stats(self) -> Optional[dict]:
+        """Planned-redistribution counters — None when MINIPS_RESHARD
+        is off (off vs armed-idle, the PR5 convention)."""
+        if self._reshard is None:
+            return None
+        with self._mig_cond:
+            inflight = len(self._slice_prog) + len(self._early_prog)
+        return {**self.rs_stats, "blocks_inflight": inflight,
+                "cap": self._reshard.cap,
+                "fanout": self._reshard.fanout}
+
     def _encode_block_state(self, b: int, ep: int, st: dict) -> tuple:
         """rbS wire format: rows AND optimizer state AND the shipper's
         min-clock view at snapshot time (stamp metadata — recorded so
@@ -1663,6 +1962,9 @@ class ShardedTable:
         if st is None:
             self._drop("malformed", sender, "bad rbS block state")
             return
+        if "sl" in payload:  # planned-mode slice frame (MINIPS_RESHARD)
+            self._ingest_slice(sender, payload, st)
+            return
         tr = _trc.TRACER
         with self._mig_cond:
             with self._state_lock:
@@ -1677,6 +1979,9 @@ class ShardedTable:
                     # would roll back updates applied since — drop it
                 else:
                     # rbS beat my plan adoption: stash until it arrives
+                    # (a whole-block frame supersedes any partial slice
+                    # accumulation — drop its progress journal too)
+                    self._early_prog.pop(b, None)
                     self._early_state[b] = st
             self._mig_cond.notify_all()
         self._drain_parked_pushes()
@@ -1703,6 +2008,10 @@ class ShardedTable:
             if not live <= self._adopt_acks.get(ep, set()):
                 return
             del self._await_acks[ep]
+            now = time.monotonic()
+            for b, dst in out:
+                self._release_unacked[(int(b), int(dst))] = (int(ep),
+                                                             now)
         for b, dst in out:
             self.bus.send(dst, f"rbF:{self.name}",
                           {"b": int(b), "ep": int(ep)})
@@ -1717,6 +2026,12 @@ class ShardedTable:
             else:  # rbF beat my plan adoption (reordered control plane)
                 self._early_release.add((b, ep))
             self._mig_cond.notify_all()
+        # confirm receipt (idempotent — a re-sent rbF for an already-
+        # released fence still acks): the old owner's leave() gate
+        # re-sends rbF until this lands, so a release eaten by a
+        # partition cannot strand the fence after the sender exits
+        self.bus.send(sender, f"rbG:{self.name}",
+                      {"b": b, "ep": ep})
         if released:
             t0 = self._fence_t0.pop(b, None)
             if t0 is not None:
@@ -1728,6 +2043,44 @@ class ShardedTable:
                     tr.complete("rebalance", "rb_fence", t0,
                                 {"b": b, "ep": ep})
         self.serve_parked()
+
+    def _on_release_ack(self, sender: int, payload: dict) -> None:
+        b = int(payload.get("b", -1))
+        with self._mig_cond:
+            self._release_unacked.pop((b, int(sender)), None)
+            self._mig_cond.notify_all()
+
+    def releases_confirmed(self) -> bool:
+        """Every rbF this rank sent has been confirmed (rbG) by a
+        still-live gainer — the leave() exit gate. Entries addressed to
+        ranks excluded since (died mid-handshake) are pruned: their
+        fences resolve through the death plan's dead-source path, not
+        through a confirmation that can never come."""
+        with self._mig_cond:
+            if self._release_unacked:
+                gone = self._excluded_ranks()
+                for key in [k for k in self._release_unacked
+                            if k[1] in gone]:
+                    del self._release_unacked[key]
+            return not self._release_unacked
+
+    def resend_stale_releases(self, age_s: float = 0.25) -> None:
+        """Re-send unconfirmed fence releases older than ``age_s`` —
+        called from the leave() wait loop so a partition that ate the
+        first rbF heals into a released fence instead of a permanently
+        wedged gainer (the sender is about to exit; nobody else can
+        ever release that fence)."""
+        now = time.monotonic()
+        with self._mig_cond:
+            stale = [(b, dst, ep)
+                     for (b, dst), (ep, t0) in
+                     self._release_unacked.items()
+                     if now - t0 > age_s]
+            for b, dst, ep in stale:
+                self._release_unacked[(b, dst)] = (ep, now)
+        for b, dst, ep in stale:
+            self.bus.send(dst, f"rbF:{self.name}",
+                          {"b": int(b), "ep": int(ep)})
 
     def rebalance_settled(self) -> bool:
         """No migration in flight at this rank: nothing fenced, no state
@@ -4972,6 +5325,7 @@ class ShardedPSTrainer:
                  rebalance: Optional[str] = None,
                  serve: Optional[str] = None,
                  elastic: Optional[str] = None,
+                 reshard: Optional[str] = None,
                  autoscale: Optional[str] = None,
                  hedge: Optional[str] = None,
                  slow: Optional[str] = None,
@@ -5073,6 +5427,25 @@ class ShardedPSTrainer:
             self.gate.membership = self.membership
             for t in tables.values():
                 t.attach_membership(self.membership)
+        # planned collective redistribution (balance/redistribute.py):
+        # OFF by default — explicit spec wins, else $MINIPS_RESHARD.
+        # Armed, every migration state ship (rebalance plans, demote
+        # drains, membership evacuations) runs as cap-bounded slice
+        # ROUNDS instead of whole-block point-to-point snapshots; the
+        # plan is a pure function of the overlay diff, so arming rides
+        # the migration machinery above.
+        from minips_tpu.balance import redistribute as _rd
+
+        self.reshard_cfg = _rd.maybe_config(reshard)
+        if self.reshard_cfg is not None:
+            if self.rebalancer is None:
+                raise ValueError(
+                    "MINIPS_RESHARD schedules the epoch-fenced "
+                    "migration's state rounds — arm MINIPS_REBALANCE "
+                    "or MINIPS_ELASTIC too (there is no migration "
+                    "wire to plan without them)")
+            for t in tables.values():
+                t.attach_reshard(self.reshard_cfg)
         # closed-loop autoscaler (balance/autoscaler.py): OFF by
         # default — a decision loop on the coordinator lease holder
         # that watches serve-plane shed counters / SERVE-SLO p99 /
@@ -5750,6 +6123,25 @@ class ShardedPSTrainer:
         subsystem is off, so scrapers can tell 'off' from 'idle'."""
         return (self.rebalancer.stats()
                 if self.rebalancer is not None else None)
+
+    def reshard_stats(self) -> Optional[dict]:
+        """Planned-redistribution counters summed over tables (peak
+        staging is a MAX — the cap bounds each rank's worst round, not
+        a sum) — None when MINIPS_RESHARD is off, zero counters when
+        armed but idle (the off-vs-idle convention)."""
+        per = [s for s in (t.reshard_table_stats()
+                           for t in self.tables.values())
+               if s is not None]
+        if not per:
+            return None
+        out = {k: sum(s[k] for s in per)
+               for k in ("plans", "rounds", "slices", "dup_slices",
+                         "aborts", "blocks_inflight")}
+        out["peak_stage_bytes"] = max(s["peak_stage_bytes"]
+                                      for s in per)
+        out["cap"] = per[0]["cap"]
+        out["fanout"] = per[0]["fanout"]
+        return out
 
     def membership_stats(self) -> Optional[dict]:
         """Elastic-membership counters (balance/membership.py): the

@@ -134,6 +134,11 @@ def wire_record(trainer) -> dict:
         # rebalancer counters (balance/): None when the subsystem is
         # off (distinguishable from an armed-but-idle run)
         "rebalance": trainer.rebalance_stats(),
+        # planned collective redistribution (balance/redistribute.py):
+        # round/slice/dup/abort counters and the measured per-round
+        # peak staging bytes the RESHARD-MEM gate reads — None when
+        # MINIPS_RESHARD is off, zero counters when armed but idle
+        "reshard": getattr(trainer, "reshard_stats", lambda: None)(),
         # elastic membership plane (balance/membership.py): None when
         # MINIPS_ELASTIC is off; armed runs carry the live/standby/
         # dead/left sets and transition counters (getattr: the bench

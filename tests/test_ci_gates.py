@@ -1142,3 +1142,108 @@ def test_shape_mismatch_fails_main_end_to_end(tmp_path):
     assert main([str(p), str(n)]) == 1
     n.write_text(json.dumps({**new, "device_shape": "cpu:3"}))
     assert main([str(p), str(n)]) == 0
+
+
+# --------- planned redistribution gates (RESHARD-MEM / RESHARD-SAFE)
+def _reshard_art(mem_equal=True, mem_peak=1 << 20, mem_cap=1 << 20,
+                 mem_p2p=12 << 20, pl_completed=True,
+                 pp_completed=True, pl_drained=True, moved=131,
+                 slices=131, rounds=6, peak_pl=4040, peak_pp=21848,
+                 pl_lost=0, pl_agree=True, p2p_absent=True,
+                 k_completed=True, restored=3, k_lost=0, k_agree=True,
+                 part_completed=True, part_slices=131,
+                 part_events=("reshard_round",)) -> dict:
+    return {"reshard_3proc": {
+        "iters": 30, "cap": 4096, "drain_at": 8, "kill_step": 10,
+        "drain_planned": {
+            "completed": pl_completed, "leaver_drained": pl_drained,
+            "blocks_moved": moved, "peak_p2p": peak_pl,
+            "wire_frames_lost": pl_lost, "finals_agree": pl_agree,
+            "reshard": {"plans": 1, "rounds": rounds,
+                        "slices": slices, "dup_slices": 0,
+                        "aborts": 0, "peak_planned": peak_pl}},
+        "drain_p2p": {
+            "completed": pp_completed, "leaver_drained": True,
+            "blocks_moved": moved, "peak_p2p": peak_pp,
+            "wire_frames_lost": 0, "finals_agree": True,
+            "reshard_absent": p2p_absent},
+        "kill": {"completed": k_completed,
+                 "blocks_restored": restored,
+                 "reshard_aborts": 0, "wire_frames_lost": k_lost,
+                 "finals_agree": k_agree},
+        "part": {
+            "completed": part_completed, "leaver_drained": True,
+            "blocks_moved": moved, "peak_p2p": peak_pl,
+            "wire_frames_lost": 0, "finals_agree": True,
+            "reshard": {"plans": 1, "rounds": rounds,
+                        "slices": part_slices, "dup_slices": 0,
+                        "aborts": 0, "peak_planned": peak_pl},
+            "flight_dumps": 3,
+            "flight_events": sorted(part_events),
+            "flight_events_ok": "reshard_round" in part_events},
+        "mem": {"equal": mem_equal, "cap": mem_cap,
+                "peak_planned": mem_peak, "peak_p2p": mem_p2p,
+                "chunks": 8}}}
+
+
+def test_reshard_tripwires_pass_on_healthy_sweep():
+    from ci.bench_regression import reshard_tripwires
+
+    assert reshard_tripwires(_reshard_art()) == []
+    assert reshard_tripwires({}) == []  # absent sweep: vacuous
+
+
+def test_reshard_mem_requires_measured_caps_both_ways():
+    from ci.bench_regression import reshard_tripwires
+
+    # the streaming drill: bitwise, capped, and a baseline above cap
+    probs = reshard_tripwires(_reshard_art(mem_equal=False))
+    assert any("RESHARD-MEM" in p and "bitwise" in p for p in probs)
+    probs = reshard_tripwires(_reshard_art(mem_peak=(1 << 20) + 1))
+    assert any("outside (0, cap=" in p for p in probs)
+    probs = reshard_tripwires(_reshard_art(mem_peak=0))
+    assert any("outside (0, cap=" in p for p in probs)
+    probs = reshard_tripwires(_reshard_art(mem_p2p=1 << 19))
+    assert any("too small" in p for p in probs)
+    # the live wire: planned peak within cap, p2p one-shot above it
+    probs = reshard_tripwires(_reshard_art(peak_pl=5000))
+    assert any("drain_planned" in p and "did not hold" in p
+               for p in probs)
+    probs = reshard_tripwires(_reshard_art(peak_pp=4000))
+    assert any("drain_p2p" in p and "not above cap" in p
+               for p in probs)
+    probs = reshard_tripwires(_reshard_art(moved=0))
+    assert any("moved nothing" in p for p in probs)
+    probs = reshard_tripwires(_reshard_art(slices=0))
+    assert any("never shipped a slice round" in p for p in probs)
+    # planner leaking into the baseline arm poisons the A/B
+    probs = reshard_tripwires(_reshard_art(p2p_absent=False))
+    assert any("leaked into the p2p arm" in p for p in probs)
+
+
+def test_reshard_safe_requires_survival_and_the_story():
+    from ci.bench_regression import reshard_tripwires
+
+    for kw in ({"pl_completed": False}, {"pp_completed": False},
+               {"part_completed": False}):
+        probs = reshard_tripwires(_reshard_art(**kw))
+        assert any("RESHARD-SAFE" in p and "completed=" in p
+                   for p in probs)
+    probs = reshard_tripwires(_reshard_art(pl_drained=False))
+    assert any("never reached its drained exit" in p for p in probs)
+    probs = reshard_tripwires(_reshard_art(pl_lost=2))
+    assert any("unrecovered frames" in p for p in probs)
+    probs = reshard_tripwires(_reshard_art(pl_agree=False))
+    assert any("disagree" in p for p in probs)
+    probs = reshard_tripwires(_reshard_art(k_completed=False))
+    assert any("kill" in p for p in probs)
+    probs = reshard_tripwires(_reshard_art(restored=0))
+    assert any("0 blocks restored" in p for p in probs)
+    probs = reshard_tripwires(_reshard_art(k_lost=1))
+    assert any("kill" in p and "unrecovered" in p for p in probs)
+    probs = reshard_tripwires(_reshard_art(k_agree=False))
+    assert any("kill" in p and "disagree" in p for p in probs)
+    probs = reshard_tripwires(_reshard_art(part_slices=0))
+    assert any("never exercised the planner" in p for p in probs)
+    probs = reshard_tripwires(_reshard_art(part_events=()))
+    assert any("missing reshard_round" in p for p in probs)

@@ -1,0 +1,806 @@
+"""Planned collective redistribution (balance/redistribute.py + the
+planned shipper/ingester in train/sharded_ps.py + the streaming elastic
+restore in ckpt/elastic.py) — this PR's tentpole.
+
+Layers of drill:
+
+- pure logic: the MINIPS_RESHARD spec parser (+ the shared seeded
+  grammar fuzzer), and the round planner's property sweep — every
+  moved block's rows land in exactly one exchange, no round stages
+  more than the cap at any rank, the partner fanout holds, and the
+  schedule is deterministic under input shuffling (what lets every
+  rank compile the identical plan with zero coordination frames);
+- threads-as-nodes over real loopback buses: a cap-bounded planned
+  migration is BITWISE the p2p migration (state moved in rounds,
+  never perturbed), the degenerate plan ships byte-identical rbS
+  blobs, redelivered slices drop idempotently (``reshard_resume``),
+  a source death mid-plan aborts partial slices back to checkpoint
+  state (``reshard_abort``), round/resume/abort events land in the
+  zero-pre-arming flight box, and the whole protocol composes with
+  seeded chaos + the retransmit layer;
+- the streaming N→M restore: ``reshard_table_state`` under a byte cap
+  is bitwise the whole-array read with MEASURED peak staging under
+  the cap (the RESHARD-MEM observable), through rebalance overlays;
+- whole-host evacuation: one ``plan_evacuation`` call re-homes every
+  block of EVERY rank in a failure domain in one deterministic plan.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu.balance.membership import plan_evacuation
+from minips_tpu.balance.redistribute import (Exchange, ReshardConfig,
+                                             maybe_config,
+                                             peak_stage_bytes,
+                                             plan_rounds,
+                                             state_row_bytes)
+from minips_tpu.balance.rebalancer import RebalanceConfig
+from minips_tpu.obs import flight as fl
+from minips_tpu.parallel.partition import BlockRouter, RangePartitioner
+from minips_tpu.train.sharded_ps import ShardedPSTrainer, ShardedTable
+
+
+def _mk_buses(n, **kw):
+    from tests.conftest import mk_loopback_buses
+
+    return mk_loopback_buses(n, **kw)
+
+
+class _StubRB:
+    """Table-level rebalancer stand-in (test_rebalance.py's) — planned
+    shipping rides the migration machinery, so arming it is the
+    precondition ``attach_reshard`` enforces."""
+
+    def __init__(self):
+        self.tables = []
+
+    def adopt_now(self):
+        pass
+
+    def note_plan(self, name, ep, ov):
+        for t in self.tables:
+            if t.name == name:
+                t.adopt_table(ep, ov)
+
+
+def _attach(tables, spec="block=4", reshard=None):
+    rb = _StubRB()
+    rb.tables = list(tables)
+    cfg = RebalanceConfig.parse(spec)
+    for t in tables:
+        t.attach_rebalancer(rb, cfg)
+        if reshard is not None:
+            t.attach_reshard(ReshardConfig.parse(reshard))
+    return cfg
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.01)
+
+
+@pytest.fixture
+def flight_box(tmp_path):
+    """A fresh flight recorder in a tmp dir (the zero-pre-arming box
+    the reshard_round/resume/abort events must land in)."""
+    fl.reset_for_tests()
+    rec = fl.init(0, str(tmp_path / "box"))
+    yield rec
+    fl.reset_for_tests()
+
+
+def _flight_kinds(rec):
+    rec.dump()
+    doc = json.load(open(rec.out_path))
+    return [e["kind"] for e in doc["events"]]
+
+
+# --------------------------------------------------------- config spec
+def test_reshard_config_parses_and_rejects_garbage():
+    c = ReshardConfig.parse("cap=64m,fanout=4")
+    assert (c.cap, c.fanout) == (64 << 20, 4)
+    assert ReshardConfig.parse("cap=2k").cap == 2048
+    assert ReshardConfig.parse("cap=1g").cap == 1 << 30
+    assert ReshardConfig.parse("cap=512").cap == 512
+    d = ReshardConfig.parse("1")
+    assert (d.cap, d.fanout) == (64 << 20, 2)  # defaults
+    with pytest.raises(ValueError, match="unknown knob"):
+        ReshardConfig.parse("explode=1")
+    with pytest.raises(ValueError, match="k=v"):
+        ReshardConfig.parse("cap")
+    with pytest.raises(ValueError, match="cap"):
+        ReshardConfig.parse("cap=abc")
+    with pytest.raises(ValueError, match="fanout"):
+        ReshardConfig.parse("fanout=x")
+    with pytest.raises(ValueError, match="cap"):
+        ReshardConfig.parse("cap=0")
+    with pytest.raises(ValueError, match="fanout"):
+        ReshardConfig.parse("fanout=0")
+
+
+def test_reshard_maybe_config_env_convention(monkeypatch):
+    monkeypatch.delenv("MINIPS_RESHARD", raising=False)
+    assert maybe_config() is None              # unset = off
+    monkeypatch.setenv("MINIPS_RESHARD", "")
+    assert maybe_config() is None              # empty = off
+    monkeypatch.setenv("MINIPS_RESHARD", "0")
+    assert maybe_config() is None              # "0" = off
+    monkeypatch.setenv("MINIPS_RESHARD", "cap=1k")
+    assert maybe_config().cap == 1024          # env fallback
+    assert maybe_config("cap=2k").cap == 2048  # explicit spec wins
+    monkeypatch.setenv("MINIPS_RESHARD", "garbage")
+    with pytest.raises(ValueError, match="MINIPS_RESHARD"):
+        maybe_config()
+
+
+def test_reshard_knob_fuzzer_parse_or_refuse_loudly():
+    """The shared MINIPS_* spec-hygiene fuzzer (PR15 convention):
+    seeded random specs from the alphabet parse or raise ValueError,
+    deterministically — never a half-configured planner."""
+    rng = np.random.default_rng(20260807)
+    vocab = ["cap", "fanout", "bogus"]
+    vals = ["0", "1", "3", "64m", "2k", "1g", "-1", "abc", "", "2.5",
+            "9999999999"]
+    for _ in range(200):
+        n = int(rng.integers(0, 5))
+        spec = ",".join(
+            f"{vocab[rng.integers(0, len(vocab))]}"
+            f"={vals[rng.integers(0, len(vals))]}"
+            for _ in range(n))
+        outcomes = []
+        for _rep in range(2):
+            try:
+                c = ReshardConfig.parse(spec)
+                outcomes.append(("ok", c.cap, c.fanout))
+            except ValueError as e:
+                outcomes.append(("refused", str(e)))
+            except Exception as e:  # noqa: BLE001 - the contract
+                pytest.fail(f"reshard spec {spec!r} raised "
+                            f"{type(e).__name__}: {e}")
+        assert outcomes[0] == outcomes[1], spec
+
+
+# ------------------------------------------------------------- planner
+def test_state_row_bytes_mirrors_encode_block_state():
+    """The planner's byte model must be the wire's byte model: one
+    row's plan_rounds accounting == one row's _encode_block_state blob
+    share, per updater — or the cap would bound the wrong quantity."""
+    for updater in ("sgd", "adagrad", "adam"):
+        t = ShardedTable("t", 16, 3, None, 0, 1, updater=updater)
+        n = 4
+        st = {"w": np.ones((n, 3), np.float32)}
+        if updater == "adagrad":
+            st["acc"] = np.ones((n, 3), np.float32)
+        if updater == "adam":
+            st["m"] = np.ones((n, 3), np.float32)
+            st["v"] = np.ones((n, 3), np.float32)
+            st["steps"] = np.ones(n, np.int32)
+        _head, blob = t._encode_block_state(0, 0, st)
+        assert len(blob) == n * state_row_bytes(3, updater), updater
+
+
+def test_plan_rounds_property_sweep():
+    """Seeded randomized properties: exact row coverage (every moved
+    block's rows in exactly one exchange set), the per-rank staging cap
+    (modulo the documented one-row honest floor), the per-round partner
+    fanout, and order-insensitive determinism."""
+    rng = np.random.default_rng(7)
+    for _case in range(120):
+        world = int(rng.integers(2, 7))
+        nblocks = int(rng.integers(1, 13))
+        blocks = rng.choice(64, size=nblocks, replace=False)
+        moves = []
+        for b in blocks:
+            s = int(rng.integers(0, world))
+            d = int(rng.integers(0, world - 1))
+            moves.append((int(b), s, d if d < s else d + 1))
+        rows = {b: int(rng.integers(1, 40)) for b, _s, _d in moves}
+        row_bytes = int(rng.integers(1, 65))
+        cap = int(rng.integers(1, 600))
+        fanout = int(rng.integers(1, 4))
+        rounds = plan_rounds(moves, rows.__getitem__, row_bytes,
+                             cap=cap, fanout=fanout)
+        # --- coverage: every block's rows exactly once, right endpoints
+        spans: dict[int, list] = {b: [] for b in rows}
+        for rnd in rounds:
+            for ex in rnd:
+                assert (ex.block, ex.src, ex.dst) in [
+                    (b, s, d) for b, s, d in moves]
+                spans[ex.block].append((ex.lo, ex.rows))
+        for b, got in spans.items():
+            got.sort()
+            assert got[0][0] == 0
+            hi = 0
+            for lo, n in got:
+                assert lo == hi, (b, got)  # no gap, no overlap
+                hi = lo + n
+            assert hi == rows[b], (b, got)
+        # --- cap: honored exactly when >= one row's bytes; a smaller
+        # cap degrades to one-row slices (the documented honest floor)
+        assert peak_stage_bytes(rounds, row_bytes) <= max(cap, row_bytes)
+        # --- fanout: distinct partners per rank per round
+        for rnd in rounds:
+            partners: dict[int, set] = {}
+            for ex in rnd:
+                partners.setdefault(ex.src, set()).add(ex.dst)
+                partners.setdefault(ex.dst, set()).add(ex.src)
+            assert all(len(p) <= fanout for p in partners.values())
+        # --- determinism: any input order -> the identical schedule
+        shuf = list(moves)
+        rng.shuffle(shuf)
+        assert plan_rounds(shuf, rows.__getitem__, row_bytes,
+                           cap=cap, fanout=fanout) == rounds
+
+
+def test_plan_rounds_degenerate_is_one_round_of_whole_blocks():
+    """cap >= every block and fanout >= world: the schedule collapses
+    to ONE round of whole-block exchanges — the shape whose shipped
+    bytes the byte-identity test below pins against the p2p path."""
+    moves = [(3, 0, 1), (7, 1, 2), (9, 2, 0)]
+    rounds = plan_rounds(moves, lambda b: 8, 16, cap=1 << 30, fanout=8)
+    assert len(rounds) == 1
+    assert sorted(rounds[0]) == [Exchange(3, 0, 1, 0, 8),
+                                 Exchange(7, 1, 2, 0, 8),
+                                 Exchange(9, 2, 0, 0, 8)]
+
+
+def test_plan_rounds_rejects_bad_input():
+    with pytest.raises(ValueError, match="more than one move"):
+        plan_rounds([(1, 0, 1), (1, 1, 2)], lambda b: 4, 8,
+                    cap=64, fanout=2)
+    with pytest.raises(ValueError, match="cap"):
+        plan_rounds([], lambda b: 4, 8, cap=0, fanout=2)
+    with pytest.raises(ValueError, match="fanout"):
+        plan_rounds([], lambda b: 4, 8, cap=64, fanout=0)
+    with pytest.raises(ValueError, match="row_bytes"):
+        plan_rounds([], lambda b: 4, 0, cap=64, fanout=2)
+    assert plan_rounds([], lambda b: 4, 8, cap=64, fanout=2) == []
+    assert peak_stage_bytes([], 8) == 0
+
+
+# ---------------------------------------- migration protocol, in-proc
+def test_planned_migration_bitwise_equals_p2p(flight_box):
+    """THE equivalence pin: a cap-forced multi-round planned migration
+    moves rows AND optimizer state bitwise-identically to the p2p
+    whole-block ship, with measured per-round staging <= cap, and the
+    round journal in the flight box."""
+    buses = _mk_buses(2)
+    # adagrad dim=2: 16 B/row, block 0 = 4 rows = 64 B; cap=32 -> two
+    # 2-row slices that cannot share a round
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="adagrad",
+                      lr=0.1, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="adagrad",
+                      lr=0.1, pull_timeout=10.0)
+    _attach([t0, t1], reshard="cap=32,fanout=2")
+    oracle = ShardedTable("o", 64, 2, None, 0, 1, updater="adagrad",
+                          lr=0.1)
+    try:
+        keys = np.arange(4, dtype=np.int64)
+        g1 = np.full((4, 2), 2.0, np.float32)
+        t0.push(keys, g1)
+        oracle.push(keys, g1)
+        w_pre = t0._w[:4].copy()
+        acc_pre = t0._acc[:4].copy()
+        t0.adopt_table(1, {0: 1})
+        t1.adopt_table(1, {0: 1})
+        _wait(lambda: t0.rebalance_settled() and t1.rebalance_settled(),
+              msg="planned migration settle")
+        np.testing.assert_array_equal(t1._xtra[0]["w"], w_pre)
+        np.testing.assert_array_equal(t1._xtra[0]["acc"], acc_pre)
+        assert t0.rb_stats["blocks_out"] == 1
+        assert t1.rb_stats["blocks_in"] == 1
+        # the round schedule: 2 slices over 2 rounds, staging == cap
+        assert t0.rs_stats["plans"] == 1
+        assert t0.rs_stats["rounds"] == 2
+        assert t0.rs_stats["slices"] == 2
+        assert 0 < t0.rs_stats["peak_stage_bytes"] <= 32
+        assert t0.rb_stats["peak_stage_bytes"] <= 32
+        assert t1.rs_stats["dup_slices"] == 0
+        assert not t1._slice_prog and not t1._early_prog
+        # post-migration pushes step the MOVED state — the oracle pin
+        g2 = np.full((4, 2), 1.0, np.float32)
+        t0.push(keys, g2)
+        oracle.push(keys, g2)
+        _wait(lambda: t1.serve["push_rows"] >= 4, msg="push applied")
+        np.testing.assert_array_equal(t1._xtra[0]["w"], oracle._w[:4])
+        np.testing.assert_array_equal(t1._xtra[0]["acc"],
+                                      oracle._acc[:4])
+        np.testing.assert_array_equal(t0.pull(keys), oracle._w[:4])
+        assert t0.frames_dropped == 0 and t1.frames_dropped == 0
+        kinds = _flight_kinds(flight_box)
+        assert kinds.count("reshard_round") == 2
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_planned_migration_moves_adam_moments_and_steps():
+    """The adam wire (m, v, per-row steps) slices and reassembles
+    bitwise too — one-row slices, the honest floor in action."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="adam",
+                      lr=0.05, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="adam",
+                      lr=0.05, pull_timeout=10.0)
+    _attach([t0, t1], reshard="cap=1,fanout=2")  # < 1 row: 1-row slices
+    oracle = ShardedTable("o", 64, 2, None, 0, 1, updater="adam",
+                          lr=0.05)
+    try:
+        keys = np.arange(4, dtype=np.int64)
+        for g in (2.0, -1.0):
+            grads = np.full((4, 2), g, np.float32)
+            t0.push(keys, grads)
+            oracle.push(keys, grads)
+        t0.adopt_table(1, {0: 1})
+        t1.adopt_table(1, {0: 1})
+        _wait(lambda: t0.rebalance_settled() and t1.rebalance_settled(),
+              msg="planned migration settle")
+        assert t0.rs_stats["slices"] == 4  # one per row
+        rb = state_row_bytes(2, "adam")
+        assert t0.rs_stats["peak_stage_bytes"] == rb  # the floor
+        g3 = np.full((4, 2), 0.5, np.float32)
+        t1.push(keys, g3)
+        oracle.push(keys, g3)
+        st_ = t1._xtra[0]
+        np.testing.assert_array_equal(st_["w"], oracle._w[:4])
+        np.testing.assert_array_equal(st_["m"], oracle._m[:4])
+        np.testing.assert_array_equal(st_["v"], oracle._v[:4])
+        np.testing.assert_array_equal(st_["steps"], oracle._steps[:4])
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_degenerate_plan_ships_byte_identical_blobs():
+    """The satellite pin: with cap >= the block and fanout >= world,
+    the planned path ships rbS frames whose BLOB BYTES are identical to
+    the p2p ship it replaces — the head differs only by the round
+    journal keys (rd/nrd/sl/bn)."""
+    def run(reshard):
+        buses = _mk_buses(2)
+        t0 = ShardedTable("t", 64, 2, buses[0], 0, 2,
+                          updater="adagrad", lr=0.1, pull_timeout=10.0)
+        t1 = ShardedTable("t", 64, 2, buses[1], 1, 2,
+                          updater="adagrad", lr=0.1, pull_timeout=10.0)
+        _attach([t0, t1], reshard=reshard)
+        sent = []
+        orig = buses[0].send
+
+        def rec_send(dst, kind, head, blob=None, **kw):
+            if kind == "rbS:t":
+                sent.append((dst, dict(head), blob))
+            return orig(dst, kind, head, blob=blob, **kw)
+
+        buses[0].send = rec_send
+        try:
+            keys = np.arange(4, dtype=np.int64)
+            t0.push(keys, np.full((4, 2), 2.0, np.float32))
+            t0.adopt_table(1, {0: 1})
+            t1.adopt_table(1, {0: 1})
+            _wait(lambda: t0.rebalance_settled()
+                  and t1.rebalance_settled(), msg="settle")
+            return sent
+        finally:
+            for b in buses:
+                b.close()
+
+    p2p = run(None)
+    planned = run("cap=1g,fanout=8")
+    assert len(p2p) == len(planned) == 1
+    (dst_a, head_a, blob_a), (dst_b, head_b, blob_b) = p2p[0], planned[0]
+    assert dst_a == dst_b == 1
+    assert blob_a == blob_b  # byte-identical state payload
+    assert {k: v for k, v in head_b.items()
+            if k not in ("rd", "nrd", "sl", "bn")} == head_a
+    assert (head_b["rd"], head_b["nrd"], head_b["sl"],
+            head_b["bn"]) == (0, 1, 0, 4)
+
+
+def test_redelivered_slice_drops_idempotently(flight_box):
+    """Exactly-once under redelivery (partition heal, retransmit): a
+    replayed slice frame is counted + dropped (``reshard_resume`` in
+    the flight box), never double-applied."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="adagrad",
+                      lr=0.1, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="adagrad",
+                      lr=0.1, pull_timeout=10.0)
+    _attach([t0, t1], reshard="cap=1g,fanout=2")
+    sent = []
+    orig = buses[0].send
+
+    def rec_send(dst, kind, head, blob=None, **kw):
+        if kind == "rbS:t":
+            sent.append((dst, dict(head), blob))
+        return orig(dst, kind, head, blob=blob, **kw)
+
+    buses[0].send = rec_send
+    try:
+        keys = np.arange(4, dtype=np.int64)
+        t0.push(keys, np.full((4, 2), 2.0, np.float32))
+        t0.adopt_table(1, {0: 1})
+        t1.adopt_table(1, {0: 1})
+        _wait(lambda: t0.rebalance_settled() and t1.rebalance_settled(),
+              msg="settle")
+        assert len(sent) == 1
+        w_post = t1._xtra[0]["w"].copy()
+        acc_post = t1._xtra[0]["acc"].copy()
+        dst, head, blob = sent[0]
+        buses[0].send(dst, "rbS:t", head, blob=blob)  # the replay
+        _wait(lambda: t1.rs_stats["dup_slices"] == 1, msg="dup counted")
+        np.testing.assert_array_equal(t1._xtra[0]["w"], w_post)
+        np.testing.assert_array_equal(t1._xtra[0]["acc"], acc_post)
+        assert t1.rb_stats["blocks_in"] == 1  # no double install
+        assert "reshard_resume" in _flight_kinds(flight_box)
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_dead_source_mid_plan_aborts_to_checkpoint_state(flight_box):
+    """A source death mid-plan: the gainer holds PARTIAL slices of the
+    block; the death-plan adoption must discard them (``reshard_abort``)
+    and install the checkpoint restore wholesale — never a mix of
+    half-landed slices and restored rows."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 1, buses[0], 0, 2, updater="sgd",
+                      lr=1.0, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 1, buses[1], 1, 2, updater="sgd",
+                      lr=1.0, pull_timeout=10.0)
+    _attach([t0, t1], reshard="cap=4,fanout=2")
+    try:
+        # hand-deliver HALF of block 0 (rows 0-1 of 4) as a slice frame
+        # — the shape a mid-plan SIGKILL of the source leaves behind
+        st = {"w": np.full((2, 1), 3.0, np.float32)}
+        head, blob = t0._encode_block_state(0, 1, st)
+        head.update({"rd": 0, "nrd": 2, "sl": 0, "bn": 4})
+        buses[0].send(1, "rbS:t", head, blob=blob)
+        _wait(lambda: 0 in t1._early_prog, msg="partial slice landed")
+        # rank 0 is now DEAD: the death plan re-homes block 0 onto
+        # rank 1 with a checkpoint restore
+        restored = np.full((4, 1), 9.0, np.float32)
+        t1.adopt_table(1, {0: 1}, dead=frozenset({0}),
+                       restore=lambda b: {"w": restored.copy()})
+        np.testing.assert_array_equal(t1._xtra[0]["w"], restored)
+        assert t1.rs_stats["aborts"] == 1
+        assert not t1._early_prog and not t1._slice_prog
+        assert t1.rb_stats["blocks_restored"] == 1
+        assert "reshard_abort" in _flight_kinds(flight_box)
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_slices_beating_adoption_carry_their_journal():
+    """Reorder window: slices that arrive BEFORE the gainer adopts the
+    plan accumulate in the early buffer WITH their progress journal;
+    adoption carries a partial buffer into the pending path and the
+    remaining slices complete it — no row lost, none double-applied."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 1, buses[0], 0, 2, updater="sgd",
+                      lr=1.0, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 1, buses[1], 1, 2, updater="sgd",
+                      lr=1.0, pull_timeout=10.0)
+    _attach([t0, t1], reshard="cap=4,fanout=2")
+    try:
+        mk = t0._encode_block_state
+        first = {"w": np.full((2, 1), 3.0, np.float32)}
+        h1, b1 = mk(0, 1, first)
+        h1.update({"rd": 0, "nrd": 2, "sl": 0, "bn": 4})
+        buses[0].send(1, "rbS:t", h1, blob=b1)
+        _wait(lambda: 0 in t1._early_prog, msg="early slice landed")
+        t1.adopt_table(1, {0: 1})  # partial buffer -> pending path
+        assert 0 in t1._slice_prog and 0 in t1._pending_state
+        # the replayed first slice is a dup even across the carry
+        buses[0].send(1, "rbS:t", dict(h1), blob=b1)
+        _wait(lambda: t1.rs_stats["dup_slices"] == 1, msg="dup")
+        second = {"w": np.full((2, 1), 5.0, np.float32)}
+        h2, b2 = mk(0, 1, second)
+        h2.update({"rd": 1, "nrd": 2, "sl": 2, "bn": 4})
+        buses[0].send(1, "rbS:t", h2, blob=b2)
+        _wait(lambda: t1.rb_stats["blocks_in"] == 1, msg="complete")
+        np.testing.assert_array_equal(
+            t1._xtra[0]["w"],
+            np.concatenate([first["w"], second["w"]]))
+        assert not t1._slice_prog and 0 not in t1._pending_state
+    finally:
+        for b in buses:
+            b.close()
+
+
+def test_fence_release_confirmation_survives_a_lost_rbF():
+    """The whole-host-evacuation wedge: a gainer's fence is released by
+    a single rbF — when a partition eats it and the old owner then
+    LEAVES, nobody can ever release that fence (the sender is gone and
+    a clean leave issues no death plan). The rbG confirmation closes
+    it: the sender tracks every release until the gainer confirms,
+    re-sends stale ones, and ``releases_confirmed()`` (the leave()
+    exit gate) only reports True once every gainer answered."""
+    buses = _mk_buses(2)
+    t0 = ShardedTable("t", 64, 2, buses[0], 0, 2, updater="sgd",
+                      lr=0.1, pull_timeout=10.0)
+    t1 = ShardedTable("t", 64, 2, buses[1], 1, 2, updater="sgd",
+                      lr=0.1, pull_timeout=10.0)
+    _attach([t0, t1])
+    real_send = buses[0].send
+    eaten = []
+
+    def send(dst, kind, payload, **kw):
+        if kind == "rbF:t" and not eaten:
+            eaten.append(dict(payload))  # the partition eats rbF #1
+            return
+        return real_send(dst, kind, payload, **kw)
+
+    buses[0].send = send
+    try:
+        t0.adopt_table(1, {0: 1})
+        t1.adopt_table(1, {0: 1})
+        _wait(lambda: t1.rb_stats["blocks_in"] == 1, msg="state ship")
+        _wait(lambda: eaten, msg="first rbF eaten")
+        # the gainer's fence is stuck — and the sender KNOWS it is
+        assert 0 in t1._fenced
+        assert not t0.releases_confirmed()
+        # nothing stale yet at a generous age: no spurious re-sends
+        t0.resend_stale_releases(age_s=60.0)
+        assert 0 in t1._fenced
+        # the leave() loop's nudge: re-send, fence releases, rbG lands
+        t0.resend_stale_releases(age_s=0.0)
+        _wait(lambda: 0 not in t1._fenced, msg="fence released")
+        _wait(t0.releases_confirmed, msg="release confirmed")
+        # a duplicate rbF for an already-released fence still acks
+        # (idempotent handshake — re-sends race the first rbG)
+        buses[0].send(1, "rbF:t", dict(eaten[0]))
+        time.sleep(0.05)
+        assert t0.releases_confirmed() and 0 not in t1._fenced
+    finally:
+        for b in buses:
+            b.close()
+
+
+# --------------------------------------------- trainer-level, in-proc
+def test_reshard_requires_the_migration_machinery():
+    t = ShardedTable("t", 16, 1, None, 0, 1, updater="sgd")
+    with pytest.raises(ValueError, match="MINIPS_RESHARD"):
+        t.attach_reshard(ReshardConfig.parse("1"))
+    buses = _mk_buses(1)
+    try:
+        t2 = ShardedTable("t", 16, 1, buses[0], 0, 1, updater="sgd")
+        with pytest.raises(ValueError, match="MINIPS_RESHARD"):
+            ShardedPSTrainer({"t": t2}, buses[0], 1, reshard="cap=1k")
+    finally:
+        for b in buses:
+            b.close()
+
+
+def _run_trainers(n, body, *, rebalance=None, reshard=None, staleness=1,
+                  rows=64, dim=1, updater="sgd", lr=1.0, bus_kw=None,
+                  steps=12):
+    """Threads-as-nodes trainer run (test_rebalance.py's harness plus
+    the reshard knob); body(r, table, trainer, step) per rank per step.
+    Returns (tables, trainers, finals, chaos_drops)."""
+    import threading
+
+    buses = _mk_buses(n, **(bus_kw or {}))
+    tables = [ShardedTable("t", rows, dim, buses[i], i, n,
+                           updater=updater, lr=lr, pull_timeout=20.0)
+              for i in range(n)]
+    trainers = [ShardedPSTrainer({"t": tables[i]}, buses[i], n,
+                                 staleness=staleness, gate_timeout=30.0,
+                                 rebalance=rebalance, reshard=reshard)
+                for i in range(n)]
+    finals: list = [None] * n
+    errs: list = []
+
+    def worker(r):
+        try:
+            for i in range(steps):
+                body(r, tables[r], trainers[r], i)
+                trainers[r].tick()
+            trainers[r].finalize(timeout=30.0)
+            finals[r] = tables[r].pull_all()
+        except Exception as e:  # noqa: BLE001 - surfaced via errs
+            import traceback
+
+            traceback.print_exc()
+            errs.append((r, repr(e)))
+
+    try:
+        ts = [threading.Thread(target=worker, args=(r,))
+              for r in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120.0)
+        assert not any(t.is_alive() for t in ts), "run wedged"
+        assert not errs, errs
+        drops = sum(getattr(b, "chaos").snapshot()["dropped"]
+                    for b in buses if getattr(b, "chaos", None))
+        return tables, trainers, finals, drops
+    finally:
+        for b in buses:
+            b.close()
+
+
+HOT_SPEC = ("interval=0.05,threshold=1.05,max_blocks=4,block=4,"
+            "topk=16,min_heat=1")
+
+
+def test_planned_migration_composes_with_chaos_and_reliable():
+    """The in-proc chaos drill: planned slice frames ride the same
+    reliable layer as everything else — under seeded drop/dup the run
+    completes, migrates in rounds, loses nothing unrecovered, measured
+    staging stays under the cap, and replicas agree bitwise."""
+    def body(r, table, trainer, i):
+        rows = table.pull(np.arange(8, dtype=np.int64))
+        table.push(np.arange(8, dtype=np.int64), (0.01 * rows + 1.0))
+        time.sleep(0.01)
+
+    tables, trainers, finals, drops = _run_trainers(
+        2, body, rebalance=HOT_SPEC, reshard="cap=8,fanout=1",
+        staleness=1, steps=15,
+        bus_kw={"chaos": "2025:drop=0.03,dup=0.01", "reliable": "1"})
+    assert drops > 0, "chaos never fired — the drill proved nothing"
+    assert sum(t.rb_stats["blocks_in"] for t in tables) >= 1
+    assert sum(t.rs_stats["slices"] for t in tables) >= 2
+    for tr in trainers:
+        assert tr.frames_dropped == 0, tr.drop_detail()
+        assert tr.wire_frames_lost == 0
+        s = tr.reshard_stats()
+        assert s is not None and s["peak_stage_bytes"] <= 8, s
+    np.testing.assert_array_equal(finals[0], finals[1])
+
+
+def test_reshard_stats_ride_wire_record():
+    """wire_record's ``reshard`` block contract: None when the knob is
+    off; armed-but-idle = ALL-ZERO counters (plus the cap/fanout
+    echo), all-numeric, so sweep tooling diffs arms field-by-field."""
+    from minips_tpu.utils.metrics import wire_record
+
+    def body(r, table, trainer, i):
+        keys = np.arange(4, dtype=np.int64)
+        table.pull(keys)
+        table.push(keys, np.ones((4, 1), np.float32))
+
+    _tabs, trainers, _finals, _ = _run_trainers(
+        2, body, rebalance=None, reshard=None, staleness=1, steps=3)
+    rec = wire_record(trainers[0])
+    assert rec["reshard"] is None  # off = None, not zeros
+
+    _tabs, trainers, _finals, _ = _run_trainers(
+        2, body, rebalance="interval=60,block=4",
+        reshard="cap=1k,fanout=3", staleness=1, steps=3)
+    st = wire_record(trainers[0])["reshard"]
+    assert st is not None
+    assert set(st) == {"plans", "rounds", "slices", "dup_slices",
+                       "aborts", "blocks_inflight", "peak_stage_bytes",
+                       "cap", "fanout"}
+    assert all(isinstance(v, int) for v in st.values()), st
+    assert (st["cap"], st["fanout"]) == (1024, 3)
+    assert all(st[k] == 0 for k in st if k not in ("cap", "fanout")), st
+
+
+# ------------------------------------------- streaming elastic restore
+def _mk_rebalanced_ckpt(tmp_path):
+    """A 2-shard rebalanced checkpoint (block 0 moved rank0 -> rank1,
+    live rows in rank1's xtra) — test_rebalance.py's elastic layout."""
+    d0 = tmp_path / "rank0" / "step_0000000001"
+    d0.mkdir(parents=True)
+    w0 = np.arange(8, dtype=np.float32).reshape(4, 2)
+    np.savez(d0 / "t.npz", w=w0, m=w0 + 100, lo=np.asarray(0),
+             ep=np.asarray(2), rb_block=np.asarray(2),
+             ovb=np.asarray([0]), ovo=np.asarray([1]))
+    d1 = tmp_path / "rank1" / "step_0000000001"
+    d1.mkdir(parents=True)
+    w1 = np.arange(8, 16, dtype=np.float32).reshape(4, 2)
+    live_b0 = np.full((2, 2), 55.0, np.float32)
+    np.savez(d1 / "t.npz", w=w1, m=w1 + 100, lo=np.asarray(4),
+             ep=np.asarray(2), rb_block=np.asarray(2),
+             ovb=np.asarray([0]), ovo=np.asarray([1]),
+             **{"xtra/0/w": live_b0, "xtra/0/m": live_b0 + 1})
+    return w0, w1, live_b0
+
+
+def test_npz_slice_reader_reads_rows_without_whole_arrays(tmp_path):
+    from minips_tpu.ckpt.elastic import NpzSliceReader
+
+    w = np.arange(40, dtype=np.float32).reshape(10, 4)
+    steps = np.arange(10, dtype=np.int32)
+    np.savez(tmp_path / "s.npz", w=w, steps=steps, lo=np.asarray(0))
+    with NpzSliceReader(str(tmp_path / "s.npz")) as r:
+        assert set(r.keys()) >= {"w", "steps", "lo"}
+        assert r.shape("w") == (10, 4) and "w" in r
+        np.testing.assert_array_equal(r.read_rows("w", 3, 7), w[3:7])
+        np.testing.assert_array_equal(r.read_rows("steps", 0, 10),
+                                      steps)
+        assert r.read_rows("w", 5, 5).shape == (0, 4)
+        got = r.read_rows("w", 0, 2)
+        got[0, 0] = -1.0  # writable (a copy, not a buffer view)
+        np.testing.assert_array_equal(r.read("w"), w)  # source intact
+        np.testing.assert_array_equal(r.read("lo"), np.asarray(0))
+
+
+def test_streaming_reshard_is_bitwise_with_cap_bounded_peak(tmp_path):
+    """Satellite 1's pin: the cap-bounded streaming restore assembles
+    BITWISE the same state as the uncapped read — through a rebalance
+    overlay — with MEASURED peak staging <= cap (never block- or
+    shard-bounded). 2 -> 1 and 2 -> 3 both ways."""
+    from minips_tpu.ckpt.elastic import reshard_table_state
+
+    _w0, _w1, _live = _mk_rebalanced_ckpt(tmp_path)
+    for new_n in (1, 3):
+        old_sz = 4
+        new_sz = -(-8 // new_n)
+        for nr in range(new_n):
+            lo = nr * new_sz
+            full = reshard_table_state(str(tmp_path), 1, 2, "t", 8,
+                                       lo, new_sz)
+            stats: dict = {}
+            # cap = one row of w (8 B): every chunk is a single row
+            capped = reshard_table_state(str(tmp_path), 1, 2, "t", 8,
+                                         lo, new_sz, cap_bytes=8,
+                                         stats=stats)
+            assert set(full) == set(capped)
+            for k in full:
+                np.testing.assert_array_equal(full[k], capped[k])
+            if lo < 8:  # a shard with real rows streamed in chunks
+                assert 0 < stats["peak_stage_bytes"] <= 8, stats
+                assert stats["chunks"] >= new_sz, stats
+    # the torn-save refusal survives the streaming reader
+    d1 = tmp_path / "rank1" / "step_0000000001"
+    w1 = np.arange(8, 16, dtype=np.float32).reshape(4, 2)
+    np.savez(d1 / "t.npz", w=w1, m=w1 + 100, lo=np.asarray(4),
+             ep=np.asarray(2), rb_block=np.asarray(2),
+             ovb=np.asarray([0]), ovo=np.asarray([1]))
+    with pytest.raises(ValueError, match="torn"):
+        reshard_table_state(str(tmp_path), 1, 2, "t", 8, 0, 8)
+
+
+def test_load_block_state_slices_through_the_reader(tmp_path):
+    """The death-path restore unit reads row ranges, not whole shards:
+    a block's state (home-slab AND xtra-overlay cases) round-trips
+    through the slice reader bitwise."""
+    from minips_tpu.ckpt.elastic import load_block_state
+
+    _w0, w1, live_b0 = _mk_rebalanced_ckpt(tmp_path)
+    cache: dict = {}
+    # block 0 (rows 0-2): live state is rank1's xtra section
+    st = load_block_state(str(tmp_path), 1, "t", 0, 0, 2, 0, 4, 2,
+                          cache=cache)
+    np.testing.assert_array_equal(st["w"], live_b0)
+    np.testing.assert_array_equal(st["m"], live_b0 + 1)
+    # block 2 (rows 4-6): plain home-slab rows of rank 1
+    st = load_block_state(str(tmp_path), 1, "t", 2, 4, 2, 1, 4, 2,
+                          cache=cache)
+    np.testing.assert_array_equal(st["w"], w1[:2])
+    np.testing.assert_array_equal(st["m"], w1[:2] + 100)
+
+
+# --------------------------------------------- whole-host evacuation
+def test_plan_evacuation_drains_a_whole_failure_domain_in_one_plan():
+    """Whole-host evacuation is ONE plan: every block of EVERY rank in
+    the failure domain re-homes in a single deterministic overlay (one
+    epoch bump, one fence), spread round-robin over the survivors."""
+    r = BlockRouter(RangePartitioner(64, 4), 4)
+    r.apply(1, {0: 3})  # a prior heat migration parked block 0 on 3
+    ov = plan_evacuation(r, {2, 3}, [0, 1])
+    r.apply(2, ov)
+    owners = r.owner_of_blocks()
+    assert not np.isin(owners, [2, 3]).any()
+    # round-robin balance across the survivors, within +/-1
+    moved = [b for b, o in enumerate(owners)
+             if o != r.home_of(b) or r.home_of(b) in (2, 3)]
+    counts = [sum(1 for b in moved if owners[b] == t) for t in (0, 1)]
+    assert max(counts) - min(counts) <= 1
+    # determinism: the same router state compiles the same plan
+    r2 = BlockRouter(RangePartitioner(64, 4), 4)
+    r2.apply(1, {0: 3})
+    assert plan_evacuation(r2, {2, 3}, [0, 1]) == ov
