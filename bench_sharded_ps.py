@@ -133,6 +133,9 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     # armed MINIPS_RESHARD must not silently re-lane (or refuse, with
     # no rebalancer armed) the non-reshard arms
     env_extra["MINIPS_RESHARD"] = ""
+    # multi-tenant tables ride their own sweep; an armed
+    # MINIPS_TENANT must not stamp (and re-bucket) the other arms
+    env_extra["MINIPS_TENANT"] = ""
     # the in-mesh collective plane rides its own sweep via --plane; an
     # armed MINIPS_MESH must not reroute (or refuse) the wire arms
     env_extra["MINIPS_MESH"] = ""
@@ -324,6 +327,7 @@ def fail_slow_arms(quick: bool = False) -> dict:
             "MINIPS_BUS": "", "MINIPS_WIRE_FMT": "",
             "MINIPS_CHAOS_KILL": "", "MINIPS_PUSH_COMM": "",
             "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
+            "MINIPS_TENANT": "",
             "MINIPS_ELASTIC": "", "MINIPS_SLOW": "",
             "MINIPS_HEDGE": "", "MINIPS_OBS": "",
             "MINIPS_FLIGHT": "", "MINIPS_HEARTBEAT": "",
@@ -434,6 +438,139 @@ def fail_slow_arms(quick: bool = False) -> dict:
     return grid
 
 
+def tenant_arms(quick: bool = False) -> dict:
+    """THE MULTI-TENANT SWEEP: one 3-proc job runs a training tenant
+    (``trn`` — every rank's sparse pull+push loop at a fixed step
+    pace; pace-KEPT rows/sec is the protected number) next to a
+    storming zipf inference tenant (``inf`` — per-rank reader threads
+    free-running ``pull_serving`` into admission). Four arms: ``solo``
+    (trn alone — the protected baseline), ``isolated`` (per-tenant
+    buckets: trn admission off, inf throttled into its own budget),
+    ``shared`` (``shared=1`` — ONE fleet bucket, the coupling the
+    per-tenant split removes), and ``idle`` (the --tenant-idle-drill
+    bitwise stamp). TENANT-ISO wants isolated trn within 10% of solo
+    with inf shedding into its own budget and trn's attributed
+    counters ZERO (and the shared arm's coupling engaged — the
+    contrast must be real); TENANT-IDLE wants the idle stamp green."""
+    from minips_tpu import launch as _launch
+
+    t_iters = 15 if quick else 40
+    tbase = [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
+             "--tenant-bench", "--path", "sparse",
+             "--iters", str(t_iters),
+             "--warmup", str(max(2, t_iters // 6)),
+             "--batch", "128", "--rows", "4096",
+             # the storm must be heavy in REQUESTS, not in raw CPU:
+             # these readers share each rank's interpreter with the
+             # trainer, so a zero-think closed loop measures GIL
+             # contention (which no admission split can remove), not
+             # tenancy — 25ms think keeps the reader threads asleep
+             # between attempts while the attempt rate still over-
+             # drives the inf bucket into visible shedding
+             "--storm-batch", "8", "--storm-think-ms", "25",
+             # pace-kept SLO: each trn step sleeps to a 60ms deadline
+             # (roughly 4x the unloaded pull+push+tick time), so
+             # trn_rows_per_sec compares PACE-KEEPING across arms —
+             # storm-tax jitter lands in the slack, only real stalls
+             # (shared-bucket denials riding retry_ms) slip deadlines
+             "--trn-step-ms", "60",
+             "--staleness", "1", "--updater", "sgd",
+             "--key-dist", "zipf", "--no-zipf-permute-hot",
+             "--pull-timeout", "30"]
+    serve = ("replicas=1,hot=16,topk=64,interval=0.05,min_heat=1,"
+             "rate=40,burst=8")
+    env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+            "MINIPS_CHAOS": "", "MINIPS_RELIABLE": "1",
+            "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
+            "MINIPS_SERVE": "", "MINIPS_BUS": "",
+            "MINIPS_WIRE_FMT": "", "MINIPS_ELASTIC": "",
+            "MINIPS_CHAOS_KILL": "", "MINIPS_HEARTBEAT": "",
+            "MINIPS_PUSH_COMM": "", "MINIPS_MESH": "",
+            "MINIPS_AUTOSCALE": "", "MINIPS_RESHARD": "",
+            "MINIPS_SLOW": "", "MINIPS_HEDGE": "",
+            "MINIPS_TENANT": ""}
+    # per-tenant buckets: trn's admission OFF (its SLO is throughput),
+    # inf throttled into its own budget; inf reads at its OWN s=2
+    # against the job's staleness=1
+    iso_spec = "trn:rate=0;inf:rate=40,burst=8,s=2"
+    grid: dict = {"iters": t_iters, "serve_spec": serve,
+                  "isolated_spec": iso_spec}
+
+    def arm(tenant_spec: str, storm: int) -> dict:
+        argv = list(tbase) + ["--storm", str(storm),
+                              "--serve", serve,
+                              "--tenant", tenant_spec]
+        try:
+            res = _launch.run_local_job(3, argv, base_port=None,
+                                        env_extra=env0, timeout=240.0)
+        except Exception as e:  # noqa: BLE001 - completion-gated
+            return {"completed": False, "error": str(e)[:300]}
+        echoed = {r.get("tenant_spec") for r in res}
+        assert echoed == {tenant_spec}, (tenant_spec, echoed)
+        tb = [r.get("tenant") or {} for r in res]
+
+        def tcnt(tname: str, key: str) -> int:
+            return sum(((b.get("tenants") or {}).get(tname) or {})
+                       .get(key, 0) for b in tb)
+
+        rep = [(r["serve"] or {}).get("replica") for r in res]
+        return {
+            "completed": all(r.get("event") == "done" for r in res),
+            # the protected number: the training tenant's fleet rate
+            "trn_rows_per_sec": round(
+                sum(r["trn_rows_per_sec"] for r in res), 1),
+            "read_rows_per_sec": round(
+                sum(r["read_rows_per_sec"] for r in res), 1),
+            "shared": max(b.get("shared", 0) for b in tb),
+            # per-tenant deny attribution — THE isolation evidence
+            "trn_denied": (tcnt("trn", "shed")
+                           + tcnt("trn", "throttle")),
+            "inf_denied": (tcnt("inf", "shed")
+                           + tcnt("inf", "throttle")),
+            # staleness-bound evidence: zero on BOTH ledgers (the
+            # tenant-attributed counter and the plane's own)
+            "stale_reads": (tcnt("trn", "stale_reads")
+                            + tcnt("inf", "stale_reads")
+                            + sum((x or {}).get("stale_reads") or 0
+                                  for x in rep)),
+            "wire_frames_lost": sum(r.get("wire_frames_lost", 0)
+                                    for r in res),
+            "frames_dropped": sum(r.get("frames_dropped", 0)
+                                  for r in res),
+        }
+
+    grid["solo"] = arm(iso_spec, 0)
+    grid["isolated"] = arm(iso_spec, 2)
+    # ONE fleet bucket (cfg rate=40 shared by both tenants): the
+    # combined load drains tokens the quiet tenant needed — the
+    # coupling the per-tenant split exists to remove
+    grid["shared"] = arm("trn;inf:s=2;shared=1", 2)
+    # TENANT-IDLE: bare default tenant vs off, bitwise + zero counters
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "minips_tpu.apps.sharded_ps_bench",
+             "--tenant-idle-drill"],
+            capture_output=True, text=True, timeout=300.0,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env={**os.environ, "MINIPS_FORCE_CPU": "1",
+                 "JAX_PLATFORMS": "cpu", "MINIPS_MESH": "",
+                 "MINIPS_CHAOS": "", "MINIPS_TENANT": ""})
+        res = json.loads([ln for ln in proc.stdout.splitlines()
+                          if ln.startswith("{")][-1])
+        grid["idle"] = {"equal": bool(res.get("bitwise_equal")),
+                        "rows_checked":
+                            int(res.get("rows_checked", 0)),
+                        "tenant_tids": res.get("tenant_tids"),
+                        "tenant_counters": res.get("tenant_counters")}
+        if res.get("error"):
+            grid["idle"]["error"] = res["error"]
+    except Exception as e:  # noqa: BLE001 - the gate reads this
+        grid["idle"] = {"equal": False, "rows_checked": 0,
+                        "error": str(e)[:300]}
+    return grid
+
+
 def reshard_arms(quick: bool = False) -> dict:
     """RESHARD-MEM / RESHARD-SAFE (planned collective redistribution,
     balance/redistribute.py): the memory-bounded N->M resharding plane
@@ -483,6 +620,7 @@ def reshard_arms(quick: bool = False) -> dict:
             "MINIPS_HEARTBEAT": "interval=0.1,timeout=2.0",
             "MINIPS_PUSH_COMM": "", "MINIPS_MESH": "",
             "MINIPS_AUTOSCALE": "1", "MINIPS_OBS": "",
+            "MINIPS_TENANT": "",
             "MINIPS_FLIGHT": "", "MINIPS_SLOW": "",
             "MINIPS_HEDGE": "", "MINIPS_ELASTIC": "1",
             "MINIPS_RESHARD": ""}
@@ -693,6 +831,7 @@ def hier_arms(quick: bool = False) -> dict:
             "MINIPS_BUS": "", "MINIPS_WIRE_FMT": "",
             "MINIPS_CHAOS": "", "MINIPS_CHAOS_KILL": "",
             "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
+            "MINIPS_TENANT": "",
             "MINIPS_ELASTIC": "", "MINIPS_SLOW": "",
             "MINIPS_HEDGE": "", "MINIPS_OBS": "",
             "MINIPS_FLIGHT": "", "MINIPS_HEARTBEAT": "",
@@ -819,6 +958,7 @@ def hybrid_arms(quick: bool = False) -> dict:
             "MINIPS_BUS": "", "MINIPS_WIRE_FMT": "",
             "MINIPS_CHAOS": "", "MINIPS_CHAOS_KILL": "",
             "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
+            "MINIPS_TENANT": "",
             "MINIPS_ELASTIC": "", "MINIPS_SLOW": "",
             "MINIPS_HEDGE": "", "MINIPS_OBS": "",
             "MINIPS_FLIGHT": "", "MINIPS_HEARTBEAT": "",
@@ -1185,7 +1325,8 @@ def main() -> int:
                 "MINIPS_SERVE": "", "MINIPS_BUS": "",
                 "MINIPS_WIRE_FMT": "", "MINIPS_ELASTIC": "",
                 "MINIPS_CHAOS_KILL": "", "MINIPS_HEARTBEAT": "",
-                "MINIPS_PUSH_COMM": "", "MINIPS_MESH": ""}
+                "MINIPS_PUSH_COMM": "", "MINIPS_MESH": "",
+                "MINIPS_TENANT": ""}
         out: dict = {"iters": e_iters}
         for arm, comm in (("f32", "float32"), ("topk8", "topk8")):
             try:
@@ -1427,7 +1568,8 @@ def main() -> int:
                            "MINIPS_WIRE_FMT": "", "MINIPS_ELASTIC": "",
                            "MINIPS_CHAOS_KILL": "",
                            "MINIPS_HEARTBEAT": "",
-                           "MINIPS_PUSH_COMM": "", "MINIPS_MESH": ""},
+                           "MINIPS_PUSH_COMM": "", "MINIPS_MESH": "",
+                           "MINIPS_TENANT": ""},
                 timeout=timeout)
         except Exception as e:  # noqa: BLE001 - completion-gated arms
             return {"completed": False, "error": str(e)[:300]}
@@ -1518,6 +1660,7 @@ def main() -> int:
                 "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
                 "MINIPS_HEARTBEAT": "", "MINIPS_PUSH_COMM": "",
                 "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
+            "MINIPS_TENANT": "",
                 "MINIPS_OBS": "", "MINIPS_FLIGHT": ""}
         kill_step = max(2, e_iters // 3)
         grid: dict = {"iters": e_iters, "kill_step": kill_step}
@@ -1637,6 +1780,7 @@ def main() -> int:
                 "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
                 "MINIPS_HEARTBEAT": "", "MINIPS_PUSH_COMM": "",
                 "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
+            "MINIPS_TENANT": "",
                 "MINIPS_OBS": "", "MINIPS_FLIGHT": ""}
         grid: dict = {"iters": c_iters}
 
@@ -1838,7 +1982,7 @@ def main() -> int:
                 "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
                 "MINIPS_PUSH_COMM": "", "MINIPS_MESH": "",
                 "MINIPS_AUTOSCALE": "", "MINIPS_OBS": "",
-                "MINIPS_FLIGHT": ""}
+                "MINIPS_FLIGHT": "", "MINIPS_TENANT": ""}
         grid: dict = {"iters": p_iters}
 
         def rate(dones: list[dict]) -> float:
@@ -2171,6 +2315,14 @@ def main() -> int:
     # degenerate drill pin exactness
     hybrid_grid = hybrid_arms(quick=args.quick)
 
+    # THE MULTI-TENANT SWEEP (this PR): a training tenant next to a
+    # storming zipf inference tenant in ONE job — TENANT-ISO wants the
+    # isolated arm's trn throughput within 10% of its solo arm with
+    # inf shedding into its OWN budget (trn's attributed counters
+    # zero, the shared-bucket contrast arm visibly coupled);
+    # TENANT-IDLE wants the bare-default-tenant lockstep bitwise
+    tenant_grid = tenant_arms(quick=args.quick)
+
     # resolved JAX backend stamp (satellite): probed in a SUBPROCESS so
     # the driver never grabs the TPU out from under a worker (libtpu is
     # exclusive per process) — ci/bench_regression.py refuses to
@@ -2239,6 +2391,7 @@ def main() -> int:
         "reshard_3proc": reshard_grid,
         "hier_agg_3proc": hier_grid,
         "hybrid_agg_3proc": hybrid_grid,
+        "multi_tenant_3proc": tenant_grid,
         "mesh_plane_fused": mesh_grid,
     }))
     return 0

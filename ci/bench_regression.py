@@ -1450,6 +1450,108 @@ def hybrid_tripwires(new: dict) -> list[str]:
     return problems
 
 
+def tenant_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``multi_tenant_3proc`` sweep
+    (multi-tenant tables — tenant/registry.py + the per-tenant serve/
+    balance splits); vacuous when the sweep is absent.
+
+    - TENANT-ISO: the solo / isolated / shared arms must all complete
+      with zero stale reads, zero unrecovered frames, and zero config
+      drops; the isolated arm's training-tenant throughput must hold
+      within 10% of its solo arm (the SLO bound tenancy promises)
+      with the storming tenant provably shedding into its OWN budget
+      (inf denied > 0) and the protected tenant's attributed deny
+      counters at ZERO; and the shared-bucket contrast arm must show
+      the coupling per-tenant buckets remove (trn denied > 0 under
+      ``shared=1``) — without it, an "isolation win" proves nothing.
+    - TENANT-IDLE: the bare-default-tenant lockstep drill must report
+      bitwise-equal finals over > 0 rows with the stamp provably
+      engaged (tenant ids [1, 1]) and zero attributed counters —
+      arming tenancy may not perturb one bit of a single-tenant run."""
+    grid = new.get("multi_tenant_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    arms = {a: grid.get(a) or {} for a in ("solo", "isolated",
+                                           "shared")}
+    for name, arm in arms.items():
+        if not arm.get("completed"):
+            problems.append(
+                f"TENANT-ISO multi_tenant_3proc/{name}: completed="
+                f"{arm.get('completed')!r} — every arm must finish "
+                "(tenancy is bookkeeping, never a failure mode)"
+                + (f" error={arm.get('error')!r}"
+                   if arm.get("error") else ""))
+            continue
+        if arm.get("stale_reads", 0):
+            problems.append(
+                f"TENANT-ISO multi_tenant_3proc/{name}: "
+                f"{arm['stale_reads']} stale reads — a tenant's own "
+                "s bound was violated")
+        if arm.get("wire_frames_lost", 0) or arm.get(
+                "frames_dropped", 0):
+            problems.append(
+                f"TENANT-ISO multi_tenant_3proc/{name}: "
+                f"wire_frames_lost={arm.get('wire_frames_lost')!r} "
+                f"frames_dropped={arm.get('frames_dropped')!r} — "
+                "tenancy must not lose or drop one frame")
+    solo, iso, sh = arms["solo"], arms["isolated"], arms["shared"]
+    if solo.get("completed") and iso.get("completed"):
+        s_rate, i_rate = (solo.get("trn_rows_per_sec"),
+                          iso.get("trn_rows_per_sec"))
+        if not (isinstance(s_rate, (int, float)) and s_rate > 0
+                and isinstance(i_rate, (int, float))
+                and i_rate >= 0.9 * s_rate):
+            problems.append(
+                f"TENANT-ISO multi_tenant_3proc: isolated trn rate "
+                f"{i_rate!r} below 90% of solo {s_rate!r} — the "
+                "noisy neighbor broke the training tenant's SLO")
+        if not iso.get("inf_denied"):
+            problems.append(
+                "TENANT-ISO multi_tenant_3proc/isolated: storm "
+                "tenant never denied (inf_denied=0) — the admission "
+                "split silently disarmed, the 'isolation' is vacuous")
+        if iso.get("trn_denied", 0):
+            problems.append(
+                f"TENANT-ISO multi_tenant_3proc/isolated: "
+                f"trn_denied={iso['trn_denied']} — the protected "
+                "tenant was charged for the storm (shed/throttle "
+                "must land on the tenant that caused them)")
+    if sh.get("completed"):
+        if not sh.get("shared"):
+            problems.append(
+                "TENANT-ISO multi_tenant_3proc/shared: shared=0 — "
+                "the contrast arm never armed the fleet bucket")
+        if not sh.get("trn_denied"):
+            problems.append(
+                "TENANT-ISO multi_tenant_3proc/shared: trn_denied=0 "
+                "under shared=1 — the coupling the per-tenant split "
+                "removes never engaged, the contrast proves nothing")
+    idle = grid.get("idle") or {}
+    if not idle.get("equal") or not idle.get("rows_checked"):
+        problems.append(
+            f"TENANT-IDLE multi_tenant_3proc/idle: equal="
+            f"{idle.get('equal')!r} rows_checked="
+            f"{idle.get('rows_checked')!r}"
+            + (f" error={idle.get('error')!r}" if idle.get("error")
+               else "")
+            + " — the bare default tenant must be bitwise-equal "
+            "to tenancy-off")
+    else:
+        if idle.get("tenant_tids") != [1, 1]:
+            problems.append(
+                f"TENANT-IDLE multi_tenant_3proc/idle: tenant_tids="
+                f"{idle.get('tenant_tids')!r} — equal because the "
+                "stamp never engaged, not because armed-idle is free")
+        if idle.get("tenant_counters", 0):
+            problems.append(
+                f"TENANT-IDLE multi_tenant_3proc/idle: "
+                f"{idle['tenant_counters']} tenant counters bumped "
+                "on an idle run — armed-IDLE means zero attributed "
+                "denials")
+    return problems
+
+
 def mesh_tripwires(new: dict) -> list[str]:
     """Absolute (prior-free) gates on the ``mesh_plane_fused`` sweep
     (the in-mesh collective data plane, train/mesh_plane.py); vacuous
@@ -1673,6 +1775,7 @@ def main(argv: list[str] | None = None) -> int:
                 + partition_tripwires(new) + fail_slow_tripwires(new)
                 + reshard_tripwires(new)
                 + hier_tripwires(new) + hybrid_tripwires(new)
+                + tenant_tripwires(new)
                 + mesh_tripwires(new))
     pts = throughput_points(new)
     print(f"bench-regression: {len(pts)} throughput points checked "

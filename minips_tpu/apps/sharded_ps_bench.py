@@ -121,6 +121,47 @@ def _run_fail_slow_idle_drill() -> int:
     return 0 if out["bitwise_equal"] else 1
 
 
+def _run_tenant_idle_drill() -> int:
+    """TENANT-IDLE: the BSP lockstep drill with the bare default
+    tenant ARMED (``MINIPS_TENANT=1``) vs off — armed-but-idle must be
+    BITWISE equal (the ``tb`` config stamp is the only armed cost;
+    no override ⇒ no behavior change) with the stamp provably engaged
+    (nonzero tenant ids) and zero attributed tenant counters. Emits
+    one JSON stamp line; failures report ``bitwise_equal: false`` so
+    the CI gate fails loudly instead of silently skipping."""
+    out = {"event": "drill", "bitwise_equal": False, "rows_checked": 0,
+           "tenant_tids": None, "tenant_counters": None}
+    try:
+        import minips_tpu
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(minips_tpu.__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from tests.test_chaos_reliable import run_bsp_lockstep
+
+        w_off, lost_off = run_bsp_lockstep(backend="zmq")
+        st: dict = {}
+        w_on, lost_on = run_bsp_lockstep(backend="zmq", tenant="1",
+                                         stats=st)
+        eq = all(np.array_equal(a, b) for a, b in zip(w_off, w_on))
+        out.update({
+            "bitwise_equal": bool(eq) and lost_off == lost_on == [0, 0]
+            and st.get("tenant_tids") == [1, 1]
+            and st.get("tenant_counters") == 0,
+            "rows_checked": int(sum(a.shape[0] for a in w_off)),
+            # evidence the armed arm really armed (tids engaged) and
+            # really idled (zero attributed counters) — the gate
+            # checks the stamps, not just the verdict
+            "tenant_tids": st.get("tenant_tids"),
+            "tenant_counters": st.get("tenant_counters"),
+        })
+    except Exception as e:  # noqa: BLE001 - the gate reads the stamp
+        out["error"] = repr(e)[:300]
+    print(json.dumps(out), flush=True)
+    return 0 if out["bitwise_equal"] else 1
+
+
 def _run_reshard_mem_drill() -> int:
     """RESHARD-MEM: the streaming N->M checkpoint reshard (mover (c),
     ckpt/elastic.reshard_table_state) at a RAM-visible table size —
@@ -242,6 +283,158 @@ def _run_hier_drill(hier_spec: str) -> int:
         out["error"] = repr(e)[:300]
     print(json.dumps(out), flush=True)
     return 0 if out["bitwise_equal"] else 1
+
+
+def _run_tenant_bench(args) -> int:
+    """TENANT-ISO bench mode: TWO tables = two tenants in ONE job —
+    ``trn`` (every rank runs the sparse pull→push training cycle at
+    the ``--trn-step-ms`` deadline pace; its pace-kept rows/sec is
+    THE protected number) and ``inf`` (per-rank
+    storm reader threads free-run ``pull_serving`` with the shared
+    zipf hot set — the noisy neighbor). The tenant spec decides the
+    arm: per-tenant buckets (``trn:rate=0;inf:rate=...``) must keep
+    trn's throughput within the solo arm's bound while inf sheds into
+    its own budget; ``shared=1`` is the coupling contrast arm; storm
+    off (``--storm 0``) is the solo arm. One done line carries trn's
+    rate, inf's read rate, and the full wire_record (the ``tenant``
+    block is the gate's attribution evidence)."""
+    import threading
+
+    from minips_tpu.apps.common import init_multiproc, table_wire_kwargs
+    from minips_tpu.data.synthetic import make_zipf_sampler
+    from minips_tpu.train.sharded_ps import (ShardedPSTrainer,
+                                             ShardedTable)
+    from minips_tpu.utils.metrics import wire_record
+
+    rank, nprocs, bus, monitor, _ = init_multiproc("asp", 0)
+    if nprocs < 2:
+        print(json.dumps({"rank": 0, "event": "error",
+                          "err": "--tenant-bench needs the launcher "
+                                 "(n >= 2): the serve plane needs "
+                                 "peers"}), flush=True)
+        return 2
+
+    def mk(name: str) -> ShardedTable:
+        return ShardedTable(name, args.rows, args.dim, bus, rank,
+                            nprocs, updater=args.updater, lr=0.05,
+                            pull_timeout=args.pull_timeout,
+                            monitor=monitor, **table_wire_kwargs(args))
+
+    tables = {"trn": mk("trn"), "inf": mk("inf")}
+    trainer = ShardedPSTrainer(tables, bus, nprocs,
+                               staleness=args.staleness,
+                               gate_timeout=60.0, monitor=monitor,
+                               serve=args.serve, tenant=args.tenant)
+    bus.handshake(nprocs)
+
+    rng = np.random.default_rng(rank)
+    B, dim = args.batch, args.dim
+    grads = rng.normal(size=(B, dim)).astype(np.float32)
+    # the inf tenant's readers hammer the SAME hot rows on every rank
+    # (spread_seed shared — real serving skew); trn trains uniform so
+    # the protected tenant's traffic is not itself promotable-hot
+    zipf_sample = make_zipf_sampler(args.rows, args.zipf_alpha,
+                                    spread_seed=7,
+                                    permute_hot=args.zipf_permute_hot)
+    storm_stop = threading.Event()
+    storm_errs: list = []
+    storm_counts = [0] * max(args.storm, 1)
+    storm_threads: list = []
+
+    def _inf_reader(j: int) -> None:
+        rrng = np.random.default_rng((rank, j, 1717))
+        SB = args.storm_batch
+        think = args.storm_think_ms / 1e3
+        inf = tables["inf"]
+        while not storm_stop.is_set():
+            if think > 0:
+                time.sleep(think)
+            keys = zipf_sample(rrng, SB)
+            try:
+                inf.pull_serving(keys)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                if not storm_stop.is_set():
+                    storm_errs.append(repr(e))
+                return
+            storm_counts[j] += SB
+
+    for j in range(args.storm):
+        th = threading.Thread(target=_inf_reader, args=(j,),
+                              daemon=True, name=f"inf-reader-{j}")
+        storm_threads.append(th)
+        th.start()
+
+    trn = tables["trn"]
+    trn_rows = 0
+    read0 = 0
+    t0 = 0.0
+    # deadline pacing: a real trainer has a step time (compute), so
+    # the protected number is PACE-KEPT throughput — each step sleeps
+    # to its deadline and an overrunning step slips it (never banks
+    # debt), so missed deadlines surface as rows/sec below the paced
+    # rate. Flat-out (pace=0) measures leftover CPU on the shared
+    # box, which no admission split can protect; pace-kept rows/sec
+    # is the SLO tenancy actually promises.
+    pace = args.trn_step_ms / 1e3
+    next_t = time.perf_counter()
+    for i in range(args.iters):
+        if i == args.warmup:
+            trn_rows = 0
+            read0 = sum(storm_counts)
+            t0 = time.perf_counter()
+            next_t = t0
+        keys = rng.integers(0, args.rows, size=B)
+        trn.pull(keys)
+        trn.push(keys, grads)
+        trn_rows += 2 * B
+        trainer.tick()
+        if pace > 0:
+            next_t += pace
+            slack = next_t - time.perf_counter()
+            if slack > 0:
+                time.sleep(slack)
+            else:
+                next_t = time.perf_counter()
+    dt = time.perf_counter() - t0
+    read_rows = sum(storm_counts) - read0
+    storm_stop.set()
+    for th in storm_threads:
+        th.join(timeout=30.0)
+    assert not any(th.is_alive() for th in storm_threads), \
+        "inf reader wedged"
+    assert not storm_errs, storm_errs
+    trainer.finalize(timeout=60.0)
+    assert trainer.frames_dropped == 0, trainer.drop_detail()
+    trainer.shutdown_barrier(timeout=15.0)
+
+    timed = args.iters - args.warmup
+    print(json.dumps({
+        "rank": rank, "event": "done", "mode": "tenant_bench",
+        "nprocs": nprocs,
+        "tenant_spec": (args.tenant
+                        or os.environ.get("MINIPS_TENANT") or None),
+        "serve_spec": (args.serve or os.environ.get("MINIPS_SERVE")
+                       or None),
+        "storm_readers": args.storm or None,
+        "storm_batch": args.storm_batch if args.storm else None,
+        "trn_step_ms": args.trn_step_ms or None,
+        "read_rows": int(read_rows),
+        "read_rows_per_sec": round(read_rows / dt, 1),
+        "staleness": (None if args.staleness == float("inf")
+                      else int(args.staleness)),
+        "reliable_on": os.environ.get("MINIPS_RELIABLE", "")
+        not in ("", "0"),
+        **wire_record(trainer),
+        "rows": args.rows, "dim": args.dim, "batch": B,
+        "iters_timed": timed,
+        # the protected number: the training tenant's pull+push rows
+        "trn_rows_per_sec": round(trn_rows / dt, 1),
+        "wall_s": round(dt, 4),
+    }), flush=True)
+    if monitor is not None:
+        monitor.stop()
+    bus.close()
+    return 0
 
 
 def _run_mesh(args) -> int:
@@ -432,6 +625,16 @@ def main(argv=None) -> int:
     ap.add_argument("--storm-step-s", type=float, default=0.02,
                     help="storm mode: main-loop pacing per iteration — "
                          "the pusher cadence; readers free-run")
+    ap.add_argument("--trn-step-ms", type=float, default=0.0,
+                    help="tenant bench: the training tenant's step "
+                         "deadline — each pull+push+tick sleeps to "
+                         "this pace and an overrun slips the deadline "
+                         "(never banks debt), so trn_rows_per_sec is "
+                         "PACE-KEPT throughput: the SLO number "
+                         "admission isolation can actually protect. "
+                         "0 = flat out (measures leftover CPU on a "
+                         "shared box, noisy-neighbor-sensitive by "
+                         "construction)")
     ap.add_argument("--serve", default=None, metavar="SPEC",
                     help="arm the read-mostly serving plane "
                          "(minips_tpu/serve/) with this MINIPS_SERVE "
@@ -509,6 +712,26 @@ def main(argv=None) -> int:
                          "degenerate tier runs THE shared f64 dedup "
                          "kernel in deposit order, so off == agg=host "
                          "== one-device mesh bit-for-bit")
+    ap.add_argument("--tenant", default=None, metavar="SPEC",
+                    help="arm multi-tenant tables on this worker's "
+                         "trainer (MINIPS_TENANT grammar, "
+                         "tenant/registry.py) — the flag spelling; "
+                         "the env works too (flag wins)")
+    ap.add_argument("--tenant-bench", action="store_true",
+                    help="two-tenant isolation mode: a 'trn' table "
+                         "trains flat out (pull+push, the protected "
+                         "trn_rows_per_sec) while --storm reader "
+                         "threads free-run pull_serving against an "
+                         "'inf' table on the shared zipf hot set; "
+                         "--tenant decides the arm (per-tenant "
+                         "buckets vs shared=1 vs storm-off solo). "
+                         "The multi_tenant_3proc sweep's worker")
+    ap.add_argument("--tenant-idle-drill", action="store_true",
+                    help="run the BSP lockstep drill with the bare "
+                         "default tenant (MINIPS_TENANT=1) vs off "
+                         "and emit its bitwise stamp + tenant-id/"
+                         "counter evidence (the artifact's "
+                         "TENANT-IDLE input)")
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="write this rank's wire trace (Chrome-trace "
                          "JSON, obs/tracer.py) into DIR — the flag "
@@ -525,6 +748,13 @@ def main(argv=None) -> int:
         return _run_mesh_drill()
     if args.fail_slow_idle_drill:
         return _run_fail_slow_idle_drill()
+    if args.tenant_idle_drill:
+        return _run_tenant_idle_drill()
+    if args.tenant_bench:
+        if args.path != "sparse" or args.compute != "none":
+            ap.error("--tenant-bench measures tenant isolation on the "
+                     "sparse serve path — drop --path dense/--compute")
+        return _run_tenant_bench(args)
     if args.reshard_mem_drill:
         return _run_reshard_mem_drill()
     if args.hier_idle_drill:
@@ -635,7 +865,8 @@ def main(argv=None) -> int:
         trainer = ShardedPSTrainer({"b": table}, bus, nprocs,
                                    staleness=args.staleness,
                                    gate_timeout=60.0, monitor=monitor,
-                                   serve=args.serve)
+                                   serve=args.serve,
+                                   tenant=args.tenant)
         bus.handshake(nprocs)
 
     rng = np.random.default_rng(rank)

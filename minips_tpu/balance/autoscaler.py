@@ -158,6 +158,15 @@ class Autoscaler:
         self.p99_last_ms: Optional[float] = None
         self.counters = {"admits": 0, "drains": 0, "hot_ticks": 0,
                          "calm_ticks": 0, "sheds_seen": 0}
+        # tenancy (tenant/registry.py): the per-tenant split of the
+        # summed signals — last tick's {table: {shed_d, p99, heat}}
+        # plus the current CULPRIT (max shed rate, p99 tie-break), so
+        # an elastic decision names the tenant that caused it instead
+        # of "the fleet" (the PR 12 summed-signals limit). Empty/None
+        # with tenancy off — the decision thresholds themselves stay
+        # fleet-wide either way: capacity is still shared.
+        self._by_tenant: dict[str, dict] = {}
+        self._culprit: Optional[str] = None
 
     # ------------------------------------------------------------ signals
     def _signals(self) -> tuple[float, Optional[float], float]:
@@ -169,7 +178,13 @@ class Autoscaler:
         shed_d = 0.0
         p99s: list[float] = []
         totals: list[float] = []
+        tenancy = getattr(self.trainer, "tenant_registry",
+                          None) is not None
+        by: dict[str, dict] = {}
         for name in self.trainer.tables:
+            td = 0.0
+            tp: list[float] = []
+            th = 0.0
             for r, rep in self.rb.heat_reports(name).items():
                 sv = rep.get("sv") or {}
                 cur = float(sv.get("shed", 0.0))
@@ -177,11 +192,25 @@ class Autoscaler:
                 prev = self._prev.get(key)
                 if prev is not None and cur > prev:
                     shed_d += cur - prev
+                    td += cur - prev
                 self._prev[key] = cur
                 p = rep.get("p99")
                 if isinstance(p, (int, float)):
                     p99s.append(float(p))
+                    tp.append(float(p))
                 totals.append(float(rep.get("total", 0.0)))
+                th += float(rep.get("total", 0.0))
+            if tenancy:
+                by[name] = {"shed_d": round(td, 3),
+                            "p99_ms": max(tp) if tp else None,
+                            "heat": round(th, 3)}
+        if tenancy and by:
+            # the culprit: most shed pressure this tick, worst tail as
+            # the tie-break — recorded into every decision's why
+            self._by_tenant = by
+            self._culprit = max(
+                by, key=lambda n: (by[n]["shed_d"],
+                                   by[n]["p99_ms"] or 0.0))
         mean = sum(totals) / len(totals) if totals else 0.0
         ratio = (max(totals) / mean) if mean > 0 else 0.0
         return shed_d, (max(p99s) if p99s else None), ratio
@@ -273,6 +302,8 @@ class Autoscaler:
                "shed_rate": rate_now,
                "p99_ms": self.p99_last_ms,
                "hot_streak": self._hot}
+        if self._culprit is not None:
+            why["tenant"] = self._culprit  # who caused the scale-up
         self.mb.grant_join()
         with self._lock:
             self.counters["admits"] += 1
@@ -317,6 +348,10 @@ class Autoscaler:
                "shed_rate": rate_now,
                "p99_ms": self.p99_last_ms,
                "calm_streak": self._calm}
+        if self._culprit is not None:
+            # the tenant whose pressure the calm streak released —
+            # last hot culprit, the drain's "who stopped storming"
+            why["tenant"] = self._culprit
         self.trainer.bus.send(victim, Membership.DRAIN_KIND,
                               {**self.mb.lease.stamp()})
         with self._lock:
@@ -378,4 +413,7 @@ class Autoscaler:
             "p99_hot_ms": round(self.p99_hot_ms, 3) or None,
             "p99_last_ms": self.p99_last_ms,
         })
+        if getattr(self.trainer, "tenant_registry", None) is not None:
+            out["tenants"] = dict(self._by_tenant)
+            out["culprit"] = self._culprit
         return out

@@ -23,15 +23,30 @@ import numpy as np
 
 
 class HeatAccountant:
-    def __init__(self, num_blocks: int, decay: float = 0.8):
+    def __init__(self, num_blocks: int, decay: float = 0.8, *,
+                 table_id: int = 0):
         if num_blocks < 1:
             raise ValueError("num_blocks must be >= 1")
         if not 0.0 <= decay <= 1.0:
             raise ValueError("decay must be in [0, 1]")
+        if table_id < 0:
+            raise ValueError("table_id must be >= 0")
         self.num_blocks = int(num_blocks)
         self.decay = float(decay)
+        # tenancy namespace (tenant/registry.py): the owning table's
+        # 1-based tenant id, 0 = tenancy off. Block ids are table-local
+        # — two tenants' block 7 are different key ranges — so every
+        # report is stamped with the id and the rebalancer refuses a
+        # report whose stamp disagrees with the table it arrived on
+        # (a crossed wire must never migrate the wrong tenant's keys).
+        self.table_id = int(table_id)
         self._heat = np.zeros(self.num_blocks, np.float64)
         self._lock = threading.Lock()
+
+    def global_key(self, block: int) -> tuple[int, int]:
+        """The (table_id, block) pair that names a block fleet-wide —
+        the namespaced form any cross-table consumer must key on."""
+        return (self.table_id, int(block))
 
     def touch(self, blocks: np.ndarray, rows: int = 1) -> None:
         """Record served rows per touched block. ``blocks`` is one block
@@ -76,11 +91,14 @@ class HeatAccountant:
         blocks = owned[idx]
         heats = h[idx]
         keep = heats > 0.0  # cold blocks are not candidates
-        return {
+        rep = {
             "total": total,
             "blocks": [int(b) for b in blocks[keep]],
             "heat": [float(x) for x in heats[keep]],
         }
+        if self.table_id:
+            rep["tb"] = self.table_id
+        return rep
 
     def snapshot(self) -> np.ndarray:
         with self._lock:

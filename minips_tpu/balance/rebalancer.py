@@ -165,6 +165,13 @@ class Rebalancer:
         self.coord = 0
         self.plans = 0
         self.stale_plans_fenced = 0  # rbP frames dropped by lease term
+        # tenancy (tenant/registry.py): rbH frames whose tenant stamp
+        # disagreed with the table they arrived on (dropped — block
+        # ids are table-local, a crossed report must never feed a
+        # plan), and heat plans deferred so one tenant's migration
+        # never overlaps another's staging window
+        self.tenant_heat_crossed = 0
+        self.tenant_plans_deferred = 0
         self._stopped = False
         self._drive_thread: Optional[int] = None  # push-driving thread
         self._lock = threading.Lock()
@@ -256,6 +263,17 @@ class Rebalancer:
 
     def _mk_on_heat(self, name: str):
         def on_heat(sender: int, payload: dict) -> None:
+            # tenancy namespace guard: block ids in a heat report are
+            # TABLE-LOCAL, so a report stamped for a different tenant
+            # than the table this wire belongs to (half-armed fleet,
+            # divergent registry order) must never enter the planner —
+            # the config stamp poisons the data wire for the same
+            # divergence; this is the control wire's twin
+            t = self.trainer.tables.get(name)
+            tid = getattr(t, "_tenant_tid", 0) if t is not None else 0
+            if int(payload.get("tb", 0)) != tid:
+                self.tenant_heat_crossed += 1
+                return
             with self._lock:
                 self._reports.setdefault(name, {})[sender] = payload
         return on_heat
@@ -379,7 +397,14 @@ class Rebalancer:
                 rep["sv"] = t._sv.load_signal()
             ow = getattr(self.trainer, "obs_window", None)
             if ow is not None:
-                rep["p99"] = ow.quantile_ms("pull_latency", 0.99)
+                # tenancy armed: the report carries THIS tenant's own
+                # windowed pull p99 (registered per table by
+                # _register_window_signals), so the autoscaler's SLO
+                # arming judges each tenant against its own tail
+                # instead of the fleet blend
+                sig = ("pull_latency" if not getattr(t, "_tenant_tid", 0)
+                       else f"pull_latency:{name}")
+                rep["p99"] = ow.quantile_ms(sig, 0.99)
             else:
                 from minips_tpu.obs.hist import summarize_counts
 
@@ -405,6 +430,21 @@ class Rebalancer:
             # interleave with a heat plan (the planner's one-plan-at-a-
             # time quality rule; adoption itself tolerates pipelining)
             return
+        if getattr(t, "_tenant_tid", 0):
+            # per-tenant migration scheduling: at most ONE tenant's
+            # heat migration in flight fleet-wide — a plan for this
+            # table is deferred while any other table has a pending
+            # plan or unsettled fences, so two tenants' state ships
+            # can never stack in one staging window (the per-round
+            # reshard cap bounds each table alone; overlap would sum
+            # them). Membership transitions (join/drain/death) stay
+            # fleet-wide — an evacuation must cover every table at
+            # once, the documented honest limit.
+            for oname, ot in self.trainer.tables.items():
+                if oname != name and (self.has_pending(oname)
+                                      or not ot.rebalance_settled()):
+                    self.tenant_plans_deferred += 1
+                    return
         last = self._last_plan.get(name, self._t0)
         if now - last < self.cfg.interval:
             return
@@ -511,7 +551,9 @@ class Rebalancer:
     # ------------------------------------------------------------- metrics
     def stats(self) -> dict:
         out = {"plans": self.plans,
-               "stale_plans_fenced": self.stale_plans_fenced}
+               "stale_plans_fenced": self.stale_plans_fenced,
+               "tenant_heat_crossed": self.tenant_heat_crossed,
+               "tenant_plans_deferred": self.tenant_plans_deferred}
         per = {}
         for name, t in self.trainer.tables.items():
             per[name] = t.rebalance_table_stats()

@@ -32,6 +32,15 @@ The TLV decode mirrors JSON's semantic quirks on purpose so handlers
 see identical objects whichever codec framed the wire: dict keys are
 coerced to ``str`` on encode (``json.dumps`` does this silently) and
 tuples decode as lists.
+
+Head-key contract: payload keys ride the TLV tail VERBATIM — there is
+no fixed key table to extend, which is what lets a protocol layer add
+a stamp without a codec version bump. The per-frame config stamp
+(``ws``/``nr``/``dm``/``rb``, train/sharded_ps._cfg_header) grew the
+tenancy field ``tb`` this way (tenant/registry.py: the owning table's
+1-based tenant id; absent = tenancy off, so an off fleet's frames are
+byte-identical to pre-tenancy builds and the small-int TLV path makes
+the armed stamp cost three bytes).
 """
 
 from __future__ import annotations

@@ -16,6 +16,15 @@ refill, ``burst`` capacity, monotonic-clock lazy refill, one lock
 float ops). ``rate=0`` disables admission entirely — the bucket always
 admits — so arming the serve plane for replicas alone costs the serve
 path one attribute check.
+
+Tenancy (tenant/registry.py): with ``MINIPS_TENANT`` armed each
+table's ``TableServeState`` builds its bucket from its TENANT's
+``rate``/``burst`` — one bucket per tenant, so tenant A's storm can
+never drain the tokens tenant B's requests needed. The registry's
+``shared=1`` contrast arm hands every table ONE plane-level instance
+of this same class instead (the lock already makes it safe to share
+across tables on one receive thread); the multi_tenant bench measures
+the coupling that re-introduces.
 """
 
 from __future__ import annotations

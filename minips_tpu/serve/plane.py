@@ -173,7 +173,24 @@ class TableServeState:
         self.table = table
         self.plane = plane
         self.cfg = cfg
-        self.bucket = TokenBucket(cfg.rate, cfg.burst)
+        # tenancy (tenant/registry.py): a tenant's spec'd rate/burst
+        # override the fleet-wide knobs — each tenant sheds into ITS
+        # OWN bucket, so one tenant's storm can never drain another's
+        # tokens. Under the registry's ``shared=1`` contrast arm every
+        # table takes the plane's ONE fleet bucket instead (the
+        # pre-tenancy coupling the multi_tenant bench measures
+        # against) at the fleet rate.
+        sp = getattr(table, "_tenant", None)
+        self._rate = cfg.rate if sp is None or sp.rate is None \
+            else sp.rate
+        burst = cfg.burst if sp is None or sp.burst is None \
+            else sp.burst
+        shared = getattr(plane, "shared_bucket", None)
+        if shared is not None:
+            self.bucket = shared
+            self._rate = cfg.rate
+        else:
+            self.bucket = TokenBucket(self._rate, burst)
         # owner role: granted block -> holder set, dirty key sets
         self._granted: dict[int, tuple[int, ...]] = {}
         self._dirty: dict[int, set[int]] = {}
@@ -207,6 +224,26 @@ class TableServeState:
     def _count(self, key: str, n: int = 1) -> None:
         with self._cnt_lock:
             self.counters[key] += n
+
+    def _tenant_deny(self, kind: str, sender: int, fl) -> None:
+        """Attribute one admission denial to this table's tenant
+        (no-op with tenancy off): the per-tenant counter feeds the
+        done line's ``tenant`` block and the windowed ``shed:{table}``
+        signal, and the ``tenant_shed``/``tenant_throttle`` flight
+        events NAME the tenant — riding the caller's existing denial
+        sampling so a storm can't rotate the black-box ring."""
+        t = self.table
+        sp = t._tenant
+        if sp is None:
+            return
+        key = "shed" if kind == "tenant_shed" else "throttle"
+        with t._serve_lock:
+            t.tenant_counters[key] += 1
+        if fl is not None:
+            fl.ev(kind, {"tenant": sp.name, "tid": sp.tid,
+                         "from": int(sender),
+                         "shared": int(self.bucket is getattr(
+                             self.plane, "shared_bucket", None))})
 
     def _staleness(self) -> float:
         return self.table._cache_staleness()
@@ -290,9 +327,13 @@ class TableServeState:
         # touching many hot blocks can ride ONE replica leg instead of
         # fragmenting per block — on loopback (and any frame-cost-bound
         # wire) leg count, not bytes, is what the storm pays for
+        nrep = cfg.replicas
+        tsp = getattr(t, "_tenant", None)
+        if tsp is not None and tsp.replicas is not None:
+            nrep = tsp.replicas  # per-tenant replica budget
         holders = tuple(sorted(
             {live[(t.rank + j) % len(live)]
-             for j in range(min(cfg.replicas, len(live)))}))
+             for j in range(min(nrep, len(live)))}))
         with self._ow_lock:
             fresh = [b for b in hot if b not in self._granted]
         fresh = [b for b in fresh if self._block_settled(b)]
@@ -553,7 +594,7 @@ class TableServeState:
         requester got an explicit answer, never silence. Retried legs
         (``rt >= 1``) are force-admitted: the retry budget is the
         liveness valve that bounds every shed/refuse loop."""
-        if self.cfg.rate <= 0:
+        if self._rate <= 0:
             return True
         if int(payload.get("rt", 0)) >= 1:
             self._count("forced_admits")
@@ -613,6 +654,7 @@ class TableServeState:
                 fl.ev("sv_shed", {"from": sender,
                                   "why": "bucket_empty",
                                   **self.bucket.snapshot()})
+            self._tenant_deny("tenant_shed", sender, fl)
             t.bus.send(sender, f"svS:{t.name}",
                        {"req": int(req), "h": sorted(common)})
             return False
@@ -644,6 +686,7 @@ class TableServeState:
                 fl.ev("sv_shed", {"from": sender, "why": "partial",
                                   "holder": int(pick),
                                   **self.bucket.snapshot()})
+            self._tenant_deny("tenant_shed", sender, fl)
             t.bus.send(sender, f"svS:{t.name}",
                        {"req": int(req), "h": [int(pick)],
                         "bs": covered})
@@ -656,6 +699,7 @@ class TableServeState:
                 fl.ev("sv_bp", {"from": sender,
                                 "retry_ms": self.cfg.retry_ms,
                                 **self.bucket.snapshot()})
+            self._tenant_deny("tenant_throttle", sender, fl)
             t.bus.send(sender, f"svB:{t.name}",
                        {"req": int(req), "ms": self.cfg.retry_ms})
         return False
@@ -1061,6 +1105,10 @@ class TableServeState:
         serve claimed. A nonzero counter is a protocol bug, never load."""
         if not admits(stamp, clk, self._staleness()):
             self._count("stale_reads")
+            t = self.table
+            if t._tenant_tid:
+                with t._serve_lock:
+                    t.tenant_counters["stale_reads"] += 1
 
     def quiesce(self) -> None:
         """Finalize-time: stop granting/refreshing and stop ROUTING to
@@ -1094,7 +1142,7 @@ class TableServeState:
         with self._ow_lock:
             out["granted_blocks"] = len(self._granted)
         out["held_blocks"] = self.held_blocks()
-        out["admission"] = self.bucket.snapshot() if self.cfg.rate > 0 \
+        out["admission"] = self.bucket.snapshot() if self._rate > 0 \
             else None
         return out
 
@@ -1108,6 +1156,15 @@ class ServePlane:
     def __init__(self, trainer, cfg: ServeConfig):
         self.trainer = trainer
         self.cfg = cfg
+        # tenancy ``shared=1`` (tenant/registry.py): ONE fleet-wide
+        # admission bucket every table draws from — the deliberately
+        # coupled contrast arm (a storming tenant drains the tokens a
+        # quiet tenant's requests needed); None = per-table buckets,
+        # the isolation default
+        reg = getattr(trainer, "tenant_registry", None)
+        self.shared_bucket = (TokenBucket(cfg.rate, cfg.burst)
+                              if reg is not None and reg.shared
+                              else None)
         for t in trainer.tables.values():
             t.attach_serve_plane(self, cfg)
 

@@ -1,0 +1,10 @@
+"""Multi-tenant tables — many models, one PS fleet, isolated SLOs.
+
+See ``minips_tpu.tenant.registry`` for the ``MINIPS_TENANT`` grammar
+and the namespace/isolation contract.
+"""
+
+from minips_tpu.tenant.registry import (TenantRegistry, TenantSpec,
+                                        maybe_registry)
+
+__all__ = ["TenantRegistry", "TenantSpec", "maybe_registry"]

@@ -956,6 +956,17 @@ class ShardedTable:
         self._serve_lock = threading.Lock()
         self.serve = {"pull_requests": 0, "pull_rows": 0,
                       "push_frames": 0, "push_rows": 0}
+        # ---- tenancy (tenant/registry.py; OFF unless the trainer
+        # binds a TenantRegistry): this table's tenant spec and its
+        # 1-based tenant id — stamped on every frame head ("tb", next
+        # to ws/nr/dm/rb) — plus the per-tenant SLO counters the serve
+        # plane's deny paths bump when tenancy is armed. tid 0 = off:
+        # no stamp, no counters (the armed-idle drill pins the bare
+        # default tenant bitwise-equal to off with these at zero).
+        self._tenant = None            # tenant.registry.TenantSpec
+        self._tenant_tid = 0
+        self.tenant_counters = {"shed": 0, "throttle": 0,
+                                "stale_reads": 0, "hedge_denied": 0}
         # ---- observability (obs/): always-on server-side latency
         # histograms (serve duration, park duration — the tail half of
         # the serve counters above — and rebalance-fence duration: a
@@ -1247,8 +1258,17 @@ class ShardedTable:
         from minips_tpu.balance.heat import HeatAccountant
 
         self._rb = rb
-        self.router = BlockRouter(self.part, cfg.block)
-        self._heat = HeatAccountant(self.router.num_blocks, cfg.decay)
+        # a tenant may spec its own rebalance block granularity (its
+        # rows may be much wider/narrower than the fleet default's
+        # sweet spot); the per-frame rb stamp is per-table, so ranks
+        # still cross-check — the registry's deterministic assignment
+        # keeps them agreeing
+        blk = cfg.block
+        if self._tenant is not None and self._tenant.block is not None:
+            blk = self._tenant.block
+        self.router = BlockRouter(self.part, blk)
+        self._heat = HeatAccountant(self.router.num_blocks, cfg.decay,
+                                    table_id=self._tenant_tid)
         if self.bus is not None:
             self.bus.on(f"rbS:{self.name}", self._on_migrate_state)
             self.bus.on(f"rbA:{self.name}", self._on_adopt_ack)
@@ -1269,6 +1289,20 @@ class ShardedTable:
                 "state rounds — arm MINIPS_REBALANCE or MINIPS_ELASTIC "
                 "too (attach_rebalancer first)")
         self._reshard = cfg
+
+    def attach_tenant(self, spec) -> None:
+        """Bind this table's tenant (tenant/registry.TenantSpec): the
+        1-based tenant id joins the per-frame config stamp next to
+        ws/nr/dm/rb — a fleet half-armed, or armed with divergent
+        tenant order, poisons the table instead of silently crossing
+        tenants' wires — and the spec's staleness/admission/hedge
+        budgets override the fleet-wide ones wherever the serve plane
+        and the consistency gates consult them. The trainer binds
+        tenancy right after consistency and BEFORE any balance/serve
+        layer arms, so attach_rebalancer/attach_serve_plane/
+        attach_hedge can read the overrides."""
+        self._tenant = spec
+        self._tenant_tid = int(spec.tid)
 
     def attach_serve_plane(self, plane, cfg) -> None:
         """Bind the read-mostly serving plane (serve/plane.py): arms
@@ -1296,6 +1330,16 @@ class ShardedTable:
         stamp, first admissible reply wins. Pure client-side state; a
         table with no serving plane attached simply never finds a
         holder (counted ``no_holder``, the documented honest limit)."""
+        if cfg is not None and self._tenant is not None \
+                and self._tenant.hedge is not None:
+            # per-tenant hedge budget: a shallow copy so one tenant's
+            # budget never moves another's valve; hedge=0 keeps the
+            # plane armed but always sheds at the valve (counted
+            # ``denied`` + the tenant's ``hedge_denied``)
+            import copy
+
+            cfg = copy.copy(cfg)
+            cfg.budget = int(self._tenant.hedge)
         self._hedge = cfg
 
     def attach_hier(self, cfg) -> None:
@@ -2324,23 +2368,32 @@ class ShardedTable:
         nr = int(payload.get("nr", self.num_rows))
         dm = int(payload.get("dm", self.dim))
         rb = int(payload.get("rb", 0))
+        tb = int(payload.get("tb", 0))
         if ws != self.num_processes or nr != self.num_rows \
-                or dm != self.dim or rb != self._rb_cfg():
+                or dm != self.dim or rb != self._rb_cfg() \
+                or tb != self._tenant_tid:
             self._drop("config", sender,
                        f"peer sees world_size={ws} num_rows={nr} dim={dm}"
-                       f" rebalance_block={rb}, mine are "
+                       f" rebalance_block={rb} tenant={tb}, mine are "
                        f"{self.num_processes}/{self.num_rows}/"
-                       f"{self.dim}/{self._rb_cfg()}")
+                       f"{self.dim}/{self._rb_cfg()}/{self._tenant_tid}")
             return False
         return True
 
     def _cfg_header(self) -> dict:
         """Per-frame config stamp: a peer relaunched at a different world
-        size / table shape — or with a divergent rebalance config —
-        must poison the receiver (loud failure), never silently train
-        garbage."""
-        return {"ws": self.num_processes, "nr": self.num_rows,
-                "dm": self.dim, "rb": self._rb_cfg()}
+        size / table shape — or with a divergent rebalance or tenant
+        config — must poison the receiver (loud failure), never
+        silently train garbage. ``tb`` is the 1-based tenant id
+        (tenant/registry.py): absent/0 = tenancy off, so an off fleet's
+        frames are byte-identical to before tenancy existed, and a
+        half-armed fleet (or one whose ranks disagree on tenant order)
+        fails the stamp check both directions."""
+        hd = {"ws": self.num_processes, "nr": self.num_rows,
+              "dm": self.dim, "rb": self._rb_cfg()}
+        if self._tenant_tid:
+            hd["tb"] = self._tenant_tid
+        return hd
 
     def _on_push(self, sender: int, payload: dict) -> None:
         try:
@@ -3094,7 +3147,12 @@ class ShardedTable:
 
     def _cache_staleness(self) -> float:
         """The staleness bound the cache's validity predicate runs under
-        — the trainer's; 0 (BSP, the strictest) when none is bound."""
+        — the TENANT's own ``s`` when one is spec'd (every per-table
+        consumer routes through here: cache validity, replica serve
+        admission, reply-stamp staleness accounting), else the
+        trainer's; 0 (BSP, the strictest) when none is bound."""
+        if self._tenant is not None and self._tenant.s is not None:
+            return self._tenant.s
         return getattr(self._cons, "staleness", 0) \
             if self._cons is not None else 0
 
@@ -3338,6 +3396,9 @@ class ShardedTable:
                     # busy-wake the wait loop at the 1ms floor and
                     # inflate `denied` into a wake count
                     self.hedge_counters["denied"] += 1
+                    if self._tenant_tid:
+                        with self._serve_lock:
+                            self.tenant_counters["hedge_denied"] += 1
                     hedged.add(rid)
                     continue
                 keys = grp["uniq"][idx]
@@ -4483,9 +4544,17 @@ class ShardedTable:
         frame no longer certifies its cross-host pushes (they ride two
         links; per-link FIFO does not compose), so the same
         ``gate.admits`` predicate is re-evaluated against the floor min
-        — semantics preserved, evidence source swapped."""
-        if self._cons is not None and not self._cons.admit_pull(clk):
-            return False
+        — semantics preserved, evidence source swapped. A tenant with
+        its own ``s`` is judged against THAT bound (trainer
+        ``admit_pull_s``); stub consistency objects without the
+        per-bound entry point keep the fleet-wide rule."""
+        ts = self._tenant.s if self._tenant is not None else None
+        if self._cons is not None:
+            if ts is not None and hasattr(self._cons, "admit_pull_s"):
+                if not self._cons.admit_pull_s(clk, ts):
+                    return False
+            elif not self._cons.admit_pull(clk):
+                return False
         fm = self._hier_floor_min()
         if fm is None:
             return True
@@ -5330,7 +5399,8 @@ class ShardedPSTrainer:
                  hedge: Optional[str] = None,
                  slow: Optional[str] = None,
                  hier: Optional[str] = None,
-                 plane: Optional[str] = None):
+                 plane: Optional[str] = None,
+                 tenant: Optional[str] = None):
         # data-plane selection at the same altitude as the bus backends
         # (train/mesh_plane.resolve_plane: explicit wins, else
         # $MINIPS_MESH): this bus-backed trainer IS the host-wire plane;
@@ -5379,6 +5449,23 @@ class ShardedPSTrainer:
         for t in tables.values():
             t.bind_consistency(self)
         self.gossip.add_listener(self._drain_parked)
+        # multi-tenant tables (tenant/registry.py): OFF by default —
+        # explicit spec wins, else $MINIPS_TENANT. Bound FIRST among
+        # the optional layers: the registry's per-tenant block/rate/
+        # burst/replica/hedge budgets override the fleet-wide knobs
+        # inside attach_rebalancer / the serve plane / attach_hedge,
+        # so every table must carry its tenant id before those arm.
+        # bind() assigns deterministic 1-based ids (spec order; the
+        # bare-"1" default takes sorted table-name order) — every rank
+        # computes the same assignment, and the per-frame "tb" stamp
+        # poisons the table if one didn't.
+        from minips_tpu.tenant.registry import maybe_registry as _mt
+
+        self.tenant_registry = _mt(tenant)
+        if self.tenant_registry is not None:
+            self.tenant_registry.bind(tables)
+            for name, t in tables.items():
+                t.attach_tenant(self.tenant_registry.spec_for(name))
         # heat-aware shard rebalancing (balance/): OFF by default —
         # explicit spec wins, else $MINIPS_REBALANCE, else disabled.
         # The elastic membership plane (below) needs the migration
@@ -5622,6 +5709,21 @@ class ShardedPSTrainer:
 
             ow.register_counter("shed", _sv_sig("shed"))
             ow.register_counter("backpressure", _sv_sig("bp"))
+        if getattr(self, "tenant_registry", None) is not None:
+            # per-tenant SLO telemetry: each tenant's own windowed
+            # pull tail (the heat report's p99 reads
+            # ``pull_latency:{table}`` instead of the fleet blend —
+            # balance/rebalancer._send_heat) plus its attributed deny
+            # counters, so "who is being shed" is a window read
+            for name, t in self.tables.items():
+                ow.register_hist(f"pull_latency:{name}", _hist_fn(
+                    [t.timers.hists["pull_latency"]]))
+                ow.register_counter(
+                    f"shed:{name}",
+                    lambda t=t: t.tenant_counters["shed"])
+                ow.register_counter(
+                    f"throttle:{name}",
+                    lambda t=t: t.tenant_counters["throttle"])
         if self.hedge_cfg is not None:
             ow.register_counter(
                 "hedges_fired",
@@ -5670,6 +5772,15 @@ class ShardedPSTrainer:
         shared ``consistency.gate.admits`` predicate, which the client
         row cache also runs as its validity rule."""
         return admits(self.gossip.global_min(), clk, self.staleness)
+
+    def admit_pull_s(self, clk: int, s: float) -> bool:
+        """:meth:`admit_pull` under an explicit staleness bound — the
+        per-tenant entry point (tenant/registry.py): a tenant with its
+        own ``s`` is judged against THAT bound over the same gossip
+        min, so one tenant's looser contract never loosens another's.
+        ``ShardedTable._admit_clk`` probes for this method by name and
+        falls back to :meth:`admit_pull` on stub consistency objects."""
+        return admits(self.gossip.global_min(), clk, s)
 
     def serving_clock(self, requester: int) -> int:
         """The freshness certificate a table stamps on pull replies to
@@ -6117,6 +6228,33 @@ class ShardedPSTrainer:
         out["replica"] = (self.serve_plane.stats_record()
                           if self.serve_plane is not None else None)
         return out
+
+    def tenant_stats(self) -> Optional[dict]:
+        """Per-tenant SLO evidence (tenant/registry.py) — None when
+        tenancy is off, zero counters when armed but idle (the
+        off-vs-idle convention; the TENANT-IDLE gate pins the zeros).
+        One block per tenant: its id, its spec'd overrides, the deny
+        counters the serve plane attributed to ITS budget (shed =
+        svS redirects, throttle = svB backpressure, stale_reads =
+        replies its own ``s`` refused, hedge_denied = its hedge-budget
+        valve), and its own serve-load counters — the per-tenant
+        split of the fleet-summed signals PR 12 couldn't separate."""
+        reg = getattr(self, "tenant_registry", None)
+        if reg is None:
+            return None
+        by: dict = {}
+        for name, t in self.tables.items():
+            sp = t._tenant
+            if sp is None:
+                continue
+            with t._serve_lock:
+                tc = dict(t.tenant_counters)
+                sv = dict(t.serve)
+            by[name] = {"tid": sp.tid, **tc,
+                        "pull_rows": sv["pull_rows"],
+                        "push_rows": sv["push_rows"],
+                        "overrides": sp.overrides()}
+        return {"shared": int(reg.shared), "tenants": by}
 
     def rebalance_stats(self) -> Optional[dict]:
         """Rebalancer counters (balance/rebalancer.py) — None when the

@@ -151,4 +151,11 @@ def wire_record(trainer) -> dict:
         # CTRL-SCALE tripwire gates
         "autoscale": getattr(trainer, "autoscale_stats",
                              lambda: None)(),
+        # multi-tenant tables (tenant/registry.py): per-tenant SLO
+        # evidence — tenant id, spec'd overrides, and the deny
+        # counters the serve plane attributed to each tenant's own
+        # budget (shed/throttle/stale_reads/hedge_denied). None when
+        # MINIPS_TENANT is off, zero counters when armed but idle —
+        # the TENANT-IDLE gate pins the zeros
+        "tenant": getattr(trainer, "tenant_stats", lambda: None)(),
     }

@@ -556,6 +556,7 @@ def test_ssp_trainer_survives_chaos_with_bounds_intact():
 
 def run_bsp_lockstep(backend: str = "zmq", chaos: str = "",
                      reliable: str = "", hedge: str = "",
+                     tenant: str = "",
                      stats: "dict | None" = None):
     """2-rank in-proc BSP lockstep run → (final weights per rank,
     frames_lost per rank). THE bitwise-drill harness: identical frame
@@ -594,6 +595,17 @@ def run_bsp_lockstep(backend: str = "zmq", chaos: str = "",
                            lr=0.5, pull_timeout=20.0)
               for i in range(2)]
     LockstepCons.clocks = [0, 0]
+    if tenant:
+        # TENANT-IDLE arm (tenant/registry.py): tenancy ARMED with the
+        # bare default registry — every frame gains the "tb" stamp and
+        # every per-tenant override resolves to "inherit", so the run
+        # must be bitwise-equal to off with zero tenant counters
+        from minips_tpu.tenant.registry import TenantRegistry
+
+        regs = [TenantRegistry.parse(tenant) for _ in range(2)]
+        for i, t in enumerate(tables):
+            regs[i].bind({"t": t})
+            t.attach_tenant(regs[i].spec_for("t"))
     for i, t in enumerate(tables):
         t.bind_consistency(LockstepCons(i))
         if hedge:
@@ -626,6 +638,12 @@ def run_bsp_lockstep(backend: str = "zmq", chaos: str = "",
             # measured'
             stats["hedges_fired"] = sum(
                 t.hedge_counters["fired"] for t in tables)
+            # TENANT-IDLE evidence: the armed stamp engaged (nonzero
+            # tid on both ranks) while every attributed deny counter
+            # stayed zero
+            stats["tenant_tids"] = [t._tenant_tid for t in tables]
+            stats["tenant_counters"] = sum(
+                sum(t.tenant_counters.values()) for t in tables)
         return [t._w.copy() for t in tables], lost
     finally:
         for b in buses:

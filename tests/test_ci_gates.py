@@ -20,6 +20,7 @@ from bench_regression import (backend_mismatch, cache_tripwires,  # noqa: E402
                               mesh_tripwires, obs_tripwires,
                               rebalance_tripwires,
                               serve_tripwires, shape_mismatch,
+                              tenant_tripwires,
                               throughput_points, trace_tripwires,
                               transport_tripwires)
 
@@ -718,6 +719,96 @@ def test_wire_converge_gates_loss_and_finals():
     probs = wire_compression_tripwires(
         _wirecomp_art(conv_completed=False))
     assert any("must complete" in p for p in probs)
+
+
+# ------------------------- multi-tenant tripwires (TENANT-ISO/TENANT-IDLE)
+def _tenant_art(*, solo_rate=10_000.0, iso_rate=9_800.0,
+                iso_inf_denied=31, iso_trn_denied=0,
+                sh_trn_denied=28, sh_shared=1,
+                stale=0, lost=0, dropped=0,
+                solo_completed=True, iso_completed=True,
+                sh_completed=True, equal=True, checked=64,
+                tids=(1, 1), idle_counters=0) -> dict:
+    def arm(rate, trn_denied, inf_denied, shared, completed):
+        return {"completed": completed, "shared": shared,
+                "trn_rows_per_sec": rate,
+                "read_rows_per_sec": 4_000.0,
+                "trn_denied": trn_denied, "inf_denied": inf_denied,
+                "stale_reads": stale, "wire_frames_lost": lost,
+                "frames_dropped": dropped}
+    return {"multi_tenant_3proc": {
+        "solo": arm(solo_rate, 0, 0, 0, solo_completed),
+        "isolated": arm(iso_rate, iso_trn_denied, iso_inf_denied,
+                        0, iso_completed),
+        "shared": arm(6_500.0, sh_trn_denied, 40, sh_shared,
+                      sh_completed),
+        "idle": {"equal": equal, "rows_checked": checked,
+                 "tenant_tids": list(tids),
+                 "tenant_counters": idle_counters}}}
+
+
+def test_tenant_tripwires_pass_on_healthy_sweep():
+    assert tenant_tripwires(_tenant_art()) == []
+    # absent sweep (other benches): vacuous
+    assert tenant_tripwires({}) == []
+
+
+def test_tenant_iso_slo_and_attribution():
+    # training tenant dragged >10% below its solo arm by the storm
+    probs = tenant_tripwires(_tenant_art(iso_rate=8_000.0))
+    assert any("TENANT-ISO" in p and "90%" in p for p in probs)
+    assert tenant_tripwires(_tenant_art(iso_rate=9_100.0)) == []
+    # storm tenant never denied: the admission split silently disarmed
+    probs = tenant_tripwires(_tenant_art(iso_inf_denied=0))
+    assert any("TENANT-ISO" in p and "vacuous" in p for p in probs)
+    # protected tenant charged for the storm's sheds
+    probs = tenant_tripwires(_tenant_art(iso_trn_denied=3))
+    assert any("TENANT-ISO" in p and "protected" in p for p in probs)
+
+
+def test_tenant_iso_shared_contrast_must_show_the_coupling():
+    probs = tenant_tripwires(_tenant_art(sh_trn_denied=0))
+    assert any("TENANT-ISO" in p and "proves nothing" in p
+               for p in probs)
+    probs = tenant_tripwires(_tenant_art(sh_shared=0))
+    assert any("TENANT-ISO" in p and "fleet bucket" in p
+               for p in probs)
+
+
+def test_tenant_iso_safety_counters_gate_every_arm():
+    probs = tenant_tripwires(_tenant_art(stale=2))
+    assert sum("stale reads" in p for p in probs) == 3  # all arms
+    probs = tenant_tripwires(_tenant_art(lost=1))
+    assert any("TENANT-ISO" in p and "lose or drop" in p
+               for p in probs)
+    probs = tenant_tripwires(_tenant_art(dropped=2))
+    assert any("lose or drop" in p for p in probs)
+    # a dead arm fails loudly instead of comparing garbage
+    probs = tenant_tripwires(_tenant_art(iso_completed=False))
+    assert any("TENANT-ISO" in p and "every arm must finish" in p
+               for p in probs)
+
+
+def test_tenant_idle_requires_bitwise_equal_with_the_stamp_engaged():
+    probs = tenant_tripwires(_tenant_art(equal=False))
+    assert any("TENANT-IDLE" in p and "bitwise-equal" in p
+               for p in probs)
+    probs = tenant_tripwires(_tenant_art(checked=0))
+    assert any("TENANT-IDLE" in p for p in probs)
+    # equal-but-disarmed (stamp never rode the wire) is not a pass
+    probs = tenant_tripwires(_tenant_art(tids=(0, 0)))
+    assert any("TENANT-IDLE" in p and "never engaged" in p
+               for p in probs)
+    probs = tenant_tripwires(_tenant_art(idle_counters=4))
+    assert any("TENANT-IDLE" in p and "zero attributed" in p
+               for p in probs)
+
+
+def test_tenant_arms_never_enter_the_throughput_gate():
+    """Tenant arms publish trn_rows_per_sec / read_rows_per_sec (gate-
+    invisible): the solo-vs-isolated comparison is TENANT-ISO's job,
+    never the run-to-run ±10% comparator's."""
+    assert throughput_points(_tenant_art()) == {}
 
 
 # -------------------------------- mesh-plane tripwires (MESH-WIN/BITWISE)

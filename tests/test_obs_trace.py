@@ -399,7 +399,7 @@ def test_wire_record_schema_full_layout():
                 "wire_frames_lost", "wire_frames_malformed", "timing",
                 "hist", "window", "heartbeat", "cache", "ef",
                 "reliable", "chaos", "serve", "rebalance", "membership",
-                "hedge", "slowness", "hier", "hybrid"}
+                "hedge", "slowness", "hier", "hybrid", "tenant"}
     assert expected <= set(rec)
     # layers OFF in this run report None — not {} — and vice versa
     assert rec["cache"] is None
@@ -413,6 +413,7 @@ def test_wire_record_schema_full_layout():
     assert rec["rebalance"] is None
     assert rec["membership"] is None
     assert rec["heartbeat"] is None  # no monitor attached: off
+    assert rec["tenant"] is None     # MINIPS_TENANT off: None, not {}
     # the hist block is ALWAYS a dict; populated quantities carry the
     # quantiles, idle ones carry {"count": 0}
     hist = rec["hist"]

@@ -1,0 +1,497 @@
+"""Multi-tenant tables (minips_tpu/tenant/ + the per-tenant splits in
+serve/, balance/, train/) — this PR's tentpole.
+
+Three layers of drill, the house shape:
+
+- pure logic: the MINIPS_TENANT grammar (parse/refuse table + the
+  seeded 250-spec fuzzer), deterministic tenant-id assignment, and the
+  bind-time coverage/consistency refusals;
+- unit protocol: the ``tb`` config stamp poisons a half-armed fleet in
+  both directions, per-tenant staleness routes through the tenant's
+  own ``s`` (cache validity AND owner-side admission), per-tenant
+  admission buckets are distinct objects (the shared=1 contrast arm is
+  ONE object), and a tenant's hedge budget rides a per-table config
+  copy;
+- threads-as-nodes isolation drills: the armed-idle lockstep is
+  bitwise-equal to tenancy-off with zero tenant counters (TENANT-IDLE
+  at test scale), and under per-tenant buckets a storming tenant sheds
+  into its own budget while the quiet tenant's counters — including
+  forced admits, the retried-leg valve — stay at zero; the shared
+  bucket re-couples them, which is the bench's contrast arm.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu.serve.plane import ServeConfig, TableServeState
+from minips_tpu.tenant.registry import (TenantRegistry, TenantSpec,
+                                        maybe_registry)
+from minips_tpu.train.sharded_ps import ShardedPSTrainer, ShardedTable
+
+
+def _mk_buses(n, **kw):
+    from tests.conftest import mk_loopback_buses
+
+    return mk_loopback_buses(n, **kw)
+
+
+class _Bus:
+    """Handler-swallowing stub for table-level unit drills."""
+
+    supports_loopback = False
+
+    def __init__(self):
+        self.sent = []
+
+    def on(self, *_a):
+        pass
+
+    def send(self, dest, kind, head, blob=None):
+        self.sent.append((dest, kind, head))
+
+
+# ------------------------------------------------------------- grammar
+def test_tenant_config_parses_and_refuses():
+    r = TenantRegistry.parse(
+        "trn:rate=0,s=1;inf:rate=500,burst=64,s=2.5,replicas=3,"
+        "hedge=0,updater=adam,wire=int8,block=16;shared=1")
+    assert list(r.tenants) == ["trn", "inf"]
+    assert [s.tid for s in r.tenants.values()] == [1, 2]
+    assert r.shared and not r.default
+    inf = r.tenants["inf"]
+    assert (inf.rate, inf.burst, inf.s, inf.replicas, inf.hedge,
+            inf.updater, inf.wire, inf.block) == (
+        500.0, 64, 2.5, 3, 0, "adam", "int8", 16)
+    assert r.tenants["trn"].overrides() == {"s": 1.0, "rate": 0.0}
+    # the bare default: one tenant per table, no overrides, ids at bind
+    d = TenantRegistry.parse("1")
+    assert d.default and not d.tenants and not d.shared
+    assert TenantRegistry.parse("a:s=inf").tenants["a"].s == float("inf")
+    # off spellings live in maybe_registry, not parse
+    assert maybe_registry("") is None and maybe_registry("0") is None
+    assert maybe_registry("1") is not None
+    for bad, frag in [
+        ("a:zz=1", "unknown knob"),
+        ("a:rate", "expected k=v"),
+        ("a:rate=abc", "bad value for rate"),
+        ("a:rate=-1", "bad value for rate"),
+        ("a:s=-0.5", "bad value for s"),
+        ("a:s=nan", "bad value for s"),
+        ("a:burst=0", "bad value for burst"),
+        ("a:block=0", "bad value for block"),
+        ("a:replicas=0", "bad value for replicas"),
+        ("a:hedge=-1", "bad value for hedge"),
+        ("a:updater=sgdx", "bad value for updater"),
+        ("a:wire=fp8", "bad value for wire"),
+        ("a;a:rate=1", "duplicate tenant"),
+        ("9bad", "bad tenant name"),
+        ("shared=2", "bad value for shared"),
+        ("turbo=1", "unknown global knob"),
+        (";", "no tenants"),
+    ]:
+        with pytest.raises(ValueError, match=frag):
+            TenantRegistry.parse(bad)
+
+
+def _sig(reg):
+    if reg is None:
+        return None
+    return (reg.shared, reg.default,
+            [(s.name, s.tid, sorted(s.overrides().items()))
+             for s in reg.tenants.values()])
+
+
+def test_tenant_knob_fuzzer_parse_or_refuse_loudly():
+    """Seeded MINIPS_TENANT fuzz (the MINIPS_CHAOS/HIER/HEDGE fuzzer
+    convention): every random spec either parses — twice, to the same
+    registry — or refuses with ValueError naming the offense; any
+    other exception is a parser bug."""
+    rng = np.random.default_rng(20260807)
+    names = ["trn", "inf", "aux", "t_0", "9bad", "x y", "", "on"]
+    knobs = ["updater", "wire", "s", "block", "rate", "burst",
+             "replicas", "hedge", "zz", ""]
+    vals = ["sgd", "adam", "f32", "int8", "1", "0", "2.5", "-1",
+            "abc", "inf", "nan", ""]
+    checked = 0
+    for _ in range(250):
+        entries = []
+        for _e in range(int(rng.integers(0, 4))):
+            if rng.random() < 0.2:
+                entries.append(
+                    f"shared={vals[int(rng.integers(len(vals)))]}")
+                continue
+            name = names[int(rng.integers(len(names)))]
+            kvs = ",".join(
+                f"{knobs[int(rng.integers(len(knobs)))]}"
+                f"={vals[int(rng.integers(len(vals)))]}"
+                for _k in range(int(rng.integers(0, 3))))
+            entries.append(name if not kvs else f"{name}:{kvs}")
+        spec = ";".join(entries)
+        outcomes = []
+        for _twice in range(2):
+            try:
+                outcomes.append(("ok", _sig(maybe_registry(spec))))
+            except ValueError as e:
+                assert "MINIPS_TENANT" in str(e), spec
+                outcomes.append(("refused", str(e)))
+            except Exception as e:  # noqa: BLE001 - the fuzzer's point
+                pytest.fail(f"spec {spec!r} raised {e!r} "
+                            f"(not ValueError)")
+        assert outcomes[0] == outcomes[1], spec
+        checked += 1
+    assert checked == 250
+
+
+# ------------------------------------------------ ids, bind, kwargs
+def test_tid_assignment_and_bind_validation():
+    b0, b1 = _Bus(), _Bus()
+    ta = ShardedTable("a", 64, 2, b0, 0, 2)
+    tb = ShardedTable("b", 64, 2, b1, 0, 2)
+    # named mode: spec order wins, whatever the table-dict order
+    r = TenantRegistry.parse("b:rate=1;a")
+    r.bind({"a": ta, "b": tb})
+    assert (r.spec_for("b").tid, r.spec_for("a").tid) == (1, 2)
+    # default mode: sorted table-name order — every rank agrees
+    d = TenantRegistry.parse("1")
+    d.bind({"b": tb, "a": ta})
+    assert (d.spec_for("a").tid, d.spec_for("b").tid) == (1, 2)
+    # an unlisted table must refuse (it would run outside every SLO)
+    with pytest.raises(ValueError, match="no tenant spec"):
+        TenantRegistry.parse("a").bind({"a": ta, "b": tb})
+    # spec'd updater/wire must match the constructed table
+    with pytest.raises(ValueError, match="updater"):
+        TenantRegistry.parse("a:updater=adam;b").bind(
+            {"a": ta, "b": tb})
+    with pytest.raises(ValueError, match="wire"):
+        TenantRegistry.parse("a;b:wire=int8").bind({"a": ta, "b": tb})
+    # table_kwargs hands the app the build overrides bind then accepts
+    kw = TenantRegistry.parse("a:updater=adam,wire=int8;b"
+                              ).table_kwargs("a")
+    assert kw == {"updater": "adam", "pull_wire": "int8"}
+    t2 = ShardedTable("a", 64, 2, _Bus(), 0, 2, **kw)
+    TenantRegistry.parse("a:updater=adam,wire=int8").bind({"a": t2})
+
+
+# --------------------------------------------------- wire namespace
+def test_tb_stamp_poisons_half_armed_fleet_both_directions():
+    """The namespace protocol's loud-failure rule: a frame whose
+    tenant stamp disagrees with mine is a config drop (poison), same
+    as a wrong world size — in BOTH arming directions, plus the
+    divergent-order case."""
+    base = {"ws": 2, "nr": 64, "dm": 2, "rb": 0}
+    # unarmed me, armed peer
+    t = ShardedTable("t", 64, 2, _Bus(), 0, 2)
+    assert t._check_peer_config(1, dict(base, tb=1)) is False
+    assert t._fatal is not None and "tenant=1" in t._fatal
+    # armed me, unarmed peer (no tb key at all)
+    t2 = ShardedTable("t", 64, 2, _Bus(), 0, 2)
+    sp = TenantSpec("t")
+    sp.tid = 1
+    t2.attach_tenant(sp)
+    assert t2._cfg_header()["tb"] == 1
+    assert t2._check_peer_config(1, dict(base)) is False
+    assert t2._fatal is not None
+    # armed both, divergent registry order
+    t3 = ShardedTable("t", 64, 2, _Bus(), 0, 2)
+    t3.attach_tenant(sp)
+    assert t3._check_peer_config(1, dict(base, tb=2)) is False
+    # agreeing stamp admits; an off table's header has no tb at all
+    t4 = ShardedTable("t", 64, 2, _Bus(), 0, 2)
+    t4.attach_tenant(sp)
+    assert t4._check_peer_config(1, dict(base, tb=1)) is True
+    assert "tb" not in ShardedTable("t", 64, 2, _Bus(), 0, 2
+                                    )._cfg_header()
+
+
+def test_heat_report_carries_and_rebalancer_checks_the_tenant_stamp():
+    from minips_tpu.balance.heat import HeatAccountant
+
+    h = HeatAccountant(8, 0.8, table_id=2)
+    h.touch(np.array([1, 1, 3]))
+    rep = h.report(np.arange(8), 4)
+    assert rep["tb"] == 2 and h.global_key(3) == (2, 3)
+    # tenancy off: no stamp at all (frames stay pre-tenancy identical)
+    h0 = HeatAccountant(8, 0.8)
+    h0.touch(np.array([1]))
+    assert "tb" not in h0.report(np.arange(8), 4)
+
+
+# ------------------------------------------------ per-tenant staleness
+def test_per_tenant_staleness_routes_through_the_tenants_own_s():
+    calls = []
+
+    class _Cons:
+        clock = 0
+        staleness = 1
+
+        def admit_pull(self, clk):
+            calls.append(("fleet", clk))
+            return True
+
+        def admit_pull_s(self, clk, s):
+            calls.append(("tenant", clk, s))
+            return True
+
+    # tenant with its own s: cache validity AND owner-side admission
+    # judge against 3, not the fleet's 1
+    t = ShardedTable("t", 64, 2, _Bus(), 0, 2)
+    sp = TenantSpec("t", s=3.0)
+    sp.tid = 1
+    t.attach_tenant(sp)
+    t.bind_consistency(_Cons())
+    assert t._cache_staleness() == 3.0
+    assert t._admit_clk(5) is True
+    assert calls == [("tenant", 5, 3.0)]
+    # no tenant s: the fleet path, untouched
+    calls.clear()
+    t2 = ShardedTable("t", 64, 2, _Bus(), 0, 2)
+    sp2 = TenantSpec("t")
+    sp2.tid = 1
+    t2.attach_tenant(sp2)
+    t2.bind_consistency(_Cons())
+    assert t2._cache_staleness() == 1
+    assert t2._admit_clk(5) is True
+    assert calls == [("fleet", 5)]
+    # stub cons without admit_pull_s (lockstep drills): fallback, even
+    # with a tenant s — the hasattr probe keeps old harnesses working
+    calls.clear()
+
+    class _Old:
+        clock = 0
+        staleness = 1
+
+        def admit_pull(self, clk):
+            calls.append(("fleet", clk))
+            return True
+
+    t3 = ShardedTable("t", 64, 2, _Bus(), 0, 2)
+    t3.attach_tenant(sp)
+    t3.bind_consistency(_Old())
+    assert t3._admit_clk(5) is True
+    assert calls == [("fleet", 5)]
+
+
+def test_trainer_admit_pull_s_judges_the_given_bound():
+    buses = _mk_buses(1)
+    try:
+        t = ShardedTable("t", 64, 2, buses[0], 0, 1)
+        tr = ShardedPSTrainer({"t": t}, buses[0], 1, staleness=0)
+        # global_min starts 0: clk 2 is out of a s=1 bound, inside s=5
+        assert tr.admit_pull_s(2, 5) is True
+        assert tr.admit_pull_s(2, 1) is False
+        assert tr.admit_pull(0) is True
+    finally:
+        for b in buses:
+            b.close()
+
+
+# -------------------------------------------- buckets and hedge budget
+def test_per_tenant_buckets_are_distinct_and_shared_arm_is_one():
+    cfg = ServeConfig.parse("rate=100,burst=5")
+    ta = ShardedTable("a", 96, 2, _Bus(), 0, 3)
+    tb = ShardedTable("b", 96, 2, _Bus(), 0, 3)
+    spa, spb = TenantSpec("a", rate=7.0, burst=2), TenantSpec("b")
+    spa.tid, spb.tid = 1, 2
+    ta.attach_tenant(spa)
+    tb.attach_tenant(spb)
+    sva = TableServeState(ta, None, cfg)
+    svb = TableServeState(tb, None, cfg)
+    assert sva.bucket is not svb.bucket
+    assert (sva.bucket.rate, sva.bucket.burst) == (7.0, 2.0)  # override
+    assert (svb.bucket.rate, svb.bucket.burst) == (100.0, 5.0)  # inherit
+    # draining tenant a's bucket leaves tenant b's tokens untouched
+    for _ in range(5):
+        sva.bucket.take()
+    assert not sva.bucket.take() and svb.bucket.take()
+
+    class _Plane:
+        shared_bucket = None
+
+    from minips_tpu.serve.admission import TokenBucket
+
+    _Plane.shared_bucket = TokenBucket(2, 1)
+    sva2 = TableServeState(ta, _Plane(), cfg)
+    svb2 = TableServeState(tb, _Plane(), cfg)
+    assert sva2.bucket is _Plane.shared_bucket
+    assert svb2.bucket is _Plane.shared_bucket  # the coupling, by design
+    assert sva2._rate == cfg.rate  # per-tenant rate ignored when shared
+
+
+def test_tenant_hedge_budget_rides_a_per_table_config_copy():
+    from minips_tpu.serve.hedge import HedgeConfig
+
+    cfg = HedgeConfig.parse("budget=4")
+    t = ShardedTable("t", 64, 2, _Bus(), 0, 2)
+    sp = TenantSpec("t", hedge=1)
+    sp.tid = 1
+    t.attach_tenant(sp)
+    t.attach_hedge(cfg)
+    assert t._hedge.budget == 1 and cfg.budget == 4  # copy, not mutate
+    # hedge=0: armed but the valve always sheds — never a crash
+    t0 = ShardedTable("t", 64, 2, _Bus(), 0, 2)
+    sp0 = TenantSpec("t", hedge=0)
+    sp0.tid = 1
+    t0.attach_tenant(sp0)
+    t0.attach_hedge(cfg)
+    assert t0._hedge.budget == 0
+    # no tenant override: the shared config object, untouched
+    t1 = ShardedTable("t", 64, 2, _Bus(), 0, 2)
+    t1.attach_hedge(cfg)
+    assert t1._hedge is cfg
+
+
+# ------------------------------------------------------- armed idle
+def test_armed_idle_lockstep_bitwise_equal_to_off_with_zero_counters():
+    """TENANT-IDLE at test scale: the bare default tenant must cost
+    nothing — identical final weights, zero losses, the stamp engaged
+    (nonzero tids) and every attributed counter at zero."""
+    from tests.test_chaos_reliable import run_bsp_lockstep
+
+    base, lost0 = run_bsp_lockstep()
+    st: dict = {}
+    armed, lost1 = run_bsp_lockstep(tenant="1", stats=st)
+    assert lost0 == [0, 0] and lost1 == [0, 0]
+    for w0, w1 in zip(base, armed):
+        np.testing.assert_array_equal(w0, w1)
+    assert st["tenant_tids"] == [1, 1], "stamp never engaged — vacuous"
+    assert st["tenant_counters"] == 0
+
+
+# -------------------------------------------------- isolation drills
+def _run_two_tenants(n, serve_spec, tenant_spec, *, staleness=2,
+                     steps=25, rows=96, dim=2):
+    """Threads-as-nodes two-table run: every rank pulls+pushes a hot
+    range on BOTH tables each step (the inf side read-heavy), tenancy
+    armed via the trainer kwarg. Returns (tables, trainers, finals)."""
+    buses = _mk_buses(n, reliable="1")
+    mk = lambda name, i: ShardedTable(name, rows, dim, buses[i], i, n,
+                                      updater="sgd", lr=1.0,
+                                      pull_timeout=20.0)
+    tabs = [{"trn": mk("trn", i), "inf": mk("inf", i)}
+            for i in range(n)]
+    trainers = [ShardedPSTrainer(tabs[i], buses[i], n,
+                                 staleness=staleness, gate_timeout=30.0,
+                                 serve=serve_spec, tenant=tenant_spec)
+                for i in range(n)]
+    finals: list = [None] * n
+    errs: list = []
+    hot = np.arange(24, dtype=np.int64)
+
+    def worker(r):
+        try:
+            for _i in range(steps):
+                for name in ("trn", "inf"):
+                    t = tabs[r][name]
+                    rows_ = t.pull(hot)
+                    t.push(hot, 0.01 * rows_ + 1.0)
+                    t.pull(hot)
+                trainers[r].tick()
+                time.sleep(0.002)
+            trainers[r].finalize(timeout=30.0)
+            finals[r] = {k: tabs[r][k].pull_all() for k in tabs[r]}
+        except Exception as e:  # noqa: BLE001 - surfaced via errs
+            import traceback
+
+            traceback.print_exc()
+            errs.append((r, repr(e)))
+
+    try:
+        ts = [threading.Thread(target=worker, args=(r,))
+              for r in range(n)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(timeout=120.0)
+        assert not any(th.is_alive() for th in ts), "run wedged"
+        assert not errs, errs
+        return tabs, trainers, finals
+    finally:
+        for b in buses:
+            b.close()
+
+
+SERVE = "replicas=2,hot=8,interval=0,min_heat=2,lease=2.0,rate=2,burst=1"
+
+
+def _counters(trainers, table, key):
+    return sum(tr.tables[table].tenant_counters[key] for tr in trainers)
+
+
+def test_isolated_buckets_shed_the_storm_tenant_only():
+    """The isolation invariant, end to end: with per-tenant buckets,
+    the throttled tenant sheds into ITS budget while the rate=0 tenant
+    never sheds, never throttles, and never has a leg force-admitted
+    (a shed on A must not travel through B's retry valve) — and no
+    read on either tenant violates its bound."""
+    tabs, trainers, finals = _run_two_tenants(
+        3, SERVE, "trn:rate=0;inf:rate=2,burst=1")
+    inf_denied = (_counters(trainers, "inf", "shed")
+                  + _counters(trainers, "inf", "throttle"))
+    assert inf_denied > 0, "storm never shed — the drill is vacuous"
+    for key in ("shed", "throttle", "stale_reads"):
+        assert _counters(trainers, "trn", key) == 0, key
+    for tr in trainers:
+        assert tr.tables["trn"]._sv.counters["forced_admits"] == 0
+        assert tr.tables["trn"]._sv.counters["stale_reads"] == 0
+        assert tr.tables["inf"]._sv.counters["stale_reads"] == 0
+        assert tr.frames_dropped == 0, tr.drop_detail()
+    for name in ("trn", "inf"):
+        np.testing.assert_array_equal(finals[0][name], finals[1][name])
+    # the done-line block names both tenants with the right attribution
+    ts = trainers[0].tenant_stats()
+    assert ts["shared"] == 0 and set(ts["tenants"]) == {"trn", "inf"}
+    assert ts["tenants"]["trn"]["tid"] != ts["tenants"]["inf"]["tid"]
+
+
+def test_shared_bucket_recouples_the_tenants():
+    """The contrast arm the bench measures: under ``shared=1`` the
+    fleet has ONE bucket, so the combined load drains tokens the quiet
+    tenant needed — its deny counters go nonzero. (rate=0 overrides
+    are deliberately ignored when shared: the arm exists to show the
+    coupling per-tenant buckets remove.)"""
+    tabs, trainers, finals = _run_two_tenants(
+        3, SERVE, "trn:rate=0;inf;shared=1")
+    trn_denied = (_counters(trainers, "trn", "shed")
+                  + _counters(trainers, "trn", "throttle"))
+    assert trn_denied > 0, \
+        "shared bucket never coupled — the contrast arm is vacuous"
+    assert _counters(trainers, "trn", "stale_reads") == 0
+    assert _counters(trainers, "inf", "stale_reads") == 0
+    assert trainers[0].tenant_stats()["shared"] == 1
+    for name in ("trn", "inf"):
+        np.testing.assert_array_equal(finals[0][name], finals[1][name])
+
+
+def test_wire_record_tenant_block_off_vs_idle():
+    """The done-line convention: tenancy OFF reports None; armed with
+    the bare default and nothing denied reports the zero-counter
+    block (per tenant, with its tid)."""
+    from minips_tpu.utils.metrics import wire_record
+
+    buses = _mk_buses(1)
+    try:
+        t = ShardedTable("t", 64, 2, buses[0], 0, 1)
+        tr = ShardedPSTrainer({"t": t}, buses[0], 1, staleness=0)
+        assert wire_record(tr)["tenant"] is None
+    finally:
+        for b in buses:
+            b.close()
+    buses = _mk_buses(1)
+    try:
+        t = ShardedTable("t", 64, 2, buses[0], 0, 1)
+        tr = ShardedPSTrainer({"t": t}, buses[0], 1, staleness=0,
+                              tenant="1")
+        blk = wire_record(tr)["tenant"]
+        assert blk["shared"] == 0
+        ten = blk["tenants"]["t"]
+        assert ten["tid"] == 1 and ten["overrides"] == {}
+        for k in ("shed", "throttle", "stale_reads", "hedge_denied"):
+            assert ten[k] == 0
+    finally:
+        for b in buses:
+            b.close()
