@@ -136,6 +136,11 @@ def _run(n: int, path: str, iters: int, warmup: int, bus: str,
     # multi-tenant tables ride their own sweep; an armed
     # MINIPS_TENANT must not stamp (and re-bucket) the other arms
     env_extra["MINIPS_TENANT"] = ""
+    # SLO burn accounting + the open-loop traffic driver ride the
+    # million_user sweep; an armed MINIPS_SLO would flex replica
+    # budgets (and pressure the autoscaler) under every other arm
+    env_extra["MINIPS_SLO"] = ""
+    env_extra["MINIPS_TRAFFIC"] = ""
     # the in-mesh collective plane rides its own sweep via --plane; an
     # armed MINIPS_MESH must not reroute (or refuse) the wire arms
     env_extra["MINIPS_MESH"] = ""
@@ -328,6 +333,7 @@ def fail_slow_arms(quick: bool = False) -> dict:
             "MINIPS_CHAOS_KILL": "", "MINIPS_PUSH_COMM": "",
             "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
             "MINIPS_TENANT": "",
+            "MINIPS_SLO": "", "MINIPS_TRAFFIC": "",
             "MINIPS_ELASTIC": "", "MINIPS_SLOW": "",
             "MINIPS_HEDGE": "", "MINIPS_OBS": "",
             "MINIPS_FLIGHT": "", "MINIPS_HEARTBEAT": "",
@@ -488,7 +494,8 @@ def tenant_arms(quick: bool = False) -> dict:
             "MINIPS_PUSH_COMM": "", "MINIPS_MESH": "",
             "MINIPS_AUTOSCALE": "", "MINIPS_RESHARD": "",
             "MINIPS_SLOW": "", "MINIPS_HEDGE": "",
-            "MINIPS_TENANT": ""}
+            "MINIPS_TENANT": "",
+            "MINIPS_SLO": "", "MINIPS_TRAFFIC": ""}
     # per-tenant buckets: trn's admission OFF (its SLO is throughput),
     # inf throttled into its own budget; inf reads at its OWN s=2
     # against the job's staleness=1
@@ -555,7 +562,8 @@ def tenant_arms(quick: bool = False) -> dict:
             cwd=os.path.dirname(os.path.abspath(__file__)),
             env={**os.environ, "MINIPS_FORCE_CPU": "1",
                  "JAX_PLATFORMS": "cpu", "MINIPS_MESH": "",
-                 "MINIPS_CHAOS": "", "MINIPS_TENANT": ""})
+                 "MINIPS_CHAOS": "", "MINIPS_TENANT": "",
+            "MINIPS_SLO": "", "MINIPS_TRAFFIC": ""})
         res = json.loads([ln for ln in proc.stdout.splitlines()
                           if ln.startswith("{")][-1])
         grid["idle"] = {"equal": bool(res.get("bitwise_equal")),
@@ -563,6 +571,219 @@ def tenant_arms(quick: bool = False) -> dict:
                             int(res.get("rows_checked", 0)),
                         "tenant_tids": res.get("tenant_tids"),
                         "tenant_counters": res.get("tenant_counters")}
+        if res.get("error"):
+            grid["idle"]["error"] = res["error"]
+    except Exception as e:  # noqa: BLE001 - the gate reads this
+        grid["idle"] = {"equal": False, "rows_checked": 0,
+                        "error": str(e)[:300]}
+    return grid
+
+
+def traffic_arms(quick: bool = False) -> dict:
+    """THE MILLION-USER SWEEP (million_user_3proc): the open-loop
+    traffic driver (apps/traffic_driver.py) replays seeded zipf user
+    streams against the ``inf`` table's ``pull_serving`` on a FIXED
+    arrival schedule — latency measured from scheduled arrival, so a
+    fleet that falls behind shows the queueing it caused instead of
+    silently offering less load — while every rank trains ``trn`` (and
+    a write stream into ``inf``) at a fixed step pace. Four arms:
+
+    - ``open_loop_base``: flat offered rate inside capacity — the
+      sched_ms/svc_ms pair should nearly agree, freshness lag samples
+      flow (TRAFFIC-FRESH's calibration leg);
+    - ``flash_crowd``: a mid-window rate spike (``crowd=``) against
+      replicas=1 + a tight read SLO — the crowd must degrade to
+      LATENCY (zero stale reads, zero poison, completion) while the
+      burning tenant's promotion budget provably flexes ABOVE the
+      configured replica count (max_budget > 1: the "replica budgets
+      ride demand" acceptance);
+    - ``overload_shed``: offered rate over the inf tenant's own
+      admission budget — sheds land in inf's attributed counters (trn
+      zero), and the burn edge leaves an ``slo_burn`` flight-recorder
+      box with zero pre-arming (TRAFFIC-SHED);
+    - ``idle``: the --traffic-idle-drill bitwise stamp (TRAFFIC-IDLE:
+      a rate-0 armed driver schedules and issues NOTHING).
+
+    Open-loop rates are offered, not achieved, so no arm publishes a
+    throughput point — the gates read latency quantiles, freshness
+    samples, budget maxima, and attributed counters (absolute checks,
+    never the run-to-run ±10% comparison)."""
+    import glob as _glob
+    import tempfile
+
+    from minips_tpu import launch as _launch
+
+    t_iters = 18 if quick else 40
+    warm = max(2, t_iters // 6)
+    timed_s = (t_iters - warm) * 0.1     # 100ms pace, the window below
+    tbase = [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
+             "--traffic-bench", "--path", "sparse",
+             "--iters", str(t_iters), "--warmup", str(warm),
+             "--batch", "128", "--rows", "4096",
+             # 100ms deadline pace: the timed window's wall clock IS
+             # the driver's schedule horizon, so the crowd's [at,
+             # at+dur) lands at a knowable second of the measurement
+             "--trn-step-ms", "100",
+             "--staleness", "1", "--updater", "sgd",
+             "--pull-timeout", "30"]
+    # replicas=1 deliberately: the flash-crowd arm's budget proof needs
+    # headroom ABOVE the configured count (3 live ranks, so a burning
+    # boost can grant 2 holders where calm grants 1)
+    serve = ("replicas=1,hot=16,topk=64,interval=0.05,min_heat=1")
+    tenant = "trn:rate=0;inf:s=2"
+    # fast=2/slow=4 rolls at the 100ms tick: burn verdicts settle in
+    # ~0.4s — inside even the quick arm's window
+    slo = "read_ms=5,shed_rate=2,fast=2,slow=4,boost=1"
+    env0 = {"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+            "MINIPS_CHAOS": "", "MINIPS_RELIABLE": "1",
+            "MINIPS_REBALANCE": "", "MINIPS_TRACE": "",
+            "MINIPS_SERVE": "", "MINIPS_BUS": "",
+            "MINIPS_WIRE_FMT": "", "MINIPS_ELASTIC": "",
+            "MINIPS_CHAOS_KILL": "", "MINIPS_HEARTBEAT": "",
+            "MINIPS_PUSH_COMM": "", "MINIPS_MESH": "",
+            "MINIPS_AUTOSCALE": "", "MINIPS_RESHARD": "",
+            "MINIPS_SLOW": "", "MINIPS_HEDGE": "",
+            "MINIPS_TENANT": "", "MINIPS_SLO": "",
+            "MINIPS_TRAFFIC": "", "MINIPS_FLIGHT": ""}
+    grid: dict = {"iters": t_iters, "timed_s": round(timed_s, 2),
+                  "serve_spec": serve, "tenant_spec": tenant,
+                  "slo_spec": slo}
+
+    def arm(traffic_spec: str, flight: str = "",
+            slo_spec: str = slo, tenant_spec: str = tenant) -> dict:
+        argv = list(tbase) + ["--serve", serve,
+                              "--tenant", tenant_spec,
+                              "--slo", slo_spec,
+                              "--traffic", traffic_spec]
+        env = dict(env0)
+        if flight:
+            env["MINIPS_FLIGHT"] = flight
+        try:
+            res = _launch.run_local_job(3, argv, base_port=None,
+                                        env_extra=env, timeout=240.0)
+        except Exception as e:  # noqa: BLE001 - completion-gated
+            return {"completed": False, "error": str(e)[:300]}
+        echoed = {r.get("traffic_spec") for r in res}
+        assert echoed == {traffic_spec}, (traffic_spec, echoed)
+        tr = [r.get("traffic") or {} for r in res]
+        fresh = [r.get("freshness") or {} for r in res]
+        fleet = [f.get("fleet") or {} for f in fresh]
+        slo_b = [r.get("slo") or {} for r in res]
+        tb = [r.get("tenant") or {} for r in res]
+        rep = [(r.get("serve") or {}).get("replica") for r in res]
+
+        def tcnt(tname: str, key: str) -> int:
+            return sum(((b.get("tenants") or {}).get(tname) or {})
+                       .get(key, 0) for b in tb)
+
+        def budget_max(tname: str) -> int:
+            return max((((b.get("tenants") or {}).get(tname) or {})
+                        .get("max_budget", 0)) for b in slo_b)
+
+        p99s = [((t.get("sched_ms") or {}).get("p99_ms") or 0.0)
+                for t in tr]
+        fp99 = [((f.get("lag") or {}).get("p99_ms") or 0.0)
+                for f in fleet]
+        out = {
+            "completed": all(r.get("event") == "done" for r in res),
+            # offered vs issued: unissued > 0 means the run ended
+            # with schedule left over (a gate problem, not a shed)
+            "scheduled": sum(t.get("scheduled", 0) for t in tr),
+            "requests": sum(t.get("requests", 0) for t in tr),
+            "unissued": sum(t.get("unissued", 0) for t in tr),
+            # summed dispatcher count: the gate's stop-boundary
+            # allowance (each thread abandons <= 1 claimed arrival)
+            "conc": sum(t.get("conc", 0) for t in tr),
+            "errors": sum(t.get("errors", 0) for t in tr),
+            "late_issues": sum(t.get("late_issues", 0) for t in tr),
+            # the honest tail (max across ranks): scheduled-arrival ->
+            # completion, next to bare service time
+            "sched_p99_ms": round(max(p99s), 3) if p99s else None,
+            "svc_p99_ms": round(max(
+                ((t.get("svc_ms") or {}).get("p99_ms") or 0.0)
+                for t in tr), 3),
+            # TRAFFIC-FRESH evidence: push-visible-at-replica lag
+            "freshness_samples": sum(f.get("lag_samples", 0)
+                                     for f in fleet),
+            "freshness_p99_ms": round(max(fp99), 3) if fp99 else None,
+            "stamped_frames": sum(f.get("stamped_frames", 0)
+                                  for f in fleet),
+            # SLO burn accounting + the budget-flex proof
+            "slo_burns": sum(b.get("burns", 0) for b in slo_b),
+            "slo_clears": sum(b.get("clears", 0) for b in slo_b),
+            "boost_ticks": sum(b.get("boost_ticks", 0)
+                               for b in slo_b),
+            "inf_max_budget": budget_max("inf"),
+            # tenant-attributed admission evidence (TRAFFIC-SHED)
+            "trn_denied": (tcnt("trn", "shed")
+                           + tcnt("trn", "throttle")),
+            "inf_denied": (tcnt("inf", "shed")
+                           + tcnt("inf", "throttle")),
+            "stale_reads": (tcnt("trn", "stale_reads")
+                            + tcnt("inf", "stale_reads")
+                            + sum((x or {}).get("stale_reads") or 0
+                                  for x in rep)),
+            "trn_rows_per_sec": round(
+                sum(r.get("trn_rows_per_sec", 0) for r in res), 1),
+            "wire_frames_lost": sum(r.get("wire_frames_lost", 0)
+                                    for r in res),
+            "frames_dropped": sum(r.get("frames_dropped", 0)
+                                  for r in res),
+        }
+        if flight:
+            files = sorted(_glob.glob(os.path.join(
+                flight, "flight-rank*.json")))
+            burn_events = []
+            for fp in files:
+                with open(fp) as fh:
+                    doc = json.load(fh)
+                burn_events += [e.get("args", {}).get("tenant")
+                                for e in doc.get("events", ())
+                                if e.get("kind") == "slo_burn"]
+            out["flight_dumps"] = len(files)
+            out["flight_slo_burns"] = len(burn_events)
+            out["flight_burn_tenants"] = sorted(
+                {t for t in burn_events if t})
+        return out
+
+    # schedule shapes: per-rank offered rates (3 ranks run one driver
+    # each); the crowd lands mid-window and must FIT inside it
+    c_at = round(timed_s * 0.3, 2)
+    c_for = round(timed_s * 0.3, 2)
+    base_spec = "rate=60,users=1000000,alpha=1.2,batch=8,conc=2,seed=11"
+    crowd_spec = base_spec + f",crowd={c_at}+{c_for}x8"
+    # overload: offered far above the inf bucket below — rate-limited
+    # admission sheds into inf's own budget, the burn edge dumps
+    overload_tenant = "trn:rate=0;inf:rate=20,burst=4,s=2"
+    grid["crowd"] = {"at": c_at, "for": c_for, "x": 8}
+    grid["open_loop_base"] = arm(base_spec)
+    grid["flash_crowd"] = arm(crowd_spec)
+    with tempfile.TemporaryDirectory() as fdir:
+        grid["overload_shed"] = arm(
+            "rate=400,users=1000000,alpha=1.2,batch=8,conc=4,seed=13",
+            flight=fdir, tenant_spec=overload_tenant)
+    grid["overload_tenant_spec"] = overload_tenant
+    # TRAFFIC-IDLE: rate-0 armed driver vs off, bitwise + zero issued
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "minips_tpu.apps.sharded_ps_bench",
+             "--traffic-idle-drill"],
+            capture_output=True, text=True, timeout=300.0,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env={**os.environ, "MINIPS_FORCE_CPU": "1",
+                 "JAX_PLATFORMS": "cpu", "MINIPS_MESH": "",
+                 "MINIPS_CHAOS": "", "MINIPS_TENANT": "",
+                 "MINIPS_SLO": "", "MINIPS_TRAFFIC": ""})
+        res = json.loads([ln for ln in proc.stdout.splitlines()
+                          if ln.startswith("{")][-1])
+        grid["idle"] = {"equal": bool(res.get("bitwise_equal")),
+                        "rows_checked":
+                            int(res.get("rows_checked", 0)),
+                        "traffic_requests":
+                            res.get("traffic_requests"),
+                        "traffic_scheduled":
+                            res.get("traffic_scheduled")}
         if res.get("error"):
             grid["idle"]["error"] = res["error"]
     except Exception as e:  # noqa: BLE001 - the gate reads this
@@ -621,6 +842,7 @@ def reshard_arms(quick: bool = False) -> dict:
             "MINIPS_PUSH_COMM": "", "MINIPS_MESH": "",
             "MINIPS_AUTOSCALE": "1", "MINIPS_OBS": "",
             "MINIPS_TENANT": "",
+            "MINIPS_SLO": "", "MINIPS_TRAFFIC": "",
             "MINIPS_FLIGHT": "", "MINIPS_SLOW": "",
             "MINIPS_HEDGE": "", "MINIPS_ELASTIC": "1",
             "MINIPS_RESHARD": ""}
@@ -832,6 +1054,7 @@ def hier_arms(quick: bool = False) -> dict:
             "MINIPS_CHAOS": "", "MINIPS_CHAOS_KILL": "",
             "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
             "MINIPS_TENANT": "",
+            "MINIPS_SLO": "", "MINIPS_TRAFFIC": "",
             "MINIPS_ELASTIC": "", "MINIPS_SLOW": "",
             "MINIPS_HEDGE": "", "MINIPS_OBS": "",
             "MINIPS_FLIGHT": "", "MINIPS_HEARTBEAT": "",
@@ -959,6 +1182,7 @@ def hybrid_arms(quick: bool = False) -> dict:
             "MINIPS_CHAOS": "", "MINIPS_CHAOS_KILL": "",
             "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
             "MINIPS_TENANT": "",
+            "MINIPS_SLO": "", "MINIPS_TRAFFIC": "",
             "MINIPS_ELASTIC": "", "MINIPS_SLOW": "",
             "MINIPS_HEDGE": "", "MINIPS_OBS": "",
             "MINIPS_FLIGHT": "", "MINIPS_HEARTBEAT": "",
@@ -1326,7 +1550,8 @@ def main() -> int:
                 "MINIPS_WIRE_FMT": "", "MINIPS_ELASTIC": "",
                 "MINIPS_CHAOS_KILL": "", "MINIPS_HEARTBEAT": "",
                 "MINIPS_PUSH_COMM": "", "MINIPS_MESH": "",
-                "MINIPS_TENANT": ""}
+                "MINIPS_TENANT": "",
+            "MINIPS_SLO": "", "MINIPS_TRAFFIC": ""}
         out: dict = {"iters": e_iters}
         for arm, comm in (("f32", "float32"), ("topk8", "topk8")):
             try:
@@ -1569,7 +1794,8 @@ def main() -> int:
                            "MINIPS_CHAOS_KILL": "",
                            "MINIPS_HEARTBEAT": "",
                            "MINIPS_PUSH_COMM": "", "MINIPS_MESH": "",
-                           "MINIPS_TENANT": ""},
+                           "MINIPS_TENANT": "",
+            "MINIPS_SLO": "", "MINIPS_TRAFFIC": ""},
                 timeout=timeout)
         except Exception as e:  # noqa: BLE001 - completion-gated arms
             return {"completed": False, "error": str(e)[:300]}
@@ -1661,6 +1887,7 @@ def main() -> int:
                 "MINIPS_HEARTBEAT": "", "MINIPS_PUSH_COMM": "",
                 "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
             "MINIPS_TENANT": "",
+            "MINIPS_SLO": "", "MINIPS_TRAFFIC": "",
                 "MINIPS_OBS": "", "MINIPS_FLIGHT": ""}
         kill_step = max(2, e_iters // 3)
         grid: dict = {"iters": e_iters, "kill_step": kill_step}
@@ -1781,6 +2008,7 @@ def main() -> int:
                 "MINIPS_HEARTBEAT": "", "MINIPS_PUSH_COMM": "",
                 "MINIPS_MESH": "", "MINIPS_AUTOSCALE": "",
             "MINIPS_TENANT": "",
+            "MINIPS_SLO": "", "MINIPS_TRAFFIC": "",
                 "MINIPS_OBS": "", "MINIPS_FLIGHT": ""}
         grid: dict = {"iters": c_iters}
 
@@ -1982,7 +2210,8 @@ def main() -> int:
                 "MINIPS_WIRE_FMT": "", "MINIPS_CHAOS_KILL": "",
                 "MINIPS_PUSH_COMM": "", "MINIPS_MESH": "",
                 "MINIPS_AUTOSCALE": "", "MINIPS_OBS": "",
-                "MINIPS_FLIGHT": "", "MINIPS_TENANT": ""}
+                "MINIPS_FLIGHT": "", "MINIPS_TENANT": "",
+            "MINIPS_SLO": "", "MINIPS_TRAFFIC": ""}
         grid: dict = {"iters": p_iters}
 
         def rate(dones: list[dict]) -> float:
@@ -2323,6 +2552,16 @@ def main() -> int:
     # TENANT-IDLE wants the bare-default-tenant lockstep bitwise
     tenant_grid = tenant_arms(quick=args.quick)
 
+    # THE MILLION-USER SWEEP (this PR): an open-loop zipf traffic
+    # driver on a fixed arrival schedule against pull_serving while
+    # training runs — TRAFFIC-FRESH wants the flash crowd degrading to
+    # latency (zero stale reads, bounded freshness p99, replica budget
+    # provably flexed above its configured count); TRAFFIC-SHED wants
+    # overload shedding into the inf tenant's own budget with an
+    # slo_burn flight event; TRAFFIC-IDLE wants the rate-0 armed
+    # driver bitwise-identical to off with zero requests scheduled
+    traffic_grid = traffic_arms(quick=args.quick)
+
     # resolved JAX backend stamp (satellite): probed in a SUBPROCESS so
     # the driver never grabs the TPU out from under a worker (libtpu is
     # exclusive per process) — ci/bench_regression.py refuses to
@@ -2392,6 +2631,7 @@ def main() -> int:
         "hier_agg_3proc": hier_grid,
         "hybrid_agg_3proc": hybrid_grid,
         "multi_tenant_3proc": tenant_grid,
+        "million_user_3proc": traffic_grid,
         "mesh_plane_fused": mesh_grid,
     }))
     return 0

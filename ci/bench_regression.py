@@ -1552,6 +1552,185 @@ def tenant_tripwires(new: dict) -> list[str]:
     return problems
 
 
+def traffic_tripwires(new: dict) -> list[str]:
+    """Absolute (prior-free) gates on the ``million_user_3proc`` sweep
+    (the open-loop traffic driver + freshness/SLO observability —
+    apps/traffic_driver.py, obs/freshness.py, obs/slo.py); vacuous
+    when the sweep is absent.
+
+    - TRAFFIC-FRESH: the base and flash-crowd arms must complete with
+      zero request errors, zero stale reads, and zero lost/dropped
+      frames (the crowd degrades to LATENCY, never to staleness or
+      poison), and both must put ``unissued`` ON THE RECORD —
+      arrivals the run ended before issuing are coordinated omission
+      unless counted. The BASE arm must issue its whole schedule up
+      to a stop-boundary sliver (each dispatcher abandons at most the
+      one arrival it had claimed when the run's deadline stopped the
+      driver, so the allowance is the summed dispatcher count plus 1%
+      of the schedule — more means the base rate was NOT sustainable
+      and every latency claim downstream rode an unintended
+      overload); the
+      CROWD arm may legitimately end with backlog (bounded ``conc``
+      cannot drain an 8x burst before the run ends) but its
+      scheduled-arrival p99 must sit STRICTLY above bare service p99
+      — the queueing delay a closed-loop driver would omit is the
+      whole point of the open-loop measurement. Freshness lag samples
+      must flow (> 0, with a sane p99 — minutes would mean the stamp
+      plumbing broke) and the crowd arm's burning tenant must show
+      its promotion budget flexed ABOVE the configured replica count
+      (max_budget > configured — "replica budgets ride demand", the
+      autoscaler/plane half of ROADMAP item 4).
+    - TRAFFIC-SHED: the overload arm's sheds must land in the
+      storming tenant's OWN attributed counters (inf denied > 0, trn
+      denied = 0) and the burn edge must leave an ``slo_burn``
+      flight-recorder box naming that tenant (zero pre-arming: the
+      violation IS the post-mortem).
+    - TRAFFIC-IDLE: the rate=0 armed driver must be bitwise-equal to
+      traffic-off over > 0 rows with ZERO requests scheduled or
+      issued — arming the layer may not perturb one bit or one read."""
+    grid = new.get("million_user_3proc") or {}
+    if not grid:
+        return []
+    problems = []
+    arms = {a: grid.get(a) or {} for a in ("open_loop_base",
+                                           "flash_crowd",
+                                           "overload_shed")}
+    for name, arm in arms.items():
+        if not arm.get("completed"):
+            problems.append(
+                f"TRAFFIC-FRESH million_user_3proc/{name}: completed="
+                f"{arm.get('completed')!r} — every arm must finish "
+                "(offered load is bounded, overload is shed not fatal)"
+                + (f" error={arm.get('error')!r}"
+                   if arm.get("error") else ""))
+            continue
+        if arm.get("stale_reads", 0):
+            problems.append(
+                f"TRAFFIC-FRESH million_user_3proc/{name}: "
+                f"{arm['stale_reads']} stale reads — the crowd must "
+                "degrade to latency, never to staleness")
+        if arm.get("wire_frames_lost", 0) or arm.get(
+                "frames_dropped", 0):
+            problems.append(
+                f"TRAFFIC-FRESH million_user_3proc/{name}: "
+                f"wire_frames_lost={arm.get('wire_frames_lost')!r} "
+                f"frames_dropped={arm.get('frames_dropped')!r} — "
+                "serving load must not poison the training plane")
+    # the latency-not-loss leg: base + crowd issue their WHOLE
+    # schedule with zero request errors and live freshness samples
+    for name in ("open_loop_base", "flash_crowd"):
+        arm = arms[name]
+        if not arm.get("completed"):
+            continue
+        if not arm.get("scheduled"):
+            problems.append(
+                f"TRAFFIC-FRESH million_user_3proc/{name}: "
+                "scheduled=0 — the driver never armed, the arm "
+                "proves nothing")
+        if "unissued" not in arm:
+            problems.append(
+                f"TRAFFIC-FRESH million_user_3proc/{name}: unissued "
+                "not recorded — arrivals the run ended before "
+                "issuing are silent coordinated omission unless "
+                "they are counted on the record")
+        if arm.get("errors", 0):
+            problems.append(
+                f"TRAFFIC-FRESH million_user_3proc/{name}: "
+                f"errors={arm.get('errors')!r} — issued requests "
+                "must succeed (latency absorbs the crowd, not "
+                "failed requests)")
+        if not arm.get("freshness_samples"):
+            problems.append(
+                f"TRAFFIC-FRESH million_user_3proc/{name}: "
+                "freshness_samples=0 — push-visible-at-replica lag "
+                "never measured (stamp plumbing or replication broke)")
+        elif not (isinstance(arm.get("freshness_p99_ms"),
+                             (int, float))
+                  and 0 < arm["freshness_p99_ms"] < 60_000):
+            problems.append(
+                f"TRAFFIC-FRESH million_user_3proc/{name}: "
+                f"freshness_p99_ms={arm.get('freshness_p99_ms')!r} — "
+                "visibility lag must be live and under a minute "
+                "(refresh-interval-scale, not backlog-scale)")
+    base = arms["open_loop_base"]
+    if base.get("completed"):
+        sliver = (base.get("conc", 0)
+                  + max(1, base.get("scheduled", 0) // 100))
+        if base.get("unissued", 0) > sliver:
+            problems.append(
+                f"TRAFFIC-FRESH million_user_3proc/open_loop_base: "
+                f"unissued={base['unissued']!r} > stop-boundary "
+                f"allowance {sliver} — the base rate must be "
+                "sustainable: open-loop arrivals must ALL issue, or "
+                "every latency claim downstream rode an unintended "
+                "overload")
+    crowd = arms["flash_crowd"]
+    if crowd.get("completed"):
+        sp = crowd.get("sched_p99_ms")
+        vp = crowd.get("svc_p99_ms")
+        if not (isinstance(sp, (int, float))
+                and isinstance(vp, (int, float)) and sp > vp):
+            problems.append(
+                f"TRAFFIC-FRESH million_user_3proc/flash_crowd: "
+                f"sched_p99_ms={sp!r} svc_p99_ms={vp!r} — the "
+                "crowd's backlog must show up as queueing delay in "
+                "the scheduled-arrival tail; matching tails mean "
+                "the crowd never outran the fleet and the open-loop "
+                "measurement proved nothing")
+        if not (isinstance(crowd.get("inf_max_budget"), int)
+                and crowd["inf_max_budget"] > 1):
+            problems.append(
+                f"TRAFFIC-FRESH million_user_3proc/flash_crowd: "
+                f"inf_max_budget={crowd.get('inf_max_budget')!r} "
+                "never exceeded the configured 1 replica — the SLO "
+                "burn must provably flex the promotion budget")
+        if not crowd.get("slo_burns"):
+            problems.append(
+                "TRAFFIC-FRESH million_user_3proc/flash_crowd: "
+                "slo_burns=0 — the crowd never tripped the burn "
+                "accounting, the budget-flex 'proof' is vacuous")
+    over = arms["overload_shed"]
+    if over.get("completed"):
+        if not over.get("inf_denied"):
+            problems.append(
+                "TRAFFIC-SHED million_user_3proc/overload_shed: "
+                "inf_denied=0 — overload never shed into the "
+                "storming tenant's budget (admission disarmed)")
+        if over.get("trn_denied", 0):
+            problems.append(
+                f"TRAFFIC-SHED million_user_3proc/overload_shed: "
+                f"trn_denied={over['trn_denied']} — the training "
+                "tenant was charged for serving overload")
+        if not over.get("flight_slo_burns"):
+            problems.append(
+                "TRAFFIC-SHED million_user_3proc/overload_shed: no "
+                "slo_burn flight events — the burn edge left no "
+                "post-mortem box (checkpoint plumbing broke)")
+        elif "inf" not in (over.get("flight_burn_tenants") or []):
+            problems.append(
+                f"TRAFFIC-SHED million_user_3proc/overload_shed: "
+                f"flight_burn_tenants="
+                f"{over.get('flight_burn_tenants')!r} — the burn "
+                "box does not name the burning tenant")
+    idle = grid.get("idle") or {}
+    if not idle.get("equal") or not idle.get("rows_checked"):
+        problems.append(
+            f"TRAFFIC-IDLE million_user_3proc/idle: equal="
+            f"{idle.get('equal')!r} rows_checked="
+            f"{idle.get('rows_checked')!r}"
+            + (f" error={idle.get('error')!r}" if idle.get("error")
+               else "")
+            + " — a rate=0 armed driver must be bitwise-equal to off")
+    elif idle.get("traffic_requests", 1) or idle.get(
+            "traffic_scheduled", 1):
+        problems.append(
+            f"TRAFFIC-IDLE million_user_3proc/idle: "
+            f"traffic_requests={idle.get('traffic_requests')!r} "
+            f"traffic_scheduled={idle.get('traffic_scheduled')!r} — "
+            "armed-IDLE means an empty schedule and zero issues")
+    return problems
+
+
 def mesh_tripwires(new: dict) -> list[str]:
     """Absolute (prior-free) gates on the ``mesh_plane_fused`` sweep
     (the in-mesh collective data plane, train/mesh_plane.py); vacuous
@@ -1776,6 +1955,7 @@ def main(argv: list[str] | None = None) -> int:
                 + reshard_tripwires(new)
                 + hier_tripwires(new) + hybrid_tripwires(new)
                 + tenant_tripwires(new)
+                + traffic_tripwires(new)
                 + mesh_tripwires(new))
     pts = throughput_points(new)
     print(f"bench-regression: {len(pts)} throughput points checked "

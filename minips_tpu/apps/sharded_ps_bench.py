@@ -162,6 +162,47 @@ def _run_tenant_idle_drill() -> int:
     return 0 if out["bitwise_equal"] else 1
 
 
+def _run_traffic_idle_drill() -> int:
+    """TRAFFIC-IDLE: the BSP lockstep drill with the open-loop traffic
+    driver ARMED at rate=0 vs off — armed-but-idle must be BITWISE
+    equal (an empty schedule issues nothing; the dispatcher threads
+    start, find no arrivals, and exit) with the stamp provably engaged
+    (driver constructed and started) and zero issued requests. Emits
+    one JSON stamp line; failures report ``bitwise_equal: false`` so
+    the CI gate fails loudly instead of silently skipping."""
+    out = {"event": "drill", "bitwise_equal": False, "rows_checked": 0,
+           "traffic_requests": None, "traffic_scheduled": None}
+    try:
+        import minips_tpu
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(minips_tpu.__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from tests.test_chaos_reliable import run_bsp_lockstep
+
+        w_off, lost_off = run_bsp_lockstep(backend="zmq")
+        st: dict = {}
+        w_on, lost_on = run_bsp_lockstep(
+            backend="zmq", traffic="rate=0,users=1000000", stats=st)
+        eq = all(np.array_equal(a, b) for a, b in zip(w_off, w_on))
+        out.update({
+            "bitwise_equal": bool(eq) and lost_off == lost_on == [0, 0]
+            and st.get("traffic_requests") == 0
+            and st.get("traffic_scheduled") == 0,
+            "rows_checked": int(sum(a.shape[0] for a in w_off)),
+            # evidence the armed arm really armed (the driver ran) and
+            # really idled (zero scheduled arrivals, zero issued) —
+            # the gate checks the stamps, not just the verdict
+            "traffic_requests": st.get("traffic_requests"),
+            "traffic_scheduled": st.get("traffic_scheduled"),
+        })
+    except Exception as e:  # noqa: BLE001 - the gate reads the stamp
+        out["error"] = repr(e)[:300]
+    print(json.dumps(out), flush=True)
+    return 0 if out["bitwise_equal"] else 1
+
+
 def _run_reshard_mem_drill() -> int:
     """RESHARD-MEM: the streaming N->M checkpoint reshard (mover (c),
     ckpt/elastic.reshard_table_state) at a RAM-visible table size —
@@ -428,6 +469,139 @@ def _run_tenant_bench(args) -> int:
         "rows": args.rows, "dim": args.dim, "batch": B,
         "iters_timed": timed,
         # the protected number: the training tenant's pull+push rows
+        "trn_rows_per_sec": round(trn_rows / dt, 1),
+        "wall_s": round(dt, 4),
+    }), flush=True)
+    if monitor is not None:
+        monitor.stop()
+    bus.close()
+    return 0
+
+
+def _run_traffic_bench(args) -> int:
+    """MINIPS_TRAFFIC bench mode (million_user_3proc): the open-loop
+    driver (apps/traffic_driver.py) replays a precomputed zipf-user
+    arrival schedule against the ``inf`` table's ``pull_serving``
+    while every rank trains the ``trn`` table at the ``--trn-step-ms``
+    deadline pace — serving load that arrives whether or not the fleet
+    keeps up, measured from SCHEDULED arrival (coordinated-omission-
+    free), with training running concurrently the whole time. The
+    ``--traffic`` spec decides the arm (flat base, diurnal ramp, flash
+    crowd); ``--slo`` arms burn-rate accounting so a crowd provably
+    flexes the replica budget and an overload provably sheds into the
+    tenant's own budget with a flight-recorder ``slo_burn`` box. One
+    done line carries the driver's record (sched_ms is the honest
+    number), trn's pace-kept rate, and the full wire_record (the
+    ``freshness``/``slo`` blocks are the gate's evidence)."""
+    from minips_tpu.apps.common import init_multiproc, table_wire_kwargs
+    from minips_tpu.apps.traffic_driver import TrafficDriver
+    from minips_tpu.apps.traffic_driver import maybe_config as _traffic
+    from minips_tpu.train.sharded_ps import (ShardedPSTrainer,
+                                             ShardedTable)
+    from minips_tpu.utils.metrics import wire_record
+
+    rank, nprocs, bus, monitor, _ = init_multiproc("asp", 0)
+    if nprocs < 2:
+        print(json.dumps({"rank": 0, "event": "error",
+                          "err": "--traffic-bench needs the launcher "
+                                 "(n >= 2): the serve plane needs "
+                                 "peers"}), flush=True)
+        return 2
+    tcfg = _traffic(args.traffic)
+    if tcfg is None:
+        print(json.dumps({"rank": rank, "event": "error",
+                          "err": "--traffic-bench needs an armed "
+                                 "--traffic/MINIPS_TRAFFIC spec"}),
+              flush=True)
+        return 2
+
+    def mk(name: str) -> ShardedTable:
+        return ShardedTable(name, args.rows, args.dim, bus, rank,
+                            nprocs, updater=args.updater, lr=0.05,
+                            pull_timeout=args.pull_timeout,
+                            monitor=monitor, **table_wire_kwargs(args))
+
+    tables = {"trn": mk("trn"), "inf": mk("inf")}
+    trainer = ShardedPSTrainer(tables, bus, nprocs,
+                               staleness=args.staleness,
+                               gate_timeout=60.0, monitor=monitor,
+                               serve=args.serve, tenant=args.tenant,
+                               slo=args.slo)
+    bus.handshake(nprocs)
+
+    rng = np.random.default_rng(rank)
+    B, dim = args.batch, args.dim
+    grads = rng.normal(size=(B, dim)).astype(np.float32)
+    # deadline pacing defines the run's wall clock, so the driver's
+    # schedule horizon is exactly the timed window — the crowd lands
+    # at a knowable second of the measurement, not of the warmup
+    pace = args.trn_step_ms / 1e3
+    timed = args.iters - args.warmup
+    duration = timed * pace
+    driver = TrafficDriver(tcfg, tables["inf"].pull_serving,
+                           args.rows, duration_s=duration)
+    # trn trains a steady write load into the INF table too (small
+    # batches) so the serving reads have fresh pushes to be stale
+    # AGAINST — freshness lag is only measurable on a written table
+    inf = tables["inf"]
+    inf_keys = rng.integers(0, args.rows, size=max(B // 4, 1))
+    inf_grads = rng.normal(size=(len(inf_keys), dim)
+                           ).astype(np.float32)
+
+    trn = tables["trn"]
+    trn_rows = 0
+    t0 = 0.0
+    next_t = time.perf_counter()
+    for i in range(args.iters):
+        if i == args.warmup:
+            trn_rows = 0
+            t0 = time.perf_counter()
+            next_t = t0
+            driver.start()  # schedule t=0 is the warmup boundary
+        keys = rng.integers(0, args.rows, size=B)
+        trn.pull(keys)
+        trn.push(keys, grads)
+        inf.push(inf_keys, inf_grads)  # the freshness write stream
+        trn_rows += 2 * B
+        trainer.tick()
+        if pace > 0:
+            next_t += pace
+            slack = next_t - time.perf_counter()
+            if slack > 0:
+                time.sleep(slack)
+            else:
+                next_t = time.perf_counter()
+    dt = time.perf_counter() - t0
+    # stop the driver BEFORE finalize (post-finalize agreement is
+    # exact; a still-running dispatcher would race the quiesce)
+    driver.stop()
+    trainer.finalize(timeout=60.0)
+    assert trainer.frames_dropped == 0, trainer.drop_detail()
+    trainer.shutdown_barrier(timeout=15.0)
+
+    print(json.dumps({
+        "rank": rank, "event": "done", "mode": "traffic_bench",
+        "nprocs": nprocs,
+        "traffic_spec": (args.traffic
+                         or os.environ.get("MINIPS_TRAFFIC") or None),
+        "slo_spec": (args.slo or os.environ.get("MINIPS_SLO") or None),
+        "tenant_spec": (args.tenant
+                        or os.environ.get("MINIPS_TENANT") or None),
+        "serve_spec": (args.serve or os.environ.get("MINIPS_SERVE")
+                       or None),
+        "trn_step_ms": args.trn_step_ms,
+        # the driver's full open-loop record: scheduled/issued/late
+        # counts, sched_ms (scheduled-arrival -> done — the honest
+        # tail) next to svc_ms (issue -> done)
+        "traffic": driver.record(),
+        "staleness": (None if args.staleness == float("inf")
+                      else int(args.staleness)),
+        "reliable_on": os.environ.get("MINIPS_RELIABLE", "")
+        not in ("", "0"),
+        **wire_record(trainer),
+        "rows": args.rows, "dim": args.dim, "batch": B,
+        "iters_timed": timed,
+        # the protected number: the training tenant's pace-kept rows
         "trn_rows_per_sec": round(trn_rows / dt, 1),
         "wall_s": round(dt, 4),
     }), flush=True)
@@ -726,6 +900,34 @@ def main(argv=None) -> int:
                          "--tenant decides the arm (per-tenant "
                          "buckets vs shared=1 vs storm-off solo). "
                          "The multi_tenant_3proc sweep's worker")
+    ap.add_argument("--slo", default=None, metavar="SPEC",
+                    help="arm SLO burn-rate accounting (MINIPS_SLO "
+                         "grammar, obs/slo.py) on this worker's "
+                         "trainer — the flag spelling; the env works "
+                         "too (flag wins). Burning tenants flex the "
+                         "serve plane's promotion budget and feed the "
+                         "autoscaler's arming pressure")
+    ap.add_argument("--traffic", default=None, metavar="SPEC",
+                    help="open-loop traffic spec (MINIPS_TRAFFIC "
+                         "grammar, apps/traffic_driver.py) for "
+                         "--traffic-bench — zipf user population, "
+                         "base rate, diurnal ramp, flash crowd; the "
+                         "env spelling works too (flag wins)")
+    ap.add_argument("--traffic-bench", action="store_true",
+                    help="open-loop serving mode: the traffic driver "
+                         "replays a precomputed arrival schedule "
+                         "against an 'inf' table's pull_serving "
+                         "(latency measured from SCHEDULED arrival — "
+                         "coordinated-omission-free) while a 'trn' "
+                         "table trains at the --trn-step-ms pace; "
+                         "--traffic decides the arm (flat / ramp / "
+                         "flash crowd), --slo arms burn accounting. "
+                         "The million_user_3proc sweep's worker")
+    ap.add_argument("--traffic-idle-drill", action="store_true",
+                    help="run the BSP lockstep drill with the traffic "
+                         "driver armed at rate=0 vs off and emit its "
+                         "bitwise stamp + scheduled/issued evidence "
+                         "(the artifact's TRAFFIC-IDLE input)")
     ap.add_argument("--tenant-idle-drill", action="store_true",
                     help="run the BSP lockstep drill with the bare "
                          "default tenant (MINIPS_TENANT=1) vs off "
@@ -750,6 +952,17 @@ def main(argv=None) -> int:
         return _run_fail_slow_idle_drill()
     if args.tenant_idle_drill:
         return _run_tenant_idle_drill()
+    if args.traffic_idle_drill:
+        return _run_traffic_idle_drill()
+    if args.traffic_bench:
+        if args.path != "sparse" or args.compute != "none":
+            ap.error("--traffic-bench measures the open-loop serve "
+                     "path — drop --path dense/--compute")
+        if args.trn_step_ms <= 0:
+            ap.error("--traffic-bench needs --trn-step-ms > 0: the "
+                     "paced training window defines the arrival "
+                     "schedule's horizon")
+        return _run_traffic_bench(args)
     if args.tenant_bench:
         if args.path != "sparse" or args.compute != "none":
             ap.error("--tenant-bench measures tenant isolation on the "
@@ -911,16 +1124,35 @@ def main(argv=None) -> int:
     storm_errs: list = []
     storm_counts = [0] * max(args.storm, 1)
     storm_threads: list = []
+    # coordinated-omission fix: each reader keeps an INTENDED-arrival
+    # schedule (next_t += think, never reset from completion) and
+    # records completion - intended next to bare service time. The old
+    # accounting slept AFTER each completion, so a slow read silently
+    # pushed every later request's start — the classic closed-loop
+    # self-throttle that under-reports the tail exactly under load.
+    # Both hists ride the done line (read_intended_ms / read_svc_ms).
+    from minips_tpu.obs.hist import (Log2Histogram,
+                                     summarize_counts as _sum_counts)
+
+    storm_hist_intended = Log2Histogram()
+    storm_hist_svc = Log2Histogram()
 
     def _storm_reader(j: int) -> None:
         rrng = np.random.default_rng((rank, j, 1717))
         SB = args.storm_batch
         think = args.storm_think_ms / 1e3
+        next_t = time.perf_counter()
         while not storm_stop.is_set():
             if think > 0:
-                time.sleep(think)
+                next_t += think
+                slack = next_t - time.perf_counter()
+                if slack > 0 and storm_stop.wait(slack):
+                    return
+            else:
+                next_t = time.perf_counter()
             keys = (zipf_sample(rrng, SB) if zipf_sample is not None
                     else rrng.integers(0, args.rows, size=SB))
+            t1 = time.perf_counter()
             try:
                 # the serving read clock: admission already proven
                 # fleet-wide, so reads never park on the in-flight step
@@ -929,6 +1161,9 @@ def main(argv=None) -> int:
                 if not storm_stop.is_set():
                     storm_errs.append(repr(e))
                 return
+            t2 = time.perf_counter()
+            storm_hist_intended.record_s(t2 - next_t)
+            storm_hist_svc.record_s(t2 - t1)
             storm_counts[j] += SB
 
     if args.storm:
@@ -1052,6 +1287,16 @@ def main(argv=None) -> int:
         "read_rows": int(read_rows) if args.storm else None,
         "read_rows_per_sec": (round(read_rows / dt, 1) if args.storm
                               else None),
+        # storm read latency, TWO ways (schema note): read_intended_ms
+        # measures from each request's INTENDED arrival (think-paced
+        # schedule, coordinated-omission-free — the honest tail);
+        # read_svc_ms is bare service time (issue -> completion, the
+        # only number the old accounting kept). intended >= svc always;
+        # a large gap means the closed loop was self-throttling.
+        "read_intended_ms": (_sum_counts(storm_hist_intended.snapshot())
+                             if args.storm else None),
+        "read_svc_ms": (_sum_counts(storm_hist_svc.snapshot())
+                        if args.storm else None),
         "staleness": (None if args.staleness == float("inf")
                       else int(args.staleness)),
         "cache_bytes": args.cache_bytes,

@@ -233,6 +233,25 @@ class Autoscaler:
                     self.counters.get("slow_pressure_ticks", 0) + 1
         return nslow * self.cfg.up_shed
 
+    def _slo_pressure(self) -> float:
+        """SLO-burn coupling (obs/slo.py): a tenant burning its error
+        budget on BOTH windows is demand pressure even before a bucket
+        refuses — the serving plane's promotion budget flexes replicas
+        immediately (serve/plane.py), and this is the rank half of the
+        same signal. One arming quantum per burning tenant per tick,
+        folded into the HOT decision ONLY, counted apart (the
+        ``_slow_pressure`` contract: never into ``sheds_seen`` or the
+        streak-rate evidence); gone the roll the burn clears."""
+        sl = getattr(self.trainer, "slo_tracker", None)
+        if sl is None:
+            return 0.0
+        nburn = sl.pressure_quanta()
+        if nburn:
+            with self._lock:
+                self.counters["slo_pressure_ticks"] = \
+                    self.counters.get("slo_pressure_ticks", 0) + 1
+        return nburn * self.cfg.up_shed
+
     # --------------------------------------------------------------- tick
     def on_tick(self) -> None:
         """Called from ``ShardedPSTrainer.tick`` just before the
@@ -250,7 +269,8 @@ class Autoscaler:
             self.counters["sheds_seen"] += int(shed_d)
         self.p99_last_ms = p99
         cfg = self.cfg
-        hot = (shed_d + self._slow_pressure() >= cfg.up_shed
+        hot = (shed_d + self._slow_pressure() + self._slo_pressure()
+               >= cfg.up_shed
                or (cfg.up_p99_ms > 0 and p99 is not None
                    and p99 >= cfg.up_p99_ms)
                or (cfg.imb > 0 and ratio >= cfg.imb))

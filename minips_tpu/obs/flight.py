@@ -476,6 +476,17 @@ def merge_dumps(dumps: dict[int, dict]) -> tuple[dict, dict]:
         except TypeError:
             return 0
 
+    # per-tenant SLO burn rollup (obs/slo.py edges): the burn edge is
+    # WHY most of these boxes exist, so the summary names the burning
+    # tenants instead of leaving the operator to grep the timeline
+    slo_burns: dict = {}
+    for e in rows:
+        if e["kind"] != "slo_burn":
+            continue
+        a = e.get("args")
+        tenant = a.get("tenant") if isinstance(a, dict) else None
+        slo_burns[tenant or "?"] = slo_burns.get(tenant or "?", 0) + 1
+
     summary = {
         "ranks": sorted(dumps),
         "events": sum(n_events(d) for d in dumps.values()),
@@ -485,6 +496,7 @@ def merge_dumps(dumps: dict[int, dict]) -> tuple[dict, dict]:
                              for r, o in sorted(offsets.items())},
         "unaligned_ranks": unaligned,
         "malformed_ranks": malformed,
+        "slo_burns": slo_burns,
     }
     doc = {"flight": rows, "windows": {str(r): d.get("window")
                                        for r, d in sorted(dumps.items())},
@@ -529,6 +541,10 @@ def main(argv: Optional[list[str]] = None) -> int:
         mark = " !POISON" if e["poison"] else ""
         print(f"+{(e['t_us'] - t0) / 1e6:10.4f}s  rank{e['rank']}  "
               f"{e['kind']}{mark}{args_s}")
+    if summary["slo_burns"]:
+        burns = ", ".join(f"{t} x{n}" for t, n in
+                          sorted(summary["slo_burns"].items()))
+        print(f"flight: SLO burn edges on this timeline: {burns}")
     if args.out:
         tmp = args.out + ".tmp"
         with open(tmp, "w") as f:

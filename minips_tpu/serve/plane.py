@@ -73,6 +73,7 @@ import numpy as np
 from minips_tpu.consistency.gate import admits
 from minips_tpu.obs import flight as _fl
 from minips_tpu.obs import tracer as _trc
+from minips_tpu.obs.freshness import FreshnessTracker
 from minips_tpu.obs.hist import Log2Histogram, merge_counts, slo_check
 from minips_tpu.serve.admission import TokenBucket
 
@@ -194,6 +195,13 @@ class TableServeState:
         # owner role: granted block -> holder set, dirty key sets
         self._granted: dict[int, tuple[int, ...]] = {}
         self._dirty: dict[int, set[int]] = {}
+        # freshness (obs/freshness.py): per dirty block, the monotonic
+        # time of the FIRST push since the last refresh — the refresh
+        # head's ``fts`` stamp is the min over the blocks it ships, so
+        # the replica's ``now - fts`` is the oldest-contained-push
+        # visibility lag
+        self._dirty_t0: dict[int, float] = {}
+        self.fresh = FreshnessTracker()
         self._ow_lock = threading.Lock()
         self._t_last_refresh = 0.0
         self._stopped = False
@@ -331,15 +339,35 @@ class TableServeState:
         tsp = getattr(t, "_tenant", None)
         if tsp is not None and tsp.replicas is not None:
             nrep = tsp.replicas  # per-tenant replica budget
+        # SLO burn feeds the promotion budget (obs/slo.py): a burning
+        # tenant's tables get ``boost`` extra replicas while the burn
+        # lasts — the replica budget rides demand, not just rank count
+        sl = getattr(getattr(self.plane, "trainer", None),
+                     "slo_tracker", None)
+        boost = sl.replica_boost(t.name) if sl is not None else 0
+        budget = min(nrep + boost, len(live))
         holders = tuple(sorted(
-            {live[(t.rank + j) % len(live)]
-             for j in range(min(nrep, len(live)))}))
+            {live[(t.rank + j) % len(live)] for j in range(budget)}))
+        if sl is not None:
+            sl.note_budget(t.name, len(holders))
         with self._ow_lock:
             fresh = [b for b in hot if b not in self._granted]
+            # budget up-flex: already-granted hot blocks whose holder
+            # set is a strict subset of the boosted one re-grant (a
+            # full snapshot to a holder that already has one is an
+            # idempotent install); down-flex just shrinks the map —
+            # dropped holders go dark via lease expiry, no revoke race
+            grow = [b for b in hot if b in self._granted
+                    and set(self._granted[b]) < set(holders)]
+            shrank = [b for b in self._granted
+                      if set(holders) < set(self._granted[b])]
+            for b in shrank:
+                self._granted[b] = holders
         fresh = [b for b in fresh if self._block_settled(b)]
-        if fresh:  # mid-migration blocks retry next tick
-            self._grant_blocks(fresh, holders)
-        return bool(fresh)
+        grow = [b for b in grow if self._block_settled(b)]
+        if fresh or grow:  # mid-migration blocks retry next tick
+            self._grant_blocks(fresh + grow, holders)
+        return bool(fresh or grow or shrank)
 
     def _serve_wire(self) -> tuple[str, int]:
         """The grant/delta row codec this owner emits: the blockwise
@@ -404,13 +432,17 @@ class TableServeState:
         return np.frombuffer(blob, np.float32).reshape(n, t.dim).copy()
 
     def _send_updates(self, holder: int, entries: list, stamp: int,
-                      *, renew: bool = False) -> None:
+                      *, renew: bool = False,
+                      fts: "Optional[float]" = None) -> None:
         """Ship ONE multi-block ``svU`` frame to ``holder`` — grants
         and deltas batch into a single frame per (holder, refresh), so
         the refresh wire cost is O(holders) frames per tick, not
         O(blocks x holders) (frame count, not bytes, is what a
         loopback/oversubscribed host pays for). ``entries`` is
-        ``[(block, full, keys|None, rows|None)]``."""
+        ``[(block, full, keys|None, rows|None)]``. ``fts`` is the
+        freshness stamp — the monotonic time of the oldest push this
+        frame's rows contain (obs/freshness.py); renew-only frames
+        carry none (nothing contained, nothing to be fresh about)."""
         t = self.table
         bs: list[int] = []
         fl: list[int] = []
@@ -434,6 +466,9 @@ class TableServeState:
             # from me — constant-size, replaces per-block renewal
             # segments (the blob carries only dirty/granted blocks)
             head["renew"] = 1
+        if fts is not None:
+            head["fts"] = float(fts)
+        self.fresh.note_shipped(fts is not None)
         t.bus.send(holder, f"svU:{t.name}", head,
                    blob=b"".join(parts))
 
@@ -456,6 +491,10 @@ class TableServeState:
             for b in bs:
                 self._granted[b] = holders
         stamp = self._stamp()
+        # a snapshot's oldest contained push is unbounded; its freshness
+        # stamp is the state-READ time, so the replica's lag reading is
+        # pure ship+decode+install delay
+        fts = time.monotonic()
         entries = []
         n_rows = 0
         for b in bs:
@@ -466,7 +505,7 @@ class TableServeState:
             entries.append((b, 1, None, rows))
             n_rows += int(ln)
         for h in holders:
-            self._send_updates(h, entries, stamp)
+            self._send_updates(h, entries, stamp, fts=fts)
         self._count("grants", len(bs))
         tr = _trc.TRACER
         if tr is not None:
@@ -488,11 +527,13 @@ class TableServeState:
         stamp = self._stamp()
         with self._ow_lock:
             dirty, self._dirty = self._dirty, {}
+            t0s, self._dirty_t0 = self._dirty_t0, {}
             holders_of = {b: self._granted.get(b) for b in dirty}
             all_holders: set[int] = set()
             for hs in self._granted.values():
                 all_holders.update(hs)
         per_holder: dict[int, list] = {h: [] for h in all_holders}
+        fts_holder: dict[int, float] = {}
         for b, dk in dirty.items():
             holders = holders_of.get(b)
             if not holders or not dk:
@@ -503,8 +544,14 @@ class TableServeState:
             for h in holders:
                 per_holder.setdefault(h, []).append((b, 0, keys, rows))
                 self._count("refresh_rows", int(keys.size))
+                # oldest contained push across every block this
+                # holder's frame ships (note_push stamps first-dirty)
+                t0 = t0s.get(b)
+                if t0 is not None:
+                    fts_holder[h] = min(fts_holder.get(h, t0), t0)
         for h, entries in per_holder.items():
-            self._send_updates(h, entries, stamp, renew=True)
+            self._send_updates(h, entries, stamp, renew=True,
+                               fts=fts_holder.get(h))
             self._count("refresh_frames")
 
     def _revoke_blocks(self, bs: list[int]) -> None:
@@ -518,6 +565,7 @@ class TableServeState:
             for b in bs:
                 holders = self._granted.pop(b, ())
                 self._dirty.pop(b, None)
+                self._dirty_t0.pop(b, None)
                 if holders:
                     revoked += 1
                     for h in holders:
@@ -572,12 +620,16 @@ class TableServeState:
         if not m.any():
             return
         mk, mb = keys[m], blocks[m]
+        now = time.monotonic()
         with self._ow_lock:
             for b in np.unique(mb):
                 bb = int(b)
                 if bb in self._granted:
                     self._dirty.setdefault(bb, set()).update(
                         int(k) for k in mk[mb == b])
+                    # first dirtier since the last refresh wins: the
+                    # freshness stamp is the OLDEST contained push
+                    self._dirty_t0.setdefault(bb, now)
 
     def note_push_range(self, lo: int, hi: int) -> None:
         if not self._granted:
@@ -734,6 +786,7 @@ class TableServeState:
         stamp = int(payload.get("stamp", 0))
         ep = int(payload.get("ep", 0))
         off = 0
+        applied = False  # any segment actually installed/scattered
         for b, full, n in zip(payload.get("bs", ()),
                               payload.get("fl", ()),
                               payload.get("ns", ())):
@@ -766,6 +819,7 @@ class TableServeState:
                     self._held[b] = {"rows": rows, "stamp": stamp,
                                      "exp": exp, "ep": ep, "lo": lo,
                                      "src": sender}
+                    applied = True
                     continue
                 h = self._held.get(b)
                 if h is None:
@@ -782,6 +836,7 @@ class TableServeState:
                                 "svU delta out of span")
                         return
                     h["rows"][offs] = rows
+                    applied = True
                 h["stamp"] = max(h["stamp"], stamp)
                 h["exp"] = exp
                 h["ep"] = max(h["ep"], ep)
@@ -795,6 +850,13 @@ class TableServeState:
                     if h.get("src") == sender:
                         h["stamp"] = max(h["stamp"], stamp)
                         h["exp"] = exp
+        fts = payload.get("fts")
+        if fts is not None and applied:
+            # push-visible-at-THIS-replica: the contained rows are
+            # servable from here on. Measured AFTER the apply, on the
+            # replica's monotonic clock (same-host comparability —
+            # obs/freshness.py spells out the cross-host limit).
+            self.fresh.note_lag(time.monotonic() - float(fts))
 
     def _on_revoke(self, sender: int, payload: dict) -> None:
         """Only the GRANTING owner may revoke its own grant: a delayed

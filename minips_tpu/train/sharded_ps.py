@@ -5400,7 +5400,8 @@ class ShardedPSTrainer:
                  slow: Optional[str] = None,
                  hier: Optional[str] = None,
                  plane: Optional[str] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 slo: Optional[str] = None):
         # data-plane selection at the same altitude as the bus backends
         # (train/mesh_plane.resolve_plane: explicit wins, else
         # $MINIPS_MESH): this bus-backed trainer IS the host-wire plane;
@@ -5650,6 +5651,23 @@ class ShardedPSTrainer:
         self.obs_window = _ow.maybe_build()
         if self.obs_window is not None:
             self._register_window_signals()
+        # per-tenant SLO burn-rate accounting (obs/slo.py): OFF by
+        # default — explicit spec wins, else $MINIPS_SLO. Built after
+        # the windowed layer (both its windows read windowed counts;
+        # SloTracker refuses a None window itself) and after tenancy
+        # bound (tenants are the keying). Its burning set feeds the
+        # serve plane's promotion budget and the autoscaler's arming
+        # pressure — both read ``trainer.slo_tracker`` lazily, so
+        # construction order against them does not matter.
+        from minips_tpu.obs import slo as _slo
+
+        slo_cfg = _slo.maybe_config(slo)
+        self.slo_tracker = None
+        if slo_cfg is not None:
+            tenants = (list(self.tables)
+                       if self.tenant_registry is not None else [])
+            self.slo_tracker = _slo.SloTracker(
+                slo_cfg, self.obs_window, tenants)
         fl = _fl.FLIGHT
         if fl is not None:
             # the black box's final windowed-metrics snapshot: every
@@ -5709,6 +5727,12 @@ class ShardedPSTrainer:
 
             ow.register_counter("shed", _sv_sig("shed"))
             ow.register_counter("backpressure", _sv_sig("bp"))
+            # push-visible-at-replica lag (obs/freshness.py): the
+            # fleet's windowed freshness quantiles — per-tenant twins
+            # register below with the other per-tenant signals
+            ow.register_hist("freshness", lambda: merge_counts(
+                [t._sv.fresh.hist.snapshot() for t in tables
+                 if t._sv is not None]))
         if getattr(self, "tenant_registry", None) is not None:
             # per-tenant SLO telemetry: each tenant's own windowed
             # pull tail (the heat report's p99 reads
@@ -5724,6 +5748,11 @@ class ShardedPSTrainer:
                 ow.register_counter(
                     f"throttle:{name}",
                     lambda t=t: t.tenant_counters["throttle"])
+                if t._sv is not None:
+                    # the tenant's own freshness tail — what its SLO
+                    # burn (obs/slo.py) is judged on
+                    ow.register_hist(f"freshness:{name}", _hist_fn(
+                        [t._sv.fresh.hist]))
         if self.hedge_cfg is not None:
             ow.register_counter(
                 "hedges_fired",
@@ -5855,6 +5884,13 @@ class ShardedPSTrainer:
             # reads a windowed value — the roll is this boundary's one
             # snapshot pass over the cumulative hists/counters
             self.obs_window.roll()
+            if self.slo_tracker is not None:
+                # burn evaluation rides the roll it just closed: the
+                # fast window always includes the newest interval, and
+                # the burning set is settled BEFORE the autoscaler
+                # below reads it as pressure (and before the serve
+                # plane's post-gate promotion reads the boost)
+                self.slo_tracker.on_roll()
         if self.slowness is not None:
             # the fail-slow judgment rolls on the same boundary, BEFORE
             # the membership/rebalancer decisions below read verdicts:
@@ -6255,6 +6291,35 @@ class ShardedPSTrainer:
                         "push_rows": sv["push_rows"],
                         "overrides": sp.overrides()}
         return {"shared": int(reg.shared), "tenants": by}
+
+    def freshness_stats(self) -> Optional[dict]:
+        """Push-visible-at-replica lag (obs/freshness.py) — None when
+        the serving plane is OFF (no replicas, nothing to be visible
+        at), ``{"count": 0}`` lag summaries + zero counters when armed
+        but idle (the off-vs-idle convention). ``fleet`` merges every
+        table's tracker; ``tenants`` carries the per-table split (one
+        tenant per table under tenancy) so the done line shows each
+        tenant's freshness p50/p99 next to its read p99."""
+        if self.serve_plane is None:
+            return None
+        from minips_tpu.obs.freshness import merge_freshness
+
+        trackers = {name: t._sv.fresh
+                    for name, t in self.tables.items()
+                    if t._sv is not None}
+        return {"fleet": merge_freshness(list(trackers.values())),
+                "tenants": {name: tr.record()
+                            for name, tr in trackers.items()}}
+
+    def slo_stats(self) -> Optional[dict]:
+        """SLO burn-rate accounting (obs/slo.py) — None when MINIPS_SLO
+        is off, zero counters and an empty burning set when armed but
+        idle. Carries the fast/slow window shape, per-tenant burn
+        ratios, the flight-evented burn/clear edge counts, and the
+        promotion-budget proof (``boost_ticks``, per-tenant
+        ``max_budget``)."""
+        return (self.slo_tracker.record()
+                if self.slo_tracker is not None else None)
 
     def rebalance_stats(self) -> Optional[dict]:
         """Rebalancer counters (balance/rebalancer.py) — None when the

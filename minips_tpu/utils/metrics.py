@@ -158,4 +158,17 @@ def wire_record(trainer) -> dict:
         # MINIPS_TENANT is off, zero counters when armed but idle —
         # the TENANT-IDLE gate pins the zeros
         "tenant": getattr(trainer, "tenant_stats", lambda: None)(),
+        # push-visible-at-replica freshness (obs/freshness.py): per-
+        # tenant visibility-lag p50/p99 + owner stamp counters, next to
+        # the read p99 above — None when the serving plane is OFF
+        # (there are no replicas to be visible at), {"count": 0} lag
+        # summaries + zero counters when armed but idle
+        "freshness": getattr(trainer, "freshness_stats",
+                             lambda: None)(),
+        # SLO burn-rate accounting (obs/slo.py): fast/slow-window burn
+        # ratios per tenant, burn/clear edge counts (each burn edge is
+        # a flight-recorder checkpoint), and the promotion-budget
+        # flex proof (boost_ticks, per-tenant max_budget) — None when
+        # MINIPS_SLO is off, zero counters when armed but idle
+        "slo": getattr(trainer, "slo_stats", lambda: None)(),
     }

@@ -556,7 +556,7 @@ def test_ssp_trainer_survives_chaos_with_bounds_intact():
 
 def run_bsp_lockstep(backend: str = "zmq", chaos: str = "",
                      reliable: str = "", hedge: str = "",
-                     tenant: str = "",
+                     tenant: str = "", traffic: str = "",
                      stats: "dict | None" = None):
     """2-rank in-proc BSP lockstep run → (final weights per rank,
     frames_lost per rank). THE bitwise-drill harness: identical frame
@@ -618,6 +618,22 @@ def run_bsp_lockstep(backend: str = "zmq", chaos: str = "",
             t.attach_hedge(HedgeConfig.parse(hedge))
         t._w[...] = np.arange(32 * 2, dtype=np.float32
                               ).reshape(32, 2) / 7.0
+    driver = None
+    if traffic:
+        # TRAFFIC-IDLE arm (apps/traffic_driver.py): the open-loop
+        # driver ARMED against rank 0's serving read with a rate-0
+        # spec — the schedule is empty, the dispatchers start and
+        # issue NOTHING, so the run must be bitwise-equal to off
+        # with zero issued requests (the stamp below proves both
+        # halves: armed, and idle)
+        from minips_tpu.apps.traffic_driver import (TrafficConfig,
+                                                    TrafficDriver)
+
+        tcfg = TrafficConfig.parse(traffic)
+        assert tcfg is not None, "TRAFFIC-IDLE arm needs an armed spec"
+        driver = TrafficDriver(tcfg, tables[0].pull_serving, 64,
+                               duration_s=5.0)
+        driver.start()
     # disjoint cross-shard keys (same shape as the row-cache bitwise
     # drill): each shard receives pushes from exactly one peer, so
     # per-link in-order delivery fixes the apply order bit-for-bit
@@ -632,6 +648,16 @@ def run_bsp_lockstep(backend: str = "zmq", chaos: str = "",
             LockstepCons.clocks[0] += 1
             LockstepCons.clocks[1] += 1
         lost = [b.frames_lost for b in buses]
+        if driver is not None:
+            driver.stop()
+            if stats is not None:
+                # TRAFFIC-IDLE evidence: the armed driver scheduled
+                # and issued zero requests (rate=0 ≡ off by
+                # construction — the gate pins the zero)
+                stats["traffic_requests"] = (
+                    driver.counters["requests"]
+                    + driver.counters["errors"])
+                stats["traffic_scheduled"] = len(driver.arrivals)
         if stats is not None:
             # engagement evidence for the armed-idle drills: the
             # SLOW-IDLE stamp must distinguish 'fired 0' from 'not
@@ -646,6 +672,8 @@ def run_bsp_lockstep(backend: str = "zmq", chaos: str = "",
                 sum(t.tenant_counters.values()) for t in tables)
         return [t._w.copy() for t in tables], lost
     finally:
+        if driver is not None:
+            driver.stop()  # idempotent; covers the exception path
         for b in buses:
             b.close()
 

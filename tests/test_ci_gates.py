@@ -811,6 +811,154 @@ def test_tenant_arms_never_enter_the_throughput_gate():
     assert throughput_points(_tenant_art()) == {}
 
 
+# ------------- million-user tripwires (TRAFFIC-FRESH/SHED/IDLE)
+def _traffic_art(*, base_completed=True, crowd_completed=True,
+                 over_completed=True, base_unissued=0,
+                 crowd_unissued=310, errors=0,
+                 crowd_sched_p99=950.0, crowd_svc_p99=95.0,
+                 fresh_samples=4_000, fresh_p99=180.0,
+                 crowd_budget=2, crowd_burns=3,
+                 over_inf_denied=220, over_trn_denied=0,
+                 flight_burns=2, burn_tenants=("inf",),
+                 stale=0, lost=0, dropped=0,
+                 equal=True, checked=64, idle_req=0,
+                 idle_sched=0) -> dict:
+    def arm(completed, budget, burns, inf_denied, trn_denied,
+            unissued, sched_p99=95.0, svc_p99=18.0):
+        return {"completed": completed, "scheduled": 540,
+                "requests": 540 - errors - unissued,
+                "errors": errors,
+                "unissued": unissued, "late_issues": 12,
+                "sched_p99_ms": sched_p99, "svc_p99_ms": svc_p99,
+                "freshness_samples": fresh_samples,
+                "freshness_p99_ms": fresh_p99,
+                "stamped_frames": 300, "slo_burns": burns,
+                "slo_clears": 1, "boost_ticks": 40,
+                "inf_max_budget": budget,
+                "trn_denied": trn_denied, "inf_denied": inf_denied,
+                "stale_reads": stale, "trn_rows_per_sec": 7_000.0,
+                "conc": 6,
+                "wire_frames_lost": lost, "frames_dropped": dropped}
+    over = arm(over_completed, 2, 2, over_inf_denied,
+               over_trn_denied, 900, sched_p99=2_000.0,
+               svc_p99=190.0)
+    over.update({"flight_dumps": 3, "flight_slo_burns": flight_burns,
+                 "flight_burn_tenants": sorted(burn_tenants)})
+    return {"million_user_3proc": {
+        "open_loop_base": arm(base_completed, 1, 0, 0, 0,
+                              base_unissued),
+        "flash_crowd": arm(crowd_completed, crowd_budget,
+                           crowd_burns, 0, 0, crowd_unissued,
+                           sched_p99=crowd_sched_p99,
+                           svc_p99=crowd_svc_p99),
+        "overload_shed": over,
+        "idle": {"equal": equal, "rows_checked": checked,
+                 "traffic_requests": idle_req,
+                 "traffic_scheduled": idle_sched}}}
+
+
+def test_traffic_tripwires_pass_on_healthy_sweep():
+    from ci.bench_regression import traffic_tripwires
+
+    assert traffic_tripwires(_traffic_art()) == []
+    # absent sweep (other benches): vacuous
+    assert traffic_tripwires({}) == []
+
+
+def test_traffic_fresh_latency_not_loss_and_live_samples():
+    from ci.bench_regression import traffic_tripwires
+
+    # the BASE rate must be sustainable: leftover schedule on the
+    # flat arm means every latency claim rode an unintended overload
+    probs = traffic_tripwires(_traffic_art(base_unissued=30))
+    assert any("TRAFFIC-FRESH" in p and "ALL issue" in p
+               for p in probs)
+    # ... but the stop-boundary sliver (one claimed arrival per
+    # dispatcher + 1% of the schedule) is teardown, not overload
+    assert traffic_tripwires(_traffic_art(base_unissued=7)) == []
+    # ... but CROWD backlog is legitimate (bounded conc cannot drain
+    # an 8x burst) — the healthy fabricated sweep carries it
+    assert _traffic_art()["million_user_3proc"]["flash_crowd"][
+        "unissued"] > 0
+    probs = traffic_tripwires(_traffic_art(errors=3))
+    assert any("must succeed" in p for p in probs)
+    # unissued must be ON the record: a sweep that silently drops the
+    # counter is coordinated omission wearing a latency costume
+    art = _traffic_art()
+    del art["million_user_3proc"]["flash_crowd"]["unissued"]
+    probs = traffic_tripwires(art)
+    assert any("coordinated omission" in p for p in probs)
+    # the crowd's queueing delay must be visible in the sched tail
+    probs = traffic_tripwires(_traffic_art(crowd_sched_p99=18.0,
+                                           crowd_svc_p99=18.0))
+    assert any("never outran the fleet" in p for p in probs)
+    # freshness must be measured, and at refresh scale, not backlog
+    probs = traffic_tripwires(_traffic_art(fresh_samples=0))
+    assert any("TRAFFIC-FRESH" in p and "never measured" in p
+               for p in probs)
+    probs = traffic_tripwires(_traffic_art(fresh_p99=120_000.0))
+    assert any("under a minute" in p for p in probs)
+    probs = traffic_tripwires(_traffic_art(fresh_p99=None))
+    assert any("under a minute" in p for p in probs)
+
+
+def test_traffic_fresh_budget_flex_proof_and_safety_counters():
+    from ci.bench_regression import traffic_tripwires
+
+    # the crowd must provably flex the budget above the configured 1
+    probs = traffic_tripwires(_traffic_art(crowd_budget=1))
+    assert any("TRAFFIC-FRESH" in p and "flex the promotion budget"
+               in p for p in probs)
+    probs = traffic_tripwires(_traffic_art(crowd_burns=0))
+    assert any("vacuous" in p for p in probs)
+    # the crowd may never degrade to staleness or poison
+    probs = traffic_tripwires(_traffic_art(stale=2))
+    assert sum("stale reads" in p for p in probs) == 3  # all arms
+    probs = traffic_tripwires(_traffic_art(lost=1))
+    assert any("poison" in p for p in probs)
+    probs = traffic_tripwires(_traffic_art(dropped=2))
+    assert any("poison" in p for p in probs)
+    probs = traffic_tripwires(_traffic_art(crowd_completed=False))
+    assert any("every arm must finish" in p for p in probs)
+
+
+def test_traffic_shed_attribution_and_flight_box():
+    from ci.bench_regression import traffic_tripwires
+
+    probs = traffic_tripwires(_traffic_art(over_inf_denied=0))
+    assert any("TRAFFIC-SHED" in p and "admission disarmed" in p
+               for p in probs)
+    probs = traffic_tripwires(_traffic_art(over_trn_denied=5))
+    assert any("TRAFFIC-SHED" in p and "training" in p
+               for p in probs)
+    probs = traffic_tripwires(_traffic_art(flight_burns=0))
+    assert any("post-mortem box" in p for p in probs)
+    probs = traffic_tripwires(_traffic_art(burn_tenants=("trn",)))
+    assert any("does not name the burning tenant" in p
+               for p in probs)
+
+
+def test_traffic_idle_requires_bitwise_and_zero_schedule():
+    from ci.bench_regression import traffic_tripwires
+
+    probs = traffic_tripwires(_traffic_art(equal=False))
+    assert any("TRAFFIC-IDLE" in p and "bitwise-equal" in p
+               for p in probs)
+    probs = traffic_tripwires(_traffic_art(checked=0))
+    assert any("TRAFFIC-IDLE" in p for p in probs)
+    # equal but the armed driver actually issued requests: not idle
+    probs = traffic_tripwires(_traffic_art(idle_req=4, idle_sched=4))
+    assert any("TRAFFIC-IDLE" in p and "empty schedule" in p
+               for p in probs)
+
+
+def test_traffic_arms_never_enter_the_throughput_gate():
+    """Open-loop rates are OFFERED load (trn_rows_per_sec rides a
+    gate-invisible key): the latency/freshness gates are TRAFFIC-*'s
+    job, never the run-to-run ±10% comparator's."""
+    assert throughput_points(_traffic_art()) == {}
+
+
 # -------------------------------- mesh-plane tripwires (MESH-WIN/BITWISE)
 def _mesh_art(wire=250_000.0, mesh=7_000_000.0, blk8=3_900_000.0,
               mesh_completed=True, blk8_completed=True,

@@ -399,11 +399,16 @@ def test_wire_record_schema_full_layout():
                 "wire_frames_lost", "wire_frames_malformed", "timing",
                 "hist", "window", "heartbeat", "cache", "ef",
                 "reliable", "chaos", "serve", "rebalance", "membership",
-                "hedge", "slowness", "hier", "hybrid", "tenant"}
+                "hedge", "slowness", "hier", "hybrid", "tenant",
+                "freshness", "slo"}
     assert expected <= set(rec)
     # layers OFF in this run report None — not {} — and vice versa
     assert rec["cache"] is None
     assert rec["ef"] is None  # exact push wire: no residual store
+    # freshness rides the serving plane: plane off -> None, not {}
+    # (armed-idle pins live in test_traffic_obs.py)
+    assert rec["freshness"] is None
+    assert rec["slo"] is None  # MINIPS_SLO off: None, not zeros
     assert rec["hedge"] is None     # fail-slow plane off: both None
     assert rec["slowness"] is None
     assert rec["hier"] is None      # two-level push tree off: None

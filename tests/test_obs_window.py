@@ -462,6 +462,45 @@ def test_flight_cli_merges_offset_aligned_timeline(tmp_path):
     assert fl.main([str(empty)]) == 1
 
 
+def test_flight_merge_surfaces_per_tenant_slo_burns(tmp_path, capsys):
+    """The merged summary rolls up slo_burn edges BY TENANT (the burn
+    is why the box exists — no grepping the timeline), tolerant of a
+    burn event with torn args, and empty when no burns fired."""
+    d = tmp_path / "boxes"
+    d.mkdir()
+
+    def box(rank, events):
+        json.dump({"rank": rank, "cap": 64,
+                   "events": [{"t_us": t, "kind": k, "args": a}
+                              for t, k, a in events],
+                   "reasons": [], "hb_delays_us": {}},
+                  open(d / f"flight-rank{rank}.json", "w"))
+
+    box(0, [(100.0, "slo_burn", {"tenant": "inf", "metric": "read"}),
+            (300.0, "slo_clear", {"tenant": "inf", "metric": "read"}),
+            (400.0, "slo_burn", {"tenant": "inf", "metric": "shed"})])
+    box(1, [(150.0, "slo_burn", {"tenant": "trn", "metric": "read"}),
+            (500.0, "slo_burn", None)])  # torn args: counted as "?"
+    rc = fl.main([str(d)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["slo_burns"] == {"inf": 2, "trn": 1, "?": 1}
+    assert "SLO burn edges on this timeline" in out
+    # clears don't count as burns; a burn-free merge reports {}
+    quiet = tmp_path / "quiet"
+    quiet.mkdir()
+    json.dump({"rank": 0, "cap": 64,
+               "events": [{"t_us": 1.0, "kind": "slo_clear",
+                           "args": {"tenant": "inf"}}],
+               "reasons": [], "hb_delays_us": {}},
+              open(quiet / "flight-rank0.json", "w"))
+    assert fl.main([str(quiet)]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out.strip().splitlines()[-1])["slo_burns"] == {}
+    assert "SLO burn edges" not in out
+
+
 def test_flight_sweep_reclaims_dead_runs_only(tmp_path, monkeypatch):
     tmp = tmp_path / "tmp"
     tmp.mkdir()

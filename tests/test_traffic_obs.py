@@ -1,0 +1,540 @@
+"""Freshness observability + SLO burn accounting + the open-loop
+traffic driver (obs/freshness.py, obs/slo.py, apps/traffic_driver.py)
+— this PR's tentpole.
+
+Three layers of drill, the house shape:
+
+- pure logic: the MINIPS_TRAFFIC grammar (parse/refuse table, the
+  crowd token, and the seeded 250-spec fuzzer), the deterministic
+  rate curve and arrival schedule, ``frac_over_target``'s log2
+  interpolation, and the MINIPS_SLO grammar;
+- unit protocol: the driver replays its schedule against a fake pull
+  (counts, key bounds, error survival) and proves the
+  coordinated-omission point at unit scale (a slow backend shows up in
+  scheduled-arrival latency, not in service time); FreshnessTracker
+  clamps cross-host skew loudly; SloTracker burns on a real windowed
+  layer, edges once per transition, flexes the boost, and falls back
+  to fleet signals for untagged tenants;
+- armed-idle drills: a rate=0 armed driver against the BSP lockstep is
+  bitwise-equal to off with zero requests scheduled (TRAFFIC-IDLE at
+  test scale), and an armed-but-idle serve+slo trainer reports the
+  zeros the off-vs-idle convention promises in ``wire_record`` (the
+  None side is pinned in test_obs_trace.py's schema test).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minips_tpu.apps.traffic_driver import (TrafficConfig,
+                                            TrafficDriver)
+from minips_tpu.apps.traffic_driver import maybe_config as maybe_traffic
+from minips_tpu.obs.freshness import FreshnessTracker, merge_freshness
+from minips_tpu.obs.slo import (SloConfig, SloTracker,
+                                frac_over_target)
+from minips_tpu.obs.slo import maybe_config as maybe_slo
+from minips_tpu.obs.window import WindowedMetrics
+
+
+def _mk_buses(n, **kw):
+    from tests.conftest import mk_loopback_buses
+
+    return mk_loopback_buses(n, **kw)
+
+
+# ---------------------------------------------- MINIPS_TRAFFIC grammar
+def test_traffic_config_parses_and_refuses():
+    c = TrafficConfig.parse(
+        "rate=500,users=250000,alpha=1.3,batch=16,conc=8,ramp=2,"
+        "period=20,crowd=4+2x8,seed=7")
+    assert (c.rate, c.users, c.alpha, c.batch, c.conc) == (
+        500.0, 250000, 1.3, 16, 8)
+    assert (c.ramp, c.period) == (2.0, 20.0)
+    assert (c.crowd_at, c.crowd_for, c.crowd_x) == (4.0, 2.0, 8.0)
+    assert c.seed == 7
+    # off spellings vs armed defaults
+    assert TrafficConfig.parse("") is None
+    assert TrafficConfig.parse("0") is None
+    assert TrafficConfig.parse("1").rate == 200.0
+    # rate=0 parses ARMED (the idle drill's whole point)
+    assert TrafficConfig.parse("rate=0").rate == 0.0
+    for bad, frag in [
+        ("rate", "expected k=v"),
+        ("rate=abc", "bad value for rate"),
+        ("rate=-1", "rate must be"),
+        ("users=0", "users must be"),
+        ("alpha=1.0", "alpha must be"),
+        ("batch=0", "batch must be"),
+        ("conc=0", "conc must be"),
+        ("ramp=0.5", "ramp is a peak multiplier"),
+        ("period=0", "period must be"),
+        ("crowd=4+2", "crowd wants"),
+        ("crowd=x", "crowd wants"),
+        ("crowd=a+bxc", "bad crowd value"),
+        ("crowd=4+2x0.5", "crowd multiplier"),
+        ("crowd=-1+2x8", "crowd at/duration"),
+        ("turbo=1", "unknown knob"),
+    ]:
+        with pytest.raises(ValueError, match=frag):
+            TrafficConfig.parse(bad)
+
+
+def test_traffic_knob_fuzzer_parse_or_refuse_loudly():
+    """Seeded MINIPS_TRAFFIC fuzz (the MINIPS_TENANT fuzzer
+    convention): every random spec either parses — twice, to the same
+    signature — or refuses with ValueError naming MINIPS_TRAFFIC; any
+    other exception is a parser bug."""
+    rng = np.random.default_rng(20260807)
+    knobs = ["rate", "users", "alpha", "batch", "conc", "ramp",
+             "period", "seed", "crowd", "zz", ""]
+    vals = ["500", "0", "1", "1.5", "-1", "abc", "inf", "nan", "",
+            "4+2x8", "4+2", "x", "1e6"]
+    checked = 0
+    for _ in range(250):
+        n = int(rng.integers(0, 5))
+        spec = ",".join(
+            f"{knobs[int(rng.integers(len(knobs)))]}"
+            f"={vals[int(rng.integers(len(vals)))]}"
+            for _k in range(n))
+        outcomes = []
+        for _twice in range(2):
+            try:
+                c = maybe_traffic(spec)
+                outcomes.append(
+                    ("ok", None if c is None else c.signature()))
+            except ValueError as e:
+                assert "MINIPS_TRAFFIC" in str(e), spec
+                outcomes.append(("refused", str(e)))
+            except Exception as e:  # noqa: BLE001 - the fuzzer's point
+                pytest.fail(f"spec {spec!r} raised {e!r} "
+                            f"(not ValueError)")
+        assert outcomes[0] == outcomes[1], spec
+        checked += 1
+    assert checked == 250
+
+
+def test_rate_curve_is_deterministic_and_shaped():
+    flat = TrafficConfig.parse("rate=100")
+    assert flat.rate_at(0.0) == flat.rate_at(7.3) == 100.0
+    # raised-cosine ramp: troughs at 0 and period, peak ramp*base at
+    # period/2 — and the curve is a pure function of t
+    ramp = TrafficConfig.parse("rate=100,ramp=3,period=10")
+    assert ramp.rate_at(0.0) == pytest.approx(100.0)
+    assert ramp.rate_at(5.0) == pytest.approx(300.0)
+    assert ramp.rate_at(10.0) == pytest.approx(100.0)
+    assert ramp.rate_at(2.5) == ramp.rate_at(2.5)
+    # crowd window is half-open [at, at+dur)
+    crowd = TrafficConfig.parse("rate=100,crowd=4+2x8")
+    assert crowd.rate_at(3.999) == 100.0
+    assert crowd.rate_at(4.0) == 800.0
+    assert crowd.rate_at(5.999) == 800.0
+    assert crowd.rate_at(6.0) == 100.0
+
+
+# --------------------------------------------------- driver: schedule
+def test_schedule_deterministic_and_rate_faithful():
+    """Same spec -> bit-identical arrivals AND user draws (two runs of
+    one spec offer identical load); the arrival count integrates the
+    rate curve (rate*duration within one inter-arrival gap)."""
+    mk = lambda: TrafficDriver(TrafficConfig.parse(
+        "rate=200,users=1000,alpha=1.2,seed=3,crowd=1+1x4"),
+        lambda keys: None, rows=64, duration_s=4.0)
+    a, b = mk(), mk()
+    np.testing.assert_array_equal(a.arrivals, b.arrivals)
+    np.testing.assert_array_equal(a._users, b._users)
+    # 3s at 200/s + 1s crowd at 800/s = ~1400 arrivals
+    assert abs(len(a.arrivals) - 1400) <= 2
+    assert np.all(np.diff(a.arrivals) > 0)
+    assert a.arrivals[-1] < 4.0
+    # user draws live on the configured population
+    assert a._users.min() >= 0 and a._users.max() < 1000
+
+
+def test_rate_zero_is_armed_idle_and_guard_refuses_blowup():
+    idle = TrafficDriver(TrafficConfig.parse("rate=0"),
+                         lambda keys: None, rows=8, duration_s=60.0)
+    assert len(idle.arrivals) == 0
+    rec = idle.record()
+    assert rec["scheduled"] == 0 and rec["requests"] == 0
+    assert rec["sched_ms"] == {"count": 0}
+    # the schedule memory guard names the fix
+    with pytest.raises(ValueError, match="lower rate/duration"):
+        TrafficDriver(TrafficConfig.parse("rate=1e6"),
+                      lambda keys: None, rows=8, duration_s=10.0)
+    with pytest.raises(ValueError, match="rows"):
+        TrafficDriver(TrafficConfig.parse("1"), lambda keys: None,
+                      rows=0, duration_s=1.0)
+
+
+def test_keys_are_bounded_and_user_pinned():
+    d = TrafficDriver(TrafficConfig.parse("rate=100,users=50,batch=4"),
+                      lambda keys: None, rows=37, duration_s=1.0)
+    for i in range(len(d.arrivals)):
+        keys = d._keys_for(i)
+        assert keys.shape == (4,)
+        assert keys.min() >= 0 and keys.max() < 37
+    # the fan-out is a function of the user alone: hot users pin hot
+    # row sets across their every request
+    same = [i for i in range(len(d.arrivals))
+            if d._users[i] == d._users[0]]
+    for i in same[1:]:
+        np.testing.assert_array_equal(d._keys_for(i), d._keys_for(0))
+
+
+# --------------------------------------------------- driver: dispatch
+def test_driver_replays_schedule_and_survives_errors():
+    calls: list = []
+
+    def pull(keys):
+        calls.append(np.asarray(keys).copy())
+
+    d = TrafficDriver(TrafficConfig.parse(
+        "rate=400,users=100,batch=3,conc=2,seed=5"),
+        pull, rows=64, duration_s=0.5)
+    d.start()
+    time.sleep(0.9)
+    d.stop()
+    rec = d.record()
+    assert rec["requests"] == rec["scheduled"] == len(calls) > 0
+    assert rec["unissued"] == 0 and rec["errors"] == 0
+    assert rec["rows"] == 3 * rec["requests"]
+    assert rec["sched_ms"]["count"] == rec["requests"]
+    assert rec["first_error"] is None
+    # a failing backend is counted and quoted, never raises into the
+    # dispatcher (the driver outlives the fleet it measures)
+    boom = TrafficDriver(TrafficConfig.parse("rate=400,conc=2"),
+                         lambda k: 1 / 0, rows=8, duration_s=0.25)
+    boom.start()
+    time.sleep(0.5)
+    boom.stop()
+    rec = boom.record()
+    assert rec["errors"] > 0 and rec["requests"] == 0
+    assert "ZeroDivisionError" in rec["first_error"]
+
+
+def test_open_loop_records_queueing_a_closed_loop_would_omit():
+    """The coordinated-omission point at unit scale: a backend that
+    serves in ~1ms but admits one request at a time under a 10x
+    oversubscribed schedule must show scheduled-arrival p50 far above
+    service p50 — the queueing a closed loop's think-after-completion
+    accounting silently absorbs."""
+    gate = threading.Lock()
+
+    def slow_pull(keys):
+        with gate:  # serialized backend: capacity ~1/svc
+            time.sleep(0.004)
+
+    d = TrafficDriver(TrafficConfig.parse("rate=1000,conc=4,seed=2"),
+                      slow_pull, rows=8, duration_s=0.4)
+    d.start()
+    deadline = time.perf_counter() + 10.0
+    while time.perf_counter() < deadline:
+        with d._lock:
+            if d._next >= len(d.arrivals):
+                break
+        time.sleep(0.05)
+    time.sleep(0.1)
+    d.stop()
+    rec = d.record()
+    assert rec["requests"] > 50
+    assert rec["late_issues"] > 0, "schedule never outpaced service"
+    # service sits near 4ms; scheduled-arrival latency carries the
+    # backlog (>= several service times by mid-schedule)
+    assert rec["sched_ms"]["p50_ms"] > 3 * rec["svc_ms"]["p50_ms"], rec
+
+
+# -------------------------------------------------- freshness tracker
+def test_freshness_tracker_records_and_clamps_skew_loudly():
+    ft = FreshnessTracker()
+    ft.note_shipped(True)
+    ft.note_shipped(True)
+    ft.note_shipped(False)  # renew-only: counted, no lag
+    ft.note_lag(0.010)
+    ft.note_lag(0.020)
+    ft.note_lag(-0.005)  # cross-host skew: clamped to 0, counted
+    rec = ft.record()
+    assert rec["stamped_frames"] == 2 and rec["unstamped_frames"] == 1
+    assert rec["lag_samples"] == 3
+    assert rec["clock_skew_clamped"] == 1
+    assert rec["lag"]["count"] == 3
+    assert 5.0 <= rec["lag"]["p50_ms"] <= 35.0
+
+
+def test_merge_freshness_fleet_view_and_armed_idle_zeros():
+    assert merge_freshness([]) == {
+        "lag": {"count": 0}, "stamped_frames": 0,
+        "unstamped_frames": 0, "lag_samples": 0,
+        "clock_skew_clamped": 0}
+    a, b = FreshnessTracker(), FreshnessTracker()
+    a.note_shipped(True)
+    a.note_lag(0.001)
+    b.note_shipped(True)
+    b.note_lag(0.1)
+    m = merge_freshness([a, b])
+    assert m["lag"]["count"] == 2 and m["stamped_frames"] == 2
+    # idle trackers merge to the same zeros as the empty list
+    idle = merge_freshness([FreshnessTracker()])
+    assert idle["lag"] == {"count": 0} and idle["lag_samples"] == 0
+
+
+# -------------------------------------------------------- MINIPS_SLO
+def test_frac_over_target_log2_interpolation():
+    from minips_tpu.obs.hist import Log2Histogram
+
+    assert frac_over_target([0] * 40, 100.0) == 0.0
+    h = Log2Histogram()
+    for us in (3, 3, 3, 3):  # bucket [2,4)us
+        h.record_us(us)
+    counts = h.snapshot()
+    assert frac_over_target(counts, 1.0) == 1.0   # all above
+    assert frac_over_target(counts, 8.0) == 0.0   # all below
+    # target mid-bucket: linear fraction of the straddler
+    assert frac_over_target(counts, 3.0) == pytest.approx(0.5)
+    # mixed: one bucket fully over, the straddler contributes its part
+    h.record_us(100)
+    assert frac_over_target(h.snapshot(), 3.0) == pytest.approx(
+        (4 * 0.5 + 1) / 5)
+
+
+def test_slo_config_parses_and_refuses():
+    c = SloConfig.parse("fresh_ms=50,read_ms=20,shed_rate=5,fast=3,"
+                        "slow=9,burn=2,q=0.95,boost=2,pressure=0")
+    assert c.signature() == (50.0, 20.0, 5.0, 3, 9, 2.0, 0.95, 2, 0)
+    assert SloConfig.parse("") is None and SloConfig.parse("0") is None
+    d = SloConfig.parse("1")  # armed-idle: no targets monitored
+    assert (d.fresh_ms, d.read_ms, d.shed_rate) == (0.0, 0.0, 0.0)
+    assert maybe_slo("") is None
+    for bad, frag in [
+        ("read_ms=-1", "targets must be"),
+        ("fast=0", "fast window"),
+        ("fast=4,slow=2", "inverts the blip filter"),
+        ("burn=0", "burn threshold"),
+        ("q=1", "q must be"),
+        ("boost=-1", "boost must be"),
+        ("pressure=2", "pressure must be"),
+        ("read_ms", "expected k=v"),
+        ("zz=1", "unknown knob"),
+        ("read_ms=abc", "bad value for read_ms"),
+    ]:
+        with pytest.raises(ValueError, match=frag):
+            SloConfig.parse(bad)
+        assert "MINIPS_SLO" in str(pytest.raises(
+            ValueError, SloConfig.parse, bad).value)
+
+
+class _FleetSim:
+    """A windowed layer fed by hand: one read-latency hist + one shed
+    counter per tenant, with an injected clock so rates are exact."""
+
+    def __init__(self, tenants=("a", "b")):
+        self.t = [0.0]
+        self.ow = WindowedMetrics(window=4, ring=16,
+                                  clock=lambda: self.t[0])
+        from minips_tpu.obs.hist import Log2Histogram
+
+        self.hists = {n: Log2Histogram() for n in tenants}
+        self.sheds = {n: [0] for n in tenants}
+        for n in tenants:
+            h, s = self.hists[n], self.sheds[n]
+            self.ow.register_hist(f"pull_latency:{n}",
+                                  (lambda hh=h: hh.counts))
+            self.ow.register_counter(f"shed:{n}",
+                                     (lambda ss=s: ss[0]))
+
+    def roll(self, dt=1.0):
+        self.t[0] += dt
+        self.ow.roll()
+
+
+def test_slo_tracker_burns_edges_and_boosts():
+    sim = _FleetSim()
+    cfg = SloConfig.parse("read_ms=1,fast=2,slow=4,boost=2")
+    sl = SloTracker(cfg, sim.ow, ["a", "b"])
+    # tenant a violates (10ms reads vs 1ms target); b is clean (100us)
+    for _ in range(4):
+        for _s in range(20):
+            sim.hists["a"].record_us(10_000)
+            sim.hists["b"].record_us(100)
+        sim.roll()
+        sl.on_roll()
+    assert sl.burning("a") and not sl.burning("b")
+    assert sl.burning_tenants() == ["a"]
+    assert sl.counters["burns"] == 1  # ONE rising edge, not per roll
+    assert sl.replica_boost("a") == 2 and sl.replica_boost("b") == 0
+    assert sl.pressure_quanta() == 1
+    sl.note_budget("a", 3)
+    sl.note_budget("a", 2)  # max wins
+    rec = sl.record()
+    assert rec["burning"] == ["a/read"]
+    assert rec["tenants"]["a"]["max_budget"] == 3
+    assert rec["tenants"]["a"]["read_burn"][0] >= cfg.burn
+    assert rec["tenants"]["b"]["burning"] == []
+    # recovery: clean windows long enough for BOTH windows -> one clear
+    for _ in range(5):
+        for _s in range(20):
+            sim.hists["a"].record_us(100)
+        sim.roll()
+        sl.on_roll()
+    assert not sl.burning("a")
+    assert sl.counters["clears"] == 1
+    assert sl.pressure_quanta() == 0
+
+
+def test_slo_tracker_shed_rate_pressure_knob_and_fallbacks():
+    sim = _FleetSim(tenants=("a",))
+    cfg = SloConfig.parse("shed_rate=5,fast=2,slow=2,pressure=0")
+    sl = SloTracker(cfg, sim.ow, ["a"])
+    for _ in range(3):
+        sim.sheds["a"][0] += 50  # 50 sheds/s vs target 5/s
+        sim.roll(dt=1.0)
+        sl.on_roll()
+    assert sl.burning("a")
+    assert sl.pressure_quanta() == 0  # the knob gates the autoscaler
+    # an unregistered per-tenant signal falls back to the FLEET signal
+    fleet = _FleetSim(tenants=())
+    shed = [0]
+    fleet.ow.register_counter("shed", lambda: shed[0])
+    sl2 = SloTracker(SloConfig.parse("shed_rate=5,fast=2,slow=2"),
+                     fleet.ow, ["ghost"])
+    for _ in range(3):
+        shed[0] += 50
+        fleet.roll(dt=1.0)
+        sl2.on_roll()
+    assert sl2.burning("ghost"), "fleet fallback never engaged"
+    # and the windowed layer is mandatory, loudly
+    with pytest.raises(ValueError, match="MINIPS_OBS=0"):
+        SloTracker(SloConfig.parse("1"), None, [])
+
+
+# --------------------------------------------------------- armed idle
+def test_traffic_armed_idle_lockstep_bitwise_equal_to_off():
+    """TRAFFIC-IDLE at test scale: a rate=0 armed driver against the
+    BSP lockstep schedules nothing, issues nothing, and the final
+    weights are bitwise-identical to the traffic-off run."""
+    from tests.test_chaos_reliable import run_bsp_lockstep
+
+    base, lost0 = run_bsp_lockstep()
+    st: dict = {}
+    armed, lost1 = run_bsp_lockstep(
+        traffic="rate=0,users=1000000", stats=st)
+    assert lost0 == [0, 0] and lost1 == [0, 0]
+    for w0, w1 in zip(base, armed):
+        np.testing.assert_array_equal(w0, w1)
+    assert st["traffic_scheduled"] == 0
+    assert st["traffic_requests"] == 0
+
+
+def test_wire_record_armed_idle_zeros_for_freshness_and_slo():
+    """The off-vs-idle convention's armed side (the None side lives in
+    test_obs_trace.py's schema test): serve+slo armed with zero
+    serving traffic reports zero-count freshness and an empty burning
+    set — scrapers can tell 'armed but quiet' from 'off'."""
+    from minips_tpu.train.sharded_ps import (ShardedPSTrainer,
+                                             ShardedTable)
+    from minips_tpu.utils.metrics import wire_record
+
+    buses = _mk_buses(2)
+    errs: list = []
+    recs: list = [None, None]
+    try:
+        tables = [ShardedTable("t", 64, 2, buses[i], i, 2,
+                               updater="sgd", pull_timeout=20.0)
+                  for i in range(2)]
+        trainers = [ShardedPSTrainer(
+            {"t": tables[i]}, buses[i], 2, staleness=1,
+            gate_timeout=30.0,
+            serve="replicas=1,hot=4,interval=0.05",
+            slo="read_ms=20,fast=2,slow=4") for i in range(2)]
+
+        def worker(r):
+            try:
+                rng = np.random.default_rng(r)
+                for _ in range(6):
+                    keys = rng.integers(0, 64, size=8)
+                    tables[r].pull(keys)
+                    tables[r].push(keys, np.ones((8, 2),
+                                                 dtype=np.float32))
+                    trainers[r].tick()
+                    time.sleep(0.01)
+                trainers[r].finalize(timeout=30.0)
+                recs[r] = wire_record(trainers[r])
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append((r, repr(e)))
+
+        ts = [threading.Thread(target=worker, args=(r,))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60.0)
+        assert not errs, errs
+        for rec in recs:
+            fr = rec["freshness"]
+            assert fr is not None, "armed plane must not report off"
+            assert fr["fleet"]["lag"] == {"count": 0}
+            assert fr["fleet"]["lag_samples"] == 0
+            sl = rec["slo"]
+            assert sl is not None
+            assert sl["burning"] == [] and sl["burns"] == 0
+            assert sl["checks"] > 0, "armed tracker never evaluated"
+            assert sl["targets"]["read_ms"] == 20.0
+    finally:
+        for b in buses:
+            b.close()
+
+
+# ------------------------------------------- storm accounting (bench)
+def test_storm_off_done_line_carries_none_latency_keys():
+    """The pull_storm_3proc schema fix (coordinated omission): storm
+    OFF pins the read_intended_ms/read_svc_ms keys to None — present
+    in every done line, so artifact diffs see the schema, not a
+    KeyError."""
+    import os as _os
+    import pathlib
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    proc = subprocess.run(
+        [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
+         "--path", "sparse", "--iters", "6", "--warmup", "2",
+         "--rows", "1024", "--batch", "64"],
+        capture_output=True, text=True, timeout=120, cwd=repo,
+        env={**_os.environ, "MINIPS_FORCE_CPU": "1",
+             "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads([ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert "read_intended_ms" in out and "read_svc_ms" in out
+    assert out["read_intended_ms"] is None
+    assert out["read_svc_ms"] is None
+
+
+@pytest.mark.slow
+def test_storm_records_intended_arrival_latency_next_to_service():
+    """Armed side of the storm fix: a 2-proc storm run must summarize
+    BOTH clocks, with intended-arrival latency >= service latency
+    (the schedule debt a closed loop would have hidden)."""
+    from minips_tpu import launch
+
+    res = launch.run_local_job(
+        2, [sys.executable, "-m", "minips_tpu.apps.sharded_ps_bench",
+            "--path", "sparse", "--iters", "12", "--warmup", "3",
+            "--rows", "2048", "--batch", "128",
+            "--storm", "2", "--storm-batch", "8",
+            "--storm-think-ms", "5"],
+        base_port=None, timeout=240.0,
+        env_extra={"MINIPS_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"})
+    assert len(res) == 2
+    for r in res:
+        assert r["event"] == "done"
+        iv, sv = r["read_intended_ms"], r["read_svc_ms"]
+        assert iv["count"] == sv["count"] > 0
+        # intended includes the wait-for-schedule leg: never below
+        # service at the median (log2-quantized, so >= not >)
+        assert iv["p50_ms"] >= sv["p50_ms"]
